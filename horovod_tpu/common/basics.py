@@ -1,0 +1,243 @@
+"""Global runtime state and rank contexts.
+
+TPU-native analogue of the reference's ``HorovodBasics``
+(horovod/common/basics.py:29-340) + the C ABI topology queries
+(operations.cc:932-1404).  Where the reference loads a shared library
+and keeps per-*process* rank state, the TPU runtime keeps per-*rank
+contexts* inside the host process: a TPU host drives all of its chips
+from one process, so ranks are thread/SPMD positions bound to mesh
+devices rather than one OS process per accelerator.
+"""
+
+import os
+import threading
+
+from . import env as env_mod
+from .exceptions import HorovodInitError
+from .topology import Topology
+
+_state_lock = threading.RLock()
+_engine = None
+_topology = None
+_timeline = None
+_tls = threading.local()
+
+
+class RankContext:
+    """Per-rank identity + auto-naming counters.  The reference names
+    unnamed ops by a per-process incrementing id
+    (e.g. allreduce.noname.1); here the counter is per rank context."""
+
+    def __init__(self, rank):
+        self.rank = rank
+        self._counters = {}
+
+    def next_name(self, op_name):
+        n = self._counters.get(op_name, 0) + 1
+        self._counters[op_name] = n
+        return f"{op_name}.noname.{n}"
+
+
+def _make_timeline(config):
+    from ..utils.timeline import Timeline
+    if config.timeline_filename:
+        return Timeline(config.timeline_filename, config.timeline_mark_cycles)
+    return None
+
+
+def init(comm=None, process_sets=None, num_ranks=None, devices=None):
+    """Initialize the runtime (reference horovod_init,
+    operations.cc:934 → InitializeHorovodOnce :856).
+
+    * ``num_ranks`` — number of ranks this process hosts.  Defaults to
+      ``HOROVOD_TPU_RANKS_PER_PROC`` (set by the launcher) or 1.
+    * ``comm`` — list of global ranks (subset init), kept for API
+      parity; MPI communicators are not a TPU concept.
+    * ``process_sets`` — list of ProcessSet objects to register at
+      init time (reference basics.py:51-148).
+    """
+    global _engine, _topology, _timeline
+    with _state_lock:
+        if _engine is not None:
+            # Reference allows repeated init as a no-op once running.
+            _bind_thread_if_unbound()
+            return
+        from ..core.engine import Engine
+
+        if num_ranks is None:
+            num_ranks = env_mod.get_int(env_mod.HOROVOD_TPU_RANKS_PER_PROC, 0)
+        if not num_ranks:
+            num_ranks = 1
+        if devices is None:
+            import jax
+            platform = env_mod.get_str(env_mod.HOROVOD_TPU_PLATFORM)
+            devices = jax.devices(platform) if platform else jax.devices()
+        config = env_mod.Config()
+        _topology = Topology(size=num_ranks)
+        _timeline = _make_timeline(config)
+        _engine = Engine(num_ranks, devices, config=config,
+                         topology=_topology, timeline=_timeline)
+        if process_sets:
+            from . import process_sets as ps_mod
+            for ps in process_sets:
+                ps_mod._register(ps)
+        _bind_thread_if_unbound()
+
+
+def _bind_thread_if_unbound():
+    if getattr(_tls, "ctx", None) is None and _engine is not None:
+        if _engine.num_ranks == 1:
+            _tls.ctx = RankContext(0)
+
+
+def bind_rank(rank):
+    """Bind the calling thread to a rank context.  Used by the thread
+    launcher (one thread per rank) and by tests."""
+    if _engine is None:
+        raise HorovodInitError("horovod_tpu.init() has not been called")
+    if rank < 0 or rank >= _engine.num_ranks:
+        raise ValueError(f"rank {rank} out of range [0, {_engine.num_ranks})")
+    _tls.ctx = RankContext(rank)
+    return _tls.ctx
+
+
+def unbind_rank():
+    _tls.ctx = None
+
+
+def context() -> RankContext:
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is None:
+        if _engine is None:
+            raise HorovodInitError(
+                "horovod_tpu has not been initialized; call init() first")
+        _bind_thread_if_unbound()
+        ctx = getattr(_tls, "ctx", None)
+        if ctx is None:
+            raise HorovodInitError(
+                "this thread is not bound to a rank; use horovod_tpu.run() "
+                "or bind_rank()")
+    return ctx
+
+
+def engine():
+    if _engine is None:
+        raise HorovodInitError(
+            "horovod_tpu has not been initialized; call init() first")
+    return _engine
+
+
+def is_initialized():
+    return _engine is not None
+
+
+def shutdown():
+    """Reference horovod_shutdown (operations.cc:966)."""
+    global _engine, _topology, _timeline
+    with _state_lock:
+        if _engine is None:
+            return
+        _engine.shutdown()
+        if _timeline is not None:
+            _timeline.close()
+        from . import process_sets as ps_mod
+        ps_mod._reset()
+        _engine = None
+        _topology = None
+        _timeline = None
+        _tls.ctx = None
+
+
+# -- topology queries (reference operations.cc:996-1075) -----------------------
+
+def rank():
+    return context().rank
+
+
+def size():
+    return engine().num_ranks
+
+
+def local_rank():
+    return engine().topology.local_rank(rank())
+
+
+def local_size():
+    return engine().topology.local_size(rank())
+
+
+def cross_rank():
+    return engine().topology.cross_rank(rank())
+
+
+def cross_size():
+    return engine().topology.cross_size(rank())
+
+
+def is_homogeneous():
+    return engine().topology.is_homogeneous()
+
+
+# -- build-feature queries (reference basics.py:250-340).  The TPU
+#    runtime has exactly one data plane: compiled XLA collectives. ------------
+
+def mpi_threads_supported():
+    return False
+
+
+def mpi_built():
+    return False
+
+
+def gloo_built():
+    return False
+
+
+def nccl_built():
+    return False
+
+
+def ddl_built():
+    return False
+
+
+def ccl_built():
+    return False
+
+
+def cuda_built():
+    return False
+
+
+def rocm_built():
+    return False
+
+
+def xla_built():
+    return True
+
+
+def tpu_built():
+    return True
+
+
+def start_timeline(filename, mark_cycles=False):
+    """Runtime timeline activation (reference operations.cc:1077)."""
+    global _timeline
+    with _state_lock:
+        eng = engine()
+        if _timeline is not None:
+            raise ValueError("timeline already active; stop it first")
+        from ..utils.timeline import Timeline
+        _timeline = Timeline(filename, mark_cycles)
+        eng.timeline = _timeline
+
+
+def stop_timeline():
+    global _timeline
+    with _state_lock:
+        eng = engine()
+        if _timeline is not None:
+            _timeline.close()
+        _timeline = None
+        eng.timeline = None

@@ -1,0 +1,113 @@
+"""Environment-variable configuration surface.
+
+The reference uses ~40 ``HOROVOD_*`` env vars as the ABI between the
+launcher and the core runtime (reference common/common.h:115-149, parsed
+in operations.cc:459-650 and utils/env_parser.cc).  We keep the same
+names so launcher flags, config files and user habits carry over.
+"""
+
+import os
+
+# --- knob names (reference common.h:115-149) ---------------------------------
+HOROVOD_FUSION_THRESHOLD = "HOROVOD_FUSION_THRESHOLD"
+HOROVOD_CYCLE_TIME = "HOROVOD_CYCLE_TIME"
+HOROVOD_CACHE_CAPACITY = "HOROVOD_CACHE_CAPACITY"
+HOROVOD_TIMELINE = "HOROVOD_TIMELINE"
+HOROVOD_TIMELINE_MARK_CYCLES = "HOROVOD_TIMELINE_MARK_CYCLES"
+HOROVOD_AUTOTUNE = "HOROVOD_AUTOTUNE"
+HOROVOD_AUTOTUNE_LOG = "HOROVOD_AUTOTUNE_LOG"
+HOROVOD_AUTOTUNE_WARMUP_SAMPLES = "HOROVOD_AUTOTUNE_WARMUP_SAMPLES"
+HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE = "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"
+HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES = "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"
+HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE = "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"
+HOROVOD_STALL_CHECK_DISABLE = "HOROVOD_STALL_CHECK_DISABLE"
+HOROVOD_STALL_CHECK_TIME_SECONDS = "HOROVOD_STALL_CHECK_TIME_SECONDS"
+HOROVOD_STALL_SHUTDOWN_TIME_SECONDS = "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"
+HOROVOD_HIERARCHICAL_ALLREDUCE = "HOROVOD_HIERARCHICAL_ALLREDUCE"
+HOROVOD_HIERARCHICAL_ALLGATHER = "HOROVOD_HIERARCHICAL_ALLGATHER"
+HOROVOD_TORUS_ALLREDUCE = "HOROVOD_TORUS_ALLREDUCE"
+HOROVOD_ELASTIC = "HOROVOD_ELASTIC"
+HOROVOD_LOG_LEVEL = "HOROVOD_LOG_LEVEL"
+HOROVOD_LOG_HIDE_TIME = "HOROVOD_LOG_HIDE_TIME"
+
+# rank/topology handoff from the launcher (reference gloo_run.py:66-103)
+HOROVOD_RANK = "HOROVOD_RANK"
+HOROVOD_SIZE = "HOROVOD_SIZE"
+HOROVOD_LOCAL_RANK = "HOROVOD_LOCAL_RANK"
+HOROVOD_LOCAL_SIZE = "HOROVOD_LOCAL_SIZE"
+HOROVOD_CROSS_RANK = "HOROVOD_CROSS_RANK"
+HOROVOD_CROSS_SIZE = "HOROVOD_CROSS_SIZE"
+HOROVOD_HOSTNAME = "HOROVOD_HOSTNAME"
+HOROVOD_RENDEZVOUS_ADDR = "HOROVOD_GLOO_RENDEZVOUS_ADDR"
+HOROVOD_RENDEZVOUS_PORT = "HOROVOD_GLOO_RENDEZVOUS_PORT"
+HOROVOD_CONTROLLER = "HOROVOD_CONTROLLER"
+HOROVOD_CPU_OPERATIONS = "HOROVOD_CPU_OPERATIONS"
+
+# TPU-native additions
+HOROVOD_TPU_PLATFORM = "HOROVOD_TPU_PLATFORM"  # jax platform for the mesh
+HOROVOD_TPU_RANKS_PER_PROC = "HOROVOD_TPU_RANKS_PER_PROC"
+HOROVOD_TPU_COORDINATOR = "HOROVOD_TPU_COORDINATOR"
+HOROVOD_TPU_NUM_PROCS = "HOROVOD_TPU_NUM_PROCS"
+HOROVOD_TPU_PROC_INDEX = "HOROVOD_TPU_PROC_INDEX"
+
+DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024
+DEFAULT_CYCLE_TIME_MS = 1.0
+DEFAULT_CACHE_CAPACITY = 1024
+DEFAULT_STALL_WARNING_SECS = 60.0
+
+
+def get_bool(name, default=False):
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    return val.strip().lower() in ("1", "true", "yes", "on")
+
+
+def get_int(name, default=0):
+    val = os.environ.get(name)
+    if val is None or not val.strip():
+        return default
+    try:
+        return int(val)
+    except ValueError:
+        return default
+
+
+def get_float(name, default=0.0):
+    val = os.environ.get(name)
+    if val is None or not val.strip():
+        return default
+    try:
+        return float(val)
+    except ValueError:
+        return default
+
+
+def get_str(name, default=None):
+    return os.environ.get(name, default)
+
+
+class Config:
+    """Runtime knobs resolved from the environment at init() time.
+
+    Mirrors the parse performed in the reference's BackgroundThreadLoop
+    (operations.cc:459-650): fusion threshold, cycle time, cache
+    capacity, stall-inspector and autotune settings.
+    """
+
+    def __init__(self):
+        self.fusion_threshold_bytes = get_int(
+            HOROVOD_FUSION_THRESHOLD, DEFAULT_FUSION_THRESHOLD_BYTES)
+        self.cycle_time_ms = get_float(HOROVOD_CYCLE_TIME, DEFAULT_CYCLE_TIME_MS)
+        self.cache_capacity = get_int(HOROVOD_CACHE_CAPACITY, DEFAULT_CACHE_CAPACITY)
+        self.timeline_filename = get_str(HOROVOD_TIMELINE)
+        self.timeline_mark_cycles = get_bool(HOROVOD_TIMELINE_MARK_CYCLES)
+        self.autotune = get_bool(HOROVOD_AUTOTUNE)
+        self.autotune_log = get_str(HOROVOD_AUTOTUNE_LOG)
+        self.autotune_warmup_samples = get_int(HOROVOD_AUTOTUNE_WARMUP_SAMPLES, 3)
+        self.autotune_steps_per_sample = get_int(HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE, 10)
+        self.stall_check_disable = get_bool(HOROVOD_STALL_CHECK_DISABLE)
+        self.stall_warning_secs = get_float(
+            HOROVOD_STALL_CHECK_TIME_SECONDS, DEFAULT_STALL_WARNING_SECS)
+        self.stall_shutdown_secs = get_float(HOROVOD_STALL_SHUTDOWN_TIME_SECONDS, 0.0)
+        self.elastic = get_bool(HOROVOD_ELASTIC)

@@ -1,0 +1,52 @@
+"""Exception types for horovod_tpu.
+
+Mirrors the capability surface of the reference's
+``horovod/common/exceptions.py`` (HorovodInternalError,
+HostsUpdatedInterrupt) while adding engine-specific errors for the
+TPU-native runtime.
+"""
+
+
+class HorovodInternalError(RuntimeError):
+    """Internal error raised when a collective operation fails.
+
+    In elastic mode this triggers state restoration and re-rendezvous
+    (see reference horovod/common/exceptions.py:20).
+    """
+
+
+class HostsUpdatedInterrupt(RuntimeError):
+    """Raised asynchronously when the set of available hosts changes.
+
+    Carries ``skip_sync``: when True, the worker state is assumed
+    current and need not be restored from the last commit
+    (reference horovod/common/exceptions.py:30).
+    """
+
+    def __init__(self, skip_sync=False):
+        super().__init__("hosts updated")
+        self.skip_sync = skip_sync
+
+
+class HorovodInitError(RuntimeError):
+    """Raised when the runtime is used before ``init()`` (or after
+    ``shutdown()``)."""
+
+
+class TensorShapeMismatchError(HorovodInternalError):
+    """Cross-rank shape/dtype/op validation failure.
+
+    The reference coordinator constructs an ERROR response when ranks
+    disagree (controller.cc:496-843); we raise this on every
+    participating rank.
+    """
+
+
+class DuplicateNameError(HorovodInternalError):
+    """Same tensor name submitted twice by one rank before completion
+    (reference common.h:238 DUPLICATE_NAME_ERROR)."""
+
+
+class StalledTensorError(HorovodInternalError):
+    """A tensor was ready on some ranks but missing on others past the
+    stall-shutdown deadline (reference stall_inspector.h)."""

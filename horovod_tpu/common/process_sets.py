@@ -1,0 +1,113 @@
+"""Process sets — collectives over arbitrary rank subsets.
+
+API parity with the reference's ``horovod/common/process_sets.py``
+(ProcessSet :18, add_process_set :123, remove_process_set :145).  Each
+registered set owns a sub-mesh executor in the engine, the TPU-native
+analogue of a per-set communicator (reference process_set.h:26-84).
+"""
+
+import threading
+
+from . import basics
+
+_lock = threading.Lock()
+_registered = {}   # id -> ProcessSet
+
+
+class ProcessSet:
+    """A set of global ranks collectives may be restricted to."""
+
+    def __init__(self, ranks=None):
+        self.ranks = sorted(set(int(r) for r in ranks)) if ranks else None
+        self.process_set_id = None
+
+    def _require_registered(self):
+        if self.process_set_id is None:
+            raise ValueError(
+                "process set is not yet registered with add_process_set() "
+                "or init(process_sets=...)")
+
+    def size(self):
+        self._require_registered()
+        return len(basics.engine().process_set_ranks(self.process_set_id))
+
+    def rank(self):
+        """Rank of the current rank context within this set (reference
+        process_sets.py ProcessSet.rank)."""
+        self._require_registered()
+        ranks = basics.engine().process_set_ranks(self.process_set_id)
+        me = basics.rank()
+        if me not in ranks:
+            return -1
+        return ranks.index(me)
+
+    def included(self):
+        self._require_registered()
+        return basics.rank() in basics.engine().process_set_ranks(
+            self.process_set_id)
+
+    def __repr__(self):
+        return (f"ProcessSet(process_set_id={self.process_set_id}, "
+                f"ranks={self.ranks})")
+
+
+global_process_set = ProcessSet()
+global_process_set.process_set_id = 0
+
+
+def _register(ps: ProcessSet):
+    if ps.process_set_id is not None:
+        return ps
+    if ps.ranks is None:
+        raise ValueError("cannot register a process set without ranks")
+    ps.process_set_id = basics.engine().add_process_set(ps.ranks)
+    with _lock:
+        _registered[ps.process_set_id] = ps
+    return ps
+
+
+def add_process_set(process_set) -> ProcessSet:
+    """Register a new process set dynamically (reference
+    process_sets.py:123: requires HOROVOD_DYNAMIC_PROCESS_SETS in the
+    reference; the TPU engine supports it unconditionally)."""
+    if isinstance(process_set, ProcessSet):
+        ps = process_set
+    else:
+        ps = ProcessSet(process_set)
+    return _register(ps)
+
+
+def remove_process_set(process_set) -> bool:
+    """Deregister (reference process_sets.py:145)."""
+    ps_id = process_set.process_set_id if isinstance(process_set, ProcessSet) \
+        else int(process_set)
+    if ps_id is None or ps_id == 0:
+        return False
+    ok = basics.engine().remove_process_set(ps_id)
+    if ok:
+        with _lock:
+            reg = _registered.pop(ps_id, None)
+        if reg is not None:
+            reg.process_set_id = None
+    return ok
+
+
+def process_set_ids():
+    return sorted([0] + list(_registered.keys()))
+
+
+def _get_by_id(ps_id):
+    if ps_id == 0:
+        return global_process_set
+    with _lock:
+        return _registered.get(ps_id)
+
+
+def _reset():
+    global _registered
+    with _lock:
+        _registered = {}
+
+
+def global_ranks():
+    return list(range(basics.size()))

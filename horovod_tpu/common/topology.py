@@ -1,0 +1,51 @@
+"""Rank topology: global / local (ICI) / cross (DCN).
+
+The reference derives a 3-level topology from MPI communicators
+(mpi/mpi_context.h:104-113: global_comm / local_comm / cross_comm) and
+uses it for hierarchical and torus collectives.  On TPU the same levels
+fall out of the platform: ranks on one host share ICI (local), hosts
+connect over DCN (cross).
+"""
+
+from dataclasses import dataclass, field
+from typing import List
+
+
+@dataclass
+class Topology:
+    """Static rank layout for one job."""
+    size: int
+    # host index per global rank; threads-mode jobs are single-host.
+    host_of_rank: List[int] = field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.host_of_rank:
+            self.host_of_rank = [0] * self.size
+
+    @property
+    def num_hosts(self):
+        return max(self.host_of_rank) + 1 if self.host_of_rank else 1
+
+    def local_ranks(self, host):
+        return [r for r, h in enumerate(self.host_of_rank) if h == host]
+
+    def local_rank(self, rank):
+        host = self.host_of_rank[rank]
+        return self.local_ranks(host).index(rank)
+
+    def local_size(self, rank):
+        return len(self.local_ranks(self.host_of_rank[rank]))
+
+    def cross_rank(self, rank):
+        """Rank among same-local-rank peers across hosts (reference
+        cross_comm semantics: one rank per node at each local index)."""
+        return self.host_of_rank[rank]
+
+    def cross_size(self, rank):
+        lr = self.local_rank(rank)
+        return sum(1 for h in range(self.num_hosts)
+                   if len(self.local_ranks(h)) > lr)
+
+    def is_homogeneous(self):
+        sizes = {len(self.local_ranks(h)) for h in range(self.num_hosts)}
+        return len(sizes) <= 1

@@ -1,0 +1,50 @@
+"""Array conversion + misc helpers shared across the API surface."""
+
+import io
+import numpy as np
+
+
+def to_numpy(tensor):
+    """Convert an input value to a host ndarray, remembering the
+    original kind so results can be returned in the caller's type.
+    Supported kinds: numpy, jax, python scalar/list."""
+    kind = "numpy"
+    if hasattr(tensor, "__module__") and type(tensor).__module__.startswith("jax"):
+        kind = "jax"
+        arr = np.asarray(tensor)
+    elif isinstance(tensor, np.ndarray):
+        arr = tensor
+    elif isinstance(tensor, (int, float, bool, complex)):
+        kind = "scalar"
+        arr = np.asarray(tensor)
+    elif isinstance(tensor, (list, tuple)):
+        kind = "numpy"
+        arr = np.asarray(tensor)
+    else:
+        # torch / tf tensors are converted by their bindings before
+        # reaching the core API; anything else must support __array__.
+        arr = np.asarray(tensor)
+    return arr, kind
+
+
+def from_numpy(arr, kind):
+    if kind == "jax":
+        import jax.numpy as jnp
+        return jnp.asarray(arr)
+    if kind == "scalar":
+        return arr.item() if arr.ndim == 0 else arr
+    return arr
+
+
+def dumps(obj) -> np.ndarray:
+    """Pickle an object into a uint8 tensor (reference
+    tensorflow/functions.py broadcast_object serialization)."""
+    import pickle
+    buf = io.BytesIO()
+    pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
+    return np.frombuffer(buf.getvalue(), dtype=np.uint8).copy()
+
+
+def loads(arr) -> object:
+    import pickle
+    return pickle.loads(arr.tobytes())

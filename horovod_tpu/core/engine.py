@@ -1,0 +1,632 @@
+"""Core runtime: rank contexts, negotiation, fusion, dispatch.
+
+TPU-native analogue of the reference's core
+(``horovod/common/operations.cc`` BackgroundThreadLoop/RunLoopOnce +
+``controller.cc`` ComputeResponseList):
+
+* Each **rank** is a rank context bound to a device of the mesh.  On a
+  TPU host one process drives all local chips, so ranks live as threads
+  of one process (launcher) or as positions in an SPMD program — not as
+  one OS process per accelerator the way CUDA forces.
+* Rank threads **enqueue** tensors (EnqueueTensorAllreduce analogue);
+  a single background thread negotiates readiness (a tensor executes
+  only when every participating rank has submitted it — the exact
+  contract of controller.cc:74-474), **fuses** ready allreduces into
+  buckets under the fusion threshold (FuseResponses,
+  controller.cc:901-1080), and dispatches each bucket to a cached
+  compiled XLA collective (ops/xla_ops.py).
+* Completion flows back through async handles
+  (torch/handle_manager.h analogue).
+
+The in-process controller needs no gatherv/bcast wire protocol: the
+negotiation table *is* shared memory.  Multi-host deployments layer a
+store-based controller on top (runner/), with this same engine running
+per host.
+"""
+
+import logging
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..common import env as env_mod
+from ..common.exceptions import (
+    DuplicateNameError,
+    HorovodInternalError,
+    HorovodInitError,
+    StalledTensorError,
+    TensorShapeMismatchError,
+)
+from .message import ReduceOp, Request, RequestType
+from .handles import Handle, HandleManager
+
+logger = logging.getLogger("horovod_tpu")
+
+
+@dataclass
+class Submission:
+    """One rank's (possibly grouped) tensor submission — the engine-side
+    TensorTableEntry (reference common.h TensorTableEntry)."""
+    rank: int
+    request: Request
+    names: List[str]
+    payloads: List[np.ndarray]          # host buffers, one per tensor
+    handle: Handle
+    enq_time: float = field(default_factory=time.monotonic)
+
+
+class NegotiationEntry:
+    """Readiness table row (reference controller.cc:1115-1140
+    IncrementTensorCount)."""
+
+    __slots__ = ("key", "subs", "first_time")
+
+    def __init__(self, key):
+        self.key = key
+        self.subs: Dict[int, Submission] = {}
+        self.first_time = time.monotonic()
+
+
+class ProcessSetState:
+    """Runtime state for one process set (reference process_set.h:26-84:
+    controller + tensor queue + joined state per set)."""
+
+    def __init__(self, ps_id, ranks, executor):
+        self.id = ps_id
+        self.ranks = list(ranks)            # global ranks, sorted
+        self.index = {r: i for i, r in enumerate(self.ranks)}
+        self.executor = executor
+        self.pending: "OrderedDict[str, NegotiationEntry]" = OrderedDict()
+        self.joined = set()                 # ranks that called join()
+        self.last_joined = -1
+        self.join_waiters: Dict[int, Handle] = {}
+
+    @property
+    def size(self):
+        return len(self.ranks)
+
+
+class Engine:
+    """The per-process core runtime (reference HorovodGlobalState +
+    BackgroundThreadLoop, global_state.h:39-126, operations.cc:409-749).
+    """
+
+    def __init__(self, num_ranks, devices, config=None, topology=None,
+                 timeline=None):
+        from ..ops.xla_ops import MeshExecutor
+
+        self.config = config or env_mod.Config()
+        self.num_ranks = num_ranks
+        self.devices = list(devices)
+        self.topology = topology
+        self.handle_manager = HandleManager()
+        self.timeline = timeline
+
+        self._lock = threading.Condition()
+        self._shutdown = False
+        self._aborted: Optional[BaseException] = None
+        self._shutdown_done = threading.Event()
+
+        self._MeshExecutor = MeshExecutor
+        ps0 = ProcessSetState(
+            0, range(num_ranks),
+            MeshExecutor(self._devices_for(range(num_ranks)), num_ranks))
+        self.process_sets: Dict[int, ProcessSetState] = {0: ps0}
+        self._next_ps_id = 1
+
+        self._stall_warned = set()
+        self._thread = threading.Thread(
+            target=self._background_loop, name="horovod_tpu-engine",
+            daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    # process sets
+
+    def _devices_for(self, ranks):
+        nd = len(self.devices)
+        return [self.devices[r % nd] for r in ranks]
+
+    def add_process_set(self, ranks) -> int:
+        ranks = sorted(set(int(r) for r in ranks))
+        if any(r < 0 or r >= self.num_ranks for r in ranks):
+            raise ValueError(f"process set ranks {ranks} out of range")
+        with self._lock:
+            for ps in self.process_sets.values():
+                if ps.ranks == ranks:
+                    raise ValueError(
+                        f"process set with ranks {ranks} already exists "
+                        f"(id {ps.id})")
+            ps_id = self._next_ps_id
+            self._next_ps_id += 1
+            self.process_sets[ps_id] = ProcessSetState(
+                ps_id, ranks,
+                self._MeshExecutor(self._devices_for(ranks), len(ranks)))
+            return ps_id
+
+    def remove_process_set(self, ps_id) -> bool:
+        if ps_id == 0:
+            raise ValueError("cannot remove the global process set")
+        with self._lock:
+            ps = self.process_sets.pop(ps_id, None)
+            if ps is None:
+                return False
+            for entry in ps.pending.values():
+                for sub in entry.subs.values():
+                    sub.handle.set_error(HorovodInternalError(
+                        f"process set {ps_id} removed while "
+                        f"{entry.key[0]} pending"))
+            return True
+
+    def get_process_set(self, ps_id) -> ProcessSetState:
+        ps = self.process_sets.get(ps_id)
+        if ps is None:
+            raise ValueError(f"unknown process set id {ps_id}")
+        return ps
+
+    def process_set_ranks(self, ps_id):
+        return list(self.get_process_set(ps_id).ranks)
+
+    # ------------------------------------------------------------------
+    # submission (rank threads)
+
+    def submit(self, sub: Submission) -> Handle:
+        """EnqueueTensorAllreduce/... analogue (operations.cc:1408-2060):
+        register the submission in the negotiation table; the background
+        thread executes it once all participating ranks arrive."""
+        with self._lock:
+            if self._shutdown:
+                raise HorovodInitError("horovod_tpu has been shut down")
+            if self._aborted is not None:
+                sub.handle.set_error(HorovodInternalError(
+                    f"horovod_tpu aborted: {self._aborted!r}"))
+                return sub.handle
+            ps = self.get_process_set(sub.request.process_set_id)
+            if sub.rank not in ps.index:
+                raise ValueError(
+                    f"rank {sub.rank} is not part of process set {ps.id}")
+            key = self._negotiation_key(sub)
+            entry = ps.pending.get(key)
+            if entry is None:
+                entry = NegotiationEntry(key)
+                ps.pending[key] = entry
+            if sub.rank in entry.subs:
+                sub.handle.set_error(DuplicateNameError(
+                    f"tensor {sub.names} submitted twice by rank "
+                    f"{sub.rank} before completion"))
+                return sub.handle
+            entry.subs[sub.rank] = sub
+            if self.timeline is not None:
+                self.timeline.negotiate_start(sub.names[0],
+                                              sub.request.request_type.name)
+            self._lock.notify_all()
+        return sub.handle
+
+    def join(self, rank, ps_id=0) -> Handle:
+        """Join op (operations.cc:1991-2024): the rank stops submitting;
+        pending/future allreduces treat it as a zero contributor.  The
+        handle completes when every rank of the set has joined, with
+        result = the last rank to join (message.h last_joined_rank)."""
+        handle = Handle()
+        with self._lock:
+            if self._shutdown:
+                raise HorovodInitError("horovod_tpu has been shut down")
+            if self._aborted is not None:
+                handle.set_error(HorovodInternalError(
+                    f"horovod_tpu aborted: {self._aborted!r}"))
+                return handle
+            ps = self.get_process_set(ps_id)
+            if rank in ps.joined:
+                handle.set_error(HorovodInternalError(
+                    f"rank {rank} already joined"))
+                return handle
+            ps.joined.add(rank)
+            ps.last_joined = rank
+            ps.join_waiters[rank] = handle
+            self._lock.notify_all()
+        return handle
+
+    def _negotiation_key(self, sub: Submission):
+        return (sub.request.request_type, tuple(sub.names))
+
+    # ------------------------------------------------------------------
+    # background loop
+
+    def _background_loop(self):
+        cycle = max(self.config.cycle_time_ms, 0.05) / 1000.0
+        while True:
+            with self._lock:
+                if not self._shutdown:
+                    self._lock.wait(timeout=cycle)
+                if self._shutdown:
+                    self._fail_all_pending_locked(
+                        HorovodInitError("shutdown during pending collective"))
+                    break
+                work = self._collect_ready_locked()
+                self._check_stalls_locked()
+            for ps, batch in work:
+                self._execute_batch(ps, batch)
+        self._shutdown_done.set()
+
+    def _collect_ready_locked(self):
+        """ComputeResponseList analogue: pull fully-ready negotiation
+        entries (readiness = submissions from every non-joined rank of
+        the set, controller.cc:269-327 for the joined case) and resolve
+        join barriers."""
+        work = []
+        for ps in list(self.process_sets.values()):
+            # join barrier: every rank joined -> release all waiters
+            if ps.joined and len(ps.joined) == ps.size:
+                for r, h in ps.join_waiters.items():
+                    h.set_result(ps.last_joined)
+                ps.join_waiters.clear()
+                ps.joined.clear()
+                ps.last_joined = -1
+            ready = []
+            for key in list(ps.pending.keys()):
+                entry = ps.pending[key]
+                needed = [r for r in ps.ranks if r not in ps.joined]
+                if all(r in entry.subs for r in needed):
+                    ready.append(entry)
+                    del ps.pending[key]
+                    self._stall_warned.discard((ps.id,) + key)
+            if ready:
+                work.append((ps, ready))
+        return work
+
+    def _check_stalls_locked(self):
+        """Stall inspector (reference stall_inspector.{h,cc}): warn when
+        a tensor is ready on some-but-not-all ranks past the warning
+        time; error everyone past the shutdown time."""
+        if self.config.stall_check_disable:
+            return
+        now = time.monotonic()
+        for ps in self.process_sets.values():
+            for key, entry in list(ps.pending.items()):
+                age = now - entry.first_time
+                wkey = (ps.id,) + key
+                if (age > self.config.stall_warning_secs
+                        and wkey not in self._stall_warned):
+                    missing = [r for r in ps.ranks
+                               if r not in entry.subs and r not in ps.joined]
+                    logger.warning(
+                        "One or more tensors were submitted to be reduced "
+                        "by some ranks but not all: %s stalled for %.0fs "
+                        "(missing ranks: %s)", key[1], age, missing)
+                    self._stall_warned.add(wkey)
+                if (self.config.stall_shutdown_secs > 0
+                        and age > self.config.stall_shutdown_secs):
+                    del ps.pending[key]
+                    for sub in entry.subs.values():
+                        sub.handle.set_error(StalledTensorError(
+                            f"tensor {key[1]} stalled for {age:.0f}s"))
+
+    def _fail_all_pending_locked(self, exc):
+        for ps in self.process_sets.values():
+            for entry in ps.pending.values():
+                for sub in entry.subs.values():
+                    sub.handle.set_error(exc)
+            ps.pending.clear()
+            for h in ps.join_waiters.values():
+                h.set_error(exc)
+            ps.join_waiters.clear()
+
+    # ------------------------------------------------------------------
+    # validation + fusion + execution (background thread)
+
+    def _execute_batch(self, ps: ProcessSetState, entries):
+        """PerformOperation analogue (operations.cc:277-334): validate,
+        fuse allreduce entries into buckets, run each response."""
+        runnable = []
+        for entry in entries:
+            err = self._validate(ps, entry)
+            if err is not None:
+                for sub in entry.subs.values():
+                    sub.handle.set_error(err)
+                continue
+            runnable.append(entry)
+
+        buckets = self._fuse(ps, runnable)
+        for bucket in buckets:
+            try:
+                self._run_bucket(ps, bucket)
+            except Exception as exc:  # noqa: BLE001 — deliver to waiters
+                logger.exception("collective execution failed")
+                wrapped = exc if isinstance(exc, HorovodInternalError) \
+                    else HorovodInternalError(str(exc))
+                for entry in bucket:
+                    for sub in entry.subs.values():
+                        sub.handle.set_error(wrapped)
+
+    def _validate(self, ps, entry) -> Optional[Exception]:
+        """Cross-rank consistency checks, mirroring ConstructResponse
+        (controller.cc:496-843): dtype, shape, op, scale factors and
+        root must agree across ranks."""
+        subs = [entry.subs[r] for r in ps.ranks if r in entry.subs]
+        first = subs[0].request
+        rt = first.request_type
+        for sub in subs[1:]:
+            r = sub.request
+            if r.dtype != first.dtype:
+                return TensorShapeMismatchError(
+                    f"Mismatched data types for {first.tensor_name}: rank "
+                    f"{sub.rank} sent {r.dtype}, rank {subs[0].rank} sent "
+                    f"{first.dtype}")
+            if r.reduce_op != first.reduce_op:
+                return TensorShapeMismatchError(
+                    f"Mismatched reduce ops for {first.tensor_name}")
+            if (r.prescale_factor != first.prescale_factor
+                    or r.postscale_factor != first.postscale_factor):
+                return TensorShapeMismatchError(
+                    f"Mismatched prescale/postscale for {first.tensor_name}")
+            if rt == RequestType.BROADCAST and r.root_rank != first.root_rank:
+                return TensorShapeMismatchError(
+                    f"Mismatched broadcast root for {first.tensor_name}: "
+                    f"{r.root_rank} vs {first.root_rank}")
+            if rt in (RequestType.ALLREDUCE, RequestType.ADASUM,
+                      RequestType.BROADCAST, RequestType.REDUCESCATTER):
+                if r.shape != first.shape:
+                    return TensorShapeMismatchError(
+                        f"Mismatched shapes for {first.tensor_name}: rank "
+                        f"{sub.rank} sent {r.shape}, rank {subs[0].rank} "
+                        f"sent {first.shape}")
+            elif rt in (RequestType.ALLGATHER, RequestType.ALLTOALL):
+                if tuple(r.shape[1:]) != tuple(first.shape[1:]):
+                    return TensorShapeMismatchError(
+                        f"Mismatched non-first dimensions for "
+                        f"{first.tensor_name}")
+            if rt == RequestType.ALLTOALL:
+                if r.splits is None or len(r.splits) != ps.size:
+                    return TensorShapeMismatchError(
+                        f"alltoall splits for {first.tensor_name} must "
+                        f"have one entry per rank of the process set")
+                if sum(r.splits) != (r.shape[0] if r.shape else 0):
+                    return TensorShapeMismatchError(
+                        f"alltoall splits for {first.tensor_name} must sum "
+                        f"to the first dimension")
+        if rt == RequestType.ALLTOALL:
+            r0 = first
+            if r0.splits is None or len(r0.splits) != ps.size or \
+                    sum(r0.splits) != (r0.shape[0] if r0.shape else 0):
+                return TensorShapeMismatchError(
+                    f"alltoall splits invalid for {first.tensor_name}")
+        if len(subs) < ps.size and rt not in (
+                RequestType.ALLREDUCE, RequestType.ADASUM):
+            return HorovodInternalError(
+                f"rank(s) {[r for r in ps.ranks if r not in entry.subs]} "
+                f"joined; {rt.name} does not support join")
+        return None
+
+    def _fuse(self, ps, entries):
+        """FuseResponses analogue (controller.cc:901-1080): pack
+        consecutive ready allreduce entries with matching
+        (dtype, op, scales) into buckets up to the fusion threshold.
+        Non-allreduce ops execute one-per-bucket."""
+        threshold = self.config.fusion_threshold_bytes
+        buckets, cur, cur_bytes, cur_sig = [], [], 0, None
+        for entry in entries:
+            first = next(iter(entry.subs.values()))
+            rt = first.request.request_type
+            if rt not in (RequestType.ALLREDUCE, RequestType.ADASUM):
+                if cur:
+                    buckets.append(cur)
+                    cur, cur_bytes, cur_sig = [], 0, None
+                buckets.append([entry])
+                continue
+            sig = (rt, first.request.dtype, first.request.reduce_op,
+                   first.request.prescale_factor,
+                   first.request.postscale_factor)
+            nbytes = sum(p.nbytes for p in first.payloads)
+            if cur and (sig != cur_sig
+                        or cur_bytes + nbytes > threshold):
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(entry)
+            cur_bytes += nbytes
+            cur_sig = sig
+        if cur:
+            buckets.append(cur)
+        return buckets
+
+    def _run_bucket(self, ps, bucket):
+        first = next(iter(bucket[0].subs.values()))
+        rt = first.request.request_type
+        if self.timeline is not None:
+            names = [n for e in bucket for s in (next(iter(e.subs.values())),)
+                     for n in s.names]
+            self.timeline.op_start(names, rt.name)
+        try:
+            if rt in (RequestType.ALLREDUCE, RequestType.ADASUM):
+                self._run_allreduce_bucket(ps, bucket)
+            elif rt == RequestType.ALLGATHER:
+                self._run_allgather(ps, bucket[0])
+            elif rt == RequestType.BROADCAST:
+                self._run_broadcast(ps, bucket[0])
+            elif rt == RequestType.ALLTOALL:
+                self._run_alltoall(ps, bucket[0])
+            elif rt == RequestType.REDUCESCATTER:
+                self._run_reducescatter(ps, bucket[0])
+            elif rt == RequestType.BARRIER:
+                for sub in bucket[0].subs.values():
+                    sub.handle.set_result(None)
+            else:
+                raise HorovodInternalError(f"unhandled op {rt}")
+        finally:
+            if self.timeline is not None:
+                self.timeline.op_end()
+
+    def _run_allreduce_bucket(self, ps, bucket):
+        """Fused allreduce: one flat buffer per rank for the whole
+        bucket, one compiled collective, then unpack — the
+        MemcpyInFusionBuffer / MemcpyOutFusionBuffer pattern
+        (collective_operations.h:38-343) with numpy packing instead of
+        a batched-D2D CUDA kernel."""
+        first = next(iter(bucket[0].subs.values())).request
+        op = first.reduce_op
+        if first.request_type == RequestType.ADASUM:
+            op = ReduceOp.ADASUM
+        dtype = np.dtype(first.dtype) if first.dtype != "bfloat16" else \
+            _bfloat16_dtype()
+        # layout: [(entry, tensor_idx, offset, size, shape)]
+        layout = []
+        offset = 0
+        for entry in bucket:
+            ref_sub = next(iter(entry.subs.values()))
+            for i, p in enumerate(ref_sub.payloads):
+                layout.append((entry, i, offset, int(p.size), p.shape))
+                offset += int(p.size)
+        total = offset
+        rows = []
+        for r in ps.ranks:
+            buf = np.zeros(total, dtype=dtype)
+            for entry, i, off, size, _ in layout:
+                sub = entry.subs.get(r)
+                if sub is not None:      # joined ranks contribute zeros
+                    buf[off:off + size] = sub.payloads[i].ravel()
+            rows.append(buf)
+        results = ps.executor.allreduce(
+            rows, op, first.prescale_factor, first.postscale_factor)
+        per_entry_results = {}
+        for entry, i, off, size, shape in layout:
+            for r, sub in entry.subs.items():
+                out = results[ps.index[r]][off:off + size].reshape(shape)
+                per_entry_results.setdefault((id(entry), r), []).append(out)
+        for entry in bucket:
+            for r, sub in entry.subs.items():
+                outs = per_entry_results[(id(entry), r)]
+                sub.handle.set_result(
+                    outs if len(sub.payloads) > 1 else outs[0])
+
+    def _run_allgather(self, ps, entry):
+        """Allgather with per-rank first-dim sizes: pad to max rows
+        (the reference exchanges shapes during negotiation and sizes the
+        fused buffer accordingly, controller.cc:901-1080)."""
+        subs = {r: entry.subs[r] for r in ps.ranks}
+        n_tensors = len(next(iter(subs.values())).payloads)
+        results_per_rank = {r: [] for r in ps.ranks}
+        for i in range(n_tensors):
+            dim0 = [int(subs[r].payloads[i].shape[0]) if subs[r].payloads[i].ndim
+                    else 1 for r in ps.ranks]
+            rest = tuple(next(iter(subs.values())).payloads[i].shape[1:])
+            max_d = max(dim0) if dim0 else 0
+            rest_n = int(np.prod(rest, dtype=np.int64)) if rest else 1
+            rows = []
+            for r in ps.ranks:
+                p = subs[r].payloads[i]
+                flat = np.ravel(p)
+                buf = np.zeros(max_d * rest_n, dtype=p.dtype)
+                buf[:flat.size] = flat
+                rows.append(buf)
+            gathered = ps.executor.allgather(rows, dim0, rest)
+            for r in ps.ranks:
+                results_per_rank[r].append(gathered[ps.index[r]])
+        for r, sub in subs.items():
+            outs = results_per_rank[r]
+            sub.handle.set_result(outs if n_tensors > 1 else outs[0])
+
+    def _run_broadcast(self, ps, entry):
+        subs = {r: entry.subs[r] for r in ps.ranks}
+        first = next(iter(subs.values()))
+        root = first.request.root_rank
+        root_pos = ps.index.get(root)
+        if root_pos is None:
+            for sub in subs.values():
+                sub.handle.set_error(HorovodInternalError(
+                    f"broadcast root {root} not in process set {ps.id}"))
+            return
+        n_tensors = len(first.payloads)
+        results_per_rank = {r: [] for r in ps.ranks}
+        for i in range(n_tensors):
+            shape = first.payloads[i].shape
+            rows = [subs[r].payloads[i].ravel() for r in ps.ranks]
+            out = ps.executor.broadcast(rows, root_pos)
+            for r in ps.ranks:
+                results_per_rank[r].append(
+                    out[ps.index[r]].reshape(shape))
+        for r, sub in subs.items():
+            outs = results_per_rank[r]
+            sub.handle.set_result(outs if n_tensors > 1 else outs[0])
+
+    def _run_alltoall(self, ps, entry):
+        subs = {r: entry.subs[r] for r in ps.ranks}
+        first = next(iter(subs.values()))
+        rest = tuple(first.payloads[0].shape[1:])
+        rest_n = int(np.prod(rest, dtype=np.int64)) if rest else 1
+        splits = [list(subs[r].request.splits) for r in ps.ranks]
+        R = ps.size
+        max_seg = max((s for sp in splits for s in sp), default=0)
+        rows = []
+        for pos, r in enumerate(ps.ranks):
+            p = subs[r].payloads[0]
+            flat = np.ravel(p)
+            buf = np.zeros(R * max_seg * rest_n, dtype=p.dtype)
+            off = 0
+            for j in range(R):
+                seg = splits[pos][j] * rest_n
+                buf[j * max_seg * rest_n: j * max_seg * rest_n + seg] = \
+                    flat[off:off + seg]
+                off += seg
+            rows.append(buf)
+        results, recv_splits = ps.executor.alltoall(rows, splits, rest)
+        for pos, r in enumerate(ps.ranks):
+            subs[r].handle.set_result(
+                results[pos], extra=np.array(recv_splits[pos], dtype=np.int32))
+
+    def _run_reducescatter(self, ps, entry):
+        subs = {r: entry.subs[r] for r in ps.ranks}
+        first = next(iter(subs.values()))
+        req = first.request
+        op = req.reduce_op
+        shape = first.payloads[0].shape
+        d0 = int(shape[0]) if shape else 1
+        rest = tuple(shape[1:])
+        rest_n = int(np.prod(rest, dtype=np.int64)) if rest else 1
+        R = ps.size
+        chunks = ps.executor.chunk_sizes(d0, R)
+        max_chunk = max(chunks) if chunks else 0
+        offsets = np.cumsum([0] + chunks[:-1])
+        rows = []
+        for r in ps.ranks:
+            flat = np.ravel(subs[r].payloads[0])
+            buf = np.zeros(R * max_chunk * rest_n, dtype=flat.dtype)
+            for j in range(R):
+                src = offsets[j] * rest_n
+                dst = j * max_chunk * rest_n
+                buf[dst:dst + chunks[j] * rest_n] = \
+                    flat[src:src + chunks[j] * rest_n]
+            rows.append(buf)
+        results = ps.executor.reducescatter(
+            rows, d0, rest, op, req.prescale_factor, req.postscale_factor)
+        for r in ps.ranks:
+            subs[r].handle.set_result(results[ps.index[r]])
+
+    # ------------------------------------------------------------------
+
+    def abort(self, exc: BaseException):
+        """One rank failed — fail every pending and future collective so
+        no rank blocks forever (the reference ends all ranks with
+        SHUT_DOWN_ERROR, common.h:231, when a peer dies)."""
+        with self._lock:
+            if self._aborted is not None or self._shutdown:
+                return
+            self._aborted = exc
+            self._fail_all_pending_locked(HorovodInternalError(
+                f"a peer rank failed: {exc!r}"))
+            self._lock.notify_all()
+
+    def shutdown(self):
+        with self._lock:
+            if self._shutdown:
+                return
+            self._shutdown = True
+            self._lock.notify_all()
+        self._shutdown_done.wait(timeout=30)
+
+
+def _bfloat16_dtype():
+    import ml_dtypes
+    return np.dtype(ml_dtypes.bfloat16)

@@ -1,0 +1,86 @@
+"""Async completion handles.
+
+TPU-native analogue of the reference torch binding's ``HandleManager``
+(torch/handle_manager.h): every enqueued collective returns an integer
+handle which ``poll()``/``synchronize()`` resolve.  Unlike the
+reference (busy-wait over a Status table), completion is event-based.
+"""
+
+import threading
+from typing import Any, Optional
+
+
+class Handle:
+    """Completion record for one enqueued tensor operation."""
+
+    __slots__ = ("_event", "result", "error", "extra", "kind",
+                 "inplace_target", "returns_splits")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+        # op-specific side data (e.g. alltoall received splits)
+        self.extra: Any = None
+        # API-layer metadata: original tensor kind(s), in-place target,
+        # whether synchronize() should return (tensor, recv_splits).
+        self.kind: Any = "numpy"
+        self.inplace_target: Any = None
+        self.returns_splits: bool = False
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def set_result(self, result, extra=None):
+        self.result = result
+        self.extra = extra
+        self._event.set()
+
+    def set_error(self, exc: BaseException):
+        self.error = exc
+        self._event.set()
+
+    def wait(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("collective operation did not complete in time")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class HandleManager:
+    """Maps integer handles to Handle records (reference
+    torch/handle_manager.h:24-41)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._next = 0
+        self._handles = {}
+
+    def allocate(self) -> int:
+        with self._lock:
+            h = self._next
+            self._next += 1
+            self._handles[h] = Handle()
+            return h
+
+    def get(self, handle: int) -> Handle:
+        with self._lock:
+            rec = self._handles.get(handle)
+        if rec is None:
+            raise ValueError(f"unknown or already-released handle {handle}")
+        return rec
+
+    def poll(self, handle: int) -> bool:
+        return self.get(handle).done()
+
+    def release(self, handle: int):
+        with self._lock:
+            self._handles.pop(handle, None)
+
+    def synchronize(self, handle: int, timeout=None):
+        rec = self.get(handle)
+        try:
+            return rec.wait(timeout)
+        finally:
+            self.release(handle)

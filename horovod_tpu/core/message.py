@@ -1,0 +1,175 @@
+"""Control-plane message types.
+
+TPU-native analogue of the reference's ``horovod/common/message.h``:
+``DataType`` (message.h:30-41), ``ReduceOp`` (message.h:43-50),
+``Request`` (message.h:59-143) and ``Response`` (message.h:175-265).
+Serialization is a compact JSON-able dict (the reference uses
+FlatBuffers, wire/message.fbs) — the wire only carries shapes and
+names, never tensor data, so the format is not performance-critical.
+"""
+
+import enum
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class ReduceOp(enum.IntEnum):
+    # Values mirror reference message.h:43-50.
+    AVERAGE = 0
+    SUM = 1
+    ADASUM = 2
+    MIN = 3
+    MAX = 4
+    PRODUCT = 5
+
+
+# Public aliases matching the hvd.* API surface.
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Adasum = ReduceOp.ADASUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+Product = ReduceOp.PRODUCT
+
+
+class RequestType(enum.IntEnum):
+    # Mirrors reference message.h:66-75.
+    ALLREDUCE = 0
+    ALLGATHER = 1
+    BROADCAST = 2
+    JOIN = 3
+    ADASUM = 4
+    ALLTOALL = 5
+    BARRIER = 6
+    REDUCESCATTER = 7
+
+
+class ResponseType(enum.IntEnum):
+    ALLREDUCE = 0
+    ALLGATHER = 1
+    BROADCAST = 2
+    JOIN = 3
+    ADASUM = 4
+    ALLTOALL = 5
+    BARRIER = 6
+    REDUCESCATTER = 7
+    ERROR = 8
+
+
+@dataclass
+class Request:
+    """One rank's declaration that a tensor is ready for a collective.
+
+    Field-parity with reference message.h:59-143 (rank, type, name,
+    root_rank, device, group_id, shape, prescale/postscale, reduce op);
+    ``splits`` covers the alltoall send-split vector which the reference
+    passes out-of-band through the entry.
+    """
+    request_type: RequestType
+    tensor_name: str
+    rank: int = 0
+    dtype: Optional[str] = None          # numpy dtype string, e.g. "float32"
+    shape: Tuple[int, ...] = ()
+    root_rank: int = -1                  # broadcast only
+    reduce_op: ReduceOp = ReduceOp.SUM
+    prescale_factor: float = 1.0
+    postscale_factor: float = 1.0
+    group_id: int = -1                   # grouped-op negotiation unit
+    process_set_id: int = 0
+    splits: Optional[Tuple[int, ...]] = None  # alltoall send splits
+
+    def to_dict(self):
+        return {
+            "t": int(self.request_type),
+            "n": self.tensor_name,
+            "r": self.rank,
+            "d": self.dtype,
+            "s": list(self.shape),
+            "rr": self.root_rank,
+            "op": int(self.reduce_op),
+            "pre": self.prescale_factor,
+            "post": self.postscale_factor,
+            "g": self.group_id,
+            "ps": self.process_set_id,
+            "sp": list(self.splits) if self.splits is not None else None,
+        }
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(
+            request_type=RequestType(d["t"]),
+            tensor_name=d["n"],
+            rank=d["r"],
+            dtype=d["d"],
+            shape=tuple(d["s"]),
+            root_rank=d["rr"],
+            reduce_op=ReduceOp(d["op"]),
+            prescale_factor=d["pre"],
+            postscale_factor=d["post"],
+            group_id=d["g"],
+            process_set_id=d["ps"],
+            splits=tuple(d["sp"]) if d["sp"] is not None else None,
+        )
+
+
+@dataclass
+class Response:
+    """The coordinator's instruction to execute one (possibly fused)
+    collective, or to deliver an error (reference message.h:175-265)."""
+    response_type: ResponseType
+    tensor_names: List[str] = field(default_factory=list)
+    error_message: str = ""
+    prescale_factor: float = 1.0
+    postscale_factor: float = 1.0
+    reduce_op: ReduceOp = ReduceOp.SUM
+    last_joined_rank: int = -1
+    process_set_id: int = 0
+
+    def to_dict(self):
+        return {
+            "t": int(self.response_type),
+            "n": self.tensor_names,
+            "e": self.error_message,
+            "pre": self.prescale_factor,
+            "post": self.postscale_factor,
+            "op": int(self.reduce_op),
+            "lj": self.last_joined_rank,
+            "ps": self.process_set_id,
+        }
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(
+            response_type=ResponseType(d["t"]),
+            tensor_names=list(d["n"]),
+            error_message=d["e"],
+            prescale_factor=d["pre"],
+            postscale_factor=d["post"],
+            reduce_op=ReduceOp(d["op"]),
+            last_joined_rank=d["lj"],
+            process_set_id=d["ps"],
+        )
+
+
+_REQUEST_TYPE_TO_RESPONSE = {
+    RequestType.ALLREDUCE: ResponseType.ALLREDUCE,
+    RequestType.ALLGATHER: ResponseType.ALLGATHER,
+    RequestType.BROADCAST: ResponseType.BROADCAST,
+    RequestType.JOIN: ResponseType.JOIN,
+    RequestType.ADASUM: ResponseType.ADASUM,
+    RequestType.ALLTOALL: ResponseType.ALLTOALL,
+    RequestType.BARRIER: ResponseType.BARRIER,
+    RequestType.REDUCESCATTER: ResponseType.REDUCESCATTER,
+}
+
+
+def response_type_for(request_type: RequestType) -> ResponseType:
+    return _REQUEST_TYPE_TO_RESPONSE[request_type]
+
+
+def normalize_dtype(dtype) -> str:
+    """Canonical dtype string used in negotiation (cross-rank dtype
+    checks compare these, like reference DataType message.h:30-41)."""
+    return np.dtype(dtype).name if not str(dtype).startswith("bfloat16") else "bfloat16"

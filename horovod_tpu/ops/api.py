@@ -1,0 +1,395 @@
+"""Public collective-op API (framework-agnostic core).
+
+Mirrors the per-framework op surface of the reference
+(``horovod/torch/mpi_ops.py``, ``horovod/tensorflow/mpi_ops.py``):
+sync + ``*_async`` handle variants, grouped ops, in-place variants,
+object broadcast/allgather — operating on numpy / JAX arrays.  The
+torch/TF bindings stage their tensors to host buffers and call these.
+"""
+
+import numpy as np
+
+from ..common import basics
+from ..common import util
+from ..common.process_sets import ProcessSet, global_process_set
+from ..core.engine import Submission
+from ..core.handles import Handle
+from ..core.message import (
+    Average, Sum, Adasum, Min, Max, Product, ReduceOp, Request, RequestType,
+    normalize_dtype,
+)
+
+__all__ = [
+    "allreduce", "allreduce_async", "allreduce_", "allreduce_async_",
+    "grouped_allreduce", "grouped_allreduce_async",
+    "allgather", "allgather_async", "grouped_allgather",
+    "grouped_allgather_async",
+    "broadcast", "broadcast_async", "broadcast_", "broadcast_async_",
+    "alltoall", "alltoall_async",
+    "reducescatter", "reducescatter_async", "grouped_reducescatter",
+    "grouped_reducescatter_async",
+    "barrier", "join", "synchronize", "poll",
+    "broadcast_object", "allgather_object",
+    "Average", "Sum", "Adasum", "Min", "Max", "Product",
+]
+
+
+def _ps_id(process_set):
+    if process_set is None:
+        return 0
+    if isinstance(process_set, ProcessSet):
+        if process_set.process_set_id is None:
+            raise ValueError("process set is not registered")
+        return process_set.process_set_id
+    return int(process_set)
+
+
+def _resolve_op(op, average, dtype):
+    """Reference op/average compatibility shim (torch/mpi_ops.py:150-190:
+    `average` is the legacy flag, `op` the modern one)."""
+    if op is not None and average is not None:
+        raise ValueError("The op parameter supersedes average; "
+                         "please provide only one of them")
+    if op is None:
+        op = Average if average is None or average else Sum
+    op = ReduceOp(op)
+    if op == Average and not (np.issubdtype(np.dtype(dtype), np.floating)
+                              or str(dtype) == "bfloat16"):
+        raise ValueError(
+            "Averaging is not supported for integer tensors; use op=Sum")
+    return op
+
+
+def _submit(request, payloads, names):
+    eng = basics.engine()
+    sub = Submission(rank=request.rank, request=request, names=names,
+                     payloads=payloads, handle=Handle())
+    return eng.submit(sub)
+
+
+# ----------------------------------------------------------------------------
+# allreduce
+
+def allreduce_async(tensor, average=None, name=None, op=None,
+                    prescale_factor=1.0, postscale_factor=1.0,
+                    process_set=global_process_set):
+    arr, kind = util.to_numpy(tensor)
+    ctx = basics.context()
+    op = _resolve_op(op, average, arr.dtype)
+    if not (np.issubdtype(arr.dtype, np.floating) or str(arr.dtype) == "bfloat16") \
+            and (prescale_factor != 1.0 or postscale_factor != 1.0):
+        raise ValueError("prescale/postscale require floating-point tensors")
+    name = name or ctx.next_name("allreduce")
+    req = Request(
+        request_type=RequestType.ALLREDUCE, tensor_name=name, rank=ctx.rank,
+        dtype=normalize_dtype(arr.dtype), shape=tuple(arr.shape),
+        reduce_op=op, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor, process_set_id=_ps_id(process_set))
+    h = _submit(req, [arr], [name])
+    h.kind = kind
+    return h
+
+
+def allreduce(tensor, average=None, name=None, op=None,
+              prescale_factor=1.0, postscale_factor=1.0,
+              process_set=global_process_set):
+    h = allreduce_async(tensor, average, name, op, prescale_factor,
+                        postscale_factor, process_set)
+    return synchronize(h)
+
+
+def allreduce_async_(tensor, average=None, name=None, op=None,
+                     prescale_factor=1.0, postscale_factor=1.0,
+                     process_set=global_process_set):
+    """In-place variant: result is copied back into ``tensor`` when it
+    is a mutable ndarray (reference allreduce_async_)."""
+    h = allreduce_async(tensor, average, name, op, prescale_factor,
+                        postscale_factor, process_set)
+    h.inplace_target = tensor if isinstance(tensor, np.ndarray) else None
+    return h
+
+
+def allreduce_(tensor, average=None, name=None, op=None,
+               prescale_factor=1.0, postscale_factor=1.0,
+               process_set=global_process_set):
+    h = allreduce_async_(tensor, average, name, op, prescale_factor,
+                         postscale_factor, process_set)
+    return synchronize(h)
+
+
+def grouped_allreduce_async(tensors, average=None, name=None, op=None,
+                            prescale_factor=1.0, postscale_factor=1.0,
+                            process_set=global_process_set):
+    """Grouped ops negotiate and execute as one unit (reference
+    EnqueueTensorAllreduces, operations.cc:1408; group_table.h)."""
+    if not tensors:
+        raise ValueError("grouped_allreduce requires at least one tensor")
+    pairs = [util.to_numpy(t) for t in tensors]
+    arrs = [p[0] for p in pairs]
+    kinds = [p[1] for p in pairs]
+    dtypes = {normalize_dtype(a.dtype) for a in arrs}
+    if len(dtypes) > 1:
+        raise ValueError(
+            f"grouped_allreduce requires matching dtypes, got {dtypes}")
+    ctx = basics.context()
+    op = _resolve_op(op, average, arrs[0].dtype)
+    base = name or ctx.next_name("grouped_allreduce")
+    names = [f"{base}.{i}" for i in range(len(arrs))]
+    req = Request(
+        request_type=RequestType.ALLREDUCE, tensor_name=base, rank=ctx.rank,
+        dtype=normalize_dtype(arrs[0].dtype),
+        shape=tuple(arrs[0].shape), reduce_op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set_id=_ps_id(process_set), group_id=0)
+    h = _submit(req, arrs, names)
+    h.kind = kinds
+    return h
+
+
+def grouped_allreduce(tensors, average=None, name=None, op=None,
+                      prescale_factor=1.0, postscale_factor=1.0,
+                      process_set=global_process_set):
+    h = grouped_allreduce_async(tensors, average, name, op, prescale_factor,
+                                postscale_factor, process_set)
+    return synchronize(h)
+
+
+# ----------------------------------------------------------------------------
+# allgather
+
+def allgather_async(tensor, name=None, process_set=global_process_set):
+    arr, kind = util.to_numpy(tensor)
+    if arr.ndim == 0:
+        arr = arr.reshape(1)
+    ctx = basics.context()
+    name = name or ctx.next_name("allgather")
+    req = Request(
+        request_type=RequestType.ALLGATHER, tensor_name=name, rank=ctx.rank,
+        dtype=normalize_dtype(arr.dtype), shape=tuple(arr.shape),
+        process_set_id=_ps_id(process_set))
+    h = _submit(req, [arr], [name])
+    h.kind = kind
+    return h
+
+
+def allgather(tensor, name=None, process_set=global_process_set):
+    return synchronize(allgather_async(tensor, name, process_set))
+
+
+def grouped_allgather_async(tensors, name=None,
+                            process_set=global_process_set):
+    if not tensors:
+        raise ValueError("grouped_allgather requires at least one tensor")
+    pairs = [util.to_numpy(t) for t in tensors]
+    arrs = [p[0].reshape(1) if p[0].ndim == 0 else p[0] for p in pairs]
+    kinds = [p[1] for p in pairs]
+    ctx = basics.context()
+    base = name or ctx.next_name("grouped_allgather")
+    names = [f"{base}.{i}" for i in range(len(arrs))]
+    req = Request(
+        request_type=RequestType.ALLGATHER, tensor_name=base, rank=ctx.rank,
+        dtype=normalize_dtype(arrs[0].dtype), shape=tuple(arrs[0].shape),
+        process_set_id=_ps_id(process_set), group_id=0)
+    h = _submit(req, arrs, names)
+    h.kind = kinds
+    return h
+
+
+def grouped_allgather(tensors, name=None, process_set=global_process_set):
+    return synchronize(grouped_allgather_async(tensors, name, process_set))
+
+
+# ----------------------------------------------------------------------------
+# broadcast
+
+def broadcast_async(tensor, root_rank, name=None,
+                    process_set=global_process_set):
+    arr, kind = util.to_numpy(tensor)
+    ctx = basics.context()
+    name = name or ctx.next_name("broadcast")
+    req = Request(
+        request_type=RequestType.BROADCAST, tensor_name=name, rank=ctx.rank,
+        dtype=normalize_dtype(arr.dtype), shape=tuple(arr.shape),
+        root_rank=int(root_rank), process_set_id=_ps_id(process_set))
+    h = _submit(req, [arr], [name])
+    h.kind = kind
+    return h
+
+
+def broadcast(tensor, root_rank, name=None, process_set=global_process_set):
+    return synchronize(broadcast_async(tensor, root_rank, name, process_set))
+
+
+def broadcast_async_(tensor, root_rank, name=None,
+                     process_set=global_process_set):
+    h = broadcast_async(tensor, root_rank, name, process_set)
+    h.inplace_target = tensor if isinstance(tensor, np.ndarray) else None
+    return h
+
+
+def broadcast_(tensor, root_rank, name=None, process_set=global_process_set):
+    return synchronize(broadcast_async_(tensor, root_rank, name, process_set))
+
+
+# ----------------------------------------------------------------------------
+# alltoall
+
+def alltoall_async(tensor, splits=None, name=None,
+                   process_set=global_process_set):
+    arr, kind = util.to_numpy(tensor)
+    if arr.ndim == 0:
+        raise ValueError("alltoall requires a tensor with at least 1 dim")
+    eng = basics.engine()
+    ps_size = len(eng.process_set_ranks(_ps_id(process_set)))
+    if splits is None:
+        if arr.shape[0] % ps_size != 0:
+            raise ValueError(
+                f"alltoall first dim {arr.shape[0]} not divisible by "
+                f"process-set size {ps_size}; pass explicit splits")
+        splits = [arr.shape[0] // ps_size] * ps_size
+    splits_arr, _ = util.to_numpy(splits)
+    splits_t = tuple(int(s) for s in np.ravel(splits_arr))
+    ctx = basics.context()
+    name = name or ctx.next_name("alltoall")
+    req = Request(
+        request_type=RequestType.ALLTOALL, tensor_name=name, rank=ctx.rank,
+        dtype=normalize_dtype(arr.dtype), shape=tuple(arr.shape),
+        splits=splits_t, process_set_id=_ps_id(process_set))
+    h = _submit(req, [arr], [name])
+    h.kind = kind
+    h.returns_splits = True
+    return h
+
+
+def alltoall(tensor, splits=None, name=None, process_set=global_process_set):
+    """Returns (received_tensor, received_splits) (reference
+    torch/mpi_ops.py alltoall returns both when splits are given)."""
+    return synchronize(alltoall_async(tensor, splits, name, process_set))
+
+
+# ----------------------------------------------------------------------------
+# reducescatter
+
+def reducescatter_async(tensor, op=Average, name=None,
+                        prescale_factor=1.0, postscale_factor=1.0,
+                        process_set=global_process_set):
+    arr, kind = util.to_numpy(tensor)
+    if arr.ndim == 0:
+        raise ValueError("reducescatter requires a tensor with >=1 dim")
+    ctx = basics.context()
+    op = _resolve_op(op, None, arr.dtype)
+    name = name or ctx.next_name("reducescatter")
+    req = Request(
+        request_type=RequestType.REDUCESCATTER, tensor_name=name,
+        rank=ctx.rank, dtype=normalize_dtype(arr.dtype),
+        shape=tuple(arr.shape), reduce_op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set_id=_ps_id(process_set))
+    h = _submit(req, [arr], [name])
+    h.kind = kind
+    return h
+
+
+def reducescatter(tensor, op=Average, name=None, prescale_factor=1.0,
+                  postscale_factor=1.0, process_set=global_process_set):
+    return synchronize(reducescatter_async(
+        tensor, op, name, prescale_factor, postscale_factor, process_set))
+
+
+def grouped_reducescatter_async(tensors, op=Average, name=None,
+                                process_set=global_process_set):
+    ctx = basics.context()
+    base = name or ctx.next_name("grouped_reducescatter")
+    return [reducescatter_async(t, op, f"{base}.{i}",
+                                process_set=process_set)
+            for i, t in enumerate(tensors)]
+
+
+def grouped_reducescatter(tensors, op=Average, name=None,
+                          process_set=global_process_set):
+    return [synchronize(h) for h in
+            grouped_reducescatter_async(tensors, op, name, process_set)]
+
+
+# ----------------------------------------------------------------------------
+# barrier / join / completion
+
+def barrier(process_set=global_process_set):
+    """Blocking barrier over the process set (reference
+    EnqueueBarrier, operations.cc:2026)."""
+    ctx = basics.context()
+    name = ctx.next_name("barrier")
+    req = Request(
+        request_type=RequestType.BARRIER, tensor_name=name, rank=ctx.rank,
+        dtype="uint8", shape=(), process_set_id=_ps_id(process_set))
+    h = _submit(req, [np.zeros(0, dtype=np.uint8)], [name])
+    h.wait()
+
+
+def join(device=None, process_set=global_process_set) -> int:
+    """Signal this rank is out of data; returns the last rank that
+    joined (reference horovod_torch_join / operations.cc:1991).  The
+    ``device`` argument exists for API parity and is ignored — joined
+    ranks contribute compiled zeros on the mesh."""
+    ctx = basics.context()
+    h = basics.engine().join(ctx.rank, _ps_id(process_set))
+    return h.wait()
+
+
+def poll(handle) -> bool:
+    return handle.done()
+
+
+def synchronize(handle):
+    result = handle.wait()
+    inplace = getattr(handle, "inplace_target", None)
+    kind = getattr(handle, "kind", "numpy")
+    if getattr(handle, "returns_splits", False):
+        recv_splits = handle.extra
+        return util.from_numpy(result, kind), recv_splits
+    if isinstance(result, list):
+        kinds = kind if isinstance(kind, list) else [kind] * len(result)
+        return [util.from_numpy(r, k) for r, k in zip(result, kinds)]
+    if inplace is not None:
+        np.copyto(inplace, result.reshape(inplace.shape))
+        return inplace
+    return util.from_numpy(result, kind)
+
+
+# ----------------------------------------------------------------------------
+# object helpers (reference tensorflow/functions.py:23-120,
+# torch/functions.py)
+
+def broadcast_object(obj, root_rank=0, name=None,
+                     process_set=global_process_set):
+    name = name or "broadcast_object"
+    payload = util.dumps(obj) if basics.rank() == root_rank else \
+        np.zeros(0, dtype=np.uint8)
+    sz = np.array([payload.size], dtype=np.int64)
+    sz_out = allgather(sz, name=f"{name}.sz", process_set=process_set)
+    true_size = int(sz_out[_ps_root_pos(process_set, root_rank)])
+    if basics.rank() != root_rank:
+        payload = np.zeros(true_size, dtype=np.uint8)
+    out = broadcast(payload, root_rank, name=f"{name}.data",
+                    process_set=process_set)
+    return util.loads(np.asarray(out))
+
+
+def allgather_object(obj, name=None, process_set=global_process_set):
+    name = name or "allgather_object"
+    payload = util.dumps(obj)
+    gathered = allgather(payload, name=f"{name}.data",
+                         process_set=process_set)
+    sizes = allgather(np.array([payload.size], dtype=np.int64),
+                      name=f"{name}.sz", process_set=process_set)
+    sizes = np.asarray(sizes).ravel()
+    out, off = [], 0
+    for s in sizes:
+        out.append(util.loads(np.asarray(gathered[off:off + int(s)])))
+        off += int(s)
+    return out
+
+
+def _ps_root_pos(process_set, root_rank):
+    ranks = basics.engine().process_set_ranks(_ps_id(process_set))
+    return ranks.index(root_rank)

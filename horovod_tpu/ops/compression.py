@@ -1,0 +1,74 @@
+"""Gradient compression (reference horovod/torch/compression.py,
+horovod/tensorflow/compression.py:20-74): a Compressor maps a tensor to
+its wire representation before allreduce and back after.  On TPU the
+natural compressed dtype is bfloat16 (same MXU-native width as fp16 on
+GPU, far better dynamic range); FP16Compressor is kept for parity."""
+
+import numpy as np
+
+
+class Compressor:
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """Default: no compression."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Cast floating tensors to float16 for the collective."""
+
+    @staticmethod
+    def compress(tensor):
+        arr = np.asarray(tensor)
+        if np.issubdtype(arr.dtype, np.floating) and arr.dtype != np.float16:
+            return arr.astype(np.float16), arr.dtype
+        return arr, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is not None:
+            return np.asarray(tensor).astype(ctx)
+        return tensor
+
+
+class BF16Compressor(Compressor):
+    """TPU-native compression: bfloat16 keeps float32's exponent range,
+    so gradient allreduce needs no loss-scaling, and bf16 is the MXU's
+    native reduced precision."""
+
+    @staticmethod
+    def compress(tensor):
+        import ml_dtypes
+        arr = np.asarray(tensor)
+        bf16 = np.dtype(ml_dtypes.bfloat16)
+        if np.issubdtype(arr.dtype, np.floating) and arr.dtype != bf16:
+            return arr.astype(bf16), arr.dtype
+        return arr, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is not None:
+            return np.asarray(tensor).astype(ctx)
+        return tensor
+
+
+class Compression:
+    """Option enum-style holder (reference compression.py:66-74)."""
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

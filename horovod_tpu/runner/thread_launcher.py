@@ -1,0 +1,91 @@
+"""In-process rank launcher.
+
+The TPU-native replacement for per-rank process spawning on a single
+host: one thread per rank, every rank bound to a device of the local
+mesh.  This is the analogue of the reference's programmatic launcher
+``horovod.run(func, np=...)`` (horovod/runner/__init__.py:95) for the
+local case — multi-host jobs wrap this per host (runner/launch.py).
+
+Threads are the right isolation level on TPU: a single process must own
+the TPU client, and rank threads release the GIL while compiled
+programs run, so per-rank Python overhead overlaps device execution.
+"""
+
+import threading
+
+from ..common import basics
+
+
+class _RankThread(threading.Thread):
+    def __init__(self, fn, rank, args, kwargs):
+        super().__init__(name=f"hvd-rank-{rank}", daemon=True)
+        self.fn = fn
+        self.rank = rank
+        self.args = args
+        self.kwargs = kwargs
+        self.result = None
+        self.error = None
+
+    def run(self):
+        basics.bind_rank(self.rank)
+        try:
+            self.result = self.fn(*self.args, **self.kwargs)
+        except BaseException as exc:  # noqa: BLE001 — reported to caller
+            self.error = exc
+        finally:
+            basics.unbind_rank()
+
+
+def run(fn, np=None, args=(), kwargs=None, devices=None,
+        keep_alive=False):
+    """Run ``fn`` once per rank and return the list of per-rank results
+    (reference horovod.run returns per-rank results,
+    runner/__init__.py:95).
+
+    ``np`` defaults to the number of local devices — one rank per TPU
+    chip.  ``keep_alive`` leaves the runtime initialized after the
+    function returns (for REPL / successive phases)."""
+    kwargs = kwargs or {}
+    if np is None:
+        import jax
+        from ..common import env as env_mod
+        if devices is None:
+            platform = env_mod.get_str(env_mod.HOROVOD_TPU_PLATFORM)
+            devices = jax.devices(platform) if platform else jax.devices()
+        np = len(devices)
+    already = basics.is_initialized()
+    if not already:
+        basics.init(num_ranks=np, devices=devices)
+    elif basics.size() != np:
+        raise ValueError(
+            f"horovod_tpu already initialized with {basics.size()} ranks; "
+            f"cannot run with np={np}")
+    threads = [_RankThread(fn, r, args, kwargs) for r in range(np)]
+    first_error = None
+    try:
+        for t in threads:
+            t.start()
+        # Monitor: the first rank failure aborts the engine so peers
+        # blocked in collectives fail fast instead of deadlocking (the
+        # reference ends all ranks with SHUT_DOWN_ERROR when one dies).
+        pending = list(threads)
+        while pending:
+            still = []
+            for t in pending:
+                t.join(timeout=0.05)
+                if t.is_alive():
+                    still.append(t)
+                elif t.error is not None and first_error is None:
+                    first_error = (t.rank, t.error)
+                    basics.engine().abort(t.error)
+            pending = still
+    finally:
+        if not keep_alive and not already:
+            basics.shutdown()
+    if first_error is not None:
+        rank, err = first_error
+        nfail = sum(1 for t in threads if t.error is not None)
+        raise RuntimeError(
+            f"{nfail}/{np} ranks failed; first failure on rank "
+            f"{rank}: {err!r}") from err
+    return [t.result for t in threads]

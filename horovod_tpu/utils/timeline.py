@@ -1,0 +1,107 @@
+"""Chrome-tracing timeline.
+
+Reimplements the reference Timeline subsystem
+(``horovod/common/timeline.{h,cc}``; format documented in
+docs/timeline.rst): per-tensor lanes with NEGOTIATING and operation
+phases, written as Chrome trace-event JSON by an async writer thread so
+the engine's dispatch loop never blocks on file IO.  View in
+chrome://tracing or Perfetto.  Activate with ``HOROVOD_TIMELINE=path``
+or ``start_timeline()``/``stop_timeline()`` at runtime (reference
+operations.cc:1077-1109).
+"""
+
+import json
+import queue
+import threading
+import time
+
+
+class Timeline:
+    """Async Chrome-trace writer (reference TimelineWriter,
+    timeline.h:48-100)."""
+
+    def __init__(self, filename, mark_cycles=False):
+        self.filename = filename
+        self.mark_cycles = mark_cycles
+        self._q = queue.Queue()
+        self._start = time.perf_counter()
+        self._tids = {}
+        self._next_tid = 1
+        self._lock = threading.Lock()
+        self._open_ops = []
+        self._thread = threading.Thread(
+            target=self._writer_loop, name="horovod_tpu-timeline", daemon=True)
+        self._thread.start()
+
+    # -- engine-facing hooks -------------------------------------------------
+
+    def _ts(self):
+        return (time.perf_counter() - self._start) * 1e6  # microseconds
+
+    def _tid(self, name):
+        with self._lock:
+            tid = self._tids.get(name)
+            if tid is None:
+                tid = self._next_tid
+                self._next_tid += 1
+                self._tids[name] = tid
+                self._q.put({"name": "thread_name", "ph": "M", "pid": 0,
+                             "tid": tid, "args": {"name": name}})
+            return tid
+
+    def negotiate_start(self, tensor_name, op_name):
+        """A rank declared the tensor ready (reference
+        Timeline::NegotiateStart, fed from controller.cc:1123)."""
+        self._q.put({"name": f"NEGOTIATE_{op_name}", "ph": "B", "pid": 0,
+                     "tid": self._tid(tensor_name), "ts": self._ts()})
+
+    def op_start(self, tensor_names, op_name):
+        """Negotiation complete; collective starting (reference
+        Timeline::Start + ActivityStartAll)."""
+        ts = self._ts()
+        tids = []
+        for n in tensor_names:
+            tid = self._tid(n)
+            tids.append(tid)
+            self._q.put({"name": f"NEGOTIATE_{op_name}", "ph": "E", "pid": 0,
+                         "tid": tid, "ts": ts})
+            self._q.put({"name": op_name, "ph": "B", "pid": 0, "tid": tid,
+                         "ts": ts})
+        with self._lock:
+            self._open_ops.append((list(tids), op_name))
+
+    def op_end(self):
+        ts = self._ts()
+        with self._lock:
+            if not self._open_ops:
+                return
+            tids, op_name = self._open_ops.pop()
+        for tid in tids:
+            self._q.put({"name": op_name, "ph": "E", "pid": 0, "tid": tid,
+                         "ts": ts})
+
+    def mark_cycle(self):
+        if self.mark_cycles:
+            self._q.put({"name": "CYCLE", "ph": "i", "pid": 0, "tid": 0,
+                         "ts": self._ts(), "s": "g"})
+
+    # -- writer --------------------------------------------------------------
+
+    def _writer_loop(self):
+        with open(self.filename, "w") as f:
+            f.write("[\n")
+            first = True
+            while True:
+                ev = self._q.get()
+                if ev is None:
+                    break
+                if not first:
+                    f.write(",\n")
+                f.write(json.dumps(ev))
+                first = False
+                f.flush()
+            f.write("\n]\n")
+
+    def close(self):
+        self._q.put(None)
+        self._thread.join(timeout=10)

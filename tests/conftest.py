@@ -1,0 +1,34 @@
+"""Test configuration: run the collective tests on a virtual 8-device
+CPU mesh (the TPU analogue of the reference running its parallel tests
+under `horovodrun -np 2 -H localhost:2 --gloo`,
+.buildkite/gen-pipeline.sh:278 — multi-device is simulated on one host
+via XLA's host-platform device partitioning)."""
+
+import os
+import sys
+
+# Must be set before jax initializes its backends.
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# The engine picks its mesh from this platform (sandbox forces the real
+# TPU platform as default; tests run on virtual CPU devices).
+os.environ["HOROVOD_TPU_PLATFORM"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_num_cpu_devices", 8)
+# The reference supports 64-bit dtypes (message.h:30-41); enable them.
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+@pytest.fixture()
+def hvd_shutdown():
+    """Ensure a clean runtime between tests that call init()."""
+    yield
+    if hvd.is_initialized():
+        hvd.shutdown()

@@ -1,0 +1,68 @@
+"""Runtime lifecycle + topology tests (reference test/parallel
+rank/size assertions + test/single lifecycle behavior)."""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+
+
+def test_init_shutdown(hvd_shutdown):
+    hvd.init()
+    assert hvd.is_initialized()
+    assert hvd.size() == 1
+    assert hvd.rank() == 0
+    assert hvd.local_rank() == 0
+    assert hvd.local_size() == 1
+    assert hvd.cross_rank() == 0
+    assert hvd.cross_size() == 1
+    assert hvd.is_homogeneous()
+    hvd.shutdown()
+    assert not hvd.is_initialized()
+
+
+def test_double_init_is_noop(hvd_shutdown):
+    hvd.init()
+    hvd.init()
+    assert hvd.size() == 1
+
+
+def test_built_flags(hvd_shutdown):
+    hvd.init()
+    assert hvd.tpu_built()
+    assert hvd.xla_built()
+    assert not hvd.mpi_built()
+    assert not hvd.nccl_built()
+    assert not hvd.gloo_built()
+    assert not hvd.cuda_built()
+    assert not hvd.mpi_threads_supported()
+
+
+def test_run_reports_ranks(hvd_shutdown):
+    def fn():
+        return hvd.rank(), hvd.size(), hvd.local_rank(), hvd.local_size()
+
+    results = hvd.run(fn, np=4)
+    assert sorted(r[0] for r in results) == [0, 1, 2, 3]
+    assert all(r[1] == 4 for r in results)
+    assert sorted(r[2] for r in results) == [0, 1, 2, 3]
+    assert all(r[3] == 4 for r in results)
+
+
+def test_run_propagates_failure(hvd_shutdown):
+    def fn():
+        if hvd.rank() == 1:
+            raise ValueError("boom")
+        return hvd.allreduce(np.ones(4, dtype=np.float32), op=hvd.Sum)
+
+    with pytest.raises(RuntimeError, match="boom"):
+        hvd.run(fn, np=2)
+
+
+def test_size_one_allreduce_identity(hvd_shutdown):
+    hvd.init()
+    x = np.arange(8, dtype=np.float32)
+    out = hvd.allreduce(x, op=hvd.Sum)
+    np.testing.assert_array_equal(out, x)
+    out = hvd.allreduce(x, op=hvd.Average)
+    np.testing.assert_allclose(out, x)

@@ -1,0 +1,483 @@
+"""Collective numerics across ranks — the TPU analogue of the
+reference's test/parallel/test_tensorflow.py / test_torch.py suites:
+random tensors per rank, asserting exact collective results for every
+op × dtype × shape × rank-count, executed on a virtual 8-device CPU
+mesh via the in-process thread launcher."""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+
+DTYPES = [np.float32, np.int32, np.float64, np.uint8, np.int64]
+FLOAT_DTYPES = [np.float32, np.float64]
+
+
+def run_ranks(fn, np_ranks=8):
+    return hvd.run(fn, np=np_ranks)
+
+
+# ---------------------------------------------------------------------------
+# allreduce
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_allreduce_sum(hvd_shutdown, dtype):
+    def fn():
+        r = hvd.rank()
+        x = (np.arange(17, dtype=dtype) + r)
+        return hvd.allreduce(x, op=hvd.Sum)
+
+    results = run_ranks(fn)
+    expected = sum((np.arange(17, dtype=dtype) + r) for r in range(8))
+    for out in results:
+        assert out.dtype == np.dtype(dtype)
+        np.testing.assert_array_equal(out, expected)
+
+
+@pytest.mark.parametrize("dtype", FLOAT_DTYPES)
+def test_allreduce_average(hvd_shutdown, dtype):
+    def fn():
+        r = hvd.rank()
+        x = np.full((5, 3), float(r), dtype=dtype)
+        return hvd.allreduce(x, op=hvd.Average)
+
+    results = run_ranks(fn)
+    expected = np.full((5, 3), np.mean(np.arange(8.0)), dtype=dtype)
+    for out in results:
+        np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+
+def test_allreduce_average_default_op(hvd_shutdown):
+    def fn():
+        x = np.full(4, float(hvd.rank()), dtype=np.float32)
+        return hvd.allreduce(x)
+
+    for out in run_ranks(fn):
+        np.testing.assert_allclose(out, np.full(4, 3.5, dtype=np.float32))
+
+
+def test_allreduce_average_int_raises(hvd_shutdown):
+    def fn():
+        with pytest.raises(ValueError, match="Averaging"):
+            hvd.allreduce(np.arange(4, dtype=np.int32), op=hvd.Average)
+        return True
+
+    assert all(run_ranks(fn, np_ranks=2))
+
+
+@pytest.mark.parametrize("op,npop", [(hvd.Min, np.minimum),
+                                     (hvd.Max, np.maximum)])
+def test_allreduce_minmax(hvd_shutdown, op, npop):
+    rng = np.random.RandomState(42)
+    data = [rng.randn(9, 4).astype(np.float32) for _ in range(8)]
+
+    def fn():
+        return hvd.allreduce(data[hvd.rank()], op=op)
+
+    results = run_ranks(fn)
+    expected = data[0]
+    for d in data[1:]:
+        expected = npop(expected, d)
+    for out in results:
+        np.testing.assert_array_equal(out, expected)
+
+
+def test_allreduce_product(hvd_shutdown):
+    def fn():
+        x = np.full(6, 2.0, dtype=np.float32)
+        return hvd.allreduce(x, op=hvd.Product)
+
+    for out in run_ranks(fn, np_ranks=4):
+        np.testing.assert_allclose(out, np.full(6, 16.0, dtype=np.float32))
+
+
+def test_allreduce_prescale_postscale(hvd_shutdown):
+    def fn():
+        x = np.full(4, float(hvd.rank() + 1), dtype=np.float32)
+        return hvd.allreduce(x, op=hvd.Sum, prescale_factor=0.5,
+                             postscale_factor=3.0)
+
+    results = run_ranks(fn, np_ranks=4)
+    # sum of 0.5*(1..4) = 5.0, * 3.0 = 15.0
+    for out in results:
+        np.testing.assert_allclose(out, np.full(4, 15.0), rtol=1e-6)
+
+
+def test_allreduce_bfloat16(hvd_shutdown):
+    import ml_dtypes
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+
+    def fn():
+        x = np.full(8, float(hvd.rank()), dtype=bf16)
+        return hvd.allreduce(x, op=hvd.Sum)
+
+    for out in run_ranks(fn):
+        assert out.dtype == bf16
+        np.testing.assert_array_equal(out.astype(np.float32),
+                                      np.full(8, 28.0, dtype=np.float32))
+
+
+def test_allreduce_jax_array_roundtrip(hvd_shutdown):
+    import jax.numpy as jnp
+
+    def fn():
+        x = jnp.full((4,), float(hvd.rank()), dtype=jnp.float32)
+        out = hvd.allreduce(x, op=hvd.Sum)
+        return isinstance(out, jnp.ndarray), np.asarray(out)
+
+    for is_jax, out in run_ranks(fn, np_ranks=4):
+        assert is_jax
+        np.testing.assert_allclose(out, np.full(4, 6.0))
+
+
+def test_allreduce_multiple_named_tensors(hvd_shutdown):
+    def fn():
+        a = hvd.allreduce(np.full(3, 1.0, dtype=np.float32), op=hvd.Sum,
+                          name="a")
+        b = hvd.allreduce(np.full(3, 2.0, dtype=np.float32), op=hvd.Sum,
+                          name="b")
+        c = hvd.allreduce(np.full(3, 3.0, dtype=np.float32), op=hvd.Sum)
+        return a, b, c
+
+    for a, b, c in run_ranks(fn, np_ranks=4):
+        np.testing.assert_allclose(a, np.full(3, 4.0))
+        np.testing.assert_allclose(b, np.full(3, 8.0))
+        np.testing.assert_allclose(c, np.full(3, 12.0))
+
+
+def test_allreduce_async_poll(hvd_shutdown):
+    def fn():
+        h = hvd.allreduce_async(np.full(4, 1.0, dtype=np.float32),
+                                op=hvd.Sum)
+        out = hvd.synchronize(h)
+        return out
+
+    for out in run_ranks(fn, np_ranks=4):
+        np.testing.assert_allclose(out, np.full(4, 4.0))
+
+
+def test_grouped_allreduce(hvd_shutdown):
+    def fn():
+        r = hvd.rank()
+        ts = [np.full(5, float(r), dtype=np.float32),
+              np.full((2, 2), float(r) * 2, dtype=np.float32)]
+        return hvd.grouped_allreduce(ts, op=hvd.Sum)
+
+    results = run_ranks(fn, np_ranks=4)
+    for outs in results:
+        np.testing.assert_allclose(outs[0], np.full(5, 6.0))
+        np.testing.assert_allclose(outs[1], np.full((2, 2), 12.0))
+
+
+def test_allreduce_shape_mismatch_errors(hvd_shutdown):
+    def fn():
+        x = np.ones(4 if hvd.rank() == 0 else 5, dtype=np.float32)
+        with pytest.raises(hvd.HorovodInternalError, match="[Mm]ismatch"):
+            hvd.allreduce(x, op=hvd.Sum)
+        return True
+
+    assert all(run_ranks(fn, np_ranks=2))
+
+
+def test_allreduce_dtype_mismatch_errors(hvd_shutdown):
+    def fn():
+        dt = np.float32 if hvd.rank() == 0 else np.float64
+        x = np.ones(4, dtype=dt)
+        with pytest.raises(hvd.HorovodInternalError, match="[Mm]ismatch"):
+            hvd.allreduce(x, op=hvd.Sum, name="mismatched_dtype")
+        return True
+
+    assert all(run_ranks(fn, np_ranks=2))
+
+
+# ---------------------------------------------------------------------------
+# allgather
+
+def test_allgather_same_shape(hvd_shutdown):
+    def fn():
+        r = hvd.rank()
+        x = np.full((2, 3), float(r), dtype=np.float32)
+        return hvd.allgather(x)
+
+    expected = np.concatenate(
+        [np.full((2, 3), float(r), dtype=np.float32) for r in range(8)])
+    for out in run_ranks(fn):
+        np.testing.assert_array_equal(out, expected)
+
+
+def test_allgather_variable_first_dim(hvd_shutdown):
+    def fn():
+        r = hvd.rank()
+        x = np.full((r + 1, 2), float(r), dtype=np.float32)
+        return hvd.allgather(x)
+
+    expected = np.concatenate(
+        [np.full((r + 1, 2), float(r), dtype=np.float32) for r in range(8)])
+    for out in run_ranks(fn):
+        np.testing.assert_array_equal(out, expected)
+
+
+@pytest.mark.parametrize("dtype", [np.int32, np.uint8])
+def test_allgather_int_dtypes(hvd_shutdown, dtype):
+    def fn():
+        r = hvd.rank()
+        return hvd.allgather(np.full(3, r, dtype=dtype))
+
+    expected = np.concatenate([np.full(3, r, dtype=dtype) for r in range(8)])
+    for out in run_ranks(fn):
+        assert out.dtype == np.dtype(dtype)
+        np.testing.assert_array_equal(out, expected)
+
+
+# ---------------------------------------------------------------------------
+# broadcast
+
+@pytest.mark.parametrize("root", [0, 3, 7])
+def test_broadcast(hvd_shutdown, root):
+    def fn():
+        r = hvd.rank()
+        x = np.full((3, 2), float(r * 10), dtype=np.float32)
+        return hvd.broadcast(x, root_rank=root)
+
+    expected = np.full((3, 2), float(root * 10), dtype=np.float32)
+    for out in run_ranks(fn):
+        np.testing.assert_array_equal(out, expected)
+
+
+def test_broadcast_int(hvd_shutdown):
+    def fn():
+        x = np.arange(5, dtype=np.int64) * (hvd.rank() + 1)
+        return hvd.broadcast(x, root_rank=2)
+
+    expected = np.arange(5, dtype=np.int64) * 3
+    for out in run_ranks(fn, np_ranks=4):
+        np.testing.assert_array_equal(out, expected)
+
+
+def test_broadcast_object(hvd_shutdown):
+    def fn():
+        obj = {"rank": hvd.rank(), "vals": [1, 2, 3]} \
+            if hvd.rank() == 1 else None
+        return hvd.broadcast_object(obj, root_rank=1)
+
+    for out in run_ranks(fn, np_ranks=4):
+        assert out == {"rank": 1, "vals": [1, 2, 3]}
+
+
+def test_allgather_object(hvd_shutdown):
+    def fn():
+        return hvd.allgather_object({"r": hvd.rank()})
+
+    for out in run_ranks(fn, np_ranks=4):
+        assert out == [{"r": i} for i in range(4)]
+
+
+# ---------------------------------------------------------------------------
+# alltoall
+
+def test_alltoall_uniform(hvd_shutdown):
+    def fn():
+        r = hvd.rank()
+        size = hvd.size()
+        # rank r sends [r*10 + j] to rank j
+        x = np.array([r * 10 + j for j in range(size)], dtype=np.int32)
+        out, recv = hvd.alltoall(x)
+        return out, recv
+
+    results = run_ranks(fn, np_ranks=4)
+    for r, (out, recv) in enumerate(results):
+        expected = np.array([j * 10 + r for j in range(4)], dtype=np.int32)
+        np.testing.assert_array_equal(out, expected)
+        np.testing.assert_array_equal(np.asarray(recv), np.ones(4, np.int32))
+
+
+def test_alltoall_variable_splits(hvd_shutdown):
+    def fn():
+        r = hvd.rank()
+        size = hvd.size()
+        # rank r sends (j+1) copies of value r to rank j
+        splits = np.array([j + 1 for j in range(size)], dtype=np.int32)
+        x = np.full(int(splits.sum()), float(r), dtype=np.float32)
+        out, recv = hvd.alltoall(x, splits=splits)
+        return out, recv
+
+    results = run_ranks(fn, np_ranks=4)
+    for r, (out, recv) in enumerate(results):
+        expected = np.concatenate(
+            [np.full(r + 1, float(j), dtype=np.float32) for j in range(4)])
+        np.testing.assert_array_equal(out, expected)
+        np.testing.assert_array_equal(np.asarray(recv),
+                                      np.full(4, r + 1, dtype=np.int32))
+
+
+def test_alltoall_2d(hvd_shutdown):
+    def fn():
+        r = hvd.rank()
+        size = hvd.size()
+        x = np.stack([np.full((3,), r * 10 + j, dtype=np.float32)
+                      for j in range(size)])
+        out, _ = hvd.alltoall(x)
+        return out
+
+    results = run_ranks(fn, np_ranks=4)
+    for r, out in enumerate(results):
+        expected = np.stack([np.full((3,), j * 10 + r, dtype=np.float32)
+                             for j in range(4)])
+        np.testing.assert_array_equal(out, expected)
+
+
+# ---------------------------------------------------------------------------
+# reducescatter
+
+def test_reducescatter_sum_even(hvd_shutdown):
+    def fn():
+        x = np.arange(16, dtype=np.float32).reshape(8, 2) * (hvd.rank() + 1)
+        return hvd.reducescatter(x, op=hvd.Sum)
+
+    results = run_ranks(fn, np_ranks=4)
+    total = np.arange(16, dtype=np.float32).reshape(8, 2) * sum(
+        r + 1 for r in range(4))
+    for r, out in enumerate(results):
+        np.testing.assert_array_equal(out, total[r * 2:(r + 1) * 2])
+
+
+def test_reducescatter_uneven(hvd_shutdown):
+    def fn():
+        x = np.arange(10, dtype=np.float32) * (hvd.rank() + 1)
+        return hvd.reducescatter(x, op=hvd.Sum)
+
+    results = run_ranks(fn, np_ranks=4)
+    total = np.arange(10, dtype=np.float32) * 10
+    # chunks: 3,3,2,2 (larger chunks on lower ranks)
+    bounds = [0, 3, 6, 8, 10]
+    for r, out in enumerate(results):
+        np.testing.assert_array_equal(out, total[bounds[r]:bounds[r + 1]])
+
+
+def test_reducescatter_average_default(hvd_shutdown):
+    def fn():
+        x = np.full((4, 2), float(hvd.rank()), dtype=np.float32)
+        return hvd.reducescatter(x)
+
+    results = run_ranks(fn, np_ranks=4)
+    for out in results:
+        np.testing.assert_allclose(out, np.full((1, 2), 1.5))
+
+
+# ---------------------------------------------------------------------------
+# barrier / join
+
+def test_barrier(hvd_shutdown):
+    import time
+    times = {}
+
+    def fn():
+        r = hvd.rank()
+        time.sleep(0.02 * r)
+        hvd.barrier()
+        times[r] = time.monotonic()
+        return times[r]
+
+    results = run_ranks(fn, np_ranks=4)
+    assert max(results) - min(results) < 0.5
+
+
+def test_join_uneven_batches(hvd_shutdown):
+    def fn():
+        r = hvd.rank()
+        nbatches = 2 if r == 0 else 4
+        outs = []
+        for _ in range(nbatches):
+            outs.append(hvd.allreduce(
+                np.full(3, 1.0, dtype=np.float32), op=hvd.Sum))
+        last = hvd.join()
+        return outs, last
+
+    results = run_ranks(fn, np_ranks=4)
+    for r, (outs, last) in enumerate(results):
+        # first 2 batches: all 4 ranks → 4.0; later: rank 0 joined → 3.0
+        np.testing.assert_allclose(outs[0], np.full(3, 4.0))
+        np.testing.assert_allclose(outs[1], np.full(3, 4.0))
+        if r != 0:
+            np.testing.assert_allclose(outs[2], np.full(3, 3.0))
+            np.testing.assert_allclose(outs[3], np.full(3, 3.0))
+        assert isinstance(last, int)
+
+
+# ---------------------------------------------------------------------------
+# process sets
+
+def test_process_set_allreduce(hvd_shutdown):
+    even = hvd.ProcessSet([0, 2])
+    odd = hvd.ProcessSet([1, 3])
+
+    def fn():
+        r = hvd.rank()
+        ps = even if r % 2 == 0 else odd
+        x = np.full(4, float(r), dtype=np.float32)
+        out = hvd.allreduce(x, op=hvd.Sum, process_set=ps)
+        return out, ps.size(), ps.rank(), ps.included()
+
+    hvd.init(num_ranks=4, process_sets=[even, odd])
+    try:
+        results = hvd.run(fn, np=4)
+    finally:
+        hvd.shutdown()
+    for r, (out, sz, psr, inc) in enumerate(results):
+        expected = 2.0 if r % 2 == 0 else 4.0
+        np.testing.assert_allclose(out, np.full(4, expected))
+        assert sz == 2
+        assert psr == r // 2
+        assert inc
+
+
+def test_add_remove_process_set(hvd_shutdown):
+    hvd.init(num_ranks=4)
+    ps = hvd.add_process_set([0, 1, 3])
+    assert ps.process_set_id is not None
+    assert hvd.remove_process_set(ps)
+    assert not hvd.remove_process_set(hvd.global_process_set)
+
+
+# ---------------------------------------------------------------------------
+# compression
+
+def test_fp16_compression_roundtrip(hvd_shutdown):
+    compressor = hvd.Compression.fp16
+
+    def fn():
+        x = np.full(8, float(hvd.rank()), dtype=np.float32)
+        comp, ctx = compressor.compress(x)
+        assert comp.dtype == np.float16
+        out = hvd.allreduce(comp, op=hvd.Sum)
+        out = compressor.decompress(out, ctx)
+        return out
+
+    for out in run_ranks(fn, np_ranks=4):
+        assert out.dtype == np.float32
+        np.testing.assert_allclose(out, np.full(8, 6.0))
+
+
+# ---------------------------------------------------------------------------
+# adasum
+
+def test_adasum_two_identical(hvd_shutdown):
+    # Identical gradients a == b: dot = |a|^2 = |b|^2 → coeffs 0.5 each
+    # → adasum(a, a) == a.
+    def fn():
+        x = np.array([1.0, 2.0, 3.0], dtype=np.float32)
+        return hvd.allreduce(x, op=hvd.Adasum)
+
+    for out in run_ranks(fn, np_ranks=2):
+        np.testing.assert_allclose(out, [1.0, 2.0, 3.0], rtol=1e-6)
+
+
+def test_adasum_orthogonal(hvd_shutdown):
+    # Orthogonal gradients: dot = 0 → coeffs 1 → plain sum.
+    def fn():
+        x = np.array([1.0, 0.0], dtype=np.float32) if hvd.rank() == 0 \
+            else np.array([0.0, 1.0], dtype=np.float32)
+        return hvd.allreduce(x, op=hvd.Adasum)
+
+    for out in run_ranks(fn, np_ranks=2):
+        np.testing.assert_allclose(out, [1.0, 1.0], rtol=1e-6)

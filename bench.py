@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 synthetic training throughput per chip.
+
+Mirrors the reference's synthetic benchmark protocol
+(``/root/reference/examples/pytorch/pytorch_synthetic_benchmark.py``:
+ResNet-50, synthetic ImageNet batches, img/sec over timed iterations;
+``/root/reference/docs/benchmarks.rst:30-43`` records 1656.82 img/sec
+on 16 Pascal GPUs => 103.55 img/sec/GPU as the per-device baseline).
+
+Here the whole training step (fwd + bwd + SGD update) is one jitted
+XLA program on one TPU chip: bf16 activations on the MXU, f32 master
+weights.  Prints ONE JSON line for the driver.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from horovod_tpu.models import ResNet50
+
+BASELINE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16   # docs/benchmarks.rst:43
+BATCH = 128
+WARMUP = 5
+ITERS = 30
+
+
+def main():
+    dev = jax.devices()[0]
+    model = ResNet50(num_classes=1000)
+    rng = jax.random.PRNGKey(0)
+    images = jax.random.normal(rng, (BATCH, 224, 224, 3), jnp.bfloat16)
+    labels = jax.random.randint(rng, (BATCH,), 0, 1000)
+
+    variables = jax.jit(lambda: model.init(rng, images, train=False))()
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt_state = tx.init(params)
+
+    def loss_fn(params, batch_stats, images, labels):
+        logits, mutated = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            images, train=True, mutable=["batch_stats"])
+        logp = jax.nn.log_softmax(logits)
+        loss = -jnp.mean(jnp.take_along_axis(
+            logp, labels[:, None], axis=-1))
+        return loss, mutated["batch_stats"]
+
+    @jax.jit
+    def train_step(params, batch_stats, opt_state, images, labels):
+        (loss, batch_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch_stats, images, labels)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, batch_stats, opt_state, loss
+
+    for _ in range(WARMUP):
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, images, labels)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, images, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = BATCH * ITERS / dt
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(img_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC_PER_DEVICE,
+                             3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -55,6 +55,12 @@ def init(comm=None, process_sets=None, num_ranks=None, devices=None):
       parity; MPI communicators are not a TPU concept.
     * ``process_sets`` — list of ProcessSet objects to register at
       init time (reference basics.py:51-148).
+
+    Under the multi-process launcher (``HOROVOD_CONTROLLER=http``,
+    reference gloo_run.py:66-103 env handoff), this also brings up
+    ``jax.distributed`` so compiled collectives span processes, and a
+    :class:`StoreController` for negotiation (reference
+    GlooContext::Initialize, gloo/gloo_context.cc:150-216).
     """
     global _engine, _topology, _timeline
     with _state_lock:
@@ -64,19 +70,57 @@ def init(comm=None, process_sets=None, num_ranks=None, devices=None):
             return
         from ..core.engine import Engine
 
+        controller = None
+        rank_offset = 0
+        global_size = None
+        multiproc = env_mod.get_str(env_mod.HOROVOD_CONTROLLER) == "http"
         if num_ranks is None:
             num_ranks = env_mod.get_int(env_mod.HOROVOD_TPU_RANKS_PER_PROC, 0)
         if not num_ranks:
             num_ranks = 1
+        if multiproc:
+            from ..core.store_controller import StoreController
+            import jax
+
+            proc_id = env_mod.get_int(env_mod.HOROVOD_TPU_PROC_INDEX, 0)
+            num_procs = env_mod.get_int(env_mod.HOROVOD_TPU_NUM_PROCS, 1)
+            coordinator = env_mod.get_str(env_mod.HOROVOD_TPU_COORDINATOR)
+            rdv_addr = env_mod.get_str(env_mod.HOROVOD_RENDEZVOUS_ADDR,
+                                       "127.0.0.1")
+            rdv_port = env_mod.get_int(env_mod.HOROVOD_RENDEZVOUS_PORT, 0)
+            secret = env_mod.get_str("HOROVOD_SECRET_KEY")
+            secret = bytes.fromhex(secret) if secret else None
+            if num_procs > 1 and coordinator:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator,
+                    num_processes=num_procs, process_id=proc_id)
+            global_size = num_procs * num_ranks
+            rank_offset = proc_id * num_ranks
+            controller = StoreController(
+                rdv_addr, rdv_port, secret, proc_id, num_procs,
+                num_ranks)
+            if devices is None:
+                import jax as _jax
+                devices = _jax.devices()
+            if len(devices) < global_size:
+                raise HorovodInitError(
+                    f"multi-process mode needs one device per rank: "
+                    f"{len(devices)} devices < {global_size} ranks")
+            _topology = Topology(
+                size=global_size,
+                host_of_rank=[r // num_ranks for r in range(global_size)])
+        else:
+            _topology = Topology(size=num_ranks)
         if devices is None:
             import jax
             platform = env_mod.get_str(env_mod.HOROVOD_TPU_PLATFORM)
             devices = jax.devices(platform) if platform else jax.devices()
         config = env_mod.Config()
-        _topology = Topology(size=num_ranks)
         _timeline = _make_timeline(config)
         _engine = Engine(num_ranks, devices, config=config,
-                         topology=_topology, timeline=_timeline)
+                         topology=_topology, timeline=_timeline,
+                         controller=controller, rank_offset=rank_offset,
+                         global_size=global_size)
         if process_sets:
             from . import process_sets as ps_mod
             for ps in process_sets:
@@ -86,18 +130,21 @@ def init(comm=None, process_sets=None, num_ranks=None, devices=None):
 
 def _bind_thread_if_unbound():
     if getattr(_tls, "ctx", None) is None and _engine is not None:
-        if _engine.num_ranks == 1:
-            _tls.ctx = RankContext(0)
+        if _engine.num_local == 1:
+            _tls.ctx = RankContext(_engine.rank_offset)
 
 
 def bind_rank(rank):
-    """Bind the calling thread to a rank context.  Used by the thread
-    launcher (one thread per rank) and by tests."""
+    """Bind the calling thread to a rank context.  ``rank`` is the
+    LOCAL rank index within this process (0..num_local); the context
+    carries the global rank.  Used by the thread launcher (one thread
+    per rank) and by tests."""
     if _engine is None:
         raise HorovodInitError("horovod_tpu.init() has not been called")
-    if rank < 0 or rank >= _engine.num_ranks:
-        raise ValueError(f"rank {rank} out of range [0, {_engine.num_ranks})")
-    _tls.ctx = RankContext(rank)
+    if rank < 0 or rank >= _engine.num_local:
+        raise ValueError(
+            f"local rank {rank} out of range [0, {_engine.num_local})")
+    _tls.ctx = RankContext(_engine.rank_offset + rank)
     return _tls.ctx
 
 

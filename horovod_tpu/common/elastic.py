@@ -1,0 +1,139 @@
+"""Elastic training core: State commit/restore/sync + the retry loop.
+
+Reference: ``horovod/common/elastic.py`` (State :26, ObjectState :116,
+run_fn :151).  A worker wraps its training function with ``run_fn``;
+on ``HorovodInternalError`` the last committed state is restored and
+the job re-rendezvouses; on ``HostsUpdatedInterrupt`` the current
+state is kept and ranks re-sync.  On TPU a membership change means the
+mesh must be rebuilt, so reset() tears the engine down and re-inits.
+"""
+
+import functools
+import queue
+
+from . import basics
+from .exceptions import HorovodInternalError, HostsUpdatedInterrupt
+
+
+class State:
+    """Base class: save/restore/sync + registered reset callbacks
+    (reference common/elastic.py:26-98)."""
+
+    def __init__(self, **kwargs):
+        self._host_messages = queue.Queue()
+        self._last_updated_timestamp = 0
+        self._reset_callbacks = []
+
+    def register_reset_callbacks(self, callbacks):
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self):
+        self._host_messages = queue.Queue()
+        self.reset()
+        for callback in self._reset_callbacks:
+            callback()
+
+    def on_hosts_updated(self, timestamp, update_res):
+        self._host_messages.put((timestamp, update_res))
+
+    def commit(self):
+        """Save and check for pending host updates (the reference
+        commits then raises HostsUpdatedInterrupt at a safe point)."""
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self):
+        """Raise HostsUpdatedInterrupt if the driver pushed membership
+        changes since the last check (reference :58-77)."""
+        updated = False
+        skip_sync = True
+        while not self._host_messages.empty():
+            timestamp, update_res = self._host_messages.get()
+            if timestamp > self._last_updated_timestamp:
+                self._last_updated_timestamp = timestamp
+                updated = True
+                # removals require rollback; additions may skip sync
+                skip_sync = skip_sync and not bool(update_res)
+        if updated:
+            raise HostsUpdatedInterrupt(skip_sync)
+
+    def save(self):
+        raise NotImplementedError
+
+    def restore(self):
+        raise NotImplementedError
+
+    def sync(self):
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+
+class ObjectState(State):
+    """State for arbitrary picklable attributes: save keeps an
+    in-memory copy, sync broadcasts from rank 0 (reference
+    common/elastic.py:116-148)."""
+
+    def __init__(self, bcast_object, get_rank, **kwargs):
+        self._bcast_object = bcast_object
+        self._rank = get_rank
+        self._saved_state = kwargs
+        self._set_attrs()
+        super().__init__()
+
+    def save(self):
+        new_state = {}
+        for attr in self._saved_state.keys():
+            new_state[attr] = getattr(self, attr)
+        self._saved_state = new_state
+
+    def restore(self):
+        self._set_attrs()
+
+    def sync(self):
+        if self._saved_state:
+            self._saved_state = self._bcast_object(self._saved_state)
+            self._set_attrs()
+
+    def _set_attrs(self):
+        for attr, value in self._saved_state.items():
+            setattr(self, attr, value)
+
+
+def run_fn(func, reset):
+    """Elastic retry loop (reference common/elastic.py:151-175)."""
+    @functools.wraps(func)
+    def wrapper(state, *args, **kwargs):
+        notification_manager = _get_notification_manager()
+        if notification_manager is not None:
+            notification_manager.init()
+            notification_manager.register_listener(state)
+        skip_sync = False
+        try:
+            while True:
+                if not skip_sync:
+                    state.sync()
+                try:
+                    return func(state, *args, **kwargs)
+                except HorovodInternalError:
+                    state.restore()
+                    skip_sync = False
+                except HostsUpdatedInterrupt as e:
+                    skip_sync = e.skip_sync
+                reset()
+                state.on_reset()
+        finally:
+            if notification_manager is not None:
+                notification_manager.remove_listener(state)
+    return wrapper
+
+
+def _get_notification_manager():
+    """The launcher-side worker notification channel; absent when not
+    running under the elastic launcher."""
+    try:
+        from ..runner.elastic.worker import notification_manager
+        return notification_manager
+    except Exception:  # pragma: no cover — runner not in use
+        return None

@@ -7,11 +7,32 @@ import numpy as np
 def to_numpy(tensor):
     """Convert an input value to a host ndarray, remembering the
     original kind so results can be returned in the caller's type.
-    Supported kinds: numpy, jax, python scalar/list."""
+    Supported kinds: numpy, jax, torch, tf, python scalar/list.
+
+    This is the DLPack-free staging layer of SURVEY §7 step 2: torch
+    and TF tensors in this image live on host, so ``.numpy()`` views
+    are zero-copy; the single H2D transfer happens per fused bucket in
+    the executor."""
     kind = "numpy"
-    if hasattr(tensor, "__module__") and type(tensor).__module__.startswith("jax"):
+    mod = type(tensor).__module__
+    if mod.startswith("jax"):
         kind = "jax"
         arr = np.asarray(tensor)
+    elif mod.startswith("torch"):
+        kind = "torch"
+        t = tensor.detach().cpu()
+        if str(t.dtype) == "torch.bfloat16":
+            # numpy has no native bf16: reinterpret the bits as
+            # ml_dtypes.bfloat16 so the wire stays 16-bit (fp16
+            # compression halves collective bytes — keep that).
+            import ml_dtypes
+            arr = t.view(__import__("torch").uint16).numpy().view(
+                ml_dtypes.bfloat16)
+        else:
+            arr = t.numpy()
+    elif mod.startswith("tensorflow"):
+        kind = "tf"
+        arr = tensor.numpy() if hasattr(tensor, "numpy") else np.asarray(tensor)
     elif isinstance(tensor, np.ndarray):
         arr = tensor
     elif isinstance(tensor, (int, float, bool, complex)):
@@ -21,8 +42,6 @@ def to_numpy(tensor):
         kind = "numpy"
         arr = np.asarray(tensor)
     else:
-        # torch / tf tensors are converted by their bindings before
-        # reaching the core API; anything else must support __array__.
         arr = np.asarray(tensor)
     return arr, kind
 
@@ -31,9 +50,32 @@ def from_numpy(arr, kind):
     if kind == "jax":
         import jax.numpy as jnp
         return jnp.asarray(arr)
+    if kind == "torch":
+        import torch
+        if str(arr.dtype) == "bfloat16":
+            return torch.from_numpy(
+                np.ascontiguousarray(arr).view(np.uint16)).view(
+                torch.bfloat16)
+        return torch.from_numpy(np.ascontiguousarray(arr))
+    if kind == "tf":
+        import tensorflow as tf
+        return tf.convert_to_tensor(arr)
     if kind == "scalar":
         return arr.item() if arr.ndim == 0 else arr
     return arr
+
+
+def copy_into(target, arr):
+    """In-place copy of a host result into a framework tensor."""
+    mod = type(target).__module__
+    if mod.startswith("torch"):
+        import torch
+        with torch.no_grad():
+            src = from_numpy(arr, "torch")   # handles bf16 bit views
+            target.copy_(src.view_as(target))
+        return target
+    np.copyto(target, arr.reshape(target.shape))
+    return target
 
 
 def dumps(obj) -> np.ndarray:

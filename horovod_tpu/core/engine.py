@@ -6,8 +6,8 @@ TPU-native analogue of the reference's core
 
 * Each **rank** is a rank context bound to a device of the mesh.  On a
   TPU host one process drives all local chips, so ranks live as threads
-  of one process (launcher) or as positions in an SPMD program — not as
-  one OS process per accelerator the way CUDA forces.
+  of one process — not one OS process per accelerator the way CUDA
+  forces.  Multi-host jobs run one such process per host.
 * Rank threads **enqueue** tensors (EnqueueTensorAllreduce analogue);
   a single background thread negotiates readiness (a tensor executes
   only when every participating rank has submitted it — the exact
@@ -15,13 +15,13 @@ TPU-native analogue of the reference's core
   buckets under the fusion threshold (FuseResponses,
   controller.cc:901-1080), and dispatches each bucket to a cached
   compiled XLA collective (ops/xla_ops.py).
+* Single-process: the negotiation table *is* shared memory — no wire
+  protocol.  Multi-process: a :class:`StoreController` reports local
+  readiness to the launcher-hosted coordinator and executes the
+  coordinator's ordered response log, which keeps every process
+  issuing identical SPMD programs (core/store_controller.py).
 * Completion flows back through async handles
   (torch/handle_manager.h analogue).
-
-The in-process controller needs no gatherv/bcast wire protocol: the
-negotiation table *is* shared memory.  Multi-host deployments layer a
-store-based controller on top (runner/), with this same engine running
-per host.
 """
 
 import logging
@@ -75,15 +75,19 @@ class ProcessSetState:
     """Runtime state for one process set (reference process_set.h:26-84:
     controller + tensor queue + joined state per set)."""
 
-    def __init__(self, ps_id, ranks, executor):
+    def __init__(self, ps_id, ranks, executor, local_ranks=None):
         self.id = ps_id
         self.ranks = list(ranks)            # global ranks, sorted
         self.index = {r: i for i, r in enumerate(self.ranks)}
+        self.local_ranks = list(local_ranks) if local_ranks is not None \
+            else list(self.ranks)           # subset hosted by this process
         self.executor = executor
         self.pending: "OrderedDict[str, NegotiationEntry]" = OrderedDict()
-        self.joined = set()                 # ranks that called join()
+        self.awaiting: Dict[str, NegotiationEntry] = {}  # store mode
+        self.joined = set()                 # local ranks that called join()
         self.last_joined = -1
         self.join_waiters: Dict[int, Handle] = {}
+        self.join_reported = False
 
     @property
     def size(self):
@@ -93,16 +97,24 @@ class ProcessSetState:
 class Engine:
     """The per-process core runtime (reference HorovodGlobalState +
     BackgroundThreadLoop, global_state.h:39-126, operations.cc:409-749).
+
+    ``num_ranks`` ranks are hosted in this process, covering global
+    ranks [rank_offset, rank_offset + num_ranks) of a ``global_size``
+    world.  Single-process: offset 0, global == local.
     """
 
     def __init__(self, num_ranks, devices, config=None, topology=None,
-                 timeline=None):
+                 timeline=None, controller=None, rank_offset=0,
+                 global_size=None):
         from ..ops.xla_ops import MeshExecutor
 
         self.config = config or env_mod.Config()
-        self.num_ranks = num_ranks
+        self.num_local = num_ranks
+        self.global_size = global_size if global_size else num_ranks
+        self.rank_offset = rank_offset
         self.devices = list(devices)
         self.topology = topology
+        self.controller = controller
         self.handle_manager = HandleManager()
         self.timeline = timeline
 
@@ -112,9 +124,7 @@ class Engine:
         self._shutdown_done = threading.Event()
 
         self._MeshExecutor = MeshExecutor
-        ps0 = ProcessSetState(
-            0, range(num_ranks),
-            MeshExecutor(self._devices_for(range(num_ranks)), num_ranks))
+        ps0 = self._make_process_set_state(0, range(self.global_size))
         self.process_sets: Dict[int, ProcessSetState] = {0: ps0}
         self._next_ps_id = 1
 
@@ -125,15 +135,50 @@ class Engine:
         self._thread.start()
 
     # ------------------------------------------------------------------
-    # process sets
+    # compat + helpers
+
+    @property
+    def num_ranks(self):
+        """Global world size (API surface: hvd.size())."""
+        return self.global_size
+
+    @property
+    def multiproc(self):
+        return self.controller is not None
+
+    def _local_global_ranks(self):
+        return range(self.rank_offset, self.rank_offset + self.num_local)
+
+    def _proc_of(self, global_rank):
+        """Hosting process of a global rank (uniform slots-per-process,
+        enforced by the launcher)."""
+        return global_rank // self.num_local
+
+    def _make_process_set_state(self, ps_id, ranks):
+        ranks = sorted(ranks)
+        local = [r for r in ranks
+                 if self.rank_offset <= r < self.rank_offset + self.num_local]
+        devices = self._devices_for(ranks)
+        positions = [ranks.index(r) for r in local] \
+            if len(local) < len(ranks) else None
+        executor = self._MeshExecutor(devices, len(ranks),
+                                      local_positions=positions)
+        return ProcessSetState(ps_id, ranks, executor, local_ranks=local)
 
     def _devices_for(self, ranks):
         nd = len(self.devices)
+        if self.multiproc:
+            # one device per global rank; self.devices is the global
+            # device list (jax.devices() after jax.distributed init)
+            return [self.devices[r] for r in ranks]
         return [self.devices[r % nd] for r in ranks]
+
+    # ------------------------------------------------------------------
+    # process sets
 
     def add_process_set(self, ranks) -> int:
         ranks = sorted(set(int(r) for r in ranks))
-        if any(r < 0 or r >= self.num_ranks for r in ranks):
+        if any(r < 0 or r >= self.global_size for r in ranks):
             raise ValueError(f"process set ranks {ranks} out of range")
         with self._lock:
             for ps in self.process_sets.values():
@@ -143,9 +188,8 @@ class Engine:
                         f"(id {ps.id})")
             ps_id = self._next_ps_id
             self._next_ps_id += 1
-            self.process_sets[ps_id] = ProcessSetState(
-                ps_id, ranks,
-                self._MeshExecutor(self._devices_for(ranks), len(ranks)))
+            self.process_sets[ps_id] = self._make_process_set_state(
+                ps_id, ranks)
             return ps_id
 
     def remove_process_set(self, ps_id) -> bool:
@@ -155,11 +199,12 @@ class Engine:
             ps = self.process_sets.pop(ps_id, None)
             if ps is None:
                 return False
-            for entry in ps.pending.values():
+            for entry in list(ps.pending.values()) + \
+                    list(ps.awaiting.values()):
                 for sub in entry.subs.values():
                     sub.handle.set_error(HorovodInternalError(
                         f"process set {ps_id} removed while "
-                        f"{entry.key[0]} pending"))
+                        f"{entry.key} pending"))
             return True
 
     def get_process_set(self, ps_id) -> ProcessSetState:
@@ -189,8 +234,16 @@ class Engine:
             if sub.rank not in ps.index:
                 raise ValueError(
                     f"rank {sub.rank} is not part of process set {ps.id}")
-            key = self._negotiation_key(sub)
+            if sub.rank not in ps.local_ranks:
+                raise ValueError(
+                    f"rank {sub.rank} is not hosted by this process")
+            key = self._negotiation_key(ps, sub)
             entry = ps.pending.get(key)
+            if entry is None and key in ps.awaiting:
+                sub.handle.set_error(DuplicateNameError(
+                    f"tensor {sub.names} resubmitted while a prior "
+                    f"submission is still executing"))
+                return sub.handle
             if entry is None:
                 entry = NegotiationEntry(key)
                 ps.pending[key] = entry
@@ -228,10 +281,15 @@ class Engine:
             ps.last_joined = rank
             ps.join_waiters[rank] = handle
             self._lock.notify_all()
+        if self.multiproc:
+            self.controller.report_join(
+                ps_id, rank, len(ps.ranks),
+                proc_members=len(ps.local_ranks))
         return handle
 
-    def _negotiation_key(self, sub: Submission):
-        return (sub.request.request_type, tuple(sub.names))
+    def _negotiation_key(self, ps, sub: Submission):
+        return (f"{sub.request.request_type.name}"
+                f"|{'/'.join(sub.names)}|ps{ps.id}")
 
     # ------------------------------------------------------------------
     # background loop
@@ -248,19 +306,22 @@ class Engine:
                     break
                 work = self._collect_ready_locked()
                 self._check_stalls_locked()
-            for ps, batch in work:
-                self._execute_batch(ps, batch)
+            if self.multiproc:
+                self._store_cycle(work)
+            else:
+                for ps, batch in work:
+                    self._execute_batch(ps, batch)
         self._shutdown_done.set()
 
     def _collect_ready_locked(self):
-        """ComputeResponseList analogue: pull fully-ready negotiation
-        entries (readiness = submissions from every non-joined rank of
-        the set, controller.cc:269-327 for the joined case) and resolve
-        join barriers."""
+        """ComputeResponseList analogue: pull locally-ready negotiation
+        entries (readiness = submissions from every non-joined LOCAL
+        rank of the set, controller.cc:269-327 for the joined case) and
+        resolve single-process join barriers."""
         work = []
         for ps in list(self.process_sets.values()):
-            # join barrier: every rank joined -> release all waiters
-            if ps.joined and len(ps.joined) == ps.size:
+            if not self.multiproc and ps.joined and \
+                    len(ps.joined) == ps.size:
                 for r, h in ps.join_waiters.items():
                     h.set_result(ps.last_joined)
                 ps.join_waiters.clear()
@@ -269,11 +330,17 @@ class Engine:
             ready = []
             for key in list(ps.pending.keys()):
                 entry = ps.pending[key]
-                needed = [r for r in ps.ranks if r not in ps.joined]
+                # ready when every non-joined local rank has submitted;
+                # if all submitters have since joined, the entry still
+                # executes with their pre-join data (entries always
+                # hold >= 1 submission)
+                needed = [r for r in ps.local_ranks if r not in ps.joined]
                 if all(r in entry.subs for r in needed):
                     ready.append(entry)
                     del ps.pending[key]
-                    self._stall_warned.discard((ps.id,) + key)
+                    if self.multiproc:
+                        ps.awaiting[key] = entry
+                    self._stall_warned.discard((ps.id, key))
             if ready:
                 work.append((ps, ready))
         return work
@@ -286,34 +353,220 @@ class Engine:
             return
         now = time.monotonic()
         for ps in self.process_sets.values():
-            for key, entry in list(ps.pending.items()):
-                age = now - entry.first_time
-                wkey = (ps.id,) + key
-                if (age > self.config.stall_warning_secs
-                        and wkey not in self._stall_warned):
-                    missing = [r for r in ps.ranks
-                               if r not in entry.subs and r not in ps.joined]
-                    logger.warning(
-                        "One or more tensors were submitted to be reduced "
-                        "by some ranks but not all: %s stalled for %.0fs "
-                        "(missing ranks: %s)", key[1], age, missing)
-                    self._stall_warned.add(wkey)
-                if (self.config.stall_shutdown_secs > 0
-                        and age > self.config.stall_shutdown_secs):
-                    del ps.pending[key]
-                    for sub in entry.subs.values():
-                        sub.handle.set_error(StalledTensorError(
-                            f"tensor {key[1]} stalled for {age:.0f}s"))
+            tables = [("pending", ps.pending), ("awaiting", ps.awaiting)]
+            for where, table in tables:
+                for key, entry in list(table.items()):
+                    age = now - entry.first_time
+                    wkey = (ps.id, key)
+                    if (age > self.config.stall_warning_secs
+                            and wkey not in self._stall_warned):
+                        if where == "pending":
+                            missing = [r for r in ps.local_ranks
+                                       if r not in entry.subs
+                                       and r not in ps.joined]
+                            logger.warning(
+                                "One or more tensors were submitted to "
+                                "be reduced by some ranks but not all: "
+                                "%s stalled for %.0fs (missing local "
+                                "ranks: %s)", key, age, missing)
+                        else:
+                            logger.warning(
+                                "Tensor %s reported ready %.0fs ago but "
+                                "the coordinator has not scheduled it "
+                                "(peer process missing or stalled)",
+                                key, age)
+                        self._stall_warned.add(wkey)
+                    if (self.config.stall_shutdown_secs > 0
+                            and age > self.config.stall_shutdown_secs):
+                        del table[key]
+                        for sub in entry.subs.values():
+                            sub.handle.set_error(StalledTensorError(
+                                f"tensor {key} stalled for {age:.0f}s"))
 
     def _fail_all_pending_locked(self, exc):
         for ps in self.process_sets.values():
-            for entry in ps.pending.values():
+            for entry in list(ps.pending.values()) + \
+                    list(ps.awaiting.values()):
                 for sub in entry.subs.values():
                     sub.handle.set_error(exc)
             ps.pending.clear()
+            ps.awaiting.clear()
             for h in ps.join_waiters.values():
                 h.set_error(exc)
             ps.join_waiters.clear()
+
+    # ------------------------------------------------------------------
+    # store-controller (multi-process) cycle
+
+    def _meta_for(self, ps, entry):
+        """Negotiation metadata sent to the coordinator — the Request
+        wire message (reference message.h:59-143 via FlatBuffers)."""
+        first = next(iter(entry.subs.values()))
+        req = first.request
+        nbytes = sum(int(p.nbytes) for p in first.payloads)
+        nprocs = len({self._proc_of(r) for r in ps.ranks})
+        meta = {
+            "key": entry.key,
+            "type": req.request_type.name,
+            "dtype": req.dtype,
+            "shape": list(req.shape),
+            "op": int(req.reduce_op),
+            "pre": req.prescale_factor,
+            "post": req.postscale_factor,
+            "ps": ps.id,
+            "nbytes": nbytes,
+            "nprocs": nprocs,
+            "root": req.root_rank,
+            "aux": {},
+        }
+        if req.request_type == RequestType.ALLGATHER:
+            # per-local-rank first dims, ordered by global rank; the
+            # coordinator merges them into the global dim0 table (the
+            # reference's allgather shape exchange)
+            meta["aux"]["dim0s"] = [
+                [int(entry.subs[r].payloads[i].shape[0])
+                 if entry.subs[r].payloads[i].ndim else 1
+                 for i in range(len(first.payloads))]
+                for r in ps.local_ranks if r in entry.subs
+            ]
+        if req.request_type == RequestType.ALLTOALL:
+            meta["aux"]["splits"] = [
+                list(entry.subs[r].request.splits)
+                for r in ps.local_ranks if r in entry.subs
+            ]
+        return meta
+
+    def _store_cycle(self, work):
+        """Report locally-ready entries; execute coordinator responses
+        in log order."""
+        metas = []
+        for ps, batch in work:
+            for entry in batch:
+                err = self._validate(ps, entry, local_only=True)
+                if err is not None:
+                    with self._lock:
+                        ps.awaiting.pop(entry.key, None)
+                    for sub in entry.subs.values():
+                        sub.handle.set_error(err)
+                    # tell the coordinator so peer processes holding
+                    # this tensor fail instead of waiting forever
+                    meta = self._meta_for(ps, entry)
+                    meta["error"] = str(err)
+                    metas.append(meta)
+                    continue
+                metas.append(self._meta_for(ps, entry))
+        try:
+            if metas:
+                self.controller.report_ready(metas)
+            responses = self.controller.poll(wait=0.2)
+        except Exception as exc:  # noqa: BLE001 — coordinator death
+            self.abort(exc)
+            return
+        for resp in responses:
+            self._apply_response(resp)
+
+    def _apply_response(self, resp):
+        kind = resp.get("kind")
+        if kind == "batch":
+            keys = resp["keys"]
+            aux = resp.get("aux", {})
+            metas = resp.get("metas", {})
+            ps = self._ps_for_response(keys, metas)
+            if ps is None or not ps.local_ranks:
+                # this process hosts no members of the set: the
+                # sub-mesh excludes our devices — do not participate
+                return
+            entries = []
+            bad_key = None
+            with self._lock:
+                popped = {}
+                for k in keys:
+                    e = ps.awaiting.pop(k, None)
+                    if e is not None:
+                        popped[k] = e
+                for k in keys:
+                    e = popped.get(k)
+                    if e is None:
+                        # our ranks joined before this entry: we must
+                        # still run the SPMD program with zero inputs
+                        # (the reference Join zero-tensor trick made
+                        # compiled: all mesh devices participate)
+                        e = self._synthetic_entry(k, metas.get(k))
+                    if e is None:
+                        bad_key = k
+                        break
+                    entries.append(e)
+            if bad_key is not None:
+                # protocol violation: we cannot participate in this
+                # SPMD program — peers would deadlock, so fail loudly
+                # everywhere (reference SHUT_DOWN_ERROR, common.h:231)
+                err = HorovodInternalError(
+                    f"coordinator response for unknown tensor "
+                    f"{bad_key}; aborting to avoid a hang")
+                for pe in popped.values():
+                    for sub in pe.subs.values():
+                        sub.handle.set_error(err)
+                self.abort(err)
+                return
+            try:
+                self._run_bucket(ps, entries, aux=aux)
+            except Exception as exc:  # noqa: BLE001 — deliver to waiters
+                logger.exception("collective execution failed")
+                wrapped = exc if isinstance(exc, HorovodInternalError) \
+                    else HorovodInternalError(str(exc))
+                for e in entries:
+                    for sub in e.subs.values():
+                        sub.handle.set_error(wrapped)
+        elif kind == "error":
+            with self._lock:
+                for cand in self.process_sets.values():
+                    e = cand.awaiting.pop(resp["key"], None)
+                    if e is not None:
+                        for sub in e.subs.values():
+                            sub.handle.set_error(TensorShapeMismatchError(
+                                resp.get("message", "negotiation error")))
+                        break
+        elif kind == "join_done":
+            with self._lock:
+                ps = self.process_sets.get(resp.get("ps", 0))
+                if ps is not None:
+                    for r, h in ps.join_waiters.items():
+                        h.set_result(resp.get("last", -1))
+                    ps.join_waiters.clear()
+                    ps.joined.clear()
+                    ps.last_joined = -1
+
+    def _ps_for_response(self, keys, metas):
+        for k in keys:
+            m = metas.get(k)
+            if m is not None:
+                return self.process_sets.get(m.get("ps", 0))
+            with self._lock:
+                for cand in self.process_sets.values():
+                    if k in cand.awaiting:
+                        return cand
+        return None
+
+    def _synthetic_entry(self, key, meta):
+        """Zero-contribution entry for a bucket our joined ranks did
+        not submit to (allreduce only — other ops reject join)."""
+        if meta is None or meta["type"] not in ("ALLREDUCE", "ADASUM"):
+            return None
+        req = Request(
+            request_type=RequestType[meta["type"]], tensor_name=key,
+            rank=-1, dtype=meta["dtype"], shape=tuple(meta["shape"]),
+            reduce_op=ReduceOp(meta["op"]),
+            prescale_factor=meta["pre"], postscale_factor=meta["post"],
+            process_set_id=meta["ps"])
+        dtype = np.dtype(meta["dtype"]) if meta["dtype"] != "bfloat16" \
+            else _bfloat16_dtype()
+        sub = Submission(rank=-1, request=req, names=[key],
+                         payloads=[np.zeros(tuple(meta["shape"]),
+                                            dtype=dtype)],
+                         handle=Handle())
+        entry = NegotiationEntry(key)
+        entry.subs[-1] = sub
+        return entry
 
     # ------------------------------------------------------------------
     # validation + fusion + execution (background thread)
@@ -342,10 +595,12 @@ class Engine:
                     for sub in entry.subs.values():
                         sub.handle.set_error(wrapped)
 
-    def _validate(self, ps, entry) -> Optional[Exception]:
+    def _validate(self, ps, entry, local_only=False) -> Optional[Exception]:
         """Cross-rank consistency checks, mirroring ConstructResponse
         (controller.cc:496-843): dtype, shape, op, scale factors and
-        root must agree across ranks."""
+        root must agree across ranks.  In multi-process mode this
+        covers the local ranks; the coordinator re-validates across
+        processes."""
         subs = [entry.subs[r] for r in ps.ranks if r in entry.subs]
         first = subs[0].request
         rt = first.request_type
@@ -394,6 +649,8 @@ class Engine:
                     sum(r0.splits) != (r0.shape[0] if r0.shape else 0):
                 return TensorShapeMismatchError(
                     f"alltoall splits invalid for {first.tensor_name}")
+        if local_only:
+            return None
         if len(subs) < ps.size and rt not in (
                 RequestType.ALLREDUCE, RequestType.ADASUM):
             return HorovodInternalError(
@@ -432,7 +689,7 @@ class Engine:
             buckets.append(cur)
         return buckets
 
-    def _run_bucket(self, ps, bucket):
+    def _run_bucket(self, ps, bucket, aux=None):
         first = next(iter(bucket[0].subs.values()))
         rt = first.request.request_type
         if self.timeline is not None:
@@ -443,11 +700,11 @@ class Engine:
             if rt in (RequestType.ALLREDUCE, RequestType.ADASUM):
                 self._run_allreduce_bucket(ps, bucket)
             elif rt == RequestType.ALLGATHER:
-                self._run_allgather(ps, bucket[0])
+                self._run_allgather(ps, bucket[0], aux=aux)
             elif rt == RequestType.BROADCAST:
                 self._run_broadcast(ps, bucket[0])
             elif rt == RequestType.ALLTOALL:
-                self._run_alltoall(ps, bucket[0])
+                self._run_alltoall(ps, bucket[0], aux=aux)
             elif rt == RequestType.REDUCESCATTER:
                 self._run_reducescatter(ps, bucket[0])
             elif rt == RequestType.BARRIER:
@@ -459,12 +716,17 @@ class Engine:
             if self.timeline is not None:
                 self.timeline.op_end()
 
+    def _local_subs(self, ps, entry):
+        """Local participating submissions, ordered by global rank."""
+        return {r: entry.subs[r] for r in ps.local_ranks if r in entry.subs}
+
     def _run_allreduce_bucket(self, ps, bucket):
-        """Fused allreduce: one flat buffer per rank for the whole
+        """Fused allreduce: one flat buffer per local rank for the whole
         bucket, one compiled collective, then unpack — the
         MemcpyInFusionBuffer / MemcpyOutFusionBuffer pattern
         (collective_operations.h:38-343) with numpy packing instead of
-        a batched-D2D CUDA kernel."""
+        a batched-D2D CUDA kernel.  Joined/missing local ranks
+        contribute zeros (the reference's Join zero-tensor trick)."""
         first = next(iter(bucket[0].subs.values())).request
         op = first.reduce_op
         if first.request_type == RequestType.ADASUM:
@@ -481,7 +743,7 @@ class Engine:
                 offset += int(p.size)
         total = offset
         rows = []
-        for r in ps.ranks:
+        for r in ps.local_ranks:
             buf = np.zeros(total, dtype=dtype)
             for entry, i, off, size, _ in layout:
                 sub = entry.subs.get(r)
@@ -490,46 +752,73 @@ class Engine:
             rows.append(buf)
         results = ps.executor.allreduce(
             rows, op, first.prescale_factor, first.postscale_factor)
-        per_entry_results = {}
+        by_rank = dict(zip(ps.local_ranks, results))
+        # single pass over layout, grouping outputs per (entry, rank)
+        per_entry = {}
         for entry, i, off, size, shape in layout:
-            for r, sub in entry.subs.items():
-                out = results[ps.index[r]][off:off + size].reshape(shape)
-                per_entry_results.setdefault((id(entry), r), []).append(out)
+            for r in entry.subs:
+                if r in by_rank:
+                    per_entry.setdefault((id(entry), r), []).append(
+                        by_rank[r][off:off + size].reshape(shape))
         for entry in bucket:
-            for r, sub in entry.subs.items():
-                outs = per_entry_results[(id(entry), r)]
+            for r, sub in self._local_subs(ps, entry).items():
+                outs = per_entry[(id(entry), r)]
                 sub.handle.set_result(
                     outs if len(sub.payloads) > 1 else outs[0])
 
-    def _run_allgather(self, ps, entry):
+    def _global_dim0s(self, ps, entry, aux, n_tensors):
+        """Global per-rank first-dim table for allgather.  Local mode
+        reads the submissions; store mode merges the coordinator's
+        per-process aux (reference allgather shape exchange)."""
+        if not self.multiproc:
+            return [
+                [int(entry.subs[r].payloads[i].shape[0])
+                 if entry.subs[r].payloads[i].ndim else 1
+                 for r in ps.ranks]
+                for i in range(n_tensors)
+            ]
+        per_proc = aux.get(entry.key, {}) if aux else {}
+        dim0s_by_rank = {}
+        for proc_str, a in per_proc.items():
+            proc = int(proc_str)
+            members = [r for r in ps.ranks
+                       if self._proc_of(r) == proc]
+            for local_i, r in enumerate(members):
+                dim0s_by_rank[r] = a["dim0s"][local_i]
+        return [
+            [int(dim0s_by_rank[r][i]) for r in ps.ranks]
+            for i in range(n_tensors)
+        ]
+
+    def _run_allgather(self, ps, entry, aux=None):
         """Allgather with per-rank first-dim sizes: pad to max rows
         (the reference exchanges shapes during negotiation and sizes the
         fused buffer accordingly, controller.cc:901-1080)."""
-        subs = {r: entry.subs[r] for r in ps.ranks}
+        subs = self._local_subs(ps, entry)
         n_tensors = len(next(iter(subs.values())).payloads)
-        results_per_rank = {r: [] for r in ps.ranks}
+        dim0_tables = self._global_dim0s(ps, entry, aux, n_tensors)
+        results_per_rank = {r: [] for r in subs}
         for i in range(n_tensors):
-            dim0 = [int(subs[r].payloads[i].shape[0]) if subs[r].payloads[i].ndim
-                    else 1 for r in ps.ranks]
+            dim0 = dim0_tables[i]
             rest = tuple(next(iter(subs.values())).payloads[i].shape[1:])
             max_d = max(dim0) if dim0 else 0
             rest_n = int(np.prod(rest, dtype=np.int64)) if rest else 1
             rows = []
-            for r in ps.ranks:
+            for r in subs:
                 p = subs[r].payloads[i]
                 flat = np.ravel(p)
                 buf = np.zeros(max_d * rest_n, dtype=p.dtype)
                 buf[:flat.size] = flat
                 rows.append(buf)
             gathered = ps.executor.allgather(rows, dim0, rest)
-            for r in ps.ranks:
-                results_per_rank[r].append(gathered[ps.index[r]])
+            for r, g in zip(subs, gathered):
+                results_per_rank[r].append(g)
         for r, sub in subs.items():
             outs = results_per_rank[r]
             sub.handle.set_result(outs if n_tensors > 1 else outs[0])
 
     def _run_broadcast(self, ps, entry):
-        subs = {r: entry.subs[r] for r in ps.ranks}
+        subs = self._local_subs(ps, entry)
         first = next(iter(subs.values()))
         root = first.request.root_rank
         root_pos = ps.index.get(root)
@@ -539,28 +828,41 @@ class Engine:
                     f"broadcast root {root} not in process set {ps.id}"))
             return
         n_tensors = len(first.payloads)
-        results_per_rank = {r: [] for r in ps.ranks}
+        results_per_rank = {r: [] for r in subs}
         for i in range(n_tensors):
             shape = first.payloads[i].shape
-            rows = [subs[r].payloads[i].ravel() for r in ps.ranks]
+            rows = [subs[r].payloads[i].ravel() for r in subs]
             out = ps.executor.broadcast(rows, root_pos)
-            for r in ps.ranks:
-                results_per_rank[r].append(
-                    out[ps.index[r]].reshape(shape))
+            for r, o in zip(subs, out):
+                results_per_rank[r].append(o.reshape(shape))
         for r, sub in subs.items():
             outs = results_per_rank[r]
             sub.handle.set_result(outs if n_tensors > 1 else outs[0])
 
-    def _run_alltoall(self, ps, entry):
-        subs = {r: entry.subs[r] for r in ps.ranks}
+    def _global_splits(self, ps, entry, aux):
+        """Global alltoall send-split table (one vector per rank)."""
+        if not self.multiproc:
+            return [list(entry.subs[r].request.splits) for r in ps.ranks]
+        per_proc = aux.get(entry.key, {}) if aux else {}
+        splits_by_rank = {}
+        for proc_str, a in per_proc.items():
+            proc = int(proc_str)
+            members = [r for r in ps.ranks if self._proc_of(r) == proc]
+            for local_i, r in enumerate(members):
+                splits_by_rank[r] = a["splits"][local_i]
+        return [list(splits_by_rank[r]) for r in ps.ranks]
+
+    def _run_alltoall(self, ps, entry, aux=None):
+        subs = self._local_subs(ps, entry)
         first = next(iter(subs.values()))
         rest = tuple(first.payloads[0].shape[1:])
         rest_n = int(np.prod(rest, dtype=np.int64)) if rest else 1
-        splits = [list(subs[r].request.splits) for r in ps.ranks]
+        splits = self._global_splits(ps, entry, aux)
         R = ps.size
         max_seg = max((s for sp in splits for s in sp), default=0)
         rows = []
-        for pos, r in enumerate(ps.ranks):
+        for r in subs:
+            pos = ps.index[r]
             p = subs[r].payloads[0]
             flat = np.ravel(p)
             buf = np.zeros(R * max_seg * rest_n, dtype=p.dtype)
@@ -572,12 +874,11 @@ class Engine:
                 off += seg
             rows.append(buf)
         results, recv_splits = ps.executor.alltoall(rows, splits, rest)
-        for pos, r in enumerate(ps.ranks):
-            subs[r].handle.set_result(
-                results[pos], extra=np.array(recv_splits[pos], dtype=np.int32))
+        for (r, sub), res, rsp in zip(subs.items(), results, recv_splits):
+            sub.handle.set_result(res, extra=np.array(rsp, dtype=np.int32))
 
     def _run_reducescatter(self, ps, entry):
-        subs = {r: entry.subs[r] for r in ps.ranks}
+        subs = self._local_subs(ps, entry)
         first = next(iter(subs.values()))
         req = first.request
         op = req.reduce_op
@@ -590,7 +891,7 @@ class Engine:
         max_chunk = max(chunks) if chunks else 0
         offsets = np.cumsum([0] + chunks[:-1])
         rows = []
-        for r in ps.ranks:
+        for r in subs:
             flat = np.ravel(subs[r].payloads[0])
             buf = np.zeros(R * max_chunk * rest_n, dtype=flat.dtype)
             for j in range(R):
@@ -601,8 +902,8 @@ class Engine:
             rows.append(buf)
         results = ps.executor.reducescatter(
             rows, d0, rest, op, req.prescale_factor, req.postscale_factor)
-        for r in ps.ranks:
-            subs[r].handle.set_result(results[ps.index[r]])
+        for (r, sub), res in zip(subs.items(), results):
+            sub.handle.set_result(res)
 
     # ------------------------------------------------------------------
 

@@ -14,7 +14,7 @@ class Handle:
     """Completion record for one enqueued tensor operation."""
 
     __slots__ = ("_event", "result", "error", "extra", "kind",
-                 "inplace_target", "returns_splits")
+                 "inplace_target", "returns_splits", "grouped")
 
     def __init__(self):
         self._event = threading.Event()
@@ -27,6 +27,8 @@ class Handle:
         self.kind: Any = "numpy"
         self.inplace_target: Any = None
         self.returns_splits: bool = False
+        # grouped ops always resolve to a list of tensors
+        self.grouped: bool = False
 
     def done(self) -> bool:
         return self._event.is_set()

@@ -1,0 +1,72 @@
+"""Worker-side distributed controller: negotiation over the launcher's
+HTTP coordinator.
+
+TPU-native replacement for the reference's controller transports
+(``mpi/mpi_controller.cc`` MPI_Gatherv/Bcast, ``gloo/gloo_controller.cc``):
+each worker *process* reports its locally-ready tensors to the
+launcher-hosted coordinator (runner/http/http_server.py Coordinator)
+and polls an ordered response log.  The log fixes the global execution
+order, which is what lets every process issue identical compiled XLA
+collectives — the SPMD invariant that replaces the reference's
+explicit NCCL communicator synchronization.
+"""
+
+import threading
+
+from ..runner.http.http_client import StoreClient
+
+
+class StoreController:
+    """One per worker process in multi-process jobs."""
+
+    def __init__(self, addr, port, secret, proc_id, num_procs,
+                 nlocal, poll_wait=5.0):
+        self.client = StoreClient(addr, port, secret)
+        self.proc_id = proc_id
+        self.num_procs = num_procs
+        self.nlocal = nlocal
+        self.poll_wait = poll_wait
+        self._cursor = 0
+        self._reported = set()
+        self._lock = threading.Lock()
+
+    # -- reporting -----------------------------------------------------------
+
+    def report_ready(self, metas):
+        """Announce locally-ready entries (idempotent per key)."""
+        fresh = []
+        with self._lock:
+            for m in metas:
+                if m["key"] not in self._reported:
+                    self._reported.add(m["key"])
+                    fresh.append(m)
+        if fresh:
+            self.client.coord("ready", {
+                "proc": self.proc_id, "nlocal": self.nlocal,
+                "entries": fresh})
+
+    def report_join(self, ps_id, rank, ps_size, proc_members=1):
+        self.client.coord("join", {"ps": ps_id, "rank": rank,
+                                   "ps_size": ps_size,
+                                   "proc": self.proc_id,
+                                   "proc_members": proc_members})
+
+    # -- polling -------------------------------------------------------------
+
+    def poll(self, wait=None):
+        """Fetch responses past the cursor; returns list of response
+        dicts ({kind: batch|error|join_done, ...})."""
+        out = self.client.coord(
+            "poll", {"cursor": self._cursor,
+                     "wait": self.poll_wait if wait is None else wait},
+            timeout=(self.poll_wait if wait is None else wait) + 30)
+        responses = out.get("responses", [])
+        self._cursor = out.get("cursor", self._cursor)
+        if responses:
+            with self._lock:
+                for r in responses:
+                    for k in r.get("keys", []):
+                        self._reported.discard(k)
+                    if "key" in r:          # error responses
+                        self._reported.discard(r["key"])
+        return responses

@@ -1,0 +1,23 @@
+"""Keras frontend (reference ``horovod/keras/__init__.py``)."""
+
+from ..common.basics import (  # noqa: F401
+    init, shutdown, is_initialized,
+    rank, size, local_rank, local_size, cross_rank, cross_size,
+)
+from ..tensorflow import (  # noqa: F401
+    allreduce, allgather, broadcast, broadcast_object, allgather_object,
+    broadcast_variables, Average, Sum, Adasum,
+    Compression, DistributedOptimizer,
+)
+from . import callbacks  # noqa: F401
+from . import elastic  # noqa: F401
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=None):
+    """Load a keras model saved by a distributed run (reference
+    keras/__init__.py:216): optimizer wrapping happens transparently at
+    compile time in this implementation, so this is a thin wrapper."""
+    import tensorflow as tf
+    return tf.keras.models.load_model(filepath,
+                                      custom_objects=custom_objects)

@@ -1,0 +1,128 @@
+"""Keras callbacks (reference ``horovod/_keras/callbacks.py:23-207``,
+re-exported via ``horovod/keras/callbacks.py``)."""
+
+import tensorflow as tf
+
+from ..common import basics
+from ..ops import api
+
+
+class BroadcastGlobalVariablesCallback(tf.keras.callbacks.Callback):
+    """Broadcast initial variable states from root to all other ranks
+    at the start of training (reference _keras/callbacks.py:23)."""
+
+    def __init__(self, root_rank=0, device=""):
+        super().__init__()
+        self.root_rank = root_rank
+        self.broadcast_done = False
+
+    def on_batch_end(self, batch, logs=None):
+        if self.broadcast_done:
+            return
+        from ..tensorflow import broadcast_variables
+        broadcast_variables(self.model.weights, self.root_rank)
+        if hasattr(self.model, "optimizer") and \
+                getattr(self.model.optimizer, "variables", None):
+            broadcast_variables(self.model.optimizer.variables,
+                                self.root_rank)
+        self.broadcast_done = True
+
+
+class MetricAverageCallback(tf.keras.callbacks.Callback):
+    """Average epoch metrics across ranks before other callbacks (e.g.
+    checkpointers) read them (reference _keras/callbacks.py:62)."""
+
+    def __init__(self, device=""):
+        super().__init__()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is None or basics.size() == 1:
+            return
+        from ..tensorflow.functions import allreduce_metrics
+        scalar = {k: v for k, v in logs.items()
+                  if isinstance(v, (int, float))}
+        logs.update(allreduce_metrics(scalar))
+
+
+class LearningRateScheduleCallback(tf.keras.callbacks.Callback):
+    """Multiply initial lr by ``multiplier`` over [start_epoch,
+    end_epoch) (reference _keras/callbacks.py:118)."""
+
+    def __init__(self, initial_lr, multiplier, start_epoch=0,
+                 end_epoch=None, staircase=True, momentum_correction=True,
+                 steps_per_epoch=None):
+        super().__init__()
+        self.initial_lr = initial_lr
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.steps_per_epoch = steps_per_epoch
+        self.current_epoch = 0
+        if not callable(multiplier):
+            self.static_multiplier = multiplier
+            self.multiplier = lambda epoch: multiplier
+        else:
+            self.static_multiplier = None
+            self.multiplier = multiplier
+
+    def _in_range(self, epoch):
+        return (epoch >= self.start_epoch and
+                (self.end_epoch is None or epoch < self.end_epoch))
+
+    def _set_lr(self, lr):
+        opt = self.model.optimizer
+        if hasattr(opt, "learning_rate"):
+            opt.learning_rate = lr
+        else:  # pragma: no cover
+            tf.keras.backend.set_value(opt.lr, lr)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+        if self.staircase and self._in_range(epoch):
+            self._set_lr(self.initial_lr * self.multiplier(epoch))
+
+    def on_batch_begin(self, batch, logs=None):
+        if self.staircase or not self._in_range(self.current_epoch):
+            return
+        if self.steps_per_epoch is None:
+            raise ValueError(
+                "steps_per_epoch is required for non-staircase "
+                "schedules")
+        epoch = self.current_epoch + float(batch) / self.steps_per_epoch
+        self._set_lr(self.initial_lr * self.multiplier(epoch))
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is not None:
+            opt = self.model.optimizer
+            lr = opt.learning_rate
+            logs["lr"] = float(lr.numpy() if hasattr(lr, "numpy") else lr)
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradual lr warmup from lr to lr*size over warmup_epochs
+    (reference _keras/callbacks.py:167: 'Facebook ImageNet in 1 Hour'
+    gradual warmup)."""
+
+    def __init__(self, initial_lr, warmup_epochs=5, momentum_correction=True,
+                 steps_per_epoch=None, verbose=0):
+        self.warmup_epochs = warmup_epochs
+        self.verbose = verbose
+
+        def multiplier(epoch):
+            # epoch is fractional within warmup
+            size = basics.size()
+            return 1.0 / size * (epoch * (size - 1) /
+                                 warmup_epochs + 1)
+        super().__init__(initial_lr=initial_lr, multiplier=multiplier,
+                         start_epoch=0, end_epoch=warmup_epochs,
+                         staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch)
+
+    def on_epoch_end(self, epoch, logs=None):
+        super().on_epoch_end(epoch, logs)
+        if epoch == self.end_epoch - 1 and self.verbose > 0 and \
+                basics.rank() == 0:
+            print(f"Epoch {epoch + 1}: finished gradual learning rate "
+                  f"warmup to {self.initial_lr}.")

@@ -1,0 +1,43 @@
+"""Keras elastic callbacks (reference ``horovod/keras/elastic.py``:
+CommitStateCallback, UpdateEpochStateCallback, UpdateBatchStateCallback).
+"""
+
+import tensorflow as tf
+
+
+class CommitStateCallback(tf.keras.callbacks.Callback):
+    """Commit state every ``batches_per_commit`` batches (reference
+    keras/elastic.py CommitStateCallbackImpl)."""
+
+    def __init__(self, state, batches_per_commit=1):
+        super().__init__()
+        self.state = state
+        self.batches_per_commit = batches_per_commit
+        self._counter = 0
+
+    def on_batch_end(self, batch, logs=None):
+        self._counter += 1
+        if self._counter >= self.batches_per_commit:
+            self._counter = 0
+            self.state.commit()
+
+
+class UpdateEpochStateCallback(tf.keras.callbacks.Callback):
+    def __init__(self, state):
+        super().__init__()
+        self.state = state
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.state.epoch = epoch
+
+
+class UpdateBatchStateCallback(tf.keras.callbacks.Callback):
+    def __init__(self, state):
+        super().__init__()
+        self.state = state
+
+    def on_batch_end(self, batch, logs=None):
+        self.state.batch = batch
+
+    def on_epoch_end(self, epoch, logs=None):
+        self.state.batch = 0

@@ -1,0 +1,225 @@
+"""Decoder-only Transformer LM, laid out for TPU parallelism.
+
+The reference ships no model zoo of its own — its flagship workloads
+are the synthetic benchmarks plus user models wrapped by
+``DistributedOptimizer`` (``examples/pytorch/pytorch_synthetic_benchmark.py``,
+``docs/benchmarks.rst``).  This model is the framework's long-context /
+multi-chip flagship: every parallelism axis the ``parallel`` package
+implements (dp / fsdp / tp / sp / ep / pp) maps onto it.
+
+TPU-first choices:
+
+* Pre-RMSNorm + SwiGLU + rotary position embeddings: all FLOPs live in
+  large einsums that tile onto the MXU; bf16 activations, f32 params.
+* Decoder blocks are stacked with ``nn.scan`` — one compiled block body
+  scanned over a leading ``layers`` parameter axis.  This keeps compile
+  time O(1) in depth and gives pipeline parallelism a natural stage
+  axis (parallel/pipeline.py scans stages the same way).
+* The attention inner function is pluggable: the sequence-parallel path
+  substitutes ring attention (parallel/ring_attention.py) without
+  touching the module.
+* Optional mixture-of-experts MLP with dense one-hot dispatch: the
+  expert einsum keeps a leading ``experts`` axis that the ``ep`` mesh
+  axis shards; XLA inserts the token all_to_all.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    d_ff: int = 1408          # SwiGLU hidden; ~8/3 * d_model rounded to 128
+    max_seq_len: int = 2048
+    num_experts: int = 0      # 0 => dense MLP
+    expert_top_k: int = 2
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+    remat: bool = False       # jax.checkpoint each block (HBM <-> FLOPs)
+
+    @property
+    def head_dim(self):
+        return self.d_model // self.n_heads
+
+
+def rope_angles(head_dim: int, max_seq: int, theta: float) -> np.ndarray:
+    """Precomputed rotary angles (max_seq, head_dim // 2), float32."""
+    inv_freq = 1.0 / theta ** (np.arange(0, head_dim, 2) / head_dim)
+    pos = np.arange(max_seq)
+    return np.einsum("s,f->sf", pos, inv_freq).astype(np.float32)
+
+
+def apply_rope(x, angles):
+    """x: (B, S, H, D); angles: (S, D//2) — rotate pairs of channels."""
+    sin = jnp.sin(angles)[None, :, None, :]
+    cos = jnp.cos(angles)[None, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def dense_causal_attention(q, k, v, *, offset=0):
+    """Reference attention inner: (B, S, H, D) -> (B, S, H, D) with a
+    causal mask.  ``offset`` shifts query positions (used when the
+    sequence axis is sharded and this shard holds positions
+    [offset, offset + S))."""
+    depth = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / np.sqrt(depth)
+    q_pos = jnp.arange(q.shape[1])[:, None] + offset
+    k_pos = jnp.arange(k.shape[1])[None, :]
+    scores = jnp.where(q_pos >= k_pos, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class RMSNorm(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],),
+                           jnp.float32)
+        x32 = x.astype(jnp.float32)
+        y = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1,
+                                         keepdims=True) + 1e-6)
+        return (y * scale).astype(self.dtype)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+    attention_fn: Callable = dense_causal_attention
+
+    @nn.compact
+    def __call__(self, x, angles):
+        cfg = self.cfg
+        H, D = cfg.n_heads, cfg.head_dim
+        dense = lambda feats, name: nn.DenseGeneral(  # noqa: E731
+            feats, axis=-1, use_bias=False, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name=name)
+        q = dense((H, D), "wq")(x)
+        k = dense((H, D), "wk")(x)
+        v = dense((H, D), "wv")(x)
+        q = apply_rope(q, angles)
+        k = apply_rope(k, angles)
+        o = self.attention_fn(q, k, v)
+        return nn.DenseGeneral(cfg.d_model, axis=(-2, -1), use_bias=False,
+                               dtype=cfg.dtype, param_dtype=jnp.float32,
+                               name="wo")(o)
+
+
+class SwiGLU(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = lambda feats, name: nn.Dense(  # noqa: E731
+            feats, use_bias=False, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name=name)
+        gate = nn.silu(dense(cfg.d_ff, "wi_gate")(x))
+        up = dense(cfg.d_ff, "wi_up")(x)
+        return dense(cfg.d_model, "wo")(gate * up)
+
+
+class MoE(nn.Module):
+    """Top-k mixture of experts with dense one-hot dispatch.
+
+    The dispatch/combine einsums carry an ``experts`` (E) axis that the
+    ``ep`` mesh axis shards; under pjit XLA turns the dispatch into the
+    token all_to_all the reference's users would hand-build on
+    ``hvd.alltoall`` (the reference exposes alltoall exactly for such
+    routing, SURVEY §2.7)."""
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        B, S, M = x.shape
+        E, F, K = cfg.num_experts, cfg.d_ff, cfg.expert_top_k
+        router = nn.Dense(E, use_bias=False, dtype=jnp.float32,
+                          param_dtype=jnp.float32, name="router")
+        logits = router(x.astype(jnp.float32))          # (B, S, E)
+        weights, idx = jax.lax.top_k(jax.nn.softmax(logits), K)
+        weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+        dispatch = jax.nn.one_hot(idx, E, dtype=cfg.dtype)  # (B, S, K, E)
+        combine = dispatch * weights[..., None].astype(cfg.dtype)
+
+        wi_gate = self.param("wi_gate", nn.initializers.lecun_normal(),
+                             (E, M, F), jnp.float32).astype(cfg.dtype)
+        wi_up = self.param("wi_up", nn.initializers.lecun_normal(),
+                           (E, M, F), jnp.float32).astype(cfg.dtype)
+        wo = self.param("wo", nn.initializers.lecun_normal(),
+                        (E, F, M), jnp.float32).astype(cfg.dtype)
+
+        xe = jnp.einsum("bske,bsm->ebsm", dispatch, x)   # route tokens
+        gate = nn.silu(jnp.einsum("ebsm,emf->ebsf", xe, wi_gate))
+        up = jnp.einsum("ebsm,emf->ebsf", xe, wi_up)
+        ye = jnp.einsum("ebsf,efm->ebsm", gate * up, wo)
+        return jnp.einsum("bske,ebsm->bsm", combine, ye)
+
+
+class DecoderBlock(nn.Module):
+    cfg: TransformerConfig
+    attention_fn: Callable = dense_causal_attention
+
+    @nn.compact
+    def __call__(self, x, angles):
+        cfg = self.cfg
+        x = x + Attention(cfg, self.attention_fn, name="attn")(
+            RMSNorm(cfg.dtype, name="ln_attn")(x), angles)
+        mlp = MoE(cfg, name="moe") if cfg.num_experts else \
+            SwiGLU(cfg, name="mlp")
+        return x + mlp(RMSNorm(cfg.dtype, name="ln_mlp")(x)), None
+
+
+class TransformerLM(nn.Module):
+    """Token ids (B, S) -> logits (B, S, V)."""
+    cfg: TransformerConfig
+
+    attention_fn: Callable = dense_causal_attention
+
+    @nn.compact
+    def __call__(self, tokens, *, seq_offset=0):
+        cfg = self.cfg
+        emb = self.param("embed", nn.initializers.normal(0.02),
+                         (cfg.vocab_size, cfg.d_model), jnp.float32)
+        x = emb[tokens].astype(cfg.dtype)
+        angles = jnp.asarray(
+            rope_angles(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta))
+        angles = jax.lax.dynamic_slice_in_dim(
+            angles, seq_offset, tokens.shape[1], axis=0)
+
+        block = DecoderBlock
+        if cfg.remat:
+            block = nn.remat(DecoderBlock, prevent_cse=False,
+                             static_argnums=())
+        stack = nn.scan(
+            block,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            in_axes=nn.broadcast,
+            length=cfg.n_layers,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )(cfg, self.attention_fn, name="layers")
+        x, _ = stack(x, angles)
+        x = RMSNorm(cfg.dtype, name="ln_final")(x)
+        logits = jnp.einsum("bsm,vm->bsv", x.astype(jnp.float32), emb)
+        return logits
+
+
+def lm_loss(logits, targets):
+    """Mean next-token cross-entropy; targets already shifted."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)

@@ -60,6 +60,14 @@ def _resolve_op(op, average, dtype):
     return op
 
 
+def _mutable(tensor):
+    """In-place collectives can write back into numpy and torch
+    tensors; jax/tf arrays are immutable (reference in-place ops exist
+    only on the torch/mxnet bindings)."""
+    return isinstance(tensor, np.ndarray) or \
+        type(tensor).__module__.startswith("torch")
+
+
 def _submit(request, payloads, names):
     eng = basics.engine()
     sub = Submission(rank=request.rank, request=request, names=names,
@@ -105,7 +113,7 @@ def allreduce_async_(tensor, average=None, name=None, op=None,
     is a mutable ndarray (reference allreduce_async_)."""
     h = allreduce_async(tensor, average, name, op, prescale_factor,
                         postscale_factor, process_set)
-    h.inplace_target = tensor if isinstance(tensor, np.ndarray) else None
+    h.inplace_target = tensor if _mutable(tensor) else None
     return h
 
 
@@ -143,6 +151,7 @@ def grouped_allreduce_async(tensors, average=None, name=None, op=None,
         process_set_id=_ps_id(process_set), group_id=0)
     h = _submit(req, arrs, names)
     h.kind = kinds
+    h.grouped = True
     return h
 
 
@@ -192,6 +201,7 @@ def grouped_allgather_async(tensors, name=None,
         process_set_id=_ps_id(process_set), group_id=0)
     h = _submit(req, arrs, names)
     h.kind = kinds
+    h.grouped = True
     return h
 
 
@@ -223,7 +233,7 @@ def broadcast(tensor, root_rank, name=None, process_set=global_process_set):
 def broadcast_async_(tensor, root_rank, name=None,
                      process_set=global_process_set):
     h = broadcast_async(tensor, root_rank, name, process_set)
-    h.inplace_target = tensor if isinstance(tensor, np.ndarray) else None
+    h.inplace_target = tensor if _mutable(tensor) else None
     return h
 
 
@@ -347,12 +357,13 @@ def synchronize(handle):
     if getattr(handle, "returns_splits", False):
         recv_splits = handle.extra
         return util.from_numpy(result, kind), recv_splits
+    if getattr(handle, "grouped", False) and not isinstance(result, list):
+        result = [result]
     if isinstance(result, list):
         kinds = kind if isinstance(kind, list) else [kind] * len(result)
         return [util.from_numpy(r, k) for r, k in zip(result, kinds)]
     if inplace is not None:
-        np.copyto(inplace, result.reshape(inplace.shape))
-        return inplace
+        return util.copy_into(inplace, result)
     return util.from_numpy(result, kind)
 
 

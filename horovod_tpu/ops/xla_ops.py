@@ -10,24 +10,32 @@ cache plays the role the response cache plays in the reference
 (response_cache.h:45-101): steady-state iterations hit an already
 compiled program keyed by (op, shape, dtype, reduce-op, ...).
 
-Two execution modes:
+Execution modes:
 
-* **shard mode** (num_ranks == num_devices): one device per rank; the
-  global array is sharded over the mesh axis ``'hvd'`` and the
-  collective is a ``shard_map`` program — the idiomatic TPU path.
-* **stacked mode** (fallback, any rank count): the per-rank buffers are
-  stacked on a single device and reduced with ordinary jnp ops in one
-  compiled program.  Used when ranks oversubscribe devices (e.g. unit
-  tests with more ranks than host devices).
+* **shard mode** (one device per rank): the global array is sharded
+  over mesh axis ``'hvd'`` and the collective is a ``shard_map``
+  program — the idiomatic TPU path.  Works single-process or
+  **multi-process** (after ``jax.distributed.initialize``): each
+  process supplies shards for the ranks it hosts and the same program
+  runs SPMD everywhere, collectives riding ICI/DCN.
+* **stacked mode** (single-process fallback, any rank count): the
+  per-rank buffers are stacked on one device and reduced with plain
+  jnp ops in one compiled program.  Used when ranks oversubscribe
+  devices (unit tests, or many rank-threads on one chip).
 
 All host→device staging happens once per fused bucket (one
-``device_put`` per rank), matching the reference's one-memcpy-per-
-fusion-buffer design (collective_operations.h:38-343).
+``device_put`` per locally-hosted rank), matching the reference's
+one-memcpy-per-fusion-buffer design (collective_operations.h:38-343).
+
+Row convention: every method takes ``rows`` = one flat host buffer per
+**locally hosted** rank (ordered by global rank), and returns outputs
+for those same local ranks; metadata spanning all ranks (allgather
+dim0s, alltoall splits) is passed explicitly, negotiated by the
+controller exactly as the reference exchanges shapes during
+negotiation (controller.cc:901-1080).
 """
 
-import math
 import threading
-from functools import partial
 
 import numpy as np
 
@@ -51,18 +59,31 @@ def _is_float(dtype) -> bool:
 
 
 class MeshExecutor:
-    """Executes collectives for one process set over a set of devices.
+    """Executes collectives for one process set.
 
     The reference binds one NCCL communicator per (stream, device-set)
     (nccl_operations.h:44-56); here the analogue is one Mesh + program
     cache per process set.
+
+    ``devices``: one device per member rank of the set (global order).
+    ``local_positions``: indices (into the set) of the ranks this
+    process hosts; ``None`` = all (single-process).
     """
 
-    def __init__(self, devices, num_ranks):
+    def __init__(self, devices, num_ranks, local_positions=None):
         self.devices = list(devices)
         self.num_ranks = num_ranks
-        self.shard_mode = (num_ranks == len(set(self.devices)) == len(self.devices)
-                           and num_ranks > 1)
+        if local_positions is None:
+            local_positions = list(range(num_ranks))
+        self.local_positions = list(local_positions)
+        self.multihost = len(self.local_positions) < num_ranks
+        one_dev_per_rank = (num_ranks == len(self.devices)
+                            and len(set(self.devices)) == len(self.devices))
+        self.shard_mode = one_dev_per_rank and (num_ranks > 1
+                                                or self.multihost)
+        if self.multihost and not self.shard_mode:
+            raise ValueError(
+                "multi-process execution requires one device per rank")
         if self.shard_mode:
             self.mesh = Mesh(np.array(self.devices), ("hvd",))
             self._row_sharding = NamedSharding(self.mesh, P("hvd"))
@@ -88,14 +109,15 @@ class MeshExecutor:
     # -- staging ------------------------------------------------------------
 
     def _stage_rows(self, rows):
-        """rows: list of num_ranks host ndarrays with identical shape.
+        """rows: one host ndarray per local rank (identical shapes).
         Returns a (R, *shape) jax.Array sharded one-row-per-device in
-        shard mode, or stacked on device 0 otherwise."""
+        shard mode (this process supplying its local shards), or
+        stacked on device 0 otherwise."""
         shape = (self.num_ranks,) + tuple(rows[0].shape)
         if self.shard_mode:
             shards = [
-                jax.device_put(row[None], d)
-                for row, d in zip(rows, self.devices)
+                jax.device_put(row[None], self.devices[pos])
+                for row, pos in zip(rows, self.local_positions)
             ]
             return jax.make_array_from_single_device_arrays(
                 shape, self._row_sharding, shards)
@@ -103,31 +125,38 @@ class MeshExecutor:
         return jax.device_put(stacked, self.devices[0])
 
     def _rows_out(self, arr):
-        """Inverse of _stage_rows for per-rank (sharded) outputs: return
-        a list of num_ranks host ndarrays.  Results are writable copies
-        — users mutate collective outputs in place (w -= lr * grad), so
-        read-only views of device buffers must not escape."""
+        """Per-rank (sharded) outputs → list of host ndarrays for the
+        local ranks, ordered like ``local_positions``.  Results are
+        writable copies — users mutate collective outputs in place
+        (w -= lr * grad), so read-only device views must not escape."""
         if self.shard_mode:
-            out = [None] * self.num_ranks
+            by_pos = {}
             for shard in arr.addressable_shards:
-                r = shard.index[0].start if isinstance(shard.index[0], slice) else shard.index[0]
-                out[r] = np.array(shard.data)[0]
-            return out
+                r = shard.index[0].start if isinstance(shard.index[0], slice) \
+                    else shard.index[0]
+                by_pos[r] = np.array(shard.data)[0]
+            return [by_pos[pos] for pos in self.local_positions]
         host = np.asarray(arr)
-        return [host[r].copy() for r in range(self.num_ranks)]
+        return [host[pos].copy() for pos in self.local_positions]
 
     def _replicated_out(self, arr):
         """Fetch a replicated result once, as a writable host copy;
-        callers hand further copies to the remaining ranks."""
+        callers hand further copies to the remaining local ranks."""
         if self.shard_mode:
             return np.array(arr.addressable_shards[0].data)
         return np.array(arr)
 
+    def _fanout(self, host):
+        """Replicate one host result to every local rank (first is the
+        original, the rest copies)."""
+        n = len(self.local_positions)
+        return [host] + [host.copy() for _ in range(n - 1)]
+
     # -- allreduce ----------------------------------------------------------
 
     def allreduce(self, rows, op: ReduceOp, prescale=1.0, postscale=1.0):
-        """rows: per-rank flat buffers of identical shape (n,).
-        Returns list of per-rank result buffers (n,)."""
+        """rows: per-local-rank flat buffers of identical shape (n,).
+        Returns list of per-local-rank result buffers (n,)."""
         n = int(rows[0].size)
         dtype = rows[0].dtype
         if n == 0:
@@ -144,8 +173,7 @@ class MeshExecutor:
             out = fn(x, np.float32(prescale), np.float32(postscale))
         else:
             out = fn(x)
-        host = self._replicated_out(out)
-        return [host] + [host.copy() for _ in range(R - 1)]
+        return self._fanout(self._replicated_out(out))
 
     def _build_allreduce(self, n, dtype, op, scaled):
         R = self.num_ranks
@@ -208,17 +236,16 @@ class MeshExecutor:
 
     def allgather(self, rows, dim0_sizes, rest_shape):
         """Concatenate per-rank tensors along dim 0.  ``rows`` are the
-        per-rank buffers already padded+flattened to (max_d0 * rest,)
-        by the caller; ``dim0_sizes`` are each rank's true first-dim
-        sizes (negotiated cross-rank, like the reference's allgather
-        shape exchange in controller.cc:901-1080)."""
-        R = self.num_ranks
+        per-local-rank buffers already padded+flattened to
+        (max_d0 * rest,) by the caller; ``dim0_sizes`` are ALL ranks'
+        true first-dim sizes (negotiated cross-rank, like the
+        reference's allgather shape exchange)."""
         dtype = rows[0].dtype
         rest = int(np.prod(rest_shape, dtype=np.int64)) if rest_shape else 1
         max_d = max(dim0_sizes) if dim0_sizes else 0
         if max_d == 0 or rest == 0:
             empty = np.zeros((0,) + tuple(rest_shape), dtype=dtype)
-            return [empty.copy() for _ in range(R)]
+            return [empty.copy() for _ in self.local_positions]
         key = ("allgather", tuple(dim0_sizes), tuple(rest_shape), str(dtype),
                self.shard_mode)
         fn = self._cached(key, lambda: self._build_allgather(
@@ -227,12 +254,10 @@ class MeshExecutor:
         out = fn(x)
         host = self._replicated_out(out)
         result_shape = (sum(dim0_sizes),) + tuple(rest_shape)
-        host = host.reshape(result_shape)
-        return [host] + [host.copy() for _ in range(R - 1)]
+        return self._fanout(host.reshape(result_shape))
 
     def _build_allgather(self, dim0_sizes, rest_shape, dtype):
         R = self.num_ranks
-        max_d = max(dim0_sizes)
         rest = int(np.prod(rest_shape, dtype=np.int64)) if rest_shape else 1
 
         def unpad_concat(g):
@@ -254,26 +279,24 @@ class MeshExecutor:
 
     # -- broadcast ----------------------------------------------------------
 
-    def broadcast(self, rows, root_rank):
+    def broadcast(self, rows, root_pos):
         n = int(rows[0].size)
         dtype = rows[0].dtype
-        R = self.num_ranks
         if n == 0:
             return [np.asarray(r) for r in rows]
-        key = ("broadcast", n, str(dtype), int(root_rank), self.shard_mode)
-        fn = self._cached(key, lambda: self._build_broadcast(root_rank))
+        key = ("broadcast", n, str(dtype), int(root_pos), self.shard_mode)
+        fn = self._cached(key, lambda: self._build_broadcast(root_pos))
         x = self._stage_rows(rows)
         out = fn(x)
-        host = self._replicated_out(out)
-        return [host] + [host.copy() for _ in range(R - 1)]
+        return self._fanout(self._replicated_out(out))
 
-    def _build_broadcast(self, root_rank):
+    def _build_broadcast(self, root_pos):
         def bcast_block(xb):
             g = lax.all_gather(xb, "hvd", axis=0, tiled=True)
-            return g[root_rank]
+            return g[root_pos]
 
         def bcast_stacked(x):
-            return x[root_rank]
+            return x[root_pos]
 
         if self.shard_mode:
             mapped = shard_map(
@@ -287,18 +310,22 @@ class MeshExecutor:
 
     def alltoall(self, rows, splits, rest_shape):
         """``splits[r]`` is rank r's send-split vector (length R) over
-        its first dimension.  ``rows`` are per-rank padded buffers of
-        shape (R * max_seg * rest,): segment j of rank r lives at
+        its first dimension — ALL ranks' splits (controller-negotiated).
+        ``rows`` are per-local-rank padded buffers of shape
+        (R * max_seg * rest,): segment j of rank r lives at
         [j*max_seg*rest : j*max_seg*rest + splits[r][j]*rest].
-        Returns (per-rank received buffers, per-rank recv_splits)."""
+        Returns (per-local-rank received buffers, per-local-rank
+        recv_splits)."""
         R = self.num_ranks
         dtype = rows[0].dtype
         rest = int(np.prod(rest_shape, dtype=np.int64)) if rest_shape else 1
         max_seg = max((s for split in splits for s in split), default=0)
-        recv_splits = [[splits[j][r] for j in range(R)] for r in range(R)]
+        recv_splits_all = [[splits[j][r] for j in range(R)]
+                           for r in range(R)]
+        recv_local = [recv_splits_all[pos] for pos in self.local_positions]
         if max_seg == 0 or rest == 0:
             empty = np.zeros((0,) + tuple(rest_shape), dtype=dtype)
-            return [empty.copy() for _ in range(R)], recv_splits
+            return [empty.copy() for _ in self.local_positions], recv_local
         m = max_seg * rest
         key = ("alltoall", R, m, str(dtype), self.shard_mode)
         fn = self._cached(key, lambda: self._build_alltoall(m))
@@ -306,14 +333,14 @@ class MeshExecutor:
         out = fn(x)  # (R_dst, R*m) sharded by dst; row r = segments recv'd
         padded_rows = self._rows_out(out)
         results = []
-        for r in range(R):
+        for i, pos in enumerate(self.local_positions):
             segs = [
-                padded_rows[r][j * m: j * m + recv_splits[r][j] * rest]
+                padded_rows[i][j * m: j * m + recv_local[i][j] * rest]
                 for j in range(R)
             ]
             buf = np.concatenate(segs) if segs else np.zeros(0, dtype=dtype)
             results.append(buf.reshape((-1,) + tuple(rest_shape)))
-        return results, recv_splits
+        return results, recv_local
 
     def _build_alltoall(self, m):
         R = self.num_ranks
@@ -353,17 +380,18 @@ class MeshExecutor:
 
     def reducescatter(self, rows, d0, rest_shape, op: ReduceOp,
                       prescale=1.0, postscale=1.0):
-        """rows: per-rank buffers pre-placed into padded layout
-        (R * max_chunk * rest,) where destination rank j's real rows sit
-        at [j*max_chunk*rest ...].  Returns per-rank (chunk_j, *rest)."""
+        """rows: per-local-rank buffers pre-placed into padded layout
+        (R * max_chunk * rest,) where destination rank j's real rows
+        sit at [j*max_chunk*rest ...].  Returns per-local-rank
+        (chunk_j, *rest)."""
         R = self.num_ranks
         dtype = rows[0].dtype
         chunks = self.chunk_sizes(d0, R)
         max_chunk = max(chunks) if chunks else 0
         rest = int(np.prod(rest_shape, dtype=np.int64)) if rest_shape else 1
         if max_chunk == 0 or rest == 0:
-            return [np.zeros((c,) + tuple(rest_shape), dtype=dtype)
-                    for c in chunks]
+            return [np.zeros((chunks[pos],) + tuple(rest_shape), dtype=dtype)
+                    for pos in self.local_positions]
         scaled = _is_float(dtype)
         if op == ReduceOp.AVERAGE:
             postscale = postscale / R
@@ -377,10 +405,11 @@ class MeshExecutor:
             out = fn(x, np.float32(prescale), np.float32(postscale))
         else:
             out = fn(x)
-        per_rank = self._rows_out(out)
+        per_local = self._rows_out(out)
         return [
-            per_rank[r][: chunks[r] * rest].reshape((chunks[r],) + tuple(rest_shape))
-            for r in range(R)
+            row[: chunks[pos] * rest].reshape(
+                (chunks[pos],) + tuple(rest_shape))
+            for row, pos in zip(per_local, self.local_positions)
         ]
 
     def _build_reducescatter(self, max_chunk, rest, dtype, op, scaled):

@@ -1,0 +1,16 @@
+"""SPMD parallelism layer: meshes, shardings, ring attention, pipeline.
+
+This package is the TPU-native superset of the reference's
+distribution capabilities: where Horovod ships data parallelism plus
+the substrate for more (process sets + alltoall, SURVEY §2.7), here
+dp / fsdp / tp / pp / sp / ep are first-class compiled shardings.
+"""
+
+from .mesh import MeshSpec, build_mesh, data_mesh, AXIS_ORDER  # noqa: F401
+from .sharding import (  # noqa: F401
+    transformer_param_spec, transformer_param_shardings,
+    batch_spec, batch_sharding, replicated,
+)
+from .ring_attention import ring_attention, make_ring_attention_fn  # noqa: F401
+from .pipeline import gpipe, make_pipelined_lm_apply  # noqa: F401
+from .train import make_lm_train_step, make_dp_train_step  # noqa: F401

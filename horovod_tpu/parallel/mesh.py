@@ -1,0 +1,86 @@
+"""Device-mesh construction over ICI/DCN.
+
+The reference scales one way — data parallel over NCCL/MPI ranks, with
+topology expressed as global/local/cross communicators
+(``mpi_context.h:104-113``).  On TPU the native formulation is a named
+``jax.sharding.Mesh``: axes replace communicators, and XLA lays
+collectives onto ICI rings automatically when the axis order matches
+the physical torus.
+
+Axis convention (outermost -> innermost):
+
+* ``dp``   — pure data parallelism (gradients psum; DCN-friendly).
+* ``fsdp`` — data parallelism with parameter sharding (ZeRO-3 style).
+* ``ep``   — expert parallelism for MoE layers.
+* ``pp``   — pipeline stages.
+* ``sp``   — sequence/context parallelism (ring attention).
+* ``tp``   — tensor parallelism (heads / mlp-hidden).
+
+Innermost axes get the most bandwidth-hungry collectives, so ``tp`` and
+``sp`` sit last: ``Mesh`` enumerates devices row-major, which makes the
+innermost axis contiguous in device order — on a TPU slice that is the
+ICI-adjacent dimension.  ``dp`` is outermost so multi-host DCN hops
+only carry gradient reductions.
+"""
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+AXIS_ORDER = ("dp", "fsdp", "ep", "pp", "sp", "tp")
+
+#: Axes along which a data batch is split.
+BATCH_AXES = ("dp", "fsdp")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Sizes per logical axis; -1 on at most one axis = use remaining
+    devices (mirrors torch-style device-count inference)."""
+    dp: int = 1
+    fsdp: int = 1
+    ep: int = 1
+    pp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def sizes(self):
+        return tuple(getattr(self, a) for a in AXIS_ORDER)
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        sizes = list(self.sizes())
+        wild = [i for i, s in enumerate(sizes) if s == -1]
+        if len(wild) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        fixed = int(np.prod([s for s in sizes if s != -1]))
+        if wild:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes "
+                    f"{fixed}")
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {dict(zip(AXIS_ORDER, sizes))} needs {fixed} "
+                f"devices, have {n_devices}")
+        return MeshSpec(**dict(zip(AXIS_ORDER, sizes)))
+
+
+def build_mesh(spec: Optional[MeshSpec] = None,
+               devices: Optional[Sequence] = None, **axis_sizes) -> Mesh:
+    """Build a Mesh; ``build_mesh(dp=-1, tp=4)`` style kwargs accepted."""
+    if spec is None:
+        spec = MeshSpec(**{a: axis_sizes.get(a, 1) for a in AXIS_ORDER})
+    devices = list(devices) if devices is not None else jax.devices()
+    spec = spec.resolve(len(devices))
+    arr = np.array(devices).reshape(spec.sizes())
+    return Mesh(arr, AXIS_ORDER)
+
+
+def data_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    """Pure-DP mesh over all devices — the reference's world."""
+    return build_mesh(MeshSpec(dp=-1), devices)

@@ -1,0 +1,116 @@
+"""GPipe-style pipeline parallelism over the ``pp`` mesh axis.
+
+Not in the reference (SURVEY §2.7: no PP engine; process sets are the
+substrate users would build one on).  TPU-native formulation: stages
+are shards of the scanned layer axis, activations hop stage-to-stage
+with ``lax.ppermute`` (one ICI neighbour hop), and microbatches stream
+through a ``lax.fori_loop`` of ``n_micro + n_stages - 1`` ticks — the
+classic collective-permute pipeline from the scaling playbook, written
+as a ``shard_map`` block so it composes under an outer ``jax.jit``.
+
+The transformer's decoder stack is already stacked on a leading layer
+axis (``nn.scan`` in models/transformer.py), so a stage's parameters
+are just the local shard of that axis — no repacking.
+"""
+
+from typing import Callable
+
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+try:
+    from jax import shard_map as _sm
+    shard_map = _sm.shard_map if hasattr(_sm, "shard_map") else _sm
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+def gpipe(stage_fn: Callable, local_stage_params, microbatches,
+          axis_name: str = "pp"):
+    """Run ``microbatches`` (M, ...) through the pipeline.
+
+    Must be called inside shard_map with ``axis_name`` bound.
+    ``stage_fn(local_stage_params, x) -> x`` applies this device's
+    stage.  Returns (M, ...) outputs, replicated across the axis.
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def tick(t, carry):
+        state, outputs = carry
+        # stage 0 injects microbatch t while t < M; later stages use
+        # the activation ppermuted in from the previous stage.
+        inject = microbatches[jnp.minimum(t, M - 1)]
+        state = jnp.where(my == 0, jnp.where(t < M, inject, state), state)
+        state = stage_fn(local_stage_params, state)
+        out_idx = t - (n - 1)
+        updated = outputs.at[jnp.clip(out_idx, 0, M - 1)].set(state)
+        take = jnp.logical_and(my == n - 1,
+                               jnp.logical_and(out_idx >= 0, out_idx < M))
+        outputs = jnp.where(take, updated, outputs)
+        state = lax.ppermute(state, axis_name, perm)
+        return state, outputs
+
+    state0 = jnp.zeros_like(microbatches[0])
+    outs0 = jnp.zeros_like(microbatches)
+    _, outputs = lax.fori_loop(0, M + n - 1, tick, (state0, outs0))
+    # replicate finished microbatches from the last stage to all stages
+    return lax.psum(jnp.where(my == n - 1, outputs, 0.0), axis_name)
+
+
+def make_pipelined_lm_apply(mesh, cfg, n_microbatches: int,
+                            batch_axes=("dp", "fsdp")):
+    """Build ``apply(params, tokens) -> logits`` running the decoder
+    stack as a pipeline over ``pp`` (embed/unembed replicated).
+
+    ``params`` is the standard TransformerLM params pytree; the
+    ``layers`` subtree (leading axis = n_layers) is consumed sharded
+    over ``pp``.
+    """
+    from ..models.transformer import (
+        DecoderBlock, RMSNorm, rope_angles)
+    import flax.linen as nn
+
+    block = DecoderBlock(cfg)
+    angles_full = jnp.asarray(
+        rope_angles(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta))
+
+    def stage_fn(local_layers, x, angles):
+        def body(h, layer_params):
+            h, _ = block.apply({"params": layer_params}, h, angles)
+            return h, None
+        x, _ = lax.scan(body, x, local_layers)
+        return x
+
+    def pipe_block(local_layers, x_emb, angles):
+        # x_emb: (local_B, S, D) — batch already sharded by shard_map
+        B = x_emb.shape[0]
+        M = n_microbatches
+        if B % M != 0:
+            raise ValueError(f"local batch {B} not divisible by "
+                             f"microbatches {M}")
+        mbs = x_emb.reshape((M, B // M) + x_emb.shape[1:])
+        outs = gpipe(lambda p, h: stage_fn(p, h, angles),
+                     local_layers, mbs)
+        return outs.reshape(x_emb.shape)
+
+    mapped = shard_map(
+        pipe_block, mesh=mesh,
+        in_specs=(P("pp"), P(batch_axes, None, None), P()),
+        out_specs=P(batch_axes, None, None),
+        check_vma=False)
+
+    def apply(params, tokens):
+        p = params["params"] if "params" in params else params
+        emb = p["embed"]
+        x = emb[tokens].astype(cfg.dtype)
+        angles = angles_full[: tokens.shape[1]]
+        x = mapped(p["layers"], x, angles)
+        x = RMSNorm(cfg.dtype, name="ln_final").apply(
+            {"params": p["ln_final"]}, x)
+        return jnp.einsum("bsm,vm->bsv", x.astype(jnp.float32), emb)
+
+    return apply

@@ -1,0 +1,99 @@
+"""Ring attention: causal self-attention over a sequence-sharded axis.
+
+The reference has no long-context subsystem (SURVEY §5.7) — its users
+would hand-roll sequence exchange on ``hvd.alltoall``.  Here sequence
+parallelism is first-class and TPU-shaped: each ``sp`` shard holds a
+contiguous sequence chunk; K/V blocks rotate around the ``sp`` ring
+with ``lax.ppermute`` (neighbour hops ride ICI), while queries stay
+put.  Softmax is computed in streaming (flash-style) form — running
+row max ``m``, normalizer ``l``, and weighted accumulator ``o`` — so
+attention over sequence length ``S`` needs only ``O(S/n)`` memory per
+chip and the compute/communication of each hop overlap in XLA's
+pipeline.
+
+Used inside ``shard_map`` (see :func:`make_ring_attention_fn`) as a
+drop-in for ``models.transformer.dense_causal_attention``.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+try:
+    from jax import shard_map as _sm
+    shard_map = _sm.shard_map if hasattr(_sm, "shard_map") else _sm
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+_NEG_INF = -1e30
+
+
+def ring_attention(q, k, v, axis_name: str = "sp"):
+    """Causal attention with q/k/v sharded on seq dim over ``axis_name``.
+
+    Shapes (per shard): q, k, v — (B, S_local, H, D).  Must be called
+    inside ``shard_map``/``pmap`` with ``axis_name`` bound.
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+    scale = np.float32(1.0 / np.sqrt(D))
+    neg_inf = np.float32(_NEG_INF)
+    q32 = q.astype(jnp.float32)
+
+    q_pos = my * S + jnp.arange(S)                     # global query pos
+
+    def step(i, carry):
+        o, m, l, k_blk, v_blk = carry
+        # shard currently held: the block that started at rank (my - i)
+        src = (my - i) % n
+        k_pos = src * S + jnp.arange(S)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q32,
+                            k_blk.astype(jnp.float32)) * scale
+        mask = q_pos[:, None] >= k_pos[None, :]        # (Sq, Sk)
+        scores = jnp.where(mask[None, None], scores, neg_inf)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+        # guard: rows with everything masked keep m at -inf sentinel
+        alpha = jnp.exp(m - m_new)                     # (B,H,Sq)
+        p = jnp.exp(scores - m_new[..., None])
+        p = jnp.where(mask[None, None], p, np.float32(0.0))
+        l = l * alpha + jnp.sum(p, axis=-1)
+        o = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
+        # rotate k/v one hop around the ring: j -> j+1
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return o, m_new, l, k_blk, v_blk
+
+    o0 = jnp.zeros((B, H, S, D), jnp.float32)
+    m0 = jnp.full((B, H, S), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, S), jnp.float32)
+    o, m, l, _, _ = lax.fori_loop(0, n, step, (o0, m0, l0, k, v))
+    l = jnp.maximum(l, np.float32(1e-30))
+    out = (o / l[..., None]).astype(q.dtype)           # (B,H,S,D)
+    return jnp.swapaxes(out, 1, 2)                     # (B,S,H,D)
+
+
+def make_ring_attention_fn(mesh, *, batch_axes=("dp", "fsdp"),
+                           seq_axis="sp", head_axis="tp"):
+    """Wrap :func:`ring_attention` in shard_map so it drops into
+    ``TransformerLM(attention_fn=...)`` under an outer ``jax.jit``:
+    q/k/v arrive sequence-sharded on ``seq_axis`` and head-sharded on
+    ``head_axis``; the ring runs per (batch, head) shard."""
+    spec = P(batch_axes, seq_axis, head_axis, None)
+
+    inner = partial(ring_attention, axis_name=seq_axis)
+    mapped = shard_map(
+        inner, mesh=mesh,
+        in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+
+    def attention_fn(q, k, v):
+        return mapped(q, k, v)
+
+    return attention_fn

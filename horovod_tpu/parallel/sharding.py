@@ -1,0 +1,107 @@
+"""Parameter / activation sharding rules for the model zoo.
+
+The reference has no sharding layer — its only distribution strategy is
+replicate-everything data parallelism, and anything fancier is left to
+users on top of process sets + alltoall (SURVEY §2.7).  Here sharding
+is first-class: rules map parameter pytree paths to ``PartitionSpec``s
+over the mesh axes of :mod:`.mesh`, and ``jax.jit`` compiles in the
+collectives (psum for dp, all_gather/reduce_scatter for fsdp, ICI-ring
+collectives for tp) the reference would have issued through NCCL.
+
+Rules follow the Megatron/llama layout:
+
+* attention qkv projections column-parallel over heads (``tp``),
+  output row-parallel;
+* SwiGLU hidden column-parallel, output row-parallel;
+* embeddings vocab-sharded over ``tp``;
+* every weight additionally sharded over ``fsdp`` on a non-tp axis;
+* MoE expert tensors sharded over ``ep`` on the expert axis;
+* scanned layer stacks sharded over ``pp`` on the layer axis.
+"""
+
+import re
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import BATCH_AXES
+
+# (path regex, spec builder).  Paths are '/'-joined pytree key paths,
+# e.g. 'layers/attn/wq/kernel'.  Specs are written WITHOUT the leading
+# scan axis; `layers/` prefixed entries get ('pp',) prepended.
+_TRANSFORMER_RULES: Tuple[Tuple[str, P], ...] = (
+    (r"embed$",                          P("tp", "fsdp")),
+    (r"attn/w[qkv]/kernel$",             P("fsdp", "tp", None)),
+    (r"attn/wo/kernel$",                 P("tp", None, "fsdp")),
+    (r"mlp/wi_(gate|up)/kernel$",        P("fsdp", "tp")),
+    (r"mlp/wo/kernel$",                  P("tp", "fsdp")),
+    (r"moe/router/kernel$",              P("fsdp", None)),
+    (r"moe/wi_(gate|up)$",               P("ep", "fsdp", "tp")),
+    (r"moe/wo$",                         P("ep", "tp", "fsdp")),
+    (r"(ln_attn|ln_mlp|ln_final)/scale$", P(None)),
+    (r"head/kernel$",                    P("fsdp", "tp")),
+)
+
+
+def _path_str(path) -> str:
+    parts = []
+    for k in path:
+        if isinstance(k, jax.tree_util.DictKey):
+            parts.append(str(k.key))
+        elif isinstance(k, jax.tree_util.SequenceKey):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def transformer_param_spec(path, leaf) -> P:
+    """PartitionSpec for one transformer parameter."""
+    s = _path_str(path)
+    scanned = "layers/" in s
+    for pat, spec in _TRANSFORMER_RULES:
+        if re.search(pat, s):
+            parts = tuple(spec)
+            if scanned:
+                parts = ("pp",) + parts
+            # pad/truncate to the leaf rank
+            rank = len(leaf.shape)
+            parts = parts[:rank] + (None,) * (rank - len(parts))
+            return P(*parts)
+    if scanned:
+        return P("pp", *(None,) * (len(leaf.shape) - 1))
+    return P()
+
+
+def transformer_param_shardings(mesh: Mesh, params) -> Any:
+    """Pytree of NamedShardings matching ``params``."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(
+            mesh, transformer_param_spec(path, leaf)),
+        params)
+
+
+def batch_spec(seq_sharded: bool = False) -> P:
+    """Spec for (B, S[, ...]) token batches: batch over dp+fsdp, and the
+    sequence axis over sp when sequence parallelism is on."""
+    return P(BATCH_AXES, "sp" if seq_sharded else None)
+
+
+def batch_sharding(mesh: Mesh, seq_sharded: bool = False) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec(seq_sharded))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def resnet_param_spec(path, leaf) -> P:
+    """ResNet trains pure-DP (replicated params), exactly the reference
+    model: conv kernels are too small to benefit from tp."""
+    return P()
+
+
+def resnet_param_shardings(mesh: Mesh, variables) -> Any:
+    return jax.tree_util.tree_map(
+        lambda leaf: NamedSharding(mesh, P()), variables)

@@ -1,0 +1,66 @@
+"""CLI/config-file → environment translation (reference
+``horovod/runner/common/util/config_parser.py``: set_env_from_args maps
+``--fusion-threshold-mb`` → ``HOROVOD_FUSION_THRESHOLD`` etc.; YAML
+config file feeds the same overrides, launch.py:345-348)."""
+
+import os
+
+# reference config_parser.py constants
+HOROVOD_FUSION_THRESHOLD = "HOROVOD_FUSION_THRESHOLD"
+HOROVOD_CYCLE_TIME = "HOROVOD_CYCLE_TIME"
+HOROVOD_CACHE_CAPACITY = "HOROVOD_CACHE_CAPACITY"
+HOROVOD_TIMELINE = "HOROVOD_TIMELINE"
+HOROVOD_TIMELINE_MARK_CYCLES = "HOROVOD_TIMELINE_MARK_CYCLES"
+HOROVOD_AUTOTUNE = "HOROVOD_AUTOTUNE"
+HOROVOD_AUTOTUNE_LOG = "HOROVOD_AUTOTUNE_LOG"
+HOROVOD_STALL_CHECK_DISABLE = "HOROVOD_STALL_CHECK_DISABLE"
+HOROVOD_STALL_CHECK_TIME_SECONDS = "HOROVOD_STALL_CHECK_TIME_SECONDS"
+HOROVOD_STALL_SHUTDOWN_TIME_SECONDS = "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"
+HOROVOD_LOG_LEVEL = "HOROVOD_LOG_LEVEL"
+
+
+def set_env_from_args(env: dict, args) -> dict:
+    """Translate parsed CLI args into HOROVOD_* env entries."""
+    def setb(name, val):
+        if val:
+            env[name] = "1"
+
+    if getattr(args, "fusion_threshold_mb", None) is not None:
+        env[HOROVOD_FUSION_THRESHOLD] = str(
+            int(args.fusion_threshold_mb * 1024 * 1024))
+    if getattr(args, "cycle_time_ms", None) is not None:
+        env[HOROVOD_CYCLE_TIME] = str(args.cycle_time_ms)
+    if getattr(args, "cache_capacity", None) is not None:
+        env[HOROVOD_CACHE_CAPACITY] = str(args.cache_capacity)
+    if getattr(args, "timeline_filename", None):
+        env[HOROVOD_TIMELINE] = args.timeline_filename
+    setb(HOROVOD_TIMELINE_MARK_CYCLES,
+         getattr(args, "timeline_mark_cycles", False))
+    setb(HOROVOD_AUTOTUNE, getattr(args, "autotune", False))
+    if getattr(args, "autotune_log_file", None):
+        env[HOROVOD_AUTOTUNE_LOG] = args.autotune_log_file
+    setb(HOROVOD_STALL_CHECK_DISABLE,
+         getattr(args, "no_stall_check", False))
+    if getattr(args, "stall_check_warning_time_seconds", None) is not None:
+        env[HOROVOD_STALL_CHECK_TIME_SECONDS] = str(
+            args.stall_check_warning_time_seconds)
+    if getattr(args, "stall_check_shutdown_time_seconds", None) is not None:
+        env[HOROVOD_STALL_SHUTDOWN_TIME_SECONDS] = str(
+            args.stall_check_shutdown_time_seconds)
+    if getattr(args, "log_level", None):
+        env[HOROVOD_LOG_LEVEL] = args.log_level
+    return env
+
+
+def parse_config_file(path, args):
+    """Apply a YAML config file onto the args namespace (reference
+    launch.py:345-348 + config_parser.py): CLI flags win over file
+    values, file values win over defaults."""
+    import yaml
+    with open(path) as f:
+        config = yaml.safe_load(f) or {}
+    for key, value in config.items():
+        attr = key.replace("-", "_")
+        if hasattr(args, attr) and getattr(args, attr) in (None, False):
+            setattr(args, attr, value)
+    return args

@@ -1,0 +1,61 @@
+"""Client for the launcher's KV/coordinator service (reference
+``horovod/runner/http/http_client.py``: read/write/delete KV helpers).
+"""
+
+import hashlib
+import hmac
+import json
+import urllib.error
+import urllib.request
+
+
+class StoreClient:
+    def __init__(self, addr: str, port: int, secret: bytes = None,
+                 timeout: float = 30.0):
+        self.base = f"http://{addr}:{port}"
+        self.secret = secret
+        self.timeout = timeout
+
+    def _auth_headers(self, body: bytes):
+        if self.secret is None:
+            return {}
+        digest = hmac.new(self.secret, body, hashlib.sha256).hexdigest()
+        return {"X-HVD-Auth": digest}
+
+    def put(self, key: str, value: bytes):
+        req = urllib.request.Request(
+            self.base + key, data=value, method="PUT",
+            headers=self._auth_headers(value))
+        with urllib.request.urlopen(req, timeout=self.timeout):
+            pass
+
+    def get(self, key: str, wait: float = 0.0):
+        url = self.base + key
+        if wait:
+            url += f"?wait={wait}"
+        req = urllib.request.Request(url, method="GET",
+                                     headers=self._auth_headers(b""))
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=max(self.timeout, wait + 5)) as r:
+                return r.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def delete(self, key: str):
+        req = urllib.request.Request(self.base + key, method="DELETE",
+                                     headers=self._auth_headers(b""))
+        with urllib.request.urlopen(req, timeout=self.timeout):
+            pass
+
+    def coord(self, verb: str, payload: dict, timeout: float = None):
+        body = json.dumps(payload).encode()
+        req = urllib.request.Request(
+            self.base + f"/coord/{verb}", data=body, method="POST",
+            headers={**self._auth_headers(body),
+                     "Content-Type": "application/json"})
+        with urllib.request.urlopen(
+                req, timeout=timeout or self.timeout) as r:
+            return json.loads(r.read() or b"{}")

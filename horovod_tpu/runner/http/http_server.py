@@ -1,0 +1,387 @@
+"""HTTP KV store + rendezvous + coordinator service.
+
+Reference: ``horovod/runner/http/http_server.py`` (KVStoreServer :35,
+RendezvousServer :192) — the launcher-hosted store Gloo workers
+rendezvous against, doubled as the elastic control plane.
+
+Here it additionally hosts the **coordinator** role the reference runs
+on rank 0's background thread (``controller.cc:74-474``): worker
+processes POST locally-ready tensor lists; the server counts readiness
+across processes, validates cross-process consistency, fuses ready
+allreduces under the fusion threshold, and appends fused responses to
+an ordered log every worker polls.  Ordering the log **is** the
+collective schedule: every process issues the same compiled XLA
+programs in the same order, which is exactly the invariant SPMD
+execution needs.
+
+Requests are HMAC-signed (reference runner/common/util/network.py:56:
+every message carries an HMAC digest of the payload keyed by the
+job secret).
+"""
+
+import hashlib
+import hmac
+import json
+import threading
+import socket
+import socketserver
+from collections import OrderedDict
+from http.server import BaseHTTPRequestHandler
+
+OK = 200
+BAD_REQUEST = 400
+FORBIDDEN = 403
+NOT_FOUND = 404
+
+
+def _digest(secret: bytes, payload: bytes) -> str:
+    return hmac.new(secret, payload, hashlib.sha256).hexdigest()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # silence
+        pass
+
+    @property
+    def store(self):
+        return self.server.store
+
+    def _verify(self, body: bytes) -> bool:
+        secret = self.server.secret
+        if secret is None:
+            return True
+        given = self.headers.get("X-HVD-Auth", "")
+        return hmac.compare_digest(given, _digest(secret, body))
+
+    def _reply(self, code, payload=b"", content_type="application/octet-stream"):
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(payload)))
+        self.send_header("Content-Type", content_type)
+        self.end_headers()
+        if payload:
+            self.wfile.write(payload)
+
+    def do_PUT(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        if not self._verify(body):
+            return self._reply(FORBIDDEN)
+        self.store.put(self.path, body)
+        self._reply(OK)
+
+    def do_GET(self):
+        if not self._verify(b""):
+            return self._reply(FORBIDDEN)
+        path, _, query = self.path.partition("?")
+        params = dict(p.split("=", 1) for p in query.split("&") if "=" in p)
+        wait = float(params.get("wait", 0))
+        value = self.store.get(path, timeout=wait)
+        if value is None:
+            return self._reply(NOT_FOUND)
+        self._reply(OK, value)
+
+    def do_DELETE(self):
+        if not self._verify(b""):
+            return self._reply(FORBIDDEN)
+        self.store.delete(self.path)
+        self._reply(OK)
+
+    def do_POST(self):
+        """Coordinator RPCs: /coord/<verb>, JSON body."""
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        if not self._verify(body):
+            return self._reply(FORBIDDEN)
+        if not self.path.startswith("/coord/"):
+            return self._reply(BAD_REQUEST)
+        verb = self.path[len("/coord/"):]
+        try:
+            req = json.loads(body) if body else {}
+            resp = self.server.coordinator.handle(verb, req)
+        except Exception as exc:  # noqa: BLE001 — reported to caller
+            return self._reply(BAD_REQUEST,
+                               json.dumps({"error": str(exc)}).encode(),
+                               "application/json")
+        self._reply(OK, json.dumps(resp).encode(), "application/json")
+
+
+class KVStore:
+    """Blocking-get key/value store (reference KVStoreHandler)."""
+
+    def __init__(self):
+        self._data = {}
+        self._cv = threading.Condition()
+
+    def put(self, key, value: bytes):
+        with self._cv:
+            self._data[key] = value
+            self._cv.notify_all()
+
+    def get(self, key, timeout=0.0):
+        deadline = None
+        with self._cv:
+            while True:
+                if key in self._data:
+                    return self._data[key]
+                if timeout <= 0:
+                    return None
+                import time
+                if deadline is None:
+                    deadline = time.monotonic() + timeout
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._cv.wait(remaining)
+
+    def delete(self, key):
+        with self._cv:
+            self._data.pop(key, None)
+            self._cv.notify_all()
+
+    def scope(self, prefix):
+        with self._cv:
+            return {k: v for k, v in self._data.items()
+                    if k.startswith(prefix)}
+
+
+class Coordinator:
+    """Server-side negotiation engine (the reference's rank-0
+    coordinator, controller.cc ComputeResponseList/FuseResponses,
+    relocated into the launcher's store service — same protocol, one
+    fewer hop)."""
+
+    def __init__(self, world_size: int,
+                 fusion_threshold_bytes: int = 128 * 1024 * 1024):
+        self.world_size = world_size
+        self.fusion_threshold = fusion_threshold_bytes
+        self._lock = threading.Condition()
+        # key -> {proc_id -> meta}
+        self._pending: "OrderedDict[str, dict]" = OrderedDict()
+        self._log = []          # ordered list of response dicts
+        self._joined = {}       # ps_id -> set of ranks that joined
+        self._proc_joined = {}  # ps_id -> {proc -> join count}
+        self._exhausted = {}    # ps_id -> set of procs fully joined
+        self._errors = {}       # key -> error string
+
+    def handle(self, verb, req):
+        if verb == "ready":
+            return self._on_ready(req)
+        if verb == "poll":
+            return self._on_poll(req)
+        if verb == "join":
+            return self._on_join(req)
+        raise ValueError(f"unknown coordinator verb {verb}")
+
+    def _on_ready(self, req):
+        """Worker announces locally-ready entries.
+        req: {proc: int, nlocal: int, entries: [meta...]}
+        meta: {key, type, dtype, shape, op, pre, post, ps, nbytes,
+               names, root}
+        """
+        proc = req["proc"]
+        with self._lock:
+            for meta in req["entries"]:
+                key = meta["key"]
+                ent = self._pending.get(key)
+                if ent is None:
+                    ent = self._pending[key] = {}
+                if proc not in ent:
+                    ent[proc] = meta
+                    if meta.get("error"):
+                        # a process failed local validation: the whole
+                        # tensor errors on every process
+                        self._errors[key] = meta["error"]
+                    err = self._validate(key, ent)
+                    if err:
+                        self._errors[key] = err
+            self._advance()
+            self._lock.notify_all()
+        return {}
+
+    def _validate(self, key, ent):
+        """Cross-process consistency (reference ConstructResponse,
+        controller.cc:496-843)."""
+        metas = list(ent.values())
+        first = metas[0]
+        for m in metas[1:]:
+            for field, label in (("dtype", "data types"),
+                                 ("op", "reduce ops"),
+                                 ("pre", "prescale factors"),
+                                 ("post", "postscale factors"),
+                                 ("root", "root ranks")):
+                if m.get(field) != first.get(field):
+                    return (f"Mismatched {label} for {key}: "
+                            f"{m.get(field)} vs {first.get(field)}")
+            if first["type"] in ("ALLREDUCE", "ADASUM", "BROADCAST",
+                                 "REDUCESCATTER"):
+                if m.get("shape") != first.get("shape"):
+                    return (f"Mismatched shapes for {key}: "
+                            f"{m.get('shape')} vs {first.get('shape')}")
+            elif m.get("shape", [])[1:] != first.get("shape", [])[1:]:
+                return (f"Mismatched non-first dimensions for {key}")
+        return None
+
+    def _on_join(self, req):
+        """A rank joined (ran out of data).  Tracks per-process
+        exhaustion so entries become ready without the exhausted
+        process's report, and emits join_done once every rank of the
+        set joined (reference controller.cc:269-327,413-423)."""
+        ps = req.get("ps", 0)
+        proc = req.get("proc", -1)
+        with self._lock:
+            j = self._joined.setdefault(ps, set())
+            j.add(req["rank"])
+            pj = self._proc_joined.setdefault(ps, {})
+            pj[proc] = pj.get(proc, 0) + 1
+            if pj[proc] >= req.get("proc_members", 1):
+                self._exhausted.setdefault(ps, set()).add(proc)
+            if len(j) >= req.get("ps_size", self.world_size):
+                self._log.append({"kind": "join_done", "ps": ps,
+                                  "last": req["rank"]})
+                self._joined[ps] = set()
+                self._proc_joined[ps] = {}
+                self._exhausted[ps] = set()
+            self._advance()
+            self._lock.notify_all()
+        return {}
+
+    def _advance(self):
+        """Move fully-ready entries (all non-exhausted processes
+        reported) from pending to the ordered response log, fusing
+        adjacent compatible allreduces (FuseResponses,
+        controller.cc:901-1080).  Must hold the lock."""
+        ready = []
+        for key in list(self._pending.keys()):
+            ent = self._pending[key]
+            if len(ent) >= self._members_for(ent):
+                meta = next(iter(ent.values()))
+                del self._pending[key]
+                if key in self._errors:
+                    self._log.append({"kind": "error", "key": key,
+                                      "message": self._errors.pop(key)})
+                else:
+                    # merge per-process aux (allgather dims / alltoall
+                    # splits) for the response
+                    meta = dict(meta)
+                    meta["aux_by_proc"] = {str(p): m.get("aux", {})
+                                           for p, m in ent.items()}
+                    ready.append(meta)
+        # fuse
+        bucket, bucket_bytes, sig = [], 0, None
+
+        def flush():
+            nonlocal bucket, bucket_bytes, sig
+            if bucket:
+                self._log.append(self._batch_response(bucket))
+                bucket, bucket_bytes, sig = [], 0, None
+
+        for meta in ready:
+            if meta["type"] not in ("ALLREDUCE", "ADASUM"):
+                if self._exhausted.get(meta.get("ps", 0)):
+                    # join only supports allreduce (reference
+                    # controller.cc:413-423): other ops with joined
+                    # processes error instead of hanging
+                    self._log.append({
+                        "kind": "error", "key": meta["key"],
+                        "message": (f"{meta['type']} does not support "
+                                    f"joined ranks")})
+                    continue
+                flush()
+                self._log.append(self._batch_response([meta]))
+                continue
+            msig = (meta["type"], meta["dtype"], meta["op"],
+                    meta["pre"], meta["post"], meta["ps"])
+            if bucket and (msig != sig or
+                           bucket_bytes + meta["nbytes"] >
+                           self.fusion_threshold):
+                flush()
+            bucket.append(meta)
+            bucket_bytes += meta["nbytes"]
+            sig = msig
+        flush()
+
+    @staticmethod
+    def _batch_response(metas):
+        return {
+            "kind": "batch",
+            "keys": [m["key"] for m in metas],
+            "metas": {m["key"]: {k: v for k, v in m.items()
+                                 if k not in ("aux", "aux_by_proc")}
+                      for m in metas},
+            "aux": {m["key"]: m.get("aux_by_proc", {}) for m in metas},
+        }
+
+    def _members_for(self, ent):
+        meta = next(iter(ent.values()))
+        nprocs = meta.get("nprocs", self.world_size)
+        exhausted = self._exhausted.get(meta.get("ps", 0), set())
+        return max(nprocs - len(exhausted), 1)
+
+    def _on_poll(self, req):
+        """Long-poll for responses after cursor."""
+        cursor = req["cursor"]
+        timeout = req.get("wait", 10.0)
+        import time
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while len(self._log) <= cursor:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return {"responses": [], "cursor": cursor}
+                self._lock.wait(remaining)
+            resp = self._log[cursor:]
+            return {"responses": resp, "cursor": len(self._log)}
+
+
+class _ThreadingHTTPServer(socketserver.ThreadingMixIn,
+                           socketserver.TCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class RendezvousServer:
+    """KV + coordinator HTTP service hosted by the launcher (reference
+    RendezvousServer, http_server.py:192)."""
+
+    def __init__(self, secret: bytes = None, world_size: int = 0,
+                 fusion_threshold_bytes: int = 128 * 1024 * 1024):
+        self.store = KVStore()
+        self.coordinator = Coordinator(world_size, fusion_threshold_bytes)
+        self.secret = secret
+        self._httpd = None
+        self._thread = None
+
+    def start(self, port=0) -> int:
+        self._httpd = _ThreadingHTTPServer(("0.0.0.0", port), _Handler)
+        self._httpd.store = self.store
+        self._httpd.coordinator = self.coordinator
+        self._httpd.secret = self.secret
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="hvd-rendezvous", daemon=True)
+        self._thread.start()
+        return self._httpd.server_address[1]
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1] if self._httpd else None
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+def local_ip():
+    """Best-effort routable local address (reference
+    driver_service NIC probing, simplified)."""
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"

@@ -1,0 +1,148 @@
+"""``horovodrun`` CLI (reference ``horovod/runner/launch.py``:
+arg surface :286-528, run_commandline :830, _run :806).
+
+Static jobs spawn one worker process per slot with the full
+``HOROVOD_*`` env handoff (proc_run.py); elastic jobs drive discovery
++ re-rendezvous (elastic/driver.py)."""
+
+import argparse
+import os
+import sys
+
+from .config_parser import parse_config_file, set_env_from_args
+from .hosts import parse_host_files
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="horovodrun",
+        description="Launch a horovod_tpu distributed job.")
+    parser.add_argument("-v", "--version", action="store_true",
+                        help="Shows horovod_tpu version.")
+    parser.add_argument("-np", "--num-proc", type=int, dest="np",
+                        help="Total number of training ranks.")
+    parser.add_argument("-H", "--hosts", dest="hosts",
+                        help="host1:slots,host2:slots list.")
+    parser.add_argument("-hostfile", "--hostfile", dest="hostfile",
+                        help="Host file with 'name slots=N' lines.")
+    parser.add_argument("--ranks-per-worker", type=int, default=1,
+                        dest="ranks_per_proc",
+                        help="Rank threads per worker process (TPU hosts "
+                             "drive all local chips from one process).")
+    parser.add_argument("--cpu", action="store_true",
+                        help="Force the CPU platform (virtual devices).")
+    parser.add_argument("--gloo", action="store_true",
+                        help="Accepted for reference compatibility; the "
+                             "data plane is always compiled XLA.")
+    parser.add_argument("--mpi", action="store_true",
+                        help="Accepted for reference compatibility.")
+    parser.add_argument("--check-build", action="store_true",
+                        help="Show available framework frontends.")
+    parser.add_argument("--start-timeout", type=float, default=None,
+                        help="Seconds to wait for the job to finish "
+                             "launching.")
+    parser.add_argument("--verbose", action="store_true")
+    parser.add_argument("--config-file", dest="config_file",
+                        help="YAML file with launcher parameters.")
+    # tunables (reference launch.py:373-431)
+    parser.add_argument("--fusion-threshold-mb", type=float, default=None)
+    parser.add_argument("--cycle-time-ms", type=float, default=None)
+    parser.add_argument("--cache-capacity", type=int, default=None)
+    # timeline
+    parser.add_argument("--timeline-filename", default=None)
+    parser.add_argument("--timeline-mark-cycles", action="store_true")
+    # autotune
+    parser.add_argument("--autotune", action="store_true")
+    parser.add_argument("--autotune-log-file", default=None)
+    # stall check
+    parser.add_argument("--no-stall-check", action="store_true")
+    parser.add_argument("--stall-check-warning-time-seconds", type=float,
+                        default=None)
+    parser.add_argument("--stall-check-shutdown-time-seconds", type=float,
+                        default=None)
+    parser.add_argument("--log-level", default=None,
+                        choices=["TRACE", "DEBUG", "INFO", "WARNING",
+                                 "ERROR", "FATAL"])
+    # elastic (reference launch.py elastic group)
+    parser.add_argument("--min-np", type=int, default=None)
+    parser.add_argument("--max-np", type=int, default=None)
+    parser.add_argument("--host-discovery-script", default=None)
+    parser.add_argument("--slots-per-host", type=int, default=None)
+    parser.add_argument("--reset-limit", type=int, default=None)
+    parser.add_argument("--blacklist-cooldown-range", type=int, nargs=2,
+                        default=None)
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="Command to run on each rank.")
+    args = parser.parse_args(argv)
+    if args.config_file:
+        parse_config_file(args.config_file, args)
+    return args
+
+
+def check_build():
+    from ..version import __version__
+    lines = [f"Horovod-TPU v{__version__}:", "",
+             "Available frameworks:"]
+    for name, mod in (("TensorFlow", "tensorflow"), ("PyTorch", "torch"),
+                      ("JAX", "jax")):
+        try:
+            __import__(mod)
+            lines.append(f"    [X] {name}")
+        except ImportError:
+            lines.append(f"    [ ] {name}")
+    lines += ["", "Available controllers:", "    [X] XLA (http store)",
+              "", "Available tensor operations:",
+              "    [X] XLA collectives (psum/all_gather/all_to_all/"
+              "psum_scatter over ICI/DCN)"]
+    print("\n".join(lines))
+
+
+def _run_elastic(args):
+    from .elastic_run import run_elastic
+    return run_elastic(args)
+
+
+def _run_static(args):
+    from .proc_run import launch_procs
+    env = {}
+    set_env_from_args(env, args)
+    fusion = int((args.fusion_threshold_mb or 64) * 1024 * 1024)
+    codes = launch_procs(
+        args.command, np=args.np, hosts=args.hosts,
+        ranks_per_proc=args.ranks_per_proc, env=env,
+        platform="cpu" if args.cpu else None,
+        verbose=args.verbose, fusion_threshold_bytes=fusion,
+        start_timeout=args.start_timeout)
+    return max(codes) if codes else 0
+
+
+def run_commandline(argv=None):
+    args = parse_args(argv)
+    if args.version:
+        from ..version import __version__
+        print(__version__)
+        return 0
+    if args.check_build:
+        check_build()
+        return 0
+    if not args.command:
+        print("horovodrun: no command given", file=sys.stderr)
+        return 2
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]
+    if args.hostfile:
+        args.hosts = parse_host_files(args.hostfile)
+    if args.np is None:
+        print("horovodrun: -np is required", file=sys.stderr)
+        return 2
+    if args.host_discovery_script or args.min_np or args.max_np:
+        return _run_elastic(args)
+    return _run_static(args)
+
+
+def main():
+    sys.exit(run_commandline())
+
+
+if __name__ == "__main__":
+    main()

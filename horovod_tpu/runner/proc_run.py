@@ -1,0 +1,170 @@
+"""Static multi-process job launch (reference
+``horovod/runner/gloo_run.py``: launch_gloo — rendezvous server +
+per-slot process spawn with env handoff :66-103,203-292).
+
+The launcher hosts the rendezvous/coordinator HTTP service; worker
+processes get their rank/topology and the service address through
+``HOROVOD_*`` env vars (exact names of the reference handoff,
+gloo_run.py:66-103 ↔ gloo_context.cc:150-216).  Process 0 additionally
+hosts the jax.distributed coordination service, which wires every
+process's devices into one global XLA client so compiled collectives
+span hosts (the TPU analogue of NCCL communicator bootstrap).
+"""
+
+import os
+import secrets as _secrets
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+from .hosts import SlotInfo, get_host_assignments, parse_hosts
+from .http.http_server import RendezvousServer, local_ip
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def slot_env(slot: SlotInfo, *, rdv_addr, rdv_port, coordinator,
+             secret_hex, num_procs, ranks_per_proc=1, platform=None):
+    """Env handoff for one worker (reference gloo_run.py:66-103)."""
+    env = {
+        "HOROVOD_RANK": str(slot.rank),
+        "HOROVOD_SIZE": str(slot.size),
+        "HOROVOD_LOCAL_RANK": str(slot.local_rank),
+        "HOROVOD_LOCAL_SIZE": str(slot.local_size),
+        "HOROVOD_CROSS_RANK": str(slot.cross_rank),
+        "HOROVOD_CROSS_SIZE": str(slot.cross_size),
+        "HOROVOD_HOSTNAME": slot.hostname,
+        "HOROVOD_CONTROLLER": "http",
+        "HOROVOD_CPU_OPERATIONS": "xla",
+        "HOROVOD_GLOO_RENDEZVOUS_ADDR": rdv_addr,
+        "HOROVOD_GLOO_RENDEZVOUS_PORT": str(rdv_port),
+        "HOROVOD_SECRET_KEY": secret_hex,
+        "HOROVOD_TPU_PROC_INDEX": str(slot.rank),
+        "HOROVOD_TPU_NUM_PROCS": str(num_procs),
+        "HOROVOD_TPU_RANKS_PER_PROC": str(ranks_per_proc),
+        "HOROVOD_TPU_COORDINATOR": coordinator,
+    }
+    if platform == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+        env["JAX_NUM_CPU_DEVICES"] = str(ranks_per_proc)
+    return env
+
+
+class ProcessPool:
+    """Tracks spawned worker processes; one failure terminates all
+    (the reference's launcher kills the job when a worker dies,
+    safe_shell_exec process-tree semantics)."""
+
+    def __init__(self):
+        self.procs: List[subprocess.Popen] = []
+
+    def spawn(self, command, env, stdout=None, stderr=None):
+        p = subprocess.Popen(command, env=env, stdout=stdout,
+                             stderr=stderr)
+        self.procs.append(p)
+        return p
+
+    def wait(self, timeout=None) -> List[int]:
+        deadline = time.monotonic() + timeout if timeout else None
+        codes: List[Optional[int]] = [None] * len(self.procs)
+        try:
+            while any(c is None for c in codes):
+                for i, p in enumerate(self.procs):
+                    if codes[i] is None:
+                        codes[i] = p.poll()
+                        if codes[i] is not None and codes[i] != 0:
+                            self.terminate()
+                if deadline and time.monotonic() > deadline:
+                    self.terminate()
+                    raise TimeoutError("job timed out")
+                time.sleep(0.05)
+        except KeyboardInterrupt:
+            self.terminate()
+            raise
+        return [c if c is not None else -1 for c in codes]
+
+    def terminate(self):
+        for p in self.procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 5:
+            if all(p.poll() is not None for p in self.procs):
+                return
+            time.sleep(0.05)
+        for p in self.procs:
+            if p.poll() is None:
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+
+
+def launch_procs(command: List[str], np: int, hosts: str = None,
+                 ranks_per_proc: int = 1, env: dict = None,
+                 platform: str = None, verbose: bool = False,
+                 fusion_threshold_bytes: int = 64 * 1024 * 1024,
+                 start_timeout: float = None):
+    """Launch ``command`` once per slot with full env handoff; blocks
+    until all workers exit.  Returns list of exit codes.
+
+    Only localhost spawning is wired (subprocess); remote hosts would
+    go through ssh exactly as the reference's exec_command
+    (gloo_run.py:203-229) — TPU pods normally use their own per-host
+    agent instead.
+    """
+    hosts = hosts or f"localhost:{np}"
+    host_infos = parse_hosts(hosts)
+    for h in host_infos:
+        if h.hostname not in ("localhost", "127.0.0.1",
+                              socket.gethostname()):
+            raise NotImplementedError(
+                f"remote host spawn ({h.hostname}) requires ssh "
+                f"plumbing; run one launcher per host or use the "
+                f"programmatic API")
+    if np % ranks_per_proc != 0:
+        raise ValueError("np must be divisible by ranks-per-proc")
+    num_procs = np // ranks_per_proc
+    slots = get_host_assignments(host_infos, num_procs)
+
+    secret_hex = _secrets.token_hex(16)
+    server = RendezvousServer(secret=bytes.fromhex(secret_hex),
+                              world_size=num_procs,
+                              fusion_threshold_bytes=fusion_threshold_bytes)
+    rdv_port = server.start()
+    rdv_addr = "127.0.0.1" if all(
+        h.hostname in ("localhost", "127.0.0.1") for h in host_infos) \
+        else local_ip()
+    coordinator = f"{rdv_addr}:{_free_port()}"
+
+    pool = ProcessPool()
+    try:
+        for slot in slots:
+            child_env = dict(os.environ)
+            child_env.update(env or {})
+            child_env.update(slot_env(
+                slot, rdv_addr=rdv_addr, rdv_port=rdv_port,
+                coordinator=coordinator, secret_hex=secret_hex,
+                num_procs=num_procs, ranks_per_proc=ranks_per_proc,
+                platform=platform))
+            if verbose:
+                print(f"[horovodrun] rank {slot.rank} -> {command}",
+                      file=sys.stderr)
+            pool.spawn(command, child_env)
+        codes = pool.wait(timeout=start_timeout)
+    finally:
+        pool.terminate()
+        server.stop()
+    return codes

@@ -46,20 +46,25 @@ def run(fn, np=None, args=(), kwargs=None, devices=None,
     chip.  ``keep_alive`` leaves the runtime initialized after the
     function returns (for REPL / successive phases)."""
     kwargs = kwargs or {}
-    if np is None:
-        import jax
-        from ..common import env as env_mod
-        if devices is None:
-            platform = env_mod.get_str(env_mod.HOROVOD_TPU_PLATFORM)
-            devices = jax.devices(platform) if platform else jax.devices()
-        np = len(devices)
     already = basics.is_initialized()
+    if np is None:
+        if already:
+            np = basics.engine().num_local
+        else:
+            import jax
+            from ..common import env as env_mod
+            if devices is None:
+                platform = env_mod.get_str(env_mod.HOROVOD_TPU_PLATFORM)
+                devices = jax.devices(platform) if platform \
+                    else jax.devices()
+            np = len(devices)
     if not already:
         basics.init(num_ranks=np, devices=devices)
-    elif basics.size() != np:
+    elif basics.engine().num_local != np:
         raise ValueError(
-            f"horovod_tpu already initialized with {basics.size()} ranks; "
-            f"cannot run with np={np}")
+            f"horovod_tpu already initialized with "
+            f"{basics.engine().num_local} local ranks; cannot run with "
+            f"np={np}")
     threads = [_RankThread(fn, r, args, kwargs) for r in range(np)]
     first_error = None
     try:

@@ -1,0 +1,171 @@
+"""TensorFlow frontend — ``import horovod_tpu.tensorflow as hvd``.
+
+API parity with ``horovod/tensorflow/__init__.py``: collectives over
+tf tensors/variables, ``DistributedGradientTape``, broadcast of global
+variables, object helpers.  Eager-first: TF here is the host-side
+frontend; the reference's AsyncOpKernel machinery
+(``tensorflow/mpi_ops.cc:446-1746``) exists to thread custom ops into
+TF's executor, which the eager path does not need — tensors stage
+through zero-copy ``.numpy()`` views and the fused collective runs as
+a compiled XLA program on the TPU mesh.
+"""
+
+import tensorflow as tf
+
+from ..common.basics import (  # noqa: F401
+    init, shutdown, is_initialized,
+    rank, size, local_rank, local_size, cross_rank, cross_size,
+    is_homogeneous, bind_rank, unbind_rank,
+    mpi_threads_supported, mpi_built, gloo_built, nccl_built, ddl_built,
+    ccl_built, cuda_built, rocm_built, xla_built, tpu_built,
+    start_timeline, stop_timeline,
+)
+from ..common.exceptions import (  # noqa: F401
+    HorovodInternalError, HostsUpdatedInterrupt,
+)
+from ..common.process_sets import (  # noqa: F401
+    ProcessSet, add_process_set, remove_process_set, global_process_set,
+)
+from ..ops import api as _api
+from ..ops.api import (  # noqa: F401
+    allreduce, allreduce_async,
+    grouped_allreduce, grouped_allreduce_async,
+    allgather, allgather_async, grouped_allgather,
+    grouped_allgather_async,
+    broadcast, broadcast_async,
+    alltoall, alltoall_async,
+    reducescatter, reducescatter_async,
+    grouped_reducescatter, grouped_reducescatter_async,
+    barrier, join, synchronize, poll,
+    broadcast_object, allgather_object,
+    Average, Sum, Adasum, Min, Max, Product,
+)
+from .compression import Compression  # noqa: F401
+
+
+def broadcast_variables(variables, root_rank, process_set=global_process_set):
+    """Assign every variable to root's value (reference
+    ``tensorflow/__init__.py`` broadcast_variables)."""
+    variables = list(variables)
+    handles = [
+        broadcast_async(v.value() if hasattr(v, "value") else v,
+                        root_rank, name=f"broadcast.{i}.{_var_name(v)}",
+                        process_set=process_set)
+        for i, v in enumerate(variables)
+    ]
+    for v, h in zip(variables, handles):
+        v.assign(tf.cast(synchronize(h), v.dtype))
+
+
+def _var_name(v):
+    name = getattr(v, "name", None) or getattr(v, "path", None)
+    return str(name).replace(":", "_") if name else "var"
+
+
+class DistributedGradientTape(tf.GradientTape):
+    """``tf.GradientTape`` whose ``gradient()`` averages gradients
+    across ranks (reference ``tensorflow/__init__.py:1110``
+    DistributedGradientTape -> _DistributedGradientTape :1026)."""
+
+    def __init__(self, persistent=False, watch_accessed_variables=True,
+                 device_dense="", device_sparse="",
+                 compression=Compression.none, sparse_as_dense=False,
+                 op=Average, gradient_predivide_factor=1.0,
+                 num_groups=0, groups=None,
+                 process_set=global_process_set):
+        super().__init__(persistent=persistent,
+                         watch_accessed_variables=watch_accessed_variables)
+        self._compression = compression
+        self._sparse_as_dense = sparse_as_dense
+        self._op = op
+        self._gradient_predivide_factor = gradient_predivide_factor
+        self._process_set = process_set
+
+    def gradient(self, target, sources, output_gradients=None,
+                 unconnected_gradients=tf.UnconnectedGradients.NONE):
+        grads = super().gradient(target, sources, output_gradients,
+                                 unconnected_gradients)
+        return self._allreduce_grads(grads)
+
+    def _allreduce_grads(self, grads):
+        flat = tf.nest.flatten(grads)
+        dense, index = [], []
+        for i, g in enumerate(flat):
+            if g is None:
+                continue
+            if isinstance(g, tf.IndexedSlices):
+                # TPU collectives are dense: densify IndexedSlices (the
+                # reference's sparse_as_dense path,
+                # tensorflow/__init__.py:59-178)
+                g = tf.convert_to_tensor(g)
+            dense.append(g)
+            index.append(i)
+        if not dense:
+            return grads
+        comp, ctxs = zip(*[self._compression.compress(g) for g in dense])
+        prescale = 1.0
+        if self._op == Average and self._gradient_predivide_factor != 1.0:
+            prescale = 1.0 / self._gradient_predivide_factor
+        outs = grouped_allreduce(list(comp), op=self._op,
+                                 prescale_factor=prescale,
+                                 process_set=self._process_set)
+        if not isinstance(outs, list):
+            outs = [outs]
+        outs = [self._compression.decompress(o, c)
+                for o, c in zip(outs, ctxs)]
+        for i, o in zip(index, outs):
+            flat[i] = o
+        return tf.nest.pack_sequence_as(grads, flat)
+
+
+class BroadcastGlobalVariablesHook:
+    """Estimator-era hook (reference tensorflow/__init__.py:508); in
+    TF2 eager it degrades to an explicit broadcast call."""
+
+    def __init__(self, root_rank, device=""):
+        self.root_rank = root_rank
+
+    def __call__(self, variables):
+        broadcast_variables(variables, self.root_rank)
+
+
+def DistributedOptimizer(optimizer, name=None,
+                         compression=Compression.none,
+                         sparse_as_dense=False, op=Average,
+                         gradient_predivide_factor=1.0,
+                         backward_passes_per_step=1,
+                         average_aggregated_gradients=False,
+                         num_groups=0, groups=None,
+                         process_set=global_process_set):
+    """Optimizer wrapper (reference
+    ``horovod/tensorflow/__init__.py:889`` / ``keras/__init__.py:40``):
+    gradients are averaged across ranks inside ``apply_gradients``.
+    Works with keras-3 optimizers."""
+    base_cls = optimizer.__class__
+    tape_args = dict(compression=compression, op=op,
+                     gradient_predivide_factor=gradient_predivide_factor,
+                     process_set=process_set)
+
+    class _Distributed(base_cls):
+        _hvd_wrapped = True
+
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            grads_and_vars = list(grads_and_vars)
+            grads = [g for g, _ in grads_and_vars]
+            helper = DistributedGradientTape(**tape_args)
+            grads = helper._allreduce_grads(grads)
+            return super().apply_gradients(
+                [(g, v) for g, (_, v) in zip(grads, grads_and_vars)],
+                *args, **kwargs)
+
+    _Distributed.__name__ = f"Distributed{base_cls.__name__}"
+    # swap the class in place so existing slot variables / iteration
+    # counters / custom schedules survive (from_config would rebuild a
+    # fresh optimizer and silently reset training state)
+    optimizer.__class__ = _Distributed
+    return optimizer
+
+
+from . import elastic  # noqa: F401,E402
+from .functions import broadcast_model, allreduce_metrics  # noqa: F401,E402
+from .sync_batch_norm import SyncBatchNormalization  # noqa: F401,E402

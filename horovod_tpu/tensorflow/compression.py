@@ -1,0 +1,46 @@
+"""Gradient compression for the TF frontend (reference
+``horovod/tensorflow/compression.py:20-74``)."""
+
+import tensorflow as tf
+
+
+class Compressor:
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """bfloat16 on the wire (TPU-native 16-bit; same exponent range as
+    f32).  The reference uses IEEE fp16 for NCCL."""
+
+    wire_dtype = tf.bfloat16
+
+    @classmethod
+    def compress(cls, tensor):
+        if tensor.dtype.is_floating:
+            return tf.cast(tensor, cls.wire_dtype), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tf.cast(tensor, ctx) if ctx is not None else tensor
+
+
+class Compression:
+    none = NoneCompressor
+    fp16 = FP16Compressor

@@ -1,0 +1,69 @@
+"""TF elastic states (reference ``horovod/tensorflow/elastic.py:91``
+TensorFlowKerasState / TensorFlowState + run decorator)."""
+
+import tensorflow as tf
+
+from ..common import basics
+from ..common.elastic import ObjectState, run_fn
+from ..ops import api
+
+
+def run(func):
+    def reset():
+        basics.shutdown()
+        basics.init()
+    return run_fn(func, reset)
+
+
+class TensorFlowKerasState(ObjectState):
+    """Keras model + optimizer state with in-memory save/restore and
+    broadcast sync (reference elastic.py:91-150)."""
+
+    def __init__(self, model, optimizer=None, **kwargs):
+        self.model = model
+        if optimizer is None:
+            optimizer = getattr(model, "optimizer", None)
+        self.optimizer = optimizer
+        self._saved_weights = [w.copy() for w in model.get_weights()]
+        super().__init__(bcast_object=api.broadcast_object,
+                         get_rank=basics.rank, **kwargs)
+
+    def save(self):
+        self._saved_weights = [w.copy() for w in self.model.get_weights()]
+        super().save()
+
+    def restore(self):
+        self.model.set_weights(self._saved_weights)
+        super().restore()
+
+    def sync(self):
+        from . import broadcast_variables
+        broadcast_variables(self.model.weights, root_rank=0)
+        if self.optimizer is not None and self.optimizer.variables:
+            broadcast_variables(self.optimizer.variables, root_rank=0)
+        super().sync()
+
+
+class TensorFlowState(ObjectState):
+    """Raw tf.Variable collection state (reference elastic.py:41)."""
+
+    def __init__(self, variables=None, **kwargs):
+        self.variables = variables or []
+        self._saved = [v.numpy().copy() for v in self.variables]
+        super().__init__(bcast_object=api.broadcast_object,
+                         get_rank=basics.rank, **kwargs)
+
+    def save(self):
+        self._saved = [v.numpy().copy() for v in self.variables]
+        super().save()
+
+    def restore(self):
+        for v, s in zip(self.variables, self._saved):
+            v.assign(s)
+        super().restore()
+
+    def sync(self):
+        from . import broadcast_variables
+        if self.variables:
+            broadcast_variables(self.variables, root_rank=0)
+        super().sync()

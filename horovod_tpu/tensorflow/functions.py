@@ -1,0 +1,32 @@
+"""TF helper functions (reference ``horovod/tensorflow/functions.py``:
+broadcast_object/allgather_object live in ops.api; model-level helpers
+here)."""
+
+import tensorflow as tf
+
+from ..common.process_sets import global_process_set
+from ..ops import api
+
+
+def broadcast_model(model, root_rank=0, process_set=global_process_set):
+    """Broadcast a keras model's weights from root."""
+    from . import broadcast_variables
+    broadcast_variables(model.weights, root_rank, process_set)
+
+
+def allreduce_metrics(metrics, process_set=global_process_set):
+    """Average a dict/list of scalar metrics across ranks (the keras
+    MetricAverageCallback path, reference _keras/callbacks.py:62)."""
+    if isinstance(metrics, dict):
+        keys = sorted(metrics.keys())
+        vals = [float(metrics[k]) for k in keys]
+        import numpy as np
+        out = api.allreduce(np.array(vals, dtype=np.float64),
+                            op=api.Average, name="metric_avg",
+                            process_set=process_set)
+        return {k: float(v) for k, v in zip(keys, out)}
+    return [
+        float(api.allreduce(tf.convert_to_tensor(float(v), tf.float64),
+                            op=api.Average, process_set=process_set))
+        for v in metrics
+    ]

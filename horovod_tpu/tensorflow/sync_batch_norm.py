@@ -1,0 +1,39 @@
+"""SyncBatchNormalization for keras (reference
+``horovod/tensorflow/sync_batch_norm.py:22``: overrides ``_moments``
+with a cross-rank group allreduce)."""
+
+import tensorflow as tf
+
+from ..common import basics
+from ..common.process_sets import global_process_set
+from ..ops import api
+
+
+class SyncBatchNormalization(tf.keras.layers.BatchNormalization):
+    """Batch normalization with cross-rank statistics.
+
+    Eager-mode: per-batch moments are allreduced (weighted by local
+    element count) so normalization matches one global batch."""
+
+    def __init__(self, process_set=global_process_set, **kwargs):
+        super().__init__(**kwargs)
+        self.process_set = process_set
+
+    def _moments(self, inputs, reduction_axes, keep_dims=False, **kwargs):
+        mean, var = super()._moments(
+            inputs, reduction_axes, keep_dims=keep_dims, **kwargs)
+        if basics.size() == 1:
+            return mean, var
+        sqmean = var + tf.square(mean)
+        packed = tf.concat([
+            tf.reshape(tf.cast(mean, tf.float32), [-1]),
+            tf.reshape(tf.cast(sqmean, tf.float32), [-1])], axis=0)
+        out = api.allreduce(packed, op=api.Average,
+                            name=f"sync_bn.{self.name}",
+                            process_set=self.process_set)
+        out = tf.convert_to_tensor(out)
+        n = tf.size(mean)
+        g_mean = tf.reshape(out[:n], tf.shape(mean))
+        g_sqmean = tf.reshape(out[n:], tf.shape(mean))
+        g_var = g_sqmean - tf.square(g_mean)
+        return tf.cast(g_mean, mean.dtype), tf.cast(g_var, var.dtype)

@@ -1,0 +1,43 @@
+"""Torch frontend — ``import horovod_tpu.torch as hvd``.
+
+API parity with ``horovod/torch/__init__.py``: collectives over torch
+tensors, DistributedOptimizer with autograd hooks, compression, sync
+batch norm, parameter/optimizer broadcast, elastic state.  Torch here
+is the host-side frontend (CPU tensors); the collective data plane is
+compiled XLA on the TPU mesh.
+"""
+
+from ..common.basics import (  # noqa: F401
+    init, shutdown, is_initialized,
+    rank, size, local_rank, local_size, cross_rank, cross_size,
+    is_homogeneous, bind_rank, unbind_rank,
+    mpi_threads_supported, mpi_built, gloo_built, nccl_built, ddl_built,
+    ccl_built, cuda_built, rocm_built, xla_built, tpu_built,
+    start_timeline, stop_timeline,
+)
+from ..common.exceptions import (  # noqa: F401
+    HorovodInternalError, HostsUpdatedInterrupt,
+)
+from ..common.process_sets import (  # noqa: F401
+    ProcessSet, add_process_set, remove_process_set, global_process_set,
+)
+from .mpi_ops import (  # noqa: F401
+    allreduce, allreduce_async, allreduce_, allreduce_async_,
+    grouped_allreduce, grouped_allreduce_async,
+    allgather, allgather_async, grouped_allgather,
+    grouped_allgather_async,
+    broadcast, broadcast_async, broadcast_, broadcast_async_,
+    alltoall, alltoall_async,
+    reducescatter, reducescatter_async,
+    grouped_reducescatter, grouped_reducescatter_async,
+    barrier, join, synchronize, poll,
+    Average, Sum, Adasum, Min, Max, Product,
+)
+from .compression import Compression  # noqa: F401
+from .functions import (  # noqa: F401
+    broadcast_parameters, broadcast_optimizer_state, broadcast_object,
+    allgather_object,
+)
+from .optimizer import DistributedOptimizer  # noqa: F401
+from .sync_batch_norm import SyncBatchNorm  # noqa: F401
+from . import elastic  # noqa: F401

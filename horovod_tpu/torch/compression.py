@@ -1,0 +1,53 @@
+"""Gradient compression (reference ``horovod/torch/compression.py``:
+``Compression.none`` / ``Compression.fp16`` compressor interface)."""
+
+import torch
+
+
+class Compressor:
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Halve allreduce bytes for float tensors.  On TPU the natural
+    16-bit format is bfloat16 (same exponent range as f32 — no loss
+    scaling needed, and the MXU consumes it natively), so that is the
+    default wire format; fp16 is kept for exact reference parity."""
+
+    wire_dtype = torch.bfloat16
+
+    @classmethod
+    def compress(cls, tensor):
+        if tensor.dtype.is_floating_point:
+            return tensor.to(cls.wire_dtype), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor.to(ctx) if ctx is not None else tensor
+
+
+class TrueFP16Compressor(FP16Compressor):
+    wire_dtype = torch.float16
+
+
+class Compression:
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    fp16_ieee = TrueFP16Compressor

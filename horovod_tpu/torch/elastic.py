@@ -1,0 +1,185 @@
+"""Torch elastic state (reference ``horovod/torch/elastic/state.py:27``
+TorchState, ``sampler.py:24`` ElasticSampler)."""
+
+import math
+
+import torch
+
+from ..common import basics
+from ..common.elastic import ObjectState, State, run_fn
+from ..ops import api
+from .functions import (
+    broadcast_object, broadcast_optimizer_state, broadcast_parameters,
+)
+
+
+def run(func):
+    """Decorator: elastic retry loop with TPU mesh re-init on reset
+    (reference torch/elastic/__init__.py run)."""
+    from ..common.basics import init, shutdown
+
+    def reset():
+        shutdown()
+        init()
+
+    return run_fn(func, reset)
+
+
+class TorchState(ObjectState):
+    """State of a torch training job: model(s), optimizer(s), plus
+    arbitrary picklable attributes (reference state.py:27-160)."""
+
+    def __init__(self, model=None, optimizer=None, **kwargs):
+        kwargs.update(dict(model=model, optimizer=optimizer))
+        self._handlers, kwargs = _get_handlers(kwargs)
+        for name, handler in self._handlers.items():
+            setattr(self, name, handler.value)
+        super().__init__(bcast_object=broadcast_object,
+                         get_rank=basics.rank, **kwargs)
+
+    def save(self):
+        for handler in self._handlers.values():
+            handler.save()
+        super().save()
+
+    def restore(self):
+        for handler in self._handlers.values():
+            handler.restore()
+        super().restore()
+
+    def sync(self):
+        for handler in self._handlers.values():
+            handler.sync()
+        super().sync()
+
+    def __setattr__(self, name, value):
+        if hasattr(self, "_handlers") and name in self._handlers:
+            self._handlers[name].set_value(value)
+        super().__setattr__(name, value)
+
+
+class _StateHandler:
+    def __init__(self, value):
+        self.value = value
+
+    def set_value(self, value):
+        self.value = value
+
+
+class _ModelStateHandler(_StateHandler):
+    def __init__(self, model):
+        super().__init__(model)
+        self._saved_model_state = _copy_state_dict(model.state_dict())
+
+    def save(self):
+        self._saved_model_state = _copy_state_dict(
+            self.value.state_dict())
+
+    def restore(self):
+        self.value.load_state_dict(self._saved_model_state)
+
+    def sync(self):
+        broadcast_parameters(self.value.state_dict(), root_rank=0)
+
+
+class _OptimizerStateHandler(_StateHandler):
+    def __init__(self, optimizer):
+        super().__init__(optimizer)
+        self._saved_state = _copy_state_dict(optimizer.state_dict())
+
+    def save(self):
+        self._saved_state = _copy_state_dict(self.value.state_dict())
+
+    def restore(self):
+        self.value.load_state_dict(self._saved_state)
+
+    def sync(self):
+        broadcast_optimizer_state(self.value, root_rank=0)
+
+
+def _copy_state_dict(sd):
+    import copy
+    return copy.deepcopy(sd)
+
+
+def _get_handlers(kwargs):
+    handlers = {}
+    remainder = {}
+    for name, value in kwargs.items():
+        if isinstance(value, torch.nn.Module):
+            handlers[name] = _ModelStateHandler(value)
+        elif isinstance(value, torch.optim.Optimizer):
+            handlers[name] = _OptimizerStateHandler(value)
+        elif value is None and name in ("model", "optimizer"):
+            continue
+        else:
+            remainder[name] = value
+    return handlers, remainder
+
+
+class ElasticSampler(torch.utils.data.Sampler):
+    """Resharding-aware sampler (reference sampler.py:24): partitions
+    indices over current ranks, tracks processed indices so a resize
+    mid-epoch resumes where it left off."""
+
+    def __init__(self, dataset, shuffle=True, seed=0):
+        self.dataset = dataset
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.processed_indices = set()
+        self.num_replicas = 0
+        self.rank = 0
+        self.remaining_indices = []
+        self.num_samples = 0
+        self.total_size = 0
+        self.reset()
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+        self.processed_indices = set()
+        self.reset()
+
+    def record_batch(self, batch_idx, batch_size):
+        start = self.rank * self.num_samples + batch_idx * batch_size
+        # indices this rank just consumed, in its local order
+        local = self.indices[batch_idx * batch_size:
+                             (batch_idx + 1) * batch_size]
+        self.processed_indices.update(local)
+
+    def load_state_dict(self, state_dict):
+        self.epoch = state_dict["epoch"]
+        self.processed_indices = set(state_dict["processed_indices"])
+        self.reset()
+
+    def state_dict(self):
+        return dict(epoch=self.epoch,
+                    processed_indices=sorted(self.processed_indices))
+
+    def reset(self):
+        self.num_replicas = basics.size() if basics.is_initialized() else 1
+        self.rank = basics.rank() if basics.is_initialized() else 0
+
+        remaining = [idx for idx in range(len(self.dataset))
+                     if idx not in self.processed_indices]
+        if self.shuffle:
+            g = torch.Generator()
+            g.manual_seed(self.seed + self.epoch)
+            order = torch.randperm(len(remaining), generator=g).tolist()
+            remaining = [remaining[i] for i in order]
+        self.remaining_indices = remaining
+
+        self.num_samples = int(
+            math.ceil(len(self.remaining_indices) / self.num_replicas))
+        self.total_size = self.num_samples * self.num_replicas
+
+        indices = list(self.remaining_indices)
+        indices += indices[: (self.total_size - len(indices))]
+        self.indices = indices[self.rank: self.total_size:
+                               self.num_replicas]
+
+    def __iter__(self):
+        return iter(self.indices)
+
+    def __len__(self):
+        return self.num_samples

@@ -1,0 +1,51 @@
+"""Parameter/optimizer-state broadcast helpers (reference
+``horovod/torch/functions.py``: broadcast_parameters,
+broadcast_optimizer_state, broadcast_object)."""
+
+import collections
+
+import torch
+
+from ..common.process_sets import global_process_set
+from ..ops import api
+
+
+def broadcast_parameters(params, root_rank, process_set=global_process_set):
+    """Broadcast model parameters from root (reference
+    functions.py:59).  Accepts ``model.state_dict()`` or
+    ``model.named_parameters()``."""
+    if isinstance(params, dict):
+        params = sorted(params.items())
+    elif isinstance(params, collections.abc.Iterable):
+        params = list(params)
+    handles = []
+    for name, p in params:
+        if p is None or not torch.is_tensor(p):
+            continue
+        h = api.broadcast_async_(p, root_rank, name=f"broadcast.{name}",
+                                 process_set=process_set)
+        handles.append(h)
+    for h in handles:
+        api.synchronize(h)
+
+
+def broadcast_optimizer_state(optimizer, root_rank,
+                              process_set=global_process_set):
+    """Broadcast the optimizer state from root (reference
+    functions.py:118).
+
+    The reference broadcasts tensor-by-tensor with a dummy step to
+    materialize missing state on non-roots; since the torch frontend
+    here is host-side, one pickled object broadcast of the full state
+    dict is both simpler and faster (one fused collective instead of
+    hundreds), and every rank takes the same collective path so
+    uneven local state cannot deadlock."""
+    if len(optimizer.param_groups) == 0:
+        raise ValueError("optimizer is empty")
+    state = api.broadcast_object(optimizer.state_dict(), root_rank,
+                                 name="opt_state", process_set=process_set)
+    optimizer.load_state_dict(state)
+
+
+broadcast_object = api.broadcast_object
+allgather_object = api.allgather_object

@@ -1,0 +1,26 @@
+"""Torch collective ops.
+
+Reference surface: ``horovod/torch/mpi_ops.py:110-1293`` (sync +
+``*_async`` handle APIs + ``synchronize``/``poll``).  The reference
+needs a pybind11 C++ module (``torch/mpi_ops_v2.cc``) because CUDA
+tensors and autograd streams must be adapted natively; in this image
+torch is CPU-only, so ``.numpy()`` views are zero-copy and the core
+framework-agnostic API (ops/api.py) already does the staging — the
+single H2D copy happens per fused bucket inside the mesh executor.
+"""
+
+import torch  # noqa: F401 — presence check; kept for API parity
+
+from ..ops import api as _api
+from ..ops.api import (  # noqa: F401
+    allreduce, allreduce_async, allreduce_, allreduce_async_,
+    grouped_allreduce, grouped_allreduce_async,
+    allgather, allgather_async, grouped_allgather,
+    grouped_allgather_async,
+    broadcast, broadcast_async, broadcast_, broadcast_async_,
+    alltoall, alltoall_async,
+    reducescatter, reducescatter_async,
+    grouped_reducescatter, grouped_reducescatter_async,
+    barrier, join, synchronize, poll,
+    Average, Sum, Adasum, Min, Max, Product,
+)

@@ -1,0 +1,297 @@
+"""DistributedOptimizer for torch (reference
+``horovod/torch/optimizer.py``).
+
+Same contract as the reference: wrap any ``torch.optim.Optimizer``;
+per-parameter hooks fire as autograd accumulates gradients and launch
+**async** allreduces immediately (overlapping communication with the
+rest of backward); ``step()`` synchronizes all handles first.  The
+engine fuses concurrently-pending allreduces into single compiled XLA
+collectives (core/engine.py _fuse), playing the role of the
+reference's fusion buffer + NCCL launch.
+"""
+
+import warnings
+from contextlib import contextmanager
+
+import torch
+
+from ..common import basics
+from ..common.process_sets import global_process_set
+from ..ops import api
+from ..ops.api import Average, Adasum, Sum
+from .compression import Compression
+
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    """Mixin whose methods are grafted onto a dynamic subclass of the
+    wrapped optimizer's class (same trick as the reference,
+    optimizer.py:516): the instance keeps the wrapped optimizer's
+    param_groups/state/defaults and gains hook-driven allreduce."""
+
+    def _dist_init(self, named_parameters=None,
+                   compression=Compression.none,
+                   backward_passes_per_step=1, op=Average,
+                   gradient_predivide_factor=1.0,
+                   groups=None, sparse_as_dense=False,
+                   process_set=global_process_set):
+        self._compression = compression
+        self.op = op
+        self.gradient_predivide_factor = gradient_predivide_factor
+        self.sparse_as_dense = sparse_as_dense
+        self.process_set = process_set
+
+        if named_parameters is not None:
+            named_parameters = list(named_parameters)
+            # reference checks for duplicate / non-tuple entries
+            if any(not isinstance(p, tuple) or len(p) != 2
+                   for p in named_parameters):
+                raise ValueError(
+                    "named_parameters should be a sequence of "
+                    "tuples (name, parameter)")
+            all_param_ids = {id(v) for group in self.param_groups
+                             for v in group["params"]}
+            named_ids = {id(v) for _, v in named_parameters}
+            unnamed = all_param_ids - named_ids
+            if unnamed:
+                raise ValueError(
+                    "named_parameters was specified, but one or more "
+                    "model parameters were not named")
+            self._parameter_names = {v: k for k, v in named_parameters}
+        else:
+            self._parameter_names = {
+                v: f"allreduce.noname.{i}.{j}"
+                for i, group in enumerate(self.param_groups)
+                for j, v in enumerate(group["params"])}
+
+        self.backward_passes_per_step = backward_passes_per_step
+        self._allreduce_delay = {}
+        self._handles = {}
+        self._grad_accs = []
+        self._requires_update = set()
+        self._synchronized = False
+        self._should_synchronize = True
+
+        # group -> list of params for grouped (jointly fused) allreduce
+        self._groups = None
+        if groups is not None:
+            if isinstance(groups, int):
+                params_flat = [p for g in self.param_groups
+                               for p in g["params"] if p.requires_grad]
+                if groups > 0:
+                    n = max(1, (len(params_flat) + groups - 1) // groups)
+                    self._groups = [params_flat[i:i + n]
+                                    for i in range(0, len(params_flat), n)]
+            else:
+                self._groups = [list(g) for g in groups]
+        self._group_of = {}
+        if self._groups:
+            for gi, g in enumerate(self._groups):
+                for p in g:
+                    self._group_of[id(p)] = gi
+        self._group_pending = {gi: set() for gi in
+                               range(len(self._groups or []))}
+
+        if basics.size() > 1:
+            self._register_hooks()
+
+    # -- hook plumbing ------------------------------------------------------
+
+    def _register_hooks(self):
+        for group in self.param_groups:
+            for p in group["params"]:
+                if not p.requires_grad:
+                    continue
+                self._requires_update.add(p)
+                self._allreduce_delay[p] = self.backward_passes_per_step
+                if hasattr(p, "register_post_accumulate_grad_hook"):
+                    p.register_post_accumulate_grad_hook(
+                        self._make_post_hook(p))
+                else:  # pragma: no cover — torch < 2.1
+                    # reference trick (optimizer.py:131-174): hook the
+                    # grad accumulator node
+                    p_tmp = p.expand_as(p)
+                    grad_acc = p_tmp.grad_fn.next_functions[0][0]
+                    grad_acc.register_hook(self._make_acc_hook(p))
+                    self._grad_accs.append(grad_acc)
+
+    def _make_post_hook(self, p):
+        def hook(param):
+            self._on_grad_ready(p)
+        return hook
+
+    def _make_acc_hook(self, p):  # pragma: no cover — torch < 2.1
+        def hook(*ignore):
+            self._on_grad_ready(p)
+        return hook
+
+    def _on_grad_ready(self, p):
+        if p.grad is None:
+            return
+        if p in self._handles and self._handles[p][0] is not None:
+            if self._allreduce_delay[p] <= 0:
+                raise AssertionError(
+                    "Gradients were computed more than "
+                    "backward_passes_per_step times before call to "
+                    "step(). Increase backward_passes_per_step to "
+                    "accumulate gradients locally.")
+        assert not p.grad.requires_grad
+        self._allreduce_delay[p] -= 1
+        if self._allreduce_delay[p] == 0:
+            gi = self._group_of.get(id(p))
+            if gi is None:
+                handle, ctx = self._allreduce_grad_async(p)
+                self._handles[p] = (handle, ctx)
+            else:
+                self._group_pending[gi].add(p)
+                if len(self._group_pending[gi]) == len(self._groups[gi]):
+                    self._grouped_allreduce_async(gi)
+
+    # -- collective launches -------------------------------------------------
+
+    def _name(self, p):
+        return self._parameter_names.get(p)
+
+    def _prepare_grad(self, p):
+        grad = p.grad
+        if grad.is_sparse:
+            if not self.sparse_as_dense:
+                raise ValueError(
+                    "sparse gradients require sparse_as_dense=True "
+                    "(TPU collectives are dense)")
+            grad = grad.to_dense()
+        return grad
+
+    def _allreduce_grad_async(self, p):
+        if p.grad.device.type != "cpu":
+            raise ValueError("horovod_tpu torch binding requires CPU "
+                             "tensors (torch is the host-side frontend)")
+        grad = self._prepare_grad(p)
+        tensor_compressed, ctx = self._compression.compress(grad)
+        if self.op == Average:
+            prescale = 1.0 / self.gradient_predivide_factor \
+                if self.gradient_predivide_factor != 1.0 else 1.0
+        else:
+            prescale = 1.0
+        handle = api.allreduce_async(
+            tensor_compressed, name=self._name(p), op=self.op,
+            prescale_factor=prescale, process_set=self.process_set)
+        return handle, ctx
+
+    def _grouped_allreduce_async(self, gi):
+        group = self._groups[gi]
+        tensors, ctxs = [], []
+        for p in group:
+            t, c = self._compression.compress(self._prepare_grad(p))
+            tensors.append(t)
+            ctxs.append(c)
+        handle = api.grouped_allreduce_async(
+            tensors, op=self.op, name=f"group.{gi}",
+            process_set=self.process_set)
+        for p, c in zip(group, ctxs):
+            self._handles[p] = (handle, ("group", gi, c))
+        self._group_pending[gi] = set()
+
+    # -- synchronize / step ---------------------------------------------------
+
+    def synchronize(self):
+        """Flush every outstanding allreduce and write averaged grads
+        back (reference optimizer.py:255-303)."""
+        if basics.size() <= 1:
+            self._synchronized = True
+            return
+        # launch any param that never hit delay 0 (missing backward)
+        for p in self._requires_update:
+            if p not in self._handles and p.grad is not None \
+                    and self._allreduce_delay[p] == \
+                    self.backward_passes_per_step:
+                continue  # nothing pending for this param
+        completed = set()
+        group_results = {}
+        for p, (handle, ctx) in list(self._handles.items()):
+            if isinstance(ctx, tuple) and ctx and ctx[0] == "group":
+                _, gi, comp_ctx = ctx
+                if gi not in group_results:
+                    group_results[gi] = api.synchronize(handle)
+                outputs = group_results[gi]
+                idx = [id(q) for q in self._groups[gi]].index(id(p))
+                out = self._compression.decompress(outputs[idx], comp_ctx)
+            else:
+                out = self._compression.decompress(
+                    api.synchronize(handle), ctx)
+            with torch.no_grad():
+                if p.grad.is_sparse:
+                    p.grad = out.view_as(p)
+                else:
+                    p.grad.copy_(out.view_as(p.grad))
+            self._allreduce_delay[p] = self.backward_passes_per_step
+            completed.add(p)
+        self._handles.clear()
+        self._synchronized = True
+
+    @contextmanager
+    def skip_synchronize(self):
+        """User already called synchronize() manually before step()
+        (reference optimizer.py:305-318)."""
+        self._should_synchronize = False
+        try:
+            yield
+        finally:
+            self._should_synchronize = True
+
+    def step(self, closure=None):
+        if self._should_synchronize:
+            if self._synchronized:
+                warnings.warn(
+                    "optimizer.step() called without a prior "
+                    "optimizer.synchronize() after the last "
+                    "backward; this is allowed but wasteful")
+            self.synchronize()
+        self._synchronized = False
+        return super(self.__class__, self).step(closure)
+
+    def zero_grad(self, *args, **kwargs):
+        if self._handles:
+            raise AssertionError(
+                "optimizer.zero_grad() was called after loss.backward() "
+                "but before optimizer.step() or optimizer.synchronize(). "
+                "This is prohibited as it can cause a race condition.")
+        return super(self.__class__, self).zero_grad(*args, **kwargs)
+
+    def set_backward_passes_per_step(self, passes):
+        self.backward_passes_per_step = passes
+        for p in self._allreduce_delay:
+            self._allreduce_delay[p] = passes
+
+
+def DistributedOptimizer(optimizer, named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step=1, op=Average,
+                         gradient_predivide_factor=1.0,
+                         num_groups=0, groups=None,
+                         sparse_as_dense=False,
+                         process_set=global_process_set):
+    """Wrap ``optimizer`` so gradient averaging happens across ranks
+    (reference ``horovod/torch/optimizer.py:516``)."""
+    if gradient_predivide_factor != 1.0 and op != Average:
+        raise ValueError(
+            "gradient_predivide_factor not supported with op != Average")
+    if num_groups != 0:
+        warnings.warn(
+            "Parameter `num_groups` has been replaced by `groups` and "
+            "will be removed", DeprecationWarning)
+        if groups is None:
+            groups = num_groups
+    # dynamic subclass: wrapped optimizer's class + distributed mixin
+    # (Adasum rides the same machinery; the scale-invariant combine
+    # happens in the engine's reduction, ops/adasum.py)
+    methods = {k: v for k, v in _DistributedOptimizer.__dict__.items()
+               if k != "__dict__" and k != "__weakref__"}
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+               methods)
+    inst = cls.__new__(cls)
+    inst.__dict__.update(optimizer.__dict__)
+    inst._dist_init(named_parameters, compression,
+                    backward_passes_per_step, op,
+                    gradient_predivide_factor, groups, sparse_as_dense,
+                    process_set)
+    return inst

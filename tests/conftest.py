@@ -9,6 +9,7 @@ import sys
 
 # Must be set before jax initializes its backends.
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
 # The engine picks its mesh from this platform (sandbox forces the real
 # TPU platform as default; tests run on virtual CPU devices).
 os.environ["HOROVOD_TPU_PLATFORM"] = "cpu"
@@ -17,6 +18,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax  # noqa: E402
 
+# The sandbox preloads jax with platforms "axon,cpu" (one real TPU via a
+# tunnel); tests want only the virtual 8-device CPU platform.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 # The reference supports 64-bit dtypes (message.h:30-41); enable them.
 jax.config.update("jax_enable_x64", True)
@@ -24,6 +28,12 @@ jax.config.update("jax_enable_x64", True)
 import pytest  # noqa: E402
 
 import horovod_tpu as hvd  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "integration: end-to-end multi-process launches (slower)")
 
 
 @pytest.fixture()

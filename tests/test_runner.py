@@ -1,0 +1,171 @@
+"""Launcher/runner tests (reference test/single/test_run.py shape:
+arg parsing, host allocation, command synthesis with mocks) plus a
+real 2-process integration launch (reference test/integration/
+test_static_run.py)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from horovod_tpu.runner.hosts import (
+    HostInfo, get_host_assignments, parse_hosts,
+)
+from horovod_tpu.runner.launch import parse_args
+from horovod_tpu.runner.config_parser import set_env_from_args
+from horovod_tpu.runner.http.http_server import (
+    Coordinator, KVStore, RendezvousServer,
+)
+from horovod_tpu.runner.http.http_client import StoreClient
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_parse_hosts():
+    hosts = parse_hosts("a:2,b:4,c")
+    assert [(h.hostname, h.slots) for h in hosts] == \
+        [("a", 2), ("b", 4), ("c", 1)]
+
+
+def test_host_assignments():
+    slots = get_host_assignments(parse_hosts("a:2,b:2"), 3)
+    assert [(s.hostname, s.rank, s.local_rank) for s in slots] == \
+        [("a", 0, 0), ("a", 1, 1), ("b", 2, 0)]
+    assert slots[2].cross_rank == 1 and slots[0].cross_size == 2
+    with pytest.raises(ValueError):
+        get_host_assignments(parse_hosts("a:1"), 2)
+
+
+def test_parse_args_and_env():
+    args = parse_args(["-np", "4", "--fusion-threshold-mb", "32",
+                       "--cycle-time-ms", "2.5", "--autotune",
+                       "--timeline-filename", "/tmp/t.json",
+                       "--", "python", "train.py"])
+    assert args.np == 4
+    assert args.command == ["--", "python", "train.py"] or \
+        args.command == ["python", "train.py"]
+    env = {}
+    set_env_from_args(env, args)
+    assert env["HOROVOD_FUSION_THRESHOLD"] == str(32 * 1024 * 1024)
+    assert env["HOROVOD_CYCLE_TIME"] == "2.5"
+    assert env["HOROVOD_AUTOTUNE"] == "1"
+    assert env["HOROVOD_TIMELINE"] == "/tmp/t.json"
+
+
+def test_kv_store_roundtrip():
+    server = RendezvousServer(secret=b"k", world_size=1)
+    port = server.start()
+    try:
+        client = StoreClient("127.0.0.1", port, b"k")
+        client.put("/ns/x", b"hello")
+        assert client.get("/ns/x") == b"hello"
+        assert client.get("/ns/missing") is None
+        client.delete("/ns/x")
+        assert client.get("/ns/x") is None
+        # wrong secret -> forbidden
+        bad = StoreClient("127.0.0.1", port, b"wrong")
+        with pytest.raises(Exception):
+            bad.put("/ns/y", b"1")
+    finally:
+        server.stop()
+
+
+def _meta(key, nbytes=64, type_="ALLREDUCE", ps=0, nprocs=2, **kw):
+    m = dict(key=key, type=type_, dtype="float32", shape=[4], op=1,
+             pre=1.0, post=1.0, ps=ps, nbytes=nbytes, nprocs=nprocs,
+             root=-1, aux={})
+    m.update(kw)
+    return m
+
+
+def test_coordinator_negotiation_and_fusion():
+    c = Coordinator(world_size=2, fusion_threshold_bytes=100)
+    c.handle("ready", {"proc": 0, "nlocal": 1,
+                       "entries": [_meta("a", 60), _meta("b", 60)]})
+    # nothing ready until proc 1 reports
+    out = c.handle("poll", {"cursor": 0, "wait": 0})
+    assert out["responses"] == []
+    c.handle("ready", {"proc": 1, "nlocal": 1,
+                       "entries": [_meta("a", 60), _meta("b", 60)]})
+    out = c.handle("poll", {"cursor": 0, "wait": 0})
+    # 60+60 > 100 -> two batches
+    kinds = [(r["kind"], r["keys"]) for r in out["responses"]]
+    assert kinds == [("batch", ["a"]), ("batch", ["b"])]
+    assert out["responses"][0]["metas"]["a"]["dtype"] == "float32"
+
+
+def test_coordinator_fuses_under_threshold():
+    c = Coordinator(world_size=1, fusion_threshold_bytes=1000)
+    c.handle("ready", {"proc": 0, "nlocal": 1, "entries": [
+        _meta("a", 60, nprocs=1), _meta("b", 60, nprocs=1),
+        _meta("g", 60, type_="ALLGATHER", nprocs=1),
+        _meta("c", 60, nprocs=1)]})
+    out = c.handle("poll", {"cursor": 0, "wait": 0})
+    keys = [r["keys"] for r in out["responses"]]
+    assert keys == [["a", "b"], ["g"], ["c"]]
+
+
+def test_coordinator_cross_process_validation():
+    c = Coordinator(world_size=2)
+    c.handle("ready", {"proc": 0, "nlocal": 1,
+                       "entries": [_meta("x", dtype="float32")]})
+    c.handle("ready", {"proc": 1, "nlocal": 1,
+                       "entries": [_meta("x", dtype="float64")]})
+    out = c.handle("poll", {"cursor": 0, "wait": 0})
+    assert out["responses"][0]["kind"] == "error"
+    assert "float64" in out["responses"][0]["message"]
+
+
+WORKER = textwrap.dedent("""
+    import numpy as np
+    import horovod_tpu as hvd
+    hvd.init()
+    r, s = hvd.rank(), hvd.size()
+    out = hvd.allreduce(np.arange(4, dtype=np.float32) * (r + 1),
+                        op=hvd.Sum, name="t")
+    assert np.allclose(out, np.arange(4, dtype=np.float32)
+                       * sum(range(1, s + 1))), (r, out)
+    g = hvd.allgather(np.full((r + 1, 2), r, np.float32), name="g")
+    assert g.shape == (sum(range(1, s + 1)), 2)
+    res, splits = hvd.alltoall(np.arange(s * 2, dtype=np.float32),
+                               splits=[2] * s, name="a2a")
+    assert res.shape == (2 * s,)
+    print(f"OK {r}")
+    hvd.shutdown()
+""")
+
+
+@pytest.mark.integration
+def test_two_process_launch(tmp_path):
+    """Real multi-process run: collectives across process boundaries
+    through jax.distributed + the HTTP coordinator."""
+    from horovod_tpu.runner.proc_run import launch_procs
+
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    codes = launch_procs([sys.executable, str(script)], np=2,
+                         platform="cpu", env={"PYTHONPATH": REPO},
+                         start_timeout=120)
+    assert codes == [0, 0]
+
+
+@pytest.mark.integration
+def test_cli_static_run(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+         "--cpu", "--", sys.executable, str(script)],
+        env={**os.environ, "PYTHONPATH": REPO},
+        capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+
+def test_check_build(capsys):
+    from horovod_tpu.runner.launch import check_build
+    check_build()
+    out = capsys.readouterr().out
+    assert "JAX" in out and "XLA" in out

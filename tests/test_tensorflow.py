@@ -1,0 +1,152 @@
+"""TF binding tests (reference test/parallel/test_tensorflow.py shape).
+TF is heavyweight to import; these tests run it eagerly on CPU."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import horovod_tpu as hvd_core  # noqa: E402
+import horovod_tpu.tensorflow as hvd  # noqa: E402
+
+
+NP = 4
+
+
+def run_ranks(fn, np_ranks=NP):
+    return hvd_core.run(fn, np=np_ranks)
+
+
+def test_tf_allreduce(hvd_shutdown):
+    def fn():
+        r = hvd.rank()
+        t = tf.constant([1.0, 2.0, 3.0]) * (r + 1)
+        out = hvd.allreduce(t, op=hvd.Average)
+        expected = np.array([1.0, 2.0, 3.0]) * np.mean(
+            [i + 1 for i in range(NP)])
+        assert isinstance(out, tf.Tensor)
+        assert np.allclose(out.numpy(), expected)
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_tf_broadcast_variables(hvd_shutdown):
+    def fn():
+        v = tf.Variable([float(hvd.rank())] * 4)
+        hvd.broadcast_variables([v], root_rank=0)
+        assert np.allclose(v.numpy(), 0.0)
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_distributed_gradient_tape(hvd_shutdown):
+    def fn():
+        r = hvd.rank()
+        w = tf.Variable([[1.0], [1.0]])
+        x = tf.constant([[float(r + 1), 2.0 * (r + 1)]])
+        with hvd.DistributedGradientTape() as tape:
+            y = tf.reduce_sum(tf.matmul(x, w))
+        grad = tape.gradient(y, [w])[0]
+        # local grad = x^T; average over ranks
+        mean_scale = np.mean([i + 1 for i in range(NP)])
+        assert np.allclose(grad.numpy(),
+                           [[mean_scale], [2.0 * mean_scale]])
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_distributed_optimizer_keras(hvd_shutdown):
+    def fn():
+        tf.keras.utils.set_random_seed(0)
+        model = tf.keras.Sequential(
+            [tf.keras.layers.Dense(1, use_bias=False,
+                                   kernel_initializer="ones")])
+        model.build((None, 2))
+        opt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(0.1))
+        r = hvd.rank()
+        x = tf.constant([[float(r + 1), 1.0]])
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_sum(model(x))
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        w = model.trainable_variables[0].numpy()
+        # averaged grad col0 = mean(r+1), col1 = 1
+        mean_scale = np.mean([i + 1 for i in range(NP)])
+        assert np.allclose(w.ravel(),
+                           [1.0 - 0.1 * mean_scale, 1.0 - 0.1])
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_tf_allgather_object(hvd_shutdown):
+    def fn():
+        out = hvd.allgather_object({"rank": hvd.rank()})
+        assert [o["rank"] for o in out] == list(range(NP))
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_keras_metric_average_callback(hvd_shutdown):
+    from horovod_tpu.keras.callbacks import MetricAverageCallback
+
+    def fn():
+        cb = MetricAverageCallback()
+        logs = {"loss": float(hvd.rank()), "acc": 1.0}
+        cb.on_epoch_end(0, logs)
+        assert np.isclose(logs["loss"],
+                          np.mean(list(range(NP))))
+        assert np.isclose(logs["acc"], 1.0)
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_keras_lr_warmup(hvd_shutdown):
+    from horovod_tpu.keras.callbacks import LearningRateWarmupCallback
+
+    class FakeOpt:
+        learning_rate = 0.0
+
+    class FakeModel:
+        optimizer = FakeOpt()
+
+    def fn():
+        cb = LearningRateWarmupCallback(initial_lr=1.0, warmup_epochs=2,
+                                        steps_per_epoch=10)
+        cb.set_model(FakeModel())
+        cb.on_epoch_begin(0)
+        cb.on_batch_begin(0)
+        lr0 = cb.model.optimizer.learning_rate
+        cb.on_epoch_begin(1)
+        cb.on_batch_begin(9)
+        lr_end = cb.model.optimizer.learning_rate
+        # warmup: starts near lr/size, approaches lr
+        assert lr0 == pytest.approx(1.0 / NP)
+        assert lr_end > lr0
+        assert lr_end <= 1.0 + 1e-6
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_tf_elastic_state(hvd_shutdown):
+    def fn():
+        model = tf.keras.Sequential(
+            [tf.keras.layers.Dense(2, use_bias=False)])
+        model.build((None, 3))
+        state = hvd.elastic.TensorFlowKerasState(model, batch=0, epoch=0)
+        state.epoch = 3
+        state.commit()
+        w0 = model.get_weights()[0].copy()
+        model.set_weights([np.zeros_like(w0)])
+        state.restore()
+        assert np.allclose(model.get_weights()[0], w0)
+        assert state.epoch == 3
+        return True
+
+    assert all(run_ranks(fn))

@@ -1,0 +1,260 @@
+"""Torch binding tests (reference test/parallel/test_torch.py shape:
+collectives numerics across ranks + DistributedOptimizer training).
+Ranks run as threads via the in-process launcher."""
+
+import numpy as np
+import pytest
+import torch
+
+import horovod_tpu as hvd_core
+import horovod_tpu.torch as hvd
+
+
+NP = 4
+
+
+def run_ranks(fn, np_ranks=NP):
+    return hvd_core.run(fn, np=np_ranks)
+
+
+def test_torch_allreduce_average(hvd_shutdown):
+    def fn():
+        r = hvd.rank()
+        t = torch.arange(8, dtype=torch.float32) * (r + 1)
+        out = hvd.allreduce(t, op=hvd.Average)
+        expected = torch.arange(8, dtype=torch.float32) * \
+            (sum(range(1, NP + 1)) / NP)
+        assert torch.allclose(out, expected)
+        assert isinstance(out, torch.Tensor)
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_torch_allreduce_inplace(hvd_shutdown):
+    def fn():
+        t = torch.ones(4) * (hvd.rank() + 1)
+        hvd.allreduce_(t, op=hvd.Sum)
+        assert torch.allclose(t, torch.full((4,),
+                                            float(sum(range(1, NP + 1)))))
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_torch_allgather_uneven(hvd_shutdown):
+    def fn():
+        r = hvd.rank()
+        t = torch.ones((r + 1, 2)) * r
+        out = hvd.allgather(t)
+        assert out.shape == (sum(range(1, NP + 1)), 2)
+        off = 0
+        for rr in range(NP):
+            seg = out[off: off + rr + 1]
+            assert torch.allclose(seg, torch.full_like(seg, float(rr)))
+            off += rr + 1
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_torch_broadcast_parameters(hvd_shutdown):
+    def fn():
+        torch.manual_seed(hvd.rank())
+        model = torch.nn.Linear(4, 2)
+        hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+        w = model.weight.detach().numpy()
+        gathered = hvd.allgather(torch.from_numpy(w).reshape(1, -1))
+        assert np.allclose(gathered.numpy(),
+                           np.tile(gathered[0].numpy(), (NP, 1)))
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_distributed_optimizer_averages_grads(hvd_shutdown):
+    def fn():
+        torch.manual_seed(0)
+        model = torch.nn.Linear(4, 1, bias=False)
+        hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+        opt = torch.optim.SGD(model.parameters(), lr=0.0)
+        opt = hvd.DistributedOptimizer(
+            opt, named_parameters=model.named_parameters())
+        x = torch.ones(2, 4) * (hvd.rank() + 1)
+        loss = model(x).sum()
+        opt.zero_grad()
+        loss.backward()
+        opt.step()
+        # grad of w for rank r is sum over batch of x = 2*(r+1) per col;
+        # average over ranks = 2 * mean(r+1)
+        expected = 2.0 * np.mean([r + 1 for r in range(NP)])
+        g = model.weight.grad.numpy()
+        assert np.allclose(g, expected), g
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_distributed_optimizer_training_converges(hvd_shutdown):
+    def fn():
+        torch.manual_seed(42)
+        model = torch.nn.Sequential(
+            torch.nn.Linear(2, 8), torch.nn.Tanh(), torch.nn.Linear(8, 1))
+        hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters())
+        # each rank sees a different slice of y = x0 + 2*x1
+        gen = torch.Generator().manual_seed(hvd.rank())
+        x = torch.randn(64, 2, generator=gen)
+        y = (x[:, :1] + 2 * x[:, 1:])
+        first = None
+        for _ in range(30):
+            opt.zero_grad()
+            loss = torch.nn.functional.mse_loss(model(x), y)
+            loss.backward()
+            opt.step()
+            if first is None:
+                first = loss.item()
+        assert loss.item() < first * 0.2
+        # all ranks end with identical weights
+        w = torch.cat([p.detach().flatten()
+                       for p in model.parameters()]).numpy()
+        gathered = hvd.allgather(torch.from_numpy(w).reshape(1, -1)).numpy()
+        assert np.allclose(gathered, np.tile(gathered[0], (NP, 1)),
+                           atol=1e-6)
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_distributed_optimizer_backward_passes_per_step(hvd_shutdown):
+    def fn():
+        model = torch.nn.Linear(2, 1, bias=False)
+        with torch.no_grad():
+            model.weight.fill_(0.0)
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.0),
+            named_parameters=model.named_parameters(),
+            backward_passes_per_step=2)
+        for i in range(2):
+            loss = model(torch.ones(1, 2) * (hvd.rank() + 1 + i)).sum()
+            loss.backward()
+        opt.step()
+        # accumulated two backward passes then averaged across ranks
+        expected = np.mean([(r + 1) + (r + 2) for r in range(NP)])
+        assert np.allclose(model.weight.grad.numpy(), expected)
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_distributed_optimizer_grouped(hvd_shutdown):
+    def fn():
+        model = torch.nn.Sequential(torch.nn.Linear(3, 3),
+                                    torch.nn.Linear(3, 1))
+        hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.05),
+            named_parameters=model.named_parameters(), groups=2)
+        x = torch.randn(8, 3, generator=torch.Generator().manual_seed(
+            hvd.rank()))
+        loss = model(x).pow(2).mean()
+        loss.backward()
+        opt.step()
+        w = torch.cat([p.detach().flatten()
+                       for p in model.parameters()]).numpy()
+        gathered = hvd.allgather(torch.from_numpy(w).reshape(1, -1)).numpy()
+        assert np.allclose(gathered, np.tile(gathered[0], (NP, 1)),
+                           atol=1e-6)
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_fp16_compression(hvd_shutdown):
+    def fn():
+        t = torch.randn(16, generator=torch.Generator().manual_seed(1))
+        comp, ctx = hvd.Compression.fp16.compress(t)
+        assert comp.dtype == torch.bfloat16
+        out = hvd.Compression.fp16.decompress(comp, ctx)
+        assert out.dtype == torch.float32
+        assert torch.allclose(out, t, atol=0.01)
+        return True
+
+    assert all(run_ranks(fn, 1))
+
+
+def test_sync_batch_norm(hvd_shutdown):
+    def fn():
+        bn = hvd.SyncBatchNorm(3, momentum=1.0)
+        bn.train()
+        # rank-dependent data; global batch = concat over ranks
+        g = torch.Generator().manual_seed(hvd.rank())
+        x = torch.randn(4, 3, 2, generator=g, requires_grad=True)
+        out = bn(x)
+        out.sum().backward()
+        assert x.grad is not None
+        return bn.running_mean.numpy()
+
+    means = run_ranks(fn)
+    # running stats identical across ranks (global stats)
+    for m in means[1:]:
+        assert np.allclose(m, means[0], atol=1e-6)
+
+
+def test_sync_batch_norm_matches_global_batch(hvd_shutdown):
+    xs = [torch.randn(4, 3, generator=torch.Generator().manual_seed(r))
+          for r in range(NP)]
+
+    def fn():
+        bn = hvd.SyncBatchNorm(3, momentum=1.0, affine=False)
+        bn.train()
+        out = bn(xs[hvd.rank()])
+        return out.detach().numpy()
+
+    outs = run_ranks(fn)
+    # reference: plain BN over the concatenated global batch
+    bn_ref = torch.nn.BatchNorm1d(3, momentum=1.0, affine=False)
+    bn_ref.train()
+    ref = bn_ref(torch.cat(xs)).detach().numpy()
+    got = np.concatenate(outs)
+    assert np.allclose(got, ref, atol=1e-5), np.abs(got - ref).max()
+
+
+def test_torch_state_save_restore(hvd_shutdown):
+    def fn():
+        model = torch.nn.Linear(2, 2)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        state = hvd.elastic.TorchState(model=model, optimizer=opt,
+                                       batch=0, epoch=0)
+        state.epoch = 5
+        state.commit()
+        w0 = model.weight.detach().clone()
+        with torch.no_grad():
+            model.weight.fill_(123.0)
+        state.epoch = 9
+        state.restore()
+        assert torch.allclose(model.weight, w0)
+        assert state.epoch == 5
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_elastic_sampler(hvd_shutdown):
+    def fn():
+        data = list(range(20))
+        sampler = hvd.elastic.ElasticSampler(data, shuffle=False)
+        assert len(sampler) == 5          # 20 / 4 ranks
+        idx = list(iter(sampler))
+        sampler.record_batch(0, 2)
+        sd = sampler.state_dict()
+        assert len(sd["processed_indices"]) == 2
+        return idx
+
+    per_rank = run_ranks(fn)
+    covered = set()
+    for idx in per_rank:
+        covered.update(idx)
+    assert covered == set(range(20))

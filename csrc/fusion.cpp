@@ -1,0 +1,58 @@
+// Native host-path kernels for horovod_tpu.
+//
+// TPU-native counterpart of the reference's batched-D2D CUDA kernels
+// (/root/reference/horovod/common/ops/cuda/cuda_kernels.cu:27-292:
+// batched memcpy + fused scale for fusion buffers).  On TPU the
+// device-side gather/scatter is XLA's job; what remains hot on the
+// host is packing hundreds of gradient tensors into one fusion buffer
+// per rank before the single H2D transfer, and unpacking afterwards.
+// A Python loop over numpy slices pays interpreter + dispatch cost per
+// tensor; this batches the whole bucket into one native call.
+//
+// Build: csrc/Makefile -> horovod_tpu/_native/libhvdnative.so
+// Binding: ctypes (horovod_tpu/core/native.py), with a numpy fallback.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Copy n buffers (sizes[i] bytes each) into contiguous dst at
+// offsets[i].  One call per fusion bucket per rank.
+void hvd_pack(const void** srcs, const int64_t* sizes,
+              const int64_t* offsets, int64_t n, char* dst) {
+  for (int64_t i = 0; i < n; ++i) {
+    std::memcpy(dst + offsets[i], srcs[i],
+                static_cast<size_t>(sizes[i]));
+  }
+}
+
+// Inverse: scatter contiguous src back out to n buffers.
+void hvd_unpack(const char* src, const int64_t* sizes,
+                const int64_t* offsets, int64_t n, void** dsts) {
+  for (int64_t i = 0; i < n; ++i) {
+    std::memcpy(dsts[i], src + offsets[i],
+                static_cast<size_t>(sizes[i]));
+  }
+}
+
+// Fused scale for f32 buffers (reference ScaleBufferCudaImpl): used by
+// host-side pre/post scaling paths that avoid an extra XLA program.
+void hvd_scale_f32(float* buf, int64_t n, float factor) {
+  for (int64_t i = 0; i < n; ++i) {
+    buf[i] *= factor;
+  }
+}
+
+// Readiness bitvector ops for the controller fast path (reference
+// response_cache.h CacheCoordinator bitvector AND/OR): word-wise
+// AND/OR of n 64-bit words.
+void hvd_bitand(uint64_t* acc, const uint64_t* other, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) acc[i] &= other[i];
+}
+
+void hvd_bitor(uint64_t* acc, const uint64_t* other, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) acc[i] |= other[i];
+}
+
+}  // extern "C"

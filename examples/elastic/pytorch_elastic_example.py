@@ -1,0 +1,47 @@
+"""Elastic torch training (reference examples/elastic/pytorch/):
+state commit/restore/sync with TorchState; run under
+  python -m horovod_tpu.runner.launch -np 2 --min-np 1 --max-np 4 \
+      --host-discovery-script ./discover.sh --cpu -- python this_file.py
+"""
+
+import torch
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+hvd.init()
+
+torch.manual_seed(0)
+model = torch.nn.Linear(8, 2)
+optimizer = hvd.DistributedOptimizer(
+    torch.optim.SGD(model.parameters(), lr=0.01),
+    named_parameters=model.named_parameters())
+hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+
+@hvd.elastic.run
+def train(state):
+    while state.epoch < 5:
+        for batch in range(state.batch, 10):
+            data = torch.randn(16, 8)
+            target = torch.randint(0, 2, (16,))
+            optimizer.zero_grad()
+            loss = F.cross_entropy(model(data), target)
+            loss.backward()
+            optimizer.step()
+            state.batch = batch
+            if batch % 5 == 0:
+                state.commit()
+        state.epoch += 1
+        state.batch = 0
+        state.commit()
+        if hvd.rank() == 0:
+            print(f"epoch {state.epoch} size {hvd.size()} "
+                  f"loss {loss.item():.4f}")
+
+
+state = hvd.elastic.TorchState(model=model, optimizer=optimizer,
+                               epoch=0, batch=0)
+train(state)
+if hvd.rank() == 0:
+    print("elastic training complete")

@@ -1,0 +1,96 @@
+"""Synthetic benchmark for the torch frontend (reference
+``examples/pytorch/pytorch_synthetic_benchmark.py``: same flags, same
+protocol — img/sec over timed iterations of a DistributedOptimizer
+step on random data).
+
+Run single-host:  python examples/pytorch/pytorch_synthetic_benchmark.py
+Run multi-proc:   python -m horovod_tpu.runner.launch -np 4 --cpu -- \
+                      python examples/pytorch/pytorch_synthetic_benchmark.py
+"""
+
+import argparse
+import timeit
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+parser = argparse.ArgumentParser()
+parser.add_argument("--batch-size", type=int, default=32)
+parser.add_argument("--num-iters", type=int, default=10)
+parser.add_argument("--num-batches-per-iter", type=int, default=10)
+parser.add_argument("--num-warmup-batches", type=int, default=10)
+parser.add_argument("--fp16-allreduce", action="store_true",
+                    help="use 16-bit compression on the wire")
+parser.add_argument("--use-adasum", action="store_true")
+parser.add_argument("--tiny", action="store_true",
+                    help="use a small MLP instead of a conv net (CI)")
+
+
+class SmallConvNet(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, 32, 3, padding=1)
+        self.conv2 = nn.Conv2d(32, 64, 3, padding=1, stride=2)
+        self.fc = nn.Linear(64 * 16 * 16, 10)
+
+    def forward(self, x):
+        x = F.relu(self.conv1(x))
+        x = F.relu(self.conv2(x))
+        return self.fc(x.flatten(1))
+
+
+def main():
+    args = parser.parse_args()
+    hvd.init()
+
+    torch.manual_seed(42)
+    if args.tiny:
+        model = nn.Sequential(nn.Linear(64, 128), nn.ReLU(),
+                              nn.Linear(128, 10))
+        data = torch.randn(args.batch_size, 64)
+    else:
+        model = SmallConvNet()
+        data = torch.randn(args.batch_size, 3, 32, 32)
+    target = torch.randint(0, 10, (args.batch_size,))
+
+    compression = hvd.Compression.fp16 if args.fp16_allreduce \
+        else hvd.Compression.none
+    optimizer = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.01),
+        named_parameters=model.named_parameters(),
+        compression=compression,
+        op=hvd.Adasum if args.use_adasum else hvd.Average)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    def benchmark_step():
+        optimizer.zero_grad()
+        loss = F.cross_entropy(model(data), target)
+        loss.backward()
+        optimizer.step()
+
+    timeit.timeit(benchmark_step, number=args.num_warmup_batches)
+
+    img_secs = []
+    for _ in range(args.num_iters):
+        t = timeit.timeit(benchmark_step,
+                          number=args.num_batches_per_iter)
+        img_sec = args.batch_size * args.num_batches_per_iter / t
+        if hvd.rank() == 0:
+            print(f"Iter: {img_sec:.1f} img/sec per rank")
+        img_secs.append(img_sec)
+
+    if hvd.rank() == 0:
+        mean = np.mean(img_secs)
+        print(f"Img/sec per rank: {mean:.1f} +- "
+              f"{1.96 * np.std(img_secs):.1f}")
+        print(f"Total img/sec on {hvd.size()} rank(s): "
+              f"{mean * hvd.size():.1f}")
+
+
+if __name__ == "__main__":
+    main()

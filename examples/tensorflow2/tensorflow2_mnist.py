@@ -1,0 +1,56 @@
+"""TF2 MNIST-style training with DistributedGradientTape (reference
+``examples/tensorflow2/tensorflow2_mnist.py`` — the SURVEY §7 step-2
+minimum-slice workload; synthetic data keeps it network-free)."""
+
+import argparse
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+
+parser = argparse.ArgumentParser()
+parser.add_argument("--steps", type=int, default=20)
+parser.add_argument("--batch-size", type=int, default=32)
+
+
+def main():
+    args = parser.parse_args()
+    hvd.init()
+
+    tf.keras.utils.set_random_seed(42 + hvd.rank())
+    model = tf.keras.Sequential([
+        tf.keras.layers.Dense(128, activation="relu"),
+        tf.keras.layers.Dense(10),
+    ])
+    model.build((None, 784))
+    opt = tf.keras.optimizers.SGD(0.01 * hvd.size())
+
+    # synthetic "MNIST"
+    x = tf.random.normal((args.batch_size, 784))
+    y = tf.random.uniform((args.batch_size,), 0, 10, tf.int64)
+
+    first = True
+    for step in range(args.steps):
+        with hvd.DistributedGradientTape() as tape:
+            logits = model(x, training=True)
+            loss = tf.reduce_mean(
+                tf.keras.losses.sparse_categorical_crossentropy(
+                    y, logits, from_logits=True))
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        if first:
+            # broadcast initial state after the first step so optimizer
+            # slots exist (reference tensorflow2_mnist.py pattern)
+            hvd.broadcast_variables(model.variables, root_rank=0)
+            hvd.broadcast_variables(opt.variables, root_rank=0)
+            first = False
+        if step % 5 == 0 and hvd.rank() == 0:
+            print(f"step {step} loss {float(loss):.4f}")
+
+    if hvd.rank() == 0:
+        print("done")
+
+
+if __name__ == "__main__":
+    main()

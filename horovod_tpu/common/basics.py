@@ -21,6 +21,42 @@ _engine = None
 _topology = None
 _timeline = None
 _tls = threading.local()
+_distributed_up = False
+_elastic_round = 0
+
+
+def _elastic_rendezvous(rdv_addr, rdv_port, secret):
+    """Fetch this worker's rank/size/coordinator for the next elastic
+    round from the launcher's KV store (reference: rank/size re-fetched
+    from the rendezvous server on every reset,
+    gloo_context.cc:168-206)."""
+    import json
+    import time as _time
+    from ..runner.http.http_client import StoreClient
+
+    global _elastic_round
+    client = StoreClient(rdv_addr, rdv_port, secret)
+    identity = (f"{env_mod.get_str(env_mod.HOROVOD_HOSTNAME, 'localhost')}"
+                f":{env_mod.get_int(env_mod.HOROVOD_LOCAL_RANK, 0)}")
+    deadline = _time.monotonic() + env_mod.get_float(
+        "HOROVOD_ELASTIC_TIMEOUT", 600.0)
+    while _time.monotonic() < deadline:
+        raw = client.get("/elastic/round", wait=10.0)
+        if raw is None:
+            continue
+        info = json.loads(raw)
+        if info["round"] <= _elastic_round:
+            _time.sleep(0.2)
+            continue
+        if identity not in info["assignments"]:
+            # not part of this round (e.g. blacklisted); keep waiting —
+            # the driver terminates us if we stay unassigned
+            _time.sleep(0.5)
+            continue
+        _elastic_round = info["round"]
+        return (info["assignments"][identity], info["size"],
+                info["coordinator"], info["round"])
+    raise HorovodInitError("timed out waiting for elastic rendezvous")
 
 
 class RankContext:
@@ -82,23 +118,33 @@ def init(comm=None, process_sets=None, num_ranks=None, devices=None):
             from ..core.store_controller import StoreController
             import jax
 
-            proc_id = env_mod.get_int(env_mod.HOROVOD_TPU_PROC_INDEX, 0)
-            num_procs = env_mod.get_int(env_mod.HOROVOD_TPU_NUM_PROCS, 1)
-            coordinator = env_mod.get_str(env_mod.HOROVOD_TPU_COORDINATOR)
             rdv_addr = env_mod.get_str(env_mod.HOROVOD_RENDEZVOUS_ADDR,
                                        "127.0.0.1")
             rdv_port = env_mod.get_int(env_mod.HOROVOD_RENDEZVOUS_PORT, 0)
             secret = env_mod.get_str("HOROVOD_SECRET_KEY")
             secret = bytes.fromhex(secret) if secret else None
+            round_id = 0
+            if env_mod.get_bool("HOROVOD_ELASTIC"):
+                proc_id, num_procs, coordinator, round_id = \
+                    _elastic_rendezvous(rdv_addr, rdv_port, secret)
+            else:
+                proc_id = env_mod.get_int(env_mod.HOROVOD_TPU_PROC_INDEX, 0)
+                num_procs = env_mod.get_int(env_mod.HOROVOD_TPU_NUM_PROCS, 1)
+                coordinator = env_mod.get_str(
+                    env_mod.HOROVOD_TPU_COORDINATOR)
             if num_procs > 1 and coordinator:
                 jax.distributed.initialize(
                     coordinator_address=coordinator,
-                    num_processes=num_procs, process_id=proc_id)
+                    num_processes=num_procs, process_id=proc_id,
+                    initialization_timeout=env_mod.get_int(
+                        "HOROVOD_TPU_INIT_TIMEOUT", 60))
+                global _distributed_up
+                _distributed_up = True
             global_size = num_procs * num_ranks
             rank_offset = proc_id * num_ranks
             controller = StoreController(
                 rdv_addr, rdv_port, secret, proc_id, num_procs,
-                num_ranks)
+                num_ranks, round_id=round_id)
             if devices is None:
                 import jax as _jax
                 devices = _jax.devices()
@@ -178,9 +224,21 @@ def is_initialized():
     return _engine is not None
 
 
+def needs_exec_restart():
+    """True when recovery requires a fresh process: the runtime aborted
+    (peer death / stale round) while jax.distributed was live — the
+    coordination client cannot be cleanly re-initialized in-process
+    and will fatally terminate us on its next heartbeat."""
+    return _engine is not None and _engine._aborted is not None \
+        and _distributed_up
+
+
 def shutdown():
-    """Reference horovod_shutdown (operations.cc:966)."""
-    global _engine, _topology, _timeline
+    """Reference horovod_shutdown (operations.cc:966).  In
+    multi-process mode also tears down jax.distributed and clears the
+    cached XLA backends so a later init() can re-form the mesh with a
+    different world (elastic re-rendezvous, SURVEY §7.7)."""
+    global _engine, _topology, _timeline, _distributed_up
     with _state_lock:
         if _engine is None:
             return
@@ -189,10 +247,36 @@ def shutdown():
             _timeline.close()
         from . import process_sets as ps_mod
         ps_mod._reset()
+        was_multiproc = _engine.multiproc
+        was_aborted = _engine._aborted is not None
         _engine = None
         _topology = None
         _timeline = None
         _tls.ctx = None
+        if _distributed_up:
+            if not was_aborted:
+                # clean teardown: every peer participates in the
+                # coordination-service shutdown barrier
+                import jax
+                try:
+                    jax.distributed.shutdown()
+                except Exception:  # noqa: BLE001 — peers may be gone
+                    pass
+            # aborted: a peer is dead — the shutdown barrier would
+            # LOG(FATAL) this process.  Leave the client; the elastic
+            # loop exec-restarts the process instead (see
+            # elastic.run / needs_exec_restart).
+            _distributed_up = False
+        if was_multiproc:
+            # clear cached XLA backends even when this round ran
+            # single-process (size-1 elastic rounds): the next round may
+            # need jax.distributed.initialize, which requires no live
+            # backend
+            try:
+                import jax.extend.backend as _xb
+                _xb.clear_backends()
+            except Exception:  # noqa: BLE001
+                pass
 
 
 # -- topology queries (reference operations.cc:996-1075) -----------------------

@@ -9,10 +9,28 @@ mesh must be rebuilt, so reset() tears the engine down and re-inits.
 """
 
 import functools
+import os
+import pickle
 import queue
+import tempfile
 
 from . import basics
 from .exceptions import HorovodInternalError, HostsUpdatedInterrupt
+
+
+def _spill_path():
+    """Per-worker state spill file.  The elastic driver sets
+    ``HOROVOD_STATE_SPILL`` to a job directory; committed state is
+    mirrored there so recovery survives even a *process* restart —
+    needed on TPU because a peer's death fatally terminates the jax
+    distributed client in survivors (coordination-service heartbeat),
+    where the reference's NCCL failures are catchable in-process."""
+    d = os.environ.get("HOROVOD_STATE_SPILL")
+    if not d:
+        return None
+    host = os.environ.get("HOROVOD_HOSTNAME", "localhost")
+    slot = os.environ.get("HOROVOD_LOCAL_RANK", "0")
+    return os.path.join(d, f"state_{host}_{slot}.pkl")
 
 
 class State:
@@ -23,6 +41,7 @@ class State:
         self._host_messages = queue.Queue()
         self._last_updated_timestamp = 0
         self._reset_callbacks = []
+        self._maybe_unspill()
 
     def register_reset_callbacks(self, callbacks):
         self._reset_callbacks.extend(callbacks)
@@ -40,7 +59,40 @@ class State:
         """Save and check for pending host updates (the reference
         commits then raises HostsUpdatedInterrupt at a safe point)."""
         self.save()
+        self._spill()
         self.check_host_updates()
+
+    # -- crash-durable spill ------------------------------------------------
+
+    def _spill_payload(self):
+        return None
+
+    def _load_spill(self, payload):
+        pass
+
+    def _spill(self):
+        path = _spill_path()
+        payload = self._spill_payload()
+        if path is None or payload is None:
+            return
+        tmp = None
+        try:
+            fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+            with os.fdopen(fd, "wb") as f:
+                pickle.dump(payload, f)
+            os.replace(tmp, path)
+        except Exception:  # noqa: BLE001 — spill is best-effort
+            if tmp and os.path.exists(tmp):
+                os.unlink(tmp)
+
+    def _maybe_unspill(self):
+        path = _spill_path()
+        if path and os.path.exists(path):
+            try:
+                with open(path, "rb") as f:
+                    self._load_spill(pickle.load(f))
+            except Exception:  # noqa: BLE001 — corrupt spill: start fresh
+                pass
 
     def check_host_updates(self):
         """Raise HostsUpdatedInterrupt if the driver pushed membership
@@ -95,10 +147,18 @@ class ObjectState(State):
         if self._saved_state:
             self._saved_state = self._bcast_object(self._saved_state)
             self._set_attrs()
+            self._spill()
 
     def _set_attrs(self):
         for attr, value in self._saved_state.items():
             setattr(self, attr, value)
+
+    def _spill_payload(self):
+        return {"saved_state": self._saved_state}
+
+    def _load_spill(self, payload):
+        self._saved_state.update(payload.get("saved_state", {}))
+        self._set_attrs()
 
 
 def run_fn(func, reset):
@@ -112,11 +172,14 @@ def run_fn(func, reset):
         skip_sync = False
         try:
             while True:
-                if not skip_sync:
-                    state.sync()
                 try:
+                    if not skip_sync:
+                        state.sync()
                     return func(state, *args, **kwargs)
                 except HorovodInternalError:
+                    # comm failure (peer died / stale round): roll back
+                    # to the last commit — covers failures inside
+                    # sync() too, which the reference leaves uncaught
                     state.restore()
                     skip_sync = False
                 except HostsUpdatedInterrupt as e:

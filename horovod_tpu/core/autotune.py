@@ -1,0 +1,113 @@
+"""Runtime parameter autotuning (reference
+``horovod/common/parameter_manager.{h,cc}``: score = bytes/sec over
+sample windows, warmup discard, Bayesian optimization over tunables,
+CSV log via HOROVOD_AUTOTUNE_LOG, converge-to-best after max samples).
+
+Tunables here are the two that exist on the TPU engine: the fusion
+threshold (bucket size for packed allreduces) and the cycle time (how
+long the background thread batches submissions).  The reference's
+hierarchical/torus toggles have no analogue — topology-aware routing
+belongs to XLA.
+"""
+
+import time
+
+import numpy as np
+
+from .optim import BayesianOptimizer
+
+# log2 bounds: fusion threshold 1 MiB .. 256 MiB, cycle 0.5 .. 32 ms
+_FUSION_LO, _FUSION_HI = 20.0, 28.0
+_CYCLE_LO, _CYCLE_HI = -1.0, 5.0
+
+
+class ParameterManager:
+    def __init__(self, config, warmup_samples=3, steps_per_sample=10,
+                 max_samples=20, log_path=None, seed=0):
+        self.config = config
+        self.warmup_samples = warmup_samples
+        self.steps_per_sample = steps_per_sample
+        self.max_samples = max_samples
+        self.active = True
+        self._bo = BayesianOptimizer(dims=2, seed=seed)
+        self._samples = 0
+        self._steps = 0
+        self._bytes = 0
+        self._t0 = None
+        self._current = self._encode(config.fusion_threshold_bytes,
+                                     config.cycle_time_ms)
+        self._best_score = -np.inf
+        self._best = self._current
+        self._log = open(log_path, "w") if log_path else None
+        if self._log:
+            self._log.write(
+                "sample,fusion_threshold_bytes,cycle_time_ms,score_bytes_per_sec\n")
+
+    # -- encoding ------------------------------------------------------------
+
+    @staticmethod
+    def _encode(fusion_bytes, cycle_ms):
+        x0 = (np.log2(max(fusion_bytes, 1)) - _FUSION_LO) / \
+            (_FUSION_HI - _FUSION_LO)
+        x1 = (np.log2(max(cycle_ms, 2 ** _CYCLE_LO)) - _CYCLE_LO) / \
+            (_CYCLE_HI - _CYCLE_LO)
+        return np.clip([x0, x1], 0.0, 1.0)
+
+    @staticmethod
+    def _decode(x):
+        fusion = int(2 ** (_FUSION_LO + x[0] * (_FUSION_HI - _FUSION_LO)))
+        cycle = float(2 ** (_CYCLE_LO + x[1] * (_CYCLE_HI - _CYCLE_LO)))
+        return fusion, cycle
+
+    # -- recording (engine hot path) ----------------------------------------
+
+    def record_bytes(self, nbytes):
+        """One fused collective completed (reference
+        ParameterManager::Update counts tensor bytes per step)."""
+        if not self.active:
+            return
+        if self._t0 is None:
+            self._t0 = time.monotonic()
+        self._bytes += nbytes
+        self._steps += 1
+        if self._steps >= self.steps_per_sample:
+            self._finish_sample()
+
+    def _finish_sample(self):
+        elapsed = max(time.monotonic() - self._t0, 1e-6)
+        score = self._bytes / elapsed
+        self._samples += 1
+        if self._log:
+            fusion, cycle = self._decode(self._current)
+            self._log.write(
+                f"{self._samples},{fusion},{cycle:.3f},{score:.1f}\n")
+            self._log.flush()
+        if self._samples > self.warmup_samples:
+            self._bo.observe(self._current, score)
+            if score > self._best_score:
+                self._best_score = score
+                self._best = self._current
+        if self._samples >= self.max_samples:
+            # converge: pin best parameters, stop tuning (reference
+            # parameter_manager.cc final tuning state)
+            self._apply(self._best)
+            self.active = False
+        else:
+            self._current = self._bo.suggest()
+            self._apply(self._current)
+        self._steps = 0
+        self._bytes = 0
+        self._t0 = None
+
+    def _apply(self, x):
+        fusion, cycle = self._decode(x)
+        self.config.fusion_threshold_bytes = fusion
+        self.config.cycle_time_ms = cycle
+
+    def best_parameters(self):
+        return self._decode(self._best)
+
+    def close(self):
+        if self._log:
+            self._log.close()
+            self._log = None

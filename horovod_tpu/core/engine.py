@@ -128,6 +128,18 @@ class Engine:
         self.process_sets: Dict[int, ProcessSetState] = {0: ps0}
         self._next_ps_id = 1
 
+        self.autotuner = None
+        if self.config.autotune and controller is None:
+            # autotune is per-process; in multi-process mode fusion is
+            # the coordinator's decision (reference: coordinator tunes,
+            # SynchronizeParameters broadcasts — a future round)
+            from .autotune import ParameterManager
+            self.autotuner = ParameterManager(
+                self.config,
+                warmup_samples=self.config.autotune_warmup_samples,
+                steps_per_sample=self.config.autotune_steps_per_sample,
+                log_path=self.config.autotune_log)
+
         self._stall_warned = set()
         self._thread = threading.Thread(
             target=self._background_loop, name="horovod_tpu-engine",
@@ -183,9 +195,9 @@ class Engine:
         with self._lock:
             for ps in self.process_sets.values():
                 if ps.ranks == ranks:
-                    raise ValueError(
-                        f"process set with ranks {ranks} already exists "
-                        f"(id {ps.id})")
+                    # every rank registers the same set (SPMD pattern);
+                    # re-registration returns the existing id
+                    return ps.id
             ps_id = self._next_ps_id
             self._next_ps_id += 1
             self.process_sets[ps_id] = self._make_process_set_state(
@@ -295,8 +307,9 @@ class Engine:
     # background loop
 
     def _background_loop(self):
-        cycle = max(self.config.cycle_time_ms, 0.05) / 1000.0
         while True:
+            # re-read each iteration: the autotuner adjusts cycle time
+            cycle = max(self.config.cycle_time_ms, 0.05) / 1000.0
             with self._lock:
                 if not self._shutdown:
                     self._lock.wait(timeout=cycle)
@@ -742,16 +755,28 @@ class Engine:
                 layout.append((entry, i, offset, int(p.size), p.shape))
                 offset += int(p.size)
         total = offset
+        from . import native
+        itemsize = dtype.itemsize
         rows = []
         for r in ps.local_ranks:
-            buf = np.zeros(total, dtype=dtype)
+            arrays, offs_bytes, missing = [], [], False
             for entry, i, off, size, _ in layout:
                 sub = entry.subs.get(r)
-                if sub is not None:      # joined ranks contribute zeros
-                    buf[off:off + size] = sub.payloads[i].ravel()
+                if sub is not None:
+                    arrays.append(sub.payloads[i].ravel())
+                    offs_bytes.append(off * itemsize)
+                else:                    # joined ranks contribute zeros
+                    missing = True
+            buf = np.zeros(total, dtype=dtype) if missing else \
+                np.empty(total, dtype=dtype)
+            # one native batched memcpy per rank per bucket (the
+            # reference's batched-D2D kernel, cuda_kernels.cu:27-292)
+            native.pack(arrays, buf, offs_bytes)
             rows.append(buf)
         results = ps.executor.allreduce(
             rows, op, first.prescale_factor, first.postscale_factor)
+        if self.autotuner is not None:
+            self.autotuner.record_bytes(total * dtype.itemsize)
         by_rank = dict(zip(ps.local_ranks, results))
         # single pass over layout, grouping outputs per (entry, rank)
         per_entry = {}
@@ -926,6 +951,8 @@ class Engine:
             self._shutdown = True
             self._lock.notify_all()
         self._shutdown_done.wait(timeout=30)
+        if self.autotuner is not None:
+            self.autotuner.close()
 
 
 def _bfloat16_dtype():

@@ -1,0 +1,113 @@
+"""ctypes binding for the native host-path library (csrc/fusion.cpp).
+
+The reference binds its native core with ctypes the same way
+(``horovod/common/basics.py:29`` loads the shared lib).  If the
+library is missing it is built once with g++ (the toolchain is part of
+the image); failing that, a numpy fallback keeps everything working.
+"""
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+logger = logging.getLogger("horovod_tpu")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_LIB_PATH = os.path.join(_PKG_DIR, "_native", "libhvdnative.so")
+_SRC_PATH = os.path.join(os.path.dirname(_PKG_DIR), "csrc", "fusion.cpp")
+
+
+def _build():
+    os.makedirs(os.path.dirname(_LIB_PATH), exist_ok=True)
+    # compile to a per-process temp file and rename atomically so
+    # concurrently launched workers never dlopen a half-written .so
+    tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-fPIC", "-std=c++17", "-shared",
+           "-o", tmp, _SRC_PATH]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB_PATH)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def get_lib():
+    """Load (building if needed) the native lib; None on failure."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            if not os.path.exists(_LIB_PATH) and os.path.exists(_SRC_PATH):
+                _build()
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.hvd_pack.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64, ctypes.c_char_p]
+            lib.hvd_unpack.argtypes = [
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_void_p)]
+            _lib = lib
+        except Exception as exc:  # noqa: BLE001 — fall back to numpy
+            logger.info("native lib unavailable (%s); using numpy "
+                        "fallback", exc)
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def pack(arrays, dst: np.ndarray, offsets_bytes) -> None:
+    """Pack flat arrays into the contiguous dst buffer at byte offsets
+    (one native call per fusion bucket; reference batched-D2D)."""
+    lib = get_lib()
+    n = len(arrays)
+    if lib is None or n == 0:
+        for a, off in zip(arrays, offsets_bytes):
+            nb = a.nbytes
+            dst.view(np.uint8)[off:off + nb] = \
+                np.ascontiguousarray(a).view(np.uint8).ravel()
+        return
+    arrays = [np.ascontiguousarray(a) for a in arrays]
+    srcs = (ctypes.c_void_p * n)(
+        *[a.ctypes.data for a in arrays])
+    sizes = (ctypes.c_int64 * n)(*[a.nbytes for a in arrays])
+    offs = (ctypes.c_int64 * n)(*offsets_bytes)
+    lib.hvd_pack(srcs, sizes, offs, n,
+                 dst.ctypes.data_as(ctypes.c_char_p))
+
+
+def unpack(src: np.ndarray, arrays, offsets_bytes) -> None:
+    """Scatter the contiguous src buffer back into writable arrays."""
+    lib = get_lib()
+    n = len(arrays)
+    if lib is None or n == 0:
+        for a, off in zip(arrays, offsets_bytes):
+            nb = a.nbytes
+            a.view(np.uint8).ravel()[:] = \
+                src.view(np.uint8)[off:off + nb]
+        return
+    for a in arrays:
+        assert a.flags["C_CONTIGUOUS"] and a.flags["WRITEABLE"]
+    dsts = (ctypes.c_void_p * n)(*[a.ctypes.data for a in arrays])
+    sizes = (ctypes.c_int64 * n)(*[a.nbytes for a in arrays])
+    offs = (ctypes.c_int64 * n)(*offsets_bytes)
+    lib.hvd_unpack(src.ctypes.data_as(ctypes.c_char_p),
+                   sizes, offs, n, dsts)

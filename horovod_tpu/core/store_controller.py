@@ -13,19 +13,28 @@ explicit NCCL communicator synchronization.
 
 import threading
 
+from ..common.exceptions import HorovodInternalError
 from ..runner.http.http_client import StoreClient
+
+
+class StaleRoundError(HorovodInternalError):
+    """The coordinator moved to a new elastic round: every in-flight
+    collective of the old round must fail so workers fall into the
+    restore/re-rendezvous path instead of hanging (reference: gloo
+    context failure -> HorovodInternalError -> state.restore)."""
 
 
 class StoreController:
     """One per worker process in multi-process jobs."""
 
     def __init__(self, addr, port, secret, proc_id, num_procs,
-                 nlocal, poll_wait=5.0):
+                 nlocal, poll_wait=5.0, round_id=0):
         self.client = StoreClient(addr, port, secret)
         self.proc_id = proc_id
         self.num_procs = num_procs
         self.nlocal = nlocal
         self.poll_wait = poll_wait
+        self.round_id = round_id
         self._cursor = 0
         self._reported = set()
         self._lock = threading.Lock()
@@ -41,15 +50,22 @@ class StoreController:
                     self._reported.add(m["key"])
                     fresh.append(m)
         if fresh:
-            self.client.coord("ready", {
+            out = self.client.coord("ready", {
                 "proc": self.proc_id, "nlocal": self.nlocal,
-                "entries": fresh})
+                "round": self.round_id, "entries": fresh})
+            if out.get("stale"):
+                raise StaleRoundError(
+                    f"coordinator moved to round {out.get('round')}")
 
     def report_join(self, ps_id, rank, ps_size, proc_members=1):
-        self.client.coord("join", {"ps": ps_id, "rank": rank,
-                                   "ps_size": ps_size,
-                                   "proc": self.proc_id,
-                                   "proc_members": proc_members})
+        out = self.client.coord("join", {"ps": ps_id, "rank": rank,
+                                         "ps_size": ps_size,
+                                         "proc": self.proc_id,
+                                         "round": self.round_id,
+                                         "proc_members": proc_members})
+        if out.get("stale"):
+            raise StaleRoundError(
+                f"coordinator moved to round {out.get('round')}")
 
     # -- polling -------------------------------------------------------------
 
@@ -57,9 +73,12 @@ class StoreController:
         """Fetch responses past the cursor; returns list of response
         dicts ({kind: batch|error|join_done, ...})."""
         out = self.client.coord(
-            "poll", {"cursor": self._cursor,
+            "poll", {"cursor": self._cursor, "round": self.round_id,
                      "wait": self.poll_wait if wait is None else wait},
             timeout=(self.poll_wait if wait is None else wait) + 30)
+        if out.get("stale"):
+            raise StaleRoundError(
+                f"coordinator moved to round {out.get('round')}")
         responses = out.get("responses", [])
         self._cursor = out.get("cursor", self._cursor)
         if responses:

@@ -1,0 +1,3 @@
+"""Data loading utilities (reference ``horovod/data/``)."""
+
+from .data_loader_base import BaseDataLoader, AsyncDataLoaderMixin  # noqa: F401

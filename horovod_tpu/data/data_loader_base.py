@@ -1,0 +1,74 @@
+"""Base data loader + async prefetch mixin (reference
+``horovod/data/data_loader_base.py:165``: BaseDataLoader +
+AsyncDataLoaderMixin with a prefetch thread)."""
+
+import queue
+import threading
+
+
+class BaseDataLoader:
+    def __len__(self):
+        raise NotImplementedError
+
+    def _iterate(self):
+        """Yield batches; subclasses implement."""
+        raise NotImplementedError
+
+    def __iter__(self):
+        return iter(self._iterate())
+
+
+class AsyncDataLoaderMixin:
+    """Prefetch batches on a background thread (reference
+    data_loader_base.py AsyncDataLoaderMixin: ``async_loading`` flag,
+    queue handoff, close() joins the thread).
+
+    On TPU hosts this overlaps host-side input processing with device
+    steps — the single-host analogue of the reference's tf.data
+    service offload.
+    """
+
+    def __init__(self, async_loading=True, queue_size=5, *args, **kwargs):
+        self.async_loading = async_loading
+        self._queue_size = queue_size
+        self._queue = None
+        self._thread = None
+        self._closing = False
+        super().__init__(*args, **kwargs)
+
+    def close_async_loader(self):
+        if self._thread is not None:
+            self._closing = True
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=10)
+            self._thread = None
+            self._closing = False
+
+    def _async_worker(self):
+        try:
+            for batch in self._iterate():
+                if self._closing:
+                    return
+                self._queue.put(batch)
+        finally:
+            self._queue.put(None)
+
+    def __iter__(self):
+        if not self.async_loading:
+            return iter(self._iterate())
+        self._queue = queue.Queue(maxsize=self._queue_size)
+        self._thread = threading.Thread(target=self._async_worker,
+                                        daemon=True)
+        self._thread.start()
+
+        def gen():
+            while True:
+                batch = self._queue.get()
+                if batch is None:
+                    break
+                yield batch
+        return gen()

@@ -1,0 +1,31 @@
+"""Elastic API surface — ``hvd.elastic`` (reference
+``horovod/common/elastic.py`` re-exported per framework)."""
+
+import os
+import sys
+
+from ..common.elastic import State, ObjectState, run_fn  # noqa: F401
+from ..common import basics
+from ..common.basics import init, shutdown
+
+
+def _reset():
+    """Tear down and re-form the mesh for the next elastic round.
+
+    Graceful membership changes re-initialize in-process.  After a
+    peer death the jax distributed client cannot survive in-process
+    (its heartbeat LOG(FATAL)s), so the worker exec-restarts itself —
+    committed state is restored from the spill file
+    (common/elastic.py _spill_path)."""
+    if basics.needs_exec_restart():
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os.execv(sys.executable, [sys.executable] + sys.argv)
+    shutdown()
+    init()
+
+
+def run(func):
+    """Elastic retry loop: on membership change or internal error,
+    re-rendezvous and continue from the last commit."""
+    return run_fn(func, _reset)

@@ -1,0 +1,36 @@
+"""MXNet frontend (reference ``horovod/mxnet/__init__.py``:
+DistributedOptimizer :44, gluon DistributedTrainer :124,
+broadcast_parameters :245).
+
+Gated: mxnet (EOL upstream) is not part of this image.  The surface is
+declared so ported scripts fail with a clear message instead of an
+AttributeError; the collective core they would bind to is the same
+framework-agnostic ops/api used by the torch/TF frontends.
+"""
+
+
+def _require_mxnet():
+    try:
+        import mxnet  # noqa: F401
+    except ImportError as exc:
+        raise ImportError(
+            "horovod_tpu.mxnet requires mxnet, which is not installed "
+            "in this environment (mxnet is EOL; prefer the torch or "
+            "tensorflow frontends)") from exc
+
+
+def init(*args, **kwargs):
+    from ..common.basics import init as _init
+    return _init(*args, **kwargs)
+
+
+def DistributedOptimizer(optimizer, *args, **kwargs):
+    _require_mxnet()
+
+
+def DistributedTrainer(params, optimizer, *args, **kwargs):
+    _require_mxnet()
+
+
+def broadcast_parameters(params, root_rank=0):
+    _require_mxnet()

@@ -1,0 +1,146 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference's custom device kernels are CUDA
+(``horovod/common/ops/cuda/cuda_kernels.cu``: batched memcpy + fused
+scale).  The TPU equivalents that XLA does NOT already fuse well:
+
+* :func:`fused_scale_cast` — one VMEM pass for the eager staging
+  path's pre/post scale + dtype cast (bf16 wire format), instead of
+  two XLA ops with an HBM round-trip between them.
+* :func:`flash_attention` — blockwise causal attention that never
+  materializes the (S, S) score matrix: streaming softmax in VMEM,
+  O(S) HBM traffic.  Used by the single-chip fast path; the
+  sequence-parallel path composes the same math with ``ppermute``
+  (parallel/ring_attention.py).
+
+Kernels run under ``interpret=True`` on CPU (tests) and compile to
+Mosaic on TPU.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def _is_tpu():
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # noqa: BLE001
+        return False
+
+
+# ---------------------------------------------------------------------------
+# fused scale + cast
+
+def _scale_cast_kernel(x_ref, o_ref, *, factor, out_dtype):
+    x = x_ref[:].astype(jnp.float32) * np.float32(factor)
+    o_ref[:] = x.astype(out_dtype)
+
+
+def fused_scale_cast(x, factor, out_dtype=None, *, block=4096,
+                     interpret=None):
+    """``(x * factor).astype(out_dtype)`` in one VMEM pass (reference
+    ScaleBufferCudaImpl, cuda_kernels.cu half2-vectorized scale)."""
+    out_dtype = out_dtype or x.dtype
+    if interpret is None:
+        interpret = not _is_tpu()
+    n = x.size
+    flat = x.reshape(-1)
+    pad = (-n) % block
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    grid = flat.size // block
+    out = pl.pallas_call(
+        functools.partial(_scale_cast_kernel,
+                          factor=float(factor),
+                          out_dtype=out_dtype),
+        out_shape=jax.ShapeDtypeStruct(flat.shape, out_dtype),
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((block,), lambda i: (i,))],
+        out_specs=pl.BlockSpec((block,), lambda i: (i,)),
+        interpret=interpret,
+    )(flat)
+    return out[:n].reshape(x.shape)
+
+
+# ---------------------------------------------------------------------------
+# flash attention (causal, forward)
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_len,
+                  scale):
+    # q_ref: (1, block_q, D); k_ref/v_ref: (1, S, D)
+    block_q = q_ref.shape[1]
+    D = q_ref.shape[2]
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * np.float32(scale)
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(kb, carry):
+        o, m, l = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = q @ k.T                                   # (bq, bk)
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = q_pos >= k_pos
+        s = jnp.where(mask, s, np.float32(_NEG_INF))
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(mask, p, np.float32(0.0))
+        l_new = l * alpha + jnp.sum(p, axis=1)
+        o_new = o * alpha[:, None] + p @ v
+        return o_new, m_new, l_new
+
+    # causal: only key blocks at or before this query block matter
+    num_kb = (qi * block_q) // block_k + 1
+    o0 = jnp.zeros((block_q, D), jnp.float32)
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    o, m, l = jax.lax.fori_loop(0, num_kb, body, (o0, m0, l0))
+    l = jnp.maximum(l, np.float32(1e-30))
+    o_ref[0] = (o / l[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, *, block_q=128, block_k=128,
+                    interpret=None):
+    """Causal attention (B, S, H, D) -> (B, S, H, D), flash-style.
+
+    Memory: O(block_q * S) VMEM per program instead of O(S^2) HBM —
+    the long-context single-chip workhorse.
+    """
+    if interpret is None:
+        interpret = not _is_tpu()
+    B, S, H, D = q.shape
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    if S % block_q or S % block_k:
+        raise ValueError(f"seq len {S} must divide blocks "
+                         f"({block_q}, {block_k})")
+    scale = 1.0 / np.sqrt(D)
+
+    # fold batch and heads into the grid's first axis
+    qf = q.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, block_k=block_k, seq_len=S,
+                          scale=scale),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        grid=(B * H, S // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)

@@ -1,0 +1,88 @@
+"""Ray integration (reference ``horovod/ray/runner.py:168`` RayExecutor,
+``ray/elastic.py:150`` ElasticRayExecutor).
+
+Gated: ray is not part of this image.  The executor contract is kept
+API-compatible; actors come up through the same rendezvous + env
+handoff as the CLI launcher.
+"""
+
+
+def _require_ray():
+    try:
+        import ray  # noqa: F401
+    except ImportError as exc:
+        raise ImportError(
+            "horovod_tpu.ray requires ray, which is not installed in "
+            "this environment") from exc
+
+
+class RayExecutor:
+    """Launch a horovod_tpu job on Ray actors (reference
+    ray/runner.py:168-420: placement strategies, per-actor env
+    handoff, run/run_remote/execute API)."""
+
+    def __init__(self, settings=None, num_workers=None,
+                 cpus_per_worker=1, use_gpu=False,
+                 placement_group_timeout_s=100, **kwargs):
+        _require_ray()
+        self.num_workers = num_workers
+        self.cpus_per_worker = cpus_per_worker
+        self._workers = []
+
+    def start(self, executable_cls=None, executable_args=None,
+              executable_kwargs=None, extra_env_vars=None):
+        import ray
+        import secrets as _secrets
+        from ..runner.http.http_server import RendezvousServer, local_ip
+
+        secret_hex = _secrets.token_hex(16)
+        self._server = RendezvousServer(
+            secret=bytes.fromhex(secret_hex),
+            world_size=self.num_workers)
+        port = self._server.start()
+        addr = local_ip()
+        import socket as _socket
+        s = _socket.socket(); s.bind(("", 0))
+        coordinator = f"{addr}:{s.getsockname()[1]}"; s.close()
+
+        @ray.remote(num_cpus=self.cpus_per_worker)
+        class Worker:
+            def __init__(self, index, env):
+                import os
+                os.environ.update(env)
+                os.environ.update({
+                    "HOROVOD_CONTROLLER": "http",
+                    "HOROVOD_GLOO_RENDEZVOUS_ADDR": addr,
+                    "HOROVOD_GLOO_RENDEZVOUS_PORT": str(port),
+                    "HOROVOD_SECRET_KEY": secret_hex,
+                    "HOROVOD_TPU_PROC_INDEX": str(index),
+                    "HOROVOD_TPU_NUM_PROCS": str(self_num),
+                    "HOROVOD_TPU_RANKS_PER_PROC": "1",
+                    "HOROVOD_TPU_COORDINATOR": coordinator,
+                })
+
+            def execute(self, fn, *a, **kw):
+                return fn(*a, **kw)
+
+        self_num = self.num_workers
+        self._workers = [
+            Worker.remote(i, extra_env_vars or {})
+            for i in range(self.num_workers)]
+
+    def run(self, fn, args=None, kwargs=None):
+        import ray
+        return ray.get([w.execute.remote(fn, *(args or ()),
+                                         **(kwargs or {}))
+                        for w in self._workers])
+
+    def execute(self, fn):
+        import ray
+        return ray.get([w.execute.remote(fn) for w in self._workers])
+
+    def shutdown(self):
+        import ray
+        for w in self._workers:
+            ray.kill(w)
+        self._workers = []
+        if getattr(self, "_server", None):
+            self._server.stop()

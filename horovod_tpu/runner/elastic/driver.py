@@ -1,0 +1,293 @@
+"""Elastic driver (reference ``horovod/runner/elastic/driver.py:69-320``
+ElasticDriver): discovery thread, rank/host assignment with ordering
+stability, worker lifecycle, blacklisting, round (re-)rendezvous.
+
+TPU adaptation: a membership change means the global device mesh must
+be re-formed, so each round publishes a fresh ``jax.distributed``
+coordinator (new port) plus the rank assignments to the KV store;
+workers tear down their runtime in-process
+(jax.distributed.shutdown + clear_backends) and re-initialize against
+the new round — state survives in memory exactly like the reference's
+gloo re-rendezvous (SURVEY §7.7's hard part, made workable).
+"""
+
+import json
+import logging
+import os
+import socket
+import subprocess
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..hosts import get_host_assignments, parse_hosts, HostInfo
+from .discovery import HostManager
+from .registration import WorkerStateRegistry
+
+logger = logging.getLogger("horovod_tpu.elastic")
+
+DISCOVER_HOSTS_FREQUENCY_SECS = 1.0
+ROUND_KEY = "/elastic/round"
+NOTIFY_KEY = "/elastic/notify"
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class ElasticDriver:
+    def __init__(self, server, discovery, min_np, max_np, command,
+                 env=None, reset_limit=None, cooldown_range=None,
+                 platform=None, verbose=False):
+        self._server = server            # RendezvousServer (KV + coord)
+        self._host_manager = HostManager(discovery, cooldown_range)
+        self._min_np = min_np
+        self._max_np = max_np
+        self._command = command
+        self._env = env or {}
+        self._platform = platform
+        self._verbose = verbose
+
+        self._registry = WorkerStateRegistry(self, self._host_manager,
+                                             reset_limit=reset_limit)
+        self._round = 0
+        self._round_started_at = 0.0
+        self._assignments: Dict[str, int] = {}
+        self._procs: Dict[str, subprocess.Popen] = {}  # "host:slot" -> p
+        self._deassigned: Dict[str, float] = {}        # key -> deadline
+        self._churn_respawns: Dict[str, int] = {}
+        self._notify_version = 0
+        # committed worker state spills here so crash recovery can
+        # restore it across process restarts
+        self._spill_dir = tempfile.mkdtemp(prefix="hvd_elastic_state_")
+
+        self._shutdown = threading.Event()
+        self._error = False
+        self._lock = threading.RLock()
+        self._discovery_thread = threading.Thread(
+            target=self._discover_hosts, daemon=True,
+            name="elastic-discovery")
+        self._monitor_thread = threading.Thread(
+            target=self._monitor_workers, daemon=True,
+            name="elastic-monitor")
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        self.wait_for_available_slots(self._min_np)
+        self._start_round()
+        self._discovery_thread.start()
+        self._monitor_thread.start()
+
+    def wait_for_available_slots(self, min_np, timeout=120):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self._host_manager.update_available_hosts()
+            if self._host_manager.current_hosts.count_available_slots() \
+                    >= min_np:
+                return
+            time.sleep(DISCOVER_HOSTS_FREQUENCY_SECS)
+        raise TimeoutError(
+            f"timed out waiting for {min_np} slots to become available")
+
+    def join(self, timeout=None) -> bool:
+        """Block until the job finishes; True on success."""
+        deadline = time.monotonic() + timeout if timeout else None
+        while not self._shutdown.is_set():
+            if deadline and time.monotonic() > deadline:
+                self.stop(error=True)
+                raise TimeoutError("elastic job timed out")
+            time.sleep(0.1)
+        self._terminate_all()
+        return not self._error
+
+    def finished(self):
+        return self._shutdown.is_set()
+
+    def stop(self, error=False):
+        with self._lock:
+            self._error = self._error or error
+            self._shutdown.set()
+
+    def resume(self):
+        """Registry decided to start a new round (some workers failed
+        or membership changed)."""
+        with self._lock:
+            if not self._shutdown.is_set():
+                self._start_round()
+
+    # -- round management ----------------------------------------------------
+
+    def _compute_assignments(self) -> List:
+        hosts = self._host_manager.current_hosts
+        host_infos = [HostInfo(h, hosts.host_slots[h])
+                      for h in hosts.host_assignment_order]
+        np = min(hosts.count_available_slots(), self._max_np)
+        return get_host_assignments(host_infos, np)
+
+    def _start_round(self):
+        with self._lock:
+            slots = self._compute_assignments()
+            if len(slots) < self._min_np:
+                logger.warning(
+                    "only %d slots available (< min_np %d); waiting",
+                    len(slots), self._min_np)
+                return
+            self._round += 1
+            self._assignments = {
+                f"{s.hostname}:{s.local_rank}": s.rank for s in slots}
+            size = len(slots)
+            coordinator = f"127.0.0.1:{_free_port()}"
+            self._registry.reset(size)
+            self._server.coordinator.reset(world_size=size,
+                                           round_id=self._round)
+            round_info = {
+                "round": self._round,
+                "size": size,
+                "coordinator": coordinator,
+                "assignments": self._assignments,
+            }
+            self._server.store.put(ROUND_KEY,
+                                   json.dumps(round_info).encode())
+            self._notify_version += 1
+            self._server.store.put(
+                NOTIFY_KEY,
+                json.dumps({"version": self._notify_version,
+                            "round": self._round}).encode())
+            logger.info("round %d: %d workers %s", self._round, size,
+                        self._assignments)
+            self._round_started_at = time.monotonic()
+            self._churn_respawns.clear()
+            # spawn processes for slots without a live worker
+            for key in self._assignments:
+                p = self._procs.get(key)
+                self._deassigned.pop(key, None)
+                if p is None or p.poll() is not None:
+                    self._spawn_worker(key)
+            # de-assigned workers get a grace period to exit cleanly
+            # (they participate in the old round's shutdown barrier,
+            # then park in rendezvous wait) before being terminated
+            for key, p in list(self._procs.items()):
+                if key not in self._assignments and p.poll() is None:
+                    self._deassigned.setdefault(
+                        key, time.monotonic() + 30.0)
+
+    def _spawn_worker(self, key):
+        host, slot = key.rsplit(":", 1)
+        env = dict(os.environ)
+        env.update(self._env)
+        env.update({
+            "HOROVOD_ELASTIC": "1",
+            "HOROVOD_CONTROLLER": "http",
+            "HOROVOD_HOSTNAME": host,
+            "HOROVOD_LOCAL_RANK": slot,
+            "HOROVOD_GLOO_RENDEZVOUS_ADDR": "127.0.0.1",
+            "HOROVOD_GLOO_RENDEZVOUS_PORT": str(self._server.port),
+            "HOROVOD_SECRET_KEY": self._server.secret.hex()
+            if self._server.secret else "",
+            "HOROVOD_TPU_RANKS_PER_PROC": "1",
+            # fail fast out of a stale round's rendezvous so the
+            # respawn picks up the current one
+            "HOROVOD_TPU_INIT_TIMEOUT": "20",
+            # crash-durable commit spill (common/elastic.py)
+            "HOROVOD_STATE_SPILL": self._spill_dir,
+        })
+        if self._platform == "cpu":
+            env["JAX_PLATFORMS"] = "cpu"
+            env["JAX_NUM_CPU_DEVICES"] = "1"
+        if self._verbose:
+            logger.info("spawning worker %s", key)
+        self._procs[key] = subprocess.Popen(self._command, env=env)
+
+    # -- background threads --------------------------------------------------
+
+    def _discover_hosts(self):
+        while not self._shutdown.is_set():
+            try:
+                changed = self._host_manager.update_available_hosts()
+            except Exception:  # noqa: BLE001 — discovery script hiccup
+                logger.exception("host discovery failed")
+                changed = False
+            if changed:
+                logger.info("host membership changed: %s",
+                            self._host_manager.current_hosts.host_slots)
+                self._start_round()
+            self._shutdown.wait(DISCOVER_HOSTS_FREQUENCY_SECS)
+
+    def _monitor_workers(self):
+        while not self._shutdown.is_set():
+            failed_hosts = []
+            now = time.monotonic()
+            with self._lock:
+                # reap grace-expired de-assigned workers
+                for key, deadline in list(self._deassigned.items()):
+                    p = self._procs.get(key)
+                    if p is None or p.poll() is not None:
+                        self._procs.pop(key, None)
+                        self._deassigned.pop(key, None)
+                    elif now > deadline:
+                        p.terminate()
+                for key, p in list(self._procs.items()):
+                    code = p.poll()
+                    if code is None:
+                        continue
+                    del self._procs[key]
+                    if key in self._deassigned:
+                        self._deassigned.pop(key, None)
+                        continue       # expected exit of a removed slot
+                    host, slot = key.rsplit(":", 1)
+                    in_churn = (now - self._round_started_at) < 25.0
+                    churns = self._churn_respawns.get(key, 0)
+                    is_churn_exit = code in (-6, 134) or \
+                        (code == 1 and in_churn)
+                    if code == 0:
+                        self._registry.record_success(host, int(slot))
+                    elif is_churn_exit and churns < 10:
+                        # SIGABRT / early-round deaths are jax
+                        # coordination-client fatalities from peer loss
+                        # or a stale rendezvous — churn, not a bad
+                        # host: respawn against the current round
+                        # (committed state restores from the spill)
+                        logger.info("worker %s exited (%d) during "
+                                    "re-rendezvous churn; respawning",
+                                    key, code)
+                        self._churn_respawns[key] = churns + 1
+                        if key in self._assignments and \
+                                not self._shutdown.is_set():
+                            self._spawn_worker(key)
+                    else:
+                        logger.warning("worker %s exited with %d",
+                                       key, code)
+                        self._registry.record_failure(host, int(slot))
+                        failed_hosts.append(host)
+            if failed_hosts and not self._shutdown.is_set():
+                # a failure mid-run must not wait for survivors to
+                # reach a terminal state — they are likely blocked in a
+                # collective with the dead peer.  Blacklist and start a
+                # new round now; survivors get a stale-round error and
+                # re-rendezvous (reference driver.py:304-320
+                # _handle_worker_exit -> blacklist -> new assignments).
+                for host in failed_hosts:
+                    self._host_manager.blacklist(host)
+                self._host_manager.update_available_hosts()
+                self._start_round()
+            self._shutdown.wait(0.2)
+
+    def _terminate_all(self):
+        with self._lock:
+            for p in self._procs.values():
+                if p.poll() is None:
+                    p.terminate()
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 5:
+                if all(p.poll() is not None for p in self._procs.values()):
+                    break
+                time.sleep(0.05)
+            for p in self._procs.values():
+                if p.poll() is None:
+                    p.kill()

@@ -1,0 +1,91 @@
+"""Worker-side host-update notifications (reference
+``horovod/runner/elastic/worker.py:32-119``
+WorkerNotificationService/Manager — driver -> worker push).
+
+Here the channel is the launcher's KV store: the driver bumps a
+version under ``/elastic/notify``; a daemon thread long-polls it and
+feeds registered ``State`` listeners, which raise
+``HostsUpdatedInterrupt`` at the next ``state.commit()``.
+"""
+
+import json
+import logging
+import threading
+import time
+
+from ...common import env as env_mod
+
+logger = logging.getLogger("horovod_tpu.elastic")
+
+NOTIFY_KEY = "/elastic/notify"
+
+
+class WorkerNotificationManager:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._listeners = set()
+        self._thread = None
+        self._stop = threading.Event()
+        self._seen_version = 0
+
+    def init(self):
+        with self._lock:
+            if self._thread is not None:
+                return
+            if env_mod.get_str("HOROVOD_ELASTIC") is None and \
+                    not env_mod.get_bool("HOROVOD_ELASTIC"):
+                return
+            addr = env_mod.get_str(env_mod.HOROVOD_RENDEZVOUS_ADDR)
+            port = env_mod.get_int(env_mod.HOROVOD_RENDEZVOUS_PORT, 0)
+            if not addr or not port:
+                return
+            secret = env_mod.get_str("HOROVOD_SECRET_KEY")
+            from ..http.http_client import StoreClient
+            self._client = StoreClient(
+                addr, port, bytes.fromhex(secret) if secret else None)
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._poll_loop, daemon=True,
+                name="hvd-notification")
+            self._thread.start()
+
+    def register_listener(self, listener):
+        with self._lock:
+            self._listeners.add(listener)
+
+    def remove_listener(self, listener):
+        with self._lock:
+            self._listeners.discard(listener)
+
+    def _poll_loop(self):
+        while not self._stop.is_set():
+            try:
+                raw = self._client.get(NOTIFY_KEY, wait=5.0)
+            except Exception:  # noqa: BLE001 — launcher went away
+                time.sleep(1.0)
+                continue
+            if raw is None:
+                continue
+            try:
+                info = json.loads(raw)
+            except ValueError:
+                continue
+            version = info.get("version", 0)
+            if version > self._seen_version:
+                if self._seen_version != 0:
+                    # version 0->first is the initial round, not a change
+                    with self._lock:
+                        listeners = list(self._listeners)
+                    ts = time.time()
+                    for listener in listeners:
+                        try:
+                            listener.on_hosts_updated(
+                                ts, info.get("round"))
+                        except Exception:  # noqa: BLE001
+                            logger.exception("listener failed")
+                self._seen_version = version
+            else:
+                time.sleep(0.5)
+
+
+notification_manager = WorkerNotificationManager()

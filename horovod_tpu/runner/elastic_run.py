@@ -1,0 +1,44 @@
+"""Elastic job entry (reference ``horovod/runner/gloo_run.py:303-368``
+launch_gloo_elastic)."""
+
+import secrets as _secrets
+
+from .elastic.discovery import HostDiscoveryScript, FixedHosts
+from .elastic.driver import ElasticDriver
+from .http.http_server import RendezvousServer
+from .config_parser import set_env_from_args
+
+
+def run_elastic(args):
+    min_np = args.min_np or args.np
+    max_np = args.max_np or args.np
+    if args.host_discovery_script:
+        discovery = HostDiscoveryScript(args.host_discovery_script,
+                                        slots=args.slots_per_host)
+    elif args.hosts:
+        from .hosts import parse_hosts
+        discovery = FixedHosts({h.hostname: h.slots
+                                for h in parse_hosts(args.hosts)})
+    else:
+        raise ValueError(
+            "elastic mode needs --host-discovery-script or -H hosts")
+
+    env = {}
+    set_env_from_args(env, args)
+    secret_hex = _secrets.token_hex(16)
+    server = RendezvousServer(secret=bytes.fromhex(secret_hex),
+                              world_size=0)
+    server.start()
+    cooldown = tuple(args.blacklist_cooldown_range) \
+        if args.blacklist_cooldown_range else None
+    driver = ElasticDriver(
+        server, discovery, min_np=min_np, max_np=max_np,
+        command=args.command, env=env, reset_limit=args.reset_limit,
+        cooldown_range=cooldown,
+        platform="cpu" if args.cpu else None, verbose=args.verbose)
+    try:
+        driver.start()
+        ok = driver.join(timeout=args.start_timeout)
+    finally:
+        server.stop()
+    return 0 if ok else 1

@@ -156,6 +156,7 @@ class Coordinator:
                  fusion_threshold_bytes: int = 128 * 1024 * 1024):
         self.world_size = world_size
         self.fusion_threshold = fusion_threshold_bytes
+        self.round_id = 0
         self._lock = threading.Condition()
         # key -> {proc_id -> meta}
         self._pending: "OrderedDict[str, dict]" = OrderedDict()
@@ -165,7 +166,24 @@ class Coordinator:
         self._exhausted = {}    # ps_id -> set of procs fully joined
         self._errors = {}       # key -> error string
 
+    def reset(self, world_size: int, round_id: int = 0):
+        """New elastic round: fresh negotiation state; stale-round
+        requests are rejected (reference: a new gloo context per
+        rendezvous, gloo_context.cc:168-206)."""
+        with self._lock:
+            self.world_size = world_size
+            self.round_id = round_id
+            self._pending.clear()
+            self._log.clear()
+            self._joined.clear()
+            self._proc_joined.clear()
+            self._exhausted.clear()
+            self._errors.clear()
+            self._lock.notify_all()
+
     def handle(self, verb, req):
+        if req.get("round", self.round_id) != self.round_id:
+            return {"stale": True, "round": self.round_id}
         if verb == "ready":
             return self._on_ready(req)
         if verb == "poll":
@@ -322,15 +340,23 @@ class Coordinator:
     def _on_poll(self, req):
         """Long-poll for responses after cursor."""
         cursor = req["cursor"]
+        round_at_entry = req.get("round", self.round_id)
         timeout = req.get("wait", 10.0)
         import time
         deadline = time.monotonic() + timeout
         with self._lock:
             while len(self._log) <= cursor:
+                if self.round_id != round_at_entry:
+                    # an elastic reset happened while we were waiting:
+                    # this worker's round is over — never hand it the
+                    # new round's responses
+                    return {"stale": True, "round": self.round_id}
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return {"responses": [], "cursor": cursor}
                 self._lock.wait(remaining)
+            if self.round_id != round_at_entry:
+                return {"stale": True, "round": self.round_id}
             resp = self._log[cursor:]
             return {"responses": resp, "cursor": len(self._log)}
 

@@ -1,0 +1,38 @@
+"""Spark integration (reference ``horovod/spark/runner.py:200,312``:
+horovod.spark.run / run_elastic — barrier-less Spark jobs where each
+task registers with a driver service and launches via gloo/mpirun).
+
+Gated: pyspark is not part of this image.  The run() contract is kept
+so Spark-side code ports unchanged; the launch path reuses the same
+rendezvous + env handoff as the CLI launcher.
+"""
+
+
+def _require_pyspark():
+    try:
+        import pyspark  # noqa: F401
+    except ImportError as exc:
+        raise ImportError(
+            "horovod_tpu.spark requires pyspark, which is not "
+            "installed in this environment") from exc
+
+
+def run(fn, args=(), kwargs=None, num_proc=None, start_timeout=None,
+        use_mpi=None, use_gloo=None, extra_mpi_args=None, env=None,
+        stdout=None, stderr=None, verbose=1, nics=None,
+        prefix_output_with_timestamp=False):
+    """Run ``fn`` on ``num_proc`` Spark tasks (reference
+    spark/runner.py:200).  Requires a live SparkContext."""
+    _require_pyspark()
+    from .runner import run as _run
+    return _run(fn, args=args, kwargs=kwargs, num_proc=num_proc,
+                start_timeout=start_timeout, env=env, verbose=verbose)
+
+
+def run_elastic(fn, args=(), kwargs=None, num_proc=None, min_np=None,
+                max_np=None, start_timeout=None, elastic_timeout=None,
+                env=None, verbose=1, nics=None):
+    """Elastic variant (reference spark/runner.py:312)."""
+    _require_pyspark()
+    raise NotImplementedError(
+        "spark elastic mode is planned; use the elastic CLI launcher")

@@ -1,0 +1,56 @@
+"""Spark job launch (reference ``horovod/spark/runner.py:49-310``):
+each Spark task binds one rank; the driver hosts the rendezvous; ranks
+come up through the same env handoff as the CLI launcher."""
+
+import os
+import secrets as _secrets
+import socket
+
+
+def run(fn, args=(), kwargs=None, num_proc=None, start_timeout=None,
+        env=None, verbose=1):
+    from pyspark import SparkContext, BarrierTaskContext
+
+    sc = SparkContext.getOrCreate()
+    num_proc = num_proc or sc.defaultParallelism
+    kwargs = kwargs or {}
+
+    from ..runner.http.http_server import RendezvousServer, local_ip
+    secret_hex = _secrets.token_hex(16)
+    server = RendezvousServer(secret=bytes.fromhex(secret_hex),
+                              world_size=num_proc)
+    port = server.start()
+    addr = local_ip()
+    coordinator = f"{addr}:{_find_free_port()}"
+    base_env = dict(env or {})
+
+    def task(index):
+        os.environ.update(base_env)
+        os.environ.update({
+            "HOROVOD_CONTROLLER": "http",
+            "HOROVOD_GLOO_RENDEZVOUS_ADDR": addr,
+            "HOROVOD_GLOO_RENDEZVOUS_PORT": str(port),
+            "HOROVOD_SECRET_KEY": secret_hex,
+            "HOROVOD_RANK": str(index),
+            "HOROVOD_SIZE": str(num_proc),
+            "HOROVOD_TPU_PROC_INDEX": str(index),
+            "HOROVOD_TPU_NUM_PROCS": str(num_proc),
+            "HOROVOD_TPU_RANKS_PER_PROC": "1",
+            "HOROVOD_TPU_COORDINATOR": coordinator,
+        })
+        return fn(*args, **kwargs)
+
+    try:
+        rdd = sc.parallelize(range(num_proc), num_proc)
+        return rdd.barrier().mapPartitionsWithIndex(
+            lambda i, _: [task(i)]).collect()
+    finally:
+        server.stop()
+
+
+def _find_free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p

@@ -9,10 +9,8 @@ from ..ops import api
 
 
 def run(func):
-    def reset():
-        basics.shutdown()
-        basics.init()
-    return run_fn(func, reset)
+    from ..elastic import _reset
+    return run_fn(func, _reset)
 
 
 class TensorFlowKerasState(ObjectState):
@@ -43,6 +41,19 @@ class TensorFlowKerasState(ObjectState):
             broadcast_variables(self.optimizer.variables, root_rank=0)
         super().sync()
 
+    # crash-durable spill covers model weights (exec-restart path)
+    def _spill_payload(self):
+        payload = super()._spill_payload() or {}
+        payload["weights"] = self._saved_weights
+        return payload
+
+    def _load_spill(self, payload):
+        super()._load_spill(payload)
+        weights = payload.get("weights")
+        if weights is not None:
+            self._saved_weights = weights
+            self.model.set_weights(weights)
+
 
 class TensorFlowState(ObjectState):
     """Raw tf.Variable collection state (reference elastic.py:41)."""
@@ -61,6 +72,19 @@ class TensorFlowState(ObjectState):
         for v, s in zip(self.variables, self._saved):
             v.assign(s)
         super().restore()
+
+    def _spill_payload(self):
+        payload = super()._spill_payload() or {}
+        payload["variables"] = self._saved
+        return payload
+
+    def _load_spill(self, payload):
+        super()._load_spill(payload)
+        saved = payload.get("variables")
+        if saved is not None:
+            self._saved = saved
+            for v, s in zip(self.variables, self._saved):
+                v.assign(s)
 
     def sync(self):
         from . import broadcast_variables

@@ -16,13 +16,8 @@ from .functions import (
 def run(func):
     """Decorator: elastic retry loop with TPU mesh re-init on reset
     (reference torch/elastic/__init__.py run)."""
-    from ..common.basics import init, shutdown
-
-    def reset():
-        shutdown()
-        init()
-
-    return run_fn(func, reset)
+    from ..elastic import _reset
+    return run_fn(func, _reset)
 
 
 class TorchState(ObjectState):
@@ -57,6 +52,23 @@ class TorchState(ObjectState):
             self._handlers[name].set_value(value)
         super().__setattr__(name, value)
 
+    # crash-durable spill covers model/optimizer state too (the
+    # exec-restart recovery path, common/elastic.py _spill_path)
+    def _spill_payload(self):
+        payload = super()._spill_payload() or {}
+        payload["handlers"] = {
+            name: handler.saved_state()
+            for name, handler in self._handlers.items()}
+        return payload
+
+    def _load_spill(self, payload):
+        super()._load_spill(payload)
+        for name, saved in payload.get("handlers", {}).items():
+            handler = self._handlers.get(name)
+            if handler is not None and saved is not None:
+                handler.load_saved_state(saved)
+                handler.restore()
+
 
 class _StateHandler:
     def __init__(self, value):
@@ -64,6 +76,12 @@ class _StateHandler:
 
     def set_value(self, value):
         self.value = value
+
+    def saved_state(self):
+        return None
+
+    def load_saved_state(self, saved):
+        pass
 
 
 class _ModelStateHandler(_StateHandler):
@@ -81,6 +99,12 @@ class _ModelStateHandler(_StateHandler):
     def sync(self):
         broadcast_parameters(self.value.state_dict(), root_rank=0)
 
+    def saved_state(self):
+        return self._saved_model_state
+
+    def load_saved_state(self, saved):
+        self._saved_model_state = saved
+
 
 class _OptimizerStateHandler(_StateHandler):
     def __init__(self, optimizer):
@@ -95,6 +119,12 @@ class _OptimizerStateHandler(_StateHandler):
 
     def sync(self):
         broadcast_optimizer_state(self.value, root_rank=0)
+
+    def saved_state(self):
+        return self._saved_state
+
+    def load_saved_state(self, saved):
+        self._saved_state = saved
 
 
 def _copy_state_dict(sd):

@@ -1,0 +1,32 @@
+"""Package build (reference ``setup.py``: extras per framework +
+``horovodrun`` entry point, setup.py:255-258).
+
+No C++ extension build is required at install time: the native
+host-path library (csrc/fusion.cpp) is compiled lazily on first use
+with g++ (core/native.py), with a pure-numpy fallback."""
+
+from setuptools import find_packages, setup
+
+setup(
+    name="horovod_tpu",
+    version="0.1.0",
+    description="TPU-native distributed training framework with the "
+                "capability surface of Horovod",
+    packages=find_packages(include=["horovod_tpu", "horovod_tpu.*"]),
+    python_requires=">=3.10",
+    install_requires=["numpy", "jax", "ml_dtypes"],
+    extras_require={
+        "models": ["flax", "optax"],
+        "tensorflow": ["tensorflow"],
+        "keras": ["tensorflow"],
+        "pytorch": ["torch"],
+        "spark": ["pyspark", "pyyaml"],
+        "ray": ["ray"],
+        "dev": ["pytest"],
+    },
+    entry_points={
+        "console_scripts": [
+            "horovodrun = horovod_tpu.runner.launch:main",
+        ],
+    },
+)

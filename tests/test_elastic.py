@@ -1,0 +1,239 @@
+"""Elastic tests: discovery/registry units (reference
+test/single/test_elastic_driver.py) + scripted-discovery integration
+(reference test/integration/elastic_common.py: templated discovery
+script whose output changes mid-run + fault schedules)."""
+
+import json
+import os
+import stat
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from horovod_tpu.runner.elastic.discovery import (
+    FixedHosts, HostManager, HostState,
+)
+from horovod_tpu.runner.elastic.registration import (
+    FAILURE, READY, SUCCESS, WorkerStateRegistry,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeDriver:
+    def __init__(self):
+        self.stopped = False
+        self.error = False
+        self.resumed = 0
+
+    def finished(self):
+        return self.stopped
+
+    def stop(self, error=False):
+        self.stopped = True
+        self.error = error
+
+    def resume(self):
+        self.resumed += 1
+
+
+def test_host_manager_change_detection():
+    disc = FixedHosts({"a": 2})
+    mgr = HostManager(disc)
+    assert mgr.update_available_hosts() is True
+    assert mgr.current_hosts.count_available_slots() == 2
+    assert mgr.update_available_hosts() is False
+    disc._available_hosts = {"a": 2, "b": 2}
+    assert mgr.update_available_hosts() is True
+    # ordering stability: existing host keeps its position
+    assert mgr.current_hosts.host_assignment_order[0] == "a"
+
+
+def test_host_manager_blacklist_and_cooldown():
+    mgr = HostManager(FixedHosts({"a": 1, "b": 1}),
+                      cooldown_range=(0.05, 0.2))
+    mgr.update_available_hosts()
+    mgr.blacklist("b")
+    assert mgr.is_blacklisted("b")
+    assert mgr.update_available_hosts() is True
+    assert mgr.current_hosts.available_hosts == {"a"}
+    # cooldown expiry resurrects the host
+    time.sleep(0.3)
+    assert not mgr.is_blacklisted("b")
+    assert mgr.update_available_hosts() is True
+    assert "b" in mgr.current_hosts.available_hosts
+
+
+def test_registry_all_success_stops_driver():
+    driver = FakeDriver()
+    mgr = HostManager(FixedHosts({"a": 2}))
+    reg = WorkerStateRegistry(driver, mgr)
+    reg.reset(2)
+    reg.record_success("a", 0)
+    assert not driver.stopped
+    reg.record_success("a", 1)
+    assert driver.stopped and not driver.error
+
+
+def test_registry_mixed_failure_blacklists_and_resumes():
+    driver = FakeDriver()
+    mgr = HostManager(FixedHosts({"a": 1, "b": 1}))
+    mgr.update_available_hosts()
+    reg = WorkerStateRegistry(driver, mgr)
+    reg.reset(2)
+    reg.record_failure("b", 0)
+    reg.record_success("a", 0)
+    assert driver.resumed == 1
+    assert mgr.is_blacklisted("b")
+
+
+def test_registry_reset_limit():
+    driver = FakeDriver()
+    mgr = HostManager(FixedHosts({"a": 1, "b": 1}))
+    reg = WorkerStateRegistry(driver, mgr, reset_limit=0)
+    reg.reset(2)
+    reg.record_failure("b", 0)
+    reg.record_success("a", 0)
+    assert driver.stopped and driver.error
+
+
+ELASTIC_WORKER = textwrap.dedent("""
+    import os
+    import numpy as np
+    import horovod_tpu as hvd
+    import horovod_tpu.elastic as elastic
+
+    LOG = os.environ["HVD_TEST_LOG"]
+    TARGET_SIZE = int(os.environ.get("HVD_TARGET_SIZE", "2"))
+
+    hvd.init()
+
+    def log(msg):
+        with open(LOG, "a") as f:
+            f.write(msg + "\\n")
+
+    state = elastic.ObjectState(
+        bcast_object=hvd.broadcast_object, get_rank=hvd.rank,
+        batch=0, at_target=0)
+
+    @elastic.run
+    def train(state):
+        while True:
+            out = hvd.allreduce(np.ones(2, np.float32) * hvd.rank(),
+                                op=hvd.Sum, name=f"b{state.batch}")
+            log(f"batch {state.batch} rank {hvd.rank()} "
+                f"size {hvd.size()}")
+            state.batch += 1
+            if hvd.size() >= TARGET_SIZE:
+                state.at_target += 1
+            if state.at_target >= 3:
+                return
+            state.commit()
+
+    train(state)
+    log(f"done rank {hvd.rank()} size {hvd.size()}")
+""")
+
+
+@pytest.mark.integration
+def test_elastic_scale_up(tmp_path):
+    """Start with one host; discovery adds a second once the first
+    worker makes progress; job finishes only after running at size 2
+    (reference elastic_common.py scale-up scenario)."""
+    log = tmp_path / "log.txt"
+    log.write_text("")
+    worker = tmp_path / "worker.py"
+    worker.write_text(ELASTIC_WORKER)
+    disc = tmp_path / "discover.sh"
+    disc.write_text(textwrap.dedent(f"""\
+        #!/bin/bash
+        echo "hostA:1"
+        if grep -q "batch 2" {log} 2>/dev/null; then
+            echo "hostB:1"
+        fi
+    """))
+    disc.chmod(disc.stat().st_mode | stat.S_IEXEC)
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "-np", "1", "--min-np", "1", "--max-np", "2", "--cpu",
+         "--host-discovery-script", str(disc),
+         "--start-timeout", "240",
+         "--", sys.executable, str(worker)],
+        env={**os.environ, "PYTHONPATH": REPO,
+             "HVD_TEST_LOG": str(log), "HVD_TARGET_SIZE": "2"},
+        capture_output=True, text=True, timeout=300)
+    content = log.read_text()
+    assert proc.returncode == 0, (proc.stderr[-3000:], content)
+    assert "size 2" in content, content
+    # both ranks logged after the resize
+    assert "rank 1 size 2" in content, content
+
+
+@pytest.mark.integration
+def test_elastic_worker_failure_recovery(tmp_path):
+    """One worker exits nonzero mid-run; its host is blacklisted and
+    the survivors re-form at smaller size and finish (reference
+    exit_schedule fault injection)."""
+    log = tmp_path / "log.txt"
+    log.write_text("")
+    worker = tmp_path / "worker.py"
+    worker.write_text(textwrap.dedent("""
+        import os, sys
+        import numpy as np
+        import horovod_tpu as hvd
+        import horovod_tpu.elastic as elastic
+
+        LOG = os.environ["HVD_TEST_LOG"]
+        hvd.init()
+
+        def log(msg):
+            with open(LOG, "a") as f:
+                f.write(msg + "\\n")
+
+        state = elastic.ObjectState(
+            bcast_object=hvd.broadcast_object, get_rank=hvd.rank,
+            batch=0)
+
+        MARKER = os.environ["HVD_FAIL_MARKER"]
+
+        @elastic.run
+        def train(state):
+            while state.batch < 8:
+                if (state.batch == 3 and hvd.size() == 2
+                        and os.environ["HOROVOD_HOSTNAME"] == "hostB"
+                        and not os.path.exists(MARKER)):
+                    open(MARKER, "w").write("1")
+                    log(f"injecting failure on rank {hvd.rank()}")
+                    os._exit(17)
+                hvd.allreduce(np.ones(2, np.float32),
+                              name=f"b{state.batch}")
+                log(f"batch {state.batch} rank {hvd.rank()} "
+                    f"size {hvd.size()}")
+                state.batch += 1
+                state.commit()
+
+        train(state)
+        log(f"done rank {hvd.rank()} size {hvd.size()}")
+    """))
+    disc = tmp_path / "discover.sh"
+    disc.write_text("#!/bin/bash\necho hostA:1\necho hostB:1\n")
+    disc.chmod(disc.stat().st_mode | stat.S_IEXEC)
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "-np", "2", "--min-np", "1", "--max-np", "2", "--cpu",
+         "--host-discovery-script", str(disc),
+         "--start-timeout", "240",
+         "--", sys.executable, str(worker)],
+        env={**os.environ, "PYTHONPATH": REPO,
+             "HVD_TEST_LOG": str(log),
+             "HVD_FAIL_MARKER": str(tmp_path / "failed.marker")},
+        capture_output=True, text=True, timeout=300)
+    content = log.read_text()
+    assert proc.returncode == 0, (proc.stderr[-3000:], content)
+    assert "injecting failure" in content, content
+    assert "done" in content, content

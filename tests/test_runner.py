@@ -137,6 +137,71 @@ WORKER = textwrap.dedent("""
     hvd.shutdown()
 """)
 
+FULL_MATRIX_WORKER = textwrap.dedent("""
+    import numpy as np
+    import horovod_tpu as hvd
+    hvd.init()
+    r, s = hvd.rank(), hvd.size()
+
+    # reducescatter (uneven first dim) across processes
+    x = np.arange(5 * 2, dtype=np.float32).reshape(5, 2) * (r + 1)
+    rs = hvd.reducescatter(x, op=hvd.Sum, name="rs")
+    chunks = [3, 2] if s == 2 else None
+    total = sum(range(1, s + 1))
+    full = np.arange(5 * 2, dtype=np.float32).reshape(5, 2) * total
+    if r == 0:
+        assert np.allclose(rs, full[:3]), rs
+    else:
+        assert np.allclose(rs, full[3:]), rs
+
+    # grouped allreduce fuses into one coordinator batch
+    outs = hvd.grouped_allreduce(
+        [np.full(3, float(r), np.float32),
+         np.full((2, 2), 1.0, np.float32)], op=hvd.Sum, name="grp")
+    assert np.allclose(outs[0], sum(range(s)))
+    assert np.allclose(outs[1], float(s))
+
+    # broadcast with non-zero root
+    b = hvd.broadcast(np.full(3, float(r), np.float32), root_rank=1,
+                      name="bc")
+    assert np.allclose(b, 1.0)
+
+    # min/max across processes
+    mn = hvd.allreduce(np.array([float(r)], np.float32), op=hvd.Min,
+                       name="mn")
+    mx = hvd.allreduce(np.array([float(r)], np.float32), op=hvd.Max,
+                       name="mx")
+    assert mn[0] == 0.0 and mx[0] == float(s - 1)
+
+    # join: rank 0 runs out of data early; rank 1 keeps reducing and
+    # gets zeros contributed for rank 0 (reference join semantics)
+    if r == 0:
+        last = hvd.join()
+    else:
+        extra = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                              name="tail")
+        assert np.allclose(extra, 1.0), extra   # only this rank's data
+        last = hvd.join()
+    assert last >= 0
+    print(f"MATRIX OK {r}")
+    hvd.shutdown()
+""")
+
+
+@pytest.mark.integration
+def test_two_process_full_matrix(tmp_path):
+    """Cross-process reducescatter/grouped/broadcast/minmax/join —
+    the reference's parallel-test matrix shape over real process
+    boundaries."""
+    from horovod_tpu.runner.proc_run import launch_procs
+
+    script = tmp_path / "worker.py"
+    script.write_text(FULL_MATRIX_WORKER)
+    codes = launch_procs([sys.executable, str(script)], np=2,
+                         platform="cpu", env={"PYTHONPATH": REPO},
+                         start_timeout=150)
+    assert codes == [0, 0]
+
 
 @pytest.mark.integration
 def test_two_process_launch(tmp_path):

@@ -26,8 +26,10 @@ def to_numpy(tensor):
             # ml_dtypes.bfloat16 so the wire stays 16-bit (fp16
             # compression halves collective bytes — keep that).
             import ml_dtypes
-            arr = t.view(__import__("torch").uint16).numpy().view(
-                ml_dtypes.bfloat16)
+            # dtype-reinterpreting view needs a contiguous tensor
+            # (transposed/sliced bf16 params would raise otherwise)
+            arr = t.contiguous().view(__import__("torch").uint16) \
+                .numpy().view(ml_dtypes.bfloat16)
         else:
             arr = t.numpy()
     elif mod.startswith("tensorflow"):

@@ -217,6 +217,9 @@ class Engine:
                     sub.handle.set_error(HorovodInternalError(
                         f"process set {ps_id} removed while "
                         f"{entry.key} pending"))
+            if self.multiproc:
+                for key in ps.awaiting:
+                    self.controller.forget(key)
             return True
 
     def get_process_set(self, ps_id) -> ProcessSetState:
@@ -392,6 +395,11 @@ class Engine:
                     if (self.config.stall_shutdown_secs > 0
                             and age > self.config.stall_shutdown_secs):
                         del table[key]
+                        if where == "awaiting" and self.multiproc:
+                            # no coordinator response will ever name
+                            # this key for us: un-mark it as reported
+                            # so a resubmission negotiates again
+                            self.controller.forget(key)
                         for sub in entry.subs.values():
                             sub.handle.set_error(StalledTensorError(
                                 f"tensor {key} stalled for {age:.0f}s"))
@@ -402,6 +410,9 @@ class Engine:
                     list(ps.awaiting.values()):
                 for sub in entry.subs.values():
                     sub.handle.set_error(exc)
+            if self.multiproc:
+                for key in ps.awaiting:
+                    self.controller.forget(key)
             ps.pending.clear()
             ps.awaiting.clear()
             for h in ps.join_waiters.values():

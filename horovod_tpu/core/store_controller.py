@@ -46,7 +46,13 @@ class StoreController:
         fresh = []
         with self._lock:
             for m in metas:
-                if m["key"] not in self._reported:
+                if m.get("error"):
+                    # error notifications are fire-and-forget: the local
+                    # handle already failed, and peers may never submit
+                    # this tensor (so no response would ever clear a
+                    # reported mark) — don't track, don't dedup
+                    fresh.append(m)
+                elif m["key"] not in self._reported:
                     self._reported.add(m["key"])
                     fresh.append(m)
         if fresh:
@@ -56,6 +62,16 @@ class StoreController:
             if out.get("stale"):
                 raise StaleRoundError(
                     f"coordinator moved to round {out.get('round')}")
+
+    def forget(self, key):
+        """Drop a key from the reported set without a coordinator
+        response.  Called by the engine whenever it removes an entry
+        from ``awaiting`` through a path that will never yield a
+        response for this process (stall shutdown, local validation
+        failure, abort) — otherwise a later resubmission of the same
+        tensor name would be silently skipped and hang the job."""
+        with self._lock:
+            self._reported.discard(key)
 
     def report_join(self, ps_id, rank, ps_size, proc_members=1):
         out = self.client.coord("join", {"ps": ps_id, "rank": rank,
@@ -74,6 +90,7 @@ class StoreController:
         dicts ({kind: batch|error|join_done, ...})."""
         out = self.client.coord(
             "poll", {"cursor": self._cursor, "round": self.round_id,
+                     "proc": self.proc_id,
                      "wait": self.poll_wait if wait is None else wait},
             timeout=(self.poll_wait if wait is None else wait) + 30)
         if out.get("stale"):

@@ -160,7 +160,14 @@ class Coordinator:
         self._lock = threading.Condition()
         # key -> {proc_id -> meta}
         self._pending: "OrderedDict[str, dict]" = OrderedDict()
-        self._log = []          # ordered list of response dicts
+        # Ordered response log.  Client cursors are absolute; entries
+        # every process has polled past are garbage-collected and
+        # _log_base keeps absolute cursors valid (without this the log
+        # grows with every collective for the lifetime of the round —
+        # millions of dicts over a long job).
+        self._log = []
+        self._log_base = 0
+        self._cursors = {}      # proc_id -> highest absolute cursor seen
         self._joined = {}       # ps_id -> set of ranks that joined
         self._proc_joined = {}  # ps_id -> {proc -> join count}
         self._exhausted = {}    # ps_id -> set of procs fully joined
@@ -175,6 +182,8 @@ class Coordinator:
             self.round_id = round_id
             self._pending.clear()
             self._log.clear()
+            self._log_base = 0
+            self._cursors.clear()
             self._joined.clear()
             self._proc_joined.clear()
             self._exhausted.clear()
@@ -338,14 +347,24 @@ class Coordinator:
         return max(nprocs - len(exhausted), 1)
 
     def _on_poll(self, req):
-        """Long-poll for responses after cursor."""
+        """Long-poll for responses after cursor (absolute)."""
         cursor = req["cursor"]
         round_at_entry = req.get("round", self.round_id)
         timeout = req.get("wait", 10.0)
+        proc = req.get("proc")
         import time
         deadline = time.monotonic() + timeout
         with self._lock:
-            while len(self._log) <= cursor:
+            if self.round_id != round_at_entry:
+                # a reset raced us past handle()'s unlocked check:
+                # don't let a stale cursor poison the new round's GC
+                return {"stale": True, "round": self.round_id}
+            if proc is not None:
+                # the client has consumed everything below its cursor
+                self._cursors[proc] = max(self._cursors.get(proc, 0),
+                                          cursor)
+                self._gc_log()
+            while self._log_base + len(self._log) <= cursor:
                 if self.round_id != round_at_entry:
                     # an elastic reset happened while we were waiting:
                     # this worker's round is over — never hand it the
@@ -357,8 +376,21 @@ class Coordinator:
                 self._lock.wait(remaining)
             if self.round_id != round_at_entry:
                 return {"stale": True, "round": self.round_id}
-            resp = self._log[cursor:]
-            return {"responses": resp, "cursor": len(self._log)}
+            resp = self._log[max(0, cursor - self._log_base):]
+            return {"responses": resp,
+                    "cursor": self._log_base + len(self._log)}
+
+    def _gc_log(self):
+        """Drop log entries every process has polled past.  Must hold
+        the lock.  Waits until all world_size processes have polled at
+        least once so a late-starting process never misses entries."""
+        if len(self._cursors) < max(self.world_size, 1):
+            return
+        low = min(self._cursors.values())
+        drop = low - self._log_base
+        if drop > 0:
+            del self._log[:drop]
+            self._log_base = low
 
 
 class _ThreadingHTTPServer(socketserver.ThreadingMixIn,

@@ -199,12 +199,18 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         if basics.size() <= 1:
             self._synchronized = True
             return
-        # launch any param that never hit delay 0 (missing backward)
-        for p in self._requires_update:
-            if p not in self._handles and p.grad is not None \
-                    and self._allreduce_delay[p] == \
-                    self.backward_passes_per_step:
-                continue  # nothing pending for this param
+        # Launch any param whose hook never fired (unused in forward) or
+        # fired fewer than backward_passes_per_step times, so its grad
+        # still gets averaged and delays reset (reference
+        # optimizer.py:260-266).  Partially-pending group members are
+        # flushed individually for the same reason.
+        for p in self._requires_update - set(self._handles):
+            if p.grad is None:
+                continue
+            handle, ctx = self._allreduce_grad_async(p)
+            self._handles[p] = (handle, ctx)
+        for pending in self._group_pending.values():
+            pending.clear()
         completed = set()
         group_results = {}
         for p, (handle, ctx) in list(self._handles.items()):
@@ -242,9 +248,11 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         if self._should_synchronize:
             if self._synchronized:
                 warnings.warn(
-                    "optimizer.step() called without a prior "
-                    "optimizer.synchronize() after the last "
-                    "backward; this is allowed but wasteful")
+                    "optimizer.synchronize() was called before "
+                    "optimizer.step(), which can cause gradients to be "
+                    "synchronized twice. Wrap optimizer.step() in "
+                    "`with optimizer.skip_synchronize():` to avoid the "
+                    "redundant synchronization")
             self.synchronize()
         self._synchronized = False
         return super(self.__class__, self).step(closure)

@@ -108,6 +108,36 @@ def test_coordinator_fuses_under_threshold():
     assert keys == [["a", "b"], ["g"], ["c"]]
 
 
+def test_coordinator_log_gc():
+    """The response log is garbage-collected once every process has
+    polled past an entry, while absolute cursors stay valid."""
+    c = Coordinator(world_size=2, fusion_threshold_bytes=100)
+    for step in range(5):
+        c.handle("ready", {"proc": 0, "nlocal": 1,
+                           "entries": [_meta(f"t{step}", 60)]})
+        c.handle("ready", {"proc": 1, "nlocal": 1,
+                           "entries": [_meta(f"t{step}", 60)]})
+    # proc 0 consumes everything; proc 1 lags at cursor 2
+    out0 = c.handle("poll", {"cursor": 0, "proc": 0, "wait": 0})
+    assert len(out0["responses"]) == 5 and out0["cursor"] == 5
+    out1 = c.handle("poll", {"cursor": 0, "proc": 1, "wait": 0})
+    assert len(out1["responses"]) == 5
+    # both acknowledge consumption on their next poll
+    c.handle("poll", {"cursor": 5, "proc": 0, "wait": 0})
+    mid = c.handle("poll", {"cursor": 2, "proc": 1, "wait": 0})
+    # proc 1 only acked 2: entries 2..4 must still be served
+    assert [r["keys"] for r in mid["responses"]] == [["t2"], ["t3"], ["t4"]]
+    assert c._log_base == 2 and len(c._log) == 3
+    c.handle("poll", {"cursor": 5, "proc": 1, "wait": 0})
+    assert c._log_base == 5 and len(c._log) == 0
+    # new work after GC still lands at valid absolute cursors
+    c.handle("ready", {"proc": 0, "nlocal": 1, "entries": [_meta("n", 60)]})
+    c.handle("ready", {"proc": 1, "nlocal": 1, "entries": [_meta("n", 60)]})
+    out = c.handle("poll", {"cursor": 5, "proc": 0, "wait": 0})
+    assert [r["keys"] for r in out["responses"]] == [["n"]]
+    assert out["cursor"] == 6
+
+
 def test_coordinator_cross_process_validation():
     c = Coordinator(world_size=2)
     c.handle("ready", {"proc": 0, "nlocal": 1,

@@ -149,6 +149,60 @@ def test_distributed_optimizer_backward_passes_per_step(hvd_shutdown):
     assert all(run_ranks(fn))
 
 
+def test_distributed_optimizer_partial_accumulation(hvd_shutdown):
+    """step() before backward_passes_per_step backwards: grads whose
+    hook never hit delay 0 must still be averaged across ranks
+    (reference optimizer.py:260-266 flushes missing handles in
+    synchronize)."""
+    def fn():
+        model = torch.nn.Linear(2, 1, bias=False)
+        with torch.no_grad():
+            model.weight.fill_(0.0)
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.0),
+            named_parameters=model.named_parameters(),
+            backward_passes_per_step=3)
+        # only ONE backward before step(): delay never reaches 0, so no
+        # hook-launched allreduce — synchronize must flush it
+        loss = model(torch.ones(1, 2) * (hvd.rank() + 1)).sum()
+        loss.backward()
+        opt.step()
+        expected = np.mean([r + 1 for r in range(NP)])
+        assert np.allclose(model.weight.grad.numpy(), expected), \
+            model.weight.grad.numpy()
+        # delay must have been reset: a full cycle afterwards still works
+        opt.zero_grad()
+        for i in range(3):
+            loss = model(torch.ones(1, 2) * (hvd.rank() + 1)).sum()
+            loss.backward()
+        opt.step()
+        assert np.allclose(model.weight.grad.numpy(), 3 * expected), \
+            model.weight.grad.numpy()
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_torch_allreduce_noncontiguous_bf16(hvd_shutdown):
+    """Transposed (non-contiguous) bf16 tensors stage through the
+    uint16 bit view — requires contiguous() first."""
+    def fn():
+        r = hvd.rank()
+        base = (torch.arange(12, dtype=torch.float32) * (r + 1)) \
+            .reshape(3, 4).to(torch.bfloat16)
+        t = base.t()                      # non-contiguous view
+        assert not t.is_contiguous()
+        out = hvd.allreduce(t, op=hvd.Sum)
+        expected = (torch.arange(12, dtype=torch.float32)
+                    * sum(range(1, NP + 1))).reshape(3, 4).t() \
+            .to(torch.bfloat16).to(torch.float32)
+        assert torch.allclose(out.to(torch.float32), expected,
+                              rtol=0.02), out
+        return True
+
+    assert all(run_ranks(fn))
+
+
 def test_distributed_optimizer_grouped(hvd_shutdown):
     def fn():
         model = torch.nn.Sequential(torch.nn.Linear(3, 3),

@@ -11,10 +11,21 @@ collectives — the SPMD invariant that replaces the reference's
 explicit NCCL communicator synchronization.
 """
 
+import json
 import threading
 
 from ..common.exceptions import HorovodInternalError
 from ..runner.http.http_client import StoreClient
+from ..runner.http.http_server import CACHEABLE_TYPES as _CACHEABLE_TYPES
+
+
+def _fingerprint(meta):
+    """Canonical identity of a negotiation meta, aux/error excluded
+    (reference response_cache.h:45-101 keys the LRU on tensor name +
+    params the same way)."""
+    return json.dumps(
+        {k: v for k, v in meta.items() if k not in ("aux", "error")},
+        sort_keys=True)
 
 
 class StaleRoundError(HorovodInternalError):
@@ -37,12 +48,16 @@ class StoreController:
         self.round_id = round_id
         self._cursor = 0
         self._reported = set()
+        self._cache = {}      # key -> (cache_id, fingerprint)
+        self._suppressed = {} # key -> full meta withheld on a cache hit
         self._lock = threading.Lock()
 
     # -- reporting -----------------------------------------------------------
 
     def report_ready(self, metas):
-        """Announce locally-ready entries (idempotent per key)."""
+        """Announce locally-ready entries (idempotent per key).  Keys
+        whose params match a cached response template go out as tiny
+        ``{key, c}`` records — the steady-state fast path."""
         fresh = []
         with self._lock:
             for m in metas:
@@ -54,14 +69,40 @@ class StoreController:
                     fresh.append(m)
                 elif m["key"] not in self._reported:
                     self._reported.add(m["key"])
-                    fresh.append(m)
+                    cached = self._cache.get(m["key"])
+                    if cached is not None and \
+                            m.get("type") in _CACHEABLE_TYPES and \
+                            cached[1] == _fingerprint(m):
+                        self._suppressed[m["key"]] = m
+                        hit = {"key": m["key"], "c": cached[0]}
+                        if m.get("aux"):
+                            hit["aux"] = m["aux"]
+                        fresh.append(hit)
+                    else:
+                        fresh.append(m)
         if fresh:
-            out = self.client.coord("ready", {
-                "proc": self.proc_id, "nlocal": self.nlocal,
-                "round": self.round_id, "entries": fresh})
-            if out.get("stale"):
-                raise StaleRoundError(
-                    f"coordinator moved to round {out.get('round')}")
+            self._post_ready(fresh)
+
+    def _post_ready(self, entries):
+        out = self.client.coord("ready", {
+            "proc": self.proc_id, "nlocal": self.nlocal,
+            "round": self.round_id, "entries": entries})
+        if out.get("stale"):
+            raise StaleRoundError(
+                f"coordinator moved to round {out.get('round')}")
+        uncached = out.get("uncached")
+        if uncached:
+            # the coordinator evicted (or never had) those cache ids:
+            # resend the withheld full metas and drop the stale entries
+            resend = []
+            with self._lock:
+                for key in uncached:
+                    self._cache.pop(key, None)
+                    full = self._suppressed.pop(key, None)
+                    if full is not None:
+                        resend.append(full)
+            if resend:
+                self._post_ready(resend)
 
     def forget(self, key):
         """Drop a key from the reported set without a coordinator
@@ -72,6 +113,7 @@ class StoreController:
         tensor name would be silently skipped and hang the job."""
         with self._lock:
             self._reported.discard(key)
+            self._suppressed.pop(key, None)
 
     def report_join(self, ps_id, rank, ps_size, proc_members=1):
         out = self.client.coord("join", {"ps": ps_id, "rank": rank,
@@ -101,8 +143,17 @@ class StoreController:
         if responses:
             with self._lock:
                 for r in responses:
+                    cache_ids = r.get("cache_ids", {})
                     for k in r.get("keys", []):
                         self._reported.discard(k)
+                        self._suppressed.pop(k, None)
+                        cid = cache_ids.get(k)
+                        meta = r.get("metas", {}).get(k)
+                        if cid is not None and meta is not None and \
+                                meta.get("type") in _CACHEABLE_TYPES:
+                            self._cache[k] = (cid, _fingerprint(meta))
                     if "key" in r:          # error responses
                         self._reported.discard(r["key"])
+                        self._suppressed.pop(r["key"], None)
+                        self._cache.pop(r["key"], None)
         return responses

@@ -33,6 +33,13 @@ BAD_REQUEST = 400
 FORBIDDEN = 403
 NOT_FOUND = 404
 
+# Ops whose negotiation meta is identical across processes and steps
+# (fixed shape): eligible for the response-cache fast path.  Allgather
+# metas carry per-proc first dims and alltoall metas carry splits, so
+# those are never cached (client sends full metas; server skips the
+# LRU so uncacheable entries can't evict hot allreduce templates).
+CACHEABLE_TYPES = ("ALLREDUCE", "ADASUM")
+
 
 def _digest(secret: bytes, payload: bytes) -> str:
     return hmac.new(secret, payload, hashlib.sha256).hexdigest()
@@ -150,12 +157,23 @@ class Coordinator:
     """Server-side negotiation engine (the reference's rank-0
     coordinator, controller.cc ComputeResponseList/FuseResponses,
     relocated into the launcher's store service — same protocol, one
-    fewer hop)."""
+    fewer hop).
+
+    Response cache (reference response_cache.{h,cc}): batch responses
+    assign each tensor a cache id workers learn from the response; on
+    repeat iterations a worker reports ``{"key", "c": id}`` instead of
+    the full negotiation meta, and entries whose reports all carry the
+    same id skip cross-process validation — the steady-state fast path
+    that replaces the reference's two-bitvector CoordinateCacheAndState
+    sync.  The LRU is capacity-bounded; reports naming an evicted id
+    get the key back in ``uncached`` and resend the full meta."""
 
     def __init__(self, world_size: int,
-                 fusion_threshold_bytes: int = 128 * 1024 * 1024):
+                 fusion_threshold_bytes: int = 128 * 1024 * 1024,
+                 cache_capacity: int = 1024):
         self.world_size = world_size
         self.fusion_threshold = fusion_threshold_bytes
+        self.cache_capacity = cache_capacity
         self.round_id = 0
         self._lock = threading.Condition()
         # key -> {proc_id -> meta}
@@ -172,6 +190,9 @@ class Coordinator:
         self._proc_joined = {}  # ps_id -> {proc -> join count}
         self._exhausted = {}    # ps_id -> set of procs fully joined
         self._errors = {}       # key -> error string
+        self._cache = OrderedDict()  # cache_id -> meta template (LRU)
+        self._cache_by_key = {}      # key -> cache_id
+        self._next_cache_id = 0
 
     def reset(self, world_size: int, round_id: int = 0):
         """New elastic round: fresh negotiation state; stale-round
@@ -188,6 +209,8 @@ class Coordinator:
             self._proc_joined.clear()
             self._exhausted.clear()
             self._errors.clear()
+            self._cache.clear()
+            self._cache_by_key.clear()
             self._lock.notify_all()
 
     def handle(self, verb, req):
@@ -205,12 +228,26 @@ class Coordinator:
         """Worker announces locally-ready entries.
         req: {proc: int, nlocal: int, entries: [meta...]}
         meta: {key, type, dtype, shape, op, pre, post, ps, nbytes,
-               names, root}
-        """
+               names, root} — or the cache-hit form {key, c, aux}.
+        Returns {uncached: [key...]} for cache ids this coordinator no
+        longer holds (evicted / new round); the worker resends those
+        with full metas."""
         proc = req["proc"]
+        uncached = []
         with self._lock:
             for meta in req["entries"]:
                 key = meta["key"]
+                if "c" in meta:
+                    template = self._cache.get(meta["c"])
+                    if template is None or \
+                            self._cache_by_key.get(key) != meta["c"]:
+                        uncached.append(key)
+                        continue
+                    self._cache.move_to_end(meta["c"])
+                    full = dict(template)
+                    full["aux"] = meta.get("aux", {})
+                    full["_cached"] = meta["c"]
+                    meta = full
                 ent = self._pending.get(key)
                 if ent is None:
                     ent = self._pending[key] = {}
@@ -225,13 +262,19 @@ class Coordinator:
                         self._errors[key] = err
             self._advance()
             self._lock.notify_all()
-        return {}
+        return {"uncached": uncached} if uncached else {}
 
     def _validate(self, key, ent):
         """Cross-process consistency (reference ConstructResponse,
         controller.cc:496-843)."""
         metas = list(ent.values())
         first = metas[0]
+        if all(m.get("_cached") is not None
+               and m.get("_cached") == first.get("_cached")
+               for m in metas):
+            # every report resolved through the same cache entry:
+            # the metas are one template by construction (fast path)
+            return None
         for m in metas[1:]:
             for field, label in (("dtype", "data types"),
                                  ("op", "reduce ops"),
@@ -329,16 +372,38 @@ class Coordinator:
             sig = msig
         flush()
 
-    @staticmethod
-    def _batch_response(metas):
-        return {
+    def _batch_response(self, metas):
+        cache_ids = {}
+        templates = {}
+        for m in metas:
+            key = m["key"]
+            # single filtered copy serves as both the wire meta and the
+            # cache template, so the two can't drift apart
+            templates[key] = {k: v for k, v in m.items()
+                              if k not in ("aux", "aux_by_proc",
+                                           "_cached")}
+            if m["type"] not in CACHEABLE_TYPES:
+                continue
+            cid = self._cache_by_key.get(key)
+            if cid is None:
+                cid = self._next_cache_id
+                self._next_cache_id += 1
+                self._cache_by_key[key] = cid
+                while len(self._cache) >= self.cache_capacity:
+                    old_id, old_t = self._cache.popitem(last=False)
+                    self._cache_by_key.pop(old_t["key"], None)
+            self._cache[cid] = templates[key]
+            self._cache.move_to_end(cid)
+            cache_ids[key] = cid
+        resp = {
             "kind": "batch",
             "keys": [m["key"] for m in metas],
-            "metas": {m["key"]: {k: v for k, v in m.items()
-                                 if k not in ("aux", "aux_by_proc")}
-                      for m in metas},
+            "metas": templates,
             "aux": {m["key"]: m.get("aux_by_proc", {}) for m in metas},
         }
+        if cache_ids:
+            resp["cache_ids"] = cache_ids
+        return resp
 
     def _members_for(self, ent):
         meta = next(iter(ent.values()))
@@ -404,9 +469,11 @@ class RendezvousServer:
     RendezvousServer, http_server.py:192)."""
 
     def __init__(self, secret: bytes = None, world_size: int = 0,
-                 fusion_threshold_bytes: int = 128 * 1024 * 1024):
+                 fusion_threshold_bytes: int = 128 * 1024 * 1024,
+                 cache_capacity: int = 1024):
         self.store = KVStore()
-        self.coordinator = Coordinator(world_size, fusion_threshold_bytes)
+        self.coordinator = Coordinator(world_size, fusion_threshold_bytes,
+                                       cache_capacity=cache_capacity)
         self.secret = secret
         self._httpd = None
         self._thread = None

@@ -138,6 +138,91 @@ def test_coordinator_log_gc():
     assert out["cursor"] == 6
 
 
+def test_coordinator_response_cache():
+    """Batch responses assign cache ids; subsequent {key, c} reports
+    resolve through the cache; unknown ids come back as uncached."""
+    c = Coordinator(world_size=2, fusion_threshold_bytes=1000)
+    c.handle("ready", {"proc": 0, "nlocal": 1, "entries": [_meta("a")]})
+    c.handle("ready", {"proc": 1, "nlocal": 1, "entries": [_meta("a")]})
+    out = c.handle("poll", {"cursor": 0, "proc": 0, "wait": 0})
+    cid = out["responses"][0]["cache_ids"]["a"]
+    # steady state: both procs report by cache id only
+    r0 = c.handle("ready", {"proc": 0, "nlocal": 1,
+                            "entries": [{"key": "a", "c": cid}]})
+    assert not r0.get("uncached")
+    c.handle("ready", {"proc": 1, "nlocal": 1,
+                       "entries": [{"key": "a", "c": cid}]})
+    out = c.handle("poll", {"cursor": 1, "proc": 0, "wait": 0})
+    assert out["responses"][0]["keys"] == ["a"]
+    assert out["responses"][0]["metas"]["a"]["dtype"] == "float32"
+    assert "_cached" not in out["responses"][0]["metas"]["a"]
+    # unknown cache id -> uncached reply, entry not consumed
+    r = c.handle("ready", {"proc": 0, "nlocal": 1,
+                           "entries": [{"key": "zz", "c": 999}]})
+    assert r["uncached"] == ["zz"]
+
+
+def test_coordinator_cache_eviction():
+    c = Coordinator(world_size=1, fusion_threshold_bytes=10**6,
+                    cache_capacity=2)
+    for name in ("a", "b", "x"):
+        c.handle("ready", {"proc": 0, "nlocal": 1,
+                           "entries": [_meta(name, nprocs=1)]})
+    out = c.handle("poll", {"cursor": 0, "proc": 0, "wait": 0})
+    ids = {}
+    for r in out["responses"]:
+        ids.update(r.get("cache_ids", {}))
+    assert set(ids) == {"a", "b", "x"}
+    # capacity 2: "a" (least recent) evicted; reporting its old id
+    # must return uncached rather than hanging
+    r = c.handle("ready", {"proc": 0, "nlocal": 1,
+                           "entries": [{"key": "a", "c": ids["a"]}]})
+    assert r["uncached"] == ["a"]
+    # "x" still cached
+    r = c.handle("ready", {"proc": 0, "nlocal": 1,
+                           "entries": [{"key": "x", "c": ids["x"]}]})
+    assert not r.get("uncached")
+
+
+def test_store_controller_cache_roundtrip():
+    """Worker-side StoreController learns cache ids from responses,
+    reports by id on repeat, and recovers from eviction."""
+    from horovod_tpu.core.store_controller import StoreController
+
+    server = RendezvousServer(secret=b"k", world_size=1,
+                              fusion_threshold_bytes=10**6,
+                              cache_capacity=1)
+    port = server.start()
+    try:
+        sc = StoreController("127.0.0.1", port, b"k", proc_id=0,
+                             num_procs=1, nlocal=1)
+        sent = []
+        orig_post = sc.client.coord
+
+        def spy(verb, payload, **kw):
+            if verb == "ready":
+                sent.append(payload["entries"])
+            return orig_post(verb, payload, **kw)
+
+        sc.client.coord = spy
+        m1 = _meta("g1", nprocs=1)
+        m2 = _meta("g2", nprocs=1)
+        sc.report_ready([m1]); sc.poll(wait=1)
+        sc.report_ready([m1]); sc.poll(wait=1)
+        # second report of g1 went out as a cache hit
+        assert sent[1] == [{"key": "g1", "c": 0}]
+        # negotiating g2 evicts g1 (capacity 1); next g1 report sends
+        # the stale id, gets uncached back, transparently resends full
+        sc.report_ready([m2]); sc.poll(wait=1)
+        sc.report_ready([m1])
+        resp = sc.poll(wait=1)
+        assert resp and resp[0]["keys"] == ["g1"]
+        assert sent[-2] == [{"key": "g1", "c": 0}]   # stale hit
+        assert sent[-1][0].get("type") == "ALLREDUCE"  # full resend
+    finally:
+        server.stop()
+
+
 def test_coordinator_cross_process_validation():
     c = Coordinator(world_size=2)
     c.handle("ready", {"proc": 0, "nlocal": 1,

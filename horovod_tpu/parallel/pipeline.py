@@ -4,7 +4,7 @@ Not in the reference (SURVEY §2.7: no PP engine; process sets are the
 substrate users would build one on).  TPU-native formulation: stages
 are shards of the scanned layer axis, activations hop stage-to-stage
 with ``lax.ppermute`` (one ICI neighbour hop), and microbatches stream
-through a ``lax.fori_loop`` of ``n_micro + n_stages - 1`` ticks — the
+through a ``lax.scan`` of ``n_micro + n_stages - 1`` ticks — the
 classic collective-permute pipeline from the scaling playbook, written
 as a ``shard_map`` block so it composes under an outer ``jax.jit``.
 
@@ -33,30 +33,34 @@ def gpipe(stage_fn: Callable, local_stage_params, microbatches,
     Must be called inside shard_map with ``axis_name`` bound.
     ``stage_fn(local_stage_params, x) -> x`` applies this device's
     stage.  Returns (M, ...) outputs, replicated across the axis.
+
+    The tick loop is a ``lax.scan`` (not fori/while) so the whole
+    pipeline is **reverse-mode differentiable**: scan transposes to a
+    reverse scan, ``ppermute`` to the inverted permutation, and the
+    last-stage psum to a broadcast — giving exact GPipe gradients with
+    the usual O(M) activation memory (use ``jax.checkpoint`` around
+    ``stage_fn`` to trade recompute for memory).
     """
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     M = microbatches.shape[0]
     perm = [(j, (j + 1) % n) for j in range(n)]
 
-    def tick(t, carry):
-        state, outputs = carry
+    def tick(state, t):
         # stage 0 injects microbatch t while t < M; later stages use
         # the activation ppermuted in from the previous stage.
         inject = microbatches[jnp.minimum(t, M - 1)]
         state = jnp.where(my == 0, jnp.where(t < M, inject, state), state)
         state = stage_fn(local_stage_params, state)
-        out_idx = t - (n - 1)
-        updated = outputs.at[jnp.clip(out_idx, 0, M - 1)].set(state)
-        take = jnp.logical_and(my == n - 1,
-                               jnp.logical_and(out_idx >= 0, out_idx < M))
-        outputs = jnp.where(take, updated, outputs)
+        emit = state
         state = lax.ppermute(state, axis_name, perm)
-        return state, outputs
+        return state, emit
 
     state0 = jnp.zeros_like(microbatches[0])
-    outs0 = jnp.zeros_like(microbatches)
-    _, outputs = lax.fori_loop(0, M + n - 1, tick, (state0, outs0))
+    _, emitted = lax.scan(tick, state0, jnp.arange(M + n - 1))
+    # microbatch m leaves the last stage at tick m + n - 1: its
+    # emissions at ticks [n-1, M+n-1) are the pipeline outputs
+    outputs = emitted[n - 1:]
     # replicate finished microbatches from the last stage to all stages
     return lax.psum(jnp.where(my == n - 1, outputs, 0.0), axis_name)
 

@@ -109,6 +109,57 @@ def _opt_state_shardings(mesh, opt_state, params, param_shardings):
     return jax.tree_util.tree_map(pick, opt_state)
 
 
+def make_pipelined_lm_train_step(mesh: Mesh, cfg: TransformerConfig,
+                                 n_microbatches: int, optimizer=None, *,
+                                 learning_rate: float = 1e-3):
+    """Trainable GPipe: the decoder stack runs as a ``pp``-axis
+    pipeline (pipeline.py gpipe — a differentiable scan of ppermute
+    ticks) and the whole fwd/bwd/update compiles as one program.
+
+    Returns (init, step, jit_step, tok_sharding) with the same contract
+    as :func:`make_lm_train_step`, so callers can switch between the
+    scan-over-sharded-layers path and the explicit pipeline path."""
+    from .pipeline import make_pipelined_lm_apply
+
+    optimizer = optimizer or optax.adamw(learning_rate)
+    model = TransformerLM(cfg)
+    pipe_apply = make_pipelined_lm_apply(mesh, cfg, n_microbatches)
+    tok_sharding = batch_sharding(mesh)
+
+    def init(rng, sample_tokens):
+        params = model.init(rng, sample_tokens)["params"]
+        opt_state = optimizer.init(params)
+        return {"params": params, "opt_state": opt_state,
+                "step": jnp.zeros((), jnp.int32)}
+
+    def loss_fn(params, tokens):
+        logits = pipe_apply({"params": params}, tokens)
+        return lm_loss(logits[:, :-1], tokens[:, 1:])
+
+    def step(state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"], tokens)
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return {"params": params, "opt_state": opt_state,
+                "step": state["step"] + 1}, loss
+
+    def jit_step(state):
+        pspec = transformer_param_shardings(mesh, state["params"])
+        ospec = _opt_state_shardings(mesh, state["opt_state"],
+                                     state["params"], pspec)
+        spec = {"params": pspec, "opt_state": ospec,
+                "step": replicated(mesh)}
+        compiled = jax.jit(
+            step,
+            in_shardings=(spec, tok_sharding),
+            out_shardings=(spec, replicated(mesh)),
+            donate_argnums=(0,))
+        return compiled, jax.device_put(state, spec)
+
+    return init, step, jit_step, tok_sharding
+
+
 # ---------------------------------------------------------------------------
 # Data-parallel step for arbitrary flax models (ResNet bench path)
 

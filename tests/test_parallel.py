@@ -153,6 +153,39 @@ def test_pipeline_matches_reference_apply():
                                rtol=2e-4, atol=2e-4)
 
 
+def test_pipeline_train_step_matches_dense():
+    """Trainable GPipe: one pipelined train step must produce the same
+    loss and updated params as the unsharded reference step (exact
+    gradients through the scan-of-ppermute pipeline)."""
+    mesh = build_mesh(dp=2, pp=4)
+    from horovod_tpu.parallel import make_pipelined_lm_train_step
+
+    init, step, jit_step, tok_shd = make_pipelined_lm_train_step(
+        mesh, CFG, n_microbatches=2, optimizer=optax.sgd(0.1))
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (4, 16), 0,
+                                CFG.vocab_size)
+    state = init(jax.random.PRNGKey(1), tokens)
+
+    # reference: plain (non-pipelined) unsharded step, same init
+    init_ref, step_ref, _, _ = make_lm_train_step(
+        mesh, CFG, optimizer=optax.sgd(0.1))
+    ref_state, ref_loss = step_ref(init_ref(jax.random.PRNGKey(1), tokens),
+                                   tokens)
+
+    compiled, state = jit_step(state)
+    out_state, loss = compiled(state, jax.device_put(tokens, tok_shd))
+    np.testing.assert_allclose(float(loss), float(ref_loss),
+                               rtol=1e-4, atol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(ref_state["params"]),
+                    jax.tree_util.tree_leaves(out_state["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
+
+    # training continues: loss drops on the repeated batch
+    out_state2, loss2 = compiled(out_state, jax.device_put(tokens, tok_shd))
+    assert float(loss2) < float(loss)
+
+
 def test_moe_ep_step():
     cfg = TransformerConfig(vocab_size=64, d_model=32, n_layers=2,
                             n_heads=4, d_ff=64, max_seq_len=32,

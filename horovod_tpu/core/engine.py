@@ -486,6 +486,11 @@ class Engine:
         except Exception as exc:  # noqa: BLE001 — coordinator death
             self.abort(exc)
             return
+        tuned = self.controller.tuned
+        if tuned and "cycle_time_ms" in tuned:
+            # coordinator-side autotune broadcast (reference
+            # SynchronizeParameters, controller.cc:40-54)
+            self.config.cycle_time_ms = tuned["cycle_time_ms"]
         for resp in responses:
             self._apply_response(resp)
 

@@ -51,6 +51,10 @@ class StoreController:
         self._cache = {}      # key -> (cache_id, fingerprint)
         self._suppressed = {} # key -> full meta withheld on a cache hit
         self._lock = threading.Lock()
+        #: Last coordinator-tuned parameters seen in a poll reply
+        #: (reference SynchronizeParameters broadcast); the engine
+        #: applies them to its config each cycle.
+        self.tuned = None
 
     # -- reporting -----------------------------------------------------------
 
@@ -140,6 +144,8 @@ class StoreController:
                 f"coordinator moved to round {out.get('round')}")
         responses = out.get("responses", [])
         self._cursor = out.get("cursor", self._cursor)
+        if "tuned" in out:
+            self.tuned = out["tuned"]
         if responses:
             with self._lock:
                 for r in responses:

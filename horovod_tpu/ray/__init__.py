@@ -33,12 +33,18 @@ class RayExecutor:
               executable_kwargs=None, extra_env_vars=None):
         import ray
         import secrets as _secrets
-        from ..runner.http.http_server import RendezvousServer, local_ip
+        from ..runner.http.http_server import (
+            RendezvousServer, autotune_kwargs, local_ip,
+        )
 
         secret_hex = _secrets.token_hex(16)
+        import os as _os
+        at_env = dict(_os.environ)
+        at_env.update(extra_env_vars or {})
         self._server = RendezvousServer(
             secret=bytes.fromhex(secret_hex),
-            world_size=self.num_workers)
+            world_size=self.num_workers,
+            **autotune_kwargs(at_env))
         port = self._server.start()
         addr = local_ip()
         import socket as _socket

@@ -1,11 +1,12 @@
 """Elastic job entry (reference ``horovod/runner/gloo_run.py:303-368``
 launch_gloo_elastic)."""
 
+import os
 import secrets as _secrets
 
 from .elastic.discovery import HostDiscoveryScript, FixedHosts
 from .elastic.driver import ElasticDriver
-from .http.http_server import RendezvousServer
+from .http.http_server import RendezvousServer, autotune_kwargs
 from .config_parser import set_env_from_args
 
 
@@ -26,8 +27,10 @@ def run_elastic(args):
     env = {}
     set_env_from_args(env, args)
     secret_hex = _secrets.token_hex(16)
+    at_env = dict(os.environ)
+    at_env.update(env)
     server = RendezvousServer(secret=bytes.fromhex(secret_hex),
-                              world_size=0)
+                              world_size=0, **autotune_kwargs(at_env))
     server.start()
     cooldown = tuple(args.blacklist_cooldown_range) \
         if args.blacklist_cooldown_range else None

@@ -22,6 +22,7 @@ job secret).
 import hashlib
 import hmac
 import json
+import os
 import threading
 import socket
 import socketserver
@@ -39,6 +40,20 @@ NOT_FOUND = 404
 # those are never cached (client sends full metas; server skips the
 # LRU so uncacheable entries can't evict hot allreduce templates).
 CACHEABLE_TYPES = ("ALLREDUCE", "ADASUM")
+
+
+def autotune_kwargs(env=None):
+    """RendezvousServer autotune settings from a ``HOROVOD_*`` env
+    mapping (default: os.environ) — shared by every launcher that
+    hosts a coordinator (static, elastic, spark, ray)."""
+    env = os.environ if env is None else env
+    on = str(env.get("HOROVOD_AUTOTUNE", "")).strip().lower() \
+        in ("1", "true", "yes", "on")
+    return {
+        "autotune": on,
+        "autotune_log": env.get("HOROVOD_AUTOTUNE_LOG") or None,
+        "cycle_time_ms": float(env.get("HOROVOD_CYCLE_TIME") or 1.0),
+    }
 
 
 def _digest(secret: bytes, payload: bytes) -> str:
@@ -170,11 +185,28 @@ class Coordinator:
 
     def __init__(self, world_size: int,
                  fusion_threshold_bytes: int = 128 * 1024 * 1024,
-                 cache_capacity: int = 1024):
+                 cache_capacity: int = 1024, autotune: bool = False,
+                 autotune_log: str = None, cycle_time_ms: float = 1.0):
         self.world_size = world_size
         self.fusion_threshold = fusion_threshold_bytes
         self.cache_capacity = cache_capacity
         self.round_id = 0
+        # Coordinator-side autotune (reference: the coordinator tunes
+        # and SynchronizeParameters broadcasts, controller.cc:40-54):
+        # fusion threshold is applied directly here — fusing IS this
+        # server's job — and the tuned cycle time rides back to every
+        # worker in poll replies.  Both tunables are seeded from the
+        # user-configured values so the first broadcast doesn't clobber
+        # them.
+        self._autotuner = None
+        if autotune:
+            import types
+            from ...core.autotune import ParameterManager
+            self._tuned_params = types.SimpleNamespace(
+                fusion_threshold_bytes=fusion_threshold_bytes,
+                cycle_time_ms=cycle_time_ms)
+            self._autotuner = ParameterManager(self._tuned_params,
+                                               log_path=autotune_log)
         self._lock = threading.Condition()
         # key -> {proc_id -> meta}
         self._pending: "OrderedDict[str, dict]" = OrderedDict()
@@ -193,6 +225,10 @@ class Coordinator:
         self._cache = OrderedDict()  # cache_id -> meta template (LRU)
         self._cache_by_key = {}      # key -> cache_id
         self._next_cache_id = 0
+
+    def close(self):
+        if self._autotuner is not None:
+            self._autotuner.close()
 
     def reset(self, world_size: int, round_id: int = 0):
         """New elastic round: fresh negotiation state; stale-round
@@ -345,8 +381,17 @@ class Coordinator:
             nonlocal bucket, bucket_bytes, sig
             if bucket:
                 self._log.append(self._batch_response(bucket))
+                if self._autotuner is not None:
+                    # emission rate tracks collective throughput:
+                    # workers only re-report after executing the
+                    # previous responses, so scheduling is gated on
+                    # completion (the reference scores bytes/sec the
+                    # same indirect way, parameter_manager.cc)
+                    self._autotuner.record_bytes(bucket_bytes)
                 bucket, bucket_bytes, sig = [], 0, None
 
+        if self._autotuner is not None:
+            self.fusion_threshold = self._tuned_params.fusion_threshold_bytes
         for meta in ready:
             if meta["type"] not in ("ALLREDUCE", "ADASUM"):
                 if self._exhausted.get(meta.get("ps", 0)):
@@ -442,8 +487,12 @@ class Coordinator:
             if self.round_id != round_at_entry:
                 return {"stale": True, "round": self.round_id}
             resp = self._log[max(0, cursor - self._log_base):]
-            return {"responses": resp,
-                    "cursor": self._log_base + len(self._log)}
+            out = {"responses": resp,
+                   "cursor": self._log_base + len(self._log)}
+            if self._autotuner is not None:
+                out["tuned"] = {
+                    "cycle_time_ms": self._tuned_params.cycle_time_ms}
+            return out
 
     def _gc_log(self):
         """Drop log entries every process has polled past.  Must hold
@@ -470,10 +519,14 @@ class RendezvousServer:
 
     def __init__(self, secret: bytes = None, world_size: int = 0,
                  fusion_threshold_bytes: int = 128 * 1024 * 1024,
-                 cache_capacity: int = 1024):
+                 cache_capacity: int = 1024, autotune: bool = False,
+                 autotune_log: str = None, cycle_time_ms: float = 1.0):
         self.store = KVStore()
         self.coordinator = Coordinator(world_size, fusion_threshold_bytes,
-                                       cache_capacity=cache_capacity)
+                                       cache_capacity=cache_capacity,
+                                       autotune=autotune,
+                                       autotune_log=autotune_log,
+                                       cycle_time_ms=cycle_time_ms)
         self.secret = secret
         self._httpd = None
         self._thread = None
@@ -493,6 +546,7 @@ class RendezvousServer:
         return self._httpd.server_address[1] if self._httpd else None
 
     def stop(self):
+        self.coordinator.close()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -21,7 +21,7 @@ import time
 from typing import List, Optional
 
 from .hosts import SlotInfo, get_host_assignments, parse_hosts
-from .http.http_server import RendezvousServer, local_ip
+from .http.http_server import RendezvousServer, autotune_kwargs, local_ip
 
 
 def _free_port():
@@ -140,9 +140,12 @@ def launch_procs(command: List[str], np: int, hosts: str = None,
     slots = get_host_assignments(host_infos, num_procs)
 
     secret_hex = _secrets.token_hex(16)
-    server = RendezvousServer(secret=bytes.fromhex(secret_hex),
-                              world_size=num_procs,
-                              fusion_threshold_bytes=fusion_threshold_bytes)
+    launcher_env = dict(os.environ)
+    launcher_env.update(env or {})
+    server = RendezvousServer(
+        secret=bytes.fromhex(secret_hex), world_size=num_procs,
+        fusion_threshold_bytes=fusion_threshold_bytes,
+        **autotune_kwargs(launcher_env))
     rdv_port = server.start()
     rdv_addr = "127.0.0.1" if all(
         h.hostname in ("localhost", "127.0.0.1") for h in host_infos) \
@@ -152,8 +155,7 @@ def launch_procs(command: List[str], np: int, hosts: str = None,
     pool = ProcessPool()
     try:
         for slot in slots:
-            child_env = dict(os.environ)
-            child_env.update(env or {})
+            child_env = dict(launcher_env)
             child_env.update(slot_env(
                 slot, rdv_addr=rdv_addr, rdv_port=rdv_port,
                 coordinator=coordinator, secret_hex=secret_hex,

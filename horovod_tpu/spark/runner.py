@@ -15,10 +15,15 @@ def run(fn, args=(), kwargs=None, num_proc=None, start_timeout=None,
     num_proc = num_proc or sc.defaultParallelism
     kwargs = kwargs or {}
 
-    from ..runner.http.http_server import RendezvousServer, local_ip
+    from ..runner.http.http_server import (
+        RendezvousServer, autotune_kwargs, local_ip,
+    )
     secret_hex = _secrets.token_hex(16)
+    at_env = dict(os.environ)
+    at_env.update(env or {})
     server = RendezvousServer(secret=bytes.fromhex(secret_hex),
-                              world_size=num_proc)
+                              world_size=num_proc,
+                              **autotune_kwargs(at_env))
     port = server.start()
     addr = local_ip()
     coordinator = f"{addr}:{_find_free_port()}"

@@ -223,6 +223,25 @@ def test_store_controller_cache_roundtrip():
         server.stop()
 
 
+def test_coordinator_autotune():
+    """Coordinator-side autotune: emitted batches feed the parameter
+    manager, the live fusion threshold follows the tuned value, and
+    poll replies broadcast the tuned cycle time to workers."""
+    c = Coordinator(world_size=1, fusion_threshold_bytes=4 * 2**20,
+                    autotune=True)
+    assert c._autotuner is not None
+    for i in range(35):
+        c.handle("ready", {"proc": 0, "nlocal": 1,
+                           "entries": [_meta(f"t{i}", 2**20, nprocs=1)]})
+    out = c.handle("poll", {"cursor": 0, "proc": 0, "wait": 0})
+    assert "tuned" in out
+    cyc = out["tuned"]["cycle_time_ms"]
+    assert 0.1 <= cyc <= 64.0
+    # the live threshold tracks the tuned parameter set
+    assert c.fusion_threshold == c._tuned_params.fusion_threshold_bytes
+    assert 2**20 <= c.fusion_threshold <= 2**28
+
+
 def test_coordinator_cross_process_validation():
     c = Coordinator(world_size=2)
     c.handle("ready", {"proc": 0, "nlocal": 1,
@@ -232,6 +251,18 @@ def test_coordinator_cross_process_validation():
     out = c.handle("poll", {"cursor": 0, "wait": 0})
     assert out["responses"][0]["kind"] == "error"
     assert "float64" in out["responses"][0]["message"]
+
+
+def test_scaling_harness():
+    """The weak-scaling efficiency harness runs end-to-end and reports
+    monotone device counts with efficiency 1.0 at the base count."""
+    sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+    import scaling
+    results = scaling.main(["--counts", "1,2", "--iters", "2",
+                            "--warmup", "1"])
+    assert [r["devices"] for r in results] == [1, 2]
+    assert results[0]["efficiency"] == 1.0
+    assert results[1]["throughput"] > 0
 
 
 WORKER = textwrap.dedent("""

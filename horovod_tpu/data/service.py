@@ -1,0 +1,196 @@
+"""Data compute service: run the input pipeline in separate processes
+and stream ready batches to training ranks.
+
+Reference: ``horovod/tensorflow/data/compute_service.py:34-147``
+(TfDataServiceConfig + tf.data dispatcher/worker cluster the training
+side connects to) and ``runner/common/service/compute_service.py``.
+The TPU-native formulation is framework-neutral: compute workers run
+any Python iterator (tf.data, torch DataLoader, generator) and serve
+pickled batches over the same HMAC-HTTP fabric the launcher already
+uses; training ranks consume via :func:`data_service`, each rank
+reading its own round-robin shard (the ``ShardingPolicy.FEDERATED``
+analogue) or any worker (``OFF``, work-stealing).
+
+On a TPU pod this moves CPU-heavy input processing off the training
+hosts — the same role tf.data service plays for the reference — while
+keeping one H2D transfer per batch on the training side.
+"""
+
+import pickle
+import queue
+import secrets as _secrets
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Iterator, Optional
+
+
+class _WorkerError:
+    """Poison sentinel a compute worker publishes when its dataset
+    iterator raises, so consumers fail loudly instead of treating the
+    truncated stream as clean end-of-data."""
+
+    def __init__(self, message: str):
+        self.message = message
+
+from ..runner.http.http_server import RendezvousServer, local_ip
+from ..runner.http.http_client import StoreClient
+
+
+@dataclass
+class DataServiceConfig:
+    """Connection handle passed from the service side to training ranks
+    (reference TfDataServiceConfig.to_dict/from_dict round-trip)."""
+    addr: str
+    port: int
+    secret_hex: str
+    num_workers: int
+
+    def to_dict(self):
+        return {"addr": self.addr, "port": self.port,
+                "secret_hex": self.secret_hex,
+                "num_workers": self.num_workers}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(**d)
+
+
+class DataServiceServer:
+    """Dispatcher + in-process compute workers.
+
+    ``dataset_fn(worker_index, num_workers) -> iterator`` runs on each
+    compute worker thread; batches are pickled into per-worker bounded
+    queues served over HTTP GETs.  Start one of these per compute host
+    (or one with several workers on a fat host).
+    """
+
+    def __init__(self, dataset_fn: Callable[[int, int], Iterator],
+                 num_workers: int = 1, queue_size: int = 8,
+                 secret: bytes = None, reuse_server=None):
+        self.dataset_fn = dataset_fn
+        self.num_workers = num_workers
+        self.queue_size = queue_size
+        # a fresh secret per service: batches are pickles, so the HMAC
+        # is the only thing standing between the 0.0.0.0 listener and
+        # arbitrary code execution — same policy as the job launcher
+        # (proc_run.py secrets.token_hex)
+        self._secret = secret or _secrets.token_bytes(16)
+        self._server = reuse_server or RendezvousServer(secret=secret)
+        self._owns_server = reuse_server is None
+        self._queues = [queue.Queue(maxsize=queue_size)
+                        for _ in range(num_workers)]
+        self._threads = []
+        self._stop = threading.Event()
+        self._port = None
+
+    # -- service side --------------------------------------------------------
+
+    def start(self, port: int = 0) -> DataServiceConfig:
+        if self._owns_server:
+            self._port = self._server.start(port)
+        else:
+            self._port = self._server.port
+        # batches are pulled through the KV store: worker w publishes
+        # /data/<w>/<seq>; the consumer deletes after read (bounded by
+        # the producer waiting for the delete)
+        for w in range(self.num_workers):
+            t = threading.Thread(target=self._produce, args=(w,),
+                                 name=f"data-worker-{w}", daemon=True)
+            t.start()
+            self._threads.append(t)
+        return DataServiceConfig(
+            addr=local_ip(), port=self._port,
+            secret_hex=self._secret.hex(),
+            num_workers=self.num_workers)
+
+    def _produce(self, w):
+        store = self._server.store
+        seq = 0
+        final = None        # None sentinel = clean end of data
+        try:
+            it = self.dataset_fn(w, self.num_workers)
+            for batch in it:
+                while not self._stop.is_set():
+                    # bound the pipeline: wait for the consumer to
+                    # delete the batch `queue_size` slots back
+                    if seq < self.queue_size or store.get(
+                            f"/data/{w}/{seq - self.queue_size}") is None:
+                        break
+                    time.sleep(0.005)
+                if self._stop.is_set():
+                    return
+                store.put(f"/data/{w}/{seq}",
+                          pickle.dumps(batch, protocol=4))
+                seq += 1
+        except BaseException as exc:  # noqa: BLE001 — forwarded
+            final = _WorkerError(f"{type(exc).__name__}: {exc}")
+        finally:
+            store.put(f"/data/{w}/{seq}", pickle.dumps(final, protocol=4))
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+        if self._owns_server:
+            self._server.stop()
+
+
+def data_service(config: DataServiceConfig, rank: int = 0,
+                 size: int = 1, timeout: float = 60.0,
+                 prefetch: int = 2) -> Iterator:
+    """Training-side consumer (reference ``tf_data_service()`` context,
+    compute_service.py:89): yields batches from the service.
+
+    With ``size`` ranks and ``num_workers`` compute workers, rank r
+    reads workers ``r, r+size, r+2*size, ...`` round-robin — each batch
+    is consumed by exactly one rank.
+    """
+    if isinstance(config, dict):
+        config = DataServiceConfig.from_dict(config)
+    client = StoreClient(config.addr, config.port,
+                         bytes.fromhex(config.secret_hex))
+    my_workers = [w for w in range(config.num_workers)
+                  if w % size == rank]
+    if not my_workers:
+        return
+    seqs = {w: 0 for w in my_workers}
+    live = set(my_workers)
+    q: "queue.Queue" = queue.Queue(maxsize=max(prefetch, 1))
+
+    _DONE = object()
+
+    def fetch():
+        try:
+            while live:
+                for w in list(live):
+                    raw = client.get(f"/data/{w}/{seqs[w]}", wait=timeout)
+                    if raw is None:
+                        raise TimeoutError(
+                            f"data service worker {w} produced nothing "
+                            f"for {timeout}s")
+                    client.delete(f"/data/{w}/{seqs[w]}")
+                    seqs[w] += 1
+                    batch = pickle.loads(raw)
+                    if batch is None:        # worker exhausted
+                        live.discard(w)
+                        continue
+                    if isinstance(batch, _WorkerError):
+                        raise RuntimeError(
+                            f"data service worker {w} failed: "
+                            f"{batch.message}")
+                    q.put(batch)
+            q.put(_DONE)
+        except BaseException as exc:  # noqa: BLE001 — re-raised below
+            q.put(exc)
+
+    t = threading.Thread(target=fetch, name="data-service-consumer",
+                         daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is _DONE:
+            break
+        if isinstance(item, BaseException):
+            raise item
+        yield item

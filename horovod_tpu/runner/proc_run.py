@@ -32,6 +32,50 @@ def _free_port():
     return port
 
 
+_LOCAL_HOSTNAMES = ("localhost", "127.0.0.1")
+
+#: Env prefixes forwarded to remote workers (reference gloo_run.py
+#: forwards the filtered launcher env plus the HOROVOD_* handoff).
+_REMOTE_ENV_PREFIXES = ("HOROVOD_", "JAX_", "XLA_", "TPU_", "PYTHON",
+                        "PATH", "LD_LIBRARY_PATH", "VIRTUAL_ENV")
+
+
+def is_local(hostname: str) -> bool:
+    return hostname in _LOCAL_HOSTNAMES or hostname == socket.gethostname()
+
+
+def ssh_command(hostname: str, command: List[str], env: dict,
+                cwd: str = None, ssh_port: int = None,
+                extra_keys=()):
+    """Build the ssh invocation that runs ``command`` on ``hostname``
+    (reference runner/util/remote.py get_remote_command + gloo
+    exec_command).  Returns ``(argv, stdin_payload)``.
+
+    The worker env — including ``HOROVOD_SECRET_KEY`` — travels on
+    **stdin** (sourced by the remote shell), never in argv, so it is
+    invisible to ``ps``/``/proc/*/cmdline`` on either host.  Besides
+    the standard prefixes, keys named in ``extra_keys`` (the caller's
+    explicit ``env=`` dict) are always forwarded.
+    """
+    import shlex
+    extra = set(extra_keys)
+    payload = "".join(
+        f"export {k}={shlex.quote(str(v))}\n"
+        for k, v in sorted(env.items())
+        if k.startswith(_REMOTE_ENV_PREFIXES) or k in extra)
+    parts = []
+    if cwd:
+        parts.append(f"cd {shlex.quote(cwd)}")
+    # source the env handoff from stdin, then exec the worker
+    parts.append(". /dev/stdin && exec "
+                 + " ".join(shlex.quote(c) for c in command))
+    ssh = ["ssh", "-o", "StrictHostKeyChecking=no",
+           "-o", "BatchMode=yes"]
+    if ssh_port:
+        ssh += ["-p", str(ssh_port)]
+    return ssh + [hostname, " && ".join(parts)], payload.encode()
+
+
 def slot_env(slot: SlotInfo, *, rdv_addr, rdv_port, coordinator,
              secret_hex, num_procs, ranks_per_proc=1, platform=None):
     """Env handoff for one worker (reference gloo_run.py:66-103)."""
@@ -67,9 +111,16 @@ class ProcessPool:
     def __init__(self):
         self.procs: List[subprocess.Popen] = []
 
-    def spawn(self, command, env, stdout=None, stderr=None):
-        p = subprocess.Popen(command, env=env, stdout=stdout,
-                             stderr=stderr)
+    def spawn(self, command, env, stdout=None, stderr=None,
+              stdin_data: bytes = None):
+        p = subprocess.Popen(
+            command, env=env, stdout=stdout, stderr=stderr,
+            stdin=subprocess.PIPE if stdin_data is not None else None)
+        if stdin_data is not None:
+            # deliver the payload and close so the remote shell sees
+            # EOF (the env handoff is sourced from stdin)
+            p.stdin.write(stdin_data)
+            p.stdin.close()
         self.procs.append(p)
         return p
 
@@ -127,13 +178,7 @@ def launch_procs(command: List[str], np: int, hosts: str = None,
     """
     hosts = hosts or f"localhost:{np}"
     host_infos = parse_hosts(hosts)
-    for h in host_infos:
-        if h.hostname not in ("localhost", "127.0.0.1",
-                              socket.gethostname()):
-            raise NotImplementedError(
-                f"remote host spawn ({h.hostname}) requires ssh "
-                f"plumbing; run one launcher per host or use the "
-                f"programmatic API")
+    any_remote = any(not is_local(h.hostname) for h in host_infos)
     if np % ranks_per_proc != 0:
         raise ValueError("np must be divisible by ranks-per-proc")
     num_procs = np // ranks_per_proc
@@ -147,10 +192,16 @@ def launch_procs(command: List[str], np: int, hosts: str = None,
         fusion_threshold_bytes=fusion_threshold_bytes,
         **autotune_kwargs(launcher_env))
     rdv_port = server.start()
-    rdv_addr = "127.0.0.1" if all(
-        h.hostname in ("localhost", "127.0.0.1") for h in host_infos) \
-        else local_ip()
-    coordinator = f"{rdv_addr}:{_free_port()}"
+    rdv_addr = local_ip() if any_remote else "127.0.0.1"
+    # jax.distributed's coordination service is hosted by PROCESS 0
+    # (basics.py), so its address must point at rank 0's host — not
+    # the launcher.  The port is probed free locally when rank 0 is
+    # local; for a remote rank 0 it is a high random port (collision
+    # surfaces as an init-timeout, same failure mode as the
+    # reference's probe-then-bind race).
+    rank0_host = slots[0].hostname
+    coord_host = rdv_addr if is_local(rank0_host) else rank0_host
+    coordinator = f"{coord_host}:{_free_port()}"
 
     pool = ProcessPool()
     try:
@@ -161,10 +212,19 @@ def launch_procs(command: List[str], np: int, hosts: str = None,
                 coordinator=coordinator, secret_hex=secret_hex,
                 num_procs=num_procs, ranks_per_proc=ranks_per_proc,
                 platform=platform))
+            if is_local(slot.hostname):
+                cmd, payload, spawn_env = command, None, child_env
+            else:
+                # remote spawn over ssh: worker env rides on stdin;
+                # ssh itself runs with the local env
+                cmd, payload = ssh_command(
+                    slot.hostname, command, child_env, cwd=os.getcwd(),
+                    extra_keys=set(env or {}))
+                spawn_env = dict(os.environ)
             if verbose:
-                print(f"[horovodrun] rank {slot.rank} -> {command}",
+                print(f"[horovodrun] rank {slot.rank} -> {cmd}",
                       file=sys.stderr)
-            pool.spawn(command, child_env)
+            pool.spawn(cmd, spawn_env, stdin_data=payload)
         codes = pool.wait(timeout=start_timeout)
     finally:
         pool.terminate()

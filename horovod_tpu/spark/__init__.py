@@ -4,17 +4,14 @@ task registers with a driver service and launches via gloo/mpirun).
 
 Gated: pyspark is not part of this image.  The run() contract is kept
 so Spark-side code ports unchanged; the launch path reuses the same
-rendezvous + env handoff as the CLI launcher.
+rendezvous + env handoff as the CLI launcher.  Estimators
+(``spark/keras``, ``spark/torch`` — reference spark/keras/estimator.py:92,
+spark/torch/estimator.py) train through this framework's rank launcher;
+only the DataFrame leg needs pyspark (``fit_arrays`` works without it).
 """
 
-
-def _require_pyspark():
-    try:
-        import pyspark  # noqa: F401
-    except ImportError as exc:
-        raise ImportError(
-            "horovod_tpu.spark requires pyspark, which is not "
-            "installed in this environment") from exc
+from .common import Store, FilesystemStore, LocalStore  # noqa: F401
+from .common.util import require_pyspark as _require_pyspark  # noqa: F401
 
 
 def run(fn, args=(), kwargs=None, num_proc=None, start_timeout=None,

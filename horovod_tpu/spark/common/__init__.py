@@ -1,0 +1,4 @@
+"""Shared estimator machinery (reference ``horovod/spark/common/``)."""
+
+from .store import Store, FilesystemStore, LocalStore  # noqa: F401
+from .params import EstimatorParams  # noqa: F401

@@ -1,0 +1,70 @@
+"""Estimator parameter surface.
+
+Reference: ``horovod/spark/common/params.py`` (507 LoC of Spark ML
+``Params`` boilerplate — getters/setters for model, loss, optimizer,
+batch size, epochs, callbacks, ...).  The TPU build keeps the same
+parameter names on a plain validated container; Spark ML's Param
+machinery adds nothing on a TPU pod.
+"""
+
+
+class EstimatorParams:
+    _DEFAULTS = dict(
+        model=None,
+        optimizer=None,
+        loss=None,
+        metrics=(),
+        feature_cols=("features",),
+        label_cols=("label",),
+        batch_size=32,
+        epochs=1,
+        validation=None,            # fraction or column name
+        num_proc=1,
+        store=None,
+        callbacks=(),
+        shuffle_buffer_size=None,
+        verbose=1,
+        run_id=None,
+        train_steps_per_epoch=None,
+        validation_steps_per_epoch=None,
+        transformation_fn=None,
+        sample_weight_col=None,
+        gradient_compression=None,
+        backward_passes_per_step=1,
+    )
+
+    def __init__(self, **kwargs):
+        unknown = set(kwargs) - set(self._DEFAULTS)
+        if unknown:
+            raise ValueError(
+                f"unknown estimator parameters: {sorted(unknown)}")
+        for k, v in self._DEFAULTS.items():
+            setattr(self, k, kwargs.get(k, v))
+        self._validate()
+
+    def _validate(self):
+        if self.batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        if self.epochs <= 0:
+            raise ValueError("epochs must be positive")
+        if self.num_proc <= 0:
+            raise ValueError("num_proc must be positive")
+        if self.validation is not None:
+            if not isinstance(self.validation, float):
+                # the reference also accepts a column name; that only
+                # makes sense on the DataFrame path, which this build
+                # gates — reject loudly instead of silently ignoring
+                raise NotImplementedError(
+                    "validation must be a float fraction (column-name "
+                    "validation needs the pyspark DataFrame path)")
+            if not 0.0 < self.validation < 1.0:
+                raise ValueError("validation fraction must be in (0, 1)")
+
+    # reference-parity getters (spark ML style)
+    def getModel(self): return self.model            # noqa: E704
+    def getLoss(self): return self.loss              # noqa: E704
+    def getOptimizer(self): return self.optimizer    # noqa: E704
+    def getBatchSize(self): return self.batch_size   # noqa: E704
+    def getEpochs(self): return self.epochs          # noqa: E704
+    def getNumProc(self): return self.num_proc       # noqa: E704
+    def getStore(self): return self.store            # noqa: E704

@@ -1,0 +1,113 @@
+"""Artifact store for estimator runs.
+
+Reference: ``horovod/spark/common/store.py:38-540`` — ``Store`` maps a
+run id to train/val data paths, checkpoint and logs directories, and
+abstracts local FS vs HDFS vs DBFS.  The TPU build keeps the same
+surface on the local/NFS filesystem (every TPU pod host mounts shared
+storage); HDFS would be a subclass, gated on pyarrow's hdfs driver.
+"""
+
+import os
+import shutil
+
+
+class Store:
+    """Run-artifact layout + blob IO (reference store.py Store)."""
+
+    def __init__(self, prefix_path: str):
+        self.prefix_path = str(prefix_path)
+
+    # -- layout (reference store.py:117-170) --------------------------------
+
+    def get_full_path(self, *parts) -> str:
+        return os.path.join(self.prefix_path, *parts)
+
+    def get_train_data_path(self, idx=None) -> str:
+        p = self.get_full_path("intermediate_train_data")
+        return f"{p}.{idx}" if idx is not None else p
+
+    def get_val_data_path(self, idx=None) -> str:
+        p = self.get_full_path("intermediate_val_data")
+        return f"{p}.{idx}" if idx is not None else p
+
+    def get_test_data_path(self, idx=None) -> str:
+        p = self.get_full_path("intermediate_test_data")
+        return f"{p}.{idx}" if idx is not None else p
+
+    def get_runs_path(self) -> str:
+        return self.get_full_path("runs")
+
+    def get_run_path(self, run_id: str) -> str:
+        return os.path.join(self.get_runs_path(), run_id)
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return os.path.join(self.get_run_path(run_id), "checkpoint")
+
+    def get_logs_path(self, run_id: str) -> str:
+        return os.path.join(self.get_run_path(run_id), "logs")
+
+    def get_checkpoint_filename(self) -> str:
+        return "checkpoint.bin"
+
+    def get_logs_subdir(self) -> str:
+        return "logs"
+
+    # -- IO ------------------------------------------------------------------
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def read(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def write(self, path: str, data: bytes):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def delete(self, path: str):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def read_serialized_keras_model(self, ckpt_path, model=None,
+                                    custom_objects=None):
+        return self.read(ckpt_path)
+
+    # -- checkpoints ---------------------------------------------------------
+
+    def save_checkpoint(self, run_id: str, data: bytes):
+        self.write(os.path.join(self.get_checkpoint_path(run_id),
+                                self.get_checkpoint_filename()), data)
+
+    def load_checkpoint(self, run_id: str) -> bytes:
+        path = os.path.join(self.get_checkpoint_path(run_id),
+                            self.get_checkpoint_filename())
+        return self.read(path) if self.exists(path) else None
+
+    @classmethod
+    def create(cls, prefix_path: str, *args, **kwargs) -> "Store":
+        """Factory (reference store.py:96-113 picks the backend from
+        the URL scheme)."""
+        if str(prefix_path).startswith(("hdfs://", "dbfs:/")):
+            raise NotImplementedError(
+                f"{prefix_path}: only filesystem stores are wired on "
+                f"this image; mount the remote FS and pass its path")
+        return FilesystemStore(prefix_path, *args, **kwargs)
+
+
+class FilesystemStore(Store):
+    """Local / NFS-mounted store (reference FilesystemStore)."""
+
+    def __init__(self, prefix_path: str):
+        super().__init__(prefix_path)
+        os.makedirs(self.prefix_path, exist_ok=True)
+
+
+#: Alias kept for reference-API parity (reference LocalStore wraps the
+#: local FS the same way).
+LocalStore = FilesystemStore

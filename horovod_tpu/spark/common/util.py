@@ -1,0 +1,41 @@
+"""Shared estimator helpers (reference ``horovod/spark/common/util.py``
+— the DataFrame materialization and validation-split machinery both
+framework estimators call into)."""
+
+import numpy as np
+
+
+def require_pyspark():
+    try:
+        import pyspark  # noqa: F401
+    except ImportError as exc:
+        raise ImportError(
+            "DataFrame fit()/transform() requires pyspark, which is "
+            "not installed in this environment; use fit_arrays(x, y)"
+        ) from exc
+
+
+def extract_x(pdf, feature_cols):
+    """Materialize the feature matrix from a pandas frame (the
+    post-``toPandas`` leg of reference util.py prepare_data)."""
+    feature_cols = list(feature_cols)
+    if len(feature_cols) == 1:
+        return np.stack([np.asarray(v) for v in pdf[feature_cols[0]]])
+    return np.column_stack([pdf[c].to_numpy() for c in feature_cols])
+
+
+def extract_xy(pdf, feature_cols, label_cols):
+    x = extract_x(pdf, feature_cols)
+    y = np.asarray(pdf[list(label_cols)[0]].tolist())
+    return x, y
+
+
+def split_validation(x, y, x_val, y_val, validation):
+    """Apply a float validation fraction when no explicit val set was
+    given (column-name validation is a DataFrame-path feature the
+    params layer rejects up front)."""
+    if x_val is None and isinstance(validation, float):
+        n_val = max(1, int(len(x) * validation))
+        x, x_val = x[:-n_val], x[-n_val:]
+        y, y_val = y[:-n_val], y[-n_val:]
+    return x, y, x_val, y_val

@@ -1,0 +1,3 @@
+"""Keras estimator (reference ``horovod/spark/keras/``)."""
+
+from .estimator import KerasEstimator, KerasModel  # noqa: F401

@@ -1,0 +1,151 @@
+"""KerasEstimator / KerasModel.
+
+Reference: ``horovod/spark/keras/estimator.py:92`` + ``remote.py`` —
+Spark ML Estimator that trains a keras model under Horovod with
+``DistributedOptimizer`` + broadcast/metric-average callbacks and
+checkpoints through the ``Store``.
+
+Same TPU-native shape as the torch estimator: the training loop runs
+on this framework's rank launcher; the DataFrame leg is a pyspark-gated
+adapter over :meth:`KerasEstimator.fit_arrays`.
+"""
+
+import pickle
+
+import numpy as np
+
+from ..common.params import EstimatorParams
+from ..common.store import Store
+from ..common.util import (
+    extract_x, extract_xy, require_pyspark, split_validation,
+)
+
+
+class KerasEstimator(EstimatorParams):
+    """``model`` is a compiled-or-not keras model; ``optimizer`` a
+    keras optimizer (re-created per rank from its config); ``loss`` a
+    keras loss (name or callable)."""
+
+    def fit(self, df, params=None):
+        require_pyspark()
+        x, y = extract_xy(df.toPandas(), self.feature_cols,
+                          self.label_cols)
+        return self.fit_arrays(x, y)
+
+    def fit_arrays(self, x, y, x_val=None, y_val=None):
+        from ... import run as hvd_run
+        from ... import keras as hvd_keras
+
+        x = np.asarray(x)
+        y = np.asarray(y)
+        x, y, x_val, y_val = split_validation(x, y, x_val, y_val,
+                                              self.validation)
+
+        est = self
+        model_blob = _serialize_keras(self.model)
+        opt_conf = _optimizer_config(self.optimizer)
+        store = self.store
+        run_id = self.run_id or "run"
+
+        def train_fn():
+            import tensorflow as tf
+
+            rank, size = hvd_keras.rank(), hvd_keras.size()
+            model = _deserialize_keras(model_blob)
+            opt = tf.keras.optimizers.get(
+                {"class_name": opt_conf[0], "config": opt_conf[1]})
+            opt = hvd_keras.DistributedOptimizer(opt)
+            # eager train step: this frontend stages gradients through
+            # host numpy (STATUS.md: eager-first TF binding), which a
+            # compiled tf.function train_step cannot do
+            model.compile(optimizer=opt, loss=est.loss,
+                          metrics=list(est.metrics), run_eagerly=True)
+            cb = [hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0),
+                  hvd_keras.callbacks.MetricAverageCallback()]
+            cb += list(est.callbacks)
+            val = (x_val, y_val) if x_val is not None else None
+            hist = model.fit(x[rank::size], y[rank::size],
+                             batch_size=est.batch_size,
+                             epochs=est.epochs,
+                             validation_data=val,
+                             callbacks=cb,
+                             verbose=est.verbose if rank == 0 else 0)
+            if rank == 0:
+                # pair the pre-compile architecture json with the
+                # trained weights: the compiled model's config embeds
+                # the dynamic Distributed* optimizer class, which
+                # cannot deserialize (reference keras/util.py saves
+                # with include_optimizer=False for the same reason)
+                blob = pickle.dumps(
+                    {"json": pickle.loads(model_blob)["json"],
+                     "weights": model.get_weights()},
+                    protocol=pickle.HIGHEST_PROTOCOL)
+                if store is not None:
+                    store.save_checkpoint(run_id, blob)
+                return blob, {k: [float(v) for v in vs]
+                              for k, vs in hist.history.items()}
+            return None
+
+        results = hvd_run(train_fn, np=self.num_proc)
+        blob, history = next(r for r in results if r is not None)
+        return KerasModel(model=_deserialize_keras(blob),
+                          history=history,
+                          feature_cols=self.feature_cols,
+                          label_cols=self.label_cols,
+                          run_id=run_id, store=store)
+
+
+class KerasModel:
+    def __init__(self, model=None, history=None, feature_cols=None,
+                 label_cols=None, run_id=None, store=None):
+        self.model = model
+        self.history = history or {}
+        self.feature_cols = feature_cols
+        self.label_cols = label_cols
+        self.run_id = run_id
+        self.store = store
+
+    def getModel(self):
+        return self.model
+
+    def transform_arrays(self, x):
+        return np.asarray(self.model.predict(np.asarray(x), verbose=0))
+
+    def transform(self, df):
+        require_pyspark()
+        pdf = df.toPandas()
+        x = extract_x(pdf, self.feature_cols)
+        pdf["prediction"] = list(self.transform_arrays(x))
+        from pyspark.sql import SparkSession
+        return SparkSession.builder.getOrCreate().createDataFrame(pdf)
+
+    @classmethod
+    def load(cls, store: Store, run_id: str, **kwargs):
+        blob = store.load_checkpoint(run_id)
+        if blob is None:
+            raise FileNotFoundError(f"no checkpoint for run {run_id}")
+        return cls(model=_deserialize_keras(blob), run_id=run_id,
+                   store=store, **kwargs)
+
+
+def _serialize_keras(model) -> bytes:
+    """Architecture + weights, no tf SavedModel dir (reference
+    keras/util.py serialize_model uses h5 bytes the same way)."""
+    payload = {"json": model.to_json(),
+               "weights": model.get_weights()}
+    return pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def _deserialize_keras(blob: bytes):
+    import tensorflow as tf
+    payload = pickle.loads(blob)
+    model = tf.keras.models.model_from_json(payload["json"])
+    model.set_weights(payload["weights"])
+    return model
+
+
+def _optimizer_config(opt):
+    import tensorflow as tf
+    if isinstance(opt, str):
+        opt = tf.keras.optimizers.get(opt)
+    return opt.__class__.__name__, opt.get_config()

@@ -1,0 +1,3 @@
+"""Torch estimator (reference ``horovod/spark/torch/``)."""
+
+from .estimator import TorchEstimator, TorchModel  # noqa: F401

@@ -1,0 +1,180 @@
+"""TorchEstimator / TorchModel.
+
+Reference: ``horovod/spark/torch/estimator.py`` + ``remote.py`` — a
+Spark ML Estimator that materializes a DataFrame, launches a Horovod
+job that trains a torch model with ``DistributedOptimizer``, checkpoints
+through the ``Store``, and returns a ``TorchModel`` transformer.
+
+TPU-native shape: the training loop is the same engine this framework
+uses everywhere (hook-based DistributedOptimizer over compiled XLA
+collectives, rank threads on one host / processes on a pod).  The
+Spark-DataFrame leg is a thin adapter gated on pyspark; all training
+logic is exercised through :meth:`TorchEstimator.fit_arrays`, which is
+also the path Spark rows take after materialization.
+"""
+
+import io
+import pickle
+
+import numpy as np
+
+from ..common.params import EstimatorParams
+from ..common.store import Store
+from ..common.util import (
+    extract_x, extract_xy, require_pyspark, split_validation,
+)
+
+
+class TorchEstimator(EstimatorParams):
+    """Trains a torch model across ranks; returns :class:`TorchModel`.
+
+    ``optimizer`` may be a factory ``params -> torch.optim.Optimizer``
+    or an optimizer instance (its class + defaults are re-instantiated
+    per rank, as the reference's remote trainer does).
+    """
+
+    def fit(self, df, params=None):
+        """Spark entry (reference estimator.py fit): materialize the
+        DataFrame columns and train."""
+        require_pyspark()
+        x, y = extract_xy(df.toPandas(), self.feature_cols,
+                          self.label_cols)
+        return self.fit_arrays(x, y)
+
+    def fit_arrays(self, x, y, x_val=None, y_val=None):
+        """Train on host arrays (the post-materialization path)."""
+        import torch
+
+        from ... import run as hvd_run
+        from ...torch import (
+            DistributedOptimizer, broadcast_parameters, allreduce,
+        )
+        from ... import torch as hvd
+
+        x = np.asarray(x)
+        y = np.asarray(y)
+        x, y, x_val, y_val = split_validation(x, y, x_val, y_val,
+                                              self.validation)
+
+        est = self
+        model_bytes = _serialize_model(self.model)
+        store = self.store
+        run_id = self.run_id or "run"
+
+        def train_fn():
+            rank, size = hvd.rank(), hvd.size()
+            model = _deserialize_model(model_bytes)
+            optimizer = _make_optimizer(est.optimizer, model)
+            optimizer = DistributedOptimizer(
+                optimizer, named_parameters=model.named_parameters(),
+                backward_passes_per_step=est.backward_passes_per_step)
+            broadcast_parameters(model.state_dict(), root_rank=0)
+
+            xs = torch.as_tensor(x[rank::size])
+            ys = torch.as_tensor(y[rank::size])
+            history = []
+            for epoch in range(est.epochs):
+                model.train()
+                perm = torch.randperm(
+                    len(xs), generator=torch.Generator().manual_seed(epoch))
+                total, count = 0.0, 0
+                for i in range(0, len(xs), est.batch_size):
+                    idx = perm[i:i + est.batch_size]
+                    optimizer.zero_grad()
+                    out = model(xs[idx])
+                    loss = est.loss(out, ys[idx])
+                    loss.backward()
+                    optimizer.step()
+                    total += float(loss.detach()) * len(idx)
+                    count += len(idx)
+                # metric averaging across ranks (reference remote.py
+                # averages epoch metrics with allreduce)
+                train_loss = float(allreduce(
+                    torch.tensor(total / max(count, 1)),
+                    name=f"train_loss.{epoch}"))
+                entry = {"epoch": epoch, "train_loss": train_loss}
+                if x_val is not None:
+                    model.eval()
+                    with torch.no_grad():
+                        vout = model(torch.as_tensor(x_val))
+                        vloss = float(est.loss(
+                            vout, torch.as_tensor(y_val)))
+                    entry["val_loss"] = float(allreduce(
+                        torch.tensor(vloss), name=f"val_loss.{epoch}"))
+                history.append(entry)
+                if rank == 0 and store is not None:
+                    store.save_checkpoint(
+                        run_id, _serialize_model(model))
+            return (_serialize_model(model), history) if rank == 0 \
+                else None
+
+        results = hvd_run(train_fn, np=self.num_proc)
+        model_out, history = next(r for r in results if r is not None)
+        return TorchModel(model=_deserialize_model(model_out),
+                          history=history,
+                          feature_cols=self.feature_cols,
+                          label_cols=self.label_cols,
+                          run_id=run_id, store=store)
+
+
+class TorchModel:
+    """Trained transformer (reference spark/torch TorchModel)."""
+
+    def __init__(self, model=None, history=None, feature_cols=None,
+                 label_cols=None, run_id=None, store=None):
+        self.model = model
+        self.history = history or []
+        self.feature_cols = feature_cols
+        self.label_cols = label_cols
+        self.run_id = run_id
+        self.store = store
+
+    def getModel(self):
+        return self.model
+
+    def transform_arrays(self, x):
+        import torch
+        self.model.eval()
+        with torch.no_grad():
+            return self.model(torch.as_tensor(np.asarray(x))).numpy()
+
+    def transform(self, df):
+        """Spark transform: adds a prediction column."""
+        require_pyspark()
+        pdf = df.toPandas()
+        x = extract_x(pdf, self.feature_cols)
+        pdf["prediction"] = list(self.transform_arrays(x))
+        from pyspark.sql import SparkSession
+        return SparkSession.builder.getOrCreate().createDataFrame(pdf)
+
+    @classmethod
+    def load(cls, store: Store, run_id: str, **kwargs):
+        blob = store.load_checkpoint(run_id)
+        if blob is None:
+            raise FileNotFoundError(f"no checkpoint for run {run_id}")
+        return cls(model=_deserialize_model(blob), run_id=run_id,
+                   store=store, **kwargs)
+
+
+def _serialize_model(model) -> bytes:
+    buf = io.BytesIO()
+    import torch
+    torch.save(model, buf, pickle_protocol=pickle.HIGHEST_PROTOCOL)
+    return buf.getvalue()
+
+
+def _deserialize_model(blob: bytes):
+    import torch
+    return torch.load(io.BytesIO(blob), weights_only=False)
+
+
+def _make_optimizer(spec, model):
+    import torch
+    if isinstance(spec, torch.optim.Optimizer):
+        # re-instantiate the same class + defaults on this rank's copy
+        # (reference remote.py rebuilds the optimizer from state)
+        return spec.__class__(model.parameters(), **spec.defaults)
+    if callable(spec):
+        return spec(model.parameters())
+    raise ValueError("optimizer must be a torch Optimizer or a factory "
+                     "params -> Optimizer")

@@ -47,9 +47,18 @@ def broadcast_variables(variables, root_rank, process_set=global_process_set):
     """Assign every variable to root's value (reference
     ``tensorflow/__init__.py`` broadcast_variables)."""
     variables = list(variables)
+
+    def _value(v):
+        # tf.Variable.value is a method; keras-3 Variable.value is a
+        # property returning the backing tensor
+        attr = getattr(v, "value", None)
+        if callable(attr):
+            return attr()
+        return attr if attr is not None else v
+
     handles = [
-        broadcast_async(v.value() if hasattr(v, "value") else v,
-                        root_rank, name=f"broadcast.{i}.{_var_name(v)}",
+        broadcast_async(_value(v), root_rank,
+                        name=f"broadcast.{i}.{_var_name(v)}",
                         process_set=process_set)
         for i, v in enumerate(variables)
     ]

@@ -1,0 +1,205 @@
+"""Platform-integration layer tests: estimator training (reference
+test/integration/test_spark.py trains tiny models through the
+estimator API), the data compute service (reference
+test/single/test_compute_service.py), and remote-spawn command
+synthesis (reference test/single/test_run.py mocks execute and asserts
+the built command)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from horovod_tpu.spark import Store, FilesystemStore
+from horovod_tpu.spark.common.params import EstimatorParams
+
+
+def test_store_layout_and_checkpoint(tmp_path):
+    store = Store.create(str(tmp_path / "store"))
+    assert isinstance(store, FilesystemStore)
+    assert store.get_checkpoint_path("r1").endswith("runs/r1/checkpoint")
+    store.save_checkpoint("r1", b"blob")
+    assert store.load_checkpoint("r1") == b"blob"
+    assert store.load_checkpoint("missing") is None
+    with pytest.raises(NotImplementedError):
+        Store.create("hdfs://nn/path")
+
+
+def test_estimator_params_validation():
+    p = EstimatorParams(batch_size=16, epochs=2, num_proc=4)
+    assert p.getBatchSize() == 16 and p.getEpochs() == 2
+    with pytest.raises(ValueError):
+        EstimatorParams(batch_size=0)
+    with pytest.raises(ValueError):
+        EstimatorParams(validation=1.5)
+    with pytest.raises(ValueError):
+        EstimatorParams(bogus_param=1)
+
+
+def test_torch_estimator_trains(tmp_path, hvd_shutdown):
+    import torch
+
+    from horovod_tpu.spark.torch import TorchEstimator, TorchModel
+
+    torch.manual_seed(0)
+    w = np.array([[2.0], [-1.0]], np.float32)
+    x = np.random.RandomState(0).randn(64, 2).astype(np.float32)
+    y = x @ w
+
+    store = Store.create(str(tmp_path / "store"))
+    est = TorchEstimator(
+        model=torch.nn.Linear(2, 1, bias=False),
+        optimizer=lambda params: torch.optim.SGD(params, lr=0.2),
+        loss=torch.nn.functional.mse_loss,
+        batch_size=8, epochs=20, num_proc=2, store=store,
+        run_id="fit1", validation=0.25)
+    model = est.fit_arrays(x, y)
+    assert isinstance(model, TorchModel)
+    # converged to the generating weights
+    pred = model.transform_arrays(x[:8])
+    np.testing.assert_allclose(pred, y[:8], atol=0.05)
+    # losses averaged across ranks and decreasing
+    assert model.history[-1]["train_loss"] < model.history[0]["train_loss"]
+    assert "val_loss" in model.history[-1]
+    # checkpoint round-trips through the store
+    loaded = TorchModel.load(store, "fit1")
+    np.testing.assert_allclose(loaded.transform_arrays(x[:4]),
+                               pred[:4], atol=1e-6)
+
+
+def test_torch_estimator_optimizer_instance(hvd_shutdown):
+    import torch
+
+    from horovod_tpu.spark.torch import TorchEstimator
+
+    proto = torch.nn.Linear(2, 1, bias=False)
+    est = TorchEstimator(
+        model=proto, optimizer=torch.optim.SGD(proto.parameters(), lr=0.1),
+        loss=torch.nn.functional.mse_loss, batch_size=16, epochs=2,
+        num_proc=2)
+    x = np.random.RandomState(1).randn(32, 2).astype(np.float32)
+    y = (x @ np.array([[1.0], [1.0]], np.float32))
+    model = est.fit_arrays(x, y)
+    assert model.history[-1]["train_loss"] < model.history[0]["train_loss"]
+
+
+def test_keras_estimator_trains(tmp_path, hvd_shutdown):
+    tf = pytest.importorskip("tensorflow")
+
+    from horovod_tpu.spark.keras import KerasEstimator, KerasModel
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(64, 3).astype(np.float32)
+    y = (x @ np.array([[1.0], [2.0], [-1.0]], np.float32))
+
+    inputs = tf.keras.Input((3,))
+    model = tf.keras.Model(inputs, tf.keras.layers.Dense(1, use_bias=False)(inputs))
+    store = Store.create(str(tmp_path / "store"))
+    est = KerasEstimator(model=model, optimizer="sgd", loss="mse",
+                         batch_size=16, epochs=8, num_proc=2,
+                         store=store, run_id="kfit", verbose=0)
+    out = est.fit_arrays(x, y)
+    assert isinstance(out, KerasModel)
+    assert out.history["loss"][-1] < out.history["loss"][0]
+    pred = out.transform_arrays(x[:8])
+    assert pred.shape == (8, 1)
+    loaded = KerasModel.load(store, "kfit")
+    np.testing.assert_allclose(loaded.transform_arrays(x[:4]),
+                               pred[:4], atol=1e-5)
+
+
+def test_data_service_round_robin():
+    from horovod_tpu.data import (
+        DataServiceConfig, DataServiceServer, data_service,
+    )
+
+    def dataset_fn(widx, num_workers):
+        for i in range(5):
+            yield {"worker": widx, "batch": i,
+                   "x": np.full((2, 2), widx * 10 + i)}
+
+    server = DataServiceServer(dataset_fn, num_workers=2, queue_size=3)
+    cfg = server.start()
+    try:
+        assert isinstance(cfg, DataServiceConfig)
+        cfg_dict = cfg.to_dict()           # reference to_dict/from_dict
+        # two consuming ranks, each owning one worker shard
+        got0 = list(data_service(cfg_dict, rank=0, size=2, timeout=30))
+        got1 = list(data_service(cfg_dict, rank=1, size=2, timeout=30))
+        assert [b["worker"] for b in got0] == [0] * 5
+        assert [b["worker"] for b in got1] == [1] * 5
+        assert [b["batch"] for b in got0] == list(range(5))
+        np.testing.assert_array_equal(got1[2]["x"], np.full((2, 2), 12))
+    finally:
+        server.stop()
+
+
+def test_ssh_command_synthesis():
+    from horovod_tpu.runner.proc_run import is_local, ssh_command
+
+    assert is_local("localhost") and is_local("127.0.0.1")
+    assert not is_local("worker-7")
+    cmd, payload = ssh_command(
+        "worker-7", ["python", "train me.py"],
+        {"HOROVOD_RANK": "3", "HOROVOD_SECRET_KEY": "s3cr3t",
+         "RANDOM_VAR": "x", "OMP_NUM_THREADS": "4",
+         "JAX_PLATFORMS": "tpu"},
+        cwd="/job dir", ssh_port=2222, extra_keys={"OMP_NUM_THREADS"})
+    assert cmd[0] == "ssh" and "worker-7" in cmd
+    assert "-p" in cmd and "2222" in cmd
+    remote = cmd[-1]
+    payload = payload.decode()
+    # env handoff travels on STDIN, never in argv (secret invisible
+    # to ps); explicit env= keys bypass the prefix filter
+    assert "s3cr3t" not in remote
+    assert "export HOROVOD_SECRET_KEY=s3cr3t" in payload
+    assert "export HOROVOD_RANK=3" in payload
+    assert "export JAX_PLATFORMS=tpu" in payload
+    assert "export OMP_NUM_THREADS=4" in payload
+    assert "RANDOM_VAR" not in payload
+    assert ". /dev/stdin && exec" in remote
+    assert "'/job dir'" in remote
+    assert "'train me.py'" in remote
+
+
+def test_ssh_stdin_env_handoff_executes():
+    """The stdin env-sourcing contract actually works in a shell: run
+    the remote command locally via sh (stand-in for sshd's shell)."""
+    import subprocess
+
+    from horovod_tpu.runner.proc_run import ssh_command
+
+    cmd, payload = ssh_command(
+        "ignored-host",
+        ["python", "-c", "import os; print(os.environ['HOROVOD_RANK'])"],
+        {"HOROVOD_RANK": "42"})
+    remote_script = cmd[-1]
+    out = subprocess.run(["sh", "-c", remote_script], input=payload,
+                         capture_output=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == b"42"
+
+
+def test_estimator_validation_column_rejected():
+    from horovod_tpu.spark.common.params import EstimatorParams
+
+    with pytest.raises(NotImplementedError):
+        EstimatorParams(validation="val_col")
+
+
+def test_data_service_worker_failure_surfaces():
+    from horovod_tpu.data import DataServiceServer, data_service
+
+    def bad_pipeline(w, n):
+        yield {"ok": 1}
+        raise OSError("corrupt shard")
+
+    server = DataServiceServer(bad_pipeline, num_workers=1)
+    cfg = server.start()
+    try:
+        it = data_service(cfg.to_dict(), rank=0, size=1, timeout=30)
+        assert next(it)["ok"] == 1
+        with pytest.raises(RuntimeError, match="corrupt shard"):
+            list(it)
+    finally:
+        server.stop()

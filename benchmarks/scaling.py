@@ -32,7 +32,9 @@ def parse_args(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--virtual", type=int, default=0,
                    help="use N virtual CPU devices instead of real chips")
-    p.add_argument("--model", choices=("transformer", "resnet"),
+    p.add_argument("--model",
+                   choices=("transformer", "resnet", "resnet101",
+                            "vgg16", "inception3"),
                    default="transformer")
     p.add_argument("--batch-per-device", type=int, default=0,
                    help="per-device batch (default: model-specific)")
@@ -105,16 +107,24 @@ def main(argv=None):
             dt = time.perf_counter() - t0
             return bpd * n * args.iters / dt      # sequences/sec
     else:
-        from horovod_tpu.models import ResNet50
+        from horovod_tpu.models import (
+            InceptionV3, ResNet50, ResNet101, VGG16,
+        )
+        factory = {"resnet": ResNet50, "resnet101": ResNet101,
+                   "vgg16": VGG16, "inception3": InceptionV3}[args.model]
         bpd = args.batch_per_device or (8 if on_cpu else 128)
-        model = ResNet50(num_classes=100 if on_cpu else 1000)
+        model = factory(num_classes=100 if on_cpu else 1000)
+        if args.model == "inception3":
+            # the stem's VALID convs need >= ~75px to survive
+            img_size = 96 if on_cpu else 299
+        else:
+            img_size = 64 if on_cpu else 224
 
         def run_one(n):
             mesh = build_mesh(MeshSpec(dp=n), devices[:n])
             images = jax.random.normal(
                 jax.random.PRNGKey(0),
-                (bpd * n, 64 if on_cpu else 224, 64 if on_cpu else 224,
-                 3), cfg_dtype)
+                (bpd * n, img_size, img_size, 3), cfg_dtype)
             labels = jax.random.randint(
                 jax.random.PRNGKey(1), (bpd * n,), 0,
                 100 if on_cpu else 1000)

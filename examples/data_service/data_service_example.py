@@ -1,0 +1,33 @@
+"""Offload the input pipeline to a data compute service (reference
+``examples/spark/tensorflow/tensorflow2_mnist_data_service*.py``:
+dispatcher + compute workers feed training ranks).  Here two compute
+workers run the (synthetic) pipeline; the training loop consumes
+batches without doing any input work itself."""
+
+import numpy as np
+
+from horovod_tpu.data import DataServiceServer, data_service
+
+
+def pipeline(worker_index, num_workers):
+    rs = np.random.RandomState(worker_index)
+    for step in range(8):
+        x = rs.randn(32, 16).astype(np.float32)   # pretend-augmented
+        y = rs.randint(0, 10, 32)
+        yield x, y
+
+
+def main():
+    server = DataServiceServer(pipeline, num_workers=2)
+    config = server.start()
+    try:
+        # a training rank consumes its shard of the batch stream
+        for i, (x, y) in enumerate(
+                data_service(config.to_dict(), rank=0, size=1)):
+            print(f"batch {i}: x{x.shape} y{y.shape}")
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

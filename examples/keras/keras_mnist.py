@@ -1,0 +1,57 @@
+"""Keras MNIST-style training with DistributedOptimizer + callbacks
+(reference ``examples/keras/keras_mnist.py`` /
+``examples/tensorflow2/tensorflow2_keras_mnist.py``: wrap the
+optimizer, scale the LR by size, broadcast initial state from rank 0,
+average metrics; synthetic data keeps it network-free)."""
+
+import argparse
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.keras as hvd
+
+parser = argparse.ArgumentParser()
+parser.add_argument("--epochs", type=int, default=2)
+parser.add_argument("--batch-size", type=int, default=32)
+
+
+def main():
+    args = parser.parse_args()
+    hvd.init()
+
+    tf.keras.utils.set_random_seed(42)
+    model = tf.keras.Sequential([
+        tf.keras.layers.Dense(128, activation="relu"),
+        tf.keras.layers.Dense(10),
+    ])
+    # LR scaled by world size (reference keras_mnist.py convention)
+    opt = hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(0.01 * hvd.size()))
+    model.compile(
+        optimizer=opt,
+        loss=tf.keras.losses.SparseCategoricalCrossentropy(
+            from_logits=True),
+        metrics=["accuracy"],
+        run_eagerly=True)   # this binding stages grads through host
+
+    rs = np.random.RandomState(1234 + hvd.rank())
+    x = rs.randn(args.batch_size * 8, 784).astype(np.float32)
+    y = rs.randint(0, 10, len(x))
+
+    callbacks = [
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd.callbacks.MetricAverageCallback(),
+        hvd.callbacks.LearningRateWarmupCallback(
+            initial_lr=0.01 * hvd.size(), warmup_epochs=1, verbose=0),
+    ]
+    model.fit(x, y, batch_size=args.batch_size, epochs=args.epochs,
+              callbacks=callbacks,
+              verbose=1 if hvd.rank() == 0 else 0)
+    if hvd.rank() == 0:
+        print("done", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

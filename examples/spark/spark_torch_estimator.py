@@ -1,0 +1,37 @@
+"""Torch estimator workflow (reference
+``examples/spark/pytorch/pytorch_spark_mnist.py``): build an estimator
+with a Store, fit, transform.  With pyspark installed, ``est.fit(df)``
+takes a DataFrame; this example uses the array path that works
+everywhere (it is the same training loop the DataFrame leg calls)."""
+
+import numpy as np
+import torch
+
+from horovod_tpu.spark import Store
+from horovod_tpu.spark.torch import TorchEstimator
+
+
+def main():
+    rs = np.random.RandomState(0)
+    x = rs.randn(256, 8).astype(np.float32)
+    w = rs.randn(8, 1).astype(np.float32)
+    y = x @ w
+
+    store = Store.create("/tmp/horovod_tpu_spark_example")
+    est = TorchEstimator(
+        model=torch.nn.Sequential(torch.nn.Linear(8, 16),
+                                  torch.nn.ReLU(),
+                                  torch.nn.Linear(16, 1)),
+        optimizer=lambda p: torch.optim.Adam(p, lr=0.01),
+        loss=torch.nn.functional.mse_loss,
+        batch_size=32, epochs=20, num_proc=2,
+        store=store, run_id="example", validation=0.2)
+    model = est.fit_arrays(x, y)
+    print("final train loss:", model.history[-1]["train_loss"])
+    print("final val loss:  ", model.history[-1]["val_loss"])
+    pred = model.transform_arrays(x[:4])
+    print("predictions:", pred.ravel(), "targets:", y[:4].ravel())
+
+
+if __name__ == "__main__":
+    main()

@@ -25,6 +25,27 @@ _distributed_up = False
 _elastic_round = 0
 
 
+def _apply_platform_env(jax):
+    """Re-assert JAX_PLATFORMS / JAX_NUM_CPU_DEVICES as config updates
+    when backends are still uninitialized (see init())."""
+    import os
+
+    try:
+        from jax._src import xla_bridge as _xb
+        if _xb.backends_are_initialized():
+            return
+        plat = os.environ.get("JAX_PLATFORMS")
+        if plat and jax.config.jax_platforms != plat:
+            jax.config.update("jax_platforms", plat)
+        ncpu = os.environ.get("JAX_NUM_CPU_DEVICES")
+        if ncpu:
+            jax.config.update("jax_num_cpu_devices", int(ncpu))
+    except Exception:  # noqa: BLE001 — best effort: private API moved,
+        # config absent on this jax version, or malformed env value;
+        # init proceeds with whatever jax resolves from env alone
+        return
+
+
 def _elastic_rendezvous(rdv_addr, rdv_port, secret):
     """Fetch this worker's rank/size/coordinator for the next elastic
     round from the launcher's KV store (reference: rank/size re-fetched
@@ -117,6 +138,14 @@ def init(comm=None, process_sets=None, num_ranks=None, devices=None):
         if multiproc:
             from ..core.store_controller import StoreController
             import jax
+
+            # Honor the launcher's platform contract programmatically:
+            # site configs (e.g. a preloaded PJRT plugin) can override
+            # the JAX_PLATFORMS env var by force-setting the config at
+            # interpreter start, which would leave every worker on the
+            # wrong backend and break the global device view.  Only
+            # possible before first backend use.
+            _apply_platform_env(jax)
 
             rdv_addr = env_mod.get_str(env_mod.HOROVOD_RENDEZVOUS_ADDR,
                                        "127.0.0.1")

@@ -85,11 +85,13 @@ class LearningRateScheduleCallback(tf.keras.callbacks.Callback):
     def on_batch_begin(self, batch, logs=None):
         if self.staircase or not self._in_range(self.current_epoch):
             return
-        if self.steps_per_epoch is None:
+        steps = self.steps_per_epoch or \
+            (self.params or {}).get("steps")
+        if not steps:
             raise ValueError(
                 "steps_per_epoch is required for non-staircase "
-                "schedules")
-        epoch = self.current_epoch + float(batch) / self.steps_per_epoch
+                "schedules (keras did not report params['steps'])")
+        epoch = self.current_epoch + float(batch) / steps
         self._set_lr(self.initial_lr * self.multiplier(epoch))
 
     def on_epoch_end(self, epoch, logs=None):

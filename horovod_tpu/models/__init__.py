@@ -1,6 +1,8 @@
 """Model zoo for benchmarks and parallelism flagships."""
 
 from .resnet import ResNet, ResNet50, ResNet101, ResNet152  # noqa: F401
+from .vgg import VGG, VGG16, VGG19  # noqa: F401
+from .inception import InceptionV3  # noqa: F401
 from .transformer import (  # noqa: F401
     TransformerConfig, TransformerLM, DecoderBlock, RMSNorm,
     dense_causal_attention, lm_loss,

@@ -1,0 +1,154 @@
+"""Inception V3 in flax.linen, laid out for TPU.
+
+Third model of the reference's benchmark trio
+(``docs/benchmarks.rst:13``: 90% scaling efficiency at 512 GPUs).
+Standard Szegedy et al. 2015 topology (299x299 input, factorized 7x7,
+auxiliary head omitted — the benchmark configuration trains without
+it).  Same TPU-first conventions as resnet.py: NHWC, bf16 activations,
+f32 params/stats.
+"""
+
+from functools import partial
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvBN(nn.Module):
+    filters: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: str = "SAME"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.filters, self.kernel, self.strides,
+                    padding=self.padding, use_bias=False,
+                    dtype=self.dtype, param_dtype=jnp.float32)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=self.dtype,
+                         param_dtype=jnp.float32, axis_name=None)(x)
+        return nn.relu(x)
+
+
+class InceptionA(nn.Module):
+    pool_filters: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(64, (1, 1))(x, train)
+        b2 = cbn(48, (1, 1))(x, train)
+        b2 = cbn(64, (5, 5))(b2, train)
+        b3 = cbn(64, (1, 1))(x, train)
+        b3 = cbn(96, (3, 3))(b3, train)
+        b3 = cbn(96, (3, 3))(b3, train)
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = cbn(self.pool_filters, (1, 1))(b4, train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class ReductionA(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(384, (3, 3), (2, 2), padding="VALID")(x, train)
+        b2 = cbn(64, (1, 1))(x, train)
+        b2 = cbn(96, (3, 3))(b2, train)
+        b2 = cbn(96, (3, 3), (2, 2), padding="VALID")(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionB(nn.Module):
+    ch7: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        c = self.ch7
+        b1 = cbn(192, (1, 1))(x, train)
+        b2 = cbn(c, (1, 1))(x, train)
+        b2 = cbn(c, (1, 7))(b2, train)
+        b2 = cbn(192, (7, 1))(b2, train)
+        b3 = cbn(c, (1, 1))(x, train)
+        b3 = cbn(c, (7, 1))(b3, train)
+        b3 = cbn(c, (1, 7))(b3, train)
+        b3 = cbn(c, (7, 1))(b3, train)
+        b3 = cbn(192, (1, 7))(b3, train)
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = cbn(192, (1, 1))(b4, train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class ReductionB(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(192, (1, 1))(x, train)
+        b1 = cbn(320, (3, 3), (2, 2), padding="VALID")(b1, train)
+        b2 = cbn(192, (1, 1))(x, train)
+        b2 = cbn(192, (1, 7))(b2, train)
+        b2 = cbn(192, (7, 1))(b2, train)
+        b2 = cbn(192, (3, 3), (2, 2), padding="VALID")(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionC(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(320, (1, 1))(x, train)
+        b2 = cbn(384, (1, 1))(x, train)
+        b2a = cbn(384, (1, 3))(b2, train)
+        b2b = cbn(384, (3, 1))(b2, train)
+        b3 = cbn(448, (1, 1))(x, train)
+        b3 = cbn(384, (3, 3))(b3, train)
+        b3a = cbn(384, (1, 3))(b3, train)
+        b3b = cbn(384, (3, 1))(b3, train)
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = cbn(192, (1, 1))(b4, train)
+        return jnp.concatenate([b1, b2a, b2b, b3a, b3b, b4], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        # stem: 299x299x3 -> 35x35x192
+        x = cbn(32, (3, 3), (2, 2), padding="VALID")(x, train)
+        x = cbn(32, (3, 3), padding="VALID")(x, train)
+        x = cbn(64, (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = cbn(80, (1, 1), padding="VALID")(x, train)
+        x = cbn(192, (3, 3), padding="VALID")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        # 3x InceptionA -> ReductionA -> 4x InceptionB -> ReductionB
+        # -> 2x InceptionC
+        for pool_filters in (32, 64, 64):
+            x = InceptionA(pool_filters, dtype=self.dtype)(x, train)
+        x = ReductionA(dtype=self.dtype)(x, train)
+        for ch7 in (128, 160, 160, 192):
+            x = InceptionB(ch7, dtype=self.dtype)(x, train)
+        x = ReductionB(dtype=self.dtype)(x, train)
+        for _ in range(2):
+            x = InceptionC(dtype=self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=jnp.float32, name="head")(x)
+        return x

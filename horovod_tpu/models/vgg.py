@@ -1,0 +1,62 @@
+"""VGG family in flax.linen, laid out for TPU.
+
+The reference's benchmark trio is ResNet-101 / Inception V3 / VGG-16
+(``docs/benchmarks.rst:8-14``: 90% / 90% / 68% scaling efficiency at
+512 GPUs — VGG-16's 68% is the stress case because its ~138M params
+make the gradient allreduce enormous relative to compute).  Same
+TPU-first conventions as resnet.py: NHWC, bf16 activations on the MXU,
+f32 parameters.
+"""
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# (conv counts per stage, filters per stage) — classic configurations
+_VGG_CFG = {
+    11: (1, 1, 2, 2, 2),
+    13: (2, 2, 2, 2, 2),
+    16: (2, 2, 3, 3, 3),
+    19: (2, 2, 4, 4, 4),
+}
+_FILTERS = (64, 128, 256, 512, 512)
+
+
+class VGG(nn.Module):
+    """VGG-N with batch norm (the tf_cnn_benchmarks variant trains
+    without dropout at benchmark settings; BN keeps bf16 stable)."""
+    depth: int = 16
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       param_dtype=jnp.float32)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                       param_dtype=jnp.float32, axis_name=None)
+        x = x.astype(self.dtype)
+        for stage, n_convs in enumerate(_VGG_CFG[self.depth]):
+            for i in range(n_convs):
+                x = conv(_FILTERS[stage], (3, 3), padding="SAME",
+                         name=f"conv{stage}_{i}")(x)
+                x = norm(name=f"bn{stage}_{i}")(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(4096, dtype=self.dtype, param_dtype=jnp.float32,
+                     name="fc1")(x)
+        x = nn.relu(x)
+        x = nn.Dense(4096, dtype=self.dtype, param_dtype=jnp.float32,
+                     name="fc2")(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=jnp.float32, name="head")(x)
+        return x
+
+
+VGG16 = partial(VGG, depth=16)
+VGG19 = partial(VGG, depth=19)

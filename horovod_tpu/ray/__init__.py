@@ -92,3 +92,97 @@ class RayExecutor:
         self._workers = []
         if getattr(self, "_server", None):
             self._server.stop()
+
+
+class RayHostDiscovery:
+    """Discovery over the Ray autoscaler (reference
+    ray/elastic.py:25-70 RayHostDiscovery / elastic_v2.py
+    RayHostDiscovery): each alive Ray node with enough CPUs offers
+    ``slots`` worker slots, keyed by node IP."""
+
+    def __init__(self, use_gpu=False, cpus_per_slot=1,
+                 gpus_per_slot=0):
+        _require_ray()
+        self.cpus_per_slot = cpus_per_slot
+        self.use_gpu = use_gpu
+        self.gpus_per_slot = gpus_per_slot or (1 if use_gpu else 0)
+
+    def find_available_hosts_and_slots(self):
+        import ray
+        hosts = {}
+        for node in ray.nodes():
+            if not node.get("Alive"):
+                continue
+            res = node.get("Resources", {})
+            slots = int(res.get("CPU", 0) // self.cpus_per_slot)
+            if self.use_gpu:
+                slots = min(slots, int(res.get("GPU", 0)
+                                       // max(self.gpus_per_slot, 1)))
+            if slots > 0:
+                hosts[node["NodeManagerAddress"]] = slots
+        return hosts
+
+
+class ElasticRayExecutor:
+    """Elastic executor over Ray (reference ``ray/elastic.py:150``
+    ElasticRayExecutor): Ray-autoscaler discovery drives the same
+    ElasticDriver the CLI elastic launcher uses; worker processes come
+    up through `ray job`-hosted shells so a membership change re-forms
+    the mesh exactly like ``horovodrun --min-np/--max-np``.
+
+    ``run(fn)`` executes ``fn`` under ``hvd.elastic`` semantics on each
+    worker: the user wraps training in ``hvd.elastic.run`` with a
+    ``State`` and commits, as in the reference's usage.
+    """
+
+    @staticmethod
+    def create_settings(min_np=1, max_np=None, reset_limit=None,
+                        elastic_timeout=600, cpus_per_slot=1,
+                        use_gpu=False, override_discovery=None):
+        return {"min_np": min_np, "max_np": max_np,
+                "reset_limit": reset_limit,
+                "elastic_timeout": elastic_timeout,
+                "cpus_per_slot": cpus_per_slot, "use_gpu": use_gpu,
+                "override_discovery": override_discovery}
+
+    def __init__(self, settings, cpus_per_slot=None, use_gpu=None,
+                 env_vars=None):
+        _require_ray()
+        self.settings = dict(settings)
+        if cpus_per_slot is not None:
+            self.settings["cpus_per_slot"] = cpus_per_slot
+        if use_gpu is not None:
+            self.settings["use_gpu"] = use_gpu
+        self.env_vars = env_vars or {}
+        self._discovery = None
+
+    def start(self):
+        self._discovery = self.settings.get("override_discovery") or \
+            RayHostDiscovery(
+                use_gpu=self.settings.get("use_gpu", False),
+                cpus_per_slot=self.settings.get("cpus_per_slot", 1))
+
+    def run(self, worker_fn, callbacks=None):
+        """Run ``worker_fn`` elastically: one worker per discovered
+        slot (ssh spawn for remote Ray nodes — autoscaler deployments
+        share an ssh fabric), rounds re-forming on membership change.
+        ``elastic_timeout`` bounds waiting for min_np slots, never a
+        healthy training run."""
+        from ..runner.elastic_api import run_elastic_fn
+
+        if callbacks:
+            import warnings
+            warnings.warn(
+                "ElasticRayExecutor callbacks are not wired in this "
+                "build; register them inside worker_fn via "
+                "hvd.elastic.State(callbacks=...) instead")
+        run_elastic_fn(
+            worker_fn, discovery=self._discovery,
+            min_np=self.settings.get("min_np", 1),
+            max_np=self.settings.get("max_np"),
+            env=dict(self.env_vars),
+            reset_limit=self.settings.get("reset_limit"),
+            start_timeout=self.settings.get("elastic_timeout"))
+
+    def shutdown(self):
+        self._discovery = None

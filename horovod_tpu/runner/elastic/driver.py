@@ -22,6 +22,8 @@ import time
 from typing import Dict, List, Optional
 
 from ..hosts import get_host_assignments, parse_hosts, HostInfo
+from ..http.http_server import local_ip
+from ..proc_run import is_local, ssh_command
 from .discovery import HostManager
 from .registration import WorkerStateRegistry
 
@@ -142,7 +144,15 @@ class ElasticDriver:
             self._assignments = {
                 f"{s.hostname}:{s.local_rank}": s.rank for s in slots}
             size = len(slots)
-            coordinator = f"127.0.0.1:{_free_port()}"
+            # routable addresses when the round spans hosts: rendezvous
+            # lives here; the jax.distributed coordinator on rank 0's
+            # host (same rule as proc_run.launch_procs)
+            any_remote = any(not is_local(s.hostname) for s in slots)
+            self._rdv_addr = local_ip() if any_remote else "127.0.0.1"
+            rank0_host = slots[0].hostname
+            coord_host = self._rdv_addr if is_local(rank0_host) \
+                else rank0_host
+            coordinator = f"{coord_host}:{_free_port()}"
             self._registry.reset(size)
             self._server.coordinator.reset(world_size=size,
                                            round_id=self._round)
@@ -186,7 +196,8 @@ class ElasticDriver:
             "HOROVOD_CONTROLLER": "http",
             "HOROVOD_HOSTNAME": host,
             "HOROVOD_LOCAL_RANK": slot,
-            "HOROVOD_GLOO_RENDEZVOUS_ADDR": "127.0.0.1",
+            "HOROVOD_GLOO_RENDEZVOUS_ADDR": getattr(
+                self, "_rdv_addr", "127.0.0.1"),
             "HOROVOD_GLOO_RENDEZVOUS_PORT": str(self._server.port),
             "HOROVOD_SECRET_KEY": self._server.secret.hex()
             if self._server.secret else "",
@@ -202,7 +213,28 @@ class ElasticDriver:
             env["JAX_NUM_CPU_DEVICES"] = "1"
         if self._verbose:
             logger.info("spawning worker %s", key)
-        self._procs[key] = subprocess.Popen(self._command, env=env)
+        if is_local(host):
+            self._procs[key] = subprocess.Popen(self._command, env=env)
+        else:
+            # remote slot: same ssh + stdin env handoff as the static
+            # launcher (proc_run.ssh_command).  The Popen handle tracks
+            # the ssh client; terminating it drops the connection and
+            # sshd delivers SIGHUP to the remote worker.
+            cmd, payload = ssh_command(host, self._command, env,
+                                       cwd=os.getcwd(),
+                                       extra_keys=set(self._env))
+            p = subprocess.Popen(cmd, env=dict(os.environ),
+                                 stdin=subprocess.PIPE)
+            try:
+                p.stdin.write(payload)
+                p.stdin.close()
+            except (BrokenPipeError, OSError):
+                # ssh died instantly (unreachable host, auth failure):
+                # leave the dead Popen in _procs so the monitor thread
+                # reaps it and blacklists the host like any worker exit
+                logger.warning("ssh to %s closed before env handoff",
+                               host)
+            self._procs[key] = p
 
     # -- background threads --------------------------------------------------
 

@@ -1,0 +1,91 @@
+"""Programmatic elastic launch shared by the platform integrations.
+
+The Ray and Spark elastic entry points (reference ``ray/elastic.py``,
+``spark/runner.py:312``) differ only in where host discovery comes
+from; everything else — rendezvous server, pickled-function worker
+command, ElasticDriver lifecycle — is this helper.
+"""
+
+import os
+import secrets as _secrets
+import sys
+
+try:
+    # closures/lambdas ship like the reference's cloudpickle-based
+    # run services (runner/common/util/network.py wire format)
+    import cloudpickle
+except ImportError:  # pragma: no cover
+    cloudpickle = None
+
+from .elastic.driver import ElasticDriver
+from .http.http_server import RendezvousServer, autotune_kwargs
+
+FN_KEY = "/elastic/fn"
+
+# Worker stub: fetch the pickled (fn, args, kwargs) from the
+# launcher's KV store over the authenticated channel whose coordinates
+# arrive in the standard env handoff.  Remote workers need only
+# horovod_tpu installed — no shared filesystem (the reference ships
+# the function the same way, through its run services' HMAC protocol).
+_WORKER_STUB = """\
+import os, pickle
+from horovod_tpu.runner.http.http_client import StoreClient
+client = StoreClient(os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"],
+                     int(os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"]),
+                     bytes.fromhex(os.environ["HOROVOD_SECRET_KEY"]))
+fn, a, kw = pickle.loads(client.get("{fn_key}", wait=30))
+fn(*a, **kw)
+"""
+
+
+def run_elastic_fn(fn, args=(), kwargs=None, *, discovery, min_np,
+                   max_np=None, env=None, reset_limit=None,
+                   start_timeout=None, verbose=False):
+    """Run ``fn(*args, **kwargs)`` on every elastic worker.
+
+    ``discovery`` provides ``find_available_hosts_and_slots()``;
+    workers spawn per slot (ssh for remote hosts) and membership
+    changes re-form the mesh.  ``start_timeout`` bounds waiting for
+    ``min_np`` slots at startup — it does NOT bound job duration (the
+    reference's elastic_timeout bounds re-rendezvous, not training).
+    """
+    if cloudpickle is None:  # pragma: no cover
+        # stdlib pickle would serialize __main__ functions by
+        # reference, which the worker stub (whose __main__ is the
+        # stub) can never resolve — fail loudly instead
+        raise RuntimeError(
+            "run_elastic_fn requires cloudpickle to ship the training "
+            "function to workers (pip install cloudpickle)")
+    secret_hex = _secrets.token_hex(16)
+    env = dict(env or {})
+    # workers must import horovod_tpu even when the launcher runs it
+    # from a source tree (sys.path doesn't survive exec)
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (pkg_root, env.get("PYTHONPATH",
+                                      os.environ.get("PYTHONPATH", "")))
+        if p)
+    at_env = dict(os.environ)
+    at_env.update(env)
+    server = RendezvousServer(secret=bytes.fromhex(secret_hex),
+                              world_size=0, **autotune_kwargs(at_env))
+    server.start()
+    try:
+        server.store.put(FN_KEY, cloudpickle.dumps(
+            (fn, tuple(args), dict(kwargs or {})), protocol=4))
+        command = [sys.executable, "-c",
+                   _WORKER_STUB.format(fn_key=FN_KEY)]
+        driver = ElasticDriver(server, discovery, min_np=min_np,
+                               max_np=max_np or min_np, command=command,
+                               env=dict(env or {}),
+                               reset_limit=reset_limit, verbose=verbose)
+        if start_timeout:
+            driver.wait_for_available_slots(min_np,
+                                            timeout=start_timeout)
+        driver.start()
+        ok = driver.join()
+    finally:
+        server.stop()
+    if not ok:
+        raise RuntimeError("elastic job failed")

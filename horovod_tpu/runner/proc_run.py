@@ -11,6 +11,7 @@ process's devices into one global XLA client so compiled collectives
 span hosts (the TPU analogue of NCCL communicator bootstrap).
 """
 
+import functools
 import os
 import secrets as _secrets
 import signal
@@ -40,8 +41,19 @@ _REMOTE_ENV_PREFIXES = ("HOROVOD_", "JAX_", "XLA_", "TPU_", "PYTHON",
                         "PATH", "LD_LIBRARY_PATH", "VIRTUAL_ENV")
 
 
+@functools.lru_cache(maxsize=256)
 def is_local(hostname: str) -> bool:
-    return hostname in _LOCAL_HOSTNAMES or hostname == socket.gethostname()
+    """True when ``hostname`` addresses this machine (reference
+    network.get_local_host_addresses check in gloo exec_command).
+    Cached: the elastic driver asks per slot per round under its lock,
+    and an unresolvable name costs a full resolver timeout."""
+    if hostname in _LOCAL_HOSTNAMES or hostname == socket.gethostname():
+        return True
+    try:
+        addr = socket.gethostbyname(hostname)
+    except OSError:
+        return False
+    return addr.startswith("127.") or addr == local_ip()
 
 
 def ssh_command(hostname: str, command: List[str], env: dict,

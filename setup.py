@@ -14,7 +14,7 @@ setup(
                 "capability surface of Horovod",
     packages=find_packages(include=["horovod_tpu", "horovod_tpu.*"]),
     python_requires=">=3.10",
-    install_requires=["numpy", "jax", "ml_dtypes"],
+    install_requires=["numpy", "jax", "ml_dtypes", "cloudpickle"],
     extras_require={
         "models": ["flax", "optax"],
         "tensorflow": ["tensorflow"],

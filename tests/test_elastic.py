@@ -150,9 +150,9 @@ def test_elastic_scale_up(tmp_path):
     disc = tmp_path / "discover.sh"
     disc.write_text(textwrap.dedent(f"""\
         #!/bin/bash
-        echo "hostA:1"
+        echo "localhost:1"
         if grep -q "batch 2" {log} 2>/dev/null; then
-            echo "hostB:1"
+            echo "127.0.0.1:1"
         fi
     """))
     disc.chmod(disc.stat().st_mode | stat.S_IEXEC)
@@ -204,7 +204,7 @@ def test_elastic_worker_failure_recovery(tmp_path):
         def train(state):
             while state.batch < 8:
                 if (state.batch == 3 and hvd.size() == 2
-                        and os.environ["HOROVOD_HOSTNAME"] == "hostB"
+                        and os.environ["HOROVOD_HOSTNAME"] == "127.0.0.1"
                         and not os.path.exists(MARKER)):
                     open(MARKER, "w").write("1")
                     log(f"injecting failure on rank {hvd.rank()}")
@@ -220,7 +220,7 @@ def test_elastic_worker_failure_recovery(tmp_path):
         log(f"done rank {hvd.rank()} size {hvd.size()}")
     """))
     disc = tmp_path / "discover.sh"
-    disc.write_text("#!/bin/bash\necho hostA:1\necho hostB:1\n")
+    disc.write_text("#!/bin/bash\necho localhost:1\necho 127.0.0.1:1\n")
     disc.chmod(disc.stat().st_mode | stat.S_IEXEC)
 
     proc = subprocess.run(
@@ -237,3 +237,38 @@ def test_elastic_worker_failure_recovery(tmp_path):
     assert proc.returncode == 0, (proc.stderr[-3000:], content)
     assert "injecting failure" in content, content
     assert "done" in content, content
+
+
+@pytest.mark.integration
+def test_run_elastic_fn_ships_function(tmp_path):
+    """The programmatic elastic API (runner/elastic_api.py, shared by
+    the ray/spark integrations): the pickled function travels through
+    the KV store to every worker — no shared filesystem."""
+    from horovod_tpu.runner.elastic.discovery import FixedHosts
+    from horovod_tpu.runner.elastic_api import run_elastic_fn
+
+    log = tmp_path / "log.txt"
+
+    def worker(log_path):
+        import os
+
+        import numpy as np
+
+        import horovod_tpu as hvd
+
+        hvd.init()
+        out = hvd.allreduce(np.ones(3, np.float32), op=hvd.Sum,
+                            name="t")
+        with open(log_path, "a") as f:
+            f.write(f"rank {hvd.rank()} size {hvd.size()} "
+                    f"sum {float(out[0])}\n")
+        hvd.shutdown()
+
+    run_elastic_fn(worker, (str(log),), discovery=FixedHosts(
+        {"localhost": 2}), min_np=2, max_np=2,
+        env={"JAX_PLATFORMS": "cpu", "JAX_NUM_CPU_DEVICES": "1",
+             "HVD_TEST_LOG": str(log)},
+        start_timeout=240)
+    content = log.read_text()
+    assert "size 2" in content, content
+    assert "sum 2.0" in content, content

@@ -47,6 +47,46 @@ def test_resnet50_param_count():
     assert 25.4e6 < n < 25.8e6, n
 
 
+def test_vgg16_param_count_and_forward():
+    # ~138.4M params, matching the canonical VGG-16 of the reference's
+    # benchmark trio (docs/benchmarks.rst:13-14, 68% scaling case).
+    from horovod_tpu.models import VGG16
+
+    model = VGG16(num_classes=1000, dtype=jnp.float32)
+    x = jnp.zeros((1, 224, 224, 3))
+    variables = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), x, train=False))
+    n = sum(int(np.prod(p.shape))
+            for p in jax.tree_util.tree_leaves(variables["params"]))
+    assert 138.0e6 < n < 138.8e6, n
+    small = VGG16(num_classes=10, dtype=jnp.float32)
+    xs = jnp.zeros((2, 64, 64, 3))
+    vs = small.init(jax.random.PRNGKey(0), xs, train=False)
+    out = small.apply(vs, xs, train=False)
+    assert out.shape == (2, 10)
+
+
+def test_inception_v3_param_count_and_forward():
+    # ~23.8M params (no aux head), matching canonical Inception V3
+    # (docs/benchmarks.rst:13, 90% scaling case).
+    from horovod_tpu.models import InceptionV3
+
+    model = InceptionV3(num_classes=1000, dtype=jnp.float32)
+    x = jnp.zeros((1, 299, 299, 3))
+    variables = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), x, train=False))
+    n = sum(int(np.prod(p.shape))
+            for p in jax.tree_util.tree_leaves(variables["params"]))
+    assert 23.5e6 < n < 24.2e6, n
+    small = InceptionV3(num_classes=10, dtype=jnp.float32)
+    xs = jnp.zeros((2, 96, 96, 3))
+    vs = small.init(jax.random.PRNGKey(0), xs, train=False)
+    out, mutated = small.apply(vs, xs, train=True,
+                               mutable=["batch_stats"])
+    assert out.shape == (2, 10)
+    assert "batch_stats" in mutated
+
+
 @pytest.fixture(scope="module")
 def tiny_lm():
     cfg = TransformerConfig(vocab_size=128, d_model=64, n_layers=2,

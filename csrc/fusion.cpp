@@ -14,8 +14,40 @@
 
 #include <cstdint>
 #include <cstring>
+#include <thread>
+#include <vector>
 
 extern "C" {
+
+void hvd_pack(const void** srcs, const int64_t* sizes,
+              const int64_t* offsets, int64_t n, char* dst);
+
+// Multithreaded pack for large buckets: split the tensor list across
+// nthreads, each worker memcpying its contiguous slice (the
+// reference's BATCHED_D2D_CAPACITY chunking, cuda_kernels.cu:27-74,
+// recast for host cores).
+void hvd_pack_mt(const void** srcs, const int64_t* sizes,
+                 const int64_t* offsets, int64_t n, char* dst,
+                 int64_t nthreads) {
+  if (nthreads <= 1 || n < nthreads * 2) {
+    hvd_pack(srcs, sizes, offsets, n, dst);
+    return;
+  }
+  std::vector<std::thread> workers;
+  const int64_t per = (n + nthreads - 1) / nthreads;
+  for (int64_t t = 0; t < nthreads; ++t) {
+    const int64_t lo = t * per;
+    const int64_t hi = lo + per < n ? lo + per : n;
+    if (lo >= hi) break;
+    workers.emplace_back([=] {
+      for (int64_t i = lo; i < hi; ++i) {
+        std::memcpy(dst + offsets[i], srcs[i],
+                    static_cast<size_t>(sizes[i]));
+      }
+    });
+  }
+  for (auto& w : workers) w.join();
+}
 
 // Copy n buffers (sizes[i] bytes each) into contiguous dst at
 // offsets[i].  One call per fusion bucket per rank.

@@ -1,0 +1,124 @@
+// Native async Chrome-trace writer for horovod_tpu.
+//
+// Counterpart of the reference's TimelineWriter
+// (/root/reference/horovod/common/timeline.{h,cc}: record queue +
+// dedicated writer thread so the coordination loop never blocks on
+// IO or formatting).  Events arrive as (name, phase, tid, ts) from
+// one ctypes call on the engine thread; JSON formatting AND file IO
+// happen on the native writer thread.
+//
+// Build: csrc/Makefile -> horovod_tpu/_native/libhvdnative.so
+// Binding: ctypes (horovod_tpu/core/native.py), python fallback.
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Event {
+  char name[96];
+  char ph[4];
+  int64_t tid;
+  double ts;
+};
+
+struct Writer {
+  FILE* f = nullptr;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::vector<Event> queue;
+  std::thread thread;
+  bool closing = false;
+  bool first = true;
+
+  void run() {
+    std::vector<Event> batch;
+    for (;;) {
+      {
+        std::unique_lock<std::mutex> lock(mu);
+        cv.wait(lock, [&] { return closing || !queue.empty(); });
+        batch.swap(queue);
+        if (batch.empty() && closing) break;
+      }
+      for (const Event& e : batch) {
+        if (!first) std::fputs(",\n", f);
+        first = false;
+        if (std::strcmp(e.ph, "M") == 0) {
+          std::fprintf(f,
+                       "{\"name\": \"thread_name\", \"ph\": \"M\", "
+                       "\"pid\": 0, \"tid\": %lld, \"args\": {\"name\": "
+                       "\"%s\"}}",
+                       static_cast<long long>(e.tid), e.name);
+        } else if (std::strcmp(e.ph, "i") == 0) {
+          // instant markers render full-height only with global scope
+          std::fprintf(f,
+                       "{\"name\": \"%s\", \"ph\": \"i\", \"s\": \"g\", "
+                       "\"pid\": 0, \"tid\": %lld, \"ts\": %.3f}",
+                       e.name, static_cast<long long>(e.tid), e.ts);
+        } else {
+          std::fprintf(f,
+                       "{\"name\": \"%s\", \"ph\": \"%s\", \"pid\": 0, "
+                       "\"tid\": %lld, \"ts\": %.3f}",
+                       e.name, e.ph, static_cast<long long>(e.tid),
+                       e.ts);
+        }
+      }
+      std::fflush(f);
+      batch.clear();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* hvd_tl_open(const char* path) {
+  Writer* w = new Writer();
+  w->f = std::fopen(path, "w");
+  if (w->f == nullptr) {
+    delete w;
+    return nullptr;
+  }
+  std::fputs("[\n", w->f);
+  w->thread = std::thread([w] { w->run(); });
+  return w;
+}
+
+// name must not contain JSON-special characters (tensor names are
+// sanitized python-side); truncated to 95 chars.
+void hvd_tl_event(void* handle, const char* name, const char* ph,
+                  int64_t tid, double ts_us) {
+  Writer* w = static_cast<Writer*>(handle);
+  Event e;
+  std::snprintf(e.name, sizeof(e.name), "%s", name);
+  std::snprintf(e.ph, sizeof(e.ph), "%s", ph);
+  e.tid = tid;
+  e.ts = ts_us;
+  {
+    std::lock_guard<std::mutex> lock(w->mu);
+    w->queue.push_back(e);
+  }
+  w->cv.notify_one();
+}
+
+void hvd_tl_close(void* handle) {
+  Writer* w = static_cast<Writer*>(handle);
+  {
+    std::lock_guard<std::mutex> lock(w->mu);
+    w->closing = true;
+  }
+  w->cv.notify_one();
+  w->thread.join();
+  std::fputs("\n]\n", w->f);
+  std::fclose(w->f);
+  delete w;
+}
+
+}  // extern "C"

@@ -140,6 +140,9 @@ class Engine:
                 steps_per_sample=self.config.autotune_steps_per_sample,
                 log_path=self.config.autotune_log)
 
+        from . import native as _native
+        self._arena = _native.Arena()
+
         self._stall_warned = set()
         self._thread = threading.Thread(
             target=self._background_loop, name="horovod_tpu-engine",
@@ -774,23 +777,37 @@ class Engine:
         from . import native
         itemsize = dtype.itemsize
         rows = []
-        for r in ps.local_ranks:
-            arrays, offs_bytes, missing = [], [], False
-            for entry, i, off, size, _ in layout:
-                sub = entry.subs.get(r)
-                if sub is not None:
-                    arrays.append(sub.payloads[i].ravel())
-                    offs_bytes.append(off * itemsize)
-                else:                    # joined ranks contribute zeros
-                    missing = True
-            buf = np.zeros(total, dtype=dtype) if missing else \
-                np.empty(total, dtype=dtype)
-            # one native batched memcpy per rank per bucket (the
-            # reference's batched-D2D kernel, cuda_kernels.cu:27-292)
-            native.pack(arrays, buf, offs_bytes)
-            rows.append(buf)
-        results = ps.executor.allreduce(
-            rows, op, first.prescale_factor, first.postscale_factor)
+        try:
+            for r in ps.local_ranks:
+                arrays, offs_bytes, missing = [], [], False
+                for entry, i, off, size, _ in layout:
+                    sub = entry.subs.get(r)
+                    if sub is not None:
+                        arrays.append(sub.payloads[i].ravel())
+                        offs_bytes.append(off * itemsize)
+                    else:                # joined ranks contribute zeros
+                        missing = True
+                # staging buffer from the native arena (reference
+                # FusionBufferManager persistent buffer): steady state
+                # reuses the same aligned slabs every step
+                buf = self._arena.acquire(total * itemsize, dtype)
+                rows.append(buf)
+                if missing:
+                    buf.fill(0)
+                # one native batched memcpy per rank per bucket (the
+                # reference's batched-D2D kernel, cuda_kernels.cu:27-292);
+                # multithreaded above 8 MiB
+                if total * itemsize >= 8 << 20:
+                    native.pack_mt(arrays, buf, offs_bytes)
+                else:
+                    native.pack(arrays, buf, offs_bytes)
+            results = ps.executor.allreduce(
+                rows, op, first.prescale_factor, first.postscale_factor)
+        finally:
+            # a pack/collective failure must not leak slabs — the
+            # engine survives bucket errors (_execute_batch catches)
+            for buf in rows:
+                self._arena.release(buf)
         if self.autotuner is not None:
             self.autotuner.record_bytes(total * dtype.itemsize)
         by_rank = dict(zip(ps.local_ranks, results))

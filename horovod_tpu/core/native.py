@@ -22,7 +22,13 @@ _tried = False
 
 _PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _LIB_PATH = os.path.join(_PKG_DIR, "_native", "libhvdnative.so")
-_SRC_PATH = os.path.join(os.path.dirname(_PKG_DIR), "csrc", "fusion.cpp")
+_SRC_DIR = os.path.join(os.path.dirname(_PKG_DIR), "csrc")
+_SRC_NAMES = ("fusion.cpp", "arena.cpp", "timeline.cpp")
+
+
+def _srcs():
+    return [os.path.join(_SRC_DIR, s) for s in _SRC_NAMES
+            if os.path.exists(os.path.join(_SRC_DIR, s))]
 
 
 def _build():
@@ -31,13 +37,21 @@ def _build():
     # concurrently launched workers never dlopen a half-written .so
     tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
     cmd = ["g++", "-O3", "-fPIC", "-std=c++17", "-shared",
-           "-o", tmp, _SRC_PATH]
+           "-o", tmp] + _srcs() + ["-lpthread"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, _LIB_PATH)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
+
+
+def _stale():
+    """Rebuild when any source is newer than the shared lib."""
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    return any(os.path.getmtime(s) > lib_mtime for s in _srcs())
 
 
 def get_lib():
@@ -48,7 +62,7 @@ def get_lib():
             return _lib
         _tried = True
         try:
-            if not os.path.exists(_LIB_PATH) and os.path.exists(_SRC_PATH):
+            if _srcs() and _stale():
                 _build()
             lib = ctypes.CDLL(_LIB_PATH)
             lib.hvd_pack.argtypes = [
@@ -62,6 +76,26 @@ def get_lib():
                 ctypes.POINTER(ctypes.c_int64),
                 ctypes.c_int64,
                 ctypes.POINTER(ctypes.c_void_p)]
+            if hasattr(lib, "hvd_pack_mt"):
+                lib.hvd_pack_mt.argtypes = \
+                    lib.hvd_pack.argtypes + [ctypes.c_int64]
+            if hasattr(lib, "hvd_arena_new"):
+                lib.hvd_arena_new.restype = ctypes.c_void_p
+                lib.hvd_arena_acquire.restype = ctypes.c_void_p
+                lib.hvd_arena_acquire.argtypes = [ctypes.c_void_p,
+                                                  ctypes.c_int64]
+                lib.hvd_arena_release.argtypes = [ctypes.c_void_p,
+                                                  ctypes.c_void_p]
+                lib.hvd_arena_bytes.restype = ctypes.c_int64
+                lib.hvd_arena_bytes.argtypes = [ctypes.c_void_p]
+                lib.hvd_arena_destroy.argtypes = [ctypes.c_void_p]
+            if hasattr(lib, "hvd_tl_open"):
+                lib.hvd_tl_open.restype = ctypes.c_void_p
+                lib.hvd_tl_open.argtypes = [ctypes.c_char_p]
+                lib.hvd_tl_event.argtypes = [
+                    ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                    ctypes.c_int64, ctypes.c_double]
+                lib.hvd_tl_close.argtypes = [ctypes.c_void_p]
             _lib = lib
         except Exception as exc:  # noqa: BLE001 — fall back to numpy
             logger.info("native lib unavailable (%s); using numpy "
@@ -111,3 +145,104 @@ def unpack(src: np.ndarray, arrays, offsets_bytes) -> None:
     offs = (ctypes.c_int64 * n)(*offsets_bytes)
     lib.hvd_unpack(src.ctypes.data_as(ctypes.c_char_p),
                    sizes, offs, n, dsts)
+
+
+def pack_mt(arrays, dst: np.ndarray, offsets_bytes,
+            nthreads: int = 4) -> None:
+    """Multithreaded pack for large buckets (csrc hvd_pack_mt); falls
+    back to the single-threaded path."""
+    lib = get_lib()
+    n = len(arrays)
+    if lib is None or n == 0 or not hasattr(lib, "hvd_pack_mt"):
+        return pack(arrays, dst, offsets_bytes)
+    arrays = [np.ascontiguousarray(a) for a in arrays]
+    srcs = (ctypes.c_void_p * n)(*[a.ctypes.data for a in arrays])
+    sizes = (ctypes.c_int64 * n)(*[a.nbytes for a in arrays])
+    offs = (ctypes.c_int64 * n)(*offsets_bytes)
+    lib.hvd_pack_mt(srcs, sizes, offs, n,
+                    dst.ctypes.data_as(ctypes.c_char_p), nthreads)
+
+
+class Arena:
+    """Size-class staging-buffer arena (csrc/arena.cpp — the
+    reference FusionBufferManager's persistent-buffer role).  Buffers
+    come back as numpy views over 64-byte-aligned native slabs; a
+    numpy freelist stands in when the native lib is unavailable."""
+
+    def __init__(self):
+        self._lib = get_lib()
+        self._native = self._lib is not None and \
+            hasattr(self._lib, "hvd_arena_new")
+        self._handle = self._lib.hvd_arena_new() if self._native else None
+        self._py_free = {}      # size-class -> [ndarray]
+        self._live = {}         # data address -> release token
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _cls(nbytes):
+        c = 4096
+        while c < nbytes:
+            c <<= 1
+        return c
+
+    def acquire(self, nbytes: int, dtype=np.uint8) -> np.ndarray:
+        """A reusable buffer of >= nbytes, viewed as `dtype`
+        (element count = nbytes // itemsize).  Release by passing the
+        SAME array (tracked by data address — ndarrays don't accept
+        attributes)."""
+        itemsize = np.dtype(dtype).itemsize
+        if self._native:
+            ptr = self._lib.hvd_arena_acquire(self._handle, nbytes)
+            if ptr:
+                raw = (ctypes.c_char * nbytes).from_address(ptr)
+                arr = np.frombuffer(raw, dtype=np.uint8, count=nbytes) \
+                    .view(dtype)[: nbytes // itemsize]
+                with self._lock:
+                    self._live[int(ptr)] = ("native", int(ptr))
+                return arr
+        cls = self._cls(nbytes)
+        with self._lock:
+            slabs = self._py_free.setdefault(cls, [])
+            base = slabs.pop() if slabs else np.empty(cls, np.uint8)
+        arr = base[:nbytes].view(dtype)[: nbytes // itemsize]
+        with self._lock:
+            self._live[int(base.ctypes.data)] = ("py", base)
+        return arr
+
+    def release(self, arr: np.ndarray):
+        addr = int(arr.ctypes.data)
+        with self._lock:
+            token = self._live.pop(addr, None)
+        if token is None:
+            return
+        kind, val = token
+        if kind == "native":
+            self._lib.hvd_arena_release(self._handle, val)
+        else:
+            with self._lock:
+                self._py_free.setdefault(len(val), []).append(val)
+
+    def total_bytes(self) -> int:
+        if self._native:
+            return int(self._lib.hvd_arena_bytes(self._handle))
+        with self._lock:
+            return sum(len(b) for slabs in self._py_free.values()
+                       for b in slabs)
+
+    def __del__(self):  # pragma: no cover — interpreter teardown
+        try:
+            if self._native and self._handle:
+                self._lib.hvd_arena_destroy(self._handle)
+                self._handle = None
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def timeline_writer(path: str):
+    """Native async chrome-trace writer handle, or None when the lib
+    lacks it (utils/timeline.py then uses its python writer thread)."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "hvd_tl_open"):
+        return None
+    handle = lib.hvd_tl_open(path.encode())
+    return (lib, handle) if handle else None

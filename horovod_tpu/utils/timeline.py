@@ -3,17 +3,25 @@
 Reimplements the reference Timeline subsystem
 (``horovod/common/timeline.{h,cc}``; format documented in
 docs/timeline.rst): per-tensor lanes with NEGOTIATING and operation
-phases, written as Chrome trace-event JSON by an async writer thread so
-the engine's dispatch loop never blocks on file IO.  View in
+phases, written as Chrome trace-event JSON by an async writer so the
+engine's dispatch loop never blocks on file IO.  View in
 chrome://tracing or Perfetto.  Activate with ``HOROVOD_TIMELINE=path``
 or ``start_timeline()``/``stop_timeline()`` at runtime (reference
 operations.cc:1077-1109).
+
+When the native library is available the writer is the C++ thread in
+``csrc/timeline.cpp`` (the reference's TimelineWriter): the engine
+thread pays one ctypes call per event and JSON formatting + IO happen
+natively.  Otherwise a Python queue + writer thread stands in.
 """
 
 import json
 import queue
+import re
 import threading
 import time
+
+_NAME_SANITIZE = re.compile(r'[\\"\x00-\x1f]')
 
 
 class Timeline:
@@ -23,20 +31,45 @@ class Timeline:
     def __init__(self, filename, mark_cycles=False):
         self.filename = filename
         self.mark_cycles = mark_cycles
-        self._q = queue.Queue()
         self._start = time.perf_counter()
         self._tids = {}
         self._next_tid = 1
         self._lock = threading.Lock()
         self._open_ops = []
-        self._thread = threading.Thread(
-            target=self._writer_loop, name="horovod_tpu-timeline", daemon=True)
-        self._thread.start()
+        self._native = None
+        self._q = None
+        self._thread = None
+        # serializes emits against close(): the native writer handle
+        # must not be freed while an engine-thread emit is in flight
+        self._emit_lock = threading.Lock()
+        from ..core import native
+        writer = native.timeline_writer(filename)
+        if writer is not None:
+            self._native = writer
+        else:
+            self._q = queue.Queue()
+            self._thread = threading.Thread(
+                target=self._writer_loop, name="horovod_tpu-timeline",
+                daemon=True)
+            self._thread.start()
 
     # -- engine-facing hooks -------------------------------------------------
 
     def _ts(self):
         return (time.perf_counter() - self._start) * 1e6  # microseconds
+
+    def _emit(self, name, ph, tid, ts):
+        with self._emit_lock:
+            if self._native is not None:
+                lib, handle = self._native
+                lib.hvd_tl_event(handle, name.encode(), ph.encode(),
+                                 tid, float(ts))
+            elif self._q is not None:
+                ev = {"name": name, "ph": ph, "pid": 0, "tid": tid,
+                      "ts": ts}
+                if ph == "i":
+                    ev["s"] = "g"    # global-scope instant marker
+                self._q.put(ev)
 
     def _tid(self, name):
         with self._lock:
@@ -45,15 +78,23 @@ class Timeline:
                 tid = self._next_tid
                 self._next_tid += 1
                 self._tids[name] = tid
-                self._q.put({"name": "thread_name", "ph": "M", "pid": 0,
-                             "tid": tid, "args": {"name": name}})
+                clean = _NAME_SANITIZE.sub("_", name)[:90]
+                with self._emit_lock:
+                    if self._native is not None:
+                        lib, handle = self._native
+                        lib.hvd_tl_event(handle, clean.encode(), b"M",
+                                         tid, 0.0)
+                    elif self._q is not None:
+                        self._q.put({"name": "thread_name", "ph": "M",
+                                     "pid": 0, "tid": tid,
+                                     "args": {"name": clean}})
             return tid
 
     def negotiate_start(self, tensor_name, op_name):
         """A rank declared the tensor ready (reference
         Timeline::NegotiateStart, fed from controller.cc:1123)."""
-        self._q.put({"name": f"NEGOTIATE_{op_name}", "ph": "B", "pid": 0,
-                     "tid": self._tid(tensor_name), "ts": self._ts()})
+        self._emit(f"NEGOTIATE_{op_name}", "B",
+                   self._tid(tensor_name), self._ts())
 
     def op_start(self, tensor_names, op_name):
         """Negotiation complete; collective starting (reference
@@ -63,10 +104,8 @@ class Timeline:
         for n in tensor_names:
             tid = self._tid(n)
             tids.append(tid)
-            self._q.put({"name": f"NEGOTIATE_{op_name}", "ph": "E", "pid": 0,
-                         "tid": tid, "ts": ts})
-            self._q.put({"name": op_name, "ph": "B", "pid": 0, "tid": tid,
-                         "ts": ts})
+            self._emit(f"NEGOTIATE_{op_name}", "E", tid, ts)
+            self._emit(op_name, "B", tid, ts)
         with self._lock:
             self._open_ops.append((list(tids), op_name))
 
@@ -77,15 +116,13 @@ class Timeline:
                 return
             tids, op_name = self._open_ops.pop()
         for tid in tids:
-            self._q.put({"name": op_name, "ph": "E", "pid": 0, "tid": tid,
-                         "ts": ts})
+            self._emit(op_name, "E", tid, ts)
 
     def mark_cycle(self):
         if self.mark_cycles:
-            self._q.put({"name": "CYCLE", "ph": "i", "pid": 0, "tid": 0,
-                         "ts": self._ts(), "s": "g"})
+            self._emit("CYCLE", "i", 0, self._ts())
 
-    # -- writer --------------------------------------------------------------
+    # -- python fallback writer ----------------------------------------------
 
     def _writer_loop(self):
         with open(self.filename, "w") as f:
@@ -103,5 +140,12 @@ class Timeline:
             f.write("\n]\n")
 
     def close(self):
-        self._q.put(None)
-        self._thread.join(timeout=10)
+        with self._emit_lock:
+            native_writer, self._native = self._native, None
+            q, self._q = self._q, None
+        if native_writer is not None:
+            lib, handle = native_writer
+            lib.hvd_tl_close(handle)
+        elif q is not None:
+            q.put(None)
+            self._thread.join(timeout=10)

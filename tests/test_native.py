@@ -55,3 +55,71 @@ def test_engine_uses_native_pack(hvd_shutdown):
     results = hvd.run(fn, np=4)
     np.testing.assert_allclose(results[0][0], np.full(5, 6.0))
     np.testing.assert_allclose(results[0][1], np.full((2, 3), 4.0))
+
+
+def test_pack_mt_matches_single():
+    from horovod_tpu.core import native
+
+    rs = np.random.RandomState(0)
+    arrays = [rs.randn(n).astype(np.float32) for n in (7, 100, 3, 4096)]
+    offsets, off = [], 0
+    for a in arrays:
+        offsets.append(off)
+        off += a.nbytes
+    total = off // 4
+    a_mt = np.empty(total, np.float32)
+    a_st = np.empty(total, np.float32)
+    native.pack_mt(arrays, a_mt, offsets, nthreads=3)
+    native.pack(arrays, a_st, offsets)
+    np.testing.assert_array_equal(a_mt, a_st)
+
+
+def test_arena_reuse_and_release():
+    from horovod_tpu.core import native
+
+    arena = native.Arena()
+    a = arena.acquire(10_000, np.float32)
+    assert a.shape == (2500,) and a.dtype == np.float32
+    a[:] = 1.5
+    addr = a.ctypes.data
+    arena.release(a)
+    # same size class comes back from the freelist (same slab)
+    b = arena.acquire(9_000, np.float32)
+    assert b.ctypes.data == addr
+    arena.release(b)
+    # growth is bounded by distinct size classes, not call count
+    before = arena.total_bytes()
+    for _ in range(20):
+        c = arena.acquire(10_000)
+        arena.release(c)
+    assert arena.total_bytes() == before
+    # double release is a no-op
+    arena.release(b)
+
+
+def test_native_timeline_writer(tmp_path):
+    import json
+
+    from horovod_tpu.utils.timeline import Timeline
+
+    path = str(tmp_path / "tl.json")
+    tl = Timeline(path)
+    tl.negotiate_start("grad/layer_0", "ALLREDUCE")
+    tl.op_start(["grad/layer_0"], "ALLREDUCE")
+    tl.op_end()
+    tl.close()
+    events = json.load(open(path))
+    names = [e["name"] for e in events]
+    assert "thread_name" in names
+    assert "NEGOTIATE_ALLREDUCE" in names
+    assert "ALLREDUCE" in names
+    phases = [e["ph"] for e in events if e["name"] == "ALLREDUCE"]
+    assert phases == ["B", "E"]
+    # name with JSON-hostile characters stays valid JSON
+    path2 = str(tmp_path / "tl2.json")
+    tl2 = Timeline(path2)
+    tl2.op_start(['bad"name\\with\x01ctl'], "ALLREDUCE")
+    tl2.op_end()
+    tl2.close()
+    events2 = json.load(open(path2))
+    assert len(events2) >= 3

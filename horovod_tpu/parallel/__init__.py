@@ -12,6 +12,7 @@ from .sharding import (  # noqa: F401
     batch_spec, batch_sharding, replicated,
 )
 from .ring_attention import ring_attention, make_ring_attention_fn  # noqa: F401
+from .ulysses import ulysses_attention, make_ulysses_attention_fn  # noqa: F401
 from .pipeline import gpipe, make_pipelined_lm_apply  # noqa: F401
 from .train import (  # noqa: F401
     make_lm_train_step, make_dp_train_step, make_pipelined_lm_train_step,

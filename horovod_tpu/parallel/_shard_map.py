@@ -1,0 +1,29 @@
+"""Shared shard_map import shim + attention-kernel wrapper.
+
+jax moved shard_map between releases (jax.shard_map vs
+jax.experimental.shard_map); every user in this package imports the
+resolved symbol from here so an API change is fixed once.
+"""
+
+from functools import partial
+
+from jax.sharding import PartitionSpec as P
+
+try:
+    from jax import shard_map as _sm
+    shard_map = _sm.shard_map if hasattr(_sm, "shard_map") else _sm
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+def make_attention_fn(kernel, mesh, *, batch_axes=("dp", "fsdp"),
+                      seq_axis="sp", head_axis="tp"):
+    """Wrap a per-shard attention kernel ``kernel(q, k, v, axis_name)``
+    in shard_map so it drops into ``TransformerLM(attention_fn=...)``
+    under an outer jit: q/k/v arrive (B, S, H, D), batch-sharded on
+    ``batch_axes``, sequence-sharded on ``seq_axis``, head-sharded on
+    ``head_axis``."""
+    spec = P(batch_axes, seq_axis, head_axis, None)
+    return shard_map(partial(kernel, axis_name=seq_axis), mesh=mesh,
+                     in_specs=(spec, spec, spec), out_specs=spec,
+                     check_vma=False)

@@ -15,19 +15,12 @@ Used inside ``shard_map`` (see :func:`make_ring_attention_fn`) as a
 drop-in for ``models.transformer.dense_causal_attention``.
 """
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import PartitionSpec as P
 
-try:
-    from jax import shard_map as _sm
-    shard_map = _sm.shard_map if hasattr(_sm, "shard_map") else _sm
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from ._shard_map import make_attention_fn, shard_map  # noqa: F401
 
 _NEG_INF = -1e30
 
@@ -79,21 +72,9 @@ def ring_attention(q, k, v, axis_name: str = "sp"):
     return jnp.swapaxes(out, 1, 2)                     # (B,S,H,D)
 
 
-def make_ring_attention_fn(mesh, *, batch_axes=("dp", "fsdp"),
-                           seq_axis="sp", head_axis="tp"):
+def make_ring_attention_fn(mesh, **kwargs):
     """Wrap :func:`ring_attention` in shard_map so it drops into
     ``TransformerLM(attention_fn=...)`` under an outer ``jax.jit``:
     q/k/v arrive sequence-sharded on ``seq_axis`` and head-sharded on
     ``head_axis``; the ring runs per (batch, head) shard."""
-    spec = P(batch_axes, seq_axis, head_axis, None)
-
-    inner = partial(ring_attention, axis_name=seq_axis)
-    mapped = shard_map(
-        inner, mesh=mesh,
-        in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
-
-    def attention_fn(q, k, v):
-        return mapped(q, k, v)
-
-    return attention_fn
+    return make_attention_fn(ring_attention, mesh, **kwargs)

@@ -31,18 +31,34 @@ from .sharding import (
 
 def make_lm_train_step(mesh: Mesh, cfg: TransformerConfig,
                        optimizer=None, *, sequence_parallel: bool = False,
+                       attention_impl: str = "ring",
                        learning_rate: float = 1e-3):
     """Build (init_fn, step_fn) for the transformer over ``mesh``.
 
     ``step_fn(state, tokens) -> (state, loss)`` is jitted with explicit
     in/out shardings: params follow the tp/fsdp/ep/pp rules
     (sharding.py), the batch is split over dp+fsdp, and the sequence
-    over sp when ``sequence_parallel`` (ring attention).
+    over sp when ``sequence_parallel`` — via ring attention
+    (``attention_impl="ring"``, S/n memory, n ppermute hops) or
+    Ulysses all-to-all head/sequence exchange (``"ulysses"``, two
+    fused all_to_alls, needs (n_heads / tp) % sp == 0).
     """
     optimizer = optimizer or optax.adamw(learning_rate)
+    if attention_impl not in ("ring", "ulysses"):
+        raise ValueError(
+            f"attention_impl must be 'ring' or 'ulysses', "
+            f"got {attention_impl!r}")
+    if not sequence_parallel and attention_impl != "ring":
+        raise ValueError(
+            "attention_impl only takes effect with "
+            "sequence_parallel=True — set it, or drop attention_impl")
     attention_fn = None
     if sequence_parallel:
-        attention_fn = make_ring_attention_fn(mesh)
+        if attention_impl == "ring":
+            attention_fn = make_ring_attention_fn(mesh)
+        else:
+            from .ulysses import make_ulysses_attention_fn
+            attention_fn = make_ulysses_attention_fn(mesh)
         model = TransformerLM(cfg, attention_fn=attention_fn)
     else:
         model = TransformerLM(cfg)

@@ -1,0 +1,65 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence
+exchange (DeepSpeed-Ulysses, arXiv:2309.14509 — see PAPERS.md).
+
+Second long-context strategy next to :mod:`.ring_attention` (SURVEY
+§5.7: the reference has none; its ``alltoall`` op is the substrate
+users would build this on).  Where the ring rotates K/V blocks around
+``sp`` with ``S/n`` memory and n hops, Ulysses performs TWO
+``all_to_all`` exchanges per attention call: heads scatter across
+``sp`` while each device gathers the FULL sequence for its head
+subset, dense attention runs locally, and the inverse exchange
+restores sequence sharding.  Communication volume is O(S·H·D/n) per
+device per exchange and rides ICI as one fused all-to-all — fewer,
+larger transfers than the ring's n ppermutes, the better trade when
+heads are plentiful and sequence moderate.
+
+Constraint: the PER-SHARD head count must divide by the ``sp`` axis
+size — with tensor parallelism that is ``(n_heads / tp) % sp == 0``
+(the classic Ulysses requirement, applied after tp head sharding).
+"""
+
+from jax import lax
+
+from ..models.transformer import dense_causal_attention
+from ._shard_map import make_attention_fn
+
+
+def ulysses_attention(q, k, v, axis_name: str = "sp"):
+    """Causal attention with q/k/v sequence-sharded over ``axis_name``.
+
+    Per-shard shapes: (B, S_local, H, D) with H % axis_size == 0.
+    Must run inside shard_map with ``axis_name`` bound.
+    """
+    n = lax.axis_size(axis_name)
+    B, S, H, D = q.shape
+    if H % n != 0:
+        raise ValueError(
+            f"Ulysses needs the per-shard head count divisible by the "
+            f"sequence axis: {H} local heads (n_heads / tp) over {n} "
+            f"sp shards — pick n_heads so (n_heads/tp) % sp == 0")
+
+    def seq_to_heads(x):
+        # (B, S_local, H, D) -> (B, S_global, H/n, D): scatter head
+        # groups across sp, gather the full sequence — one fused tiled
+        # all_to_all (head chunk g lands on rank g; received sequence
+        # chunks concatenate in rank order = global order)
+        return lax.all_to_all(x, axis_name, split_axis=2,
+                              concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):
+        # inverse: (B, S_global, H/n, D) -> (B, S_local, H, D)
+        return lax.all_to_all(x, axis_name, split_axis=1,
+                              concat_axis=2, tiled=True)
+
+    qh = seq_to_heads(q)
+    kh = seq_to_heads(k)
+    vh = seq_to_heads(v)
+    out = dense_causal_attention(qh, kh, vh)       # full-seq, H/n heads
+    return heads_to_seq(out)
+
+
+def make_ulysses_attention_fn(mesh, **kwargs):
+    """shard_map wrapper dropping into
+    ``TransformerLM(attention_fn=...)`` exactly like
+    :func:`.ring_attention.make_ring_attention_fn`."""
+    return make_attention_fn(ulysses_attention, mesh, **kwargs)

@@ -1,0 +1,6 @@
+"""Aux subsystems: timeline tracing, checkpoint/resume."""
+
+from .timeline import Timeline  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    CheckpointManager, load_and_broadcast, save_rank0,
+)

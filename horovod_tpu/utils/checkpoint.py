@@ -1,0 +1,127 @@
+"""Checkpoint / resume for distributed training states.
+
+The reference has no global checkpoint subsystem (SURVEY §5.4): it
+delegates to the frameworks and layers two conventions on top —
+rank 0 writes, and restores broadcast from rank 0
+(``tensorflow/__init__.py:474-543`` BroadcastGlobalVariablesHook,
+elastic in-memory State commit/restore).  The TPU-native build keeps
+both conventions and adds what the reference cannot: **sharded**
+checkpoints of pjit training states through orbax, where every host
+writes exactly its own shards and restore re-forms arbitrary
+shardings — the right primitive for fsdp/tp states that never fit one
+host.
+
+Two layers:
+
+* :class:`CheckpointManager` — orbax-backed save/restore of any
+  pytree of (possibly sharded) jax arrays, with step retention.
+* :func:`save_rank0` / :func:`load_and_broadcast` — the reference's
+  rank-0-writes + broadcast-on-restore convention for host-side
+  (numpy/torch) states in multi-controller jobs.
+"""
+
+import os
+from typing import Any, Optional
+
+
+class CheckpointManager:
+    """Sharded pjit-state checkpointing (orbax under the hood).
+
+    >>> mgr = CheckpointManager("/ckpts", max_to_keep=3)
+    >>> mgr.save(step, state)            # every host writes its shards
+    >>> state = mgr.restore(target=abstract_state, shardings=spec)
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True))
+
+    def save(self, step: int, state: Any, *, force: bool = False,
+             wait: bool = True) -> bool:
+        """Save ``state`` (pytree of jax arrays, sharded or not) at
+        ``step``; each process writes only its addressable shards."""
+        saved = self._mgr.save(
+            step, args=self._ocp.args.StandardSave(state), force=force)
+        if wait:
+            self._mgr.wait_until_finished()
+        return saved
+
+    def restore(self, step: Optional[int] = None, *,
+                target: Any = None, shardings: Any = None) -> Any:
+        """Restore ``step`` (default: latest).  Pass ``target`` (a
+        matching pytree of ShapeDtypeStructs or arrays) and/or
+        ``shardings`` to place shards directly onto the mesh."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoints under {self.directory}")
+        if shardings is not None and target is None:
+            # a bare StandardRestore would silently fall back to the
+            # sharding layout recorded at save time — refuse instead
+            raise ValueError(
+                "restore(shardings=...) needs target= (a pytree of "
+                "arrays or ShapeDtypeStructs matching the state)")
+        if target is not None and shardings is not None:
+            import jax
+
+            target = jax.tree_util.tree_map(
+                lambda t, s: jax.ShapeDtypeStruct(t.shape, t.dtype,
+                                                  sharding=s),
+                target, shardings)
+        args = self._ocp.args.StandardRestore(target) \
+            if target is not None else self._ocp.args.StandardRestore()
+        return self._mgr.restore(step, args=args)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return sorted(self._mgr.all_steps())
+
+    def close(self):
+        self._mgr.close()
+
+
+def save_rank0(path: str, state: Any):
+    """Rank-0-writes convention for host-side states (reference:
+    checkpoint on rank 0 only, docs and examples throughout).  Call
+    from every rank; only rank 0 touches the filesystem."""
+    import pickle
+
+    from ..common import basics
+
+    if basics.rank() != 0:
+        return
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb") as f:
+        # stream straight to disk — no in-memory serialized copies
+        # (multi-GB host states are the point of this helper)
+        pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
+    os.replace(tmp, path)
+
+
+def load_and_broadcast(path: str, root_rank: int = 0) -> Any:
+    """Restore-and-broadcast convention (reference
+    BroadcastGlobalVariablesHook / broadcast_object on restore): root
+    loads the file, every rank receives the object, so all ranks
+    resume bit-identical."""
+    import pickle
+
+    from ..common import basics
+    from ..ops.api import broadcast_object
+
+    state = None
+    if basics.rank() == root_rank:
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+    return broadcast_object(state, root_rank=root_rank,
+                            name=f"ckpt.{os.path.basename(path)}")

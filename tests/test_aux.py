@@ -118,3 +118,62 @@ def test_spark_ray_gated():
         spark.run(lambda: None)
     with pytest.raises(ImportError):
         hvd_ray.RayExecutor(num_workers=2)
+
+
+def test_checkpoint_manager_sharded_roundtrip(tmp_path):
+    """Sharded orbax checkpointing: save a pjit-sharded state, restore
+    onto the same mesh with the same shardings (SURVEY §5.4 — beyond
+    the reference's delegate-to-framework stance)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.parallel import build_mesh
+    from horovod_tpu.utils.checkpoint import CheckpointManager
+
+    mesh = build_mesh(dp=4, tp=2)
+    shd = NamedSharding(mesh, P("dp", "tp"))
+    rep = NamedSharding(mesh, P())
+    state = {
+        "w": jax.device_put(
+            jnp.arange(32, dtype=jnp.float32).reshape(8, 4), shd),
+        "step": jax.device_put(jnp.int32(7), rep),
+    }
+    mgr = CheckpointManager(str(tmp_path / "ck"), max_to_keep=2)
+    try:
+        mgr.save(7, state)
+        mgr.save(8, {"w": state["w"] + 1, "step": state["step"] + 1})
+        assert mgr.all_steps() == [7, 8]
+        out = mgr.restore(target=state,
+                          shardings={"w": shd, "step": rep})
+        assert out["w"].sharding == shd
+        np.testing.assert_array_equal(np.asarray(out["w"] - 1),
+                                      np.asarray(state["w"]))
+        assert int(out["step"]) == 8
+        # retention: saving a third drops the oldest
+        mgr.save(9, state, force=True)
+        assert 7 not in mgr.all_steps()
+    finally:
+        mgr.close()
+
+
+def test_rank0_save_and_broadcast_restore(tmp_path, hvd_shutdown):
+    import horovod_tpu as hvd
+    from horovod_tpu.utils.checkpoint import (
+        load_and_broadcast, save_rank0,
+    )
+
+    path = str(tmp_path / "state.pkl")
+
+    def fn():
+        state = {"weights": np.arange(4) * (hvd.rank() + 1),
+                 "epoch": 3 + hvd.rank()}
+        save_rank0(path, state)     # only rank 0's state lands
+        hvd.barrier()
+        restored = load_and_broadcast(path)
+        return restored
+
+    outs = hvd.run(fn, np=4)
+    for o in outs:                  # every rank got rank 0's state
+        np.testing.assert_array_equal(o["weights"], np.arange(4))
+        assert o["epoch"] == 3

@@ -1,0 +1,55 @@
+"""TF2 elastic training (reference
+``examples/elastic/tensorflow2/tensorflow2_mnist_elastic.py``):
+state commits survive membership changes; on a host update the mesh
+re-forms and training resumes from the last commit.
+
+Run:
+    python -m horovod_tpu.runner.launch -np 2 --min-np 1 --max-np 4 \
+        --host-discovery-script ./discover.sh -- \
+        python examples/elastic/tensorflow2_elastic.py
+"""
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+import horovod_tpu.tensorflow.elastic as elastic
+
+hvd.init()
+
+tf.keras.utils.set_random_seed(42)
+model = tf.keras.Sequential([
+    tf.keras.layers.Dense(64, activation="relu"),
+    tf.keras.layers.Dense(10),
+])
+model.build((None, 784))
+optimizer = tf.keras.optimizers.SGD(0.01 * hvd.size())
+
+rs = np.random.RandomState(1234 + hvd.rank())
+x = tf.constant(rs.randn(256, 784).astype(np.float32))
+y = tf.constant(rs.randint(0, 10, 256).astype(np.int64))
+
+
+@elastic.run
+def train(state):
+    while state.batch < 40:
+        with hvd.DistributedGradientTape() as tape:
+            logits = model(x[:32], training=True)
+            loss = tf.reduce_mean(
+                tf.keras.losses.sparse_categorical_crossentropy(
+                    y[:32], logits, from_logits=True))
+        grads = tape.gradient(loss, model.trainable_variables)
+        optimizer.apply_gradients(zip(grads, model.trainable_variables))
+        state.batch += 1
+        if state.batch % 10 == 0:
+            if hvd.rank() == 0:
+                print(f"batch {state.batch} size {hvd.size()} "
+                      f"loss {float(loss):.4f}", flush=True)
+            state.commit()
+
+
+state = elastic.TensorFlowKerasState(model, optimizer, batch=0)
+train(state)
+if hvd.rank() == 0:
+    print("done", flush=True)
+hvd.shutdown()

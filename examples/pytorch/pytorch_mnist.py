@@ -1,0 +1,69 @@
+"""Torch MNIST-style training (reference
+``examples/pytorch/pytorch_mnist.py``: DistributedOptimizer + LR
+scaled by size + broadcast of params/optimizer state + per-rank data
+sharding; synthetic data keeps it network-free)."""
+
+import argparse
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+parser = argparse.ArgumentParser()
+parser.add_argument("--epochs", type=int, default=2)
+parser.add_argument("--batch-size", type=int, default=32)
+parser.add_argument("--lr", type=float, default=0.01)
+
+
+class Net(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = torch.nn.Linear(784, 128)
+        self.fc2 = torch.nn.Linear(128, 10)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def main():
+    args = parser.parse_args()
+    hvd.init()
+
+    torch.manual_seed(42)
+    model = Net()
+    # LR scaled by world size (reference convention)
+    optimizer = torch.optim.SGD(model.parameters(),
+                                lr=args.lr * hvd.size())
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    # synthetic "MNIST", sharded per rank
+    rs = np.random.RandomState(1234)
+    x_all = rs.randn(args.batch_size * 16, 784).astype(np.float32)
+    y_all = rs.randint(0, 10, len(x_all))
+    x = torch.from_numpy(x_all[hvd.rank()::hvd.size()])
+    y = torch.from_numpy(y_all[hvd.rank()::hvd.size()])
+
+    for epoch in range(args.epochs):
+        model.train()
+        perm = torch.randperm(len(x))
+        total = 0.0
+        for i in range(0, len(x), args.batch_size):
+            idx = perm[i:i + args.batch_size]
+            optimizer.zero_grad()
+            loss = F.cross_entropy(model(x[idx]), y[idx])
+            loss.backward()
+            optimizer.step()
+            total += float(loss)
+        avg = hvd.allreduce(torch.tensor(total), name=f"loss.{epoch}")
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss {float(avg):.4f}", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

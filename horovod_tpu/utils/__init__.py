@@ -4,3 +4,6 @@ from .timeline import Timeline  # noqa: F401
 from .checkpoint import (  # noqa: F401
     CheckpointManager, load_and_broadcast, save_rank0,
 )
+from .profiler import (  # noqa: F401
+    annotate, profile, start_profile, stop_profile,
+)

@@ -1,0 +1,70 @@
+"""Device-side profiling: jax profiler (XPlane/Perfetto) integration.
+
+SURVEY §5.1: the reference traces with (a) the host-side Timeline and
+(b) NVTX ranges around every user-facing op for nsight
+(``nvtx_op_range.{h,cc}``, started in EnqueueTensorAllreduces).  On
+TPU the device-side tracer is the jax profiler — its traces carry XLA
+op timelines, HBM usage, and ICI collective activity.  This module is
+the thin glue: start/stop the trace programmatically (reference
+start_timeline/stop_timeline shape) and annotate host-side phases so
+they appear as named ranges alongside device activity (the NVTX role).
+
+``annotate`` always emits a ``TraceAnnotation`` — jax's TraceMe is a
+nanosecond-level no-op while no profiler is attached, and this way
+ranges also show up in traces started elsewhere (TensorBoard's
+on-demand remote profiling, a direct ``jax.profiler.trace``).
+"""
+
+import contextlib
+import threading
+
+_lock = threading.Lock()
+_active = False
+
+
+def start_profile(logdir: str):
+    """Begin an XPlane trace into ``logdir`` (view with TensorBoard's
+    profile plugin or Perfetto).  Reference analogue:
+    horovod_start_timeline (operations.cc:1077).  Raises if a trace
+    started through this module is already running."""
+    global _active
+    import jax
+
+    with _lock:
+        if _active:
+            raise RuntimeError(
+                "a profile is already active; stop_profile() first "
+                "(jax supports one trace at a time)")
+        jax.profiler.start_trace(logdir)
+        _active = True
+
+
+def stop_profile():
+    global _active
+    import jax
+
+    with _lock:
+        if not _active:
+            return
+        _active = False
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named range in the profile (the reference's NvtxOpRange).
+    Near-zero overhead when no profiler is attached."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@contextlib.contextmanager
+def profile(logdir: str):
+    """Trace a scoped region: ``with profile('/tmp/trace'): step()``."""
+    start_profile(logdir)
+    try:
+        yield
+    finally:
+        stop_profile()

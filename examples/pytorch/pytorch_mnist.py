@@ -51,7 +51,7 @@ def main():
     for epoch in range(args.epochs):
         model.train()
         perm = torch.randperm(len(x))
-        total = 0.0
+        total, nbatch = 0.0, 0
         for i in range(0, len(x), args.batch_size):
             idx = perm[i:i + args.batch_size]
             optimizer.zero_grad()
@@ -59,7 +59,9 @@ def main():
             loss.backward()
             optimizer.step()
             total += float(loss)
-        avg = hvd.allreduce(torch.tensor(total), name=f"loss.{epoch}")
+            nbatch += 1
+        avg = hvd.allreduce(torch.tensor(total / max(nbatch, 1)),
+                            name=f"loss.{epoch}")
         if hvd.rank() == 0:
             print(f"epoch {epoch}: loss {float(avg):.4f}", flush=True)
     hvd.shutdown()

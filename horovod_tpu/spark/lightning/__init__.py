@@ -1,0 +1,11 @@
+"""Lightning estimator (reference ``horovod/spark/lightning/``).
+
+Gated: pytorch_lightning is not part of this image.  The contract is
+kept so Lightning-side code ports unchanged; a LightningModule is a
+torch module + optimizer/loss configuration, so the training loop
+delegates to :class:`horovod_tpu.spark.torch.TorchEstimator`'s
+machinery with the module's own ``configure_optimizers`` and
+``training_step``.
+"""
+
+from .estimator import LightningEstimator, LightningModel  # noqa: F401

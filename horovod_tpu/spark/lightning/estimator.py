@@ -1,0 +1,98 @@
+"""LightningEstimator / LightningModel.
+
+Reference: ``horovod/spark/lightning/estimator.py`` (LightningEstimator
+wrapping a LightningModule in the same Store/backend machinery as the
+torch estimator).  Gated on pytorch_lightning; the distributed loop is
+shared with :mod:`..torch.estimator` — a LightningModule supplies its
+optimizer via ``configure_optimizers`` and its loss via
+``training_step``.
+"""
+
+import numpy as np
+
+from ..common.params import EstimatorParams
+from ..torch.estimator import TorchModel
+
+
+def _require_lightning():
+    try:
+        import pytorch_lightning  # noqa: F401
+    except ImportError:
+        try:
+            import lightning  # noqa: F401
+        except ImportError as exc:
+            raise ImportError(
+                "horovod_tpu.spark.lightning requires pytorch_lightning, "
+                "which is not installed in this environment; use "
+                "horovod_tpu.spark.torch.TorchEstimator") from exc
+
+
+class LightningEstimator(EstimatorParams):
+    """``model`` is a LightningModule; batch/epoch/store parameters as
+    in :class:`..torch.estimator.TorchEstimator`."""
+
+    def fit(self, df, params=None):
+        _require_lightning()
+        from ..torch.estimator import TorchEstimator
+
+        # shared DataFrame-materialization path (dispatches back into
+        # this class's fit_arrays)
+        return TorchEstimator.fit(self, df, params)
+
+    def fit_arrays(self, x, y, x_val=None, y_val=None):
+        _require_lightning()
+        from ..torch.estimator import TorchEstimator
+
+        module = self.model
+
+        def optimizer_fn(params):
+            opt = module.configure_optimizers()
+            if isinstance(opt, dict):           # {'optimizer': ..., ...}
+                opt = opt["optimizer"]
+            if isinstance(opt, (list, tuple)):
+                opt = opt[0]
+                if isinstance(opt, (list, tuple)):
+                    opt = opt[0]
+                if isinstance(opt, dict):
+                    opt = opt["optimizer"]
+            if opt is None:
+                raise ValueError(
+                    "configure_optimizers() returned None (manual "
+                    "optimization); LightningEstimator needs an "
+                    "optimizer to drive the shared training loop")
+            return opt.__class__(params, **opt.defaults)
+
+        crit = getattr(module, "loss", None) or \
+            getattr(module, "criterion", None)
+        if crit is None:
+            # the shared loop decomposes training as model(x) +
+            # loss(out, y); silently guessing a criterion would train
+            # the wrong objective for modules that bury it inside
+            # training_step
+            raise ValueError(
+                "the LightningModule must expose its criterion as a "
+                "`loss` (or `criterion`) attribute — the distributed "
+                "loop runs model(x) + loss(out, y) rather than "
+                "training_step")
+
+        def loss_fn(outputs, labels):
+            return crit(outputs, labels)
+
+        inner = TorchEstimator(
+            model=module, optimizer=optimizer_fn, loss=loss_fn,
+            feature_cols=self.feature_cols, label_cols=self.label_cols,
+            batch_size=self.batch_size, epochs=self.epochs,
+            validation=self.validation, num_proc=self.num_proc,
+            store=self.store, run_id=self.run_id,
+            backward_passes_per_step=self.backward_passes_per_step)
+        tm = inner.fit_arrays(x, y, x_val, y_val)
+        return LightningModel(model=tm.model, history=tm.history,
+                              feature_cols=self.feature_cols,
+                              label_cols=self.label_cols,
+                              run_id=tm.run_id, store=tm.store)
+
+
+class LightningModel(TorchModel):
+    """Trained transformer (reference spark/lightning TorchModel
+    analogue) — same surface as :class:`..torch.estimator.TorchModel`;
+    the inherited ``load`` already constructs this class via ``cls``."""

@@ -51,6 +51,7 @@ class StoreController:
         self._cache = {}      # key -> (cache_id, fingerprint)
         self._suppressed = {} # key -> full meta withheld on a cache hit
         self._lock = threading.Lock()
+        self._jid = 0         # join-request id (idempotent retries)
         #: Last coordinator-tuned parameters seen in a poll reply
         #: (reference SynchronizeParameters broadcast); the engine
         #: applies them to its config each cycle.
@@ -120,11 +121,15 @@ class StoreController:
             self._suppressed.pop(key, None)
 
     def report_join(self, ps_id, rank, ps_size, proc_members=1):
+        with self._lock:
+            self._jid += 1
+            jid = self._jid
         out = self.client.coord("join", {"ps": ps_id, "rank": rank,
                                          "ps_size": ps_size,
                                          "proc": self.proc_id,
                                          "round": self.round_id,
-                                         "proc_members": proc_members})
+                                         "proc_members": proc_members,
+                                         "jid": jid})
         if out.get("stale"):
             raise StaleRoundError(
                 f"coordinator moved to round {out.get('round')}")

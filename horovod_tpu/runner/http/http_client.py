@@ -1,20 +1,91 @@
 """Client for the launcher's KV/coordinator service (reference
 ``horovod/runner/http/http_client.py``: read/write/delete KV helpers).
+
+Connections are persistent (HTTP/1.1 keep-alive, one per thread): the
+store-mode hot path issues a ready-POST and a poll per negotiation
+cycle, and a fresh TCP handshake per request would dominate small-op
+latency.  A dropped/stale connection transparently reconnects once.
 """
 
 import hashlib
 import hmac
+import http.client
 import json
-import urllib.error
-import urllib.request
+import threading
+
+
+class _HTTPError(Exception):
+    def __init__(self, code, msg=""):
+        super().__init__(f"HTTP {code} {msg}")
+        self.code = code
 
 
 class StoreClient:
     def __init__(self, addr: str, port: int, secret: bytes = None,
                  timeout: float = 30.0):
-        self.base = f"http://{addr}:{port}"
+        self.addr = addr
+        self.port = port
         self.secret = secret
         self.timeout = timeout
+        self._tls = threading.local()
+
+    # -- connection management ----------------------------------------------
+
+    def _conn(self, timeout):
+        conn = getattr(self._tls, "conn", None)
+        if conn is None:
+            conn = http.client.HTTPConnection(self.addr, self.port,
+                                              timeout=timeout)
+            self._tls.conn = conn
+        elif conn.sock is not None:
+            # adjust the live socket instead of reconnecting: the hot
+            # path alternates ready-POST (default timeout) with
+            # long-poll (larger timeout) on the same connection
+            conn.sock.settimeout(timeout)
+        else:
+            conn.timeout = timeout
+        return conn
+
+    def _drop_conn(self):
+        conn = getattr(self._tls, "conn", None)
+        if conn is not None:
+            conn.close()
+        self._tls.conn = None
+        self._tls.timeout = None
+
+    # Stale keep-alive shapes only: a TIMEOUT is never retried (the
+    # request may still be processing server-side; re-sending would
+    # double-deliver and the caller's deadline is the contract), and
+    # every coordinator verb is idempotent (ready/poll by design, join
+    # via jid dedup) so replaying one of these failures is safe.
+    _RETRYABLE = (http.client.RemoteDisconnected,
+                  http.client.CannotSendRequest,
+                  http.client.BadStatusLine,
+                  ConnectionResetError, ConnectionRefusedError,
+                  ConnectionAbortedError, BrokenPipeError)
+
+    def _request(self, method, path, body=b"", timeout=None):
+        timeout = timeout or self.timeout
+        headers = dict(self._auth_headers(body))
+        if body:
+            headers["Content-Type"] = "application/json"
+        for attempt in (0, 1):
+            conn = self._conn(timeout)
+            try:
+                conn.request(method, path, body=body or None,
+                             headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+                return resp.status, data
+            except TimeoutError:
+                self._drop_conn()
+                raise
+            except self._RETRYABLE:
+                # stale keep-alive or server restart: reconnect once
+                self._drop_conn()
+                if attempt:
+                    raise
+        raise AssertionError("unreachable")
 
     def _auth_headers(self, body: bytes):
         if self.secret is None:
@@ -22,40 +93,33 @@ class StoreClient:
         digest = hmac.new(self.secret, body, hashlib.sha256).hexdigest()
         return {"X-HVD-Auth": digest}
 
+    # -- API -----------------------------------------------------------------
+
     def put(self, key: str, value: bytes):
-        req = urllib.request.Request(
-            self.base + key, data=value, method="PUT",
-            headers=self._auth_headers(value))
-        with urllib.request.urlopen(req, timeout=self.timeout):
-            pass
+        status, _ = self._request("PUT", key, value)
+        if status != 200:
+            raise _HTTPError(status, f"PUT {key}")
 
     def get(self, key: str, wait: float = 0.0):
-        url = self.base + key
-        if wait:
-            url += f"?wait={wait}"
-        req = urllib.request.Request(url, method="GET",
-                                     headers=self._auth_headers(b""))
-        try:
-            with urllib.request.urlopen(
-                    req, timeout=max(self.timeout, wait + 5)) as r:
-                return r.read()
-        except urllib.error.HTTPError as e:
-            if e.code == 404:
-                return None
-            raise
+        path = key + (f"?wait={wait}" if wait else "")
+        status, data = self._request(
+            "GET", path, timeout=max(self.timeout, wait + 5))
+        if status == 404:
+            return None
+        if status != 200:
+            raise _HTTPError(status, f"GET {key}")
+        return data
 
     def delete(self, key: str):
-        req = urllib.request.Request(self.base + key, method="DELETE",
-                                     headers=self._auth_headers(b""))
-        with urllib.request.urlopen(req, timeout=self.timeout):
-            pass
+        status, _ = self._request("DELETE", key)
+        if status != 200:
+            raise _HTTPError(status, f"DELETE {key}")
 
     def coord(self, verb: str, payload: dict, timeout: float = None):
         body = json.dumps(payload).encode()
-        req = urllib.request.Request(
-            self.base + f"/coord/{verb}", data=body, method="POST",
-            headers={**self._auth_headers(body),
-                     "Content-Type": "application/json"})
-        with urllib.request.urlopen(
-                req, timeout=timeout or self.timeout) as r:
-            return json.loads(r.read() or b"{}")
+        status, data = self._request("POST", f"/coord/{verb}", body,
+                                     timeout=timeout)
+        if status != 200:
+            raise _HTTPError(status, f"coord/{verb}: "
+                                     f"{data[:200].decode(errors='replace')}")
+        return json.loads(data or b"{}")

@@ -221,6 +221,7 @@ class Coordinator:
         self._joined = {}       # ps_id -> set of ranks that joined
         self._proc_joined = {}  # ps_id -> {proc -> join count}
         self._exhausted = {}    # ps_id -> set of procs fully joined
+        self._join_seen = {}    # (ps, proc) -> set of seen join ids
         self._errors = {}       # key -> error string
         self._cache = OrderedDict()  # cache_id -> meta template (LRU)
         self._cache_by_key = {}      # key -> cache_id
@@ -244,6 +245,7 @@ class Coordinator:
             self._joined.clear()
             self._proc_joined.clear()
             self._exhausted.clear()
+            self._join_seen.clear()
             self._errors.clear()
             self._cache.clear()
             self._cache_by_key.clear()
@@ -337,6 +339,15 @@ class Coordinator:
         ps = req.get("ps", 0)
         proc = req.get("proc", -1)
         with self._lock:
+            jid = req.get("jid")
+            if jid is not None:
+                # joins are not naturally idempotent (per-proc counting
+                # below); dedup on the client's join id so the http
+                # client's reconnect-retry can safely re-send
+                seen = self._join_seen.setdefault((ps, proc), set())
+                if jid in seen:
+                    return {}
+                seen.add(jid)
             j = self._joined.setdefault(ps, set())
             j.add(req["rank"])
             pj = self._proc_joined.setdefault(ps, {})

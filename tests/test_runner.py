@@ -380,3 +380,20 @@ def test_check_build(capsys):
     check_build()
     out = capsys.readouterr().out
     assert "JAX" in out and "XLA" in out
+
+
+def test_coordinator_join_idempotent():
+    """A retried join (same jid) must not double-count toward
+    per-process exhaustion (the http client may replay a join whose
+    response was lost to a dropped keep-alive connection)."""
+    c = Coordinator(world_size=1)
+    req = {"ps": 0, "rank": 0, "ps_size": 2, "proc": 0,
+           "proc_members": 2, "jid": 1}
+    c.handle("join", dict(req))
+    c.handle("join", dict(req))          # replay — must be dropped
+    assert c._proc_joined[0][0] == 1
+    assert 0 not in c._exhausted.get(0, set())
+    # a second DISTINCT join counts: completes ps_size=2 -> join_done
+    c.handle("join", {**req, "rank": 1, "jid": 2})
+    out = c.handle("poll", {"cursor": 0, "wait": 0})
+    assert [r["kind"] for r in out["responses"]] == ["join_done"]

@@ -71,8 +71,8 @@ def fused_scale_cast(x, factor, out_dtype=None, *, block=4096,
 # ---------------------------------------------------------------------------
 # flash attention (causal, forward)
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_len,
-                  scale):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
+                  seq_len, scale):
     # q_ref: (1, block_q, D); k_ref/v_ref: (1, S, D)
     block_q = q_ref.shape[1]
     D = q_ref.shape[2]
@@ -98,14 +98,173 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_len,
         o_new = o * alpha[:, None] + p @ v
         return o_new, m_new, l_new
 
-    # causal: only key blocks at or before this query block matter
-    num_kb = (qi * block_q) // block_k + 1
+    # causal: key blocks covering positions up to the LAST row of this
+    # query block (block_q may exceed block_k)
+    num_kb = ((qi + 1) * block_q - 1) // block_k + 1
     o0 = jnp.zeros((block_q, D), jnp.float32)
     m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)
     o, m, l = jax.lax.fori_loop(0, num_kb, body, (o0, m0, l0))
     l = jnp.maximum(l, np.float32(1e-30))
     o_ref[0] = (o / l[:, None]).astype(o_ref.dtype)
+    # logsumexp per row, consumed by the backward kernels; stored as
+    # (BH, 1, S) so TPU block shapes satisfy the (8, 128) tiling rule
+    lse_ref[0, 0] = m + jnp.log(l)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                         delta_ref, dq_ref, *, block_k, scale):
+    """dq for one query block: loop over key blocks <= this one,
+    recompute p from (q, k, lse), accumulate ds @ k."""
+    block_q = q_ref.shape[1]
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * np.float32(scale)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(kb, dq):
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = q @ k.T
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = q_pos >= k_pos
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), np.float32(0.0))
+        dp = do @ v.T
+        ds = p * (dp - delta[:, None])
+        return dq + ds @ k
+
+    num_kb = ((qi + 1) * block_q - 1) // block_k + 1
+    dq = jax.lax.fori_loop(
+        0, num_kb, body, jnp.zeros((block_q, q_ref.shape[2]),
+                                   jnp.float32))
+    dq_ref[0] = (dq * np.float32(scale)).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref,
+                          delta_ref, dk_ref, dv_ref, *, block_q,
+                          seq_len, scale):
+    """dk/dv for one key block: loop over query blocks >= this one."""
+    block_k = k_ref.shape[1]
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :] \
+            .astype(jnp.float32) * np.float32(scale)
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :] \
+            .astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)]
+        s = q @ k.T                                  # (bq, bk)
+        q_pos = qb * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        mask = q_pos >= k_pos
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), np.float32(0.0))
+        dv = dv + p.T @ do
+        dp = do @ v.T
+        ds = p * (dp - delta[:, None])
+        # q here is already q_unscaled * scale, which is exactly the
+        # factor dk needs: dk = ds^T @ (q_unscaled * scale)
+        dk = dk + ds.T @ q
+        return dk, dv
+
+    # causal: only query blocks whose END reaches this key block
+    first_qb = (ki * block_k) // block_q
+    num_qb = seq_len // block_q
+    D = k_ref.shape[2]
+    dk0 = jnp.zeros((block_k, D), jnp.float32)
+    dv0 = jnp.zeros((block_k, D), jnp.float32)
+    dk, dv = jax.lax.fori_loop(first_qb, num_qb, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(qf, kf, vf, block_q, block_k, interpret):
+    out, _ = _flash_fwd_call(qf, kf, vf, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd_call(qf, kf, vf, block_q, block_k, interpret):
+    BH, S, D = qf.shape
+    scale = 1.0 / np.sqrt(D)
+    out, lse = pl.pallas_call(
+        functools.partial(_flash_kernel, block_k=block_k, seq_len=S,
+                          scale=scale),
+        out_shape=(jax.ShapeDtypeStruct((BH, S, D), qf.dtype),
+                   jax.ShapeDtypeStruct((BH, 1, S), jnp.float32)),
+        grid=(BH, S // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=(pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+                   pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i))),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out, lse
+
+
+def _flash_vjp_fwd(qf, kf, vf, block_q, block_k, interpret):
+    out, lse = _flash_fwd_call(qf, kf, vf, block_q, block_k, interpret)
+    return out, (qf, kf, vf, out, lse)
+
+
+def _flash_vjp_bwd(block_q, block_k, interpret, res, do):
+    qf, kf, vf, out, lse = res
+    BH, S, D = qf.shape
+    scale = 1.0 / np.sqrt(D)
+    # delta = rowsum(dO * O) — cheap elementwise, plain XLA; shaped
+    # (BH, 1, S) for the TPU block-tiling rule like lse
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)[:, None, :]              # (BH, 1, S)
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
+                          scale=scale),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), qf.dtype),
+        grid=(BH, S // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(qf, kf, vf, do, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
+                          seq_len=S, scale=scale),
+        out_shape=(jax.ShapeDtypeStruct((BH, S, D), kf.dtype),
+                   jax.ShapeDtypeStruct((BH, S, D), vf.dtype)),
+        grid=(BH, S // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, S), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, S), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=(pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+                   pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0))),
+        interpret=interpret,
+    )(kf, vf, qf, do, lse, delta)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def flash_attention(q, k, v, *, block_q=128, block_k=128,
@@ -113,7 +272,10 @@ def flash_attention(q, k, v, *, block_q=128, block_k=128,
     """Causal attention (B, S, H, D) -> (B, S, H, D), flash-style.
 
     Memory: O(block_q * S) VMEM per program instead of O(S^2) HBM —
-    the long-context single-chip workhorse.
+    the long-context single-chip workhorse.  Differentiable: the
+    backward pass is two pallas kernels (dq; dk/dv) recomputing
+    attention probabilities blockwise from the saved logsumexp, per
+    FlashAttention's backward (never materializing the S^2 matrix).
     """
     if interpret is None:
         interpret = not _is_tpu()
@@ -123,24 +285,10 @@ def flash_attention(q, k, v, *, block_q=128, block_k=128,
     if S % block_q or S % block_k:
         raise ValueError(f"seq len {S} must divide blocks "
                          f"({block_q}, {block_k})")
-    scale = 1.0 / np.sqrt(D)
 
     # fold batch and heads into the grid's first axis
     qf = q.transpose(0, 2, 1, 3).reshape(B * H, S, D)
     kf = k.transpose(0, 2, 1, 3).reshape(B * H, S, D)
     vf = v.transpose(0, 2, 1, 3).reshape(B * H, S, D)
-
-    out = pl.pallas_call(
-        functools.partial(_flash_kernel, block_k=block_k, seq_len=S,
-                          scale=scale),
-        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
-        grid=(B * H, S // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-        interpret=interpret,
-    )(qf, kf, vf)
+    out = _flash(qf, kf, vf, block_q, block_k, interpret)
     return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)

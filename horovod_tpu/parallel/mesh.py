@@ -72,11 +72,27 @@ class MeshSpec:
 
 def build_mesh(spec: Optional[MeshSpec] = None,
                devices: Optional[Sequence] = None, **axis_sizes) -> Mesh:
-    """Build a Mesh; ``build_mesh(dp=-1, tp=4)`` style kwargs accepted."""
+    """Build a Mesh; ``build_mesh(dp=-1, tp=4)`` style kwargs accepted.
+
+    On real TPU slices (no explicit device list) the assignment goes
+    through ``mesh_utils.create_device_mesh``, which maps logical axes
+    onto the physical ICI torus so innermost-axis collectives ride
+    nearest-neighbour links; an explicit ``devices`` list is honored
+    verbatim (tests, sub-meshes)."""
     if spec is None:
         spec = MeshSpec(**{a: axis_sizes.get(a, 1) for a in AXIS_ORDER})
-    devices = list(devices) if devices is not None else jax.devices()
+    explicit = devices is not None
+    devices = list(devices) if explicit else jax.devices()
     spec = spec.resolve(len(devices))
+    if not explicit and devices and devices[0].platform == "tpu":
+        try:
+            from jax.experimental import mesh_utils
+
+            arr = mesh_utils.create_device_mesh(spec.sizes(),
+                                                devices=devices)
+            return Mesh(arr, AXIS_ORDER)
+        except Exception:  # noqa: BLE001 — odd topologies: row-major
+            pass
     arr = np.array(devices).reshape(spec.sizes())
     return Mesh(arr, AXIS_ORDER)
 

@@ -44,22 +44,32 @@ def make_lm_train_step(mesh: Mesh, cfg: TransformerConfig,
     fused all_to_alls, needs (n_heads / tp) % sp == 0).
     """
     optimizer = optimizer or optax.adamw(learning_rate)
-    if attention_impl not in ("ring", "ulysses"):
+    if attention_impl not in ("ring", "ulysses", "flash"):
         raise ValueError(
-            f"attention_impl must be 'ring' or 'ulysses', "
+            f"attention_impl must be 'ring', 'ulysses', or 'flash', "
             f"got {attention_impl!r}")
-    if not sequence_parallel and attention_impl != "ring":
+    if not sequence_parallel and attention_impl not in ("ring", "flash"):
         raise ValueError(
-            "attention_impl only takes effect with "
+            "attention_impl='ulysses' only takes effect with "
             "sequence_parallel=True — set it, or drop attention_impl")
     attention_fn = None
     if sequence_parallel:
+        if attention_impl == "flash":
+            raise ValueError(
+                "attention_impl='flash' is the single-shard pallas "
+                "kernel; with sequence_parallel use 'ring' (itself "
+                "flash-style streaming) or 'ulysses'")
         if attention_impl == "ring":
             attention_fn = make_ring_attention_fn(mesh)
         else:
             from .ulysses import make_ulysses_attention_fn
             attention_fn = make_ulysses_attention_fn(mesh)
         model = TransformerLM(cfg, attention_fn=attention_fn)
+    elif attention_impl == "flash":
+        # pallas flash kernel on the MXU (ops/pallas_kernels.py):
+        # O(S) memory instead of the S^2 score matrix
+        from ..ops.pallas_kernels import flash_attention
+        model = TransformerLM(cfg, attention_fn=flash_attention)
     else:
         model = TransformerLM(cfg)
 

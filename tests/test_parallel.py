@@ -189,6 +189,40 @@ def test_sequence_parallel_ulysses_step():
                                rtol=1e-4, atol=1e-5)
 
 
+def test_flash_attention_train_step_matches_dense():
+    """attention_impl='flash' (pallas kernel) computes the same loss
+    as the dense step (interpret mode on CPU; compiled on TPU)."""
+    mesh = build_mesh(dp=8)
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_layers=2,
+                            n_heads=4, d_ff=64, max_seq_len=128,
+                            dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (8, 128), 0, 64)
+    init, step, jit_step, tok_shd = make_lm_train_step(
+        mesh, cfg, optimizer=optax.sgd(0.1), attention_impl="flash")
+    state = init(jax.random.PRNGKey(1), tokens)
+    state, loss = step(state, tokens)
+    _, loss2 = step(state, tokens)    # 2nd step loss depends on grads
+
+    init_d, step_d, _, _ = make_lm_train_step(mesh, cfg,
+                                              optimizer=optax.sgd(0.1))
+    ref_state, ref_loss = step_d(init_d(jax.random.PRNGKey(1), tokens),
+                                 tokens)
+    _, ref_loss2 = step_d(ref_state, tokens)
+    np.testing.assert_allclose(float(loss), float(ref_loss),
+                               rtol=1e-4, atol=1e-5)
+    # the backward kernels produced the dense gradients: updated params
+    # and the post-update loss both match
+    np.testing.assert_allclose(float(loss2), float(ref_loss2),
+                               rtol=1e-4, atol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(state["params"]),
+                    jax.tree_util.tree_leaves(ref_state["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
+    with pytest.raises(ValueError):
+        make_lm_train_step(mesh, cfg, sequence_parallel=True,
+                           attention_impl="flash")
+
+
 def test_pipeline_matches_reference_apply():
     mesh = build_mesh(dp=2, pp=4)
     model = TransformerLM(CFG)

@@ -319,6 +319,14 @@ FULL_MATRIX_WORKER = textwrap.dedent("""
                        name="mx")
     assert mn[0] == 0.0 and mx[0] == float(s - 1)
 
+    # process set spanning a subset of PROCESSES: only rank 0's proc
+    # participates; completion must not wait on the other process
+    ps = hvd.add_process_set([0])
+    if r == 0:
+        out = hvd.allreduce(np.full(2, 7.0, np.float32), op=hvd.Sum,
+                            name="ps0", process_set=ps)
+        assert np.allclose(out, 7.0), out
+
     # join: rank 0 runs out of data early; rank 1 keeps reducing and
     # gets zeros contributed for rank 0 (reference join semantics)
     if r == 0:

@@ -39,7 +39,9 @@ def parse_args(argv=None):
     p.add_argument("--batch-per-device", type=int, default=0,
                    help="per-device batch (default: model-specific)")
     p.add_argument("--iters", type=int, default=20)
-    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--warmup", type=int, default=3,
+                   help="warmup iterations (min 1: the first call also "
+                        "binds the timed loop's state)")
     p.add_argument("--counts", type=str, default="",
                    help="comma-separated device counts (default: powers "
                         "of two up to the device total)")
@@ -48,6 +50,7 @@ def parse_args(argv=None):
 
 def main(argv=None):
     args = parse_args(argv)
+    args.warmup = max(args.warmup, 1)   # the loops bind `loss`
 
     import jax
     if args.virtual:

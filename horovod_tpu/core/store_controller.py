@@ -52,6 +52,7 @@ class StoreController:
         self._suppressed = {} # key -> full meta withheld on a cache hit
         self._lock = threading.Lock()
         self._jid = 0         # join-request id (idempotent retries)
+        self._rid = 0         # ready-report id (idempotent retries)
         #: Last coordinator-tuned parameters seen in a poll reply
         #: (reference SynchronizeParameters broadcast); the engine
         #: applies them to its config each cycle.
@@ -89,9 +90,12 @@ class StoreController:
             self._post_ready(fresh)
 
     def _post_ready(self, entries):
+        with self._lock:
+            self._rid += 1
+            rid = self._rid
         out = self.client.coord("ready", {
             "proc": self.proc_id, "nlocal": self.nlocal,
-            "round": self.round_id, "entries": entries})
+            "round": self.round_id, "entries": entries, "rid": rid})
         if out.get("stale"):
             raise StaleRoundError(
                 f"coordinator moved to round {out.get('round')}")

@@ -60,9 +60,11 @@ class DataServiceServer:
     """Dispatcher + in-process compute workers.
 
     ``dataset_fn(worker_index, num_workers) -> iterator`` runs on each
-    compute worker thread; batches are pickled into per-worker bounded
-    queues served over HTTP GETs.  Start one of these per compute host
-    (or one with several workers on a fat host).
+    compute worker thread; batches are pickled into per-worker slots of
+    the KV store (``/data/<w>/<seq>``) with delete-based flow control —
+    at most ``queue_size`` undelivered batches per worker.  Start one
+    of these per compute host (or one with several workers on a fat
+    host).
     """
 
     def __init__(self, dataset_fn: Callable[[int, int], Iterator],
@@ -76,10 +78,9 @@ class DataServiceServer:
         # arbitrary code execution — same policy as the job launcher
         # (proc_run.py secrets.token_hex)
         self._secret = secret or _secrets.token_bytes(16)
-        self._server = reuse_server or RendezvousServer(secret=secret)
+        self._server = reuse_server or RendezvousServer(
+            secret=self._secret)
         self._owns_server = reuse_server is None
-        self._queues = [queue.Queue(maxsize=queue_size)
-                        for _ in range(num_workers)]
         self._threads = []
         self._stop = threading.Event()
         self._port = None
@@ -144,16 +145,21 @@ def data_service(config: DataServiceConfig, rank: int = 0,
 
     With ``size`` ranks and ``num_workers`` compute workers, rank r
     reads workers ``r, r+size, r+2*size, ...`` round-robin — each batch
-    is consumed by exactly one rank.
+    is consumed by exactly one rank.  ``num_workers`` must be >= size
+    (a rank with no worker would yield nothing and hang its peers in
+    the first collective).
     """
     if isinstance(config, dict):
         config = DataServiceConfig.from_dict(config)
+    if config.num_workers < size:
+        raise ValueError(
+            f"data service has {config.num_workers} compute workers "
+            f"for {size} consuming ranks; every rank needs at least "
+            f"one worker shard")
     client = StoreClient(config.addr, config.port,
                          bytes.fromhex(config.secret_hex))
     my_workers = [w for w in range(config.num_workers)
                   if w % size == rank]
-    if not my_workers:
-        return
     seqs = {w: 0 for w in my_workers}
     live = set(my_workers)
     q: "queue.Queue" = queue.Queue(maxsize=max(prefetch, 1))
@@ -161,16 +167,24 @@ def data_service(config: DataServiceConfig, rank: int = 0,
     _DONE = object()
 
     def fetch():
+        import time as _time
+
         try:
+            last_progress = _time.monotonic()
             while live:
+                # short non-blocking-ish polls in rotation so one slow
+                # worker can't head-of-line-block batches the rank's
+                # other workers already have ready
+                progressed = False
                 for w in list(live):
-                    raw = client.get(f"/data/{w}/{seqs[w]}", wait=timeout)
+                    raw = client.get(f"/data/{w}/{seqs[w]}",
+                                     wait=0.2 if len(live) > 1 else
+                                     min(timeout, 5.0))
                     if raw is None:
-                        raise TimeoutError(
-                            f"data service worker {w} produced nothing "
-                            f"for {timeout}s")
+                        continue
                     client.delete(f"/data/{w}/{seqs[w]}")
                     seqs[w] += 1
+                    progressed = True
                     batch = pickle.loads(raw)
                     if batch is None:        # worker exhausted
                         live.discard(w)
@@ -180,6 +194,12 @@ def data_service(config: DataServiceConfig, rank: int = 0,
                             f"data service worker {w} failed: "
                             f"{batch.message}")
                     q.put(batch)
+                if progressed:
+                    last_progress = _time.monotonic()
+                elif _time.monotonic() - last_progress > timeout:
+                    raise TimeoutError(
+                        f"data service workers {sorted(live)} produced "
+                        f"nothing for {timeout}s")
             q.put(_DONE)
         except BaseException as exc:  # noqa: BLE001 — re-raised below
             q.put(exc)

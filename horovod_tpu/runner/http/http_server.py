@@ -222,6 +222,7 @@ class Coordinator:
         self._proc_joined = {}  # ps_id -> {proc -> join count}
         self._exhausted = {}    # ps_id -> set of procs fully joined
         self._join_seen = {}    # (ps, proc) -> set of seen join ids
+        self._ready_seen = {}   # proc -> highest seen ready-report id
         self._errors = {}       # key -> error string
         self._cache = OrderedDict()  # cache_id -> meta template (LRU)
         self._cache_by_key = {}      # key -> cache_id
@@ -246,6 +247,7 @@ class Coordinator:
             self._proc_joined.clear()
             self._exhausted.clear()
             self._join_seen.clear()
+            self._ready_seen.clear()
             self._errors.clear()
             self._cache.clear()
             self._cache_by_key.clear()
@@ -273,6 +275,16 @@ class Coordinator:
         proc = req["proc"]
         uncached = []
         with self._lock:
+            rid = req.get("rid")
+            if rid is not None:
+                # ready is only idempotent while the entry is still
+                # pending; a replayed POST (dropped keep-alive after the
+                # server processed the original) could otherwise plant a
+                # phantom entry with the PREVIOUS step's meta — dedup on
+                # the client's monotonically increasing report id
+                if rid <= self._ready_seen.get(proc, 0):
+                    return {}
+                self._ready_seen[proc] = rid
             for meta in req["entries"]:
                 key = meta["key"]
                 if "c" in meta:

@@ -131,8 +131,14 @@ class ProcessPool:
         if stdin_data is not None:
             # deliver the payload and close so the remote shell sees
             # EOF (the env handoff is sourced from stdin)
-            p.stdin.write(stdin_data)
-            p.stdin.close()
+            try:
+                p.stdin.write(stdin_data)
+                p.stdin.close()
+            except (BrokenPipeError, OSError):
+                # ssh died instantly (unreachable host / auth failure):
+                # keep the dead Popen so wait() reports a clean launch
+                # failure instead of an unhandled traceback here
+                pass
         self.procs.append(p)
         return p
 

@@ -219,3 +219,26 @@ def test_lightning_estimator_gated():
         est.fit_arrays(np.zeros((4, 2), np.float32),
                        np.zeros((4, 1), np.float32))
     assert issubclass(LightningModel, object)
+
+
+def test_data_service_rejects_unauthenticated_writes():
+    """The service's listener must enforce its advertised HMAC secret —
+    batches are pickles, so an open PUT would be remote code
+    execution."""
+    from horovod_tpu.data import DataServiceServer, data_service
+    from horovod_tpu.runner.http.http_client import StoreClient
+
+    server = DataServiceServer(lambda w, n: iter(()), num_workers=1)
+    cfg = server.start()
+    try:
+        intruder = StoreClient("127.0.0.1", cfg.port, b"not-the-secret")
+        with pytest.raises(Exception):
+            intruder.put("/data/0/999", b"attack")
+        legit = StoreClient("127.0.0.1", cfg.port,
+                            bytes.fromhex(cfg.secret_hex))
+        legit.put("/probe", b"ok")          # real secret works
+        # rank/size mismatch fails fast instead of hanging peers
+        with pytest.raises(ValueError, match="at least"):
+            next(iter(data_service(cfg.to_dict(), rank=0, size=2)))
+    finally:
+        server.stop()

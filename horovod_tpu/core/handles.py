@@ -14,7 +14,8 @@ class Handle:
     """Completion record for one enqueued tensor operation."""
 
     __slots__ = ("_event", "result", "error", "extra", "kind",
-                 "inplace_target", "returns_splits", "grouped")
+                 "inplace_target", "inplace_targets", "returns_splits",
+                 "grouped")
 
     def __init__(self):
         self._event = threading.Event()
@@ -26,6 +27,8 @@ class Handle:
         # whether synchronize() should return (tensor, recv_splits).
         self.kind: Any = "numpy"
         self.inplace_target: Any = None
+        # grouped in-place variant: per-tensor write-back targets
+        self.inplace_targets: Any = None
         self.returns_splits: bool = False
         # grouped ops always resolve to a list of tensors
         self.grouped: bool = False

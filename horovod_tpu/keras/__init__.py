@@ -5,12 +5,54 @@ from ..common.basics import (  # noqa: F401
     rank, size, local_rank, local_size, cross_rank, cross_size,
 )
 from ..tensorflow import (  # noqa: F401
-    allreduce, allgather, broadcast, broadcast_object, allgather_object,
+    allreduce, allgather, broadcast, reducescatter, alltoall,
+    broadcast_object, allgather_object,
     broadcast_variables, Average, Sum, Adasum,
     Compression, DistributedOptimizer,
 )
 from . import callbacks  # noqa: F401
 from . import elastic  # noqa: F401
+
+
+def PartialDistributedOptimizer(optimizer, name=None,
+                                device_dense="", device_sparse="",
+                                compression=Compression.none,
+                                sparse_as_dense=False,
+                                gradient_predivide_factor=1.0,
+                                op=Average, groups=None,
+                                process_set=None,
+                                local_layers=None,
+                                scale_local_gradients=True):
+    """DistributedOptimizer whose ``local_layers`` keep their gradients
+    local — no allreduce (reference keras/__init__.py:116)."""
+    import tensorflow as tf
+    from ..common.process_sets import global_process_set
+    from ..tensorflow import DistributedOptimizer as _wrap
+
+    if local_layers is None:
+        local_layers = []
+    elif isinstance(local_layers, tf.keras.layers.Layer):
+        local_layers = [local_layers]
+    elif not all(isinstance(l, tf.keras.layers.Layer)
+                 for l in local_layers):
+        raise ValueError(
+            "All local layers must be of tf.keras.layers.Layer type.")
+    opt = _wrap(optimizer, name=name, compression=compression,
+                sparse_as_dense=sparse_as_dense, op=op, groups=groups,
+                gradient_predivide_factor=gradient_predivide_factor,
+                process_set=process_set or global_process_set,
+                scale_local_gradients=scale_local_gradients)
+    for layer in local_layers:
+        for var in layer.trainable_weights:
+            opt.register_local_var(var)
+    return opt
+
+
+def broadcast_global_variables(root_rank):
+    """Broadcast all TF global variables from root (reference
+    keras/__init__.py:183; TF2 keeps the v1 collection under compat)."""
+    import tensorflow as tf
+    return broadcast_variables(tf.compat.v1.global_variables(), root_rank)
 
 
 def load_model(filepath, custom_optimizers=None, custom_objects=None,

@@ -128,3 +128,20 @@ class LearningRateWarmupCallback(LearningRateScheduleCallback):
                 basics.rank() == 0:
             print(f"Epoch {epoch + 1}: finished gradual learning rate "
                   f"warmup to {self.initial_lr}.")
+
+
+class BestModelCheckpoint(tf.keras.callbacks.ModelCheckpoint):
+    """ModelCheckpoint preset that saves only the best full model by
+    the monitored metric (reference keras/callbacks.py:161).  Pair with
+    MetricAverageCallback so every rank agrees on the metric, and guard
+    saving to rank 0 in the filepath choice."""
+
+    def __init__(self, filepath=None, monitor="val_loss", verbose=0,
+                 mode="auto", save_freq="epoch"):
+        if filepath is None:
+            raise ValueError(
+                "BestModelCheckpoint requires a filepath to save to")
+        super().__init__(filepath=filepath, monitor=monitor,
+                         verbose=verbose, save_best_only=True,
+                         save_weights_only=False, mode=mode,
+                         save_freq=save_freq)

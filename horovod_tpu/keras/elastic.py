@@ -4,6 +4,8 @@ CommitStateCallback, UpdateEpochStateCallback, UpdateBatchStateCallback).
 
 import tensorflow as tf
 
+from ..tensorflow.elastic import TensorFlowKerasState
+
 
 class CommitStateCallback(tf.keras.callbacks.Callback):
     """Commit state every ``batches_per_commit`` batches (reference
@@ -41,3 +43,8 @@ class UpdateBatchStateCallback(tf.keras.callbacks.Callback):
 
     def on_epoch_end(self, epoch, logs=None):
         self.state.batch = 0
+
+
+class KerasState(TensorFlowKerasState):
+    """Elastic state for a keras model (reference keras/elastic.py:22 —
+    an alias of TensorFlowKerasState bound to the installed keras)."""

@@ -22,6 +22,7 @@ from ..core.message import (
 __all__ = [
     "allreduce", "allreduce_async", "allreduce_", "allreduce_async_",
     "grouped_allreduce", "grouped_allreduce_async",
+    "grouped_allreduce_", "grouped_allreduce_async_",
     "allgather", "allgather_async", "grouped_allgather",
     "grouped_allgather_async",
     "broadcast", "broadcast_async", "broadcast_", "broadcast_async_",
@@ -160,6 +161,25 @@ def grouped_allreduce(tensors, average=None, name=None, op=None,
                       process_set=global_process_set):
     h = grouped_allreduce_async(tensors, average, name, op, prescale_factor,
                                 postscale_factor, process_set)
+    return synchronize(h)
+
+
+def grouped_allreduce_async_(tensors, average=None, name=None, op=None,
+                             prescale_factor=1.0, postscale_factor=1.0,
+                             process_set=global_process_set):
+    """In-place grouped variant (reference torch/mpi_ops.py:491):
+    results are written back into each mutable input tensor."""
+    h = grouped_allreduce_async(tensors, average, name, op, prescale_factor,
+                                postscale_factor, process_set)
+    h.inplace_targets = [t if _mutable(t) else None for t in tensors]
+    return h
+
+
+def grouped_allreduce_(tensors, average=None, name=None, op=None,
+                       prescale_factor=1.0, postscale_factor=1.0,
+                       process_set=global_process_set):
+    h = grouped_allreduce_async_(tensors, average, name, op, prescale_factor,
+                                 postscale_factor, process_set)
     return synchronize(h)
 
 
@@ -361,7 +381,11 @@ def synchronize(handle):
         result = [result]
     if isinstance(result, list):
         kinds = kind if isinstance(kind, list) else [kind] * len(result)
-        return [util.from_numpy(r, k) for r, k in zip(result, kinds)]
+        targets = getattr(handle, "inplace_targets", None) or \
+            [None] * len(result)
+        return [util.copy_into(t, r) if t is not None
+                else util.from_numpy(r, k)
+                for r, k, t in zip(result, kinds, targets)]
     if inplace is not None:
         return util.copy_into(inplace, result)
     return util.from_numpy(result, kind)

@@ -12,6 +12,7 @@ a compiled XLA program on the TPU mesh.
 
 import tensorflow as tf
 
+from ..common import basics as _basics
 from ..common.basics import (  # noqa: F401
     init, shutdown, is_initialized,
     rank, size, local_rank, local_size, cross_rank, cross_size,
@@ -71,32 +72,88 @@ def _var_name(v):
     return str(name).replace(":", "_") if name else "var"
 
 
-class DistributedGradientTape(tf.GradientTape):
-    """``tf.GradientTape`` whose ``gradient()`` averages gradients
-    across ranks (reference ``tensorflow/__init__.py:1110``
-    DistributedGradientTape -> _DistributedGradientTape :1026)."""
+def _var_key(v):
+    """Hashable identity for a variable: tf.Variable.ref() when
+    available, object identity otherwise (keras-3 Variables are
+    unhashable and have no ref())."""
+    try:
+        return v.ref()
+    except (AttributeError, TypeError):
+        return id(v)
 
-    def __init__(self, persistent=False, watch_accessed_variables=True,
-                 device_dense="", device_sparse="",
-                 compression=Compression.none, sparse_as_dense=False,
-                 op=Average, gradient_predivide_factor=1.0,
-                 num_groups=0, groups=None,
-                 process_set=global_process_set):
-        super().__init__(persistent=persistent,
-                         watch_accessed_variables=watch_accessed_variables)
-        self._compression = compression
-        self._sparse_as_dense = sparse_as_dense
-        self._op = op
-        self._gradient_predivide_factor = gradient_predivide_factor
-        self._process_set = process_set
 
-    def gradient(self, target, sources, output_gradients=None,
-                 unconnected_gradients=tf.UnconnectedGradients.NONE):
-        grads = super().gradient(target, sources, output_gradients,
-                                 unconnected_gradients)
-        return self._allreduce_grads(grads)
+# ----------------------------------------------------------------------------
+# in-graph scalar query ops (reference tensorflow/mpi_ops.py:
+# size_op/local_size_op/rank_op/local_rank_op/process_set_included_op —
+# TF custom ops there; eager constants suffice here since topology is
+# fixed for the life of the process between elastic resets)
 
-    def _allreduce_grads(self, grads):
+def size_op(process_set_id=0, name=None):
+    ranks = _basics.engine().process_set_ranks(process_set_id)
+    return tf.constant(len(ranks), dtype=tf.int32, name=name)
+
+
+def local_size_op(name=None):
+    return tf.constant(local_size(), dtype=tf.int32, name=name)
+
+
+def rank_op(name=None):
+    return tf.constant(rank(), dtype=tf.int32, name=name)
+
+
+def local_rank_op(name=None):
+    return tf.constant(local_rank(), dtype=tf.int32, name=name)
+
+
+def process_set_included_op(process_set_id=0, name=None):
+    ranks = _basics.engine().process_set_ranks(process_set_id)
+    return tf.constant(int(rank() in ranks), dtype=tf.int32, name=name)
+
+
+def broadcast_object_fn(root_rank=0, session=None, name=None,
+                        process_set=global_process_set):
+    """Returns a fn(obj) that broadcasts the object from root
+    (reference tensorflow/functions.py broadcast_object_fn; the
+    ``session`` arg is TF1 compat and ignored)."""
+    def _fn(obj=None):
+        return broadcast_object(obj, root_rank=root_rank, name=name,
+                                process_set=process_set)
+    return _fn
+
+
+class _GradSync:
+    """Single implementation of the cross-rank gradient sync used by
+    DistributedGradientTape, PartialDistributedGradientTape and
+    DistributedOptimizer (the reference spreads this over
+    _make_allreduce_grads_fn + per-wrapper copies,
+    tensorflow/__init__.py:655-760)."""
+
+    def __init__(self, compression=Compression.none, op=Average,
+                 gradient_predivide_factor=1.0,
+                 process_set=global_process_set,
+                 scale_local_gradients=True):
+        self.compression = compression
+        self.op = op
+        self.gradient_predivide_factor = gradient_predivide_factor
+        self.process_set = process_set
+        self.scale_local_gradients = scale_local_gradients
+        # local (non-synced) variables, reference tensorflow/__init__.py
+        # register_local_source / scale_local_gradients (:1029-1100)
+        self.local_vars = set()
+
+    def register_local_var(self, var):
+        self.local_vars.add(_var_key(var))
+
+    def is_local(self, var):
+        return _var_key(var) in self.local_vars
+
+    def _size(self):
+        return len(_basics.engine().process_set_ranks(
+            self.process_set.process_set_id or 0))
+
+    def allreduce_grads(self, grads):
+        """Grouped allreduce of a (possibly nested) grad structure;
+        None entries pass through, IndexedSlices densify."""
         flat = tf.nest.flatten(grads)
         dense, index = [], []
         for i, g in enumerate(flat):
@@ -111,20 +168,108 @@ class DistributedGradientTape(tf.GradientTape):
             index.append(i)
         if not dense:
             return grads
-        comp, ctxs = zip(*[self._compression.compress(g) for g in dense])
+        comp, ctxs = zip(*[self.compression.compress(g) for g in dense])
         prescale = 1.0
-        if self._op == Average and self._gradient_predivide_factor != 1.0:
-            prescale = 1.0 / self._gradient_predivide_factor
-        outs = grouped_allreduce(list(comp), op=self._op,
+        if self.op == Average and self.gradient_predivide_factor != 1.0:
+            prescale = 1.0 / self.gradient_predivide_factor
+        outs = grouped_allreduce(list(comp), op=self.op,
                                  prescale_factor=prescale,
-                                 process_set=self._process_set)
+                                 process_set=self.process_set)
         if not isinstance(outs, list):
             outs = [outs]
-        outs = [self._compression.decompress(o, c)
+        outs = [self.compression.decompress(o, c)
                 for o, c in zip(outs, ctxs)]
         for i, o in zip(index, outs):
             flat[i] = o
         return tf.nest.pack_sequence_as(grads, flat)
+
+    def sync(self, grads, sources=None):
+        """allreduce_grads, but gradients of registered local sources
+        are kept local (scaled by 1/size when scale_local_gradients)."""
+        if sources is None or not self.local_vars:
+            return self.allreduce_grads(grads)
+        flat_src = tf.nest.flatten(sources)
+        flat = tf.nest.flatten(grads)
+        sync_idx = [i for i, s in enumerate(flat_src)
+                    if not self.is_local(s)]
+        synced = self.allreduce_grads([flat[i] for i in sync_idx])
+        for i, g in zip(sync_idx, synced):
+            flat[i] = g
+        if self.scale_local_gradients:
+            # scale local grads by 1/size so their magnitude matches the
+            # averaged synced grads (reference pull/3695 semantics)
+            n = self._size()
+            for i, s in enumerate(flat_src):
+                if self.is_local(s) and flat[i] is not None:
+                    flat[i] = flat[i] / n
+        return tf.nest.pack_sequence_as(grads, flat)
+
+
+class DistributedGradientTape(tf.GradientTape):
+    """``tf.GradientTape`` whose ``gradient()`` averages gradients
+    across ranks (reference ``tensorflow/__init__.py:1110``
+    DistributedGradientTape -> _DistributedGradientTape :1026)."""
+
+    def __init__(self, persistent=False, watch_accessed_variables=True,
+                 device_dense="", device_sparse="",
+                 compression=Compression.none, sparse_as_dense=False,
+                 op=Average, gradient_predivide_factor=1.0,
+                 num_groups=0, groups=None,
+                 process_set=global_process_set,
+                 scale_local_gradients=True):
+        super().__init__(persistent=persistent,
+                         watch_accessed_variables=watch_accessed_variables)
+        self._sync = _GradSync(
+            compression=compression, op=op,
+            gradient_predivide_factor=gradient_predivide_factor,
+            process_set=process_set,
+            scale_local_gradients=scale_local_gradients)
+
+    def register_local_source(self, var):
+        """Exclude ``var``'s gradient from allreduce (kept local)."""
+        self._sync.register_local_var(var)
+
+    register_local_var = register_local_source
+
+    def gradient(self, target, sources, output_gradients=None,
+                 unconnected_gradients=tf.UnconnectedGradients.NONE):
+        grads = super().gradient(target, sources, output_gradients,
+                                 unconnected_gradients)
+        return self._sync.sync(grads, sources)
+
+    def _allreduce_grads(self, grads):
+        return self._sync.allreduce_grads(grads)
+
+
+class _DistributedTapeWrapper:
+    """Wraps a user-created ``tf.GradientTape`` so its ``gradient()``
+    syncs across ranks — the reference's dynamic-subclass trick
+    (tensorflow/__init__.py:1026) without mutating the user's tape."""
+
+    def __init__(self, tape, sync):
+        self._tape = tape
+        self._sync = sync
+
+    def __getattr__(self, item):
+        return getattr(self._tape, item)
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def register_local_source(self, var):
+        self._sync.register_local_var(var)
+
+    register_local_var = register_local_source
+
+    def gradient(self, target, sources, output_gradients=None,
+                 unconnected_gradients=tf.UnconnectedGradients.NONE):
+        grads = self._tape.gradient(target, sources, output_gradients,
+                                    unconnected_gradients)
+        return self._sync.sync(grads, sources)
 
 
 class BroadcastGlobalVariablesHook:
@@ -138,6 +283,46 @@ class BroadcastGlobalVariablesHook:
         broadcast_variables(variables, self.root_rank)
 
 
+def PartialDistributedGradientTape(gradtape=None, device_dense="",
+                                   device_sparse="",
+                                   compression=Compression.none,
+                                   sparse_as_dense=False, op=Average,
+                                   gradient_predivide_factor=1.0,
+                                   num_groups=0, groups=None,
+                                   process_set=global_process_set,
+                                   local_layers=None,
+                                   scale_local_gradients=True,
+                                   **tape_kwargs):
+    """DistributedGradientTape that skips allreduce for the gradients
+    of ``local_layers`` (reference tensorflow/__init__.py:1189).  When
+    an existing ``gradtape`` is passed it is wrapped (its recording is
+    preserved); otherwise a fresh distributed tape is built."""
+    if local_layers is None:
+        local_layers = []
+    elif isinstance(local_layers, tf.keras.layers.Layer):
+        local_layers = [local_layers]
+    elif not all(isinstance(l, tf.keras.layers.Layer)
+                 for l in local_layers):
+        raise ValueError(
+            "All local layers must be of tf.keras.layers.Layer type.")
+    if gradtape is not None:
+        tape = _DistributedTapeWrapper(gradtape, _GradSync(
+            compression=compression, op=op,
+            gradient_predivide_factor=gradient_predivide_factor,
+            process_set=process_set,
+            scale_local_gradients=scale_local_gradients))
+    else:
+        tape = DistributedGradientTape(
+            compression=compression, sparse_as_dense=sparse_as_dense,
+            op=op, gradient_predivide_factor=gradient_predivide_factor,
+            num_groups=num_groups, groups=groups, process_set=process_set,
+            scale_local_gradients=scale_local_gradients, **tape_kwargs)
+    for layer in local_layers:
+        for var in layer.trainable_weights:
+            tape.register_local_source(var)
+    return tape
+
+
 def DistributedOptimizer(optimizer, name=None,
                          compression=Compression.none,
                          sparse_as_dense=False, op=Average,
@@ -145,33 +330,70 @@ def DistributedOptimizer(optimizer, name=None,
                          backward_passes_per_step=1,
                          average_aggregated_gradients=False,
                          num_groups=0, groups=None,
-                         process_set=global_process_set):
+                         process_set=global_process_set,
+                         scale_local_gradients=True):
     """Optimizer wrapper (reference
     ``horovod/tensorflow/__init__.py:889`` / ``keras/__init__.py:40``):
     gradients are averaged across ranks inside ``apply_gradients``.
+    ``backward_passes_per_step > 1`` accumulates that many
+    micro-batches locally before each allreduce (reference
+    gradient_aggregation_eager.py LocalGradientAggregationHelperEager).
     Works with keras-3 optimizers."""
     base_cls = optimizer.__class__
-    tape_args = dict(compression=compression, op=op,
-                     gradient_predivide_factor=gradient_predivide_factor,
-                     process_set=process_set)
+    bpps = int(backward_passes_per_step)
+    if bpps < 1:
+        raise ValueError("backward_passes_per_step must be >= 1")
 
     class _Distributed(base_cls):
         _hvd_wrapped = True
 
+        def register_local_var(self, var):
+            """Keep this variable's gradient local (no allreduce)."""
+            self._hvd_sync.register_local_var(var)
+
         def apply_gradients(self, grads_and_vars, *args, **kwargs):
             grads_and_vars = list(grads_and_vars)
             grads = [g for g, _ in grads_and_vars]
-            helper = DistributedGradientTape(**tape_args)
-            grads = helper._allreduce_grads(grads)
-            return super().apply_gradients(
-                [(g, v) for g, (_, v) in zip(grads, grads_and_vars)],
-                *args, **kwargs)
+            tvars = [v for _, v in grads_and_vars]
+            if bpps > 1:
+                # local aggregation: accumulate bpps micro-batches, then
+                # allreduce once (reference gradient_aggregation_eager.py)
+                if self._hvd_agg is None:
+                    self._hvd_agg = [
+                        tf.Variable(tf.zeros_like(g), trainable=False)
+                        if g is not None else None for g in grads]
+                for buf, g in zip(self._hvd_agg, grads):
+                    if buf is not None and g is not None:
+                        buf.assign_add(tf.convert_to_tensor(g))
+                self._hvd_counter += 1
+                if self._hvd_counter % bpps != 0:
+                    return None   # grads only accumulated this step
+                grads = [None if buf is None else
+                         (tf.convert_to_tensor(buf) / bpps
+                          if average_aggregated_gradients
+                          else tf.convert_to_tensor(buf))
+                         for buf in self._hvd_agg]
+            grads = self._hvd_sync.sync(grads, tvars)
+            result = super().apply_gradients(
+                list(zip(grads, tvars)), *args, **kwargs)
+            if bpps > 1:
+                for buf in self._hvd_agg:
+                    if buf is not None:
+                        buf.assign(tf.zeros_like(buf))
+            return result
 
     _Distributed.__name__ = f"Distributed{base_cls.__name__}"
     # swap the class in place so existing slot variables / iteration
     # counters / custom schedules survive (from_config would rebuild a
     # fresh optimizer and silently reset training state)
     optimizer.__class__ = _Distributed
+    optimizer._hvd_sync = _GradSync(
+        compression=compression, op=op,
+        gradient_predivide_factor=gradient_predivide_factor,
+        process_set=process_set,
+        scale_local_gradients=scale_local_gradients)
+    optimizer._hvd_agg = None
+    optimizer._hvd_counter = 0
     return optimizer
 
 

@@ -24,14 +24,19 @@ from ..common.process_sets import (  # noqa: F401
 from .mpi_ops import (  # noqa: F401
     allreduce, allreduce_async, allreduce_, allreduce_async_,
     grouped_allreduce, grouped_allreduce_async,
+    grouped_allreduce_, grouped_allreduce_async_,
     allgather, allgather_async, grouped_allgather,
     grouped_allgather_async,
     broadcast, broadcast_async, broadcast_, broadcast_async_,
     alltoall, alltoall_async,
     reducescatter, reducescatter_async,
     grouped_reducescatter, grouped_reducescatter_async,
+    sparse_allreduce_async,
     barrier, join, synchronize, poll,
     Average, Sum, Adasum, Min, Max, Product,
+    HorovodAllreduce, HorovodGroupedAllreduce, HorovodAllgather,
+    HorovodGroupedAllgather, HorovodBroadcast, HorovodAlltoall,
+    HorovodReducescatter, HorovodGroupedReducescatter,
 )
 from .compression import Compression  # noqa: F401
 from .functions import (  # noqa: F401

@@ -1,26 +1,371 @@
 """Torch collective ops.
 
 Reference surface: ``horovod/torch/mpi_ops.py:110-1293`` (sync +
-``*_async`` handle APIs + ``synchronize``/``poll``).  The reference
-needs a pybind11 C++ module (``torch/mpi_ops_v2.cc``) because CUDA
-tensors and autograd streams must be adapted natively; in this image
-torch is CPU-only, so ``.numpy()`` views are zero-copy and the core
-framework-agnostic API (ops/api.py) already does the staging — the
-single H2D copy happens per fused bucket inside the mesh executor.
+``*_async`` handle APIs + ``synchronize``/``poll`` + autograd
+Functions).  The reference needs a pybind11 C++ module
+(``torch/mpi_ops_v2.cc``) because CUDA tensors and autograd streams
+must be adapted natively; in this image torch is CPU-only, so
+``.numpy()`` views are zero-copy and the core framework-agnostic API
+(ops/api.py) already does the staging — the single H2D copy happens
+per fused bucket inside the mesh executor.
+
+The sync collectives here are thin wrappers around
+``torch.autograd.Function`` subclasses, so collectives used inside a
+model graph backpropagate (reference torch/mpi_ops.py:194-1130):
+
+* allreduce grad  = allreduce of the output grad (same op/scales)
+* allgather grad  = average-allreduce, then take this rank's row slice
+* broadcast grad  = average-allreduce, zeroed on non-root ranks
+* alltoall grad   = alltoall routed back with the received splits
+* reducescatter grad = allgather (un-scatter), /size for Average
+
+(The reference's reducescatter backward scales Sum by size instead
+— reference torch/mpi_ops.py:1082-1092 — which is size× the true
+adjoint of its own forward; here the backward is the exact adjoint:
+forward Average = Sum/size, so d(out)/d(in) carries the same 1/size.)
 """
 
-import torch  # noqa: F401 — presence check; kept for API parity
+import torch
 
+from ..common import basics
+from ..common.process_sets import global_process_set
 from ..ops import api as _api
 from ..ops.api import (  # noqa: F401
-    allreduce, allreduce_async, allreduce_, allreduce_async_,
-    grouped_allreduce, grouped_allreduce_async,
-    allgather, allgather_async, grouped_allgather,
-    grouped_allgather_async,
-    broadcast, broadcast_async, broadcast_, broadcast_async_,
-    alltoall, alltoall_async,
-    reducescatter, reducescatter_async,
-    grouped_reducescatter, grouped_reducescatter_async,
+    allreduce_async, allreduce_, allreduce_async_,
+    grouped_allreduce_async, grouped_allreduce_, grouped_allreduce_async_,
+    allgather_async, grouped_allgather_async,
+    broadcast_async, broadcast_, broadcast_async_,
+    alltoall_async,
+    reducescatter_async, grouped_reducescatter_async,
     barrier, join, synchronize, poll,
     Average, Sum, Adasum, Min, Max, Product,
 )
+from .compression import Compression
+
+
+def _differentiable(*tensors):
+    return torch.is_grad_enabled() and any(
+        isinstance(t, torch.Tensor) and t.requires_grad for t in tensors)
+
+
+def _ps_size(process_set):
+    return len(basics.engine().process_set_ranks(
+        process_set.process_set_id if process_set.process_set_id is not None
+        else 0))
+
+
+def _ps_rank_pos(process_set):
+    ranks = basics.engine().process_set_ranks(
+        process_set.process_set_id if process_set.process_set_id is not None
+        else 0)
+    return ranks.index(basics.rank())
+
+
+class HorovodAllreduce(torch.autograd.Function):
+    """Differentiable allreduce (reference torch/mpi_ops.py:194)."""
+
+    @staticmethod
+    def forward(ctx, tensor, average, name, op, prescale_factor,
+                postscale_factor, process_set):
+        ctx.average = average
+        ctx.op = op
+        ctx.prescale_factor = prescale_factor
+        ctx.postscale_factor = postscale_factor
+        ctx.process_set = process_set
+        h = _api.allreduce_async(tensor, average, name, op, prescale_factor,
+                                 postscale_factor, process_set)
+        return _api.synchronize(h)
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        return (allreduce(grad_output, average=ctx.average, op=ctx.op,
+                          prescale_factor=ctx.prescale_factor,
+                          postscale_factor=ctx.postscale_factor,
+                          process_set=ctx.process_set),
+                None, None, None, None, None, None)
+
+
+class HorovodGroupedAllreduce(torch.autograd.Function):
+    """Differentiable grouped allreduce (reference torch/mpi_ops.py:421)."""
+
+    @staticmethod
+    def forward(ctx, average, name, op, prescale_factor, postscale_factor,
+                process_set, *tensors):
+        ctx.average = average
+        ctx.op = op
+        ctx.prescale_factor = prescale_factor
+        ctx.postscale_factor = postscale_factor
+        ctx.process_set = process_set
+        h = _api.grouped_allreduce_async(
+            list(tensors), average, name, op, prescale_factor,
+            postscale_factor, process_set)
+        return tuple(_api.synchronize(h))
+
+    @staticmethod
+    def backward(ctx, *grad_outputs):
+        grads = grouped_allreduce(list(grad_outputs), average=ctx.average,
+                                  op=ctx.op,
+                                  prescale_factor=ctx.prescale_factor,
+                                  postscale_factor=ctx.postscale_factor,
+                                  process_set=ctx.process_set)
+        return (None, None, None, None, None, None, *grads)
+
+
+class HorovodAllgather(torch.autograd.Function):
+    """Differentiable allgather (reference torch/mpi_ops.py:630)."""
+
+    @staticmethod
+    def forward(ctx, tensor, name, process_set):
+        ctx.dim0 = tensor.shape[0] if tensor.dim() else 1
+        ctx.process_set = process_set
+        return _api.synchronize(
+            _api.allgather_async(tensor, name, process_set))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        grad_reduced = allreduce(grad_output, average=True,
+                                 process_set=ctx.process_set)
+        dims = allgather(torch.tensor([ctx.dim0]),
+                         process_set=ctx.process_set)
+        pos = _ps_rank_pos(ctx.process_set)
+        offset = int(dims[:pos].sum()) if pos else 0
+        return grad_reduced.narrow(0, offset, ctx.dim0), None, None
+
+
+class HorovodGroupedAllgather(torch.autograd.Function):
+    """Differentiable grouped allgather."""
+
+    @staticmethod
+    def forward(ctx, name, process_set, *tensors):
+        ctx.dim0s = [t.shape[0] if t.dim() else 1 for t in tensors]
+        ctx.process_set = process_set
+        return tuple(_api.synchronize(
+            _api.grouped_allgather_async(list(tensors), name, process_set)))
+
+    @staticmethod
+    def backward(ctx, *grad_outputs):
+        grads_reduced = grouped_allreduce(list(grad_outputs), average=True,
+                                          process_set=ctx.process_set)
+        dims = allgather(torch.tensor(ctx.dim0s).view(1, -1),
+                         process_set=ctx.process_set)
+        pos = _ps_rank_pos(ctx.process_set)
+        grads = []
+        for i, g in enumerate(grads_reduced):
+            offset = int(dims[:pos, i].sum()) if pos else 0
+            grads.append(g.narrow(0, offset, ctx.dim0s[i]))
+        return (None, None, *grads)
+
+
+class HorovodBroadcast(torch.autograd.Function):
+    """Differentiable broadcast (reference torch/mpi_ops.py:813)."""
+
+    @staticmethod
+    def forward(ctx, tensor, root_rank, name, process_set):
+        ctx.root_rank = root_rank
+        ctx.process_set = process_set
+        return _api.synchronize(
+            _api.broadcast_async(tensor, root_rank, name, process_set))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        grad_reduced = allreduce(grad_output, average=True,
+                                 process_set=ctx.process_set)
+        if basics.rank() != ctx.root_rank:
+            grad_reduced = grad_reduced * 0
+        return grad_reduced, None, None, None
+
+
+class HorovodAlltoall(torch.autograd.Function):
+    """Differentiable alltoall (reference torch/mpi_ops.py:960)."""
+
+    @staticmethod
+    def forward(ctx, tensor, splits, name, process_set):
+        out, recv_splits = _api.synchronize(
+            _api.alltoall_async(tensor, splits, name, process_set))
+        ctx.process_set = process_set
+        ctx.recv_splits = recv_splits
+        if splits is None:
+            return out
+        rs = torch.as_tensor(recv_splits)
+        ctx.mark_non_differentiable(rs)
+        return out, rs
+
+    @staticmethod
+    def backward(ctx, grad_output, *dead_gradients):
+        grad_wrt_tensor, _ = alltoall(grad_output, splits=ctx.recv_splits,
+                                      process_set=ctx.process_set)
+        return grad_wrt_tensor, None, None, None
+
+
+class HorovodReducescatter(torch.autograd.Function):
+    """Differentiable reducescatter (reference torch/mpi_ops.py:1070)."""
+
+    @staticmethod
+    def forward(ctx, tensor, name, op, process_set, prescale_factor,
+                postscale_factor):
+        ctx.op = op
+        ctx.process_set = process_set
+        ctx.prescale_factor = prescale_factor
+        ctx.postscale_factor = postscale_factor
+        return _api.synchronize(_api.reducescatter_async(
+            tensor, op, name, prescale_factor, postscale_factor,
+            process_set))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        # exact adjoint: forward = postscale * reduce(prescale * x),
+        # Average folds an extra 1/size into the reduction
+        if ctx.op == Average:
+            grad_output = grad_output / _ps_size(ctx.process_set)
+        if ctx.prescale_factor != 1.0:
+            grad_output = grad_output * ctx.prescale_factor
+        if ctx.postscale_factor != 1.0:
+            grad_output = grad_output * ctx.postscale_factor
+        return (allgather(grad_output, process_set=ctx.process_set),
+                None, None, None, None, None)
+
+
+class HorovodGroupedReducescatter(torch.autograd.Function):
+    """Differentiable grouped reducescatter."""
+
+    @staticmethod
+    def forward(ctx, name, op, process_set, *tensors):
+        ctx.op = op
+        ctx.process_set = process_set
+        return tuple(_api.grouped_reducescatter(
+            list(tensors), op, name, process_set=process_set))
+
+    @staticmethod
+    def backward(ctx, *grad_outputs):
+        inv = 1.0 / _ps_size(ctx.process_set) if ctx.op == Average else 1
+        grads = [allgather(g * inv if inv != 1 else g,
+                           process_set=ctx.process_set)
+                 for g in grad_outputs]
+        return (None, None, None, *grads)
+
+
+# ----------------------------------------------------------------------------
+# sync wrappers: differentiable for torch tensors with grad, otherwise
+# delegate straight to the framework-neutral api.
+
+def allreduce(tensor, average=None, name=None, compression=Compression.none,
+              op=None, prescale_factor=1.0, postscale_factor=1.0,
+              process_set=global_process_set):
+    """Allreduce; differentiable, with optional wire compression
+    (reference torch/mpi_ops.py:215)."""
+    compressed, cctx = compression.compress(tensor) \
+        if isinstance(tensor, torch.Tensor) else (tensor, None)
+    if _differentiable(compressed):
+        out = HorovodAllreduce.apply(compressed, average, name, op,
+                                     prescale_factor, postscale_factor,
+                                     process_set)
+    else:
+        out = _api.allreduce(compressed, average, name, op, prescale_factor,
+                             postscale_factor, process_set)
+    return compression.decompress(out, cctx) if cctx is not None else out
+
+
+def grouped_allreduce(tensors, average=None, name=None,
+                      compression=Compression.none, op=None,
+                      prescale_factor=1.0, postscale_factor=1.0,
+                      process_set=global_process_set):
+    compressed, cctxs = [], []
+    for t in tensors:
+        c, cc = compression.compress(t) if isinstance(t, torch.Tensor) \
+            else (t, None)
+        compressed.append(c)
+        cctxs.append(cc)
+    if _differentiable(*compressed):
+        outs = list(HorovodGroupedAllreduce.apply(
+            average, name, op, prescale_factor, postscale_factor,
+            process_set, *compressed))
+    else:
+        outs = _api.grouped_allreduce(compressed, average, name, op,
+                                      prescale_factor, postscale_factor,
+                                      process_set)
+    return [compression.decompress(o, cc) if cc is not None else o
+            for o, cc in zip(outs, cctxs)]
+
+
+def allgather(tensor, name=None, process_set=global_process_set):
+    if _differentiable(tensor):
+        return HorovodAllgather.apply(tensor, name, process_set)
+    return _api.allgather(tensor, name, process_set)
+
+
+def grouped_allgather(tensors, name=None, process_set=global_process_set):
+    if _differentiable(*tensors):
+        return list(HorovodGroupedAllgather.apply(name, process_set,
+                                                  *tensors))
+    return _api.grouped_allgather(tensors, name, process_set)
+
+
+def broadcast(tensor, root_rank, name=None, process_set=global_process_set):
+    if _differentiable(tensor):
+        return HorovodBroadcast.apply(tensor, root_rank, name, process_set)
+    return _api.broadcast(tensor, root_rank, name, process_set)
+
+
+def alltoall(tensor, splits=None, name=None,
+             process_set=global_process_set):
+    """Reference return contract (torch/mpi_ops.py:984-1013): a bare
+    tensor when ``splits`` is None, ``(tensor, recv_splits)`` when
+    splits are given — identical on the grad and no-grad paths."""
+    if _differentiable(tensor):
+        return HorovodAlltoall.apply(tensor, splits, name, process_set)
+    out, recv_splits = _api.alltoall(tensor, splits, name, process_set)
+    if splits is None:
+        return out
+    return out, torch.as_tensor(recv_splits)
+
+
+def reducescatter(tensor, name=None, compression=Compression.none,
+                  op=Average, process_set=global_process_set,
+                  prescale_factor=1.0, postscale_factor=1.0):
+    compressed, cctx = compression.compress(tensor) \
+        if isinstance(tensor, torch.Tensor) else (tensor, None)
+    if _differentiable(compressed):
+        out = HorovodReducescatter.apply(compressed, name, op, process_set,
+                                         prescale_factor, postscale_factor)
+    else:
+        out = _api.reducescatter(compressed, op, name, prescale_factor,
+                                 postscale_factor, process_set)
+    return compression.decompress(out, cctx) if cctx is not None else out
+
+
+def grouped_reducescatter(tensors, name=None, op=Average,
+                          process_set=global_process_set):
+    if _differentiable(*tensors):
+        return list(HorovodGroupedReducescatter.apply(name, op, process_set,
+                                                      *tensors))
+    return _api.grouped_reducescatter(tensors, op, name, process_set)
+
+
+def sparse_allreduce_async(tensor, name, op,
+                           process_set=global_process_set):
+    """Average/sum a ``torch.sparse_coo_tensor`` by allgathering its
+    indices and values (reference torch/mpi_ops.py:567 — allgather
+    concatenates along dim 0, so indices travel transposed).  Returns a
+    zero-arg callable that completes the op and rebuilds the sparse
+    tensor."""
+    t = tensor.coalesce() if not tensor.is_coalesced() else tensor
+    indices_h = _api.allgather_async(
+        t._indices().transpose(0, 1).contiguous(),
+        name=f"{name}.indices", process_set=process_set)
+    values_h = _api.allgather_async(t._values(), name=f"{name}.values",
+                                    process_set=process_set)
+
+    def handle():
+        values = _api.synchronize(values_h)
+        indices = _api.synchronize(indices_h)
+        if op == Average:
+            values = values / _ps_size(process_set)
+        if indices.numel() == 0 or values.numel() == 0:
+            return torch.sparse_coo_tensor(
+                torch.zeros((t.sparse_dim(), 0), dtype=torch.long),
+                torch.zeros((0, *t.shape[t.sparse_dim():]),
+                            dtype=t.dtype), t.size())
+        return torch.sparse_coo_tensor(indices.transpose(0, 1), values,
+                                       t.size())
+
+    return handle

@@ -138,6 +138,11 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         self._allreduce_delay[p] -= 1
         if self._allreduce_delay[p] == 0:
             gi = self._group_of.get(id(p))
+            if gi is not None and p.grad.is_sparse and \
+                    not self.sparse_as_dense:
+                # sparse grads can't join a dense fused group — route
+                # through the allgather-based sparse path individually
+                gi = None
             if gi is None:
                 handle, ctx = self._allreduce_grad_async(p)
                 self._handles[p] = (handle, ctx)
@@ -153,11 +158,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 
     def _prepare_grad(self, p):
         grad = p.grad
-        if grad.is_sparse:
-            if not self.sparse_as_dense:
-                raise ValueError(
-                    "sparse gradients require sparse_as_dense=True "
-                    "(TPU collectives are dense)")
+        if grad.is_sparse and self.sparse_as_dense:
             grad = grad.to_dense()
         return grad
 
@@ -166,6 +167,14 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             raise ValueError("horovod_tpu torch binding requires CPU "
                              "tensors (torch is the host-side frontend)")
         grad = self._prepare_grad(p)
+        if grad.is_sparse:
+            # true-sparse path: allgather of indices/values (reference
+            # optimizer.py:194-198 → mpi_ops.py sparse_allreduce_async)
+            from .mpi_ops import sparse_allreduce_async
+            handle = sparse_allreduce_async(
+                grad, name=self._name(p), op=self.op,
+                process_set=self.process_set)
+            return handle, ("sparse",)
         tensor_compressed, ctx = self._compression.compress(grad)
         if self.op == Average:
             prescale = 1.0 / self.gradient_predivide_factor \
@@ -214,6 +223,12 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         completed = set()
         group_results = {}
         for p, (handle, ctx) in list(self._handles.items()):
+            if isinstance(ctx, tuple) and ctx and ctx[0] == "sparse":
+                with torch.no_grad():
+                    p.grad = handle()   # callable completes the op
+                self._allreduce_delay[p] = self.backward_passes_per_step
+                completed.add(p)
+                continue
             if isinstance(ctx, tuple) and ctx and ctx[0] == "group":
                 _, gi, comp_ctx = ctx
                 if gi not in group_results:

@@ -150,3 +150,122 @@ def test_tf_elastic_state(hvd_shutdown):
         return True
 
     assert all(run_ranks(fn))
+
+
+def test_tf_scalar_query_ops(hvd_shutdown):
+    def fn():
+        assert int(hvd.size_op()) == NP
+        assert int(hvd.rank_op()) == hvd.rank()
+        assert int(hvd.local_rank_op()) == hvd.local_rank()
+        assert int(hvd.local_size_op()) == NP
+        assert int(hvd.process_set_included_op(0)) == 1
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_tf_broadcast_object_fn(hvd_shutdown):
+    def fn():
+        bcast = hvd.broadcast_object_fn(root_rank=0)
+        obj = {"epoch": 7} if hvd.rank() == 0 else None
+        out = bcast(obj)
+        assert out == {"epoch": 7}
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_tf_optimizer_backward_passes_per_step(hvd_shutdown):
+    def fn():
+        r = hvd.rank()
+        v = tf.Variable([0.0, 0.0])
+        opt = tf.keras.optimizers.SGD(learning_rate=1.0)
+        opt = hvd.DistributedOptimizer(opt, backward_passes_per_step=2)
+        # two micro-batches with per-rank grads (r+1) and 2(r+1)
+        g1 = tf.constant([float(r + 1), 0.0])
+        g2 = tf.constant([2.0 * (r + 1), 0.0])
+        assert opt.apply_gradients([(g1, v)]) is None   # accumulated only
+        assert np.allclose(v.numpy(), 0.0)              # no update yet
+        opt.apply_gradients([(g2, v)])
+        # sum of micro-batches = 3(r+1); averaged over ranks = 3*mean(r+1)
+        expected = -3.0 * np.mean([i + 1 for i in range(NP)])
+        assert np.allclose(v.numpy(), [expected, 0.0]), v.numpy()
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_tf_partial_distributed_gradient_tape(hvd_shutdown):
+    def fn():
+        r = hvd.rank()
+        local_layer = tf.keras.layers.Dense(
+            1, use_bias=False, kernel_initializer="ones")
+        local_layer.build((None, 2))
+        w_global = tf.Variable([[2.0], [2.0]])
+        x = tf.constant([[float(r + 1), float(r + 1)]])
+        tape = hvd.PartialDistributedGradientTape(
+            local_layers=local_layer, scale_local_gradients=False)
+        with tape:
+            y = tf.reduce_sum(local_layer(x)) + \
+                tf.reduce_sum(tf.matmul(x, w_global))
+        grads = tape.gradient(y, [local_layer.kernel, w_global])
+        # local layer grad stays per-rank (= x), global grad is averaged
+        assert np.allclose(grads[0].numpy().ravel(),
+                           [float(r + 1)] * 2)
+        mean = np.mean([i + 1 for i in range(NP)])
+        assert np.allclose(grads[1].numpy().ravel(), [mean, mean])
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_keras_partial_distributed_optimizer(hvd_shutdown):
+    import horovod_tpu.keras as hvdk
+
+    def fn():
+        r = hvd.rank()
+        local_layer = tf.keras.layers.Dense(
+            1, use_bias=False, kernel_initializer="zeros")
+        local_layer.build((None, 2))
+        v = tf.Variable([1.0])
+        opt = tf.keras.optimizers.SGD(learning_rate=1.0)
+        opt = hvdk.PartialDistributedOptimizer(
+            opt, local_layers=[local_layer], scale_local_gradients=False)
+        g_local = tf.constant([[float(r + 1)], [0.0]])
+        g_sync = tf.constant([float(r + 1)])
+        opt.apply_gradients([(g_local, local_layer.kernel), (g_sync, v)])
+        # local grad applied unreduced; synced grad averaged
+        assert np.allclose(local_layer.kernel.numpy().ravel(),
+                           [-(r + 1.0), 0.0])
+        mean = np.mean([i + 1 for i in range(NP)])
+        assert np.allclose(v.numpy(), [1.0 - mean])
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_keras_best_model_checkpoint(tmp_path):
+    import horovod_tpu.keras as hvdk
+    with pytest.raises(ValueError):
+        hvdk.callbacks.BestModelCheckpoint()
+    cb = hvdk.callbacks.BestModelCheckpoint(
+        filepath=str(tmp_path / "best.keras"))
+    assert cb.save_best_only
+
+
+def test_tf_partial_tape_wraps_existing_tape(hvd_shutdown):
+    """Passing a recorded tf.GradientTape must preserve its recording
+    (reference wraps the user tape rather than discarding it)."""
+    def fn():
+        r = hvd.rank()
+        w = tf.Variable([[2.0], [2.0]])
+        x = tf.constant([[float(r + 1), float(r + 1)]])
+        with tf.GradientTape() as inner:
+            y = tf.reduce_sum(tf.matmul(x, w))
+        tape = hvd.PartialDistributedGradientTape(inner)
+        grads = tape.gradient(y, [w])
+        mean = np.mean([i + 1 for i in range(NP)])
+        assert np.allclose(grads[0].numpy().ravel(), [mean, mean])
+        return True
+
+    assert all(run_ranks(fn))

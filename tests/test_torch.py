@@ -312,3 +312,209 @@ def test_elastic_sampler(hvd_shutdown):
     for idx in per_rank:
         covered.update(idx)
     assert covered == set(range(20))
+
+
+# ---------------------------------------------------------------------------
+# autograd-differentiable collectives (reference torch/mpi_ops.py:194-1130)
+
+def test_torch_allreduce_grad(hvd_shutdown):
+    def fn():
+        t = (torch.ones(4) * (hvd.rank() + 1)).requires_grad_()
+        out = hvd.allreduce(t, op=hvd.Average)
+        out.backward(torch.ones(4) * 2.0)
+        # d(avg allreduce)/dt backpropagated through a second average
+        # allreduce of an identical grad on every rank -> unchanged
+        assert torch.allclose(t.grad, torch.ones(4) * 2.0)
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_torch_allgather_grad(hvd_shutdown):
+    def fn():
+        r = hvd.rank()
+        t = (torch.ones(2, 3) * (r + 1)).requires_grad_()
+        out = hvd.allgather(t)
+        assert out.shape == (2 * NP, 3)
+        g = torch.arange(float(2 * NP * 3)).view(2 * NP, 3)
+        out.backward(g)
+        # backward: average-allreduce (identical grads -> g itself),
+        # then this rank's row slice
+        assert torch.allclose(t.grad, g[2 * r:2 * r + 2])
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_torch_broadcast_grad(hvd_shutdown):
+    def fn():
+        r = hvd.rank()
+        t = (torch.ones(3) * (r + 1)).requires_grad_()
+        out = hvd.broadcast(t, root_rank=1)
+        assert torch.allclose(out.detach(), torch.ones(3) * 2)
+        out.sum().backward()
+        if r == 1:
+            assert torch.allclose(t.grad, torch.ones(3))
+        else:
+            assert torch.allclose(t.grad, torch.zeros(3))
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_torch_reducescatter_grad(hvd_shutdown):
+    def fn():
+        t = (torch.ones(NP, 2) * (hvd.rank() + 1)).requires_grad_()
+        out = hvd.reducescatter(t, op=hvd.Average)
+        assert out.shape == (1, 2)
+        out.sum().backward()
+        # exact adjoint: forward averages (Sum/NP), so each input
+        # element's grad is 1/NP; backward allgathers that
+        assert torch.allclose(t.grad, torch.ones(NP, 2) / NP)
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_torch_reducescatter_grad_matches_autograd_sum(hvd_shutdown):
+    """gradcheck-style: Sum reducescatter's VJP must equal the dense
+    equivalent computed by torch autograd on a single rank."""
+    def fn():
+        t = torch.arange(float(NP * 2)).view(NP, 2).requires_grad_()
+        out = hvd.reducescatter(t, op=hvd.Sum)
+        g = torch.tensor([[2.0, 3.0]])
+        out.backward(g)
+        # each rank's slice r of input feeds output slice r on rank r
+        # with coefficient 1 -> grad = allgather of per-slice grads
+        expected = g.repeat(NP, 1)
+        assert torch.allclose(t.grad, expected), t.grad
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_torch_alltoall_return_contract(hvd_shutdown):
+    """splits=None -> bare tensor; explicit splits -> (tensor, recv);
+    identical with and without grad (reference torch/mpi_ops.py:984)."""
+    def fn():
+        t = torch.ones(NP, 2)
+        out = hvd.alltoall(t)
+        assert isinstance(out, torch.Tensor)
+        out2, recv = hvd.alltoall(t, splits=[1] * NP)
+        assert isinstance(out2, torch.Tensor)
+        assert recv.tolist() == [1] * NP
+        tg = t.clone().requires_grad_()
+        outg = hvd.alltoall(tg)
+        assert isinstance(outg, torch.Tensor)
+        outg2, recvg = hvd.alltoall(tg, splits=[1] * NP)
+        assert recvg.tolist() == [1] * NP
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_torch_alltoall_grad(hvd_shutdown):
+    def fn():
+        t = (torch.ones(NP, 2) * (hvd.rank() + 1)).requires_grad_()
+        out = hvd.alltoall(t)
+        assert out.shape == (NP, 2)
+        expected = torch.stack([torch.full((2,), float(i + 1))
+                                for i in range(NP)])
+        assert torch.allclose(out.detach(), expected)
+        out.sum().backward()
+        assert torch.allclose(t.grad, torch.ones(NP, 2))
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_torch_grouped_allreduce_grad(hvd_shutdown):
+    def fn():
+        ts = [(torch.ones(3) * (hvd.rank() + 1)).requires_grad_()
+              for _ in range(2)]
+        outs = hvd.grouped_allreduce(ts, op=hvd.Average)
+        (outs[0].sum() + 2 * outs[1].sum()).backward()
+        assert torch.allclose(ts[0].grad, torch.ones(3))
+        assert torch.allclose(ts[1].grad, torch.ones(3) * 2)
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_torch_grouped_allreduce_inplace(hvd_shutdown):
+    def fn():
+        ts = [torch.ones(4) * (hvd.rank() + 1), torch.ones(2)]
+        hvd.grouped_allreduce_(ts, op=hvd.Sum)
+        assert torch.allclose(ts[0],
+                              torch.full((4,), float(sum(range(1, NP + 1)))))
+        assert torch.allclose(ts[1], torch.full((2,), float(NP)))
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_torch_sparse_allreduce(hvd_shutdown):
+    def fn():
+        r = hvd.rank()
+        # each rank contributes one row of a 4x3 embedding grad
+        idx = torch.tensor([[r]])
+        vals = torch.ones(1, 3) * (r + 1)
+        sp = torch.sparse_coo_tensor(idx, vals, (NP, 3))
+        handle = hvd.sparse_allreduce_async(sp, name="sp", op=hvd.Average)
+        out = handle()
+        dense = out.to_dense()
+        expected = torch.diag(torch.arange(1.0, NP + 1) / NP) @ \
+            torch.ones(NP, 3)
+        assert torch.allclose(dense, expected)
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_torch_optimizer_sparse_grads(hvd_shutdown):
+    def fn():
+        r = hvd.rank()
+        emb = torch.nn.Embedding(8, 4, sparse=True)
+        with torch.no_grad():
+            emb.weight.fill_(1.0)
+        opt = torch.optim.SGD(emb.parameters(), lr=1.0)
+        opt = hvd.DistributedOptimizer(
+            opt, named_parameters=emb.named_parameters())
+        out = emb(torch.tensor([r % 8]))
+        (out.sum() * (r + 1)).backward()
+        opt.step()
+        # row r got grad (r+1) on rank r only -> averaged to (r+1)/NP
+        w = emb.weight.detach()
+        for row in range(NP):
+            expected = 1.0 - (row + 1) / NP
+            assert torch.allclose(w[row], torch.full((4,), expected)), \
+                (row, w[row])
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_torch_optimizer_sparse_in_group_routes_individually(hvd_shutdown):
+    """A sparse-grad param inside a grouped optimizer must take the
+    allgather-based sparse path instead of crashing the dense group."""
+    def fn():
+        r = hvd.rank()
+        emb = torch.nn.Embedding(4, 2, sparse=True)
+        lin = torch.nn.Linear(2, 2, bias=False)
+        with torch.no_grad():
+            emb.weight.fill_(0.0)
+        params = list(emb.parameters()) + list(lin.parameters())
+        opt = torch.optim.SGD(params, lr=1.0)
+        opt = hvd.DistributedOptimizer(
+            opt,
+            named_parameters=list(emb.named_parameters()) +
+            list(lin.named_parameters()),
+            groups=[params])
+        out = lin(emb(torch.tensor([r % 4])))
+        out.sum().backward()
+        opt.step()
+        assert not torch.isnan(emb.weight.to_dense() if
+                               emb.weight.is_sparse else emb.weight).any()
+        return True
+
+    assert all(run_ranks(fn))

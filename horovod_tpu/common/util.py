@@ -35,6 +35,9 @@ def to_numpy(tensor):
     elif mod.startswith("tensorflow"):
         kind = "tf"
         arr = tensor.numpy() if hasattr(tensor, "numpy") else np.asarray(tensor)
+    elif mod.startswith("mxnet"):
+        kind = "mxnet"
+        arr = tensor.asnumpy()
     elif isinstance(tensor, np.ndarray):
         arr = tensor
     elif isinstance(tensor, (int, float, bool, complex)):
@@ -62,6 +65,9 @@ def from_numpy(arr, kind):
     if kind == "tf":
         import tensorflow as tf
         return tf.convert_to_tensor(arr)
+    if kind == "mxnet":
+        import mxnet as mx
+        return mx.nd.array(arr, dtype=arr.dtype)
     if kind == "scalar":
         return arr.item() if arr.ndim == 0 else arr
     return arr
@@ -75,6 +81,9 @@ def copy_into(target, arr):
         with torch.no_grad():
             src = from_numpy(arr, "torch")   # handles bf16 bit views
             target.copy_(src.view_as(target))
+        return target
+    if mod.startswith("mxnet"):
+        target[:] = arr.reshape(target.shape)
         return target
     np.copyto(target, arr.reshape(target.shape))
     return target

@@ -25,18 +25,11 @@ def PartialDistributedOptimizer(optimizer, name=None,
                                 scale_local_gradients=True):
     """DistributedOptimizer whose ``local_layers`` keep their gradients
     local — no allreduce (reference keras/__init__.py:116)."""
-    import tensorflow as tf
     from ..common.process_sets import global_process_set
     from ..tensorflow import DistributedOptimizer as _wrap
+    from ..tensorflow import _normalize_local_layers
 
-    if local_layers is None:
-        local_layers = []
-    elif isinstance(local_layers, tf.keras.layers.Layer):
-        local_layers = [local_layers]
-    elif not all(isinstance(l, tf.keras.layers.Layer)
-                 for l in local_layers):
-        raise ValueError(
-            "All local layers must be of tf.keras.layers.Layer type.")
+    local_layers = _normalize_local_layers(local_layers)
     opt = _wrap(optimizer, name=name, compression=compression,
                 sparse_as_dense=sparse_as_dense, op=op, groups=groups,
                 gradient_predivide_factor=gradient_predivide_factor,
@@ -50,9 +43,19 @@ def PartialDistributedOptimizer(optimizer, name=None,
 
 def broadcast_global_variables(root_rank):
     """Broadcast all TF global variables from root (reference
-    keras/__init__.py:183; TF2 keeps the v1 collection under compat)."""
+    keras/__init__.py:183).  Only graph-mode (tf.compat.v1) variables
+    live in the global collection; eagerly-created keras variables do
+    not, and silently broadcasting nothing would let ranks train from
+    different initializations — so an empty collection is an error."""
     import tensorflow as tf
-    return broadcast_variables(tf.compat.v1.global_variables(), root_rank)
+    variables = tf.compat.v1.global_variables()
+    if not variables:
+        raise RuntimeError(
+            "broadcast_global_variables found no graph-collection "
+            "variables (TF2 eager variables are not registered there); "
+            "use hvd.broadcast_variables(model.weights, root_rank) or "
+            "the BroadcastGlobalVariablesCallback instead")
+    return broadcast_variables(variables, root_rank)
 
 
 def load_model(filepath, custom_optimizers=None, custom_objects=None,

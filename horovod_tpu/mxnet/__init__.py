@@ -1,36 +1,53 @@
-"""MXNet frontend (reference ``horovod/mxnet/__init__.py``:
-DistributedOptimizer :44, gluon DistributedTrainer :124,
-broadcast_parameters :245).
+"""MXNet frontend — ``import horovod_tpu.mxnet as hvd`` (reference
+``horovod/mxnet/__init__.py``: DistributedOptimizer :44, gluon
+DistributedTrainer :124, broadcast_parameters :245).
 
-Gated: mxnet (EOL upstream) is not part of this image.  The surface is
-declared so ported scripts fail with a clear message instead of an
-AttributeError; the collective core they would bind to is the same
-framework-agnostic ops/api used by the torch/TF frontends.
+The collective surface (allreduce/allgather/broadcast/alltoall/
+reducescatter + topology queries) is framework-neutral and works
+without mxnet installed; the three mxnet-dependent entry points
+(DistributedOptimizer, DistributedTrainer, broadcast_parameters) are
+resolved lazily and raise a clear ImportError when mxnet (EOL
+upstream) is absent from the image.
 """
 
+from ..common.basics import (  # noqa: F401
+    init, shutdown, is_initialized,
+    rank, size, local_rank, local_size, cross_rank, cross_size,
+    is_homogeneous, mpi_threads_supported, mpi_built, gloo_built,
+    nccl_built, ddl_built, ccl_built, cuda_built, rocm_built,
+    xla_built, tpu_built, start_timeline, stop_timeline,
+)
+from ..common.exceptions import (  # noqa: F401
+    HorovodInternalError, HostsUpdatedInterrupt,
+)
+from ..common.process_sets import (  # noqa: F401
+    ProcessSet, add_process_set, remove_process_set, global_process_set,
+)
+from .compression import Compression  # noqa: F401
+from .mpi_ops import (  # noqa: F401
+    allreduce, allreduce_, grouped_allreduce, grouped_allreduce_,
+    allgather, grouped_allgather,
+    broadcast, broadcast_,
+    alltoall,
+    reducescatter, grouped_reducescatter,
+    barrier, join, synchronize, poll,
+    broadcast_object, allgather_object,
+    Average, Sum, Adasum, Min, Max, Product,
+)
 
-def _require_mxnet():
-    try:
-        import mxnet  # noqa: F401
-    except ImportError as exc:
-        raise ImportError(
-            "horovod_tpu.mxnet requires mxnet, which is not installed "
-            "in this environment (mxnet is EOL; prefer the torch or "
-            "tensorflow frontends)") from exc
+_MXNET_NAMES = ("DistributedOptimizer", "DistributedTrainer",
+                "broadcast_parameters")
 
 
-def init(*args, **kwargs):
-    from ..common.basics import init as _init
-    return _init(*args, **kwargs)
-
-
-def DistributedOptimizer(optimizer, *args, **kwargs):
-    _require_mxnet()
-
-
-def DistributedTrainer(params, optimizer, *args, **kwargs):
-    _require_mxnet()
-
-
-def broadcast_parameters(params, root_rank=0):
-    _require_mxnet()
+def __getattr__(name):
+    if name in _MXNET_NAMES:
+        try:
+            from . import _impl
+        except ImportError as exc:
+            raise ImportError(
+                f"horovod_tpu.mxnet.{name} requires mxnet, which is not "
+                "installed in this environment (mxnet is EOL; prefer the "
+                "torch or tensorflow frontends)") from exc
+        return getattr(_impl, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

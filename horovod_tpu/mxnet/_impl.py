@@ -1,0 +1,187 @@
+"""MXNet-dependent pieces of the binding (reference
+``horovod/mxnet/__init__.py:44-290``).  Imported lazily from
+``horovod_tpu.mxnet`` so the rest of the surface works without mxnet
+installed (mxnet is EOL and absent from most modern images)."""
+
+import types
+import warnings
+from collections import OrderedDict
+
+import mxnet as mx
+
+from ..common import basics
+from ..common.process_sets import global_process_set
+from .compression import Compression
+from .mpi_ops import allreduce_, broadcast_, grouped_allreduce_
+
+
+def _split_list(xs, n_groups):
+    n = max(1, (len(xs) + n_groups - 1) // n_groups)
+    return [xs[i:i + n] for i in range(0, len(xs), n)]
+
+
+class DistributedOptimizer(mx.optimizer.Optimizer):
+    """Wraps an mx.optimizer.Optimizer: allreduces gradients before
+    every update (reference mxnet/__init__.py:44-116)."""
+
+    def __init__(self, optimizer, gradient_predivide_factor=1.0,
+                 num_groups=0, process_set=global_process_set):
+        self._optimizer = optimizer
+        self._gradient_predivide_factor = gradient_predivide_factor
+        self._num_groups = num_groups
+        self._process_set = process_set
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def create_state(self, index, weight):
+        return self._optimizer.create_state(index, weight)
+
+    def create_state_multi_precision(self, index, weight):
+        return self._optimizer.create_state_multi_precision(index, weight)
+
+    def _do_allreduce(self, index, grad):
+        if basics.size() == 1:
+            return
+        pre = 1.0 / self._gradient_predivide_factor
+        post = self._gradient_predivide_factor
+        if isinstance(index, (tuple, list)):
+            if self._num_groups > 0:
+                for i, (grads, indices) in enumerate(zip(
+                        _split_list(grad, self._num_groups),
+                        _split_list(index, self._num_groups))):
+                    grouped_allreduce_(
+                        tensors=grads, average=True,
+                        name=f"{indices[0]}:{indices[-1]}", priority=-i,
+                        prescale_factor=pre, postscale_factor=post,
+                        process_set=self._process_set)
+            else:
+                for i in range(len(index)):
+                    allreduce_(grad[i], average=True,
+                               name=str(index[i]), priority=-i,
+                               prescale_factor=pre, postscale_factor=post,
+                               process_set=self._process_set)
+        else:
+            allreduce_(grad, average=True, name=str(index),
+                       prescale_factor=pre, postscale_factor=post,
+                       process_set=self._process_set)
+
+    def update(self, index, weight, grad, state):
+        self._do_allreduce(index, grad)
+        self._optimizer.update(index, weight, grad, state)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self._do_allreduce(index, grad)
+        self._optimizer.update_multi_precision(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def set_lr_mult(self, args_lr_mult):
+        self._optimizer.set_lr_mult(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self._optimizer.set_wd_mult(args_wd_mult)
+
+
+class DistributedTrainer(mx.gluon.Trainer):
+    """gluon Trainer whose ``_allreduce_grads`` averages over ranks
+    via the TPU collective engine instead of kvstore push/pull
+    (reference mxnet/__init__.py:124-234)."""
+
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 compression=Compression.none,
+                 gradient_predivide_factor=1.0, prefix=None,
+                 num_groups=0, process_set=global_process_set):
+        self._compression = compression
+        self._process_set = process_set
+        if isinstance(optimizer, DistributedOptimizer):
+            optimizer = optimizer._optimizer
+            warnings.warn("DistributedTrainer does not take "
+                          "DistributedOptimizer as its optimizer. "
+                          "We have unwrapped it for you.")
+        # deterministic parameter ordering across ranks: dict keys are
+        # sorted; Parameter objects order by name (gluon Parameters
+        # define no __lt__)
+        if isinstance(params, dict):
+            params = OrderedDict(sorted(params.items()))
+        elif isinstance(params, (list, tuple)):
+            params = sorted(params,
+                            key=lambda p: getattr(p, "name", str(p)))
+        super().__init__(params, optimizer,
+                         optimizer_params=optimizer_params, kvstore=None)
+        self._gradient_predivide_factor = gradient_predivide_factor
+        assert prefix is None or isinstance(prefix, str)
+        self._prefix = prefix if prefix else ""
+        self._num_groups = num_groups
+
+    def _allreduce_grads(self):
+        if basics.size() == 1:
+            return
+        pre = 1.0 / self._gradient_predivide_factor
+        post = self._gradient_predivide_factor
+        entries = []
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null":
+                comp, cctx = self._compression.compress(
+                    param.list_grad()[0])
+                entries.append((i, param, comp, cctx))
+        if self._num_groups > 0:
+            for gi, group in enumerate(
+                    _split_list(entries, self._num_groups)):
+                grouped_allreduce_(
+                    tensors=[e[2] for e in group], average=True,
+                    name=f"{self._prefix}{group[0][0]}:{group[-1][0]}",
+                    priority=-gi, prescale_factor=pre,
+                    postscale_factor=post,
+                    process_set=self._process_set)
+        else:
+            for i, _, comp, _ in entries:
+                allreduce_(comp, average=True,
+                           name=self._prefix + str(i), priority=-i,
+                           prescale_factor=pre, postscale_factor=post,
+                           process_set=self._process_set)
+        if self._compression is not Compression.none:
+            for _, param, comp, cctx in entries:
+                param.list_grad()[0][:] = \
+                    self._compression.decompress(comp, cctx)
+
+
+def _append_broadcast_init(param, root_rank, name):
+    init_impl = getattr(param, "_init_impl")
+
+    def wrapped_init_impl(self, *args, **kwargs):
+        init_impl(*args, **kwargs)
+        broadcast_(self.data(), root_rank=root_rank, name=name)
+    return wrapped_init_impl
+
+
+def broadcast_parameters(params, root_rank=0, prefix=None):
+    """Broadcast a dict / gluon ParameterDict of parameters from root
+    (reference mxnet/__init__.py:245-290); deferred-init parameters get
+    a post-init broadcast hook."""
+    if basics.size() == 1:
+        return
+    tensors, names = [], []
+    assert prefix is None or isinstance(prefix, str)
+    prefix = prefix if prefix else ""
+    try:
+        from mxnet.gluon.parameter import ParameterDict
+        valid_types = (dict, ParameterDict)
+    except ImportError:
+        valid_types = (dict,)
+    if not isinstance(params, valid_types):
+        raise ValueError(f"invalid params of type: {type(params)}")
+    for name, p in sorted(params.items()):
+        try:
+            if isinstance(p, mx.gluon.parameter.Parameter):
+                tensors.append(p.data())
+            else:
+                tensors.append(p)
+            names.append(prefix + str(name))
+        except mx.gluon.parameter.DeferredInitializationError:
+            new_init = _append_broadcast_init(p, root_rank,
+                                              prefix + str(name))
+            p._init_impl = types.MethodType(new_init, p)
+    for tensor, name in zip(tensors, names):
+        broadcast_(tensor, root_rank=root_rank, name=name)

@@ -1,0 +1,88 @@
+"""MXNet collective ops (reference ``horovod/mxnet/mpi_ops.py``).
+
+Thin wrappers over the framework-neutral ops/api: MXNet NDArrays stage
+to host ndarrays (``.asnumpy()`` — see common/util.to_numpy) and the
+fused collective runs as a compiled XLA program on the TPU mesh, the
+same data plane the torch/TF frontends use.  The reference's
+``priority`` argument ordered NDArray-engine pushes; the engine here
+fuses whatever is concurrently pending, so priority is accepted for
+API compatibility and ignored.
+"""
+
+from ..common.process_sets import global_process_set
+from ..ops import api as _api
+from ..ops.api import (  # noqa: F401
+    Average, Sum, Adasum, Min, Max, Product,
+    barrier, join, synchronize, poll,
+    broadcast_object, allgather_object,
+)
+
+
+def allreduce(tensor, average=None, name=None, priority=0, op=None,
+              prescale_factor=1.0, postscale_factor=1.0,
+              process_set=global_process_set):
+    return _api.allreduce(tensor, average, name, op, prescale_factor,
+                          postscale_factor, process_set)
+
+
+def allreduce_(tensor, average=None, name=None, priority=0, op=None,
+               prescale_factor=1.0, postscale_factor=1.0,
+               process_set=global_process_set):
+    return _api.allreduce_(tensor, average, name, op, prescale_factor,
+                           postscale_factor, process_set)
+
+
+def grouped_allreduce(tensors, average=None, name=None, priority=0,
+                      op=None, prescale_factor=1.0, postscale_factor=1.0,
+                      process_set=global_process_set):
+    return _api.grouped_allreduce(tensors, average, name, op,
+                                  prescale_factor, postscale_factor,
+                                  process_set)
+
+
+def grouped_allreduce_(tensors, average=None, name=None, priority=0,
+                       op=None, prescale_factor=1.0, postscale_factor=1.0,
+                       process_set=global_process_set):
+    return _api.grouped_allreduce_(tensors, average, name, op,
+                                   prescale_factor, postscale_factor,
+                                   process_set)
+
+
+def allgather(tensor, name=None, priority=0,
+              process_set=global_process_set):
+    return _api.allgather(tensor, name, process_set)
+
+
+def grouped_allgather(tensors, name=None, priority=0,
+                      process_set=global_process_set):
+    return _api.grouped_allgather(tensors, name, process_set)
+
+
+def broadcast(tensor, root_rank, name=None, priority=0,
+              process_set=global_process_set):
+    return _api.broadcast(tensor, root_rank, name, process_set)
+
+
+def broadcast_(tensor, root_rank, name=None, priority=0,
+               process_set=global_process_set):
+    return _api.broadcast_(tensor, root_rank, name, process_set)
+
+
+def alltoall(tensor, splits=None, name=None, priority=0,
+             process_set=global_process_set):
+    out, recv_splits = _api.alltoall(tensor, splits, name, process_set)
+    if splits is None:
+        return out
+    return out, recv_splits
+
+
+def reducescatter(tensor, op=Average, name=None, priority=0,
+                  prescale_factor=1.0, postscale_factor=1.0,
+                  process_set=global_process_set):
+    return _api.reducescatter(tensor, op, name, prescale_factor,
+                              postscale_factor, process_set)
+
+
+def grouped_reducescatter(tensors, op=Average, name=None, priority=0,
+                          process_set=global_process_set):
+    return _api.grouped_reducescatter(tensors, op, name, process_set)

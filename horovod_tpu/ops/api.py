@@ -62,11 +62,12 @@ def _resolve_op(op, average, dtype):
 
 
 def _mutable(tensor):
-    """In-place collectives can write back into numpy and torch
+    """In-place collectives can write back into numpy, torch and mxnet
     tensors; jax/tf arrays are immutable (reference in-place ops exist
     only on the torch/mxnet bindings)."""
+    mod = type(tensor).__module__
     return isinstance(tensor, np.ndarray) or \
-        type(tensor).__module__.startswith("torch")
+        mod.startswith("torch") or mod.startswith("mxnet")
 
 
 def _submit(request, payloads, names):

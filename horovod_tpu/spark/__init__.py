@@ -10,7 +10,9 @@ spark/torch/estimator.py) train through this framework's rank launcher;
 only the DataFrame leg needs pyspark (``fit_arrays`` works without it).
 """
 
-from .common import Store, FilesystemStore, LocalStore  # noqa: F401
+from .common import (  # noqa: F401
+    Store, FilesystemStore, LocalStore, DBFSLocalStore, HDFSStore,
+)
 from .common.util import require_pyspark as _require_pyspark  # noqa: F401
 
 

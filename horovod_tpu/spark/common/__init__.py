@@ -1,4 +1,6 @@
 """Shared estimator machinery (reference ``horovod/spark/common/``)."""
 
-from .store import Store, FilesystemStore, LocalStore  # noqa: F401
+from .store import (  # noqa: F401
+    Store, FilesystemStore, LocalStore, DBFSLocalStore, HDFSStore,
+)
 from .params import EstimatorParams  # noqa: F401

@@ -91,13 +91,14 @@ class Store:
 
     @classmethod
     def create(cls, prefix_path: str, *args, **kwargs) -> "Store":
-        """Factory (reference store.py:96-113 picks the backend from
+        """Factory (reference store.py:158-165 picks the backend from
         the URL scheme)."""
-        if str(prefix_path).startswith(("hdfs://", "dbfs:/")):
-            raise NotImplementedError(
-                f"{prefix_path}: only filesystem stores are wired on "
-                f"this image; mount the remote FS and pass its path")
-        return FilesystemStore(prefix_path, *args, **kwargs)
+        prefix = str(prefix_path)
+        if DBFSLocalStore.matches_dbfs(prefix):
+            return DBFSLocalStore(prefix, *args, **kwargs)
+        if HDFSStore.matches(prefix):
+            return HDFSStore(prefix, *args, **kwargs)
+        return FilesystemStore(prefix, *args, **kwargs)
 
 
 class FilesystemStore(Store):
@@ -111,3 +112,103 @@ class FilesystemStore(Store):
 #: Alias kept for reference-API parity (reference LocalStore wraps the
 #: local FS the same way).
 LocalStore = FilesystemStore
+
+
+class DBFSLocalStore(FilesystemStore):
+    """Databricks DBFS store (reference store.py:540-576): ``dbfs:/x``
+    and ``file:///dbfs/x`` URLs both map onto the ``/dbfs`` FUSE mount,
+    after which everything is plain filesystem IO."""
+
+    def __init__(self, prefix_path: str):
+        super().__init__(self.normalize_path(str(prefix_path)))
+
+    @classmethod
+    def matches_dbfs(cls, path: str) -> bool:
+        path = str(path)
+        return path.startswith("dbfs:/") or path == "/dbfs" or \
+            path.startswith("/dbfs/") or path == "file:///dbfs" or \
+            path.startswith("file:///dbfs/")
+
+    @staticmethod
+    def normalize_path(path: str) -> str:
+        if path.startswith("dbfs:/"):
+            return "/dbfs" + path[len("dbfs:"):]
+        if path.startswith("file:///dbfs"):
+            return path[len("file://"):]
+        return path
+
+    def get_checkpoint_filename(self) -> str:
+        # the DBFS FUSE mount forbids random writes; the reference
+        # saves weights-only .tf checkpoints there for the same reason
+        return "checkpoint.weights.bin"
+
+
+class HDFSStore(Store):
+    """HDFS-backed store (reference store.py:396-537, built on
+    pyarrow's HadoopFileSystem).  Gated: constructing it without a
+    working pyarrow+libhdfs raises a clear error."""
+
+    FS_PREFIX = "hdfs://"
+
+    def __init__(self, prefix_path: str, host=None, port=None, user=None,
+                 kerb_ticket=None, **_):
+        try:
+            from pyarrow import fs as pafs
+        except ImportError as exc:
+            raise ImportError(
+                "HDFSStore requires pyarrow (with libhdfs) which is not "
+                "installed in this environment; mount HDFS and use "
+                "FilesystemStore, or install pyarrow") from exc
+        prefix = str(prefix_path)
+        host_part, path = self._parse_url(prefix)
+        h = host or (host_part.split(":")[0] if host_part else "default")
+        p = port or (int(host_part.split(":")[1])
+                     if host_part and ":" in host_part else 0)
+        try:
+            self._fs = pafs.HadoopFileSystem(
+                host=h, port=p, user=user, kerb_ticket=kerb_ticket)
+        except Exception as exc:
+            raise RuntimeError(
+                f"HDFSStore could not open {prefix!r}: pyarrow needs the "
+                "Hadoop native library (libhdfs) and a reachable "
+                "namenode; mount HDFS locally and use FilesystemStore "
+                "if Hadoop is not available on this host") from exc
+        super().__init__(path)
+
+    @classmethod
+    def matches(cls, path: str) -> bool:
+        return str(path).startswith(cls.FS_PREFIX)
+
+    @staticmethod
+    def _parse_url(url: str):
+        rest = url[len("hdfs://"):] if url.startswith("hdfs://") else url
+        if "/" in rest:
+            host, path = rest.split("/", 1)
+            return host, "/" + path
+        return rest, "/"
+
+    # -- IO over pyarrow fs --------------------------------------------------
+
+    def exists(self, path: str) -> bool:
+        from pyarrow import fs as pafs
+        info = self._fs.get_file_info([path])[0]
+        return info.type != pafs.FileType.NotFound
+
+    def read(self, path: str) -> bytes:
+        with self._fs.open_input_stream(path) as f:
+            return f.read()
+
+    def write(self, path: str, data: bytes):
+        parent = os.path.dirname(path)
+        if parent:
+            self._fs.create_dir(parent, recursive=True)
+        with self._fs.open_output_stream(path) as f:
+            f.write(data)
+
+    def delete(self, path: str):
+        from pyarrow import fs as pafs
+        info = self._fs.get_file_info([path])[0]
+        if info.type == pafs.FileType.Directory:
+            self._fs.delete_dir(path)
+        elif info.type != pafs.FileType.NotFound:
+            self._fs.delete_file(path)

@@ -121,6 +121,22 @@ def broadcast_object_fn(root_rank=0, session=None, name=None,
     return _fn
 
 
+def _normalize_local_layers(local_layers):
+    """None / one Layer / iterable of Layers -> validated list (shared
+    by PartialDistributedGradientTape and keras
+    PartialDistributedOptimizer)."""
+    if local_layers is None:
+        return []
+    if isinstance(local_layers, tf.keras.layers.Layer):
+        return [local_layers]
+    local_layers = list(local_layers)
+    if not all(isinstance(l, tf.keras.layers.Layer)
+               for l in local_layers):
+        raise ValueError(
+            "All local layers must be of tf.keras.layers.Layer type.")
+    return local_layers
+
+
 class _GradSync:
     """Single implementation of the cross-rank gradient sync used by
     DistributedGradientTape, PartialDistributedGradientTape and
@@ -297,14 +313,7 @@ def PartialDistributedGradientTape(gradtape=None, device_dense="",
     of ``local_layers`` (reference tensorflow/__init__.py:1189).  When
     an existing ``gradtape`` is passed it is wrapped (its recording is
     preserved); otherwise a fresh distributed tape is built."""
-    if local_layers is None:
-        local_layers = []
-    elif isinstance(local_layers, tf.keras.layers.Layer):
-        local_layers = [local_layers]
-    elif not all(isinstance(l, tf.keras.layers.Layer)
-                 for l in local_layers):
-        raise ValueError(
-            "All local layers must be of tf.keras.layers.Layer type.")
+    local_layers = _normalize_local_layers(local_layers)
     if gradtape is not None:
         tape = _DistributedTapeWrapper(gradtape, _GradSync(
             compression=compression, op=op,
@@ -352,8 +361,21 @@ def DistributedOptimizer(optimizer, name=None,
             self._hvd_sync.register_local_var(var)
 
         def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            if not tf.executing_eagerly():
+                # the collective data plane stages through host ndarrays
+                # (.numpy()), which cannot run inside a traced
+                # tf.function — and a Python-side accumulation counter
+                # would be frozen at trace time.  Fail loudly instead of
+                # silently mistracing.
+                raise RuntimeError(
+                    "horovod_tpu DistributedOptimizer must run eagerly; "
+                    "compile with run_eagerly=True (model.compile(..., "
+                    "run_eagerly=True)) or call apply_gradients outside "
+                    "tf.function")
             grads_and_vars = list(grads_and_vars)
-            grads = [g for g, _ in grads_and_vars]
+            grads = [tf.convert_to_tensor(g)
+                     if isinstance(g, tf.IndexedSlices) else g
+                     for g, _ in grads_and_vars]
             tvars = [v for _, v in grads_and_vars]
             if bpps > 1:
                 # local aggregation: accumulate bpps micro-batches, then

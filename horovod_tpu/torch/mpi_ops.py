@@ -116,6 +116,7 @@ class HorovodAllgather(torch.autograd.Function):
 
     @staticmethod
     def forward(ctx, tensor, name, process_set):
+        ctx.scalar = tensor.dim() == 0   # staged as shape-(1,)
         ctx.dim0 = tensor.shape[0] if tensor.dim() else 1
         ctx.process_set = process_set
         return _api.synchronize(
@@ -129,7 +130,10 @@ class HorovodAllgather(torch.autograd.Function):
                          process_set=ctx.process_set)
         pos = _ps_rank_pos(ctx.process_set)
         offset = int(dims[:pos].sum()) if pos else 0
-        return grad_reduced.narrow(0, offset, ctx.dim0), None, None
+        grad = grad_reduced.narrow(0, offset, ctx.dim0)
+        if ctx.scalar:
+            grad = grad.reshape(())
+        return grad, None, None
 
 
 class HorovodGroupedAllgather(torch.autograd.Function):
@@ -137,6 +141,7 @@ class HorovodGroupedAllgather(torch.autograd.Function):
 
     @staticmethod
     def forward(ctx, name, process_set, *tensors):
+        ctx.scalars = [t.dim() == 0 for t in tensors]
         ctx.dim0s = [t.shape[0] if t.dim() else 1 for t in tensors]
         ctx.process_set = process_set
         return tuple(_api.synchronize(
@@ -152,7 +157,8 @@ class HorovodGroupedAllgather(torch.autograd.Function):
         grads = []
         for i, g in enumerate(grads_reduced):
             offset = int(dims[:pos, i].sum()) if pos else 0
-            grads.append(g.narrow(0, offset, ctx.dim0s[i]))
+            g = g.narrow(0, offset, ctx.dim0s[i])
+            grads.append(g.reshape(()) if ctx.scalars[i] else g)
         return (None, None, *grads)
 
 

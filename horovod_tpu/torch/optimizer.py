@@ -48,6 +48,16 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                 raise ValueError(
                     "named_parameters should be a sequence of "
                     "tuples (name, parameter)")
+            # duplicate names make two params share one collective
+            # tensor name — ranks then silently average mismatched
+            # tensors (reference optimizer.py dedup check)
+            names = [k for k, _ in named_parameters]
+            dups = {n for n in names if names.count(n) > 1}
+            if dups:
+                raise ValueError(
+                    f"named_parameters contains duplicate names "
+                    f"{sorted(dups)}; parameters need unique names "
+                    "(e.g. pass model.named_parameters() of one module)")
             all_param_ids = {id(v) for group in self.param_groups
                              for v in group["params"]}
             named_ids = {id(v) for _, v in named_parameters}
@@ -140,8 +150,16 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             gi = self._group_of.get(id(p))
             if gi is not None and p.grad.is_sparse and \
                     not self.sparse_as_dense:
-                # sparse grads can't join a dense fused group — route
-                # through the allgather-based sparse path individually
+                # sparse grads can't join a dense fused group — evict
+                # the param permanently so the remaining dense members
+                # keep fusing, and route it through the allgather-based
+                # sparse path individually
+                group = self._groups[gi]
+                group[:] = [q for q in group if id(q) != id(p)]
+                del self._group_of[id(p)]
+                if group and \
+                        len(self._group_pending[gi]) == len(group):
+                    self._grouped_allreduce_async(gi)
                 gi = None
             if gi is None:
                 handle, ctx = self._allreduce_grad_async(p)

@@ -21,8 +21,28 @@ def test_store_layout_and_checkpoint(tmp_path):
     store.save_checkpoint("r1", b"blob")
     assert store.load_checkpoint("r1") == b"blob"
     assert store.load_checkpoint("missing") is None
-    with pytest.raises(NotImplementedError):
+    # hdfs:// dispatches to HDFSStore, which needs libhdfs + a
+    # namenode — absent here, so construction must fail loudly
+    with pytest.raises((ImportError, RuntimeError)):
         Store.create("hdfs://nn/path")
+
+
+def test_dbfs_local_store(tmp_path, monkeypatch):
+    from horovod_tpu.spark.common.store import DBFSLocalStore
+    assert DBFSLocalStore.matches_dbfs("dbfs:/foo")
+    assert DBFSLocalStore.matches_dbfs("file:///dbfs/foo")
+    assert not DBFSLocalStore.matches_dbfs("/data/foo")
+    assert DBFSLocalStore.normalize_path("dbfs:/foo/bar") == "/dbfs/foo/bar"
+    assert DBFSLocalStore.normalize_path("file:///dbfs/x") == "/dbfs/x"
+    # dbfs:/ URLs map to the FUSE mount; exercise via a fake /dbfs root
+    fake = tmp_path / "dbfs"
+    monkeypatch.setattr(DBFSLocalStore, "normalize_path",
+                        staticmethod(lambda p: str(fake / p.split(":/")[-1])))
+    store = Store.create("dbfs:/run")
+    assert isinstance(store, DBFSLocalStore)
+    store.save_checkpoint("r1", b"x")
+    assert store.load_checkpoint("r1") == b"x"
+    assert store.get_checkpoint_filename() == "checkpoint.weights.bin"
 
 
 def test_estimator_params_validation():
@@ -242,3 +262,40 @@ def test_data_service_rejects_unauthenticated_writes():
             next(iter(data_service(cfg.to_dict(), rank=0, size=2)))
     finally:
         server.stop()
+
+
+# ---------------------------------------------------------------------------
+# mxnet frontend (gated: mxnet is absent from this image)
+
+def test_mxnet_neutral_surface_works_without_mxnet(hvd_shutdown):
+    import numpy as np
+    import horovod_tpu as hvd_core
+    import horovod_tpu.mxnet as hvdmx
+
+    def fn():
+        out = hvdmx.allreduce(np.ones(4, np.float32) * (hvdmx.rank() + 1),
+                              op=hvdmx.Sum)
+        assert np.allclose(out, sum(range(1, 5)))
+        return True
+
+    assert all(hvd_core.run(fn, np=4))
+
+
+def test_mxnet_gated_names_raise_clear_importerror():
+    import importlib
+    import horovod_tpu.mxnet as hvdmx
+    try:
+        importlib.import_module("mxnet")
+        has_mxnet = True
+    except ImportError:
+        has_mxnet = False
+    if has_mxnet:
+        assert hvdmx.DistributedOptimizer is not None
+        return
+    import pytest
+    for name in ("DistributedOptimizer", "DistributedTrainer",
+                 "broadcast_parameters"):
+        with pytest.raises(ImportError, match="requires mxnet"):
+            getattr(hvdmx, name)
+    with pytest.raises(AttributeError):
+        hvdmx.not_a_real_name

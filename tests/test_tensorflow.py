@@ -269,3 +269,31 @@ def test_tf_partial_tape_wraps_existing_tape(hvd_shutdown):
         return True
 
     assert all(run_ranks(fn))
+
+
+def test_tf_optimizer_rejects_graph_mode(hvd_shutdown):
+    def fn():
+        v = tf.Variable([1.0])
+        opt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(0.1))
+
+        @tf.function
+        def step():
+            opt.apply_gradients([(tf.constant([1.0]), v)])
+
+        with pytest.raises(Exception, match="eagerly"):
+            step()
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_keras_broadcast_global_variables_raises_when_empty(hvd_shutdown):
+    import horovod_tpu.keras as hvdk
+
+    def fn():
+        tf.keras.layers.Dense(1)  # eager vars: not in the v1 collection
+        with pytest.raises(RuntimeError, match="broadcast_variables"):
+            hvdk.broadcast_global_variables(0)
+        return True
+
+    assert all(run_ranks(fn))

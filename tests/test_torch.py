@@ -499,22 +499,52 @@ def test_torch_optimizer_sparse_in_group_routes_individually(hvd_shutdown):
     allgather-based sparse path instead of crashing the dense group."""
     def fn():
         r = hvd.rank()
-        emb = torch.nn.Embedding(4, 2, sparse=True)
-        lin = torch.nn.Linear(2, 2, bias=False)
+        net = torch.nn.Sequential(torch.nn.Embedding(4, 2, sparse=True),
+                                  torch.nn.Linear(2, 2, bias=False))
         with torch.no_grad():
-            emb.weight.fill_(0.0)
-        params = list(emb.parameters()) + list(lin.parameters())
+            net[0].weight.fill_(0.0)
+        hvd.broadcast_parameters(net.state_dict(), root_rank=0)
+        params = list(net.parameters())
         opt = torch.optim.SGD(params, lr=1.0)
         opt = hvd.DistributedOptimizer(
-            opt,
-            named_parameters=list(emb.named_parameters()) +
-            list(lin.named_parameters()),
-            groups=[params])
-        out = lin(emb(torch.tensor([r % 4])))
+            opt, named_parameters=net.named_parameters(), groups=[params])
+        out = net(torch.tensor([r % 4]))
         out.sum().backward()
         opt.step()
-        assert not torch.isnan(emb.weight.to_dense() if
-                               emb.weight.is_sparse else emb.weight).any()
+        # the sparse param was evicted from the group; dense members
+        # still averaged — weights must stay identical across ranks
+        w = torch.cat([p.detach().to_dense().flatten() if p.is_sparse
+                       else p.detach().flatten() for p in params])
+        gathered = hvd.allgather(w.reshape(1, -1))
+        assert torch.allclose(gathered, gathered[0].expand_as(gathered))
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_torch_optimizer_duplicate_names_rejected(hvd_shutdown):
+    def fn():
+        emb = torch.nn.Embedding(4, 2)
+        lin = torch.nn.Linear(2, 2, bias=False)
+        params = list(emb.parameters()) + list(lin.parameters())
+        with pytest.raises(ValueError, match="duplicate names"):
+            hvd.DistributedOptimizer(
+                torch.optim.SGD(params, lr=1.0),
+                named_parameters=list(emb.named_parameters()) +
+                list(lin.named_parameters()))
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_torch_allgather_scalar_grad(hvd_shutdown):
+    def fn():
+        t = torch.tensor(float(hvd.rank() + 1), requires_grad=True)
+        out = hvd.allgather(t)
+        assert out.shape == (NP,)
+        out.sum().backward()
+        assert t.grad.shape == ()
+        assert torch.isfinite(t.grad)
         return True
 
     assert all(run_ranks(fn))

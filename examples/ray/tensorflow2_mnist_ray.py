@@ -1,0 +1,59 @@
+"""Train a keras model through the RayExecutor (reference
+``examples/ray/tensorflow2_mnist_ray.py``): place one actor per slot,
+run the same single-device training function everywhere.
+
+Requires ray:  pip install ray  (gated out of this image's tests).
+
+    python examples/ray/tensorflow2_mnist_ray.py
+"""
+
+import argparse
+
+
+def train(num_epochs):
+    import numpy as np
+    import tensorflow as tf
+    import horovod_tpu.keras as hvd
+
+    hvd.init()
+
+    x = np.random.rand(512, 28, 28).astype("float32")
+    y = np.random.randint(0, 10, 512)
+
+    model = tf.keras.Sequential([
+        tf.keras.layers.Flatten(input_shape=(28, 28)),
+        tf.keras.layers.Dense(128, activation="relu"),
+        tf.keras.layers.Dense(10),
+    ])
+    opt = hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(0.01 * hvd.size()))
+    model.compile(
+        optimizer=opt,
+        loss=tf.keras.losses.SparseCategoricalCrossentropy(
+            from_logits=True),
+        metrics=["accuracy"],
+        run_eagerly=True,   # collectives stage through host buffers
+    )
+    model.fit(
+        x, y, batch_size=64, epochs=num_epochs,
+        callbacks=[
+            hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+            hvd.callbacks.MetricAverageCallback(),
+        ],
+        verbose=1 if hvd.rank() == 0 else 0)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-workers", type=int, default=4)
+    parser.add_argument("--epochs", type=int, default=2)
+    args = parser.parse_args()
+
+    import ray
+    from horovod_tpu.ray import RayExecutor
+
+    ray.init()
+    executor = RayExecutor(num_workers=args.num_workers, use_gpu=False)
+    executor.start()
+    executor.run(train, args=(args.epochs,))
+    executor.shutdown()

@@ -1,0 +1,95 @@
+"""Synthetic benchmark for the TF2 frontend (reference
+``examples/tensorflow2/tensorflow2_synthetic_benchmark.py``: same
+flags, same protocol — img/sec over timed iterations of a
+DistributedGradientTape step on random data).
+
+Run single-host:  python examples/tensorflow2/tensorflow2_synthetic_benchmark.py --tiny
+Run multi-proc:   python -m horovod_tpu.runner.launch -np 4 --cpu -- \
+                      python examples/tensorflow2/tensorflow2_synthetic_benchmark.py --tiny
+"""
+
+import argparse
+import timeit
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+
+parser = argparse.ArgumentParser()
+parser.add_argument("--batch-size", type=int, default=32)
+parser.add_argument("--num-iters", type=int, default=10)
+parser.add_argument("--num-batches-per-iter", type=int, default=10)
+parser.add_argument("--num-warmup-batches", type=int, default=10)
+parser.add_argument("--fp16-allreduce", action="store_true",
+                    help="use 16-bit compression on the wire")
+parser.add_argument("--tiny", action="store_true",
+                    help="use a small MLP instead of a conv net (CI)")
+args = parser.parse_args()
+
+hvd.init()
+
+
+def make_model():
+    if args.tiny:
+        return tf.keras.Sequential([
+            tf.keras.layers.Flatten(input_shape=(32, 32, 3)),
+            tf.keras.layers.Dense(64, activation="relu"),
+            tf.keras.layers.Dense(10),
+        ])
+    return tf.keras.Sequential([
+        tf.keras.layers.Conv2D(32, 3, padding="same", activation="relu",
+                               input_shape=(32, 32, 3)),
+        tf.keras.layers.Conv2D(64, 3, strides=2, padding="same",
+                               activation="relu"),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(10),
+    ])
+
+
+model = make_model()
+opt = tf.keras.optimizers.SGD(0.01)
+compression = hvd.Compression.fp16 if args.fp16_allreduce else \
+    hvd.Compression.none
+
+data = tf.random.normal((args.batch_size, 32, 32, 3))
+target = tf.random.uniform((args.batch_size,), 0, 10, dtype=tf.int64)
+
+# one forward to build variables, then sync initial state
+model(data)
+hvd.broadcast_variables(model.weights, root_rank=0)
+
+
+def benchmark_step():
+    with hvd.DistributedGradientTape(compression=compression) as tape:
+        logits = model(data, training=True)
+        loss = tf.reduce_mean(
+            tf.keras.losses.sparse_categorical_crossentropy(
+                target, logits, from_logits=True))
+    grads = tape.gradient(loss, model.trainable_variables)
+    opt.apply_gradients(zip(grads, model.trainable_variables))
+
+
+def log(s):
+    if hvd.rank() == 0:
+        print(s)
+
+
+log(f"Model: {'tiny-mlp' if args.tiny else 'small-conv'}")
+log(f"Batch size: {args.batch_size}")
+log(f"Number of ranks: {hvd.size()}")
+
+timeit.timeit(benchmark_step, number=args.num_warmup_batches)
+
+img_secs = []
+for x in range(args.num_iters):
+    t = timeit.timeit(benchmark_step, number=args.num_batches_per_iter)
+    img_sec = args.batch_size * args.num_batches_per_iter / t
+    log(f"Iter #{x}: {img_sec:.1f} img/sec per rank")
+    img_secs.append(img_sec)
+
+img_sec_mean = np.mean(img_secs)
+img_sec_conf = 1.96 * np.std(img_secs)
+log(f"Img/sec per rank: {img_sec_mean:.1f} +-{img_sec_conf:.1f}")
+log(f"Total img/sec on {hvd.size()} rank(s): "
+    f"{hvd.size() * img_sec_mean:.1f} +-{hvd.size() * img_sec_conf:.1f}")

@@ -11,6 +11,7 @@ devices rather than one OS process per accelerator.
 
 import os
 import threading
+from contextlib import contextmanager as _contextmanager
 
 from . import env as env_mod
 from .exceptions import HorovodInitError
@@ -225,6 +226,20 @@ def bind_rank(rank):
 
 def unbind_rank():
     _tls.ctx = None
+
+
+@_contextmanager
+def bound_context(ctx):
+    """Temporarily bind ``ctx`` (a RankContext) to the calling thread.
+    Frameworks that run callbacks on their own pool threads (e.g. TF's
+    py_function executor) use this to carry the submitting rank's
+    identity across the thread hop."""
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _tls.ctx = prev
 
 
 def context() -> RankContext:

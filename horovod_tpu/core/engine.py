@@ -936,32 +936,41 @@ class Engine:
             sub.handle.set_result(res, extra=np.array(rsp, dtype=np.int32))
 
     def _run_reducescatter(self, ps, entry):
+        """Reducescatter; grouped submissions carry several payloads
+        and resolve to a list per rank (like _run_allgather)."""
         subs = self._local_subs(ps, entry)
         first = next(iter(subs.values()))
         req = first.request
         op = req.reduce_op
-        shape = first.payloads[0].shape
-        d0 = int(shape[0]) if shape else 1
-        rest = tuple(shape[1:])
-        rest_n = int(np.prod(rest, dtype=np.int64)) if rest else 1
+        n_tensors = len(first.payloads)
         R = ps.size
-        chunks = ps.executor.chunk_sizes(d0, R)
-        max_chunk = max(chunks) if chunks else 0
-        offsets = np.cumsum([0] + chunks[:-1])
-        rows = []
-        for r in subs:
-            flat = np.ravel(subs[r].payloads[0])
-            buf = np.zeros(R * max_chunk * rest_n, dtype=flat.dtype)
-            for j in range(R):
-                src = offsets[j] * rest_n
-                dst = j * max_chunk * rest_n
-                buf[dst:dst + chunks[j] * rest_n] = \
-                    flat[src:src + chunks[j] * rest_n]
-            rows.append(buf)
-        results = ps.executor.reducescatter(
-            rows, d0, rest, op, req.prescale_factor, req.postscale_factor)
-        for (r, sub), res in zip(subs.items(), results):
-            sub.handle.set_result(res)
+        results_per_rank = {r: [] for r in subs}
+        for i in range(n_tensors):
+            shape = first.payloads[i].shape
+            d0 = int(shape[0]) if shape else 1
+            rest = tuple(shape[1:])
+            rest_n = int(np.prod(rest, dtype=np.int64)) if rest else 1
+            chunks = ps.executor.chunk_sizes(d0, R)
+            max_chunk = max(chunks) if chunks else 0
+            offsets = np.cumsum([0] + chunks[:-1])
+            rows = []
+            for r in subs:
+                flat = np.ravel(subs[r].payloads[i])
+                buf = np.zeros(R * max_chunk * rest_n, dtype=flat.dtype)
+                for j in range(R):
+                    src = offsets[j] * rest_n
+                    dst = j * max_chunk * rest_n
+                    buf[dst:dst + chunks[j] * rest_n] = \
+                        flat[src:src + chunks[j] * rest_n]
+                rows.append(buf)
+            results = ps.executor.reducescatter(
+                rows, d0, rest, op, req.prescale_factor,
+                req.postscale_factor)
+            for r, res in zip(subs, results):
+                results_per_rank[r].append(res)
+        for r, sub in subs.items():
+            outs = results_per_rank[r]
+            sub.handle.set_result(outs if n_tensors > 1 else outs[0])
 
     # ------------------------------------------------------------------
 

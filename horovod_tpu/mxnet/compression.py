@@ -26,7 +26,10 @@ class NoneCompressor(Compressor):
 class FP16Compressor(Compressor):
     @staticmethod
     def compress(tensor):
-        if "float" in str(tensor.dtype) and str(tensor.dtype) != "float16":
+        import numpy as np
+        # NDArray.dtype is a numpy type class — compare types, not str
+        if np.issubdtype(tensor.dtype, np.floating) and \
+                tensor.dtype != np.float16:
             return tensor.astype("float16"), tensor.dtype
         return tensor, None
 

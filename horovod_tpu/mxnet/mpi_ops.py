@@ -85,4 +85,5 @@ def reducescatter(tensor, op=Average, name=None, priority=0,
 
 def grouped_reducescatter(tensors, op=Average, name=None, priority=0,
                           process_set=global_process_set):
-    return _api.grouped_reducescatter(tensors, op, name, process_set)
+    return _api.grouped_reducescatter(tensors, op, name,
+                                      process_set=process_set)

@@ -328,18 +328,45 @@ def reducescatter(tensor, op=Average, name=None, prescale_factor=1.0,
 
 
 def grouped_reducescatter_async(tensors, op=Average, name=None,
+                                prescale_factor=1.0, postscale_factor=1.0,
                                 process_set=global_process_set):
+    """Jointly-negotiated grouped reducescatter (reference
+    EnqueueTensorReducescatters + group_table joint readiness): one
+    submission, one negotiated unit, one handle resolving to a list."""
+    if not tensors:
+        raise ValueError("grouped_reducescatter requires at least one "
+                         "tensor")
+    pairs = [util.to_numpy(t) for t in tensors]
+    arrs = [p[0] for p in pairs]
+    kinds = [p[1] for p in pairs]
+    if any(a.ndim == 0 for a in arrs):
+        raise ValueError("reducescatter requires tensors with >=1 dim")
+    dtypes = {normalize_dtype(a.dtype) for a in arrs}
+    if len(dtypes) > 1:
+        raise ValueError(
+            f"grouped_reducescatter requires matching dtypes, got {dtypes}")
     ctx = basics.context()
+    op = _resolve_op(op, None, arrs[0].dtype)
     base = name or ctx.next_name("grouped_reducescatter")
-    return [reducescatter_async(t, op, f"{base}.{i}",
-                                process_set=process_set)
-            for i, t in enumerate(tensors)]
+    names = [f"{base}.{i}" for i in range(len(arrs))]
+    req = Request(
+        request_type=RequestType.REDUCESCATTER, tensor_name=base,
+        rank=ctx.rank, dtype=normalize_dtype(arrs[0].dtype),
+        shape=tuple(arrs[0].shape), reduce_op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set_id=_ps_id(process_set), group_id=0)
+    h = _submit(req, arrs, names)
+    h.kind = kinds
+    h.grouped = True
+    return h
 
 
 def grouped_reducescatter(tensors, op=Average, name=None,
+                          prescale_factor=1.0, postscale_factor=1.0,
                           process_set=global_process_set):
-    return [synchronize(h) for h in
-            grouped_reducescatter_async(tensors, op, name, process_set)]
+    return synchronize(grouped_reducescatter_async(
+        tensors, op, name, prescale_factor, postscale_factor,
+        process_set))
 
 
 # ----------------------------------------------------------------------------

@@ -169,7 +169,12 @@ class _GradSync:
 
     def allreduce_grads(self, grads):
         """Grouped allreduce of a (possibly nested) grad structure;
-        None entries pass through, IndexedSlices densify."""
+        None entries pass through, IndexedSlices densify.  Inside a
+        traced tf.function the collective runs through tf.py_function
+        (the data plane stages through host ndarrays), so user code
+        like model.fit works without run_eagerly — the reference's
+        AsyncOpKernels play the same host-hop role
+        (tensorflow/mpi_ops.cc:446-501)."""
         flat = tf.nest.flatten(grads)
         dense, index = [], []
         for i, g in enumerate(flat):
@@ -184,6 +189,40 @@ class _GradSync:
             index.append(i)
         if not dense:
             return grads
+        if tf.executing_eagerly():
+            outs = self._reduce_dense(dense)
+        else:
+            if _basics.engine().num_local > 1:
+                # one shared TF runtime serializes py_function bodies,
+                # so two rank THREADS blocking on each other inside
+                # py_functions deadlock.  Real deployments run one
+                # process per rank (runner/proc_run) where this cannot
+                # happen; in-process thread mode must stay eager.
+                raise RuntimeError(
+                    "tf.function-traced collectives need one process "
+                    "per rank (horovodrun/proc_run); with the "
+                    "in-process thread launcher use run_eagerly=True")
+            # py_function may run on a TF executor thread that carries
+            # no rank binding — capture the tracing thread's context
+            # and re-bind it across the hop
+            caller_ctx = _basics.context()
+
+            def _bridge(*ts):
+                with _basics.bound_context(caller_ctx):
+                    return self._reduce_dense(list(ts))
+
+            outs = tf.py_function(func=_bridge, inp=dense,
+                                  Tout=[g.dtype for g in dense])
+            if not isinstance(outs, (list, tuple)):
+                outs = [outs]
+            for o, g in zip(outs, dense):
+                o.set_shape(g.shape)   # py_function erases shapes
+        for i, o in zip(index, outs):
+            flat[i] = o
+        return tf.nest.pack_sequence_as(grads, flat)
+
+    def _reduce_dense(self, dense):
+        """Eager grouped allreduce of a flat dense list."""
         comp, ctxs = zip(*[self.compression.compress(g) for g in dense])
         prescale = 1.0
         if self.op == Average and self.gradient_predivide_factor != 1.0:
@@ -193,11 +232,8 @@ class _GradSync:
                                  process_set=self.process_set)
         if not isinstance(outs, list):
             outs = [outs]
-        outs = [self.compression.decompress(o, c)
+        return [self.compression.decompress(o, c)
                 for o, c in zip(outs, ctxs)]
-        for i, o in zip(index, outs):
-            flat[i] = o
-        return tf.nest.pack_sequence_as(grads, flat)
 
     def sync(self, grads, sources=None):
         """allreduce_grads, but gradients of registered local sources
@@ -361,17 +397,16 @@ def DistributedOptimizer(optimizer, name=None,
             self._hvd_sync.register_local_var(var)
 
         def apply_gradients(self, grads_and_vars, *args, **kwargs):
-            if not tf.executing_eagerly():
-                # the collective data plane stages through host ndarrays
-                # (.numpy()), which cannot run inside a traced
-                # tf.function — and a Python-side accumulation counter
-                # would be frozen at trace time.  Fail loudly instead of
-                # silently mistracing.
+            if not tf.executing_eagerly() and bpps > 1:
+                # the accumulate-or-apply branch below runs on a
+                # Python-side counter, which a tf.function trace would
+                # freeze permanently into one arm.  (bpps == 1 works
+                # traced: the collective itself rides tf.py_function.)
                 raise RuntimeError(
-                    "horovod_tpu DistributedOptimizer must run eagerly; "
-                    "compile with run_eagerly=True (model.compile(..., "
-                    "run_eagerly=True)) or call apply_gradients outside "
-                    "tf.function")
+                    "backward_passes_per_step > 1 requires eager "
+                    "execution; compile with run_eagerly=True "
+                    "(model.compile(..., run_eagerly=True)) or call "
+                    "apply_gradients outside tf.function")
             grads_and_vars = list(grads_and_vars)
             grads = [tf.convert_to_tensor(g)
                      if isinstance(g, tf.IndexedSlices) else g

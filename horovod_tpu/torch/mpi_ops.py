@@ -344,7 +344,8 @@ def grouped_reducescatter(tensors, name=None, op=Average,
     if _differentiable(*tensors):
         return list(HorovodGroupedReducescatter.apply(name, op, process_set,
                                                       *tensors))
-    return _api.grouped_reducescatter(tensors, op, name, process_set)
+    return _api.grouped_reducescatter(tensors, op, name,
+                                      process_set=process_set)
 
 
 def sparse_allreduce_async(tensor, name, op,

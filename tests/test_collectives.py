@@ -481,3 +481,23 @@ def test_adasum_orthogonal(hvd_shutdown):
 
     for out in run_ranks(fn, np_ranks=2):
         np.testing.assert_allclose(out, [1.0, 1.0], rtol=1e-6)
+
+
+def test_grouped_reducescatter_joint(hvd_shutdown):
+    """Grouped reducescatter is one negotiated unit: a single handle
+    resolves to a list; mixed shapes share the group."""
+    def fn():
+        r = hvd.rank()
+        a = np.ones((8, 3), np.float32) * (r + 1)
+        b = np.ones((16, 2), np.float32) * (r + 1)
+        outs = hvd.grouped_reducescatter([a, b], op=hvd.Sum)
+        assert isinstance(outs, list) and len(outs) == 2
+        total = float(sum(range(1, 9)))
+        assert outs[0].shape == (1, 3) and np.allclose(outs[0], total)
+        assert outs[1].shape == (2, 2) and np.allclose(outs[1], total)
+        # average variant divides by the process-set size
+        outs = hvd.grouped_reducescatter([a], op=hvd.Average)
+        assert np.allclose(outs[0], total / 8)
+        return True
+
+    assert all(run_ranks(fn))

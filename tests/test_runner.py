@@ -307,6 +307,14 @@ FULL_MATRIX_WORKER = textwrap.dedent("""
     assert np.allclose(outs[0], sum(range(s)))
     assert np.allclose(outs[1], float(s))
 
+    # grouped reducescatter: one negotiated unit across processes
+    gouts = hvd.grouped_reducescatter(
+        [np.ones((s, 3), np.float32) * (r + 1),
+         np.ones((2 * s, 2), np.float32) * (r + 1)],
+        op=hvd.Sum, name="grs")
+    assert gouts[0].shape == (1, 3) and np.allclose(gouts[0], total)
+    assert gouts[1].shape == (2, 2) and np.allclose(gouts[1], total)
+
     # broadcast with non-zero root
     b = hvd.broadcast(np.full(3, float(r), np.float32), root_rank=1,
                       name="bc")
@@ -405,3 +413,54 @@ def test_coordinator_join_idempotent():
     c.handle("join", {**req, "rank": 1, "jid": 2})
     out = c.handle("poll", {"cursor": 0, "wait": 0})
     assert [r["kind"] for r in out["responses"]] == ["join_done"]
+
+
+TF_GRAPH_WORKER = textwrap.dedent("""
+    import numpy as np
+    import tensorflow as tf
+    import horovod_tpu.tensorflow as hvd
+
+    hvd.init()
+    r, s = hvd.rank(), hvd.size()
+
+    v = tf.Variable([0.0])
+    opt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(1.0))
+
+    @tf.function
+    def step():
+        opt.apply_gradients([(tf.constant([float(r + 1)]), v)])
+
+    step()
+    expected = -np.mean([i + 1 for i in range(s)])
+    assert np.allclose(v.numpy(), [expected]), v.numpy()
+
+    w = tf.Variable([[1.0], [1.0]])
+
+    @tf.function
+    def tape_step():
+        x = tf.constant([[float(r + 1), 2.0 * (r + 1)]])
+        with hvd.DistributedGradientTape() as tape:
+            y = tf.reduce_sum(tf.matmul(x, w))
+        return tape.gradient(y, [w])
+
+    g = tape_step()[0].numpy()
+    mean = np.mean([i + 1 for i in range(s)])
+    assert np.allclose(g.ravel(), [mean, 2 * mean]), g
+    print(f"TF GRAPH OK {r}")
+    hvd.shutdown()
+""")
+
+
+@pytest.mark.integration
+def test_two_process_tf_graph_mode(tmp_path):
+    """tf.function-traced collectives ride tf.py_function; with one
+    process per rank (each its own TF runtime) the traced path works
+    end-to-end — model.fit without run_eagerly."""
+    from horovod_tpu.runner.proc_run import launch_procs
+
+    script = tmp_path / "worker.py"
+    script.write_text(TF_GRAPH_WORKER)
+    codes = launch_procs([sys.executable, str(script)], np=2,
+                         platform="cpu", env={"PYTHONPATH": REPO},
+                         start_timeout=240)
+    assert codes == [0, 0]

@@ -271,7 +271,10 @@ def test_tf_partial_tape_wraps_existing_tape(hvd_shutdown):
     assert all(run_ranks(fn))
 
 
-def test_tf_optimizer_rejects_graph_mode(hvd_shutdown):
+def test_tf_graph_mode_rejected_under_thread_launcher(hvd_shutdown):
+    """One shared TF runtime serializes py_function bodies, so the
+    traced path must refuse multi-rank THREAD mode with a clear error
+    (the process-per-rank path is covered in test_runner.py)."""
     def fn():
         v = tf.Variable([1.0])
         opt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(0.1))
@@ -280,7 +283,24 @@ def test_tf_optimizer_rejects_graph_mode(hvd_shutdown):
         def step():
             opt.apply_gradients([(tf.constant([1.0]), v)])
 
-        with pytest.raises(Exception, match="eagerly"):
+        with pytest.raises(Exception, match="one process per rank"):
+            step()
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_tf_optimizer_bpps_rejects_graph_mode(hvd_shutdown):
+    def fn():
+        v = tf.Variable([1.0])
+        opt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(0.1),
+                                       backward_passes_per_step=2)
+
+        @tf.function
+        def step():
+            opt.apply_gradients([(tf.constant([1.0]), v)])
+
+        with pytest.raises(Exception, match="eager"):
             step()
         return True
 

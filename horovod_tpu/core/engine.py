@@ -446,6 +446,8 @@ class Engine:
             "root": req.root_rank,
             "aux": {},
         }
+        if req.group_shapes is not None:
+            meta["gshapes"] = [list(s) for s in req.group_shapes]
         if req.request_type == RequestType.ALLGATHER:
             # per-local-rank first dims, ordered by global rank; the
             # coordinator merges them into the global dim0 table (the
@@ -661,11 +663,25 @@ class Engine:
                         f"Mismatched shapes for {first.tensor_name}: rank "
                         f"{sub.rank} sent {r.shape}, rank {subs[0].rank} "
                         f"sent {first.shape}")
+                if r.group_shapes != first.group_shapes:
+                    return TensorShapeMismatchError(
+                        f"Mismatched group member shapes for "
+                        f"{first.tensor_name}: rank {sub.rank} sent "
+                        f"{r.group_shapes}, rank {subs[0].rank} sent "
+                        f"{first.group_shapes}")
             elif rt in (RequestType.ALLGATHER, RequestType.ALLTOALL):
                 if tuple(r.shape[1:]) != tuple(first.shape[1:]):
                     return TensorShapeMismatchError(
                         f"Mismatched non-first dimensions for "
                         f"{first.tensor_name}")
+                gs_a = r.group_shapes or ()
+                gs_b = first.group_shapes or ()
+                if len(gs_a) != len(gs_b) or any(
+                        tuple(a[1:]) != tuple(b[1:])
+                        for a, b in zip(gs_a, gs_b)):
+                    return TensorShapeMismatchError(
+                        f"Mismatched group member non-first dimensions "
+                        f"for {first.tensor_name}")
             if rt == RequestType.ALLTOALL:
                 if r.splits is None or len(r.splits) != ps.size:
                     return TensorShapeMismatchError(

@@ -79,6 +79,10 @@ class Request:
     group_id: int = -1                   # grouped-op negotiation unit
     process_set_id: int = 0
     splits: Optional[Tuple[int, ...]] = None  # alltoall send splits
+    # grouped submissions: shape of EVERY member tensor, so cross-rank
+    # validation covers members beyond the first (the reference issues
+    # one Request per member inside the group instead)
+    group_shapes: Optional[Tuple[Tuple[int, ...], ...]] = None
 
     def to_dict(self):
         return {

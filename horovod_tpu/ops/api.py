@@ -77,6 +77,17 @@ def _submit(request, payloads, names):
     return eng.submit(sub)
 
 
+def _check_scale(dtype, prescale_factor, postscale_factor):
+    """Scale factors only apply on the float path (xla_ops applies
+    them inside the compiled program when _is_float); reject integer
+    tensors instead of silently ignoring the factors."""
+    if not (np.issubdtype(np.dtype(dtype), np.floating)
+            or str(dtype) == "bfloat16") \
+            and (prescale_factor != 1.0 or postscale_factor != 1.0):
+        raise ValueError("prescale/postscale require floating-point "
+                         "tensors")
+
+
 # ----------------------------------------------------------------------------
 # allreduce
 
@@ -86,9 +97,7 @@ def allreduce_async(tensor, average=None, name=None, op=None,
     arr, kind = util.to_numpy(tensor)
     ctx = basics.context()
     op = _resolve_op(op, average, arr.dtype)
-    if not (np.issubdtype(arr.dtype, np.floating) or str(arr.dtype) == "bfloat16") \
-            and (prescale_factor != 1.0 or postscale_factor != 1.0):
-        raise ValueError("prescale/postscale require floating-point tensors")
+    _check_scale(arr.dtype, prescale_factor, postscale_factor)
     name = name or ctx.next_name("allreduce")
     req = Request(
         request_type=RequestType.ALLREDUCE, tensor_name=name, rank=ctx.rank,
@@ -143,6 +152,7 @@ def grouped_allreduce_async(tensors, average=None, name=None, op=None,
             f"grouped_allreduce requires matching dtypes, got {dtypes}")
     ctx = basics.context()
     op = _resolve_op(op, average, arrs[0].dtype)
+    _check_scale(arrs[0].dtype, prescale_factor, postscale_factor)
     base = name or ctx.next_name("grouped_allreduce")
     names = [f"{base}.{i}" for i in range(len(arrs))]
     req = Request(
@@ -150,7 +160,8 @@ def grouped_allreduce_async(tensors, average=None, name=None, op=None,
         dtype=normalize_dtype(arrs[0].dtype),
         shape=tuple(arrs[0].shape), reduce_op=op,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
-        process_set_id=_ps_id(process_set), group_id=0)
+        process_set_id=_ps_id(process_set), group_id=0,
+        group_shapes=tuple(tuple(a.shape) for a in arrs))
     h = _submit(req, arrs, names)
     h.kind = kinds
     h.grouped = True
@@ -219,7 +230,8 @@ def grouped_allgather_async(tensors, name=None,
     req = Request(
         request_type=RequestType.ALLGATHER, tensor_name=base, rank=ctx.rank,
         dtype=normalize_dtype(arrs[0].dtype), shape=tuple(arrs[0].shape),
-        process_set_id=_ps_id(process_set), group_id=0)
+        process_set_id=_ps_id(process_set), group_id=0,
+        group_shapes=tuple(tuple(a.shape) for a in arrs))
     h = _submit(req, arrs, names)
     h.kind = kinds
     h.grouped = True
@@ -309,6 +321,7 @@ def reducescatter_async(tensor, op=Average, name=None,
         raise ValueError("reducescatter requires a tensor with >=1 dim")
     ctx = basics.context()
     op = _resolve_op(op, None, arr.dtype)
+    _check_scale(arr.dtype, prescale_factor, postscale_factor)
     name = name or ctx.next_name("reducescatter")
     req = Request(
         request_type=RequestType.REDUCESCATTER, tensor_name=name,
@@ -347,6 +360,7 @@ def grouped_reducescatter_async(tensors, op=Average, name=None,
             f"grouped_reducescatter requires matching dtypes, got {dtypes}")
     ctx = basics.context()
     op = _resolve_op(op, None, arrs[0].dtype)
+    _check_scale(arrs[0].dtype, prescale_factor, postscale_factor)
     base = name or ctx.next_name("grouped_reducescatter")
     names = [f"{base}.{i}" for i in range(len(arrs))]
     req = Request(
@@ -354,7 +368,8 @@ def grouped_reducescatter_async(tensors, op=Average, name=None,
         rank=ctx.rank, dtype=normalize_dtype(arrs[0].dtype),
         shape=tuple(arrs[0].shape), reduce_op=op,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
-        process_set_id=_ps_id(process_set), group_id=0)
+        process_set_id=_ps_id(process_set), group_id=0,
+        group_shapes=tuple(tuple(a.shape) for a in arrs))
     h = _submit(req, arrs, names)
     h.kind = kinds
     h.grouped = True

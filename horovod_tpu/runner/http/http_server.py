@@ -339,8 +339,19 @@ class Coordinator:
                 if m.get("shape") != first.get("shape"):
                     return (f"Mismatched shapes for {key}: "
                             f"{m.get('shape')} vs {first.get('shape')}")
-            elif m.get("shape", [])[1:] != first.get("shape", [])[1:]:
-                return (f"Mismatched non-first dimensions for {key}")
+                if m.get("gshapes") != first.get("gshapes"):
+                    return (f"Mismatched group member shapes for {key}: "
+                            f"{m.get('gshapes')} vs "
+                            f"{first.get('gshapes')}")
+            else:
+                if m.get("shape", [])[1:] != first.get("shape", [])[1:]:
+                    return f"Mismatched non-first dimensions for {key}"
+                gs_a = m.get("gshapes") or []
+                gs_b = first.get("gshapes") or []
+                if len(gs_a) != len(gs_b) or any(
+                        a[1:] != b[1:] for a, b in zip(gs_a, gs_b)):
+                    return (f"Mismatched group member non-first "
+                            f"dimensions for {key}")
         return None
 
     def _on_join(self, req):

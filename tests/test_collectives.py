@@ -501,3 +501,92 @@ def test_grouped_reducescatter_joint(hvd_shutdown):
         return True
 
     assert all(run_ranks(fn))
+
+
+def test_reducescatter_prescale_postscale(hvd_shutdown):
+    def fn():
+        x = np.ones((8, 2), np.float32) * 2.0
+        out = hvd.reducescatter(x, op=hvd.Sum, prescale_factor=0.5,
+                                postscale_factor=3.0)
+        # 8 ranks x (2 * 0.5) summed, then x3
+        assert np.allclose(out, 8 * 1.0 * 3.0), out
+        return True
+
+    assert all(run_ranks(fn))
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64, np.int32])
+def test_reducescatter_dtype_matrix(dtype, hvd_shutdown):
+    def fn():
+        x = (np.arange(16, dtype=dtype).reshape(8, 2) %
+             np.asarray(5, dtype)).astype(dtype)
+        out = hvd.reducescatter(x, op=hvd.Sum)
+        pos = hvd.rank()
+        expected = (np.arange(16).reshape(8, 2) % 5)[pos:pos + 1] * 8
+        assert out.dtype == dtype
+        assert np.allclose(out, expected.astype(dtype)), out
+        return True
+
+    assert all(run_ranks(fn))
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.int64, np.uint8])
+def test_alltoall_dtype_matrix(dtype, hvd_shutdown):
+    def fn():
+        r = hvd.rank()
+        x = np.full((8, 3), r, dtype=dtype)
+        out, recv = hvd.alltoall(x)
+        expected = np.repeat(np.arange(8, dtype=dtype), 1)[:, None] * \
+            np.ones((1, 3), dtype)
+        assert out.dtype == dtype
+        assert np.array_equal(out, expected.astype(dtype)), out
+        assert list(recv) == [1] * 8
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_grouped_allreduce_prescale(hvd_shutdown):
+    def fn():
+        outs = hvd.grouped_allreduce(
+            [np.ones(4, np.float32), np.ones(2, np.float32) * 2],
+            op=hvd.Sum, prescale_factor=0.25)
+        assert np.allclose(outs[0], 8 * 0.25)
+        assert np.allclose(outs[1], 8 * 2 * 0.25)
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_grouped_reducescatter_int_prescale_rejected(hvd_shutdown):
+    def fn():
+        with pytest.raises(ValueError, match="floating-point"):
+            hvd.grouped_reducescatter([np.ones(8, np.int32)], op=hvd.Sum,
+                                      prescale_factor=0.5)
+        with pytest.raises(ValueError, match="floating-point"):
+            hvd.reducescatter(np.ones(8, np.int32), op=hvd.Sum,
+                              postscale_factor=2.0)
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_grouped_member_shape_mismatch_raises(hvd_shutdown):
+    """Shapes of group members BEYOND the first must be validated
+    across ranks (the joint Request carries every member's shape)."""
+    def fn():
+        r = hvd.rank()
+        second = np.ones((16, 2) if r != 1 else (12, 2), np.float32)
+        with pytest.raises(Exception, match="[Mm]ismatch"):
+            hvd.grouped_reducescatter(
+                [np.ones((8, 3), np.float32), second], op=hvd.Sum,
+                name="mismatch_grs")
+        # allreduce groups validate member shapes exactly, too
+        second = np.ones(4 if r != 2 else 5, np.float32)
+        with pytest.raises(Exception, match="[Mm]ismatch"):
+            hvd.grouped_allreduce(
+                [np.ones(3, np.float32), second], op=hvd.Sum,
+                name="mismatch_gar")
+        return True
+
+    assert all(run_ranks(fn))

@@ -272,3 +272,70 @@ def test_run_elastic_fn_ships_function(tmp_path):
     content = log.read_text()
     assert "size 2" in content, content
     assert "sum 2.0" in content, content
+
+
+@pytest.mark.integration
+def test_elastic_scale_down(tmp_path):
+    """Start at two hosts; discovery drops one after progress; workers
+    re-form at size 1 and finish (reference elastic_common.py
+    hosts-removed scenario)."""
+    log = tmp_path / "log.txt"
+    log.write_text("")
+    worker = tmp_path / "worker.py"
+    worker.write_text(textwrap.dedent("""
+        import os
+        import numpy as np
+        import horovod_tpu as hvd
+        import horovod_tpu.elastic as elastic
+
+        LOG = os.environ["HVD_TEST_LOG"]
+        hvd.init()
+
+        def log(msg):
+            with open(LOG, "a") as f:
+                f.write(msg + "\\n")
+
+        state = elastic.ObjectState(
+            bcast_object=hvd.broadcast_object, get_rank=hvd.rank,
+            batch=0, at_small=0)
+
+        @elastic.run
+        def train(state):
+            while True:
+                hvd.allreduce(np.ones(2, np.float32),
+                              name=f"b{state.batch}")
+                log(f"batch {state.batch} rank {hvd.rank()} "
+                    f"size {hvd.size()}")
+                state.batch += 1
+                if hvd.size() == 1:
+                    state.at_small += 1
+                if state.at_small >= 3:
+                    return
+                state.commit()
+
+        train(state)
+        log(f"done rank {hvd.rank()} size {hvd.size()}")
+    """))
+    disc = tmp_path / "discover.sh"
+    disc.write_text(textwrap.dedent(f"""\
+        #!/bin/bash
+        echo "localhost:1"
+        if ! grep -q "batch 2" {log} 2>/dev/null; then
+            echo "127.0.0.1:1"
+        fi
+    """))
+    disc.chmod(disc.stat().st_mode | stat.S_IEXEC)
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "-np", "2", "--min-np", "1", "--max-np", "2", "--cpu",
+         "--host-discovery-script", str(disc),
+         "--start-timeout", "240",
+         "--", sys.executable, str(worker)],
+        env={**os.environ, "PYTHONPATH": REPO,
+             "HVD_TEST_LOG": str(log)},
+        capture_output=True, text=True, timeout=300)
+    content = log.read_text()
+    assert proc.returncode == 0, (proc.stderr[-3000:], content)
+    assert "size 2" in content, content      # ran at 2 first
+    assert "done rank 0 size 1" in content, content

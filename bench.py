@@ -60,13 +60,17 @@ def main():
     for _ in range(WARMUP):
         params, batch_stats, opt_state, loss = train_step(
             params, batch_stats, opt_state, images, labels)
-    jax.block_until_ready(loss)
+    # value-forcing sync: fetching the final loss waits for the whole
+    # dependency chain.  (Empirically the experimental 'axon' tunnel
+    # backend returns early from block_until_ready — a 10-step chain
+    # "completed" in 1.3 ms — so benches here sync by fetching values.)
+    float(loss)
 
     t0 = time.perf_counter()
     for _ in range(ITERS):
         params, batch_stats, opt_state, loss = train_step(
             params, batch_stats, opt_state, images, labels)
-    jax.block_until_ready(loss)
+    float(loss)
     dt = time.perf_counter() - t0
 
     img_per_sec = BATCH * ITERS / dt

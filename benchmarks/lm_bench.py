@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Transformer-LM training throughput: pallas flash attention vs the
+XLA dense path on one chip.
+
+The reference has no long-context subsystem (SURVEY §5.7); this bench
+records the beyond-parity numbers for ours: tokens/sec of the full
+train step (fwd+bwd+adamw) at growing sequence lengths, with
+``attention_impl="flash"`` (ops/pallas_kernels.py custom-VJP kernel,
+O(S) memory) against the dense S^2 softmax.
+
+    python benchmarks/lm_bench.py                 # real chip
+    python benchmarks/lm_bench.py --seq 4096 --iters 10
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def bench_impl(impl, cfg, tokens, mesh, iters, warmup):
+    from horovod_tpu.parallel import make_lm_train_step
+
+    init, _, jit_step, tok_shd = make_lm_train_step(
+        mesh, cfg, optimizer=optax.adamw(1e-3), attention_impl=impl)
+    if iters < 1 or warmup < 1:
+        raise ValueError("--iters and --warmup must be >= 1")
+    state = init(jax.random.PRNGKey(0), tokens)
+    compiled, state = jit_step(state)
+    toks = jax.device_put(tokens, tok_shd)
+    for _ in range(warmup):
+        state, loss = compiled(state, toks)
+    float(loss)   # value-forcing sync: waits for the whole chain
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = compiled(state, toks)
+    lv = float(loss)
+    dt = time.perf_counter() - t0
+    return tokens.size * iters / dt, lv
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--seq", type=int, default=2048)
+    p.add_argument("--d-model", type=int, default=512)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--impls", default="flash,dense")
+    p.add_argument("--remat", action="store_true",
+                   help="jax.checkpoint each block (required for long "
+                        "sequences on one 16G chip)")
+    args = p.parse_args()
+
+    from horovod_tpu.models import TransformerConfig
+    from horovod_tpu.parallel import MeshSpec, build_mesh
+
+    cfg = TransformerConfig(
+        vocab_size=32000, d_model=args.d_model, n_layers=args.layers,
+        n_heads=args.heads, d_ff=4 * args.d_model,
+        max_seq_len=args.seq, dtype=jnp.bfloat16, remat=args.remat)
+    mesh = build_mesh(MeshSpec(dp=1), jax.devices()[:1])
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (args.batch, args.seq), 0, cfg.vocab_size)
+
+    out = {"batch": args.batch, "seq": args.seq,
+           "d_model": args.d_model, "layers": args.layers}
+    for impl in args.impls.split(","):
+        impl = impl.strip()
+        # "dense" = the default XLA S^2 softmax path ("ring" without
+        # sequence_parallel is the single-shard dense fallback)
+        tps, loss = bench_impl("ring" if impl == "dense" else impl,
+                               cfg, tokens, mesh, args.iters,
+                               args.warmup)
+        out[f"{impl}_tokens_per_sec"] = round(tps, 1)
+        out[f"{impl}_loss"] = round(loss, 4)
+    if "flash_tokens_per_sec" in out and "dense_tokens_per_sec" in out:
+        out["flash_speedup"] = round(
+            out["flash_tokens_per_sec"] / out["dense_tokens_per_sec"], 3)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

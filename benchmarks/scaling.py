@@ -102,11 +102,12 @@ def main(argv=None):
             tok = jax.device_put(tokens, tok_shd)
             for _ in range(args.warmup):
                 state, loss = compiled(state, tok)
-            jax.block_until_ready(loss)
+            float(loss)   # value-forcing sync (axon's
+                          # block_until_ready can return early)
             t0 = time.perf_counter()
             for _ in range(args.iters):
                 state, loss = compiled(state, tok)
-            jax.block_until_ready(loss)
+            float(loss)
             dt = time.perf_counter() - t0
             return bpd * n * args.iters / dt      # sequences/sec
     else:
@@ -156,11 +157,12 @@ def main(argv=None):
             lbl = jax.device_put(labels, shd)
             for _ in range(args.warmup):
                 state, loss = compiled(state, img, lbl)
-            jax.block_until_ready(loss)
+            float(loss)   # value-forcing sync (axon's
+                          # block_until_ready can return early)
             t0 = time.perf_counter()
             for _ in range(args.iters):
                 state, loss = compiled(state, img, lbl)
-            jax.block_until_ready(loss)
+            float(loss)
             dt = time.perf_counter() - t0
             return bpd * n * args.iters / dt      # images/sec
 

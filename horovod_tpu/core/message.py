@@ -98,6 +98,8 @@ class Request:
             "g": self.group_id,
             "ps": self.process_set_id,
             "sp": list(self.splits) if self.splits is not None else None,
+            "gs": [list(s) for s in self.group_shapes]
+            if self.group_shapes is not None else None,
         }
 
     @classmethod
@@ -115,6 +117,8 @@ class Request:
             group_id=d["g"],
             process_set_id=d["ps"],
             splits=tuple(d["sp"]) if d["sp"] is not None else None,
+            group_shapes=tuple(tuple(s) for s in d["gs"])
+            if d.get("gs") is not None else None,
         )
 
 

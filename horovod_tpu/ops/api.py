@@ -224,6 +224,12 @@ def grouped_allgather_async(tensors, name=None,
     pairs = [util.to_numpy(t) for t in tensors]
     arrs = [p[0].reshape(1) if p[0].ndim == 0 else p[0] for p in pairs]
     kinds = [p[1] for p in pairs]
+    dtypes = {normalize_dtype(a.dtype) for a in arrs}
+    if len(dtypes) > 1:
+        # the joint Request carries one dtype; mixed members would
+        # concatenate mismatched bytes instead of erroring cleanly
+        raise ValueError(
+            f"grouped_allgather requires matching dtypes, got {dtypes}")
     ctx = basics.context()
     base = name or ctx.next_name("grouped_allgather")
     names = [f"{base}.{i}" for i in range(len(arrs))]

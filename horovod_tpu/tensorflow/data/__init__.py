@@ -1,0 +1,15 @@
+"""TF-named aliases for the data compute service (reference
+``horovod/tensorflow/data/compute_service.py``: TfDataServiceConfig,
+tf_data_service).  The service itself is framework-neutral
+(``horovod_tpu.data.service``): compute workers serve pickled batches
+over the HMAC-HTTP fabric and each training rank consumes a disjoint
+round-robin shard of workers — the same split-dispatcher contract the
+reference builds on tf.data service dispatchers/workers."""
+
+from ...data.service import (  # noqa: F401
+    DataServiceConfig, DataServiceServer, data_service,
+)
+
+# reference names, so ported scripts keep working verbatim
+TfDataServiceConfig = DataServiceConfig
+tf_data_service = data_service

@@ -371,8 +371,12 @@ def sparse_allreduce_async(tensor, name, op,
             return torch.sparse_coo_tensor(
                 torch.zeros((t.sparse_dim(), 0), dtype=torch.long),
                 torch.zeros((0, *t.shape[t.sparse_dim():]),
-                            dtype=t.dtype), t.size())
-        return torch.sparse_coo_tensor(indices.transpose(0, 1), values,
-                                       t.size())
+                            dtype=t.dtype), t.size(),
+                check_invariants=False)
+        # coalesce sums entries that several ranks contributed for the
+        # same index — the sparse equivalent of the dense reduction
+        return torch.sparse_coo_tensor(
+            indices.transpose(0, 1), values, t.size(),
+            check_invariants=False).coalesce()
 
     return handle

@@ -66,3 +66,31 @@ def test_size_one_allreduce_identity(hvd_shutdown):
     np.testing.assert_array_equal(out, x)
     out = hvd.allreduce(x, op=hvd.Average)
     np.testing.assert_allclose(out, x)
+
+
+def test_request_roundtrip_group_shapes():
+    from horovod_tpu.core.message import Request, RequestType, ReduceOp
+    req = Request(request_type=RequestType.REDUCESCATTER,
+                  tensor_name="g", dtype="float32", shape=(8, 3),
+                  reduce_op=ReduceOp.SUM, group_id=0,
+                  group_shapes=((8, 3), (16, 2)))
+    back = Request.from_dict(req.to_dict())
+    assert back.group_shapes == ((8, 3), (16, 2))
+    # absent field stays None (older wire dicts)
+    d = req.to_dict()
+    del d["gs"]
+    assert Request.from_dict(d).group_shapes is None
+
+
+def test_grouped_allgather_mixed_dtypes_rejected(hvd_shutdown):
+    import numpy as np
+
+    def fn():
+        import horovod_tpu as hvd
+        with pytest.raises(ValueError, match="matching dtypes"):
+            hvd.grouped_allgather([np.ones(3, np.float32),
+                                   np.ones(3, np.int32)])
+        return True
+
+    import horovod_tpu as hvd
+    assert all(hvd.run(fn, np=2))

@@ -34,7 +34,7 @@ def parse_args(argv=None):
                    help="use N virtual CPU devices instead of real chips")
     p.add_argument("--model",
                    choices=("transformer", "resnet", "resnet101",
-                            "vgg16", "inception3"),
+                            "vgg16", "inception3", "vit_b16"),
                    default="transformer")
     p.add_argument("--batch-per-device", type=int, default=0,
                    help="per-device batch (default: model-specific)")
@@ -112,17 +112,24 @@ def main(argv=None):
             return bpd * n * args.iters / dt      # sequences/sec
     else:
         from horovod_tpu.models import (
-            InceptionV3, ResNet50, ResNet101, VGG16,
+            InceptionV3, ResNet50, ResNet101, VGG16, ViT_B16,
         )
         factory = {"resnet": ResNet50, "resnet101": ResNet101,
-                   "vgg16": VGG16, "inception3": InceptionV3}[args.model]
+                   "vgg16": VGG16, "inception3": InceptionV3,
+                   "vit_b16": ViT_B16}[args.model]
         bpd = args.batch_per_device or (8 if on_cpu else 128)
-        model = factory(num_classes=100 if on_cpu else 1000)
+        factory_kwargs = {}
         if args.model == "inception3":
             # the stem's VALID convs need >= ~75px to survive
             img_size = 96 if on_cpu else 299
+        elif args.model == "vit_b16":
+            img_size = 96 if on_cpu else 224   # multiple of patch 16
+            # pos embeddings are sized from the configured image size
+            factory_kwargs["image_size"] = img_size
         else:
             img_size = 64 if on_cpu else 224
+        model = factory(num_classes=100 if on_cpu else 1000,
+                        **factory_kwargs)
 
         def run_one(n):
             mesh = build_mesh(MeshSpec(dp=n), devices[:n])
@@ -145,7 +152,8 @@ def main(argv=None):
                 return model.apply(vars_, batch, train=False)
 
             state = {"params": variables["params"],
-                     "extra": {"batch_stats": variables["batch_stats"]},
+                     "extra": {"batch_stats":
+                               variables.get("batch_stats", {})},
                      "opt_state": optax.sgd(0.1).init(variables["params"]),
                      "step": jnp.zeros((), jnp.int32)}
             _, jit_step = make_dp_train_step(

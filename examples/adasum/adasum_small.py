@@ -3,6 +3,14 @@ adasum_bench.ipynb: compare op=Adasum against op=Average on simple
 gradients — Adasum's scale-invariant combine keeps the update useful
 when per-rank gradients disagree)."""
 
+import os as _os
+import sys as _sys
+
+# allow running straight from a source checkout
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.dirname(_os.path.abspath(__file__)))))
+
+
 import numpy as np
 
 import horovod_tpu as hvd

@@ -4,6 +4,14 @@ dispatcher + compute workers feed training ranks).  Here two compute
 workers run the (synthetic) pipeline; the training loop consumes
 batches without doing any input work itself."""
 
+import os as _os
+import sys as _sys
+
+# allow running straight from a source checkout
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.dirname(_os.path.abspath(__file__)))))
+
+
 import numpy as np
 
 from horovod_tpu.data import DataServiceServer, data_service

@@ -4,6 +4,14 @@ state commit/restore/sync with TorchState; run under
       --host-discovery-script ./discover.sh --cpu -- python this_file.py
 """
 
+import os as _os
+import sys as _sys
+
+# allow running straight from a source checkout
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.dirname(_os.path.abspath(__file__)))))
+
+
 import torch
 import torch.nn.functional as F
 

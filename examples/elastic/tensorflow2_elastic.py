@@ -9,6 +9,14 @@ Run:
         python examples/elastic/tensorflow2_elastic.py
 """
 
+import os as _os
+import sys as _sys
+
+# allow running straight from a source checkout
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.dirname(_os.path.abspath(__file__)))))
+
+
 import numpy as np
 import tensorflow as tf
 

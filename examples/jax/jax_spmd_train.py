@@ -5,6 +5,14 @@ attention for long sequences.
   python examples/jax/jax_spmd_train.py --dp 2 --sp 2 --tp 2
 """
 
+import os as _os
+import sys as _sys
+
+# allow running straight from a source checkout
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.dirname(_os.path.abspath(__file__)))))
+
+
 import argparse
 
 import jax

@@ -4,6 +4,14 @@
 optimizer, scale the LR by size, broadcast initial state from rank 0,
 average metrics; synthetic data keeps it network-free)."""
 
+import os as _os
+import sys as _sys
+
+# allow running straight from a source checkout
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.dirname(_os.path.abspath(__file__)))))
+
+
 import argparse
 
 import numpy as np

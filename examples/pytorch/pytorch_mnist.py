@@ -3,6 +3,14 @@
 scaled by size + broadcast of params/optimizer state + per-rank data
 sharding; synthetic data keeps it network-free)."""
 
+import os as _os
+import sys as _sys
+
+# allow running straight from a source checkout
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.dirname(_os.path.abspath(__file__)))))
+
+
 import argparse
 
 import numpy as np

@@ -8,6 +8,14 @@ Run multi-proc:   python -m horovod_tpu.runner.launch -np 4 --cpu -- \
                       python examples/pytorch/pytorch_synthetic_benchmark.py
 """
 
+import os as _os
+import sys as _sys
+
+# allow running straight from a source checkout
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.dirname(_os.path.abspath(__file__)))))
+
+
 import argparse
 import timeit
 

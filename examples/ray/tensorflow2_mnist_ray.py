@@ -7,6 +7,14 @@ Requires ray:  pip install ray  (gated out of this image's tests).
     python examples/ray/tensorflow2_mnist_ray.py
 """
 
+import os as _os
+import sys as _sys
+
+# allow running straight from a source checkout
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.dirname(_os.path.abspath(__file__)))))
+
+
 import argparse
 
 

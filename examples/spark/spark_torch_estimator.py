@@ -4,6 +4,14 @@ with a Store, fit, transform.  With pyspark installed, ``est.fit(df)``
 takes a DataFrame; this example uses the array path that works
 everywhere (it is the same training loop the DataFrame leg calls)."""
 
+import os as _os
+import sys as _sys
+
+# allow running straight from a source checkout
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.dirname(_os.path.abspath(__file__)))))
+
+
 import numpy as np
 import torch
 

@@ -2,6 +2,14 @@
 ``examples/tensorflow2/tensorflow2_mnist.py`` — the SURVEY §7 step-2
 minimum-slice workload; synthetic data keeps it network-free)."""
 
+import os as _os
+import sys as _sys
+
+# allow running straight from a source checkout
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.dirname(_os.path.abspath(__file__)))))
+
+
 import argparse
 
 import numpy as np

@@ -7,3 +7,4 @@ from .transformer import (  # noqa: F401
     TransformerConfig, TransformerLM, DecoderBlock, RMSNorm,
     dense_causal_attention, lm_loss,
 )
+from .vit import ViT, ViTConfig, ViT_B16, ViT_S16  # noqa: F401

@@ -1,0 +1,95 @@
+"""Vision Transformer (beyond the reference's benchmark trio).
+
+TPU-first ViT: patchify is one strided conv (lowered to a single MXU
+matmul over flattened patches), everything after is the bidirectional
+transformer encoder — large batched matmuls in bf16 with f32 params,
+no data-dependent control flow. Canonical variants at standard sizes
+(ViT-B/16 = 86M params) so the scaling harness can use them like the
+reference trio.
+"""
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def n_patches(self):
+        return (self.image_size // self.patch_size) ** 2
+
+
+class EncoderBlock(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cfg = self.cfg
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x)
+        h = nn.MultiHeadDotProductAttention(
+            num_heads=cfg.n_heads, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name="attn")(h, h)
+        x = x + h
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x)
+        h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, param_dtype=jnp.float32,
+                     name="fc1")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.d_model, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="fc2")(h)
+        return x + h
+
+
+class ViT(nn.Module):
+    """Classifier over images (B, H, W, 3) -> logits (B, classes)."""
+
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, images, train: bool = False):
+        cfg = self.cfg
+        x = images.astype(cfg.dtype)
+        # patchify: one strided conv == matmul over flattened patches
+        x = nn.Conv(cfg.d_model,
+                    kernel_size=(cfg.patch_size, cfg.patch_size),
+                    strides=(cfg.patch_size, cfg.patch_size),
+                    dtype=cfg.dtype, param_dtype=jnp.float32,
+                    name="patch_embed")(x)
+        B = x.shape[0]
+        x = x.reshape(B, -1, cfg.d_model)
+        cls = self.param("cls", nn.initializers.zeros,
+                         (1, 1, cfg.d_model), jnp.float32)
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (B, 1, cfg.d_model)).astype(cfg.dtype),
+             x], axis=1)
+        pos = self.param("pos_embed",
+                         nn.initializers.normal(stddev=0.02),
+                         (1, cfg.n_patches + 1, cfg.d_model), jnp.float32)
+        x = x + pos.astype(cfg.dtype)
+        for i in range(cfg.n_layers):
+            x = EncoderBlock(cfg, name=f"block_{i}")(x, train=train)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32,
+                        param_dtype=jnp.float32, name="head")(x[:, 0])
+
+
+def ViT_B16(num_classes: int = 1000, image_size: int = 224) -> ViT:
+    """ViT-Base/16 (86M params at 1000 classes)."""
+    return ViT(ViTConfig(image_size=image_size, num_classes=num_classes))
+
+
+def ViT_S16(num_classes: int = 1000, image_size: int = 224) -> ViT:
+    """ViT-Small/16 (22M params)."""
+    return ViT(ViTConfig(image_size=image_size, d_model=384, n_layers=12,
+                         n_heads=6, d_ff=1536, num_classes=num_classes))

@@ -149,3 +149,48 @@ def test_attention_offset_matches_full():
     half = dense_causal_attention(q[:, 8:], k, v, offset=8)
     np.testing.assert_allclose(np.asarray(full[:, 8:]), np.asarray(half),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_vit_b16_param_count_and_forward():
+    from horovod_tpu.models import ViT_B16
+    model = ViT_B16(num_classes=1000)
+    x = jnp.zeros((1, 224, 224, 3), jnp.float32)
+    variables = jax.jit(lambda: model.init(jax.random.PRNGKey(0), x))()
+    n = sum(int(np.prod(p.shape)) for p in
+            jax.tree_util.tree_leaves(variables["params"]))
+    # canonical ViT-B/16: 86.6M params
+    assert 85e6 < n < 88e6, n
+    out = model.apply(variables, x)
+    assert out.shape == (1, 1000)
+    assert out.dtype == jnp.float32
+
+
+def test_vit_small_trains():
+    from horovod_tpu.models import ViT, ViTConfig
+    import optax
+    cfg = ViTConfig(image_size=32, patch_size=8, d_model=64, n_layers=2,
+                    n_heads=2, d_ff=128, num_classes=10,
+                    dtype=jnp.float32)
+    model = ViT(cfg)
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 32, 32, 3))
+    y = jax.random.randint(jax.random.PRNGKey(1), (4,), 0, 10)
+    params = model.init(jax.random.PRNGKey(2), x)["params"]
+    tx = optax.adam(1e-2)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, x, train=True)
+            logp = jax.nn.log_softmax(logits)
+            return -jnp.mean(jnp.take_along_axis(logp, y[:, None], -1))
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    first = None
+    for _ in range(10):
+        params, opt_state, loss = step(params, opt_state)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first, (first, float(loss))

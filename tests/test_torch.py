@@ -548,3 +548,34 @@ def test_torch_allgather_scalar_grad(hvd_shutdown):
         return True
 
     assert all(run_ranks(fn))
+
+
+def test_torch_optimizer_adasum(hvd_shutdown):
+    """op=Adasum trains through the engine's adasum reduction and all
+    ranks stay synced (reference _DistributedAdasumOptimizer role)."""
+    def fn():
+        torch.manual_seed(7)
+        model = torch.nn.Linear(4, 1, bias=False)
+        hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.05),
+            named_parameters=model.named_parameters(), op=hvd.Adasum)
+        gen = torch.Generator().manual_seed(hvd.rank())
+        x = torch.randn(8, 4, generator=gen)
+        y = x.sum(dim=1, keepdim=True)
+        first = None
+        for _ in range(10):
+            opt.zero_grad()
+            loss = torch.nn.functional.mse_loss(model(x), y)
+            loss.backward()
+            opt.step()
+            if first is None:
+                first = loss.item()
+        assert loss.item() < first          # converging
+        w = model.weight.detach().flatten()
+        gathered = hvd.allgather(w.reshape(1, -1))
+        assert torch.allclose(gathered, gathered[0].expand_as(gathered),
+                              atol=1e-6)
+        return True
+
+    assert all(run_ranks(fn))

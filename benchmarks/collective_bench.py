@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Eager collective micro-benchmark: allreduce goodput through the
+full engine path (submit -> negotiate -> fuse -> native pack ->
+compiled XLA collective -> unpack).
+
+This is the engine-side analogue of the reference's fusion argument
+(SURVEY §2.1 FusionBufferManager, §6): many small tensors submitted
+concurrently must approach the goodput of one large tensor.  Run
+single-rank on the real chip (measures staging + launch overhead —
+communication is identity) or multi-rank on the virtual CPU mesh.
+
+    python benchmarks/collective_bench.py                # 1 rank, chip
+    python benchmarks/collective_bench.py --np 4 --cpu   # 4 ranks, CPU
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def worker(sizes_mb, small_count, iters):
+    import numpy as np
+    import horovod_tpu as hvd
+
+    out = {}
+    # one large tensor per size: bytes/sec through the whole path
+    for mb in sizes_mb:
+        n = int(mb * (1 << 20) / 4)
+        x = np.ones(n, np.float32)
+        hvd.allreduce(x, op=hvd.Sum, name=f"warm{mb}")
+        t0 = time.perf_counter()
+        for i in range(iters):
+            hvd.allreduce(x, op=hvd.Sum, name=f"big{mb}.{i % 2}")
+        dt = time.perf_counter() - t0
+        out[f"allreduce_{mb}mb_MBps"] = round(
+            mb * iters / dt, 1)
+
+    # many small tensors submitted async then synchronized — the
+    # fusion path (DistributedOptimizer's shape of traffic)
+    small = [np.ones(64 * 1024 // 4, np.float32)  # 64 KiB each
+             for _ in range(small_count)]
+    handles = [hvd.allreduce_async(t, op=hvd.Sum, name=f"w.{j}")
+               for j, t in enumerate(small)]
+    for h in handles:
+        hvd.synchronize(h)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        handles = [hvd.allreduce_async(t, op=hvd.Sum,
+                                       name=f"s.{i % 2}.{j}")
+                   for j, t in enumerate(small)]
+        for h in handles:
+            hvd.synchronize(h)
+    dt = time.perf_counter() - t0
+    total_mb = small_count * 64 / 1024 * iters
+    out["fused_small_64k_MBps"] = round(total_mb / dt, 1)
+    out["small_count"] = small_count
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--np", type=int, default=1)
+    p.add_argument("--cpu", action="store_true")
+    p.add_argument("--sizes-mb", default="1,16,64")
+    p.add_argument("--small-count", type=int, default=64)
+    p.add_argument("--iters", type=int, default=5)
+    args = p.parse_args()
+
+    if args.cpu:
+        os.environ["HOROVOD_TPU_PLATFORM"] = "cpu"
+        import jax
+        jax.config.update("jax_num_cpu_devices", max(args.np, 2))
+
+    import horovod_tpu as hvd
+
+    sizes = [int(s) for s in args.sizes_mb.split(",")]
+    if args.np == 1:
+        hvd.init(num_ranks=1)
+        res = worker(sizes, args.small_count, args.iters)
+    else:
+        res = hvd.run(lambda: worker(sizes, args.small_count,
+                                     args.iters), np=args.np)[0]
+    res["np"] = args.np
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()

@@ -80,8 +80,13 @@ class ElasticDriver:
 
     # -- lifecycle -----------------------------------------------------------
 
-    def start(self):
-        self.wait_for_available_slots(self._min_np)
+    def start(self, start_timeout=None):
+        """``start_timeout`` bounds the wait for min_np slots (the
+        reference's --start-timeout semantics); it does NOT bound job
+        runtime."""
+        self.wait_for_available_slots(
+            self._min_np,
+            timeout=120 if start_timeout is None else start_timeout)
         self._start_round()
         self._discovery_thread.start()
         self._monitor_thread.start()
@@ -98,7 +103,9 @@ class ElasticDriver:
             f"timed out waiting for {min_np} slots to become available")
 
     def join(self, timeout=None) -> bool:
-        """Block until the job finishes; True on success."""
+        """Block until the job finishes; True on success.  ``timeout``
+        (if given) bounds total runtime — normal jobs pass None; the
+        startup wait is bounded separately in start()."""
         deadline = time.monotonic() + timeout if timeout else None
         while not self._shutdown.is_set():
             if deadline and time.monotonic() > deadline:
@@ -306,8 +313,11 @@ class ElasticDriver:
                 # _handle_worker_exit -> blacklist -> new assignments).
                 for host in failed_hosts:
                     self._host_manager.blacklist(host)
-                self._host_manager.update_available_hosts()
-                self._start_round()
+                if not self._registry.note_reset():
+                    self.stop(error=True)
+                else:
+                    self._host_manager.update_available_hosts()
+                    self._start_round()
             self._shutdown.wait(0.2)
 
     def _terminate_all(self):

@@ -95,11 +95,21 @@ class WorkerStateRegistry:
             return
         for host, slot in self.get(FAILURE):
             self._host_manager.blacklist(host)
-        if self._reset_limit is not None and \
-                self._reset_count >= self._reset_limit:
-            logger.error("reset limit %d reached; aborting job",
-                         self._reset_limit)
+        if not self.note_reset():
             self._driver.stop(error=True)
             return
-        self._reset_count += 1
         self._driver.resume()
+
+    def note_reset(self) -> bool:
+        """Count one round restart toward the reset limit.  Returns
+        False when the limit is exhausted — EVERY restart path must
+        consult this (the reference enforces reset_limit on each
+        re-rendezvous, driver-triggered or registry-triggered)."""
+        with self._lock:
+            if self._reset_limit is not None and \
+                    self._reset_count >= self._reset_limit:
+                logger.error("reset limit %d reached; aborting job",
+                             self._reset_limit)
+                return False
+            self._reset_count += 1
+            return True

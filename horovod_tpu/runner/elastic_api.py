@@ -80,10 +80,7 @@ def run_elastic_fn(fn, args=(), kwargs=None, *, discovery, min_np,
                                max_np=max_np or min_np, command=command,
                                env=dict(env or {}),
                                reset_limit=reset_limit, verbose=verbose)
-        if start_timeout:
-            driver.wait_for_available_slots(min_np,
-                                            timeout=start_timeout)
-        driver.start()
+        driver.start(start_timeout=start_timeout)
         ok = driver.join()
     finally:
         server.stop()

@@ -40,8 +40,14 @@ def run_elastic(args):
         cooldown_range=cooldown,
         platform="cpu" if args.cpu else None, verbose=args.verbose)
     try:
-        driver.start()
-        ok = driver.join(timeout=args.start_timeout)
+        # --start-timeout bounds waiting for min_np slots, NOT the job
+        # runtime (reference launch_gloo_elastic semantics)
+        driver.start(start_timeout=args.start_timeout)
+        ok = driver.join()
+    except TimeoutError as exc:
+        print(f"horovod_tpu elastic: {exc}", flush=True)
+        driver.stop(error=True)
+        return 1
     finally:
         server.stop()
     return 0 if ok else 1

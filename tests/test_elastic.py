@@ -90,6 +90,20 @@ def test_registry_mixed_failure_blacklists_and_resumes():
     assert mgr.is_blacklisted("b")
 
 
+def test_registry_note_reset_counts_every_restart_path():
+    """Failure-driven round restarts (driver monitor path) consume the
+    same reset budget as registry-driven ones — note_reset() returns
+    False once the limit is exhausted."""
+    driver = FakeDriver()
+    mgr = HostManager(FixedHosts({"a": 1, "b": 1}))
+    reg = WorkerStateRegistry(driver, mgr, reset_limit=2)
+    reg.reset(2)
+    assert reg.note_reset()          # restart 1
+    assert reg.note_reset()          # restart 2
+    assert not reg.note_reset()      # budget exhausted
+    assert not reg.note_reset()      # stays exhausted
+
+
 def test_registry_reset_limit():
     driver = FakeDriver()
     mgr = HostManager(FixedHosts({"a": 1, "b": 1}))
@@ -339,3 +353,76 @@ def test_elastic_scale_down(tmp_path):
     assert proc.returncode == 0, (proc.stderr[-3000:], content)
     assert "size 2" in content, content      # ran at 2 first
     assert "done rank 0 size 1" in content, content
+
+
+@pytest.mark.integration
+def test_elastic_min_np_timeout(tmp_path):
+    """Discovery never yields min_np slots: the launcher must exit
+    nonzero within the start timeout instead of waiting forever
+    (reference wait_for_available_slots timeout)."""
+    worker = tmp_path / "worker.py"
+    worker.write_text("print('should never run')\n")
+    disc = tmp_path / "discover.sh"
+    disc.write_text("#!/bin/bash\necho localhost:1\n")
+    disc.chmod(disc.stat().st_mode | stat.S_IEXEC)
+
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "-np", "3", "--min-np", "3", "--max-np", "4", "--cpu",
+         "--host-discovery-script", str(disc),
+         "--start-timeout", "10",
+         "--", sys.executable, str(worker)],
+        env={**os.environ, "PYTHONPATH": REPO},
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode != 0
+    assert time.monotonic() - t0 < 100
+
+
+@pytest.mark.integration
+def test_elastic_repeated_failures_abort(tmp_path):
+    """Workers die every round; the job must end with a nonzero exit
+    (all-failed terminal or reset-limit exhaustion — reference
+    fault-injection scenario) instead of restarting forever."""
+    log = tmp_path / "log.txt"
+    log.write_text("")
+    worker = tmp_path / "worker.py"
+    worker.write_text(textwrap.dedent("""
+        import os, sys
+        import numpy as np
+        import horovod_tpu as hvd
+        import horovod_tpu.elastic as elastic
+
+        LOG = os.environ["HVD_TEST_LOG"]
+        hvd.init()
+        with open(LOG, "a") as f:
+            f.write(f"start rank {hvd.rank()}\\n")
+
+        state = elastic.ObjectState(
+            bcast_object=hvd.broadcast_object, get_rank=hvd.rank,
+            batch=0)
+
+        @elastic.run
+        def train(state):
+            for b in range(3):
+                hvd.allreduce(np.ones(2, np.float32), name=f"b{b}")
+            # crash every time: the job can never finish
+            os._exit(23)
+
+        train(state)
+    """))
+    disc = tmp_path / "discover.sh"
+    disc.write_text("#!/bin/bash\necho localhost:1\necho 127.0.0.1:1\n")
+    disc.chmod(disc.stat().st_mode | stat.S_IEXEC)
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "-np", "2", "--min-np", "1", "--max-np", "2", "--cpu",
+         "--host-discovery-script", str(disc),
+         "--reset-limit", "2", "--start-timeout", "240",
+         "--", sys.executable, str(worker)],
+        env={**os.environ, "PYTHONPATH": REPO,
+             "HVD_TEST_LOG": str(log)},
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode != 0, proc.stdout[-500:]
+    assert "start rank" in log.read_text()

@@ -99,9 +99,10 @@ class RMSNorm(nn.Module):
 class Attention(nn.Module):
     cfg: TransformerConfig
     attention_fn: Callable = dense_causal_attention
+    decode: bool = False      # KV-cache autoregressive path
 
     @nn.compact
-    def __call__(self, x, angles):
+    def __call__(self, x, angles, offset=0):
         cfg = self.cfg
         H, D = cfg.n_heads, cfg.head_dim
         dense = lambda feats, name: nn.DenseGeneral(  # noqa: E731
@@ -112,7 +113,33 @@ class Attention(nn.Module):
         v = dense((H, D), "wv")(x)
         q = apply_rope(q, angles)
         k = apply_rope(k, angles)
-        o = self.attention_fn(q, k, v)
+        if self.decode:
+            if self.attention_fn is not dense_causal_attention:
+                # ring/ulysses/flash are training inner fns with their
+                # own sharding contracts; silently decoding dense would
+                # break them — fail loudly
+                raise ValueError(
+                    "KV-cache decoding supports the dense attention "
+                    "path only; build the model with the default "
+                    "attention_fn for generation")
+            # KV cache: write this chunk at [offset, offset+T) and
+            # attend over the full cache — rows past the write head are
+            # zeros and masked away by causality (offset may be traced)
+            B = x.shape[0]
+            ck = self.variable(
+                "cache", "k", jnp.zeros,
+                (B, cfg.max_seq_len, H, D), cfg.dtype)
+            cv = self.variable(
+                "cache", "v", jnp.zeros,
+                (B, cfg.max_seq_len, H, D), cfg.dtype)
+            ck.value = jax.lax.dynamic_update_slice_in_dim(
+                ck.value, k.astype(ck.value.dtype), offset, axis=1)
+            cv.value = jax.lax.dynamic_update_slice_in_dim(
+                cv.value, v.astype(cv.value.dtype), offset, axis=1)
+            o = dense_causal_attention(q, ck.value, cv.value,
+                                       offset=offset)
+        else:
+            o = self.attention_fn(q, k, v)
         return nn.DenseGeneral(cfg.d_model, axis=(-2, -1), use_bias=False,
                                dtype=cfg.dtype, param_dtype=jnp.float32,
                                name="wo")(o)
@@ -172,12 +199,14 @@ class MoE(nn.Module):
 class DecoderBlock(nn.Module):
     cfg: TransformerConfig
     attention_fn: Callable = dense_causal_attention
+    decode: bool = False
 
     @nn.compact
-    def __call__(self, x, angles):
+    def __call__(self, x, angles, offset=0):
         cfg = self.cfg
-        x = x + Attention(cfg, self.attention_fn, name="attn")(
-            RMSNorm(cfg.dtype, name="ln_attn")(x), angles)
+        x = x + Attention(cfg, self.attention_fn, self.decode,
+                          name="attn")(
+            RMSNorm(cfg.dtype, name="ln_attn")(x), angles, offset)
         mlp = MoE(cfg, name="moe") if cfg.num_experts else \
             SwiGLU(cfg, name="mlp")
         return x + mlp(RMSNorm(cfg.dtype, name="ln_mlp")(x)), None
@@ -190,7 +219,7 @@ class TransformerLM(nn.Module):
     attention_fn: Callable = dense_causal_attention
 
     @nn.compact
-    def __call__(self, tokens, *, seq_offset=0):
+    def __call__(self, tokens, *, seq_offset=0, decode=False):
         cfg = self.cfg
         emb = self.param("embed", nn.initializers.normal(0.02),
                          (cfg.vocab_size, cfg.d_model), jnp.float32)
@@ -206,16 +235,78 @@ class TransformerLM(nn.Module):
                              static_argnums=())
         stack = nn.scan(
             block,
-            variable_axes={"params": 0},
+            variable_axes={"params": 0, "cache": 0},
             split_rngs={"params": True},
             in_axes=nn.broadcast,
             length=cfg.n_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
-        )(cfg, self.attention_fn, name="layers")
-        x, _ = stack(x, angles)
+        )(cfg, self.attention_fn, decode, name="layers")
+        x, _ = stack(x, angles, seq_offset)
         x = RMSNorm(cfg.dtype, name="ln_final")(x)
         logits = jnp.einsum("bsm,vm->bsv", x.astype(jnp.float32), emb)
         return logits
+
+
+def make_generate_fn(model: "TransformerLM", *, max_new_tokens: int,
+                     temperature: float = 0.0):
+    """Autoregressive decoding with a KV cache (beyond reference —
+    the reference is training-only).  Returns
+    ``generate(params, prompt_tokens, rng=None) -> (B, max_new_tokens)``.
+
+    Two compiled programs: a prefill over the prompt (populates the
+    cache, one chunked attention) and a single-token step reused for
+    every position (offset is a traced scalar, so no retracing as the
+    sequence grows).  Static shapes throughout: the cache is sized to
+    ``cfg.max_seq_len`` up front.
+    """
+    cfg = model.cfg
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+
+    @jax.jit
+    def prefill(params, tokens):
+        logits, vars_ = model.apply(
+            {"params": params}, tokens, decode=True, mutable=["cache"])
+        return logits[:, -1], vars_["cache"]
+
+    from functools import partial
+
+    # donate the cache so each step updates it in place instead of
+    # copying the full (L, B, max_seq_len, H, D) buffers per token
+    @partial(jax.jit, donate_argnums=(1,))
+    def step(params, cache, tok, offset):
+        logits, vars_ = model.apply(
+            {"params": params, "cache": cache}, tok,
+            seq_offset=offset, decode=True, mutable=["cache"])
+        return logits[:, -1], vars_["cache"]
+
+    def pick(logits, rng):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1)
+        return jax.random.categorical(rng, logits / temperature, axis=-1)
+
+    def generate(params, prompt_tokens, rng=None):
+        if prompt_tokens.shape[1] + max_new_tokens > cfg.max_seq_len:
+            raise ValueError(
+                f"prompt ({prompt_tokens.shape[1]}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_seq_len "
+                f"{cfg.max_seq_len}")
+        if temperature != 0.0 and rng is None:
+            raise ValueError("sampling (temperature > 0) needs an rng")
+        logits, cache = prefill(params, prompt_tokens)
+        rngs = jax.random.split(rng, max_new_tokens) \
+            if rng is not None else [None] * max_new_tokens
+        tok = pick(logits, rngs[0])
+        out = [tok]
+        offset = jnp.asarray(prompt_tokens.shape[1], jnp.int32)
+        for i in range(1, max_new_tokens):
+            logits, cache = step(params, cache, tok[:, None], offset)
+            tok = pick(logits, rngs[i])
+            out.append(tok)
+            offset = offset + 1
+        return jnp.stack(out, axis=1)
+
+    return generate
 
 
 def lm_loss(logits, targets):

@@ -93,14 +93,18 @@ class ElasticDriver:
 
     def wait_for_available_slots(self, min_np, timeout=120):
         deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        while True:
+            # availability is checked at least once, so timeout=0 means
+            # "fail fast unless slots are ALREADY available"
             self._host_manager.update_available_hosts()
             if self._host_manager.current_hosts.count_available_slots() \
                     >= min_np:
                 return
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"timed out waiting for {min_np} slots to become "
+                    f"available")
             time.sleep(DISCOVER_HOSTS_FREQUENCY_SECS)
-        raise TimeoutError(
-            f"timed out waiting for {min_np} slots to become available")
 
     def join(self, timeout=None) -> bool:
         """Block until the job finishes; True on success.  ``timeout``
@@ -262,6 +266,7 @@ class ElasticDriver:
         while not self._shutdown.is_set():
             failed_hosts = []
             now = time.monotonic()
+            rid_before = self._registry.last_rendezvous()
             with self._lock:
                 # reap grace-expired de-assigned workers
                 for key, deadline in list(self._deassigned.items()):
@@ -304,13 +309,18 @@ class ElasticDriver:
                                        key, code)
                         self._registry.record_failure(host, int(slot))
                         failed_hosts.append(host)
-            if failed_hosts and not self._shutdown.is_set():
+            if failed_hosts and not self._shutdown.is_set() and \
+                    self._registry.last_rendezvous() == rid_before:
                 # a failure mid-run must not wait for survivors to
                 # reach a terminal state — they are likely blocked in a
                 # collective with the dead peer.  Blacklist and start a
                 # new round now; survivors get a stale-round error and
                 # re-rendezvous (reference driver.py:304-320
                 # _handle_worker_exit -> blacklist -> new assignments).
+                # (When record_failure completed the round, the registry
+                # already blacklisted / consumed one reset / resumed —
+                # last_rendezvous moved on, and burning a second reset
+                # here would double-count one failure event.)
                 for host in failed_hosts:
                     self._host_manager.blacklist(host)
                 if not self._registry.note_reset():

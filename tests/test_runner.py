@@ -315,6 +315,31 @@ FULL_MATRIX_WORKER = textwrap.dedent("""
     assert gouts[0].shape == (1, 3) and np.allclose(gouts[0], total)
     assert gouts[1].shape == (2, 2) and np.allclose(gouts[1], total)
 
+    # alltoall with uneven splits across processes
+    send = np.arange(3, dtype=np.float32).reshape(3, 1) + 10 * r
+    out, recv = hvd.alltoall(send, splits=[1, 2] if r == 0 else [2, 1],
+                             name="a2a")
+    if r == 0:
+        assert list(recv) == [1, 2]
+        assert np.allclose(out.ravel(), [0.0, 10.0, 11.0]), out
+    else:
+        assert list(recv) == [2, 1]
+        assert np.allclose(out.ravel(), [1.0, 2.0, 12.0]), out
+
+    # allgather with uneven first dims across processes
+    g = hvd.allgather(np.full((r + 1, 2), float(r), np.float32),
+                      name="ag")
+    assert g.shape == (3, 2) and np.allclose(g[0], 0.0) \
+        and np.allclose(g[1:], 1.0), g
+
+    # bfloat16 wire across processes (16-bit staging path); note
+    # ml_dtypes promotes bf16*int to f32, so cast explicitly
+    import ml_dtypes
+    hb = (np.ones(4, np.float32) * (r + 1)).astype(ml_dtypes.bfloat16)
+    hb_out = hvd.allreduce(hb, op=hvd.Sum, name="bf16")
+    assert hb_out.dtype == ml_dtypes.bfloat16
+    assert np.allclose(np.asarray(hb_out, np.float32), total), hb_out
+
     # broadcast with non-zero root
     b = hvd.broadcast(np.full(3, float(r), np.float32), root_rank=1,
                       name="bc")

@@ -317,3 +317,22 @@ def test_keras_broadcast_global_variables_raises_when_empty(hvd_shutdown):
         return True
 
     assert all(run_ranks(fn))
+
+
+def test_tf_tape_with_process_set(hvd_shutdown):
+    """DistributedGradientTape scoped to a subset averages only over
+    its members; other ranks train locally."""
+    def fn():
+        r = hvd.rank()
+        ps = hvd_core.add_process_set([1, 3])
+        if r in (1, 3):
+            w = tf.Variable([[1.0], [1.0]])
+            x = tf.constant([[float(r), 2.0 * r]])
+            with hvd.DistributedGradientTape(process_set=ps) as tape:
+                y = tf.reduce_sum(tf.matmul(x, w))
+            g = tape.gradient(y, [w])[0].numpy()
+            mean = np.mean([1.0, 3.0])
+            assert np.allclose(g.ravel(), [mean, 2 * mean]), g
+        return True
+
+    assert all(run_ranks(fn))

@@ -579,3 +579,51 @@ def test_torch_optimizer_adasum(hvd_shutdown):
         return True
 
     assert all(run_ranks(fn))
+
+
+def test_torch_process_set_collectives(hvd_shutdown):
+    """Collectives over a rank subset; excluded ranks are unaffected
+    (reference test_process_sets shape, torch frontend)."""
+    def fn():
+        r = hvd.rank()
+        evens = hvd_core.add_process_set([0, 2])
+        local = torch.ones(3) * (r + 1)       # excluded ranks' tensor
+        if r in (0, 2):
+            out = hvd.allreduce(local, op=hvd.Sum,
+                                process_set=evens, name="ps_ar")
+            assert torch.allclose(out, torch.full((3,), 4.0))
+            g = hvd.allgather(torch.ones(1, 2) * r, process_set=evens,
+                              name="ps_ag")
+            assert g.shape == (2, 2)
+        # excluded ranks' local data untouched by the subset collective
+        assert torch.allclose(local, torch.ones(3) * (r + 1))
+        # global collective still spans everyone afterwards
+        out = hvd.allreduce(torch.ones(2), op=hvd.Sum, name="ps_glob")
+        assert torch.allclose(out, torch.full((2,), float(NP)))
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_torch_optimizer_with_process_set(hvd_shutdown):
+    """DistributedOptimizer scoped to a process set averages only over
+    its members."""
+    def fn():
+        r = hvd.rank()
+        ps = hvd_core.add_process_set([0, 1])
+        if r in (0, 1):
+            model = torch.nn.Linear(2, 1, bias=False)
+            with torch.no_grad():
+                model.weight.fill_(0.0)
+            opt = hvd.DistributedOptimizer(
+                torch.optim.SGD(model.parameters(), lr=0.0),
+                named_parameters=model.named_parameters(),
+                process_set=ps)
+            model(torch.ones(1, 2) * (r + 1)).sum().backward()
+            opt.step()
+            expected = np.mean([1.0, 2.0])
+            assert np.allclose(model.weight.grad.numpy(), expected), \
+                model.weight.grad.numpy()
+        return True
+
+    assert all(run_ranks(fn))

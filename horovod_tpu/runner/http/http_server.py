@@ -546,6 +546,16 @@ class _ThreadingHTTPServer(socketserver.ThreadingMixIn,
     daemon_threads = True
     allow_reuse_address = True
 
+    def handle_error(self, request, client_address):
+        # keep-alive sockets torn down by exiting workers are routine,
+        # not server errors — don't spray tracebacks on every shutdown
+        import sys
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (ConnectionResetError, BrokenPipeError,
+                            ConnectionAbortedError, TimeoutError)):
+            return
+        super().handle_error(request, client_address)
+
 
 class RendezvousServer:
     """KV + coordinator HTTP service hosted by the launcher (reference

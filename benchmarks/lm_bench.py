@@ -60,6 +60,8 @@ def main():
     p.add_argument("--remat", action="store_true",
                    help="jax.checkpoint each block (required for long "
                         "sequences on one 16G chip)")
+    p.add_argument("--decode", action="store_true",
+                   help="also measure KV-cache generation tokens/sec")
     args = p.parse_args()
 
     from horovod_tpu.models import TransformerConfig
@@ -87,6 +89,27 @@ def main():
     if "flash_tokens_per_sec" in out and "dense_tokens_per_sec" in out:
         out["flash_speedup"] = round(
             out["flash_tokens_per_sec"] / out["dense_tokens_per_sec"], 3)
+
+    if args.decode and args.seq > 9:
+        from horovod_tpu.models import TransformerLM, make_generate_fn
+        model = TransformerLM(cfg)
+        params = model.init(jax.random.PRNGKey(9),
+                            tokens[:, :8])["params"]
+        new = min(128, args.seq - 8)
+        gen = make_generate_fn(model, max_new_tokens=new)
+        gen(params, tokens[:, :8])            # compile prefill + step
+        t0 = time.perf_counter()
+        res = gen(params, tokens[:, :8])
+        res.block_until_ready() if hasattr(res, "block_until_ready") \
+            else None
+        import numpy as _np
+        _np.asarray(res)                      # value-forcing sync
+        dt = time.perf_counter() - t0
+        out["decode_tokens_per_sec"] = round(
+            args.batch * new / dt, 1)
+        out["decode_new_tokens"] = new
+    elif args.decode:
+        out["decode_skipped"] = "seq too short for an 8-token prompt"
     print(json.dumps(out))
 
 

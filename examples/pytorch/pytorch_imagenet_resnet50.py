@@ -23,6 +23,7 @@ _sys.path.insert(0, _os.path.dirname(_os.path.dirname(
 import argparse
 import os
 
+import numpy as np
 import torch
 import torch.nn.functional as F
 import torch.utils.data.distributed
@@ -66,7 +67,7 @@ def make_model_and_data():
 
             def forward(self, x):
                 x = F.relu(self.stem(x))
-                x = F.relu(self.b1(x) + 0)
+                x = F.relu(self.b1(x))
                 x = F.relu(self.b2(x))
                 x = x.mean(dim=(2, 3))
                 return self.head(x)
@@ -75,7 +76,7 @@ def make_model_and_data():
         data = [(torch.randn(args.batch_size, 3, 64, 64),
                  torch.randint(0, 100, (args.batch_size,)))
                 for _ in range(args.steps_per_epoch)]
-        return model, data, data
+        return model, data, None
     try:
         import torchvision
         from torchvision import datasets, models, transforms
@@ -98,10 +99,23 @@ def make_model_and_data():
         train_ds, num_replicas=hvd.size(), rank=hvd.rank())
     loader = torch.utils.data.DataLoader(
         train_ds, batch_size=args.batch_size, sampler=sampler)
-    return model, loader, loader
+    val_loader = None
+    if args.val_dir:
+        tf_val = transforms.Compose([
+            transforms.Resize(256), transforms.CenterCrop(224),
+            transforms.ToTensor(),
+            transforms.Normalize((0.485, 0.456, 0.406),
+                                 (0.229, 0.224, 0.225)),
+        ])
+        val_ds = datasets.ImageFolder(args.val_dir, tf_val)
+        val_sampler = torch.utils.data.distributed.DistributedSampler(
+            val_ds, num_replicas=hvd.size(), rank=hvd.rank())
+        val_loader = torch.utils.data.DataLoader(
+            val_ds, batch_size=args.batch_size, sampler=val_sampler)
+    return model, loader, val_loader
 
 
-model, train_loader, _ = make_model_and_data()
+model, train_loader, val_loader = make_model_and_data()
 
 # scale lr by total batch parallelism; Adasum converges with the base lr
 lr_scaler = 1 if args.use_adasum else \
@@ -128,6 +142,24 @@ def save_checkpoint(epoch):
                    args.checkpoint_format.format(epoch=epoch))
 
 
+STEPS_PER_EPOCH = args.steps_per_epoch if args.synthetic else \
+    max(len(train_loader), 1)
+
+
+def adjust_learning_rate(epoch, step):
+    """Gradual lr warmup from base_lr to base_lr*scaler over
+    --warmup-epochs (reference example's adjust_learning_rate /
+    'ImageNet in 1 Hour' recipe), constant afterwards."""
+    progress = epoch + step / STEPS_PER_EPOCH
+    if progress < args.warmup_epochs:
+        factor = (1.0 + (lr_scaler - 1.0) *
+                  progress / args.warmup_epochs) / lr_scaler
+    else:
+        factor = 1.0
+    for group in optimizer.param_groups:
+        group["lr"] = args.base_lr * lr_scaler * factor
+
+
 for epoch in range(args.epochs):
     model.train()
     sampler = getattr(train_loader, "sampler", None)
@@ -137,10 +169,14 @@ for epoch in range(args.epochs):
         sampler.set_epoch(epoch)
     seen, loss_sum, pending = 0, 0.0, False
     for step, (data, target) in enumerate(train_loader):
+        adjust_learning_rate(epoch, step)
         if step % args.batches_per_allreduce == 0:
             optimizer.zero_grad()
         loss = F.cross_entropy(model(data), target)
-        loss.backward()
+        # accumulated micro-batches are summed by autograd: divide so
+        # the aggregate matches one full-batch gradient (the lr scaler
+        # already accounts for the larger effective batch)
+        (loss / args.batches_per_allreduce).backward()
         pending = True
         if (step + 1) % args.batches_per_allreduce == 0:
             optimizer.step()
@@ -152,13 +188,25 @@ for epoch in range(args.epochs):
         # accumulation so those samples still train
         optimizer.step()
     # averaged epoch metric across ranks (MetricAverageCallback role)
-    import numpy as np
     avg = hvd.allreduce(np.array([loss_sum / max(seen, 1)],
                                  np.float32), op=hvd.Average,
                         name=f"epoch_loss.{epoch}")
     if hvd.rank() == 0:
         print(f"epoch {epoch}: mean loss {float(avg[0]):.4f} "
               f"(size {hvd.size()})")
+    if val_loader is not None:
+        model.eval()
+        correct, count = 0, 0
+        with torch.no_grad():
+            for data, target in val_loader:
+                pred = model(data).argmax(dim=1)
+                correct += int((pred == target).sum())
+                count += target.size(0)
+        acc = hvd.allreduce(np.array([correct / max(count, 1)],
+                                     np.float32), op=hvd.Average,
+                            name=f"val_acc.{epoch}")
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: val accuracy {float(acc[0]):.4f}")
     save_checkpoint(epoch)
 
 if args.checkpoint_format.startswith("checkpoint-") and \

@@ -19,21 +19,29 @@ class SyncBatchNormalization(tf.keras.layers.BatchNormalization):
         super().__init__(**kwargs)
         self.process_set = process_set
 
-    def _moments(self, inputs, reduction_axes, keep_dims=False, **kwargs):
-        mean, var = super()._moments(
-            inputs, reduction_axes, keep_dims=keep_dims, **kwargs)
+    def _moments(self, inputs, *args, **kwargs):
+        # keras 2 signature: (inputs, reduction_axes, keep_dims=...);
+        # keras 3: (inputs, mask) — pass through either unchanged
+        mean, var = super()._moments(inputs, *args, **kwargs)
         if basics.size() == 1:
             return mean, var
         sqmean = var + tf.square(mean)
+        # weight by the local element count so uneven per-rank batches
+        # still produce the true global moments (reference
+        # sync_batch_norm.py weights by per-rank counts the same way)
+        count = tf.cast(
+            tf.size(inputs) / tf.maximum(tf.size(mean), 1), tf.float32)
         packed = tf.concat([
-            tf.reshape(tf.cast(mean, tf.float32), [-1]),
-            tf.reshape(tf.cast(sqmean, tf.float32), [-1])], axis=0)
-        out = api.allreduce(packed, op=api.Average,
+            tf.reshape(tf.cast(mean, tf.float32), [-1]) * count,
+            tf.reshape(tf.cast(sqmean, tf.float32), [-1]) * count,
+            tf.reshape(count, [1])], axis=0)
+        out = api.allreduce(packed, op=api.Sum,
                             name=f"sync_bn.{self.name}",
                             process_set=self.process_set)
         out = tf.convert_to_tensor(out)
         n = tf.size(mean)
-        g_mean = tf.reshape(out[:n], tf.shape(mean))
-        g_sqmean = tf.reshape(out[n:], tf.shape(mean))
+        total = out[-1]
+        g_mean = tf.reshape(out[:n] / total, tf.shape(mean))
+        g_sqmean = tf.reshape(out[n:-1] / total, tf.shape(mean))
         g_var = g_sqmean - tf.square(g_mean)
         return tf.cast(g_mean, mean.dtype), tf.cast(g_var, var.dtype)

@@ -336,3 +336,28 @@ def test_tf_tape_with_process_set(hvd_shutdown):
         return True
 
     assert all(run_ranks(fn))
+
+
+def test_tf_sync_batch_norm_matches_global_batch(hvd_shutdown):
+    """SyncBatchNormalization over per-rank shards must normalize like
+    plain BN over the concatenated global batch (reference
+    tensorflow/sync_batch_norm.py contract)."""
+    rng = np.random.RandomState(0)
+    # UNEVEN per-rank batches: the combine must weight by local count
+    sizes = [2, 4, 6, 4][:NP]
+    xs = [rng.randn(s, 3).astype("float32") for s in sizes]
+
+    def fn():
+        bn = hvd.SyncBatchNormalization(momentum=0.0, center=False,
+                                        scale=False)
+        out = bn(tf.constant(xs[hvd.rank()]), training=True)
+        return np.asarray(out)
+
+    outs = run_ranks(fn)
+    ref_bn = tf.keras.layers.BatchNormalization(momentum=0.0,
+                                                center=False,
+                                                scale=False)
+    ref = np.asarray(ref_bn(tf.constant(np.concatenate(xs)),
+                            training=True))
+    got = np.concatenate(outs)
+    assert np.allclose(got, ref, atol=1e-4), np.abs(got - ref).max()

@@ -26,11 +26,24 @@ class SyncBatchNormalization(tf.keras.layers.BatchNormalization):
         if basics.size() == 1:
             return mean, var
         sqmean = var + tf.square(mean)
-        # weight by the local element count so uneven per-rank batches
-        # still produce the true global moments (reference
-        # sync_batch_norm.py weights by per-rank counts the same way)
-        count = tf.cast(
-            tf.size(inputs) / tf.maximum(tf.size(mean), 1), tf.float32)
+        # weight by the local VALID element count so uneven per-rank
+        # batches (and keras-3 masks) still produce the true global
+        # moments (reference sync_batch_norm.py weights by per-rank
+        # counts the same way)
+        mask = kwargs.get("mask")
+        if mask is None and args and tf.is_tensor(args[-1]):
+            mask = args[-1]           # keras 3 positional mask
+        if mask is not None:
+            valid = tf.reduce_sum(tf.cast(mask, tf.float32))
+            per_pos = tf.cast(
+                tf.size(inputs) / tf.maximum(tf.size(mask), 1),
+                tf.float32)
+            count = valid * per_pos / tf.cast(
+                tf.maximum(tf.size(mean), 1), tf.float32)
+        else:
+            count = tf.cast(
+                tf.size(inputs) / tf.maximum(tf.size(mean), 1),
+                tf.float32)
         packed = tf.concat([
             tf.reshape(tf.cast(mean, tf.float32), [-1]) * count,
             tf.reshape(tf.cast(sqmean, tf.float32), [-1]) * count,

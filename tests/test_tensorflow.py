@@ -361,3 +361,45 @@ def test_tf_sync_batch_norm_matches_global_batch(hvd_shutdown):
                             training=True))
     got = np.concatenate(outs)
     assert np.allclose(got, ref, atol=1e-4), np.abs(got - ref).max()
+
+
+def test_tf_state_save_restore(hvd_shutdown):
+    """Raw-variable TensorFlowState commit/restore round-trip
+    (reference tensorflow/elastic.py:41 TensorFlowState)."""
+    def fn():
+        v = tf.Variable([1.0, 2.0])
+        state = hvd.elastic.TensorFlowState(variables=[v], epoch=0)
+        state.epoch = 4
+        state.commit()
+        v.assign([9.0, 9.0])
+        state.epoch = 7
+        state.restore()
+        assert np.allclose(v.numpy(), [1.0, 2.0])
+        assert state.epoch == 4
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_tf_sync_batch_norm_masked_valid_counts(hvd_shutdown):
+    """keras-3 mask path: the cross-rank combine must weight by VALID
+    element counts, matching plain moments over the valid rows."""
+    rng = np.random.RandomState(2)
+    xs = [rng.randn(8, 3).astype("float32") for _ in range(NP)]
+    n_valid = [2, 6, 4, 8][:NP]
+    masks = [np.arange(8) < n for n in n_valid]
+
+    def fn():
+        r = hvd.rank()
+        bn = hvd.SyncBatchNormalization(momentum=0.0, center=False,
+                                        scale=False)
+        bn.build(xs[r].shape)
+        m, v = bn._moments(tf.constant(xs[r]), tf.constant(masks[r]))
+        return np.asarray(m).ravel(), np.asarray(v).ravel()
+
+    outs = run_ranks(fn)
+    valid = np.concatenate([x[:n] for x, n in zip(xs, n_valid)])
+    ref_m, ref_v = valid.mean(0), valid.var(0)
+    for m, v in outs:
+        assert np.allclose(m, ref_m, atol=1e-4)
+        assert np.allclose(v, ref_v, atol=1e-4)

@@ -627,3 +627,25 @@ def test_torch_optimizer_with_process_set(hvd_shutdown):
         return True
 
     assert all(run_ranks(fn))
+
+
+def test_torch_broadcast_optimizer_state(hvd_shutdown):
+    """Momentum buffers and hyperparameters travel from root so all
+    ranks resume identically (reference functions.py:118 role)."""
+    def fn():
+        r = hvd.rank()
+        model = torch.nn.Linear(3, 1, bias=False)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1 * (r + 1),
+                              momentum=0.9)
+        # build momentum state with one local step, divergent per rank
+        model(torch.ones(1, 3) * (r + 1)).sum().backward()
+        opt.step()
+        hvd.broadcast_optimizer_state(opt, root_rank=0)
+        assert opt.param_groups[0]["lr"] == 0.1        # root's lr
+        buf = next(iter(opt.state.values()))["momentum_buffer"]
+        gathered = hvd.allgather(buf.reshape(1, -1))
+        assert torch.allclose(gathered,
+                              gathered[0].expand_as(gathered))
+        return True
+
+    assert all(run_ranks(fn))

@@ -403,3 +403,23 @@ def test_tf_sync_batch_norm_masked_valid_counts(hvd_shutdown):
     for m, v in outs:
         assert np.allclose(m, ref_m, atol=1e-4)
         assert np.allclose(v, ref_v, atol=1e-4)
+
+
+def test_tf_tape_fp16_compression(hvd_shutdown):
+    """fp16 wire compression through the tape: grads still average
+    correctly (within 16-bit tolerance) and come back f32."""
+    def fn():
+        r = hvd.rank()
+        w = tf.Variable([[1.0], [1.0]])
+        x = tf.constant([[float(r + 1), 2.0 * (r + 1)]])
+        with hvd.DistributedGradientTape(
+                compression=hvd.Compression.fp16) as tape:
+            y = tf.reduce_sum(tf.matmul(x, w))
+        g = tape.gradient(y, [w])[0]
+        assert g.dtype == tf.float32
+        mean = np.mean([i + 1 for i in range(NP)])
+        assert np.allclose(g.numpy().ravel(), [mean, 2 * mean],
+                           rtol=0.02)
+        return True
+
+    assert all(run_ranks(fn))

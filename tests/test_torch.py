@@ -257,9 +257,14 @@ def test_sync_batch_norm(hvd_shutdown):
         assert np.allclose(m, means[0], atol=1e-6)
 
 
-def test_sync_batch_norm_matches_global_batch(hvd_shutdown):
-    xs = [torch.randn(4, 3, generator=torch.Generator().manual_seed(r))
-          for r in range(NP)]
+@pytest.mark.parametrize("sizes", [[4] * NP, [2, 5, 3, 6][:NP]],
+                         ids=["even", "uneven"])
+def test_sync_batch_norm_matches_global_batch(sizes, hvd_shutdown):
+    """Per-rank shards (even or uneven) normalize like plain BN over
+    the concatenated global batch (sum/count packing weights ranks by
+    their true element counts)."""
+    xs = [torch.randn(s, 3, generator=torch.Generator().manual_seed(r))
+          for r, s in enumerate(sizes)]
 
     def fn():
         bn = hvd.SyncBatchNorm(3, momentum=1.0, affine=False)

@@ -94,3 +94,44 @@ def test_grouped_allgather_mixed_dtypes_rejected(hvd_shutdown):
 
     import horovod_tpu as hvd
     assert all(hvd.run(fn, np=2))
+
+
+def test_one_rank_failure_aborts_peers(hvd_shutdown):
+    """A rank raising before it submits must fail its peers' pending
+    collectives promptly (reference SHUT_DOWN_ERROR semantics) — never
+    a hang."""
+    import horovod_tpu as hvd
+
+    def fn():
+        if hvd.rank() == 2:
+            raise RuntimeError("injected rank failure")
+        # peers enter a collective the failed rank never joins
+        hvd.allreduce(np.ones(3, np.float32), op=hvd.Sum,
+                      name="doomed")
+        return True
+
+    # watchdog: a broken abort would block hvd.run forever, so run it
+    # on a worker thread and bound the join — the guard then FAILS
+    # instead of hanging the suite
+    import threading as _threading
+    box = {}
+
+    def _invoke():
+        try:
+            hvd.run(fn, np=4)
+            box["error"] = None
+        except RuntimeError as exc:
+            box["error"] = exc
+
+    w = _threading.Thread(target=_invoke, daemon=True)
+    w.start()
+    w.join(timeout=60)
+    assert not w.is_alive(), "peers hung on dead rank"
+    assert box["error"] is not None and \
+        "ranks failed" in str(box["error"])
+
+    # the runtime is reusable after the failed run
+    out = hvd.run(lambda: np.asarray(
+        hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                      name="after_abort")), np=4)
+    assert all(np.allclose(o, 4.0) for o in out)

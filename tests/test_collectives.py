@@ -590,3 +590,16 @@ def test_grouped_member_shape_mismatch_raises(hvd_shutdown):
         return True
 
     assert all(run_ranks(fn))
+
+
+def test_jax_allgather_round_trip(hvd_shutdown):
+    """jax-array allgather comes back as a jax array (the allreduce
+    half lives in test_allreduce_jax_array_roundtrip)."""
+    import jax.numpy as jnp
+
+    def fn():
+        g = hvd.allgather(jnp.full((1, 2), float(hvd.rank())))
+        assert "jax" in type(g).__module__ and g.shape == (8, 2)
+        return True
+
+    assert all(run_ranks(fn))

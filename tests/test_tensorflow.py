@@ -423,3 +423,23 @@ def test_tf_tape_fp16_compression(hvd_shutdown):
         return True
 
     assert all(run_ranks(fn))
+
+
+def test_keras_state_commit_restore(hvd_shutdown):
+    import horovod_tpu.keras as hvdk
+
+    def fn():
+        model = tf.keras.Sequential(
+            [tf.keras.layers.Dense(2, use_bias=False)])
+        model.build((None, 3))
+        state = hvdk.elastic.KerasState(model, epoch=0)
+        state.epoch = 2
+        state.commit()
+        w0 = model.get_weights()[0].copy()
+        model.set_weights([np.zeros_like(w0)])
+        state.restore()
+        assert np.allclose(model.get_weights()[0], w0)
+        assert state.epoch == 2
+        return True
+
+    assert all(run_ranks(fn))

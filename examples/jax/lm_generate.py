@@ -27,20 +27,22 @@ from horovod_tpu.parallel import MeshSpec, build_mesh, make_lm_train_step
 
 
 def main():
-    def _nonneg(kind, name):
-        def parse(v):
-            v = kind(v)
-            if v < (1 if name == "steps" else 0):
-                raise argparse.ArgumentTypeError(
-                    f"--{name} must be >= {1 if name == 'steps' else 0}")
-            return v
-        return parse
+    def positive_int(v):
+        v = int(v)
+        if v < 1:
+            raise argparse.ArgumentTypeError("must be >= 1")
+        return v
+
+    def nonneg_float(v):
+        v = float(v)
+        if v < 0:
+            raise argparse.ArgumentTypeError("must be >= 0")
+        return v
 
     p = argparse.ArgumentParser()
-    p.add_argument("--steps", type=_nonneg(int, "steps"), default=30)
-    p.add_argument("--max-new-tokens", type=int, default=24)
-    p.add_argument("--temperature", type=_nonneg(float, "temperature"),
-                   default=0.0)
+    p.add_argument("--steps", type=positive_int, default=30)
+    p.add_argument("--max-new-tokens", type=positive_int, default=24)
+    p.add_argument("--temperature", type=nonneg_float, default=0.0)
     args = p.parse_args()
 
     cfg = TransformerConfig(vocab_size=256, d_model=128, n_layers=2,

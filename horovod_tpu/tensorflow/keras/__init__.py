@@ -1,0 +1,11 @@
+"""``import horovod_tpu.tensorflow.keras as hvd`` — the tf.keras
+binding ported scripts import (reference
+``horovod/tensorflow/keras/__init__.py``; in this build it is the same
+implementation as ``horovod_tpu.keras``, which binds the installed
+keras — tf.keras IS keras 3 in this image)."""
+
+from ...keras import *          # noqa: F401,F403
+from ...keras import (          # noqa: F401
+    PartialDistributedOptimizer, broadcast_global_variables, load_model,
+    callbacks, elastic,
+)

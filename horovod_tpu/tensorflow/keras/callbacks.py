@@ -1,0 +1,5 @@
+"""Alias of ``horovod_tpu.keras.callbacks`` (reference
+horovod/tensorflow/keras/callbacks.py) — star-import so new callbacks
+track automatically."""
+
+from ...keras.callbacks import *  # noqa: F401,F403

@@ -1,0 +1,5 @@
+"""Alias of ``horovod_tpu.keras.elastic`` (reference
+horovod/tensorflow/keras/elastic.py) — star-import so new state and
+callback classes track automatically."""
+
+from ...keras.elastic import *  # noqa: F401,F403

@@ -443,3 +443,21 @@ def test_keras_state_commit_restore(hvd_shutdown):
         return True
 
     assert all(run_ranks(fn))
+
+
+def test_tensorflow_keras_alias_module(hvd_shutdown):
+    """`import horovod_tpu.tensorflow.keras as hvd` — the module name
+    ported scripts use (reference horovod/tensorflow/keras)."""
+    import horovod_tpu.tensorflow.keras as hvdk
+
+    assert hvdk.DistributedOptimizer is not None
+    assert hvdk.callbacks.MetricAverageCallback is not None
+    assert hvdk.elastic.KerasState is not None
+
+    def fn():
+        out = hvdk.allreduce(tf.constant([1.0]) * (hvdk.rank() + 1),
+                             op=hvdk.Sum)
+        assert np.allclose(out.numpy(), sum(range(1, NP + 1)))
+        return True
+
+    assert all(run_ranks(fn))

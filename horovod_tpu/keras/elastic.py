@@ -4,7 +4,7 @@ CommitStateCallback, UpdateEpochStateCallback, UpdateBatchStateCallback).
 
 import tensorflow as tf
 
-from ..tensorflow.elastic import TensorFlowKerasState
+from ..tensorflow.elastic import TensorFlowKerasState, run  # noqa: F401
 
 
 class CommitStateCallback(tf.keras.callbacks.Callback):

@@ -603,3 +603,48 @@ def test_jax_allgather_round_trip(hvd_shutdown):
         return True
 
     assert all(run_ranks(fn))
+
+
+def test_engine_stress_mixed_concurrent_ops(hvd_shutdown):
+    """Stress the negotiation/fusion engine: every rank submits an
+    interleaved mix of async allreduces (several dtypes/sizes), grouped
+    ops, allgathers and broadcasts per iteration, synchronizing out of
+    order — results must stay exact for every op every iteration."""
+    def fn():
+        r = hvd.rank()
+        R = 8
+        for it in range(12):
+            handles = {}
+            handles["ar_f32"] = hvd.allreduce_async(
+                np.full(97, r + 1.0, np.float32), op=hvd.Sum,
+                name=f"st_f32.{it}")
+            handles["ar_i64"] = hvd.allreduce_async(
+                np.full(13, r + 1, np.int64), op=hvd.Sum,
+                name=f"st_i64.{it}")
+            handles["grp"] = hvd.grouped_allreduce_async(
+                [np.full(5, float(r), np.float32),
+                 np.ones((2, 3), np.float32)], op=hvd.Sum,
+                name=f"st_grp.{it}")
+            handles["ag"] = hvd.allgather_async(
+                np.full((1 + r % 2, 2), float(r), np.float32),
+                name=f"st_ag.{it}")
+            handles["bc"] = hvd.broadcast_async(
+                np.full(7, float(r), np.float32), root_rank=it % R,
+                name=f"st_bc.{it}")
+            # drain in a rank-dependent order
+            order = list(handles)
+            for i in range(r % len(order)):
+                order.append(order.pop(0))
+            out = {k: hvd.synchronize(handles[k]) for k in order}
+            total = sum(range(1, R + 1))
+            assert np.allclose(out["ar_f32"], total)
+            assert np.array_equal(out["ar_i64"],
+                                  np.full(13, total, np.int64))
+            assert np.allclose(out["grp"][0], sum(range(R)))
+            assert np.allclose(out["grp"][1], R)
+            rows = sum(1 + rr % 2 for rr in range(R))
+            assert out["ag"].shape == (rows, 2)
+            assert np.allclose(out["bc"], float(it % R))
+        return True
+
+    assert all(run_ranks(fn))

@@ -360,6 +360,16 @@ FULL_MATRIX_WORKER = textwrap.dedent("""
                             name="ps0", process_set=ps)
         assert np.allclose(out, 7.0), out
 
+    # steady-state stress: repeated mixed ops hit the coordinator's
+    # response-cache fast path; results must stay exact every round
+    for it in range(6):
+        h1 = hvd.allreduce_async(np.full(33, float(r + 1), np.float32),
+                                 op=hvd.Sum, name="steady_a")
+        h2 = hvd.allgather_async(np.full((2, 2), float(r), np.float32),
+                                 name="steady_g")
+        assert np.allclose(hvd.synchronize(h2)[2:], 1.0)
+        assert np.allclose(hvd.synchronize(h1), float(total))
+
     # join: rank 0 runs out of data early; rank 1 keeps reducing and
     # gets zeros contributed for rank 0 (reference join semantics)
     if r == 0:

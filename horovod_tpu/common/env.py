@@ -27,6 +27,7 @@ HOROVOD_HIERARCHICAL_ALLREDUCE = "HOROVOD_HIERARCHICAL_ALLREDUCE"
 HOROVOD_HIERARCHICAL_ALLGATHER = "HOROVOD_HIERARCHICAL_ALLGATHER"
 HOROVOD_TORUS_ALLREDUCE = "HOROVOD_TORUS_ALLREDUCE"
 HOROVOD_ELASTIC = "HOROVOD_ELASTIC"
+HOROVOD_PROCESS_SET_REMOVAL_TIMEOUT = "HOROVOD_PROCESS_SET_REMOVAL_TIMEOUT"
 HOROVOD_LOG_LEVEL = "HOROVOD_LOG_LEVEL"
 HOROVOD_LOG_HIDE_TIME = "HOROVOD_LOG_HIDE_TIME"
 
@@ -111,3 +112,8 @@ class Config:
             HOROVOD_STALL_CHECK_TIME_SECONDS, DEFAULT_STALL_WARNING_SECS)
         self.stall_shutdown_secs = get_float(HOROVOD_STALL_SHUTDOWN_TIME_SECONDS, 0.0)
         self.elastic = get_bool(HOROVOD_ELASTIC)
+        # process-set removal is a barrier across local rank threads;
+        # this bounds the wait for peers' votes and the drain of
+        # in-flight collectives on the set
+        self.ps_removal_timeout_secs = get_float(
+            HOROVOD_PROCESS_SET_REMOVAL_TIMEOUT, 60.0)

@@ -9,6 +9,7 @@ analogue of a per-set communicator (reference process_set.h:26-84).
 import threading
 
 from . import basics
+from .exceptions import HorovodInitError
 
 _lock = threading.Lock()
 _registered = {}   # id -> ProcessSet
@@ -78,16 +79,32 @@ def add_process_set(process_set) -> ProcessSet:
 
 
 def remove_process_set(process_set) -> bool:
-    """Deregister (reference process_sets.py:145)."""
-    ps_id = process_set.process_set_id if isinstance(process_set, ProcessSet) \
-        else int(process_set)
+    """Deregister (reference process_sets.py:145).  Collective, like
+    the reference: every rank calls it and removal takes effect once
+    all local rank threads have (a fast rank can no longer kill a
+    collective its peers still have in flight).  Callers without a
+    bound rank context (driver/admin threads) remove immediately."""
+    if isinstance(process_set, ProcessSet):
+        # the ProcessSet object is SHARED across rank threads; the
+        # first thread to finish the collective removal nulls
+        # process_set_id, so siblings re-resolve through _removed_id
+        ps_id = process_set.process_set_id
+        if ps_id is None:
+            ps_id = getattr(process_set, "_removed_id", None)
+    else:
+        ps_id = int(process_set)
     if ps_id is None or ps_id == 0:
         return False
-    ok = basics.engine().remove_process_set(ps_id)
+    try:
+        rank = basics.context().rank
+    except HorovodInitError:
+        rank = None      # administrative caller (no bound rank thread)
+    ok = basics.engine().remove_process_set(ps_id, rank=rank)
     if ok:
         with _lock:
             reg = _registered.pop(ps_id, None)
         if reg is not None:
+            reg._removed_id = ps_id
             reg.process_set_id = None
     return ok
 

@@ -127,6 +127,10 @@ class Engine:
         ps0 = self._make_process_set_state(0, range(self.global_size))
         self.process_sets: Dict[int, ProcessSetState] = {0: ps0}
         self._next_ps_id = 1
+        # removal barrier bookkeeping (see remove_process_set)
+        self._removal_events: Dict[int, threading.Event] = {}
+        self._removal_votes: Dict[int, set] = {}
+        self._removed_ps_ids: set = set()
 
         self.autotuner = None
         if self.config.autotune and controller is None:
@@ -207,23 +211,95 @@ class Engine:
                 ps_id, ranks)
             return ps_id
 
-    def remove_process_set(self, ps_id) -> bool:
+    def remove_process_set(self, ps_id, rank=None) -> bool:
+        """Deregister a process set.  Removal is a BARRIER across the
+        local rank threads (reference process_set.h:89-171 removal
+        barriers): it takes effect only once every local rank has
+        requested it, then in-flight fully-submitted collectives on the
+        set DRAIN before the set disappears — so a fast rank cannot
+        kill work its peers (or it itself, via an unsynchronized async
+        handle) still have outstanding.  Returns True once the set is
+        gone."""
         if ps_id == 0:
             raise ValueError("cannot remove the global process set")
+        timeout = self.config.ps_removal_timeout_secs
         with self._lock:
-            ps = self.process_sets.pop(ps_id, None)
+            ps = self.process_sets.get(ps_id)
             if ps is None:
-                return False
-            for entry in list(ps.pending.values()) + \
-                    list(ps.awaiting.values()):
-                for sub in entry.subs.values():
-                    sub.handle.set_error(HorovodInternalError(
-                        f"process set {ps_id} removed while "
-                        f"{entry.key} pending"))
-            if self.multiproc:
-                for key in ps.awaiting:
-                    self.controller.forget(key)
+                # already removed (our vote may have been the follower's)
+                return ps_id in self._removed_ps_ids
+            if self.num_local > 1 and rank is not None:
+                # rank-bound callers vote; an unbound (administrative)
+                # caller removes immediately
+                ev = self._removal_events.setdefault(
+                    ps_id, threading.Event())
+                voters = self._removal_votes.setdefault(ps_id, set())
+                voters.add(rank)
+                if len(voters) < self.num_local:
+                    wait_ev = ev
+                else:
+                    wait_ev = None
+            else:
+                wait_ev = None
+            if wait_ev is None:
+                self._finalize_removal_locked(ps_id, ps, timeout)
+                return True
+        # vote recorded; wait for the remaining votes AND the drain
+        # (the event is set by the finalizer, abort() and shutdown()).
+        # The window covers both phases; waiters never mutate the
+        # shared barrier state — only the finalizer does.
+        wait_ev.wait(timeout=2 * timeout)
+        with self._lock:
+            removed = ps_id in self._removed_ps_ids
+        if removed:
             return True
+        if self._aborted is not None:
+            raise HorovodInternalError(
+                f"a peer rank failed during remove_process_set: "
+                f"{self._aborted!r}")
+        if self._shutdown:
+            raise HorovodInternalError(
+                "engine shut down during remove_process_set")
+        raise HorovodInternalError(
+            f"remove_process_set({ps_id}) timed out waiting for "
+            f"peer rank threads to request removal")
+
+    def _finalize_removal_locked(self, ps_id, ps, timeout):
+        """Drain then drop the set (called with the lock held by the
+        final voter / an administrative caller)."""
+        # every local member rank has requested removal, so no further
+        # submissions can arrive: entries whose non-JOINED local subs
+        # are all present just need the background thread to execute
+        # them (joined ranks contribute zeros — same rule as
+        # _collect_ready_locked); entries missing live local subs can
+        # never complete and are abandoned.
+        deadline = time.monotonic() + timeout
+
+        def incomplete(entry):
+            return any(r not in entry.subs
+                       for r in ps.local_ranks if r not in ps.joined)
+
+        while (ps.pending or ps.awaiting) \
+                and self._aborted is None and not self._shutdown:
+            for table in (ps.pending, ps.awaiting):
+                for key, entry in list(table.items()):
+                    if incomplete(entry) or time.monotonic() > deadline:
+                        table.pop(key, None)
+                        if self.multiproc:
+                            self.controller.forget(key)
+                        for sub in entry.subs.values():
+                            sub.handle.set_error(HorovodInternalError(
+                                f"process set {ps_id} removed while "
+                                f"{key} pending"))
+            if not (ps.pending or ps.awaiting):
+                break
+            self._lock.wait(timeout=0.05)   # let the engine drain
+        self.process_sets.pop(ps_id, None)
+        self._removed_ps_ids.add(ps_id)
+        ev = self._removal_events.pop(ps_id, None)
+        self._removal_votes.pop(ps_id, None)
+        if ev is not None:
+            ev.set()
 
     def get_process_set(self, ps_id) -> ProcessSetState:
         ps = self.process_sets.get(ps_id)
@@ -340,7 +416,12 @@ class Engine:
         work = []
         for ps in list(self.process_sets.values()):
             if not self.multiproc and ps.joined and \
-                    len(ps.joined) == ps.size:
+                    len(ps.joined) == ps.size \
+                    and not ps.pending and not ps.awaiting:
+                # resolve the join barrier only once pending collectives
+                # have drained: clearing ps.joined earlier would strand
+                # entries submitted before the join (their readiness
+                # test would suddenly require the joined ranks again)
                 for r, h in ps.join_waiters.items():
                     h.set_result(ps.last_joined)
                 ps.join_waiters.clear()
@@ -1000,6 +1081,10 @@ class Engine:
             self._aborted = exc
             self._fail_all_pending_locked(HorovodInternalError(
                 f"a peer rank failed: {exc!r}"))
+            # wake threads parked in the process-set removal barrier —
+            # they re-check _aborted and surface the peer failure
+            for ev in self._removal_events.values():
+                ev.set()
             self._lock.notify_all()
 
     def shutdown(self):
@@ -1007,6 +1092,9 @@ class Engine:
             if self._shutdown:
                 return
             self._shutdown = True
+            # wake threads parked in the process-set removal barrier
+            for ev in self._removal_events.values():
+                ev.set()
             self._lock.notify_all()
         self._shutdown_done.wait(timeout=30)
         if self.autotuner is not None:

@@ -104,8 +104,10 @@ def test_dynamic_process_sets(hvd_shutdown):
             expected = 4.0      # ranks 0 and 2 -> (1 + 3)
             assert np.allclose(out, expected), out
         barrier.wait()
-        if r == 0:
-            assert hvd.remove_process_set(evens)
+        # removal is collective (reference: add/remove must be called
+        # by every process) — all ranks vote; the barrier inside
+        # remove_process_set releases them together
+        assert hvd.remove_process_set(evens)
         return True
 
     assert all(hvd.run(fn, np=4))

@@ -648,3 +648,67 @@ def test_engine_stress_mixed_concurrent_ops(hvd_shutdown):
         return True
 
     assert all(run_ranks(fn))
+
+
+def test_remove_process_set_waits_for_inflight_peers(hvd_shutdown):
+    """A fast rank's removal vote must NOT kill collectives its peers
+    still have in flight — removal is a barrier across local rank
+    threads (non-members vote immediately here while members are still
+    inside their subset allreduce)."""
+    def fn():
+        r = hvd.rank()
+        ps = hvd.add_process_set([0, 1])
+        if r in (0, 1):
+            out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                                process_set=ps, name="inflight")
+            assert np.allclose(out, 2.0)
+        # ranks 2..7 reach this instantly; 0/1 only after their op
+        assert hvd.remove_process_set(ps)
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_remove_process_set_drains_async_handles(hvd_shutdown):
+    """An UNSYNCHRONIZED async collective on the set survives removal:
+    fully-submitted entries drain before the set disappears, so the
+    handle still resolves to the correct result afterwards."""
+    def fn():
+        r = hvd.rank()
+        ps = hvd.add_process_set([0, 1, 2, 3, 4, 5, 6])
+        h = None
+        if r < 7:
+            h = hvd.allreduce_async(np.ones(2, np.float32) * (r + 1),
+                                    op=hvd.Sum, process_set=ps,
+                                    name="drain_me")
+        assert hvd.remove_process_set(ps)
+        if h is not None:
+            out = hvd.synchronize(h)       # completed despite removal
+            assert np.allclose(out, sum(range(1, 8))), out
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_join_resolves_after_pending_entries_drain(hvd_shutdown):
+    """An async collective submitted BEFORE join must execute (joined
+    ranks contribute zeros) — the join barrier resolves only once
+    pending entries drain, instead of clearing the joined set under
+    them and stranding the entry."""
+    def fn():
+        r = hvd.rank()
+        ps = hvd.add_process_set([0, 1])
+        h = None
+        if r == 0:
+            h = hvd.allreduce_async(np.ones(2, np.float32), op=hvd.Sum,
+                                    process_set=ps, name="prejoin")
+            hvd.join(process_set=ps)
+        elif r == 1:
+            hvd.join(process_set=ps)
+        assert hvd.remove_process_set(ps)
+        if h is not None:
+            out = hvd.synchronize(h)
+            assert np.allclose(out, 1.0), out   # rank 1 joined -> zeros
+        return True
+
+    assert all(run_ranks(fn))

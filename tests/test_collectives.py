@@ -712,3 +712,61 @@ def test_join_resolves_after_pending_entries_drain(hvd_shutdown):
         return True
 
     assert all(run_ranks(fn))
+
+
+def test_edge_cases_zero_splits_empty_tensors(hvd_shutdown):
+    """Zero-sized alltoall splits, fully-empty allreduce, and
+    allgather with empty contributions from some ranks."""
+    def fn():
+        r = hvd.rank()
+        splits = [0] * 8
+        splits[(r + 1) % 8] = 3
+        out, recv = hvd.alltoall(np.full((3, 2), float(r), np.float32),
+                                 splits=splits, name="a2a_zero")
+        src = (r - 1) % 8
+        expect_recv = [0] * 8
+        expect_recv[src] = 3
+        assert list(recv) == expect_recv
+        assert out.shape == (3, 2) and np.allclose(out, float(src))
+        e = hvd.allreduce(np.zeros((0, 4), np.float32), op=hvd.Sum,
+                          name="empty")
+        assert e.shape == (0, 4)
+        g = hvd.allgather(
+            np.zeros((0, 2) if r % 2 else (1, 2), np.float32),
+            name="some_empty")
+        assert g.shape == (4, 2), g.shape
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_repeated_join_rounds(hvd_shutdown):
+    """Joined state resets after each full join round so the set keeps
+    working (collective between rounds stays exact)."""
+    def fn():
+        assert hvd.join() >= 0
+        out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                            name="between_joins")
+        assert np.allclose(out, 8.0)
+        assert hvd.join() >= 0
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_large_object_broadcast_and_mixed_allgather(hvd_shutdown):
+    """Multi-MB pickled broadcast + allgather_object with wildly
+    different per-rank payload sizes."""
+    def fn():
+        r = hvd.rank()
+        big = {"w": np.random.RandomState(0).randn(256, 1024)} \
+            if r == 0 else None
+        out = hvd.broadcast_object(big, root_rank=0)
+        assert out["w"].shape == (256, 1024)
+        objs = hvd.allgather_object(
+            np.zeros(10 ** (r + 1)) if r < 3 else "tiny")
+        assert objs[0].size == 10 and objs[2].size == 1000
+        assert objs[3] == "tiny"
+        return True
+
+    assert all(run_ranks(fn, np_ranks=4))

@@ -554,3 +554,36 @@ def test_two_process_keras_fit(tmp_path):
                          platform="cpu", env={"PYTHONPATH": REPO},
                          start_timeout=300)
     assert codes == [0, 0]
+
+
+PS_LIFECYCLE_WORKER = textwrap.dedent("""
+    import numpy as np
+    import horovod_tpu as hvd
+    hvd.init()
+    r = hvd.rank()
+    for cycle in range(3):
+        ps = hvd.add_process_set([0, 1])
+        if r in (0, 1):
+            out = hvd.allreduce(np.ones(2, np.float32) * (r + 1),
+                                op=hvd.Sum, process_set=ps,
+                                name=f"c{cycle}")
+            assert np.allclose(out, 3.0), out
+        assert hvd.remove_process_set(ps)
+    print(f"PS LIFECYCLE OK {r}")
+    hvd.shutdown()
+""")
+
+
+@pytest.mark.integration
+def test_three_process_ps_lifecycle(tmp_path):
+    """Repeated add/use/remove of a rank-subset process set across
+    three real processes (id reuse + coordinator forget + store
+    protocol all in the loop)."""
+    from horovod_tpu.runner.proc_run import launch_procs
+
+    script = tmp_path / "worker.py"
+    script.write_text(PS_LIFECYCLE_WORKER)
+    codes = launch_procs([sys.executable, str(script)], np=3,
+                         platform="cpu", env={"PYTHONPATH": REPO},
+                         start_timeout=150)
+    assert codes == [0, 0, 0]

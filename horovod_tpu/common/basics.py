@@ -182,9 +182,23 @@ def init(comm=None, process_sets=None, num_ranks=None, devices=None):
                 raise HorovodInitError(
                     f"multi-process mode needs one device per rank: "
                     f"{len(devices)} devices < {global_size} ranks")
-            _topology = Topology(
-                size=global_size,
-                host_of_rank=[r // num_ranks for r in range(global_size)])
+            hof = env_mod.get_str("HOROVOD_TPU_HOST_OF_RANK")
+            if hof:
+                # launcher's true host layout (one entry per process):
+                # multiple processes on one host share local_rank space
+                host_of_proc = [int(x) for x in hof.split(",")]
+                if len(host_of_proc) != num_procs:
+                    raise HorovodInitError(
+                        f"HOROVOD_TPU_HOST_OF_RANK has "
+                        f"{len(host_of_proc)} entries for {num_procs} "
+                        f"processes (stale environment?)")
+                host_of_rank = [host_of_proc[r // num_ranks]
+                                for r in range(global_size)]
+            else:
+                host_of_rank = [r // num_ranks
+                                for r in range(global_size)]
+            _topology = Topology(size=global_size,
+                                 host_of_rank=host_of_rank)
         else:
             _topology = Topology(size=num_ranks)
         if devices is None:

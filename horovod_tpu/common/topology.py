@@ -38,8 +38,13 @@ class Topology:
 
     def cross_rank(self, rank):
         """Rank among same-local-rank peers across hosts (reference
-        cross_comm semantics: one rank per node at each local index)."""
-        return self.host_of_rank[rank]
+        cross_comm semantics: one rank per node at each local index).
+        With heterogeneous slot counts, only hosts that HAVE this local
+        index participate in the cross communicator."""
+        lr = self.local_rank(rank)
+        own = self.host_of_rank[rank]
+        return sum(1 for h in range(own)
+                   if len(self.local_ranks(h)) > lr)
 
     def cross_size(self, rank):
         lr = self.local_rank(rank)

@@ -202,6 +202,9 @@ class ElasticDriver:
         host, slot = key.rsplit(":", 1)
         env = dict(os.environ)
         env.update(self._env)
+        # elastic workers derive topology from the rendezvous, not a
+        # static host map — a stale inherited value would mislead them
+        env.pop("HOROVOD_TPU_HOST_OF_RANK", None)
         env.update({
             "HOROVOD_ELASTIC": "1",
             "HOROVOD_CONTROLLER": "http",

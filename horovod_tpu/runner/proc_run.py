@@ -88,8 +88,26 @@ def ssh_command(hostname: str, command: List[str], env: dict,
     return ssh + [hostname, " && ".join(parts)], payload.encode()
 
 
+def host_of_rank_env(slots) -> str:
+    """Comma-joined host-group index, ONE ENTRY PER PROCESS SLOT (the
+    worker expands per-rank via its ranks_per_proc) — lets workers
+    rebuild the full local/cross topology (the reference workers derive
+    it from gloo contexts; here it rides the env contract).  Groups are
+    taken from the launcher's own slot assignment (a new group starts
+    at each local_rank 0), so hostfiles listing one hostname twice stay
+    consistent with the per-slot HOROVOD_LOCAL_* env."""
+    hosts = []
+    group = -1
+    for s in sorted(slots, key=lambda s: s.rank):
+        if s.local_rank == 0:
+            group += 1
+        hosts.append(str(group))
+    return ",".join(hosts)
+
+
 def slot_env(slot: SlotInfo, *, rdv_addr, rdv_port, coordinator,
-             secret_hex, num_procs, ranks_per_proc=1, platform=None):
+             secret_hex, num_procs, ranks_per_proc=1, platform=None,
+             host_of_rank=None):
     """Env handoff for one worker (reference gloo_run.py:66-103)."""
     env = {
         "HOROVOD_RANK": str(slot.rank),
@@ -109,6 +127,8 @@ def slot_env(slot: SlotInfo, *, rdv_addr, rdv_port, coordinator,
         "HOROVOD_TPU_RANKS_PER_PROC": str(ranks_per_proc),
         "HOROVOD_TPU_COORDINATOR": coordinator,
     }
+    if host_of_rank:
+        env["HOROVOD_TPU_HOST_OF_RANK"] = host_of_rank
     if platform == "cpu":
         env["JAX_PLATFORMS"] = "cpu"
         env["JAX_NUM_CPU_DEVICES"] = str(ranks_per_proc)
@@ -222,6 +242,7 @@ def launch_procs(command: List[str], np: int, hosts: str = None,
     coordinator = f"{coord_host}:{_free_port()}"
 
     pool = ProcessPool()
+    hof = host_of_rank_env(slots)
     try:
         for slot in slots:
             child_env = dict(launcher_env)
@@ -229,7 +250,7 @@ def launch_procs(command: List[str], np: int, hosts: str = None,
                 slot, rdv_addr=rdv_addr, rdv_port=rdv_port,
                 coordinator=coordinator, secret_hex=secret_hex,
                 num_procs=num_procs, ranks_per_proc=ranks_per_proc,
-                platform=platform))
+                platform=platform, host_of_rank=hof))
             if is_local(slot.hostname):
                 cmd, payload, spawn_env = command, None, child_env
             else:

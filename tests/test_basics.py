@@ -135,3 +135,19 @@ def test_one_rank_failure_aborts_peers(hvd_shutdown):
         hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
                       name="after_abort")), np=4)
     assert all(np.allclose(o, 4.0) for o in out)
+
+
+def test_topology_heterogeneous_cross_rank():
+    """cross_rank counts only hosts that HAVE the local index, so
+    heterogeneous slot counts keep cross_rank < cross_size
+    (reference cross_comm semantics)."""
+    from horovod_tpu.common.topology import Topology
+    # hosts: a has rank 0; b has ranks 1,2
+    t = Topology(size=3, host_of_rank=[0, 1, 1])
+    assert t.local_rank(2) == 1
+    assert t.cross_size(2) == 1        # only host b has local index 1
+    assert t.cross_rank(2) == 0        # so its cross rank is 0, not 1
+    assert t.cross_rank(1) == 1 and t.cross_size(1) == 2
+    assert not t.is_homogeneous()
+    for r in range(3):
+        assert t.cross_rank(r) < t.cross_size(r)

@@ -587,3 +587,40 @@ def test_three_process_ps_lifecycle(tmp_path):
                          platform="cpu", env={"PYTHONPATH": REPO},
                          start_timeout=150)
     assert codes == [0, 0, 0]
+
+
+TWO_HOST_WORKER = textwrap.dedent("""
+    import numpy as np
+    import horovod_tpu as hvd
+    hvd.init()
+    r = hvd.rank()
+    # 4 ranks on 2 simulated hosts of 2 slots each: the launcher's
+    # HOROVOD_TPU_HOST_OF_RANK handoff must yield 2-rank local groups
+    assert hvd.size() == 4
+    assert hvd.local_size() == 2, hvd.local_size()
+    assert hvd.local_rank() == r % 2, (r, hvd.local_rank())
+    assert hvd.cross_size() == 2, hvd.cross_size()
+    assert hvd.cross_rank() == r // 2, (r, hvd.cross_rank())
+    out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum, name="xh")
+    assert np.allclose(out, 4.0)
+    print(f"TWO-HOST OK {r}")
+    hvd.shutdown()
+""")
+
+
+@pytest.mark.integration
+def test_two_host_topology_simulated(tmp_path):
+    """Two 'hosts' of two slots each (distinct hostnames mapped to
+    localhost, the reference's multi-node-without-a-cluster trick,
+    SURVEY §4): workers rebuild the true local/cross topology from the
+    launcher's host map and collectives span the simulated DCN
+    boundary."""
+    from horovod_tpu.runner.proc_run import launch_procs
+
+    script = tmp_path / "worker.py"
+    script.write_text(TWO_HOST_WORKER)
+    codes = launch_procs([sys.executable, str(script)], np=4,
+                         hosts="localhost:2,127.0.0.1:2",
+                         platform="cpu", env={"PYTHONPATH": REPO},
+                         start_timeout=180)
+    assert codes == [0, 0, 0, 0]

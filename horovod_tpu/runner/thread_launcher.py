@@ -50,14 +50,21 @@ def run(fn, np=None, args=(), kwargs=None, devices=None,
     if np is None:
         if already:
             np = basics.engine().num_local
+        elif devices is not None:
+            np = len(devices)        # explicit devices win
         else:
-            import jax
             from ..common import env as env_mod
-            if devices is None:
+            # under the multi-process launcher the rank count comes
+            # from the env contract — touching jax.devices() here
+            # would initialize the XLA backend before init() can call
+            # jax.distributed.initialize()
+            np = env_mod.get_int(env_mod.HOROVOD_TPU_RANKS_PER_PROC, 0)
+            if not np:
+                import jax
                 platform = env_mod.get_str(env_mod.HOROVOD_TPU_PLATFORM)
                 devices = jax.devices(platform) if platform \
                     else jax.devices()
-            np = len(devices)
+                np = len(devices)
     if not already:
         basics.init(num_ranks=np, devices=devices)
     elif basics.engine().num_local != np:

@@ -624,3 +624,43 @@ def test_two_host_topology_simulated(tmp_path):
                          platform="cpu", env={"PYTHONPATH": REPO},
                          start_timeout=180)
     assert codes == [0, 0, 0, 0]
+
+
+HYBRID_WORKER = textwrap.dedent("""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    def fn():
+        r = hvd.rank()
+        assert hvd.size() == 4, hvd.size()
+        assert hvd.local_size() == 2, hvd.local_size()
+        assert hvd.cross_size() == 2, hvd.cross_size()
+        out = hvd.allreduce(np.ones(2, np.float32) * (r + 1),
+                            op=hvd.Sum, name="hybrid")
+        assert np.allclose(out, 10.0), out
+        g = hvd.allgather(np.full((1, 2), float(r), np.float32),
+                          name="hg")
+        assert g.shape == (4, 2)
+        return r
+
+    ranks = hvd.run(fn)     # np from the launcher's env contract
+    print(f"HYBRID OK {sorted(ranks)}")
+""")
+
+
+@pytest.mark.integration
+def test_hybrid_procs_with_rank_threads(tmp_path):
+    """The TPU pod shape: one process per (simulated) host, each
+    driving two ranks as threads — hvd.run() picks the local rank
+    count from the env contract without touching jax.devices() before
+    jax.distributed comes up, and collectives span all four ranks."""
+    from horovod_tpu.runner.proc_run import launch_procs
+
+    script = tmp_path / "worker.py"
+    script.write_text(HYBRID_WORKER)
+    codes = launch_procs([sys.executable, str(script)], np=4,
+                         ranks_per_proc=2,
+                         hosts="localhost:1,127.0.0.1:1",
+                         platform="cpu", env={"PYTHONPATH": REPO},
+                         start_timeout=180)
+    assert codes == [0, 0]

@@ -206,6 +206,14 @@ def init(comm=None, process_sets=None, num_ranks=None, devices=None):
             platform = env_mod.get_str(env_mod.HOROVOD_TPU_PLATFORM)
             devices = jax.devices(platform) if platform else jax.devices()
         config = env_mod.Config()
+        # each process records its own local ranks; the rank-0 process
+        # keeps the user's HOROVOD_TIMELINE path (reference
+        # docs/timeline.rst names rank 0's file) and the others write
+        # suffixed siblings — same-path clobbering on a shared
+        # filesystem would otherwise corrupt the trace
+        if config.timeline_filename and rank_offset != 0:
+            root, ext = os.path.splitext(config.timeline_filename)
+            config.timeline_filename = f"{root}.proc{proc_id}{ext}"
         _timeline = _make_timeline(config)
         _engine = Engine(num_ranks, devices, config=config,
                          topology=_topology, timeline=_timeline,

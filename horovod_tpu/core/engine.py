@@ -219,7 +219,17 @@ class Engine:
         set DRAIN before the set disappears — so a fast rank cannot
         kill work its peers (or it itself, via an unsynchronized async
         handle) still have outstanding.  Returns True once the set is
-        gone."""
+        gone.
+
+        Multi-process contract (weaker than the reference's coordinated
+        removal): the vote barrier spans only the LOCAL rank threads of
+        this process.  With one rank per process, removal finalizes
+        locally after the drain timeout without a cross-process
+        rendezvous — a fast process may drop the set while a peer still
+        has a collective on it mid-negotiation; that peer's collective
+        then fails with ProcessSetError rather than deadlocking.
+        Callers needing a strict cross-process barrier should issue
+        ``barrier(process_set=ps)`` immediately before removal."""
         if ps_id == 0:
             raise ValueError("cannot remove the global process set")
         timeout = self.config.ps_removal_timeout_secs

@@ -148,6 +148,11 @@ class _GradSync:
                  gradient_predivide_factor=1.0,
                  process_set=global_process_set,
                  scale_local_gradients=True):
+        if gradient_predivide_factor != 1.0 and op != Average:
+            # match the torch frontend and the reference
+            # (tensorflow/__init__.py:957-961)
+            raise ValueError("gradient_predivide_factor not supported "
+                             "with op != Average")
         self.compression = compression
         self.op = op
         self.gradient_predivide_factor = gradient_predivide_factor
@@ -224,11 +229,16 @@ class _GradSync:
     def _reduce_dense(self, dense):
         """Eager grouped allreduce of a flat dense list."""
         comp, ctxs = zip(*[self.compression.compress(g) for g in dense])
-        prescale = 1.0
+        prescale, postscale = 1.0, 1.0
         if self.op == Average and self.gradient_predivide_factor != 1.0:
+            # split the average as prescale=1/gpf, postscale=gpf (the
+            # engine applies a further 1/size for Average), matching
+            # reference tensorflow/__init__.py:553-554
             prescale = 1.0 / self.gradient_predivide_factor
+            postscale = self.gradient_predivide_factor
         outs = grouped_allreduce(list(comp), op=self.op,
                                  prescale_factor=prescale,
+                                 postscale_factor=postscale,
                                  process_set=self.process_set)
         if not isinstance(outs, list):
             outs = [outs]

@@ -53,7 +53,10 @@ class SyncBatchNormalization(tf.keras.layers.BatchNormalization):
                             process_set=self.process_set)
         out = tf.convert_to_tensor(out)
         n = tf.size(mean)
-        total = out[-1]
+        # guard against a fully-masked/empty batch on every rank: with
+        # total == 0 the packed sums are also 0, so dividing by 1
+        # yields zero moments instead of NaN
+        total = tf.maximum(out[-1], 1.0)
         g_mean = tf.reshape(out[:n] / total, tf.shape(mean))
         g_sqmean = tf.reshape(out[n:-1] / total, tf.shape(mean))
         g_var = g_sqmean - tf.square(g_mean)

@@ -39,6 +39,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         self.gradient_predivide_factor = gradient_predivide_factor
         self.sparse_as_dense = sparse_as_dense
         self.process_set = process_set
+        self._sparse_scale_warned = False
 
         if named_parameters is not None:
             named_parameters = list(named_parameters)
@@ -188,21 +189,37 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         if grad.is_sparse:
             # true-sparse path: allgather of indices/values (reference
             # optimizer.py:194-198 → mpi_ops.py sparse_allreduce_async)
+            if not self._sparse_scale_warned and (
+                    self._compression is not Compression.none
+                    or self.gradient_predivide_factor != 1.0):
+                warnings.warn(
+                    "sparse gradients bypass compression and "
+                    "gradient_predivide_factor: the sparse allreduce "
+                    "moves exact index/value pairs uncompressed and "
+                    "averages without the pre/postscale split",
+                    stacklevel=2)
+                self._sparse_scale_warned = True
             from .mpi_ops import sparse_allreduce_async
             handle = sparse_allreduce_async(
                 grad, name=self._name(p), op=self.op,
                 process_set=self.process_set)
             return handle, ("sparse",)
         tensor_compressed, ctx = self._compression.compress(grad)
-        if self.op == Average:
-            prescale = 1.0 / self.gradient_predivide_factor \
-                if self.gradient_predivide_factor != 1.0 else 1.0
-        else:
-            prescale = 1.0
+        prescale, postscale = self._scale_factors()
         handle = api.allreduce_async(
             tensor_compressed, name=self._name(p), op=self.op,
-            prescale_factor=prescale, process_set=self.process_set)
+            prescale_factor=prescale, postscale_factor=postscale,
+            process_set=self.process_set)
         return handle, ctx
+
+    def _scale_factors(self):
+        """Split the average as prescale=1/gpf, postscale=gpf (the
+        engine applies a further 1/size for Average), matching
+        reference tensorflow/__init__.py:553-554 / torch optimizer."""
+        if self.op == Average and self.gradient_predivide_factor != 1.0:
+            return (1.0 / self.gradient_predivide_factor,
+                    self.gradient_predivide_factor)
+        return 1.0, 1.0
 
     def _grouped_allreduce_async(self, gi):
         group = self._groups[gi]
@@ -211,8 +228,10 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             t, c = self._compression.compress(self._prepare_grad(p))
             tensors.append(t)
             ctxs.append(c)
+        prescale, postscale = self._scale_factors()
         handle = api.grouped_allreduce_async(
             tensors, op=self.op, name=f"group.{gi}",
+            prescale_factor=prescale, postscale_factor=postscale,
             process_set=self.process_set)
         for p, c in zip(group, ctxs):
             self._handles[p] = (handle, ("group", gi, c))

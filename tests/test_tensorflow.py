@@ -461,3 +461,38 @@ def test_tensorflow_keras_alias_module(hvd_shutdown):
         return True
 
     assert all(run_ranks(fn))
+
+
+def test_tf_tape_gradient_predivide(hvd_shutdown):
+    """op=Average + gradient_predivide_factor != 1 yields the plain
+    average (prescale=1/gpf, postscale=gpf split; reference
+    tensorflow/__init__.py:553-554)."""
+    def fn():
+        r = hvd.rank()
+        w = tf.Variable([[1.0], [1.0]])
+        x = tf.constant([[float(r + 1), 2.0 * (r + 1)]])
+        with hvd.DistributedGradientTape(
+                gradient_predivide_factor=2.0) as tape:
+            y = tf.reduce_sum(tf.matmul(x, w))
+        grad = tape.gradient(y, [w])[0]
+        mean_scale = np.mean([i + 1 for i in range(NP)])
+        assert np.allclose(grad.numpy(),
+                           [[mean_scale], [2.0 * mean_scale]]), \
+            grad.numpy()
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_tf_sync_batch_norm_all_masked(hvd_shutdown):
+    """A fully-masked batch on every rank yields finite (zero)
+    moments, not NaN (total-count guard)."""
+    def fn():
+        bn = hvd.SyncBatchNormalization(axis=-1)
+        x = tf.zeros((2, 3))
+        mask = tf.zeros((2,), dtype=tf.bool)
+        out = bn(x, training=True, mask=mask)
+        assert np.all(np.isfinite(out.numpy()))
+        return True
+
+    assert all(run_ranks(fn))

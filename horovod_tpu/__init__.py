@@ -48,4 +48,8 @@ from .ops.api import (  # noqa: F401
     broadcast_object, allgather_object,
 )
 from .ops.compression import Compression  # noqa: F401
+from .ops.compiled import (  # noqa: F401
+    compiled_allreduce, compiled_grouped_allreduce,
+    CompiledGroupedAllreduce, make_compiled_train_step,
+)
 from .runner.thread_launcher import run  # noqa: F401

@@ -313,6 +313,8 @@ def shutdown():
             _timeline.close()
         from . import process_sets as ps_mod
         ps_mod._reset()
+        from ..ops import compiled as _compiled
+        _compiled.reset_compiled_state()
         was_multiproc = _engine.multiproc
         was_aborted = _engine._aborted is not None
         _engine = None

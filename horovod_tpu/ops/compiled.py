@@ -1,0 +1,594 @@
+"""In-program (compiled-step) collectives — the TPU-native analogue of
+the reference's XLA ops (``horovod/tensorflow/xla_mpi_ops.cc:185-307``,
+``CallbackHVDAllreduce`` / ``SCHEDULE_EARLIEST``..``SCHEDULE_LATEST``
+CustomCall pairs) and graph-mode AsyncOpKernels
+(``horovod/tensorflow/mpi_ops.cc:446-501``).
+
+Where the reference injects opaque CustomCalls into the user's XLA
+graph and services them from the background engine, on TPU the
+collective IS an XLA op: ``lax.psum`` compiled over the process set's
+``Mesh``.  So the "in-graph" path here skips the engine entirely —
+gradient reduction (or the whole train step) is ONE cached jitted
+program, collectives scheduled by XLA alongside the surrounding
+compute, exactly the overlap the reference's SCHEDULE_EARLIEST /
+SCHEDULE_LATEST hints exist to approximate.
+
+Contract (same as the reference XLA-ops path): every member rank must
+enter the same compiled collective in the same order with the same
+shapes — there is no negotiation, no readiness cycle, no stall
+inspector on this path.  Use the engine API (``hvd.allreduce``) when
+ranks may issue collectives in data-dependent order.
+
+Two deliverables live here:
+
+* ``CompiledGroupedAllreduce`` — a per-process-set grouped allreduce
+  as one compiled program: host buffers are packed per dtype (the
+  fusion-buffer role), staged once, reduced by a single XLA program,
+  and split on the way out.  One host sync per call, regardless of
+  how many tensors are in the group.  The TF frontend's traced path
+  rides this (``HOROVOD_ENABLE_XLA_OPS``).
+* ``make_compiled_train_step`` — the full Horovod training step
+  (forward, backward, gradient pmean, optimizer update) jitted as one
+  program over the process set's device mesh.  This is the headline
+  TPU design: the reference needs tape hooks + NCCL launches because
+  its compiler cannot see the collective; XLA can, so the entire step
+  fuses.
+"""
+
+import threading
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..common import basics
+from ..common.process_sets import ProcessSet, global_process_set
+from ..core.message import Average, ReduceOp, Sum
+from .xla_ops import shard_map, _is_float
+
+__all__ = [
+    "CompiledGroupedAllreduce", "compiled_allreduce",
+    "compiled_grouped_allreduce", "make_compiled_train_step",
+]
+
+
+def _ps_state(process_set):
+    eng = basics.engine()
+    ps_id = 0
+    if isinstance(process_set, ProcessSet):
+        if process_set.process_set_id is None:
+            raise ValueError("process set is not registered")
+        ps_id = process_set.process_set_id
+    elif process_set is not None:
+        ps_id = int(process_set)
+    ps = eng.process_sets.get(ps_id)
+    if ps is None:
+        raise ValueError(f"unknown process set {ps_id}")
+    return eng, ps
+
+
+class _Rendezvous:
+    """Meeting point for the local rank threads of one process set.
+
+    Compiled programs are one-per-process: when several ranks live in
+    this process (thread launcher, or several chips per host), every
+    local rank delivers its operand, the LAST arrival runs the program
+    once, and all pick up their result.  Plays the role the engine's
+    negotiation plays for the queued path, at ~condvar cost.
+
+    Rendezvous instances live in a process-global registry keyed by
+    (process set, collective identity): rank threads each construct
+    their own ``CompiledGroupedAllreduce`` / train-step objects (the
+    SPMD style — every rank runs the same code), and equivalent
+    objects meet at the same rendezvous.
+    """
+
+    def __init__(self, n):
+        self.n = n
+        self._cond = threading.Condition()
+        self._slots = {}
+        self._result = None
+        self._error = None
+        self._generation = 0
+
+    def run(self, pos, value, fn):
+        """Deliver ``value`` for participant ``pos``; returns ``fn``'s
+        result (computed once per generation on the full slot dict)."""
+        with self._cond:
+            gen = self._generation
+            if pos in self._slots:
+                raise RuntimeError(
+                    f"participant {pos} entered the compiled collective "
+                    "twice in one round (peer missing?)")
+            self._slots[pos] = value
+            if len(self._slots) == self.n:
+                slots, self._slots = self._slots, {}
+                try:
+                    self._result = (fn(slots), None)
+                except BaseException as e:  # propagate to every waiter
+                    self._result = (None, e)
+                self._generation = gen + 1
+                self._cond.notify_all()
+            else:
+                while self._generation == gen:
+                    if not self._cond.wait(timeout=600):
+                        raise RuntimeError(
+                            "compiled collective rendezvous timed out "
+                            "(a local rank never arrived)")
+            result, err = self._result
+            if err is not None:
+                raise err
+            return result
+
+
+def _caller_pos(eng, ps):
+    """Position (index into the set's rank list) of the calling rank
+    thread; None for an unbound (driver-mode) caller."""
+    try:
+        rank = basics.context().rank
+    except Exception:
+        return None
+    if rank not in ps.index:
+        raise ValueError(
+            f"rank {rank} is not a member of process set {ps.id}")
+    return ps.index[rank]
+
+
+# process-global rendezvous registry: equivalent per-rank objects meet
+# here (cleared on shutdown via reset_compiled_state)
+_RDV_REGISTRY = {}
+_RDV_LOCK = threading.Lock()
+_STEP_COUNTERS = {}
+# shared compiled-program cache: whichever rank leads a round reuses
+# the program any previous leader built (one compile per process)
+_PROGRAM_CACHE = {}
+_PROGRAM_LOCK = threading.Lock()
+
+
+def _shared_program(key, builder):
+    with _PROGRAM_LOCK:
+        prog = _PROGRAM_CACHE.get(key)
+        if prog is None:
+            prog = builder()
+            _PROGRAM_CACHE[key] = prog
+        return prog
+
+
+def _rendezvous_for(ps, tag, n):
+    key = (ps.id, tag)
+    with _RDV_LOCK:
+        rdv = _RDV_REGISTRY.get(key)
+        if rdv is None or rdv.n != n:
+            rdv = _Rendezvous(n)
+            _RDV_REGISTRY[key] = rdv
+        return rdv
+
+
+class CompiledGroupedAllreduce:
+    """Grouped allreduce as ONE compiled XLA program per shape
+    signature (reference ``xla_mpi_ops.cc:185-307`` role).
+
+    Call per local rank (or once per process in one-rank-per-process
+    deployments) with a list of numpy arrays; returns the reduced
+    arrays, same shapes/dtypes.  All member ranks must call with the
+    same signature — no negotiation happens.  ``name`` identifies the
+    collective stream when rank threads share a process; instances
+    with the same (op, scales, process set, name) meet at one
+    rendezvous.
+    """
+
+    def __init__(self, op=Average, prescale_factor=1.0,
+                 postscale_factor=1.0, process_set=global_process_set,
+                 name=None):
+        op = ReduceOp(op)
+        if op not in (Average, Sum):
+            raise ValueError(
+                "compiled allreduce supports Average and Sum (the "
+                "reference XLA op surface, xla_mpi_ops.cc:558-603)")
+        self.op = op
+        self.prescale = float(prescale_factor)
+        self.postscale = float(postscale_factor)
+        self.process_set = process_set
+        self.name = name
+        self._programs = {}
+        self._lock = threading.Lock()
+
+    # -- program construction ------------------------------------------------
+
+    def _signature(self, arrays):
+        return tuple((a.shape, str(a.dtype)) for a in arrays)
+
+    def _plan(self, arrays):
+        """Group leaves by dtype → per-dtype pack layout (the fusion
+        buffer, computed once per signature)."""
+        groups = {}   # dtype str -> list of (index, size, shape)
+        for i, a in enumerate(arrays):
+            groups.setdefault(str(a.dtype), []).append(
+                (i, int(a.size), a.shape))
+        order = sorted(groups)   # deterministic across ranks
+        return [(d, groups[d]) for d in order]
+
+    def _build(self, ex, plan):
+        R = ex.num_ranks
+        op, pre, post = self.op, self.prescale, self.postscale
+
+        def reduce_buf(x, dtype):
+            # x: (1, n) per-rank block (shard) or (R, n) stacked
+            fl = _is_float(dtype)
+            if fl and pre != 1.0:
+                x = (x.astype(jnp.float32) * pre).astype(x.dtype)
+            if ex.shard_mode:
+                y = lax.psum(x, "hvd")
+            else:
+                y = jnp.sum(x, axis=0, keepdims=True)
+            scale = post
+            if op == Average:
+                scale = post / R
+            if fl and scale != 1.0:
+                y = (y.astype(jnp.float32) * scale).astype(y.dtype)
+            elif not fl and op == Average:
+                raise ValueError("Average needs floating-point tensors")
+            return y
+
+        dtypes = [d for d, _ in plan]
+
+        if ex.shard_mode:
+            def body(*bufs):
+                return tuple(reduce_buf(b, d)
+                             for b, d in zip(bufs, dtypes))
+
+            prog = shard_map(
+                body, mesh=ex.mesh,
+                in_specs=tuple(P("hvd") for _ in plan),
+                out_specs=tuple(P() for _ in plan))
+            return jax.jit(prog)
+
+        def stacked(*bufs):
+            return tuple(reduce_buf(b, d)[0] for b, d in zip(bufs, dtypes))
+
+        return jax.jit(stacked)
+
+    def _program(self, ex, sig, plan):
+        with self._lock:
+            entry = self._programs.get(sig)
+            if entry is None:
+                key = ("reduce", id(ex), int(self.op), self.prescale,
+                       self.postscale, sig)
+                entry = _shared_program(key,
+                                        lambda: self._build(ex, plan))
+                self._programs[sig] = entry
+            return entry
+
+    # -- host packing --------------------------------------------------------
+
+    @staticmethod
+    def _pack(arrays, plan):
+        """One contiguous host buffer per dtype (fusion-buffer pack)."""
+        bufs = []
+        for dtype, members in plan:
+            parts = [np.ascontiguousarray(arrays[i]).reshape(-1)
+                     for i, _, _ in members]
+            bufs.append(parts[0] if len(parts) == 1
+                        else np.concatenate(parts))
+        return bufs
+
+    @staticmethod
+    def _unpack(bufs, plan):
+        outs = {}
+        for buf, (dtype, members) in zip(bufs, plan):
+            # writable host copy, one per dtype; programs return the
+            # packed buffer as a (1, n) block — flatten it
+            host = np.array(buf).reshape(-1)
+            off = 0
+            for i, size, shape in members:
+                outs[i] = host[off:off + size].reshape(shape)
+                off += size
+        return [outs[i] for i in range(len(outs))]
+
+    # -- execution -----------------------------------------------------------
+
+    def __call__(self, arrays):
+        arrays = [np.asarray(a) for a in arrays]
+        if not arrays:
+            return []
+        eng, ps = _ps_state(self.process_set)
+        ex = ps.executor
+        if ex.num_ranks == 1:
+            scale = self.prescale * self.postscale
+            if scale != 1.0:
+                return [(a.astype(np.float32) * scale).astype(a.dtype)
+                        for a in arrays]
+            return [a.copy() for a in arrays]
+        sig = self._signature(arrays)
+        plan = self._plan(arrays)
+        prog = self._program(ex, sig, plan)
+        n_local = len(ex.local_positions)
+
+        def launch(slot_bufs):
+            # slot_bufs: {pos: [buf per dtype]} for the local ranks
+            staged = []
+            for k in range(len(plan)):
+                rows = [slot_bufs[pos][k] for pos in ex.local_positions]
+                staged.append(self._stage(ex, rows))
+            return prog(*staged)
+
+        my_bufs = self._pack(arrays, plan)
+        if n_local == 1:
+            out = launch({ex.local_positions[0]: my_bufs})
+        else:
+            pos = _caller_pos(eng, ps)
+            if pos is None:
+                raise ValueError(
+                    "unbound caller: compiled collectives need a rank "
+                    "context (call inside hvd.run / a launched worker)")
+            tag = ("reduce", int(self.op), self.prescale, self.postscale,
+                   self.name)
+            rdv = _rendezvous_for(ps, tag, n_local)
+            out = rdv.run(pos, my_bufs, launch)
+        return self._unpack(out, plan)
+
+    @staticmethod
+    def _stage(ex, rows):
+        """Per-local-rank flat buffers → device operand ((R, n) sharded
+        row-per-rank in shard mode, stacked otherwise)."""
+        if ex.shard_mode:
+            shape = (ex.num_ranks, rows[0].size)
+            shards = [jax.device_put(r[None], ex.devices[pos])
+                      for r, pos in zip(rows, ex.local_positions)]
+            return jax.make_array_from_single_device_arrays(
+                shape, ex._row_sharding, shards)
+        return jax.device_put(np.stack(rows), ex.devices[0])
+
+
+# module-level cache so hot paths reuse programs across calls
+_REDUCERS = {}
+_REDUCERS_LOCK = threading.Lock()
+
+
+def _reducer(op, prescale_factor, postscale_factor, process_set):
+    ps_id = process_set.process_set_id \
+        if isinstance(process_set, ProcessSet) else int(process_set or 0)
+    key = (int(ReduceOp(op)), float(prescale_factor),
+           float(postscale_factor), ps_id)
+    with _REDUCERS_LOCK:
+        red = _REDUCERS.get(key)
+        if red is None:
+            red = CompiledGroupedAllreduce(
+                op=op, prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor, process_set=process_set)
+            _REDUCERS[key] = red
+        return red
+
+
+def compiled_grouped_allreduce(arrays, op=Average, prescale_factor=1.0,
+                               postscale_factor=1.0,
+                               process_set=global_process_set):
+    """Grouped allreduce through one compiled program (no engine)."""
+    return _reducer(op, prescale_factor, postscale_factor,
+                    process_set)(arrays)
+
+
+def compiled_allreduce(array, op=Average, prescale_factor=1.0,
+                       postscale_factor=1.0,
+                       process_set=global_process_set):
+    """Single-tensor convenience over ``compiled_grouped_allreduce``."""
+    return compiled_grouped_allreduce(
+        [array], op=op, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor, process_set=process_set)[0]
+
+
+def reset_compiled_state():
+    """Drop cached reducers/programs/rendezvous (shutdown hook)."""
+    with _REDUCERS_LOCK:
+        _REDUCERS.clear()
+    with _RDV_LOCK:
+        _RDV_REGISTRY.clear()
+        _STEP_COUNTERS.clear()
+    with _PROGRAM_LOCK:
+        _PROGRAM_CACHE.clear()
+
+
+# ----------------------------------------------------------------------------
+# full compiled train step
+
+class _CompiledTrainStep:
+    """See make_compiled_train_step."""
+
+    def __init__(self, loss_fn, optimizer, op, process_set, donate):
+        op = ReduceOp(op)
+        if op not in (Average, Sum):
+            raise ValueError("op must be Average or Sum")
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.op = op
+        self.process_set = process_set
+        self.donate = donate
+        self._prog = None
+        self._tag = None
+        self._lock = threading.Lock()
+
+    # -- program -------------------------------------------------------------
+
+    def _build(self, ex):
+        loss_fn, optimizer, op = self.loss_fn, self.optimizer, self.op
+
+        import optax
+
+        def update(params, opt_state, grads):
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        if ex.shard_mode:
+            def body(state, batch_rows):
+                batch = jax.tree.map(lambda x: x[0], batch_rows)
+                loss, grads = jax.value_and_grad(loss_fn)(
+                    state["params"], batch)
+                if op == Average:
+                    grads = jax.tree.map(
+                        lambda g: lax.pmean(g, "hvd"), grads)
+                else:
+                    grads = jax.tree.map(
+                        lambda g: lax.psum(g, "hvd"), grads)
+                loss = lax.pmean(loss, "hvd")
+                params, opt_state = update(
+                    state["params"], state["opt_state"], grads)
+                return {"params": params, "opt_state": opt_state}, loss
+
+            # check_vma=False: jax 0.9's varying-manual-axes checker
+            # mistypes cotangents of values closed over by the loss as
+            # axis-invariant, turning the gradient psum into a
+            # size-N multiplication (same workaround as
+            # parallel/_shard_map.make_attention_fn)
+            prog = shard_map(body, mesh=ex.mesh,
+                             in_specs=(P(), P("hvd")),
+                             out_specs=(P(), P()),
+                             check_vma=False)
+        else:
+            def prog(state, batch_rows):   # stacked: (R, ...) leaves
+                losses, grads = jax.vmap(
+                    jax.value_and_grad(loss_fn),
+                    in_axes=(None, 0))(state["params"], batch_rows)
+                if op == Average:
+                    grads = jax.tree.map(lambda g: jnp.mean(g, axis=0),
+                                         grads)
+                else:
+                    grads = jax.tree.map(lambda g: jnp.sum(g, axis=0),
+                                         grads)
+                loss = jnp.mean(losses)
+                params, opt_state = update(
+                    state["params"], state["opt_state"], grads)
+                return {"params": params, "opt_state": opt_state}, loss
+
+        donate = (0,) if self.donate else ()
+        return jax.jit(prog, donate_argnums=donate)
+
+    # -- staging -------------------------------------------------------------
+
+    def init_state(self, params):
+        """Build a replicated device-resident train state from host (or
+        device) params."""
+        eng, ps = _ps_state(self.process_set)
+        ex = ps.executor
+        opt_state = self.optimizer.init(params)
+        state = {"params": params, "opt_state": opt_state}
+        if ex.shard_mode:
+            rep = NamedSharding(ex.mesh, P())
+
+            def put(x):
+                x = np.asarray(x)
+                return jax.make_array_from_callback(
+                    x.shape, rep, lambda idx: x[idx])
+
+            return jax.tree.map(put, state)
+        return jax.tree.map(
+            lambda x: jax.device_put(np.asarray(x), ex.devices[0]), state)
+
+    def _stage_batch(self, ex, slots):
+        """{pos: batch_tree} for local ranks → global (R, ...) batch."""
+        trees = [slots[pos] for pos in ex.local_positions]
+        leaves0, treedef = jax.tree.flatten(trees[0])
+        all_leaves = [jax.tree.flatten(t)[0] for t in trees]
+        staged = []
+        for k in range(len(leaves0)):
+            rows = [np.asarray(lv[k]) for lv in all_leaves]
+            if ex.shard_mode:
+                shape = (ex.num_ranks,) + rows[0].shape
+                sharding = NamedSharding(
+                    ex.mesh, P("hvd", *([None] * rows[0].ndim)))
+                shards = [jax.device_put(r[None], ex.devices[pos])
+                          for r, pos in zip(rows, ex.local_positions)]
+                staged.append(jax.make_array_from_single_device_arrays(
+                    shape, sharding, shards))
+            else:
+                staged.append(jax.device_put(np.stack(rows),
+                                             ex.devices[0]))
+        return jax.tree.unflatten(treedef, staged)
+
+    # -- call ----------------------------------------------------------------
+
+    def _program(self, ex):
+        # built lazily by whichever rank leads first; later leaders
+        # (other instances) reuse it via the shared cache so there is
+        # exactly one compile per process
+        with self._lock:
+            if self._prog is None:
+                if self._tag is not None:
+                    key = ("step", id(ex), self._tag)
+                    self._prog = _shared_program(
+                        key, lambda: self._build(ex))
+                else:
+                    self._prog = self._build(ex)
+            return self._prog
+
+    def _step_tag(self, ps, rank):
+        """Creation-order identity: rank r's Nth first-called compiled
+        step pairs with rank s's Nth (ranks run the same program —
+        the deterministic-order contract this whole path carries)."""
+        with self._lock:
+            if self._tag is None:
+                with _RDV_LOCK:
+                    key = (ps.id, rank)
+                    idx = _STEP_COUNTERS.get(key, 0)
+                    _STEP_COUNTERS[key] = idx + 1
+                self._tag = ("step", idx)
+            return self._tag
+
+    def __call__(self, state, batch):
+        """Run one step with THIS rank's ``batch``; returns
+        ``(new_state, loss)``.  All member ranks call per step."""
+        eng, ps = _ps_state(self.process_set)
+        ex = ps.executor
+        n_local = len(ex.local_positions)
+
+        if n_local == 1:
+            prog = self._program(ex)
+            batches = {ex.local_positions[0]: batch}
+            return prog(state, self._stage_batch(ex, batches))
+        pos = _caller_pos(eng, ps)
+        if pos is None:
+            raise ValueError(
+                "unbound caller: run the compiled step from rank "
+                "threads (hvd.run) or one-rank-per-process workers")
+        rdv = _rendezvous_for(ps, self._step_tag(ps, basics.context().rank),
+                              n_local)
+
+        def launch_rdv(slots):
+            # every rank passed the same (shared/replicated) state;
+            # the leader's program runs with the first slot's state
+            st = slots[sorted(slots)[0]][0]
+            batches = {p: slots[p][1] for p in slots}
+            return self._program(ex)(st, self._stage_batch(ex, batches))
+
+        return rdv.run(pos, (state, batch), launch_rdv)
+
+
+def make_compiled_train_step(loss_fn, optimizer, *, op=Average,
+                             process_set=global_process_set,
+                             donate=True):
+    """Build the fully-compiled Horovod train step (reference
+    ``xla_mpi_ops.cc`` capability, done the TPU way).
+
+    ``loss_fn(params, batch) -> scalar`` is the user's per-rank loss;
+    ``optimizer`` is an optax transform.  Returns a callable
+    ``step(state, batch) -> (state, loss)`` where forward, backward,
+    cross-rank gradient reduction (``lax.pmean`` over the process
+    set's mesh axis) and the optimizer update run as ONE XLA program —
+    zero host syncs beyond fetching ``loss``; XLA overlaps the
+    collectives with backward compute (the scheduling the reference
+    approximates with SCHEDULE_EARLIEST/LATEST CustomCall hints).
+
+    Use ``step.init_state(params)`` to build the replicated train
+    state.  Every member rank of ``process_set`` must call ``step``
+    each iteration (same shapes — no negotiation on this path).
+
+    Example (per rank)::
+
+        step = hvd.make_compiled_train_step(loss_fn, optax.adam(1e-3))
+        state = step.init_state(params)
+        for batch in shard_of_data:
+            state, loss = step(state, batch)
+    """
+    return _CompiledTrainStep(loss_fn, optimizer, op, process_set, donate)

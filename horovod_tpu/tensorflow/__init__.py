@@ -10,6 +10,7 @@ through zero-copy ``.numpy()`` views and the fused collective runs as
 a compiled XLA program on the TPU mesh.
 """
 
+import numpy as np
 import tensorflow as tf
 
 from ..common import basics as _basics
@@ -147,7 +148,8 @@ class _GradSync:
     def __init__(self, compression=Compression.none, op=Average,
                  gradient_predivide_factor=1.0,
                  process_set=global_process_set,
-                 scale_local_gradients=True):
+                 scale_local_gradients=True,
+                 use_compiled_ops=None):
         if gradient_predivide_factor != 1.0 and op != Average:
             # match the torch frontend and the reference
             # (tensorflow/__init__.py:957-961)
@@ -158,6 +160,15 @@ class _GradSync:
         self.gradient_predivide_factor = gradient_predivide_factor
         self.process_set = process_set
         self.scale_local_gradients = scale_local_gradients
+        # in-program collective path (reference HOROVOD_ENABLE_XLA_OPS,
+        # xla_mpi_ops.cc:258-270 opt-in registrar): grads reduce via one
+        # compiled XLA program instead of the engine's negotiated queue
+        if use_compiled_ops is None:
+            from ..common import env as _env
+            use_compiled_ops = _env.get_bool("HOROVOD_ENABLE_XLA_OPS")
+        self.use_compiled_ops = bool(use_compiled_ops) \
+            and op in (Average, Sum)
+        self._compiled_reducer = None
         # local (non-synced) variables, reference tensorflow/__init__.py
         # register_local_source / scale_local_gradients (:1029-1100)
         self.local_vars = set()
@@ -226,24 +237,45 @@ class _GradSync:
             flat[i] = o
         return tf.nest.pack_sequence_as(grads, flat)
 
-    def _reduce_dense(self, dense):
-        """Eager grouped allreduce of a flat dense list."""
-        comp, ctxs = zip(*[self.compression.compress(g) for g in dense])
-        prescale, postscale = 1.0, 1.0
+    def _scale_split(self):
         if self.op == Average and self.gradient_predivide_factor != 1.0:
             # split the average as prescale=1/gpf, postscale=gpf (the
             # engine applies a further 1/size for Average), matching
             # reference tensorflow/__init__.py:553-554
-            prescale = 1.0 / self.gradient_predivide_factor
-            postscale = self.gradient_predivide_factor
-        outs = grouped_allreduce(list(comp), op=self.op,
-                                 prescale_factor=prescale,
-                                 postscale_factor=postscale,
-                                 process_set=self.process_set)
+            return (1.0 / self.gradient_predivide_factor,
+                    self.gradient_predivide_factor)
+        return 1.0, 1.0
+
+    def _reduce_dense(self, dense):
+        """Eager grouped allreduce of a flat dense list."""
+        comp, ctxs = zip(*[self.compression.compress(g) for g in dense])
+        prescale, postscale = self._scale_split()
+        if self.use_compiled_ops:
+            outs = self._reduce_compiled(list(comp), prescale, postscale)
+        else:
+            outs = grouped_allreduce(list(comp), op=self.op,
+                                     prescale_factor=prescale,
+                                     postscale_factor=postscale,
+                                     process_set=self.process_set)
         if not isinstance(outs, list):
             outs = [outs]
         return [self.compression.decompress(o, c)
                 for o, c in zip(outs, ctxs)]
+
+    def _reduce_compiled(self, comp, prescale, postscale):
+        """One compiled XLA program for the whole gradient group — the
+        in-graph path (reference xla_mpi_ops.cc:185-307 capability):
+        no negotiation, one host hop per step."""
+        if self._compiled_reducer is None:
+            from ..ops.compiled import CompiledGroupedAllreduce
+            self._compiled_reducer = CompiledGroupedAllreduce(
+                op=self.op, prescale_factor=prescale,
+                postscale_factor=postscale,
+                process_set=self.process_set, name="grad_sync")
+        arrs = [t.numpy() if hasattr(t, "numpy") else np.asarray(t)
+                for t in comp]
+        outs = self._compiled_reducer(arrs)
+        return [tf.convert_to_tensor(o) for o in outs]
 
     def sync(self, grads, sources=None):
         """allreduce_grads, but gradients of registered local sources
@@ -278,14 +310,15 @@ class DistributedGradientTape(tf.GradientTape):
                  op=Average, gradient_predivide_factor=1.0,
                  num_groups=0, groups=None,
                  process_set=global_process_set,
-                 scale_local_gradients=True):
+                 scale_local_gradients=True, use_compiled_ops=None):
         super().__init__(persistent=persistent,
                          watch_accessed_variables=watch_accessed_variables)
         self._sync = _GradSync(
             compression=compression, op=op,
             gradient_predivide_factor=gradient_predivide_factor,
             process_set=process_set,
-            scale_local_gradients=scale_local_gradients)
+            scale_local_gradients=scale_local_gradients,
+            use_compiled_ops=use_compiled_ops)
 
     def register_local_source(self, var):
         """Exclude ``var``'s gradient from allreduce (kept local)."""
@@ -354,6 +387,7 @@ def PartialDistributedGradientTape(gradtape=None, device_dense="",
                                    process_set=global_process_set,
                                    local_layers=None,
                                    scale_local_gradients=True,
+                                   use_compiled_ops=None,
                                    **tape_kwargs):
     """DistributedGradientTape that skips allreduce for the gradients
     of ``local_layers`` (reference tensorflow/__init__.py:1189).  When
@@ -365,7 +399,8 @@ def PartialDistributedGradientTape(gradtape=None, device_dense="",
             compression=compression, op=op,
             gradient_predivide_factor=gradient_predivide_factor,
             process_set=process_set,
-            scale_local_gradients=scale_local_gradients))
+            scale_local_gradients=scale_local_gradients,
+            use_compiled_ops=use_compiled_ops))
     else:
         tape = DistributedGradientTape(
             compression=compression, sparse_as_dense=sparse_as_dense,
@@ -407,47 +442,73 @@ def DistributedOptimizer(optimizer, name=None,
             self._hvd_sync.register_local_var(var)
 
         def apply_gradients(self, grads_and_vars, *args, **kwargs):
-            if not tf.executing_eagerly() and bpps > 1:
-                # the accumulate-or-apply branch below runs on a
-                # Python-side counter, which a tf.function trace would
-                # freeze permanently into one arm.  (bpps == 1 works
-                # traced: the collective itself rides tf.py_function.)
-                raise RuntimeError(
-                    "backward_passes_per_step > 1 requires eager "
-                    "execution; compile with run_eagerly=True "
-                    "(model.compile(..., run_eagerly=True)) or call "
-                    "apply_gradients outside tf.function")
             grads_and_vars = list(grads_and_vars)
             grads = [tf.convert_to_tensor(g)
                      if isinstance(g, tf.IndexedSlices) else g
                      for g, _ in grads_and_vars]
             tvars = [v for _, v in grads_and_vars]
-            if bpps > 1:
-                # local aggregation: accumulate bpps micro-batches, then
-                # allreduce once (reference gradient_aggregation_eager.py)
-                if self._hvd_agg is None:
-                    self._hvd_agg = [
-                        tf.Variable(tf.zeros_like(g), trainable=False)
-                        if g is not None else None for g in grads]
-                for buf, g in zip(self._hvd_agg, grads):
-                    if buf is not None and g is not None:
-                        buf.assign_add(tf.convert_to_tensor(g))
-                self._hvd_counter += 1
-                if self._hvd_counter % bpps != 0:
-                    return None   # grads only accumulated this step
-                grads = [None if buf is None else
-                         (tf.convert_to_tensor(buf) / bpps
-                          if average_aggregated_gradients
-                          else tf.convert_to_tensor(buf))
-                         for buf in self._hvd_agg]
-            grads = self._hvd_sync.sync(grads, tvars)
-            result = super().apply_gradients(
-                list(zip(grads, tvars)), *args, **kwargs)
-            if bpps > 1:
+            if bpps == 1:
+                grads = self._hvd_sync.sync(grads, tvars)
+                return super().apply_gradients(
+                    list(zip(grads, tvars)), *args, **kwargs)
+            return self._apply_aggregated(grads, tvars, *args, **kwargs)
+
+        def _apply_aggregated(self, grads, tvars, *args, **kwargs):
+            """bpps > 1: accumulate micro-batches in graph variables,
+            allreduce + apply every bpps-th call via tf.cond — works
+            both eager and inside a tf.function trace (reference
+            gradient_aggregation.py LocalGradientAggregationHelper's
+            counter/cond design, :103-263)."""
+            if self._hvd_agg is None:
+                # creation must escape the surrounding trace so the
+                # variables persist across calls (reference
+                # _init_aggregation_vars under tf1 variable scoping)
+                shapes = [(g.shape, g.dtype) if g is not None else None
+                          for g in grads]
+                if any(sh is not None and not sh[0].is_fully_defined()
+                       for sh in shapes):
+                    raise ValueError(
+                        "backward_passes_per_step > 1 needs statically "
+                        "shaped gradients")
+                with tf.init_scope():
+                    # traced tensors are out of scope here — build the
+                    # buffers from static shape/dtype only
+                    agg = []
+                    for s in shapes:
+                        if s is None:
+                            agg.append(None)
+                        else:
+                            agg.append(tf.Variable(
+                                tf.zeros(s[0], s[1]), trainable=False))
+                    self._hvd_agg = agg
+                    self._hvd_counter = tf.Variable(
+                        0, dtype=tf.int64, trainable=False)
+            for buf, g in zip(self._hvd_agg, grads):
+                if buf is not None and g is not None:
+                    buf.assign_add(tf.convert_to_tensor(g))
+            self._hvd_counter.assign_add(1)
+            sup = super()   # bind outside the branch closures
+
+            def _flush_and_apply():
+                agg = [None if buf is None else
+                       (tf.convert_to_tensor(buf) / bpps
+                        if average_aggregated_gradients
+                        else tf.convert_to_tensor(buf))
+                       for buf in self._hvd_agg]
+                synced = self._hvd_sync.sync(agg, tvars)
+                sup.apply_gradients(
+                    list(zip(synced, tvars)), *args, **kwargs)
                 for buf in self._hvd_agg:
                     if buf is not None:
                         buf.assign(tf.zeros_like(buf))
-            return result
+                return tf.constant(True)
+
+            def _skip():
+                return tf.constant(False)
+
+            return tf.cond(
+                tf.equal(self._hvd_counter % bpps, 0),
+                _flush_and_apply, _skip)
 
     _Distributed.__name__ = f"Distributed{base_cls.__name__}"
     # swap the class in place so existing slot variables / iteration
@@ -460,7 +521,7 @@ def DistributedOptimizer(optimizer, name=None,
         process_set=process_set,
         scale_local_gradients=scale_local_gradients)
     optimizer._hvd_agg = None
-    optimizer._hvd_counter = 0
+    optimizer._hvd_counter = None
     return optimizer
 
 

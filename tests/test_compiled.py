@@ -1,0 +1,198 @@
+"""Compiled-step (in-program) collective tests — the analogue of the
+reference's XLA-ops tests (``test/parallel/test_tensorflow.py``
+HorovodAllreduce-under-jit cases, ``xla_mpi_ops.cc:185-307`` path):
+grouped allreduce as one XLA program, and the fully-compiled train
+step."""
+
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvd
+
+
+NP = 4
+
+
+def run_ranks(fn, np_ranks=NP):
+    return hvd.run(fn, np=np_ranks)
+
+
+def test_compiled_allreduce_average(hvd_shutdown):
+    def fn():
+        r = hvd.rank()
+        x = np.arange(8, dtype=np.float32) * (r + 1)
+        out = hvd.compiled_allreduce(x)
+        expected = np.arange(8, dtype=np.float32) * \
+            np.mean([i + 1 for i in range(NP)])
+        assert np.allclose(out, expected)
+        out -= 1.0          # results must be writable
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_compiled_allreduce_sum_matches_engine(hvd_shutdown):
+    def fn():
+        r = hvd.rank()
+        x = np.arange(16, dtype=np.float64) + r
+        fast = hvd.compiled_allreduce(x, op=hvd.Sum)
+        slow = hvd.allreduce(x, op=hvd.Sum)
+        assert np.allclose(fast, slow)
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_compiled_grouped_mixed_dtypes(hvd_shutdown):
+    """One program reduces a mixed f32/f64/int32 group (per-dtype
+    fusion packing, reference fusion-buffer role)."""
+    def fn():
+        r = hvd.rank()
+        arrs = [np.ones((3, 4), np.float32) * (r + 1),
+                np.full((5,), float(r), np.float64),
+                np.arange(6, dtype=np.int32) * (r + 1),
+                np.ones((2, 2), np.float32) * r]
+        outs = hvd.compiled_grouped_allreduce(arrs, op=hvd.Sum)
+        s = NP
+        tri = sum(range(1, NP + 1))
+        assert np.allclose(outs[0], np.ones((3, 4)) * tri)
+        assert np.allclose(outs[1], np.full((5,), sum(range(NP))))
+        assert np.array_equal(outs[2], np.arange(6) * tri)
+        assert np.allclose(outs[3], np.ones((2, 2)) * sum(range(NP)))
+        assert outs[0].dtype == np.float32 and outs[1].dtype == np.float64
+        assert outs[2].dtype == np.int32
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_compiled_allreduce_prescale_postscale(hvd_shutdown):
+    """The gpf split (pre=1/f, post=f) must cancel for Average."""
+    def fn():
+        r = hvd.rank()
+        x = np.ones(4, np.float32) * (r + 1)
+        out = hvd.compiled_allreduce(x, prescale_factor=0.5,
+                                     postscale_factor=2.0)
+        assert np.allclose(out, np.mean([i + 1 for i in range(NP)]))
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_compiled_allreduce_int_average_rejected(hvd_shutdown):
+    def fn():
+        with pytest.raises(ValueError):
+            hvd.compiled_allreduce(np.arange(4, dtype=np.int32),
+                                   op=hvd.Average)
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_compiled_allreduce_unsupported_op(hvd_shutdown):
+    def fn():
+        with pytest.raises(ValueError):
+            hvd.compiled_allreduce(np.ones(4, np.float32), op=hvd.Min)
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_compiled_allreduce_process_set(hvd_shutdown):
+    """Compiled collectives scope to a process set's sub-mesh."""
+    def fn():
+        ps = hvd.add_process_set([0, 1])
+        r = hvd.rank()
+        if r in (0, 1):
+            out = hvd.compiled_allreduce(
+                np.ones(4, np.float32) * (r + 1), process_set=ps)
+            assert np.allclose(out, 1.5)
+        hvd.barrier()
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_compiled_train_step_matches_single_rank(hvd_shutdown):
+    """The one-program train step must equal serial SGD on the
+    concatenated global batch (Average semantics)."""
+    W0 = np.ones((3, 1), np.float32)
+
+    def loss_fn(params, batch):
+        import jax.numpy as jnp
+        x, y = batch
+        pred = x @ params["w"]
+        return jnp.mean((pred - y) ** 2)
+
+    def make_data(r):
+        rng = np.random.RandomState(r)
+        x = rng.rand(8, 3).astype(np.float32)
+        y = (x.sum(axis=1, keepdims=True)).astype(np.float32)
+        return x, y
+
+    def fn():
+        step = hvd.make_compiled_train_step(loss_fn, optax.sgd(0.1))
+        state = step.init_state({"w": W0.copy()})
+        x, y = make_data(hvd.rank())
+        for _ in range(5):
+            state, loss = step(state, (x, y))
+        return np.asarray(state["params"]["w"]), float(loss)
+
+    results = run_ranks(fn)
+    ws = [w for w, _ in results]
+    # every rank holds identical (replicated) params
+    for w in ws[1:]:
+        assert np.allclose(w, ws[0], atol=1e-6)
+
+    # serial reference: average of per-rank grads == grad of mean loss
+    import jax
+    import jax.numpy as jnp
+
+    def serial_loss(w, batches):
+        losses = [jnp.mean((x @ w - y) ** 2) for x, y in batches]
+        return jnp.mean(jnp.stack(losses))
+
+    batches = [make_data(r) for r in range(NP)]
+    w = jnp.asarray(W0)
+    for _ in range(5):
+        g = jax.grad(serial_loss)(w, batches)
+        w = w - 0.1 * g
+    assert np.allclose(ws[0], np.asarray(w), atol=1e-5), \
+        (ws[0].ravel(), np.asarray(w).ravel())
+
+
+def test_compiled_train_step_sum_op(hvd_shutdown):
+    def loss_fn(params, batch):
+        import jax.numpy as jnp
+        return jnp.sum(params["w"] * batch)
+
+    def fn():
+        step = hvd.make_compiled_train_step(
+            loss_fn, optax.sgd(1.0), op=hvd.Sum)
+        state = step.init_state({"w": np.zeros(3, np.float32)})
+        batch = np.ones(3, np.float32) * (hvd.rank() + 1)
+        state, _ = step(state, batch)
+        # grad per rank = batch; summed = sum(r+1); w = -sum
+        expected = -np.ones(3) * sum(range(1, NP + 1))
+        assert np.allclose(np.asarray(state["params"]["w"]), expected)
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_compiled_reducer_reuses_programs(hvd_shutdown):
+    """Steady state hits the program cache (response-cache role)."""
+    def fn():
+        red = hvd.CompiledGroupedAllreduce(op=hvd.Sum)
+        x = [np.ones(4, np.float32) * hvd.rank()]
+        red(x)
+        n1 = len(red._programs)
+        red(x)
+        red([np.ones(4, np.float32)])      # same signature
+        assert len(red._programs) == n1 == 1
+        red([np.ones(5, np.float32)])      # new signature -> new program
+        assert len(red._programs) == 2
+        return True
+
+    assert all(run_ranks(fn))

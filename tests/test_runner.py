@@ -664,3 +664,80 @@ def test_hybrid_procs_with_rank_threads(tmp_path):
                          platform="cpu", env={"PYTHONPATH": REPO},
                          start_timeout=180)
     assert codes == [0, 0]
+
+
+TF_XLA_OPS_WORKER = textwrap.dedent("""
+    import os
+    os.environ["HOROVOD_ENABLE_XLA_OPS"] = "1"
+    import numpy as np
+    import tensorflow as tf
+    import horovod_tpu.tensorflow as hvd
+
+    hvd.init()
+    r, s = hvd.rank(), hvd.size()
+
+    # traced tape through the compiled (in-program) reducer
+    w = tf.Variable([[1.0], [1.0]])
+
+    @tf.function
+    def tape_step():
+        x = tf.constant([[float(r + 1), 2.0 * (r + 1)]])
+        with hvd.DistributedGradientTape() as tape:
+            y = tf.reduce_sum(tf.matmul(x, w))
+        return tape.gradient(y, [w])
+
+    g = tape_step()[0].numpy()
+    mean = np.mean([i + 1 for i in range(s)])
+    assert np.allclose(g.ravel(), [mean, 2 * mean]), g
+
+    # traced backward_passes_per_step>1: graph-side counter + cond
+    v = tf.Variable([0.0])
+    opt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(1.0),
+                                   backward_passes_per_step=2)
+
+    @tf.function
+    def micro_step(g):
+        return opt.apply_gradients([(g, v)])
+
+    micro_step(tf.constant([float(r + 1)]))
+    assert np.allclose(v.numpy(), [0.0]), v.numpy()   # accumulated
+    micro_step(tf.constant([2.0 * (r + 1)]))
+    expected = -3.0 * np.mean([i + 1 for i in range(s)])
+    assert np.allclose(v.numpy(), [expected]), v.numpy()
+
+    # model.fit WITHOUT run_eagerly, grads through the compiled path
+    tf.keras.utils.set_random_seed(1)
+    x = np.random.rand(64, 8).astype("float32")[r::s]
+    y = (x.sum(axis=1) > 4).astype("int64")
+    model = tf.keras.Sequential([
+        tf.keras.layers.Dense(8, activation="relu"),
+        tf.keras.layers.Dense(2)])
+    mopt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(0.05))
+    model.compile(optimizer=mopt,
+                  loss=tf.keras.losses.SparseCategoricalCrossentropy(
+                      from_logits=True))
+    hist = model.fit(x, y, batch_size=16, epochs=1, verbose=0)
+    assert np.isfinite(hist.history["loss"][-1])
+    wts = np.concatenate([t.numpy().ravel() for t in model.weights])
+    gathered = hvd.allgather(wts.reshape(1, -1))
+    assert np.allclose(gathered, np.tile(gathered[0], (s, 1))), \\
+        "ranks diverged under compiled-ops fit"
+    print(f"TF XLA-OPS OK {r}")
+    hvd.shutdown()
+""")
+
+
+@pytest.mark.integration
+def test_two_process_tf_compiled_ops(tmp_path):
+    """HOROVOD_ENABLE_XLA_OPS=1: traced collectives ride ONE compiled
+    XLA program per step (no engine negotiation) — the reference's
+    xla_mpi_ops.cc:185-307 capability — including traced bpps>1 and a
+    full model.fit without run_eagerly."""
+    from horovod_tpu.runner.proc_run import launch_procs
+
+    script = tmp_path / "worker.py"
+    script.write_text(TF_XLA_OPS_WORKER)
+    codes = launch_procs([sys.executable, str(script)], np=2,
+                         platform="cpu", env={"PYTHONPATH": REPO},
+                         start_timeout=240)
+    assert codes == [0, 0]

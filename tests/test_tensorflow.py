@@ -184,7 +184,8 @@ def test_tf_optimizer_backward_passes_per_step(hvd_shutdown):
         # two micro-batches with per-rank grads (r+1) and 2(r+1)
         g1 = tf.constant([float(r + 1), 0.0])
         g2 = tf.constant([2.0 * (r + 1), 0.0])
-        assert opt.apply_gradients([(g1, v)]) is None   # accumulated only
+        applied = opt.apply_gradients([(g1, v)])        # accumulated only
+        assert not bool(applied)   # False tensor: nothing applied yet
         assert np.allclose(v.numpy(), 0.0)              # no update yet
         opt.apply_gradients([(g2, v)])
         # sum of micro-batches = 3(r+1); averaged over ranks = 3*mean(r+1)
@@ -493,6 +494,41 @@ def test_tf_sync_batch_norm_all_masked(hvd_shutdown):
         mask = tf.zeros((2,), dtype=tf.bool)
         out = bn(x, training=True, mask=mask)
         assert np.all(np.isfinite(out.numpy()))
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_tf_tape_compiled_ops_eager(hvd_shutdown):
+    """use_compiled_ops=True: grads reduce via one compiled XLA
+    program (xla_mpi_ops.cc role) instead of the engine queue."""
+    def fn():
+        r = hvd.rank()
+        w = tf.Variable([[1.0], [1.0]])
+        x = tf.constant([[float(r + 1), 2.0 * (r + 1)]])
+        with hvd.DistributedGradientTape(use_compiled_ops=True) as tape:
+            y = tf.reduce_sum(tf.matmul(x, w))
+        grad = tape.gradient(y, [w])[0]
+        ms = np.mean([i + 1 for i in range(NP)])
+        assert np.allclose(grad.numpy(), [[ms], [2.0 * ms]])
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_tf_tape_compiled_ops_gpf(hvd_shutdown):
+    """gpf split rides the compiled path too."""
+    def fn():
+        r = hvd.rank()
+        w = tf.Variable([[1.0]])
+        x = tf.constant([[float(r + 1)]])
+        with hvd.DistributedGradientTape(
+                use_compiled_ops=True,
+                gradient_predivide_factor=2.0) as tape:
+            y = tf.reduce_sum(tf.matmul(x, w))
+        grad = tape.gradient(y, [w])[0]
+        ms = np.mean([i + 1 for i in range(NP)])
+        assert np.allclose(grad.numpy(), [[ms]]), grad.numpy()
         return True
 
     assert all(run_ranks(fn))

@@ -86,12 +86,17 @@ class _Rendezvous:
     objects meet at the same rendezvous.
     """
 
+    # how long to wait for PEERS to arrive; once the leader is running
+    # fn (first-call XLA compiles can take many minutes) waiters wait
+    # indefinitely — the leader is making progress on their behalf
+    ARRIVAL_TIMEOUT = 600
+
     def __init__(self, n):
         self.n = n
         self._cond = threading.Condition()
         self._slots = {}
         self._result = None
-        self._error = None
+        self._computing = None     # generation the leader is running
         self._generation = 0
 
     def run(self, pos, value, fn):
@@ -106,15 +111,24 @@ class _Rendezvous:
             self._slots[pos] = value
             if len(self._slots) == self.n:
                 slots, self._slots = self._slots, {}
+                self._computing = gen
                 try:
                     self._result = (fn(slots), None)
                 except BaseException as e:  # propagate to every waiter
                     self._result = (None, e)
+                finally:
+                    self._computing = None
                 self._generation = gen + 1
                 self._cond.notify_all()
             else:
                 while self._generation == gen:
-                    if not self._cond.wait(timeout=600):
+                    if not self._cond.wait(timeout=self.ARRIVAL_TIMEOUT) \
+                            and self._generation == gen \
+                            and self._computing != gen:
+                        # leader never formed: a peer is missing.  Take
+                        # our stale delivery back so a caller-level
+                        # retry re-enters cleanly.
+                        self._slots.pop(pos, None)
                         raise RuntimeError(
                             "compiled collective rendezvous timed out "
                             "(a local rank never arrived)")
@@ -146,6 +160,23 @@ _STEP_COUNTERS = {}
 # the program any previous leader built (one compile per process)
 _PROGRAM_CACHE = {}
 _PROGRAM_LOCK = threading.Lock()
+
+
+_EX_UID = [0]
+
+
+def _ex_uid(ex):
+    """Stable unique token per executor (id() can be recycled after an
+    old executor is garbage-collected)."""
+    uid = getattr(ex, "_compiled_uid", None)
+    if uid is None:
+        with _PROGRAM_LOCK:
+            uid = getattr(ex, "_compiled_uid", None)
+            if uid is None:
+                _EX_UID[0] += 1
+                uid = _EX_UID[0]
+                ex._compiled_uid = uid
+    return uid
 
 
 def _shared_program(key, builder):
@@ -194,6 +225,7 @@ class CompiledGroupedAllreduce:
         self.process_set = process_set
         self.name = name
         self._programs = {}
+        self._ex = None          # executor the cached programs target
         self._lock = threading.Lock()
 
     # -- program construction ------------------------------------------------
@@ -253,9 +285,15 @@ class CompiledGroupedAllreduce:
 
     def _program(self, ex, sig, plan):
         with self._lock:
+            if self._ex is not ex:
+                # the engine re-initialized or the process set was
+                # rebuilt: programs compiled for the old mesh/world
+                # size would silently mis-average — drop them
+                self._programs.clear()
+                self._ex = ex
             entry = self._programs.get(sig)
             if entry is None:
-                key = ("reduce", id(ex), int(self.op), self.prescale,
+                key = ("reduce", _ex_uid(ex), int(self.op), self.prescale,
                        self.postscale, sig)
                 entry = _shared_program(key,
                                         lambda: self._build(ex, plan))
@@ -290,16 +328,31 @@ class CompiledGroupedAllreduce:
 
     # -- execution -----------------------------------------------------------
 
+    def _validate(self, arrays):
+        """World-size-independent validation so code exercised at one
+        rank behaves identically at N (engine api._check_scale rules)."""
+        for a in arrays:
+            if not _is_float(a.dtype):
+                if self.op == Average:
+                    raise ValueError(
+                        "Averaging is not supported for integer "
+                        "tensors; use op=Sum")
+                if self.prescale != 1.0 or self.postscale != 1.0:
+                    raise ValueError("prescale/postscale require "
+                                     "floating-point tensors")
+
     def __call__(self, arrays):
         arrays = [np.asarray(a) for a in arrays]
         if not arrays:
             return []
+        self._validate(arrays)
         eng, ps = _ps_state(self.process_set)
         ex = ps.executor
         if ex.num_ranks == 1:
             scale = self.prescale * self.postscale
             if scale != 1.0:
                 return [(a.astype(np.float32) * scale).astype(a.dtype)
+                        if _is_float(a.dtype) else a.copy()
                         for a in arrays]
             return [a.copy() for a in arrays]
         sig = self._signature(arrays)
@@ -332,15 +385,10 @@ class CompiledGroupedAllreduce:
 
     @staticmethod
     def _stage(ex, rows):
-        """Per-local-rank flat buffers → device operand ((R, n) sharded
-        row-per-rank in shard mode, stacked otherwise)."""
-        if ex.shard_mode:
-            shape = (ex.num_ranks, rows[0].size)
-            shards = [jax.device_put(r[None], ex.devices[pos])
-                      for r, pos in zip(rows, ex.local_positions)]
-            return jax.make_array_from_single_device_arrays(
-                shape, ex._row_sharding, shards)
-        return jax.device_put(np.stack(rows), ex.devices[0])
+        """Per-local-rank flat buffers → device operand; delegates to
+        the executor's row staging (xla_ops._stage_rows) so shard/stack
+        layout logic lives in one place."""
+        return ex._stage_rows(rows)
 
 
 # module-level cache so hot paths reuse programs across calls
@@ -407,6 +455,7 @@ class _CompiledTrainStep:
         self.process_set = process_set
         self.donate = donate
         self._prog = None
+        self._ex = None
         self._tag = None
         self._lock = threading.Lock()
 
@@ -514,9 +563,14 @@ class _CompiledTrainStep:
         # (other instances) reuse it via the shared cache so there is
         # exactly one compile per process
         with self._lock:
+            if self._ex is not ex:
+                # engine re-init / process-set rebuild: a program
+                # compiled for the old mesh would silently mis-average
+                self._prog = None
+                self._ex = ex
             if self._prog is None:
                 if self._tag is not None:
-                    key = ("step", id(ex), self._tag)
+                    key = ("step", _ex_uid(ex), self._tag)
                     self._prog = _shared_program(
                         key, lambda: self._build(ex))
                 else:

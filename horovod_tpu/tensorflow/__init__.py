@@ -164,10 +164,16 @@ class _GradSync:
         # xla_mpi_ops.cc:258-270 opt-in registrar): grads reduce via one
         # compiled XLA program instead of the engine's negotiated queue
         if use_compiled_ops is None:
+            # env opt-in downgrades silently for unsupported ops (it
+            # is a blanket switch); an EXPLICIT request must not
             from ..common import env as _env
-            use_compiled_ops = _env.get_bool("HOROVOD_ENABLE_XLA_OPS")
-        self.use_compiled_ops = bool(use_compiled_ops) \
-            and op in (Average, Sum)
+            use_compiled_ops = _env.get_bool("HOROVOD_ENABLE_XLA_OPS") \
+                and op in (Average, Sum)
+        elif use_compiled_ops and op not in (Average, Sum):
+            raise ValueError(
+                "use_compiled_ops supports op=Average or Sum only "
+                "(the reference XLA op surface, xla_mpi_ops.cc:558-603)")
+        self.use_compiled_ops = bool(use_compiled_ops)
         self._compiled_reducer = None
         # local (non-synced) variables, reference tensorflow/__init__.py
         # register_local_source / scale_local_gradients (:1029-1100)
@@ -496,19 +502,26 @@ def DistributedOptimizer(optimizer, name=None,
                         else tf.convert_to_tensor(buf))
                        for buf in self._hvd_agg]
                 synced = self._hvd_sync.sync(agg, tvars)
-                sup.apply_gradients(
+                result = sup.apply_gradients(
                     list(zip(synced, tvars)), *args, **kwargs)
                 for buf in self._hvd_agg:
                     if buf is not None:
                         buf.assign(tf.zeros_like(buf))
-                return tf.constant(True)
+                return result
 
-            def _skip():
-                return tf.constant(False)
+            if tf.executing_eagerly():
+                # keep the reference eager contract: None while only
+                # accumulating, the underlying apply result on flush
+                if int(self._hvd_counter) % bpps == 0:
+                    return _flush_and_apply()
+                return None
 
+            # traced: the branch decision must live in the graph; both
+            # arms return a bool (applied / accumulated-only)
             return tf.cond(
                 tf.equal(self._hvd_counter % bpps, 0),
-                _flush_and_apply, _skip)
+                lambda: (_flush_and_apply(), tf.constant(True))[1],
+                lambda: tf.constant(False))
 
     _Distributed.__name__ = f"Distributed{base_cls.__name__}"
     # swap the class in place so existing slot variables / iteration

@@ -196,3 +196,23 @@ def test_compiled_reducer_reuses_programs(hvd_shutdown):
         return True
 
     assert all(run_ranks(fn))
+
+
+def test_compiled_reducer_survives_reinit(hvd_shutdown):
+    """A long-lived reducer must not serve programs compiled for a
+    previous engine's world size after shutdown + re-init."""
+    red = hvd.CompiledGroupedAllreduce(op=hvd.Average)
+
+    def fn4():
+        return red([np.ones(4, np.float32) * (hvd.rank() + 1)])[0]
+
+    outs = hvd.run(fn4, np=4)
+    assert all(np.allclose(o, 2.5) for o in outs)
+    hvd.shutdown()
+
+    def fn2():
+        return red([np.ones(4, np.float32) * (hvd.rank() + 1)])[0]
+
+    outs = hvd.run(fn2, np=2)
+    # average over the NEW world of 2, not the stale 4
+    assert all(np.allclose(o, 1.5) for o in outs), outs

@@ -184,8 +184,7 @@ def test_tf_optimizer_backward_passes_per_step(hvd_shutdown):
         # two micro-batches with per-rank grads (r+1) and 2(r+1)
         g1 = tf.constant([float(r + 1), 0.0])
         g2 = tf.constant([2.0 * (r + 1), 0.0])
-        applied = opt.apply_gradients([(g1, v)])        # accumulated only
-        assert not bool(applied)   # False tensor: nothing applied yet
+        assert opt.apply_gradients([(g1, v)]) is None   # accumulated only
         assert np.allclose(v.numpy(), 0.0)              # no update yet
         opt.apply_gradients([(g2, v)])
         # sum of micro-batches = 3(r+1); averaged over ranks = 3*mean(r+1)

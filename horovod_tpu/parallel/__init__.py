@@ -6,7 +6,9 @@ the substrate for more (process sets + alltoall, SURVEY §2.7), here
 dp / fsdp / tp / pp / sp / ep are first-class compiled shardings.
 """
 
-from .mesh import MeshSpec, build_mesh, data_mesh, AXIS_ORDER  # noqa: F401
+from .mesh import (  # noqa: F401
+    MeshSpec, build_mesh, data_mesh, two_level_mesh, AXIS_ORDER,
+)
 from .sharding import (  # noqa: F401
     transformer_param_spec, transformer_param_shardings,
     batch_spec, batch_sharding, replicated,

@@ -100,3 +100,40 @@ def build_mesh(spec: Optional[MeshSpec] = None,
 def data_mesh(devices: Optional[Sequence] = None) -> Mesh:
     """Pure-DP mesh over all devices — the reference's world."""
     return build_mesh(MeshSpec(dp=-1), devices)
+
+
+def two_level_mesh(topology, devices: Optional[Sequence] = None) -> Mesh:
+    """("cross", "local") Mesh from the job topology: hosts on the
+    outer (DCN) axis, same-host ranks on the inner (ICI) axis.
+
+    This is the TPU formulation of the reference's hierarchical
+    communicators (``mpi_context.h:104-113`` local/cross comms,
+    ``nccl_operations.cc:606-830`` torus/hierarchical allreduce): a
+    reduction expressed as psum over ``local`` then ``cross`` (or one
+    psum over both axes — XLA decomposes it) rides ICI within a host
+    and only crosses DCN once per host.
+
+    ``topology`` is the engine's ``Topology`` (host index per global
+    rank, the ``HOROVOD_TPU_HOST_OF_RANK`` launcher handoff); device
+    ``r`` must be global rank ``r``'s chip — the engine's multi-process
+    device order.  Requires a homogeneous layout with ranks grouped by
+    host (the launcher emits hosts in slot order, so this holds for
+    every launched job)."""
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)[:topology.size]
+    if len(devices) < topology.size:
+        raise ValueError(
+            f"{len(devices)} devices < {topology.size} ranks")
+    if not topology.is_homogeneous():
+        raise ValueError(
+            "two_level_mesh needs the same rank count on every host")
+    hor = topology.host_of_rank
+    if any(hor[r] > hor[r + 1] for r in range(len(hor) - 1)):
+        raise ValueError(
+            "two_level_mesh needs ranks grouped by host "
+            f"(host_of_rank={hor})")
+    hosts = topology.num_hosts
+    local = topology.size // hosts
+    arr = np.array(devices).reshape(hosts, local)
+    return Mesh(arr, ("cross", "local"))

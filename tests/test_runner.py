@@ -741,3 +741,83 @@ def test_two_process_tf_compiled_ops(tmp_path):
                          platform="cpu", env={"PYTHONPATH": REPO},
                          start_timeout=240)
     assert codes == [0, 0]
+
+
+TWO_LEVEL_WORKER = textwrap.dedent("""
+    import os
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics
+    from horovod_tpu.parallel import two_level_mesh
+    from horovod_tpu.parallel._shard_map import shard_map
+
+    def fn():
+        r = hvd.rank()
+        eng = basics.engine()
+        topo = eng.topology
+        # the launcher's host layout reached the engine intact
+        hof_env = os.environ["HOROVOD_TPU_HOST_OF_RANK"]
+        host_of_proc = [int(x) for x in hof_env.split(",")]
+        expect = [host_of_proc[rr // eng.num_local]
+                  for rr in range(hvd.size())]
+        assert topo.host_of_rank == expect, (topo.host_of_rank, expect)
+        assert topo.num_hosts == 2 and hvd.cross_size() == 2
+        assert hvd.local_size() == 2
+
+        # 2-level ("cross","local") mesh from that topology; a
+        # hierarchical reduce (local psum then cross psum) must equal
+        # both the flat mesh psum and the engine's negotiated
+        # allreduce — the stand-in for the reference's hierarchical /
+        # torus allreduce paths (nccl_operations.cc:606-830).
+        # Multi-host global arrays need every PROCESS to participate:
+        # one rank thread per process drives the mesh program.
+        if hvd.local_rank() == 0:
+            mesh = two_level_mesh(topo, eng.devices)
+            assert dict(mesh.shape) == {"cross": 2, "local": 2}
+            rows = np.stack([np.full(4, float(rr + 1), np.float32)
+                             for rr in range(hvd.size())])
+            x = jax.device_put(
+                rows.reshape(2, 2, 4),
+                NamedSharding(mesh, P("cross", "local")))
+
+            def hier(xb):
+                y = lax.psum(xb, "local")     # ICI hop
+                return lax.psum(y, "cross")   # one DCN hop per host
+
+            prog = jax.jit(shard_map(
+                hier, mesh=mesh,
+                in_specs=P("cross", "local"), out_specs=P()))
+            out = np.asarray(prog(x)).reshape(-1)[:4]
+            assert np.allclose(out, 10.0), out
+        hvd.barrier()
+        eng_out = hvd.allreduce(np.full(4, float(r + 1), np.float32),
+                                op=hvd.Sum, name="two_level_check")
+        assert np.allclose(eng_out, 10.0), eng_out
+        return True
+
+    assert all(hvd.run(fn))
+    print("TWO-LEVEL OK")
+""")
+
+
+@pytest.mark.integration
+def test_two_level_topology_mesh(tmp_path):
+    """2 processes x 2 rank threads on 2 (simulated) hosts: the
+    HOROVOD_TPU_HOST_OF_RANK handoff reaches the engine's Topology,
+    feeds the ("cross","local") mesh builder, and a hierarchical
+    local-then-cross psum equals the engine's flat allreduce."""
+    from horovod_tpu.runner.proc_run import launch_procs
+
+    script = tmp_path / "worker.py"
+    script.write_text(TWO_LEVEL_WORKER)
+    codes = launch_procs([sys.executable, str(script)], np=4,
+                         ranks_per_proc=2,
+                         hosts="localhost:1,127.0.0.1:1",
+                         platform="cpu", env={"PYTHONPATH": REPO},
+                         start_timeout=180)
+    assert codes == [0, 0]

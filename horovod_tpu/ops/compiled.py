@@ -445,7 +445,8 @@ def reset_compiled_state():
 class _CompiledTrainStep:
     """See make_compiled_train_step."""
 
-    def __init__(self, loss_fn, optimizer, op, process_set, donate):
+    def __init__(self, loss_fn, optimizer, op, process_set, donate,
+                 has_aux=False):
         op = ReduceOp(op)
         if op not in (Average, Sum):
             raise ValueError("op must be Average or Sum")
@@ -454,6 +455,7 @@ class _CompiledTrainStep:
         self.op = op
         self.process_set = process_set
         self.donate = donate
+        self.has_aux = has_aux
         self._prog = None
         self._ex = None
         self._tag = None
@@ -463,6 +465,7 @@ class _CompiledTrainStep:
 
     def _build(self, ex):
         loss_fn, optimizer, op = self.loss_fn, self.optimizer, self.op
+        has_aux = self.has_aux
 
         import optax
 
@@ -470,11 +473,28 @@ class _CompiledTrainStep:
             updates, opt_state = optimizer.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), opt_state
 
+        def grad_call(params, aux, batch):
+            """-> (loss, new_aux, grads); aux threads mutable model
+            state (e.g. BN batch_stats) through the step."""
+            if has_aux:
+                (loss, new_aux), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, aux, batch)
+            else:
+                loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+                new_aux = aux
+            return loss, new_aux, grads
+
+        def pack(params, opt_state, aux):
+            state = {"params": params, "opt_state": opt_state}
+            if has_aux:
+                state["aux"] = aux
+            return state
+
         if ex.shard_mode:
             def body(state, batch_rows):
                 batch = jax.tree.map(lambda x: x[0], batch_rows)
-                loss, grads = jax.value_and_grad(loss_fn)(
-                    state["params"], batch)
+                loss, new_aux, grads = grad_call(
+                    state["params"], state.get("aux"), batch)
                 if op == Average:
                     grads = jax.tree.map(
                         lambda g: lax.pmean(g, "hvd"), grads)
@@ -482,9 +502,16 @@ class _CompiledTrainStep:
                     grads = jax.tree.map(
                         lambda g: lax.psum(g, "hvd"), grads)
                 loss = lax.pmean(loss, "hvd")
+                if has_aux:
+                    # cross-replica averaged aux (float leaves): the
+                    # sync-BN convention for running statistics; other
+                    # dtypes are taken as replicated
+                    new_aux = jax.tree.map(
+                        lambda a: lax.pmean(a, "hvd")
+                        if _is_float(a.dtype) else a, new_aux)
                 params, opt_state = update(
                     state["params"], state["opt_state"], grads)
-                return {"params": params, "opt_state": opt_state}, loss
+                return pack(params, opt_state, new_aux), loss
 
             # check_vma=False: jax 0.9's varying-manual-axes checker
             # mistypes cotangents of values closed over by the loss as
@@ -497,9 +524,9 @@ class _CompiledTrainStep:
                              check_vma=False)
         else:
             def prog(state, batch_rows):   # stacked: (R, ...) leaves
-                losses, grads = jax.vmap(
-                    jax.value_and_grad(loss_fn),
-                    in_axes=(None, 0))(state["params"], batch_rows)
+                losses, new_aux, grads = jax.vmap(
+                    lambda b: grad_call(state["params"],
+                                        state.get("aux"), b))(batch_rows)
                 if op == Average:
                     grads = jax.tree.map(lambda g: jnp.mean(g, axis=0),
                                          grads)
@@ -507,22 +534,31 @@ class _CompiledTrainStep:
                     grads = jax.tree.map(lambda g: jnp.sum(g, axis=0),
                                          grads)
                 loss = jnp.mean(losses)
+                if has_aux:
+                    new_aux = jax.tree.map(
+                        lambda a: jnp.mean(a, axis=0)
+                        if _is_float(a.dtype) else a[0], new_aux)
+                else:
+                    new_aux = None
                 params, opt_state = update(
                     state["params"], state["opt_state"], grads)
-                return {"params": params, "opt_state": opt_state}, loss
+                return pack(params, opt_state, new_aux), loss
 
         donate = (0,) if self.donate else ()
         return jax.jit(prog, donate_argnums=donate)
 
     # -- staging -------------------------------------------------------------
 
-    def init_state(self, params):
+    def init_state(self, params, aux=None):
         """Build a replicated device-resident train state from host (or
-        device) params."""
+        device) params (and mutable-model ``aux``, e.g. batch_stats,
+        when the step was built with ``has_aux``)."""
         eng, ps = _ps_state(self.process_set)
         ex = ps.executor
         opt_state = self.optimizer.init(params)
         state = {"params": params, "opt_state": opt_state}
+        if self.has_aux:
+            state["aux"] = {} if aux is None else aux
         if ex.shard_mode:
             rep = NamedSharding(ex.mesh, P())
 
@@ -590,6 +626,21 @@ class _CompiledTrainStep:
                 self._tag = ("step", idx)
             return self._tag
 
+    def place_batch(self, batch):
+        """Pre-stage this rank's batch onto the mesh once; the returned
+        ``StagedBatch`` skips per-step host->device staging when the
+        same data is fed repeatedly (synthetic benchmarks, or
+        double-buffered input pipelines that re-fill device arrays)."""
+        eng, ps = _ps_state(self.process_set)
+        ex = ps.executor
+        if len(ex.local_positions) != 1:
+            raise ValueError(
+                "place_batch is per-process: use it in one-rank-per-"
+                "process deployments (rank threads stage via the "
+                "rendezvous instead)")
+        return StagedBatch(
+            self._stage_batch(ex, {ex.local_positions[0]: batch}))
+
     def __call__(self, state, batch):
         """Run one step with THIS rank's ``batch``; returns
         ``(new_state, loss)``.  All member ranks call per step."""
@@ -599,6 +650,8 @@ class _CompiledTrainStep:
 
         if n_local == 1:
             prog = self._program(ex)
+            if isinstance(batch, StagedBatch):
+                return prog(state, batch.tree)
             batches = {ex.local_positions[0]: batch}
             return prog(state, self._stage_batch(ex, batches))
         pos = _caller_pos(eng, ps)
@@ -619,14 +672,28 @@ class _CompiledTrainStep:
         return rdv.run(pos, (state, batch), launch_rdv)
 
 
+class StagedBatch:
+    """Marker for a batch already staged onto the step's mesh (see
+    ``_CompiledTrainStep.place_batch``)."""
+
+    __slots__ = ("tree",)
+
+    def __init__(self, tree):
+        self.tree = tree
+
+
 def make_compiled_train_step(loss_fn, optimizer, *, op=Average,
                              process_set=global_process_set,
-                             donate=True):
+                             donate=True, has_aux=False):
     """Build the fully-compiled Horovod train step (reference
     ``xla_mpi_ops.cc`` capability, done the TPU way).
 
-    ``loss_fn(params, batch) -> scalar`` is the user's per-rank loss;
-    ``optimizer`` is an optax transform.  Returns a callable
+    ``loss_fn(params, batch) -> scalar`` is the user's per-rank loss
+    (with ``has_aux=True``: ``loss_fn(params, aux, batch) ->
+    (scalar, new_aux)`` threads mutable model state such as BN
+    batch_stats; float aux leaves are cross-replica averaged — the
+    sync-BN convention).  ``optimizer`` is an optax transform.
+    Returns a callable
     ``step(state, batch) -> (state, loss)`` where forward, backward,
     cross-rank gradient reduction (``lax.pmean`` over the process
     set's mesh axis) and the optimizer update run as ONE XLA program —
@@ -645,4 +712,5 @@ def make_compiled_train_step(loss_fn, optimizer, *, op=Average,
         for batch in shard_of_data:
             state, loss = step(state, batch)
     """
-    return _CompiledTrainStep(loss_fn, optimizer, op, process_set, donate)
+    return _CompiledTrainStep(loss_fn, optimizer, op, process_set,
+                              donate, has_aux=has_aux)

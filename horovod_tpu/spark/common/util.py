@@ -39,3 +39,39 @@ def split_validation(x, y, x_val, y_val, validation):
         x, x_val = x[:-n_val], x[-n_val:]
         y, y_val = y[:-n_val], y[-n_val:]
     return x, y, x_val, y_val
+
+
+def batch_to_xy(batch, feature_cols, label_cols):
+    """Streaming-reader batch dict -> (x, y) ndarrays: columns stack
+    into a feature matrix, a single scalar feature column becomes
+    (N, 1).  Shared by the torch and keras streaming paths."""
+    xs = [batch[c] for c in feature_cols]
+    ys = [batch[c] for c in label_cols]
+    x = xs[0] if len(xs) == 1 else np.stack(xs, axis=1)
+    y = ys[0] if len(ys) == 1 else np.stack(ys, axis=1)
+    if x.ndim == 1:
+        x = x[:, None]
+    return np.asarray(x, np.float32), np.asarray(y, np.float32)
+
+
+def stage_dataframe_to_store(df, store, feature_cols, label_cols):
+    """Spark executors write the projected DataFrame as Parquet into
+    the store's intermediate path (no driver materialization);
+    returns the path (reference util.py prepare_data role)."""
+    train_path = store.get_train_data_path()
+    df.select(list(feature_cols) + list(label_cols)) \
+      .write.mode("overwrite").parquet(train_path)
+    return train_path
+
+
+def synced_step_count(local_batches, name):
+    """Minimum batch count across ranks: every rank must run the SAME
+    number of optimizer steps per epoch or per-batch gradient
+    allreduces mismatch and deadlock (reference keras/remote.py drives
+    a fixed steps_per_epoch for the same reason).  Costs one tiny Min
+    allreduce per epoch."""
+    from ...ops import api
+
+    out = api.allreduce(np.asarray(int(local_batches), np.int64),
+                        op=api.Min, name=name)
+    return int(out)

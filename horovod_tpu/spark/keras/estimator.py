@@ -17,7 +17,8 @@ import numpy as np
 from ..common.params import EstimatorParams
 from ..common.store import Store
 from ..common.util import (
-    extract_x, extract_xy, require_pyspark, split_validation,
+    batch_to_xy, extract_x, extract_xy, require_pyspark,
+    split_validation, stage_dataframe_to_store, synced_step_count,
 )
 
 
@@ -27,10 +28,88 @@ class KerasEstimator(EstimatorParams):
     keras loss (name or callable)."""
 
     def fit(self, df, params=None):
+        """Spark entry: executors write the DataFrame as Parquet into
+        the store (no driver materialization), ranks stream shards
+        (reference keras/remote.py make_batch_reader flow)."""
         require_pyspark()
-        x, y = extract_xy(df.toPandas(), self.feature_cols,
-                          self.label_cols)
-        return self.fit_arrays(x, y)
+        if self.store is None:
+            x, y = extract_xy(df.toPandas(), self.feature_cols,
+                              self.label_cols)
+            return self.fit_arrays(x, y)
+        train_path = stage_dataframe_to_store(
+            df, self.store, self.feature_cols, self.label_cols)
+        return self.fit_on_parquet(train_path)
+
+    def fit_on_parquet(self, train_path, val_path=None):
+        """Stream a Parquet dataset per rank (Petastorm role —
+        reference store.py:38-540) into ``model.fit`` via a generator
+        dataset."""
+        from ... import run as hvd_run
+        from ... import keras as hvd_keras
+        from ..common.reader import make_batch_reader
+
+        est = self
+        model_blob = _serialize_keras(self.model)
+        opt_conf = _optimizer_config(self.optimizer)
+        store = self.store
+        run_id = self.run_id or "run"
+        feature_cols = list(self.feature_cols)
+        label_cols = list(self.label_cols)
+
+        def train_fn():
+            import tensorflow as tf
+
+            rank, size = hvd_keras.rank(), hvd_keras.size()
+            model = _deserialize_keras(model_blob)
+            opt = tf.keras.optimizers.get(
+                {"class_name": opt_conf[0], "config": opt_conf[1]})
+            opt = hvd_keras.DistributedOptimizer(opt)
+            model.compile(optimizer=opt, loss=est.loss,
+                          metrics=list(est.metrics), run_eagerly=True)
+            cb = [hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0),
+                  hvd_keras.callbacks.MetricAverageCallback()]
+            cb += list(est.callbacks)
+
+            hist_all = {}
+            for epoch in range(est.epochs):
+                reader = make_batch_reader(
+                    train_path,
+                    schema_fields=feature_cols + label_cols,
+                    batch_size=est.batch_size, cur_shard=rank,
+                    shard_count=size, shuffle_row_groups=True,
+                    seed=epoch)
+                # equalized step count: shards can differ by a row
+                # group; a lone extra gradient allreduce would
+                # deadlock (reference keras/remote.py steps_per_epoch)
+                n_local = -(-reader.num_rows // est.batch_size)
+                steps = synced_step_count(n_local,
+                                          name=f"ksteps.{epoch}")
+                gen = (batch_to_xy(b, feature_cols, label_cols)
+                       for b in reader)
+                hist = model.fit(gen, epochs=1, steps_per_epoch=steps,
+                                 callbacks=cb,
+                                 verbose=est.verbose if rank == 0
+                                 else 0)
+                for k, vs in hist.history.items():
+                    hist_all.setdefault(k, []).extend(
+                        float(v) for v in vs)
+            if rank == 0:
+                blob = pickle.dumps(
+                    {"json": pickle.loads(model_blob)["json"],
+                     "weights": model.get_weights()},
+                    protocol=pickle.HIGHEST_PROTOCOL)
+                if store is not None:
+                    store.save_checkpoint(run_id, blob)
+                return blob, hist_all
+            return None
+
+        results = hvd_run(train_fn, np=self.num_proc)
+        blob, history = next(r for r in results if r is not None)
+        return KerasModel(model=_deserialize_keras(blob),
+                          history=history,
+                          feature_cols=self.feature_cols,
+                          label_cols=self.label_cols,
+                          run_id=run_id, store=store)
 
     def fit_arrays(self, x, y, x_val=None, y_val=None):
         from ... import run as hvd_run

@@ -1,19 +1,164 @@
-"""Spark job launch (reference ``horovod/spark/runner.py:49-310``):
-each Spark task binds one rank; the driver hosts the rendezvous; ranks
-come up through the same env handoff as the CLI launcher."""
+"""Spark job launch (reference ``horovod/spark/runner.py:49-310``).
 
+Flow parity with the reference:
+
+* the DRIVER hosts the rendezvous (our HMAC HTTP KV + coordinator,
+  standing in for SparkDriverService);
+* each barrier task REGISTERS itself with its host hash
+  (``_task_fn`` -> ``driver_client.register_task``, runner.py:49-70);
+* the driver groups registrations by host and publishes the rank PLAN
+  (global/local/cross ranks + host layout — the reference's
+  ``task_host_hash_indices`` / ``_get_indices_in_rank_order``,
+  runner.py:161-198);
+* tasks pick up their plan entry, export the standard
+  ``HOROVOD_*`` env contract, and run the user fn.
+
+The task body (`_spark_task_body`) is a plain function over the HTTP
+fabric so the whole flow is testable without pyspark — Spark
+contributes only the remote process spawn (``rdd.barrier()``).
+"""
+
+import json
 import os
 import secrets as _secrets
 import socket
+import threading
+import time
 
 
-def run(fn, args=(), kwargs=None, num_proc=None, start_timeout=None,
+def host_hash(salt=None):
+    """Identity of this host for rank grouping (reference
+    ``horovod/runner/common/util/host_hash.py`` role).  Tasks on one
+    machine share it, so they become local ranks of one host."""
+    base = socket.gethostname()
+    if salt is not None:
+        base = f"{base}-{salt}"
+    return base
+
+
+def compute_plan(registrations):
+    """Registrations {index: host_hash} -> per-index plan.
+
+    Ranks are assigned grouped by host (reference
+    ``_get_indices_in_rank_order``): hosts ordered by first-seen task
+    index, tasks within a host ordered by index.  Returns a dict
+    ``{index: {rank, size, local_rank, local_size, cross_rank,
+    cross_size, host_of_proc}}``."""
+    by_host = {}
+    for index in sorted(registrations):
+        by_host.setdefault(registrations[index], []).append(index)
+    hosts = sorted(by_host, key=lambda h: by_host[h][0])
+    size = len(registrations)
+    plan = {}
+    host_of_proc = []
+    rank = 0
+    for hi, h in enumerate(hosts):
+        for li, index in enumerate(by_host[h]):
+            plan[index] = {
+                "rank": rank, "size": size,
+                "local_rank": li, "local_size": len(by_host[h]),
+                "host_index": hi,
+            }
+            host_of_proc.append(hi)
+            rank += 1
+    for index, ent in plan.items():
+        li = ent["local_rank"]
+        ent["cross_rank"] = sum(
+            1 for hj in range(ent["host_index"])
+            if len(by_host[hosts[hj]]) > li)
+        ent["cross_size"] = sum(
+            1 for h in hosts if len(by_host[h]) > li)
+        ent["host_of_proc"] = ",".join(str(h) for h in host_of_proc)
+    return plan
+
+
+def _spark_task_body(index, addr, port, secret_hex, fn, args=(),
+                     kwargs=None, start_timeout=120, salt=None):
+    """What one Spark barrier task runs (reference ``_task_fn``,
+    runner.py:49-118): register -> await plan -> publish/await the
+    coordinator address -> env handoff -> fn.
+
+    The jax.distributed coordination service binds on RANK 0's host,
+    so rank 0 (not the driver) probes a free port and publishes its
+    own reachable address through the KV store — a port probed on the
+    driver could be taken on the executor host."""
+    from ..runner.http.http_client import StoreClient
+    from ..runner.http.http_server import local_ip
+
+    kwargs = kwargs or {}
+    client = StoreClient(addr, port, secret=bytes.fromhex(secret_hex))
+    client.put(f"spark/task/{index}",
+               json.dumps({"host": host_hash(salt=salt),
+                           "pid": os.getpid()}).encode())
+    raw = client.get("spark/plan", wait=start_timeout)
+    if raw is None:
+        raise TimeoutError(
+            f"spark task {index}: driver never published the rank plan")
+    doc = json.loads(raw.decode())
+    plan = doc[str(index)]
+    if plan["rank"] == 0:
+        coordinator = f"{local_ip()}:{_find_free_port()}"
+        client.put("spark/coordinator", coordinator.encode())
+    else:
+        raw = client.get("spark/coordinator", wait=start_timeout)
+        if raw is None:
+            raise TimeoutError(
+                f"spark task {index}: rank 0 never published the "
+                "coordinator address")
+        coordinator = raw.decode()
+    os.environ.update({
+        "HOROVOD_CONTROLLER": "http",
+        "HOROVOD_GLOO_RENDEZVOUS_ADDR": addr,
+        "HOROVOD_GLOO_RENDEZVOUS_PORT": str(port),
+        "HOROVOD_SECRET_KEY": secret_hex,
+        "HOROVOD_RANK": str(plan["rank"]),
+        "HOROVOD_SIZE": str(plan["size"]),
+        "HOROVOD_LOCAL_RANK": str(plan["local_rank"]),
+        "HOROVOD_LOCAL_SIZE": str(plan["local_size"]),
+        "HOROVOD_CROSS_RANK": str(plan["cross_rank"]),
+        "HOROVOD_CROSS_SIZE": str(plan["cross_size"]),
+        "HOROVOD_HOSTNAME": host_hash(salt=salt),
+        "HOROVOD_TPU_PROC_INDEX": str(plan["rank"]),
+        "HOROVOD_TPU_NUM_PROCS": str(plan["size"]),
+        "HOROVOD_TPU_RANKS_PER_PROC": "1",
+        "HOROVOD_TPU_HOST_OF_RANK": plan["host_of_proc"],
+        "HOROVOD_TPU_COORDINATOR": coordinator,
+    })
+    return fn(*args, **kwargs)
+
+
+def drive_plan(server, num_proc, start_timeout=120):
+    """Driver side: collect registrations from the KV store, publish
+    the plan (reference ``_notify_and_register_task_addresses``,
+    runner.py:165-198)."""
+    store = server.store
+    deadline = time.monotonic() + (start_timeout or 120)
+    registrations = {}
+    while len(registrations) < num_proc:
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"only {len(registrations)}/{num_proc} spark tasks "
+                "registered before start_timeout")
+        for i in range(num_proc):
+            if i in registrations:
+                continue
+            raw = store.get(f"spark/task/{i}", timeout=0.05)
+            if raw is not None:
+                registrations[i] = json.loads(raw.decode())["host"]
+    plan = {str(i): ent
+            for i, ent in compute_plan(registrations).items()}
+    store.put("spark/plan", json.dumps(plan).encode())
+    return plan
+
+
+def run(fn, args=(), kwargs=None, num_proc=None, start_timeout=120,
         env=None, verbose=1):
-    from pyspark import SparkContext, BarrierTaskContext
+    """Run ``fn`` on ``num_proc`` Spark barrier tasks, one rank each
+    (reference ``horovod.spark.run``, runner.py:200-310)."""
+    from pyspark import SparkContext
 
     sc = SparkContext.getOrCreate()
     num_proc = num_proc or sc.defaultParallelism
-    kwargs = kwargs or {}
 
     from ..runner.http.http_server import (
         RendezvousServer, autotune_kwargs, local_ip,
@@ -26,24 +171,21 @@ def run(fn, args=(), kwargs=None, num_proc=None, start_timeout=None,
                               **autotune_kwargs(at_env))
     port = server.start()
     addr = local_ip()
-    coordinator = f"{addr}:{_find_free_port()}"
     base_env = dict(env or {})
+
+    # plan publication runs concurrently with the barrier job: tasks
+    # register as they come up, the driver groups them by host and
+    # answers their long-poll
+    driver = threading.Thread(
+        target=drive_plan, args=(server, num_proc, start_timeout),
+        daemon=True)
+    driver.start()
 
     def task(index):
         os.environ.update(base_env)
-        os.environ.update({
-            "HOROVOD_CONTROLLER": "http",
-            "HOROVOD_GLOO_RENDEZVOUS_ADDR": addr,
-            "HOROVOD_GLOO_RENDEZVOUS_PORT": str(port),
-            "HOROVOD_SECRET_KEY": secret_hex,
-            "HOROVOD_RANK": str(index),
-            "HOROVOD_SIZE": str(num_proc),
-            "HOROVOD_TPU_PROC_INDEX": str(index),
-            "HOROVOD_TPU_NUM_PROCS": str(num_proc),
-            "HOROVOD_TPU_RANKS_PER_PROC": "1",
-            "HOROVOD_TPU_COORDINATOR": coordinator,
-        })
-        return fn(*args, **kwargs)
+        return _spark_task_body(index, addr, port, secret_hex,
+                                fn, args, kwargs,
+                                start_timeout=start_timeout)
 
     try:
         rdd = sc.parallelize(range(num_proc), num_proc)

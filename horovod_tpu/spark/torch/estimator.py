@@ -21,7 +21,8 @@ import numpy as np
 from ..common.params import EstimatorParams
 from ..common.store import Store
 from ..common.util import (
-    extract_x, extract_xy, require_pyspark, split_validation,
+    batch_to_xy, extract_x, extract_xy, require_pyspark,
+    split_validation, stage_dataframe_to_store, synced_step_count,
 )
 
 
@@ -34,12 +35,117 @@ class TorchEstimator(EstimatorParams):
     """
 
     def fit(self, df, params=None):
-        """Spark entry (reference estimator.py fit): materialize the
-        DataFrame columns and train."""
+        """Spark entry (reference estimator.py fit): Spark writes the
+        DataFrame as Parquet into the store's intermediate path (its
+        executors stream partitions — nothing funnels through the
+        driver), then each rank streams its shard of the row groups
+        (reference keras/remote.py make_batch_reader flow)."""
         require_pyspark()
-        x, y = extract_xy(df.toPandas(), self.feature_cols,
-                          self.label_cols)
-        return self.fit_arrays(x, y)
+        if self.store is None:
+            # no store to stage through: small-data fallback
+            x, y = extract_xy(df.toPandas(), self.feature_cols,
+                              self.label_cols)
+            return self.fit_arrays(x, y)
+        train_path = stage_dataframe_to_store(
+            df, self.store, self.feature_cols, self.label_cols)
+        return self.fit_on_parquet(train_path)
+
+    def fit_on_parquet(self, train_path, val_path=None):
+        """Train by streaming a (multi-file) Parquet dataset: each rank
+        reads only its own row groups via
+        :func:`horovod_tpu.spark.common.reader.make_batch_reader` —
+        the Petastorm role in the reference (store.py:38-540,
+        torch/remote.py)."""
+        import torch
+
+        from ... import run as hvd_run
+        from ...torch import (
+            DistributedOptimizer, broadcast_parameters, allreduce,
+        )
+        from ... import torch as hvd
+        from ..common.reader import make_batch_reader
+
+        est = self
+        model_bytes = _serialize_model(self.model)
+        store = self.store
+        run_id = self.run_id or "run"
+        feature_cols = list(self.feature_cols)
+        label_cols = list(self.label_cols)
+
+        def batch_xy(batch):
+            x, y = batch_to_xy(batch, feature_cols, label_cols)
+            # torch.tensor copies: arrow hands out read-only views
+            return torch.tensor(x), torch.tensor(y)
+
+        def train_fn():
+            rank, size = hvd.rank(), hvd.size()
+            model = _deserialize_model(model_bytes)
+            optimizer = _make_optimizer(est.optimizer, model)
+            optimizer = DistributedOptimizer(
+                optimizer, named_parameters=model.named_parameters(),
+                backward_passes_per_step=est.backward_passes_per_step)
+            broadcast_parameters(model.state_dict(), root_rank=0)
+
+            history = []
+            for epoch in range(est.epochs):
+                model.train()
+                total, count = 0.0, 0
+                reader = make_batch_reader(
+                    train_path,
+                    schema_fields=feature_cols + label_cols,
+                    batch_size=est.batch_size, cur_shard=rank,
+                    shard_count=size, shuffle_row_groups=True,
+                    seed=epoch)
+                # every rank must run the SAME number of optimizer
+                # steps: shards can differ by a row group, and a lone
+                # extra gradient allreduce would deadlock the job
+                n_local = -(-reader.num_rows // est.batch_size)
+                steps = synced_step_count(n_local,
+                                          name=f"steps.{epoch}")
+                batches = iter(reader)
+                for _ in range(steps):
+                    xb, yb = batch_xy(next(batches))
+                    optimizer.zero_grad()
+                    loss = est.loss(model(xb), yb)
+                    loss.backward()
+                    optimizer.step()
+                    total += float(loss.detach()) * len(xb)
+                    count += len(xb)
+                train_loss = float(allreduce(
+                    torch.tensor(total / max(count, 1)),
+                    name=f"train_loss.{epoch}"))
+                entry = {"epoch": epoch, "train_loss": train_loss}
+                if val_path is not None:
+                    model.eval()
+                    vtotal, vcount = 0.0, 0
+                    vreader = make_batch_reader(
+                        val_path,
+                        schema_fields=feature_cols + label_cols,
+                        batch_size=est.batch_size, cur_shard=rank,
+                        shard_count=size)
+                    with torch.no_grad():
+                        for batch in vreader:
+                            xb, yb = batch_xy(batch)
+                            vtotal += float(est.loss(model(xb), yb)) \
+                                * len(xb)
+                            vcount += len(xb)
+                    entry["val_loss"] = float(allreduce(
+                        torch.tensor(vtotal / max(vcount, 1)),
+                        name=f"val_loss.{epoch}"))
+                history.append(entry)
+                if rank == 0 and store is not None:
+                    store.save_checkpoint(
+                        run_id, _serialize_model(model))
+            return (_serialize_model(model), history) if rank == 0 \
+                else None
+
+        results = hvd_run(train_fn, np=self.num_proc)
+        model_out, history = next(r for r in results if r is not None)
+        return TorchModel(model=_deserialize_model(model_out),
+                          history=history,
+                          feature_cols=self.feature_cols,
+                          label_cols=self.label_cols,
+                          run_id=run_id, store=store)
 
     def fit_arrays(self, x, y, x_val=None, y_val=None):
         """Train on host arrays (the post-materialization path)."""

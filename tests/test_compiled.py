@@ -216,3 +216,35 @@ def test_compiled_reducer_survives_reinit(hvd_shutdown):
     outs = hvd.run(fn2, np=2)
     # average over the NEW world of 2, not the stale 4
     assert all(np.allclose(o, 1.5) for o in outs), outs
+
+
+def test_compiled_train_step_has_aux(hvd_shutdown):
+    """aux (mutable model state, e.g. BN stats) threads through the
+    step and float leaves are cross-replica averaged."""
+    import jax.numpy as jnp
+
+    def loss_fn(params, aux, batch):
+        loss = jnp.mean((batch @ params["w"]) ** 2)
+        new_aux = {"running": aux["running"] * 0.9
+                   + 0.1 * jnp.mean(batch),
+                   "count": aux["count"] + 1}
+        return loss, new_aux
+
+    def fn():
+        step = hvd.make_compiled_train_step(
+            loss_fn, optax.sgd(0.01), has_aux=True)
+        state = step.init_state(
+            {"w": np.ones((3, 1), np.float32)},
+            aux={"running": np.zeros((), np.float32),
+                 "count": np.zeros((), np.int32)})
+        batch = np.full((2, 3), float(hvd.rank()), np.float32)
+        state, loss = step(state, batch)
+        return (float(state["aux"]["running"]),
+                int(state["aux"]["count"]), float(loss))
+
+    results = run_ranks(fn)
+    runnings = [r[0] for r in results]
+    # pmean of 0.1*mean(batch)=0.1*r over ranks = 0.1*mean(r)
+    expected = 0.1 * np.mean(range(NP))
+    assert all(np.isclose(v, expected) for v in runnings), runnings
+    assert all(r[1] == 1 for r in results)

@@ -16,29 +16,117 @@ def _require_ray():
             "this environment") from exc
 
 
+class HorovodWorker:
+    """Actor body for one rank (reference ray/worker.py
+    BaseHorovodWorker): carries rank identity, exposes host/node
+    queries for placement bookkeeping, executes functions in-actor."""
+
+    def __init__(self, world_rank=0, world_size=1, env=None):
+        import os
+
+        self.world_rank = world_rank
+        self.world_size = world_size
+        os.environ.update(env or {})
+
+    def hostname(self):
+        import socket
+
+        return socket.gethostname()
+
+    def node_id(self):
+        try:
+            import ray
+
+            return ray.get_runtime_context().get_node_id()
+        except Exception:  # noqa: BLE001 — fake/old ray
+            return self.hostname()
+
+    def update_env_vars(self, env):
+        import os
+
+        os.environ.update({k: str(v) for k, v in env.items()})
+
+    def env_vars(self):
+        import os
+
+        return dict(os.environ)
+
+    def execute(self, fn, *a, **kw):
+        return fn(*a, **kw)
+
+
 class RayExecutor:
     """Launch a horovod_tpu job on Ray actors (reference
-    ray/runner.py:168-420: placement strategies, per-actor env
-    handoff, run/run_remote/execute API)."""
+    ray/runner.py:168-420): worker placement goes through the
+    reference's two strategies (strategy.py here) —
 
-    def __init__(self, settings=None, num_workers=None,
-                 cpus_per_worker=1, use_gpu=False,
+    * ``num_hosts`` x ``num_workers_per_host`` -> ColocatedStrategy
+      (balanced hosts, STRICT_SPREAD bundles; the TPU-pod shape), or
+    * ``num_workers`` -> PGStrategy (PACK, honors an ambient
+      placement group — Ray Tune trials).
+    """
+
+    def __init__(self, settings=None, num_workers=None, num_hosts=None,
+                 num_workers_per_host=1, cpus_per_worker=1,
+                 use_gpu=False, gpus_per_worker=None,
+                 use_current_placement_group=True,
                  placement_group_timeout_s=100, **kwargs):
         _require_ray()
-        self.num_workers = num_workers
+        if num_workers is None and num_hosts is None:
+            raise ValueError(
+                "set either num_workers (PACK) or num_hosts + "
+                "num_workers_per_host (colocated)")
+        if num_workers is not None and num_hosts is not None:
+            # the two specs would disagree about world size (the
+            # reference runner rejects the combination the same way)
+            raise ValueError(
+                "num_workers and num_hosts are mutually exclusive")
+        self.num_hosts = num_hosts
+        self.num_workers_per_host = num_workers_per_host
         self.cpus_per_worker = cpus_per_worker
+        self.use_gpu = use_gpu
+        self.gpus_per_worker = gpus_per_worker
+        self.use_current_placement_group = use_current_placement_group
+        self.pg_timeout = placement_group_timeout_s
+        self._num_workers = num_workers
+        self.strategy = None
         self._workers = []
+
+    @property
+    def num_workers(self):
+        if self._num_workers is not None:
+            return self._num_workers
+        return self.num_hosts * self.num_workers_per_host
+
+    def _make_strategy(self):
+        from .strategy import ColocatedStrategy, PGStrategy
+
+        if self.num_hosts is not None:
+            return ColocatedStrategy(
+                num_hosts=self.num_hosts,
+                num_workers_per_host=self.num_workers_per_host,
+                use_gpu=self.use_gpu,
+                cpus_per_worker=self.cpus_per_worker,
+                gpus_per_worker=self.gpus_per_worker,
+                placement_group_timeout_s=self.pg_timeout)
+        return PGStrategy(
+            num_workers=self._num_workers, use_gpu=self.use_gpu,
+            cpus_per_worker=self.cpus_per_worker,
+            gpus_per_worker=self.gpus_per_worker,
+            force_create_placement_group=(
+                not self.use_current_placement_group),
+            placement_group_timeout_s=self.pg_timeout)
 
     def start(self, executable_cls=None, executable_args=None,
               executable_kwargs=None, extra_env_vars=None):
-        import ray
+        import os as _os
         import secrets as _secrets
+
         from ..runner.http.http_server import (
             RendezvousServer, autotune_kwargs, local_ip,
         )
 
         secret_hex = _secrets.token_hex(16)
-        import os as _os
         at_env = dict(_os.environ)
         at_env.update(extra_env_vars or {})
         self._server = RendezvousServer(
@@ -51,29 +139,25 @@ class RayExecutor:
         s = _socket.socket(); s.bind(("", 0))
         coordinator = f"{addr}:{s.getsockname()[1]}"; s.close()
 
-        @ray.remote(num_cpus=self.cpus_per_worker)
-        class Worker:
-            def __init__(self, index, env):
-                import os
-                os.environ.update(env)
-                os.environ.update({
-                    "HOROVOD_CONTROLLER": "http",
-                    "HOROVOD_GLOO_RENDEZVOUS_ADDR": addr,
-                    "HOROVOD_GLOO_RENDEZVOUS_PORT": str(port),
-                    "HOROVOD_SECRET_KEY": secret_hex,
-                    "HOROVOD_TPU_PROC_INDEX": str(index),
-                    "HOROVOD_TPU_NUM_PROCS": str(self_num),
-                    "HOROVOD_TPU_RANKS_PER_PROC": "1",
-                    "HOROVOD_TPU_COORDINATOR": coordinator,
-                })
-
-            def execute(self, fn, *a, **kw):
-                return fn(*a, **kw)
-
-        self_num = self.num_workers
-        self._workers = [
-            Worker.remote(i, extra_env_vars or {})
-            for i in range(self.num_workers)]
+        self.strategy = self._make_strategy()
+        base_env = dict(extra_env_vars or {})
+        base_env.update({
+            "HOROVOD_CONTROLLER": "http",
+            "HOROVOD_GLOO_RENDEZVOUS_ADDR": addr,
+            "HOROVOD_GLOO_RENDEZVOUS_PORT": str(port),
+            "HOROVOD_SECRET_KEY": secret_hex,
+            "HOROVOD_TPU_NUM_PROCS": str(self.num_workers),
+            "HOROVOD_TPU_RANKS_PER_PROC": "1",
+            "HOROVOD_TPU_COORDINATOR": coordinator,
+        })
+        self._workers, self._node_workers =             self.strategy.create_workers(HorovodWorker, base_env)
+        # per-rank identity rides a post-placement env update (the
+        # reference does the same for CUDA_VISIBLE_DEVICES fan-out)
+        import ray
+        ray.get([
+            w.update_env_vars.remote({"HOROVOD_TPU_PROC_INDEX": i,
+                                      "HOROVOD_RANK": i})
+            for i, w in enumerate(self._workers)])
 
     def run(self, fn, args=None, kwargs=None):
         import ray
@@ -87,9 +171,18 @@ class RayExecutor:
 
     def shutdown(self):
         import ray
+        # kill actors explicitly: with an ambient placement group the
+        # strategy does not remove the group, and lingering handles
+        # (incl. _node_workers) would otherwise pin trial resources
         for w in self._workers:
-            ray.kill(w)
+            try:
+                ray.kill(w)
+            except Exception:  # noqa: BLE001 — already dead / fake ray
+                pass
         self._workers = []
+        self._node_workers = []
+        if self.strategy is not None:
+            self.strategy.shutdown()
         if getattr(self, "_server", None):
             self._server.stop()
 

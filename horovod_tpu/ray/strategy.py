@@ -1,0 +1,214 @@
+"""Worker placement strategies over Ray placement groups.
+
+Reference: ``horovod/ray/strategy.py:1-223`` — ``ColocatedStrategy``
+(balanced hosts via one aggregate bundle per host + STRICT_SPREAD) and
+``PGStrategy`` (one bundle per worker, PACK, honoring an ambient
+placement group).  The TPU build keeps the same two shapes: colocated
+placement is what keeps a host's workers on that host's TPU chips
+(local ranks must sit with their chips for ICI to be reachable), and
+PACK minimizes cross-host DCN hops for small jobs.
+
+``ray`` is imported lazily inside methods so the classes are
+constructible and unit-testable without ray installed (a fake module
+in ``sys.modules`` suffices — the tests assert bundle layouts).
+"""
+
+from collections import defaultdict
+
+
+def create_placement_group(resources_per_bundle, num_bundles,
+                           pg_timeout, pg_strategy):
+    """Allocate + await a placement group (reference strategy.py:13-30)."""
+    import ray
+
+    bundles = [dict(resources_per_bundle) for _ in range(num_bundles)]
+    pg = ray.util.placement_group(bundles, strategy=pg_strategy)
+    ready, _ = ray.wait([pg.ready()], timeout=pg_timeout)
+    if not ready:
+        raise TimeoutError(
+            "Placement group creation timed out; cluster lacks "
+            f"resources for {bundles} (available: "
+            f"{ray.available_resources()})")
+    return pg, bundles
+
+
+class BaseStrategy:
+    """Common surface (reference strategy.py:33-62)."""
+
+    placement_group = None
+    workers = None
+
+    def create_workers(self, worker_cls, worker_env):
+        raise NotImplementedError
+
+    @property
+    def num_workers(self):
+        raise NotImplementedError
+
+    @classmethod
+    def get_node_workers(cls, workers):
+        """One worker per node (the reference uses these for NIC
+        probing; here they anchor per-host work like data staging)."""
+        import ray
+
+        hostnames = ray.get([w.hostname.remote() for w in workers])
+        by_host = {}
+        for hostname, worker in zip(hostnames, workers):
+            by_host.setdefault(hostname, worker)
+        return list(by_host.values())
+
+    def shutdown(self):
+        import ray
+
+        if self.placement_group:
+            ray.util.remove_placement_group(self.placement_group)
+        self.workers = []
+        self.placement_group = None
+
+
+class ColocatedStrategy(BaseStrategy):
+    """Balanced hosts: one aggregate bundle per host, STRICT_SPREAD so
+    every bundle lands on a distinct node, then
+    ``num_workers_per_host`` workers pinned into each bundle
+    (reference strategy.py:65-137).  This is the TPU-pod shape: a
+    host's workers must sit with the host's chips."""
+
+    def __init__(self, *, settings=None, num_hosts,
+                 num_workers_per_host, use_gpu=False, cpus_per_worker=1,
+                 gpus_per_worker=None, placement_group_timeout_s=100):
+        self.settings = settings
+        self.num_hosts = int(num_hosts)
+        self.num_workers_per_host = int(num_workers_per_host)
+        self.use_gpu = use_gpu
+        self.cpus_per_worker = cpus_per_worker
+        self.gpus_per_worker = gpus_per_worker or 1
+        self.pg_timeout = getattr(settings, "placement_group_timeout_s",
+                                  placement_group_timeout_s)
+
+    @property
+    def num_workers(self):
+        return self.num_hosts * self.num_workers_per_host
+
+    def _resources_per_host(self):
+        res = {"CPU": self.cpus_per_worker * self.num_workers_per_host}
+        if self.use_gpu:
+            res["GPU"] = self.gpus_per_worker * self.num_workers_per_host
+        return res
+
+    def create_workers(self, worker_cls, worker_env=None):
+        """Returns (workers, node_workers); worker ``i`` has
+        world_rank ``i``, grouped per host bundle."""
+        import ray
+
+        self.placement_group, bundles = create_placement_group(
+            resources_per_bundle=self._resources_per_host(),
+            num_bundles=self.num_hosts,
+            pg_timeout=self.pg_timeout,
+            pg_strategy="STRICT_SPREAD")
+        self.workers = []
+        remote_cls = ray.remote(worker_cls)
+        for bundle_index in range(len(bundles)):
+            for i in range(self.num_workers_per_host):
+                options = remote_cls.options(
+                    num_cpus=self.cpus_per_worker,
+                    num_gpus=self.gpus_per_worker * int(self.use_gpu),
+                    placement_group_capture_child_tasks=False,
+                    placement_group=self.placement_group,
+                    placement_group_bundle_index=bundle_index)
+                rank = self.num_workers_per_host * bundle_index + i
+                self.workers.append(options.remote(
+                    world_rank=rank, world_size=self.num_workers,
+                    env=dict(worker_env or {})))
+        return self.workers, self.get_node_workers(self.workers)
+
+
+class PGStrategy(BaseStrategy):
+    """One bundle per worker, PACK (reference strategy.py:139-223):
+    dense placement without a balanced-hosts guarantee; reuses the
+    ambient placement group when the caller already runs inside one
+    (Ray Tune trials do)."""
+
+    def __init__(self, *, settings=None, num_workers, use_gpu=False,
+                 cpus_per_worker=1, gpus_per_worker=None,
+                 placement_group=None,
+                 force_create_placement_group=False,
+                 placement_group_timeout_s=100):
+        self.settings = settings
+        self._num_workers = int(num_workers)
+        self.use_gpu = use_gpu
+        self.cpus_per_worker = cpus_per_worker
+        self.gpus_per_worker = gpus_per_worker or 1
+        self.pg_timeout = getattr(settings, "placement_group_timeout_s",
+                                  placement_group_timeout_s)
+        if force_create_placement_group:
+            self.placement_group = None
+        else:
+            self.placement_group = placement_group or \
+                self._current_placement_group()
+        self._created_placement_group = False
+
+    @staticmethod
+    def _current_placement_group():
+        try:
+            from ray.util.placement_group import \
+                get_current_placement_group
+            return get_current_placement_group()
+        except Exception:  # noqa: BLE001 — fake/old ray
+            return None
+
+    @property
+    def num_workers(self):
+        return self._num_workers
+
+    def resources_per_worker(self):
+        res = {"CPU": self.cpus_per_worker}
+        if self.use_gpu:
+            res["GPU"] = self.gpus_per_worker
+        return res
+
+    def create_workers(self, worker_cls, worker_env=None):
+        import ray
+
+        if not self.placement_group:
+            self.placement_group, _ = create_placement_group(
+                resources_per_bundle=self.resources_per_worker(),
+                num_bundles=self.num_workers,
+                pg_timeout=self.pg_timeout,
+                pg_strategy="PACK")
+            self._created_placement_group = True
+        self.workers = []
+        remote_cls = ray.remote(worker_cls)
+        for worker_index in range(self.num_workers):
+            options = remote_cls.options(
+                num_cpus=self.cpus_per_worker,
+                num_gpus=self.gpus_per_worker * int(self.use_gpu),
+                placement_group_capture_child_tasks=False,
+                placement_group=self.placement_group,
+                placement_group_bundle_index=(
+                    worker_index if self._created_placement_group
+                    else -1))
+            self.workers.append(options.remote(
+                world_rank=worker_index, world_size=self.num_workers,
+                env=dict(worker_env or {})))
+        return self.workers, self.get_node_workers(self.workers)
+
+    def shutdown(self):
+        import ray
+
+        if self._created_placement_group and self.placement_group:
+            ray.util.remove_placement_group(self.placement_group)
+            self.placement_group = None
+        self.workers = []
+
+
+def group_workers_by_node(workers):
+    """{node_id: [workers]} — the reference's per-node env fan-out
+    (CUDA_VISIBLE_DEVICES aggregation, strategy.py:199-216) keyed the
+    same way; TPU pods use it to hand each host its chip set."""
+    import ray
+
+    node_ids = ray.get([w.node_id.remote() for w in workers])
+    grouped = defaultdict(list)
+    for worker, node_id in zip(workers, node_ids):
+        grouped[node_id].append(worker)
+    return dict(grouped)

@@ -1,11 +1,11 @@
 """Lightning estimator (reference ``horovod/spark/lightning/``).
 
-Gated: pytorch_lightning is not part of this image.  The contract is
-kept so Lightning-side code ports unchanged; a LightningModule is a
-torch module + optimizer/loss configuration, so the training loop
-delegates to :class:`horovod_tpu.spark.torch.TorchEstimator`'s
-machinery with the module's own ``configure_optimizers`` and
-``training_step``.
+The distributed loop drives the LightningModule's own hook cycle
+(configure_optimizers / training_step / epoch hooks / validation_step
+/ self.log) through the framework's DistributedOptimizer — see
+``estimator.py``.  The hooks are duck-typed, so the machinery runs
+and is tested without pytorch_lightning installed; real
+LightningModules pass through unchanged when it is.
 """
 
 from .estimator import LightningEstimator, LightningModel  # noqa: F401

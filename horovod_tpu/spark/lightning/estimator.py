@@ -1,98 +1,315 @@
 """LightningEstimator / LightningModel.
 
-Reference: ``horovod/spark/lightning/estimator.py`` (LightningEstimator
-wrapping a LightningModule in the same Store/backend machinery as the
-torch estimator).  Gated on pytorch_lightning; the distributed loop is
-shared with :mod:`..torch.estimator` — a LightningModule supplies its
-optimizer via ``configure_optimizers`` and its loss via
-``training_step``.
+Reference: ``horovod/spark/lightning/estimator.py`` +
+``lightning/remote.py`` — a Spark ML Estimator that trains a
+LightningModule under Horovod, streaming Petastorm shards, and returns
+a transformer.
+
+This build drives the LightningModule's OWN hook cycle
+(``configure_optimizers`` / ``on_train_start`` /
+``on_train_epoch_start`` / ``training_step`` / ``backward`` /
+``on_train_epoch_end`` / ``validation_step``) through the framework's
+``DistributedOptimizer`` + rank launcher — rather than embedding
+``pl.Trainer`` (whose horovod strategy was removed upstream).  Modules
+written for Lightning run unmodified: ``self.log(...)`` is captured
+per epoch and metric-averaged across ranks.
+
+Works with any LightningModule-shaped object (the hooks are duck
+typed), so the machinery is fully tested without pytorch_lightning in
+the image; when pytorch_lightning IS installed, real modules pass
+through the gate in :mod:`.` unchanged.
 """
 
 import numpy as np
 
 from ..common.params import EstimatorParams
+from ..common.util import synced_step_count
 from ..torch.estimator import TorchModel
 
 
-def _require_lightning():
-    try:
-        import pytorch_lightning  # noqa: F401
-    except ImportError:
+class _LogCapture:
+    """Stand-in for Lightning's trainer-backed ``self.log``: records
+    scalar metrics per epoch so they can be rank-averaged."""
+
+    def __init__(self):
+        self.metrics = {}
+
+    def __call__(self, name, value, *a, **kw):
         try:
-            import lightning  # noqa: F401
-        except ImportError as exc:
-            raise ImportError(
-                "horovod_tpu.spark.lightning requires pytorch_lightning, "
-                "which is not installed in this environment; use "
-                "horovod_tpu.spark.torch.TorchEstimator") from exc
+            v = float(value)
+        except (TypeError, ValueError):
+            return
+        self.metrics.setdefault(name, []).append(v)
+
+    def epoch_means(self):
+        out = {k: float(np.mean(vs)) for k, vs in self.metrics.items()}
+        self.metrics = {}
+        return out
+
+
+def _resolve_optimizer(module):
+    """configure_optimizers() -> a single torch optimizer (reference
+    remote.py supports the common single-optimizer shapes)."""
+    opt = module.configure_optimizers()
+    if isinstance(opt, dict):
+        opt = opt.get("optimizer")
+    if isinstance(opt, (list, tuple)):
+        opt = opt[0]
+        if isinstance(opt, (list, tuple)):
+            opt = opt[0]
+        if isinstance(opt, dict):
+            opt = opt.get("optimizer")
+    if opt is None:
+        raise ValueError(
+            "configure_optimizers() returned None (manual "
+            "optimization is not supported by LightningEstimator)")
+    return opt
+
+
+def _step_loss(out):
+    if out is None:
+        return None
+    if isinstance(out, dict):
+        return out["loss"]
+    return out
+
+
+def _call_hook(module, name, *args):
+    hook = getattr(module, name, None)
+    if callable(hook):
+        return hook(*args)
+    return None
 
 
 class LightningEstimator(EstimatorParams):
-    """``model`` is a LightningModule; batch/epoch/store parameters as
-    in :class:`..torch.estimator.TorchEstimator`."""
+    """``model`` is a LightningModule (or any object with
+    ``training_step(batch, idx)`` + ``configure_optimizers()``);
+    batch/epoch/store parameters as in
+    :class:`..torch.estimator.TorchEstimator`."""
 
     def fit(self, df, params=None):
-        _require_lightning()
-        from ..torch.estimator import TorchEstimator
+        """Spark entry: stage Parquet through the store and stream
+        (same flow as the torch estimator)."""
+        from ..common.util import (
+            extract_xy, require_pyspark, stage_dataframe_to_store,
+        )
 
-        # shared DataFrame-materialization path (dispatches back into
-        # this class's fit_arrays)
-        return TorchEstimator.fit(self, df, params)
+        require_pyspark()
+        if self.store is None:
+            x, y = extract_xy(df.toPandas(), self.feature_cols,
+                              self.label_cols)
+            return self.fit_arrays(x, y)
+        train_path = stage_dataframe_to_store(
+            df, self.store, self.feature_cols, self.label_cols)
+        return self.fit_on_parquet(train_path)
+
+    # -- training loops ------------------------------------------------------
 
     def fit_arrays(self, x, y, x_val=None, y_val=None):
-        _require_lightning()
-        from ..torch.estimator import TorchEstimator
+        """Train on host arrays."""
+        from ..common.util import split_validation
 
-        module = self.model
+        x = np.asarray(x)
+        y = np.asarray(y)
+        x, y, x_val, y_val = split_validation(x, y, x_val, y_val,
+                                              self.validation)
 
-        def optimizer_fn(params):
-            opt = module.configure_optimizers()
-            if isinstance(opt, dict):           # {'optimizer': ..., ...}
-                opt = opt["optimizer"]
-            if isinstance(opt, (list, tuple)):
-                opt = opt[0]
-                if isinstance(opt, (list, tuple)):
-                    opt = opt[0]
-                if isinstance(opt, dict):
-                    opt = opt["optimizer"]
-            if opt is None:
-                raise ValueError(
-                    "configure_optimizers() returned None (manual "
-                    "optimization); LightningEstimator needs an "
-                    "optimizer to drive the shared training loop")
-            return opt.__class__(params, **opt.defaults)
+        def batches_fn(rank, size, epoch):
+            import torch
 
-        crit = getattr(module, "loss", None) or \
-            getattr(module, "criterion", None)
-        if crit is None:
-            # the shared loop decomposes training as model(x) +
-            # loss(out, y); silently guessing a criterion would train
-            # the wrong objective for modules that bury it inside
-            # training_step
-            raise ValueError(
-                "the LightningModule must expose its criterion as a "
-                "`loss` (or `criterion`) attribute — the distributed "
-                "loop runs model(x) + loss(out, y) rather than "
-                "training_step")
+            xs = torch.as_tensor(x[rank::size])
+            ys = torch.as_tensor(y[rank::size])
+            perm = torch.randperm(
+                len(xs), generator=torch.Generator().manual_seed(epoch))
+            bs = self.batch_size
+            batches = [(xs[perm[i:i + bs]], ys[perm[i:i + bs]])
+                       for i in range(0, len(xs), bs)]
+            return batches, len(batches)
 
-        def loss_fn(outputs, labels):
-            return crit(outputs, labels)
+        val_fn = None
+        if x_val is not None:
+            def val_fn(rank, size):
+                import torch
 
-        inner = TorchEstimator(
-            model=module, optimizer=optimizer_fn, loss=loss_fn,
-            feature_cols=self.feature_cols, label_cols=self.label_cols,
-            batch_size=self.batch_size, epochs=self.epochs,
-            validation=self.validation, num_proc=self.num_proc,
-            store=self.store, run_id=self.run_id,
-            backward_passes_per_step=self.backward_passes_per_step)
-        tm = inner.fit_arrays(x, y, x_val, y_val)
-        return LightningModel(model=tm.model, history=tm.history,
+                return [(torch.as_tensor(x_val), torch.as_tensor(y_val))]
+
+        return self._fit(batches_fn, val_fn)
+
+    def fit_on_parquet(self, train_path, val_path=None):
+        """Stream a Parquet dataset per rank (Petastorm role)."""
+        from ..common.reader import make_batch_reader
+        from ..common.util import batch_to_xy
+
+        feature_cols = list(self.feature_cols)
+        label_cols = list(self.label_cols)
+
+        def batches_fn(rank, size, epoch):
+            import torch
+
+            # count and iterate the SAME shuffled reader: the shuffle
+            # permutes row groups before sharding, so this epoch's
+            # shard size is only known from this epoch's reader
+            reader = make_batch_reader(
+                train_path, schema_fields=feature_cols + label_cols,
+                batch_size=self.batch_size, cur_shard=rank,
+                shard_count=size, shuffle_row_groups=True, seed=epoch)
+            n_batches = -(-reader.num_rows // self.batch_size)
+
+            def gen():
+                for b in reader:
+                    xb, yb = batch_to_xy(b, feature_cols, label_cols)
+                    yield torch.tensor(xb), torch.tensor(yb)
+
+            return gen(), n_batches
+
+        val_fn = None
+        if val_path is not None:
+            def val_fn(rank, size):
+                import torch
+
+                reader = make_batch_reader(
+                    val_path, schema_fields=feature_cols + label_cols,
+                    batch_size=self.batch_size, cur_shard=rank,
+                    shard_count=size)
+                for b in reader:
+                    xb, yb = batch_to_xy(b, feature_cols, label_cols)
+                    yield torch.tensor(xb), torch.tensor(yb)
+
+        return self._fit(batches_fn, val_fn)
+
+    def _fit(self, batches_fn, val_fn=None):
+        """Shared distributed Lightning loop: hooks + training_step
+        through DistributedOptimizer (reference lightning/remote.py
+        role).  ``batches_fn(rank, size, epoch) -> (iterable,
+        n_batches)``; step counts are Min-synced every epoch so uneven
+        shards cannot mismatch gradient collectives."""
+        from ... import run as hvd_run
+        from ... import torch as hvd
+        from ...torch import (
+            DistributedOptimizer, broadcast_parameters, allreduce,
+        )
+
+        est = self
+        module_bytes = _serialize(self.model)
+        store = self.store
+        run_id = self.run_id or "run"
+
+        def train_fn():
+            import torch
+
+            rank, size = hvd.rank(), hvd.size()
+            module = _deserialize(module_bytes)
+            log = _LogCapture()
+            module.log = log                      # trainer-log shim
+            base_opt = _resolve_optimizer(module)
+            optimizer = DistributedOptimizer(
+                base_opt, named_parameters=module.named_parameters(),
+                backward_passes_per_step=est.backward_passes_per_step)
+            broadcast_parameters(module.state_dict(), root_rank=0)
+
+            _call_hook(module, "on_train_start")
+            skip_warned = False
+            history = []
+            for epoch in range(est.epochs):
+                module.train()
+                _call_hook(module, "on_train_epoch_start")
+                total, count = 0.0, 0
+                batches, n_local = batches_fn(rank, size, epoch)
+                # every rank must run the same number of optimizer
+                # steps: shards (array slices or row groups) can be
+                # uneven, and a lone extra gradient allreduce deadlocks
+                steps = synced_step_count(n_local,
+                                          name=f"lsteps.{epoch}")
+                it = iter(batches)
+                for i in range(steps):
+                    batch = next(it)
+                    optimizer.zero_grad()
+                    loss = _step_loss(module.training_step(batch, i))
+                    if loss is None:
+                        # Lightning's skip-this-step contract.  The
+                        # skip must be replicated on every rank (the
+                        # batch schedule is) or collectives desync.
+                        if not skip_warned:
+                            import warnings
+
+                            warnings.warn(
+                                "training_step returned None (step "
+                                "skipped); ensure skips are "
+                                "rank-independent", stacklevel=2)
+                            skip_warned = True
+                        continue
+                    loss.backward()
+                    optimizer.step()
+                    total += float(loss.detach()) * len(batch[0])
+                    count += len(batch[0])
+                _call_hook(module, "on_train_epoch_end")
+                entry = {"epoch": epoch,
+                         "train_loss": float(allreduce(
+                             torch.tensor(total / max(count, 1)),
+                             name=f"ltrain.{epoch}"))}
+                for k, v in log.epoch_means().items():
+                    entry[k] = float(allreduce(
+                        torch.tensor(v), name=f"lmetric.{k}.{epoch}"))
+                if val_fn is not None and \
+                        callable(getattr(module, "validation_step",
+                                         None)):
+                    module.eval()
+                    _call_hook(module, "on_validation_epoch_start")
+                    vtotal, vcount = 0.0, 0
+                    with torch.no_grad():
+                        for j, vb in enumerate(val_fn(rank, size)):
+                            vout = _step_loss(
+                                module.validation_step(vb, j))
+                            if vout is not None:
+                                vtotal += float(vout) * len(vb[0])
+                                vcount += len(vb[0])
+                    _call_hook(module, "on_validation_epoch_end")
+                    log.epoch_means()   # drop val-side self.log dups
+                    # EVERY rank enters both collectives — a rank with
+                    # an empty val shard contributes zero weight
+                    # rather than skipping (which would hang peers)
+                    gtotal = float(allreduce(
+                        torch.tensor(float(vtotal)), average=False,
+                        name=f"lval_sum.{epoch}"))
+                    gcount = float(allreduce(
+                        torch.tensor(float(vcount)), average=False,
+                        name=f"lval_cnt.{epoch}"))
+                    if gcount > 0:
+                        entry["val_loss"] = gtotal / gcount
+                history.append(entry)
+                if rank == 0 and store is not None:
+                    store.save_checkpoint(run_id, _serialize(module))
+            _call_hook(module, "on_train_end")
+            return (_serialize(module), history) if rank == 0 else None
+
+        results = hvd_run(train_fn, np=self.num_proc)
+        blob, history = next(r for r in results if r is not None)
+        return LightningModel(model=_deserialize(blob), history=history,
                               feature_cols=self.feature_cols,
                               label_cols=self.label_cols,
-                              run_id=tm.run_id, store=tm.store)
+                              run_id=run_id, store=store)
 
 
 class LightningModel(TorchModel):
     """Trained transformer (reference spark/lightning TorchModel
-    analogue) — same surface as :class:`..torch.estimator.TorchModel`;
-    the inherited ``load`` already constructs this class via ``cls``."""
+    analogue) — same surface as
+    :class:`..torch.estimator.TorchModel` (inherited ``load`` /
+    ``transform_arrays`` / ``transform``)."""
+
+
+def _serialize(module) -> bytes:
+    from ..torch.estimator import _serialize_model
+
+    # drop the unpicklable log shim for the trip
+    log = module.__dict__.pop("log", None)
+    try:
+        return _serialize_model(module)
+    finally:
+        if log is not None:
+            module.log = log
+
+
+def _deserialize(blob: bytes):
+    from ..torch.estimator import _deserialize_model
+
+    return _deserialize_model(blob)

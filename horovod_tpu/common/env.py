@@ -100,6 +100,10 @@ class Config:
         self.fusion_threshold_bytes = get_int(
             HOROVOD_FUSION_THRESHOLD, DEFAULT_FUSION_THRESHOLD_BYTES)
         self.cycle_time_ms = get_float(HOROVOD_CYCLE_TIME, DEFAULT_CYCLE_TIME_MS)
+        # fusion pack goes multithreaded above this bucket size
+        # (csrc hvd_pack_mt); a third autotune dimension
+        self.pack_mt_threshold_bytes = get_int(
+            "HOROVOD_TPU_PACK_MT_THRESHOLD", 8 << 20)
         self.cache_capacity = get_int(HOROVOD_CACHE_CAPACITY, DEFAULT_CACHE_CAPACITY)
         self.timeline_filename = get_str(HOROVOD_TIMELINE)
         self.timeline_mark_cycles = get_bool(HOROVOD_TIMELINE_MARK_CYCLES)

@@ -583,10 +583,14 @@ class Engine:
             self.abort(exc)
             return
         tuned = self.controller.tuned
-        if tuned and "cycle_time_ms" in tuned:
+        if tuned:
             # coordinator-side autotune broadcast (reference
             # SynchronizeParameters, controller.cc:40-54)
-            self.config.cycle_time_ms = tuned["cycle_time_ms"]
+            if "cycle_time_ms" in tuned:
+                self.config.cycle_time_ms = tuned["cycle_time_ms"]
+            if "pack_mt_threshold_bytes" in tuned:
+                self.config.pack_mt_threshold_bytes = \
+                    tuned["pack_mt_threshold_bytes"]
         for resp in responses:
             self._apply_response(resp)
 
@@ -904,7 +908,8 @@ class Engine:
                 # one native batched memcpy per rank per bucket (the
                 # reference's batched-D2D kernel, cuda_kernels.cu:27-292);
                 # multithreaded above 8 MiB
-                if total * itemsize >= 8 << 20:
+                if total * itemsize >= \
+                        self.config.pack_mt_threshold_bytes:
                     native.pack_mt(arrays, buf, offs_bytes)
                 else:
                     native.pack(arrays, buf, offs_bytes)

@@ -53,6 +53,12 @@ class StoreController:
         self._lock = threading.Lock()
         self._jid = 0         # join-request id (idempotent retries)
         self._rid = 0         # ready-report id (idempotent retries)
+        # session id: a NEW controller against the SAME coordinator
+        # (engine shutdown + re-init without an elastic round reset)
+        # must not have its reports deduplicated against the previous
+        # controller's counters
+        import secrets as _secrets
+        self._sid = _secrets.token_hex(8)
         #: Last coordinator-tuned parameters seen in a poll reply
         #: (reference SynchronizeParameters broadcast); the engine
         #: applies them to its config each cycle.
@@ -95,7 +101,8 @@ class StoreController:
             rid = self._rid
         out = self.client.coord("ready", {
             "proc": self.proc_id, "nlocal": self.nlocal,
-            "round": self.round_id, "entries": entries, "rid": rid})
+            "round": self.round_id, "entries": entries, "rid": rid,
+            "sid": self._sid})
         if out.get("stale"):
             raise StaleRoundError(
                 f"coordinator moved to round {out.get('round')}")
@@ -133,7 +140,7 @@ class StoreController:
                                          "proc": self.proc_id,
                                          "round": self.round_id,
                                          "proc_members": proc_members,
-                                         "jid": jid})
+                                         "jid": jid, "sid": self._sid})
         if out.get("stale"):
             raise StaleRoundError(
                 f"coordinator moved to round {out.get('round')}")

@@ -8,6 +8,11 @@ without mxnet installed; the three mxnet-dependent entry points
 (DistributedOptimizer, DistributedTrainer, broadcast_parameters) are
 resolved lazily and raise a clear ImportError when mxnet (EOL
 upstream) is absent from the image.
+
+STATUS: experimental — mxnet is not installable in the CI image, so
+the mxnet-dependent wrappers are exercised only through their gating
+tests; the framework-neutral surface below them is the same tested
+engine every other frontend uses.
 """
 
 from ..common.basics import (  # noqa: F401

@@ -136,24 +136,92 @@ def allreduce_(tensor, average=None, name=None, op=None,
     return synchronize(h)
 
 
+class _MultiHandle:
+    """Composite handle over per-dtype grouped submissions: a mixed-
+    dtype group partitions into one fused submission per dtype (the
+    reference enqueues mixed groups the same way — same ready-event,
+    per-dtype fusion buffers) and reassembles results in input
+    order."""
+
+    def __init__(self, parts, index_lists, n):
+        self.parts = parts
+        self.index_lists = index_lists
+        self.n = n
+        self.kind = "numpy"
+        self.grouped = True
+        self.inplace_target = None
+        self.inplace_targets = None
+        self.returns_splits = False
+        self.extra = None
+
+    def done(self):
+        return all(h.done() for h in self.parts)
+
+    def wait(self, timeout=None):
+        import time as _time
+
+        deadline = None if timeout is None else \
+            _time.monotonic() + timeout
+        out = [None] * self.n
+        for h, idxs in zip(self.parts, self.index_lists):
+            remaining = None if deadline is None else \
+                max(deadline - _time.monotonic(), 1e-3)
+            res = h.wait(remaining)
+            if not isinstance(res, list):
+                res = [res]
+            for i, r in zip(idxs, res):
+                out[i] = r
+        return out
+
+
 def grouped_allreduce_async(tensors, average=None, name=None, op=None,
                             prescale_factor=1.0, postscale_factor=1.0,
                             process_set=global_process_set):
     """Grouped ops negotiate and execute as one unit (reference
-    EnqueueTensorAllreduces, operations.cc:1408; group_table.h)."""
+    EnqueueTensorAllreduces, operations.cc:1408; group_table.h).
+    Mixed-dtype groups partition into one fused submission per dtype
+    (deterministic dtype order, so all ranks partition identically)."""
     if not tensors:
         raise ValueError("grouped_allreduce requires at least one tensor")
     pairs = [util.to_numpy(t) for t in tensors]
     arrs = [p[0] for p in pairs]
     kinds = [p[1] for p in pairs]
-    dtypes = {normalize_dtype(a.dtype) for a in arrs}
-    if len(dtypes) > 1:
-        raise ValueError(
-            f"grouped_allreduce requires matching dtypes, got {dtypes}")
     ctx = basics.context()
+    base = name or ctx.next_name("grouped_allreduce")
+
+    by_dtype = {}
+    for i, a in enumerate(arrs):
+        by_dtype.setdefault(normalize_dtype(a.dtype), []).append(i)
+    if len(by_dtype) > 1:
+        # validate EVERY dtype subgroup before submitting ANY: a
+        # late-subgroup rejection must not orphan in-flight
+        # collectives from the earlier ones
+        for dt in sorted(by_dtype):
+            probe = arrs[by_dtype[dt][0]]
+            _resolve_op(op, average, probe.dtype)
+            _check_scale(probe.dtype, prescale_factor, postscale_factor)
+        parts, index_lists = [], []
+        for dt in sorted(by_dtype):
+            idxs = by_dtype[dt]
+            sub = _grouped_allreduce_uniform(
+                [arrs[i] for i in idxs], average, f"{base}.{dt}", op,
+                prescale_factor, postscale_factor, process_set, ctx)
+            parts.append(sub)
+            index_lists.append(idxs)
+        h = _MultiHandle(parts, index_lists, len(arrs))
+        h.kind = kinds
+        return h
+    h = _grouped_allreduce_uniform(arrs, average, base, op,
+                                   prescale_factor, postscale_factor,
+                                   process_set, ctx)
+    h.kind = kinds
+    return h
+
+
+def _grouped_allreduce_uniform(arrs, average, base, op, prescale_factor,
+                               postscale_factor, process_set, ctx):
     op = _resolve_op(op, average, arrs[0].dtype)
     _check_scale(arrs[0].dtype, prescale_factor, postscale_factor)
-    base = name or ctx.next_name("grouped_allreduce")
     names = [f"{base}.{i}" for i in range(len(arrs))]
     req = Request(
         request_type=RequestType.ALLREDUCE, tensor_name=base, rank=ctx.rank,
@@ -163,7 +231,6 @@ def grouped_allreduce_async(tensors, average=None, name=None, op=None,
         process_set_id=_ps_id(process_set), group_id=0,
         group_shapes=tuple(tuple(a.shape) for a in arrs))
     h = _submit(req, arrs, names)
-    h.kind = kinds
     h.grouped = True
     return h
 

@@ -196,7 +196,7 @@ class MeshExecutor:
                 y = lax.pmax(xb, "hvd")
             elif op == ReduceOp.PRODUCT:
                 g = lax.all_gather(xb, "hvd", axis=0, tiled=True)
-                y = jnp.prod(g, axis=0, keepdims=True)
+                y = jnp.prod(g, axis=0, keepdims=True, dtype=g.dtype)
             elif op == ReduceOp.ADASUM:
                 g = lax.all_gather(xb, "hvd", axis=0, tiled=True)
                 y = adasum_ops.adasum_reduce(g)[None]
@@ -217,7 +217,7 @@ class MeshExecutor:
             elif op == ReduceOp.MAX:
                 y = jnp.max(x, axis=0)
             elif op == ReduceOp.PRODUCT:
-                y = jnp.prod(x, axis=0)
+                y = jnp.prod(x, axis=0, dtype=x.dtype)
             elif op == ReduceOp.ADASUM:
                 y = adasum_ops.adasum_reduce(x)
             else:
@@ -321,7 +321,16 @@ class MeshExecutor:
         (R * max_seg * rest,): segment j of rank r lives at
         [j*max_seg*rest : j*max_seg*rest + splits[r][j]*rest].
         Returns (per-local-rank received buffers, per-local-rank
-        recv_splits)."""
+        recv_splits).
+
+        Skew note: XLA collectives are static-shaped, so every segment
+        pads to the GLOBAL max split — device buffers and wire traffic
+        scale with R*max(split) rather than the exact byte counts the
+        reference moves (mpi_operations.cc:441-530).  Balanced loads
+        (MoE capacity-factor routing, even shards) pad ~nothing; a
+        single pathological split inflates every rank's buffer, so
+        heavily ragged exchanges should re-bucket by size first (see
+        docs/benchmarks.md "collective skew")."""
         R = self.num_ranks
         dtype = rows[0].dtype
         rest = int(np.prod(rest_shape, dtype=np.int64)) if rest_shape else 1
@@ -440,7 +449,7 @@ class MeshExecutor:
                 elif op == ReduceOp.MAX:
                     y = jnp.max(tile, axis=0, keepdims=True)
                 elif op == ReduceOp.PRODUCT:
-                    y = jnp.prod(tile, axis=0, keepdims=True)
+                    y = jnp.prod(tile, axis=0, keepdims=True, dtype=tile.dtype)
                 else:
                     raise ValueError(f"unsupported reducescatter op {op}")
             if scaled:
@@ -459,7 +468,7 @@ class MeshExecutor:
             elif op == ReduceOp.MAX:
                 y = jnp.max(x, axis=0)
             elif op == ReduceOp.PRODUCT:
-                y = jnp.prod(x, axis=0)
+                y = jnp.prod(x, axis=0, dtype=x.dtype)
             else:
                 raise ValueError(f"unsupported reducescatter op {op}")
             if scaled:

@@ -25,6 +25,9 @@ def create_placement_group(resources_per_bundle, num_bundles,
     pg = ray.util.placement_group(bundles, strategy=pg_strategy)
     ready, _ = ray.wait([pg.ready()], timeout=pg_timeout)
     if not ready:
+        # remove the pending group or its reservation keeps queueing
+        # against the very resources a retry would need
+        ray.util.remove_placement_group(pg)
         raise TimeoutError(
             "Placement group creation timed out; cluster lacks "
             f"resources for {bundles} (available: "

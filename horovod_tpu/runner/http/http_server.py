@@ -204,7 +204,8 @@ class Coordinator:
             from ...core.autotune import ParameterManager
             self._tuned_params = types.SimpleNamespace(
                 fusion_threshold_bytes=fusion_threshold_bytes,
-                cycle_time_ms=cycle_time_ms)
+                cycle_time_ms=cycle_time_ms,
+                pack_mt_threshold_bytes=8 << 20)
             self._autotuner = ParameterManager(self._tuned_params,
                                                log_path=autotune_log)
         self._lock = threading.Condition()
@@ -223,6 +224,8 @@ class Coordinator:
         self._exhausted = {}    # ps_id -> set of procs fully joined
         self._join_seen = {}    # (ps, proc) -> set of seen join ids
         self._ready_seen = {}   # proc -> highest seen ready-report id
+        self._proc_sid = {}     # proc -> controller session id
+        self._session_base = {}  # proc -> log index its session starts at
         self._errors = {}       # key -> error string
         self._cache = OrderedDict()  # cache_id -> meta template (LRU)
         self._cache_by_key = {}      # key -> cache_id
@@ -248,6 +251,8 @@ class Coordinator:
             self._exhausted.clear()
             self._join_seen.clear()
             self._ready_seen.clear()
+            self._proc_sid.clear()
+            self._session_base.clear()
             self._errors.clear()
             self._cache.clear()
             self._cache_by_key.clear()
@@ -264,6 +269,37 @@ class Coordinator:
             return self._on_join(req)
         raise ValueError(f"unknown coordinator verb {verb}")
 
+    def _check_session(self, proc, sid):
+        """A fresh controller session (engine re-init against this
+        live coordinator) restarts its report counters; drop the
+        PER-PROCESS state of the previous session (locked by caller):
+
+        * rid/jid dedup — or the new session's reports would be
+          discarded as replays;
+        * join/exhaustion flags — or the new session's collectives
+          would complete without this process's contribution;
+        * response-log position — or the new session's cursor-0 poll
+          would replay the previous session's batches."""
+        if sid is None:
+            return
+        if self._proc_sid.get(proc) != sid:
+            self._proc_sid[proc] = sid
+            self._ready_seen.pop(proc, None)
+            for key in [k for k in self._join_seen if k[1] == proc]:
+                del self._join_seen[key]
+            self._exhausted.discard(proc) if hasattr(
+                self._exhausted, "discard") else None
+            for ps_key in list(self._proc_joined):
+                self._proc_joined[ps_key].discard(proc)
+            for ps_key in list(self._joined):
+                self._joined[ps_key] = {
+                    (p, r) for (p, r) in self._joined[ps_key]
+                    if p != proc} if isinstance(
+                        self._joined[ps_key], set) else                     self._joined[ps_key]
+            # new sessions start polling at the CURRENT log end
+            self._session_base[proc] = self._log_base + len(self._log)
+            self._cursors.pop(proc, None)
+
     def _on_ready(self, req):
         """Worker announces locally-ready entries.
         req: {proc: int, nlocal: int, entries: [meta...]}
@@ -275,6 +311,7 @@ class Coordinator:
         proc = req["proc"]
         uncached = []
         with self._lock:
+            self._check_session(proc, req.get("sid"))
             rid = req.get("rid")
             if rid is not None:
                 # ready is only idempotent while the entry is still
@@ -362,6 +399,7 @@ class Coordinator:
         ps = req.get("ps", 0)
         proc = req.get("proc", -1)
         with self._lock:
+            self._check_session(proc, req.get("sid"))
             jid = req.get("jid")
             if jid is not None:
                 # joins are not naturally idempotent (per-proc counting
@@ -504,6 +542,13 @@ class Coordinator:
                 # don't let a stale cursor poison the new round's GC
                 return {"stale": True, "round": self.round_id}
             if proc is not None:
+                # a re-sessioned controller polls from cursor 0; its
+                # session starts at the log position recorded when the
+                # new session was first seen — never replay the
+                # previous session's batches to it
+                base = self._session_base.get(proc)
+                if base is not None and cursor < base:
+                    cursor = base
                 # the client has consumed everything below its cursor
                 self._cursors[proc] = max(self._cursors.get(proc, 0),
                                           cursor)
@@ -525,7 +570,9 @@ class Coordinator:
                    "cursor": self._log_base + len(self._log)}
             if self._autotuner is not None:
                 out["tuned"] = {
-                    "cycle_time_ms": self._tuned_params.cycle_time_ms}
+                    "cycle_time_ms": self._tuned_params.cycle_time_ms,
+                    "pack_mt_threshold_bytes":
+                        self._tuned_params.pack_mt_threshold_bytes}
             return out
 
     def _gc_log(self):

@@ -131,7 +131,10 @@ class LightningEstimator(EstimatorParams):
             def val_fn(rank, size):
                 import torch
 
-                return [(torch.as_tensor(x_val), torch.as_tensor(y_val))]
+                # shard validation like training: the weighted
+                # lval_sum/cnt reduction reassembles the global loss
+                return [(torch.as_tensor(x_val[rank::size]),
+                         torch.as_tensor(y_val[rank::size]))]
 
         return self._fit(batches_fn, val_fn)
 

@@ -53,9 +53,11 @@ def test_parameter_manager_converges(tmp_path):
     for _ in range(5 * 2):
         pm.record_bytes(1 << 20)
     assert not pm.active               # converged after max_samples
-    fusion, cycle = pm.best_parameters()
+    fusion, cycle, pack_mt = pm.best_parameters()
     assert 1 << 20 <= fusion <= 1 << 28
     assert 0.5 <= cycle <= 32.0
+    assert 1 << 20 <= pack_mt <= 1 << 26
+    assert cfg.pack_mt_threshold_bytes == pack_mt   # applied
     pm.close()
     lines = log.read_text().strip().splitlines()
     assert lines[0].startswith("sample,")

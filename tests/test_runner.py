@@ -237,6 +237,8 @@ def test_coordinator_autotune():
     assert "tuned" in out
     cyc = out["tuned"]["cycle_time_ms"]
     assert 0.1 <= cyc <= 64.0
+    # the MT-pack threshold (third GP dimension) broadcasts too
+    assert 2**20 <= out["tuned"]["pack_mt_threshold_bytes"] <= 2**26
     # the live threshold tracks the tuned parameter set
     assert c.fusion_threshold == c._tuned_params.fusion_threshold_bytes
     assert 2**20 <= c.fusion_threshold <= 2**28

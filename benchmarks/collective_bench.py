@@ -59,6 +59,27 @@ def worker(sizes_mb, small_count, iters):
     total_mb = small_count * 64 / 1024 * iters
     out["fused_small_64k_MBps"] = round(total_mb / dt, 1)
     out["small_count"] = small_count
+
+    # the same small-tensor group through the COMPILED (in-graph)
+    # path: one cached XLA program per call, no negotiation —
+    # reference xla_mpi_ops.cc role (ops/compiled.py)
+    hvd.compiled_grouped_allreduce(small, op=hvd.Sum)   # compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        hvd.compiled_grouped_allreduce(small, op=hvd.Sum)
+    dt = time.perf_counter() - t0
+    out["compiled_small_64k_MBps"] = round(total_mb / dt, 1)
+
+    # and one large buffer through the compiled path
+    for mb in sizes_mb:
+        n = int(mb * (1 << 20) / 4)
+        x = np.ones(n, np.float32)
+        hvd.compiled_allreduce(x, op=hvd.Sum)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            hvd.compiled_allreduce(x, op=hvd.Sum)
+        dt = time.perf_counter() - t0
+        out[f"compiled_{mb}mb_MBps"] = round(mb * iters / dt, 1)
     return out
 
 

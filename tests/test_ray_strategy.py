@@ -203,3 +203,24 @@ def test_ray_executor_rejects_missing_spec(fake_ray):
 
     with pytest.raises(ValueError):
         RayExecutor()
+
+
+def test_ray_host_discovery(fake_ray):
+    """RayHostDiscovery (reference ray/elastic.py:25-70): alive nodes
+    contribute CPU//cpus_per_slot slots, GPU-capped when use_gpu."""
+    fake_ray.nodes = lambda: [
+        {"Alive": True, "NodeManagerAddress": "10.0.0.1",
+         "Resources": {"CPU": 8.0, "GPU": 2.0}},
+        {"Alive": True, "NodeManagerAddress": "10.0.0.2",
+         "Resources": {"CPU": 4.0}},
+        {"Alive": False, "NodeManagerAddress": "10.0.0.3",
+         "Resources": {"CPU": 16.0}},
+    ]
+    from horovod_tpu.ray import RayHostDiscovery
+
+    d = RayHostDiscovery(cpus_per_slot=2)
+    assert d.find_available_hosts_and_slots() == {
+        "10.0.0.1": 4, "10.0.0.2": 2}
+    dg = RayHostDiscovery(cpus_per_slot=2, use_gpu=True,
+                          gpus_per_slot=1)
+    assert dg.find_available_hosts_and_slots() == {"10.0.0.1": 2}

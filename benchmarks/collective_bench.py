@@ -62,11 +62,15 @@ def worker(sizes_mb, small_count, iters):
 
     # the same small-tensor group through the COMPILED (in-graph)
     # path: one cached XLA program per call, no negotiation —
-    # reference xla_mpi_ops.cc role (ops/compiled.py)
-    hvd.compiled_grouped_allreduce(small, op=hvd.Sum)   # compile
+    # reference xla_mpi_ops.cc role (ops/compiled.py).  force_program
+    # keeps the measurement honest at world size 1 (the production
+    # shortcut would otherwise reduce on the host).
+    red = hvd.CompiledGroupedAllreduce(op=hvd.Sum, name="bench",
+                                       force_program=True)
+    red(small)                                          # compile
     t0 = time.perf_counter()
     for _ in range(iters):
-        hvd.compiled_grouped_allreduce(small, op=hvd.Sum)
+        red(small)
     dt = time.perf_counter() - t0
     out["compiled_small_64k_MBps"] = round(total_mb / dt, 1)
 
@@ -74,10 +78,10 @@ def worker(sizes_mb, small_count, iters):
     for mb in sizes_mb:
         n = int(mb * (1 << 20) / 4)
         x = np.ones(n, np.float32)
-        hvd.compiled_allreduce(x, op=hvd.Sum)
+        red([x])
         t0 = time.perf_counter()
         for _ in range(iters):
-            hvd.compiled_allreduce(x, op=hvd.Sum)
+            red([x])
         dt = time.perf_counter() - t0
         out[f"compiled_{mb}mb_MBps"] = round(mb * iters / dt, 1)
     return out

@@ -213,7 +213,7 @@ class CompiledGroupedAllreduce:
 
     def __init__(self, op=Average, prescale_factor=1.0,
                  postscale_factor=1.0, process_set=global_process_set,
-                 name=None):
+                 name=None, force_program=False):
         op = ReduceOp(op)
         if op not in (Average, Sum):
             raise ValueError(
@@ -224,6 +224,9 @@ class CompiledGroupedAllreduce:
         self.postscale = float(postscale_factor)
         self.process_set = process_set
         self.name = name
+        # benchmarking/diagnostics: run the compiled program even at
+        # world size 1 instead of the host-copy shortcut
+        self.force_program = bool(force_program)
         self._programs = {}
         self._ex = None          # executor the cached programs target
         self._lock = threading.Lock()
@@ -348,7 +351,7 @@ class CompiledGroupedAllreduce:
         self._validate(arrays)
         eng, ps = _ps_state(self.process_set)
         ex = ps.executor
-        if ex.num_ranks == 1:
+        if ex.num_ranks == 1 and not self.force_program:
             scale = self.prescale * self.postscale
             if scale != 1.0:
                 return [(a.astype(np.float32) * scale).astype(a.dtype)
@@ -359,18 +362,36 @@ class CompiledGroupedAllreduce:
         plan = self._plan(arrays)
         prog = self._program(ex, sig, plan)
         n_local = len(ex.local_positions)
+        timeline = eng.timeline
 
-        def launch(slot_bufs):
-            # slot_bufs: {pos: [buf per dtype]} for the local ranks
-            staged = []
-            for k in range(len(plan)):
-                rows = [slot_bufs[pos][k] for pos in ex.local_positions]
-                staged.append(self._stage(ex, rows))
-            return prog(*staged)
+        def launch(slot_values):
+            # slot_values: {pos: (sig, [buf per dtype])} — the leader
+            # checks every local rank brought the SAME signature; a
+            # mismatch is a caller bug that must fail loudly on every
+            # rank, not hang or silently mis-reduce
+            sigs = {p: v[0] for p, v in slot_values.items()}
+            if len(set(sigs.values())) > 1:
+                raise ValueError(
+                    "compiled collective signature mismatch across "
+                    f"local ranks: {sigs} — every member rank must "
+                    "call with identical shapes/dtypes in the same "
+                    "order")
+            import contextlib
+
+            span = timeline.span(f"compiled.{self.name or 'reduce'}",
+                                 "COMPILED_ALLREDUCE") \
+                if timeline is not None else contextlib.nullcontext()
+            with span:
+                staged = []
+                for k in range(len(plan)):
+                    rows = [slot_values[pos][1][k]
+                            for pos in ex.local_positions]
+                    staged.append(self._stage(ex, rows))
+                return prog(*staged)
 
         my_bufs = self._pack(arrays, plan)
         if n_local == 1:
-            out = launch({ex.local_positions[0]: my_bufs})
+            out = launch({ex.local_positions[0]: (sig, my_bufs)})
         else:
             pos = _caller_pos(eng, ps)
             if pos is None:
@@ -380,7 +401,7 @@ class CompiledGroupedAllreduce:
             tag = ("reduce", int(self.op), self.prescale, self.postscale,
                    self.name)
             rdv = _rendezvous_for(ps, tag, n_local)
-            out = rdv.run(pos, my_bufs, launch)
+            out = rdv.run(pos, (sig, my_bufs), launch)
         return self._unpack(out, plan)
 
     @staticmethod

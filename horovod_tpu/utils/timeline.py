@@ -122,6 +122,25 @@ class Timeline:
         if self.mark_cycles:
             self._emit("CYCLE", "i", 0, self._ts())
 
+    def span(self, tensor_name, op_name):
+        """Self-contained B/E pair on the tensor's own lane — safe
+        from ANY thread (no shared open-op stack, no negotiate
+        pairing).  Used by the compiled (in-graph) path, which has no
+        negotiation phase."""
+        tid = self._tid(tensor_name)
+        self._emit(op_name, "B", tid, self._ts())
+        timeline = self
+
+        class _Span:
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                timeline._emit(op_name, "E", tid, timeline._ts())
+                return False
+
+        return _Span()
+
     # -- python fallback writer ----------------------------------------------
 
     def _writer_loop(self):

@@ -248,3 +248,17 @@ def test_compiled_train_step_has_aux(hvd_shutdown):
     expected = 0.1 * np.mean(range(NP))
     assert all(np.isclose(v, expected) for v in runnings), runnings
     assert all(r[1] == 1 for r in results)
+
+
+def test_compiled_allreduce_signature_mismatch_raises(hvd_shutdown):
+    """Mismatched shapes across rank threads fail loudly on every rank
+    (the engine path negotiates this; the compiled path checks at the
+    rendezvous) instead of hanging or silently mis-reducing."""
+    def fn():
+        n = 4 if hvd.rank() == 0 else 5
+        with pytest.raises((ValueError, RuntimeError)) as ei:
+            hvd.compiled_allreduce(np.ones(n, np.float32))
+        assert "signature mismatch" in str(ei.value)
+        return True
+
+    assert all(run_ranks(fn))

@@ -287,15 +287,18 @@ class Coordinator:
             self._ready_seen.pop(proc, None)
             for key in [k for k in self._join_seen if k[1] == proc]:
                 del self._join_seen[key]
-            self._exhausted.discard(proc) if hasattr(
-                self._exhausted, "discard") else None
+            # _exhausted: {ps -> set(procs)}; _proc_joined:
+            # {ps -> {proc -> count}}; _joined holds RANKS (rank->proc
+            # is not tracked), so when the restarting proc had join
+            # state on a set, void that set's partial join bookkeeping
+            # — a session restart without a round reset is a full-job
+            # restart (every proc re-sessions), so state converges
+            for ps_key in list(self._exhausted):
+                self._exhausted[ps_key].discard(proc)
             for ps_key in list(self._proc_joined):
-                self._proc_joined[ps_key].discard(proc)
-            for ps_key in list(self._joined):
-                self._joined[ps_key] = {
-                    (p, r) for (p, r) in self._joined[ps_key]
-                    if p != proc} if isinstance(
-                        self._joined[ps_key], set) else                     self._joined[ps_key]
+                if proc in self._proc_joined[ps_key]:
+                    del self._proc_joined[ps_key][proc]
+                    self._joined[ps_key] = set()
             # new sessions start polling at the CURRENT log end
             self._session_base[proc] = self._log_base + len(self._log)
             self._cursors.pop(proc, None)

@@ -9,10 +9,12 @@ without mxnet installed; the three mxnet-dependent entry points
 resolved lazily and raise a clear ImportError when mxnet (EOL
 upstream) is absent from the image.
 
-STATUS: experimental — mxnet is not installable in the CI image, so
-the mxnet-dependent wrappers are exercised only through their gating
-tests; the framework-neutral surface below them is the same tested
-engine every other frontend uses.
+STATUS: experimental — mxnet (EOL upstream) is not installable in
+the CI image; the wrappers are exercised against a faithful in-process
+stand-in (tests/test_mxnet_fake.py: DistributedOptimizer /
+DistributedTrainer / broadcast_parameters incl. the deferred-init
+hook, over the real engine), and the framework-neutral surface below
+them is the same tested engine every other frontend uses.
 """
 
 from ..common.basics import (  # noqa: F401

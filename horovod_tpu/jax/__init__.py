@@ -1,0 +1,129 @@
+"""JAX frontend — ``import horovod_tpu.jax as hvd``.
+
+The reference has no JAX binding (its newest framework is mxnet); on a
+TPU-native framework JAX is the FIRST-class citizen, so this frontend
+rounds out the binding matrix with the reference's API shape applied
+to jax/optax programs:
+
+* the full collective surface over jax arrays (the engine path — ops
+  stage through host buffers exactly like the torch/TF bindings);
+* ``DistributedOptimizer``: wraps any optax ``GradientTransformation``
+  so ``update()`` averages gradients across ranks first — the optax
+  formulation of ``horovod.torch.DistributedOptimizer`` /
+  ``horovod.tensorflow.DistributedGradientTape``;
+* ``broadcast_parameters``: root's pytree to every rank.
+
+Two gradient-reduction modes:
+
+* ``compiled=True`` (default): gradients reduce through ONE cached XLA
+  program per shape signature (``ops/compiled.py`` — the in-graph
+  path, no engine negotiation);
+* ``compiled=False``: the negotiated engine path (grouped_allreduce),
+  for data-dependent submission orders.
+
+For zero-host-hop training, jit the whole step instead:
+``hvd.make_compiled_train_step`` (re-exported here).
+"""
+
+import numpy as np
+
+from ..common.basics import (  # noqa: F401
+    init, shutdown, is_initialized,
+    rank, size, local_rank, local_size, cross_rank, cross_size,
+    is_homogeneous,
+)
+from ..common.process_sets import (  # noqa: F401
+    ProcessSet, add_process_set, remove_process_set, global_process_set,
+)
+from ..ops.api import (  # noqa: F401
+    allreduce, allreduce_async, grouped_allreduce,
+    grouped_allreduce_async, allgather, allgather_async, broadcast,
+    broadcast_async, alltoall, alltoall_async, reducescatter,
+    reducescatter_async, grouped_reducescatter, barrier, join,
+    synchronize, poll, broadcast_object, allgather_object,
+    Average, Sum, Adasum, Min, Max, Product,
+)
+from ..ops.compiled import (  # noqa: F401
+    compiled_allreduce, compiled_grouped_allreduce,
+    CompiledGroupedAllreduce, make_compiled_train_step,
+)
+from ..runner.thread_launcher import run  # noqa: F401
+
+__all__ = [
+    "DistributedOptimizer", "broadcast_parameters",
+    "make_compiled_train_step", "allreduce", "allgather", "broadcast",
+    "alltoall", "reducescatter", "run", "init", "shutdown", "rank",
+    "size",
+]
+
+
+def broadcast_parameters(params, root_rank=0, name="jax_bcast",
+                         process_set=global_process_set):
+    """Root's pytree of arrays to every rank (the torch binding's
+    ``broadcast_parameters`` for jax pytrees).  Returns the same
+    structure with every leaf replaced by root's value."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(params)
+    out = []
+    for i, leaf in enumerate(leaves):
+        out.append(broadcast(np.asarray(leaf), root_rank,
+                             name=f"{name}.{i}",
+                             process_set=process_set))
+    import jax.numpy as jnp
+
+    return jax.tree.unflatten(treedef, [jnp.asarray(o) for o in out])
+
+
+def DistributedOptimizer(optimizer, *, op=Average,
+                         prescale_factor=1.0, postscale_factor=1.0,
+                         compiled=True, name=None,
+                         process_set=global_process_set):
+    """Wrap an optax ``GradientTransformation`` so that ``update()``
+    first averages the gradient pytree across the process set's ranks
+    (reference ``DistributedOptimizer`` contract, expressed as an
+    optax transform).
+
+    The returned transform drops into any optax chain::
+
+        tx = hvd.DistributedOptimizer(optax.adamw(1e-3))
+        opt_state = tx.init(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+
+    The reduction runs on HOST boundaries (one hop per update) — for
+    collectives inside the jitted step use
+    ``hvd.make_compiled_train_step``.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    if compiled:
+        reducer = CompiledGroupedAllreduce(
+            op=op, prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor, process_set=process_set,
+            name=name or "jax_opt")
+    else:
+        reducer = None
+
+    def _reduce(grads):
+        leaves, treedef = jax.tree.flatten(grads)
+        arrs = [np.asarray(leaf) for leaf in leaves]
+        if reducer is not None:
+            outs = reducer(arrs)
+        else:
+            outs = grouped_allreduce(
+                arrs, op=op, prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+                name=name or "jax_opt", process_set=process_set)
+        return jax.tree.unflatten(
+            treedef, [jnp.asarray(o) for o in outs])
+
+    def init_fn(params):
+        return optimizer.init(params)
+
+    def update_fn(updates, state, params=None, **extra):
+        updates = _reduce(updates)
+        return optimizer.update(updates, state, params, **extra)
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -1,6 +1,7 @@
 """Data loading utilities (reference ``horovod/data/``)."""
 
 from .data_loader_base import BaseDataLoader, AsyncDataLoaderMixin  # noqa: F401
+from .device_feeder import DeviceFeeder  # noqa: F401
 from .service import (  # noqa: F401
     DataServiceConfig, DataServiceServer, data_service,
 )

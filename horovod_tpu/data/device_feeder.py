@@ -1,0 +1,82 @@
+"""Async device feeder: overlap host->device batch staging with the
+running step.
+
+TPU steps are dispatched asynchronously; the host's job each iteration
+is only to have the NEXT batch's device buffers ready.  The reference
+handles this with tf.data prefetching / the AsyncDataLoaderMixin
+(host-side only); this feeder goes one step further and performs the
+DEVICE placement on the background thread, so the training loop never
+blocks on a host->device copy:
+
+    step = hvd.make_compiled_train_step(loss_fn, tx, ...)
+    feeder = DeviceFeeder(step, my_batches())      # any iterable
+    state = step.init_state(params)
+    for staged in feeder:                          # StagedBatch items
+        state, loss = step(state, staged)
+
+``DeviceFeeder`` stages through ``step.place_batch`` (so batches land
+with the step's exact sharding) and keeps ``prefetch`` batches in
+flight.  One-rank-per-process deployments only (the thread-launcher
+path stages at the rendezvous instead — see ``place_batch``).
+"""
+
+import queue
+import threading
+
+__all__ = ["DeviceFeeder"]
+
+_SENTINEL = object()
+
+
+class DeviceFeeder:
+    """Iterates ``StagedBatch`` items staged ahead of the consumer."""
+
+    def __init__(self, step, batches, prefetch=2):
+        if prefetch < 1:
+            raise ValueError("prefetch must be >= 1")
+        self._step = step
+        self._src = iter(batches)
+        self._q = queue.Queue(maxsize=prefetch)
+        self._error = None
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._fill, name="hvd-device-feeder", daemon=True)
+        self._thread.start()
+
+    def _fill(self):
+        try:
+            for batch in self._src:
+                if self._closed:
+                    return
+                staged = self._step.place_batch(batch)
+                self._q.put(staged)
+        except BaseException as exc:  # surface on the consumer side
+            self._error = exc
+        finally:
+            self._q.put(_SENTINEL)
+
+    def __iter__(self):
+        while True:
+            item = self._q.get()
+            if item is _SENTINEL:
+                if self._error is not None:
+                    raise self._error
+                return
+            yield item
+
+    def close(self):
+        """Stop the feeder early (drains nothing; the thread exits at
+        its next put)."""
+        self._closed = True
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

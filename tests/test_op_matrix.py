@@ -471,3 +471,87 @@ def test_compiled_allreduce_matrix(live_engine, op_name, dtype):
     for out, _ in results:
         assert np.allclose(out, expected, atol=_tol(dtype)), \
             (op_name, dtype)
+
+
+# ---------------------------------------------------------------------------
+# in-place variants: dtype sweep (numpy targets are mutable)
+
+@pytest.mark.parametrize("dtype", ALL_DTYPES)
+def test_allreduce_inplace_matrix(live_engine, dtype):
+    def fn():
+        r = hvd.rank()
+        x = _make(dtype, scale=r + 1)
+        ref = [_make(dtype, scale=i + 1) for i in range(NP)]
+        out = hvd.allreduce_(x, op=hvd.Sum, name=f"m.ip.{dtype}")
+        assert out is x        # wrote back into the caller's buffer
+        expected = _expected_reduce(
+            "sum", [v.astype(np.int64) if not _is_float(dtype)
+                    else v for v in ref]).astype(_dt(dtype))
+        assert np.allclose(np.asarray(x, np.float64),
+                           np.asarray(expected, np.float64),
+                           atol=_tol(dtype))
+        return True
+
+    assert all(run_ranks(fn))
+
+
+# ---------------------------------------------------------------------------
+# async handles: submit-many then synchronize, per dtype
+
+@pytest.mark.parametrize("dtype", ["float32", "float64", "int32",
+                                   "bfloat16"])
+def test_async_handles_matrix(live_engine, dtype):
+    def fn():
+        r = hvd.rank()
+        if dtype == "bfloat16" and BF16 is None:
+            return True
+        handles = [
+            hvd.allreduce_async(
+                (np.ones(4) * (r + 1) * (k + 1)).astype(_dt(dtype)),
+                op=hvd.Sum, name=f"m.async.{dtype}.{k}")
+            for k in range(4)
+        ]
+        for k, h in enumerate(handles):
+            out = hvd.synchronize(h)
+            expected = (k + 1) * sum(range(1, NP + 1))
+            assert np.allclose(np.asarray(out, np.float64), expected)
+        return True
+
+    assert all(run_ranks(fn))
+
+
+# ---------------------------------------------------------------------------
+# grouped allgather / reducescatter dtype cells
+
+@pytest.mark.parametrize("dtype", ["float32", "int64"])
+def test_grouped_allgather_matrix(live_engine, dtype):
+    def fn():
+        r = hvd.rank()
+        xs = [np.full((r + 1, 2), r).astype(_dt(dtype)),
+              np.full((1, 3), r + 10).astype(_dt(dtype))]
+        outs = hvd.grouped_allgather(xs, name=f"m.gag.{dtype}")
+        assert outs[0].shape == (sum(range(1, NP + 1)), 2)
+        assert outs[1].shape == (NP, 3)
+        assert np.allclose(np.asarray(outs[1], np.float64)[:, 0],
+                           np.arange(10, 10 + NP))
+        return True
+
+    assert all(run_ranks(fn))
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float64"])
+def test_grouped_reducescatter_matrix(live_engine, dtype):
+    def fn():
+        r = hvd.rank()
+        xs = [np.ones((NP * 2, 2)).astype(_dt(dtype)) * (r + 1),
+              np.ones((NP, 3)).astype(_dt(dtype)) * (r + 1)]
+        outs = hvd.grouped_reducescatter(
+            xs, op=hvd.Sum, name=f"m.grs.{dtype}")
+        total = sum(range(1, NP + 1))
+        assert outs[0].shape == (2, 2)
+        assert outs[1].shape == (1, 3)
+        assert np.allclose(np.asarray(outs[0], np.float64), total)
+        assert np.allclose(np.asarray(outs[1], np.float64), total)
+        return True
+
+    assert all(run_ranks(fn))

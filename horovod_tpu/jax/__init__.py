@@ -49,6 +49,11 @@ from ..ops.compiled import (  # noqa: F401
 )
 from ..runner.thread_launcher import run  # noqa: F401
 
+import threading as _threading
+
+_OPT_COUNTS = {}
+_OPT_LOCK = _threading.Lock()
+
 __all__ = [
     "DistributedOptimizer", "broadcast_parameters",
     "make_compiled_train_step", "allreduce", "allgather", "broadcast",
@@ -63,16 +68,19 @@ def broadcast_parameters(params, root_rank=0, name="jax_bcast",
     ``broadcast_parameters`` for jax pytrees).  Returns the same
     structure with every leaf replaced by root's value."""
     import jax
-
-    leaves, treedef = jax.tree.flatten(params)
-    out = []
-    for i, leaf in enumerate(leaves):
-        out.append(broadcast(np.asarray(leaf), root_rank,
-                             name=f"{name}.{i}",
-                             process_set=process_set))
     import jax.numpy as jnp
 
-    return jax.tree.unflatten(treedef, [jnp.asarray(o) for o in out])
+    leaves, treedef = jax.tree.flatten(params)
+    # pipeline: submit every broadcast, then synchronize once each —
+    # the torch binding's pattern (torch/functions.py), N round-trips
+    # collapse into one negotiated cycle
+    handles = [
+        broadcast_async(np.asarray(leaf), root_rank,
+                        name=f"{name}.{i}", process_set=process_set)
+        for i, leaf in enumerate(leaves)
+    ]
+    return jax.tree.unflatten(
+        treedef, [jnp.asarray(synchronize(h)) for h in handles])
 
 
 def DistributedOptimizer(optimizer, *, op=Average,
@@ -102,11 +110,34 @@ def DistributedOptimizer(optimizer, *, op=Average,
         reducer = CompiledGroupedAllreduce(
             op=op, prescale_factor=prescale_factor,
             postscale_factor=postscale_factor, process_set=process_set,
-            name=name or "jax_opt")
+            name=name)
     else:
         reducer = None
+    resolved = {"name": name}
+
+    def _resolved_name():
+        # default names must be UNIQUE per wrapper but IDENTICAL
+        # across ranks (they key the thread-mode rendezvous): assign
+        # by per-rank creation order at first use, like the compiled
+        # train step's _step_tag — two default-named optimizers get
+        # jax_opt.0 / jax_opt.1 on every rank
+        if resolved["name"] is None:
+            from ..common import basics as _basics
+
+            try:
+                r = _basics.context().rank
+            except Exception:  # noqa: BLE001 — unbound driver mode
+                r = -1
+            with _OPT_LOCK:
+                idx = _OPT_COUNTS.get(r, 0)
+                _OPT_COUNTS[r] = idx + 1
+            resolved["name"] = f"jax_opt.{idx}"
+            if reducer is not None:
+                reducer.name = resolved["name"]
+        return resolved["name"]
 
     def _reduce(grads):
+        opname = _resolved_name()
         leaves, treedef = jax.tree.flatten(grads)
         arrs = [np.asarray(leaf) for leaf in leaves]
         if reducer is not None:
@@ -115,7 +146,7 @@ def DistributedOptimizer(optimizer, *, op=Average,
             outs = grouped_allreduce(
                 arrs, op=op, prescale_factor=prescale_factor,
                 postscale_factor=postscale_factor,
-                name=name or "jax_opt", process_set=process_set)
+                name=opname, process_set=process_set)
         return jax.tree.unflatten(
             treedef, [jnp.asarray(o) for o in outs])
 

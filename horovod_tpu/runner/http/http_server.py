@@ -219,7 +219,7 @@ class Coordinator:
         self._log = []
         self._log_base = 0
         self._cursors = {}      # proc_id -> highest absolute cursor seen
-        self._joined = {}       # ps_id -> set of ranks that joined
+        self._joined = {}       # ps_id -> set of (proc, rank) joined
         self._proc_joined = {}  # ps_id -> {proc -> join count}
         self._exhausted = {}    # ps_id -> set of procs fully joined
         self._join_seen = {}    # (ps, proc) -> set of seen join ids
@@ -287,18 +287,17 @@ class Coordinator:
             self._ready_seen.pop(proc, None)
             for key in [k for k in self._join_seen if k[1] == proc]:
                 del self._join_seen[key]
-            # _exhausted: {ps -> set(procs)}; _proc_joined:
-            # {ps -> {proc -> count}}; _joined holds RANKS (rank->proc
-            # is not tracked), so when the restarting proc had join
-            # state on a set, void that set's partial join bookkeeping
-            # — a session restart without a round reset is a full-job
-            # restart (every proc re-sessions), so state converges
+            # drop exactly THIS proc's join/exhaustion state
+            # (_joined tracks (proc, rank) pairs so other procs'
+            # fresh-session joins survive the cleanup)
             for ps_key in list(self._exhausted):
                 self._exhausted[ps_key].discard(proc)
             for ps_key in list(self._proc_joined):
-                if proc in self._proc_joined[ps_key]:
-                    del self._proc_joined[ps_key][proc]
-                    self._joined[ps_key] = set()
+                self._proc_joined[ps_key].pop(proc, None)
+            for ps_key in list(self._joined):
+                self._joined[ps_key] = {
+                    (p, rk) for (p, rk) in self._joined[ps_key]
+                    if p != proc}
             # new sessions start polling at the CURRENT log end
             self._session_base[proc] = self._log_base + len(self._log)
             self._cursors.pop(proc, None)
@@ -413,7 +412,7 @@ class Coordinator:
                     return {}
                 seen.add(jid)
             j = self._joined.setdefault(ps, set())
-            j.add(req["rank"])
+            j.add((proc, req["rank"]))
             pj = self._proc_joined.setdefault(ps, {})
             pj[proc] = pj.get(proc, 0) + 1
             if pj[proc] >= req.get("proc_members", 1):

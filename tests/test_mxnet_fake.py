@@ -158,7 +158,14 @@ def fake_mx(monkeypatch):
     for name in [n for n in sys.modules
                  if n.startswith("horovod_tpu.mxnet")]:
         monkeypatch.delitem(sys.modules, name, raising=False)
-    return mx
+    yield mx
+    # modules IMPORTED DURING the test (e.g. horovod_tpu.mxnet._impl
+    # bound to the fake) were absent at setup, so monkeypatch has no
+    # undo for them — drop them or the gated-ImportError contract
+    # breaks for later tests
+    for name in [n for n in sys.modules
+                 if n.startswith("horovod_tpu.mxnet")]:
+        del sys.modules[name]
 
 
 def run_ranks(fn):

@@ -900,3 +900,25 @@ def test_coordinator_session_restart_clean():
     flat = " ".join(str(k) for k in keys) + str(out["responses"])
     assert "t1" in flat, out["responses"]
     assert "t0" not in flat, out["responses"]
+
+
+def test_coordinator_session_restart_preserves_peer_joins():
+    """Full-job restart with stale join state: one proc's re-session
+    cleanup must drop only ITS OWN stale joins — peers' fresh-session
+    joins survive, and the join barrier still completes."""
+    c = Coordinator(world_size=2, fusion_threshold_bytes=1 << 20)
+    join = lambda proc, rank, sid, jid: c.handle(
+        "join", {"ps": 0, "rank": rank, "ps_size": 4, "proc": proc,
+                 "proc_members": 2, "jid": jid, "sid": sid})
+    # session A: proc1 had joined rank 2 before the job died
+    join(1, 2, "A1", 1)
+    # restart: proc0 comes up first and joins both its ranks
+    join(0, 0, "B0", 1)
+    join(0, 1, "B0", 2)
+    # proc1's first new-session join triggers ITS stale-state cleanup;
+    # proc0's fresh joins must survive it
+    join(1, 2, "B1", 1)
+    join(1, 3, "B1", 2)
+    out = c.handle("poll", {"cursor": 0, "proc": 0, "wait": 0})
+    kinds = [r.get("kind") for r in out["responses"]]
+    assert kinds.count("join_done") == 1, out["responses"]

@@ -46,7 +46,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..common import basics
 from ..common.process_sets import ProcessSet, global_process_set
-from ..core.message import Average, ReduceOp, Sum
+from ..core.message import Adasum, Average, ReduceOp, Sum
+from . import adasum as adasum_ops
 from .xla_ops import shard_map, _is_float
 
 __all__ = [
@@ -469,8 +470,8 @@ class _CompiledTrainStep:
     def __init__(self, loss_fn, optimizer, op, process_set, donate,
                  has_aux=False):
         op = ReduceOp(op)
-        if op not in (Average, Sum):
-            raise ValueError("op must be Average or Sum")
+        if op not in (Average, Sum, Adasum):
+            raise ValueError("op must be Average, Sum, or Adasum")
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.op = op
@@ -511,17 +512,23 @@ class _CompiledTrainStep:
                 state["aux"] = aux
             return state
 
+        def reduce_leaf_sharded(g):
+            if op == Average:
+                return lax.pmean(g, "hvd")
+            if op == Sum:
+                return lax.psum(g, "hvd")
+            # Adasum (reference DistributedOptimizer op=Adasum,
+            # adasum.h:38): gather per-rank grads, projection-weighted
+            # pairwise combine — still inside the one program
+            return adasum_ops.adasum_reduce(
+                lax.all_gather(g, "hvd"))
+
         if ex.shard_mode:
             def body(state, batch_rows):
                 batch = jax.tree.map(lambda x: x[0], batch_rows)
                 loss, new_aux, grads = grad_call(
                     state["params"], state.get("aux"), batch)
-                if op == Average:
-                    grads = jax.tree.map(
-                        lambda g: lax.pmean(g, "hvd"), grads)
-                else:
-                    grads = jax.tree.map(
-                        lambda g: lax.psum(g, "hvd"), grads)
+                grads = jax.tree.map(reduce_leaf_sharded, grads)
                 loss = lax.pmean(loss, "hvd")
                 if has_aux:
                     # cross-replica averaged aux (float leaves): the
@@ -551,8 +558,11 @@ class _CompiledTrainStep:
                 if op == Average:
                     grads = jax.tree.map(lambda g: jnp.mean(g, axis=0),
                                          grads)
-                else:
+                elif op == Sum:
                     grads = jax.tree.map(lambda g: jnp.sum(g, axis=0),
+                                         grads)
+                else:       # Adasum over the stacked rank axis
+                    grads = jax.tree.map(adasum_ops.adasum_reduce,
                                          grads)
                 loss = jnp.mean(losses)
                 if has_aux:

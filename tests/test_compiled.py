@@ -351,3 +351,31 @@ def test_compiled_step_state_checkpoints(hvd_shutdown, tmp_path):
         restored, _ = step(restored, b)
     for a, c in zip(jax.tree.leaves(ref), jax.tree.leaves(restored)):
         assert np.allclose(np.asarray(a), np.asarray(c), atol=1e-6)
+
+
+def test_compiled_train_step_adasum(hvd_shutdown):
+    """op=Adasum inside the one-program step matches the engine's
+    Adasum allreduce of the same per-rank gradients."""
+    import jax
+    import jax.numpy as jnp
+
+    def loss_fn(params, batch):
+        return jnp.sum(params["w"] * batch)
+
+    def fn():
+        r = hvd.rank()
+        batch = (np.arange(1, 5, dtype=np.float32)) * (r + 1)
+        # engine reference: adasum-allreduce the analytic grad (=batch)
+        ref = hvd.allreduce(batch.copy(), op=hvd.Adasum,
+                            name="ada_ref")
+        step = hvd.make_compiled_train_step(
+            loss_fn, optax.sgd(1.0), op=hvd.Adasum)
+        state = step.init_state({"w": np.zeros(4, np.float32)})
+        state, _ = step(state, batch)
+        # w = -combined_grad with lr 1.0
+        got = -np.asarray(state["params"]["w"])
+        assert np.allclose(got, np.asarray(ref), atol=1e-5), \
+            (got, np.asarray(ref))
+        return True
+
+    assert all(run_ranks(fn))

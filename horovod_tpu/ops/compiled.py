@@ -724,10 +724,13 @@ def make_compiled_train_step(loss_fn, optimizer, *, op=Average,
     (scalar, new_aux)`` threads mutable model state such as BN
     batch_stats; float aux leaves are cross-replica averaged — the
     sync-BN convention).  ``optimizer`` is an optax transform.
+    ``op`` picks the gradient reduction: ``Average`` (``lax.pmean``),
+    ``Sum`` (``lax.psum``), or ``Adasum`` (all_gather +
+    projection-weighted pairwise combine, reference adasum.h:38).
     Returns a callable
     ``step(state, batch) -> (state, loss)`` where forward, backward,
-    cross-rank gradient reduction (``lax.pmean`` over the process
-    set's mesh axis) and the optimizer update run as ONE XLA program —
+    cross-rank gradient reduction over the process
+    set's mesh axis and the optimizer update run as ONE XLA program —
     zero host syncs beyond fetching ``loss``; XLA overlaps the
     collectives with backward compute (the scheduling the reference
     approximates with SCHEDULE_EARLIEST/LATEST CustomCall hints).

@@ -309,6 +309,20 @@ FULL_MATRIX_WORKER = textwrap.dedent("""
     assert np.allclose(outs[0], sum(range(s)))
     assert np.allclose(outs[1], float(s))
 
+    # MIXED-dtype grouped allreduce: partitions into per-dtype fused
+    # submissions behind one composite handle — both negotiate through
+    # the coordinator in deterministic dtype order
+    mouts = hvd.grouped_allreduce(
+        [np.full(3, float(r + 1), np.float32),
+         np.arange(4, dtype=np.int32) * (r + 1),
+         np.full(2, float(r), np.float16)],
+        op=hvd.Sum, name="gmix")
+    tri = sum(range(1, s + 1))
+    assert np.allclose(mouts[0], tri)
+    assert np.array_equal(mouts[1], np.arange(4) * tri)
+    assert np.allclose(mouts[2], sum(range(s)))
+    assert mouts[1].dtype == np.int32, mouts[1].dtype
+
     # grouped reducescatter: one negotiated unit across processes
     gouts = hvd.grouped_reducescatter(
         [np.ones((s, 3), np.float32) * (r + 1),

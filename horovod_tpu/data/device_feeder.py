@@ -43,17 +43,30 @@ class DeviceFeeder:
             target=self._fill, name="hvd-device-feeder", daemon=True)
         self._thread.start()
 
+    def _put(self, item):
+        """Put that gives up once the feeder is closed (a plain blocking
+        put can deadlock: close() drains the queue, the blocked put then
+        refills it, and nobody ever consumes the slot again)."""
+        while not self._closed:
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def _fill(self):
         try:
             for batch in self._src:
                 if self._closed:
                     return
                 staged = self._step.place_batch(batch)
-                self._q.put(staged)
+                if not self._put(staged):
+                    return
         except BaseException as exc:  # surface on the consumer side
             self._error = exc
         finally:
-            self._q.put(_SENTINEL)
+            self._put(_SENTINEL)
 
     def __iter__(self):
         while True:
@@ -65,13 +78,26 @@ class DeviceFeeder:
             yield item
 
     def close(self):
-        """Stop the feeder early (drains nothing; the thread exits at
-        its next put)."""
+        """Stop the feeder early and join the staging thread."""
         self._closed = True
+        # Unblock any in-flight put so the thread can observe _closed.
         try:
             while True:
                 self._q.get_nowait()
         except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)
+        # Discard whatever the thread pushed while winding down, then
+        # re-post the sentinel so a consumer blocked in (or re-entering)
+        # __iter__ gets a clean StopIteration instead of hanging.
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        try:
+            self._q.put_nowait(_SENTINEL)
+        except queue.Full:
             pass
 
     def __enter__(self):

@@ -164,8 +164,12 @@ class _MultiHandle:
             _time.monotonic() + timeout
         out = [None] * self.n
         for h, idxs in zip(self.parts, self.index_lists):
-            remaining = None if deadline is None else \
-                max(deadline - _time.monotonic(), 1e-3)
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0 and not h.done():
+                    raise TimeoutError(
+                        "grouped collective did not complete in time")
             res = h.wait(remaining)
             if not isinstance(res, list):
                 res = [res]

@@ -55,6 +55,17 @@ class HorovodWorker:
         return fn(*a, **kw)
 
 
+def _probe_coordinator_address():
+    """Runs INSIDE the rank-0 actor: the jax.distributed coordination
+    service binds on rank 0's host, so rank 0 probes a free port there
+    and reports its own reachable IP (a port probed on the driver could
+    be taken — or unroutable — on the worker node; same fix as
+    ``spark/runner.py:_spark_task_body``)."""
+    from ..runner.http.http_server import free_port, local_ip
+
+    return f"{local_ip()}:{free_port()}"
+
+
 class RayExecutor:
     """Launch a horovod_tpu job on Ray actors (reference
     ray/runner.py:168-420): worker placement goes through the
@@ -135,9 +146,6 @@ class RayExecutor:
             **autotune_kwargs(at_env))
         port = self._server.start()
         addr = local_ip()
-        import socket as _socket
-        s = _socket.socket(); s.bind(("", 0))
-        coordinator = f"{addr}:{s.getsockname()[1]}"; s.close()
 
         self.strategy = self._make_strategy()
         base_env = dict(extra_env_vars or {})
@@ -148,15 +156,39 @@ class RayExecutor:
             "HOROVOD_SECRET_KEY": secret_hex,
             "HOROVOD_TPU_NUM_PROCS": str(self.num_workers),
             "HOROVOD_TPU_RANKS_PER_PROC": "1",
-            "HOROVOD_TPU_COORDINATOR": coordinator,
         })
         self._workers, self._node_workers =             self.strategy.create_workers(HorovodWorker, base_env)
+        import ray
+
+        # The coordination service binds on RANK 0's host — probe the
+        # port and learn the reachable address in that actor, not on
+        # the driver (which may be a different machine entirely).
+        coordinator = ray.get(
+            self._workers[0].execute.remote(_probe_coordinator_address))
+        # Host topology from the actors' actual node placement.  Rank
+        # order must GROUP by host (the engine's two-level mesh rejects
+        # interleaved layouts, parallel/mesh.py): PACK placement can
+        # land actors interleaved across nodes, so reorder the worker
+        # list host-grouped (stable within a host) before stamping
+        # ranks, instead of merely recording the interleaving.
+        node_ids = ray.get([w.node_id.remote() for w in self._workers])
+        host_index = {}
+        for nid in node_ids:
+            host_index.setdefault(nid, len(host_index))
+        order = sorted(range(len(self._workers)),
+                       key=lambda i: (host_index[node_ids[i]], i))
+        self._workers = [self._workers[i] for i in order]
+        host_of_rank = ",".join(
+            str(host_index[node_ids[i]]) for i in order)
         # per-rank identity rides a post-placement env update (the
         # reference does the same for CUDA_VISIBLE_DEVICES fan-out)
-        import ray
         ray.get([
-            w.update_env_vars.remote({"HOROVOD_TPU_PROC_INDEX": i,
-                                      "HOROVOD_RANK": i})
+            w.update_env_vars.remote({
+                "HOROVOD_TPU_PROC_INDEX": i,
+                "HOROVOD_RANK": i,
+                "HOROVOD_TPU_COORDINATOR": coordinator,
+                "HOROVOD_TPU_HOST_OF_RANK": host_of_rank,
+            })
             for i, w in enumerate(self._workers)])
 
     def run(self, fn, args=None, kwargs=None):

@@ -34,12 +34,7 @@ ROUND_KEY = "/elastic/round"
 NOTIFY_KEY = "/elastic/notify"
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+from ..http.http_server import free_port as _free_port
 
 
 class ElasticDriver:

@@ -646,6 +646,17 @@ class RendezvousServer:
             self._httpd = None
 
 
+def free_port():
+    """Probe an OS-assigned free TCP port on THIS host (shared by every
+    launcher; probe where the service will bind, never on the driver
+    for a worker-hosted service)."""
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
 def local_ip():
     """Best-effort routable local address (reference
     driver_service NIC probing, simplified)."""

@@ -22,15 +22,9 @@ import time
 from typing import List, Optional
 
 from .hosts import SlotInfo, get_host_assignments, parse_hosts
-from .http.http_server import RendezvousServer, autotune_kwargs, local_ip
-
-
-def _free_port():
-    s = socket.socket()
-    s.bind(("", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+from .http.http_server import (
+    RendezvousServer, autotune_kwargs, free_port as _free_port, local_ip,
+)
 
 
 _LOCAL_HOSTNAMES = ("localhost", "127.0.0.1")

@@ -83,6 +83,7 @@ def _spark_task_body(index, addr, port, secret_hex, fn, args=(),
     own reachable address through the KV store — a port probed on the
     driver could be taken on the executor host."""
     from ..runner.http.http_client import StoreClient
+    from ..runner.http.http_server import free_port as _find_free_port
     from ..runner.http.http_server import local_ip
 
     kwargs = kwargs or {}
@@ -195,9 +196,3 @@ def run(fn, args=(), kwargs=None, num_proc=None, start_timeout=120,
         server.stop()
 
 
-def _find_free_port():
-    s = socket.socket()
-    s.bind(("", 0))
-    p = s.getsockname()[1]
-    s.close()
-    return p

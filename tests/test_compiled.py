@@ -315,6 +315,37 @@ def test_device_feeder_surfaces_source_errors(hvd_shutdown):
     assert len(got) == 1
 
 
+def test_device_feeder_close_joins_thread(hvd_shutdown):
+    """close() must not deadlock the staging thread: with prefetch=1
+    and an unconsumed queue, the blocked put used to refill the slot
+    close() had just drained and then hang on the sentinel put forever
+    (round-3 advisor finding)."""
+
+    from horovod_tpu.data import DeviceFeeder
+
+    class FakeStep:
+        def place_batch(self, batch):
+            return batch
+
+    def endless():
+        i = 0
+        while True:
+            yield i
+            i += 1
+
+    feeder = DeviceFeeder(FakeStep(), endless(), prefetch=1)
+    it = iter(feeder)
+    next(it)                      # thread is now blocked on a full queue
+    feeder.close()
+    assert not feeder._thread.is_alive()
+    # a consumer resuming after close() sees clean exhaustion, not a
+    # permanently-blocked get()
+    with pytest.raises(StopIteration):
+        next(it)
+    # idempotent: a second close is harmless
+    feeder.close()
+
+
 def test_compiled_step_state_checkpoints(hvd_shutdown, tmp_path):
     """Compiled-step train state round-trips through the sharded
     CheckpointManager: save mid-training, restore, resume — resumed

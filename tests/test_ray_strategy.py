@@ -181,9 +181,39 @@ def test_ray_executor_uses_colocated_strategy(fake_ray):
     assert len(ex._workers) == 4
     envs = [a.instance.env_vars() for a in fake_ray.spawned]
     assert all("HOROVOD_GLOO_RENDEZVOUS_PORT" in e for e in envs)
+    # the coordinator address is probed IN the rank-0 actor (round-3
+    # advisor: a driver-probed port may be taken/unroutable on the
+    # worker node) and fanned out with the host topology
+    env = envs[0]
+    host, port = env["HOROVOD_TPU_COORDINATOR"].rsplit(":", 1)
+    assert int(port) > 0 and host
+    # fake actors share one host -> every rank maps to host index 0
+    assert env["HOROVOD_TPU_HOST_OF_RANK"] == "0,0,0,0"
     # per-rank identity stamped post-placement
     out = ex.run(lambda: 42)
     assert out == [42, 42, 42, 42]
+    ex.shutdown()
+
+
+def test_ray_executor_groups_ranks_by_host(fake_ray, monkeypatch):
+    """PACK placement can interleave actors across nodes; rank order
+    must regroup by host (the two-level mesh rejects interleaved
+    HOROVOD_TPU_HOST_OF_RANK layouts)."""
+    from horovod_tpu.ray import HorovodWorker, RayExecutor
+
+    nodes = iter(["nodeA", "nodeB", "nodeA", "nodeB"])
+    monkeypatch.setattr(
+        HorovodWorker, "node_id",
+        lambda self, _n=nodes: setattr(self, "_nid",
+                                       getattr(self, "_nid", next(_n)))
+        or self._nid)
+    ex = RayExecutor(num_workers=4)
+    ex.start()
+    # spawn order 0,1,2,3 on nodes A,B,A,B -> rank order regrouped to
+    # [0,2,1,3] and the topology string is host-grouped
+    assert [a.instance.world_rank for a in ex._workers] == [0, 2, 1, 3]
+    import os
+    assert os.environ["HOROVOD_TPU_HOST_OF_RANK"] == "0,0,1,1"
     ex.shutdown()
 
 

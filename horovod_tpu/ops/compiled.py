@@ -157,6 +157,9 @@ def _caller_pos(eng, ps):
 _RDV_REGISTRY = {}
 _RDV_LOCK = threading.Lock()
 _STEP_COUNTERS = {}
+# per-(ps, tag) count of distinct signatures already validated across
+# processes — the Nth new signature on every process must match
+_SIG_COUNTERS = {}
 # shared compiled-program cache: whichever rank leads a round reuses
 # the program any previous leader built (one compile per process)
 _PROGRAM_CACHE = {}
@@ -199,6 +202,61 @@ def _rendezvous_for(ps, tag, n):
         return rdv
 
 
+def _validate_signature_cross_process(eng, ps, tag, sig):
+    """First-call fingerprint exchange over the coordinator KV.
+
+    The compiled path has no negotiation: across PROCESSES a
+    mismatched signature would silently mis-reduce or hang (the
+    reference XLA path, ``xla_mpi_ops.cc:185-307``, shares that
+    contract and cannot do better — it has no side channel; this build
+    has the launcher's KV store).  On the first call for each new
+    (process set, collective, signature) every process publishes a
+    fingerprint and verifies all peers match before anything compiles;
+    callers cache the verdict so steady state never touches the KV.
+
+    Sequenced by a per-(ps, tag) counter: process A's Nth new
+    signature is compared against process B's Nth — the
+    deterministic-order contract this path already carries.
+    """
+    ctl = getattr(eng, "controller", None)
+    if ctl is None or ctl.num_procs <= 1:
+        return
+    import hashlib
+    import json
+    import time
+
+    from ..common import env as env_mod
+
+    taghash = hashlib.md5(repr(tag).encode()).hexdigest()[:12]
+    with _RDV_LOCK:
+        seq = _SIG_COUNTERS.get((ps.id, taghash), 0)
+        _SIG_COUNTERS[(ps.id, taghash)] = seq + 1
+    fp = json.dumps(sig, sort_keys=True)
+    base = (f"compiled_sig/{ctl.round_id}/{ps.id}/{taghash}/{seq}")
+    ctl.client.put(f"{base}/{ctl.proc_id}", fp.encode())
+    timeout = env_mod.get_int("HOROVOD_COMPILED_SIG_TIMEOUT", 120)
+    deadline = time.monotonic() + timeout
+    for p in range(ctl.num_procs):
+        if p == ctl.proc_id:
+            continue
+        raw = ctl.client.get(
+            f"{base}/{p}", wait=max(deadline - time.monotonic(), 0.1))
+        if raw is None:
+            raise RuntimeError(
+                f"compiled collective signature exchange timed out "
+                f"waiting for process {p} (tag={tag}, seq={seq}): a "
+                "peer never entered this collective — every member "
+                "process must issue compiled collectives in the same "
+                "order")
+        if raw.decode() != fp:
+            raise ValueError(
+                "compiled collective signature mismatch across "
+                f"processes (tag={tag}, call #{seq}): this process "
+                f"has {fp} but process {p} has {raw.decode()} — "
+                "every member rank must call with identical "
+                "shapes/dtypes in the same order")
+
+
 class CompiledGroupedAllreduce:
     """Grouped allreduce as ONE compiled XLA program per shape
     signature (reference ``xla_mpi_ops.cc:185-307`` role).
@@ -229,6 +287,7 @@ class CompiledGroupedAllreduce:
         # world size 1 instead of the host-copy shortcut
         self.force_program = bool(force_program)
         self._programs = {}
+        self._validated = set()  # sigs fingerprint-checked across procs
         self._ex = None          # executor the cached programs target
         self._lock = threading.Lock()
 
@@ -294,6 +353,7 @@ class CompiledGroupedAllreduce:
                 # rebuilt: programs compiled for the old mesh/world
                 # size would silently mis-average — drop them
                 self._programs.clear()
+                self._validated.clear()
                 self._ex = ex
             entry = self._programs.get(sig)
             if entry is None:
@@ -364,6 +424,8 @@ class CompiledGroupedAllreduce:
         prog = self._program(ex, sig, plan)
         n_local = len(ex.local_positions)
         timeline = eng.timeline
+        tag = ("reduce", int(self.op), self.prescale, self.postscale,
+               self.name)
 
         def launch(slot_values):
             # slot_values: {pos: (sig, [buf per dtype])} — the leader
@@ -377,6 +439,12 @@ class CompiledGroupedAllreduce:
                     f"local ranks: {sigs} — every member rank must "
                     "call with identical shapes/dtypes in the same "
                     "order")
+            # first call per signature: fingerprint exchange across
+            # PROCESSES over the coordinator KV (leader-only, cached)
+            if sig not in self._validated:
+                _validate_signature_cross_process(eng, ps, tag, sig)
+                with self._lock:
+                    self._validated.add(sig)
             import contextlib
 
             span = timeline.span(f"compiled.{self.name or 'reduce'}",
@@ -399,8 +467,6 @@ class CompiledGroupedAllreduce:
                 raise ValueError(
                     "unbound caller: compiled collectives need a rank "
                     "context (call inside hvd.run / a launched worker)")
-            tag = ("reduce", int(self.op), self.prescale, self.postscale,
-                   self.name)
             rdv = _rendezvous_for(ps, tag, n_local)
             out = rdv.run(pos, (sig, my_bufs), launch)
         return self._unpack(out, plan)
@@ -457,6 +523,7 @@ def reset_compiled_state():
     with _RDV_LOCK:
         _RDV_REGISTRY.clear()
         _STEP_COUNTERS.clear()
+        _SIG_COUNTERS.clear()
     with _PROGRAM_LOCK:
         _PROGRAM_CACHE.clear()
 
@@ -481,6 +548,7 @@ class _CompiledTrainStep:
         self._prog = None
         self._ex = None
         self._tag = None
+        self._sig_checked = False
         self._lock = threading.Lock()
 
     # -- program -------------------------------------------------------------
@@ -634,6 +702,7 @@ class _CompiledTrainStep:
                 # engine re-init / process-set rebuild: a program
                 # compiled for the old mesh would silently mis-average
                 self._prog = None
+                self._sig_checked = False
                 self._ex = ex
             if self._prog is None:
                 if self._tag is not None:
@@ -656,6 +725,22 @@ class _CompiledTrainStep:
                     _STEP_COUNTERS[key] = idx + 1
                 self._tag = ("step", idx)
             return self._tag
+
+    def _check_step_signature(self, eng, ps, state, batch):
+        """First-step cross-process fingerprint of (params, batch)
+        shapes/dtypes — a divergent model or batch shape on one
+        process otherwise compiles a different program and hangs or
+        mis-reduces (see _validate_signature_cross_process)."""
+        if self._sig_checked:
+            return
+        tree = batch.tree if isinstance(batch, StagedBatch) else batch
+        sig = tuple(
+            (tuple(getattr(leaf, "shape", ())),
+             str(getattr(leaf, "dtype", type(leaf).__name__)))
+            for leaf in jax.tree.leaves((state.get("params"), tree)))
+        _validate_signature_cross_process(
+            eng, ps, ("step_sig",) + tuple(self._tag or ()), sig)
+        self._sig_checked = True
 
     def place_batch(self, batch):
         """Pre-stage this rank's batch onto the mesh once; the returned
@@ -680,6 +765,7 @@ class _CompiledTrainStep:
         n_local = len(ex.local_positions)
 
         if n_local == 1:
+            self._check_step_signature(eng, ps, state, batch)
             prog = self._program(ex)
             if isinstance(batch, StagedBatch):
                 return prog(state, batch.tree)
@@ -697,6 +783,7 @@ class _CompiledTrainStep:
             # every rank passed the same (shared/replicated) state;
             # the leader's program runs with the first slot's state
             st = slots[sorted(slots)[0]][0]
+            self._check_step_signature(eng, ps, st, slots[sorted(slots)[0]][1])
             batches = {p: slots[p][1] for p in slots}
             return self._program(ex)(st, self._stage_batch(ex, batches))
 

@@ -887,6 +887,51 @@ def test_two_process_compiled_train_step(tmp_path):
     assert codes == [0, 0]
 
 
+SIG_MISMATCH_WORKER = textwrap.dedent("""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    # good signature first: the fingerprint exchange validates and the
+    # reduce proceeds
+    out = hvd.compiled_allreduce(np.full(4, float(r + 1), np.float32),
+                                 op=hvd.Sum)
+    assert np.allclose(out, 3.0), out
+    # now diverge: rank 0 brings 4 elements, rank 1 brings 5 — the KV
+    # fingerprint exchange must fail LOUDLY on every process (the
+    # engine path negotiates this; the compiled path has no
+    # negotiation, so without the exchange this would mis-reduce or
+    # hang)
+    n = 4 if r == 0 else 5
+    try:
+        hvd.compiled_allreduce(np.ones(n, np.float32))
+    except ValueError as e:
+        assert "signature mismatch across processes" in str(e), e
+        print(f"SIG MISMATCH CAUGHT {r}")
+        hvd.shutdown()
+        raise SystemExit(0)
+    raise SystemExit(1)
+""")
+
+
+@pytest.mark.integration
+def test_two_process_compiled_signature_mismatch(tmp_path):
+    """Cross-PROCESS compiled-path signature validation: mismatched
+    shapes fail loudly on both processes via the coordinator-KV
+    fingerprint exchange instead of silently mis-reducing (the
+    reference XLA-ops contract can't detect this; the KV store makes
+    it nearly free)."""
+    from horovod_tpu.runner.proc_run import launch_procs
+
+    script = tmp_path / "worker.py"
+    script.write_text(SIG_MISMATCH_WORKER)
+    codes = launch_procs([sys.executable, str(script)], np=2,
+                         platform="cpu", env={"PYTHONPATH": REPO},
+                         start_timeout=150)
+    assert codes == [0, 0]
+
+
 def test_coordinator_session_restart_clean():
     """A re-sessioned process (engine re-init, same coordinator round)
     must not inherit the previous session's dedup counters, join

@@ -5,6 +5,15 @@ Reference: ``horovod/spark/common/params.py`` (507 LoC of Spark ML
 batch size, epochs, callbacks, ...).  The TPU build keeps the same
 parameter names on a plain validated container; Spark ML's Param
 machinery adds nothing on a TPU pod.
+
+Load-bearing reference Params honored by the estimator training loops
+(reference params.py:50-175): ``callbacks``, ``sample_weight_col``,
+``train_steps_per_epoch`` / ``validation_steps_per_epoch``,
+``transformation_fn``, validation by column name, ``shuffle``,
+``val_batch_size``, ``random_seed``.  The purely-petastorm /
+purely-CUDA knobs (reader pool sizing, ``use_gpu``,
+``mp_start_method``, TransformSpec field editing) are intentionally
+absent — they configure machinery this build replaces.
 """
 
 
@@ -17,12 +26,15 @@ class EstimatorParams:
         feature_cols=("features",),
         label_cols=("label",),
         batch_size=32,
+        val_batch_size=None,        # defaults to batch_size
         epochs=1,
         validation=None,            # fraction or column name
         num_proc=1,
         store=None,
         callbacks=(),
         shuffle_buffer_size=None,
+        shuffle=True,
+        random_seed=None,
         verbose=1,
         run_id=None,
         train_steps_per_epoch=None,
@@ -45,20 +57,61 @@ class EstimatorParams:
     def _validate(self):
         if self.batch_size <= 0:
             raise ValueError("batch_size must be positive")
+        if self.val_batch_size is not None and self.val_batch_size <= 0:
+            raise ValueError("val_batch_size must be positive")
         if self.epochs <= 0:
             raise ValueError("epochs must be positive")
         if self.num_proc <= 0:
             raise ValueError("num_proc must be positive")
+        for steps_attr in ("train_steps_per_epoch",
+                           "validation_steps_per_epoch"):
+            v = getattr(self, steps_attr)
+            if v is not None and (not isinstance(v, int) or v <= 0):
+                raise ValueError(f"{steps_attr} must be a positive int")
+        if self.transformation_fn is not None \
+                and not callable(self.transformation_fn):
+            raise ValueError("transformation_fn must be callable")
         if self.validation is not None:
-            if not isinstance(self.validation, float):
-                # the reference also accepts a column name; that only
-                # makes sense on the DataFrame path, which this build
-                # gates — reject loudly instead of silently ignoring
-                raise NotImplementedError(
-                    "validation must be a float fraction (column-name "
-                    "validation needs the pyspark DataFrame path)")
-            if not 0.0 < self.validation < 1.0:
-                raise ValueError("validation fraction must be in (0, 1)")
+            if isinstance(self.validation, str):
+                # column-name validation: rows with a non-zero value in
+                # this column form the validation set (reference
+                # util.py _get_dataset_info splits the same way); only
+                # meaningful on the DataFrame path
+                if not self.validation:
+                    raise ValueError("validation column name is empty")
+            elif isinstance(self.validation, float):
+                if not 0.0 < self.validation < 1.0:
+                    raise ValueError(
+                        "validation fraction must be in (0, 1)")
+            else:
+                raise ValueError(
+                    "validation must be a float fraction or a column "
+                    "name string")
+
+    @property
+    def effective_val_batch_size(self):
+        return self.val_batch_size or self.batch_size
+
+    def epoch_seed(self, epoch):
+        """Shuffle seed for one epoch: reproducible when random_seed
+        is set, varying per epoch either way."""
+        base = 0 if self.random_seed is None else int(self.random_seed)
+        return base + epoch
+
+    def run_callbacks(self, epoch, logs):
+        """Invoke user callbacks after an epoch (torch loop; the keras
+        loops hand ``callbacks`` to ``model.fit`` natively).  Accepts
+        keras-style objects with ``on_epoch_end`` or plain callables
+        ``cb(epoch, logs)``."""
+        for cb in self.callbacks:
+            if hasattr(cb, "on_epoch_end"):
+                cb.on_epoch_end(epoch, logs)
+            elif callable(cb):
+                cb(epoch, logs)
+            else:
+                raise TypeError(
+                    f"callback {cb!r} is neither callable nor has "
+                    "on_epoch_end")
 
     # reference-parity getters (spark ML style)
     def getModel(self): return self.model            # noqa: E704
@@ -68,3 +121,11 @@ class EstimatorParams:
     def getEpochs(self): return self.epochs          # noqa: E704
     def getNumProc(self): return self.num_proc       # noqa: E704
     def getStore(self): return self.store            # noqa: E704
+    def getCallbacks(self): return self.callbacks    # noqa: E704
+    def getSampleWeightCol(self): return self.sample_weight_col  # noqa: E704
+    def getTransformationFn(self): return self.transformation_fn  # noqa: E704
+    def getTrainStepsPerEpoch(self): return self.train_steps_per_epoch  # noqa: E704
+    def getValidationStepsPerEpoch(self): return self.validation_steps_per_epoch  # noqa: E704
+    def getShuffle(self): return self.shuffle        # noqa: E704
+    def getValBatchSize(self): return self.val_batch_size  # noqa: E704
+    def getRandomSeed(self): return self.random_seed  # noqa: E704

@@ -54,14 +54,27 @@ def batch_to_xy(batch, feature_cols, label_cols):
     return np.asarray(x, np.float32), np.asarray(y, np.float32)
 
 
-def stage_dataframe_to_store(df, store, feature_cols, label_cols):
+def stage_dataframe_to_store(df, store, feature_cols, label_cols,
+                             sample_weight_col=None, validation=None):
     """Spark executors write the projected DataFrame as Parquet into
-    the store's intermediate path (no driver materialization);
-    returns the path (reference util.py prepare_data role)."""
+    the store's intermediate paths (no driver materialization);
+    returns ``(train_path, val_path)`` — ``val_path`` is None unless
+    ``validation`` names a column, in which case rows with a non-zero
+    value in it become the validation set (reference util.py
+    prepare_data / _train_val_split)."""
+    cols = list(feature_cols) + list(label_cols)
+    if sample_weight_col:
+        cols.append(sample_weight_col)
     train_path = store.get_train_data_path()
-    df.select(list(feature_cols) + list(label_cols)) \
-      .write.mode("overwrite").parquet(train_path)
-    return train_path
+    if isinstance(validation, str):
+        val_path = store.get_val_data_path()
+        df.filter(df[validation] == 0).select(cols) \
+          .write.mode("overwrite").parquet(train_path)
+        df.filter(df[validation] != 0).select(cols) \
+          .write.mode("overwrite").parquet(val_path)
+        return train_path, val_path
+    df.select(cols).write.mode("overwrite").parquet(train_path)
+    return train_path, None
 
 
 def synced_step_count(local_batches, name):
@@ -75,3 +88,85 @@ def synced_step_count(local_batches, name):
     out = api.allreduce(np.asarray(int(local_batches), np.int64),
                         op=api.Min, name=name)
     return int(out)
+
+
+def make_predict_partition_fn(model_blob, deserialize, predict_batch,
+                              feature_cols, batch_size=1024,
+                              output_col="prediction"):
+    """Per-partition inference closure (reference
+    ``horovod/spark/torch/estimator.py:439-470`` ``predict(rows)``,
+    batched): the returned function maps an iterator of row dicts to
+    an iterator of row dicts with ``output_col`` added.  The model is
+    deserialized ONCE per partition from ``model_blob`` (executors
+    never see the driver's live model object), rows are buffered up to
+    ``batch_size`` and predicted in one forward pass.
+
+    Framework-agnostic so it unit-tests with plain iterators:
+    ``deserialize(blob) -> model`` and
+    ``predict_batch(model, x) -> (N, ...) predictions``.
+    """
+    feature_cols = list(feature_cols)
+
+    def predict_partition(rows):
+        model = deserialize(model_blob)
+        buf = []
+
+        def flush():
+            if not buf:
+                return
+            x = np.asarray(
+                [[row[c] for c in feature_cols] for row in buf],
+                np.float32)
+            if x.ndim == 2 and len(feature_cols) == 1 \
+                    and np.ndim(buf[0][feature_cols[0]]) > 0:
+                # single array-valued feature column: drop the wrap
+                x = x[:, 0]
+            preds = np.asarray(predict_batch(model, x))
+            for row, p in zip(buf, preds):
+                out = dict(row)
+                out[output_col] = p.tolist() if p.ndim else float(p)
+                yield out
+            buf.clear()
+
+        for row in rows:
+            buf.append(row)
+            if len(buf) >= batch_size:
+                yield from flush()
+        yield from flush()
+
+    return predict_partition
+
+
+def transform_dataframe(df, predict_partition):
+    """Distributed ``Model.transform`` leg: map the partition fn over
+    the DataFrame's rows on the EXECUTORS (reference ``_transform``
+    maps ``predict`` with ``df.rdd.mapPartitions``) — nothing funnels
+    through the driver."""
+    require_pyspark()
+    from pyspark.sql import Row, SparkSession
+
+    def part(rows):
+        for out in predict_partition(r.asDict() for r in rows):
+            yield Row(**out)
+
+    spark = SparkSession.builder.getOrCreate()
+    return spark.createDataFrame(df.rdd.mapPartitions(part))
+
+
+def warn_driver_materialization(df, what, threshold=100_000):
+    """Store-less ``fit(df)`` funnels the DataFrame through the driver
+    (``toPandas``); warn when that is clearly not a toy (reference
+    jobs always stage through a Store)."""
+    import warnings
+
+    try:
+        n = df.count()
+    except Exception:  # noqa: BLE001 — exotic frame; warn unconditionally
+        n = None
+    if n is None or n > threshold:
+        warnings.warn(
+            f"{what} without a Store materializes the whole DataFrame "
+            f"on the driver ({n or 'unknown'} rows); configure "
+            "store=... so executors stream Parquet instead",
+            RuntimeWarning, stacklevel=3)
+    return n

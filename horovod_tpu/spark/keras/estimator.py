@@ -33,12 +33,18 @@ class KerasEstimator(EstimatorParams):
         (reference keras/remote.py make_batch_reader flow)."""
         require_pyspark()
         if self.store is None:
+            # small-data fallback; warns — driver materialization
+            from ..common.util import warn_driver_materialization
+
+            warn_driver_materialization(df, "KerasEstimator.fit(df)")
             x, y = extract_xy(df.toPandas(), self.feature_cols,
                               self.label_cols)
             return self.fit_arrays(x, y)
-        train_path = stage_dataframe_to_store(
-            df, self.store, self.feature_cols, self.label_cols)
-        return self.fit_on_parquet(train_path)
+        train_path, val_path = stage_dataframe_to_store(
+            df, self.store, self.feature_cols, self.label_cols,
+            sample_weight_col=self.sample_weight_col,
+            validation=self.validation)
+        return self.fit_on_parquet(train_path, val_path)
 
     def fit_on_parquet(self, train_path, val_path=None):
         """Stream a Parquet dataset per rank (Petastorm role —
@@ -55,6 +61,19 @@ class KerasEstimator(EstimatorParams):
         run_id = self.run_id or "run"
         feature_cols = list(self.feature_cols)
         label_cols = list(self.label_cols)
+        weight_col = self.sample_weight_col
+        schema = feature_cols + label_cols + \
+            ([weight_col] if weight_col else [])
+
+        def to_fit_tuple(batch):
+            if est.transformation_fn is not None:
+                batch = est.transformation_fn(batch)
+            xy = batch_to_xy(batch, feature_cols, label_cols)
+            if weight_col:
+                # keras consumes (x, y, sample_weight) triples natively
+                return xy + (np.asarray(batch[weight_col],
+                                        np.float32),)
+            return xy
 
         def train_fn():
             import tensorflow as tf
@@ -70,26 +89,48 @@ class KerasEstimator(EstimatorParams):
                   hvd_keras.callbacks.MetricAverageCallback()]
             cb += list(est.callbacks)
 
+            def cycling(epoch):
+                sub = 0
+                while True:
+                    reader = make_batch_reader(
+                        train_path, schema_fields=schema,
+                        batch_size=est.batch_size, cur_shard=rank,
+                        shard_count=size,
+                        shuffle_row_groups=est.shuffle,
+                        seed=est.epoch_seed(epoch * 1000 + sub))
+                    for b in reader:
+                        yield to_fit_tuple(b)
+                    sub += 1
+
             hist_all = {}
             for epoch in range(est.epochs):
-                reader = make_batch_reader(
-                    train_path,
-                    schema_fields=feature_cols + label_cols,
+                probe = make_batch_reader(
+                    train_path, schema_fields=schema,
                     batch_size=est.batch_size, cur_shard=rank,
-                    shard_count=size, shuffle_row_groups=True,
-                    seed=epoch)
+                    shard_count=size)
                 # equalized step count: shards can differ by a row
                 # group; a lone extra gradient allreduce would
                 # deadlock (reference keras/remote.py steps_per_epoch)
-                n_local = -(-reader.num_rows // est.batch_size)
-                steps = synced_step_count(n_local,
-                                          name=f"ksteps.{epoch}")
-                gen = (batch_to_xy(b, feature_cols, label_cols)
-                       for b in reader)
-                hist = model.fit(gen, epochs=1, steps_per_epoch=steps,
+                n_local = -(-probe.num_rows // est.batch_size)
+                steps = est.train_steps_per_epoch or \
+                    synced_step_count(n_local, name=f"ksteps.{epoch}")
+                fit_kw = {}
+                if val_path is not None:
+                    vreader = make_batch_reader(
+                        val_path, schema_fields=schema,
+                        batch_size=est.effective_val_batch_size,
+                        cur_shard=rank, shard_count=size)
+                    vsteps = est.validation_steps_per_epoch or \
+                        max(-(-vreader.num_rows
+                              // est.effective_val_batch_size), 1)
+                    fit_kw = {"validation_data":
+                              (to_fit_tuple(b) for b in vreader),
+                              "validation_steps": vsteps}
+                hist = model.fit(cycling(epoch), epochs=1,
+                                 steps_per_epoch=steps,
                                  callbacks=cb,
                                  verbose=est.verbose if rank == 0
-                                 else 0)
+                                 else 0, **fit_kw)
                 for k, vs in hist.history.items():
                     hist_all.setdefault(k, []).extend(
                         float(v) for v in vs)
@@ -190,13 +231,26 @@ class KerasModel:
     def transform_arrays(self, x):
         return np.asarray(self.model.predict(np.asarray(x), verbose=0))
 
+    def make_predict_fn(self, batch_size=1024, output_col="prediction"):
+        """Partition-level inference closure (reference keras
+        estimator ``_transform`` predict-per-partition); the model is
+        re-deserialized per executor partition."""
+        from ..common.util import make_predict_partition_fn
+
+        def predict_batch(model, x):
+            return np.asarray(model.predict(x, verbose=0))
+
+        return make_predict_partition_fn(
+            _serialize_keras(self.model), _deserialize_keras,
+            predict_batch, self.feature_cols, batch_size=batch_size,
+            output_col=output_col)
+
     def transform(self, df):
-        require_pyspark()
-        pdf = df.toPandas()
-        x = extract_x(pdf, self.feature_cols)
-        pdf["prediction"] = list(self.transform_arrays(x))
-        from pyspark.sql import SparkSession
-        return SparkSession.builder.getOrCreate().createDataFrame(pdf)
+        """Adds a prediction column on the EXECUTORS partition by
+        partition (never ``toPandas``)."""
+        from ..common.util import transform_dataframe
+
+        return transform_dataframe(df, self.make_predict_fn())
 
     @classmethod
     def load(cls, store: Store, run_id: str, **kwargs):

@@ -96,11 +96,16 @@ class LightningEstimator(EstimatorParams):
 
         require_pyspark()
         if self.store is None:
+            from ..common.util import warn_driver_materialization
+
+            warn_driver_materialization(df, "LightningEstimator.fit(df)")
             x, y = extract_xy(df.toPandas(), self.feature_cols,
                               self.label_cols)
             return self.fit_arrays(x, y)
-        train_path = stage_dataframe_to_store(
-            df, self.store, self.feature_cols, self.label_cols)
+        train_path, val_path = stage_dataframe_to_store(
+            df, self.store, self.feature_cols, self.label_cols,
+            sample_weight_col=self.sample_weight_col,
+            validation=self.validation)
         return self.fit_on_parquet(train_path)
 
     # -- training loops ------------------------------------------------------

@@ -42,13 +42,19 @@ class TorchEstimator(EstimatorParams):
         (reference keras/remote.py make_batch_reader flow)."""
         require_pyspark()
         if self.store is None:
-            # no store to stage through: small-data fallback
+            # no store to stage through: small-data fallback (warns —
+            # everything funnels through the driver)
+            from ..common.util import warn_driver_materialization
+
+            warn_driver_materialization(df, "TorchEstimator.fit(df)")
             x, y = extract_xy(df.toPandas(), self.feature_cols,
                               self.label_cols)
             return self.fit_arrays(x, y)
-        train_path = stage_dataframe_to_store(
-            df, self.store, self.feature_cols, self.label_cols)
-        return self.fit_on_parquet(train_path)
+        train_path, val_path = stage_dataframe_to_store(
+            df, self.store, self.feature_cols, self.label_cols,
+            sample_weight_col=self.sample_weight_col,
+            validation=self.validation)
+        return self.fit_on_parquet(train_path, val_path)
 
     def fit_on_parquet(self, train_path, val_path=None):
         """Train by streaming a (multi-file) Parquet dataset: each rank
@@ -71,11 +77,33 @@ class TorchEstimator(EstimatorParams):
         run_id = self.run_id or "run"
         feature_cols = list(self.feature_cols)
         label_cols = list(self.label_cols)
+        weight_col = self.sample_weight_col
+        schema = feature_cols + label_cols + \
+            ([weight_col] if weight_col else [])
 
-        def batch_xy(batch):
+        def batch_xyw(batch):
+            if est.transformation_fn is not None:
+                batch = est.transformation_fn(batch)
             x, y = batch_to_xy(batch, feature_cols, label_cols)
             # torch.tensor copies: arrow hands out read-only views
-            return torch.tensor(x), torch.tensor(y)
+            w = torch.tensor(np.asarray(batch[weight_col],
+                                        np.float32)) \
+                if weight_col else None
+            return torch.tensor(x), torch.tensor(y), w
+
+        def batch_loss(model, xb, yb, wb):
+            out = model(xb)
+            if wb is None:
+                return est.loss(out, yb)
+            # sample-weighted loss contract: loss(out, y, w) (the
+            # reference threads petastorm sample weights into its
+            # loss calculation the same way, torch/remote.py)
+            try:
+                return est.loss(out, yb, wb)
+            except TypeError as exc:
+                raise TypeError(
+                    "sample_weight_col requires a loss accepting "
+                    "(output, target, weights)") from exc
 
         def train_fn():
             rank, size = hvd.rank(), hvd.size()
@@ -86,27 +114,40 @@ class TorchEstimator(EstimatorParams):
                 backward_passes_per_step=est.backward_passes_per_step)
             broadcast_parameters(model.state_dict(), root_rank=0)
 
+            def cycling_batches(epoch):
+                """Recreate the shard reader when exhausted so a user
+                train_steps_per_epoch larger than one shard pass keeps
+                feeding (reference remote loops the petastorm reader)."""
+                sub = 0
+                while True:
+                    reader = make_batch_reader(
+                        train_path, schema_fields=schema,
+                        batch_size=est.batch_size, cur_shard=rank,
+                        shard_count=size,
+                        shuffle_row_groups=est.shuffle,
+                        seed=est.epoch_seed(epoch * 1000 + sub))
+                    yield from reader
+                    sub += 1
+
             history = []
             for epoch in range(est.epochs):
                 model.train()
                 total, count = 0.0, 0
-                reader = make_batch_reader(
-                    train_path,
-                    schema_fields=feature_cols + label_cols,
+                probe = make_batch_reader(
+                    train_path, schema_fields=schema,
                     batch_size=est.batch_size, cur_shard=rank,
-                    shard_count=size, shuffle_row_groups=True,
-                    seed=epoch)
+                    shard_count=size)
                 # every rank must run the SAME number of optimizer
                 # steps: shards can differ by a row group, and a lone
                 # extra gradient allreduce would deadlock the job
-                n_local = -(-reader.num_rows // est.batch_size)
-                steps = synced_step_count(n_local,
-                                          name=f"steps.{epoch}")
-                batches = iter(reader)
+                n_local = -(-probe.num_rows // est.batch_size)
+                steps = est.train_steps_per_epoch or \
+                    synced_step_count(n_local, name=f"steps.{epoch}")
+                batches = cycling_batches(epoch)
                 for _ in range(steps):
-                    xb, yb = batch_xy(next(batches))
+                    xb, yb, wb = batch_xyw(next(batches))
                     optimizer.zero_grad()
-                    loss = est.loss(model(xb), yb)
+                    loss = batch_loss(model, xb, yb, wb)
                     loss.backward()
                     optimizer.step()
                     total += float(loss.detach()) * len(xb)
@@ -117,21 +158,25 @@ class TorchEstimator(EstimatorParams):
                 entry = {"epoch": epoch, "train_loss": train_loss}
                 if val_path is not None:
                     model.eval()
-                    vtotal, vcount = 0.0, 0
+                    vtotal, vcount, vsteps = 0.0, 0, 0
                     vreader = make_batch_reader(
-                        val_path,
-                        schema_fields=feature_cols + label_cols,
-                        batch_size=est.batch_size, cur_shard=rank,
-                        shard_count=size)
+                        val_path, schema_fields=schema,
+                        batch_size=est.effective_val_batch_size,
+                        cur_shard=rank, shard_count=size)
                     with torch.no_grad():
                         for batch in vreader:
-                            xb, yb = batch_xy(batch)
-                            vtotal += float(est.loss(model(xb), yb)) \
-                                * len(xb)
+                            if est.validation_steps_per_epoch and \
+                                    vsteps >= est.validation_steps_per_epoch:
+                                break
+                            xb, yb, wb = batch_xyw(batch)
+                            vtotal += float(batch_loss(
+                                model, xb, yb, wb)) * len(xb)
                             vcount += len(xb)
+                            vsteps += 1
                     entry["val_loss"] = float(allreduce(
                         torch.tensor(vtotal / max(vcount, 1)),
                         name=f"val_loss.{epoch}"))
+                est.run_callbacks(epoch, entry)
                 history.append(entry)
                 if rank == 0 and store is not None:
                     store.save_checkpoint(
@@ -182,9 +227,14 @@ class TorchEstimator(EstimatorParams):
             for epoch in range(est.epochs):
                 model.train()
                 perm = torch.randperm(
-                    len(xs), generator=torch.Generator().manual_seed(epoch))
-                total, count = 0.0, 0
+                    len(xs), generator=torch.Generator().manual_seed(
+                        est.epoch_seed(epoch))) \
+                    if est.shuffle else torch.arange(len(xs))
+                total, count, nb = 0.0, 0, 0
                 for i in range(0, len(xs), est.batch_size):
+                    if est.train_steps_per_epoch is not None \
+                            and nb >= est.train_steps_per_epoch:
+                        break
                     idx = perm[i:i + est.batch_size]
                     optimizer.zero_grad()
                     out = model(xs[idx])
@@ -193,6 +243,7 @@ class TorchEstimator(EstimatorParams):
                     optimizer.step()
                     total += float(loss.detach()) * len(idx)
                     count += len(idx)
+                    nb += 1
                 # metric averaging across ranks (reference remote.py
                 # averages epoch metrics with allreduce)
                 train_loss = float(allreduce(
@@ -207,6 +258,7 @@ class TorchEstimator(EstimatorParams):
                             vout, torch.as_tensor(y_val)))
                     entry["val_loss"] = float(allreduce(
                         torch.tensor(vloss), name=f"val_loss.{epoch}"))
+                est.run_callbacks(epoch, entry)
                 history.append(entry)
                 if rank == 0 and store is not None:
                     store.save_checkpoint(
@@ -244,14 +296,30 @@ class TorchModel:
         with torch.no_grad():
             return self.model(torch.as_tensor(np.asarray(x))).numpy()
 
+    def make_predict_fn(self, batch_size=1024, output_col="prediction"):
+        """Partition-level inference closure (reference
+        ``spark/torch/estimator.py:439-470`` ``predict(rows)``): the
+        model is re-deserialized per executor partition; rows batch
+        through one forward pass.  Plain-iterator testable."""
+        from ..common.util import make_predict_partition_fn
+
+        def predict_batch(model, x):
+            import torch
+            model.eval()
+            with torch.no_grad():
+                return model(torch.as_tensor(x)).numpy()
+
+        return make_predict_partition_fn(
+            _serialize_model(self.model), _deserialize_model,
+            predict_batch, self.feature_cols, batch_size=batch_size,
+            output_col=output_col)
+
     def transform(self, df):
-        """Spark transform: adds a prediction column."""
-        require_pyspark()
-        pdf = df.toPandas()
-        x = extract_x(pdf, self.feature_cols)
-        pdf["prediction"] = list(self.transform_arrays(x))
-        from pyspark.sql import SparkSession
-        return SparkSession.builder.getOrCreate().createDataFrame(pdf)
+        """Spark transform: adds a prediction column, computed on the
+        EXECUTORS partition by partition (never ``toPandas``)."""
+        from ..common.util import transform_dataframe
+
+        return transform_dataframe(df, self.make_predict_fn())
 
     @classmethod
     def load(cls, store: Store, run_id: str, **kwargs):

@@ -149,7 +149,7 @@ class _GradSync:
                  gradient_predivide_factor=1.0,
                  process_set=global_process_set,
                  scale_local_gradients=True,
-                 use_compiled_ops=None):
+                 use_compiled_ops=None, sparse_as_dense=False):
         if gradient_predivide_factor != 1.0 and op != Average:
             # match the torch frontend and the reference
             # (tensorflow/__init__.py:957-961)
@@ -174,6 +174,7 @@ class _GradSync:
                 "use_compiled_ops supports op=Average or Sum only "
                 "(the reference XLA op surface, xla_mpi_ops.cc:558-603)")
         self.use_compiled_ops = bool(use_compiled_ops)
+        self.sparse_as_dense = bool(sparse_as_dense)
         self._compiled_reducer = None
         # local (non-synced) variables, reference tensorflow/__init__.py
         # register_local_source / scale_local_gradients (:1029-1100)
@@ -203,14 +204,21 @@ class _GradSync:
             if g is None:
                 continue
             if isinstance(g, tf.IndexedSlices):
-                # TPU collectives are dense: densify IndexedSlices (the
-                # reference's sparse_as_dense path,
-                # tensorflow/__init__.py:59-178)
+                if not self.sparse_as_dense:
+                    # allgather(values) + allgather(indices) instead of
+                    # densify+allreduce (reference
+                    # tensorflow/__init__.py:104-127): an embedding
+                    # gradient stays a few KB on the wire instead of
+                    # the full embedding matrix
+                    flat[i] = self._sparse_allreduce(g)
+                    continue
+                # opt-in densify (the reference's sparse_as_dense path)
                 g = tf.convert_to_tensor(g)
             dense.append(g)
             index.append(i)
         if not dense:
-            return grads
+            # possibly only sparse grads were handled above
+            return tf.nest.pack_sequence_as(grads, flat)
         if tf.executing_eagerly():
             outs = self._reduce_dense(dense)
         else:
@@ -242,6 +250,47 @@ class _GradSync:
         for i, o in zip(index, outs):
             flat[i] = o
         return tf.nest.pack_sequence_as(grads, flat)
+
+    def _sparse_allreduce(self, slices):
+        """IndexedSlices "allreduce" as two allgathers (reference
+        tensorflow/__init__.py:104-127): gathered values/indices form
+        an equivalent IndexedSlices (duplicate indices are summed by
+        the optimizer's scatter-add, exactly as in the reference)."""
+        if self.op not in (Average, Sum):
+            raise NotImplementedError(
+                "only Sum and Average are supported with "
+                "tf.IndexedSlices; pass sparse_as_dense=True for "
+                f"op={self.op}")
+        if self.gradient_predivide_factor != 1.0:
+            raise NotImplementedError(
+                "gradient_predivide_factor is not supported with "
+                "tf.IndexedSlices (reference contract); pass "
+                "sparse_as_dense=True")
+        values = self._allgather_tensor(slices.values, "sparse_v")
+        indices = self._allgather_tensor(slices.indices, "sparse_i")
+        if self.op == Average:
+            values = values / tf.cast(self._size(), values.dtype)
+        return tf.IndexedSlices(values, indices,
+                                dense_shape=slices.dense_shape)
+
+    def _allgather_tensor(self, t, tag):
+        """Engine allgather of one tensor (uneven dim-0 supported);
+        bridges the py_function hop when inside a trace."""
+        def gather_host(x):
+            return _api.allgather(np.asarray(x),
+                                  process_set=self.process_set)
+
+        if tf.executing_eagerly():
+            return tf.constant(gather_host(t.numpy()))
+        caller_ctx = _basics.context()
+
+        def _bridge(x):
+            with _basics.bound_context(caller_ctx):
+                return gather_host(x)
+
+        out = tf.py_function(func=_bridge, inp=[t], Tout=t.dtype)
+        out.set_shape(tf.TensorShape([None]).concatenate(t.shape[1:]))
+        return out
 
     def _scale_split(self):
         if self.op == Average and self.gradient_predivide_factor != 1.0:
@@ -324,7 +373,8 @@ class DistributedGradientTape(tf.GradientTape):
             gradient_predivide_factor=gradient_predivide_factor,
             process_set=process_set,
             scale_local_gradients=scale_local_gradients,
-            use_compiled_ops=use_compiled_ops)
+            use_compiled_ops=use_compiled_ops,
+            sparse_as_dense=sparse_as_dense)
 
     def register_local_source(self, var):
         """Exclude ``var``'s gradient from allreduce (kept local)."""
@@ -406,7 +456,8 @@ def PartialDistributedGradientTape(gradtape=None, device_dense="",
             gradient_predivide_factor=gradient_predivide_factor,
             process_set=process_set,
             scale_local_gradients=scale_local_gradients,
-            use_compiled_ops=use_compiled_ops))
+            use_compiled_ops=use_compiled_ops,
+            sparse_as_dense=sparse_as_dense))
     else:
         tape = DistributedGradientTape(
             compression=compression, sparse_as_dense=sparse_as_dense,
@@ -449,8 +500,12 @@ def DistributedOptimizer(optimizer, name=None,
 
         def apply_gradients(self, grads_and_vars, *args, **kwargs):
             grads_and_vars = list(grads_and_vars)
+            # bpps > 1 accumulates into dense buffers, so IndexedSlices
+            # must densify there; at bpps == 1 they ride the sparse
+            # allgather path in _GradSync
             grads = [tf.convert_to_tensor(g)
-                     if isinstance(g, tf.IndexedSlices) else g
+                     if isinstance(g, tf.IndexedSlices) and bpps > 1
+                     else g
                      for g, _ in grads_and_vars]
             tvars = [v for _, v in grads_and_vars]
             if bpps == 1:
@@ -532,7 +587,8 @@ def DistributedOptimizer(optimizer, name=None,
         compression=compression, op=op,
         gradient_predivide_factor=gradient_predivide_factor,
         process_set=process_set,
-        scale_local_gradients=scale_local_gradients)
+        scale_local_gradients=scale_local_gradients,
+        sparse_as_dense=sparse_as_dense)
     optimizer._hvd_agg = None
     optimizer._hvd_counter = None
     return optimizer

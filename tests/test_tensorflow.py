@@ -531,3 +531,72 @@ def test_tf_tape_compiled_ops_gpf(hvd_shutdown):
         return True
 
     assert all(run_ranks(fn))
+
+
+def test_tape_sparse_allgather_path(hvd_shutdown):
+    """IndexedSlices gradients ride allgather(values)+allgather(indices)
+    (reference tensorflow/__init__.py:104-127) — the result STAYS an
+    IndexedSlices carrying only the touched rows from every rank, never
+    the densified embedding matrix."""
+    def fn():
+        r = hvd.rank()
+        emb = tf.Variable(tf.ones((100, 4)))   # 100-row "embedding"
+        with hvd.DistributedGradientTape() as tape:
+            # each rank touches ONE distinct row
+            row = tf.nn.embedding_lookup(emb, tf.constant([r]))
+            y = tf.reduce_sum(row) * float(r + 1)
+        g = tape.gradient(y, [emb])[0]
+        assert isinstance(g, tf.IndexedSlices), type(g)
+        # gathered, not densified: NP rows total on the wire, not 100
+        assert g.values.shape[0] == NP, g.values.shape
+        idx = np.sort(np.asarray(g.indices))
+        np.testing.assert_array_equal(idx, np.arange(NP))
+        # Average semantics: each touched row's value = (rank+1)/NP
+        vals = {int(i): float(v[0]) for i, v in
+                zip(np.asarray(g.indices), np.asarray(g.values))}
+        for rr in range(NP):
+            assert abs(vals[rr] - (rr + 1) / NP) < 1e-6, vals
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_tape_sparse_as_dense_still_densifies(hvd_shutdown):
+    def fn():
+        r = hvd.rank()
+        emb = tf.Variable(tf.ones((10, 2)))
+        with hvd.DistributedGradientTape(sparse_as_dense=True) as tape:
+            y = tf.reduce_sum(tf.nn.embedding_lookup(
+                emb, tf.constant([r])))
+        g = tape.gradient(y, [emb])[0]
+        assert not isinstance(g, tf.IndexedSlices)
+        assert g.shape == (10, 2)
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_optimizer_sparse_allgather_path(hvd_shutdown):
+    """DistributedOptimizer at bpps=1 keeps IndexedSlices sparse
+    through the sync (scatter-add applies duplicate indices)."""
+    def fn():
+        r = hvd.rank()
+        emb = tf.Variable(tf.zeros((6, 2)))
+        opt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(1.0))
+        with tf.GradientTape() as tape:
+            y = tf.reduce_sum(tf.nn.embedding_lookup(
+                emb, tf.constant([r % 2])))
+        g = tape.gradient(y, [emb])[0]
+        assert isinstance(g, tf.IndexedSlices)
+        opt.apply_gradients([(g, emb)])
+        out = emb.numpy()
+        # ranks split between rows 0 and 1; Average => each rank
+        # contributed 1/NP per touched row
+        touched = {0: sum(1 for i in range(NP) if i % 2 == 0),
+                   1: sum(1 for i in range(NP) if i % 2 == 1)}
+        for row, cnt in touched.items():
+            assert np.allclose(out[row], -cnt / NP), out
+        assert np.allclose(out[2:], 0.0)
+        return True
+
+    assert all(run_ranks(fn))

@@ -15,12 +15,24 @@ class BroadcastGlobalVariablesCallback(tf.keras.callbacks.Callback):
         super().__init__()
         self.root_rank = root_rank
         self.broadcast_done = False
+        self._local_vars = set()
+
+    def register_local_var(self, var):
+        """Exclude ``var`` from the initial broadcast (reference
+        _keras/callbacks.py:32-41) — the worker-local-variable story
+        for PartialDistributedOptimizer users: locally-trained layers
+        must not be overwritten by root's initial values."""
+        from ..tensorflow import _var_key
+
+        self._local_vars.add(_var_key(var))
 
     def on_batch_end(self, batch, logs=None):
         if self.broadcast_done:
             return
-        from ..tensorflow import broadcast_variables
-        broadcast_variables(self.model.weights, self.root_rank)
+        from ..tensorflow import _var_key, broadcast_variables
+        broadcast_variables(
+            [v for v in self.model.weights
+             if _var_key(v) not in self._local_vars], self.root_rank)
         if hasattr(self.model, "optimizer") and \
                 getattr(self.model.optimizer, "variables", None):
             broadcast_variables(self.model.optimizer.variables,

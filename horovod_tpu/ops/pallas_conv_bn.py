@@ -48,34 +48,35 @@ def _is_tpu():
         return False
 
 
-# VMEM working-set budget for picking the M-block size: double-buffered
-# in/out blocks must fit beside the weight tile and (backward) the
-# (K, N) grad accumulator.
-_VMEM_BUDGET = 5 * 1024 * 1024
+# VMEM block budget for picking the M-block size: double-buffered
+# in/out blocks beside the weight tile and (backward) the (K, N) f32
+# grad accumulator, inside the raised 64 MB scoped-vmem limit
+# (_compiler_params).
+_VMEM_BUDGET = 40 * 1024 * 1024
 
 
-def _pick_bm(m, k, n):
-    """Largest M-block that divides ``m``, is sublane-aligned for bf16
-    (multiple of 16), and fits the VMEM budget.  Returns None if no
-    such block exists (caller falls back to the XLA path)."""
-    bm = 1024
-    while bm >= 16 and (bm * k + bm * n) * 2 * 2 > _VMEM_BUDGET:
-        bm //= 2
-    while bm >= 16 and m % bm:
-        bm //= 2
-    if bm >= 16:
-        return bm
-    # non-power-of-two M (e.g. 49 * B): try multiples of 16 divisors
+def _pick_bm(m, k, n, backward=False):
+    """Largest M-block ≤ 1024 that divides ``m``, is sublane-aligned
+    for bf16 (multiple of 16), and fits the VMEM budget.  Returns None
+    if no such block exists (caller falls back to the XLA path)."""
+    # fixed-resident bytes: weight tile (+ grad accumulator backward)
+    fixed = k * n * 2 + (k * n * 4 if backward else 0)
+    # per-M-block bytes, double-buffered: fwd reads x and writes y;
+    # bwd reads x, dy, y and writes dx
+    per_row = (2 * (k + n)) * 2 if not backward \
+        else (2 * (2 * k + 2 * n)) * 2
+    budget = _VMEM_BUDGET - fixed
     best = None
     for bm in range(16, 1041, 16):
-        if m % bm == 0 and (bm * k + bm * n) * 4 <= _VMEM_BUDGET:
+        if m % bm == 0 and bm * per_row <= budget:
             best = bm
     return best
 
 
 def supported_m(m, k, n):
     """Whether the pallas path can tile an (m, k) x (k, n) problem."""
-    return _pick_bm(m, k, n) is not None
+    return _pick_bm(m, k, n) is not None \
+        and _pick_bm(m, k, n, backward=True) is not None
 
 
 # ---------------------------------------------------------------------------
@@ -199,7 +200,7 @@ def _bwd_kernel(x_ref, a_ref, b_ref, w_ref, dy_ref, y_ref,
 def _bwd_call(x, a, b, w, y, dy, ds1, ds2, fold, interpret):
     m, k = x.shape
     n = w.shape[1]
-    bm = _pick_bm(m, k, n)
+    bm = _pick_bm(m, k, n, backward=True)
     dx, dw, da, db = pl.pallas_call(
         functools.partial(_bwd_kernel, fold=fold),
         grid=(m // bm,),

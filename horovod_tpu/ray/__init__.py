@@ -292,22 +292,22 @@ class ElasticRayExecutor:
         slot (ssh spawn for remote Ray nodes — autoscaler deployments
         share an ssh fabric), rounds re-forming on membership change.
         ``elastic_timeout`` bounds waiting for min_np slots, never a
-        healthy training run."""
+        healthy training run.
+
+        ``callbacks`` receive the round-lifecycle events
+        (hosts_updated / round_start / worker_start / worker_exit) as
+        dicts — the reference's ElasticRayExecutor callback surface
+        (ray/elastic_v2.py:402-470)."""
         from ..runner.elastic_api import run_elastic_fn
 
-        if callbacks:
-            import warnings
-            warnings.warn(
-                "ElasticRayExecutor callbacks are not wired in this "
-                "build; register them inside worker_fn via "
-                "hvd.elastic.State(callbacks=...) instead")
         run_elastic_fn(
             worker_fn, discovery=self._discovery,
             min_np=self.settings.get("min_np", 1),
             max_np=self.settings.get("max_np"),
             env=dict(self.env_vars),
             reset_limit=self.settings.get("reset_limit"),
-            start_timeout=self.settings.get("elastic_timeout"))
+            start_timeout=self.settings.get("elastic_timeout"),
+            callbacks=callbacks)
 
     def shutdown(self):
         self._discovery = None

@@ -40,7 +40,7 @@ from ..http.http_server import free_port as _free_port
 class ElasticDriver:
     def __init__(self, server, discovery, min_np, max_np, command,
                  env=None, reset_limit=None, cooldown_range=None,
-                 platform=None, verbose=False):
+                 platform=None, verbose=False, on_event=None):
         self._server = server            # RendezvousServer (KV + coord)
         self._host_manager = HostManager(discovery, cooldown_range)
         self._min_np = min_np
@@ -49,6 +49,11 @@ class ElasticDriver:
         self._env = env or {}
         self._platform = platform
         self._verbose = verbose
+        # lifecycle event hook (reference ray/elastic_v2.py:402-470
+        # callback queue): called with dicts like
+        # {"event": "round_start", ...}; exceptions are logged, never
+        # fatal to the driver
+        self._on_event = on_event
 
         self._registry = WorkerStateRegistry(self, self._host_manager,
                                              reset_limit=reset_limit)
@@ -129,6 +134,14 @@ class ElasticDriver:
             if not self._shutdown.is_set():
                 self._start_round()
 
+    def _emit(self, event, **fields):
+        if self._on_event is None:
+            return
+        try:
+            self._on_event({"event": event, **fields})
+        except Exception:  # noqa: BLE001 — user callback bug
+            logger.exception("elastic event callback failed (%s)", event)
+
     # -- round management ----------------------------------------------------
 
     def _compute_assignments(self) -> List:
@@ -177,6 +190,8 @@ class ElasticDriver:
                             "round": self._round}).encode())
             logger.info("round %d: %d workers %s", self._round, size,
                         self._assignments)
+            self._emit("round_start", round=self._round, size=size,
+                       assignments=dict(self._assignments))
             self._round_started_at = time.monotonic()
             self._churn_respawns.clear()
             # spawn processes for slots without a live worker
@@ -222,6 +237,8 @@ class ElasticDriver:
             env["JAX_NUM_CPU_DEVICES"] = "1"
         if self._verbose:
             logger.info("spawning worker %s", key)
+        self._emit("worker_start", host=host, slot=int(slot),
+                   round=self._round)
         if is_local(host):
             self._procs[key] = subprocess.Popen(self._command, env=env)
         else:
@@ -257,6 +274,10 @@ class ElasticDriver:
             if changed:
                 logger.info("host membership changed: %s",
                             self._host_manager.current_hosts.host_slots)
+                self._emit(
+                    "hosts_updated",
+                    hosts=dict(
+                        self._host_manager.current_hosts.host_slots))
                 self._start_round()
             self._shutdown.wait(DISCOVER_HOSTS_FREQUENCY_SECS)
 
@@ -283,6 +304,9 @@ class ElasticDriver:
                         self._deassigned.pop(key, None)
                         continue       # expected exit of a removed slot
                     host, slot = key.rsplit(":", 1)
+                    self._emit("worker_exit", host=host,
+                               slot=int(slot), code=code,
+                               round=self._round)
                     in_churn = (now - self._round_started_at) < 25.0
                     churns = self._churn_respawns.get(key, 0)
                     is_churn_exit = code in (-6, 134) or \

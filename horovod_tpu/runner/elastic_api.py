@@ -40,7 +40,7 @@ fn(*a, **kw)
 
 def run_elastic_fn(fn, args=(), kwargs=None, *, discovery, min_np,
                    max_np=None, env=None, reset_limit=None,
-                   start_timeout=None, verbose=False):
+                   start_timeout=None, verbose=False, callbacks=None):
     """Run ``fn(*args, **kwargs)`` on every elastic worker.
 
     ``discovery`` provides ``find_available_hosts_and_slots()``;
@@ -48,6 +48,11 @@ def run_elastic_fn(fn, args=(), kwargs=None, *, discovery, min_np,
     changes re-form the mesh.  ``start_timeout`` bounds waiting for
     ``min_np`` slots at startup — it does NOT bound job duration (the
     reference's elastic_timeout bounds re-rendezvous, not training).
+
+    ``callbacks`` (reference ray/elastic_v2.py:402-470 callback
+    plumbing): each callable receives every round-lifecycle event as a
+    dict — ``{"event": "hosts_updated"|"round_start"|"worker_start"|
+    "worker_exit", ...}`` — as it happens.
     """
     if cloudpickle is None:  # pragma: no cover
         # stdlib pickle would serialize __main__ functions by
@@ -76,10 +81,19 @@ def run_elastic_fn(fn, args=(), kwargs=None, *, discovery, min_np,
             (fn, tuple(args), dict(kwargs or {})), protocol=4))
         command = [sys.executable, "-c",
                    _WORKER_STUB.format(fn_key=FN_KEY)]
+        on_event = None
+        if callbacks:
+            cbs = list(callbacks)
+
+            def on_event(event):
+                for cb in cbs:
+                    cb(event)
+
         driver = ElasticDriver(server, discovery, min_np=min_np,
                                max_np=max_np or min_np, command=command,
                                env=dict(env or {}),
-                               reset_limit=reset_limit, verbose=verbose)
+                               reset_limit=reset_limit, verbose=verbose,
+                               on_event=on_event)
         driver.start(start_timeout=start_timeout)
         ok = driver.join()
     finally:

@@ -471,3 +471,78 @@ def test_elastic_reinit_real_backend(tmp_path):
     codes = launch_procs([sys.executable, str(script)], np=1,
                          platform=None, env=env, start_timeout=600)
     assert codes == [0]
+
+
+@pytest.mark.integration
+def test_ray_elastic_callbacks_scale_up(tmp_path, monkeypatch):
+    """ElasticRayExecutor callbacks (reference ray/elastic_v2.py:402-470):
+    lifecycle events — round_start / hosts_updated / worker_start /
+    worker_exit — reach the registered callbacks across a scale-up
+    round.  Ray itself is faked; discovery + workers are real."""
+    import types
+
+    monkeypatch.setitem(sys.modules, "ray", types.ModuleType("ray"))
+    from horovod_tpu.ray import ElasticRayExecutor
+
+    log = tmp_path / "log.txt"
+    log.write_text("")
+
+    class GrowingDiscovery:
+        def find_available_hosts_and_slots(self):
+            if "batch 2" in log.read_text():
+                return {"localhost": 2}
+            return {"localhost": 1}
+
+    def worker():
+        import os
+
+        import numpy as np
+
+        import horovod_tpu as hvd
+        import horovod_tpu.elastic as elastic
+
+        hvd.init()
+        logp = os.environ["HVD_TEST_LOG"]
+
+        def wlog(msg):
+            with open(logp, "a") as f:
+                f.write(msg + "\n")
+
+        state = elastic.ObjectState(
+            bcast_object=hvd.broadcast_object, get_rank=hvd.rank,
+            batch=0, at_target=0)
+
+        @elastic.run
+        def train(state):
+            while True:
+                hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                              name=f"b{state.batch}")
+                wlog(f"batch {state.batch} rank {hvd.rank()} "
+                     f"size {hvd.size()}")
+                state.batch += 1
+                if hvd.size() >= 2:
+                    state.at_target += 1
+                if state.at_target >= 3:
+                    return
+                state.commit()
+
+        train(state)
+
+    settings = ElasticRayExecutor.create_settings(
+        min_np=1, max_np=2, elastic_timeout=240,
+        override_discovery=GrowingDiscovery())
+    ex = ElasticRayExecutor(settings, env_vars={
+        "JAX_PLATFORMS": "cpu", "JAX_NUM_CPU_DEVICES": "1",
+        "HVD_TEST_LOG": str(log)})
+    ex.start()
+    events = []
+    ex.run(worker, callbacks=[events.append])
+    ex.shutdown()
+
+    kinds = [e["event"] for e in events]
+    assert "hosts_updated" in kinds, kinds
+    rounds = [e for e in events if e["event"] == "round_start"]
+    assert rounds[0]["size"] == 1 and rounds[-1]["size"] == 2, rounds
+    starts = [e for e in events if e["event"] == "worker_start"]
+    assert len(starts) >= 2, events
+    assert "size 2" in log.read_text()

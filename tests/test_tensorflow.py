@@ -600,3 +600,31 @@ def test_optimizer_sparse_allgather_path(hvd_shutdown):
         return True
 
     assert all(run_ranks(fn))
+
+
+def test_broadcast_callback_register_local_var(hvd_shutdown):
+    """register_local_var on the keras broadcast callback (reference
+    _keras/callbacks.py:32-41): excluded variables keep their per-rank
+    values through the initial broadcast."""
+    def fn():
+        import horovod_tpu.keras as hvd_keras
+
+        r = hvd.rank()
+        inputs = tf.keras.Input((2,))
+        model = tf.keras.Model(
+            inputs, tf.keras.layers.Dense(
+                1, use_bias=True, name="d")(inputs))
+        dense = model.get_layer("d")
+        dense.kernel.assign(tf.fill((2, 1), float(r + 1)))
+        dense.bias.assign(tf.fill((1,), float(r + 10)))
+
+        cb = hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0)
+        cb.register_local_var(dense.bias)      # stays per-rank
+        cb.set_model(model)
+        cb.on_batch_end(0)
+
+        assert np.allclose(dense.kernel.numpy(), 1.0)       # root's
+        assert np.allclose(dense.bias.numpy(), r + 10)      # local
+        return True
+
+    assert all(run_ranks(fn))

@@ -7,7 +7,8 @@ dp / fsdp / tp / pp / sp / ep are first-class compiled shardings.
 """
 
 from .mesh import (  # noqa: F401
-    MeshSpec, build_mesh, data_mesh, two_level_mesh, AXIS_ORDER,
+    MeshSpec, build_mesh, data_mesh, two_level_mesh, two_level_plan,
+    TwoLevelPlan, hierarchical_allreduce, AXIS_ORDER,
 )
 from .sharding import (  # noqa: F401
     transformer_param_spec, transformer_param_shardings,

@@ -284,3 +284,59 @@ def test_moe_ep_step():
     compiled, state = jit_step(state)
     _, loss = compiled(state, jax.device_put(tokens, tok_shd))
     assert np.isfinite(float(loss))
+
+
+def test_two_level_plan_heterogeneous_psum():
+    """Unequal ranks per host (3+2+3) degrade to the flat-mesh
+    grouped-psum hierarchy — local reduce / leader cross-reduce /
+    local broadcast, the reference's NCCLHierarchicalAllreduce stages
+    under its is_homogeneous degradation (nccl_operations.cc:380-420)
+    — and still produce the exact global sum."""
+    import numpy as np
+
+    from horovod_tpu.common.topology import Topology
+    from horovod_tpu.parallel import (
+        hierarchical_allreduce, two_level_plan,
+    )
+
+    topo = Topology(size=8, host_of_rank=[0, 0, 0, 1, 1, 2, 2, 2])
+    plan = two_level_plan(topo)
+    assert not plan.homogeneous
+    assert plan.mesh.axis_names == ("rank",)
+    # per-group meshes: one per host at that host's width, plus a
+    # cross stage over the 3 host leaders
+    assert [m.shape["local"] for m in plan.local_meshes] == [3, 2, 3]
+    assert plan.cross_mesh.shape["cross"] == 3
+
+    rows = np.stack([np.full(5, float(r + 1), np.float32)
+                     for r in range(8)])
+    out = hierarchical_allreduce(rows, topo)
+    np.testing.assert_allclose(out, rows.sum(0))
+
+
+def test_two_level_plan_homogeneous_uses_mesh():
+    import numpy as np
+
+    from horovod_tpu.common.topology import Topology
+    from horovod_tpu.parallel import (
+        hierarchical_allreduce, two_level_plan,
+    )
+
+    topo = Topology(size=8, host_of_rank=[0, 0, 0, 0, 1, 1, 1, 1])
+    plan = two_level_plan(topo)
+    assert plan.homogeneous
+    assert dict(plan.mesh.shape) == {"cross": 2, "local": 4}
+    rows = np.arange(8 * 3, dtype=np.float32).reshape(8, 3)
+    np.testing.assert_allclose(hierarchical_allreduce(rows, topo),
+                               rows.sum(0))
+
+
+def test_two_level_plan_rejects_interleaved_hosts():
+    import pytest as _pytest
+
+    from horovod_tpu.common.topology import Topology
+    from horovod_tpu.parallel import two_level_plan
+
+    topo = Topology(size=4, host_of_rank=[0, 1, 0, 1])
+    with _pytest.raises(ValueError, match="grouped by host"):
+        two_level_plan(topo)

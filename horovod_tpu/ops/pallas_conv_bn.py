@@ -242,12 +242,51 @@ def _vjp_fwd(x, a, b, w, fold, interpret):
     return (y, s1, s2), (x, a, b, w, y)
 
 
+def _bwd_xla(x, a, b, w, y, dy, ds1, ds2, fold):
+    """XLA backward with the same math as _bwd_kernel (A/B lever and
+    oracle; env HOROVOD_CONV_BN_BWD=xla selects it)."""
+    ytot = (dy.astype(jnp.float32) + ds1[None, :]
+            + 2.0 * y.astype(jnp.float32) * ds2[None, :])
+    ytot_bf = ytot.astype(jnp.bfloat16)
+    if fold:
+        pre = x.astype(jnp.float32) * a + b
+        mask = (pre > 0.0).astype(jnp.float32)
+        xh = jnp.maximum(pre, 0.0).astype(jnp.bfloat16)
+    else:
+        xh = x
+    dxh = jax.lax.dot_general(
+        ytot_bf, w, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dw = jax.lax.dot_general(
+        xh, ytot_bf, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    if fold:
+        dxh_m = dxh * mask
+        dx = (dxh_m * a).astype(x.dtype)
+        da = jnp.sum(dxh_m * x.astype(jnp.float32), axis=0,
+                     keepdims=True)
+        db = jnp.sum(dxh_m, axis=0, keepdims=True)
+    else:
+        dx = dxh.astype(x.dtype)
+        da = db = None
+    return dx, dw, da, db
+
+
+def _bwd_mode():
+    import os
+
+    return os.environ.get("HOROVOD_CONV_BN_BWD", "pallas")
+
+
 def _vjp_bwd(fold, interpret, res, cots):
     x, a, b, w, y = res
     dy, ds1, ds2 = cots
-    dx, dw, da, db = _bwd_call(x, a, b, w, y, dy, ds1, ds2,
-                               fold, interpret)
-    if not fold:
+    if _bwd_mode() == "xla":
+        dx, dw, da, db = _bwd_xla(x, a, b, w, y, dy, ds1, ds2, fold)
+    else:
+        dx, dw, da, db = _bwd_call(x, a, b, w, y, dy, ds1, ds2,
+                                   fold, interpret)
+    if not fold or da is None:
         da = jnp.zeros_like(a)
         db = jnp.zeros_like(b)
     else:

@@ -350,6 +350,13 @@ def DistributedOptimizer(optimizer, named_parameters=None,
                methods)
     inst = cls.__new__(cls)
     inst.__dict__.update(optimizer.__dict__)
+    # torch LRSchedulers patch ``step`` as an INSTANCE attribute on
+    # the optimizer they wrap (profiling/step-order bookkeeping); the
+    # dict copy would carry that bound-to-the-base-instance method
+    # over, shadowing the distributed step() and silently skipping
+    # gradient synchronization.  Drop it — only the scheduler's
+    # step-order warning is lost.
+    inst.__dict__.pop("step", None)
     inst._dist_init(named_parameters, compression,
                     backward_passes_per_step, op,
                     gradient_predivide_factor, groups, sparse_as_dense,

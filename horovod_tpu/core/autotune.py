@@ -3,12 +3,15 @@
 sample windows, warmup discard, Bayesian optimization over tunables,
 CSV log via HOROVOD_AUTOTUNE_LOG, converge-to-best after max samples).
 
-Tunables here are the three that exist on the TPU engine: the fusion
+Tunables here are the four that exist on the TPU engine: the fusion
 threshold (bucket size for packed allreduces), the cycle time (how
-long the background thread batches submissions), and the
+long the background thread batches submissions), the
 multithreaded-pack threshold (bucket size above which the native pack
-fans out across threads).  The reference's hierarchical/torus toggles
-have no analogue — topology-aware routing belongs to XLA.
+fans out across threads), and the coordinator response-cache capacity
+(the reference tunes cache on/off, parameter_manager.h:65; here the
+LRU size tunes smoothly with 0 = disabled).  The reference's
+hierarchical/torus toggles have no analogue — topology-aware routing
+belongs to XLA.
 """
 
 import time
@@ -18,10 +21,11 @@ import numpy as np
 from .optim import BayesianOptimizer
 
 # log2 bounds: fusion threshold 1 MiB .. 256 MiB, cycle 0.5 .. 32 ms,
-# MT-pack threshold 1 MiB .. 64 MiB
+# MT-pack threshold 1 MiB .. 64 MiB, cache capacity 0 .. 4096 entries
 _FUSION_LO, _FUSION_HI = 20.0, 28.0
 _CYCLE_LO, _CYCLE_HI = -1.0, 5.0
 _PACKMT_LO, _PACKMT_HI = 20.0, 26.0
+_CACHE_BITS = 12.0
 
 
 class ParameterManager:
@@ -32,40 +36,46 @@ class ParameterManager:
         self.steps_per_sample = steps_per_sample
         self.max_samples = max_samples
         self.active = True
-        self._bo = BayesianOptimizer(dims=3, seed=seed)
+        self._bo = BayesianOptimizer(dims=4, seed=seed)
         self._samples = 0
         self._steps = 0
         self._bytes = 0
         self._t0 = None
         self._current = self._encode(
             config.fusion_threshold_bytes, config.cycle_time_ms,
-            getattr(config, "pack_mt_threshold_bytes", 8 << 20))
+            getattr(config, "pack_mt_threshold_bytes", 8 << 20),
+            getattr(config, "cache_capacity", 1024))
         self._best_score = -np.inf
         self._best = self._current
         self._log = open(log_path, "w") if log_path else None
         if self._log:
             self._log.write(
                 "sample,fusion_threshold_bytes,cycle_time_ms,"
-                "pack_mt_threshold_bytes,score_bytes_per_sec\n")
+                "pack_mt_threshold_bytes,cache_capacity,"
+                "score_bytes_per_sec\n")
 
     # -- encoding ------------------------------------------------------------
 
     @staticmethod
-    def _encode(fusion_bytes, cycle_ms, pack_mt_bytes):
+    def _encode(fusion_bytes, cycle_ms, pack_mt_bytes, cache_capacity):
         x0 = (np.log2(max(fusion_bytes, 1)) - _FUSION_LO) / \
             (_FUSION_HI - _FUSION_LO)
         x1 = (np.log2(max(cycle_ms, 2 ** _CYCLE_LO)) - _CYCLE_LO) / \
             (_CYCLE_HI - _CYCLE_LO)
         x2 = (np.log2(max(pack_mt_bytes, 1)) - _PACKMT_LO) / \
             (_PACKMT_HI - _PACKMT_LO)
-        return np.clip([x0, x1, x2], 0.0, 1.0)
+        x3 = np.log2(cache_capacity + 1) / _CACHE_BITS
+        return np.clip([x0, x1, x2, x3], 0.0, 1.0)
 
     @staticmethod
     def _decode(x):
         fusion = int(2 ** (_FUSION_LO + x[0] * (_FUSION_HI - _FUSION_LO)))
         cycle = float(2 ** (_CYCLE_LO + x[1] * (_CYCLE_HI - _CYCLE_LO)))
         pack_mt = int(2 ** (_PACKMT_LO + x[2] * (_PACKMT_HI - _PACKMT_LO)))
-        return fusion, cycle, pack_mt
+        # capacity 0 (cache off) is reachable at the low end — the
+        # reference's cache-enabled toggle as the floor of a smooth dim
+        cache = int(round(2 ** (x[3] * _CACHE_BITS))) - 1
+        return fusion, cycle, pack_mt, cache
 
     # -- recording (engine hot path) ----------------------------------------
 
@@ -86,10 +96,10 @@ class ParameterManager:
         score = self._bytes / elapsed
         self._samples += 1
         if self._log:
-            fusion, cycle, pack_mt = self._decode(self._current)
+            fusion, cycle, pack_mt, cache = self._decode(self._current)
             self._log.write(
                 f"{self._samples},{fusion},{cycle:.3f},{pack_mt},"
-                f"{score:.1f}\n")
+                f"{cache},{score:.1f}\n")
             self._log.flush()
         if self._samples > self.warmup_samples:
             self._bo.observe(self._current, score)
@@ -109,10 +119,11 @@ class ParameterManager:
         self._t0 = None
 
     def _apply(self, x):
-        fusion, cycle, pack_mt = self._decode(x)
+        fusion, cycle, pack_mt, cache = self._decode(x)
         self.config.fusion_threshold_bytes = fusion
         self.config.cycle_time_ms = cycle
         self.config.pack_mt_threshold_bytes = pack_mt
+        self.config.cache_capacity = cache
 
     def best_parameters(self):
         return self._decode(self._best)

@@ -1026,23 +1026,10 @@ class Engine:
         subs = self._local_subs(ps, entry)
         first = next(iter(subs.values()))
         rest = tuple(first.payloads[0].shape[1:])
-        rest_n = int(np.prod(rest, dtype=np.int64)) if rest else 1
         splits = self._global_splits(ps, entry, aux)
-        R = ps.size
-        max_seg = max((s for sp in splits for s in sp), default=0)
-        rows = []
-        for r in subs:
-            pos = ps.index[r]
-            p = subs[r].payloads[0]
-            flat = np.ravel(p)
-            buf = np.zeros(R * max_seg * rest_n, dtype=p.dtype)
-            off = 0
-            for j in range(R):
-                seg = splits[pos][j] * rest_n
-                buf[j * max_seg * rest_n: j * max_seg * rest_n + seg] = \
-                    flat[off:off + seg]
-                off += seg
-            rows.append(buf)
+        # exact concat buffers; the executor picks the wire layout
+        # (one-shot padded vs skew-aware diagonal schedule)
+        rows = [np.ravel(subs[r].payloads[0]) for r in subs]
         results, recv_splits = ps.executor.alltoall(rows, splits, rest)
         for (r, sub), res, rsp in zip(subs.items(), results, recv_splits):
             sub.handle.set_result(res, extra=np.array(rsp, dtype=np.int32))

@@ -323,14 +323,16 @@ class MeshExecutor:
         Returns (per-local-rank received buffers, per-local-rank
         recv_splits).
 
-        Skew note: XLA collectives are static-shaped, so every segment
-        pads to the GLOBAL max split — device buffers and wire traffic
-        scale with R*max(split) rather than the exact byte counts the
+        Skew: XLA collectives are static-shaped, so the one-shot
+        ``all_to_all`` pads every segment to the GLOBAL max split —
+        wire traffic R*max(split) instead of the exact byte counts the
         reference moves (mpi_operations.cc:441-530).  Balanced loads
-        (MoE capacity-factor routing, even shards) pad ~nothing; a
-        single pathological split inflates every rank's buffer, so
-        heavily ragged exchanges should re-bucket by size first (see
-        docs/benchmarks.md "collective skew")."""
+        (MoE capacity-factor routing, even shards) pad ~nothing and
+        take that path; when padding would more than double the wire
+        bytes, the exchange switches to the DIAGONAL schedule — R-1
+        ``ppermute`` steps, step d carrying only segment (r+d) padded
+        to that diagonal's own max — so a single pathological split
+        inflates one step, not every segment."""
         R = self.num_ranks
         dtype = rows[0].dtype
         rest = int(np.prod(rest_shape, dtype=np.int64)) if rest_shape else 1
@@ -341,10 +343,18 @@ class MeshExecutor:
         if max_seg == 0 or rest == 0:
             empty = np.zeros((0,) + tuple(rest_shape), dtype=dtype)
             return [empty.copy() for _ in self.local_positions], recv_local
+        diag_max = [max(splits[r][(r + d) % R] for r in range(R))
+                    for d in range(R)]
+        if self.shard_mode and R > 2 and \
+                R * max_seg > 2 * sum(diag_max):
+            return self._alltoall_diag(rows, splits, rest_shape,
+                                       diag_max, recv_local)
         m = max_seg * rest
         key = ("alltoall", R, m, str(dtype), self.shard_mode)
         fn = self._cached(key, lambda: self._build_alltoall(m))
-        x = self._stage_rows([r.reshape(R * m) for r in rows])
+        x = self._stage_rows([self._pad_segments(r, splits[pos], m, rest)
+                              for r, pos in zip(rows,
+                                                self.local_positions)])
         out = fn(x)  # (R_dst, R*m) sharded by dst; row r = segments recv'd
         padded_rows = self._rows_out(out)
         results = []
@@ -356,6 +366,71 @@ class MeshExecutor:
             buf = np.concatenate(segs) if segs else np.zeros(0, dtype=dtype)
             results.append(buf.reshape((-1,) + tuple(rest_shape)))
         return results, recv_local
+
+    def _pad_segments(self, flat, my_splits, m, rest):
+        """Exact concat buffer -> per-destination padded layout."""
+        R = self.num_ranks
+        buf = np.zeros(R * m, dtype=flat.dtype)
+        off = 0
+        for j in range(R):
+            seg = my_splits[j] * rest
+            buf[j * m: j * m + seg] = flat[off:off + seg]
+            off += seg
+        return buf
+
+    def _alltoall_diag(self, rows, splits, rest_shape, diag_max,
+                       recv_local):
+        """Skew-aware alltoall: one ppermute per diagonal ``d`` (rank
+        r -> rank (r+d) % R), each padded only to that diagonal's max
+        segment.  Total wire = sum(diag_max) vs the one-shot path's
+        R * max(split)."""
+        R = self.num_ranks
+        dtype = rows[0].dtype
+        rest = int(np.prod(rest_shape, dtype=np.int64)) if rest_shape else 1
+        ms = [dm * rest for dm in diag_max]
+        key = ("alltoall_diag", R, tuple(ms), str(dtype))
+        fn = self._cached(key, lambda: self._build_alltoall_diag(ms))
+        staged = []
+        for d in range(R):
+            diag_rows = []
+            for flat, pos in zip(rows, self.local_positions):
+                j = (pos + d) % R
+                off = sum(splits[pos][:j]) * rest
+                seg = splits[pos][j] * rest
+                buf = np.zeros(max(ms[d], 1), dtype=dtype)
+                buf[:seg] = flat[off:off + seg]
+                diag_rows.append(buf)
+            staged.append(self._stage_rows(diag_rows))
+        outs = fn(*staged)
+        # out d, row r = the segment sent by src (r-d) % R
+        per_local_out = [self._rows_out(o) for o in outs]
+        results = []
+        for i, pos in enumerate(self.local_positions):
+            segs = []
+            for j in range(R):          # reassemble in src order
+                d = (pos - j) % R
+                seg = recv_local[i][j] * rest
+                segs.append(per_local_out[d][i][:seg])
+            buf = np.concatenate(segs) if segs else np.zeros(0, dtype=dtype)
+            results.append(buf.reshape((-1,) + tuple(rest_shape)))
+        return results, recv_local
+
+    def _build_alltoall_diag(self, ms):
+        R = self.num_ranks
+
+        def body(*xs):
+            outs = [xs[0]]              # d=0: own segment stays local
+            for d in range(1, R):
+                perm = [(r, (r + d) % R) for r in range(R)]
+                outs.append(lax.ppermute(xs[d], "hvd", perm=perm))
+            return tuple(outs)
+
+        mapped = shard_map(
+            body, mesh=self.mesh,
+            in_specs=tuple(P("hvd") for _ in range(R)),
+            out_specs=tuple(P("hvd") for _ in range(R)),
+            check_vma=False)
+        return jax.jit(mapped)
 
     def _build_alltoall(self, m):
         R = self.num_ranks

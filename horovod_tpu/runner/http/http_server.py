@@ -205,7 +205,8 @@ class Coordinator:
             self._tuned_params = types.SimpleNamespace(
                 fusion_threshold_bytes=fusion_threshold_bytes,
                 cycle_time_ms=cycle_time_ms,
-                pack_mt_threshold_bytes=8 << 20)
+                pack_mt_threshold_bytes=8 << 20,
+                cache_capacity=cache_capacity)
             self._autotuner = ParameterManager(self._tuned_params,
                                                log_path=autotune_log)
         self._lock = threading.Condition()
@@ -466,6 +467,7 @@ class Coordinator:
 
         if self._autotuner is not None:
             self.fusion_threshold = self._tuned_params.fusion_threshold_bytes
+            self.cache_capacity = self._tuned_params.cache_capacity
         for meta in ready:
             if meta["type"] not in ("ALLREDUCE", "ADASUM"):
                 if self._exhausted.get(meta.get("ps", 0)):
@@ -501,7 +503,10 @@ class Coordinator:
             templates[key] = {k: v for k, v in m.items()
                               if k not in ("aux", "aux_by_proc",
                                            "_cached")}
-            if m["type"] not in CACHEABLE_TYPES:
+            if m["type"] not in CACHEABLE_TYPES \
+                    or self.cache_capacity <= 0:
+                # capacity 0 = cache disabled (an autotunable point —
+                # the reference tunes cache on/off the same way)
                 continue
             cid = self._cache_by_key.get(key)
             if cid is None:

@@ -790,3 +790,58 @@ def test_multi_handle_wait_times_out_promptly():
     with pytest.raises(TimeoutError):
         mh.wait(timeout=0.01)
     assert time.monotonic() - t0 < 0.5     # not 50 sequential waits
+
+
+def test_alltoall_skewed_takes_diagonal_schedule(hvd_shutdown):
+    """A pathologically skewed split (one huge segment, rest tiny)
+    routes through the diagonal ppermute schedule — sum(diag_max)
+    wire instead of R*max padding — and still delivers exact bytes
+    (reference alltoallv moves exact counts, mpi_operations.cc:441)."""
+    def fn():
+        r = hvd.rank()
+        s = hvd.size()
+        # rank 0 sends 64 rows to rank 1; every other segment is 1 row
+        splits = [1] * s
+        if r == 0:
+            splits[1] = 64
+        n = sum(splits)
+        x = (np.arange(n * 2, dtype=np.float32).reshape(n, 2)
+             + 100.0 * r)
+        out, recv = hvd.alltoall(x, splits=splits, name="skewed")
+        # recv sizes: from rank 0 it's 64 rows for rank 1, 1 otherwise
+        expect_recv = [1] * s
+        if r == 1:
+            expect_recv[0] = 64
+        assert list(recv) == expect_recv, (r, recv)
+        assert out.shape == (sum(expect_recv), 2)
+        # spot-check payload integrity: the block from rank j starts
+        # with rank j's row offset value
+        off = 0
+        for j in range(s):
+            seg = expect_recv[j]
+            src_off = sum(([1] * s if j != 0 else
+                           ([1, 64] + [1] * (s - 2)))[:r]) \
+                if j == 0 else r  # rank j's send offset to us
+            first = out[off, 0]
+            assert abs(first - (100.0 * j + 2 * src_off)) < 1e-5, \
+                (r, j, first)
+            off += seg
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_alltoall_diag_selector():
+    """The skew threshold picks the diagonal path only when padding
+    would more than double the wire bytes."""
+    from horovod_tpu.ops.xla_ops import MeshExecutor  # noqa: F401
+
+    R = 8
+    balanced = [[4] * R for _ in range(R)]
+    skewed = [[1] * R for _ in range(R)]
+    skewed[0][1] = 64
+    for splits, want_diag in ((balanced, False), (skewed, True)):
+        max_seg = max(s for sp in splits for s in sp)
+        diag_max = [max(splits[r][(r + d) % R] for r in range(R))
+                    for d in range(R)]
+        assert (R * max_seg > 2 * sum(diag_max)) == want_diag, splits

@@ -116,8 +116,14 @@ class FusedBottleneckBlock(nn.Module):
             wp = self.param("conv_proj", kinit, (Cin, F * 4),
                             jnp.float32)
             xs = x[:, ::self.strides[0], ::self.strides[1], :]
-            yp, sp1, sp2 = conv1x1_bn(xs.reshape(-1, Cin),
-                                      wp.astype(self.dtype))
+            # strided projections route through the XLA matmul: the
+            # strided gather fuses into the dot's operand read there,
+            # while a pallas call would force the slice to materialize
+            # row-major first (measured ~1 ms/block on chip)
+            strided = self.strides != (1, 1)
+            yp, sp1, sp2 = conv1x1_bn(
+                xs.reshape(-1, Cin), wp.astype(self.dtype),
+                use_pallas=False if strided else None)
             ap, bp = bn(name="bn_proj")(sp1, sp2, yp.shape[0])
             res = yp.astype(jnp.float32) * ap + bp
         else:
@@ -171,6 +177,7 @@ class ResNet(nn.Module):
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
     fused: bool = False
+    s2d_stem: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -180,8 +187,26 @@ class ResNet(nn.Module):
                        momentum=0.9, epsilon=1e-5, dtype=self.dtype,
                        param_dtype=jnp.float32, axis_name=None)
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2),
-                 padding=[(3, 3), (3, 3)], name="conv_init")(x)
+        if self.s2d_stem:
+            # space-to-depth stem (the MLPerf ResNet trick): 2x2
+            # blocks fold into channels so the stem conv contracts
+            # over 4x4x12 = 192 inputs instead of 7x7x3 = 147 with 3
+            # channels underfeeding the MXU lanes.  Same receptive
+            # field and output grid as 7x7/s2 (a 7x7/s2 tap window
+            # spans exactly 4 s2d rows/cols); the 4x4x12 kernel spans
+            # a slightly larger function class — the MLPerf-accepted
+            # equivalence.  Measured SLOWER on the bench chip (0.53x —
+            # docs/benchmarks.md round-4 notes); kept for parts where
+            # the stem is the bottleneck.
+            B, H, W, C = x.shape
+            x = x.reshape(B, H // 2, 2, W // 2, 2, C)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(
+                B, H // 2, W // 2, 4 * C)
+            x = conv(self.num_filters, (4, 4), (1, 1),
+                     padding=[(2, 1), (2, 1)], name="conv_init")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2),
+                     padding=[(3, 3), (3, 3)], name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))

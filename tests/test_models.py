@@ -238,3 +238,59 @@ def test_kv_cache_decode_sampling_reproducible():
         gen(params, prompt)
     with pytest.raises(ValueError, match="max_seq_len"):
         make_generate_fn(model, max_new_tokens=20)(params, prompt)
+
+
+def test_s2d_stem_matches_7x7_conv():
+    """The space-to-depth stem is function-space equivalent to the
+    7x7/s2 conv: remapping a 7x7x3 kernel into the 4x4x12 layout
+    (w4[KY,KX,(dy,dx,c)] = w7[2KY+dy-1, 2KX+dx-1, c], zero where out
+    of range) reproduces the original conv output exactly."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 32, 32, 3).astype(np.float32)
+    w7 = rng.randn(7, 7, 3, 8).astype(np.float32) * 0.1
+
+    ref = lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w7), (2, 2),
+        [(3, 3), (3, 3)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    # remap weights into the s2d layout
+    w4 = np.zeros((4, 4, 12, 8), np.float32)
+    for KY in range(4):
+        for KX in range(4):
+            for dy in range(2):
+                for dx in range(2):
+                    ky, kx = 2 * KY + dy - 1, 2 * KX + dx - 1
+                    if 0 <= ky < 7 and 0 <= kx < 7:
+                        w4[KY, KX, dy * 6 + dx * 3: dy * 6 + dx * 3 + 3] \
+                            = w7[ky, kx]
+    B, H, W, C = x.shape
+    xs = x.reshape(B, H // 2, 2, W // 2, 2, C) \
+          .transpose(0, 1, 3, 2, 4, 5).reshape(B, H // 2, W // 2, 12)
+    got = lax.conv_general_dilated(
+        jnp.asarray(xs), jnp.asarray(w4), (1, 1),
+        [(2, 1), (2, 1)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_resnet_s2d_stem_trains():
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.models.resnet import ResNet
+
+    model = ResNet(stage_sizes=[1, 1], num_classes=5, num_filters=8,
+                   s2d_stem=True)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (2, 32, 32, 3), jnp.float32)
+    v = model.init(rng, x, train=False)
+    out, mut = model.apply(v, x, train=True, mutable=["batch_stats"])
+    assert out.shape == (2, 5)
+    # stem output grid matches the 7x7/s2 stem's
+    assert v["params"]["conv_init"]["kernel"].shape == (4, 4, 12, 8)

@@ -77,12 +77,34 @@ class RayExecutor:
       placement group — Ray Tune trials).
     """
 
+    @classmethod
+    def create_settings(cls, timeout_s=30, ssh_identity_file=None,
+                        ssh_str=None, placement_group_timeout_s=100,
+                        nics=None):
+        """Mini settings object (reference ray/runner.py:211): ssh
+        identity is used for multi-host worker spawns; nics are N/A on
+        TPU pods (kept for signature parity)."""
+        import os as _os
+
+        if ssh_str and ssh_identity_file \
+                and not _os.path.exists(ssh_identity_file):
+            with open(ssh_identity_file, "w") as f:
+                _os.chmod(ssh_identity_file, 0o600)
+                f.write(ssh_str)
+        return {"timeout_s": timeout_s,
+                "ssh_identity_file": ssh_identity_file,
+                "placement_group_timeout_s": placement_group_timeout_s,
+                "nics": nics}
+
     def __init__(self, settings=None, num_workers=None, num_hosts=None,
                  num_workers_per_host=1, cpus_per_worker=1,
                  use_gpu=False, gpus_per_worker=None,
                  use_current_placement_group=True,
                  placement_group_timeout_s=100, **kwargs):
         _require_ray()
+        if settings:
+            placement_group_timeout_s = settings.get(
+                "placement_group_timeout_s", placement_group_timeout_s)
         if num_workers is None and num_hosts is None:
             raise ValueError(
                 "set either num_workers (PACK) or num_hosts + "
@@ -217,6 +239,11 @@ class RayExecutor:
             self.strategy.shutdown()
         if getattr(self, "_server", None):
             self._server.stop()
+
+
+#: Reference export name (``horovod/ray/__init__.py`` re-exports the
+#: actor body as BaseHorovodWorker).
+BaseHorovodWorker = HorovodWorker
 
 
 class RayHostDiscovery:

@@ -1,0 +1,5 @@
+"""Launcher package.  ``horovod_tpu.runner.run`` mirrors the
+reference's programmatic entry (``horovod/runner/__init__.py:95``
+``horovod.run``); the CLI lives in :mod:`.launch`."""
+
+from .thread_launcher import run  # noqa: F401

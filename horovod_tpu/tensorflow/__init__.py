@@ -34,7 +34,7 @@ from ..ops.api import (  # noqa: F401
     grouped_allreduce, grouped_allreduce_async,
     allgather, allgather_async, grouped_allgather,
     grouped_allgather_async,
-    broadcast, broadcast_async,
+    broadcast, broadcast_async, broadcast_,
     alltoall, alltoall_async,
     reducescatter, reducescatter_async,
     grouped_reducescatter, grouped_reducescatter_async,

@@ -657,6 +657,27 @@ HYBRID_WORKER = textwrap.dedent("""
         g = hvd.allgather(np.full((1, 2), float(r), np.float32),
                           name="hg")
         assert g.shape == (4, 2)
+
+        # skewed alltoall across process boundaries: rank 0's huge
+        # segment to rank 1 routes through the diagonal ppermute
+        # schedule (R*max > 2*sum(diag_max) at 4 ranks) and must still
+        # deliver exact bytes end-to-end
+        splits = [1, 40, 1, 1] if r == 0 else [1, 1, 1, 1]
+        x = np.arange(sum(splits), dtype=np.float32) + 100.0 * r
+        out, recv = hvd.alltoall(x, splits=splits, name="skew")
+        want_recv = [40 if (r == 1 and j == 0) else 1
+                     for j in range(4)]
+        assert list(recv) == want_recv, (r, recv)
+        assert out.shape == (sum(want_recv),)
+        # the first element from each source is that source's send
+        # offset into its own buffer
+        off = 0
+        for j in range(4):
+            src_splits = [1, 40, 1, 1] if j == 0 else [1, 1, 1, 1]
+            src_off = sum(src_splits[:r])
+            assert abs(out[off] - (100.0 * j + src_off)) < 1e-6, \
+                (r, j, out[off])
+            off += want_recv[j]
         return r
 
     ranks = hvd.run(fn)     # np from the launcher's env contract

@@ -16,6 +16,7 @@ class BroadcastGlobalVariablesCallback(tf.keras.callbacks.Callback):
         self.root_rank = root_rank
         self.broadcast_done = False
         self._local_vars = set()
+        self._local_slot_frags = set()   # (name fragment, shape)
 
     def register_local_var(self, var):
         """Exclude ``var`` from the initial broadcast (reference
@@ -25,6 +26,15 @@ class BroadcastGlobalVariablesCallback(tf.keras.callbacks.Callback):
         from ..tensorflow import _var_key
 
         self._local_vars.add(_var_key(var))
+        # identity fragments for matching the var's OPTIMIZER slot
+        # variables (momentum/adam moments), which would otherwise be
+        # clobbered by root's broadcast just like the weight itself
+        name = getattr(var, "path", None) or getattr(var, "name", "")
+        name = str(name).split(":")[0]
+        if name:
+            self._local_slot_frags.add((name, tuple(var.shape)))
+            self._local_slot_frags.add(
+                (name.replace("/", "_"), tuple(var.shape)))
 
     def on_batch_end(self, batch, logs=None):
         if self.broadcast_done:
@@ -35,9 +45,24 @@ class BroadcastGlobalVariablesCallback(tf.keras.callbacks.Callback):
              if _var_key(v) not in self._local_vars], self.root_rank)
         if hasattr(self.model, "optimizer") and \
                 getattr(self.model.optimizer, "variables", None):
-            broadcast_variables(self.model.optimizer.variables,
-                                self.root_rank)
+            broadcast_variables(
+                [v for v in self.model.optimizer.variables
+                 if not self._is_local_slot(v)], self.root_rank)
         self.broadcast_done = True
+
+    def _is_local_slot(self, opt_var):
+        """Best-effort: an optimizer slot belongs to a local var when
+        its path embeds the var's name (keras slots are named from
+        their reference variable) and the shapes agree.  (The
+        reference broadcasts optimizer state unfiltered — clobbering
+        exactly the per-rank slots register_local_var protects.)"""
+        if not self._local_slot_frags:
+            return False
+        path = str(getattr(opt_var, "path", None)
+                   or getattr(opt_var, "name", "")).split(":")[0]
+        shape = tuple(opt_var.shape)
+        return any(frag in path and shape == fshape
+                   for frag, fshape in self._local_slot_frags)
 
 
 class MetricAverageCallback(tf.keras.callbacks.Callback):

@@ -32,8 +32,16 @@ def extract_xy(pdf, feature_cols, label_cols):
 
 def split_validation(x, y, x_val, y_val, validation):
     """Apply a float validation fraction when no explicit val set was
-    given (column-name validation is a DataFrame-path feature the
-    params layer rejects up front)."""
+    given.  Column-name validation only exists on the store-backed
+    DataFrame path (rows split at staging time) — reaching here with a
+    string means the caller took an array / store-less path that has
+    no such column, so fail loudly instead of silently training on
+    the validation rows."""
+    if isinstance(validation, str) and x_val is None:
+        raise ValueError(
+            f"validation by column name ({validation!r}) requires the "
+            "store-backed fit(df) path; array paths take a float "
+            "fraction or explicit x_val/y_val")
     if x_val is None and isinstance(validation, float):
         n_val = max(1, int(len(x) * validation))
         x, x_val = x[:-n_val], x[-n_val:]
@@ -114,13 +122,17 @@ def make_predict_partition_fn(model_blob, deserialize, predict_batch,
         def flush():
             if not buf:
                 return
-            x = np.asarray(
-                [[row[c] for c in feature_cols] for row in buf],
-                np.float32)
-            if x.ndim == 2 and len(feature_cols) == 1 \
-                    and np.ndim(buf[0][feature_cols[0]]) > 0:
-                # single array-valued feature column: drop the wrap
-                x = x[:, 0]
+            if len(feature_cols) == 1:
+                # single column: scalar values -> (N, 1), vector
+                # values -> (N, D)
+                x = np.asarray([row[feature_cols[0]] for row in buf],
+                               np.float32)
+                if x.ndim == 1:
+                    x = x[:, None]
+            else:
+                x = np.asarray(
+                    [[row[c] for c in feature_cols] for row in buf],
+                    np.float32)
             preds = np.asarray(predict_batch(model, x))
             for row, p in zip(buf, preds):
                 out = dict(row)
@@ -153,20 +165,14 @@ def transform_dataframe(df, predict_partition):
     return spark.createDataFrame(df.rdd.mapPartitions(part))
 
 
-def warn_driver_materialization(df, what, threshold=100_000):
+def warn_driver_materialization(df, what):
     """Store-less ``fit(df)`` funnels the DataFrame through the driver
-    (``toPandas``); warn when that is clearly not a toy (reference
-    jobs always stage through a Store)."""
+    (``toPandas``); warn unconditionally — counting rows first would
+    itself run the full Spark lineage on exactly the frames the
+    warning targets (reference jobs always stage through a Store)."""
     import warnings
 
-    try:
-        n = df.count()
-    except Exception:  # noqa: BLE001 — exotic frame; warn unconditionally
-        n = None
-    if n is None or n > threshold:
-        warnings.warn(
-            f"{what} without a Store materializes the whole DataFrame "
-            f"on the driver ({n or 'unknown'} rows); configure "
-            "store=... so executors stream Parquet instead",
-            RuntimeWarning, stacklevel=3)
-    return n
+    warnings.warn(
+        f"{what} without a Store materializes the whole DataFrame on "
+        "the driver; configure store=... so executors stream Parquet "
+        "instead", RuntimeWarning, stacklevel=3)

@@ -104,16 +104,20 @@ class KerasEstimator(EstimatorParams):
 
             hist_all = {}
             for epoch in range(est.epochs):
-                probe = make_batch_reader(
-                    train_path, schema_fields=schema,
-                    batch_size=est.batch_size, cur_shard=rank,
-                    shard_count=size)
-                # equalized step count: shards can differ by a row
-                # group; a lone extra gradient allreduce would
-                # deadlock (reference keras/remote.py steps_per_epoch)
-                n_local = -(-probe.num_rows // est.batch_size)
-                steps = est.train_steps_per_epoch or \
-                    synced_step_count(n_local, name=f"ksteps.{epoch}")
+                if est.train_steps_per_epoch:
+                    steps = est.train_steps_per_epoch
+                else:
+                    # equalized step count: shards can differ by a row
+                    # group; a lone extra gradient allreduce would
+                    # deadlock (reference keras/remote.py
+                    # steps_per_epoch)
+                    probe = make_batch_reader(
+                        train_path, schema_fields=schema,
+                        batch_size=est.batch_size, cur_shard=rank,
+                        shard_count=size)
+                    n_local = -(-probe.num_rows // est.batch_size)
+                    steps = synced_step_count(n_local,
+                                              name=f"ksteps.{epoch}")
                 fit_kw = {}
                 if val_path is not None:
                     vreader = make_batch_reader(

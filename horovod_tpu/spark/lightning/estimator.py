@@ -142,7 +142,7 @@ class LightningEstimator(EstimatorParams):
             df, self.store, self.feature_cols, self.label_cols,
             sample_weight_col=self.sample_weight_col,
             validation=self.validation)
-        return self.fit_on_parquet(train_path)
+        return self.fit_on_parquet(train_path, val_path)
 
     # -- training loops ------------------------------------------------------
 

@@ -133,16 +133,19 @@ class TorchEstimator(EstimatorParams):
             for epoch in range(est.epochs):
                 model.train()
                 total, count = 0.0, 0
-                probe = make_batch_reader(
-                    train_path, schema_fields=schema,
-                    batch_size=est.batch_size, cur_shard=rank,
-                    shard_count=size)
-                # every rank must run the SAME number of optimizer
-                # steps: shards can differ by a row group, and a lone
-                # extra gradient allreduce would deadlock the job
-                n_local = -(-probe.num_rows // est.batch_size)
-                steps = est.train_steps_per_epoch or \
-                    synced_step_count(n_local, name=f"steps.{epoch}")
+                if est.train_steps_per_epoch:
+                    steps = est.train_steps_per_epoch
+                else:
+                    # every rank must run the SAME number of optimizer
+                    # steps: shards can differ by a row group, and a
+                    # lone extra gradient allreduce would deadlock
+                    probe = make_batch_reader(
+                        train_path, schema_fields=schema,
+                        batch_size=est.batch_size, cur_shard=rank,
+                        shard_count=size)
+                    n_local = -(-probe.num_rows // est.batch_size)
+                    steps = synced_step_count(n_local,
+                                              name=f"steps.{epoch}")
                 batches = cycling_batches(epoch)
                 for _ in range(steps):
                     xb, yb, wb = batch_xyw(next(batches))

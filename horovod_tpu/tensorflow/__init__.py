@@ -282,6 +282,14 @@ class _GradSync:
 
         if tf.executing_eagerly():
             return tf.constant(gather_host(t.numpy()))
+        if _basics.engine().num_local > 1:
+            # same deadlock as the dense traced path: one TF runtime
+            # serializes py_function bodies, so rank THREADS blocking
+            # on each other's collectives hang
+            raise RuntimeError(
+                "tf.function-traced sparse collectives need one "
+                "process per rank (horovodrun/proc_run); with the "
+                "in-process thread launcher use run_eagerly=True")
         caller_ctx = _basics.context()
 
         def _bridge(x):

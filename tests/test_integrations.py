@@ -347,3 +347,31 @@ def test_mxnet_gated_names_raise_clear_importerror():
             getattr(hvdmx, name)
     with pytest.raises(AttributeError):
         hvdmx.not_a_real_name
+
+
+def test_partition_predict_vector_feature(hvd_shutdown):
+    """Single array-valued feature column (the default 'features'
+    layout): rows reach the model as (N, D), not (N, 1, D)."""
+    import torch
+
+    from horovod_tpu.spark.torch import TorchModel
+
+    lin = torch.nn.Linear(3, 1, bias=False)
+    with torch.no_grad():
+        lin.weight[:] = torch.tensor([[1.0, 2.0, 3.0]])
+    model = TorchModel(model=lin, feature_cols=["features"])
+    rows = [{"features": [float(i), 1.0, 0.0]} for i in range(4)]
+    out = list(model.make_predict_fn(batch_size=3)(iter(rows)))
+    assert [round(r["prediction"][0], 4) for r in out] == \
+        [2.0, 3.0, 4.0, 5.0]
+
+
+def test_split_validation_rejects_column_on_array_path():
+    from horovod_tpu.spark.common.util import split_validation
+
+    x = np.zeros((8, 2)); y = np.zeros((8, 1))
+    with pytest.raises(ValueError, match="store-backed"):
+        split_validation(x, y, None, None, "val_col")
+    # explicit val data short-circuits (the column is then unused)
+    xs, ys, xv, yv = split_validation(x, y, x[:2], y[:2], "val_col")
+    assert len(xv) == 2

@@ -628,3 +628,42 @@ def test_broadcast_callback_register_local_var(hvd_shutdown):
         return True
 
     assert all(run_ranks(fn))
+
+
+def test_broadcast_callback_skips_local_optimizer_slots(hvd_shutdown):
+    """Optimizer slot variables of a registered local var keep their
+    per-rank values through the initial broadcast (the reference
+    clobbers them — its optimizer broadcast is unfiltered)."""
+    def fn():
+        import horovod_tpu.keras as hvd_keras
+
+        r = hvd.rank()
+        inputs = tf.keras.Input((2,))
+        model = tf.keras.Model(
+            inputs, tf.keras.layers.Dense(1, name="d")(inputs))
+        opt = tf.keras.optimizers.SGD(0.1, momentum=0.9)
+        model.compile(optimizer=opt, loss="mse")
+        opt.build(model.trainable_variables)
+        dense = model.get_layer("d")
+        # per-rank momentum on the local var
+        for v in opt.variables:
+            path = str(getattr(v, "path", v.name))
+            if "bias" in path and "momentum" in path:
+                v.assign(tf.fill(v.shape, float(r + 5)))
+            elif "momentum" in path:
+                v.assign(tf.fill(v.shape, float(r + 1)))
+
+        cb = hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0)
+        cb.register_local_var(dense.bias)
+        cb.set_model(model)
+        cb.on_batch_end(0)
+
+        for v in opt.variables:
+            path = str(getattr(v, "path", v.name))
+            if "bias" in path and "momentum" in path:
+                assert np.allclose(v.numpy(), r + 5), (path, v.numpy())
+            elif "momentum" in path:
+                assert np.allclose(v.numpy(), 1.0), (path, v.numpy())
+        return True
+
+    assert all(run_ranks(fn))

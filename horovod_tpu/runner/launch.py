@@ -42,6 +42,11 @@ def parse_args(argv=None):
                         help="Seconds to wait for the job to finish "
                              "launching.")
     parser.add_argument("--verbose", action="store_true")
+    parser.add_argument("--output-filename", default=None,
+                        help="directory for per-rank output capture: "
+                             "worker stdout/stderr are saved to "
+                             "<dir>/rank.<rank>/{stdout,stderr} "
+                             "(rank zero-padded)")
     parser.add_argument("--config-file", dest="config_file",
                         help="YAML file with launcher parameters.")
     # tunables (reference launch.py:373-431)
@@ -112,7 +117,8 @@ def _run_static(args):
         ranks_per_proc=args.ranks_per_proc, env=env,
         platform="cpu" if args.cpu else None,
         verbose=args.verbose, fusion_threshold_bytes=fusion,
-        start_timeout=args.start_timeout)
+        start_timeout=args.start_timeout,
+        output_filename=args.output_filename)
     return max(codes) if codes else 0
 
 

@@ -199,9 +199,16 @@ def launch_procs(command: List[str], np: int, hosts: str = None,
                  ranks_per_proc: int = 1, env: dict = None,
                  platform: str = None, verbose: bool = False,
                  fusion_threshold_bytes: int = 64 * 1024 * 1024,
-                 start_timeout: float = None):
+                 start_timeout: float = None,
+                 output_filename: str = None):
     """Launch ``command`` once per slot with full env handoff; blocks
     until all workers exit.  Returns list of exit codes.
+
+    ``output_filename``: directory for per-rank output capture —
+    worker stdout/stderr land in ``<dir>/rank.<NN>/{stdout,stderr}``
+    (reference ``horovodrun --output-filename``, launch.py:332; rank
+    zero-padded the same way).  Remote workers' streams flow back
+    through their ssh client and are captured identically.
 
     Only localhost spawning is wired (subprocess); remote hosts would
     go through ssh exactly as the reference's exec_command
@@ -237,6 +244,8 @@ def launch_procs(command: List[str], np: int, hosts: str = None,
 
     pool = ProcessPool()
     hof = host_of_rank_env(slots)
+    out_files = []
+    pad = max(3, len(str(max(num_procs - 1, 0))))
     try:
         for slot in slots:
             child_env = dict(launcher_env)
@@ -257,9 +266,20 @@ def launch_procs(command: List[str], np: int, hosts: str = None,
             if verbose:
                 print(f"[horovodrun] rank {slot.rank} -> {cmd}",
                       file=sys.stderr)
-            pool.spawn(cmd, spawn_env, stdin_data=payload)
+            stdout = stderr = None
+            if output_filename:
+                d = os.path.join(output_filename,
+                                 f"rank.{slot.rank:0{pad}d}")
+                os.makedirs(d, exist_ok=True)
+                stdout = open(os.path.join(d, "stdout"), "wb")
+                stderr = open(os.path.join(d, "stderr"), "wb")
+                out_files += [stdout, stderr]
+            pool.spawn(cmd, spawn_env, stdout=stdout, stderr=stderr,
+                       stdin_data=payload)
         codes = pool.wait(timeout=start_timeout)
     finally:
         pool.terminate()
         server.stop()
+        for f in out_files:
+            f.close()
     return codes

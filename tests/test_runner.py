@@ -1002,3 +1002,30 @@ def test_coordinator_session_restart_preserves_peer_joins():
     out = c.handle("poll", {"cursor": 0, "proc": 0, "wait": 0})
     kinds = [r.get("kind") for r in out["responses"]]
     assert kinds.count("join_done") == 1, out["responses"]
+
+
+@pytest.mark.integration
+def test_output_filename_captures_per_rank(tmp_path):
+    """--output-filename saves each rank's stdout/stderr under
+    rank.<NN>/ (reference launch.py:332 contract, zero-padded)."""
+    from horovod_tpu.runner.proc_run import launch_procs
+
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent("""
+        import sys
+        import horovod_tpu as hvd
+        hvd.init()
+        print(f"OUT rank {hvd.rank()}")
+        print(f"ERR rank {hvd.rank()}", file=sys.stderr)
+        hvd.shutdown()
+    """))
+    outdir = tmp_path / "logs"
+    codes = launch_procs([sys.executable, str(script)], np=2,
+                         platform="cpu", env={"PYTHONPATH": REPO},
+                         start_timeout=120,
+                         output_filename=str(outdir))
+    assert codes == [0, 0]
+    for r in range(2):
+        d = outdir / f"rank.{r:03d}"
+        assert f"OUT rank {r}" in (d / "stdout").read_text()
+        assert f"ERR rank {r}" in (d / "stderr").read_text()

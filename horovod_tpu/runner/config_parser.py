@@ -39,6 +39,19 @@ def set_env_from_args(env: dict, args) -> dict:
     setb(HOROVOD_AUTOTUNE, getattr(args, "autotune", False))
     if getattr(args, "autotune_log_file", None):
         env[HOROVOD_AUTOTUNE_LOG] = args.autotune_log_file
+    if getattr(args, "autotune_warmup_samples", None) is not None:
+        env["HOROVOD_AUTOTUNE_WARMUP_SAMPLES"] = str(
+            args.autotune_warmup_samples)
+    if getattr(args, "autotune_steps_per_sample", None) is not None:
+        env["HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"] = str(
+            args.autotune_steps_per_sample)
+    if getattr(args, "autotune_bayes_opt_max_samples", None) is not None:
+        env["HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"] = str(
+            args.autotune_bayes_opt_max_samples)
+    if getattr(args, "disable_cache", False):
+        # capacity 0 disables the coordinator response cache entirely
+        # (reference --disable-cache -> HOROVOD_CACHE_CAPACITY=0)
+        env[HOROVOD_CACHE_CAPACITY] = "0"
     setb(HOROVOD_STALL_CHECK_DISABLE,
          getattr(args, "no_stall_check", False))
     if getattr(args, "stall_check_warning_time_seconds", None) is not None:

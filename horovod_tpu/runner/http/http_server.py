@@ -49,11 +49,16 @@ def autotune_kwargs(env=None):
     env = os.environ if env is None else env
     on = str(env.get("HOROVOD_AUTOTUNE", "")).strip().lower() \
         in ("1", "true", "yes", "on")
-    return {
+    kwargs = {
         "autotune": on,
         "autotune_log": env.get("HOROVOD_AUTOTUNE_LOG") or None,
         "cycle_time_ms": float(env.get("HOROVOD_CYCLE_TIME") or 1.0),
     }
+    cap = env.get("HOROVOD_CACHE_CAPACITY")
+    if cap is not None and str(cap).strip() != "":
+        # 0 = response cache disabled (--disable-cache)
+        kwargs["cache_capacity"] = int(cap)
+    return kwargs
 
 
 def _digest(secret: bytes, payload: bytes) -> str:

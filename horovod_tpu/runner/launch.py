@@ -59,6 +59,15 @@ def parse_args(argv=None):
     # autotune
     parser.add_argument("--autotune", action="store_true")
     parser.add_argument("--autotune-log-file", default=None)
+    parser.add_argument("--autotune-warmup-samples", type=int,
+                        default=None)
+    parser.add_argument("--autotune-steps-per-sample", type=int,
+                        default=None)
+    parser.add_argument("--autotune-bayes-opt-max-samples", type=int,
+                        default=None)
+    parser.add_argument("--disable-cache", action="store_true",
+                        help="disable the coordinator response cache "
+                             "(HOROVOD_CACHE_CAPACITY=0)")
     # stall check
     parser.add_argument("--no-stall-check", action="store_true")
     parser.add_argument("--stall-check-warning-time-seconds", type=float,

@@ -1029,3 +1029,30 @@ def test_output_filename_captures_per_rank(tmp_path):
         d = outdir / f"rank.{r:03d}"
         assert f"OUT rank {r}" in (d / "stdout").read_text()
         assert f"ERR rank {r}" in (d / "stderr").read_text()
+
+
+def test_disable_cache_and_autotune_flags():
+    """--disable-cache maps to HOROVOD_CACHE_CAPACITY=0 (honored by
+    the coordinator: capacity 0 assigns no cache ids) and the autotune
+    sampling knobs pass through (reference launch.py flag set)."""
+    args = parse_args(["-np", "2", "--disable-cache",
+                       "--autotune", "--autotune-warmup-samples", "1",
+                       "--autotune-steps-per-sample", "5",
+                       "--autotune-bayes-opt-max-samples", "9",
+                       "--", "python", "x.py"])
+    env = {}
+    set_env_from_args(env, args)
+    assert env["HOROVOD_CACHE_CAPACITY"] == "0"
+    assert env["HOROVOD_AUTOTUNE_WARMUP_SAMPLES"] == "1"
+    assert env["HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"] == "5"
+    assert env["HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"] == "9"
+
+    from horovod_tpu.runner.http.http_server import autotune_kwargs
+    kw = autotune_kwargs(env)
+    assert kw["cache_capacity"] == 0
+    c = Coordinator(world_size=1, fusion_threshold_bytes=10**6,
+                    cache_capacity=0)
+    c.handle("ready", {"proc": 0, "nlocal": 1,
+                       "entries": [_meta("a", nprocs=1)]})
+    out = c.handle("poll", {"cursor": 0, "proc": 0, "wait": 0})
+    assert not out["responses"][0].get("cache_ids"), out

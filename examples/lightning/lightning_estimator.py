@@ -1,0 +1,67 @@
+"""Lightning estimator workflow (reference
+``examples/spark/pytorch/pytorch_lightning_spark_mnist.py`` /
+``examples/pytorch/pytorch_lightning_mnist.py``): a
+LightningModule-shaped module — training_step / validation_step /
+configure_optimizers (with an lr-scheduler dict) / epoch hooks —
+trains across ranks through DistributedOptimizer.  Runs without
+pytorch_lightning installed (the hooks are duck-typed)."""
+
+import os as _os
+import sys as _sys
+
+# allow running straight from a source checkout
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.dirname(_os.path.abspath(__file__)))))
+
+
+import numpy as np
+import torch
+
+from horovod_tpu.spark.lightning import LightningEstimator
+
+
+class LitRegression(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.net = torch.nn.Sequential(
+            torch.nn.Linear(4, 16), torch.nn.ReLU(),
+            torch.nn.Linear(16, 1))
+
+    def forward(self, x):
+        return self.net(x)
+
+    def training_step(self, batch, batch_idx):
+        x, y = batch
+        loss = torch.nn.functional.mse_loss(self(x), y.reshape(-1, 1))
+        self.log("train_mse", loss.detach())
+        return {"loss": loss}
+
+    def validation_step(self, batch, batch_idx):
+        x, y = batch
+        return torch.nn.functional.mse_loss(self(x), y.reshape(-1, 1))
+
+    def configure_optimizers(self):
+        opt = torch.optim.Adam(self.parameters(), lr=0.01)
+        sched = torch.optim.lr_scheduler.StepLR(opt, step_size=5,
+                                                gamma=0.5)
+        return {"optimizer": opt,
+                "lr_scheduler": {"scheduler": sched,
+                                 "interval": "epoch"}}
+
+
+def main():
+    rs = np.random.RandomState(0)
+    x = rs.randn(512, 4).astype(np.float32)
+    y = (x @ rs.randn(4, 1).astype(np.float32)).ravel()
+
+    est = LightningEstimator(model=LitRegression(), batch_size=32,
+                             epochs=10, num_proc=2, validation=0.2)
+    model = est.fit_arrays(x, y)
+    for entry in model.history:
+        print(entry)
+    preds = model.transform_arrays(x[:4])
+    print("predictions:", preds.ravel())
+
+
+if __name__ == "__main__":
+    main()

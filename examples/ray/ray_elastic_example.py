@@ -1,0 +1,53 @@
+"""Elastic training on Ray (reference
+``examples/ray/pytorch_ray_elastic.py``): ElasticRayExecutor
+discovers slots from the Ray autoscaler, spawns a worker per slot,
+and re-forms the job when membership changes.  Lifecycle callbacks
+receive every round event (round_start / hosts_updated /
+worker_start / worker_exit)."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.dirname(_os.path.abspath(__file__)))))
+
+
+def training_fn():
+    import numpy as np
+
+    import horovod_tpu as hvd
+    import horovod_tpu.elastic as elastic
+
+    hvd.init()
+    state = elastic.ObjectState(
+        bcast_object=hvd.broadcast_object, get_rank=hvd.rank,
+        batch=0)
+
+    @elastic.run
+    def train(state):
+        while state.batch < 100:
+            grad = np.ones(4, np.float32) * hvd.rank()
+            hvd.allreduce(grad, op=hvd.Average,
+                          name=f"step{state.batch}")
+            state.batch += 1
+            if state.batch % 10 == 0:
+                state.commit()
+
+    train(state)
+    print(f"rank {hvd.rank()} done at size {hvd.size()}")
+
+
+def main():
+    from horovod_tpu.ray import ElasticRayExecutor
+
+    settings = ElasticRayExecutor.create_settings(
+        min_np=1, max_np=4, elastic_timeout=600)
+    executor = ElasticRayExecutor(settings)
+    executor.start()
+    executor.run(training_fn,
+                 callbacks=[lambda event: print("event:", event)])
+    executor.shutdown()
+
+
+if __name__ == "__main__":
+    main()

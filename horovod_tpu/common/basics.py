@@ -76,6 +76,10 @@ def _elastic_rendezvous(rdv_addr, rdv_port, secret):
             _time.sleep(0.5)
             continue
         _elastic_round = info["round"]
+        # round-formation marker: the driver's elastic_timeout watches
+        # these to distinguish a forming round from a stuck one
+        client.put(f"/elastic/joined/{info['round']}/"
+                   f"{info['assignments'][identity]}", b"1")
         return (info["assignments"][identity], info["size"],
                 info["coordinator"], info["round"])
     raise HorovodInitError("timed out waiting for elastic rendezvous")

@@ -40,7 +40,8 @@ from ..http.http_server import free_port as _free_port
 class ElasticDriver:
     def __init__(self, server, discovery, min_np, max_np, command,
                  env=None, reset_limit=None, cooldown_range=None,
-                 platform=None, verbose=False, on_event=None):
+                 platform=None, verbose=False, on_event=None,
+                 elastic_timeout=600):
         self._server = server            # RendezvousServer (KV + coord)
         self._host_manager = HostManager(discovery, cooldown_range)
         self._min_np = min_np
@@ -54,6 +55,12 @@ class ElasticDriver:
         # {"event": "round_start", ...}; exceptions are logged, never
         # fatal to the driver
         self._on_event = on_event
+        # bound on each round's (re-)initialization — how long workers
+        # may take to rendezvous after a reset before the round is
+        # declared stuck and restarted (reference --elastic-timeout,
+        # launch.py: "timeout for elastic initialisation after
+        # re-scaling the cluster"); never bounds healthy training
+        self._elastic_timeout = elastic_timeout
 
         self._registry = WorkerStateRegistry(self, self._host_manager,
                                              reset_limit=reset_limit)
@@ -281,10 +288,52 @@ class ElasticDriver:
                 self._start_round()
             self._shutdown.wait(DISCOVER_HOSTS_FREQUENCY_SECS)
 
+    def _round_joined(self):
+        """How many of this round's workers picked up the rendezvous
+        (the /elastic/joined markers workers write on re-init)."""
+        store = self._server.store
+        return sum(
+            1 for rank in range(len(self._assignments))
+            if store.get(f"/elastic/joined/{self._round}/{rank}",
+                         timeout=0.01) is not None)
+
+    def _check_round_formation(self, now):
+        """A round whose workers never all rendezvous within
+        elastic_timeout is stuck (hung worker, stale state): terminate
+        its processes and start a fresh round, burning one reset
+        (reference --elastic-timeout role, launch.py)."""
+        if not self._elastic_timeout or not self._assignments:
+            return
+        if (now - self._round_started_at) <= self._elastic_timeout:
+            return
+        joined = self._round_joined()
+        size = len(self._assignments)
+        if joined >= size:
+            return
+        logger.warning(
+            "round %d never formed within %.0fs (%d/%d workers "
+            "rendezvoused); restarting the round", self._round,
+            self._elastic_timeout, joined, size)
+        with self._lock:
+            for key in list(self._assignments):
+                p = self._procs.pop(key, None)
+                if p is not None and p.poll() is None:
+                    p.terminate()
+                    try:
+                        p.wait(timeout=5)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+            if not self._registry.note_reset():
+                self.stop(error=True)
+                return
+            self._host_manager.update_available_hosts()
+            self._start_round()
+
     def _monitor_workers(self):
         while not self._shutdown.is_set():
             failed_hosts = []
             now = time.monotonic()
+            self._check_round_formation(now)
             rid_before = self._registry.last_rendezvous()
             with self._lock:
                 # reap grace-expired de-assigned workers

@@ -40,7 +40,8 @@ fn(*a, **kw)
 
 def run_elastic_fn(fn, args=(), kwargs=None, *, discovery, min_np,
                    max_np=None, env=None, reset_limit=None,
-                   start_timeout=None, verbose=False, callbacks=None):
+                   start_timeout=None, verbose=False, callbacks=None,
+                   elastic_timeout=600):
     """Run ``fn(*args, **kwargs)`` on every elastic worker.
 
     ``discovery`` provides ``find_available_hosts_and_slots()``;
@@ -93,7 +94,8 @@ def run_elastic_fn(fn, args=(), kwargs=None, *, discovery, min_np,
                                max_np=max_np or min_np, command=command,
                                env=dict(env or {}),
                                reset_limit=reset_limit, verbose=verbose,
-                               on_event=on_event)
+                               on_event=on_event,
+                               elastic_timeout=elastic_timeout)
         driver.start(start_timeout=start_timeout)
         ok = driver.join()
     finally:

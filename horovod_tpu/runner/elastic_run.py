@@ -38,7 +38,8 @@ def run_elastic(args):
         server, discovery, min_np=min_np, max_np=max_np,
         command=args.command, env=env, reset_limit=args.reset_limit,
         cooldown_range=cooldown,
-        platform="cpu" if args.cpu else None, verbose=args.verbose)
+        platform="cpu" if args.cpu else None, verbose=args.verbose,
+        elastic_timeout=getattr(args, "elastic_timeout", 600))
     try:
         # --start-timeout bounds waiting for min_np slots, NOT the job
         # runtime (reference launch_gloo_elastic semantics)

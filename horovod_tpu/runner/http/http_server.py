@@ -241,6 +241,13 @@ class Coordinator:
         if self._autotuner is not None:
             self._autotuner.close()
 
+    def procs_seen(self) -> int:
+        """How many worker processes have polled this round — the
+        round-formation signal the elastic driver's re-init timeout
+        watches."""
+        with self._lock:
+            return len(self._cursors)
+
     def reset(self, world_size: int, round_id: int = 0):
         """New elastic round: fresh negotiation state; stale-round
         requests are rejected (reference: a new gloo context per

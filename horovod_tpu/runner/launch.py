@@ -83,6 +83,11 @@ def parse_args(argv=None):
     parser.add_argument("--host-discovery-script", default=None)
     parser.add_argument("--slots-per-host", type=int, default=None)
     parser.add_argument("--reset-limit", type=int, default=None)
+    parser.add_argument("--elastic-timeout", type=float, default=600,
+                        help="bound on each round's (re-)initialization "
+                             "after a membership change; a round whose "
+                             "workers never all rendezvous restarts "
+                             "(never bounds healthy training)")
     parser.add_argument("--blacklist-cooldown-range", type=int, nargs=2,
                         default=None)
     parser.add_argument("command", nargs=argparse.REMAINDER,

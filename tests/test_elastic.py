@@ -610,3 +610,22 @@ def test_elastic_multi_round_soak_real_backend(tmp_path):
     codes = launch_procs([sys.executable, str(script)], np=1,
                          platform=None, env=env, start_timeout=600)
     assert codes == [0]
+
+
+@pytest.mark.integration
+def test_elastic_timeout_restarts_stuck_round(tmp_path):
+    """--elastic-timeout (reference launch.py): a round whose workers
+    never rendezvous (hung worker) is terminated and restarted,
+    burning a reset; with reset_limit exhausted the job exits nonzero
+    instead of hanging forever."""
+    worker = tmp_path / "worker.py"
+    worker.write_text("import time\ntime.sleep(3600)\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "-np", "1", "--min-np", "1", "--max-np", "1", "--cpu",
+         "-H", "localhost:1", "--elastic-timeout", "4",
+         "--reset-limit", "1", "--start-timeout", "60",
+         "--", sys.executable, str(worker)],
+        env={**os.environ, "PYTHONPATH": REPO},
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode != 0

@@ -334,6 +334,7 @@ class ElasticRayExecutor:
             env=dict(self.env_vars),
             reset_limit=self.settings.get("reset_limit"),
             start_timeout=self.settings.get("elastic_timeout"),
+            elastic_timeout=self.settings.get("elastic_timeout") or 600,
             callbacks=callbacks)
 
     def shutdown(self):

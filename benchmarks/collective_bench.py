@@ -87,14 +87,136 @@ def worker(sizes_mb, small_count, iters):
     return out
 
 
+def proc_worker(small_count, iters):
+    """Runs inside one launcher-spawned process: the store-controller
+    (coordinator) negotiation path the thread launcher bypasses."""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    out = {"np": hvd.size()}
+
+    # steady-state negotiated cycle latency: one small sequential op
+    # per cycle.  The reference's claim is a cached cycle costs two
+    # bitvector allreduces (response_cache.h:107-169); here it is one
+    # ready-report POST + one long-poll wake per process.
+    x = np.ones(1024, np.float32)
+    for i in range(6):
+        hvd.allreduce(x, op=hvd.Sum, name=f"lat.w{i % 2}")
+    t0 = time.perf_counter()
+    lat_iters = 40
+    for i in range(lat_iters):
+        hvd.allreduce(x, op=hvd.Sum, name=f"lat.{i % 2}")
+    out["eager_cycle_latency_ms"] = round(
+        (time.perf_counter() - t0) / lat_iters * 1e3, 2)
+
+    # eager fused allreduce goodput: 64 KiB x small_count burst
+    small = [np.ones(64 * 1024 // 4, np.float32)
+             for _ in range(small_count)]
+    for i in range(2):
+        hs = [hvd.allreduce_async(t, op=hvd.Sum, name=f"w.{i}.{j}")
+              for j, t in enumerate(small)]
+        [hvd.synchronize(h) for h in hs]
+    t0 = time.perf_counter()
+    for i in range(iters):
+        hs = [hvd.allreduce_async(t, op=hvd.Sum, name=f"s.{i % 2}.{j}")
+              for j, t in enumerate(small)]
+        [hvd.synchronize(h) for h in hs]
+    dt = time.perf_counter() - t0
+    total_mb = small_count * 64 / 1024 * iters
+    out["fused_small_64k_MBps"] = round(total_mb / dt, 1)
+
+    # allgather: fused burst of small tensors vs ONE equal-bytes
+    # gather (VERDICT r5 item 5 'fused ~ single-large for allgather')
+    rows = 64 * 1024 // 8
+    ag_small = [np.ones((rows, 2), np.float32)
+                for _ in range(small_count)]
+    for i in range(2):
+        hs = [hvd.allgather_async(t, name=f"agw.{i}.{j}")
+              for j, t in enumerate(ag_small)]
+        [hvd.synchronize(h) for h in hs]
+    t0 = time.perf_counter()
+    for i in range(iters):
+        hs = [hvd.allgather_async(t, name=f"ag.{i % 2}.{j}")
+              for j, t in enumerate(ag_small)]
+        [hvd.synchronize(h) for h in hs]
+    dt = time.perf_counter() - t0
+    out["allgather_fused_small_MBps"] = round(total_mb / dt, 1)
+
+    big = np.ones((rows * small_count, 2), np.float32)
+    for i in range(2):
+        hvd.allgather(big, name=f"agbw.{i}")
+    t0 = time.perf_counter()
+    for i in range(iters):
+        hvd.allgather(big, name=f"agb.{i % 2}")
+    dt = time.perf_counter() - t0
+    out["allgather_single_large_MBps"] = round(total_mb / dt, 1)
+
+    from horovod_tpu.common import basics
+    out["fused_allgather_runs"] = basics.engine().fused_allgather_runs
+    if r == 0:
+        dest = os.environ.get("CB_OUT")
+        payload = json.dumps(out)
+        if dest:
+            with open(dest, "w") as f:
+                f.write(payload)
+        print(payload)
+    hvd.shutdown()
+
+
+def run_proc_curve(np_list, small_count, iters):
+    """Spawn the real launcher at each process count and collect the
+    coordinator-path numbers (VERDICT r5 item 3: negotiation-overhead
+    scaling curve)."""
+    import tempfile
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    from horovod_tpu.runner.proc_run import launch_procs
+
+    results = []
+    for n in np_list:
+        with tempfile.TemporaryDirectory() as td:
+            dest = os.path.join(td, "out.json")
+            env = {"PYTHONPATH": repo, "CB_OUT": dest,
+                   "CB_WORKER": "1",
+                   "CB_SMALL_COUNT": str(small_count),
+                   "CB_ITERS": str(iters)}
+            codes = launch_procs(
+                [sys.executable, os.path.abspath(__file__)], np=n,
+                platform="cpu", env=env, start_timeout=300)
+            if any(codes):
+                results.append({"np": n, "error": f"exit {codes}"})
+                continue
+            with open(dest) as f:
+                results.append(json.load(f))
+    for row in results:
+        print(json.dumps(row))
+    return results
+
+
 def main():
+    if os.environ.get("CB_WORKER"):
+        proc_worker(int(os.environ.get("CB_SMALL_COUNT", "64")),
+                    int(os.environ.get("CB_ITERS", "5")))
+        return
+
     p = argparse.ArgumentParser()
     p.add_argument("--np", type=int, default=1)
     p.add_argument("--cpu", action="store_true")
     p.add_argument("--sizes-mb", default="1,16,64")
     p.add_argument("--small-count", type=int, default=64)
     p.add_argument("--iters", type=int, default=5)
+    p.add_argument("--proc-curve", default=None,
+                   help="comma list of process counts, e.g. 1,2,4,8: "
+                        "run the REAL launcher + coordinator at each "
+                        "and print one JSON row per count")
     args = p.parse_args()
+
+    if args.proc_curve:
+        run_proc_curve([int(x) for x in args.proc_curve.split(",")],
+                       args.small_count, args.iters)
+        return
 
     if args.cpu:
         os.environ["HOROVOD_TPU_PLATFORM"] = "cpu"

@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Headline LM benchmark: a 436M-param decoder trained THROUGH the
+framework's compiled train step, reported as tok/s and MFU.
+
+The reference's headline protocol is synthetic throughput through
+``DistributedOptimizer`` (``docs/benchmarks.rst:15-63``); this is the
+same idea on the matmul-dominated workload TPUs are built for: a
+properly-sized Transformer (d_model 1024, 24 layers, head_dim 128,
+SwiGLU d_ff 4096, vocab 32k, S=2048, bf16, remat with the
+dots-saveable policy, pallas flash attention) through
+``hvd.make_compiled_train_step`` — engine up, process set 0's
+executor staging, fwd+bwd+reduce+update as one XLA program.
+
+MFU convention: model FLOPs = 6 * (matmul params incl. the logits
+projection) + causal attention matmuls, with NO credit for remat
+recompute — divided by the chip's measured bf16 matmul peak
+(141 TFLOP/s on this part, docs/benchmarks.md).
+
+    python benchmarks/lm_mfu_bench.py
+    python benchmarks/lm_mfu_bench.py --raw   # plain-jit ceiling too
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+MEASURED_PEAK_TFLOPS = 141.0          # docs/benchmarks.md matmul probe
+
+# headline config: ~436M params (402.7M block + 32.8M embedding)
+HEADLINE = dict(vocab_size=32000, d_model=1024, n_layers=24, n_heads=8,
+                d_ff=4096, max_seq_len=2048)
+HEADLINE_BATCH = 5                    # best measured on 16G HBM
+
+
+def lm_train_flops_per_token(cfg):
+    """MFU-convention FLOPs/token: 6*(block + logits matmul params) +
+    fwd/bwd causal-attention matmuls; remat recompute NOT counted."""
+    d, f, L, V = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab_size
+    n_block = L * (4 * d * d + 3 * d * f)
+    n_logits = V * d
+    attn = 6 * L * cfg.max_seq_len * d * 0.5    # causal halves it
+    return 6 * (n_block + n_logits) + attn
+
+
+def build(args):
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.models import TransformerConfig
+
+    cfg = TransformerConfig(dtype=jnp.bfloat16, remat=True,
+                            remat_policy="dots", **HEADLINE)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (args.batch, cfg.max_seq_len), 0,
+        cfg.vocab_size)
+    return cfg, tokens
+
+
+def bench_framework(cfg, tokens, iters, warmup):
+    """Through hvd.make_compiled_train_step (the user path)."""
+    import jax
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import TransformerLM, lm_loss
+    from horovod_tpu.ops.pallas_kernels import flash_attention
+
+    hvd.init()
+    model = TransformerLM(cfg, attention_fn=flash_attention)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 tokens)["params"]
+
+    def loss_fn(params, batch):
+        logits = model.apply({"params": params}, batch)
+        return lm_loss(logits[:, :-1], batch[:, 1:])
+
+    step = hvd.make_compiled_train_step(loss_fn, optax.adamw(1e-3))
+    state = step.init_state(params)
+    staged = step.place_batch(tokens)
+    for _ in range(warmup):
+        state, loss = step(state, staged)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = step(state, staged)
+    lv = float(loss)
+    dt = time.perf_counter() - t0
+    hvd.shutdown()
+    return tokens.size * iters / dt, lv
+
+
+def bench_raw(cfg, tokens, iters, warmup):
+    """Plain-jit ceiling (make_lm_train_step, no engine)."""
+    import jax
+    import optax
+
+    from horovod_tpu.parallel import MeshSpec, build_mesh, \
+        make_lm_train_step
+
+    mesh = build_mesh(MeshSpec(dp=1), jax.devices()[:1])
+    init, _, jit_step, tok_shd = make_lm_train_step(
+        mesh, cfg, optimizer=optax.adamw(1e-3),
+        attention_impl="flash")
+    state = init(jax.random.PRNGKey(0), tokens)
+    compiled, state = jit_step(state)
+    toks = jax.device_put(tokens, tok_shd)
+    for _ in range(warmup):
+        state, loss = compiled(state, toks)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = compiled(state, toks)
+    float(loss)
+    dt = time.perf_counter() - t0
+    return tokens.size * iters / dt
+
+
+def make_report(tps, loss, cfg):
+    """The headline metric dict — shared by this CLI and bench.py so
+    the MFU convention and metric key cannot drift apart."""
+    fpt = lm_train_flops_per_token(cfg)
+    return {
+        "metric": "lm436m_train_tokens_per_sec_per_chip_hvd",
+        "value": round(tps, 1),
+        "unit": "tokens/sec",
+        "loss": round(loss, 4),
+        "model_tflops_per_sec": round(tps * fpt / 1e12, 2),
+        "mfu_vs_measured_peak_pct": round(
+            100 * tps * fpt / 1e12 / MEASURED_PEAK_TFLOPS, 1),
+        "flops_per_token_g": round(fpt / 1e9, 3),
+        "peak_tflops": MEASURED_PEAK_TFLOPS,
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=HEADLINE_BATCH)
+    p.add_argument("--iters", type=int, default=12)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--raw", action="store_true",
+                   help="also measure the plain-jit ceiling")
+    args = p.parse_args()
+
+    cfg, tokens = build(args)
+    tps, loss = bench_framework(cfg, tokens, args.iters, args.warmup)
+    out = make_report(tps, loss, cfg)
+    if args.raw:
+        raw = bench_raw(cfg, tokens, args.iters, args.warmup)
+        out["raw_jax_tokens_per_sec"] = round(raw, 1)
+        out["framework_fraction_of_raw"] = round(tps / raw, 4)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Bisect the big-LM step time into components on one chip.
+
+Times, per variant, the full train step (fwd+bwd+adamw) through
+``make_lm_train_step`` and prints tok/s + model TFLOP/s (MFU
+convention: 6*N_matmul + causal-attention FLOPs, NO remat recompute
+credit) so the expensive part is attributable.
+
+    python benchmarks/mfu_bisect.py --variants base,novocab,dense
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def model_flops_per_token(cfg):
+    """MFU-convention FLOPs/token: 6*(block+logit matmul params)
+    + fwd/bwd causal attention matmuls (no remat recompute)."""
+    d, f, L, V = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab_size
+    n_block = L * (4 * d * d + 3 * d * f)
+    n_logits = V * d
+    attn = 6 * L * cfg.max_seq_len * d * 0.5   # causal halves the work
+    return 6 * (n_block + n_logits) + attn
+
+
+def time_step(cfg, mesh, tokens, impl, iters, warmup):
+    from horovod_tpu.parallel import make_lm_train_step
+    init, _, jit_step, tok_shd = make_lm_train_step(
+        mesh, cfg, optimizer=optax.adamw(1e-3), attention_impl=impl)
+    state = init(jax.random.PRNGKey(0), tokens)
+    compiled, state = jit_step(state)
+    toks = jax.device_put(tokens, tok_shd)
+    for _ in range(warmup):
+        state, loss = compiled(state, toks)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = compiled(state, toks)
+    float(loss)
+    dt = time.perf_counter() - t0
+    return tokens.size * iters / dt
+
+
+def time_attn_only(cfg, B, iters):
+    """Standalone flash fwd+bwd at the model's shapes, scanned in-jit."""
+    from horovod_tpu.ops.pallas_kernels import flash_attention
+    S, H, D = cfg.max_seq_len, cfg.n_heads, cfg.head_dim
+    q = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, D),
+                          jnp.bfloat16)
+
+    def one(q):
+        def loss(q):
+            return jnp.sum(flash_attention(q, q, q).astype(jnp.float32))
+        return jax.grad(loss)(q)
+
+    @jax.jit
+    def loop(q):
+        def body(carry, _):
+            return carry + 1e-6 * one(q), None
+        out, _ = jax.lax.scan(body, q, None, length=iters)
+        return jnp.sum(out.astype(jnp.float32))
+
+    float(loop(q))                     # compile + run once
+    t0 = time.perf_counter()
+    float(loop(q))
+    dt = time.perf_counter() - t0
+    return B * S * iters / dt
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--seq", type=int, default=2048)
+    p.add_argument("--d-model", type=int, default=1024)
+    p.add_argument("--layers", type=int, default=24)
+    p.add_argument("--heads", type=int, default=16)
+    p.add_argument("--vocab", type=int, default=32000)
+    p.add_argument("--iters", type=int, default=8)
+    p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--peak-tflops", type=float, default=141.0)
+    p.add_argument("--variants",
+                   default="base,novocab,dense,noremat,attn")
+    args = p.parse_args()
+
+    from horovod_tpu.models import TransformerConfig
+    from horovod_tpu.parallel import MeshSpec, build_mesh
+
+    def cfg_for(vocab, remat):
+        return TransformerConfig(
+            vocab_size=vocab, d_model=args.d_model,
+            n_layers=args.layers, n_heads=args.heads,
+            d_ff=4 * args.d_model, max_seq_len=args.seq,
+            dtype=jnp.bfloat16, remat=remat)
+
+    mesh = build_mesh(MeshSpec(dp=1), jax.devices()[:1])
+    base_cfg = cfg_for(args.vocab, True)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (args.batch, args.seq), 0, 2000)
+
+    fpt = model_flops_per_token(base_cfg)
+    out = {"flops_per_token_g": round(fpt / 1e9, 3)}
+    for v in args.variants.split(","):
+        v = v.strip()
+        try:
+            if v == "base":
+                tps = time_step(base_cfg, mesh, tokens, "flash",
+                                args.iters, args.warmup)
+            elif v == "novocab":
+                tps = time_step(cfg_for(2048, True), mesh, tokens,
+                                "flash", args.iters, args.warmup)
+            elif v == "dense":
+                tps = time_step(base_cfg, mesh, tokens, "ring",
+                                args.iters, args.warmup)
+            elif v == "noremat":
+                tps = time_step(cfg_for(args.vocab, False), mesh,
+                                tokens, "flash", args.iters,
+                                args.warmup)
+            elif v == "attn":
+                tps = time_attn_only(base_cfg, args.batch, args.iters)
+                out["attn_tokens_per_sec"] = round(tps, 1)
+                continue
+            else:
+                continue
+        except Exception as e:  # noqa: BLE001
+            out[f"{v}_error"] = str(e)[:200]
+            continue
+        vf = model_flops_per_token(
+            cfg_for(2048 if v == "novocab" else args.vocab, True))
+        out[f"{v}_tokens_per_sec"] = round(tps, 1)
+        out[f"{v}_tflops"] = round(tps * vf / 1e12, 2)
+        out[f"{v}_mfu_pct"] = round(
+            100 * tps * vf / 1e12 / args.peak_tflops, 1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -135,6 +135,7 @@ def init(comm=None, process_sets=None, num_ranks=None, devices=None):
         controller = None
         rank_offset = 0
         global_size = None
+        ranks_of_proc = None
         multiproc = env_mod.get_str(env_mod.HOROVOD_CONTROLLER) == "http"
         if num_ranks is None:
             num_ranks = env_mod.get_int(env_mod.HOROVOD_TPU_RANKS_PER_PROC, 0)
@@ -174,8 +175,24 @@ def init(comm=None, process_sets=None, num_ranks=None, devices=None):
                         "HOROVOD_TPU_INIT_TIMEOUT", 60))
                 global _distributed_up
                 _distributed_up = True
-            global_size = num_procs * num_ranks
-            rank_offset = proc_id * num_ranks
+            # heterogeneous host:slots jobs (reference -H h1:4,h2:2,
+            # gloo_run.py:66-103) carry per-process rank counts; the
+            # uniform path is the table [num_ranks] * num_procs
+            rop = env_mod.get_str("HOROVOD_TPU_RANKS_OF_PROC")
+            ranks_of_proc = None
+            if rop:
+                ranks_of_proc = [int(x) for x in rop.split(",")]
+                if len(ranks_of_proc) != num_procs:
+                    raise HorovodInitError(
+                        f"HOROVOD_TPU_RANKS_OF_PROC has "
+                        f"{len(ranks_of_proc)} entries for "
+                        f"{num_procs} processes (stale environment?)")
+                num_ranks = ranks_of_proc[proc_id]
+                global_size = sum(ranks_of_proc)
+                rank_offset = sum(ranks_of_proc[:proc_id])
+            else:
+                global_size = num_procs * num_ranks
+                rank_offset = proc_id * num_ranks
             controller = StoreController(
                 rdv_addr, rdv_port, secret, proc_id, num_procs,
                 num_ranks, round_id=round_id)
@@ -187,6 +204,7 @@ def init(comm=None, process_sets=None, num_ranks=None, devices=None):
                     f"multi-process mode needs one device per rank: "
                     f"{len(devices)} devices < {global_size} ranks")
             hof = env_mod.get_str("HOROVOD_TPU_HOST_OF_RANK")
+            counts = ranks_of_proc or [num_ranks] * num_procs
             if hof:
                 # launcher's true host layout (one entry per process):
                 # multiple processes on one host share local_rank space
@@ -196,11 +214,11 @@ def init(comm=None, process_sets=None, num_ranks=None, devices=None):
                         f"HOROVOD_TPU_HOST_OF_RANK has "
                         f"{len(host_of_proc)} entries for {num_procs} "
                         f"processes (stale environment?)")
-                host_of_rank = [host_of_proc[r // num_ranks]
-                                for r in range(global_size)]
             else:
-                host_of_rank = [r // num_ranks
-                                for r in range(global_size)]
+                host_of_proc = list(range(num_procs))
+            host_of_rank = [host_of_proc[p]
+                            for p in range(num_procs)
+                            for _ in range(counts[p])]
             _topology = Topology(size=global_size,
                                  host_of_rank=host_of_rank)
         else:
@@ -222,7 +240,8 @@ def init(comm=None, process_sets=None, num_ranks=None, devices=None):
         _engine = Engine(num_ranks, devices, config=config,
                          topology=_topology, timeline=_timeline,
                          controller=controller, rank_offset=rank_offset,
-                         global_size=global_size)
+                         global_size=global_size,
+                         ranks_of_proc=ranks_of_proc)
         if process_sets:
             from . import process_sets as ps_mod
             for ps in process_sets:

@@ -105,13 +105,27 @@ class Engine:
 
     def __init__(self, num_ranks, devices, config=None, topology=None,
                  timeline=None, controller=None, rank_offset=0,
-                 global_size=None):
+                 global_size=None, ranks_of_proc=None):
         from ..ops.xla_ops import MeshExecutor
 
         self.config = config or env_mod.Config()
         self.num_local = num_ranks
         self.global_size = global_size if global_size else num_ranks
         self.rank_offset = rank_offset
+        # per-process rank counts for heterogeneous host:slots jobs
+        # (reference -H h1:4,h2:2); None => uniform num_local per proc
+        self.ranks_of_proc = list(ranks_of_proc) if ranks_of_proc \
+            else None
+        if self.ranks_of_proc:
+            starts, acc = [], 0
+            for n in self.ranks_of_proc:
+                starts.append(acc)
+                acc += n
+            if acc != self.global_size:
+                raise ValueError(
+                    f"ranks_of_proc sums to {acc} but global size is "
+                    f"{self.global_size}")
+            self._proc_starts = starts
         self.devices = list(devices)
         self.topology = topology
         self.controller = controller
@@ -148,6 +162,8 @@ class Engine:
         self._arena = _native.Arena()
 
         self._stall_warned = set()
+        #: fused-allgather buckets executed (observability + tests)
+        self.fused_allgather_runs = 0
         self._thread = threading.Thread(
             target=self._background_loop, name="horovod_tpu-engine",
             daemon=True)
@@ -169,8 +185,12 @@ class Engine:
         return range(self.rank_offset, self.rank_offset + self.num_local)
 
     def _proc_of(self, global_rank):
-        """Hosting process of a global rank (uniform slots-per-process,
-        enforced by the launcher)."""
+        """Hosting process of a global rank: table lookup for
+        heterogeneous host:slots jobs, integer division for the
+        uniform layout the launcher otherwise enforces."""
+        if self.ranks_of_proc:
+            from bisect import bisect_right
+            return bisect_right(self._proc_starts, global_rank) - 1
         return global_rank // self.num_local
 
     def _make_process_set_state(self, ps_id, ranks):
@@ -187,10 +207,36 @@ class Engine:
     def _devices_for(self, ranks):
         nd = len(self.devices)
         if self.multiproc:
+            if self.ranks_of_proc:
+                return [self._device_of_rank(r) for r in ranks]
             # one device per global rank; self.devices is the global
             # device list (jax.devices() after jax.distributed init)
             return [self.devices[r] for r in ranks]
         return [self.devices[r % nd] for r in ranks]
+
+    def _device_of_rank(self, global_rank):
+        """Heterogeneous layouts: rank r of process p uses p's
+        (r - start_p)'th device — indexing the flat global list by
+        rank would cross process boundaries when counts differ."""
+        per = getattr(self, "_per_proc_devices", None)
+        if per is None:
+            grouped = {}
+            for d in self.devices:
+                grouped.setdefault(getattr(d, "process_index", 0),
+                                   []).append(d)
+            per = [grouped[k] for k in sorted(grouped)]
+            if len(per) != len(self.ranks_of_proc):
+                raise ValueError(
+                    f"{len(per)} device-owning processes but "
+                    f"{len(self.ranks_of_proc)} launcher processes")
+            for p, (devs, n) in enumerate(zip(per, self.ranks_of_proc)):
+                if len(devs) < n:
+                    raise ValueError(
+                        f"process {p} hosts {n} ranks but only "
+                        f"{len(devs)} devices")
+            self._per_proc_devices = per
+        p = self._proc_of(global_rank)
+        return per[p][global_rank - self._proc_starts[p]]
 
     # ------------------------------------------------------------------
     # process sets
@@ -534,6 +580,7 @@ class Engine:
             "ps": ps.id,
             "nbytes": nbytes,
             "nprocs": nprocs,
+            "nranks": ps.size,
             "root": req.root_rank,
             "aux": {},
         }
@@ -804,23 +851,33 @@ class Engine:
     def _fuse(self, ps, entries):
         """FuseResponses analogue (controller.cc:901-1080): pack
         consecutive ready allreduce entries with matching
-        (dtype, op, scales) into buckets up to the fusion threshold.
-        Non-allreduce ops execute one-per-bucket."""
+        (dtype, op, scales) into buckets up to the fusion threshold,
+        and consecutive same-dtype allgathers likewise (the reference
+        packs allgather responses with padding rules, :927-947 — the
+        TF sparse-gradient path generates exactly this many-small-
+        allgather stream).  Other ops execute one-per-bucket."""
         threshold = self.config.fusion_threshold_bytes
         buckets, cur, cur_bytes, cur_sig = [], [], 0, None
         for entry in entries:
             first = next(iter(entry.subs.values()))
             rt = first.request.request_type
-            if rt not in (RequestType.ALLREDUCE, RequestType.ADASUM):
+            if rt in (RequestType.ALLREDUCE, RequestType.ADASUM):
+                sig = (rt, first.request.dtype,
+                       first.request.reduce_op,
+                       first.request.prescale_factor,
+                       first.request.postscale_factor)
+                nbytes = sum(p.nbytes for p in first.payloads)
+            elif rt == RequestType.ALLGATHER:
+                sig = (rt, first.request.dtype)
+                # threshold accounts the OUTPUT (gathered) size, like
+                # the reference's fused-buffer accounting
+                nbytes = sum(p.nbytes for p in first.payloads) * ps.size
+            else:
                 if cur:
                     buckets.append(cur)
                     cur, cur_bytes, cur_sig = [], 0, None
                 buckets.append([entry])
                 continue
-            sig = (rt, first.request.dtype, first.request.reduce_op,
-                   first.request.prescale_factor,
-                   first.request.postscale_factor)
-            nbytes = sum(p.nbytes for p in first.payloads)
             if cur and (sig != cur_sig
                         or cur_bytes + nbytes > threshold):
                 buckets.append(cur)
@@ -843,7 +900,10 @@ class Engine:
             if rt in (RequestType.ALLREDUCE, RequestType.ADASUM):
                 self._run_allreduce_bucket(ps, bucket)
             elif rt == RequestType.ALLGATHER:
-                self._run_allgather(ps, bucket[0], aux=aux)
+                if len(bucket) > 1:
+                    self._run_allgather_fused(ps, bucket, aux=aux)
+                else:
+                    self._run_allgather(ps, bucket[0], aux=aux)
             elif rt == RequestType.BROADCAST:
                 self._run_broadcast(ps, bucket[0])
             elif rt == RequestType.ALLTOALL:
@@ -986,6 +1046,77 @@ class Engine:
         for r, sub in subs.items():
             outs = results_per_rank[r]
             sub.handle.set_result(outs if n_tensors > 1 else outs[0])
+
+    def _run_allgather_fused(self, ps, bucket, aux=None):
+        """Fused allgather bucket: every entry's tensors pack into ONE
+        flat per-rank buffer and ONE compiled gather (FuseResponses
+        allgather packing, controller.cc:901-1080 with the :927-947
+        padding role).  The wire pads each rank to the max TOTAL
+        contribution instead of per-tensor max rows, and a stream of
+        small gathers (sparse embedding rows) costs one program
+        dispatch instead of one each."""
+        self.fused_allgather_runs += 1
+        R = ps.size
+        tables = []     # (entry, subs, n_tensors, rest_shapes, dim0s)
+        for entry in bucket:
+            subs = self._local_subs(ps, entry)
+            ref = next(iter(subs.values()))
+            n_tensors = len(ref.payloads)
+            dim0s = self._global_dim0s(ps, entry, aux, n_tensors)
+            rests = [tuple(ref.payloads[i].shape[1:])
+                     for i in range(n_tensors)]
+            tables.append((entry, subs, n_tensors, rests, dim0s))
+        rest_ns = [
+            [int(np.prod(r, dtype=np.int64)) if r else 1 for r in rests]
+            for _, _, _, rests, _ in tables]
+        # per-global-rank flat totals (elements) — the wire dim0s
+        totals = []
+        for pos in range(R):
+            t = 0
+            for (entry, subs, n, rests, dim0s), rns in \
+                    zip(tables, rest_ns):
+                for i in range(n):
+                    t += dim0s[i][pos] * rns[i]
+            totals.append(t)
+        dtype = next(iter(bucket[0].subs.values())).payloads[0].dtype
+        max_t = max(totals) if totals else 0
+        rows = []
+        local = [r for r in ps.local_ranks if r in bucket[0].subs]
+        for r in local:
+            parts = [np.ravel(subs[r].payloads[i])
+                     for (entry, subs, n, rests, dim0s) in tables
+                     for i in range(n)]
+            flat = np.concatenate(parts) if parts else \
+                np.zeros(0, dtype=dtype)
+            buf = np.zeros(max_t, dtype=dtype)
+            buf[:flat.size] = flat
+            rows.append(buf)
+        gathered = ps.executor.allgather(rows, totals, ())
+        # slice table: absolute [start, end) of (entry_idx, tensor,
+        # source position) inside the concatenated exact buffer
+        rank_starts = np.cumsum([0] + totals[:-1])
+        slices = {}
+        for pos in range(R):
+            off = int(rank_starts[pos])
+            for e_idx, ((entry, subs, n, rests, dim0s), rns) in \
+                    enumerate(zip(tables, rest_ns)):
+                for i in range(n):
+                    sz = dim0s[i][pos] * rns[i]
+                    slices[(e_idx, i, pos)] = (off, off + sz)
+                    off += sz
+        for r, g in zip(local, gathered):
+            for e_idx, (entry, subs, n, rests, dim0s) in \
+                    enumerate(tables):
+                outs = []
+                for i in range(n):
+                    segs = []
+                    for pos in range(R):
+                        a, b = slices[(e_idx, i, pos)]
+                        segs.append(g[a:b].reshape(
+                            (dim0s[i][pos],) + rests[i]))
+                    outs.append(np.concatenate(segs, axis=0))
+                subs[r].handle.set_result(
+                    outs if n > 1 else outs[0])
 
     def _run_broadcast(self, ps, entry):
         subs = self._local_subs(ps, entry)

@@ -45,6 +45,11 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     dtype: Any = jnp.bfloat16
     remat: bool = False       # jax.checkpoint each block (HBM <-> FLOPs)
+    remat_policy: str = "full"  # "full" recomputes everything;
+    # "dots" saves matmul outputs (jax dots_with_no_batch_dims_saveable)
+    # so the backward pass skips re-running the MXU work — worth ~400MB
+    # * n_layers of HBM at (B=8, S=2048, d=1024) in exchange for the
+    # ~33% remat recompute FLOPs
 
     @property
     def head_dim(self):
@@ -231,8 +236,16 @@ class TransformerLM(nn.Module):
 
         block = DecoderBlock
         if cfg.remat:
+            policy = None
+            if cfg.remat_policy == "dots":
+                policy = jax.checkpoint_policies.\
+                    dots_with_no_batch_dims_saveable
+            elif cfg.remat_policy != "full":
+                raise ValueError(
+                    f"remat_policy must be 'full' or 'dots', got "
+                    f"{cfg.remat_policy!r}")
             block = nn.remat(DecoderBlock, prevent_cse=False,
-                             static_argnums=())
+                             static_argnums=(), policy=policy)
         stack = nn.scan(
             block,
             variable_axes={"params": 0, "cache": 0},
@@ -243,7 +256,12 @@ class TransformerLM(nn.Module):
         )(cfg, self.attention_fn, decode, name="layers")
         x, _ = stack(x, angles, seq_offset)
         x = RMSNorm(cfg.dtype, name="ln_final")(x)
-        logits = jnp.einsum("bsm,vm->bsv", x.astype(jnp.float32), emb)
+        # logits matmul in the activation dtype with f32 accumulation:
+        # a (B*S, M) @ (M, V) f32 matmul would run at a fraction of the
+        # MXU's bf16 rate and dominate the step at large vocab
+        logits = jnp.einsum("bsm,vm->bsv", x,
+                            emb.astype(cfg.dtype),
+                            preferred_element_type=jnp.float32)
         return logits
 
 

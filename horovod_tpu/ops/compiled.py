@@ -660,15 +660,28 @@ class _CompiledTrainStep:
             state["aux"] = {} if aux is None else aux
         if ex.shard_mode:
             rep = NamedSharding(ex.mesh, P())
+            single_proc = jax.process_count() == 1
 
             def put(x):
+                if single_proc and isinstance(x, jax.Array):
+                    # already device-resident: re-lay out on the mesh
+                    # without a host round-trip (a 1 GB-scale param
+                    # tree would otherwise bounce through the host)
+                    return jax.device_put(x, rep)
                 x = np.asarray(x)
                 return jax.make_array_from_callback(
                     x.shape, rep, lambda idx: x[idx])
 
             return jax.tree.map(put, state)
-        return jax.tree.map(
-            lambda x: jax.device_put(np.asarray(x), ex.devices[0]), state)
+
+        def put_single(x):
+            # device-resident arrays move (or no-op) device-side;
+            # np.asarray on them would round-trip GBs through the host
+            if isinstance(x, jax.Array):
+                return jax.device_put(x, ex.devices[0])
+            return jax.device_put(np.asarray(x), ex.devices[0])
+
+        return jax.tree.map(put_single, state)
 
     def _stage_batch(self, ex, slots):
         """{pos: batch_tree} for local ranks → global (R, ...) batch."""

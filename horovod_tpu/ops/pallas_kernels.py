@@ -73,19 +73,26 @@ def fused_scale_cast(x, factor, out_dtype=None, *, block=4096,
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
                   seq_len, scale):
-    # q_ref: (1, block_q, D); k_ref/v_ref: (1, S, D)
+    # q_ref: (1, block_q, D); k_ref/v_ref: (1, S, D).  Matmuls run in
+    # the INPUT dtype with f32 accumulation: bf16 activations hit the
+    # MXU's fast path (f32 operands would halve+ its rate) while f32
+    # inputs keep exact reference numerics.  All softmax math is f32;
+    # the 1/sqrt(D) scale is applied to the f32 scores, not to q, so
+    # no precision is lost to a low-precision pre-multiply.
     block_q = q_ref.shape[1]
     D = q_ref.shape[2]
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * np.float32(scale)
+    q = q_ref[0]
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
 
     def body(kb, carry):
         o, m, l = carry
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = q @ k.T                                   # (bq, bk)
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(                      # (bq, bk) f32
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * np.float32(scale)
         k_pos = kb * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         mask = q_pos >= k_pos
@@ -95,7 +102,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
         p = jnp.exp(s - m_new[:, None])
         p = jnp.where(mask, p, np.float32(0.0))
         l_new = l * alpha + jnp.sum(p, axis=1)
-        o_new = o * alpha[:, None] + p @ v
+        o_new = o * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         return o_new, m_new, l_new
 
     # causal: key blocks covering positions up to the LAST row of this
@@ -118,24 +127,30 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
     recompute p from (q, k, lse), accumulate ds @ k."""
     block_q = q_ref.shape[1]
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * np.float32(scale)
-    do = do_ref[0].astype(jnp.float32)
+    q = q_ref[0]
+    do = do_ref[0]
     lse = lse_ref[0, 0]
     delta = delta_ref[0, 0]
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
 
     def body(kb, dq):
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = q @ k.T
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * np.float32(scale)
         k_pos = kb * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         mask = q_pos >= k_pos
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), np.float32(0.0))
-        dp = do @ v.T
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
-        return dq + ds @ k
+        return dq + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     num_kb = ((qi + 1) * block_q - 1) // block_k + 1
     dq = jax.lax.fori_loop(
@@ -150,30 +165,36 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref,
     """dk/dv for one key block: loop over query blocks >= this one."""
     block_k = k_ref.shape[1]
     ki = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]
+    v = v_ref[0]
     k_pos = ki * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
 
     def body(qb, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(qb * block_q, block_q), :] \
-            .astype(jnp.float32) * np.float32(scale)
-        do = do_ref[0, pl.ds(qb * block_q, block_q), :] \
-            .astype(jnp.float32)
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :]
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :]
         lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)]
         delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)]
-        s = q @ k.T                                  # (bq, bk)
+        s = jax.lax.dot_general(                     # (bq, bk) f32
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * np.float32(scale)
         q_pos = qb * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         mask = q_pos >= k_pos
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), np.float32(0.0))
-        dv = dv + p.T @ do
-        dp = do @ v.T
+        pc = p.astype(do.dtype)
+        dv = dv + jax.lax.dot_general(
+            pc, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
-        # q here is already q_unscaled * scale, which is exactly the
-        # factor dk needs: dk = ds^T @ (q_unscaled * scale)
-        dk = dk + ds.T @ q
+        dsc = ds.astype(q.dtype)
+        dk = dk + jax.lax.dot_general(
+            dsc, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         return dk, dv
 
     # causal: only query blocks whose END reaches this key block
@@ -183,7 +204,8 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref,
     dk0 = jnp.zeros((block_k, D), jnp.float32)
     dv0 = jnp.zeros((block_k, D), jnp.float32)
     dk, dv = jax.lax.fori_loop(first_qb, num_qb, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
+    # s carried one `scale` factor, so dk = scale * (ds^T @ q_unscaled)
+    dk_ref[0] = (dk * np.float32(scale)).astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
@@ -267,7 +289,7 @@ def _flash_vjp_bwd(block_q, block_k, interpret, res, do):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-def flash_attention(q, k, v, *, block_q=128, block_k=128,
+def flash_attention(q, k, v, *, block_q=512, block_k=512,
                     interpret=None):
     """Causal attention (B, S, H, D) -> (B, S, H, D), flash-style.
 
@@ -280,11 +302,20 @@ def flash_attention(q, k, v, *, block_q=128, block_k=128,
     if interpret is None:
         interpret = not _is_tpu()
     B, S, H, D = q.shape
+    # blocks must divide S: clamp, then fall back to the largest
+    # common divisor (keeps every S the old 128-default accepted
+    # working under the faster 512 default), finally to one block
+    import math
     block_q = min(block_q, S)
     block_k = min(block_k, S)
-    if S % block_q or S % block_k:
-        raise ValueError(f"seq len {S} must divide blocks "
-                         f"({block_q}, {block_k})")
+    if S % block_q:
+        block_q = math.gcd(block_q, S)
+        if block_q < 8:
+            block_q = S
+    if S % block_k:
+        block_k = math.gcd(block_k, S)
+        if block_k < 8:
+            block_k = S
 
     # fold batch and heads into the grid's first axis
     qf = q.transpose(0, 2, 1, 3).reshape(B * H, S, D)

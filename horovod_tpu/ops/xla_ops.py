@@ -35,6 +35,7 @@ controller exactly as the reference exchanges shapes during
 negotiation (controller.cc:901-1080).
 """
 
+import os
 import threading
 
 import numpy as np
@@ -345,8 +346,21 @@ class MeshExecutor:
             return [empty.copy() for _ in self.local_positions], recv_local
         diag_max = [max(splits[r][(r + d) % R] for r in range(R))
                     for d in range(R)]
-        if self.shard_mode and R > 2 and \
-                R * max_seg > 2 * sum(diag_max):
+        # schedule pick: the diagonal path wins once one-shot padding
+        # inflates wire bytes >1.25x (measured at R=8: 8% slower at
+        # ratio 1.0, 2.9x faster already at ratio 1.31 — the old >2x
+        # threshold left that win on the table; docs/benchmarks.md
+        # alltoall table).  HOROVOD_TPU_ALLTOALL_SCHEDULE=
+        # {auto,oneshot,diag} forces it for experiments.
+        mode = os.environ.get("HOROVOD_TPU_ALLTOALL_SCHEDULE", "auto")
+        if mode not in ("auto", "oneshot", "diag"):
+            raise ValueError(
+                f"HOROVOD_TPU_ALLTOALL_SCHEDULE={mode!r}: must be "
+                f"'auto', 'oneshot', or 'diag'")
+        want_diag = (mode == "diag" or
+                     (mode == "auto" and
+                      4 * R * max_seg > 5 * sum(diag_max)))
+        if self.shard_mode and R > 2 and want_diag:
             return self._alltoall_diag(rows, splits, rest_shape,
                                        diag_max, recv_local)
         m = max_seg * rest

@@ -481,7 +481,8 @@ class Coordinator:
             self.fusion_threshold = self._tuned_params.fusion_threshold_bytes
             self.cache_capacity = self._tuned_params.cache_capacity
         for meta in ready:
-            if meta["type"] not in ("ALLREDUCE", "ADASUM"):
+            if meta["type"] not in ("ALLREDUCE", "ADASUM",
+                                    "ALLGATHER"):
                 if self._exhausted.get(meta.get("ps", 0)):
                     # join only supports allreduce (reference
                     # controller.cc:413-423): other ops with joined
@@ -494,14 +495,32 @@ class Coordinator:
                 flush()
                 self._log.append(self._batch_response([meta]))
                 continue
-            msig = (meta["type"], meta["dtype"], meta["op"],
-                    meta["pre"], meta["post"], meta["ps"])
+            if meta["type"] == "ALLGATHER":
+                if self._exhausted.get(meta.get("ps", 0)):
+                    self._log.append({
+                        "kind": "error", "key": meta["key"],
+                        "message": "ALLGATHER does not support "
+                                   "joined ranks"})
+                    continue
+                # same-dtype allgathers fuse like allreduces (the
+                # reference packs allgather responses too,
+                # controller.cc:901-1080); output-size accounting over
+                # RANKS (nprocs undercounts by ranks_per_proc —
+                # engine-side _fuse uses ps.size the same way)
+                msig = ("ALLGATHER", meta["dtype"], meta["ps"])
+                nbytes = meta["nbytes"] * max(
+                    meta.get("nranks",
+                             meta.get("nprocs", self.world_size)), 1)
+            else:
+                msig = (meta["type"], meta["dtype"], meta["op"],
+                        meta["pre"], meta["post"], meta["ps"])
+                nbytes = meta["nbytes"]
             if bucket and (msig != sig or
-                           bucket_bytes + meta["nbytes"] >
+                           bucket_bytes + nbytes >
                            self.fusion_threshold):
                 flush()
             bucket.append(meta)
-            bucket_bytes += meta["nbytes"]
+            bucket_bytes += nbytes
             sig = msig
         flush()
 

@@ -25,10 +25,15 @@ def parse_args(argv=None):
                         help="host1:slots,host2:slots list.")
     parser.add_argument("-hostfile", "--hostfile", dest="hostfile",
                         help="Host file with 'name slots=N' lines.")
-    parser.add_argument("--ranks-per-worker", type=int, default=1,
+    parser.add_argument("--ranks-per-worker", default=1,
                         dest="ranks_per_proc",
+                        type=lambda s: s if s == "host" else int(s),
                         help="Rank threads per worker process (TPU hosts "
-                             "drive all local chips from one process).")
+                             "drive all local chips from one process), "
+                             "or 'host': one process per -H entry "
+                             "driving that entry's slots — the "
+                             "reference's heterogeneous h1:4,h2:2 "
+                             "layout.")
     parser.add_argument("--cpu", action="store_true",
                         help="Force the CPU platform (virtual devices).")
     parser.add_argument("--gloo", action="store_true",

@@ -101,8 +101,13 @@ def host_of_rank_env(slots) -> str:
 
 def slot_env(slot: SlotInfo, *, rdv_addr, rdv_port, coordinator,
              secret_hex, num_procs, ranks_per_proc=1, platform=None,
-             host_of_rank=None):
-    """Env handoff for one worker (reference gloo_run.py:66-103)."""
+             host_of_rank=None, ranks_of_proc=None):
+    """Env handoff for one worker (reference gloo_run.py:66-103).
+
+    ``ranks_of_proc``: per-process rank-thread counts for
+    heterogeneous ``host:slots`` jobs; travels as
+    ``HOROVOD_TPU_RANKS_OF_PROC`` so every worker derives the same
+    rank->process table the engine's collectives group by."""
     env = {
         "HOROVOD_RANK": str(slot.rank),
         "HOROVOD_SIZE": str(slot.size),
@@ -123,6 +128,9 @@ def slot_env(slot: SlotInfo, *, rdv_addr, rdv_port, coordinator,
     }
     if host_of_rank:
         env["HOROVOD_TPU_HOST_OF_RANK"] = host_of_rank
+    if ranks_of_proc:
+        env["HOROVOD_TPU_RANKS_OF_PROC"] = ",".join(
+            str(n) for n in ranks_of_proc)
     if platform == "cpu":
         env["JAX_PLATFORMS"] = "cpu"
         env["JAX_NUM_CPU_DEVICES"] = str(ranks_per_proc)
@@ -210,6 +218,15 @@ def launch_procs(command: List[str], np: int, hosts: str = None,
     zero-padded the same way).  Remote workers' streams flow back
     through their ssh client and are captured identically.
 
+    ``ranks_per_proc``: rank threads per worker process — an int
+    (uniform, every process identical), or the string ``"host"`` for
+    the reference's heterogeneous ``-H h1:4,h2:2`` layout
+    (gloo_run.py:66-103 host allocation): ONE process per host entry,
+    driving that entry's ``slots`` chips as rank threads.  The
+    per-process rank counts travel to workers as
+    ``HOROVOD_TPU_RANKS_OF_PROC`` so the engine maps rank->process by
+    table instead of integer division.
+
     Only localhost spawning is wired (subprocess); remote hosts would
     go through ssh exactly as the reference's exec_command
     (gloo_run.py:203-229) — TPU pods normally use their own per-host
@@ -218,10 +235,36 @@ def launch_procs(command: List[str], np: int, hosts: str = None,
     hosts = hosts or f"localhost:{np}"
     host_infos = parse_hosts(hosts)
     any_remote = any(not is_local(h.hostname) for h in host_infos)
-    if np % ranks_per_proc != 0:
-        raise ValueError("np must be divisible by ranks-per-proc")
-    num_procs = np // ranks_per_proc
-    slots = get_host_assignments(host_infos, num_procs)
+    ranks_of_proc = None
+    if ranks_per_proc == "host":
+        # heterogeneous: host entry i => process i with slots_i ranks,
+        # filled in order until np ranks are placed
+        ranks_of_proc, left = [], np
+        for h in host_infos:
+            if left <= 0:
+                break
+            take = min(h.slots, left)
+            ranks_of_proc.append(take)
+            left -= take
+        if left > 0:
+            raise ValueError(
+                f"requested np={np} exceeds the "
+                f"{sum(h.slots for h in host_infos)} slots in "
+                f"-H {hosts}")
+        num_procs = len(ranks_of_proc)
+        slots = [SlotInfo(hostname=host_infos[i].hostname, rank=i,
+                          local_rank=0, local_size=1, cross_rank=i,
+                          cross_size=num_procs, size=num_procs)
+                 for i in range(num_procs)]
+    else:
+        if np % ranks_per_proc != 0:
+            raise ValueError(
+                f"np={np} is not divisible by "
+                f"ranks_per_proc={ranks_per_proc}; for unequal "
+                f"hosts pass ranks_per_proc='host' (-H h1:2,h2:1 -> "
+                f"one process per host driving that many chips)")
+        num_procs = np // ranks_per_proc
+        slots = get_host_assignments(host_infos, num_procs)
 
     secret_hex = _secrets.token_hex(16)
     launcher_env = dict(os.environ)
@@ -249,11 +292,14 @@ def launch_procs(command: List[str], np: int, hosts: str = None,
     try:
         for slot in slots:
             child_env = dict(launcher_env)
+            rpp = ranks_of_proc[slot.rank] if ranks_of_proc \
+                else ranks_per_proc
             child_env.update(slot_env(
                 slot, rdv_addr=rdv_addr, rdv_port=rdv_port,
                 coordinator=coordinator, secret_hex=secret_hex,
-                num_procs=num_procs, ranks_per_proc=ranks_per_proc,
-                platform=platform, host_of_rank=hof))
+                num_procs=num_procs, ranks_per_proc=rpp,
+                platform=platform, host_of_rank=hof,
+                ranks_of_proc=ranks_of_proc))
             if is_local(slot.hostname):
                 cmd, payload, spawn_env = command, None, child_env
             else:

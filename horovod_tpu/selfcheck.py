@@ -1,0 +1,108 @@
+"""Multi-process engine self-check: the coordinator/store-controller
+protocol exercised end-to-end at N OS processes.
+
+The reference validates its controller with multi-worker integration
+runs (``test/integration/``, ``controller.h:78-110`` negotiation
+contract); this module is the equivalent harness, reused by the CI
+suite (``tests/test_runner.py``) and the driver's multi-chip dry run
+(``__graft_entry__.dryrun_multichip``) so the part that must survive a
+pod — negotiation, aux merging, join, dynamic process sets — runs at
+real process boundaries, not rank threads.
+"""
+
+import os
+import sys
+import tempfile
+import textwrap
+
+#: Worker: one rank per process; every negotiated surface the
+#: coordinator owns.  Asserts are exact (no float tolerance games).
+ENGINE_CHECK_WORKER = textwrap.dedent("""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+
+    # negotiated allreduce
+    out = hvd.allreduce(np.ones(8, np.float32) * (r + 1),
+                        op=hvd.Average)
+    assert np.allclose(out, np.mean([i + 1.0 for i in range(n)])), \\
+        (r, out)
+
+    # grouped mixed-dtype allreduce (per-dtype fused submissions)
+    a, b = hvd.grouped_allreduce(
+        [np.full(4, r + 1, np.float32), np.full(3, r + 1, np.int64)],
+        op=hvd.Sum, name="gmix")
+    tot = sum(i + 1 for i in range(n))
+    assert np.array_equal(a, np.full(4, float(tot), np.float32)), a
+    assert np.array_equal(b, np.full(3, tot, np.int64)), b
+
+    # allgather with uneven first dims: the coordinator merges the
+    # per-process aux dim0 tables in rank order
+    g = hvd.allgather(np.full((r % 3 + 1, 2), float(r), np.float32),
+                      name="ag")
+    assert g.shape == (sum(i % 3 + 1 for i in range(n)), 2), g.shape
+    off = 0
+    for j in range(n):
+        rows = j % 3 + 1
+        assert np.allclose(g[off:off + rows], float(j)), (r, j)
+        off += rows
+
+    # alltoall with non-uniform splits (rank j sends k+1 rows to
+    # rank k); exact delivery across every process boundary
+    splits = [k + 1 for k in range(n)]
+    x = np.arange(sum(splits), dtype=np.float32) + 1000.0 * r
+    out, recv = hvd.alltoall(x, splits=splits, name="a2a")
+    assert list(recv) == [r + 1] * n, (r, recv)
+    off = 0
+    for j in range(n):
+        src_off = sum(splits[:r])
+        want = np.arange(r + 1, dtype=np.float32) + src_off + 1000.0 * j
+        assert np.allclose(out[off:off + r + 1], want), (r, j)
+        off += r + 1
+
+    # dynamic process sets: add (evens), reduce inside, remove —
+    # registration and the draining removal barrier are collective
+    evens = [i for i in range(n) if i % 2 == 0]
+    ps = hvd.add_process_set(evens)
+    if r in evens:
+        sub = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                            name="psred", process_set=ps)
+        assert np.allclose(sub, float(len(evens))), sub
+    hvd.remove_process_set(ps)
+
+    # join: every rank but the last submits one extra allreduce; the
+    # joined ranks' zero contributions must merge (reference Join op)
+    if r != n - 1:
+        tail = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                             name="tail")
+        assert np.allclose(tail, float(n - 1)), tail
+    last = hvd.join()
+    assert last >= 0, last
+
+    print(f"ENGINE-CHECK OK {r}/{n}")
+    hvd.shutdown()
+""")
+
+
+def run_engine_selfcheck(np_procs: int = 8, start_timeout: float = 420):
+    """Launch ``np_procs`` one-rank worker PROCESSES (jax.distributed
+    over virtual CPU devices + the HTTP store controller) through the
+    real launcher and run the negotiated-op scenario.  Raises on any
+    nonzero worker exit."""
+    from .runner.proc_run import launch_procs
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with tempfile.TemporaryDirectory() as td:
+        script = os.path.join(td, "engine_check_worker.py")
+        with open(script, "w") as f:
+            f.write(ENGINE_CHECK_WORKER)
+        codes = launch_procs(
+            [sys.executable, script], np=np_procs, platform="cpu",
+            env={"PYTHONPATH": repo}, start_timeout=start_timeout)
+    if codes != [0] * np_procs:
+        raise RuntimeError(
+            f"engine self-check failed at np={np_procs}: exit codes "
+            f"{codes}")
+    return True

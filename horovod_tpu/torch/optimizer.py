@@ -307,6 +307,14 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                     "redundant synchronization")
             self.synchronize()
         self._synchronized = False
+        # LRSchedulers built on the ORIGINAL optimizer (before the
+        # wrap) watch that instance's `_opt_called` flag for their
+        # step-order check; the wrap severed their view of step(), so
+        # mirror the flag or the first LR value is reported skipped.
+        base = self.__dict__.get("_lr_sched_base_opt")
+        if base is not None:
+            base._opt_called = True
+        self._opt_called = True
         return super(self.__class__, self).step(closure)
 
     def zero_grad(self, *args, **kwargs):
@@ -357,6 +365,9 @@ def DistributedOptimizer(optimizer, named_parameters=None,
     # gradient synchronization.  Drop it — only the scheduler's
     # step-order warning is lost.
     inst.__dict__.pop("step", None)
+    # schedulers the user created on `optimizer` before wrapping keep
+    # watching it; step() mirrors the step-order flag onto it
+    inst.__dict__["_lr_sched_base_opt"] = optimizer
     inst._dist_init(named_parameters, compression,
                     backward_passes_per_step, op,
                     gradient_predivide_factor, groups, sparse_as_dense,

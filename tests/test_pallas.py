@@ -38,6 +38,21 @@ def test_flash_attention_matches_dense():
                                rtol=2e-5, atol=2e-5)
 
 
+def test_flash_attention_nondividing_default_blocks():
+    """Sequence lengths that divided the old 128 default but not the
+    512 default (e.g. S=24, S=12) must still work — the block falls
+    back to a common divisor instead of raising."""
+    for S in (24, 12):
+        B, H, D = 1, 1, 8
+        keys = jax.random.split(jax.random.PRNGKey(2), 3)
+        q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.float32)
+                   for kk in keys)
+        out = flash_attention(q, k, v, interpret=True)
+        ref = dense_causal_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
 def test_flash_attention_uneven_blocks():
     B, S, H, D = 1, 32, 1, 8
     keys = jax.random.split(jax.random.PRNGKey(1), 3)

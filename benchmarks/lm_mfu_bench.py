@@ -7,9 +7,10 @@ The reference's headline protocol is synthetic throughput through
 same idea on the matmul-dominated workload TPUs are built for: a
 properly-sized Transformer (d_model 1024, 24 layers, head_dim 128,
 SwiGLU d_ff 4096, vocab 32k, S=2048, bf16, remat with the
-dots-saveable policy, pallas flash attention) through
-``hvd.make_compiled_train_step`` — engine up, process set 0's
-executor staging, fwd+bwd+reduce+update as one XLA program.
+dots-saveable policy, pallas flash attention, chunked fused
+cross-entropy) through ``hvd.make_compiled_train_step`` — engine up,
+process set 0's executor staging, fwd+bwd+reduce+update as one XLA
+program.
 
 MFU convention: model FLOPs = 6 * (matmul params incl. the logits
 projection) + causal attention matmuls, with NO credit for remat
@@ -61,13 +62,14 @@ def build(args):
     return cfg, tokens
 
 
-def bench_framework(cfg, tokens, iters, warmup):
+def bench_framework(cfg, tokens, iters, warmup, fused_ce=True):
     """Through hvd.make_compiled_train_step (the user path)."""
     import jax
     import optax
 
     import horovod_tpu as hvd
-    from horovod_tpu.models import TransformerLM, lm_loss
+    from horovod_tpu.models import TransformerLM, lm_loss, \
+        make_fused_lm_loss
     from horovod_tpu.ops.pallas_kernels import flash_attention
 
     hvd.init()
@@ -75,9 +77,15 @@ def bench_framework(cfg, tokens, iters, warmup):
     params = jax.jit(model.init)(jax.random.PRNGKey(0),
                                  tokens)["params"]
 
-    def loss_fn(params, batch):
-        logits = model.apply({"params": params}, batch)
-        return lm_loss(logits[:, :-1], batch[:, 1:])
+    if fused_ce:
+        # logits projection fused into a chunked loss: the (B, S, V)
+        # f32 logits + log-softmax (2.6 GB at B=5) never exist —
+        # the SAME objective make_lm_train_step(fused_ce=True) builds
+        loss_fn = make_fused_lm_loss(model, n_chunks=16)
+    else:
+        def loss_fn(params, batch):
+            logits = model.apply({"params": params}, batch)
+            return lm_loss(logits[:, :-1], batch[:, 1:])
 
     step = hvd.make_compiled_train_step(loss_fn, optax.adamw(1e-3))
     state = step.init_state(params)
@@ -94,7 +102,7 @@ def bench_framework(cfg, tokens, iters, warmup):
     return tokens.size * iters / dt, lv
 
 
-def bench_raw(cfg, tokens, iters, warmup):
+def bench_raw(cfg, tokens, iters, warmup, fused_ce=True):
     """Plain-jit ceiling (make_lm_train_step, no engine)."""
     import jax
     import optax
@@ -105,7 +113,7 @@ def bench_raw(cfg, tokens, iters, warmup):
     mesh = build_mesh(MeshSpec(dp=1), jax.devices()[:1])
     init, _, jit_step, tok_shd = make_lm_train_step(
         mesh, cfg, optimizer=optax.adamw(1e-3),
-        attention_impl="flash")
+        attention_impl="flash", fused_ce=fused_ce)
     state = init(jax.random.PRNGKey(0), tokens)
     compiled, state = jit_step(state)
     toks = jax.device_put(tokens, tok_shd)
@@ -144,13 +152,17 @@ def main():
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--raw", action="store_true",
                    help="also measure the plain-jit ceiling")
+    p.add_argument("--no-fused-ce", action="store_true",
+                   help="unfused loss (materialize the full logits)")
     args = p.parse_args()
 
     cfg, tokens = build(args)
-    tps, loss = bench_framework(cfg, tokens, args.iters, args.warmup)
+    tps, loss = bench_framework(cfg, tokens, args.iters, args.warmup,
+                                fused_ce=not args.no_fused_ce)
     out = make_report(tps, loss, cfg)
     if args.raw:
-        raw = bench_raw(cfg, tokens, args.iters, args.warmup)
+        raw = bench_raw(cfg, tokens, args.iters, args.warmup,
+                        fused_ce=not args.no_fused_ce)
         out["raw_jax_tokens_per_sec"] = round(raw, 1)
         out["framework_fraction_of_raw"] = round(tps / raw, 4)
     print(json.dumps(out))

@@ -23,15 +23,10 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-
-def model_flops_per_token(cfg):
-    """MFU-convention FLOPs/token: 6*(block+logit matmul params)
-    + fwd/bwd causal attention matmuls (no remat recompute)."""
-    d, f, L, V = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab_size
-    n_block = L * (4 * d * d + 3 * d * f)
-    n_logits = V * d
-    attn = 6 * L * cfg.max_seq_len * d * 0.5   # causal halves the work
-    return 6 * (n_block + n_logits) + attn
+# the ONE definition of the MFU FLOPs convention — shared with the
+# headline bench so the two cannot drift apart
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from lm_mfu_bench import lm_train_flops_per_token as model_flops_per_token  # noqa: E402,E501
 
 
 def time_step(cfg, mesh, tokens, impl, iters, warmup):

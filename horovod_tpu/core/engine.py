@@ -164,6 +164,8 @@ class Engine:
         self._stall_warned = set()
         #: fused-allgather buckets executed (observability + tests)
         self.fused_allgather_runs = 0
+        #: hold_cycles() depth — while >0 the loop parks (no dispatch)
+        self._hold_depth = 0
         self._thread = threading.Thread(
             target=self._background_loop, name="horovod_tpu-engine",
             daemon=True)
@@ -441,6 +443,26 @@ class Engine:
         return (f"{sub.request.request_type.name}"
                 f"|{'/'.join(sub.names)}|ps{ps.id}")
 
+    def hold_cycles(self):
+        """Context manager parking the negotiation loop: entries
+        submitted inside the ``with`` accumulate and dispatch together
+        in ONE cycle on exit.  Deterministic fusion-bucket formation —
+        the timing-independent way to exercise/observe the fusion
+        paths (tests, timeline experiments).  Re-entrant."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _hold():
+            with self._lock:
+                self._hold_depth += 1
+            try:
+                yield self
+            finally:
+                with self._lock:
+                    self._hold_depth = max(0, self._hold_depth - 1)
+                    self._lock.notify_all()
+        return _hold()
+
     # ------------------------------------------------------------------
     # background loop
 
@@ -455,6 +477,10 @@ class Engine:
                     self._fail_all_pending_locked(
                         HorovodInitError("shutdown during pending collective"))
                     break
+                if self._hold_depth:
+                    # hold_cycles(): park so concurrent submissions
+                    # accumulate and dispatch in ONE cycle on release
+                    continue
                 work = self._collect_ready_locked()
                 self._check_stalls_locked()
             if self.multiproc:

@@ -5,6 +5,7 @@ from .vgg import VGG, VGG16, VGG19  # noqa: F401
 from .inception import InceptionV3  # noqa: F401
 from .transformer import (  # noqa: F401
     TransformerConfig, TransformerLM, DecoderBlock, RMSNorm,
-    dense_causal_attention, lm_loss, make_generate_fn,
+    dense_causal_attention, lm_loss, chunked_lm_loss, make_fused_lm_loss,
+    make_generate_fn,
 )
 from .vit import ViT, ViTConfig, ViT_B16, ViT_S16  # noqa: F401

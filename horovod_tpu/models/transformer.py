@@ -224,7 +224,8 @@ class TransformerLM(nn.Module):
     attention_fn: Callable = dense_causal_attention
 
     @nn.compact
-    def __call__(self, tokens, *, seq_offset=0, decode=False):
+    def __call__(self, tokens, *, seq_offset=0, decode=False,
+                 pre_logits=False):
         cfg = self.cfg
         emb = self.param("embed", nn.initializers.normal(0.02),
                          (cfg.vocab_size, cfg.d_model), jnp.float32)
@@ -256,6 +257,11 @@ class TransformerLM(nn.Module):
         )(cfg, self.attention_fn, decode, name="layers")
         x, _ = stack(x, angles, seq_offset)
         x = RMSNorm(cfg.dtype, name="ln_final")(x)
+        if pre_logits:
+            # hand the caller the final hidden states + tied embedding
+            # so the logits projection can fuse into a chunked loss
+            # (chunked_lm_loss) instead of materializing (B, S, V)
+            return x, emb
         # logits matmul in the activation dtype with f32 accumulation:
         # a (B*S, M) @ (M, V) f32 matmul would run at a fraction of the
         # MXU's bf16 rate and dominate the step at large vocab
@@ -332,3 +338,80 @@ def lm_loss(logits, targets):
     logp = jax.nn.log_softmax(logits.astype(jnp.float32))
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll)
+
+
+def chunked_lm_loss(x, emb, targets, n_chunks=8, weights=None):
+    """Cross-entropy fused with the logits projection, chunked over the
+    sequence so the full (B, S, V) logits tensor is never materialized.
+
+    ``lm_loss(model.apply(...), targets)`` stores the f32 logits plus a
+    f32 log-softmax — 2 * B*S*V*4 bytes of HBM (2.6 GB at B=5, S=2048,
+    V=32k) that caps the trainable batch and adds two full HBM sweeps.
+    Here each ``lax.scan`` step projects one sequence chunk, reduces it
+    to per-token (logsumexp − target-logit) contributions, and drops
+    the chunk logits; ``jax.checkpoint`` re-runs the chunk projection
+    in the backward instead of saving it (the logits matmul is ~7% of
+    the model's FLOPs, so the recompute costs ~2%).
+
+    Exactly equals ``lm_loss`` in f32 (tests/test_models.py).
+
+    Args:
+      x: final hidden states (B, S, M) in the activation dtype
+         (``model.apply(..., pre_logits=True)``).
+      emb: tied embedding (V, M) f32.
+      targets: (B, S) int32 target ids (already shifted).
+      n_chunks: sequence chunks; S % n_chunks must be 0.
+      weights: optional (B, S) f32 per-token weights — pass 0 for
+        padding / the final position when feeding unshifted batches
+        (``targets=roll(tokens)``, ``weights[:, -1]=0``); the mean is
+        over the weight sum.
+    """
+    b, s, m = x.shape
+    if s % n_chunks:
+        raise ValueError(f"seq len {s} not divisible by n_chunks "
+                         f"{n_chunks}")
+    c = s // n_chunks
+    embd = emb.astype(x.dtype)
+    if weights is None:
+        weights = jnp.ones((b, s), jnp.float32)
+
+    def chunk_nll(xc, tc, wc):
+        # (B, C, M) @ (M, V): f32 accumulation on bf16 operands, same
+        # numerics as the unfused logits einsum
+        logits = jnp.einsum("bcm,vm->bcv", xc, embd,
+                            preferred_element_type=jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, tc[..., None],
+                                  axis=-1)[..., 0]
+        return jnp.sum((lse - tgt) * wc)
+
+    def body(total, inp):
+        return total + jax.checkpoint(chunk_nll)(*inp), None
+
+    def chunked(a):
+        return jnp.moveaxis(a.reshape(b, n_chunks, c, *a.shape[2:]),
+                            1, 0)
+
+    total, _ = jax.lax.scan(
+        body, jnp.zeros((), jnp.float32),
+        (chunked(x), chunked(targets), chunked(weights)))
+    return total / jnp.sum(weights)
+
+
+def make_fused_lm_loss(model: "TransformerLM", n_chunks: int = 16):
+    """``loss_fn(params, tokens)`` computing the next-token objective of
+    ``lm_loss(model.apply(...)[:, :-1], tokens[:, 1:])`` via
+    :func:`chunked_lm_loss` — targets rolled (not sliced, so S stays
+    chunkable and sp-shard-aligned) with the final position weighted 0.
+
+    The single definition of the fused objective, shared by
+    ``parallel.make_lm_train_step(fused_ce=True)`` and the MFU
+    benchmark so they cannot drift apart."""
+    def loss_fn(params, tokens):
+        x, emb = model.apply({"params": params}, tokens,
+                             pre_logits=True)
+        targets = jnp.roll(tokens, -1, axis=1)
+        w = jnp.ones(tokens.shape, jnp.float32).at[:, -1].set(0.0)
+        return chunked_lm_loss(x, emb, targets, n_chunks=n_chunks,
+                               weights=w)
+    return loss_fn

@@ -302,20 +302,29 @@ def flash_attention(q, k, v, *, block_q=512, block_k=512,
     if interpret is None:
         interpret = not _is_tpu()
     B, S, H, D = q.shape
-    # blocks must divide S: clamp, then fall back to the largest
-    # common divisor (keeps every S the old 128-default accepted
-    # working under the faster 512 default), finally to one block
-    import math
-    block_q = min(block_q, S)
-    block_k = min(block_k, S)
-    if S % block_q:
-        block_q = math.gcd(block_q, S)
-        if block_q < 8:
-            block_q = S
-    if S % block_k:
-        block_k = math.gcd(block_k, S)
-        if block_k < 8:
-            block_k = S
+
+    # blocks must divide S: clamp, then fall back to the LARGEST
+    # divisor of S that still fits under the requested block (NOT the
+    # gcd — gcd(512, 1032) is 8, a perf cliff; the largest divisor is
+    # 344).  A sequence with no usable divisor would silently become
+    # one S-sized block whose (S, S) f32 score tile blows VMEM past
+    # ~1k — raise the actionable error instead.
+    def _fit_block(requested):
+        b = min(requested, S)
+        if S % b:
+            b = next(d for d in range(b, 0, -1) if S % d == 0)
+        if b < 8:
+            if S > 1024:
+                raise ValueError(
+                    f"flash_attention: seq len {S} has no block "
+                    f"divisor in [8, {min(requested, S)}] (S is "
+                    f"prime-ish); pad the sequence to a multiple of "
+                    f"128 or use dense_causal_attention")
+            b = S          # short sequence: one block is cheap
+        return b
+
+    block_q = _fit_block(block_q)
+    block_k = _fit_block(block_k)
 
     # fold batch and heads into the grid's first axis
     qf = q.transpose(0, 2, 1, 3).reshape(B * H, S, D)

@@ -6,7 +6,8 @@ import numpy as np
 import pytest
 
 from horovod_tpu.models import (
-    ResNet50, TransformerConfig, TransformerLM, lm_loss,
+    ResNet50, TransformerConfig, TransformerLM, chunked_lm_loss,
+    lm_loss,
 )
 from horovod_tpu.models.resnet import ResNet
 from horovod_tpu.models.transformer import dense_causal_attention
@@ -104,6 +105,42 @@ def test_transformer_forward(tiny_lm):
     assert logits.shape == (2, 16, 128)
     loss = lm_loss(logits, tokens)
     assert np.isfinite(float(loss))
+
+
+def test_chunked_lm_loss_matches_unfused(tiny_lm):
+    """chunked_lm_loss (logits projection fused into the loss, never
+    materializing (B, S, V)) equals lm_loss in value AND gradients —
+    both the pre-shifted form and the rolled-targets + weights form
+    the MFU bench uses."""
+    cfg, model, params, tokens = tiny_lm
+
+    def unfused(p):
+        logits = model.apply({"params": p["params"]}, tokens)
+        return lm_loss(logits[:, :-1], tokens[:, 1:])
+
+    def fused_shifted(p):
+        x, emb = model.apply({"params": p["params"]}, tokens,
+                             pre_logits=True)
+        return chunked_lm_loss(x[:, :-1], emb, tokens[:, 1:],
+                               n_chunks=5)          # S-1 = 15 = 5*3
+
+    def fused_weighted(p):
+        x, emb = model.apply({"params": p["params"]}, tokens,
+                             pre_logits=True)
+        targets = jnp.roll(tokens, -1, axis=1)
+        w = jnp.ones(tokens.shape, jnp.float32).at[:, -1].set(0.0)
+        return chunked_lm_loss(x, emb, targets, n_chunks=4, weights=w)
+
+    la, ga = jax.value_and_grad(unfused)(params)
+    for fused in (fused_shifted, fused_weighted):
+        lb, gb = jax.value_and_grad(fused)(params)
+        assert abs(float(la) - float(lb)) < 1e-5
+        for a, b in zip(jax.tree.leaves(ga), jax.tree.leaves(gb)):
+            np.testing.assert_allclose(a, b, atol=1e-5)
+
+    with pytest.raises(ValueError, match="not divisible"):
+        x, emb = model.apply(params, tokens, pre_logits=True)
+        chunked_lm_loss(x, emb, tokens, n_chunks=7)
 
 
 def test_transformer_scan_layer_axis(tiny_lm):

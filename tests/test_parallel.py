@@ -120,6 +120,24 @@ def test_lm_train_step_matches_single_device():
                                    rtol=5e-4, atol=5e-5)
 
 
+def test_lm_train_step_fused_ce_matches_unfused():
+    # fused_ce (chunked projection+CE, no (B,S,V) logits) is the same
+    # math as the unfused loss — including over a sharded mesh.
+    mesh = build_mesh(dp=2, tp=2, sp=2)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (4, 16), 0,
+                                CFG.vocab_size)
+    init, step, _, _ = make_lm_train_step(
+        mesh, CFG, optimizer=optax.sgd(0.1))
+    _, ref_loss = step(init(jax.random.PRNGKey(1), tokens), tokens)
+
+    init_f, step_f, jit_f, tok_shd = make_lm_train_step(
+        mesh, CFG, optimizer=optax.sgd(0.1), fused_ce=True, ce_chunks=4)
+    compiled, state = jit_f(init_f(jax.random.PRNGKey(1), tokens))
+    _, loss = compiled(state, jax.device_put(tokens, tok_shd))
+    np.testing.assert_allclose(float(loss), float(ref_loss),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_sequence_parallel_ring_step():
     mesh = build_mesh(dp=2, sp=2, tp=2)
     init, step, jit_step, tok_shd = make_lm_train_step(

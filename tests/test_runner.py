@@ -425,11 +425,15 @@ FUSED_AG_WORKER = textwrap.dedent("""
     r = hvd.rank()
     # burst of small same-dtype allgathers with uneven first dims:
     # the coordinator fuses them into one batch response and the
-    # engine runs ONE compiled gather for the bucket
-    hs = [hvd.allgather_async(
-              np.full((r + 1 + i % 2, 3), float(r * 10 + i),
-                      np.float32), name=f"pag{i}")
-          for i in range(5)]
+    # engine runs ONE compiled gather for the bucket.  hold_cycles
+    # parks this process's loop until all five are submitted, so its
+    # first ready-report carries the whole burst (deterministic
+    # bucket formation regardless of host load).
+    with basics.engine().hold_cycles():
+        hs = [hvd.allgather_async(
+                  np.full((r + 1 + i % 2, 3), float(r * 10 + i),
+                          np.float32), name=f"pag{i}")
+              for i in range(5)]
     outs = [hvd.synchronize(h) for h in hs]
     for i, out in enumerate(outs):
         want = np.concatenate(

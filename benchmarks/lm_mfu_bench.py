@@ -6,9 +6,10 @@ The reference's headline protocol is synthetic throughput through
 ``DistributedOptimizer`` (``docs/benchmarks.rst:15-63``); this is the
 same idea on the matmul-dominated workload TPUs are built for: a
 properly-sized Transformer (d_model 1024, 24 layers, head_dim 128,
-SwiGLU d_ff 4096, vocab 32k, S=2048, bf16, remat with the
-dots-saveable policy, pallas flash attention, chunked fused
-cross-entropy) through ``hvd.make_compiled_train_step`` — engine up,
+SwiGLU d_ff 4096, vocab 32k, S=2048, bf16, dots_flash remat — save
+matmul + flash-kernel outputs, replay only cheap glue — pallas flash
+attention, chunked fused cross-entropy) through
+``hvd.make_compiled_train_step`` — engine up,
 process set 0's executor staging, fwd+bwd+reduce+update as one XLA
 program.
 
@@ -54,8 +55,10 @@ def build(args):
 
     from horovod_tpu.models import TransformerConfig
 
-    cfg = TransformerConfig(dtype=jnp.bfloat16, remat=True,
-                            remat_policy="dots", **HEADLINE)
+    remat = getattr(args, "remat", "dots_flash")
+    cfg = TransformerConfig(dtype=jnp.bfloat16, remat=remat != "none",
+                            remat_policy=remat if remat != "none"
+                            else "full", **HEADLINE)
     tokens = jax.random.randint(
         jax.random.PRNGKey(1), (args.batch, cfg.max_seq_len), 0,
         cfg.vocab_size)
@@ -154,6 +157,11 @@ def main():
                    help="also measure the plain-jit ceiling")
     p.add_argument("--no-fused-ce", action="store_true",
                    help="unfused loss (materialize the full logits)")
+    p.add_argument("--remat",
+                   choices=["dots", "dots_flash", "full", "none"],
+                   default="dots_flash",
+                   help="remat policy sweep knob (headline: "
+                        "dots_flash)")
     args = p.parse_args()
 
     cfg, tokens = build(args)

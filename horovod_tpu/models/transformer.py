@@ -49,7 +49,9 @@ class TransformerConfig:
     # "dots" saves matmul outputs (jax dots_with_no_batch_dims_saveable)
     # so the backward pass skips re-running the MXU work — worth ~400MB
     # * n_layers of HBM at (B=8, S=2048, d=1024) in exchange for the
-    # ~33% remat recompute FLOPs
+    # ~33% remat recompute FLOPs; "dots_flash" additionally saves the
+    # flash-attention kernel outputs (out + lse, checkpoint-named) so
+    # the backward replay skips the pallas forward too
 
     @property
     def head_dim(self):
@@ -241,10 +243,20 @@ class TransformerLM(nn.Module):
             if cfg.remat_policy == "dots":
                 policy = jax.checkpoint_policies.\
                     dots_with_no_batch_dims_saveable
+            elif cfg.remat_policy == "dots_flash":
+                # "dots" + the flash-attention kernel outputs
+                # (checkpoint-named in ops/pallas_kernels.py): a
+                # pallas call is not a dot, so without the names the
+                # backward replay re-runs every flash forward
+                policy = jax.checkpoint_policies.save_from_both_policies(
+                    jax.checkpoint_policies.
+                    dots_with_no_batch_dims_saveable,
+                    jax.checkpoint_policies.save_only_these_names(
+                        "flash_out", "flash_lse"))
             elif cfg.remat_policy != "full":
                 raise ValueError(
-                    f"remat_policy must be 'full' or 'dots', got "
-                    f"{cfg.remat_policy!r}")
+                    f"remat_policy must be 'full', 'dots', or "
+                    f"'dots_flash', got {cfg.remat_policy!r}")
             block = nn.remat(DecoderBlock, prevent_cse=False,
                              static_argnums=(), policy=policy)
         stack = nn.scan(
@@ -395,7 +407,9 @@ def chunked_lm_loss(x, emb, targets, n_chunks=8, weights=None):
     total, _ = jax.lax.scan(
         body, jnp.zeros((), jnp.float32),
         (chunked(x), chunked(targets), chunked(weights)))
-    return total / jnp.sum(weights)
+    denom = jnp.sum(weights)
+    # all-padding batches (weight sum 0) yield loss 0, not 0/0 = NaN
+    return total / jnp.where(denom > 0, denom, 1.0)
 
 
 def make_fused_lm_loss(model: "TransformerLM", n_chunks: int = 16):

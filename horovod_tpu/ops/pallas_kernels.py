@@ -238,6 +238,13 @@ def _flash_fwd_call(qf, kf, vf, block_q, block_k, interpret):
 
 def _flash_vjp_fwd(qf, kf, vf, block_q, block_k, interpret):
     out, lse = _flash_fwd_call(qf, kf, vf, block_q, block_k, interpret)
+    # named so a checkpoint policy can SAVE the kernel's outputs:
+    # they are a pallas custom call, not a dot, so the "dots" policy
+    # alone re-runs every flash forward during the backward replay
+    # (models/transformer.py remat_policy="dots_flash" saves them)
+    from jax.ad_checkpoint import checkpoint_name
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return out, (qf, kf, vf, out, lse)
 
 
@@ -311,8 +318,9 @@ def flash_attention(q, k, v, *, block_q=512, block_k=512,
     # ~1k — raise the actionable error instead.
     def _fit_block(requested):
         b = min(requested, S)
-        if S % b:
-            b = next(d for d in range(b, 0, -1) if S % d == 0)
+        if S % b == 0:
+            return b       # explicit/divisible blocks pass unchanged
+        b = next(d for d in range(b, 0, -1) if S % d == 0)
         if b < 8:
             if S > 1024:
                 raise ValueError(

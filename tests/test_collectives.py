@@ -245,19 +245,24 @@ def test_allgather_fused_bucket(hvd_shutdown):
         eng = basics.engine()
         # deterministic bucket formation: park the negotiation loop
         # (engine.hold_cycles) until EVERY rank has submitted all six
-        # gathers, so one cycle collects — and fuses — the whole burst
+        # gathers, so one cycle collects — and fuses — the whole
+        # burst.  try/finally + barrier timeouts: a rank failing
+        # mid-burst must surface as a test failure, not park the
+        # shared engine forever.
         hold = eng.hold_cycles() if r == 0 else None
         if hold is not None:
             hold.__enter__()
-        gate.wait()
-        hs = [hvd.allgather_async(
-                  np.full((r % 3 + 1 + i % 2, 2),
-                          float(r * 100 + i), np.float32),
-                  name=f"fag{i}")
-              for i in range(6)]
-        done.wait()
-        if hold is not None:
-            hold.__exit__(None, None, None)
+        try:
+            gate.wait(timeout=60)
+            hs = [hvd.allgather_async(
+                      np.full((r % 3 + 1 + i % 2, 2),
+                              float(r * 100 + i), np.float32),
+                      name=f"fag{i}")
+                  for i in range(6)]
+            done.wait(timeout=60)
+        finally:
+            if hold is not None:
+                hold.__exit__(None, None, None)
         outs = [hvd.synchronize(h) for h in hs]
         return outs, eng.fused_allgather_runs
 

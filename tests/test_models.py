@@ -143,6 +143,37 @@ def test_chunked_lm_loss_matches_unfused(tiny_lm):
         chunked_lm_loss(x, emb, tokens, n_chunks=7)
 
 
+def test_remat_dots_flash_matches_dots():
+    """remat_policy='dots_flash' (save the checkpoint-named flash
+    kernel outputs so the backward replay skips the pallas forward)
+    computes identical loss and grads to 'dots'."""
+    from horovod_tpu.models import make_fused_lm_loss
+    from horovod_tpu.ops.pallas_kernels import flash_attention
+
+    toks = jax.random.randint(jax.random.PRNGKey(0), (2, 32), 0, 128)
+    out = {}
+    for pol in ("dots", "dots_flash"):
+        cfg = TransformerConfig(
+            vocab_size=128, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+            max_seq_len=32, dtype=jnp.float32, remat=True,
+            remat_policy=pol)
+        model = TransformerLM(cfg, attention_fn=flash_attention)
+        params = model.init(jax.random.PRNGKey(1), toks)["params"]
+        out[pol] = jax.jit(jax.value_and_grad(
+            make_fused_lm_loss(model, 4)))(params, toks)
+    assert abs(float(out["dots"][0]) - float(out["dots_flash"][0])) \
+        < 1e-6
+    for a, b in zip(jax.tree.leaves(out["dots"][1]),
+                    jax.tree.leaves(out["dots_flash"][1])):
+        np.testing.assert_allclose(a, b, atol=1e-5)
+
+    with pytest.raises(ValueError, match="remat_policy"):
+        cfg = TransformerConfig(
+            vocab_size=128, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+            max_seq_len=32, remat=True, remat_policy="bogus")
+        TransformerLM(cfg).init(jax.random.PRNGKey(1), toks)
+
+
 def test_transformer_scan_layer_axis(tiny_lm):
     cfg, model, params, tokens = tiny_lm
     # nn.scan stacks per-layer params along a leading axis of length

@@ -65,7 +65,8 @@ def build(args):
     return cfg, tokens
 
 
-def bench_framework(cfg, tokens, iters, warmup, fused_ce=True):
+def bench_framework(cfg, tokens, iters, warmup, fused_ce=True,
+                    ce_chunks=16):
     """Through hvd.make_compiled_train_step (the user path)."""
     import jax
     import optax
@@ -84,7 +85,7 @@ def bench_framework(cfg, tokens, iters, warmup, fused_ce=True):
         # logits projection fused into a chunked loss: the (B, S, V)
         # f32 logits + log-softmax (2.6 GB at B=5) never exist —
         # the SAME objective make_lm_train_step(fused_ce=True) builds
-        loss_fn = make_fused_lm_loss(model, n_chunks=16)
+        loss_fn = make_fused_lm_loss(model, n_chunks=ce_chunks)
     else:
         def loss_fn(params, batch):
             logits = model.apply({"params": params}, batch)
@@ -162,11 +163,14 @@ def main():
                    default="dots_flash",
                    help="remat policy sweep knob (headline: "
                         "dots_flash)")
+    p.add_argument("--ce-chunks", type=int, default=16,
+                   help="fused-CE sequence chunks (headline: 16)")
     args = p.parse_args()
 
     cfg, tokens = build(args)
     tps, loss = bench_framework(cfg, tokens, args.iters, args.warmup,
-                                fused_ce=not args.no_fused_ce)
+                                fused_ce=not args.no_fused_ce,
+                                ce_chunks=args.ce_chunks)
     out = make_report(tps, loss, cfg)
     if args.raw:
         raw = bench_raw(cfg, tokens, args.iters, args.warmup,

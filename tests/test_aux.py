@@ -198,3 +198,22 @@ def test_profiler_trace_produces_xplane(tmp_path):
     import glob
     dumps = glob.glob(f"{logdir}/**/*.xplane.pb", recursive=True)
     assert dumps, f"no xplane dump under {logdir}"
+
+
+def test_examples_and_benchmarks_compile():
+    """Every shipped example/benchmark script must at least be valid
+    Python against the current library surface (the reference smoke-
+    runs its examples in CI; a full run needs frameworks/clusters this
+    image lacks, but a stale import after a refactor must not ship)."""
+    import compileall
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for target in ("examples", "benchmarks"):
+        assert compileall.compile_dir(
+            os.path.join(root, target), quiet=2, force=True), \
+            f"{target}/ contains a script that does not compile"
+    for script in ("bench.py", "__graft_entry__.py"):
+        assert compileall.compile_file(
+            os.path.join(root, script), quiet=2, force=True), \
+            f"{script} does not compile"

@@ -24,9 +24,9 @@ PART1="tests/test_autotune.py tests/test_aux.py tests/test_basics.py \
   tests/test_conv_bn_fusion.py tests/test_integrations.py \
   tests/test_jax_frontend.py tests/test_lightning.py \
   tests/test_models.py tests/test_mxnet_fake.py tests/test_native.py"
-PART2="tests/test_elastic.py tests/test_op_matrix.py \
-  tests/test_pallas.py tests/test_ray_strategy.py \
-  tests/test_spark_streaming.py"
+PART2="tests/test_elastic.py tests/test_examples.py \
+  tests/test_op_matrix.py tests/test_pallas.py \
+  tests/test_ray_strategy.py tests/test_spark_streaming.py"
 PART3="tests/test_parallel.py tests/test_runner.py \
   tests/test_tensorflow.py tests/test_torch.py"
 
@@ -46,9 +46,10 @@ case "${1:-all}" in
     ;;
   integration)
     # launcher tier: real multi-process runs, CLI, elastic churn /
-    # fault injection (the reference's test/integration role)
-    python -m pytest tests/test_runner.py tests/test_elastic.py -q \
-      -m integration
+    # fault injection, example smoke-runs (the reference's
+    # test/integration + examples-in-CI role)
+    python -m pytest tests/test_runner.py tests/test_elastic.py \
+      tests/test_examples.py -q -m integration
     ;;
   bench)
     python bench.py

@@ -1,0 +1,54 @@
+"""Smoke-run the shipped examples (the reference's CI runs its
+examples under the launcher — .buildkite/gen-pipeline.sh; here every
+network-free example executes end-to-end on the CPU platform with tiny
+knobs).  Compile-only coverage of the full tree lives in
+tests/test_aux.py::test_examples_and_benchmarks_compile."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (script, args) — every entry must be synthetic-data / network-free.
+# Scripts with a --cpu-devices knob configure jax themselves; the rest
+# only touch jax through the engine, which honors
+# HOROVOD_TPU_PLATFORM=cpu.
+CASES = [
+    ("examples/jax/compiled_train_step.py",
+     ["--cpu-devices", "2", "--steps", "3", "--batch", "8"]),
+    ("examples/jax/jax_spmd_train.py",
+     ["--cpu-devices", "4", "--dp", "2", "--tp", "2", "--steps", "2"]),
+    ("examples/adasum/adasum_small.py", []),
+    ("examples/data_service/data_service_example.py", []),
+    ("examples/pytorch/pytorch_mnist.py",
+     ["--epochs", "1", "--batch-size", "16"]),
+    ("examples/tensorflow2/tensorflow2_mnist.py",
+     ["--steps", "3", "--batch-size", "16"]),
+    ("examples/pytorch/pytorch_bert_benchmark.py",
+     ["--tiny", "--num-iters", "1", "--warmup", "0",
+      "--batch-size", "2", "--seq-len", "32"]),
+]
+
+
+@pytest.mark.integration
+@pytest.mark.parametrize("script,args",
+                         CASES, ids=[c[0].split("/")[-1] for c in CASES])
+def test_example_runs(script, args):
+    env = dict(os.environ)
+    env.update({
+        "HOROVOD_TPU_PLATFORM": "cpu",
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        # keep TF quiet and CPU-only
+        "TF_CPP_MIN_LOG_LEVEL": "2",
+        "CUDA_VISIBLE_DEVICES": "",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, script), *args],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, (
+        f"{script} rc={proc.returncode}\nstdout:\n{proc.stdout[-3000:]}"
+        f"\nstderr:\n{proc.stderr[-3000:]}")

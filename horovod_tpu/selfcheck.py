@@ -6,8 +6,9 @@ runs (``test/integration/``, ``controller.h:78-110`` negotiation
 contract); this module is the equivalent harness, reused by the CI
 suite (``tests/test_runner.py``) and the driver's multi-chip dry run
 (``__graft_entry__.dryrun_multichip``) so the part that must survive a
-pod — negotiation, aux merging, join, dynamic process sets — runs at
-real process boundaries, not rank threads.
+pod — negotiation, aux merging, join, dynamic process sets, and the
+parallel package's dp/tp SPMD train step over a process-spanning mesh
+— runs at real process boundaries, not rank threads.
 """
 
 import os
@@ -80,6 +81,40 @@ ENGINE_CHECK_WORKER = textwrap.dedent("""
         assert np.allclose(tail, float(n - 1)), tail
     last = hvd.join()
     assert last >= 0, last
+
+    # the SPMD pod shape: the parallel package's dp/tp train step over
+    # a global mesh SPANNING the processes (multi-controller jax) —
+    # every process holds one device, XLA inserts the cross-process
+    # collectives, the fused-CE loss trains and stays replicated
+    if n >= 2 and n % 2 == 0:
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from horovod_tpu.models import TransformerConfig
+        from horovod_tpu.parallel import MeshSpec, build_mesh, \\
+            make_lm_train_step
+
+        devs = jax.devices()
+        assert len(devs) == n, (len(devs), n)
+        cfg = TransformerConfig(vocab_size=64, d_model=32, n_layers=2,
+                                n_heads=2, d_ff=64, max_seq_len=16,
+                                dtype=jnp.float32)
+        mesh = build_mesh(MeshSpec(dp=n // 2, tp=2), devs)
+        toks = jax.random.randint(jax.random.PRNGKey(0), (n, 16), 0,
+                                  64)
+        init, _, jit_step, tok_shd = make_lm_train_step(
+            mesh, cfg, optimizer=optax.sgd(0.1), fused_ce=True,
+            ce_chunks=4)
+        state = init(jax.random.PRNGKey(1), toks)
+        compiled, state = jit_step(state)
+        td = jax.device_put(toks, tok_shd)
+        l0 = l1 = None
+        for _ in range(2):
+            state, loss = compiled(state, td)
+            l0, l1 = l1, float(loss)
+        assert l1 < l0, (l0, l1)
+        same = hvd.allreduce(np.array([l1], np.float32), op=hvd.Average)
+        assert abs(float(same[0]) - l1) < 1e-6, (same, l1)
 
     print(f"ENGINE-CHECK OK {r}/{n}")
     hvd.shutdown()

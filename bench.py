@@ -142,7 +142,7 @@ def bench_lm_headline():
 
     args = argparse.Namespace(batch=mod.HEADLINE_BATCH)
     cfg, tokens = mod.build(args)
-    tps, loss = mod.bench_framework(cfg, tokens, iters=8, warmup=2)
+    tps, loss = mod.bench_framework(cfg, tokens, iters=12, warmup=3)
     return mod.make_report(tps, loss, cfg)
 
 

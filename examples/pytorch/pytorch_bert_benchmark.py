@@ -96,4 +96,10 @@ if __name__ == "__main__":
     else:
         from horovod_tpu import run as hvd_run
 
+        # transformers resolves its exports lazily and that machinery
+        # is not thread-safe: resolve the names ONCE here, before the
+        # rank threads race into build_model()
+        from transformers import (  # noqa: F401
+            BertConfig, BertForSequenceClassification,
+        )
         hvd_run(main)                   # direct: rank threads

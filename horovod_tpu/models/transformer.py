@@ -419,11 +419,22 @@ def make_fused_lm_loss(model: "TransformerLM", n_chunks: int = 16):
     chunkable and sp-shard-aligned) with the final position weighted 0.
 
     The single definition of the fused objective, shared by
-    ``parallel.make_lm_train_step(fused_ce=True)`` and the MFU
-    benchmark so they cannot drift apart."""
+    ``parallel.make_lm_train_step(fused_ce=True)``, the pipelined
+    step, and the MFU benchmark so they cannot drift apart.
+
+    ``model`` is a ``TransformerLM`` (flax) or any plain
+    ``apply(params, tokens, pre_logits=True) -> (x, emb)`` callable
+    (e.g. ``make_pipelined_lm_apply``'s)."""
+    if hasattr(model, "apply"):
+        def pre(params, tokens):
+            return model.apply({"params": params}, tokens,
+                               pre_logits=True)
+    else:
+        def pre(params, tokens):
+            return model(params, tokens, pre_logits=True)
+
     def loss_fn(params, tokens):
-        x, emb = model.apply({"params": params}, tokens,
-                             pre_logits=True)
+        x, emb = pre(params, tokens)
         targets = jnp.roll(tokens, -1, axis=1)
         w = jnp.ones(tokens.shape, jnp.float32).at[:, -1].set(0.0)
         return chunked_lm_loss(x, emb, targets, n_chunks=n_chunks,

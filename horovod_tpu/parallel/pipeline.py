@@ -107,7 +107,7 @@ def make_pipelined_lm_apply(mesh, cfg, n_microbatches: int,
         out_specs=P(batch_axes, None, None),
         check_vma=False)
 
-    def apply(params, tokens):
+    def apply(params, tokens, pre_logits=False):
         p = params["params"] if "params" in params else params
         emb = p["embed"]
         x = emb[tokens].astype(cfg.dtype)
@@ -115,6 +115,14 @@ def make_pipelined_lm_apply(mesh, cfg, n_microbatches: int,
         x = mapped(p["layers"], x, angles)
         x = RMSNorm(cfg.dtype, name="ln_final").apply(
             {"params": p["ln_final"]}, x)
-        return jnp.einsum("bsm,vm->bsv", x.astype(jnp.float32), emb)
+        if pre_logits:
+            # same contract as TransformerLM(pre_logits=True): the
+            # caller fuses the projection into a chunked loss
+            return x, emb
+        # activation-dtype operands with f32 accumulation, matching
+        # TransformerLM's unembed (a full-f32 matmul would run at a
+        # fraction of the MXU's bf16 rate)
+        return jnp.einsum("bsm,vm->bsv", x, emb.astype(cfg.dtype),
+                          preferred_element_type=jnp.float32)
 
     return apply

@@ -153,7 +153,9 @@ def _opt_state_shardings(mesh, opt_state, params, param_shardings):
 
 def make_pipelined_lm_train_step(mesh: Mesh, cfg: TransformerConfig,
                                  n_microbatches: int, optimizer=None, *,
-                                 learning_rate: float = 1e-3):
+                                 learning_rate: float = 1e-3,
+                                 fused_ce: bool = False,
+                                 ce_chunks: int = 16):
     """Trainable GPipe: the decoder stack runs as a ``pp``-axis
     pipeline (pipeline.py gpipe — a differentiable scan of ppermute
     ticks) and the whole fwd/bwd/update compiles as one program.
@@ -174,9 +176,12 @@ def make_pipelined_lm_train_step(mesh: Mesh, cfg: TransformerConfig,
         return {"params": params, "opt_state": opt_state,
                 "step": jnp.zeros((), jnp.int32)}
 
-    def loss_fn(params, tokens):
-        logits = pipe_apply({"params": params}, tokens)
-        return lm_loss(logits[:, :-1], tokens[:, 1:])
+    if fused_ce:
+        loss_fn = make_fused_lm_loss(pipe_apply, n_chunks=ce_chunks)
+    else:
+        def loss_fn(params, tokens):
+            logits = pipe_apply({"params": params}, tokens)
+            return lm_loss(logits[:, :-1], tokens[:, 1:])
 
     def step(state, tokens):
         loss, grads = jax.value_and_grad(loss_fn)(state["params"], tokens)

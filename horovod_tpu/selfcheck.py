@@ -16,6 +16,48 @@ import sys
 import tempfile
 import textwrap
 
+
+def spmd_lm_check(steps: int = 3):
+    """The pod-shape SPMD scenario, shared by the engine self-check
+    worker and the CI test worker (tests/test_runner.py) so the two
+    cannot drift: build a dp·tp mesh over ALL global devices
+    (spanning the processes under multi-controller jax.distributed),
+    train ``steps`` fused-CE LM steps, assert the loss decreases, and
+    return the final loss (replication checks — engine allreduce —
+    stay with the caller, whose rank-binding context differs).
+
+    Returns None when the global device count is odd or < 2 (no tp=2
+    mesh to build)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from .models import TransformerConfig
+    from .parallel import MeshSpec, build_mesh, make_lm_train_step
+
+    devs = jax.devices()
+    n = len(devs)
+    if n < 2 or n % 2:
+        return None
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_layers=2,
+                            n_heads=2, d_ff=64, max_seq_len=16,
+                            dtype=jnp.float32)
+    mesh = build_mesh(MeshSpec(dp=n // 2, tp=2), devs)
+    toks = jax.random.randint(jax.random.PRNGKey(0), (n, 16), 0, 64)
+    init, _, jit_step, tok_shd = make_lm_train_step(
+        mesh, cfg, optimizer=optax.sgd(0.1), fused_ce=True,
+        ce_chunks=4)
+    # same seed everywhere -> identical initial state on every process
+    state = init(jax.random.PRNGKey(1), toks)
+    compiled, state = jit_step(state)
+    td = jax.device_put(toks, tok_shd)
+    losses = []
+    for _ in range(steps):
+        state, loss = compiled(state, td)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    return losses[-1]
+
 #: Worker: one rank per process; every negotiated surface the
 #: coordinator owns.  Asserts are exact (no float tolerance games).
 ENGINE_CHECK_WORKER = textwrap.dedent("""
@@ -86,33 +128,10 @@ ENGINE_CHECK_WORKER = textwrap.dedent("""
     # a global mesh SPANNING the processes (multi-controller jax) —
     # every process holds one device, XLA inserts the cross-process
     # collectives, the fused-CE loss trains and stays replicated
-    if n >= 2 and n % 2 == 0:
-        import jax
-        import jax.numpy as jnp
-        import optax
-        from horovod_tpu.models import TransformerConfig
-        from horovod_tpu.parallel import MeshSpec, build_mesh, \\
-            make_lm_train_step
-
-        devs = jax.devices()
-        assert len(devs) == n, (len(devs), n)
-        cfg = TransformerConfig(vocab_size=64, d_model=32, n_layers=2,
-                                n_heads=2, d_ff=64, max_seq_len=16,
-                                dtype=jnp.float32)
-        mesh = build_mesh(MeshSpec(dp=n // 2, tp=2), devs)
-        toks = jax.random.randint(jax.random.PRNGKey(0), (n, 16), 0,
-                                  64)
-        init, _, jit_step, tok_shd = make_lm_train_step(
-            mesh, cfg, optimizer=optax.sgd(0.1), fused_ce=True,
-            ce_chunks=4)
-        state = init(jax.random.PRNGKey(1), toks)
-        compiled, state = jit_step(state)
-        td = jax.device_put(toks, tok_shd)
-        l0 = l1 = None
-        for _ in range(2):
-            state, loss = compiled(state, td)
-            l0, l1 = l1, float(loss)
-        assert l1 < l0, (l0, l1)
+    # (scenario shared with tests/test_runner.py via spmd_lm_check)
+    from horovod_tpu.selfcheck import spmd_lm_check
+    l1 = spmd_lm_check(steps=2)
+    if l1 is not None:
         same = hvd.allreduce(np.array([l1], np.float32), op=hvd.Average)
         assert abs(float(same[0]) - l1) < 1e-6, (same, l1)
 

@@ -41,6 +41,9 @@ def test_example_runs(script, args):
     env.update({
         "HOROVOD_TPU_PLATFORM": "cpu",
         "JAX_PLATFORMS": "cpu",
+        # deterministic rank count for hvd.run()-style examples (the
+        # jax SPMD ones override via their own --cpu-devices knob)
+        "JAX_NUM_CPU_DEVICES": "2",
         "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
         # keep TF quiet and CPU-only
         "TF_CPP_MIN_LOG_LEVEL": "2",

@@ -288,6 +288,27 @@ def test_pipeline_train_step_matches_dense():
     assert float(loss2) < float(loss)
 
 
+def test_pipeline_fused_ce_matches_unfused():
+    """fused_ce through the GPipe path (make_fused_lm_loss over the
+    pipelined apply) computes the same loss as the unfused pipeline."""
+    mesh = build_mesh(dp=2, pp=4)
+    from horovod_tpu.parallel import make_pipelined_lm_train_step
+
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (4, 16), 0,
+                                CFG.vocab_size)
+    init_u, step_u, _, _ = make_pipelined_lm_train_step(
+        mesh, CFG, n_microbatches=2, optimizer=optax.sgd(0.1))
+    _, ref_loss = step_u(init_u(jax.random.PRNGKey(1), tokens), tokens)
+
+    init_f, _, jit_f, tok_shd = make_pipelined_lm_train_step(
+        mesh, CFG, n_microbatches=2, optimizer=optax.sgd(0.1),
+        fused_ce=True, ce_chunks=4)
+    compiled, state = jit_f(init_f(jax.random.PRNGKey(1), tokens))
+    _, loss = compiled(state, jax.device_put(tokens, tok_shd))
+    np.testing.assert_allclose(float(loss), float(ref_loss),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_moe_ep_step():
     cfg = TransformerConfig(vocab_size=64, d_model=32, n_layers=2,
                             n_heads=4, d_ff=64, max_seq_len=32,

@@ -1041,47 +1041,27 @@ def test_two_process_compiled_train_step(tmp_path):
 SPMD_LM_WORKER = textwrap.dedent("""
     import numpy as np
     import jax
-    import optax
     import horovod_tpu as hvd
+    from horovod_tpu.selfcheck import spmd_lm_check
 
     hvd.init()                       # jax.distributed up: 2 procs x 2
-    import jax.numpy as jnp          # cpu devices = 4 global devices
-    from horovod_tpu.models import TransformerConfig
-    from horovod_tpu.parallel import MeshSpec, build_mesh, \\
-        make_lm_train_step
-
-    devs = jax.devices()
-    assert len(devs) == 4, devs
-    cfg = TransformerConfig(vocab_size=64, d_model=32, n_layers=2,
-                            n_heads=2, d_ff=64, max_seq_len=16,
-                            dtype=jnp.float32)
-    mesh = build_mesh(MeshSpec(dp=2, tp=2), devs)
-    toks = jax.random.randint(jax.random.PRNGKey(0), (4, 16), 0, 64)
-    init, step, jit_step, tok_shd = make_lm_train_step(
-        mesh, cfg, optimizer=optax.sgd(0.1), fused_ce=True,
-        ce_chunks=4)
-    # same seed everywhere -> identical initial state on every process
-    state = init(jax.random.PRNGKey(1), toks)
-    compiled, state = jit_step(state)
-    td = jax.device_put(toks, tok_shd)
-    losses = []
-    for _ in range(3):
-        state, loss = compiled(state, td)
-        losses.append(float(loss))
-    assert losses[-1] < losses[0], losses
+    # the shared pod-shape scenario (also run at 8 single-device
+    # processes by the engine selfcheck): dp/tp mesh over the 4
+    # global devices spanning both processes, fused-CE LM training
+    last = spmd_lm_check(steps=3)
+    assert last is not None
 
     # every process computed the same replicated loss: the engine
     # allreduce average (run on the per-rank threads — the main
     # thread is not a rank when ranks_per_proc > 1) equals it
     def check():
-        avg = hvd.allreduce(np.array([losses[-1]], np.float32),
+        avg = hvd.allreduce(np.array([last], np.float32),
                             op=hvd.Average)
-        assert abs(float(avg[0]) - losses[-1]) < 1e-6, (avg, losses)
+        assert abs(float(avg[0]) - last) < 1e-6, (avg, last)
         return True
 
     assert all(hvd.run(check))
-    print(f"SPMD LM OK proc={jax.process_index()} "
-          f"loss={losses[-1]:.4f}")
+    print(f"SPMD LM OK proc={jax.process_index()} loss={last:.4f}")
     hvd.shutdown()
 """)
 

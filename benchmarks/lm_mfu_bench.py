@@ -66,8 +66,10 @@ def build(args):
 
 
 def bench_framework(cfg, tokens, iters, warmup, fused_ce=True,
-                    ce_chunks=16):
+                    ce_chunks=16, bwd_block=None):
     """Through hvd.make_compiled_train_step (the user path)."""
+    import functools
+
     import jax
     import optax
 
@@ -77,7 +79,9 @@ def bench_framework(cfg, tokens, iters, warmup, fused_ce=True,
     from horovod_tpu.ops.pallas_kernels import flash_attention
 
     hvd.init()
-    model = TransformerLM(cfg, attention_fn=flash_attention)
+    attn = flash_attention if bwd_block is None else functools.partial(
+        flash_attention, bwd_block_q=bwd_block, bwd_block_k=bwd_block)
+    model = TransformerLM(cfg, attention_fn=attn)
     params = jax.jit(model.init)(jax.random.PRNGKey(0),
                                  tokens)["params"]
 
@@ -165,12 +169,16 @@ def main():
                         "dots_flash)")
     p.add_argument("--ce-chunks", type=int, default=16,
                    help="fused-CE sequence chunks (headline: 16)")
+    p.add_argument("--flash-bwd-block", type=int, default=None,
+                   help="independent flash BACKWARD kernel block size "
+                        "(default: same as forward, 512)")
     args = p.parse_args()
 
     cfg, tokens = build(args)
     tps, loss = bench_framework(cfg, tokens, args.iters, args.warmup,
                                 fused_ce=not args.no_fused_ce,
-                                ce_chunks=args.ce_chunks)
+                                ce_chunks=args.ce_chunks,
+                                bwd_block=args.flash_bwd_block)
     out = make_report(tps, loss, cfg)
     if args.raw:
         raw = bench_raw(cfg, tokens, args.iters, args.warmup,

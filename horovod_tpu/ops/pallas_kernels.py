@@ -209,8 +209,9 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(qf, kf, vf, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(qf, kf, vf, block_q, block_k, bwd_block_q, bwd_block_k,
+           interpret):
     out, _ = _flash_fwd_call(qf, kf, vf, block_q, block_k, interpret)
     return out
 
@@ -236,7 +237,8 @@ def _flash_fwd_call(qf, kf, vf, block_q, block_k, interpret):
     return out, lse
 
 
-def _flash_vjp_fwd(qf, kf, vf, block_q, block_k, interpret):
+def _flash_vjp_fwd(qf, kf, vf, block_q, block_k, bwd_block_q,
+                   bwd_block_k, interpret):
     out, lse = _flash_fwd_call(qf, kf, vf, block_q, block_k, interpret)
     # named so a checkpoint policy can SAVE the kernel's outputs:
     # they are a pallas custom call, not a dot, so the "dots" policy
@@ -248,7 +250,12 @@ def _flash_vjp_fwd(qf, kf, vf, block_q, block_k, interpret):
     return out, (qf, kf, vf, out, lse)
 
 
-def _flash_vjp_bwd(block_q, block_k, interpret, res, do):
+def _flash_vjp_bwd(block_q, block_k, bwd_block_q, bwd_block_k,
+                   interpret, res, do):
+    # the backward kernels tile independently of the forward: their
+    # per-block dot chain (5 matmuls + exp) has a different
+    # VMEM/pipeline sweet spot than the forward's 2
+    block_q, block_k = bwd_block_q, bwd_block_k
     qf, kf, vf, out, lse = res
     BH, S, D = qf.shape
     scale = 1.0 / np.sqrt(D)
@@ -297,6 +304,7 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def flash_attention(q, k, v, *, block_q=512, block_k=512,
+                    bwd_block_q=None, bwd_block_k=None,
                     interpret=None):
     """Causal attention (B, S, H, D) -> (B, S, H, D), flash-style.
 
@@ -305,6 +313,9 @@ def flash_attention(q, k, v, *, block_q=512, block_k=512,
     backward pass is two pallas kernels (dq; dk/dv) recomputing
     attention probabilities blockwise from the saved logsumexp, per
     FlashAttention's backward (never materializing the S^2 matrix).
+    ``bwd_block_*`` tile the backward kernels independently (their
+    5-matmul block body has a different VMEM sweet spot than the
+    forward's 2); default: same as the forward blocks.
     """
     if interpret is None:
         interpret = not _is_tpu()
@@ -333,10 +344,15 @@ def flash_attention(q, k, v, *, block_q=512, block_k=512,
 
     block_q = _fit_block(block_q)
     block_k = _fit_block(block_k)
+    bwd_block_q = block_q if bwd_block_q is None \
+        else _fit_block(bwd_block_q)
+    bwd_block_k = block_k if bwd_block_k is None \
+        else _fit_block(bwd_block_k)
 
     # fold batch and heads into the grid's first axis
     qf = q.transpose(0, 2, 1, 3).reshape(B * H, S, D)
     kf = k.transpose(0, 2, 1, 3).reshape(B * H, S, D)
     vf = v.transpose(0, 2, 1, 3).reshape(B * H, S, D)
-    out = _flash(qf, kf, vf, block_q, block_k, interpret)
+    out = _flash(qf, kf, vf, block_q, block_k, bwd_block_q,
+                 bwd_block_k, interpret)
     return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)

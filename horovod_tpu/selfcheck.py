@@ -17,7 +17,7 @@ import tempfile
 import textwrap
 
 
-def spmd_lm_check(steps: int = 3):
+def spmd_lm_check(steps: int = 3, expect_devices: int = None):
     """The pod-shape SPMD scenario, shared by the engine self-check
     worker and the CI test worker (tests/test_runner.py) so the two
     cannot drift: build a dp·tp mesh over ALL global devices
@@ -26,8 +26,12 @@ def spmd_lm_check(steps: int = 3):
     return the final loss (replication checks — engine allreduce —
     stay with the caller, whose rank-binding context differs).
 
-    Returns None when the global device count is odd or < 2 (no tp=2
-    mesh to build)."""
+    ``expect_devices`` asserts the GLOBAL device count — callers in
+    multi-process mode must pass their world size so a
+    jax.distributed regression (each process seeing only its local
+    devices) fails loudly instead of silently degrading to a local
+    mesh.  Returns None when the global device count is odd or < 2
+    (no tp=2 mesh to build)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -37,6 +41,10 @@ def spmd_lm_check(steps: int = 3):
 
     devs = jax.devices()
     n = len(devs)
+    if expect_devices is not None and n != expect_devices:
+        raise AssertionError(
+            f"expected a {expect_devices}-device global mesh, got "
+            f"{n} — jax.distributed is not spanning the processes")
     if n < 2 or n % 2:
         return None
     cfg = TransformerConfig(vocab_size=64, d_model=32, n_layers=2,
@@ -130,7 +138,7 @@ ENGINE_CHECK_WORKER = textwrap.dedent("""
     # collectives, the fused-CE loss trains and stays replicated
     # (scenario shared with tests/test_runner.py via spmd_lm_check)
     from horovod_tpu.selfcheck import spmd_lm_check
-    l1 = spmd_lm_check(steps=2)
+    l1 = spmd_lm_check(steps=2, expect_devices=n)
     if l1 is not None:
         same = hvd.allreduce(np.array([l1], np.float32), op=hvd.Average)
         assert abs(float(same[0]) - l1) < 1e-6, (same, l1)

@@ -63,3 +63,24 @@ def test_flash_attention_uneven_blocks():
     ref = dense_causal_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attention_independent_bwd_blocks():
+    """bwd_block_q/bwd_block_k tile the backward kernels independently
+    of the forward; gradients must be identical to the shared-block
+    path."""
+    from functools import partial
+
+    B, S, H, D = 1, 64, 2, 8
+    q = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, D))
+
+    def loss(fn, q):
+        return jnp.sum(fn(q, q, q) ** 2)
+
+    g_ref = jax.grad(partial(loss, partial(
+        flash_attention, block_q=16, block_k=16, interpret=True)))(q)
+    g_bwd = jax.grad(partial(loss, partial(
+        flash_attention, block_q=16, block_k=16, bwd_block_q=32,
+        bwd_block_k=8, interpret=True)))(q)
+    np.testing.assert_allclose(np.asarray(g_ref), np.asarray(g_bwd),
+                               rtol=1e-5, atol=1e-5)

@@ -1048,7 +1048,7 @@ SPMD_LM_WORKER = textwrap.dedent("""
     # the shared pod-shape scenario (also run at 8 single-device
     # processes by the engine selfcheck): dp/tp mesh over the 4
     # global devices spanning both processes, fused-CE LM training
-    last = spmd_lm_check(steps=3)
+    last = spmd_lm_check(steps=3, expect_devices=4)
     assert last is not None
 
     # every process computed the same replicated loss: the engine

@@ -42,6 +42,10 @@ class TransformerConfig:
     max_seq_len: int = 2048
     num_experts: int = 0      # 0 => dense MLP
     expert_top_k: int = 2
+    n_kv_heads: Optional[int] = None   # GQA/MQA: kv heads < n_heads
+    # (None => n_heads, i.e. standard multi-head attention); each kv
+    # head serves n_heads/n_kv_heads query heads and the decode cache
+    # shrinks by the same factor (llama-2/3 style)
     rope_theta: float = 10000.0
     dtype: Any = jnp.bfloat16
     remat: bool = False       # jax.checkpoint each block (HBM <-> FLOPs)
@@ -56,6 +60,16 @@ class TransformerConfig:
     @property
     def head_dim(self):
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self):
+        kv = self.n_kv_heads if self.n_kv_heads is not None \
+            else self.n_heads
+        if kv < 1 or self.n_heads % kv:
+            raise ValueError(
+                f"n_kv_heads ({kv}) must divide n_heads "
+                f"({self.n_heads})")
+        return kv
 
 
 def rope_angles(head_dim: int, max_seq: int, theta: float) -> np.ndarray:
@@ -72,6 +86,27 @@ def apply_rope(x, angles):
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     return out.astype(x.dtype)
+
+
+def grouped_causal_attention(q, k, v, *, offset=0):
+    """GQA attention against an UN-expanded kv tensor: q (B, T, H, D)
+    with H = KV*G query heads attends k/v (B, S, KV, D) directly —
+    no (B, S, H, D) materialization, so the decode path reads the
+    reduced cache at its stored size (the GQA bandwidth win)."""
+    B, T, H, D = q.shape
+    S, KV = k.shape[1], k.shape[2]
+    G = H // KV
+    qg = q.reshape(B, T, KV, G, D)
+    scores = jnp.einsum("btkgd,bskd->bkgts", qg, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / np.sqrt(D)
+    q_pos = jnp.arange(T)[:, None] + offset
+    k_pos = jnp.arange(S)[None, :]
+    mask = (q_pos >= k_pos)[None, None, None]
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    o = jnp.einsum("bkgts,bskd->btkgd", probs, v)
+    return o.reshape(B, T, H, D)
 
 
 def dense_causal_attention(q, k, v, *, offset=0):
@@ -112,14 +147,27 @@ class Attention(nn.Module):
     def __call__(self, x, angles, offset=0):
         cfg = self.cfg
         H, D = cfg.n_heads, cfg.head_dim
+        KV = cfg.kv_heads          # == H unless GQA/MQA configured
         dense = lambda feats, name: nn.DenseGeneral(  # noqa: E731
             feats, axis=-1, use_bias=False, dtype=cfg.dtype,
             param_dtype=jnp.float32, name=name)
         q = dense((H, D), "wq")(x)
-        k = dense((H, D), "wk")(x)
-        v = dense((H, D), "wv")(x)
+        k = dense((KV, D), "wk")(x)
+        v = dense((KV, D), "wv")(x)
         q = apply_rope(q, angles)
         k = apply_rope(k, angles)
+
+        def expand_kv(t):
+            # training path only: each kv head serves H/KV query
+            # heads; materializing the repeat keeps every attention
+            # inner fn (dense/flash/ring/ulysses) unchanged and costs
+            # exactly what MHA's k/v already cost.  The decode path
+            # below never expands — grouped_causal_attention reads
+            # the reduced cache at its stored size.
+            if KV == H:
+                return t
+            return jnp.repeat(t, H // KV, axis=2)
+
         if self.decode:
             if self.attention_fn is not dense_causal_attention:
                 # ring/ulysses/flash are training inner fns with their
@@ -131,22 +179,28 @@ class Attention(nn.Module):
                     "attention_fn for generation")
             # KV cache: write this chunk at [offset, offset+T) and
             # attend over the full cache — rows past the write head are
-            # zeros and masked away by causality (offset may be traced)
+            # zeros and masked away by causality (offset may be traced).
+            # The cache stores KV heads (H/KV x smaller under GQA) and
+            # expands after the update.
             B = x.shape[0]
             ck = self.variable(
                 "cache", "k", jnp.zeros,
-                (B, cfg.max_seq_len, H, D), cfg.dtype)
+                (B, cfg.max_seq_len, KV, D), cfg.dtype)
             cv = self.variable(
                 "cache", "v", jnp.zeros,
-                (B, cfg.max_seq_len, H, D), cfg.dtype)
+                (B, cfg.max_seq_len, KV, D), cfg.dtype)
             ck.value = jax.lax.dynamic_update_slice_in_dim(
                 ck.value, k.astype(ck.value.dtype), offset, axis=1)
             cv.value = jax.lax.dynamic_update_slice_in_dim(
                 cv.value, v.astype(cv.value.dtype), offset, axis=1)
-            o = dense_causal_attention(q, ck.value, cv.value,
-                                       offset=offset)
+            if KV == H:
+                o = dense_causal_attention(q, ck.value, cv.value,
+                                           offset=offset)
+            else:
+                o = grouped_causal_attention(q, ck.value, cv.value,
+                                             offset=offset)
         else:
-            o = self.attention_fn(q, k, v)
+            o = self.attention_fn(q, expand_kv(k), expand_kv(v))
         return nn.DenseGeneral(cfg.d_model, axis=(-2, -1), use_bias=False,
                                dtype=cfg.dtype, param_dtype=jnp.float32,
                                name="wo")(o)

@@ -120,6 +120,27 @@ def test_lm_train_step_matches_single_device():
                                    rtol=5e-4, atol=5e-5)
 
 
+def test_gqa_sharded_train_step():
+    """GQA (n_kv_heads=2 serving 4 query heads) under the tp-sharded
+    train step: kv projections shard over tp at the reduced head
+    count (kv_heads % tp == 0, the llama constraint) and the step
+    matches the unsharded math."""
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_layers=2,
+                            n_heads=4, n_kv_heads=2, d_ff=64,
+                            max_seq_len=16, dtype=jnp.float32)
+    mesh = build_mesh(dp=2, tp=2, sp=2)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (4, 16), 0,
+                                cfg.vocab_size)
+    init, step, jit_step, tok_shd = make_lm_train_step(
+        mesh, cfg, optimizer=optax.sgd(0.1))
+    state = init(jax.random.PRNGKey(1), tokens)
+    ref_state, ref_loss = step(state, tokens)
+    compiled, state_sh = jit_step(init(jax.random.PRNGKey(1), tokens))
+    _, loss = compiled(state_sh, jax.device_put(tokens, tok_shd))
+    np.testing.assert_allclose(float(loss), float(ref_loss),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_lm_train_step_fused_ce_matches_unfused():
     # fused_ce (chunked projection+CE, no (B,S,V) logits) is the same
     # math as the unfused loss — including over a sharded mesh.

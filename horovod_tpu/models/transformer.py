@@ -46,6 +46,11 @@ class TransformerConfig:
     # (None => n_heads, i.e. standard multi-head attention); each kv
     # head serves n_heads/n_kv_heads query heads and the decode cache
     # shrinks by the same factor (llama-2/3 style)
+    attention_window: Optional[int] = None   # sliding-window span
+    # (mistral style): each query sees the last W positions only.
+    # Applies consistently to training (dense or flash attention_fn)
+    # AND the KV-cache decode path; ring/ulysses sequence-parallel
+    # inners don't support it (rejected loudly)
     rope_theta: float = 10000.0
     dtype: Any = jnp.bfloat16
     remat: bool = False       # jax.checkpoint each block (HBM <-> FLOPs)
@@ -88,11 +93,13 @@ def apply_rope(x, angles):
     return out.astype(x.dtype)
 
 
-def grouped_causal_attention(q, k, v, *, offset=0):
+def grouped_causal_attention(q, k, v, *, offset=0, window=None):
     """GQA attention against an UN-expanded kv tensor: q (B, T, H, D)
     with H = KV*G query heads attends k/v (B, S, KV, D) directly —
     no (B, S, H, D) materialization, so the decode path reads the
-    reduced cache at its stored size (the GQA bandwidth win)."""
+    reduced cache at its stored size (the GQA bandwidth win).
+    ``window`` restricts each query to the last ``window`` positions
+    (sliding-window attention)."""
     B, T, H, D = q.shape
     S, KV = k.shape[1], k.shape[2]
     G = H // KV
@@ -102,25 +109,33 @@ def grouped_causal_attention(q, k, v, *, offset=0):
     scores = scores / np.sqrt(D)
     q_pos = jnp.arange(T)[:, None] + offset
     k_pos = jnp.arange(S)[None, :]
-    mask = (q_pos >= k_pos)[None, None, None]
+    mask = q_pos >= k_pos
+    if window is not None:
+        mask = mask & (q_pos - k_pos < window)
+    mask = mask[None, None, None]
     scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     o = jnp.einsum("bkgts,bskd->btkgd", probs, v)
     return o.reshape(B, T, H, D)
 
 
-def dense_causal_attention(q, k, v, *, offset=0):
+def dense_causal_attention(q, k, v, *, offset=0, window=None):
     """Reference attention inner: (B, S, H, D) -> (B, S, H, D) with a
     causal mask.  ``offset`` shifts query positions (used when the
     sequence axis is sharded and this shard holds positions
-    [offset, offset + S))."""
+    [offset, offset + S)).  ``window`` limits each query to the last
+    ``window`` positions (sliding-window attention; None = full
+    causal)."""
     depth = q.shape[-1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32)
     scores = scores / np.sqrt(depth)
     q_pos = jnp.arange(q.shape[1])[:, None] + offset
     k_pos = jnp.arange(k.shape[1])[None, :]
-    scores = jnp.where(q_pos >= k_pos, scores, -1e30)
+    mask = q_pos >= k_pos
+    if window is not None:
+        mask = mask & (q_pos - k_pos < window)
+    scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
@@ -194,13 +209,34 @@ class Attention(nn.Module):
             cv.value = jax.lax.dynamic_update_slice_in_dim(
                 cv.value, v.astype(cv.value.dtype), offset, axis=1)
             if KV == H:
-                o = dense_causal_attention(q, ck.value, cv.value,
-                                           offset=offset)
+                o = dense_causal_attention(
+                    q, ck.value, cv.value, offset=offset,
+                    window=cfg.attention_window)
             else:
-                o = grouped_causal_attention(q, ck.value, cv.value,
-                                             offset=offset)
+                o = grouped_causal_attention(
+                    q, ck.value, cv.value, offset=offset,
+                    window=cfg.attention_window)
         else:
-            o = self.attention_fn(q, expand_kv(k), expand_kv(v))
+            if cfg.attention_window is not None:
+                # config-driven sliding window: forwarded to inners
+                # that accept it (dense reference, pallas flash); the
+                # sequence-parallel inners (ring/ulysses) don't — a
+                # silent full-causal fallback would train a different
+                # model than the config says, so fail loudly
+                try:
+                    o = self.attention_fn(
+                        q, expand_kv(k), expand_kv(v),
+                        window=cfg.attention_window)
+                except TypeError as exc:
+                    raise ValueError(
+                        f"attention_window={cfg.attention_window} "
+                        f"set but attention_fn "
+                        f"{getattr(self.attention_fn, '__name__', self.attention_fn)!r} "
+                        f"does not accept a window= kwarg (ring/"
+                        f"ulysses sequence parallelism does not "
+                        f"support sliding windows)") from exc
+            else:
+                o = self.attention_fn(q, expand_kv(k), expand_kv(v))
         return nn.DenseGeneral(cfg.d_model, axis=(-2, -1), use_bias=False,
                                dtype=cfg.dtype, param_dtype=jnp.float32,
                                name="wo")(o)

@@ -72,7 +72,7 @@ def fused_scale_cast(x, factor, out_dtype=None, *, block=4096,
 # flash attention (causal, forward)
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
-                  seq_len, scale):
+                  seq_len, scale, window=None):
     # q_ref: (1, block_q, D); k_ref/v_ref: (1, S, D).  Matmuls run in
     # the INPUT dtype with f32 accumulation: bf16 activations hit the
     # MXU's fast path (f32 operands would halve+ its rate) while f32
@@ -96,6 +96,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
         k_pos = kb * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         mask = q_pos >= k_pos
+        if window is not None:
+            mask = mask & (q_pos - k_pos < window)
         s = jnp.where(mask, s, np.float32(_NEG_INF))
         m_new = jnp.maximum(m, jnp.max(s, axis=1))
         alpha = jnp.exp(m - m_new)
@@ -108,12 +110,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
         return o_new, m_new, l_new
 
     # causal: key blocks covering positions up to the LAST row of this
-    # query block (block_q may exceed block_k)
+    # query block (block_q may exceed block_k); a sliding window also
+    # skips blocks entirely BEFORE the first row's window start
     num_kb = ((qi + 1) * block_q - 1) // block_k + 1
+    first_kb = 0
+    if window is not None:
+        # qi is a traced grid index — stay in jnp
+        first_kb = jnp.maximum(0, qi * block_q - window + 1) // block_k
     o0 = jnp.zeros((block_q, D), jnp.float32)
     m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)
-    o, m, l = jax.lax.fori_loop(0, num_kb, body, (o0, m0, l0))
+    o, m, l = jax.lax.fori_loop(first_kb, num_kb, body, (o0, m0, l0))
     l = jnp.maximum(l, np.float32(1e-30))
     o_ref[0] = (o / l[:, None]).astype(o_ref.dtype)
     # logsumexp per row, consumed by the backward kernels; stored as
@@ -122,7 +129,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
-                         delta_ref, dq_ref, *, block_k, scale):
+                         delta_ref, dq_ref, *, block_k, scale,
+                         window=None):
     """dq for one query block: loop over key blocks <= this one,
     recompute p from (q, k, lse), accumulate ds @ k."""
     block_q = q_ref.shape[1]
@@ -143,6 +151,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
         k_pos = kb * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         mask = q_pos >= k_pos
+        if window is not None:
+            mask = mask & (q_pos - k_pos < window)
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), np.float32(0.0))
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -153,15 +163,18 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
             preferred_element_type=jnp.float32)
 
     num_kb = ((qi + 1) * block_q - 1) // block_k + 1
+    first_kb = 0
+    if window is not None:
+        first_kb = jnp.maximum(0, qi * block_q - window + 1) // block_k
     dq = jax.lax.fori_loop(
-        0, num_kb, body, jnp.zeros((block_q, q_ref.shape[2]),
-                                   jnp.float32))
+        first_kb, num_kb, body, jnp.zeros((block_q, q_ref.shape[2]),
+                                          jnp.float32))
     dq_ref[0] = (dq * np.float32(scale)).astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref,
                           delta_ref, dk_ref, dv_ref, *, block_q,
-                          seq_len, scale):
+                          seq_len, scale, window=None):
     """dk/dv for one key block: loop over query blocks >= this one."""
     block_k = k_ref.shape[1]
     ki = pl.program_id(1)
@@ -182,6 +195,8 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref,
         q_pos = qb * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         mask = q_pos >= k_pos
+        if window is not None:
+            mask = mask & (q_pos - k_pos < window)
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), np.float32(0.0))
         pc = p.astype(do.dtype)
         dv = dv + jax.lax.dot_general(
@@ -197,9 +212,14 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref,
             preferred_element_type=jnp.float32)
         return dk, dv
 
-    # causal: only query blocks whose END reaches this key block
+    # causal: only query blocks whose END reaches this key block; a
+    # sliding window also stops once every query row is PAST the last
+    # key row's window (q_pos >= k_pos_last + window)
     first_qb = (ki * block_k) // block_q
     num_qb = seq_len // block_q
+    if window is not None:
+        last_q = (ki + 1) * block_k - 1 + window - 1   # last visible q
+        num_qb = jnp.minimum(num_qb, last_q // block_q + 1)
     D = k_ref.shape[2]
     dk0 = jnp.zeros((block_k, D), jnp.float32)
     dv0 = jnp.zeros((block_k, D), jnp.float32)
@@ -209,19 +229,22 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def _flash(qf, kf, vf, block_q, block_k, bwd_block_q, bwd_block_k,
-           interpret):
-    out, _ = _flash_fwd_call(qf, kf, vf, block_q, block_k, interpret)
+           window, interpret):
+    out, _ = _flash_fwd_call(qf, kf, vf, block_q, block_k, window,
+                             interpret)
     return out
 
 
-def _flash_fwd_call(qf, kf, vf, block_q, block_k, interpret):
+def _flash_fwd_call(qf, kf, vf, block_q, block_k, window,
+                    interpret):
     BH, S, D = qf.shape
     scale = 1.0 / np.sqrt(D)
     out, lse = pl.pallas_call(
         functools.partial(_flash_kernel, block_k=block_k, seq_len=S,
-                          scale=scale),
+                          scale=scale, window=window),
         out_shape=(jax.ShapeDtypeStruct((BH, S, D), qf.dtype),
                    jax.ShapeDtypeStruct((BH, 1, S), jnp.float32)),
         grid=(BH, S // block_q),
@@ -238,8 +261,9 @@ def _flash_fwd_call(qf, kf, vf, block_q, block_k, interpret):
 
 
 def _flash_vjp_fwd(qf, kf, vf, block_q, block_k, bwd_block_q,
-                   bwd_block_k, interpret):
-    out, lse = _flash_fwd_call(qf, kf, vf, block_q, block_k, interpret)
+                   bwd_block_k, window, interpret):
+    out, lse = _flash_fwd_call(qf, kf, vf, block_q, block_k, window,
+                               interpret)
     # named so a checkpoint policy can SAVE the kernel's outputs:
     # they are a pallas custom call, not a dot, so the "dots" policy
     # alone re-runs every flash forward during the backward replay
@@ -251,7 +275,7 @@ def _flash_vjp_fwd(qf, kf, vf, block_q, block_k, bwd_block_q,
 
 
 def _flash_vjp_bwd(block_q, block_k, bwd_block_q, bwd_block_k,
-                   interpret, res, do):
+                   window, interpret, res, do):
     # the backward kernels tile independently of the forward: their
     # per-block dot chain (5 matmuls + exp) has a different
     # VMEM/pipeline sweet spot than the forward's 2
@@ -265,7 +289,7 @@ def _flash_vjp_bwd(block_q, block_k, bwd_block_q, bwd_block_k,
                     axis=-1)[:, None, :]              # (BH, 1, S)
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
-                          scale=scale),
+                          scale=scale, window=window),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), qf.dtype),
         grid=(BH, S // block_q),
         in_specs=[
@@ -281,7 +305,7 @@ def _flash_vjp_bwd(block_q, block_k, bwd_block_q, bwd_block_k,
     )(qf, kf, vf, do, lse, delta)
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
-                          seq_len=S, scale=scale),
+                          seq_len=S, scale=scale, window=window),
         out_shape=(jax.ShapeDtypeStruct((BH, S, D), kf.dtype),
                    jax.ShapeDtypeStruct((BH, S, D), vf.dtype)),
         grid=(BH, S // block_k),
@@ -305,7 +329,7 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 def flash_attention(q, k, v, *, block_q=512, block_k=512,
                     bwd_block_q=None, bwd_block_k=None,
-                    interpret=None):
+                    window=None, interpret=None):
     """Causal attention (B, S, H, D) -> (B, S, H, D), flash-style.
 
     Memory: O(block_q * S) VMEM per program instead of O(S^2) HBM —
@@ -316,10 +340,21 @@ def flash_attention(q, k, v, *, block_q=512, block_k=512,
     ``bwd_block_*`` tile the backward kernels independently (their
     5-matmul block body has a different VMEM sweet spot than the
     forward's 2); default: same as the forward blocks.
+    ``window`` enables SLIDING-WINDOW attention (mistral-style): each
+    query sees only the last ``window`` positions, and all three
+    kernels skip blocks wholly outside the band — attention cost
+    becomes O(S·window) instead of O(S²/2).  Gradient-exact vs
+    ``dense_causal_attention(window=...)``.
     """
     if interpret is None:
         interpret = not _is_tpu()
     B, S, H, D = q.shape
+    if window is not None:
+        window = int(window)
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if window >= S:
+            window = None       # full causal — use the cheaper masks
 
     # blocks must divide S: clamp, then fall back to the LARGEST
     # divisor of S that still fits under the requested block (NOT the
@@ -354,5 +389,5 @@ def flash_attention(q, k, v, *, block_q=512, block_k=512,
     kf = k.transpose(0, 2, 1, 3).reshape(B * H, S, D)
     vf = v.transpose(0, 2, 1, 3).reshape(B * H, S, D)
     out = _flash(qf, kf, vf, block_q, block_k, bwd_block_q,
-                 bwd_block_k, interpret)
+                 bwd_block_k, window, interpret)
     return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)

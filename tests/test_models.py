@@ -344,6 +344,65 @@ def test_gqa_forward_trains_and_caches():
                           max_seq_len=8).kv_heads
 
 
+def test_attention_window_consistent_train_and_decode():
+    """TransformerConfig(attention_window=W): the dense and flash
+    training paths compute the same windowed logits, cached greedy
+    decode matches the windowed full re-forward exactly, and the
+    sequence-parallel inners reject the window loudly instead of
+    silently training full-causal."""
+    from functools import partial
+
+    from horovod_tpu.models import make_generate_fn
+    from horovod_tpu.ops.pallas_kernels import flash_attention
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_layers=2,
+                            n_heads=2, d_ff=64, max_seq_len=32,
+                            attention_window=8, dtype=jnp.float32)
+    model = TransformerLM(cfg)                      # dense windowed
+    prompt = jax.random.randint(jax.random.PRNGKey(0), (2, 32), 0, 64)
+    params = model.init(jax.random.PRNGKey(1), prompt)["params"]
+    logits_dense = model.apply({"params": params}, prompt)
+
+    # flash inner gets the same window from the config
+    flash_model = TransformerLM(cfg, attention_fn=partial(
+        flash_attention, block_q=8, block_k=8, interpret=True))
+    logits_flash = flash_model.apply({"params": params}, prompt)
+    np.testing.assert_allclose(np.asarray(logits_dense),
+                               np.asarray(logits_flash),
+                               rtol=2e-4, atol=2e-4)
+
+    # the window actually binds: full-causal logits differ
+    logits_full = TransformerLM(
+        TransformerConfig(vocab_size=64, d_model=32, n_layers=2,
+                          n_heads=2, d_ff=64, max_seq_len=32,
+                          dtype=jnp.float32)).apply(
+        {"params": params}, prompt)
+    assert not np.allclose(np.asarray(logits_dense),
+                           np.asarray(logits_full), atol=1e-3)
+
+    # cached decode applies the SAME window as training
+    gen = make_generate_fn(model, max_new_tokens=4)
+    short = prompt[:, :20]
+    cached = np.asarray(gen(params, short))
+    toks = short
+    expected = []
+    for _ in range(4):
+        lg = model.apply({"params": params}, toks)
+        nxt = jnp.argmax(lg[:, -1], axis=-1)
+        expected.append(nxt)
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+    expected = np.stack([np.asarray(e) for e in expected], axis=1)
+    assert np.array_equal(cached, expected), (cached, expected)
+
+    # inners without window support fail loudly
+    def no_window_attn(q, k, v):
+        return q
+
+    bad = TransformerLM(cfg, attention_fn=no_window_attn)
+    with pytest.raises(ValueError, match="window"):
+        bad.init(jax.random.PRNGKey(2), prompt)
+
+
 def test_kv_cache_decode_sampling_reproducible():
     from horovod_tpu.models import make_generate_fn
     cfg = TransformerConfig(vocab_size=64, d_model=32, n_layers=2,

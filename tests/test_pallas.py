@@ -65,6 +65,48 @@ def test_flash_attention_uneven_blocks():
                                rtol=2e-5, atol=2e-5)
 
 
+def test_flash_attention_sliding_window():
+    """window=W (mistral-style sliding window) must match the dense
+    windowed reference in values AND gradients, across window sizes
+    that hit every block-boundary case (W < block, W % block != 0,
+    W = S, W > S degenerating to full causal)."""
+    from functools import partial
+
+    from horovod_tpu.models.transformer import dense_causal_attention
+
+    B, S, H, D = 2, 64, 2, 8
+    keys = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, S, H, D)) for kk in keys)
+
+    def loss(fn, q, k, v):
+        return jnp.sum(fn(q, k, v) ** 2)
+
+    # unequal block pairs included: the block-skip bounds (first_kb
+    # floor division, dkv num_qb clamp) depend on the block ratio
+    for bq, bk in ((16, 16), (32, 8), (8, 32)):
+        for W in (1, 5, 16, 17, 63, 64, 200):
+            dense_w = W if W < S else None
+            flash = partial(flash_attention, block_q=bq, block_k=bk,
+                            window=W, interpret=True)
+            out = flash(q, k, v)
+            ref = dense_causal_attention(q, k, v, window=dense_w)
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref), rtol=2e-5,
+                atol=2e-5, err_msg=f"bq={bq} bk={bk} W={W}")
+            gf = jax.grad(partial(loss, flash),
+                          argnums=(0, 1, 2))(q, k, v)
+            gd = jax.grad(partial(loss, partial(
+                dense_causal_attention, window=dense_w)),
+                argnums=(0, 1, 2))(q, k, v)
+            for a, b in zip(gf, gd):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=5e-5,
+                    atol=5e-5, err_msg=f"bq={bq} bk={bk} W={W}")
+
+    with pytest.raises(ValueError, match="window"):
+        flash_attention(q, k, v, window=0, interpret=True)
+
+
 def test_flash_attention_independent_bwd_blocks():
     """bwd_block_q/bwd_block_k tile the backward kernels independently
     of the forward; gradients must be identical to the shared-block

@@ -120,6 +120,37 @@ def test_lm_train_step_matches_single_device():
                                    rtol=5e-4, atol=5e-5)
 
 
+def test_attention_window_sharded_flash_step():
+    """TransformerConfig(attention_window=W) rides through the
+    sharded flash train step (the config forwards the window to the
+    pallas kernel) and matches the dense windowed reference step."""
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_layers=2,
+                            n_heads=2, d_ff=64, max_seq_len=32,
+                            attention_window=8, dtype=jnp.float32)
+    mesh = build_mesh(dp=4, tp=2)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (4, 32), 0,
+                                cfg.vocab_size)
+    # dense windowed reference (un-jitted single device)
+    init_d, step_d, _, _ = make_lm_train_step(
+        mesh, cfg, optimizer=optax.sgd(0.1))
+    _, ref_loss = step_d(init_d(jax.random.PRNGKey(1), tokens), tokens)
+
+    init_f, _, jit_f, tok_shd = make_lm_train_step(
+        mesh, cfg, optimizer=optax.sgd(0.1), attention_impl="flash")
+    compiled, state = jit_f(init_f(jax.random.PRNGKey(1), tokens))
+    _, loss = compiled(state, jax.device_put(tokens, tok_shd))
+    np.testing.assert_allclose(float(loss), float(ref_loss),
+                               rtol=1e-4, atol=1e-5)
+
+    # sequence-parallel inners reject the window loudly
+    sp_mesh = build_mesh(dp=2, tp=2, sp=2)
+    with pytest.raises(ValueError, match="window"):
+        init_r, step_r, _, _ = make_lm_train_step(
+            sp_mesh, cfg, optimizer=optax.sgd(0.1),
+            sequence_parallel=True, attention_impl="ring")
+        step_r(init_r(jax.random.PRNGKey(1), tokens), tokens)
+
+
 def test_gqa_sharded_train_step():
     """GQA (n_kv_heads=2 serving 4 query heads) under the tp-sharded
     train step: kv projections shard over tp at the reduced head

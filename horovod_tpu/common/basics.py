@@ -443,6 +443,22 @@ def tpu_built():
     return True
 
 
+def mpi_enabled():
+    """Whether the MPI controller drives negotiation (reference
+    mpi_ops ``mpi_enabled``).  Never on TPU — the store controller
+    fills that role."""
+    return False
+
+
+def gloo_enabled():
+    """Whether the gloo-style control plane is active (reference
+    mpi_ops ``gloo_enabled``).  Always True: the HMAC-HTTP store
+    controller (core/store_controller.py) fills the gloo controller's
+    role on every launch path, including elastic.  Note
+    ``gloo_built()`` stays False — no libgloo is linked."""
+    return True
+
+
 def start_timeline(filename, mark_cycles=False):
     """Runtime timeline activation (reference operations.cc:1077)."""
     global _timeline
@@ -463,3 +479,36 @@ def stop_timeline():
             _timeline.close()
         _timeline = None
         eng.timeline = None
+
+
+# -- reference-shaped surface (horovod/common/basics.py:21-29) ---------------
+
+class MPI:
+    """Typing stand-in matching the reference's lazy mpi4py shim
+    (reference basics.py:21-23) — there is no MPI on TPU pods, so
+    ``MPI.Comm`` only exists for signature compatibility."""
+
+    class Comm:
+        ...
+
+
+class HorovodBasics:
+    """Object-shaped view of this module (reference basics.py:29
+    wraps the C library in a class; frontends hold an instance).
+    Every method delegates to the module-level implementation, so
+    ``HorovodBasics().rank()`` and ``basics.rank()`` are the same."""
+
+    def __init__(self, pkg_path=None, *args):
+        # the reference dlopen()s the compiled extension here; this
+        # runtime is pure Python so the arguments are accepted and
+        # ignored
+        self.MPI_LIB_CTYPES = None
+
+    def __getattr__(self, name):
+        import sys
+        mod = sys.modules[__name__]
+        try:
+            return getattr(mod, name)
+        except AttributeError:
+            raise AttributeError(
+                f"'HorovodBasics' object has no attribute '{name}'")

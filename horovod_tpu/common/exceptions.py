@@ -28,6 +28,27 @@ class HostsUpdatedInterrupt(RuntimeError):
         self.skip_sync = skip_sync
 
 
+def get_version_mismatch_message(name, version, installed_version):
+    """Reference horovod/common/exceptions.py:39."""
+    return (
+        f"Framework {name} installed with version {version} but found "
+        f"version {installed_version}.\n\t     This can result in "
+        "unexpected behavior including runtime errors.\n\t     Reinstall "
+        "horovod_tpu so the frontend and runtime versions match.")
+
+
+class HorovodVersionMismatchError(ImportError):
+    """Frontend and runtime were built from different versions
+    (reference horovod/common/exceptions.py:48)."""
+
+    def __init__(self, name, version, installed_version):
+        super().__init__(get_version_mismatch_message(
+            name, version, installed_version))
+        self.name = name
+        self.version = version
+        self.installed_version = installed_version
+
+
 class HorovodInitError(RuntimeError):
     """Raised when the runtime is used before ``init()`` (or after
     ``shutdown()``)."""

@@ -101,3 +101,160 @@ def dumps(obj) -> np.ndarray:
 def loads(arr) -> object:
     import pickle
     return pickle.loads(arr.tobytes())
+
+
+# -- reference horovod/common/util.py parity helpers -------------------------
+#
+# The reference's util module doubles as its build-introspection layer
+# (compiled per-framework extensions, metadata.json version stamps).
+# This build has no compiled frontend extensions — the queries below
+# answer for the frontends' importability and this package's version
+# instead, keeping the call sites of migrating scripts working.
+
+EXTENSIONS = ("tensorflow", "torch", "mxnet", "jax")
+
+
+def get_ext_suffix():
+    """Native-extension filename suffix (reference util.py:34)."""
+    import sysconfig
+    return sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+
+
+def get_extension_full_path(pkg_path, *args):
+    """Path a compiled extension would occupy (reference util.py:47)."""
+    import os
+    dir_path = os.path.join(os.path.dirname(pkg_path), *args[:-1])
+    return os.path.join(dir_path, args[-1] + get_ext_suffix())
+
+
+def extension_available(ext_base_name, verbose=False):
+    """Whether the named frontend is usable (reference util.py:108).
+    There is no compiled extension to probe; the frontend is available
+    iff its framework imports."""
+    import importlib.util
+    if ext_base_name not in EXTENSIONS:
+        return False
+    return importlib.util.find_spec(ext_base_name) is not None
+
+
+def check_extension(ext_name, ext_env_var, pkg_path, *args):
+    """Reference util.py:54 raises when a frontend was built without
+    its extension.  Here the equivalent failure is the framework being
+    absent from the environment."""
+    base = ext_name.split(".")[-1]
+    if base in EXTENSIONS and not extension_available(base):
+        raise ImportError(
+            f"Extension {ext_name} requires {base}, which is not "
+            f"installed in this environment.")
+
+
+def gpu_available(ext_base_name, verbose=False):
+    """Reference util.py:131.  The TPU build has no CUDA/ROCm path;
+    accelerator presence is a JAX device query, see ``tpu_built``."""
+    return False
+
+
+def env(**kwargs):
+    """Context manager: temporarily set environment variables, ignoring
+    None values (reference util.py:189)."""
+    import contextlib
+    import os
+
+    @contextlib.contextmanager
+    def _ctx():
+        updates = {k: v for k, v in kwargs.items() if v is not None}
+        backup = {k: os.environ.get(k) for k in updates}
+        os.environ.update(updates)
+        try:
+            yield
+        finally:
+            for k, old in backup.items():
+                if old is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = old
+
+    return _ctx()
+
+
+def get_average_backwards_compatibility_fun(reduce_ops):
+    """Adapter from the deprecated ``average=`` kwarg to ``op=``
+    (reference util.py:214-232): passing both is an error, ``average``
+    alone warns and maps True/False to Average/Sum, neither defaults
+    to Average."""
+    import warnings
+
+    def impl(op, average):
+        if op is not None:
+            if average is not None:
+                raise ValueError(
+                    "The op parameter supersedes average. Please "
+                    "provide only one of them.")
+            return op
+        if average is not None:
+            warnings.warn(
+                "Parameter `average` has been replaced with `op` and "
+                "will be removed in v1.0", DeprecationWarning)
+            return reduce_ops.Average if average else reduce_ops.Sum
+        return reduce_ops.Average
+
+    return impl
+
+
+def num_rank_is_power_2(num_rank):
+    """Adasum's rank-count precondition (reference util.py:235)."""
+    return num_rank != 0 and (num_rank & (num_rank - 1)) == 0
+
+
+def split_list(l, n):  # noqa: E741 — reference signature
+    """Split ``l`` into ``n`` approximately even chunks (reference
+    util.py:244)."""
+    d, r = divmod(len(l), n)
+    return [l[i * d + min(i, r):(i + 1) * d + min(i + 1, r)]
+            for i in range(n)]
+
+
+def is_iterable(x):
+    try:
+        iter(x)
+    except TypeError:
+        return False
+    return True
+
+
+def is_version_greater_equal_than(ver, target):
+    """Reference util.py:272 — target must be major.minor.patch."""
+    from packaging import version
+    if not isinstance(ver, str) or not isinstance(target, str):
+        raise ValueError("This function only accepts string arguments.")
+    if len(target.split(".")) != 3:
+        raise ValueError(
+            "We only accept target version values in the form of: "
+            f"major.minor.patch. Received: {target}")
+    return version.parse(ver) >= version.parse(target)
+
+
+def check_installed_version(name, version, exception=None):
+    """Reference util.py:252 compares a frontend's import-time version
+    stamp against the installed package's; here the package is pure
+    Python so the stamp is always this module's own version."""
+    import warnings
+    from ..version import __version__
+    from .exceptions import (
+        HorovodVersionMismatchError, get_version_mismatch_message,
+    )
+    if version != __version__:
+        if exception is None:
+            warnings.warn(get_version_mismatch_message(
+                name, version, __version__))
+        else:
+            raise HorovodVersionMismatchError(
+                name, version, __version__) from exception
+
+
+def support_non_legacy_keras_optimizers(k):
+    """Whether keras's non-legacy optimizer classes predate the 2.11
+    split (reference util.py:292)."""
+    from packaging import version
+    return version.parse(
+        k.__version__.replace("-tf", "+tf")) < version.parse("2.11")

@@ -55,6 +55,30 @@ class DataServiceConfig:
     def from_dict(cls, d):
         return cls(**d)
 
+    def write(self, path):
+        """Persist for out-of-band handoff (reference
+        TfDataServiceConfig.write — the compute job writes its config
+        file, the training job polls for it)."""
+        import json
+        import os
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.to_dict(), f)
+        os.replace(tmp, path)
+
+    @classmethod
+    def read(cls, path, wait_for_file=False, timeout=60.0):
+        import json
+        import os
+        deadline = time.monotonic() + timeout
+        while wait_for_file and not os.path.exists(path):
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"no data service config at {path} "
+                                   f"after {timeout}s")
+            time.sleep(0.1)
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
 
 class DataServiceServer:
     """Dispatcher + in-process compute workers.
@@ -69,10 +93,16 @@ class DataServiceServer:
 
     def __init__(self, dataset_fn: Callable[[int, int], Iterator],
                  num_workers: int = 1, queue_size: int = 8,
-                 secret: bytes = None, reuse_server=None):
+                 secret: bytes = None, reuse_server=None,
+                 remote_workers: bool = False):
         self.dataset_fn = dataset_fn
         self.num_workers = num_workers
         self.queue_size = queue_size
+        # remote_workers: this process only hosts the KV dispatcher;
+        # the produce loops run in other processes/hosts via
+        # :func:`run_remote_worker` (the multi-host compute cluster of
+        # reference compute_worker.py — input CPU scales with hosts)
+        self.remote_workers = remote_workers
         # a fresh secret per service: batches are pickles, so the HMAC
         # is the only thing standing between the 0.0.0.0 listener and
         # arbitrary code execution — same policy as the job launcher
@@ -95,11 +125,13 @@ class DataServiceServer:
         # batches are pulled through the KV store: worker w publishes
         # /data/<w>/<seq>; the consumer deletes after read (bounded by
         # the producer waiting for the delete)
-        for w in range(self.num_workers):
-            t = threading.Thread(target=self._produce, args=(w,),
-                                 name=f"data-worker-{w}", daemon=True)
-            t.start()
-            self._threads.append(t)
+        if not self.remote_workers:
+            for w in range(self.num_workers):
+                t = threading.Thread(target=self._produce, args=(w,),
+                                     name=f"data-worker-{w}",
+                                     daemon=True)
+                t.start()
+                self._threads.append(t)
         return DataServiceConfig(
             addr=local_ip(), port=self._port,
             secret_hex=self._secret.hex(),
@@ -135,6 +167,44 @@ class DataServiceServer:
             t.join(timeout=5)
         if self._owns_server:
             self._server.stop()
+
+
+def run_remote_worker(config: DataServiceConfig, worker_index: int,
+                      dataset_fn: Callable[[int, int], Iterator],
+                      queue_size: int = 8,
+                      stop_event: Optional[threading.Event] = None):
+    """Produce loop for one worker slot running OUTSIDE the dispatcher
+    process: batches go to the dispatcher's KV store over HTTP with the
+    same delete-based flow control as the in-process path.  This is how
+    a set of hosts becomes a data-compute cluster (reference
+    compute_worker.py) — each host's CPUs run their own iterator.
+    Blocks until the iterator is exhausted or ``stop_event`` is set.
+    """
+    if isinstance(config, dict):
+        config = DataServiceConfig.from_dict(config)
+    client = StoreClient(config.addr, config.port,
+                         bytes.fromhex(config.secret_hex))
+    stop = stop_event or threading.Event()
+    w, seq, final = worker_index, 0, None
+    try:
+        it = dataset_fn(w, config.num_workers)
+        for batch in it:
+            while not stop.is_set():
+                if seq < queue_size or client.get(
+                        f"/data/{w}/{seq - queue_size}") is None:
+                    break
+                # backpressure poll re-fetches the undelivered batch
+                # body over HTTP, so poll sparsely
+                time.sleep(0.05)
+            if stop.is_set():
+                return
+            client.put(f"/data/{w}/{seq}",
+                       pickle.dumps(batch, protocol=4))
+            seq += 1
+    except BaseException as exc:  # noqa: BLE001 — forwarded
+        final = _WorkerError(f"{type(exc).__name__}: {exc}")
+    finally:
+        client.put(f"/data/{w}/{seq}", pickle.dumps(final, protocol=4))
 
 
 def data_service(config: DataServiceConfig, rank: int = 0,

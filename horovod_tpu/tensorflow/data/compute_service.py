@@ -1,0 +1,109 @@
+"""Reference import path ``horovod.tensorflow.data.compute_service``
+(reference compute_service.py:34-147).
+
+The service itself is framework-neutral (``horovod_tpu.data.service``);
+this module adds the reference's trainer-side verbs:
+
+* :func:`compute_worker_fn` — run a compute worker that waits for the
+  trainer to ship a dataset function.
+* :func:`send_to_data_service` — ship a dataset *function* to the
+  workers over the authenticated KV store and consume the resulting
+  stream.  (The reference serializes a ``tf.data.Dataset`` graph into
+  its dispatcher; a Dataset object itself does not pickle, so the
+  TPU-native contract ships the zero-arg callable that builds it.)
+"""
+
+import pickle
+import time
+
+from . import TfDataServiceConfig, tf_data_service  # noqa: F401
+from ...data.service import (  # noqa: F401
+    DataServiceConfig, DataServiceServer, data_service,
+    run_remote_worker,
+)
+
+_FN_KEY = "/data/_dataset_fn"
+
+
+def _pickle_fn(fn):
+    try:
+        import cloudpickle
+        return cloudpickle.dumps(fn)
+    except ImportError:
+        return pickle.dumps(fn)
+
+
+def _waiting_fn(dataset_fn, get_raw, stop_is_set, timeout=0):
+    """Wrap ``dataset_fn`` so a None value means "wait for the trainer
+    to ship one" (send_to_data_service publishes it under _FN_KEY).
+    ``timeout`` > 0 bounds the wait; the server's stop event ends it."""
+
+    def _fn(worker_index, n_workers):
+        if dataset_fn is not None:
+            return dataset_fn(worker_index, n_workers)
+        deadline = time.monotonic() + timeout if timeout else None
+        while not stop_is_set():
+            raw = get_raw(_FN_KEY)
+            if raw is not None:
+                shipped = pickle.loads(raw)
+                return shipped(worker_index, n_workers)
+            if deadline and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"no dataset_fn shipped to the data service "
+                    f"within {timeout}s")
+            time.sleep(0.05)
+        return iter(())
+
+    return _fn
+
+
+def compute_worker_fn(compute_config=None, dataset_fn=None,
+                      num_workers=1, queue_size=8, port=0, timeout=0):
+    """Run a single-process compute service (reference
+    compute_service.py ``compute_worker_fn`` — the fn handed to
+    ``horovod.spark.run`` so executors become data workers; the
+    multi-host form is the compute_worker CLI).
+
+    With ``dataset_fn=None`` the workers block until the trainer ships
+    one via :func:`send_to_data_service` (``timeout`` > 0 bounds the
+    wait).  Returns the started :class:`DataServiceServer` and its
+    config.
+    """
+    server_holder = {}
+    server = DataServiceServer(
+        _waiting_fn(
+            dataset_fn,
+            lambda key: server_holder["server"]._server.store.get(key),
+            lambda: server_holder["server"]._stop.is_set(),
+            timeout),
+        num_workers=num_workers, queue_size=queue_size)
+    server_holder["server"] = server
+    config = server.start(port)
+    return server, config
+
+
+def send_to_data_service(dataset_fn, compute_config, rank=0, size=1,
+                         timeout=60.0, prefetch=2):
+    """Ship ``dataset_fn(worker_index, num_workers) -> iterator`` to
+    the compute workers and return the stream of this rank's batches
+    (reference compute_service.py ``send_to_data_service``).
+
+    ``dataset_fn`` must be a picklable callable; a materialized
+    ``tf.data.Dataset`` is rejected with guidance because dataset
+    objects do not serialize across processes.
+    """
+    if hasattr(dataset_fn, "element_spec"):
+        raise TypeError(
+            "send_to_data_service expects a callable "
+            "dataset_fn(worker_index, num_workers) -> iterator, not a "
+            "tf.data.Dataset: dataset objects do not pickle across "
+            "processes. Wrap the dataset construction in a function.")
+    if isinstance(compute_config, dict):
+        compute_config = DataServiceConfig.from_dict(compute_config)
+
+    from ...runner.http.http_client import StoreClient
+    client = StoreClient(compute_config.addr, compute_config.port,
+                         bytes.fromhex(compute_config.secret_hex))
+    client.put(_FN_KEY, _pickle_fn(dataset_fn))
+    return data_service(compute_config, rank=rank, size=size,
+                        timeout=timeout, prefetch=prefetch)

@@ -1,0 +1,74 @@
+"""Compute-worker CLI (reference
+``horovod/tensorflow/data/compute_worker.py``): launched under the
+runner so a set of hosts becomes a data-compute cluster.
+
+Reference flow: rank 0 starts the ComputeService, writes the config
+file, every rank runs a worker, trainer discovers the service through
+the file.  Same flow here: rank 0 hosts the KV dispatcher
+(``remote_workers=True`` — no local produce loops) and EVERY rank runs
+its own produce loop (``run_remote_worker``) on its own host's CPUs,
+publishing batches to the dispatcher over the authenticated fabric, so
+input throughput scales with hosts.
+"""
+
+import argparse
+import threading
+import time
+
+from . import compute_service as _cs
+from ...data.service import DataServiceServer, run_remote_worker
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="horovod_tpu data compute worker")
+    parser.add_argument("configfile",
+                        help="path rank 0 writes the service config to")
+    parser.add_argument("--queue-size", type=int, default=8)
+    parser.add_argument("--timeout", type=int, default=0,
+                        help="seconds to wait for the trainer to ship "
+                             "a dataset_fn (0 = wait forever)")
+    args = parser.parse_args(argv)
+
+    from ...common import basics as hvd
+    from ...ops.api import broadcast_object
+    hvd.init()
+    server = None
+    try:
+        if hvd.rank() == 0:
+            server = DataServiceServer(None, num_workers=hvd.size(),
+                                       queue_size=args.queue_size,
+                                       remote_workers=True)
+            config = server.start(0)
+            config.write(args.configfile)
+        config = broadcast_object(
+            config.to_dict() if hvd.rank() == 0 else None,
+            root_rank=0, name="data_service_config")
+
+        # each rank produces its own worker slot on its own host
+        stop = threading.Event()
+        fetch = _cs._waiting_fn(
+            None,
+            _make_store_get(config), stop.is_set, args.timeout)
+        run_remote_worker(config, hvd.rank(), fetch,
+                          queue_size=args.queue_size, stop_event=stop)
+    finally:
+        if server is not None:
+            # drain delay so remote workers' final sentinels land
+            time.sleep(0.5)
+            server.stop()
+        hvd.shutdown()
+
+
+def _make_store_get(config):
+    from ...data.service import DataServiceConfig
+    from ...runner.http.http_client import StoreClient
+    if isinstance(config, dict):
+        config = DataServiceConfig.from_dict(config)
+    client = StoreClient(config.addr, config.port,
+                         bytes.fromhex(config.secret_hex))
+    return client.get
+
+
+if __name__ == "__main__":
+    main()

@@ -1,11 +1,33 @@
 """TF helper functions (reference ``horovod/tensorflow/functions.py``:
-broadcast_object/allgather_object live in ops.api; model-level helpers
-here)."""
+broadcast_variables/broadcast_object(_fn)/allgather_object, plus
+model-level helpers).
+
+The object collectives are framework-neutral (ops/api.py pickles to a
+uint8 tensor and rides the same engine path — reference
+functions.py:97-207 does the same via cloudpickle + allgather);
+``broadcast_variables``/``broadcast_object_fn`` are defined with the
+tape machinery in ``__init__`` and re-exported here under the
+reference module path."""
 
 import tensorflow as tf
 
 from ..common.process_sets import global_process_set
 from ..ops import api
+from ..ops.api import broadcast_object, allgather_object  # noqa: F401
+
+
+def broadcast_variables(*args, **kwargs):
+    """Reference functions.py:66 — defined in the package root (it
+    shares the group-broadcast machinery); thin dispatch keeps this
+    import path working."""
+    from . import broadcast_variables as impl
+    return impl(*args, **kwargs)
+
+
+def broadcast_object_fn(*args, **kwargs):
+    """Reference functions.py:144."""
+    from . import broadcast_object_fn as impl
+    return impl(*args, **kwargs)
 
 
 def broadcast_model(model, root_rank=0, process_set=global_process_set):

@@ -1,0 +1,153 @@
+"""Graph/tf.function local gradient aggregation (reference
+``horovod/tensorflow/gradient_aggregation.py:23-340``).
+
+Accumulates ``backward_passes_per_step`` micro-batch gradients in
+non-trainable variables and allreduces/applies every N-th call, using
+``tf.cond`` on a counter variable so the same code works eagerly and
+under ``tf.function`` tracing.  ``DistributedOptimizer(...,
+backward_passes_per_step=N)`` embeds this logic directly
+(``__init__._apply_aggregated``); these classes are the standalone
+reference-shaped surface for code that drives the helper itself.
+"""
+
+import tensorflow as tf
+
+from ..common.process_sets import global_process_set
+
+
+def apply_op_to_not_none_tensors(tensor_op, tensors, *args):
+    """Reference gradient_aggregation.py:11."""
+    return [tensor_op(t, *args) if t is not None else t for t in tensors]
+
+
+def get_not_none_from_list(tensor_list):
+    """Reference gradient_aggregation.py:19."""
+    return [x for x in tensor_list if x is not None]
+
+
+class LocalGradientAggregationHelper:
+    """Reference gradient_aggregation.py:23 — graph-mode aggregation.
+
+    ``compute_gradients(grads, vars)`` returns locally-aggregated
+    gradients, allreduced on every ``backward_passes_per_step``-th
+    call; ``apply_gradients(closure, optimizer, ...)`` runs the
+    closure only on those calls and advances ``optimizer.iterations``
+    on the skipped ones.
+    """
+
+    _OPTIMIZER_TYPE_KERAS = "optimizer_type_keras"
+    _OPTIMIZER_TYPE_LEGACY = "optimizer_type_legacy"
+
+    def __init__(self, backward_passes_per_step, allreduce_func,
+                 sparse_as_dense, average_aggregated_gradients,
+                 rank=0, optimizer_type=_OPTIMIZER_TYPE_KERAS,
+                 process_set=global_process_set,
+                 scale_local_gradients=True):
+        if backward_passes_per_step <= 0:
+            raise ValueError("backward_passes_per_step must be > 0")
+        self.backward_passes_per_step = backward_passes_per_step
+        self.allreduce_grads = allreduce_func
+        self.sparse_as_dense = sparse_as_dense
+        self.average_aggregated_gradients = average_aggregated_gradients
+        self.rank = rank
+        self.optimizer_type = optimizer_type
+        self.process_set = process_set
+        self.scale_local_gradients = scale_local_gradients
+        self.locally_aggregated_grads = {}
+        self.counter = None
+        self._local_vars = set()
+
+    def register_local_var(self, var):
+        """Gradients of registered variables skip the allreduce and
+        stay local (reference :80)."""
+        self._local_vars.add(var.ref())
+
+    def _maybe_convert_grad(self, grad):
+        if isinstance(grad, tf.IndexedSlices):
+            if self.sparse_as_dense:
+                return tf.convert_to_tensor(grad)
+            raise ValueError(
+                "IndexedSlices are not supported when "
+                "`backward_passes_per_step` > 1 and `sparse_as_dense` "
+                "is False.")
+        return grad
+
+    def _init_aggregation_vars(self, grads):
+        if self.counter is None:
+            self.counter = tf.Variable(0, trainable=False,
+                                       dtype=tf.int64,
+                                       name="hvd_aggregation_counter")
+        for idx, grad in enumerate(grads):
+            if idx not in self.locally_aggregated_grads and \
+                    grad is not None:
+                self.locally_aggregated_grads[idx] = tf.Variable(
+                    tf.zeros_like(grad), trainable=False,
+                    dtype=grad.dtype)
+
+    def _allreduce_helper(self, grads, tvars):
+        reduce_vars, reduce_grads = [], []
+        v2g = {v.ref(): g for v, g in zip(tvars, grads)}
+        for v, g in zip(tvars, grads):
+            if v.ref() not in self._local_vars:
+                reduce_vars.append(v)
+                reduce_grads.append(g)
+        reduced = self.allreduce_grads(reduce_grads, reduce_vars)
+        for v, g in zip(reduce_vars, reduced):
+            v2g[v.ref()] = g
+        if self.scale_local_gradients and self._local_vars:
+            ps_size = self.process_set.size()
+            for ref in list(v2g):
+                if ref in self._local_vars and v2g[ref] is not None:
+                    v2g[ref] = v2g[ref] / ps_size
+        out = [v2g[v.ref()] for v in tvars]
+        if self.average_aggregated_gradients:
+            out = apply_op_to_not_none_tensors(
+                lambda g: g / self.backward_passes_per_step, out)
+        return out
+
+    def compute_gradients(self, grads, vars):  # noqa: A002
+        grads = [self._maybe_convert_grad(g) if g is not None else None
+                 for g in grads]
+        self._init_aggregation_vars(grads)
+
+        aggregated = []
+        for idx, grad in enumerate(grads):
+            if grad is None:
+                aggregated.append(None)
+                continue
+            buf = self.locally_aggregated_grads[idx]
+            buf.assign_add(grad)
+            aggregated.append(buf.read_value())
+
+        self.counter.assign_add(1)
+
+        def _reduce_and_clear():
+            reduced = self._allreduce_helper(aggregated, list(vars))
+            with tf.control_dependencies(
+                    get_not_none_from_list(reduced)):
+                clear = [v.assign(tf.zeros_like(v))
+                         for v in self.locally_aggregated_grads.values()]
+            with tf.control_dependencies(clear):
+                return [tf.identity(g) if g is not None else None
+                        for g in reduced]
+
+        return tf.cond(
+            tf.equal(self.counter % self.backward_passes_per_step, 0),
+            _reduce_and_clear,
+            lambda: aggregated)
+
+    def apply_gradients(self, apply_grads_closure, optimizer,
+                        *args, **kwargs):
+        def _increment_iteration():
+            # a skipped step still advances the optimizer clock so LR
+            # schedules keyed on iterations see wall-clock steps
+            # (reference :307-340)
+            if hasattr(optimizer, "iterations") and \
+                    optimizer.iterations is not None:
+                return optimizer.iterations.assign_add(1).op
+            return tf.no_op()
+
+        return tf.cond(
+            tf.equal(self.counter % self.backward_passes_per_step, 0),
+            apply_grads_closure,
+            _increment_iteration)

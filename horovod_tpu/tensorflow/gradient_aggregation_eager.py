@@ -1,0 +1,98 @@
+"""Eager local gradient aggregation (reference
+``horovod/tensorflow/gradient_aggregation_eager.py:12-180``).
+
+Same accumulate-every-N contract as
+:class:`..gradient_aggregation.LocalGradientAggregationHelper`, with
+the counter reset eagerly instead of via control dependencies.
+"""
+
+import tensorflow as tf
+
+from ..common.process_sets import global_process_set
+
+
+class LocalGradientAggregationHelperEager:
+    """Reference gradient_aggregation_eager.py:12."""
+
+    def __init__(self, backward_passes_per_step, allreduce_func,
+                 sparse_as_dense, average_aggregated_gradients,
+                 process_set=global_process_set,
+                 scale_local_gradients=True):
+        if backward_passes_per_step <= 0:
+            raise ValueError("backward_passes_per_step must be > 0")
+        self.backward_passes_per_step = backward_passes_per_step
+        self.allreduce_grads = allreduce_func
+        self.sparse_as_dense = sparse_as_dense
+        self.average_aggregated_gradients = average_aggregated_gradients
+        self.process_set = process_set
+        self.scale_local_gradients = scale_local_gradients
+        self.locally_aggregated_grads = {}
+        self.counter = tf.Variable(0, trainable=False, dtype=tf.int64)
+        self._local_vars = set()
+
+    def register_local_var(self, var):
+        self._local_vars.add(var.ref())
+
+    def compute_gradients(self, grads, vars):  # noqa: A002
+        aggregated = []
+        for idx, grad in enumerate(grads):
+            if isinstance(grad, tf.IndexedSlices):
+                if self.sparse_as_dense:
+                    grad = tf.convert_to_tensor(grad)
+                else:
+                    raise ValueError(
+                        "IndexedSlices are not supported when "
+                        "`backward_passes_per_step` > 1 and "
+                        "`sparse_as_dense` is False.")
+            if grad is None:
+                aggregated.append(None)
+                continue
+            if idx not in self.locally_aggregated_grads:
+                self.locally_aggregated_grads[idx] = tf.Variable(
+                    tf.zeros_like(grad), trainable=False,
+                    dtype=grad.dtype)
+            self.locally_aggregated_grads[idx].assign_add(grad)
+            aggregated.append(
+                self.locally_aggregated_grads[idx].read_value())
+
+        self.counter.assign_add(1)
+        if int(self.counter) == self.backward_passes_per_step:
+            reduced = self._allreduce_helper(aggregated, list(vars))
+            self._clear_vars()
+            return reduced
+        return aggregated
+
+    def _allreduce_helper(self, grads, tvars):
+        reduce_vars, reduce_grads = [], []
+        v2g = {v.ref(): g for v, g in zip(tvars, grads)}
+        for v, g in zip(tvars, grads):
+            if v.ref() not in self._local_vars:
+                reduce_vars.append(v)
+                reduce_grads.append(g)
+        reduced = self.allreduce_grads(reduce_grads, reduce_vars)
+        for v, g in zip(reduce_vars, reduced):
+            v2g[v.ref()] = g
+        if self.scale_local_gradients and self._local_vars:
+            ps_size = self.process_set.size()
+            for ref in list(v2g):
+                if ref in self._local_vars and v2g[ref] is not None:
+                    v2g[ref] = v2g[ref] / ps_size
+        out = [v2g[v.ref()] for v in tvars]
+        if self.average_aggregated_gradients:
+            out = [g / self.backward_passes_per_step
+                   if g is not None else None for g in out]
+        return out
+
+    def _clear_vars(self):
+        self.counter.assign(0)
+        for var in self.locally_aggregated_grads.values():
+            var.assign(tf.zeros_like(var))
+
+    def apply_gradients(self, apply_grads_closure, optimizer,
+                        *args, **kwargs):
+        if int(self.counter) == 0:
+            return apply_grads_closure()
+        if hasattr(optimizer, "iterations") and \
+                optimizer.iterations is not None:
+            optimizer.iterations.assign_add(1)
+        return None

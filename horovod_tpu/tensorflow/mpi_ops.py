@@ -1,0 +1,64 @@
+"""TF collective-op module (reference ``horovod/tensorflow/mpi_ops.py``).
+
+The reference splits the TF surface between ``mpi_ops`` (the custom-op
+wrappers + runtime queries) and ``__init__`` (optimizer/tape); this
+build defines everything on the package and keeps this module as the
+reference import path.  The ops are eager-first wrappers over the
+framework-neutral engine API (ops/api.py) — there is no TF custom-op
+kernel because no TF executor sits in the collective path on TPU.
+"""
+
+from ..common.basics import (  # noqa: F401
+    init, shutdown, is_initialized,
+    rank, size, local_rank, local_size, cross_rank, cross_size,
+    is_homogeneous, mpi_threads_supported,
+    mpi_built, gloo_built, nccl_built, ddl_built, ccl_built,
+    cuda_built, rocm_built, mpi_enabled, gloo_enabled,
+    start_timeline, stop_timeline,
+)
+from ..common.process_sets import (  # noqa: F401
+    ProcessSet, global_process_set,
+)
+from ..common.util import (
+    get_average_backwards_compatibility_fun,
+    num_rank_is_power_2 as check_num_rank_power_of_2,  # noqa: F401
+)
+from ..ops import api as _api
+from ..ops.api import (  # noqa: F401
+    allreduce, grouped_allreduce,
+    allgather, grouped_allgather,
+    broadcast, broadcast_,
+    alltoall,
+    reducescatter, grouped_reducescatter,
+    join,
+    Average, Sum, Adasum, Min, Max, Product,
+)
+
+handle_average_backwards_compatibility = \
+    get_average_backwards_compatibility_fun(_api)
+
+
+def size_op(process_set_id=0, name=None):
+    """Reference mpi_ops.py size_op — graph-evaluated size query."""
+    from . import size_op as impl
+    return impl(process_set_id=process_set_id, name=name)
+
+
+def local_size_op(name=None):
+    from . import local_size_op as impl
+    return impl(name=name)
+
+
+def rank_op(name=None):
+    from . import rank_op as impl
+    return impl(name=name)
+
+
+def local_rank_op(name=None):
+    from . import local_rank_op as impl
+    return impl(name=name)
+
+
+def process_set_included_op(process_set_id=0, name=None):
+    from . import process_set_included_op as impl
+    return impl(process_set_id=process_set_id, name=name)

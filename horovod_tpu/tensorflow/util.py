@@ -1,0 +1,43 @@
+"""TF frontend utilities (reference ``horovod/tensorflow/util.py``).
+
+``vars_to_refs``/``refs_to_vars`` let variable collections be used as
+hashable cache keys (tf Variables are unhashable in TF2); the private
+helpers mirror the reference's eager/caching shims for code ported
+verbatim.
+"""
+
+import tensorflow as tf
+
+
+def _executing_eagerly():
+    return tf.executing_eagerly()
+
+
+def _make_subgraph(f):
+    return tf.function(f)
+
+
+def _cache(f):
+    cache = {}
+
+    def wrapper(*args):
+        key = (args, _executing_eagerly())
+        if key not in cache:
+            cache[key] = f(*args)
+        return cache[key]
+
+    return wrapper
+
+
+def vars_to_refs(vars):  # noqa: A002 — reference signature
+    """Variables -> hashable ``.ref()`` tuple (reference util.py:47)."""
+    if isinstance(vars, list):
+        return tuple(vars_to_refs(v) for v in vars)
+    return vars.ref()
+
+
+def refs_to_vars(refs):
+    """Inverse of :func:`vars_to_refs` (reference util.py:53)."""
+    if isinstance(refs, tuple):
+        return [refs_to_vars(r) for r in refs]
+    return refs.deref()

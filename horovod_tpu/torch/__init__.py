@@ -13,6 +13,7 @@ from ..common.basics import (  # noqa: F401
     is_homogeneous, bind_rank, unbind_rank,
     mpi_threads_supported, mpi_built, gloo_built, nccl_built, ddl_built,
     ccl_built, cuda_built, rocm_built, xla_built, tpu_built,
+    mpi_enabled, gloo_enabled,
     start_timeline, stop_timeline,
 )
 from ..common.exceptions import (  # noqa: F401

@@ -1,0 +1,75 @@
+"""Resharding-aware elastic sampler (reference
+``horovod/torch/elastic/sampler.py:24``)."""
+
+import math
+
+import torch
+
+from ...common import basics
+
+
+class ElasticSampler(torch.utils.data.Sampler):
+    """Partitions indices over current ranks, tracks processed indices
+    so a resize mid-epoch resumes where it left off (reference
+    sampler.py:24-139)."""
+
+    def __init__(self, dataset, shuffle=True, seed=0):
+        self.dataset = dataset
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.processed_indices = set()
+        self.num_replicas = 0
+        self.rank = 0
+        self.remaining_indices = []
+        self.num_samples = 0
+        self.total_size = 0
+        self.reset()
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+        self.processed_indices = set()
+        self.reset()
+
+    def record_batch(self, batch_idx, batch_size):
+        # indices this rank just consumed, in its local order
+        local = self.indices[batch_idx * batch_size:
+                             (batch_idx + 1) * batch_size]
+        self.processed_indices.update(local)
+
+    def load_state_dict(self, state_dict):
+        self.epoch = state_dict["epoch"]
+        self.processed_indices = set(state_dict["processed_indices"])
+        self.reset()
+
+    def state_dict(self):
+        return dict(epoch=self.epoch,
+                    processed_indices=sorted(self.processed_indices))
+
+    def reset(self):
+        self.num_replicas = basics.size() if basics.is_initialized() else 1
+        self.rank = basics.rank() if basics.is_initialized() else 0
+
+        remaining = [idx for idx in range(len(self.dataset))
+                     if idx not in self.processed_indices]
+        if self.shuffle:
+            g = torch.Generator()
+            g.manual_seed(self.seed + self.epoch)
+            order = torch.randperm(len(remaining), generator=g).tolist()
+            remaining = [remaining[i] for i in order]
+        self.remaining_indices = remaining
+
+        self.num_samples = int(
+            math.ceil(len(self.remaining_indices) / self.num_replicas))
+        self.total_size = self.num_samples * self.num_replicas
+
+        indices = list(self.remaining_indices)
+        indices += indices[: (self.total_size - len(indices))]
+        self.indices = indices[self.rank: self.total_size:
+                               self.num_replicas]
+
+    def __iter__(self):
+        return iter(self.indices)
+
+    def __len__(self):
+        return self.num_samples

@@ -1,0 +1,215 @@
+"""Torch elastic state + the public state-handler registry (reference
+``horovod/torch/elastic/state.py:27-180``).
+
+Users can register handlers for custom object types with
+``set_handler_registry`` — the registry maps an ``isinstance`` check to
+a handler class, first match wins (reference state.py:142-162).
+"""
+
+import copy
+
+import torch
+
+from ...common import basics
+from ...common.elastic import ObjectState
+from ..functions import (
+    broadcast_object, broadcast_optimizer_state, broadcast_parameters,
+)
+from .sampler import ElasticSampler
+
+
+class StateHandler:
+    """Save/restore/sync protocol for one stateful object (reference
+    state.py:71-88).  ``saved_state``/``load_saved_state`` extend the
+    reference contract for the crash-durable spill path
+    (common/elastic.py _spill_path)."""
+
+    def __init__(self, value):
+        self.value = value
+
+    def save(self):
+        raise NotImplementedError
+
+    def restore(self):
+        raise NotImplementedError
+
+    def sync(self):
+        raise NotImplementedError
+
+    def set_value(self, value):
+        self.value = value
+
+    def saved_state(self):
+        return None
+
+    def load_saved_state(self, saved):
+        pass
+
+
+class ModelStateHandler(StateHandler):
+    """Handles ``torch.nn.Module`` (reference state.py:89-103)."""
+
+    def __init__(self, model):
+        super().__init__(model)
+        self._saved_model_state = copy.deepcopy(model.state_dict())
+
+    def save(self):
+        self._saved_model_state = copy.deepcopy(self.value.state_dict())
+
+    def restore(self):
+        self.value.load_state_dict(self._saved_model_state)
+
+    def sync(self):
+        broadcast_parameters(self.value.state_dict(), root_rank=0)
+
+    def saved_state(self):
+        return self._saved_model_state
+
+    def load_saved_state(self, saved):
+        self._saved_model_state = saved
+
+
+class OptimizerStateHandler(StateHandler):
+    """Handles ``torch.optim.Optimizer`` (reference state.py:104-118)."""
+
+    def __init__(self, optimizer):
+        super().__init__(optimizer)
+        self._saved_state = copy.deepcopy(optimizer.state_dict())
+
+    def save(self):
+        self._saved_state = copy.deepcopy(self.value.state_dict())
+
+    def restore(self):
+        self.value.load_state_dict(self._saved_state)
+
+    def sync(self):
+        broadcast_optimizer_state(self.value, root_rank=0)
+
+    def saved_state(self):
+        return self._saved_state
+
+    def load_saved_state(self, saved):
+        self._saved_state = saved
+
+
+class SamplerStateHandler(StateHandler):
+    """Handles ``ElasticSampler`` — epoch + processed indices travel
+    with the state so a restored/resized job resumes mid-epoch
+    (reference state.py:119-135)."""
+
+    def __init__(self, sampler):
+        super().__init__(sampler)
+        self._saved_sampler_state = copy.deepcopy(sampler.state_dict())
+
+    def save(self):
+        self._saved_sampler_state = copy.deepcopy(self.value.state_dict())
+
+    def restore(self):
+        self.value.load_state_dict(self._saved_sampler_state)
+
+    def sync(self):
+        # every rank's mid-epoch progress matters: union the processed
+        # indices across ranks first, else a resize would re-serve (and
+        # double-train) the samples non-root ranks already consumed
+        from ..functions import allgather_object
+        state = self.value.state_dict()
+        all_states = allgather_object(state)
+        merged = set()
+        for s in all_states:
+            merged.update(s["processed_indices"])
+        state["processed_indices"] = sorted(merged)
+        self.value.load_state_dict(broadcast_object(state))
+
+    def saved_state(self):
+        return self._saved_sampler_state
+
+    def load_saved_state(self, saved):
+        self._saved_sampler_state = saved
+
+
+_handler_registry = [
+    (torch.nn.Module, ModelStateHandler),
+    (torch.optim.Optimizer, OptimizerStateHandler),
+    (ElasticSampler, SamplerStateHandler),
+]
+
+
+def get_handler_registry():
+    return _handler_registry
+
+
+def set_handler_registry(registry):
+    global _handler_registry
+    _handler_registry = registry
+
+
+def _get_handler(v):
+    for handler_type, handler_cls in _handler_registry:
+        if isinstance(v, handler_type):
+            return handler_cls(v)
+    return None
+
+
+def _get_handlers(kwargs):
+    handlers = {}
+    remainder = {}
+    for name, value in kwargs.items():
+        handler = _get_handler(value)
+        if handler is not None:
+            handlers[name] = handler
+        else:
+            remainder[name] = value
+    return handlers, remainder
+
+
+class TorchState(ObjectState):
+    """State of a torch training job: model(s), optimizer(s),
+    sampler(s), plus arbitrary picklable attributes (reference
+    state.py:27-70)."""
+
+    def __init__(self, model=None, optimizer=None, **kwargs):
+        kwargs.update(dict(model=model, optimizer=optimizer))
+        kwargs = {k: v for k, v in kwargs.items()
+                  if not (v is None and k in ("model", "optimizer"))}
+        self._handlers, kwargs = _get_handlers(kwargs)
+        for name, handler in self._handlers.items():
+            setattr(self, name, handler.value)
+        super().__init__(bcast_object=broadcast_object,
+                         get_rank=basics.rank, **kwargs)
+
+    def save(self):
+        for handler in self._handlers.values():
+            handler.save()
+        super().save()
+
+    def restore(self):
+        for handler in self._handlers.values():
+            handler.restore()
+        super().restore()
+
+    def sync(self):
+        for handler in self._handlers.values():
+            handler.sync()
+        super().sync()
+
+    def __setattr__(self, name, value):
+        if hasattr(self, "_handlers") and name in self._handlers:
+            self._handlers[name].set_value(value)
+        super().__setattr__(name, value)
+
+    # crash-durable spill covers model/optimizer state too (the
+    # exec-restart recovery path, common/elastic.py _spill_path)
+    def _spill_payload(self):
+        payload = super()._spill_payload() or {}
+        payload["handlers"] = {
+            name: handler.saved_state()
+            for name, handler in self._handlers.items()}
+        return payload
+
+    def _load_spill(self, payload):
+        super()._load_spill(payload)
+        for name, saved in payload.get("handlers", {}).items():
+            handler = self._handlers.get(name)
+            if handler is not None and saved is not None:
+                handler.load_saved_state(saved)
+                handler.restore()

@@ -28,7 +28,16 @@ forward Average = Sum/size, so d(out)/d(in) carries the same 1/size.)
 import torch
 
 from ..common import basics
+from ..common.basics import (  # noqa: F401 — reference mpi_ops module surface
+    init, shutdown, is_initialized,
+    rank, size, local_rank, local_size, cross_rank, cross_size,
+    is_homogeneous, mpi_threads_supported,
+    mpi_built, gloo_built, nccl_built, ddl_built, ccl_built,
+    cuda_built, rocm_built, mpi_enabled, gloo_enabled,
+    start_timeline, stop_timeline,
+)
 from ..common.process_sets import global_process_set
+from ..common.util import get_average_backwards_compatibility_fun
 from ..ops import api as _api
 from ..ops.api import (  # noqa: F401
     allreduce_async, allreduce_, allreduce_async_,
@@ -41,6 +50,10 @@ from ..ops.api import (  # noqa: F401
     Average, Sum, Adasum, Min, Max, Product,
 )
 from .compression import Compression
+
+# deprecated ``average=`` kwarg adapter (reference torch/mpi_ops.py:125)
+handle_average_backwards_compatibility = \
+    get_average_backwards_compatibility_fun(_api)
 
 
 def _differentiable(*tensors):

@@ -217,3 +217,60 @@ def test_examples_and_benchmarks_compile():
         assert compileall.compile_file(
             os.path.join(root, script), quiet=2, force=True), \
             f"{script} does not compile"
+
+
+def test_data_service_remote_worker_and_shipped_fn():
+    """Multi-host compute-cluster path: dispatcher with
+    remote_workers=True, produce loop in another 'host' publishing
+    over HTTP, dataset_fn shipped by the trainer
+    (reference tensorflow/data/compute_worker.py flow)."""
+    import threading
+
+    from horovod_tpu.data.service import (
+        DataServiceServer, data_service, run_remote_worker,
+    )
+    from horovod_tpu.tensorflow.data.compute_service import (
+        _FN_KEY, _pickle_fn, _waiting_fn,
+    )
+    from horovod_tpu.runner.http.http_client import StoreClient
+
+    server = DataServiceServer(None, num_workers=1,
+                               remote_workers=True)
+    config = server.start(0)
+    try:
+        client = StoreClient(config.addr, config.port,
+                             bytes.fromhex(config.secret_hex))
+        # trainer ships the dataset fn before/while workers wait
+        client.put(_FN_KEY, _pickle_fn(
+            lambda w, n: iter([{"w": w, "i": i} for i in range(3)])))
+
+        stop = threading.Event()
+        worker = threading.Thread(
+            target=run_remote_worker,
+            args=(config, 0,
+                  _waiting_fn(None, client.get, stop.is_set, 10)),
+            kwargs=dict(stop_event=stop), daemon=True)
+        worker.start()
+
+        got = list(data_service(config, rank=0, size=1, timeout=20))
+        assert got == [{"w": 0, "i": i} for i in range(3)]
+        worker.join(timeout=10)
+        assert not worker.is_alive()
+    finally:
+        server.stop()
+
+
+def test_compute_worker_fn_stop_without_dataset_fn():
+    """A stopped service ends the dataset_fn wait loop instead of
+    leaking a forever-polling thread."""
+    import time
+
+    from horovod_tpu.tensorflow.data.compute_service import (
+        compute_worker_fn,
+    )
+
+    server, config = compute_worker_fn(num_workers=1)
+    time.sleep(0.2)           # let the produce thread enter the wait
+    server.stop()
+    time.sleep(0.3)
+    assert all(not t.is_alive() for t in server._threads)

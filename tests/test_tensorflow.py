@@ -667,3 +667,94 @@ def test_broadcast_callback_skips_local_optimizer_slots(hvd_shutdown):
         return True
 
     assert all(run_ranks(fn))
+
+
+def test_eager_gradient_aggregation_helper(hvd_shutdown):
+    """Standalone LocalGradientAggregationHelperEager: accumulates
+    bpps passes, allreduces on the Nth (reference
+    gradient_aggregation_eager.py contract)."""
+    from horovod_tpu.tensorflow.gradient_aggregation_eager import (
+        LocalGradientAggregationHelperEager,
+    )
+
+    def fn():
+        r = hvd.rank()
+        calls = []
+
+        def allreduce_func(grads, tvars):
+            calls.append(len(grads))
+            return [hvd.allreduce(g, op=hvd.Average) for g in grads]
+
+        helper = LocalGradientAggregationHelperEager(
+            backward_passes_per_step=2, allreduce_func=allreduce_func,
+            sparse_as_dense=True, average_aggregated_gradients=True)
+        v = tf.Variable([0.0, 0.0])
+        g1 = tf.constant([1.0, 2.0]) * (r + 1)
+        out1 = helper.compute_gradients([g1], [v])
+        assert not calls                       # first pass: local only
+        assert np.allclose(out1[0].numpy(), g1.numpy())
+        out2 = helper.compute_gradients([g1], [v])
+        assert calls == [1]                    # second pass: allreduced
+        # sum of two passes, averaged over ranks, /bpps
+        expected = np.array([1.0, 2.0]) * np.mean(
+            [i + 1 for i in range(NP)])
+        assert np.allclose(out2[0].numpy(), expected)
+        applied = []
+        helper.apply_gradients(lambda: applied.append(True), object())
+        assert applied == [True]               # counter reset -> apply
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_graph_gradient_aggregation_helper(hvd_shutdown):
+    """LocalGradientAggregationHelper under tf.function: tf.cond
+    gates the allreduce on the counter (reference
+    gradient_aggregation.py:103-263 design)."""
+    from horovod_tpu.tensorflow.gradient_aggregation import (
+        LocalGradientAggregationHelper,
+    )
+
+    def fn():
+        r = hvd.rank()
+
+        def allreduce_func(grads, tvars):
+            return [hvd.allreduce(g, op=hvd.Average) for g in grads]
+
+        helper = LocalGradientAggregationHelper(
+            backward_passes_per_step=2, allreduce_func=allreduce_func,
+            sparse_as_dense=True, average_aggregated_gradients=False)
+        v = tf.Variable([0.0, 0.0])
+        g = tf.constant([2.0, 4.0]) * (r + 1)
+        out1 = helper.compute_gradients([g], [v])
+        out2 = helper.compute_gradients([g], [v])
+        expected = 2 * np.array([2.0, 4.0]) * np.mean(
+            [i + 1 for i in range(NP)])
+        assert np.allclose(out2[0].numpy(), expected)
+        assert not np.allclose(out1[0].numpy(), expected)
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_reference_module_paths_tf(hvd_shutdown):
+    """The reference's TF import paths resolve onto this build
+    (mpi_ops module, util, functions object collectives)."""
+    from horovod_tpu.tensorflow import functions, mpi_ops, util
+
+    assert mpi_ops.check_num_rank_power_of_2(4)
+    assert not mpi_ops.check_num_rank_power_of_2(3)
+    v = tf.Variable([1.0])
+    refs = util.vars_to_refs([v])
+    assert util.refs_to_vars(refs)[0] is v
+
+    def fn():
+        obj = {"rank": hvd.rank()}
+        got = functions.allgather_object(obj)
+        assert [g["rank"] for g in got] == list(range(NP))
+        b = functions.broadcast_object(obj if hvd.rank() == 0 else None,
+                                       root_rank=0)
+        assert b == {"rank": 0}
+        return True
+
+    assert all(run_ranks(fn))

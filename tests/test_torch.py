@@ -735,3 +735,94 @@ def test_torch_sparse_grad_compression_warns(hvd_shutdown):
         return True
 
     assert all(run_ranks(fn))
+
+
+def test_torch_elastic_handler_registry(hvd_shutdown):
+    """Public state-handler registry (reference
+    torch/elastic/state.py:142-162): custom types get handlers,
+    ElasticSampler state rides TorchState sync."""
+    from horovod_tpu.torch.elastic import (
+        ElasticSampler, SamplerStateHandler, StateHandler, TorchState,
+        get_handler_registry, set_handler_registry,
+    )
+    from horovod_tpu.torch.elastic.state import _get_handler
+
+    registry = get_handler_registry()
+    assert any(cls is SamplerStateHandler for _, cls in registry)
+
+    class Clock:
+        def __init__(self):
+            self.t = 0
+
+    class ClockHandler(StateHandler):
+        def save(self):
+            self._saved = self.value.t
+
+        def restore(self):
+            self.value.t = self._saved
+
+        def sync(self):
+            pass
+
+    set_handler_registry(registry + [(Clock, ClockHandler)])
+    try:
+        handler = _get_handler(Clock())
+        assert isinstance(handler, ClockHandler)
+    finally:
+        set_handler_registry(registry)
+
+    def fn():
+        model = torch.nn.Linear(2, 1)
+        sampler = ElasticSampler(list(range(8)), shuffle=False)
+        state = TorchState(model=model, sampler=sampler, batch=0)
+        sampler.record_batch(0, 2)
+        state.batch = 1
+        state.save()
+        sampler.record_batch(1, 2)
+        state.batch = 2
+        state.restore()
+        assert state.batch == 1
+        assert len(sampler.processed_indices) == 2  # rolled back
+        return True
+
+    assert all(run_ranks(fn, 2))
+
+
+def test_torch_mpi_ops_reference_surface(hvd_shutdown):
+    """torch.mpi_ops carries the runtime queries + the deprecated
+    average= adapter (reference torch/mpi_ops.py module surface)."""
+    import warnings
+
+    from horovod_tpu.torch import mpi_ops
+
+    assert mpi_ops.mpi_built() is False
+    assert mpi_ops.gloo_enabled() is True
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        assert mpi_ops.handle_average_backwards_compatibility(
+            None, True) is mpi_ops.Average
+        assert mpi_ops.handle_average_backwards_compatibility(
+            None, False) is mpi_ops.Sum
+    with pytest.raises(ValueError):
+        mpi_ops.handle_average_backwards_compatibility(
+            mpi_ops.Adasum, True)
+
+
+def test_elastic_sampler_sync_unions_progress(hvd_shutdown):
+    """SamplerStateHandler.sync() merges every rank's processed
+    indices before broadcasting — a resize must not re-serve samples
+    other ranks already trained on."""
+    from horovod_tpu.torch.elastic import ElasticSampler, TorchState
+
+    def fn():
+        r = hvd.rank()
+        sampler = ElasticSampler(list(range(8)), shuffle=False)
+        state = TorchState(sampler=sampler)
+        sampler.record_batch(0, 2)   # rank 0: {0,2}; rank 1: {1,3}
+        before = set(sampler.processed_indices)
+        assert len(before) == 2
+        state.sync()
+        assert sampler.processed_indices == {0, 1, 2, 3}
+        return True
+
+    assert all(run_ranks(fn, 2))

@@ -1,0 +1,114 @@
+"""Keras implementation layer (reference ``horovod/_keras/__init__.py``).
+
+The reference shares one implementation between ``horovod.keras`` and
+``horovod.tensorflow.keras`` through this private package; here the
+shared implementation lives in ``horovod_tpu.keras`` /
+``horovod_tpu.tensorflow``, and this package keeps the internal import
+path working for code (and forks) that reaches into ``horovod._keras``
+directly.  Functions keep the reference's ``(keras, ...)`` /
+``(backend, ...)`` leading argument, which is accepted and unused —
+there is exactly one keras in this environment.
+"""
+
+import tensorflow as tf
+
+from ..common.util import support_non_legacy_keras_optimizers
+from ..tensorflow import (
+    DistributedOptimizer as _tf_distributed_optimizer,
+)
+from ..ops import api as _api
+
+
+def get_keras_optimizer_base_type(k):
+    """Reference _keras/__init__.py:30.  Keras 3 dropped the real
+    ``optimizers.legacy`` module (the attribute is a warning shim), so
+    the legacy branch only applies when a genuine Optimizer class is
+    there (keras 2.11–2.x)."""
+    if not support_non_legacy_keras_optimizers(k):
+        legacy = getattr(tf.keras.optimizers, "legacy", None)
+        legacy_opt = getattr(legacy, "Optimizer", None)
+        if isinstance(legacy_opt, type) and \
+                legacy_opt.__name__ == "Optimizer":
+            return legacy_opt
+    return k.optimizers.Optimizer
+
+
+def check_keras_optimizer_type(k, optimizer):
+    """Reference _keras/__init__.py:37."""
+    base = get_keras_optimizer_base_type(k)
+    if not isinstance(optimizer, base):
+        raise ValueError(
+            f"Optimizer has to be an instance of {base.__module__}."
+            f"{base.__name__}: {type(optimizer).__name__}")
+
+
+def create_distributed_optimizer(keras, optimizer, name=None,
+                                 device_dense="", device_sparse="",
+                                 compression=None,
+                                 sparse_as_dense=False,
+                                 gradient_predivide_factor=1.0,
+                                 op=None, groups=None,
+                                 process_set=None,
+                                 backward_passes_per_step=1,
+                                 average_aggregated_gradients=False,
+                                 scale_local_gradients=True,
+                                 **kwargs):
+    """Reference _keras/__init__.py:43 — builds the wrapped keras
+    optimizer.  Delegates to the TF frontend's DistributedOptimizer,
+    which handles keras optimizers natively."""
+    from ..common.process_sets import global_process_set
+    from ..tensorflow.compression import Compression
+    return _tf_distributed_optimizer(
+        optimizer, name=name,
+        compression=compression or Compression.none,
+        sparse_as_dense=sparse_as_dense,
+        gradient_predivide_factor=gradient_predivide_factor,
+        op=op if op is not None else _api.Average,
+        groups=groups,
+        process_set=process_set or global_process_set,
+        backward_passes_per_step=backward_passes_per_step,
+        average_aggregated_gradients=average_aggregated_gradients,
+        scale_local_gradients=scale_local_gradients)
+
+
+def _eval(backend, op_or_result):
+    """Reference _keras/__init__.py:250 — eager TF2: already a value."""
+    return op_or_result
+
+
+def allreduce(backend, value, name=None, average=None,
+              prescale_factor=1.0, postscale_factor=1.0, op=None,
+              compression=None):
+    """Reference _keras/__init__.py:262."""
+    from ..common.util import get_average_backwards_compatibility_fun
+    op = get_average_backwards_compatibility_fun(_api)(op, average)
+    return _eval(backend, _api.allreduce(
+        tf.constant(value) if not tf.is_tensor(value) else value,
+        name=name, op=op, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor))
+
+
+def allgather(backend, value, name=None):
+    return _eval(backend, _api.allgather(
+        tf.constant(value) if not tf.is_tensor(value) else value,
+        name=name))
+
+
+def broadcast(backend, value, root_rank=0, name=None):
+    return _eval(backend, _api.broadcast(
+        tf.constant(value) if not tf.is_tensor(value) else value,
+        root_rank=root_rank, name=name))
+
+
+def reducescatter(backend, value, name=None, op=None):
+    return _eval(backend, _api.reducescatter(
+        tf.constant(value) if not tf.is_tensor(value) else value,
+        name=name, op=op if op is not None else _api.Average))
+
+
+def load_model(keras, wrap_optimizer, optimizer_modules, filepath,
+               custom_optimizers=None, custom_objects=None):
+    """Reference _keras/__init__.py:281 — optimizer wrapping happens at
+    compile time in this build, so loading is direct."""
+    return keras.models.load_model(filepath,
+                                   custom_objects=custom_objects)

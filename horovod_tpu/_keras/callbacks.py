@@ -1,0 +1,42 @@
+"""Impl-layer callback names (reference ``horovod/_keras/callbacks.py``).
+
+The reference composes ``<Name>CallbackImpl`` mixins with the
+framework's ``Callback`` base per keras flavor; this build's callbacks
+(``horovod_tpu.keras.callbacks``) are complete keras callbacks already,
+so each Impl here is a thin adapter that accepts the reference's
+leading ``backend`` argument and delegates.
+"""
+
+from ..keras import callbacks as _cb
+
+
+class BroadcastGlobalVariablesCallbackImpl(
+        _cb.BroadcastGlobalVariablesCallback):
+    def __init__(self, backend, root_rank=0, device="", *args):
+        super().__init__(root_rank=root_rank, device=device)
+
+
+class MetricAverageCallbackImpl(_cb.MetricAverageCallback):
+    def __init__(self, backend, device="", *args):
+        super().__init__(device=device)
+
+
+class LearningRateScheduleCallbackImpl(_cb.LearningRateScheduleCallback):
+    def __init__(self, backend, initial_lr, multiplier, start_epoch=0,
+                 end_epoch=None, staircase=True, momentum_correction=True,
+                 steps_per_epoch=None, *args):
+        super().__init__(initial_lr, multiplier,
+                         start_epoch=start_epoch, end_epoch=end_epoch,
+                         staircase=staircase,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch)
+
+
+class LearningRateWarmupCallbackImpl(_cb.LearningRateWarmupCallback):
+    def __init__(self, backend, initial_lr, warmup_epochs=5,
+                 momentum_correction=True, steps_per_epoch=None,
+                 verbose=0, *args):
+        super().__init__(initial_lr, warmup_epochs=warmup_epochs,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch,
+                         verbose=verbose)

@@ -1,0 +1,22 @@
+"""Impl-layer elastic callback names (reference
+``horovod/_keras/elastic.py``).  Adapters over the complete callbacks
+in ``horovod_tpu.keras.elastic`` — the leading ``backend`` argument is
+accepted and unused (one keras in this environment).
+"""
+
+from ..keras import elastic as _el
+
+
+class CommitStateCallbackImpl(_el.CommitStateCallback):
+    def __init__(self, backend, state, batches_per_commit=1, *args):
+        super().__init__(state, batches_per_commit=batches_per_commit)
+
+
+class UpdateBatchStateCallbackImpl(_el.UpdateBatchStateCallback):
+    def __init__(self, backend, state, *args):
+        super().__init__(state)
+
+
+class UpdateEpochStateCallbackImpl(_el.UpdateEpochStateCallback):
+    def __init__(self, backend, state, *args):
+        super().__init__(state)

@@ -1,0 +1,8 @@
+"""MXNet object collectives (reference ``horovod/mxnet/functions.py``:
+broadcast_object :27, allgather_object :64).  Framework-neutral in
+this build — objects pickle into uint8 tensors and ride the engine
+path (ops/api.py), no mxnet NDArray staging needed."""
+
+from ..ops.api import (  # noqa: F401
+    allgather_object, broadcast_object,
+)

@@ -9,13 +9,31 @@ fuses whatever is concurrently pending, so priority is accepted for
 API compatibility and ignored.
 """
 
+from ..common.basics import (  # noqa: F401 — reference mpi_ops surface
+    init, shutdown, is_initialized,
+    rank, size, local_rank, local_size, cross_rank, cross_size,
+    mpi_threads_supported,
+    mpi_built, gloo_built, nccl_built, ddl_built, ccl_built,
+    cuda_built, rocm_built, mpi_enabled, gloo_enabled,
+    start_timeline, stop_timeline,
+)
 from ..common.process_sets import global_process_set
+from ..common.util import get_average_backwards_compatibility_fun
 from ..ops import api as _api
 from ..ops.api import (  # noqa: F401
     Average, Sum, Adasum, Min, Max, Product,
     barrier, join, synchronize, poll,
     broadcast_object, allgather_object,
 )
+
+# reference mxnet/mpi_ops.py module constants: the ctypes handle to the
+# compiled extension and its path — None/absent by design (pure-Python
+# runtime, no dlopen)
+MPI_MXNET_LIB_CTYPES = None
+dll_path = None
+
+handle_average_backwards_compatibility = \
+    get_average_backwards_compatibility_fun(_api)
 
 
 def allreduce(tensor, average=None, name=None, priority=0, op=None,

@@ -1,0 +1,2 @@
+"""Reference package path ``horovod.runner.common`` — shared runner
+utilities and the pickled-message service framework."""

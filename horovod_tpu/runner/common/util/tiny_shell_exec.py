@@ -1,0 +1,16 @@
+"""Capture-only shell execution (reference
+``horovod/runner/common/util/tiny_shell_exec.py``)."""
+
+import subprocess
+
+
+def execute(command):
+    """Run ``command`` in a shell; returns ``(output, exit_code)`` or
+    None on failure to spawn."""
+    try:
+        proc = subprocess.run(
+            command, shell=True, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT)
+    except OSError:
+        return None
+    return proc.stdout.decode("utf-8", errors="replace"), proc.returncode

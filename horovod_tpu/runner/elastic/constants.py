@@ -1,0 +1,7 @@
+"""Elastic constants (reference
+``horovod/runner/elastic/constants.py``)."""
+
+RESET_LIMIT_EXCEEDED_MESSAGE = (
+    "Horovod detected that the maximum number of resets in the job "
+    "has been exceeded (reset_limit={reset_limit}). Shutting down "
+    "the job.")

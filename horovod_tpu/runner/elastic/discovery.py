@@ -188,3 +188,9 @@ class FixedHosts(HostDiscovery):
 
     def find_available_hosts_and_slots(self):
         return dict(self._available_hosts)
+
+
+# reference discovery.py cooldown constant names (the tuple above is
+# the live configuration; these are the reference's split form)
+DEFAULT_COOLDOWN_LOWER_LIMIT_SECONDS = DEFAULT_COOLDOWN_RANGE[0]
+DEFAULT_COOLDOWN_UPPER_LIMIT_SECONDS = DEFAULT_COOLDOWN_RANGE[1]

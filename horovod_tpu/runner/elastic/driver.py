@@ -67,6 +67,8 @@ class ElasticDriver:
         self._round = 0
         self._round_started_at = 0.0
         self._assignments: Dict[str, int] = {}
+        self._slots_by_key: Dict[str, object] = {}  # "host:slot" -> SlotInfo
+        self._worker_servers: Dict[str, tuple] = {}
         self._procs: Dict[str, subprocess.Popen] = {}  # "host:slot" -> p
         self._deassigned: Dict[str, float] = {}        # key -> deadline
         self._churn_respawns: Dict[str, int] = {}
@@ -169,6 +171,8 @@ class ElasticDriver:
             self._round += 1
             self._assignments = {
                 f"{s.hostname}:{s.local_rank}": s.rank for s in slots}
+            self._slots_by_key = {
+                f"{s.hostname}:{s.local_rank}": s for s in slots}
             size = len(slots)
             # routable addresses when the round spans hosts: rendezvous
             # lives here; the jax.distributed coordinator on rank 0's
@@ -287,6 +291,58 @@ class ElasticDriver:
                         self._host_manager.current_hosts.host_slots))
                 self._start_round()
             self._shutdown.wait(DISCOVER_HOSTS_FREQUENCY_SECS)
+
+    # -- reference per-worker rendezvous verbs (driver.py:200-260;
+    #    consumed by elastic/rendezvous.py's handler adapter) ----------------
+
+    def record_ready(self, host, local_rank):
+        """A worker at ``host:local_rank`` reached rendezvous
+        (reference driver.py record_ready).  The KV path records this
+        via /elastic/joined markers; this direct form feeds the same
+        registry.  Rank AND round are resolved under one lock so a
+        concurrent ``_start_round`` cannot stamp the marker into the
+        wrong round."""
+        with self._lock:
+            rank = self._assignments.get(f"{host}:{local_rank}")
+            round_id = self._round
+        if rank is not None:
+            self._server.store.put(
+                f"/elastic/joined/{round_id}/{rank}", b"1")
+
+    def get_slot_info(self, host, local_rank):
+        """SlotInfo for a worker slot in the current round (reference
+        driver.py get_slot_info); INVALID for unassigned slots.
+        Served from the allocator's own slot table (``_slots_by_key``,
+        recorded at round start) so cross/local ranks always match the
+        published round."""
+        from ..common.util.hosts import INVALID_SLOT_INFO
+
+        with self._lock:
+            return self._slots_by_key.get(f"{host}:{local_rank}",
+                                          INVALID_SLOT_INFO)
+
+    def register_worker_server(self, host, local_rank, addresses,
+                               secret_key):
+        """Store a worker's notification-service address (reference
+        driver.py register_worker_server) so the driver can push
+        HostsUpdatedRequests over TCP in addition to the KV bump."""
+        with self._lock:
+            self._worker_servers[f"{host}:{local_rank}"] = \
+                (addresses, secret_key)
+
+    def get_worker_client(self, slot_info):
+        """WorkerNotificationClient for a registered worker, or None
+        (reference driver.py get_worker_client)."""
+        from .worker import WorkerNotificationClient
+
+        with self._lock:
+            entry = self._worker_servers.get(
+                f"{slot_info.hostname}:{slot_info.local_rank}")
+        if entry is None:
+            return None
+        addresses, key = entry
+        return WorkerNotificationClient(addresses, key,
+                                        verbose=self._verbose)
 
     def _round_joined(self):
         """How many of this round's workers picked up the rendezvous
@@ -414,3 +470,41 @@ class ElasticDriver:
             for p in self._procs.values():
                 if p.poll() is None:
                     p.kill()
+
+
+ELASTIC_TIMEOUT_SECS = 600
+
+
+class Results:
+    """Collected worker results for a run-function job (reference
+    driver.py:39)."""
+
+    def __init__(self, error_message, worker_results):
+        self.error_message = error_message
+        self.worker_results = worker_results
+
+
+class ResultsRecorder:
+    """Reference driver.py:45 — threads publishing per-worker results
+    are registered with ``expect`` and joined at ``get_results``."""
+
+    def __init__(self):
+        import queue
+        self._error_message = None
+        self._worker_results = {}
+        self._worker_threads = queue.Queue()
+
+    def expect(self, worker_thread):
+        self._worker_threads.put(worker_thread)
+
+    def set_error_message(self, error_message):
+        self._error_message = error_message
+
+    def add_result(self, key, value):
+        if key not in self._worker_results:
+            self._worker_results[key] = value
+
+    def get_results(self):
+        while not self._worker_threads.empty():
+            self._worker_threads.get().join()
+        return Results(self._error_message, self._worker_results)

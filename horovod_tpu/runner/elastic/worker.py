@@ -88,4 +88,74 @@ class WorkerNotificationManager:
                 time.sleep(0.5)
 
 
+    def handle_hosts_updated(self, timestamp, update_res):
+        """Direct dispatch (reference worker.py:85) — the path the
+        TCP WorkerNotificationService below uses."""
+        with self._lock:
+            listeners = list(self._listeners)
+        for listener in listeners:
+            try:
+                listener.on_hosts_updated(timestamp, update_res)
+            except Exception:  # noqa: BLE001
+                logger.exception("listener failed")
+
+
 notification_manager = WorkerNotificationManager()
+
+
+# -- reference-shaped surface (horovod/runner/elastic/worker.py) -------------
+#
+# The live notification channel above is KV-store push (driver bumps
+# /elastic/notify, workers long-poll).  The reference's TCP
+# notification service is also provided, fully functional, for tooling
+# that drives workers through it directly.
+
+from enum import IntFlag
+
+from ..common.util import network as _network
+
+HOROVOD_GLOO_RENDEZVOUS_ADDR = "HOROVOD_GLOO_RENDEZVOUS_ADDR"
+HOROVOD_GLOO_RENDEZVOUS_PORT = "HOROVOD_GLOO_RENDEZVOUS_PORT"
+HOROVOD_GLOO_IFACE = "HOROVOD_GLOO_IFACE"
+HOROVOD_HOSTNAME = "HOROVOD_HOSTNAME"
+HOROVOD_LOCAL_RANK = "HOROVOD_LOCAL_RANK"
+
+
+class HostUpdateResult(IntFlag):
+    no_update = 0
+    removed = 1
+    added = 2
+    mixed = removed | added
+
+
+class HostsUpdatedRequest:
+    """Driver -> worker: available hosts/slots changed (reference
+    worker.py:38)."""
+
+    def __init__(self, timestamp, res=HostUpdateResult.no_update):
+        self.timestamp = timestamp
+        self.res = res
+
+
+class WorkerNotificationService(_network.BasicService):
+    NAME = "worker notification service"
+
+    def __init__(self, key, nic, manager):
+        super().__init__(WorkerNotificationService.NAME, key,
+                         [nic] if nic else None)
+        self._manager = manager
+
+    def _handle(self, req, client_address):
+        if isinstance(req, HostsUpdatedRequest):
+            self._manager.handle_hosts_updated(req.timestamp, req.res)
+            return _network.AckResponse()
+        return super()._handle(req, client_address)
+
+
+class WorkerNotificationClient(_network.BasicClient):
+    def __init__(self, addresses, key, verbose=0, match_intf=False):
+        super().__init__(WorkerNotificationService.NAME, addresses,
+                         key, verbose, match_intf=match_intf)
+
+    def notify_hosts_updated(self, timestamp, update_res):
+        self._send(HostsUpdatedRequest(timestamp, update_res))

@@ -1,0 +1,163 @@
+"""Gloo-role launch surface (reference
+``horovod/runner/gloo_run.py``).
+
+The gloo controller's role (rendezvous + per-slot env handoff +
+worker spawn) is played here by the HMAC-HTTP store controller and
+``proc_run.launch_procs``; this module keeps the reference's entry
+points and helpers on top of that machinery so programmatic callers
+and ported tooling keep working.
+"""
+
+import os
+import signal
+import threading
+
+from .hosts import SlotInfo
+from .proc_run import launch_procs
+
+
+class MultiFile:
+    """Fan-out file object (reference gloo_run.py:53) — writes go to
+    every underlying stream."""
+
+    def __init__(self, files):
+        self._files = files
+
+    def write(self, text):
+        for f in self._files:
+            f.write(text)
+
+    def flush(self):
+        for f in self._files:
+            f.flush()
+
+
+def create_slot_env_vars(slot_info):
+    """Per-slot identity env (reference gloo_run.py:66) — the same
+    names proc_run.slot_env hands every worker."""
+    return {
+        "HOROVOD_HOSTNAME": slot_info.hostname,
+        "HOROVOD_RANK": str(slot_info.rank),
+        "HOROVOD_SIZE": str(slot_info.size),
+        "HOROVOD_LOCAL_RANK": str(slot_info.local_rank),
+        "HOROVOD_LOCAL_SIZE": str(slot_info.local_size),
+        "HOROVOD_CROSS_RANK": str(slot_info.cross_rank),
+        "HOROVOD_CROSS_SIZE": str(slot_info.cross_size),
+    }
+
+
+def create_run_env_vars(server_ip, nics, port, elastic=False):
+    """Rendezvous-location env (reference gloo_run.py:203).  The gloo
+    names are kept verbatim — common/env.py reads either spelling —
+    plus the TPU launcher's own names."""
+    env = {
+        "HOROVOD_GLOO_RENDEZVOUS_ADDR": server_ip,
+        "HOROVOD_GLOO_RENDEZVOUS_PORT": str(port),
+        "HOROVOD_CONTROLLER": "http",
+        "HOROVOD_CPU_OPERATIONS": "cpu",
+        "HOROVOD_RENDEZVOUS_ADDR": server_ip,
+        "HOROVOD_RENDEZVOUS_PORT": str(port),
+    }
+    if nics:
+        env["HOROVOD_GLOO_IFACE"] = list(nics)[0]
+    if elastic:
+        env["HOROVOD_ELASTIC"] = "1"
+    return env
+
+
+def get_run_command(command, server_ip, nics, port, elastic=False):
+    """``env k=v ... command`` string (reference gloo_run.py:218)."""
+    env_vars = create_run_env_vars(server_ip, nics, port, elastic)
+    env_string = " ".join(f"{k}={v}" for k, v in env_vars.items())
+    if isinstance(command, (list, tuple)):
+        command = " ".join(command)
+    return f"env {env_string} {command}"
+
+
+def register_shutdown_event():
+    """SIGTERM -> event (reference gloo_run.py:230) so the launcher
+    can tear down worker trees on job-manager termination."""
+    event = threading.Event()
+
+    def handler(signum, frame):
+        event.set()
+
+    signal.signal(signal.SIGTERM, handler)
+    return event
+
+
+def create_slot_env_vars_list(slots):
+    return [create_slot_env_vars(s) for s in slots]
+
+
+def _settings_to_kwargs(settings, env, command):
+    kwargs = dict(
+        command=list(command) if isinstance(command, (list, tuple))
+        else [command],
+        np=settings.num_proc,
+        hosts=getattr(settings, "hosts", None),
+        env=dict(env or os.environ),
+        verbose=bool(settings.verbose),
+        output_filename=settings.output_filename,
+    )
+    if settings.start_timeout is not None:
+        remaining = getattr(settings.start_timeout, "remaining", None)
+        kwargs["start_timeout"] = remaining() if callable(remaining) \
+            else float(settings.start_timeout)
+    return kwargs
+
+
+def launch_gloo(command, exec_command, settings, nics, env, server_ip):
+    """Static launch (reference gloo_run.py:242).  ``exec_command`` /
+    ``nics`` / ``server_ip`` belong to the reference's ssh+gloo
+    machinery; the store-controller launcher owns rendezvous and spawn
+    internally, so they are accepted and unused."""
+    exit_codes = launch_procs(**_settings_to_kwargs(settings, env,
+                                                    command))
+    failed = [(i, c) for i, c in enumerate(exit_codes) if c != 0]
+    if failed:
+        raise RuntimeError(
+            f"Horovod detected that one or more processes exited with "
+            f"non-zero status: {failed}")
+
+
+def gloo_run(settings, nics, env, server_ip, command):
+    """Reference gloo_run.py:295."""
+    launch_gloo(command, None, settings, nics, env, server_ip)
+
+
+def launch_gloo_elastic(command_or_func, exec_command, settings, env,
+                        get_common_interfaces, rendezvous,
+                        executable=None):
+    """Elastic launch (reference gloo_run.py:303) — delegates to the
+    elastic driver + KV rendezvous (runner/elastic_run.py)."""
+    from argparse import Namespace
+
+    from .elastic_run import run_elastic
+
+    args = Namespace(
+        np=settings.num_proc,
+        min_np=getattr(settings, "min_num_proc", None),
+        max_np=getattr(settings, "max_num_proc", None),
+        hosts=getattr(settings, "hosts", None),
+        host_discovery_script=getattr(settings, "discovery_script",
+                                      None),
+        slots_per_host=getattr(settings, "slots", None),
+        command=command_or_func
+        if isinstance(command_or_func, (list, tuple))
+        else [command_or_func],
+        verbose=bool(settings.verbose),
+        start_timeout=None,
+        output_filename=settings.output_filename,
+        reset_limit=getattr(settings, "reset_limit", None),
+        elastic_timeout=getattr(settings, "elastic_timeout", None),
+        cpu=False,
+        ranks_per_worker=1,
+    )
+    return run_elastic(args)
+
+
+def gloo_run_elastic(settings, env, command_or_func, executable=None):
+    """Reference gloo_run.py:370."""
+    return launch_gloo_elastic(command_or_func, None, settings, env,
+                               None, None, executable)

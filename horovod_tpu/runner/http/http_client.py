@@ -123,3 +123,41 @@ class StoreClient:
             raise _HTTPError(status, f"coord/{verb}: "
                                      f"{data[:200].decode(errors='replace')}")
         return json.loads(data or b"{}")
+
+
+# -- reference-shaped module functions (horovod/runner/http/http_client.py
+#    read_data_from_kvstore :22 / put_data_into_kvstore :35).  Values are
+#    base64-pickled (codec module); the signing key comes from
+#    HOROVOD_SECRET_KEY when the server enforces HMAC. ----------------------
+
+def _env_secret():
+    import os
+    secret_hex = os.environ.get("HOROVOD_SECRET_KEY")
+    try:
+        return bytes.fromhex(secret_hex) if secret_hex else None
+    except ValueError:
+        return None
+
+
+def read_data_from_kvstore(addr, port, scope, key):
+    from ..common.util import codec
+    try:
+        client = StoreClient(addr, port, _env_secret())
+        raw = client.get(f"/{scope}/{key}")
+    except Exception as e:  # noqa: BLE001 — reference raises RuntimeError
+        raise RuntimeError("Read data from KVStore server failed.", e)
+    if raw is None:
+        raise RuntimeError(
+            f"Read data from KVStore server failed: no value at "
+            f"/{scope}/{key}")
+    return codec.loads_base64(raw)
+
+
+def put_data_into_kvstore(addr, port, scope, key, value):
+    from ..common.util import codec
+    try:
+        client = StoreClient(addr, port, _env_secret())
+        client.put(f"/{scope}/{key}",
+                   codec.dumps_base64(value, to_ascii=False))
+    except Exception as e:  # noqa: BLE001 — reference raises RuntimeError
+        raise RuntimeError("Put data input KVStore server failed.", e)

@@ -704,3 +704,18 @@ def local_ip():
         return ip
     except OSError:
         return "127.0.0.1"
+
+
+# -- reference-shaped aliases (horovod/runner/http/http_server.py):
+#    one threaded HTTP service plays both the KVStore and Rendezvous
+#    roles in this build, so the reference's four names map onto the
+#    two classes above. ------------------------------------------------------
+
+SINGLE_REQUEST_TIMEOUT = 5
+TIMEOUT = 60
+
+KVStoreHandler = _Handler
+RendezvousHandler = _Handler
+KVStoreHTTPServer = _ThreadingHTTPServer
+RendezvousHTTPServer = _ThreadingHTTPServer
+KVStoreServer = RendezvousServer

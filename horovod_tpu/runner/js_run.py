@@ -1,0 +1,45 @@
+"""jsrun (LSF) launch surface (reference
+``horovod/runner/js_run.py``).  Sanctioned N/A on TPU pods (SURVEY
+§7.4): detection is a real ``which jsrun`` probe, the rankfile
+generator works from an LSF allocation's env, and ``js_run`` fails
+loudly with the supported alternative."""
+
+import shutil
+
+from .util.lsf import LSFUtils
+
+
+def is_jsrun_installed():
+    return shutil.which("jsrun") is not None
+
+
+def generate_jsrun_rankfile(settings, path=None):
+    """Explicit resource file for a jsrun launch (reference
+    js_run.py:38), one line per host from the LSF allocation."""
+    if not LSFUtils.using_lsf():
+        raise RuntimeError(
+            "generate_jsrun_rankfile requires an LSF allocation "
+            "(LSB_JOBID not set)")
+    import tempfile
+    path = path or tempfile.mktemp(suffix=".rankfile")
+    hosts = LSFUtils.get_compute_hosts()
+    slots_total = settings.num_proc
+    per_host = max(1, slots_total // max(len(hosts), 1))
+    with open(path, "w") as f:
+        f.write("overlapping_rs: allow\ncpu_index_using: logical\n\n")
+        rank = 0
+        for host in hosts:
+            for _ in range(per_host):
+                if rank >= slots_total:
+                    break
+                f.write(f"rank: {rank}: {{ hostname: {host}; }}\n")
+                rank += 1
+    return path
+
+
+def js_run(settings, nics, env, command, stdout=None, stderr=None):
+    raise RuntimeError(
+        "jsrun launch is not supported on the TPU runtime (no LSF on "
+        "TPU pods). Use the default launcher — horovodrun / "
+        "horovod_tpu.runner.launch — which spawns workers over "
+        "ssh/subprocess with the same env contract.")

@@ -171,3 +171,126 @@ def main():
 
 if __name__ == "__main__":
     main()
+
+
+# -- reference launch.py surface (constants, argparse action factories,
+#    controller selection) ---------------------------------------------------
+
+CACHE_FOLDER = os.path.join(os.path.expanduser("~"), ".horovod")
+CACHE_STALENESS_THRESHOLD_MINUTES = 60
+SSH_ATTEMPTS = 3
+SSH_CONNECT_TIMEOUT_S = 10
+
+
+def is_gloo_used(use_gloo=None, use_mpi=None, use_jsrun=None):
+    """Reference launch.py is_gloo_used: gloo (the store-controller
+    role here) is the launcher unless MPI/jsrun was explicitly
+    requested — which the TPU runtime doesn't support, so it is
+    effectively always True; kept for call-site parity."""
+    return bool(use_gloo) or not (use_mpi or use_jsrun)
+
+
+def run_controller(use_gloo, gloo_run_fn, use_mpi, mpi_run_fn,
+                   use_jsrun, js_run_fn, verbosity=0):
+    """Pick and invoke the launch path (reference launch.py
+    run_controller).  On TPU the gloo-role path is the only live one;
+    explicit --mpi/--jsrun fall through to their run fns, which raise
+    with guidance."""
+    if use_mpi:
+        return mpi_run_fn()
+    if use_jsrun:
+        return js_run_fn()
+    return gloo_run_fn()
+
+
+def make_override_action(override_args):
+    """argparse action recording which flags the user set explicitly,
+    so config-file values don't clobber them (reference launch.py
+    make_override_action; consumed by
+    common.util.config_parser.set_args_from_config)."""
+
+    class StoreOverrideAction(argparse.Action):
+        def __init__(self, option_strings, dest, default=None,
+                     type=None, choices=None, required=False,
+                     help=None, nargs=None, const=None, metavar=None):
+            super().__init__(option_strings=option_strings, dest=dest,
+                             default=default, type=type,
+                             choices=choices, required=required,
+                             help=help, nargs=nargs, const=const,
+                             metavar=metavar)
+
+        def __call__(self, parser, args, values, option_string=None):
+            override_args.add(self.dest)
+            setattr(args, self.dest, values)
+
+    return StoreOverrideAction
+
+
+def make_override_bool_action(override_args, bool_value):
+    """Const-storing flag action (reference launch.py:185): --flag
+    pairs register one action with True and its --no-flag twin with
+    False, both recording the override."""
+
+    class StoreOverrideBoolAction(argparse.Action):
+        def __init__(self, option_strings, dest, required=False,
+                     help=None):
+            super().__init__(option_strings=option_strings, dest=dest,
+                             const=bool_value, nargs=0, default=None,
+                             required=required, help=help)
+
+        def __call__(self, parser, args, values, option_string=None):
+            override_args.add(self.dest)
+            setattr(args, self.dest, self.const)
+
+    return StoreOverrideBoolAction
+
+
+def make_override_true_action(override_args):
+    return make_override_bool_action(override_args, True)
+
+
+def make_override_false_action(override_args):
+    return make_override_bool_action(override_args, False)
+
+
+def make_deprecated_bool_action(override_args, replacement_option):
+    class DeprecatedBoolAction(argparse.Action):
+        def __init__(self, option_strings, dest, **kwargs):
+            kwargs.setdefault("nargs", 0)
+            kwargs.pop("const", None)
+            super().__init__(option_strings, dest, **kwargs)
+
+        def __call__(self, parser, args, values, option_string=None):
+            import warnings
+            warnings.warn(
+                f"Argument {option_string} is deprecated; use "
+                f"{replacement_option} instead", DeprecationWarning)
+            override_args.add(self.dest)
+            setattr(args, self.dest, True)
+
+    return DeprecatedBoolAction
+
+
+def make_check_build_action(np_arg):
+    class CheckBuildAction(argparse.Action):
+        def __init__(self, option_strings, dest, **kwargs):
+            kwargs.setdefault("nargs", 0)
+            super().__init__(option_strings, dest, **kwargs)
+
+        def __call__(self, parser, args, values, option_string=None):
+            check_build()
+            parser.exit()
+
+    return CheckBuildAction
+
+
+def make_nic_action(_override_args=None):
+    class StoreNicAction(argparse.Action):
+        def __call__(self, parser, args, values, option_string=None):
+            if _override_args is not None:
+                _override_args.add(self.dest)
+            setattr(args, self.dest,
+                    set(v.strip() for v in str(values).split(",")
+                        if v.strip()))
+
+    return StoreNicAction

@@ -1,0 +1,49 @@
+"""MPI launch surface (reference ``horovod/runner/mpi_run.py``).
+
+TPU pods have no MPI (SURVEY §7.4 — the launcher accepts ``--mpi`` as
+a compatibility no-op and uses the store controller).  The detection
+predicates are real probes of the local ``mpirun``; ``mpi_run`` itself
+fails loudly with the supported alternative instead of silently doing
+something different from what the caller asked."""
+
+from .common.util.tiny_shell_exec import execute as _exec
+
+
+def _mpirun_version_output():
+    result = _exec("mpirun --version")
+    if result is None or result[1] != 0:
+        return None
+    return result[0]
+
+
+def is_open_mpi():
+    out = _mpirun_version_output()
+    return out is not None and "Open MPI" in out
+
+
+def is_spectrum_mpi():
+    out = _mpirun_version_output()
+    return out is not None and "IBM Spectrum MPI" in out
+
+
+def is_mpich():
+    out = _mpirun_version_output()
+    return out is not None and ("MPICH" in out or "HYDRA" in out)
+
+
+def is_intel_mpi():
+    out = _mpirun_version_output()
+    return out is not None and "Intel(R) MPI" in out
+
+
+def mpi_available(env=None):
+    return _mpirun_version_output() is not None
+
+
+def mpi_run(settings, nics, env, command, stdout=None, stderr=None):
+    raise RuntimeError(
+        "MPI launch is not supported on the TPU runtime: there is no "
+        "MPI data or control plane on TPU pods. Use the default "
+        "launcher (horovodrun without --mpi, or "
+        "horovod_tpu.runner.gloo_run.gloo_run) — it provides the same "
+        "rendezvous/env-handoff contract over the store controller.")

@@ -25,6 +25,33 @@ def get_not_none_from_list(tensor_list):
     return [x for x in tensor_list if x is not None]
 
 
+def filtered_allreduce(grads, tvars, *, allreduce_grads, local_vars,
+                       scale_local_gradients, process_set, divisor=1):
+    """Shared reduce/scale/average step for both aggregation helpers:
+    allreduce every gradient except the registered-local ones, scale
+    local gradients by 1/process-set-size when requested, divide by
+    ``divisor`` (the bpps average)."""
+    reduce_vars, reduce_grads = [], []
+    v2g = {v.ref(): g for v, g in zip(tvars, grads)}
+    for v, g in zip(tvars, grads):
+        if v.ref() not in local_vars:
+            reduce_vars.append(v)
+            reduce_grads.append(g)
+    reduced = allreduce_grads(reduce_grads, reduce_vars)
+    for v, g in zip(reduce_vars, reduced):
+        v2g[v.ref()] = g
+    if scale_local_gradients and local_vars:
+        ps_size = process_set.size()
+        for ref in list(v2g):
+            if ref in local_vars and v2g[ref] is not None:
+                v2g[ref] = v2g[ref] / ps_size
+    out = [v2g[v.ref()] for v in tvars]
+    if divisor != 1:
+        out = apply_op_to_not_none_tensors(
+            lambda g: g / divisor, out)
+    return out
+
+
 class LocalGradientAggregationHelper:
     """Reference gradient_aggregation.py:23 — graph-mode aggregation.
 
@@ -85,25 +112,13 @@ class LocalGradientAggregationHelper:
                     dtype=grad.dtype)
 
     def _allreduce_helper(self, grads, tvars):
-        reduce_vars, reduce_grads = [], []
-        v2g = {v.ref(): g for v, g in zip(tvars, grads)}
-        for v, g in zip(tvars, grads):
-            if v.ref() not in self._local_vars:
-                reduce_vars.append(v)
-                reduce_grads.append(g)
-        reduced = self.allreduce_grads(reduce_grads, reduce_vars)
-        for v, g in zip(reduce_vars, reduced):
-            v2g[v.ref()] = g
-        if self.scale_local_gradients and self._local_vars:
-            ps_size = self.process_set.size()
-            for ref in list(v2g):
-                if ref in self._local_vars and v2g[ref] is not None:
-                    v2g[ref] = v2g[ref] / ps_size
-        out = [v2g[v.ref()] for v in tvars]
-        if self.average_aggregated_gradients:
-            out = apply_op_to_not_none_tensors(
-                lambda g: g / self.backward_passes_per_step, out)
-        return out
+        return filtered_allreduce(
+            grads, tvars, allreduce_grads=self.allreduce_grads,
+            local_vars=self._local_vars,
+            scale_local_gradients=self.scale_local_gradients,
+            process_set=self.process_set,
+            divisor=self.backward_passes_per_step
+            if self.average_aggregated_gradients else 1)
 
     def compute_gradients(self, grads, vars):  # noqa: A002
         grads = [self._maybe_convert_grad(g) if g is not None else None

@@ -33,7 +33,17 @@ class LocalGradientAggregationHelperEager:
     def register_local_var(self, var):
         self._local_vars.add(var.ref())
 
+    def _check_eager(self):
+        if not tf.executing_eagerly():
+            raise RuntimeError(
+                "LocalGradientAggregationHelperEager only supports "
+                "eager execution (its counter is read as a Python "
+                "int); inside tf.function use "
+                "gradient_aggregation.LocalGradientAggregationHelper, "
+                "whose tf.cond form traces.")
+
     def compute_gradients(self, grads, vars):  # noqa: A002
+        self._check_eager()
         aggregated = []
         for idx, grad in enumerate(grads):
             if isinstance(grad, tf.IndexedSlices):
@@ -63,25 +73,14 @@ class LocalGradientAggregationHelperEager:
         return aggregated
 
     def _allreduce_helper(self, grads, tvars):
-        reduce_vars, reduce_grads = [], []
-        v2g = {v.ref(): g for v, g in zip(tvars, grads)}
-        for v, g in zip(tvars, grads):
-            if v.ref() not in self._local_vars:
-                reduce_vars.append(v)
-                reduce_grads.append(g)
-        reduced = self.allreduce_grads(reduce_grads, reduce_vars)
-        for v, g in zip(reduce_vars, reduced):
-            v2g[v.ref()] = g
-        if self.scale_local_gradients and self._local_vars:
-            ps_size = self.process_set.size()
-            for ref in list(v2g):
-                if ref in self._local_vars and v2g[ref] is not None:
-                    v2g[ref] = v2g[ref] / ps_size
-        out = [v2g[v.ref()] for v in tvars]
-        if self.average_aggregated_gradients:
-            out = [g / self.backward_passes_per_step
-                   if g is not None else None for g in out]
-        return out
+        from .gradient_aggregation import filtered_allreduce
+        return filtered_allreduce(
+            grads, tvars, allreduce_grads=self.allreduce_grads,
+            local_vars=self._local_vars,
+            scale_local_gradients=self.scale_local_gradients,
+            process_set=self.process_set,
+            divisor=self.backward_passes_per_step
+            if self.average_aggregated_gradients else 1)
 
     def _clear_vars(self):
         self.counter.assign(0)
@@ -90,6 +89,7 @@ class LocalGradientAggregationHelperEager:
 
     def apply_gradients(self, apply_grads_closure, optimizer,
                         *args, **kwargs):
+        self._check_eager()
         if int(self.counter) == 0:
             return apply_grads_closure()
         if hasattr(optimizer, "iterations") and \

@@ -274,3 +274,161 @@ def test_compute_worker_fn_stop_without_dataset_fn():
     server.stop()
     time.sleep(0.3)
     assert all(not t.is_alive() for t in server._threads)
+
+
+def test_reference_task_and_driver_services():
+    """The reference's TCP service stack end-to-end: driver
+    registration by host hash, task command execution with captured
+    output, exit codes, abort (reference
+    runner/common/service/{driver,task}_service.py)."""
+    import io
+    import time
+
+    from horovod_tpu.runner.common.service.driver_service import (
+        BasicDriverClient, BasicDriverService,
+    )
+    from horovod_tpu.runner.common.service.task_service import (
+        BasicTaskClient, BasicTaskService,
+    )
+    from horovod_tpu.runner.common.util import secret
+    from horovod_tpu.runner.common.util.timeout import Timeout
+
+    key = secret.make_secret_key()
+    driver = BasicDriverService(2, "test driver service", key)
+    tasks = [BasicTaskService(f"test task service #{i}", i, key)
+             for i in range(2)]
+    try:
+        client = BasicDriverClient("test driver service",
+                                   driver.addresses(), key)
+        for i, t in enumerate(tasks):
+            client.register_task(i, t.addresses(), f"hosthash-{i % 2}")
+        driver.wait_for_initial_registration(Timeout(10, "{activity}"))
+        assert sorted(driver.task_indices()) == [0, 1]
+        assert driver.task_index_host_hash(0) == "hosthash-0"
+
+        task_client = BasicTaskClient("test task service #0",
+                                      tasks[0].addresses(), key)
+        task_client.run_command("echo hello-from-task; exit 7",
+                                env={}, capture_stdout=True)
+        out = io.StringIO()
+        stdout_t, _ = task_client.stream_command_output(stdout=out)
+        exit_code = task_client.wait_for_command_exit_code(delay=0.1)
+        assert exit_code == 7
+        stdout_t.join(timeout=5)
+        assert "hello-from-task" in out.getvalue()
+
+        # second run_command is idempotent — same command result
+        task_client.run_command("echo other", env={})
+        terminated, code = task_client.command_result()
+        assert terminated and code == 7
+    finally:
+        for t in tasks:
+            t.shutdown()
+        driver.shutdown()
+
+
+def test_reference_compute_service_registration():
+    """Dispatcher/worker registration + shutdown barrier (reference
+    runner/common/service/compute_service.py)."""
+    from horovod_tpu.runner.common.service.compute_service import (
+        ComputeClient, ComputeService,
+    )
+    from horovod_tpu.runner.common.util import secret
+
+    key = secret.make_secret_key()
+    service = ComputeService(1, 2, key)
+    try:
+        client = ComputeClient(service.addresses(), key)
+        client.register_dispatcher(0, "grpc://somewhere:1234")
+        assert client.wait_for_dispatcher_registration(0, timeout=5) \
+            == "grpc://somewhere:1234"
+        with pytest.raises(IndexError):
+            client.register_dispatcher(3, "grpc://bad:1")
+        client.register_worker_for_dispatcher(0, worker_id=0)
+        client.register_worker_for_dispatcher(0, worker_id=1)
+        client.wait_for_dispatcher_worker_registration(0, timeout=5)
+        client.shutdown()
+        client.wait_for_shutdown()   # returns because shutdown was set
+    finally:
+        service.shutdown()
+
+
+def test_runner_util_helpers():
+    """runner.util + runner.common.util reference helpers behave."""
+    import threading
+
+    from horovod_tpu.runner.common.util.codec import (
+        dumps_base64, loads_base64,
+    )
+    from horovod_tpu.runner.common.util.host_hash import host_hash
+    from horovod_tpu.runner.common.util.hosts import (
+        get_host_assignments, parse_hosts, parse_hosts_and_slots,
+    )
+    from horovod_tpu.runner.util.streams import Pipe
+    from horovod_tpu.runner.util.threads import (
+        execute_function_multithreaded, in_thread,
+    )
+
+    assert loads_base64(dumps_base64({"x": (1, 2)})) == {"x": (1, 2)}
+    h1, h2 = host_hash(), host_hash(salt="other")
+    assert h1 != h2 and "-" in h1
+
+    names, slots = parse_hosts_and_slots("a:2,b:3")
+    assert names == ["a", "b"] and slots == {"a": 2, "b": 3}
+    alloc = get_host_assignments(parse_hosts("a:2,b:3"),
+                                 2, max_num_proc=4)
+    assert len(alloc) == 4  # capped by max, not total
+
+    pipe = Pipe()
+    got = []
+    t = in_thread(lambda: got.append(pipe.read()))
+    pipe.write("hello")
+    t.join(timeout=5)
+    assert got == ["hello"]
+    pipe.close()
+    assert pipe.read() is None
+
+    results = execute_function_multithreaded(
+        lambda a, b: a + b, [[1, 2], [3, 4], [5, 6]])
+    assert results == {0: 3, 1: 7, 2: 11}
+
+
+def test_elastic_reference_surface():
+    """Elastic constants/settings/worker-notification TCP path."""
+    import time
+
+    from horovod_tpu.runner.common.util import secret
+    from horovod_tpu.runner.elastic.constants import (
+        RESET_LIMIT_EXCEEDED_MESSAGE,
+    )
+    from horovod_tpu.runner.elastic.settings import ElasticSettings
+    from horovod_tpu.runner.elastic.worker import (
+        HostUpdateResult, WorkerNotificationClient,
+        WorkerNotificationManager, WorkerNotificationService,
+    )
+
+    assert "reset_limit" in RESET_LIMIT_EXCEEDED_MESSAGE
+    s = ElasticSettings(discovery=None, min_num_proc=1,
+                        max_num_proc=4, elastic_timeout=600,
+                        reset_limit=3, num_proc=2)
+    assert s.elastic and s.max_num_proc == 4
+
+    manager = WorkerNotificationManager()
+    seen = []
+
+    class Listener:
+        def on_hosts_updated(self, ts, res):
+            seen.append((ts, res))
+
+    manager.register_listener(Listener())
+    key = secret.make_secret_key()
+    service = WorkerNotificationService(key, None, manager)
+    try:
+        client = WorkerNotificationClient(service.addresses(), key)
+        client.notify_hosts_updated(123.0, HostUpdateResult.added)
+        deadline = time.monotonic() + 5
+        while not seen and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert seen and seen[0][1] == HostUpdateResult.added
+    finally:
+        service.shutdown()

@@ -375,3 +375,35 @@ def test_split_validation_rejects_column_on_array_path():
     # explicit val data short-circuits (the column is then unused)
     xs, ys, xv, yv = split_validation(x, y, x[:2], y[:2], "val_col")
     assert len(xv) == 2
+
+
+def test_keras_impl_layer_paths():
+    """horovod._keras impl-layer import path: optimizer type checks +
+    Impl adapters resolve and build (reference _keras/__init__.py,
+    callbacks.py, elastic.py)."""
+    tf = pytest.importorskip("tensorflow")
+    import horovod_tpu._keras as hk
+    from horovod_tpu._keras.callbacks import (
+        BroadcastGlobalVariablesCallbackImpl, MetricAverageCallbackImpl,
+    )
+    from horovod_tpu._keras.elastic import CommitStateCallbackImpl
+
+    base = hk.get_keras_optimizer_base_type(tf.keras)
+    opt = tf.keras.optimizers.SGD(0.1)
+    assert isinstance(opt, base)
+    hk.check_keras_optimizer_type(tf.keras, opt)
+    with pytest.raises(ValueError):
+        hk.check_keras_optimizer_type(tf.keras, object())
+
+    cb = BroadcastGlobalVariablesCallbackImpl("tf", 0)
+    assert cb.root_rank == 0
+    assert MetricAverageCallbackImpl("tf") is not None
+
+    class _S:
+        def commit(self):
+            pass
+
+        def on_batch_end(self, *a):
+            pass
+
+    assert CommitStateCallbackImpl("tf", _S(), 2) is not None

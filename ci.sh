@@ -19,7 +19,7 @@ cd "$(dirname "$0")"
 # exceeds a 10-minute cap on CI runners.  Four groups (was two — the
 # integration half drifted toward the cap as tests accumulated) keep
 # every invocation comfortably under it.
-PART1="tests/test_autotune.py tests/test_aux.py tests/test_basics.py \
+PART1="tests/test_api_parity.py tests/test_autotune.py tests/test_aux.py tests/test_basics.py \
   tests/test_collectives.py tests/test_compiled.py \
   tests/test_conv_bn_fusion.py tests/test_integrations.py \
   tests/test_jax_frontend.py tests/test_lightning.py \

@@ -1,0 +1,59 @@
+"""``horovod`` — drop-in alias for :mod:`horovod_tpu`.
+
+Reference scripts run byte-for-byte unchanged::
+
+    import horovod.torch as hvd      # -> horovod_tpu.torch
+    import horovod.tensorflow.keras  # -> horovod_tpu.tensorflow.keras
+    from horovod.runner.common.util import secret
+
+A meta-path finder maps every ``horovod.X`` import onto the already-
+loaded ``horovod_tpu.X`` module object (one module, two names — state
+is shared, ``isinstance`` checks agree).  The north-star of SURVEY §6
+("BERT scripts run unchanged") is literal: no import rewriting needed.
+"""
+
+import importlib
+import importlib.abc
+import importlib.machinery
+import importlib.util
+import sys
+
+import horovod_tpu as _real
+
+# this module mirrors the real package root's attributes
+globals().update({k: v for k, v in _real.__dict__.items()
+                  if k not in ("__name__", "__loader__", "__spec__",
+                               "__package__", "__path__", "__file__")})
+
+__version__ = _real.__version__
+
+
+class _AliasFinder(importlib.abc.MetaPathFinder, importlib.abc.Loader):
+    """Resolve ``horovod.X`` to the ``horovod_tpu.X`` module object."""
+
+    _PREFIX = "horovod."
+
+    def find_spec(self, fullname, path=None, target=None):
+        if not fullname.startswith(self._PREFIX):
+            return None
+        real_name = "horovod_tpu." + fullname[len(self._PREFIX):]
+        try:
+            self._module = importlib.import_module(real_name)
+        except ImportError:
+            return None
+        return importlib.machinery.ModuleSpec(fullname, self)
+
+    def create_module(self, spec):
+        return importlib.import_module(
+            "horovod_tpu." + spec.name[len(self._PREFIX):])
+
+    def exec_module(self, module):
+        pass
+
+
+if not any(isinstance(f, _AliasFinder) for f in sys.meta_path):
+    # must PRECEDE the path-based finder: the aliased parent modules
+    # carry horovod_tpu's __path__, so PathFinder would otherwise
+    # re-execute each submodule file as a second module object under
+    # the horovod.* name
+    sys.meta_path.insert(0, _AliasFinder())

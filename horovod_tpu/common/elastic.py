@@ -200,3 +200,18 @@ def _get_notification_manager():
         return notification_manager
     except Exception:  # pragma: no cover — runner not in use
         return None
+
+
+# reference common/elastic.py module attribute: the process-wide
+# notification manager (lazy here — resolving at import would pull the
+# runner stack into every frontend import)
+def __getattr__(name):
+    if name == "notification_manager":
+        manager = _get_notification_manager()
+        if manager is None:
+            raise AttributeError(
+                "notification_manager is unavailable (runner stack "
+                "not importable)")
+        return manager
+    raise AttributeError(f"module {__name__!r} has no attribute "
+                         f"{name!r}")

@@ -128,3 +128,7 @@ def _reset():
 
 def global_ranks():
     return list(range(basics.size()))
+
+
+# reference process_sets.py:21 — mpi4py typing shim (no MPI on TPU)
+from .basics import MPI  # noqa: F401,E402

@@ -258,3 +258,11 @@ def support_non_legacy_keras_optimizers(k):
     from packaging import version
     return version.parse(
         k.__version__.replace("-tf", "+tf")) < version.parse("2.11")
+
+
+# reference common/util.py also surfaces the build queries (there they
+# probe the compiled extension; here they answer from the runtime)
+from .basics import (  # noqa: F401,E402
+    ccl_built, cuda_built, ddl_built, gloo_built, mpi_built,
+    nccl_built, rocm_built,
+)

@@ -223,6 +223,18 @@ class RayExecutor:
         import ray
         return ray.get([w.execute.remote(fn) for w in self._workers])
 
+    def run_remote(self, fn, args=None, kwargs=None):
+        """Launch without blocking; returns the ray futures (reference
+        runner.py run_remote)."""
+        return [w.execute.remote(fn, *(args or ()), **(kwargs or {}))
+                for w in self._workers]
+
+    def execute_single(self, fn):
+        """Run ``fn`` on the rank-0 worker only (reference runner.py
+        execute_single)."""
+        import ray
+        return ray.get(self._workers[0].execute.remote(fn))
+
     def shutdown(self):
         import ray
         # kill actors explicitly: with an ambient placement group the

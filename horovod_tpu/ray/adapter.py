@@ -1,0 +1,58 @@
+"""Ray adapter interface (reference ``horovod/ray/adapter.py``):
+the strategy-agnostic start/execute/shutdown surface RayExecutor
+drives, plus the shared worker-resource params."""
+
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class BaseParams:
+    """Reference adapter.py:6."""
+
+    cpus_per_worker: int = 1
+    use_gpu: bool = False
+    gpus_per_worker: Optional[int] = None
+
+    def __post_init__(self):
+        if self.gpus_per_worker and not self.use_gpu:
+            raise ValueError(
+                "gpus_per_worker is set, but use_gpu is False. "
+                "use_gpu must be True if gpus_per_worker is set.")
+        if self.use_gpu and isinstance(self.gpus_per_worker, int) \
+                and self.gpus_per_worker < 1:
+            raise ValueError(
+                f"gpus_per_worker must be >= 1: "
+                f"Got {self.gpus_per_worker}.")
+        self.gpus_per_worker = self.gpus_per_worker or \
+            int(self.use_gpu)
+
+
+class Adapter(ABC):
+    """Reference adapter.py:22."""
+
+    @abstractmethod
+    def start(self, executable_cls=None, executable_args=None,
+              executable_kwargs=None, extra_env_vars=None):
+        ...
+
+    @abstractmethod
+    def execute(self, fn, callbacks=None):
+        ...
+
+    @abstractmethod
+    def run(self, fn, args=None, kwargs=None, callbacks=None):
+        ...
+
+    @abstractmethod
+    def run_remote(self, fn, args=None, kwargs=None):
+        ...
+
+    @abstractmethod
+    def execute_single(self, fn):
+        ...
+
+    @abstractmethod
+    def shutdown(self):
+        ...

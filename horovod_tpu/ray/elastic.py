@@ -1,0 +1,10 @@
+"""Reference import path ``horovod.ray.elastic`` — the v1 elastic
+surface: executor + host discovery (live implementations in the
+package root) and the chaos TestDiscovery from elastic_v2."""
+
+import logging
+
+from . import ElasticRayExecutor, RayHostDiscovery  # noqa: F401
+from .elastic_v2 import TestDiscovery  # noqa: F401
+
+logger = logging.getLogger("horovod_tpu.ray")

@@ -1,0 +1,145 @@
+"""Elastic ray adapter (reference ``horovod/ray/elastic_v2.py``).
+
+``ElasticParams``/``ElasticAdapter`` wrap the package root's
+ElasticRayExecutor (KV-rendezvous elastic flow); ``TestDiscovery``
+injects scheduled host churn for elastic testing, mirroring the
+reference's chaos discovery."""
+
+import logging
+import random
+import time
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from . import ElasticRayExecutor, RayHostDiscovery
+from .adapter import Adapter, BaseParams
+
+logger = logging.getLogger("horovod_tpu.ray")
+
+
+class TestDiscovery(RayHostDiscovery):
+    """Scheduled host churn on top of real discovery (reference
+    elastic_v2.py:74): every ``change_frequency_s`` a host is added
+    back or removed, bounded by min/max."""
+
+    def __init__(self, min_hosts, max_hosts, change_frequency_s,
+                 use_gpu=False, cpus_per_worker=1, gpus_per_worker=1,
+                 verbose=True, _graceful=True, seed=None):
+        super().__init__(use_gpu=use_gpu,
+                         cpus_per_worker=cpus_per_worker,
+                         gpus_per_worker=gpus_per_worker)
+        self._min_hosts = min_hosts
+        self._max_hosts = max_hosts
+        self._change_frequency_s = change_frequency_s
+        self._graceful = _graceful
+        self._last_reset_t = None
+        self._removed_hosts = set()
+        self._rng = random.Random(seed)
+        self.verbose = verbose
+
+    def add_host(self, hosts):
+        available = self._removed_hosts & set(hosts)
+        if available:
+            self._removed_hosts.remove(
+                self._rng.choice(sorted(available)))
+        elif self.verbose:
+            print("No hosts to add.")
+
+    def remove_host(self, hosts):
+        good = [h for h in hosts if h not in self._removed_hosts]
+        if good:
+            self._removed_hosts.add(self._rng.choice(good))
+
+    def change_hosts(self, hosts):
+        self._removed_hosts &= set(hosts)
+        current = len(hosts) - len(self._removed_hosts)
+        if current <= self._min_hosts:
+            self.add_host(hosts)
+        elif current >= self._max_hosts:
+            self.remove_host(hosts)
+        elif self._rng.random() < 0.5:
+            self.add_host(hosts)
+        else:
+            self.remove_host(hosts)
+
+    def find_available_hosts_and_slots(self):
+        t = time.time()
+        if self._last_reset_t is None:
+            self._last_reset_t = t
+        hosts = super().find_available_hosts_and_slots()
+        if t - self._last_reset_t >= self._change_frequency_s:
+            self.change_hosts(hosts)
+            self._last_reset_t = t
+        return {h: s for h, s in hosts.items()
+                if h not in self._removed_hosts}
+
+
+@dataclass
+class ElasticParams(BaseParams):
+    """Reference elastic_v2.py:151."""
+
+    min_workers: int = 1
+    max_workers: Optional[int] = None
+    reset_limit: Optional[int] = None
+    cooldown_range: Optional[Tuple[int, int]] = None
+    elastic_timeout: int = 600
+    override_discovery: bool = True
+
+    @property
+    def elastic(self):
+        return True
+
+    @property
+    def adapter(self):
+        return ElasticAdapter
+
+
+class ElasticAdapter(Adapter):
+    """Reference elastic_v2.py:197 — drives the elastic executor."""
+
+    def __init__(self, params, settings=None, discovery=None):
+        self.params = params
+        self.settings = settings
+        self.discovery = discovery
+        self._executor = None
+        self._extra_env = None
+
+    def start(self, executable_cls=None, executable_args=None,
+              executable_kwargs=None, extra_env_vars=None):
+        self._extra_env = extra_env_vars
+        settings = self.settings or \
+            ElasticRayExecutor.create_settings(
+                min_np=self.params.min_workers,
+                max_np=self.params.max_workers,
+                reset_limit=self.params.reset_limit,
+                elastic_timeout=self.params.elastic_timeout,
+                cpus_per_slot=self.params.cpus_per_worker,
+                use_gpu=self.params.use_gpu,
+                override_discovery=self.discovery
+                if self.params.override_discovery else None)
+        self._executor = ElasticRayExecutor(
+            settings, env_vars=extra_env_vars)
+        self._executor.start()
+
+    def run(self, fn, args=None, kwargs=None, callbacks=None):
+        def bound():
+            return fn(*(args or ()), **(kwargs or {}))
+
+        return self._executor.run(bound, callbacks=callbacks)
+
+    def execute(self, fn, callbacks=None):
+        return self._executor.run(fn, callbacks=callbacks)
+
+    def run_remote(self, fn, args=None, kwargs=None):
+        raise RuntimeError(
+            "run_remote is a static-job API; elastic jobs block in "
+            "run() so membership changes can be handled")
+
+    def execute_single(self, fn):
+        raise RuntimeError(
+            "execute_single is a static-job API; elastic jobs have "
+            "no stable rank-0 actor")
+
+    def shutdown(self):
+        if self._executor is not None:
+            self._executor.shutdown()

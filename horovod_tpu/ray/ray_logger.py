@@ -1,0 +1,32 @@
+"""Rank-0 log forwarding (reference ``horovod/ray/ray_logger.py``):
+workers push dicts onto a queue configured by the driver; callbacks
+consume them."""
+
+_queue = None
+_warning_raised = False
+
+logger = __import__("logging").getLogger("horovod_tpu.ray")
+
+
+def configure(queue):
+    """Reference ray_logger.py:14."""
+    global _queue
+    _queue = queue
+
+
+def log(info_dict):
+    """Reference ray_logger.py:25 — silently drops (with one warning)
+    when no queue is configured."""
+    global _warning_raised
+    if _queue is None:
+        if not _warning_raised:
+            logger.warning(
+                "ray_logger.log called before configure(); "
+                "log entries are dropped")
+            _warning_raised = True
+        return
+    _queue.put(info_dict)
+
+
+def warning_raised():
+    return _warning_raised

@@ -1,0 +1,177 @@
+"""Reference import path ``horovod.ray.runner``.
+
+``RayExecutor``/``BaseHorovodWorker`` live in the package root (the
+actor-spawn flow over the env-handoff contract); this module adds the
+reference's support classes — MiniSettings, the rank-layout
+Coordinator, and the static params/adapter pair — all functional
+without a live ray cluster except actor spawning itself."""
+
+import logging
+from collections import defaultdict
+from dataclasses import dataclass
+from typing import Optional
+
+from . import BaseHorovodWorker, RayExecutor, _require_ray  # noqa: F401
+from .adapter import Adapter, BaseParams
+from ..runner.common.util import secret, timeout
+
+logger = logging.getLogger("horovod_tpu.ray")
+
+
+class MiniSettings:
+    """Minimal settings for the ray flow (reference runner.py:21)."""
+
+    def __init__(self, nics=None, verbose=1, key=None, ssh_port=None,
+                 ssh_identity_file=None, timeout_s=300,
+                 placement_group_timeout_s=100, elastic=False):
+        self.nics = nics
+        self.verbose = verbose
+        self.key = key if key is not None else \
+            secret.make_secret_key()
+        self.ssh_port = ssh_port
+        self.ssh_identity_file = ssh_identity_file
+        self.timeout_s = timeout_s
+        self.placement_group_timeout_s = placement_group_timeout_s
+        self.elastic = elastic
+
+    @property
+    def start_timeout(self):
+        return timeout.Timeout(
+            self.timeout_s,
+            message="Timed out waiting for {activity}. Please check "
+                    "connectivity between servers.")
+
+
+class Coordinator:
+    """Rank-layout bookkeeping for actor-based launches (reference
+    runner.py:45): workers register (hostname, node, world rank), and
+    finalize_registration derives each rank's local/cross geometry."""
+
+    rendezvous = None
+    global_rendezv_port = None
+    nics = None
+
+    def __init__(self, settings):
+        self.settings = settings
+        self.node_id_by_rank = defaultdict(list)
+        self._hostnames = set()
+
+    @property
+    def world_size(self):
+        return sum(len(ranks)
+                   for ranks in self.node_id_by_rank.values())
+
+    @property
+    def hostnames(self):
+        return self._hostnames
+
+    @property
+    def node_id_string(self):
+        return ",".join(f"{node_id}:{len(ranks)}"
+                        for node_id, ranks in
+                        self.node_id_by_rank.items())
+
+    def register(self, hostname, node_id, world_rank):
+        self._hostnames.add(hostname)
+        self.node_id_by_rank[node_id].append(world_rank)
+
+    def finalize_registration(self):
+        """Per-rank env map (reference runner.py:83)."""
+        rank_to_info = {}
+        cross_sizes = defaultdict(int)
+        cross_ranks = {}
+        for rank_list in self.node_id_by_rank.values():
+            for local_rank, world_rank in enumerate(rank_list):
+                cross_ranks[world_rank] = cross_sizes[local_rank]
+                cross_sizes[local_rank] += 1
+        for node_id, ranks in self.node_id_by_rank.items():
+            for local_rank, world_rank in enumerate(ranks):
+                rank_to_info[world_rank] = dict(
+                    HOROVOD_CROSS_RANK=cross_ranks[world_rank],
+                    HOROVOD_CROSS_SIZE=cross_sizes[local_rank],
+                    HOROVOD_LOCAL_RANK=local_rank,
+                    HOROVOD_LOCAL_SIZE=len(ranks))
+        return rank_to_info
+
+    def establish_rendezvous(self):
+        """Start the KV/coordinator service and return the workers'
+        rendezvous env (reference runner.py:102 — gloo names kept)."""
+        from ..runner.http.http_server import (
+            RendezvousServer, local_ip,
+        )
+
+        self.rendezvous = RendezvousServer(
+            secret=self.settings.key
+            if isinstance(self.settings.key, bytes) else None,
+            world_size=self.world_size)
+        self.global_rendezv_port = self.rendezvous.start()
+        addr = local_ip()
+        return {
+            "HOROVOD_GLOO_RENDEZVOUS_ADDR": addr,
+            "HOROVOD_GLOO_RENDEZVOUS_PORT":
+                str(self.global_rendezv_port),
+            "HOROVOD_RENDEZVOUS_ADDR": addr,
+            "HOROVOD_RENDEZVOUS_PORT": str(self.global_rendezv_port),
+            "HOROVOD_CONTROLLER": "http",
+            "HOROVOD_CPU_OPERATIONS": "cpu",
+        }
+
+
+@dataclass
+class StaticParams(BaseParams):
+    """Reference runner.py:133."""
+
+    num_workers: Optional[int] = None
+    num_hosts: Optional[int] = None
+    num_workers_per_host: int = 1
+    use_current_placement_group: bool = True
+
+    @property
+    def elastic(self):
+        return False
+
+    @property
+    def adapter(self):
+        return StaticAdapter
+
+
+class StaticAdapter(Adapter):
+    """Reference runner.py:424 — drives a fixed-size actor set.
+    Delegates to RayExecutor (package root), which owns the actor
+    lifecycle; requires ray at start()."""
+
+    def __init__(self, params, settings=None):
+        self.params = params
+        self.settings = settings or MiniSettings()
+        self._executor = None
+
+    def start(self, executable_cls=None, executable_args=None,
+              executable_kwargs=None, extra_env_vars=None):
+        self._executor = RayExecutor(
+            self.settings,
+            num_workers=self.params.num_workers,
+            num_hosts=self.params.num_hosts,
+            num_workers_per_host=self.params.num_workers_per_host,
+            cpus_per_worker=self.params.cpus_per_worker,
+            use_gpu=self.params.use_gpu,
+            gpus_per_worker=self.params.gpus_per_worker)
+        self._executor.start(executable_cls=executable_cls,
+                             executable_args=executable_args,
+                             executable_kwargs=executable_kwargs,
+                             extra_env_vars=extra_env_vars)
+
+    def execute(self, fn, callbacks=None):
+        return self._executor.execute(fn)
+
+    def run(self, fn, args=None, kwargs=None, callbacks=None):
+        return self._executor.run(fn, args=args, kwargs=kwargs)
+
+    def run_remote(self, fn, args=None, kwargs=None):
+        return self._executor.run_remote(fn, args=args, kwargs=kwargs)
+
+    def execute_single(self, fn):
+        return self._executor.execute_single(fn)
+
+    def shutdown(self):
+        if self._executor is not None:
+            self._executor.shutdown()

@@ -13,7 +13,10 @@ constructible and unit-testable without ray installed (a fake module
 in ``sys.modules`` suffices — the tests assert bundle layouts).
 """
 
+import logging
 from collections import defaultdict
+
+logger = logging.getLogger("horovod_tpu.ray")
 
 
 def create_placement_group(resources_per_bundle, num_bundles,

@@ -1,0 +1,29 @@
+"""Ray helpers (reference ``horovod/ray/utils.py``)."""
+
+
+def map_blocking(fn, collection):
+    """``ray.get`` over ``fn`` mapped on the collection (reference
+    utils.py:90)."""
+    import ray
+    return ray.get([fn(w) for w in collection])
+
+
+def nics_to_env_var(nics):
+    """Reference utils.py:82."""
+    return {
+        "HOROVOD_GLOO_IFACE": list(nics)[0] if nics else "",
+        "NCCL_SOCKET_IFNAME": ",".join(nics or []),
+    }
+
+
+def detect_nics(settings, all_host_names=None, node_workers=None):
+    """NIC detection (reference utils.py:36 probes actors on every
+    host).  TPU pods share one fabric, so the probe reduces to the
+    driver-side resolution: an explicit ``settings.nics`` wins,
+    single-host jobs get the loopback set, multi-host jobs need no
+    interface constraint (the control plane is address-based)."""
+    from ..runner.driver.driver_service import get_common_interfaces
+
+    hosts = list(all_host_names or [])
+    nics = get_common_interfaces(settings, hosts)
+    return list(nics)

@@ -1,0 +1,3 @@
+"""Reference import path ``horovod.ray.worker``."""
+
+from . import BaseHorovodWorker  # noqa: F401

@@ -53,16 +53,9 @@ class Wire:
     def __init__(self, key):
         self._key = key or b""
 
-    def _dumps(self, obj):
-        try:
-            import cloudpickle
-            return cloudpickle.dumps(obj,
-                                     protocol=pickle.HIGHEST_PROTOCOL)
-        except ImportError:
-            return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-
     def write(self, obj, wfile):
-        message = self._dumps(obj)
+        from .codec import _dumps
+        message = _dumps(obj)
         wfile.write(secret.compute_digest(self._key, message))
         wfile.write(struct.pack("i", len(message)))
         wfile.write(message)
@@ -185,7 +178,11 @@ class BasicClient:
         attempts = 1 if probing else self._attempts
         for attempt in range(attempts):
             sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-            sock.settimeout(self._probe_timeout)
+            # probe sockets are bounded; real RPCs block — several of
+            # the protocol's requests legitimately wait minutes
+            # (WaitForCommandExitCode, WaitForShutdown), and a timeout
+            # retry would double-deliver or duplicate streamed output
+            sock.settimeout(self._probe_timeout if probing else None)
             try:
                 sock.connect(tuple(addr))
                 rfile = sock.makefile("rb")

@@ -13,7 +13,11 @@ from .config_parser import set_env_from_args
 def run_elastic(args):
     min_np = args.min_np or args.np
     max_np = args.max_np or args.np
-    if args.host_discovery_script:
+    if getattr(args, "discovery", None) is not None:
+        # programmatic callers (gloo_run.launch_gloo_elastic /
+        # ElasticSettings) hand over a ready HostDiscovery object
+        discovery = args.discovery
+    elif args.host_discovery_script:
         discovery = HostDiscoveryScript(args.host_discovery_script,
                                         slots=args.slots_per_host)
     elif args.hosts:
@@ -26,6 +30,11 @@ def run_elastic(args):
 
     env = {}
     set_env_from_args(env, args)
+    # programmatic callers (gloo_run.launch_gloo_elastic) pass a base
+    # env for the workers; CLI-derived HOROVOD_* entries win over it
+    extra = getattr(args, "extra_env", None)
+    if extra:
+        env = {**extra, **env}
     secret_hex = _secrets.token_hex(16)
     at_env = dict(os.environ)
     at_env.update(env)

@@ -135,14 +135,20 @@ def launch_gloo_elastic(command_or_func, exec_command, settings, env,
 
     from .elastic_run import run_elastic
 
+    discovery = getattr(settings, "discovery", None)
     args = Namespace(
         np=settings.num_proc,
         min_np=getattr(settings, "min_num_proc", None),
         max_np=getattr(settings, "max_num_proc", None),
         hosts=getattr(settings, "hosts", None),
-        host_discovery_script=getattr(settings, "discovery_script",
-                                      None),
+        discovery=discovery if not isinstance(discovery, str)
+        else None,
+        host_discovery_script=discovery
+        if isinstance(discovery, str)
+        else getattr(settings, "discovery_script", None),
         slots_per_host=getattr(settings, "slots", None),
+        blacklist_cooldown_range=getattr(settings, "cooldown_range",
+                                         None),
         command=command_or_func
         if isinstance(command_or_func, (list, tuple))
         else [command_or_func],
@@ -150,9 +156,11 @@ def launch_gloo_elastic(command_or_func, exec_command, settings, env,
         start_timeout=None,
         output_filename=settings.output_filename,
         reset_limit=getattr(settings, "reset_limit", None),
-        elastic_timeout=getattr(settings, "elastic_timeout", None),
+        elastic_timeout=getattr(settings, "elastic_timeout", None)
+        or 600,
         cpu=False,
         ranks_per_worker=1,
+        extra_env=dict(env) if env else None,
     )
     return run_elastic(args)
 

@@ -24,14 +24,15 @@ def generate_jsrun_rankfile(settings, path=None):
     path = path or tempfile.mktemp(suffix=".rankfile")
     hosts = LSFUtils.get_compute_hosts()
     slots_total = settings.num_proc
-    per_host = max(1, slots_total // max(len(hosts), 1))
+    n_hosts = max(len(hosts), 1)
+    base, rem = divmod(slots_total, n_hosts)
     with open(path, "w") as f:
         f.write("overlapping_rs: allow\ncpu_index_using: logical\n\n")
         rank = 0
-        for host in hosts:
-            for _ in range(per_host):
-                if rank >= slots_total:
-                    break
+        for i, host in enumerate(hosts):
+            # first `rem` hosts carry one extra rank so every
+            # requested rank lands in the file
+            for _ in range(base + (1 if i < rem else 0)):
                 f.write(f"rank: {rank}: {{ hostname: {host}; }}\n")
                 rank += 1
     return path

@@ -43,16 +43,21 @@ def get_local_host_addresses():
 
 def get_local_intfs(nic=None):
     """Interfaces carrying 127.0.0.1 (reference network.py:36 — used
-    only as the single-host fallback NIC set)."""
+    only as the single-host fallback NIC set).  ``nic`` may be a
+    single name or a set of names (launch.py's --nics action builds a
+    set)."""
+    wanted = None
+    if nic is not None:
+        wanted = {nic} if isinstance(nic, str) else set(nic)
     intfs = set()
     try:
         names = {name for _, name in socket.if_nameindex()}
     except OSError:
         names = {"lo"}
-    if "lo" in names and (nic is None or nic == "lo"):
+    if "lo" in names and (wanted is None or "lo" in wanted):
         intfs.add("lo")
-    elif nic in names:
-        intfs.add(nic)
+    elif wanted:
+        intfs |= wanted & names
     return intfs
 
 

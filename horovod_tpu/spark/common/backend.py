@@ -1,0 +1,74 @@
+"""Estimator execution backends (reference
+``horovod/spark/common/backend.py``): the estimator's training loop is
+handed to a Backend, which decides how the distributed job launches.
+``SparkBackend`` drives Spark barrier tasks (spark/runner.py
+register→plan flow); the default in-process backend runs the same
+loop through the thread launcher — the path the TPU estimators use
+when no SparkContext exists."""
+
+
+def default_num_proc():
+    """Reference backend.py:25 — Spark's default parallelism, or the
+    local device count without a SparkContext."""
+    try:
+        import pyspark
+        sc = pyspark.SparkContext._active_spark_context
+        if sc is not None:
+            return sc.defaultParallelism
+    except ImportError:
+        pass
+    try:
+        import jax
+        return max(1, len(jax.devices()))
+    except Exception:  # noqa: BLE001 — backend not initialized
+        return 1
+
+
+class Backend:
+    """Interface (reference backend.py:30)."""
+
+    def run(self, fn, args=(), kwargs=None, env=None):
+        raise NotImplementedError
+
+    def num_processes(self):
+        raise NotImplementedError
+
+
+class SparkBackend(Backend):
+    """Run training through Spark barrier tasks (reference
+    backend.py:56)."""
+
+    def __init__(self, num_proc=None, env=None, verbose=1,
+                 start_timeout=None, nics=None, **kwargs):
+        self._num_proc = num_proc or default_num_proc()
+        self._env = env
+        self._verbose = verbose
+        self._start_timeout = start_timeout
+
+    def run(self, fn, args=(), kwargs=None, env=None):
+        from .. import run as spark_run
+        return spark_run(fn, args=args, kwargs=kwargs or {},
+                         num_proc=self._num_proc,
+                         start_timeout=self._start_timeout,
+                         env=env or self._env,
+                         verbose=self._verbose)
+
+    def num_processes(self):
+        return self._num_proc
+
+
+class LocalBackend(Backend):
+    """Thread-launcher backend: one process drives all local chips
+    (the TPU-host model; beyond-reference but the natural default
+    here)."""
+
+    def __init__(self, num_proc=None):
+        self._num_proc = num_proc or default_num_proc()
+
+    def run(self, fn, args=(), kwargs=None, env=None):
+        from ... import runner
+        return runner.run(fn, args=args, kwargs=kwargs or {},
+                          np=self._num_proc)
+
+    def num_processes(self):
+        return self._num_proc

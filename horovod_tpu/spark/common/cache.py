@@ -1,0 +1,67 @@
+"""Prepared-dataset cache (reference
+``horovod/spark/common/cache.py`` TrainingDataCache): repeated
+``fit()`` calls over the same DataFrame + store skip the Parquet
+staging step by reusing the previously materialized dataset index."""
+
+import threading
+
+
+class TrainingDataCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._reset()
+
+    def _reset(self):
+        self._key_to_dataset = {}
+        self._dataset_props = {}
+        self._next_index = 0
+        self._last_key = None
+
+    def create_key(self, df, store, validation):
+        return (id(df), store.prefix_path if store is not None
+                else None, validation)
+
+    def use_key(self, key):
+        with self._lock:
+            self._last_key = key
+
+    def next_dataset_index(self, key):
+        """Index for this key's dataset — reused when cached, fresh
+        otherwise (reference cache.py:37)."""
+        with self._lock:
+            if key in self._key_to_dataset:
+                return self._key_to_dataset[key]
+            index = self._next_index
+            self._next_index += 1
+            self._key_to_dataset[key] = index
+            return index
+
+    def get_dataset(self, key):
+        with self._lock:
+            return self._key_to_dataset.get(key)
+
+    def get_dataset_properties(self, dataset_idx):
+        with self._lock:
+            return self._dataset_props.get(dataset_idx)
+
+    def set_dataset_properties(self, dataset_idx, props):
+        with self._lock:
+            self._dataset_props[dataset_idx] = props
+
+    def is_cached(self, key, store):
+        with self._lock:
+            idx = self._key_to_dataset.get(key)
+            if idx is None:
+                return False
+            props = self._dataset_props.get(idx)
+        if props is None:
+            return False
+        train_path = props.get("train_data_path")
+        if train_path is None:
+            return True
+        import os
+        return os.path.exists(train_path)
+
+    def clear(self):
+        with self._lock:
+            self._reset()

@@ -1,0 +1,16 @@
+"""Spark-layer constants (reference
+``horovod/spark/common/constants.py``)."""
+
+TOTAL_BUFFER_MEMORY_CAP_GIB = 4
+BYTES_PER_GIB = 1073741824
+METRIC_PRINT_FREQUENCY = 100
+
+# column/value type tags used by the DataFrame staging layer
+ARRAY = "array"
+CUSTOM_SPARSE = "custom_sparse_format"
+NOCHANGE = "nochange"
+DENSE_VECTOR = "dense_vector"
+SPARSE_VECTOR = "sparse_vector"
+MIXED_SPARSE_DENSE_VECTOR = "mixed_sparse_dense_vector"
+
+PETASTORM_HDFS_DRIVER = "libhdfs"

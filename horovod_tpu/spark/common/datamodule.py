@@ -1,0 +1,69 @@
+"""Base data module (reference
+``horovod/spark/common/datamodule.py``): a context-managed pair of
+train/validation readers the estimators loop over.  The default
+implementation reads the store's staged Parquet through the per-rank
+streaming reader (spark/common/reader.py)."""
+
+from abc import ABC, abstractmethod
+
+
+class DataModule(ABC):
+    """Reference datamodule.py:18."""
+
+    short_name = None
+
+    def __init__(self, train_dir, val_dir=None, num_train_epochs=1,
+                 has_val=True, train_batch_size=32, val_batch_size=32,
+                 shuffle=True, transformation_fn=None, train_reader_worker_count=1,
+                 val_reader_worker_count=1, random_seed=0, **kwargs):
+        self.train_dir = train_dir
+        self.val_dir = val_dir
+        self.num_train_epochs = num_train_epochs
+        self.has_val = has_val and val_dir is not None
+        self.train_batch_size = train_batch_size
+        self.val_batch_size = val_batch_size
+        self.shuffle = shuffle
+        self.transformation_fn = transformation_fn
+        self.train_reader_worker_count = train_reader_worker_count
+        self.val_reader_worker_count = val_reader_worker_count
+        self.random_seed = random_seed
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_value, traceback):
+        return False
+
+    @abstractmethod
+    def train_data(self):
+        """Iterator of training batches for this rank."""
+
+    @abstractmethod
+    def val_data(self):
+        """Iterator of validation batches for this rank."""
+
+
+class ParquetDataModule(DataModule):
+    """Streams the store's row groups for this rank (the live path the
+    estimators use; beyond-reference name)."""
+
+    short_name = "parquet"
+
+    def _reader(self, path, batch_size, shuffle):
+        from ...common import basics
+        from .reader import make_batch_reader
+        rank = basics.rank() if basics.is_initialized() else 0
+        size = basics.size() if basics.is_initialized() else 1
+        return make_batch_reader(path, batch_size=batch_size,
+                                 cur_shard=rank, shard_count=size,
+                                 shuffle_row_groups=shuffle,
+                                 seed=self.random_seed or 0)
+
+    def train_data(self):
+        return self._reader(self.train_dir, self.train_batch_size,
+                            self.shuffle)
+
+    def val_data(self):
+        if not self.has_val:
+            return iter(())
+        return self._reader(self.val_dir, self.val_batch_size, False)

@@ -1,0 +1,35 @@
+"""Estimator/model base classes (reference
+``horovod/spark/common/estimator.py`` HorovodEstimator/HorovodModel).
+
+The concrete estimators (spark/torch, spark/keras, spark/lightning)
+implement the fit/transform contract directly; these bases carry the
+shared contract + persistence mixins for code typed against the
+reference's class hierarchy."""
+
+from .params import EstimatorParams, ModelParams
+from .serialization import ParamsReadable, ParamsWritable
+
+
+class HorovodEstimator(EstimatorParams, ParamsWritable,
+                       ParamsReadable):
+    """Reference estimator.py:25 — ``fit(df)`` returns a trained
+    HorovodModel transformer; ``fit_on_parquet`` trains straight from
+    a staged dataset."""
+
+    def fit(self, df, params=None):
+        raise NotImplementedError(
+            "use TorchEstimator / KerasEstimator / LightningEstimator "
+            "— each implements fit() over the streaming Parquet store")
+
+    def fit_on_parquet(self, params=None, dataset_idx=None):
+        raise NotImplementedError(
+            "use TorchEstimator / KerasEstimator / LightningEstimator")
+
+
+class HorovodModel(ModelParams, ParamsWritable, ParamsReadable):
+    """Reference estimator.py:97 — transformer over a trained model;
+    prediction columns default to ``<label>__output``."""
+
+    def transform(self, df, params=None):
+        raise NotImplementedError(
+            "use the model returned by an estimator's fit()")

@@ -129,3 +129,48 @@ class EstimatorParams:
     def getShuffle(self): return self.shuffle        # noqa: E704
     def getValBatchSize(self): return self.val_batch_size  # noqa: E704
     def getRandomSeed(self): return self.random_seed  # noqa: E704
+
+
+class ModelParams:
+    """Model-side parameter surface (reference spark/common/params.py
+    ModelParams:444): the trained-model transformer's attributes with
+    the MLlib-style accessor pairs, pyspark-free."""
+
+    _DEFAULTS = dict(
+        history=None,
+        model=None,
+        feature_columns=(),
+        label_columns=(),
+        output_cols=(),
+        run_id=None,
+        _metadata=None,
+    )
+
+    def __init__(self, **kwargs):
+        unknown = set(kwargs) - set(self._DEFAULTS)
+        if unknown:
+            raise ValueError(
+                f"unknown model parameters: {sorted(unknown)}")
+        for k, v in self._DEFAULTS.items():
+            setattr(self, k, kwargs.get(k, v))
+
+    def setParams(self, **kwargs):
+        for k, v in kwargs.items():
+            if k not in self._DEFAULTS:
+                raise ValueError(f"unknown model parameter: {k}")
+            setattr(self, k, v)
+        return self
+
+    def _get_metadata(self): return self._metadata    # noqa: E704
+    def setHistory(self, v): self.history = v; return self  # noqa: E702,E704
+    def getHistory(self): return self.history         # noqa: E704
+    def setModel(self, v): self.model = v; return self  # noqa: E702,E704
+    def getModel(self): return self.model             # noqa: E704
+    def setFeatureColumns(self, v): self.feature_columns = v; return self  # noqa: E702,E704
+    def getFeatureColumns(self): return self.feature_columns  # noqa: E704
+    def setLabelColumns(self, v): self.label_columns = v; return self  # noqa: E702,E704
+    def getLabelColumns(self): return self.label_columns  # noqa: E704
+    def setOutputCols(self, v): self.output_cols = v; return self  # noqa: E702,E704
+    def getOutputCols(self): return self.output_cols  # noqa: E704
+    def setRunId(self, v): self.run_id = v; return self  # noqa: E702,E704
+    def getRunId(self): return self.run_id            # noqa: E704

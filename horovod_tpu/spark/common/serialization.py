@@ -1,0 +1,98 @@
+"""Estimator/model persistence (reference
+``horovod/spark/common/serialization.py``).
+
+The reference subclasses MLlib's DefaultParamsWriter/Reader; this
+build's params are plain attributes, so persistence is a directory
+with ``metadata.json`` (class path + JSON-able params) and
+``params.pkl`` (the rest, pickled).  Framework objects (models,
+optimizers) are serialized by each estimator's own blob helpers
+before they reach the param dict."""
+
+import importlib
+import json
+import os
+import pickle
+
+
+class HorovodParamsWriter:
+    """Reference serialization.py:23."""
+
+    def __init__(self, instance):
+        self.instance = instance
+
+    def save(self, path):
+        os.makedirs(path, exist_ok=True)
+        cls = type(self.instance)
+        json_params, pickled_params = {}, {}
+        for name in getattr(self.instance, "_DEFAULTS", {}):
+            value = getattr(self.instance, name)
+            try:
+                json.dumps(value)
+                json_params[name] = value
+            except (TypeError, ValueError):
+                pickled_params[name] = value
+        metadata = {
+            "class": f"{cls.__module__}.{cls.__qualname__}",
+            "paramMap": json_params,
+        }
+        with open(os.path.join(path, "metadata.json"), "w") as f:
+            json.dump(metadata, f, indent=2)
+        with open(os.path.join(path, "params.pkl"), "wb") as f:
+            pickle.dump(pickled_params, f)
+
+    # MLlib-writer-style alias
+    def overwrite(self):
+        return self
+
+
+class HorovodParamsReader:
+    """Reference serialization.py:71."""
+
+    def __init__(self, cls=None):
+        self.cls = cls
+
+    def load(self, path):
+        with open(os.path.join(path, "metadata.json")) as f:
+            metadata = json.load(f)
+        params = dict(metadata.get("paramMap", {}))
+        pkl = os.path.join(path, "params.pkl")
+        if os.path.exists(pkl):
+            with open(pkl, "rb") as f:
+                params.update(pickle.load(f))
+        cls = self.cls
+        if cls is None:
+            module, _, qualname = metadata["class"].rpartition(".")
+            cls = getattr(importlib.import_module(module), qualname)
+        instance = cls.__new__(cls)
+        for name, default in getattr(cls, "_DEFAULTS", {}).items():
+            setattr(instance, name, params.get(name, default))
+        return instance
+
+
+class ParamsWritable:
+    """Mixin giving estimators/models ``.write()``/``.save(path)``
+    (the MLlib Writable contract the per-estimator *Writable classes
+    re-export)."""
+
+    def write(self):
+        return _BoundWriter(self)
+
+    def save(self, path):
+        HorovodParamsWriter(self).save(path)
+
+
+class ParamsReadable:
+    """Mixin giving classes ``.read()``/``.load(path)``."""
+
+    @classmethod
+    def read(cls):
+        return HorovodParamsReader(cls)
+
+    @classmethod
+    def load(cls, path):
+        return HorovodParamsReader(cls).load(path)
+
+
+class _BoundWriter(HorovodParamsWriter):
+    def overwrite(self):
+        return self

@@ -212,3 +212,9 @@ class HDFSStore(Store):
             self._fs.delete_dir(path)
         elif info.type != pafs.FileType.NotFound:
             self._fs.delete_file(path)
+
+
+# reference spark/common/store.py:38 class name: the filesystem layer
+# base.  FilesystemStore here IS the abstract-filesystem implementation
+# (fsspec-free), so the reference name aliases it.
+AbstractFilesystemStore = FilesystemStore

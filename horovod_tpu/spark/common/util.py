@@ -176,3 +176,258 @@ def warn_driver_materialization(df, what):
         f"{what} without a Store materializes the whole DataFrame on "
         "the driver; configure store=... so executors stream Parquet "
         "instead", RuntimeWarning, stacklevel=3)
+
+
+# -- reference spark/common/util.py surface ----------------------------------
+#
+# Pyspark-free where the semantics allow (the hot path here stages
+# through pyarrow, not Spark SQL types); the Spark-type mappers gate
+# on pyspark with explicit errors.
+
+from ...runner.common.util.host_hash import host_hash  # noqa: F401,E402
+
+
+def to_list(var, length):
+    """Reference util.py:749 — normalize a scalar/1-list to a list of
+    ``length``."""
+    if var is None:
+        return None
+    if not isinstance(var, list):
+        var = [var]
+    if len(var) == 1:
+        return [var[0]] * length
+    if len(var) != length:
+        raise ValueError(
+            f"List {var} must be length {length} (found: {len(var)})")
+    return var
+
+
+def is_databricks():
+    """Reference util.py — running inside a Databricks runtime."""
+    import os
+    return "DATABRICKS_RUNTIME_VERSION" in os.environ
+
+
+def check_validation(validation, df=None):
+    """Reference util.py:691."""
+    if validation:
+        if isinstance(validation, float):
+            if validation < 0 or validation >= 1:
+                raise ValueError(
+                    f"Validation split {validation} must be in the "
+                    f"range: [0, 1)")
+        elif isinstance(validation, str):
+            if df is not None and validation not in df.columns:
+                raise ValueError(
+                    f"Validation column {validation} does not exist "
+                    f"in the DataFrame")
+        else:
+            raise ValueError(
+                f'Param validation must be of type "float" or "str", '
+                f"found: {type(validation)}")
+
+
+def numpy_type_to_str(dtype):
+    """Reference util.py:87."""
+    import numpy as np
+    mapping = {
+        np.dtype(np.int32): "Int",
+        np.dtype(np.float32): "Float",
+        np.dtype(np.uint8): "Binary",
+        np.dtype(np.float64): "Double",
+        np.dtype(np.int64): "Long",
+        np.dtype(np.bool_): "Boolean",
+    }
+    key = np.dtype(dtype)
+    if key not in mapping:
+        raise ValueError(
+            f"Cannot convert numpy data type to Spark string: {dtype}")
+    return mapping[key]
+
+
+def data_type_to_numpy(dtype):
+    """Reference util.py:104 — Spark SQL type to numpy dtype; accepts
+    the type classes by name so it works without pyspark for the
+    common tags."""
+    import numpy as np
+    name = getattr(dtype, "__name__", str(dtype))
+    mapping = {
+        "IntegerType": np.int32, "Int": np.int32,
+        "StringType": np.str_, "String": np.str_,
+        "FloatType": np.float32, "Float": np.float32,
+        "BinaryType": np.uint8, "Binary": np.uint8,
+        "DoubleType": np.float64, "Double": np.float64,
+        "LongType": np.int64, "Long": np.int64,
+        "BooleanType": np.bool_, "Boolean": np.bool_,
+        "VectorUDT": np.float64, "Vector": np.float64,
+    }
+    if name not in mapping:
+        raise ValueError(
+            f"Unrecognized data type: {dtype}")
+    return mapping[name]
+
+
+def data_type_to_str(dtype):
+    """Reference util.py:66."""
+    name = getattr(dtype, "__name__", str(dtype))
+    mapping = {
+        "VectorUDT": "Vector", "SparseVector": "Vector",
+        "DenseVector": "Vector",
+        "IntegerType": "Int", "StringType": "String",
+        "FloatType": "Float", "BinaryType": "Binary",
+        "DoubleType": "Double", "LongType": "Long",
+        "BooleanType": "Boolean",
+    }
+    if name not in mapping:
+        raise ValueError(
+            f"Unrecognized DataType: {dtype}")
+    return mapping[name]
+
+
+def pyarrow_to_spark_data_type(dtype):
+    """Reference util.py — pyarrow type to the Spark SQL type class
+    (requires pyspark)."""
+    require_pyspark()
+    try:
+        # pyspark >= 3.0
+        from pyspark.sql.pandas.types import from_arrow_type
+    except ImportError:
+        from pyspark.sql.types import from_arrow_type
+    return type(from_arrow_type(dtype))
+
+
+def spark_scalar_to_python_type(dtype):
+    """Reference util.py — Spark SQL scalar type to the Python type."""
+    numpy_type = data_type_to_numpy(dtype)
+    import numpy as np
+    return {np.int32: int, np.int64: int, np.float32: float,
+            np.float64: float, np.uint8: bytes, np.bool_: bool,
+            np.str_: str}.get(numpy_type, float)
+
+
+def get_output_cols(label_cols, output_cols=None):
+    """Reference util.py — prediction column names default to
+    ``<label>__output``."""
+    if output_cols:
+        return list(output_cols)
+    return [f"{col}__output" for col in label_cols]
+
+
+def check_shape_compatibility(metadata, feature_columns, label_columns,
+                              input_shapes=None, output_shapes=None,
+                              label_shapes=None):
+    """Reference util.py:154 — column element counts must match the
+    model's declared input/output shapes."""
+    import numpy as np
+
+    def _check(cols, shapes, what):
+        if shapes is None:
+            return
+        if len(cols) != len(shapes):
+            raise ValueError(
+                f"{what} column count {len(cols)} must equal model "
+                f"{what.lower()} count {len(shapes)}")
+        for col, shape in zip(cols, shapes):
+            col_shape = metadata.get(col, {}).get("shape")
+            if col_shape is None or shape is None:
+                continue
+            col_size = int(np.prod([d for d in np.atleast_1d(col_shape)
+                                    if d and d > 0]))
+            model_size = int(np.prod([d for d in shape
+                                      if d and d > 0]))
+            if col_size != model_size:
+                raise ValueError(
+                    f"Feature column '{col}' with size {col_size} "
+                    f"must equal that of the model input shape "
+                    f"{shape} (size {model_size})")
+
+    _check(feature_columns, input_shapes, "Feature")
+    _check(label_columns, output_shapes or label_shapes, "Label")
+
+
+def get_simple_meta_from_parquet(store, label_columns=None,
+                                 feature_columns=None,
+                                 sample_weight_col=None,
+                                 dataset_idx=None):
+    """Reference util.py — column metadata (shape, dtype, count) read
+    from the staged Parquet dataset."""
+    import pyarrow.parquet as pq
+    train_path = store.train_data_path(dataset_idx) \
+        if hasattr(store, "train_data_path") else store
+    dataset = pq.ParquetDataset(train_path)
+    schema = dataset.schema
+    try:
+        total_rows = sum(f.count_rows() for f in dataset.fragments)
+    except Exception:  # noqa: BLE001 — older pyarrow
+        total_rows = None
+    metadata = {}
+    for field in schema:
+        metadata[field.name] = {
+            "spark_data_type": str(field.type),
+            "is_sparse_vector_only": False,
+            "shape": None,
+            "intermediate_format": "nochange",
+            "max_size": None,
+        }
+    return total_rows, metadata, None
+
+
+def prepare_data(num_processes, store, df, label_columns,
+                 feature_columns, validation=None,
+                 sample_weight_col=None, compress_sparse=False,
+                 partitions_per_process=10, verbose=0,
+                 dataset_idx=None):
+    """Reference util.py prepare_data — stage the DataFrame into the
+    store's Parquet layout.  Delegates to the streaming staging path
+    (stage_dataframe_to_store); requires pyspark for the DataFrame
+    leg."""
+    check_validation(validation, df)
+    return stage_dataframe_to_store(
+        df, store, list(feature_columns), list(label_columns),
+        validation=validation, sample_weight_col=sample_weight_col)
+
+
+def clear_training_cache():
+    """Reference util.py — drop the prepared-dataset cache."""
+    _training_cache.clear()
+
+
+def get_dataset_properties(dataset_idx):
+    """Reference util.py — properties recorded when the dataset was
+    staged."""
+    return _training_cache.get_dataset_properties(dataset_idx)
+
+
+def to_petastorm_fn(schema_cols, metadata):
+    """Reference util.py — row-transform used when staging to
+    Parquet; the pyarrow staging layer stores arrays natively, so
+    this is the identity on the selected columns."""
+
+    def _to_petastorm(row):
+        if isinstance(row, dict):
+            return {col: row[col] for col in schema_cols}
+        return row
+
+    return _to_petastorm
+
+
+from .cache import TrainingDataCache as _TrainingDataCache  # noqa: E402
+_training_cache = _TrainingDataCache()
+
+
+def get_spark_df_output_schema(df_schema, label_cols, output_cols,
+                               metadata):
+    """Reference util.py — the transformed DataFrame's schema: input
+    columns plus one prediction column per label (requires pyspark
+    for the StructType form)."""
+    require_pyspark()
+    from pyspark.sql.types import StructField, StructType
+    fields = list(df_schema.fields)
+    out_cols = get_output_cols(label_cols, output_cols)
+    for label, out in zip(label_cols, out_cols):
+        label_field = next(
+            (f for f in df_schema.fields if f.name == label), None)
+        dtype = label_field.dataType if label_field is not None \
+            else df_schema.fields[-1].dataType
+        fields.append(StructField(out, dtype, nullable=True))
+    return StructType(fields)

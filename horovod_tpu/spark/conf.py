@@ -1,0 +1,66 @@
+"""Spark configuration pairs for elastic jobs (reference
+``horovod/spark/conf.py``): the (key, value) tuples an elastic Spark
+job sets so Spark's own task-retry/blacklist machinery defers to
+Horovod's reset counters.  Values are Spark's documented configuration
+keys — see spark.apache.org/docs/latest/configuration.html."""
+
+SPARK_CONF_MAX_INT = "2147483647"
+SPARK_CONF_MAX_INT_MINUS_ONE = "2147483646"
+
+# Horovod owns retry limits; never let Spark give up first
+SPARK_CONF_ALWAYS_RESTART_FAILED_TASK = \
+    ("spark.task.maxFailures", SPARK_CONF_MAX_INT)
+
+SPARK_CONF_BLACKLIST_DISABLED = ("spark.blacklist.enabled", "false")
+SPARK_CONF_BLACKLIST_ENABLED = ("spark.blacklist.enabled", "true")
+
+SPARK_CONF_REUSE_FAILED_EXECUTOR = \
+    ("spark.blacklist.stage.maxFailedTasksPerExecutor",
+     SPARK_CONF_MAX_INT)
+SPARK_CONF_DONT_REUSE_FAILED_EXECUTOR = \
+    ("spark.blacklist.stage.maxFailedTasksPerExecutor", "1")
+
+SPARK_CONF_REUSE_FAILING_NODE = \
+    ("spark.blacklist.stage.maxFailedExecutorsPerNode",
+     SPARK_CONF_MAX_INT_MINUS_ONE)
+SPARK_CONF_DONT_REUSE_FAILING_NODE = \
+    ("spark.blacklist.stage.maxFailedExecutorsPerNode", "1")
+
+SPARK_CONF_REUSE_EXECUTOR_ALWAYS_FOR_SAME_TASK = \
+    ("spark.blacklist.task.maxTaskAttemptsPerExecutor",
+     SPARK_CONF_MAX_INT)
+SPARK_CONF_REUSE_EXECUTOR_ONCE_FOR_SAME_TASK = \
+    ("spark.blacklist.task.maxTaskAttemptsPerExecutor", "2")
+SPARK_CONF_DONT_REUSE_EXECUTOR_FOR_SAME_TASK = \
+    ("spark.blacklist.task.maxTaskAttemptsPerExecutor", "1")
+
+SPARK_CONF_REUSE_NODE_ALWAYS_FOR_SAME_TASK = \
+    ("spark.blacklist.task.maxTaskAttemptsPerNode",
+     SPARK_CONF_MAX_INT_MINUS_ONE)
+SPARK_CONF_REUSE_NODE_ONCE_FOR_SAME_TASK = \
+    ("spark.blacklist.task.maxTaskAttemptsPerNode", "2")
+SPARK_CONF_DONT_REUSE_NODE_FOR_SAME_TASK = \
+    ("spark.blacklist.task.maxTaskAttemptsPerNode", "1")
+
+SPARK_CONF_REUSE_FAILED_EXECUTOR_IN_APP = \
+    ("spark.blacklist.application.maxFailedTasksPerExecutor",
+     SPARK_CONF_MAX_INT)
+SPARK_CONF_DONT_REUSE_FAILED_EXECUTOR_IN_APP = \
+    ("spark.blacklist.application.maxFailedTasksPerExecutor", "1")
+
+SPARK_CONF_REUSE_FAILING_NODE_IN_APP = \
+    ("spark.blacklist.application.maxFailedExecutorsPerNode",
+     SPARK_CONF_MAX_INT)
+SPARK_CONF_DONT_REUSE_FAILING_NODE_IN_APP = \
+    ("spark.blacklist.application.maxFailedExecutorsPerNode", "1")
+
+SPARK_CONF_DEFAULT_VALUES = {
+    "spark.task.maxFailures": "4",
+    "spark.blacklist.enabled": "false",
+    "spark.blacklist.stage.maxFailedTasksPerExecutor": "2",
+    "spark.blacklist.stage.maxFailedExecutorsPerNode": "2",
+    "spark.blacklist.task.maxTaskAttemptsPerExecutor": "1",
+    "spark.blacklist.task.maxTaskAttemptsPerNode": "2",
+    "spark.blacklist.application.maxFailedTasksPerExecutor": "2",
+    "spark.blacklist.application.maxFailedExecutorsPerNode": "2",
+}

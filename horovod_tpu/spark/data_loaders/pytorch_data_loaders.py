@@ -1,0 +1,155 @@
+"""Torch data loaders over the streaming Parquet reader (reference
+``horovod/spark/data_loaders/pytorch_data_loaders.py``).
+
+The reference wraps petastorm's BatchedDataLoader; here the reader is
+the row-group-sharded Parquet streamer (spark/common/reader.py), and
+batches are converted to torch tensors at yield time.  The async
+variants stage batches through the AsyncDataLoaderMixin's background
+thread (data/data_loader_base.py), the same decoupling the reference
+uses to hide IO behind the train step.
+"""
+
+from ...data.data_loader_base import AsyncDataLoaderMixin, BaseDataLoader
+
+
+def _to_torch(batch):
+    import torch
+    if isinstance(batch, dict):
+        return {k: torch.as_tensor(v) for k, v in batch.items()}
+    if isinstance(batch, (list, tuple)):
+        return type(batch)(torch.as_tensor(v) for v in batch)
+    return torch.as_tensor(batch)
+
+
+class PytorchDataLoader(BaseDataLoader):
+    def __init__(self, reader, batch_size,
+                 shuffling_queue_capacity=0, name="",
+                 limit_step_per_epoch=-1, verbose=False):
+        self.reader = reader
+        self.batch_size = batch_size
+        self.shuffling_queue_capacity = shuffling_queue_capacity
+        self.name = name
+        self.limit_step_per_epoch = limit_step_per_epoch
+        self.verbose = verbose
+
+    def __len__(self):
+        return self.limit_step_per_epoch \
+            if self.limit_step_per_epoch != -1 else 0
+
+    def _reader_iter(self):
+        reset = getattr(self.reader, "reset", None)
+        if reset is not None and \
+                getattr(self.reader, "last_row_consumed", False):
+            reset()
+        return iter(self.reader)
+
+    def _iterate(self):
+        num_steps = 0
+        for batch in self._reader_iter():
+            if num_steps == self.limit_step_per_epoch:
+                break
+            num_steps += 1
+            yield _to_torch(batch)
+
+    def _print_verbose(self, *args, **kwargs):
+        if self.verbose:
+            print(*args, **kwargs)
+
+
+class PytorchAsyncDataLoader(AsyncDataLoaderMixin, PytorchDataLoader):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+
+
+class PytorchInfiniteDataLoader(PytorchDataLoader):
+    """Cycles the reader forever; an epoch is exactly
+    ``limit_step_per_epoch`` steps (reference :76)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        if self.limit_step_per_epoch == -1:
+            raise ValueError(
+                "PytorchInfiniteDataLoader requires "
+                "limit_step_per_epoch to be set")
+        self._iterator = None
+
+    def _iterate(self):
+        for _ in range(self.limit_step_per_epoch):
+            if self._iterator is None:
+                self._iterator = self._reader_iter()
+            try:
+                batch = next(self._iterator)
+            except StopIteration:
+                self._iterator = self._reader_iter()
+                batch = next(self._iterator)
+            yield _to_torch(batch)
+
+
+class PytorchInfiniteAsyncDataLoader(AsyncDataLoaderMixin,
+                                     PytorchInfiniteDataLoader):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+
+
+class PytorchInmemDataLoader(BaseDataLoader):
+    """Materializes the whole shard once and shuffles in memory each
+    epoch (reference :107) — for datasets that fit in host RAM."""
+
+    def __init__(self, reader, batch_size, num_epochs=1, name="",
+                 shuffle=False, limit_step_per_epoch=-1,
+                 verbose=False):
+        self.batch_size = batch_size
+        self.num_epochs = num_epochs
+        self.name = name
+        self.shuffle = shuffle
+        self.limit_step_per_epoch = limit_step_per_epoch
+        self.verbose = verbose
+        self._rows = [row for batch in reader
+                      for row in _iter_rows(batch)]
+
+    def __len__(self):
+        if self.limit_step_per_epoch != -1:
+            return self.limit_step_per_epoch
+        return max(1, len(self._rows) // self.batch_size)
+
+    def _iterate(self):
+        import random
+        rows = list(self._rows)
+        if self.shuffle:
+            random.shuffle(rows)
+        num_steps = 0
+        for start in range(0, len(rows), self.batch_size):
+            if num_steps == self.limit_step_per_epoch:
+                break
+            num_steps += 1
+            yield _collate(rows[start:start + self.batch_size])
+
+
+class PytorchInmemAsyncDataLoader(AsyncDataLoaderMixin,
+                                  PytorchInmemDataLoader):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+
+
+#: Petastorm-named alias (reference :153 wraps petastorm's
+#: BatchedDataLoader; the streaming reader plays that role here).
+PetastormBatchedDataLoader = PytorchDataLoader
+
+
+def _iter_rows(batch):
+    if isinstance(batch, dict):
+        keys = list(batch)
+        n = len(batch[keys[0]])
+        for i in range(n):
+            yield {k: batch[k][i] for k in keys}
+    else:
+        yield from batch
+
+
+def _collate(rows):
+    import numpy as np
+    import torch
+    if rows and isinstance(rows[0], dict):
+        return {k: torch.as_tensor(np.stack([r[k] for r in rows]))
+                for k in rows[0]}
+    return torch.as_tensor(np.stack(rows))

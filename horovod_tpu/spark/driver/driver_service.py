@@ -1,0 +1,166 @@
+"""Spark driver service (reference
+``horovod/spark/driver/driver_service.py``): the BasicDriverService
+plus the Spark-job verbs — host-hash index queries, local-rank→rank
+mapping, shipping the training function to executors, shutdown
+barrier.  The live TPU launch path registers over the HMAC-HTTP KV
+store (spark/runner.py); this TCP form serves reference-shaped
+tooling end-to-end."""
+
+import threading
+
+from ...runner.common.service import driver_service
+from ...runner.common.util import network
+
+
+class TaskHostHashIndicesRequest:
+    def __init__(self, host_hash):
+        self.host_hash = host_hash
+
+
+class TaskHostHashIndicesResponse:
+    def __init__(self, indices):
+        self.indices = indices
+
+
+class SetLocalRankToRankRequest:
+    def __init__(self, host_hash, local_rank, rank):
+        self.host_hash = host_hash
+        self.local_rank = local_rank
+        self.rank = rank
+
+
+class SetLocalRankToRankResponse:
+    def __init__(self, index):
+        self.index = index
+
+
+class TaskIndexByRankRequest:
+    def __init__(self, rank):
+        self.rank = rank
+
+
+class TaskIndexByRankResponse:
+    def __init__(self, index):
+        self.index = index
+
+
+class CodeRequest:
+    pass
+
+
+class CodeResponse:
+    def __init__(self, fn, args, kwargs):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+
+
+class WaitForTaskShutdownRequest:
+    pass
+
+
+class SparkDriverService(driver_service.BasicDriverService):
+    NAME = "driver service"
+
+    def __init__(self, initial_num_proc, num_proc, fn, args, kwargs,
+                 key, nics=None):
+        super().__init__(initial_num_proc, SparkDriverService.NAME,
+                         key, nics)
+        self._initial_num_proc = initial_num_proc
+        self._fn = fn
+        self._args = args
+        self._kwargs = kwargs
+        self._ranks_to_indices = {}
+        self._spark_job_failed = False
+        self._task_shutdown = threading.Event()
+
+    def _handle(self, req, client_address):
+        if isinstance(req, TaskHostHashIndicesRequest):
+            return TaskHostHashIndicesResponse(
+                self.task_host_hash_indices().get(req.host_hash, []))
+
+        if isinstance(req, SetLocalRankToRankRequest):
+            with self._wait_cond:
+                indices = self.task_host_hash_indices().get(
+                    req.host_hash, [])
+                index = indices[req.local_rank]
+                values = list(self._ranks_to_indices.values())
+                if index in values:
+                    # previous rank mapping of a re-registering task
+                    for r, i in list(self._ranks_to_indices.items()):
+                        if i == index:
+                            del self._ranks_to_indices[r]
+                self._ranks_to_indices[req.rank] = index
+            return SetLocalRankToRankResponse(index)
+
+        if isinstance(req, TaskIndexByRankRequest):
+            with self._wait_cond:
+                return TaskIndexByRankResponse(
+                    self._ranks_to_indices[req.rank])
+
+        if isinstance(req, CodeRequest):
+            return CodeResponse(self._fn, self._args, self._kwargs)
+
+        if isinstance(req, WaitForTaskShutdownRequest):
+            self._task_shutdown.wait()
+            return network.AckResponse()
+
+        return super()._handle(req, client_address)
+
+    def set_ranks_to_indices(self, ranks_to_indices):
+        with self._wait_cond:
+            self._ranks_to_indices = dict(ranks_to_indices)
+
+    def get_ranks_to_indices(self):
+        with self._wait_cond:
+            return dict(self._ranks_to_indices)
+
+    def notify_spark_job_failed(self):
+        with self._wait_cond:
+            self._spark_job_failed = True
+            self._wait_cond.notify_all()
+
+    def check_for_spark_job_failure(self):
+        if self._spark_job_failed:
+            raise RuntimeError(
+                "Spark job has failed, see the error above.")
+
+    def wait_for_initial_registration(self, timeout):
+        with self._wait_cond:
+            while len(self._all_task_addresses) < \
+                    self._initial_num_proc:
+                self.check_for_spark_job_failure()
+                self._wait_cond.wait(timeout.remaining())
+                timeout.check_time_out_for("tasks to start")
+
+    def shutdown_tasks(self):
+        self._task_shutdown.set()
+
+    def shutdown(self):
+        self.shutdown_tasks()
+        super().shutdown()
+
+
+class SparkDriverClient(driver_service.BasicDriverClient):
+    def __init__(self, driver_addresses, key, verbose=0,
+                 match_intf=False):
+        super().__init__(SparkDriverService.NAME, driver_addresses,
+                         key, verbose, match_intf=match_intf)
+
+    def task_host_hash_indices(self, host_hash):
+        return self._send(
+            TaskHostHashIndicesRequest(host_hash)).indices
+
+    def set_local_rank_to_rank(self, host_hash, local_rank, rank):
+        return self._send(SetLocalRankToRankRequest(
+            host_hash, local_rank, rank)).index
+
+    def task_index_by_rank(self, rank):
+        return self._send(TaskIndexByRankRequest(rank)).index
+
+    def code(self):
+        resp = self._send(CodeRequest())
+        return resp.fn, resp.args, resp.kwargs
+
+    def wait_for_task_shutdown(self):
+        self._send(WaitForTaskShutdownRequest())

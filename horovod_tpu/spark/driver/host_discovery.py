@@ -1,0 +1,17 @@
+"""Elastic host discovery from the Spark driver service (reference
+``horovod/spark/driver/host_discovery.py``): available hosts/slots
+are whatever executors have registered."""
+
+from ...runner.elastic.discovery import HostDiscovery
+
+
+class SparkDriverHostDiscovery(HostDiscovery):
+    def __init__(self, driver):
+        super().__init__()
+        self._driver = driver
+
+    def find_available_hosts_and_slots(self):
+        host_hash_indices = self._driver.task_host_hash_indices()
+        return {host: len(indices)
+                for host, indices in host_hash_indices.items()
+                if indices}

@@ -1,0 +1,14 @@
+"""Monotonic per-driver job ids (reference
+``horovod/spark/driver/job_id.py``)."""
+
+import threading
+
+LOCK = threading.Lock()
+JOB_ID = -1
+
+
+def next_job_id():
+    global JOB_ID
+    with LOCK:
+        JOB_ID += 1
+        return JOB_ID

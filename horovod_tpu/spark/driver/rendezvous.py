@@ -1,0 +1,29 @@
+"""Spark-aware rendezvous server (reference
+``horovod/spark/driver/rendezvous.py``): on every (re-)allocation it
+republishes the rank→executor-index mapping to the driver service so
+rsh targets stay correct across elastic rounds."""
+
+from ...runner.http.http_server import RendezvousServer
+
+
+class SparkRendezvousServer(RendezvousServer):
+    def __init__(self, driver, verbose=0, **kwargs):
+        super().__init__(**kwargs)
+        self._driver = driver
+        self._verbose = verbose
+
+    def init(self, host_alloc_plan):
+        """Record the new plan's rank→index map (reference
+        rendezvous.py:24).  The KV/coordinator service itself has no
+        per-plan init step in this build — rounds are published as
+        values — so this only updates the driver."""
+        ranks_to_indices = {}
+        host_indices = self._driver.task_host_hash_indices()
+        for slot_info in host_alloc_plan:
+            ranks_to_indices[slot_info.rank] = \
+                host_indices[slot_info.hostname][slot_info.local_rank]
+        self._driver.set_ranks_to_indices(ranks_to_indices)
+
+    def stop(self):
+        self._driver.shutdown_tasks()
+        super().stop()

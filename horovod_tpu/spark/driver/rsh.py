@@ -1,0 +1,48 @@
+"""Remote-shell onto a Spark executor (reference
+``horovod/spark/driver/rsh.py``): resolve the task with the given
+host hash + local rank through the driver service and run a command
+in it via its task service."""
+
+import threading
+
+from ...runner.util.threads import on_event
+from ..driver import driver_service
+from ..task import task_service
+
+
+def rsh(driver_addresses, key, host_hash, command, env, local_rank,
+        verbose=0, stdout=None, stderr=None,
+        prefix_output_with_timestamp=False, background=True,
+        events=None):
+    """Reference rsh.py:20 — returns the exit code when
+    ``background`` is False."""
+    if ":" in host_hash:
+        raise Exception(
+            "Illegal host hash provided. Are you using "
+            "Open MPI 4.0.0+?")
+
+    driver_client = driver_service.SparkDriverClient(
+        driver_addresses, key, verbose=verbose)
+    task_indices = driver_client.task_host_hash_indices(host_hash)
+    task_index = task_indices[local_rank]
+    task_addresses = driver_client.all_task_addresses(task_index)
+    task_client = task_service.SparkTaskClient(
+        task_index, task_addresses, key, verbose=verbose)
+    task_client.stream_command_output(stdout, stderr)
+    task_client.run_command(
+        command, env,
+        capture_stdout=stdout is not None,
+        capture_stderr=stderr is not None,
+        prefix_output_with_timestamp=prefix_output_with_timestamp)
+
+    if not background:
+        stop = threading.Event()
+        for event in events or []:
+            on_event(event, task_client.abort_command, stop=stop)
+        try:
+            exit_code = task_client.wait_for_command_exit_code()
+            return exit_code
+        except Exception:  # noqa: BLE001 — connection reset mid-wait
+            return -1
+        finally:
+            stop.set()

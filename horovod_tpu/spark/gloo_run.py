@@ -1,0 +1,74 @@
+"""Spark gloo-mode launch (reference ``horovod/spark/gloo_run.py``):
+run the per-rank exec command in each registered executor through its
+task service."""
+
+from ..runner.common.util import codec, secret
+from ..runner.util.threads import in_thread
+from .driver.rsh import rsh
+
+
+def _exec_command_fn(driver, key, settings, env,
+                     stdout=None, stderr=None):
+    def _exec_command(command, slot_info, events):
+        host = slot_info.hostname
+        local_rank = slot_info.local_rank
+        verbose = settings.verbose
+        result = rsh(driver.addresses(), key, host, command, env,
+                     local_rank, verbose, stdout, stderr,
+                     settings.prefix_output_with_timestamp, False,
+                     events)
+        return result, time.time()
+
+    import time
+    return _exec_command
+
+
+def gloo_run(executable, settings, nics, driver, env, stdout=None,
+             stderr=None):
+    """Reference spark/gloo_run.py gloo_run: launch every rank's exec
+    fn through its executor's task service and fail if any rank
+    fails."""
+    key = secret.make_secret_key() if not hasattr(driver, "_key") \
+        else driver._wire._key
+    # command each rank executes inside its executor
+    command = (
+        f"{executable} -m horovod_tpu.spark.task.gloo_exec_fn "
+        f"{codec.dumps_base64(driver.addresses())} "
+        f"{codec.dumps_base64(settings)}")
+
+    host_indices = driver.task_host_hash_indices()
+    threads = []
+    results = {}
+
+    def run_one(host, local_rank, rank):
+        code = rsh(driver.addresses(), key, host,
+                   f"HOROVOD_RANK={rank} HOROVOD_LOCAL_RANK="
+                   f"{local_rank} {command}",
+                   dict(env or {}), local_rank, settings.verbose,
+                   stdout, stderr,
+                   settings.prefix_output_with_timestamp,
+                   background=False)
+        results[rank] = code
+
+    rank = 0
+    for host, indices in host_indices.items():
+        for local_rank, _ in enumerate(indices):
+            threads.append(in_thread(run_one,
+                                     (host, local_rank, rank),
+                                     daemon=False))
+            rank += 1
+    for t in threads:
+        t.join()
+    failed = {r: c for r, c in results.items() if c != 0}
+    if failed:
+        raise RuntimeError(
+            f"Spark gloo job failed on ranks {sorted(failed)}")
+
+
+def gloo_run_elastic(settings, driver, env, stdout=None, stderr=None):
+    """Reference spark/gloo_run.py gloo_run_elastic — delegates to
+    the elastic driver over executor discovery."""
+    raise RuntimeError(
+        "elastic Spark launch goes through horovod_tpu.spark."
+        "run_elastic(fn, ...) — the KV-store flow that replaces the "
+        "reference's rsh-based elastic leg on TPU; call that instead")

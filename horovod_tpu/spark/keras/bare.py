@@ -1,0 +1,8 @@
+"""Bare-keras optimizer file persistence (reference
+``horovod/spark/keras/bare.py``).  Keras 3 unified the packages, so
+the bare path shares the tf.keras implementation."""
+
+from .tensorflow import (
+    load_tf_keras_optimizer as load_bare_keras_optimizer,  # noqa: F401
+    save_tf_keras_optimizer as save_bare_keras_optimizer,  # noqa: F401
+)

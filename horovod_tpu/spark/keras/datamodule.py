@@ -1,0 +1,22 @@
+"""Keras data modules (reference
+``horovod/spark/keras/datamodule.py``): PetastormDataModule streams
+the store's Parquet shards (the live reader plays petastorm's role);
+NVTabularDataModule requires the nvtabular GPU stack, absent on TPU
+hosts, and is gated loudly."""
+
+from ..common.datamodule import ParquetDataModule
+
+
+class PetastormDataModule(ParquetDataModule):
+    short_name = "petastorm"
+
+
+class NVTabularDataModule(ParquetDataModule):
+    short_name = "nvtabular"
+
+    def __init__(self, *args, **kwargs):
+        raise ImportError(
+            "NVTabularDataModule requires nvtabular (a CUDA/GPU "
+            "stack), which does not exist on TPU hosts; use "
+            "PetastormDataModule — the streaming Parquet reader "
+            "serves the same role.")

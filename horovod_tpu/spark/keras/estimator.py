@@ -286,3 +286,34 @@ def _optimizer_config(opt):
     if isinstance(opt, str):
         opt = tf.keras.optimizers.get(opt)
     return opt.__class__.__name__, opt.get_config()
+
+
+# -- MLlib-style persistence surface (reference spark/keras/estimator.py
+#    KerasEstimatorParams{Writable,Readable,Writer,Reader}) -----------------
+
+from ..common.serialization import (  # noqa: E402
+    HorovodParamsReader, HorovodParamsWriter, ParamsReadable,
+    ParamsWritable,
+)
+
+
+class KerasEstimatorParamsWriter(HorovodParamsWriter):
+    pass
+
+
+class KerasEstimatorParamsReader(HorovodParamsReader):
+    pass
+
+
+class KerasEstimatorParamsWritable(ParamsWritable):
+    pass
+
+
+class KerasEstimatorParamsReadable(ParamsReadable):
+    pass
+
+
+KerasEstimator.write = ParamsWritable.write
+KerasEstimator.save = ParamsWritable.save
+KerasEstimator.read = classmethod(ParamsReadable.read.__func__)
+KerasEstimator.load = classmethod(ParamsReadable.load.__func__)

@@ -1,0 +1,51 @@
+"""Keras optimizer serialization (reference
+``horovod/spark/keras/optimizer.py``): config + slot weights travel
+as a base64 pickle; string names pass through ``optimizers.get``."""
+
+import pickle
+
+from ...runner.common.util import codec
+
+
+def is_string(obj):
+    return isinstance(obj, str)
+
+
+def _opt_to_payload(opt):
+    import tensorflow as tf
+    if is_string(opt):
+        opt = tf.keras.optimizers.get(opt)
+    payload = {
+        "class_name": opt.__class__.__name__,
+        "config": opt.get_config(),
+    }
+    try:
+        payload["weights"] = [w.numpy() if hasattr(w, "numpy") else w
+                              for w in opt.variables]
+    except Exception:  # noqa: BLE001 — un-built optimizer: no slots yet
+        payload["weights"] = None
+    return payload
+
+
+def _payload_to_opt(payload):
+    import tensorflow as tf
+    cls = getattr(tf.keras.optimizers, payload["class_name"])
+    opt = cls.from_config(payload["config"])
+    return opt
+
+
+def serialize_tf_keras_optimizer(x):
+    """Reference optimizer.py:42."""
+    return codec.dumps_base64(_opt_to_payload(x))
+
+
+def deserialize_tf_keras_optimizer(x):
+    """Reference optimizer.py:53."""
+    return _payload_to_opt(codec.loads_base64(x))
+
+
+# keras 2.x "bare keras" (standalone keras package) used a separate
+# save path in the reference; keras 3 is the single keras, so both
+# spellings serialize identically here
+serialize_bare_keras_optimizer = serialize_tf_keras_optimizer
+deserialize_bare_keras_optimizer = deserialize_tf_keras_optimizer

@@ -1,0 +1,15 @@
+"""Per-rank remote trainer factory (reference
+``horovod/spark/keras/remote.py``); see torch/remote.py for the
+mapping onto the estimator-owned loop."""
+
+from ..common.constants import (  # noqa: F401
+    BYTES_PER_GIB, TOTAL_BUFFER_MEMORY_CAP_GIB,
+)
+
+
+def RemoteTrainer(estimator, metadata=None, keras_utils=None,
+                  run_id=None, dataset_idx=None):
+    def train(train_path, val_path=None):
+        return estimator.fit_on_parquet(train_path, val_path)
+
+    return train

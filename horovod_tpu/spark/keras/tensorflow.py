@@ -1,0 +1,21 @@
+"""tf.keras optimizer file persistence (reference
+``horovod/spark/keras/tensorflow.py``): write/read optimizer config +
+slot weights to an open binary file.  The reference packs h5py groups;
+the same contract here is a single pickle payload — the file is
+consumed only by the matching loader."""
+
+import pickle
+
+from .optimizer import _opt_to_payload, _payload_to_opt
+
+
+def save_tf_keras_optimizer(optimizer, f):
+    """Reference tensorflow.py:33 — ``f`` is an open binary file (the
+    reference passes an h5py file object)."""
+    pickle.dump(_opt_to_payload(optimizer), f,
+                protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def load_tf_keras_optimizer(f, custom_objects=None):
+    """Reference tensorflow.py:82."""
+    return _payload_to_opt(pickle.load(f))

@@ -1,0 +1,39 @@
+"""Keras estimator utilities (reference
+``horovod/spark/keras/util.py`` TFKerasUtil): the model/optimizer
+serialization entry points the estimator layer shares.  The heavy
+DataFrame-to-tf.data plumbing of the reference lives in the streaming
+Parquet reader here (spark/common/reader.py)."""
+
+from ...runner.common.util import codec
+from .estimator import _deserialize_keras, _serialize_keras
+
+TF_KERAS = "tf_keras"
+
+
+class TFKerasUtil:
+    """Reference keras/util.py:34 — static helpers bound to tf.keras."""
+
+    type = TF_KERAS
+
+    @staticmethod
+    def keras():
+        import tensorflow as tf
+        return tf.keras
+
+    @staticmethod
+    def serialize_model(model):
+        return codec.dumps_base64(_serialize_keras(model))
+
+    @staticmethod
+    def deserialize_model(model_bytes, load_model_fn=None):
+        return _deserialize_keras(codec.loads_base64(model_bytes))
+
+    @staticmethod
+    def serialize_optimizer(optimizer):
+        from .optimizer import serialize_tf_keras_optimizer
+        return serialize_tf_keras_optimizer(optimizer)
+
+    @staticmethod
+    def deserialize_optimizer(serialized_opt):
+        from .optimizer import deserialize_tf_keras_optimizer
+        return deserialize_tf_keras_optimizer(serialized_opt)

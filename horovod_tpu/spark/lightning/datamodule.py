@@ -1,0 +1,5 @@
+"""Lightning data module (reference
+``horovod/spark/lightning/datamodule.py``)."""
+
+from ..common.constants import PETASTORM_HDFS_DRIVER  # noqa: F401
+from ..torch.datamodule import PetastormDataModule  # noqa: F401

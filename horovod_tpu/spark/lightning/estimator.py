@@ -369,3 +369,44 @@ def _deserialize(blob: bytes):
     from ..torch.estimator import _deserialize_model
 
     return _deserialize_model(blob)
+
+
+# -- reference-shaped surface (spark/lightning/estimator.py) -----------------
+
+#: Minimum pytorch_lightning the reference supported; recorded for
+#: call sites that check it.  The estimator here drives the hook
+#: surface itself (upstream removed its horovod strategy), so the
+#: version only matters when a real pl.LightningModule is passed.
+MIN_PL_VERSION = "1.3.8"
+
+#: The reference names its lightning estimator TorchEstimator (the
+#: lightning package superseded spark/torch there).
+TorchEstimator = LightningEstimator
+TorchModel = LightningModel
+
+from ..common.serialization import (  # noqa: E402
+    HorovodParamsReader, HorovodParamsWriter, ParamsReadable,
+    ParamsWritable,
+)
+
+
+class TorchEstimatorParamsWriter(HorovodParamsWriter):
+    pass
+
+
+class TorchEstimatorParamsReader(HorovodParamsReader):
+    pass
+
+
+class TorchEstimatorParamsWritable(ParamsWritable):
+    pass
+
+
+class TorchEstimatorParamsReadable(ParamsReadable):
+    pass
+
+
+LightningEstimator.write = ParamsWritable.write
+LightningEstimator.save = ParamsWritable.save
+LightningEstimator.read = classmethod(ParamsReadable.read.__func__)
+LightningEstimator.load = classmethod(ParamsReadable.load.__func__)

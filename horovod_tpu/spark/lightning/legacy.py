@@ -1,0 +1,91 @@
+"""Legacy TorchEstimator -> LightningModule adapter (reference
+``horovod/spark/lightning/legacy.py`` to_lightning_module): wraps a
+plain torch model + optimizer + losses into a module exposing the
+Lightning hook surface our LightningEstimator drives
+(training_step/validation_step/configure_optimizers).  Uses
+``pytorch_lightning.LightningModule`` as the base when the package is
+installed; otherwise a duck-typed base with the same hooks — the
+estimator only calls hooks, never pl.Trainer."""
+
+import torch
+
+from ..common.util import to_list
+
+try:
+    from pytorch_lightning import LightningModule as _Base
+except ImportError:
+    class _Base(torch.nn.Module):
+        """Hook-surface stand-in for pl.LightningModule."""
+
+        def log(self, name, value, *args, **kwargs):
+            getattr(self, "_logged", {}).setdefault(
+                name, []).append(value)
+
+
+def to_lightning_module(model, optimizer, loss_fns, loss_weights,
+                        feature_cols, label_cols, sample_weights_col,
+                        validation):
+    """Reference legacy.py:23."""
+    optimizer_cls = optimizer.__class__
+    optimizer_state = optimizer.state_dict()
+    loss_weights = loss_weights or \
+        [1.0 / len(label_cols)] * len(label_cols)
+    loss_fns = to_list(loss_fns, len(label_cols))
+
+    class _EstimatorLightningModule(_Base):
+        def __init__(self):
+            super().__init__()
+            self._model = model
+
+        def forward(self, *args, **kwargs):
+            return self._model(*args, **kwargs)
+
+        def configure_optimizers(self):
+            # the optimizer must be rebuilt against THIS module's
+            # parameters — a deserialized optimizer holds dead
+            # parameter identities (reference legacy.py:32-40)
+            opt = optimizer_cls(self.parameters(), lr=1)
+            opt.load_state_dict(optimizer_state)
+            return opt
+
+        def training_step(self, batch, batch_nb):
+            loss = self._step(batch)
+            return {"loss": loss,
+                    "log": {"train_loss": loss}}
+
+        def validation_step(self, batch, batch_nb):
+            return {"val_loss": self._step(batch)}
+
+        def _step(self, batch):
+            inputs = {f: batch[f].float() for f in feature_cols}
+            labels = [batch[label].float() for label in label_cols]
+            weights = batch[sample_weights_col].float() \
+                if sample_weights_col else None
+            outputs = self(**inputs)
+            if not isinstance(outputs, (tuple, list)):
+                outputs = [outputs]
+            labels = [
+                label.reshape(output.shape)
+                if hasattr(output, "shape") and
+                output.shape.numel() == label.shape.numel() else label
+                for label, output in zip(labels, outputs)]
+            return self._loss(outputs, labels, weights)
+
+        def _loss(self, outputs, labels, weights=None):
+            total = None
+            for out, label, fn, w in zip(outputs, labels, loss_fns,
+                                         loss_weights):
+                if weights is not None:
+                    try:
+                        per_sample = fn(out, label, reduction="none")
+                    except TypeError:
+                        # custom loss without a reduction kwarg:
+                        # weight the already-reduced value
+                        per_sample = fn(out, label)
+                    term = (per_sample * weights).mean() * w
+                else:
+                    term = fn(out, label) * w
+                total = term if total is None else total + term
+            return total
+
+    return _EstimatorLightningModule()

@@ -1,0 +1,17 @@
+"""Per-rank remote trainer factory (reference
+``horovod/spark/lightning/remote.py``); see torch/remote.py for the
+mapping onto the estimator-owned loop."""
+
+from ..common.constants import (  # noqa: F401
+    BYTES_PER_GIB, CUSTOM_SPARSE, METRIC_PRINT_FREQUENCY,
+    TOTAL_BUFFER_MEMORY_CAP_GIB,
+)
+
+
+def RemoteTrainer(estimator, metadata=None, run_id=None,
+                  dataset_idx=None, train_rows=None, val_rows=None,
+                  avg_row_size=None, is_legacy=False):
+    def train(train_path, val_path=None):
+        return estimator.fit_on_parquet(train_path, val_path)
+
+    return train

@@ -1,0 +1,12 @@
+"""Lightning serialization helpers (reference
+``horovod/spark/lightning/util.py``) — identical contract to the
+torch module's; LightningModules are torch modules."""
+
+from ..torch.util import (  # noqa: F401
+    deserialize_fn,
+    is_module_available,
+    is_module_available_fn,
+    save_into_bio,
+    save_into_bio_fn,
+    serialize_fn,
+)

@@ -1,0 +1,10 @@
+"""Spark MPI-mode launch (reference ``horovod/spark/mpi_run.py``).
+No MPI on TPU pods — fails loudly with the supported path."""
+
+
+def mpi_run(executable, settings, nics, driver, env, stdout=None,
+            stderr=None):
+    raise RuntimeError(
+        "MPI launch is not supported on the TPU runtime. Use "
+        "horovod_tpu.spark.run / horovod_tpu.spark.gloo_run — the "
+        "store-controller flow provides the same contract.")

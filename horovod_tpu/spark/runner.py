@@ -196,3 +196,17 @@ def run(fn, args=(), kwargs=None, num_proc=None, start_timeout=120,
         server.stop()
 
 
+
+
+# reference spark/runner.py timing constants
+MINIMUM_COMMAND_LIFETIME_S = 3
+WAIT_FOR_COMMAND_START_DELAY_SECONDS = 0.1
+WAIT_FOR_SHUTDOWN_DELAY_SECONDS = 0.1
+
+
+def run_elastic(fn, args=(), kwargs=None, num_proc=None, **kwd):
+    """Reference spark/runner.py run_elastic — the elastic flow lives
+    in the package root (KV-store rendezvous over executors)."""
+    from . import run_elastic as _impl
+    return _impl(fn, args=args, kwargs=kwargs, num_proc=num_proc,
+                 **kwd)

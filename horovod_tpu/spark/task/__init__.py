@@ -1,0 +1,46 @@
+"""Spark task-side entrypoint (reference
+``horovod/spark/task/__init__.py``): executed inside each Spark
+executor process — registers with the driver, fetches the training
+function, runs it, publishes the result."""
+
+import os
+import time
+
+from ...runner.common.util import codec, secret
+from ...runner.util.threads import in_thread
+from ..driver import driver_service
+from . import task_info, task_service
+
+
+def _parent_process_monitor(initial_ppid):
+    try:
+        while True:
+            if initial_ppid != os.getppid():
+                os._exit(1)
+            time.sleep(1)
+    except Exception:  # noqa: BLE001 — interpreter shutdown
+        pass
+
+
+def task_exec(driver_addresses, settings, rank_env, local_rank_env):
+    """Reference task/__init__.py:37."""
+    in_thread(_parent_process_monitor, (os.getppid(),))
+
+    key = codec.loads_base64(os.environ[secret.HOROVOD_SECRET_KEY])
+    rank = int(os.environ[rank_env])
+    local_rank = int(os.environ[local_rank_env])
+    driver_client = driver_service.SparkDriverClient(
+        driver_addresses, key, verbose=settings.verbose)
+
+    host_hash = os.environ["HOROVOD_HOSTNAME"]
+    task_index = driver_client.set_local_rank_to_rank(
+        host_hash, local_rank, rank)
+
+    task_addresses = driver_client.all_task_addresses(task_index)
+    task_client = task_service.SparkTaskClient(
+        task_index, task_addresses, key, verbose=settings.verbose)
+    task_info.set_resources(task_client.resources())
+
+    fn, args, kwargs = driver_client.code()
+    result = fn(*args, **kwargs)
+    task_client.register_code_result(result)

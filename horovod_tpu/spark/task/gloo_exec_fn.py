@@ -1,0 +1,20 @@
+"""Gloo-mode executor entrypoint (reference
+``horovod/spark/task/gloo_exec_fn.py``)."""
+
+import sys
+
+from ...runner.common.util import codec
+from . import task_exec
+
+
+def main(driver_addresses, settings):
+    task_exec(driver_addresses, settings, "HOROVOD_RANK",
+              "HOROVOD_LOCAL_RANK")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 3:
+        print(f"Usage: {sys.argv[0]} <driver addresses> <settings>")
+        sys.exit(1)
+    main(codec.loads_base64(sys.argv[1]),
+         codec.loads_base64(sys.argv[2]))

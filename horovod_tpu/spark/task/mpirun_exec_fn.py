@@ -1,0 +1,36 @@
+"""MPI-mode executor entrypoint (reference
+``horovod/spark/task/mpirun_exec_fn.py``).  There is no mpirun on TPU
+pods; the env/cwd handling is kept so a job arriving through an MPI
+launcher anyway behaves, and the rank env names follow OpenMPI's."""
+
+import os
+import sys
+
+from ...runner.common.util import codec
+from . import task_exec
+
+
+def main(driver_addresses, settings):
+    if "HOROVOD_SPARK_PYTHONPATH" in os.environ:
+        ppath = os.environ["HOROVOD_SPARK_PYTHONPATH"]
+        for p in reversed(ppath.split(os.pathsep)):
+            sys.path.insert(1, p)
+        if "PYTHONPATH" in os.environ:
+            ppath = os.pathsep.join([ppath,
+                                     os.environ["PYTHONPATH"]])
+        os.environ["PYTHONPATH"] = ppath
+
+    work_dir = os.environ.get("HOROVOD_SPARK_WORK_DIR")
+    if work_dir:
+        os.chdir(work_dir)
+
+    task_exec(driver_addresses, settings, "OMPI_COMM_WORLD_RANK",
+              "OMPI_COMM_WORLD_LOCAL_RANK")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 3:
+        print(f"Usage: {sys.argv[0]} <driver addresses> <settings>")
+        sys.exit(1)
+    main(codec.loads_base64(sys.argv[1]),
+         codec.loads_base64(sys.argv[2]))

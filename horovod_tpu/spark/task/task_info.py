@@ -1,0 +1,20 @@
+"""Per-task resource info (reference
+``horovod/spark/task/task_info.py``)."""
+
+
+class TaskInfo:
+    def __init__(self):
+        self.resources = {}
+
+
+_info = TaskInfo()
+
+
+def get_available_devices():
+    if "gpu" not in _info.resources:
+        return []
+    return _info.resources["gpu"].addresses
+
+
+def set_resources(resources):
+    _info.resources = resources

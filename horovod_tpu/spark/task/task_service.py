@@ -1,0 +1,104 @@
+"""Spark executor task service (reference
+``horovod/spark/task/task_service.py``): BasicTaskService plus the
+Spark verbs — executor resource queries and task-to-task address
+probing — with the executor's environment (and injected secret)
+visible to the launched command."""
+
+import os
+import time
+
+from ...runner.common.service import task_service
+from ...runner.common.util import codec, secret
+from ...runner.common.util.timeout import Timeout
+
+
+class ResourcesRequest:
+    pass
+
+
+class ResourcesResponse:
+    def __init__(self, resources):
+        self.resources = resources
+
+
+class GetTaskToTaskAddressesRequest:
+    def __init__(self, task_index, all_task_addresses):
+        self.task_index = task_index
+        self.all_task_addresses = all_task_addresses
+
+
+class GetTaskToTaskAddressesResponse:
+    def __init__(self, task_addresses_for_task):
+        self.task_addresses_for_task = task_addresses_for_task
+
+
+class SparkTaskService(task_service.BasicTaskService):
+    NAME_FORMAT = "task service #%d"
+
+    def __init__(self, index, key, nics=None,
+                 minimum_command_lifetime_s=None, verbose=0):
+        env = os.environ.copy()
+        env[secret.HOROVOD_SECRET_KEY] = codec.dumps_base64(key)
+        env["HOROVOD_SPARK_WORK_DIR"] = os.getcwd()
+        super().__init__(SparkTaskService.NAME_FORMAT % index, index,
+                         key, nics, env, verbose)
+        self._key = key
+        self._minimum_command_lifetime_s = minimum_command_lifetime_s
+        self._minimum_command_lifetime = None
+
+    def _run_command(self, command, env, event, stdout=None,
+                     stderr=None, prefix_output_with_timestamp=False):
+        super()._run_command(command, env, event, stdout, stderr,
+                             prefix_output_with_timestamp)
+        if self._minimum_command_lifetime_s is not None:
+            self._minimum_command_lifetime = Timeout(
+                self._minimum_command_lifetime_s,
+                message="Just measuring runtime")
+
+    def _handle(self, req, client_address):
+        if isinstance(req, ResourcesRequest):
+            return ResourcesResponse(self._get_resources())
+
+        if isinstance(req, GetTaskToTaskAddressesRequest):
+            next_task_client = SparkTaskClient(
+                req.task_index, req.all_task_addresses, self._key,
+                self._verbose, match_intf=True)
+            return GetTaskToTaskAddressesResponse(
+                next_task_client.addresses())
+
+        return super()._handle(req, client_address)
+
+    def _get_resources(self):
+        try:
+            import pyspark
+            task_context = pyspark.TaskContext.get()
+            if task_context is not None and \
+                    hasattr(task_context, "resources"):
+                return task_context.resources()
+        except ImportError:
+            pass
+        return {}
+
+    def wait_for_command_termination(self):
+        try:
+            return super().wait_for_command_termination()
+        finally:
+            # give the rsh client time to reconnect for the result
+            if self._minimum_command_lifetime is not None:
+                time.sleep(self._minimum_command_lifetime.remaining())
+
+
+class SparkTaskClient(task_service.BasicTaskClient):
+    def __init__(self, index, task_addresses, key, verbose=0,
+                 match_intf=False):
+        super().__init__(SparkTaskService.NAME_FORMAT % index,
+                         task_addresses, key, verbose,
+                         match_intf=match_intf)
+
+    def resources(self):
+        return self._send(ResourcesRequest()).resources
+
+    def get_task_addresses_for_task(self, task_index,
+                                    all_task_addresses):
+        return self._send(GetTaskToTaskAddressesRequest(
+            task_index, all_task_addresses)).task_addresses_for_task

@@ -1,0 +1,38 @@
+"""Torch data modules (reference
+``horovod/spark/torch/datamodule.py``)."""
+
+from ..common.datamodule import ParquetDataModule
+
+
+class MapIterable:
+    """Apply ``fn`` lazily over an iterable (reference
+    torch/datamodule.py MapIterable)."""
+
+    def __init__(self, fn, iterable):
+        self._fn = fn
+        self._iterable = iterable
+
+    def __iter__(self):
+        return (self._fn(item) for item in self._iterable)
+
+
+class PetastormDataModule(ParquetDataModule):
+    short_name = "petastorm"
+
+    def train_data(self):
+        from ..data_loaders.pytorch_data_loaders import _to_torch
+        return MapIterable(_to_torch, super().train_data())
+
+    def val_data(self):
+        from ..data_loaders.pytorch_data_loaders import _to_torch
+        return MapIterable(_to_torch, super().val_data())
+
+
+class NVTabularDataModule(ParquetDataModule):
+    short_name = "nvtabular"
+
+    def __init__(self, *args, **kwargs):
+        raise ImportError(
+            "NVTabularDataModule requires nvtabular (a CUDA/GPU "
+            "stack), which does not exist on TPU hosts; use "
+            "PetastormDataModule.")

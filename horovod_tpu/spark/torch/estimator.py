@@ -355,3 +355,36 @@ def _make_optimizer(spec, model):
         return spec(model.parameters())
     raise ValueError("optimizer must be a torch Optimizer or a factory "
                      "params -> Optimizer")
+
+
+# -- MLlib-style persistence surface (reference spark/torch/estimator.py
+#    TorchEstimatorParams{Writable,Readable,Writer,Reader}) -----------------
+
+from ..common.serialization import (  # noqa: E402
+    HorovodParamsReader, HorovodParamsWriter, ParamsReadable,
+    ParamsWritable,
+)
+
+
+class TorchEstimatorParamsWriter(HorovodParamsWriter):
+    pass
+
+
+class TorchEstimatorParamsReader(HorovodParamsReader):
+    pass
+
+
+class TorchEstimatorParamsWritable(ParamsWritable):
+    pass
+
+
+class TorchEstimatorParamsReadable(ParamsReadable):
+    pass
+
+
+# graft the persistence mixin surface onto the estimator: save(path)/
+# write() and read()/load(path) per the reference contract
+TorchEstimator.write = ParamsWritable.write
+TorchEstimator.save = ParamsWritable.save
+TorchEstimator.read = classmethod(ParamsReadable.read.__func__)
+TorchEstimator.load = classmethod(ParamsReadable.load.__func__)

@@ -1,0 +1,27 @@
+"""Per-rank remote trainer factory (reference
+``horovod/spark/torch/remote.py`` RemoteTrainer).
+
+The reference builds a closure over serialized model/optimizer that
+each executor runs; this build's estimator owns that loop
+(``TorchEstimator.fit_on_parquet`` → per-rank train_fn), so
+``RemoteTrainer`` returns the function a rank executes for the given
+estimator + staged dataset — same role, driven by the estimator's
+own machinery."""
+
+from ..common.constants import (  # noqa: F401
+    BYTES_PER_GIB, CUSTOM_SPARSE, METRIC_PRINT_FREQUENCY,
+    PETASTORM_HDFS_DRIVER, TOTAL_BUFFER_MEMORY_CAP_GIB,
+)
+
+
+def RemoteTrainer(estimator, metadata=None, loss_fns=None,
+                  loss_constructors=None, run_id=None,
+                  train_rows=None, val_rows=None, avg_row_size=None,
+                  is_legacy=False):
+    """Returns ``train(train_path, val_path)`` bound to the
+    estimator."""
+
+    def train(train_path, val_path=None):
+        return estimator.fit_on_parquet(train_path, val_path)
+
+    return train

@@ -1,0 +1,56 @@
+"""Torch serialization helpers (reference
+``horovod/spark/torch/util.py``): base64-pickle a model for the env/
+KV handoff, with TorchScript modules routed through
+``torch.jit.save``/``load``."""
+
+import io
+
+from ...runner.common.util import codec
+
+
+def is_module_available_fn():
+    def _is_module_available(module_name):
+        import importlib.util
+        return importlib.util.find_spec(module_name) is not None
+
+    return _is_module_available
+
+
+def is_module_available(module_name):
+    return is_module_available_fn()(module_name)
+
+
+def save_into_bio_fn():
+    def _save_into_bio(obj, save_obj_fn):
+        bio = io.BytesIO()
+        save_obj_fn(obj, bio)
+        bio.seek(0)
+        return bio
+
+    return _save_into_bio
+
+
+def save_into_bio(obj, save_obj_fn):
+    return save_into_bio_fn()(obj, save_obj_fn)
+
+
+def serialize_fn():
+    def _serialize(model):
+        import torch
+        if isinstance(model, torch.jit.ScriptModule):
+            model = save_into_bio(model, torch.jit.save)
+        return codec.dumps_base64(model)
+
+    return _serialize
+
+
+def deserialize_fn():
+    def _deserialize(model_bytes_base64):
+        import torch
+        obj = codec.loads_base64(model_bytes_base64)
+        if not isinstance(obj, torch.nn.Module):
+            obj.seek(0)
+            obj = torch.jit.load(io.BytesIO(obj.read()))
+        return obj
+
+    return _deserialize

@@ -12,7 +12,11 @@ setup(
     version="0.1.0",
     description="TPU-native distributed training framework with the "
                 "capability surface of Horovod",
-    packages=find_packages(include=["horovod_tpu", "horovod_tpu.*"]),
+    # `horovod` is the drop-in alias package: reference scripts'
+    # imports (horovod.torch, horovod.runner...) resolve to the same
+    # module objects via its meta-path finder
+    packages=find_packages(
+        include=["horovod_tpu", "horovod_tpu.*", "horovod"]),
     python_requires=">=3.10",
     install_requires=["numpy", "jax", "ml_dtypes", "cloudpickle"],
     extras_require={

@@ -1,0 +1,138 @@
+"""Generated reference-API parity sweep.
+
+Walks the reference tree (`/root/reference/horovod`), AST-extracts
+every public module and top-level symbol, and asserts the same import
+path + name resolves in ``horovod_tpu``.  This is the executable form
+of the migration contract: any public reference import a user's script
+does must land somewhere real here.
+
+The test is generated from the reference at run time, so it fails the
+moment a surface regresses — no frozen symbol list to go stale.
+"""
+
+import ast
+import os
+
+import pytest
+
+REF = os.environ.get("HOROVOD_TPU_REFERENCE", "/root/reference/horovod")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF),
+    reason="reference tree not available")
+
+
+def _public_names(path):
+    with open(path) as f:
+        tree = ast.parse(f.read())
+    names = set()
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            if not node.name.startswith("_"):
+                names.add(node.name)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    if t.id == "__all__":
+                        try:
+                            names |= set(ast.literal_eval(node.value))
+                        except (ValueError, SyntaxError):
+                            pass
+                    elif not t.id.startswith("_"):
+                        names.add(t.id)
+    return names
+
+
+def _reference_surface():
+    modules = {}
+    for root, dirs, files in os.walk(REF):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            rel = os.path.relpath(path, REF)
+            mod = rel[:-3].replace(os.sep, ".")
+            if mod.endswith(".__init__"):
+                mod = mod[: -len(".__init__")]
+            names = _public_names(path)
+            if names:
+                modules[mod] = names
+    return modules
+
+
+def _has(obj, name):
+    try:
+        getattr(obj, name)
+        return True
+    except AttributeError:
+        return False
+    except Exception:
+        # gated name: exists but needs an absent optional package
+        # (e.g. mxnet frontend objects) — the import path is intact
+        return True
+
+
+def test_every_reference_module_and_symbol_resolves():
+    import importlib
+
+    modules = _reference_surface()
+    assert len(modules) > 100   # sanity: the walk found the tree
+
+    missing_modules = []
+    missing_symbols = []
+    for mod, names in sorted(modules.items()):
+        target = f"horovod_tpu.{mod}" if mod else "horovod_tpu"
+        try:
+            ours = importlib.import_module(target)
+        except Exception as exc:  # noqa: BLE001 — reported below
+            missing_modules.append(f"{target}: {exc}")
+            continue
+        for name in sorted(names):
+            if not _has(ours, name):
+                missing_symbols.append(f"{target}.{name}")
+
+    assert not missing_modules, \
+        f"reference modules without a counterpart: {missing_modules}"
+    assert not missing_symbols, \
+        f"reference symbols missing: {missing_symbols}"
+
+
+def test_horovod_alias_package():
+    """`import horovod.X as hvd` resolves to the same module objects
+    as horovod_tpu.X — reference scripts run unchanged."""
+    import horovod
+    import horovod.torch
+    import horovod_tpu
+    import horovod_tpu.torch
+
+    assert horovod.torch is horovod_tpu.torch
+    assert horovod.__version__ == horovod_tpu.__version__
+
+    from horovod.runner.common.util.hosts import parse_hosts
+    from horovod_tpu.runner.common.util.hosts import (
+        parse_hosts as real_parse_hosts,
+    )
+    assert parse_hosts is real_parse_hosts
+
+    # a missing submodule still raises ImportError, not something odd
+    with pytest.raises(ImportError):
+        import horovod.does_not_exist  # noqa: F401
+
+
+def test_reference_script_import_block():
+    """The import block of the reference's own examples executes
+    verbatim (examples/pytorch/pytorch_synthetic_benchmark.py etc.)."""
+    import horovod.torch as hvd
+
+    hvd.init()
+    try:
+        assert hvd.size() >= 1
+        assert hvd.local_rank() == 0
+        import torch
+        t = torch.ones(3)
+        out = hvd.allreduce(t, name="alias_smoke")
+        assert float(out.sum()) == 3.0
+    finally:
+        hvd.shutdown()

@@ -407,3 +407,144 @@ def test_keras_impl_layer_paths():
             pass
 
     assert CommitStateCallbackImpl("tf", _S(), 2) is not None
+
+
+def test_estimator_params_persistence_roundtrip(tmp_path):
+    """MLlib-style save/load of estimator params (reference
+    spark/torch/estimator.py TorchEstimatorParams{Writer,Reader})."""
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.spark.torch.estimator import TorchEstimator
+
+    model = torch.nn.Linear(4, 2)
+    est = TorchEstimator(model=model, optimizer="SGD",
+                         loss=torch.nn.functional.mse_loss,
+                         feature_cols=["features"], label_cols=["y"],
+                         batch_size=16, epochs=3)
+    path = str(tmp_path / "est")
+    est.write().save(path)
+
+    loaded = TorchEstimator.load(path)
+    assert loaded.batch_size == 16 and loaded.epochs == 3
+    assert loaded.feature_cols == ["features"]
+    assert isinstance(loaded.model, torch.nn.Module)
+    x = torch.randn(3, 4)
+    assert torch.allclose(loaded.model(x), model(x))
+
+
+def test_spark_driver_task_services_code_flow():
+    """Spark driver/task TCP services: fn shipping, local-rank->rank
+    mapping, resources, code result (reference spark/task/__init__.py
+    task_exec flow, driven in-process)."""
+    from horovod_tpu.runner.common.util import secret
+    from horovod_tpu.runner.common.util.timeout import Timeout
+    from horovod_tpu.spark.driver.driver_service import (
+        SparkDriverClient, SparkDriverService,
+    )
+    from horovod_tpu.spark.task.task_service import (
+        SparkTaskClient, SparkTaskService,
+    )
+
+    key = secret.make_secret_key()
+    fn = lambda a, b: a * b  # noqa: E731
+    driver = SparkDriverService(2, 2, fn, (6, 7), {}, key)
+    tasks = [SparkTaskService(i, key) for i in range(2)]
+    try:
+        client = SparkDriverClient(driver.addresses(), key)
+        for i, t in enumerate(tasks):
+            client.register_task(i, t.addresses(), f"hh-{i}")
+        driver.wait_for_initial_registration(
+            Timeout(10, "{activity}"))
+        indices = client.task_host_hash_indices("hh-1")
+        assert indices == [1]
+        index = client.set_local_rank_to_rank("hh-1", 0, rank=0)
+        assert index == 1
+        assert client.task_index_by_rank(0) == 1
+        got_fn, args, kwargs = client.code()
+        assert got_fn(*args, **kwargs) == 42
+
+        tc = SparkTaskClient(0, tasks[0].addresses(), key)
+        assert tc.resources() == {}
+        tc.register_code_result(99)
+        assert tasks[0].fn_result() == 99
+    finally:
+        for t in tasks:
+            t.shutdown()
+        driver.shutdown()
+
+
+def test_pytorch_data_loaders(tmp_path):
+    """Loader family over a plain iterable reader (reference
+    spark/data_loaders/pytorch_data_loaders.py)."""
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.spark.data_loaders.pytorch_data_loaders import (
+        PytorchAsyncDataLoader, PytorchDataLoader,
+        PytorchInfiniteDataLoader, PytorchInmemDataLoader,
+    )
+
+    batches = [{"x": np.ones((2, 3)) * i} for i in range(4)]
+    loader = PytorchDataLoader(batches, batch_size=2)
+    out = list(loader)
+    assert len(out) == 4 and torch.is_tensor(out[0]["x"])
+
+    inf = PytorchInfiniteDataLoader(batches, batch_size=2,
+                                    limit_step_per_epoch=6)
+    assert len(list(inf)) == 6       # cycles past the 4 batches
+
+    inmem = PytorchInmemDataLoader(batches, batch_size=3,
+                                   shuffle=False)
+    rows = list(inmem)
+    assert sum(b["x"].shape[0] for b in rows) == 8  # 4 batches x 2
+
+    async_loader = PytorchAsyncDataLoader(reader=batches,
+                                          batch_size=2)
+    assert len(list(async_loader)) == 4
+    async_loader.close_async_loader()
+
+
+def test_keras_optimizer_serialization_roundtrip():
+    tf = pytest.importorskip("tensorflow")
+    from horovod_tpu.spark.keras.optimizer import (
+        deserialize_tf_keras_optimizer, serialize_tf_keras_optimizer,
+    )
+    from horovod_tpu.spark.keras.tensorflow import (
+        load_tf_keras_optimizer, save_tf_keras_optimizer,
+    )
+
+    opt = tf.keras.optimizers.Adam(learning_rate=0.123)
+    restored = deserialize_tf_keras_optimizer(
+        serialize_tf_keras_optimizer(opt))
+    assert abs(float(restored.learning_rate) - 0.123) < 1e-6
+
+    import io
+    bio = io.BytesIO()
+    save_tf_keras_optimizer(opt, bio)
+    bio.seek(0)
+    assert abs(float(load_tf_keras_optimizer(bio).learning_rate)
+               - 0.123) < 1e-6
+
+
+def test_lightning_legacy_to_lightning_module():
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.spark.lightning.legacy import to_lightning_module
+
+    class Net(torch.nn.Module):
+        # the legacy adapter feeds features as named kwargs
+        # (reference legacy.py _step: self(**inputs))
+        def __init__(self):
+            super().__init__()
+            self.lin = torch.nn.Linear(2, 1)
+
+        def forward(self, f):
+            return self.lin(f)
+
+    model = Net()
+    opt = torch.optim.SGD(model.parameters(), lr=0.05)
+    module = to_lightning_module(
+        model, opt, loss_fns=torch.nn.functional.mse_loss,
+        loss_weights=None, feature_cols=["f"], label_cols=["y"],
+        sample_weights_col=None, validation=None)
+    batch = {"f": torch.randn(4, 2), "y": torch.randn(4, 1)}
+    out = module.training_step(batch, 0)
+    assert out["loss"].requires_grad
+    new_opt = module.configure_optimizers()
+    assert new_opt.param_groups[0]["lr"] == 0.05

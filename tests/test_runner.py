@@ -1231,3 +1231,34 @@ def test_disable_cache_and_autotune_flags():
                        "entries": [_meta("a", nprocs=1)]})
     out = c.handle("poll", {"cursor": 0, "proc": 0, "wait": 0})
     assert not out["responses"][0].get("cache_ids"), out
+
+
+@pytest.mark.integration
+def test_gloo_run_elastic_programmatic(tmp_path):
+    """ElasticSettings + a HostDiscovery object through
+    gloo_run_elastic (reference gloo_run.py:303 launch_gloo_elastic):
+    the programmatic elastic entry point launches a real 2-process
+    round."""
+    from horovod_tpu.runner.elastic.discovery import FixedHosts
+    from horovod_tpu.runner.elastic.settings import ElasticSettings
+    from horovod_tpu.runner.gloo_run import gloo_run_elastic
+
+    marker = str(tmp_path / "ok")
+    settings = ElasticSettings(
+        discovery=FixedHosts({"localhost": 2}),
+        min_num_proc=2, max_num_proc=2, elastic_timeout=120,
+        reset_limit=2, num_proc=2, verbose=0, output_filename=None)
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", JAX_NUM_CPU_DEVICES="2",
+               HOROVOD_TPU_PLATFORM="cpu")
+    worker = (
+        "import sys; sys.path.insert(0, r'%s'); "
+        "import horovod_tpu as hvd; hvd.init(); "
+        "open(r'%s' + str(hvd.rank()), 'w').write('1'); "
+        "hvd.shutdown()" % (os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), marker))
+    code = gloo_run_elastic(settings, env,
+                            [sys.executable, "-c", worker])
+    assert code == 0
+    assert os.path.exists(marker + "0")
+    assert os.path.exists(marker + "1")

@@ -38,8 +38,9 @@ class _AliasFinder(importlib.abc.MetaPathFinder, importlib.abc.Loader):
             return None
         real_name = "horovod_tpu." + fullname[len(self._PREFIX):]
         try:
-            self._module = importlib.import_module(real_name)
-        except ImportError:
+            if importlib.util.find_spec(real_name) is None:
+                return None
+        except (ImportError, ValueError):
             return None
         return importlib.machinery.ModuleSpec(fullname, self)
 

@@ -19,7 +19,12 @@ class ProcessSet:
     """A set of global ranks collectives may be restricted to."""
 
     def __init__(self, ranks=None):
-        self.ranks = sorted(set(int(r) for r in ranks)) if ranks else None
+        # an EMPTY rank list is a valid (inert) set — the reference's
+        # tests register odd/even splits that are empty at small sizes
+        # (test_torch.py process-set grids at np=1); None means "the
+        # global set", chosen at registration
+        self.ranks = sorted(set(int(r) for r in ranks)) \
+            if ranks is not None else None
         self.process_set_id = None
 
     def _require_registered(self):

@@ -54,10 +54,9 @@ def _resolve_op(op, average, dtype):
     if op is None:
         op = Average if average is None or average else Sum
     op = ReduceOp(op)
-    if op == Average and not (np.issubdtype(np.dtype(dtype), np.floating)
-                              or str(dtype) == "bfloat16"):
-        raise ValueError(
-            "Averaging is not supported for integer tensors; use op=Sum")
+    # integer average is supported with the reference's semantics:
+    # sum, then divide in FP64 with a truncating cast back
+    # (xla_ops post_step; reference test_torch.py:201-230)
     return op
 
 
@@ -78,14 +77,11 @@ def _submit(request, payloads, names):
 
 
 def _check_scale(dtype, prescale_factor, postscale_factor):
-    """Scale factors only apply on the float path (xla_ops applies
-    them inside the compiled program when _is_float); reject integer
-    tensors instead of silently ignoring the factors."""
-    if not (np.issubdtype(np.dtype(dtype), np.floating)
-            or str(dtype) == "bfloat16") \
-            and (prescale_factor != 1.0 or postscale_factor != 1.0):
-        raise ValueError("prescale/postscale require floating-point "
-                         "tensors")
+    """Integer tensors scale with the reference's semantics — factor
+    applied in FP64, truncating cast back (xla_ops _build_allreduce
+    post_step; reference test_torch.py:434-487) — so nothing to
+    reject; kept as the single place to add dtype/scale validation."""
+    del dtype, prescale_factor, postscale_factor
 
 
 # ----------------------------------------------------------------------------
@@ -368,7 +364,21 @@ def alltoall_async(tensor, splits=None, name=None,
                 f"process-set size {ps_size}; pass explicit splits")
         splits = [arr.shape[0] // ps_size] * ps_size
     splits_arr, _ = util.to_numpy(splits)
+    # eager client-side validation, ValueError like the reference
+    # (alltoall_op checks splits locally before enqueueing —
+    # test_torch.py:2102-2138 asserts the error type)
+    if not np.issubdtype(splits_arr.dtype, np.integer):
+        raise ValueError(
+            f"alltoall splits must contain 32-bit integers, got "
+            f"{splits_arr.dtype}")
     splits_t = tuple(int(s) for s in np.ravel(splits_arr))
+    if any(s < 0 for s in splits_t):
+        raise ValueError(f"alltoall splits must be non-negative: "
+                         f"{splits_t}")
+    if sum(splits_t) != arr.shape[0]:
+        raise ValueError(
+            f"alltoall splits sum to {sum(splits_t)} but the "
+            f"tensor's first dimension is {arr.shape[0]}")
     ctx = basics.context()
     name = name or ctx.next_name("alltoall")
     req = Request(

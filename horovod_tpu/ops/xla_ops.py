@@ -55,6 +55,25 @@ from ..core.message import ReduceOp
 from . import adasum as adasum_ops
 
 
+def _scale_np_dtype(dtype):
+    """Host dtype for scale factors, following the reference's math:
+    the tensor's own precision for f64 tensors (its CPU path scales in
+    the tensor dtype and the tests compare exactly at small sizes),
+    FP64 for integer tensors (scale-then-truncate), f32 for everything
+    else.  64-bit math needs x64; otherwise f32 is the best
+    available."""
+    x64 = jax.config.jax_enable_x64
+    if str(dtype) != "bfloat16" and np.dtype(dtype) == np.float64:
+        return np.float64 if x64 else np.float32
+    if _is_float(dtype):
+        return np.float32
+    return np.float64 if x64 else np.float32
+
+
+def _scale_jnp_dtype(dtype):
+    return jnp.dtype(_scale_np_dtype(dtype))
+
+
 def _is_float(dtype) -> bool:
     return jnp.issubdtype(np.dtype(dtype), jnp.floating) or str(dtype) == "bfloat16"
 
@@ -131,27 +150,39 @@ class MeshExecutor:
         stacked = np.stack([np.asarray(r) for r in rows])
         return jax.device_put(stacked, self.devices[0])
 
-    def _rows_out(self, arr):
+    def _rows_out(self, arr, dtype=None):
         """Per-rank (sharded) outputs → list of host ndarrays for the
         local ranks, ordered like ``local_positions``.  Results are
         writable copies — users mutate collective outputs in place
-        (w -= lr * grad), so read-only device views must not escape."""
+        (w -= lr * grad), so read-only device views must not escape.
+        ``dtype``: the caller's dtype — without x64 jax narrows 64-bit
+        inputs (its platform convention, f32 precision), and the
+        result must still round-trip in the submitted dtype."""
         if self.shard_mode:
             by_pos = {}
             for shard in arr.addressable_shards:
                 r = shard.index[0].start if isinstance(shard.index[0], slice) \
                     else shard.index[0]
                 by_pos[r] = np.array(shard.data)[0]
-            return [by_pos[pos] for pos in self.local_positions]
-        host = np.asarray(arr)
-        return [host[pos].copy() for pos in self.local_positions]
+            rows = [by_pos[pos] for pos in self.local_positions]
+        else:
+            host = np.asarray(arr)
+            rows = [host[pos].copy() for pos in self.local_positions]
+        if dtype is not None and rows and rows[0].dtype != dtype:
+            rows = [r.astype(dtype) for r in rows]
+        return rows
 
-    def _replicated_out(self, arr):
+    def _replicated_out(self, arr, dtype=None):
         """Fetch a replicated result once, as a writable host copy;
-        callers hand further copies to the remaining local ranks."""
+        callers hand further copies to the remaining local ranks.
+        ``dtype`` restores the caller's dtype (see _rows_out)."""
         if self.shard_mode:
-            return np.array(arr.addressable_shards[0].data)
-        return np.array(arr)
+            host = np.array(arr.addressable_shards[0].data)
+        else:
+            host = np.array(arr)
+        if dtype is not None and host.dtype != dtype:
+            host = host.astype(dtype)
+        return host
 
     def _fanout(self, host):
         """Replicate one host result to every local rank (first is the
@@ -169,26 +200,44 @@ class MeshExecutor:
         if n == 0:
             return [np.asarray(r) for r in rows]
         R = self.num_ranks
-        scaled = _is_float(dtype)
-        if op == ReduceOp.AVERAGE:
+        is_float = _is_float(dtype)
+        if is_float and op == ReduceOp.AVERAGE:
             postscale = postscale / R
             op = ReduceOp.SUM
+        # integer tensors support average and pre/post scaling with the
+        # reference's semantics (scale in FP64, truncate back —
+        # test_torch.py:434-487); average divides rather than
+        # multiplying by 1/R so exact multiples stay exact
+        scaled = is_float or op == ReduceOp.AVERAGE or \
+            prescale != 1.0 or postscale != 1.0
         key = ("allreduce", n, str(dtype), int(op), scaled, self.shard_mode)
         fn = self._cached(key, lambda: self._build_allreduce(n, dtype, op, scaled))
         x = self._stage_rows(rows)
         if scaled:
-            out = fn(x, np.float32(prescale), np.float32(postscale))
+            sdt = _scale_np_dtype(dtype)
+            out = fn(x, sdt(prescale), sdt(postscale))
         else:
             out = fn(x)
-        return self._fanout(self._replicated_out(out))
+        return self._fanout(self._replicated_out(out, dtype))
 
     def _build_allreduce(self, n, dtype, op, scaled):
         R = self.num_ranks
+        sf = _scale_jnp_dtype(dtype)
+        avg_int = op == ReduceOp.AVERAGE       # int-average: divide
+        if avg_int:
+            op = ReduceOp.SUM
+
+        def post_step(y, post):
+            if avg_int:
+                # divide, don't multiply by 1/R: exact multiples must
+                # stay exact under the truncating int cast
+                return ((y.astype(sf) / R) * post).astype(dtype)
+            return (y.astype(sf) * post).astype(dtype)
 
         def reduce_block(xb, pre, post):
             # xb: (1, n) in shard mode (per-device row)
             if scaled:
-                xb = (xb.astype(jnp.float32) * pre).astype(dtype)
+                xb = (xb.astype(sf) * pre).astype(dtype)
             if op == ReduceOp.SUM:
                 y = lax.psum(xb, "hvd")
             elif op == ReduceOp.MIN:
@@ -204,15 +253,18 @@ class MeshExecutor:
             else:
                 raise ValueError(f"unsupported reduce op {op}")
             if scaled:
-                y = (y.astype(jnp.float32) * post).astype(dtype)
+                y = post_step(y, post).astype(dtype)
             return y[0]
 
         def reduce_stacked(x, pre, post):
             # x: (R, n) on one device
             if scaled:
-                x = (x.astype(jnp.float32) * pre).astype(dtype)
+                x = (x.astype(sf) * pre).astype(dtype)
             if op == ReduceOp.SUM:
-                y = jnp.sum(x, axis=0)
+                # dtype pinned: jnp.sum follows numpy's
+                # promote-small-ints-to-default-int rule, which
+                # would hand int32 callers int64 results
+                y = jnp.sum(x, axis=0, dtype=x.dtype)
             elif op == ReduceOp.MIN:
                 y = jnp.min(x, axis=0)
             elif op == ReduceOp.MAX:
@@ -224,7 +276,7 @@ class MeshExecutor:
             else:
                 raise ValueError(f"unsupported reduce op {op}")
             if scaled:
-                y = (y.astype(jnp.float32) * post).astype(dtype)
+                y = post_step(y, post).astype(dtype)
             return y
 
         if self.shard_mode:
@@ -259,7 +311,7 @@ class MeshExecutor:
             tuple(dim0_sizes), tuple(rest_shape), dtype))
         x = self._stage_rows(rows)
         out = fn(x)
-        host = self._replicated_out(out)
+        host = self._replicated_out(out, dtype)
         result_shape = (sum(dim0_sizes),) + tuple(rest_shape)
         return self._fanout(host.reshape(result_shape))
 
@@ -295,7 +347,7 @@ class MeshExecutor:
         fn = self._cached(key, lambda: self._build_broadcast(root_pos))
         x = self._stage_rows(rows)
         out = fn(x)
-        return self._fanout(self._replicated_out(out))
+        return self._fanout(self._replicated_out(out, dtype))
 
     def _build_broadcast(self, root_pos):
         def bcast_block(xb):
@@ -370,7 +422,7 @@ class MeshExecutor:
                               for r, pos in zip(rows,
                                                 self.local_positions)])
         out = fn(x)  # (R_dst, R*m) sharded by dst; row r = segments recv'd
-        padded_rows = self._rows_out(out)
+        padded_rows = self._rows_out(out, dtype)
         results = []
         for i, pos in enumerate(self.local_positions):
             segs = [
@@ -417,7 +469,7 @@ class MeshExecutor:
             staged.append(self._stage_rows(diag_rows))
         outs = fn(*staged)
         # out d, row r = the segment sent by src (r-d) % R
-        per_local_out = [self._rows_out(o) for o in outs]
+        per_local_out = [self._rows_out(o, dtype) for o in outs]
         results = []
         for i, pos in enumerate(self.local_positions):
             segs = []
@@ -496,20 +548,25 @@ class MeshExecutor:
         if max_chunk == 0 or rest == 0:
             return [np.zeros((chunks[pos],) + tuple(rest_shape), dtype=dtype)
                     for pos in self.local_positions]
-        scaled = _is_float(dtype)
-        if op == ReduceOp.AVERAGE:
+        is_float = _is_float(dtype)
+        if is_float and op == ReduceOp.AVERAGE:
             postscale = postscale / R
             op = ReduceOp.SUM
+        # int average/scaling: reference semantics (FP64 scale +
+        # truncating cast; average divides) — see allreduce
+        scaled = is_float or op == ReduceOp.AVERAGE or \
+            prescale != 1.0 or postscale != 1.0
         key = ("reducescatter", R, max_chunk, rest, str(dtype), int(op),
                scaled, self.shard_mode)
         fn = self._cached(key, lambda: self._build_reducescatter(
             max_chunk, rest, dtype, op, scaled))
         x = self._stage_rows(rows)
         if scaled:
-            out = fn(x, np.float32(prescale), np.float32(postscale))
+            sdt = _scale_np_dtype(dtype)
+            out = fn(x, sdt(prescale), sdt(postscale))
         else:
             out = fn(x)
-        per_local = self._rows_out(out)
+        per_local = self._rows_out(out, dtype)
         return [
             row[: chunks[pos] * rest].reshape(
                 (chunks[pos],) + tuple(rest_shape))
@@ -519,11 +576,20 @@ class MeshExecutor:
     def _build_reducescatter(self, max_chunk, rest, dtype, op, scaled):
         R = self.num_ranks
         m = max_chunk * rest
+        sf = _scale_jnp_dtype(dtype)
+        avg_int = op == ReduceOp.AVERAGE
+        if avg_int:
+            op = ReduceOp.SUM
+
+        def post_step(y, post):
+            if avg_int:
+                return ((y.astype(sf) / R) * post).astype(dtype)
+            return (y.astype(sf) * post).astype(dtype)
 
         def rs_block(xb, pre, post):
             # xb: (1, R*m).  psum_scatter over tiles of m elements.
             if scaled:
-                xb = (xb.astype(jnp.float32) * pre).astype(dtype)
+                xb = (xb.astype(sf) * pre).astype(dtype)
             if op == ReduceOp.SUM:
                 y = lax.psum_scatter(xb, "hvd", scatter_dimension=1,
                                      tiled=True)
@@ -542,16 +608,19 @@ class MeshExecutor:
                 else:
                     raise ValueError(f"unsupported reducescatter op {op}")
             if scaled:
-                y = (y.astype(jnp.float32) * post).astype(dtype)
+                y = post_step(y, post)
             return y
 
         def rs_stacked(x, pre, post):
             # x: (R, R*m) → out (R, m): out[j] = reduce_r x[r, j*m:(j+1)*m]
             if scaled:
-                x = (x.astype(jnp.float32) * pre).astype(dtype)
+                x = (x.astype(sf) * pre).astype(dtype)
             x = x.reshape(R, R, m)
             if op == ReduceOp.SUM:
-                y = jnp.sum(x, axis=0)
+                # dtype pinned: jnp.sum follows numpy's
+                # promote-small-ints-to-default-int rule, which
+                # would hand int32 callers int64 results
+                y = jnp.sum(x, axis=0, dtype=x.dtype)
             elif op == ReduceOp.MIN:
                 y = jnp.min(x, axis=0)
             elif op == ReduceOp.MAX:
@@ -561,7 +630,7 @@ class MeshExecutor:
             else:
                 raise ValueError(f"unsupported reducescatter op {op}")
             if scaled:
-                y = (y.astype(jnp.float32) * post).astype(dtype)
+                y = post_step(y, post)
             return y
 
         if self.shard_mode:

@@ -26,8 +26,8 @@ class TestDiscovery(RayHostDiscovery):
                  use_gpu=False, cpus_per_worker=1, gpus_per_worker=1,
                  verbose=True, _graceful=True, seed=None):
         super().__init__(use_gpu=use_gpu,
-                         cpus_per_worker=cpus_per_worker,
-                         gpus_per_worker=gpus_per_worker)
+                         cpus_per_slot=cpus_per_worker,
+                         gpus_per_slot=gpus_per_worker)
         self._min_hosts = min_hosts
         self._max_hosts = max_hosts
         self._change_frequency_s = change_frequency_s

@@ -100,13 +100,13 @@ class Coordinator:
             RendezvousServer, local_ip,
         )
 
+        key = self.settings.key \
+            if isinstance(self.settings.key, bytes) else None
         self.rendezvous = RendezvousServer(
-            secret=self.settings.key
-            if isinstance(self.settings.key, bytes) else None,
-            world_size=self.world_size)
+            secret=key, world_size=self.world_size)
         self.global_rendezv_port = self.rendezvous.start()
         addr = local_ip()
-        return {
+        env = {
             "HOROVOD_GLOO_RENDEZVOUS_ADDR": addr,
             "HOROVOD_GLOO_RENDEZVOUS_PORT":
                 str(self.global_rendezv_port),
@@ -115,6 +115,12 @@ class Coordinator:
             "HOROVOD_CONTROLLER": "http",
             "HOROVOD_CPU_OPERATIONS": "cpu",
         }
+        if key is not None:
+            # workers sign every KV/coordinator request with this
+            # (common/basics.py reads the hex form; same publication
+            # rule as the elastic driver's worker env)
+            env["HOROVOD_SECRET_KEY"] = key.hex()
+        return env
 
 
 @dataclass

@@ -2,25 +2,9 @@
 run the per-rank exec command in each registered executor through its
 task service."""
 
-from ..runner.common.util import codec, secret
+from ..runner.common.util import codec
 from ..runner.util.threads import in_thread
 from .driver.rsh import rsh
-
-
-def _exec_command_fn(driver, key, settings, env,
-                     stdout=None, stderr=None):
-    def _exec_command(command, slot_info, events):
-        host = slot_info.hostname
-        local_rank = slot_info.local_rank
-        verbose = settings.verbose
-        result = rsh(driver.addresses(), key, host, command, env,
-                     local_rank, verbose, stdout, stderr,
-                     settings.prefix_output_with_timestamp, False,
-                     events)
-        return result, time.time()
-
-    import time
-    return _exec_command
 
 
 def gloo_run(executable, settings, nics, driver, env, stdout=None,
@@ -28,9 +12,8 @@ def gloo_run(executable, settings, nics, driver, env, stdout=None,
     """Reference spark/gloo_run.py gloo_run: launch every rank's exec
     fn through its executor's task service and fail if any rank
     fails."""
-    key = secret.make_secret_key() if not hasattr(driver, "_key") \
-        else driver._wire._key
-    # command each rank executes inside its executor
+    # the job key lives on the driver service's wire framing
+    key = driver._wire._key
     command = (
         f"{executable} -m horovod_tpu.spark.task.gloo_exec_fn "
         f"{codec.dumps_base64(driver.addresses())} "
@@ -41,13 +24,20 @@ def gloo_run(executable, settings, nics, driver, env, stdout=None,
     results = {}
 
     def run_one(host, local_rank, rank):
-        code = rsh(driver.addresses(), key, host,
-                   f"HOROVOD_RANK={rank} HOROVOD_LOCAL_RANK="
-                   f"{local_rank} {command}",
-                   dict(env or {}), local_rank, settings.verbose,
-                   stdout, stderr,
-                   settings.prefix_output_with_timestamp,
-                   background=False)
+        try:
+            code = rsh(
+                driver.addresses(), key, host,
+                # the slot env the reference's create_slot_env_vars
+                # carries: identity + the host hash task_exec reads
+                f"HOROVOD_RANK={rank} HOROVOD_LOCAL_RANK={local_rank} "
+                f"HOROVOD_HOSTNAME={host} {command}",
+                dict(env or {}), local_rank, settings.verbose,
+                stdout, stderr,
+                settings.prefix_output_with_timestamp,
+                background=False)
+        except Exception:  # noqa: BLE001 — a dead thread must not
+            # read as success; the rank is recorded failed below
+            code = -1
         results[rank] = code
 
     rank = 0
@@ -59,7 +49,8 @@ def gloo_run(executable, settings, nics, driver, env, stdout=None,
             rank += 1
     for t in threads:
         t.join()
-    failed = {r: c for r, c in results.items() if c != 0}
+    failed = {r: results.get(r, -1) for r in range(rank)
+              if results.get(r, -1) != 0}
     if failed:
         raise RuntimeError(
             f"Spark gloo job failed on ranks {sorted(failed)}")

@@ -49,6 +49,13 @@ def broadcast_variables(variables, root_rank, process_set=global_process_set):
     """Assign every variable to root's value (reference
     ``tensorflow/__init__.py`` broadcast_variables)."""
     variables = list(variables)
+    from ..common import basics as _b
+    ranks = _b.engine().process_set_ranks(
+        process_set.process_set_id or 0) if _b.is_initialized() else [0]
+    if len(ranks) == 1:
+        # single-rank: broadcast is the identity; skipping it lets
+        # unchanged reference scripts call this inside tf.function
+        return
 
     def _value(v):
         # tf.Variable.value is a method; keras-3 Variable.value is a
@@ -343,6 +350,12 @@ class _GradSync:
     def sync(self, grads, sources=None):
         """allreduce_grads, but gradients of registered local sources
         are kept local (scaled by 1/size when scale_local_gradients)."""
+        if self._size() == 1:
+            # single-rank jobs: the reduction is the identity, and
+            # skipping it lets unchanged reference scripts trace the
+            # whole step under tf.function (the engine's eager staging
+            # cannot run inside a traced graph)
+            return grads
         if sources is None or not self.local_vars:
             return self.allreduce_grads(grads)
         flat_src = tf.nest.flatten(sources)
@@ -362,7 +375,7 @@ class _GradSync:
         return tf.nest.pack_sequence_as(grads, flat)
 
 
-class DistributedGradientTape(tf.GradientTape):
+class _OwnedDistributedGradientTape(tf.GradientTape):
     """``tf.GradientTape`` whose ``gradient()`` averages gradients
     across ranks (reference ``tensorflow/__init__.py:1110``
     DistributedGradientTape -> _DistributedGradientTape :1026)."""
@@ -398,6 +411,43 @@ class DistributedGradientTape(tf.GradientTape):
 
     def _allreduce_grads(self, grads):
         return self._sync.allreduce_grads(grads)
+
+
+def DistributedGradientTape(gradtape=None, persistent=False,
+                            watch_accessed_variables=True,
+                            device_dense="", device_sparse="",
+                            compression=Compression.none,
+                            sparse_as_dense=False, op=Average,
+                            gradient_predivide_factor=1.0,
+                            num_groups=0, groups=None,
+                            process_set=global_process_set,
+                            scale_local_gradients=True,
+                            use_compiled_ops=None):
+    """Distributed gradient tape, both reference calling conventions:
+
+    * ``hvd.DistributedGradientTape(tape)`` — wrap a tape the user
+      already recorded with (the reference's primary form,
+      tensorflow/__init__.py:1110: it wraps, never records itself);
+    * ``with hvd.DistributedGradientTape() as tape:`` — a recording
+      tape subclass (convenience form).
+    """
+    kwargs = dict(compression=compression, op=op,
+                  gradient_predivide_factor=gradient_predivide_factor,
+                  process_set=process_set,
+                  scale_local_gradients=scale_local_gradients,
+                  use_compiled_ops=use_compiled_ops,
+                  sparse_as_dense=sparse_as_dense)
+    if gradtape is not None:
+        if not isinstance(gradtape, tf.GradientTape):
+            raise TypeError(
+                "DistributedGradientTape's first argument must be a "
+                f"tf.GradientTape (got {type(gradtape).__name__}); "
+                "for a recording tape call it with no positional "
+                "arguments")
+        return _DistributedTapeWrapper(gradtape, _GradSync(**kwargs))
+    return _OwnedDistributedGradientTape(
+        persistent=persistent,
+        watch_accessed_variables=watch_accessed_variables, **kwargs)
 
 
 class _DistributedTapeWrapper:

@@ -25,10 +25,10 @@ class NoneCompressor(Compressor):
 
 
 class FP16Compressor(Compressor):
-    """bfloat16 on the wire (TPU-native 16-bit; same exponent range as
-    f32).  The reference uses IEEE fp16 for NCCL."""
+    """IEEE float16 on the wire, exactly like the reference.  On TPU
+    prefer ``Compression.bf16`` (same width, f32's exponent range)."""
 
-    wire_dtype = tf.bfloat16
+    wire_dtype = tf.float16
 
     @classmethod
     def compress(cls, tensor):
@@ -41,6 +41,11 @@ class FP16Compressor(Compressor):
         return tf.cast(tensor, ctx) if ctx is not None else tensor
 
 
+class BF16Compressor(FP16Compressor):
+    wire_dtype = tf.bfloat16
+
+
 class Compression:
     none = NoneCompressor
     fp16 = FP16Compressor
+    bf16 = BF16Compressor

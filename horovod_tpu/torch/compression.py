@@ -25,12 +25,12 @@ class NoneCompressor(Compressor):
 
 
 class FP16Compressor(Compressor):
-    """Halve allreduce bytes for float tensors.  On TPU the natural
-    16-bit format is bfloat16 (same exponent range as f32 — no loss
-    scaling needed, and the MXU consumes it natively), so that is the
-    default wire format; fp16 is kept for exact reference parity."""
+    """Halve allreduce bytes for float tensors.  IEEE float16 on the
+    wire, exactly like the reference (its test suite asserts the
+    compressed dtype).  On TPU prefer ``Compression.bf16``: same
+    width, f32's exponent range (no loss scaling), MXU-native."""
 
-    wire_dtype = torch.bfloat16
+    wire_dtype = torch.float16
 
     @classmethod
     def compress(cls, tensor):
@@ -43,11 +43,13 @@ class FP16Compressor(Compressor):
         return tensor.to(ctx) if ctx is not None else tensor
 
 
-class TrueFP16Compressor(FP16Compressor):
-    wire_dtype = torch.float16
+class BF16Compressor(FP16Compressor):
+    wire_dtype = torch.bfloat16
 
 
 class Compression:
     none = NoneCompressor
     fp16 = FP16Compressor
-    fp16_ieee = TrueFP16Compressor
+    bf16 = BF16Compressor
+    #: former name of the IEEE-f16 compressor, now the default fp16
+    fp16_ieee = FP16Compressor

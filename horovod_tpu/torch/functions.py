@@ -45,6 +45,14 @@ def broadcast_optimizer_state(optimizer, root_rank,
     state = api.broadcast_object(optimizer.state_dict(), root_rank,
                                  name="opt_state", process_set=process_set)
     optimizer.load_state_dict(state)
+    # the reference's dummy-step trick materializes zero gradients for
+    # grad-requiring params that have no optimizer state yet
+    # (functions.py:94-95); callers rely on .grad being a tensor
+    # afterwards (reference test_torch.py:2541 broadcasts it)
+    for group in optimizer.param_groups:
+        for p in group["params"]:
+            if p.requires_grad and p.grad is None:
+                p.grad = p.data.new(p.size()).zero_()
 
 
 broadcast_object = api.broadcast_object

@@ -248,11 +248,15 @@ class HorovodGroupedReducescatter(torch.autograd.Function):
     """Differentiable grouped reducescatter."""
 
     @staticmethod
-    def forward(ctx, name, op, process_set, *tensors):
+    def forward(ctx, name, op, process_set, prescale_factor,
+                postscale_factor, *tensors):
         ctx.op = op
         ctx.process_set = process_set
         return tuple(_api.grouped_reducescatter(
-            list(tensors), op, name, process_set=process_set))
+            list(tensors), op, name,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+            process_set=process_set))
 
     @staticmethod
     def backward(ctx, *grad_outputs):
@@ -260,7 +264,7 @@ class HorovodGroupedReducescatter(torch.autograd.Function):
         grads = [allgather(g * inv if inv != 1 else g,
                            process_set=ctx.process_set)
                  for g in grad_outputs]
-        return (None, None, None, *grads)
+        return (None, None, None, None, None, *grads)
 
 
 # ----------------------------------------------------------------------------
@@ -352,13 +356,23 @@ def reducescatter(tensor, name=None, compression=Compression.none,
     return compression.decompress(out, cctx) if cctx is not None else out
 
 
-def grouped_reducescatter(tensors, name=None, op=Average,
-                          process_set=global_process_set):
-    if _differentiable(*tensors):
-        return list(HorovodGroupedReducescatter.apply(name, op, process_set,
-                                                      *tensors))
-    return _api.grouped_reducescatter(tensors, op, name,
-                                      process_set=process_set)
+def grouped_reducescatter(tensors, name=None,
+                          compression=Compression.none, op=Average,
+                          process_set=global_process_set,
+                          prescale_factor=1.0, postscale_factor=1.0):
+    pairs = [compression.compress(t) if isinstance(t, torch.Tensor)
+             else (t, None) for t in tensors]
+    compressed = [p[0] for p in pairs]
+    if _differentiable(*compressed):
+        outs = list(HorovodGroupedReducescatter.apply(
+            name, op, process_set, prescale_factor, postscale_factor,
+            *compressed))
+    else:
+        outs = _api.grouped_reducescatter(
+            compressed, op, name, prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor, process_set=process_set)
+    return [compression.decompress(o, ctx) if ctx is not None else o
+            for o, (_, ctx) in zip(outs, pairs)]
 
 
 def sparse_allreduce_async(tensor, name, op,

@@ -136,3 +136,36 @@ def test_reference_script_import_block():
         assert float(out.sum()) == 3.0
     finally:
         hvd.shutdown()
+
+
+@pytest.mark.integration
+@pytest.mark.parametrize("op", ["Average", "Adasum"])
+def test_reference_example_runs_verbatim(op, tmp_path):
+    """The reference's own example file
+    (examples/adasum/adasum_small_model.py) runs UNCHANGED — same
+    bytes, `import horovod.torch as hvd` — under this framework's
+    horovodrun at 2 processes."""
+    import subprocess
+    import sys
+
+    example = os.path.join(os.path.dirname(REF), "examples", "adasum",
+                           "adasum_small_model.py")
+    if not os.path.exists(example):
+        pytest.skip("reference examples not available")
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu", JAX_NUM_CPU_DEVICES="2",
+        PYTHONPATH=repo + os.pathsep + env.get("PYTHONPATH", ""))
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+         "--cpu", "--", sys.executable, example, "--op", op,
+         "--learning_rate", "0.2"],
+        env=env, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    # rank 0 prints: x_max op learning_rate size steps
+    out_line = [l for l in proc.stdout.splitlines()
+                if l.startswith("1.0 ")]
+    assert out_line, proc.stdout
+    assert f"1.0 {op} 0.2 2" in out_line[0]

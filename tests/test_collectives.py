@@ -56,10 +56,14 @@ def test_allreduce_average_default_op(hvd_shutdown):
         np.testing.assert_allclose(out, np.full(4, 3.5, dtype=np.float32))
 
 
-def test_allreduce_average_int_raises(hvd_shutdown):
+def test_allreduce_average_int_reference_semantics(hvd_shutdown):
+    """Int average = sum then FP64 divide with truncating cast
+    (reference test_torch.py:201-230) — equal inputs are a fixpoint."""
     def fn():
-        with pytest.raises(ValueError, match="Averaging"):
-            hvd.allreduce(np.arange(4, dtype=np.int32), op=hvd.Average)
+        t = np.arange(-4, 4, dtype=np.int32)
+        out = hvd.allreduce(t, op=hvd.Average)
+        assert out.dtype == np.int32
+        np.testing.assert_array_equal(out, t)
         return True
 
     assert all(run_ranks(fn, np_ranks=2))
@@ -633,14 +637,22 @@ def test_grouped_allreduce_prescale(hvd_shutdown):
     assert all(run_ranks(fn))
 
 
-def test_grouped_reducescatter_int_prescale_rejected(hvd_shutdown):
+def test_grouped_reducescatter_int_prescale_semantics(hvd_shutdown):
+    """Int reducescatter scaling: FP64 factor, truncating cast
+    (reference test_torch.py reducescatter prescale grid)."""
     def fn():
-        with pytest.raises(ValueError, match="floating-point"):
-            hvd.grouped_reducescatter([np.ones(8, np.int32)], op=hvd.Sum,
-                                      prescale_factor=0.5)
-        with pytest.raises(ValueError, match="floating-point"):
-            hvd.reducescatter(np.ones(8, np.int32), op=hvd.Sum,
-                              postscale_factor=2.0)
+        n = hvd.size()
+        outs = hvd.grouped_reducescatter(
+            [np.full((8, 2), 3, np.int32)], op=hvd.Sum,
+            prescale_factor=0.5)
+        # trunc(3 * 0.5) = 1 per rank, summed over all ranks
+        assert outs[0].dtype == np.int32
+        np.testing.assert_array_equal(
+            outs[0], np.full((8 // n, 2), n))
+        post = hvd.reducescatter(np.full((8, 2), 3, np.int32),
+                                 op=hvd.Sum, postscale_factor=2.0)
+        np.testing.assert_array_equal(
+            post, np.full((8 // n, 2), 3 * n * 2))
         return True
 
     assert all(run_ranks(fn))
@@ -920,3 +932,20 @@ def test_alltoall_diag_selector():
         diag_max = [max(splits[r][(r + d) % R] for r in range(R))
                     for d in range(R)]
         assert (R * max_seg > 2 * sum(diag_max)) == want_diag, splits
+
+
+def test_allreduce_preserves_small_int_dtypes(hvd_shutdown):
+    """Sum must return the caller's dtype — jnp.sum's numpy-style
+    promote-to-default-int rule handed int32 callers int64 results
+    (caught by running the reference's own test_torch.py)."""
+    def fn():
+        for dtype in (np.int8, np.int16, np.int32, np.uint8):
+            t = np.arange(5, dtype=dtype)
+            out = hvd.allreduce(t, op=hvd.Sum)
+            assert out.dtype == dtype, (dtype, out.dtype)
+            rs = hvd.reducescatter(np.ones((4, 2), dtype=dtype),
+                                   op=hvd.Sum)
+            assert rs.dtype == dtype, (dtype, rs.dtype)
+        return True
+
+    assert all(hvd.run(fn, np=4))

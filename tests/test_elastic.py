@@ -633,7 +633,16 @@ EIGHT_WAY_WORKER = textwrap.dedent("""
 
     @elastic.run
     def train(state):
+        import time
         while state.batch < 14:
+            if (state.batch >= 6 and state.saw_eight == 0
+                    and hvd.size() < 8):
+                # park until the discovery-driven scale-up lands, so
+                # the size-8 phase cannot be raced away by a slow
+                # driver restart on a loaded box; identical condition
+                # on every rank (batch/saw_eight are synced state)
+                time.sleep(0.2)
+                continue
             if (hvd.size() == 8 and state.saw_eight >= 2
                     and os.environ["HOROVOD_HOSTNAME"] == "127.0.0.1"
                     and hvd.local_rank() == 0

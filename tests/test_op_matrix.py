@@ -129,13 +129,18 @@ def test_allreduce_matrix(live_engine, op_name, dtype):
             (op_name, dtype, out, expected)
 
 
-def test_allreduce_int_average_rejected(live_engine):
+def test_allreduce_int_average_reference_semantics(live_engine):
+    """Int average follows the reference (test_torch.py:201-230): sum,
+    divide in FP64, truncating cast — equal inputs come back exact."""
     def fn():
-        with pytest.raises(ValueError):
-            hvd.allreduce(np.arange(4, dtype=np.int32), op=hvd.Average)
-        return True
+        out = hvd.allreduce(np.arange(-4, 4, dtype=np.int32),
+                            op=hvd.Average, name="m.avg.int32")
+        assert out.dtype == np.int32
+        return out
 
-    assert all(run_ranks(fn))
+    for out in run_ranks(fn):
+        np.testing.assert_array_equal(
+            out, np.arange(-4, 4, dtype=np.int32))
 
 
 # ---------------------------------------------------------------------------
@@ -164,14 +169,25 @@ def test_allreduce_scale_matrix(live_engine, dtype, pre, post):
 
 
 @pytest.mark.parametrize("dtype", INT_DTYPES)
-def test_allreduce_int_scale_rejected(live_engine, dtype):
+def test_allreduce_int_scale_reference_semantics(live_engine, dtype):
+    """Int prescale follows the reference (test_torch.py:434-487):
+    factor applied in FP64, truncating cast back, then summed."""
     def fn():
-        with pytest.raises(ValueError):
-            hvd.allreduce(_make(dtype), op=hvd.Sum,
-                          prescale_factor=2.0)
-        return True
+        x = _make(dtype)
+        out = hvd.allreduce(x, op=hvd.Sum, prescale_factor=2.5,
+                            name=f"m.isc.{dtype}")
+        assert out.dtype == _dt(dtype)
+        return (np.asarray(out, np.float64),
+                np.asarray(x, np.float64))
 
-    assert all(run_ranks(fn))
+    results = run_ranks(fn)
+    per_rank = [np.trunc(x * 2.5).astype(_dt(dtype)).astype(np.float64)
+                for _, x in results]
+    expected = np.sum(per_rank, axis=0)
+    # modular wrap for small ints, matching on-wire arithmetic
+    expected = expected.astype(_dt(dtype)).astype(np.float64)
+    for out, _ in results:
+        assert np.allclose(out, expected), (dtype, out, expected)
 
 
 # ---------------------------------------------------------------------------

@@ -230,7 +230,9 @@ def test_fp16_compression(hvd_shutdown):
     def fn():
         t = torch.randn(16, generator=torch.Generator().manual_seed(1))
         comp, ctx = hvd.Compression.fp16.compress(t)
-        assert comp.dtype == torch.bfloat16
+        assert comp.dtype == torch.float16      # reference wire dtype
+        bcomp, _ = hvd.Compression.bf16.compress(t)
+        assert bcomp.dtype == torch.bfloat16    # TPU-preferred option
         out = hvd.Compression.fp16.decompress(comp, ctx)
         assert out.dtype == torch.float32
         assert torch.allclose(out, t, atol=0.01)
@@ -823,6 +825,28 @@ def test_elastic_sampler_sync_unions_progress(hvd_shutdown):
         assert len(before) == 2
         state.sync()
         assert sampler.processed_indices == {0, 1, 2, 3}
+        return True
+
+    assert all(run_ranks(fn, 2))
+
+
+def test_grouped_reducescatter_scales_and_compression(hvd_shutdown):
+    """Reference surface: scale factors flow through the grouped
+    autograd path (no silent gradient detach) and compression
+    round-trips (torch/mpi_ops.py:1209 signature)."""
+    def fn():
+        n = hvd.size()
+        t = torch.ones(2 * n, 3, requires_grad=True)
+        outs = hvd.grouped_reducescatter(
+            [t], op=hvd.Sum, prescale_factor=0.5,
+            compression=hvd.Compression.fp16)
+        assert outs[0].requires_grad
+        assert outs[0].dtype == torch.float32     # decompressed
+        # sum over n ranks of 0.5 each
+        assert torch.allclose(outs[0].detach(),
+                              torch.full((2, 3), 0.5 * n))
+        outs[0].sum().backward()
+        assert t.grad is not None
         return True
 
     assert all(run_ranks(fn, 2))

@@ -68,6 +68,18 @@ case "${1:-all}" in
       python -m pytest "$REF/test/parallel/test_torch.py" -q \
         -p no:cacheprovider \
         -k "not test_horovod_join_allreduce and not test_broadcast_state_options and not (test_broadcast_state and not test_broadcast_state_no_grad)"
+    # single-node suites: service framework, task services, compute
+    # service, elastic sampler/state, common utils, discovery
+    printf 'import functools\nclass parameterized:\n    @staticmethod\n    def expand(params, **kw):\n        def deco(fn):\n            @functools.wraps(fn)\n            def wrapper(self, *a, **k):\n                for p in params:\n                    case = p if isinstance(p, (list, tuple)) else (p,)\n                    fn(self, *case)\n            return wrapper\n        return deco\n' > "$SHIM/parameterized.py"
+    HOROVOD_TPU_PLATFORM=cpu JAX_ENABLE_X64=1 \
+      PYTHONPATH="$PWD:$REF/test/single:$SHIM:${PYTHONPATH:-}" \
+      python -m pytest -q -p no:cacheprovider \
+        "$REF/test/single/test_service.py" \
+        "$REF/test/single/test_task_service.py" \
+        "$REF/test/single/test_compute_service.py" \
+        "$REF/test/single/test_torch_elastic.py" \
+        "$REF/test/single/test_util.py" \
+        "$REF/test/single/test_elastic_discovery.py"
     # deselected: broadcast_state{,_options} iterate every torch.optim
     # class incl. torch-2.x-only Muon (2D-params-only — the reference
     # itself fails these on modern torch); join_allreduce asserts

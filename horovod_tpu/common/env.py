@@ -106,6 +106,10 @@ class Config:
             "HOROVOD_TPU_PACK_MT_THRESHOLD", 8 << 20)
         self.cache_capacity = get_int(HOROVOD_CACHE_CAPACITY, DEFAULT_CACHE_CAPACITY)
         self.timeline_filename = get_str(HOROVOD_TIMELINE)
+        if self.timeline_filename == "DYNAMIC":
+            # reference sentinel (test_torch.py:54): timeline support
+            # enabled but no file until start_timeline() names one
+            self.timeline_filename = None
         self.timeline_mark_cycles = get_bool(HOROVOD_TIMELINE_MARK_CYCLES)
         self.autotune = get_bool(HOROVOD_AUTOTUNE)
         self.autotune_log = get_str(HOROVOD_AUTOTUNE_LOG)

@@ -110,7 +110,10 @@ class ComputeService(network.BasicService):
                         timeout=req.timeout):
                     return TimeoutException(
                         f"Timed out waiting for dispatcher "
-                        f"{req.dispatcher_id} to register")
+                        f"{req.dispatcher_id} to register. Try to "
+                        f"find out what takes the dispatcher so long "
+                        f"to register or increase timeout. Timeout "
+                        f"after {req.timeout} seconds.")
                 return WaitForDispatcherRegistrationResponse(
                     self._dispatcher_addresses[req.dispatcher_id])
 
@@ -135,8 +138,11 @@ class ComputeService(network.BasicService):
                         self._workers_per_dispatcher,
                         timeout=req.timeout):
                     return TimeoutException(
-                        f"Timed out waiting for workers of dispatcher "
-                        f"{req.dispatcher_id} to register")
+                        f"Timed out waiting for workers for "
+                        f"dispatcher {req.dispatcher_id} to register. "
+                        f"Try to find out what takes the workers so "
+                        f"long to register or increase timeout. "
+                        f"Timeout after {req.timeout} seconds.")
             return network.AckResponse()
 
         if isinstance(req, ShutdownRequest):
@@ -151,6 +157,16 @@ class ComputeService(network.BasicService):
             return network.AckResponse()
 
         return super()._handle(req, client_address)
+
+    def shutdown(self):
+        # wake parked WaitForShutdown handlers BEFORE draining the
+        # server: block_on_close joins handler threads, and a handler
+        # waiting on the condition would deadlock the teardown
+        # (reference compute_service.py shutdown() sets the flag too)
+        with self._wait_cond:
+            self._shutdown = True
+            self._wait_cond.notify_all()
+        super().shutdown()
 
 
 class ComputeClient(network.BasicClient):

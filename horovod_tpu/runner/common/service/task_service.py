@@ -81,9 +81,9 @@ class RegisterCodeResultRequest:
 class BasicTaskService(network.BasicService):
     def __init__(self, name, index, key, nics=None, command_env=None,
                  verbose=0):
-        super().__init__(name, key, nics)
         self._initial_registration_complete = False
         self._wait_cond = threading.Condition()
+        self._service_shutdown = False
         self._index = index
         self._command_env = command_env
         self._command_thread = None
@@ -93,6 +93,7 @@ class BasicTaskService(network.BasicService):
         self._command_exit_code = None
         self._fn_result = None
         self._verbose = verbose
+        super().__init__(name, key, nics)
 
     def _run_command(self, command, env, event, stdout, stderr,
                      prefix_output_with_timestamp=False):
@@ -132,6 +133,9 @@ class BasicTaskService(network.BasicService):
 
         if isinstance(req, StreamCommandOutputRequest):
             self.wait_for_command_start()
+            if self._command_thread is None:
+                # service shutting down before any command started
+                return CommandOutputNotCaptured()
             stream = self._command_stdout \
                 if isinstance(req, StreamCommandStdOutRequest) \
                 else self._command_stderr
@@ -165,8 +169,15 @@ class BasicTaskService(network.BasicService):
 
         if isinstance(req, WaitForCommandExitCodeRequest):
             with self._wait_cond:
-                while self._command_thread is None or \
-                        self._command_thread.is_alive():
+                # a RUNNING command is waited out even through
+                # shutdown (the draining contract,
+                # test_service.py:143: the caller gets the real exit
+                # code); only a never-started command releases on
+                # shutdown so teardown cannot hang forever
+                while (self._command_thread is None
+                       and not self._service_shutdown) or \
+                        (self._command_thread is not None
+                         and self._command_thread.is_alive()):
                     self._wait_cond.wait(
                         max(req.delay, WAIT_FOR_COMMAND_MIN_DELAY))
                 return WaitForCommandExitCodeResponse(
@@ -194,7 +205,8 @@ class BasicTaskService(network.BasicService):
 
     def wait_for_command_start(self, timeout=None):
         with self._wait_cond:
-            while self._command_thread is None:
+            while self._command_thread is None and \
+                    not self._service_shutdown:
                 if timeout:
                     self._wait_cond.wait(timeout.remaining())
                     timeout.check_time_out_for("command to run")
@@ -213,6 +225,16 @@ class BasicTaskService(network.BasicService):
 
     def wait_for_command_termination(self):
         self._command_thread.join()
+
+    def shutdown(self):
+        # wake every parked waiter (command-start, exit-code) before
+        # the draining server joins handler threads; in-flight command
+        # handlers still finish (test_service.py:143 contract) —
+        # running commands are not aborted, only waits are released
+        with self._wait_cond:
+            self._service_shutdown = True
+            self._wait_cond.notify_all()
+        super().shutdown()
 
     def command_exit_code(self):
         return self._command_exit_code
@@ -233,11 +255,31 @@ class BasicTaskClient(network.BasicClient):
 
     def stream_command_output(self, stdout=None, stderr=None):
         def send(req, stream):
-            try:
-                self._send(req, stream=stream)
-            except Exception:
-                self.abort_command()
-                raise
+            # a broken client-side stream (or dropped connection)
+            # re-requests the stream and resumes from the live pipe —
+            # some lines are lost, the command keeps running
+            # (reference test_task_service.py reconnect contract);
+            # only after the attempt budget does it abort the command
+            for attempt in range(self._attempts):
+                try:
+                    self._send(req, stream=stream)
+                    return
+                except (OSError, EOFError) as exc:
+                    # connection-level failure: _send already burned
+                    # its own retry budget — don't square it
+                    try:
+                        self.abort_command()
+                    finally:
+                        raise exc
+                except Exception:
+                    # mid-stream failure (e.g. the caller's stream
+                    # object raised): re-request and resume from the
+                    # live pipe, losing some lines
+                    if attempt == self._attempts - 1:
+                        try:
+                            self.abort_command()
+                        finally:
+                            raise
 
         return (in_thread(send, (StreamCommandStdOutRequest(), stdout))
                 if stdout else None,

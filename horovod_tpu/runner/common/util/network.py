@@ -76,15 +76,24 @@ class Wire:
         return pickle.loads(message)
 
 
+class _DrainingTCPServer(socketserver.ThreadingTCPServer):
+    """shutdown() must wait for in-flight request handlers — the
+    reference's services guarantee a long-running RPC completes before
+    the server goes away (test_service.py:122-173 contract)."""
+
+    daemon_threads = False
+    block_on_close = True
+    allow_reuse_address = True
+
+
 class BasicService:
     def __init__(self, service_name, key, nics=None):
         self._service_name = service_name
         self._wire = Wire(key)
         self._nics = nics
         self._server, self._port = find_port(
-            lambda addr: socketserver.ThreadingTCPServer(
+            lambda addr: _DrainingTCPServer(
                 addr, self._make_handler()))
-        self._server.daemon_threads = True
         self._addresses = {
             "all": [(a, self._port)
                     for a in sorted(get_local_host_addresses())]}
@@ -200,17 +209,32 @@ class BasicClient:
                     wfile.close()
             except (OSError, EOFError, struct.error):
                 if attempt == attempts - 1:
-                    return None
+                    if probing:
+                        return None
+                    # surface the raw connection error — callers (and
+                    # the reference's tests) match on the errno text
+                    raise
             finally:
                 sock.close()
         return None
 
     def _send(self, req, stream=None):
+        last_error = None
         for intf, addrs in self._addresses.items():
             for addr in addrs:
-                resp = self._try_request(addr, req, stream=stream)
+                try:
+                    resp = self._try_request(addr, req, stream=stream)
+                except (OSError, EOFError, struct.error) as exc:
+                    # fail over to the next probed address; only the
+                    # LAST address's failure surfaces (callers — and
+                    # the reference's tests — match on the raw errno
+                    # text)
+                    last_error = exc
+                    continue
                 if resp is not None:
                     return resp
+        if last_error is not None:
+            raise last_error
         raise NoValidAddressesFound(
             f"{self._service_name} stopped responding on "
             f"{self._addresses}")

@@ -162,9 +162,15 @@ class HostDiscoveryScript(HostDiscovery):
         self._discovery_script = discovery_script
         self._default_slots = slots
 
-    def find_available_hosts_and_slots(self):
-        stdout = subprocess.check_output(
+    def _execute_discovery_script(self):
+        """Run the user's script, return its stdout (separate method
+        so tests can substitute results — reference discovery.py
+        contract)."""
+        return subprocess.check_output(
             self._discovery_script, shell=True, timeout=60).decode()
+
+    def find_available_hosts_and_slots(self):
+        stdout = self._execute_discovery_script()
         host_slots = {}
         for line in stdout.strip().splitlines():
             line = line.strip()

@@ -108,16 +108,20 @@ class SamplerStateHandler(StateHandler):
         self.value.load_state_dict(self._saved_sampler_state)
 
     def sync(self):
-        # every rank's mid-epoch progress matters: union the processed
-        # indices across ranks first, else a resize would re-serve (and
-        # double-train) the samples non-root ranks already consumed
+        # progress is global but may be unevenly recorded at a resize:
+        # take the conservative MIN count (no rank skips samples a
+        # slower peer never saw) plus the UNION of individually
+        # consumed indices (no rank re-serves samples a faster peer
+        # already trained on); reset() honors both
         from ..functions import allgather_object
         state = self.value.state_dict()
         all_states = allgather_object(state)
         merged = set()
         for s in all_states:
-            merged.update(s["processed_indices"])
+            merged.update(s.get("processed_indices", ()))
         state["processed_indices"] = sorted(merged)
+        state["processed_num"] = min(
+            s.get("processed_num", 0) for s in all_states)
         self.value.load_state_dict(broadcast_object(state))
 
     def saved_state(self):

@@ -68,6 +68,13 @@ case "${1:-all}" in
       python -m pytest "$REF/test/parallel/test_torch.py" -q \
         -p no:cacheprovider \
         -k "not test_horovod_join_allreduce and not test_broadcast_state_options and not (test_broadcast_state and not test_broadcast_state_no_grad)"
+    # TF parallel suite (syncbn deselected: the TEST body itself calls
+    # tf.keras.layers.BatchNormalization(fused=False), a kwarg keras 3
+    # removed — the reference fails identically on this keras)
+    HOROVOD_TPU_PLATFORM=cpu JAX_ENABLE_X64=1 \
+      PYTHONPATH="$PWD:$REF/test/parallel:$SHIM:${PYTHONPATH:-}" \
+      python -m pytest "$REF/test/parallel/test_tensorflow.py" -q \
+        -p no:cacheprovider -k "not test_horovod_syncbn"
     # single-node suites: service framework, task services, compute
     # service, elastic sampler/state, common utils, discovery
     printf 'import functools\nclass parameterized:\n    @staticmethod\n    def expand(params, **kw):\n        def deco(fn):\n            @functools.wraps(fn)\n            def wrapper(self, *a, **k):\n                for p in params:\n                    case = p if isinstance(p, (list, tuple)) else (p,)\n                    fn(self, *case)\n            return wrapper\n        return deco\n' > "$SHIM/parameterized.py"

@@ -8,6 +8,7 @@ exit code, and abort the process tree.  The spark/ray integration
 layers drive remote workers through this protocol.
 """
 
+import struct
 import threading
 
 from ..util import network, safe_shell_exec
@@ -264,7 +265,7 @@ class BasicTaskClient(network.BasicClient):
                 try:
                     self._send(req, stream=stream)
                     return
-                except (OSError, EOFError) as exc:
+                except (OSError, EOFError, struct.error) as exc:
                     # connection-level failure: _send already burned
                     # its own retry budget — don't square it
                     try:

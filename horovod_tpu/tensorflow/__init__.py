@@ -30,19 +30,349 @@ from ..common.process_sets import (  # noqa: F401
 )
 from ..ops import api as _api
 from ..ops.api import (  # noqa: F401
-    allreduce, allreduce_async,
-    grouped_allreduce, grouped_allreduce_async,
-    allgather, allgather_async, grouped_allgather,
+    allreduce_async,
+    grouped_allreduce_async,
+    allgather_async,
     grouped_allgather_async,
-    broadcast, broadcast_async, broadcast_,
-    alltoall, alltoall_async,
-    reducescatter, reducescatter_async,
-    grouped_reducescatter, grouped_reducescatter_async,
+    broadcast_async, broadcast_,
+    alltoall_async,
+    reducescatter_async,
+    grouped_reducescatter_async,
     barrier, join, synchronize, poll,
     broadcast_object, allgather_object,
     Average, Sum, Adasum, Min, Max, Product,
 )
 from .compression import Compression  # noqa: F401
+
+
+# -- public collectives: differentiable + trace-capable ----------------------
+#
+# Eagerly the data plane is the framework-neutral API; inside a traced
+# tf.function the collective hops to the host through tf.py_function —
+# the role the reference's AsyncOpKernels play
+# (tensorflow/mpi_ops.cc:446-501).  Every op carries a custom gradient
+# (the reference registers gradients per custom op,
+# mpi_ops.py:137-360; the adjoints here match torch/mpi_ops.py's
+# autograd Functions).  Traced mode is single process only: one TF
+# runtime serializes py_function bodies, so in-process rank THREADS
+# would deadlock (real deployments run one process per rank).
+
+def _run_host(host_fn, inputs, touts):
+    """Execute ``host_fn`` over host values of ``inputs`` — directly
+    when eager, through a py_function hop when traced."""
+    if tf.executing_eagerly():
+        outs = host_fn(*inputs)
+        return tf.nest.map_structure(tf.convert_to_tensor, outs)
+    if _basics.engine().num_local > 1:
+        raise RuntimeError(
+            "tf.function-traced collectives need one process per rank "
+            "(horovodrun/proc_run); with the in-process thread "
+            "launcher use eager mode")
+    caller_ctx = _basics.context()
+
+    def _bridge(*ts):
+        with _basics.bound_context(caller_ctx):
+            return host_fn(*ts)
+
+    return tf.py_function(func=_bridge, inp=inputs, Tout=touts)
+
+
+def _ps_size(process_set):
+    # ProcessSet.size() is the one shared implementation
+    # (common/process_sets.py)
+    return process_set.size()
+
+
+def _ps_pos(process_set):
+    return process_set.rank()
+
+
+def _sparse_allreduce_public(slices, average, op, prescale_factor,
+                             postscale_factor, process_set):
+    """IndexedSlices allreduce = allgather(values)+allgather(indices)
+    (reference tensorflow/__init__.py:104-138)."""
+    op = op if op is not None else \
+        (Sum if average is False else Average)
+    if op not in (Average, Sum):
+        raise NotImplementedError(
+            "IndexedSlices allreduce supports op=Average or op=Sum "
+            "only")
+    if prescale_factor != 1.0 or postscale_factor != 1.0:
+        raise NotImplementedError(
+            "prescale_factor and postscale_factor are not supported "
+            "with tf.IndexedSlices")
+    values = allgather(slices.values, process_set=process_set)
+    indices = allgather(slices.indices, process_set=process_set)
+    if op == Average:
+        values = values / tf.cast(_ps_size(process_set), values.dtype)
+    return tf.IndexedSlices(values, indices,
+                            dense_shape=slices.dense_shape)
+
+
+def allreduce(tensor, average=None, name=None, op=None,
+              prescale_factor=1.0, postscale_factor=1.0,
+              process_set=global_process_set):
+    if isinstance(tensor, tf.IndexedSlices):
+        return _sparse_allreduce_public(
+            tensor, average, op, prescale_factor, postscale_factor,
+            process_set)
+    if not tf.is_tensor(tensor):
+        return _api.allreduce(tensor, average, name, op,
+                              prescale_factor, postscale_factor,
+                              process_set)
+
+    @tf.custom_gradient
+    def _op(t):
+        out = _run_host(
+            lambda x: _api.allreduce(x, average, name, op,
+                                     prescale_factor,
+                                     postscale_factor, process_set),
+            [t], t.dtype)
+        out.set_shape(t.shape)
+
+        def grad(dy):
+            # allreduce adjoint = allreduce with the same op/scales
+            # (reference mpi_ops.py:137-153)
+            return allreduce(dy, average=average, op=op,
+                             prescale_factor=prescale_factor,
+                             postscale_factor=postscale_factor,
+                             process_set=process_set)
+
+        return out, grad
+
+    return _op(tensor)
+
+
+def grouped_allreduce(tensors, average=None, name=None, op=None,
+                      prescale_factor=1.0, postscale_factor=1.0,
+                      process_set=global_process_set):
+    if any(isinstance(t, tf.IndexedSlices) for t in tensors):
+        # reference grouped allreduce handles mixed dense/sparse
+        # member-wise (tensorflow/__init__.py grouped IndexedSlices)
+        return [allreduce(t, average=average, op=op,
+                          prescale_factor=prescale_factor,
+                          postscale_factor=postscale_factor,
+                          process_set=process_set) for t in tensors]
+    if not any(tf.is_tensor(t) for t in tensors):
+        return _api.grouped_allreduce(tensors, average, name, op,
+                                      prescale_factor,
+                                      postscale_factor, process_set)
+
+    @tf.custom_gradient
+    def _op(*ts):
+        outs = _run_host(
+            lambda *xs: _api.grouped_allreduce(
+                list(xs), average, name, op, prescale_factor,
+                postscale_factor, process_set),
+            list(ts), [t.dtype for t in ts])
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        for o, t in zip(outs, ts):
+            o.set_shape(t.shape)
+
+        def grad(*dys):
+            return grouped_allreduce(
+                list(dys), average=average, op=op,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+                process_set=process_set)
+
+        return tuple(outs), grad
+
+    return list(_op(*tensors))
+
+
+def broadcast(tensor, root_rank=0, name=None,
+              process_set=global_process_set):
+    if not tf.is_tensor(tensor):
+        return _api.broadcast(tensor, root_rank, name, process_set)
+
+    @tf.custom_gradient
+    def _op(t):
+        out = _run_host(
+            lambda x: _api.broadcast(x, root_rank, name, process_set),
+            [t], t.dtype)
+        out.set_shape(t.shape)
+
+        def grad(dy):
+            # reduce the output grads to root; non-roots contributed
+            # nothing (reference mpi_ops.py:337-360 / torch broadcast
+            # backward)
+            reduced = allreduce(dy, op=Average,
+                                process_set=process_set)
+            if _basics.rank() == root_rank:
+                return reduced
+            return tf.zeros_like(reduced)
+
+        return out, grad
+
+    return _op(tensor)
+
+
+def allgather(tensor, name=None, process_set=global_process_set):
+    if not tf.is_tensor(tensor):
+        return _api.allgather(tensor, name, process_set)
+
+    @tf.custom_gradient
+    def _op(t):
+        out = _run_host(
+            lambda x: _api.allgather(x, name, process_set),
+            [t], t.dtype)
+        out.set_shape(
+            tf.TensorShape([None]).concatenate(t.shape[1:]))
+
+        def grad(dy):
+            # average-allreduce the gathered grad, take this rank's
+            # row slice (reference mpi_ops.py:227-256)
+            reduced = allreduce(dy, op=Average,
+                                process_set=process_set)
+            d0 = tf.reshape(tf.shape(t)[0], [1])
+            dims = allgather(d0, process_set=process_set)
+            pos = _ps_pos(process_set)
+            offset = tf.reduce_sum(dims[:pos])
+            return reduced[offset:offset + tf.shape(t)[0]]
+
+        return out, grad
+
+    return _op(tensor)
+
+
+def grouped_allgather(tensors, name=None,
+                      process_set=global_process_set):
+    if not any(tf.is_tensor(t) for t in tensors):
+        return _api.grouped_allgather(tensors, name, process_set)
+
+    @tf.custom_gradient
+    def _op(*ts):
+        outs = _run_host(
+            lambda *xs: _api.grouped_allgather(list(xs), name,
+                                               process_set),
+            list(ts), [t.dtype for t in ts])
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        for o, t in zip(outs, ts):
+            o.set_shape(
+                tf.TensorShape([None]).concatenate(t.shape[1:]))
+
+        def grad(*dys):
+            pos = _ps_pos(process_set)
+            grads = []
+            for dy, t in zip(dys, ts):
+                reduced = allreduce(dy, op=Average,
+                                    process_set=process_set)
+                d0 = tf.reshape(tf.shape(t)[0], [1])
+                dims = allgather(d0, process_set=process_set)
+                offset = tf.reduce_sum(dims[:pos])
+                grads.append(reduced[offset:offset + tf.shape(t)[0]])
+            return tuple(grads)
+
+        return tuple(outs), grad
+
+    return list(_op(*tensors))
+
+
+def reducescatter(tensor, op=None, name=None,
+                  process_set=global_process_set,
+                  prescale_factor=1.0, postscale_factor=1.0):
+    rs_op = op if op is not None else Average
+    if not tf.is_tensor(tensor):
+        return _api.reducescatter(tensor, rs_op, name,
+                                  prescale_factor, postscale_factor,
+                                  process_set)
+
+    @tf.custom_gradient
+    def _op(t):
+        out = _run_host(
+            lambda x: _api.reducescatter(
+                x, rs_op, name, prescale_factor, postscale_factor,
+                process_set),
+            [t], t.dtype)
+        out.set_shape(
+            tf.TensorShape([None]).concatenate(t.shape[1:]))
+
+        def grad(dy):
+            # exact adjoint: un-scatter via allgather, /size for
+            # Average (torch/mpi_ops.py reducescatter backward)
+            g = allgather(dy, process_set=process_set)
+            if rs_op == Average:
+                g = g / tf.cast(_ps_size(process_set), g.dtype)
+            return g
+
+        return out, grad
+
+    return _op(tensor)
+
+
+def grouped_reducescatter(tensors, op=None, name=None,
+                          process_set=global_process_set,
+                          prescale_factor=1.0, postscale_factor=1.0):
+    rs_op = op if op is not None else Average
+    if not any(tf.is_tensor(t) for t in tensors):
+        return _api.grouped_reducescatter(
+            tensors, rs_op, name, prescale_factor, postscale_factor,
+            process_set)
+
+    @tf.custom_gradient
+    def _op(*ts):
+        outs = _run_host(
+            lambda *xs: _api.grouped_reducescatter(
+                list(xs), rs_op, name, prescale_factor,
+                postscale_factor, process_set),
+            list(ts), [t.dtype for t in ts])
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        for o, t in zip(outs, ts):
+            o.set_shape(
+                tf.TensorShape([None]).concatenate(t.shape[1:]))
+
+        def grad(*dys):
+            grads = []
+            for dy in dys:
+                g = allgather(dy, process_set=process_set)
+                if rs_op == Average:
+                    g = g / tf.cast(_ps_size(process_set), g.dtype)
+                grads.append(g)
+            return tuple(grads)
+
+        return tuple(outs), grad
+
+    return list(_op(*tensors))
+
+
+def alltoall(tensor, splits=None, name=None,
+             process_set=global_process_set):
+    if not tf.is_tensor(tensor):
+        out, recv = _api.alltoall(tensor, splits, name, process_set)
+        return (out, recv) if splits is not None else out
+
+    def _host(t, *maybe_splits):
+        s = maybe_splits[0] if maybe_splits else None
+        out, recv_splits = _api.alltoall(t, s, name, process_set)
+        return out, np.asarray(recv_splits, np.int32)
+
+    @tf.custom_gradient
+    def _op(t):
+        splits_in = [] if splits is None else [splits]
+        out, recv = _run_host(_host, [t] + splits_in,
+                              [t.dtype, tf.int32])
+        out.set_shape(
+            tf.TensorShape([None]).concatenate(t.shape[1:]))
+        recv.set_shape([None])
+
+        def grad(dy, drecv=None):
+            # route the grads back along the reversed exchange
+            # (reference mpi_ops.py alltoall grad; torch
+            # HorovodAlltoall backward)
+            gout, _ = alltoall(dy, splits=recv,
+                               process_set=process_set)
+            return gout
+
+        return (out, recv), grad
+
+    out, recv = _op(tensor)
+    # reference return shape (mpi_ops.py:432): the received-splits
+    # tensor only accompanies an explicit splits argument
+    return (out, recv) if splits is not None else out
 
 
 def broadcast_variables(variables, root_rank, process_set=global_process_set):
@@ -282,30 +612,8 @@ class _GradSync:
 
     def _allgather_tensor(self, t, tag):
         """Engine allgather of one tensor (uneven dim-0 supported);
-        bridges the py_function hop when inside a trace."""
-        def gather_host(x):
-            return _api.allgather(np.asarray(x),
-                                  process_set=self.process_set)
-
-        if tf.executing_eagerly():
-            return tf.constant(gather_host(t.numpy()))
-        if _basics.engine().num_local > 1:
-            # same deadlock as the dense traced path: one TF runtime
-            # serializes py_function bodies, so rank THREADS blocking
-            # on each other's collectives hang
-            raise RuntimeError(
-                "tf.function-traced sparse collectives need one "
-                "process per rank (horovodrun/proc_run); with the "
-                "in-process thread launcher use run_eagerly=True")
-        caller_ctx = _basics.context()
-
-        def _bridge(x):
-            with _basics.bound_context(caller_ctx):
-                return gather_host(x)
-
-        out = tf.py_function(func=_bridge, inp=[t], Tout=t.dtype)
-        out.set_shape(tf.TensorShape([None]).concatenate(t.shape[1:]))
-        return out
+        the public wrapper owns the eager/traced host-hop logic."""
+        return allgather(t, process_set=self.process_set)
 
     def _scale_split(self):
         if self.op == Average and self.gradient_predivide_factor != 1.0:
@@ -655,3 +963,22 @@ def DistributedOptimizer(optimizer, name=None,
 from . import elastic  # noqa: F401,E402
 from .functions import broadcast_model, allreduce_metrics  # noqa: F401,E402
 from .sync_batch_norm import SyncBatchNormalization  # noqa: F401,E402
+
+
+# -- tf1-era surface (reference tensorflow/__init__.py:474-500) --------------
+
+from . import util  # noqa: F401,E402
+from .util import _executing_eagerly  # noqa: F401,E402
+
+
+def broadcast_global_variables(root_rank):
+    """Broadcast all tf1 global variables from root (reference
+    tensorflow/__init__.py:474): deprecated in TF2 — eager mode raises
+    with the modern alternative."""
+    if _executing_eagerly():
+        raise RuntimeError(
+            "hvd.broadcast_global_variables() does not support eager "
+            "execution. Please use `hvd.broadcast_variables(<model/"
+            "optimizer variables>)` instead.")
+    import tensorflow.compat.v1 as tf1
+    return broadcast_variables(tf1.global_variables(), root_rank)

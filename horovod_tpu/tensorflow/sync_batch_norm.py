@@ -16,6 +16,10 @@ class SyncBatchNormalization(tf.keras.layers.BatchNormalization):
     element count) so normalization matches one global batch."""
 
     def __init__(self, process_set=global_process_set, **kwargs):
+        # the reference forced fused=False (its class predates keras 3,
+        # which removed the kwarg); accept and drop it so ported
+        # constructor calls keep working
+        kwargs.pop("fused", None)
         super().__init__(**kwargs)
         self.process_set = process_set
 

@@ -266,3 +266,17 @@ from .basics import (  # noqa: F401,E402
     ccl_built, cuda_built, ddl_built, gloo_built, mpi_built,
     nccl_built, rocm_built,
 )
+
+
+def _cache(f):
+    """Memoize by positional+keyword args (reference util.py:114 —
+    imported by the reference's own tests)."""
+    cache = {}
+
+    def wrapper(*args, **kwargs):
+        key = (args, frozenset(kwargs.items()))
+        if key not in cache:
+            cache[key] = f(*args, **kwargs)
+        return cache[key]
+
+    return wrapper

@@ -483,6 +483,11 @@ class Engine:
                     continue
                 work = self._collect_ready_locked()
                 self._check_stalls_locked()
+            if self.timeline is not None and work:
+                # reference timeline.cc MarkCycleStart: one instant
+                # marker per negotiation cycle that produced work
+                # (HOROVOD_TIMELINE_MARK_CYCLES)
+                self.timeline.mark_cycle()
             if self.multiproc:
                 self._store_cycle(work)
             else:

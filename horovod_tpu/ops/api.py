@@ -76,6 +76,18 @@ def _submit(request, payloads, names):
     return eng.submit(sub)
 
 
+def _wire_name(ctx, op_type, name):
+    """Reference wire-name rule in ONE place: explicit names become
+    ``<optype>.<name>`` (torch/mpi_ops.py:129), auto names come from
+    the per-rank counter (already prefixed).  An already-prefixed name
+    passes through so helper layers can pre-name tensors."""
+    if not name:
+        return ctx.next_name(op_type)
+    if name.startswith(f"{op_type}."):
+        return name
+    return f"{op_type}.{name}"
+
+
 def _check_scale(dtype, prescale_factor, postscale_factor):
     """Integer tensors scale with the reference's semantics — factor
     applied in FP64, truncating cast back (xla_ops _build_allreduce
@@ -94,7 +106,7 @@ def allreduce_async(tensor, average=None, name=None, op=None,
     ctx = basics.context()
     op = _resolve_op(op, average, arr.dtype)
     _check_scale(arr.dtype, prescale_factor, postscale_factor)
-    name = name or ctx.next_name("allreduce")
+    name = _wire_name(ctx, "allreduce", name)
     req = Request(
         request_type=RequestType.ALLREDUCE, tensor_name=name, rank=ctx.rank,
         dtype=normalize_dtype(arr.dtype), shape=tuple(arr.shape),
@@ -187,7 +199,7 @@ def grouped_allreduce_async(tensors, average=None, name=None, op=None,
     arrs = [p[0] for p in pairs]
     kinds = [p[1] for p in pairs]
     ctx = basics.context()
-    base = name or ctx.next_name("grouped_allreduce")
+    base = _wire_name(ctx, "grouped_allreduce", name)
 
     by_dtype = {}
     for i, a in enumerate(arrs):
@@ -270,7 +282,7 @@ def allgather_async(tensor, name=None, process_set=global_process_set):
     if arr.ndim == 0:
         arr = arr.reshape(1)
     ctx = basics.context()
-    name = name or ctx.next_name("allgather")
+    name = _wire_name(ctx, "allgather", name)
     req = Request(
         request_type=RequestType.ALLGATHER, tensor_name=name, rank=ctx.rank,
         dtype=normalize_dtype(arr.dtype), shape=tuple(arr.shape),
@@ -298,7 +310,7 @@ def grouped_allgather_async(tensors, name=None,
         raise ValueError(
             f"grouped_allgather requires matching dtypes, got {dtypes}")
     ctx = basics.context()
-    base = name or ctx.next_name("grouped_allgather")
+    base = _wire_name(ctx, "grouped_allgather", name)
     names = [f"{base}.{i}" for i in range(len(arrs))]
     req = Request(
         request_type=RequestType.ALLGATHER, tensor_name=base, rank=ctx.rank,
@@ -322,7 +334,7 @@ def broadcast_async(tensor, root_rank, name=None,
                     process_set=global_process_set):
     arr, kind = util.to_numpy(tensor)
     ctx = basics.context()
-    name = name or ctx.next_name("broadcast")
+    name = _wire_name(ctx, "broadcast", name)
     req = Request(
         request_type=RequestType.BROADCAST, tensor_name=name, rank=ctx.rank,
         dtype=normalize_dtype(arr.dtype), shape=tuple(arr.shape),
@@ -380,7 +392,7 @@ def alltoall_async(tensor, splits=None, name=None,
             f"alltoall splits sum to {sum(splits_t)} but the "
             f"tensor's first dimension is {arr.shape[0]}")
     ctx = basics.context()
-    name = name or ctx.next_name("alltoall")
+    name = _wire_name(ctx, "alltoall", name)
     req = Request(
         request_type=RequestType.ALLTOALL, tensor_name=name, rank=ctx.rank,
         dtype=normalize_dtype(arr.dtype), shape=tuple(arr.shape),
@@ -409,7 +421,7 @@ def reducescatter_async(tensor, op=Average, name=None,
     ctx = basics.context()
     op = _resolve_op(op, None, arr.dtype)
     _check_scale(arr.dtype, prescale_factor, postscale_factor)
-    name = name or ctx.next_name("reducescatter")
+    name = _wire_name(ctx, "reducescatter", name)
     req = Request(
         request_type=RequestType.REDUCESCATTER, tensor_name=name,
         rank=ctx.rank, dtype=normalize_dtype(arr.dtype),
@@ -448,7 +460,7 @@ def grouped_reducescatter_async(tensors, op=Average, name=None,
     ctx = basics.context()
     op = _resolve_op(op, None, arrs[0].dtype)
     _check_scale(arrs[0].dtype, prescale_factor, postscale_factor)
-    base = name or ctx.next_name("grouped_reducescatter")
+    base = _wire_name(ctx, "grouped_reducescatter", name)
     names = [f"{base}.{i}" for i in range(len(arrs))]
     req = Request(
         request_type=RequestType.REDUCESCATTER, tensor_name=base,

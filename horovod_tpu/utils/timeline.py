@@ -120,7 +120,9 @@ class Timeline:
 
     def mark_cycle(self):
         if self.mark_cycles:
-            self._emit("CYCLE", "i", 0, self._ts())
+            # reference marker name (timeline.cc MarkCycleStart; its
+            # own test asserts the exact string)
+            self._emit("CYCLE_START", "i", 0, self._ts())
 
     def span(self, tensor_name, op_name):
         """Self-contained B/E pair on the tensor's own lane — safe

@@ -640,8 +640,12 @@ EIGHT_WAY_WORKER = textwrap.dedent("""
                 # park until the discovery-driven scale-up lands, so
                 # the size-8 phase cannot be raced away by a slow
                 # driver restart on a loaded box; identical condition
-                # on every rank (batch/saw_eight are synced state)
+                # on every rank (batch/saw_eight are synced state).
+                # commit() IS the sync point where the host-update
+                # interrupt fires — a bare sleep would never join the
+                # new round and the job would deadlock
                 time.sleep(0.2)
+                state.commit()
                 continue
             if (hvd.size() == 8 and state.saw_eight >= 2
                     and os.environ["HOROVOD_HOSTNAME"] == "127.0.0.1"

@@ -55,7 +55,14 @@ def main():
 
     os.environ["HOROVOD_TPU_PLATFORM"] = "cpu"
     import jax
-    jax.config.update("jax_num_cpu_devices", max(args.np, 2))
+    try:
+        jax.config.update("jax_num_cpu_devices", max(args.np, 2))
+    except AttributeError:
+        # older jax: partition the host platform via XLA_FLAGS
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") +
+            f" --xla_force_host_platform_device_count="
+            f"{max(args.np, 2)}").strip()
 
     import numpy as np
     import horovod_tpu as hvd

@@ -87,6 +87,64 @@ def worker(sizes_mb, small_count, iters):
     return out
 
 
+def wire_sweep(iters, wire_dtype="all", mb=8):
+    """Quantized-wire section: the same logical payload through every
+    wire format, on BOTH reduction paths.  Reports per dtype:
+
+    * ``*_MBps`` — logical goodput (gradient MB averaged per second;
+      the autotuner's score, core/autotune.py);
+    * ``*_wire_bytes`` — what the encoding actually puts on the
+      interconnect per rank (int8 = codes + one bf16 scale per
+      256-element block, ~3.97x under f32);
+    * ``wire_reduction_vs_f32`` — the featured dtype's byte ratio.
+
+    All three dtypes always run (the reduction ratio needs the f32
+    baseline); ``--wire-dtype`` picks which one the summary keys
+    feature."""
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics
+
+    out = {}
+    n = int(mb * (1 << 20) / 4)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal(n).astype(np.float32)
+    eng = basics.engine()
+    for wire in (None, "bf16", "int8"):
+        name = wire or "f32"
+        hvd.allreduce(x, op=hvd.Sum, name=f"wire.w.{name}",
+                      wire_dtype=wire)
+        a0, l0 = eng.actual_wire_bytes, eng.logical_wire_bytes
+        t0 = time.perf_counter()
+        for i in range(iters):
+            hvd.allreduce(x, op=hvd.Sum, name=f"wire.{name}.{i % 2}",
+                          wire_dtype=wire)
+        dt = time.perf_counter() - t0
+        out[f"wire_{name}_engine_MBps"] = round(mb * iters / dt, 1)
+        out[f"wire_{name}_engine_wire_bytes"] = \
+            (eng.actual_wire_bytes - a0) // iters
+        out[f"wire_{name}_logical_bytes"] = \
+            (eng.logical_wire_bytes - l0) // iters
+
+        red = hvd.CompiledGroupedAllreduce(
+            op=hvd.Sum, name=f"wire.c.{name}", force_program=True,
+            wire_dtype=wire)
+        red([x])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            red([x])
+        dt = time.perf_counter() - t0
+        out[f"wire_{name}_compiled_MBps"] = round(mb * iters / dt, 1)
+        out[f"wire_{name}_compiled_wire_bytes"] = red.last_wire_bytes
+
+    featured = "int8" if wire_dtype == "all" else wire_dtype
+    out["wire_dtype"] = featured
+    out["wire_reduction_vs_f32"] = round(
+        out["wire_f32_engine_wire_bytes"]
+        / out[f"wire_{featured}_engine_wire_bytes"], 2)
+    return out
+
+
 def proc_worker(small_count, iters):
     """Runs inside one launcher-spawned process: the store-controller
     (coordinator) negotiation path the thread launcher bypasses."""
@@ -207,6 +265,12 @@ def main():
     p.add_argument("--sizes-mb", default="1,16,64")
     p.add_argument("--small-count", type=int, default=64)
     p.add_argument("--iters", type=int, default=5)
+    p.add_argument("--wire-dtype", default=None,
+                   choices=["f32", "bf16", "int8", "all"],
+                   help="run the quantized-wire sweep (engine + "
+                        "compiled paths, all three dtypes measured; "
+                        "the chosen dtype is featured in "
+                        "wire_reduction_vs_f32)")
     p.add_argument("--proc-curve", default=None,
                    help="comma list of process counts, e.g. 1,2,4,8: "
                         "run the REAL launcher + coordinator at each "
@@ -220,18 +284,32 @@ def main():
 
     if args.cpu:
         os.environ["HOROVOD_TPU_PLATFORM"] = "cpu"
+        os.environ.setdefault(
+            "XLA_FLAGS",
+            f"--xla_force_host_platform_device_count={max(args.np, 2)}")
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
         import jax
-        jax.config.update("jax_num_cpu_devices", max(args.np, 2))
+        try:
+            jax.config.update("jax_num_cpu_devices", max(args.np, 2))
+        except AttributeError:
+            # older jax: the XLA_FLAGS partitioning above is the only
+            # way to get virtual CPU devices (tests/conftest.py note)
+            pass
 
     import horovod_tpu as hvd
 
     sizes = [int(s) for s in args.sizes_mb.split(",")]
+
+    def body():
+        if args.wire_dtype:
+            return wire_sweep(args.iters, args.wire_dtype)
+        return worker(sizes, args.small_count, args.iters)
+
     if args.np == 1:
         hvd.init(num_ranks=1)
-        res = worker(sizes, args.small_count, args.iters)
+        res = body()
     else:
-        res = hvd.run(lambda: worker(sizes, args.small_count,
-                                     args.iters), np=args.np)[0]
+        res = hvd.run(body, np=args.np)[0]
     res["np"] = args.np
     print(json.dumps(res))
 

@@ -52,11 +52,19 @@ def main(argv=None):
     args = parse_args(argv)
     args.warmup = max(args.warmup, 1)   # the loops bind `loss`
 
+    import os
     import jax
     if args.virtual:
         # must precede any backend use
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.virtual)
+        try:
+            jax.config.update("jax_num_cpu_devices", args.virtual)
+        except AttributeError:
+            # older jax: partition the host platform via XLA_FLAGS
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "") +
+                f" --xla_force_host_platform_device_count="
+                f"{args.virtual}").strip()
     import jax.numpy as jnp
     import numpy as np
     import optax

@@ -18,18 +18,25 @@ cd "$(dirname "$0")"
 # Split used by 'all': the full suite in one pytest invocation
 # exceeds a 10-minute cap on CI runners.  Four groups (was two — the
 # integration half drifted toward the cap as tests accumulated) keep
-# every invocation comfortably under it.
+# every invocation comfortably under it.  The quantized-wire tests
+# ride the files that own their layer: codec kernels in
+# test_pallas.py (PART4 — moved off PART2 when the wire matrix, the
+# int8 frontends and the EF-convergence LM grew PART2's op-matrix/
+# tensorflow/torch suites), the wire x op x path matrix + error-
+# feedback convergence in test_op_matrix.py, frontend wiring in
+# test_torch.py / test_tensorflow.py.
 PART1="tests/test_autotune.py tests/test_aux.py tests/test_basics.py \
   tests/test_collectives.py tests/test_compiled.py \
   tests/test_conv_bn_fusion.py tests/test_integrations.py \
   tests/test_jax_frontend.py tests/test_lightning.py \
   tests/test_models.py tests/test_mxnet_fake.py tests/test_native.py"
 PART2="tests/test_elastic.py tests/test_examples.py \
-  tests/test_op_matrix.py tests/test_pallas.py \
+  tests/test_op_matrix.py \
   tests/test_ray_strategy.py tests/test_spark_streaming.py \
   tests/test_tensorflow.py"
 PART3="tests/test_parallel.py tests/test_torch.py"
-PART4="tests/test_api_parity.py tests/test_runner.py"
+PART4="tests/test_api_parity.py tests/test_pallas.py \
+  tests/test_runner.py"
 
 case "${1:-all}" in
   fast)

@@ -29,7 +29,15 @@ if args.cpu_devices:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+    try:
+        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+    except AttributeError:
+        # older jax: partition the host platform via XLA_FLAGS (must
+        # land before the backends initialize)
+        _os.environ["XLA_FLAGS"] = (
+            _os.environ.get("XLA_FLAGS", "") +
+            f" --xla_force_host_platform_device_count="
+            f"{args.cpu_devices}").strip()
 
 import jax.numpy as jnp
 import numpy as np

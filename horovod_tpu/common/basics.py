@@ -40,7 +40,21 @@ def _apply_platform_env(jax):
             jax.config.update("jax_platforms", plat)
         ncpu = os.environ.get("JAX_NUM_CPU_DEVICES")
         if ncpu:
-            jax.config.update("jax_num_cpu_devices", int(ncpu))
+            try:
+                jax.config.update("jax_num_cpu_devices", int(ncpu))
+            except AttributeError:
+                # older jax spells CPU-device partitioning only as an
+                # XLA flag; an inherited flag (e.g. a parent test
+                # process forcing 8 devices) must be OVERRIDDEN, not
+                # appended to — the launcher's count is the contract
+                flags = os.environ.get("XLA_FLAGS", "")
+                flags = " ".join(
+                    f for f in flags.split()
+                    if not f.startswith(
+                        "--xla_force_host_platform_device_count"))
+                os.environ["XLA_FLAGS"] = (
+                    flags + f" --xla_force_host_platform_device_count="
+                    f"{int(ncpu)}").strip()
     except Exception:  # noqa: BLE001 — best effort: private API moved,
         # config absent on this jax version, or malformed env value;
         # init proceeds with whatever jax resolves from env alone
@@ -168,6 +182,19 @@ def init(comm=None, process_sets=None, num_ranks=None, devices=None):
                 coordinator = env_mod.get_str(
                     env_mod.HOROVOD_TPU_COORDINATOR)
             if num_procs > 1 and coordinator:
+                # the TFRT CPU client can't launch cross-process
+                # computations without a collectives transport; jax's
+                # gloo implementation (when this jax has it) makes the
+                # virtual CPU mesh behave like a real multi-host TPU
+                # slice.  Must be set before the backends initialize.
+                try:
+                    if jax.config.jax_platforms in ("cpu", None) or \
+                            env_mod.get_str(
+                                env_mod.HOROVOD_TPU_PLATFORM) == "cpu":
+                        jax.config.update(
+                            "jax_cpu_collectives_implementation", "gloo")
+                except Exception:  # pragma: no cover - option missing
+                    pass
                 jax.distributed.initialize(
                     coordinator_address=coordinator,
                     num_processes=num_procs, process_id=proc_id,

@@ -45,6 +45,7 @@ HOROVOD_CONTROLLER = "HOROVOD_CONTROLLER"
 HOROVOD_CPU_OPERATIONS = "HOROVOD_CPU_OPERATIONS"
 
 # TPU-native additions
+HOROVOD_WIRE_DTYPE = "HOROVOD_WIRE_DTYPE"      # f32 | fp16 | bf16 | int8
 HOROVOD_TPU_PLATFORM = "HOROVOD_TPU_PLATFORM"  # jax platform for the mesh
 HOROVOD_TPU_RANKS_PER_PROC = "HOROVOD_TPU_RANKS_PER_PROC"
 HOROVOD_TPU_COORDINATOR = "HOROVOD_TPU_COORDINATOR"
@@ -105,6 +106,12 @@ class Config:
         self.pack_mt_threshold_bytes = get_int(
             "HOROVOD_TPU_PACK_MT_THRESHOLD", 8 << 20)
         self.cache_capacity = get_int(HOROVOD_CACHE_CAPACITY, DEFAULT_CACHE_CAPACITY)
+        # default wire format for float allreduce/reducescatter payloads
+        # (per-request wire_dtype overrides; autotune sweeps this as its
+        # fifth dimension).  None = full-width tensor dtype.
+        from ..ops.quantize import normalize_wire_dtype
+        self.wire_dtype = normalize_wire_dtype(
+            get_str(HOROVOD_WIRE_DTYPE))
         self.timeline_filename = get_str(HOROVOD_TIMELINE)
         if self.timeline_filename == "DYNAMIC":
             # reference sentinel (test_torch.py:54): timeline support

@@ -3,13 +3,19 @@
 sample windows, warmup discard, Bayesian optimization over tunables,
 CSV log via HOROVOD_AUTOTUNE_LOG, converge-to-best after max samples).
 
-Tunables here are the four that exist on the TPU engine: the fusion
+Tunables here are the five that exist on the TPU engine: the fusion
 threshold (bucket size for packed allreduces), the cycle time (how
 long the background thread batches submissions), the
 multithreaded-pack threshold (bucket size above which the native pack
-fans out across threads), and the coordinator response-cache capacity
+fans out across threads), the coordinator response-cache capacity
 (the reference tunes cache on/off, parameter_manager.h:65; here the
-LRU size tunes smoothly with 0 = disabled).  The reference's
+LRU size tunes smoothly with 0 = disabled), and the WIRE DTYPE
+(f32 / bf16 / block-scaled int8, ops/quantize.py).  The score is
+LOGICAL bytes/sec — gradient goodput — so shrinking the wire payload
+raises the score exactly when the interconnect, not the chip, is the
+bottleneck: that is how the parameter manager learns to turn
+quantization on for network-bound jobs and leave it off when encode
+overhead outweighs the saved bytes.  The reference's
 hierarchical/torus toggles have no analogue — topology-aware routing
 belongs to XLA.
 """
@@ -19,6 +25,7 @@ import time
 import numpy as np
 
 from .optim import BayesianOptimizer
+from ..ops.quantize import WIRE_CHOICES
 
 # log2 bounds: fusion threshold 1 MiB .. 256 MiB, cycle 0.5 .. 32 ms,
 # MT-pack threshold 1 MiB .. 64 MiB, cache capacity 0 .. 4096 entries
@@ -30,13 +37,20 @@ _CACHE_BITS = 12.0
 
 class ParameterManager:
     def __init__(self, config, warmup_samples=3, steps_per_sample=10,
-                 max_samples=20, log_path=None, seed=0):
+                 max_samples=20, log_path=None, seed=0, tune_wire=True):
         self.config = config
         self.warmup_samples = warmup_samples
         self.steps_per_sample = steps_per_sample
         self.max_samples = max_samples
         self.active = True
-        self._bo = BayesianOptimizer(dims=4, seed=seed)
+        # tune_wire=False drops the wire-dtype dimension entirely (4-dim
+        # BO): the coordinator-side autotuner (runner/http/http_server)
+        # has no consumer for a tuned wire format, and sweeping a
+        # dimension nothing applies would waste samples and write
+        # never-applied wire dtypes into the CSV
+        self.tune_wire = bool(tune_wire)
+        self._bo = BayesianOptimizer(dims=5 if self.tune_wire else 4,
+                                     seed=seed)
         self._samples = 0
         self._steps = 0
         self._bytes = 0
@@ -44,20 +58,22 @@ class ParameterManager:
         self._current = self._encode(
             config.fusion_threshold_bytes, config.cycle_time_ms,
             getattr(config, "pack_mt_threshold_bytes", 8 << 20),
-            getattr(config, "cache_capacity", 1024))
+            getattr(config, "cache_capacity", 1024),
+            getattr(config, "wire_dtype", None))
         self._best_score = -np.inf
         self._best = self._current
         self._log = open(log_path, "w") if log_path else None
         if self._log:
+            wire_col = "wire_dtype," if self.tune_wire else ""
             self._log.write(
                 "sample,fusion_threshold_bytes,cycle_time_ms,"
-                "pack_mt_threshold_bytes,cache_capacity,"
+                f"pack_mt_threshold_bytes,cache_capacity,{wire_col}"
                 "score_bytes_per_sec\n")
 
     # -- encoding ------------------------------------------------------------
 
-    @staticmethod
-    def _encode(fusion_bytes, cycle_ms, pack_mt_bytes, cache_capacity):
+    def _encode(self, fusion_bytes, cycle_ms, pack_mt_bytes,
+                cache_capacity, wire_dtype=None):
         x0 = (np.log2(max(fusion_bytes, 1)) - _FUSION_LO) / \
             (_FUSION_HI - _FUSION_LO)
         x1 = (np.log2(max(cycle_ms, 2 ** _CYCLE_LO)) - _CYCLE_LO) / \
@@ -65,17 +81,32 @@ class ParameterManager:
         x2 = (np.log2(max(pack_mt_bytes, 1)) - _PACKMT_LO) / \
             (_PACKMT_HI - _PACKMT_LO)
         x3 = np.log2(cache_capacity + 1) / _CACHE_BITS
-        return np.clip([x0, x1, x2, x3], 0.0, 1.0)
+        if not self.tune_wire:
+            return np.clip([x0, x1, x2, x3], 0.0, 1.0)
+        # fifth dimension: wire dtype as a categorical grid over [0, 1]
+        # (WIRE_CHOICES at bin centers — the BO's continuous
+        # suggestion snaps to the nearest bin in _decode); an explicit
+        # 'f32' default encodes as the full-width bin
+        try:
+            wi = WIRE_CHOICES.index(
+                None if wire_dtype == "f32" else wire_dtype)
+        except ValueError:
+            wi = 0
+        x4 = (wi + 0.5) / len(WIRE_CHOICES)
+        return np.clip([x0, x1, x2, x3, x4], 0.0, 1.0)
 
-    @staticmethod
-    def _decode(x):
+    def _decode(self, x):
         fusion = int(2 ** (_FUSION_LO + x[0] * (_FUSION_HI - _FUSION_LO)))
         cycle = float(2 ** (_CYCLE_LO + x[1] * (_CYCLE_HI - _CYCLE_LO)))
         pack_mt = int(2 ** (_PACKMT_LO + x[2] * (_PACKMT_HI - _PACKMT_LO)))
         # capacity 0 (cache off) is reachable at the low end — the
         # reference's cache-enabled toggle as the floor of a smooth dim
         cache = int(round(2 ** (x[3] * _CACHE_BITS))) - 1
-        return fusion, cycle, pack_mt, cache
+        if not self.tune_wire:
+            return fusion, cycle, pack_mt, cache
+        wi = min(int(x[4] * len(WIRE_CHOICES)), len(WIRE_CHOICES) - 1)
+        wire = WIRE_CHOICES[wi]
+        return fusion, cycle, pack_mt, cache, wire
 
     # -- recording (engine hot path) ----------------------------------------
 
@@ -96,10 +127,12 @@ class ParameterManager:
         score = self._bytes / elapsed
         self._samples += 1
         if self._log:
-            fusion, cycle, pack_mt, cache = self._decode(self._current)
+            decoded = self._decode(self._current)
+            fusion, cycle, pack_mt, cache = decoded[:4]
+            wire_col = f"{decoded[4] or 'f32'}," if self.tune_wire else ""
             self._log.write(
                 f"{self._samples},{fusion},{cycle:.3f},{pack_mt},"
-                f"{cache},{score:.1f}\n")
+                f"{cache},{wire_col}{score:.1f}\n")
             self._log.flush()
         if self._samples > self.warmup_samples:
             self._bo.observe(self._current, score)
@@ -119,11 +152,14 @@ class ParameterManager:
         self._t0 = None
 
     def _apply(self, x):
-        fusion, cycle, pack_mt, cache = self._decode(x)
+        decoded = self._decode(x)
+        fusion, cycle, pack_mt, cache = decoded[:4]
         self.config.fusion_threshold_bytes = fusion
         self.config.cycle_time_ms = cycle
         self.config.pack_mt_threshold_bytes = pack_mt
         self.config.cache_capacity = cache
+        if self.tune_wire:
+            self.config.wire_dtype = decoded[4]
 
     def best_parameters(self):
         return self._decode(self._best)

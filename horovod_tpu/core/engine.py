@@ -63,12 +63,17 @@ class NegotiationEntry:
     """Readiness table row (reference controller.cc:1115-1140
     IncrementTensorCount)."""
 
-    __slots__ = ("key", "subs", "first_time")
+    __slots__ = ("key", "subs", "first_time", "wire_default")
 
     def __init__(self, key):
         self.key = key
         self.subs: Dict[int, Submission] = {}
         self.first_time = time.monotonic()
+        # process-wide wire default LATCHED when the first local rank
+        # arrives, so an autotune sweep flipping config.wire_dtype
+        # between two ranks' submits of the same tensor cannot split
+        # one negotiation across two wire formats
+        self.wire_default = None
 
 
 class ProcessSetState:
@@ -164,6 +169,14 @@ class Engine:
         self._stall_warned = set()
         #: fused-allgather buckets executed (observability + tests)
         self.fused_allgather_runs = 0
+        #: wire accounting (observability + collective_bench): logical
+        #: bytes = the full-width payload a rank handed in; wire bytes
+        #: = what its encoding actually puts on the interconnect
+        #: (int8 codes + bf16 scales for the quantized wire)
+        self.logical_wire_bytes = 0
+        self.actual_wire_bytes = 0
+        #: quantized (int8-wire) buckets executed
+        self.quantized_bucket_runs = 0
         #: hold_cycles() depth — while >0 the loop parks (no dispatch)
         self._hold_depth = 0
         self._thread = threading.Thread(
@@ -212,9 +225,36 @@ class Engine:
             if self.ranks_of_proc:
                 return [self._device_of_rank(r) for r in ranks]
             # one device per global rank; self.devices is the global
-            # device list (jax.devices() after jax.distributed init)
+            # device list (jax.devices() after jax.distributed init).
+            # A process can expose MORE devices than the ranks it
+            # hosts (a forced multi-device host platform): rank r then
+            # lives on the (r % num_local)'th device OF ITS OWN
+            # process — flat indexing would cross process boundaries
+            # and stage rows onto non-addressable devices.
+            per = self._uniform_proc_devices()
+            if per is not None:
+                return [per[r // self.num_local][r % self.num_local]
+                        for r in ranks]
             return [self.devices[r] for r in ranks]
         return [self.devices[r % nd] for r in ranks]
+
+    def _uniform_proc_devices(self):
+        """Per-process device groups for the uniform layout, or None
+        when the global device view doesn't match one-process-per-
+        num_local-ranks (then the flat table is the only contract)."""
+        per = getattr(self, "_per_proc_uniform", False)
+        if per is False:
+            grouped = {}
+            for d in self.devices:
+                grouped.setdefault(getattr(d, "process_index", 0),
+                                   []).append(d)
+            per = [grouped[k] for k in sorted(grouped)]
+            nprocs = -(-self.global_size // self.num_local)
+            if len(per) != nprocs \
+                    or any(len(g) < self.num_local for g in per):
+                per = None
+            self._per_proc_uniform = per
+        return per
 
     def _device_of_rank(self, global_rank):
         """Heterogeneous layouts: rank r of process p uses p's
@@ -398,7 +438,22 @@ class Engine:
                 return sub.handle
             if entry is None:
                 entry = NegotiationEntry(key)
+                entry.wire_default = self.config.wire_dtype
                 ps.pending[key] = entry
+            req = sub.request
+            if (req.wire_dtype is None and entry.wire_default
+                    and req.request_type in (RequestType.ALLREDUCE,
+                                             RequestType.REDUCESCATTER)
+                    and req.reduce_op in (ReduceOp.SUM,
+                                          ReduceOp.AVERAGE)):
+                # resolve the (entry-latched) process-wide default INTO
+                # the request before negotiation: every local rank of
+                # this negotiation sees one default even if autotune
+                # flips config.wire_dtype mid-submit, while processes
+                # whose configs genuinely diverge (env drift) fail the
+                # cross-rank wire check loudly instead of executing
+                # different collective programs against each other
+                req.wire_dtype = entry.wire_default
             if sub.rank in entry.subs:
                 sub.handle.set_error(DuplicateNameError(
                     f"tensor {sub.names} submitted twice by rank "
@@ -608,6 +663,7 @@ class Engine:
             "op": int(req.reduce_op),
             "pre": req.prescale_factor,
             "post": req.postscale_factor,
+            "wire": req.wire_dtype,
             "ps": ps.id,
             "nbytes": nbytes,
             "nprocs": nprocs,
@@ -764,7 +820,7 @@ class Engine:
             rank=-1, dtype=meta["dtype"], shape=tuple(meta["shape"]),
             reduce_op=ReduceOp(meta["op"]),
             prescale_factor=meta["pre"], postscale_factor=meta["post"],
-            process_set_id=meta["ps"])
+            process_set_id=meta["ps"], wire_dtype=meta.get("wire"))
         dtype = np.dtype(meta["dtype"]) if meta["dtype"] != "bfloat16" \
             else _bfloat16_dtype()
         sub = Submission(rank=-1, request=req, names=[key],
@@ -825,6 +881,11 @@ class Engine:
                     or r.postscale_factor != first.postscale_factor):
                 return TensorShapeMismatchError(
                     f"Mismatched prescale/postscale for {first.tensor_name}")
+            if r.wire_dtype != first.wire_dtype:
+                return TensorShapeMismatchError(
+                    f"Mismatched wire dtypes for {first.tensor_name}: "
+                    f"rank {sub.rank} sent {r.wire_dtype}, rank "
+                    f"{subs[0].rank} sent {first.wire_dtype}")
             if rt == RequestType.BROADCAST and r.root_rank != first.root_rank:
                 return TensorShapeMismatchError(
                     f"Mismatched broadcast root for {first.tensor_name}: "
@@ -893,10 +954,14 @@ class Engine:
             first = next(iter(entry.subs.values()))
             rt = first.request.request_type
             if rt in (RequestType.ALLREDUCE, RequestType.ADASUM):
+                # wire dtype is part of the bucket signature: quantized
+                # (int8) payloads pack contiguously with each other and
+                # never share a fusion buffer with full-width tensors
                 sig = (rt, first.request.dtype,
                        first.request.reduce_op,
                        first.request.prescale_factor,
-                       first.request.postscale_factor)
+                       first.request.postscale_factor,
+                       first.request.wire_dtype)
                 nbytes = sum(p.nbytes for p in first.payloads)
             elif rt == RequestType.ALLGATHER:
                 sig = (rt, first.request.dtype)
@@ -1004,8 +1069,8 @@ class Engine:
                     native.pack_mt(arrays, buf, offs_bytes)
                 else:
                     native.pack(arrays, buf, offs_bytes)
-            results = ps.executor.allreduce(
-                rows, op, first.prescale_factor, first.postscale_factor)
+            results = self._dispatch_allreduce(ps, first, op, dtype,
+                                               rows, total)
         finally:
             # a pack/collective failure must not leak slabs — the
             # engine survives bucket errors (_execute_batch catches)
@@ -1026,6 +1091,72 @@ class Engine:
                 outs = per_entry[(id(entry), r)]
                 sub.handle.set_result(
                     outs if len(sub.payloads) > 1 else outs[0])
+
+    def _wire_for(self, req, dtype, op):
+        """Effective wire format for a float reduction.  The process-
+        wide default (HOROVOD_WIRE_DTYPE / autotune) was already
+        resolved into the request at submit() — before negotiation —
+        so this is a pure function of the cross-rank-validated request.
+        'f32' is the explicit full-width override.  Non-float payloads
+        and non-linear reductions (min/max/product/adasum — their math
+        does not commute with per-rank decode) ship full width, as do
+        combinations where the "compression" would not shrink the wire
+        (bf16 wire for an already-16-bit tensor)."""
+        if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+            return None
+        if not (np.issubdtype(dtype, np.floating)
+                or str(dtype) == "bfloat16"):
+            return None
+        wire = req.wire_dtype
+        if wire == "f32":
+            return None
+        if wire in ("fp16", "bf16") and dtype.itemsize <= 2:
+            return None
+        return wire
+
+    def _account_wire(self, logical, actual):
+        self.logical_wire_bytes += int(logical)
+        self.actual_wire_bytes += int(actual)
+
+    def _encode_int8_rows(self, rows, logical_nbytes):
+        """Block-quantize per-rank rows for the int8 wire (shared by
+        the allreduce and reducescatter paths) and account the actual
+        bytes: int8 codes + bf16 scales, the codec's 2 B/block."""
+        from ..ops import quantize as qz
+        q_rows, s_rows = [], []
+        for r in rows:
+            q, s, _ = qz.np_quantize_blockwise(r)
+            q_rows.append(q)
+            s_rows.append(s)
+        self._account_wire(logical_nbytes,
+                           q_rows[0].nbytes + s_rows[0].nbytes)
+        self.quantized_bucket_runs += 1
+        return q_rows, s_rows
+
+    def _dispatch_allreduce(self, ps, req, op, dtype, rows, total):
+        """Run the fused allreduce over the configured wire format:
+        full width, 16-bit cast, or block-scaled int8 (encode ->
+        quantized collective -> f32 decode).  The tentpole wire
+        optimization of this engine path."""
+        wire = self._wire_for(req, dtype, op)
+        itemsize = dtype.itemsize
+        if wire is None:
+            self._account_wire(total * itemsize, total * itemsize)
+            return ps.executor.allreduce(
+                rows, op, req.prescale_factor, req.postscale_factor)
+        if wire in ("fp16", "bf16"):
+            wdt = np.dtype(np.float16) if wire == "fp16" \
+                else _bfloat16_dtype()
+            self._account_wire(total * itemsize, total * 2)
+            out = ps.executor.allreduce(
+                [r.astype(wdt) for r in rows], op,
+                req.prescale_factor, req.postscale_factor)
+            return [o.astype(dtype) for o in out]
+        q_rows, s_rows = self._encode_int8_rows(rows, total * itemsize)
+        out = ps.executor.allreduce_quantized(
+            q_rows, s_rows, op, req.prescale_factor,
+            req.postscale_factor)
+        return [o[:total].astype(dtype) for o in out]
 
     def _global_dim0s(self, ps, entry, aux, n_tensors):
         """Global per-rank first-dim table for allgather.  Local mode
@@ -1224,9 +1355,36 @@ class Engine:
                     buf[dst:dst + chunks[j] * rest_n] = \
                         flat[src:src + chunks[j] * rest_n]
                 rows.append(buf)
-            results = ps.executor.reducescatter(
-                rows, d0, rest, op, req.prescale_factor,
-                req.postscale_factor)
+            wire = self._wire_for(req, np.dtype(rows[0].dtype), op)
+            if wire == "int8":
+                dtype = rows[0].dtype
+                q_rows, s_rows = self._encode_int8_rows(
+                    rows, rows[0].nbytes)
+                results = [
+                    res.astype(dtype)
+                    for res in ps.executor.reducescatter_quantized(
+                        q_rows, s_rows, d0, rest, op,
+                        req.prescale_factor, req.postscale_factor)
+                ]
+            else:
+                if wire in ("fp16", "bf16"):
+                    dtype = rows[0].dtype
+                    wdt = np.dtype(np.float16) if wire == "fp16" \
+                        else _bfloat16_dtype()
+                    self._account_wire(rows[0].nbytes,
+                                       rows[0].size * 2)
+                    results = [
+                        res.astype(dtype)
+                        for res in ps.executor.reducescatter(
+                            [row.astype(wdt) for row in rows], d0,
+                            rest, op, req.prescale_factor,
+                            req.postscale_factor)
+                    ]
+                else:
+                    self._account_wire(rows[0].nbytes, rows[0].nbytes)
+                    results = ps.executor.reducescatter(
+                        rows, d0, rest, op, req.prescale_factor,
+                        req.postscale_factor)
             for r, res in zip(subs, results):
                 results_per_rank[r].append(res)
         for r, sub in subs.items():

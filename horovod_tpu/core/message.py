@@ -79,6 +79,11 @@ class Request:
     group_id: int = -1                   # grouped-op negotiation unit
     process_set_id: int = 0
     splits: Optional[Tuple[int, ...]] = None  # alltoall send splits
+    # wire compression for the payload of THIS collective:
+    # None (= tensor dtype) | 'fp16' | 'bf16' | 'int8' (block-scaled,
+    # ops/quantize.py).  Cross-rank validated like dtype — ranks
+    # disagreeing on the wire format would mis-decode each other.
+    wire_dtype: Optional[str] = None
     # grouped submissions: shape of EVERY member tensor, so cross-rank
     # validation covers members beyond the first (the reference issues
     # one Request per member inside the group instead)
@@ -100,6 +105,7 @@ class Request:
             "sp": list(self.splits) if self.splits is not None else None,
             "gs": [list(s) for s in self.group_shapes]
             if self.group_shapes is not None else None,
+            "w": self.wire_dtype,
         }
 
     @classmethod
@@ -119,6 +125,7 @@ class Request:
             splits=tuple(d["sp"]) if d["sp"] is not None else None,
             group_shapes=tuple(tuple(s) for s in d["gs"])
             if d.get("gs") is not None else None,
+            wire_dtype=d.get("w"),
         )
 
 

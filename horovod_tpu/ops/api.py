@@ -18,6 +18,7 @@ from ..core.message import (
     Average, Sum, Adasum, Min, Max, Product, ReduceOp, Request, RequestType,
     normalize_dtype,
 )
+from .quantize import normalize_wire_dtype
 
 __all__ = [
     "allreduce", "allreduce_async", "allreduce_", "allreduce_async_",
@@ -101,7 +102,7 @@ def _check_scale(dtype, prescale_factor, postscale_factor):
 
 def allreduce_async(tensor, average=None, name=None, op=None,
                     prescale_factor=1.0, postscale_factor=1.0,
-                    process_set=global_process_set):
+                    process_set=global_process_set, wire_dtype=None):
     arr, kind = util.to_numpy(tensor)
     ctx = basics.context()
     op = _resolve_op(op, average, arr.dtype)
@@ -111,7 +112,8 @@ def allreduce_async(tensor, average=None, name=None, op=None,
         request_type=RequestType.ALLREDUCE, tensor_name=name, rank=ctx.rank,
         dtype=normalize_dtype(arr.dtype), shape=tuple(arr.shape),
         reduce_op=op, prescale_factor=prescale_factor,
-        postscale_factor=postscale_factor, process_set_id=_ps_id(process_set))
+        postscale_factor=postscale_factor, process_set_id=_ps_id(process_set),
+        wire_dtype=normalize_wire_dtype(wire_dtype))
     h = _submit(req, [arr], [name])
     h.kind = kind
     return h
@@ -119,28 +121,28 @@ def allreduce_async(tensor, average=None, name=None, op=None,
 
 def allreduce(tensor, average=None, name=None, op=None,
               prescale_factor=1.0, postscale_factor=1.0,
-              process_set=global_process_set):
+              process_set=global_process_set, wire_dtype=None):
     h = allreduce_async(tensor, average, name, op, prescale_factor,
-                        postscale_factor, process_set)
+                        postscale_factor, process_set, wire_dtype)
     return synchronize(h)
 
 
 def allreduce_async_(tensor, average=None, name=None, op=None,
                      prescale_factor=1.0, postscale_factor=1.0,
-                     process_set=global_process_set):
+                     process_set=global_process_set, wire_dtype=None):
     """In-place variant: result is copied back into ``tensor`` when it
     is a mutable ndarray (reference allreduce_async_)."""
     h = allreduce_async(tensor, average, name, op, prescale_factor,
-                        postscale_factor, process_set)
+                        postscale_factor, process_set, wire_dtype)
     h.inplace_target = tensor if _mutable(tensor) else None
     return h
 
 
 def allreduce_(tensor, average=None, name=None, op=None,
                prescale_factor=1.0, postscale_factor=1.0,
-               process_set=global_process_set):
+               process_set=global_process_set, wire_dtype=None):
     h = allreduce_async_(tensor, average, name, op, prescale_factor,
-                         postscale_factor, process_set)
+                         postscale_factor, process_set, wire_dtype)
     return synchronize(h)
 
 
@@ -188,7 +190,8 @@ class _MultiHandle:
 
 def grouped_allreduce_async(tensors, average=None, name=None, op=None,
                             prescale_factor=1.0, postscale_factor=1.0,
-                            process_set=global_process_set):
+                            process_set=global_process_set,
+                            wire_dtype=None):
     """Grouped ops negotiate and execute as one unit (reference
     EnqueueTensorAllreduces, operations.cc:1408; group_table.h).
     Mixed-dtype groups partition into one fused submission per dtype
@@ -217,7 +220,8 @@ def grouped_allreduce_async(tensors, average=None, name=None, op=None,
             idxs = by_dtype[dt]
             sub = _grouped_allreduce_uniform(
                 [arrs[i] for i in idxs], average, f"{base}.{dt}", op,
-                prescale_factor, postscale_factor, process_set, ctx)
+                prescale_factor, postscale_factor, process_set, ctx,
+                wire_dtype)
             parts.append(sub)
             index_lists.append(idxs)
         h = _MultiHandle(parts, index_lists, len(arrs))
@@ -225,13 +229,14 @@ def grouped_allreduce_async(tensors, average=None, name=None, op=None,
         return h
     h = _grouped_allreduce_uniform(arrs, average, base, op,
                                    prescale_factor, postscale_factor,
-                                   process_set, ctx)
+                                   process_set, ctx, wire_dtype)
     h.kind = kinds
     return h
 
 
 def _grouped_allreduce_uniform(arrs, average, base, op, prescale_factor,
-                               postscale_factor, process_set, ctx):
+                               postscale_factor, process_set, ctx,
+                               wire_dtype=None):
     op = _resolve_op(op, average, arrs[0].dtype)
     _check_scale(arrs[0].dtype, prescale_factor, postscale_factor)
     names = [f"{base}.{i}" for i in range(len(arrs))]
@@ -241,7 +246,8 @@ def _grouped_allreduce_uniform(arrs, average, base, op, prescale_factor,
         shape=tuple(arrs[0].shape), reduce_op=op,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
         process_set_id=_ps_id(process_set), group_id=0,
-        group_shapes=tuple(tuple(a.shape) for a in arrs))
+        group_shapes=tuple(tuple(a.shape) for a in arrs),
+        wire_dtype=normalize_wire_dtype(wire_dtype))
     h = _submit(req, arrs, names)
     h.grouped = True
     return h
@@ -249,9 +255,9 @@ def _grouped_allreduce_uniform(arrs, average, base, op, prescale_factor,
 
 def grouped_allreduce(tensors, average=None, name=None, op=None,
                       prescale_factor=1.0, postscale_factor=1.0,
-                      process_set=global_process_set):
+                      process_set=global_process_set, wire_dtype=None):
     h = grouped_allreduce_async(tensors, average, name, op, prescale_factor,
-                                postscale_factor, process_set)
+                                postscale_factor, process_set, wire_dtype)
     return synchronize(h)
 
 
@@ -414,7 +420,7 @@ def alltoall(tensor, splits=None, name=None, process_set=global_process_set):
 
 def reducescatter_async(tensor, op=Average, name=None,
                         prescale_factor=1.0, postscale_factor=1.0,
-                        process_set=global_process_set):
+                        process_set=global_process_set, wire_dtype=None):
     arr, kind = util.to_numpy(tensor)
     if arr.ndim == 0:
         raise ValueError("reducescatter requires a tensor with >=1 dim")
@@ -427,21 +433,25 @@ def reducescatter_async(tensor, op=Average, name=None,
         rank=ctx.rank, dtype=normalize_dtype(arr.dtype),
         shape=tuple(arr.shape), reduce_op=op,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
-        process_set_id=_ps_id(process_set))
+        process_set_id=_ps_id(process_set),
+        wire_dtype=normalize_wire_dtype(wire_dtype))
     h = _submit(req, [arr], [name])
     h.kind = kind
     return h
 
 
 def reducescatter(tensor, op=Average, name=None, prescale_factor=1.0,
-                  postscale_factor=1.0, process_set=global_process_set):
+                  postscale_factor=1.0, process_set=global_process_set,
+                  wire_dtype=None):
     return synchronize(reducescatter_async(
-        tensor, op, name, prescale_factor, postscale_factor, process_set))
+        tensor, op, name, prescale_factor, postscale_factor, process_set,
+        wire_dtype))
 
 
 def grouped_reducescatter_async(tensors, op=Average, name=None,
                                 prescale_factor=1.0, postscale_factor=1.0,
-                                process_set=global_process_set):
+                                process_set=global_process_set,
+                                wire_dtype=None):
     """Jointly-negotiated grouped reducescatter (reference
     EnqueueTensorReducescatters + group_table joint readiness): one
     submission, one negotiated unit, one handle resolving to a list."""
@@ -468,7 +478,8 @@ def grouped_reducescatter_async(tensors, op=Average, name=None,
         shape=tuple(arrs[0].shape), reduce_op=op,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
         process_set_id=_ps_id(process_set), group_id=0,
-        group_shapes=tuple(tuple(a.shape) for a in arrs))
+        group_shapes=tuple(tuple(a.shape) for a in arrs),
+        wire_dtype=normalize_wire_dtype(wire_dtype))
     h = _submit(req, arrs, names)
     h.kind = kinds
     h.grouped = True
@@ -477,10 +488,11 @@ def grouped_reducescatter_async(tensors, op=Average, name=None,
 
 def grouped_reducescatter(tensors, op=Average, name=None,
                           prescale_factor=1.0, postscale_factor=1.0,
-                          process_set=global_process_set):
+                          process_set=global_process_set,
+                          wire_dtype=None):
     return synchronize(grouped_reducescatter_async(
         tensors, op, name, prescale_factor, postscale_factor,
-        process_set))
+        process_set, wire_dtype))
 
 
 # ----------------------------------------------------------------------------

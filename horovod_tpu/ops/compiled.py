@@ -48,6 +48,7 @@ from ..common import basics
 from ..common.process_sets import ProcessSet, global_process_set
 from ..core.message import Adasum, Average, ReduceOp, Sum
 from . import adasum as adasum_ops
+from . import quantize as quantize_mod
 from .xla_ops import shard_map, _is_float
 
 __all__ = [
@@ -272,7 +273,8 @@ class CompiledGroupedAllreduce:
 
     def __init__(self, op=Average, prescale_factor=1.0,
                  postscale_factor=1.0, process_set=global_process_set,
-                 name=None, force_program=False):
+                 name=None, force_program=False, wire_dtype=None,
+                 error_feedback=False):
         op = ReduceOp(op)
         if op not in (Average, Sum):
             raise ValueError(
@@ -286,6 +288,29 @@ class CompiledGroupedAllreduce:
         # benchmarking/diagnostics: run the compiled program even at
         # world size 1 instead of the host-copy shortcut
         self.force_program = bool(force_program)
+        # wire compression INSIDE the one program: 'bf16'/'fp16' cast
+        # the fusion buffer for the psum; 'int8' emits the EQuARX-style
+        # quantize -> psum-of-int16-partials -> dequantize sequence
+        # with a SHARED (pmax'd) per-block scale, so the partial sums
+        # are exact integers (R * 127 fits int16 up to R=258; int32
+        # beyond) and decode with one multiply.  Still one cached XLA
+        # program per signature — no per-step retrace.  There is no
+        # ambient default here, so an explicit 'f32' collapses to
+        # full width.
+        self.wire_dtype = quantize_mod.normalize_wire_dtype(wire_dtype)
+        if self.wire_dtype == "f32":
+            self.wire_dtype = None
+        # error feedback (EF21-style): the program also returns the
+        # shared scales; callers' local quantization error
+        # x - deq(q(x)) is reconstructed host-side and added into the
+        # next call's payload, so the quantization bias cancels over
+        # steps instead of accumulating into the trained weights
+        self.error_feedback = bool(error_feedback) \
+            and self.wire_dtype == "int8"
+        self._residuals = {}     # (sig, pos, buf_idx) -> f32 residual
+        #: wire accounting for the most recent call (collective_bench)
+        self.last_logical_bytes = 0
+        self.last_wire_bytes = 0
         self._programs = {}
         self._validated = set()  # sigs fingerprint-checked across procs
         self._ex = None          # executor the cached programs target
@@ -306,11 +331,27 @@ class CompiledGroupedAllreduce:
         order = sorted(groups)   # deterministic across ranks
         return [(d, groups[d]) for d in order]
 
+    def _wire_use(self, dtype):
+        """Effective wire format for one plan buffer: float buffers
+        follow the configured wire; 16-bit wires are a no-op for
+        already-16-bit tensors; int buffers always ship full width."""
+        if not _is_float(dtype):
+            return None
+        use = self.wire_dtype
+        if use in ("bf16", "fp16") and str(dtype) in ("float16",
+                                                      "bfloat16"):
+            return None
+        return use
+
     def _build(self, ex, plan):
         R = ex.num_ranks
         op, pre, post = self.op, self.prescale, self.postscale
+        BLOCK = quantize_mod.BLOCK
 
-        def reduce_buf(x, dtype):
+        def out_scale():
+            return pre * post / R if op == Average else pre * post
+
+        def reduce_plain(x, dtype):
             # x: (1, n) per-rank block (shard) or (R, n) stacked
             fl = _is_float(dtype)
             if fl and pre != 1.0:
@@ -328,21 +369,120 @@ class CompiledGroupedAllreduce:
                 raise ValueError("Average needs floating-point tensors")
             return y
 
+        def reduce_cast16(x, dtype, wire):
+            # bf16/fp16 wire: the fusion buffer crosses the wire at
+            # half width; pre/post scaling runs in f32 around it
+            wdt = jnp.bfloat16 if wire == "bf16" else jnp.float16
+            xw = x.astype(jnp.float32).astype(wdt) if pre == 1.0 else \
+                (x.astype(jnp.float32) * pre).astype(wdt)
+            if ex.shard_mode:
+                y = lax.psum(xw, "hvd")
+            else:
+                y = jnp.sum(xw, axis=0, keepdims=True, dtype=wdt)
+            scale = post / R if op == Average else post
+            y = y.astype(jnp.float32)
+            if scale != 1.0:
+                y = y * np.float32(scale)
+            return y.astype(dtype)
+
+        def reduce_int8(x, dtype):
+            # quantize -> psum of int32 partials -> dequantize, all
+            # inside this one cached program (EQuARX, arXiv:2506.17615):
+            # the per-block scale is SHARED across ranks (pmax of the
+            # local absmax, bf16-rounded like the wire format), so
+            # every rank's int8 codes live on one grid and their
+            # integer-accumulated psum decodes with a single multiply.
+            # pre/post fold into the final dequantize scale (linear).
+            n = x.shape[-1]
+            nb = -(-n // BLOCK)
+            padn = nb * BLOCK - n
+            xf = x.astype(jnp.float32)
+            if padn:
+                xf = jnp.pad(xf, ((0, 0), (0, padn)))
+            xb = xf.reshape(x.shape[0], nb, BLOCK)
+            absmax = jnp.max(jnp.abs(xb), axis=-1)       # (rows, nb)
+            # pmax ships the absmax in bf16 (2 B/block, matching the
+            # wire format's scale width) — bf16-round BEFORE the max
+            # so every rank derives the identical shared scale
+            absmax16 = absmax.astype(jnp.bfloat16)
+            if ex.shard_mode:
+                shared = lax.pmax(absmax16, "hvd")       # (1, nb)
+            else:
+                shared = jnp.max(absmax16, axis=0, keepdims=True)
+            scale = (shared.astype(jnp.float32) / np.float32(127.0)) \
+                .astype(jnp.bfloat16).astype(jnp.float32)
+            safe = jnp.where(scale > 0, scale, np.float32(1.0))
+            q = jnp.clip(jnp.round(xb / safe[..., None]), -127, 127)
+            # partial sums are exact in int16 while R * 127 fits
+            # (R <= 258) — 2 B/element on the interconnect instead of
+            # int32's 4 B; the codes themselves are int8, so the psum
+            # operand width IS the wire cost of this path
+            if ex.shard_mode:
+                acc = jnp.int16 if R <= 258 else jnp.int32
+                y32 = lax.psum(q.astype(acc), "hvd")
+            else:
+                # stacked mode is single-process: no wire, accumulate
+                # in int32 unconditionally
+                y32 = jnp.sum(q.astype(jnp.int32), axis=0,
+                              keepdims=True)
+            y = y32.astype(jnp.float32) * scale[..., None]
+            y = y.reshape(1, nb * BLOCK)[:, :n]
+            s = out_scale()
+            if s != 1.0:
+                y = y * np.float32(s)
+            return y.astype(dtype), scale.reshape(1, nb)
+
+        def reduce_buf(x, dtype):
+            use = self._wire_use(dtype)
+            if use == "int8":
+                return reduce_int8(x, dtype)
+            if use in ("bf16", "fp16"):
+                y = reduce_cast16(x, dtype, use)
+            else:
+                y = reduce_plain(x, dtype)
+            return y, jnp.zeros((1, 0), jnp.float32)
+
         dtypes = [d for d, _ in plan]
 
+        if self.wire_dtype is None:
+            # full-width path: original program shape (outs only)
+            if ex.shard_mode:
+                def body(*bufs):
+                    return tuple(reduce_plain(b, d)
+                                 for b, d in zip(bufs, dtypes))
+
+                prog = shard_map(
+                    body, mesh=ex.mesh,
+                    in_specs=tuple(P("hvd") for _ in plan),
+                    out_specs=tuple(P() for _ in plan))
+                return jax.jit(prog)
+
+            def stacked(*bufs):
+                return tuple(reduce_plain(b, d)[0]
+                             for b, d in zip(bufs, dtypes))
+
+            return jax.jit(stacked)
+
+        # wire path: program returns (out_0..out_k, scales_0..scales_k)
+        # — scales empty for non-quantized buffers; consumed by the
+        # host-side error-feedback update
         if ex.shard_mode:
             def body(*bufs):
-                return tuple(reduce_buf(b, d)
-                             for b, d in zip(bufs, dtypes))
+                pairs = [reduce_buf(b, d) for b, d in zip(bufs, dtypes)]
+                return tuple(p[0] for p in pairs) + \
+                    tuple(p[1] for p in pairs)
 
             prog = shard_map(
                 body, mesh=ex.mesh,
                 in_specs=tuple(P("hvd") for _ in plan),
-                out_specs=tuple(P() for _ in plan))
+                out_specs=tuple(P() for _ in plan) * 2,
+                check_vma=False)
             return jax.jit(prog)
 
         def stacked(*bufs):
-            return tuple(reduce_buf(b, d)[0] for b, d in zip(bufs, dtypes))
+            pairs = [reduce_buf(b, d) for b, d in zip(bufs, dtypes)]
+            return tuple(p[0][0] for p in pairs) + \
+                tuple(p[1][0] for p in pairs)
 
         return jax.jit(stacked)
 
@@ -351,14 +491,18 @@ class CompiledGroupedAllreduce:
             if self._ex is not ex:
                 # the engine re-initialized or the process set was
                 # rebuilt: programs compiled for the old mesh/world
-                # size would silently mis-average — drop them
+                # size would silently mis-average — drop them (and the
+                # error-feedback residuals: they belong to the old
+                # training run; see docs/concepts.md on the residual
+                # lifecycle across elastic resets)
                 self._programs.clear()
                 self._validated.clear()
+                self._residuals.clear()
                 self._ex = ex
             entry = self._programs.get(sig)
             if entry is None:
                 key = ("reduce", _ex_uid(ex), int(self.op), self.prescale,
-                       self.postscale, sig)
+                       self.postscale, self.wire_dtype, sig)
                 entry = _shared_program(key,
                                         lambda: self._build(ex, plan))
                 self._programs[sig] = entry
@@ -405,6 +549,53 @@ class CompiledGroupedAllreduce:
                     raise ValueError("prescale/postscale require "
                                      "floating-point tensors")
 
+    def _account_wire(self, plan, num_ranks):
+        """Per-rank interconnect bytes of THIS path's programs.  The
+        int8 program's transport is the psum operand — int16 partial
+        sums (int32 past R=258) plus the bf16 absmax pmax — NOT the
+        1 B/element codec format (jax exposes no int8-transport
+        allreduce; the engine's all_gather-of-codes path does ship the
+        raw codec, see MeshExecutor.allreduce_quantized)."""
+        logical = wire = 0
+        for dtype, members in plan:
+            n = sum(size for _, size, _ in members)
+            itemsize = 2 if dtype == "bfloat16" else np.dtype(dtype).itemsize
+            logical += n * itemsize
+            use = self._wire_use(dtype)
+            if use == "int8":
+                nb = -(-n // quantize_mod.BLOCK)
+                per = 2 if num_ranks <= 258 else 4
+                wire += n * per + nb * 2
+            else:
+                wire += quantize_mod.wire_nbytes(n, use, itemsize)
+        self.last_logical_bytes = logical
+        self.last_wire_bytes = wire
+
+    def _apply_residuals(self, sig, pos, bufs, plan):
+        """Error feedback, inject side: add the previous call's local
+        quantization error into this call's payload (EF21)."""
+        out = []
+        for k, (buf, (dtype, _)) in enumerate(zip(bufs, plan)):
+            r = self._residuals.get((sig, pos, k))
+            if r is None or self._wire_use(dtype) != "int8":
+                out.append(buf)
+            else:
+                out.append((buf.astype(np.float32) + r)
+                           .astype(buf.dtype))
+        return out
+
+    def _update_residuals(self, sig, pos, bufs, scales, plan):
+        """Error feedback, measure side: re-encode this rank's payload
+        against the program's returned SHARED scales (deterministic —
+        same math as the device) and store x - decode(encode(x))."""
+        for k, (buf, (dtype, _)) in enumerate(zip(bufs, plan)):
+            s = np.asarray(scales[k], np.float32).reshape(-1)
+            if s.size == 0 or self._wire_use(dtype) != "int8":
+                continue
+            x = buf.astype(np.float32).ravel()
+            deq = quantize_mod.np_fake_quantize_with_scales(x, s)
+            self._residuals[(sig, pos, k)] = x - deq
+
     def __call__(self, arrays):
         arrays = [np.asarray(a) for a in arrays]
         if not arrays:
@@ -421,11 +612,12 @@ class CompiledGroupedAllreduce:
             return [a.copy() for a in arrays]
         sig = self._signature(arrays)
         plan = self._plan(arrays)
+        self._account_wire(plan, ex.num_ranks)
         prog = self._program(ex, sig, plan)
         n_local = len(ex.local_positions)
         timeline = eng.timeline
         tag = ("reduce", int(self.op), self.prescale, self.postscale,
-               self.name)
+               self.name, self.wire_dtype)
 
         def launch(slot_values):
             # slot_values: {pos: (sig, [buf per dtype])} — the leader
@@ -460,15 +652,25 @@ class CompiledGroupedAllreduce:
 
         my_bufs = self._pack(arrays, plan)
         if n_local == 1:
-            out = launch({ex.local_positions[0]: (sig, my_bufs)})
+            pos = ex.local_positions[0]
+            if self.error_feedback:
+                my_bufs = self._apply_residuals(sig, pos, my_bufs, plan)
+            out = launch({pos: (sig, my_bufs)})
         else:
             pos = _caller_pos(eng, ps)
             if pos is None:
                 raise ValueError(
                     "unbound caller: compiled collectives need a rank "
                     "context (call inside hvd.run / a launched worker)")
+            if self.error_feedback:
+                my_bufs = self._apply_residuals(sig, pos, my_bufs, plan)
             rdv = _rendezvous_for(ps, tag, n_local)
             out = rdv.run(pos, (sig, my_bufs), launch)
+        if self.wire_dtype is not None:
+            outs, scales = out[:len(plan)], out[len(plan):]
+            if self.error_feedback:
+                self._update_residuals(sig, pos, my_bufs, scales, plan)
+            out = outs
         return self._unpack(out, plan)
 
     @staticmethod
@@ -484,36 +686,41 @@ _REDUCERS = {}
 _REDUCERS_LOCK = threading.Lock()
 
 
-def _reducer(op, prescale_factor, postscale_factor, process_set):
+def _reducer(op, prescale_factor, postscale_factor, process_set,
+             wire_dtype=None):
     ps_id = process_set.process_set_id \
         if isinstance(process_set, ProcessSet) else int(process_set or 0)
+    wire_dtype = quantize_mod.normalize_wire_dtype(wire_dtype)
     key = (int(ReduceOp(op)), float(prescale_factor),
-           float(postscale_factor), ps_id)
+           float(postscale_factor), ps_id, wire_dtype)
     with _REDUCERS_LOCK:
         red = _REDUCERS.get(key)
         if red is None:
             red = CompiledGroupedAllreduce(
                 op=op, prescale_factor=prescale_factor,
-                postscale_factor=postscale_factor, process_set=process_set)
+                postscale_factor=postscale_factor, process_set=process_set,
+                wire_dtype=wire_dtype)
             _REDUCERS[key] = red
         return red
 
 
 def compiled_grouped_allreduce(arrays, op=Average, prescale_factor=1.0,
                                postscale_factor=1.0,
-                               process_set=global_process_set):
+                               process_set=global_process_set,
+                               wire_dtype=None):
     """Grouped allreduce through one compiled program (no engine)."""
     return _reducer(op, prescale_factor, postscale_factor,
-                    process_set)(arrays)
+                    process_set, wire_dtype)(arrays)
 
 
 def compiled_allreduce(array, op=Average, prescale_factor=1.0,
                        postscale_factor=1.0,
-                       process_set=global_process_set):
+                       process_set=global_process_set, wire_dtype=None):
     """Single-tensor convenience over ``compiled_grouped_allreduce``."""
     return compiled_grouped_allreduce(
         [array], op=op, prescale_factor=prescale_factor,
-        postscale_factor=postscale_factor, process_set=process_set)[0]
+        postscale_factor=postscale_factor, process_set=process_set,
+        wire_dtype=wire_dtype)[0]
 
 
 def reset_compiled_state():

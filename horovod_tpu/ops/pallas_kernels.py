@@ -12,6 +12,15 @@ scale).  The TPU equivalents that XLA does NOT already fuse well:
   O(S) HBM traffic.  Used by the single-chip fast path; the
   sequence-parallel path composes the same math with ``ppermute``
   (parallel/ring_attention.py).
+* :func:`quantize_blockwise` / :func:`dequantize_blockwise` — the
+  block-scaled int8 wire codec (ops/quantize.py semantics) as ONE
+  fused VMEM pass each: absmax, bf16 scale, round/clip and the int8
+  store happen without re-reading the block from HBM (XLA would split
+  the absmax reduction and the rescale into two passes).
+  :func:`fake_quantize_blockwise` composes them under a custom VJP
+  whose backward is the identity — gradients are exact with respect
+  to the DEQUANTIZED value (straight-through), so a training step that
+  fake-quantizes its gradient wire differentiates cleanly.
 
 Kernels run under ``interpret=True`` on CPU (tests) and compile to
 Mosaic on TPU.
@@ -66,6 +75,110 @@ def fused_scale_cast(x, factor, out_dtype=None, *, block=4096,
         interpret=interpret,
     )(flat)
     return out[:n].reshape(x.shape)
+
+
+# ---------------------------------------------------------------------------
+# block-scaled int8 wire codec (quantized collectives)
+
+from .quantize import BLOCK as _QBLOCK  # noqa: E402  (shared wire constant)
+
+# scale-blocks handled per program instance: 128 scales x 256 elements
+# = 32768 elements/program — the f32 view is 128 KiB of VMEM, the int8
+# output tile (128, 256) satisfies the (32, 128) int8 tiling rule and
+# the (1, 128) scale row satisfies the lane-width rule.
+_QROWS = 128
+
+
+def _quantize_kernel(x_ref, q_ref, s_ref):
+    x = x_ref[:].astype(jnp.float32)                   # (_QROWS, BLOCK)
+    absmax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
+    # materialize the scale in bf16 BEFORE dividing so q * bf16(scale)
+    # decodes exactly what was encoded (ops/quantize.py contract)
+    scale = (absmax / np.float32(127.0)) \
+        .astype(jnp.bfloat16).astype(jnp.float32)
+    safe = jnp.where(scale > 0, scale, np.float32(1.0))
+    q_ref[:] = jnp.clip(jnp.round(x / safe), -127, 127).astype(jnp.int8)
+    s_ref[:] = scale.reshape(1, _QROWS)
+
+
+def _dequantize_kernel(q_ref, s_ref, o_ref):
+    x = q_ref[:].astype(jnp.float32) * \
+        s_ref[:].reshape(_QROWS, 1)
+    o_ref[:] = x.astype(o_ref.dtype)
+
+
+def _pad_to_rows(flat, block_elems):
+    n = flat.shape[0]
+    nb = -(-max(n, 1) // block_elems)
+    rows = -(-nb // _QROWS) * _QROWS
+    pad = rows * block_elems - n
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat, rows
+
+
+def quantize_blockwise(x, *, interpret=None):
+    """Flat float vector -> (q int8, scales f32), both padded to a
+    ``_QROWS``-scale-block multiple (zeros encode as zeros; callers
+    slice with the true length).  Same semantics as
+    quantize.np_quantize_blockwise / quantize_blockwise_xla."""
+    if interpret is None:
+        interpret = not _is_tpu()
+    flat, rows = _pad_to_rows(x.reshape(-1), _QBLOCK)
+    xb = flat.reshape(rows, _QBLOCK)
+    q, s = pl.pallas_call(
+        _quantize_kernel,
+        out_shape=(jax.ShapeDtypeStruct((rows, _QBLOCK), jnp.int8),
+                   jax.ShapeDtypeStruct((1, rows), jnp.float32)),
+        grid=(rows // _QROWS,),
+        in_specs=[pl.BlockSpec((_QROWS, _QBLOCK), lambda i: (i, 0))],
+        out_specs=(pl.BlockSpec((_QROWS, _QBLOCK), lambda i: (i, 0)),
+                   pl.BlockSpec((1, _QROWS), lambda i: (0, i))),
+        interpret=interpret,
+    )(xb)
+    return q.reshape(-1), s.reshape(-1)
+
+
+def dequantize_blockwise(q, scales, n, out_dtype=jnp.float32, *,
+                         interpret=None):
+    """Inverse pass: (q, scales) from quantize_blockwise -> flat (n,)
+    array of ``out_dtype``."""
+    if interpret is None:
+        interpret = not _is_tpu()
+    rows = scales.shape[0]
+    out = pl.pallas_call(
+        _dequantize_kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, _QBLOCK), out_dtype),
+        grid=(rows // _QROWS,),
+        in_specs=[pl.BlockSpec((_QROWS, _QBLOCK), lambda i: (i, 0)),
+                  pl.BlockSpec((1, _QROWS), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((_QROWS, _QBLOCK), lambda i: (i, 0)),
+        interpret=interpret,
+    )(q.reshape(rows, _QBLOCK), scales.reshape(1, rows))
+    return out.reshape(-1)[:n]
+
+
+@jax.custom_vjp
+def fake_quantize_blockwise(x):
+    """Quant->dequant roundtrip, any shape, same dtype — the value the
+    quantized wire actually delivers.  Backward is the identity: the
+    VJP is exact w.r.t. the dequantized value (straight-through), so
+    ``grad(loss(fake_quantize(g)))`` equals ``grad(loss(g))`` evaluated
+    at the dequantized point instead of the useless a.e.-zero
+    derivative of round()."""
+    q, s = quantize_blockwise(x.reshape(-1))
+    return dequantize_blockwise(q, s, x.size, x.dtype).reshape(x.shape)
+
+
+def _fq_fwd(x):
+    return fake_quantize_blockwise(x), None
+
+
+def _fq_bwd(_, g):
+    return (g,)
+
+
+fake_quantize_blockwise.defvjp(_fq_fwd, _fq_bwd)
 
 
 # ---------------------------------------------------------------------------

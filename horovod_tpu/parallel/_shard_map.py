@@ -1,19 +1,15 @@
-"""Shared shard_map import shim + attention-kernel wrapper.
+"""Package-local re-export of the shard_map shim + attention wrapper.
 
-jax moved shard_map between releases (jax.shard_map vs
-jax.experimental.shard_map); every user in this package imports the
-resolved symbol from here so an API change is fixed once.
+Every shard_map user in this package imports the resolved symbol from
+here; the actual version-compat logic lives once, in
+``common/shard_compat.py`` (shared with ops/xla_ops.py).
 """
 
 from functools import partial
 
 from jax.sharding import PartitionSpec as P
 
-try:
-    from jax import shard_map as _sm
-    shard_map = _sm.shard_map if hasattr(_sm, "shard_map") else _sm
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from ..common.shard_compat import axis_size, shard_map  # noqa: F401
 
 
 def make_attention_fn(kernel, mesh, *, batch_axes=("dp", "fsdp"),

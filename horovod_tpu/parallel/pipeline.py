@@ -19,11 +19,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-try:
-    from jax import shard_map as _sm
-    shard_map = _sm.shard_map if hasattr(_sm, "shard_map") else _sm
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+# the package-wide import shim resolves jax's moving shard_map API
+# (and maps check_vma -> check_rep on pre-0.6 jax)
+from ._shard_map import axis_size, shard_map
 
 
 def gpipe(stage_fn: Callable, local_stage_params, microbatches,
@@ -41,7 +39,7 @@ def gpipe(stage_fn: Callable, local_stage_params, microbatches,
     the usual O(M) activation memory (use ``jax.checkpoint`` around
     ``stage_fn`` to trade recompute for memory).
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     M = microbatches.shape[0]
     perm = [(j, (j + 1) % n) for j in range(n)]

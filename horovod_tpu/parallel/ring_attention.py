@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ._shard_map import make_attention_fn, shard_map  # noqa: F401
+from ._shard_map import axis_size, make_attention_fn, shard_map  # noqa: F401
 
 _NEG_INF = -1e30
 
@@ -31,7 +31,7 @@ def ring_attention(q, k, v, axis_name: str = "sp"):
     Shapes (per shard): q, k, v — (B, S_local, H, D).  Must be called
     inside ``shard_map``/``pmap`` with ``axis_name`` bound.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     B, S, H, D = q.shape
     scale = np.float32(1.0 / np.sqrt(D))

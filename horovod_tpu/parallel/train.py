@@ -85,8 +85,17 @@ def make_lm_train_step(mesh: Mesh, cfg: TransformerConfig,
 
     tok_sharding = batch_sharding(mesh, seq_sharded=sequence_parallel)
 
+    # Attention carries no parameters, so init MUST be identical
+    # across attention implementations — same rng, same weights,
+    # whether the step later runs dense, flash, ring, or ulysses.
+    # Initializing through `model` would break that on jax/flax
+    # versions where a shard_map inside the scanned block perturbs the
+    # traced rng derivation; the dense twin sidesteps it (and skips
+    # interpret-mode pallas kernels during init).
+    init_model = TransformerLM(cfg)
+
     def init(rng, sample_tokens):
-        params = model.init(rng, sample_tokens)["params"]
+        params = init_model.init(rng, sample_tokens)["params"]
         opt_state = optimizer.init(params)
         return {"params": params, "opt_state": opt_state,
                 "step": jnp.zeros((), jnp.int32)}

@@ -21,7 +21,7 @@ size — with tensor parallelism that is ``(n_heads / tp) % sp == 0``
 from jax import lax
 
 from ..models.transformer import dense_causal_attention
-from ._shard_map import make_attention_fn
+from ._shard_map import axis_size, make_attention_fn
 
 
 def ulysses_attention(q, k, v, axis_name: str = "sp"):
@@ -30,7 +30,7 @@ def ulysses_attention(q, k, v, axis_name: str = "sp"):
     Per-shard shapes: (B, S_local, H, D) with H % axis_size == 0.
     Must run inside shard_map with ``axis_name`` bound.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     B, S, H, D = q.shape
     if H % n != 0:
         raise ValueError(

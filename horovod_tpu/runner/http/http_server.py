@@ -212,8 +212,13 @@ class Coordinator:
                 cycle_time_ms=cycle_time_ms,
                 pack_mt_threshold_bytes=8 << 20,
                 cache_capacity=cache_capacity)
+            # tune_wire=False: the wire dtype is a worker-side knob
+            # with no distribution channel from this coordinator —
+            # sweeping it here would burn samples on a dimension
+            # nothing applies (engine-side autotune owns it)
             self._autotuner = ParameterManager(self._tuned_params,
-                                               log_path=autotune_log)
+                                               log_path=autotune_log,
+                                               tune_wire=False)
         self._lock = threading.Condition()
         # key -> {proc_id -> meta}
         self._pending: "OrderedDict[str, dict]" = OrderedDict()

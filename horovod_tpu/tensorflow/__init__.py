@@ -111,7 +111,7 @@ def _sparse_allreduce_public(slices, average, op, prescale_factor,
 
 def allreduce(tensor, average=None, name=None, op=None,
               prescale_factor=1.0, postscale_factor=1.0,
-              process_set=global_process_set):
+              process_set=global_process_set, wire_dtype=None):
     if isinstance(tensor, tf.IndexedSlices):
         return _sparse_allreduce_public(
             tensor, average, op, prescale_factor, postscale_factor,
@@ -119,24 +119,27 @@ def allreduce(tensor, average=None, name=None, op=None,
     if not tf.is_tensor(tensor):
         return _api.allreduce(tensor, average, name, op,
                               prescale_factor, postscale_factor,
-                              process_set)
+                              process_set, wire_dtype)
 
     @tf.custom_gradient
     def _op(t):
         out = _run_host(
             lambda x: _api.allreduce(x, average, name, op,
                                      prescale_factor,
-                                     postscale_factor, process_set),
+                                     postscale_factor, process_set,
+                                     wire_dtype),
             [t], t.dtype)
         out.set_shape(t.shape)
 
         def grad(dy):
             # allreduce adjoint = allreduce with the same op/scales
-            # (reference mpi_ops.py:137-153)
+            # (reference mpi_ops.py:137-153); the wire format travels
+            # with it — the adjoint crosses the same interconnect
             return allreduce(dy, average=average, op=op,
                              prescale_factor=prescale_factor,
                              postscale_factor=postscale_factor,
-                             process_set=process_set)
+                             process_set=process_set,
+                             wire_dtype=wire_dtype)
 
         return out, grad
 
@@ -145,7 +148,7 @@ def allreduce(tensor, average=None, name=None, op=None,
 
 def grouped_allreduce(tensors, average=None, name=None, op=None,
                       prescale_factor=1.0, postscale_factor=1.0,
-                      process_set=global_process_set):
+                      process_set=global_process_set, wire_dtype=None):
     if any(isinstance(t, tf.IndexedSlices) for t in tensors):
         # reference grouped allreduce handles mixed dense/sparse
         # member-wise (tensorflow/__init__.py grouped IndexedSlices)
@@ -156,14 +159,15 @@ def grouped_allreduce(tensors, average=None, name=None, op=None,
     if not any(tf.is_tensor(t) for t in tensors):
         return _api.grouped_allreduce(tensors, average, name, op,
                                       prescale_factor,
-                                      postscale_factor, process_set)
+                                      postscale_factor, process_set,
+                                      wire_dtype)
 
     @tf.custom_gradient
     def _op(*ts):
         outs = _run_host(
             lambda *xs: _api.grouped_allreduce(
                 list(xs), average, name, op, prescale_factor,
-                postscale_factor, process_set),
+                postscale_factor, process_set, wire_dtype),
             list(ts), [t.dtype for t in ts])
         if not isinstance(outs, (list, tuple)):
             outs = [outs]
@@ -175,7 +179,7 @@ def grouped_allreduce(tensors, average=None, name=None, op=None,
                 list(dys), average=average, op=op,
                 prescale_factor=prescale_factor,
                 postscale_factor=postscale_factor,
-                process_set=process_set)
+                process_set=process_set, wire_dtype=wire_dtype)
 
         return tuple(outs), grad
 
@@ -292,10 +296,16 @@ def reducescatter(tensor, op=None, name=None,
 
         def grad(dy):
             # exact adjoint: un-scatter via allgather, /size for
-            # Average (torch/mpi_ops.py reducescatter backward)
+            # Average, x(prescale*postscale) for the linear scaling
+            # the forward applied (torch/mpi_ops.py
+            # HorovodReducescatter.backward)
             g = allgather(dy, process_set=process_set)
             if rs_op == Average:
                 g = g / tf.cast(_ps_size(process_set), g.dtype)
+            if prescale_factor != 1.0:
+                g = g * tf.cast(prescale_factor, g.dtype)
+            if postscale_factor != 1.0:
+                g = g * tf.cast(postscale_factor, g.dtype)
             return g
 
         return out, grad
@@ -331,6 +341,10 @@ def grouped_reducescatter(tensors, op=None, name=None,
                 g = allgather(dy, process_set=process_set)
                 if rs_op == Average:
                     g = g / tf.cast(_ps_size(process_set), g.dtype)
+                if prescale_factor != 1.0:
+                    g = g * tf.cast(prescale_factor, g.dtype)
+                if postscale_factor != 1.0:
+                    g = g * tf.cast(postscale_factor, g.dtype)
                 grads.append(g)
             return tuple(grads)
 
@@ -383,9 +397,11 @@ def broadcast_variables(variables, root_rank, process_set=global_process_set):
     ranks = _b.engine().process_set_ranks(
         process_set.process_set_id or 0) if _b.is_initialized() else [0]
     if len(ranks) == 1:
-        # single-rank: broadcast is the identity; skipping it lets
-        # unchanged reference scripts call this inside tf.function
-        return
+        # single-rank: broadcast is the identity, but callers still
+        # expect an op they can sess.run / depend on (reference
+        # broadcast_global_variables returns a grouped assign) — hand
+        # back an empty group instead of None
+        return tf.group([])
 
     def _value(v):
         # tf.Variable.value is a method; keras-3 Variable.value is a
@@ -401,8 +417,9 @@ def broadcast_variables(variables, root_rank, process_set=global_process_set):
                         process_set=process_set)
         for i, v in enumerate(variables)
     ]
-    for v, h in zip(variables, handles):
-        v.assign(tf.cast(synchronize(h), v.dtype))
+    assigns = [v.assign(tf.cast(synchronize(h), v.dtype))
+               for v, h in zip(variables, handles)]
+    return tf.group(assigns)
 
 
 def _var_name(v):
@@ -493,6 +510,13 @@ class _GradSync:
             raise ValueError("gradient_predivide_factor not supported "
                              "with op != Average")
         self.compression = compression
+        # quantized-wire compressors (Compression.int8) are markers:
+        # the collective quantizes the fused buffer on the wire, and
+        # this sync object owns the error-feedback residual state
+        # (keyed by position in the dense gradient list — stable for a
+        # fixed model across steps)
+        self.wire_dtype = getattr(compression, "wire", None)
+        self._residuals = {}
         self.op = op
         self.gradient_predivide_factor = gradient_predivide_factor
         self.process_set = process_set
@@ -627,18 +651,51 @@ class _GradSync:
     def _reduce_dense(self, dense):
         """Eager grouped allreduce of a flat dense list."""
         comp, ctxs = zip(*[self.compression.compress(g) for g in dense])
+        comp = list(comp)
         prescale, postscale = self._scale_split()
+        wire = self.wire_dtype if self.op in (Average, Sum) else None
         if self.use_compiled_ops:
-            outs = self._reduce_compiled(list(comp), prescale, postscale)
+            # the compiled program quantizes in-graph and does its own
+            # (exact, shared-scale) error feedback — no host residuals
+            outs = self._reduce_compiled(comp, prescale, postscale)
         else:
-            outs = grouped_allreduce(list(comp), op=self.op,
+            if wire == "int8":
+                comp = self._ef_inject(comp)
+            outs = grouped_allreduce(comp, op=self.op,
                                      prescale_factor=prescale,
                                      postscale_factor=postscale,
-                                     process_set=self.process_set)
+                                     process_set=self.process_set,
+                                     wire_dtype=wire)
         if not isinstance(outs, list):
             outs = [outs]
         return [self.compression.decompress(o, c)
                 for o, c in zip(outs, ctxs)]
+
+    def _ef_inject(self, dense):
+        """Error feedback (EF21) for the engine path: add the previous
+        step's local quantization error into each float gradient, then
+        store the new residual ``x - deq(q(x))`` from re-running the
+        wire codec host-side (ops/quantize.py, a pure function of x)."""
+        from ..ops import quantize as qz
+        out = []
+        for k, g in enumerate(dense):
+            if not g.dtype.is_floating:
+                out.append(g)
+                continue
+            x = np.asarray(tf.cast(g, tf.float32))
+            r = self._residuals.get(k)
+            if r is not None and r.shape == x.shape:
+                x = x + r
+            self._residuals[k] = x - qz.np_fake_quantize_blockwise(x)
+            out.append(tf.cast(tf.convert_to_tensor(x), g.dtype))
+        return out
+
+    def reset_wire_state(self):
+        """Drop error-feedback residuals — call on elastic resets or
+        whenever the gradient stream restarts (docs/concepts.md)."""
+        self._residuals.clear()
+        if self._compiled_reducer is not None:
+            self._compiled_reducer._residuals.clear()
 
     def _reduce_compiled(self, comp, prescale, postscale):
         """One compiled XLA program for the whole gradient group — the
@@ -649,7 +706,9 @@ class _GradSync:
             self._compiled_reducer = CompiledGroupedAllreduce(
                 op=self.op, prescale_factor=prescale,
                 postscale_factor=postscale,
-                process_set=self.process_set, name="grad_sync")
+                process_set=self.process_set, name="grad_sync",
+                wire_dtype=self.wire_dtype,
+                error_feedback=self.wire_dtype == "int8")
         arrs = [t.numpy() if hasattr(t, "numpy") else np.asarray(t)
                 for t in comp]
         outs = self._compiled_reducer(arrs)

@@ -45,7 +45,30 @@ class BF16Compressor(FP16Compressor):
     wire_dtype = tf.bfloat16
 
 
+class Int8Compressor(Compressor):
+    """Block-scaled int8 wire (ops/quantize.py) with EF21-style error
+    feedback.  A *marker* compressor, not a cast: int8 codes under
+    different scales cannot be summed, so ``_GradSync`` /
+    ``DistributedOptimizer`` pass ``wire_dtype='int8'`` down to the
+    collective (the engine or the compiled XLA program quantizes the
+    fused buffer on the wire) and keep per-gradient residuals
+    ``e = g - dequantize(quantize(g))`` that are injected into the
+    next step's gradient."""
+
+    #: wire format the gradient sync forwards to the collective
+    wire = "int8"
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
 class Compression:
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+    int8 = Int8Compressor

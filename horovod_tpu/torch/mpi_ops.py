@@ -252,6 +252,8 @@ class HorovodGroupedReducescatter(torch.autograd.Function):
                 postscale_factor, *tensors):
         ctx.op = op
         ctx.process_set = process_set
+        ctx.prescale_factor = prescale_factor
+        ctx.postscale_factor = postscale_factor
         return tuple(_api.grouped_reducescatter(
             list(tensors), op, name,
             prescale_factor=prescale_factor,
@@ -260,8 +262,12 @@ class HorovodGroupedReducescatter(torch.autograd.Function):
 
     @staticmethod
     def backward(ctx, *grad_outputs):
-        inv = 1.0 / _ps_size(ctx.process_set) if ctx.op == Average else 1
-        grads = [allgather(g * inv if inv != 1 else g,
+        # same adjoint as the single-tensor op: /size for Average,
+        # then the linear prescale*postscale the forward applied
+        scale = ctx.prescale_factor * ctx.postscale_factor
+        if ctx.op == Average:
+            scale /= _ps_size(ctx.process_set)
+        grads = [allgather(g * scale if scale != 1 else g,
                            process_set=ctx.process_set)
                  for g in grad_outputs]
         return (None, None, None, None, None, *grads)

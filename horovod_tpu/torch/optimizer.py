@@ -35,6 +35,11 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                    groups=None, sparse_as_dense=False,
                    process_set=global_process_set):
         self._compression = compression
+        # quantized-wire compressors (Compression.int8) are markers:
+        # the collective itself quantizes the fused buffer, and this
+        # optimizer owns the error-feedback residual state
+        self._wire_dtype = getattr(compression, "wire", None)
+        self._residuals = {}
         self.op = op
         self.gradient_predivide_factor = gradient_predivide_factor
         self.sparse_as_dense = sparse_as_dense
@@ -205,12 +210,50 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                 process_set=self.process_set)
             return handle, ("sparse",)
         tensor_compressed, ctx = self._compression.compress(grad)
+        wire = self._wire_for(tensor_compressed)
+        if wire == "int8":
+            tensor_compressed = self._ef_inject(p, tensor_compressed)
         prescale, postscale = self._scale_factors()
         handle = api.allreduce_async(
             tensor_compressed, name=self._name(p), op=self.op,
             prescale_factor=prescale, postscale_factor=postscale,
-            process_set=self.process_set)
+            process_set=self.process_set, wire_dtype=wire)
         return handle, ctx
+
+    def _wire_for(self, grad):
+        """Wire format for one gradient: the compressor's marker when
+        it applies (float dense gradients on Sum/Average — the only
+        reductions whose math commutes with the quantized decode)."""
+        if self._wire_dtype is None or grad.is_sparse \
+                or not grad.dtype.is_floating_point \
+                or self.op not in (Average, Sum):
+            return None
+        return self._wire_dtype
+
+    def _ef_inject(self, p, grad):
+        """Error feedback (EF21): add the residual left over from the
+        previous step's quantization into this gradient, then store
+        the new local quantization error ``x - deq(q(x))`` — computed
+        by re-running the wire codec host-side (ops/quantize.py is a
+        pure function of x, so this matches what the engine encodes up
+        to fusion-buffer block alignment)."""
+        from ..ops import quantize as qz
+        x = grad.float()
+        r = self._residuals.get(p)
+        if r is not None and r.shape == x.shape:
+            x = x + r
+        fq = torch.from_numpy(
+            qz.np_fake_quantize_blockwise(x.detach().numpy()))
+        self._residuals[p] = x - fq.view_as(x)
+        return x.to(grad.dtype) if grad.dtype != torch.float32 else x
+
+    def reset_wire_state(self):
+        """Drop error-feedback residuals.  Call when the gradient
+        stream is discontinuous — elastic reset, parameter reshape,
+        optimizer state restore — so stale errors from the old run are
+        not injected into the new one (docs/concepts.md, residual
+        lifecycle)."""
+        self._residuals.clear()
 
     def _scale_factors(self):
         """Split the average as prescale=1/gpf, postscale=gpf (the
@@ -224,15 +267,20 @@ class _DistributedOptimizer(torch.optim.Optimizer):
     def _grouped_allreduce_async(self, gi):
         group = self._groups[gi]
         tensors, ctxs = [], []
+        wire = None
         for p in group:
             t, c = self._compression.compress(self._prepare_grad(p))
+            w = self._wire_for(t)
+            if w == "int8":
+                t = self._ef_inject(p, t)
+                wire = w
             tensors.append(t)
             ctxs.append(c)
         prescale, postscale = self._scale_factors()
         handle = api.grouped_allreduce_async(
             tensors, op=self.op, name=f"group.{gi}",
             prescale_factor=prescale, postscale_factor=postscale,
-            process_set=self.process_set)
+            process_set=self.process_set, wire_dtype=wire)
         for p, c in zip(group, ctxs):
             self._handles[p] = (handle, ("group", gi, c))
         self._group_pending[gi] = set()

@@ -21,7 +21,13 @@ import jax  # noqa: E402
 # The sandbox preloads jax with platforms "axon,cpu" (one real TPU via a
 # tunnel); tests want only the virtual 8-device CPU platform.
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax: the XLA_FLAGS host-platform partitioning above is the
+    # only way to get 8 virtual CPU devices (works as long as no other
+    # import initialized the backends first)
+    pass
 # The reference supports 64-bit dtypes (message.h:30-41); enable them.
 jax.config.update("jax_enable_x64", True)
 
@@ -34,6 +40,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "integration: end-to-end multi-process launches (slower)")
+    config.addinivalue_line(
+        "markers",
+        "slow: needs the host's REAL default backend (the bench chip) "
+        "— minutes-long start timeouts when the chip is remote; "
+        "excluded from the fast tier, run explicitly with -m slow")
 
 
 @pytest.fixture()

@@ -53,13 +53,15 @@ def test_parameter_manager_converges(tmp_path):
     for _ in range(5 * 2):
         pm.record_bytes(1 << 20)
     assert not pm.active               # converged after max_samples
-    fusion, cycle, pack_mt, cache = pm.best_parameters()
+    fusion, cycle, pack_mt, cache, wire = pm.best_parameters()
     assert 1 << 20 <= fusion <= 1 << 28
     assert 0.5 <= cycle <= 32.0
     assert 1 << 20 <= pack_mt <= 1 << 26
     assert 0 <= cache <= 4096                       # 4th dim (r4):
+    assert wire in (None, "fp16", "bf16", "int8")   # 5th dim: wire dtype
     assert cfg.pack_mt_threshold_bytes == pack_mt   # applied
     assert cfg.cache_capacity == cache              # applied
+    assert cfg.wire_dtype == wire                   # applied
     pm.close()
     lines = log.read_text().strip().splitlines()
     assert lines[0].startswith("sample,")

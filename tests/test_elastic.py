@@ -454,6 +454,7 @@ REAL_BACKEND_WORKER = textwrap.dedent("""
 
 
 @pytest.mark.integration
+@pytest.mark.slow
 def test_elastic_reinit_real_backend(tmp_path):
     """init -> shutdown -> re-init of jax.distributed + the engine
     against the REAL default backend (the bench TPU chip when this
@@ -590,6 +591,7 @@ SOAK_WORKER = textwrap.dedent("""
 
 
 @pytest.mark.integration
+@pytest.mark.slow
 def test_elastic_multi_round_soak_real_backend(tmp_path):
     """N>=3 consecutive init/train/commit/shutdown rounds against the
     REAL default backend (the bench TPU chip when present), restoring

@@ -571,3 +571,226 @@ def test_grouped_reducescatter_matrix(live_engine, dtype):
         return True
 
     assert all(run_ranks(fn))
+
+
+# ---------------------------------------------------------------------------
+# wire compression: (none | fp16 | int8) x (allreduce | grouped |
+# reducescatter) x (engine | compiled).  int8 is the block-scaled
+# quantized wire (ops/quantize.py); its tolerance follows the codec's
+# error bound (absmax/254 per element per rank).
+
+WIRE_ATOL = {None: 1e-5, "fp16": 3e-2, "int8": 2e-1}
+
+WIRE_CASES = [
+    (w, o, p)
+    for w in (None, "fp16", "int8")
+    for o in ("allreduce", "grouped_allreduce", "reducescatter")
+    for p in ("engine", "compiled")
+]
+
+
+@pytest.mark.parametrize(
+    "wire,op_kind,path", WIRE_CASES,
+    ids=[f"{w or 'f32'}-{o}-{p}" for w, o, p in WIRE_CASES])
+def test_wire_compression_matrix(live_engine, wire, op_kind, path):
+    if path == "compiled" and op_kind == "reducescatter":
+        pytest.skip("compiled surface is allreduce-only "
+                    "(ops/compiled.py)")
+    tag = f"{wire or 'f32'}.{op_kind}.{path}"
+
+    def fn():
+        r = hvd.rank()
+        rng = np.random.default_rng(r)
+        if op_kind == "reducescatter":
+            x = rng.standard_normal((NP * 2, 5)).astype(np.float32)
+            out = hvd.reducescatter(x, op=hvd.Sum,
+                                    name=f"m.wire.{tag}",
+                                    wire_dtype=wire)
+            return np.asarray(out, np.float64), x, r
+        x = rng.standard_normal(1000).astype(np.float32)
+        if op_kind == "allreduce":
+            if path == "compiled":
+                out = hvd.compiled_allreduce(x, op=hvd.Sum,
+                                             wire_dtype=wire)
+            else:
+                out = hvd.allreduce(x, op=hvd.Sum,
+                                    name=f"m.wire.{tag}",
+                                    wire_dtype=wire)
+            return np.asarray(out, np.float64), x, r
+        xs = [x[:600], x[600:]]
+        if path == "compiled":
+            outs = hvd.compiled_grouped_allreduce(xs, op=hvd.Sum,
+                                                  wire_dtype=wire)
+        else:
+            outs = hvd.grouped_allreduce(xs, op=hvd.Sum,
+                                         name=f"m.wire.{tag}",
+                                         wire_dtype=wire)
+        return np.concatenate([np.asarray(o, np.float64)
+                               for o in outs]), x, r
+
+    results = run_ranks(fn)
+    expected = np.sum([x.astype(np.float64) for _, x, _ in results],
+                      axis=0)
+    for out, _, r in results:
+        want = expected[r * 2:(r + 1) * 2] \
+            if op_kind == "reducescatter" else expected
+        assert np.allclose(out, want, atol=WIRE_ATOL[wire]), \
+            (wire, op_kind, path, np.abs(out - want).max())
+
+
+def test_int8_wire_accounting(live_engine):
+    """The engine's wire accounting must show the ~3.97x reduction the
+    int8 format promises (1 byte/elem + 2 bytes/256-elem block vs 4)."""
+    from horovod_tpu.common import basics
+    eng = basics.engine()
+    l0, a0 = eng.logical_wire_bytes, eng.actual_wire_bytes
+    q0 = eng.quantized_bucket_runs
+
+    def fn():
+        x = np.ones(1 << 16, np.float32)
+        hvd.allreduce(x, op=hvd.Sum, name="m.acct", wire_dtype="int8")
+        return True
+
+    assert all(run_ranks(fn))
+    dl = eng.logical_wire_bytes - l0
+    da = eng.actual_wire_bytes - a0
+    assert eng.quantized_bucket_runs > q0
+    assert dl > 0 and dl / da > 3.9, (dl, da)
+
+
+def test_compiled_int8_stays_single_program(live_engine):
+    """Quantized compiled-path allreduce must remain ONE cached XLA
+    program across steps — encode, psum of integer partials, and
+    decode all live inside it (no per-step retrace).  Its transport is
+    the psum operand: int16 partial sums at this world size, so the
+    honest accounting shows ~2x under f32 (the ~4x codec wire belongs
+    to the engine's all_gather-of-codes path)."""
+    def fn():
+        red = hvd.CompiledGroupedAllreduce(
+            op=hvd.Average, wire_dtype="int8", error_feedback=True,
+            force_program=True)
+        rng = np.random.default_rng(hvd.rank())
+        xs = [rng.standard_normal(500).astype(np.float32),
+              rng.standard_normal(300).astype(np.float32)]
+        for _ in range(4):
+            red(xs)
+        ratio = red.last_logical_bytes / red.last_wire_bytes
+        assert 1.9 < ratio <= 2.0, ratio
+        return len(red._programs)
+
+    assert all(n == 1 for n in run_ranks(fn))
+
+
+def test_explicit_f32_wire_overrides_default(live_engine):
+    """wire_dtype='f32' must force a full-width reduction even when a
+    process-wide default (HOROVOD_WIRE_DTYPE / autotune) says int8 —
+    users need a lossless escape hatch for metrics/validation."""
+    from horovod_tpu.common import basics
+    eng = basics.engine()
+    old = eng.config.wire_dtype
+    eng.config.wire_dtype = "int8"
+    try:
+        q0 = eng.quantized_bucket_runs
+
+        def fn_f32():
+            x = np.full(2048, float(hvd.rank() + 1), np.float32)
+            return hvd.allreduce(x, op=hvd.Sum, name="m.wire.exp32",
+                                 wire_dtype="f32")
+
+        outs = run_ranks(fn_f32)
+        assert eng.quantized_bucket_runs == q0, "f32 override ignored"
+        expect = sum(range(1, NP + 1))
+        for o in outs:
+            np.testing.assert_array_equal(np.asarray(o),
+                                          np.full(2048, expect))
+
+        def fn_default():
+            x = np.full(2048, float(hvd.rank() + 1), np.float32)
+            return hvd.allreduce(x, op=hvd.Sum, name="m.wire.dflt")
+
+        run_ranks(fn_default)
+        assert eng.quantized_bucket_runs > q0, \
+            "config default not honored"
+    finally:
+        eng.config.wire_dtype = old
+
+
+def test_wire_dtype_skips_nonlinear_ops(live_engine):
+    """Min/max/product do not commute with per-rank decode — the
+    engine must silently ship them full width, not corrupt them."""
+    def fn():
+        r = hvd.rank()
+        x = np.arange(1, 9, dtype=np.float32) * (r + 1)
+        out = hvd.allreduce(x, op=hvd.Max, name="m.wire.max",
+                            wire_dtype="int8")
+        return np.asarray(out, np.float64)
+
+    expected = np.arange(1, 9, dtype=np.float64) * NP
+    for out in run_ranks(fn):
+        np.testing.assert_array_equal(out, expected)
+
+
+# ---------------------------------------------------------------------------
+# error-feedback convergence: a small LM trained over the int8 wire
+# must reach the f32-wire loss (EF21: residuals cancel the
+# quantization bias over steps instead of letting it accumulate)
+
+def _train_tiny_lm(compression, steps=100):
+    """Train next-token prediction of t -> (t + 1) % V on synthetic
+    tokens, gradients averaged through DistributedOptimizer.  Returns
+    the final loss (identical on every rank: grads are allreduced and
+    weights start in sync)."""
+    import torch
+    import horovod_tpu.torch as thvd
+
+    V, D, T, B = 32, 16, 8, 4
+
+    def fn():
+        r = hvd.rank()
+        wrng = np.random.default_rng(0)
+        emb = torch.nn.Parameter(torch.from_numpy(
+            (wrng.standard_normal((V, D)) * 0.3).astype(np.float32)))
+        head = torch.nn.Parameter(torch.from_numpy(
+            (wrng.standard_normal((D, V)) * 0.3).astype(np.float32)))
+        opt = torch.optim.SGD([emb, head], lr=1.0)
+        opt = thvd.DistributedOptimizer(
+            opt, named_parameters=[("emb", emb), ("head", head)],
+            compression=compression)
+        drng = np.random.default_rng(1000 + r)
+        for _ in range(steps):
+            x = torch.from_numpy(
+                drng.integers(0, V, size=(B, T)).astype(np.int64))
+            y = (x + 1) % V
+            logits = emb[x] @ head
+            loss = torch.nn.functional.cross_entropy(
+                logits.reshape(-1, V), y.reshape(-1))
+            opt.zero_grad()
+            loss.backward()
+            opt.step()
+        # eval on a batch every rank shares: training data is sharded
+        # per rank, so the train loss differs — the synced WEIGHTS are
+        # what must agree
+        erng = np.random.default_rng(42)
+        with torch.no_grad():
+            x = torch.from_numpy(
+                erng.integers(0, V, size=(16, T)).astype(np.int64))
+            y = (x + 1) % V
+            eval_loss = torch.nn.functional.cross_entropy(
+                (emb[x] @ head).reshape(-1, V), y.reshape(-1))
+        return float(eval_loss)
+
+    losses = run_ranks(fn)
+    assert max(losses) - min(losses) < 1e-5, "ranks out of sync"
+    return losses[0]
+
+
+def test_int8_wire_error_feedback_convergence(live_engine):
+    import horovod_tpu.torch as thvd
+
+    f32_loss = _train_tiny_lm(thvd.Compression.none)
+    int8_loss = _train_tiny_lm(thvd.Compression.int8)
+    assert f32_loss < 1.0, f"baseline failed to learn: {f32_loss}"
+    # acceptance bar: int8 wire with error feedback within 1% of the
+    # f32-wire final loss
+    assert abs(int8_loss - f32_loss) <= 0.01 * f32_loss + 1e-3, \
+        (int8_loss, f32_loss)

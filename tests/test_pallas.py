@@ -126,3 +126,94 @@ def test_flash_attention_independent_bwd_blocks():
         bwd_block_k=8, interpret=True)))(q)
     np.testing.assert_allclose(np.asarray(g_ref), np.asarray(g_bwd),
                                rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# block-scaled int8 wire codec (quantized collectives)
+
+def test_quantize_blockwise_matches_numpy_codec():
+    """The Pallas encoder and the numpy wire codec (ops/quantize.py)
+    must agree bit-for-bit: error-feedback residuals re-run the codec
+    host-side and rely on encode(x) being one pure function."""
+    from horovod_tpu.ops import quantize as qz
+    from horovod_tpu.ops.pallas_kernels import (
+        dequantize_blockwise, quantize_blockwise)
+
+    x = np.random.default_rng(0).standard_normal(70_000) \
+        .astype(np.float32)
+    q, s = quantize_blockwise(jnp.asarray(x), interpret=True)
+    qn, sn, n = qz.np_quantize_blockwise(x)
+    assert np.array_equal(np.asarray(q)[:qn.size], qn)
+    np.testing.assert_array_equal(np.asarray(s)[:sn.size],
+                                  sn.astype(np.float32))
+    out = dequantize_blockwise(q, s, n, interpret=True)
+    np.testing.assert_array_equal(
+        np.asarray(out), qz.np_dequantize_blockwise(qn, sn, n))
+
+
+def test_quantize_blockwise_xla_matches_numpy_codec():
+    """Third implementation of the same contract: the pure-XLA codec
+    (used inside the executor's quantized collective programs) must
+    match the numpy wire codec bit-for-bit too."""
+    from horovod_tpu.ops import quantize as qz
+
+    x = np.random.default_rng(3).standard_normal(70_000) \
+        .astype(np.float32)
+    q, s = qz.quantize_blockwise_xla(jnp.asarray(x))
+    qn, sn, n = qz.np_quantize_blockwise(x)
+    assert np.array_equal(np.asarray(q)[:qn.size], qn)
+    np.testing.assert_array_equal(np.asarray(s)[:sn.size],
+                                  sn.astype(np.float32))
+    out = qz.dequantize_blockwise_xla(q, s, n)
+    np.testing.assert_array_equal(
+        np.asarray(out), qz.np_dequantize_blockwise(qn, sn, n))
+
+
+def test_quantize_blockwise_error_bound():
+    """Per-element error is bounded by half the block scale
+    (absmax / 254) — the property the int8 wire's accuracy story
+    rests on."""
+    from horovod_tpu.ops.pallas_kernels import (
+        dequantize_blockwise, quantize_blockwise)
+
+    x = (np.random.default_rng(1).standard_normal(4096) * 7) \
+        .astype(np.float32)
+    q, s = quantize_blockwise(jnp.asarray(x), interpret=True)
+    out = np.asarray(dequantize_blockwise(q, s, x.size,
+                                          interpret=True))
+    blocks = x.reshape(-1, 256)
+    bound = (np.abs(blocks).max(axis=1) / 254 + 1e-7)[:, None]
+    assert np.all(np.abs(out.reshape(-1, 256) - blocks) <= bound * 1.01)
+
+
+def test_fake_quantize_blockwise_vjp_is_straight_through():
+    """Custom VJP contract: gradients are exact w.r.t. the DEQUANTIZED
+    value — d/dx sum(c * fq(x)) == c, not the a.e.-zero derivative of
+    round()."""
+    from horovod_tpu.ops import quantize as qz
+    from horovod_tpu.ops.pallas_kernels import fake_quantize_blockwise
+
+    x = jnp.asarray(np.random.default_rng(2)
+                    .standard_normal((3, 700)).astype(np.float32))
+    fq = fake_quantize_blockwise(x)
+    np.testing.assert_array_equal(
+        np.asarray(fq), qz.np_fake_quantize_blockwise(np.asarray(x)))
+    g = jax.grad(lambda v: jnp.sum(fake_quantize_blockwise(v) * 3.0))(x)
+    np.testing.assert_array_equal(np.asarray(g),
+                                  np.full(x.shape, 3.0, np.float32))
+
+
+def test_quantize_blockwise_zero_and_tiny_blocks():
+    """All-zero blocks encode with scale 0 and decode to exact zeros;
+    sub-block inputs pad with zeros that round-trip losslessly."""
+    from horovod_tpu.ops.pallas_kernels import (
+        dequantize_blockwise, quantize_blockwise)
+
+    x = np.zeros(300, np.float32)
+    x[:7] = [1e-30, -1e-30, 0.5, -0.5, 2.0, -2.0, 1e20]
+    q, s = quantize_blockwise(jnp.asarray(x), interpret=True)
+    out = np.asarray(dequantize_blockwise(q, s, x.size,
+                                          interpret=True))
+    assert out.shape == x.shape
+    assert np.all(np.isfinite(out[:256]) | (x[:256] > 1e19))
+    np.testing.assert_array_equal(out[256:], np.zeros(44, np.float32))

@@ -758,3 +758,87 @@ def test_reference_module_paths_tf(hvd_shutdown):
         return True
 
     assert all(run_ranks(fn))
+
+
+# ---------------------------------------------------------------------------
+# quantized wire (Compression.int8) + reducescatter-gradient satellites
+
+
+def test_tf_reducescatter_grad_applies_scale_factors(hvd_shutdown):
+    """Backward must carry prescale*postscale: forward is
+    postscale * reduce(prescale * x), whose adjoint multiplies by both
+    (torch HorovodReducescatter.backward parity)."""
+    def fn():
+        t = tf.Variable(tf.ones([NP, 2]))
+        with tf.GradientTape() as tape:
+            out = hvd.reducescatter(t, op=hvd.Sum, prescale_factor=0.5,
+                                    postscale_factor=3.0)
+            s = tf.reduce_sum(out)
+        g = tape.gradient(s, t)
+        assert np.allclose(g.numpy(), 0.5 * 3.0), g.numpy()
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_tf_grouped_reducescatter_grad_applies_scale_factors(
+        hvd_shutdown):
+    def fn():
+        t = tf.Variable(tf.ones([NP, 2]))
+        with tf.GradientTape() as tape:
+            outs = hvd.grouped_reducescatter(
+                [t], op=hvd.Average, prescale_factor=2.0)
+            s = tf.reduce_sum(outs[0])
+        g = tape.gradient(s, t)
+        # Average adjoint carries 1/NP, then the prescale 2.0
+        assert np.allclose(g.numpy(), 2.0 / NP), g.numpy()
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_tf_broadcast_variables_single_rank_returns_op(hvd_shutdown):
+    """World size 1: the early return must still be a runnable op —
+    sess.run(hvd.broadcast_global_variables(0)) in unchanged tf1
+    scripts (reference returns a grouped assign)."""
+    def fn():
+        import tensorflow.compat.v1 as tf1
+        with tf1.Graph().as_default():
+            v = tf1.get_variable("bv_single", initializer=[1.0, 2.0])
+            op = hvd.broadcast_variables([v], root_rank=0)
+            assert op is not None
+            with tf1.Session() as sess:
+                sess.run(tf1.global_variables_initializer())
+                sess.run(op)   # must not crash on None
+        return True
+
+    assert all(run_ranks(fn, 1))
+
+
+def test_tf_tape_int8_wire_stays_in_sync(hvd_shutdown):
+    """Compression.int8: gradients cross the wire block-quantized, the
+    sync object keeps error-feedback residuals, and every rank applies
+    the identical decoded average."""
+    def fn():
+        r = hvd.rank()
+        rng = np.random.default_rng(0)
+        w = tf.Variable(rng.standard_normal((16, 4))
+                        .astype(np.float32) * 0.1)
+        drng = np.random.default_rng(100 + r)
+        tape = hvd.DistributedGradientTape(
+            compression=hvd.Compression.int8)
+        for _ in range(3):
+            x = tf.constant(drng.standard_normal((8, 16))
+                            .astype(np.float32))
+            with tape:
+                loss = tf.reduce_mean(tf.square(x @ w))
+            g = tape.gradient(loss, [w])[0]
+            w.assign_sub(0.1 * g)
+        assert tape._sync._residuals, "residual state missing"
+        tape._sync.reset_wire_state()
+        assert not tape._sync._residuals
+        return w.numpy()
+
+    res = run_ranks(fn)
+    for v in res[1:]:
+        assert np.array_equal(v, res[0]), "ranks diverged"

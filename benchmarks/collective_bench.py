@@ -145,6 +145,93 @@ def wire_sweep(iters, wire_dtype="all", mb=8):
     return out
 
 
+def algo_sweep(iters, algorithm="all", sizes_mb=(1, 8, 32)):
+    """Topology-aware section (ISSUE 2): the same logical payload
+    through flat / hierarchical / torus on BOTH reduction paths.
+    Reports per (algorithm, size):
+
+    * ``*_MBps`` — logical goodput through the engine / compiled path;
+    * ``*_cross_bytes`` — what the engine's accounting says crossed
+      the slow (cross-host / DCN) hop per call: flat pays its whole
+      wire there, hierarchical/torus only 1/local_size of it.
+
+    Single-host jobs get a simulated 2-host slot map (the launcher's
+    HOROVOD_TPU_HOST_OF_RANK handoff, patched in-process) so the
+    hierarchical split is real; launched multi-host jobs use their
+    true topology.  A short engine-autotune session (six-dimension BO,
+    core/autotune.py) runs at the end and the converged algorithm is
+    recorded as ``autotune_algorithm_pick``."""
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics
+    from horovod_tpu.common.topology import Topology
+
+    eng = basics.engine()
+    n_ranks = hvd.size()
+    if eng.topology.num_hosts == 1 and n_ranks >= 4 \
+            and n_ranks % 2 == 0:
+        # equivalent assignment from every rank thread — idempotent
+        eng.topology = Topology(
+            size=n_ranks,
+            host_of_rank=[0] * (n_ranks // 2) + [1] * (n_ranks // 2))
+
+    algos = ("flat", "hierarchical", "torus") \
+        if algorithm == "all" else (algorithm,)
+    out = {}
+    rng = np.random.default_rng(0)
+    for mb in sizes_mb:
+        n = int(mb * (1 << 20) / 4)
+        x = rng.standard_normal(n).astype(np.float32)
+        for algo in algos:
+            tag = f"algo_{algo}_{mb}mb"
+            hvd.allreduce(x, op=hvd.Sum, name=f"{tag}.w",
+                          algorithm=algo)
+            c0 = eng.cross_wire_bytes
+            t0 = time.perf_counter()
+            for i in range(iters):
+                hvd.allreduce(x, op=hvd.Sum, name=f"{tag}.{i % 2}",
+                              algorithm=algo)
+            dt = time.perf_counter() - t0
+            out[f"{tag}_engine_MBps"] = round(mb * iters / dt, 1)
+            out[f"{tag}_engine_cross_bytes"] = \
+                (eng.cross_wire_bytes - c0) // iters
+
+            red = hvd.CompiledGroupedAllreduce(
+                op=hvd.Sum, name=f"{tag}.c", force_program=True,
+                algorithm=algo)
+            red([x])
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                red([x])
+            dt = time.perf_counter() - t0
+            out[f"{tag}_compiled_MBps"] = round(mb * iters / dt, 1)
+            out[f"{tag}_compiled_cross_bytes"] = red.last_cross_bytes
+            out[f"{tag}_resolved"] = red.last_algorithm
+
+    # short real-traffic autotune session: does the six-dimension BO
+    # (fusion/cycle/pack/cache/wire/algorithm) land on a non-flat
+    # algorithm for this configuration?
+    from horovod_tpu.core.autotune import ParameterManager
+    old_wire, old_algo = eng.config.wire_dtype, eng.config.algorithm
+    pm = None
+    if hvd.rank() == 0:
+        pm = ParameterManager(eng.config, warmup_samples=2,
+                              steps_per_sample=4, max_samples=14)
+        eng.autotuner = pm
+    xat = rng.standard_normal(int(4 * (1 << 20) / 4)) \
+        .astype(np.float32)
+    for i in range(15 * 4 + 4):
+        hvd.allreduce(xat, op=hvd.Sum, name=f"algo_at.{i % 2}")
+    if pm is not None:
+        eng.autotuner = None
+        best = pm.best_parameters()
+        out["autotune_algorithm_pick"] = best[5]
+        out["autotune_wire_pick"] = best[4] or "f32"
+        pm.close()
+        eng.config.wire_dtype, eng.config.algorithm = old_wire, old_algo
+    return out
+
+
 def proc_worker(small_count, iters):
     """Runs inside one launcher-spawned process: the store-controller
     (coordinator) negotiation path the thread launcher bypasses."""
@@ -271,6 +358,14 @@ def main():
                         "compiled paths, all three dtypes measured; "
                         "the chosen dtype is featured in "
                         "wire_reduction_vs_f32)")
+    p.add_argument("--algorithm", default=None,
+                   choices=["flat", "hier", "hierarchical", "torus",
+                            "all"],
+                   help="run the topology-aware sweep: the same "
+                        "payload through flat / hierarchical / torus "
+                        "on both paths, with cross-host byte "
+                        "accounting and a six-dimension autotune "
+                        "session at the end")
     p.add_argument("--proc-curve", default=None,
                    help="comma list of process counts, e.g. 1,2,4,8: "
                         "run the REAL launcher + coordinator at each "
@@ -301,6 +396,10 @@ def main():
     sizes = [int(s) for s in args.sizes_mb.split(",")]
 
     def body():
+        if args.algorithm:
+            algo = "hierarchical" if args.algorithm == "hier" \
+                else args.algorithm
+            return algo_sweep(args.iters, algo, tuple(sizes))
         if args.wire_dtype:
             return wire_sweep(args.iters, args.wire_dtype)
         return worker(sizes, args.small_count, args.iters)

@@ -61,6 +61,15 @@ case "${1:-all}" in
     ;;
   bench)
     python bench.py
+    # collective sweeps on the 4-rank virtual mesh: the quantized-wire
+    # section and the topology-aware algorithm section (flat vs
+    # hierarchical vs torus on both paths, with cross-host byte
+    # accounting + a six-dimension autotune pick) — the numbers
+    # docs/benchmarks.md quotes
+    python benchmarks/collective_bench.py --np 4 --cpu \
+      --wire-dtype all --iters 8
+    python benchmarks/collective_bench.py --np 4 --cpu \
+      --algorithm all --iters 8 --sizes-mb 1,8,32
     ;;
   refsuite)
     # the REFERENCE's own torch test suite, run unmodified against

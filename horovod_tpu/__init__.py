@@ -50,6 +50,6 @@ from .ops.api import (  # noqa: F401
 from .ops.compression import Compression  # noqa: F401
 from .ops.compiled import (  # noqa: F401
     compiled_allreduce, compiled_grouped_allreduce,
-    CompiledGroupedAllreduce, make_compiled_train_step,
+    CompiledGroupedAllreduce, TopologyHint, make_compiled_train_step,
 )
 from .runner.thread_launcher import run  # noqa: F401

@@ -46,6 +46,15 @@ HOROVOD_CPU_OPERATIONS = "HOROVOD_CPU_OPERATIONS"
 
 # TPU-native additions
 HOROVOD_WIRE_DTYPE = "HOROVOD_WIRE_DTYPE"      # f32 | fp16 | bf16 | int8
+# flat | hierarchical | torus (generic spelling; the reference's
+# HOROVOD_HIERARCHICAL_ALLREDUCE / HOROVOD_TORUS_ALLREDUCE booleans
+# above are honored as aliases)
+HOROVOD_ALLREDUCE_ALGORITHM = "HOROVOD_ALLREDUCE_ALGORITHM"
+# reducescatter backward convention: default matches the reference
+# (Sum grad x= size, Average unscaled); set to 1 for the true adjoint
+# of the forward (docs/migration.md "reducescatter gradients")
+HOROVOD_EXACT_ADJOINT_REDUCESCATTER = \
+    "HOROVOD_EXACT_ADJOINT_REDUCESCATTER"
 HOROVOD_TPU_PLATFORM = "HOROVOD_TPU_PLATFORM"  # jax platform for the mesh
 HOROVOD_TPU_RANKS_PER_PROC = "HOROVOD_TPU_RANKS_PER_PROC"
 HOROVOD_TPU_COORDINATOR = "HOROVOD_TPU_COORDINATOR"
@@ -112,6 +121,19 @@ class Config:
         from ..ops.quantize import normalize_wire_dtype
         self.wire_dtype = normalize_wire_dtype(
             get_str(HOROVOD_WIRE_DTYPE))
+        # default reduction algorithm for float Sum/Average allreduces
+        # (per-request algorithm= overrides; autotune sweeps this as
+        # its sixth dimension).  The reference's boolean toggles
+        # (HOROVOD_TORUS_ALLREDUCE wins over HIERARCHICAL, matching
+        # the fork's NCCL dispatch order) alias the generic knob.
+        from .topology import normalize_algorithm
+        if get_bool(HOROVOD_TORUS_ALLREDUCE):
+            self.algorithm = "torus"
+        elif get_bool(HOROVOD_HIERARCHICAL_ALLREDUCE):
+            self.algorithm = "hierarchical"
+        else:
+            self.algorithm = normalize_algorithm(
+                get_str(HOROVOD_ALLREDUCE_ALGORITHM))
         self.timeline_filename = get_str(HOROVOD_TIMELINE)
         if self.timeline_filename == "DYNAMIC":
             # reference sentinel (test_torch.py:54): timeline support

@@ -5,10 +5,137 @@ The reference derives a 3-level topology from MPI communicators
 uses it for hierarchical and torus collectives.  On TPU the same levels
 fall out of the platform: ranks on one host share ICI (local), hosts
 connect over DCN (cross).
+
+This module also owns the ALGORITHM vocabulary for topology-aware
+reductions (the reference's ``HOROVOD_HIERARCHICAL_ALLREDUCE`` /
+``HOROVOD_TORUS_ALLREDUCE`` toggles, ``nccl_operations.cc:606-830``):
+
+* ``flat``          — one collective over all ranks (the default).
+* ``hierarchical``  — reducescatter over each host's ranks (ICI),
+  allreduce of the shards across hosts (DCN), allgather back.  Only
+  1/local_size of the logical bytes cross the slow hop.
+* ``torus``         — the same two-stage decomposition over a 2-D
+  factorization of the rank space (Google's 2-D torus allreduce on
+  TPU-v3 pods, arXiv:1909.09756), for multi-axis device meshes.
+
+:func:`plan_decomposition` turns (algorithm, topology, set ranks) into
+the inner-axis size the executors reshape their meshes by — or
+``None`` when the request degrades to flat (heterogeneous hosts,
+prime world sizes, tiny sets), exactly like the reference's
+``is_homogeneous`` fallback.
 """
 
 from dataclasses import dataclass, field
 from typing import List
+
+#: algorithm vocabulary, in autotune-grid order (core/autotune.py)
+ALGORITHMS = ("flat", "hierarchical", "torus")
+
+_ALGO_ALIASES = {
+    # None / "" = UNSET (a process-wide default may apply); an
+    # explicit 'flat' spelling = "one flat collective, overriding any
+    # default" — the same unset-vs-explicit split wire_dtype carries
+    None: None, "": None,
+    "flat": "flat", "none": "flat", "ring": "flat",
+    "hier": "hierarchical", "hierarchical": "hierarchical",
+    "torus": "torus", "2d": "torus",
+}
+
+
+def normalize_algorithm(algorithm):
+    """Canonicalize an algorithm spec -> None (unset) | 'flat'
+    (explicit) | 'hierarchical' | 'torus'."""
+    key = algorithm.strip().lower() if isinstance(algorithm, str) \
+        else algorithm
+    try:
+        return _ALGO_ALIASES[key]
+    except KeyError:
+        raise ValueError(
+            f"unknown allreduce algorithm {algorithm!r}: expected one "
+            f"of {ALGORITHMS}")
+
+
+def torus_inner(n):
+    """Largest factor of ``n`` that is <= sqrt(n): the near-square 2-D
+    factorization the torus decomposition reshapes the rank space by.
+    Returns 1 for primes / n < 4 (no useful second axis)."""
+    best = 1
+    f = 1
+    while f * f <= n:
+        if n % f == 0:
+            best = f
+        f += 1
+    return best
+
+
+def _grouped_local_size(topology, ranks):
+    """Per-host rank count when the set's ranks are grouped by host
+    with the SAME count on every spanned host (the reference's
+    ``is_homogeneous`` gate); None otherwise (or single-host /
+    unknown topology)."""
+    if topology is None:
+        return None
+    hosts = []
+    for r in ranks:
+        try:
+            hosts.append(topology.host_of_rank[r])
+        except IndexError:
+            return None
+    counts = {}
+    for h in hosts:
+        counts[h] = counts.get(h, 0) + 1
+    if len(counts) < 2 or len(set(counts.values())) != 1:
+        return None       # single host or heterogeneous
+    # ranks must be grouped by host (the launcher emits hosts in slot
+    # order, so this holds for every launched job)
+    if any(hosts[i] > hosts[i + 1] for i in range(len(hosts) - 1)):
+        return None
+    return len(ranks) // len(counts)
+
+
+def plan_decomposition(algorithm, topology, ranks):
+    """Inner-axis size for a 2-stage reduction over ``ranks``, or
+    ``None`` when the algorithm degrades to flat.
+
+    ``hierarchical`` needs the set's ranks grouped by host with the
+    SAME count on every spanned host (the reference's
+    ``is_homogeneous`` gate on ``NCCLHierarchicalAllreduce``) and
+    more than one host; ``torus`` needs a composite set size.  The
+    inner axis is the fast (ICI) hop: host-local ranks for
+    hierarchical, the near-square factor for torus — and on
+    multi-host jobs the torus inner axis is CONSTRAINED to divisors
+    of the per-host rank count so its heavy reducescatter/allgather
+    hops never straddle a DCN boundary (otherwise the "fast" axis
+    would be the slow one and the cross-byte accounting a lie)."""
+    algorithm = normalize_algorithm(algorithm)
+    if algorithm in (None, "flat"):
+        return None
+    n = len(ranks)
+    if n < 4:
+        return None
+    local = _grouped_local_size(topology, ranks)
+    if algorithm == "torus":
+        if local is None:
+            # single host (or no host map): any near-square split of
+            # the one ICI domain works
+            if topology is not None and topology.num_hosts > 1:
+                # spans hosts but heterogeneous/ungrouped: no safe
+                # inner axis
+                return None
+            inner = torus_inner(n)
+            return inner if inner > 1 else None
+        # multi-host: inner must divide the per-host count so each
+        # inner group stays on one host; pick the divisor nearest the
+        # near-square ideal, falling back to the whole host (= the
+        # hierarchical split) when the host count itself is the only
+        # intra-host factor
+        divisors = [d for d in range(2, local + 1) if local % d == 0]
+        if not divisors:
+            return None
+        near_square = [d for d in divisors if d * d <= n]
+        return max(near_square) if near_square else min(divisors)
+    # hierarchical: the whole host is the inner axis
+    return local
 
 
 @dataclass

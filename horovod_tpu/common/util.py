@@ -201,6 +201,26 @@ def get_average_backwards_compatibility_fun(reduce_ops):
     return impl
 
 
+def reducescatter_grad_factor(op_is_average, size):
+    """Scalar the reducescatter backward multiplies the allgathered
+    cotangent by (before the linear prescale*postscale the forward
+    applied).
+
+    Default: the REFERENCE convention (tensorflow/mpi_ops.py:483-506 /
+    torch mpi_ops_v2 — Sum gradient scaled BY world size, Average
+    unscaled), which is size x the true adjoint of the Sum forward but
+    is what every migrated multi-worker job was trained against.
+    ``HOROVOD_EXACT_ADJOINT_REDUCESCATTER=1`` opts into the exact
+    adjoint (Sum unscaled, Average /= size); the two coincide at
+    world size 1.  See docs/migration.md "reducescatter gradients"."""
+    from . import env as env_mod
+
+    exact = env_mod.get_bool(env_mod.HOROVOD_EXACT_ADJOINT_REDUCESCATTER)
+    if op_is_average:
+        return 1.0 / size if exact else 1.0
+    return 1.0 if exact else float(size)
+
+
 def num_rank_is_power_2(num_rank):
     """Adasum's rank-count precondition (reference util.py:235)."""
     return num_rank != 0 and (num_rank & (num_rank - 1)) == 0

@@ -3,21 +3,25 @@
 sample windows, warmup discard, Bayesian optimization over tunables,
 CSV log via HOROVOD_AUTOTUNE_LOG, converge-to-best after max samples).
 
-Tunables here are the five that exist on the TPU engine: the fusion
+Tunables here are the six that exist on the TPU engine: the fusion
 threshold (bucket size for packed allreduces), the cycle time (how
 long the background thread batches submissions), the
 multithreaded-pack threshold (bucket size above which the native pack
 fans out across threads), the coordinator response-cache capacity
 (the reference tunes cache on/off, parameter_manager.h:65; here the
-LRU size tunes smoothly with 0 = disabled), and the WIRE DTYPE
-(f32 / bf16 / block-scaled int8, ops/quantize.py).  The score is
-LOGICAL bytes/sec — gradient goodput — so shrinking the wire payload
-raises the score exactly when the interconnect, not the chip, is the
-bottleneck: that is how the parameter manager learns to turn
-quantization on for network-bound jobs and leave it off when encode
-overhead outweighs the saved bytes.  The reference's
-hierarchical/torus toggles have no analogue — topology-aware routing
-belongs to XLA.
+LRU size tunes smoothly with 0 = disabled), the WIRE DTYPE
+(f32 / bf16 / block-scaled int8, ops/quantize.py), and the reduction
+ALGORITHM (flat / hierarchical / torus, common/topology.py — the
+reference's HOROVOD_HIERARCHICAL_ALLREDUCE / HOROVOD_TORUS_ALLREDUCE
+toggles as one swept categorical).  The score is LOGICAL bytes/sec —
+gradient goodput — so shrinking the wire payload (or keeping it off
+the cross-host hop) raises the score exactly when the interconnect,
+not the chip, is the bottleneck: that is how the parameter manager
+learns to turn quantization or hierarchical routing on for
+network-bound jobs and leave them off when the extra hops outweigh
+the saved slow-hop bytes.  Algorithms that do not factor the running
+topology silently execute flat (engine._algo_plan), so a sweep never
+breaks a job — it just scores the fallback.
 """
 
 import time
@@ -25,6 +29,7 @@ import time
 import numpy as np
 
 from .optim import BayesianOptimizer
+from ..common.topology import ALGORITHMS
 from ..ops.quantize import WIRE_CHOICES
 
 # log2 bounds: fusion threshold 1 MiB .. 256 MiB, cycle 0.5 .. 32 ms,
@@ -37,20 +42,25 @@ _CACHE_BITS = 12.0
 
 class ParameterManager:
     def __init__(self, config, warmup_samples=3, steps_per_sample=10,
-                 max_samples=20, log_path=None, seed=0, tune_wire=True):
+                 max_samples=20, log_path=None, seed=0, tune_wire=True,
+                 tune_algorithm=True):
         self.config = config
         self.warmup_samples = warmup_samples
         self.steps_per_sample = steps_per_sample
         self.max_samples = max_samples
         self.active = True
-        # tune_wire=False drops the wire-dtype dimension entirely (4-dim
-        # BO): the coordinator-side autotuner (runner/http/http_server)
-        # has no consumer for a tuned wire format, and sweeping a
-        # dimension nothing applies would waste samples and write
-        # never-applied wire dtypes into the CSV
+        # tune_wire=False / tune_algorithm=False drop those categorical
+        # dimensions entirely: the coordinator-side autotuner
+        # (runner/http/http_server) has no distribution channel for a
+        # tuned wire format or algorithm (workers applying a new
+        # default at different cycles would fail the cross-process
+        # consistency check), and sweeping a dimension nothing applies
+        # would waste samples and write never-applied values into the
+        # CSV
         self.tune_wire = bool(tune_wire)
-        self._bo = BayesianOptimizer(dims=5 if self.tune_wire else 4,
-                                     seed=seed)
+        self.tune_algorithm = bool(tune_algorithm)
+        dims = 4 + int(self.tune_wire) + int(self.tune_algorithm)
+        self._bo = BayesianOptimizer(dims=dims, seed=seed)
         self._samples = 0
         self._steps = 0
         self._bytes = 0
@@ -59,21 +69,23 @@ class ParameterManager:
             config.fusion_threshold_bytes, config.cycle_time_ms,
             getattr(config, "pack_mt_threshold_bytes", 8 << 20),
             getattr(config, "cache_capacity", 1024),
-            getattr(config, "wire_dtype", None))
+            getattr(config, "wire_dtype", None),
+            getattr(config, "algorithm", None))
         self._best_score = -np.inf
         self._best = self._current
         self._log = open(log_path, "w") if log_path else None
         if self._log:
             wire_col = "wire_dtype," if self.tune_wire else ""
+            algo_col = "algorithm," if self.tune_algorithm else ""
             self._log.write(
                 "sample,fusion_threshold_bytes,cycle_time_ms,"
                 f"pack_mt_threshold_bytes,cache_capacity,{wire_col}"
-                "score_bytes_per_sec\n")
+                f"{algo_col}score_bytes_per_sec\n")
 
     # -- encoding ------------------------------------------------------------
 
     def _encode(self, fusion_bytes, cycle_ms, pack_mt_bytes,
-                cache_capacity, wire_dtype=None):
+                cache_capacity, wire_dtype=None, algorithm=None):
         x0 = (np.log2(max(fusion_bytes, 1)) - _FUSION_LO) / \
             (_FUSION_HI - _FUSION_LO)
         x1 = (np.log2(max(cycle_ms, 2 ** _CYCLE_LO)) - _CYCLE_LO) / \
@@ -81,19 +93,27 @@ class ParameterManager:
         x2 = (np.log2(max(pack_mt_bytes, 1)) - _PACKMT_LO) / \
             (_PACKMT_HI - _PACKMT_LO)
         x3 = np.log2(cache_capacity + 1) / _CACHE_BITS
-        if not self.tune_wire:
-            return np.clip([x0, x1, x2, x3], 0.0, 1.0)
-        # fifth dimension: wire dtype as a categorical grid over [0, 1]
-        # (WIRE_CHOICES at bin centers — the BO's continuous
-        # suggestion snaps to the nearest bin in _decode); an explicit
-        # 'f32' default encodes as the full-width bin
-        try:
-            wi = WIRE_CHOICES.index(
-                None if wire_dtype == "f32" else wire_dtype)
-        except ValueError:
-            wi = 0
-        x4 = (wi + 0.5) / len(WIRE_CHOICES)
-        return np.clip([x0, x1, x2, x3, x4], 0.0, 1.0)
+        xs = [x0, x1, x2, x3]
+        if self.tune_wire:
+            # fifth dimension: wire dtype as a categorical grid over
+            # [0, 1] (WIRE_CHOICES at bin centers — the BO's continuous
+            # suggestion snaps to the nearest bin in _decode); an
+            # explicit 'f32' default encodes as the full-width bin
+            try:
+                wi = WIRE_CHOICES.index(
+                    None if wire_dtype == "f32" else wire_dtype)
+            except ValueError:
+                wi = 0
+            xs.append((wi + 0.5) / len(WIRE_CHOICES))
+        if self.tune_algorithm:
+            # sixth dimension: reduction algorithm over the same kind
+            # of categorical grid; an unset default encodes as flat
+            try:
+                ai = ALGORITHMS.index(algorithm or "flat")
+            except ValueError:
+                ai = 0
+            xs.append((ai + 0.5) / len(ALGORITHMS))
+        return np.clip(xs, 0.0, 1.0)
 
     def _decode(self, x):
         fusion = int(2 ** (_FUSION_LO + x[0] * (_FUSION_HI - _FUSION_LO)))
@@ -102,11 +122,16 @@ class ParameterManager:
         # capacity 0 (cache off) is reachable at the low end — the
         # reference's cache-enabled toggle as the floor of a smooth dim
         cache = int(round(2 ** (x[3] * _CACHE_BITS))) - 1
-        if not self.tune_wire:
-            return fusion, cycle, pack_mt, cache
-        wi = min(int(x[4] * len(WIRE_CHOICES)), len(WIRE_CHOICES) - 1)
-        wire = WIRE_CHOICES[wi]
-        return fusion, cycle, pack_mt, cache, wire
+        out = [fusion, cycle, pack_mt, cache]
+        i = 4
+        if self.tune_wire:
+            wi = min(int(x[i] * len(WIRE_CHOICES)), len(WIRE_CHOICES) - 1)
+            out.append(WIRE_CHOICES[wi])
+            i += 1
+        if self.tune_algorithm:
+            ai = min(int(x[i] * len(ALGORITHMS)), len(ALGORITHMS) - 1)
+            out.append(ALGORITHMS[ai])
+        return tuple(out)
 
     # -- recording (engine hot path) ----------------------------------------
 
@@ -129,10 +154,15 @@ class ParameterManager:
         if self._log:
             decoded = self._decode(self._current)
             fusion, cycle, pack_mt, cache = decoded[:4]
-            wire_col = f"{decoded[4] or 'f32'}," if self.tune_wire else ""
+            i = 4
+            wire_col = ""
+            if self.tune_wire:
+                wire_col = f"{decoded[i] or 'f32'},"
+                i += 1
+            algo_col = f"{decoded[i]}," if self.tune_algorithm else ""
             self._log.write(
                 f"{self._samples},{fusion},{cycle:.3f},{pack_mt},"
-                f"{cache},{wire_col}{score:.1f}\n")
+                f"{cache},{wire_col}{algo_col}{score:.1f}\n")
             self._log.flush()
         if self._samples > self.warmup_samples:
             self._bo.observe(self._current, score)
@@ -158,8 +188,12 @@ class ParameterManager:
         self.config.cycle_time_ms = cycle
         self.config.pack_mt_threshold_bytes = pack_mt
         self.config.cache_capacity = cache
+        i = 4
         if self.tune_wire:
-            self.config.wire_dtype = decoded[4]
+            self.config.wire_dtype = decoded[i]
+            i += 1
+        if self.tune_algorithm:
+            self.config.algorithm = decoded[i]
 
     def best_parameters(self):
         return self._decode(self._best)

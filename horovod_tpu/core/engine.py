@@ -63,7 +63,8 @@ class NegotiationEntry:
     """Readiness table row (reference controller.cc:1115-1140
     IncrementTensorCount)."""
 
-    __slots__ = ("key", "subs", "first_time", "wire_default")
+    __slots__ = ("key", "subs", "first_time", "wire_default",
+                 "algo_default")
 
     def __init__(self, key):
         self.key = key
@@ -74,6 +75,8 @@ class NegotiationEntry:
         # between two ranks' submits of the same tensor cannot split
         # one negotiation across two wire formats
         self.wire_default = None
+        # ditto for the reduction algorithm (config.algorithm)
+        self.algo_default = None
 
 
 class ProcessSetState:
@@ -167,6 +170,7 @@ class Engine:
         self._arena = _native.Arena()
 
         self._stall_warned = set()
+        self._algo_warned = set()
         #: fused-allgather buckets executed (observability + tests)
         self.fused_allgather_runs = 0
         #: wire accounting (observability + collective_bench): logical
@@ -175,6 +179,14 @@ class Engine:
         #: (int8 codes + bf16 scales for the quantized wire)
         self.logical_wire_bytes = 0
         self.actual_wire_bytes = 0
+        #: bytes that crossed the SLOW (cross-host / DCN) hop — the
+        #: number topology-aware algorithms exist to shrink: flat
+        #: collectives on a multi-host set pay their full wire here,
+        #: hierarchical/torus only 1/inner of it
+        self.cross_wire_bytes = 0
+        #: buckets executed per reduction algorithm
+        #: (flat / hierarchical / torus) — observability + tests
+        self.algo_runs = {}
         #: quantized (int8-wire) buckets executed
         self.quantized_bucket_runs = 0
         #: hold_cycles() depth — while >0 the loop parks (no dispatch)
@@ -439,6 +451,8 @@ class Engine:
             if entry is None:
                 entry = NegotiationEntry(key)
                 entry.wire_default = self.config.wire_dtype
+                entry.algo_default = getattr(
+                    self.config, "algorithm", None)
                 ps.pending[key] = entry
             req = sub.request
             if (req.wire_dtype is None and entry.wire_default
@@ -454,6 +468,13 @@ class Engine:
                 # cross-rank wire check loudly instead of executing
                 # different collective programs against each other
                 req.wire_dtype = entry.wire_default
+            if (req.algorithm is None and entry.algo_default
+                    and req.request_type == RequestType.ALLREDUCE
+                    and req.reduce_op in (ReduceOp.SUM,
+                                          ReduceOp.AVERAGE)):
+                # same latch for the reduction algorithm (autotune's
+                # sixth dimension): one negotiation, one algorithm
+                req.algorithm = entry.algo_default
             if sub.rank in entry.subs:
                 sub.handle.set_error(DuplicateNameError(
                     f"tensor {sub.names} submitted twice by rank "
@@ -664,6 +685,7 @@ class Engine:
             "pre": req.prescale_factor,
             "post": req.postscale_factor,
             "wire": req.wire_dtype,
+            "algo": req.algorithm,
             "ps": ps.id,
             "nbytes": nbytes,
             "nprocs": nprocs,
@@ -820,7 +842,8 @@ class Engine:
             rank=-1, dtype=meta["dtype"], shape=tuple(meta["shape"]),
             reduce_op=ReduceOp(meta["op"]),
             prescale_factor=meta["pre"], postscale_factor=meta["post"],
-            process_set_id=meta["ps"], wire_dtype=meta.get("wire"))
+            process_set_id=meta["ps"], wire_dtype=meta.get("wire"),
+            algorithm=meta.get("algo"))
         dtype = np.dtype(meta["dtype"]) if meta["dtype"] != "bfloat16" \
             else _bfloat16_dtype()
         sub = Submission(rank=-1, request=req, names=[key],
@@ -886,6 +909,11 @@ class Engine:
                     f"Mismatched wire dtypes for {first.tensor_name}: "
                     f"rank {sub.rank} sent {r.wire_dtype}, rank "
                     f"{subs[0].rank} sent {first.wire_dtype}")
+            if r.algorithm != first.algorithm:
+                return TensorShapeMismatchError(
+                    f"Mismatched algorithms for {first.tensor_name}: "
+                    f"rank {sub.rank} sent {r.algorithm}, rank "
+                    f"{subs[0].rank} sent {first.algorithm}")
             if rt == RequestType.BROADCAST and r.root_rank != first.root_rank:
                 return TensorShapeMismatchError(
                     f"Mismatched broadcast root for {first.tensor_name}: "
@@ -954,14 +982,18 @@ class Engine:
             first = next(iter(entry.subs.values()))
             rt = first.request.request_type
             if rt in (RequestType.ALLREDUCE, RequestType.ADASUM):
-                # wire dtype is part of the bucket signature: quantized
-                # (int8) payloads pack contiguously with each other and
-                # never share a fusion buffer with full-width tensors
+                # wire dtype AND algorithm are part of the bucket
+                # signature: quantized (int8) payloads pack
+                # contiguously with each other and never share a
+                # fusion buffer with full-width tensors, and a
+                # hierarchical bucket never fuses with a flat one
+                # (they run different SPMD programs)
                 sig = (rt, first.request.dtype,
                        first.request.reduce_op,
                        first.request.prescale_factor,
                        first.request.postscale_factor,
-                       first.request.wire_dtype)
+                       first.request.wire_dtype,
+                       first.request.algorithm)
                 nbytes = sum(p.nbytes for p in first.payloads)
             elif rt == RequestType.ALLGATHER:
                 sig = (rt, first.request.dtype)
@@ -991,7 +1023,11 @@ class Engine:
         if self.timeline is not None:
             names = [n for e in bucket for s in (next(iter(e.subs.values())),)
                      for n in s.names]
-            self.timeline.op_start(names, rt.name)
+            algo = None
+            if rt in (RequestType.ALLREDUCE, RequestType.ADASUM):
+                algo, _ = self._algo_plan(ps, first.request,
+                                          first.request.reduce_op)
+            self.timeline.op_start(names, rt.name, algorithm=algo)
         try:
             if rt in (RequestType.ALLREDUCE, RequestType.ADASUM):
                 self._run_allreduce_bucket(ps, bucket)
@@ -1114,9 +1150,27 @@ class Engine:
             return None
         return wire
 
-    def _account_wire(self, logical, actual):
+    def _spans_hosts(self, ps=None):
+        """Whether the job (or one process set) crosses a DCN hop."""
+        topo = self.topology
+        if topo is None or not topo.host_of_rank:
+            return False
+        if ps is None:
+            return topo.num_hosts > 1
+        hosts = {topo.host_of_rank[r] for r in ps.ranks
+                 if r < len(topo.host_of_rank)}
+        return len(hosts) > 1
+
+    def _account_wire(self, logical, actual, cross=None):
+        """``cross`` = bytes over the slow (cross-host) hop; ``None``
+        means the collective was flat, so its whole wire crosses DCN
+        whenever the job spans hosts (topology-aware dispatch passes
+        its decomposed cross-hop bytes explicitly)."""
         self.logical_wire_bytes += int(logical)
         self.actual_wire_bytes += int(actual)
+        if cross is None:
+            cross = actual if self._spans_hosts() else 0
+        self.cross_wire_bytes += int(cross)
 
     def _encode_int8_rows(self, rows, logical_nbytes):
         """Block-quantize per-rank rows for the int8 wire (shared by
@@ -1133,21 +1187,61 @@ class Engine:
         self.quantized_bucket_runs += 1
         return q_rows, s_rows
 
+    def _algo_plan(self, ps, req, op):
+        """Effective (algorithm, inner-axis size) for an allreduce
+        bucket.  Non-flat algorithms need a float Sum/Average payload,
+        shard mode (one device per rank — decomposition is meaningless
+        when rank threads share a chip) and a topology that factors
+        (common/topology.plan_decomposition); anything else degrades
+        to flat, the reference's ``is_homogeneous`` fallback."""
+        algo = req.algorithm
+        if req.request_type == RequestType.ADASUM:
+            op = ReduceOp.ADASUM
+        if algo in (None, "flat"):
+            return "flat", None
+        if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+            return "flat", None
+        if req.dtype != "bfloat16" and \
+                not np.issubdtype(np.dtype(req.dtype), np.floating):
+            return "flat", None
+        if not ps.executor.shard_mode:
+            return "flat", None
+        from ..common.topology import plan_decomposition
+        inner = plan_decomposition(algo, self.topology, ps.ranks)
+        if inner is None:
+            key = (ps.id, algo)
+            if key not in self._algo_warned:
+                self._algo_warned.add(key)
+                logger.debug(
+                    "%s allreduce requested but process set %d "
+                    "(%d ranks) does not decompose; running flat",
+                    algo, ps.id, ps.size)
+            return "flat", None
+        return algo, inner
+
     def _dispatch_allreduce(self, ps, req, op, dtype, rows, total):
-        """Run the fused allreduce over the configured wire format:
-        full width, 16-bit cast, or block-scaled int8 (encode ->
-        quantized collective -> f32 decode).  The tentpole wire
-        optimization of this engine path."""
+        """Run the fused allreduce over the configured wire format AND
+        algorithm: full width, 16-bit cast, or block-scaled int8
+        (encode -> quantized collective -> f32 decode) x flat /
+        hierarchical / torus (ops/xla_ops.allreduce_2d)."""
         wire = self._wire_for(req, dtype, op)
+        algo, inner = self._algo_plan(ps, req, op)
+        self.algo_runs[algo] = self.algo_runs.get(algo, 0) + 1
         itemsize = dtype.itemsize
+        if algo != "flat":
+            return self._dispatch_allreduce_2d(
+                ps, req, op, dtype, rows, total, wire, inner)
+        flat_cross = total * itemsize if self._spans_hosts(ps) else 0
         if wire is None:
-            self._account_wire(total * itemsize, total * itemsize)
+            self._account_wire(total * itemsize, total * itemsize,
+                               cross=flat_cross)
             return ps.executor.allreduce(
                 rows, op, req.prescale_factor, req.postscale_factor)
         if wire in ("fp16", "bf16"):
             wdt = np.dtype(np.float16) if wire == "fp16" \
                 else _bfloat16_dtype()
-            self._account_wire(total * itemsize, total * 2)
+            self._account_wire(total * itemsize, total * 2,
+                               cross=total * 2 if flat_cross else 0)
             out = ps.executor.allreduce(
                 [r.astype(wdt) for r in rows], op,
                 req.prescale_factor, req.postscale_factor)
@@ -1157,6 +1251,45 @@ class Engine:
             q_rows, s_rows, op, req.prescale_factor,
             req.postscale_factor)
         return [o[:total].astype(dtype) for o in out]
+
+    def _dispatch_allreduce_2d(self, ps, req, op, dtype, rows, total,
+                               wire, inner):
+        """Hierarchical / torus bucket: reducescatter along the fast
+        (inner) axis, allreduce the 1/inner shard along the slow
+        (outer) axis — quantized when the wire says int8 — allgather
+        back.  Cross-hop accounting shows the decomposition's whole
+        point: only the shard crosses DCN.  Like the flat branch,
+        cross bytes are attributed only when the set actually spans
+        hosts — a single-host torus run has no DCN hop, and counting
+        one would invert the flat-vs-torus comparison the field
+        exists for."""
+        from ..ops import quantize as qz
+        itemsize = dtype.itemsize
+        m = -(-total // inner)          # cross-hop shard elements
+        spans = self._spans_hosts(ps)
+        if wire in ("fp16", "bf16"):
+            wdt = np.dtype(np.float16) if wire == "fp16" \
+                else _bfloat16_dtype()
+            self._account_wire(total * itemsize, total * 2,
+                               cross=m * 2 if spans else 0)
+            out = ps.executor.allreduce_2d(
+                [r.astype(wdt) for r in rows], op,
+                req.prescale_factor, req.postscale_factor, inner)
+            return [o.astype(dtype) for o in out]
+        if wire == "int8":
+            # local hops ship full width (ICI is cheap); the cross hop
+            # ships shared-scale integer partials + bf16 scales
+            cross = qz.quantized_psum_wire_nbytes(m, ps.size // inner)
+            self._account_wire(total * itemsize, total * itemsize,
+                               cross=cross if spans else 0)
+            self.quantized_bucket_runs += 1
+            return ps.executor.allreduce_2d(
+                rows, op, req.prescale_factor, req.postscale_factor,
+                inner, wire="int8")
+        self._account_wire(total * itemsize, total * itemsize,
+                           cross=m * itemsize if spans else 0)
+        return ps.executor.allreduce_2d(
+            rows, op, req.prescale_factor, req.postscale_factor, inner)
 
     def _global_dim0s(self, ps, entry, aux, n_tensors):
         """Global per-rank first-dim table for allgather.  Local mode

@@ -84,6 +84,11 @@ class Request:
     # ops/quantize.py).  Cross-rank validated like dtype — ranks
     # disagreeing on the wire format would mis-decode each other.
     wire_dtype: Optional[str] = None
+    # reduction algorithm for THIS collective: None (= process-wide
+    # default) | 'flat' | 'hierarchical' | 'torus'
+    # (common/topology.py).  Cross-rank validated like wire_dtype —
+    # ranks disagreeing would issue different SPMD programs.
+    algorithm: Optional[str] = None
     # grouped submissions: shape of EVERY member tensor, so cross-rank
     # validation covers members beyond the first (the reference issues
     # one Request per member inside the group instead)
@@ -106,6 +111,7 @@ class Request:
             "gs": [list(s) for s in self.group_shapes]
             if self.group_shapes is not None else None,
             "w": self.wire_dtype,
+            "alg": self.algorithm,
         }
 
     @classmethod
@@ -126,6 +132,7 @@ class Request:
             group_shapes=tuple(tuple(s) for s in d["gs"])
             if d.get("gs") is not None else None,
             wire_dtype=d.get("w"),
+            algorithm=d.get("alg"),
         )
 
 

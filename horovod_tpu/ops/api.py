@@ -12,6 +12,7 @@ import numpy as np
 from ..common import basics
 from ..common import util
 from ..common.process_sets import ProcessSet, global_process_set
+from ..common.topology import normalize_algorithm
 from ..core.engine import Submission
 from ..core.handles import Handle
 from ..core.message import (
@@ -102,7 +103,8 @@ def _check_scale(dtype, prescale_factor, postscale_factor):
 
 def allreduce_async(tensor, average=None, name=None, op=None,
                     prescale_factor=1.0, postscale_factor=1.0,
-                    process_set=global_process_set, wire_dtype=None):
+                    process_set=global_process_set, wire_dtype=None,
+                    algorithm=None):
     arr, kind = util.to_numpy(tensor)
     ctx = basics.context()
     op = _resolve_op(op, average, arr.dtype)
@@ -113,7 +115,8 @@ def allreduce_async(tensor, average=None, name=None, op=None,
         dtype=normalize_dtype(arr.dtype), shape=tuple(arr.shape),
         reduce_op=op, prescale_factor=prescale_factor,
         postscale_factor=postscale_factor, process_set_id=_ps_id(process_set),
-        wire_dtype=normalize_wire_dtype(wire_dtype))
+        wire_dtype=normalize_wire_dtype(wire_dtype),
+        algorithm=normalize_algorithm(algorithm))
     h = _submit(req, [arr], [name])
     h.kind = kind
     return h
@@ -121,28 +124,34 @@ def allreduce_async(tensor, average=None, name=None, op=None,
 
 def allreduce(tensor, average=None, name=None, op=None,
               prescale_factor=1.0, postscale_factor=1.0,
-              process_set=global_process_set, wire_dtype=None):
+              process_set=global_process_set, wire_dtype=None,
+              algorithm=None):
     h = allreduce_async(tensor, average, name, op, prescale_factor,
-                        postscale_factor, process_set, wire_dtype)
+                        postscale_factor, process_set, wire_dtype,
+                        algorithm)
     return synchronize(h)
 
 
 def allreduce_async_(tensor, average=None, name=None, op=None,
                      prescale_factor=1.0, postscale_factor=1.0,
-                     process_set=global_process_set, wire_dtype=None):
+                     process_set=global_process_set, wire_dtype=None,
+                     algorithm=None):
     """In-place variant: result is copied back into ``tensor`` when it
     is a mutable ndarray (reference allreduce_async_)."""
     h = allreduce_async(tensor, average, name, op, prescale_factor,
-                        postscale_factor, process_set, wire_dtype)
+                        postscale_factor, process_set, wire_dtype,
+                        algorithm)
     h.inplace_target = tensor if _mutable(tensor) else None
     return h
 
 
 def allreduce_(tensor, average=None, name=None, op=None,
                prescale_factor=1.0, postscale_factor=1.0,
-               process_set=global_process_set, wire_dtype=None):
+               process_set=global_process_set, wire_dtype=None,
+               algorithm=None):
     h = allreduce_async_(tensor, average, name, op, prescale_factor,
-                         postscale_factor, process_set, wire_dtype)
+                         postscale_factor, process_set, wire_dtype,
+                         algorithm)
     return synchronize(h)
 
 
@@ -191,7 +200,7 @@ class _MultiHandle:
 def grouped_allreduce_async(tensors, average=None, name=None, op=None,
                             prescale_factor=1.0, postscale_factor=1.0,
                             process_set=global_process_set,
-                            wire_dtype=None):
+                            wire_dtype=None, algorithm=None):
     """Grouped ops negotiate and execute as one unit (reference
     EnqueueTensorAllreduces, operations.cc:1408; group_table.h).
     Mixed-dtype groups partition into one fused submission per dtype
@@ -221,7 +230,7 @@ def grouped_allreduce_async(tensors, average=None, name=None, op=None,
             sub = _grouped_allreduce_uniform(
                 [arrs[i] for i in idxs], average, f"{base}.{dt}", op,
                 prescale_factor, postscale_factor, process_set, ctx,
-                wire_dtype)
+                wire_dtype, algorithm)
             parts.append(sub)
             index_lists.append(idxs)
         h = _MultiHandle(parts, index_lists, len(arrs))
@@ -229,14 +238,15 @@ def grouped_allreduce_async(tensors, average=None, name=None, op=None,
         return h
     h = _grouped_allreduce_uniform(arrs, average, base, op,
                                    prescale_factor, postscale_factor,
-                                   process_set, ctx, wire_dtype)
+                                   process_set, ctx, wire_dtype,
+                                   algorithm)
     h.kind = kinds
     return h
 
 
 def _grouped_allreduce_uniform(arrs, average, base, op, prescale_factor,
                                postscale_factor, process_set, ctx,
-                               wire_dtype=None):
+                               wire_dtype=None, algorithm=None):
     op = _resolve_op(op, average, arrs[0].dtype)
     _check_scale(arrs[0].dtype, prescale_factor, postscale_factor)
     names = [f"{base}.{i}" for i in range(len(arrs))]
@@ -247,7 +257,8 @@ def _grouped_allreduce_uniform(arrs, average, base, op, prescale_factor,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
         process_set_id=_ps_id(process_set), group_id=0,
         group_shapes=tuple(tuple(a.shape) for a in arrs),
-        wire_dtype=normalize_wire_dtype(wire_dtype))
+        wire_dtype=normalize_wire_dtype(wire_dtype),
+        algorithm=normalize_algorithm(algorithm))
     h = _submit(req, arrs, names)
     h.grouped = True
     return h
@@ -255,9 +266,11 @@ def _grouped_allreduce_uniform(arrs, average, base, op, prescale_factor,
 
 def grouped_allreduce(tensors, average=None, name=None, op=None,
                       prescale_factor=1.0, postscale_factor=1.0,
-                      process_set=global_process_set, wire_dtype=None):
+                      process_set=global_process_set, wire_dtype=None,
+                      algorithm=None):
     h = grouped_allreduce_async(tensors, average, name, op, prescale_factor,
-                                postscale_factor, process_set, wire_dtype)
+                                postscale_factor, process_set, wire_dtype,
+                                algorithm)
     return synchronize(h)
 
 

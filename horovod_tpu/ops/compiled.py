@@ -36,6 +36,8 @@ Two deliverables live here:
 """
 
 import threading
+from dataclasses import dataclass
+from typing import Tuple
 
 import numpy as np
 
@@ -46,15 +48,43 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..common import basics
 from ..common.process_sets import ProcessSet, global_process_set
+from ..common.topology import normalize_algorithm, plan_decomposition
 from ..core.message import Adasum, Average, ReduceOp, Sum
 from . import adasum as adasum_ops
 from . import quantize as quantize_mod
 from .xla_ops import shard_map, _is_float
 
 __all__ = [
-    "CompiledGroupedAllreduce", "compiled_allreduce",
+    "CompiledGroupedAllreduce", "TopologyHint", "compiled_allreduce",
     "compiled_grouped_allreduce", "make_compiled_train_step",
 ]
+
+
+@dataclass(frozen=True)
+class TopologyHint:
+    """Explicit 2-D decomposition for a compiled reduction: named
+    mesh axes plus their sizes, outer (slow / DCN) axis first.  The
+    hint is part of the compiled-program cache key, so the same
+    tensors reduced under different hints compile distinct programs
+    — e.g. ``TopologyHint(axes=("dp", "tp"), sizes=(2, 4))`` on a
+    dp x tp mesh reduces within each tp group first, crosses dp
+    once per shard, then gathers back.  When no hint is given the
+    ``algorithm`` policy derives one from the job topology
+    (hierarchical: hosts x local ranks; torus: the near-square
+    factorization)."""
+    axes: Tuple[str, str] = ("cross", "local")
+    sizes: Tuple[int, int] = (1, 1)
+
+    @property
+    def inner(self):
+        return self.sizes[1]
+
+    @property
+    def outer(self):
+        return self.sizes[0]
+
+    def key(self):
+        return (self.axes, self.sizes)
 
 
 def _ps_state(process_set):
@@ -274,7 +304,8 @@ class CompiledGroupedAllreduce:
     def __init__(self, op=Average, prescale_factor=1.0,
                  postscale_factor=1.0, process_set=global_process_set,
                  name=None, force_program=False, wire_dtype=None,
-                 error_feedback=False):
+                 error_feedback=False, algorithm=None,
+                 topology_hint=None):
         op = ReduceOp(op)
         if op not in (Average, Sum):
             raise ValueError(
@@ -288,6 +319,22 @@ class CompiledGroupedAllreduce:
         # benchmarking/diagnostics: run the compiled program even at
         # world size 1 instead of the host-copy shortcut
         self.force_program = bool(force_program)
+        # topology-aware decomposition INSIDE the one program:
+        # 'hierarchical'/'torus' emit nested psum_scatter -> psum ->
+        # all_gather over a 2-D reshape of the set's mesh instead of
+        # one flat psum; an explicit TopologyHint pins the axes/sizes
+        # (and implies a non-flat algorithm), otherwise the policy
+        # derives the split from the job topology at call time and
+        # degrades to flat when nothing factors (the reference's
+        # is_homogeneous gate).  The resolved hint is part of the
+        # program cache key.
+        self.algorithm = normalize_algorithm(algorithm)
+        if topology_hint is not None and \
+                not isinstance(topology_hint, TopologyHint):
+            raise ValueError("topology_hint must be a TopologyHint")
+        self.topology_hint = topology_hint
+        if topology_hint is not None and self.algorithm in (None, "flat"):
+            self.algorithm = "torus"
         # wire compression INSIDE the one program: 'bf16'/'fp16' cast
         # the fusion buffer for the psum; 'int8' emits the EQuARX-style
         # quantize -> psum-of-int16-partials -> dequantize sequence
@@ -307,10 +354,23 @@ class CompiledGroupedAllreduce:
         # steps instead of accumulating into the trained weights
         self.error_feedback = bool(error_feedback) \
             and self.wire_dtype == "int8"
+        if self.error_feedback and self.algorithm not in (None, "flat"):
+            # EF residuals are reconstructed from the program's
+            # returned full-buffer scales; a decomposed program only
+            # quantizes the cross-hop SHARD, whose scales do not map
+            # back onto the caller's payload
+            raise ValueError(
+                "error_feedback requires the flat algorithm")
         self._residuals = {}     # (sig, pos, buf_idx) -> f32 residual
         #: wire accounting for the most recent call (collective_bench)
         self.last_logical_bytes = 0
         self.last_wire_bytes = 0
+        #: bytes over the slow (outer / DCN) hop in the most recent
+        #: call — 1/inner of the payload under a non-flat algorithm
+        self.last_cross_bytes = 0
+        #: resolved algorithm of the most recent call ('flat' when the
+        #: policy degraded — observability + tests)
+        self.last_algorithm = "flat"
         self._programs = {}
         self._validated = set()  # sigs fingerprint-checked across procs
         self._ex = None          # executor the cached programs target
@@ -343,7 +403,105 @@ class CompiledGroupedAllreduce:
             return None
         return use
 
-    def _build(self, ex, plan):
+    def _resolve_hint(self, eng, ps, ex):
+        """Effective :class:`TopologyHint` for this call, or ``None``
+        (flat).  An explicit hint is validated against the set size;
+        the algorithm policies derive one from the job topology and
+        degrade to flat when nothing factors."""
+        if self.algorithm in (None, "flat") or not ex.shard_mode:
+            return None
+        if self.topology_hint is not None:
+            hint = self.topology_hint
+            if hint.outer * hint.inner != ex.num_ranks \
+                    or hint.inner <= 1 or hint.outer <= 1:
+                raise ValueError(
+                    f"TopologyHint sizes {hint.sizes} do not factor "
+                    f"the process set's {ex.num_ranks} ranks into a "
+                    f"2-D mesh")
+            return hint
+        inner = plan_decomposition(self.algorithm, eng.topology,
+                                   ps.ranks)
+        if inner is None:
+            return None
+        axes = ("cross", "local") if self.algorithm == "hierarchical" \
+            else ("hvd_y", "hvd_x")
+        return TopologyHint(axes=axes,
+                            sizes=(ex.num_ranks // inner, inner))
+
+    def _build_2d(self, ex, plan, hint):
+        """Topology-aware variant of :meth:`_build`: per dtype buffer,
+        reducescatter along the inner (fast) axis, allreduce of the
+        1/inner shard along the outer (slow) axis — 16-bit cast or
+        shared-scale int8 integer partials when the wire says so —
+        then allgather back, all nested inside the ONE cached XLA
+        program.  The reference's NCCLHierarchicalAllreduce / torus
+        allreduce (nccl_operations.cc:606-830) done as compiler-visible
+        named-axis collectives."""
+        R = ex.num_ranks
+        op, pre, post = self.op, self.prescale, self.postscale
+        inner, outer = hint.inner, hint.outer
+        ax_out, ax_in = hint.axes
+        mesh = ex.mesh2d(inner, hint.axes)
+
+        def reduce_buf_2d(x, dtype):
+            # x: (1, 1, n) — this device's slice of one fusion buffer
+            n = x.shape[-1]
+            npad = -(-n // inner) * inner
+            fl = _is_float(dtype)
+            if fl and pre != 1.0:
+                x = (x.astype(jnp.float32) * pre).astype(x.dtype)
+            elif not fl and op == Average:
+                raise ValueError("Average needs floating-point tensors")
+            if npad != n:
+                x = jnp.pad(x, ((0, 0), (0, 0), (0, npad - n)))
+            # stage 1 (inner / ICI): reducescatter to 1/inner shards
+            y = lax.psum_scatter(x, ax_in, scatter_dimension=2,
+                                 tiled=True)
+            # stage 2 (outer / DCN): allreduce the shard only, over
+            # the wire format
+            use = self._wire_use(dtype)
+            if use == "int8":
+                y = quantize_mod.quantized_psum_xla(y, ax_out, outer) \
+                    .astype(dtype)
+            elif use in ("bf16", "fp16"):
+                wdt = jnp.bfloat16 if use == "bf16" else jnp.float16
+                y = lax.psum(y.astype(jnp.float32).astype(wdt), ax_out) \
+                    .astype(jnp.float32).astype(dtype)
+            else:
+                y = lax.psum(y, ax_out)
+            scale = post / R if op == Average else post
+            if fl and scale != 1.0:
+                y = (y.astype(jnp.float32) * np.float32(scale)) \
+                    .astype(dtype)
+            # stage 3 (inner / ICI): allgather the reduced shards back
+            y = lax.all_gather(y, ax_in, axis=2, tiled=True)
+            return y[..., :n].reshape(n)
+
+        dtypes = [d for d, _ in plan]
+
+        def body(*bufs):
+            outs = tuple(reduce_buf_2d(b, d)
+                         for b, d in zip(bufs, dtypes))
+            if self.wire_dtype is None:
+                return outs
+            # keep the wire-path program contract (outs + scales);
+            # decomposed programs quantize only the cross-hop shard,
+            # whose scales do not map onto the caller's payload —
+            # error feedback is rejected at construction
+            return outs + tuple(jnp.zeros((0,), jnp.float32)
+                                for _ in plan)
+
+        prog = shard_map(
+            body, mesh=mesh,
+            in_specs=tuple(P(ax_out, ax_in) for _ in plan),
+            out_specs=tuple(P() for _ in plan) *
+            (1 if self.wire_dtype is None else 2),
+            check_vma=False)
+        return jax.jit(prog)
+
+    def _build(self, ex, plan, hint=None):
+        if hint is not None:
+            return self._build_2d(ex, plan, hint)
         R = ex.num_ranks
         op, pre, post = self.op, self.prescale, self.postscale
         BLOCK = quantize_mod.BLOCK
@@ -486,7 +644,7 @@ class CompiledGroupedAllreduce:
 
         return jax.jit(stacked)
 
-    def _program(self, ex, sig, plan):
+    def _program(self, ex, sig, plan, hint=None):
         with self._lock:
             if self._ex is not ex:
                 # the engine re-initialized or the process set was
@@ -499,13 +657,17 @@ class CompiledGroupedAllreduce:
                 self._validated.clear()
                 self._residuals.clear()
                 self._ex = ex
-            entry = self._programs.get(sig)
+            hkey = hint.key() if hint is not None else None
+            entry = self._programs.get((sig, hkey))
             if entry is None:
+                # the TopologyHint (axes + sizes) is part of the cache
+                # key: the same tensors under a different decomposition
+                # are a different XLA program
                 key = ("reduce", _ex_uid(ex), int(self.op), self.prescale,
-                       self.postscale, self.wire_dtype, sig)
-                entry = _shared_program(key,
-                                        lambda: self._build(ex, plan))
-                self._programs[sig] = entry
+                       self.postscale, self.wire_dtype, hkey, sig)
+                entry = _shared_program(
+                    key, lambda: self._build(ex, plan, hint))
+                self._programs[(sig, hkey)] = entry
             return entry
 
     # -- host packing --------------------------------------------------------
@@ -549,20 +711,35 @@ class CompiledGroupedAllreduce:
                     raise ValueError("prescale/postscale require "
                                      "floating-point tensors")
 
-    def _account_wire(self, plan, num_ranks):
+    def _account_wire(self, plan, num_ranks, hint=None,
+                      multihost=False):
         """Per-rank interconnect bytes of THIS path's programs.  The
         int8 program's transport is the psum operand — int16 partial
         sums (int32 past R=258) plus the bf16 absmax pmax — NOT the
         1 B/element codec format (jax exposes no int8-transport
         allreduce; the engine's all_gather-of-codes path does ship the
-        raw codec, see MeshExecutor.allreduce_quantized)."""
-        logical = wire = 0
+        raw codec, see MeshExecutor.allreduce_quantized).  Under a
+        decomposition (``hint``), only the 1/inner cross-hop shard
+        counts as cross bytes — local hops stay full width; flat
+        programs put their whole wire on the slow hop whenever the
+        job spans hosts."""
+        logical = wire = cross = 0
         for dtype, members in plan:
             n = sum(size for _, size, _ in members)
             itemsize = 2 if dtype == "bfloat16" else np.dtype(dtype).itemsize
             logical += n * itemsize
             use = self._wire_use(dtype)
-            if use == "int8":
+            if hint is not None:
+                m = -(-n // hint.inner)
+                wire += n * itemsize
+                if use == "int8":
+                    cross += quantize_mod.quantized_psum_wire_nbytes(
+                        m, hint.outer)
+                elif use in ("bf16", "fp16"):
+                    cross += m * 2
+                else:
+                    cross += m * itemsize
+            elif use == "int8":
                 nb = -(-n // quantize_mod.BLOCK)
                 per = 2 if num_ranks <= 258 else 4
                 wire += n * per + nb * 2
@@ -570,6 +747,19 @@ class CompiledGroupedAllreduce:
                 wire += quantize_mod.wire_nbytes(n, use, itemsize)
         self.last_logical_bytes = logical
         self.last_wire_bytes = wire
+        if hint is None:
+            # flat program: the whole wire rides the slow hop when the
+            # job spans hosts
+            self.last_cross_bytes = wire if multihost else 0
+        elif self.topology_hint is not None:
+            # explicit hint: the caller declared the outer axis slow
+            # (e.g. dp over DCN on a dp x tp mesh) — report its bytes
+            self.last_cross_bytes = cross
+        else:
+            # policy-derived decomposition: like the engine, a
+            # single-host run has no DCN hop to attribute
+            self.last_cross_bytes = cross if multihost else 0
+        self.last_algorithm = "flat" if hint is None else self.algorithm
 
     def _apply_residuals(self, sig, pos, bufs, plan):
         """Error feedback, inject side: add the previous call's local
@@ -612,12 +802,15 @@ class CompiledGroupedAllreduce:
             return [a.copy() for a in arrays]
         sig = self._signature(arrays)
         plan = self._plan(arrays)
-        self._account_wire(plan, ex.num_ranks)
-        prog = self._program(ex, sig, plan)
+        hint = self._resolve_hint(eng, ps, ex)
+        self._account_wire(plan, ex.num_ranks, hint=hint,
+                           multihost=eng._spans_hosts(ps))
+        prog = self._program(ex, sig, plan, hint)
         n_local = len(ex.local_positions)
         timeline = eng.timeline
         tag = ("reduce", int(self.op), self.prescale, self.postscale,
-               self.name, self.wire_dtype)
+               self.name, self.wire_dtype,
+               hint.key() if hint is not None else None)
 
         def launch(slot_values):
             # slot_values: {pos: (sig, [buf per dtype])} — the leader
@@ -647,7 +840,11 @@ class CompiledGroupedAllreduce:
                 for k in range(len(plan)):
                     rows = [slot_values[pos][1][k]
                             for pos in ex.local_positions]
-                    staged.append(self._stage(ex, rows))
+                    if hint is not None:
+                        staged.append(ex._stage_rows_2d(
+                            rows, hint.inner, hint.axes))
+                    else:
+                        staged.append(self._stage(ex, rows))
                 return prog(*staged)
 
         my_bufs = self._pack(arrays, plan)
@@ -687,19 +884,22 @@ _REDUCERS_LOCK = threading.Lock()
 
 
 def _reducer(op, prescale_factor, postscale_factor, process_set,
-             wire_dtype=None):
+             wire_dtype=None, algorithm=None, topology_hint=None):
     ps_id = process_set.process_set_id \
         if isinstance(process_set, ProcessSet) else int(process_set or 0)
     wire_dtype = quantize_mod.normalize_wire_dtype(wire_dtype)
+    algorithm = normalize_algorithm(algorithm)
     key = (int(ReduceOp(op)), float(prescale_factor),
-           float(postscale_factor), ps_id, wire_dtype)
+           float(postscale_factor), ps_id, wire_dtype, algorithm,
+           topology_hint.key() if topology_hint is not None else None)
     with _REDUCERS_LOCK:
         red = _REDUCERS.get(key)
         if red is None:
             red = CompiledGroupedAllreduce(
                 op=op, prescale_factor=prescale_factor,
                 postscale_factor=postscale_factor, process_set=process_set,
-                wire_dtype=wire_dtype)
+                wire_dtype=wire_dtype, algorithm=algorithm,
+                topology_hint=topology_hint)
             _REDUCERS[key] = red
         return red
 
@@ -707,20 +907,24 @@ def _reducer(op, prescale_factor, postscale_factor, process_set,
 def compiled_grouped_allreduce(arrays, op=Average, prescale_factor=1.0,
                                postscale_factor=1.0,
                                process_set=global_process_set,
-                               wire_dtype=None):
+                               wire_dtype=None, algorithm=None,
+                               topology_hint=None):
     """Grouped allreduce through one compiled program (no engine)."""
     return _reducer(op, prescale_factor, postscale_factor,
-                    process_set, wire_dtype)(arrays)
+                    process_set, wire_dtype, algorithm,
+                    topology_hint)(arrays)
 
 
 def compiled_allreduce(array, op=Average, prescale_factor=1.0,
                        postscale_factor=1.0,
-                       process_set=global_process_set, wire_dtype=None):
+                       process_set=global_process_set, wire_dtype=None,
+                       algorithm=None, topology_hint=None):
     """Single-tensor convenience over ``compiled_grouped_allreduce``."""
     return compiled_grouped_allreduce(
         [array], op=op, prescale_factor=prescale_factor,
         postscale_factor=postscale_factor, process_set=process_set,
-        wire_dtype=wire_dtype)[0]
+        wire_dtype=wire_dtype, algorithm=algorithm,
+        topology_hint=topology_hint)[0]
 
 
 def reset_compiled_state():

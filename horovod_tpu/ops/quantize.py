@@ -165,3 +165,48 @@ def dequantize_blockwise_xla(q, scales, n, out_dtype=None):
         scales.astype(jnp.float32)[..., None]
     x = x.reshape(q.shape)[..., :n]
     return x.astype(out_dtype) if out_dtype is not None else x
+
+
+def quantized_psum_xla(x, axis_name, num_ranks):
+    """Allreduce of ``x`` over mesh axis ``axis_name`` through the
+    shared-scale int8 wire, inside a shard_map body.
+
+    The EQuARX sequence (arXiv:2506.17615) the compiled path pioneered
+    (ops/compiled.py reduce_int8), factored out so the hierarchical /
+    torus decompositions can quantize exactly one hop — the cross-host
+    (DCN) psum — while their ICI hops stay full width: per-block
+    absmax is bf16-rounded then pmax'd across the axis so every rank
+    derives the identical shared scale; codes psum as exact integer
+    partials (int16 while num_ranks * 127 fits, int32 beyond) and
+    decode with one multiply.  ``x``: (..., n) float; returns f32 of
+    the same shape."""
+    from jax import lax
+    import jax.numpy as jnp
+
+    n = x.shape[-1]
+    nb = -(-n // BLOCK)
+    pad = nb * BLOCK - n
+    xf = x.astype(jnp.float32)
+    if pad:
+        xf = jnp.pad(xf, [(0, 0)] * (xf.ndim - 1) + [(0, pad)])
+    xb = xf.reshape(xf.shape[:-1] + (nb, BLOCK))
+    absmax16 = jnp.max(jnp.abs(xb), axis=-1).astype(jnp.bfloat16)
+    shared = lax.pmax(absmax16, axis_name)
+    scale = (shared.astype(jnp.float32) / np.float32(127.0)) \
+        .astype(jnp.bfloat16).astype(jnp.float32)
+    safe = jnp.where(scale > 0, scale, np.float32(1.0))
+    q = jnp.clip(jnp.round(xb / safe[..., None]), -127, 127)
+    acc = jnp.int16 if num_ranks <= 258 else jnp.int32
+    s = lax.psum(q.astype(acc), axis_name)
+    y = s.astype(jnp.float32) * scale[..., None]
+    return y.reshape(xf.shape)[..., :n]
+
+
+def quantized_psum_wire_nbytes(n_elems, num_ranks):
+    """Per-rank interconnect bytes of one quantized_psum_xla hop: the
+    psum operand is the integer-partial width plus the bf16 absmax
+    pmax (honest accounting, as ops/compiled.py documents — jax
+    exposes no int8-transport allreduce)."""
+    nb = -(-n_elems // BLOCK)
+    per = 2 if num_ranks <= 258 else 4
+    return n_elems * per + nb * SCALE_BYTES

@@ -105,6 +105,9 @@ class MeshExecutor:
             self._rep_sharding = NamedSharding(self.mesh, P())
         else:
             self.mesh = None
+        # 2-D reshapes of the SAME member devices, keyed by inner-axis
+        # size (hierarchical / torus decompositions, mesh2d)
+        self._meshes_2d = {}
         self._cache = {}
         self._cache_lock = threading.Lock()
         # Donate the staged input so the collective reuses its HBM
@@ -285,6 +288,123 @@ class MeshExecutor:
         if scaled:
             return fn
         return lambda x: fn(x, np.float32(1.0), np.float32(1.0))
+
+    # -- 2-D decomposed allreduce (hierarchical / torus) --------------------
+    #
+    # The reference's NCCLHierarchicalAllreduce / torus allreduce
+    # (nccl_operations.cc:606-830, arXiv:1909.09756) as ONE compiled
+    # program over a (outer, inner) reshape of the member devices:
+    # reducescatter along the inner (fast / ICI) axis, allreduce of
+    # the shards along the outer (slow / DCN) axis, allgather back —
+    # only 1/inner of the logical bytes cross the outer hop, and with
+    # wire='int8' that hop additionally ships shared-scale quantized
+    # integer partials (quantize.quantized_psum_xla).
+
+    def mesh2d(self, inner, axes=("hvd_y", "hvd_x")):
+        """Cached (outer-axis, inner-axis) mesh over the same member
+        devices, reshaped (num_ranks // inner, inner) row-major —
+        inner-axis neighbors stay adjacent in device order, which is
+        the ICI-adjacent dimension on a TPU slice (and the intra-host
+        ranks for launcher jobs, whose device table is grouped by
+        process).  ``axes`` lets callers name the grid (the compiled
+        path's TopologyHint, e.g. ("dp", "tp"))."""
+        axes = tuple(axes)
+        mesh = self._meshes_2d.get((inner, axes))
+        if mesh is None:
+            if not self.shard_mode:
+                raise ValueError(
+                    "2-D decompositions need shard mode (one device "
+                    "per rank)")
+            if inner <= 1 or self.num_ranks % inner:
+                raise ValueError(
+                    f"inner axis {inner} does not factor world size "
+                    f"{self.num_ranks}")
+            arr = np.array(self.devices).reshape(
+                self.num_ranks // inner, inner)
+            mesh = Mesh(arr, axes)
+            self._meshes_2d[(inner, axes)] = mesh
+        return mesh
+
+    def _stage_rows_2d(self, rows, inner, axes=("hvd_y", "hvd_x")):
+        """Like :meth:`_stage_rows` on the (outer, inner) grid: flat
+        position p = y * inner + x, matching mesh2d's row-major
+        device reshape."""
+        mesh = self.mesh2d(inner, axes)
+        shape = (self.num_ranks // inner, inner) + tuple(rows[0].shape)
+        sharding = NamedSharding(mesh, P(*mesh.axis_names))
+        shards = [
+            jax.device_put(row[None, None], self.devices[pos])
+            for row, pos in zip(rows, self.local_positions)
+        ]
+        return jax.make_array_from_single_device_arrays(
+            shape, sharding, shards)
+
+    def allreduce_2d(self, rows, op: ReduceOp, prescale=1.0,
+                     postscale=1.0, inner=1, wire=None):
+        """Two-stage decomposed allreduce.  ``rows``: per-local-rank
+        flat float buffers (n,); ``inner`` is the fast-axis size
+        (host-local ranks for hierarchical, the near-square factor
+        for torus); ``wire`` is None (full width on every hop) or
+        'int8' (the OUTER hop ships shared-scale quantized partials;
+        16-bit wires are handled by the caller casting ``rows``).
+        Returns per-local-rank result buffers (n,)."""
+        n = int(rows[0].size)
+        dtype = rows[0].dtype
+        if n == 0:
+            return [np.asarray(r) for r in rows]
+        R = self.num_ranks
+        if op == ReduceOp.AVERAGE:
+            postscale = postscale / R
+            op = ReduceOp.SUM
+        if op != ReduceOp.SUM:
+            raise ValueError(
+                f"2-D decompositions support Sum/Average, got {op}")
+        npad = -(-n // inner) * inner
+        if npad != n:
+            padded = []
+            for r in rows:
+                buf = np.zeros(npad, dtype=r.dtype)
+                buf[:n] = r
+                padded.append(buf)
+            rows = padded
+        key = ("allreduce2d", npad, str(dtype), inner, wire)
+        fn = self._cached(key, lambda: self._build_allreduce_2d(
+            npad, dtype, inner, wire))
+        x = self._stage_rows_2d(rows, inner)
+        sdt = _scale_np_dtype(dtype)
+        out = fn(x, sdt(prescale), sdt(postscale))
+        host = self._replicated_out(out, dtype)
+        if npad != n:
+            host = host[:n]
+        return self._fanout(host)
+
+    def _build_allreduce_2d(self, npad, dtype, inner, wire):
+        from .quantize import quantized_psum_xla
+        outer = self.num_ranks // inner
+        sf = _scale_jnp_dtype(dtype)
+        mesh = self.mesh2d(inner)
+
+        def body(xb, pre, post):
+            # xb: (1, 1, npad) — this device's row on the (y, x) grid
+            xb = (xb.astype(sf) * pre).astype(dtype)
+            # stage 1 (inner / ICI): reducescatter to 1/inner shards
+            y = lax.psum_scatter(xb, "hvd_x", scatter_dimension=2,
+                                 tiled=True)        # (1, 1, npad/inner)
+            # stage 2 (outer / DCN): allreduce of the shard only
+            if wire == "int8":
+                y = quantized_psum_xla(y, "hvd_y", outer)
+            else:
+                y = lax.psum(y, "hvd_y")
+            y = (y.astype(sf) * post).astype(dtype)
+            # stage 3 (inner / ICI): allgather the reduced shards back
+            y = lax.all_gather(y, "hvd_x", axis=2, tiled=True)
+            return y.reshape(npad)
+
+        mapped = shard_map(
+            body, mesh=mesh,
+            in_specs=(P("hvd_y", "hvd_x"), P(), P()), out_specs=P(),
+            check_vma=False)
+        return jax.jit(mapped, donate_argnums=self._donate)
 
     # -- quantized allreduce / reducescatter (int8 wire) --------------------
     #

@@ -11,12 +11,14 @@ from ...config_parser import (  # noqa: F401
     HOROVOD_CACHE_CAPACITY,
     HOROVOD_CYCLE_TIME,
     HOROVOD_FUSION_THRESHOLD,
+    HOROVOD_HIERARCHICAL_ALLREDUCE,
     HOROVOD_LOG_LEVEL,
     HOROVOD_STALL_CHECK_DISABLE,
     HOROVOD_STALL_CHECK_TIME_SECONDS,
     HOROVOD_STALL_SHUTDOWN_TIME_SECONDS,
     HOROVOD_TIMELINE,
     HOROVOD_TIMELINE_MARK_CYCLES,
+    HOROVOD_TORUS_ALLREDUCE,
     parse_config_file,
     set_env_from_args,
 )
@@ -31,11 +33,12 @@ HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE = \
     "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"
 
 # reference names with no TPU-side effect (accepted for config-file
-# compatibility; the comm stack has no NCCL/MPI/gloo data plane)
+# compatibility; the comm stack has no NCCL/MPI/gloo data plane).
+# HOROVOD_HIERARCHICAL_ALLREDUCE / HOROVOD_TORUS_ALLREDUCE are LIVE
+# (re-exported above): they pick the topology-aware reduction
+# algorithm (common/env.py, core/engine._algo_plan).
 HOROVOD_GLOO_TIMEOUT_SECONDS = "HOROVOD_GLOO_TIMEOUT_SECONDS"
-HOROVOD_HIERARCHICAL_ALLREDUCE = "HOROVOD_HIERARCHICAL_ALLREDUCE"
 HOROVOD_HIERARCHICAL_ALLGATHER = "HOROVOD_HIERARCHICAL_ALLGATHER"
-HOROVOD_TORUS_ALLREDUCE = "HOROVOD_TORUS_ALLREDUCE"
 HOROVOD_MPI_THREADS_DISABLE = "HOROVOD_MPI_THREADS_DISABLE"
 HOROVOD_NUM_NCCL_STREAMS = "HOROVOD_NUM_NCCL_STREAMS"
 HOROVOD_THREAD_AFFINITY = "HOROVOD_THREAD_AFFINITY"

@@ -29,6 +29,32 @@ class PingRequest:
     pass
 
 
+def _intf_ipv4_addresses(intf):
+    """IPv4 addresses bound to a REAL interface name, or None when the
+    name does not resolve to a NIC on this host (pseudo keys like this
+    framework's 'all' advertisement, or a platform without the
+    ioctl).  stdlib-only (no psutil in this image): SIOCGIFADDR."""
+    try:
+        names = {name for _, name in socket.if_nameindex()}
+    except OSError:
+        return None
+    if intf not in names:
+        return None
+    try:
+        import fcntl
+
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            packed = fcntl.ioctl(
+                s.fileno(), 0x8915,        # SIOCGIFADDR
+                struct.pack("256s", intf.encode()[:15]))
+            return {socket.inet_ntoa(packed[20:24])}
+        finally:
+            s.close()
+    except (OSError, ImportError):
+        return None
+
+
 class NoValidAddressesFound(Exception):
     pass
 
@@ -179,9 +205,33 @@ class BasicClient:
     def _probe_one(self, intf, addr, results):
         resp = self._try_request(addr, PingRequest(),
                                  probing=True)
-        if resp is not None and \
-                resp.service_name == self._service_name:
-            results.put((intf, addr))
+        if resp is None or resp.service_name != self._service_name:
+            return
+        if self._match_intf:
+            # reference network.py _probe_one: accept the address only
+            # when the server saw our probe ARRIVE from an address of
+            # the interface it was advertised under — i.e. the route
+            # to ``addr`` actually leaves through ``intf``
+            # (PingResponse.source_address is our address as the
+            # server observed it).  Names that resolve to no NIC
+            # (this framework's 'all' advertisement) carry no routing
+            # claim and pass through unfiltered, and a source address
+            # we cannot attribute to ANY interface (SIOCGIFADDR only
+            # reports primaries, not aliases) is not evidence of a
+            # wrong route — reject only a POSITIVE mismatch, a source
+            # that is another NIC's address.
+            local = _intf_ipv4_addresses(intf)
+            if local is not None and resp.source_address not in local:
+                others = set()
+                try:
+                    for _, name in socket.if_nameindex():
+                        if name != intf:
+                            others |= _intf_ipv4_addresses(name) or set()
+                except OSError:
+                    pass
+                if resp.source_address in others:
+                    return
+        results.put((intf, addr))
 
     def _try_request(self, addr, req, probing=False, stream=None):
         attempts = 1 if probing else self._attempts

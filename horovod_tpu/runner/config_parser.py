@@ -17,6 +17,12 @@ HOROVOD_STALL_CHECK_DISABLE = "HOROVOD_STALL_CHECK_DISABLE"
 HOROVOD_STALL_CHECK_TIME_SECONDS = "HOROVOD_STALL_CHECK_TIME_SECONDS"
 HOROVOD_STALL_SHUTDOWN_TIME_SECONDS = "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"
 HOROVOD_LOG_LEVEL = "HOROVOD_LOG_LEVEL"
+# topology-aware collectives (common/env.py reads these; the boolean
+# pair carries the reference's knob names, the generic one the
+# flat/hierarchical/torus spelling)
+HOROVOD_HIERARCHICAL_ALLREDUCE = "HOROVOD_HIERARCHICAL_ALLREDUCE"
+HOROVOD_TORUS_ALLREDUCE = "HOROVOD_TORUS_ALLREDUCE"
+HOROVOD_ALLREDUCE_ALGORITHM = "HOROVOD_ALLREDUCE_ALGORITHM"
 
 
 def set_env_from_args(env: dict, args) -> dict:
@@ -62,6 +68,12 @@ def set_env_from_args(env: dict, args) -> dict:
             args.stall_check_shutdown_time_seconds)
     if getattr(args, "log_level", None):
         env[HOROVOD_LOG_LEVEL] = args.log_level
+    setb(HOROVOD_TORUS_ALLREDUCE,
+         getattr(args, "torus_allreduce", False))
+    setb(HOROVOD_HIERARCHICAL_ALLREDUCE,
+         getattr(args, "hierarchical_allreduce", False))
+    if getattr(args, "allreduce_algorithm", None):
+        env[HOROVOD_ALLREDUCE_ALGORITHM] = args.allreduce_algorithm
     return env
 
 

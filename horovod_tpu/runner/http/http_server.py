@@ -212,13 +212,17 @@ class Coordinator:
                 cycle_time_ms=cycle_time_ms,
                 pack_mt_threshold_bytes=8 << 20,
                 cache_capacity=cache_capacity)
-            # tune_wire=False: the wire dtype is a worker-side knob
-            # with no distribution channel from this coordinator —
-            # sweeping it here would burn samples on a dimension
-            # nothing applies (engine-side autotune owns it)
+            # tune_wire=False / tune_algorithm=False: wire dtype and
+            # reduction algorithm are worker-side knobs with no safe
+            # distribution channel from this coordinator (workers
+            # applying a new default at different cycles would fail
+            # the cross-process consistency check) — sweeping them
+            # here would burn samples on dimensions nothing applies
+            # (engine-side autotune owns both)
             self._autotuner = ParameterManager(self._tuned_params,
                                                log_path=autotune_log,
-                                               tune_wire=False)
+                                               tune_wire=False,
+                                               tune_algorithm=False)
         self._lock = threading.Condition()
         # key -> {proc_id -> meta}
         self._pending: "OrderedDict[str, dict]" = OrderedDict()
@@ -387,6 +391,8 @@ class Coordinator:
                                  ("op", "reduce ops"),
                                  ("pre", "prescale factors"),
                                  ("post", "postscale factors"),
+                                 ("wire", "wire dtypes"),
+                                 ("algo", "algorithms"),
                                  ("root", "root ranks")):
                 if m.get(field) != first.get(field):
                     return (f"Mismatched {label} for {key}: "
@@ -517,8 +523,13 @@ class Coordinator:
                     meta.get("nranks",
                              meta.get("nprocs", self.world_size)), 1)
             else:
+                # wire dtype and algorithm split buckets exactly like
+                # the engine-side _fuse signature: a quantized or
+                # hierarchical entry must not share a fused SPMD
+                # program with a full-width / flat one
                 msig = (meta["type"], meta["dtype"], meta["op"],
-                        meta["pre"], meta["post"], meta["ps"])
+                        meta["pre"], meta["post"], meta["ps"],
+                        meta.get("wire"), meta.get("algo"))
                 nbytes = meta["nbytes"]
             if bucket and (msig != sig or
                            bucket_bytes + nbytes >

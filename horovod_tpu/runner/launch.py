@@ -58,6 +58,24 @@ def parse_args(argv=None):
     parser.add_argument("--fusion-threshold-mb", type=float, default=None)
     parser.add_argument("--cycle-time-ms", type=float, default=None)
     parser.add_argument("--cache-capacity", type=int, default=None)
+    # topology-aware collectives (the source fork's NCCL torus-
+    # allreduce flag plus upstream's hierarchical toggle, mapped to
+    # the same HOROVOD_* env names workers read)
+    parser.add_argument("--torus-allreduce", action="store_true",
+                        help="decompose float Sum/Average allreduces "
+                             "over a 2-D torus factorization of the "
+                             "ranks (HOROVOD_TORUS_ALLREDUCE)")
+    parser.add_argument("--hierarchical-allreduce", action="store_true",
+                        help="reducescatter within each host, "
+                             "allreduce the shards across hosts, "
+                             "allgather back "
+                             "(HOROVOD_HIERARCHICAL_ALLREDUCE)")
+    parser.add_argument("--allreduce-algorithm", default=None,
+                        choices=["flat", "hierarchical", "torus"],
+                        help="generic spelling of the algorithm knob "
+                             "(HOROVOD_ALLREDUCE_ALGORITHM); the "
+                             "boolean flags above win when both are "
+                             "given")
     # timeline
     parser.add_argument("--timeline-filename", default=None)
     parser.add_argument("--timeline-mark-cycles", action="store_true")

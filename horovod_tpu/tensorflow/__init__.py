@@ -14,6 +14,7 @@ import numpy as np
 import tensorflow as tf
 
 from ..common import basics as _basics
+from ..common import util as _util
 from ..common.basics import (  # noqa: F401
     init, shutdown, is_initialized,
     rank, size, local_rank, local_size, cross_rank, cross_size,
@@ -295,17 +296,18 @@ def reducescatter(tensor, op=None, name=None,
             tf.TensorShape([None]).concatenate(t.shape[1:]))
 
         def grad(dy):
-            # exact adjoint: un-scatter via allgather, /size for
-            # Average, x(prescale*postscale) for the linear scaling
-            # the forward applied (torch/mpi_ops.py
-            # HorovodReducescatter.backward)
+            # un-scatter via allgather; reference convention by
+            # default (Sum x= size, Average unscaled;
+            # HOROVOD_EXACT_ADJOINT_REDUCESCATTER=1 for the true
+            # adjoint), then the linear prescale*postscale the
+            # forward applied (torch HorovodReducescatter.backward
+            # parity — common/util.reducescatter_grad_factor)
             g = allgather(dy, process_set=process_set)
-            if rs_op == Average:
-                g = g / tf.cast(_ps_size(process_set), g.dtype)
-            if prescale_factor != 1.0:
-                g = g * tf.cast(prescale_factor, g.dtype)
-            if postscale_factor != 1.0:
-                g = g * tf.cast(postscale_factor, g.dtype)
+            scale = _util.reducescatter_grad_factor(
+                rs_op == Average, _ps_size(process_set))
+            scale *= prescale_factor * postscale_factor
+            if scale != 1.0:
+                g = g * tf.cast(scale, g.dtype)
             return g
 
         return out, grad
@@ -336,15 +338,14 @@ def grouped_reducescatter(tensors, op=None, name=None,
                 tf.TensorShape([None]).concatenate(t.shape[1:]))
 
         def grad(*dys):
+            scale = _util.reducescatter_grad_factor(
+                rs_op == Average, _ps_size(process_set))
+            scale *= prescale_factor * postscale_factor
             grads = []
             for dy in dys:
                 g = allgather(dy, process_set=process_set)
-                if rs_op == Average:
-                    g = g / tf.cast(_ps_size(process_set), g.dtype)
-                if prescale_factor != 1.0:
-                    g = g * tf.cast(prescale_factor, g.dtype)
-                if postscale_factor != 1.0:
-                    g = g * tf.cast(postscale_factor, g.dtype)
+                if scale != 1.0:
+                    g = g * tf.cast(scale, g.dtype)
                 grads.append(g)
             return tuple(grads)
 

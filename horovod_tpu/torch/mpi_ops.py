@@ -17,12 +17,14 @@ model graph backpropagate (reference torch/mpi_ops.py:194-1130):
 * allgather grad  = average-allreduce, then take this rank's row slice
 * broadcast grad  = average-allreduce, zeroed on non-root ranks
 * alltoall grad   = alltoall routed back with the received splits
-* reducescatter grad = allgather (un-scatter), /size for Average
-
-(The reference's reducescatter backward scales Sum by size instead
-— reference torch/mpi_ops.py:1082-1092 — which is size× the true
-adjoint of its own forward; here the backward is the exact adjoint:
-forward Average = Sum/size, so d(out)/d(in) carries the same 1/size.)
+* reducescatter grad = allgather (un-scatter), scaled by the
+  REFERENCE convention by default (Sum ×= size, Average unscaled —
+  reference torch/mpi_ops.py:1082-1092), so migrated multi-worker
+  jobs keep their gradient magnitudes.  That convention is size× the
+  true adjoint of the Sum forward;
+  ``HOROVOD_EXACT_ADJOINT_REDUCESCATTER=1`` opts into the exact
+  adjoint (Sum unscaled, Average /= size) — the two coincide at world
+  size 1.  See ``common/util.reducescatter_grad_factor``.
 """
 
 import torch
@@ -37,6 +39,7 @@ from ..common.basics import (  # noqa: F401 — reference mpi_ops module surface
     start_timeline, stop_timeline,
 )
 from ..common.process_sets import global_process_set
+from ..common import util as _util
 from ..common.util import get_average_backwards_compatibility_fun
 from ..ops import api as _api
 from ..ops.api import (  # noqa: F401
@@ -232,14 +235,15 @@ class HorovodReducescatter(torch.autograd.Function):
 
     @staticmethod
     def backward(ctx, grad_output):
-        # exact adjoint: forward = postscale * reduce(prescale * x),
-        # Average folds an extra 1/size into the reduction
-        if ctx.op == Average:
-            grad_output = grad_output / _ps_size(ctx.process_set)
-        if ctx.prescale_factor != 1.0:
-            grad_output = grad_output * ctx.prescale_factor
-        if ctx.postscale_factor != 1.0:
-            grad_output = grad_output * ctx.postscale_factor
+        # reference convention by default (Sum grad x= size, Average
+        # unscaled; HOROVOD_EXACT_ADJOINT_REDUCESCATTER=1 opts into
+        # the true adjoint), then the linear prescale*postscale the
+        # forward applied (common/util.reducescatter_grad_factor)
+        scale = _util.reducescatter_grad_factor(
+            ctx.op == Average, _ps_size(ctx.process_set))
+        scale *= ctx.prescale_factor * ctx.postscale_factor
+        if scale != 1.0:
+            grad_output = grad_output * scale
         return (allgather(grad_output, process_set=ctx.process_set),
                 None, None, None, None, None)
 
@@ -262,11 +266,11 @@ class HorovodGroupedReducescatter(torch.autograd.Function):
 
     @staticmethod
     def backward(ctx, *grad_outputs):
-        # same adjoint as the single-tensor op: /size for Average,
-        # then the linear prescale*postscale the forward applied
-        scale = ctx.prescale_factor * ctx.postscale_factor
-        if ctx.op == Average:
-            scale /= _ps_size(ctx.process_set)
+        # same convention as the single-tensor op (reference default /
+        # exact-adjoint opt-in), then the linear prescale*postscale
+        scale = _util.reducescatter_grad_factor(
+            ctx.op == Average, _ps_size(ctx.process_set))
+        scale *= ctx.prescale_factor * ctx.postscale_factor
         grads = [allgather(g * scale if scale != 1 else g,
                            process_set=ctx.process_set)
                  for g in grad_outputs]

@@ -96,9 +96,13 @@ class Timeline:
         self._emit(f"NEGOTIATE_{op_name}", "B",
                    self._tid(tensor_name), self._ts())
 
-    def op_start(self, tensor_names, op_name):
+    def op_start(self, tensor_names, op_name, algorithm=None):
         """Negotiation complete; collective starting (reference
-        Timeline::Start + ActivityStartAll)."""
+        Timeline::Start + ActivityStartAll).  ``algorithm`` records
+        the chosen reduction algorithm (flat / hierarchical / torus)
+        as an instant marker on each tensor's lane, so traces show
+        which hops a reduction took without changing the op event
+        names the reference's own timeline tests assert."""
         ts = self._ts()
         tids = []
         for n in tensor_names:
@@ -106,6 +110,8 @@ class Timeline:
             tids.append(tid)
             self._emit(f"NEGOTIATE_{op_name}", "E", tid, ts)
             self._emit(op_name, "B", tid, ts)
+            if algorithm is not None:
+                self._emit(f"ALGO_{algorithm.upper()}", "i", tid, ts)
         with self._lock:
             self._open_ops.append((list(tids), op_name))
 

@@ -53,19 +53,48 @@ def test_parameter_manager_converges(tmp_path):
     for _ in range(5 * 2):
         pm.record_bytes(1 << 20)
     assert not pm.active               # converged after max_samples
-    fusion, cycle, pack_mt, cache, wire = pm.best_parameters()
+    fusion, cycle, pack_mt, cache, wire, algo = pm.best_parameters()
     assert 1 << 20 <= fusion <= 1 << 28
     assert 0.5 <= cycle <= 32.0
     assert 1 << 20 <= pack_mt <= 1 << 26
     assert 0 <= cache <= 4096                       # 4th dim (r4):
     assert wire in (None, "fp16", "bf16", "int8")   # 5th dim: wire dtype
+    assert algo in ("flat", "hierarchical", "torus")  # 6th dim
     assert cfg.pack_mt_threshold_bytes == pack_mt   # applied
     assert cfg.cache_capacity == cache              # applied
     assert cfg.wire_dtype == wire                   # applied
+    assert cfg.algorithm == algo                    # applied
     pm.close()
     lines = log.read_text().strip().splitlines()
     assert lines[0].startswith("sample,")
     assert len(lines) == 6             # header + 5 samples
+
+
+def test_autotune_selects_nonflat_when_cross_hop_bound(monkeypatch):
+    """The sixth dimension earns its keep: on a job whose goodput is
+    bounded by cross-host bytes (hierarchical/torus move 1/local_size
+    of them, so logical bytes/sec quadruples), the manager must
+    converge to a NON-FLAT algorithm.  Timing is made deterministic
+    by stepping a fake clock one second per sample window, so the
+    score IS the simulated goodput."""
+    from horovod_tpu.core import autotune as at
+
+    monkeypatch.setattr(at.time, "monotonic", lambda: 0.0)
+
+    cfg = env_mod.Config()
+    pm = ParameterManager(cfg, warmup_samples=2, steps_per_sample=1,
+                          max_samples=30, seed=3)
+    for _ in range(30):
+        # simulated DCN-bound step: the interconnect moves a fixed
+        # byte budget per window; non-flat algorithms push 4x the
+        # logical payload through it.  The frozen clock makes every
+        # window the same (floor) length, so score == goodput.
+        goodput = (1 << 22) if cfg.algorithm in ("hierarchical",
+                                                 "torus") else (1 << 20)
+        pm.record_bytes(goodput)
+    assert not pm.active
+    best = pm.best_parameters()
+    assert best[5] in ("hierarchical", "torus"), best
 
 
 def test_autotune_engine_integration(hvd_shutdown, tmp_path,

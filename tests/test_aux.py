@@ -31,6 +31,29 @@ def test_timeline_records_ops(hvd_shutdown, tmp_path, monkeypatch):
     assert any("tl_test" in str(e.get("args")) for e in lanes)
 
 
+def test_timeline_records_algorithm(hvd_shutdown, tmp_path,
+                                    monkeypatch):
+    """The chosen reduction algorithm rides each negotiation entry's
+    lane as an instant marker (flat / hierarchical / torus), without
+    renaming the op events the reference's timeline tests assert."""
+    path = tmp_path / "timeline_algo.json"
+    monkeypatch.setenv("HOROVOD_TIMELINE", str(path))
+
+    def fn():
+        hvd.allreduce(np.ones(64, np.float32), name="tl_algo",
+                      algorithm="torus")
+        hvd.allreduce(np.ones(64, np.float32), name="tl_flat")
+        return True
+
+    assert all(hvd.run(fn, np=4))
+    hvd.shutdown()
+    events = json.loads(path.read_text())
+    names = {e.get("name") for e in events}
+    assert "ALGO_TORUS" in names, names
+    assert "ALGO_FLAT" in names, names
+    assert "ALLREDUCE" in names          # op names unchanged
+
+
 def test_start_stop_timeline_runtime(hvd_shutdown, tmp_path):
     path = tmp_path / "tl2.json"
 

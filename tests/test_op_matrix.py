@@ -731,6 +731,230 @@ def test_wire_dtype_skips_nonlinear_ops(live_engine):
 
 
 # ---------------------------------------------------------------------------
+# topology-aware algorithms (ISSUE 2): algorithm x op x wire dtype x
+# path matrix — every cell must match the flat f32 reduction within
+# the wire format's tolerance — plus the topology cases: hierarchical
+# cross-byte budget on a (simulated) two-host layout, heterogeneous
+# host:slots fallback, and a dp x tp mesh torus via TopologyHint.
+
+
+@pytest.fixture()
+def two_host_topology(live_engine):
+    """Patch a 2-hosts-x-2-slots layout onto the live engine (the
+    launcher's HOROVOD_TPU_HOST_OF_RANK handoff, simulated
+    in-process so the matrix runs on the module-scoped engine)."""
+    from horovod_tpu.common import basics
+    from horovod_tpu.common.topology import Topology
+
+    eng = basics.engine()
+    old = eng.topology
+    eng.topology = Topology(size=NP, host_of_rank=[0, 0, 1, 1])
+    yield eng
+    eng.topology = old
+
+
+ALGO_CASES = [
+    (a, o, w, p)
+    for a in ("hierarchical", "torus")
+    for o in ("sum", "average")
+    for w in (None, "fp16", "int8")
+    for p in ("engine", "compiled")
+]
+
+
+@pytest.mark.parametrize(
+    "algo,op_name,wire,path", ALGO_CASES,
+    ids=[f"{a}-{o}-{w or 'f32'}-{p}" for a, o, w, p in ALGO_CASES])
+def test_algorithm_matrix(two_host_topology, algo, op_name, wire, path):
+    eng = two_host_topology
+    runs0 = dict(eng.algo_runs)
+    tag = f"{algo}.{op_name}.{wire or 'f32'}.{path}"
+
+    def fn():
+        r = hvd.rank()
+        rng = np.random.default_rng(r)
+        x = rng.standard_normal(1000).astype(np.float32)
+        if path == "compiled":
+            out = hvd.compiled_allreduce(
+                x, op=_OPS[op_name], algorithm=algo, wire_dtype=wire)
+        else:
+            out = hvd.allreduce(x, op=_OPS[op_name],
+                                name=f"m.algo.{tag}",
+                                algorithm=algo, wire_dtype=wire)
+        return np.asarray(out, np.float64), x
+
+    results = run_ranks(fn)
+    stack = np.stack([x.astype(np.float64) for _, x in results])
+    expected = stack.sum(0) if op_name == "sum" else stack.mean(0)
+    tol = WIRE_ATOL[wire]
+    for out, _ in results:
+        assert np.allclose(out, expected, atol=tol), \
+            (algo, op_name, wire, path, np.abs(out - expected).max())
+    if path == "engine":
+        # the engine really took the decomposed path (not a silent
+        # flat fallback)
+        assert eng.algo_runs.get(algo, 0) > runs0.get(algo, 0), \
+            (algo, runs0, eng.algo_runs)
+
+
+def test_hierarchical_cross_byte_budget(two_host_topology):
+    """ISSUE 2 acceptance: hierarchical moves <= (1/local_size + eps)
+    of the logical bytes across the cross-host hop, asserted via the
+    engine's wire-byte accounting; the int8 wire shrinks that hop a
+    further ~2x (integer partials + shared scales)."""
+    eng = two_host_topology
+
+    def run_one(wire, name):
+        l0, c0 = eng.logical_wire_bytes, eng.cross_wire_bytes
+
+        def fn():
+            x = np.ones(1 << 14, np.float32) * (hvd.rank() + 1)
+            hvd.allreduce(x, op=hvd.Sum, name=name,
+                          algorithm="hierarchical", wire_dtype=wire)
+            return True
+
+        assert all(run_ranks(fn))
+        return (eng.logical_wire_bytes - l0,
+                eng.cross_wire_bytes - c0)
+
+    dl, dc = run_one(None, "m.budget.f32")
+    local = 2                       # host_of_rank = [0, 0, 1, 1]
+    assert dl > 0
+    assert dc <= dl / local * 1.01 + 64, (dc, dl)
+    dl8, dc8 = run_one("int8", "m.budget.int8")
+    assert dc8 <= dc / 1.9, (dc8, dc)   # int16 partials ~halve the hop
+
+    # a FLAT reduction on the same multi-host layout pays its whole
+    # wire on the cross hop — the contrast the accounting exists for
+    l0, c0 = eng.logical_wire_bytes, eng.cross_wire_bytes
+
+    def fn_flat():
+        x = np.ones(1 << 14, np.float32)
+        hvd.allreduce(x, op=hvd.Sum, name="m.budget.flat")
+        return True
+
+    assert all(run_ranks(fn_flat))
+    assert eng.cross_wire_bytes - c0 == eng.logical_wire_bytes - l0
+
+
+def test_hierarchical_heterogeneous_host_slots_falls_back(live_engine):
+    """3+1 host:slots layout: hierarchical cannot factor (the
+    reference gates NCCLHierarchicalAllreduce on is_homogeneous the
+    same way) — the request must silently run flat and stay exact."""
+    from horovod_tpu.common import basics
+    from horovod_tpu.common.topology import Topology
+
+    eng = basics.engine()
+    old = eng.topology
+    eng.topology = Topology(size=NP, host_of_rank=[0, 0, 0, 1])
+    try:
+        flat0 = eng.algo_runs.get("flat", 0)
+        hier0 = eng.algo_runs.get("hierarchical", 0)
+
+        def fn():
+            x = np.full(64, float(hvd.rank() + 1), np.float32)
+            out = hvd.allreduce(x, op=hvd.Sum, name="m.hetero",
+                                algorithm="hierarchical")
+            np.testing.assert_array_equal(
+                np.asarray(out), np.full(64, 10.0))
+            return True
+
+        assert all(run_ranks(fn))
+        assert eng.algo_runs.get("flat", 0) > flat0
+        assert eng.algo_runs.get("hierarchical", 0) == hier0
+    finally:
+        eng.topology = old
+
+
+def test_compiled_torus_dp_tp_mesh_hint(live_engine):
+    """dp x tp mesh torus case: an explicit TopologyHint pins the
+    compiled decomposition to named axes, rides the cache key, and
+    moves only 1/tp of the bytes across the dp (outer) axis."""
+    def fn():
+        r = hvd.rank()
+        red = hvd.CompiledGroupedAllreduce(
+            op=hvd.Sum, force_program=True, name="m.dp_tp",
+            topology_hint=hvd.TopologyHint(axes=("dp", "tp"),
+                                           sizes=(2, 2)))
+        rng = np.random.default_rng(r)
+        x = rng.standard_normal(512).astype(np.float32)
+        out = red([x])[0]
+        assert red.last_algorithm == "torus"
+        assert red.last_cross_bytes * 2 == red.last_logical_bytes, \
+            (red.last_cross_bytes, red.last_logical_bytes)
+        return np.asarray(out, np.float64), x
+
+    results = run_ranks(fn)
+    expected = np.sum([x.astype(np.float64) for _, x in results],
+                      axis=0)
+    for out, _ in results:
+        assert np.allclose(out, expected, atol=1e-5)
+
+
+def test_torus_on_single_host(live_engine):
+    """Torus needs no host map — a composite world size factors into
+    the near-square grid (4 -> 2x2) even on one host, the arXiv
+    1909.09756 2-D decomposition over one ICI domain."""
+    from horovod_tpu.common import basics
+
+    eng = basics.engine()
+    t0 = eng.algo_runs.get("torus", 0)
+
+    def fn():
+        x = np.arange(130, dtype=np.float64) * (hvd.rank() + 1)
+        out = hvd.allreduce(x, op=hvd.Sum, name="m.torus1h",
+                            algorithm="torus")
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.arange(130) * 10.0)
+        return True
+
+    assert all(run_ranks(fn))
+    assert eng.algo_runs.get("torus", 0) > t0
+
+
+def test_algorithm_mismatch_fails_loudly(live_engine):
+    """Ranks disagreeing on the algorithm would issue different SPMD
+    programs against each other — negotiation must reject, like a
+    dtype mismatch."""
+    from horovod_tpu.common.exceptions import TensorShapeMismatchError
+
+    def fn():
+        r = hvd.rank()
+        algo = "torus" if r == 0 else "flat"
+        x = np.ones(8, np.float32)
+        try:
+            hvd.allreduce(x, op=hvd.Sum, name="m.algomix",
+                          algorithm=algo)
+            return False
+        except TensorShapeMismatchError:
+            return True
+
+    assert all(run_ranks(fn))
+
+
+def test_process_set_algorithm_decomposition(two_host_topology):
+    """A sub-set spanning both hosts decomposes over ITS OWN rank
+    list (ranks 1,2 live on different hosts but 1-per-host does not
+    factor -> falls back flat and stays correct; the full-set
+    hierarchical above proves the non-degenerate case)."""
+    def fn():
+        ps = hvd.add_process_set([1, 2])
+        try:
+            if hvd.rank() in (1, 2):
+                x = np.ones(32, np.float32) * (hvd.rank() + 1)
+                out = hvd.allreduce(x, op=hvd.Sum, process_set=ps,
+                                    name="m.psalgo",
+                                    algorithm="hierarchical")
+                np.testing.assert_array_equal(np.asarray(out),
+                                              np.full(32, 5.0))
+            return True
+        finally:
+            hvd.remove_process_set(ps)
+
+    assert all(run_ranks(fn))
+
+
+# ---------------------------------------------------------------------------
 # error-feedback convergence: a small LM trained over the int8 wire
 # must reach the f32-wire loss (EF21: residuals cancel the
 # quantization bias over steps instead of letting it accumulate)

@@ -765,9 +765,9 @@ def test_reference_module_paths_tf(hvd_shutdown):
 
 
 def test_tf_reducescatter_grad_applies_scale_factors(hvd_shutdown):
-    """Backward must carry prescale*postscale: forward is
-    postscale * reduce(prescale * x), whose adjoint multiplies by both
-    (torch HorovodReducescatter.backward parity)."""
+    """Backward must carry prescale*postscale on top of the reference
+    Sum-convention size factor (torch HorovodReducescatter.backward
+    parity)."""
     def fn():
         t = tf.Variable(tf.ones([NP, 2]))
         with tf.GradientTape() as tape:
@@ -775,7 +775,25 @@ def test_tf_reducescatter_grad_applies_scale_factors(hvd_shutdown):
                                     postscale_factor=3.0)
             s = tf.reduce_sum(out)
         g = tape.gradient(s, t)
-        assert np.allclose(g.numpy(), 0.5 * 3.0), g.numpy()
+        assert np.allclose(g.numpy(), NP * 0.5 * 3.0), g.numpy()
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_tf_reducescatter_grad_exact_adjoint_opt_in(
+        hvd_shutdown, monkeypatch):
+    """HOROVOD_EXACT_ADJOINT_REDUCESCATTER=1: Sum backward is the
+    unscaled allgather (the true adjoint of the forward)."""
+    monkeypatch.setenv("HOROVOD_EXACT_ADJOINT_REDUCESCATTER", "1")
+
+    def fn():
+        t = tf.Variable(tf.ones([NP, 2]))
+        with tf.GradientTape() as tape:
+            out = hvd.reducescatter(t, op=hvd.Sum)
+            s = tf.reduce_sum(out)
+        g = tape.gradient(s, t)
+        assert np.allclose(g.numpy(), 1.0), g.numpy()
         return True
 
     assert all(run_ranks(fn))
@@ -790,8 +808,9 @@ def test_tf_grouped_reducescatter_grad_applies_scale_factors(
                 [t], op=hvd.Average, prescale_factor=2.0)
             s = tf.reduce_sum(outs[0])
         g = tape.gradient(s, t)
-        # Average adjoint carries 1/NP, then the prescale 2.0
-        assert np.allclose(g.numpy(), 2.0 / NP), g.numpy()
+        # reference convention: Average backward is the unscaled
+        # allgather, then the prescale 2.0
+        assert np.allclose(g.numpy(), 2.0), g.numpy()
         return True
 
     assert all(run_ranks(fn))

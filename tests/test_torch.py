@@ -370,22 +370,45 @@ def test_torch_broadcast_grad(hvd_shutdown):
 
 
 def test_torch_reducescatter_grad(hvd_shutdown):
+    """Default gradient convention MATCHES the reference
+    (tensorflow/mpi_ops.py:483-506: Average backward is the unscaled
+    allgather; Sum backward scales by size) so migrated multi-worker
+    jobs keep their gradient magnitudes (ADVICE r5)."""
     def fn():
         t = (torch.ones(NP, 2) * (hvd.rank() + 1)).requires_grad_()
         out = hvd.reducescatter(t, op=hvd.Average)
         assert out.shape == (1, 2)
         out.sum().backward()
-        # exact adjoint: forward averages (Sum/NP), so each input
-        # element's grad is 1/NP; backward allgathers that
-        assert torch.allclose(t.grad, torch.ones(NP, 2) / NP)
+        assert torch.allclose(t.grad, torch.ones(NP, 2))
         return True
 
     assert all(run_ranks(fn))
 
 
-def test_torch_reducescatter_grad_matches_autograd_sum(hvd_shutdown):
-    """gradcheck-style: Sum reducescatter's VJP must equal the dense
-    equivalent computed by torch autograd on a single rank."""
+def test_torch_reducescatter_grad_sum_reference_convention(
+        hvd_shutdown):
+    """The reference scales the Sum-reducescatter gradient BY world
+    size (its own test_horovod_reducescatter_grad expects ones*size at
+    size > 1) — the default here now matches."""
+    def fn():
+        t = torch.arange(float(NP * 2)).view(NP, 2).requires_grad_()
+        out = hvd.reducescatter(t, op=hvd.Sum)
+        g = torch.tensor([[2.0, 3.0]])
+        out.backward(g)
+        expected = g.repeat(NP, 1) * NP
+        assert torch.allclose(t.grad, expected), t.grad
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_torch_reducescatter_grad_matches_autograd_sum(
+        hvd_shutdown, monkeypatch):
+    """gradcheck-style: with the exact-adjoint opt-in, Sum
+    reducescatter's VJP equals the dense equivalent computed by torch
+    autograd on a single rank (and Average carries 1/size)."""
+    monkeypatch.setenv("HOROVOD_EXACT_ADJOINT_REDUCESCATTER", "1")
+
     def fn():
         t = torch.arange(float(NP * 2)).view(NP, 2).requires_grad_()
         out = hvd.reducescatter(t, op=hvd.Sum)
@@ -395,6 +418,10 @@ def test_torch_reducescatter_grad_matches_autograd_sum(hvd_shutdown):
         # with coefficient 1 -> grad = allgather of per-slice grads
         expected = g.repeat(NP, 1)
         assert torch.allclose(t.grad, expected), t.grad
+        t2 = torch.ones(NP, 2, requires_grad=True)
+        out2 = hvd.reducescatter(t2, op=hvd.Average)
+        out2.sum().backward()
+        assert torch.allclose(t2.grad, torch.ones(NP, 2) / NP), t2.grad
         return True
 
     assert all(run_ranks(fn))
@@ -859,16 +886,16 @@ def test_grouped_reducescatter_scales_and_compression(hvd_shutdown):
 def test_torch_grouped_reducescatter_backward_scale_factors(
         hvd_shutdown):
     """Regression: the grouped backward dropped prescale/postscale —
-    it must match the single-tensor adjoint (forward applies
-    postscale * reduce(prescale * x), so the VJP multiplies by
-    both)."""
+    it must match the single-tensor backward (reference convention
+    scales Sum by size, then the VJP multiplies by both factors)."""
     def fn():
         t = torch.ones(NP, 2, requires_grad=True)
         outs = hvd.grouped_reducescatter([t], op=hvd.Sum,
                                          prescale_factor=0.5,
                                          postscale_factor=3.0)
         outs[0].sum().backward()
-        assert torch.allclose(t.grad, torch.full((NP, 2), 0.5 * 3.0)), \
+        assert torch.allclose(t.grad,
+                              torch.full((NP, 2), NP * 0.5 * 3.0)), \
             t.grad
         return True
 

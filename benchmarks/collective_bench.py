@@ -103,18 +103,26 @@ def wire_sweep(iters, wire_dtype="all", mb=8):
     feature."""
     import numpy as np
     import horovod_tpu as hvd
-    from horovod_tpu.common import basics
+    from horovod_tpu import telemetry
+
+    # wire accounting comes from registry snapshots
+    # (horovod_wire_*_bytes_total families, docs/observability.md) —
+    # the engine attributes those counters replaced are deprecated
+    # aliases over the same families
+    actual = lambda: telemetry.counter_total(  # noqa: E731
+        "horovod_wire_actual_bytes_total")
+    logical = lambda: telemetry.counter_total(  # noqa: E731
+        "horovod_wire_logical_bytes_total")
 
     out = {}
     n = int(mb * (1 << 20) / 4)
     rng = np.random.default_rng(0)
     x = rng.standard_normal(n).astype(np.float32)
-    eng = basics.engine()
     for wire in (None, "bf16", "int8"):
         name = wire or "f32"
         hvd.allreduce(x, op=hvd.Sum, name=f"wire.w.{name}",
                       wire_dtype=wire)
-        a0, l0 = eng.actual_wire_bytes, eng.logical_wire_bytes
+        a0, l0 = actual(), logical()
         t0 = time.perf_counter()
         for i in range(iters):
             hvd.allreduce(x, op=hvd.Sum, name=f"wire.{name}.{i % 2}",
@@ -122,9 +130,9 @@ def wire_sweep(iters, wire_dtype="all", mb=8):
         dt = time.perf_counter() - t0
         out[f"wire_{name}_engine_MBps"] = round(mb * iters / dt, 1)
         out[f"wire_{name}_engine_wire_bytes"] = \
-            (eng.actual_wire_bytes - a0) // iters
+            int(actual() - a0) // iters
         out[f"wire_{name}_logical_bytes"] = \
-            (eng.logical_wire_bytes - l0) // iters
+            int(logical() - l0) // iters
 
         red = hvd.CompiledGroupedAllreduce(
             op=hvd.Sum, name=f"wire.c.{name}", force_program=True,
@@ -163,9 +171,12 @@ def algo_sweep(iters, algorithm="all", sizes_mb=(1, 8, 32)):
     recorded as ``autotune_algorithm_pick``."""
     import numpy as np
     import horovod_tpu as hvd
+    from horovod_tpu import telemetry
     from horovod_tpu.common import basics
     from horovod_tpu.common.topology import Topology
 
+    cross = lambda: telemetry.counter_total(  # noqa: E731
+        "horovod_wire_cross_bytes_total")
     eng = basics.engine()
     n_ranks = hvd.size()
     if eng.topology.num_hosts == 1 and n_ranks >= 4 \
@@ -186,7 +197,7 @@ def algo_sweep(iters, algorithm="all", sizes_mb=(1, 8, 32)):
             tag = f"algo_{algo}_{mb}mb"
             hvd.allreduce(x, op=hvd.Sum, name=f"{tag}.w",
                           algorithm=algo)
-            c0 = eng.cross_wire_bytes
+            c0 = cross()
             t0 = time.perf_counter()
             for i in range(iters):
                 hvd.allreduce(x, op=hvd.Sum, name=f"{tag}.{i % 2}",
@@ -194,7 +205,7 @@ def algo_sweep(iters, algorithm="all", sizes_mb=(1, 8, 32)):
             dt = time.perf_counter() - t0
             out[f"{tag}_engine_MBps"] = round(mb * iters / dt, 1)
             out[f"{tag}_engine_cross_bytes"] = \
-                (eng.cross_wire_bytes - c0) // iters
+                int(cross() - c0) // iters
 
             red = hvd.CompiledGroupedAllreduce(
                 op=hvd.Sum, name=f"{tag}.c", force_program=True,
@@ -298,8 +309,17 @@ def proc_worker(small_count, iters):
     dt = time.perf_counter() - t0
     out["allgather_single_large_MBps"] = round(total_mb / dt, 1)
 
-    from horovod_tpu.common import basics
-    out["fused_allgather_runs"] = basics.engine().fused_allgather_runs
+    from horovod_tpu import telemetry
+    out["fused_allgather_runs"] = int(telemetry.counter_total(
+        "horovod_fused_allgather_runs_total"))
+    # steady-state negotiation latency straight from the histogram the
+    # engine exports (mean over the run; the /metrics scrape carries
+    # the full distribution)
+    neg = telemetry.metrics().get("horovod_negotiation_seconds", {})
+    n = sum(s.get("count", 0) for s in neg.get("samples", []))
+    tot = sum(s.get("sum", 0.0) for s in neg.get("samples", []))
+    if n:
+        out["negotiation_mean_ms"] = round(tot / n * 1e3, 3)
     if r == 0:
         dest = os.environ.get("CB_OUT")
         payload = json.dumps(out)

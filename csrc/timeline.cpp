@@ -26,6 +26,10 @@ struct Event {
   char ph[4];
   int64_t tid;
   double ts;
+  // pre-serialized JSON args for counter ("C") events; empty
+  // otherwise.  Python sends ready-made JSON so the writer thread
+  // stays a formatter, never a serializer.
+  char args[160];
 };
 
 struct Writer {
@@ -55,6 +59,13 @@ struct Writer {
                        "\"pid\": 0, \"tid\": %lld, \"args\": {\"name\": "
                        "\"%s\"}}",
                        static_cast<long long>(e.tid), e.name);
+        } else if (std::strcmp(e.ph, "C") == 0) {
+          // counter event: args payload arrives pre-serialized
+          std::fprintf(f,
+                       "{\"name\": \"%s\", \"ph\": \"C\", \"pid\": 0, "
+                       "\"tid\": %lld, \"ts\": %.3f, \"args\": %s}",
+                       e.name, static_cast<long long>(e.tid), e.ts,
+                       e.args[0] ? e.args : "{}");
         } else if (std::strcmp(e.ph, "i") == 0) {
           // instant markers render full-height only with global scope
           std::fprintf(f,
@@ -101,6 +112,27 @@ void hvd_tl_event(void* handle, const char* name, const char* ph,
   std::snprintf(e.ph, sizeof(e.ph), "%s", ph);
   e.tid = tid;
   e.ts = ts_us;
+  e.args[0] = '\0';
+  {
+    std::lock_guard<std::mutex> lock(w->mu);
+    w->queue.push_back(e);
+  }
+  w->cv.notify_one();
+}
+
+// Counter ("C") event: args_json must be a complete JSON object
+// (python-side json.dumps of {series: number}); truncation at 159
+// chars would corrupt the trace, so oversized payloads are dropped.
+void hvd_tl_counter(void* handle, const char* name,
+                    const char* args_json, double ts_us) {
+  Writer* w = static_cast<Writer*>(handle);
+  Event e;
+  if (std::strlen(args_json) >= sizeof(e.args)) return;
+  std::snprintf(e.name, sizeof(e.name), "%s", name);
+  std::snprintf(e.ph, sizeof(e.ph), "C");
+  e.tid = 0;
+  e.ts = ts_us;
+  std::snprintf(e.args, sizeof(e.args), "%s", args_json);
   {
     std::lock_guard<std::mutex> lock(w->mu);
     w->queue.push_back(e);

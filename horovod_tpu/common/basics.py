@@ -24,6 +24,8 @@ _timeline = None
 _tls = threading.local()
 _distributed_up = False
 _elastic_round = 0
+_metrics_server = None
+_last_world_size = None
 
 
 def _apply_platform_env(jax):
@@ -121,6 +123,47 @@ def _make_timeline(config):
     return None
 
 
+def _record_resize_event(new_size):
+    """Elastic membership change → telemetry.  Called AFTER the engine
+    installed the round's fresh registry; ``_last_world_size``
+    survives shutdown/init cycles so the direction is the true delta
+    across rounds."""
+    global _last_world_size
+    from .. import telemetry
+
+    prev, _last_world_size = _last_world_size, new_size
+    if prev is None or prev == new_size:
+        direction = "initial" if prev is None else "rebalance"
+    else:
+        direction = "up" if new_size > prev else "down"
+    telemetry.registry().counter(
+        "horovod_elastic_resize_events_total",
+        "Elastic membership changes seen by this worker",
+        labelnames=("direction",)).labels(direction=direction).inc()
+
+
+def _start_metrics_endpoint(config, proc_index):
+    """Per-worker Prometheus endpoint (HOROVOD_METRICS_PORT /
+    ``horovodrun --metrics-port``).  Workers sharing a host offset the
+    base port by their process index so every endpoint binds."""
+    global _metrics_server
+    if config.metrics_port <= 0 or _metrics_server is not None:
+        return
+    from ..telemetry import MetricsServer
+    port = config.metrics_port + (proc_index or 0)
+    server = MetricsServer(port=port)
+    try:
+        server.start()
+    except OSError as exc:
+        import logging
+        logging.getLogger("horovod_tpu").warning(
+            "could not bind metrics endpoint on port %d: %s "
+            "(metrics still available via hvd.metrics() and the "
+            "coordinator's /metrics)", port, exc)
+        return
+    _metrics_server = server
+
+
 def init(comm=None, process_sets=None, num_ranks=None, devices=None):
     """Initialize the runtime (reference horovod_init,
     operations.cc:934 → InitializeHorovodOnce :856).
@@ -146,10 +189,16 @@ def init(comm=None, process_sets=None, num_ranks=None, devices=None):
             return
         from ..core.engine import Engine
 
+        # honor the runner's HOROVOD_LOG_LEVEL / HOROVOD_LOG_HIDE_TIME
+        # handoff before anything logs (reference logging.cc reads the
+        # same env in every worker)
+        env_mod.setup_logging()
+
         controller = None
         rank_offset = 0
         global_size = None
         ranks_of_proc = None
+        proc_index = 0
         multiproc = env_mod.get_str(env_mod.HOROVOD_CONTROLLER) == "http"
         if num_ranks is None:
             num_ranks = env_mod.get_int(env_mod.HOROVOD_TPU_RANKS_PER_PROC, 0)
@@ -220,6 +269,7 @@ def init(comm=None, process_sets=None, num_ranks=None, devices=None):
             else:
                 global_size = num_procs * num_ranks
                 rank_offset = proc_id * num_ranks
+            proc_index = proc_id
             controller = StoreController(
                 rdv_addr, rdv_port, secret, proc_id, num_procs,
                 num_ranks, round_id=round_id)
@@ -269,6 +319,12 @@ def init(comm=None, process_sets=None, num_ranks=None, devices=None):
                          controller=controller, rank_offset=rank_offset,
                          global_size=global_size,
                          ranks_of_proc=ranks_of_proc)
+        # telemetry surface: per-worker exposition endpoint + elastic
+        # resize accounting (the engine just installed this round's
+        # fresh registry)
+        _start_metrics_endpoint(config, proc_index)
+        if env_mod.get_bool(env_mod.HOROVOD_ELASTIC):
+            _record_resize_event(_engine.global_size)
         if process_sets:
             from . import process_sets as ps_mod
             for ps in process_sets:
@@ -484,6 +540,39 @@ def gloo_enabled():
     role on every launch path, including elastic.  Note
     ``gloo_built()`` stays False — no libgloo is linked."""
     return True
+
+
+def metrics():
+    """Snapshot of this process's metric registry — a JSON-able dict
+    keyed by family name (docs/observability.md).  The programmatic
+    twin of the ``/metrics.json`` endpoint; works before init() too
+    (empty registry)."""
+    from .. import telemetry
+    return telemetry.metrics()
+
+
+def start_metrics_server(port=None):
+    """Start (or return) this worker's Prometheus endpoint.  With no
+    argument uses ``HOROVOD_METRICS_PORT`` (+ process index); an
+    explicit ``port`` binds exactly there.  Returns the server object
+    (``.port`` is the bound port — pass ``port=0`` for an ephemeral
+    one)."""
+    global _metrics_server
+    with _state_lock:
+        if port is None:
+            if _metrics_server is not None:
+                return _metrics_server
+            from . import env as env_mod_
+            port = env_mod_.get_int(env_mod_.HOROVOD_METRICS_PORT, 0)
+            if port:
+                port += env_mod_.get_int(
+                    env_mod_.HOROVOD_TPU_PROC_INDEX, 0)
+        from ..telemetry import MetricsServer
+        server = MetricsServer(port=port or 0)
+        server.start()
+        if _metrics_server is None:
+            _metrics_server = server
+        return server
 
 
 def start_timeline(filename, mark_cycles=False):

@@ -6,6 +6,7 @@ in operations.cc:459-650 and utils/env_parser.cc).  We keep the same
 names so launcher flags, config files and user habits carry over.
 """
 
+import logging
 import os
 
 # --- knob names (reference common.h:115-149) ---------------------------------
@@ -43,6 +44,13 @@ HOROVOD_RENDEZVOUS_ADDR = "HOROVOD_GLOO_RENDEZVOUS_ADDR"
 HOROVOD_RENDEZVOUS_PORT = "HOROVOD_GLOO_RENDEZVOUS_PORT"
 HOROVOD_CONTROLLER = "HOROVOD_CONTROLLER"
 HOROVOD_CPU_OPERATIONS = "HOROVOD_CPU_OPERATIONS"
+
+# telemetry (docs/observability.md): per-worker Prometheus endpoint
+# on METRICS_PORT (+ proc index in multi-process jobs) and the
+# worker->coordinator snapshot push cadence feeding the job-wide
+# /metrics on the launcher's rendezvous service
+HOROVOD_METRICS_PORT = "HOROVOD_METRICS_PORT"
+HOROVOD_METRICS_PUSH_SECONDS = "HOROVOD_METRICS_PUSH_SECONDS"
 
 # TPU-native additions
 HOROVOD_WIRE_DTYPE = "HOROVOD_WIRE_DTYPE"      # f32 | fp16 | bf16 | int8
@@ -98,6 +106,54 @@ def get_str(name, default=None):
     return os.environ.get(name, default)
 
 
+# -- worker-side logging (reference common/logging.cc + env_parser.cc
+#    SetLogLevelFromEnv/SetBoolFromEnv(HOROVOD_LOG_HIDE_TIME)) --------------
+
+_LOG_LEVELS = {
+    "trace": logging.DEBUG,     # python logging has no TRACE tier
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "fatal": logging.CRITICAL,
+}
+
+
+def setup_logging():
+    """Configure the ``horovod_tpu`` logger from ``HOROVOD_LOG_LEVEL``
+    and ``HOROVOD_LOG_HIDE_TIME``.
+
+    The runner exports both (runner/config_parser.py) exactly like the
+    reference launcher, and the reference workers honor them in
+    ``logging.cc``; called from ``hvd.init()`` so launched workers do
+    too.  Without an explicit level the logger is left alone (library
+    default: warnings propagate to whatever the host app configured)."""
+    level = get_str(HOROVOD_LOG_LEVEL)
+    hide_time = get_bool(HOROVOD_LOG_HIDE_TIME)
+    logger = logging.getLogger("horovod_tpu")
+    if level is None:
+        return logger
+    logger.setLevel(_LOG_LEVELS.get(level.strip().lower(),
+                                    logging.WARNING))
+    fmt = "[%(levelname)s] %(message)s" if hide_time else \
+        "[%(asctime)s.%(msecs)03d, %(levelname)s] %(message)s"
+    handler = None
+    for h in logger.handlers:
+        if getattr(h, "_hvd_env_handler", False):
+            handler = h
+            break
+    if handler is None:
+        handler = logging.StreamHandler()
+        handler._hvd_env_handler = True
+        logger.addHandler(handler)
+        # this logger now owns its output (reference logging.cc writes
+        # its own stream); propagating too would double every record
+        # through the host application's root handlers
+        logger.propagate = False
+    handler.setFormatter(logging.Formatter(fmt, datefmt="%H:%M:%S"))
+    return logger
+
+
 class Config:
     """Runtime knobs resolved from the environment at init() time.
 
@@ -149,6 +205,15 @@ class Config:
             HOROVOD_STALL_CHECK_TIME_SECONDS, DEFAULT_STALL_WARNING_SECS)
         self.stall_shutdown_secs = get_float(HOROVOD_STALL_SHUTDOWN_TIME_SECONDS, 0.0)
         self.elastic = get_bool(HOROVOD_ELASTIC)
+        # telemetry exposition (docs/observability.md): metrics_port 0
+        # = no per-worker HTTP endpoint.  The snapshot push that feeds
+        # the coordinator's job-wide /metrics defaults on (cheap: one
+        # small KV put per interval) whenever an endpoint is enabled,
+        # and can be forced on/off explicitly.
+        self.metrics_port = get_int(HOROVOD_METRICS_PORT, 0)
+        self.metrics_push_secs = get_float(
+            HOROVOD_METRICS_PUSH_SECONDS,
+            2.0 if self.metrics_port else 0.0)
         # process-set removal is a barrier across local rank threads;
         # this bounds the wait for peers' votes and the drain of
         # in-flight collectives on the set

@@ -147,6 +147,41 @@ class ParameterManager:
         if self._steps >= self.steps_per_sample:
             self._finish_sample()
 
+    def _metrics_record(self, score):
+        """Export the sample count, best score and best config
+        (telemetry/registry.py; docs/observability.md) — the CSV log's
+        scrape-able twin."""
+        from .. import telemetry
+
+        reg = telemetry.registry()
+        reg.counter("horovod_autotune_samples_total",
+                    "Autotune sample windows scored").inc()
+        reg.gauge("horovod_autotune_best_score_bytes_per_sec",
+                  "Best autotune score observed (logical bytes/sec)"
+                  ).set(max(self._best_score, score)
+                        if self._best_score != -np.inf else score)
+        decoded = self._decode(self._best)
+        fusion, cycle, _, _ = decoded[:4]
+        i = 4
+        wire = algo = ""
+        if self.tune_wire:
+            wire = decoded[i] or "f32"
+            i += 1
+        if self.tune_algorithm:
+            algo = decoded[i]
+        best = reg.gauge(
+            "horovod_autotune_best_config",
+            "Current best autotune configuration (value 1; the "
+            "labels are the config)",
+            labelnames=("fusion_threshold_bytes", "cycle_time_ms",
+                        "wire", "algorithm"))
+        # the gauge is an info-style marker: exactly ONE labeled child
+        # (the current best) — a new best replaces, never accumulates
+        best.clear()
+        best.labels(fusion_threshold_bytes=fusion,
+                    cycle_time_ms=f"{cycle:.3f}", wire=wire,
+                    algorithm=algo).set(1)
+
     def _finish_sample(self):
         elapsed = max(time.monotonic() - self._t0, 1e-6)
         score = self._bytes / elapsed
@@ -169,6 +204,10 @@ class ParameterManager:
             if score > self._best_score:
                 self._best_score = score
                 self._best = self._current
+        try:
+            self._metrics_record(score)
+        except Exception:  # noqa: BLE001 — telemetry must never kill
+            pass           # a tuning session
         if self._samples >= self.max_samples:
             # converge: pin best parameters, stop tuning (reference
             # parameter_manager.cc final tuning state)

@@ -171,26 +171,17 @@ class Engine:
 
         self._stall_warned = set()
         self._algo_warned = set()
-        #: fused-allgather buckets executed (observability + tests)
-        self.fused_allgather_runs = 0
-        #: wire accounting (observability + collective_bench): logical
-        #: bytes = the full-width payload a rank handed in; wire bytes
-        #: = what its encoding actually puts on the interconnect
-        #: (int8 codes + bf16 scales for the quantized wire)
-        self.logical_wire_bytes = 0
-        self.actual_wire_bytes = 0
-        #: bytes that crossed the SLOW (cross-host / DCN) hop — the
-        #: number topology-aware algorithms exist to shrink: flat
-        #: collectives on a multi-host set pay their full wire here,
-        #: hierarchical/torus only 1/inner of it
-        self.cross_wire_bytes = 0
-        #: buckets executed per reduction algorithm
-        #: (flat / hierarchical / torus) — observability + tests
-        self.algo_runs = {}
-        #: quantized (int8-wire) buckets executed
-        self.quantized_bucket_runs = 0
+        # one fresh registry per engine lifecycle (telemetry/registry):
+        # every counter the benchmarks and the /metrics endpoints read
+        # lives here; the legacy engine attributes (logical_wire_bytes,
+        # algo_runs, ...) are deprecated property shims over these
+        # families — see docs/observability.md
+        self._install_metrics()
         #: hold_cycles() depth — while >0 the loop parks (no dispatch)
         self._hold_depth = 0
+        self._tl_queues_nonzero = False
+        self._metrics_pusher = None
+        self._start_metrics_push()
         self._thread = threading.Thread(
             target=self._background_loop, name="horovod_tpu-engine",
             daemon=True)
@@ -207,6 +198,148 @@ class Engine:
     @property
     def multiproc(self):
         return self.controller is not None
+
+    # ------------------------------------------------------------------
+    # telemetry
+
+    def _install_metrics(self):
+        """Create this engine's registry and the standard families
+        (telemetry/registry.py).  Families the compiled path, the
+        autotuner and the elastic driver update are pre-declared too,
+        so a scrape always shows the full catalogue (zero-valued until
+        touched) — the contract docs/observability.md documents."""
+        from .. import telemetry
+
+        m = self.metrics = telemetry.fresh_registry()
+        self._m_logical = m.counter(
+            "horovod_wire_logical_bytes_total",
+            "Full-width payload bytes handed to reductions",
+            labelnames=("wire",))
+        self._m_actual = m.counter(
+            "horovod_wire_actual_bytes_total",
+            "Bytes the wire encoding actually puts on the interconnect",
+            labelnames=("wire",))
+        self._m_cross = m.counter(
+            "horovod_wire_cross_bytes_total",
+            "Bytes that crossed the slow (cross-host / DCN) hop",
+            labelnames=("wire",))
+        self._m_algo = m.counter(
+            "horovod_allreduce_runs_total",
+            "Allreduce buckets executed per reduction algorithm",
+            labelnames=("algorithm",))
+        self._m_quantized = m.counter(
+            "horovod_quantized_buckets_total",
+            "Buckets executed over the block-scaled int8 wire")
+        self._m_fused_ag = m.counter(
+            "horovod_fused_allgather_runs_total",
+            "Fused allgather buckets executed")
+        self._m_negotiation = m.histogram(
+            "horovod_negotiation_seconds",
+            "First local submission to locally-ready, per op",
+            labelnames=("op",))
+        self._m_execution = m.histogram(
+            "horovod_execution_seconds",
+            "Bucket dispatch to completion, per op",
+            labelnames=("op",))
+        self._m_cycle = m.histogram(
+            "horovod_cycle_seconds",
+            "Active portion of engine cycles that produced work")
+        self._m_cycles = m.counter(
+            "horovod_engine_cycles_total",
+            "Engine negotiation cycles that produced work")
+        self._m_pending = m.gauge(
+            "horovod_pending_entries",
+            "Negotiation entries awaiting local submissions",
+            labelnames=("process_set",))
+        self._m_awaiting = m.gauge(
+            "horovod_awaiting_entries",
+            "Locally-ready entries awaiting the coordinator's schedule",
+            labelnames=("process_set",))
+        self._m_stalled = m.gauge(
+            "horovod_stalled_tensors",
+            "Entries currently past the stall warning time",
+            labelnames=("process_set",))
+        self._m_stall_warn = m.counter(
+            "horovod_stall_warnings_total",
+            "Stall warnings issued; 'ranks' names the global ranks "
+            "attributed (locally-missing ranks, or every rank a "
+            "non-reporting process hosts)",
+            labelnames=("ranks",))
+        # families owned by other layers, pre-declared for the catalogue
+        m.counter("horovod_program_cache_hits_total",
+                  "Compiled-path program cache hits")
+        m.counter("horovod_program_cache_misses_total",
+                  "Compiled-path program cache misses (new builds)")
+        m.counter("horovod_compile_seconds_total",
+                  "Seconds spent building + first-compiling programs")
+        m.counter("horovod_autotune_samples_total",
+                  "Autotune sample windows scored")
+        m.gauge("horovod_autotune_best_score_bytes_per_sec",
+                "Best autotune score observed (logical bytes/sec)")
+        m.gauge("horovod_autotune_best_config",
+                "Current best autotune configuration (value 1; the "
+                "labels are the config)",
+                labelnames=("fusion_threshold_bytes", "cycle_time_ms",
+                            "wire", "algorithm"))
+        m.counter("horovod_elastic_resize_events_total",
+                  "Elastic membership changes seen by this worker",
+                  labelnames=("direction",))
+        ws = m.gauge("horovod_world_size", "Global number of ranks")
+        ws.set(self.global_size)
+
+    def _start_metrics_push(self):
+        """Multi-process jobs push periodic registry snapshots to the
+        launcher's KV store over the existing fabric; the coordinator
+        merges them into its job-wide /metrics."""
+        if not self.multiproc:
+            return
+        secs = getattr(self.config, "metrics_push_secs", 0.0)
+        if secs <= 0:
+            return
+        from ..telemetry import MetricsPusher
+        self._metrics_pusher = MetricsPusher(
+            self.controller.client, self.controller.proc_id,
+            interval=secs,
+            # round + proc let the coordinator drop stale snapshots
+            # (elastic downsizes, previous rounds) from the aggregate
+            meta={"rank_offset": self.rank_offset,
+                  "num_local": self.num_local,
+                  "round": self.controller.round_id}).start()
+
+    def push_metrics(self):
+        """Push this worker's snapshot to the coordinator NOW (the
+        periodic pusher's out-of-band hook — tests and short jobs)."""
+        if self._metrics_pusher is not None:
+            self._metrics_pusher.push_now()
+
+    # -- deprecated counter shims: the pre-telemetry attribute surface.
+    #    Benchmarks and tests historically read these off the engine;
+    #    new code reads telemetry snapshots (hvd.metrics()).  Each is a
+    #    read-only view over the registry family that replaced it.
+
+    @property
+    def logical_wire_bytes(self):
+        return int(self._m_logical.total())
+
+    @property
+    def actual_wire_bytes(self):
+        return int(self._m_actual.total())
+
+    @property
+    def cross_wire_bytes(self):
+        return int(self._m_cross.total())
+
+    @property
+    def algo_runs(self):
+        return {k: int(v) for k, v in self._m_algo.as_dict().items()}
+
+    @property
+    def quantized_bucket_runs(self):
+        return int(self._m_quantized.total())
+
+    @property
+    def fused_allgather_runs(self):
+        return int(self._m_fused_ag.total())
 
     def _local_global_ranks(self):
         return range(self.rank_offset, self.rank_offset + self.num_local)
@@ -395,6 +528,7 @@ class Engine:
                 for key, entry in list(table.items()):
                     if incomplete(entry) or time.monotonic() > deadline:
                         table.pop(key, None)
+                        self._discard_stall_mark(ps_id, key)
                         if self.multiproc:
                             self.controller.forget(key)
                         for sub in entry.subs.values():
@@ -405,6 +539,11 @@ class Engine:
                 break
             self._lock.wait(timeout=0.05)   # let the engine drain
         self.process_sets.pop(ps_id, None)
+        # the set's gauge children go with it — a phantom nonzero
+        # queue depth for a dead set would trip alerting forever
+        for fam in (self._m_pending, self._m_awaiting,
+                    self._m_stalled):
+            fam.remove(process_set=ps_id)
         self._removed_ps_ids.add(ps_id)
         ev = self._removal_events.pop(ps_id, None)
         self._removal_votes.pop(ps_id, None)
@@ -557,8 +696,10 @@ class Engine:
                     # hold_cycles(): park so concurrent submissions
                     # accumulate and dispatch in ONE cycle on release
                     continue
+                cycle_t0 = time.monotonic()
                 work = self._collect_ready_locked()
                 self._check_stalls_locked()
+                self._observe_queues_locked()
             if self.timeline is not None and work:
                 # reference timeline.cc MarkCycleStart: one instant
                 # marker per negotiation cycle that produced work
@@ -569,7 +710,35 @@ class Engine:
             else:
                 for ps, batch in work:
                     self._execute_batch(ps, batch)
+            if work:
+                # idle cycles are just the wait timeout expiring; only
+                # cycles that produced work say anything about dispatch
+                self._m_cycles.inc()
+                self._m_cycle.observe(time.monotonic() - cycle_t0)
         self._shutdown_done.set()
+
+    def _observe_queues_locked(self):
+        """Queue-depth gauges per process set, mirrored as Chrome
+        counter ("C") events on the timeline so traces and metrics
+        tell one story (docs/timeline.md)."""
+        pending = awaiting = 0
+        for ps in self.process_sets.values():
+            self._m_pending.labels(process_set=ps.id).set(
+                len(ps.pending))
+            self._m_awaiting.labels(process_set=ps.id).set(
+                len(ps.awaiting))
+            pending += len(ps.pending)
+            awaiting += len(ps.awaiting)
+        tl = self.timeline
+        if tl is not None and (pending or awaiting
+                               or self._tl_queues_nonzero):
+            self._tl_queues_nonzero = bool(pending or awaiting)
+            tl.counter("queue_depth", {"pending": pending,
+                                       "awaiting": awaiting})
+            tl.counter("wire_bytes", {
+                "logical": self.logical_wire_bytes,
+                "actual": self.actual_wire_bytes,
+                "cross": self.cross_wire_bytes})
 
     def _collect_ready_locked(self):
         """ComputeResponseList analogue: pull locally-ready negotiation
@@ -603,45 +772,97 @@ class Engine:
                     del ps.pending[key]
                     if self.multiproc:
                         ps.awaiting[key] = entry
-                    self._stall_warned.discard((ps.id, key))
+                    self._discard_stall_mark(ps.id, key)
+                    self._m_negotiation.labels(
+                        op=key.split("|", 1)[0]).observe(
+                            time.monotonic() - entry.first_time)
             if ready:
                 work.append((ps, ready))
         return work
 
+    def _stall_ranks_label(self, ranks):
+        """Bounded label value naming the attributed ranks: the first
+        eight rank ids verbatim (+count of the rest), folding into
+        ``other`` once the family holds 64 distinct children.  Keeps
+        the exported labels naming ranks (the log line always carries
+        the full list) without the unbounded-cardinality anti-pattern
+        a flapping large job would otherwise mint."""
+        label = ",".join(str(r) for r in ranks[:8])
+        if len(ranks) > 8:
+            label += f",+{len(ranks) - 8}"
+        seen = self._m_stall_warn.as_dict()
+        if label not in seen and len(seen) >= 64:
+            return "other"
+        return label
+
+    def _discard_stall_mark(self, ps_id, key):
+        """Drop the once-per-stall warning mark for a tensor.  MUST be
+        called from every path that removes an entry from pending OR
+        awaiting — ready collection, coordinator batch/error responses,
+        stall shutdown, validation failure, abort — or a re-used tensor
+        name that stalls again warns only once per process lifetime."""
+        self._stall_warned.discard((ps_id, key))
+
     def _check_stalls_locked(self):
         """Stall inspector (reference stall_inspector.{h,cc}): warn when
         a tensor is ready on some-but-not-all ranks past the warning
-        time; error everyone past the shutdown time."""
+        time; error everyone past the shutdown time.
+
+        Attribution is GLOBAL in multi-process jobs: the coordinator
+        aggregates which processes never reported a stalled tensor and
+        names the missing global ranks in a ``stall`` response
+        (runner/http/http_server.py _scan_stalls → _apply_response),
+        exactly the reference's coordinator-side
+        ``StallInspector::CheckForStalledTensors``.  The local check
+        here covers what only this process can see — ranks IT hosts
+        that never submitted — and falls back for the awaiting table
+        only after 2x the warning time, so the coordinator's
+        rank-attributed warning lands first when it is alive."""
         if self.config.stall_check_disable:
             return
         now = time.monotonic()
+        stalled = {}
         for ps in self.process_sets.values():
             tables = [("pending", ps.pending), ("awaiting", ps.awaiting)]
             for where, table in tables:
                 for key, entry in list(table.items()):
                     age = now - entry.first_time
                     wkey = (ps.id, key)
-                    if (age > self.config.stall_warning_secs
+                    if age > self.config.stall_warning_secs:
+                        stalled[ps.id] = stalled.get(ps.id, 0) + 1
+                    warn_after = self.config.stall_warning_secs
+                    if where == "awaiting":
+                        warn_after *= 2
+                    if (age > warn_after
                             and wkey not in self._stall_warned):
                         if where == "pending":
+                            # ps.local_ranks hold GLOBAL rank ids; this
+                            # process can attribute its own ranks
                             missing = [r for r in ps.local_ranks
                                        if r not in entry.subs
                                        and r not in ps.joined]
                             logger.warning(
                                 "One or more tensors were submitted to "
                                 "be reduced by some ranks but not all: "
-                                "%s stalled for %.0fs (missing local "
-                                "ranks: %s)", key, age, missing)
+                                "%s stalled for %.0fs (missing ranks: "
+                                "%s, hosted by this process)",
+                                key, age, missing)
+                            self._m_stall_warn.labels(
+                                ranks=self._stall_ranks_label(
+                                    missing)).inc()
                         else:
                             logger.warning(
                                 "Tensor %s reported ready %.0fs ago but "
                                 "the coordinator has not scheduled it "
-                                "(peer process missing or stalled)",
+                                "(peer process missing or stalled; no "
+                                "coordinator stall report received)",
                                 key, age)
+                            self._m_stall_warn.labels(ranks="").inc()
                         self._stall_warned.add(wkey)
                     if (self.config.stall_shutdown_secs > 0
                             and age > self.config.stall_shutdown_secs):
                         del table[key]
+                        self._discard_stall_mark(ps.id, key)
                         if where == "awaiting" and self.multiproc:
                             # no coordinator response will ever name
                             # this key for us: un-mark it as reported
@@ -650,8 +871,12 @@ class Engine:
                         for sub in entry.subs.values():
                             sub.handle.set_error(StalledTensorError(
                                 f"tensor {key} stalled for {age:.0f}s"))
+        for ps in self.process_sets.values():
+            self._m_stalled.labels(process_set=ps.id).set(
+                stalled.get(ps.id, 0))
 
     def _fail_all_pending_locked(self, exc):
+        self._stall_warned.clear()
         for ps in self.process_sets.values():
             for entry in list(ps.pending.values()) + \
                     list(ps.awaiting.values()):
@@ -676,6 +901,16 @@ class Engine:
         req = first.request
         nbytes = sum(int(p.nbytes) for p in first.payloads)
         nprocs = len({self._proc_of(r) for r in ps.ranks})
+        members = getattr(ps, "_members_by_proc", None)
+        if members is None:
+            # per-process member ranks: the coordinator's stall
+            # inspector maps a non-reporting process back to the
+            # GLOBAL ranks it hosts (reference stall_inspector.cc
+            # names ranks, not hosts).  Static per set — cached.
+            members = {}
+            for r in ps.ranks:
+                members.setdefault(str(self._proc_of(r)), []).append(r)
+            ps._members_by_proc = members
         meta = {
             "key": entry.key,
             "type": req.request_type.name,
@@ -691,6 +926,7 @@ class Engine:
             "nprocs": nprocs,
             "nranks": ps.size,
             "root": req.root_rank,
+            "members": members,
             "aux": {},
         }
         if req.group_shapes is not None:
@@ -722,6 +958,7 @@ class Engine:
                 if err is not None:
                     with self._lock:
                         ps.awaiting.pop(entry.key, None)
+                        self._discard_stall_mark(ps.id, entry.key)
                     for sub in entry.subs.values():
                         sub.handle.set_error(err)
                     # tell the coordinator so peer processes holding
@@ -769,6 +1006,7 @@ class Engine:
                     e = ps.awaiting.pop(k, None)
                     if e is not None:
                         popped[k] = e
+                        self._discard_stall_mark(ps.id, k)
                 for k in keys:
                     e = popped.get(k)
                     if e is None:
@@ -807,10 +1045,42 @@ class Engine:
                 for cand in self.process_sets.values():
                     e = cand.awaiting.pop(resp["key"], None)
                     if e is not None:
+                        self._discard_stall_mark(cand.id, resp["key"])
                         for sub in e.subs.values():
                             sub.handle.set_error(TensorShapeMismatchError(
                                 resp.get("message", "negotiation error")))
                         break
+        elif kind == "stall":
+            # coordinator-side stall attribution (reference
+            # stall_inspector.cc CheckForStalledTensors relocated into
+            # the launcher's coordinator): the warning names the
+            # missing GLOBAL ranks, aggregated across processes —
+            # today's local view can only name ranks this process
+            # hosts.  The mark doubles as dedup against the local
+            # fallback in _check_stalls_locked.
+            key = resp.get("key")
+            ps_id = resp.get("ps", 0)
+            missing = resp.get("missing_ranks") or []
+            with self._lock:
+                wkey = (ps_id, key)
+                fresh = wkey not in self._stall_warned
+                if fresh:
+                    self._stall_warned.add(wkey)
+            if fresh:
+                # ranks = every global rank a non-reporting process
+                # hosts (the coordinator's attribution granularity is
+                # the process; that process's own local inspector
+                # narrows to the exact rank it is missing)
+                logger.warning(
+                    "One or more tensors were submitted to be reduced "
+                    "by some ranks but not all: %s stalled for %ss "
+                    "(missing global ranks: %s, hosted by "
+                    "non-reporting processes %s)",
+                    key, resp.get("age", "?"),
+                    missing if missing else "unknown",
+                    resp.get("missing_procs", []))
+                self._m_stall_warn.labels(
+                    ranks=self._stall_ranks_label(missing)).inc()
         elif kind == "join_done":
             with self._lock:
                 ps = self.process_sets.get(resp.get("ps", 0))
@@ -1020,6 +1290,7 @@ class Engine:
     def _run_bucket(self, ps, bucket, aux=None):
         first = next(iter(bucket[0].subs.values()))
         rt = first.request.request_type
+        exec_t0 = time.monotonic()
         if self.timeline is not None:
             names = [n for e in bucket for s in (next(iter(e.subs.values())),)
                      for n in s.names]
@@ -1048,6 +1319,8 @@ class Engine:
             else:
                 raise HorovodInternalError(f"unhandled op {rt}")
         finally:
+            self._m_execution.labels(op=rt.name).observe(
+                time.monotonic() - exec_t0)
             if self.timeline is not None:
                 self.timeline.op_end()
 
@@ -1161,16 +1434,19 @@ class Engine:
                  if r < len(topo.host_of_rank)}
         return len(hosts) > 1
 
-    def _account_wire(self, logical, actual, cross=None):
+    def _account_wire(self, logical, actual, cross=None, wire=None):
         """``cross`` = bytes over the slow (cross-host) hop; ``None``
         means the collective was flat, so its whole wire crosses DCN
         whenever the job spans hosts (topology-aware dispatch passes
-        its decomposed cross-hop bytes explicitly)."""
-        self.logical_wire_bytes += int(logical)
-        self.actual_wire_bytes += int(actual)
+        its decomposed cross-hop bytes explicitly).  ``wire`` labels
+        the metric family with the encoding that produced the bytes
+        (None = full width)."""
         if cross is None:
             cross = actual if self._spans_hosts() else 0
-        self.cross_wire_bytes += int(cross)
+        w = wire or "f32"
+        self._m_logical.labels(wire=w).inc(int(logical))
+        self._m_actual.labels(wire=w).inc(int(actual))
+        self._m_cross.labels(wire=w).inc(int(cross))
 
     def _encode_int8_rows(self, rows, logical_nbytes):
         """Block-quantize per-rank rows for the int8 wire (shared by
@@ -1183,8 +1459,9 @@ class Engine:
             q_rows.append(q)
             s_rows.append(s)
         self._account_wire(logical_nbytes,
-                           q_rows[0].nbytes + s_rows[0].nbytes)
-        self.quantized_bucket_runs += 1
+                           q_rows[0].nbytes + s_rows[0].nbytes,
+                           wire="int8")
+        self._m_quantized.inc()
         return q_rows, s_rows
 
     def _algo_plan(self, ps, req, op):
@@ -1226,7 +1503,7 @@ class Engine:
         hierarchical / torus (ops/xla_ops.allreduce_2d)."""
         wire = self._wire_for(req, dtype, op)
         algo, inner = self._algo_plan(ps, req, op)
-        self.algo_runs[algo] = self.algo_runs.get(algo, 0) + 1
+        self._m_algo.labels(algorithm=algo).inc()
         itemsize = dtype.itemsize
         if algo != "flat":
             return self._dispatch_allreduce_2d(
@@ -1241,7 +1518,8 @@ class Engine:
             wdt = np.dtype(np.float16) if wire == "fp16" \
                 else _bfloat16_dtype()
             self._account_wire(total * itemsize, total * 2,
-                               cross=total * 2 if flat_cross else 0)
+                               cross=total * 2 if flat_cross else 0,
+                               wire=wire)
             out = ps.executor.allreduce(
                 [r.astype(wdt) for r in rows], op,
                 req.prescale_factor, req.postscale_factor)
@@ -1271,7 +1549,7 @@ class Engine:
             wdt = np.dtype(np.float16) if wire == "fp16" \
                 else _bfloat16_dtype()
             self._account_wire(total * itemsize, total * 2,
-                               cross=m * 2 if spans else 0)
+                               cross=m * 2 if spans else 0, wire=wire)
             out = ps.executor.allreduce_2d(
                 [r.astype(wdt) for r in rows], op,
                 req.prescale_factor, req.postscale_factor, inner)
@@ -1281,8 +1559,8 @@ class Engine:
             # ships shared-scale integer partials + bf16 scales
             cross = qz.quantized_psum_wire_nbytes(m, ps.size // inner)
             self._account_wire(total * itemsize, total * itemsize,
-                               cross=cross if spans else 0)
-            self.quantized_bucket_runs += 1
+                               cross=cross if spans else 0, wire=wire)
+            self._m_quantized.inc()
             return ps.executor.allreduce_2d(
                 rows, op, req.prescale_factor, req.postscale_factor,
                 inner, wire="int8")
@@ -1350,7 +1628,7 @@ class Engine:
         contribution instead of per-tensor max rows, and a stream of
         small gathers (sparse embedding rows) costs one program
         dispatch instead of one each."""
-        self.fused_allgather_runs += 1
+        self._m_fused_ag.inc()
         R = ps.size
         tables = []     # (entry, subs, n_tensors, rest_shapes, dim0s)
         for entry in bucket:
@@ -1505,7 +1783,7 @@ class Engine:
                     wdt = np.dtype(np.float16) if wire == "fp16" \
                         else _bfloat16_dtype()
                     self._account_wire(rows[0].nbytes,
-                                       rows[0].size * 2)
+                                       rows[0].size * 2, wire=wire)
                     results = [
                         res.astype(dtype)
                         for res in ps.executor.reducescatter(
@@ -1552,6 +1830,11 @@ class Engine:
                 ev.set()
             self._lock.notify_all()
         self._shutdown_done.wait(timeout=30)
+        if self._metrics_pusher is not None:
+            # final snapshot so short jobs still land in the job-wide
+            # /metrics aggregation
+            self._metrics_pusher.stop()
+            self._metrics_pusher = None
         if self.autotuner is not None:
             self.autotuner.close()
 

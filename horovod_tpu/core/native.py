@@ -95,6 +95,10 @@ def get_lib():
                 lib.hvd_tl_event.argtypes = [
                     ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
                     ctypes.c_int64, ctypes.c_double]
+                if hasattr(lib, "hvd_tl_counter"):
+                    lib.hvd_tl_counter.argtypes = [
+                        ctypes.c_void_p, ctypes.c_char_p,
+                        ctypes.c_char_p, ctypes.c_double]
                 lib.hvd_tl_close.argtypes = [ctypes.c_void_p]
             _lib = lib
         except Exception as exc:  # noqa: BLE001 — fall back to numpy

@@ -214,12 +214,68 @@ def _ex_uid(ex):
     return uid
 
 
+def _cache_metrics():
+    """(hits, misses, compile_seconds) counter children for the
+    process-current registry, resolved once per registry — this sits
+    on the per-call hot path, so it must not re-take the registry
+    lock or rebuild help strings every step (registry.py's own design
+    note).  Cached ON the registry object: a fresh registry per
+    engine lifecycle gets fresh children automatically."""
+    from .. import telemetry
+
+    reg = telemetry.registry()
+    cached = getattr(reg, "_compiled_cache_metrics", None)
+    if cached is None:
+        cached = (
+            reg.counter("horovod_program_cache_hits_total",
+                        "Compiled-path program cache hits"),
+            reg.counter("horovod_program_cache_misses_total",
+                        "Compiled-path program cache misses "
+                        "(new builds)"),
+            reg.counter("horovod_compile_seconds_total",
+                        "Seconds spent building + first-compiling "
+                        "programs"),
+        )
+        reg._compiled_cache_metrics = cached
+    return cached
+
+
+class _TimedFirstCall:
+    """Wraps a fresh jitted program so its FIRST invocation — the one
+    that pays the XLA compile — lands in
+    ``horovod_compile_seconds_total``.  jax.jit is lazy, so timing the
+    builder alone would record microseconds of tracing setup and miss
+    the multi-second compile the metric exists to surface."""
+
+    __slots__ = ("_fn", "_timed")
+
+    def __init__(self, fn):
+        self._fn = fn
+        self._timed = False
+
+    def __call__(self, *args):
+        if self._timed:
+            return self._fn(*args)
+        import time as _time
+
+        t0 = _time.perf_counter()
+        try:
+            return self._fn(*args)
+        finally:
+            self._timed = True
+            _cache_metrics()[2].inc(_time.perf_counter() - t0)
+
+
 def _shared_program(key, builder):
+    hits, misses, _ = _cache_metrics()
     with _PROGRAM_LOCK:
         prog = _PROGRAM_CACHE.get(key)
         if prog is None:
-            prog = builder()
+            misses.inc()
+            prog = _TimedFirstCall(builder())
             _PROGRAM_CACHE[key] = prog
+        else:
+            hits.inc()
         return prog
 
 
@@ -668,6 +724,8 @@ class CompiledGroupedAllreduce:
                 entry = _shared_program(
                     key, lambda: self._build(ex, plan, hint))
                 self._programs[(sig, hkey)] = entry
+            else:
+                _cache_metrics()[0].inc()
             return entry
 
     # -- host packing --------------------------------------------------------
@@ -1134,7 +1192,13 @@ class _CompiledTrainStep:
                     self._prog = _shared_program(
                         key, lambda: self._build(ex))
                 else:
-                    self._prog = self._build(ex)
+                    # untagged (single-rank) steps skip the shared
+                    # cache but still report cache traffic + compile
+                    # time to the registry (bench.py reads these)
+                    _cache_metrics()[1].inc()
+                    self._prog = _TimedFirstCall(self._build(ex))
+            else:
+                _cache_metrics()[0].inc()
             return self._prog
 
     def _step_tag(self, ps, rank):

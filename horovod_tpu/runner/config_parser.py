@@ -13,6 +13,8 @@ HOROVOD_TIMELINE = "HOROVOD_TIMELINE"
 HOROVOD_TIMELINE_MARK_CYCLES = "HOROVOD_TIMELINE_MARK_CYCLES"
 HOROVOD_AUTOTUNE = "HOROVOD_AUTOTUNE"
 HOROVOD_AUTOTUNE_LOG = "HOROVOD_AUTOTUNE_LOG"
+HOROVOD_METRICS_PORT = "HOROVOD_METRICS_PORT"
+HOROVOD_METRICS_PUSH_SECONDS = "HOROVOD_METRICS_PUSH_SECONDS"
 HOROVOD_STALL_CHECK_DISABLE = "HOROVOD_STALL_CHECK_DISABLE"
 HOROVOD_STALL_CHECK_TIME_SECONDS = "HOROVOD_STALL_CHECK_TIME_SECONDS"
 HOROVOD_STALL_SHUTDOWN_TIME_SECONDS = "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"
@@ -58,6 +60,11 @@ def set_env_from_args(env: dict, args) -> dict:
         # capacity 0 disables the coordinator response cache entirely
         # (reference --disable-cache -> HOROVOD_CACHE_CAPACITY=0)
         env[HOROVOD_CACHE_CAPACITY] = "0"
+    if getattr(args, "metrics_port", None) is not None:
+        env[HOROVOD_METRICS_PORT] = str(args.metrics_port)
+    if getattr(args, "metrics_push_seconds", None) is not None:
+        env[HOROVOD_METRICS_PUSH_SECONDS] = str(
+            args.metrics_push_seconds)
     setb(HOROVOD_STALL_CHECK_DISABLE,
          getattr(args, "no_stall_check", False))
     if getattr(args, "stall_check_warning_time_seconds", None) is not None:

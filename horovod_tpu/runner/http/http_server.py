@@ -22,12 +22,16 @@ job secret).
 import hashlib
 import hmac
 import json
+import logging
 import os
 import threading
+import time
 import socket
 import socketserver
 from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler
+
+logger = logging.getLogger("horovod_tpu")
 
 OK = 200
 BAD_REQUEST = 400
@@ -43,9 +47,12 @@ CACHEABLE_TYPES = ("ALLREDUCE", "ADASUM")
 
 
 def autotune_kwargs(env=None):
-    """RendezvousServer autotune settings from a ``HOROVOD_*`` env
+    """RendezvousServer coordinator settings from a ``HOROVOD_*`` env
     mapping (default: os.environ) — shared by every launcher that
-    hosts a coordinator (static, elastic, spark, ray)."""
+    hosts a coordinator (static, elastic, spark, ray).  Besides the
+    autotune knobs this carries the stall-inspector warning time, so
+    the coordinator's global stall attribution fires on the same
+    clock as the workers' local inspectors."""
     env = os.environ if env is None else env
     on = str(env.get("HOROVOD_AUTOTUNE", "")).strip().lower() \
         in ("1", "true", "yes", "on")
@@ -58,6 +65,16 @@ def autotune_kwargs(env=None):
     if cap is not None and str(cap).strip() != "":
         # 0 = response cache disabled (--disable-cache)
         kwargs["cache_capacity"] = int(cap)
+    disabled = str(env.get("HOROVOD_STALL_CHECK_DISABLE", "")) \
+        .strip().lower() in ("1", "true", "yes", "on")
+    if disabled:
+        kwargs["stall_warning_secs"] = 0.0
+    else:
+        try:
+            kwargs["stall_warning_secs"] = float(
+                env.get("HOROVOD_STALL_CHECK_TIME_SECONDS") or 60.0)
+        except ValueError:
+            kwargs["stall_warning_secs"] = 60.0
     return kwargs
 
 
@@ -99,15 +116,60 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(OK)
 
     def do_GET(self):
+        path, _, query = self.path.partition("?")
+        if path in ("/metrics", "/metrics.json"):
+            # job-wide exposition: merge the snapshots workers push
+            # over the KV fabric.  Deliberately UNAUTHENTICATED —
+            # Prometheus scrapers cannot HMAC-sign, and the payload is
+            # read-only operational metadata (docs/observability.md).
+            return self._serve_job_metrics(path)
         if not self._verify(b""):
             return self._reply(FORBIDDEN)
-        path, _, query = self.path.partition("?")
         params = dict(p.split("=", 1) for p in query.split("&") if "=" in p)
         wait = float(params.get("wait", 0))
         value = self.store.get(path, timeout=wait)
         if value is None:
             return self._reply(NOT_FOUND)
         self._reply(OK, value)
+
+    def _serve_job_metrics(self, path):
+        """One scrape covers the whole job: counters sum across
+        workers, gauges expose per-worker max/min (an ``agg`` label),
+        histograms merge bucket-wise (telemetry.merge_snapshots).
+        Only pushed worker snapshots participate — the launcher
+        process's own registry may belong to an unrelated embedding
+        application (spark/ray drivers)."""
+        from ...telemetry import (
+            CONTENT_TYPE_LATEST, TELEMETRY_KV_PREFIX, merge_snapshots,
+            render_json, render_prometheus,
+        )
+
+        coord = self.server.coordinator
+        snaps = []
+        for key, raw in sorted(
+                self.store.scope(TELEMETRY_KV_PREFIX).items()):
+            try:
+                payload = json.loads(raw)
+                # stale pushes must not haunt the aggregate: a worker
+                # that left in an elastic downsize (proc id beyond the
+                # current world) or pushed during a previous round
+                # keeps its final snapshot in the KV store forever
+                proc = payload.get("proc")
+                rnd = payload.get("round")
+                if rnd is not None and rnd != coord.round_id:
+                    continue
+                if proc is not None and 0 < coord.world_size <= proc:
+                    continue
+                snaps.append(payload.get("families", {}))
+            except (ValueError, AttributeError):
+                continue    # half-written/foreign value: skip, not 500
+        merged = merge_snapshots(snaps)
+        if path == "/metrics.json":
+            self._reply(OK, render_json(merged).encode(),
+                        "application/json")
+        else:
+            self._reply(OK, render_prometheus(merged).encode(),
+                        CONTENT_TYPE_LATEST)
 
     def do_DELETE(self):
         if not self._verify(b""):
@@ -191,11 +253,18 @@ class Coordinator:
     def __init__(self, world_size: int,
                  fusion_threshold_bytes: int = 128 * 1024 * 1024,
                  cache_capacity: int = 1024, autotune: bool = False,
-                 autotune_log: str = None, cycle_time_ms: float = 1.0):
+                 autotune_log: str = None, cycle_time_ms: float = 1.0,
+                 stall_warning_secs: float = 60.0):
         self.world_size = world_size
         self.fusion_threshold = fusion_threshold_bytes
         self.cache_capacity = cache_capacity
         self.round_id = 0
+        # coordinator-side stall inspector (reference
+        # stall_inspector.cc relocated with the coordinator): an entry
+        # pending past this age gets a ``stall`` response naming the
+        # GLOBAL ranks of the processes that never reported it.
+        # 0 disables (HOROVOD_STALL_CHECK_DISABLE).
+        self.stall_warning_secs = stall_warning_secs
         # Coordinator-side autotune (reference: the coordinator tunes
         # and SynchronizeParameters broadcasts, controller.cc:40-54):
         # fusion threshold is applied directly here — fusing IS this
@@ -242,6 +311,8 @@ class Coordinator:
         self._proc_sid = {}     # proc -> controller session id
         self._session_base = {}  # proc -> log index its session starts at
         self._errors = {}       # key -> error string
+        self._pending_since = {}     # key -> first-report monotonic
+        self._stall_warned_keys = set()  # once-per-stall dedup
         self._cache = OrderedDict()  # cache_id -> meta template (LRU)
         self._cache_by_key = {}      # key -> cache_id
         self._next_cache_id = 0
@@ -276,6 +347,8 @@ class Coordinator:
             self._proc_sid.clear()
             self._session_base.clear()
             self._errors.clear()
+            self._pending_since.clear()
+            self._stall_warned_keys.clear()
             self._cache.clear()
             self._cache_by_key.clear()
             self._lock.notify_all()
@@ -362,6 +435,7 @@ class Coordinator:
                 ent = self._pending.get(key)
                 if ent is None:
                     ent = self._pending[key] = {}
+                    self._pending_since[key] = time.monotonic()
                 if proc not in ent:
                     ent[proc] = meta
                     if meta.get("error"):
@@ -462,6 +536,11 @@ class Coordinator:
             if len(ent) >= self._members_for(ent):
                 meta = next(iter(ent.values()))
                 del self._pending[key]
+                self._pending_since.pop(key, None)
+                # completion re-arms the once-per-stall warning for a
+                # re-used tensor name (mirrors the worker-side
+                # _discard_stall_mark contract)
+                self._stall_warned_keys.discard(key)
                 if key in self._errors:
                     self._log.append({"kind": "error", "key": key,
                                       "message": self._errors.pop(key)})
@@ -582,19 +661,80 @@ class Coordinator:
         exhausted = self._exhausted.get(meta.get("ps", 0), set())
         return max(nprocs - len(exhausted), 1)
 
+    def _scan_stalls(self):
+        """Global stall attribution (reference stall_inspector.cc
+        CheckForStalledTensors, which runs on the coordinator rank and
+        names every missing rank): an entry some processes reported
+        past the warning age is attributed to the GLOBAL ranks of the
+        processes that never did (the ``members`` map each report
+        carries), logged here and appended to the response log as a
+        ``stall`` record — so every worker's warning (and exported
+        ``horovod_stall_warnings_total`` labels) names the same
+        ranks.  Once per stall; completion re-arms.  Must hold the
+        lock; cheap (the pending table holds in-flight entries only),
+        called from every poll."""
+        if self.stall_warning_secs <= 0 or not self._pending:
+            return
+        now = time.monotonic()
+        for key, ent in self._pending.items():
+            t0 = self._pending_since.get(key)
+            if t0 is None or now - t0 <= self.stall_warning_secs \
+                    or key in self._stall_warned_keys:
+                continue
+            self._stall_warned_keys.add(key)
+            meta = next(iter(ent.values()))
+            ps = meta.get("ps", 0)
+            members = meta.get("members") or {}
+            exhausted = self._exhausted.get(ps, set())
+            reported = set(ent.keys())
+            if members:
+                missing_procs = sorted(
+                    int(p) for p in members
+                    if int(p) not in reported
+                    and int(p) not in exhausted)
+            else:
+                # report lacked the members map: fall back to the
+                # world proc universe (exact for the global set)
+                missing_procs = sorted(
+                    set(range(self.world_size)) - reported - exhausted)
+            missing_ranks = sorted(
+                r for p in missing_procs
+                for r in members.get(str(p), []))
+            age = now - t0
+            # attribution granularity is the PROCESS: a process only
+            # reports once every local rank submitted, so the ranks
+            # named are "hosted by a non-reporting process" — the
+            # process's own local inspector narrows to the exact rank
+            logger.warning(
+                "One or more tensors were submitted to be reduced by "
+                "some ranks but not all: %s stalled for %.0fs "
+                "(non-reporting processes: %s, hosting global ranks: "
+                "%s)", key, age, missing_procs,
+                missing_ranks if members else "unknown")
+            self._log.append({
+                "kind": "stall", "key": key, "ps": ps,
+                "age": round(age, 1),
+                "missing_ranks": missing_ranks,
+                "missing_procs": missing_procs,
+            })
+            self._lock.notify_all()     # wake parked long-polls
+
     def _on_poll(self, req):
         """Long-poll for responses after cursor (absolute)."""
         cursor = req["cursor"]
         round_at_entry = req.get("round", self.round_id)
         timeout = req.get("wait", 10.0)
         proc = req.get("proc")
-        import time
         deadline = time.monotonic() + timeout
         with self._lock:
             if self.round_id != round_at_entry:
                 # a reset raced us past handle()'s unlocked check:
                 # don't let a stale cursor poison the new round's GC
                 return {"stale": True, "round": self.round_id}
+            # polls arrive every worker cycle, so they are the stall
+            # inspector's clock (the coordinator has no thread of its
+            # own)
+            self._scan_stalls()
             if proc is not None:
                 # a re-sessioned controller polls from cursor 0; its
                 # session starts at the log position recorded when the
@@ -665,13 +805,15 @@ class RendezvousServer:
     def __init__(self, secret: bytes = None, world_size: int = 0,
                  fusion_threshold_bytes: int = 128 * 1024 * 1024,
                  cache_capacity: int = 1024, autotune: bool = False,
-                 autotune_log: str = None, cycle_time_ms: float = 1.0):
+                 autotune_log: str = None, cycle_time_ms: float = 1.0,
+                 stall_warning_secs: float = 60.0):
         self.store = KVStore()
         self.coordinator = Coordinator(world_size, fusion_threshold_bytes,
                                        cache_capacity=cache_capacity,
                                        autotune=autotune,
                                        autotune_log=autotune_log,
-                                       cycle_time_ms=cycle_time_ms)
+                                       cycle_time_ms=cycle_time_ms,
+                                       stall_warning_secs=stall_warning_secs)
         self.secret = secret
         self._httpd = None
         self._thread = None

@@ -79,6 +79,19 @@ def parse_args(argv=None):
     # timeline
     parser.add_argument("--timeline-filename", default=None)
     parser.add_argument("--timeline-mark-cycles", action="store_true")
+    # telemetry (docs/observability.md)
+    parser.add_argument("--metrics-port", type=int, default=None,
+                        help="base port for per-worker Prometheus "
+                             "/metrics endpoints (worker i binds "
+                             "port+i on its host); also enables the "
+                             "job-wide /metrics on the launcher's "
+                             "rendezvous service "
+                             "(HOROVOD_METRICS_PORT)")
+    parser.add_argument("--metrics-push-seconds", type=float,
+                        default=None,
+                        help="cadence of worker snapshot pushes into "
+                             "the job-wide aggregation "
+                             "(HOROVOD_METRICS_PUSH_SECONDS)")
     # autotune
     parser.add_argument("--autotune", action="store_true")
     parser.add_argument("--autotune-log-file", default=None)

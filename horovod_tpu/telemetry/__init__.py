@@ -1,0 +1,52 @@
+"""Job-wide observability: one metric registry, Prometheus/JSON
+exposition, coordinator-side aggregation.
+
+The TPU-native analogue of the reference's scattered introspection
+hooks (timeline, stall inspector logs, autotune CSV) pulled into one
+subsystem, as the Horovod paper's own postmortem recommends
+(arXiv:1802.05799 — the timeline found the problems fusion and
+autotuning fixed; a production system wants those signals exported,
+not buried in per-process logs):
+
+* :mod:`.registry` — counters / gauges / bounded-bucket histograms in
+  labeled families, cheap enough to update from the engine dispatch
+  loop;
+* :mod:`.exporter` — Prometheus text-format v0.0.4 + JSON snapshots,
+  per-worker HTTP endpoint (``HOROVOD_METRICS_PORT``), worker→
+  coordinator snapshot push over the launcher's KV fabric;
+* job-wide aggregation (counters sum, gauges per-worker max/min,
+  histograms merge) served from the coordinator's ``/metrics``
+  (runner/http/http_server.py).
+
+User surface: ``hvd.metrics()`` (snapshot dict),
+``hvd.start_metrics_server()`` — exported by every frontend.  See
+docs/observability.md for the family catalogue.
+"""
+
+from .registry import (  # noqa: F401
+    MetricRegistry, registry, install_registry, fresh_registry,
+    merge_snapshots, DEFAULT_LATENCY_BUCKETS,
+)
+from .exporter import (  # noqa: F401
+    render_prometheus, render_json, MetricsServer,
+    start_metrics_server, MetricsPusher, TELEMETRY_KV_PREFIX,
+    CONTENT_TYPE_LATEST,
+)
+
+
+def metrics():
+    """Snapshot of the process-current registry (JSON-able dict keyed
+    by family name) — the programmatic twin of ``GET /metrics.json``."""
+    return registry().snapshot()
+
+
+def counter_total(name, **labels):
+    """Convenience: current value of a counter/gauge family summed
+    over children (or one child when ``labels`` are given).  Benchmarks
+    read deltas of these instead of reaching into engine attributes."""
+    fam = registry().get(name)
+    if fam is None:
+        return 0.0
+    if labels:
+        return fam.value(**labels)
+    return fam.total()

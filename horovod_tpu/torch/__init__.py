@@ -15,6 +15,7 @@ from ..common.basics import (  # noqa: F401
     ccl_built, cuda_built, rocm_built, xla_built, tpu_built,
     mpi_enabled, gloo_enabled,
     start_timeline, stop_timeline,
+    metrics, start_metrics_server,
 )
 from ..common.exceptions import (  # noqa: F401
     HorovodInternalError, HostsUpdatedInterrupt,

@@ -130,6 +130,28 @@ class Timeline:
             # own test asserts the exact string)
             self._emit("CYCLE_START", "i", 0, self._ts())
 
+    def counter(self, name, values):
+        """Chrome counter ("C") event: ``values`` is a {series: number}
+        dict rendered as a stacked area track in the trace viewer.  The
+        engine mirrors its queue-depth and wire-byte gauges here every
+        work cycle, so traces and /metrics tell one story
+        (docs/timeline.md).  Safe from any thread; numbers only."""
+        ts = self._ts()
+        with self._emit_lock:
+            if self._native is not None:
+                lib, handle = self._native
+                if not hasattr(lib, "hvd_tl_counter"):
+                    return      # stale native build: degrade silently
+                args_json = json.dumps(
+                    {str(k): float(v) for k, v in values.items()})
+                lib.hvd_tl_counter(handle, name.encode(),
+                                   args_json.encode(), float(ts))
+            elif self._q is not None:
+                self._q.put({"name": name, "ph": "C", "pid": 0,
+                             "tid": 0, "ts": ts,
+                             "args": {str(k): float(v)
+                                      for k, v in values.items()}})
+
     def span(self, tensor_name, op_name):
         """Self-contained B/E pair on the tensor's own lane — safe
         from ANY thread (no shared open-op stack, no negotiate

@@ -92,6 +92,155 @@ def test_async_data_loader():
     assert list(sync) == [i * i for i in range(5)]
 
 
+def test_stall_warning_names_ranks_and_rewarns(hvd_shutdown,
+                                               monkeypatch, caplog):
+    """Warning path of the stall inspector: the log names the missing
+    GLOBAL rank ids, fires once per stall (dedup across cycles), and
+    fires AGAIN when the same tensor name stalls a second time."""
+    import logging
+    import threading
+
+    monkeypatch.setenv("HOROVOD_STALL_CHECK_TIME_SECONDS", "0.25")
+    release = [threading.Event(), threading.Event()]
+
+    def fn():
+        for phase in range(2):
+            if hvd.rank() == 0:
+                # rank 0 holds back past the warning time, twice
+                release[phase].wait(timeout=10)
+            # same name on BOTH phases on purpose: the re-warn
+            # contract is about re-used tensor names
+            hvd.allreduce(np.ones(4, np.float32), name="stallw")
+        return True
+
+    def warnings():
+        return [r for r in caplog.records
+                if "stallw" in r.getMessage()
+                and "stalled" in r.getMessage()]
+
+    with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+        t = threading.Thread(
+            target=lambda: hvd.run(fn, np=2, keep_alive=True),
+            daemon=True)
+        t.start()
+        deadline = time.monotonic() + 10
+        while not warnings() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        first = warnings()
+        assert first, "no stall warning before the deadline"
+        msg = first[0].getMessage()
+        # global attribution: rank 0 (a global rank id) is named
+        assert "missing ranks: [0]" in msg, msg
+        # once-per-stall dedup: the stall persists across many engine
+        # cycles but warns exactly once
+        time.sleep(0.5)
+        assert len(warnings()) == 1, [r.getMessage()
+                                      for r in warnings()]
+        release[0].set()            # phase 1 completes
+        # phase 2: the SAME tensor name stalls again -> second warning
+        deadline = time.monotonic() + 10
+        while len(warnings()) < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert len(warnings()) == 2, \
+            "re-used tensor name did not re-warn on its second stall"
+        release[1].set()
+        t.join(timeout=10)
+        assert not t.is_alive()
+    # exported labels name the ranks too
+    from horovod_tpu import telemetry
+    assert telemetry.counter_total("horovod_stall_warnings_total",
+                                   ranks="0") >= 2
+
+
+def test_stall_mark_cleared_at_awaiting_completion_sites(hvd_shutdown):
+    """Satellite fix for the _stall_warned leak: entries completing
+    from ``awaiting`` (coordinator batch/error responses) must clear
+    their warning mark, or a re-used name that stalls again warns only
+    once per process lifetime."""
+    from horovod_tpu.common import basics
+    from horovod_tpu.core.engine import NegotiationEntry
+
+    hvd.init(num_ranks=1)
+    eng = basics.engine()
+    ps = eng.get_process_set(0)
+    key = "ALLREDUCE|leak|ps0"
+    with eng._lock:
+        ps.awaiting[key] = NegotiationEntry(key)
+        eng._stall_warned.add((0, key))
+    # completion through the coordinator-error path
+    eng._apply_response({"kind": "error", "key": key, "message": "x"})
+    assert (0, key) not in eng._stall_warned
+    assert key not in ps.awaiting
+
+
+def test_engine_applies_coordinator_stall_response(hvd_shutdown,
+                                                   caplog):
+    """A coordinator ``stall`` record warns once with the GLOBAL rank
+    attribution and feeds the labeled stall-warning counter."""
+    import logging
+
+    from horovod_tpu import telemetry
+    from horovod_tpu.common import basics
+
+    hvd.init(num_ranks=1)
+    eng = basics.engine()
+    resp = {"kind": "stall", "key": "ALLREDUCE|g|ps0", "ps": 0,
+            "age": 61.0, "missing_ranks": [3, 5],
+            "missing_procs": [1]}
+    with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+        eng._apply_response(resp)
+        eng._apply_response(resp)       # duplicate: deduped
+    msgs = [r.getMessage() for r in caplog.records
+            if "missing global ranks" in r.getMessage()]
+    assert len(msgs) == 1, msgs
+    assert "[3, 5]" in msgs[0]
+    assert telemetry.counter_total("horovod_stall_warnings_total",
+                                   ranks="3,5") == 1
+
+
+def test_coordinator_stall_attribution_two_procs():
+    """Coordinator-side global stall attribution at 2 processes: the
+    stall record names the global ranks of the process that never
+    reported, once per stall, re-armed by completion."""
+    from horovod_tpu.runner.http.http_server import Coordinator
+
+    c = Coordinator(world_size=2, stall_warning_secs=0.1)
+
+    def meta(key):
+        return dict(key=key, type="ALLREDUCE", dtype="float32",
+                    shape=[4], op=1, pre=1.0, post=1.0, ps=0,
+                    nbytes=64, nprocs=2, nranks=4, root=-1,
+                    members={"0": [0, 1], "1": [2, 3]}, aux={})
+
+    c.handle("ready", {"proc": 0, "nlocal": 2,
+                       "entries": [meta("s")]})
+    time.sleep(0.15)
+    out = c.handle("poll", {"cursor": 0, "wait": 0, "proc": 0})
+    stalls = [r for r in out["responses"] if r["kind"] == "stall"]
+    assert len(stalls) == 1
+    assert stalls[0]["key"] == "s"
+    assert stalls[0]["missing_ranks"] == [2, 3]     # global ranks
+    assert stalls[0]["missing_procs"] == [1]
+    # dedup while the same stall persists
+    time.sleep(0.15)
+    out = c.handle("poll", {"cursor": out["cursor"], "wait": 0,
+                            "proc": 0})
+    assert not [r for r in out["responses"] if r["kind"] == "stall"]
+    # completion (proc 1 reports) re-arms; a second stall of the same
+    # name warns again
+    c.handle("ready", {"proc": 1, "nlocal": 2, "entries": [meta("s")],
+                       "rid": 1})
+    out = c.handle("poll", {"cursor": 0, "wait": 0, "proc": 0})
+    assert [r for r in out["responses"] if r["kind"] == "batch"]
+    c.handle("ready", {"proc": 0, "nlocal": 2, "entries": [meta("s")],
+                       "rid": 2})
+    time.sleep(0.15)
+    out = c.handle("poll", {"cursor": out["cursor"], "wait": 0,
+                            "proc": 0})
+    stalls = [r for r in out["responses"] if r["kind"] == "stall"]
+    assert len(stalls) == 1, "completion did not re-arm the stall mark"
+
+
 def test_stall_inspector_errors_out(hvd_shutdown, monkeypatch):
     monkeypatch.setenv("HOROVOD_STALL_CHECK_TIME_SECONDS", "0.2")
     monkeypatch.setenv("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", "0.5")
@@ -110,6 +259,42 @@ def test_stall_inspector_errors_out(hvd_shutdown, monkeypatch):
     out = hvd.run(fn, np=3)
     assert out[0] == "skipped"
     assert out[1] == out[2] == "stalled"
+
+
+def test_log_level_env_honored_in_workers(hvd_shutdown, monkeypatch):
+    """The runner exports HOROVOD_LOG_LEVEL / HOROVOD_LOG_HIDE_TIME
+    (runner/config_parser.py); init() must configure the horovod_tpu
+    logger from them, like the reference's logging.cc."""
+    import logging
+
+    monkeypatch.setenv("HOROVOD_LOG_LEVEL", "DEBUG")
+    monkeypatch.setenv("HOROVOD_LOG_HIDE_TIME", "1")
+    hvd.init(num_ranks=1)
+    logger = logging.getLogger("horovod_tpu")
+    assert logger.level == logging.DEBUG
+    handlers = [h for h in logger.handlers
+                if getattr(h, "_hvd_env_handler", False)]
+    assert len(handlers) == 1
+    assert "asctime" not in handlers[0].formatter._fmt
+    # the logger owns its output now — no double-printing through the
+    # host app's root handlers
+    assert logger.propagate is False
+    hvd.shutdown()
+
+    # re-init with time shown: same handler, new format (idempotent)
+    monkeypatch.setenv("HOROVOD_LOG_LEVEL", "ERROR")
+    monkeypatch.delenv("HOROVOD_LOG_HIDE_TIME")
+    hvd.init(num_ranks=1)
+    assert logger.level == logging.ERROR
+    handlers2 = [h for h in logger.handlers
+                 if getattr(h, "_hvd_env_handler", False)]
+    assert handlers2 == handlers        # no handler pile-up
+    assert "asctime" in handlers[0].formatter._fmt
+    # restore library defaults so later tests' caplog behavior is
+    # unchanged
+    logger.removeHandler(handlers[0])
+    logger.setLevel(logging.NOTSET)
+    logger.propagate = True
 
 
 def test_dynamic_process_sets(hvd_shutdown):

@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""CI metrics smoke (ci.sh `metrics` step; also wrapped by
+tests/test_telemetry.py::test_two_process_job_wide_metrics): launch a
+REAL 2-process job with telemetry enabled, have each worker scrape its
+own /metrics endpoint, have rank 0 scrape the launcher's job-wide
+/metrics, and assert the required families parse as valid Prometheus
+text-format v0.0.4.
+
+Driver mode (no args): picks a free base port, launches 2 workers.
+Worker mode (MS_WORKER=1): runs collectives, pushes a snapshot,
+scrapes, validates.
+"""
+
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+REQUIRED = (
+    "horovod_wire_actual_bytes_total",       # wire bytes
+    "horovod_wire_logical_bytes_total",
+    "horovod_negotiation_seconds",           # negotiation latency
+    "horovod_pending_entries",               # queue depth
+    "horovod_program_cache_hits_total",      # compiled-path cache
+    "horovod_stalled_tensors",               # stall gauge
+    "horovod_world_size",
+)
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
+    r' (-?[0-9.e+-]+|\+Inf|-Inf|NaN)$')
+
+
+def parse_prometheus(text):
+    """Minimal text-format validator; returns {family: n_samples}."""
+    families = {}
+    typed = set()
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            assert len(parts) == 4 and parts[3] in (
+                "counter", "gauge", "histogram", "untyped"), line
+            typed.add(parts[2])
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        base = re.sub(r"_(bucket|sum|count)$", "", m.group(1))
+        assert base in typed or m.group(1) in typed, \
+            f"sample before its TYPE line: {line!r}"
+        families[base] = families.get(base, 0) + 1
+    return families
+
+
+def _scrape(url):
+    import urllib.request
+    return urllib.request.urlopen(url, timeout=20).read().decode()
+
+
+def worker():
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics
+
+    hvd.init()
+    r = hvd.rank()
+    for i in range(4):
+        hvd.allreduce(np.ones(2048, np.float32), name=f"ms.{i % 2}")
+    hvd.allreduce(np.ones(4096, np.float32), name="ms.q",
+                  wire_dtype="int8")
+
+    # per-worker endpoint: base port + proc index (docs/observability)
+    base = int(os.environ["HOROVOD_METRICS_PORT"])
+    proc = int(os.environ.get("HOROVOD_TPU_PROC_INDEX", "0"))
+    mine = parse_prometheus(
+        _scrape(f"http://127.0.0.1:{base + proc}/metrics"))
+    for fam in REQUIRED:
+        assert fam in mine, f"worker {r}: missing family {fam}"
+
+    # make sure both workers' snapshots are in the KV store before
+    # anyone reads the job-wide view
+    basics.engine().push_metrics()
+    hvd.barrier()
+
+    if r == 0:
+        addr = os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"]
+        port = os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"]
+        text = _scrape(f"http://{addr}:{port}/metrics")
+        fams = parse_prometheus(text)
+        for fam in REQUIRED:
+            assert fam in fams, f"job-wide: missing family {fam}"
+        # counters summed across both workers: each worker moved
+        # > 2 MiB of f32 payload, so the job total must exceed one
+        # worker's contribution
+        m = re.search(
+            r'^horovod_wire_logical_bytes_total\{wire="f32"\} (\d+)',
+            text, re.M)
+        assert m, "no f32 logical-byte sample in job-wide scrape"
+        per_worker = 4 * 2048 * 4
+        assert int(m.group(1)) >= 2 * per_worker, m.group(0)
+        # gauges arrive with per-worker max/min attribution
+        assert 'horovod_pending_entries{agg="max"' in text
+        print("job-wide scrape OK:", len(fams), "families")
+    hvd.barrier()
+    hvd.shutdown()
+    print(f"worker {r} OK")
+
+
+def main():
+    if os.environ.get("MS_WORKER"):
+        worker()
+        return
+    from horovod_tpu.runner.http.http_server import free_port
+    from horovod_tpu.runner.proc_run import launch_procs
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    codes = launch_procs(
+        [sys.executable, os.path.abspath(__file__)], np=2,
+        platform="cpu",
+        env={"PYTHONPATH": repo, "MS_WORKER": "1",
+             "HOROVOD_METRICS_PORT": str(free_port()),
+             "HOROVOD_METRICS_PUSH_SECONDS": "1"},
+        start_timeout=240)
+    assert codes == [0, 0], f"worker exit codes {codes}"
+    print("METRICS SMOKE OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -10,6 +10,8 @@
 #   ./ci.sh integration   # tier 3: multi-process launches + elastic
 #   ./ci.sh metrics       # smoke: 2-process job, scrape job-wide
 #                         #   /metrics, validate Prometheus families
+#   ./ci.sh trace         # smoke: 2-process job, merged GET /timeline
+#                         #   + trace_merge CLI + stall auto-dump
 #   ./ci.sh bench         # smoke: one bench.py run (real chip if any)
 #   ./ci.sh all           # tiers 1-3 (what the round judge re-runs,
 #                         #   split in four parts to stay under per-
@@ -32,7 +34,7 @@ PART1="tests/test_autotune.py tests/test_aux.py tests/test_basics.py \
   tests/test_conv_bn_fusion.py tests/test_integrations.py \
   tests/test_jax_frontend.py tests/test_lightning.py \
   tests/test_models.py tests/test_mxnet_fake.py tests/test_native.py \
-  tests/test_telemetry.py"
+  tests/test_telemetry.py tests/test_tracing.py"
 PART2="tests/test_elastic.py tests/test_examples.py \
   tests/test_op_matrix.py \
   tests/test_ray_strategy.py tests/test_spark_streaming.py \
@@ -61,6 +63,14 @@ case "${1:-all}" in
     # test/integration + examples-in-CI role)
     python -m pytest tests/test_runner.py tests/test_elastic.py \
       tests/test_examples.py -q -m integration
+    ;;
+  trace)
+    # job-wide tracing smoke: a REAL 2-process job — merged GET
+    # /timeline (>=2 pids, clock_sync, flow pairs), offline
+    # tools/trace_merge.py over the per-worker timeline files, and an
+    # induced stall auto-dumping the flight recorder with the
+    # straggler's lane attributable (docs/timeline.md)
+    python tools/trace_smoke.py
     ;;
   metrics)
     # telemetry smoke: a REAL 2-process job with --metrics-port wired
@@ -137,7 +147,7 @@ case "${1:-all}" in
     python -m pytest $PART4 -q
     ;;
   *)
-    echo "usage: $0 {fast|matrix|integration|metrics|bench|all}" >&2
+    echo "usage: $0 {fast|matrix|integration|trace|metrics|bench|all}" >&2
     exit 2
     ;;
 esac

@@ -7,6 +7,12 @@
 // one ctypes call on the engine thread; JSON formatting AND file IO
 // happen on the native writer thread.
 //
+// Job-wide tracing extensions: a per-writer pid (the worker's first
+// global rank — merged traces get one lane group per rank instead of
+// everything under pid 0), metadata records with JSON args
+// (process_name, clock_sync), and Chrome flow events ("s"/"f") tying
+// negotiation spans to execution spans across ranks.
+//
 // Build: csrc/Makefile -> horovod_tpu/_native/libhvdnative.so
 // Binding: ctypes (horovod_tpu/core/native.py), python fallback.
 
@@ -25,11 +31,14 @@ struct Event {
   char name[96];
   char ph[4];
   int64_t tid;
+  int64_t pid;
   double ts;
-  // pre-serialized JSON args for counter ("C") events; empty
-  // otherwise.  Python sends ready-made JSON so the writer thread
-  // stays a formatter, never a serializer.
-  char args[160];
+  // flow-event chain id ("s"/"f" phases); unused otherwise.
+  int64_t flow_id;
+  // pre-serialized JSON args for counter ("C") and metadata ("M")
+  // events; empty otherwise.  Python sends ready-made JSON so the
+  // writer thread stays a formatter, never a serializer.
+  char args[208];
 };
 
 struct Writer {
@@ -40,6 +49,7 @@ struct Writer {
   std::thread thread;
   bool closing = false;
   bool first = true;
+  int64_t pid = 0;
 
   void run() {
     std::vector<Event> batch;
@@ -53,36 +63,66 @@ struct Writer {
       for (const Event& e : batch) {
         if (!first) std::fputs(",\n", f);
         first = false;
+        long long pid = static_cast<long long>(e.pid);
+        long long tid = static_cast<long long>(e.tid);
         if (std::strcmp(e.ph, "M") == 0) {
-          std::fprintf(f,
-                       "{\"name\": \"thread_name\", \"ph\": \"M\", "
-                       "\"pid\": 0, \"tid\": %lld, \"args\": {\"name\": "
-                       "\"%s\"}}",
-                       static_cast<long long>(e.tid), e.name);
+          if (e.args[0]) {
+            // metadata with a ready-made args payload (process_name,
+            // clock_sync); e.name is the record name verbatim
+            std::fprintf(f,
+                         "{\"name\": \"%s\", \"ph\": \"M\", "
+                         "\"pid\": %lld, \"tid\": %lld, \"args\": %s}",
+                         e.name, pid, tid, e.args);
+          } else {
+            // legacy shape: a thread_name record for lane e.tid
+            std::fprintf(f,
+                         "{\"name\": \"thread_name\", \"ph\": \"M\", "
+                         "\"pid\": %lld, \"tid\": %lld, \"args\": "
+                         "{\"name\": \"%s\"}}",
+                         pid, tid, e.name);
+          }
         } else if (std::strcmp(e.ph, "C") == 0) {
           // counter event: args payload arrives pre-serialized
           std::fprintf(f,
-                       "{\"name\": \"%s\", \"ph\": \"C\", \"pid\": 0, "
+                       "{\"name\": \"%s\", \"ph\": \"C\", \"pid\": %lld, "
                        "\"tid\": %lld, \"ts\": %.3f, \"args\": %s}",
-                       e.name, static_cast<long long>(e.tid), e.ts,
+                       e.name, pid, tid, e.ts,
                        e.args[0] ? e.args : "{}");
+        } else if (std::strcmp(e.ph, "s") == 0 ||
+                   std::strcmp(e.ph, "f") == 0) {
+          // flow event; "f" binds to the enclosing slice (bp: e)
+          std::fprintf(f,
+                       "{\"name\": \"negotiation\", \"cat\": \"hvd\", "
+                       "\"ph\": \"%s\", \"id\": %lld, \"pid\": %lld, "
+                       "\"tid\": %lld, \"ts\": %.3f%s}",
+                       e.ph, static_cast<long long>(e.flow_id), pid,
+                       tid, e.ts,
+                       e.ph[0] == 'f' ? ", \"bp\": \"e\"" : "");
         } else if (std::strcmp(e.ph, "i") == 0) {
           // instant markers render full-height only with global scope
           std::fprintf(f,
                        "{\"name\": \"%s\", \"ph\": \"i\", \"s\": \"g\", "
-                       "\"pid\": 0, \"tid\": %lld, \"ts\": %.3f}",
-                       e.name, static_cast<long long>(e.tid), e.ts);
+                       "\"pid\": %lld, \"tid\": %lld, \"ts\": %.3f}",
+                       e.name, pid, tid, e.ts);
         } else {
           std::fprintf(f,
-                       "{\"name\": \"%s\", \"ph\": \"%s\", \"pid\": 0, "
-                       "\"tid\": %lld, \"ts\": %.3f}",
-                       e.name, e.ph, static_cast<long long>(e.tid),
-                       e.ts);
+                       "{\"name\": \"%s\", \"ph\": \"%s\", "
+                       "\"pid\": %lld, \"tid\": %lld, \"ts\": %.3f}",
+                       e.name, e.ph, pid, tid, e.ts);
         }
       }
       std::fflush(f);
       batch.clear();
     }
+  }
+
+  void enqueue(Event& e) {
+    {
+      std::lock_guard<std::mutex> lock(mu);
+      e.pid = pid;
+      queue.push_back(e);
+    }
+    cv.notify_one();
   }
 };
 
@@ -102,6 +142,14 @@ void* hvd_tl_open(const char* path) {
   return w;
 }
 
+// Per-writer pid stamped on every subsequent event (the worker's
+// first global rank; merged traces key lane groups on it).
+void hvd_tl_set_pid(void* handle, int64_t pid) {
+  Writer* w = static_cast<Writer*>(handle);
+  std::lock_guard<std::mutex> lock(w->mu);
+  w->pid = pid;
+}
+
 // name must not contain JSON-special characters (tensor names are
 // sanitized python-side); truncated to 95 chars.
 void hvd_tl_event(void* handle, const char* name, const char* ph,
@@ -112,17 +160,14 @@ void hvd_tl_event(void* handle, const char* name, const char* ph,
   std::snprintf(e.ph, sizeof(e.ph), "%s", ph);
   e.tid = tid;
   e.ts = ts_us;
+  e.flow_id = 0;
   e.args[0] = '\0';
-  {
-    std::lock_guard<std::mutex> lock(w->mu);
-    w->queue.push_back(e);
-  }
-  w->cv.notify_one();
+  w->enqueue(e);
 }
 
 // Counter ("C") event: args_json must be a complete JSON object
-// (python-side json.dumps of {series: number}); truncation at 159
-// chars would corrupt the trace, so oversized payloads are dropped.
+// (python-side json.dumps of {series: number}); truncation would
+// corrupt the trace, so oversized payloads are dropped.
 void hvd_tl_counter(void* handle, const char* name,
                     const char* args_json, double ts_us) {
   Writer* w = static_cast<Writer*>(handle);
@@ -132,12 +177,41 @@ void hvd_tl_counter(void* handle, const char* name,
   std::snprintf(e.ph, sizeof(e.ph), "C");
   e.tid = 0;
   e.ts = ts_us;
+  e.flow_id = 0;
   std::snprintf(e.args, sizeof(e.args), "%s", args_json);
-  {
-    std::lock_guard<std::mutex> lock(w->mu);
-    w->queue.push_back(e);
-  }
-  w->cv.notify_one();
+  w->enqueue(e);
+}
+
+// Metadata ("M") record with a JSON args payload (process_name,
+// clock_sync).  Same truncation contract as hvd_tl_counter.
+void hvd_tl_meta(void* handle, const char* name, const char* args_json,
+                 int64_t tid) {
+  Writer* w = static_cast<Writer*>(handle);
+  Event e;
+  if (std::strlen(args_json) >= sizeof(e.args)) return;
+  std::snprintf(e.name, sizeof(e.name), "%s", name);
+  std::snprintf(e.ph, sizeof(e.ph), "M");
+  e.tid = tid;
+  e.ts = 0.0;
+  e.flow_id = 0;
+  std::snprintf(e.args, sizeof(e.args), "%s", args_json);
+  w->enqueue(e);
+}
+
+// Chrome flow event: ph is "s" (start, at the rank's ready time) or
+// "f" (finish, bound to the enclosing execution slice); flow_id is
+// the coordinator-minted job-unique trace id.
+void hvd_tl_flow(void* handle, const char* ph, int64_t flow_id,
+                 int64_t tid, double ts_us) {
+  Writer* w = static_cast<Writer*>(handle);
+  Event e;
+  e.name[0] = '\0';
+  std::snprintf(e.ph, sizeof(e.ph), "%s", ph);
+  e.tid = tid;
+  e.ts = ts_us;
+  e.flow_id = flow_id;
+  e.args[0] = '\0';
+  w->enqueue(e);
 }
 
 void hvd_tl_close(void* handle) {

@@ -116,11 +116,25 @@ class RankContext:
         return f"{op_name}.noname.{n}"
 
 
-def _make_timeline(config):
+def _make_timeline(config, pid=0, num_ranks=1, proc_id=0):
+    """Per-process timeline.  With ``HOROVOD_TIMELINE`` it writes a
+    Chrome trace file; without one it still runs ring-only when the
+    flight recorder is enabled (``HOROVOD_TRACE_RING_EVENTS``, default
+    on) so stall warnings always have a last-N-events trace to dump.
+    ``pid`` is the process's first global rank — merged traces key one
+    lane group per rank on it (docs/timeline.md)."""
     from ..utils.timeline import Timeline
-    if config.timeline_filename:
-        return Timeline(config.timeline_filename, config.timeline_mark_cycles)
-    return None
+    if not (config.timeline_filename or config.trace_ring_events > 0):
+        return None
+    if num_ranks > 1:
+        pname = (f"ranks {pid}-{pid + num_ranks - 1} "
+                 f"(proc {proc_id})")
+    else:
+        pname = f"rank {pid}"
+    return Timeline(config.timeline_filename,
+                    config.timeline_mark_cycles,
+                    pid=pid, process_name=pname,
+                    ring_events=config.trace_ring_events)
 
 
 def _record_resize_event(new_size):
@@ -313,7 +327,9 @@ def init(comm=None, process_sets=None, num_ranks=None, devices=None):
         if config.timeline_filename and rank_offset != 0:
             root, ext = os.path.splitext(config.timeline_filename)
             config.timeline_filename = f"{root}.proc{proc_id}{ext}"
-        _timeline = _make_timeline(config)
+        _timeline = _make_timeline(config, pid=rank_offset,
+                                   num_ranks=num_ranks,
+                                   proc_id=proc_index)
         _engine = Engine(num_ranks, devices, config=config,
                          topology=_topology, timeline=_timeline,
                          controller=controller, rank_offset=rank_offset,
@@ -576,25 +592,54 @@ def start_metrics_server(port=None):
 
 
 def start_timeline(filename, mark_cycles=False):
-    """Runtime timeline activation (reference operations.cc:1077)."""
+    """Runtime timeline activation (reference operations.cc:1077).
+    A ring-only flight-recorder timeline (no file) is upgraded in
+    place; an already-writing file timeline must be stopped first."""
     global _timeline
     with _state_lock:
         eng = engine()
-        if _timeline is not None:
+        if _timeline is not None and _timeline.filename:
             raise ValueError("timeline already active; stop it first")
         from ..utils.timeline import Timeline
-        _timeline = Timeline(filename, mark_cycles)
+        old, pid, pname = _timeline, eng.rank_offset, None
+        if old is not None:
+            pid, pname = old.pid, old.process_name
+        _timeline = Timeline(filename, mark_cycles, pid=pid,
+                             process_name=pname,
+                             ring_events=eng.config.trace_ring_events)
         eng.timeline = _timeline
+        # a job initialized with tracing fully off (ring disabled, no
+        # HOROVOD_TIMELINE) had no clock sync to start; the first
+        # runtime-activated timeline needs it for mergeable traces
+        eng._start_clock_sync()
+        if old is not None:
+            old.close()
 
 
 def stop_timeline():
+    """Stop writing the timeline file.  The flight recorder stays
+    live (a fresh ring-only timeline replaces the file writer) so
+    stall auto-dumps and ``hvd.dump_trace()`` keep working."""
     global _timeline
     with _state_lock:
         eng = engine()
-        if _timeline is not None:
-            _timeline.close()
-        _timeline = None
-        eng.timeline = None
+        old = _timeline
+        eng.config.timeline_filename = None
+        _timeline = _make_timeline(
+            eng.config, pid=eng.rank_offset, num_ranks=eng.num_local,
+            proc_id=eng.controller.proc_id if eng.multiproc else 0)
+        eng.timeline = _timeline
+        if old is not None:
+            old.close()
+
+
+def dump_trace(path=None):
+    """Dump the flight recorder's last-N-events ring NOW: pushes it to
+    the launcher over the KV fabric (multi-process — the buffers
+    ``GET /timeline`` merges) and writes a stand-alone Chrome trace to
+    ``path`` (or ``HOROVOD_TRACE_DUMP_DIR``) when given.  Returns the
+    file path written, or None (docs/timeline.md "Flight recorder")."""
+    return engine().dump_trace(path=path, reason="manual")
 
 
 # -- reference-shaped surface (horovod/common/basics.py:21-29) ---------------

@@ -52,6 +52,15 @@ HOROVOD_CPU_OPERATIONS = "HOROVOD_CPU_OPERATIONS"
 HOROVOD_METRICS_PORT = "HOROVOD_METRICS_PORT"
 HOROVOD_METRICS_PUSH_SECONDS = "HOROVOD_METRICS_PUSH_SECONDS"
 
+# job-wide tracing (docs/timeline.md "Job-wide traces"): the
+# flight-recorder ring size (events; 0 disables), the directory stall
+# auto-dumps and hvd.dump_trace() default into (unset = KV push only),
+# and the clock-sync re-sample cadence mapping each worker's timeline
+# epoch onto the launcher's clock (0 disables)
+HOROVOD_TRACE_RING_EVENTS = "HOROVOD_TRACE_RING_EVENTS"
+HOROVOD_TRACE_DUMP_DIR = "HOROVOD_TRACE_DUMP_DIR"
+HOROVOD_TRACE_CLOCK_SYNC_SECONDS = "HOROVOD_TRACE_CLOCK_SYNC_SECONDS"
+
 # TPU-native additions
 HOROVOD_WIRE_DTYPE = "HOROVOD_WIRE_DTYPE"      # f32 | fp16 | bf16 | int8
 # flat | hierarchical | torus (generic spelling; the reference's
@@ -214,6 +223,17 @@ class Config:
         self.metrics_push_secs = get_float(
             HOROVOD_METRICS_PUSH_SECONDS,
             2.0 if self.metrics_port else 0.0)
+        # flight recorder (docs/timeline.md): always-on bounded ring of
+        # recent timeline events, default on — the emit path is a dict
+        # + deque append, cheap enough for the dispatch loop; 0
+        # disables.  Stall warnings auto-dump it (engine.dump_trace).
+        self.trace_ring_events = get_int(HOROVOD_TRACE_RING_EVENTS, 4096)
+        self.trace_dump_dir = get_str(HOROVOD_TRACE_DUMP_DIR)
+        # NTP-style clock sync against the launcher's clock, re-sampled
+        # for drift; multi-process only (single-process traces carry a
+        # wall-clock mapping from birth)
+        self.clock_sync_secs = get_float(
+            HOROVOD_TRACE_CLOCK_SYNC_SECONDS, 30.0)
         # process-set removal is a barrier across local rank threads;
         # this bounds the wait for peers' votes and the drain of
         # in-flight collectives on the set

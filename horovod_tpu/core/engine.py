@@ -34,6 +34,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..common import env as env_mod
+from ..utils import profiler
 from ..common.exceptions import (
     DuplicateNameError,
     HorovodInternalError,
@@ -64,7 +65,7 @@ class NegotiationEntry:
     IncrementTensorCount)."""
 
     __slots__ = ("key", "subs", "first_time", "wire_default",
-                 "algo_default")
+                 "algo_default", "ready_ts", "trace_id")
 
     def __init__(self, key):
         self.key = key
@@ -77,6 +78,11 @@ class NegotiationEntry:
         self.wire_default = None
         # ditto for the reduction algorithm (config.algorithm)
         self.algo_default = None
+        # timeline-clock instant this entry became locally ready (the
+        # flow-event "s" anchor) and its job-unique trace id
+        # (coordinator-minted in store mode, engine-minted locally)
+        self.ready_ts = None
+        self.trace_id = None
 
 
 class ProcessSetState:
@@ -171,6 +177,13 @@ class Engine:
 
         self._stall_warned = set()
         self._algo_warned = set()
+        # local-mode trace ids (store mode uses coordinator-minted
+        # ones); offset by the rank window so per-process single-mode
+        # traces merged offline never collide
+        self._next_trace_id = self.rank_offset << 24
+        # local stall inspector's deferred flight-recorder dump reason
+        # (set under the lock, dumped outside it — the dump may do IO)
+        self._pending_trace_dump = None
         # one fresh registry per engine lifecycle (telemetry/registry):
         # every counter the benchmarks and the /metrics endpoints read
         # lives here; the legacy engine attributes (logical_wire_bytes,
@@ -182,6 +195,8 @@ class Engine:
         self._tl_queues_nonzero = False
         self._metrics_pusher = None
         self._start_metrics_push()
+        self._clock_sync = None
+        self._start_clock_sync()
         self._thread = threading.Thread(
             target=self._background_loop, name="horovod_tpu-engine",
             daemon=True)
@@ -265,6 +280,11 @@ class Engine:
             "attributed (locally-missing ranks, or every rank a "
             "non-reporting process hosts)",
             labelnames=("ranks",))
+        self._m_ring_dumps = m.counter(
+            "horovod_trace_ring_dumps_total",
+            "Flight-recorder ring dumps (stall auto-dumps, coordinator"
+            " requests, hvd.dump_trace)",
+            labelnames=("reason",))
         # families owned by other layers, pre-declared for the catalogue
         m.counter("horovod_program_cache_hits_total",
                   "Compiled-path program cache hits")
@@ -311,6 +331,74 @@ class Engine:
         periodic pusher's out-of-band hook — tests and short jobs)."""
         if self._metrics_pusher is not None:
             self._metrics_pusher.push_now()
+
+    # ------------------------------------------------------------------
+    # job-wide tracing (docs/timeline.md "Job-wide traces")
+
+    def _start_clock_sync(self):
+        """Multi-process jobs map this worker's timeline epoch onto
+        the launcher's clock (NTP midpoint over the coordinator's
+        ``clock`` verb, re-sampled for drift) so per-worker traces
+        merge onto one time axis.  Single-process timelines carry a
+        wall-clock mapping from birth — nothing to sync against.
+        Idempotent: also re-invoked when ``hvd.start_timeline()``
+        creates the first timeline after init."""
+        if self._clock_sync is not None:
+            return
+        if not self.multiproc or self.timeline is None:
+            return
+        secs = getattr(self.config, "clock_sync_secs", 0.0)
+        if secs <= 0:
+            return
+        from ..utils.clock_sync import ClockSync
+        # resolve the timeline at every sync round: start_timeline /
+        # stop_timeline may swap it at runtime
+        self._clock_sync = ClockSync(
+            lambda: self.timeline, self.controller.client,
+            interval=secs).start()
+
+    def dump_trace(self, path=None, reason="manual", dump_id=None):
+        """Dump the flight-recorder ring: push it over the KV fabric
+        (multi-process — feeds the launcher's ``GET /timeline``) and,
+        when ``path`` or ``HOROVOD_TRACE_DUMP_DIR`` names a
+        destination, write it as a stand-alone Chrome trace file.
+        Returns the file path written (or None).  Called by the stall
+        path automatically and by ``hvd.dump_trace()`` on demand."""
+        tl = self.timeline
+        if tl is None:
+            return None
+        events = tl.ring_dump()
+        self._m_ring_dumps.labels(reason=reason).inc()
+        proc = self.controller.proc_id if self.multiproc else 0
+        if self.multiproc:
+            from ..utils.trace_merge import TRACE_KV_PREFIX
+            import json as _json
+            payload = {"proc": proc, "pid": tl.pid,
+                       "dump_id": dump_id, "reason": reason,
+                       "round": self.controller.round_id,
+                       "events": events}
+            try:
+                self.controller.client.put(
+                    f"{TRACE_KV_PREFIX}{proc}",
+                    _json.dumps(payload).encode())
+            except Exception:  # noqa: BLE001 — the coordinator may be
+                # gone during teardown; tracing must never kill a worker
+                pass
+        if path is None and getattr(self.config, "trace_dump_dir", None):
+            import os as _os
+            _os.makedirs(self.config.trace_dump_dir, exist_ok=True)
+            path = _os.path.join(self.config.trace_dump_dir,
+                                 f"hvd_flight_p{proc}.json")
+        if path:
+            import json as _json
+            try:
+                with open(path, "w") as f:
+                    _json.dump(events, f)
+            except OSError as exc:
+                logger.warning("could not write flight-recorder dump "
+                               "%s: %s", path, exc)
+                return None
+        return path
 
     # -- deprecated counter shims: the pre-telemetry attribute surface.
     #    Benchmarks and tests historically read these off the engine;
@@ -705,6 +793,13 @@ class Engine:
                 # marker per negotiation cycle that produced work
                 # (HOROVOD_TIMELINE_MARK_CYCLES)
                 self.timeline.mark_cycle()
+            if self._pending_trace_dump is not None:
+                # local stall inspector requested a flight-recorder
+                # dump; it runs here, outside the lock (KV put / file
+                # IO must not block submitters)
+                reason, self._pending_trace_dump = \
+                    self._pending_trace_dump, None
+                self.dump_trace(reason=reason)
             if self.multiproc:
                 self._store_cycle(work)
             else:
@@ -772,6 +867,10 @@ class Engine:
                     del ps.pending[key]
                     if self.multiproc:
                         ps.awaiting[key] = entry
+                    if self.timeline is not None:
+                        # flow-event anchor: the instant this process
+                        # became ready (the straggler's lands last)
+                        entry.ready_ts = self.timeline._ts()
                     self._discard_stall_mark(ps.id, key)
                     self._m_negotiation.labels(
                         op=key.split("|", 1)[0]).observe(
@@ -859,6 +958,11 @@ class Engine:
                                 key, age)
                             self._m_stall_warn.labels(ranks="").inc()
                         self._stall_warned.add(wkey)
+                        # ship the warning with the trace that explains
+                        # it (multi-process stalls normally dump via
+                        # the coordinator's trace_dump broadcast; this
+                        # covers local-only and coordinator-dead cases)
+                        self._pending_trace_dump = "stall"
                     if (self.config.stall_shutdown_secs > 0
                             and age > self.config.stall_shutdown_secs):
                         del table[key]
@@ -993,6 +1097,7 @@ class Engine:
             keys = resp["keys"]
             aux = resp.get("aux", {})
             metas = resp.get("metas", {})
+            trace_ids = resp.get("trace", {})
             ps = self._ps_for_response(keys, metas)
             if ps is None or not ps.local_ranks:
                 # this process hosts no members of the set: the
@@ -1018,6 +1123,12 @@ class Engine:
                     if e is None:
                         bad_key = k
                         break
+                    tid = trace_ids.get(k)
+                    if tid is not None:
+                        # the coordinator-minted job-unique trace id:
+                        # every process stamps the same id on this
+                        # entry's flow events
+                        e.trace_id = tid
                     entries.append(e)
             if bad_key is not None:
                 # protocol violation: we cannot participate in this
@@ -1081,6 +1192,12 @@ class Engine:
                     resp.get("missing_procs", []))
                 self._m_stall_warn.labels(
                     ranks=self._stall_ranks_label(missing)).inc()
+        elif kind == "trace_dump":
+            # coordinator-requested flight-recorder dump (stall
+            # auto-dump, POST /trace/dump, GET /timeline): push the
+            # ring so the launcher can serve the merged job trace
+            self.dump_trace(reason=resp.get("reason", "request"),
+                            dump_id=resp.get("id"))
         elif kind == "join_done":
             with self._lock:
                 ps = self.process_sets.get(resp.get("ps", 0))
@@ -1137,6 +1254,12 @@ class Engine:
                 for sub in entry.subs.values():
                     sub.handle.set_error(err)
                 continue
+            if entry.trace_id is None:
+                # local mode has no coordinator to mint trace ids;
+                # engine-minted ones (rank-offset-disjoint) keep the
+                # flow events working single-process too
+                self._next_trace_id += 1
+                entry.trace_id = self._next_trace_id
             runnable.append(entry)
 
         buckets = self._fuse(ps, runnable)
@@ -1298,7 +1421,17 @@ class Engine:
             if rt in (RequestType.ALLREDUCE, RequestType.ADASUM):
                 algo, _ = self._algo_plan(ps, first.request,
                                           first.request.reduce_op)
-            self.timeline.op_start(names, rt.name, algorithm=algo)
+            # flow events per negotiation entry: an "s" anchored at
+            # the instant THIS process became ready, chained by the
+            # job-unique trace id into the execution span's "f" — the
+            # merged trace's straggler arrows (docs/timeline.md)
+            flows = {}
+            for e in bucket:
+                if e.trace_id is not None and e.ready_ts is not None:
+                    ref = next(iter(e.subs.values()))
+                    flows[ref.names[0]] = (e.trace_id, e.ready_ts)
+            self.timeline.op_start(names, rt.name, algorithm=algo,
+                                   flows=flows or None)
         try:
             if rt in (RequestType.ALLREDUCE, RequestType.ADASUM):
                 self._run_allreduce_bucket(ps, bucket)
@@ -1354,30 +1487,34 @@ class Engine:
         itemsize = dtype.itemsize
         rows = []
         try:
-            for r in ps.local_ranks:
-                arrays, offs_bytes, missing = [], [], False
-                for entry, i, off, size, _ in layout:
-                    sub = entry.subs.get(r)
-                    if sub is not None:
-                        arrays.append(sub.payloads[i].ravel())
-                        offs_bytes.append(off * itemsize)
-                    else:                # joined ranks contribute zeros
-                        missing = True
-                # staging buffer from the native arena (reference
-                # FusionBufferManager persistent buffer): steady state
-                # reuses the same aligned slabs every step
-                buf = self._arena.acquire(total * itemsize, dtype)
-                rows.append(buf)
-                if missing:
-                    buf.fill(0)
-                # one native batched memcpy per rank per bucket (the
-                # reference's batched-D2D kernel, cuda_kernels.cu:27-292);
-                # multithreaded above 8 MiB
-                if total * itemsize >= \
-                        self.config.pack_mt_threshold_bytes:
-                    native.pack_mt(arrays, buf, offs_bytes)
-                else:
-                    native.pack(arrays, buf, offs_bytes)
+            # annotated so host-side fusion phases appear as named
+            # ranges inside jax-profiler device traces (the reference's
+            # NVTX role, utils/profiler.py)
+            with profiler.annotate("hvd_fusion_pack"):
+                for r in ps.local_ranks:
+                    arrays, offs_bytes, missing = [], [], False
+                    for entry, i, off, size, _ in layout:
+                        sub = entry.subs.get(r)
+                        if sub is not None:
+                            arrays.append(sub.payloads[i].ravel())
+                            offs_bytes.append(off * itemsize)
+                        else:            # joined ranks contribute zeros
+                            missing = True
+                    # staging buffer from the native arena (reference
+                    # FusionBufferManager persistent buffer): steady
+                    # state reuses the same aligned slabs every step
+                    buf = self._arena.acquire(total * itemsize, dtype)
+                    rows.append(buf)
+                    if missing:
+                        buf.fill(0)
+                    # one native batched memcpy per rank per bucket
+                    # (the reference's batched-D2D kernel,
+                    # cuda_kernels.cu:27-292); multithreaded above 8 MiB
+                    if total * itemsize >= \
+                            self.config.pack_mt_threshold_bytes:
+                        native.pack_mt(arrays, buf, offs_bytes)
+                    else:
+                        native.pack(arrays, buf, offs_bytes)
             results = self._dispatch_allreduce(ps, first, op, dtype,
                                                rows, total)
         finally:
@@ -1390,11 +1527,12 @@ class Engine:
         by_rank = dict(zip(ps.local_ranks, results))
         # single pass over layout, grouping outputs per (entry, rank)
         per_entry = {}
-        for entry, i, off, size, shape in layout:
-            for r in entry.subs:
-                if r in by_rank:
-                    per_entry.setdefault((id(entry), r), []).append(
-                        by_rank[r][off:off + size].reshape(shape))
+        with profiler.annotate("hvd_fusion_unpack"):
+            for entry, i, off, size, shape in layout:
+                for r in entry.subs:
+                    if r in by_rank:
+                        per_entry.setdefault((id(entry), r), []).append(
+                            by_rank[r][off:off + size].reshape(shape))
         for entry in bucket:
             for r, sub in self._local_subs(ps, entry).items():
                 outs = per_entry[(id(entry), r)]
@@ -1454,10 +1592,11 @@ class Engine:
         bytes: int8 codes + bf16 scales, the codec's 2 B/block."""
         from ..ops import quantize as qz
         q_rows, s_rows = [], []
-        for r in rows:
-            q, s, _ = qz.np_quantize_blockwise(r)
-            q_rows.append(q)
-            s_rows.append(s)
+        with profiler.annotate("hvd_quantize_encode"):
+            for r in rows:
+                q, s, _ = qz.np_quantize_blockwise(r)
+                q_rows.append(q)
+                s_rows.append(s)
         self._account_wire(logical_nbytes,
                            q_rows[0].nbytes + s_rows[0].nbytes,
                            wire="int8")
@@ -1528,7 +1667,8 @@ class Engine:
         out = ps.executor.allreduce_quantized(
             q_rows, s_rows, op, req.prescale_factor,
             req.postscale_factor)
-        return [o[:total].astype(dtype) for o in out]
+        with profiler.annotate("hvd_quantize_decode"):
+            return [o[:total].astype(dtype) for o in out]
 
     def _dispatch_allreduce_2d(self, ps, req, op, dtype, rows, total,
                                wire, inner):
@@ -1830,6 +1970,9 @@ class Engine:
                 ev.set()
             self._lock.notify_all()
         self._shutdown_done.wait(timeout=30)
+        if self._clock_sync is not None:
+            self._clock_sync.stop()
+            self._clock_sync = None
         if self._metrics_pusher is not None:
             # final snapshot so short jobs still land in the job-wide
             # /metrics aggregation

@@ -99,6 +99,18 @@ def get_lib():
                     lib.hvd_tl_counter.argtypes = [
                         ctypes.c_void_p, ctypes.c_char_p,
                         ctypes.c_char_p, ctypes.c_double]
+                if hasattr(lib, "hvd_tl_set_pid"):
+                    lib.hvd_tl_set_pid.argtypes = [
+                        ctypes.c_void_p, ctypes.c_int64]
+                if hasattr(lib, "hvd_tl_meta"):
+                    lib.hvd_tl_meta.argtypes = [
+                        ctypes.c_void_p, ctypes.c_char_p,
+                        ctypes.c_char_p, ctypes.c_int64]
+                if hasattr(lib, "hvd_tl_flow"):
+                    lib.hvd_tl_flow.argtypes = [
+                        ctypes.c_void_p, ctypes.c_char_p,
+                        ctypes.c_int64, ctypes.c_int64,
+                        ctypes.c_double]
                 lib.hvd_tl_close.argtypes = [ctypes.c_void_p]
             _lib = lib
         except Exception as exc:  # noqa: BLE001 — fall back to numpy

@@ -3,7 +3,7 @@
 from ..common.basics import (  # noqa: F401
     init, shutdown, is_initialized,
     rank, size, local_rank, local_size, cross_rank, cross_size,
-    metrics, start_metrics_server,
+    metrics, start_metrics_server, dump_trace,
 )
 from ..tensorflow import (  # noqa: F401
     allreduce, allgather, broadcast, reducescatter, alltoall,

@@ -22,7 +22,7 @@ from ..common.basics import (  # noqa: F401
     rank, size, local_rank, local_size, cross_rank, cross_size,
     is_homogeneous, mpi_threads_supported, mpi_built, gloo_built,
     nccl_built, ddl_built, ccl_built, cuda_built, rocm_built,
-    xla_built, tpu_built, start_timeline, stop_timeline,
+    xla_built, tpu_built, start_timeline, stop_timeline, dump_trace,
     metrics, start_metrics_server,
 )
 from ..common.exceptions import (  # noqa: F401

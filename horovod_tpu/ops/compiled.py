@@ -890,10 +890,12 @@ class CompiledGroupedAllreduce:
                     self._validated.add(sig)
             import contextlib
 
+            from ..utils import profiler
+
             span = timeline.span(f"compiled.{self.name or 'reduce'}",
                                  "COMPILED_ALLREDUCE") \
                 if timeline is not None else contextlib.nullcontext()
-            with span:
+            with span, profiler.annotate("hvd_compiled_dispatch"):
                 staged = []
                 for k in range(len(plan)):
                     rows = [slot_values[pos][1][k]

@@ -15,6 +15,9 @@ HOROVOD_AUTOTUNE = "HOROVOD_AUTOTUNE"
 HOROVOD_AUTOTUNE_LOG = "HOROVOD_AUTOTUNE_LOG"
 HOROVOD_METRICS_PORT = "HOROVOD_METRICS_PORT"
 HOROVOD_METRICS_PUSH_SECONDS = "HOROVOD_METRICS_PUSH_SECONDS"
+HOROVOD_TRACE_RING_EVENTS = "HOROVOD_TRACE_RING_EVENTS"
+HOROVOD_TRACE_DUMP_DIR = "HOROVOD_TRACE_DUMP_DIR"
+HOROVOD_TRACE_CLOCK_SYNC_SECONDS = "HOROVOD_TRACE_CLOCK_SYNC_SECONDS"
 HOROVOD_STALL_CHECK_DISABLE = "HOROVOD_STALL_CHECK_DISABLE"
 HOROVOD_STALL_CHECK_TIME_SECONDS = "HOROVOD_STALL_CHECK_TIME_SECONDS"
 HOROVOD_STALL_SHUTDOWN_TIME_SECONDS = "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"
@@ -44,6 +47,13 @@ def set_env_from_args(env: dict, args) -> dict:
         env[HOROVOD_TIMELINE] = args.timeline_filename
     setb(HOROVOD_TIMELINE_MARK_CYCLES,
          getattr(args, "timeline_mark_cycles", False))
+    if getattr(args, "trace_ring_events", None) is not None:
+        env[HOROVOD_TRACE_RING_EVENTS] = str(args.trace_ring_events)
+    if getattr(args, "trace_dump_dir", None):
+        env[HOROVOD_TRACE_DUMP_DIR] = args.trace_dump_dir
+    if getattr(args, "trace_clock_sync_seconds", None) is not None:
+        env[HOROVOD_TRACE_CLOCK_SYNC_SECONDS] = str(
+            args.trace_clock_sync_seconds)
     setb(HOROVOD_AUTOTUNE, getattr(args, "autotune", False))
     if getattr(args, "autotune_log_file", None):
         env[HOROVOD_AUTOTUNE_LOG] = args.autotune_log_file

@@ -123,6 +123,12 @@ class _Handler(BaseHTTPRequestHandler):
             # Prometheus scrapers cannot HMAC-sign, and the payload is
             # read-only operational metadata (docs/observability.md).
             return self._serve_job_metrics(path)
+        if path == "/timeline":
+            # job-wide merged trace: ask every worker to dump its
+            # flight-recorder ring, then clock-align + merge the
+            # buffers into one Perfetto-loadable JSON.  Unauthenticated
+            # for the same reason as /metrics (docs/timeline.md).
+            return self._serve_job_timeline(query)
         if not self._verify(b""):
             return self._reply(FORBIDDEN)
         params = dict(p.split("=", 1) for p in query.split("&") if "=" in p)
@@ -171,6 +177,68 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(OK, render_prometheus(merged).encode(),
                         CONTENT_TYPE_LATEST)
 
+    def _serve_job_timeline(self, query):
+        """Collect per-worker flight-recorder buffers, clock-align and
+        merge them (utils/trace_merge.py), serve one job trace.
+
+        A fresh dump request rides the coordinator's response log;
+        workers poll every engine cycle, so buffers land within a
+        cycle or two.  If a worker never answers (dead, or the very
+        stall being debugged has wedged its user threads — the engine
+        background thread still polls, so even stalled workers
+        normally dump), the handler serves whatever buffers exist
+        after ``?wait=`` seconds rather than nothing."""
+        from ...utils.trace_merge import TRACE_KV_PREFIX, merge_traces
+
+        coord = self.server.coordinator
+        params = dict(p.split("=", 1) for p in query.split("&")
+                      if "=" in p)
+        try:
+            wait = float(params.get("wait", 15.0))
+        except ValueError:
+            wait = 15.0
+        if not (0.0 <= wait <= 120.0):
+            # unauthenticated endpoint: an unclamped (or NaN/inf) wait
+            # would pin a launcher thread forever when a worker is dead
+            wait = 15.0 if wait != wait or wait < 0 else 120.0
+        dump_id = coord.request_trace_dump(reason="http")
+        deadline = time.monotonic() + wait
+        world = max(coord.world_size, 1)
+        bufs = {}
+        seen_raw = {}       # key -> raw bytes already parsed (rings
+        #                     are MBs; re-parsing unchanged buffers
+        #                     every poll tick would melt the launcher)
+        while True:
+            for key, raw in self.store.scope(TRACE_KV_PREFIX).items():
+                if seen_raw.get(key) == raw:
+                    continue
+                seen_raw[key] = raw
+                try:
+                    payload = json.loads(raw)
+                    proc = payload.get("proc")
+                except (ValueError, AttributeError):
+                    continue    # half-written value: skip, not 500
+                if proc is None:
+                    continue
+                rnd = payload.get("round")
+                if rnd is not None and rnd != coord.round_id:
+                    continue    # stale elastic round
+                if 0 < coord.world_size <= proc:
+                    # a worker removed in an elastic downsize keeps its
+                    # final buffer in the KV store forever (same guard
+                    # as _serve_job_metrics): don't show a pid lane for
+                    # a rank that no longer exists
+                    continue
+                bufs[proc] = payload
+            fresh = sum(1 for p in bufs.values()
+                        if (p.get("dump_id") or 0) >= dump_id)
+            if fresh >= world or time.monotonic() > deadline:
+                break
+            time.sleep(0.1)
+        merged = merge_traces(
+            [p.get("events") or [] for _, p in sorted(bufs.items())])
+        self._reply(OK, json.dumps(merged).encode(), "application/json")
+
     def do_DELETE(self):
         if not self._verify(b""):
             return self._reply(FORBIDDEN)
@@ -181,6 +249,16 @@ class _Handler(BaseHTTPRequestHandler):
         """Coordinator RPCs: /coord/<verb>, JSON body."""
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length)
+        if self.path == "/trace/dump":
+            # on-demand flight-recorder dump trigger (curl-able like
+            # /metrics and /timeline: unauthenticated, bounded work —
+            # each worker pushes its ring once); fetch the merged
+            # result from GET /timeline
+            did = self.server.coordinator.request_trace_dump(
+                reason="request")
+            return self._reply(OK,
+                               json.dumps({"dump_id": did}).encode(),
+                               "application/json")
         if not self._verify(body):
             return self._reply(FORBIDDEN)
         if not self.path.startswith("/coord/"):
@@ -316,6 +394,13 @@ class Coordinator:
         self._cache = OrderedDict()  # cache_id -> meta template (LRU)
         self._cache_by_key = {}      # key -> cache_id
         self._next_cache_id = 0
+        # job-unique trace ids, one per scheduled negotiation entry:
+        # batch responses carry them so every rank's flow events for
+        # one collective chain on the same id (docs/timeline.md)
+        self._next_trace_id = 0
+        # flight-recorder dump requests appended to the response log
+        # (stall auto-dumps, POST /trace/dump, GET /timeline)
+        self._next_dump_id = 0
 
     def close(self):
         if self._autotuner is not None:
@@ -354,6 +439,12 @@ class Coordinator:
             self._lock.notify_all()
 
     def handle(self, verb, req):
+        if verb == "clock":
+            # NTP-style ping target (utils/clock_sync.py): the
+            # launcher's wall clock is THE reference clock every
+            # worker's timeline epoch is mapped onto.  Round-agnostic
+            # and lock-free — it must answer with minimal jitter.
+            return {"t": time.time()}
         if req.get("round", self.round_id) != self.round_id:
             return {"stale": True, "round": self.round_id}
         if verb == "ready":
@@ -363,6 +454,20 @@ class Coordinator:
         if verb == "join":
             return self._on_join(req)
         raise ValueError(f"unknown coordinator verb {verb}")
+
+    def request_trace_dump(self, reason="request"):
+        """Append a flight-recorder dump request to the response log;
+        every worker's next poll sees it and pushes its ring to the KV
+        store (``/trace/buf/<proc>``).  Returns the dump id workers
+        echo, so ``GET /timeline`` can tell fresh buffers from stale
+        ones."""
+        with self._lock:
+            self._next_dump_id += 1
+            did = self._next_dump_id
+            self._log.append({"kind": "trace_dump", "id": did,
+                              "reason": reason})
+            self._lock.notify_all()
+        return did
 
     def _check_session(self, proc, sid):
         """A fresh controller session (engine re-init against this
@@ -645,11 +750,20 @@ class Coordinator:
             self._cache[cid] = templates[key]
             self._cache.move_to_end(cid)
             cache_ids[key] = cid
+        # job-unique trace ids, minted per negotiation entry at
+        # scheduling time: every process receives the same id for the
+        # same entry, so the flow events each rank emits chain into
+        # one cross-rank arrow in the merged trace
+        trace_ids = {}
+        for m in metas:
+            self._next_trace_id += 1
+            trace_ids[m["key"]] = self._next_trace_id
         resp = {
             "kind": "batch",
             "keys": [m["key"] for m in metas],
             "metas": templates,
             "aux": {m["key"]: m.get("aux_by_proc", {}) for m in metas},
+            "trace": trace_ids,
         }
         if cache_ids:
             resp["cache_ids"] = cache_ids
@@ -676,6 +790,7 @@ class Coordinator:
         if self.stall_warning_secs <= 0 or not self._pending:
             return
         now = time.monotonic()
+        new_stalls = 0
         for key, ent in self._pending.items():
             t0 = self._pending_since.get(key)
             if t0 is None or now - t0 <= self.stall_warning_secs \
@@ -717,6 +832,20 @@ class Coordinator:
                 "missing_ranks": missing_ranks,
                 "missing_procs": missing_procs,
             })
+            new_stalls += 1
+        if new_stalls:
+            # every stall warning ships with the clock-aligned job
+            # trace that explains it: ONE flight-recorder dump request
+            # rides the log behind this scan's stall records (one
+            # straggler can stall many tensors at once; per-key dump
+            # requests would have every worker re-push its full ring
+            # N times), so each worker — the straggler included, its
+            # engine thread still polls — pushes its last-N-seconds
+            # ring exactly once per stall burst
+            self._next_dump_id += 1
+            self._log.append({"kind": "trace_dump",
+                              "id": self._next_dump_id,
+                              "reason": "stall"})
             self._lock.notify_all()     # wake parked long-polls
 
     def _on_poll(self, req):

@@ -76,9 +76,25 @@ def parse_args(argv=None):
                              "(HOROVOD_ALLREDUCE_ALGORITHM); the "
                              "boolean flags above win when both are "
                              "given")
-    # timeline
+    # timeline + job-wide tracing (docs/timeline.md)
     parser.add_argument("--timeline-filename", default=None)
     parser.add_argument("--timeline-mark-cycles", action="store_true")
+    parser.add_argument("--trace-ring-events", type=int, default=None,
+                        help="flight-recorder ring size per worker "
+                             "(events; 0 disables) — the buffer stall "
+                             "warnings auto-dump and GET /timeline "
+                             "merges (HOROVOD_TRACE_RING_EVENTS)")
+    parser.add_argument("--trace-dump-dir", default=None,
+                        help="directory flight-recorder auto-dumps "
+                             "are written into as stand-alone Chrome "
+                             "traces (HOROVOD_TRACE_DUMP_DIR; unset = "
+                             "KV push only)")
+    parser.add_argument("--trace-clock-sync-seconds", type=float,
+                        default=None,
+                        help="cadence of the NTP-style clock re-sync "
+                             "mapping each worker's timeline onto the "
+                             "launcher's clock "
+                             "(HOROVOD_TRACE_CLOCK_SYNC_SECONDS)")
     # telemetry (docs/observability.md)
     parser.add_argument("--metrics-port", type=int, default=None,
                         help="base port for per-worker Prometheus "

@@ -21,7 +21,7 @@ from ..common.basics import (  # noqa: F401
     is_homogeneous, bind_rank, unbind_rank,
     mpi_threads_supported, mpi_built, gloo_built, nccl_built, ddl_built,
     ccl_built, cuda_built, rocm_built, xla_built, tpu_built,
-    start_timeline, stop_timeline,
+    start_timeline, stop_timeline, dump_trace,
     metrics, start_metrics_server,
 )
 from ..common.exceptions import (  # noqa: F401

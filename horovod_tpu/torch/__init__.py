@@ -14,7 +14,7 @@ from ..common.basics import (  # noqa: F401
     mpi_threads_supported, mpi_built, gloo_built, nccl_built, ddl_built,
     ccl_built, cuda_built, rocm_built, xla_built, tpu_built,
     mpi_enabled, gloo_enabled,
-    start_timeline, stop_timeline,
+    start_timeline, stop_timeline, dump_trace,
     metrics, start_metrics_server,
 )
 from ..common.exceptions import (  # noqa: F401

@@ -1,4 +1,5 @@
-"""Aux subsystems: timeline tracing, checkpoint/resume."""
+"""Aux subsystems: timeline tracing, job-wide trace merge/clock sync,
+checkpoint/resume."""
 
 from .timeline import Timeline  # noqa: F401
 from .checkpoint import (  # noqa: F401
@@ -7,3 +8,5 @@ from .checkpoint import (  # noqa: F401
 from .profiler import (  # noqa: F401
     annotate, profile, start_profile, stop_profile,
 )
+from .clock_sync import ClockSync, estimate_offset  # noqa: F401
+from .trace_merge import load_trace, merge_traces  # noqa: F401

@@ -1,0 +1,452 @@
+"""Job-wide distributed tracing tests: clock-offset estimation, trace
+merge, flow events, per-rank pid metadata, the flight recorder, and
+the coordinator's trace-id/dump plumbing (docs/timeline.md "Job-wide
+traces")."""
+
+import contextlib
+import json
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.utils.clock_sync import estimate_offset
+from horovod_tpu.utils.trace_merge import (
+    TRACE_KV_PREFIX, load_trace, merge_traces,
+)
+
+
+# ---------------------------------------------------------------------------
+# clock-offset estimator
+
+def test_estimate_offset_recovers_synthetic_skew():
+    """Synthetic skewed clocks: the midpoint estimator recovers a
+    known offset within the uncertainty it reports."""
+    rng = random.Random(1234)
+    true_offset = 98_765_432.1          # µs between the two clocks
+    local = [0.0]
+
+    def sample():
+        t0 = local[0]
+        up = rng.uniform(50, 400)       # asymmetric legs: the error
+        down = rng.uniform(50, 400)     # the rtt/2 bound covers
+        server = t0 + up + true_offset
+        t1 = t0 + up + down
+        local[0] = t1 + rng.uniform(10, 100)
+        return t0, server, t1
+
+    offset, err = estimate_offset(sample, samples=16)
+    assert err > 0
+    assert abs(offset - true_offset) <= err + 1e-6
+
+
+def test_estimate_offset_negative_and_single_sample():
+    offset, err = estimate_offset(lambda: (100.0, 50.0, 120.0),
+                                  samples=1)
+    assert offset == pytest.approx(50.0 - 110.0)
+    assert err == pytest.approx(10.0)
+
+
+def test_coordinator_clock_verb():
+    from horovod_tpu.runner.http.http_server import Coordinator
+    coord = Coordinator(world_size=1)
+    before = time.time()
+    out = coord.handle("clock", {})
+    assert before <= out["t"] <= time.time()
+
+
+# ---------------------------------------------------------------------------
+# trace merge
+
+def _worker_trace(pid, offset_us, t0, flow_id=7):
+    """A minimal worker trace: clock_sync + one NEGOTIATE/op pair with
+    a flow s/f, on a private epoch such that aligned events land at
+    reference time ``t0``."""
+    base = t0 - offset_us
+    return [
+        {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+         "args": {"name": f"rank {pid}"}},
+        {"name": "clock_sync", "ph": "M", "pid": pid, "tid": 0,
+         "args": {"offset_us": offset_us, "uncertainty_us": 25.0,
+                  "source": "coordinator"}},
+        {"name": "thread_name", "ph": "M", "pid": pid, "tid": 1,
+         "args": {"name": "grad"}},
+        {"name": "NEGOTIATE_ALLREDUCE", "ph": "B", "pid": pid,
+         "tid": 1, "ts": base},
+        {"name": "negotiation", "cat": "hvd", "ph": "s",
+         "id": flow_id, "pid": pid, "tid": 1, "ts": base + 10.0},
+        {"name": "NEGOTIATE_ALLREDUCE", "ph": "E", "pid": pid,
+         "tid": 1, "ts": base + 20.0},
+        {"name": "ALLREDUCE", "ph": "B", "pid": pid, "tid": 1,
+         "ts": base + 20.0},
+        {"name": "negotiation", "cat": "hvd", "ph": "f", "bp": "e",
+         "id": flow_id, "pid": pid, "tid": 1, "ts": base + 20.0},
+        {"name": "ALLREDUCE", "ph": "E", "pid": pid, "tid": 1,
+         "ts": base + 90.0},
+    ]
+
+
+def test_merge_aligns_epochs_and_keeps_flows():
+    """Two worker buffers on wildly different epochs merge into one
+    monotonic trace where the same collective's spans coincide and the
+    flow pair survives intact."""
+    # rank 0's epoch is ~1e9 µs behind the reference, rank 1's ~5e6
+    # ahead; both executed the collective at reference time 2000 µs
+    t_ref = 2000.0
+    a = _worker_trace(0, offset_us=1.0e9, t0=t_ref)
+    b = _worker_trace(1, offset_us=-5.0e6, t0=t_ref + 3.0)
+    merged = merge_traces([a, b])
+
+    assert {e["pid"] for e in merged} == {0, 1}
+    stamped = [e for e in merged if "ts" in e and e.get("ph") != "M"]
+    ts = [e["ts"] for e in stamped]
+    assert ts == sorted(ts)                 # monotonic
+    assert min(ts) == pytest.approx(0.0)    # normalized
+    # clock-aligned: both ranks' ALLREDUCE B within the 3 µs skew
+    starts = {e["pid"]: e["ts"] for e in merged
+              if e["name"] == "ALLREDUCE" and e["ph"] == "B"}
+    assert abs(starts[0] - starts[1]) == pytest.approx(3.0, abs=1e-3)
+    # flow events intact: a chained s/f pair per rank, same id
+    s = [e for e in merged if e.get("ph") == "s"]
+    f = [e for e in merged if e.get("ph") == "f"]
+    assert len(s) == 2 and len(f) == 2
+    assert {e["id"] for e in s} == {e["id"] for e in f} == {7}
+    # perfetto-valid: plain JSON array round-trip
+    assert json.loads(json.dumps(merged)) == merged
+
+
+def test_merge_rebases_legacy_trace_without_clock_sync():
+    """A pre-trace-PR file (no clock_sync record) must not land ~50
+    years away from aligned unix-epoch traces: it is rebased to the
+    earliest aligned event."""
+    modern = _worker_trace(0, offset_us=1.7e15, t0=1.7e15 + 500.0)
+    legacy = [
+        {"name": "thread_name", "ph": "M", "pid": 9, "tid": 1,
+         "args": {"name": "grad"}},
+        {"name": "ALLREDUCE", "ph": "B", "pid": 9, "tid": 1,
+         "ts": 12345.0},
+        {"name": "ALLREDUCE", "ph": "E", "pid": 9, "tid": 1,
+         "ts": 12395.0},
+    ]
+    merged = merge_traces([modern, legacy])
+    ts = [e["ts"] for e in merged if "ts" in e]
+    # whole merged axis spans microseconds, not decades
+    assert max(ts) - min(ts) < 1e6
+    legacy_ts = [e["ts"] for e in merged
+                 if e.get("pid") == 9 and "ts" in e]
+    assert min(legacy_ts) == pytest.approx(0.0)
+    assert max(legacy_ts) - min(legacy_ts) == pytest.approx(50.0)
+
+
+def test_merge_remaps_colliding_pids():
+    """Legacy traces that both claim pid 0 still get distinct lanes."""
+    a = _worker_trace(0, 0.0, 100.0)
+    b = _worker_trace(0, 0.0, 200.0)
+    merged = merge_traces([a, b])
+    assert len({e["pid"] for e in merged}) == 2
+
+
+def test_load_trace_repairs_truncated_file(tmp_path):
+    """A worker killed mid-write leaves a trace without the closing
+    bracket (possibly mid-event); load_trace recovers every complete
+    event."""
+    events = _worker_trace(3, 0.0, 50.0)
+    body = ",\n".join(json.dumps(e) for e in events)
+    whole = tmp_path / "ok.json"
+    whole.write_text("[\n" + body + "\n]\n")
+    assert load_trace(str(whole)) == events
+
+    torn = tmp_path / "torn.json"
+    torn.write_text("[\n" + body + ",\n{\"name\": \"AL")
+    recovered = load_trace(str(torn))
+    assert recovered == events
+
+    trailing = tmp_path / "trailing.json"
+    trailing.write_text("[\n" + body + ",\n")
+    assert load_trace(str(trailing)) == events
+
+
+# ---------------------------------------------------------------------------
+# timeline: pid + process_name, flow events, clock_sync record
+
+def _run_allreduce_with_timeline(path, np_ranks=2, fn_extra=None):
+    def fn():
+        hvd.allreduce(np.ones(16, np.float32), name="tr_test")
+        if fn_extra is not None:
+            fn_extra()
+        return True
+
+    assert all(hvd.run(fn, np=np_ranks))
+    return json.loads(path.read_text())
+
+
+def test_timeline_pid_clock_sync_and_flows(hvd_shutdown, tmp_path,
+                                           monkeypatch):
+    path = tmp_path / "tl.json"
+    monkeypatch.setenv("HOROVOD_TIMELINE", str(path))
+    events = _run_allreduce_with_timeline(path)
+    # every event carries the worker's pid (no hardcoded omissions)
+    assert all("pid" in e for e in events)
+    names = {e["name"] for e in events}
+    assert {"process_name", "clock_sync",
+            "NEGOTIATE_ALLREDUCE", "ALLREDUCE"} <= names
+    clock = [e for e in events if e["name"] == "clock_sync"]
+    assert all("offset_us" in e["args"] for e in clock)
+    assert clock[0]["args"]["source"] == "wallclock"
+    # flow pair: s anchored in the NEGOTIATE span, f on the op start,
+    # chained by one trace id
+    s = [e for e in events if e.get("ph") == "s"]
+    f = [e for e in events if e.get("ph") == "f"]
+    assert s and f
+    assert {e["id"] for e in s} == {e["id"] for e in f}
+    assert all(e.get("cat") == "hvd" for e in s + f)
+    op_b = [e for e in events
+            if e["name"] == "ALLREDUCE" and e["ph"] == "B"]
+    assert s[0]["ts"] <= f[0]["ts"] == pytest.approx(op_b[0]["ts"])
+
+
+def test_timeline_python_fallback_writer_parity(hvd_shutdown, tmp_path,
+                                                monkeypatch):
+    """The python writer (native lib unavailable) produces the same
+    job-wide records: pid, process_name, clock_sync, flows."""
+    from horovod_tpu.core import native as native_mod
+    monkeypatch.setattr(native_mod, "timeline_writer", lambda p: None)
+    path = tmp_path / "tl_py.json"
+    monkeypatch.setenv("HOROVOD_TIMELINE", str(path))
+    events = _run_allreduce_with_timeline(path)
+    names = {e["name"] for e in events}
+    assert {"process_name", "clock_sync", "ALLREDUCE"} <= names
+    assert any(e.get("ph") == "s" for e in events)
+    assert any(e.get("ph") == "f" for e in events)
+    assert all("pid" in e for e in events)
+
+
+def test_timeline_close_idempotent(tmp_path):
+    from horovod_tpu.utils.timeline import Timeline
+    path = tmp_path / "tl.json"
+    tl = Timeline(str(path))
+    tl.op_start(["t"], "ALLREDUCE")
+    tl.op_end()
+    tl.close()
+    tl.close()                      # second close is a no-op
+    events = json.load(open(path))
+    assert any(e["name"] == "ALLREDUCE" for e in events)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+
+def test_ring_dump_without_timeline_file(hvd_shutdown, tmp_path,
+                                         monkeypatch):
+    """The flight recorder runs by default with NO timeline file and
+    hvd.dump_trace writes a stand-alone parseable Chrome trace."""
+    monkeypatch.delenv("HOROVOD_TIMELINE", raising=False)
+    out = tmp_path / "flight.json"
+
+    def fn():
+        hvd.allreduce(np.ones(32, np.float32), name="fr_test")
+        if hvd.rank() == 0:
+            assert hvd.dump_trace(str(out)) == str(out)
+        return True
+
+    assert all(hvd.run(fn, np=2))
+    events = json.load(open(out))
+    names = {e["name"] for e in events}
+    assert {"process_name", "clock_sync", "thread_name"} <= names
+    assert any("fr_test" in str(e.get("args")) for e in events
+               if e["name"] == "thread_name")
+    # manual dumps land in the telemetry counter
+    snap = hvd.metrics()
+    fam = snap["horovod_trace_ring_dumps_total"]
+    reasons = {s["labels"].get("reason"): s["value"]
+               for s in fam["samples"]}
+    assert reasons.get("manual", 0) >= 1
+
+
+def test_ring_disabled_no_timeline(hvd_shutdown, monkeypatch):
+    monkeypatch.delenv("HOROVOD_TIMELINE", raising=False)
+    monkeypatch.setenv("HOROVOD_TRACE_RING_EVENTS", "0")
+    hvd.init(num_ranks=2)
+    from horovod_tpu.common import basics
+    assert basics.engine().timeline is None
+    assert hvd.dump_trace() is None
+
+
+def test_ring_is_bounded(tmp_path):
+    from horovod_tpu.utils.timeline import Timeline
+    tl = Timeline(ring_events=8)
+    for i in range(100):
+        tl.span(f"t{i % 4}", "OP").__exit__()
+    dump = tl.ring_dump()
+    ring_events = [e for e in dump if e.get("ph") in ("B", "E")]
+    assert len(ring_events) == 8
+    tl.close()
+
+
+def test_ring_only_lane_map_is_bounded():
+    """The flight recorder is on by default, so auto-named tensors
+    ('allreduce.noname.N' — a fresh name per call) must not grow the
+    lane map without bound; file-writing timelines keep the unbounded
+    pre-ring behavior (lanes are the file format)."""
+    from horovod_tpu.utils.timeline import Timeline
+    tl = Timeline(ring_events=16)
+    for i in range(3000):
+        tl.negotiate_start(f"allreduce.noname.{i}", "ALLREDUCE")
+    assert len(tl._tids) <= 1024
+    assert len(tl.ring_dump()) <= 1024 + 16 + 2
+    tl.close()
+
+
+def test_stall_autodump_writes_flight_trace(hvd_shutdown, tmp_path,
+                                            monkeypatch):
+    """The local stall inspector's warning ships with a flight-recorder
+    dump into HOROVOD_TRACE_DUMP_DIR."""
+    monkeypatch.delenv("HOROVOD_TIMELINE", raising=False)
+    monkeypatch.setenv("HOROVOD_STALL_CHECK_TIME_SECONDS", "0.25")
+    monkeypatch.setenv("HOROVOD_TRACE_DUMP_DIR", str(tmp_path))
+    release = threading.Event()
+
+    def fn():
+        if hvd.rank() == 0:
+            release.wait(timeout=10)
+        hvd.allreduce(np.ones(4, np.float32), name="fr_stall")
+        return True
+
+    def waiter():
+        time.sleep(1.0)
+        release.set()
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    assert all(hvd.run(fn, np=2))
+    t.join()
+    dump = tmp_path / "hvd_flight_p0.json"
+    assert dump.exists()
+    events = json.load(open(dump))
+    # the dumped trace names the stalled tensor's lane — what the
+    # punctual rank was waiting on
+    lanes = [e for e in events if e["name"] == "thread_name"]
+    assert any("fr_stall" in str(e.get("args")) for e in lanes)
+    snap = hvd.metrics()
+    fam = snap["horovod_trace_ring_dumps_total"]
+    assert any(s["labels"].get("reason") == "stall"
+               and s["value"] >= 1 for s in fam["samples"])
+
+
+def test_stop_timeline_keeps_flight_recorder(hvd_shutdown, tmp_path):
+    path = tmp_path / "tl.json"
+    hvd.init(num_ranks=2)
+    hvd.start_timeline(str(path))
+
+    def fn():
+        hvd.allreduce(np.ones(4, np.float32), name="sw_test")
+        return True
+
+    hvd.run(fn, np=2, keep_alive=True)
+    hvd.stop_timeline()
+    from horovod_tpu.common import basics
+    assert basics.engine().timeline is not None    # ring-only stands in
+    out = tmp_path / "after_stop.json"
+    hvd.run(fn, np=2, keep_alive=True)
+    assert hvd.dump_trace(str(out)) == str(out)
+    assert json.load(open(out))
+    hvd.shutdown()
+    assert json.load(open(path))                   # file finalized
+
+
+# ---------------------------------------------------------------------------
+# coordinator plumbing: trace ids, dump requests, GET /timeline
+
+def _ready_meta(key, nprocs=1):
+    return {"key": key, "type": "ALLREDUCE", "dtype": "float32",
+            "shape": [4], "op": 1, "pre": 1.0, "post": 1.0,
+            "wire": None, "algo": None, "ps": 0, "nbytes": 16,
+            "nprocs": nprocs, "nranks": nprocs, "root": -1,
+            "members": {str(p): [p] for p in range(nprocs)}, "aux": {}}
+
+
+def test_coordinator_mints_job_unique_trace_ids():
+    from horovod_tpu.runner.http.http_server import Coordinator
+    coord = Coordinator(world_size=1)
+    coord.handle("ready", {"proc": 0, "entries": [_ready_meta("k1")],
+                           "rid": 1})
+    coord.handle("ready", {"proc": 0, "entries": [_ready_meta("k2")],
+                           "rid": 2})
+    out = coord.handle("poll", {"cursor": 0, "wait": 0.1, "proc": 0})
+    batches = [r for r in out["responses"] if r["kind"] == "batch"]
+    ids = [tid for b in batches for tid in b["trace"].values()]
+    assert len(ids) == 2 and len(set(ids)) == 2
+    assert all(isinstance(t, int) for t in ids)
+
+
+def test_coordinator_trace_dump_request_rides_log():
+    from horovod_tpu.runner.http.http_server import Coordinator
+    coord = Coordinator(world_size=1)
+    did = coord.request_trace_dump(reason="request")
+    out = coord.handle("poll", {"cursor": 0, "wait": 0.1, "proc": 0})
+    dumps = [r for r in out["responses"] if r["kind"] == "trace_dump"]
+    assert dumps and dumps[0]["id"] == did
+    assert dumps[0]["reason"] == "request"
+    assert coord.request_trace_dump() == did + 1
+
+
+def test_http_timeline_endpoint_merges_pushed_buffers():
+    """GET /timeline merges whatever flight-recorder buffers workers
+    pushed (serving stale ones after the wait deadline when no fresh
+    dump arrives — better partial coverage than a 500)."""
+    import urllib.request
+    from horovod_tpu.runner.http.http_server import RendezvousServer
+
+    server = RendezvousServer(secret=None, world_size=2)
+    port = server.start()
+    try:
+        for proc, pid, t0 in ((0, 0, 500.0), (1, 1, 520.0)):
+            payload = {"proc": proc, "pid": pid, "dump_id": None,
+                       "events": _worker_trace(pid, 0.0, t0)}
+            server.store.put(f"{TRACE_KV_PREFIX}{proc}",
+                             json.dumps(payload).encode())
+        raw = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/timeline?wait=0.3",
+            timeout=30).read()
+        merged = json.loads(raw)
+        assert {e["pid"] for e in merged} == {0, 1}
+        assert any(e["name"] == "clock_sync" for e in merged)
+        assert any(e.get("ph") == "s" for e in merged)
+        # POST /trace/dump answers with a dump id
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/trace/dump", data=b"",
+            method="POST")
+        out = json.loads(urllib.request.urlopen(req, timeout=10).read())
+        assert out["dump_id"] >= 1
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# profiler annotations (satellite: engine hot phases)
+
+def test_profiler_annotations_emitted(hvd_shutdown, monkeypatch):
+    from horovod_tpu.utils import profiler
+    seen = []
+
+    @contextlib.contextmanager
+    def recording(name):
+        seen.append(name)
+        yield
+
+    monkeypatch.setattr(profiler, "annotate", recording)
+
+    def fn():
+        hvd.allreduce(np.ones(2048, np.float32), name="prof_full")
+        hvd.allreduce(np.ones(2048, np.float32), name="prof_q",
+                      wire_dtype="int8")
+        return True
+
+    assert all(hvd.run(fn, np=2))
+    assert "hvd_fusion_pack" in seen
+    assert "hvd_fusion_unpack" in seen
+    assert "hvd_quantize_encode" in seen
+    assert "hvd_quantize_decode" in seen

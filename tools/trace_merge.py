@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Merge per-worker Chrome traces into one clock-aligned job trace.
+
+Each worker of a multi-process job writes its own timeline file
+(``HOROVOD_TIMELINE=/tmp/tl.json`` -> ``tl.json``, ``tl.proc1.json``,
+...) or flight-recorder dump, every one on its own private clock
+epoch.  This tool applies each file's ``clock_sync`` offset, keeps one
+pid lane per rank, and emits a single Perfetto-loadable JSON — the
+offline twin of the launcher's ``GET /timeline``
+(docs/timeline.md "Job-wide traces").
+
+Usage:
+    python tools/trace_merge.py -o merged.json tl.json tl.proc1.json
+    python tools/trace_merge.py worker*.json > merged.json
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from horovod_tpu.utils.trace_merge import load_trace, merge_traces  # noqa: E402
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Merge per-worker Chrome traces into one "
+                    "clock-aligned job trace.")
+    parser.add_argument("inputs", nargs="+",
+                        help="per-worker Chrome trace JSON files "
+                             "(timeline files or flight-recorder "
+                             "dumps; truncated files are repaired)")
+    parser.add_argument("-o", "--output", default=None,
+                        help="merged trace path (default: stdout)")
+    parser.add_argument("--no-align", action="store_true",
+                        help="skip clock_sync alignment (raw "
+                             "per-worker timestamps)")
+    args = parser.parse_args(argv)
+
+    traces = [load_trace(p) for p in args.inputs]
+    merged = merge_traces(traces, align=not args.no_align)
+    out = json.dumps(merged)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(out)
+        pids = {ev.get("pid") for ev in merged}
+        print(f"merged {len(args.inputs)} traces "
+              f"({len(merged)} events, {len(pids)} pid lanes) "
+              f"-> {args.output}", file=sys.stderr)
+    else:
+        sys.stdout.write(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

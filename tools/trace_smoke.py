@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""CI trace smoke (ci.sh `trace` step; modeled on metrics_smoke.py):
+launch a REAL 2-process job, exercise the whole job-wide tracing
+stack, and validate end-to-end that
+
+* ``GET /timeline`` on the launcher's rendezvous service serves ONE
+  merged Perfetto-loadable JSON with >= 2 distinct pids, clock_sync
+  metadata, and at least one flow-event (s/f) pair;
+* ``tools/trace_merge.py`` merges the per-worker timeline FILES into
+  the same shape of trace;
+* an induced stall auto-dumps the flight recorder on every worker
+  (the ``horovod_trace_ring_dumps_total{reason="stall"}`` counter),
+  and the job trace scraped DURING the stall names the straggler:
+  the stalled tensor's lane exists only under the punctual rank's
+  pid.
+
+Driver mode (no args): launches 2 workers with a short stall-warning
+time.  Worker mode (TS_WORKER=1): runs collectives, induces a stall,
+scrapes, validates.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+STALL_SECS = 1.0        # coordinator stall-warning time for the smoke
+STALL_TENSOR = "ts.stall"
+
+
+def _get(url, timeout=60):
+    import urllib.request
+    return urllib.request.urlopen(url, timeout=timeout).read().decode()
+
+
+def _counter_total(snapshot, family, **labels):
+    fam = snapshot.get(family) or {}
+    total = 0.0
+    for s in fam.get("samples", []):
+        lab = s.get("labels", {})
+        if all(lab.get(k) == v for k, v in labels.items()):
+            total += float(s.get("value", 0.0))
+    return total
+
+
+def _validate_merged(merged, where, expect_pids=2):
+    """The acceptance shape every merged job trace must have."""
+    assert isinstance(merged, list) and merged, f"{where}: empty trace"
+    pids = {e.get("pid") for e in merged if "pid" in e}
+    assert len(pids) >= expect_pids, f"{where}: pids {pids}"
+    clock = [e for e in merged if e.get("name") == "clock_sync"]
+    assert clock, f"{where}: no clock_sync metadata"
+    assert all("offset_us" in e.get("args", {}) for e in clock), clock
+    s_ids = {e.get("id") for e in merged if e.get("ph") == "s"}
+    f_ids = {e.get("id") for e in merged if e.get("ph") == "f"}
+    assert s_ids & f_ids, \
+        f"{where}: no complete flow pair (s={s_ids}, f={f_ids})"
+    # clock-aligned: both ranks' spans of the same collective overlap
+    # on the merged axis (they execute together; raw per-worker epochs
+    # would scatter them arbitrarily)
+    spans = {}
+    for e in merged:
+        if e.get("name") == "ALLREDUCE" and e.get("ph") == "B":
+            spans.setdefault(e["pid"], []).append(float(e["ts"]))
+    if len(spans) >= 2:
+        firsts = [min(v) for v in spans.values()]
+        assert max(firsts) - min(firsts) < 60e6, \
+            f"{where}: B events {firsts} not clock-aligned"
+    ts_seq = [float(e["ts"]) for e in merged
+              if "ts" in e and e.get("ph") != "M"]
+    assert ts_seq == sorted(ts_seq), f"{where}: not monotonic"
+    return pids
+
+
+def worker():
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    addr = os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"]
+    port = os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"]
+
+    for i in range(3):
+        hvd.allreduce(np.ones(1024, np.float32), name=f"ts.{i % 2}")
+    hvd.barrier()
+
+    # -- induced stall: rank 0 holds back past the warning time -------
+    if r == 0:
+        time.sleep(STALL_SECS + 2.0)
+    else:
+        handle = hvd.allreduce_async(np.ones(8, np.float32),
+                                     name=STALL_TENSOR)
+        # wait for the coordinator's stall broadcast to auto-dump the
+        # flight recorder here
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if _counter_total(hvd.metrics(),
+                              "horovod_trace_ring_dumps_total",
+                              reason="stall") >= 1:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("stall never auto-dumped the ring")
+        # job trace DURING the stall: the stalled tensor's lane exists
+        # only under THIS (punctual) rank's pid — the missing lane IS
+        # the straggler the stall warning names
+        merged = json.loads(_get(
+            f"http://{addr}:{port}/timeline?wait=10"))
+        _validate_merged(merged, "mid-stall /timeline")
+        lanes = {(e["pid"], e["args"]["name"]) for e in merged
+                 if e.get("name") == "thread_name"}
+        stall_pids = {p for (p, n) in lanes if STALL_TENSOR in n}
+        assert stall_pids == {r}, \
+            f"straggler lane attribution: {stall_pids} != {{{r}}}"
+    # rank 0 wakes and completes the stalled collective
+    if r == 0:
+        hvd.allreduce(np.ones(8, np.float32), name=STALL_TENSOR)
+    else:
+        hvd.synchronize(handle)
+    hvd.barrier()
+
+    # every worker (straggler included) auto-dumped on the stall
+    dumps = _counter_total(hvd.metrics(),
+                           "horovod_trace_ring_dumps_total",
+                           reason="stall")
+    assert dumps >= 1, f"worker {r}: stall dumps {dumps}"
+
+    if r == 0:
+        merged = json.loads(_get(
+            f"http://{addr}:{port}/timeline?wait=15"))
+        pids = _validate_merged(merged, "final /timeline")
+        print(f"job-wide /timeline OK: {len(merged)} events, "
+              f"pids {sorted(pids)}")
+    hvd.barrier()
+    hvd.shutdown()
+    print(f"worker {r} OK")
+
+
+def main():
+    if os.environ.get("TS_WORKER"):
+        worker()
+        return
+    import subprocess
+    import tempfile
+
+    from horovod_tpu.runner.proc_run import launch_procs
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tldir = tempfile.mkdtemp(prefix="hvd_trace_smoke_")
+    tl = os.path.join(tldir, "tl.json")
+    codes = launch_procs(
+        [sys.executable, os.path.abspath(__file__)], np=2,
+        platform="cpu",
+        env={"PYTHONPATH": repo, "TS_WORKER": "1",
+             "HOROVOD_TIMELINE": tl,
+             "HOROVOD_STALL_CHECK_TIME_SECONDS": str(STALL_SECS),
+             "HOROVOD_TRACE_CLOCK_SYNC_SECONDS": "2"},
+        start_timeout=240)
+    assert codes == [0, 0], f"worker exit codes {codes}"
+
+    # offline merge of the per-worker timeline FILES through the CLI
+    merged_path = os.path.join(tldir, "merged.json")
+    files = [tl, os.path.join(tldir, "tl.proc1.json")]
+    for f in files:
+        assert os.path.exists(f), f"missing worker timeline {f}"
+    subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "trace_merge.py"),
+         "-o", merged_path] + files, check=True)
+    merged = json.load(open(merged_path))
+    _validate_merged(merged, "tools/trace_merge.py")
+    print("TRACE SMOKE OK")
+
+
+if __name__ == "__main__":
+    main()

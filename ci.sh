@@ -12,6 +12,11 @@
 #                         #   /metrics, validate Prometheus families
 #   ./ci.sh trace         # smoke: 2-process job, merged GET /timeline
 #                         #   + trace_merge CLI + stall auto-dump
+#   ./ci.sh chaos         # smoke: real multi-process jobs under
+#                         #   seeded fault plans (kill, slow-rank,
+#                         #   coordinator 5xx, hang) with a hang
+#                         #   watchdog; asserts recovery, stall
+#                         #   attribution and same-seed determinism
 #   ./ci.sh bench         # smoke: one bench.py run (real chip if any)
 #   ./ci.sh all           # tiers 1-3 (what the round judge re-runs,
 #                         #   split in four parts to stay under per-
@@ -40,8 +45,8 @@ PART2="tests/test_elastic.py tests/test_examples.py \
   tests/test_ray_strategy.py tests/test_spark_streaming.py \
   tests/test_tensorflow.py"
 PART3="tests/test_parallel.py tests/test_torch.py"
-PART4="tests/test_api_parity.py tests/test_pallas.py \
-  tests/test_runner.py"
+PART4="tests/test_api_parity.py tests/test_chaos.py \
+  tests/test_pallas.py tests/test_runner.py"
 
 case "${1:-all}" in
   fast)
@@ -62,7 +67,17 @@ case "${1:-all}" in
     # fault injection, example smoke-runs (the reference's
     # test/integration + examples-in-CI role)
     python -m pytest tests/test_runner.py tests/test_elastic.py \
-      tests/test_examples.py -q -m integration
+      tests/test_chaos.py tests/test_examples.py -q -m integration
+    ;;
+  chaos)
+    # chaos tier (docs/fault_tolerance.md): seeded fault plans against
+    # REAL jobs — coordinator 5xx burst survives via backoff with
+    # identical fault sequences across two same-seed runs; an injected
+    # straggler gets stall-attributed by rank with a flight-recorder
+    # dump; a SIGKILLed worker recovers through elastic restart; a
+    # HUNG worker is declared dead by heartbeat liveness and reaped.
+    # Every scenario runs under a hard watchdog.
+    python tools/chaos_smoke.py
     ;;
   trace)
     # job-wide tracing smoke: a REAL 2-process job — merged GET
@@ -147,7 +162,7 @@ case "${1:-all}" in
     python -m pytest $PART4 -q
     ;;
   *)
-    echo "usage: $0 {fast|matrix|integration|trace|metrics|bench|all}" >&2
+    echo "usage: $0 {fast|matrix|integration|chaos|trace|metrics|bench|all}" >&2
     exit 2
     ;;
 esac

@@ -1,0 +1,20 @@
+"""Chaos subsystem: deterministic fault injection for the robustness
+surface (docs/fault_tolerance.md).
+
+* :mod:`.plan` — the seeded, declarative fault-plan schema
+  (``HOROVOD_FAULT_PLAN`` / ``horovodrun --fault-plan``);
+* :mod:`.inject` — the worker-side injector threading plans through
+  the real fabric client, engine loop and process lifecycle.
+
+Coordinator-side events (``"side": "coord"``) are installed by the
+launcher into its rendezvous service
+(runner/http/http_server.py ``Coordinator.add_chaos_rule``).
+"""
+
+from .plan import (  # noqa: F401
+    FaultEvent, FaultPlan, KINDS, load_plan, parse_plan, plan_from_env,
+)
+from .inject import (  # noqa: F401
+    FaultInjector, current, current_skew_seconds, install,
+    install_coordinator_rules,
+)

@@ -1,0 +1,302 @@
+"""Worker-side fault injection runtime.
+
+One :class:`FaultInjector` per worker process, installed by
+``hvd.init()`` when ``HOROVOD_FAULT_PLAN`` is set.  Faults strike the
+REAL code paths, not mocks:
+
+* **wire faults** (``drop`` / ``delay_ms`` / ``duplicate`` /
+  ``http_error``) ride the :class:`StoreClient` middleware hook —
+  they fire *before* the bytes leave the process, so the client's
+  retry/backoff machinery is what recovers, exactly as it would from
+  a flaky coordinator;
+* **slow_rank** rides the engine's background loop — the injector
+  sleeps right before ``report_ready``, so the coordinator's global
+  stall attribution and the stall-triggered flight recorder see a
+  genuine straggler;
+* **process faults** (``kill`` / ``exit`` / ``hang`` /
+  ``clock_skew``) are applied by whichever trigger matures first —
+  a fabric-request count, a collective count, or the wall-offset
+  chaos thread.  ``hang`` wedges the engine background thread AND
+  stops the liveness heartbeat, emulating a fully-stuck process the
+  coordinator must detect by missed beats.
+
+Trigger counters advance under one lock and every probabilistic
+decision draws from an RNG seeded by ``(plan seed, event index)``
+(:meth:`FaultPlan.rng_for`), so two runs of the same plan produce the
+identical fault sequence — ``fired`` records it for comparison.
+"""
+
+import logging
+import os
+import signal
+import threading
+import time
+
+from .plan import FaultEvent, FaultPlan, PROCESS_KINDS
+
+logger = logging.getLogger("horovod_tpu.chaos")
+
+
+def _count_injected(kind):
+    """Export the injection into the process-current registry
+    (``horovod_faults_injected_total{kind=...}``; the family lives in
+    telemetry) — resolved at fire time because the engine installs a
+    fresh registry per lifecycle."""
+    try:
+        from ..telemetry import count_fault_injected
+        count_fault_injected(kind)
+    except Exception:  # noqa: BLE001 — accounting must never mask the fault
+        pass
+
+
+class _EventState:
+    """Runtime arming state for one event on this process."""
+
+    __slots__ = ("event", "rng", "fires")
+
+    def __init__(self, event: FaultEvent, rng):
+        self.event = event
+        self.rng = rng
+        self.fires = 0
+
+    def due(self, n: float) -> bool:
+        """Whether the event fires at trigger point ``n`` (consumes
+        one RNG draw per eligible point when probabilistic)."""
+        e = self.event
+        if self.fires >= e.count or n < e.at:
+            return False
+        if e.p < 1.0 and self.rng.random() >= e.p:
+            return False
+        self.fires += 1
+        return True
+
+    @property
+    def exhausted(self):
+        return self.fires >= self.event.count
+
+
+class FaultInjector:
+    """Applies one plan's worker-side events on this process."""
+
+    def __init__(self, plan: FaultPlan, proc: int = 0,
+                 rank_offset: int = 0, num_local: int = 1):
+        self.plan = plan
+        self.proc = proc
+        self.rank_offset = rank_offset
+        self.num_local = num_local
+        self._lock = threading.Lock()
+        self._requests = 0
+        self._collectives = 0
+        self._epoch = time.monotonic()
+        self._skew_ms = 0.0
+        self._hang = threading.Event()
+        #: chronological record of fired events — the determinism
+        #: evidence two same-seed runs compare (tools/chaos_smoke.py)
+        self.fired = []
+        events = plan.worker_events(
+            proc, rank_offset, rank_offset + num_local)
+        self._by_trigger = {"requests": [], "collectives": [], "wall": []}
+        for e in events:
+            self._by_trigger[e.trigger].append(
+                _EventState(e, plan.rng_for(e)))
+        self._wall_thread = None
+        if self._by_trigger["wall"]:
+            self._wall_thread = threading.Thread(
+                target=self._wall_loop, name="horovod_tpu-chaos",
+                daemon=True)
+            self._wall_thread.start()
+
+    # -- state ---------------------------------------------------------------
+
+    @property
+    def hung(self):
+        """True once a ``hang`` event fired: the engine loop is wedged
+        and the heartbeat thread must stop beating (the whole point —
+        the coordinator's liveness scan has to notice)."""
+        return self._hang.is_set()
+
+    def skew_seconds(self):
+        """Active ``clock_skew`` offset (seconds) — added to the clock
+        estimator's measured offset (utils/clock_sync.py)."""
+        return self._skew_ms / 1000.0
+
+    def rebind(self, proc, rank_offset, num_local):
+        """Elastic re-init under the same process: retarget without
+        resetting counters — triggers count per process lifetime, so
+        the fault sequence stays deterministic across rounds."""
+        with self._lock:
+            self.proc = proc
+            self.rank_offset = rank_offset
+            self.num_local = num_local
+
+    # -- injection points ----------------------------------------------------
+
+    def before_request(self, method, path):
+        """StoreClient middleware hook: called before every fabric
+        request (retries included — each attempt is a real request).
+        Returns None or one wire action:
+        ``("drop",)`` | ``("delay", secs)`` | ``("duplicate",)`` |
+        ``("error", status)``."""
+        if self._hang.is_set():
+            self._park()
+        with self._lock:
+            self._requests += 1
+            n = self._requests
+            due = [st.event for st in self._by_trigger["requests"]
+                   if st.due(n)]
+        return self._apply(due, "requests", n, wire=True)
+
+    def on_collectives(self, n_entries=1):
+        """Engine background-loop hook: called with the number of
+        entries about to be reported ready.  Sleeps here — before
+        ``report_ready`` — when a ``slow_rank`` event matures, turning
+        this process into the straggler the coordinator attributes."""
+        for _ in range(max(int(n_entries), 1)):
+            with self._lock:
+                self._collectives += 1
+                n = self._collectives
+                due = [st.event for st in self._by_trigger["collectives"]
+                       if st.due(n)]
+            self._apply(due, "collectives", n)
+
+    # -- application ---------------------------------------------------------
+
+    def _record(self, event: FaultEvent, trigger, n):
+        entry = {"kind": event.kind, "event": event.index,
+                 "trigger": trigger, "n": n}
+        with self._lock:
+            self.fired.append(entry)
+        _count_injected(event.kind)
+        logger.warning("chaos: injecting %s (event #%d, %s=%s, proc %d)",
+                       event.kind, event.index, trigger, n, self.proc)
+
+    def _apply(self, events, trigger, n, wire=False):
+        """Fire matured events.  Process faults apply immediately; in
+        a wire context (``before_request``) at most one wire action is
+        returned, with delays stacked onto it — elsewhere delays sleep
+        inline and the wire-only kinds (drop/duplicate/http_error,
+        which only make sense against a request) are recorded but
+        inert: plans should trigger those on ``after_requests``."""
+        action = None
+        delay = 0.0
+        for e in events:
+            self._record(e, trigger, n)
+            if e.kind == "kill":
+                os.kill(os.getpid(), signal.SIGKILL)
+            elif e.kind == "exit":
+                os._exit(e.code)
+            elif e.kind == "hang":
+                self._hang.set()
+                self._park()
+            elif e.kind == "clock_skew":
+                self._skew_ms += e.ms
+            elif e.kind == "slow_rank":
+                time.sleep(e.ms / 1000.0)
+            elif e.kind == "delay_ms":
+                delay += e.ms / 1000.0
+            elif wire and action is None:   # drop/duplicate/http_error
+                if e.kind == "drop":
+                    action = ("drop",)
+                elif e.kind == "duplicate":
+                    action = ("duplicate",)
+                else:
+                    action = ("error", e.code)
+        if delay:
+            if not wire or action is not None:
+                time.sleep(delay)       # inline (or delayed AND failed)
+            else:
+                action = ("delay", delay)
+        return action
+
+    def _park(self):
+        """Simulated full-process hang: this thread blocks forever.
+        The heartbeat thread observes :attr:`hung` and stops beating,
+        so the ONLY way out is the coordinator declaring this worker
+        dead and the elastic driver reaping the process."""
+        threading.Event().wait()
+
+    def _wall_loop(self):
+        states = sorted(self._by_trigger["wall"],
+                        key=lambda st: st.event.at)
+        for st in states:
+            while not st.exhausted:
+                dt = self._epoch + st.event.at - time.monotonic()
+                if dt > 0:
+                    time.sleep(dt)
+                secs = time.monotonic() - self._epoch
+                if st.due(secs):
+                    self._apply([st.event], "wall", round(secs, 3))
+                else:
+                    # probabilistic skip: redraw shortly — request/
+                    # collective triggers redraw at every later
+                    # trigger point, so the wall trigger must too
+                    # (``break`` would abandon the event after one
+                    # failed coin flip)
+                    time.sleep(0.05)
+
+
+# -- process-wide installation -------------------------------------------------
+
+_INSTALLED = None
+_INSTALL_LOCK = threading.Lock()
+
+
+def install(plan: FaultPlan, proc=0, rank_offset=0, num_local=1,
+            client=None):
+    """Install (or rebind) the process-wide injector and hook it into
+    the fabric client.  Idempotent per process: an elastic re-init
+    retargets the existing injector so trigger counters — and with
+    them the deterministic fault sequence — span the whole process
+    lifetime."""
+    global _INSTALLED
+    with _INSTALL_LOCK:
+        if _INSTALLED is None:
+            _INSTALLED = FaultInjector(plan, proc=proc,
+                                       rank_offset=rank_offset,
+                                       num_local=num_local)
+        else:
+            _INSTALLED.rebind(proc, rank_offset, num_local)
+        if client is not None:
+            client.middleware = _INSTALLED
+        return _INSTALLED
+
+
+def current():
+    """The process-wide injector, or None."""
+    return _INSTALLED
+
+
+def current_skew_seconds():
+    """Injected clock skew (seconds); 0.0 without an active injector.
+    Consumed by utils/clock_sync.py so skew scenarios flow through the
+    real trace-merge alignment path."""
+    inj = _INSTALLED
+    return inj.skew_seconds() if inj is not None else 0.0
+
+
+def install_coordinator_rules(coordinator, env=None):
+    """Install a plan's ``side: "coord"`` events into a launcher's
+    coordinator (runner/http/http_server.py Coordinator) so the server
+    itself rejects or stalls chosen procs' requests.  Reads
+    ``HOROVOD_FAULT_PLAN`` from ``env``; returns the number of rules
+    installed (0 when no plan / no coordinator-side events)."""
+    from .plan import plan_from_env
+    plan = plan_from_env(env)
+    if plan is None:
+        return 0
+    rules = plan.coordinator_rules()
+    for e in rules:
+        coordinator.add_chaos_rule(
+            e.kind, proc=e.proc, verb=e.verb, after=e.at,
+            count=e.count, code=e.code, ms=e.ms, p=e.p,
+            rng=plan.rng_for(e))
+    if rules:
+        logger.warning("chaos: %d coordinator-side fault rule(s) "
+                       "installed", len(rules))
+    return len(rules)
+
+
+def _reset_for_tests():
+    global _INSTALLED
+    with _INSTALL_LOCK:
+        _INSTALLED = None

@@ -1,0 +1,240 @@
+"""Declarative, seeded fault plans.
+
+A fault plan is a JSON document describing *when* and *where* faults
+strike a running job, so the robustness surface — elastic restart,
+host blacklisting, stall attribution, the flight recorder, fabric
+retries — can be exercised deterministically in CI instead of waiting
+for real pod preemptions (the failure mode arXiv:1909.09756 reports
+MLPerf-scale jobs must survive).  Horovod's claim that fault tolerance
+falls out of elastic re-rendezvous (arXiv:1802.05799; SURVEY §5.4) is
+only credible if a checked-in plan can prove it on demand.
+
+Schema (``HOROVOD_FAULT_PLAN`` — inline JSON, ``@/path``, or a bare
+path to a JSON file; ``horovodrun --fault-plan`` forwards it)::
+
+    {
+      "seed": 1234,                  # shared RNG seed (default 0)
+      "events": [
+        {"kind": "kill",       "proc": 1, "after_collectives": 3},
+        {"kind": "exit",       "proc": 0, "code": 3, "after_s": 5.0},
+        {"kind": "hang",       "proc": 1, "after_requests": 40},
+        {"kind": "slow_rank",  "rank": 1, "ms": 2500,
+                               "after_collectives": 2, "count": 1},
+        {"kind": "drop",       "proc": 0, "after_requests": 10,
+                               "count": 2},
+        {"kind": "delay_ms",   "proc": 0, "ms": 200,
+                               "after_requests": 5, "count": 4},
+        {"kind": "duplicate",  "proc": 0, "after_requests": 7},
+        {"kind": "http_error", "proc": 0, "code": 503,
+                               "after_requests": 8, "count": 3},
+        {"kind": "http_error", "side": "coord", "proc": 0,
+                               "verb": "poll", "code": 503,
+                               "after": 5, "count": 3},
+        {"kind": "clock_skew", "proc": 1, "ms": 5000, "after_s": 2.0}
+      ]
+    }
+
+Every event names exactly one trigger — ``after_requests`` (the n-th
+fabric request this process issues), ``after_collectives`` (the n-th
+collective this process reports ready), or ``after_s`` (wall-clock
+offset from injector install) — plus a target (``proc`` index, or
+``rank`` for ``slow_rank``; terminal kinds require an explicit target
+so a sloppy plan cannot kill every process at once).  ``count`` fires
+the event on that many consecutive trigger points (default 1);
+``p`` gates each firing on a coin flip drawn from an RNG seeded by
+``(seed, event index)``, so two runs of the same plan make identical
+fire/skip decisions — the determinism contract ``ci.sh chaos``
+asserts.
+
+Events with ``"side": "coord"`` are applied by the *launcher* to its
+coordinator instead of by workers: they reject (``http_error``) or
+stall (``delay_ms``) a chosen proc's coordinator requests server-side
+(``after`` counts that proc's matching requests).  See
+docs/fault_tolerance.md for the full scenario → expected-behavior
+matrix.
+"""
+
+import json
+import os
+import random
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+#: Worker-side fault kinds, by injection point.
+PROCESS_KINDS = ("kill", "exit", "hang", "clock_skew")
+WIRE_KINDS = ("drop", "delay_ms", "duplicate", "http_error")
+ENGINE_KINDS = ("slow_rank",)
+KINDS = PROCESS_KINDS + WIRE_KINDS + ENGINE_KINDS
+
+#: Trigger spellings -> canonical trigger name.
+_TRIGGERS = {"after_requests": "requests",
+             "after_collectives": "collectives",
+             "after_s": "wall",
+             # coordinator-side rules count matching requests
+             "after": "requests"}
+
+
+@dataclass
+class FaultEvent:
+    """One scheduled fault (see module docstring for the schema)."""
+
+    index: int                      # position in the plan (RNG stream id)
+    kind: str
+    trigger: str                    # requests | collectives | wall
+    at: float                       # trigger threshold (count or seconds)
+    proc: Optional[int] = None      # target process index (None = any)
+    rank: Optional[int] = None      # target global rank (slow_rank)
+    verb: Optional[str] = None      # coordinator-side verb filter
+    code: int = 503                 # exit status / HTTP status
+    ms: float = 0.0                 # delay / skew magnitude
+    count: int = 1                  # consecutive trigger points to fire on
+    p: float = 1.0                  # per-firing probability (seeded RNG)
+    side: str = "worker"            # worker | coord
+
+
+@dataclass
+class FaultPlan:
+    """Parsed, validated plan.  ``events`` keep their JSON order; the
+    order is the RNG-stream identity, so editing a plan reshuffles
+    only the edited events' randomness."""
+
+    seed: int = 0
+    events: List[FaultEvent] = field(default_factory=list)
+
+    def worker_events(self, proc: int, rank_lo: int = 0,
+                      rank_hi: int = 0) -> List[FaultEvent]:
+        """Events this worker process must inject: worker-side events
+        targeting its proc index, or (for rank-targeted events) a
+        global rank inside [rank_lo, rank_hi)."""
+        out = []
+        for e in self.events:
+            if e.side != "worker":
+                continue
+            if e.rank is not None:
+                if rank_lo <= e.rank < rank_hi:
+                    out.append(e)
+            elif e.proc is None or e.proc == proc:
+                out.append(e)
+        return out
+
+    def coordinator_rules(self) -> List[FaultEvent]:
+        """Events the launcher installs into its coordinator."""
+        return [e for e in self.events if e.side == "coord"]
+
+    def rng_for(self, event: FaultEvent) -> random.Random:
+        """The event's private RNG stream — a pure function of
+        (plan seed, event index), so every process and every run draws
+        the same sequence for the same event."""
+        return random.Random(f"{self.seed}:{event.index}")
+
+
+def _parse_event(index: int, raw: dict) -> FaultEvent:
+    if not isinstance(raw, dict):
+        raise ValueError(f"fault event #{index} is not an object: {raw!r}")
+    kind = raw.get("kind")
+    if kind not in KINDS:
+        raise ValueError(
+            f"fault event #{index}: unknown kind {kind!r} "
+            f"(valid: {', '.join(KINDS)})")
+    side = raw.get("side", "worker")
+    if side not in ("worker", "coord"):
+        raise ValueError(
+            f"fault event #{index}: side must be 'worker' or 'coord', "
+            f"got {side!r}")
+    if side == "coord" and kind not in ("http_error", "delay_ms"):
+        raise ValueError(
+            f"fault event #{index}: coordinator-side events support "
+            f"http_error (reject) and delay_ms (stall), not {kind}")
+    triggers = [k for k in _TRIGGERS if k in raw]
+    if len(triggers) != 1:
+        raise ValueError(
+            f"fault event #{index} ({kind}): exactly one trigger of "
+            f"{sorted(_TRIGGERS)} required, got {triggers or 'none'}")
+    trig_key = triggers[0]
+    at = float(raw[trig_key])
+    if at < 0:
+        raise ValueError(
+            f"fault event #{index}: trigger {trig_key} must be >= 0")
+    proc = raw.get("proc")
+    rank = raw.get("rank")
+    if kind == "slow_rank":
+        if rank is None and proc is None:
+            raise ValueError(
+                f"fault event #{index}: slow_rank needs 'rank' "
+                f"(global rank) or 'proc'")
+        if not raw.get("ms"):
+            raise ValueError(
+                f"fault event #{index}: slow_rank needs 'ms' > 0")
+    if kind in ("kill", "exit", "hang") and proc is None and rank is None:
+        # terminal faults must name their victim explicitly — an
+        # untargeted kill would take down every process at once and the
+        # "recovery" scenario under test with it
+        raise ValueError(
+            f"fault event #{index}: {kind} requires an explicit "
+            f"'proc' target")
+    p = float(raw.get("p", 1.0))
+    if not 0.0 < p <= 1.0:
+        raise ValueError(
+            f"fault event #{index}: p must be in (0, 1], got {p}")
+    count = int(raw.get("count", 1))
+    if count < 1:
+        raise ValueError(f"fault event #{index}: count must be >= 1")
+    return FaultEvent(
+        index=index, kind=kind,
+        trigger=_TRIGGERS[trig_key], at=at,
+        proc=int(proc) if proc is not None else None,
+        rank=int(rank) if rank is not None else None,
+        verb=raw.get("verb"),
+        code=int(raw.get("code", 503 if kind == "http_error" else 1)),
+        ms=float(raw.get("ms", 0.0)),
+        count=count, p=p, side=side)
+
+
+def parse_plan(doc, seed_override=None) -> FaultPlan:
+    """Parse a plan from a dict or JSON string."""
+    if isinstance(doc, (str, bytes)):
+        doc = json.loads(doc)
+    if not isinstance(doc, dict):
+        raise ValueError(f"fault plan must be a JSON object, got "
+                         f"{type(doc).__name__}")
+    seed = int(doc.get("seed", 0)) if seed_override is None \
+        else int(seed_override)
+    events = [_parse_event(i, e)
+              for i, e in enumerate(doc.get("events", []))]
+    return FaultPlan(seed=seed, events=events)
+
+
+def read_plan_source(source: str) -> str:
+    """Resolve a plan source to its JSON text: inline JSON (leading
+    ``{``), ``@/path``, or a bare file path.  THE one definition of
+    what ``HOROVOD_FAULT_PLAN`` / ``--fault-plan`` may contain — the
+    launcher uses it too, to inline file contents into the env handoff
+    for ssh workers."""
+    text = source.strip()
+    if text.startswith("@"):
+        with open(text[1:]) as f:
+            return f.read()
+    if not text.startswith("{") and os.path.exists(text):
+        with open(text) as f:
+            return f.read()
+    return text
+
+
+def load_plan(source: str, seed_override=None) -> FaultPlan:
+    """Load a plan from inline JSON, ``@/path``, or a bare file path."""
+    return parse_plan(read_plan_source(source),
+                      seed_override=seed_override)
+
+
+def plan_from_env(env=None) -> Optional[FaultPlan]:
+    """The plan named by ``HOROVOD_FAULT_PLAN`` (+ optional
+    ``HOROVOD_FAULT_SEED`` override), or None when unset.  A malformed
+    plan raises — silently dropping the faults a test scheduled would
+    make that test pass vacuously."""
+    env = os.environ if env is None else env
+    raw = env.get("HOROVOD_FAULT_PLAN")
+    if not raw or not str(raw).strip():
+        return None
+    seed = env.get("HOROVOD_FAULT_SEED")
+    return load_plan(str(raw),
+                     seed_override=int(seed) if seed else None)

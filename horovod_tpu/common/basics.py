@@ -319,6 +319,23 @@ def init(comm=None, process_sets=None, num_ranks=None, devices=None):
             platform = env_mod.get_str(env_mod.HOROVOD_TPU_PLATFORM)
             devices = jax.devices(platform) if platform else jax.devices()
         config = env_mod.Config()
+        # chaos fault injection (docs/fault_tolerance.md): parse the
+        # plan BEFORE the engine exists so request-count triggers see
+        # every fabric request, and hook the injector into the
+        # controller's client (wire faults) + the engine (slow-rank).
+        # A malformed plan raises here — a chaos test whose faults
+        # silently failed to install would pass vacuously.
+        chaos_injector = None
+        if config.fault_plan:
+            from .. import chaos as chaos_mod
+            plan = chaos_mod.plan_from_env()
+            if plan is not None and plan.events:
+                chaos_injector = chaos_mod.install(
+                    plan,
+                    proc=controller.proc_id if controller else 0,
+                    rank_offset=rank_offset,
+                    num_local=num_ranks,
+                    client=controller.client if controller else None)
         # each process records its own local ranks; the rank-0 process
         # keeps the user's HOROVOD_TIMELINE path (reference
         # docs/timeline.rst names rank 0's file) and the others write
@@ -334,7 +351,8 @@ def init(comm=None, process_sets=None, num_ranks=None, devices=None):
                          topology=_topology, timeline=_timeline,
                          controller=controller, rank_offset=rank_offset,
                          global_size=global_size,
-                         ranks_of_proc=ranks_of_proc)
+                         ranks_of_proc=ranks_of_proc,
+                         chaos=chaos_injector)
         # telemetry surface: per-worker exposition endpoint + elastic
         # resize accounting (the engine just installed this round's
         # fresh registry)

@@ -61,6 +61,22 @@ HOROVOD_TRACE_RING_EVENTS = "HOROVOD_TRACE_RING_EVENTS"
 HOROVOD_TRACE_DUMP_DIR = "HOROVOD_TRACE_DUMP_DIR"
 HOROVOD_TRACE_CLOCK_SYNC_SECONDS = "HOROVOD_TRACE_CLOCK_SYNC_SECONDS"
 
+# chaos + liveness + fabric hardening (docs/fault_tolerance.md):
+# HOROVOD_FAULT_PLAN names a seeded fault plan (inline JSON, @path, or
+# a bare file path; horovodrun --fault-plan); HOROVOD_FAULT_SEED
+# overrides the plan's seed.  Workers beat the coordinator every
+# HEARTBEAT_INTERVAL seconds (0 disables); the coordinator declares a
+# proc dead after HEARTBEAT_WINDOW seconds without a beat (0 = 1.5x
+# the interval — detection inside 2x the interval).  Fabric retries
+# are bounded by attempts AND a wall deadline.
+HOROVOD_FAULT_PLAN = "HOROVOD_FAULT_PLAN"
+HOROVOD_FAULT_SEED = "HOROVOD_FAULT_SEED"
+HOROVOD_HEARTBEAT_INTERVAL_SECONDS = "HOROVOD_HEARTBEAT_INTERVAL_SECONDS"
+HOROVOD_HEARTBEAT_WINDOW_SECONDS = "HOROVOD_HEARTBEAT_WINDOW_SECONDS"
+HOROVOD_FABRIC_RETRY_ATTEMPTS = "HOROVOD_FABRIC_RETRY_ATTEMPTS"
+HOROVOD_FABRIC_RETRY_DEADLINE_SECONDS = \
+    "HOROVOD_FABRIC_RETRY_DEADLINE_SECONDS"
+
 # TPU-native additions
 HOROVOD_WIRE_DTYPE = "HOROVOD_WIRE_DTYPE"      # f32 | fp16 | bf16 | int8
 # flat | hierarchical | torus (generic spelling; the reference's
@@ -239,3 +255,12 @@ class Config:
         # in-flight collectives on the set
         self.ps_removal_timeout_secs = get_float(
             HOROVOD_PROCESS_SET_REMOVAL_TIMEOUT, 60.0)
+        # worker liveness (docs/fault_tolerance.md): heartbeat cadence
+        # to the coordinator in multi-process jobs; 0 disables.  The
+        # coordinator's death window rides autotune_kwargs from the
+        # same env so both sides agree.
+        self.heartbeat_secs = get_float(
+            HOROVOD_HEARTBEAT_INTERVAL_SECONDS, 5.0)
+        # chaos fault plan (raw source; parsed by chaos.plan_from_env
+        # at init so a malformed plan fails loudly, not silently)
+        self.fault_plan = get_str(HOROVOD_FAULT_PLAN)

@@ -119,7 +119,7 @@ class Engine:
 
     def __init__(self, num_ranks, devices, config=None, topology=None,
                  timeline=None, controller=None, rank_offset=0,
-                 global_size=None, ranks_of_proc=None):
+                 global_size=None, ranks_of_proc=None, chaos=None):
         from ..ops.xla_ops import MeshExecutor
 
         self.config = config or env_mod.Config()
@@ -197,6 +197,14 @@ class Engine:
         self._start_metrics_push()
         self._clock_sync = None
         self._start_clock_sync()
+        #: chaos fault injector (chaos/inject.py FaultInjector): the
+        #: background loop calls its on_collectives hook right before
+        #: report_ready, so slow-rank scenarios delay exactly the
+        #: report the coordinator's stall attribution watches
+        self.chaos = chaos
+        self._hb_stop = threading.Event()
+        self._hb_thread = None
+        self._start_heartbeat()
         self._thread = threading.Thread(
             target=self._background_loop, name="horovod_tpu-engine",
             daemon=True)
@@ -304,6 +312,18 @@ class Engine:
         m.counter("horovod_elastic_resize_events_total",
                   "Elastic membership changes seen by this worker",
                   labelnames=("direction",))
+        # fabric/chaos/liveness families (docs/fault_tolerance.md):
+        # retries are counted by the StoreClient, injections by the
+        # chaos injector, and worker_alive is set by the heartbeat
+        # thread (the coordinator's /metrics adds its authoritative
+        # per-proc view, including the 0 a dead worker can't push)
+        m.counter(telemetry.FABRIC_RETRIES_FAMILY,
+                  telemetry.FABRIC_RETRIES_HELP, labelnames=("verb",))
+        m.counter(telemetry.FAULTS_INJECTED_FAMILY,
+                  telemetry.FAULTS_INJECTED_HELP, labelnames=("kind",))
+        self._m_alive = m.gauge(
+            telemetry.WORKER_ALIVE_FAMILY, telemetry.WORKER_ALIVE_HELP,
+            labelnames=("proc",))
         ws = m.gauge("horovod_world_size", "Global number of ranks")
         ws.set(self.global_size)
 
@@ -356,6 +376,68 @@ class Engine:
         self._clock_sync = ClockSync(
             lambda: self.timeline, self.controller.client,
             interval=secs).start()
+
+    # ------------------------------------------------------------------
+    # worker liveness (docs/fault_tolerance.md "Liveness")
+
+    def _start_heartbeat(self):
+        """Multi-process jobs beat the coordinator's ``heartbeat``
+        verb from a dedicated thread (NOT the background loop — a
+        wedged dispatch loop must still be seen as alive only while
+        the process itself is healthy; a chaos ``hang`` wedges both,
+        which is exactly what the coordinator must detect)."""
+        if not self.multiproc:
+            return
+        secs = getattr(self.config, "heartbeat_secs", 0.0)
+        if secs <= 0:
+            return
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, args=(secs,),
+            name="horovod_tpu-heartbeat", daemon=True)
+        self._hb_thread.start()
+
+    def _heartbeat_loop(self, interval):
+        ranks = list(self._local_global_ranks())
+        host = env_mod.get_str(env_mod.HOROVOD_HOSTNAME)
+        alive = self._m_alive.labels(proc=str(self.controller.proc_id))
+        while not self._hb_stop.is_set():
+            if self.chaos is not None and self.chaos.hung:
+                # simulated full-process hang: stop beating so the
+                # coordinator's liveness scan declares us dead
+                return
+            try:
+                dead = self.controller.heartbeat(ranks=ranks, host=host)
+                alive.set(1)
+                if dead:
+                    # the coordinator already failed our peers'
+                    # collectives on our behalf (a hang that woke up,
+                    # a partition that healed): computing on would
+                    # diverge from the job — abort into the elastic
+                    # recovery path instead
+                    alive.set(0)
+                    self.abort(HorovodInternalError(
+                        "coordinator declared this worker dead after "
+                        "missed heartbeats"))
+                    return
+            except Exception:  # noqa: BLE001 — coordinator restart or
+                # teardown; the fabric client already retried with
+                # backoff, so just beat again next interval
+                pass
+            self._hb_stop.wait(interval)
+
+    def _stop_heartbeat(self):
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2.0)
+            self._hb_thread = None
+            if not (self.chaos is not None and self.chaos.hung) \
+                    and self._aborted is None:
+                # clean shutdown: deregister so an elastic teardown is
+                # never mistaken for a death
+                try:
+                    self.controller.heartbeat(bye=True)
+                except Exception:  # noqa: BLE001 — coordinator gone
+                    pass
 
     def dump_trace(self, path=None, reason="manual", dump_id=None):
         """Dump the flight-recorder ring: push it over the KV fabric
@@ -804,6 +886,10 @@ class Engine:
                 self._store_cycle(work)
             else:
                 for ps, batch in work:
+                    if self.chaos is not None:
+                        # single-process twin of the store-cycle hook:
+                        # slow-rank faults delay dispatch here
+                        self.chaos.on_collectives(len(batch))
                     self._execute_batch(ps, batch)
             if work:
                 # idle cycles are just the wait timeout expiring; only
@@ -1072,6 +1158,13 @@ class Engine:
                     metas.append(meta)
                     continue
                 metas.append(self._meta_for(ps, entry))
+        if self.chaos is not None and metas:
+            # chaos slow_rank injection point: sleeping HERE — after
+            # the entries went locally ready, before report_ready —
+            # makes this process the straggler the coordinator's
+            # global stall attribution names and the stall-triggered
+            # flight recorder captures (docs/fault_tolerance.md)
+            self.chaos.on_collectives(len(metas))
         try:
             if metas:
                 self.controller.report_ready(metas)
@@ -1192,6 +1285,23 @@ class Engine:
                     resp.get("missing_procs", []))
                 self._m_stall_warn.labels(
                     ranks=self._stall_ranks_label(missing)).inc()
+        elif kind == "dead":
+            # coordinator liveness verdict: a peer process missed its
+            # heartbeats.  A dead peer dooms every collective it
+            # belongs to, so treat it exactly like an observed peer
+            # failure: abort — every pending AND future handle fails
+            # NOW with an error naming the dead global ranks (fast
+            # explicit failure instead of stall-timeout limbo), and
+            # elastic workers take the exec-restart recovery path a
+            # peer death requires (docs/fault_tolerance.md).  The
+            # coordinator's per-key error responses, applied above in
+            # log order, already failed the entries it knew about.
+            msg = resp.get("message") or (
+                f"worker process {resp.get('proc')} hosting global "
+                f"ranks {resp.get('ranks') or []} declared dead "
+                f"after missed heartbeats")
+            logger.warning("%s; failing pending collectives", msg)
+            self.abort(HorovodInternalError(msg))
         elif kind == "trace_dump":
             # coordinator-requested flight-recorder dump (stall
             # auto-dump, POST /trace/dump, GET /timeline): push the
@@ -1970,6 +2080,11 @@ class Engine:
                 ev.set()
             self._lock.notify_all()
         self._shutdown_done.wait(timeout=30)
+        if self.multiproc:
+            # stop beating (with a goodbye) BEFORE the controller's
+            # fabric goes away, so a clean teardown never reads as a
+            # missed-heartbeat death
+            self._stop_heartbeat()
         if self._clock_sync is not None:
             self._clock_sync.stop()
             self._clock_sync = None

@@ -131,6 +131,29 @@ class StoreController:
             self._reported.discard(key)
             self._suppressed.pop(key, None)
 
+    def heartbeat(self, ranks=None, host=None, bye=False):
+        """Liveness beat to the coordinator (docs/fault_tolerance.md):
+        carries the global ranks this process hosts (so a later death
+        is attributed to ranks, not just a proc index) and the
+        hostname (so the elastic driver can blacklist the host).
+        ``bye=True`` deregisters on clean shutdown.  Returns True if
+        the coordinator has declared THIS process dead — the caller
+        must abort and restart rather than keep computing against
+        peers whose collectives were already failed."""
+        payload = {"proc": self.proc_id, "round": self.round_id,
+                   "sid": self._sid}
+        if ranks is not None:
+            payload["ranks"] = list(ranks)
+        if host:
+            payload["host"] = host
+        if bye:
+            payload["bye"] = True
+        out = self.client.coord("heartbeat", payload, timeout=5.0)
+        if out.get("stale"):
+            raise StaleRoundError(
+                f"coordinator moved to round {out.get('round')}")
+        return bool(out.get("dead"))
+
     def report_join(self, ps_id, rank, ps_size, proc_members=1):
         with self._lock:
             self._jid += 1

@@ -18,6 +18,10 @@ HOROVOD_METRICS_PUSH_SECONDS = "HOROVOD_METRICS_PUSH_SECONDS"
 HOROVOD_TRACE_RING_EVENTS = "HOROVOD_TRACE_RING_EVENTS"
 HOROVOD_TRACE_DUMP_DIR = "HOROVOD_TRACE_DUMP_DIR"
 HOROVOD_TRACE_CLOCK_SYNC_SECONDS = "HOROVOD_TRACE_CLOCK_SYNC_SECONDS"
+HOROVOD_FAULT_PLAN = "HOROVOD_FAULT_PLAN"
+HOROVOD_FAULT_SEED = "HOROVOD_FAULT_SEED"
+HOROVOD_HEARTBEAT_INTERVAL_SECONDS = "HOROVOD_HEARTBEAT_INTERVAL_SECONDS"
+HOROVOD_HEARTBEAT_WINDOW_SECONDS = "HOROVOD_HEARTBEAT_WINDOW_SECONDS"
 HOROVOD_STALL_CHECK_DISABLE = "HOROVOD_STALL_CHECK_DISABLE"
 HOROVOD_STALL_CHECK_TIME_SECONDS = "HOROVOD_STALL_CHECK_TIME_SECONDS"
 HOROVOD_STALL_SHUTDOWN_TIME_SECONDS = "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"
@@ -75,6 +79,19 @@ def set_env_from_args(env: dict, args) -> dict:
     if getattr(args, "metrics_push_seconds", None) is not None:
         env[HOROVOD_METRICS_PUSH_SECONDS] = str(
             args.metrics_push_seconds)
+    if getattr(args, "fault_plan", None):
+        # inline the file contents so remote workers (env-over-ssh)
+        # don't need the plan on their filesystem
+        from ..chaos.plan import read_plan_source
+        env[HOROVOD_FAULT_PLAN] = read_plan_source(args.fault_plan)
+    if getattr(args, "fault_seed", None) is not None:
+        env[HOROVOD_FAULT_SEED] = str(args.fault_seed)
+    if getattr(args, "heartbeat_interval_seconds", None) is not None:
+        env[HOROVOD_HEARTBEAT_INTERVAL_SECONDS] = str(
+            args.heartbeat_interval_seconds)
+    if getattr(args, "heartbeat_window_seconds", None) is not None:
+        env[HOROVOD_HEARTBEAT_WINDOW_SECONDS] = str(
+            args.heartbeat_window_seconds)
     setb(HOROVOD_STALL_CHECK_DISABLE,
          getattr(args, "no_stall_check", False))
     if getattr(args, "stall_check_warning_time_seconds", None) is not None:

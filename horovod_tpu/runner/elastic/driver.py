@@ -72,6 +72,10 @@ class ElasticDriver:
         self._procs: Dict[str, subprocess.Popen] = {}  # "host:slot" -> p
         self._deassigned: Dict[str, float] = {}        # key -> deadline
         self._churn_respawns: Dict[str, int] = {}
+        # procs the coordinator's liveness scan declared dead that the
+        # monitor already acted on this round (missed-heartbeat feed —
+        # catches HUNG workers that never exit; docs/fault_tolerance)
+        self._dead_handled: set = set()
         self._notify_version = 0
         # committed worker state spills here so crash recovery can
         # restore it across process restarts
@@ -205,6 +209,7 @@ class ElasticDriver:
                        assignments=dict(self._assignments))
             self._round_started_at = time.monotonic()
             self._churn_respawns.clear()
+            self._dead_handled.clear()
             # spawn processes for slots without a live worker
             for key in self._assignments:
                 p = self._procs.get(key)
@@ -436,6 +441,38 @@ class ElasticDriver:
                                        key, code)
                         self._registry.record_failure(host, int(slot))
                         failed_hosts.append(host)
+                # coordinator liveness feed: a proc whose heartbeats
+                # stopped but whose PROCESS never exited (hung worker,
+                # network partition) would otherwise survive until the
+                # stall timeout — reap it, fail its slot, blacklist
+                # its host, exactly like an observed exit
+                for proc, info in \
+                        self._server.coordinator.dead_procs().items():
+                    if proc in self._dead_handled:
+                        continue
+                    self._dead_handled.add(proc)
+                    key = next((k for k, r in self._assignments.items()
+                                if r == proc), None)
+                    if key is None:
+                        continue
+                    p = self._procs.get(key)
+                    if p is None or p.poll() is not None:
+                        # the process also EXITED: the exit-code path
+                        # above owns that failure — recording it here
+                        # too would double-count one death
+                        continue
+                    host, slot = key.rsplit(":", 1)
+                    logger.warning(
+                        "worker %s (proc %d, global ranks %s) missed "
+                        "heartbeats; treating as failed", key, proc,
+                        info.get("ranks") or "unknown")
+                    self._procs.pop(key, None)
+                    p.kill()            # a hung process never exits
+                    self._emit("worker_dead", host=host,
+                               slot=int(slot), round=self._round,
+                               ranks=info.get("ranks") or [])
+                    self._registry.record_failure(host, int(slot))
+                    failed_hosts.append(host)
             if failed_hosts and not self._shutdown.is_set() and \
                     self._registry.last_rendezvous() == rid_before:
                 # a failure mid-run must not wait for survivors to

@@ -40,6 +40,12 @@ def run_elastic(args):
     at_env.update(env)
     server = RendezvousServer(secret=bytes.fromhex(secret_hex),
                               world_size=0, **autotune_kwargs(at_env))
+    if at_env.get("HOROVOD_FAULT_PLAN"):
+        # coordinator-side fault-plan events (side="coord") install
+        # into the elastic rendezvous service too; rules persist
+        # across round resets (docs/fault_tolerance.md)
+        from ..chaos import install_coordinator_rules
+        install_coordinator_rules(server.coordinator, at_env)
     server.start()
     cooldown = tuple(args.blacklist_cooldown_range) \
         if args.blacklist_cooldown_range else None

@@ -4,20 +4,55 @@
 Connections are persistent (HTTP/1.1 keep-alive, one per thread): the
 store-mode hot path issues a ready-POST and a poll per negotiation
 cycle, and a fresh TCP handshake per request would dominate small-op
-latency.  A dropped/stale connection transparently reconnects once.
+latency.
+
+Transient fabric failures — dropped keep-alives, a coordinator
+restarting, a 5xx burst — retry with bounded exponential backoff +
+jitter (``HOROVOD_FABRIC_RETRY_ATTEMPTS`` /
+``HOROVOD_FABRIC_RETRY_DEADLINE_SECONDS``); every retry is counted in
+``horovod_fabric_retries_total{verb}``.  A ``TimeoutError`` is retried
+only on the verbs whose server-side handling is deduplicated by a
+client-supplied id (ready/join via rid/jid, heartbeat naturally
+idempotent) — replaying anything else could double-deliver.  The
+chaos subsystem's fault middleware (chaos/inject.py) hooks in right
+before the wire, so injected faults exercise exactly this machinery.
 """
 
 import hashlib
 import hmac
 import http.client
 import json
+import os
+import random
 import threading
+import time
 
 
 class _HTTPError(Exception):
     def __init__(self, code, msg=""):
         super().__init__(f"HTTP {code} {msg}")
         self.code = code
+
+
+class _DroppedRequest(ConnectionError):
+    """Chaos middleware swallowed the request before the wire — the
+    client-visible symptom of a lost packet/connection."""
+
+
+#: Verbs whose POSTs the coordinator deduplicates on a client id
+#: (rid/jid) or that are naturally idempotent — the only verbs where
+#: retrying a TIMEOUT is safe (the original may still have landed).
+REPLAY_SAFE_VERBS = ("ready", "join", "heartbeat")
+
+
+def _count_retry(verb):
+    """One retry attempt on the fabric, into the process-current
+    registry (telemetry.count_fabric_retry owns the family)."""
+    try:
+        from ...telemetry import count_fabric_retry
+        count_fabric_retry(verb)
+    except Exception:  # noqa: BLE001 — accounting must never fail a retry
+        pass
 
 
 class StoreClient:
@@ -28,6 +63,19 @@ class StoreClient:
         self.secret = secret
         self.timeout = timeout
         self._tls = threading.local()
+        #: chaos fault middleware (chaos/inject.py FaultInjector); its
+        #: ``before_request(method, path)`` may drop, delay, duplicate
+        #: or synthesize an HTTP error before the wire
+        self.middleware = None
+        # retry budget: attempts AND a wall deadline bound every
+        # request's total retry time (env-tunable; docs/fault_tolerance)
+        self.retry_attempts = int(
+            os.environ.get("HOROVOD_FABRIC_RETRY_ATTEMPTS") or 8)
+        self.retry_deadline = float(
+            os.environ.get("HOROVOD_FABRIC_RETRY_DEADLINE_SECONDS")
+            or 30.0)
+        self._retry_base = 0.05     # first backoff step (seconds)
+        self._retry_cap = 2.0       # per-step ceiling
 
     # -- connection management ----------------------------------------------
 
@@ -53,39 +101,105 @@ class StoreClient:
         self._tls.conn = None
         self._tls.timeout = None
 
-    # Stale keep-alive shapes only: a TIMEOUT is never retried (the
-    # request may still be processing server-side; re-sending would
-    # double-deliver and the caller's deadline is the contract), and
-    # every coordinator verb is idempotent (ready/poll by design, join
-    # via jid dedup) so replaying one of these failures is safe.
+    # Connection-shape failures: safe to replay on every verb (the
+    # request never completed server-side, or the verb is idempotent /
+    # id-deduplicated).  A TIMEOUT is retried only for
+    # REPLAY_SAFE_VERBS — the request may still be processing
+    # server-side, so re-sending anything else could double-deliver.
     _RETRYABLE = (http.client.RemoteDisconnected,
                   http.client.CannotSendRequest,
                   http.client.BadStatusLine,
                   ConnectionResetError, ConnectionRefusedError,
-                  ConnectionAbortedError, BrokenPipeError)
+                  ConnectionAbortedError, BrokenPipeError,
+                  _DroppedRequest)
 
-    def _request(self, method, path, body=b"", timeout=None):
+    def _backoff(self, attempt):
+        """Exponential backoff with jitter, capped per step."""
+        step = min(self._retry_cap, self._retry_base * (2 ** attempt))
+        time.sleep(step * (0.5 + random.random()))
+
+    def _send_once(self, method, path, body, headers, timeout,
+                   duplicate=False):
+        conn = self._conn(timeout)
+        conn.request(method, path, body=body or None, headers=headers)
+        resp = conn.getresponse()
+        data = resp.read()
+        if duplicate:
+            # chaos 'duplicate': re-send the identical request on the
+            # same connection (a replayed POST after a dropped
+            # keep-alive) and serve the replay's response — the
+            # server's rid/jid dedup is what keeps this harmless
+            conn.request(method, path, body=body or None,
+                         headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+        return resp.status, data
+
+    def _request(self, method, path, body=b"", timeout=None,
+                 verb=None, retry_timeout=False):
+        """One logical request with bounded retries.  ``verb`` labels
+        the retry counter; ``retry_timeout`` opts the verb into
+        TimeoutError replays (REPLAY_SAFE_VERBS only)."""
         timeout = timeout or self.timeout
+        verb = verb or method.lower()
         headers = dict(self._auth_headers(body))
         if body:
             headers["Content-Type"] = "application/json"
-        for attempt in (0, 1):
-            conn = self._conn(timeout)
+        deadline = time.monotonic() + self.retry_deadline
+        attempt = 0
+        while True:
+            exhausted = (attempt + 1 >= max(self.retry_attempts, 1)
+                         or time.monotonic() > deadline)
             try:
-                conn.request(method, path, body=body or None,
-                             headers=headers)
-                resp = conn.getresponse()
-                data = resp.read()
-                return resp.status, data
+                action = None
+                mw = self.middleware
+                if mw is not None:
+                    action = mw.before_request(method, path)
+                if action is not None and action[0] == "drop":
+                    raise _DroppedRequest(
+                        f"chaos: dropped {method} {path}")
+                if action is not None and action[0] == "error":
+                    status, data = action[1], b"chaos: injected error"
+                else:
+                    if action is not None and action[0] == "delay":
+                        time.sleep(action[1])
+                    status, data = self._send_once(
+                        method, path, body, headers, timeout,
+                        duplicate=(action is not None
+                                   and action[0] == "duplicate"))
+                if status >= 500 and not exhausted:
+                    # transient server failure (restart, overload,
+                    # injected burst): the response was fully read, so
+                    # the keep-alive connection stays usable
+                    _count_retry(verb)
+                    self._backoff(attempt)
+                    attempt += 1
+                    continue
+                return status, data
             except TimeoutError:
                 self._drop_conn()
-                raise
-            except self._RETRYABLE:
-                # stale keep-alive or server restart: reconnect once
-                self._drop_conn()
-                if attempt:
+                if not retry_timeout or exhausted:
                     raise
-        raise AssertionError("unreachable")
+            except self._RETRYABLE:
+                # stale keep-alive, server restart, or injected drop:
+                # reconnect and replay under the retry budget
+                self._drop_conn()
+                if attempt == 0:
+                    # the first connection-shape failure is routinely
+                    # just an idle-closed keep-alive: reconnect and
+                    # replay IMMEDIATELY, even past the deadline (a
+                    # long-poll GET can outlive it legitimately) —
+                    # the pre-backoff code's unconditional single
+                    # reconnect, preserved.  Waiting is for servers
+                    # that answered sick, not for a dropped socket.
+                    _count_retry(verb)
+                    attempt = 1
+                    continue
+                if exhausted:
+                    raise
+            _count_retry(verb)
+            self._backoff(attempt)
+            attempt += 1
 
     def _auth_headers(self, body: bytes):
         if self.secret is None:
@@ -96,14 +210,18 @@ class StoreClient:
     # -- API -----------------------------------------------------------------
 
     def put(self, key: str, value: bytes):
-        status, _ = self._request("PUT", key, value)
+        # KV puts are last-writer-wins: replaying a timed-out put is
+        # safe, so the full retry surface applies
+        status, _ = self._request("PUT", key, value, verb="kv_put",
+                                  retry_timeout=True)
         if status != 200:
             raise _HTTPError(status, f"PUT {key}")
 
     def get(self, key: str, wait: float = 0.0):
         path = key + (f"?wait={wait}" if wait else "")
         status, data = self._request(
-            "GET", path, timeout=max(self.timeout, wait + 5))
+            "GET", path, timeout=max(self.timeout, wait + 5),
+            verb="kv_get", retry_timeout=True)
         if status == 404:
             return None
         if status != 200:
@@ -111,14 +229,18 @@ class StoreClient:
         return data
 
     def delete(self, key: str):
-        status, _ = self._request("DELETE", key)
+        status, _ = self._request("DELETE", key, verb="kv_delete")
         if status != 200:
             raise _HTTPError(status, f"DELETE {key}")
 
     def coord(self, verb: str, payload: dict, timeout: float = None):
         body = json.dumps(payload).encode()
-        status, data = self._request("POST", f"/coord/{verb}", body,
-                                     timeout=timeout)
+        status, data = self._request(
+            "POST", f"/coord/{verb}", body, timeout=timeout, verb=verb,
+            # ready/join are rid/jid-deduplicated server-side and
+            # heartbeat is naturally idempotent: a slow reply on those
+            # POSTs is retried instead of killing the job
+            retry_timeout=verb in REPLAY_SAFE_VERBS)
         if status != 200:
             raise _HTTPError(status, f"coord/{verb}: "
                                      f"{data[:200].decode(errors='replace')}")

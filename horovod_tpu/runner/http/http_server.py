@@ -75,6 +75,20 @@ def autotune_kwargs(env=None):
                 env.get("HOROVOD_STALL_CHECK_TIME_SECONDS") or 60.0)
         except ValueError:
             kwargs["stall_warning_secs"] = 60.0
+    # worker liveness (docs/fault_tolerance.md): the coordinator
+    # declares a proc dead once its heartbeats stop for the window
+    # (default 1.5x the interval — detection inside 2x the interval);
+    # interval 0 disables.  Shared with workers through the same env.
+    try:
+        kwargs["heartbeat_secs"] = float(
+            env.get("HOROVOD_HEARTBEAT_INTERVAL_SECONDS") or 5.0)
+    except ValueError:
+        kwargs["heartbeat_secs"] = 5.0
+    try:
+        kwargs["heartbeat_window"] = float(
+            env.get("HOROVOD_HEARTBEAT_WINDOW_SECONDS") or 0.0)
+    except ValueError:
+        kwargs["heartbeat_window"] = 0.0
     return kwargs
 
 
@@ -169,6 +183,9 @@ class _Handler(BaseHTTPRequestHandler):
                 snaps.append(payload.get("families", {}))
             except (ValueError, AttributeError):
                 continue    # half-written/foreign value: skip, not 500
+        # coordinator-derived liveness + server-side chaos accounting
+        # join the aggregate (a dead worker can't push its own 0)
+        snaps.append(coord.liveness_snapshot())
         merged = merge_snapshots(snaps)
         if path == "/metrics.json":
             self._reply(OK, render_json(merged).encode(),
@@ -266,6 +283,15 @@ class _Handler(BaseHTTPRequestHandler):
         verb = self.path[len("/coord/"):]
         try:
             req = json.loads(body) if body else {}
+            # coordinator-side fault injection (fault-plan events with
+            # side="coord"): reject or stall this request before the
+            # verb runs — the client's backoff is what must recover
+            act = self.server.coordinator.chaos_check(verb, req)
+            if act is not None and act[0] == "error":
+                return self._reply(
+                    act[1], b"chaos: injected coordinator error")
+            if act is not None and act[0] == "stall":
+                time.sleep(act[1] / 1000.0)
             resp = self.server.coordinator.handle(verb, req)
         except Exception as exc:  # noqa: BLE001 — reported to caller
             return self._reply(BAD_REQUEST,
@@ -332,7 +358,9 @@ class Coordinator:
                  fusion_threshold_bytes: int = 128 * 1024 * 1024,
                  cache_capacity: int = 1024, autotune: bool = False,
                  autotune_log: str = None, cycle_time_ms: float = 1.0,
-                 stall_warning_secs: float = 60.0):
+                 stall_warning_secs: float = 60.0,
+                 heartbeat_secs: float = 5.0,
+                 heartbeat_window: float = 0.0):
         self.world_size = world_size
         self.fusion_threshold = fusion_threshold_bytes
         self.cache_capacity = cache_capacity
@@ -343,6 +371,15 @@ class Coordinator:
         # GLOBAL ranks of the processes that never reported it.
         # 0 disables (HOROVOD_STALL_CHECK_DISABLE).
         self.stall_warning_secs = stall_warning_secs
+        # worker liveness (docs/fault_tolerance.md): workers beat via
+        # the ``heartbeat`` verb; a proc whose beats stop for the
+        # window (default 1.5x the interval) is declared dead — its
+        # pending negotiations fail IMMEDIATELY with an error naming
+        # the global ranks it hosts, instead of stall-timeout limbo.
+        # A proc is only expected to beat after its FIRST beat, so
+        # slow starters are never false-positived.  0 disables.
+        self.heartbeat_secs = heartbeat_secs
+        self.heartbeat_window = heartbeat_window
         # Coordinator-side autotune (reference: the coordinator tunes
         # and SynchronizeParameters broadcasts, controller.cc:40-54):
         # fusion threshold is applied directly here — fusing IS this
@@ -386,6 +423,7 @@ class Coordinator:
         self._exhausted = {}    # ps_id -> set of procs fully joined
         self._join_seen = {}    # (ps, proc) -> set of seen join ids
         self._ready_seen = {}   # proc -> highest seen ready-report id
+        self._ready_reply = {}  # proc -> response of that ready report
         self._proc_sid = {}     # proc -> controller session id
         self._session_base = {}  # proc -> log index its session starts at
         self._errors = {}       # key -> error string
@@ -401,6 +439,18 @@ class Coordinator:
         # flight-recorder dump requests appended to the response log
         # (stall auto-dumps, POST /trace/dump, GET /timeline)
         self._next_dump_id = 0
+        # liveness state: proc -> last beat monotonic / hosted global
+        # ranks / hostname; _dead holds declared-dead procs until the
+        # next round reset (the elastic driver reads it to blacklist)
+        self._beats = {}
+        self._proc_ranks = {}
+        self._proc_hosts = {}
+        self._dead = {}
+        # coordinator-side chaos rules (fault-plan events with
+        # side="coord": reject or stall a chosen proc's requests) and
+        # the per-rule injection accounting exported via /metrics
+        self._chaos_rules = []
+        self._chaos_injected = {}
 
     def close(self):
         if self._autotuner is not None:
@@ -429,6 +479,7 @@ class Coordinator:
             self._exhausted.clear()
             self._join_seen.clear()
             self._ready_seen.clear()
+            self._ready_reply.clear()
             self._proc_sid.clear()
             self._session_base.clear()
             self._errors.clear()
@@ -436,6 +487,15 @@ class Coordinator:
             self._stall_warned_keys.clear()
             self._cache.clear()
             self._cache_by_key.clear()
+            self._beats.clear()
+            self._proc_ranks.clear()
+            self._proc_hosts.clear()
+            self._dead.clear()
+            # chaos rules persist across rounds (the plan describes
+            # the whole job) but their request counters restart with
+            # the round's fresh proc numbering
+            for rule in self._chaos_rules:
+                rule["n"] = 0
             self._lock.notify_all()
 
     def handle(self, verb, req):
@@ -453,6 +513,8 @@ class Coordinator:
             return self._on_poll(req)
         if verb == "join":
             return self._on_join(req)
+        if verb == "heartbeat":
+            return self._on_heartbeat(req)
         raise ValueError(f"unknown coordinator verb {verb}")
 
     def request_trace_dump(self, reason="request"):
@@ -468,6 +530,195 @@ class Coordinator:
                               "reason": reason})
             self._lock.notify_all()
         return did
+
+    # -- worker liveness (docs/fault_tolerance.md "Liveness") ---------------
+
+    def _on_heartbeat(self, req):
+        """Record a worker's liveness beat.  The first beat registers
+        the proc (and the global ranks / hostname it carries, so a
+        later death can be attributed); ``bye`` deregisters on clean
+        shutdown — an elastic teardown must not read as a death.  A
+        beat from an already-declared-dead proc (a hang that woke up,
+        a network partition that healed) gets ``{"dead": true}`` back:
+        its peers' collectives were already failed, so the only safe
+        move for that worker is to restart into the next round."""
+        proc = req.get("proc")
+        if proc is None:
+            return {}
+        with self._lock:
+            if req.get("bye"):
+                self._beats.pop(proc, None)
+                return {}
+            if proc in self._dead:
+                return {"dead": True}
+            self._beats[proc] = time.monotonic()
+            if req.get("ranks") is not None:
+                self._proc_ranks[proc] = list(req["ranks"])
+            if req.get("host"):
+                self._proc_hosts[proc] = req["host"]
+        return {}
+
+    def _scan_heartbeats(self):
+        """Declare procs whose beats stopped for the window dead and
+        fail every negotiation blocked on them — fast explicit failure
+        naming the dead GLOBAL ranks, instead of waiting for the stall
+        timeout.  Clocked by worker polls like the stall scan (the
+        coordinator has no thread of its own); detection latency is
+        therefore window + one poll interval, under 2x the heartbeat
+        interval with the default 1.5x window.  Must hold the lock."""
+        if self.heartbeat_secs <= 0 or not self._beats:
+            return
+        window = self.heartbeat_window or 1.5 * self.heartbeat_secs
+        now = time.monotonic()
+        died = False
+        for proc, last in list(self._beats.items()):
+            if proc in self._dead or now - last <= window:
+                continue
+            age = now - last
+            ranks = self._proc_ranks.get(proc, [])
+            self._dead[proc] = {"ranks": ranks, "age": round(age, 1),
+                                "host": self._proc_hosts.get(proc)}
+            logger.warning(
+                "worker process %s (global ranks %s) missed heartbeats "
+                "for %.1fs (interval %.1fs); failing its pending "
+                "negotiations", proc, ranks or "unknown", age,
+                self.heartbeat_secs)
+            self._log.append({
+                "kind": "dead", "proc": proc, "ranks": ranks,
+                "host": self._proc_hosts.get(proc),
+                "message": (f"worker process {proc} hosting global "
+                            f"ranks {ranks} is unresponsive (missed "
+                            f"heartbeats for {age:.1f}s)")})
+            died = True
+        if died:
+            self._fail_dead_entries_locked()
+            self._lock.notify_all()
+
+    def _fail_dead_entries_locked(self):
+        """Error-out pending entries blocked on a dead proc (and, via
+        the _on_ready call site, entries reported AFTER the death).
+        The error names the dead proc's global ranks so every waiting
+        rank's exception points at the failed hardware."""
+        if not self._dead:
+            return
+        for key in list(self._pending):
+            ent = self._pending[key]
+            meta = next(iter(ent.values()))
+            members = meta.get("members") or {}
+            for proc, info in self._dead.items():
+                if proc in ent:
+                    continue
+                in_set = (str(proc) in members) if members \
+                    else (0 <= proc < max(self.world_size, 1))
+                if not in_set:
+                    continue
+                del self._pending[key]
+                self._pending_since.pop(key, None)
+                self._stall_warned_keys.discard(key)
+                self._log.append({
+                    "kind": "error", "key": key,
+                    "message": (
+                        f"worker process {proc} hosting global ranks "
+                        f"{info.get('ranks', [])} is unresponsive "
+                        f"(missed heartbeats); {key} cannot complete")})
+                break
+
+    def dead_procs(self):
+        """Declared-dead procs this round: {proc: {ranks, host, age}}.
+        The elastic driver polls this to blacklist hung hosts that
+        never exit (runner/elastic/driver.py)."""
+        with self._lock:
+            return {p: dict(info) for p, info in self._dead.items()}
+
+    def liveness_snapshot(self):
+        """Coordinator-derived families merged into the job-wide
+        ``/metrics``: ``horovod_worker_alive{proc}`` (1 = beating,
+        0 = declared dead) and the coordinator-side chaos injections
+        (``horovod_faults_injected_total{kind="coord_*"}``)."""
+        from ...telemetry import (
+            FAULTS_INJECTED_FAMILY, FAULTS_INJECTED_HELP,
+            WORKER_ALIVE_FAMILY, WORKER_ALIVE_HELP,
+        )
+
+        with self._lock:
+            alive = {p: (0.0 if p in self._dead else 1.0)
+                     for p in set(self._beats) | set(self._dead)}
+            injected = dict(self._chaos_injected)
+        fams = {}
+        if alive:
+            fams[WORKER_ALIVE_FAMILY] = {
+                "type": "gauge",
+                "help": WORKER_ALIVE_HELP,
+                "labelnames": ["proc"],
+                "samples": [{"labels": {"proc": str(p)}, "value": v}
+                            for p, v in sorted(alive.items())]}
+        if injected:
+            fams[FAULTS_INJECTED_FAMILY] = {
+                "type": "counter",
+                "help": FAULTS_INJECTED_HELP,
+                "labelnames": ["kind"],
+                "samples": [{"labels": {"kind": k}, "value": float(v)}
+                            for k, v in sorted(injected.items())]}
+        return fams
+
+    # -- coordinator-side chaos (docs/fault_tolerance.md) -------------------
+
+    def add_chaos_rule(self, kind, proc=None, verb=None, after=1,
+                       count=1, code=503, ms=0.0, p=1.0, rng=None):
+        """Install one server-side fault rule: reject
+        (``kind="http_error"``) or stall (``kind="delay_ms"``) the
+        matching coordinator requests from the ``after``-th on, up to
+        ``count`` firings — matching on verb and/or requesting proc.
+        ``p`` gates each eligible request on a draw from ``rng`` (the
+        plan's seeded per-event stream; skipped requests redraw at
+        the next one, mirroring worker-side semantics).  Installed by
+        launchers from fault-plan events with ``side: "coord"``."""
+        if kind not in ("http_error", "delay_ms"):
+            raise ValueError(
+                f"coordinator chaos supports http_error/delay_ms, "
+                f"not {kind}")
+        import random as _random
+        with self._lock:
+            self._chaos_rules.append({
+                "kind": kind, "proc": proc, "verb": verb,
+                "after": int(after), "count": int(count),
+                "code": int(code), "ms": float(ms),
+                "p": float(p), "rng": rng or _random.Random(0),
+                "n": 0, "fires": 0})
+
+    def chaos_check(self, verb, req):
+        """Consulted by the HTTP handler before dispatching a verb.
+        Returns None, ``("error", status)`` or ``("stall", ms)``."""
+        if not self._chaos_rules:
+            return None
+        proc = req.get("proc") if isinstance(req, dict) else None
+        action = None
+        with self._lock:
+            for rule in self._chaos_rules:
+                if rule["verb"] not in (None, verb):
+                    continue
+                if rule["proc"] is not None and proc != rule["proc"]:
+                    continue
+                rule["n"] += 1
+                if action is not None or rule["fires"] >= rule["count"] \
+                        or rule["n"] < rule["after"]:
+                    continue
+                if rule["p"] < 1.0 and \
+                        rule["rng"].random() >= rule["p"]:
+                    continue    # probabilistic skip: redraw next time
+                rule["fires"] += 1
+                if rule["kind"] == "http_error":
+                    label = "coord_http_error"
+                    action = ("error", rule["code"])
+                else:
+                    label = "coord_stall"
+                    action = ("stall", rule["ms"])
+                self._chaos_injected[label] = \
+                    self._chaos_injected.get(label, 0) + 1
+                logger.warning(
+                    "chaos: coordinator injecting %s on %s from "
+                    "proc %s", rule["kind"], verb, proc)
+        return action
 
     def _check_session(self, proc, sid):
         """A fresh controller session (engine re-init against this
@@ -485,6 +736,7 @@ class Coordinator:
         if self._proc_sid.get(proc) != sid:
             self._proc_sid[proc] = sid
             self._ready_seen.pop(proc, None)
+            self._ready_reply.pop(proc, None)
             for key in [k for k in self._join_seen if k[1] == proc]:
                 del self._join_seen[key]
             # drop exactly THIS proc's join/exhaustion state
@@ -517,11 +769,20 @@ class Coordinator:
             rid = req.get("rid")
             if rid is not None:
                 # ready is only idempotent while the entry is still
-                # pending; a replayed POST (dropped keep-alive after the
-                # server processed the original) could otherwise plant a
-                # phantom entry with the PREVIOUS step's meta — dedup on
-                # the client's monotonically increasing report id
-                if rid <= self._ready_seen.get(proc, 0):
+                # pending; a replayed POST (dropped keep-alive or
+                # timeout retry after the server processed the
+                # original) could otherwise plant a phantom entry with
+                # the PREVIOUS step's meta — dedup on the client's
+                # monotonically increasing report id.  The CURRENT
+                # rid's replay must get the ORIGINAL response back:
+                # returning {} would swallow an ``uncached`` list and
+                # strand the withheld metas forever (the client only
+                # ever replays its latest report, so one slot per
+                # proc suffices)
+                last = self._ready_seen.get(proc, 0)
+                if rid == last:
+                    return self._ready_reply.get(proc, {})
+                if rid < last:
                     return {}
                 self._ready_seen[proc] = rid
             for meta in req["entries"]:
@@ -550,9 +811,15 @@ class Coordinator:
                     err = self._validate(key, ent)
                     if err:
                         self._errors[key] = err
+            # entries reported after a peer was declared dead must
+            # fail now, not sit pending forever
+            self._fail_dead_entries_locked()
             self._advance()
             self._lock.notify_all()
-        return {"uncached": uncached} if uncached else {}
+            reply = {"uncached": uncached} if uncached else {}
+            if rid is not None:
+                self._ready_reply[proc] = reply
+        return reply
 
     def _validate(self, key, ent):
         """Cross-process consistency (reference ConstructResponse,
@@ -861,9 +1128,10 @@ class Coordinator:
                 # don't let a stale cursor poison the new round's GC
                 return {"stale": True, "round": self.round_id}
             # polls arrive every worker cycle, so they are the stall
-            # inspector's clock (the coordinator has no thread of its
-            # own)
+            # inspector's AND the liveness scan's clock (the
+            # coordinator has no thread of its own)
             self._scan_stalls()
+            self._scan_heartbeats()
             if proc is not None:
                 # a re-sessioned controller polls from cursor 0; its
                 # session starts at the log position recorded when the
@@ -935,14 +1203,18 @@ class RendezvousServer:
                  fusion_threshold_bytes: int = 128 * 1024 * 1024,
                  cache_capacity: int = 1024, autotune: bool = False,
                  autotune_log: str = None, cycle_time_ms: float = 1.0,
-                 stall_warning_secs: float = 60.0):
+                 stall_warning_secs: float = 60.0,
+                 heartbeat_secs: float = 5.0,
+                 heartbeat_window: float = 0.0):
         self.store = KVStore()
         self.coordinator = Coordinator(world_size, fusion_threshold_bytes,
                                        cache_capacity=cache_capacity,
                                        autotune=autotune,
                                        autotune_log=autotune_log,
                                        cycle_time_ms=cycle_time_ms,
-                                       stall_warning_secs=stall_warning_secs)
+                                       stall_warning_secs=stall_warning_secs,
+                                       heartbeat_secs=heartbeat_secs,
+                                       heartbeat_window=heartbeat_window)
         self.secret = secret
         self._httpd = None
         self._thread = None

@@ -120,6 +120,29 @@ def parse_args(argv=None):
     parser.add_argument("--disable-cache", action="store_true",
                         help="disable the coordinator response cache "
                              "(HOROVOD_CACHE_CAPACITY=0)")
+    # chaos + liveness (docs/fault_tolerance.md)
+    parser.add_argument("--fault-plan", default=None,
+                        help="seeded fault-injection plan: inline "
+                             "JSON, @/path, or a path to a JSON file "
+                             "(HOROVOD_FAULT_PLAN); worker-side "
+                             "events ride the env handoff, "
+                             "coordinator-side events install into "
+                             "the launcher's rendezvous service")
+    parser.add_argument("--fault-seed", type=int, default=None,
+                        help="override the plan's RNG seed "
+                             "(HOROVOD_FAULT_SEED)")
+    parser.add_argument("--heartbeat-interval-seconds", type=float,
+                        default=None,
+                        help="worker liveness heartbeat cadence; the "
+                             "coordinator fails a silent worker's "
+                             "pending collectives after ~1.5x this "
+                             "(0 disables; "
+                             "HOROVOD_HEARTBEAT_INTERVAL_SECONDS)")
+    parser.add_argument("--heartbeat-window-seconds", type=float,
+                        default=None,
+                        help="explicit missed-beat death window "
+                             "(default 1.5x the interval; "
+                             "HOROVOD_HEARTBEAT_WINDOW_SECONDS)")
     # stall check
     parser.add_argument("--no-stall-check", action="store_true")
     parser.add_argument("--stall-check-warning-time-seconds", type=float,

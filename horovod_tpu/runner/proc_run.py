@@ -273,6 +273,12 @@ def launch_procs(command: List[str], np: int, hosts: str = None,
         secret=bytes.fromhex(secret_hex), world_size=num_procs,
         fusion_threshold_bytes=fusion_threshold_bytes,
         **autotune_kwargs(launcher_env))
+    # fault-plan events with side="coord" are the LAUNCHER's to apply
+    # (reject/stall chosen procs' coordinator requests server-side);
+    # worker-side events ride the HOROVOD_FAULT_PLAN env handoff
+    if launcher_env.get("HOROVOD_FAULT_PLAN"):
+        from ..chaos import install_coordinator_rules
+        install_coordinator_rules(server.coordinator, launcher_env)
     rdv_port = server.start()
     rdv_addr = local_ip() if any_remote else "127.0.0.1"
     # jax.distributed's coordination service is hosted by PROCESS 0

@@ -34,6 +34,37 @@ from .exporter import (  # noqa: F401
 )
 
 
+# -- fabric / chaos / liveness families (docs/fault_tolerance.md):
+#    THE definitions every declaring site shares — the StoreClient,
+#    the chaos injector, the engine catalogue and the coordinator's
+#    hand-built liveness snapshot must not drift apart (the registry
+#    keeps the first declaration's help/labels on re-registration).
+
+FABRIC_RETRIES_FAMILY = "horovod_fabric_retries_total"
+FABRIC_RETRIES_HELP = ("Fabric request retries (reconnects, 5xx, "
+                       "safe timeouts), by verb")
+FAULTS_INJECTED_FAMILY = "horovod_faults_injected_total"
+FAULTS_INJECTED_HELP = ("Faults injected by the chaos subsystem, "
+                        "by kind")
+WORKER_ALIVE_FAMILY = "horovod_worker_alive"
+WORKER_ALIVE_HELP = ("Worker liveness from coordinator heartbeats "
+                     "(1 = beating, 0 = declared dead)")
+
+
+def count_fabric_retry(verb):
+    """One fabric retry attempt, into the process-current registry
+    (resolved per call: the engine installs a fresh registry each
+    lifecycle and the StoreClient outlives it)."""
+    registry().counter(FABRIC_RETRIES_FAMILY, FABRIC_RETRIES_HELP,
+                       labelnames=("verb",)).labels(verb=verb).inc()
+
+
+def count_fault_injected(kind):
+    """One chaos injection, into the process-current registry."""
+    registry().counter(FAULTS_INJECTED_FAMILY, FAULTS_INJECTED_HELP,
+                       labelnames=("kind",)).labels(kind=kind).inc()
+
+
 def metrics():
     """Snapshot of the process-current registry (JSON-able dict keyed
     by family name) — the programmatic twin of ``GET /metrics.json``."""

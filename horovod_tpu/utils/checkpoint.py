@@ -109,11 +109,31 @@ def save_rank0(path: str, state: Any):
     os.replace(tmp, path)
 
 
+class CheckpointLoadError(RuntimeError):
+    """The root rank failed to load a checkpoint in
+    :func:`load_and_broadcast`; raised COLLECTIVELY on every rank."""
+
+
+class _LoadFailure:
+    """Broadcastable error sentinel: the root ships this instead of
+    the state when its load fails, so non-root ranks raise instead of
+    blocking forever in ``broadcast_object``."""
+
+    def __init__(self, message):
+        self.message = message
+
+
 def load_and_broadcast(path: str, root_rank: int = 0) -> Any:
     """Restore-and-broadcast convention (reference
     BroadcastGlobalVariablesHook / broadcast_object on restore): root
     loads the file, every rank receives the object, so all ranks
-    resume bit-identical."""
+    resume bit-identical.
+
+    A load failure on the root (missing/corrupt file) broadcasts an
+    error sentinel first, then every rank raises
+    :class:`CheckpointLoadError` together — raising only on the root
+    would leave every other rank hanging in the broadcast with no
+    counterpart (docs/fault_tolerance.md)."""
     import pickle
 
     from ..common import basics
@@ -121,7 +141,15 @@ def load_and_broadcast(path: str, root_rank: int = 0) -> Any:
 
     state = None
     if basics.rank() == root_rank:
-        with open(path, "rb") as f:
-            state = pickle.load(f)
-    return broadcast_object(state, root_rank=root_rank,
-                            name=f"ckpt.{os.path.basename(path)}")
+        try:
+            with open(path, "rb") as f:
+                state = pickle.load(f)
+        except Exception as exc:  # noqa: BLE001 — shipped to all ranks
+            state = _LoadFailure(
+                f"rank {root_rank} could not load checkpoint "
+                f"{path}: {type(exc).__name__}: {exc}")
+    state = broadcast_object(state, root_rank=root_rank,
+                             name=f"ckpt.{os.path.basename(path)}")
+    if isinstance(state, _LoadFailure):
+        raise CheckpointLoadError(state.message)
+    return state

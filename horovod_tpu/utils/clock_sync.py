@@ -106,6 +106,14 @@ class ClockSync:
             # unreachable (teardown, elastic reset); retry next round
             logger.debug("clock sync round failed: %s", exc)
             return None
+        try:
+            # chaos clock_skew faults shift THIS worker's estimated
+            # offset, so skew scenarios flow through the real trace
+            # alignment path (chaos/inject.py; 0.0 without a plan)
+            from ..chaos import current_skew_seconds
+            offset_us += current_skew_seconds() * 1e6
+        except Exception:  # noqa: BLE001 — chaos is optional tooling
+            pass
         tl.set_clock_sync(offset_us, err_us, source="coordinator",
                           samples=self.samples)
         return offset_us, err_us

@@ -1,0 +1,473 @@
+"""Chaos subsystem tests (docs/fault_tolerance.md): fault-plan
+parsing + seeded scheduling determinism, each injection point against
+the real fabric client / coordinator, missed-heartbeat liveness
+timing, the checkpoint error sentinel, and the end-to-end scenarios
+(kill -> elastic restart, slow-rank -> stall attribution + ring dump,
+coordinator 5xx -> backoff survival) via tools/chaos_smoke.py."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import telemetry
+from horovod_tpu.chaos.inject import FaultInjector, _reset_for_tests
+from horovod_tpu.chaos.plan import load_plan, parse_plan, plan_from_env
+from horovod_tpu.runner.http.http_client import (
+    REPLAY_SAFE_VERBS, StoreClient, _HTTPError,
+)
+from horovod_tpu.runner.http.http_server import (
+    Coordinator, RendezvousServer,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def clean_injector():
+    _reset_for_tests()
+    yield
+    _reset_for_tests()
+
+
+def _meta(key, members):
+    """Minimal ready-report meta (what _meta_for ships)."""
+    return {"key": key, "type": "ALLREDUCE", "dtype": "float32",
+            "shape": [2], "op": 1, "pre": 1.0, "post": 1.0, "ps": 0,
+            "nbytes": 8, "nprocs": len(members), "nranks": len(members),
+            "root": -1, "members": members, "aux": {}}
+
+
+# -- fault-plan schema --------------------------------------------------------
+
+def test_plan_parsing_and_targeting():
+    plan = parse_plan({"seed": 5, "events": [
+        {"kind": "kill", "proc": 1, "after_requests": 10},
+        {"kind": "slow_rank", "rank": 3, "ms": 50,
+         "after_collectives": 2, "count": 2},
+        {"kind": "http_error", "side": "coord", "proc": 0,
+         "verb": "poll", "after": 4, "count": 3, "code": 503},
+        {"kind": "clock_skew", "proc": 0, "ms": 1000, "after_s": 2.5},
+    ]})
+    assert plan.seed == 5
+    assert [e.kind for e in plan.events] == [
+        "kill", "slow_rank", "http_error", "clock_skew"]
+    assert plan.events[0].trigger == "requests"
+    assert plan.events[1].trigger == "collectives"
+    assert plan.events[3].trigger == "wall" and plan.events[3].at == 2.5
+    # proc targeting: proc 1 hosts rank 1 only -> kill, not slow_rank
+    assert [e.kind for e in plan.worker_events(1, 1, 2)] == ["kill"]
+    # the process hosting global rank 3 gets the slow_rank
+    assert [e.kind for e in plan.worker_events(3, 2, 4)] == ["slow_rank"]
+    # proc 0 gets only the clock skew (the coord event is NOT worker-side)
+    assert [e.kind for e in plan.worker_events(0, 0, 1)] == ["clock_skew"]
+    rules = plan.coordinator_rules()
+    assert len(rules) == 1 and rules[0].verb == "poll" \
+        and rules[0].code == 503
+
+
+@pytest.mark.parametrize("bad", [
+    {"events": [{"kind": "frobnicate", "after_requests": 1}]},
+    {"events": [{"kind": "drop"}]},                      # no trigger
+    {"events": [{"kind": "drop", "after_requests": 1,
+                 "after_s": 2}]},                        # two triggers
+    {"events": [{"kind": "kill", "after_requests": 1}]},  # no target
+    {"events": [{"kind": "slow_rank", "rank": 0,
+                 "after_collectives": 1}]},              # no ms
+    {"events": [{"kind": "drop", "after_requests": 1, "p": 0}]},
+    {"events": [{"kind": "kill", "side": "coord", "proc": 0,
+                 "after": 1}]},                          # coord kill
+])
+def test_plan_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        parse_plan(bad)
+
+
+def test_plan_file_and_env_loading(tmp_path, monkeypatch):
+    doc = {"seed": 9, "events": [
+        {"kind": "drop", "proc": 0, "after_requests": 3}]}
+    path = tmp_path / "plan.json"
+    path.write_text(json.dumps(doc))
+    assert load_plan(f"@{path}").seed == 9
+    assert load_plan(str(path)).seed == 9           # bare path too
+    assert load_plan(json.dumps(doc)).seed == 9     # inline
+    monkeypatch.setenv("HOROVOD_FAULT_PLAN", str(path))
+    monkeypatch.setenv("HOROVOD_FAULT_SEED", "77")
+    plan = plan_from_env()
+    assert plan.seed == 77 and len(plan.events) == 1
+    monkeypatch.setenv("HOROVOD_FAULT_PLAN", "not json {")
+    with pytest.raises(Exception):
+        plan_from_env()            # malformed plans fail LOUDLY
+    monkeypatch.delenv("HOROVOD_FAULT_PLAN")
+    assert plan_from_env() is None
+
+
+def test_same_seed_same_fault_sequence(clean_injector):
+    """The determinism contract: two injectors over the same plan make
+    identical fire/skip decisions for probabilistic events."""
+    doc = {"seed": 123, "events": [
+        {"kind": "slow_rank", "rank": 0, "ms": 1,
+         "after_collectives": 1, "count": 40, "p": 0.5}]}
+    runs = []
+    for _ in range(2):
+        inj = FaultInjector(parse_plan(doc), proc=0, rank_offset=0,
+                            num_local=1)
+        inj.on_collectives(120)
+        runs.append(list(inj.fired))
+    assert runs[0] == runs[1]
+    assert 0 < len(runs[0]) < 120       # the coin actually flipped
+    # a different seed draws a different sequence
+    other = FaultInjector(parse_plan({**doc, "seed": 124}), proc=0,
+                          rank_offset=0, num_local=1)
+    other.on_collectives(120)
+    assert other.fired != runs[0]
+
+
+# -- injection points ---------------------------------------------------------
+
+def test_injector_wire_actions(clean_injector):
+    plan = parse_plan({"events": [
+        {"kind": "drop", "proc": 0, "after_requests": 1, "count": 1},
+        {"kind": "delay_ms", "proc": 0, "ms": 10, "after_requests": 2,
+         "count": 1},
+        {"kind": "http_error", "proc": 0, "code": 500,
+         "after_requests": 3, "count": 1},
+        {"kind": "duplicate", "proc": 0, "after_requests": 4,
+         "count": 1},
+    ]})
+    inj = FaultInjector(plan, proc=0)
+    assert inj.before_request("POST", "/coord/poll") == ("drop",)
+    act = inj.before_request("POST", "/coord/poll")
+    assert act[0] == "delay" and act[1] == pytest.approx(0.01)
+    assert inj.before_request("POST", "/coord/poll") == ("error", 500)
+    assert inj.before_request("POST", "/coord/poll") == ("duplicate",)
+    assert inj.before_request("POST", "/coord/poll") is None
+    assert [f["kind"] for f in inj.fired] == [
+        "drop", "delay_ms", "http_error", "duplicate"]
+
+
+def test_injector_slow_rank_sleeps_on_collective(clean_injector):
+    plan = parse_plan({"events": [
+        {"kind": "slow_rank", "rank": 2, "ms": 80,
+         "after_collectives": 2, "count": 1}]})
+    # a process NOT hosting rank 2 never sleeps
+    other = FaultInjector(plan, proc=0, rank_offset=0, num_local=2)
+    t0 = time.monotonic()
+    other.on_collectives(4)
+    assert time.monotonic() - t0 < 0.05 and not other.fired
+    # the hosting process sleeps on its 2nd reported collective
+    inj = FaultInjector(plan, proc=1, rank_offset=2, num_local=2)
+    t0 = time.monotonic()
+    inj.on_collectives(1)
+    assert time.monotonic() - t0 < 0.05
+    inj.on_collectives(1)
+    assert time.monotonic() - t0 >= 0.08
+    assert [f["kind"] for f in inj.fired] == ["slow_rank"]
+
+
+def test_injector_wall_clock_skew(clean_injector):
+    plan = parse_plan({"events": [
+        {"kind": "clock_skew", "proc": 0, "ms": 5000, "after_s": 0.05}]})
+    inj = FaultInjector(plan, proc=0)
+    deadline = time.monotonic() + 2.0
+    while inj.skew_seconds() == 0.0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert inj.skew_seconds() == pytest.approx(5.0)
+    from horovod_tpu.chaos import current_skew_seconds
+    assert current_skew_seconds() == 0.0    # nothing installed
+
+
+def test_engine_hook_via_env_single_process(monkeypatch, hvd_shutdown,
+                                            clean_injector):
+    """hvd.init() wires HOROVOD_FAULT_PLAN through Config into the
+    engine loop: the single-process dispatch path sleeps on the
+    triggered collective and the injection is exported."""
+    monkeypatch.setenv("HOROVOD_FAULT_PLAN", json.dumps({
+        "seed": 1, "events": [
+            {"kind": "slow_rank", "rank": 0, "ms": 60,
+             "after_collectives": 1, "count": 1}]}))
+    hvd.init(num_ranks=1)
+    t0 = time.monotonic()
+    out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum, name="cz")
+    assert np.allclose(out, 1.0)
+    assert time.monotonic() - t0 >= 0.06
+    assert telemetry.counter_total(
+        "horovod_faults_injected_total", kind="slow_rank") >= 1
+
+
+# -- fabric hardening ---------------------------------------------------------
+
+def test_replay_safe_verbs_contract():
+    # timeout replays are ONLY safe where the coordinator dedups on a
+    # client id (ready/join) or the verb is naturally idempotent
+    # (heartbeat); widening this list needs a server-side dedup first
+    assert REPLAY_SAFE_VERBS == ("ready", "join", "heartbeat")
+
+
+def test_client_retries_coordinator_5xx_burst():
+    telemetry.fresh_registry()
+    server = RendezvousServer(world_size=1)
+    port = server.start()
+    try:
+        server.coordinator.add_chaos_rule(
+            "http_error", verb="clock", after=1, count=2, code=503)
+        client = StoreClient("127.0.0.1", port)
+        out = client.coord("clock", {})
+        assert "t" in out
+        assert telemetry.counter_total(
+            "horovod_fabric_retries_total", verb="clock") >= 2
+        assert server.coordinator.liveness_snapshot()[
+            "horovod_faults_injected_total"]["samples"]
+    finally:
+        server.stop()
+
+
+def test_client_5xx_exhaustion_raises():
+    server = RendezvousServer(world_size=1)
+    port = server.start()
+    try:
+        server.coordinator.add_chaos_rule(
+            "http_error", verb="clock", after=1, count=50, code=503)
+        client = StoreClient("127.0.0.1", port)
+        client.retry_attempts = 3
+        client.retry_deadline = 5.0
+        with pytest.raises(_HTTPError) as exc:
+            client.coord("clock", {})
+        assert exc.value.code == 503
+    finally:
+        server.stop()
+
+
+def test_client_recovers_from_injected_drop(clean_injector):
+    telemetry.fresh_registry()
+    server = RendezvousServer(world_size=1)
+    port = server.start()
+    try:
+        client = StoreClient("127.0.0.1", port)
+        client.middleware = FaultInjector(parse_plan({"events": [
+            {"kind": "drop", "proc": 0, "after_requests": 1,
+             "count": 1}]}), proc=0)
+        out = client.coord("clock", {})
+        assert "t" in out
+        assert telemetry.counter_total(
+            "horovod_fabric_retries_total", verb="clock") >= 1
+        assert [f["kind"] for f in client.middleware.fired] == ["drop"]
+    finally:
+        server.stop()
+
+
+def test_duplicate_request_deduped_by_rid(clean_injector):
+    """An injected duplicate ready-POST must not plant a second
+    phantom report (the coordinator's rid dedup contract the client's
+    timeout replays rely on)."""
+    server = RendezvousServer(world_size=2)
+    port = server.start()
+    try:
+        client = StoreClient("127.0.0.1", port)
+        client.middleware = FaultInjector(parse_plan({"events": [
+            {"kind": "duplicate", "proc": 0, "after_requests": 1,
+             "count": 1}]}), proc=0)
+        client.coord("ready", {
+            "proc": 0, "nlocal": 1, "round": 0, "rid": 1, "sid": "s",
+            "entries": [_meta("dup.k", {"0": [0], "1": [1]})]})
+        with server.coordinator._lock:
+            ent = server.coordinator._pending["dup.k"]
+            assert list(ent.keys()) == [0]      # one report, not two
+    finally:
+        server.stop()
+
+
+def test_timeout_retried_only_on_replay_safe_verbs():
+    """A server-side stall longer than the client timeout: heartbeat
+    (replay-safe) retries and succeeds; clock raises TimeoutError."""
+    server = RendezvousServer(world_size=1)
+    port = server.start()
+    try:
+        server.coordinator.add_chaos_rule(
+            "delay_ms", verb="heartbeat", ms=1200, after=1, count=1)
+        server.coordinator.add_chaos_rule(
+            "delay_ms", verb="clock", ms=1200, after=1, count=1)
+        client = StoreClient("127.0.0.1", port, timeout=0.4)
+        out = client.coord("heartbeat", {"proc": 0, "round": 0})
+        assert out == {}
+        with pytest.raises(TimeoutError):
+            client.coord("clock", {})
+    finally:
+        server.stop()
+
+
+def test_ready_replay_returns_original_response():
+    """A timeout-retried ready POST (now routine: retry_timeout=True)
+    must get the ORIGINAL response back — swallowing an ``uncached``
+    list on the replay would strand the withheld metas forever."""
+    c = Coordinator(world_size=2)
+    req = {"proc": 0, "nlocal": 1, "round": 0, "rid": 1, "sid": "s",
+           "entries": [{"key": "rk", "c": 99}]}    # evicted cache id
+    assert c.handle("ready", req) == {"uncached": ["rk"]}
+    # replay of the SAME rid: identical response, no phantom entry
+    assert c.handle("ready", req) == {"uncached": ["rk"]}
+    assert "rk" not in c._pending
+    # an OLDER rid replay stays inert
+    assert c.handle("ready", {**req, "rid": 0}) == {}
+
+
+def test_coordinator_chaos_rule_probability_deterministic():
+    import random
+    seqs = []
+    for _ in range(2):
+        c = Coordinator(world_size=1)
+        c.add_chaos_rule("http_error", verb="clock", after=1,
+                         count=100, p=0.5, rng=random.Random("x"))
+        seqs.append([c.chaos_check("clock", {}) is not None
+                     for _ in range(50)])
+    assert seqs[0] == seqs[1]
+    assert 0 < sum(seqs[0]) < 50        # the coin actually flipped
+
+
+# -- liveness -----------------------------------------------------------------
+
+def test_missed_heartbeats_fail_peers_fast():
+    """Acceptance: a missed-heartbeat worker fails its peers' pending
+    negotiations with an error naming its global ranks in under 2x
+    the heartbeat interval — without the stall timeout (60s default)
+    in the loop."""
+    interval = 0.5
+    c = Coordinator(world_size=2, heartbeat_secs=interval)
+    c.handle("heartbeat", {"proc": 0, "round": 0, "ranks": [0],
+                           "host": "host-a"})
+    c.handle("heartbeat", {"proc": 1, "round": 0, "ranks": [1],
+                           "host": "host-b"})
+    c.handle("ready", {"proc": 0, "nlocal": 1, "round": 0, "rid": 1,
+                       "sid": "s0",
+                       "entries": [_meta("hb.k1", {"0": [0],
+                                                   "1": [1]})]})
+    t_last_beat = time.monotonic()      # proc 1 goes silent NOW
+    responses = []
+    while time.monotonic() - t_last_beat < 3.0:
+        c.handle("heartbeat", {"proc": 0, "round": 0})   # peer lives on
+        out = c.handle("poll", {"proc": 0, "cursor": 0, "round": 0,
+                                "wait": 0.0})
+        responses = out.get("responses", [])
+        if any(r.get("kind") == "dead" for r in responses):
+            break
+        time.sleep(0.05)
+    detection = time.monotonic() - t_last_beat
+    kinds = [r.get("kind") for r in responses]
+    assert "dead" in kinds and "error" in kinds, responses
+    assert detection < 2 * interval, detection
+    err = next(r for r in responses if r.get("kind") == "error")
+    assert err["key"] == "hb.k1"
+    assert "[1]" in err["message"]          # names the dead GLOBAL rank
+    dead = next(r for r in responses if r.get("kind") == "dead")
+    assert dead["proc"] == 1 and dead["ranks"] == [1]
+    assert dead["host"] == "host-b"
+    dp = c.dead_procs()
+    assert set(dp) == {1} and dp[1]["ranks"] == [1] \
+        and dp[1]["host"] == "host-b"
+    # entries reported AFTER the death fail immediately too
+    c.handle("ready", {"proc": 0, "nlocal": 1, "round": 0, "rid": 2,
+                       "sid": "s0",
+                       "entries": [_meta("hb.k2", {"0": [0],
+                                                   "1": [1]})]})
+    out = c.handle("poll", {"proc": 0, "cursor": out["cursor"],
+                            "round": 0, "wait": 0.0})
+    late = [r for r in out["responses"] if r.get("kind") == "error"]
+    assert late and late[0]["key"] == "hb.k2"
+    # a dead proc that beats again is told so (restart, don't compute)
+    assert c.handle("heartbeat", {"proc": 1, "round": 0}) == \
+        {"dead": True}
+    # liveness joins the job-wide /metrics
+    alive = c.liveness_snapshot()["horovod_worker_alive"]["samples"]
+    assert {s["labels"]["proc"]: s["value"] for s in alive} == \
+        {"0": 1.0, "1": 0.0}
+
+
+def test_heartbeat_bye_is_not_a_death():
+    c = Coordinator(world_size=2, heartbeat_secs=0.1,
+                    heartbeat_window=0.15)
+    c.handle("heartbeat", {"proc": 0, "round": 0, "ranks": [0]})
+    c.handle("heartbeat", {"proc": 1, "round": 0, "ranks": [1]})
+    c.handle("heartbeat", {"proc": 1, "round": 0, "bye": True})
+    time.sleep(0.3)
+    c.handle("heartbeat", {"proc": 0, "round": 0})
+    out = c.handle("poll", {"proc": 0, "cursor": 0, "round": 0,
+                            "wait": 0.0})
+    assert not [r for r in out["responses"]
+                if r.get("kind") == "dead"]
+    assert c.dead_procs() == {}
+    # a round reset clears liveness state entirely
+    c.handle("heartbeat", {"proc": 0, "round": 0})
+    c.reset(world_size=2, round_id=1)
+    time.sleep(0.3)
+    out = c.handle("poll", {"proc": 0, "cursor": 0, "round": 1,
+                            "wait": 0.0})
+    assert not [r for r in out["responses"]
+                if r.get("kind") == "dead"]
+
+
+# -- checkpoint sentinel ------------------------------------------------------
+
+def test_load_and_broadcast_raises_collectively(tmp_path, hvd_shutdown):
+    from horovod_tpu.utils.checkpoint import (
+        CheckpointLoadError, load_and_broadcast, save_rank0,
+    )
+
+    hvd.init(num_ranks=1)
+    with pytest.raises(CheckpointLoadError) as exc:
+        load_and_broadcast(str(tmp_path / "missing.pkl"))
+    assert "missing.pkl" in str(exc.value)
+    # corrupt file: same collective failure, not a hang
+    bad = tmp_path / "corrupt.pkl"
+    bad.write_bytes(b"\x00not a pickle")
+    with pytest.raises(CheckpointLoadError):
+        load_and_broadcast(str(bad))
+    # the healthy path still round-trips
+    good = tmp_path / "good.pkl"
+    save_rank0(str(good), {"step": 7})
+    assert load_and_broadcast(str(good)) == {"step": 7}
+
+
+# -- end-to-end scenarios (ci.sh chaos runs the same bodies) ------------------
+
+def _run_scenario(name, timeout=600):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_smoke.py"),
+         name],
+        capture_output=True, text=True, timeout=timeout,
+        env={**os.environ, "PYTHONPATH": REPO})
+    assert proc.returncode == 0, (proc.stdout[-3000:],
+                                  proc.stderr[-3000:])
+    assert "CHAOS SMOKE OK" in proc.stdout
+
+
+@pytest.mark.integration
+def test_scenario_coordinator_5xx_and_determinism():
+    """Job survives a coordinator 5xx burst via backoff (retries > 0,
+    exit 0) and two same-seed runs inject identical fault sequences."""
+    _run_scenario("fivexx")
+
+
+@pytest.mark.integration
+def test_scenario_slow_rank_stall_attribution():
+    """Injected straggler: stall warning names the injected rank and
+    the flight recorder dumps a ring."""
+    _run_scenario("slow")
+
+
+@pytest.mark.integration
+def test_scenario_kill_worker_elastic_restart():
+    """SIGKILLed worker: elastic restart resumes training from the
+    last commit and the job completes."""
+    _run_scenario("kill")
+
+
+@pytest.mark.integration
+def test_scenario_hung_worker_heartbeat_liveness():
+    """Hung (never-exiting) worker: heartbeat liveness declares it
+    dead, the driver reaps + blacklists it, survivors finish."""
+    _run_scenario("hang")

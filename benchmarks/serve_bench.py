@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Serving-tier benchmark (bench.py protocol: one JSON line for the
+driver; numbers recorded in docs/benchmarks.md).
+
+Measures the serving hot path end to end on one replica — HTTP
+decode excluded, batcher + compiled dispatch included — under closed-
+loop concurrent load, the way an SLO is experienced:
+
+* ``throughput_rps`` — completed predicts per second;
+* ``p50_ms`` / ``p99_ms`` — per-request latency (submit → result),
+  measured client-side per request (exact, not bucket-estimated);
+* ``batch_mean`` — average real requests per dispatched device batch
+  (how much coalescing the load actually got);
+* ``cache_misses`` — compiled-program builds during the timed phase
+  (MUST be 0: warm-up covers every bucket).
+
+The model is a deliberately small MLP so the numbers characterize the
+serving machinery, not the model: batcher overhead, padding waste and
+program-cache dispatch are what this file guards.
+
+Usage: python benchmarks/serve_bench.py [--requests N] [--concurrency C]
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+DIM, HIDDEN, OUT = 256, 512, 32
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=2000)
+    ap.add_argument("--concurrency", type=int, default=16)
+    ap.add_argument("--max-batch-size", type=int, default=16)
+    ap.add_argument("--max-latency-ms", type=float, default=2.0)
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from horovod_tpu import serving, telemetry
+
+    rng = np.random.default_rng(0)
+    params = {
+        "w1": rng.standard_normal((DIM, HIDDEN)).astype(np.float32)
+        / np.sqrt(DIM),
+        "w2": rng.standard_normal((HIDDEN, OUT)).astype(np.float32)
+        / np.sqrt(HIDDEN),
+    }
+
+    def predict_fn(p, batch):
+        import jax.numpy as jnp
+        h = jnp.maximum(batch["x"] @ p["w1"], 0.0)
+        return {"y": h @ p["w2"]}
+
+    replica = serving.ServingReplica(
+        predict_fn, params=params,
+        config=serving.ServingConfig(
+            max_batch_size=args.max_batch_size,
+            max_latency_ms=args.max_latency_ms))
+    replica.warmup({"x": np.zeros(DIM, np.float32)})
+    miss0 = telemetry.counter_total(
+        "horovod_program_cache_misses_total")
+
+    x = rng.standard_normal(DIM).astype(np.float32)
+    latencies = []
+    lat_lock = threading.Lock()
+    idx = iter(range(args.requests))
+    idx_lock = threading.Lock()
+
+    def pump():
+        local = []
+        while True:
+            with idx_lock:
+                i = next(idx, None)
+            if i is None:
+                break
+            t0 = time.perf_counter()
+            out = replica.predict_one({"x": x})
+            local.append(time.perf_counter() - t0)
+            assert out["y"].shape == (OUT,)
+        with lat_lock:
+            latencies.extend(local)
+
+    threads = [threading.Thread(target=pump)
+               for _ in range(args.concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+
+    lat_ms = np.sort(np.array(latencies)) * 1000.0
+    occ = telemetry.registry().get("horovod_serving_batch_occupancy")
+    batches = occ.total()
+    result = {
+        "benchmark": "serve_bench",
+        "requests": args.requests,
+        "concurrency": args.concurrency,
+        "max_batch_size": args.max_batch_size,
+        "max_latency_ms": args.max_latency_ms,
+        "model": f"mlp {DIM}x{HIDDEN}x{OUT} f32",
+        "throughput_rps": round(args.requests / wall, 1),
+        "p50_ms": round(float(lat_ms[len(lat_ms) // 2]), 3),
+        "p99_ms": round(float(lat_ms[int(len(lat_ms) * 0.99)]), 3),
+        "batch_mean": round(args.requests / max(batches, 1), 2),
+        "cache_misses": telemetry.counter_total(
+            "horovod_program_cache_misses_total") - miss0,
+    }
+    replica.close()
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -17,6 +17,11 @@
 #                         #   coordinator 5xx, hang) with a hang
 #                         #   watchdog; asserts recovery, stall
 #                         #   attribution and same-seed determinism
+#   ./ci.sh serve         # smoke: real 2-proc serving job — dynamic
+#                         #   batching through the compiled cache,
+#                         #   kill one replica mid-traffic (fault
+#                         #   plan), zero dropped requests, job-wide
+#                         #   SLO families + liveness on /metrics
 #   ./ci.sh bench         # smoke: one bench.py run (real chip if any)
 #   ./ci.sh all           # tiers 1-3 (what the round judge re-runs,
 #                         #   split in four parts to stay under per-
@@ -46,7 +51,7 @@ PART2="tests/test_elastic.py tests/test_examples.py \
   tests/test_tensorflow.py"
 PART3="tests/test_parallel.py tests/test_torch.py"
 PART4="tests/test_api_parity.py tests/test_chaos.py \
-  tests/test_pallas.py tests/test_runner.py"
+  tests/test_pallas.py tests/test_runner.py tests/test_serving.py"
 
 case "${1:-all}" in
   fast)
@@ -96,6 +101,17 @@ case "${1:-all}" in
     # (docs/observability.md)
     python tools/metrics_smoke.py
     ;;
+  serve)
+    # serving tier (docs/serving.md): a REAL 2-process serving job —
+    # both replicas load one broadcast checkpoint and warm every batch
+    # bucket; a seeded fault plan SIGKILLs replica 1 on its 25th
+    # predict; the traffic loop fails over to the survivor with ZERO
+    # dropped in-flight requests; the job-wide /metrics shows the
+    # request-latency + queue-depth SLO families and the recorded
+    # death (worker_alive), and steady-state traffic adds zero
+    # compiled-program-cache misses after warm-up
+    python tools/serve_smoke.py
+    ;;
   bench)
     python bench.py
     # collective sweeps on the 4-rank virtual mesh: the quantized-wire
@@ -107,6 +123,9 @@ case "${1:-all}" in
       --wire-dtype all --iters 8
     python benchmarks/collective_bench.py --np 4 --cpu \
       --algorithm all --iters 8 --sizes-mb 1,8,32
+    # serving-tier throughput/latency (batcher + compiled dispatch
+    # under closed-loop load) — the docs/benchmarks.md serving row
+    python benchmarks/serve_bench.py
     ;;
   refsuite)
     # the REFERENCE's own torch test suite, run unmodified against
@@ -162,7 +181,7 @@ case "${1:-all}" in
     python -m pytest $PART4 -q
     ;;
   *)
-    echo "usage: $0 {fast|matrix|integration|chaos|trace|metrics|bench|all}" >&2
+    echo "usage: $0 {fast|matrix|integration|chaos|trace|metrics|serve|bench|all}" >&2
     exit 2
     ;;
 esac

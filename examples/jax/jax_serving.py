@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Minimal serving replica (docs/serving.md).
+
+Run a 2-replica fleet on CPU::
+
+    python -m horovod_tpu.runner.launch -np 2 --cpu \
+        --serve --serve-port 8500 --serve-max-latency-ms 5 \
+        -- python examples/jax/jax_serving.py
+
+then::
+
+    curl -s localhost:8500/predict \
+        -d '{"inputs": {"x": [0.1, 0.2, ...]}}'    # DIM floats
+
+Each replica loads the same params (rank 0 writes a checkpoint on
+first run, every rank restores it via the broadcast convention),
+warms every bucketed batch shape, and serves until terminated.
+"""
+
+import os
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+DIM, OUT = 32, 8
+CKPT = os.environ.get("SERVE_CKPT", "/tmp/hvd_serving_example.pkl")
+
+
+def predict_fn(params, batch):
+    import jax.numpy as jnp
+
+    return {"y": jnp.tanh(batch["x"] @ params["w"] + params["b"])}
+
+
+def main():
+    hvd.init()
+    if hvd.rank() == 0 and not os.path.exists(CKPT):
+        from horovod_tpu.utils.checkpoint import save_rank0
+
+        rng = np.random.default_rng(0)
+        save_rank0(CKPT, {
+            "w": rng.standard_normal((DIM, OUT)).astype(np.float32),
+            "b": np.zeros(OUT, np.float32)})
+    hvd.barrier()
+    hvd.serving.serve_forever(
+        predict_fn, checkpoint=CKPT,
+        warmup_example={"x": np.zeros(DIM, np.float32)})
+
+
+if __name__ == "__main__":
+    main()

@@ -87,6 +87,7 @@ class FaultInjector:
         self._lock = threading.Lock()
         self._requests = 0
         self._collectives = 0
+        self._predicts = 0
         self._epoch = time.monotonic()
         self._skew_ms = 0.0
         self._hang = threading.Event()
@@ -95,7 +96,8 @@ class FaultInjector:
         self.fired = []
         events = plan.worker_events(
             proc, rank_offset, rank_offset + num_local)
-        self._by_trigger = {"requests": [], "collectives": [], "wall": []}
+        self._by_trigger = {"requests": [], "collectives": [],
+                            "predicts": [], "wall": []}
         for e in events:
             self._by_trigger[e.trigger].append(
                 _EventState(e, plan.rng_for(e)))
@@ -145,6 +147,28 @@ class FaultInjector:
             due = [st.event for st in self._by_trigger["requests"]
                    if st.due(n)]
         return self._apply(due, "requests", n, wire=True)
+
+    def before_predict(self, path=None):
+        """Serving-frontend hook: called before every predict request
+        the ingestion HTTP server accepts (serving/frontend.py) — the
+        serving twin of :meth:`before_request`, on its OWN counter so
+        a plan seeded against the fabric-request stream fires
+        identically whether or not serving traffic exists.  Returns
+        None or a wire action exactly like ``before_request``
+        (``("error", status)`` rejects the predict with that HTTP
+        status, ``("delay", secs)`` stalls it, ``("drop",)`` closes
+        the connection without a response); process kinds (``kill`` /
+        ``exit`` / ``hang``) fire inline — a replica dying on its n-th
+        predict is the deterministic mid-traffic failover scenario
+        ``ci.sh serve`` runs."""
+        if self._hang.is_set():
+            self._park()
+        with self._lock:
+            self._predicts += 1
+            n = self._predicts
+            due = [st.event for st in self._by_trigger["predicts"]
+                   if st.due(n)]
+        return self._apply(due, "predicts", n, wire=True)
 
     def on_collectives(self, n_entries=1):
         """Engine background-loop hook: called with the number of

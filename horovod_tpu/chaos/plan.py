@@ -36,10 +36,14 @@ path to a JSON file; ``horovodrun --fault-plan`` forwards it)::
 
 Every event names exactly one trigger — ``after_requests`` (the n-th
 fabric request this process issues), ``after_collectives`` (the n-th
-collective this process reports ready), or ``after_s`` (wall-clock
-offset from injector install) — plus a target (``proc`` index, or
-``rank`` for ``slow_rank``; terminal kinds require an explicit target
-so a sloppy plan cannot kill every process at once).  ``count`` fires
+collective this process reports ready), ``after_predicts`` (the n-th
+predict request this process's serving frontend receives — the
+ingestion path of :mod:`horovod_tpu.serving`, counted on its OWN
+counter so adding serving traffic never perturbs the fabric-request
+stream an existing plan was seeded against), or ``after_s``
+(wall-clock offset from injector install) — plus a target (``proc``
+index, or ``rank`` for ``slow_rank``; terminal kinds require an
+explicit target so a sloppy plan cannot kill every process at once).  ``count`` fires
 the event on that many consecutive trigger points (default 1);
 ``p`` gates each firing on a coin flip drawn from an RNG seeded by
 ``(seed, event index)``, so two runs of the same plan make identical
@@ -69,6 +73,7 @@ KINDS = PROCESS_KINDS + WIRE_KINDS + ENGINE_KINDS
 #: Trigger spellings -> canonical trigger name.
 _TRIGGERS = {"after_requests": "requests",
              "after_collectives": "collectives",
+             "after_predicts": "predicts",
              "after_s": "wall",
              # coordinator-side rules count matching requests
              "after": "requests"}
@@ -155,6 +160,10 @@ def _parse_event(index: int, raw: dict) -> FaultEvent:
     if at < 0:
         raise ValueError(
             f"fault event #{index}: trigger {trig_key} must be >= 0")
+    if side == "coord" and trig_key != "after":
+        raise ValueError(
+            f"fault event #{index}: coordinator-side events count "
+            f"matching requests via 'after', not {trig_key}")
     proc = raw.get("proc")
     rank = raw.get("rank")
     if kind == "slow_rank":

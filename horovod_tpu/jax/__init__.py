@@ -32,6 +32,7 @@ from ..common.basics import (  # noqa: F401
     rank, size, local_rank, local_size, cross_rank, cross_size,
     is_homogeneous, metrics, start_metrics_server, dump_trace,
 )
+from .. import serving  # noqa: F401
 from ..common.process_sets import (  # noqa: F401
     ProcessSet, add_process_set, remove_process_set, global_process_set,
 )
@@ -58,7 +59,7 @@ __all__ = [
     "DistributedOptimizer", "broadcast_parameters",
     "make_compiled_train_step", "allreduce", "allgather", "broadcast",
     "alltoall", "reducescatter", "run", "init", "shutdown", "rank",
-    "size", "metrics", "start_metrics_server", "dump_trace",
+    "size", "metrics", "start_metrics_server", "dump_trace", "serving",
 ]
 
 

@@ -5,6 +5,7 @@ from ..common.basics import (  # noqa: F401
     rank, size, local_rank, local_size, cross_rank, cross_size,
     metrics, start_metrics_server, dump_trace,
 )
+from .. import serving  # noqa: F401
 from ..tensorflow import (  # noqa: F401
     allreduce, allgather, broadcast, reducescatter, alltoall,
     broadcast_object, allgather_object,

@@ -55,7 +55,8 @@ from . import quantize as quantize_mod
 from .xla_ops import shard_map, _is_float
 
 __all__ = [
-    "CompiledGroupedAllreduce", "TopologyHint", "compiled_allreduce",
+    "CompiledGroupedAllreduce", "CompiledPredict", "TopologyHint",
+    "batch_signature", "compiled_allreduce",
     "compiled_grouped_allreduce", "make_compiled_train_step",
 ]
 
@@ -936,6 +937,82 @@ class CompiledGroupedAllreduce:
         the executor's row staging (xla_ops._stage_rows) so shard/stack
         layout logic lives in one place."""
         return ex._stage_rows(rows)
+
+
+def batch_signature(tree):
+    """Tree structure + leaf shapes/dtypes of a (batch or example)
+    pytree — THE batch-identity function.  Shared by
+    :class:`CompiledPredict` (cache key) and the serving batcher's
+    consistency split (serving/batcher.py), so "requests grouped as
+    consistent" and "batches that map to one compiled program" can
+    never drift apart."""
+    leaves, treedef = jax.tree.flatten(tree)
+    return (str(treedef),
+            tuple((tuple(np.shape(x)),
+                   str(getattr(x, "dtype", type(x).__name__)))
+                  for x in leaves))
+
+
+class CompiledPredict:
+    """Inference dispatch through the shared compiled-program cache —
+    the serving tier's entry into this module (docs/serving.md).
+
+    ``predict_fn(params, batch) -> outputs`` is the user's forward
+    pass; ``batch`` is a pytree of arrays whose leading dimension is
+    one of the serving batcher's BUCKETED batch sizes.  Each distinct
+    batch signature (tree structure + leaf shapes/dtypes) builds ONE
+    jitted program, registered in the same :func:`_shared_program`
+    cache the grouped allreduce and the compiled train step use — so
+    serving traffic rides ``horovod_program_cache_hits_total`` /
+    ``..._misses_total`` / ``horovod_compile_seconds_total``, and
+    "steady-state serving never recompiles" is assertable from a
+    metrics scrape (``ci.sh serve`` does exactly that).
+
+    The params tree is taken as shape-stable for the lifetime of this
+    object (a serving replica loads one checkpoint); swapping in
+    differently-shaped params warrants a fresh ``CompiledPredict`` —
+    the signature deliberately hashes only the batch, keeping the
+    per-request cost to one small tree flatten.
+
+    Engine-independent: predict is purely local compute, so this works
+    before ``hvd.init()`` and keeps working on a replica whose engine
+    aborted after a peer death — the property serving failover relies
+    on (a surviving replica keeps answering; only collectives die).
+    """
+
+    def __init__(self, predict_fn, name="predict"):
+        self.predict_fn = predict_fn
+        self.name = name
+        self._uid = None
+        self._programs = {}
+        self._lock = threading.Lock()
+
+    def _signature(self, batch):
+        return batch_signature(batch)
+
+    def _program(self, sig):
+        with self._lock:
+            prog = self._programs.get(sig)
+            if prog is None:
+                if self._uid is None:
+                    # reuse the executor-uid counter: any process-
+                    # unique token keyed alongside the signature works
+                    self._uid = _ex_uid(self)
+                prog = _shared_program(
+                    ("predict", self._uid, self.name, sig),
+                    lambda: jax.jit(self.predict_fn))
+                self._programs[sig] = prog
+            else:
+                _cache_metrics()[0].inc()
+            return prog
+
+    def __call__(self, params, batch):
+        return self._program(self._signature(batch))(params, batch)
+
+    def signatures(self):
+        """Batch signatures compiled so far (diagnostics/tests)."""
+        with self._lock:
+            return list(self._programs)
 
 
 # module-level cache so hot paths reuse programs across calls

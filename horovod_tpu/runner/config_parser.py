@@ -92,6 +92,33 @@ def set_env_from_args(env: dict, args) -> dict:
     if getattr(args, "heartbeat_window_seconds", None) is not None:
         env[HOROVOD_HEARTBEAT_WINDOW_SECONDS] = str(
             args.heartbeat_window_seconds)
+    if getattr(args, "serve", False):
+        env["HOROVOD_SERVING"] = "1"
+        # the autoscaler is blind without the replicas' snapshot
+        # stream: serving jobs push metrics even when no --metrics-port
+        # is exposed (an explicit --metrics-push-seconds above wins)
+        env.setdefault("HOROVOD_METRICS_PUSH_SECONDS", "2")
+    if getattr(args, "serve_port", None) is not None:
+        env["HOROVOD_SERVING_PORT"] = str(args.serve_port)
+    if getattr(args, "serve_max_batch_size", None) is not None:
+        env["HOROVOD_SERVING_MAX_BATCH_SIZE"] = str(
+            args.serve_max_batch_size)
+    if getattr(args, "serve_max_latency_ms", None) is not None:
+        env["HOROVOD_SERVING_MAX_LATENCY_MS"] = str(
+            args.serve_max_latency_ms)
+    if getattr(args, "serve_batch_buckets", None):
+        env["HOROVOD_SERVING_BATCH_BUCKETS"] = \
+            str(args.serve_batch_buckets)
+    if getattr(args, "serve_slo_p99_ms", None) is not None:
+        env["HOROVOD_SERVING_SLO_P99_MS"] = str(args.serve_slo_p99_ms)
+    if getattr(args, "serve_queue_high", None) is not None:
+        env["HOROVOD_SERVING_QUEUE_HIGH"] = str(args.serve_queue_high)
+    if getattr(args, "serve_autoscale_seconds", None) is not None:
+        env["HOROVOD_SERVING_AUTOSCALE_SECONDS"] = str(
+            args.serve_autoscale_seconds)
+    if getattr(args, "serve_drain_seconds", None) is not None:
+        env["HOROVOD_SERVING_DRAIN_SECONDS"] = str(
+            args.serve_drain_seconds)
     setb(HOROVOD_STALL_CHECK_DISABLE,
          getattr(args, "no_stall_check", False))
     if getattr(args, "stall_check_warning_time_seconds", None) is not None:

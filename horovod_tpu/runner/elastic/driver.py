@@ -64,6 +64,9 @@ class ElasticDriver:
 
         self._registry = WorkerStateRegistry(self, self._host_manager,
                                              reset_limit=reset_limit)
+        # autoscale lever (serving/autoscale.py): rounds are sized
+        # min(available slots, _target_np); starts wide open
+        self._target_np = max_np
         self._round = 0
         self._round_started_at = 0.0
         self._assignments: Dict[str, int] = {}
@@ -161,8 +164,43 @@ class ElasticDriver:
         hosts = self._host_manager.current_hosts
         host_infos = [HostInfo(h, hosts.host_slots[h])
                       for h in hosts.host_assignment_order]
-        np = min(hosts.count_available_slots(), self._max_np)
+        np = min(hosts.count_available_slots(), self._target_np)
         return get_host_assignments(host_infos, np)
+
+    def current_world_size(self) -> int:
+        """Workers in the current round (0 before the first forms)."""
+        with self._lock:
+            return len(self._assignments)
+
+    def set_target_np(self, target: int) -> int:
+        """Autoscale lever (serving/autoscale.py): retarget the fleet
+        to ``target`` workers, clamped to [min_np, max_np], and
+        re-form the round exactly like a membership change — scale-up
+        claims available slots, scale-down de-assigns workers (they
+        get the usual drain grace before termination).  Returns the
+        clamped target.  A no-op target keeps the current round."""
+        with self._lock:
+            target = max(self._min_np, min(int(target), self._max_np))
+            if target == self._target_np:
+                return target
+            prev, self._target_np = self._target_np, target
+            # only re-form a live round (round 0 = driver not started:
+            # start() will size its first round off the new target),
+            # and only when the EFFECTIVE size actually moves — a
+            # scale-up with no free slots must not bounce every
+            # replica through a re-rendezvous for zero capacity gain
+            # (the discovery thread starts the bigger round when new
+            # hosts appear; _compute_assignments reads the target)
+            effective = min(
+                self._host_manager.current_hosts
+                    .count_available_slots(), target)
+            changed = self._round > 0 and \
+                effective != len(self._assignments)
+        logger.info("autoscale target: %d -> %d workers", prev, target)
+        self._emit("autoscale_target", target=target, previous=prev)
+        if changed and not self._shutdown.is_set():
+            self._start_round()
+        return target
 
     def _start_round(self):
         with self._lock:

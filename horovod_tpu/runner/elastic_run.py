@@ -55,15 +55,38 @@ def run_elastic(args):
         cooldown_range=cooldown,
         platform="cpu" if args.cpu else None, verbose=args.verbose,
         elastic_timeout=getattr(args, "elastic_timeout", 600))
+    # serving jobs (--serve): the SLO autoscaler reads the replicas'
+    # pushed metric snapshots off this launcher's KV store and drives
+    # the fleet through driver.set_target_np (docs/serving.md)
+    autoscaler = None
+    if at_env.get("HOROVOD_SERVING"):
+        from ..serving.autoscale import Autoscaler, AutoscalePolicy
+
+        def _f(key, default):
+            try:
+                return float(at_env.get(key) or default)
+            except ValueError:
+                return default
+
+        autoscaler = Autoscaler(
+            driver, server.store,
+            policy=AutoscalePolicy(
+                slo_p99_ms=_f("HOROVOD_SERVING_SLO_P99_MS", 100.0),
+                queue_high=int(_f("HOROVOD_SERVING_QUEUE_HIGH", 64))),
+            interval_s=_f("HOROVOD_SERVING_AUTOSCALE_SECONDS", 5.0))
     try:
         # --start-timeout bounds waiting for min_np slots, NOT the job
         # runtime (reference launch_gloo_elastic semantics)
         driver.start(start_timeout=args.start_timeout)
+        if autoscaler is not None:
+            autoscaler.start()
         ok = driver.join()
     except TimeoutError as exc:
         print(f"horovod_tpu elastic: {exc}", flush=True)
         driver.stop(error=True)
         return 1
     finally:
+        if autoscaler is not None:
+            autoscaler.stop()
         server.stop()
     return 0 if ok else 1

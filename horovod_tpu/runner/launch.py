@@ -143,6 +143,51 @@ def parse_args(argv=None):
                         help="explicit missed-beat death window "
                              "(default 1.5x the interval; "
                              "HOROVOD_HEARTBEAT_WINDOW_SECONDS)")
+    # serving tier (docs/serving.md): --serve marks the job as an
+    # inference fleet — workers run hvd.serving.start() replicas, the
+    # knobs ride the same HOROVOD_SERVING_* env handoff as every other
+    # launcher setting, and (elastic jobs) the launcher attaches the
+    # SLO autoscaler to the elastic driver
+    parser.add_argument("--serve", action="store_true",
+                        help="serving job: enable the serving env "
+                             "handoff and (with elastic flags) the "
+                             "SLO-driven autoscaler "
+                             "(HOROVOD_SERVING=1)")
+    parser.add_argument("--serve-port", type=int, default=None,
+                        help="base port for per-replica HTTP predict "
+                             "frontends (replica i on a host binds "
+                             "port+i; HOROVOD_SERVING_PORT)")
+    parser.add_argument("--serve-max-batch-size", type=int,
+                        default=None,
+                        help="dynamic batcher: max requests per "
+                             "device batch "
+                             "(HOROVOD_SERVING_MAX_BATCH_SIZE)")
+    parser.add_argument("--serve-max-latency-ms", type=float,
+                        default=None,
+                        help="dynamic batcher: max time a request "
+                             "waits for co-riders "
+                             "(HOROVOD_SERVING_MAX_LATENCY_MS)")
+    parser.add_argument("--serve-batch-buckets", default=None,
+                        help="comma-separated bucketed batch sizes "
+                             "the compiled path pads to (default: "
+                             "powers of two up to the max; "
+                             "HOROVOD_SERVING_BATCH_BUCKETS)")
+    parser.add_argument("--serve-slo-p99-ms", type=float, default=None,
+                        help="p99 latency SLO the autoscaler defends "
+                             "(HOROVOD_SERVING_SLO_P99_MS)")
+    parser.add_argument("--serve-queue-high", type=int, default=None,
+                        help="queue-depth high-water mark that also "
+                             "triggers scale-up "
+                             "(HOROVOD_SERVING_QUEUE_HIGH)")
+    parser.add_argument("--serve-autoscale-seconds", type=float,
+                        default=None,
+                        help="autoscaler evaluation cadence "
+                             "(HOROVOD_SERVING_AUTOSCALE_SECONDS)")
+    parser.add_argument("--serve-drain-seconds", type=float,
+                        default=None,
+                        help="max time a draining replica waits for "
+                             "queued requests before shutdown "
+                             "(HOROVOD_SERVING_DRAIN_SECONDS)")
     # stall check
     parser.add_argument("--no-stall-check", action="store_true")
     parser.add_argument("--stall-check-warning-time-seconds", type=float,
@@ -207,7 +252,10 @@ def _run_static(args):
         platform="cpu" if args.cpu else None,
         verbose=args.verbose, fusion_threshold_bytes=fusion,
         start_timeout=args.start_timeout,
-        output_filename=args.output_filename)
+        output_filename=args.output_filename,
+        # a serving fleet DEGRADES on a replica death (survivors keep
+        # answering; docs/serving.md) — only training jobs collapse
+        stop_on_failure=not getattr(args, "serve", False))
     return max(codes) if codes else 0
 
 

@@ -138,9 +138,13 @@ def slot_env(slot: SlotInfo, *, rdv_addr, rdv_port, coordinator,
 
 
 class ProcessPool:
-    """Tracks spawned worker processes; one failure terminates all
-    (the reference's launcher kills the job when a worker dies,
-    safe_shell_exec process-tree semantics)."""
+    """Tracks spawned worker processes.  Training jobs terminate all
+    on one failure (the reference's launcher kills the job when a
+    worker dies, safe_shell_exec process-tree semantics); serving
+    jobs pass ``stop_on_failure=False`` so a dead replica DEGRADES
+    the fleet instead of collapsing it — survivors keep answering
+    while liveness/elastic machinery handles the replacement
+    (docs/serving.md "Failover")."""
 
     def __init__(self):
         self.procs: List[subprocess.Popen] = []
@@ -164,7 +168,7 @@ class ProcessPool:
         self.procs.append(p)
         return p
 
-    def wait(self, timeout=None) -> List[int]:
+    def wait(self, timeout=None, stop_on_failure=True) -> List[int]:
         deadline = time.monotonic() + timeout if timeout else None
         codes: List[Optional[int]] = [None] * len(self.procs)
         try:
@@ -172,7 +176,8 @@ class ProcessPool:
                 for i, p in enumerate(self.procs):
                     if codes[i] is None:
                         codes[i] = p.poll()
-                        if codes[i] is not None and codes[i] != 0:
+                        if codes[i] is not None and codes[i] != 0 \
+                                and stop_on_failure:
                             self.terminate()
                 if deadline and time.monotonic() > deadline:
                     self.terminate()
@@ -208,7 +213,8 @@ def launch_procs(command: List[str], np: int, hosts: str = None,
                  platform: str = None, verbose: bool = False,
                  fusion_threshold_bytes: int = 64 * 1024 * 1024,
                  start_timeout: float = None,
-                 output_filename: str = None):
+                 output_filename: str = None,
+                 stop_on_failure: bool = True):
     """Launch ``command`` once per slot with full env handoff; blocks
     until all workers exit.  Returns list of exit codes.
 
@@ -328,7 +334,8 @@ def launch_procs(command: List[str], np: int, hosts: str = None,
                 out_files += [stdout, stderr]
             pool.spawn(cmd, spawn_env, stdout=stdout, stderr=stderr,
                        stdin_data=payload)
-        codes = pool.wait(timeout=start_timeout)
+        codes = pool.wait(timeout=start_timeout,
+                          stop_on_failure=stop_on_failure)
     finally:
         pool.terminate()
         server.stop()

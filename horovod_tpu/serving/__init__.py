@@ -1,0 +1,169 @@
+"""Elastic inference serving tier — ``hvd.serving`` (docs/serving.md).
+
+A first-class inference workload on the training engine's control
+plane (ROADMAP item 4): per-host HTTP ingestion
+(:mod:`.frontend`), dynamic batching into the cached compiled path
+(:mod:`.batcher` → :class:`..ops.compiled.CompiledPredict`), replicas
+that load params through the checkpoint broadcast convention and
+register liveness through the heartbeat verbs (:mod:`.replica`), and
+SLO-driven autoscaling through the elastic driver (:mod:`.autoscale`).
+
+Minimal replica (what ``horovodrun --serve`` workers run)::
+
+    import horovod_tpu as hvd
+
+    def predict_fn(params, batch):          # batch: (B, ...) arrays
+        return batch["x"] @ params["w"] + params["b"]
+
+    handle = hvd.serving.start(predict_fn, checkpoint="/ckpt/model.pkl",
+                               warmup_example={"x": np.zeros(64, "f4")})
+    handle.wait()                           # serve until stopped
+"""
+
+import logging
+import os
+import sys
+import threading
+
+from ..common import basics
+from ..common import env as env_mod
+from .batcher import (  # noqa: F401
+    DrainingError, DynamicBatcher, PredictFuture, default_buckets,
+)
+from .replica import ServingConfig, ServingReplica  # noqa: F401
+from .frontend import (  # noqa: F401
+    ServingFrontend, decode_example, encode_example,
+)
+from .autoscale import (  # noqa: F401
+    Autoscaler, AutoscalePolicy, quantile_from_buckets,
+)
+
+logger = logging.getLogger("horovod_tpu.serving")
+
+__all__ = [
+    "start", "serve_forever", "ServingHandle", "ServingConfig",
+    "ServingReplica", "ServingFrontend", "DynamicBatcher",
+    "DrainingError", "Autoscaler", "AutoscalePolicy",
+    "default_buckets", "quantile_from_buckets", "decode_example",
+    "encode_example",
+]
+
+
+def _port_offset():
+    """Stable per-host port offset so replicas sharing a host all
+    bind: the static launcher's proc index, or the elastic slot's
+    local rank (elastic proc ids are per-round, ports must not be)."""
+    off = env_mod.get_int(env_mod.HOROVOD_TPU_PROC_INDEX, -1)
+    if off >= 0:
+        return off
+    return env_mod.get_int(env_mod.HOROVOD_LOCAL_RANK, 0)
+
+
+class ServingHandle:
+    """A started replica + frontend; ``wait()`` until ``stop()``."""
+
+    def __init__(self, replica, frontend, config):
+        self.replica = replica
+        self.frontend = frontend
+        self.config = config
+        self._stopped = threading.Event()
+
+    @property
+    def port(self):
+        return self.frontend.port
+
+    def wait(self, timeout=None, should_stop=None,
+             stop_on_abort=None):
+        """Block until :meth:`stop` (or ``should_stop()`` turns true,
+        polled every 200 ms).  ``stop_on_abort``: whether an engine
+        abort (peer death, stale round) also ends the wait — default
+        True only for ELASTIC replicas, which must bounce into
+        re-rendezvous; a static replica's predict path holds no
+        collectives, so it keeps serving through a peer death (the
+        degraded-fleet semantics docs/serving.md describes).
+        Returns True when stopped, False on timeout."""
+        import time
+        if stop_on_abort is None:
+            stop_on_abort = env_mod.get_bool(env_mod.HOROVOD_ELASTIC)
+        deadline = time.monotonic() + timeout if timeout else None
+        while not self._stopped.is_set():
+            if should_stop is not None and should_stop():
+                return True
+            if stop_on_abort and basics.is_initialized() and \
+                    basics.engine()._aborted is not None:
+                return True
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            self._stopped.wait(0.2)
+        return True
+
+    def stop(self):
+        """Drain in-flight requests, then stop the frontend.  Order
+        matters: ``/healthz`` flips to draining first (new requests
+        get 503 and retry a peer), queued requests complete, and only
+        then does the listener close."""
+        try:
+            self.replica.drain()
+        finally:
+            self.frontend.stop()
+            self.replica.close()
+            self._stopped.set()
+
+
+def start(predict_fn, params=None, checkpoint=None, config=None,
+          warmup_example=None, port=None, name="predict"):
+    """Bring up one serving replica + its HTTP frontend; returns a
+    :class:`ServingHandle` (``horovodrun --serve`` workers then just
+    ``handle.wait()``).  Initializes the runtime if needed — under the
+    launcher that performs the full rendezvous, param broadcast and
+    heartbeat registration; standalone it serves single-process."""
+    basics.init()
+    config = config or ServingConfig()
+    replica = ServingReplica(predict_fn, params=params,
+                             checkpoint=checkpoint, config=config,
+                             name=name)
+    if warmup_example is not None:
+        replica.warmup(warmup_example)
+    if port is None:
+        port = config.port + _port_offset() if config.port else 0
+    frontend = ServingFrontend(replica, port=port)
+    frontend.start()
+    return ServingHandle(replica, frontend, config)
+
+
+def serve_forever(predict_fn, params=None, checkpoint=None,
+                  config=None, warmup_example=None, port=None,
+                  should_stop=None):
+    """The elastic serving loop: serve; on an engine abort (peer died,
+    round reset) drain, tear down and re-join the next round — the
+    serving twin of ``hvd.elastic.run``'s reset cycle.  After a peer
+    DEATH the jax distributed client cannot re-initialize in-process,
+    so like elastic training the worker exec-restarts itself; with a
+    graceful membership change it re-inits in place.  Returns when
+    ``should_stop()`` turns true (or on KeyboardInterrupt)."""
+    while True:
+        handle = start(predict_fn, params=params, checkpoint=checkpoint,
+                       config=config, warmup_example=warmup_example,
+                       port=port)
+        try:
+            handle.wait(should_stop=should_stop)
+        except KeyboardInterrupt:
+            handle.stop()
+            return
+        aborted = basics.is_initialized() and \
+            basics.engine()._aborted is not None
+        handle.stop()
+        if should_stop is not None and should_stop():
+            basics.shutdown()
+            return
+        if not aborted:
+            basics.shutdown()
+            return
+        if basics.needs_exec_restart():
+            logger.warning("serving replica exec-restarting into the "
+                           "next elastic round")
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os.execv(sys.executable, [sys.executable] + sys.argv)
+        basics.shutdown()
+        basics.init()

@@ -1,0 +1,272 @@
+"""SLO-driven autoscaling for the serving tier.
+
+Runs on the **launcher**, beside the elastic driver — the only place
+that already has (a) the job-wide metric stream every replica pushes
+over the KV fabric (telemetry/exporter.py MetricsPusher → the same
+snapshots the coordinator's ``/metrics`` merges) and (b) the lever
+that changes the fleet: :meth:`ElasticDriver.set_target_np`.
+
+The loop every ``interval`` seconds:
+
+1. merge the replicas' pushed snapshots (``telemetry.merge_snapshots``
+   — identical semantics to a job-wide scrape);
+2. extract the SLO signals: **p99** of
+   ``horovod_serving_request_seconds`` over the last window (bucket
+   deltas, not lifetime — an SLO is about now) and the **max** queue
+   depth across replicas (``horovod_serving_queue_depth``);
+3. hand them to :class:`AutoscalePolicy.decide` — consecutive-breach
+   hysteresis up, long-idle hysteresis down, cooldown after every
+   move;
+4. apply the target through the elastic driver, which re-forms the
+   round at the new size exactly like any other membership change
+   (replicas re-rendezvous; docs/serving.md "Autoscaling").
+
+The policy is a pure function of its inputs so tests drive it without
+threads or clocks.
+"""
+
+import json
+import logging
+import threading
+import time
+
+logger = logging.getLogger("horovod_tpu.serving")
+
+__all__ = ["quantile_from_buckets", "AutoscalePolicy", "Autoscaler"]
+
+
+def quantile_from_buckets(bounds, counts, q):
+    """Quantile estimate from a Prometheus-style histogram: linear
+    interpolation inside the bucket the target rank falls in (the
+    standard ``histogram_quantile`` estimator).  ``counts`` are
+    per-bucket (non-cumulative), one longer than ``bounds`` (+Inf
+    last).  Returns None when the histogram is empty; observations in
+    the +Inf bucket clamp to the top bound."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    target = q * total
+    acc = 0.0
+    for i, c in enumerate(counts):
+        if c <= 0:
+            continue
+        if acc + c >= target:
+            if i >= len(bounds):        # +Inf bucket
+                return float(bounds[-1]) if bounds else None
+            lo = float(bounds[i - 1]) if i > 0 else 0.0
+            hi = float(bounds[i])
+            return lo + (hi - lo) * (target - acc) / c
+        acc += c
+    return float(bounds[-1]) if bounds else None
+
+
+class AutoscalePolicy:
+    """Hysteresis + cooldown around the two SLO signals.
+
+    Scale **up** one step after ``breach_evals`` consecutive windows
+    with p99 over the SLO or queue depth over the high-water mark;
+    scale **down** one step after ``idle_evals`` consecutive windows
+    with p99 under ``idle_frac`` of the SLO AND an (almost) empty
+    queue.  Every move starts a ``cooldown_s`` during which the fleet
+    holds still — a resize re-forms the round, and deciding again off
+    mid-resize noise would oscillate."""
+
+    def __init__(self, slo_p99_ms=100.0, queue_high=64,
+                 breach_evals=2, idle_evals=6, idle_frac=0.25,
+                 idle_queue=1, cooldown_s=30.0):
+        self.slo_p99_s = float(slo_p99_ms) / 1000.0
+        self.queue_high = int(queue_high)
+        self.breach_evals = int(breach_evals)
+        self.idle_evals = int(idle_evals)
+        self.idle_frac = float(idle_frac)
+        self.idle_queue = int(idle_queue)
+        self.cooldown_s = float(cooldown_s)
+        self._breaches = 0
+        self._idles = 0
+        self._cooldown_until = 0.0
+        #: (reason, p99_s, queue) of the most recent decision
+        self.last = None
+
+    def decide(self, p99_s, queue_depth, current, now=None):
+        """→ target replica count (== ``current`` for "hold")."""
+        now = time.monotonic() if now is None else now
+        if now < self._cooldown_until:
+            # windows observed mid-resize are noise (replicas
+            # re-rendezvousing, queues rebalancing): hold AND restart
+            # the streaks so the next decision needs fresh evidence
+            self._breaches = self._idles = 0
+            self.last = ("cooldown", p99_s, queue_depth)
+            return current
+        breach = (p99_s is not None and p99_s > self.slo_p99_s) or \
+            queue_depth > self.queue_high
+        idle = (p99_s is None or p99_s < self.slo_p99_s *
+                self.idle_frac) and queue_depth <= self.idle_queue
+        self._breaches = self._breaches + 1 if breach else 0
+        self._idles = self._idles + 1 if idle else 0
+        if self._breaches >= self.breach_evals:
+            self._breaches = self._idles = 0
+            self._cooldown_until = now + self.cooldown_s
+            self.last = ("scale_up", p99_s, queue_depth)
+            return current + 1
+        if self._idles >= self.idle_evals and current > 1:
+            self._idles = 0
+            self._cooldown_until = now + self.cooldown_s
+            self.last = ("scale_down", p99_s, queue_depth)
+            return current - 1
+        self.last = ("hold", p99_s, queue_depth)
+        return current
+
+
+class Autoscaler:
+    """Launcher-side loop: replica metric stream → policy → elastic
+    driver.  ``driver`` needs ``set_target_np(n)`` and
+    ``current_world_size()`` (ElasticDriver); ``store`` is the
+    launcher's KV store the replicas push snapshots into."""
+
+    LATENCY_FAMILY = "horovod_serving_request_seconds"
+    QUEUE_FAMILY = "horovod_serving_queue_depth"
+
+    def __init__(self, driver, store, policy=None, interval_s=5.0):
+        self.driver = driver
+        self.store = store
+        self.policy = policy or AutoscalePolicy()
+        self.interval_s = max(float(interval_s), 0.5)
+        #: how long a snapshot's bytes may stay unchanged before it is
+        #: treated as a dead replica's frozen last push
+        self.staleness_s = max(3.0 * self.interval_s, 10.0)
+        #: per-KV-key cumulative latency counts (window deltas are
+        #: PER REPLICA: a replica whose snapshot re-enters the merge
+        #: must not inject its whole lifetime into one window)
+        self._prev_counts = {}
+        #: per-KV-key (raw bytes, last-changed LAUNCHER monotonic) —
+        #: the staleness clock; never compares cross-host wall clocks
+        self._seen = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="horovod_tpu-serving-autoscale",
+            daemon=True)
+        #: decision log (bounded) — surfaced in driver events/tests
+        self.decisions = []
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+
+    # -- signal extraction ---------------------------------------------------
+
+    def _fresh_payloads(self):
+        """{kv key: families} for snapshots still being PUSHED.
+
+        Staleness is judged on the LAUNCHER's monotonic clock — a
+        snapshot whose bytes stop changing for the horizon is a dead
+        replica's frozen last push (every live push differs at least
+        in its ``ts`` stamp).  Comparing the payload's worker-side
+        wall clock against the launcher's would silently discard every
+        snapshot from a host whose clock is skewed (the very drift
+        utils/clock_sync.py exists for); without aging frozen pushes
+        out, a killed replica's queue-depth gauge would pin the policy
+        in permanent scale-up."""
+        from ..telemetry import TELEMETRY_KV_PREFIX
+
+        horizon = self.staleness_s
+        now = time.monotonic()
+        out = {}
+        for key, raw in sorted(
+                self.store.scope(TELEMETRY_KV_PREFIX).items()):
+            prev = self._seen.get(key)
+            if prev is None or prev[0] != raw:
+                self._seen[key] = (raw, now)
+            elif now - prev[1] > horizon:
+                continue
+            try:
+                payload = json.loads(raw)
+                out[key] = payload.get("families", {})
+            except (ValueError, AttributeError):
+                continue
+        return out
+
+    def read_signals(self, payloads=None):
+        """(p99 seconds over the last window or None, max queue depth,
+        any-serving-telemetry-seen) from the replicas' fresh
+        snapshots.  Window deltas are tracked per replica key so a
+        snapshot (re)entering the set only contributes what it
+        observed since its last inclusion — never its whole lifetime
+        in one "window"."""
+        payloads = self._fresh_payloads() if payloads is None \
+            else payloads
+        p99 = None
+        seen_serving = False
+        bounds, window = None, None
+        queue = 0.0
+        for key, fams in payloads.items():
+            lat = fams.get(self.LATENCY_FAMILY)
+            if lat and lat.get("type") == "histogram":
+                seen_serving = True
+                b = lat.get("buckets", [])
+                counts = [0] * (len(b) + 1)
+                for sample in lat.get("samples", []):
+                    for i, c in enumerate(sample.get("counts", [])):
+                        if i < len(counts):
+                            counts[i] += c
+                prev = self._prev_counts.get(key)
+                delta = [max(c - p, 0) for c, p in zip(counts, prev)] \
+                    if prev is not None and len(prev) == len(counts) \
+                    else counts
+                self._prev_counts[key] = counts
+                if bounds is None:
+                    bounds, window = b, [0] * len(counts)
+                if list(b) == list(bounds) and \
+                        len(delta) == len(window):
+                    window = [a + d for a, d in zip(window, delta)]
+            qd = fams.get(self.QUEUE_FAMILY)
+            if qd:
+                seen_serving = True
+                for sample in qd.get("samples", []):
+                    queue = max(queue,
+                                float(sample.get("value", 0.0)))
+        if window is not None:
+            p99 = quantile_from_buckets(bounds, window, 0.99)
+        return p99, queue, seen_serving
+
+    # -- loop ----------------------------------------------------------------
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.evaluate()
+            except Exception:  # noqa: BLE001 — autoscaling must never
+                # kill the launcher; next window re-evaluates
+                logger.exception("autoscale evaluation failed")
+
+    def evaluate(self, now=None):
+        """One policy evaluation (the loop body, callable directly in
+        tests/smokes).  Returns (p99_s, queue_depth, target)."""
+        p99, queue, seen = self.read_signals()
+        current = self.driver.current_world_size()
+        if current <= 0:
+            return p99, queue, current      # round not formed yet
+        if not seen:
+            # NO serving telemetry at all (pushing disabled, replicas
+            # still warming, or every snapshot stale): hold — absence
+            # of data must never read as "idle" and melt a loaded
+            # fleet down to min_np
+            return p99, queue, current
+        target = self.policy.decide(p99, queue, current, now=now)
+        if target != current:
+            reason = self.policy.last[0]
+            logger.warning(
+                "autoscale: %s %d -> %d (p99=%s queue=%.0f slo=%.3fs)",
+                reason, current, target,
+                f"{p99:.4f}s" if p99 is not None else "n/a", queue,
+                self.policy.slo_p99_s)
+            applied = self.driver.set_target_np(target)
+            self.decisions.append(
+                {"reason": reason, "from": current, "to": applied,
+                 "p99_s": p99, "queue": queue})
+            del self.decisions[:-64]
+        return p99, queue, target

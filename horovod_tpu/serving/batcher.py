@@ -1,0 +1,317 @@
+"""Dynamic request batching into the compiled path.
+
+The serving tier's throughput lever: single predict requests are
+coalesced into batches under a **max-latency / max-batch-size**
+policy (the classic dynamic-batching contract: a request waits at
+most ``max_latency_ms`` for co-riders; a full batch dispatches
+immediately), then padded up to a small set of **bucketed batch
+shapes** so the compiled path (:class:`..ops.compiled.CompiledPredict`)
+serves steady-state traffic from a handful of cached XLA programs —
+zero recompiles once every bucket is warm, which ``ci.sh serve``
+asserts via the program-cache hit/miss counters.
+
+Threading model: callers (frontend HTTP handler threads) block in
+:meth:`DynamicBatcher.submit(...).result` while one background
+dispatch thread forms and runs batches; results are sliced back per
+request.  Shutdown is **drain, not drop**: :meth:`drain` stops intake,
+flushes every queued request through the model, and only then lets
+the replica exit — the "zero dropped in-flight requests" half of the
+failover contract (docs/serving.md).
+"""
+
+import threading
+import time
+
+from .. import telemetry
+
+__all__ = ["DynamicBatcher", "DrainingError", "PredictFuture",
+           "default_buckets"]
+
+
+class DrainingError(RuntimeError):
+    """Raised by :meth:`DynamicBatcher.submit` once the replica is
+    draining/closed.  A DISTINCT type so the frontend can map exactly
+    this to 503-retry-a-peer — a model/runtime failure (including
+    jax's XlaRuntimeError, which also subclasses RuntimeError) is the
+    request's own 400, not a rotation signal."""
+
+
+def default_buckets(max_batch_size):
+    """Power-of-two bucket ladder up to ``max_batch_size`` (inclusive;
+    the max itself is always a bucket so a full batch never pads)."""
+    buckets, b = [], 1
+    while b < max_batch_size:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_batch_size)
+    return tuple(dict.fromkeys(buckets))
+
+
+class PredictFuture:
+    """Completion handle for one submitted request."""
+
+    __slots__ = ("_event", "_result", "_error")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result = None
+        self._error = None
+
+    def _set(self, result):
+        self._result = result
+        self._event.set()
+
+    def _set_error(self, exc):
+        self._error = exc
+        self._event.set()
+
+    def done(self):
+        return self._event.is_set()
+
+    def result(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("predict request timed out")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class _Pending:
+    __slots__ = ("inputs", "future", "enqueued_at")
+
+    def __init__(self, inputs):
+        self.inputs = inputs
+        self.future = PredictFuture()
+        self.enqueued_at = time.monotonic()
+
+
+class DynamicBatcher:
+    """Coalesce queued predict requests into bucketed batches.
+
+    ``dispatch(batch, n_real)`` is the model call: ``batch`` is a
+    pytree of numpy arrays with leading dimension equal to one of
+    ``buckets`` (requests stacked, padding rows appended), ``n_real``
+    how many leading rows are real requests; it returns outputs with
+    the same leading dimension.  Each request's inputs are a pytree of
+    per-example arrays (no batch dim) sharing one structure.
+
+    Padding repeats the last real example rather than feeding zeros —
+    a model with data-dependent control (masking, top-k) sees only
+    in-distribution rows, and the padded rows' outputs are discarded
+    anyway.
+    """
+
+    def __init__(self, dispatch, max_batch_size=16, max_latency_ms=5.0,
+                 buckets=None, name="serving"):
+        if max_batch_size < 1:
+            raise ValueError("max_batch_size must be >= 1")
+        self.dispatch = dispatch
+        self.max_batch_size = int(max_batch_size)
+        self.max_latency_s = float(max_latency_ms) / 1000.0
+        buckets = tuple(sorted(set(
+            int(b) for b in (buckets or
+                             default_buckets(self.max_batch_size)))))
+        if not buckets or buckets[0] < 1:
+            raise ValueError(f"invalid batch buckets {buckets}")
+        if buckets[-1] != self.max_batch_size:
+            raise ValueError(
+                f"largest bucket {buckets[-1]} must equal "
+                f"max_batch_size {self.max_batch_size} (anything "
+                f"bigger never dispatches; anything smaller forces "
+                f"splitting full batches)")
+        self.buckets = buckets
+        self._queue = []
+        self._cv = threading.Condition()
+        self._closed = False
+        self._draining = False
+        self._inflight = 0          # requests inside dispatch right now
+        self._install_metrics(name)
+        self._thread = threading.Thread(
+            target=self._loop, name="horovod_tpu-serving-batcher",
+            daemon=True)
+        self._thread.start()
+
+    # -- telemetry -----------------------------------------------------------
+
+    def _install_metrics(self, name):
+        reg = telemetry.registry()
+        self._m_queue = reg.gauge(
+            "horovod_serving_queue_depth",
+            "Predict requests queued awaiting batch formation")
+        self._m_batches = reg.counter(
+            "horovod_serving_batches_total",
+            "Batches dispatched, by what flushed them",
+            labelnames=("reason",))
+        # fixed power-of-two ladder (NOT this batcher's bucket list):
+        # bucket bounds are part of a family's identity — two batchers
+        # configured differently must still share one family
+        self._m_batch_occupancy = reg.histogram(
+            "horovod_serving_batch_occupancy",
+            "Real requests per dispatched batch",
+            buckets=tuple(float(2 ** i) for i in range(11)))
+        self._m_padded = reg.counter(
+            "horovod_serving_padded_rows_total",
+            "Padding rows added to reach a bucketed batch shape")
+
+    # -- intake --------------------------------------------------------------
+
+    def submit(self, inputs):
+        """Queue one request; returns its :class:`PredictFuture`.
+        Raises :class:`DrainingError` once draining/closed — the
+        frontend maps exactly that to 503 so a load balancer retries
+        a peer replica."""
+        p = _Pending(inputs)
+        with self._cv:
+            if self._closed or self._draining:
+                raise DrainingError("serving batcher is draining")
+            self._queue.append(p)
+            self._m_queue.set(len(self._queue))
+            self._cv.notify_all()
+        return p.future
+
+    def queue_depth(self):
+        with self._cv:
+            return len(self._queue)
+
+    # -- batch formation -----------------------------------------------------
+
+    def _take_batch_locked(self):
+        take = self._queue[:self.max_batch_size]
+        del self._queue[:len(take)]
+        self._m_queue.set(len(self._queue))
+        self._inflight += len(take)
+        return take
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while not self._queue and not self._closed:
+                    self._cv.wait()
+                if self._closed and not self._queue:
+                    return
+                # a batch exists; hold it open until the OLDEST
+                # request's latency budget expires or the batch fills
+                deadline = self._queue[0].enqueued_at + self.max_latency_s
+                while len(self._queue) < self.max_batch_size \
+                        and not self._closed and not self._draining:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(remaining)
+                    if not self._queue:
+                        break       # drained by a racing flush
+                if not self._queue:
+                    continue
+                if len(self._queue) >= self.max_batch_size:
+                    reason = "full"
+                elif self._closed or self._draining:
+                    reason = "drain"
+                else:
+                    reason = "latency"
+                take = self._take_batch_locked()
+            self._run_batch(take, reason)
+
+    @staticmethod
+    def _split_consistent(take):
+        """Partition a batch by input signature (tree structure + leaf
+        shapes/dtypes, the SAME ``batch_signature`` the compiled-
+        predict cache keys by): the MAJORITY signature proceeds;
+        stragglers get their own per-request error instead of
+        poisoning their co-riders (one client's malformed request must
+        not 400 seven innocent ones)."""
+        from ..ops.compiled import batch_signature
+
+        groups = {}
+        for p in take:
+            groups.setdefault(batch_signature(p.inputs), []).append(p)
+        if len(groups) == 1:
+            return take, []
+        keep_sig = max(groups, key=lambda s: len(groups[s]))
+        keep, rejected = [], []
+        for s, members in groups.items():
+            (keep if s == keep_sig else rejected).extend(members)
+        return keep, rejected
+
+    def _run_batch(self, take, reason):
+        import numpy as np
+        import jax
+
+        total = len(take)
+        take, rejected = self._split_consistent(take)
+        for p in rejected:
+            p.future._set_error(ValueError(
+                "request input signature differs from the rest of its "
+                "batch (shape/dtype/structure mismatch with this "
+                "model's traffic)"))
+        n = len(take)
+        bucket = next(b for b in self.buckets if b >= n)
+        try:
+            trees = [p.inputs for p in take]
+            leaves0, treedef = jax.tree.flatten(trees[0])
+            all_leaves = [jax.tree.flatten(t)[0] for t in trees]
+            stacked = []
+            for k in range(len(leaves0)):
+                rows = [np.asarray(lv[k]) for lv in all_leaves]
+                if bucket > n:
+                    rows = rows + [rows[-1]] * (bucket - n)
+                stacked.append(np.stack(rows))
+            batch = jax.tree.unflatten(treedef, stacked)
+            outputs = self.dispatch(batch, n)
+            out_leaves, out_def = jax.tree.flatten(outputs)
+            for i, p in enumerate(take):
+                p.future._set(jax.tree.unflatten(
+                    out_def, [np.asarray(lv)[i] for lv in out_leaves]))
+        except Exception as exc:  # noqa: BLE001 — propagate per request
+            for p in take:
+                p.future._set_error(exc)
+        finally:
+            with self._cv:
+                self._inflight -= total   # rejected stragglers too
+                self._cv.notify_all()
+            self._m_batches.labels(reason=reason).inc()
+            self._m_batch_occupancy.observe(n)
+            if bucket > n:
+                self._m_padded.inc(bucket - n)
+
+    # -- shutdown ------------------------------------------------------------
+
+    def drain(self, timeout=30.0):
+        """Stop intake, flush every queued request through the model,
+        wait for in-flight batches.  Returns the number of requests
+        completed during the drain.  Every future submitted before the
+        drain is completed (result or error) — nothing is dropped."""
+        with self._cv:
+            if self._draining:
+                pending = 0
+            else:
+                self._draining = True
+                pending = len(self._queue) + self._inflight
+            self._cv.notify_all()
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while (self._queue or self._inflight) and \
+                    time.monotonic() < deadline:
+                self._cv.wait(0.1)
+            leftover = len(self._queue)
+            inflight = self._inflight
+        if leftover or inflight:
+            # a hung model call is NOT a completed drain: callers'
+            # futures are still unset — report it, don't claim success
+            raise TimeoutError(
+                f"drain timed out with {leftover} requests queued and "
+                f"{inflight} in flight")
+        return pending
+
+    def close(self, timeout=30.0):
+        """Drain, then stop the dispatch thread."""
+        try:
+            self.drain(timeout=timeout)
+        finally:
+            with self._cv:
+                self._closed = True
+                self._cv.notify_all()
+            self._thread.join(timeout=5.0)
+
+    @property
+    def draining(self):
+        return self._draining or self._closed

@@ -1,0 +1,238 @@
+"""Per-host HTTP ingestion frontend for the serving tier.
+
+Same pattern as the telemetry exporter's per-worker endpoint
+(telemetry/exporter.py MetricsServer): a stdlib threading HTTP server,
+one per replica process, bound at ``HOROVOD_SERVING_PORT + proc``
+(``horovodrun --serve-port``).  JSON in, JSON out — the external load
+balancer's contract:
+
+* ``POST /predict``        ``{"inputs": <example>}`` → ``{"outputs": ...}``
+* ``POST /predict_batch``  ``{"inputs": [<example>, ...]}`` →
+  ``{"outputs": [...]}`` — each element enters the batcher as its own
+  request, so a client batch and loose singles coalesce into the same
+  bucketed device batches;
+* ``GET /healthz``         readiness: 200 while accepting, 503 while
+  draining (a load balancer drains this replica out of rotation);
+* ``GET /stats``           queue depth / buckets / counters (JSON);
+* ``GET /metrics``         this replica's Prometheus exposition
+  (same renderer as the telemetry endpoint — one scrape target per
+  replica even when ``--metrics-port`` isn't set).
+
+**Chaos** rides the ingestion path exactly like it rides the fabric
+client: every accepted predict request is offered to the process-wide
+:class:`..chaos.FaultInjector` (``before_predict``, its own
+deterministic ``after_predicts`` counter), so a fault plan can 503,
+delay, drop, or — the failover drill — ``kill`` this replica on its
+n-th predict, with the ``fired`` log staying seed-deterministic.
+
+Examples are JSON: scalars/nested lists (``{"__ndarray__": ..,
+"dtype": ..}`` wrappers optional for explicit dtypes).  Binary/tensor
+transports are a frontend concern external gateways can layer on; the
+batcher/replica below this speak numpy either way.
+"""
+
+import json
+import logging
+import threading
+
+import numpy as np
+
+from .batcher import DrainingError
+
+logger = logging.getLogger("horovod_tpu.serving")
+
+__all__ = ["ServingFrontend", "encode_example", "decode_example"]
+
+
+def decode_example(obj):
+    """JSON payload → pytree of numpy arrays (dicts/lists of numbers
+    become arrays; ``{"__ndarray__": data, "dtype": d}`` pins a
+    dtype)."""
+    if isinstance(obj, dict):
+        if "__ndarray__" in obj:
+            return np.asarray(obj["__ndarray__"],
+                              dtype=np.dtype(obj.get("dtype", "float32")))
+        return {k: decode_example(v) for k, v in obj.items()}
+    return np.asarray(obj, dtype=np.float32) \
+        if not isinstance(obj, np.ndarray) else obj
+
+
+def encode_example(obj):
+    """Pytree of arrays → JSON-able structure.  Dict/list/tuple
+    containers keep their structure (a multi-output model returning
+    ``(logits, embedding)`` must not be flattened — or worse, raise —
+    on the HTTP path); tuples encode as JSON lists."""
+    if isinstance(obj, dict):
+        return {k: encode_example(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [encode_example(v) for v in obj]
+    arr = np.asarray(obj)
+    return arr.item() if arr.ndim == 0 else arr.tolist()
+
+
+class ServingFrontend:
+    """HTTP ingestion server over one :class:`.replica.ServingReplica`
+    (or anything with ``predict_one`` / ``submit`` / ``draining`` /
+    ``batcher``)."""
+
+    def __init__(self, replica, port=0, addr="0.0.0.0"):
+        self.replica = replica
+        self.addr = addr
+        self._port = port
+        self._httpd = None
+        self._thread = None
+
+    # -- request handling ----------------------------------------------------
+
+    def _chaos_gate(self, handler, path):
+        """Offer this predict request to the fault injector.  Returns
+        True when the request was consumed by a fault (response
+        already sent / connection dropped); sleeps through delays."""
+        from .. import chaos
+
+        inj = chaos.current()
+        if inj is None:
+            return False
+        act = inj.before_predict(path)
+        if act is None:
+            return False
+        if act[0] == "delay":
+            import time
+            time.sleep(act[1])
+            return False
+        if act[0] == "error":
+            handler.reply(act[1], json.dumps(
+                {"error": "chaos: injected serving error"}).encode())
+            return True
+        if act[0] == "drop":
+            # no response at all: the client sees a dead socket and
+            # retries a peer — the load-balancer failover path
+            import socket as _socket
+            handler.close_connection = True
+            try:
+                handler.connection.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+            return True
+        return False    # duplicate: meaningless server-side, inert
+
+    def _predict(self, handler, payload, batch):
+        replica = self.replica
+        try:
+            if batch:
+                examples = [decode_example(e)
+                            for e in payload.get("inputs", [])]
+                outs = [encode_example(o)
+                        for o in replica.predict_many(examples)]
+                body = {"outputs": outs, "n": len(outs)}
+            else:
+                out = replica.predict_one(
+                    decode_example(payload.get("inputs")),
+                    path="predict")
+                body = {"outputs": encode_example(out)}
+            handler.reply(200, json.dumps(body).encode(),
+                          "application/json")
+        except DrainingError as exc:
+            # draining: tell the balancer to take its traffic
+            # elsewhere.  EXACTLY this type — a model failure (jax's
+            # XlaRuntimeError also subclasses RuntimeError) is the
+            # request's own 400 below, never a rotation signal
+            handler.reply(503, json.dumps(
+                {"error": str(exc), "draining": True}).encode(),
+                "application/json")
+        except Exception as exc:  # noqa: BLE001 — model/shape errors
+            # belong to THIS request, not the server
+            handler.reply(400, json.dumps(
+                {"error": f"{type(exc).__name__}: {exc}"}).encode(),
+                "application/json")
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        from http.server import BaseHTTPRequestHandler
+        import socketserver
+
+        frontend = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # silence
+                pass
+
+            def reply(self, code, payload=b"",
+                      content_type="application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                if payload:
+                    self.wfile.write(payload)
+
+            def do_GET(self):
+                path = self.path.partition("?")[0]
+                replica = frontend.replica
+                if path == "/healthz":
+                    draining = replica.draining
+                    self.reply(503 if draining else 200, json.dumps({
+                        "status": "draining" if draining else "ok",
+                    }).encode())
+                elif path == "/stats":
+                    self.reply(200, json.dumps({
+                        "queue_depth": replica.batcher.queue_depth(),
+                        "buckets": list(replica.batcher.buckets),
+                        "max_batch_size": replica.batcher.max_batch_size,
+                        "max_latency_ms":
+                            replica.batcher.max_latency_s * 1000.0,
+                        "draining": replica.draining,
+                    }).encode())
+                elif path == "/metrics":
+                    from ..telemetry import (
+                        CONTENT_TYPE_LATEST, registry, render_prometheus,
+                    )
+                    self.reply(200,
+                               render_prometheus(
+                                   registry().snapshot()).encode(),
+                               CONTENT_TYPE_LATEST)
+                else:
+                    self.reply(404, b'{"error": "not found"}')
+
+            def do_POST(self):
+                path = self.path.partition("?")[0]
+                if path not in ("/predict", "/predict_batch"):
+                    return self.reply(404, b'{"error": "not found"}')
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                if frontend._chaos_gate(self, path):
+                    return
+                try:
+                    payload = json.loads(body) if body else {}
+                except ValueError:
+                    return self.reply(
+                        400, b'{"error": "body is not JSON"}')
+                frontend._predict(self, payload,
+                                  batch=(path == "/predict_batch"))
+
+        class _Server(socketserver.ThreadingMixIn,
+                      socketserver.TCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._httpd = _Server((self.addr, self._port), _Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="horovod_tpu-serving-frontend", daemon=True)
+        self._thread.start()
+        logger.info("serving frontend listening on %s:%d", self.addr,
+                    self.port)
+        return self.port
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1] if self._httpd else None
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

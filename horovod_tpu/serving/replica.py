@@ -1,0 +1,259 @@
+"""One serving replica: params in, batched compiled predict out.
+
+A replica is one worker process of a serving job.  It reuses the
+training control plane end to end instead of inventing a parallel one
+(ROADMAP item 4):
+
+* **params** arrive through the rank-0-loads + broadcast convention
+  (:func:`..utils.checkpoint.load_and_broadcast`) so every replica of
+  a round starts bit-identical — the same primitive elastic training
+  restores through;
+* **liveness** is the PR 5 heartbeat: ``hvd.init()`` already beats the
+  coordinator's ``heartbeat`` verb from a dedicated thread, so a dead
+  replica is declared within ~2 heartbeat intervals, its host is
+  blacklisted by the elastic driver, and the job-wide ``/metrics``
+  shows ``horovod_worker_alive{proc=...} 0`` — serving adds only the
+  ``horovod_serving_replica_up`` gauge flipped during drain;
+* **dispatch** rides the compiled-program cache
+  (:class:`..ops.compiled.CompiledPredict`): one cached XLA program
+  per bucketed batch shape, warmed at startup so steady-state traffic
+  never compiles.
+
+The predict hot path runs NO collectives — after the initial
+broadcast a replica is self-sufficient, which is exactly why a peer
+dying mid-traffic leaves the survivors answering (the failover
+scenario ``ci.sh serve`` kills a replica to verify).
+"""
+
+import logging
+import time
+
+from .. import telemetry
+from ..common import basics
+from ..common import env as env_mod
+from ..ops.compiled import CompiledPredict
+from .batcher import DynamicBatcher, default_buckets
+
+logger = logging.getLogger("horovod_tpu.serving")
+
+__all__ = ["ServingConfig", "ServingReplica"]
+
+HOROVOD_SERVING_PORT = "HOROVOD_SERVING_PORT"
+HOROVOD_SERVING_MAX_BATCH_SIZE = "HOROVOD_SERVING_MAX_BATCH_SIZE"
+HOROVOD_SERVING_MAX_LATENCY_MS = "HOROVOD_SERVING_MAX_LATENCY_MS"
+HOROVOD_SERVING_BATCH_BUCKETS = "HOROVOD_SERVING_BATCH_BUCKETS"
+HOROVOD_SERVING_SLO_P99_MS = "HOROVOD_SERVING_SLO_P99_MS"
+HOROVOD_SERVING_QUEUE_HIGH = "HOROVOD_SERVING_QUEUE_HIGH"
+HOROVOD_SERVING_AUTOSCALE_SECONDS = "HOROVOD_SERVING_AUTOSCALE_SECONDS"
+HOROVOD_SERVING_DRAIN_SECONDS = "HOROVOD_SERVING_DRAIN_SECONDS"
+
+
+class ServingConfig:
+    """Serving knobs, resolved from ``HOROVOD_SERVING_*`` (the
+    ``horovodrun --serve-*`` flags ride the same env handoff every
+    other launcher knob uses; docs/serving.md has the table)."""
+
+    def __init__(self, port=None, max_batch_size=None,
+                 max_latency_ms=None, buckets=None, slo_p99_ms=None,
+                 queue_high=None, autoscale_interval_s=None,
+                 drain_timeout_s=None):
+        self.port = port if port is not None else \
+            env_mod.get_int(HOROVOD_SERVING_PORT, 0)
+        self.max_batch_size = max_batch_size if max_batch_size is not None \
+            else env_mod.get_int(HOROVOD_SERVING_MAX_BATCH_SIZE, 16)
+        self.max_latency_ms = max_latency_ms if max_latency_ms is not None \
+            else env_mod.get_float(HOROVOD_SERVING_MAX_LATENCY_MS, 5.0)
+        if buckets is not None:
+            self.buckets = tuple(int(b) for b in buckets)
+        else:
+            raw = env_mod.get_str(HOROVOD_SERVING_BATCH_BUCKETS)
+            self.buckets = tuple(int(b) for b in raw.split(",")) \
+                if raw else default_buckets(self.max_batch_size)
+        self.slo_p99_ms = slo_p99_ms if slo_p99_ms is not None else \
+            env_mod.get_float(HOROVOD_SERVING_SLO_P99_MS, 100.0)
+        self.queue_high = queue_high if queue_high is not None else \
+            env_mod.get_int(HOROVOD_SERVING_QUEUE_HIGH, 64)
+        self.autoscale_interval_s = autoscale_interval_s \
+            if autoscale_interval_s is not None else \
+            env_mod.get_float(HOROVOD_SERVING_AUTOSCALE_SECONDS, 5.0)
+        self.drain_timeout_s = drain_timeout_s \
+            if drain_timeout_s is not None else \
+            env_mod.get_float(HOROVOD_SERVING_DRAIN_SECONDS, 30.0)
+
+
+class ServingReplica:
+    """Load params, serve batched predicts through the compiled path.
+
+    ``predict_fn(params, batch) -> outputs`` with ``batch`` a pytree
+    of arrays carrying a leading (bucketed) batch dimension.  Params
+    come from ``params=`` directly or ``checkpoint=`` (a path saved by
+    :func:`..utils.checkpoint.save_rank0`): rank 0 loads, every rank
+    receives the broadcast, a load failure raises collectively.
+    """
+
+    def __init__(self, predict_fn, params=None, checkpoint=None,
+                 config=None, name="predict"):
+        if (params is None) == (checkpoint is None):
+            raise ValueError(
+                "pass exactly one of params= or checkpoint=")
+        self.config = config or ServingConfig()
+        if checkpoint is not None:
+            if basics.is_initialized() and basics.size() > 1:
+                from ..utils.checkpoint import load_and_broadcast
+                params = load_and_broadcast(checkpoint)
+            else:
+                import pickle
+                with open(checkpoint, "rb") as f:
+                    params = pickle.load(f)
+        self.params = params
+        self.predict = CompiledPredict(predict_fn, name=name)
+        self._install_metrics()
+        self.batcher = DynamicBatcher(
+            self._dispatch,
+            max_batch_size=self.config.max_batch_size,
+            max_latency_ms=self.config.max_latency_ms,
+            buckets=self.config.buckets)
+        self._up.set(1)
+
+    # -- telemetry -----------------------------------------------------------
+
+    def _install_metrics(self):
+        reg = telemetry.registry()
+        # ms-scale SLO ladder, NOT the engine-cycle default
+        # (telemetry/registry.py REQUEST_LATENCY_BUCKETS): p50/p99
+        # between 0.5 ms and 10 s need resolution there
+        self._m_latency = reg.histogram(
+            "horovod_serving_request_seconds",
+            "Predict latency, submit to response, per entry path",
+            labelnames=("path",),
+            buckets=telemetry.REQUEST_LATENCY_BUCKETS)
+        self._m_model = reg.histogram(
+            "horovod_serving_model_seconds",
+            "Model execution time per dispatched batch",
+            buckets=telemetry.REQUEST_LATENCY_BUCKETS)
+        self._m_requests = reg.counter(
+            "horovod_serving_requests_total",
+            "Predict requests completed, by outcome",
+            labelnames=("outcome",))
+        self._up = reg.gauge(
+            "horovod_serving_replica_up",
+            "1 while this replica accepts predict requests "
+            "(0 = draining or stopped)")
+
+    # -- dispatch ------------------------------------------------------------
+
+    def _dispatch(self, batch, n_real):
+        t0 = time.perf_counter()
+        out = self.predict(self.params, batch)
+        import jax
+
+        # block until device results materialize so the latency
+        # histogram measures the model, not async dispatch
+        def _block(x):
+            if hasattr(x, "block_until_ready"):
+                x.block_until_ready()
+            return x
+
+        out = jax.tree.map(_block, out)
+        self._m_model.observe(time.perf_counter() - t0)
+        return out
+
+    def warmup(self, example):
+        """Compile every bucket's program now (one padded batch per
+        bucket) so the first real request never pays an XLA compile.
+        ``example`` is one request's input pytree."""
+        import numpy as np
+        import jax
+
+        leaves, treedef = jax.tree.flatten(example)
+        for b in self.batcher.buckets:
+            batch = jax.tree.unflatten(
+                treedef,
+                [np.stack([np.asarray(lv)] * b) for lv in leaves])
+            self._dispatch(batch, b)
+        logger.info("serving warm-up complete: %d bucketed programs "
+                    "(batch sizes %s)", len(self.batcher.buckets),
+                    list(self.batcher.buckets))
+
+    # -- request path --------------------------------------------------------
+
+    def submit(self, inputs):
+        """Queue one request; returns its future (frontend path)."""
+        return self.batcher.submit(inputs)
+
+    def predict_one(self, inputs, timeout=None, path="predict"):
+        """Blocking single predict (in-process convenience + the
+        frontend's worker-thread body)."""
+        from .batcher import DrainingError
+
+        t0 = time.perf_counter()
+        timeout = timeout if timeout is not None else \
+            max(self.config.drain_timeout_s, 10.0)
+        try:
+            fut = self.batcher.submit(inputs)
+            out = fut.result(timeout)
+        except DrainingError:
+            # intake rejection during a routine drain: the request was
+            # never served — counting it as outcome=error would spray
+            # phantom failures over every scale-down/shutdown
+            raise
+        except Exception:
+            self._m_requests.labels(outcome="error").inc()
+            raise
+        self._m_latency.labels(path=path).observe(
+            time.perf_counter() - t0)
+        self._m_requests.labels(outcome="ok").inc()
+        return out
+
+    def predict_many(self, examples, timeout=None,
+                     path="predict_batch"):
+        """Blocking multi-request predict: every example enters the
+        batcher as its OWN request (client batches and loose singles
+        coalesce into the same bucketed device batches); results come
+        back in order."""
+        t0 = time.perf_counter()
+        timeout = timeout if timeout is not None else \
+            max(self.config.drain_timeout_s, 10.0)
+        # an intake rejection (DrainingError) propagates uncounted —
+        # nothing was served (requests already queued before the drain
+        # complete server-side; the client retries the batch on a peer)
+        futures = [self.batcher.submit(e) for e in examples]
+        outs, first_err, ok, errs = [], None, 0, 0
+        # await EVERY future before accounting: an early failure must
+        # not mis-attribute the later co-riders' real successes (they
+        # were dispatched and served regardless)
+        for f in futures:
+            try:
+                outs.append(f.result(timeout))
+                ok += 1
+            except Exception as exc:  # noqa: BLE001 — per-request
+                errs += 1
+                if first_err is None:
+                    first_err = exc
+        dt = time.perf_counter() - t0
+        for _ in range(ok):
+            self._m_latency.labels(path=path).observe(dt)
+        if ok:
+            self._m_requests.labels(outcome="ok").inc(ok)
+        if errs:
+            self._m_requests.labels(outcome="error").inc(errs)
+        if first_err is not None:
+            raise first_err
+        return outs
+
+    @property
+    def draining(self):
+        return self.batcher.draining
+
+    def drain(self):
+        """Stop intake, flush the queue, flip the up-gauge.  Returns
+        the number of requests completed during the drain."""
+        self._up.set(0)
+        done = self.batcher.drain(timeout=self.config.drain_timeout_s)
+        logger.info("serving replica drained (%d in-flight requests "
+                    "completed)", done)
+        return done
+
+    def close(self):
+        self._up.set(0)
+        self.batcher.close(timeout=self.config.drain_timeout_s)

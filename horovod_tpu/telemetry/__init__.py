@@ -26,6 +26,7 @@ docs/observability.md for the family catalogue.
 from .registry import (  # noqa: F401
     MetricRegistry, registry, install_registry, fresh_registry,
     merge_snapshots, DEFAULT_LATENCY_BUCKETS,
+    REQUEST_LATENCY_BUCKETS,
 )
 from .exporter import (  # noqa: F401
     render_prometheus, render_json, MetricsServer,

@@ -205,8 +205,15 @@ class MetricsPusher:
         return self
 
     def push_now(self):
+        import time
+
+        # ts lets snapshot consumers with liveness semantics (the
+        # serving autoscaler's queue-depth gauge) age out a dead
+        # worker's frozen last push; the /metrics merge keeps using
+        # the round/proc guards instead (counters must survive)
         payload = render_json(registry().snapshot(),
-                              proc=self.proc_id, **self.meta)
+                              proc=self.proc_id, ts=time.time(),
+                              **self.meta)
         try:
             self.client.put(f"{TELEMETRY_KV_PREFIX}{self.proc_id}",
                             payload.encode())

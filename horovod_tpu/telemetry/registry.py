@@ -25,20 +25,37 @@ Design constraints:
   ``/metrics``.
 """
 
+import logging
 import re
 import threading
 
 __all__ = [
     "MetricRegistry", "registry", "install_registry", "fresh_registry",
     "merge_snapshots", "DEFAULT_LATENCY_BUCKETS",
+    "REQUEST_LATENCY_BUCKETS",
 ]
+
+logger = logging.getLogger("horovod_tpu.telemetry")
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 
-#: Default histogram ladder for latencies in seconds: 100us .. 60s.
+#: Default histogram ladder for latencies in seconds: 100us .. 60s —
+#: tuned for engine cycle / negotiation times.
 DEFAULT_LATENCY_BUCKETS = (
     0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
     0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+#: Ladder for ms-scale request latencies (seconds): the serving tier's
+#: SLO histograms live between 0.5 ms and 10 s, where the engine-cycle
+#: ladder above has almost no resolution.  Families pick their bounds
+#: at registration time (``histogram(..., buckets=...)``); the bounds
+#: become part of the family's identity — re-registering with
+#: different bounds raises, and :func:`merge_snapshots` refuses to
+#: silently co-bucket heterogeneous ladders.
+REQUEST_LATENCY_BUCKETS = (
+    0.0005, 0.001, 0.002, 0.003, 0.005, 0.0075, 0.01, 0.015, 0.025,
+    0.05, 0.075, 0.1, 0.15, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
 
 
@@ -255,6 +272,15 @@ class MetricRegistry:
                 raise ValueError(
                     f"metric {name} already registered as {fam.type}, "
                     f"not {mtype}")
+            elif mtype == "histogram" and buckets is not None \
+                    and tuple(buckets) != fam.buckets:
+                # bucket bounds are part of a histogram family's
+                # identity: two declaring sites disagreeing would have
+                # the second site's observations silently mis-bucketed
+                # into the first site's ladder
+                raise ValueError(
+                    f"histogram {name} already registered with buckets "
+                    f"{fam.buckets}, not {tuple(buckets)}")
             return fam
 
     def counter(self, name, help_text="", labelnames=()):
@@ -317,10 +343,16 @@ def merge_snapshots(snapshots):
       an ``agg`` label with ``max`` and ``min`` samples (a queue-depth
       or stalled-tensor gauge answers "is ANY worker unhealthy", so
       the extremes are the aggregation, not the mean);
-    * **histograms** merge bucket-wise (identical ladders by
-      construction — every worker runs the same code).
+    * **histograms** merge bucket-wise.  Ladders are per-family now
+      (``histogram(..., buckets=...)``), so two workers disagreeing on
+      a family's bounds — a version skew, or two subsystems fighting
+      over one name — can no longer be co-bucketed honestly: the
+      mismatched worker's samples are DROPPED from the aggregate with
+      a warning naming the family, instead of silently mis-bucketing
+      its counts into the wrong bounds.
     """
     merged = {}
+    mismatched = set()
     for snap in snapshots:
         if not isinstance(snap, dict):
             continue
@@ -335,6 +367,18 @@ def merge_snapshots(snapshots):
                 }
                 if "buckets" in fam:
                     out["buckets"] = list(fam["buckets"])
+            elif out["type"] == "histogram" and \
+                    list(fam.get("buckets", [])) != \
+                    out.get("buckets", []):
+                if name not in mismatched:
+                    mismatched.add(name)
+                    logger.warning(
+                        "merge_snapshots: histogram %s has "
+                        "heterogeneous bucket bounds across workers "
+                        "(%s vs %s); dropping the mismatched "
+                        "worker's samples from the aggregate", name,
+                        fam.get("buckets"), out.get("buckets"))
+                continue
             acc = out["_acc"]
             for sample in fam.get("samples", []):
                 key = tuple(sorted(sample.get("labels", {}).items()))
@@ -352,6 +396,15 @@ def merge_snapshots(snapshots):
                                          zip(cur["counts"], counts)]
                         cur["sum"] += float(sample.get("sum", 0.0))
                         cur["count"] += int(sample.get("count", 0))
+                    elif name not in mismatched:
+                        # same bounds list but ragged counts: a
+                        # half-written push — still refuse silently
+                        mismatched.add(name)
+                        logger.warning(
+                            "merge_snapshots: histogram %s sample has "
+                            "%d buckets where the family has %d; "
+                            "dropping it from the aggregate", name,
+                            len(counts), len(cur["counts"]))
                 else:
                     val = float(sample.get("value", 0.0))
                     cur = acc.get(key)

@@ -24,6 +24,7 @@ from ..common.basics import (  # noqa: F401
     start_timeline, stop_timeline, dump_trace,
     metrics, start_metrics_server,
 )
+from .. import serving  # noqa: F401
 from ..common.exceptions import (  # noqa: F401
     HorovodInternalError, HostsUpdatedInterrupt,
 )

@@ -69,13 +69,19 @@ def test_conv1x1_bn_grads_match_reference(fold):
     for name, p, r in zip(names, gp, gr):
         if not fold and name in ("da", "db"):
             continue
-        # dx tolerance is bf16-cotangent rounding: the kernel (like the
-        # unfused path, where the conv-output cotangent round-trips
-        # through the bf16 activation) feeds the backward MXU matmuls
-        # in bf16
+        # dx tolerance is bf16-cotangent rounding: the kernel rounds
+        # the COMBINED cotangent ytot = dy + ds1 + 2*y*ds2 (|ytot| up
+        # to ~128 here, so one bf16 ulp is 1.0 and each element
+        # carries up to 0.5 of rounding) to bf16 before the dx matmul,
+        # while the reference's autodiff contracts the unrounded f32
+        # cotangent; over the N=48-term contraction the rounding
+        # residues random-walk to ~sqrt(48)*0.25*E|w| ~= 1 absolute on
+        # elements where the products cancel (observed max 0.93).
+        # rtol covers the large elements; the atol floor must cover
+        # that cancellation noise.
         np.testing.assert_allclose(
             np.asarray(p, np.float32), np.asarray(r, np.float32),
-            rtol=0.1, atol=0.8, err_msg=name)
+            rtol=0.1, atol=1.6, err_msg=name)
 
 
 def test_bn_fold_matches_batchnorm_math():

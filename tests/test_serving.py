@@ -1,0 +1,641 @@
+"""Serving tier tests (docs/serving.md): dynamic batcher policy
+(max-latency vs max-batch flush, bucketed padding, drain-on-shutdown),
+the compiled-path no-recompile contract via the program-cache
+counters, the HTTP ingestion frontend + chaos fault injection on the
+predict path (seed-deterministic ``fired`` log), per-family histogram
+bucket bounds + loud heterogeneous merge, the autoscale policy, and
+the elastic driver's autoscale lever."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import serving, telemetry
+from horovod_tpu.chaos.inject import FaultInjector, _reset_for_tests
+from horovod_tpu.chaos.plan import parse_plan
+from horovod_tpu.ops.compiled import CompiledPredict
+from horovod_tpu.serving.autoscale import (
+    AutoscalePolicy, Autoscaler, quantile_from_buckets,
+)
+from horovod_tpu.serving.batcher import DynamicBatcher, default_buckets
+from horovod_tpu.telemetry.registry import (
+    MetricRegistry, REQUEST_LATENCY_BUCKETS, merge_snapshots,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def fresh_registry():
+    reg = telemetry.fresh_registry()
+    yield reg
+    telemetry.fresh_registry()
+
+
+@pytest.fixture()
+def clean_injector():
+    _reset_for_tests()
+    yield
+    _reset_for_tests()
+
+
+# -- batcher ------------------------------------------------------------------
+
+class _RecordingDispatch:
+    """Dispatch stub recording every (batch rows, n_real) call."""
+
+    def __init__(self, gate=None, fail=False):
+        self.calls = []
+        self.gate = gate
+        self.fail = fail
+
+    def __call__(self, batch, n_real):
+        if self.gate is not None:
+            self.gate.wait(10)
+        if self.fail:
+            raise ValueError("model exploded")
+        self.calls.append((int(batch["x"].shape[0]), n_real))
+        return {"y": batch["x"] * 2.0}
+
+
+def test_default_buckets_ladder():
+    assert default_buckets(16) == (1, 2, 4, 8, 16)
+    assert default_buckets(12) == (1, 2, 4, 8, 12)
+    assert default_buckets(1) == (1,)
+
+
+def test_batcher_max_batch_flush(fresh_registry):
+    d = _RecordingDispatch()
+    b = DynamicBatcher(d, max_batch_size=4, max_latency_ms=10_000)
+    futs = [b.submit({"x": np.full(3, i, np.float32)})
+            for i in range(4)]
+    outs = [f.result(10) for f in futs]
+    # a full batch dispatches immediately — nobody waited for the
+    # 10-second latency budget
+    assert d.calls == [(4, 4)]
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(o["y"], np.full(3, 2.0 * i))
+    fam = telemetry.registry().get("horovod_serving_batches_total")
+    assert fam.value(reason="full") == 1
+    b.close()
+
+
+def test_batcher_max_latency_flush(fresh_registry):
+    d = _RecordingDispatch()
+    b = DynamicBatcher(d, max_batch_size=64, max_latency_ms=30)
+    t0 = time.monotonic()
+    out = b.submit({"x": np.ones(2, np.float32)}).result(10)
+    dt = time.monotonic() - t0
+    np.testing.assert_allclose(out["y"], 2.0)
+    # flushed by the latency budget (well under the 64-batch fill),
+    # after waiting ~max_latency for co-riders
+    assert d.calls == [(1, 1)]
+    assert 0.02 <= dt < 5.0
+    assert telemetry.registry().get(
+        "horovod_serving_batches_total").value(reason="latency") == 1
+    b.close()
+
+
+def test_batcher_bucket_padding(fresh_registry):
+    d = _RecordingDispatch()
+    b = DynamicBatcher(d, max_batch_size=8, max_latency_ms=20,
+                       buckets=(1, 2, 4, 8))
+    futs = [b.submit({"x": np.full(2, i, np.float32)})
+            for i in range(3)]
+    outs = [f.result(10) for f in futs]
+    # 3 requests pad up to the 4-bucket; padding rows are discarded
+    assert d.calls == [(4, 3)]
+    assert [float(o["y"][0]) for o in outs] == [0.0, 2.0, 4.0]
+    assert telemetry.counter_total(
+        "horovod_serving_padded_rows_total") == 1
+    b.close()
+
+
+def test_batcher_drain_returns_every_queued_request(fresh_registry):
+    gate = threading.Event()
+    d = _RecordingDispatch(gate=gate)
+    b = DynamicBatcher(d, max_batch_size=2, max_latency_ms=1)
+    # first batch blocks inside dispatch; the rest queue behind it
+    futs = [b.submit({"x": np.full(1, i, np.float32)})
+            for i in range(6)]
+    time.sleep(0.1)
+    drained = []
+
+    def drain():
+        drained.append(b.drain(timeout=10))
+
+    t = threading.Thread(target=drain)
+    t.start()
+    # new intake is refused during the drain (frontend maps to 503)
+    time.sleep(0.05)
+    with pytest.raises(RuntimeError):
+        b.submit({"x": np.zeros(1, np.float32)})
+    gate.set()
+    t.join(timeout=10)
+    # every queued request completed with its real result
+    for i, f in enumerate(futs):
+        np.testing.assert_allclose(f.result(10)["y"], 2.0 * i)
+    assert drained and drained[0] == 6
+    b.close()
+
+
+def test_batcher_dispatch_error_propagates_per_request(fresh_registry):
+    b = DynamicBatcher(_RecordingDispatch(fail=True),
+                       max_batch_size=2, max_latency_ms=1)
+    f = b.submit({"x": np.ones(1, np.float32)})
+    with pytest.raises(ValueError, match="model exploded"):
+        f.result(10)
+    # a poisoned batch must not wedge the batcher
+    b.drain(timeout=5)
+    b.close()
+
+
+def test_batcher_rejects_inconsistent_buckets():
+    with pytest.raises(ValueError, match="largest bucket"):
+        DynamicBatcher(lambda b, n: b, max_batch_size=8,
+                       buckets=(1, 2, 4))
+
+
+def test_batcher_malformed_request_spares_co_riders(fresh_registry):
+    """One client's bad shape must 400 only that client: the batch's
+    majority signature dispatches normally."""
+    d = _RecordingDispatch()
+    b = DynamicBatcher(d, max_batch_size=4, max_latency_ms=10_000)
+    good = [b.submit({"x": np.full(3, i, np.float32)})
+            for i in range(3)]
+    bad = b.submit({"x": np.zeros(5, np.float32)})     # wrong dim
+    with pytest.raises(ValueError, match="signature differs"):
+        bad.result(10)
+    for i, f in enumerate(good):
+        np.testing.assert_allclose(f.result(10)["y"], 2.0 * i)
+    assert d.calls == [(4, 3)]      # 3 real rows, padded to bucket 4
+    b.close()
+
+
+def test_batcher_drain_timeout_reports_hung_inflight(fresh_registry):
+    gate = threading.Event()
+    b = DynamicBatcher(_RecordingDispatch(gate=gate),
+                       max_batch_size=1, max_latency_ms=1)
+    b.submit({"x": np.ones(1, np.float32)})
+    time.sleep(0.1)                 # batch now wedged inside dispatch
+    with pytest.raises(TimeoutError, match="in flight"):
+        b.drain(timeout=0.3)
+    gate.set()                      # unwedge so close() can finish
+    b.close()
+
+
+def test_draining_error_is_distinct_from_model_errors():
+    from horovod_tpu.serving import DrainingError
+
+    assert issubclass(DrainingError, RuntimeError)
+    d = _RecordingDispatch()
+    b = DynamicBatcher(d, max_batch_size=2, max_latency_ms=1)
+    b.drain(timeout=5)
+    with pytest.raises(DrainingError):
+        b.submit({"x": np.ones(1, np.float32)})
+    b.close()
+
+
+def test_encode_example_preserves_tuple_outputs():
+    from horovod_tpu.serving import encode_example
+
+    out = encode_example((np.arange(2.0), {"e": np.float32(1.5)}))
+    assert out == [[0.0, 1.0], {"e": 1.5}]
+
+
+# -- compiled path: no recompiles in steady state -----------------------------
+
+def test_bucketed_predict_never_recompiles_steady_state(fresh_registry):
+    w = np.random.randn(6, 3).astype(np.float32)
+    pred = CompiledPredict(lambda p, b: b["x"] @ p["w"], name="nr")
+    hits0 = telemetry.counter_total("horovod_program_cache_hits_total")
+    miss0 = telemetry.counter_total(
+        "horovod_program_cache_misses_total")
+    buckets = (1, 2, 4)
+    for b in buckets:            # warm-up: one compile per bucket
+        pred({"w": w}, {"x": np.zeros((b, 6), np.float32)})
+    warm_miss = telemetry.counter_total(
+        "horovod_program_cache_misses_total")
+    assert warm_miss - miss0 == len(buckets)
+    for _ in range(5):           # steady state: cache hits only
+        for b in buckets:
+            pred({"w": w}, {"x": np.ones((b, 6), np.float32)})
+    assert telemetry.counter_total(
+        "horovod_program_cache_misses_total") == warm_miss
+    assert telemetry.counter_total(
+        "horovod_program_cache_hits_total") - hits0 == 15
+    # compile time was attributed (the first call per bucket pays XLA)
+    assert telemetry.counter_total(
+        "horovod_compile_seconds_total") > 0
+
+
+def test_replica_warmup_covers_every_bucket(fresh_registry,
+                                            hvd_shutdown):
+    hvd.init()
+    w = np.random.randn(4, 2).astype(np.float32)
+    replica = serving.ServingReplica(
+        lambda p, b: {"y": b["x"] @ p["w"]}, params={"w": w},
+        config=serving.ServingConfig(max_batch_size=4,
+                                     max_latency_ms=2,
+                                     buckets=(1, 2, 4)))
+    miss0 = telemetry.counter_total(
+        "horovod_program_cache_misses_total")
+    replica.warmup({"x": np.zeros(4, np.float32)})
+    warm = telemetry.counter_total(
+        "horovod_program_cache_misses_total")
+    assert warm - miss0 == 3
+    out = replica.predict_one({"x": np.ones(4, np.float32)})
+    np.testing.assert_allclose(out["y"], w.sum(axis=0), rtol=1e-6)
+    # served from the warmed programs — zero new compiles
+    assert telemetry.counter_total(
+        "horovod_program_cache_misses_total") == warm
+    replica.close()
+
+
+# -- frontend + chaos on the ingestion path -----------------------------------
+
+def _post(url, payload, timeout=10):
+    req = urllib.request.Request(
+        url, json.dumps(payload).encode(),
+        {"Content-Type": "application/json"})
+    try:
+        resp = urllib.request.urlopen(req, timeout=timeout)
+        return resp.getcode(), json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+@pytest.fixture()
+def served_replica(fresh_registry, hvd_shutdown):
+    hvd.init()
+    w = np.arange(8, dtype=np.float32).reshape(4, 2)
+    replica = serving.ServingReplica(
+        lambda p, b: {"y": b["x"] @ p["w"]}, params={"w": w},
+        config=serving.ServingConfig(max_batch_size=4,
+                                     max_latency_ms=2))
+    frontend = serving.ServingFrontend(replica, port=0,
+                                       addr="127.0.0.1")
+    frontend.start()
+    yield replica, frontend, f"http://127.0.0.1:{frontend.port}", w
+    frontend.stop()
+    replica.close()
+
+
+def test_frontend_predict_single_and_batch(served_replica):
+    replica, frontend, url, w = served_replica
+    code, body = _post(f"{url}/predict",
+                       {"inputs": {"x": [1.0, 0.0, 0.0, 0.0]}})
+    assert code == 200
+    np.testing.assert_allclose(body["outputs"]["y"], w[0])
+    code, body = _post(
+        f"{url}/predict_batch",
+        {"inputs": [{"x": [0.0, 1.0, 0.0, 0.0]},
+                    {"x": [0.0, 0.0, 1.0, 0.0]}]})
+    assert code == 200 and body["n"] == 2
+    np.testing.assert_allclose(body["outputs"][0]["y"], w[1])
+    np.testing.assert_allclose(body["outputs"][1]["y"], w[2])
+    # SLO families populated with the ms-scale ladder
+    fam = telemetry.registry().get("horovod_serving_request_seconds")
+    assert fam.buckets == tuple(REQUEST_LATENCY_BUCKETS)
+    assert fam.total() == 3      # 1 single + 2 batch entries
+    assert telemetry.registry().get(
+        "horovod_serving_requests_total").value(outcome="ok") == 3
+
+
+def test_frontend_healthz_and_drain(served_replica):
+    replica, frontend, url, _ = served_replica
+    assert urllib.request.urlopen(
+        f"{url}/healthz", timeout=10).getcode() == 200
+    replica.drain()
+    with pytest.raises(urllib.error.HTTPError) as err:
+        urllib.request.urlopen(f"{url}/healthz", timeout=10)
+    assert err.value.code == 503
+    # draining replicas 503 new predicts so the balancer retries peers
+    code, body = _post(f"{url}/predict",
+                       {"inputs": {"x": [1.0, 0.0, 0.0, 0.0]}})
+    assert code == 503 and body.get("draining")
+    assert telemetry.counter_total(
+        "horovod_serving_replica_up") == 0
+
+
+def test_frontend_bad_request_is_400_not_500(served_replica):
+    _, _, url, _ = served_replica
+    code, body = _post(f"{url}/predict",
+                       {"inputs": {"x": [1.0, 2.0]}})   # wrong shape
+    assert code == 400 and "error" in body
+    code, _ = _post(f"{url}/nope", {})
+    assert code == 404
+
+
+def test_chaos_faults_predict_requests(served_replica, clean_injector):
+    from horovod_tpu import chaos
+
+    _, _, url, w = served_replica
+    plan = parse_plan({"seed": 11, "events": [
+        {"kind": "http_error", "code": 503, "after_predicts": 2,
+         "count": 2},
+        {"kind": "delay_ms", "ms": 80, "after_predicts": 5,
+         "count": 1},
+        {"kind": "drop", "after_predicts": 6, "count": 1},
+    ]})
+    inj = chaos.install(plan)
+    codes, times = [], []
+    for _i in range(6):
+        t0 = time.monotonic()
+        try:
+            code, _body = _post(f"{url}/predict",
+                                {"inputs": {"x": [1.0, 0.0, 0.0,
+                                                  0.0]}})
+        except (urllib.error.URLError, ConnectionError, OSError):
+            code = "dropped"     # dead socket: balancer retries a peer
+        times.append(time.monotonic() - t0)
+        codes.append(code)
+    # predicts 2+3 rejected, 5 delayed >= 80 ms but served, 6 dropped
+    assert codes == [200, 503, 503, 200, 200, "dropped"]
+    assert times[4] >= 0.08
+    assert [f["kind"] for f in inj.fired] == \
+        ["http_error", "http_error", "delay_ms", "drop"]
+    assert all(f["trigger"] == "predicts" for f in inj.fired)
+    assert telemetry.registry().get(
+        "horovod_faults_injected_total").value(kind="http_error") == 2
+
+
+def test_chaos_predict_stream_is_seed_deterministic(clean_injector):
+    """Two injectors over the same plan draw identical fire/skip
+    decisions for probabilistic predict faults, and the predict
+    counter never perturbs the fabric-request stream."""
+    doc = {"seed": 99, "events": [
+        {"kind": "http_error", "code": 500, "after_predicts": 1,
+         "count": 4, "p": 0.5},
+        {"kind": "delay_ms", "ms": 1, "after_requests": 1,
+         "count": 2, "p": 0.5},
+    ]}
+    logs = []
+    for _run in range(2):
+        inj = FaultInjector(parse_plan(doc))
+        for _ in range(10):
+            inj.before_predict("/predict")
+        for _ in range(10):
+            inj.before_request("POST", "/coord/poll")
+        logs.append(inj.fired)
+    assert logs[0] == logs[1]
+    # with predicts interleaved BEFORE requests, the request-triggered
+    # event still fired on the same request indices: its own counter
+    inj2 = FaultInjector(parse_plan(doc))
+    for _ in range(10):
+        inj2.before_request("POST", "/coord/poll")
+    assert [f for f in inj2.fired if f["trigger"] == "requests"] == \
+        [f for f in logs[0] if f["trigger"] == "requests"]
+
+
+# -- registry: per-family buckets + loud heterogeneous merge ------------------
+
+def test_histogram_custom_buckets_at_registration():
+    reg = MetricRegistry()
+    h = reg.histogram("test_req_seconds", "t",
+                      buckets=REQUEST_LATENCY_BUCKETS)
+    h.observe(0.004)
+    snap = reg.snapshot()["test_req_seconds"]
+    assert snap["buckets"] == list(REQUEST_LATENCY_BUCKETS)
+    # 0.004 lands in the (0.003, 0.005] bucket of the ms ladder
+    idx = list(REQUEST_LATENCY_BUCKETS).index(0.005)
+    assert snap["samples"][0]["counts"][idx] == 1
+    # idempotent re-registration with the same bounds is fine
+    assert reg.histogram("test_req_seconds", "t",
+                         buckets=REQUEST_LATENCY_BUCKETS) is h
+
+
+def test_histogram_conflicting_buckets_raise():
+    reg = MetricRegistry()
+    reg.histogram("test_h", "t", buckets=(1.0, 2.0))
+    with pytest.raises(ValueError, match="already registered with"):
+        reg.histogram("test_h", "t", buckets=(1.0, 2.0, 3.0))
+
+
+def test_merge_snapshots_heterogeneous_buckets_loud(caplog):
+    def snap(bounds, counts):
+        return {"lat": {"type": "histogram", "help": "",
+                        "labelnames": [], "buckets": list(bounds),
+                        "samples": [{"labels": {},
+                                     "counts": list(counts),
+                                     "sum": 1.0,
+                                     "count": sum(counts)}]}}
+
+    import logging
+    with caplog.at_level(logging.WARNING,
+                         logger="horovod_tpu.telemetry"):
+        merged = merge_snapshots([
+            snap((0.1, 1.0), [1, 2, 3]),
+            snap((0.5, 5.0), [10, 20, 30]),    # heterogeneous bounds
+            snap((0.1, 1.0), [1, 1, 1]),
+        ])
+    # the mismatched worker was dropped LOUDLY, not mis-bucketed
+    assert any("heterogeneous bucket bounds" in r.message
+               for r in caplog.records)
+    lat = merged["lat"]
+    assert lat["buckets"] == [0.1, 1.0]
+    assert lat["samples"][0]["counts"] == [2, 3, 4]
+
+
+# -- autoscaling --------------------------------------------------------------
+
+def test_quantile_from_buckets():
+    bounds = (0.01, 0.1, 1.0)
+    # 90 obs <= 10ms, 10 in (10ms, 100ms]
+    assert 0.01 < quantile_from_buckets(bounds, [90, 10, 0, 0], 0.99) \
+        <= 0.1
+    assert quantile_from_buckets(bounds, [100, 0, 0, 0], 0.5) <= 0.01
+    assert quantile_from_buckets(bounds, [0, 0, 0, 0], 0.99) is None
+    # +Inf-bucket mass clamps to the top bound
+    assert quantile_from_buckets(bounds, [0, 0, 0, 5], 0.99) == 1.0
+
+
+def test_autoscale_policy_up_down_hysteresis_cooldown():
+    p = AutoscalePolicy(slo_p99_ms=100, queue_high=10,
+                        breach_evals=2, idle_evals=3, cooldown_s=30)
+    now = 1000.0
+    # one breach is noise — two consecutive scale up
+    assert p.decide(0.5, 0, 2, now=now) == 2
+    assert p.decide(0.5, 0, 2, now=now + 1) == 3
+    assert p.last[0] == "scale_up"
+    # cooldown holds even through continued breaches
+    assert p.decide(0.5, 0, 3, now=now + 2) == 3
+    assert p.last[0] == "cooldown"
+    # queue high-water alone also counts as a breach
+    assert p.decide(0.001, 50, 3, now=now + 40) == 3
+    assert p.decide(0.001, 50, 3, now=now + 41) == 4
+    # idle long enough scales down, never below 1
+    t = now + 80
+    for i in range(3):
+        target = p.decide(0.001, 0, 4, now=t + i)
+    assert target == 3 and p.last[0] == "scale_down"
+    one = AutoscalePolicy(idle_evals=1, cooldown_s=0)
+    assert one.decide(None, 0, 1, now=0.0) == 1   # floor at 1 replica
+
+
+class _FakeDriver:
+    def __init__(self, size=2):
+        self.size = size
+        self.targets = []
+
+    def current_world_size(self):
+        return self.size
+
+    def set_target_np(self, n):
+        self.targets.append(n)
+        return n
+
+
+class _FakeStore:
+    def __init__(self, snaps):
+        self.snaps = snaps
+
+    def scope(self, prefix):
+        return {f"{prefix}{i}": json.dumps({"families": s}).encode()
+                for i, s in enumerate(self.snaps)}
+
+
+def _serving_snapshot(counts, queue):
+    return {
+        "horovod_serving_request_seconds": {
+            "type": "histogram", "help": "", "labelnames": ["path"],
+            "buckets": list(REQUEST_LATENCY_BUCKETS),
+            "samples": [{"labels": {"path": "predict"},
+                         "counts": list(counts),
+                         "sum": 1.0, "count": sum(counts)}]},
+        "horovod_serving_queue_depth": {
+            "type": "gauge", "help": "", "labelnames": [],
+            "samples": [{"labels": {}, "value": queue}]},
+    }
+
+
+def test_autoscaler_reads_signals_and_drives_driver():
+    n = len(REQUEST_LATENCY_BUCKETS) + 1
+    slow = [0] * n
+    slow[-2] = 100                        # ~10s latencies: SLO breach
+    driver = _FakeDriver(size=2)
+    scaler = Autoscaler(
+        driver, _FakeStore([_serving_snapshot(slow, 80.0)]),
+        policy=AutoscalePolicy(slo_p99_ms=100, queue_high=10,
+                               breach_evals=2, cooldown_s=0))
+    p99, queue, _ = scaler.evaluate(now=1.0)
+    assert p99 is not None and p99 > 0.1
+    assert queue == 80.0
+    # second window: counts unchanged -> empty delta window -> p99
+    # None; the queue high-water alone keeps the breach streak alive
+    _p99, _q, target = scaler.evaluate(now=2.0)
+    assert target == 3 and driver.targets == [3]
+    assert scaler.decisions[-1]["reason"] == "scale_up"
+
+
+def test_autoscaler_holds_without_any_serving_telemetry():
+    """Absence of data must read as 'hold', never 'idle': a fleet
+    whose replicas aren't pushing (or are still warming) must not be
+    melted down to min_np."""
+    driver = _FakeDriver(size=3)
+    scaler = Autoscaler(driver, _FakeStore([]),
+                        policy=AutoscalePolicy(idle_evals=1,
+                                               cooldown_s=0))
+    for i in range(5):
+        _p99, _q, target = scaler.evaluate(now=float(i))
+        assert target == 3
+    assert driver.targets == []
+
+
+def test_autoscaler_ages_out_frozen_snapshots():
+    """A dead replica's last push stops changing; after the staleness
+    horizon (launcher-monotonic — no cross-host clock comparison) its
+    queue gauge must stop pinning the policy in scale-up."""
+    n = len(REQUEST_LATENCY_BUCKETS) + 1
+    busy = _serving_snapshot([0] * n, 500.0)    # huge frozen queue
+    driver = _FakeDriver(size=2)
+    scaler = Autoscaler(driver, _FakeStore([busy]))
+    scaler.staleness_s = 0.05
+    p99, queue, seen = scaler.read_signals()
+    assert seen and queue == 500.0              # first sight: fresh
+    time.sleep(0.1)                             # bytes never change
+    p99, queue, seen = scaler.read_signals()
+    assert queue == 0.0 and not seen
+
+
+def test_autoscaler_windows_deltas_per_replica():
+    """A replica (re)entering the merge contributes only its delta —
+    its lifetime histogram must not land in one 'window' and fake an
+    SLO breach."""
+    n = len(REQUEST_LATENCY_BUCKETS) + 1
+    fast, slow_hist = [0] * n, [0] * n
+    fast[1] = 50                                # ~1ms traffic
+    slow_hist[-2] = 1000                        # old slow lifetime
+    store = _FakeStore([_serving_snapshot(fast, 0.0)])
+    scaler = Autoscaler(_FakeDriver(size=2), store)
+    scaler.read_signals()                       # baseline for key 0
+    # a second replica appears, carrying a long slow HISTORY; its
+    # lifetime seeds its own baseline without entering the window of
+    # the already-tracked replica
+    store.snaps = [_serving_snapshot(fast, 0.0),
+                   _serving_snapshot(slow_hist, 0.0)]
+    p99, _q, _ = scaler.read_signals()
+    # first sight of a key still contributes its counts once (there
+    # is no earlier baseline to delta against) — but from the NEXT
+    # window on, both replicas delta against their own baselines
+    p99, _q, _ = scaler.read_signals()
+    assert p99 is None                          # no new observations
+    # new fast traffic on replica 0 only: p99 reflects it, not the
+    # other replica's slow lifetime
+    fast2 = list(fast)
+    fast2[1] += 20
+    store.snaps = [_serving_snapshot(fast2, 0.0),
+                   _serving_snapshot(slow_hist, 0.0)]
+    p99, _q, _ = scaler.read_signals()
+    assert p99 is not None and p99 <= 0.01
+
+
+def test_elastic_driver_autoscale_lever():
+    from horovod_tpu.runner.elastic.discovery import (
+        FixedHosts, HostManager,
+    )
+    from horovod_tpu.runner.elastic.driver import ElasticDriver
+
+    driver = ElasticDriver.__new__(ElasticDriver)
+    driver._host_manager = HostManager(
+        FixedHosts({"a": 2, "b": 2}), None)
+    driver._host_manager.update_available_hosts()
+    driver._min_np = 1
+    driver._max_np = 4
+    driver._target_np = 4
+    driver._round = 0
+    driver._assignments = {}
+    driver._lock = threading.RLock()
+    driver._shutdown = threading.Event()
+    driver._on_event = None
+    assert len(driver._compute_assignments()) == 4
+    # clamped into [min_np, max_np]; assignments follow the target
+    assert driver.set_target_np(2) == 2
+    assert len(driver._compute_assignments()) == 2
+    assert driver.set_target_np(99) == 4
+    assert driver.set_target_np(0) == 1
+    assert len(driver._compute_assignments()) == 1
+    assert driver.current_world_size() == 0    # no round formed yet
+
+
+# -- end-to-end smoke (real 2-proc job; ci.sh serve runs it directly) ---------
+
+@pytest.mark.integration
+@pytest.mark.slow
+def test_serve_smoke_end_to_end():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_smoke.py")],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "PYTHONPATH": REPO})
+    assert proc.returncode == 0, (proc.stdout[-2000:],
+                                  proc.stderr[-3000:])
+    assert "SERVE SMOKE OK" in proc.stdout

@@ -1,0 +1,320 @@
+#!/usr/bin/env python
+"""CI serving smoke (ci.sh `serve`; wrapped by
+tests/test_serving.py::test_serve_smoke_end_to_end): a REAL 2-process
+serving job proving the acceptance criteria of the serving tier
+(docs/serving.md):
+
+* both replicas load the SAME params (rank-0 checkpoint +
+  load_and_broadcast), warm every batch bucket, and answer HTTP
+  predicts with correct outputs;
+* a seeded fault plan SIGKILLs replica 1 on its 25th predict request
+  — mid-traffic, deterministically — and the driver's traffic loop
+  retries failed sends against the survivor: **zero requests are
+  dropped** (every one of them eventually returns the right answer);
+* the job-wide ``/metrics`` on the launcher's rendezvous service
+  shows the serving SLO families (request-latency histogram with the
+  ms-scale ladder, queue-depth gauge) and records the fleet change:
+  ``horovod_worker_alive{proc="1"}`` drops to 0 once heartbeat
+  liveness declares the killed replica dead;
+* steady-state traffic over the bucketed batch shapes adds ZERO
+  compiled-program-cache misses after warm-up (scraped twice, delta
+  asserted).
+
+Driver mode (no args): orchestrates.  Worker mode (SRV_WORKER=1):
+runs one replica.
+"""
+
+import json
+import os
+import re
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SEED = 20260803
+N_REQUESTS = 120
+KILL_AFTER_PREDICTS = 25
+DIM, OUT = 16, 4
+
+
+# ---------------------------------------------------------------------------
+# worker
+
+def worker():
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu import serving
+
+    out_dir = os.environ["SRV_OUT"]
+    stop_file = os.path.join(out_dir, "stop")
+    hvd.init()
+    proc = int(os.environ.get("HOROVOD_TPU_PROC_INDEX", "0"))
+    if proc == 0:
+        # tell the traffic driver where the job-wide /metrics lives
+        with open(os.path.join(out_dir, "rdv.json"), "w") as f:
+            json.dump({
+                "addr": os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"],
+                "port": os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"],
+            }, f)
+
+    def predict_fn(params, batch):
+        return {"y": batch["x"] @ params["w"] + params["b"]}
+
+    handle = serving.start(
+        predict_fn,
+        checkpoint=os.path.join(out_dir, "model.pkl"),
+        config=serving.ServingConfig(
+            max_batch_size=8, max_latency_ms=3, buckets=(1, 2, 4, 8)),
+        warmup_example={"x": np.zeros(DIM, np.float32)})
+    # publish readiness AFTER warm-up so the driver's steady-state
+    # cache-miss assertion never races a warm-up compile
+    hvd.barrier()
+    with open(os.path.join(out_dir, f"ready_{proc}.json"), "w") as f:
+        json.dump({"port": handle.port}, f)
+    while not os.path.exists(stop_file):
+        time.sleep(0.2)
+    handle.stop()
+    aborted = hvd.is_initialized() and \
+        __import__("horovod_tpu.common.basics",
+                   fromlist=["basics"]).engine()._aborted is not None
+    try:
+        hvd.shutdown()
+    except Exception:  # noqa: BLE001 — peers may be dead
+        pass
+    print(f"replica {proc} OK", flush=True)
+    if aborted:
+        # a peer DIED this round: the jax coordination client cannot
+        # run its atexit shutdown barrier against a dead task — it
+        # LOG(FATAL)s the process into a -6 (the same limitation the
+        # elastic driver classifies as churn and exec-restarts
+        # around).  The replica's own teardown (drain + final metric
+        # push) is already done, so skip jax's atexit.
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(0)
+
+
+# ---------------------------------------------------------------------------
+# driver
+
+def _scrape(url, timeout=20):
+    return urllib.request.urlopen(url, timeout=timeout).read().decode()
+
+
+def _metric_value(text, pattern):
+    m = re.search(pattern, text, re.M)
+    return float(m.group(1)) if m else None
+
+
+class Traffic:
+    """Round-robin load with failover: a send that dies at the socket
+    (killed replica) or gets a 503 (draining) is retried against the
+    other replica — the external-load-balancer contract.  Records
+    every request's final outcome; ``dropped`` must end at zero."""
+
+    def __init__(self, ports, expect_fn):
+        self.ports = ports
+        self.expect_fn = expect_fn
+        self.ok = 0
+        self.retried = 0
+        self.dropped = []
+        self._lock = threading.Lock()
+
+    def send_one(self, i):
+        payload = json.dumps(
+            {"inputs": {"x": [float(i % 7)] * DIM}}).encode()
+        last_err = None
+        for attempt in range(6):
+            port = self.ports[(i + attempt) % len(self.ports)]
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/predict", payload,
+                {"Content-Type": "application/json"})
+            try:
+                resp = urllib.request.urlopen(req, timeout=15)
+                body = json.loads(resp.read())
+                got = body["outputs"]["y"]
+                want = self.expect_fn(float(i % 7))
+                assert all(abs(g - w) < 1e-3
+                           for g, w in zip(got, want)), (got, want)
+                with self._lock:
+                    self.ok += 1
+                    if attempt:
+                        self.retried += 1
+                return
+            except AssertionError:
+                raise
+            except Exception as exc:  # noqa: BLE001 — dead socket /
+                # 5xx: fail over to the peer replica
+                last_err = exc
+                time.sleep(0.2 * (attempt + 1))
+        with self._lock:
+            self.dropped.append((i, repr(last_err)))
+
+    def run(self, n, concurrency=8):
+        idx = iter(range(n))
+        lock = threading.Lock()
+
+        def pump():
+            while True:
+                with lock:
+                    i = next(idx, None)
+                if i is None:
+                    return
+                self.send_one(i)
+
+        threads = [threading.Thread(target=pump)
+                   for _ in range(concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+
+def main():
+    if os.environ.get("SRV_WORKER"):
+        worker()
+        return
+
+    import pickle
+    import tempfile
+
+    import numpy as np
+
+    from horovod_tpu.runner.http.http_server import free_port
+    from horovod_tpu.runner.proc_run import launch_procs
+
+    out = tempfile.mkdtemp(prefix="serve_smoke_")
+    rng = np.random.default_rng(SEED)
+    w = rng.standard_normal((DIM, OUT)).astype(np.float32)
+    b = rng.standard_normal(OUT).astype(np.float32)
+    with open(os.path.join(out, "model.pkl"), "wb") as f:
+        pickle.dump({"w": w, "b": b}, f)
+
+    def expect(v):
+        return (np.full(DIM, v, np.float32) @ w + b).tolist()
+
+    plan = json.dumps({"seed": SEED, "events": [
+        {"kind": "kill", "proc": 1,
+         "after_predicts": KILL_AFTER_PREDICTS},
+    ]})
+    base_port = free_port()
+    env = {"PYTHONPATH": REPO, "SRV_WORKER": "1", "SRV_OUT": out,
+           "HOROVOD_SERVING": "1",
+           "HOROVOD_SERVING_PORT": str(base_port),
+           "HOROVOD_FAULT_PLAN": plan,
+           "HOROVOD_HEARTBEAT_INTERVAL_SECONDS": "1",
+           "HOROVOD_METRICS_PUSH_SECONDS": "0.5"}
+
+    codes = []
+
+    def launch():
+        # stop_on_failure=False: the serving-fleet semantics (what
+        # `horovodrun --serve` passes) — the killed replica must NOT
+        # take the survivor down with it
+        codes.extend(launch_procs(
+            [sys.executable, os.path.abspath(__file__)], np=2,
+            platform="cpu", env=env, start_timeout=420,
+            stop_on_failure=False))
+
+    runner = threading.Thread(target=launch)
+    runner.start()
+
+    # wait for both replicas to finish warm-up and publish their ports
+    deadline = time.monotonic() + 240
+    ports = {}
+    while len(ports) < 2 and time.monotonic() < deadline:
+        for proc in (0, 1):
+            path = os.path.join(out, f"ready_{proc}.json")
+            if proc not in ports and os.path.exists(path):
+                with open(path) as f:
+                    ports[proc] = json.load(f)["port"]
+        time.sleep(0.2)
+    assert len(ports) == 2, f"replicas never became ready: {ports}"
+    with open(os.path.join(out, "rdv.json")) as f:
+        rdv = json.load(f)
+    jobwide = f"http://{rdv['addr']}:{rdv['port']}/metrics"
+
+    # snapshot the warm-state cache counters (both replicas pushed at
+    # least one post-warm-up snapshot before flipping ready)
+    time.sleep(1.5)
+    before = _scrape(jobwide)
+    miss_before = _metric_value(
+        before, r"^horovod_program_cache_misses_total (\d+)")
+    assert miss_before is not None, before[:2000]
+
+    # drive traffic; the fault plan SIGKILLs replica 1 on its 25th
+    # predict — the retry loop must land every request on the survivor
+    traffic = Traffic([ports[0], ports[1]], expect)
+    traffic.run(N_REQUESTS)
+    assert not traffic.dropped, (
+        f"dropped {len(traffic.dropped)} in-flight requests: "
+        f"{traffic.dropped[:5]}")
+    assert traffic.ok == N_REQUESTS
+    assert traffic.retried > 0, \
+        "replica 1 was never killed mid-traffic (no request failed over)"
+
+    # liveness: the coordinator declared the killed replica dead
+    deadline = time.monotonic() + 30
+    alive = None
+    while time.monotonic() < deadline:
+        text = _scrape(jobwide)
+        alive = _metric_value(
+            text, r'^horovod_worker_alive\{agg="min",proc="1"\} (\d+)')
+        if alive == 0.0:
+            break
+        time.sleep(1)
+    assert alive == 0.0, \
+        f"job-wide /metrics never recorded the replica death: {alive}"
+
+    # SLO families on the job-wide scrape, with the ms-scale ladder;
+    # poll until the survivor's periodic push covers the traffic
+    # (the victim's frozen last snapshot undercounts)
+    want_count = N_REQUESTS - KILL_AFTER_PREDICTS
+    deadline = time.monotonic() + 30
+    count = None
+    while time.monotonic() < deadline:
+        text = _scrape(jobwide)
+        count = _metric_value(
+            text, r'^horovod_serving_request_seconds_count'
+            r'\{path="predict"\} (\d+)')
+        if count is not None and count >= want_count:
+            break
+        time.sleep(1)
+    assert count is not None and count >= want_count, \
+        f"job-wide request histogram count {count} < {want_count}"
+    assert re.search(
+        r'^horovod_serving_request_seconds_bucket\{le="0\.005",'
+        r'path="predict"\} \d+', text, re.M), text[:2000]
+    assert re.search(r'^horovod_serving_queue_depth\{agg="max"\} \d+',
+                     text, re.M), "queue-depth gauge missing"
+    assert re.search(r'^horovod_serving_batch_occupancy_count \d+',
+                     text, re.M)
+
+    # steady state never recompiled: zero new cache misses through the
+    # whole traffic phase (the survivor's post-kill snapshots keep
+    # pushing; the victim's last snapshot is frozen pre-kill)
+    miss_after = _metric_value(
+        text, r"^horovod_program_cache_misses_total (\d+)")
+    assert miss_after == miss_before, (
+        f"compiled-program cache missed during steady-state serving: "
+        f"{miss_before} -> {miss_after}")
+
+    # clean shutdown: survivor drains and exits 0; victim died -9
+    open(os.path.join(out, "stop"), "w").close()
+    runner.join(timeout=120)
+    assert not runner.is_alive(), "launcher never returned"
+    assert codes and codes[0] == 0, f"worker exit codes {codes}"
+    assert any(c != 0 for c in codes[1:]), \
+        f"replica 1 exited cleanly ({codes}) — was it ever killed?"
+    print(f"SERVE SMOKE OK ({traffic.ok}/{N_REQUESTS} answered, "
+          f"{traffic.retried} failed over, 0 dropped; "
+          f"cache misses {miss_before:.0f} -> {miss_after:.0f})")
+
+
+if __name__ == "__main__":
+    main()

@@ -330,6 +330,76 @@ def proc_worker(small_count, iters):
     hvd.shutdown()
 
 
+def bypass_worker():
+    """Runs inside one launcher-spawned process: steady-state
+    negotiated cycle latency with ONE repeated tensor name — the
+    training-loop shape the bypass (core/bypass.py, ROADMAP item 2)
+    fast-paths.  With HOROVOD_BYPASS_AFTER_CYCLES set the cycle
+    becomes a 1-element agreement allreduce + the payload program;
+    with it 0 every cycle pays the ready-POST + long-poll round trip
+    against the coordinator."""
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu import telemetry
+
+    hvd.init()
+    x = np.ones(1024, np.float32)
+    for _ in range(10):                      # warm-up + arming window
+        hvd.allreduce(x, op=hvd.Sum, name="bp.lat")
+    iters = int(os.environ.get("CB_ITERS", "200"))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        hvd.allreduce(x, op=hvd.Sum, name="bp.lat")
+    dt = time.perf_counter() - t0
+    out = {
+        "cycle_latency_ms": round(dt / iters * 1e3, 3),
+        "bypass_hits": telemetry.counter_total(
+            "horovod_negotiation_bypass_cycles_total", outcome="hit"),
+    }
+    if hvd.rank() == 0:
+        dest = os.environ.get("CB_OUT")
+        if dest:
+            with open(dest, "w") as f:
+                f.write(json.dumps(out))
+        print(json.dumps(out))
+    hvd.barrier()
+    hvd.shutdown()
+
+
+def run_bypass_compare(np_, iters):
+    """Spawn the REAL launcher twice — bypass armed (K=3) vs disabled
+    — and report the steady-state cycle-latency ratio, the number
+    ROADMAP item 2 / docs/benchmarks.md track."""
+    import tempfile
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    from horovod_tpu.runner.proc_run import launch_procs
+
+    results = {}
+    for label, k in (("bypass", "3"), ("full_poll", "0")):
+        with tempfile.TemporaryDirectory() as td:
+            dest = os.path.join(td, "out.json")
+            env = {"PYTHONPATH": repo, "CB_OUT": dest,
+                   "CB_BYPASS_WORKER": "1", "CB_ITERS": str(iters),
+                   "HOROVOD_BYPASS_AFTER_CYCLES": k}
+            codes = launch_procs(
+                [sys.executable, os.path.abspath(__file__)], np=np_,
+                platform="cpu", env=env, start_timeout=300)
+            if any(codes):
+                results[label] = {"error": f"exit {codes}"}
+                continue
+            with open(dest) as f:
+                results[label] = json.load(f)
+    try:
+        results["bypass_speedup"] = round(
+            results["full_poll"]["cycle_latency_ms"]
+            / results["bypass"]["cycle_latency_ms"], 2)
+    except (KeyError, ZeroDivisionError):
+        pass
+    print(json.dumps(results))
+    return results
+
+
 def run_proc_curve(np_list, small_count, iters):
     """Spawn the real launcher at each process count and collect the
     coordinator-path numbers (VERDICT r5 item 3: negotiation-overhead
@@ -361,6 +431,9 @@ def run_proc_curve(np_list, small_count, iters):
 
 
 def main():
+    if os.environ.get("CB_BYPASS_WORKER"):
+        bypass_worker()
+        return
     if os.environ.get("CB_WORKER"):
         proc_worker(int(os.environ.get("CB_SMALL_COUNT", "64")),
                     int(os.environ.get("CB_ITERS", "5")))
@@ -390,7 +463,18 @@ def main():
                    help="comma list of process counts, e.g. 1,2,4,8: "
                         "run the REAL launcher + coordinator at each "
                         "and print one JSON row per count")
+    p.add_argument("--bypass-compare", action="store_true",
+                   help="steady-state cycle latency with the "
+                        "negotiation bypass armed vs the full "
+                        "ready/poll path, on a REAL --np-process job "
+                        "(docs/benchmarks.md; ROADMAP item 2)")
     args = p.parse_args()
+
+    if args.bypass_compare:
+        run_bypass_compare(max(args.np, 2),
+                           max(args.iters, 50) if args.iters != 5
+                           else 200)
+        return
 
     if args.proc_curve:
         run_proc_curve([int(x) for x in args.proc_curve.split(",")],

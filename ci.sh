@@ -80,8 +80,13 @@ case "${1:-all}" in
     # identical fault sequences across two same-seed runs; an injected
     # straggler gets stall-attributed by rank with a flight-recorder
     # dump; a SIGKILLed worker recovers through elastic restart; a
-    # HUNG worker is declared dead by heartbeat liveness and reaped.
-    # Every scenario runs under a hard watchdog.
+    # HUNG worker is declared dead by heartbeat liveness and reaped;
+    # the RENDEZVOUS SERVICE ITSELF is killed mid-training — steps
+    # keep flowing on the negotiation bypass (>= 20 during the
+    # outage), the service restarts from its journal at epoch+1 with
+    # zero workers falsely declared dead, and the same-seed fault
+    # evidence is byte-identical.  Every scenario runs under a hard
+    # watchdog.
     python tools/chaos_smoke.py
     ;;
   trace)
@@ -123,6 +128,10 @@ case "${1:-all}" in
       --wire-dtype all --iters 8
     python benchmarks/collective_bench.py --np 4 --cpu \
       --algorithm all --iters 8 --sizes-mb 1,8,32
+    # steady-state negotiation bypass vs the full ready/poll path on
+    # a REAL 2-process job (ROADMAP item 2's fast path; the
+    # docs/benchmarks.md control-plane row)
+    python benchmarks/collective_bench.py --np 2 --bypass-compare
     # serving-tier throughput/latency (batcher + compiled dispatch
     # under closed-loop load) — the docs/benchmarks.md serving row
     python benchmarks/serve_bench.py

@@ -8,13 +8,18 @@ surface (docs/fault_tolerance.md).
 
 Coordinator-side events (``"side": "coord"``) are installed by the
 launcher into its rendezvous service
-(runner/http/http_server.py ``Coordinator.add_chaos_rule``).
+(runner/http/http_server.py ``Coordinator.add_chaos_rule``); the
+service-targeting kinds (``coord_kill`` / ``coord_restart``) are
+applied by the launcher's :class:`.inject.CoordFaultRunner`, which
+kills the rendezvous HTTP service itself and (for restarts) rebuilds
+it from the control-plane journal.
 """
 
 from .plan import (  # noqa: F401
-    FaultEvent, FaultPlan, KINDS, load_plan, parse_plan, plan_from_env,
+    COORD_KINDS, FaultEvent, FaultPlan, KINDS, load_plan, parse_plan,
+    plan_from_env,
 )
 from .inject import (  # noqa: F401
-    FaultInjector, current, current_skew_seconds, install,
-    install_coordinator_rules,
+    CoordFaultRunner, FaultInjector, current, current_skew_seconds,
+    install, install_coordinator_rules, start_coordinator_faults,
 )

@@ -26,13 +26,14 @@ decision draws from an RNG seeded by ``(plan seed, event index)``
 identical fault sequence — ``fired`` records it for comparison.
 """
 
+import json
 import logging
 import os
 import signal
 import threading
 import time
 
-from .plan import FaultEvent, FaultPlan, PROCESS_KINDS
+from .plan import COORD_KINDS, FaultEvent, FaultPlan, PROCESS_KINDS
 
 logger = logging.getLogger("horovod_tpu.chaos")
 
@@ -299,16 +300,20 @@ def current_skew_seconds():
 
 
 def install_coordinator_rules(coordinator, env=None):
-    """Install a plan's ``side: "coord"`` events into a launcher's
-    coordinator (runner/http/http_server.py Coordinator) so the server
-    itself rejects or stalls chosen procs' requests.  Reads
+    """Install a plan's ``side: "coord"`` request-perturbing events
+    (http_error/delay_ms) into a launcher's coordinator
+    (runner/http/http_server.py Coordinator) so the server itself
+    rejects or stalls chosen procs' requests.  The service-targeting
+    kinds (coord_kill/coord_restart) are the CoordFaultRunner's —
+    they act on the RendezvousServer, not on requests.  Reads
     ``HOROVOD_FAULT_PLAN`` from ``env``; returns the number of rules
     installed (0 when no plan / no coordinator-side events)."""
     from .plan import plan_from_env
     plan = plan_from_env(env)
     if plan is None:
         return 0
-    rules = plan.coordinator_rules()
+    rules = [e for e in plan.coordinator_rules()
+             if e.kind not in COORD_KINDS]
     for e in rules:
         coordinator.add_chaos_rule(
             e.kind, proc=e.proc, verb=e.verb, after=e.at,
@@ -318,6 +323,144 @@ def install_coordinator_rules(coordinator, env=None):
         logger.warning("chaos: %d coordinator-side fault rule(s) "
                        "installed", len(rules))
     return len(rules)
+
+
+class CoordFaultRunner:
+    """Launcher-side applier of ``coord_kill`` / ``coord_restart``
+    fault events: the chaos tier's way to SIGKILL the control plane
+    itself (docs/fault_tolerance.md "Coordinator crash survival").
+
+    ``coord_kill`` stops the rendezvous HTTP service for good — from
+    the workers' view the coordinator is gone; only the negotiation
+    bypass keeps steps flowing.  ``coord_restart`` stops it, sleeps
+    the event's ``ms``, then rebuilds store + coordinator purely from
+    the journal (``RendezvousServer.restart_from_journal``: epoch
+    bumped, liveness grace armed) on the same port.
+
+    The deterministic evidence ``ci.sh chaos`` compares byte-for-byte
+    lives in :attr:`fired` (kind/event/trigger/n only); wall-clock
+    outage bounds ride separate ``t_stop``/``t_start`` keys.  Both are
+    appended as JSON lines to ``HOROVOD_FAULT_COORD_LOG`` when set."""
+
+    def __init__(self, server, plan: FaultPlan, env=None):
+        self.server = server
+        self.plan = plan
+        self.env = env
+        self.events = [e for e in plan.coordinator_rules()
+                       if e.kind in COORD_KINDS]
+        self.fired = []
+        self._log_path = (env or os.environ).get(
+            "HOROVOD_FAULT_COORD_LOG")
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads = []
+        self._signal_rules = []     # (state, event) of request triggers
+
+    def _install_signal_rule(self, e, sig):
+        self.server.coordinator.add_chaos_rule(
+            "signal", proc=e.proc, verb=e.verb, after=e.at,
+            count=1, event=sig)
+
+    def start(self):
+        for e in self.events:
+            st = _EventState(e, self.plan.rng_for(e))
+            if e.trigger == "requests":
+                sig = threading.Event()
+                self._install_signal_rule(e, sig)
+                self._signal_rules.append((st, sig))
+                t = threading.Thread(target=self._await_signal,
+                                     args=(st, sig),
+                                     name="horovod_tpu-chaos-coord",
+                                     daemon=True)
+            else:
+                t = threading.Thread(target=self._await_wall,
+                                     args=(st,),
+                                     name="horovod_tpu-chaos-coord",
+                                     daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+    def _await_signal(self, st, sig):
+        while not self._stop.is_set():
+            if sig.wait(timeout=0.2):
+                if st.due(st.event.at):
+                    self._fire(st.event, st.event.at)
+                return
+
+    def _await_wall(self, st):
+        epoch = time.monotonic()
+        while not st.exhausted and not self._stop.is_set():
+            dt = epoch + st.event.at - time.monotonic()
+            if dt > 0 and self._stop.wait(min(dt, 0.5)):
+                return
+            if time.monotonic() - epoch < st.event.at:
+                continue
+            secs = round(time.monotonic() - epoch, 3)
+            if st.due(secs):
+                self._fire(st.event, secs)
+            else:
+                time.sleep(0.05)    # probabilistic skip: redraw
+
+    def _fire(self, event: FaultEvent, n):
+        # the deterministic projection (compared across same-seed
+        # runs) carries no wall-clock fields: a wall trigger records
+        # its SCHEDULED offset (the measured seconds jitter at ms
+        # resolution and live in t_stop/t_start instead)
+        rec = {"kind": event.kind, "event": event.index,
+               "trigger": event.trigger,
+               "n": event.at if event.trigger == "wall" else n}
+        logger.warning("chaos: injecting %s (event #%d, %s=%s)",
+                       event.kind, event.index, event.trigger, n)
+        times = {"t_stop": time.time()}
+        self.server.stop_http()
+        if event.kind == "coord_restart":
+            time.sleep(event.ms / 1000.0)
+            self.server.restart_from_journal()
+            times["t_start"] = time.time()
+            coord = self.server.coordinator
+            with coord._lock:
+                coord._chaos_injected["coord_restart"] = \
+                    coord._chaos_injected.get("coord_restart", 0) + 1
+            # the rebuilt coordinator lost the plan's request-level
+            # rules; re-install them (their counters restart — the
+            # plan describes the whole job, docs/fault_tolerance.md),
+            # INCLUDING the signal triggers of this runner's own
+            # not-yet-fired events — they would otherwise wait forever
+            # on a rule living only in the discarded coordinator
+            install_coordinator_rules(coord, self.env)
+            for st, sig in self._signal_rules:
+                if not st.exhausted and not sig.is_set():
+                    self._install_signal_rule(st.event, sig)
+        with self._lock:
+            self.fired.append(rec)
+        if self._log_path:
+            try:
+                with open(self._log_path, "a") as f:
+                    f.write(json.dumps({**rec, **times},
+                                       sort_keys=True) + "\n")
+            except OSError:
+                pass
+
+
+def start_coordinator_faults(server, env=None):
+    """Start the coord_kill/coord_restart runner for a launcher's
+    rendezvous service, when the fault plan has such events.  Returns
+    the runner or None."""
+    from .plan import plan_from_env
+    plan = plan_from_env(env)
+    if plan is None:
+        return None
+    if not any(e.kind in COORD_KINDS
+               for e in plan.coordinator_rules()):
+        return None
+    runner = CoordFaultRunner(server, plan, env=env).start()
+    logger.warning("chaos: %d coordinator service fault(s) armed",
+                   len(runner.events))
+    return runner
 
 
 def _reset_for_tests():

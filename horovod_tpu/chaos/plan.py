@@ -30,7 +30,9 @@ path to a JSON file; ``horovodrun --fault-plan`` forwards it)::
         {"kind": "http_error", "side": "coord", "proc": 0,
                                "verb": "poll", "code": 503,
                                "after": 5, "count": 3},
-        {"kind": "clock_skew", "proc": 1, "ms": 5000, "after_s": 2.0}
+        {"kind": "clock_skew", "proc": 1, "ms": 5000, "after_s": 2.0},
+        {"kind": "coord_restart", "after_s": 5.0, "ms": 3000},
+        {"kind": "coord_kill", "after": 200}
       ]
     }
 
@@ -68,7 +70,16 @@ from typing import List, Optional
 PROCESS_KINDS = ("kill", "exit", "hang", "clock_skew")
 WIRE_KINDS = ("drop", "delay_ms", "duplicate", "http_error")
 ENGINE_KINDS = ("slow_rank",)
-KINDS = PROCESS_KINDS + WIRE_KINDS + ENGINE_KINDS
+#: Launcher-side kinds targeting the rendezvous service ITSELF
+#: (docs/fault_tolerance.md "Coordinator crash survival"):
+#: ``coord_kill`` tears the HTTP service down for good; steps keep
+#: flowing only on the negotiation bypass.  ``coord_restart`` tears it
+#: down for ``ms`` milliseconds, then rebuilds store + coordinator
+#: purely from the journal (epoch bumped) on the same port.  Both are
+#: implicitly ``side: "coord"`` and trigger on ``after_s`` (wall) or
+#: ``after`` (the n-th coordinator request).
+COORD_KINDS = ("coord_kill", "coord_restart")
+KINDS = PROCESS_KINDS + WIRE_KINDS + ENGINE_KINDS + COORD_KINDS
 
 #: Trigger spellings -> canonical trigger name.
 _TRIGGERS = {"after_requests": "requests",
@@ -146,10 +157,15 @@ def _parse_event(index: int, raw: dict) -> FaultEvent:
         raise ValueError(
             f"fault event #{index}: side must be 'worker' or 'coord', "
             f"got {side!r}")
-    if side == "coord" and kind not in ("http_error", "delay_ms"):
+    if kind in COORD_KINDS:
+        # coordinator-targeting kinds are coord-side by definition
+        side = "coord"
+    if side == "coord" and kind not in (
+            "http_error", "delay_ms") + COORD_KINDS:
         raise ValueError(
             f"fault event #{index}: coordinator-side events support "
-            f"http_error (reject) and delay_ms (stall), not {kind}")
+            f"http_error (reject), delay_ms (stall), coord_kill and "
+            f"coord_restart, not {kind}")
     triggers = [k for k in _TRIGGERS if k in raw]
     if len(triggers) != 1:
         raise ValueError(
@@ -160,10 +176,20 @@ def _parse_event(index: int, raw: dict) -> FaultEvent:
     if at < 0:
         raise ValueError(
             f"fault event #{index}: trigger {trig_key} must be >= 0")
-    if side == "coord" and trig_key != "after":
+    if side == "coord" and kind not in COORD_KINDS \
+            and trig_key != "after":
         raise ValueError(
             f"fault event #{index}: coordinator-side events count "
             f"matching requests via 'after', not {trig_key}")
+    if kind in COORD_KINDS and trig_key not in ("after", "after_s"):
+        raise ValueError(
+            f"fault event #{index}: {kind} triggers on 'after' "
+            f"(n-th coordinator request) or 'after_s' (wall), not "
+            f"{trig_key}")
+    if kind == "coord_restart" and not raw.get("ms"):
+        raise ValueError(
+            f"fault event #{index}: coord_restart needs 'ms' > 0 "
+            f"(the outage duration before the journal restart)")
     proc = raw.get("proc")
     rank = raw.get("rank")
     if kind == "slow_rank":

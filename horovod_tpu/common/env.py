@@ -77,6 +77,22 @@ HOROVOD_FABRIC_RETRY_ATTEMPTS = "HOROVOD_FABRIC_RETRY_ATTEMPTS"
 HOROVOD_FABRIC_RETRY_DEADLINE_SECONDS = \
     "HOROVOD_FABRIC_RETRY_DEADLINE_SECONDS"
 
+# coordinator crash survival + steady-state bypass
+# (docs/fault_tolerance.md "Coordinator crash survival"):
+# COORD_JOURNAL names the launcher-side control-plane journal a
+# restarted rendezvous service replays (epoch-fenced);
+# COORD_OUTAGE_DEADLINE bounds how long replay-safe fabric requests
+# keep retrying across a coordinator outage; BYPASS_AFTER_CYCLES is
+# the K identical negotiation cycles that arm the coordinator-free
+# fast path (0 disables the bypass); BYPASS_WAIT_SECONDS bounds each
+# armed cycle's wait for the cached tensors before it forces the
+# unanimous fallback to full negotiation.
+HOROVOD_COORD_JOURNAL = "HOROVOD_COORD_JOURNAL"
+HOROVOD_COORD_OUTAGE_DEADLINE_SECONDS = \
+    "HOROVOD_COORD_OUTAGE_DEADLINE_SECONDS"
+HOROVOD_BYPASS_AFTER_CYCLES = "HOROVOD_BYPASS_AFTER_CYCLES"
+HOROVOD_BYPASS_WAIT_SECONDS = "HOROVOD_BYPASS_WAIT_SECONDS"
+
 # TPU-native additions
 HOROVOD_WIRE_DTYPE = "HOROVOD_WIRE_DTYPE"      # f32 | fp16 | bf16 | int8
 # flat | hierarchical | torus (generic spelling; the reference's
@@ -261,6 +277,15 @@ class Config:
         # same env so both sides agree.
         self.heartbeat_secs = get_float(
             HOROVOD_HEARTBEAT_INTERVAL_SECONDS, 5.0)
+        # steady-state negotiation bypass (docs/fault_tolerance.md +
+        # core/bypass.py): after K identical negotiation cycles the
+        # ranks agree via a bitvector exchange and skip the
+        # coordinator; 0 disables.  The wait bound forces the
+        # unanimous fallback when a cached tensor never goes ready.
+        self.bypass_after_cycles = get_int(
+            HOROVOD_BYPASS_AFTER_CYCLES, 5)
+        self.bypass_wait_secs = get_float(
+            HOROVOD_BYPASS_WAIT_SECONDS, 10.0)
         # chaos fault plan (raw source; parsed by chaos.plan_from_env
         # at init so a malformed plan fails loudly, not silently)
         self.fault_plan = get_str(HOROVOD_FAULT_PLAN)

@@ -1,0 +1,239 @@
+"""Steady-state negotiation bypass (ROADMAP item 2; the reference's
+``response_cache.cc`` CoordinateCacheAndState idea, Horovod paper
+arXiv:1802.05799 §4, rebuilt as the DEGRADED MODE of a crash-tolerant
+control plane).
+
+Training loops are periodic: after warm-up the coordinator schedules
+the identical response list every cycle, yet every cycle still pays a
+ready-POST + long-poll round-trip per process against one
+launcher-hosted box.  The bypass removes the coordinator from the
+steady state entirely:
+
+1. **Detect** — each worker fingerprints the batch-response list of
+   every completed negotiation cycle (the ``_fingerprint`` seam of
+   core/store_controller.py extended from per-entry to per-cycle).
+   Once the list is identical for K consecutive cycles
+   (``HOROVOD_BYPASS_AFTER_CYCLES``), the worker votes its
+   fingerprint to the coordinator (``bypass_ready`` verb).
+2. **Arm** — when EVERY proc votes the same fingerprint, the
+   coordinator appends one ``bypass_arm`` record to the response log.
+   Consumed in log order, that record is the coordinated instant all
+   workers switch modes — no two-phase commit needed.
+3. **Run** — each armed cycle, once the cached keys are locally
+   ready, the ranks agree via a cheap all-to-all bitvector exchange
+   over the EXISTING collective path (a 1-element MIN allreduce on
+   the global mesh: my-state-matches = 1).  Unanimity executes the
+   cached response list with no coordinator traffic; any dissent — a
+   new tensor, a changed wire dtype, a resize, a stall past the wait
+   bound, a desynced rank — makes the fallback UNANIMOUS too (the
+   vote result is identical on every rank), so all procs re-enter
+   full negotiation together and the coordinator re-validates
+   everything cross-process.
+
+Because armed workers never touch the coordinator, training steps
+keep flowing while the rendezvous service is down or restarting from
+its journal — "fast path" and "survives coordinator death" are one
+mechanism (docs/fault_tolerance.md "Coordinator crash survival").
+
+Safety argument: vote 1 means "my locally-ready entries match MY
+cached response list exactly"; the arm handshake proved every proc
+cached the SAME list (same fingerprint), so unanimity implies
+cross-process meta consistency — the same invariant the
+coordinator's ``_validate`` enforces on the slow path.
+"""
+
+import hashlib
+import json
+import time
+
+#: Ops whose metas are identical across steps — only all-cacheable,
+#: global-process-set cycles are bypass-eligible (mirrors the
+#: coordinator's response-cache eligibility).
+CACHEABLE_TYPES = ("ALLREDUCE", "ADASUM")
+
+
+def sanitize_response(resp):
+    """Strip the per-step volatile fields (trace ids, cache ids) from
+    a batch response, keeping exactly what re-execution needs."""
+    return {"kind": "batch", "keys": list(resp.get("keys", [])),
+            "metas": resp.get("metas", {}),
+            "aux": resp.get("aux", {})}
+
+
+def cycle_fingerprint(responses):
+    """Canonical identity of one negotiation cycle's response list."""
+    return hashlib.sha1(
+        json.dumps(responses, sort_keys=True).encode()).hexdigest()
+
+
+def meta_fingerprint(meta):
+    """Canonical identity of one negotiation meta (aux/error excluded
+    — the per-entry ``_fingerprint`` contract of
+    core/store_controller.py, shared so the two seams cannot
+    drift)."""
+    return json.dumps(
+        {k: v for k, v in meta.items() if k not in ("aux", "error")},
+        sort_keys=True)
+
+
+def _eligible(resp):
+    metas = resp.get("metas", {})
+    if not metas or len(metas) != len(resp.get("keys", [])):
+        return False
+    return all(m.get("type") in CACHEABLE_TYPES and m.get("ps", 0) == 0
+               for m in metas.values())
+
+
+class BypassState:
+    """Per-engine bypass tracker + armed-mode state machine.
+
+    Driven from the engine background thread (plus ``poison`` from
+    rank threads); no internal locking — every mutating call happens
+    on the engine loop, and ``poison`` is a benign one-shot flag."""
+
+    def __init__(self, after_cycles=5, wait_secs=10.0):
+        self.K = int(after_cycles)
+        self.wait_secs = float(wait_secs)
+        #: armed-mode state
+        self.active = False
+        self.broken = False     # armed without the list: vote 0 once
+        self.fp = None
+        self.responses = []     # sanitized batch responses, in order
+        self.keys = set()
+        self.key_fps = {}       # key -> meta fingerprint
+        self.cycles = 0         # executed bypass cycles
+        #: cumulative per-key trace-id sequence: every proc executes
+        #: the same responses in the same order, so the sequence is
+        #: identical everywhere (never reset — ids must not reuse)
+        self.trace_seq = 0
+        #: detection state
+        self._cycle = []        # sanitized responses of the open cycle
+        self._cycle_ok = True
+        self._last_fp = None
+        self._stable = 0
+        self._candidate = None  # (fp, responses) of the last stable list
+        #: armed-cycle wait state
+        self._wait_t0 = None
+        self._poison = None
+
+    # -- detection (un-armed) ------------------------------------------------
+
+    def observe_response(self, resp):
+        """One coordinator response applied by the store cycle."""
+        kind = resp.get("kind")
+        if kind == "batch":
+            s = sanitize_response(resp)
+            if not _eligible(s):
+                self._cycle_ok = False
+            self._cycle.append(s)
+        elif kind in ("error", "join_done", "dead", "stall"):
+            # not a steady cycle: reset stability
+            self._cycle_ok = False
+
+    def cycle_complete(self):
+        """Close the open cycle (the awaiting table drained).  Returns
+        the fingerprint to VOTE to the coordinator once the list has
+        been identical for K consecutive cycles, else None."""
+        if not self._cycle:
+            return None
+        cycle, self._cycle = self._cycle, []
+        ok, self._cycle_ok = self._cycle_ok, True
+        if not ok:
+            self._last_fp, self._stable = None, 0
+            return None
+        fp = cycle_fingerprint(cycle)
+        if fp == self._last_fp:
+            self._stable += 1
+        else:
+            self._last_fp, self._stable = fp, 1
+        self._candidate = (fp, cycle)
+        if self.K > 0 and self._stable >= self.K:
+            return fp
+        return None
+
+    # -- arming --------------------------------------------------------------
+
+    def on_arm(self, fp):
+        """The coordinator's ``bypass_arm`` record arrived (in log
+        order, so every proc arms at the same point in its response
+        stream).  Arming is UNCONDITIONAL — a proc whose cycle moved
+        on since it voted arms ``broken`` and votes 0 in the first
+        agreement round, which makes the fallback unanimous instead
+        of deadlocking the peers' vote collective."""
+        if self.active:
+            return
+        self.active = True
+        self._wait_t0 = None
+        self._poison = None
+        if self._candidate is not None and self._candidate[0] == fp:
+            self.fp, self.responses = fp, list(self._candidate[1])
+            self.keys = {k for r in self.responses for k in r["keys"]}
+            self.key_fps = {
+                k: meta_fingerprint(m)
+                for r in self.responses
+                for k, m in r["metas"].items()}
+            self.broken = False
+        else:
+            self.broken = True
+
+    def disarm(self):
+        """Back to cold detection (fallback taken, or elastic reset)."""
+        self.active = False
+        self.broken = False
+        self.fp = None
+        self.responses = []
+        self.keys = set()
+        self.key_fps = {}
+        self._cycle = []
+        self._cycle_ok = True
+        self._last_fp, self._stable = None, 0
+        self._candidate = None
+        self._wait_t0 = None
+        self._poison = None
+
+    def poison(self, reason):
+        """Force the next agreement round to vote 0 (join requested,
+        process-set churn — anything the cached list cannot cover)."""
+        self._poison = reason
+
+    # -- armed-cycle decisions -----------------------------------------------
+
+    def decide(self, awaiting_fps, foreign, now=None):
+        """One armed-cycle decision from the engine loop.
+
+        ``awaiting_fps``: {key: meta_fingerprint} of the global set's
+        awaiting entries; ``foreign``: entries awaiting on any other
+        process set.  Returns None (keep waiting), or
+        ``(vote, reason)`` — vote 1 to execute the cached list, vote 0
+        to force the unanimous fallback."""
+        now = time.monotonic() if now is None else now
+        if self.broken:
+            return 0, "unarmed"
+        if self._poison:
+            return 0, self._poison
+        if foreign:
+            return 0, "mismatch"
+        keys = set(awaiting_fps)
+        if not keys:
+            return None
+        if keys - self.keys:
+            # a tensor outside the cached list can never match
+            return 0, "mismatch"
+        if keys == self.keys:
+            for k, fp in awaiting_fps.items():
+                if fp != self.key_fps[k]:
+                    # same name, different params (wire-dtype flip,
+                    # reshape): renegotiate
+                    return 0, "mismatch"
+            self._wait_t0 = None
+            return 1, None
+        # partial: some cached keys not locally ready yet — wait, but
+        # bounded, so a genuinely stalled/desynced rank degrades into
+        # full negotiation (where stall attribution lives) instead of
+        # wedging the job
+        if self._wait_t0 is None:
+            self._wait_t0 = now
+        if now - self._wait_t0 > self.wait_secs:
+            self._wait_t0 = None
+            return 0, "timeout"
+        return None

@@ -65,12 +65,16 @@ class NegotiationEntry:
     IncrementTensorCount)."""
 
     __slots__ = ("key", "subs", "first_time", "wire_default",
-                 "algo_default", "ready_ts", "trace_id")
+                 "algo_default", "ready_ts", "trace_id", "meta_fp")
 
     def __init__(self, key):
         self.key = key
         self.subs: Dict[int, Submission] = {}
         self.first_time = time.monotonic()
+        # memoized meta fingerprint (core/bypass.py): the meta is
+        # invariant once the entry is fully submitted, and the armed
+        # bypass consults it every engine tick
+        self.meta_fp = None
         # process-wide wire default LATCHED when the first local rank
         # arrives, so an autotune sweep flipping config.wire_dtype
         # between two ranks' submits of the same tensor cannot split
@@ -202,6 +206,17 @@ class Engine:
         #: report_ready, so slow-rank scenarios delay exactly the
         #: report the coordinator's stall attribution watches
         self.chaos = chaos
+        #: steady-state negotiation bypass (core/bypass.py): armed by
+        #: the coordinator's bypass_arm record once every proc voted
+        #: the same stable cycle fingerprint; while active the
+        #: background loop runs _bypass_cycle instead of _store_cycle
+        self._bypass = None
+        if self.multiproc and \
+                getattr(self.config, "bypass_after_cycles", 0) > 0:
+            from .bypass import BypassState
+            self._bypass = BypassState(
+                self.config.bypass_after_cycles,
+                getattr(self.config, "bypass_wait_secs", 10.0))
         self._hb_stop = threading.Event()
         self._hb_thread = None
         self._start_heartbeat()
@@ -293,6 +308,20 @@ class Engine:
             "Flight-recorder ring dumps (stall auto-dumps, coordinator"
             " requests, hvd.dump_trace)",
             labelnames=("reason",))
+        # steady-state negotiation bypass + coordinator crash survival
+        # (docs/fault_tolerance.md): hit cycles ran without touching
+        # the coordinator, fallback cycles disengaged (labeled by
+        # reason); the histogram times vote + execution of hit cycles.
+        # Resyncs are counted by the StoreController on epoch bumps.
+        self._m_bypass = m.counter(
+            telemetry.BYPASS_CYCLES_FAMILY,
+            telemetry.BYPASS_CYCLES_HELP,
+            labelnames=("outcome",))
+        self._m_bypass_cycle = m.histogram(
+            telemetry.BYPASS_CYCLE_SECONDS_FAMILY,
+            telemetry.BYPASS_CYCLE_SECONDS_HELP)
+        m.counter(telemetry.COORD_RESYNCS_FAMILY,
+                  telemetry.COORD_RESYNCS_HELP)
         # families owned by other layers, pre-declared for the catalogue
         m.counter("horovod_program_cache_hits_total",
                   "Compiled-path program cache hits")
@@ -819,6 +848,11 @@ class Engine:
             ps.join_waiters[rank] = handle
             self._lock.notify_all()
         if self.multiproc:
+            if self._bypass is not None:
+                # a joined rank stops submitting: the cached list can
+                # never be fully ready again — make the next agreement
+                # round fall back promptly instead of waiting it out
+                self._bypass.poison("join")
             self.controller.report_join(
                 ps_id, rank, len(ps.ranks),
                 proc_members=len(ps.local_ranks))
@@ -883,7 +917,14 @@ class Engine:
                     self._pending_trace_dump, None
                 self.dump_trace(reason=reason)
             if self.multiproc:
-                self._store_cycle(work)
+                if self._bypass is not None and self._bypass.active:
+                    # armed fast path: agree via the collective-path
+                    # bitvector and execute the cached response list —
+                    # zero coordinator traffic (and the reason steps
+                    # keep flowing while the coordinator is down)
+                    self._bypass_cycle()
+                else:
+                    self._store_cycle(work)
             else:
                 for ps, batch in work:
                     if self.chaos is not None:
@@ -1183,6 +1224,165 @@ class Engine:
                     tuned["pack_mt_threshold_bytes"]
         for resp in responses:
             self._apply_response(resp)
+        if self._bypass is not None and not self._bypass.active:
+            self._bypass_track(responses)
+        if self.controller.take_rereport():
+            # the epoch resync drained the restarted coordinator's
+            # replayed log; whatever is STILL awaiting was lost with
+            # the old coordinator's pending table — re-report it
+            self._rereport_awaiting()
+
+    # ------------------------------------------------------------------
+    # steady-state negotiation bypass (core/bypass.py)
+
+    def _bypass_track(self, responses):
+        """Un-armed detection: feed applied responses to the tracker;
+        when the awaiting tables drain, the cycle closes — a list
+        stable for K cycles votes its fingerprint to the coordinator
+        (idempotent; re-voted each stable cycle until the arm record
+        arrives in the log)."""
+        bp = self._bypass
+        for resp in responses:
+            bp.observe_response(resp)
+        with self._lock:
+            drained = all(not ps.awaiting
+                          for ps in self.process_sets.values())
+        if not drained:
+            return
+        fp = bp.cycle_complete()
+        if fp is not None:
+            try:
+                self.controller.bypass_ready(fp)
+            except Exception:  # noqa: BLE001 — advisory: the vote is
+                # re-sent next stable cycle; a dead coordinator here
+                # just delays arming
+                pass
+
+    def _bypass_cycle(self):
+        """One armed cycle: wait for the cached tensors, agree via a
+        1-element MIN allreduce over the existing collective path
+        (vote 1 = my locally-ready entries match my cached list), and
+        on unanimity execute the cached response list with no
+        coordinator traffic.  ANY dissent is unanimous too (same
+        collective result everywhere), so all procs fall back into
+        full negotiation together."""
+        from .bypass import meta_fingerprint
+        bp = self._bypass
+        with self._lock:
+            ps0 = self.process_sets.get(0)
+            foreign = any(ps.id != 0 and ps.awaiting
+                          for ps in self.process_sets.values())
+            awaiting_fps = {}
+            if ps0 is not None:
+                for key, entry in ps0.awaiting.items():
+                    if entry.meta_fp is None:
+                        # invariant once awaiting — computed once, not
+                        # per engine tick
+                        entry.meta_fp = meta_fingerprint(
+                            self._meta_for(ps0, entry))
+                    awaiting_fps[key] = entry.meta_fp
+        if ps0 is None:
+            return
+        decision = bp.decide(awaiting_fps, foreign)
+        if decision is None:
+            return
+        vote, reason = decision
+        if self.chaos is not None and vote == 1:
+            # after_collectives triggers must keep counting (and
+            # slow_rank must keep making a visible straggler) while
+            # armed — the bypass replaces report_ready, so the hook
+            # fires here, right before the agreement vote (fallback
+            # cycles count via _rereport_awaiting's report instead)
+            self.chaos.on_collectives(len(awaiting_fps))
+        try:
+            agreed = self._bypass_vote(ps0, vote)
+        except Exception as exc:  # noqa: BLE001 — a failed agreement
+            # collective means a dead/diverged peer: same contract as
+            # any peer failure
+            self.abort(exc)
+            return
+        if agreed:
+            t0 = time.monotonic()
+            bp.cycles += 1
+            for i, resp in enumerate(bp.responses):
+                self._apply_response(self._bypass_response(resp, i))
+            self._m_bypass.labels(outcome="hit").inc()
+            self._m_bypass_cycle.observe(time.monotonic() - t0)
+        else:
+            self._m_bypass.labels(outcome="fallback").inc()
+            logger.info(
+                "negotiation bypass disengaged (%s); falling back to "
+                "full negotiation", reason or "peer mismatch")
+            bp.disarm()
+            # marks from the pre-arm race window would swallow the
+            # re-report of re-used tensor names (the coordinator
+            # dropped those entries when it armed)
+            self.controller.clear_reported()
+            self._rereport_awaiting()
+
+    def _bypass_vote(self, ps0, vote):
+        """The all-to-all bitvector exchange (reference
+        response_cache CoordinateCacheAndState, collapsed to one MIN
+        bit): every rank contributes 1 iff its process's state matches
+        its cached list, so the reduced value is 1 only on global
+        agreement — and identical on every rank, which is what makes
+        the fallback coordinated."""
+        rows = [np.full(1, float(vote), np.float32)
+                for _ in ps0.local_ranks]
+        out = ps0.executor.allreduce(rows, ReduceOp.MIN)
+        return bool(out[0][0] >= 0.5)
+
+    def _bypass_response(self, resp, idx):
+        """Cached batch response for one bypass execution: fresh
+        DETERMINISTIC trace ids (every proc executes the same
+        responses in the same order, so the cumulative sequence is
+        identical everywhere and cross-rank flow arrows keep
+        working), disjoint from the coordinator-minted id space."""
+        r = dict(resp)
+        bp = self._bypass
+        ids = {}
+        for k in resp["keys"]:
+            bp.trace_seq += 1
+            ids[k] = (1 << 40) + bp.trace_seq
+        r["trace"] = ids
+        return r
+
+    def _rereport_awaiting(self):
+        """Re-report every entry still awaiting a coordinator response
+        — the recovery shared by the bypass fallback (entries were
+        never reported while armed) and the post-restart resync (the
+        old coordinator's pending table died with it).  Local
+        validation runs here because bypass-mode entries skipped the
+        _store_cycle validation pass."""
+        with self._lock:
+            items = [(ps, key, entry)
+                     for ps in self.process_sets.values()
+                     for key, entry in list(ps.awaiting.items())]
+        metas = []
+        for ps, key, entry in items:
+            err = self._validate(ps, entry, local_only=True)
+            if err is not None:
+                with self._lock:
+                    ps.awaiting.pop(key, None)
+                    self._discard_stall_mark(ps.id, key)
+                for sub in entry.subs.values():
+                    sub.handle.set_error(err)
+                meta = self._meta_for(ps, entry)
+                meta["error"] = str(err)
+                metas.append(meta)
+            else:
+                metas.append(self._meta_for(ps, entry))
+        if not metas:
+            return
+        if self.chaos is not None:
+            # this IS a ready report: the chaos collectives counter
+            # (and slow_rank's pre-report sleep) must see it, exactly
+            # like _store_cycle's hook
+            self.chaos.on_collectives(len(metas))
+        try:
+            self.controller.report_ready(metas)
+        except Exception as exc:  # noqa: BLE001 — coordinator death
+            self.abort(exc)
 
     def _apply_response(self, resp):
         kind = resp.get("kind")
@@ -1302,6 +1502,12 @@ class Engine:
                 f"after missed heartbeats")
             logger.warning("%s; failing pending collectives", msg)
             self.abort(HorovodInternalError(msg))
+        elif kind == "bypass_arm":
+            # the coordinated switch point: every proc consumes this
+            # record at the same position in its response stream and
+            # arms the steady-state bypass (core/bypass.py)
+            if self._bypass is not None:
+                self._bypass.on_arm(resp.get("fp"))
         elif kind == "trace_dump":
             # coordinator-requested flight-recorder dump (stall
             # auto-dump, POST /trace/dump, GET /timeline): push the

@@ -63,6 +63,98 @@ class StoreController:
         #: (reference SynchronizeParameters broadcast); the engine
         #: applies them to its config each cycle.
         self.tuned = None
+        #: Coordinator generation (docs/fault_tolerance.md
+        #: "Coordinator crash survival"): learned from poll replies,
+        #: carried on every verb thereafter.  A mismatch reply means
+        #: the rendezvous service restarted from its journal — one
+        #: resync handshake re-registers the session instead of blind
+        #: replay, then the engine drains the replayed response log
+        #: and re-reports whatever is still awaiting.
+        self.epoch = None
+        self._drain_to = None
+        self._rereport = False
+
+    # -- epoch fencing -------------------------------------------------------
+
+    def _coord(self, verb, payload, timeout=None, budget=None):
+        """One coordinator verb with the epoch attached; handles the
+        stale-round and epoch-mismatch replies in ONE place."""
+        with self._lock:
+            if self.epoch is not None:
+                payload = {**payload, "epoch": self.epoch}
+        out = self.client.coord(verb, payload, timeout=timeout,
+                                budget=budget)
+        if out.get("stale"):
+            raise StaleRoundError(
+                f"coordinator moved to round {out.get('round')}")
+        if out.get("epoch_mismatch"):
+            self.resync()
+            if verb == "ready":
+                # never blind-replay a ready across an epoch bump: the
+                # restarted coordinator may have scheduled these
+                # entries pre-crash (the journaled log replays them).
+                # Recovery is drain-then-rereport (take_rereport).
+                return {}
+            payload = {**payload, "epoch": self.epoch}
+            out = self.client.coord(verb, payload, timeout=timeout,
+                                    budget=budget)
+            if out.get("stale"):
+                raise StaleRoundError(
+                    f"coordinator moved to round {out.get('round')}")
+            if out.get("epoch_mismatch"):
+                raise HorovodInternalError(
+                    "coordinator epoch moved twice within one request")
+        if out.get("epoch") is not None:
+            with self._lock:
+                self.epoch = out["epoch"]
+        return out
+
+    def resync(self):
+        """Epoch resync handshake against a restarted coordinator:
+        re-register this session, adopt the new epoch, and arm the
+        drain-then-rereport recovery — entries the old coordinator
+        scheduled before dying arrive via the replayed log, and only
+        what is STILL awaiting after the drain gets re-reported (full
+        metas; the restarted response cache starts cold)."""
+        out = self.client.coord("resync", {
+            "proc": self.proc_id, "sid": self._sid,
+            "round": self.round_id})
+        if out.get("stale"):
+            raise StaleRoundError(
+                f"coordinator moved to round {out.get('round')}")
+        with self._lock:
+            self.epoch = out.get("epoch")
+            self._drain_to = out.get("cursor", 0)
+            self._rereport = True
+            self._reported.clear()
+            self._suppressed.clear()
+            self._cache.clear()
+        try:
+            from ..telemetry import count_coord_resync
+            count_coord_resync()
+        except Exception:  # noqa: BLE001 — accounting only
+            pass
+
+    def take_rereport(self):
+        """True ONCE per resync, and only after the replayed response
+        log has been drained (cursor past the resync point) — the
+        engine then re-reports every entry still awaiting."""
+        with self._lock:
+            if not self._rereport:
+                return False
+            if self._drain_to is not None \
+                    and self._cursor < self._drain_to:
+                return False
+            self._rereport = False
+            self._drain_to = None
+            return True
+
+    def bypass_ready(self, fp):
+        """Vote this worker's stable cycle fingerprint (core/bypass.py
+        step 1 -> 2).  Idempotent server-side; advisory here."""
+        self._coord("bypass_ready", {
+            "proc": self.proc_id, "round": self.round_id,
+            "sid": self._sid, "fp": fp}, timeout=5.0)
 
     # -- reporting -----------------------------------------------------------
 
@@ -99,13 +191,10 @@ class StoreController:
         with self._lock:
             self._rid += 1
             rid = self._rid
-        out = self.client.coord("ready", {
+        out = self._coord("ready", {
             "proc": self.proc_id, "nlocal": self.nlocal,
             "round": self.round_id, "entries": entries, "rid": rid,
             "sid": self._sid})
-        if out.get("stale"):
-            raise StaleRoundError(
-                f"coordinator moved to round {out.get('round')}")
         uncached = out.get("uncached")
         if uncached:
             # the coordinator evicted (or never had) those cache ids:
@@ -119,6 +208,17 @@ class StoreController:
                         resend.append(full)
             if resend:
                 self._post_ready(resend)
+
+    def clear_reported(self):
+        """Drop ALL reported-key dedup marks.  Called by the engine
+        when the bypass disengages: entries reported in the pre-arm
+        race window were dropped server-side at arm time (and executed
+        through the bypass), so their marks would otherwise silently
+        swallow the re-report of any re-used tensor name — nothing is
+        genuinely in flight at a bypass fallback."""
+        with self._lock:
+            self._reported.clear()
+            self._suppressed.clear()
 
     def forget(self, key):
         """Drop a key from the reported set without a coordinator
@@ -148,41 +248,49 @@ class StoreController:
             payload["host"] = host
         if bye:
             payload["bye"] = True
-        out = self.client.coord("heartbeat", payload, timeout=5.0)
-        if out.get("stale"):
-            raise StaleRoundError(
-                f"coordinator moved to round {out.get('round')}")
+        # the goodbye races teardown: a dead rendezvous service must
+        # not wedge clean worker exit behind the outage-spanning
+        # retry budget — one bounded retry, then give up
+        out = self._coord("heartbeat", payload, timeout=5.0,
+                          budget=(2, 3.0) if bye else None)
         return bool(out.get("dead"))
 
     def report_join(self, ps_id, rank, ps_size, proc_members=1):
         with self._lock:
             self._jid += 1
             jid = self._jid
-        out = self.client.coord("join", {"ps": ps_id, "rank": rank,
-                                         "ps_size": ps_size,
-                                         "proc": self.proc_id,
-                                         "round": self.round_id,
-                                         "proc_members": proc_members,
-                                         "jid": jid, "sid": self._sid})
-        if out.get("stale"):
-            raise StaleRoundError(
-                f"coordinator moved to round {out.get('round')}")
+        self._coord("join", {"ps": ps_id, "rank": rank,
+                             "ps_size": ps_size,
+                             "proc": self.proc_id,
+                             "round": self.round_id,
+                             "proc_members": proc_members,
+                             "jid": jid, "sid": self._sid})
 
     # -- polling -------------------------------------------------------------
 
     def poll(self, wait=None):
         """Fetch responses past the cursor; returns list of response
         dicts ({kind: batch|error|join_done, ...})."""
-        out = self.client.coord(
+        out = self._coord(
             "poll", {"cursor": self._cursor, "round": self.round_id,
                      "proc": self.proc_id,
                      "wait": self.poll_wait if wait is None else wait},
             timeout=(self.poll_wait if wait is None else wait) + 30)
-        if out.get("stale"):
-            raise StaleRoundError(
-                f"coordinator moved to round {out.get('round')}")
         responses = out.get("responses", [])
         self._cursor = out.get("cursor", self._cursor)
+        for j, r in enumerate(responses):
+            if r.get("kind") == "bypass_arm":
+                # the arm record is the coordinated mode switch: STOP
+                # consuming the log exactly there, records before it
+                # included.  A batch scheduled after the arm must not
+                # be executed by fast pollers only (the slow ones
+                # bypass those entries instead — a guaranteed
+                # collective-order divergence); fencing the cursor to
+                # the arm position makes every proc resume from the
+                # same log point after a later fallback/resync.
+                self._cursor -= len(responses) - (j + 1)
+                responses = responses[:j + 1]
+                break
         if "tuned" in out:
             self.tuned = out["tuned"]
         if responses:

@@ -22,6 +22,11 @@ HOROVOD_FAULT_PLAN = "HOROVOD_FAULT_PLAN"
 HOROVOD_FAULT_SEED = "HOROVOD_FAULT_SEED"
 HOROVOD_HEARTBEAT_INTERVAL_SECONDS = "HOROVOD_HEARTBEAT_INTERVAL_SECONDS"
 HOROVOD_HEARTBEAT_WINDOW_SECONDS = "HOROVOD_HEARTBEAT_WINDOW_SECONDS"
+HOROVOD_COORD_JOURNAL = "HOROVOD_COORD_JOURNAL"
+HOROVOD_COORD_OUTAGE_DEADLINE_SECONDS = \
+    "HOROVOD_COORD_OUTAGE_DEADLINE_SECONDS"
+HOROVOD_BYPASS_AFTER_CYCLES = "HOROVOD_BYPASS_AFTER_CYCLES"
+HOROVOD_BYPASS_WAIT_SECONDS = "HOROVOD_BYPASS_WAIT_SECONDS"
 HOROVOD_STALL_CHECK_DISABLE = "HOROVOD_STALL_CHECK_DISABLE"
 HOROVOD_STALL_CHECK_TIME_SECONDS = "HOROVOD_STALL_CHECK_TIME_SECONDS"
 HOROVOD_STALL_SHUTDOWN_TIME_SECONDS = "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"
@@ -92,6 +97,17 @@ def set_env_from_args(env: dict, args) -> dict:
     if getattr(args, "heartbeat_window_seconds", None) is not None:
         env[HOROVOD_HEARTBEAT_WINDOW_SECONDS] = str(
             args.heartbeat_window_seconds)
+    if getattr(args, "coord_journal", None):
+        env[HOROVOD_COORD_JOURNAL] = args.coord_journal
+    if getattr(args, "coord_outage_deadline_seconds", None) is not None:
+        env[HOROVOD_COORD_OUTAGE_DEADLINE_SECONDS] = str(
+            args.coord_outage_deadline_seconds)
+    if getattr(args, "bypass_after_cycles", None) is not None:
+        env[HOROVOD_BYPASS_AFTER_CYCLES] = str(
+            args.bypass_after_cycles)
+    if getattr(args, "bypass_wait_seconds", None) is not None:
+        env[HOROVOD_BYPASS_WAIT_SECONDS] = str(
+            args.bypass_wait_seconds)
     if getattr(args, "serve", False):
         env["HOROVOD_SERVING"] = "1"
         # the autoscaler is blind without the replicas' snapshot

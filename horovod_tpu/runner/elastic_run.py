@@ -40,13 +40,18 @@ def run_elastic(args):
     at_env.update(env)
     server = RendezvousServer(secret=bytes.fromhex(secret_hex),
                               world_size=0, **autotune_kwargs(at_env))
+    coord_faults = None
     if at_env.get("HOROVOD_FAULT_PLAN"):
         # coordinator-side fault-plan events (side="coord") install
         # into the elastic rendezvous service too; rules persist
         # across round resets (docs/fault_tolerance.md)
-        from ..chaos import install_coordinator_rules
+        from ..chaos import (
+            install_coordinator_rules, start_coordinator_faults,
+        )
         install_coordinator_rules(server.coordinator, at_env)
     server.start()
+    if at_env.get("HOROVOD_FAULT_PLAN"):
+        coord_faults = start_coordinator_faults(server, at_env)
     cooldown = tuple(args.blacklist_cooldown_range) \
         if args.blacklist_cooldown_range else None
     driver = ElasticDriver(
@@ -69,7 +74,7 @@ def run_elastic(args):
                 return default
 
         autoscaler = Autoscaler(
-            driver, server.store,
+            driver, server,
             policy=AutoscalePolicy(
                 slo_p99_ms=_f("HOROVOD_SERVING_SLO_P99_MS", 100.0),
                 queue_high=int(_f("HOROVOD_SERVING_QUEUE_HIGH", 64))),
@@ -88,5 +93,7 @@ def run_elastic(args):
     finally:
         if autoscaler is not None:
             autoscaler.stop()
+        if coord_faults is not None:
+            coord_faults.stop()
         server.stop()
     return 0 if ok else 1

@@ -40,9 +40,15 @@ class _DroppedRequest(ConnectionError):
 
 
 #: Verbs whose POSTs the coordinator deduplicates on a client id
-#: (rid/jid) or that are naturally idempotent — the only verbs where
-#: retrying a TIMEOUT is safe (the original may still have landed).
-REPLAY_SAFE_VERBS = ("ready", "join", "heartbeat")
+#: (rid/jid), on idempotent per-slot state (resync session
+#: registration, bypass_ready votes), or that are naturally idempotent
+#: (heartbeat) — the only verbs where retrying a TIMEOUT is safe (the
+#: original may still have landed).  Across a coordinator restart the
+#: epoch fence rejects any blind replay BEFORE its verb runs, so the
+#: contract holds outage-spanning too (tests/test_chaos.py
+#: test_replay_safe_verbs_contract).
+REPLAY_SAFE_VERBS = ("ready", "join", "heartbeat", "resync",
+                     "bypass_ready")
 
 
 def _count_retry(verb):
@@ -74,6 +80,17 @@ class StoreClient:
         self.retry_deadline = float(
             os.environ.get("HOROVOD_FABRIC_RETRY_DEADLINE_SECONDS")
             or 30.0)
+        # coordinator-outage budget (docs/fault_tolerance.md
+        # "Coordinator crash survival"): CONNECTION-SHAPE failures —
+        # the server is gone, the request never completed server-side,
+        # so replay is safe on every verb — and safe-timeout replays
+        # keep retrying up to this wall deadline instead of the per-
+        # request one, spanning a rendezvous-service restart.  5xx
+        # keeps the tight budget: a server answering sick is not an
+        # outage.
+        self.outage_deadline = float(
+            os.environ.get("HOROVOD_COORD_OUTAGE_DEADLINE_SECONDS")
+            or 120.0)
         self._retry_base = 0.05     # first backoff step (seconds)
         self._retry_cap = 2.0       # per-step ceiling
 
@@ -136,19 +153,33 @@ class StoreClient:
         return resp.status, data
 
     def _request(self, method, path, body=b"", timeout=None,
-                 verb=None, retry_timeout=False):
+                 verb=None, retry_timeout=False, budget=None):
         """One logical request with bounded retries.  ``verb`` labels
         the retry counter; ``retry_timeout`` opts the verb into
-        TimeoutError replays (REPLAY_SAFE_VERBS only)."""
+        TimeoutError replays (REPLAY_SAFE_VERBS only).  ``budget`` is
+        an explicit ``(attempts, seconds)`` override that ALSO caps
+        the outage deadline — teardown-path callers (final metrics
+        push, heartbeat bye) use it so a dead coordinator can never
+        wedge a clean worker exit."""
         timeout = timeout or self.timeout
         verb = verb or method.lower()
         headers = dict(self._auth_headers(body))
         if body:
             headers["Content-Type"] = "application/json"
-        deadline = time.monotonic() + self.retry_deadline
+        attempts, deadline_s = budget or (self.retry_attempts,
+                                          self.retry_deadline)
+        start = time.monotonic()
+        deadline = start + deadline_s
+        # connection-shape failures (and safe-timeout replays) span a
+        # coordinator outage: the server is down/restarting, not
+        # answering sick, so keep retrying up to the outage deadline —
+        # unless the caller pinned an explicit budget
+        outage_deadline = start + (deadline_s if budget is not None
+                                   else max(deadline_s,
+                                            self.outage_deadline))
         attempt = 0
         while True:
-            exhausted = (attempt + 1 >= max(self.retry_attempts, 1)
+            exhausted = (attempt + 1 >= max(attempts, 1)
                          or time.monotonic() > deadline)
             try:
                 action = None
@@ -178,11 +209,14 @@ class StoreClient:
                 return status, data
             except TimeoutError:
                 self._drop_conn()
-                if not retry_timeout or exhausted:
+                if not retry_timeout \
+                        or time.monotonic() > outage_deadline:
                     raise
             except self._RETRYABLE:
                 # stale keep-alive, server restart, or injected drop:
-                # reconnect and replay under the retry budget
+                # reconnect and replay under the outage deadline (the
+                # request never completed server-side, so replay is
+                # safe on every verb)
                 self._drop_conn()
                 if attempt == 0:
                     # the first connection-shape failure is routinely
@@ -195,7 +229,7 @@ class StoreClient:
                     _count_retry(verb)
                     attempt = 1
                     continue
-                if exhausted:
+                if time.monotonic() > outage_deadline:
                     raise
             _count_retry(verb)
             self._backoff(attempt)
@@ -209,11 +243,12 @@ class StoreClient:
 
     # -- API -----------------------------------------------------------------
 
-    def put(self, key: str, value: bytes):
+    def put(self, key: str, value: bytes, budget=None):
         # KV puts are last-writer-wins: replaying a timed-out put is
-        # safe, so the full retry surface applies
+        # safe, so the full retry surface applies.  ``budget`` caps
+        # the retries for teardown-path callers (final metrics push).
         status, _ = self._request("PUT", key, value, verb="kv_put",
-                                  retry_timeout=True)
+                                  retry_timeout=True, budget=budget)
         if status != 200:
             raise _HTTPError(status, f"PUT {key}")
 
@@ -233,14 +268,15 @@ class StoreClient:
         if status != 200:
             raise _HTTPError(status, f"DELETE {key}")
 
-    def coord(self, verb: str, payload: dict, timeout: float = None):
+    def coord(self, verb: str, payload: dict, timeout: float = None,
+              budget=None):
         body = json.dumps(payload).encode()
         status, data = self._request(
             "POST", f"/coord/{verb}", body, timeout=timeout, verb=verb,
             # ready/join are rid/jid-deduplicated server-side and
             # heartbeat is naturally idempotent: a slow reply on those
             # POSTs is retried instead of killing the job
-            retry_timeout=verb in REPLAY_SAFE_VERBS)
+            retry_timeout=verb in REPLAY_SAFE_VERBS, budget=budget)
         if status != 200:
             raise _HTTPError(status, f"coord/{verb}: "
                                      f"{data[:200].decode(errors='replace')}")

@@ -31,6 +31,8 @@ import socketserver
 from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler
 
+from . import journal as journal_mod
+
 logger = logging.getLogger("horovod_tpu")
 
 OK = 200
@@ -89,6 +91,17 @@ def autotune_kwargs(env=None):
             env.get("HOROVOD_HEARTBEAT_WINDOW_SECONDS") or 0.0)
     except ValueError:
         kwargs["heartbeat_window"] = 0.0
+    # coordinator crash survival (docs/fault_tolerance.md): journal
+    # control-plane transitions to this path so a restarted rendezvous
+    # service replays them (epoch-fenced).  REPLAY=1 opts a FRESH
+    # server into replaying an existing file (a restarted launcher);
+    # by default a new job truncates a stale journal on its path.
+    journal = env.get("HOROVOD_COORD_JOURNAL")
+    if journal:
+        kwargs["journal_path"] = journal
+        kwargs["journal_replay"] = str(
+            env.get("HOROVOD_COORD_JOURNAL_REPLAY", "")).strip().lower() \
+            in ("1", "true", "yes", "on")
     return kwargs
 
 
@@ -301,15 +314,38 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class KVStore:
-    """Blocking-get key/value store (reference KVStoreHandler)."""
+    """Blocking-get key/value store (reference KVStoreHandler).
+
+    With a coordinator journal attached (``journal`` attribute, set by
+    RendezvousServer AFTER any replay so restored entries are not
+    re-journaled), every small write is recorded so a restarted
+    service resurrects the KV state — elastic round assignments, user
+    scopes — under the journal's size cap.  The bulky ephemeral
+    namespaces (telemetry pushes, trace buffers) are excluded."""
 
     def __init__(self):
         self._data = {}
         self._cv = threading.Condition()
+        self.journal = None
+
+    def _journal_write(self, key, value):
+        j = self.journal
+        if j is None or key.startswith(journal_mod.KV_EXCLUDE_PREFIXES):
+            return
+        if value is not None and len(value) > j.kv_max_bytes:
+            logger.debug("journal: skipping oversized KV value %s "
+                         "(%d bytes)", key, len(value))
+            return
+        if value is None:
+            j.append({"k": "kvdel", "key": key})
+        else:
+            j.append({"k": "kv", "key": key,
+                      "v": journal_mod._b64(value)})
 
     def put(self, key, value: bytes):
         with self._cv:
             self._data[key] = value
+            self._journal_write(key, value)
             self._cv.notify_all()
 
     def get(self, key, timeout=0.0):
@@ -331,6 +367,16 @@ class KVStore:
     def delete(self, key):
         with self._cv:
             self._data.pop(key, None)
+            self._journal_write(key, None)
+            self._cv.notify_all()
+
+    def restore(self, key, value: bytes):
+        """Journal replay: restore an entry without re-journaling."""
+        with self._cv:
+            if value is None:
+                self._data.pop(key, None)
+            else:
+                self._data[key] = value
             self._cv.notify_all()
 
     def scope(self, prefix):
@@ -360,11 +406,36 @@ class Coordinator:
                  autotune_log: str = None, cycle_time_ms: float = 1.0,
                  stall_warning_secs: float = 60.0,
                  heartbeat_secs: float = 5.0,
-                 heartbeat_window: float = 0.0):
+                 heartbeat_window: float = 0.0,
+                 journal=None):
         self.world_size = world_size
         self.fusion_threshold = fusion_threshold_bytes
         self.cache_capacity = cache_capacity
         self.round_id = 0
+        # crash-survival state (docs/fault_tolerance.md "Coordinator
+        # crash survival"): coord_epoch is a monotonic generation id
+        # bumped on every journal replay; StoreClients carry it on
+        # every verb and a mismatch triggers ONE resync handshake
+        # instead of blind replay.  The journal records state-changing
+        # transitions so restore_journal can rebuild this object.
+        self.coord_epoch = 1
+        self._journal = journal
+        self._replaying = False
+        self._store = None              # attach_store (KV for snapshots)
+        self._journal_replayed = {}     # record kind -> replay count
+        self._last_tuned_journaled = None
+        # post-restart liveness grace: beats are only EXPECTED after a
+        # proc's first post-restart beat, and no death is declared
+        # before this instant — beats missed during the outage must
+        # not read as deaths
+        self._grace_until = 0.0
+        # steady-state negotiation bypass (core/bypass.py): per-proc
+        # cycle-fingerprint votes; when every proc votes the same
+        # fingerprint a ``bypass_arm`` record rides the response log —
+        # the coordinated instant all workers switch to the
+        # coordinator-free fast path
+        self._bypass_votes = {}
+        self._bypass_armed_fp = None
         # coordinator-side stall inspector (reference
         # stall_inspector.cc relocated with the coordinator): an entry
         # pending past this age gets a ``stall`` response naming the
@@ -455,6 +526,33 @@ class Coordinator:
     def close(self):
         if self._autotuner is not None:
             self._autotuner.close()
+        if self._journal is not None:
+            self._journal.close()
+
+    # -- journal plumbing (docs/fault_tolerance.md) --------------------------
+
+    def attach_store(self, store):
+        """Give the coordinator its paired KV store, for journal
+        replay (restoring KV records) and compaction snapshots."""
+        self._store = store
+
+    def _j(self, rec):
+        """Journal one record (no-op without a journal / during
+        replay)."""
+        if self._journal is not None and not self._replaying:
+            self._journal.append(rec)
+
+    def _log_append(self, rec):
+        """THE response-log append point: journals the record with its
+        absolute index so a restarted service replays the log workers
+        have not consumed yet (their cursors stay valid).  Suppressed
+        during replay — replayed joins must not re-emit the join_done
+        records the journal already holds.  Must hold the lock."""
+        if self._replaying:
+            return
+        idx = self._log_base + len(self._log)
+        self._log.append(rec)
+        self._j({"k": "log", "i": idx, "r": rec})
 
     def procs_seen(self) -> int:
         """How many worker processes have polled this round — the
@@ -468,8 +566,12 @@ class Coordinator:
         requests are rejected (reference: a new gloo context per
         rendezvous, gloo_context.cc:168-206)."""
         with self._lock:
+            self._j({"k": "reset", "world": world_size,
+                     "round": round_id})
             self.world_size = world_size
             self.round_id = round_id
+            self._bypass_votes.clear()
+            self._bypass_armed_fp = None
             self._pending.clear()
             self._log.clear()
             self._log_base = 0
@@ -505,6 +607,14 @@ class Coordinator:
             # worker's timeline epoch is mapped onto.  Round-agnostic
             # and lock-free — it must answer with minimal jitter.
             return {"t": time.time()}
+        epoch = req.get("epoch")
+        if epoch is not None and epoch != self.coord_epoch \
+                and verb != "resync":
+            # epoch fence: a request minted against a pre-restart
+            # coordinator generation is rejected BEFORE any verb runs
+            # — the cross-outage dedup blind HTTP replays rely on.
+            # The client answers with one resync handshake.
+            return {"epoch_mismatch": True, "epoch": self.coord_epoch}
         if req.get("round", self.round_id) != self.round_id:
             return {"stale": True, "round": self.round_id}
         if verb == "ready":
@@ -515,6 +625,10 @@ class Coordinator:
             return self._on_join(req)
         if verb == "heartbeat":
             return self._on_heartbeat(req)
+        if verb == "resync":
+            return self._on_resync(req)
+        if verb == "bypass_ready":
+            return self._on_bypass_ready(req)
         raise ValueError(f"unknown coordinator verb {verb}")
 
     def request_trace_dump(self, reason="request"):
@@ -526,7 +640,7 @@ class Coordinator:
         with self._lock:
             self._next_dump_id += 1
             did = self._next_dump_id
-            self._log.append({"kind": "trace_dump", "id": did,
+            self._log_append({"kind": "trace_dump", "id": did,
                               "reason": reason})
             self._lock.notify_all()
         return did
@@ -547,16 +661,289 @@ class Coordinator:
             return {}
         with self._lock:
             if req.get("bye"):
-                self._beats.pop(proc, None)
+                # the bye INTENT is journaled: a restarted coordinator
+                # must never re-arm liveness for a worker that already
+                # said goodbye (its bye would otherwise be lost with
+                # the in-memory beat table and the replayed first-beat
+                # expectation would read its silence as a death)
+                if self._beats.pop(proc, None) is not None or \
+                        proc in self._proc_ranks:
+                    self._j({"k": "bye", "proc": proc})
+                self._proc_ranks.pop(proc, None)
+                self._proc_hosts.pop(proc, None)
                 return {}
             if proc in self._dead:
                 return {"dead": True}
+            if proc not in self._beats:
+                # first beat registers the proc: journaled so a
+                # restarted coordinator keeps the rank/host attribution
+                # (liveness itself re-arms only on a post-restart beat)
+                self._j({"k": "hb", "proc": proc,
+                         "ranks": req.get("ranks"),
+                         "host": req.get("host")})
             self._beats[proc] = time.monotonic()
             if req.get("ranks") is not None:
                 self._proc_ranks[proc] = list(req["ranks"])
             if req.get("host"):
                 self._proc_hosts[proc] = req["host"]
+            # beats are a liveness-scan clock too (AFTER recording
+            # this beat — the caller is alive by definition): while
+            # every worker is armed on the negotiation bypass nobody
+            # polls, and a poll-clocked-only scan would never declare
+            # a hung bypassed worker dead.  The elastic driver's
+            # reaper reads dead_procs() in-process, so the verdict
+            # reaches it — and reaping the hung process is what
+            # unblocks the survivors' agreement collective.
+            self._scan_heartbeats()
         return {}
+
+    # -- epoch fencing + steady-state bypass (docs/fault_tolerance.md) -------
+
+    def _on_resync(self, req):
+        """Epoch resync handshake: a worker whose request hit the
+        epoch fence re-registers here ONCE instead of blindly
+        replaying.  A journal-replayed session (same sid) keeps its
+        log position — the worker drains the replayed response log
+        from its own absolute cursor, then re-reports whatever is
+        still awaiting; a brand-new session starts at the log end as
+        usual.  Idempotent: re-sending the same (proc, sid) changes
+        nothing (REPLAY_SAFE_VERBS contract)."""
+        proc = req.get("proc")
+        with self._lock:
+            if proc is not None:
+                self._check_session(proc, req.get("sid"))
+            return {"epoch": self.coord_epoch, "round": self.round_id,
+                    "cursor": self._log_base + len(self._log)}
+
+    def _on_bypass_ready(self, req):
+        """One worker's vote that its negotiated response list has
+        been stable (same fingerprint) for K cycles.  When EVERY proc
+        has voted the same fingerprint, a ``bypass_arm`` record rides
+        the response log — consumed in log order, it is the
+        coordinated instant all workers switch to the coordinator-free
+        fast path (core/bypass.py).  Idempotent per (proc, fp): a
+        replayed vote re-writes the same slot and an armed coordinator
+        never re-arms the same fingerprint."""
+        proc = req.get("proc")
+        fp = req.get("fp")
+        if proc is None or not fp:
+            return {}
+        with self._lock:
+            self._check_session(proc, req.get("sid"))
+            if self._bypass_armed_fp == fp:
+                return {"armed": True}
+            self._bypass_votes[proc] = fp
+            world = max(self.world_size, 1)
+            if len(self._bypass_votes) >= world and \
+                    len(set(self._bypass_votes.values())) == 1:
+                self._bypass_armed_fp = fp
+                self._bypass_votes = {}
+                # entries reported in the race window right before the
+                # arm are dropped: every proc executes them through
+                # the bypass (they ARE the armed list), and a batch
+                # scheduled after the arm record would be consumed by
+                # fast pollers only.  Entries that turn out NOT to be
+                # coverable get re-reported by the unanimous fallback.
+                for key in list(self._pending):
+                    del self._pending[key]
+                    self._pending_since.pop(key, None)
+                    self._stall_warned_keys.discard(key)
+                logger.info(
+                    "steady-state negotiation bypass armed "
+                    "(fingerprint %s..., %d procs)", fp[:12], world)
+                self._log_append({"kind": "bypass_arm", "fp": fp})
+                self._lock.notify_all()
+                return {"armed": True}
+        return {}
+
+    def _disarm_bypass_locked(self):
+        if self._bypass_armed_fp is not None:
+            logger.info("steady-state negotiation bypass disarmed")
+        self._bypass_armed_fp = None
+        self._bypass_votes.clear()
+
+    # -- journal restore + compaction ----------------------------------------
+
+    def restore_journal(self, records):
+        """Rebuild control-plane state from journal records (the
+        restarted-service path: RendezvousServer.restart_from_journal).
+        Bumps the monotonic epoch and opens the liveness grace window;
+        the in-flight pending table is deliberately NOT restored —
+        workers re-report it after their resync handshake."""
+        with self._lock:
+            self._replaying = True
+            try:
+                for rec in records:
+                    self._restore_record_locked(rec)
+            finally:
+                self._replaying = False
+            self.coord_epoch += 1
+            grace = self.heartbeat_window or 1.5 * self.heartbeat_secs
+            self._grace_until = time.monotonic() + max(grace, 0.0)
+        self._j({"k": "epoch", "epoch": self.coord_epoch})
+        replayed = sum(self._journal_replayed.values())
+        logger.warning(
+            "coordinator restored from journal: %d records replayed, "
+            "epoch %d, round %d, %d response-log entries, liveness "
+            "grace %.1fs", replayed, self.coord_epoch, self.round_id,
+            len(self._log), max(self._grace_until - time.monotonic(),
+                                0.0))
+
+    def _restore_record_locked(self, rec):
+        kind = rec.get("k")
+        self._journal_replayed[kind] = \
+            self._journal_replayed.get(kind, 0) + 1
+        if kind == "epoch":
+            self.coord_epoch = int(rec["epoch"])
+        elif kind == "reset":
+            self.world_size = rec["world"]
+            self.round_id = rec["round"]
+            self._restore_clear_locked()
+        elif kind == "log":
+            if not self._log:
+                self._log_base = int(rec["i"])
+            self._log.append(rec["r"])
+            r = rec["r"]
+            if r.get("kind") == "dead":
+                self._dead[r["proc"]] = {
+                    "ranks": r.get("ranks", []), "age": 0.0,
+                    "host": r.get("host")}
+            elif r.get("kind") == "bypass_arm":
+                self._bypass_armed_fp = r.get("fp")
+        elif kind == "sess":
+            self._proc_sid[rec["proc"]] = rec["sid"]
+            self._session_base[rec["proc"]] = rec["base"]
+        elif kind == "join":
+            self._apply_join_locked(rec["req"])
+        elif kind == "hb":
+            if rec.get("ranks") is not None:
+                self._proc_ranks[rec["proc"]] = list(rec["ranks"])
+            if rec.get("host"):
+                self._proc_hosts[rec["proc"]] = rec["host"]
+        elif kind == "bye":
+            self._proc_ranks.pop(rec["proc"], None)
+            self._proc_hosts.pop(rec["proc"], None)
+        elif kind == "kv":
+            if self._store is not None:
+                self._store.restore(rec["key"],
+                                    journal_mod._unb64(rec["v"]))
+        elif kind == "kvdel":
+            if self._store is not None:
+                self._store.restore(rec["key"], None)
+        elif kind == "tuned":
+            if self._autotuner is not None:
+                for name, val in rec.get("p", {}).items():
+                    setattr(self._tuned_params, name, val)
+        elif kind == "snap":
+            self._restore_snapshot_locked(rec["s"])
+
+    def _restore_clear_locked(self):
+        """Round-reset state clear during replay (mirrors reset())."""
+        self._pending.clear()
+        self._log.clear()
+        self._log_base = 0
+        self._joined.clear()
+        self._proc_joined.clear()
+        self._exhausted.clear()
+        self._join_seen.clear()
+        self._proc_sid.clear()
+        self._session_base.clear()
+        self._errors.clear()
+        self._proc_ranks.clear()
+        self._proc_hosts.clear()
+        self._dead.clear()
+        self._bypass_votes.clear()
+        self._bypass_armed_fp = None
+
+    def _restore_snapshot_locked(self, s):
+        self._restore_clear_locked()
+        self.coord_epoch = s["epoch"]
+        self.round_id = s["round"]
+        self.world_size = s["world"]
+        self._log = list(s.get("log", []))
+        self._log_base = s.get("log_base", 0)
+        for proc, sid, base in s.get("sess", []):
+            self._proc_sid[proc] = sid
+            self._session_base[proc] = base
+        for ps, pairs in s.get("joined", {}).items():
+            self._joined[int(ps)] = {(p, r) for p, r in pairs}
+        for ps, counts in s.get("proc_joined", {}).items():
+            self._proc_joined[int(ps)] = {int(p): c
+                                          for p, c in counts.items()}
+        for ps, procs in s.get("exhausted", {}).items():
+            self._exhausted[int(ps)] = set(procs)
+        for ps, proc, jids in s.get("join_seen", []):
+            self._join_seen[(ps, proc)] = set(jids)
+        self._proc_ranks = {int(p): r
+                            for p, r in s.get("ranks", {}).items()}
+        self._proc_hosts = {int(p): h
+                            for p, h in s.get("hosts", {}).items()}
+        self._dead = {int(p): dict(info)
+                      for p, info in s.get("dead", {}).items()}
+        self._bypass_armed_fp = s.get("bypass_fp")
+        if self._autotuner is not None and s.get("tuned"):
+            for name, val in s["tuned"].items():
+                setattr(self._tuned_params, name, val)
+        if self._store is not None:
+            for key, val in s.get("kv", {}).items():
+                self._store.restore(key, journal_mod._unb64(val))
+
+    def _journal_snapshot_locked(self):
+        """Full current state for journal compaction (coordinator lock
+        held; takes the store lock via scope() — lock order
+        coordinator -> store everywhere, never the reverse)."""
+        kv = {}
+        if self._store is not None:
+            for key, val in self._store.scope("").items():
+                if key.startswith(journal_mod.KV_EXCLUDE_PREFIXES):
+                    continue
+                if len(val) > self._journal.kv_max_bytes:
+                    continue
+                kv[key] = journal_mod._b64(val)
+        tuned = None
+        if self._autotuner is not None:
+            tuned = dict(vars(self._tuned_params))
+        return {
+            "epoch": self.coord_epoch, "round": self.round_id,
+            "world": self.world_size,
+            "log": list(self._log), "log_base": self._log_base,
+            "sess": [[p, sid, self._session_base.get(p, 0)]
+                     for p, sid in self._proc_sid.items()],
+            "joined": {str(ps): sorted([p, r] for p, r in pairs)
+                       for ps, pairs in self._joined.items()},
+            "proc_joined": {str(ps): {str(p): c
+                                      for p, c in counts.items()}
+                            for ps, counts in self._proc_joined.items()},
+            "exhausted": {str(ps): sorted(procs)
+                          for ps, procs in self._exhausted.items()},
+            "join_seen": [[ps, proc, sorted(jids)]
+                          for (ps, proc), jids
+                          in self._join_seen.items()],
+            "ranks": {str(p): r for p, r in self._proc_ranks.items()},
+            "hosts": {str(p): h for p, h in self._proc_hosts.items()},
+            "dead": {str(p): dict(info)
+                     for p, info in self._dead.items()},
+            "bypass_fp": self._bypass_armed_fp,
+            "kv": kv, "tuned": tuned,
+        }
+
+    def _maybe_compact_locked(self):
+        """Bound the journal: replace history with one snapshot record
+        once the file exceeds its cap (clocked by worker polls, like
+        the stall and liveness scans)."""
+        if self._journal is None or not self._journal.needs_compaction():
+            return
+        self._journal.compact(self._journal_snapshot_locked())
+
+    def _journal_tuned_locked(self):
+        """Journal the coordinator autotuner's current best config
+        when it changes (cheap dict compare, clocked by _advance)."""
+        if self._journal is None or self._autotuner is None:
+            return
+        params = dict(vars(self._tuned_params))
+        if params != self._last_tuned_journaled:
+            self._last_tuned_journaled = params
+            self._j({"k": "tuned", "p": params})
 
     def _scan_heartbeats(self):
         """Declare procs whose beats stopped for the window dead and
@@ -568,8 +955,12 @@ class Coordinator:
         interval with the default 1.5x window.  Must hold the lock."""
         if self.heartbeat_secs <= 0 or not self._beats:
             return
-        window = self.heartbeat_window or 1.5 * self.heartbeat_secs
         now = time.monotonic()
+        if now < self._grace_until:
+            # post-restart grace: beats missed during the outage are
+            # not deaths; liveness only counts beats after the window
+            return
+        window = self.heartbeat_window or 1.5 * self.heartbeat_secs
         died = False
         for proc, last in list(self._beats.items()):
             if proc in self._dead or now - last <= window:
@@ -583,7 +974,7 @@ class Coordinator:
                 "for %.1fs (interval %.1fs); failing its pending "
                 "negotiations", proc, ranks or "unknown", age,
                 self.heartbeat_secs)
-            self._log.append({
+            self._log_append({
                 "kind": "dead", "proc": proc, "ranks": ranks,
                 "host": self._proc_hosts.get(proc),
                 "message": (f"worker process {proc} hosting global "
@@ -615,7 +1006,7 @@ class Coordinator:
                 del self._pending[key]
                 self._pending_since.pop(key, None)
                 self._stall_warned_keys.discard(key)
-                self._log.append({
+                self._log_append({
                     "kind": "error", "key": key,
                     "message": (
                         f"worker process {proc} hosting global ranks "
@@ -626,17 +1017,26 @@ class Coordinator:
     def dead_procs(self):
         """Declared-dead procs this round: {proc: {ranks, host, age}}.
         The elastic driver polls this to blacklist hung hosts that
-        never exit (runner/elastic/driver.py)."""
+        never exit (runner/elastic/driver.py).  Doubles as a scan
+        clock: with every worker bypassed (no polls) and ALL workers
+        hung (no beats either), the driver's monitor loop is the only
+        clock left."""
         with self._lock:
+            self._scan_heartbeats()
             return {p: dict(info) for p, info in self._dead.items()}
 
     def liveness_snapshot(self):
         """Coordinator-derived families merged into the job-wide
         ``/metrics``: ``horovod_worker_alive{proc}`` (1 = beating,
         0 = declared dead) and the coordinator-side chaos injections
-        (``horovod_faults_injected_total{kind="coord_*"}``)."""
+        (``horovod_faults_injected_total{kind="coord_*"}``), plus the
+        crash-survival families: ``horovod_coord_epoch`` (bumped on
+        every journal replay) and the per-kind journal replay
+        counters."""
         from ...telemetry import (
+            COORD_EPOCH_FAMILY, COORD_EPOCH_HELP,
             FAULTS_INJECTED_FAMILY, FAULTS_INJECTED_HELP,
+            JOURNAL_REPLAYED_FAMILY, JOURNAL_REPLAYED_HELP,
             WORKER_ALIVE_FAMILY, WORKER_ALIVE_HELP,
         )
 
@@ -644,7 +1044,22 @@ class Coordinator:
             alive = {p: (0.0 if p in self._dead else 1.0)
                      for p in set(self._beats) | set(self._dead)}
             injected = dict(self._chaos_injected)
-        fams = {}
+            epoch = self.coord_epoch
+            replayed = dict(self._journal_replayed)
+        fams = {
+            COORD_EPOCH_FAMILY: {
+                "type": "gauge",
+                "help": COORD_EPOCH_HELP,
+                "labelnames": [],
+                "samples": [{"labels": {}, "value": float(epoch)}]},
+        }
+        if replayed:
+            fams[JOURNAL_REPLAYED_FAMILY] = {
+                "type": "counter",
+                "help": JOURNAL_REPLAYED_HELP,
+                "labelnames": ["kind"],
+                "samples": [{"labels": {"kind": k}, "value": float(v)}
+                            for k, v in sorted(replayed.items())]}
         if alive:
             fams[WORKER_ALIVE_FAMILY] = {
                 "type": "gauge",
@@ -664,7 +1079,8 @@ class Coordinator:
     # -- coordinator-side chaos (docs/fault_tolerance.md) -------------------
 
     def add_chaos_rule(self, kind, proc=None, verb=None, after=1,
-                       count=1, code=503, ms=0.0, p=1.0, rng=None):
+                       count=1, code=503, ms=0.0, p=1.0, rng=None,
+                       event=None):
         """Install one server-side fault rule: reject
         (``kind="http_error"``) or stall (``kind="delay_ms"``) the
         matching coordinator requests from the ``after``-th on, up to
@@ -672,11 +1088,16 @@ class Coordinator:
         ``p`` gates each eligible request on a draw from ``rng`` (the
         plan's seeded per-event stream; skipped requests redraw at
         the next one, mirroring worker-side semantics).  Installed by
-        launchers from fault-plan events with ``side: "coord"``."""
-        if kind not in ("http_error", "delay_ms"):
+        launchers from fault-plan events with ``side: "coord"``.
+        ``kind="signal"`` fires ``event.set()`` instead of perturbing
+        the request — the hook the chaos CoordFaultRunner uses to
+        trigger a coordinator kill/restart on the n-th request."""
+        if kind not in ("http_error", "delay_ms", "signal"):
             raise ValueError(
-                f"coordinator chaos supports http_error/delay_ms, "
-                f"not {kind}")
+                f"coordinator chaos supports http_error/delay_ms/"
+                f"signal, not {kind}")
+        if kind == "signal" and event is None:
+            raise ValueError("signal rules need an event to set")
         import random as _random
         with self._lock:
             self._chaos_rules.append({
@@ -684,7 +1105,7 @@ class Coordinator:
                 "after": int(after), "count": int(count),
                 "code": int(code), "ms": float(ms),
                 "p": float(p), "rng": rng or _random.Random(0),
-                "n": 0, "fires": 0})
+                "event": event, "n": 0, "fires": 0})
 
     def chaos_check(self, verb, req):
         """Consulted by the HTTP handler before dispatching a verb.
@@ -700,13 +1121,20 @@ class Coordinator:
                 if rule["proc"] is not None and proc != rule["proc"]:
                     continue
                 rule["n"] += 1
-                if action is not None or rule["fires"] >= rule["count"] \
+                if (action is not None and rule["kind"] != "signal") \
+                        or rule["fires"] >= rule["count"] \
                         or rule["n"] < rule["after"]:
                     continue
                 if rule["p"] < 1.0 and \
                         rule["rng"].random() >= rule["p"]:
                     continue    # probabilistic skip: redraw next time
                 rule["fires"] += 1
+                if rule["kind"] == "signal":
+                    # trigger hook for the launcher-side coordinator
+                    # fault runner (kill/restart on the n-th request);
+                    # the request itself proceeds untouched
+                    rule["event"].set()
+                    continue
                 if rule["kind"] == "http_error":
                     label = "coord_http_error"
                     action = ("error", rule["code"])
@@ -753,6 +1181,11 @@ class Coordinator:
             # new sessions start polling at the CURRENT log end
             self._session_base[proc] = self._log_base + len(self._log)
             self._cursors.pop(proc, None)
+            # journaled so a restarted coordinator recognizes the SAME
+            # session (no state wipe, cursor fencing intact) instead of
+            # treating the surviving worker as a fresh one
+            self._j({"k": "sess", "proc": proc, "sid": sid,
+                     "base": self._session_base[proc]})
 
     def _on_ready(self, req):
         """Worker announces locally-ready entries.
@@ -785,6 +1218,11 @@ class Coordinator:
                 if rid < last:
                     return {}
                 self._ready_seen[proc] = rid
+            if req.get("entries"):
+                # a worker reporting entries has left the bypass fast
+                # path (the agreement vote made the exit unanimous):
+                # disarm so a fresh stable phase must re-vote
+                self._disarm_bypass_locked()
             for meta in req["entries"]:
                 key = meta["key"]
                 if "c" in meta:
@@ -872,30 +1310,49 @@ class Coordinator:
         proc = req.get("proc", -1)
         with self._lock:
             self._check_session(proc, req.get("sid"))
-            jid = req.get("jid")
-            if jid is not None:
-                # joins are not naturally idempotent (per-proc counting
-                # below); dedup on the client's join id so the http
-                # client's reconnect-retry can safely re-send
-                seen = self._join_seen.setdefault((ps, proc), set())
-                if jid in seen:
-                    return {}
-                seen.add(jid)
-            j = self._joined.setdefault(ps, set())
-            j.add((proc, req["rank"]))
-            pj = self._proc_joined.setdefault(ps, {})
-            pj[proc] = pj.get(proc, 0) + 1
-            if pj[proc] >= req.get("proc_members", 1):
-                self._exhausted.setdefault(ps, set()).add(proc)
-            if len(j) >= req.get("ps_size", self.world_size):
-                self._log.append({"kind": "join_done", "ps": ps,
-                                  "last": req["rank"]})
-                self._joined[ps] = set()
-                self._proc_joined[ps] = {}
-                self._exhausted[ps] = set()
+            if self._apply_join_locked(req):
+                # journaled post-dedup: a restarted coordinator must
+                # not lose joined/exhausted state (or the exhausted
+                # proc's peers would wait for reports that never come),
+                # and the replayed jid keeps outage-spanning join
+                # retries single-apply
+                self._j({"k": "join", "req": {
+                    "ps": ps, "proc": proc, "rank": req.get("rank"),
+                    "jid": req.get("jid"),
+                    "proc_members": req.get("proc_members", 1),
+                    "ps_size": req.get("ps_size", self.world_size)}})
+            self._disarm_bypass_locked()
             self._advance()
             self._lock.notify_all()
         return {}
+
+    def _apply_join_locked(self, req) -> bool:
+        """Join-state mutation shared by the live verb and journal
+        replay.  Returns False when the jid was already seen (dedup)."""
+        ps = req.get("ps", 0)
+        proc = req.get("proc", -1)
+        jid = req.get("jid")
+        if jid is not None:
+            # joins are not naturally idempotent (per-proc counting
+            # below); dedup on the client's join id so the http
+            # client's reconnect-retry can safely re-send
+            seen = self._join_seen.setdefault((ps, proc), set())
+            if jid in seen:
+                return False
+            seen.add(jid)
+        j = self._joined.setdefault(ps, set())
+        j.add((proc, req["rank"]))
+        pj = self._proc_joined.setdefault(ps, {})
+        pj[proc] = pj.get(proc, 0) + 1
+        if pj[proc] >= req.get("proc_members", 1):
+            self._exhausted.setdefault(ps, set()).add(proc)
+        if len(j) >= req.get("ps_size", self.world_size):
+            self._log_append({"kind": "join_done", "ps": ps,
+                              "last": req["rank"]})
+            self._joined[ps] = set()
+            self._proc_joined[ps] = {}
+            self._exhausted[ps] = set()
+        return True
 
     def _advance(self):
         """Move fully-ready entries (all non-exhausted processes
@@ -914,7 +1371,7 @@ class Coordinator:
                 # _discard_stall_mark contract)
                 self._stall_warned_keys.discard(key)
                 if key in self._errors:
-                    self._log.append({"kind": "error", "key": key,
+                    self._log_append({"kind": "error", "key": key,
                                       "message": self._errors.pop(key)})
                 else:
                     # merge per-process aux (allgather dims / alltoall
@@ -929,7 +1386,7 @@ class Coordinator:
         def flush():
             nonlocal bucket, bucket_bytes, sig
             if bucket:
-                self._log.append(self._batch_response(bucket))
+                self._log_append(self._batch_response(bucket))
                 if self._autotuner is not None:
                     # emission rate tracks collective throughput:
                     # workers only re-report after executing the
@@ -942,6 +1399,9 @@ class Coordinator:
         if self._autotuner is not None:
             self.fusion_threshold = self._tuned_params.fusion_threshold_bytes
             self.cache_capacity = self._tuned_params.cache_capacity
+            # a restarted coordinator must not re-learn from scratch:
+            # the current best config rides the journal
+            self._journal_tuned_locked()
         for meta in ready:
             if meta["type"] not in ("ALLREDUCE", "ADASUM",
                                     "ALLGATHER"):
@@ -949,17 +1409,17 @@ class Coordinator:
                     # join only supports allreduce (reference
                     # controller.cc:413-423): other ops with joined
                     # processes error instead of hanging
-                    self._log.append({
+                    self._log_append({
                         "kind": "error", "key": meta["key"],
                         "message": (f"{meta['type']} does not support "
                                     f"joined ranks")})
                     continue
                 flush()
-                self._log.append(self._batch_response([meta]))
+                self._log_append(self._batch_response([meta]))
                 continue
             if meta["type"] == "ALLGATHER":
                 if self._exhausted.get(meta.get("ps", 0)):
-                    self._log.append({
+                    self._log_append({
                         "kind": "error", "key": meta["key"],
                         "message": "ALLGATHER does not support "
                                    "joined ranks"})
@@ -1093,7 +1553,7 @@ class Coordinator:
                 "(non-reporting processes: %s, hosting global ranks: "
                 "%s)", key, age, missing_procs,
                 missing_ranks if members else "unknown")
-            self._log.append({
+            self._log_append({
                 "kind": "stall", "key": key, "ps": ps,
                 "age": round(age, 1),
                 "missing_ranks": missing_ranks,
@@ -1110,7 +1570,7 @@ class Coordinator:
             # engine thread still polls — pushes its last-N-seconds
             # ring exactly once per stall burst
             self._next_dump_id += 1
-            self._log.append({"kind": "trace_dump",
+            self._log_append({"kind": "trace_dump",
                               "id": self._next_dump_id,
                               "reason": "stall"})
             self._lock.notify_all()     # wake parked long-polls
@@ -1128,10 +1588,12 @@ class Coordinator:
                 # don't let a stale cursor poison the new round's GC
                 return {"stale": True, "round": self.round_id}
             # polls arrive every worker cycle, so they are the stall
-            # inspector's AND the liveness scan's clock (the
-            # coordinator has no thread of its own)
+            # inspector's, the liveness scan's AND the journal
+            # compactor's clock (the coordinator has no thread of its
+            # own)
             self._scan_stalls()
             self._scan_heartbeats()
+            self._maybe_compact_locked()
             if proc is not None:
                 # a re-sessioned controller polls from cursor 0; its
                 # session starts at the log position recorded when the
@@ -1152,13 +1614,17 @@ class Coordinator:
                     return {"stale": True, "round": self.round_id}
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    return {"responses": [], "cursor": cursor}
+                    return {"responses": [], "cursor": cursor,
+                            "epoch": self.coord_epoch}
                 self._lock.wait(remaining)
             if self.round_id != round_at_entry:
                 return {"stale": True, "round": self.round_id}
             resp = self._log[max(0, cursor - self._log_base):]
+            # poll replies carry the epoch: the worker adopts it on
+            # first contact and fences every later verb with it
             out = {"responses": resp,
-                   "cursor": self._log_base + len(self._log)}
+                   "cursor": self._log_base + len(self._log),
+                   "epoch": self.coord_epoch}
             if self._autotuner is not None:
                 out["tuned"] = {
                     "cycle_time_ms": self._tuned_params.cycle_time_ms,
@@ -1184,6 +1650,38 @@ class _ThreadingHTTPServer(socketserver.ThreadingMixIn,
     daemon_threads = True
     allow_reuse_address = True
 
+    def __init__(self, *args, **kwargs):
+        # live keep-alive connections, so a coordinator kill/restart
+        # can sever them: a handler thread parked on an old keep-alive
+        # would otherwise keep serving the PRE-restart coordinator
+        # object, quietly splitting the control plane in two
+        self._conns = set()
+        self._conns_lock = threading.Lock()
+        super().__init__(*args, **kwargs)
+
+    def process_request(self, request, client_address):
+        with self._conns_lock:
+            self._conns.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._conns_lock:
+            self._conns.discard(request)
+        super().shutdown_request(request)
+
+    def close_all_connections(self):
+        with self._conns_lock:
+            conns, self._conns = set(self._conns), set()
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
     def handle_error(self, request, client_address):
         # keep-alive sockets torn down by exiting workers are routine,
         # not server errors — don't spray tracebacks on every shutdown
@@ -1205,21 +1703,59 @@ class RendezvousServer:
                  autotune_log: str = None, cycle_time_ms: float = 1.0,
                  stall_warning_secs: float = 60.0,
                  heartbeat_secs: float = 5.0,
-                 heartbeat_window: float = 0.0):
-        self.store = KVStore()
-        self.coordinator = Coordinator(world_size, fusion_threshold_bytes,
-                                       cache_capacity=cache_capacity,
-                                       autotune=autotune,
-                                       autotune_log=autotune_log,
-                                       cycle_time_ms=cycle_time_ms,
-                                       stall_warning_secs=stall_warning_secs,
-                                       heartbeat_secs=heartbeat_secs,
-                                       heartbeat_window=heartbeat_window)
+                 heartbeat_window: float = 0.0,
+                 journal_path: str = None,
+                 journal_replay: bool = False):
+        self._coord_kwargs = dict(
+            world_size=world_size,
+            fusion_threshold_bytes=fusion_threshold_bytes,
+            cache_capacity=cache_capacity, autotune=autotune,
+            autotune_log=autotune_log, cycle_time_ms=cycle_time_ms,
+            stall_warning_secs=stall_warning_secs,
+            heartbeat_secs=heartbeat_secs,
+            heartbeat_window=heartbeat_window)
+        self._journal_path = journal_path
         self.secret = secret
         self._httpd = None
         self._thread = None
+        self._bound_port = None
+        self._build(replay=journal_replay)
+
+    def _build(self, replay):
+        """(Re)build store + coordinator.  With a journal path: a
+        fresh job truncates whatever a previous job left there, while
+        ``replay=True`` (restart_from_journal, or
+        ``HOROVOD_COORD_JOURNAL_REPLAY=1`` for a restarted launcher)
+        rebuilds the control plane from the records and bumps the
+        epoch."""
+        journal = records = None
+        if self._journal_path:
+            journal = journal_mod.CoordJournal(self._journal_path)
+            if replay:
+                records = journal.read()
+            elif os.path.exists(self._journal_path):
+                journal.truncate()
+        self.store = KVStore()
+        self.coordinator = Coordinator(journal=journal,
+                                       **self._coord_kwargs)
+        self.coordinator.attach_store(self.store)
+        if journal is not None:
+            if records:
+                self.coordinator.restore_journal(records)
+            else:
+                # first record of a fresh journal: the base epoch
+                self.coordinator._j(
+                    {"k": "epoch",
+                     "epoch": self.coordinator.coord_epoch})
+            # KV journaling goes live only AFTER replay so restored
+            # entries are not re-journaled
+            self.store.journal = journal
 
     def start(self, port=0) -> int:
+        if port == 0 and self._bound_port:
+            # a restarted service must come back on the SAME port —
+            # workers have the address baked into their env handoff
+            port = self._bound_port
         self._httpd = _ThreadingHTTPServer(("0.0.0.0", port), _Handler)
         self._httpd.store = self.store
         self._httpd.coordinator = self.coordinator
@@ -1227,18 +1763,48 @@ class RendezvousServer:
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name="hvd-rendezvous", daemon=True)
         self._thread.start()
-        return self._httpd.server_address[1]
+        self._bound_port = self._httpd.server_address[1]
+        return self._bound_port
 
     @property
     def port(self):
-        return self._httpd.server_address[1] if self._httpd else None
+        # while the HTTP service is down (coord_kill window) the bound
+        # port is still the service's identity: an elastic round reset
+        # mid-outage must bake the REAL port into worker env, not None
+        if self._httpd is not None:
+            return self._httpd.server_address[1]
+        return self._bound_port
 
-    def stop(self):
-        self.coordinator.close()
+    def stop_http(self):
+        """Tear down the HTTP service only (chaos ``coord_kill``):
+        state and journal stay, workers see connection failures and
+        ride the bypass / outage-deadline retry path."""
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
+            # sever live keep-alives too: their handler threads hold
+            # the OLD coordinator object and would keep answering
+            self._httpd.close_all_connections()
             self._httpd = None
+
+    def restart_from_journal(self) -> int:
+        """Crash-recovery drill (chaos ``coord_restart``): drop ALL
+        in-memory state, rebuild store + coordinator purely from the
+        journal (epoch bumped, liveness grace armed) and re-serve on
+        the same port.  Proves the journal alone carries the control
+        plane."""
+        if not self._journal_path:
+            raise RuntimeError(
+                "restart_from_journal requires a journal "
+                "(HOROVOD_COORD_JOURNAL)")
+        self.stop_http()
+        self.coordinator.close()
+        self._build(replay=True)
+        return self.start()
+
+    def stop(self):
+        self.coordinator.close()
+        self.stop_http()
 
 
 def free_port():

@@ -143,6 +143,32 @@ def parse_args(argv=None):
                         help="explicit missed-beat death window "
                              "(default 1.5x the interval; "
                              "HOROVOD_HEARTBEAT_WINDOW_SECONDS)")
+    # coordinator crash survival + steady-state bypass
+    # (docs/fault_tolerance.md "Coordinator crash survival")
+    parser.add_argument("--coord-journal", default=None,
+                        help="path for the launcher-side control-plane "
+                             "journal; a restarted rendezvous service "
+                             "replays it (epoch-fenced) instead of "
+                             "killing every healthy worker "
+                             "(HOROVOD_COORD_JOURNAL)")
+    parser.add_argument("--coord-outage-deadline-seconds", type=float,
+                        default=None,
+                        help="how long replay-safe fabric requests "
+                             "keep retrying across a coordinator "
+                             "outage (default 120; "
+                             "HOROVOD_COORD_OUTAGE_DEADLINE_SECONDS)")
+    parser.add_argument("--bypass-after-cycles", type=int, default=None,
+                        help="identical negotiation cycles before the "
+                             "ranks bypass the coordinator via a "
+                             "bitvector agreement on the collective "
+                             "path (0 disables; default 5; "
+                             "HOROVOD_BYPASS_AFTER_CYCLES)")
+    parser.add_argument("--bypass-wait-seconds", type=float,
+                        default=None,
+                        help="bound on each bypass cycle's wait for "
+                             "the cached tensors before forcing full "
+                             "renegotiation "
+                             "(HOROVOD_BYPASS_WAIT_SECONDS)")
     # serving tier (docs/serving.md): --serve marks the job as an
     # inference fleet — workers run hvd.serving.start() replicas, the
     # knobs ride the same HOROVOD_SERVING_* env handoff as every other

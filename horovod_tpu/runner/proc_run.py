@@ -282,10 +282,18 @@ def launch_procs(command: List[str], np: int, hosts: str = None,
     # fault-plan events with side="coord" are the LAUNCHER's to apply
     # (reject/stall chosen procs' coordinator requests server-side);
     # worker-side events ride the HOROVOD_FAULT_PLAN env handoff
+    coord_faults = None
     if launcher_env.get("HOROVOD_FAULT_PLAN"):
-        from ..chaos import install_coordinator_rules
+        from ..chaos import (
+            install_coordinator_rules, start_coordinator_faults,
+        )
         install_coordinator_rules(server.coordinator, launcher_env)
     rdv_port = server.start()
+    if launcher_env.get("HOROVOD_FAULT_PLAN"):
+        # service-targeting faults (coord_kill/coord_restart) act on
+        # the RUNNING server — armed after the port is bound so a
+        # restart can rebind it
+        coord_faults = start_coordinator_faults(server, launcher_env)
     rdv_addr = local_ip() if any_remote else "127.0.0.1"
     # jax.distributed's coordination service is hosted by PROCESS 0
     # (basics.py), so its address must point at rank 0's host — not
@@ -338,6 +346,8 @@ def launch_procs(command: List[str], np: int, hosts: str = None,
                           stop_on_failure=stop_on_failure)
     finally:
         pool.terminate()
+        if coord_faults is not None:
+            coord_faults.stop()
         server.stop()
         for f in out_files:
             f.close()

@@ -128,7 +128,12 @@ class Autoscaler:
 
     def __init__(self, driver, store, policy=None, interval_s=5.0):
         self.driver = driver
-        self.store = store
+        # accept a RendezvousServer too, and ALWAYS dereference its
+        # live store per read: restart_from_journal swaps the store
+        # object, and a captured reference would read a dead one
+        # forever (the same contract ElasticDriver follows)
+        self._store_owner = store if hasattr(store, "store") else None
+        self._store = None if self._store_owner is not None else store
         self.policy = policy or AutoscalePolicy()
         self.interval_s = max(float(interval_s), 0.5)
         #: how long a snapshot's bytes may stay unchanged before it is
@@ -147,6 +152,11 @@ class Autoscaler:
             daemon=True)
         #: decision log (bounded) — surfaced in driver events/tests
         self.decisions = []
+
+    @property
+    def store(self):
+        return self._store_owner.store \
+            if self._store_owner is not None else self._store
 
     def start(self):
         self._thread.start()

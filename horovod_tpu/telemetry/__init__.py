@@ -51,6 +51,29 @@ WORKER_ALIVE_FAMILY = "horovod_worker_alive"
 WORKER_ALIVE_HELP = ("Worker liveness from coordinator heartbeats "
                      "(1 = beating, 0 = declared dead)")
 
+# -- coordinator crash survival + steady-state bypass families
+#    (docs/fault_tolerance.md "Coordinator crash survival"):
+#    coord_epoch/journal live on the coordinator's liveness snapshot,
+#    the bypass families on every worker's registry.
+
+COORD_EPOCH_FAMILY = "horovod_coord_epoch"
+COORD_EPOCH_HELP = ("Coordinator generation id; bumped every time a "
+                    "restarted rendezvous service replays its journal")
+JOURNAL_REPLAYED_FAMILY = "horovod_coord_journal_replayed_total"
+JOURNAL_REPLAYED_HELP = ("Journal records replayed by the last "
+                         "coordinator restart, by record kind")
+BYPASS_CYCLES_FAMILY = "horovod_negotiation_bypass_cycles_total"
+BYPASS_CYCLES_HELP = ("Steady-state negotiation bypass cycles: "
+                      "outcome=hit executed the cached response list "
+                      "without the coordinator, outcome=fallback "
+                      "disengaged into full negotiation")
+BYPASS_CYCLE_SECONDS_FAMILY = "horovod_bypass_cycle_seconds"
+BYPASS_CYCLE_SECONDS_HELP = ("Agreement-vote + execution time of "
+                             "bypass hit cycles")
+COORD_RESYNCS_FAMILY = "horovod_coord_resyncs_total"
+COORD_RESYNCS_HELP = ("Epoch-fenced resync handshakes this worker "
+                      "performed against a restarted coordinator")
+
 
 def count_fabric_retry(verb):
     """One fabric retry attempt, into the process-current registry
@@ -64,6 +87,13 @@ def count_fault_injected(kind):
     """One chaos injection, into the process-current registry."""
     registry().counter(FAULTS_INJECTED_FAMILY, FAULTS_INJECTED_HELP,
                        labelnames=("kind",)).labels(kind=kind).inc()
+
+
+def count_coord_resync():
+    """One epoch resync handshake (the StoreController performed it
+    against a restarted coordinator), into the process-current
+    registry."""
+    registry().counter(COORD_RESYNCS_FAMILY, COORD_RESYNCS_HELP).inc()
 
 
 def metrics():

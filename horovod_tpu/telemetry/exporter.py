@@ -204,7 +204,7 @@ class MetricsPusher:
         self._thread.start()
         return self
 
-    def push_now(self):
+    def push_now(self, final=False):
         import time
 
         # ts lets snapshot consumers with liveness semantics (the
@@ -215,16 +215,27 @@ class MetricsPusher:
                               proc=self.proc_id, ts=time.time(),
                               **self.meta)
         try:
+            # the FINAL push races teardown: the rendezvous service
+            # may already be gone, and the fabric's outage-spanning
+            # retry budget would wedge clean worker exit for minutes —
+            # one bounded retry, then drop the snapshot with a debug
+            # log (docs/fault_tolerance.md)
             self.client.put(f"{TELEMETRY_KV_PREFIX}{self.proc_id}",
-                            payload.encode())
-        except Exception:  # noqa: BLE001 — the coordinator may be
-            # gone during teardown; telemetry must never kill a worker
-            pass
+                            payload.encode(),
+                            budget=(2, 2.0) if final else None)
+        except Exception as exc:  # noqa: BLE001 — the coordinator may
+            # be gone during teardown; telemetry must never kill (or
+            # hang) a worker
+            if final:
+                import logging
+                logging.getLogger("horovod_tpu").debug(
+                    "final metrics push dropped (coordinator gone): "
+                    "%s", exc)
 
     def _loop(self):
         while not self._stop.wait(self.interval):
             self.push_now()
-        self.push_now()     # final snapshot at shutdown
+        self.push_now(final=True)   # final snapshot at shutdown
 
     def stop(self, timeout=5.0):
         self._stop.set()

@@ -204,9 +204,65 @@ def test_engine_hook_via_env_single_process(monkeypatch, hvd_shutdown,
 
 def test_replay_safe_verbs_contract():
     # timeout replays are ONLY safe where the coordinator dedups on a
-    # client id (ready/join) or the verb is naturally idempotent
+    # client id (ready/join), on idempotent per-slot state
+    # (resync/bypass_ready), or the verb is naturally idempotent
     # (heartbeat); widening this list needs a server-side dedup first
-    assert REPLAY_SAFE_VERBS == ("ready", "join", "heartbeat")
+    assert REPLAY_SAFE_VERBS == ("ready", "join", "heartbeat",
+                                 "resync", "bypass_ready")
+    # EVERY replay-safe verb must be single-apply under an identical
+    # replay — the property outage-spanning retries lean on
+    c = Coordinator(world_size=2)
+    # ready: rid-deduplicated (one report, no phantom second entry)
+    req = {"proc": 0, "nlocal": 1, "round": 0, "rid": 1, "sid": "s",
+           "entries": [_meta("rs.k", {"0": [0], "1": [1]})]}
+    c.handle("ready", req)
+    c.handle("ready", req)
+    assert list(c._pending["rs.k"].keys()) == [0]
+    # heartbeat: naturally idempotent
+    c.handle("heartbeat", {"proc": 0, "ranks": [0]})
+    c.handle("heartbeat", {"proc": 0, "ranks": [0]})
+    assert set(c._beats) == {0} and c._proc_ranks == {0: [0]}
+    # resync: re-registering the same session is a no-op (state and
+    # log position survive)
+    out1 = c.handle("resync", {"proc": 0, "sid": "s", "round": 0})
+    out2 = c.handle("resync", {"proc": 0, "sid": "s", "round": 0})
+    assert out1 == out2
+    assert list(c._pending["rs.k"].keys()) == [0]   # not wiped
+    # join: jid-deduplicated (counted once)
+    jreq = {"ps": 0, "proc": 0, "rank": 0, "ps_size": 2,
+            "proc_members": 1, "jid": 7, "sid": "s"}
+    c.handle("join", jreq)
+    c.handle("join", jreq)
+    assert c._proc_joined[0][0] == 1
+    # bypass_ready: replayed votes re-fill the same slot; a full
+    # quorum arms EXACTLY one bypass_arm record even when every vote
+    # is replayed
+    for _ in range(2):
+        c.handle("bypass_ready", {"proc": 0, "sid": "s", "round": 0,
+                                  "fp": "fp.x"})
+        c.handle("bypass_ready", {"proc": 1, "sid": "t", "round": 0,
+                                  "fp": "fp.x"})
+    arms = [r for r in c._log if r.get("kind") == "bypass_arm"]
+    assert len(arms) == 1 and arms[0]["fp"] == "fp.x"
+
+
+def test_epoch_fence_rejects_stale_generation_before_verb_runs():
+    """The cross-restart half of the replay contract: a request minted
+    against a previous coordinator generation is fenced BEFORE its
+    verb executes, so an outage-spanning blind replay can never
+    double-apply — the client answers with one resync handshake."""
+    c = Coordinator(world_size=2)
+    c.coord_epoch = 3
+    req = {"proc": 0, "round": 0, "rid": 1, "sid": "s", "epoch": 2,
+           "entries": [_meta("ef.k", {"0": [0], "1": [1]})]}
+    assert c.handle("ready", req) == {"epoch_mismatch": True,
+                                      "epoch": 3}
+    assert "ef.k" not in c._pending           # verb never ran
+    out = c.handle("resync", {"proc": 0, "sid": "s", "round": 0})
+    assert out["epoch"] == 3
+    req["epoch"] = 3
+    c.handle("ready", req)
+    assert "ef.k" in c._pending
 
 
 def test_client_retries_coordinator_5xx_burst():
@@ -430,6 +486,217 @@ def test_load_and_broadcast_raises_collectively(tmp_path, hvd_shutdown):
     good = tmp_path / "good.pkl"
     save_rank0(str(good), {"step": 7})
     assert load_and_broadcast(str(good)) == {"step": 7}
+
+
+# -- steady-state negotiation bypass (core/bypass.py) -------------------------
+
+def _batch(key, **over):
+    """A coordinator batch response for one allreduce entry."""
+    meta = _meta(key, {"0": [0], "1": [1]})
+    meta.update(over)
+    return {"kind": "batch", "keys": [key], "metas": {key: meta},
+            "aux": {key: {"0": {}, "1": {}}}, "trace": {key: 42}}
+
+
+def _bp(K=3, wait=5.0):
+    from horovod_tpu.core.bypass import BypassState
+    return BypassState(after_cycles=K, wait_secs=wait)
+
+
+def _cycles(bp, responses, n):
+    """Feed n identical cycles; return the last cycle_complete()."""
+    fp = None
+    for _ in range(n):
+        for r in responses:
+            bp.observe_response(r)
+        fp = bp.cycle_complete()
+    return fp
+
+
+def test_bypass_engages_after_k_stable_cycles():
+    bp = _bp(K=3)
+    assert _cycles(bp, [_batch("g.0"), _batch("g.1")], 2) is None
+    fp = _cycles(bp, [_batch("g.0"), _batch("g.1")], 1)
+    assert fp is not None               # K-th identical cycle votes
+    # trace/cache ids are volatile and must NOT shape the fingerprint
+    bp2 = _bp(K=3)
+    alt = [dict(_batch("g.0"), trace={"g.0": 999}), _batch("g.1")]
+    assert _cycles(bp2, alt, 3) == fp
+
+
+def test_bypass_stability_resets_on_list_or_param_change():
+    bp = _bp(K=2)
+    assert _cycles(bp, [_batch("g.0")], 2) is not None
+    # wire-dtype flip: same tensor name, different negotiated params
+    bp.disarm()
+    _cycles(bp, [_batch("g.0")], 1)
+    assert _cycles(bp, [_batch("g.0", wire="int8")], 1) is None
+    # new tensor joins the cycle
+    bp.disarm()
+    _cycles(bp, [_batch("g.0")], 1)
+    assert _cycles(bp, [_batch("g.0"), _batch("g.new")], 1) is None
+    # an error response poisons the cycle entirely
+    bp.disarm()
+    _cycles(bp, [_batch("g.0")], 1)
+    bp.observe_response({"kind": "error", "key": "g.0",
+                         "message": "boom"})
+    bp.observe_response(_batch("g.0"))
+    assert bp.cycle_complete() is None
+
+
+def test_bypass_ineligible_kinds_never_vote():
+    # non-cacheable op types and non-global process sets are out
+    bp = _bp(K=1)
+    assert _cycles(bp, [_batch("b.0", type="BROADCAST")], 3) is None
+    bp = _bp(K=1)
+    assert _cycles(bp, [_batch("p.0", ps=1)], 3) is None
+
+
+def test_bypass_armed_decisions_matrix():
+    from horovod_tpu.core.bypass import meta_fingerprint
+    bp = _bp(K=1, wait=0.5)
+    fp = _cycles(bp, [_batch("g.0"), _batch("g.1")], 1)
+    bp.on_arm(fp)
+    assert bp.active and not bp.broken
+    fps = {k: meta_fingerprint(m)
+           for r in bp.responses for k, m in r["metas"].items()}
+    # exact match -> vote 1
+    assert bp.decide(fps, foreign=False) == (1, None)
+    # nothing ready yet -> keep waiting
+    assert bp.decide({}, foreign=False) is None
+    # a foreign process set's entry -> unanimous fallback
+    assert bp.decide(fps, foreign=True) == (0, "mismatch")
+    # an extra (new) tensor -> fallback
+    assert bp.decide({**fps, "g.new": "x"},
+                     foreign=False) == (0, "mismatch")
+    # same name, flipped params (wire dtype) -> fallback
+    bad = dict(fps)
+    bad["g.0"] = meta_fingerprint(
+        _batch("g.0", wire="int8")["metas"]["g.0"])
+    assert bp.decide(bad, foreign=False) == (0, "mismatch")
+    # partial readiness waits... but only up to the bound (a stalled
+    # or desynced rank must degrade into full negotiation)
+    part = {"g.0": fps["g.0"]}
+    assert bp.decide(part, foreign=False, now=100.0) is None
+    assert bp.decide(part, foreign=False,
+                     now=100.7) == (0, "timeout")
+    # poison (join) forces the next round to fall back
+    bp._wait_t0 = None
+    bp.poison("join")
+    assert bp.decide(fps, foreign=False) == (0, "join")
+
+
+def test_bypass_arm_with_unknown_fingerprint_is_broken_not_deadlock():
+    """A proc whose cycle moved on after voting still ARMS (else its
+    peers' agreement collective would block forever) — but broken, so
+    its first vote is 0 and the fallback is unanimous."""
+    bp = _bp(K=1)
+    _cycles(bp, [_batch("g.0")], 1)
+    bp.on_arm("some-other-fingerprint")
+    assert bp.active and bp.broken
+    assert bp.decide({}, foreign=False) == (0, "unarmed")
+
+
+def test_coordinator_arm_quorum_and_disarm():
+    c = Coordinator(world_size=2)
+    # one proc's vote is not a quorum
+    c.handle("bypass_ready", {"proc": 0, "sid": "a", "round": 0,
+                              "fp": "f1"})
+    assert c._bypass_armed_fp is None
+    # disagreeing fingerprints never arm
+    c.handle("bypass_ready", {"proc": 1, "sid": "b", "round": 0,
+                              "fp": "f2"})
+    assert c._bypass_armed_fp is None
+    # a ready WITH entries wipes the vote slate (cycle moved on)
+    c.handle("ready", {"proc": 0, "round": 0, "rid": 1, "sid": "a",
+                       "entries": [_meta("r.k", {"0": [0], "1": [1]})]})
+    assert c._bypass_votes == {}
+    # agreement arms: ONE bypass_arm record rides the response log,
+    # and the pre-arm pending race window is dropped (those entries
+    # execute through the bypass on every proc)
+    c.handle("bypass_ready", {"proc": 0, "sid": "a", "round": 0,
+                              "fp": "f1"})
+    c.handle("bypass_ready", {"proc": 1, "sid": "b", "round": 0,
+                              "fp": "f1"})
+    assert c._bypass_armed_fp == "f1"
+    assert "r.k" not in c._pending
+    assert [r for r in c._log if r.get("kind") == "bypass_arm"]
+    # any ready WITH entries disarms (the unanimous fallback landed)
+    c.handle("ready", {"proc": 0, "round": 0, "rid": 2, "sid": "a",
+                       "entries": [_meta("s.k", {"0": [0], "1": [1]})]})
+    assert c._bypass_armed_fp is None
+
+
+def test_poll_truncates_at_bypass_arm_record():
+    """The cursor fence: a batch scheduled AFTER the arm record must
+    not be consumed by fast pollers only — every proc stops its
+    cursor exactly at the arm and resumes from there on fallback."""
+    server = RendezvousServer(world_size=1)
+    port = server.start()
+    try:
+        from horovod_tpu.core.store_controller import StoreController
+        ctrl = StoreController("127.0.0.1", port, None, 0, 1, 1)
+        coord = server.coordinator
+        with coord._lock:
+            coord._log_append({"kind": "batch", "keys": [],
+                               "metas": {}, "aux": {}, "trace": {}})
+            coord._log_append({"kind": "bypass_arm", "fp": "f"})
+            coord._log_append({"kind": "batch", "keys": ["late.k"],
+                               "metas": {}, "aux": {}, "trace": {}})
+        resp = ctrl.poll(wait=0)
+        assert [r["kind"] for r in resp] == ["batch", "bypass_arm"]
+        assert ctrl._cursor == 2
+        # the post-arm record is re-delivered after the fallback
+        resp = ctrl.poll(wait=0)
+        assert [r.get("keys") for r in resp
+                if r["kind"] == "batch"] == [["late.k"]]
+    finally:
+        server.stop()
+
+
+@pytest.mark.integration
+def test_bypass_engage_fallback_rearm_real_job():
+    """Bypass correctness matrix on a REAL 2-process job: engages
+    after K stable cycles (hit counter > 0), a new tensor disengages
+    it cleanly (fallback counter > 0, results exact), it re-arms
+    afterwards, and a deliberately DESYNCED rank (same tensor name,
+    mismatched dtype) forces full renegotiation where the
+    coordinator's cross-process validation fails BOTH ranks loudly —
+    no silent divergence."""
+    from horovod_tpu.runner.proc_run import launch_procs
+
+    script = os.path.join(REPO, "tools", "_bypass_worker.py")
+    proc = subprocess.run(
+        [sys.executable, "-c", f"""
+import sys
+sys.path.insert(0, {REPO!r})
+from horovod_tpu.runner.proc_run import launch_procs
+codes = launch_procs(
+    [sys.executable, "-u", {script!r}], np=2, platform="cpu",
+    env={{"PYTHONPATH": {REPO!r},
+         "HOROVOD_BYPASS_AFTER_CYCLES": "3",
+         "HOROVOD_BYPASS_WAIT_SECONDS": "5"}},
+    start_timeout=240)
+assert codes == [0, 0], codes
+print("BYPASS JOB OK")
+"""],
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "PYTHONPATH": REPO})
+    assert proc.returncode == 0, (proc.stdout[-3000:],
+                                  proc.stderr[-3000:])
+    assert "BYPASS JOB OK" in proc.stdout
+
+
+@pytest.mark.integration
+@pytest.mark.slow
+def test_scenario_coordinator_kill_restart():
+    """Coordinator SIGKILL drill (ci.sh chaos coordkill): >= 20 steps
+    flow on the bypass during the outage, the service restarts from
+    its journal at epoch 2 with zero false deaths, and same-seed runs
+    produce byte-identical coordinator fault sequences.  Runs two
+    full jobs — slow-marked so the fast tier keeps its budget; the
+    chaos tier always runs it."""
+    _run_scenario("coordkill")
 
 
 # -- end-to-end scenarios (ci.sh chaos runs the same bodies) ------------------

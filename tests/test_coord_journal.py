@@ -1,0 +1,275 @@
+"""Coordinator crash survival (docs/fault_tolerance.md "Coordinator
+crash survival"): control-plane journal replay, epoch fencing, the
+resync handshake + drain-then-rereport recovery, liveness grace after
+a restart, and journal compaction."""
+
+import json
+import os
+
+import pytest
+
+from horovod_tpu.core.store_controller import StoreController
+from horovod_tpu.runner.http.http_client import StoreClient
+from horovod_tpu.runner.http.http_server import (
+    Coordinator, KVStore, RendezvousServer,
+)
+from horovod_tpu.runner.http.journal import CoordJournal
+
+
+def _meta(key, members, **over):
+    m = {"key": key, "type": "ALLREDUCE", "dtype": "float32",
+         "shape": [2], "op": 1, "pre": 1.0, "post": 1.0, "ps": 0,
+         "nbytes": 8, "nprocs": len(members), "nranks": len(members),
+         "root": -1, "members": members, "aux": {}}
+    m.update(over)
+    return m
+
+
+def _server(tmp_path, name="j.jsonl", replay=False, **kw):
+    kw.setdefault("world_size", 2)
+    return RendezvousServer(journal_path=str(tmp_path / name),
+                            journal_replay=replay, **kw)
+
+
+def test_journal_replay_restores_control_plane(tmp_path):
+    s1 = _server(tmp_path)
+    c = s1.coordinator
+    assert c.coord_epoch == 1
+    # one scheduled batch (both procs reported), one partial pending,
+    # a join, a heartbeat registration, a KV write
+    done = _meta("done.k", {"0": [0], "1": [1]})
+    c.handle("ready", {"proc": 0, "round": 0, "rid": 1, "sid": "sA",
+                       "entries": [done]})
+    c.handle("ready", {"proc": 1, "round": 0, "rid": 1, "sid": "sB",
+                       "entries": [done]})
+    c.handle("ready", {"proc": 0, "round": 0, "rid": 2, "sid": "sA",
+                       "entries": [_meta("half.k",
+                                         {"0": [0], "1": [1]})]})
+    # join on a DIFFERENT process set, so exhausting proc 0 there
+    # does not complete half.k on ps0
+    c.handle("join", {"ps": 1, "proc": 0, "rank": 0, "ps_size": 2,
+                      "proc_members": 1, "jid": 4, "sid": "sA"})
+    c.handle("heartbeat", {"proc": 1, "ranks": [1], "host": "hostB"})
+    s1.store.put("/elastic/round", b'{"round": 0}')
+    s1.stop()
+
+    s2 = _server(tmp_path, replay=True)
+    c2 = s2.coordinator
+    assert c2.coord_epoch == 2
+    # the scheduled-but-unconsumed batch is replayed at its absolute
+    # log position; the in-flight pending table is NOT (workers
+    # re-report after resync)
+    assert [r["kind"] for r in c2._log] == ["batch"]
+    assert c2._log[0]["keys"] == ["done.k"]
+    assert "half.k" not in c2._pending
+    # joins, sessions, attribution and KV survive
+    assert c2._proc_joined[1][0] == 1 and 4 in c2._join_seen[(1, 0)]
+    assert c2._proc_sid == {0: "sA", 1: "sB"}
+    assert c2._proc_ranks == {1: [1]} and c2._proc_hosts == {1: "hostB"}
+    assert s2.store.get("/elastic/round") == b'{"round": 0}'
+    # liveness re-arms only on a POST-restart beat
+    assert not c2._beats
+    assert c2._journal_replayed.get("log") == 1
+    s2.stop()
+
+
+def test_fresh_job_truncates_stale_journal(tmp_path):
+    s1 = _server(tmp_path)
+    s1.coordinator.handle("join", {"ps": 0, "proc": 0, "rank": 0,
+                                   "ps_size": 2, "proc_members": 1,
+                                   "jid": 1, "sid": "s"})
+    s1.stop()
+    # a NEW job on the same path must not inherit the old job's state
+    s2 = _server(tmp_path)
+    assert s2.coordinator.coord_epoch == 1
+    assert not s2.coordinator._proc_joined
+    s2.stop()
+
+
+def test_epoch_fence_and_resync_over_http(tmp_path):
+    server = _server(tmp_path, world_size=1)
+    port = server.start()
+    try:
+        client = StoreClient("127.0.0.1", port)
+        out = client.coord("poll", {"cursor": 0, "wait": 0, "proc": 0,
+                                    "round": 0})
+        assert out["epoch"] == 1
+        server.restart_from_journal()
+        # a stale-generation request is fenced BEFORE the verb runs
+        out = client.coord("ready", {"proc": 0, "round": 0, "rid": 9,
+                                     "sid": "s", "epoch": 1,
+                                     "entries": [_meta("x.k",
+                                                       {"0": [0]})]})
+        assert out == {"epoch_mismatch": True, "epoch": 2}
+        assert "x.k" not in server.coordinator._pending
+        out = client.coord("resync", {"proc": 0, "sid": "s",
+                                      "round": 0})
+        assert out["epoch"] == 2
+    finally:
+        server.stop()
+
+
+def test_controller_resync_drains_replayed_log_then_rereports(tmp_path):
+    """The A-executed/B-didn't crash race: a batch scheduled (and
+    journaled) before the crash but not yet consumed by proc B must
+    reach B through the REPLAYED log after the restart — and only
+    what is still unscheduled gets re-reported."""
+    server = _server(tmp_path, world_size=1)
+    port = server.start()
+    try:
+        ctrl = StoreController("127.0.0.1", port, None, 0, 1, 1)
+        assert ctrl.poll(wait=0) == []      # learn epoch 1
+        assert ctrl.epoch == 1
+        ctrl.report_ready([_meta("a.k", {"0": [0]})])
+        # scheduled server-side; crash BEFORE this proc polls it
+        server.restart_from_journal()
+        assert server.coordinator.coord_epoch == 2
+        # the next verb is fenced -> resync; the swallowed ready is
+        # recovered by drain-then-rereport
+        ctrl.report_ready([_meta("b.k", {"0": [0]})])
+        assert ctrl.epoch == 2
+        # drain: the REPLAYED batch for a.k arrives at the old cursor
+        resp = ctrl.poll(wait=1.0)
+        assert [r["keys"] for r in resp
+                if r.get("kind") == "batch"] == [["a.k"]]
+        assert ctrl.take_rereport() is True
+        assert ctrl.take_rereport() is False      # once per resync
+        # the engine would now re-report b.k (still awaiting)
+        ctrl.report_ready([_meta("b.k", {"0": [0]})])
+        resp = ctrl.poll(wait=1.0)
+        assert [r["keys"] for r in resp
+                if r.get("kind") == "batch"] == [["b.k"]]
+    finally:
+        server.stop()
+
+
+def test_journaled_bye_is_not_a_death_after_restart(tmp_path):
+    """Satellite contract: a worker whose goodbye (or death window)
+    raced the outage must NOT be declared dead by the restarted
+    coordinator — byes are journaled, and post-restart liveness only
+    counts beats after the grace window."""
+    s1 = _server(tmp_path, heartbeat_secs=0.2)
+    c = s1.coordinator
+    c.handle("heartbeat", {"proc": 0, "ranks": [0], "host": "h0"})
+    c.handle("heartbeat", {"proc": 1, "ranks": [1], "host": "h1"})
+    c.handle("heartbeat", {"proc": 0, "bye": True})   # clean exit
+    s1.stop()
+
+    s2 = _server(tmp_path, replay=True, heartbeat_secs=0.2)
+    c2 = s2.coordinator
+    # proc 0 said goodbye: even its attribution is gone
+    assert 0 not in c2._proc_ranks
+    # proc 1 beat before the crash but has not re-beaten yet: the
+    # first-beat contract + grace window keep it alive
+    import time
+    time.sleep(0.7)     # well past the 0.3s window
+    c2.handle("poll", {"cursor": 0, "wait": 0, "proc": 1, "round": 0,
+                       "epoch": 2})
+    assert c2.dead_procs() == {}
+    # a post-restart beat re-arms liveness normally
+    c2.handle("heartbeat", {"proc": 1, "ranks": [1]})
+    assert 1 in c2._beats
+    s2.stop()
+
+
+def test_liveness_grace_window_after_restart(tmp_path):
+    """A proc that re-beats IMMEDIATELY after the restart, then goes
+    silent, is still protected by the grace window — beats missed
+    during the outage never combine with a short window into an
+    instant death."""
+    s1 = _server(tmp_path, heartbeat_secs=0.2, heartbeat_window=1.0)
+    s1.coordinator.handle("heartbeat", {"proc": 0, "ranks": [0]})
+    s1.stop()
+    s2 = _server(tmp_path, replay=True, heartbeat_secs=0.2,
+                 heartbeat_window=1.0)
+    c2 = s2.coordinator
+    import time
+    assert c2._grace_until > time.monotonic()
+    c2.handle("heartbeat", {"proc": 0, "ranks": [0]})
+    with c2._lock:
+        c2._beats[0] -= 0.5     # silent past the scan's naive window
+        c2._scan_heartbeats()
+    assert c2.dead_procs() == {}
+    s2.stop()
+
+
+def test_journal_compaction_preserves_state(tmp_path):
+    path = tmp_path / "j.jsonl"
+    journal = CoordJournal(str(path), max_bytes=600)
+    store = KVStore()
+    c = Coordinator(world_size=1, journal=journal)
+    c.attach_store(store)
+    store.journal = journal
+    store.put("/scope/key", b"value")
+    c.handle("join", {"ps": 0, "proc": 0, "rank": 0, "ps_size": 9,
+                      "proc_members": 5, "jid": 3, "sid": "s"})
+    for i in range(20):
+        c.handle("ready", {"proc": 0, "round": 0, "rid": i + 1,
+                           "sid": "s",
+                           "entries": [_meta(f"k{i}", {"0": [0]})]})
+        # polls clock the compactor (cursor 0: nothing is GC'd, so
+        # the snapshot must carry the whole live log)
+        c.handle("poll", {"cursor": 0, "wait": 0, "proc": 0,
+                          "round": 0})
+    c.close()
+    lines = [json.loads(line)
+             for line in path.read_text().splitlines() if line]
+    assert any(rec.get("k") == "snap" for rec in lines)
+    assert os.path.getsize(path) < 16 * 600   # bounded, not unbounded
+
+    j2 = CoordJournal(str(path))
+    store2 = KVStore()
+    c2 = Coordinator(world_size=1, journal=j2)
+    c2.attach_store(store2)
+    c2.restore_journal(j2.read())
+    assert c2.coord_epoch == 2
+    assert c2._proc_joined[0][0] == 1 and 3 in c2._join_seen[(0, 0)]
+    assert store2.get("/scope/key") == b"value"
+    # the log survives compaction with its absolute indexing intact
+    assert c2._log_base + len(c2._log) == 20
+    c2.close()
+
+
+def test_journal_tolerates_torn_tail(tmp_path):
+    path = tmp_path / "j.jsonl"
+    j = CoordJournal(str(path))
+    j.append({"k": "epoch", "epoch": 1})
+    j.append({"k": "hb", "proc": 0, "ranks": [0], "host": "h"})
+    j.close()
+    with open(path, "a") as f:
+        f.write('{"k": "hb", "proc": 1, "ra')    # crash mid-append
+    records = CoordJournal(str(path)).read()
+    assert [r["k"] for r in records] == ["epoch", "hb"]
+
+
+def test_outage_deadline_env_is_read():
+    import os as _os
+    _os.environ["HOROVOD_COORD_OUTAGE_DEADLINE_SECONDS"] = "7.5"
+    try:
+        assert StoreClient("127.0.0.1", 1).outage_deadline == 7.5
+    finally:
+        del _os.environ["HOROVOD_COORD_OUTAGE_DEADLINE_SECONDS"]
+
+
+def test_connection_failures_retry_up_to_outage_deadline():
+    """A dead coordinator (connection refused) keeps replay-safe
+    requests retrying under the OUTAGE deadline, not the tight
+    per-request budget — but an explicit budget (teardown paths) caps
+    everything."""
+    import time
+
+    client = StoreClient("127.0.0.1", 1)    # nothing listens here
+    client.retry_attempts = 3
+    client.retry_deadline = 0.2
+    client.outage_deadline = 1.2
+    client._retry_base = 0.02
+    client._retry_cap = 0.05
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        client.coord("heartbeat", {"proc": 0})
+    spanned = time.monotonic() - t0
+    assert spanned >= 1.0, spanned          # outlived the 0.2s budget
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        client.coord("heartbeat", {"proc": 0}, budget=(2, 0.3))
+    assert time.monotonic() - t0 < 1.0      # the bye/teardown cap

@@ -1,0 +1,65 @@
+"""Worker body for the bypass correctness-matrix integration test
+(tests/test_chaos.py::test_bypass_engage_fallback_rearm_real_job).
+
+Phases: (1) identical steps arm the bypass (hit counter > 0);
+(2) a new tensor name disengages it cleanly; (3) the steady phase
+re-arms; (4) a deliberately desynced rank (same tensor name,
+mismatched dtype) forces full renegotiation and the coordinator's
+cross-process validation fails BOTH ranks loudly — no silent
+divergence; (5) the job keeps working afterwards."""
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import telemetry
+from horovod_tpu.common.exceptions import TensorShapeMismatchError
+
+FAMILY = "horovod_negotiation_bypass_cycles_total"
+
+
+def main():
+    hvd.init()
+    r = hvd.rank()
+    x = np.ones(256, np.float32)
+
+    # 1: engage after K=3 stable cycles
+    for i in range(12):
+        out = hvd.allreduce(x, op=hvd.Sum, name="bt.step")
+        assert np.allclose(out, 2.0), out
+    hits = telemetry.counter_total(FAMILY, outcome="hit")
+    assert hits > 0, "bypass never engaged"
+
+    # 2: a new tensor disengages cleanly (correct result, fallback
+    # counted)
+    out = hvd.allreduce(x, op=hvd.Sum, name="bt.new")
+    assert np.allclose(out, 2.0), out
+    assert telemetry.counter_total(FAMILY, outcome="fallback") >= 1
+
+    # 3: the steady phase re-arms
+    for i in range(8):
+        out = hvd.allreduce(x, op=hvd.Sum, name="bt.step")
+        assert np.allclose(out, 2.0), out
+    hits2 = telemetry.counter_total(FAMILY, outcome="hit")
+    assert hits2 > hits, (hits, hits2)
+
+    # 4: desynced rank — rank 1 ships float64 where rank 0 ships
+    # float32 under the SAME name: the bypass must refuse to run it
+    # (vote 0) and the renegotiation must fail both ranks loudly
+    bad = np.ones(256, np.float64 if r == 1 else np.float32)
+    try:
+        hvd.allreduce(bad, op=hvd.Sum, name="bt.mix")
+    except TensorShapeMismatchError:
+        pass
+    else:
+        raise SystemExit(f"rank {r}: desynced rank was NOT detected")
+
+    # 5: the job still works after the divergence was rejected
+    out = hvd.allreduce(x, op=hvd.Sum, name="bt.after")
+    assert np.allclose(out, 2.0), out
+    hvd.barrier()
+    hvd.shutdown()
+    print(f"rank {r} OK (hits={hits2:.0f})", flush=True)
+
+
+if __name__ == "__main__":
+    main()

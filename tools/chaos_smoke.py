@@ -18,6 +18,13 @@ plans, asserting the robustness claims docs/fault_tolerance.md makes:
   coordinator's heartbeat liveness declares it dead, fails its peers'
   collectives naming its global ranks, and the driver reaps +
   blacklists it — no stall-timeout limbo.
+* ``coordkill`` — kill the RENDEZVOUS SERVICE ITSELF mid-training
+  (seeded ``coord_restart`` plan): training steps keep flowing on the
+  steady-state negotiation bypass while the coordinator is down, the
+  service restarts purely from its journal on the same port (epoch
+  bumped, zero workers falsely declared dead), post-restart
+  renegotiation works (the final barrier), and two same-seed runs
+  produce byte-identical coordinator fault sequences.
 
 Every scenario runs under a hard watchdog (launcher start_timeout /
 subprocess timeout), so a hung scenario fails the smoke instead of
@@ -69,6 +76,58 @@ def worker_fivexx():
     hvd.barrier()
     hvd.shutdown()
     print(f"worker {r} OK")
+
+
+def worker_coordkill():
+    import urllib.request
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu import telemetry
+
+    hvd.init()
+    r = hvd.rank()
+    out_dir = os.environ["CS_OUT"]
+    run_s = float(os.environ.get("CK_RUN_SECONDS", "18"))
+    # ONE tensor per step, flag folded into element 0 (two separate
+    # tensors would alternate the cycle fingerprint and defeat the
+    # bypass): both ranks vote continue=1.0; the summed flag drops
+    # below 2 as soon as EITHER rank's deadline passed, so both stop
+    # at the same step — the SPMD way to time-bound a loop.
+    deadline = time.time() + run_s
+    x = np.ones(256, np.float32)
+    steps = []
+    for i in range(20000):
+        x[0] = 1.0 if time.time() < deadline else 0.0
+        out = hvd.allreduce(x, op=hvd.Sum, name="ck.step")
+        assert np.allclose(out[1:], 2.0), out[:4]
+        steps.append(time.time())
+        if out[0] < 2.0:
+            break
+    hits = telemetry.counter_total(
+        "horovod_negotiation_bypass_cycles_total", outcome="hit")
+    with open(os.path.join(out_dir, f"steps_{r}.json"), "w") as f:
+        json.dump(steps, f)
+    # post-restart renegotiation must still work: BARRIER is not
+    # bypass-cacheable, so this forces the unanimous fallback and a
+    # full negotiation against the journal-restored coordinator
+    hvd.barrier()
+    if r == 0:
+        # push this worker's snapshot, then scrape the job-wide
+        # /metrics off the RESTARTED service: the epoch gauge and the
+        # bypass counters are the acceptance evidence
+        from horovod_tpu.common import basics
+        basics._engine.push_metrics()
+        addr = os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"]
+        port = os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"]
+        text = urllib.request.urlopen(
+            f"http://{addr}:{port}/metrics", timeout=15).read().decode()
+        with open(os.path.join(out_dir, "metrics.txt"), "w") as f:
+            f.write(text)
+    assert hits > 0, "bypass never engaged"
+    hvd.barrier()
+    hvd.shutdown()
+    print(f"worker {r} OK ({len(steps)} steps, "
+          f"{hits:.0f} bypass hits)", flush=True)
 
 
 def worker_slow():
@@ -225,6 +284,91 @@ def _run_elastic(name, plan, extra_env=None, timeout=360):
     return proc, content
 
 
+def scenario_coordkill():
+    """Coordinator SIGKILL drill: a seeded coord_restart plan tears
+    the rendezvous service down for 3s mid-training.  Steps must keep
+    flowing on the negotiation bypass during the outage (>= 20), the
+    service must restart from its journal at epoch 2 with zero
+    workers falsely declared dead, bypass hits must be visible on the
+    job-wide /metrics, and two same-seed runs must produce
+    byte-identical coordinator fault sequences."""
+    from horovod_tpu.runner.proc_run import launch_procs
+
+    plan = json.dumps({"seed": SEED, "events": [
+        {"kind": "coord_restart", "after_s": 8.0, "ms": 3000},
+    ]})
+    coord_logs = []
+    for run in (1, 2):
+        out = _out_dir(f"coordkill{run}")
+        journal = os.path.join(out, "coord_journal.jsonl")
+        coord_log = os.path.join(out, "coord_fired.jsonl")
+        codes = launch_procs(
+            [sys.executable, "-u", os.path.abspath(__file__)], np=2,
+            platform="cpu",
+            env={"PYTHONPATH": REPO, "CS_SCENARIO": "coordkill",
+                 "CS_OUT": out, "CK_RUN_SECONDS": "18",
+                 "HOROVOD_FAULT_PLAN": plan,
+                 "HOROVOD_FAULT_COORD_LOG": coord_log,
+                 "HOROVOD_COORD_JOURNAL": journal,
+                 "HOROVOD_BYPASS_AFTER_CYCLES": "3",
+                 "HOROVOD_HEARTBEAT_INTERVAL_SECONDS": "1",
+                 "HOROVOD_METRICS_PUSH_SECONDS": "1",
+                 "HOROVOD_COORD_OUTAGE_DEADLINE_SECONDS": "90"},
+            start_timeout=300)
+        assert codes == [0, 0], f"run {run}: worker exit codes {codes}"
+        with open(coord_log) as f:
+            fired = [json.loads(line) for line in f if line.strip()]
+        assert len(fired) == 1 and fired[0]["kind"] == "coord_restart", \
+            fired
+        # deterministic projection (same-seed evidence): everything
+        # but the wall-clock outage bounds
+        coord_logs.append(json.dumps(
+            [{k: v for k, v in rec.items()
+              if not k.startswith("t_")} for rec in fired],
+            sort_keys=True))
+        if run != 1:
+            continue
+        # >= 20 training steps DURING the outage window, on bypass
+        t_stop, t_start = fired[0]["t_stop"], fired[0]["t_start"]
+        with open(os.path.join(out, "steps_0.json")) as f:
+            steps = json.load(f)
+        during = [t for t in steps if t_stop <= t <= t_start]
+        assert len(during) >= 20, (
+            f"only {len(during)} steps during the {t_start - t_stop:.1f}s "
+            f"outage (total {len(steps)})")
+        # journal-restored service: epoch bumped to 2, bypass hits on
+        # the job-wide /metrics, no worker falsely declared dead
+        with open(os.path.join(out, "metrics.txt")) as f:
+            metrics = f.read()
+        epoch_vals = [float(line.rsplit(" ", 1)[1])
+                      for line in metrics.splitlines()
+                      if line.startswith("horovod_coord_epoch")]
+        assert epoch_vals and max(epoch_vals) == 2.0, epoch_vals
+        hit_vals = [float(line.rsplit(" ", 1)[1])
+                    for line in metrics.splitlines()
+                    if line.startswith(
+                        "horovod_negotiation_bypass_cycles_total")
+                    and 'outcome="hit"' in line]
+        assert hit_vals and max(hit_vals) > 0, hit_vals
+        alive_vals = [float(line.rsplit(" ", 1)[1])
+                      for line in metrics.splitlines()
+                      if line.startswith("horovod_worker_alive")]
+        assert alive_vals and min(alive_vals) == 1.0, (
+            "a worker was falsely declared dead across the restart: "
+            + repr(alive_vals))
+        # the journal itself records the generation history
+        with open(journal) as f:
+            recs = [json.loads(line) for line in f if line.strip()]
+        assert any(r.get("k") == "epoch" and r.get("epoch") == 2
+                   for r in recs), "no epoch-2 record in the journal"
+        n_steps = len(during)
+    assert coord_logs[0] == coord_logs[1], (
+        "same-seed runs produced DIFFERENT coordinator fault "
+        f"sequences:\nrun1={coord_logs[0]}\nrun2={coord_logs[1]}")
+    print(f"COORDKILL OK ({n_steps} steps during the outage, "
+          f"epoch 2, deterministic: {coord_logs[0]})")
+
+
 def scenario_kill():
     """SIGKILL one elastic worker mid-training: the job must recover
     through elastic restart and finish from the last commit."""
@@ -269,13 +413,15 @@ def scenario_hang():
 
 
 SCENARIOS = {"fivexx": scenario_fivexx, "slow": scenario_slow,
+             "coordkill": scenario_coordkill,
              "kill": scenario_kill, "hang": scenario_hang}
 
 
 def main():
     which = os.environ.get("CS_SCENARIO")
     if which:
-        {"fivexx": worker_fivexx, "slow": worker_slow}[which]()
+        {"fivexx": worker_fivexx, "slow": worker_slow,
+         "coordkill": worker_coordkill}[which]()
         return
     names = sys.argv[1:] or list(SCENARIOS)
     t0 = time.monotonic()

@@ -5,6 +5,12 @@
 # test under the launcher; .github/workflows/ci.yaml).
 #
 # Usage:
+#   ./ci.sh analyze       # hvdlint: the five invariant checkers
+#                         #   (determinism, lock order, replay-safety,
+#                         #   telemetry hygiene, knob registry) over
+#                         #   horovod_tpu/ + tools/ — fails on any
+#                         #   finding NOT in tools/hvdlint/baseline
+#                         #   .json; --update-baseline rewrites it
 #   ./ci.sh fast          # tier 1: unit tests (no process spawns)
 #   ./ci.sh matrix        # tier 2: engine op matrix + collectives
 #   ./ci.sh integration   # tier 3: multi-process launches + elastic
@@ -41,7 +47,8 @@ cd "$(dirname "$0")"
 # test_torch.py / test_tensorflow.py.
 PART1="tests/test_autotune.py tests/test_aux.py tests/test_basics.py \
   tests/test_collectives.py tests/test_compiled.py \
-  tests/test_conv_bn_fusion.py tests/test_integrations.py \
+  tests/test_conv_bn_fusion.py tests/test_hvdlint.py \
+  tests/test_integrations.py \
   tests/test_jax_frontend.py tests/test_lightning.py \
   tests/test_models.py tests/test_mxnet_fake.py tests/test_native.py \
   tests/test_telemetry.py tests/test_tracing.py"
@@ -54,6 +61,15 @@ PART4="tests/test_api_parity.py tests/test_chaos.py \
   tests/test_pallas.py tests/test_runner.py tests/test_serving.py"
 
 case "${1:-all}" in
+  analyze)
+    # static analysis gate (docs/invariants.md): zero NEW findings vs
+    # the checked-in baseline.  `./ci.sh analyze --update-baseline`
+    # is the escape hatch after triaging intentional changes; the
+    # shipped baseline is EMPTY and determinism/lock-order/replay
+    # findings must be fixed, never baselined (ISSUE 8 acceptance).
+    shift
+    python -m tools.hvdlint "$@"
+    ;;
   fast)
     # unit tier: everything that neither spawns worker processes nor
     # compiles multi-minute programs
@@ -184,13 +200,16 @@ case "${1:-all}" in
     # ret != first_join_rank, impossible at world size 1.
     ;;
   all)
+    # the analysis gate runs FIRST: invariant violations fail the
+    # pipeline before any test time is spent
+    python -m tools.hvdlint
     python -m pytest $PART1 -q
     python -m pytest $PART2 -q
     python -m pytest $PART3 -q
     python -m pytest $PART4 -q
     ;;
   *)
-    echo "usage: $0 {fast|matrix|integration|chaos|trace|metrics|serve|bench|all}" >&2
+    echo "usage: $0 {analyze|fast|matrix|integration|chaos|trace|metrics|serve|bench|all}" >&2
     exit 2
     ;;
 esac

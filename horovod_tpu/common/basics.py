@@ -151,8 +151,8 @@ def _record_resize_event(new_size):
     else:
         direction = "up" if new_size > prev else "down"
     telemetry.registry().counter(
-        "horovod_elastic_resize_events_total",
-        "Elastic membership changes seen by this worker",
+        telemetry.ELASTIC_RESIZE_FAMILY,
+        telemetry.ELASTIC_RESIZE_HELP,
         labelnames=("direction",)).labels(direction=direction).inc()
 
 

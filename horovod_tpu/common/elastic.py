@@ -15,6 +15,7 @@ import queue
 import tempfile
 
 from . import basics
+from . import env
 from .exceptions import HorovodInternalError, HostsUpdatedInterrupt
 
 
@@ -25,11 +26,11 @@ def _spill_path():
     needed on TPU because a peer's death fatally terminates the jax
     distributed client in survivors (coordination-service heartbeat),
     where the reference's NCCL failures are catchable in-process."""
-    d = os.environ.get("HOROVOD_STATE_SPILL")
+    d = env.get_str(env.HOROVOD_STATE_SPILL)
     if not d:
         return None
-    host = os.environ.get("HOROVOD_HOSTNAME", "localhost")
-    slot = os.environ.get("HOROVOD_LOCAL_RANK", "0")
+    host = env.get_str(env.HOROVOD_HOSTNAME, "localhost")
+    slot = env.get_int(env.HOROVOD_LOCAL_RANK, 0)
     return os.path.join(d, f"state_{host}_{slot}.pkl")
 
 

@@ -93,6 +93,20 @@ HOROVOD_COORD_OUTAGE_DEADLINE_SECONDS = \
 HOROVOD_BYPASS_AFTER_CYCLES = "HOROVOD_BYPASS_AFTER_CYCLES"
 HOROVOD_BYPASS_WAIT_SECONDS = "HOROVOD_BYPASS_WAIT_SECONDS"
 
+# shared-secret for the launcher's HMAC-authenticated KV channel
+# (reference runner/common/util/secret.py; hex in the env)
+HOROVOD_SECRET_KEY = "HOROVOD_SECRET_KEY"
+# elastic: crash-durable state spill directory (common/elastic.py)
+# and the init-barrier wait for the first rendezvous (reference
+# --elastic-timeout semantics, also a worker-side knob here)
+HOROVOD_STATE_SPILL = "HOROVOD_STATE_SPILL"
+HOROVOD_ELASTIC_TIMEOUT = "HOROVOD_ELASTIC_TIMEOUT"
+# coordinator journal bounds (runner/http/journal.py): whole-file
+# compaction threshold and the per-value KV journaling cap
+HOROVOD_COORD_JOURNAL_MAX_BYTES = "HOROVOD_COORD_JOURNAL_MAX_BYTES"
+HOROVOD_COORD_JOURNAL_KV_MAX_BYTES = \
+    "HOROVOD_COORD_JOURNAL_KV_MAX_BYTES"
+
 # TPU-native additions
 HOROVOD_WIRE_DTYPE = "HOROVOD_WIRE_DTYPE"      # f32 | fp16 | bf16 | int8
 # flat | hierarchical | torus (generic spelling; the reference's
@@ -109,6 +123,34 @@ HOROVOD_TPU_RANKS_PER_PROC = "HOROVOD_TPU_RANKS_PER_PROC"
 HOROVOD_TPU_COORDINATOR = "HOROVOD_TPU_COORDINATOR"
 HOROVOD_TPU_NUM_PROCS = "HOROVOD_TPU_NUM_PROCS"
 HOROVOD_TPU_PROC_INDEX = "HOROVOD_TPU_PROC_INDEX"
+# alltoall SPMD schedule (ops/xla_ops.py: auto | oneshot | diag) and
+# the conv+bn fused-backward kernel selector (ops/pallas_conv_bn.py:
+# pallas | xla)
+HOROVOD_TPU_ALLTOALL_SCHEDULE = "HOROVOD_TPU_ALLTOALL_SCHEDULE"
+HOROVOD_CONV_BN_BWD = "HOROVOD_CONV_BN_BWD"
+# fusion pack goes multithreaded above this bucket size (csrc
+# hvd_pack_mt); a third autotune dimension
+HOROVOD_TPU_PACK_MT_THRESHOLD = "HOROVOD_TPU_PACK_MT_THRESHOLD"
+
+#: Launcher↔worker handoff ABI: env vars the launcher exports for its
+#: own workers and users never set by hand.  hvdlint checker 5
+#: (`knob-undocumented`) exempts these from the docs/migration.md
+#: knob-table requirement; everything else read anywhere in the tree
+#: must be documented.  Keep this list honest — moving a knob here to
+#: silence the checker defeats the registry.
+INTERNAL_KNOBS = (
+    # rank/topology handoff (reference gloo_run.py:66-103)
+    "HOROVOD_RANK", "HOROVOD_SIZE", "HOROVOD_LOCAL_RANK",
+    "HOROVOD_LOCAL_SIZE", "HOROVOD_CROSS_RANK", "HOROVOD_CROSS_SIZE",
+    "HOROVOD_HOSTNAME", "HOROVOD_CONTROLLER", "HOROVOD_CPU_OPERATIONS",
+    # multi-process mesh handoff (proc_run -> workers)
+    "HOROVOD_TPU_PROC_INDEX", "HOROVOD_TPU_NUM_PROCS",
+    "HOROVOD_TPU_COORDINATOR", "HOROVOD_TPU_RANKS_PER_PROC",
+    "HOROVOD_TPU_RANKS_OF_PROC", "HOROVOD_TPU_HOST_OF_RANK",
+    "HOROVOD_TPU_INIT_TIMEOUT",
+    # spark driver -> task handoff (spark/task/)
+    "HOROVOD_SPARK_PYTHONPATH", "HOROVOD_SPARK_WORK_DIR",
+)
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024
 DEFAULT_CYCLE_TIME_MS = 1.0
@@ -123,6 +165,14 @@ def get_bool(name, default=False):
     return val.strip().lower() in ("1", "true", "yes", "on")
 
 
+def _warn_malformed(name, val, default):
+    # loud, not fatal: an operator's typo (e.g. FOO=64M) must not be
+    # silently replaced by the default with nothing in the logs
+    logging.getLogger("horovod_tpu").warning(
+        "%s=%r is not a valid number; using default %r",
+        name, val, default)
+
+
 def get_int(name, default=0):
     val = os.environ.get(name)
     if val is None or not val.strip():
@@ -130,6 +180,7 @@ def get_int(name, default=0):
     try:
         return int(val)
     except ValueError:
+        _warn_malformed(name, val, default)
         return default
 
 
@@ -140,11 +191,28 @@ def get_float(name, default=0.0):
     try:
         return float(val)
     except ValueError:
+        _warn_malformed(name, val, default)
         return default
 
 
 def get_str(name, default=None):
     return os.environ.get(name, default)
+
+
+def require_str(name):
+    """A handoff variable that MUST be present: missing-or-empty
+    raises naming the variable, instead of leaking None into an
+    address/port where it fails as an opaque downstream error."""
+    val = os.environ.get(name)
+    if val is None or not val.strip():
+        raise KeyError(
+            f"{name} missing from the environment — the launcher "
+            f"handoff did not reach this process")
+    return val
+
+
+def require_int(name):
+    return int(require_str(name))
 
 
 # -- worker-side logging (reference common/logging.cc + env_parser.cc
@@ -210,7 +278,7 @@ class Config:
         # fusion pack goes multithreaded above this bucket size
         # (csrc hvd_pack_mt); a third autotune dimension
         self.pack_mt_threshold_bytes = get_int(
-            "HOROVOD_TPU_PACK_MT_THRESHOLD", 8 << 20)
+            HOROVOD_TPU_PACK_MT_THRESHOLD, 8 << 20)
         self.cache_capacity = get_int(HOROVOD_CACHE_CAPACITY, DEFAULT_CACHE_CAPACITY)
         # default wire format for float allreduce/reducescatter payloads
         # (per-request wire_dtype overrides; autotune sweeps this as its

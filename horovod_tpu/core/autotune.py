@@ -154,10 +154,10 @@ class ParameterManager:
         from .. import telemetry
 
         reg = telemetry.registry()
-        reg.counter("horovod_autotune_samples_total",
-                    "Autotune sample windows scored").inc()
-        reg.gauge("horovod_autotune_best_score_bytes_per_sec",
-                  "Best autotune score observed (logical bytes/sec)"
+        reg.counter(telemetry.AUTOTUNE_SAMPLES_FAMILY,
+                    telemetry.AUTOTUNE_SAMPLES_HELP).inc()
+        reg.gauge(telemetry.AUTOTUNE_BEST_SCORE_FAMILY,
+                  telemetry.AUTOTUNE_BEST_SCORE_HELP
                   ).set(max(self._best_score, score)
                         if self._best_score != -np.inf else score)
         decoded = self._decode(self._best)
@@ -170,15 +170,14 @@ class ParameterManager:
         if self.tune_algorithm:
             algo = decoded[i]
         best = reg.gauge(
-            "horovod_autotune_best_config",
-            "Current best autotune configuration (value 1; the "
-            "labels are the config)",
-            labelnames=("fusion_threshold_bytes", "cycle_time_ms",
-                        "wire", "algorithm"))
+            telemetry.AUTOTUNE_BEST_CONFIG_FAMILY,
+            telemetry.AUTOTUNE_BEST_CONFIG_HELP,
+            labelnames=telemetry.AUTOTUNE_BEST_CONFIG_LABELS)
         # the gauge is an info-style marker: exactly ONE labeled child
         # (the current best) — a new best replaces, never accumulates
         best.clear()
         best.labels(fusion_threshold_bytes=fusion,
+                    # hvdlint: ignore[telemetry-unbounded-label] info-gauge: best.clear() above caps it at ONE live child; the label IS the payload
                     cycle_time_ms=f"{cycle:.3f}", wire=wire,
                     algorithm=algo).set(1)
 

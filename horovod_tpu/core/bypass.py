@@ -47,11 +47,13 @@ import json
 import time
 
 #: Ops whose metas are identical across steps — only all-cacheable,
-#: global-process-set cycles are bypass-eligible (mirrors the
-#: coordinator's response-cache eligibility).
-CACHEABLE_TYPES = ("ALLREDUCE", "ADASUM")
+#: global-process-set cycles are bypass-eligible.  ONE definition
+#: shared with the coordinator's response-cache eligibility and the
+#: worker controller's hit path (runner/http/contract.py).
+from ..runner.http.contract import CACHEABLE_TYPES  # noqa: F401
 
 
+# hvdlint: seam[determinism]
 def sanitize_response(resp):
     """Strip the per-step volatile fields (trace ids, cache ids) from
     a batch response, keeping exactly what re-execution needs."""
@@ -60,12 +62,14 @@ def sanitize_response(resp):
             "aux": resp.get("aux", {})}
 
 
+# hvdlint: seam[determinism]
 def cycle_fingerprint(responses):
     """Canonical identity of one negotiation cycle's response list."""
     return hashlib.sha1(
         json.dumps(responses, sort_keys=True).encode()).hexdigest()
 
 
+# hvdlint: seam[determinism]
 def meta_fingerprint(meta):
     """Canonical identity of one negotiation meta (aux/error excluded
     — the per-entry ``_fingerprint`` contract of
@@ -198,6 +202,7 @@ class BypassState:
 
     # -- armed-cycle decisions -----------------------------------------------
 
+    # hvdlint: seam[determinism]
     def decide(self, awaiting_fps, foreign, now=None):
         """One armed-cycle decision from the engine loop.
 

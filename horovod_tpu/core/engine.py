@@ -150,7 +150,7 @@ class Engine:
         self.handle_manager = HandleManager()
         self.timeline = timeline
 
-        self._lock = threading.Condition()
+        self._lock = threading.Condition()  # hvdlint: lock[engine:20]
         self._shutdown = False
         self._aborted: Optional[BaseException] = None
         self._shutdown_done = threading.Event()
@@ -322,24 +322,24 @@ class Engine:
             telemetry.BYPASS_CYCLE_SECONDS_HELP)
         m.counter(telemetry.COORD_RESYNCS_FAMILY,
                   telemetry.COORD_RESYNCS_HELP)
-        # families owned by other layers, pre-declared for the catalogue
-        m.counter("horovod_program_cache_hits_total",
-                  "Compiled-path program cache hits")
-        m.counter("horovod_program_cache_misses_total",
-                  "Compiled-path program cache misses (new builds)")
-        m.counter("horovod_compile_seconds_total",
-                  "Seconds spent building + first-compiling programs")
-        m.counter("horovod_autotune_samples_total",
-                  "Autotune sample windows scored")
-        m.gauge("horovod_autotune_best_score_bytes_per_sec",
-                "Best autotune score observed (logical bytes/sec)")
-        m.gauge("horovod_autotune_best_config",
-                "Current best autotune configuration (value 1; the "
-                "labels are the config)",
-                labelnames=("fusion_threshold_bytes", "cycle_time_ms",
-                            "wire", "algorithm"))
-        m.counter("horovod_elastic_resize_events_total",
-                  "Elastic membership changes seen by this worker",
+        # families owned by other layers, pre-declared for the
+        # catalogue (names+helps live ONCE in telemetry/__init__.py;
+        # hvdlint checker 4 rejects literal copies)
+        m.counter(telemetry.PROGRAM_CACHE_HITS_FAMILY,
+                  telemetry.PROGRAM_CACHE_HITS_HELP)
+        m.counter(telemetry.PROGRAM_CACHE_MISSES_FAMILY,
+                  telemetry.PROGRAM_CACHE_MISSES_HELP)
+        m.counter(telemetry.COMPILE_SECONDS_FAMILY,
+                  telemetry.COMPILE_SECONDS_HELP)
+        m.counter(telemetry.AUTOTUNE_SAMPLES_FAMILY,
+                  telemetry.AUTOTUNE_SAMPLES_HELP)
+        m.gauge(telemetry.AUTOTUNE_BEST_SCORE_FAMILY,
+                telemetry.AUTOTUNE_BEST_SCORE_HELP)
+        m.gauge(telemetry.AUTOTUNE_BEST_CONFIG_FAMILY,
+                telemetry.AUTOTUNE_BEST_CONFIG_HELP,
+                labelnames=telemetry.AUTOTUNE_BEST_CONFIG_LABELS)
+        m.counter(telemetry.ELASTIC_RESIZE_FAMILY,
+                  telemetry.ELASTIC_RESIZE_HELP,
                   labelnames=("direction",))
         # fabric/chaos/liveness families (docs/fault_tolerance.md):
         # retries are counted by the StoreClient, injections by the
@@ -761,6 +761,7 @@ class Engine:
     # ------------------------------------------------------------------
     # submission (rank threads)
 
+    # hvdlint: seam[determinism]
     def submit(self, sub: Submission) -> Handle:
         """EnqueueTensorAllreduce/... analogue (operations.cc:1408-2060):
         register the submission in the negotiation table; the background
@@ -1125,6 +1126,7 @@ class Engine:
     # ------------------------------------------------------------------
     # store-controller (multi-process) cycle
 
+    # hvdlint: seam[determinism]
     def _meta_for(self, ps, entry):
         """Negotiation metadata sent to the coordinator — the Request
         wire message (reference message.h:59-143 via FlatBuffers)."""
@@ -1677,6 +1679,7 @@ class Engine:
                 f"joined; {rt.name} does not support join")
         return None
 
+    # hvdlint: seam[determinism]
     def _fuse(self, ps, entries):
         """FuseResponses analogue (controller.cc:901-1080): pack
         consecutive ready allreduce entries with matching

@@ -16,9 +16,10 @@ import threading
 
 from ..common.exceptions import HorovodInternalError
 from ..runner.http.http_client import StoreClient
-from ..runner.http.http_server import CACHEABLE_TYPES as _CACHEABLE_TYPES
+from ..runner.http.contract import CACHEABLE_TYPES as _CACHEABLE_TYPES
 
 
+# hvdlint: seam[determinism]
 def _fingerprint(meta):
     """Canonical identity of a negotiation meta, aux/error excluded
     (reference response_cache.h:45-101 keys the LRU on tensor name +
@@ -50,7 +51,7 @@ class StoreController:
         self._reported = set()
         self._cache = {}      # key -> (cache_id, fingerprint)
         self._suppressed = {} # key -> full meta withheld on a cache hit
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # hvdlint: lock[ctrl:21]
         self._jid = 0         # join-request id (idempotent retries)
         self._rid = 0         # ready-report id (idempotent retries)
         # session id: a NEW controller against the SAME coordinator
@@ -158,6 +159,7 @@ class StoreController:
 
     # -- reporting -----------------------------------------------------------
 
+    # hvdlint: seam[determinism]
     def report_ready(self, metas):
         """Announce locally-ready entries (idempotent per key).  Keys
         whose params match a cached response template go out as tiny

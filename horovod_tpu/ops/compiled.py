@@ -228,14 +228,12 @@ def _cache_metrics():
     cached = getattr(reg, "_compiled_cache_metrics", None)
     if cached is None:
         cached = (
-            reg.counter("horovod_program_cache_hits_total",
-                        "Compiled-path program cache hits"),
-            reg.counter("horovod_program_cache_misses_total",
-                        "Compiled-path program cache misses "
-                        "(new builds)"),
-            reg.counter("horovod_compile_seconds_total",
-                        "Seconds spent building + first-compiling "
-                        "programs"),
+            reg.counter(telemetry.PROGRAM_CACHE_HITS_FAMILY,
+                        telemetry.PROGRAM_CACHE_HITS_HELP),
+            reg.counter(telemetry.PROGRAM_CACHE_MISSES_FAMILY,
+                        telemetry.PROGRAM_CACHE_MISSES_HELP),
+            reg.counter(telemetry.COMPILE_SECONDS_FAMILY,
+                        telemetry.COMPILE_SECONDS_HELP),
         )
         reg._compiled_cache_metrics = cached
     return cached

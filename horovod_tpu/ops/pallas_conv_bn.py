@@ -273,9 +273,9 @@ def _bwd_xla(x, a, b, w, y, dy, ds1, ds2, fold):
 
 
 def _bwd_mode():
-    import os
+    from ..common import env
 
-    return os.environ.get("HOROVOD_CONV_BN_BWD", "pallas")
+    return env.get_str(env.HOROVOD_CONV_BN_BWD, "pallas")
 
 
 def _vjp_bwd(fold, interpret, res, cots):

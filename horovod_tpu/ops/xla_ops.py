@@ -643,7 +643,9 @@ class MeshExecutor:
         # threshold left that win on the table; docs/benchmarks.md
         # alltoall table).  HOROVOD_TPU_ALLTOALL_SCHEDULE=
         # {auto,oneshot,diag} forces it for experiments.
-        mode = os.environ.get("HOROVOD_TPU_ALLTOALL_SCHEDULE", "auto")
+        from ..common import env as env_mod
+        mode = env_mod.get_str(
+            env_mod.HOROVOD_TPU_ALLTOALL_SCHEDULE, "auto")
         if mode not in ("auto", "oneshot", "diag"):
             raise ValueError(
                 f"HOROVOD_TPU_ALLTOALL_SCHEDULE={mode!r}: must be "

@@ -23,6 +23,7 @@ HOROVOD_FAULT_SEED = "HOROVOD_FAULT_SEED"
 HOROVOD_HEARTBEAT_INTERVAL_SECONDS = "HOROVOD_HEARTBEAT_INTERVAL_SECONDS"
 HOROVOD_HEARTBEAT_WINDOW_SECONDS = "HOROVOD_HEARTBEAT_WINDOW_SECONDS"
 HOROVOD_COORD_JOURNAL = "HOROVOD_COORD_JOURNAL"
+HOROVOD_ELASTIC_TIMEOUT = "HOROVOD_ELASTIC_TIMEOUT"
 HOROVOD_COORD_OUTAGE_DEADLINE_SECONDS = \
     "HOROVOD_COORD_OUTAGE_DEADLINE_SECONDS"
 HOROVOD_BYPASS_AFTER_CYCLES = "HOROVOD_BYPASS_AFTER_CYCLES"
@@ -97,6 +98,13 @@ def set_env_from_args(env: dict, args) -> dict:
     if getattr(args, "heartbeat_window_seconds", None) is not None:
         env[HOROVOD_HEARTBEAT_WINDOW_SECONDS] = str(
             args.heartbeat_window_seconds)
+    if getattr(args, "elastic_timeout", None) is not None:
+        # the elastic driver bounds each round's re-init with this
+        # launcher-side, but workers ALSO wait on it at the init
+        # barrier (common/basics.py reads HOROVOD_ELASTIC_TIMEOUT) —
+        # without the handoff the flag silently didn't reach them
+        # (found by hvdlint knob-flag-unhandled)
+        env[HOROVOD_ELASTIC_TIMEOUT] = str(args.elastic_timeout)
     if getattr(args, "coord_journal", None):
         env[HOROVOD_COORD_JOURNAL] = args.coord_journal
     if getattr(args, "coord_outage_deadline_seconds", None) is not None:

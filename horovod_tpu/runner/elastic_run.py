@@ -59,7 +59,11 @@ def run_elastic(args):
         command=args.command, env=env, reset_limit=args.reset_limit,
         cooldown_range=cooldown,
         platform="cpu" if args.cpu else None, verbose=args.verbose,
-        elastic_timeout=getattr(args, "elastic_timeout", 600))
+        # at_env carries both the --elastic-timeout handoff and a
+        # user-exported HOROVOD_ELASTIC_TIMEOUT, so driver and worker
+        # init barrier (common/basics.py) always agree on the bound
+        elastic_timeout=float(
+            at_env.get("HOROVOD_ELASTIC_TIMEOUT") or 600))
     # serving jobs (--serve): the SLO autoscaler reads the replicas'
     # pushed metric snapshots off this launcher's KV store and drives
     # the fleet through driver.set_target_np (docs/serving.md)

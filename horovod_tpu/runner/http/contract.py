@@ -1,0 +1,57 @@
+"""Control-plane wire contract: THE definitions shared by the client
+(http_client.py), the server (http_server.py), the worker-side
+controller (core/store_controller.py) and the bypass state machine
+(core/bypass.py).
+
+Every constant here encodes a cross-component invariant that used to
+live as a copy on each side of the wire — one drifting copy is a
+silent replay-unsafety or cache-divergence bug, so the copies were
+hoisted into this module and ``tools/hvdlint`` (checker ``replay``)
+mechanically rejects any re-definition elsewhere.  The runtime
+contract test (tests/test_chaos.py ``test_replay_safe_verbs_contract``)
+validates the SAME single definition dynamically.
+"""
+
+#: Verbs whose POSTs the coordinator deduplicates on a client id
+#: (rid/jid), on idempotent per-slot state (resync session
+#: registration, bypass_ready votes), or that are naturally idempotent
+#: (heartbeat) — the only coordinator verbs where retrying a TIMEOUT
+#: is safe (the original may still have landed).  Across a coordinator
+#: restart the epoch fence rejects any blind replay BEFORE its verb
+#: runs, so the contract holds outage-spanning too.
+REPLAY_SAFE_VERBS = ("ready", "join", "heartbeat", "resync",
+                     "bypass_ready")
+
+#: KV-path pseudo-verbs that are replay-safe by DATA MODEL rather than
+#: by dedup: puts are last-writer-wins and gets are reads, so a
+#: timed-out request can be blindly re-sent.  (kv_delete is excluded:
+#: delete-then-recreate races a replayed delete.)
+REPLAY_SAFE_KV_VERBS = ("kv_put", "kv_get")
+
+#: The server-side dedup / idempotency structure each replay-safe verb
+#: handler must route through (attribute names on the Coordinator).
+#: hvdlint checker ``replay`` statically verifies every ``_on_<verb>``
+#: handler touches its declared structure; the chaos contract test
+#: proves single-apply under identical replay at runtime.
+REPLAY_DEDUP_ATTRS = {
+    "ready": ("_ready_seen",),          # rid high-water + cached reply
+    "join": ("_join_seen",),            # per-(ps, proc) jid sets
+    "heartbeat": ("_beats",),           # last-beat map: re-beat = update
+    "resync": ("_proc_sid",),           # session re-registration
+    "bypass_ready": ("_bypass_votes",),  # per-proc vote slot
+}
+
+#: Verbs that bypass the coordinator epoch fence: ``clock`` is a
+#: lock-free, state-free NTP ping that must answer with minimal
+#: jitter; ``resync`` IS the fence's recovery handshake (it cannot be
+#: fenced by the epoch it exists to re-learn).  Every other verb must
+#: be rejected on an epoch mismatch BEFORE its handler runs —
+#: hvdlint checker ``replay`` verifies the dispatch order.
+EPOCH_EXEMPT_VERBS = ("clock", "resync")
+
+#: Negotiation-meta types eligible for the coordinator response cache
+#: AND the steady-state bypass (reference response_cache.cc
+#: eligibility): metas identical across steps.  Shared by the server's
+#: cache admission, the worker controller's hit path and the bypass
+#: eligibility filter — three sites that previously each held a copy.
+CACHEABLE_TYPES = ("ALLREDUCE", "ADASUM")

@@ -22,10 +22,11 @@ import hashlib
 import hmac
 import http.client
 import json
-import os
 import random
 import threading
 import time
+
+from ...common import env as env_mod
 
 
 class _HTTPError(Exception):
@@ -39,16 +40,12 @@ class _DroppedRequest(ConnectionError):
     client-visible symptom of a lost packet/connection."""
 
 
-#: Verbs whose POSTs the coordinator deduplicates on a client id
-#: (rid/jid), on idempotent per-slot state (resync session
-#: registration, bypass_ready votes), or that are naturally idempotent
-#: (heartbeat) — the only verbs where retrying a TIMEOUT is safe (the
-#: original may still have landed).  Across a coordinator restart the
-#: epoch fence rejects any blind replay BEFORE its verb runs, so the
-#: contract holds outage-spanning too (tests/test_chaos.py
-#: test_replay_safe_verbs_contract).
-REPLAY_SAFE_VERBS = ("ready", "join", "heartbeat", "resync",
-                     "bypass_ready")
+#: Re-exported from the shared contract module (one definition for
+#: client, server and checkers — see contract.py for the invariant);
+#: kept as a module attribute because tests and callers import it
+#: from here historically.
+from .contract import (  # noqa: F401 — re-export
+    REPLAY_SAFE_VERBS, REPLAY_SAFE_KV_VERBS)
 
 
 def _count_retry(verb):
@@ -75,11 +72,10 @@ class StoreClient:
         self.middleware = None
         # retry budget: attempts AND a wall deadline bound every
         # request's total retry time (env-tunable; docs/fault_tolerance)
-        self.retry_attempts = int(
-            os.environ.get("HOROVOD_FABRIC_RETRY_ATTEMPTS") or 8)
-        self.retry_deadline = float(
-            os.environ.get("HOROVOD_FABRIC_RETRY_DEADLINE_SECONDS")
-            or 30.0)
+        self.retry_attempts = env_mod.get_int(
+            env_mod.HOROVOD_FABRIC_RETRY_ATTEMPTS, 8)
+        self.retry_deadline = env_mod.get_float(
+            env_mod.HOROVOD_FABRIC_RETRY_DEADLINE_SECONDS, 30.0)
         # coordinator-outage budget (docs/fault_tolerance.md
         # "Coordinator crash survival"): CONNECTION-SHAPE failures —
         # the server is gone, the request never completed server-side,
@@ -88,9 +84,8 @@ class StoreClient:
         # request one, spanning a rendezvous-service restart.  5xx
         # keeps the tight budget: a server answering sick is not an
         # outage.
-        self.outage_deadline = float(
-            os.environ.get("HOROVOD_COORD_OUTAGE_DEADLINE_SECONDS")
-            or 120.0)
+        self.outage_deadline = env_mod.get_float(
+            env_mod.HOROVOD_COORD_OUTAGE_DEADLINE_SECONDS, 120.0)
         self._retry_base = 0.05     # first backoff step (seconds)
         self._retry_cap = 2.0       # per-step ceiling
 
@@ -135,6 +130,7 @@ class StoreClient:
         step = min(self._retry_cap, self._retry_base * (2 ** attempt))
         time.sleep(step * (0.5 + random.random()))
 
+    # hvdlint: blocking
     def _send_once(self, method, path, body, headers, timeout,
                    duplicate=False):
         conn = self._conn(timeout)
@@ -289,8 +285,7 @@ class StoreClient:
 #    HOROVOD_SECRET_KEY when the server enforces HMAC. ----------------------
 
 def _env_secret():
-    import os
-    secret_hex = os.environ.get("HOROVOD_SECRET_KEY")
+    secret_hex = env_mod.get_str(env_mod.HOROVOD_SECRET_KEY)
     try:
         return bytes.fromhex(secret_hex) if secret_hex else None
     except ValueError:

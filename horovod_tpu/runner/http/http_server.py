@@ -45,7 +45,10 @@ NOT_FOUND = 404
 # metas carry per-proc first dims and alltoall metas carry splits, so
 # those are never cached (client sends full metas; server skips the
 # LRU so uncacheable entries can't evict hot allreduce templates).
-CACHEABLE_TYPES = ("ALLREDUCE", "ADASUM")
+# ONE definition, shared with the worker controller and the bypass
+# eligibility filter (contract.py); re-exported for back-compat.
+from .contract import (  # noqa: F401 — re-export
+    CACHEABLE_TYPES, EPOCH_EXEMPT_VERBS, REPLAY_DEDUP_ATTRS)
 
 
 def autotune_kwargs(env=None):
@@ -325,7 +328,7 @@ class KVStore:
 
     def __init__(self):
         self._data = {}
-        self._cv = threading.Condition()
+        self._cv = threading.Condition()  # hvdlint: lock[store:1]
         self.journal = None
 
     def _journal_write(self, key, value):
@@ -337,9 +340,9 @@ class KVStore:
                          "(%d bytes)", key, len(value))
             return
         if value is None:
-            j.append({"k": "kvdel", "key": key})
+            j.append({"k": "kvdel", "key": key})  # hvdlint: acquires[journal]
         else:
-            j.append({"k": "kv", "key": key,
+            j.append({"k": "kv", "key": key,  # hvdlint: acquires[journal]
                       "v": journal_mod._b64(value)})
 
     def put(self, key, value: bytes):
@@ -478,7 +481,7 @@ class Coordinator:
                                                log_path=autotune_log,
                                                tune_wire=False,
                                                tune_algorithm=False)
-        self._lock = threading.Condition()
+        self._lock = threading.Condition()  # hvdlint: lock[coord:0]
         # key -> {proc_id -> meta}
         self._pending: "OrderedDict[str, dict]" = OrderedDict()
         # Ordered response log.  Client cursors are absolute; entries
@@ -540,7 +543,7 @@ class Coordinator:
         """Journal one record (no-op without a journal / during
         replay)."""
         if self._journal is not None and not self._replaying:
-            self._journal.append(rec)
+            self._journal.append(rec)  # hvdlint: acquires[journal]
 
     def _log_append(self, rec):
         """THE response-log append point: journals the record with its
@@ -609,7 +612,7 @@ class Coordinator:
             return {"t": time.time()}
         epoch = req.get("epoch")
         if epoch is not None and epoch != self.coord_epoch \
-                and verb != "resync":
+                and verb not in EPOCH_EXEMPT_VERBS:
             # epoch fence: a request minted against a pre-restart
             # coordinator generation is rejected BEFORE any verb runs
             # — the cross-outage dedup blind HTTP replays rely on.
@@ -894,7 +897,7 @@ class Coordinator:
         coordinator -> store everywhere, never the reverse)."""
         kv = {}
         if self._store is not None:
-            for key, val in self._store.scope("").items():
+            for key, val in self._store.scope("").items():  # hvdlint: acquires[store]
                 if key.startswith(journal_mod.KV_EXCLUDE_PREFIXES):
                     continue
                 if len(val) > self._journal.kv_max_bytes:
@@ -933,7 +936,7 @@ class Coordinator:
         the stall and liveness scans)."""
         if self._journal is None or not self._journal.needs_compaction():
             return
-        self._journal.compact(self._journal_snapshot_locked())
+        self._journal.compact(self._journal_snapshot_locked())  # hvdlint: acquires[journal]
 
     def _journal_tuned_locked(self):
         """Journal the coordinator autotuner's current best config

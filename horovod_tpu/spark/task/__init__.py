@@ -6,6 +6,7 @@ function, runs it, publishes the result."""
 import os
 import time
 
+from ...common import env as env_mod
 from ...runner.common.util import codec, secret
 from ...runner.util.threads import in_thread
 from ..driver import driver_service
@@ -26,13 +27,22 @@ def task_exec(driver_addresses, settings, rank_env, local_rank_env):
     """Reference task/__init__.py:37."""
     in_thread(_parent_process_monitor, (os.getppid(),))
 
-    key = codec.loads_base64(os.environ[secret.HOROVOD_SECRET_KEY])
+    key_b64 = env_mod.get_str(secret.HOROVOD_SECRET_KEY)
+    if key_b64 is None:
+        raise RuntimeError(
+            f"{secret.HOROVOD_SECRET_KEY} missing from the task "
+            f"environment — the spark driver's handoff is broken")
+    key = codec.loads_base64(key_b64)
     rank = int(os.environ[rank_env])
     local_rank = int(os.environ[local_rank_env])
     driver_client = driver_service.SparkDriverClient(
         driver_addresses, key, verbose=settings.verbose)
 
-    host_hash = os.environ["HOROVOD_HOSTNAME"]
+    host_hash = env_mod.get_str(env_mod.HOROVOD_HOSTNAME)
+    if host_hash is None:
+        raise RuntimeError(
+            f"{env_mod.HOROVOD_HOSTNAME} missing from the task "
+            f"environment — the spark driver's handoff is broken")
     task_index = driver_client.set_local_rank_to_rank(
         host_hash, local_rank, rank)
 

@@ -6,13 +6,14 @@ launcher anyway behaves, and the rank env names follow OpenMPI's."""
 import os
 import sys
 
+from ...common import env as env_mod
 from ...runner.common.util import codec
 from . import task_exec
 
 
 def main(driver_addresses, settings):
-    if "HOROVOD_SPARK_PYTHONPATH" in os.environ:
-        ppath = os.environ["HOROVOD_SPARK_PYTHONPATH"]
+    ppath = env_mod.get_str("HOROVOD_SPARK_PYTHONPATH")
+    if ppath is not None:
         for p in reversed(ppath.split(os.pathsep)):
             sys.path.insert(1, p)
         if "PYTHONPATH" in os.environ:
@@ -20,7 +21,7 @@ def main(driver_addresses, settings):
                                      os.environ["PYTHONPATH"]])
         os.environ["PYTHONPATH"] = ppath
 
-    work_dir = os.environ.get("HOROVOD_SPARK_WORK_DIR")
+    work_dir = env_mod.get_str("HOROVOD_SPARK_WORK_DIR")
     if work_dir:
         os.chdir(work_dir)
 

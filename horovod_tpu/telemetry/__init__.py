@@ -74,6 +74,35 @@ COORD_RESYNCS_FAMILY = "horovod_coord_resyncs_total"
 COORD_RESYNCS_HELP = ("Epoch-fenced resync handshakes this worker "
                       "performed against a restarted coordinator")
 
+# -- families registered from more than one layer (hvdlint checker 4
+#    `telemetry-dup-family`): the compiled-path cache counters are
+#    bumped by ops/compiled.py and pre-declared by the engine's
+#    catalogue; the autotune families by core/autotune.py and the
+#    catalogue; elastic resizes by common/basics.py and the catalogue.
+#    One name + one help here, imported everywhere.
+
+PROGRAM_CACHE_HITS_FAMILY = "horovod_program_cache_hits_total"
+PROGRAM_CACHE_HITS_HELP = "Compiled-path program cache hits"
+PROGRAM_CACHE_MISSES_FAMILY = "horovod_program_cache_misses_total"
+PROGRAM_CACHE_MISSES_HELP = ("Compiled-path program cache misses "
+                             "(new builds)")
+COMPILE_SECONDS_FAMILY = "horovod_compile_seconds_total"
+COMPILE_SECONDS_HELP = ("Seconds spent building + first-compiling "
+                        "programs")
+AUTOTUNE_SAMPLES_FAMILY = "horovod_autotune_samples_total"
+AUTOTUNE_SAMPLES_HELP = "Autotune sample windows scored"
+AUTOTUNE_BEST_SCORE_FAMILY = "horovod_autotune_best_score_bytes_per_sec"
+AUTOTUNE_BEST_SCORE_HELP = ("Best autotune score observed (logical "
+                            "bytes/sec)")
+AUTOTUNE_BEST_CONFIG_FAMILY = "horovod_autotune_best_config"
+AUTOTUNE_BEST_CONFIG_HELP = ("Current best autotune configuration "
+                             "(value 1; the labels are the config)")
+AUTOTUNE_BEST_CONFIG_LABELS = ("fusion_threshold_bytes",
+                               "cycle_time_ms", "wire", "algorithm")
+ELASTIC_RESIZE_FAMILY = "horovod_elastic_resize_events_total"
+ELASTIC_RESIZE_HELP = ("Elastic membership changes seen by this "
+                       "worker")
+
 
 def count_fabric_retry(verb):
     """One fabric retry attempt, into the process-current registry

@@ -209,6 +209,12 @@ def test_replay_safe_verbs_contract():
     # (heartbeat); widening this list needs a server-side dedup first
     assert REPLAY_SAFE_VERBS == ("ready", "join", "heartbeat",
                                  "resync", "bypass_ready")
+    # ONE definition: the client re-exports the contract module's
+    # tuple (hvdlint checker `replay` rejects any re-definition
+    # statically; this is the runtime half of the same invariant)
+    from horovod_tpu.runner.http import contract
+    assert REPLAY_SAFE_VERBS is contract.REPLAY_SAFE_VERBS
+    assert set(contract.REPLAY_DEDUP_ATTRS) == set(REPLAY_SAFE_VERBS)
     # EVERY replay-safe verb must be single-apply under an identical
     # replay — the property outage-spanning retries lean on
     c = Coordinator(world_size=2)

@@ -1,0 +1,648 @@
+"""hvdlint — the invariant-checking static analysis suite (ISSUE 8).
+
+Per checker: one fixture that MUST flag (a seeded violation of the
+invariant) and one that MUST pass (the sanctioned pattern — the
+false-positive guard).  Plus: suppression-comment parsing, baseline
+round-trip, the zero-new-findings gate over the REAL tree with the
+shipped baseline, and the one-definition contract-module invariants.
+"""
+
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.hvdlint import (  # noqa: E402
+    Project, collect_py_files, load_baseline, partition_new,
+    run_checkers, save_baseline,
+)
+
+
+def build_project(tmp_path, files):
+    rels = []
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+        rels.append(rel)
+    return Project(str(tmp_path), rels)
+
+
+def ids(findings):
+    return sorted({f.checker_id for f in findings})
+
+
+def run(tmp_path, files, checkers=None):
+    return run_checkers(build_project(tmp_path, files),
+                        checker_ids=checkers)
+
+
+#: minimal contract module for replay fixtures
+CONTRACT = """\
+REPLAY_SAFE_VERBS = ("ready", "heartbeat")
+REPLAY_SAFE_KV_VERBS = ("kv_put",)
+EPOCH_EXEMPT_VERBS = ("clock", "resync")
+REPLAY_DEDUP_ATTRS = {"ready": ("_ready_seen",),
+                      "heartbeat": ("_beats",)}
+"""
+
+
+# ---------------------------------------------------------------------------
+# checker 1: cross-rank determinism
+
+
+class TestDeterminism:
+    def test_flags_seeded_violations(self, tmp_path):
+        findings = run(tmp_path, {"mod.py": """\
+            import time
+            import json
+            import os
+
+
+            # hvdlint: seam[determinism]
+            def fingerprint(meta):
+                stamp = time.time()
+                wire = os.environ.get("HOROVOD_WIRE_DTYPE")
+                for k in set(meta):
+                    helper(k)
+                return json.dumps({"t": stamp, "w": wire})
+
+
+            def helper(k):
+                return hash(k)
+            """}, checkers=["det"])
+        got = ids(findings)
+        assert "det-wallclock" in got
+        assert "det-env-read" in got
+        assert "det-set-iter" in got
+        assert "det-json-unsorted" in got
+        # transitive: hash() sits in helper(), reached from the seam
+        assert any(f.checker_id == "det-hash-id" and
+                   "helper" in f.message for f in findings)
+
+    def test_sanctioned_patterns_pass(self, tmp_path):
+        findings = run(tmp_path, {"mod.py": """\
+            import json
+            import time
+
+
+            # hvdlint: seam[determinism]
+            def fingerprint(meta):
+                t0 = time.monotonic()      # per-rank timeout: allowed
+                keys = sorted(set(meta))   # sorted set: allowed
+                return json.dumps({"k": keys}, sort_keys=True), t0
+            """}, checkers=["det"])
+        assert not findings
+
+    def test_seeded_random_instance_allowed(self, tmp_path):
+        # random.Random(seed) is the det-random hint's own recommended
+        # fix — constructing it must not re-trigger the finding
+        findings = run(tmp_path, {"mod.py": """\
+            import random
+
+
+            # hvdlint: seam[determinism]
+            def fingerprint(meta, seed):
+                rng = random.Random(seed)
+                jitter = random.random()
+                return meta, rng, jitter
+            """}, checkers=["det"])
+        assert ids(findings) == ["det-random"]
+        assert all("random.Random" not in f.message for f in findings)
+
+    def test_finding_keys_are_line_stable(self, tmp_path):
+        # baseline keys must survive unrelated edits (core.py
+        # contract): inserting lines above a finding keeps its key
+        src = """\
+            # hvdlint: seam[determinism]
+            def fingerprint(meta):
+                for k in set(meta):
+                    pass
+                return meta
+            """
+        before = run(tmp_path, {"mod.py": src}, checkers=["det"])
+        shifted = run(tmp_path / "b", {"mod.py": "x = 1\ny = 2\n" +
+                                       textwrap.dedent(src)},
+                      checkers=["det"])
+        assert {f.key for f in before} == {f.key for f in shifted}
+
+    def test_outside_cone_not_flagged(self, tmp_path):
+        findings = run(tmp_path, {"mod.py": """\
+            import time
+
+
+            # hvdlint: seam[determinism]
+            def fingerprint(meta):
+                return repr(meta)
+
+
+            def unrelated():
+                return time.time()
+            """}, checkers=["det"])
+        assert not findings
+
+    def test_missing_seams_is_a_config_error(self, tmp_path):
+        findings = run(tmp_path, {"mod.py": "x = 1\n"},
+                       checkers=["det"])
+        assert ids(findings) == ["det-no-seams"]
+
+
+# ---------------------------------------------------------------------------
+# checker 2: lock order + blocking under lock
+
+
+class TestLockOrder:
+    def test_flags_out_of_order_reentrant_and_blocking(self, tmp_path):
+        findings = run(tmp_path, {"mod.py": """\
+            import threading
+            import time
+
+
+            class Journal:
+                def __init__(self):
+                    self._lock = threading.Lock()  # hvdlint: lock[journal:2]
+
+                def append(self, rec, coord):
+                    with self._lock:
+                        coord.tick()  # hvdlint: acquires[coord]
+
+
+            class Coordinator:
+                def __init__(self):
+                    self._lock = threading.Condition()  # hvdlint: lock[coord:0]
+
+                def tick(self):
+                    with self._lock:
+                        time.sleep(0.1)
+                        self._rescan_locked()
+
+                def _rescan_locked(self):
+                    with self._lock:
+                        pass
+            """}, checkers=["lock"])
+        msgs = [f.message for f in findings]
+        assert any(f.checker_id == "lock-order" and
+                   "out-of-order" in f.message for f in findings), msgs
+        assert any(f.checker_id == "lock-order" and
+                   "reentrant" in f.message for f in findings), msgs
+        assert any(f.checker_id == "lock-blocking" and
+                   "time.sleep" in f.message for f in findings), msgs
+
+    def test_in_order_chain_passes(self, tmp_path):
+        findings = run(tmp_path, {"mod.py": """\
+            import threading
+
+
+            class Journal:
+                def __init__(self):
+                    self._lock = threading.Lock()  # hvdlint: lock[journal:2]
+
+                def append(self, rec):
+                    with self._lock:
+                        pass
+
+
+            class Store:
+                def __init__(self, journal):
+                    self._cv = threading.Condition()  # hvdlint: lock[store:1]
+                    self.journal = journal
+
+                def put(self, key):
+                    with self._cv:
+                        self.journal.append(key)  # hvdlint: acquires[journal]
+                        self._cv.notify_all()
+
+                def get(self, key, timeout):
+                    with self._cv:
+                        self._cv.wait(timeout)  # releases: not blocking
+
+
+            class Coordinator:
+                def __init__(self, store):
+                    self._lock = threading.Condition()  # hvdlint: lock[coord:0]
+                    self.store = store
+
+                def snapshot(self):
+                    with self._lock:
+                        self._compact_locked()
+
+                def _compact_locked(self):
+                    self.store.put("snap")  # hvdlint: acquires[store]
+            """}, checkers=["lock"])
+        assert not findings
+
+    def test_locked_convention_infers_holding(self, tmp_path):
+        findings = run(tmp_path, {"mod.py": """\
+            import threading
+            import time
+
+
+            class Coordinator:
+                def __init__(self):
+                    self._lock = threading.Condition()  # hvdlint: lock[coord:0]
+
+                def _scan_locked(self):
+                    time.sleep(1.0)
+            """}, checkers=["lock"])
+        assert [f.checker_id for f in findings] == ["lock-blocking"]
+
+
+# ---------------------------------------------------------------------------
+# checker 3: replay safety
+
+
+class TestReplaySafety:
+    def test_flags_contract_violations(self, tmp_path):
+        findings = run(tmp_path, {
+            "contract.py": CONTRACT,
+            "client.py": """\
+            REPLAY_SAFE_VERBS = ("ready", "evil")
+
+
+            class Client:
+                def _request(self, m, p, verb=None, retry_timeout=False):
+                    pass
+
+                def coord(self):
+                    self._request("POST", "/x", verb="evil",
+                                  retry_timeout=True)
+            """,
+            "server.py": """\
+            class Coordinator:
+                coord_epoch = 1
+
+                def handle(self, verb, req):
+                    if verb == "ready":
+                        return self._on_ready(req)
+                    if req.get("epoch") != self.coord_epoch:
+                        return {"epoch_mismatch": True}
+                    if verb == "heartbeat":
+                        return self._on_heartbeat(req)
+
+                def _on_ready(self, req):
+                    return {}
+
+                def _on_heartbeat(self, req):
+                    self._beats[req["proc"]] = 1
+                    return {}
+            """}, checkers=["replay"])
+        got = ids(findings)
+        assert "replay-dup-contract" in got     # client re-defines tuple
+        assert "replay-unsafe-verb" in got      # 'evil' retried on timeout
+        assert "replay-fence" in got            # ready dispatched pre-fence
+        assert "replay-no-dedup" in got         # _on_ready ignores _ready_seen
+
+    def test_canonical_pattern_passes(self, tmp_path):
+        findings = run(tmp_path, {
+            "contract.py": CONTRACT,
+            "client.py": """\
+            from contract import REPLAY_SAFE_VERBS
+
+
+            class Client:
+                def _request(self, m, p, verb=None, retry_timeout=False):
+                    pass
+
+                def coord(self, verb):
+                    self._request("POST", f"/coord/{verb}", verb=verb,
+                                  retry_timeout=verb in REPLAY_SAFE_VERBS)
+
+                def put(self, key):
+                    self._request("PUT", key, verb="kv_put",
+                                  retry_timeout=True)
+            """,
+            "server.py": """\
+            class Coordinator:
+                coord_epoch = 1
+
+                def handle(self, verb, req):
+                    if verb == "clock":
+                        return {"t": 0}
+                    if req.get("epoch") != self.coord_epoch:
+                        return {"epoch_mismatch": True}
+                    if verb == "ready":
+                        return self._on_ready(req)
+                    if verb == "heartbeat":
+                        return self._on_heartbeat(req)
+
+                def _on_ready(self, req):
+                    if req["rid"] in self._ready_seen:
+                        return self._ready_reply
+                    return {}
+
+                def _on_heartbeat(self, req):
+                    self._beats[req["proc"]] = 1
+                    return {}
+            """}, checkers=["replay"])
+        assert not findings
+
+    def test_missing_fence_flagged(self, tmp_path):
+        findings = run(tmp_path, {
+            "contract.py": CONTRACT,
+            "server.py": """\
+            class Coordinator:
+                def handle(self, verb, req):
+                    if verb == "ready":
+                        return self._on_ready(req)
+
+                def _on_ready(self, req):
+                    return dict(self._ready_seen)
+            """}, checkers=["replay"])
+        assert "replay-fence" in ids(findings)
+
+
+# ---------------------------------------------------------------------------
+# checker 4: telemetry hygiene
+
+
+class TestTelemetryHygiene:
+    def test_flags_duplicates_and_unbounded_labels(self, tmp_path):
+        findings = run(tmp_path, {
+            "a.py": """\
+            def setup(reg):
+                reg.counter("horovod_things_total", "Things")
+                reg.histogram("horovod_lat_seconds", "Latency",
+                              buckets=[0.1, 1.0])
+            """,
+            "b.py": """\
+            def bump(reg, name):
+                reg.counter("horovod_things_total",
+                            "Things, but described differently")
+                reg.counter("horovod_things_total").labels(
+                    kind=f"item-{name}").inc()
+            """}, checkers=["telemetry"])
+        got = ids(findings)
+        assert "telemetry-dup-family" in got
+        assert "telemetry-help-drift" in got
+        assert "telemetry-unbounded-label" in got
+        assert "telemetry-bucket-literal" in got
+
+    def test_shared_constants_pass(self, tmp_path):
+        findings = run(tmp_path, {
+            "fams.py": """\
+            THINGS_FAMILY = "horovod_things_total"
+            THINGS_HELP = "Things"
+            LAT_BUCKETS = (0.1, 1.0)
+            """,
+            "a.py": """\
+            from fams import THINGS_FAMILY, THINGS_HELP, LAT_BUCKETS
+
+
+            def setup(reg, kind):
+                reg.counter(THINGS_FAMILY, THINGS_HELP)
+                reg.histogram("horovod_lat_seconds", "Latency",
+                              buckets=LAT_BUCKETS)
+                reg.counter(THINGS_FAMILY, THINGS_HELP).labels(
+                    kind=kind).inc()
+            """}, checkers=["telemetry"])
+        assert not findings
+
+    def test_literal_next_to_constant_flagged(self, tmp_path):
+        findings = run(tmp_path, {
+            "fams.py": 'THINGS_FAMILY = "horovod_things_total"\n'
+                       'THINGS_HELP = "Things"\n',
+            "a.py": """\
+            from fams import THINGS_FAMILY, THINGS_HELP
+
+
+            def setup(reg):
+                reg.counter(THINGS_FAMILY, THINGS_HELP)
+                reg.counter("horovod_things_total", THINGS_HELP)
+            """}, checkers=["telemetry"])
+        assert "telemetry-literal-family" in ids(findings)
+
+
+# ---------------------------------------------------------------------------
+# checker 5: knob registry
+
+
+class TestKnobRegistry:
+    DOCS = "# knobs\n\n`HOROVOD_DOCUMENTED` is documented.\n"
+    ENV = """\
+    import os
+
+    INTERNAL_KNOBS = ("HOROVOD_INTERNAL",)
+
+
+    def get_str(name, default=None):
+        return os.environ.get(name, default)
+    """
+
+    def test_direct_and_undocumented_reads_flagged(self, tmp_path):
+        findings = run(tmp_path, {
+            "docs/migration.md.py": "",   # placeholder, ignored
+            "horovod_tpu/common/env.py": self.ENV,
+            "horovod_tpu/mod.py": """\
+            import os
+            from .common import env
+
+
+            def load():
+                a = os.environ["HOROVOD_DOCUMENTED"]     # direct read
+                b = env.get_str("HOROVOD_MYSTERY_KNOB")  # undocumented
+                c = env.get_str("HOROVOD_INTERNAL")      # internal: fine
+                return a, b, c
+            """}, checkers=["knob"])
+        (tmp_path / "docs").mkdir(exist_ok=True)
+        (tmp_path / "docs" / "migration.md").write_text(self.DOCS)
+        findings = run_checkers(
+            Project(str(tmp_path),
+                    ["horovod_tpu/common/env.py", "horovod_tpu/mod.py"]),
+            checker_ids=["knob"])
+        keys = {f.key for f in findings}
+        assert "knob-direct-read:horovod_tpu/mod.py:" \
+               "HOROVOD_DOCUMENTED" in keys
+        assert "knob-undocumented:HOROVOD_MYSTERY_KNOB" in keys
+        assert not any("HOROVOD_INTERNAL" in k for k in keys)
+
+    def test_flag_handoff_drift(self, tmp_path):
+        (tmp_path / "docs").mkdir(parents=True)
+        (tmp_path / "docs" / "migration.md").write_text(self.DOCS)
+        findings = run(tmp_path, {
+            "horovod_tpu/runner/launch.py": """\
+            _LAUNCHER_ONLY_FLAGS = ("np",)
+
+
+            def parse_args(parser):
+                parser.add_argument("-np", "--num-proc", dest="np")
+                parser.add_argument("--cycle-time-ms", type=float)
+                parser.add_argument("--orphan-knob", type=int)
+            """,
+            "horovod_tpu/runner/config_parser.py": """\
+            def set_env_from_args(env, args):
+                if args.cycle_time_ms is not None:
+                    env["HOROVOD_CYCLE_TIME"] = str(args.cycle_time_ms)
+                if getattr(args, "renamed_flag", None):
+                    env["HOROVOD_X"] = "1"
+                return env
+            """}, checkers=["knob"])
+        keys = {f.key for f in findings}
+        assert "knob-flag-unhandled:orphan_knob" in keys
+        assert "knob-flag-drift:renamed_flag" in keys
+        assert not any("cycle_time_ms" in k for k in keys)
+
+
+# ---------------------------------------------------------------------------
+# suppressions + baseline
+
+
+class TestSuppressionsAndBaseline:
+    SRC = """\
+    import time
+
+
+    # hvdlint: seam[determinism]
+    def fingerprint(meta):
+        {line}
+        return meta
+    """
+
+    def test_suppression_with_reason_silences(self, tmp_path):
+        findings = run(tmp_path, {"mod.py": self.SRC.format(
+            line="t = time.time()  "
+                 "# hvdlint: ignore[det-wallclock] test fixture: "
+                 "timestamp never crosses ranks")},
+            checkers=["det"])
+        assert not findings
+
+    def test_suppression_without_reason_is_a_finding(self, tmp_path):
+        findings = run(tmp_path, {"mod.py": self.SRC.format(
+            line="t = time.time()  # hvdlint: ignore[det-wallclock]")},
+            checkers=["det"])
+        got = ids(findings)
+        assert "hvdlint-bad-suppression" in got
+        assert "det-wallclock" in got   # not silenced either
+
+    def test_bare_suppression_not_also_reported_unused(self, tmp_path):
+        # a matched-but-justification-less marker is a bad-suppression
+        # finding; it must NOT additionally be called "unused" on a
+        # full run ("matches no finding" would be false, and the two
+        # hints would contradict each other)
+        findings = run(tmp_path, {"mod.py": self.SRC.format(
+            line="t = time.time()  # hvdlint: ignore[det-wallclock]")})
+        got = ids(findings)
+        assert "hvdlint-bad-suppression" in got
+        assert "det-wallclock" in got
+        assert "hvdlint-unused-suppression" not in got
+
+    def test_family_prefix_matches(self, tmp_path):
+        findings = run(tmp_path, {"mod.py": self.SRC.format(
+            line="t = time.time()  "
+                 "# hvdlint: ignore[det] whole-family suppression")},
+            checkers=["det"])
+        assert not findings
+
+    def test_unused_suppression_reported_on_full_run(self, tmp_path):
+        findings = run(tmp_path, {"mod.py": """\
+            x = 1  # hvdlint: ignore[det-wallclock] nothing here
+            """})
+        assert "hvdlint-unused-suppression" in ids(findings)
+
+    def test_marker_inside_string_is_not_a_marker(self, tmp_path):
+        project = build_project(tmp_path, {"mod.py": '''\
+            DOC = """
+            # hvdlint: ignore[det-wallclock] quoted example
+            """
+            '''})
+        assert not project.by_rel["mod.py"].markers
+        findings = run_checkers(project)
+        assert "hvdlint-unused-suppression" not in ids(findings)
+
+    def test_baseline_round_trip_and_gate(self, tmp_path):
+        files = {"mod.py": self.SRC.format(line="t = time.time()")}
+        findings = run(tmp_path, files, checkers=["det"])
+        assert len(findings) == 1
+        path = tmp_path / "baseline.json"
+        save_baseline(str(path), findings)
+        baseline = load_baseline(str(path))
+        assert baseline == {findings[0].key: 1}
+        # identical findings are baselined, not new
+        new, old, stale = partition_new(findings, baseline)
+        assert (len(new), len(old), stale) == (0, 1, [])
+        # a second instance of the same key IS new (count semantics)
+        new, old, _ = partition_new(findings * 2, baseline)
+        assert (len(new), len(old)) == (1, 1)
+        # fixed findings surface as stale entries
+        new, old, stale = partition_new([], baseline)
+        assert (new, old) == ([], [])
+        assert stale == [findings[0].key]
+        # round-trip stability
+        save_baseline(str(path), findings)
+        assert json.loads(path.read_text())["findings"] == baseline
+
+
+# ---------------------------------------------------------------------------
+# the real tree
+
+
+class TestRealTree:
+    @pytest.fixture(scope="class")
+    def real_findings(self):
+        rels = collect_py_files(REPO, ["horovod_tpu", "tools"])
+        project = Project(REPO, rels)
+        return run_checkers(project)
+
+    def test_gate_is_green_with_shipped_baseline(self, real_findings):
+        baseline = load_baseline(
+            os.path.join(REPO, "tools", "hvdlint", "baseline.json"))
+        new, _, _ = partition_new(real_findings, baseline)
+        assert not new, "NEW hvdlint findings:\n" + "\n".join(
+            f.render() for f in new)
+
+    def test_no_baselined_invariant_violations(self, real_findings):
+        """Acceptance: determinism / lock-order / replay-safety are
+        FIXED, never baselined — and hold on the real tree."""
+        baseline = load_baseline(
+            os.path.join(REPO, "tools", "hvdlint", "baseline.json"))
+        hard = ("det-", "lock-", "replay-")
+        assert not [k for k in baseline if k.startswith(hard)]
+        assert not [f for f in real_findings
+                    if f.checker_id.startswith(hard)]
+
+    def test_seams_and_locks_are_declared(self):
+        rels = collect_py_files(REPO, ["horovod_tpu"])
+        project = Project(REPO, rels)
+        seams = {f"{fi.file.rel}::{fi.qualname}"
+                 for fi in project.seam_functions("determinism")}
+        assert "horovod_tpu/core/bypass.py::cycle_fingerprint" in seams
+        assert "horovod_tpu/core/bypass.py::meta_fingerprint" in seams
+        assert "horovod_tpu/core/store_controller.py::_fingerprint" \
+               in seams
+        assert "horovod_tpu/core/engine.py::Engine.submit" in seams
+        assert "horovod_tpu/core/engine.py::Engine._fuse" in seams
+        locks = {d.name: d.rank for d in project.locks.values()}
+        assert locks["coord"] < locks["store"] < locks["journal"]
+        assert "engine" in locks and "ctrl" in locks
+
+
+# ---------------------------------------------------------------------------
+# contract module (satellite: one definition for client + server)
+
+
+class TestContractModule:
+    def test_one_definition_everywhere(self):
+        from horovod_tpu.runner.http import contract, http_client, \
+            http_server
+        from horovod_tpu.core import bypass, store_controller
+        assert http_client.REPLAY_SAFE_VERBS is \
+            contract.REPLAY_SAFE_VERBS
+        assert http_server.CACHEABLE_TYPES is contract.CACHEABLE_TYPES
+        assert bypass.CACHEABLE_TYPES is contract.CACHEABLE_TYPES
+        assert store_controller._CACHEABLE_TYPES is \
+            contract.CACHEABLE_TYPES
+        assert http_server.EPOCH_EXEMPT_VERBS is \
+            contract.EPOCH_EXEMPT_VERBS
+
+    def test_dedup_attrs_cover_every_replay_safe_verb(self):
+        from horovod_tpu.runner.http import contract
+        assert set(contract.REPLAY_DEDUP_ATTRS) == \
+            set(contract.REPLAY_SAFE_VERBS)
+        from horovod_tpu.runner.http.http_server import Coordinator
+        for verb in contract.REPLAY_SAFE_VERBS:
+            assert hasattr(Coordinator, f"_on_{verb}")

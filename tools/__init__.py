@@ -1,0 +1,3 @@
+# Namespace package marker so `python -m tools.hvdlint` works from the
+# repo root; the smoke scripts in this directory remain directly
+# runnable (`python tools/chaos_smoke.py`).

@@ -14,7 +14,7 @@ import horovod_tpu as hvd
 from horovod_tpu import telemetry
 from horovod_tpu.common.exceptions import TensorShapeMismatchError
 
-FAMILY = "horovod_negotiation_bypass_cycles_total"
+FAMILY = telemetry.BYPASS_CYCLES_FAMILY
 
 
 def main():

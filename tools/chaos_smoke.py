@@ -117,8 +117,9 @@ def worker_coordkill():
         # bypass counters are the acceptance evidence
         from horovod_tpu.common import basics
         basics._engine.push_metrics()
-        addr = os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"]
-        port = os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"]
+        from horovod_tpu.common import env as env_mod
+        addr = env_mod.require_str(env_mod.HOROVOD_RENDEZVOUS_ADDR)
+        port = env_mod.require_int(env_mod.HOROVOD_RENDEZVOUS_PORT)
         text = urllib.request.urlopen(
             f"http://{addr}:{port}/metrics", timeout=15).read().decode()
         with open(os.path.join(out_dir, "metrics.txt"), "w") as f:

@@ -1,0 +1,24 @@
+"""hvdlint — the project's invariant-checking static analysis suite.
+
+Five AST checkers encode the control-plane invariants the runtime's
+correctness argument rests on (see docs/invariants.md for the
+catalogue and ISSUE 8 for the motivation):
+
+1. ``det-*``        cross-rank determinism of the agreement seams
+2. ``lock-*``       coord→store→journal lock order, no blocking I/O
+                    under dispatch locks
+3. ``replay-*``     timeout-replay / dedup / epoch-fence contracts
+4. ``telemetry-*``  one-definition metric families, closed-set labels
+5. ``knob-*``       HOROVOD_* env reads through common/env.py,
+                    documented in docs/migration.md
+
+Run: ``./ci.sh analyze`` (gate: zero new findings vs baseline.json),
+``./ci.sh analyze --update-baseline`` (escape hatch), or
+``python -m tools.hvdlint --help``.
+"""
+
+from .core import (  # noqa: F401
+    Checker, Finding, all_checkers, load_baseline, partition_new,
+    register, run_checkers, save_baseline,
+)
+from .project import Project, collect_py_files  # noqa: F401

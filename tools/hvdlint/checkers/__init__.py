@@ -1,0 +1,7 @@
+"""Checker modules self-register on import (core.register)."""
+
+from . import determinism      # noqa: F401
+from . import lock_order       # noqa: F401
+from . import replay_safety    # noqa: F401
+from . import telemetry_hygiene  # noqa: F401
+from . import knob_registry    # noqa: F401

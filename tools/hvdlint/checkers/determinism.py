@@ -1,0 +1,221 @@
+"""Checker 1 — cross-rank determinism (``det-*``).
+
+Every rank must compute IDENTICAL negotiation fingerprints, fusion
+buckets and latched wire/algorithm choices, or the job diverges
+silently: two ranks that disagree about a bypass fingerprint execute
+different collective programs against each other (the failure class
+the reference Horovod's coordinator protocol exists to prevent,
+arXiv:1802.05799 §4).
+
+The entry points of that agreement machinery are declared in source
+with ``# hvdlint: seam[determinism]`` (bypass fingerprinting, the
+response-cache fingerprint, fusion-bucket signatures, the
+wire/algorithm latch at ``submit()``).  This checker walks the
+intra-project call graph from every seam and flags nondeterminism
+sources inside the cone:
+
+* ``det-wallclock``   — ``time.time``/``datetime.now`` (ranks read
+  different clocks; ``time.monotonic`` is allowed — it only feeds
+  per-rank timeouts whose fallback is unanimous by protocol)
+* ``det-random``      — unseeded ``random`` module calls
+* ``det-uuid``        — ``uuid.*`` / ``secrets.*`` / ``os.urandom``
+* ``det-env-read``    — ``os.environ`` reads (config drift between
+  ranks must be caught by the cross-rank check at submit, not leak
+  into fingerprints; latch at init instead)
+* ``det-hash-id``     — builtin ``hash()`` (PYTHONHASHSEED varies per
+  process) and ``id()``
+* ``det-set-iter``    — iterating a set (order varies per process);
+  wrap in ``sorted()``
+* ``det-json-unsorted`` — ``json.dumps`` without ``sort_keys=True``
+  (fingerprints must not depend on dict construction order)
+
+Calls into declared observability sinks (telemetry, timeline,
+profiler, logging) are not walked: they never feed values back into
+the agreement machinery.
+"""
+
+import ast
+
+from ..core import Checker, Finding, register
+from ..project import attr_chain
+
+SEAM_KIND = "determinism"
+
+WALLCLOCK = {"time.time", "time.time_ns", "time.localtime",
+             "time.gmtime", "time.strftime",
+             "datetime.now", "datetime.utcnow", "datetime.today",
+             "datetime.datetime.now", "datetime.datetime.utcnow",
+             "datetime.datetime.today", "datetime.date.today"}
+
+#: modules the walk never descends into (observability side channels)
+STOP_MODULE_PREFIXES = ("horovod_tpu/telemetry/",)
+STOP_MODULES = ("horovod_tpu/utils/timeline.py",
+                "horovod_tpu/utils/profiler.py",
+                "horovod_tpu/utils/clock_sync.py")
+#: attribute-call chains never walked or flagged (logging etc.)
+BENIGN_CHAIN_HEADS = ("logger.", "logging.", "warnings.")
+
+
+def _is_stop(fi):
+    rel = fi.file.rel
+    return rel in STOP_MODULES or \
+        rel.startswith(STOP_MODULE_PREFIXES)
+
+
+def _set_like(expr, local_sets):
+    if isinstance(expr, ast.Set) or isinstance(expr, ast.SetComp):
+        return True
+    if isinstance(expr, ast.Call) and \
+            isinstance(expr.func, ast.Name) and expr.func.id == "set":
+        return True
+    if isinstance(expr, ast.Name) and expr.id in local_sets:
+        return True
+    if isinstance(expr, ast.BinOp) and isinstance(
+            expr.op, (ast.BitOr, ast.BitAnd, ast.Sub)):
+        # set algebra: a | b, a & b, a - b on known sets
+        return _set_like(expr.left, local_sets) or \
+            _set_like(expr.right, local_sets)
+    return False
+
+
+@register
+class DeterminismChecker(Checker):
+    id = "det"
+    name = "determinism"
+    description = ("nondeterminism sources reachable from declared "
+                   "cross-rank agreement seams")
+
+    def run(self, project):
+        findings = []
+        seams = project.seam_functions(SEAM_KIND)
+        if not seams:
+            findings.append(Finding(
+                "det-no-seams", "<project>", 1,
+                "no `# hvdlint: seam[determinism]` declarations found"
+                " — the determinism checker has nothing to protect",
+                hint="mark the fingerprint/signature/latch entry "
+                     "points (core/bypass.py, core/store_controller"
+                     ".py, core/engine.py)"))
+            return findings
+        # BFS over the call graph, remembering which seam reached a
+        # function first (for the report)
+        queue = [(fi, fi.qualname) for fi in seams]
+        origin = {}
+        while queue:
+            fi, root = queue.pop()
+            if fi in origin:
+                continue
+            origin[fi] = root
+            self._scan(project, fi, root, findings, queue)
+        return findings
+
+    def _scan(self, project, fi, root, findings, queue):
+        pf, cls = fi.file, fi.cls
+        where = f"{pf.rel}::{fi.qualname}"
+
+        def emit(cid, node, msg, hint, slug):
+            findings.append(Finding(
+                cid, pf.rel, node.lineno, f"{msg} (reachable from "
+                f"determinism seam `{root}`)", hint=hint,
+                col=getattr(node, "col_offset", 0),
+                key=f"{cid}:{pf.rel}:{fi.qualname}:{slug}"))
+
+        # local names assigned from set-like expressions
+        local_sets = set()
+        set_iters = 0  # occurrence index: keys must not embed line numbers
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Assign) and \
+                    len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name) and \
+                    _set_like(node.value, local_sets):
+                local_sets.add(node.targets[0].id)
+
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call):
+                self._scan_call(project, fi, root, node, emit, queue)
+            elif isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, ast.Load):
+                chain = attr_chain(node.value)
+                if chain and chain.endswith("environ"):
+                    emit("det-env-read", node,
+                         f"`{chain}[...]` read inside `{where}`",
+                         "latch the value once at init() and pass it "
+                         "in; per-cycle env reads let ranks diverge",
+                         "environ-subscript")
+            iters = []
+            if isinstance(node, ast.For):
+                iters.append(node.iter)
+            elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                   ast.DictComp, ast.GeneratorExp)):
+                iters.extend(g.iter for g in node.generators)
+            for it in iters:
+                if _set_like(it, local_sets):
+                    set_iters += 1
+                    emit("det-set-iter", it,
+                         f"iteration over a set in `{where}` — "
+                         f"iteration order varies across processes",
+                         "wrap the iterable in sorted(...)",
+                         f"set-iter-{set_iters}")
+
+    def _scan_call(self, project, fi, root, node, emit, queue):
+        kind, target = project.resolve_call(fi.file, fi.cls, node)
+        where = f"{fi.file.rel}::{fi.qualname}"
+        if kind == "func":
+            if not _is_stop(target):
+                queue.append((target, root))
+            return
+        if kind == "unknown":
+            if target and target.startswith(BENIGN_CHAIN_HEADS):
+                return
+            if target and ".environ." in (target + "."):
+                tail = target.split(".")[-1]
+                if tail in ("get", "setdefault", "pop", "keys",
+                            "items", "values"):
+                    emit("det-env-read", node,
+                         f"`{target}` read inside `{where}`",
+                         "latch the value once at init() and pass "
+                         "it in", f"environ-{tail}")
+            return
+        # external call with a resolved dotted name
+        name = target
+        if name in WALLCLOCK:
+            emit("det-wallclock", node,
+                 f"wall-clock call `{name}` inside `{where}`",
+                 "ranks read different clocks; use a value agreed "
+                 "through negotiation (time.monotonic is fine for "
+                 "per-rank timeouts)", name)
+        elif name.startswith("random.") and name != "random.Random":
+            # random.Random(seed) is the hint's own recommended fix —
+            # constructing an explicitly seeded instance is fine (its
+            # method calls resolve to "unknown" and are never flagged)
+            emit("det-random", node,
+                 f"unseeded `{name}` inside `{where}`",
+                 "use an explicitly seeded random.Random shared by "
+                 "contract, or move the randomness out of the "
+                 "agreement path", name)
+        elif name.startswith("uuid.") or name.startswith("secrets.") \
+                or name == "os.urandom":
+            emit("det-uuid", node,
+                 f"process-local unique id `{name}` inside `{where}`",
+                 "ids that differ per process must not feed "
+                 "fingerprints; mint them on the coordinator", name)
+        elif name in ("os.getenv",) or name.endswith("environ.get"):
+            emit("det-env-read", node,
+                 f"`{name}` read inside `{where}`",
+                 "latch the value once at init() and pass it in",
+                 name)
+        elif name in ("hash", "id"):
+            emit("det-hash-id", node,
+                 f"builtin `{name}()` inside `{where}` — varies per "
+                 f"process (PYTHONHASHSEED / addresses)",
+                 "use hashlib over a canonical encoding", name)
+        elif name == "json.dumps":
+            kw = {k.arg: k.value for k in node.keywords}
+            sk = kw.get("sort_keys")
+            if not (isinstance(sk, ast.Constant) and
+                    sk.value is True):
+                emit("det-json-unsorted", node,
+                     f"`json.dumps` without sort_keys=True inside "
+                     f"`{where}`",
+                     "fingerprints must not depend on dict "
+                     "construction order", "json-dumps")

@@ -1,0 +1,248 @@
+"""Checker 5 — knob registry (``knob-*``).
+
+The ``HOROVOD_*`` env vars are the ABI between the launcher and the
+runtime AND the user-facing migration surface: the Horovod-to-TPU
+story depends on ``docs/migration.md`` listing every knob a user can
+set.  A knob read directly off ``os.environ`` skips the typed
+accessors (``common/env.py`` get_bool/get_int/get_float/get_str) that
+make defaults and parse failures uniform; a knob read but absent from
+the docs is a silent contract hole — a grep at ISSUE-8 time found
+dozens.
+
+``knob-direct-read``    — ``os.environ`` / ``os.getenv`` read of a
+                          ``HOROVOD_*`` key outside common/env.py.
+``knob-undocumented``   — a knob read anywhere in the runtime that
+                          appears neither in docs/migration.md nor in
+                          the declared launcher↔worker-internal list
+                          (``INTERNAL_KNOBS`` in common/env.py).
+``knob-flag-drift``     — runner/config_parser.py reads an ``args.X``
+                          that launch.py never defines (the handoff
+                          silently no-ops through getattr defaults).
+``knob-flag-unhandled`` — a launch.py flag with no config_parser env
+                          handoff and no ``_LAUNCHER_ONLY_FLAGS``
+                          declaration.
+"""
+
+import ast
+import os
+import re
+
+from ..core import Checker, Finding, register
+from ..project import attr_chain
+
+ENV_MODULE = "horovod_tpu/common/env.py"
+ACCESSORS = ("get_bool", "get_int", "get_float", "get_str")
+LAUNCH = "horovod_tpu/runner/launch.py"
+CONFIG_PARSER = "horovod_tpu/runner/config_parser.py"
+DOCS = "docs/migration.md"
+KNOB_RE = re.compile(r"^HOROVOD_[A-Z0-9_]+$")
+
+
+def _knob_from_node(project, pf, node):
+    """Resolve an expression to a HOROVOD_* knob name, or None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value if KNOB_RE.match(node.value) else None
+    if isinstance(node, ast.Name):
+        value = project.resolve_constant(pf, node.id)
+        if isinstance(value, str) and KNOB_RE.match(value):
+            return value
+        # convention: constants are named after their value
+        if KNOB_RE.match(node.id):
+            return node.id
+        return None
+    if isinstance(node, ast.Attribute) and KNOB_RE.match(node.attr):
+        # env_mod.HOROVOD_X: resolve through the module's constants
+        # (some constants alias a differently-named env var, e.g.
+        # HOROVOD_RENDEZVOUS_ADDR = "HOROVOD_GLOO_RENDEZVOUS_ADDR")
+        if isinstance(node.value, ast.Name) and \
+                node.value.id in pf.import_modules:
+            dotted = pf.import_modules[node.value.id]
+            mod = project.module_file(dotted) or \
+                project.module_file(dotted + ".__init__")
+            if mod is not None:
+                value = mod.constants.get(node.attr)
+                if isinstance(value, str) and KNOB_RE.match(value):
+                    return value
+        return node.attr
+    return None
+
+
+@register
+class KnobRegistryChecker(Checker):
+    id = "knob"
+    name = "knob-registry"
+    description = ("HOROVOD_* reads via common/env.py accessors, "
+                   "documented in docs/migration.md, launch flags "
+                   "handed off")
+
+    def run(self, project):
+        findings = []
+        reads = {}      # knob -> (rel, line) of first read
+        for pf in project.files:
+            if pf.tree is None:
+                continue
+            self._scan_file(project, pf, reads, findings)
+        self._check_docs(project, reads, findings)
+        self._check_flags(project, findings)
+        return findings
+
+    # -- reads ----------------------------------------------------------------
+
+    def _scan_file(self, project, pf, reads, findings):
+        is_env_module = pf.rel.endswith(ENV_MODULE) or \
+            pf.rel == ENV_MODULE
+
+        def record(knob, line):
+            reads.setdefault(knob, (pf.rel, line))
+
+        def direct(knob, node, what):
+            record(knob, node.lineno)
+            if not is_env_module:
+                findings.append(Finding(
+                    "knob-direct-read", pf.rel, node.lineno,
+                    f"direct {what} read of {knob}",
+                    hint="route it through a common/env.py accessor "
+                         "(get_bool/get_int/get_float/get_str) so "
+                         "defaults and parse failures are uniform "
+                         "and the knob registry sees it",
+                    key=f"knob-direct-read:{pf.rel}:{knob}"))
+
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.Call):
+                chain = attr_chain(node.func)
+                if chain and (chain.endswith("environ.get") or
+                              chain.endswith("environ.setdefault") or
+                              chain.endswith("environ.pop") or
+                              chain == "os.getenv" or
+                              chain == "getenv"):
+                    if node.args:
+                        knob = _knob_from_node(project, pf,
+                                               node.args[0])
+                        if knob:
+                            direct(knob, node, f"`{chain}`")
+                    continue
+                # accessor calls: env.get_*(NAME) / get_*(NAME)
+                tail = chain.rsplit(".", 1)[-1] if chain else None
+                if tail in ACCESSORS and node.args:
+                    knob = _knob_from_node(project, pf, node.args[0])
+                    if knob:
+                        record(knob, node.lineno)
+            elif isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, ast.Load):
+                chain = attr_chain(node.value)
+                if chain and chain.endswith("environ"):
+                    knob = _knob_from_node(project, pf, node.slice)
+                    if knob:
+                        direct(knob, node, f"`{chain}[...]`")
+            elif isinstance(node, ast.Compare) and \
+                    len(node.ops) == 1 and \
+                    isinstance(node.ops[0], (ast.In, ast.NotIn)):
+                chain = attr_chain(node.comparators[0])
+                if chain and chain.endswith("environ"):
+                    knob = _knob_from_node(project, pf, node.left)
+                    if knob:
+                        direct(knob, node, "membership-test")
+
+    # -- documentation --------------------------------------------------------
+
+    def _check_docs(self, project, reads, findings):
+        docs_path = os.path.join(project.root, DOCS)
+        try:
+            with open(docs_path, "r", encoding="utf-8") as f:
+                docs_text = f.read()
+        except OSError:
+            docs_text = None
+        env_mod = project.by_rel.get(ENV_MODULE)
+        internal = set()
+        if env_mod is not None:
+            internal = set(env_mod.constants.get("INTERNAL_KNOBS",
+                                                 ()) or ())
+        if docs_text is None:
+            if reads:
+                knob, (rel, line) = sorted(reads.items())[0]
+                findings.append(Finding(
+                    "knob-undocumented", rel, line,
+                    f"{DOCS} not found — cannot verify the knob "
+                    f"registry",
+                    key="knob-undocumented:<no-docs>"))
+            return
+        documented = set(re.findall(r"HOROVOD_[A-Z0-9_]+", docs_text))
+        for knob, (rel, line) in sorted(reads.items()):
+            if knob in documented or knob in internal:
+                continue
+            findings.append(Finding(
+                "knob-undocumented", rel, line,
+                f"{knob} is read here but appears neither in "
+                f"{DOCS} nor in common/env.py INTERNAL_KNOBS",
+                hint="add a row to the migration.md knob tables "
+                     "(user-facing) or to INTERNAL_KNOBS (launcher↔"
+                     "worker handoff ABI, with a comment saying why "
+                     "users never set it)",
+                key=f"knob-undocumented:{knob}"))
+
+    # -- launch flag handoff --------------------------------------------------
+
+    def _check_flags(self, project, findings):
+        launch = project.by_rel.get(LAUNCH)
+        parser = project.by_rel.get(CONFIG_PARSER)
+        if launch is None or parser is None or \
+                launch.tree is None or parser.tree is None:
+            return
+        dests = {}      # dest -> lineno
+        for node in ast.walk(launch.tree):
+            if not (isinstance(node, ast.Call) and
+                    isinstance(node.func, ast.Attribute) and
+                    node.func.attr == "add_argument"):
+                continue
+            dest = None
+            for k in node.keywords:
+                if k.arg == "dest" and isinstance(k.value,
+                                                  ast.Constant):
+                    dest = k.value.value
+            if dest is None:
+                longs = [a.value for a in node.args
+                         if isinstance(a, ast.Constant) and
+                         isinstance(a.value, str) and
+                         a.value.startswith("--")]
+                if longs:
+                    dest = longs[0][2:].replace("-", "_")
+                elif node.args and isinstance(node.args[0],
+                                              ast.Constant) and \
+                        not str(node.args[0].value).startswith("-"):
+                    dest = str(node.args[0].value)
+            if dest:
+                dests.setdefault(dest, node.lineno)
+        refs = set()
+        for node in ast.walk(parser.tree):
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "args":
+                refs.add(node.attr)
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id == "getattr" and \
+                    len(node.args) >= 2 and \
+                    isinstance(node.args[0], ast.Name) and \
+                    node.args[0].id == "args" and \
+                    isinstance(node.args[1], ast.Constant):
+                refs.add(node.args[1].value)
+        launcher_only = set(launch.constants.get(
+            "_LAUNCHER_ONLY_FLAGS", ()) or ())
+        for ref in sorted(refs - set(dests)):
+            findings.append(Finding(
+                "knob-flag-drift", CONFIG_PARSER, 1,
+                f"config_parser reads args.{ref} but launch.py "
+                f"defines no such flag",
+                hint="the handoff silently no-ops through getattr "
+                     "defaults — rename or remove it",
+                key=f"knob-flag-drift:{ref}"))
+        for dest in sorted(set(dests) - refs - launcher_only):
+            findings.append(Finding(
+                "knob-flag-unhandled", LAUNCH, dests[dest],
+                f"launch.py flag `{dest}` has no config_parser env "
+                f"handoff and is not declared launcher-only",
+                hint="add the HOROVOD_* handoff in config_parser."
+                     "set_env_from_args, or add the dest to "
+                     "_LAUNCHER_ONLY_FLAGS in launch.py with the "
+                     "other flags the launcher itself consumes",
+                key=f"knob-flag-unhandled:{dest}"))

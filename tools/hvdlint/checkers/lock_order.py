@@ -1,0 +1,233 @@
+"""Checker 2 — lock order & hot-path blocking (``lock-*``).
+
+The control plane's deadlock-freedom argument is a PARTIAL ORDER:
+coordinator lock (rank 0) → KV-store condition (rank 1) → journal
+lock (rank 2).  Any nested acquisition must move STRICTLY up the
+order; the journal compactor taking the store lock inside the
+coordinator lock is fine, a KV handler calling back into the
+coordinator is a deadlock waiting for two threads to interleave.
+Worker-side, the engine dispatch lock (rank 20) and the controller
+lock (rank 21) form their own tier.
+
+Locks are declared in source on their construction line::
+
+    self._lock = threading.Condition()   # hvdlint: lock[coord:0]
+
+``lock-order``     — acquiring a lock whose rank is <= the highest
+                     rank already held (out of order, or reentrant on
+                     a non-reentrant primitive).
+``lock-blocking``  — a blocking call (``time.sleep``, socket /
+                     ``http.client`` I/O, any function marked
+                     ``# hvdlint: blocking``) reached while a
+                     declared lock is held.  ``Condition.wait`` on
+                     the HELD lock is exempt — it releases.
+
+Holding is inferred from ``with self.<lock>:`` blocks and from the
+``*_locked`` naming convention (a method named ``foo_locked`` in a
+class that declares a lock is assumed to run with that lock held);
+both propagate through the intra-project call graph.  Calls the
+resolver cannot see into are ignored — conservatively, with
+``# hvdlint: acquires[<name>]`` call-site markers available to teach
+the checker about acquisitions behind attribute indirection.
+"""
+
+import ast
+
+from ..core import Checker, Finding, register
+from ..project import attr_chain
+
+BLOCKING_EXT = ("time.sleep",)
+BLOCKING_EXT_PREFIXES = ("socket.", "http.client.", "subprocess.",
+                         "urllib.")
+#: attribute-chain tails that mean "this call releases/uses the held
+#: condition", never blocking I/O
+CONDITION_METHODS = ("wait", "wait_for", "notify", "notify_all")
+
+
+@register
+class LockOrderChecker(Checker):
+    id = "lock"
+    name = "lock-order"
+    description = ("partial-order violations and blocking calls "
+                   "under declared control-plane locks")
+
+    def run(self, project):
+        findings = []
+        if not project.locks:
+            findings.append(Finding(
+                "lock-no-locks", "<project>", 1,
+                "no `# hvdlint: lock[name:rank]` declarations found "
+                "— the lock-order checker has nothing to protect",
+                hint="mark the control-plane lock constructions "
+                     "(Coordinator, KVStore, CoordJournal)"))
+            return findings
+        self.project = project
+        self.findings = findings
+        #: memo of (funcinfo, frozenset(held ranks)) already walked
+        self.visited = set()
+        # entry points: every function, starting with nothing held —
+        # with-blocks inside introduce holds; *_locked methods start
+        # with their class lock held
+        for pf in project.files:
+            for fi in pf.functions:
+                self._walk(fi)
+        return findings
+
+    # -- inference ------------------------------------------------------------
+
+    def _class_locks(self, fi):
+        """Declared locks of the function's class."""
+        if fi.cls is None:
+            return []
+        return [d for (rel, cls, _attr), d in self.project.locks.items()
+                if rel == fi.file.rel and cls == fi.cls]
+
+    def _implicit_held(self, fi):
+        """``*_locked`` methods run with their class's (single
+        declared) lock held — the codebase's naming convention."""
+        if fi.name.endswith("_locked"):
+            decls = self._class_locks(fi)
+            if len(decls) == 1:
+                return {decls[0].rank: decls[0]}
+        return {}
+
+    def _lock_of_with(self, fi, item):
+        """LockDecl for a ``with self.X:`` context item, if declared."""
+        expr = item.context_expr
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self" and fi.cls is not None:
+            return self.project.locks.get(
+                (fi.file.rel, fi.cls, expr.attr))
+        return None
+
+    def _held_lock_attrs(self, fi, held):
+        """Attribute names that hold the currently-held locks in this
+        class (for the Condition.wait exemption)."""
+        attrs = set()
+        for decl in held.values():
+            if decl.file.rel == fi.file.rel and decl.cls == fi.cls:
+                attrs.add(decl.attr)
+        return attrs
+
+    # -- the walk --------------------------------------------------------------
+
+    def _walk(self, fi):
+        """Walk one function body as an entry point: nothing held,
+        except the class lock for ``*_locked``-convention methods."""
+        held = dict(self._implicit_held(fi))
+        memo = (fi.file.rel, fi.qualname, frozenset(held))
+        if memo in self.visited:
+            return
+        self.visited.add(memo)
+        self._walk_stmts(fi, fi.node.body, dict(held))
+
+    def _acquire(self, fi, node, held, decl, via=None):
+        """Record an acquisition; returns True if it may proceed
+        (always — findings don't stop the walk)."""
+        if held:
+            top = max(held)
+            if decl.rank <= top:
+                holder = held[top]
+                kind = ("reentrant acquisition of"
+                        if decl.name == holder.name else
+                        "out-of-order acquisition of")
+                via_txt = f" via `{via}`" if via else ""
+                self.findings.append(Finding(
+                    "lock-order", fi.file.rel, node.lineno,
+                    f"{kind} lock `{decl.name}` (rank {decl.rank}) "
+                    f"while holding `{holder.name}` (rank "
+                    f"{holder.rank}) in `{fi.qualname}`{via_txt}",
+                    hint="the control plane's deadlock-freedom "
+                         "argument is the coord→store→journal "
+                         "partial order (docs/invariants.md); "
+                         "restructure so locks are taken in rank "
+                         "order",
+                    key=f"lock-order:{fi.file.rel}:{fi.qualname}:"
+                        f"{holder.name}->{decl.name}"))
+
+    def _walk_stmts(self, fi, stmts, held):
+        for stmt in stmts:
+            self._walk_node(fi, stmt, held)
+
+    def _walk_node(self, fi, node, held):
+        """Recursive walk carrying the held-lock set; ``with`` blocks
+        extend it for their body at ANY nesting depth."""
+        if isinstance(node, ast.With):
+            inner = dict(held)
+            for item in node.items:
+                self._walk_node(fi, item.context_expr, held)
+                decl = self._lock_of_with(fi, item)
+                if decl is not None:
+                    self._acquire(fi, node, inner, decl)
+                    inner[decl.rank] = decl
+            for s in node.body:
+                self._walk_node(fi, s, inner)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            return              # nested defs run later, not here
+        if isinstance(node, ast.Call):
+            self._handle_call(fi, node, held)
+        for child in ast.iter_child_nodes(node):
+            self._walk_node(fi, child, held)
+
+    def _handle_call(self, fi, node, held):
+        # call-site acquires[...] markers (attribute indirection the
+        # resolver can't see through)
+        for line, name in fi.acquires:
+            if line == node.lineno:
+                decl = self.project.locks_by_name.get(name)
+                if decl is not None:
+                    self._acquire(fi, node, held, decl)
+        kind, target = self.project.resolve_call(fi.file, fi.cls, node)
+        if kind == "func":
+            # does the callee acquire (or eventually block)?
+            self._enter(fi, node, target, held)
+            return
+        if not held:
+            return
+        chain = target if kind == "ext" else (target or "")
+        tail = chain.rsplit(".", 1)[-1] if chain else ""
+        if tail in CONDITION_METHODS:
+            return          # Condition wait/notify on a held lock
+        if kind == "ext":
+            if chain in BLOCKING_EXT or \
+                    chain.startswith(BLOCKING_EXT_PREFIXES):
+                self._blocking(fi, node, held, chain)
+
+    def _enter(self, fi, node, callee, held):
+        """Propagate held locks into an intra-project callee."""
+        if callee.blocking and held:
+            self._blocking(fi, node, held,
+                           f"{callee.qualname} (marked blocking)")
+        # acquisitions implied by the callee's own *_locked convention
+        implicit = self._implicit_held_of(callee)
+        merged = dict(held)
+        for rank, decl in implicit.items():
+            if rank not in merged:
+                # calling a *_locked method does not TAKE the lock —
+                # it asserts the caller already holds it; treat as
+                # held to keep walking, but flag if the caller holds
+                # a HIGHER rank (the assert would be violated by an
+                # out-of-order caller elsewhere; cheap heuristic)
+                merged[rank] = decl
+        memo = (callee.file.rel, callee.qualname, frozenset(merged))
+        if memo in self.visited:
+            return
+        self.visited.add(memo)
+        self._walk_stmts(callee, callee.node.body, merged)
+
+    def _implicit_held_of(self, fi):
+        return self._implicit_held(fi)
+
+    def _blocking(self, fi, node, held, what):
+        top = held[max(held)]
+        self.findings.append(Finding(
+            "lock-blocking", fi.file.rel, node.lineno,
+            f"blocking call `{what}` while holding lock "
+            f"`{top.name}` in `{fi.qualname}`",
+            hint="release the lock before I/O or sleeping — a "
+                 "blocked holder stalls every poll/dispatch on the "
+                 "hot path",
+            key=f"lock-blocking:{fi.file.rel}:{fi.qualname}:{what}"))

@@ -1,0 +1,310 @@
+"""Checker 3 — replay-safety (``replay-*``).
+
+The fabric retries requests; the coordinator restarts from its
+journal.  Both are only safe under three contracts, all declared in
+ONE place (``horovod_tpu/runner/http/contract.py``):
+
+* a TIMEOUT may be replayed only for verbs in ``REPLAY_SAFE_VERBS``
+  (+ the last-writer-wins KV pseudo-verbs) — anything else can
+  double-deliver;
+* every replay-safe verb's server handler must route through its
+  declared dedup structure (``REPLAY_DEDUP_ATTRS``) so the replay of
+  a request that DID land is answered, not re-applied;
+* every verb handler sits behind the epoch fence (rejected before the
+  verb runs after a coordinator restart) except the declared exempt
+  verbs (``clock`` — lock-free ping; ``resync`` — the fence's own
+  recovery handshake).
+
+Checks:
+
+``replay-dup-contract``   — ``REPLAY_SAFE_VERBS`` (or the other
+                            contract constants) re-defined outside
+                            the contract module.
+``replay-unsafe-verb``    — a ``_request(..., retry_timeout=True)``
+                            call whose verb is not in the contract
+                            (or whose retry predicate is not the
+                            membership test).
+``replay-no-dedup``       — a replay-safe verb handler that never
+                            touches its declared dedup structure.
+``replay-undeclared-verb``— a replay-safe verb with no dedup
+                            declaration at all.
+``replay-fence``          — a verb dispatched before the epoch fence
+                            in ``handle`` without being declared
+                            exempt.
+``replay-no-contract``    — no contract module found.
+"""
+
+import ast
+
+from ..core import Checker, Finding, register
+
+CONTRACT_NAMES = ("REPLAY_SAFE_VERBS", "REPLAY_SAFE_KV_VERBS",
+                  "EPOCH_EXEMPT_VERBS", "REPLAY_DEDUP_ATTRS",
+                  "CACHEABLE_TYPES")
+
+
+def _find_contract(project):
+    """The contract module: a file named contract.py that assigns
+    REPLAY_SAFE_VERBS."""
+    for pf in project.files:
+        if pf.rel.endswith("contract.py") and \
+                "REPLAY_SAFE_VERBS" in pf.constants:
+            return pf
+    return None
+
+
+def _self_attrs(fi, project, depth=2):
+    """Attribute names read/written on ``self`` in a method,
+    following intra-class calls ``depth`` levels deep."""
+    attrs = set()
+    seen = set()
+
+    def walk(f, d):
+        if f in seen:
+            return
+        seen.add(f)
+        for node in ast.walk(f.node):
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "self":
+                attrs.add(node.attr)
+            if d > 0 and isinstance(node, ast.Call):
+                kind, target = project.resolve_call(
+                    f.file, f.cls, node)
+                if kind == "func" and target.cls == f.cls:
+                    walk(target, d - 1)
+
+    walk(fi, depth)
+    return attrs
+
+
+@register
+class ReplaySafetyChecker(Checker):
+    id = "replay"
+    name = "replay-safety"
+    description = ("timeout-replay, dedup-routing and epoch-fence "
+                   "contracts around the coordinator verbs")
+
+    def run(self, project):
+        findings = []
+        contract = _find_contract(project)
+        if contract is None:
+            findings.append(Finding(
+                "replay-no-contract", "<project>", 1,
+                "no contract module (contract.py defining "
+                "REPLAY_SAFE_VERBS) found",
+                hint="the replay-safety invariants need one shared "
+                     "definition (see horovod_tpu/runner/http/"
+                     "contract.py)"))
+            return findings
+        safe = tuple(contract.constants.get("REPLAY_SAFE_VERBS", ()))
+        kv_safe = tuple(contract.constants.get(
+            "REPLAY_SAFE_KV_VERBS", ()))
+        exempt = tuple(contract.constants.get(
+            "EPOCH_EXEMPT_VERBS", ()))
+        dedup = dict(contract.constants.get(
+            "REPLAY_DEDUP_ATTRS", {}) or {})
+
+        self._check_duplicates(project, contract, findings)
+        self._check_client(project, safe, kv_safe, findings)
+        self._check_server(project, safe, exempt, dedup, findings)
+        return findings
+
+    # -- one definition -------------------------------------------------------
+
+    def _check_duplicates(self, project, contract, findings):
+        for pf in project.files:
+            if pf is contract or pf.tree is None:
+                continue
+            for node in pf.tree.body:
+                if not isinstance(node, ast.Assign):
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and \
+                            tgt.id in CONTRACT_NAMES:
+                        findings.append(Finding(
+                            "replay-dup-contract", pf.rel,
+                            node.lineno,
+                            f"`{tgt.id}` re-defined outside the "
+                            f"contract module ({contract.rel})",
+                            hint="import it — a drifting copy is a "
+                                 "silent replay-unsafety bug",
+                            key=f"replay-dup-contract:{pf.rel}:"
+                                f"{tgt.id}"))
+
+    # -- client side ----------------------------------------------------------
+
+    def _check_client(self, project, safe, kv_safe, findings):
+        ok_verbs = set(safe) | set(kv_safe)
+        for pf in project.files:
+            if pf.tree is None:
+                continue
+            for node in ast.walk(pf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fname = None
+                if isinstance(node.func, ast.Attribute):
+                    fname = node.func.attr
+                elif isinstance(node.func, ast.Name):
+                    fname = node.func.id
+                if fname != "_request":
+                    continue
+                kw = {k.arg: k.value for k in node.keywords}
+                rt = kw.get("retry_timeout")
+                if rt is None:
+                    continue
+                if isinstance(rt, ast.Constant):
+                    if rt.value is not True:
+                        continue
+                    verb = kw.get("verb")
+                    vname = verb.value if isinstance(
+                        verb, ast.Constant) else None
+                    if vname not in ok_verbs:
+                        findings.append(Finding(
+                            "replay-unsafe-verb", pf.rel, node.lineno,
+                            f"timeout replay enabled for verb "
+                            f"{vname!r} which is not in "
+                            f"REPLAY_SAFE_VERBS/"
+                            f"REPLAY_SAFE_KV_VERBS",
+                            hint="a replayed timeout can double-"
+                                 "deliver; add server-side dedup and "
+                                 "declare the verb in the contract, "
+                                 "or drop retry_timeout",
+                            key=f"replay-unsafe-verb:{pf.rel}:"
+                                f"{vname}"))
+                elif isinstance(rt, ast.Compare) and \
+                        len(rt.ops) == 1 and \
+                        isinstance(rt.ops[0], ast.In) and \
+                        isinstance(rt.comparators[0], ast.Name) and \
+                        rt.comparators[0].id == "REPLAY_SAFE_VERBS":
+                    continue    # the canonical membership predicate
+                else:
+                    findings.append(Finding(
+                        "replay-unsafe-verb", pf.rel, node.lineno,
+                        "retry_timeout predicate is not the "
+                        "`verb in REPLAY_SAFE_VERBS` membership "
+                        "test and cannot be verified statically",
+                        hint="gate timeout replay on the contract "
+                             "tuple so the checker (and readers) can "
+                             "audit it",
+                        key=f"replay-unsafe-verb:{pf.rel}:opaque"))
+
+    # -- server side ----------------------------------------------------------
+
+    def _coordinator_classes(self, project):
+        """Classes that look like the coordinator: define ``handle``
+        plus ``_on_*`` verb handlers."""
+        out = []
+        for pf in project.files:
+            for cls_name in pf.classes:
+                if (cls_name, "handle") in pf.methods and any(
+                        n.startswith("_on_")
+                        for (c, n) in pf.methods if c == cls_name):
+                    out.append((pf, cls_name))
+        return out
+
+    def _check_server(self, project, safe, exempt, dedup, findings):
+        for pf, cls in self._coordinator_classes(project):
+            handle = pf.methods[(cls, "handle")]
+            self._check_fence(pf, cls, handle, exempt, findings)
+            for verb in safe:
+                fi = pf.methods.get((cls, f"_on_{verb}"))
+                if fi is None:
+                    continue    # not this class's verb
+                declared = dedup.get(verb)
+                if not declared:
+                    findings.append(Finding(
+                        "replay-undeclared-verb", pf.rel,
+                        fi.node.lineno,
+                        f"replay-safe verb {verb!r} has no "
+                        f"REPLAY_DEDUP_ATTRS declaration",
+                        hint="declare which server-side structure "
+                             "dedups its replays in the contract "
+                             "module",
+                        key=f"replay-undeclared-verb:{pf.rel}:"
+                            f"{verb}"))
+                    continue
+                touched = _self_attrs(fi, project)
+                if not touched.intersection(declared):
+                    findings.append(Finding(
+                        "replay-no-dedup", pf.rel, fi.node.lineno,
+                        f"handler `_on_{verb}` never touches its "
+                        f"declared dedup structure "
+                        f"({', '.join(declared)})",
+                        hint="route the handler through the rid/jid "
+                             "dedup path — timeout replays of this "
+                             "verb double-apply otherwise",
+                        key=f"replay-no-dedup:{pf.rel}:{verb}"))
+
+    def _check_fence(self, pf, cls, handle, exempt, findings):
+        """Verbs must not be dispatched before the epoch-fence
+        statement in ``handle``."""
+        fence_seen = False
+        dispatches = []     # (verb, node, fenced)
+        for stmt in handle.node.body:
+            if isinstance(stmt, ast.If) and self._is_fence(stmt.test):
+                fence_seen = True
+                continue
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr.startswith("_on_"):
+                    verb = node.func.attr[len("_on_"):]
+                    dispatches.append((verb, node, fence_seen))
+                elif isinstance(node, ast.Return) and \
+                        not fence_seen and \
+                        isinstance(stmt, ast.If):
+                    # inline pre-fence return (e.g. the clock ping):
+                    # fine only for exempt verbs — match the literal
+                    # compared in the If test
+                    verb = self._verb_literal(stmt.test)
+                    if verb is not None and verb not in exempt:
+                        findings.append(Finding(
+                            "replay-fence", pf.rel, node.lineno,
+                            f"verb {verb!r} answered before the "
+                            f"epoch fence in `{cls}.handle`",
+                            hint="only EPOCH_EXEMPT_VERBS may skip "
+                                 "the fence; a stale-generation "
+                                 "replay would run this verb",
+                            key=f"replay-fence:{pf.rel}:{verb}"))
+        if not fence_seen:
+            findings.append(Finding(
+                "replay-fence", pf.rel, handle.node.lineno,
+                f"`{cls}.handle` has no epoch-fence check",
+                hint="reject requests whose epoch != coord_epoch "
+                     "before dispatching any verb",
+                key=f"replay-fence:{pf.rel}:<missing>"))
+            return
+        for verb, node, fenced in dispatches:
+            if not fenced and verb not in exempt:
+                findings.append(Finding(
+                    "replay-fence", pf.rel, node.lineno,
+                    f"verb {verb!r} dispatched before the epoch "
+                    f"fence in `{cls}.handle`",
+                    hint="move the dispatch after the fence or "
+                         "declare the verb in EPOCH_EXEMPT_VERBS "
+                         "with a justification",
+                    key=f"replay-fence:{pf.rel}:{verb}"))
+
+    @staticmethod
+    def _is_fence(test):
+        for node in ast.walk(test):
+            if isinstance(node, ast.Attribute) and \
+                    node.attr == "coord_epoch":
+                return True
+            if isinstance(node, ast.Name) and \
+                    node.id == "coord_epoch":
+                return True
+        return False
+
+    @staticmethod
+    def _verb_literal(test):
+        """The string literal compared against ``verb`` in an If
+        test, if any."""
+        for node in ast.walk(test):
+            if isinstance(node, ast.Compare) and \
+                    isinstance(node.left, ast.Name) and \
+                    node.left.id == "verb" and \
+                    isinstance(node.comparators[0], ast.Constant):
+                return node.comparators[0].value
+        return None

@@ -1,0 +1,292 @@
+"""Checker 4 — telemetry hygiene (``telemetry-*``).
+
+The registry keeps the FIRST registration's help/labels/buckets for a
+family, so two sites that disagree produce whichever drift wins the
+race — silently.  PR 5 hoisted the fabric/chaos/liveness family names
+into ``telemetry/__init__.py`` constants for exactly this reason;
+this checker mechanizes the rule for every family:
+
+``telemetry-dup-family``     — one family name registered with a
+                               string literal from more than one
+                               module (hoist to a shared constant).
+``telemetry-dup-const``      — two module-level constants in
+                               different modules holding the same
+                               family name.
+``telemetry-literal-family`` — a literal registration of a family
+                               that already has a shared constant
+                               (use the constant).
+``telemetry-help-drift``     — registrations of one family with
+                               different (or missing) help text.
+``telemetry-unbounded-label``— a label VALUE built by interpolation
+                               (f-string/format/%/concat): label
+                               values must come from closed sets or
+                               every distinct value mints a new
+                               Prometheus series forever.
+``telemetry-bucket-literal`` — histogram bucket bounds passed as an
+                               inline literal outside the telemetry
+                               package (bounds are per-family
+                               identity; use the shared ladders).
+``telemetry-bucket-conflict``— one family registered with textually
+                               different bucket bounds.
+"""
+
+import ast
+
+from ..core import Checker, Finding, register
+
+REG_METHODS = ("counter", "gauge", "histogram")
+TELEMETRY_PKG = "horovod_tpu/telemetry/"
+
+
+class _Reg:
+    __slots__ = ("family", "file", "line", "via_const", "const_name",
+                 "help_value", "help_missing", "buckets_src",
+                 "method")
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw.get(k))
+
+
+@register
+class TelemetryHygieneChecker(Checker):
+    id = "telemetry"
+    name = "telemetry"
+    description = ("one-definition rule for metric families, closed-"
+                   "set labels, shared bucket ladders")
+
+    def run(self, project):
+        findings = []
+        regs = []           # [_Reg]
+        consts = {}         # family value -> [(file, const name, line)]
+        for pf in project.files:
+            if pf.tree is None:
+                continue
+            for name, value in pf.constants.items():
+                if isinstance(value, str) and \
+                        value.startswith("horovod_"):
+                    node_line = self._const_line(pf, name)
+                    consts.setdefault(value, []).append(
+                        (pf, name, node_line))
+            label_counts = {}  # (label arg) -> occurrences in this file
+            for node in ast.walk(pf.tree):
+                if isinstance(node, ast.Call):
+                    reg = self._registration(project, pf, node)
+                    if reg is not None:
+                        regs.append(reg)
+                    self._check_labels(pf, node, findings,
+                                       label_counts)
+        self._check_one_definition(regs, consts, findings)
+        self._check_help(project, regs, findings)
+        self._check_buckets(regs, findings)
+        return findings
+
+    @staticmethod
+    def _const_line(pf, name):
+        for node in pf.tree.body:
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.targets[0], ast.Name) and \
+                    node.targets[0].id == name:
+                return node.lineno
+        return 1
+
+    def _registration(self, project, pf, node):
+        if not isinstance(node.func, ast.Attribute) or \
+                node.func.attr not in REG_METHODS or not node.args:
+            return None
+        first = node.args[0]
+        family = project.resolve_str_expr(pf, first)
+        if family is None or not family.startswith("horovod_"):
+            return None
+        via_const = not (isinstance(first, ast.Constant))
+        const_name = None
+        if isinstance(first, ast.Name):
+            const_name = first.id
+        elif isinstance(first, ast.Attribute):
+            const_name = first.attr
+        help_value, help_missing = None, True
+        if len(node.args) > 1:
+            help_missing = False
+            help_value = project.resolve_str_expr(pf, node.args[1])
+        else:
+            for k in node.keywords:
+                if k.arg == "help_text":
+                    help_missing = False
+                    help_value = project.resolve_str_expr(pf, k.value)
+        buckets_src = None
+        for k in node.keywords:
+            if k.arg == "buckets":
+                buckets_src = ast.unparse(k.value)
+        return _Reg(family=family, file=pf, line=node.lineno,
+                    via_const=via_const, const_name=const_name,
+                    help_value=help_value, help_missing=help_missing,
+                    buckets_src=buckets_src, method=node.func.attr)
+
+    # -- one-definition rule --------------------------------------------------
+
+    def _check_one_definition(self, regs, consts, findings):
+        by_family = {}
+        for r in regs:
+            by_family.setdefault(r.family, []).append(r)
+        for family, sites in consts.items():
+            mods = sorted({pf.rel for pf, _, _ in sites})
+            if len(mods) > 1:
+                for pf, cname, line in sites:
+                    if pf.rel != mods[0]:
+                        findings.append(Finding(
+                            "telemetry-dup-const", pf.rel, line,
+                            f"family {family!r} constant re-defined "
+                            f"here and in {mods[0]}",
+                            hint="one family, one definition site — "
+                                 "keep the constant where the family "
+                                 "is owned and import it",
+                            key=f"telemetry-dup-const:{pf.rel}:"
+                                f"{family}"))
+        for family, sites in by_family.items():
+            literal_sites = [r for r in sites if not r.via_const]
+            literal_mods = sorted({r.file.rel for r in literal_sites})
+            has_const = family in consts
+            if has_const and literal_sites:
+                cpf, cname, _ = consts[family][0]
+                for r in literal_sites:
+                    findings.append(Finding(
+                        "telemetry-literal-family", r.file.rel,
+                        r.line,
+                        f"family {family!r} registered with a "
+                        f"string literal but a shared constant "
+                        f"exists ({cpf.rel}:{cname})",
+                        hint="import the constant — literal copies "
+                             "drift",
+                        key=f"telemetry-literal-family:{r.file.rel}"
+                            f":{family}"))
+            elif len(literal_mods) > 1:
+                for r in literal_sites:
+                    findings.append(Finding(
+                        "telemetry-dup-family", r.file.rel, r.line,
+                        f"family {family!r} registered with a "
+                        f"literal in {len(literal_mods)} modules "
+                        f"({', '.join(literal_mods)})",
+                        hint="hoist the name+help into a shared "
+                             "constant (telemetry/__init__.py owns "
+                             "the cross-layer families)",
+                        key=f"telemetry-dup-family:{r.file.rel}:"
+                            f"{family}"))
+
+    # -- help drift -----------------------------------------------------------
+
+    def _check_help(self, project, regs, findings):
+        by_family = {}
+        for r in regs:
+            by_family.setdefault(r.family, []).append(r)
+        for family, sites in by_family.items():
+            helps = {r.help_value for r in sites
+                     if r.help_value not in (None, "")}
+            has_help = bool(helps)
+            if len(helps) > 1:
+                canonical = sorted(helps)[0]
+                for r in sites:
+                    if r.help_value not in (None, "", canonical):
+                        findings.append(Finding(
+                            "telemetry-help-drift", r.file.rel,
+                            r.line,
+                            f"family {family!r} registered with "
+                            f"help text differing from another "
+                            f"site's",
+                            hint="the registry keeps whichever "
+                                 "registration runs first — share "
+                                 "one help constant",
+                            key=f"telemetry-help-drift:{r.file.rel}"
+                                f":{family}"))
+            for r in sites:
+                if has_help and (r.help_missing or
+                                 r.help_value == ""):
+                    findings.append(Finding(
+                        "telemetry-help-drift", r.file.rel, r.line,
+                        f"family {family!r} registered without help "
+                        f"text here but with help elsewhere — "
+                        f"help depends on registration order",
+                        hint="pass the shared help constant at "
+                             "every registration site",
+                        key=f"telemetry-help-drift:{r.file.rel}:"
+                            f"{family}:empty"))
+
+    # -- labels ---------------------------------------------------------------
+
+    def _check_labels(self, pf, node, findings, label_counts):
+        if not isinstance(node.func, ast.Attribute) or \
+                node.func.attr != "labels":
+            return
+        # only registry children: heuristically require kwargs-only
+        # call on an attribute named labels
+        for k in node.keywords:
+            if k.arg is None:
+                continue
+            v = k.value
+            bad = None
+            if isinstance(v, ast.JoinedStr):
+                bad = "f-string"
+            elif isinstance(v, ast.BinOp) and \
+                    isinstance(v.op, (ast.Add, ast.Mod)):
+                bad = "string interpolation"
+            elif isinstance(v, ast.Call) and \
+                    isinstance(v.func, ast.Attribute) and \
+                    v.func.attr == "format":
+                bad = ".format()"
+            if bad:
+                # occurrence index, NOT a line number: baseline keys
+                # must survive unrelated edits (core.py contract)
+                n = label_counts.get(k.arg, 0) + 1
+                label_counts[k.arg] = n
+                findings.append(Finding(
+                    "telemetry-unbounded-label", pf.rel, v.lineno,
+                    f"label {k.arg!r} built by {bad} — label values "
+                    f"must come from a closed set",
+                    hint="every distinct label value mints a new "
+                         "series in every scrape forever; move "
+                         "variable data into the sample or a log "
+                         "record",
+                    key=f"telemetry-unbounded-label:{pf.rel}:"
+                        f"{k.arg}:{n}"))
+
+    # -- buckets --------------------------------------------------------------
+
+    def _check_buckets(self, regs, findings):
+        by_family = {}
+        for r in regs:
+            if r.method == "histogram":
+                by_family.setdefault(r.family, []).append(r)
+        for family, sites in by_family.items():
+            srcs = {r.buckets_src for r in sites
+                    if r.buckets_src is not None}
+            if len(srcs) > 1:
+                for r in sites:
+                    if r.buckets_src is not None:
+                        findings.append(Finding(
+                            "telemetry-bucket-conflict", r.file.rel,
+                            r.line,
+                            f"family {family!r} registered with "
+                            f"conflicting bucket bounds "
+                            f"({', '.join(sorted(srcs))})",
+                            hint="bucket bounds are per-family "
+                                 "identity (the registry raises on "
+                                 "conflict since PR 6) — share one "
+                                 "ladder constant",
+                            key=f"telemetry-bucket-conflict:"
+                                f"{r.file.rel}:{family}"))
+            for r in sites:
+                if r.buckets_src and \
+                        r.buckets_src.lstrip().startswith(
+                            ("(", "[")) and \
+                        not r.file.rel.startswith(TELEMETRY_PKG):
+                    findings.append(Finding(
+                        "telemetry-bucket-literal", r.file.rel,
+                        r.line,
+                        f"family {family!r} passes inline bucket "
+                        f"bounds",
+                        hint="use the shared ladders "
+                             "(DEFAULT_LATENCY_BUCKETS / "
+                             "REQUEST_LATENCY_BUCKETS) or define a "
+                             "named ladder next to them",
+                        key=f"telemetry-bucket-literal:{r.file.rel}"
+                            f":{family}"))
+        return findings

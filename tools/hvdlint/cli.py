@@ -1,0 +1,84 @@
+"""hvdlint CLI: analyze the tree, gate on zero NEW findings."""
+
+import argparse
+import os
+import sys
+
+from .core import (all_checkers, load_baseline, partition_new,
+                   run_checkers, save_baseline)
+from .project import Project, collect_py_files
+
+DEFAULT_PATHS = ("horovod_tpu", "tools")
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__),
+                                "baseline.json")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="hvdlint",
+        description="invariant-checking static analysis for the "
+                    "horovod_tpu control plane")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help=f"files/dirs to analyze (default: "
+                         f"{' '.join(DEFAULT_PATHS)})")
+    ap.add_argument("--root", default=os.getcwd(),
+                    help="repo root (default: cwd)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: the checked-in "
+                         "tools/hvdlint/baseline.json)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to the current "
+                         "findings and exit 0")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report and gate on "
+                         "ALL findings")
+    ap.add_argument("--checker", action="append", default=None,
+                    metavar="ID",
+                    help="run only this checker family (repeatable; "
+                         "disables the unused-suppression scan)")
+    ap.add_argument("--list-checkers", action="store_true")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="summary line only")
+    args = ap.parse_args(argv)
+
+    if args.list_checkers:
+        for cls in all_checkers():
+            print(f"{cls.id:<10} {cls.name:<14} {cls.description}")
+        return 0
+
+    root = os.path.abspath(args.root)
+    paths = args.paths or list(DEFAULT_PATHS)
+    rels = collect_py_files(root, paths)
+    if not rels:
+        print(f"hvdlint: no python files under {paths}",
+              file=sys.stderr)
+        return 2
+    project = Project(root, rels)
+    findings = run_checkers(project, checker_ids=args.checker)
+
+    if args.update_baseline:
+        save_baseline(args.baseline, findings)
+        print(f"hvdlint: baseline updated with {len(findings)} "
+              f"finding(s) -> {args.baseline}")
+        return 0
+
+    baseline = {} if args.no_baseline else \
+        load_baseline(args.baseline)
+    new, old, stale = partition_new(findings, baseline)
+    if not args.quiet:
+        for f in new:
+            print(f.render())
+        if stale:
+            print(f"hvdlint: note: {len(stale)} baseline entr"
+                  f"{'y is' if len(stale) == 1 else 'ies are'} "
+                  f"stale (fixed findings — run --update-baseline "
+                  f"to shrink the baseline)")
+    status = "FAIL" if new else "ok"
+    print(f"hvdlint: {status}: {len(new)} new finding(s), "
+          f"{len(old)} baselined, {len(project.files)} file(s), "
+          f"{len(all_checkers())} checker(s)")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

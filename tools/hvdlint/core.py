@@ -1,0 +1,202 @@
+"""hvdlint core: findings, suppressions, the baseline gate, and the
+checker registry.
+
+The gate is **zero NEW findings**: every finding carries a stable
+``key`` (checker id + file + a content slug, never a line number, so
+unrelated edits don't invalidate it), the checked-in
+``baseline.json`` maps keys to counts, and the run fails iff a key's
+current count exceeds its baselined count.  ``--update-baseline``
+rewrites the file; the shipped baseline is empty — every real finding
+the suite produced at introduction time was FIXED, not baselined
+(ISSUE 8 acceptance: determinism / lock-order / replay-safety
+violations must never be baselined).
+"""
+
+import json
+import os
+
+#: Checker ids every finding id must be prefixed by (suppression
+#: comments may name the family prefix to cover the whole checker).
+CHECKER_FAMILIES = ("det", "lock", "replay", "telemetry", "knob",
+                    "hvdlint")
+
+
+class Finding:
+    __slots__ = ("checker_id", "path", "line", "col", "message",
+                 "hint", "key")
+
+    def __init__(self, checker_id, path, line, message, hint=None,
+                 col=0, key=None):
+        self.checker_id = checker_id
+        self.path = path
+        self.line = line
+        self.col = col
+        self.message = message
+        self.hint = hint
+        # stable identity for the baseline: no line numbers
+        self.key = key or f"{checker_id}:{path}:{message}"
+
+    def render(self):
+        loc = f"{self.path}:{self.line}:{self.col}"
+        out = f"{loc}: {self.checker_id}: {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.checker_id,
+                self.message)
+
+
+class Checker:
+    """Base class; subclasses set ``id`` (family prefix) + ``name``
+    and implement ``run(project) -> [Finding]``."""
+
+    id = None
+    name = None
+    description = ""
+
+    def run(self, project):
+        raise NotImplementedError
+
+
+_REGISTRY = []
+
+
+def register(cls):
+    _REGISTRY.append(cls)
+    return cls
+
+
+def all_checkers():
+    # import for side effect: checker modules self-register
+    from . import checkers  # noqa: F401
+    return list(_REGISTRY)
+
+
+# -- suppressions ------------------------------------------------------------
+
+def _suppression_index(pf):
+    """Map line -> suppression marker for a file.  A marker on a
+    comment-only line covers the NEXT line; otherwise it covers its
+    own line."""
+    index = {}
+    for m in pf.markers_of("ignore"):
+        code = pf.lines[m.line - 1].split("#", 1)[0].strip() \
+            if m.line - 1 < len(pf.lines) else ""
+        target = m.line if code else m.line + 1
+        index[target] = m
+    return index
+
+
+def _matches(ids, checker_id):
+    for i in ids:
+        if i == "*" or i == checker_id or \
+                checker_id.startswith(i + "-"):
+            return True
+    return False
+
+
+def apply_suppressions(project, findings, full_run):
+    """Filter suppressed findings; emit meta-findings for malformed
+    suppressions, and (on a full run) for unused ones."""
+    kept, meta = [], []
+    used = set()
+    indexes = {pf.rel: _suppression_index(pf) for pf in project.files}
+    for f in findings:
+        marker = indexes.get(f.path, {}).get(f.line)
+        if marker and _matches(marker.args, f.checker_id):
+            # either way the marker DID match — it must never also be
+            # reported as unused ("matches no finding" would be false)
+            used.add((f.path, marker.line))
+            if not marker.text:
+                meta.append(Finding(
+                    "hvdlint-bad-suppression", f.path, marker.line,
+                    f"suppression of {f.checker_id} has no "
+                    f"justification",
+                    hint="write `# hvdlint: ignore[...] <why this is "
+                         "safe>` — unexplained suppressions are "
+                         "findings themselves",
+                    key=f"hvdlint-bad-suppression:{f.path}:"
+                        f"{','.join(marker.args)}"))
+                kept.append(f)
+        else:
+            kept.append(f)
+    if full_run:
+        for pf in project.files:
+            stale_counts = {}  # key must not embed line numbers
+            for line, marker in sorted(indexes.get(pf.rel,
+                                                   {}).items()):
+                if (pf.rel, marker.line) in used:
+                    continue
+                ids = ",".join(marker.args)
+                n = stale_counts.get(ids, 0) + 1
+                stale_counts[ids] = n
+                meta.append(Finding(
+                    "hvdlint-unused-suppression", pf.rel, marker.line,
+                    f"suppression ignore[{ids}] matches no finding",
+                    hint="delete it — stale suppressions hide future "
+                         "regressions",
+                    key=f"hvdlint-unused-suppression:{pf.rel}:"
+                        f"{ids}:{n}"))
+    return kept + meta
+
+
+# -- baseline ----------------------------------------------------------------
+
+BASELINE_VERSION = 1
+
+
+def load_baseline(path):
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return dict(data.get("findings", {}))
+
+
+def save_baseline(path, findings):
+    counts = {}
+    for f in findings:
+        counts[f.key] = counts.get(f.key, 0) + 1
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": BASELINE_VERSION,
+                   "findings": dict(sorted(counts.items()))},
+                  f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+def partition_new(findings, baseline):
+    """Split findings into (new, baselined) under the per-key counts
+    of the baseline."""
+    budget = dict(baseline)
+    new, old = [], []
+    for f in sorted(findings, key=Finding.sort_key):
+        if budget.get(f.key, 0) > 0:
+            budget[f.key] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    stale = sorted(k for k, n in budget.items() if n > 0)
+    return new, old, stale
+
+
+def run_checkers(project, checker_ids=None):
+    """Run (a subset of) the registered checkers over the project."""
+    findings = []
+    selected = []
+    for cls in all_checkers():
+        if checker_ids and cls.id not in checker_ids and \
+                cls.name not in checker_ids:
+            continue
+        selected.append(cls)
+    for cls in selected:
+        findings.extend(cls().run(project))
+    for pf in project.files:
+        if pf.syntax_error is not None:
+            findings.append(Finding(
+                "hvdlint-syntax-error", pf.rel,
+                pf.syntax_error.lineno or 1,
+                f"file does not parse: {pf.syntax_error.msg}"))
+    full_run = not checker_ids
+    return apply_suppressions(project, findings, full_run)

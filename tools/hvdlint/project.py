@@ -1,0 +1,405 @@
+"""Project model for hvdlint: parsed files, symbol/function indexes,
+import resolution, a conservative call-graph resolver, and the
+``# hvdlint:`` marker grammar.
+
+The analyses are deliberately CONSERVATIVE: a call that cannot be
+resolved inside the project is never walked into, and only calls that
+resolve to an explicit blacklist (or to a marker-declared function)
+produce findings.  False negatives are accepted; false positives are
+treated as checker bugs, because a lint gate people route around is
+worse than none.
+
+Marker grammar (one per comment, anywhere a ``#`` comment fits)::
+
+    # hvdlint: ignore[<id>,<id>...] <reason>     suppress findings on
+                                                 this (or the next) line
+    # hvdlint: seam[<kind>]                      declare the def on this
+                                                 (or the next) line a
+                                                 checker entry point
+    # hvdlint: lock[<name>:<rank>]               declare ``self.X = ...``
+                                                 on this line a ranked
+                                                 lock (partial order)
+    # hvdlint: acquires[<name>]                  teach the lock checker
+                                                 that the call on this
+                                                 line takes lock <name>
+    # hvdlint: blocking                          declare the def on this
+                                                 (or the next) line as
+                                                 performing blocking I/O
+"""
+
+import ast
+import io
+import os
+import re
+import tokenize
+
+MARKER_RE = re.compile(
+    r"#\s*hvdlint:\s*([\w-]+)\s*(?:\[([^\]]*)\])?\s*(.*?)\s*$")
+
+
+def _comment_lines(source):
+    """(lineno, comment_text) for every REAL comment token — markers
+    quoted inside docstrings/string literals must not count."""
+    out = []
+    try:
+        for tok in tokenize.generate_tokens(
+                io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out.append((tok.start[0], tok.string))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        # fall back to raw lines; the file likely fails ast.parse too
+        out = [(i, line) for i, line in
+               enumerate(source.splitlines(), start=1)
+               if "#" in line]
+    return out
+
+
+class Marker:
+    __slots__ = ("line", "kind", "args", "text")
+
+    def __init__(self, line, kind, args, text):
+        self.line = line          # 1-based source line
+        self.kind = kind          # ignore | seam | lock | acquires | blocking
+        self.args = args          # list of strings inside [...]
+        self.text = text          # trailing free text (ignore reason)
+
+    def __repr__(self):
+        return f"Marker({self.line}, {self.kind}, {self.args!r})"
+
+
+class FuncInfo:
+    """One function or method definition."""
+
+    __slots__ = ("file", "node", "cls", "name", "qualname",
+                 "seams", "blocking", "acquires")
+
+    def __init__(self, file, node, cls):
+        self.file = file
+        self.node = node
+        self.cls = cls            # enclosing class name or None
+        self.name = node.name
+        self.qualname = (f"{cls}.{node.name}" if cls else node.name)
+        self.seams = []           # seam kinds declared on this def
+        self.blocking = False     # marker-declared blocking I/O
+        self.acquires = []        # [(lineno, lockname)] from markers
+
+    def __repr__(self):
+        return f"<{self.file.rel}::{self.qualname}>"
+
+
+class LockDecl:
+    __slots__ = ("file", "cls", "attr", "name", "rank", "line")
+
+    def __init__(self, file, cls, attr, name, rank, line):
+        self.file = file
+        self.cls = cls
+        self.attr = attr          # instance attribute holding the lock
+        self.name = name          # declared lock name
+        self.rank = rank          # position in the global partial order
+        self.line = line
+
+
+class ProjectFile:
+    def __init__(self, path, rel, source):
+        self.path = path
+        self.rel = rel            # posix-style path relative to root
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = None
+        self.syntax_error = None
+        try:
+            self.tree = ast.parse(source)
+        except SyntaxError as exc:
+            self.syntax_error = exc
+        self.markers = []
+        for i, line in _comment_lines(source):
+            if "hvdlint:" not in line:
+                continue
+            m = MARKER_RE.search(line)
+            if m:
+                kind, rawargs, text = m.group(1), m.group(2), m.group(3)
+                args = ([a.strip() for a in rawargs.split(",")
+                         if a.strip()] if rawargs else [])
+                self.markers.append(Marker(i, kind, args, text))
+        # filled by Project._index_file
+        self.functions = []       # [FuncInfo]
+        self.func_by_name = {}    # module-level name -> FuncInfo
+        self.methods = {}         # (cls, name) -> FuncInfo
+        self.classes = {}         # cls name -> ast.ClassDef
+        self.import_modules = {}  # local alias -> dotted module
+        self.import_names = {}    # local name -> (dotted module, orig name)
+        self.constants = {}       # NAME -> constant value (str/tuple/...)
+
+    def markers_of(self, kind):
+        return [m for m in self.markers if m.kind == kind]
+
+
+def _module_of(rel):
+    """Dotted module name for a repo-relative path (``a/b/c.py`` ->
+    ``a.b.c``; packages drop ``__init__``)."""
+    mod = rel[:-3] if rel.endswith(".py") else rel
+    mod = mod.replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def attr_chain(node):
+    """Dotted text of a Name/Attribute chain, or None for anything
+    dynamic (``a.b.c`` -> "a.b.c", ``f().x`` -> None)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class Project:
+    """All parsed files plus the cross-file indexes checkers share."""
+
+    def __init__(self, root, rel_paths):
+        self.root = root
+        self.files = []
+        self.by_rel = {}
+        self.by_module = {}
+        for rel in sorted(rel_paths):
+            path = os.path.join(root, rel)
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    source = f.read()
+            except (OSError, UnicodeDecodeError):
+                continue
+            pf = ProjectFile(path, rel.replace(os.sep, "/"), source)
+            self.files.append(pf)
+            self.by_rel[pf.rel] = pf
+            self.by_module[_module_of(pf.rel)] = pf
+        self.locks = {}           # (rel, cls, attr) -> LockDecl
+        self.locks_by_name = {}   # name -> LockDecl
+        for pf in self.files:
+            if pf.tree is not None:
+                self._index_file(pf)
+
+    # -- indexing ------------------------------------------------------------
+
+    def _index_file(self, pf):
+        lock_markers = {m.line: m for m in pf.markers_of("lock")}
+        seam_markers = {}
+        for m in pf.markers_of("seam"):
+            seam_markers.setdefault(m.line, []).extend(m.args)
+        blocking_lines = {m.line for m in pf.markers_of("blocking")}
+        acquire_markers = {}
+        for m in pf.markers_of("acquires"):
+            acquire_markers.setdefault(m.line, []).extend(m.args)
+
+        for node in ast.walk(pf.tree):
+            # imports are indexed wherever they appear (function-local
+            # imports are the project idiom for cycle-breaking); the
+            # flat namespace is a deliberate approximation
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                self._index_import(pf, node)
+        for node in pf.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                try:
+                    pf.constants[node.targets[0].id] = \
+                        ast.literal_eval(node.value)
+                except (ValueError, SyntaxError):
+                    pass
+
+        class Indexer(ast.NodeVisitor):
+            def __init__(self):
+                self.cls = None
+
+            def visit_ClassDef(self, node):
+                prev, self.cls = self.cls, node.name
+                pf.classes[node.name] = node
+                self.generic_visit(node)
+                self.cls = prev
+
+            def visit_FunctionDef(self, node):
+                self._func(node)
+
+            def visit_AsyncFunctionDef(self, node):
+                self._func(node)
+
+            def _func(self, node):
+                fi = FuncInfo(pf, node, self.cls)
+                pf.functions.append(fi)
+                if self.cls is None:
+                    pf.func_by_name.setdefault(node.name, fi)
+                else:
+                    pf.methods[(self.cls, node.name)] = fi
+                for line in (node.lineno, node.lineno - 1):
+                    fi.seams.extend(seam_markers.get(line, ()))
+                    if line in blocking_lines:
+                        fi.blocking = True
+                for sub in ast.walk(node):
+                    names = acquire_markers.get(
+                        getattr(sub, "lineno", -1))
+                    if names and isinstance(sub, ast.Call):
+                        for n in names:
+                            if (sub.lineno, n) not in fi.acquires:
+                                fi.acquires.append((sub.lineno, n))
+                # nested defs are indexed but not descended for class
+                # context changes; good enough for this codebase
+                for sub in ast.iter_child_nodes(node):
+                    self.visit(sub)
+
+            def visit_Assign(self, node):
+                marker = lock_markers.get(node.lineno)
+                if marker and marker.args:
+                    target = node.targets[0]
+                    if isinstance(target, ast.Attribute) and \
+                            isinstance(target.value, ast.Name) and \
+                            target.value.id == "self":
+                        spec = marker.args[0]
+                        name, _, rank = spec.partition(":")
+                        decl = LockDecl(pf, self.cls, target.attr,
+                                        name, int(rank or 0),
+                                        node.lineno)
+                        proj.locks[(pf.rel, self.cls, target.attr)] = decl
+                        proj.locks_by_name[name] = decl
+                self.generic_visit(node)
+
+        proj = self
+        Indexer().visit(pf.tree)
+
+    def _index_import(self, pf, node):
+        pkg = _module_of(pf.rel)
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                pf.import_modules[alias.asname or
+                                  alias.name.split(".")[0]] = alias.name
+            return
+        # ImportFrom: resolve relative levels against this module
+        base = node.module or ""
+        if node.level:
+            parts = pkg.split(".")
+            # a package module (__init__) is its own package
+            if pf.rel.endswith("__init__.py"):
+                parts = parts + ["__init__"]
+            parts = parts[: -node.level]
+            base = ".".join(parts + ([base] if base else []))
+        for alias in node.names:
+            local = alias.asname or alias.name
+            sub = f"{base}.{alias.name}" if base else alias.name
+            if sub in self.by_module or \
+                    f"{sub}.__init__" in self.by_module:
+                # ``from pkg import module`` — the name IS a module
+                pf.import_modules[local] = sub
+            else:
+                pf.import_names[local] = (base, alias.name)
+
+    # -- resolution ----------------------------------------------------------
+
+    def module_file(self, dotted):
+        return self.by_module.get(dotted)
+
+    def resolve_constant(self, pf, name):
+        """Value of NAME as seen from file ``pf`` (local constant or
+        from-imported constant of a project module)."""
+        if name in pf.constants:
+            return pf.constants[name]
+        tgt = pf.import_names.get(name)
+        if tgt:
+            mod = self.module_file(tgt[0])
+            if mod is not None:
+                return mod.constants.get(tgt[1])
+        return None
+
+    def resolve_str_expr(self, pf, node):
+        """Constant string value of an expression, following Name and
+        single-level module-Attribute references; None if dynamic."""
+        if isinstance(node, ast.Constant):
+            return node.value if isinstance(node.value, str) else None
+        if isinstance(node, ast.Name):
+            value = self.resolve_constant(pf, node.id)
+            return value if isinstance(value, str) else None
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id in pf.import_modules:
+            dotted = pf.import_modules[node.value.id]
+            mod = self.module_file(dotted) or \
+                self.module_file(dotted + ".__init__")
+            if mod is not None:
+                value = mod.constants.get(node.attr)
+                return value if isinstance(value, str) else None
+        return None
+
+    def resolve_call(self, pf, cls, call):
+        """Resolve a Call conservatively.
+
+        Returns one of::
+
+            ("func", FuncInfo)   intra-project function/method
+            ("ext", "dotted.name")  external callable with known name
+            ("unknown", "attr.chain" | None)
+        """
+        func = call.func
+        if isinstance(func, ast.Name):
+            name = func.id
+            fi = pf.func_by_name.get(name)
+            if fi is not None:
+                return ("func", fi)
+            tgt = pf.import_names.get(name)
+            if tgt:
+                mod = self.module_file(tgt[0])
+                if mod is not None:
+                    sub = mod.func_by_name.get(tgt[1])
+                    if sub is not None:
+                        return ("func", sub)
+                return ("ext", f"{tgt[0]}.{tgt[1]}" if tgt[0]
+                        else tgt[1])
+            if name in pf.import_modules:
+                return ("ext", pf.import_modules[name])
+            return ("ext", name)      # builtin (hash, id, sorted, ...)
+        if isinstance(func, ast.Attribute):
+            chain = attr_chain(func)
+            if chain is None:
+                return ("unknown", None)
+            head, _, rest = chain.partition(".")
+            if head == "self" and cls is not None and rest and \
+                    "." not in rest:
+                fi = pf.methods.get((cls, rest))
+                if fi is not None:
+                    return ("func", fi)
+                return ("unknown", chain)
+            if head in pf.import_modules:
+                dotted = pf.import_modules[head]
+                mod = self.module_file(dotted)
+                if mod is not None and rest and "." not in rest:
+                    fi = mod.func_by_name.get(rest)
+                    if fi is not None:
+                        return ("func", fi)
+                return ("ext", f"{dotted}.{rest}")
+            return ("unknown", chain)
+        return ("unknown", None)
+
+    def seam_functions(self, kind):
+        out = []
+        for pf in self.files:
+            for fi in pf.functions:
+                if kind in fi.seams:
+                    out.append(fi)
+        return out
+
+
+def collect_py_files(root, paths, exclude_dirs=("__pycache__",)):
+    """Expand CLI path arguments into repo-relative ``.py`` paths."""
+    rels = []
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(full) and full.endswith(".py"):
+            rels.append(os.path.relpath(full, root))
+        elif os.path.isdir(full):
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = [d for d in dirnames
+                               if d not in exclude_dirs]
+                for fn in filenames:
+                    if fn.endswith(".py"):
+                        rels.append(os.path.relpath(
+                            os.path.join(dirpath, fn), root))
+    return sorted(set(rels))

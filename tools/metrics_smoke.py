@@ -77,8 +77,9 @@ def worker():
                   wire_dtype="int8")
 
     # per-worker endpoint: base port + proc index (docs/observability)
-    base = int(os.environ["HOROVOD_METRICS_PORT"])
-    proc = int(os.environ.get("HOROVOD_TPU_PROC_INDEX", "0"))
+    from horovod_tpu.common import env as env_mod
+    base = env_mod.require_int(env_mod.HOROVOD_METRICS_PORT)
+    proc = env_mod.get_int(env_mod.HOROVOD_TPU_PROC_INDEX, 0)
     mine = parse_prometheus(
         _scrape(f"http://127.0.0.1:{base + proc}/metrics"))
     for fam in REQUIRED:
@@ -90,8 +91,8 @@ def worker():
     hvd.barrier()
 
     if r == 0:
-        addr = os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"]
-        port = os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"]
+        addr = env_mod.require_str(env_mod.HOROVOD_RENDEZVOUS_ADDR)
+        port = env_mod.require_int(env_mod.HOROVOD_RENDEZVOUS_PORT)
         text = _scrape(f"http://{addr}:{port}/metrics")
         fams = parse_prometheus(text)
         for fam in REQUIRED:

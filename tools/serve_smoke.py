@@ -53,13 +53,14 @@ def worker():
     out_dir = os.environ["SRV_OUT"]
     stop_file = os.path.join(out_dir, "stop")
     hvd.init()
-    proc = int(os.environ.get("HOROVOD_TPU_PROC_INDEX", "0"))
+    from horovod_tpu.common import env as env_mod
+    proc = env_mod.get_int(env_mod.HOROVOD_TPU_PROC_INDEX, 0)
     if proc == 0:
         # tell the traffic driver where the job-wide /metrics lives
         with open(os.path.join(out_dir, "rdv.json"), "w") as f:
             json.dump({
-                "addr": os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"],
-                "port": os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"],
+                "addr": env_mod.require_str(env_mod.HOROVOD_RENDEZVOUS_ADDR),
+                "port": env_mod.require_int(env_mod.HOROVOD_RENDEZVOUS_PORT),
             }, f)
 
     def predict_fn(params, batch):

@@ -81,8 +81,9 @@ def worker():
 
     hvd.init()
     r = hvd.rank()
-    addr = os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"]
-    port = os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"]
+    from horovod_tpu.common import env as env_mod
+    addr = env_mod.require_str(env_mod.HOROVOD_RENDEZVOUS_ADDR)
+    port = env_mod.require_int(env_mod.HOROVOD_RENDEZVOUS_PORT)
 
     for i in range(3):
         hvd.allreduce(np.ones(1024, np.float32), name=f"ts.{i % 2}")

@@ -118,7 +118,7 @@ def wire_sweep(iters, wire_dtype="all", mb=8):
     n = int(mb * (1 << 20) / 4)
     rng = np.random.default_rng(0)
     x = rng.standard_normal(n).astype(np.float32)
-    for wire in (None, "bf16", "int8"):
+    for wire in (None, "bf16", "int8", "int4"):
         name = wire or "f32"
         hvd.allreduce(x, op=hvd.Sum, name=f"wire.w.{name}",
                       wire_dtype=wire)
@@ -150,6 +150,126 @@ def wire_sweep(iters, wire_dtype="all", mb=8):
     out["wire_reduction_vs_f32"] = round(
         out["wire_f32_engine_wire_bytes"]
         / out[f"wire_{featured}_engine_wire_bytes"], 2)
+    return out
+
+
+def wire_pair_sweep(iters, pair_spec="all", mb=8):
+    """Per-hop wire pair section (ISSUE 9): the same logical payload
+    through (inner, outer) wire pairs on the DECOMPOSED (torus)
+    engine and compiled paths, against the flat paths they replace —
+    including the STAGED int8 path (PR 1: host-side numpy encode ->
+    all_gather-of-codes program -> host decode), which the fused
+    per-hop path must beat on the 8 MiB cross-host bucket.
+
+    Single-host runs get the simulated 2-host slot map (the
+    launcher's HOROVOD_TPU_HOST_OF_RANK handoff, patched in-process)
+    so the cross (DCN) hop is real.  Reports per pair:
+
+    * ``pair_<inner>_<outer>_{engine,compiled}_MBps`` — logical
+      goodput (the autotuner's score);
+    * ``pair_<inner>_<outer>_inner_bytes`` / ``_cross_bytes`` — what
+      the per-hop accounting (horovod_wire_hop_bytes_total) says each
+      hop moved per call;
+
+    and the headline ratios: ``fused_per_hop_vs_staged_int8`` (best
+    per-hop pair over the flat staged-int8 goodput) and
+    ``per_hop_vs_flat_f32`` (the torus-vs-flat figure the per-hop
+    path must push past — docs/benchmarks.md)."""
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu import telemetry
+    from horovod_tpu.common import basics
+    from horovod_tpu.common.topology import Topology
+    from horovod_tpu.ops.quantize import (WIRE_PAIR_CHOICES,
+                                          normalize_wire_pair,
+                                          wire_pair_label)
+
+    eng = basics.engine()
+    n_ranks = hvd.size()
+    if eng.topology.num_hosts == 1 and n_ranks >= 4 \
+            and n_ranks % 2 == 0:
+        eng.topology = Topology(
+            size=n_ranks,
+            host_of_rank=[0] * (n_ranks // 2) + [1] * (n_ranks // 2))
+
+    def hop_bytes():
+        snap = telemetry.metrics().get(
+            telemetry.WIRE_HOP_BYTES_FAMILY, {})
+        out = {"inner": 0.0, "cross": 0.0}
+        for s in snap.get("samples", []):
+            hop = s.get("labels", {}).get("hop")
+            if hop in out:
+                out[hop] += s.get("value", 0.0)
+        return out
+
+    if pair_spec == "all":
+        # the quantized-DCN slice of the legal enumeration plus the
+        # full-width reference — the pairs whose cross-hop budgets
+        # docs/benchmarks.md tabulates (uniform 16-bit pairs are the
+        # --wire-dtype sweep's territory)
+        pairs = [p for p in WIRE_PAIR_CHOICES
+                 if p == (None, None) or p[1] in ("int8", "int4")]
+    else:
+        pairs = [normalize_wire_pair(*pair_spec.split(":"))]
+
+    out = {}
+    n = int(mb * (1 << 20) / 4)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal(n).astype(np.float32)
+
+    def time_engine(tag, **kw):
+        hvd.allreduce(x, op=hvd.Sum, name=f"{tag}.w", **kw)
+        h0 = hop_bytes()
+        t0 = time.perf_counter()
+        for i in range(iters):
+            hvd.allreduce(x, op=hvd.Sum, name=f"{tag}.{i % 2}", **kw)
+        dt = time.perf_counter() - t0
+        h1 = hop_bytes()
+        return (round(mb * iters / dt, 1),
+                int(h1["inner"] - h0["inner"]) // iters,
+                int(h1["cross"] - h0["cross"]) // iters)
+
+    # the flat baselines this PR's fused path is judged against:
+    # full-width flat, and PR 1's staged int8 (host codec + separate
+    # quantized program)
+    out["flat_f32_engine_MBps"], _, _ = time_engine("wp.flatf32")
+    out["staged_int8_engine_MBps"], _, _ = time_engine(
+        "wp.staged8", wire_dtype="int8")
+
+    for inner, outer in pairs:
+        label = wire_pair_label(inner, outer).replace(":", "_")
+        tag = f"pair_{label}"
+        mbps, ib, cb = time_engine(
+            f"wp.{label}", algorithm="torus",
+            wire_dtype=outer or "f32", wire_inner=inner or "f32")
+        out[f"{tag}_engine_MBps"] = mbps
+        out[f"{tag}_inner_bytes"] = ib
+        out[f"{tag}_cross_bytes"] = cb
+
+        red = hvd.CompiledGroupedAllreduce(
+            op=hvd.Sum, name=f"wp.c.{label}", force_program=True,
+            algorithm="torus", wire_dtype=outer, wire_inner=inner)
+        red([x])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            red([x])
+        dt = time.perf_counter() - t0
+        out[f"{tag}_compiled_MBps"] = round(mb * iters / dt, 1)
+        out[f"{tag}_compiled_cross_bytes"] = red.last_cross_bytes
+
+    quant = [(i, o) for i, o in pairs if o in ("int8", "int4")]
+    if quant:
+        best_pair = max(quant, key=lambda p: out[
+            f"pair_{wire_pair_label(*p).replace(':', '_')}"
+            "_engine_MBps"])
+        best_key = f"pair_{wire_pair_label(*best_pair).replace(':', '_')}"
+        out["per_hop_best_pair"] = wire_pair_label(*best_pair)
+        out["fused_per_hop_vs_staged_int8"] = round(
+            out[f"{best_key}_engine_MBps"]
+            / out["staged_int8_engine_MBps"], 2)
+        out["per_hop_vs_flat_f32"] = round(
+            out[f"{best_key}_engine_MBps"]
+            / out["flat_f32_engine_MBps"], 2)
     return out
 
 
@@ -224,6 +344,7 @@ def algo_sweep(iters, algorithm="all", sizes_mb=(1, 8, 32)):
     # algorithm for this configuration?
     from horovod_tpu.core.autotune import ParameterManager
     old_wire, old_algo = eng.config.wire_dtype, eng.config.algorithm
+    old_inner = eng.config.wire_inner
     pm = None
     if hvd.rank() == 0:
         pm = ParameterManager(eng.config, warmup_samples=2,
@@ -234,12 +355,14 @@ def algo_sweep(iters, algorithm="all", sizes_mb=(1, 8, 32)):
     for i in range(15 * 4 + 4):
         hvd.allreduce(xat, op=hvd.Sum, name=f"algo_at.{i % 2}")
     if pm is not None:
+        from horovod_tpu.ops.quantize import wire_pair_label
         eng.autotuner = None
         best = pm.best_parameters()
         out["autotune_algorithm_pick"] = best[5]
-        out["autotune_wire_pick"] = best[4] or "f32"
+        out["autotune_wire_pick"] = wire_pair_label(*best[4])
         pm.close()
         eng.config.wire_dtype, eng.config.algorithm = old_wire, old_algo
+        eng.config.wire_inner = old_inner
     return out
 
 
@@ -446,11 +569,19 @@ def main():
     p.add_argument("--small-count", type=int, default=64)
     p.add_argument("--iters", type=int, default=5)
     p.add_argument("--wire-dtype", default=None,
-                   choices=["f32", "bf16", "int8", "all"],
+                   choices=["f32", "bf16", "int8", "int4", "all"],
                    help="run the quantized-wire sweep (engine + "
-                        "compiled paths, all three dtypes measured; "
-                        "the chosen dtype is featured in "
-                        "wire_reduction_vs_f32)")
+                        "compiled paths, every dtype measured; the "
+                        "chosen dtype is featured in "
+                        "wire_reduction_vs_f32).  As a per-call knob "
+                        "this remains the UNIFORM shorthand for a "
+                        "per-hop pair (--wire-pair)")
+    p.add_argument("--wire-pair", default=None,
+                   help="run the per-hop pair sweep: 'inner:outer' "
+                        "(e.g. bf16:int4) or 'all' — decomposed "
+                        "torus engine+compiled paths vs the flat "
+                        "staged-int8 baseline, with per-hop byte "
+                        "accounting (docs/benchmarks.md)")
     p.add_argument("--algorithm", default=None,
                    choices=["flat", "hier", "hierarchical", "torus",
                             "all"],
@@ -504,6 +635,8 @@ def main():
             algo = "hierarchical" if args.algorithm == "hier" \
                 else args.algorithm
             return algo_sweep(args.iters, algo, tuple(sizes))
+        if args.wire_pair:
+            return wire_pair_sweep(args.iters, args.wire_pair)
         if args.wire_dtype:
             return wire_sweep(args.iters, args.wire_dtype)
         return worker(sizes, args.small_count, args.iters)

@@ -108,7 +108,16 @@ HOROVOD_COORD_JOURNAL_KV_MAX_BYTES = \
     "HOROVOD_COORD_JOURNAL_KV_MAX_BYTES"
 
 # TPU-native additions
-HOROVOD_WIRE_DTYPE = "HOROVOD_WIRE_DTYPE"      # f32 | fp16 | bf16 | int8
+# uniform wire shorthand: one format for every hop (a 16-bit value
+# applies to both hops of a decomposed reduction; int8/int4 apply to
+# the cross hop only — the inner hop stays full width)
+HOROVOD_WIRE_DTYPE = "HOROVOD_WIRE_DTYPE"  # f32|fp16|bf16|int8|int4
+# per-hop wire pair (docs/concepts.md "Per-hop wire"): INNER is the
+# fast intra-host/ICI hop (f32 | fp16 | bf16 — quantized formats are
+# never legal there), OUTER the slow cross-host/DCN hop (f32 | fp16 |
+# bf16 | int8 | int4).  OUTER wins over the WIRE_DTYPE shorthand.
+HOROVOD_WIRE_INNER = "HOROVOD_WIRE_INNER"
+HOROVOD_WIRE_OUTER = "HOROVOD_WIRE_OUTER"
 # flat | hierarchical | torus (generic spelling; the reference's
 # HOROVOD_HIERARCHICAL_ALLREDUCE / HOROVOD_TORUS_ALLREDUCE booleans
 # above are honored as aliases)
@@ -280,12 +289,20 @@ class Config:
         self.pack_mt_threshold_bytes = get_int(
             HOROVOD_TPU_PACK_MT_THRESHOLD, 8 << 20)
         self.cache_capacity = get_int(HOROVOD_CACHE_CAPACITY, DEFAULT_CACHE_CAPACITY)
-        # default wire format for float allreduce/reducescatter payloads
-        # (per-request wire_dtype overrides; autotune sweeps this as its
-        # fifth dimension).  None = full-width tensor dtype.
-        from ..ops.quantize import normalize_wire_dtype
+        # default wire formats for float allreduce/reducescatter
+        # payloads (per-request wire_dtype=/wire_inner= override;
+        # autotune sweeps the per-hop PAIR as one categorical).
+        # wire_dtype is the OUTER (cross-host/DCN) hop — or the only
+        # hop of a flat collective; wire_inner the intra-host/ICI hop.
+        # HOROVOD_WIRE_DTYPE stays as the uniform shorthand (the
+        # engine expands a 16-bit value onto both hops); an explicit
+        # HOROVOD_WIRE_OUTER wins over it.  None = full width.
+        from ..ops.quantize import (normalize_inner_wire,
+                                    normalize_wire_dtype)
         self.wire_dtype = normalize_wire_dtype(
-            get_str(HOROVOD_WIRE_DTYPE))
+            get_str(HOROVOD_WIRE_OUTER) or get_str(HOROVOD_WIRE_DTYPE))
+        self.wire_inner = normalize_inner_wire(
+            get_str(HOROVOD_WIRE_INNER))
         # default reduction algorithm for float Sum/Average allreduces
         # (per-request algorithm= overrides; autotune sweeps this as
         # its sixth dimension).  The reference's boolean toggles

@@ -9,11 +9,15 @@ long the background thread batches submissions), the
 multithreaded-pack threshold (bucket size above which the native pack
 fans out across threads), the coordinator response-cache capacity
 (the reference tunes cache on/off, parameter_manager.h:65; here the
-LRU size tunes smoothly with 0 = disabled), the WIRE DTYPE
-(f32 / bf16 / block-scaled int8, ops/quantize.py), and the reduction
-ALGORITHM (flat / hierarchical / torus, common/topology.py — the
-reference's HOROVOD_HIERARCHICAL_ALLREDUCE / HOROVOD_TORUS_ALLREDUCE
-toggles as one swept categorical).  The score is LOGICAL bytes/sec —
+LRU size tunes smoothly with 0 = disabled), the per-hop WIRE PAIR
+((inner, outer) — full width / 16-bit on the intra-host/ICI hop,
+anything up to block-scaled int4 on the cross-host/DCN hop,
+ops/quantize.py WIRE_PAIR_CHOICES: a LEGAL-PAIR ENUMERATION swept as
+ONE categorical, not a cross product — intra-hop int4 is never
+legal, so the grid never proposes it), and the reduction ALGORITHM
+(flat / hierarchical / torus, common/topology.py — the reference's
+HOROVOD_HIERARCHICAL_ALLREDUCE / HOROVOD_TORUS_ALLREDUCE toggles as
+one swept categorical).  The score is LOGICAL bytes/sec —
 gradient goodput — so shrinking the wire payload (or keeping it off
 the cross-host hop) raises the score exactly when the interconnect,
 not the chip, is the bottleneck: that is how the parameter manager
@@ -30,7 +34,7 @@ import numpy as np
 
 from .optim import BayesianOptimizer
 from ..common.topology import ALGORITHMS
-from ..ops.quantize import WIRE_CHOICES
+from ..ops.quantize import WIRE_PAIR_CHOICES, wire_pair_label
 
 # log2 bounds: fusion threshold 1 MiB .. 256 MiB, cycle 0.5 .. 32 ms,
 # MT-pack threshold 1 MiB .. 64 MiB, cache capacity 0 .. 4096 entries
@@ -69,13 +73,14 @@ class ParameterManager:
             config.fusion_threshold_bytes, config.cycle_time_ms,
             getattr(config, "pack_mt_threshold_bytes", 8 << 20),
             getattr(config, "cache_capacity", 1024),
-            getattr(config, "wire_dtype", None),
+            (getattr(config, "wire_inner", None),
+             getattr(config, "wire_dtype", None)),
             getattr(config, "algorithm", None))
         self._best_score = -np.inf
         self._best = self._current
         self._log = open(log_path, "w") if log_path else None
         if self._log:
-            wire_col = "wire_dtype," if self.tune_wire else ""
+            wire_col = "wire_pair," if self.tune_wire else ""
             algo_col = "algorithm," if self.tune_algorithm else ""
             self._log.write(
                 "sample,fusion_threshold_bytes,cycle_time_ms,"
@@ -85,7 +90,7 @@ class ParameterManager:
     # -- encoding ------------------------------------------------------------
 
     def _encode(self, fusion_bytes, cycle_ms, pack_mt_bytes,
-                cache_capacity, wire_dtype=None, algorithm=None):
+                cache_capacity, wire_pair=None, algorithm=None):
         x0 = (np.log2(max(fusion_bytes, 1)) - _FUSION_LO) / \
             (_FUSION_HI - _FUSION_LO)
         x1 = (np.log2(max(cycle_ms, 2 ** _CYCLE_LO)) - _CYCLE_LO) / \
@@ -95,16 +100,36 @@ class ParameterManager:
         x3 = np.log2(cache_capacity + 1) / _CACHE_BITS
         xs = [x0, x1, x2, x3]
         if self.tune_wire:
-            # fifth dimension: wire dtype as a categorical grid over
-            # [0, 1] (WIRE_CHOICES at bin centers — the BO's continuous
-            # suggestion snaps to the nearest bin in _decode); an
-            # explicit 'f32' default encodes as the full-width bin
+            # fifth dimension: the per-hop (inner, outer) wire pair as
+            # a categorical grid over [0, 1] (WIRE_PAIR_CHOICES at bin
+            # centers — the BO's continuous suggestion snaps to the
+            # nearest legal pair in _decode; quantized inner hops are
+            # not in the enumeration, so the tuner can never propose
+            # one).  Seeds canonicalize to the enumeration's spelling:
+            # an unset inner INHERITS a 16-bit outer (the uniform
+            # shorthand lands on the uniform bin), while an explicit
+            # 'f32' inner keeps the cross-hop-only bin; an 'f32'
+            # outer encodes as full width.  'f32' is only a distinct
+            # spelling AGAINST a 16-bit outer — against a quantized or
+            # unset outer the inner hop runs full width either way
+            # (effective_inner_wire), so those seeds land on the
+            # matching (None, outer) bin, and an API-legal 16-bit
+            # inner the grid does not enumerate (e.g. fp16 over a
+            # quantized outer) seeds its byte-equivalent 16-bit bin.
+            inner, outer = wire_pair or (None, None)
+            outer = None if outer == "f32" else outer
+            if inner is None and outer in ("fp16", "bf16"):
+                inner = outer
+            elif inner == "f32" and outer not in ("fp16", "bf16"):
+                inner = None
             try:
-                wi = WIRE_CHOICES.index(
-                    None if wire_dtype == "f32" else wire_dtype)
+                wi = WIRE_PAIR_CHOICES.index((inner, outer))
             except ValueError:
-                wi = 0
-            xs.append((wi + 0.5) / len(WIRE_CHOICES))
+                if inner in ("fp16", "bf16") and outer in ("int8", "int4"):
+                    wi = WIRE_PAIR_CHOICES.index(("bf16", outer))
+                else:
+                    wi = 0
+            xs.append((wi + 0.5) / len(WIRE_PAIR_CHOICES))
         if self.tune_algorithm:
             # sixth dimension: reduction algorithm over the same kind
             # of categorical grid; an unset default encodes as flat
@@ -125,8 +150,9 @@ class ParameterManager:
         out = [fusion, cycle, pack_mt, cache]
         i = 4
         if self.tune_wire:
-            wi = min(int(x[i] * len(WIRE_CHOICES)), len(WIRE_CHOICES) - 1)
-            out.append(WIRE_CHOICES[wi])
+            wi = min(int(x[i] * len(WIRE_PAIR_CHOICES)),
+                     len(WIRE_PAIR_CHOICES) - 1)
+            out.append(WIRE_PAIR_CHOICES[wi])
             i += 1
         if self.tune_algorithm:
             ai = min(int(x[i] * len(ALGORITHMS)), len(ALGORITHMS) - 1)
@@ -165,7 +191,7 @@ class ParameterManager:
         i = 4
         wire = algo = ""
         if self.tune_wire:
-            wire = decoded[i] or "f32"
+            wire = wire_pair_label(*decoded[i])
             i += 1
         if self.tune_algorithm:
             algo = decoded[i]
@@ -191,7 +217,7 @@ class ParameterManager:
             i = 4
             wire_col = ""
             if self.tune_wire:
-                wire_col = f"{decoded[i] or 'f32'},"
+                wire_col = f"{wire_pair_label(*decoded[i])},"
                 i += 1
             algo_col = f"{decoded[i]}," if self.tune_algorithm else ""
             self._log.write(
@@ -228,7 +254,13 @@ class ParameterManager:
         self.config.cache_capacity = cache
         i = 4
         if self.tune_wire:
-            self.config.wire_dtype = decoded[i]
+            # one categorical, both halves applied at one instant —
+            # the engine's per-entry latch (submit) then freezes the
+            # pair per negotiation so a mid-submit flip can never
+            # split one tensor across wire formats
+            inner, outer = decoded[i]
+            self.config.wire_inner = inner
+            self.config.wire_dtype = outer
             i += 1
         if self.tune_algorithm:
             self.config.algorithm = decoded[i]

@@ -65,7 +65,8 @@ class NegotiationEntry:
     IncrementTensorCount)."""
 
     __slots__ = ("key", "subs", "first_time", "wire_default",
-                 "algo_default", "ready_ts", "trace_id", "meta_fp")
+                 "wire_inner_default", "algo_default", "ready_ts",
+                 "trace_id", "meta_fp")
 
     def __init__(self, key):
         self.key = key
@@ -80,7 +81,10 @@ class NegotiationEntry:
         # between two ranks' submits of the same tensor cannot split
         # one negotiation across two wire formats
         self.wire_default = None
-        # ditto for the reduction algorithm (config.algorithm)
+        # ditto for the inner (ICI) hop of the per-hop wire pair
+        # (config.wire_inner) and the reduction algorithm
+        # (config.algorithm)
+        self.wire_inner_default = None
         self.algo_default = None
         # timeline-clock instant this entry became locally ready (the
         # flow-event "s" anchor) and its job-unique trace id
@@ -267,7 +271,12 @@ class Engine:
             labelnames=("algorithm",))
         self._m_quantized = m.counter(
             "horovod_quantized_buckets_total",
-            "Buckets executed over the block-scaled int8 wire")
+            "Buckets executed over a block-scaled quantized "
+            "(int8/int4) wire")
+        self._m_hop = m.counter(
+            telemetry.WIRE_HOP_BYTES_FAMILY,
+            telemetry.WIRE_HOP_BYTES_HELP,
+            labelnames=telemetry.WIRE_HOP_BYTES_LABELS)
         self._m_fused_ag = m.counter(
             "horovod_fused_allgather_runs_total",
             "Fused allgather buckets executed")
@@ -790,6 +799,8 @@ class Engine:
             if entry is None:
                 entry = NegotiationEntry(key)
                 entry.wire_default = self.config.wire_dtype
+                entry.wire_inner_default = getattr(
+                    self.config, "wire_inner", None)
                 entry.algo_default = getattr(
                     self.config, "algorithm", None)
                 ps.pending[key] = entry
@@ -807,6 +818,14 @@ class Engine:
                 # cross-rank wire check loudly instead of executing
                 # different collective programs against each other
                 req.wire_dtype = entry.wire_default
+            if (req.wire_inner is None and entry.wire_inner_default
+                    and req.request_type == RequestType.ALLREDUCE
+                    and req.reduce_op in (ReduceOp.SUM,
+                                          ReduceOp.AVERAGE)):
+                # same latch for the inner-hop wire: the per-hop pair
+                # is tuned as ONE categorical (core/autotune.py), so
+                # both halves resolve at the same instant
+                req.wire_inner = entry.wire_inner_default
             if (req.algorithm is None and entry.algo_default
                     and req.request_type == RequestType.ALLREDUCE
                     and req.reduce_op in (ReduceOp.SUM,
@@ -1153,6 +1172,7 @@ class Engine:
             "pre": req.prescale_factor,
             "post": req.postscale_factor,
             "wire": req.wire_dtype,
+            "wi": req.wire_inner,
             "algo": req.algorithm,
             "ps": ps.id,
             "nbytes": nbytes,
@@ -1548,7 +1568,7 @@ class Engine:
             reduce_op=ReduceOp(meta["op"]),
             prescale_factor=meta["pre"], postscale_factor=meta["post"],
             process_set_id=meta["ps"], wire_dtype=meta.get("wire"),
-            algorithm=meta.get("algo"))
+            wire_inner=meta.get("wi"), algorithm=meta.get("algo"))
         dtype = np.dtype(meta["dtype"]) if meta["dtype"] != "bfloat16" \
             else _bfloat16_dtype()
         sub = Submission(rank=-1, request=req, names=[key],
@@ -1620,6 +1640,12 @@ class Engine:
                     f"Mismatched wire dtypes for {first.tensor_name}: "
                     f"rank {sub.rank} sent {r.wire_dtype}, rank "
                     f"{subs[0].rank} sent {first.wire_dtype}")
+            if r.wire_inner != first.wire_inner:
+                return TensorShapeMismatchError(
+                    f"Mismatched inner wire dtypes for "
+                    f"{first.tensor_name}: rank {sub.rank} sent "
+                    f"{r.wire_inner}, rank {subs[0].rank} sent "
+                    f"{first.wire_inner}")
             if r.algorithm != first.algorithm:
                 return TensorShapeMismatchError(
                     f"Mismatched algorithms for {first.tensor_name}: "
@@ -1705,6 +1731,7 @@ class Engine:
                        first.request.prescale_factor,
                        first.request.postscale_factor,
                        first.request.wire_dtype,
+                       first.request.wire_inner,
                        first.request.algorithm)
                 nbytes = sum(p.nbytes for p in first.payloads)
             elif rt == RequestType.ALLGATHER:
@@ -1905,22 +1932,36 @@ class Engine:
         self._m_actual.labels(wire=w).inc(int(actual))
         self._m_cross.labels(wire=w).inc(int(cross))
 
-    def _encode_int8_rows(self, rows, logical_nbytes):
-        """Block-quantize per-rank rows for the int8 wire (shared by
-        the allreduce and reducescatter paths) and account the actual
-        bytes: int8 codes + bf16 scales, the codec's 2 B/block."""
+    def _account_hop(self, hop, wire, nbytes):
+        """Per-hop byte accounting (telemetry WIRE_HOP_BYTES_FAMILY):
+        ``hop`` is the decomposition stage ('inner' = the fast
+        ICI stage, 'cross' = the slow DCN stage), ``wire`` that hop's
+        encoding — the split that shows WHERE a per-hop pair actually
+        spends its bytes."""
+        self._m_hop.labels(hop=hop, wire=wire or "f32").inc(
+            int(nbytes))
+
+    def _encode_quantized_rows(self, rows, logical_nbytes, wire):
+        """Block-quantize per-rank rows for the int8 or int4 wire
+        (shared by the allreduce and reducescatter paths) and account
+        the actual bytes: codes + bf16 scales — 1 B/elem for int8,
+        0.5 B/elem (packed nibbles) for int4.  Returns
+        (q_rows, s_rows, n_elems) where n_elems is the padded element
+        count of the code layout."""
         from ..ops import quantize as qz
+        encode = qz.np_quantize_blockwise_int4 if wire == "int4" \
+            else qz.np_quantize_blockwise
         q_rows, s_rows = [], []
         with profiler.annotate("hvd_quantize_encode"):
             for r in rows:
-                q, s, _ = qz.np_quantize_blockwise(r)
+                q, s, _ = encode(r)
                 q_rows.append(q)
                 s_rows.append(s)
         self._account_wire(logical_nbytes,
                            q_rows[0].nbytes + s_rows[0].nbytes,
-                           wire="int8")
+                           wire=wire)
         self._m_quantized.inc()
-        return q_rows, s_rows
+        return q_rows, s_rows, s_rows[0].size * qz.BLOCK
 
     def _algo_plan(self, ps, req, op):
         """Effective (algorithm, inner-axis size) for an allreduce
@@ -1954,11 +1995,20 @@ class Engine:
             return "flat", None
         return algo, inner
 
+    def _inner_wire_for(self, req, outer, dtype):
+        """Effective INNER (ICI) hop wire for a decomposed reduction
+        (the one uniform-shorthand rule,
+        quantize.effective_inner_wire)."""
+        from ..ops import quantize as qz
+        return qz.effective_inner_wire(req.wire_inner, outer,
+                                       dtype.itemsize)
+
     def _dispatch_allreduce(self, ps, req, op, dtype, rows, total):
-        """Run the fused allreduce over the configured wire format AND
-        algorithm: full width, 16-bit cast, or block-scaled int8
+        """Run the fused allreduce over the configured wire PAIR and
+        algorithm: full width, 16-bit cast, or block-scaled int8/int4
         (encode -> quantized collective -> f32 decode) x flat /
-        hierarchical / torus (ops/xla_ops.allreduce_2d)."""
+        hierarchical / torus (ops/xla_ops.allreduce_2d, which fuses
+        the per-hop codecs into the one decomposed program)."""
         wire = self._wire_for(req, dtype, op)
         algo, inner = self._algo_plan(ps, req, op)
         self._m_algo.labels(algorithm=algo).inc()
@@ -1966,10 +2016,13 @@ class Engine:
         if algo != "flat":
             return self._dispatch_allreduce_2d(
                 ps, req, op, dtype, rows, total, wire, inner)
-        flat_cross = total * itemsize if self._spans_hosts(ps) else 0
+        spans = self._spans_hosts(ps)
+        flat_hop = "cross" if spans else "inner"
+        flat_cross = total * itemsize if spans else 0
         if wire is None:
             self._account_wire(total * itemsize, total * itemsize,
                                cross=flat_cross)
+            self._account_hop(flat_hop, None, total * itemsize)
             return ps.executor.allreduce(
                 rows, op, req.prescale_factor, req.postscale_factor)
         if wire in ("fp16", "bf16"):
@@ -1978,55 +2031,73 @@ class Engine:
             self._account_wire(total * itemsize, total * 2,
                                cross=total * 2 if flat_cross else 0,
                                wire=wire)
+            self._account_hop(flat_hop, wire, total * 2)
             out = ps.executor.allreduce(
                 [r.astype(wdt) for r in rows], op,
                 req.prescale_factor, req.postscale_factor)
             return [o.astype(dtype) for o in out]
-        q_rows, s_rows = self._encode_int8_rows(rows, total * itemsize)
+        q_rows, s_rows, npad = self._encode_quantized_rows(
+            rows, total * itemsize, wire)
+        self._account_hop(flat_hop, wire,
+                          q_rows[0].nbytes + s_rows[0].nbytes)
         out = ps.executor.allreduce_quantized(
             q_rows, s_rows, op, req.prescale_factor,
-            req.postscale_factor)
+            req.postscale_factor, nbits=4 if wire == "int4" else 8,
+            n_elems=npad)
         with profiler.annotate("hvd_quantize_decode"):
             return [o[:total].astype(dtype) for o in out]
 
     def _dispatch_allreduce_2d(self, ps, req, op, dtype, rows, total,
                                wire, inner):
-        """Hierarchical / torus bucket: reducescatter along the fast
-        (inner) axis, allreduce the 1/inner shard along the slow
-        (outer) axis — quantized when the wire says int8 — allgather
-        back.  Cross-hop accounting shows the decomposition's whole
-        point: only the shard crosses DCN.  Like the flat branch,
-        cross bytes are attributed only when the set actually spans
-        hosts — a single-host torus run has no DCN hop, and counting
-        one would invert the flat-vs-torus comparison the field
-        exists for."""
+        """Hierarchical / torus bucket with the PER-HOP wire pair:
+        reducescatter along the fast (inner) axis over the inner
+        wire, allreduce the 1/inner shard along the slow (outer)
+        axis over the outer wire — shared-scale quantized integer
+        partials for int8/int4, the codec fused into the one
+        compiled program (ops/xla_ops._build_allreduce_2d) — then
+        allgather back over the inner wire.  Cross-hop accounting
+        shows the decomposition's whole point: only the shard crosses
+        DCN, at the outer wire's width.  Like the flat branch, cross
+        bytes are attributed only when the set actually spans hosts —
+        a single-host torus run has no DCN hop, and counting one
+        would invert the flat-vs-torus comparison the field exists
+        for.  The hop family accounts both stages unconditionally
+        (the inner stage is real traffic either way)."""
         from ..ops import quantize as qz
         itemsize = dtype.itemsize
         m = -(-total // inner)          # cross-hop shard elements
         spans = self._spans_hosts(ps)
-        if wire in ("fp16", "bf16"):
-            wdt = np.dtype(np.float16) if wire == "fp16" \
-                else _bfloat16_dtype()
-            self._account_wire(total * itemsize, total * 2,
-                               cross=m * 2 if spans else 0, wire=wire)
-            out = ps.executor.allreduce_2d(
-                [r.astype(wdt) for r in rows], op,
-                req.prescale_factor, req.postscale_factor, inner)
-            return [o.astype(dtype) for o in out]
-        if wire == "int8":
-            # local hops ship full width (ICI is cheap); the cross hop
-            # ships shared-scale integer partials + bf16 scales
-            cross = qz.quantized_psum_wire_nbytes(m, ps.size // inner)
-            self._account_wire(total * itemsize, total * itemsize,
+        inner_wire = self._inner_wire_for(req, wire, dtype)
+        iw_width = 2 if inner_wire else itemsize
+        # the inner stage moves the payload twice: the psum_scatter
+        # into shards and the all_gather back
+        self._account_hop("inner", inner_wire, 2 * total * iw_width)
+        if wire in ("int8", "int4"):
+            bits = 4 if wire == "int4" else 8
+            # local hops ship the inner wire (ICI is cheap); the cross
+            # hop ships shared-scale integer partials + bf16 scales
+            cross = qz.quantized_psum_wire_nbytes(
+                m, ps.size // inner, bits=bits)
+            self._account_wire(total * itemsize, total * iw_width,
                                cross=cross if spans else 0, wire=wire)
+            self._account_hop("cross", wire, cross)
             self._m_quantized.inc()
-            return ps.executor.allreduce_2d(
+            out = ps.executor.allreduce_2d(
                 rows, op, req.prescale_factor, req.postscale_factor,
-                inner, wire="int8")
-        self._account_wire(total * itemsize, total * itemsize,
-                           cross=m * itemsize if spans else 0)
-        return ps.executor.allreduce_2d(
-            rows, op, req.prescale_factor, req.postscale_factor, inner)
+                inner, inner_wire=inner_wire, outer_wire=wire)
+            return [o.astype(dtype, copy=False) for o in out]
+        if wire in ("fp16", "bf16"):
+            cross = m * 2
+        else:
+            cross = m * itemsize
+        self._account_wire(total * itemsize, total * iw_width
+                           if (inner_wire or wire) else total * itemsize,
+                           cross=cross if spans else 0, wire=wire)
+        self._account_hop("cross", wire, cross)
+        out = ps.executor.allreduce_2d(
+            rows, op, req.prescale_factor, req.postscale_factor,
+            inner, inner_wire=inner_wire, outer_wire=wire)
+        return [o.astype(dtype, copy=False) for o in out]
 
     def _global_dim0s(self, ps, entry, aux, n_tensors):
         """Global per-rank first-dim table for allgather.  Local mode
@@ -2226,15 +2297,20 @@ class Engine:
                         flat[src:src + chunks[j] * rest_n]
                 rows.append(buf)
             wire = self._wire_for(req, np.dtype(rows[0].dtype), op)
-            if wire == "int8":
+            if wire in ("int8", "int4"):
                 dtype = rows[0].dtype
-                q_rows, s_rows = self._encode_int8_rows(
-                    rows, rows[0].nbytes)
+                q_rows, s_rows, npad = self._encode_quantized_rows(
+                    rows, rows[0].nbytes, wire)
+                self._account_hop(
+                    "cross" if self._spans_hosts(ps) else "inner",
+                    wire, q_rows[0].nbytes + s_rows[0].nbytes)
                 results = [
                     res.astype(dtype)
                     for res in ps.executor.reducescatter_quantized(
                         q_rows, s_rows, d0, rest, op,
-                        req.prescale_factor, req.postscale_factor)
+                        req.prescale_factor, req.postscale_factor,
+                        nbits=4 if wire == "int4" else 8,
+                        n_elems=npad)
                 ]
             else:
                 if wire in ("fp16", "bf16"):

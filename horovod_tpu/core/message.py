@@ -80,10 +80,19 @@ class Request:
     process_set_id: int = 0
     splits: Optional[Tuple[int, ...]] = None  # alltoall send splits
     # wire compression for the payload of THIS collective:
-    # None (= tensor dtype) | 'fp16' | 'bf16' | 'int8' (block-scaled,
-    # ops/quantize.py).  Cross-rank validated like dtype — ranks
-    # disagreeing on the wire format would mis-decode each other.
+    # None (= tensor dtype) | 'fp16' | 'bf16' | 'int8' | 'int4'
+    # (block-scaled, ops/quantize.py).  Cross-rank validated like
+    # dtype — ranks disagreeing on the wire format would mis-decode
+    # each other.  Under a 2-D decomposition this is the OUTER
+    # (cross-host / DCN) hop's format; flat collectives have one hop
+    # and this is it.
     wire_dtype: Optional[str] = None
+    # INNER (intra-host / ICI) hop wire for decomposed allreduces:
+    # None (= uniform-shorthand expansion of wire_dtype, or full
+    # width) | 'f32' (explicit full width) | 'fp16' | 'bf16'.  The
+    # quantized formats are never legal here (ops/quantize.py
+    # INNER_WIRE_CHOICES).  Cross-rank validated like wire_dtype.
+    wire_inner: Optional[str] = None
     # reduction algorithm for THIS collective: None (= process-wide
     # default) | 'flat' | 'hierarchical' | 'torus'
     # (common/topology.py).  Cross-rank validated like wire_dtype —
@@ -111,6 +120,7 @@ class Request:
             "gs": [list(s) for s in self.group_shapes]
             if self.group_shapes is not None else None,
             "w": self.wire_dtype,
+            "wi": self.wire_inner,
             "alg": self.algorithm,
         }
 
@@ -132,6 +142,7 @@ class Request:
             group_shapes=tuple(tuple(s) for s in d["gs"])
             if d.get("gs") is not None else None,
             wire_dtype=d.get("w"),
+            wire_inner=d.get("wi"),
             algorithm=d.get("alg"),
         )
 

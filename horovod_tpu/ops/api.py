@@ -19,7 +19,7 @@ from ..core.message import (
     Average, Sum, Adasum, Min, Max, Product, ReduceOp, Request, RequestType,
     normalize_dtype,
 )
-from .quantize import normalize_wire_dtype
+from .quantize import normalize_inner_wire, normalize_wire_dtype
 
 __all__ = [
     "allreduce", "allreduce_async", "allreduce_", "allreduce_async_",
@@ -104,7 +104,7 @@ def _check_scale(dtype, prescale_factor, postscale_factor):
 def allreduce_async(tensor, average=None, name=None, op=None,
                     prescale_factor=1.0, postscale_factor=1.0,
                     process_set=global_process_set, wire_dtype=None,
-                    algorithm=None):
+                    algorithm=None, wire_inner=None):
     arr, kind = util.to_numpy(tensor)
     ctx = basics.context()
     op = _resolve_op(op, average, arr.dtype)
@@ -116,6 +116,7 @@ def allreduce_async(tensor, average=None, name=None, op=None,
         reduce_op=op, prescale_factor=prescale_factor,
         postscale_factor=postscale_factor, process_set_id=_ps_id(process_set),
         wire_dtype=normalize_wire_dtype(wire_dtype),
+        wire_inner=normalize_inner_wire(wire_inner),
         algorithm=normalize_algorithm(algorithm))
     h = _submit(req, [arr], [name])
     h.kind = kind
@@ -125,10 +126,10 @@ def allreduce_async(tensor, average=None, name=None, op=None,
 def allreduce(tensor, average=None, name=None, op=None,
               prescale_factor=1.0, postscale_factor=1.0,
               process_set=global_process_set, wire_dtype=None,
-              algorithm=None):
+              algorithm=None, wire_inner=None):
     h = allreduce_async(tensor, average, name, op, prescale_factor,
                         postscale_factor, process_set, wire_dtype,
-                        algorithm)
+                        algorithm, wire_inner)
     return synchronize(h)
 
 
@@ -200,7 +201,8 @@ class _MultiHandle:
 def grouped_allreduce_async(tensors, average=None, name=None, op=None,
                             prescale_factor=1.0, postscale_factor=1.0,
                             process_set=global_process_set,
-                            wire_dtype=None, algorithm=None):
+                            wire_dtype=None, algorithm=None,
+                            wire_inner=None):
     """Grouped ops negotiate and execute as one unit (reference
     EnqueueTensorAllreduces, operations.cc:1408; group_table.h).
     Mixed-dtype groups partition into one fused submission per dtype
@@ -230,7 +232,7 @@ def grouped_allreduce_async(tensors, average=None, name=None, op=None,
             sub = _grouped_allreduce_uniform(
                 [arrs[i] for i in idxs], average, f"{base}.{dt}", op,
                 prescale_factor, postscale_factor, process_set, ctx,
-                wire_dtype, algorithm)
+                wire_dtype, algorithm, wire_inner)
             parts.append(sub)
             index_lists.append(idxs)
         h = _MultiHandle(parts, index_lists, len(arrs))
@@ -239,14 +241,15 @@ def grouped_allreduce_async(tensors, average=None, name=None, op=None,
     h = _grouped_allreduce_uniform(arrs, average, base, op,
                                    prescale_factor, postscale_factor,
                                    process_set, ctx, wire_dtype,
-                                   algorithm)
+                                   algorithm, wire_inner)
     h.kind = kinds
     return h
 
 
 def _grouped_allreduce_uniform(arrs, average, base, op, prescale_factor,
                                postscale_factor, process_set, ctx,
-                               wire_dtype=None, algorithm=None):
+                               wire_dtype=None, algorithm=None,
+                               wire_inner=None):
     op = _resolve_op(op, average, arrs[0].dtype)
     _check_scale(arrs[0].dtype, prescale_factor, postscale_factor)
     names = [f"{base}.{i}" for i in range(len(arrs))]
@@ -258,6 +261,7 @@ def _grouped_allreduce_uniform(arrs, average, base, op, prescale_factor,
         process_set_id=_ps_id(process_set), group_id=0,
         group_shapes=tuple(tuple(a.shape) for a in arrs),
         wire_dtype=normalize_wire_dtype(wire_dtype),
+        wire_inner=normalize_inner_wire(wire_inner),
         algorithm=normalize_algorithm(algorithm))
     h = _submit(req, arrs, names)
     h.grouped = True
@@ -267,10 +271,10 @@ def _grouped_allreduce_uniform(arrs, average, base, op, prescale_factor,
 def grouped_allreduce(tensors, average=None, name=None, op=None,
                       prescale_factor=1.0, postscale_factor=1.0,
                       process_set=global_process_set, wire_dtype=None,
-                      algorithm=None):
+                      algorithm=None, wire_inner=None):
     h = grouped_allreduce_async(tensors, average, name, op, prescale_factor,
                                 postscale_factor, process_set, wire_dtype,
-                                algorithm)
+                                algorithm, wire_inner)
     return synchronize(h)
 
 

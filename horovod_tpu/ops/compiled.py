@@ -188,6 +188,20 @@ def _caller_pos(eng, ps):
 # here (cleared on shutdown via reset_compiled_state)
 _RDV_REGISTRY = {}
 _RDV_LOCK = threading.Lock()
+# per-hop error-feedback residuals (device-resident, sharded over the
+# decomposition mesh), keyed (ef, executor uid, rendezvous tag, sig):
+# shared across the equivalent per-rank reducer instances that meet at
+# one rendezvous, cleared by reset_ef_state / reset_compiled_state
+_EF_STATE = {}
+_EF_LOCK = threading.Lock()
+
+
+def reset_ef_state():
+    """Drop all per-hop error-feedback device residuals (elastic
+    resets, checkpoint restores — the frontends' reset_wire_state
+    hooks call this so a resized mesh starts from zero residuals)."""
+    with _EF_LOCK:
+        _EF_STATE.clear()
 _STEP_COUNTERS = {}
 # per-(ps, tag) count of distinct signatures already validated across
 # processes — the Nth new signature on every process must match
@@ -360,7 +374,7 @@ class CompiledGroupedAllreduce:
                  postscale_factor=1.0, process_set=global_process_set,
                  name=None, force_program=False, wire_dtype=None,
                  error_feedback=False, algorithm=None,
-                 topology_hint=None):
+                 topology_hint=None, wire_inner=None):
         op = ReduceOp(op)
         if op not in (Average, Sum):
             raise ValueError(
@@ -391,31 +405,35 @@ class CompiledGroupedAllreduce:
         if topology_hint is not None and self.algorithm in (None, "flat"):
             self.algorithm = "torus"
         # wire compression INSIDE the one program: 'bf16'/'fp16' cast
-        # the fusion buffer for the psum; 'int8' emits the EQuARX-style
-        # quantize -> psum-of-int16-partials -> dequantize sequence
-        # with a SHARED (pmax'd) per-block scale, so the partial sums
-        # are exact integers (R * 127 fits int16 up to R=258; int32
-        # beyond) and decode with one multiply.  Still one cached XLA
-        # program per signature — no per-step retrace.  There is no
-        # ambient default here, so an explicit 'f32' collapses to
-        # full width.
+        # the fusion buffer for the psum; 'int8'/'int4' emit the
+        # EQuARX-style quantize -> psum-of-integer-partials ->
+        # dequantize sequence with a SHARED (pmax'd) per-block scale,
+        # so the partial sums are exact integers (int8 wire: int16 to
+        # R=258; int4 wire: int8 to R=18, int16 to R=4681 — the
+        # exact-rank bounds ops/quantize.py documents) and decode with
+        # one multiply.  Still one cached XLA program per signature —
+        # no per-step retrace.  There is no ambient default here, so
+        # an explicit 'f32' collapses to full width.  Under a
+        # decomposition, ``wire_dtype`` is the OUTER (cross/DCN) hop
+        # format and ``wire_inner`` the ICI hop's (None expands the
+        # uniform shorthand: 16-bit outer applies to both hops,
+        # quantized outer leaves the inner hop full width).
         self.wire_dtype = quantize_mod.normalize_wire_dtype(wire_dtype)
         if self.wire_dtype == "f32":
             self.wire_dtype = None
-        # error feedback (EF21-style): the program also returns the
-        # shared scales; callers' local quantization error
+        self.wire_inner = quantize_mod.normalize_inner_wire(wire_inner)
+        # error feedback (EF21-style).  Flat: the program also returns
+        # the shared scales; callers' local quantization error
         # x - deq(q(x)) is reconstructed host-side and added into the
-        # next call's payload, so the quantization bias cancels over
-        # steps instead of accumulating into the trained weights
+        # next call's payload.  Decomposed (per-hop): quantization
+        # error exists only on the cross-hop SHARD, so the program
+        # carries the residual as DEVICE state — an extra sharded
+        # input/output pair per quantized buffer (quantize.
+        # quantized_psum_ef_xla), never leaving the mesh.  Either
+        # way the bias cancels over steps instead of accumulating
+        # into the trained weights.
         self.error_feedback = bool(error_feedback) \
-            and self.wire_dtype == "int8"
-        if self.error_feedback and self.algorithm not in (None, "flat"):
-            # EF residuals are reconstructed from the program's
-            # returned full-buffer scales; a decomposed program only
-            # quantizes the cross-hop SHARD, whose scales do not map
-            # back onto the caller's payload
-            raise ValueError(
-                "error_feedback requires the flat algorithm")
+            and self.wire_dtype in ("int8", "int4")
         self._residuals = {}     # (sig, pos, buf_idx) -> f32 residual
         #: wire accounting for the most recent call (collective_bench)
         self.last_logical_bytes = 0
@@ -447,9 +465,10 @@ class CompiledGroupedAllreduce:
         return [(d, groups[d]) for d in order]
 
     def _wire_use(self, dtype):
-        """Effective wire format for one plan buffer: float buffers
-        follow the configured wire; 16-bit wires are a no-op for
-        already-16-bit tensors; int buffers always ship full width."""
+        """Effective (outer / only-hop) wire format for one plan
+        buffer: float buffers follow the configured wire; 16-bit
+        wires are a no-op for already-16-bit tensors; int buffers
+        always ship full width."""
         if not _is_float(dtype):
             return None
         use = self.wire_dtype
@@ -457,6 +476,23 @@ class CompiledGroupedAllreduce:
                                                       "bfloat16"):
             return None
         return use
+
+    def _inner_wire_use(self, dtype):
+        """Effective INNER (ICI) hop wire for one plan buffer under a
+        decomposition (the one uniform-shorthand rule,
+        quantize.effective_inner_wire)."""
+        if not _is_float(dtype):
+            return None
+        itemsize = 2 if str(dtype) in ("float16", "bfloat16") \
+            else np.dtype(dtype).itemsize
+        return quantize_mod.effective_inner_wire(
+            self.wire_inner, self.wire_dtype, itemsize)
+
+    def _ef_indices(self, plan):
+        """Plan-buffer indices that carry a per-hop EF residual under
+        a decomposed program (the quantized float buffers)."""
+        return [k for k, (d, _) in enumerate(plan)
+                if self._wire_use(d) in ("int8", "int4")]
 
     def _resolve_hint(self, eng, ps, ex):
         """Effective :class:`TopologyHint` for this call, or ``None``
@@ -484,22 +520,32 @@ class CompiledGroupedAllreduce:
                             sizes=(ex.num_ranks // inner, inner))
 
     def _build_2d(self, ex, plan, hint):
-        """Topology-aware variant of :meth:`_build`: per dtype buffer,
-        reducescatter along the inner (fast) axis, allreduce of the
-        1/inner shard along the outer (slow) axis — 16-bit cast or
-        shared-scale int8 integer partials when the wire says so —
-        then allgather back, all nested inside the ONE cached XLA
-        program.  The reference's NCCLHierarchicalAllreduce / torus
-        allreduce (nccl_operations.cc:606-830) done as compiler-visible
-        named-axis collectives."""
+        """Topology-aware variant of :meth:`_build` with the PER-HOP
+        wire pair: per dtype buffer, reducescatter along the inner
+        (fast) axis over the inner wire, allreduce of the 1/inner
+        shard along the outer (slow) axis over the outer wire —
+        16-bit cast or shared-scale int8/int4 integer partials, the
+        codec fused into the hop — then allgather back over the inner
+        wire, all nested inside the ONE cached XLA program.  The
+        reference's NCCLHierarchicalAllreduce / torus allreduce
+        (nccl_operations.cc:606-830) done as compiler-visible
+        named-axis collectives.
+
+        With ``error_feedback`` the program grows one sharded
+        residual input/output per quantized buffer: the cross-hop
+        shard's quantization error (quantize.quantized_psum_ef_xla)
+        is added into the next call's shard and re-measured, all as
+        device state that never leaves the mesh — the per-hop EF21."""
         R = ex.num_ranks
         op, pre, post = self.op, self.prescale, self.postscale
         inner, outer = hint.inner, hint.outer
         ax_out, ax_in = hint.axes
         mesh = ex.mesh2d(inner, hint.axes)
+        ef_idx = self._ef_indices(plan) if self.error_feedback else []
 
-        def reduce_buf_2d(x, dtype):
-            # x: (1, 1, n) — this device's slice of one fusion buffer
+        def reduce_buf_2d(x, dtype, res):
+            # x: (1, 1, n) — this device's slice of one fusion buffer;
+            # res: (1, 1, npad/inner) EF residual shard or None
             n = x.shape[-1]
             npad = -(-n // inner) * inner
             fl = _is_float(dtype)
@@ -509,48 +555,73 @@ class CompiledGroupedAllreduce:
                 raise ValueError("Average needs floating-point tensors")
             if npad != n:
                 x = jnp.pad(x, ((0, 0), (0, 0), (0, npad - n)))
-            # stage 1 (inner / ICI): reducescatter to 1/inner shards
+            iw = self._inner_wire_use(dtype)
+            iwdt = None
+            if iw is not None:
+                iwdt = jnp.bfloat16 if iw == "bf16" else jnp.float16
+                x = x.astype(jnp.float32).astype(iwdt)
+            # stage 1 (inner / ICI): reducescatter to 1/inner shards,
+            # over the inner wire
             y = lax.psum_scatter(x, ax_in, scatter_dimension=2,
                                  tiled=True)
             # stage 2 (outer / DCN): allreduce the shard only, over
-            # the wire format
+            # the outer wire
             use = self._wire_use(dtype)
-            if use == "int8":
-                y = quantize_mod.quantized_psum_xla(y, ax_out, outer) \
-                    .astype(dtype)
+            new_res = None
+            if use in ("int8", "int4"):
+                bits = 8 if use == "int8" else 4
+                yf = y.astype(jnp.float32)
+                if res is not None:
+                    # per-hop error feedback: inject last call's
+                    # cross-hop quantization error, measure this one
+                    yf = yf + res
+                    y, new_res = quantize_mod.quantized_psum_ef_xla(
+                        yf, ax_out, outer, bits=bits)
+                else:
+                    y = quantize_mod.quantized_psum_xla(
+                        yf, ax_out, outer, bits=bits)
+                y = y.astype(dtype)
             elif use in ("bf16", "fp16"):
                 wdt = jnp.bfloat16 if use == "bf16" else jnp.float16
                 y = lax.psum(y.astype(jnp.float32).astype(wdt), ax_out) \
                     .astype(jnp.float32).astype(dtype)
             else:
-                y = lax.psum(y, ax_out)
+                # full-width outer: re-widen a 16-bit inner shard so
+                # the DCN psum accumulates at the tensor dtype (the
+                # inner cast narrows ONLY the ICI hop)
+                if iwdt is not None:
+                    y = y.astype(dtype)
+                y = lax.psum(y, ax_out).astype(dtype)
             scale = post / R if op == Average else post
             if fl and scale != 1.0:
                 y = (y.astype(jnp.float32) * np.float32(scale)) \
                     .astype(dtype)
-            # stage 3 (inner / ICI): allgather the reduced shards back
+            # stage 3 (inner / ICI): allgather the reduced shards
+            # back, again over the inner wire
+            if iwdt is not None:
+                y = y.astype(jnp.float32).astype(iwdt)
             y = lax.all_gather(y, ax_in, axis=2, tiled=True)
-            return y[..., :n].reshape(n)
+            return y[..., :n].reshape(n).astype(dtype), new_res
 
         dtypes = [d for d, _ in plan]
 
-        def body(*bufs):
-            outs = tuple(reduce_buf_2d(b, d)
-                         for b, d in zip(bufs, dtypes))
-            if self.wire_dtype is None:
-                return outs
-            # keep the wire-path program contract (outs + scales);
-            # decomposed programs quantize only the cross-hop shard,
-            # whose scales do not map onto the caller's payload —
-            # error feedback is rejected at construction
-            return outs + tuple(jnp.zeros((0,), jnp.float32)
-                                for _ in plan)
+        def body(*args):
+            bufs = args[:len(plan)]
+            res_by_idx = dict(zip(ef_idx, args[len(plan):]))
+            outs, new_ress = [], []
+            for k, (b, d) in enumerate(zip(bufs, dtypes)):
+                o, nr = reduce_buf_2d(b, d, res_by_idx.get(k))
+                outs.append(o)
+                if k in res_by_idx:
+                    new_ress.append(nr)
+            return tuple(outs) + tuple(new_ress)
 
         prog = shard_map(
             body, mesh=mesh,
-            in_specs=tuple(P(ax_out, ax_in) for _ in plan),
-            out_specs=tuple(P() for _ in plan) *
-            (1 if self.wire_dtype is None else 2),
+            in_specs=tuple(P(ax_out, ax_in) for _ in plan) +
+            tuple(P(ax_out, ax_in) for _ in ef_idx),
+            out_specs=tuple(P() for _ in plan) +
+            tuple(P(ax_out, ax_in) for _ in ef_idx),
             check_vma=False)
         return jax.jit(prog)
 
@@ -598,14 +669,15 @@ class CompiledGroupedAllreduce:
                 y = y * np.float32(scale)
             return y.astype(dtype)
 
-        def reduce_int8(x, dtype):
-            # quantize -> psum of int32 partials -> dequantize, all
+        def reduce_quantized(x, dtype, bits):
+            # quantize -> psum of integer partials -> dequantize, all
             # inside this one cached program (EQuARX, arXiv:2506.17615):
             # the per-block scale is SHARED across ranks (pmax of the
             # local absmax, bf16-rounded like the wire format), so
-            # every rank's int8 codes live on one grid and their
+            # every rank's codes live on one grid and their
             # integer-accumulated psum decodes with a single multiply.
             # pre/post fold into the final dequantize scale (linear).
+            qmax = quantize_mod.quantized_qmax(bits)
             n = x.shape[-1]
             nb = -(-n // BLOCK)
             padn = nb * BLOCK - n
@@ -622,16 +694,18 @@ class CompiledGroupedAllreduce:
                 shared = lax.pmax(absmax16, "hvd")       # (1, nb)
             else:
                 shared = jnp.max(absmax16, axis=0, keepdims=True)
-            scale = (shared.astype(jnp.float32) / np.float32(127.0)) \
+            scale = (shared.astype(jnp.float32) / np.float32(qmax)) \
                 .astype(jnp.bfloat16).astype(jnp.float32)
             safe = jnp.where(scale > 0, scale, np.float32(1.0))
-            q = jnp.clip(jnp.round(xb / safe[..., None]), -127, 127)
-            # partial sums are exact in int16 while R * 127 fits
-            # (R <= 258) — 2 B/element on the interconnect instead of
-            # int32's 4 B; the codes themselves are int8, so the psum
-            # operand width IS the wire cost of this path
+            q = jnp.clip(jnp.round(xb / safe[..., None]), -qmax, qmax)
+            # partial sums ride the narrowest exact accumulator
+            # (quantize.quantized_acc_dtype_np: int8 wire — int16 to
+            # R=258; int4 wire — int8 to R=18, HALF the int8 path's
+            # psum operand): that operand width IS the wire cost of
+            # this path
             if ex.shard_mode:
-                acc = jnp.int16 if R <= 258 else jnp.int32
+                acc = jnp.dtype(quantize_mod.quantized_acc_dtype_np(
+                    bits, R))
                 y32 = lax.psum(q.astype(acc), "hvd")
             else:
                 # stacked mode is single-process: no wire, accumulate
@@ -647,8 +721,9 @@ class CompiledGroupedAllreduce:
 
         def reduce_buf(x, dtype):
             use = self._wire_use(dtype)
-            if use == "int8":
-                return reduce_int8(x, dtype)
+            if use in ("int8", "int4"):
+                return reduce_quantized(x, dtype,
+                                        8 if use == "int8" else 4)
             if use in ("bf16", "fp16"):
                 y = reduce_cast16(x, dtype, use)
             else:
@@ -705,21 +780,31 @@ class CompiledGroupedAllreduce:
                 # the engine re-initialized or the process set was
                 # rebuilt: programs compiled for the old mesh/world
                 # size would silently mis-average — drop them (and the
-                # error-feedback residuals: they belong to the old
-                # training run; see docs/concepts.md on the residual
+                # error-feedback residuals, flat AND per-hop: they
+                # belong to the old training run and the old mesh's
+                # shard shapes; see docs/concepts.md on the residual
                 # lifecycle across elastic resets)
                 self._programs.clear()
                 self._validated.clear()
                 self._residuals.clear()
+                old_uid = getattr(self._ex, "_compiled_uid", None)
+                if old_uid is not None:
+                    with _EF_LOCK:
+                        for k in [k for k in _EF_STATE
+                                  if k[1] == old_uid]:
+                            del _EF_STATE[k]
                 self._ex = ex
             hkey = hint.key() if hint is not None else None
             entry = self._programs.get((sig, hkey))
             if entry is None:
                 # the TopologyHint (axes + sizes) is part of the cache
-                # key: the same tensors under a different decomposition
-                # are a different XLA program
+                # key — the same tensors under a different
+                # decomposition are a different XLA program — and so
+                # are both halves of the wire pair and the EF mode
+                # (per-hop EF changes the program arity)
                 key = ("reduce", _ex_uid(ex), int(self.op), self.prescale,
-                       self.postscale, self.wire_dtype, hkey, sig)
+                       self.postscale, self.wire_dtype, self.wire_inner,
+                       self.error_feedback, hkey, sig)
                 entry = _shared_program(
                     key, lambda: self._build(ex, plan, hint))
                 self._programs[(sig, hkey)] = entry
@@ -788,17 +873,19 @@ class CompiledGroupedAllreduce:
             use = self._wire_use(dtype)
             if hint is not None:
                 m = -(-n // hint.inner)
-                wire += n * itemsize
-                if use == "int8":
+                iw = self._inner_wire_use(dtype)
+                wire += n * (2 if iw else itemsize)
+                if use in ("int8", "int4"):
                     cross += quantize_mod.quantized_psum_wire_nbytes(
-                        m, hint.outer)
+                        m, hint.outer, bits=8 if use == "int8" else 4)
                 elif use in ("bf16", "fp16"):
                     cross += m * 2
                 else:
                     cross += m * itemsize
-            elif use == "int8":
+            elif use in ("int8", "int4"):
                 nb = -(-n // quantize_mod.BLOCK)
-                per = 2 if num_ranks <= 258 else 4
+                per = quantize_mod.quantized_acc_dtype_np(
+                    8 if use == "int8" else 4, num_ranks).itemsize
                 wire += n * per + nb * 2
             else:
                 wire += quantize_mod.wire_nbytes(n, use, itemsize)
@@ -819,12 +906,14 @@ class CompiledGroupedAllreduce:
         self.last_algorithm = "flat" if hint is None else self.algorithm
 
     def _apply_residuals(self, sig, pos, bufs, plan):
-        """Error feedback, inject side: add the previous call's local
-        quantization error into this call's payload (EF21)."""
+        """Error feedback, inject side (flat programs): add the
+        previous call's local quantization error into this call's
+        payload (EF21)."""
         out = []
         for k, (buf, (dtype, _)) in enumerate(zip(bufs, plan)):
             r = self._residuals.get((sig, pos, k))
-            if r is None or self._wire_use(dtype) != "int8":
+            if r is None or self._wire_use(dtype) not in ("int8",
+                                                          "int4"):
                 out.append(buf)
             else:
                 out.append((buf.astype(np.float32) + r)
@@ -832,16 +921,66 @@ class CompiledGroupedAllreduce:
         return out
 
     def _update_residuals(self, sig, pos, bufs, scales, plan):
-        """Error feedback, measure side: re-encode this rank's payload
-        against the program's returned SHARED scales (deterministic —
-        same math as the device) and store x - decode(encode(x))."""
+        """Error feedback, measure side (flat programs): re-encode
+        this rank's payload against the program's returned SHARED
+        scales (deterministic — same math as the device) and store
+        x - decode(encode(x))."""
         for k, (buf, (dtype, _)) in enumerate(zip(bufs, plan)):
+            use = self._wire_use(dtype)
             s = np.asarray(scales[k], np.float32).reshape(-1)
-            if s.size == 0 or self._wire_use(dtype) != "int8":
+            if s.size == 0 or use not in ("int8", "int4"):
                 continue
             x = buf.astype(np.float32).ravel()
-            deq = quantize_mod.np_fake_quantize_with_scales(x, s)
+            deq = quantize_mod.np_fake_quantize_with_scales(
+                x, s, qmax=quantize_mod.quantized_qmax(
+                    8 if use == "int8" else 4))
             self._residuals[(sig, pos, k)] = x - deq
+
+    def _hop_residuals(self, ex, sig, tag, plan, hint):
+        """Device-resident per-hop EF residuals for one (program,
+        signature): fetched from the process-global registry (the
+        rendezvous leader alternates between equivalent per-rank
+        instances, so instance state would go stale), zero-initialized
+        with the program's (outer, inner, shard) sharding on first
+        use.  Keyed by executor uid: an elastic rebuild gets fresh
+        zeros — stale residual shapes from the old world size can
+        never be injected (reset_wire_state / reset_compiled_state
+        clear the registry outright)."""
+        key = ("ef", _ex_uid(ex), tag, sig)
+        with _EF_LOCK:
+            ress = _EF_STATE.get(key)
+            if ress is None:
+                mesh = ex.mesh2d(hint.inner, hint.axes)
+                sh = NamedSharding(mesh, P(*hint.axes))
+                ress = []
+                for k in self._ef_indices(plan):
+                    n = sum(size for _, size, _ in plan[k][1])
+                    m2 = -(-n // hint.inner)
+                    shape = (hint.outer, hint.inner, m2)
+                    ress.append(jax.make_array_from_callback(
+                        shape, sh,
+                        lambda idx, _s=shape: np.zeros(
+                            tuple(len(range(*sl.indices(dim)))
+                                  for sl, dim in zip(idx, _s)),
+                            np.float32)))
+                _EF_STATE[key] = ress
+            return key, ress
+
+    @staticmethod
+    def _store_hop_residuals(key, ress):
+        with _EF_LOCK:
+            _EF_STATE[key] = list(ress)
+
+    def reset_wire_state(self):
+        """Drop every error-feedback residual this reducer holds —
+        host-side flat residuals AND the process-global per-hop
+        device residuals.  Call when the gradient stream is
+        discontinuous (elastic resize, checkpoint restore) so stale
+        errors from the old run are never injected into the new one
+        (docs/concepts.md, residual lifecycle)."""
+        with self._lock:
+            self._residuals.clear()
+        reset_ef_state()
 
     def __call__(self, arrays):
         arrays = [np.asarray(a) for a in arrays]
@@ -866,8 +1005,14 @@ class CompiledGroupedAllreduce:
         n_local = len(ex.local_positions)
         timeline = eng.timeline
         tag = ("reduce", int(self.op), self.prescale, self.postscale,
-               self.name, self.wire_dtype,
+               self.name, self.wire_dtype, self.wire_inner,
+               self.error_feedback,
                hint.key() if hint is not None else None)
+        hop_ef = self.error_feedback and hint is not None
+        ef_key = ef_ress = None
+        if hop_ef:
+            ef_key, ef_ress = self._hop_residuals(ex, sig, tag, plan,
+                                                  hint)
 
         def launch(slot_values):
             # slot_values: {pos: (sig, [buf per dtype])} — the leader
@@ -904,12 +1049,18 @@ class CompiledGroupedAllreduce:
                             rows, hint.inner, hint.axes))
                     else:
                         staged.append(self._stage(ex, rows))
+                if hop_ef:
+                    # per-hop EF: the device residuals ride as extra
+                    # sharded operands; the program returns their
+                    # successors after the outs
+                    staged.extend(ef_ress)
                 return prog(*staged)
 
         my_bufs = self._pack(arrays, plan)
+        flat_ef = self.error_feedback and hint is None
         if n_local == 1:
             pos = ex.local_positions[0]
-            if self.error_feedback:
+            if flat_ef:
                 my_bufs = self._apply_residuals(sig, pos, my_bufs, plan)
             out = launch({pos: (sig, my_bufs)})
         else:
@@ -918,14 +1069,16 @@ class CompiledGroupedAllreduce:
                 raise ValueError(
                     "unbound caller: compiled collectives need a rank "
                     "context (call inside hvd.run / a launched worker)")
-            if self.error_feedback:
+            if flat_ef:
                 my_bufs = self._apply_residuals(sig, pos, my_bufs, plan)
             rdv = _rendezvous_for(ps, tag, n_local)
             out = rdv.run(pos, (sig, my_bufs), launch)
         if self.wire_dtype is not None:
-            outs, scales = out[:len(plan)], out[len(plan):]
-            if self.error_feedback:
-                self._update_residuals(sig, pos, my_bufs, scales, plan)
+            outs, extras = out[:len(plan)], out[len(plan):]
+            if flat_ef:
+                self._update_residuals(sig, pos, my_bufs, extras, plan)
+            elif hop_ef and extras:
+                self._store_hop_residuals(ef_key, extras)
             out = outs
         return self._unpack(out, plan)
 
@@ -1019,13 +1172,16 @@ _REDUCERS_LOCK = threading.Lock()
 
 
 def _reducer(op, prescale_factor, postscale_factor, process_set,
-             wire_dtype=None, algorithm=None, topology_hint=None):
+             wire_dtype=None, algorithm=None, topology_hint=None,
+             wire_inner=None):
     ps_id = process_set.process_set_id \
         if isinstance(process_set, ProcessSet) else int(process_set or 0)
     wire_dtype = quantize_mod.normalize_wire_dtype(wire_dtype)
+    wire_inner = quantize_mod.normalize_inner_wire(wire_inner)
     algorithm = normalize_algorithm(algorithm)
     key = (int(ReduceOp(op)), float(prescale_factor),
-           float(postscale_factor), ps_id, wire_dtype, algorithm,
+           float(postscale_factor), ps_id, wire_dtype, wire_inner,
+           algorithm,
            topology_hint.key() if topology_hint is not None else None)
     with _REDUCERS_LOCK:
         red = _REDUCERS.get(key)
@@ -1034,7 +1190,7 @@ def _reducer(op, prescale_factor, postscale_factor, process_set,
                 op=op, prescale_factor=prescale_factor,
                 postscale_factor=postscale_factor, process_set=process_set,
                 wire_dtype=wire_dtype, algorithm=algorithm,
-                topology_hint=topology_hint)
+                topology_hint=topology_hint, wire_inner=wire_inner)
             _REDUCERS[key] = red
         return red
 
@@ -1043,27 +1199,29 @@ def compiled_grouped_allreduce(arrays, op=Average, prescale_factor=1.0,
                                postscale_factor=1.0,
                                process_set=global_process_set,
                                wire_dtype=None, algorithm=None,
-                               topology_hint=None):
+                               topology_hint=None, wire_inner=None):
     """Grouped allreduce through one compiled program (no engine)."""
     return _reducer(op, prescale_factor, postscale_factor,
                     process_set, wire_dtype, algorithm,
-                    topology_hint)(arrays)
+                    topology_hint, wire_inner)(arrays)
 
 
 def compiled_allreduce(array, op=Average, prescale_factor=1.0,
                        postscale_factor=1.0,
                        process_set=global_process_set, wire_dtype=None,
-                       algorithm=None, topology_hint=None):
+                       algorithm=None, topology_hint=None,
+                       wire_inner=None):
     """Single-tensor convenience over ``compiled_grouped_allreduce``."""
     return compiled_grouped_allreduce(
         [array], op=op, prescale_factor=prescale_factor,
         postscale_factor=postscale_factor, process_set=process_set,
         wire_dtype=wire_dtype, algorithm=algorithm,
-        topology_hint=topology_hint)[0]
+        topology_hint=topology_hint, wire_inner=wire_inner)[0]
 
 
 def reset_compiled_state():
-    """Drop cached reducers/programs/rendezvous (shutdown hook)."""
+    """Drop cached reducers/programs/rendezvous and per-hop EF
+    residuals (shutdown hook)."""
     with _REDUCERS_LOCK:
         _REDUCERS.clear()
     with _RDV_LOCK:
@@ -1072,6 +1230,7 @@ def reset_compiled_state():
         _SIG_COUNTERS.clear()
     with _PROGRAM_LOCK:
         _PROGRAM_CACHE.clear()
+    reset_ef_state()
 
 
 # ----------------------------------------------------------------------------

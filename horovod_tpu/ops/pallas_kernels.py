@@ -182,6 +182,101 @@ fake_quantize_blockwise.defvjp(_fq_fwd, _fq_bwd)
 
 
 # ---------------------------------------------------------------------------
+# block-scaled int4 wire codec (cross-hop / DCN wire format)
+
+def _quantize_int4_kernel(x_ref, q_ref, s_ref):
+    x = x_ref[:].astype(jnp.float32)                   # (_QROWS, BLOCK)
+    absmax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
+    # bf16-materialized scale BEFORE the division, exactly like the
+    # int8 kernel (ops/quantize.py contract; qmax = 7)
+    scale = (absmax / np.float32(7.0)) \
+        .astype(jnp.bfloat16).astype(jnp.float32)
+    safe = jnp.where(scale > 0, scale, np.float32(1.0))
+    q = jnp.clip(jnp.round(x / safe), -7, 7)
+    # biased-nibble pack, two codes per byte (np_pack_nibbles layout:
+    # even index low nibble) fused into the same VMEM pass
+    b = (q + 8).astype(jnp.uint8).reshape(_QROWS, _QBLOCK // 2, 2)
+    q_ref[:] = b[:, :, 0] | (b[:, :, 1] << 4)
+    s_ref[:] = scale.reshape(1, _QROWS)
+
+
+def _dequantize_int4_kernel(q_ref, s_ref, o_ref):
+    p = q_ref[:]                                  # (_QROWS, BLOCK//2)
+    lo = (p & 0x0F).astype(jnp.int8) - 8
+    hi = (p >> 4).astype(jnp.int8) - 8
+    q = jnp.stack([lo, hi], axis=-1).reshape(_QROWS, _QBLOCK)
+    x = q.astype(jnp.float32) * s_ref[:].reshape(_QROWS, 1)
+    o_ref[:] = x.astype(o_ref.dtype)
+
+
+def quantize_blockwise_int4(x, *, interpret=None):
+    """Flat float vector -> (packed uint8, scales f32), both padded to
+    a ``_QROWS``-scale-block multiple.  One fused VMEM pass: absmax,
+    bf16 scale, round/clip AND the nibble pack happen without
+    re-reading the block from HBM.  Same semantics as
+    quantize.np_quantize_blockwise_int4 / quantize_blockwise_int4_xla."""
+    if interpret is None:
+        interpret = not _is_tpu()
+    flat, rows = _pad_to_rows(x.reshape(-1), _QBLOCK)
+    xb = flat.reshape(rows, _QBLOCK)
+    q, s = pl.pallas_call(
+        _quantize_int4_kernel,
+        out_shape=(jax.ShapeDtypeStruct((rows, _QBLOCK // 2),
+                                        jnp.uint8),
+                   jax.ShapeDtypeStruct((1, rows), jnp.float32)),
+        grid=(rows // _QROWS,),
+        in_specs=[pl.BlockSpec((_QROWS, _QBLOCK), lambda i: (i, 0))],
+        out_specs=(pl.BlockSpec((_QROWS, _QBLOCK // 2),
+                                lambda i: (i, 0)),
+                   pl.BlockSpec((1, _QROWS), lambda i: (0, i))),
+        interpret=interpret,
+    )(xb)
+    return q.reshape(-1), s.reshape(-1)
+
+
+def dequantize_blockwise_int4(q, scales, n, out_dtype=jnp.float32, *,
+                              interpret=None):
+    """Inverse pass: (packed, scales) from quantize_blockwise_int4 ->
+    flat (n,) array of ``out_dtype`` (unpack fused with the rescale)."""
+    if interpret is None:
+        interpret = not _is_tpu()
+    rows = scales.shape[0]
+    out = pl.pallas_call(
+        _dequantize_int4_kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, _QBLOCK), out_dtype),
+        grid=(rows // _QROWS,),
+        in_specs=[pl.BlockSpec((_QROWS, _QBLOCK // 2),
+                               lambda i: (i, 0)),
+                  pl.BlockSpec((1, _QROWS), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((_QROWS, _QBLOCK), lambda i: (i, 0)),
+        interpret=interpret,
+    )(q.reshape(rows, _QBLOCK // 2), scales.reshape(1, rows))
+    return out.reshape(-1)[:n]
+
+
+@jax.custom_vjp
+def fake_quantize_blockwise_int4(x):
+    """int4 quant->dequant roundtrip, any shape, same dtype, with the
+    same straight-through backward as :func:`fake_quantize_blockwise`
+    — gradients are exact w.r.t. the dequantized value, so training
+    through the int4 wire differentiates cleanly."""
+    q, s = quantize_blockwise_int4(x.reshape(-1))
+    return dequantize_blockwise_int4(q, s, x.size, x.dtype) \
+        .reshape(x.shape)
+
+
+def _fq4_fwd(x):
+    return fake_quantize_blockwise_int4(x), None
+
+
+def _fq4_bwd(_, g):
+    return (g,)
+
+
+fake_quantize_blockwise_int4.defvjp(_fq4_fwd, _fq4_bwd)
+
+
+# ---------------------------------------------------------------------------
 # flash attention (causal, forward)
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,

@@ -1,32 +1,48 @@
-"""Block-scaled int8 wire codec for quantized collectives.
+"""Block-scaled int8/int4 wire codecs for quantized collectives.
 
-The wire format (EQuARX, arXiv:2506.17615, done the Horovod way): a
+The wire formats (EQuARX, arXiv:2506.17615, done the Horovod way): a
 float tensor is flattened, split into 256-element blocks, and each
-block is stored as 256 int8 codes plus ONE bfloat16 scale
-(``scale = absmax / 127`` rounded to bf16, codes =
-``clip(round(x / scale), -127, 127)``).  Wire cost: 1 byte/element +
-2 bytes/256 elements ≈ **3.97x smaller than f32**, 1.98x smaller than
-bf16.
+block is stored as 256 integer codes plus ONE bfloat16 scale.
+
+* **int8**: ``scale = absmax / 127`` rounded to bf16, codes =
+  ``clip(round(x / scale), -127, 127)``, one byte per element.
+  Wire cost: 1 byte/element + 2 bytes/256 elements ≈ **3.97x smaller
+  than f32**, 1.98x smaller than bf16.
+* **int4**: ``scale = absmax / 7``, codes in [-7, 7] PACKED two per
+  byte (biased nibbles: ``(q + 8)`` in [1, 15], even index in the low
+  nibble).  Wire cost: 0.5 byte/element + 2 bytes/256 elements ≈
+  **7.88x smaller than f32** — the cross-host (DCN) hop format the
+  per-hop wire pair exists for (docs/concepts.md "Per-hop wire").
 
 Three implementations share these exact semantics so a value encoded
 by one layer decodes bit-identically in another:
 
 * numpy (this module) — the engine's host-side fusion-buffer encode
   and the frontends' error-feedback re-encode;
-* pure XLA (this module) — ``dequantize_blockwise_xla`` decodes
-  inside the executor's quantized collective programs
-  (ops/xla_ops.py); ``quantize_blockwise_xla`` is the per-rank-scale
-  encoder (ops/compiled.py's in-graph encoder is the SHARED-scale
-  variant of the same math — pmax'd absmax — and must track any
-  change made here);
+* pure XLA (this module) — ``dequantize_blockwise_xla`` /
+  ``dequantize_blockwise_int4_xla`` decode inside the executor's
+  quantized collective programs (ops/xla_ops.py);
+  ``quantize_blockwise_xla`` is the per-rank-scale encoder
+  (ops/compiled.py's in-graph encoder is the SHARED-scale variant of
+  the same math — pmax'd absmax — and must track any change made
+  here);
 * Pallas kernels (ops/pallas_kernels.py ``quantize_blockwise`` /
-  ``dequantize_blockwise``) — one fused VMEM pass on TPU.
+  ``dequantize_blockwise`` and the ``*_int4`` pair) — one fused VMEM
+  pass each on TPU.
 
 Determinism matters: error-feedback residuals are computed by
 re-running the encoder locally (frontends) or from the program's
 returned scales (compiled path), so encode(x) must be a pure function
 of x.  The scale is materialized in bfloat16 *before* the division so
 the decoder's ``q * scale`` uses the same value the encoder used.
+
+Exact-rank bounds for ``quantized_psum_xla`` integer partials (the
+fused in-program reduction): the psum of codes must not overflow its
+accumulator, so with qmax = 127 (int8) partial sums are exact in
+int16 up to ``32767 // 127 = 258`` ranks and int32 beyond; with
+qmax = 7 (int4) they are exact in **int8 up to ``127 // 7 = 18``
+ranks** (a genuinely narrower psum operand — half int8's transport),
+int16 up to ``32767 // 7 = 4681``, int32 beyond.
 """
 
 import numpy as np
@@ -42,24 +58,97 @@ _WIRE_ALIASES = {
     "f16": "fp16", "fp16": "fp16", "float16": "fp16",
     "bf16": "bf16", "bfloat16": "bf16",
     "int8": "int8", "i8": "int8",
+    "int4": "int4", "i4": "int4",
 }
 
-#: wire dtypes the autotuner sweeps (core/autotune.py fifth dimension);
-#: every normalized non-None value must be representable here so the
-#: incumbent config encodes faithfully
-WIRE_CHOICES = (None, "fp16", "bf16", "int8")
+#: single-hop wire dtype vocabulary, in grid order; the autotuner now
+#: sweeps WIRE_PAIR_CHOICES (per-hop pairs) instead of this flat list,
+#: which remains the per-call ``wire_dtype=`` vocabulary
+WIRE_CHOICES = (None, "fp16", "bf16", "int8", "int4")
+
+#: wire dtypes legal on the fast intra-host / ICI (inner) hop: full
+#: width or a 16-bit cast only — the block-quantized formats are
+#: cross-hop (DCN) formats, where the byte discount actually pays for
+#: the codec (EQuARX; intra-hop int4/int8 is never legal and the
+#: autotuner's pair grid never proposes it)
+INNER_WIRE_CHOICES = (None, "f32", "fp16", "bf16")
+
+#: legal (inner_wire, outer_wire) pairs — the autotune categorical
+#: (core/autotune.py): an ENUMERATION, not a cross product.  Pairs the
+#: grid sweeps: full width / 16-bit on the ICI hop, anything up to
+#: int4 on the DCN hop; quantized inner hops are excluded by
+#: construction.
+WIRE_PAIR_CHOICES = (
+    (None, None),            # full width everywhere
+    ("f32", "fp16"),         # 16-bit cross hop, explicit full-width ICI
+    ("f32", "bf16"),         # (unset inner would INHERIT a 16-bit
+    #                          outer — the uniform shorthand — so the
+    #                          cross-hop-only points need the explicit
+    #                          'f32' inner to be distinct bins)
+    (None, "int8"),          # quantized cross hop, full-width ICI
+    (None, "int4"),
+    ("fp16", "fp16"),        # uniform 16-bit
+    ("bf16", "bf16"),
+    ("bf16", "int8"),        # 16-bit ICI + quantized DCN
+    ("bf16", "int4"),
+)
 
 
 def normalize_wire_dtype(wire):
     """Canonicalize a wire-dtype spec -> None (unset) | 'f32' (explicit
-    full width) | 'fp16' | 'bf16' | 'int8'."""
+    full width) | 'fp16' | 'bf16' | 'int8' | 'int4'."""
     key = wire.strip().lower() if isinstance(wire, str) else wire
     try:
         return _WIRE_ALIASES[key]
     except KeyError:
         raise ValueError(
             f"unknown wire dtype {wire!r}; expected one of "
-            "f32, fp16, bf16, int8") from None
+            "f32, fp16, bf16, int8, int4") from None
+
+
+def normalize_inner_wire(wire):
+    """Canonicalize an INNER-hop (ICI) wire spec.  Same vocabulary as
+    :func:`normalize_wire_dtype` minus the block-quantized formats:
+    int8/int4 on the fast hop is never legal (the codec cost would
+    outweigh bytes the ICI moves nearly for free) and is rejected
+    loudly rather than silently degraded."""
+    w = normalize_wire_dtype(wire)
+    if w in ("int8", "int4"):
+        raise ValueError(
+            f"wire_inner={w!r} is not legal: block-quantized formats "
+            "only apply to the cross-host (outer) hop — use fp16/bf16 "
+            "or full width on the ICI hop")
+    return w
+
+
+def effective_inner_wire(inner, outer, itemsize):
+    """THE uniform-shorthand expansion rule, defined once for both
+    reduction paths (core/engine._inner_wire_for, ops/compiled.
+    _inner_wire_use): an unset inner INHERITS a 16-bit outer (so
+    ``wire_dtype='bf16'`` behaves exactly as it did before the pair
+    existed) while a quantized outer leaves the ICI hop full width;
+    ``'f32'`` is the explicit full-width override; and a 16-bit inner
+    on an already-16-bit tensor (``itemsize <= 2``) is a no-op.
+    Returns the wire the inner hop actually runs (None = full
+    width)."""
+    if inner is None:
+        inner = outer if outer in ("fp16", "bf16") else None
+    if inner == "f32":
+        inner = None
+    if inner in ("fp16", "bf16") and itemsize <= 2:
+        inner = None
+    return inner
+
+
+def normalize_wire_pair(inner, outer):
+    """Canonicalize a per-hop (inner, outer) wire pair."""
+    return normalize_inner_wire(inner), normalize_wire_dtype(outer)
+
+
+def wire_pair_label(inner, outer):
+    """Human/metric spelling of a pair: ``'inner:outer'`` with f32 for
+    full width (autotune CSV + horovod_autotune_best_config label)."""
+    return f"{inner or 'f32'}:{outer or 'f32'}"
 
 
 def _bf16():
@@ -69,9 +158,12 @@ def _bf16():
 
 def wire_nbytes(n_elems, wire, itemsize):
     """Per-rank wire payload bytes for ``n_elems`` elements."""
+    nb = -(-n_elems // BLOCK)
     if wire == "int8":
-        nb = -(-n_elems // BLOCK)
         return n_elems + nb * SCALE_BYTES
+    if wire == "int4":
+        # packed nibbles: half a byte per element (block-padded)
+        return nb * (BLOCK // 2) + nb * SCALE_BYTES
     if wire in ("bf16", "fp16"):
         return n_elems * 2
     return n_elems * itemsize
@@ -106,11 +198,12 @@ def np_dequantize_blockwise(q, scales, n, out_dtype=np.float32):
     return x.reshape(-1)[:n].astype(out_dtype)
 
 
-def np_fake_quantize_with_scales(x, scales):
+def np_fake_quantize_with_scales(x, scales, qmax=127):
     """Quant->dequant of flat ``x`` against externally-provided f32
     block scales (the compiled path's SHARED cross-rank scales, which
     its program returns so callers can reconstruct their local
-    quantization error for error feedback)."""
+    quantization error for error feedback).  ``qmax`` = 127 for the
+    int8 wire, 7 for int4."""
     x = np.ascontiguousarray(x, dtype=np.float32).ravel()
     n = x.size
     nb = int(scales.size)
@@ -120,7 +213,7 @@ def np_fake_quantize_with_scales(x, scales):
     sf = np.asarray(scales, np.float32)
     safe = np.where(sf > 0, sf, np.float32(1.0))
     q = np.clip(np.rint(x.reshape(nb, BLOCK) / safe[:, None]),
-                -127, 127)
+                -qmax, qmax)
     return (q * sf[:, None]).reshape(-1)[:n]
 
 
@@ -130,6 +223,71 @@ def np_fake_quantize_blockwise(x):
     q, s, n = np_quantize_blockwise(x)
     return np_dequantize_blockwise(q, s, n).reshape(np.shape(x)) \
         .astype(np.asarray(x).dtype)
+
+
+# ---------------------------------------------------------------------------
+# numpy int4 codec (packed nibbles; engine host path)
+
+def np_pack_nibbles(q):
+    """int codes in [-7, 7], length a multiple of 2 -> uint8 packed
+    two-per-byte, biased (+8) so every nibble is in [1, 15] (0 never
+    appears; the bias makes sign handling branch-free)."""
+    b = (np.asarray(q, np.int16) + 8).astype(np.uint8)
+    return (b[0::2] | (b[1::2] << 4)).astype(np.uint8)
+
+
+def np_unpack_nibbles(packed):
+    """Inverse of :func:`np_pack_nibbles` -> int8 codes in [-7, 7]."""
+    p = np.asarray(packed, np.uint8)
+    out = np.empty(p.size * 2, np.int8)
+    out[0::2] = (p & 0x0F).astype(np.int8) - 8
+    out[1::2] = (p >> 4).astype(np.int8) - 8
+    return out
+
+
+def np_quantize_blockwise_int4(x):
+    """Flat float array -> (packed uint8 (nb * BLOCK/2,), scales bf16
+    (nb,), n).  scale = absmax / 7 rounded to bf16; padding encodes as
+    zeros (nibble 8)."""
+    x = np.ascontiguousarray(x, dtype=np.float32).ravel()
+    n = x.size
+    nb = -(-n // BLOCK) if n else 0
+    pad = nb * BLOCK - n
+    if pad:
+        x = np.concatenate([x, np.zeros(pad, np.float32)])
+    xb = x.reshape(nb, BLOCK) if nb else x.reshape(0, BLOCK)
+    absmax = np.abs(xb).max(axis=1)
+    scales = (absmax / np.float32(7.0)).astype(_bf16())
+    sf = scales.astype(np.float32)
+    safe = np.where(sf > 0, sf, np.float32(1.0))
+    q = np.clip(np.rint(xb / safe[:, None]), -7, 7).astype(np.int8)
+    return np_pack_nibbles(q.reshape(-1)), scales, n
+
+
+def np_dequantize_blockwise_int4(packed, scales, n,
+                                 out_dtype=np.float32):
+    """Inverse of np_quantize_blockwise_int4 (exact: q * bf16-scale)."""
+    nb = scales.size
+    q = np_unpack_nibbles(packed)
+    x = q.reshape(nb, BLOCK).astype(np.float32) * \
+        scales.astype(np.float32)[:, None]
+    return x.reshape(-1)[:n].astype(out_dtype)
+
+
+def np_fake_quantize_blockwise_int4(x):
+    """int4 quant->dequant roundtrip keeping shape/dtype — the value
+    the int4 wire delivers (residual = x - fake_quantize(x))."""
+    q, s, n = np_quantize_blockwise_int4(x)
+    return np_dequantize_blockwise_int4(q, s, n) \
+        .reshape(np.shape(x)).astype(np.asarray(x).dtype)
+
+
+def np_fake_quantize_wire(x, wire):
+    """Dispatch the fake-quantize roundtrip by wire format (the
+    frontends' error-feedback codec entry point)."""
+    if wire == "int4":
+        return np_fake_quantize_blockwise_int4(x)
+    return np_fake_quantize_blockwise(x)
 
 
 # ---------------------------------------------------------------------------
@@ -167,20 +325,9 @@ def dequantize_blockwise_xla(q, scales, n, out_dtype=None):
     return x.astype(out_dtype) if out_dtype is not None else x
 
 
-def quantized_psum_xla(x, axis_name, num_ranks):
-    """Allreduce of ``x`` over mesh axis ``axis_name`` through the
-    shared-scale int8 wire, inside a shard_map body.
-
-    The EQuARX sequence (arXiv:2506.17615) the compiled path pioneered
-    (ops/compiled.py reduce_int8), factored out so the hierarchical /
-    torus decompositions can quantize exactly one hop — the cross-host
-    (DCN) psum — while their ICI hops stay full width: per-block
-    absmax is bf16-rounded then pmax'd across the axis so every rank
-    derives the identical shared scale; codes psum as exact integer
-    partials (int16 while num_ranks * 127 fits, int32 beyond) and
-    decode with one multiply.  ``x``: (..., n) float; returns f32 of
-    the same shape."""
-    from jax import lax
+def quantize_blockwise_int4_xla(x):
+    """jnp flat float vector -> (packed uint8 (nb*BLOCK/2,), scales
+    f32 (nb,)).  Bit-identical to np_quantize_blockwise_int4."""
     import jax.numpy as jnp
 
     n = x.shape[-1]
@@ -190,23 +337,113 @@ def quantized_psum_xla(x, axis_name, num_ranks):
     if pad:
         xf = jnp.pad(xf, [(0, 0)] * (xf.ndim - 1) + [(0, pad)])
     xb = xf.reshape(xf.shape[:-1] + (nb, BLOCK))
+    absmax = jnp.max(jnp.abs(xb), axis=-1)
+    scales = (absmax / np.float32(7.0)) \
+        .astype(jnp.bfloat16).astype(jnp.float32)
+    safe = jnp.where(scales > 0, scales, np.float32(1.0))
+    q = jnp.clip(jnp.round(xb / safe[..., None]), -7, 7)
+    b = (q + 8).astype(jnp.uint8).reshape(
+        xf.shape[:-1] + (nb * BLOCK // 2, 2))
+    packed = b[..., 0] | (b[..., 1] << 4)
+    return packed, scales
+
+
+def dequantize_blockwise_int4_xla(packed, scales, n, out_dtype=None):
+    """Inverse of quantize_blockwise_int4_xla -> (..., n) float."""
+    import jax.numpy as jnp
+
+    nb = scales.shape[-1]
+    p = packed.astype(jnp.uint8)
+    lo = (p & 0x0F).astype(jnp.int8) - 8
+    hi = (p >> 4).astype(jnp.int8) - 8
+    q = jnp.stack([lo, hi], axis=-1).reshape(
+        packed.shape[:-1] + (nb, BLOCK))
+    x = q.astype(jnp.float32) * scales.astype(jnp.float32)[..., None]
+    x = x.reshape(packed.shape[:-1] + (nb * BLOCK,))[..., :n]
+    return x.astype(out_dtype) if out_dtype is not None else x
+
+
+def quantized_qmax(bits):
+    """Symmetric code range per wire width: 127 (int8) / 7 (int4)."""
+    if bits == 8:
+        return 127
+    if bits == 4:
+        return 7
+    raise ValueError(f"unsupported quantized wire width: {bits} bits")
+
+
+def quantized_acc_dtype_np(bits, num_ranks):
+    """Narrowest integer accumulator whose psum of ``num_ranks``
+    maxed-out codes stays exact — the documented exact-rank bounds:
+    int8 wire: int16 to 258 ranks, int32 beyond; int4 wire: int8 to
+    18 ranks, int16 to 4681, int32 beyond."""
+    qmax = quantized_qmax(bits)
+    for dt in (np.int8, np.int16, np.int32):
+        if num_ranks * qmax <= np.iinfo(dt).max:
+            return np.dtype(dt)
+    return np.dtype(np.int32)
+
+
+def quantized_psum_xla(x, axis_name, num_ranks, bits=8):
+    """Allreduce of ``x`` over mesh axis ``axis_name`` through the
+    shared-scale int8/int4 wire, inside a shard_map body.
+
+    The EQuARX sequence (arXiv:2506.17615) the compiled path pioneered
+    (ops/compiled.py reduce_quantized), factored out so the
+    hierarchical / torus decompositions can quantize exactly one hop —
+    the cross-host (DCN) psum — while their ICI hops stay full width
+    (or a 16-bit cast): per-block absmax is bf16-rounded then pmax'd
+    across the axis so every rank derives the identical shared scale;
+    codes psum as exact integer partials in the narrowest accumulator
+    the rank count allows (quantized_acc_dtype_np: int4 rides an int8
+    psum operand up to 18 ranks — half the int8 wire's transport) and
+    decode with one multiply.  ``x``: (..., n) float; returns f32 of
+    the same shape.  The wire math lives once, in
+    :func:`quantized_psum_ef_xla`; this wrapper drops the residual
+    (XLA dead-code-eliminates its computation)."""
+    y, _ = quantized_psum_ef_xla(x, axis_name, num_ranks, bits=bits)
+    return y
+
+
+def quantized_psum_ef_xla(x, axis_name, num_ranks, bits=8):
+    """:func:`quantized_psum_xla` that ALSO returns this rank's new
+    error-feedback residual ``x - deq(q(x))`` (shape of ``x``) — the
+    fused per-hop EF the compiled decomposed programs carry as device
+    state: callers add the previous residual into ``x`` before the
+    call and feed the returned one back next step, so the cross-hop
+    quantization bias cancels over steps without the residual ever
+    leaving the device (ops/compiled.py)."""
+    from jax import lax
+    import jax.numpy as jnp
+
+    qmax = quantized_qmax(bits)
+    n = x.shape[-1]
+    nb = -(-n // BLOCK)
+    pad = nb * BLOCK - n
+    xf = x.astype(jnp.float32)
+    if pad:
+        xf = jnp.pad(xf, [(0, 0)] * (xf.ndim - 1) + [(0, pad)])
+    xb = xf.reshape(xf.shape[:-1] + (nb, BLOCK))
     absmax16 = jnp.max(jnp.abs(xb), axis=-1).astype(jnp.bfloat16)
     shared = lax.pmax(absmax16, axis_name)
-    scale = (shared.astype(jnp.float32) / np.float32(127.0)) \
+    scale = (shared.astype(jnp.float32) / np.float32(qmax)) \
         .astype(jnp.bfloat16).astype(jnp.float32)
     safe = jnp.where(scale > 0, scale, np.float32(1.0))
-    q = jnp.clip(jnp.round(xb / safe[..., None]), -127, 127)
-    acc = jnp.int16 if num_ranks <= 258 else jnp.int32
+    q = jnp.clip(jnp.round(xb / safe[..., None]), -qmax, qmax)
+    resid = (xb - q * scale[..., None]) \
+        .reshape(xf.shape)[..., :n].astype(x.dtype)
+    acc = jnp.dtype(quantized_acc_dtype_np(bits, num_ranks))
     s = lax.psum(q.astype(acc), axis_name)
     y = s.astype(jnp.float32) * scale[..., None]
-    return y.reshape(xf.shape)[..., :n]
+    return y.reshape(xf.shape)[..., :n], resid
 
 
-def quantized_psum_wire_nbytes(n_elems, num_ranks):
+def quantized_psum_wire_nbytes(n_elems, num_ranks, bits=8):
     """Per-rank interconnect bytes of one quantized_psum_xla hop: the
     psum operand is the integer-partial width plus the bf16 absmax
     pmax (honest accounting, as ops/compiled.py documents — jax
-    exposes no int8-transport allreduce)."""
+    exposes no sub-operand-width-transport allreduce; int4's win here
+    is the narrower accumulator its small code range allows)."""
     nb = -(-n_elems // BLOCK)
-    per = 2 if num_ranks <= 258 else 4
+    per = quantized_acc_dtype_np(bits, num_ranks).itemsize
     return n_elems * per + nb * SCALE_BYTES

@@ -340,14 +340,22 @@ class MeshExecutor:
             shape, sharding, shards)
 
     def allreduce_2d(self, rows, op: ReduceOp, prescale=1.0,
-                     postscale=1.0, inner=1, wire=None):
-        """Two-stage decomposed allreduce.  ``rows``: per-local-rank
-        flat float buffers (n,); ``inner`` is the fast-axis size
-        (host-local ranks for hierarchical, the near-square factor
-        for torus); ``wire`` is None (full width on every hop) or
-        'int8' (the OUTER hop ships shared-scale quantized partials;
-        16-bit wires are handled by the caller casting ``rows``).
-        Returns per-local-rank result buffers (n,)."""
+                     postscale=1.0, inner=1, inner_wire=None,
+                     outer_wire=None, wire=None):
+        """Two-stage decomposed allreduce with a PER-HOP wire pair.
+        ``rows``: per-local-rank flat float buffers (n,); ``inner`` is
+        the fast-axis size (host-local ranks for hierarchical, the
+        near-square factor for torus).  ``inner_wire`` is the ICI-hop
+        format (None = full width, 'fp16'/'bf16' cast the
+        psum_scatter and all_gather operands INSIDE the one program);
+        ``outer_wire`` is the DCN-hop format (additionally 'int8' /
+        'int4': shared-scale quantized integer partials, encode fused
+        into the cross psum and decode fused before the gather-back —
+        ops/quantize.quantized_psum_xla).  ``wire`` is the legacy
+        single-format spelling, treated as the outer wire.  Returns
+        per-local-rank result buffers (n,)."""
+        if wire is not None and outer_wire is None:
+            outer_wire = wire
         n = int(rows[0].size)
         dtype = rows[0].dtype
         if n == 0:
@@ -367,9 +375,10 @@ class MeshExecutor:
                 buf[:n] = r
                 padded.append(buf)
             rows = padded
-        key = ("allreduce2d", npad, str(dtype), inner, wire)
+        key = ("allreduce2d", npad, str(dtype), inner, inner_wire,
+               outer_wire)
         fn = self._cached(key, lambda: self._build_allreduce_2d(
-            npad, dtype, inner, wire))
+            npad, dtype, inner, inner_wire, outer_wire))
         x = self._stage_rows_2d(rows, inner)
         sdt = _scale_np_dtype(dtype)
         out = fn(x, sdt(prescale), sdt(postscale))
@@ -378,27 +387,51 @@ class MeshExecutor:
             host = host[:n]
         return self._fanout(host)
 
-    def _build_allreduce_2d(self, npad, dtype, inner, wire):
+    def _build_allreduce_2d(self, npad, dtype, inner, inner_wire,
+                            outer_wire):
         from .quantize import quantized_psum_xla
         outer = self.num_ranks // inner
         sf = _scale_jnp_dtype(dtype)
         mesh = self.mesh2d(inner)
+        iw = {"fp16": jnp.float16, "bf16": jnp.bfloat16} \
+            .get(inner_wire)
 
         def body(xb, pre, post):
             # xb: (1, 1, npad) — this device's row on the (y, x) grid
             xb = (xb.astype(sf) * pre).astype(dtype)
-            # stage 1 (inner / ICI): reducescatter to 1/inner shards
+            # stage 1 (inner / ICI): reducescatter to 1/inner shards —
+            # the inner-wire cast is fused HERE, so only the hop
+            # operand narrows (the tensor itself stays full width on
+            # the host, unlike the old caller-side row cast which also
+            # narrowed the cross hop)
+            if iw is not None:
+                xb = xb.astype(jnp.float32).astype(iw)
             y = lax.psum_scatter(xb, "hvd_x", scatter_dimension=2,
                                  tiled=True)        # (1, 1, npad/inner)
-            # stage 2 (outer / DCN): allreduce of the shard only
-            if wire == "int8":
-                y = quantized_psum_xla(y, "hvd_y", outer)
+            # stage 2 (outer / DCN): allreduce of the shard only, over
+            # the outer wire — quantized encode/decode fused in-line
+            if outer_wire in ("int8", "int4"):
+                bits = 8 if outer_wire == "int8" else 4
+                y = quantized_psum_xla(y.astype(jnp.float32), "hvd_y",
+                                       outer, bits=bits)
+            elif outer_wire in ("fp16", "bf16"):
+                ow = jnp.float16 if outer_wire == "fp16" \
+                    else jnp.bfloat16
+                y = lax.psum(y.astype(jnp.float32).astype(ow), "hvd_y")
             else:
+                # full-width outer: re-widen a 16-bit inner shard so
+                # the DCN psum really accumulates at the tensor dtype
+                # (the inner cast narrows ONLY the ICI hop)
+                if iw is not None:
+                    y = y.astype(dtype)
                 y = lax.psum(y, "hvd_y")
             y = (y.astype(sf) * post).astype(dtype)
-            # stage 3 (inner / ICI): allgather the reduced shards back
+            # stage 3 (inner / ICI): allgather the reduced shards back,
+            # again over the inner wire
+            if iw is not None:
+                y = y.astype(jnp.float32).astype(iw)
             y = lax.all_gather(y, "hvd_x", axis=2, tiled=True)
-            return y.reshape(npad)
+            return y.reshape(npad).astype(dtype)
 
         mapped = shard_map(
             body, mesh=mesh,
@@ -406,24 +439,30 @@ class MeshExecutor:
             check_vma=False)
         return jax.jit(mapped, donate_argnums=self._donate)
 
-    # -- quantized allreduce / reducescatter (int8 wire) --------------------
+    # -- quantized allreduce / reducescatter (int8 / int4 wire) -------------
     #
-    # The wire payload is the block-scaled int8 encoding
-    # (ops/quantize.py): per 256-element block, int8 codes + one bf16
-    # scale — ~3.97x fewer wire bytes than f32.  Each rank encodes with
-    # its OWN scales; the program moves only the quantized
-    # representation (all_gather of codes + scales), decodes per rank
-    # and reduces in f32 — so the reduction is exactly the sum of the
-    # values each rank's error-feedback residual was computed against.
-    # (The compiled in-graph path uses the shared-scale
-    # psum-of-int32-partials variant instead — ops/compiled.py.)
+    # The wire payload is the block-scaled encoding (ops/quantize.py):
+    # per 256-element block, integer codes + one bf16 scale — ~3.97x
+    # fewer wire bytes than f32 for int8, ~7.9x for the nibble-packed
+    # int4 format.  Each rank encodes with its OWN scales; the program
+    # moves only the quantized representation (all_gather of codes +
+    # scales), decodes per rank and reduces in f32 — so the reduction
+    # is exactly the sum of the values each rank's error-feedback
+    # residual was computed against.  (The compiled in-graph path uses
+    # the shared-scale psum-of-integer-partials variant instead —
+    # ops/compiled.py.)
 
     def allreduce_quantized(self, q_rows, scale_rows, op: ReduceOp,
-                            prescale=1.0, postscale=1.0):
-        """q_rows: per-local-rank int8 codes (npad,), scale_rows:
-        per-local-rank f32 scales (nb,).  Returns per-local-rank f32
-        result buffers (npad,) — callers slice to the true length."""
-        npad = int(q_rows[0].size)
+                            prescale=1.0, postscale=1.0, nbits=8,
+                            n_elems=None):
+        """q_rows: per-local-rank int8 codes (npad,) — or packed uint8
+        nibbles (npad/2,) for ``nbits=4``; scale_rows: per-local-rank
+        f32 scales (nb,).  ``n_elems``: the padded element count
+        (defaults to the int8 layout's code count).  Returns
+        per-local-rank f32 result buffers (n_elems,) — callers slice
+        to the true length."""
+        npad = int(n_elems) if n_elems is not None \
+            else int(q_rows[0].size)
         nb = int(scale_rows[0].size)
         R = self.num_ranks
         post = float(prescale) * float(postscale)
@@ -431,24 +470,34 @@ class MeshExecutor:
             post /= R
         elif op != ReduceOp.SUM:
             raise ValueError(
-                f"int8 wire supports Sum/Average allreduce, got {op}")
-        key = ("allreduce_q", npad, nb, self.shard_mode)
+                f"quantized wire supports Sum/Average allreduce, "
+                f"got {op}")
+        key = ("allreduce_q", npad, nb, nbits, self.shard_mode)
         fn = self._cached(key, lambda: self._build_allreduce_quantized(
-            npad, nb))
+            npad, nb, nbits))
         q = self._stage_rows(q_rows)
         s = self._stage_rows(scale_rows)
         out = fn(q, s, np.float32(post))
         return self._fanout(self._replicated_out(out, np.float32))
 
-    def _build_allreduce_quantized(self, npad, nb):
-        from .quantize import dequantize_blockwise_xla
-        R = self.num_ranks
+    @staticmethod
+    def _dequant_fn(nbits, npad):
+        """Shared decode dispatch: (R, codes) x (R, nb) -> (R, npad)
+        f32 via the wire codec, so device and host decode
+        bit-identically for both widths."""
+        from .quantize import (dequantize_blockwise_int4_xla,
+                               dequantize_blockwise_xla)
 
         def dequant(qg, sg):
-            # (R, npad) int8 x (R, nb) bf16 -> (R, npad) f32, via the
-            # shared codec so device and host decode bit-identically
+            if nbits == 4:
+                return dequantize_blockwise_int4_xla(
+                    qg, sg.astype(jnp.float32), npad)
             return dequantize_blockwise_xla(
                 qg, sg.astype(jnp.float32), npad)
+        return dequant
+
+    def _build_allreduce_quantized(self, npad, nb, nbits):
+        dequant = self._dequant_fn(nbits, npad)
 
         def body(qb, sb, post):
             qg = lax.all_gather(qb, "hvd", axis=0, tiled=True)
@@ -468,11 +517,14 @@ class MeshExecutor:
 
     def reducescatter_quantized(self, q_rows, scale_rows, d0,
                                 rest_shape, op: ReduceOp,
-                                prescale=1.0, postscale=1.0):
+                                prescale=1.0, postscale=1.0, nbits=8,
+                                n_elems=None):
         """Quantized variant of :meth:`reducescatter`: ``q_rows`` /
         ``scale_rows`` encode the padded (R * max_chunk * rest,)
-        layout.  Returns per-local-rank f32 (chunk_j, *rest)."""
-        npad = int(q_rows[0].size)
+        layout (packed nibbles for ``nbits=4``).  Returns
+        per-local-rank f32 (chunk_j, *rest)."""
+        npad = int(n_elems) if n_elems is not None \
+            else int(q_rows[0].size)
         nb = int(scale_rows[0].size)
         R = self.num_ranks
         chunks = self.chunk_sizes(d0, R)
@@ -484,10 +536,11 @@ class MeshExecutor:
             post /= R
         elif op != ReduceOp.SUM:
             raise ValueError(
-                f"int8 wire supports Sum/Average reducescatter, got {op}")
-        key = ("reducescatter_q", npad, nb, m, self.shard_mode)
+                f"quantized wire supports Sum/Average reducescatter, "
+                f"got {op}")
+        key = ("reducescatter_q", npad, nb, m, nbits, self.shard_mode)
         fn = self._cached(key, lambda: self._build_reducescatter_quantized(
-            npad, nb, m))
+            npad, nb, m, nbits))
         q = self._stage_rows(q_rows)
         s = self._stage_rows(scale_rows)
         out = fn(q, s, np.float32(post))
@@ -498,13 +551,9 @@ class MeshExecutor:
             for row, pos in zip(per_local, self.local_positions)
         ]
 
-    def _build_reducescatter_quantized(self, npad, nb, m):
-        from .quantize import dequantize_blockwise_xla
+    def _build_reducescatter_quantized(self, npad, nb, m, nbits):
         R = self.num_ranks
-
-        def dequant(qg, sg):
-            return dequantize_blockwise_xla(
-                qg, sg.astype(jnp.float32), npad)
+        dequant = self._dequant_fn(nbits, npad)
 
         def body(qb, sb, post):
             qg = lax.all_gather(qb, "hvd", axis=0, tiled=True)

@@ -38,6 +38,11 @@ HOROVOD_LOG_LEVEL = "HOROVOD_LOG_LEVEL"
 HOROVOD_HIERARCHICAL_ALLREDUCE = "HOROVOD_HIERARCHICAL_ALLREDUCE"
 HOROVOD_TORUS_ALLREDUCE = "HOROVOD_TORUS_ALLREDUCE"
 HOROVOD_ALLREDUCE_ALGORITHM = "HOROVOD_ALLREDUCE_ALGORITHM"
+# per-hop quantized wire (common/env.py reads these: DTYPE is the
+# uniform shorthand, INNER/OUTER the explicit per-hop pair)
+HOROVOD_WIRE_DTYPE = "HOROVOD_WIRE_DTYPE"
+HOROVOD_WIRE_INNER = "HOROVOD_WIRE_INNER"
+HOROVOD_WIRE_OUTER = "HOROVOD_WIRE_OUTER"
 
 
 def set_env_from_args(env: dict, args) -> dict:
@@ -159,6 +164,12 @@ def set_env_from_args(env: dict, args) -> dict:
          getattr(args, "hierarchical_allreduce", False))
     if getattr(args, "allreduce_algorithm", None):
         env[HOROVOD_ALLREDUCE_ALGORITHM] = args.allreduce_algorithm
+    if getattr(args, "wire_dtype", None):
+        env[HOROVOD_WIRE_DTYPE] = args.wire_dtype
+    if getattr(args, "wire_inner", None):
+        env[HOROVOD_WIRE_INNER] = args.wire_inner
+    if getattr(args, "wire_outer", None):
+        env[HOROVOD_WIRE_OUTER] = args.wire_outer
     return env
 
 

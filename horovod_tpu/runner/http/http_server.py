@@ -1279,6 +1279,7 @@ class Coordinator:
                                  ("pre", "prescale factors"),
                                  ("post", "postscale factors"),
                                  ("wire", "wire dtypes"),
+                                 ("wi", "inner wire dtypes"),
                                  ("algo", "algorithms"),
                                  ("root", "root ranks")):
                 if m.get(field) != first.get(field):
@@ -1437,13 +1438,15 @@ class Coordinator:
                     meta.get("nranks",
                              meta.get("nprocs", self.world_size)), 1)
             else:
-                # wire dtype and algorithm split buckets exactly like
+                # wire pair and algorithm split buckets exactly like
                 # the engine-side _fuse signature: a quantized or
                 # hierarchical entry must not share a fused SPMD
-                # program with a full-width / flat one
+                # program with a full-width / flat one, nor may two
+                # halves of one bucket disagree on a hop's format
                 msig = (meta["type"], meta["dtype"], meta["op"],
                         meta["pre"], meta["post"], meta["ps"],
-                        meta.get("wire"), meta.get("algo"))
+                        meta.get("wire"), meta.get("wi"),
+                        meta.get("algo"))
                 nbytes = meta["nbytes"]
             if bucket and (msig != sig or
                            bucket_bytes + nbytes >

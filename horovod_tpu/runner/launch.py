@@ -94,6 +94,26 @@ def parse_args(argv=None):
                              "(HOROVOD_ALLREDUCE_ALGORITHM); the "
                              "boolean flags above win when both are "
                              "given")
+    # per-hop quantized wire (docs/concepts.md "Per-hop wire")
+    parser.add_argument("--wire-dtype", default=None,
+                        choices=["f32", "fp16", "bf16", "int8",
+                                 "int4"],
+                        help="uniform wire shorthand for every "
+                             "reduction (HOROVOD_WIRE_DTYPE): 16-bit "
+                             "values apply to both hops of a "
+                             "decomposed allreduce, int8/int4 to the "
+                             "cross-host hop only")
+    parser.add_argument("--wire-inner", default=None,
+                        choices=["f32", "fp16", "bf16"],
+                        help="intra-host/ICI hop wire of the per-hop "
+                             "pair (HOROVOD_WIRE_INNER; quantized "
+                             "formats are not legal on this hop)")
+    parser.add_argument("--wire-outer", default=None,
+                        choices=["f32", "fp16", "bf16", "int8",
+                                 "int4"],
+                        help="cross-host/DCN hop wire of the per-hop "
+                             "pair (HOROVOD_WIRE_OUTER; wins over "
+                             "--wire-dtype)")
     # timeline + job-wide tracing (docs/timeline.md)
     parser.add_argument("--timeline-filename", default=None)
     parser.add_argument("--timeline-mark-cycles", action="store_true")

@@ -103,6 +103,23 @@ ELASTIC_RESIZE_FAMILY = "horovod_elastic_resize_events_total"
 ELASTIC_RESIZE_HELP = ("Elastic membership changes seen by this "
                        "worker")
 
+# -- per-hop wire accounting (docs/concepts.md "Per-hop wire"): the
+#    engine's reduction dispatch and collective_bench both consume
+#    these, so the family name lives ONCE here.  `hop` is the
+#    decomposition stage the bytes rode (inner = intra-host / ICI,
+#    cross = cross-host / DCN); `wire` is THAT hop's encoding — which
+#    is how cross_wire_bytes splits by hop and wire under the per-hop
+#    pair (a torus bucket with pair bf16:int4 accounts its ICI bytes
+#    under {hop=inner, wire=bf16} and its DCN bytes under
+#    {hop=cross, wire=int4}).
+
+WIRE_HOP_BYTES_FAMILY = "horovod_wire_hop_bytes_total"
+WIRE_HOP_BYTES_HELP = ("Interconnect bytes per decomposition hop, "
+                       "labeled by that hop's wire encoding "
+                       "(hop=inner: intra-host/ICI, hop=cross: "
+                       "cross-host/DCN)")
+WIRE_HOP_BYTES_LABELS = ("hop", "wire")
+
 
 def count_fabric_retry(verb):
     """One fabric retry attempt, into the process-current registry

@@ -662,8 +662,8 @@ class _GradSync:
             # (exact, shared-scale) error feedback — no host residuals
             outs = self._reduce_compiled(comp, prescale, postscale)
         else:
-            if wire == "int8":
-                comp = self._ef_inject(comp)
+            if wire in ("int8", "int4"):
+                comp = self._ef_inject(comp, wire)
             outs = grouped_allreduce(comp, op=self.op,
                                      prescale_factor=prescale,
                                      postscale_factor=postscale,
@@ -674,11 +674,12 @@ class _GradSync:
         return [self.compression.decompress(o, c)
                 for o, c in zip(outs, ctxs)]
 
-    def _ef_inject(self, dense):
+    def _ef_inject(self, dense, wire="int8"):
         """Error feedback (EF21) for the engine path: add the previous
         step's local quantization error into each float gradient, then
         store the new residual ``x - deq(q(x))`` from re-running the
-        wire codec host-side (ops/quantize.py, a pure function of x)."""
+        wire codec host-side (ops/quantize.py, a pure function of x;
+        ``wire`` picks the int8 or packed-int4 codec)."""
         from ..ops import quantize as qz
         out = []
         for k, g in enumerate(dense):
@@ -689,16 +690,23 @@ class _GradSync:
             r = self._residuals.get(k)
             if r is not None and r.shape == x.shape:
                 x = x + r
-            self._residuals[k] = x - qz.np_fake_quantize_blockwise(x)
+            self._residuals[k] = x - qz.np_fake_quantize_wire(x, wire)
             out.append(tf.cast(tf.convert_to_tensor(x), g.dtype))
         return out
 
     def reset_wire_state(self):
-        """Drop error-feedback residuals — call on elastic resets or
-        whenever the gradient stream restarts (docs/concepts.md)."""
+        """Drop error-feedback residuals — host-side engine-path ones,
+        the compiled reducer's flat residuals AND the per-hop device
+        residuals (ops/compiled.reset_ef_state).  Call on elastic
+        resets/resizes or whenever the gradient stream restarts, so a
+        resized mesh never sees stale residual shapes
+        (docs/concepts.md)."""
         self._residuals.clear()
         if self._compiled_reducer is not None:
-            self._compiled_reducer._residuals.clear()
+            self._compiled_reducer.reset_wire_state()
+        else:
+            from ..ops.compiled import reset_ef_state
+            reset_ef_state()
 
     def _reduce_compiled(self, comp, prescale, postscale):
         """One compiled XLA program for the whole gradient group — the
@@ -711,7 +719,7 @@ class _GradSync:
                 postscale_factor=postscale,
                 process_set=self.process_set, name="grad_sync",
                 wire_dtype=self.wire_dtype,
-                error_feedback=self.wire_dtype == "int8")
+                error_feedback=self.wire_dtype in ("int8", "int4"))
         arrs = [t.numpy() if hasattr(t, "numpy") else np.asarray(t)
                 for t in comp]
         outs = self._compiled_reducer(arrs)

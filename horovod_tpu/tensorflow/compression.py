@@ -67,8 +67,18 @@ class Int8Compressor(Compressor):
         return tensor
 
 
+class Int4Compressor(Int8Compressor):
+    """Block-scaled int4 wire (packed nibbles + bf16 scales, ~7.9x
+    under f32) with EF21 error feedback — a marker like int8; pair
+    with a topology-aware algorithm so only the cross-host hop is
+    quantized (docs/concepts.md "Per-hop wire")."""
+
+    wire = "int4"
+
+
 class Compression:
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
     int8 = Int8Compressor
+    int4 = Int4Compressor

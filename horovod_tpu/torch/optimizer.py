@@ -211,8 +211,9 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             return handle, ("sparse",)
         tensor_compressed, ctx = self._compression.compress(grad)
         wire = self._wire_for(tensor_compressed)
-        if wire == "int8":
-            tensor_compressed = self._ef_inject(p, tensor_compressed)
+        if wire in ("int8", "int4"):
+            tensor_compressed = self._ef_inject(p, tensor_compressed,
+                                                wire)
         prescale, postscale = self._scale_factors()
         handle = api.allreduce_async(
             tensor_compressed, name=self._name(p), op=self.op,
@@ -230,30 +231,35 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             return None
         return self._wire_dtype
 
-    def _ef_inject(self, p, grad):
+    def _ef_inject(self, p, grad, wire="int8"):
         """Error feedback (EF21): add the residual left over from the
         previous step's quantization into this gradient, then store
         the new local quantization error ``x - deq(q(x))`` — computed
         by re-running the wire codec host-side (ops/quantize.py is a
         pure function of x, so this matches what the engine encodes up
-        to fusion-buffer block alignment)."""
+        to fusion-buffer block alignment).  ``wire`` picks the codec
+        (int8 or packed int4)."""
         from ..ops import quantize as qz
         x = grad.float()
         r = self._residuals.get(p)
         if r is not None and r.shape == x.shape:
             x = x + r
         fq = torch.from_numpy(
-            qz.np_fake_quantize_blockwise(x.detach().numpy()))
+            qz.np_fake_quantize_wire(x.detach().numpy(), wire))
         self._residuals[p] = x - fq.view_as(x)
         return x.to(grad.dtype) if grad.dtype != torch.float32 else x
 
     def reset_wire_state(self):
-        """Drop error-feedback residuals.  Call when the gradient
-        stream is discontinuous — elastic reset, parameter reshape,
-        optimizer state restore — so stale errors from the old run are
-        not injected into the new one (docs/concepts.md, residual
-        lifecycle)."""
+        """Drop error-feedback residuals — the host-side per-parameter
+        ones AND any per-hop device residuals the compiled path keeps
+        (ops/compiled.reset_ef_state).  Call when the gradient stream
+        is discontinuous — elastic reset/resize, parameter reshape,
+        optimizer state restore — so stale errors (or stale residual
+        SHAPES from the old world size) are never injected into the
+        new run (docs/concepts.md, residual lifecycle)."""
         self._residuals.clear()
+        from ..ops.compiled import reset_ef_state
+        reset_ef_state()
 
     def _scale_factors(self):
         """Split the average as prescale=1/gpf, postscale=gpf (the
@@ -271,8 +277,8 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         for p in group:
             t, c = self._compression.compress(self._prepare_grad(p))
             w = self._wire_for(t)
-            if w == "int8":
-                t = self._ef_inject(p, t)
+            if w in ("int8", "int4"):
+                t = self._ef_inject(p, t, w)
                 wire = w
             tensors.append(t)
             ctxs.append(c)

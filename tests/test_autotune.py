@@ -53,21 +53,58 @@ def test_parameter_manager_converges(tmp_path):
     for _ in range(5 * 2):
         pm.record_bytes(1 << 20)
     assert not pm.active               # converged after max_samples
-    fusion, cycle, pack_mt, cache, wire, algo = pm.best_parameters()
+    fusion, cycle, pack_mt, cache, pair, algo = pm.best_parameters()
     assert 1 << 20 <= fusion <= 1 << 28
     assert 0.5 <= cycle <= 32.0
     assert 1 << 20 <= pack_mt <= 1 << 26
     assert 0 <= cache <= 4096                       # 4th dim (r4):
-    assert wire in (None, "fp16", "bf16", "int8")   # 5th dim: wire dtype
+    # 5th dim: the per-hop wire PAIR, one categorical over the legal
+    # enumeration only (intra-hop int4/int8 never appears)
+    from horovod_tpu.ops.quantize import (INNER_WIRE_CHOICES,
+                                          WIRE_PAIR_CHOICES)
+    assert pair in WIRE_PAIR_CHOICES
+    assert pair[0] in INNER_WIRE_CHOICES
     assert algo in ("flat", "hierarchical", "torus")  # 6th dim
     assert cfg.pack_mt_threshold_bytes == pack_mt   # applied
     assert cfg.cache_capacity == cache              # applied
-    assert cfg.wire_dtype == wire                   # applied
+    assert cfg.wire_inner == pair[0]                # applied (pair is
+    assert cfg.wire_dtype == pair[1]                # one categorical)
     assert cfg.algorithm == algo                    # applied
     pm.close()
     lines = log.read_text().strip().splitlines()
     assert lines[0].startswith("sample,")
     assert len(lines) == 6             # header + 5 samples
+
+
+def test_pair_seed_canonicalizes_to_enumeration_bins():
+    """An incumbent config's wire pair must seed the BO in its OWN
+    bin for every API-legal spelling — not fall back to the
+    full-width bin (the tuner would then attribute the incumbent's
+    score to full width, and its early suggestions could clobber an
+    explicitly configured quantized cross-hop wire)."""
+    from horovod_tpu.ops.quantize import WIRE_PAIR_CHOICES
+
+    pm = ParameterManager(env_mod.Config(), warmup_samples=1,
+                          steps_per_sample=1, max_samples=2)
+
+    def seeded_bin(pair):
+        x = pm._encode(1 << 22, 1.0, 8 << 20, 64, pair, None)
+        return WIRE_PAIR_CHOICES[int(x[4] * len(WIRE_PAIR_CHOICES))]
+
+    assert seeded_bin((None, None)) == (None, None)
+    # uniform shorthand: an unset inner inherits a 16-bit outer
+    assert seeded_bin((None, "bf16")) == ("bf16", "bf16")
+    # explicit 'f32' inner is a distinct bin against a 16-bit outer...
+    assert seeded_bin(("f32", "bf16")) == ("f32", "bf16")
+    # ...but IS full width against a quantized or unset outer
+    assert seeded_bin(("f32", "int8")) == (None, "int8")
+    assert seeded_bin(("f32", "int4")) == (None, "int4")
+    assert seeded_bin(("f32", None)) == (None, None)
+    assert seeded_bin(("f32", "f32")) == (None, None)
+    # an unenumerated 16-bit inner over a quantized outer seeds the
+    # byte-equivalent 16-bit bin, not full width
+    assert seeded_bin(("fp16", "int8")) == ("bf16", "int8")
+    pm.close()
 
 
 def test_autotune_selects_nonflat_when_cross_hop_bound(monkeypatch):

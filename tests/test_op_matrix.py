@@ -579,11 +579,14 @@ def test_grouped_reducescatter_matrix(live_engine, dtype):
 # quantized wire (ops/quantize.py); its tolerance follows the codec's
 # error bound (absmax/254 per element per rank).
 
-WIRE_ATOL = {None: 1e-5, "fp16": 3e-2, "int8": 2e-1}
+# int4's bound follows its codec: error <= absmax/14 per element per
+# rank (test_pallas int4 error bound), absmax ~3.5 for the N(0,1)
+# payloads below, summed over NP ranks
+WIRE_ATOL = {None: 1e-5, "fp16": 3e-2, "int8": 2e-1, "int4": 1.6}
 
 WIRE_CASES = [
     (w, o, p)
-    for w in (None, "fp16", "int8")
+    for w in (None, "fp16", "int8", "int4")
     for o in ("allreduce", "grouped_allreduce", "reducescatter")
     for p in ("engine", "compiled")
 ]
@@ -1018,3 +1021,253 @@ def test_int8_wire_error_feedback_convergence(live_engine):
     # f32-wire final loss
     assert abs(int8_loss - f32_loss) <= 0.01 * f32_loss + 1e-3, \
         (int8_loss, f32_loss)
+
+
+def test_int4_wire_accounting(live_engine):
+    """The int4 wire must show ~7.88x under f32 on the engine path
+    (0.5 byte/elem packed nibbles + 2 bytes/256-elem block vs 4)."""
+    from horovod_tpu.common import basics
+    eng = basics.engine()
+    l0, a0 = eng.logical_wire_bytes, eng.actual_wire_bytes
+    q0 = eng.quantized_bucket_runs
+
+    def fn():
+        x = np.ones(1 << 16, np.float32)
+        hvd.allreduce(x, op=hvd.Sum, name="m.acct4", wire_dtype="int4")
+        return True
+
+    assert all(run_ranks(fn))
+    dl = eng.logical_wire_bytes - l0
+    da = eng.actual_wire_bytes - a0
+    assert eng.quantized_bucket_runs > q0
+    assert dl > 0 and dl / da > 7.8, (dl, da)
+
+
+# ---------------------------------------------------------------------------
+# per-hop wire pair (ISSUE 9): (inner, outer) x algorithm x path —
+# every cell must match the flat f32 reduction within the OUTER
+# wire's tolerance (the inner 16-bit hop adds ~1e-2-scale error,
+# absorbed by the quantized outer bounds; the pure-16-bit pairs use
+# the fp16 bound)
+
+PAIR_CASES = [
+    (iw, ow, a, p)
+    for iw, ow in ((None, "int8"), (None, "int4"), ("bf16", "int8"),
+                   ("bf16", "int4"), ("bf16", None), ("fp16", "fp16"))
+    for a in ("hierarchical", "torus")
+    for p in ("engine", "compiled")
+]
+
+
+@pytest.mark.parametrize(
+    "iw,ow,algo,path", PAIR_CASES,
+    ids=[f"{iw or 'f32'}:{ow or 'f32'}-{a}-{p}"
+         for iw, ow, a, p in PAIR_CASES])
+def test_wire_pair_matrix(two_host_topology, iw, ow, algo, path):
+    eng = two_host_topology
+    runs0 = dict(eng.algo_runs)
+    tag = f"{iw or 'f32'}.{ow or 'f32'}.{algo}.{path}"
+
+    def fn():
+        r = hvd.rank()
+        rng = np.random.default_rng(r)
+        x = rng.standard_normal(1000).astype(np.float32)
+        if path == "compiled":
+            out = hvd.compiled_allreduce(
+                x, op=hvd.Sum, algorithm=algo,
+                wire_dtype=ow or "f32", wire_inner=iw or "f32")
+        else:
+            out = hvd.allreduce(x, op=hvd.Sum, name=f"m.pair.{tag}",
+                                algorithm=algo,
+                                wire_dtype=ow or "f32",
+                                wire_inner=iw or "f32")
+        return np.asarray(out, np.float64), x
+
+    results = run_ranks(fn)
+    expected = np.sum([x.astype(np.float64) for _, x in results],
+                      axis=0)
+    # bf16 inner hops add their own rounding on top of the outer
+    # wire's quantization error
+    tol = WIRE_ATOL[ow] + (5e-2 if iw else 0.0)
+    for out, _ in results:
+        assert np.allclose(out, expected, atol=tol),             (iw, ow, algo, path, np.abs(out - expected).max())
+    if path == "engine":
+        assert eng.algo_runs.get(algo, 0) > runs0.get(algo, 0)
+
+
+def test_per_hop_cross_bytes_split(two_host_topology):
+    """The hop accounting must show the pair's whole point: with pair
+    (bf16, int4) on a hierarchical reduction, the inner hop moves
+    2x the payload at bf16 width while the cross hop moves only the
+    quantized 1/local_size shard — and the cross family's int4 bytes
+    undercut the same reduction's int8 bytes."""
+    from horovod_tpu import telemetry
+    eng = two_host_topology
+
+    def hop(h):
+        fam = telemetry.metrics().get(
+            telemetry.WIRE_HOP_BYTES_FAMILY, {})
+        return sum(s.get("value", 0.0) for s in fam.get("samples", [])
+                   if s.get("labels", {}).get("hop") == h)
+
+    def run_one(wire, name):
+        i0, c0 = hop("inner"), hop("cross")
+
+        def fn():
+            x = np.ones(1 << 14, np.float32)
+            hvd.allreduce(x, op=hvd.Sum, name=name,
+                          algorithm="hierarchical", wire_dtype=wire,
+                          wire_inner="bf16")
+            return True
+
+        assert all(run_ranks(fn))
+        return hop("inner") - i0, hop("cross") - c0
+
+    n = 1 << 14
+    di8, dc8 = run_one("int8", "m.hop.i8")
+    di4, dc4 = run_one("int4", "m.hop.i4")
+    # inner hop: 2 passes (scatter + gather) at bf16 width
+    assert di8 == di4 == 2 * n * 2, (di8, di4)
+    # cross hop: int4 rides int8 partials at 2 hosts — half int8's
+    # int16 partials
+    assert 0 < dc4 < dc8, (dc4, dc8)
+    assert dc8 <= n * 2 + 256, dc8       # int16 partials + scales
+    assert dc4 <= n * 1 + 256, dc4       # int8 partials + scales
+
+
+def test_wire_inner_mismatch_fails_loudly(live_engine):
+    """Ranks disagreeing on the inner-hop wire would issue different
+    SPMD programs — negotiation must reject, like a dtype mismatch."""
+    from horovod_tpu.common.exceptions import TensorShapeMismatchError
+
+    def fn():
+        r = hvd.rank()
+        iw = "bf16" if r == 0 else "f32"
+        x = np.ones(8, np.float32)
+        try:
+            hvd.allreduce(x, op=hvd.Sum, name="m.iwmix",
+                          algorithm="torus", wire_dtype="int8",
+                          wire_inner=iw)
+            return False
+        except TensorShapeMismatchError:
+            return True
+
+    assert all(run_ranks(fn))
+
+
+def test_quantized_inner_wire_rejected(live_engine):
+    """int8/int4 on the ICI hop is never legal — the API must reject
+    it loudly (quantize.normalize_inner_wire), not silently degrade."""
+    def fn():
+        x = np.ones(8, np.float32)
+        try:
+            hvd.allreduce(x, op=hvd.Sum, name="m.badiw",
+                          wire_inner="int4")
+            return False
+        except ValueError:
+            return True
+
+    assert all(run_ranks(fn))
+
+
+def test_per_hop_ef_state_reset_on_resize(two_host_topology):
+    """Satellite (ISSUE 9): per-hop EF residuals are DEVICE state
+    keyed by executor — reset_wire_state() must drop them, and an
+    executor swap (elastic resize) must purge the old mesh's entries
+    so a post-resize step can never inject stale residual shapes.
+
+    The rank threads share one engine, so every global mutation
+    (state inspection, reset, executor swap, restore) runs on rank 0
+    only, fenced by barriers — ranks racing their own swaps would
+    rendezvous against different executors.  Barrier timeouts turn a
+    rank-0 assertion failure into BrokenBarrierError on the peers
+    instead of a deadlock."""
+    import threading
+    from horovod_tpu.common import basics
+    from horovod_tpu.ops import compiled as comp
+
+    bar = threading.Barrier(NP)
+    shared = {}
+
+    def fence():
+        bar.wait(timeout=120)
+
+    def fn():
+        red = hvd.CompiledGroupedAllreduce(
+            op=hvd.Sum, wire_dtype="int4", algorithm="torus",
+            error_feedback=True, force_program=True, name="m.efreset")
+        rng = np.random.default_rng(hvd.rank())
+        x = rng.standard_normal(600).astype(np.float32)
+        red([x])
+        fence()
+        if hvd.rank() == 0:
+            with comp._EF_LOCK:
+                n_state = len(comp._EF_STATE)
+                shapes = [tuple(r.shape)
+                          for v in comp._EF_STATE.values() for r in v]
+            # the decomposed EF program materialized its sharded
+            # residual
+            assert n_state >= 1 and shapes, (n_state, shapes)
+            # reset drops it (the elastic on_reset contract)
+            red.reset_wire_state()
+            with comp._EF_LOCK:
+                assert not comp._EF_STATE
+        fence()
+        # run again, then simulate a resize: a NEW executor for the
+        # same set must purge the old executor's entries on first use
+        red([x])
+        fence()
+        if hvd.rank() == 0:
+            eng = basics.engine()
+            ps = eng.process_sets[0]
+            with comp._EF_LOCK:
+                shared["old_keys"] = set(comp._EF_STATE)
+            assert shared["old_keys"]
+            shared["ps"] = ps
+            shared["old_ex"] = ps.executor
+            ps.executor = eng._MeshExecutor(ps.executor.devices,
+                                            ps.executor.num_ranks)
+        fence()
+        try:
+            red([x])
+            fence()
+            if hvd.rank() == 0:
+                with comp._EF_LOCK:
+                    # old executor's residuals were purged; only the
+                    # new mesh's state remains
+                    assert not (shared["old_keys"]
+                                & set(comp._EF_STATE))
+                    assert comp._EF_STATE
+        finally:
+            fence()
+            if hvd.rank() == 0:
+                shared["ps"].executor = shared["old_ex"]
+                comp.reset_ef_state()
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_int4_on_dcn_error_feedback_convergence(live_engine):
+    """ISSUE 9 acceptance: the int4 wire ON THE CROSS-HOST HOP (per-
+    hop pair via a hierarchical decomposition over a simulated 2-host
+    layout) with error feedback converges within 1% of the f32-wire
+    loss — the EF21 story extended to the narrowest wire format."""
+    import horovod_tpu.torch as thvd
+    from horovod_tpu.common import basics
+    from horovod_tpu.common.topology import Topology
+
+    eng = basics.engine()
+    old_topo, old_algo = eng.topology, eng.config.algorithm
+    f32_loss = _train_tiny_lm(thvd.Compression.none)
+    eng.topology = Topology(size=NP, host_of_rank=[0, 0, 1, 1])
+    eng.config.algorithm = "hierarchical"
+    try:
+        int4_loss = _train_tiny_lm(thvd.Compression.int4)
+        # the decomposed path really ran (not a silent flat fallback)
+        assert eng.algo_runs.get("hierarchical", 0) > 0
+    finally:
+        eng.topology, eng.config.algorithm = old_topo, old_algo
+    assert f32_loss < 1.0, f"baseline failed to learn: {f32_loss}"
+    assert abs(int4_loss - f32_loss) <= 0.01 * f32_loss + 1e-3, \
+        (int4_loss, f32_loss)

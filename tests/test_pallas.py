@@ -203,6 +203,107 @@ def test_fake_quantize_blockwise_vjp_is_straight_through():
                                   np.full(x.shape, 3.0, np.float32))
 
 
+def test_quantize_blockwise_int4_matches_numpy_codec():
+    """All three int4 implementations (numpy / pure-XLA / Pallas) must
+    agree bit-for-bit — packed nibbles AND bf16 scales — the same
+    purity contract the int8 codec carries (error feedback re-runs
+    the encoder host-side)."""
+    import jax.numpy as jnp
+
+    from horovod_tpu.ops import quantize as qz
+    from horovod_tpu.ops.pallas_kernels import (
+        dequantize_blockwise_int4, quantize_blockwise_int4)
+
+    x = np.random.default_rng(5).standard_normal(70_000) \
+        .astype(np.float32)
+    qn, sn, n = qz.np_quantize_blockwise_int4(x)
+    # pallas
+    q, s = quantize_blockwise_int4(jnp.asarray(x), interpret=True)
+    assert np.array_equal(np.asarray(q)[:qn.size], qn)
+    np.testing.assert_array_equal(np.asarray(s)[:sn.size],
+                                  sn.astype(np.float32))
+    out = dequantize_blockwise_int4(q, s, n, interpret=True)
+    np.testing.assert_array_equal(
+        np.asarray(out), qz.np_dequantize_blockwise_int4(qn, sn, n))
+    # pure XLA
+    qx, sx = qz.quantize_blockwise_int4_xla(jnp.asarray(x))
+    assert np.array_equal(np.asarray(qx), qn)
+    np.testing.assert_array_equal(np.asarray(sx),
+                                  sn.astype(np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(qz.dequantize_blockwise_int4_xla(qx, sx, n)),
+        qz.np_dequantize_blockwise_int4(qn, sn, n))
+
+
+def test_int4_nibble_pack_roundtrip_property():
+    """Property test over the full code range: every int4 code in
+    [-7, 7], at every parity position, survives pack -> unpack
+    exactly (the biased-nibble layout is lossless by construction)."""
+    from horovod_tpu.ops import quantize as qz
+
+    rng = np.random.default_rng(7)
+    for _ in range(20):
+        q = rng.integers(-7, 8, size=512).astype(np.int8)
+        np.testing.assert_array_equal(
+            qz.np_unpack_nibbles(qz.np_pack_nibbles(q)), q)
+    # exhaustive pair coverage: all 15 x 15 nibble combinations
+    lo, hi = np.meshgrid(np.arange(-7, 8), np.arange(-7, 8))
+    q = np.stack([lo.ravel(), hi.ravel()], axis=1).reshape(-1) \
+        .astype(np.int8)
+    np.testing.assert_array_equal(
+        qz.np_unpack_nibbles(qz.np_pack_nibbles(q)), q)
+
+
+def test_quantize_blockwise_int4_error_bound():
+    """Per-element error is bounded by half the block scale
+    (absmax / 14) — the bound the int4 wire's accuracy story (and
+    the WIRE_ATOL the op matrix uses) rests on."""
+    from horovod_tpu.ops import quantize as qz
+
+    x = (np.random.default_rng(11).standard_normal(8192) * 5) \
+        .astype(np.float32)
+    out = qz.np_fake_quantize_blockwise_int4(x)
+    blocks = x.reshape(-1, 256)
+    bound = (np.abs(blocks).max(axis=1) / 14 + 1e-7)[:, None]
+    assert np.all(np.abs(out.reshape(-1, 256) - blocks)
+                  <= bound * 1.01)
+
+
+def test_fake_quantize_blockwise_int4_vjp_is_straight_through():
+    from horovod_tpu.ops import quantize as qz
+    from horovod_tpu.ops.pallas_kernels import \
+        fake_quantize_blockwise_int4
+
+    x = jnp.asarray(np.random.default_rng(13)
+                    .standard_normal((2, 600)).astype(np.float32))
+    fq = fake_quantize_blockwise_int4(x)
+    np.testing.assert_array_equal(
+        np.asarray(fq),
+        qz.np_fake_quantize_blockwise_int4(np.asarray(x)))
+    g = jax.grad(
+        lambda v: jnp.sum(fake_quantize_blockwise_int4(v) * 2.0))(x)
+    np.testing.assert_array_equal(np.asarray(g),
+                                  np.full(x.shape, 2.0, np.float32))
+
+
+def test_quantized_psum_acc_bounds():
+    """The documented exact-rank bounds: the accumulator is the
+    narrowest integer whose psum of maxed-out codes stays exact —
+    int4 rides an int8 operand (half int8's transport) to 18 ranks."""
+    from horovod_tpu.ops import quantize as qz
+
+    assert qz.quantized_acc_dtype_np(8, 258) == np.dtype(np.int16)
+    assert qz.quantized_acc_dtype_np(8, 259) == np.dtype(np.int32)
+    assert qz.quantized_acc_dtype_np(4, 18) == np.dtype(np.int8)
+    assert qz.quantized_acc_dtype_np(4, 19) == np.dtype(np.int16)
+    assert qz.quantized_acc_dtype_np(4, 4681) == np.dtype(np.int16)
+    assert qz.quantized_acc_dtype_np(4, 4682) == np.dtype(np.int32)
+    # wire accounting follows the operand width
+    n = 1 << 20
+    assert qz.quantized_psum_wire_nbytes(n, 2, bits=4) < \
+        qz.quantized_psum_wire_nbytes(n, 2, bits=8)
+
+
 def test_quantize_blockwise_zero_and_tiny_blocks():
     """All-zero blocks encode with scale 0 and decode to exact zeros;
     sub-block inputs pad with zeros that round-trip losslessly."""

@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""``ci.sh perf`` — the performance regression gate (ROADMAP item 5,
+first slice).
+
+Runs the collective_bench sweeps that produce docs/benchmarks.md's
+headline numbers and compares the results against the checked-in
+``benchmarks/BASELINE.json`` tolerance band, so the wins PR 1-2 and
+the per-hop wire PR measured (3.97x int8 / 7.88x int4 codec wire, the
+fused-per-hop-vs-staged-int8 goodput ratio, the cross-hop byte
+budgets) cannot silently regress.
+
+Two metric classes, different tolerances:
+
+* **byte-accounting metrics** (wire ratios, per-hop cross/inner
+  bytes) are deterministic — they regress only when someone changes
+  the codec or the accounting, so the band is tight (3-5%) and
+  TWO-SIDED: bytes disappearing from a hop counter is as much an
+  accounting regression as bytes appearing;
+* **goodput metrics** (MB/s, fused-vs-staged ratio) are wall-clock on
+  a shared CI runner — the band is wide (50%), and the metrics that
+  encode an ISSUE acceptance bar additionally carry an ABSOLUTE floor
+  that no amount of baseline drift can lower (e.g. the fused per-hop
+  path must stay above 1.54x the staged int8 path, the figure the
+  per-hop wire PR had to beat).
+
+``--update-baseline`` re-records the measured values (the tolerance
+spec lives here in code, the values in the JSON); use it after an
+intentional perf-affecting change, exactly like hvdlint's baseline
+escape hatch.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE_PATH = os.path.join(REPO, "benchmarks", "BASELINE.json")
+
+BENCHES = {
+    "wire": ["benchmarks/collective_bench.py", "--np", "4", "--cpu",
+             "--wire-dtype", "all", "--iters", "6"],
+    "pair": ["benchmarks/collective_bench.py", "--np", "4", "--cpu",
+             "--wire-pair", "all", "--iters", "6"],
+}
+
+# metric -> (bench, extractor, direction, relative tolerance,
+#            absolute bound or None).  direction 'min': measured must
+#  stay ABOVE baseline*(1-tol) (higher is better); 'max': measured
+#  must stay BELOW baseline*(1+tol) (lower is better); 'eq': measured
+#  must stay WITHIN baseline*(1±tol) — the deterministic
+#  byte-accounting metrics, where a drift in EITHER direction means
+#  the codec or the accounting changed (bytes vanishing from the
+#  cross-hop counter is as much a regression as bytes appearing).
+#  The absolute bound encodes acceptance bars independent of the
+#  recorded baseline ('eq' treats it as a floor — the ratio metrics
+#  are higher-is-better).
+METRICS = {
+    # codec wire ratios — deterministic byte accounting
+    "wire_int8_reduction_vs_f32": (
+        "wire",
+        lambda d: d["wire_f32_engine_wire_bytes"]
+        / d["wire_int8_engine_wire_bytes"],
+        "eq", 0.03, 3.8),
+    "wire_int4_reduction_vs_f32": (
+        "wire",
+        lambda d: d["wire_f32_engine_wire_bytes"]
+        / d["wire_int4_engine_wire_bytes"],
+        "eq", 0.03, 7.5),
+    # per-hop cross/inner budgets — deterministic accounting of what
+    # each hop moves per 8 MiB call (the decomposition's whole point)
+    "pair_f32_int8_cross_bytes": (
+        "pair", lambda d: d["pair_f32_int8_cross_bytes"],
+        "eq", 0.05, None),
+    "pair_f32_int4_cross_bytes": (
+        "pair", lambda d: d["pair_f32_int4_cross_bytes"],
+        "eq", 0.05, None),
+    "pair_bf16_int4_inner_bytes": (
+        "pair", lambda d: d["pair_bf16_int4_inner_bytes"],
+        "eq", 0.05, None),
+    # goodput — wall clock, wide band; the fused-vs-staged ratio
+    # carries the per-hop PR's acceptance floor as an absolute bound
+    "fused_per_hop_vs_staged_int8": (
+        "pair", lambda d: d["fused_per_hop_vs_staged_int8"],
+        "min", 0.5, 1.54),
+    "pair_f32_int8_engine_MBps": (
+        "pair", lambda d: d["pair_f32_int8_engine_MBps"],
+        "min", 0.5, None),
+    "wire_int8_engine_MBps": (
+        "wire", lambda d: d["wire_int8_engine_MBps"],
+        "min", 0.5, None),
+}
+
+
+def run_bench(args_list):
+    """Run one collective_bench invocation, return its JSON row (the
+    last stdout line)."""
+    cmd = [sys.executable] + args_list
+    print(f"[perf] running: {' '.join(args_list)}", flush=True)
+    out = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                         timeout=900)
+    if out.returncode != 0:
+        sys.stderr.write(out.stdout[-4000:] + out.stderr[-4000:])
+        raise RuntimeError(f"bench failed: {' '.join(args_list)}")
+    for line in reversed(out.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError("bench produced no JSON row")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="record the measured values as the new "
+                         "baseline instead of gating")
+    ap.add_argument("--baseline", default=BASELINE_PATH)
+    opts = ap.parse_args()
+
+    results = {name: run_bench(args) for name, args in BENCHES.items()}
+    measured = {}
+    for metric, (bench, extract, *_rest) in METRICS.items():
+        measured[metric] = round(float(extract(results[bench])), 3)
+
+    if opts.update_baseline:
+        payload = {
+            "_comment": "perf-gate baseline (tools/perf_gate.py; "
+                        "ci.sh perf).  Values only — the tolerance "
+                        "band and absolute acceptance floors live in "
+                        "the gate's METRICS table.",
+            "metrics": measured,
+        }
+        with open(opts.baseline, "w") as f:
+            json.dump(payload, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"[perf] baseline updated: {opts.baseline}")
+        for k, v in sorted(measured.items()):
+            print(f"[perf]   {k} = {v}")
+        return 0
+
+    with open(opts.baseline) as f:
+        baseline = json.load(f)["metrics"]
+
+    failures = []
+    for metric, (bench, _x, direction, tol, floor) in METRICS.items():
+        got = measured[metric]
+        base = baseline.get(metric)
+        lines = [f"{metric}: measured {got}"]
+        ok = True
+        if base is not None:
+            if direction == "eq":
+                lo, hi = base * (1 - tol), base * (1 + tol)
+                if not lo <= got <= hi:
+                    ok = False
+                lines.append(f"baseline {base} (must stay within "
+                             f"[{lo:.3f}, {hi:.3f}])")
+            elif direction == "min":
+                bound = base * (1 - tol)
+                if got < bound:
+                    ok = False
+                lines.append(f"baseline {base} (must stay >= "
+                             f"{bound:.3f})")
+            else:
+                bound = base * (1 + tol)
+                if got > bound:
+                    ok = False
+                lines.append(f"baseline {base} (must stay <= "
+                             f"{bound:.3f})")
+        if floor is not None:
+            if direction in ("min", "eq") and got < floor:
+                ok = False
+            if direction == "max" and got > floor:
+                ok = False
+            lines.append(f"absolute bar {floor}")
+        status = "ok  " if ok else "FAIL"
+        print(f"[perf] {status} {' | '.join(lines)}")
+        if not ok:
+            failures.append(metric)
+
+    if failures:
+        print(f"[perf] REGRESSION: {len(failures)} metric(s) out of "
+              f"band: {', '.join(failures)} — if intentional, rerun "
+              "with --update-baseline and commit the new "
+              "benchmarks/BASELINE.json")
+        return 1
+    print("[perf] gate green")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,11 +26,23 @@ import jax.numpy as jnp
 import optax
 
 
-def bench_impl(impl, cfg, tokens, mesh, iters, warmup):
+def parse_parallelism(text):
+    """``--parallelism dp,tp,pp`` → (dp, tp, pp) ints (docs/
+    parallelism.md; pp > 1 routes through the MPMD runtime)."""
+    parts = [int(x) for x in str(text).split(",")]
+    if len(parts) != 3 or any(x < 1 for x in parts):
+        raise ValueError(
+            f"--parallelism wants 'dp,tp,pp' positive ints, got "
+            f"{text!r}")
+    return tuple(parts)
+
+
+def bench_impl(impl, cfg, tokens, mesh, iters, warmup, pipeline=None):
     from horovod_tpu.parallel import make_lm_train_step
 
     init, _, jit_step, tok_shd = make_lm_train_step(
-        mesh, cfg, optimizer=optax.adamw(1e-3), attention_impl=impl)
+        mesh, cfg, optimizer=optax.adamw(1e-3), attention_impl=impl,
+        pipeline=pipeline)
     if iters < 1 or warmup < 1:
         raise ValueError("--iters and --warmup must be >= 1")
     state = init(jax.random.PRNGKey(0), tokens)
@@ -62,28 +74,74 @@ def main():
                         "sequences on one 16G chip)")
     p.add_argument("--decode", action="store_true",
                    help="also measure KV-cache generation tokens/sec")
+    p.add_argument("--parallelism", default=None,
+                   help="'dp,tp,pp' decomposition over the local "
+                        "devices; pp > 1 runs the MPMD pipeline "
+                        "runtime (docs/parallelism.md)")
+    p.add_argument("--pipeline-schedule", default="1f1b",
+                   choices=["gpipe", "1f1b", "interleaved"])
+    p.add_argument("--microbatches", type=int, default=0,
+                   help="microbatches per pipelined step (0 = auto)")
+    p.add_argument("--cpu", type=int, default=0, metavar="N",
+                   help="run on N virtual CPU devices (multi-device "
+                        "pipeline smoke without a TPU)")
     args = p.parse_args()
 
+    if args.cpu:
+        os.environ["HOROVOD_TPU_PLATFORM"] = "cpu"
+        os.environ.setdefault(
+            "XLA_FLAGS",
+            f"--xla_force_host_platform_device_count={args.cpu}")
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        # jax captured JAX_PLATFORMS at import; the config update is
+        # what actually forces CPU on a TPU host (scaling.py idiom)
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            jax.config.update("jax_num_cpu_devices", args.cpu)
+        except AttributeError:
+            pass   # older jax: XLA_FLAGS is the only lever
+
     from horovod_tpu.models import TransformerConfig
-    from horovod_tpu.parallel import MeshSpec, build_mesh
+    from horovod_tpu.parallel import (
+        MeshSpec, PipelineSpec, build_mesh, bubble_fraction,
+    )
 
     cfg = TransformerConfig(
         vocab_size=32000, d_model=args.d_model, n_layers=args.layers,
         n_heads=args.heads, d_ff=4 * args.d_model,
         max_seq_len=args.seq, dtype=jnp.bfloat16, remat=args.remat)
-    mesh = build_mesh(MeshSpec(dp=1), jax.devices()[:1])
+    pipeline = None
+    if args.parallelism:
+        dp, tp, pp = parse_parallelism(args.parallelism)
+        mesh = build_mesh(MeshSpec(dp=dp, tp=tp, pp=pp),
+                          jax.devices()[: dp * tp * pp])
+        if pp > 1:
+            pipeline = PipelineSpec(pp=pp, dp=dp, tp=tp,
+                                    n_micro=args.microbatches,
+                                    schedule=args.pipeline_schedule)
+            r = pipeline.resolved()
+            out_pp = {"parallelism": {"dp": dp, "tp": tp, "pp": pp},
+                      "pipeline_schedule": r.schedule,
+                      "n_microbatches": r.n_micro,
+                      "bubble_fraction": round(bubble_fraction(
+                          r.schedule, pp, r.n_micro, r.chunks), 4)}
+        else:
+            out_pp = {"parallelism": {"dp": dp, "tp": tp, "pp": pp}}
+    else:
+        mesh = build_mesh(MeshSpec(dp=1), jax.devices()[:1])
+        out_pp = {}
     tokens = jax.random.randint(
         jax.random.PRNGKey(1), (args.batch, args.seq), 0, cfg.vocab_size)
 
     out = {"batch": args.batch, "seq": args.seq,
-           "d_model": args.d_model, "layers": args.layers}
+           "d_model": args.d_model, "layers": args.layers, **out_pp}
     for impl in args.impls.split(","):
         impl = impl.strip()
         # "dense" = the default XLA S^2 softmax path ("ring" without
         # sequence_parallel is the single-shard dense fallback)
         tps, loss = bench_impl("ring" if impl == "dense" else impl,
                                cfg, tokens, mesh, args.iters,
-                               args.warmup)
+                               args.warmup, pipeline=pipeline)
         out[f"{impl}_tokens_per_sec"] = round(tps, 1)
         out[f"{impl}_loss"] = round(loss, 4)
     if "flash_tokens_per_sec" in out and "dense_tokens_per_sec" in out:

@@ -136,21 +136,60 @@ def bench_raw(cfg, tokens, iters, warmup, fused_ce=True):
     return tokens.size * iters / dt
 
 
-def make_report(tps, loss, cfg):
+def make_report(tps, loss, cfg, n_chips=1):
     """The headline metric dict — shared by this CLI and bench.py so
-    the MFU convention and metric key cannot drift apart."""
+    the MFU convention and metric key cannot drift apart.  Multi-chip
+    runs (``--parallelism``) report PER-CHIP tok/s and MFU against
+    the single-chip peak, so the number stays comparable to the
+    headline."""
     fpt = lm_train_flops_per_token(cfg)
-    return {
+    per_chip = tps / max(n_chips, 1)
+    out = {
         "metric": "lm436m_train_tokens_per_sec_per_chip_hvd",
-        "value": round(tps, 1),
+        "value": round(per_chip, 1),
         "unit": "tokens/sec",
         "loss": round(loss, 4),
-        "model_tflops_per_sec": round(tps * fpt / 1e12, 2),
+        "model_tflops_per_sec": round(per_chip * fpt / 1e12, 2),
         "mfu_vs_measured_peak_pct": round(
-            100 * tps * fpt / 1e12 / MEASURED_PEAK_TFLOPS, 1),
+            100 * per_chip * fpt / 1e12 / MEASURED_PEAK_TFLOPS, 1),
         "flops_per_token_g": round(fpt / 1e9, 3),
         "peak_tflops": MEASURED_PEAK_TFLOPS,
     }
+    if n_chips > 1:
+        out["n_chips"] = n_chips
+        out["total_tokens_per_sec"] = round(tps, 1)
+    return out
+
+
+def bench_pipelined(cfg, tokens, iters, warmup, parallelism,
+                    schedule, n_micro):
+    """Through make_lm_train_step(pipeline=...) — the MPMD dp×tp×pp
+    runtime (docs/parallelism.md) with the flash attention kernel."""
+    import jax
+    import optax
+
+    from horovod_tpu.parallel import (
+        MeshSpec, PipelineSpec, build_mesh, make_lm_train_step,
+    )
+
+    dp, tp, pp = parallelism
+    mesh = build_mesh(MeshSpec(dp=dp, tp=tp, pp=pp),
+                      jax.devices()[: dp * tp * pp])
+    spec = PipelineSpec(pp=pp, dp=dp, tp=tp, n_micro=n_micro,
+                        schedule=schedule)
+    init, step, _, tok_shd = make_lm_train_step(
+        mesh, cfg, optimizer=optax.adamw(1e-3),
+        attention_impl="flash", pipeline=spec)
+    state = init(jax.random.PRNGKey(0), tokens)
+    for _ in range(warmup):
+        state, loss = step(state, tokens)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = step(state, tokens)
+    lv = float(loss)
+    dt = time.perf_counter() - t0
+    return tokens.size * iters / dt, lv, spec.resolved()
 
 
 def main():
@@ -172,9 +211,36 @@ def main():
     p.add_argument("--flash-bwd-block", type=int, default=None,
                    help="independent flash BACKWARD kernel block size "
                         "(default: same as forward, 512)")
+    p.add_argument("--parallelism", default=None,
+                   help="'dp,tp,pp' decomposition over the local "
+                        "devices; pp > 1 runs the headline model "
+                        "through the MPMD pipeline runtime "
+                        "(docs/parallelism.md)")
+    p.add_argument("--pipeline-schedule", default="1f1b",
+                   choices=["gpipe", "1f1b", "interleaved"])
+    p.add_argument("--microbatches", type=int, default=0,
+                   help="microbatches per pipelined step (0 = auto)")
     args = p.parse_args()
 
     cfg, tokens = build(args)
+    if args.parallelism:
+        from lm_bench import parse_parallelism
+
+        from horovod_tpu.parallel import bubble_fraction
+
+        dp, tp, pp = parse_parallelism(args.parallelism)
+        tps, loss, spec = bench_pipelined(
+            cfg, tokens, args.iters, args.warmup, (dp, tp, pp),
+            args.pipeline_schedule, args.microbatches)
+        out = make_report(tps, loss, cfg, n_chips=dp * tp * pp)
+        out["parallelism"] = {"dp": dp, "tp": tp, "pp": pp}
+        if pp > 1:
+            out["pipeline_schedule"] = spec.schedule
+            out["n_microbatches"] = spec.n_micro
+            out["bubble_fraction"] = round(bubble_fraction(
+                spec.schedule, pp, spec.n_micro, spec.chunks), 4)
+        print(json.dumps(out))
+        return
     tps, loss = bench_framework(cfg, tokens, args.iters, args.warmup,
                                 fused_ce=not args.no_fused_ce,
                                 ce_chunks=args.ce_chunks,

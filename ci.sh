@@ -28,6 +28,10 @@
 #                         #   kill one replica mid-traffic (fault
 #                         #   plan), zero dropped requests, job-wide
 #                         #   SLO families + liveness on /metrics
+#   ./ci.sh pp            # smoke: 4-proc 2-stage MPMD pipeline job —
+#                         #   loss parity with the dense run, per-
+#                         #   stage timeline lanes, zero steady-state
+#                         #   recompiles
 #   ./ci.sh bench         # smoke: one bench.py run (real chip if any)
 #   ./ci.sh perf          # gate: collective_bench sweeps vs the
 #                         #   checked-in benchmarks/BASELINE.json
@@ -172,6 +176,27 @@ case "${1:-all}" in
     # serving-tier throughput/latency (batcher + compiled dispatch
     # under closed-loop load) — the docs/benchmarks.md serving row
     python benchmarks/serve_bench.py
+    # pipelined LM training on the 8-device virtual mesh: dp×pp and
+    # dp×tp×pp through the MPMD runtime (1f1b + interleaved vs the
+    # gpipe fallback) — the docs/benchmarks.md pipeline rows report
+    # tok/s next to each schedule's analytic bubble fraction
+    python benchmarks/lm_bench.py --cpu 8 --batch 8 --seq 128 \
+      --d-model 64 --layers 4 --heads 4 --iters 4 --warmup 1 \
+      --impls dense --parallelism 2,1,4 --pipeline-schedule 1f1b \
+      --microbatches 4
+    python benchmarks/lm_bench.py --cpu 8 --batch 8 --seq 128 \
+      --d-model 64 --layers 4 --heads 4 --iters 4 --warmup 1 \
+      --impls dense --parallelism 2,2,2 --pipeline-schedule \
+      interleaved --microbatches 4
+    ;;
+  pp)
+    # pipeline smoke (docs/parallelism.md): a REAL 4-process 2-stage
+    # dp×pp LM job through the MPMD runtime — per-step loss parity
+    # with the dense single-process run, per-stage pp.stage<k> lanes
+    # present in the merged GET /timeline, and ZERO steady-state
+    # recompiles per the compiled-program-cache counters on the
+    # job-wide /metrics
+    python tools/pp_smoke.py
     ;;
   refsuite)
     # the REFERENCE's own torch test suite, run unmodified against
@@ -230,7 +255,7 @@ case "${1:-all}" in
     python -m pytest $PART4 -q
     ;;
   *)
-    echo "usage: $0 {analyze|fast|matrix|integration|chaos|trace|metrics|serve|bench|perf|all}" >&2
+    echo "usage: $0 {analyze|fast|matrix|integration|chaos|trace|metrics|serve|pp|bench|perf|all}" >&2
     exit 2
     ;;
 esac

@@ -141,6 +141,24 @@ HOROVOD_CONV_BN_BWD = "HOROVOD_CONV_BN_BWD"
 # hvd_pack_mt); a third autotune dimension
 HOROVOD_TPU_PACK_MT_THRESHOLD = "HOROVOD_TPU_PACK_MT_THRESHOLD"
 
+# MPMD pipeline runtime (docs/parallelism.md "MPMD pipeline runtime";
+# parallel/runtime.py + schedule.py): number of pipeline stages the
+# job is carved into (1 = no pipelining), microbatches per step (0 =
+# auto: 2·pp for every schedule), the schedule (gpipe |
+# 1f1b | interleaved), and model chunks per stage for the interleaved
+# schedule.  horovodrun --pipeline-stages / --num-microbatches /
+# --pipeline-schedule hand these off; (schedule, n_micro) is also the
+# autotuner's seventh dimension, latched per negotiation entry and
+# cross-rank validated like the wire pair and algorithm.
+HOROVOD_PP_STAGES = "HOROVOD_PP_STAGES"
+HOROVOD_PP_MICROBATCHES = "HOROVOD_PP_MICROBATCHES"
+HOROVOD_PP_SCHEDULE = "HOROVOD_PP_SCHEDULE"
+HOROVOD_PP_CHUNKS = "HOROVOD_PP_CHUNKS"
+# autotune warm-start cache (docs/autotune.md "Warm start"): a local
+# JSON file of converged best configs keyed by (bucket signature,
+# topology, world size); jobs reload yesterday's optimum at start
+HOROVOD_AUTOTUNE_CACHE = "HOROVOD_AUTOTUNE_CACHE"
+
 #: Launcher↔worker handoff ABI: env vars the launcher exports for its
 #: own workers and users never set by hand.  hvdlint checker 5
 #: (`knob-undocumented`) exempts these from the docs/migration.md
@@ -326,6 +344,8 @@ class Config:
         self.autotune_log = get_str(HOROVOD_AUTOTUNE_LOG)
         self.autotune_warmup_samples = get_int(HOROVOD_AUTOTUNE_WARMUP_SAMPLES, 3)
         self.autotune_steps_per_sample = get_int(HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE, 10)
+        self.autotune_max_samples = get_int(
+            HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES, 20)
         self.stall_check_disable = get_bool(HOROVOD_STALL_CHECK_DISABLE)
         self.stall_warning_secs = get_float(
             HOROVOD_STALL_CHECK_TIME_SECONDS, DEFAULT_STALL_WARNING_SECS)
@@ -374,3 +394,25 @@ class Config:
         # chaos fault plan (raw source; parsed by chaos.plan_from_env
         # at init so a malformed plan fails loudly, not silently)
         self.fault_plan = get_str(HOROVOD_FAULT_PLAN)
+        # MPMD pipeline runtime (parallel/runtime.py): stage count,
+        # schedule and microbatch count.  (pp_schedule, pp_n_micro)
+        # is ONE autotune categorical (the seventh dimension) — the
+        # runtime latches the pair at each step start, and the engine
+        # latches it per negotiation entry on the step's gradient
+        # reduces so a mid-step autotune flip can never split one
+        # step across two schedules.
+        self.pp_stages = get_int(HOROVOD_PP_STAGES, 1)
+        raw_sched = get_str(HOROVOD_PP_SCHEDULE)
+        if raw_sched:
+            # lazy: importing parallel.schedule executes the whole
+            # parallel package (flax models, attention helpers) —
+            # only jobs that actually set a schedule pay that, and
+            # they import it again at make_lm_train_step anyway
+            from ..parallel.schedule import normalize_schedule
+            self.pp_schedule = normalize_schedule(raw_sched) or "1f1b"
+        else:
+            self.pp_schedule = "1f1b"
+        self.pp_n_micro = get_int(HOROVOD_PP_MICROBATCHES, 0)
+        self.pp_chunks = get_int(HOROVOD_PP_CHUNKS, 0)
+        # autotune warm-start cache file (core/autotune.py load/save)
+        self.autotune_cache = get_str(HOROVOD_AUTOTUNE_CACHE)

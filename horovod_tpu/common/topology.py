@@ -138,6 +138,57 @@ def plan_decomposition(algorithm, topology, ranks):
     return local
 
 
+def carve_stage_ranks(topology, n_stages, ranks=None):
+    """Partition ``ranks`` into ``n_stages`` equal, contiguous pipeline
+    stages, preferring HOST-ALIGNED boundaries so the pp hops —
+    activations and activation gradients, the pipeline's only
+    steady-state cross-stage traffic — land on the cross-host/DCN hop
+    while each stage's dp×tp collectives stay on intra-host ICI
+    (arXiv:1909.09756's pod layout; the pp analogue of what
+    :func:`plan_decomposition` does for 2-stage reductions: slow
+    traffic on the outer hop, heavy traffic on the inner).
+
+    Stages must be EQUAL-SIZED (activations flow between
+    corresponding (dp, tp) peers of adjacent stages; unequal widths
+    would need a re-shard at every boundary), so the partition is the
+    contiguous equal split of the host-grouped rank list — which IS
+    host-aligned whenever any host-aligned equal partition exists,
+    including heterogeneous host:slots layouts (e.g. slots 3+1+1+3 at
+    pp=2: the boundary after the 4th rank falls between hosts).  When no
+    boundary lands on a host edge (or there is no host map) the same
+    split still runs, just with pp traffic riding ICI — reported via
+    the returned flag so callers can warn.
+
+    Returns ``(stage_ranks, host_aligned)``: a list of ``n_stages``
+    rank lists plus whether every boundary fell on a host boundary.
+    """
+    n_stages = int(n_stages)
+    if ranks is None:
+        ranks = list(range(topology.size if topology is not None else 0))
+    ranks = list(ranks)
+    if n_stages < 1:
+        raise ValueError(f"n_stages must be >= 1, got {n_stages}")
+    if not ranks or len(ranks) % n_stages != 0:
+        raise ValueError(
+            f"{len(ranks)} ranks not divisible into {n_stages} "
+            f"equal pipeline stages")
+    per = len(ranks) // n_stages
+    stages = [ranks[i * per:(i + 1) * per] for i in range(n_stages)]
+    if topology is None or n_stages == 1:
+        return stages, n_stages == 1
+    try:
+        hosts = [topology.host_of_rank[r] for r in ranks]
+    except IndexError:
+        return stages, False
+    # host-aligned only meaningful when ranks arrive grouped by host
+    # (the launcher's slot order)
+    if any(hosts[i] > hosts[i + 1] for i in range(len(hosts) - 1)):
+        return stages, False
+    aligned = all(i == len(ranks) or hosts[i - 1] != hosts[i]
+                  for i in range(per, len(ranks), per))
+    return stages, aligned
+
+
 @dataclass
 class Topology:
     """Static rank layout for one job."""

@@ -28,6 +28,8 @@ topology silently execute flat (engine._algo_plan), so a sweep never
 breaks a job — it just scores the fallback.
 """
 
+import json
+import os
 import time
 
 import numpy as np
@@ -35,6 +37,12 @@ import numpy as np
 from .optim import BayesianOptimizer
 from ..common.topology import ALGORITHMS
 from ..ops.quantize import WIRE_PAIR_CHOICES, wire_pair_label
+# PP_CHOICES / pp_label load lazily in ParameterManager.__init__:
+# importing parallel.schedule executes the whole parallel package
+# (flax models, attention helpers), which only pipeline-tuning jobs
+# should pay — the same deal common/env.py strikes for pp_schedule
+PP_CHOICES = None
+pp_label = None
 
 # log2 bounds: fusion threshold 1 MiB .. 256 MiB, cycle 0.5 .. 32 ms,
 # MT-pack threshold 1 MiB .. 64 MiB, cache capacity 0 .. 4096 entries
@@ -47,7 +55,8 @@ _CACHE_BITS = 12.0
 class ParameterManager:
     def __init__(self, config, warmup_samples=3, steps_per_sample=10,
                  max_samples=20, log_path=None, seed=0, tune_wire=True,
-                 tune_algorithm=True):
+                 tune_algorithm=True, tune_pipeline=False,
+                 cache_path=None, topo_fp="local", world_size=1):
         self.config = config
         self.warmup_samples = warmup_samples
         self.steps_per_sample = steps_per_sample
@@ -63,7 +72,29 @@ class ParameterManager:
         # CSV
         self.tune_wire = bool(tune_wire)
         self.tune_algorithm = bool(tune_algorithm)
-        dims = 4 + int(self.tune_wire) + int(self.tune_algorithm)
+        # seventh dimension: the pipeline (schedule, n_micro) pair —
+        # only swept when the job actually runs the MPMD pipeline
+        # runtime (config.pp_stages > 1); the runtime re-latches the
+        # pair at each step start and snaps an indivisible n_micro to
+        # the nearest legal value, so a sweep can propose any bin
+        # without breaking a step mid-flight
+        self.tune_pipeline = bool(tune_pipeline)
+        if self.tune_pipeline:
+            global PP_CHOICES, pp_label
+            from ..parallel.schedule import PP_CHOICES, pp_label
+        # warm start (docs/autotune.md "Warm start"): a local JSON
+        # cache of converged best configs keyed by (bucket signature,
+        # topology, world size) — production jobs start at
+        # yesterday's optimum instead of re-learning from scratch.
+        # The key completes when the engine notes the first fusion
+        # bucket's signature (note_bucket_signature); convergence
+        # persists under the same key.
+        self.cache_path = cache_path
+        self._key_suffix = f"{topo_fp}|np{int(world_size)}"
+        self._cache_key = None
+        self.warm_started = False
+        dims = 4 + int(self.tune_wire) + int(self.tune_algorithm) \
+            + int(self.tune_pipeline)
         self._bo = BayesianOptimizer(dims=dims, seed=seed)
         self._samples = 0
         self._steps = 0
@@ -75,22 +106,26 @@ class ParameterManager:
             getattr(config, "cache_capacity", 1024),
             (getattr(config, "wire_inner", None),
              getattr(config, "wire_dtype", None)),
-            getattr(config, "algorithm", None))
+            getattr(config, "algorithm", None),
+            (getattr(config, "pp_schedule", None),
+             getattr(config, "pp_n_micro", 0)))
         self._best_score = -np.inf
         self._best = self._current
         self._log = open(log_path, "w") if log_path else None
         if self._log:
             wire_col = "wire_pair," if self.tune_wire else ""
             algo_col = "algorithm," if self.tune_algorithm else ""
+            pp_col = "pipeline," if self.tune_pipeline else ""
             self._log.write(
                 "sample,fusion_threshold_bytes,cycle_time_ms,"
                 f"pack_mt_threshold_bytes,cache_capacity,{wire_col}"
-                f"{algo_col}score_bytes_per_sec\n")
+                f"{algo_col}{pp_col}score_bytes_per_sec\n")
 
     # -- encoding ------------------------------------------------------------
 
     def _encode(self, fusion_bytes, cycle_ms, pack_mt_bytes,
-                cache_capacity, wire_pair=None, algorithm=None):
+                cache_capacity, wire_pair=None, algorithm=None,
+                pp_pair=None):
         x0 = (np.log2(max(fusion_bytes, 1)) - _FUSION_LO) / \
             (_FUSION_HI - _FUSION_LO)
         x1 = (np.log2(max(cycle_ms, 2 ** _CYCLE_LO)) - _CYCLE_LO) / \
@@ -138,6 +173,22 @@ class ParameterManager:
             except ValueError:
                 ai = 0
             xs.append((ai + 0.5) / len(ALGORITHMS))
+        if self.tune_pipeline:
+            # seventh dimension: the pipeline (schedule, n_micro)
+            # pair over the PP_CHOICES enumeration; an incumbent
+            # n_micro outside the grid seeds the nearest bin of its
+            # schedule so its score is attributed to its own
+            # neighborhood, never to gpipe@2
+            sched, m = pp_pair or (None, 0)
+            sched = sched or "1f1b"
+            try:
+                pi = PP_CHOICES.index((sched, int(m or 0)))
+            except ValueError:
+                cands = [i for i, (s2, _) in enumerate(PP_CHOICES)
+                         if s2 == sched] or [0]
+                pi = min(cands, key=lambda i: abs(
+                    PP_CHOICES[i][1] - int(m or PP_CHOICES[i][1])))
+            xs.append((pi + 0.5) / len(PP_CHOICES))
         return np.clip(xs, 0.0, 1.0)
 
     def _decode(self, x):
@@ -157,6 +208,10 @@ class ParameterManager:
         if self.tune_algorithm:
             ai = min(int(x[i] * len(ALGORITHMS)), len(ALGORITHMS) - 1)
             out.append(ALGORITHMS[ai])
+            i += 1
+        if self.tune_pipeline:
+            pi = min(int(x[i] * len(PP_CHOICES)), len(PP_CHOICES) - 1)
+            out.append(PP_CHOICES[pi])
         return tuple(out)
 
     # -- recording (engine hot path) ----------------------------------------
@@ -189,12 +244,15 @@ class ParameterManager:
         decoded = self._decode(self._best)
         fusion, cycle, _, _ = decoded[:4]
         i = 4
-        wire = algo = ""
+        wire = algo = pipeline = ""
         if self.tune_wire:
             wire = wire_pair_label(*decoded[i])
             i += 1
         if self.tune_algorithm:
             algo = decoded[i]
+            i += 1
+        if self.tune_pipeline:
+            pipeline = pp_label(*decoded[i])
         best = reg.gauge(
             telemetry.AUTOTUNE_BEST_CONFIG_FAMILY,
             telemetry.AUTOTUNE_BEST_CONFIG_HELP,
@@ -205,7 +263,7 @@ class ParameterManager:
         best.labels(fusion_threshold_bytes=fusion,
                     # hvdlint: ignore[telemetry-unbounded-label] info-gauge: best.clear() above caps it at ONE live child; the label IS the payload
                     cycle_time_ms=f"{cycle:.3f}", wire=wire,
-                    algorithm=algo).set(1)
+                    algorithm=algo, pipeline=pipeline).set(1)
 
     def _finish_sample(self):
         elapsed = max(time.monotonic() - self._t0, 1e-6)
@@ -215,14 +273,18 @@ class ParameterManager:
             decoded = self._decode(self._current)
             fusion, cycle, pack_mt, cache = decoded[:4]
             i = 4
-            wire_col = ""
+            wire_col = algo_col = pp_col = ""
             if self.tune_wire:
                 wire_col = f"{wire_pair_label(*decoded[i])},"
                 i += 1
-            algo_col = f"{decoded[i]}," if self.tune_algorithm else ""
+            if self.tune_algorithm:
+                algo_col = f"{decoded[i]},"
+                i += 1
+            if self.tune_pipeline:
+                pp_col = f"{pp_label(*decoded[i])},"
             self._log.write(
                 f"{self._samples},{fusion},{cycle:.3f},{pack_mt},"
-                f"{cache},{wire_col}{algo_col}{score:.1f}\n")
+                f"{cache},{wire_col}{algo_col}{pp_col}{score:.1f}\n")
             self._log.flush()
         if self._samples > self.warmup_samples:
             self._bo.observe(self._current, score)
@@ -235,9 +297,14 @@ class ParameterManager:
             pass           # a tuning session
         if self._samples >= self.max_samples:
             # converge: pin best parameters, stop tuning (reference
-            # parameter_manager.cc final tuning state)
+            # parameter_manager.cc final tuning state) — and persist
+            # them so the next same-shaped job warm-starts here
             self._apply(self._best)
             self.active = False
+            try:
+                self._save_cache()
+            except Exception:  # noqa: BLE001 — the cache is an
+                pass           # optimization, never a failure mode
         else:
             self._current = self._bo.suggest()
             self._apply(self._current)
@@ -264,9 +331,133 @@ class ParameterManager:
             i += 1
         if self.tune_algorithm:
             self.config.algorithm = decoded[i]
+            i += 1
+        if self.tune_pipeline:
+            # one categorical again: schedule and n_micro flip
+            # together; the pipeline runtime latches the pair at its
+            # next step start (and the engine per negotiation entry),
+            # so the running step finishes under its own schedule
+            sched, m = decoded[i]
+            self.config.pp_schedule = sched
+            self.config.pp_n_micro = int(m)
 
     def best_parameters(self):
         return self._decode(self._best)
+
+    # -- warm-start cache ----------------------------------------------------
+
+    def note_bucket_signature(self, sig):
+        """The engine observed its first fusion bucket: ``sig`` (a
+        stable hash of the bucket's tensor keys/shapes/dtype)
+        completes the cache key — (bucket signature, topology, world
+        size) — and triggers the one warm-start lookup.  Idempotent;
+        only the first signature counts (steady-state training re-forms
+        the same buckets every cycle, which is what makes the key
+        stable across jobs)."""
+        if self._cache_key is not None:
+            return
+        self._cache_key = f"{sig}|{self._key_suffix}"
+        if self.cache_path:
+            try:
+                self._load_cache()
+            except Exception:  # noqa: BLE001 — a corrupt cache file
+                pass           # must never take down a job
+
+    def _cache_entry(self):
+        decoded = self._decode(self._best)
+        fusion, cycle, pack_mt, cache = decoded[:4]
+        entry = {"fusion_threshold_bytes": int(fusion),
+                 "cycle_time_ms": float(cycle),
+                 "pack_mt_threshold_bytes": int(pack_mt),
+                 "cache_capacity": int(cache),
+                 "score_bytes_per_sec": float(self._best_score)
+                 if self._best_score != -np.inf else 0.0}
+        i = 4
+        if self.tune_wire:
+            entry["wire_inner"], entry["wire_outer"] = decoded[i]
+            i += 1
+        if self.tune_algorithm:
+            entry["algorithm"] = decoded[i]
+            i += 1
+        if self.tune_pipeline:
+            entry["pp_schedule"], entry["pp_n_micro"] = decoded[i]
+        return entry
+
+    def _load_cache(self):
+        if not (self.cache_path and os.path.exists(self.cache_path)):
+            return
+        with open(self.cache_path) as f:
+            data = json.load(f)
+        entry = data.get(self._cache_key)
+        if not isinstance(entry, dict):
+            return
+        seed = self._encode(
+            entry.get("fusion_threshold_bytes",
+                      self.config.fusion_threshold_bytes),
+            entry.get("cycle_time_ms", self.config.cycle_time_ms),
+            entry.get("pack_mt_threshold_bytes",
+                      getattr(self.config, "pack_mt_threshold_bytes",
+                              8 << 20)),
+            entry.get("cache_capacity",
+                      getattr(self.config, "cache_capacity", 1024)),
+            (entry.get("wire_inner"), entry.get("wire_outer")),
+            entry.get("algorithm"),
+            (entry.get("pp_schedule"), entry.get("pp_n_micro", 0)))
+        # start the sweep AT the cached optimum: it becomes both the
+        # applied config and the BO's incumbent, so early suggestions
+        # explore around it instead of from scratch
+        self._best = self._current = seed
+        self._apply(seed)
+        # the log-scale encoding quantizes integers by ~1 ulp; apply
+        # the EXACT cached values on top so the job runs yesterday's
+        # optimum verbatim, not its nearest grid point
+        for attr, key in (("fusion_threshold_bytes",
+                           "fusion_threshold_bytes"),
+                          ("cycle_time_ms", "cycle_time_ms"),
+                          ("pack_mt_threshold_bytes",
+                           "pack_mt_threshold_bytes"),
+                          ("cache_capacity", "cache_capacity")):
+            if key in entry:
+                setattr(self.config, attr, entry[key])
+        self.warm_started = True
+
+    def _save_cache(self):
+        if not (self.cache_path and self._cache_key):
+            return
+        os.makedirs(os.path.dirname(os.path.abspath(self.cache_path)),
+                    exist_ok=True)
+        # advisory lock on a sidecar (os.replace swaps the cache
+        # file's inode, so locking the cache itself would not
+        # serialize writers): two jobs sharing one cache converge
+        # concurrently under DIFFERENT keys — without the lock the
+        # second read-merge-replace drops the first job's entry
+        lock = open(f"{self.cache_path}.lock", "w")
+        try:
+            try:
+                import fcntl
+                fcntl.flock(lock, fcntl.LOCK_EX)
+            except (ImportError, OSError):
+                pass   # no flock: keep the lock-free best effort
+            data = {}
+            if os.path.exists(self.cache_path):
+                try:
+                    with open(self.cache_path) as f:
+                        data = json.load(f)
+                except (OSError, ValueError):
+                    data = {}
+            if not isinstance(data, dict):
+                data = {}
+            prior = data.get(self._cache_key) or {}
+            if prior.get("score_bytes_per_sec", -1.0) > \
+                    float(self._best_score):
+                return   # never clobber a better prior optimum
+            data[self._cache_key] = self._cache_entry()
+            tmp = f"{self.cache_path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(data, f, indent=1, sort_keys=True)
+            os.replace(tmp, self.cache_path)   # readers never see a
+        finally:                               # torn file
+            lock.close()
 
     def close(self):
         if self._log:

@@ -65,8 +65,8 @@ class NegotiationEntry:
     IncrementTensorCount)."""
 
     __slots__ = ("key", "subs", "first_time", "wire_default",
-                 "wire_inner_default", "algo_default", "ready_ts",
-                 "trace_id", "meta_fp")
+                 "wire_inner_default", "algo_default", "pp_default",
+                 "ready_ts", "trace_id", "meta_fp")
 
     def __init__(self, key):
         self.key = key
@@ -86,6 +86,9 @@ class NegotiationEntry:
         # (config.algorithm)
         self.wire_inner_default = None
         self.algo_default = None
+        # ditto for the pipeline-schedule tag (parallel/runtime.py
+        # sets config.pp_sched_tag for the duration of a step)
+        self.pp_default = None
         # timeline-clock instant this entry became locally ready (the
         # flow-event "s" anchor) and its job-unique trace id
         # (coordinator-minted in store mode, engine-minted locally)
@@ -174,11 +177,29 @@ class Engine:
             # the coordinator's decision (reference: coordinator tunes,
             # SynchronizeParameters broadcasts — a future round)
             from .autotune import ParameterManager
+            # topology fingerprint for the warm-start cache key: slot
+            # counts per host (the layout that decides hierarchical /
+            # torus viability), or flat<N> without a host map
+            if topology is not None and topology.host_of_rank:
+                counts = {}
+                for h in topology.host_of_rank:
+                    counts[h] = counts.get(h, 0) + 1
+                topo_fp = "h" + "-".join(
+                    str(counts[h]) for h in sorted(counts))
+            else:
+                topo_fp = f"flat{self.global_size}"
             self.autotuner = ParameterManager(
                 self.config,
                 warmup_samples=self.config.autotune_warmup_samples,
                 steps_per_sample=self.config.autotune_steps_per_sample,
-                log_path=self.config.autotune_log)
+                max_samples=self.config.autotune_max_samples,
+                log_path=self.config.autotune_log,
+                tune_pipeline=getattr(self.config, "pp_stages", 1) > 1,
+                cache_path=getattr(self.config, "autotune_cache", None),
+                topo_fp=topo_fp, world_size=self.global_size)
+        #: first-fusion-bucket signature noted exactly once per
+        #: lifecycle (autotuner warm-start cache key)
+        self._autotune_sig_noted = False
 
         from . import native as _native
         self._arena = _native.Arena()
@@ -803,6 +824,8 @@ class Engine:
                     self.config, "wire_inner", None)
                 entry.algo_default = getattr(
                     self.config, "algorithm", None)
+                entry.pp_default = getattr(
+                    self.config, "pp_sched_tag", None)
                 ps.pending[key] = entry
             req = sub.request
             if (req.wire_dtype is None and entry.wire_default
@@ -833,6 +856,14 @@ class Engine:
                 # same latch for the reduction algorithm (autotune's
                 # sixth dimension): one negotiation, one algorithm
                 req.algorithm = entry.algo_default
+            if (req.pp_sched is None and entry.pp_default
+                    and req.request_type == RequestType.ALLREDUCE):
+                # same latch for the pipeline-schedule tag (autotune's
+                # SEVENTH dimension): the runtime's bubble-overlapped
+                # gradient reduces all carry the step's latched
+                # schedule@n_micro even if autotune flips the config
+                # default mid-step
+                req.pp_sched = entry.pp_default
             if sub.rank in entry.subs:
                 sub.handle.set_error(DuplicateNameError(
                     f"tensor {sub.names} submitted twice by rank "
@@ -1174,6 +1205,7 @@ class Engine:
             "wire": req.wire_dtype,
             "wi": req.wire_inner,
             "algo": req.algorithm,
+            "pp": req.pp_sched,
             "ps": ps.id,
             "nbytes": nbytes,
             "nprocs": nprocs,
@@ -1651,6 +1683,12 @@ class Engine:
                     f"Mismatched algorithms for {first.tensor_name}: "
                     f"rank {sub.rank} sent {r.algorithm}, rank "
                     f"{subs[0].rank} sent {first.algorithm}")
+            if r.pp_sched != first.pp_sched:
+                return TensorShapeMismatchError(
+                    f"Mismatched pipeline schedules for "
+                    f"{first.tensor_name}: rank {sub.rank} sent "
+                    f"{r.pp_sched}, rank {subs[0].rank} sent "
+                    f"{first.pp_sched}")
             if rt == RequestType.BROADCAST and r.root_rank != first.root_rank:
                 return TensorShapeMismatchError(
                     f"Mismatched broadcast root for {first.tensor_name}: "
@@ -1732,7 +1770,8 @@ class Engine:
                        first.request.postscale_factor,
                        first.request.wire_dtype,
                        first.request.wire_inner,
-                       first.request.algorithm)
+                       first.request.algorithm,
+                       first.request.pp_sched)
                 nbytes = sum(p.nbytes for p in first.payloads)
             elif rt == RequestType.ALLGATHER:
                 sig = (rt, first.request.dtype)
@@ -1869,6 +1908,17 @@ class Engine:
             for buf in rows:
                 self._arena.release(buf)
         if self.autotuner is not None:
+            if not self._autotune_sig_noted:
+                # the FIRST bucket's identity keys the warm-start
+                # cache: steady-state training re-forms the same
+                # buckets every cycle, so (keys, shapes, dtype) is a
+                # stable job fingerprint
+                self._autotune_sig_noted = True
+                import hashlib
+                parts = ",".join(sorted(
+                    f"{e.key}:{s}" for e, _i, _o, _sz, s in layout))
+                self.autotuner.note_bucket_signature(hashlib.md5(
+                    f"{dtype}|{parts}".encode()).hexdigest()[:12])
             self.autotuner.record_bytes(total * dtype.itemsize)
         by_rank = dict(zip(ps.local_ranks, results))
         # single pass over layout, grouping outputs per (entry, rank)

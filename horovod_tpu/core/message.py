@@ -98,6 +98,16 @@ class Request:
     # (common/topology.py).  Cross-rank validated like wire_dtype —
     # ranks disagreeing would issue different SPMD programs.
     algorithm: Optional[str] = None
+    # pipeline-schedule tag ("<schedule>@<n_micro>",
+    # schedule.pp_label) on gradient reduces submitted from inside an
+    # MPMD pipeline step: None outside pipelines.  Cross-rank
+    # validated like wire_dtype — ranks running different schedules
+    # (or microbatch counts) would overlap different collectives into
+    # different bubbles and accumulate different gradient sums, so a
+    # divergence must fail loudly, not train silently skewed.  The
+    # engine latches the process-wide default per negotiation entry
+    # (autotune's seventh dimension flips it between steps only).
+    pp_sched: Optional[str] = None
     # grouped submissions: shape of EVERY member tensor, so cross-rank
     # validation covers members beyond the first (the reference issues
     # one Request per member inside the group instead)
@@ -122,6 +132,7 @@ class Request:
             "w": self.wire_dtype,
             "wi": self.wire_inner,
             "alg": self.algorithm,
+            "pp": self.pp_sched,
         }
 
     @classmethod
@@ -144,6 +155,7 @@ class Request:
             wire_dtype=d.get("w"),
             wire_inner=d.get("wi"),
             algorithm=d.get("alg"),
+            pp_sched=d.get("pp"),
         )
 
 

@@ -63,29 +63,69 @@ __all__ = [
 
 @dataclass(frozen=True)
 class TopologyHint:
-    """Explicit 2-D decomposition for a compiled reduction: named
-    mesh axes plus their sizes, outer (slow / DCN) axis first.  The
-    hint is part of the compiled-program cache key, so the same
-    tensors reduced under different hints compile distinct programs
-    — e.g. ``TopologyHint(axes=("dp", "tp"), sizes=(2, 4))`` on a
-    dp x tp mesh reduces within each tp group first, crosses dp
-    once per shard, then gathers back.  When no hint is given the
+    """Explicit decomposition for a compiled reduction: named mesh
+    axes plus their sizes, outer (slow / DCN) axis first.  The hint
+    is part of the compiled-program cache key, so the same tensors
+    reduced under different hints compile distinct programs — e.g.
+    ``TopologyHint(axes=("dp", "tp"), sizes=(2, 4))`` on a dp x tp
+    mesh reduces within each tp group first, crosses dp once per
+    shard, then gathers back.  When no hint is given the
     ``algorithm`` policy derives one from the job topology
     (hierarchical: hosts x local ranks; torus: the near-square
-    factorization)."""
-    axes: Tuple[str, str] = ("cross", "local")
-    sizes: Tuple[int, int] = (1, 1)
+    factorization).
+
+    Under the MPMD pipeline runtime the hint grows a leading ``pp``
+    axis: ``TopologyHint(axes=("pp", "dp", "tp"), sizes=(4, 2, 2),
+    pp_stage=1)`` describes stage 1 of a 4-stage job whose
+    dp-dimension gradient reduce decomposes (dp, tp) INSIDE the
+    stage's process set.  The pp axis spans the per-stage process
+    sets rather than this one, so it never enters the reduction plan
+    (``reduce_axes``/``reduce_sizes`` are the trailing two) — it and
+    ``pp_stage`` exist to keep per-stage programs distinct in the
+    shared cache."""
+    axes: Tuple[str, ...] = ("cross", "local")
+    sizes: Tuple[int, ...] = (1, 1)
+    #: pipeline stage this hint's process set belongs to (only
+    #: meaningful with a leading "pp" axis)
+    pp_stage: int = 0
+
+    def __post_init__(self):
+        if len(self.axes) != len(self.sizes) or \
+                len(self.axes) not in (2, 3):
+            raise ValueError(
+                f"TopologyHint needs matching 2-axis (outer, inner) "
+                f"or 3-axis (pp, outer, inner) axes/sizes, got "
+                f"axes={self.axes} sizes={self.sizes}")
+        if len(self.axes) == 3 and self.axes[0] != "pp":
+            raise ValueError(
+                f"a 3-axis TopologyHint's leading axis must be 'pp', "
+                f"got {self.axes[0]!r}")
+
+    @property
+    def reduce_axes(self):
+        """The (outer, inner) axes the reduction decomposes over —
+        everything but a leading pp axis."""
+        return self.axes[-2:]
+
+    @property
+    def reduce_sizes(self):
+        return self.sizes[-2:]
 
     @property
     def inner(self):
-        return self.sizes[1]
+        return self.sizes[-1]
 
     @property
     def outer(self):
-        return self.sizes[0]
+        return self.sizes[-2]
+
+    @property
+    def pp(self):
+        """Pipeline-stage count, 1 when the hint has no pp axis."""
+        return self.sizes[0] if len(self.sizes) == 3 else 1
 
     def key(self):
-        return (self.axes, self.sizes)
+        return (self.axes, self.sizes, self.pp_stage)
 
 
 def _ps_state(process_set):
@@ -503,6 +543,9 @@ class CompiledGroupedAllreduce:
             return None
         if self.topology_hint is not None:
             hint = self.topology_hint
+            # the reduction factors (outer, inner) over THIS set's
+            # ranks; a leading pp axis spans the per-stage sets and
+            # stays out of the product
             if hint.outer * hint.inner != ex.num_ranks \
                     or hint.inner <= 1 or hint.outer <= 1:
                 raise ValueError(
@@ -539,8 +582,8 @@ class CompiledGroupedAllreduce:
         R = ex.num_ranks
         op, pre, post = self.op, self.prescale, self.postscale
         inner, outer = hint.inner, hint.outer
-        ax_out, ax_in = hint.axes
-        mesh = ex.mesh2d(inner, hint.axes)
+        ax_out, ax_in = hint.reduce_axes
+        mesh = ex.mesh2d(inner, hint.reduce_axes)
         ef_idx = self._ef_indices(plan) if self.error_feedback else []
 
         def reduce_buf_2d(x, dtype, res):
@@ -950,8 +993,8 @@ class CompiledGroupedAllreduce:
         with _EF_LOCK:
             ress = _EF_STATE.get(key)
             if ress is None:
-                mesh = ex.mesh2d(hint.inner, hint.axes)
-                sh = NamedSharding(mesh, P(*hint.axes))
+                mesh = ex.mesh2d(hint.inner, hint.reduce_axes)
+                sh = NamedSharding(mesh, P(*hint.reduce_axes))
                 ress = []
                 for k in self._ef_indices(plan):
                     n = sum(size for _, size, _ in plan[k][1])
@@ -1046,7 +1089,7 @@ class CompiledGroupedAllreduce:
                             for pos in ex.local_positions]
                     if hint is not None:
                         staged.append(ex._stage_rows_2d(
-                            rows, hint.inner, hint.axes))
+                            rows, hint.inner, hint.reduce_axes))
                     else:
                         staged.append(self._stage(ex, rows))
                 if hop_ef:

@@ -17,6 +17,14 @@ from .sharding import (  # noqa: F401
 from .ring_attention import ring_attention, make_ring_attention_fn  # noqa: F401
 from .ulysses import ulysses_attention, make_ulysses_attention_fn  # noqa: F401
 from .pipeline import gpipe, make_pipelined_lm_apply  # noqa: F401
+from .schedule import (  # noqa: F401
+    SCHEDULES, PP_CHOICES, Instr, Schedule, build_schedule,
+    bubble_fraction, normalize_schedule, pp_label, parse_pp_label,
+)
+from .runtime import (  # noqa: F401
+    PipelineSpec, LocalPipelineRuntime, MpmdWorker,
+    make_mpmd_lm_train_step, stage_meshes_from,
+)
 from .train import (  # noqa: F401
     make_lm_train_step, make_dp_train_step, make_pipelined_lm_train_step,
 )

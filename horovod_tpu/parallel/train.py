@@ -36,7 +36,8 @@ def make_lm_train_step(mesh: Mesh, cfg: TransformerConfig,
                        attention_impl: str = "ring",
                        learning_rate: float = 1e-3,
                        fused_ce: bool = False,
-                       ce_chunks: int = 16):
+                       ce_chunks: int = 16,
+                       pipeline=None):
     """Build (init_fn, step_fn) for the transformer over ``mesh``.
 
     ``step_fn(state, tokens) -> (state, loss)`` is jitted with explicit
@@ -52,12 +53,53 @@ def make_lm_train_step(mesh: Mesh, cfg: TransformerConfig,
     (B, S, V) logits tensor never hits HBM — worth ~9% tok/s and
     +1 batch step on the 436M single-chip headline
     (docs/benchmarks.md).
+
+    ``pipeline`` opts the step into the MPMD pipeline runtime
+    (runtime.py; docs/parallelism.md): a :class:`~.runtime.
+    PipelineSpec` (or dict / bare stage count) whose ``pp`` must match
+    ``mesh``'s pp axis.  The decoder stack runs as explicit 1F1B /
+    interleaved / GPipe instruction streams over per-stage sub-meshes
+    while dp/tp/sp collectives still compile into the per-stage chunk
+    programs — the dp×tp×pp path.  Same return contract; the step is
+    not one fused program (that is the point — the schedule is
+    runtime data the autotuner flips between steps).
     """
     optimizer = optimizer or optax.adamw(learning_rate)
     if attention_impl not in ("ring", "ulysses", "flash"):
         raise ValueError(
             f"attention_impl must be 'ring', 'ulysses', or 'flash', "
             f"got {attention_impl!r}")
+    if pipeline is not None:
+        from .runtime import PipelineSpec, make_mpmd_lm_train_step
+
+        if isinstance(pipeline, int):
+            pipeline = PipelineSpec(pp=pipeline)
+        elif isinstance(pipeline, dict):
+            pipeline = PipelineSpec(**pipeline)
+        if pipeline.pp > 1:
+            if fused_ce:
+                raise ValueError(
+                    "fused_ce is not available under the MPMD "
+                    "pipeline runtime: the loss head lives inside the "
+                    "last stage's value_and_grad chunk program")
+            att_factory = None
+            if sequence_parallel:
+                if attention_impl == "flash":
+                    raise ValueError(
+                        "attention_impl='flash' is the single-shard "
+                        "pallas kernel; with sequence_parallel use "
+                        "'ring' or 'ulysses'")
+                att_factory = make_ring_attention_fn \
+                    if attention_impl == "ring" else None
+                if att_factory is None:
+                    from .ulysses import make_ulysses_attention_fn
+                    att_factory = make_ulysses_attention_fn
+            elif attention_impl == "flash":
+                from ..ops.pallas_kernels import flash_attention
+                att_factory = lambda _mesh: flash_attention  # noqa: E731
+            return make_mpmd_lm_train_step(
+                mesh, cfg, pipeline, optimizer,
+                attention_fn_factory=att_factory)
     if not sequence_parallel and attention_impl not in ("ring", "flash"):
         raise ValueError(
             "attention_impl='ulysses' only takes effect with "

@@ -43,6 +43,13 @@ HOROVOD_ALLREDUCE_ALGORITHM = "HOROVOD_ALLREDUCE_ALGORITHM"
 HOROVOD_WIRE_DTYPE = "HOROVOD_WIRE_DTYPE"
 HOROVOD_WIRE_INNER = "HOROVOD_WIRE_INNER"
 HOROVOD_WIRE_OUTER = "HOROVOD_WIRE_OUTER"
+# MPMD pipeline runtime (common/env.py reads these;
+# docs/parallelism.md knob catalogue)
+HOROVOD_PP_STAGES = "HOROVOD_PP_STAGES"
+HOROVOD_PP_MICROBATCHES = "HOROVOD_PP_MICROBATCHES"
+HOROVOD_PP_SCHEDULE = "HOROVOD_PP_SCHEDULE"
+HOROVOD_PP_CHUNKS = "HOROVOD_PP_CHUNKS"
+HOROVOD_AUTOTUNE_CACHE = "HOROVOD_AUTOTUNE_CACHE"
 
 
 def set_env_from_args(env: dict, args) -> dict:
@@ -170,6 +177,16 @@ def set_env_from_args(env: dict, args) -> dict:
         env[HOROVOD_WIRE_INNER] = args.wire_inner
     if getattr(args, "wire_outer", None):
         env[HOROVOD_WIRE_OUTER] = args.wire_outer
+    if getattr(args, "pipeline_stages", None) is not None:
+        env[HOROVOD_PP_STAGES] = str(args.pipeline_stages)
+    if getattr(args, "num_microbatches", None) is not None:
+        env[HOROVOD_PP_MICROBATCHES] = str(args.num_microbatches)
+    if getattr(args, "pipeline_schedule", None):
+        env[HOROVOD_PP_SCHEDULE] = args.pipeline_schedule
+    if getattr(args, "pipeline_chunks", None) is not None:
+        env[HOROVOD_PP_CHUNKS] = str(args.pipeline_chunks)
+    if getattr(args, "autotune_cache_file", None):
+        env[HOROVOD_AUTOTUNE_CACHE] = args.autotune_cache_file
     return env
 
 

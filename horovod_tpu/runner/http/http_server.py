@@ -1281,6 +1281,7 @@ class Coordinator:
                                  ("wire", "wire dtypes"),
                                  ("wi", "inner wire dtypes"),
                                  ("algo", "algorithms"),
+                                 ("pp", "pipeline schedules"),
                                  ("root", "root ranks")):
                 if m.get(field) != first.get(field):
                     return (f"Mismatched {label} for {key}: "
@@ -1446,7 +1447,7 @@ class Coordinator:
                 msig = (meta["type"], meta["dtype"], meta["op"],
                         meta["pre"], meta["post"], meta["ps"],
                         meta.get("wire"), meta.get("wi"),
-                        meta.get("algo"))
+                        meta.get("algo"), meta.get("pp"))
                 nbytes = meta["nbytes"]
             if bucket and (msig != sig or
                            bucket_bytes + nbytes >

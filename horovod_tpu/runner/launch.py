@@ -114,6 +114,32 @@ def parse_args(argv=None):
                         help="cross-host/DCN hop wire of the per-hop "
                              "pair (HOROVOD_WIRE_OUTER; wins over "
                              "--wire-dtype)")
+    # MPMD pipeline runtime (docs/parallelism.md)
+    parser.add_argument("--pipeline-stages", type=int, default=None,
+                        help="carve the job into this many pipeline "
+                             "stages backed by per-stage process "
+                             "sets (HOROVOD_PP_STAGES; 1 = no "
+                             "pipelining)")
+    parser.add_argument("--num-microbatches", type=int, default=None,
+                        help="microbatches per pipelined step "
+                             "(HOROVOD_PP_MICROBATCHES; 0 = auto, "
+                             "also the autotuner's seventh-dimension "
+                             "sweep variable)")
+    parser.add_argument("--pipeline-schedule", default=None,
+                        choices=["gpipe", "1f1b", "interleaved"],
+                        help="pipeline schedule the per-rank "
+                             "instruction streams follow "
+                             "(HOROVOD_PP_SCHEDULE; default 1f1b, "
+                             "gpipe is the fill-drain fallback)")
+    parser.add_argument("--pipeline-chunks", type=int, default=None,
+                        help="model chunks per stage for the "
+                             "interleaved schedule "
+                             "(HOROVOD_PP_CHUNKS; 0 = auto: 2)")
+    parser.add_argument("--autotune-cache-file", default=None,
+                        help="local JSON warm-start cache of "
+                             "converged autotune optima keyed by "
+                             "(bucket signature, topology, world "
+                             "size) (HOROVOD_AUTOTUNE_CACHE)")
     # timeline + job-wide tracing (docs/timeline.md)
     parser.add_argument("--timeline-filename", default=None)
     parser.add_argument("--timeline-mark-cycles", action="store_true")

@@ -98,7 +98,8 @@ AUTOTUNE_BEST_CONFIG_FAMILY = "horovod_autotune_best_config"
 AUTOTUNE_BEST_CONFIG_HELP = ("Current best autotune configuration "
                              "(value 1; the labels are the config)")
 AUTOTUNE_BEST_CONFIG_LABELS = ("fusion_threshold_bytes",
-                               "cycle_time_ms", "wire", "algorithm")
+                               "cycle_time_ms", "wire", "algorithm",
+                               "pipeline")
 ELASTIC_RESIZE_FAMILY = "horovod_elastic_resize_events_total"
 ELASTIC_RESIZE_HELP = ("Elastic membership changes seen by this "
                        "worker")
@@ -119,6 +120,29 @@ WIRE_HOP_BYTES_HELP = ("Interconnect bytes per decomposition hop, "
                        "(hop=inner: intra-host/ICI, hop=cross: "
                        "cross-host/DCN)")
 WIRE_HOP_BYTES_LABELS = ("hop", "wire")
+
+# -- MPMD pipeline runtime (docs/parallelism.md; parallel/runtime.py):
+#    the runtime and pp_smoke/benchmarks consume these, so the family
+#    names live ONCE here.  `schedule` label values are the latched
+#    "<schedule>@<n_micro>" tag (schedule.pp_label) the engine
+#    cross-rank-validates on every overlapped gradient reduce.
+
+PP_STEPS_FAMILY = "horovod_pp_steps_total"
+PP_STEPS_HELP = ("Pipeline training steps executed, labeled by the "
+                 "step's latched schedule@n_micro tag")
+PP_STEPS_LABELS = ("schedule",)
+PP_OVERLAP_FAMILY = "horovod_pp_overlapped_reductions_total"
+PP_OVERLAP_HELP = ("Gradient allreduces submitted asynchronously into "
+                   "pipeline bubbles (reduce ticks routed through the "
+                   "engine before the step's last backward finished)")
+PP_BUBBLE_FRACTION_FAMILY = "horovod_pp_bubble_fraction"
+PP_BUBBLE_FRACTION_HELP = ("Analytic idle fraction of the stage x "
+                           "tick grid for the latched schedule")
+PP_RECV_WAIT_FAMILY = "horovod_pp_recv_wait_seconds_total"
+PP_RECV_WAIT_HELP = ("Seconds stages spent blocked on activation / "
+                     "gradient hops — the measured (residual) bubble "
+                     "time after overlap, labeled by stage")
+PP_RECV_WAIT_LABELS = ("stage",)
 
 
 def count_fabric_retry(verb):

@@ -151,3 +151,180 @@ def test_autotune_engine_integration(hvd_shutdown, tmp_path,
     hvd.shutdown()
     assert log.exists()
     assert len(log.read_text().strip().splitlines()) > 1
+
+
+def test_parameter_manager_tunes_pipeline_pair(tmp_path):
+    """The SEVENTH dimension: (schedule, n_micro) as one categorical
+    over schedule.PP_CHOICES, applied as config.pp_schedule +
+    config.pp_n_micro together (the runtime re-latches the pair at
+    each step start)."""
+    from horovod_tpu.parallel.schedule import PP_CHOICES
+
+    cfg = env_mod.Config()
+    log = tmp_path / "at.csv"
+    pm = ParameterManager(cfg, warmup_samples=1, steps_per_sample=2,
+                          max_samples=5, log_path=str(log),
+                          tune_pipeline=True)
+    for _ in range(5 * 2):
+        pm.record_bytes(1 << 20)
+    assert not pm.active
+    best = pm.best_parameters()
+    assert len(best) == 7
+    sched, m = best[6]
+    assert (sched, m) in PP_CHOICES
+    assert cfg.pp_schedule == sched          # applied as ONE pair
+    assert cfg.pp_n_micro == m
+    pm.close()
+    header = log.read_text().splitlines()[0]
+    assert "pipeline," in header
+
+
+def test_pipeline_pair_seed_canonicalizes_to_own_schedule():
+    """An incumbent n_micro outside the sweep grid must seed the
+    nearest bin OF ITS OWN SCHEDULE, never gpipe@2 (bin 0)."""
+    from horovod_tpu.parallel.schedule import PP_CHOICES
+
+    cfg = env_mod.Config()
+    pm = ParameterManager(cfg, tune_pipeline=True)
+
+    def seeded_bin(pair):
+        x = pm._encode(1 << 24, 2.0, 8 << 20, 1024,
+                       (None, None), "flat", pair)
+        return pm._decode(x)[6]
+
+    assert seeded_bin(("1f1b", 4)) == ("1f1b", 4)     # exact bin
+    assert seeded_bin(("interleaved", 8)) == ("interleaved", 8)
+    assert seeded_bin(("1f1b", 6))[0] == "1f1b"       # off-grid m
+    assert seeded_bin(("1f1b", 1000)) == ("1f1b", 8)  # clamps high
+    assert seeded_bin((None, 0))[0] == "1f1b"         # unset default
+    for pair in PP_CHOICES:
+        assert seeded_bin(pair) == pair
+    pm.close()
+
+
+def test_autotune_warm_start_round_trip(tmp_path):
+    """Satellite: the converged best config persists to a local cache
+    keyed by (bucket signature, topology, world size) and a
+    same-shaped job reloads it at start — config applied VERBATIM,
+    BO seeded at the cached optimum."""
+    cache = str(tmp_path / "warm.json")
+    cfg = env_mod.Config()
+    pm = ParameterManager(cfg, warmup_samples=1, steps_per_sample=2,
+                          max_samples=5, tune_pipeline=True,
+                          cache_path=cache, topo_fp="h4-4",
+                          world_size=8)
+    pm.note_bucket_signature("sigA")
+    assert not pm.warm_started           # nothing cached yet
+    for _ in range(5 * 2):
+        pm.record_bytes(1 << 20)
+    assert not pm.active                 # converged -> saved
+    import json as _json
+    data = _json.load(open(cache))
+    assert "sigA|h4-4|np8" in data
+    entry = data["sigA|h4-4|np8"]
+    assert entry["fusion_threshold_bytes"] == cfg.fusion_threshold_bytes
+    assert entry["pp_schedule"] == cfg.pp_schedule
+    best = pm.best_parameters()
+    pm.close()
+
+    # same-shaped job: reload at start, run yesterday's optimum
+    cfg2 = env_mod.Config()
+    pm2 = ParameterManager(cfg2, tune_pipeline=True, cache_path=cache,
+                           topo_fp="h4-4", world_size=8)
+    pm2.note_bucket_signature("sigA")
+    assert pm2.warm_started
+    assert cfg2.fusion_threshold_bytes == entry["fusion_threshold_bytes"]
+    assert cfg2.cycle_time_ms == entry["cycle_time_ms"]
+    assert cfg2.cache_capacity == entry["cache_capacity"]
+    assert (cfg2.wire_inner, cfg2.wire_dtype) == \
+        (entry.get("wire_inner"), entry.get("wire_outer"))
+    assert cfg2.algorithm == entry["algorithm"]
+    assert (cfg2.pp_schedule, cfg2.pp_n_micro) == \
+        (entry["pp_schedule"], entry["pp_n_micro"])
+    # BO incumbent sits at the cached optimum's grid point: the
+    # log-scale encoding quantizes integer dims by ~1 ulp (the CONFIG
+    # got the exact values above), categoricals are exact
+    best2 = pm2.best_parameters()
+    assert abs(best2[0] - best[0]) <= 1          # fusion bytes
+    assert abs(best2[1] - best[1]) < 1e-6        # cycle ms
+    assert best2[4:] == best[4:]                 # wire/algo/pipeline
+    pm2.close()
+
+    # a DIFFERENT bucket signature / topology / size never matches
+    for kwargs in ({"topo_fp": "h8", "world_size": 8},
+                   {"topo_fp": "h4-4", "world_size": 4}):
+        pm3 = ParameterManager(env_mod.Config(), tune_pipeline=True,
+                               cache_path=cache, **kwargs)
+        pm3.note_bucket_signature("sigA")
+        assert not pm3.warm_started
+        pm3.close()
+    pm4 = ParameterManager(env_mod.Config(), tune_pipeline=True,
+                           cache_path=cache, topo_fp="h4-4",
+                           world_size=8)
+    pm4.note_bucket_signature("sigB")
+    assert not pm4.warm_started
+    pm4.close()
+
+
+def test_autotune_warm_start_survives_corrupt_cache(tmp_path):
+    cache = tmp_path / "warm.json"
+    cache.write_text("{not json")
+    cfg = env_mod.Config()
+    pm = ParameterManager(cfg, cache_path=str(cache), topo_fp="flat2",
+                          world_size=2)
+    pm.note_bucket_signature("sig")      # must not raise
+    assert not pm.warm_started
+    pm.close()
+
+
+def test_autotune_cache_never_clobbers_better_prior(tmp_path):
+    """A worse rerun (noisy day, throttled fabric) must not overwrite
+    a better recorded optimum under the same key."""
+    cache = str(tmp_path / "warm.json")
+    import json as _json
+
+    def converge(score_bytes):
+        cfg = env_mod.Config()
+        pm = ParameterManager(cfg, warmup_samples=1,
+                              steps_per_sample=1, max_samples=3,
+                              cache_path=cache, topo_fp="flat4",
+                              world_size=4)
+        pm.note_bucket_signature("sig")
+        for _ in range(3):
+            pm.record_bytes(score_bytes)
+        pm.close()
+
+    converge(1 << 24)
+    first = _json.load(open(cache))["sig|flat4|np4"]
+    converge(1 << 10)                    # much worse rerun
+    again = _json.load(open(cache))["sig|flat4|np4"]
+    assert again == first
+
+
+def test_autotune_engine_session_sweeps_pipeline(hvd_shutdown,
+                                                 tmp_path, monkeypatch):
+    """schedule×n_micro participates in (and survives) a live engine
+    autotune session: with HOROVOD_PP_STAGES > 1 the manager sweeps
+    the seventh dimension, logs a pipeline column, and the job's
+    collectives keep completing while the pair flips between
+    samples."""
+    log = tmp_path / "at.csv"
+    monkeypatch.setenv("HOROVOD_AUTOTUNE", "1")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_LOG", str(log))
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", "1")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", "2")
+    monkeypatch.setenv("HOROVOD_PP_STAGES", "2")
+
+    def fn():
+        for i in range(12):
+            hvd.allreduce(np.ones(256, np.float32), name=f"tp{i}")
+        return True
+
+    assert all(hvd.run(fn, np=4))
+    hvd.shutdown()
+    lines = log.read_text().strip().splitlines()
+    assert "pipeline," in lines[0]
+    from horovod_tpu.parallel.schedule import parse_pp_label
+    col = lines[0].split(",").index("pipeline")
+    pairs = {parse_pp_label(ln.split(",")[col]) for ln in lines[1:]}
+    assert pairs                         # every sample logged a pair

@@ -935,6 +935,47 @@ def test_algorithm_mismatch_fails_loudly(live_engine):
     assert all(run_ranks(fn))
 
 
+def test_pp_sched_mismatch_fails_loudly(live_engine):
+    """Ranks running different pipeline schedules (or microbatch
+    counts) would overlap different collectives into different
+    bubbles and accumulate different gradient sums — the latched
+    schedule@n_micro tag (Request.pp_sched, normally stamped by
+    parallel/runtime.py on its bubble-overlapped reduces) must be
+    cross-rank validated like the wire pair and algorithm.  The tag
+    has no per-call API knob, so the divergent requests are built
+    directly."""
+    from horovod_tpu.common.exceptions import TensorShapeMismatchError
+    from horovod_tpu.core.message import Request, RequestType
+    from horovod_tpu.ops import api as ops_api
+
+    def submit(name, tag):
+        x = np.ones(8, np.float32)
+        req = Request(
+            request_type=RequestType.ALLREDUCE, tensor_name=name,
+            rank=hvd.rank(), dtype=np.dtype(np.float32), shape=(8,),
+            reduce_op=hvd.Sum, process_set_id=0, pp_sched=tag)
+        return ops_api._submit(req, [x], [name])
+
+    def fn():
+        tag = "1f1b@4" if hvd.rank() == 0 else "gpipe@4"
+        try:
+            ops_api.synchronize(submit("m.ppmix", tag))
+            return False
+        except TensorShapeMismatchError as e:
+            return "pipeline schedule" in str(e).lower()
+
+    assert all(run_ranks(fn))
+
+    # the agreeing case negotiates and executes normally
+    def ok():
+        out = ops_api.synchronize(submit("m.ppsame", "1f1b@4"))
+        np.testing.assert_allclose(np.asarray(out), np.full(8, NP,
+                                                            np.float32))
+        return True
+
+    assert all(run_ranks(ok))
+
+
 def test_process_set_algorithm_decomposition(two_host_topology):
     """A sub-set spanning both hosts decomposes over ITS OWN rank
     list (ranks 1,2 live on different hosts but 1-per-host does not

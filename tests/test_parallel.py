@@ -431,3 +431,279 @@ def test_two_level_plan_rejects_interleaved_hosts():
     topo = Topology(size=4, host_of_rank=[0, 1, 0, 1])
     with _pytest.raises(ValueError, match="grouped by host"):
         two_level_plan(topo)
+
+
+# ---------------------------------------------------------------------------
+# MPMD pipeline runtime (parallel/runtime.py + schedule.py)
+
+from horovod_tpu.parallel import (  # noqa: E402
+    PipelineSpec, build_schedule, bubble_fraction, make_mpmd_lm_train_step,
+)
+from horovod_tpu.parallel.runtime import snap_n_micro, stage_meshes_from  # noqa: E402
+from horovod_tpu.parallel.schedule import (  # noqa: E402
+    PP_CHOICES, normalize_schedule, parse_pp_label, pp_label,
+)
+
+PP_CFG = TransformerConfig(vocab_size=64, d_model=32, n_layers=4,
+                           n_heads=4, d_ff=64, max_seq_len=32,
+                           dtype=jnp.float32)
+
+
+def test_normalize_schedule():
+    assert normalize_schedule(None) is None
+    assert normalize_schedule("") is None
+    assert normalize_schedule("GPipe") == "gpipe"
+    assert normalize_schedule("fill-drain") == "gpipe"
+    assert normalize_schedule("1f1b") == "1f1b"
+    assert normalize_schedule("interleaved-1f1b") == "interleaved"
+    with pytest.raises(ValueError, match="unknown pipeline schedule"):
+        normalize_schedule("zigzag")
+
+
+def test_pp_label_round_trip():
+    for sched, m in PP_CHOICES:
+        assert parse_pp_label(pp_label(sched, m)) == (sched, m)
+
+
+def test_build_schedule_counts_and_reduce_ticks():
+    for sched, S, M, V in (("gpipe", 4, 8, 1), ("1f1b", 4, 8, 1),
+                           ("1f1b", 2, 4, 1), ("interleaved", 2, 4, 2),
+                           ("interleaved", 4, 8, 2)):
+        s = build_schedule(sched, S, M, V)
+        for st, stream in enumerate(s.streams):
+            fwd = [i for i in stream if i.op == "fwd"]
+            bwd = [i for i in stream if i.op == "bwd"]
+            red = [i for i in stream if i.op == "reduce"]
+            assert len(fwd) == len(bwd) == M * V, (sched, st)
+            # one reduce per chunk hosted on this stage, fired at the
+            # chunk's LAST backward (the bubble-overlap hook)
+            assert len(red) == V, (sched, st)
+            # a chunk's reduce never precedes its last backward
+            for r in red:
+                last = max(i for i, ins in enumerate(stream)
+                           if ins.op == "bwd" and ins.chunk == r.chunk)
+                assert stream.index(r) > last or \
+                    stream[last + 1:].index(r) >= 0
+
+
+def test_build_schedule_is_deterministic():
+    a = build_schedule("interleaved", 4, 8, 2)
+    b = build_schedule("interleaved", 4, 8, 2)
+    assert a.streams == b.streams
+    assert a.events == b.events
+    assert a.n_ticks == b.n_ticks
+
+
+def test_gpipe_bubble_closed_form():
+    # fill-drain: bubble = (S-1)/(M+S-1)
+    for S, M in ((2, 4), (4, 8), (4, 4)):
+        assert abs(bubble_fraction("gpipe", S, M)
+                   - (S - 1) / (M + S - 1)) < 1e-9
+
+
+def test_interleaved_bubble_smaller_than_1f1b():
+    assert bubble_fraction("interleaved", 4, 8, 2) < \
+        bubble_fraction("1f1b", 4, 8)
+
+
+def test_1f1b_warmup_depth_bounds_live_activations():
+    """Steady-state 1F1B holds at most S-s in-flight activations on
+    stage s (the memory bound that motivates the schedule)."""
+    S, M = 4, 16
+    s0 = build_schedule("1f1b", S, M).streams[0]
+    live = peak = 0
+    for i in s0:
+        if i.op == "fwd":
+            live += 1
+            peak = max(peak, live)
+        elif i.op == "bwd":
+            live -= 1
+    assert peak == S      # stage 0: warmup S-1, +1 steady
+
+
+def test_schedule_validation_errors():
+    with pytest.raises(ValueError, match="divisible"):
+        build_schedule("interleaved", 4, 6, 2)
+    with pytest.raises(ValueError, match="n_chunks >= 2"):
+        build_schedule("interleaved", 4, 8, 1)
+    with pytest.raises(ValueError, match="one chunk per stage"):
+        build_schedule("1f1b", 4, 8, 2)
+    with pytest.raises(ValueError, match="n_micro"):
+        build_schedule("1f1b", 4, 0)
+
+
+def test_snap_n_micro():
+    assert snap_n_micro(4, 8, 2, "1f1b") == 4
+    assert snap_n_micro(3, 8, 2, "1f1b") == 2    # must divide batch
+    assert snap_n_micro(8, 6, 3, "interleaved") == 6
+    assert snap_n_micro(6, 8, 4, "interleaved") == 4  # m % S == 0
+    # no m <= 4 divides 6 AND is a multiple of 4: degrade to 1
+    assert snap_n_micro(4, 6, 4, "interleaved") == 1
+    assert snap_n_micro(0, 8, 2, "1f1b") == 1
+
+
+def test_pipeline_spec_resolution():
+    r = PipelineSpec(pp=4).resolved()
+    assert (r.schedule, r.n_micro, r.chunks) == ("1f1b", 8, 1)
+    r = PipelineSpec(pp=2, schedule="interleaved", n_micro=3).resolved()
+    assert r.n_micro == 4 and r.chunks == 2   # rounded up to pp | m
+    r = PipelineSpec(pp=2, schedule="fill-drain").resolved()
+    assert r.schedule == "gpipe"
+
+
+def test_stage_meshes_from_carves_contiguous_subgrids():
+    mesh = build_mesh(dp=2, pp=2, tp=2)
+    subs = stage_meshes_from(mesh)
+    assert len(subs) == 2
+    for sm in subs:
+        assert "pp" not in sm.axis_names
+        assert sm.shape["dp"] == 2 and sm.shape["tp"] == 2
+    ids = [set(d.id for d in sm.devices.ravel()) for sm in subs]
+    assert not (ids[0] & ids[1])
+
+
+def test_carve_stage_ranks_host_aligned():
+    from horovod_tpu.common.topology import Topology, carve_stage_ranks
+
+    topo = Topology(size=8, host_of_rank=[0, 0, 0, 0, 1, 1, 1, 1])
+    stages, aligned = carve_stage_ranks(topo, 2)
+    assert stages == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    assert aligned          # pp boundary ON the host/DCN edge
+
+
+def test_carve_stage_ranks_heterogeneous_slots():
+    """slots 3+1+1+3 at pp=2: the equal split's boundary falls
+    between hosts 1 and 2 — host-aligned despite unequal hosts."""
+    from horovod_tpu.common.topology import Topology, carve_stage_ranks
+
+    topo = Topology(size=8, host_of_rank=[0, 0, 0, 1, 2, 3, 3, 3])
+    stages, aligned = carve_stage_ranks(topo, 2)
+    assert stages == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    assert aligned
+    # pp=4 over the same layout: boundaries at 2/4/6 cut host 0 and 3
+    stages, aligned = carve_stage_ranks(topo, 4)
+    assert stages == [[0, 1], [2, 3], [4, 5], [6, 7]]
+    assert not aligned
+
+
+def test_carve_stage_ranks_errors_and_edges():
+    from horovod_tpu.common.topology import Topology, carve_stage_ranks
+
+    topo = Topology(size=6)
+    with pytest.raises(ValueError, match="not divisible"):
+        carve_stage_ranks(topo, 4)
+    stages, aligned = carve_stage_ranks(topo, 1)
+    assert stages == [list(range(6))] and aligned
+    # ranks not grouped by host: same split, flagged unaligned
+    topo = Topology(size=4, host_of_rank=[0, 1, 0, 1])
+    stages, aligned = carve_stage_ranks(topo, 2)
+    assert stages == [[0, 1], [2, 3]] and not aligned
+
+
+def _run_lm(step, init, tokens, n=3):
+    st = init(jax.random.PRNGKey(0), tokens)
+    losses = []
+    for _ in range(n):
+        st, l = step(st, tokens)
+        losses.append(float(l))
+    return st, losses
+
+
+@pytest.mark.parametrize("schedule,pp", [("1f1b", 2), ("1f1b", 4),
+                                         ("gpipe", 2),
+                                         ("interleaved", 2)])
+def test_mpmd_runtime_matches_dense_baseline(schedule, pp):
+    """The satellite acceptance: 1F1B and interleaved gradients
+    against the single-stage dense baseline at 2 and 4 stages — same
+    rng, same tokens, same optimizer; losses AND updated params must
+    agree to float32 rounding."""
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64)
+    mesh_d = build_mesh(dp=8)
+    init_d, step_d, _, _ = make_lm_train_step(
+        mesh_d, PP_CFG, optimizer=optax.sgd(1e-2))
+    st_d, losses_d = _run_lm(step_d, init_d, tokens)
+
+    mesh_p = build_mesh(dp=8 // pp, pp=pp)
+    spec = PipelineSpec(pp=pp, dp=8 // pp, schedule=schedule, n_micro=4)
+    init_p, step_p, _, _ = make_lm_train_step(
+        mesh_p, PP_CFG, optimizer=optax.sgd(1e-2), pipeline=spec)
+    st_p, losses_p = _run_lm(step_p, init_p, tokens)
+
+    np.testing.assert_allclose(losses_p, losses_d, rtol=0, atol=2e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=0, atol=2e-6),
+        st_p["params"], st_d["params"])
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_mpmd_composes_with_sequence_parallel(impl):
+    """ring_attention / ulysses run INSIDE each stage's sub-mesh
+    under an outer pp axis and still match the dense run."""
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
+    mesh_d = build_mesh(dp=4, sp=2)
+    init_d, step_d, _, _ = make_lm_train_step(
+        mesh_d, PP_CFG, optimizer=optax.sgd(1e-2),
+        sequence_parallel=True, attention_impl=impl)
+    _, losses_d = _run_lm(step_d, init_d, tokens, n=2)
+
+    mesh_p = build_mesh(dp=2, pp=2, sp=2)
+    init_p, step_p, _, _ = make_lm_train_step(
+        mesh_p, PP_CFG, optimizer=optax.sgd(1e-2),
+        sequence_parallel=True, attention_impl=impl,
+        pipeline=PipelineSpec(pp=2, dp=2, n_micro=2))
+    _, losses_p = _run_lm(step_p, init_p, tokens, n=2)
+    np.testing.assert_allclose(losses_p, losses_d, rtol=0, atol=2e-6)
+
+
+def test_mpmd_snaps_indivisible_n_micro():
+    """An autotune proposal the batch cannot divide degrades
+    deterministically instead of failing the step."""
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (6, 16), 0, 64)
+    mesh = build_mesh(MeshSpec(pp=2), jax.devices()[:2])
+    spec = PipelineSpec(pp=2, n_micro=4, schedule="1f1b")
+    init, step, _, _ = make_lm_train_step(
+        mesh, PP_CFG, optimizer=optax.sgd(1e-2),
+        pipeline=spec)
+    st = init(jax.random.PRNGKey(0), tokens)
+    st, loss = step(st, tokens)       # 6 % 4 != 0 -> snaps to 3
+    assert np.isfinite(float(loss))
+
+
+def test_mpmd_rejects_fused_ce():
+    mesh = build_mesh(dp=4, pp=2)
+    with pytest.raises(ValueError, match="fused_ce"):
+        make_lm_train_step(mesh, PP_CFG, fused_ce=True,
+                           pipeline=PipelineSpec(pp=2, dp=4))
+
+
+def test_mpmd_rejects_mesh_spec_mismatch():
+    mesh = build_mesh(dp=4, pp=2)
+    with pytest.raises(ValueError, match="pp axis"):
+        make_mpmd_lm_train_step(mesh, PP_CFG, PipelineSpec(pp=4))
+
+
+def test_mpmd_latch_degrades_unsnappable_interleaved_proposal():
+    """An autotune pipeline proposal with no legal downward snap —
+    (interleaved, m=2) at pp=4 is a real PP_CHOICES grid point — must
+    degrade deterministically inside MpmdWorker._latch (snap UP to
+    the smallest batch-dividing multiple of pp), never kill the step;
+    a batch pp cannot divide at all still fails loudly."""
+    from types import SimpleNamespace
+
+    from horovod_tpu.parallel.runtime import MpmdWorker
+
+    w = MpmdWorker.__new__(MpmdWorker)
+    w.spec = PipelineSpec(pp=4, schedule="interleaved", n_micro=8,
+                          chunks=2).resolved()
+    w.programs = SimpleNamespace(total_chunks=8)
+    w._schedules = {}
+    w.eng = SimpleNamespace(config=SimpleNamespace(
+        pp_stages=4, pp_schedule="interleaved", pp_n_micro=2))
+    sched, m, sobj = w._latch(16)
+    assert sched == "interleaved"
+    assert m == 4 and 16 % m == 0 and m % 4 == 0
+    assert sobj.total_chunks == 8
+
+    with pytest.raises(ValueError, match="admits none"):
+        w._latch(6)

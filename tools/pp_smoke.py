@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""CI pipeline smoke (ci.sh `pp` step; modeled on metrics_smoke.py):
+launch a REAL 4-process 2-stage dp×pp LM training job through the
+MPMD pipeline runtime (parallel/runtime.MpmdWorker — per-stage
+process sets, 1F1B instruction streams, gradient allreduces submitted
+into the pipeline bubbles) and validate end-to-end that
+
+* the per-step loss trajectory MATCHES a dense single-process run of
+  the same model/rng/batch within float tolerance (the dense twin is
+  computed on rank 0 — same init, same tokens);
+* gradient reduces were genuinely overlapped into bubbles
+  (``horovod_pp_overlapped_reductions_total`` > 0) and every step ran
+  under the latched schedule tag (``horovod_pp_steps_total``);
+* the merged ``GET /timeline`` on the launcher carries PER-STAGE
+  lanes (``pp.stage0`` / ``pp.stage1`` thread_name metadata) so
+  bubble time is attributable by stage;
+* steady state never recompiles: after the warm-up steps the
+  compiled-program-cache miss counter is FLAT across the remaining
+  steps (every chunk program is a `_shared_program` cache hit).
+
+Driver mode (no args): launches 4 workers.  Worker mode
+(PP_WORKER=1): builds the MpmdWorker, trains, validates.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+N_STAGES = 2
+DP = 2
+N_MICRO = 4
+GLOBAL_BATCH = 8
+SEQ = 16
+WARMUP_STEPS = 2        # compile + cache-fill steps
+STEADY_STEPS = 5        # must add ZERO cache misses
+LOSS_ATOL = 2e-3        # f32 sum-order tolerance on a ~10.0 loss
+
+
+def _get(url, timeout=60):
+    import urllib.request
+    return urllib.request.urlopen(url, timeout=timeout).read().decode()
+
+
+def _counter_total(snapshot, family, **labels):
+    fam = snapshot.get(family) or {}
+    total = 0.0
+    for s in fam.get("samples", []):
+        lab = s.get("labels", {})
+        if all(lab.get(k) == v for k, v in labels.items()):
+            total += float(s.get("value", 0.0))
+    return total
+
+
+def worker():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.common import env as env_mod
+    from horovod_tpu.models import TransformerConfig
+    from horovod_tpu.parallel import (
+        MeshSpec, PipelineSpec, build_mesh, make_lm_train_step,
+        MpmdWorker,
+    )
+
+    hvd.init()
+    r = hvd.rank()
+    assert hvd.size() == N_STAGES * DP
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=4, n_heads=4, d_ff=64,
+        max_seq_len=SEQ, dtype=jnp.float32)
+    rng = jax.random.PRNGKey(0)
+    tokens = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(1), (GLOBAL_BATCH, SEQ), 0, cfg.vocab_size))
+
+    spec = PipelineSpec(pp=N_STAGES, dp=DP, n_micro=N_MICRO,
+                        schedule="1f1b")
+    w = MpmdWorker(cfg, spec, optimizer=optax.sgd(1e-2))
+    assert w.my_stage == r // DP and w.dp_index == r % DP, \
+        f"rank {r}: stage {w.my_stage} dp {w.dp_index}"
+    w.init(rng, jnp.asarray(tokens))
+
+    # this dp shard's rows — the SAME shard at every stage of this
+    # dp index (stage 0 embeds it, stage 1 scores it)
+    per = GLOBAL_BATCH // DP
+    mine = tokens[w.dp_index * per:(w.dp_index + 1) * per]
+
+    losses = []
+    for _ in range(WARMUP_STEPS):
+        losses.append(w.step(mine))
+
+    # cache-fill done: steady state must be all hits
+    snap = hvd.metrics()
+    miss_before = _counter_total(
+        snap, "horovod_program_cache_misses_total")
+    assert miss_before > 0, "pipeline never touched the program cache"
+
+    for _ in range(STEADY_STEPS):
+        losses.append(w.step(mine))
+
+    snap = hvd.metrics()
+    miss_after = _counter_total(
+        snap, "horovod_program_cache_misses_total")
+    assert miss_after == miss_before, (
+        f"worker {r}: steady-state pipeline recompiled — cache "
+        f"misses {miss_before} -> {miss_after}")
+    # every step ran under the latched schedule tag, and (dp > 1) the
+    # per-chunk gradient reduces were submitted into the bubbles
+    steps = _counter_total(snap, "horovod_pp_steps_total",
+                           schedule=f"1f1b@{N_MICRO}")
+    assert steps == WARMUP_STEPS + STEADY_STEPS, \
+        f"worker {r}: pp steps {steps}"
+    overlapped = _counter_total(
+        snap, "horovod_pp_overlapped_reductions_total")
+    assert overlapped > 0, \
+        f"worker {r}: no gradient reduce was overlapped into a bubble"
+    hvd.barrier()
+
+    if r == 0:
+        # -- loss parity: the dense twin — same rng, same global
+        # batch, same optimizer, one process, no pipeline ------------
+        mesh = build_mesh(MeshSpec(dp=1), jax.devices()[:1])
+        init_d, step_d, _, _ = make_lm_train_step(
+            mesh, cfg, optimizer=optax.sgd(1e-2))
+        st = init_d(rng, jnp.asarray(tokens))
+        dense = []
+        for _ in range(WARMUP_STEPS + STEADY_STEPS):
+            st, l = step_d(st, jnp.asarray(tokens))
+            dense.append(float(l))
+        worst = max(abs(a - b) for a, b in zip(dense, losses))
+        assert worst <= LOSS_ATOL, (
+            f"pipelined loss diverged from the dense twin: "
+            f"dense={dense} pipelined={losses} (worst {worst:.2e})")
+        assert dense[-1] < dense[0], "loss never decreased"
+        print(f"loss parity OK: worst |Δ| {worst:.2e} over "
+              f"{len(dense)} steps")
+
+        # -- per-stage lanes in the merged job trace ----------------
+        addr = env_mod.require_str(env_mod.HOROVOD_RENDEZVOUS_ADDR)
+        port = env_mod.require_int(env_mod.HOROVOD_RENDEZVOUS_PORT)
+        merged = json.loads(_get(
+            f"http://{addr}:{port}/timeline?wait=15"))
+        lanes = {e["args"]["name"] for e in merged
+                 if e.get("name") == "thread_name"}
+        stage_lanes = {n for n in lanes if n.startswith("pp.stage")}
+        for s in range(N_STAGES):
+            assert f"pp.stage{s}" in stage_lanes, (
+                f"merged /timeline missing the pp.stage{s} lane "
+                f"(lanes: {sorted(lanes)})")
+        ops = {e.get("name") for e in merged}
+        assert "PP_FWD" in ops and "PP_BWD" in ops, sorted(ops)[:40]
+        print(f"merged /timeline OK: stage lanes {sorted(stage_lanes)}")
+    hvd.barrier()
+    hvd.shutdown()
+    print(f"worker {r} OK")
+
+
+def main():
+    if os.environ.get("PP_WORKER"):
+        worker()
+        return
+    from horovod_tpu.runner.proc_run import launch_procs
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    codes = launch_procs(
+        [sys.executable, os.path.abspath(__file__)],
+        np=N_STAGES * DP, platform="cpu",
+        env={"PYTHONPATH": repo, "PP_WORKER": "1",
+             "HOROVOD_PP_STAGES": str(N_STAGES),
+             "HOROVOD_PP_MICROBATCHES": str(N_MICRO),
+             "HOROVOD_PP_SCHEDULE": "1f1b"},
+        start_timeout=240)
+    assert codes == [0] * (N_STAGES * DP), f"worker exit codes {codes}"
+    print("PP SMOKE OK")
+
+
+if __name__ == "__main__":
+    main()

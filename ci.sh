@@ -23,6 +23,13 @@
 #                         #   coordinator 5xx, hang) with a hang
 #                         #   watchdog; asserts recovery, stall
 #                         #   attribution and same-seed determinism
+#   ./ci.sh scale         # gate: tools/scale_harness.py — 1000
+#                         #   synthetic fabric clients over 25
+#                         #   per-host aggregators, one aggregator
+#                         #   killed mid-warm-up; asserts coordinator
+#                         #   requests/cycle scale with hosts (not
+#                         #   procs), zero false worker deaths,
+#                         #   bounded p99 negotiation-cycle time
 #   ./ci.sh serve         # smoke: real 2-proc serving job — dynamic
 #                         #   batching through the compiled cache,
 #                         #   kill one replica mid-traffic (fault
@@ -65,7 +72,8 @@ PART2="tests/test_elastic.py tests/test_examples.py \
   tests/test_ray_strategy.py tests/test_spark_streaming.py \
   tests/test_tensorflow.py"
 PART3="tests/test_parallel.py tests/test_torch.py"
-PART4="tests/test_api_parity.py tests/test_chaos.py \
+PART4="tests/test_aggregator.py tests/test_api_parity.py \
+  tests/test_chaos.py \
   tests/test_pallas.py tests/test_runner.py tests/test_serving.py"
 
 case "${1:-all}" in
@@ -109,9 +117,22 @@ case "${1:-all}" in
     # keep flowing on the negotiation bypass (>= 20 during the
     # outage), the service restarts from its journal at epoch+1 with
     # zero workers falsely declared dead, and the same-seed fault
-    # evidence is byte-identical.  Every scenario runs under a hard
-    # watchdog.
+    # evidence is byte-identical; the PER-HOST AGGREGATOR tier is
+    # restarted during warm-up and killed at steady state — steps
+    # keep flowing (direct fallback), zero false deaths, same-seed
+    # byte-identical.  Every scenario runs under a hard watchdog.
     python tools/chaos_smoke.py
+    ;;
+  scale)
+    # control-plane scale gate (docs/fault_tolerance.md "Per-host
+    # aggregator tier"): 1000 synthetic StoreControllers (threads, no
+    # training) through 25 aggregators into one coordinator, with
+    # host 0's aggregator killed mid-warm-up and an elastic round
+    # reset mid-run.  The harness itself asserts the fan-in ratio,
+    # zero false deaths and the p99 cycle-time bound; every cycle
+    # runs under a hard deadline so a wedged tier fails, not hangs.
+    shift
+    python tools/scale_harness.py "$@"
     ;;
   trace)
     # job-wide tracing smoke: a REAL 2-process job — merged GET
@@ -255,7 +276,7 @@ case "${1:-all}" in
     python -m pytest $PART4 -q
     ;;
   *)
-    echo "usage: $0 {analyze|fast|matrix|integration|chaos|trace|metrics|serve|pp|bench|perf|all}" >&2
+    echo "usage: $0 {analyze|fast|matrix|integration|chaos|scale|trace|metrics|serve|pp|bench|perf|all}" >&2
     exit 2
     ;;
 esac

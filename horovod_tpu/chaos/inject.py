@@ -260,6 +260,25 @@ class FaultInjector:
                     time.sleep(0.05)
 
 
+def _wall_trigger_loop(st, stop, fire):
+    """Shared wall-offset trigger for the service fault runners
+    (Coord/Agg): fire the event at its scheduled offset, redrawing
+    shortly on a probabilistic skip — ONE definition, so the two
+    runners' same-seed determinism semantics can never diverge."""
+    epoch = time.monotonic()
+    while not st.exhausted and not stop.is_set():
+        dt = epoch + st.event.at - time.monotonic()
+        if dt > 0 and stop.wait(min(dt, 0.5)):
+            return
+        if time.monotonic() - epoch < st.event.at:
+            continue
+        secs = round(time.monotonic() - epoch, 3)
+        if st.due(secs):
+            fire(st.event, secs)
+        else:
+            time.sleep(0.05)    # probabilistic skip: redraw
+
+
 # -- process-wide installation -------------------------------------------------
 
 _INSTALLED = None
@@ -392,18 +411,7 @@ class CoordFaultRunner:
                 return
 
     def _await_wall(self, st):
-        epoch = time.monotonic()
-        while not st.exhausted and not self._stop.is_set():
-            dt = epoch + st.event.at - time.monotonic()
-            if dt > 0 and self._stop.wait(min(dt, 0.5)):
-                return
-            if time.monotonic() - epoch < st.event.at:
-                continue
-            secs = round(time.monotonic() - epoch, 3)
-            if st.due(secs):
-                self._fire(st.event, secs)
-            else:
-                time.sleep(0.05)    # probabilistic skip: redraw
+        _wall_trigger_loop(st, self._stop, self._fire)
 
     def _fire(self, event: FaultEvent, n):
         # the deterministic projection (compared across same-seed
@@ -444,6 +452,113 @@ class CoordFaultRunner:
                                        sort_keys=True) + "\n")
             except OSError:
                 pass
+
+
+class AggFaultRunner:
+    """Owner-process applier of ``agg_kill`` / ``agg_restart`` fault
+    events against one host's AggregatorServer — the chaos tier's way
+    to kill the MIDDLE tier (docs/fault_tolerance.md "Per-host
+    aggregator tier").
+
+    ``agg_kill`` stops the aggregator HTTP service for good: local
+    workers see connection failures, fall back to direct coordinator
+    mode within ``HOROVOD_AGG_FALLBACK_DEADLINE_SECONDS``, and the
+    coordinator's liveness holds their verdict as *suspect* until the
+    direct beats land.  ``agg_restart`` stops it, sleeps the event's
+    ``ms``, then starts a FRESH stateless core on the same port — the
+    coordinator bumps that aggregator's agg_epoch and every worker is
+    re-fenced into resync + drain + re-report.
+
+    Triggers mirror the CoordFaultRunner: ``after_s`` (wall) or
+    ``after`` (the n-th request the aggregator handles, polled off
+    its request counter; the deterministic evidence records the
+    SCHEDULED threshold, like the coordinator runner's wall records).
+    Fired records (plus wall-clock ``t_stop``/``t_start`` bounds) are
+    appended to ``HOROVOD_FAULT_AGG_LOG`` when set."""
+
+    def __init__(self, server, plan: FaultPlan, agg_index: int,
+                 env=None):
+        self.server = server
+        self.plan = plan
+        self.agg_index = agg_index
+        self.events = plan.aggregator_events(agg_index)
+        self.fired = []
+        self._log_path = (env or os.environ).get(
+            "HOROVOD_FAULT_AGG_LOG")
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads = []
+
+    def start(self):
+        for e in self.events:
+            st = _EventState(e, self.plan.rng_for(e))
+            target = self._await_requests if e.trigger == "requests" \
+                else self._await_wall
+            t = threading.Thread(target=target, args=(st,),
+                                 name="horovod_tpu-chaos-agg",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+    def _await_requests(self, st):
+        """Fire once the aggregator has handled the event's n-th
+        request (polled off the core's counter — restarted cores
+        restart the count, like the coordinator's re-installed
+        rules)."""
+        while not self._stop.wait(0.05):
+            agg = self.server.aggregator
+            if agg is None or agg.requests < st.event.at:
+                continue
+            if st.due(st.event.at):
+                self._fire(st.event, st.event.at)
+            return
+
+    def _await_wall(self, st):
+        _wall_trigger_loop(st, self._stop, self._fire)
+
+    def _fire(self, event: FaultEvent, n):
+        # deterministic projection (compared across same-seed runs):
+        # scheduled thresholds only, wall bounds ride t_stop/t_start
+        rec = {"kind": event.kind, "event": event.index,
+               "trigger": event.trigger,
+               "n": event.at, "agg": self.agg_index}
+        logger.warning("chaos: injecting %s on aggregator %s "
+                       "(event #%d, %s=%s)", event.kind,
+                       self.agg_index, event.index, event.trigger, n)
+        _count_injected(event.kind)
+        times = {"t_stop": time.time()}
+        self.server.stop_http()
+        if event.kind == "agg_restart":
+            time.sleep(event.ms / 1000.0)
+            self.server.restart()
+            times["t_start"] = time.time()
+        with self._lock:
+            self.fired.append(rec)
+        if self._log_path:
+            try:
+                with open(self._log_path, "a") as f:
+                    f.write(json.dumps({**rec, **times},
+                                       sort_keys=True) + "\n")
+            except OSError:
+                pass
+
+
+def start_aggregator_faults(server, agg_index, env=None):
+    """Start the agg_kill/agg_restart runner for one host's
+    aggregator server, when the fault plan targets it.  Returns the
+    runner or None."""
+    from .plan import plan_from_env
+    plan = plan_from_env(env)
+    if plan is None or not plan.aggregator_events(agg_index):
+        return None
+    runner = AggFaultRunner(server, plan, agg_index, env=env).start()
+    logger.warning("chaos: %d aggregator service fault(s) armed on "
+                   "aggregator %s", len(runner.events), agg_index)
+    return runner
 
 
 def start_coordinator_faults(server, env=None):

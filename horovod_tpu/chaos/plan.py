@@ -32,7 +32,10 @@ path to a JSON file; ``horovodrun --fault-plan`` forwards it)::
                                "after": 5, "count": 3},
         {"kind": "clock_skew", "proc": 1, "ms": 5000, "after_s": 2.0},
         {"kind": "coord_restart", "after_s": 5.0, "ms": 3000},
-        {"kind": "coord_kill", "after": 200}
+        {"kind": "coord_kill", "after": 200},
+        {"kind": "agg_restart", "proc": 0, "after_s": 3.0,
+                                "ms": 1500},
+        {"kind": "agg_kill", "proc": 1, "after_s": 8.0}
       ]
     }
 
@@ -79,7 +82,19 @@ ENGINE_KINDS = ("slow_rank",)
 #: implicitly ``side: "coord"`` and trigger on ``after_s`` (wall) or
 #: ``after`` (the n-th coordinator request).
 COORD_KINDS = ("coord_kill", "coord_restart")
-KINDS = PROCESS_KINDS + WIRE_KINDS + ENGINE_KINDS + COORD_KINDS
+#: Aggregator-tier kinds, mirroring the coordinator pair
+#: (docs/fault_tolerance.md "Per-host aggregator tier"): ``agg_kill``
+#: tears one host's aggregator down for good — its workers fall back
+#: to direct coordinator mode; ``agg_restart`` tears it down for
+#: ``ms`` milliseconds, then starts a FRESH stateless core on the
+#: same port (agg_epoch bumped upstream, workers re-fenced).  Both
+#: are implicitly ``side: "agg"``; ``proc`` names the target host/
+#: aggregator index (None = every host's aggregator), and the
+#: trigger is ``after_s`` (wall) or ``after`` (the n-th request that
+#: host's aggregator handles).
+AGG_KINDS = ("agg_kill", "agg_restart")
+KINDS = PROCESS_KINDS + WIRE_KINDS + ENGINE_KINDS + COORD_KINDS \
+    + AGG_KINDS
 
 #: Trigger spellings -> canonical trigger name.
 _TRIGGERS = {"after_requests": "requests",
@@ -137,6 +152,14 @@ class FaultPlan:
         """Events the launcher installs into its coordinator."""
         return [e for e in self.events if e.side == "coord"]
 
+    def aggregator_events(self, agg_index: int) -> List[FaultEvent]:
+        """Service faults the process owning aggregator ``agg_index``
+        (= its host index) must apply — targeted by ``proc``, or
+        untargeted (every host's aggregator)."""
+        return [e for e in self.events
+                if e.side == "agg"
+                and (e.proc is None or e.proc == agg_index)]
+
     def rng_for(self, event: FaultEvent) -> random.Random:
         """The event's private RNG stream — a pure function of
         (plan seed, event index), so every process and every run draws
@@ -153,19 +176,26 @@ def _parse_event(index: int, raw: dict) -> FaultEvent:
             f"fault event #{index}: unknown kind {kind!r} "
             f"(valid: {', '.join(KINDS)})")
     side = raw.get("side", "worker")
-    if side not in ("worker", "coord"):
+    if side not in ("worker", "coord", "agg"):
         raise ValueError(
-            f"fault event #{index}: side must be 'worker' or 'coord', "
-            f"got {side!r}")
+            f"fault event #{index}: side must be 'worker', 'coord' "
+            f"or 'agg', got {side!r}")
     if kind in COORD_KINDS:
         # coordinator-targeting kinds are coord-side by definition
         side = "coord"
+    if kind in AGG_KINDS:
+        # aggregator-targeting kinds are agg-side by definition
+        side = "agg"
     if side == "coord" and kind not in (
             "http_error", "delay_ms") + COORD_KINDS:
         raise ValueError(
             f"fault event #{index}: coordinator-side events support "
             f"http_error (reject), delay_ms (stall), coord_kill and "
             f"coord_restart, not {kind}")
+    if side == "agg" and kind not in AGG_KINDS:
+        raise ValueError(
+            f"fault event #{index}: aggregator-side events support "
+            f"agg_kill and agg_restart, not {kind}")
     triggers = [k for k in _TRIGGERS if k in raw]
     if len(triggers) != 1:
         raise ValueError(
@@ -181,15 +211,20 @@ def _parse_event(index: int, raw: dict) -> FaultEvent:
         raise ValueError(
             f"fault event #{index}: coordinator-side events count "
             f"matching requests via 'after', not {trig_key}")
-    if kind in COORD_KINDS and trig_key not in ("after", "after_s"):
+    if kind in COORD_KINDS + AGG_KINDS \
+            and trig_key not in ("after", "after_s"):
         raise ValueError(
             f"fault event #{index}: {kind} triggers on 'after' "
-            f"(n-th coordinator request) or 'after_s' (wall), not "
+            f"(n-th service request) or 'after_s' (wall), not "
             f"{trig_key}")
     if kind == "coord_restart" and not raw.get("ms"):
         raise ValueError(
             f"fault event #{index}: coord_restart needs 'ms' > 0 "
             f"(the outage duration before the journal restart)")
+    if kind == "agg_restart" and not raw.get("ms"):
+        raise ValueError(
+            f"fault event #{index}: agg_restart needs 'ms' > 0 "
+            f"(the outage duration before the stateless restart)")
     proc = raw.get("proc")
     rank = raw.get("rank")
     if kind == "slow_rank":

@@ -284,9 +284,6 @@ def init(comm=None, process_sets=None, num_ranks=None, devices=None):
                 global_size = num_procs * num_ranks
                 rank_offset = proc_id * num_ranks
             proc_index = proc_id
-            controller = StoreController(
-                rdv_addr, rdv_port, secret, proc_id, num_procs,
-                num_ranks, round_id=round_id)
             if devices is None:
                 import jax as _jax
                 devices = _jax.devices()
@@ -312,6 +309,23 @@ def init(comm=None, process_sets=None, num_ranks=None, devices=None):
                             for _ in range(counts[p])]
             _topology = Topology(size=global_size,
                                  host_of_rank=host_of_rank)
+            # per-host aggregator tier (docs/fault_tolerance.md): the
+            # lowest-indexed proc of each host starts the aggregator
+            # and publishes its address in the launcher's KV store;
+            # every local proc routes its control traffic through it
+            # (TieredStoreClient keeps the direct coordinator route
+            # as the fallback)
+            agg_addr = agg_port = None
+            from ..runner.http import aggregator as agg_mod
+            if agg_mod.tier_enabled() and num_procs > 1:
+                agg_addr, agg_port, _agg_id = \
+                    agg_mod.ensure_host_aggregator(
+                        rdv_addr, rdv_port, secret, proc_id,
+                        host_of_proc, round_id=round_id)
+            controller = StoreController(
+                rdv_addr, rdv_port, secret, proc_id, num_procs,
+                num_ranks, round_id=round_id,
+                agg_addr=agg_addr, agg_port=agg_port)
         else:
             _topology = Topology(size=num_ranks)
         if devices is None:
@@ -451,6 +465,13 @@ def shutdown():
         _engine.shutdown()
         if _timeline is not None:
             _timeline.close()
+        if _engine.multiproc:
+            # stop this process's per-host aggregator (if it owns
+            # one) AFTER the engine's goodbye rode it; co-hosted
+            # workers still running fall back to direct mode
+            from ..runner.http.aggregator import \
+                stop_process_aggregator
+            stop_process_aggregator()
         from . import process_sets as ps_mod
         ps_mod._reset()
         from ..ops import compiled as _compiled

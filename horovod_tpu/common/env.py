@@ -93,6 +93,20 @@ HOROVOD_COORD_OUTAGE_DEADLINE_SECONDS = \
 HOROVOD_BYPASS_AFTER_CYCLES = "HOROVOD_BYPASS_AFTER_CYCLES"
 HOROVOD_BYPASS_WAIT_SECONDS = "HOROVOD_BYPASS_WAIT_SECONDS"
 
+# per-host aggregator tier (docs/fault_tolerance.md "Per-host
+# aggregator tier"): TIER selects the control-plane topology (flat =
+# every proc talks to the coordinator, host = one aggregator per host
+# batches its workers' traffic upstream); LINGER_MS is the batching
+# window the aggregator's flusher waits for co-reporting local
+# workers; FALLBACK_DEADLINE bounds how long a worker's requests
+# retry against a silent aggregator before falling back to direct
+# coordinator mode (deliberately much tighter than the coordinator
+# outage deadline — the fallback IS the recovery).
+HOROVOD_CONTROL_PLANE_TIER = "HOROVOD_CONTROL_PLANE_TIER"
+HOROVOD_AGG_LINGER_MS = "HOROVOD_AGG_LINGER_MS"
+HOROVOD_AGG_FALLBACK_DEADLINE_SECONDS = \
+    "HOROVOD_AGG_FALLBACK_DEADLINE_SECONDS"
+
 # shared-secret for the launcher's HMAC-authenticated KV channel
 # (reference runner/common/util/secret.py; hex in the env)
 HOROVOD_SECRET_KEY = "HOROVOD_SECRET_KEY"

@@ -14,8 +14,9 @@ explicit NCCL communicator synchronization.
 import json
 import threading
 
+from ..common import env as env_mod
 from ..common.exceptions import HorovodInternalError
-from ..runner.http.http_client import StoreClient
+from ..runner.http.http_client import StoreClient, TieredStoreClient
 from ..runner.http.contract import CACHEABLE_TYPES as _CACHEABLE_TYPES
 
 
@@ -40,8 +41,26 @@ class StoreController:
     """One per worker process in multi-process jobs."""
 
     def __init__(self, addr, port, secret, proc_id, num_procs,
-                 nlocal, poll_wait=5.0, round_id=0):
-        self.client = StoreClient(addr, port, secret)
+                 nlocal, poll_wait=5.0, round_id=0,
+                 agg_addr=None, agg_port=None):
+        if agg_addr is not None:
+            # per-host aggregator tier: PRIMARY route is the host's
+            # aggregator with a deliberately tight retry budget (a
+            # silent aggregator must trigger the direct fallback in
+            # seconds, not after the coordinator outage deadline);
+            # the direct coordinator client keeps the
+            # outage-spanning budget
+            agg_client = StoreClient(agg_addr, agg_port, secret)
+            fb = env_mod.get_float(
+                env_mod.HOROVOD_AGG_FALLBACK_DEADLINE_SECONDS, 5.0)
+            agg_client.retry_attempts = 3
+            agg_client.retry_deadline = fb
+            agg_client.outage_deadline = fb
+            self.client = TieredStoreClient(
+                agg_client, StoreClient(addr, port, secret))
+            self.client.on_route_change = self._on_route_change
+        else:
+            self.client = StoreClient(addr, port, secret)
         self.proc_id = proc_id
         self.num_procs = num_procs
         self.nlocal = nlocal
@@ -72,19 +91,52 @@ class StoreController:
         #: replay, then the engine drains the replayed response log
         #: and re-reports whatever is still awaiting.
         self.epoch = None
+        #: aggregator generation (the second half of the
+        #: (coord_epoch, agg_epoch) fence pair, docs/fault_tolerance
+        #: "Per-host aggregator tier"): learned from the tier's
+        #: replies, carried on every verb.  A restarted (stateless)
+        #: aggregator registers a new session upstream, the
+        #: coordinator bumps its agg_epoch, and this worker's first
+        #: contact with the successor gets the SAME
+        #: mismatch-then-resync recovery a coordinator restart does.
+        #: The coordinator itself ignores the field, so a direct
+        #: fallback needs no unstamping.
+        self.agg_epoch = None
         self._drain_to = None
         self._rereport = False
 
     # -- epoch fencing -------------------------------------------------------
 
-    def _coord(self, verb, payload, timeout=None, budget=None):
-        """One coordinator verb with the epoch attached; handles the
-        stale-round and epoch-mismatch replies in ONE place."""
+    def _on_route_change(self, reason):
+        """TieredStoreClient switched routes (aggregator died ->
+        direct, or a probe re-attached).  Either way the in-flight
+        picture is unknown — the last batch may or may not have
+        landed — so run the same resync + drain + re-report recovery
+        an epoch bump triggers."""
+        self.resync()
+
+    def _stamp(self, payload):
         with self._lock:
             if self.epoch is not None:
                 payload = {**payload, "epoch": self.epoch}
-        out = self.client.coord(verb, payload, timeout=timeout,
-                                budget=budget)
+            if self.agg_epoch is not None:
+                payload = {**payload, "agg_epoch": self.agg_epoch}
+        return payload
+
+    def _adopt_epochs(self, out):
+        with self._lock:
+            if out.get("epoch") is not None:
+                self.epoch = out["epoch"]
+            if out.get("agg_epoch") is not None:
+                self.agg_epoch = out["agg_epoch"]
+
+    def _coord(self, verb, payload, timeout=None, budget=None):
+        """One coordinator verb with the (coord_epoch, agg_epoch)
+        pair attached; handles the stale-round and epoch-mismatch
+        replies in ONE place.  Either tier's fence may answer — the
+        recovery is identical."""
+        out = self.client.coord(verb, self._stamp(payload),
+                                timeout=timeout, budget=budget)
         if out.get("stale"):
             raise StaleRoundError(
                 f"coordinator moved to round {out.get('round')}")
@@ -96,18 +148,15 @@ class StoreController:
                 # entries pre-crash (the journaled log replays them).
                 # Recovery is drain-then-rereport (take_rereport).
                 return {}
-            payload = {**payload, "epoch": self.epoch}
-            out = self.client.coord(verb, payload, timeout=timeout,
-                                    budget=budget)
+            out = self.client.coord(verb, self._stamp(payload),
+                                    timeout=timeout, budget=budget)
             if out.get("stale"):
                 raise StaleRoundError(
                     f"coordinator moved to round {out.get('round')}")
             if out.get("epoch_mismatch"):
                 raise HorovodInternalError(
                     "coordinator epoch moved twice within one request")
-        if out.get("epoch") is not None:
-            with self._lock:
-                self.epoch = out["epoch"]
+        self._adopt_epochs(out)
         return out
 
     def resync(self):
@@ -125,6 +174,7 @@ class StoreController:
                 f"coordinator moved to round {out.get('round')}")
         with self._lock:
             self.epoch = out.get("epoch")
+            self.agg_epoch = out.get("agg_epoch")
             self._drain_to = out.get("cursor", 0)
             self._rereport = True
             self._reported.clear()
@@ -250,6 +300,11 @@ class StoreController:
             payload["host"] = host
         if bye:
             payload["bye"] = True
+        elif isinstance(self.client, TieredStoreClient):
+            # the heartbeat loop is the probe clock: a fallen-back
+            # worker re-pings its aggregator here and re-attaches
+            # when an agg_restart brought it back
+            self.client.maybe_probe()
         # the goodbye races teardown: a dead rendezvous service must
         # not wedge clean worker exit behind the outage-spanning
         # retry budget — one bounded retry, then give up

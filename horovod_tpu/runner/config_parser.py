@@ -28,6 +28,10 @@ HOROVOD_COORD_OUTAGE_DEADLINE_SECONDS = \
     "HOROVOD_COORD_OUTAGE_DEADLINE_SECONDS"
 HOROVOD_BYPASS_AFTER_CYCLES = "HOROVOD_BYPASS_AFTER_CYCLES"
 HOROVOD_BYPASS_WAIT_SECONDS = "HOROVOD_BYPASS_WAIT_SECONDS"
+HOROVOD_CONTROL_PLANE_TIER = "HOROVOD_CONTROL_PLANE_TIER"
+HOROVOD_AGG_LINGER_MS = "HOROVOD_AGG_LINGER_MS"
+HOROVOD_AGG_FALLBACK_DEADLINE_SECONDS = \
+    "HOROVOD_AGG_FALLBACK_DEADLINE_SECONDS"
 HOROVOD_STALL_CHECK_DISABLE = "HOROVOD_STALL_CHECK_DISABLE"
 HOROVOD_STALL_CHECK_TIME_SECONDS = "HOROVOD_STALL_CHECK_TIME_SECONDS"
 HOROVOD_STALL_SHUTDOWN_TIME_SECONDS = "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"
@@ -128,6 +132,13 @@ def set_env_from_args(env: dict, args) -> dict:
     if getattr(args, "bypass_wait_seconds", None) is not None:
         env[HOROVOD_BYPASS_WAIT_SECONDS] = str(
             args.bypass_wait_seconds)
+    if getattr(args, "control_plane_tier", None):
+        env[HOROVOD_CONTROL_PLANE_TIER] = args.control_plane_tier
+    if getattr(args, "agg_linger_ms", None) is not None:
+        env[HOROVOD_AGG_LINGER_MS] = str(args.agg_linger_ms)
+    if getattr(args, "agg_fallback_deadline_seconds", None) is not None:
+        env[HOROVOD_AGG_FALLBACK_DEADLINE_SECONDS] = str(
+            args.agg_fallback_deadline_seconds)
     if getattr(args, "serve", False):
         env["HOROVOD_SERVING"] = "1"
         # the autoscaler is blind without the replicas' snapshot

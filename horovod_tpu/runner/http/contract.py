@@ -19,8 +19,17 @@ validates the SAME single definition dynamically.
 #: is safe (the original may still have landed).  Across a coordinator
 #: restart the epoch fence rejects any blind replay BEFORE its verb
 #: runs, so the contract holds outage-spanning too.
+#:
+#: The aggregator tier (runner/http/aggregator.py) batches worker
+#: verbs into the ``agg_*`` upstream verbs; each inherits the dedup
+#: of the per-proc reports it carries (``agg_ready``: per-proc rid,
+#: ``agg_heartbeat``: naturally idempotent beats, ``agg_resync``:
+#: idempotent per-(agg, sid) registration), so the SAME contract holds
+#: across all three tiers — worker↔aggregator, aggregator↔coordinator,
+#: and the direct worker↔coordinator fallback.
 REPLAY_SAFE_VERBS = ("ready", "join", "heartbeat", "resync",
-                     "bypass_ready")
+                     "bypass_ready", "agg_ready", "agg_heartbeat",
+                     "agg_resync")
 
 #: KV-path pseudo-verbs that are replay-safe by DATA MODEL rather than
 #: by dedup: puts are last-writer-wins and gets are reads, so a
@@ -39,15 +48,33 @@ REPLAY_DEDUP_ATTRS = {
     "heartbeat": ("_beats",),           # last-beat map: re-beat = update
     "resync": ("_proc_sid",),           # session re-registration
     "bypass_ready": ("_bypass_votes",),  # per-proc vote slot
+    # aggregator-tier verbs: the batch envelope dedups through the
+    # per-proc structures of the reports it carries
+    "agg_ready": ("_ready_seen",),      # per-proc rid high-waters
+    "agg_heartbeat": ("_beats",),       # beats are idempotent updates
+    "agg_resync": ("_agg_sid",),        # per-agg session registration
 }
 
 #: Verbs that bypass the coordinator epoch fence: ``clock`` is a
 #: lock-free, state-free NTP ping that must answer with minimal
 #: jitter; ``resync`` IS the fence's recovery handshake (it cannot be
-#: fenced by the epoch it exists to re-learn).  Every other verb must
-#: be rejected on an epoch mismatch BEFORE its handler runs —
-#: hvdlint checker ``replay`` verifies the dispatch order.
-EPOCH_EXEMPT_VERBS = ("clock", "resync")
+#: fenced by the epoch it exists to re-learn), and ``agg_resync`` is
+#: the same handshake for the aggregator tier — a restarted
+#: aggregator re-registers through it to learn the epochs it will
+#: fence everything else with.  Every other verb must be rejected on
+#: an epoch mismatch BEFORE its handler runs — hvdlint checker
+#: ``replay`` verifies the dispatch order.
+EPOCH_EXEMPT_VERBS = ("clock", "resync", "agg_resync")
+
+#: Long-poll stream verbs: fenced like any other verb, NEVER
+#: timeout-replayed (a long poll legitimately outlives the request
+#: timeout), and idempotent by cursor — re-polling a cursor re-serves
+#: the same log suffix.  Every ``_on_<verb>`` handler on a
+#: coordinator-shaped class must be classified in exactly one of
+#: REPLAY_SAFE_VERBS / EPOCH_EXEMPT_VERBS / STREAM_VERBS — hvdlint
+#: checker ``replay`` (``replay-unclassified-verb``) rejects a new
+#: verb that skips the classification, on all three tiers.
+STREAM_VERBS = ("poll", "agg_poll")
 
 #: Negotiation-meta types eligible for the coordinator response cache
 #: AND the steady-state bypass (reference response_cache.cc

@@ -279,6 +279,135 @@ class StoreClient:
         return json.loads(data or b"{}")
 
 
+class TieredStoreClient:
+    """Two-route fabric client for the per-host aggregator tier
+    (docs/fault_tolerance.md "Per-host aggregator tier"): the PRIMARY
+    route is the host's aggregator, the FALLBACK the coordinator
+    itself.  An aggregator that stops answering — connection refused,
+    timeout, or a 5xx it returns when IT cannot reach upstream —
+    triggers a one-way switch to direct mode for this worker:
+    degradation, never deadlock.  The aggregator client's retry
+    budget is pinned tight (``HOROVOD_AGG_FALLBACK_DEADLINE_SECONDS``)
+    so the fallback fires in seconds, while the direct client keeps
+    the coordinator-outage-spanning budget.
+
+    ``maybe_probe()`` (clocked by the engine's heartbeat loop)
+    re-pings a fallen-back aggregator occasionally and re-attaches
+    when it answers — an ``agg_restart`` heals back to the batched
+    path without a round reset.  Route changes invoke
+    ``on_route_change(reason)`` so the StoreController can run its
+    resync handshake: falling back (or re-attaching) mid-stream is
+    recovered exactly like an epoch bump — resync, drain, re-report."""
+
+    #: seconds between re-attach probes after a fallback
+    PROBE_SECS = 10.0
+
+    def __init__(self, agg_client: StoreClient,
+                 direct_client: StoreClient):
+        self.agg = agg_client
+        self.direct = direct_client
+        self.via_agg = True
+        self.on_route_change = None
+        self._route_lock = threading.Lock()
+        self._fell_back_at = None
+
+    # chaos middleware rides BOTH routes (one injector, one request
+    # counter — the deterministic trigger stream must not depend on
+    # which route a request took)
+    @property
+    def middleware(self):
+        return self.direct.middleware
+
+    @middleware.setter
+    def middleware(self, mw):
+        self.agg.middleware = mw
+        self.direct.middleware = mw
+
+    @staticmethod
+    def _falls_back(exc):
+        if isinstance(exc, _HTTPError):
+            return exc.code >= 500
+        return isinstance(exc, (OSError, TimeoutError,
+                                http.client.HTTPException))
+
+    def _call(self, name, args, kwargs):
+        primary = self.agg if self.via_agg else self.direct
+        try:
+            return getattr(primary, name)(*args, **kwargs)
+        except Exception as exc:  # noqa: BLE001 — classified below
+            if primary is not self.agg or not self._falls_back(exc):
+                raise
+            self._fall_back(exc)
+            return getattr(self.direct, name)(*args, **kwargs)
+
+    def coord(self, verb, payload, timeout=None, budget=None):
+        return self._call("coord", (verb, payload),
+                          {"timeout": timeout, "budget": budget})
+
+    def put(self, key, value, budget=None):
+        return self._call("put", (key, value), {"budget": budget})
+
+    def get(self, key, wait=0.0):
+        return self._call("get", (key,), {"wait": wait})
+
+    def delete(self, key):
+        return self._call("delete", (key,), {})
+
+    def _fall_back(self, exc):
+        with self._route_lock:
+            if not self.via_agg:
+                return
+            self.via_agg = False
+            self._fell_back_at = time.monotonic()
+        import logging
+        logging.getLogger("horovod_tpu").warning(
+            "aggregator route failed (%s: %s); falling back to "
+            "direct coordinator mode", type(exc).__name__, exc)
+        try:
+            from ...telemetry import count_agg_fallback
+            count_agg_fallback("direct")
+        except Exception:  # noqa: BLE001 — accounting only
+            pass
+        self._notify("direct")
+
+    def maybe_probe(self):
+        """Probe a fallen-back aggregator (bounded, spaced) and
+        re-attach when it answers.  Returns True on a re-attach."""
+        with self._route_lock:
+            if self.via_agg or self._fell_back_at is None or \
+                    time.monotonic() - self._fell_back_at < \
+                    self.PROBE_SECS:
+                return False
+            self._fell_back_at = time.monotonic()   # space the probes
+        try:
+            self.agg.coord("clock", {}, timeout=2.0, budget=(1, 2.5))
+        except Exception:  # noqa: BLE001 — still down; stay direct
+            return False
+        with self._route_lock:
+            self.via_agg = True
+        import logging
+        logging.getLogger("horovod_tpu").warning(
+            "aggregator answering again; re-attaching to the "
+            "batched control-plane route")
+        try:
+            from ...telemetry import count_agg_fallback
+            count_agg_fallback("reattach")
+        except Exception:  # noqa: BLE001 — accounting only
+            pass
+        self._notify("reattach")
+        return True
+
+    def _notify(self, reason):
+        cb = self.on_route_change
+        if cb is None:
+            return
+        try:
+            cb(reason)
+        except Exception:  # noqa: BLE001 — the route change already
+            # happened; the controller's next fenced verb recovers
+            pass
+
+
 # -- reference-shaped module functions (horovod/runner/http/http_client.py
 #    read_data_from_kvstore :22 / put_data_into_kvstore :35).  Values are
 #    base64-pickled (codec module); the signing key comes from

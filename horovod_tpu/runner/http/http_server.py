@@ -454,6 +454,16 @@ class Coordinator:
         # slow starters are never false-positived.  0 disables.
         self.heartbeat_secs = heartbeat_secs
         self.heartbeat_window = heartbeat_window
+        # suspect grace for aggregator-routed procs: when a proc's
+        # beats rode a now-silent aggregator, death is withheld for
+        # the time its direct-fallback probing needs — the worker's
+        # tight aggregator retry budget (the SAME env knob the
+        # workers read: launcher and workers share the handoff) plus
+        # one beat interval for the first direct beat to land
+        from ...common import env as _env_mod
+        self._agg_probe_grace = _env_mod.get_float(
+            _env_mod.HOROVOD_AGG_FALLBACK_DEADLINE_SECONDS, 5.0) \
+            + max(heartbeat_secs, 0.0)
         # Coordinator-side autotune (reference: the coordinator tunes
         # and SynchronizeParameters broadcasts, controller.cc:40-54):
         # fusion threshold is applied directly here — fusing IS this
@@ -520,6 +530,28 @@ class Coordinator:
         self._proc_ranks = {}
         self._proc_hosts = {}
         self._dead = {}
+        # per-host aggregator tier (docs/fault_tolerance.md "Per-host
+        # aggregator tier"): each host's aggregator registers a
+        # session (agg_resync) and batches its workers' verbs into
+        # agg_ready / agg_heartbeat / agg_poll.  _agg_epoch[agg] is
+        # the tier's OWN generation id — bumped on every NEW session
+        # of the same aggregator id, so a restarted (stateless)
+        # aggregator fences its workers exactly like a restarted
+        # coordinator fences everyone.  _proc_via_agg records each
+        # proc's last-known beat route: a silent aggregator makes its
+        # hosted procs SUSPECT, not dead — they get one extra liveness
+        # window to fall back to direct beats before any verdict.
+        self._agg_sid = {}      # agg -> session id
+        self._agg_epoch = {}    # agg -> generation (monotonic per agg)
+        self._agg_procs = {}    # agg -> hosted proc ids
+        self._agg_hosts = {}    # agg -> hostname
+        self._agg_beats = {}    # agg -> last upstream-contact monotonic
+        self._agg_warned = set()  # once-per-silence warning dedup
+        self._proc_via_agg = {}   # proc -> agg id (None = direct)
+        # control-plane fan-in accounting ((verb, tier) -> requests),
+        # exported through liveness_snapshot — the scale harness's
+        # "coordinator load scales with hosts, not procs" evidence
+        self._verb_counts = {}
         # coordinator-side chaos rules (fault-plan events with
         # side="coord": reject or stall a chosen proc's requests) and
         # the per-rule injection accounting exported via /metrics
@@ -596,6 +628,16 @@ class Coordinator:
             self._proc_ranks.clear()
             self._proc_hosts.clear()
             self._dead.clear()
+            # aggregator sessions are round-scoped (surviving
+            # aggregators re-register on the stale reply, which bumps
+            # their agg_epoch and re-fences their workers into the
+            # new round); only the epoch counters survive — they are
+            # monotonic per agg id for the life of the coordinator
+            self._agg_sid.clear()
+            self._agg_procs.clear()
+            self._agg_beats.clear()
+            self._agg_warned.clear()
+            self._proc_via_agg.clear()
             # chaos rules persist across rounds (the plan describes
             # the whole job) but their request counters restart with
             # the round's fresh proc numbering
@@ -610,6 +652,14 @@ class Coordinator:
             # worker's timeline epoch is mapped onto.  Round-agnostic
             # and lock-free — it must answer with minimal jitter.
             return {"t": time.time()}
+        with self._lock:
+            # fan-in accounting: one count per handled request, split
+            # by tier (agg_* verbs arrive once per HOST per cycle, the
+            # rest once per PROC) — the ratio liveness_snapshot
+            # exports and ci.sh scale gates
+            key = (verb, "agg" if verb.startswith("agg_")
+                   else "worker")
+            self._verb_counts[key] = self._verb_counts.get(key, 0) + 1
         epoch = req.get("epoch")
         if epoch is not None and epoch != self.coord_epoch \
                 and verb not in EPOCH_EXEMPT_VERBS:
@@ -632,6 +682,14 @@ class Coordinator:
             return self._on_resync(req)
         if verb == "bypass_ready":
             return self._on_bypass_ready(req)
+        if verb == "agg_resync":
+            return self._on_agg_resync(req)
+        if verb == "agg_ready":
+            return self._on_agg_ready(req)
+        if verb == "agg_heartbeat":
+            return self._on_agg_heartbeat(req)
+        if verb == "agg_poll":
+            return self._on_agg_poll(req)
         raise ValueError(f"unknown coordinator verb {verb}")
 
     def request_trace_dump(self, reason="request"):
@@ -658,37 +716,17 @@ class Coordinator:
         beat from an already-declared-dead proc (a hang that woke up,
         a network partition that healed) gets ``{"dead": true}`` back:
         its peers' collectives were already failed, so the only safe
-        move for that worker is to restart into the next round."""
+        move for that worker is to restart into the next round.
+
+        A DIRECT beat (this verb, as opposed to one relayed inside
+        ``agg_heartbeat``) also clears the proc's aggregator route:
+        it is the "direct-fallback probing succeeded" signal that
+        takes the proc off a silent aggregator's suspect list."""
         proc = req.get("proc")
         if proc is None:
             return {}
         with self._lock:
-            if req.get("bye"):
-                # the bye INTENT is journaled: a restarted coordinator
-                # must never re-arm liveness for a worker that already
-                # said goodbye (its bye would otherwise be lost with
-                # the in-memory beat table and the replayed first-beat
-                # expectation would read its silence as a death)
-                if self._beats.pop(proc, None) is not None or \
-                        proc in self._proc_ranks:
-                    self._j({"k": "bye", "proc": proc})
-                self._proc_ranks.pop(proc, None)
-                self._proc_hosts.pop(proc, None)
-                return {}
-            if proc in self._dead:
-                return {"dead": True}
-            if proc not in self._beats:
-                # first beat registers the proc: journaled so a
-                # restarted coordinator keeps the rank/host attribution
-                # (liveness itself re-arms only on a post-restart beat)
-                self._j({"k": "hb", "proc": proc,
-                         "ranks": req.get("ranks"),
-                         "host": req.get("host")})
-            self._beats[proc] = time.monotonic()
-            if req.get("ranks") is not None:
-                self._proc_ranks[proc] = list(req["ranks"])
-            if req.get("host"):
-                self._proc_hosts[proc] = req["host"]
+            out = self._apply_heartbeat_locked(req, via=None)
             # beats are a liveness-scan clock too (AFTER recording
             # this beat — the caller is alive by definition): while
             # every worker is armed on the negotiation bypass nobody
@@ -698,6 +736,43 @@ class Coordinator:
             # reaches it — and reaping the hung process is what
             # unblocks the survivors' agreement collective.
             self._scan_heartbeats()
+        return out
+
+    def _apply_heartbeat_locked(self, req, via=None):
+        """Beat-state mutation shared by the direct verb and the
+        aggregator relay (``via`` = relaying agg id, None = direct).
+        Must hold the lock."""
+        proc = req.get("proc")
+        if proc is None:
+            return {}
+        if req.get("bye"):
+            # the bye INTENT is journaled: a restarted coordinator
+            # must never re-arm liveness for a worker that already
+            # said goodbye (its bye would otherwise be lost with
+            # the in-memory beat table and the replayed first-beat
+            # expectation would read its silence as a death)
+            if self._beats.pop(proc, None) is not None or \
+                    proc in self._proc_ranks:
+                self._j({"k": "bye", "proc": proc})
+            self._proc_ranks.pop(proc, None)
+            self._proc_hosts.pop(proc, None)
+            self._proc_via_agg.pop(proc, None)
+            return {}
+        if proc in self._dead:
+            return {"dead": True}
+        if proc not in self._beats:
+            # first beat registers the proc: journaled so a
+            # restarted coordinator keeps the rank/host attribution
+            # (liveness itself re-arms only on a post-restart beat)
+            self._j({"k": "hb", "proc": proc,
+                     "ranks": req.get("ranks"),
+                     "host": req.get("host")})
+        self._beats[proc] = time.monotonic()
+        self._proc_via_agg[proc] = via
+        if req.get("ranks") is not None:
+            self._proc_ranks[proc] = list(req["ranks"])
+        if req.get("host"):
+            self._proc_hosts[proc] = req["host"]
         return {}
 
     # -- epoch fencing + steady-state bypass (docs/fault_tolerance.md) -------
@@ -710,11 +785,25 @@ class Coordinator:
         from its own absolute cursor, then re-reports whatever is
         still awaiting; a brand-new session starts at the log end as
         usual.  Idempotent: re-sending the same (proc, sid) changes
-        nothing (REPLAY_SAFE_VERBS contract)."""
+        nothing (REPLAY_SAFE_VERBS contract).
+
+        ``via_agg`` records the route the handshake arrived on (the
+        aggregator forwards its workers' resyncs upstream, stamping
+        its id): liveness treats beats whose route went silent as
+        suspect rather than dead.  A direct resync clears the route —
+        the worker fell back to the coordinator."""
         proc = req.get("proc")
         with self._lock:
             if proc is not None:
                 self._check_session(proc, req.get("sid"))
+                self._proc_via_agg[proc] = req.get("via_agg")
+                if proc in self._beats:
+                    # the handshake itself proves liveness: a worker
+                    # resyncing off a dead aggregator route must not
+                    # be killed for the beats that died with it —
+                    # its own direct beats resume within one interval
+                    self._beats[proc] = max(self._beats[proc],
+                                            time.monotonic())
             return {"epoch": self.coord_epoch, "round": self.round_id,
                     "cursor": self._log_base + len(self._log)}
 
@@ -764,6 +853,164 @@ class Coordinator:
             logger.info("steady-state negotiation bypass disarmed")
         self._bypass_armed_fp = None
         self._bypass_votes.clear()
+
+    # -- per-host aggregator tier (docs/fault_tolerance.md) ------------------
+
+    def _touch_agg_locked(self, agg):
+        """Any upstream contact from an aggregator is a liveness beat
+        for the tier (and re-arms the once-per-silence warning).
+        Must hold the lock."""
+        if agg is None:
+            return
+        self._agg_beats[agg] = time.monotonic()
+        self._agg_warned.discard(agg)
+
+    def _on_agg_resync(self, req):
+        """Aggregator session registration — the tier's resync
+        handshake, exempt from the epoch fence for the same reason
+        ``resync`` is (a restarted aggregator re-learns the epochs it
+        will fence everything else with).  A NEW session of a known
+        aggregator id bumps that aggregator's ``agg_epoch``: the
+        stateless restart contract — workers fencing on the
+        (coord_epoch, agg_epoch) pair get a mismatch on first contact
+        with the successor and answer with one worker-level resync,
+        exactly like a coordinator restart.  Idempotent per
+        (agg, sid); journaled so a restarted COORDINATOR keeps the
+        registration (and the epoch keeps climbing, never resets).
+        Round-agnostic: the reply carries the current round, which is
+        how a surviving aggregator follows an elastic reset."""
+        agg = req.get("agg")
+        sid = req.get("sid")
+        with self._lock:
+            self._touch_agg_locked(agg)
+            if agg is not None and self._agg_sid.get(agg) != sid:
+                self._agg_sid[agg] = sid
+                self._agg_epoch[agg] = self._agg_epoch.get(agg, 0) + 1
+                self._agg_procs[agg] = [int(p)
+                                        for p in req.get("procs", [])]
+                if req.get("host"):
+                    self._agg_hosts[agg] = req["host"]
+                now = time.monotonic()
+                for p in self._agg_procs[agg]:
+                    # a weak routing hint only: beats are authoritative
+                    # (a worker that already fell back direct must not
+                    # be re-attributed to the re-registered aggregator
+                    # until it actually routes through it again)
+                    self._proc_via_agg.setdefault(p, agg)
+                    # liveness grace, per tier (the agg-level twin of
+                    # the coordinator's post-restart _grace_until): a
+                    # NEW session means the old aggregator died — its
+                    # workers' beats were lost with it, and they need
+                    # a full window to re-fence/re-attach before
+                    # silence may read as death
+                    if p in self._beats and \
+                            self._proc_via_agg.get(p) == agg:
+                        self._beats[p] = max(self._beats[p], now)
+                self._j({"k": "aggsess", "agg": agg, "sid": sid,
+                         "host": self._agg_hosts.get(agg),
+                         "procs": self._agg_procs[agg],
+                         "epoch": self._agg_epoch[agg]})
+                logger.info(
+                    "aggregator %s (host %s) registered: %d hosted "
+                    "procs, agg_epoch %d", agg,
+                    self._agg_hosts.get(agg),
+                    len(self._agg_procs[agg]), self._agg_epoch[agg])
+            return {"epoch": self.coord_epoch,
+                    "agg_epoch": self._agg_epoch.get(agg, 0),
+                    "round": self.round_id,
+                    "cursor": self._log_base + len(self._log)}
+
+    def _on_agg_ready(self, req):
+        """One aggregator's batched ready stream: every hosted proc's
+        reports of this flush window in ONE request — the fan-in that
+        makes coordinator load scale with hosts, not procs.  Each
+        inner report dedups through the same per-proc rid high-water
+        as the direct verb (``_ready_seen``), so a replayed batch is
+        single-apply report-by-report; scheduling (``_advance``) runs
+        once per batch."""
+        agg = req.get("agg")
+        replies = {}
+        with self._lock:
+            self._touch_agg_locked(agg)
+            for rep in req.get("reports", []):
+                replies[str(rep.get("proc"))] = \
+                    self._apply_ready_locked(rep)
+            self._fail_dead_entries_locked()
+            self._advance()
+            self._lock.notify_all()
+        return {"replies": replies}
+
+    def _on_agg_heartbeat(self, req):
+        """One aggregator's batched liveness relay: every hosted proc
+        that beat since the last relay, in one request.  Beats apply
+        through the same idempotent ``_beats`` update as the direct
+        verb, stamped with the relaying aggregator id (the route the
+        suspect logic consults); the reply names the hosted procs the
+        coordinator has declared dead so the aggregator can answer
+        their local beats with ``{"dead": true}``."""
+        agg = req.get("agg")
+        dead = []
+        with self._lock:
+            self._touch_agg_locked(agg)
+            if req.get("host"):
+                self._agg_hosts[agg] = req["host"]
+            for beat in req.get("beats", []):
+                out = self._apply_heartbeat_locked(beat, via=agg)
+                if out.get("dead"):
+                    dead.append(beat.get("proc"))
+            self._scan_heartbeats()
+        return {"dead": dead} if dead else {}
+
+    def _on_agg_poll(self, req):
+        """One aggregator's shared long-poll: ONE upstream poll per
+        host mirrors the response log for every local worker.
+        ``acked`` carries the hosted workers' own consumed cursors
+        (clamped by their journaled session bases) so log GC — which
+        waits on every proc — keeps working with zero direct polls.
+        Clocks the stall / liveness / compaction scans exactly like
+        worker polls (the coordinator has no thread of its own)."""
+        cursor = req["cursor"]
+        agg = req.get("agg")
+        round_at_entry = req.get("round", self.round_id)
+        timeout = req.get("wait", 10.0)
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            if self.round_id != round_at_entry:
+                return {"stale": True, "round": self.round_id}
+            self._touch_agg_locked(agg)
+            self._scan_stalls()
+            self._scan_heartbeats()
+            self._maybe_compact_locked()
+            for p, c in (req.get("acked") or {}).items():
+                p = int(p)
+                c = int(c)
+                base = self._session_base.get(p)
+                if base is not None and c < base:
+                    c = base
+                self._cursors[p] = max(self._cursors.get(p, 0), c)
+            self._gc_log()
+            while self._log_base + len(self._log) <= cursor:
+                if self.round_id != round_at_entry:
+                    return {"stale": True, "round": self.round_id}
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return {"responses": [], "cursor": cursor,
+                            "epoch": self.coord_epoch,
+                            "agg_epoch": self._agg_epoch.get(agg, 0)}
+                self._lock.wait(remaining)
+            if self.round_id != round_at_entry:
+                return {"stale": True, "round": self.round_id}
+            resp = self._log[max(0, cursor - self._log_base):]
+            out = {"responses": resp,
+                   "cursor": self._log_base + len(self._log),
+                   "epoch": self.coord_epoch,
+                   "agg_epoch": self._agg_epoch.get(agg, 0)}
+            if self._autotuner is not None:
+                out["tuned"] = {
+                    "cycle_time_ms": self._tuned_params.cycle_time_ms,
+                    "pack_mt_threshold_bytes":
+                        self._tuned_params.pack_mt_threshold_bytes}
+            return out
 
     # -- journal restore + compaction ----------------------------------------
 
@@ -826,6 +1073,22 @@ class Coordinator:
         elif kind == "bye":
             self._proc_ranks.pop(rec["proc"], None)
             self._proc_hosts.pop(rec["proc"], None)
+        elif kind == "aggsess":
+            # a restarted coordinator keeps the aggregator tier's
+            # registrations (sid + monotonic agg_epoch + hosted
+            # procs): the surviving aggregators resync without an
+            # epoch bump, so their workers are never re-fenced by a
+            # coordinator-only outage.  Liveness (_agg_beats) re-arms
+            # only on post-restart contact, like worker beats.
+            self._agg_sid[rec["agg"]] = rec["sid"]
+            self._agg_epoch[rec["agg"]] = int(rec["epoch"])
+            self._agg_procs[rec["agg"]] = [int(p)
+                                           for p in rec.get("procs",
+                                                            [])]
+            if rec.get("host"):
+                self._agg_hosts[rec["agg"]] = rec["host"]
+            for p in self._agg_procs[rec["agg"]]:
+                self._proc_via_agg.setdefault(p, rec["agg"])
         elif kind == "kv":
             if self._store is not None:
                 self._store.restore(rec["key"],
@@ -857,6 +1120,12 @@ class Coordinator:
         self._dead.clear()
         self._bypass_votes.clear()
         self._bypass_armed_fp = None
+        self._agg_sid.clear()
+        self._agg_procs.clear()
+        self._agg_hosts.clear()
+        self._agg_beats.clear()
+        self._agg_warned.clear()
+        self._proc_via_agg.clear()
 
     def _restore_snapshot_locked(self, s):
         self._restore_clear_locked()
@@ -883,6 +1152,13 @@ class Coordinator:
                             for p, h in s.get("hosts", {}).items()}
         self._dead = {int(p): dict(info)
                       for p, info in s.get("dead", {}).items()}
+        for agg, sid, epoch, host, procs in s.get("aggs", []):
+            self._agg_sid[agg] = sid
+            self._agg_epoch[agg] = int(epoch)
+            self._agg_hosts[agg] = host
+            self._agg_procs[agg] = [int(p) for p in procs]
+            for p in self._agg_procs[agg]:
+                self._proc_via_agg.setdefault(p, agg)
         self._bypass_armed_fp = s.get("bypass_fp")
         if self._autotuner is not None and s.get("tuned"):
             for name, val in s["tuned"].items():
@@ -926,6 +1202,10 @@ class Coordinator:
             "hosts": {str(p): h for p, h in self._proc_hosts.items()},
             "dead": {str(p): dict(info)
                      for p, info in self._dead.items()},
+            "aggs": [[agg, sid, self._agg_epoch.get(agg, 0),
+                      self._agg_hosts.get(agg),
+                      sorted(self._agg_procs.get(agg, []))]
+                     for agg, sid in sorted(self._agg_sid.items())],
             "bypass_fp": self._bypass_armed_fp,
             "kv": kv, "tuned": tuned,
         }
@@ -968,6 +1248,30 @@ class Coordinator:
         for proc, last in list(self._beats.items()):
             if proc in self._dead or now - last <= window:
                 continue
+            agg = self._proc_via_agg.get(proc)
+            if agg is not None:
+                agg_last = self._agg_beats.get(agg)
+                if agg_last is None or now - agg_last > window:
+                    # the proc's beats rode an aggregator that is
+                    # itself silent: its hosted ranks are SUSPECT,
+                    # not dead — withhold the verdict for the probe
+                    # grace (the worker-side fallback budget + one
+                    # beat interval; a direct beat or resync clears
+                    # the route and normal rules resume); only a proc
+                    # still silent PAST that grace failed the direct
+                    # fallback too and is declared dead
+                    if agg not in self._agg_warned:
+                        self._agg_warned.add(agg)
+                        logger.warning(
+                            "aggregator %s (host %s) silent for "
+                            "%.1fs; treating its %d hosted procs as "
+                            "suspect pending direct-fallback probing",
+                            agg, self._agg_hosts.get(agg),
+                            (now - agg_last) if agg_last else
+                            float("inf"),
+                            len(self._agg_procs.get(agg, ())))
+                    if now - last <= window + self._agg_probe_grace:
+                        continue
             age = now - last
             ranks = self._proc_ranks.get(proc, [])
             self._dead[proc] = {"ranks": ranks, "age": round(age, 1),
@@ -1037,6 +1341,10 @@ class Coordinator:
         every journal replay) and the per-kind journal replay
         counters."""
         from ...telemetry import (
+            CONTROL_FANIN_FAMILY, CONTROL_FANIN_HELP,
+            CONTROL_FANIN_LABELS,
+            CONTROL_REQUESTS_FAMILY, CONTROL_REQUESTS_HELP,
+            CONTROL_REQUESTS_LABELS,
             COORD_EPOCH_FAMILY, COORD_EPOCH_HELP,
             FAULTS_INJECTED_FAMILY, FAULTS_INJECTED_HELP,
             JOURNAL_REPLAYED_FAMILY, JOURNAL_REPLAYED_HELP,
@@ -1049,6 +1357,23 @@ class Coordinator:
             injected = dict(self._chaos_injected)
             epoch = self.coord_epoch
             replayed = dict(self._journal_replayed)
+            verb_counts = dict(self._verb_counts)
+            # "currently attached" means LIVE: an aggregator silent
+            # past the liveness window (killed, or its host died) must
+            # drop out of the gauge, or an operator watching it never
+            # sees the tier shrink
+            now = time.monotonic()
+            window = self.heartbeat_window or \
+                1.5 * self.heartbeat_secs
+            fanin = {
+                "agg": float(sum(
+                    1 for t in self._agg_beats.values()
+                    if self.heartbeat_secs <= 0
+                    or now - t <= window)),
+                "direct": float(sum(
+                    1 for p in self._beats
+                    if self._proc_via_agg.get(p) is None)),
+            }
         fams = {
             COORD_EPOCH_FAMILY: {
                 "type": "gauge",
@@ -1077,6 +1402,21 @@ class Coordinator:
                 "labelnames": ["kind"],
                 "samples": [{"labels": {"kind": k}, "value": float(v)}
                             for k, v in sorted(injected.items())]}
+        if verb_counts:
+            fams[CONTROL_REQUESTS_FAMILY] = {
+                "type": "counter",
+                "help": CONTROL_REQUESTS_HELP,
+                "labelnames": list(CONTROL_REQUESTS_LABELS),
+                "samples": [{"labels": {"verb": v, "tier": t},
+                             "value": float(n)}
+                            for (v, t), n
+                            in sorted(verb_counts.items())]}
+        fams[CONTROL_FANIN_FAMILY] = {
+            "type": "gauge",
+            "help": CONTROL_FANIN_HELP,
+            "labelnames": list(CONTROL_FANIN_LABELS),
+            "samples": [{"labels": {"tier": t}, "value": v}
+                        for t, v in sorted(fanin.items())]}
         return fams
 
     # -- coordinator-side chaos (docs/fault_tolerance.md) -------------------
@@ -1198,68 +1538,77 @@ class Coordinator:
         Returns {uncached: [key...]} for cache ids this coordinator no
         longer holds (evicted / new round); the worker resends those
         with full metas."""
-        proc = req["proc"]
-        uncached = []
         with self._lock:
-            self._check_session(proc, req.get("sid"))
-            rid = req.get("rid")
-            if rid is not None:
-                # ready is only idempotent while the entry is still
-                # pending; a replayed POST (dropped keep-alive or
-                # timeout retry after the server processed the
-                # original) could otherwise plant a phantom entry with
-                # the PREVIOUS step's meta — dedup on the client's
-                # monotonically increasing report id.  The CURRENT
-                # rid's replay must get the ORIGINAL response back:
-                # returning {} would swallow an ``uncached`` list and
-                # strand the withheld metas forever (the client only
-                # ever replays its latest report, so one slot per
-                # proc suffices)
-                last = self._ready_seen.get(proc, 0)
-                if rid == last:
-                    return self._ready_reply.get(proc, {})
-                if rid < last:
-                    return {}
-                self._ready_seen[proc] = rid
-            if req.get("entries"):
-                # a worker reporting entries has left the bypass fast
-                # path (the agreement vote made the exit unanimous):
-                # disarm so a fresh stable phase must re-vote
-                self._disarm_bypass_locked()
-            for meta in req["entries"]:
-                key = meta["key"]
-                if "c" in meta:
-                    template = self._cache.get(meta["c"])
-                    if template is None or \
-                            self._cache_by_key.get(key) != meta["c"]:
-                        uncached.append(key)
-                        continue
-                    self._cache.move_to_end(meta["c"])
-                    full = dict(template)
-                    full["aux"] = meta.get("aux", {})
-                    full["_cached"] = meta["c"]
-                    meta = full
-                ent = self._pending.get(key)
-                if ent is None:
-                    ent = self._pending[key] = {}
-                    self._pending_since[key] = time.monotonic()
-                if proc not in ent:
-                    ent[proc] = meta
-                    if meta.get("error"):
-                        # a process failed local validation: the whole
-                        # tensor errors on every process
-                        self._errors[key] = meta["error"]
-                    err = self._validate(key, ent)
-                    if err:
-                        self._errors[key] = err
+            reply = self._apply_ready_locked(req)
             # entries reported after a peer was declared dead must
             # fail now, not sit pending forever
             self._fail_dead_entries_locked()
             self._advance()
             self._lock.notify_all()
-            reply = {"uncached": uncached} if uncached else {}
-            if rid is not None:
-                self._ready_reply[proc] = reply
+        return reply
+
+    def _apply_ready_locked(self, req):
+        """Ready-report mutation shared by the direct verb and the
+        aggregator batch (``agg_ready`` applies one per report under a
+        single lock hold).  Must hold the lock; the caller runs
+        ``_fail_dead_entries_locked`` + ``_advance`` once per
+        request."""
+        proc = req["proc"]
+        uncached = []
+        self._check_session(proc, req.get("sid"))
+        rid = req.get("rid")
+        if rid is not None:
+            # ready is only idempotent while the entry is still
+            # pending; a replayed POST (dropped keep-alive or
+            # timeout retry after the server processed the
+            # original) could otherwise plant a phantom entry with
+            # the PREVIOUS step's meta — dedup on the client's
+            # monotonically increasing report id.  The CURRENT
+            # rid's replay must get the ORIGINAL response back:
+            # returning {} would swallow an ``uncached`` list and
+            # strand the withheld metas forever (the client only
+            # ever replays its latest report, so one slot per
+            # proc suffices)
+            last = self._ready_seen.get(proc, 0)
+            if rid == last:
+                return self._ready_reply.get(proc, {})
+            if rid < last:
+                return {}
+            self._ready_seen[proc] = rid
+        if req.get("entries"):
+            # a worker reporting entries has left the bypass fast
+            # path (the agreement vote made the exit unanimous):
+            # disarm so a fresh stable phase must re-vote
+            self._disarm_bypass_locked()
+        for meta in req["entries"]:
+            key = meta["key"]
+            if "c" in meta:
+                template = self._cache.get(meta["c"])
+                if template is None or \
+                        self._cache_by_key.get(key) != meta["c"]:
+                    uncached.append(key)
+                    continue
+                self._cache.move_to_end(meta["c"])
+                full = dict(template)
+                full["aux"] = meta.get("aux", {})
+                full["_cached"] = meta["c"]
+                meta = full
+            ent = self._pending.get(key)
+            if ent is None:
+                ent = self._pending[key] = {}
+                self._pending_since[key] = time.monotonic()
+            if proc not in ent:
+                ent[proc] = meta
+                if meta.get("error"):
+                    # a process failed local validation: the whole
+                    # tensor errors on every process
+                    self._errors[key] = meta["error"]
+                err = self._validate(key, ent)
+                if err:
+                    self._errors[key] = err
+        reply = {"uncached": uncached} if uncached else {}
+        if rid is not None:
+            self._ready_reply[proc] = reply
         return reply
 
     def _validate(self, key, ent):

@@ -233,6 +233,28 @@ def parse_args(argv=None):
                              "the cached tensors before forcing full "
                              "renegotiation "
                              "(HOROVOD_BYPASS_WAIT_SECONDS)")
+    # per-host aggregator tier (docs/fault_tolerance.md "Per-host
+    # aggregator tier"): coordinator load scales with hosts, not procs
+    parser.add_argument("--control-plane-tier", default=None,
+                        choices=["flat", "host"],
+                        help="control-plane topology: 'flat' fans "
+                             "every proc into the coordinator; "
+                             "'host' runs one aggregator per host "
+                             "that batches its workers' ready-"
+                             "reports/heartbeats/polls upstream "
+                             "(HOROVOD_CONTROL_PLANE_TIER)")
+    parser.add_argument("--agg-linger-ms", type=float, default=None,
+                        help="aggregator batching window: how long "
+                             "the upstream flusher waits for "
+                             "co-reporting local workers "
+                             "(HOROVOD_AGG_LINGER_MS)")
+    parser.add_argument("--agg-fallback-deadline-seconds",
+                        type=float, default=None,
+                        help="how long a worker's requests retry "
+                             "against a silent aggregator before "
+                             "falling back to direct coordinator "
+                             "mode (HOROVOD_AGG_FALLBACK_DEADLINE_"
+                             "SECONDS)")
     # serving tier (docs/serving.md): --serve marks the job as an
     # inference fleet — workers run hvd.serving.start() replicas, the
     # knobs ride the same HOROVOD_SERVING_* env handoff as every other

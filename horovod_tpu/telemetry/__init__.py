@@ -74,6 +74,42 @@ COORD_RESYNCS_FAMILY = "horovod_coord_resyncs_total"
 COORD_RESYNCS_HELP = ("Epoch-fenced resync handshakes this worker "
                       "performed against a restarted coordinator")
 
+# -- per-host aggregator tier (docs/fault_tolerance.md "Per-host
+#    aggregator tier"): the control-plane fan-in families live on the
+#    coordinator's liveness snapshot (request counts per verb and
+#    tier, distinct downstream clients per tier) — the scale harness's
+#    "coordinator load scales with hosts, not procs" evidence — while
+#    the per-tier cycle histogram is observed worker-side (one
+#    negotiation round trip) and aggregator-side (one upstream batch
+#    flush), and fallbacks/epoch ride the process registries.
+
+CONTROL_REQUESTS_FAMILY = "horovod_control_requests_total"
+CONTROL_REQUESTS_HELP = ("Control-plane requests handled by the "
+                         "coordinator, by verb and by tier (agg = "
+                         "batched aggregator upstream verbs, worker = "
+                         "direct worker verbs)")
+CONTROL_REQUESTS_LABELS = ("verb", "tier")
+CONTROL_FANIN_FAMILY = "horovod_control_fanin_clients"
+CONTROL_FANIN_HELP = ("Distinct downstream clients currently attached "
+                      "to the coordinator, per control-plane tier "
+                      "(agg = live per-host aggregators, direct = "
+                      "procs beating without an aggregator)")
+CONTROL_FANIN_LABELS = ("tier",)
+CONTROL_CYCLE_SECONDS_FAMILY = "horovod_control_cycle_seconds"
+CONTROL_CYCLE_SECONDS_HELP = ("Control-plane cycle time per tier "
+                              "(worker = one negotiation round trip, "
+                              "agg = one batched upstream flush)")
+CONTROL_CYCLE_SECONDS_LABELS = ("tier",)
+AGG_FALLBACKS_FAMILY = "horovod_agg_fallbacks_total"
+AGG_FALLBACKS_HELP = ("Worker route changes off/onto the per-host "
+                      "aggregator (reason=direct: fell back to the "
+                      "coordinator, reason=reattach: probed back "
+                      "onto a returned aggregator)")
+AGG_EPOCH_FAMILY = "horovod_agg_epoch"
+AGG_EPOCH_HELP = ("Per-host aggregator generation id; bumped every "
+                  "time a (re)started aggregator re-registers with "
+                  "the coordinator")
+
 # -- families registered from more than one layer (hvdlint checker 4
 #    `telemetry-dup-family`): the compiled-path cache counters are
 #    bumped by ops/compiled.py and pre-declared by the engine's
@@ -164,6 +200,24 @@ def count_coord_resync():
     against a restarted coordinator), into the process-current
     registry."""
     registry().counter(COORD_RESYNCS_FAMILY, COORD_RESYNCS_HELP).inc()
+
+
+def count_agg_fallback(reason):
+    """One worker route change off/onto its per-host aggregator
+    (TieredStoreClient), into the process-current registry."""
+    registry().counter(AGG_FALLBACKS_FAMILY, AGG_FALLBACKS_HELP,
+                       labelnames=("reason",)).labels(
+        reason=reason).inc()
+
+
+def observe_control_cycle(tier, seconds):
+    """One control-plane cycle observation (worker negotiation round
+    trip, or aggregator upstream flush), into the process-current
+    registry."""
+    registry().histogram(
+        CONTROL_CYCLE_SECONDS_FAMILY, CONTROL_CYCLE_SECONDS_HELP,
+        labelnames=CONTROL_CYCLE_SECONDS_LABELS).labels(
+        tier=tier).observe(seconds)
 
 
 def metrics():

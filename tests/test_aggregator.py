@@ -1,0 +1,393 @@
+"""Per-host aggregator tier (docs/fault_tolerance.md "Per-host
+aggregator tier"; ISSUE 12): two-tier (coord_epoch, agg_epoch)
+fencing, stateless aggregator restart -> resync -> drain -> re-report,
+the worker that outlives BOTH its aggregator and a coordinator
+restart, direct-fallback degradation, the coordinator's
+suspect-not-dead liveness for silent aggregators, upstream batching
+fan-in, and the KV proxy."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from horovod_tpu.core.store_controller import StoreController
+from horovod_tpu.runner.http.aggregator import (
+    Aggregator, AggregatorServer,
+)
+from horovod_tpu.runner.http.http_client import (
+    StoreClient, TieredStoreClient,
+)
+from horovod_tpu.runner.http.http_server import (
+    Coordinator, RendezvousServer,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _meta(key, members):
+    return {"key": key, "type": "ALLREDUCE", "dtype": "float32",
+            "shape": [2], "op": 1, "pre": 1.0, "post": 1.0, "ps": 0,
+            "nbytes": 8, "nprocs": len(members), "nranks": len(members),
+            "root": -1, "members": members, "aux": {}}
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    """coordinator (journaled) + one aggregator over real HTTP."""
+    server = RendezvousServer(
+        world_size=1, journal_path=str(tmp_path / "j.jsonl"))
+    port = server.start()
+    agg_srv = AggregatorServer(None, lambda: Aggregator(
+        StoreClient("127.0.0.1", port), "host0", "h0", [0],
+        linger_ms=1))
+    aport = agg_srv.start()
+    yield server, port, agg_srv, aport
+    agg_srv.stop()
+    server.stop()
+
+
+def _controller(port, aport, proc=0, world=1):
+    c = StoreController("127.0.0.1", port, None, proc, world, 1,
+                        agg_addr="127.0.0.1", agg_port=aport)
+    # tests want fast fallbacks, not the 5s default
+    c.client.agg.retry_attempts = 2
+    c.client.agg.retry_deadline = 1.0
+    c.client.agg.outage_deadline = 1.0
+    return c
+
+
+# -- two-tier epoch fencing ---------------------------------------------------
+
+def test_stale_agg_epoch_rejected_before_verb_runs(stack):
+    """Satellite: a request carrying a stale agg_epoch is fenced by
+    the aggregator BEFORE the verb executes — nothing is queued,
+    nothing goes upstream."""
+    server, port, agg_srv, aport = stack
+    agg = agg_srv.aggregator
+    assert agg.agg_epoch == 1 and agg.coord_epoch == 1
+    out = agg.handle("ready", {
+        "proc": 0, "rid": 1, "sid": "s", "round": 0,
+        "epoch": agg.coord_epoch, "agg_epoch": 0,
+        "entries": [_meta("f.k", {"0": [0]})]})
+    assert out == {"epoch_mismatch": True, "epoch": 1, "agg_epoch": 1}
+    assert agg._batch == [] and 0 not in agg._ready_seen
+    assert "f.k" not in server.coordinator._pending
+    # a stale COORD epoch through the tier fences identically
+    out = agg.handle("ready", {
+        "proc": 0, "rid": 1, "sid": "s", "round": 0,
+        "epoch": 0, "agg_epoch": agg.agg_epoch,
+        "entries": [_meta("f.k", {"0": [0]})]})
+    assert out["epoch_mismatch"] and "f.k" not in \
+        server.coordinator._pending
+    # the exempt recovery verb passes the fence it re-learns through
+    out = agg.handle("resync", {"proc": 0, "sid": "s",
+                                "epoch": 0, "agg_epoch": 0})
+    assert out["epoch"] == 1 and out["agg_epoch"] == 1
+
+
+def test_agg_restart_bumps_epoch_and_resync_drains_replayed_log(stack):
+    """Satellite: an aggregator death is a RESYNC, not a job death —
+    the stateless successor bumps agg_epoch, the worker's next verb
+    is fenced, and recovery drains what the coordinator already
+    scheduled before re-reporting ONLY what is still awaiting."""
+    server, port, agg_srv, aport = stack
+    ctrl = _controller(port, aport)
+    assert ctrl.poll(wait=0) == []      # learn the epoch pair
+    assert (ctrl.epoch, ctrl.agg_epoch) == (1, 1)
+    ctrl.report_ready([_meta("a.k", {"0": [0]})])    # scheduled
+    # the aggregator dies before this worker polled the batch
+    assert agg_srv.restart() == aport
+    assert agg_srv.aggregator.agg_epoch == 2
+    # next verb -> agg_epoch fence -> resync; the ready is NOT
+    # blind-replayed (drain-then-rereport recovers it)
+    ctrl.report_ready([_meta("b.k", {"0": [0]})])
+    assert ctrl.agg_epoch == 2
+    # drain: a.k arrives through the successor's cursor pass-through
+    resp = ctrl.poll(wait=2.0)
+    assert [r["keys"] for r in resp
+            if r.get("kind") == "batch"] == [["a.k"]]
+    assert ctrl.take_rereport() is True
+    ctrl.forget("b.k")
+    ctrl.report_ready([_meta("b.k", {"0": [0]})])
+    resp = ctrl.poll(wait=2.0)
+    assert [r["keys"] for r in resp
+            if r.get("kind") == "batch"] == [["b.k"]]
+    # a.k was scheduled exactly once (no double-apply through the
+    # restart)
+    with server.coordinator._lock:
+        batches = [r for r in server.coordinator._log
+                   if r.get("kind") == "batch"]
+    assert [b["keys"] for b in batches] == [["a.k"], ["b.k"]]
+
+
+def test_worker_outlives_aggregator_and_coordinator_restart(stack):
+    """Satellite: the composed worst case — the aggregator dies AND
+    the coordinator restarts from its journal.  The surviving worker
+    resyncs once through the new tier pair, drains the REPLAYED log,
+    and re-reports exactly its awaiting set."""
+    server, port, agg_srv, aport = stack
+    ctrl = _controller(port, aport)
+    assert ctrl.poll(wait=0) == []      # learn the epoch pair
+    ctrl.report_ready([_meta("a.k", {"0": [0]})])    # scheduled+journaled
+    agg_srv.stop_http()
+    assert server.restart_from_journal() == port
+    assert server.coordinator.coord_epoch == 2
+    assert agg_srv.start() == aport     # fresh core, epoch pair (2, 2)
+    ctrl.report_ready([_meta("b.k", {"0": [0]})])
+    assert (ctrl.epoch, ctrl.agg_epoch) == (2, 2)
+    resp = ctrl.poll(wait=2.0)
+    assert [r["keys"] for r in resp
+            if r.get("kind") == "batch"] == [["a.k"]]
+    assert ctrl.take_rereport() is True
+    # exactly the awaiting set: b.k, nothing else
+    ctrl.forget("b.k")
+    ctrl.report_ready([_meta("b.k", {"0": [0]})])
+    resp = ctrl.poll(wait=2.0)
+    assert [r["keys"] for r in resp
+            if r.get("kind") == "batch"] == [["b.k"]]
+
+
+# -- degradation --------------------------------------------------------------
+
+def test_dead_aggregator_falls_back_direct_never_deadlocks(stack):
+    server, port, agg_srv, aport = stack
+    ctrl = _controller(port, aport)
+    ctrl.report_ready([_meta("a.k", {"0": [0]})])
+    assert [r["keys"] for r in ctrl.poll(wait=2.0)] == [["a.k"]]
+    agg_srv.stop()
+    t0 = time.monotonic()
+    ctrl.report_ready([_meta("b.k", {"0": [0]})])
+    resp = ctrl.poll(wait=3.0)
+    assert time.monotonic() - t0 < 20.0
+    assert isinstance(ctrl.client, TieredStoreClient)
+    assert ctrl.client.via_agg is False
+    # the route change armed the same resync recovery as an epoch
+    # bump; after the drain the worker re-reports its awaiting set
+    if ctrl.take_rereport():
+        ctrl.forget("b.k")
+        ctrl.report_ready([_meta("b.k", {"0": [0]})])
+        resp = ctrl.poll(wait=3.0)
+    assert [r["keys"] for r in resp
+            if r.get("kind") == "batch"] == [["b.k"]]
+
+
+def test_failed_flush_does_not_poison_rid_dedup(stack):
+    """Code-review regression: a flush that FAILS upstream must leave
+    the per-proc rid high-water untouched — the worker's retry of the
+    same rid re-queues the report instead of being answered with a
+    stale cached reply (which would silently lose the report and
+    wedge its peers)."""
+    from horovod_tpu.runner.http.aggregator import (
+        AggregatorUpstreamError,
+    )
+
+    server, port, agg_srv, aport = stack
+    agg = agg_srv.aggregator
+    agg.client.retry_attempts = 2
+    agg.client.retry_deadline = 0.5
+    agg.client.outage_deadline = 0.5
+    req = {"proc": 0, "rid": 1, "sid": "s", "round": 0,
+           "entries": [_meta("p.k", {"0": [0]})]}
+    server.stop_http()                  # coordinator unreachable
+    with pytest.raises(AggregatorUpstreamError):
+        agg.handle("ready", dict(req))
+    assert agg._ready_seen.get(0) is None
+    assert server.start() == port       # coordinator back, same port
+    out = agg.handle("ready", dict(req))    # the retry, same rid
+    assert not out.get("epoch_mismatch"), out
+    assert "p.k" not in server.coordinator._pending  # scheduled
+    assert agg._ready_seen[0] == 1
+
+
+def test_kv_traffic_proxies_through_the_aggregator(stack):
+    server, port, agg_srv, aport = stack
+    cli = StoreClient("127.0.0.1", aport)
+    cli.put("/scope/x", b"v1")
+    assert server.store.get("/scope/x") == b"v1"     # landed upstream
+    assert cli.get("/scope/x") == b"v1"
+    cli.delete("/scope/x")
+    assert cli.get("/scope/x") is None
+
+
+# -- coordinator-side liveness ------------------------------------------------
+
+def test_silent_aggregator_marks_ranks_suspect_not_dead():
+    """Satellite + tentpole contract: a silent aggregator's hosted
+    ranks are suspect — held alive for the direct-fallback probe
+    grace; a direct beat clears the route, and only a proc that ALSO
+    fails the fallback is declared dead."""
+    c = Coordinator(world_size=2, heartbeat_secs=0.2)
+    c._agg_probe_grace = 0.6
+    window = 0.3
+    c.heartbeat_window = window
+    c.handle("agg_resync", {"agg": "h0", "sid": "s", "host": "hA",
+                            "procs": [0, 1]})
+    c.handle("agg_heartbeat", {"agg": "h0", "host": "hA", "beats": [
+        {"proc": 0, "ranks": [0], "host": "hA"},
+        {"proc": 1, "ranks": [1], "host": "hA"}]})
+    # everything (agg + procs) goes silent past the plain window
+    time.sleep(0.4)
+    with c._lock:
+        c._scan_heartbeats()
+    assert c.dead_procs() == {}          # suspect, not dead
+    # proc 0 falls back: a DIRECT beat clears its route
+    c.handle("heartbeat", {"proc": 0, "ranks": [0], "host": "hA"})
+    assert c._proc_via_agg[0] is None
+    # past window + probe grace: proc 1 (no fallback) dies, proc 0
+    # (beating direct) lives
+    time.sleep(0.7)
+    c.handle("heartbeat", {"proc": 0, "ranks": [0], "host": "hA"})
+    dead = c.dead_procs()
+    assert set(dead) == {1} and dead[1]["ranks"] == [1]
+
+
+def test_agg_registration_rearms_hosted_beats():
+    """A NEW aggregator session (stateless restart) grants its hosted
+    procs a fresh liveness window — beats lost with the dead tier are
+    not deaths."""
+    c = Coordinator(world_size=1, heartbeat_secs=0.2,
+                    heartbeat_window=0.3)
+    c._agg_probe_grace = 10.0   # isolate the re-arm (no grace expiry)
+    c.handle("agg_resync", {"agg": "h0", "sid": "s1", "procs": [0]})
+    c.handle("agg_heartbeat", {"agg": "h0", "beats": [
+        {"proc": 0, "ranks": [0]}]})
+    time.sleep(0.4)
+    c.handle("agg_resync", {"agg": "h0", "sid": "s2", "procs": [0]})
+    with c._lock:
+        age = time.monotonic() - c._beats[0]
+    assert age < 0.2            # re-armed at registration
+    assert c._agg_epoch["h0"] == 2
+
+
+def test_agg_session_survives_coordinator_restart(tmp_path):
+    """The journal composes per tier: a restarted COORDINATOR keeps
+    the aggregator registrations (same sid -> no agg_epoch bump), so
+    a coordinator-only outage never re-fences the aggregator tier."""
+    server = RendezvousServer(world_size=2,
+                              journal_path=str(tmp_path / "j.jsonl"))
+    server.start()
+    c = server.coordinator
+    out = c.handle("agg_resync", {"agg": "h0", "sid": "sX",
+                                  "host": "hA", "procs": [0, 1]})
+    assert out["agg_epoch"] == 1
+    server.restart_from_journal()
+    c2 = server.coordinator
+    assert c2.coord_epoch == 2
+    out = c2.handle("agg_resync", {"agg": "h0", "sid": "sX",
+                                   "host": "hA", "procs": [0, 1]})
+    assert out["agg_epoch"] == 1        # same session: no bump
+    # a NEW session id keeps the monotonic epoch climbing
+    out = c2.handle("agg_resync", {"agg": "h0", "sid": "sY",
+                                   "host": "hA", "procs": [0, 1]})
+    assert out["agg_epoch"] == 2
+    server.stop()
+
+
+# -- fan-in -------------------------------------------------------------------
+
+def test_upstream_batching_scales_with_hosts_not_procs():
+    """Four workers on one host ride ONE (or very few) agg_ready
+    request(s) upstream, and zero direct worker verbs."""
+    server = RendezvousServer(world_size=4)
+    port = server.start()
+    agg_srv = AggregatorServer(None, lambda: Aggregator(
+        StoreClient("127.0.0.1", port), "host0", "h0",
+        [0, 1, 2, 3], linger_ms=500))
+    aport = agg_srv.start()
+    try:
+        import threading
+        ctrls = [_controller(port, aport, proc=p, world=4)
+                 for p in range(4)]
+        members = {str(p): [p] for p in range(4)}
+
+        def one(c):
+            c.report_ready([_meta("f.k", members)])
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                if any("f.k" in (r.get("keys") or ())
+                       for r in c.poll(wait=0.5)):
+                    return
+            raise TimeoutError(c.proc_id)
+
+        ts = [threading.Thread(target=one, args=(c,)) for c in ctrls]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(25)
+        with server.coordinator._lock:
+            counts = dict(server.coordinator._verb_counts)
+        # the full-coverage flush fast path: all four reports in ONE
+        # upstream request is the common case; allow a straggler split
+        assert counts.get(("agg_ready", "agg"), 0) <= 2
+        assert counts.get(("ready", "worker"), 0) == 0
+        assert counts.get(("poll", "worker"), 0) == 0
+    finally:
+        agg_srv.stop()
+        server.stop()
+
+
+# -- launcher bootstrap -------------------------------------------------------
+
+def test_ensure_host_aggregator_owner_and_discovery(monkeypatch):
+    from horovod_tpu.runner.http import aggregator as agg_mod
+
+    server = RendezvousServer(world_size=2)
+    port = server.start()
+    try:
+        monkeypatch.setattr(agg_mod, "_PROCESS_AGG", None)
+        monkeypatch.setattr(agg_mod, "_PROCESS_AGG_FAULTS", None)
+        # owner (lowest proc on the host) starts + publishes
+        addr, aport, agg_id = agg_mod.ensure_host_aggregator(
+            "127.0.0.1", port, None, 0, [0, 0], start_timeout=10)
+        assert agg_id == "host0" and aport > 0
+        # the co-hosted proc discovers the SAME address from the KV
+        addr2, aport2, agg_id2 = agg_mod.ensure_host_aggregator(
+            "127.0.0.1", port, None, 1, [0, 0], start_timeout=10)
+        assert (addr2, aport2, agg_id2) == (addr, aport, agg_id)
+        assert server.coordinator._agg_sid.get("host0")
+    finally:
+        agg_mod.stop_process_aggregator()
+        server.stop()
+
+
+def test_tier_enabled_spellings(monkeypatch):
+    from horovod_tpu.runner.http.aggregator import tier_enabled
+    monkeypatch.delenv("HOROVOD_CONTROL_PLANE_TIER", raising=False)
+    assert tier_enabled() is False
+    monkeypatch.setenv("HOROVOD_CONTROL_PLANE_TIER", "flat")
+    assert tier_enabled() is False
+    monkeypatch.setenv("HOROVOD_CONTROL_PLANE_TIER", "host")
+    assert tier_enabled() is True
+
+
+# -- scale harness (small) ----------------------------------------------------
+
+@pytest.mark.integration
+def test_scale_harness_small():
+    """The ci.sh scale gate body at toy scale: 24 synthetic clients,
+    4 aggregators, aggregator 0 killed mid-warm-up, one resize."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "scale_harness.py"),
+         "--np", "24", "--hosts", "4", "--warmup", "2",
+         "--steady", "3", "--resize", "1", "--linger-ms", "300",
+         "--cycle-timeout", "60"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "PYTHONPATH": REPO})
+    assert proc.returncode == 0, (proc.stdout[-3000:],
+                                  proc.stderr[-2000:])
+    assert "SCALE HARNESS OK" in proc.stdout
+    # the evidence record parses and the fan-in ratio is real
+    payload = json.loads(
+        proc.stdout[proc.stdout.index("{"):
+                    proc.stdout.rindex("}") + 1])
+    assert payload["false_deaths"] == []
+    # the aggregator tier's load scales with (surviving) hosts — the
+    # harness gates the full fan-in ratio at real scale; at toy scale
+    # the killed host's 6 direct-fallback clients dominate the total
+    assert payload["coord_requests_per_cycle"]["agg_tier"] <= \
+        8 * payload["alive_aggs"]

@@ -82,6 +82,12 @@ def test_plan_parsing_and_targeting():
     {"events": [{"kind": "drop", "after_requests": 1, "p": 0}]},
     {"events": [{"kind": "kill", "side": "coord", "proc": 0,
                  "after": 1}]},                          # coord kill
+    {"events": [{"kind": "agg_restart", "proc": 0,
+                 "after_s": 1}]},                        # no ms
+    {"events": [{"kind": "agg_kill", "proc": 0,
+                 "after_collectives": 1}]},              # bad trigger
+    {"events": [{"kind": "drop", "side": "agg", "proc": 0,
+                 "after": 1}]},                          # agg wire
 ])
 def test_plan_rejects_malformed(bad):
     with pytest.raises(ValueError):
@@ -126,6 +132,74 @@ def test_same_seed_same_fault_sequence(clean_injector):
                           rank_offset=0, num_local=1)
     other.on_collectives(120)
     assert other.fired != runs[0]
+
+
+def test_agg_plan_kinds_parse_and_target():
+    """Satellite: agg_kill/agg_restart mirror coord_kill/coord_restart
+    — agg-side by definition, targeted by aggregator (host) index,
+    triggering on 'after' (n-th aggregator request) or 'after_s'."""
+    plan = parse_plan({"seed": 3, "events": [
+        {"kind": "agg_restart", "proc": 0, "after_s": 2.0, "ms": 500},
+        {"kind": "agg_kill", "proc": 1, "after": 40},
+        {"kind": "agg_kill", "after_s": 9.0},            # every host
+        {"kind": "kill", "proc": 1, "after_collectives": 3},
+    ]})
+    assert [e.side for e in plan.events] == \
+        ["agg", "agg", "agg", "worker"]
+    assert plan.events[1].trigger == "requests"
+    # per-host targeting: host 0 gets its event + the untargeted one
+    assert [e.kind for e in plan.aggregator_events(0)] == \
+        ["agg_restart", "agg_kill"]
+    assert [e.index for e in plan.aggregator_events(1)] == [1, 2]
+    # agg events never leak into worker or coordinator applier sets
+    assert [e.kind for e in plan.worker_events(1, 1, 2)] == ["kill"]
+    assert plan.coordinator_rules() == []
+
+
+def test_agg_fault_runner_same_seed_byte_identical():
+    """Satellite: two same-seed AggFaultRunner passes over the same
+    plan produce byte-identical fired evidence (the projection
+    ci.sh chaos compares), including probabilistic skips."""
+    import random as _random
+    from horovod_tpu.chaos.inject import AggFaultRunner
+
+    class _FakeServer:
+        def __init__(self):
+            self.aggregator = type("A", (), {"requests": 0})()
+            self.calls = []
+
+        def stop_http(self):
+            self.calls.append("stop")
+
+        def restart(self):
+            self.calls.append("restart")
+
+    doc = {"seed": 99, "events": [
+        {"kind": "agg_restart", "proc": 0, "after": 3, "ms": 1},
+        {"kind": "agg_kill", "proc": 0, "after_s": 0.05, "p": 0.5,
+         "count": 1},
+    ]}
+    runs = []
+    for _ in range(2):
+        srv = _FakeServer()
+        runner = AggFaultRunner(srv, parse_plan(doc), agg_index=0,
+                                env={})
+        runner.start()
+        srv.aggregator.requests = 5      # trip the 'after' trigger
+        deadline = time.monotonic() + 5.0
+        while len(runner.fired) < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.3)     # let the probabilistic wall event decide
+        runner.stop()
+        runs.append(json.dumps(sorted(runner.fired,
+                                      key=lambda r: r["event"]),
+                               sort_keys=True))
+        assert "stop" in srv.calls and "restart" in srv.calls
+    assert runs[0] == runs[1]
+    # the recorded projection carries scheduled thresholds only
+    rec = json.loads(runs[0])[0]
+    assert rec == {"agg": 0, "event": 0, "kind": "agg_restart",
+                   "n": 3, "trigger": "requests"}
 
 
 # -- injection points ---------------------------------------------------------
@@ -206,9 +280,13 @@ def test_replay_safe_verbs_contract():
     # timeout replays are ONLY safe where the coordinator dedups on a
     # client id (ready/join), on idempotent per-slot state
     # (resync/bypass_ready), or the verb is naturally idempotent
-    # (heartbeat); widening this list needs a server-side dedup first
+    # (heartbeat) — the agg_* batch envelopes inherit the dedup of
+    # the per-proc reports they carry; widening this list needs a
+    # server-side dedup first
     assert REPLAY_SAFE_VERBS == ("ready", "join", "heartbeat",
-                                 "resync", "bypass_ready")
+                                 "resync", "bypass_ready",
+                                 "agg_ready", "agg_heartbeat",
+                                 "agg_resync")
     # ONE definition: the client re-exports the contract module's
     # tuple (hvdlint checker `replay` rejects any re-definition
     # statically; this is the runtime half of the same invariant)
@@ -250,6 +328,31 @@ def test_replay_safe_verbs_contract():
                                   "fp": "fp.x"})
     arms = [r for r in c._log if r.get("kind") == "bypass_arm"]
     assert len(arms) == 1 and arms[0]["fp"] == "fp.x"
+    # agg_resync: re-sending the same (agg, sid) registration changes
+    # nothing — the agg_epoch bumps ONLY on a NEW session
+    r1 = c.handle("agg_resync", {"agg": "h0", "sid": "as",
+                                 "host": "hostA", "procs": [0, 1]})
+    r2 = c.handle("agg_resync", {"agg": "h0", "sid": "as",
+                                 "host": "hostA", "procs": [0, 1]})
+    assert r1["agg_epoch"] == r2["agg_epoch"] == 1
+    # agg_ready: the batch envelope replays single-apply through the
+    # per-proc rid high-waters (proc 0 joined ps 0 above, so its lone
+    # report schedules immediately — a double-apply would schedule
+    # the batch twice)
+    areq = {"agg": "h0", "reports": [
+        {"proc": 0, "nlocal": 1, "rid": 5, "sid": "s",
+         "entries": [_meta("agg.k", {"0": [0], "1": [1]})]}]}
+    c.handle("agg_ready", areq)
+    c.handle("agg_ready", areq)
+    scheduled = [r for r in c._log
+                 if r.get("kind") == "batch" and "agg.k" in r["keys"]]
+    assert len(scheduled) == 1 and "agg.k" not in c._pending
+    # agg_heartbeat: idempotent relayed beats, route recorded
+    hreq = {"agg": "h0", "host": "hostA",
+            "beats": [{"proc": 0, "ranks": [0], "host": "hostA"}]}
+    c.handle("agg_heartbeat", hreq)
+    c.handle("agg_heartbeat", hreq)
+    assert c._proc_via_agg[0] == "h0"
 
 
 def test_epoch_fence_rejects_stale_generation_before_verb_runs():
@@ -716,6 +819,17 @@ def _run_scenario(name, timeout=600):
     assert proc.returncode == 0, (proc.stdout[-3000:],
                                   proc.stderr[-3000:])
     assert "CHAOS SMOKE OK" in proc.stdout
+
+
+@pytest.mark.integration
+@pytest.mark.slow
+def test_scenario_aggregator_death():
+    """Aggregator-death drill (ISSUE 12 acceptance): steps keep
+    flowing through an agg_restart during warm-up and an agg_kill at
+    steady state (direct fallback), zero false worker deaths, two
+    same-seed runs byte-identical.  Slow-marked like the coordinator
+    drill — the chaos tier always runs it."""
+    _run_scenario("aggkill")
 
 
 @pytest.mark.integration

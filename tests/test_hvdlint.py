@@ -356,6 +356,45 @@ class TestReplaySafety:
             """}, checkers=["replay"])
         assert "replay-fence" in ids(findings)
 
+    def test_unclassified_verb_flagged_on_every_tier(self, tmp_path):
+        """Satellite (ISSUE 12): a NEW verb handler — on the
+        coordinator OR an aggregator-shaped class — whose verb is in
+        none of REPLAY_SAFE_VERBS / EPOCH_EXEMPT_VERBS / STREAM_VERBS
+        flags; classifying it (here: a stream verb) passes."""
+        server = """\
+        class Aggregator:
+            coord_epoch = 1
+
+            def handle(self, verb, req):
+                if verb == "clock":
+                    return {"t": 0}
+                if req.get("epoch") != self.coord_epoch:
+                    return {"epoch_mismatch": True}
+                if verb == "ready":
+                    return self._on_ready(req)
+                if verb == "evil_poll":
+                    return self._on_evil_poll(req)
+
+            def _on_ready(self, req):
+                if req["rid"] in self._ready_seen:
+                    return {}
+                return {}
+
+            def _on_evil_poll(self, req):
+                return {"responses": []}
+        """
+        findings = run(tmp_path, {"contract.py": CONTRACT,
+                                  "server.py": server},
+                       checkers=["replay"])
+        assert any(f.checker_id == "replay-unclassified-verb"
+                   and "evil_poll" in f.message for f in findings)
+        classified = CONTRACT + 'STREAM_VERBS = ("evil_poll",)\n'
+        findings = run(tmp_path, {"contract.py": classified,
+                                  "server.py": server},
+                       checkers=["replay"])
+        assert not [f for f in findings
+                    if f.checker_id == "replay-unclassified-verb"]
+
 
 # ---------------------------------------------------------------------------
 # checker 4: telemetry hygiene

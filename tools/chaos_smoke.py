@@ -25,6 +25,15 @@ plans, asserting the robustness claims docs/fault_tolerance.md makes:
   bumped, zero workers falsely declared dead), post-restart
   renegotiation works (the final barrier), and two same-seed runs
   produce byte-identical coordinator fault sequences.
+* ``aggkill`` — kill the per-host AGGREGATOR tier mid-training
+  (``--control-plane-tier host``): an ``agg_restart`` during warm-up
+  re-fences the workers through the stateless restart (agg_epoch
+  bump -> resync -> drain -> re-report), an ``agg_kill`` at steady
+  state drops them into direct-coordinator fallback; steps keep
+  flowing through BOTH outages, zero workers are falsely declared
+  dead (the coordinator holds a silent aggregator's hosted ranks as
+  suspect until direct-fallback probing settles), and two same-seed
+  runs produce byte-identical aggregator fault sequences.
 
 Every scenario runs under a hard watchdog (launcher start_timeout /
 subprocess timeout), so a hung scenario fails the smoke instead of
@@ -129,6 +138,47 @@ def worker_coordkill():
     hvd.shutdown()
     print(f"worker {r} OK ({len(steps)} steps, "
           f"{hits:.0f} bypass hits)", flush=True)
+
+
+def worker_aggkill():
+    import urllib.request
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.common import env as env_mod
+
+    hvd.init()
+    r = hvd.rank()
+    out_dir = os.environ["CS_OUT"]
+    run_s = float(os.environ.get("AK_RUN_SECONDS", "16"))
+    # same SPMD deadline trick as worker_coordkill: one tensor per
+    # step, the continue-flag folded into element 0
+    deadline = time.time() + run_s
+    x = np.ones(256, np.float32)
+    steps = []
+    for i in range(20000):
+        x[0] = 1.0 if time.time() < deadline else 0.0
+        out = hvd.allreduce(x, op=hvd.Sum, name="ak.step")
+        assert np.allclose(out[1:], 2.0), out[:4]
+        steps.append(time.time())
+        if out[0] < 2.0:
+            break
+    with open(os.path.join(out_dir, f"steps_{r}.json"), "w") as f:
+        json.dump(steps, f)
+    # renegotiation against whatever route survived (direct fallback
+    # after the agg_kill): BARRIER is never bypass-cacheable
+    hvd.barrier()
+    if r == 0:
+        from horovod_tpu.common import basics
+        basics._engine.push_metrics()
+        addr = env_mod.require_str(env_mod.HOROVOD_RENDEZVOUS_ADDR)
+        port = env_mod.require_int(env_mod.HOROVOD_RENDEZVOUS_PORT)
+        text = urllib.request.urlopen(
+            f"http://{addr}:{port}/metrics", timeout=15).read().decode()
+        with open(os.path.join(out_dir, "metrics.txt"), "w") as f:
+            f.write(text)
+    hvd.barrier()
+    hvd.shutdown()
+    print(f"worker {r} OK ({len(steps)} steps)", flush=True)
 
 
 def worker_slow():
@@ -370,6 +420,93 @@ def scenario_coordkill():
           f"epoch 2, deterministic: {coord_logs[0]})")
 
 
+def scenario_aggkill():
+    """Aggregator-death drill (ISSUE 12 acceptance): with the
+    per-host tier enabled, a seeded plan restarts the host's
+    aggregator during warm-up (1.5s outage, stateless restart,
+    agg_epoch bump) and kills it for good at steady state.  Steps
+    must keep flowing through both outages (direct fallback or
+    post-resync), zero workers may be falsely declared dead, and two
+    same-seed runs must produce byte-identical aggregator fault
+    sequences."""
+    from horovod_tpu.runner.proc_run import launch_procs
+
+    plan = json.dumps({"seed": SEED, "events": [
+        {"kind": "agg_restart", "proc": 0, "after_s": 3.0,
+         "ms": 1500},
+        {"kind": "agg_kill", "proc": 0, "after_s": 10.0},
+    ]})
+    agg_logs = []
+    for run in (1, 2):
+        out = _out_dir(f"aggkill{run}")
+        agg_log = os.path.join(out, "agg_fired.jsonl")
+        codes = launch_procs(
+            [sys.executable, "-u", os.path.abspath(__file__)], np=2,
+            platform="cpu",
+            env={"PYTHONPATH": REPO, "CS_SCENARIO": "aggkill",
+                 "CS_OUT": out, "AK_RUN_SECONDS": "16",
+                 "HOROVOD_FAULT_PLAN": plan,
+                 "HOROVOD_FAULT_AGG_LOG": agg_log,
+                 "HOROVOD_CONTROL_PLANE_TIER": "host",
+                 "HOROVOD_AGG_FALLBACK_DEADLINE_SECONDS": "2",
+                 "HOROVOD_BYPASS_AFTER_CYCLES": "3",
+                 "HOROVOD_HEARTBEAT_INTERVAL_SECONDS": "1",
+                 "HOROVOD_METRICS_PUSH_SECONDS": "1"},
+            start_timeout=300)
+        assert codes == [0, 0], f"run {run}: worker exit codes {codes}"
+        with open(agg_log) as f:
+            fired = [json.loads(line) for line in f if line.strip()]
+        assert sorted(r["kind"] for r in fired) == \
+            ["agg_kill", "agg_restart"], fired
+        # deterministic projection: everything but the wall-clock
+        # bounds, canonically ordered (one aggregator here, but multi-
+        # host plans interleave appends nondeterministically)
+        agg_logs.append(json.dumps(sorted(
+            ({k: v for k, v in rec.items() if not k.startswith("t_")}
+             for rec in fired), key=lambda r: (r["agg"], r["event"])),
+            sort_keys=True))
+        if run != 1:
+            continue
+        restart = next(r for r in fired if r["kind"] == "agg_restart")
+        kill = next(r for r in fired if r["kind"] == "agg_kill")
+        with open(os.path.join(out, "steps_0.json")) as f:
+            steps = json.load(f)
+        # steps kept flowing through the warm-up restart outage...
+        during_restart = [t for t in steps
+                          if restart["t_stop"] <= t
+                          <= restart["t_start"] + 2.0]
+        # ...and after the steady-state kill (direct fallback)
+        after_kill = [t for t in steps if t >= kill["t_stop"]]
+        assert during_restart, (
+            f"no steps through the agg_restart outage "
+            f"({len(steps)} total)")
+        assert len(after_kill) >= 5, (
+            f"only {len(after_kill)} steps after the agg_kill "
+            f"(fallback to direct mode failed?)")
+        # zero false deaths across both outages
+        with open(os.path.join(out, "metrics.txt")) as f:
+            metrics = f.read()
+        alive_vals = [float(line.rsplit(" ", 1)[1])
+                      for line in metrics.splitlines()
+                      if line.startswith("horovod_worker_alive")]
+        assert alive_vals and min(alive_vals) == 1.0, (
+            "a worker was falsely declared dead across the "
+            "aggregator outages: " + repr(alive_vals))
+        # the fallback was exercised and exported
+        fb_vals = [float(line.rsplit(" ", 1)[1])
+                   for line in metrics.splitlines()
+                   if line.startswith("horovod_agg_fallbacks_total")]
+        assert fb_vals and max(fb_vals) > 0, (
+            "agg_kill fired but no worker recorded a direct "
+            "fallback: " + repr(fb_vals))
+        n_restart, n_kill = len(during_restart), len(after_kill)
+    assert agg_logs[0] == agg_logs[1], (
+        "same-seed runs produced DIFFERENT aggregator fault "
+        f"sequences:\nrun1={agg_logs[0]}\nrun2={agg_logs[1]}")
+    print(f"AGGKILL OK ({n_restart} steps through the restart, "
+          f"{n_kill} after the kill, deterministic: {agg_logs[0]})")
+
+
 def scenario_kill():
     """SIGKILL one elastic worker mid-training: the job must recover
     through elastic restart and finish from the last commit."""
@@ -415,6 +552,7 @@ def scenario_hang():
 
 SCENARIOS = {"fivexx": scenario_fivexx, "slow": scenario_slow,
              "coordkill": scenario_coordkill,
+             "aggkill": scenario_aggkill,
              "kill": scenario_kill, "hang": scenario_hang}
 
 
@@ -422,7 +560,8 @@ def main():
     which = os.environ.get("CS_SCENARIO")
     if which:
         {"fivexx": worker_fivexx, "slow": worker_slow,
-         "coordkill": worker_coordkill}[which]()
+         "coordkill": worker_coordkill,
+         "aggkill": worker_aggkill}[which]()
         return
     names = sys.argv[1:] or list(SCENARIOS)
     t0 = time.monotonic()

@@ -31,6 +31,15 @@ Checks:
 ``replay-fence``          — a verb dispatched before the epoch fence
                             in ``handle`` without being declared
                             exempt.
+``replay-unclassified-verb`` — an ``_on_<verb>`` handler on a
+                            coordinator-shaped class whose verb is in
+                            NONE of REPLAY_SAFE_VERBS /
+                            EPOCH_EXEMPT_VERBS / STREAM_VERBS.  Every
+                            verb on every tier (coordinator AND
+                            per-host aggregator) must pick a replay
+                            class in the contract module — an
+                            unclassified verb is a retry/restart
+                            policy nobody wrote down.
 ``replay-no-contract``    — no contract module found.
 """
 
@@ -39,8 +48,8 @@ import ast
 from ..core import Checker, Finding, register
 
 CONTRACT_NAMES = ("REPLAY_SAFE_VERBS", "REPLAY_SAFE_KV_VERBS",
-                  "EPOCH_EXEMPT_VERBS", "REPLAY_DEDUP_ATTRS",
-                  "CACHEABLE_TYPES")
+                  "EPOCH_EXEMPT_VERBS", "STREAM_VERBS",
+                  "REPLAY_DEDUP_ATTRS", "CACHEABLE_TYPES")
 
 
 def _find_contract(project):
@@ -102,12 +111,14 @@ class ReplaySafetyChecker(Checker):
             "REPLAY_SAFE_KV_VERBS", ()))
         exempt = tuple(contract.constants.get(
             "EPOCH_EXEMPT_VERBS", ()))
+        stream = tuple(contract.constants.get("STREAM_VERBS", ()))
         dedup = dict(contract.constants.get(
             "REPLAY_DEDUP_ATTRS", {}) or {})
 
         self._check_duplicates(project, contract, findings)
         self._check_client(project, safe, kv_safe, findings)
-        self._check_server(project, safe, exempt, dedup, findings)
+        self._check_server(project, safe, exempt, stream, dedup,
+                           findings)
         return findings
 
     # -- one definition -------------------------------------------------------
@@ -203,10 +214,30 @@ class ReplaySafetyChecker(Checker):
                     out.append((pf, cls_name))
         return out
 
-    def _check_server(self, project, safe, exempt, dedup, findings):
+    def _check_server(self, project, safe, exempt, stream, dedup,
+                      findings):
+        classified = set(safe) | set(exempt) | set(stream)
         for pf, cls in self._coordinator_classes(project):
             handle = pf.methods[(cls, "handle")]
             self._check_fence(pf, cls, handle, exempt, findings)
+            for (c, name) in sorted(pf.methods):
+                if c != cls or not name.startswith("_on_"):
+                    continue
+                verb = name[len("_on_"):]
+                if verb not in classified:
+                    findings.append(Finding(
+                        "replay-unclassified-verb", pf.rel,
+                        pf.methods[(c, name)].node.lineno,
+                        f"verb {verb!r} (handler `{cls}.{name}`) is "
+                        f"classified in none of REPLAY_SAFE_VERBS / "
+                        f"EPOCH_EXEMPT_VERBS / STREAM_VERBS",
+                        hint="every verb on every tier must pick a "
+                             "replay class in the contract module — "
+                             "replay-safe (with a dedup structure), "
+                             "fence-exempt recovery, or cursor-"
+                             "idempotent stream",
+                        key=f"replay-unclassified-verb:{pf.rel}:"
+                            f"{verb}"))
             for verb in safe:
                 fi = pf.methods.get((cls, f"_on_{verb}"))
                 if fi is None:

@@ -1,0 +1,411 @@
+#!/usr/bin/env python
+"""Synthetic control-plane load harness (ci.sh ``scale``; ISSUE 12).
+
+Drives N synthetic fabric clients — real :class:`StoreController`
+instances on real HTTP, one thread each, NO training — through the
+two-tier control plane: H per-host aggregators (one
+:class:`AggregatorServer` per synthetic host) batching upstream into
+one launcher-grade :class:`RendezvousServer` coordinator.  Phases:
+
+* **warm-up** — registration + first negotiation cycles (cold caches,
+  sessions forming).  With ``--agg-kill warmup``, host 0's aggregator
+  is killed between warm-up cycles: its clients must fall back to
+  direct coordinator mode and NOBODY may be falsely declared dead
+  (the coordinator holds the silent aggregator's hosted procs as
+  suspect until their direct beats land).
+* **steady** — the measured window: every client runs one negotiation
+  cycle per barrier tick (ready -> poll until scheduled), beating
+  once per cycle.  Coordinator requests are counted per (verb, tier).
+* **resize** — an elastic round reset mid-run: clients ride
+  StaleRoundError into fresh controllers, surviving aggregators adopt
+  the new round through their stale replies, and one clean cycle must
+  complete in the new round.
+
+The acceptance evidence (printed + ``--json``):
+
+* coordinator requests/steady-cycle split by tier — the aggregator
+  tier must scale with HOSTS (≤ ``agg_budget``/host/cycle) and the
+  total must stay far below one-per-proc (the flat topology's floor);
+* p99 negotiation-cycle time from the process registry's
+  ``horovod_control_cycle_seconds{tier="worker"}`` histogram — the
+  ``ci.sh perf``-style regression number for the control plane;
+* zero false worker deaths across the aggregator kill.
+
+Every cycle runs under a hard deadline, so a wedged tier fails the
+harness instead of hanging CI.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from horovod_tpu.common import env as env_mod              # noqa: E402
+
+# the worker-side fallback budget must be set BEFORE the runtime
+# objects read it (coordinator suspect grace + client budgets)
+if env_mod.get_str(
+        env_mod.HOROVOD_AGG_FALLBACK_DEADLINE_SECONDS) is None:
+    os.environ[env_mod.HOROVOD_AGG_FALLBACK_DEADLINE_SECONDS] = "3"
+
+from horovod_tpu import telemetry                          # noqa: E402
+from horovod_tpu.core.store_controller import (            # noqa: E402
+    StaleRoundError, StoreController,
+)
+from horovod_tpu.runner.http.aggregator import (           # noqa: E402
+    Aggregator, AggregatorServer,
+)
+from horovod_tpu.runner.http.http_client import StoreClient  # noqa: E402
+from horovod_tpu.runner.http.http_server import (          # noqa: E402
+    RendezvousServer,
+)
+
+
+def _meta(key, nprocs):
+    """Minimal fixed-shape allreduce meta (no per-proc members map —
+    at 1000 procs the map itself would dominate the wire)."""
+    return {"key": key, "type": "ALLREDUCE", "dtype": "float32",
+            "shape": [1], "op": 1, "pre": 1.0, "post": 1.0, "ps": 0,
+            "nbytes": 4, "nprocs": nprocs, "nranks": nprocs,
+            "root": -1, "aux": {}}
+
+
+class Client(threading.Thread):
+    """One synthetic fabric client: a real StoreController driven
+    through ready -> poll cycles, beating once per cycle."""
+
+    def __init__(self, harness, proc, host):
+        super().__init__(name=f"scale-client-{proc}", daemon=True)
+        self.h = harness
+        self.proc = proc
+        self.host = host
+        self.error = None
+        self.round_id = 0
+        self.ctrl = None
+
+    def _controller(self):
+        agg_addr, agg_port = self.h.agg_addr[self.host]
+        c = StoreController(
+            "127.0.0.1", self.h.port, None, self.proc, self.h.np, 1,
+            poll_wait=2.0, round_id=self.round_id,
+            agg_addr=agg_addr, agg_port=agg_port)
+        return c
+
+    def run(self):
+        try:
+            self.ctrl = self._controller()
+            while True:
+                cycle = self.h.next_cycle(self)
+                if cycle is None:
+                    return
+                self._one_cycle(cycle)
+        except BaseException as exc:  # noqa: BLE001 — surfaced by main
+            self.error = exc
+            self.h.abort(f"client {self.proc}: {exc!r}")
+
+    def _one_cycle(self, cycle):
+        key = f"t.{self.round_id}.{cycle}"
+        deadline = time.monotonic() + self.h.cycle_timeout
+        t0 = time.monotonic()
+        reported = False
+        iters = 0
+        while True:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"client {self.proc}: cycle {cycle} (round "
+                    f"{self.round_id}) never completed")
+            try:
+                if iters % 4 == 0:
+                    # beats ride the poll loop (~every 4s), not the
+                    # cycle clock: at 1000 procs a cold cycle can
+                    # outlast the liveness window, and the aggregator
+                    # batches the beats upstream anyway
+                    self.ctrl.heartbeat(ranks=[self.proc],
+                                        host=f"shost{self.host}")
+                iters += 1
+                if not reported:
+                    self.ctrl.report_ready(
+                        [_meta(key, self.h.np)])
+                    reported = True
+                elif self.ctrl.take_rereport():
+                    # post-resync recovery (an aggregator restart or
+                    # route change mid-cycle): re-report the awaiting
+                    # entry after draining the replayed log
+                    self.ctrl.forget(key)
+                    self.ctrl.report_ready([_meta(key, self.h.np)])
+                responses = self.ctrl.poll(wait=2.0)
+            except StaleRoundError:
+                # elastic reset: rebuild against the new round and
+                # re-run THIS cycle's negotiation in it
+                self.round_id = self.h.round_id
+                key = f"t.{self.round_id}.{cycle}"
+                self.ctrl = self._controller()
+                reported = False
+                time.sleep(0.05)
+                continue
+            if any(key in (r.get("keys") or ())
+                   for r in responses):
+                telemetry.observe_control_cycle(
+                    "worker", time.monotonic() - t0)
+                return
+
+
+class Harness:
+    def __init__(self, args):
+        self.np = args.np
+        self.hosts = args.hosts
+        self.cycle_timeout = args.cycle_timeout
+        self.round_id = 0
+        self._abort = None
+        self._phases = []           # (name, cycles) consumed by ticks
+        self._barrier = threading.Barrier(self.np + 1)
+        self._schedule = []         # per-tick cycle ids, None = stop
+        self._tick = {}             # per-client tick index
+        self._tick_lock = threading.Lock()
+
+        telemetry.fresh_registry()
+        os.environ["HOROVOD_AGG_LINGER_MS"] = str(args.linger_ms)
+        self.server = RendezvousServer(
+            world_size=self.np, heartbeat_secs=args.heartbeat_secs)
+        self.port = self.server.start()
+        self.agg_servers = []
+        self.agg_addr = {}
+        per = (self.np + self.hosts - 1) // self.hosts
+        self.host_of = [min(p // per, self.hosts - 1)
+                        for p in range(self.np)]
+        for h in range(self.hosts):
+            procs = [p for p in range(self.np)
+                     if self.host_of[p] == h]
+
+            def make_core(h=h, procs=procs):
+                return Aggregator(
+                    StoreClient("127.0.0.1", self.port),
+                    agg_id=f"shost{h}", host=f"shost{h}",
+                    procs=procs, poll_wait=10.0,
+                    linger_ms=args.linger_ms, relay_secs=5.0)
+
+            srv = AggregatorServer(None, make_core)
+            aport = srv.start()
+            self.agg_servers.append(srv)
+            self.agg_addr[h] = ("127.0.0.1", aport)
+        self.clients = [Client(self, p, self.host_of[p])
+                        for p in range(self.np)]
+
+    # -- lock-step scheduling ------------------------------------------------
+
+    def abort(self, why):
+        self._abort = self._abort or why
+        self._barrier.abort()
+
+    def next_cycle(self, client):
+        """Block until the driver publishes the next cycle id (or
+        None to stop).  The barrier keeps phases lock-step so per-
+        phase request counting is exact."""
+        with self._tick_lock:
+            i = self._tick.get(client.proc, 0)
+            self._tick[client.proc] = i + 1
+        self._barrier.wait()
+        if i >= len(self._schedule):
+            return None
+        return self._schedule[i]
+
+    def tick(self, cycle):
+        """Publish one cycle id and release the clients; returns when
+        every client reached the NEXT barrier (cycle complete)."""
+        self._schedule.append(cycle)
+        self._barrier.wait()
+
+    def stop_clients(self):
+        self._schedule.append(None)
+        try:
+            self._barrier.wait(timeout=30)
+        except threading.BrokenBarrierError:
+            pass
+
+    # -- measurement ---------------------------------------------------------
+
+    def verb_counts(self):
+        with self.server.coordinator._lock:
+            return dict(self.server.coordinator._verb_counts)
+
+    @staticmethod
+    def _tier_totals(counts):
+        out = {"agg": 0, "worker": 0}
+        for (verb, tier), n in counts.items():
+            out[tier] = out.get(tier, 0) + n
+        return out
+
+    def p99_cycle_seconds(self):
+        fam = telemetry.registry().get(
+            telemetry.CONTROL_CYCLE_SECONDS_FAMILY)
+        if fam is None:
+            return None
+        snap = fam.snapshot()
+        for sample in snap["samples"]:
+            if sample["labels"].get("tier") != "worker":
+                continue
+            counts = sample["counts"]
+            total = sample["count"]
+            if not total:
+                return None
+            bounds = snap["buckets"] + [float("inf")]
+            cum = 0
+            for i, c in enumerate(counts):
+                cum += c
+                if cum >= 0.99 * total:
+                    return bounds[i]
+        return None
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--np", type=int, default=1000,
+                    help="synthetic fabric clients (procs)")
+    ap.add_argument("--hosts", type=int, default=25,
+                    help="synthetic hosts (= aggregators)")
+    ap.add_argument("--warmup", type=int, default=4)
+    ap.add_argument("--steady", type=int, default=6)
+    ap.add_argument("--resize", type=int, default=2,
+                    help="cycles after the elastic round reset "
+                         "(0 skips the resize phase)")
+    ap.add_argument("--agg-kill", choices=["warmup", "none"],
+                    default="warmup",
+                    help="kill host 0's aggregator mid-warm-up")
+    ap.add_argument("--linger-ms", type=float, default=1000.0,
+                    help="aggregator co-report linger; full local "
+                         "coverage flushes early, so all-report "
+                         "cycles pay none of it")
+    ap.add_argument("--heartbeat-secs", type=float, default=30.0)
+    ap.add_argument("--cycle-timeout", type=float, default=120.0)
+    ap.add_argument("--p99-bound", type=float, default=60.0,
+                    help="bound on the p99 worker negotiation-cycle "
+                         "bucket (seconds)")
+    ap.add_argument("--agg-budget", type=float, default=8.0,
+                    help="allowed aggregator-tier coordinator "
+                         "requests per host per steady cycle")
+    ap.add_argument("--json", default=None,
+                    help="write the evidence record here")
+    args = ap.parse_args()
+
+    t_start = time.monotonic()
+    h = Harness(args)
+    print(f"scale harness: np={args.np} hosts={args.hosts} "
+          f"(coordinator :{h.port})", flush=True)
+    for c in h.clients:
+        c.start()
+
+    killed_procs = 0
+    evidence = {"np": args.np, "hosts": args.hosts}
+    try:
+        # -- warm-up, with the aggregator killed mid-phase ----------------
+        for i in range(args.warmup):
+            if args.agg_kill == "warmup" and i == args.warmup // 2:
+                print("warm-up: killing host 0's aggregator",
+                      flush=True)
+                h.agg_servers[0].stop()
+                killed_procs = sum(1 for p in range(args.np)
+                                   if h.host_of[p] == 0)
+            h.tick(i)
+            if h._abort:
+                raise RuntimeError(h._abort)
+            print(f"warm-up cycle {i + 1}/{args.warmup} done",
+                  flush=True)
+
+        # -- steady: the measured window ----------------------------------
+        before = h.verb_counts()
+        for i in range(args.steady):
+            h.tick(args.warmup + i)
+            if h._abort:
+                raise RuntimeError(h._abort)
+            print(f"steady cycle {i + 1}/{args.steady} done",
+                  flush=True)
+        after = h.verb_counts()
+        delta = {k: after.get(k, 0) - before.get(k, 0)
+                 for k in set(after) | set(before)}
+        tiers = h._tier_totals(delta)
+        agg_pc = tiers["agg"] / args.steady
+        worker_pc = tiers["worker"] / args.steady
+        total_pc = agg_pc + worker_pc
+        alive_aggs = args.hosts - (1 if killed_procs else 0)
+
+        # -- resize: elastic round reset mid-run --------------------------
+        if args.resize:
+            print("resize: coordinator round reset", flush=True)
+            h.round_id = 1
+            h.server.coordinator.reset(args.np, round_id=1)
+            for i in range(args.resize):
+                h.tick(args.warmup + args.steady + i)
+                if h._abort:
+                    raise RuntimeError(h._abort)
+                print(f"resize cycle {i + 1}/{args.resize} done",
+                      flush=True)
+    finally:
+        h.stop_clients()
+
+    # -- evidence + gates --------------------------------------------------
+    dead = h.server.coordinator.dead_procs()
+    p99 = h.p99_cycle_seconds()
+    evidence.update({
+        "killed_agg_procs": killed_procs,
+        "alive_aggs": alive_aggs,
+        "steady_cycles": args.steady,
+        "coord_requests_per_cycle": {
+            "agg_tier": round(agg_pc, 2),
+            "worker_tier": round(worker_pc, 2),
+            "total": round(total_pc, 2)},
+        "per_verb_delta": {f"{v}:{t}": n
+                           for (v, t), n in sorted(delta.items())},
+        "fanin_ratio_procs_over_requests":
+            round(args.np / max(total_pc, 1e-9), 2),
+        "p99_worker_cycle_seconds_bucket": p99,
+        "false_deaths": sorted(dead),
+        "wall_seconds": round(time.monotonic() - t_start, 1),
+    })
+    print(json.dumps(evidence, indent=2, sort_keys=True), flush=True)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(evidence, f, indent=2, sort_keys=True)
+
+    errors = []
+    if dead:
+        errors.append(f"false worker deaths: {sorted(dead)}")
+    # the fan-in claim: the aggregator tier scales with HOSTS...
+    if agg_pc > args.agg_budget * alive_aggs:
+        errors.append(
+            f"aggregator tier issued {agg_pc:.1f} coordinator "
+            f"requests/cycle (> {args.agg_budget}/host x "
+            f"{alive_aggs} hosts)")
+    # ...and the total stays far below the flat topology's
+    # one-request-per-proc floor (direct-fallback clients from the
+    # killed aggregator are the only per-proc traffic left)
+    flat_floor = args.np
+    if total_pc > max(flat_floor / 2.0,
+                      args.agg_budget * alive_aggs
+                      + 10.0 * killed_procs):
+        errors.append(
+            f"total coordinator load {total_pc:.1f} requests/cycle "
+            f"does not beat the flat topology (np={args.np})")
+    if p99 is None or p99 > args.p99_bound:
+        errors.append(f"p99 worker cycle bucket {p99} exceeds "
+                      f"{args.p99_bound}s")
+    client_errors = [c.error for c in h.clients if c.error]
+    if client_errors:
+        errors.append(f"{len(client_errors)} clients failed; first: "
+                      f"{client_errors[0]!r}")
+    if errors:
+        print("SCALE HARNESS FAILED:\n  - " + "\n  - ".join(errors))
+        sys.exit(1)
+    print(f"SCALE HARNESS OK ({args.np} procs over {args.hosts} "
+          f"hosts: {total_pc:.1f} coordinator requests/cycle — "
+          f"{evidence['fanin_ratio_procs_over_requests']}x below "
+          f"one-per-proc; agg kill -> {killed_procs} direct "
+          f"fallbacks, zero false deaths)")
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,13 @@
 #                         #   coordinator 5xx, hang) with a hang
 #                         #   watchdog; asserts recovery, stall
 #                         #   attribution and same-seed determinism
+#   ./ci.sh fleet         # gate: tools/fleet_smoke.py — the multi-
+#                         #   tenant day-in-the-life scenario: two
+#                         #   real jobs on one shared pool, SLO spike
+#                         #   preempts training dp, revoke/restore
+#                         #   storm debounced, host SIGKILL
+#                         #   blacklisted fleet-wide; byte-identical
+#                         #   same-seed evidence
 #   ./ci.sh scale         # gate: tools/scale_harness.py — 1000
 #                         #   synthetic fabric clients over 25
 #                         #   per-host aggregators, one aggregator
@@ -73,7 +80,7 @@ PART2="tests/test_elastic.py tests/test_examples.py \
   tests/test_tensorflow.py"
 PART3="tests/test_parallel.py tests/test_torch.py"
 PART4="tests/test_aggregator.py tests/test_api_parity.py \
-  tests/test_chaos.py \
+  tests/test_chaos.py tests/test_fleet.py \
   tests/test_pallas.py tests/test_runner.py tests/test_serving.py"
 
 case "${1:-all}" in
@@ -123,6 +130,19 @@ case "${1:-all}" in
     # byte-identical.  Every scenario runs under a hard watchdog.
     python tools/chaos_smoke.py
     ;;
+  fleet)
+    # multi-tenant fleet gate (docs/fleet.md; ISSUE 13): the
+    # day-in-the-life scenario — a REAL elastic training job + a REAL
+    # elastic serving job on one shared host pool; a traffic spike
+    # preempts training dp through the elasticity lever, a seeded
+    # revoke/restore storm is debounced to one shrink + one grow, a
+    # SIGKILLed training host is blacklisted for every job and its
+    # chips return after the deterministic cooldown; per-job goodput
+    # and SLO conformance assert from the controller's merged
+    # /metrics, and two same-seed runs must produce byte-identical
+    # preemption/fault evidence logs
+    python tools/fleet_smoke.py
+    ;;
   scale)
     # control-plane scale gate (docs/fault_tolerance.md "Per-host
     # aggregator tier"): 1000 synthetic StoreControllers (threads, no
@@ -163,15 +183,20 @@ case "${1:-all}" in
     python tools/serve_smoke.py
     ;;
   perf)
-    # perf regression gate (ROADMAP item 5, first slice): re-runs the
+    # perf regression gate: re-runs the
     # collective_bench wire + wire-pair sweeps and compares the
     # goodput/byte-accounting numbers against the checked-in
     # benchmarks/BASELINE.json tolerance band — the 3.97x int8 /
     # 7.88x int4 codec wire, the per-hop cross-byte budgets and the
     # fused-per-hop-vs-staged-int8 ratio (absolute floor 1.54x, the
     # bar ISSUE 9 set) cannot silently regress.
+    # The SAME matrix then re-runs under a seeded fault plan (fabric
+    # delays, 5xx bursts, a probabilistic straggler): it must
+    # complete, move byte-identical wire traffic, and hold goodput
+    # within the bounded fault-regression budget — "fast" and
+    # "survives faults" gate as one property (docs/fleet.md).
     # `./ci.sh perf --update-baseline` re-records after intentional
-    # perf changes.
+    # perf changes; --no-fault-plan skips the faulted pass.
     shift
     python tools/perf_gate.py "$@"
     ;;
@@ -276,7 +301,7 @@ case "${1:-all}" in
     python -m pytest $PART4 -q
     ;;
   *)
-    echo "usage: $0 {analyze|fast|matrix|integration|chaos|scale|trace|metrics|serve|pp|bench|perf|all}" >&2
+    echo "usage: $0 {analyze|fast|matrix|integration|chaos|fleet|scale|trace|metrics|serve|pp|bench|perf|all}" >&2
     exit 2
     ;;
 esac

@@ -35,7 +35,9 @@ path to a JSON file; ``horovodrun --fault-plan`` forwards it)::
         {"kind": "coord_kill", "after": 200},
         {"kind": "agg_restart", "proc": 0, "after_s": 3.0,
                                 "ms": 1500},
-        {"kind": "agg_kill", "proc": 1, "after_s": 8.0}
+        {"kind": "agg_kill", "proc": 1, "after_s": 8.0},
+        {"kind": "revoke_host", "host": "host3", "after": 12},
+        {"kind": "restore_host", "host": "host3", "after": 18}
       ]
     }
 
@@ -93,8 +95,19 @@ COORD_KINDS = ("coord_kill", "coord_restart")
 #: trigger is ``after_s`` (wall) or ``after`` (the n-th request that
 #: host's aggregator handles).
 AGG_KINDS = ("agg_kill", "agg_restart")
+#: Fleet-controller kinds (docs/fleet.md "Chaos"): ``revoke_host``
+#: removes a host from the shared pool — every job placed on it is
+#: reassigned through the SAME preemption-by-elasticity path a real
+#: preemption or hardware death takes (one mechanism for both drills);
+#: ``restore_host`` returns it.  Both are implicitly ``side: "fleet"``
+#: and applied by the launcher's FleetController; the target is
+#: ``host`` (a pool hostname) or ``proc`` (the host's index in the
+#: spec's pool order), and the trigger is ``after`` (the n-th
+#: reconcile tick — deterministic across same-seed runs) or
+#: ``after_s`` (wall offset).
+FLEET_KINDS = ("revoke_host", "restore_host")
 KINDS = PROCESS_KINDS + WIRE_KINDS + ENGINE_KINDS + COORD_KINDS \
-    + AGG_KINDS
+    + AGG_KINDS + FLEET_KINDS
 
 #: Trigger spellings -> canonical trigger name.
 _TRIGGERS = {"after_requests": "requests",
@@ -120,7 +133,8 @@ class FaultEvent:
     ms: float = 0.0                 # delay / skew magnitude
     count: int = 1                  # consecutive trigger points to fire on
     p: float = 1.0                  # per-firing probability (seeded RNG)
-    side: str = "worker"            # worker | coord
+    side: str = "worker"            # worker | coord | agg | fleet
+    host: Optional[str] = None      # fleet-side pool hostname target
 
 
 @dataclass
@@ -152,6 +166,11 @@ class FaultPlan:
         """Events the launcher installs into its coordinator."""
         return [e for e in self.events if e.side == "coord"]
 
+    def fleet_events(self) -> List[FaultEvent]:
+        """Events the launcher's FleetController applies to its shared
+        host pool (revoke_host / restore_host)."""
+        return [e for e in self.events if e.side == "fleet"]
+
     def aggregator_events(self, agg_index: int) -> List[FaultEvent]:
         """Service faults the process owning aggregator ``agg_index``
         (= its host index) must apply — targeted by ``proc``, or
@@ -176,16 +195,19 @@ def _parse_event(index: int, raw: dict) -> FaultEvent:
             f"fault event #{index}: unknown kind {kind!r} "
             f"(valid: {', '.join(KINDS)})")
     side = raw.get("side", "worker")
-    if side not in ("worker", "coord", "agg"):
+    if side not in ("worker", "coord", "agg", "fleet"):
         raise ValueError(
-            f"fault event #{index}: side must be 'worker', 'coord' "
-            f"or 'agg', got {side!r}")
+            f"fault event #{index}: side must be 'worker', 'coord', "
+            f"'agg' or 'fleet', got {side!r}")
     if kind in COORD_KINDS:
         # coordinator-targeting kinds are coord-side by definition
         side = "coord"
     if kind in AGG_KINDS:
         # aggregator-targeting kinds are agg-side by definition
         side = "agg"
+    if kind in FLEET_KINDS:
+        # pool-targeting kinds are fleet-side by definition
+        side = "fleet"
     if side == "coord" and kind not in (
             "http_error", "delay_ms") + COORD_KINDS:
         raise ValueError(
@@ -196,6 +218,15 @@ def _parse_event(index: int, raw: dict) -> FaultEvent:
         raise ValueError(
             f"fault event #{index}: aggregator-side events support "
             f"agg_kill and agg_restart, not {kind}")
+    if side == "fleet" and kind not in FLEET_KINDS:
+        raise ValueError(
+            f"fault event #{index}: fleet-side events support "
+            f"revoke_host and restore_host, not {kind}")
+    if kind in FLEET_KINDS and raw.get("host") is None \
+            and raw.get("proc") is None:
+        raise ValueError(
+            f"fault event #{index}: {kind} requires a 'host' (pool "
+            f"hostname) or 'proc' (pool-order host index) target")
     triggers = [k for k in _TRIGGERS if k in raw]
     if len(triggers) != 1:
         raise ValueError(
@@ -211,12 +242,12 @@ def _parse_event(index: int, raw: dict) -> FaultEvent:
         raise ValueError(
             f"fault event #{index}: coordinator-side events count "
             f"matching requests via 'after', not {trig_key}")
-    if kind in COORD_KINDS + AGG_KINDS \
+    if kind in COORD_KINDS + AGG_KINDS + FLEET_KINDS \
             and trig_key not in ("after", "after_s"):
         raise ValueError(
             f"fault event #{index}: {kind} triggers on 'after' "
-            f"(n-th service request) or 'after_s' (wall), not "
-            f"{trig_key}")
+            f"(n-th service request / reconcile tick) or 'after_s' "
+            f"(wall), not {trig_key}")
     if kind == "coord_restart" and not raw.get("ms"):
         raise ValueError(
             f"fault event #{index}: coord_restart needs 'ms' > 0 "
@@ -257,7 +288,8 @@ def _parse_event(index: int, raw: dict) -> FaultEvent:
         verb=raw.get("verb"),
         code=int(raw.get("code", 503 if kind == "http_error" else 1)),
         ms=float(raw.get("ms", 0.0)),
-        count=count, p=p, side=side)
+        count=count, p=p, side=side,
+        host=str(raw["host"]) if raw.get("host") is not None else None)
 
 
 def parse_plan(doc, seed_override=None) -> FaultPlan:

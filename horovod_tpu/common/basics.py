@@ -83,6 +83,18 @@ def _elastic_rendezvous(rdv_addr, rdv_port, secret):
         if raw is None:
             continue
         info = json.loads(raw)
+        if info.get("suspended") and info["round"] > _elastic_round:
+            # the fleet controller preempted this job to zero
+            # (docs/fleet.md "Suspension"): the last commit is in the
+            # spill and the control plane stays up — a worker that
+            # outlives its job's suspension self-aborts CLEANLY so the
+            # driver's drain grace never has to SIGTERM it, and the
+            # resumed round restores committed state in fresh workers
+            import logging as _logging
+            _logging.getLogger("horovod_tpu").warning(
+                "job suspended at round %d; exiting cleanly (state "
+                "committed to the spill)", info["round"])
+            raise SystemExit(0)
         if info["round"] <= _elastic_round:
             _time.sleep(0.2)
             continue
@@ -265,6 +277,25 @@ def init(comm=None, process_sets=None, num_ranks=None, devices=None):
                         "HOROVOD_TPU_INIT_TIMEOUT", 60))
                 global _distributed_up
                 _distributed_up = True
+            else:
+                # size-1 round after an IN-PROCESS elastic resize: a
+                # sticky gloo collectives flag from the previous
+                # multi-proc round would make the fresh CPU backend
+                # demand a distributed client that no longer exists
+                # (make_gloo_tcp_collectives(None) TypeError) — reset
+                # it before first backend use
+                try:
+                    current = getattr(
+                        jax.config,
+                        "jax_cpu_collectives_implementation",
+                        None) or jax.config._read(
+                        "jax_cpu_collectives_implementation")
+                    if current == "gloo":
+                        jax.config.update(
+                            "jax_cpu_collectives_implementation",
+                            None)
+                except Exception:  # pragma: no cover - option missing
+                    pass
             # heterogeneous host:slots jobs (reference -H h1:4,h2:2,
             # gloo_run.py:66-103) carry per-process rank counts; the
             # uniform path is the table [num_ranks] * num_procs
@@ -453,6 +484,21 @@ def needs_exec_restart():
         and _distributed_up
 
 
+#: set by shutdown() when the clean-teardown coordination barrier
+#: timed out (a peer never arrived): the abandoned client makes
+#: in-process re-init unsafe
+_teardown_wedged = False
+
+
+def take_teardown_wedged():
+    """True (once) when the last shutdown() abandoned its coordination
+    barrier — the elastic reset must exec-restart instead of
+    re-initializing in-process (docs/fault_tolerance.md)."""
+    global _teardown_wedged
+    wedged, _teardown_wedged = _teardown_wedged, False
+    return wedged
+
+
 def shutdown():
     """Reference horovod_shutdown (operations.cc:966).  In
     multi-process mode also tears down jax.distributed and clears the
@@ -485,12 +531,42 @@ def shutdown():
         if _distributed_up:
             if not was_aborted:
                 # clean teardown: every peer participates in the
-                # coordination-service shutdown barrier
+                # coordination-service shutdown barrier — but BOUNDED.
+                # A peer wedged in a data-plane collective (an armed
+                # bypass vote racing a graceful resize: its agreement
+                # allreduce blocks on us while we block on its
+                # barrier) can never arrive; waiting forever would
+                # deadlock the whole job.  On timeout, abandon the
+                # barrier thread and flag the teardown wedged — the
+                # coordination client is in an unknown state, so the
+                # elastic reset exec-restarts this worker into the
+                # next round (take_teardown_wedged).
+                import threading as _threading
                 import jax
-                try:
-                    jax.distributed.shutdown()
-                except Exception:  # noqa: BLE001 — peers may be gone
-                    pass
+
+                done = _threading.Event()
+
+                def _barrier():
+                    try:
+                        jax.distributed.shutdown()
+                    except Exception:  # noqa: BLE001 — peers gone
+                        pass
+                    done.set()
+
+                _threading.Thread(target=_barrier, daemon=True,
+                                  name="hvd-dist-shutdown").start()
+                budget = env_mod.get_float(
+                    env_mod.HOROVOD_TEARDOWN_BARRIER_SECONDS, 10.0)
+                if not done.wait(budget):
+                    global _teardown_wedged
+                    _teardown_wedged = True
+                    import logging as _logging
+                    _logging.getLogger("horovod_tpu").warning(
+                        "coordination shutdown barrier did not "
+                        "complete within %.1fs (a peer is wedged in "
+                        "a data-plane collective?); abandoning it — "
+                        "this worker will exec-restart into the next "
+                        "round", budget)
             # aborted: a peer is dead — the shutdown barrier would
             # LOG(FATAL) this process.  Leave the client; the elastic
             # loop exec-restarts the process instead (see

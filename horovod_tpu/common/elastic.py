@@ -34,6 +34,20 @@ def _spill_path():
     return os.path.join(d, f"state_{host}_{slot}.pkl")
 
 
+def _count_commit():
+    """One elastic commit into the process-current registry — the
+    training goodput unit the fleet controller aggregates per job off
+    the merged snapshot pushes (docs/fleet.md).  Resolved per call:
+    the engine installs a fresh registry each lifecycle."""
+    try:
+        from .. import telemetry
+        telemetry.registry().counter(
+            telemetry.ELASTIC_COMMITS_FAMILY,
+            telemetry.ELASTIC_COMMITS_HELP).inc()
+    except Exception:  # noqa: BLE001 — accounting must never block a commit
+        pass
+
+
 class State:
     """Base class: save/restore/sync + registered reset callbacks
     (reference common/elastic.py:26-98)."""
@@ -61,6 +75,7 @@ class State:
         commits then raises HostsUpdatedInterrupt at a safe point)."""
         self.save()
         self._spill()
+        _count_commit()
         self.check_host_updates()
 
     # -- crash-durable spill ------------------------------------------------

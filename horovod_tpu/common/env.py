@@ -115,6 +115,13 @@ HOROVOD_SECRET_KEY = "HOROVOD_SECRET_KEY"
 # --elastic-timeout semantics, also a worker-side knob here)
 HOROVOD_STATE_SPILL = "HOROVOD_STATE_SPILL"
 HOROVOD_ELASTIC_TIMEOUT = "HOROVOD_ELASTIC_TIMEOUT"
+# bound on the clean-teardown coordination barrier
+# (jax.distributed.shutdown) during an elastic re-init: a peer wedged
+# in a data-plane collective (e.g. an armed bypass vote racing the
+# resize) can never reach the barrier — after this many seconds the
+# worker abandons it and exec-restarts into the new round instead of
+# deadlocking the whole job (docs/fault_tolerance.md)
+HOROVOD_TEARDOWN_BARRIER_SECONDS = "HOROVOD_TEARDOWN_BARRIER_SECONDS"
 # coordinator journal bounds (runner/http/journal.py): whole-file
 # compaction threshold and the per-value KV journaling cap
 HOROVOD_COORD_JOURNAL_MAX_BYTES = "HOROVOD_COORD_JOURNAL_MAX_BYTES"
@@ -172,6 +179,23 @@ HOROVOD_PP_CHUNKS = "HOROVOD_PP_CHUNKS"
 # JSON file of converged best configs keyed by (bucket signature,
 # topology, world size); jobs reload yesterday's optimum at start
 HOROVOD_AUTOTUNE_CACHE = "HOROVOD_AUTOTUNE_CACHE"
+
+# multi-tenant fleet controller (docs/fleet.md; horovodrun
+# --fleet-spec): the JSON fleet spec source (inline, @path, or bare
+# path), the reconciliation cadence, the controller's own journal
+# (crash-restartable: HOROVOD_FLEET_RESUME=1 replays it), the
+# deterministic preemption/fault evidence log the day-in-the-life
+# gate compares byte-for-byte, the controller's Prometheus port, and
+# the placement debounce/cooldown windows (in reconcile ticks) that
+# keep a resize storm from thrashing rounds.
+HOROVOD_FLEET_SPEC = "HOROVOD_FLEET_SPEC"
+HOROVOD_FLEET_RECONCILE_SECONDS = "HOROVOD_FLEET_RECONCILE_SECONDS"
+HOROVOD_FLEET_JOURNAL = "HOROVOD_FLEET_JOURNAL"
+HOROVOD_FLEET_RESUME = "HOROVOD_FLEET_RESUME"
+HOROVOD_FLEET_EVIDENCE_LOG = "HOROVOD_FLEET_EVIDENCE_LOG"
+HOROVOD_FLEET_METRICS_PORT = "HOROVOD_FLEET_METRICS_PORT"
+HOROVOD_FLEET_SETTLE_TICKS = "HOROVOD_FLEET_SETTLE_TICKS"
+HOROVOD_FLEET_BLACKLIST_TICKS = "HOROVOD_FLEET_BLACKLIST_TICKS"
 
 #: Launcher↔worker handoff ABI: env vars the launcher exports for its
 #: own workers and users never set by hand.  hvdlint checker 5

@@ -22,6 +22,14 @@ def _reset():
         sys.stderr.flush()
         os.execv(sys.executable, [sys.executable] + sys.argv)
     shutdown()
+    if basics.take_teardown_wedged():
+        # the clean-teardown barrier timed out (a peer is wedged in a
+        # data-plane collective): the abandoned coordination client
+        # makes in-process re-init unsafe — restart the interpreter;
+        # committed state restores from the spill like any restart
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os.execv(sys.executable, [sys.executable] + sys.argv)
     init()
 
 

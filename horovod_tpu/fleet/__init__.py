@@ -1,0 +1,25 @@
+"""Multi-tenant fleet controller — ``horovod_tpu.fleet``
+(docs/fleet.md; ``horovodrun --fleet-spec``).
+
+Training and serving jobs co-scheduled on ONE shared host pool with
+preemption-by-elasticity: a serving SLO breach shrinks a training
+job's dp through the elastic target lever, a job preempted to zero
+suspends (journaled, drained at a commit boundary) and resumes from
+its last elastic commit, and host health + chaos revocation apply to
+every job through one mechanism.
+"""
+
+from .spec import (  # noqa: F401
+    FleetOptions, FleetSpec, JobSpec, load_spec, parse_spec,
+)
+from .controller import (  # noqa: F401
+    FleetController, FleetDiscovery, ManagedJob, assign_hosts,
+    size_jobs, DONE, FAILED, PENDING, RUNNING, SUSPENDED,
+)
+
+__all__ = [
+    "FleetController", "FleetDiscovery", "FleetSpec", "FleetOptions",
+    "JobSpec", "ManagedJob", "load_spec", "parse_spec", "size_jobs",
+    "assign_hosts", "PENDING", "RUNNING", "SUSPENDED", "DONE",
+    "FAILED",
+]

@@ -1,0 +1,906 @@
+"""Launcher-side multi-tenant fleet controller (docs/fleet.md).
+
+One process runs N jobs — training + serving mixes, declared in a
+JSON :mod:`fleet spec <.spec>` — over a shared host pool, by composing
+the levers previous subsystems built instead of inventing new ones:
+
+* **placement** walks the pool in declared order and sizes every job
+  between its ``min_np``/``max_np``, serving jobs first (they carry
+  live traffic), a pure deterministic function of (capacity, demands);
+* **preemption-by-elasticity**: a serving job whose SLO signals
+  (windowed p99 / queue depth off the merged snapshot pushes, read by
+  the SAME :class:`~..serving.autoscale.ServingSignals` the per-job
+  autoscaler uses) breach gets chips by *shrinking* a training job's
+  dp through :meth:`ElasticDriver.set_target_np` — never by killing a
+  job that can shrink; idle chips flow back the same way;
+* **suspension**: a training job preempted below ``min_np`` suspends
+  (:meth:`ElasticDriver.suspend` — coordinator journal flushed,
+  workers drained at a commit boundary, committed state in the spill)
+  and later resumes from journal + last elastic commit; suspension is
+  a control-plane pause, not a restart;
+* **fault tolerance composes across jobs**: a host death observed by
+  ANY job's driver blacklists the host for ALL jobs (deterministic
+  tick-based cooldown — the evidence log must be byte-identical
+  across same-seed runs, so no jitter here); the controller journals
+  its own transitions and is restartable from that journal without
+  double-preempting; and chaos gains ``revoke_host``/``restore_host``
+  kinds so a scheduled preemption and a hardware death drill through
+  ONE mechanism.
+
+Each job gets its own RendezvousServer + ElasticDriver; the
+controller feeds every driver through a :class:`FleetDiscovery` (the
+driver's ordinary discovery poll picks placement changes up like any
+membership change) and owns every driver's target lever
+(:meth:`ElasticDriver.acquire_target_lever` — a per-job autoscaler
+racing the fleet serializes out, last-writer-wins by reconcile tick).
+
+``reconcile()`` is one tick and is directly callable — tests and the
+day-in-the-life smoke drive it deterministically; ``run()`` loops it
+on ``HOROVOD_FLEET_RECONCILE_SECONDS``.
+"""
+
+import json
+import logging
+import os
+import threading
+import time
+
+from ..common import env as env_mod
+from ..runner.elastic.discovery import HostDiscovery
+from ..runner.http.journal import CoordJournal
+from ..serving.autoscale import AutoscalePolicy, ServingSignals
+from .. import telemetry
+from .spec import FleetSpec
+
+logger = logging.getLogger("horovod_tpu.fleet")
+
+#: serving goodput unit: requests answered ok (registered by
+#: serving/replica.py; read here off the merged snapshots)
+SERVING_REQUESTS_FAMILY = telemetry.SERVING_REQUESTS_FAMILY
+
+#: job lifecycle states journaled + exported
+PENDING, RUNNING, SUSPENDED, DONE, FAILED = (
+    "pending", "running", "suspended", "done", "failed")
+
+
+class FleetDiscovery(HostDiscovery):
+    """The slice of the shared pool the controller currently assigns
+    to one job, served through the driver's ordinary discovery poll —
+    placement changes reach the driver exactly like real membership
+    changes."""
+
+    def __init__(self, slots=None):
+        self._lock = threading.Lock()
+        self._slots = dict(slots or {})
+
+    def set_slots(self, slots):
+        with self._lock:
+            self._slots = dict(slots)
+
+    def find_available_hosts_and_slots(self):
+        with self._lock:
+            return dict(self._slots)
+
+
+def claim_order(jobs):
+    """THE claim ranking every placement pass shares: serving first,
+    then priority descending, then spec order.  One definition —
+    :func:`size_jobs` and :func:`assign_hosts` walking different
+    rankings would place jobs sized by one order onto hosts by
+    another."""
+    return sorted(
+        range(len(jobs)),
+        key=lambda i: (jobs[i]["kind"] != "serving",
+                       -jobs[i].get("priority", 0), i))
+
+
+def size_jobs(capacity, jobs):
+    """Size every job's worker count from total ``capacity`` slots —
+    a PURE, deterministic function (the placement half the evidence
+    log's byte-identical guarantee rests on).
+
+    ``jobs``: list of dicts with name/kind/min_np/max_np/demand/
+    priority/active, in spec order.  Returns ``{name: np}`` where 0
+    means unplaceable (suspend).  Order of claims: serving first,
+    then priority descending, then spec order.  Three passes:
+    min_np guarantees, then surplus up to each job's demand, then —
+    the preemption-by-elasticity rule — an UNMET serving demand may
+    suspend whole training jobs (lowest claim first): a training job
+    is never left between 0 and min_np, it either runs at >= min_np
+    or suspends to zero."""
+    order = claim_order(jobs)
+    out = {j["name"]: 0 for j in jobs}
+
+    def clamp(j):
+        return max(min(int(j.get("demand", j["min_np"])),
+                       j["max_np"]), j["min_np"])
+
+    remaining = int(capacity)
+    for i in order:
+        j = jobs[i]
+        if not j.get("active", True):
+            continue
+        if j["min_np"] <= remaining:
+            out[j["name"]] = j["min_np"]
+            remaining -= j["min_np"]
+    for i in order:
+        j = jobs[i]
+        if out[j["name"]] == 0:
+            continue
+        take = min(max(clamp(j) - out[j["name"]], 0), remaining)
+        out[j["name"]] += take
+        remaining -= take
+    # preemption pass: serving SLO demand decides who gets chips —
+    # a still-unmet serving claim first drains the pool surplus
+    # (including chips an EARLIER claim's suspension freed — they
+    # must not strand while a later serving job sits under-
+    # provisioned), then suspends training jobs from the lowest-claim
+    # end
+    for i in order:
+        j = jobs[i]
+        if j["kind"] != "serving" or out[j["name"]] == 0:
+            continue
+        need = clamp(j) - out[j["name"]]
+        if need <= 0:
+            continue
+        take = min(need, remaining)
+        out[j["name"]] += take
+        remaining -= take
+        need -= take
+        for v in reversed(order):
+            if need <= 0:
+                break
+            vj = jobs[v]
+            if vj["kind"] != "training" or out[vj["name"]] == 0:
+                continue
+            freed = out[vj["name"]]
+            out[vj["name"]] = 0
+            take = min(freed, need)
+            out[j["name"]] += take
+            remaining += freed - take
+            need -= take
+    return out
+
+
+def assign_hosts(pool, hosts_order, sizes, job_order):
+    """Map job sizes onto concrete ``{job: {host: slots}}`` — hosts
+    walked in declared pool order, jobs in the SAME claim order as
+    :func:`size_jobs`, contiguously, so serving jobs keep the pool
+    front across ticks and churn stays minimal.  Pure/deterministic."""
+    alloc = {name: {} for name in sizes}
+    free = [pool[h] for h in hosts_order]
+    for name in job_order:
+        need = sizes.get(name, 0)
+        for i, host in enumerate(hosts_order):
+            if need <= 0:
+                break
+            take = min(free[i], need)
+            if take > 0:
+                alloc[name][host] = alloc[name].get(host, 0) + take
+                free[i] -= take
+                need -= take
+    return alloc
+
+
+class ManagedJob:
+    """Per-job runtime state inside the controller."""
+
+    def __init__(self, spec):
+        self.spec = spec
+        self.state = PENDING
+        self.np = 0                  # currently allocated slots
+        self.alloc = {}              # {host: slots}
+        self.demand = spec.max_np if spec.kind == "training" \
+            else spec.min_np
+        self.server = None
+        self.driver = None
+        self.discovery = FleetDiscovery()
+        self.signals = None          # ServingSignals (serving jobs)
+        self.policy = None           # AutoscalePolicy (serving jobs)
+        self.started = False
+        self.last_change_tick = -(10 ** 9)
+        self._good_prev = {}         # per-KV-key goodput baselines
+        if spec.kind == "serving":
+            slo = dict(spec.slo or {})
+            self.policy = AutoscalePolicy(
+                slo_p99_ms=float(slo.get("p99_ms", 100.0)),
+                queue_high=int(slo.get("queue_high", 64)),
+                breach_evals=int(slo.get("breach_evals", 2)),
+                idle_evals=int(slo.get("idle_evals", 6)),
+                idle_frac=float(slo.get("idle_frac", 0.25)),
+                idle_queue=int(slo.get("idle_queue", 1)),
+                cooldown_s=float(slo.get("cooldown_s", 30.0)))
+
+    @property
+    def name(self):
+        return self.spec.name
+
+    @property
+    def active(self):
+        return self.state in (PENDING, RUNNING, SUSPENDED)
+
+
+class FleetController:
+    """Reconciliation loop over one shared host pool (docs/fleet.md).
+
+    ``driver_factory(job_spec, discovery, on_event)`` →
+    ``(server, driver)`` — overridable so tests drive the control
+    logic with fakes; the default builds a real RendezvousServer +
+    ElasticDriver per job."""
+
+    LEVER_OWNER = "fleet"
+
+    def __init__(self, spec: FleetSpec, platform=None, verbose=False,
+                 env=None, journal_path=None, evidence_path=None,
+                 resume=None, driver_factory=None, metrics_port=None):
+        self.spec = spec
+        self._platform = platform
+        self._verbose = verbose
+        self._env = dict(env or {})
+        self._journal_path = journal_path if journal_path is not None \
+            else env_mod.get_str(env_mod.HOROVOD_FLEET_JOURNAL)
+        self._evidence_path = evidence_path \
+            if evidence_path is not None \
+            else env_mod.get_str(env_mod.HOROVOD_FLEET_EVIDENCE_LOG)
+        self._resume = env_mod.get_bool(env_mod.HOROVOD_FLEET_RESUME) \
+            if resume is None else bool(resume)
+        self._metrics_port = metrics_port if metrics_port is not None \
+            else env_mod.get_int(env_mod.HOROVOD_FLEET_METRICS_PORT, 0)
+        self.interval_s = env_mod.get_float(
+            env_mod.HOROVOD_FLEET_RECONCILE_SECONDS,
+            spec.options.reconcile_seconds)
+        self._driver_factory = driver_factory or self._build_real_job
+
+        self.jobs = [ManagedJob(j) for j in spec.jobs]
+        self._by_name = {j.name: j for j in self.jobs}
+        self.tick = 0
+        #: fleet-level host health: host -> blacklisted-until tick
+        #: (deterministic cooldown, docs/fleet.md "Host health")
+        self._blacklisted = {}
+        #: hosts removed by chaos revoke_host / a scheduled preemption
+        #: (restored only by restore_host)
+        self._revoked = set()
+        #: host -> first tick it was seen back (settle debounce)
+        self._returning = {}
+        #: queue of (host, cause) failures reported by job drivers
+        self._failed_hosts = []
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread = None
+        self._error = False
+        #: in-memory evidence (deterministic projection; also appended
+        #: to HOROVOD_FLEET_EVIDENCE_LOG as JSON lines)
+        self.decisions = []
+        self.registry = telemetry.MetricRegistry()
+        self._metrics_server = None
+        self._journal = None
+        self._restored = {}
+        if self._journal_path:
+            self._journal = CoordJournal(self._journal_path)
+            if self._resume:
+                self._restored = self._read_journal()
+            elif os.path.exists(self._journal_path):
+                self._journal.truncate()
+        self._fault_states = []
+        # the controller must see the SAME effective environment its
+        # workers inherit (_spawn_worker merges os.environ under the
+        # job env) — `env or os.environ` would hide a shell-exported
+        # HOROVOD_FAULT_PLAN / fault log whenever any env dict was
+        # passed, and the drill would silently half-run
+        self._at_env = dict(os.environ)
+        self._at_env.update(self._env)
+        self._fault_log_path = self._at_env.get(
+            "HOROVOD_FAULT_FLEET_LOG")
+        self._arm_fault_plan()
+
+    # -- construction --------------------------------------------------------
+
+    def _build_real_job(self, job_spec, discovery, on_event):
+        """One real control plane per job: RendezvousServer (with its
+        own coordinator journal when the fleet journal is on) +
+        ElasticDriver reading placement through ``discovery``."""
+        import secrets as _secrets
+        from ..runner.elastic.driver import ElasticDriver
+        from ..runner.http.http_server import (
+            RendezvousServer, autotune_kwargs,
+        )
+
+        at_env = dict(os.environ)
+        at_env.update(self._env)
+        at_env.update(job_spec.env)
+        coord_journal = None
+        if self._journal_path:
+            coord_journal = f"{self._journal_path}.{job_spec.name}.coord"
+        restored = self._restored.get(job_spec.name, {})
+        server = RendezvousServer(
+            secret=_secrets.token_bytes(16), world_size=0,
+            journal_path=coord_journal,
+            journal_replay=bool(restored and coord_journal and
+                                os.path.exists(coord_journal)),
+            **autotune_kwargs(at_env))
+        server.start(port=int(restored.get("port", 0)))
+        env = dict(self._env)
+        env.update(job_spec.env)
+        env.setdefault("HOROVOD_METRICS_PUSH_SECONDS", "1")
+        driver = ElasticDriver(
+            server, discovery, min_np=job_spec.min_np,
+            max_np=job_spec.max_np, command=list(job_spec.command),
+            env=env, platform=self._platform, verbose=self._verbose,
+            on_event=on_event,
+            elastic_timeout=float(
+                at_env.get("HOROVOD_ELASTIC_TIMEOUT") or 600))
+        return server, driver
+
+    def _on_job_event(self, job):
+        def handler(event):
+            # only REAL slot failures blacklist fleet-wide:
+            # worker_failed (the driver's record_failure verdict) and
+            # worker_dead (heartbeat liveness).  Plain worker_exit
+            # also fires for elastic churn (jax peer-loss aborts that
+            # exec-restart) and clean de-assignments — treating those
+            # as host deaths would cascade one resize into a
+            # fleet-wide blacklist storm.
+            if event.get("event") in ("worker_failed", "worker_dead"):
+                with self._lock:
+                    self._failed_hosts.append(
+                        (event.get("host"), job.name))
+        return handler
+
+    def start(self):
+        """Build every job's control plane, run the first placement
+        tick, and start the placed drivers.  Jobs restored as
+        SUSPENDED from the journal stay suspended — a restarted
+        controller must reconcile, not re-preempt."""
+        for job in self.jobs:
+            restored = self._restored.get(job.name)
+            if restored:
+                job.state = restored.get("state", PENDING)
+                job.np = int(restored.get("np", 0))
+                job.demand = int(restored.get("demand", job.demand))
+                if job.state == RUNNING:
+                    # the restarted controller must re-start this
+                    # job's driver; the preserved np/demand make the
+                    # first reconcile reproduce the SAME placement —
+                    # a restart reconciles, it never re-preempts
+                    job.state = PENDING
+                    job.np = 0
+            if not job.active:
+                # restored in a terminal state: no reconcile path
+                # will ever use a control plane — building one would
+                # leak a bound rendezvous service per finished job
+                continue
+            job.server, job.driver = self._driver_factory(
+                job.spec, job.discovery, self._on_job_event(job))
+            if hasattr(job.driver, "acquire_target_lever"):
+                job.driver.acquire_target_lever(self.LEVER_OWNER)
+            if job.server is not None:
+                job.signals = ServingSignals(
+                    job.server,
+                    staleness_s=max(3.0 * self.interval_s, 10.0))
+        if self._metrics_port:
+            self._metrics_server = telemetry.MetricsServer(
+                port=self._metrics_port,
+                registry_fn=lambda: self.registry)
+            self._metrics_server.start()
+        self.reconcile()
+        return self
+
+    def run(self):
+        """Start the background reconcile loop."""
+        self._thread = threading.Thread(
+            target=self._loop, name="horovod_tpu-fleet", daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.reconcile()
+            except Exception:  # noqa: BLE001 — the fleet loop must
+                # survive a bad tick; next tick re-evaluates
+                logger.exception("fleet reconcile failed")
+
+    def join(self, timeout=None):
+        """Block until every job reaches a terminal state (or the
+        controller is stopped).  True when no job failed."""
+        deadline = time.monotonic() + timeout if timeout else None
+        while not self._stop.is_set():
+            if all(not j.active for j in self.jobs):
+                break
+            if deadline and time.monotonic() > deadline:
+                raise TimeoutError("fleet join timed out")
+            time.sleep(0.2)
+        return not self._error and \
+            all(j.state != FAILED for j in self.jobs)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+        for job in self.jobs:
+            try:
+                if job.driver is not None and job.started:
+                    job.driver.stop()
+                    if hasattr(job.driver, "join"):
+                        job.driver.join(timeout=30)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                logger.exception("stopping job %s failed", job.name)
+            try:
+                if job.server is not None:
+                    job.server.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+
+    # -- journal -------------------------------------------------------------
+
+    def _read_journal(self):
+        out = {}
+        for rec in self._journal.read():
+            if rec.get("k") == "fjob":
+                out[rec["name"]] = rec
+            elif rec.get("k") == "fhost":
+                # conservative restore: re-blacklist for a full window
+                # from tick 0 (tick counters restart with the process)
+                if rec.get("st") == "blacklist":
+                    self._blacklisted[rec["host"]] = \
+                        self.spec.options.blacklist_ticks
+                else:
+                    self._blacklisted.pop(rec["host"], None)
+            elif rec.get("k") == "snap":
+                for name, jrec in rec.get("s", {}).get(
+                        "jobs", {}).items():
+                    out[name] = jrec
+        return out
+
+    def _journal_job(self, job):
+        if self._journal is None:
+            return
+        port = None
+        if job.server is not None:
+            port = getattr(job.server, "port", None)
+        self._journal.append({
+            "k": "fjob", "name": job.name, "state": job.state,
+            "np": job.np, "demand": job.demand, "port": port})
+
+    def _journal_host(self, host, state):
+        if self._journal is not None:
+            self._journal.append({"k": "fhost", "host": host,
+                                  "st": state})
+
+    # -- evidence ------------------------------------------------------------
+
+    def _evidence(self, rec, wall=None):
+        """Append one decision to the deterministic evidence log.
+        ``rec`` carries NO wall-clock, measured, or race-ordered
+        fields (same-seed runs must produce byte-identical logs);
+        ``wall`` extras ride only the on-disk line, every key
+        ``t_``-prefixed (the chaos runners' stripping convention —
+        timestamps AND racy attribution like ``t_via``)."""
+        with self._lock:
+            self.decisions.append(dict(rec))
+        logger.warning("fleet: %s", json.dumps(rec, sort_keys=True))
+        if self._evidence_path:
+            try:
+                with open(self._evidence_path, "a") as f:
+                    f.write(json.dumps(
+                        {**rec, **(wall or {})}, sort_keys=True) + "\n")
+            except OSError:
+                pass
+
+    # -- chaos ---------------------------------------------------------------
+
+    def _arm_fault_plan(self):
+        """Install the plan's fleet-side events (revoke_host /
+        restore_host).  Tick triggers (``after``) are evaluated inside
+        :meth:`reconcile` — deterministic across same-seed runs; wall
+        triggers (``after_s``) run on chaos threads."""
+        from ..chaos.plan import plan_from_env
+        from ..chaos.inject import _EventState, _wall_trigger_loop
+
+        plan = plan_from_env(self._at_env)
+        if plan is None:
+            return
+        for e in plan.fleet_events():
+            # loud target validation at ARM time, matching the plan
+            # parser's posture — a typo'd pool index must fail the
+            # launch, not silently drill the wrong host
+            if e.host is not None:
+                if e.host not in self.spec.pool:
+                    raise ValueError(
+                        f"fault plan event #{e.index} ({e.kind}): "
+                        f"host {e.host!r} is not in the fleet pool "
+                        f"{self.spec.pool_hosts}")
+            elif not 0 <= int(e.proc or 0) < len(self.spec.pool_hosts):
+                raise ValueError(
+                    f"fault plan event #{e.index} ({e.kind}): proc "
+                    f"{e.proc} is outside the pool "
+                    f"(hosts: {self.spec.pool_hosts})")
+            st = _EventState(e, plan.rng_for(e))
+            if e.trigger == "wall":
+                t = threading.Thread(
+                    target=_wall_trigger_loop,
+                    args=(st, self._stop, self._fire_fleet_fault),
+                    name="horovod_tpu-chaos-fleet", daemon=True)
+                t.start()
+            else:
+                self._fault_states.append(st)
+        if plan.fleet_events():
+            logger.warning("chaos: %d fleet pool fault(s) armed",
+                           len(plan.fleet_events()))
+
+    def _fault_host(self, event):
+        if event.host is not None:
+            return event.host
+        # index validated at arm time (_arm_fault_plan)
+        return self.spec.pool_hosts[int(event.proc or 0)]
+
+    def _fire_fleet_fault(self, event, n):
+        host = self._fault_host(event)
+        rec = {"e": event.kind, "host": host, "event": event.index,
+               "n": event.at}
+        with self._lock:
+            if event.kind == "revoke_host":
+                self._revoked.add(host)
+            else:
+                self._revoked.discard(host)
+        try:
+            from ..chaos.inject import _count_injected
+            _count_injected(event.kind)
+        except Exception:  # noqa: BLE001
+            pass
+        # wall extras carry the t_ prefix (the chaos runners'
+        # convention): the deterministic projection the byte-compare
+        # strips them by prefix
+        self._evidence(rec, wall={"t_fired": time.time()})
+        if self._fault_log_path:
+            try:
+                with open(self._fault_log_path, "a") as f:
+                    f.write(json.dumps({**rec,
+                                        "t_fired": time.time()},
+                                       sort_keys=True) + "\n")
+            except OSError:
+                pass
+
+    def revoke_host(self, host):
+        """Programmatic preemption drill: remove ``host`` from the
+        pool (same mechanism chaos ``revoke_host`` uses)."""
+        with self._lock:
+            self._revoked.add(host)
+        self._evidence({"e": "revoke_host", "host": host,
+                        "event": -1, "n": self.tick})
+
+    def restore_host(self, host):
+        with self._lock:
+            self._revoked.discard(host)
+        self._evidence({"e": "restore_host", "host": host,
+                        "event": -1, "n": self.tick})
+
+    # -- signals -------------------------------------------------------------
+
+    def _payload_total(self, job, fams):
+        """Goodput units in ONE pushed snapshot: elastic commits for
+        training, ok-requests for serving."""
+        if job.spec.kind == "training":
+            fam = fams.get(telemetry.ELASTIC_COMMITS_FAMILY)
+            if not fam:
+                return 0.0
+            return sum(float(s.get("value", 0.0))
+                       for s in fam.get("samples", []))
+        fam = fams.get(SERVING_REQUESTS_FAMILY)
+        if not fam:
+            return 0.0
+        return sum(float(s.get("value", 0.0))
+                   for s in fam.get("samples", [])
+                   if s.get("labels", {}).get("outcome") == "ok")
+
+    def _observe_job(self, job):
+        """Per-tick observation: goodput deltas into the fleet
+        registry, SLO signals → demand for serving jobs.  Every job
+        (training too) reads its workers' pushed snapshots through a
+        :class:`ServingSignals` — the payload/staleness handling is
+        identical; only serving jobs also extract SLO signals."""
+        if job.signals is None:
+            return
+        payloads = job.signals.fresh_payloads()
+        good = 0.0
+        for key, fams in payloads.items():
+            total = self._payload_total(job, fams)
+            prev = job._good_prev.get(key)
+            if prev is None or total < prev:
+                # first sight of the key, or a COUNTER RESET (every
+                # elastic round installs a fresh worker registry, so
+                # the lifetime total restarts at 0 after a resize or
+                # resume): Prometheus reset semantics — the whole new
+                # total is fresh goodput, clamping it away would
+                # silently freeze the metric after the first resize
+                good += max(total, 0.0)
+            else:
+                good += total - prev
+            job._good_prev[key] = total
+        if good > 0:
+            self.registry.counter(
+                telemetry.FLEET_GOODPUT_FAMILY,
+                telemetry.FLEET_GOODPUT_HELP,
+                labelnames=telemetry.FLEET_GOODPUT_LABELS).labels(
+                job=job.name).inc(good)
+        if job.spec.kind != "serving" or job.policy is None:
+            return
+        p99, queue, seen = (None, 0.0, False)
+        if job.signals is not None:
+            p99, queue, seen = job.signals.read(payloads)
+        breach = (p99 is not None and
+                  p99 > job.policy.slo_p99_s) or \
+            queue > job.policy.queue_high
+        if breach:
+            self.registry.counter(
+                telemetry.FLEET_SLO_BREACH_FAMILY,
+                telemetry.FLEET_SLO_BREACH_HELP,
+                labelnames=telemetry.FLEET_SLO_BREACH_LABELS).labels(
+                job=job.name).inc()
+        if not seen or job.state != RUNNING:
+            return
+        # the policy clock is the reconcile tick (deterministic in
+        # tests/smokes): cooldown_s counts tick-seconds
+        target = job.policy.decide(p99, queue, max(job.np, 1),
+                                   now=self.tick * self.interval_s)
+        job.demand = max(job.spec.min_np,
+                         min(target, job.spec.max_np))
+
+    # -- the reconcile tick --------------------------------------------------
+
+    def _available_pool(self):
+        """Pool minus blacklisted/revoked hosts, with the settle
+        debounce: a host coming back (blacklist expiry or
+        restore_host) only re-enters after ``settle_ticks``
+        consecutive ticks of health — a flapping host (resize storm)
+        re-places once, not once per flap."""
+        pool = {}
+        settle = self.spec.options.settle_ticks
+        with self._lock:
+            return self._available_pool_locked(pool, settle)
+
+    def _available_pool_locked(self, pool, settle):
+        for host, slots in self.spec.pool.items():
+            until = self._blacklisted.get(host)
+            bad = (until is not None and self.tick < until) or \
+                host in self._revoked
+            if bad:
+                self._returning.pop(host, None)
+                continue
+            if until is not None and self.tick >= until:
+                del self._blacklisted[host]
+                self._journal_host(host, "ok")
+            first_ok = self._returning.setdefault(host, self.tick)
+            if self.tick - first_ok < settle and first_ok > 1:
+                continue            # still settling
+            pool[host] = slots
+        return pool
+
+    def reconcile(self):
+        """One reconciliation tick: harvest failures, fire due
+        tick-triggered chaos, observe signals, place, apply diffs.
+        Deterministic given the same signal history and tick count."""
+        with self._lock:
+            self.tick += 1
+            tick = self.tick
+            failed = list(self._failed_hosts)
+            del self._failed_hosts[:]
+        # chaos: tick-triggered pool faults fire BEFORE placement so
+        # the tick they name is the tick that re-places
+        for st in self._fault_states:
+            if not st.exhausted and st.due(tick):
+                self._fire_fleet_fault(st.event, tick)
+        # host deaths reported by any job blacklist for ALL jobs.
+        # The reporting job rides the on-disk extras only: with two
+        # jobs co-located on a dying host, WHICH driver reports first
+        # is a thread race — the byte-compared projection must not
+        # carry it
+        for host, via in failed:
+            if host is None:
+                continue
+            with self._lock:
+                already = self._blacklisted.get(host)
+                if already is not None and self.tick < already:
+                    continue
+                self._blacklisted[host] = \
+                    tick + self.spec.options.blacklist_ticks
+                self._returning.pop(host, None)
+            self._journal_host(host, "blacklist")
+            self._evidence({"e": "blacklist", "host": host},
+                           wall={"t_via": via})
+        # lifecycle: finished/failed drivers leave the pool
+        for job in self.jobs:
+            if job.started and job.driver is not None and \
+                    job.state in (RUNNING,) and \
+                    hasattr(job.driver, "finished") and \
+                    job.driver.finished():
+                ok = True
+                if hasattr(job.driver, "_error"):
+                    ok = not job.driver._error
+                job.state = DONE if ok else FAILED
+                job.np = 0
+                job.alloc = {}
+                job.discovery.set_slots({})
+                self._journal_job(job)
+                self._evidence({"e": "done" if ok else "failed",
+                                "job": job.name})
+        # observe signals + goodput
+        for job in self.jobs:
+            if job.active and job.started:
+                try:
+                    self._observe_job(job)
+                except Exception:  # noqa: BLE001 — a job's telemetry
+                    # must never wedge the fleet tick
+                    logger.exception("observing job %s failed",
+                                     job.name)
+        # place
+        pool = self._available_pool()
+        capacity = sum(pool.values())
+        jobs_in = [{"name": j.name, "kind": j.spec.kind,
+                    "min_np": j.spec.min_np, "max_np": j.spec.max_np,
+                    "demand": j.demand,
+                    "priority": j.spec.priority,
+                    "active": j.active}
+                   for j in self.jobs]
+        sizes = size_jobs(capacity, jobs_in)
+        order = claim_order(jobs_in)
+        alloc = assign_hosts(pool, [h for h in self.spec.pool_hosts
+                                    if h in pool],
+                             sizes, [jobs_in[i]["name"] for i in order])
+        # apply diffs in SPEC order (stable evidence ordering)
+        for job in self.jobs:
+            if not job.active:
+                continue
+            self._apply_placement(job, sizes[job.name],
+                                  alloc[job.name], tick)
+        self._export_gauges()
+
+    def _apply_placement(self, job, np, host_slots, tick):
+        """Diff one job's placement against its current state and
+        drive the levers: discovery view + ``set_target_np`` (epoch =
+        the reconcile tick — last-writer-wins across controller
+        generations), suspend on preempt-to-zero, resume when
+        capacity returns."""
+        opts = self.spec.options
+        grew = np > job.np
+        if np == job.np and job.state in (RUNNING, SUSPENDED):
+            if np > 0 and host_slots != job.alloc:
+                # same size, different hosts (a blacklist/revoke hit
+                # this job): a capacity substitution, applied now
+                job.alloc = dict(host_slots)
+                job.discovery.set_slots(host_slots)
+            return
+        # discretionary growth is rate-limited for TRAINING jobs (the
+        # greedy idle-chip reclaim must not thrash rounds when
+        # capacity flaps); serving growth is already hysteretic at the
+        # demand level (AutoscalePolicy breach streaks + cooldown),
+        # and capacity loss / SLO shrink always apply immediately
+        if grew and job.spec.kind == "training" and \
+                job.state == RUNNING and \
+                tick - job.last_change_tick < opts.cooldown_ticks:
+            return
+        if np < job.np:
+            # a shrink the job's own demand explains is an idle
+            # give-back; otherwise another job (or a host loss) took
+            # the chips — a preemption
+            cause = "idle" if job.demand <= np else "capacity"
+        else:
+            cause = "demand"
+        if job.state == PENDING and np >= job.spec.min_np:
+            job.np, job.alloc = np, dict(host_slots)
+            job.discovery.set_slots(host_slots)
+            self._start_job(job, np, tick, cause="init")
+            return
+        if np == 0 and job.state == RUNNING:
+            # preemption to zero: suspend, never kill
+            job.np, job.alloc = 0, {}
+            job.discovery.set_slots({})
+            if hasattr(job.driver, "suspend"):
+                job.driver.suspend()
+            job.state = SUSPENDED
+            job.last_change_tick = tick
+            self._journal_job(job)
+            self._count_action(job, "suspend")
+            self._evidence({"e": "suspend", "job": job.name})
+            return
+        if np >= job.spec.min_np and job.state == SUSPENDED:
+            job.np, job.alloc = np, dict(host_slots)
+            job.discovery.set_slots(host_slots)
+            if hasattr(job.driver, "refresh_hosts"):
+                job.driver.refresh_hosts()
+            if hasattr(job.driver, "set_target_np"):
+                job.driver.set_target_np(np, owner=self.LEVER_OWNER,
+                                         epoch=tick)
+            if job.started:
+                if hasattr(job.driver, "unsuspend"):
+                    job.driver.unsuspend()
+                job.state = RUNNING
+            else:
+                # resumed under a RESTARTED controller: the fresh
+                # driver was never started — start it now; workers
+                # restore the last elastic commit from the spill
+                self._start_job(job, np, tick, cause="resume",
+                                evidence=False)
+            job.last_change_tick = tick
+            self._journal_job(job)
+            self._count_action(job, "resume")
+            self._evidence({"e": "resume", "job": job.name,
+                            "np": np})
+            return
+        if job.state != RUNNING or np < job.spec.min_np:
+            return
+        # ordinary grow/shrink through the elasticity lever; the
+        # synchronous host refresh makes the lever compute its
+        # effective size against the placement view we just wrote,
+        # not the discovery thread's cache (no transient round on a
+        # just-revoked host)
+        prev = job.np
+        job.np, job.alloc = np, dict(host_slots)
+        job.discovery.set_slots(host_slots)
+        if hasattr(job.driver, "refresh_hosts"):
+            job.driver.refresh_hosts()
+        if hasattr(job.driver, "set_target_np"):
+            job.driver.set_target_np(np, owner=self.LEVER_OWNER,
+                                     epoch=tick)
+        job.last_change_tick = tick
+        self._journal_job(job)
+        self._count_action(job, "grow" if np > prev else "shrink")
+        self._evidence({"e": "place", "job": job.name, "np": np,
+                        "cause": cause})
+
+    def _start_job(self, job, np, tick, cause, evidence=True):
+        if hasattr(job.driver, "set_target_np"):
+            job.driver.set_target_np(np, owner=self.LEVER_OWNER,
+                                     epoch=tick)
+        try:
+            if hasattr(job.driver, "start") and not job.started:
+                job.driver.start(start_timeout=300)
+            job.started = True
+            job.state = RUNNING
+        except Exception:  # noqa: BLE001 — a job that cannot start is
+            # failed, not fatal to the fleet
+            logger.exception("starting job %s failed", job.name)
+            job.state = FAILED
+            self._error = True
+        job.last_change_tick = tick
+        self._journal_job(job)
+        if evidence:
+            self._evidence({"e": "place", "job": job.name, "np": np,
+                            "cause": cause})
+
+    def _count_action(self, job, action):
+        self.registry.counter(
+            telemetry.FLEET_PREEMPTIONS_FAMILY,
+            telemetry.FLEET_PREEMPTIONS_HELP,
+            labelnames=telemetry.FLEET_PREEMPTIONS_LABELS).labels(
+            job=job.name, action=action).inc()
+
+    def _export_gauges(self):
+        chips = self.registry.gauge(
+            telemetry.FLEET_CHIPS_FAMILY, telemetry.FLEET_CHIPS_HELP,
+            labelnames=telemetry.FLEET_CHIPS_LABELS)
+        up = self.registry.gauge(
+            telemetry.FLEET_JOB_RUNNING_FAMILY,
+            telemetry.FLEET_JOB_RUNNING_HELP,
+            labelnames=telemetry.FLEET_JOB_RUNNING_LABELS)
+        for job in self.jobs:
+            chips.labels(job=job.name).set(float(job.np))
+            up.labels(job=job.name).set(
+                1.0 if job.state == RUNNING else 0.0)
+
+    # -- introspection -------------------------------------------------------
+
+    def snapshot(self):
+        """JSON-able fleet state (tests + the smoke read this)."""
+        with self._lock:
+            return {
+                "tick": self.tick,
+                "jobs": {j.name: {"state": j.state, "np": j.np,
+                                  "demand": j.demand,
+                                  "alloc": dict(j.alloc)}
+                         for j in self.jobs},
+                "blacklisted": dict(self._blacklisted),
+                "revoked": sorted(self._revoked),
+            }

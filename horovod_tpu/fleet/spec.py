@@ -1,0 +1,184 @@
+"""Fleet spec: N jobs declared over ONE shared host pool
+(docs/fleet.md).
+
+A fleet spec is a JSON document (``horovodrun --fleet-spec`` — inline
+JSON, ``@/path``, or a bare path, the same source grammar as fault
+plans)::
+
+    {
+      "pool": {"host-a": 4, "host-b": 4},
+      "options": {"reconcile_seconds": 2.0, "settle_ticks": 3,
+                  "cooldown_ticks": 10, "blacklist_ticks": 30},
+      "jobs": [
+        {"name": "serve", "kind": "serving", "min_np": 1, "max_np": 4,
+         "priority": 10,
+         "command": ["python", "serve_worker.py"],
+         "slo": {"p99_ms": 50, "queue_high": 8, "breach_evals": 2,
+                 "idle_evals": 6},
+         "env": {"HOROVOD_SERVING": "1"}},
+        {"name": "train", "kind": "training", "min_np": 2, "max_np": 6,
+         "priority": 0,
+         "command": ["python", "train_worker.py"]}
+      ]
+    }
+
+Semantics the controller enforces (docs/fleet.md "Reconciliation"):
+
+* every job is guaranteed ``min_np`` while pool capacity allows —
+  serving jobs first (they carry live traffic), then by descending
+  ``priority``, then spec order;
+* surplus capacity goes to each job's *demand* in the same order —
+  a serving job's demand moves with its SLO signals, a training
+  job's demand is ``max_np`` (training soaks up idle chips and
+  returns them on demand: preemption-by-elasticity);
+* a training job whose ``min_np`` cannot be met is **suspended**
+  (preempted to zero — a control-plane pause, never a kill); it
+  resumes when capacity returns.
+"""
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..chaos.plan import read_plan_source
+
+JOB_KINDS = ("training", "serving")
+
+
+@dataclass
+class JobSpec:
+    """One job of the fleet."""
+
+    name: str
+    kind: str                       # training | serving
+    command: List[str]
+    min_np: int = 1
+    max_np: int = 1
+    priority: int = 0               # higher = earlier claim on chips
+    env: Dict[str, str] = field(default_factory=dict)
+    #: serving-only SLO policy knobs (AutoscalePolicy spellings):
+    #: p99_ms, queue_high, breach_evals, idle_evals, idle_frac,
+    #: idle_queue, cooldown_s
+    slo: Optional[dict] = None
+
+
+@dataclass
+class FleetOptions:
+    """Controller cadence + debounce windows (tick = one reconcile)."""
+
+    reconcile_seconds: float = 2.0
+    #: a restored/resurrected host only re-enters placement after this
+    #: many consecutive ticks of presence — the resize-storm debounce
+    settle_ticks: int = 2
+    #: minimum ticks between successive DISCRETIONARY reconfigurations
+    #: of one job (capacity-loss shrinks are never delayed)
+    cooldown_ticks: int = 5
+    #: fleet-level blacklist duration, in ticks (deterministic — no
+    #: jitter: the evidence log must be byte-identical across
+    #: same-seed runs)
+    blacklist_ticks: int = 30
+
+
+@dataclass
+class FleetSpec:
+    pool: Dict[str, int]
+    jobs: List[JobSpec]
+    options: FleetOptions = field(default_factory=FleetOptions)
+
+    def job(self, name: str) -> JobSpec:
+        for j in self.jobs:
+            if j.name == name:
+                return j
+        raise KeyError(name)
+
+    @property
+    def pool_hosts(self) -> List[str]:
+        """Pool hosts in DECLARED order — the stable order placement
+        walks and chaos ``proc`` indices address."""
+        return list(self.pool.keys())
+
+
+def _parse_job(i: int, raw: dict) -> JobSpec:
+    if not isinstance(raw, dict):
+        raise ValueError(f"fleet job #{i} is not an object: {raw!r}")
+    name = raw.get("name")
+    if not name or not isinstance(name, str):
+        raise ValueError(f"fleet job #{i}: 'name' (string) required")
+    kind = raw.get("kind", "training")
+    if kind not in JOB_KINDS:
+        raise ValueError(
+            f"fleet job {name!r}: kind must be one of "
+            f"{', '.join(JOB_KINDS)}, got {kind!r}")
+    command = raw.get("command")
+    if not command or not isinstance(command, list) or \
+            not all(isinstance(c, str) for c in command):
+        raise ValueError(
+            f"fleet job {name!r}: 'command' (list of strings) required")
+    min_np = int(raw.get("min_np", 1))
+    max_np = int(raw.get("max_np", min_np))
+    if min_np < 1 or max_np < min_np:
+        raise ValueError(
+            f"fleet job {name!r}: need 1 <= min_np <= max_np "
+            f"(got {min_np}/{max_np})")
+    env = raw.get("env", {})
+    if not isinstance(env, dict):
+        raise ValueError(f"fleet job {name!r}: 'env' must be an object")
+    slo = raw.get("slo")
+    if slo is not None:
+        if kind != "serving":
+            raise ValueError(
+                f"fleet job {name!r}: 'slo' is only valid on serving "
+                f"jobs")
+        if not isinstance(slo, dict):
+            raise ValueError(f"fleet job {name!r}: 'slo' must be an "
+                             f"object")
+    return JobSpec(name=name, kind=kind, command=list(command),
+                   min_np=min_np, max_np=max_np,
+                   priority=int(raw.get("priority", 0)),
+                   env={str(k): str(v) for k, v in env.items()},
+                   slo=slo)
+
+
+def parse_spec(doc) -> FleetSpec:
+    """Parse + validate a fleet spec from a dict or JSON string."""
+    if isinstance(doc, (str, bytes)):
+        doc = json.loads(doc)
+    if not isinstance(doc, dict):
+        raise ValueError(
+            f"fleet spec must be a JSON object, got "
+            f"{type(doc).__name__}")
+    pool = doc.get("pool")
+    if not pool or not isinstance(pool, dict):
+        raise ValueError("fleet spec: 'pool' ({host: slots}) required")
+    pool = {str(h): int(s) for h, s in pool.items()}
+    if any(s < 1 for s in pool.values()):
+        raise ValueError("fleet spec: every pool host needs >= 1 slot")
+    raw_jobs = doc.get("jobs")
+    if not raw_jobs or not isinstance(raw_jobs, list):
+        raise ValueError("fleet spec: 'jobs' (non-empty list) required")
+    jobs = [_parse_job(i, j) for i, j in enumerate(raw_jobs)]
+    names = [j.name for j in jobs]
+    if len(set(names)) != len(names):
+        raise ValueError(f"fleet spec: duplicate job names in {names}")
+    opts_raw = doc.get("options", {})
+    if not isinstance(opts_raw, dict):
+        raise ValueError("fleet spec: 'options' must be an object")
+    opts = FleetOptions(
+        reconcile_seconds=float(opts_raw.get("reconcile_seconds", 2.0)),
+        settle_ticks=int(opts_raw.get("settle_ticks", 2)),
+        cooldown_ticks=int(opts_raw.get("cooldown_ticks", 5)),
+        blacklist_ticks=int(opts_raw.get("blacklist_ticks", 30)))
+    total_min = sum(j.min_np for j in jobs if j.kind == "serving")
+    capacity = sum(pool.values())
+    if total_min > capacity:
+        raise ValueError(
+            f"fleet spec: serving jobs' min_np sum ({total_min}) "
+            f"exceeds pool capacity ({capacity}) — nothing could ever "
+            f"be placed")
+    return FleetSpec(pool=pool, jobs=jobs, options=opts)
+
+
+def load_spec(source: str) -> FleetSpec:
+    """Load a spec from inline JSON, ``@/path``, or a bare file path
+    (the same source grammar as fault plans)."""
+    return parse_spec(read_plan_source(source))

@@ -67,6 +67,18 @@ class ElasticDriver:
         # autoscale lever (serving/autoscale.py): rounds are sized
         # min(available slots, _target_np); starts wide open
         self._target_np = max_np
+        # multi-caller lever arbitration (docs/fleet.md): once an
+        # owner claims the lever (the fleet controller), calls from
+        # other writers are ignored, and a tagged write with a stale
+        # epoch loses to the last accepted one — two racing callers
+        # serialize into last-writer-wins instead of ping-ponging the
+        # fleet through competing rounds
+        self._lever_owner = None
+        self._lever_epoch = -1
+        # preemption-to-zero (docs/fleet.md "Suspension"): a suspended
+        # job keeps its control plane (server, journal, spill) but
+        # forms no rounds and drains its workers at a commit boundary
+        self._suspended = False
         self._round = 0
         self._round_started_at = 0.0
         self._assignments: Dict[str, int] = {}
@@ -172,14 +184,62 @@ class ElasticDriver:
         with self._lock:
             return len(self._assignments)
 
-    def set_target_np(self, target: int) -> int:
+    def refresh_hosts(self) -> bool:
+        """Synchronously re-poll discovery; True when membership
+        changed.  The fleet controller calls this right after moving a
+        job's placement view so the set_target_np that follows
+        computes its effective size against the NEW hosts instead of
+        the discovery thread's 1s-cadence cache (a shrink racing the
+        cache would otherwise form a transient round on a host the
+        controller just revoked).  Cheap for in-memory discoveries
+        (FleetDiscovery); script-based discoveries pay one script run."""
+        return self._host_manager.update_available_hosts()
+
+    def acquire_target_lever(self, owner: str):
+        """Claim exclusive ownership of the ``set_target_np`` lever
+        (docs/fleet.md): after this, only calls tagged with ``owner``
+        move the target — a per-job autoscaler racing the fleet
+        controller is serialized out instead of re-forming rounds the
+        fleet immediately undoes."""
+        with self._lock:
+            self._lever_owner = owner
+
+    def release_target_lever(self):
+        with self._lock:
+            self._lever_owner = None
+            self._lever_epoch = -1
+
+    def set_target_np(self, target: int, owner: str = None,
+                      epoch: int = None) -> int:
         """Autoscale lever (serving/autoscale.py): retarget the fleet
         to ``target`` workers, clamped to [min_np, max_np], and
         re-form the round exactly like a membership change — scale-up
         claims available slots, scale-down de-assigns workers (they
         get the usual drain grace before termination).  Returns the
-        clamped target.  A no-op target keeps the current round."""
+        accepted target (the CURRENT target when the write was
+        rejected).  A no-op target keeps the current round.
+
+        Multi-caller arbitration: when an owner holds the lever
+        (:meth:`acquire_target_lever`), writes from anyone else are
+        ignored; ``epoch``-tagged writes are last-writer-wins — a
+        write whose epoch is below the last accepted one is stale and
+        dropped (two callers racing the lever resolve to the newest
+        decision instead of interleaving rounds)."""
         with self._lock:
+            if self._lever_owner is not None and \
+                    owner != self._lever_owner:
+                logger.info(
+                    "set_target_np(%s) from %r ignored: lever owned "
+                    "by %r", target, owner, self._lever_owner)
+                return self._target_np
+            if epoch is not None:
+                if epoch < self._lever_epoch:
+                    logger.info(
+                        "set_target_np(%s) epoch %d is stale "
+                        "(last accepted %d); dropped", target, epoch,
+                        self._lever_epoch)
+                    return self._target_np
+                self._lever_epoch = epoch
             target = max(self._min_np, min(int(target), self._max_np))
             if target == self._target_np:
                 return target
@@ -202,8 +262,74 @@ class ElasticDriver:
             self._start_round()
         return target
 
+    # -- suspension (docs/fleet.md "Suspension"): preemption to zero is
+    #    a control-plane pause, not a restart ------------------------------
+
+    @property
+    def suspended(self) -> bool:
+        return self._suspended
+
+    def suspend(self, drain_grace: float = 30.0):
+        """Preempt the job to ZERO workers while keeping its control
+        plane: publish a ``suspended`` round so every worker drains at
+        its next commit boundary (the committed state is already in
+        the spill; the worker self-aborts cleanly — see
+        ``basics._elastic_rendezvous``), journal the transition through
+        the coordinator (a ``reset`` at size 0 — a later
+        journal-restarted coordinator restores into the suspended
+        shape), and stop forming rounds until :meth:`unsuspend`.
+        Workers that miss the drain grace are terminated; their state
+        survives in the spill either way."""
+        with self._lock:
+            if self._suspended:
+                return
+            self._suspended = True
+            self._round += 1
+            self._assignments = {}
+            self._slots_by_key = {}
+            round_info = {"round": self._round, "size": 0,
+                          "suspended": True, "assignments": {}}
+            self._server.store.put(ROUND_KEY,
+                                   json.dumps(round_info).encode())
+            self._notify_version += 1
+            self._server.store.put(
+                NOTIFY_KEY,
+                json.dumps({"version": self._notify_version,
+                            "round": self._round,
+                            "suspended": True}).encode())
+            # flush the suspension into the coordinator journal: the
+            # round reset is a journaled transition, so a coordinator
+            # (or fleet-controller) restart while suspended rebuilds
+            # the paused control plane, not a live round
+            self._server.coordinator.reset(world_size=0,
+                                           round_id=self._round)
+            now = time.monotonic()
+            for key, p in list(self._procs.items()):
+                if p.poll() is None:
+                    self._deassigned.setdefault(key, now + drain_grace)
+        logger.warning("job suspended at round %d (workers draining "
+                       "at their next commit)", self._round)
+        self._emit("suspend", round=self._round)
+
+    def unsuspend(self):
+        """Resume a suspended job: re-form a round from the current
+        target + discovery.  Fresh workers restore the last elastic
+        commit from the spill, and the coordinator's journal/epoch
+        machinery fences any restart that happened while paused — the
+        resumed job continues from the committed step."""
+        with self._lock:
+            if not self._suspended:
+                return
+            self._suspended = False
+        logger.warning("job resuming from suspension")
+        self._emit("resume", round=self._round)
+        self._host_manager.update_available_hosts()
+        self._start_round()
+
     def _start_round(self):
         with self._lock:
+            if self._suspended:
+                return
             slots = self._compute_assignments()
             if len(slots) < self._min_np:
                 logger.warning(
@@ -477,6 +603,13 @@ class ElasticDriver:
                     else:
                         logger.warning("worker %s exited with %d",
                                        key, code)
+                        # distinct from worker_exit (which ALSO fires
+                        # for churn/clean exits): this is the event
+                        # consumers like the fleet controller treat as
+                        # a real slot failure (docs/fleet.md)
+                        self._emit("worker_failed", host=host,
+                                   slot=int(slot), code=code,
+                                   round=self._round)
                         self._registry.record_failure(host, int(slot))
                         failed_hosts.append(host)
                 # coordinator liveness feed: a proc whose heartbeats

@@ -1,0 +1,45 @@
+"""Fleet job entry — ``horovodrun --fleet-spec`` (docs/fleet.md).
+
+Unlike a single elastic job (elastic_run.py), a fleet launch reads a
+JSON spec declaring N jobs over one shared host pool and hands the
+whole lifecycle to the :class:`~horovod_tpu.fleet.FleetController`:
+per-job rendezvous services + elastic drivers, reconciliation,
+preemption-by-elasticity, suspension, and the journaled-restart path
+(``HOROVOD_FLEET_RESUME=1`` replays ``HOROVOD_FLEET_JOURNAL``).
+"""
+
+from ..common import env as env_mod
+from .config_parser import set_env_from_args
+
+
+def run_fleet(args):
+    import sys
+
+    from ..fleet import load_spec, FleetController
+
+    source = args.fleet_spec or env_mod.get_str(
+        env_mod.HOROVOD_FLEET_SPEC)
+    if not source:
+        print("horovodrun: --fleet-spec (or HOROVOD_FLEET_SPEC) "
+              "required for a fleet launch", file=sys.stderr)
+        return 2
+    try:
+        spec = load_spec(source)
+    except (ValueError, OSError) as exc:
+        print(f"horovodrun: invalid fleet spec: {exc}",
+              file=sys.stderr)
+        return 2
+    env = {}
+    set_env_from_args(env, args)
+    controller = FleetController(
+        spec, platform="cpu" if args.cpu else None,
+        verbose=args.verbose, env=env)
+    controller.start()
+    controller.run()
+    try:
+        ok = controller.join()
+    except KeyboardInterrupt:
+        ok = False
+    finally:
+        controller.stop()
+    return 0 if ok else 1

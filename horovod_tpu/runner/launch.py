@@ -27,6 +27,9 @@ _LAUNCHER_ONLY_FLAGS = (
     # for the workers' init barrier)
     "min_np", "max_np", "host_discovery_script", "slots_per_host",
     "reset_limit", "blacklist_cooldown_range",
+    # fleet controller (consumed launcher-side by fleet_run.py /
+    # fleet/controller.py; per-job commands live in the spec)
+    "fleet_spec",
     "command",
 )
 
@@ -327,6 +330,14 @@ def parse_args(argv=None):
                              "default 600)")
     parser.add_argument("--blacklist-cooldown-range", type=int, nargs=2,
                         default=None)
+    # multi-tenant fleet (docs/fleet.md): N jobs over one shared host
+    # pool; per-job commands/env live in the spec, so the ordinary
+    # -np/command surface is not used
+    parser.add_argument("--fleet-spec", default=None,
+                        help="JSON fleet spec (inline, @/path, or a "
+                             "bare path): jobs + shared host pool for "
+                             "the multi-tenant fleet controller "
+                             "(HOROVOD_FLEET_SPEC); see docs/fleet.md")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="Command to run on each rank.")
     args = parser.parse_args(argv)
@@ -385,6 +396,10 @@ def run_commandline(argv=None):
     if args.check_build:
         check_build()
         return 0
+    if getattr(args, "fleet_spec", None):
+        # fleet launches carry their jobs' commands in the spec
+        from .fleet_run import run_fleet
+        return run_fleet(args)
     if not args.command:
         print("horovodrun: no command given", file=sys.stderr)
         return 2

@@ -166,4 +166,13 @@ def serve_forever(predict_fn, params=None, checkpoint=None,
             sys.stderr.flush()
             os.execv(sys.executable, [sys.executable] + sys.argv)
         basics.shutdown()
+        if basics.take_teardown_wedged():
+            # clean-teardown barrier timed out (a peer wedged in a
+            # data-plane collective): same escape as elastic.run —
+            # a fresh interpreter joins the next round
+            logger.warning("serving replica exec-restarting after a "
+                           "wedged teardown barrier")
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os.execv(sys.executable, [sys.executable] + sys.argv)
         basics.init()

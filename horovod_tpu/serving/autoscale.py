@@ -32,7 +32,8 @@ import time
 
 logger = logging.getLogger("horovod_tpu.serving")
 
-__all__ = ["quantile_from_buckets", "AutoscalePolicy", "Autoscaler"]
+__all__ = ["quantile_from_buckets", "AutoscalePolicy", "Autoscaler",
+           "ServingSignals"]
 
 
 def quantile_from_buckets(bounds, counts, q):
@@ -117,28 +118,24 @@ class AutoscalePolicy:
         return current
 
 
-class Autoscaler:
-    """Launcher-side loop: replica metric stream → policy → elastic
-    driver.  ``driver`` needs ``set_target_np(n)`` and
-    ``current_world_size()`` (ElasticDriver); ``store`` is the
-    launcher's KV store the replicas push snapshots into."""
+class ServingSignals:
+    """Launcher-side SLO signal reader: the replicas' pushed metric
+    snapshots → (windowed p99, max queue depth).  Factored out of the
+    :class:`Autoscaler` so the fleet controller (docs/fleet.md) reads
+    the SAME signals off each serving job's KV store that the per-job
+    autoscaler would — one definition of what "the SLO is breached"
+    means.  ``store`` may be a KV store or a RendezvousServer (always
+    dereferenced live: a journal restart swaps the store object)."""
 
     LATENCY_FAMILY = "horovod_serving_request_seconds"
     QUEUE_FAMILY = "horovod_serving_queue_depth"
 
-    def __init__(self, driver, store, policy=None, interval_s=5.0):
-        self.driver = driver
-        # accept a RendezvousServer too, and ALWAYS dereference its
-        # live store per read: restart_from_journal swaps the store
-        # object, and a captured reference would read a dead one
-        # forever (the same contract ElasticDriver follows)
+    def __init__(self, store, staleness_s=15.0):
         self._store_owner = store if hasattr(store, "store") else None
         self._store = None if self._store_owner is not None else store
-        self.policy = policy or AutoscalePolicy()
-        self.interval_s = max(float(interval_s), 0.5)
         #: how long a snapshot's bytes may stay unchanged before it is
         #: treated as a dead replica's frozen last push
-        self.staleness_s = max(3.0 * self.interval_s, 10.0)
+        self.staleness_s = float(staleness_s)
         #: per-KV-key cumulative latency counts (window deltas are
         #: PER REPLICA: a replica whose snapshot re-enters the merge
         #: must not inject its whole lifetime into one window)
@@ -146,30 +143,13 @@ class Autoscaler:
         #: per-KV-key (raw bytes, last-changed LAUNCHER monotonic) —
         #: the staleness clock; never compares cross-host wall clocks
         self._seen = {}
-        self._stop = threading.Event()
-        self._thread = threading.Thread(
-            target=self._loop, name="horovod_tpu-serving-autoscale",
-            daemon=True)
-        #: decision log (bounded) — surfaced in driver events/tests
-        self.decisions = []
 
     @property
     def store(self):
         return self._store_owner.store \
             if self._store_owner is not None else self._store
 
-    def start(self):
-        self._thread.start()
-        return self
-
-    def stop(self):
-        self._stop.set()
-        if self._thread.is_alive():
-            self._thread.join(timeout=5.0)
-
-    # -- signal extraction ---------------------------------------------------
-
-    def _fresh_payloads(self):
+    def fresh_payloads(self):
         """{kv key: families} for snapshots still being PUSHED.
 
         Staleness is judged on the LAUNCHER's monotonic clock — a
@@ -200,14 +180,14 @@ class Autoscaler:
                 continue
         return out
 
-    def read_signals(self, payloads=None):
+    def read(self, payloads=None):
         """(p99 seconds over the last window or None, max queue depth,
         any-serving-telemetry-seen) from the replicas' fresh
         snapshots.  Window deltas are tracked per replica key so a
         snapshot (re)entering the set only contributes what it
         observed since its last inclusion — never its whole lifetime
         in one "window"."""
-        payloads = self._fresh_payloads() if payloads is None \
+        payloads = self.fresh_payloads() if payloads is None \
             else payloads
         p99 = None
         seen_serving = False
@@ -243,6 +223,61 @@ class Autoscaler:
             p99 = quantile_from_buckets(bounds, window, 0.99)
         return p99, queue, seen_serving
 
+
+class Autoscaler:
+    """Launcher-side loop: replica metric stream → policy → elastic
+    driver.  ``driver`` needs ``set_target_np(n)`` and
+    ``current_world_size()`` (ElasticDriver); ``store`` is the
+    launcher's KV store the replicas push snapshots into.  Signal
+    extraction lives in :class:`ServingSignals` (shared with the
+    fleet controller); this class owns the policy loop and the
+    lever.  Lever writes carry ``owner="autoscale"`` so a fleet
+    controller that claimed the lever serializes this caller out
+    (docs/fleet.md "Lever arbitration")."""
+
+    LATENCY_FAMILY = ServingSignals.LATENCY_FAMILY
+    QUEUE_FAMILY = ServingSignals.QUEUE_FAMILY
+
+    LEVER_OWNER = "autoscale"
+
+    def __init__(self, driver, store, policy=None, interval_s=5.0):
+        self.driver = driver
+        self.policy = policy or AutoscalePolicy()
+        self.interval_s = max(float(interval_s), 0.5)
+        self.signals = ServingSignals(
+            store, staleness_s=max(3.0 * self.interval_s, 10.0))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="horovod_tpu-serving-autoscale",
+            daemon=True)
+        #: decision log (bounded) — surfaced in driver events/tests
+        self.decisions = []
+
+    @property
+    def store(self):
+        return self.signals.store
+
+    @property
+    def staleness_s(self):
+        return self.signals.staleness_s
+
+    @staleness_s.setter
+    def staleness_s(self, value):
+        self.signals.staleness_s = float(value)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+
+    def read_signals(self, payloads=None):
+        """Back-compat alias for :meth:`ServingSignals.read`."""
+        return self.signals.read(payloads)
+
     # -- loop ----------------------------------------------------------------
 
     def _loop(self):
@@ -256,7 +291,7 @@ class Autoscaler:
     def evaluate(self, now=None):
         """One policy evaluation (the loop body, callable directly in
         tests/smokes).  Returns (p99_s, queue_depth, target)."""
-        p99, queue, seen = self.read_signals()
+        p99, queue, seen = self.signals.read()
         current = self.driver.current_world_size()
         if current <= 0:
             return p99, queue, current      # round not formed yet
@@ -274,7 +309,8 @@ class Autoscaler:
                 reason, current, target,
                 f"{p99:.4f}s" if p99 is not None else "n/a", queue,
                 self.policy.slo_p99_s)
-            applied = self.driver.set_target_np(target)
+            applied = self.driver.set_target_np(
+                target, owner=self.LEVER_OWNER)
             self.decisions.append(
                 {"reason": reason, "from": current, "to": applied,
                  "p99_s": p99, "queue": queue})

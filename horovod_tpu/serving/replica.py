@@ -132,8 +132,8 @@ class ServingReplica:
             "Model execution time per dispatched batch",
             buckets=telemetry.REQUEST_LATENCY_BUCKETS)
         self._m_requests = reg.counter(
-            "horovod_serving_requests_total",
-            "Predict requests completed, by outcome",
+            telemetry.SERVING_REQUESTS_FAMILY,
+            telemetry.SERVING_REQUESTS_HELP,
             labelnames=("outcome",))
         self._up = reg.gauge(
             "horovod_serving_replica_up",

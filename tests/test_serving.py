@@ -490,7 +490,7 @@ class _FakeDriver:
     def current_world_size(self):
         return self.size
 
-    def set_target_np(self, n):
+    def set_target_np(self, n, owner=None, epoch=None):
         self.targets.append(n)
         return n
 
@@ -617,6 +617,9 @@ def test_elastic_driver_autoscale_lever():
     driver._lock = threading.RLock()
     driver._shutdown = threading.Event()
     driver._on_event = None
+    driver._lever_owner = None
+    driver._lever_epoch = -1
+    driver._suspended = False
     assert len(driver._compute_assignments()) == 4
     # clamped into [min_np, max_np]; assignments follow the target
     assert driver.set_target_np(2) == 2

@@ -1,0 +1,500 @@
+#!/usr/bin/env python
+"""CI fleet smoke (``ci.sh fleet``): the day-in-the-life scenario of
+the multi-tenant fleet controller (docs/fleet.md; ISSUE 13's headline
+gate) — TWO REAL jobs (an elastic training job + an elastic serving
+job) co-scheduled on one shared host pool ({localhost, 127.0.0.1}),
+driven through deterministic reconcile ticks:
+
+* **calm** — placement: serving gets its min, training soaks the
+  surplus; both jobs produce goodput;
+* **traffic spike** — a flood of real HTTP predicts breaches the
+  serving SLO (windowed p99/queue off the replicas' pushed
+  snapshots): the controller GROWS serving and SHRINKS training dp
+  through ``set_target_np`` (preemption-by-elasticity — nobody is
+  killed);
+* **spike ends** — serving gives the chip back on idle hysteresis
+  and training reclaims it after its cooldown;
+* **resize storm** — a seeded fault plan flaps ``revoke_host`` /
+  ``restore_host`` on one host across consecutive ticks: the settle
+  debounce yields exactly ONE shrink + ONE grow, not one round per
+  flap (no thrash);
+* **host death** — a training worker on 127.0.0.1 SIGKILLs itself:
+  the host is blacklisted for EVERY job, placement reassigns, and the
+  deterministic tick-based cooldown returns it later — chips return;
+* **assertions** from the controller's merged ``/metrics``: every
+  job's goodput > 0, zero SLO-breach ticks after the spike settles,
+  exactly the one injected blacklist (zero false deaths) — and TWO
+  same-seed runs produce byte-identical preemption/fault evidence
+  logs (the controller's decision projection carries no wall-clock
+  or measured fields; hysteresis is what MAKES the sequence
+  reproducible).
+
+Driver mode (no args): runs the scenario twice and compares.
+Run mode (``FS_RUN`` set): executes one scenario.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SEED = 20260804
+TICK_S = 0.5
+SERVE_PORT = 19640
+FLEET_METRICS_PORT = 19720
+
+# phase boundaries, in reconcile ticks (the smoke's clock).  The
+# margins matter for the byte-identical evidence guarantee: every
+# decision of phase N must land before phase N+1 opens in BOTH runs,
+# so the evidence ordering never depends on sub-tick timing.  The
+# post-storm phases are CONDITION-gated instead (re-formation time
+# varies wildly with exec-restart churn); the budgets below bound
+# them — blowing a budget fails the final assertions loudly.
+T_FLOOD_START = 10
+T_FLOOD_END = 28
+T_SETTLE_END = 52
+T_STORM = (54, 56, 58, 60, 62, 64)      # revoke/restore flaps
+T_LIVE_BUDGET = 120       # ticks for the post-storm round to go live
+T_KILL_BUDGET = 90        # ticks for the kill -> blacklist verdict
+T_RECOVER_BUDGET = 90     # ticks for cooldown expiry + chip return
+
+TRAIN_WORKER = textwrap.dedent("""
+    import os, signal
+    import numpy as np
+    import horovod_tpu as hvd
+    import horovod_tpu.elastic as elastic
+
+    OUT = os.environ["FS_OUT"]
+    STOP = os.path.join(OUT, "stop_train")
+    KILL = os.path.join(OUT, "kill_marker")
+    KILLED = os.path.join(OUT, "kill_done")
+
+    import time as _time
+
+    def tlog(msg):
+        with open(os.path.join(OUT, "train_log.txt"), "a") as f:
+            f.write(f"{_time.time():.1f} {msg}\\n")
+
+    hvd.init()
+    state = elastic.ObjectState(
+        bcast_object=hvd.broadcast_object, get_rank=hvd.rank,
+        batch=0, last_size=0)
+
+    @elastic.run
+    def train(state):
+        # the stop flag rides element 0 of the step's own allreduce so
+        # every rank leaves at the SAME step — an unsynchronized
+        # filesystem check would strand peers inside the collective
+        x = np.ones(64, np.float32)
+        while True:
+            if (os.path.exists(KILL) and not os.path.exists(KILLED)
+                    and os.environ.get("HOROVOD_HOSTNAME") == "127.0.0.1"
+                    and os.environ.get("HOROVOD_LOCAL_RANK") == "0"):
+                # the injected host death (exactly once per scenario)
+                open(KILLED, "w").write("1")
+                os.kill(os.getpid(), signal.SIGKILL)
+            x[0] = 0.0 if os.path.exists(STOP) else 1.0
+            out = hvd.allreduce(x, op=hvd.Sum, name="fs.step")
+            # per-host liveness beacon: the smoke's phase gates need
+            # to know a worker on THIS host is actually stepping (the
+            # fleet's np is allocation, not round state)
+            host = os.environ.get("HOROVOD_HOSTNAME", "?")
+            with open(os.path.join(OUT, f"beat_{host}"), "w") as f:
+                f.write(str(state.batch))
+            if hvd.size() != state.last_size:
+                state.last_size = hvd.size()
+                tlog(f"round rank {hvd.rank()} size {hvd.size()} "
+                     f"host {os.environ.get('HOROVOD_HOSTNAME')} "
+                     f"batch {state.batch}")
+            state.batch += 1
+            state.commit()
+            if out[0] < float(hvd.size()):
+                return
+
+    train(state)
+    tlog(f"done rank {hvd.rank()} batch {state.batch}")
+""")
+
+SERVE_WORKER = textwrap.dedent("""
+    import os
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu import serving
+
+    OUT = os.environ["FS_OUT"]
+    STOP = os.path.join(OUT, "stop_serve")
+
+    DIM = 256
+    params = {"w": np.eye(DIM, dtype=np.float32)}
+
+    def predict_fn(p, batch):
+        # deliberately heavy (a chain of dense matmuls): the spike
+        # must overload ONE replica on any box speed, or the SLO
+        # never breaches and the scenario is vacuous
+        y = batch["x"]
+        for _ in range(100):
+            y = y @ p["w"]
+        return {"y": y}
+
+    serving.serve_forever(
+        predict_fn, params=params,
+        config=serving.ServingConfig(max_batch_size=4,
+                                     max_latency_ms=30,
+                                     buckets=(1, 2, 4)),
+        warmup_example={"x": np.zeros(DIM, np.float32)},
+        should_stop=lambda: os.path.exists(STOP))
+""")
+
+
+# ---------------------------------------------------------------------------
+# one scenario run (FS_RUN mode)
+
+def _flood(ports, stop_event, counts):
+    """Closed-ish-loop HTTP predict flood across the serving
+    frontends; failures during re-rendezvous are expected and
+    tolerated (the failover contract)."""
+    payload = json.dumps({"inputs": {"x": [0.5] * 256}}).encode()
+
+    def pump(i):
+        while not stop_event.is_set():
+            port = ports[i % len(ports)]
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/predict", data=payload,
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    r.read()
+                counts["ok"] += 1
+            except Exception:  # noqa: BLE001 — replica resizing/busy
+                counts["err"] += 1
+                time.sleep(0.02)
+
+    threads = [threading.Thread(target=pump, args=(i,), daemon=True)
+               for i in range(16)]
+    for t in threads:
+        t.start()
+    return threads
+
+
+def _breach_ticks(controller, job):
+    from horovod_tpu import telemetry
+    fam = controller.registry.snapshot().get(
+        telemetry.FLEET_SLO_BREACH_FAMILY)
+    if not fam:
+        return 0.0
+    return sum(s["value"] for s in fam["samples"]
+               if s["labels"].get("job") == job)
+
+
+def run_scenario():
+    from horovod_tpu.fleet import DONE, FleetController, parse_spec
+
+    out = os.environ["FS_OUT"]
+    train_py = os.path.join(out, "train_worker.py")
+    serve_py = os.path.join(out, "serve_worker.py")
+    with open(train_py, "w") as f:
+        f.write(TRAIN_WORKER)
+    with open(serve_py, "w") as f:
+        f.write(SERVE_WORKER)
+
+    spec = parse_spec(json.dumps({
+        "pool": {"localhost": 2, "127.0.0.1": 2},
+        "options": {"reconcile_seconds": TICK_S, "settle_ticks": 3,
+                    "cooldown_ticks": 4, "blacklist_ticks": 8},
+        "jobs": [
+            {"name": "serve", "kind": "serving", "min_np": 1,
+             "max_np": 2, "priority": 10,
+             "command": [sys.executable, serve_py],
+             "env": {"FS_OUT": out, "PYTHONPATH": REPO,
+                     "HOROVOD_SERVING": "1",
+                     "HOROVOD_SERVING_PORT": str(SERVE_PORT),
+                     "HOROVOD_METRICS_PUSH_SECONDS": "0.5"},
+             # idle needs p99 under 20% of the SLO AND a drained
+             # queue: a loaded-but-keeping-up window can never read
+             # as idle mid-spike (that flap would also break the
+             # same-seed evidence identity)
+             # breach_evals=1: latency windows only EXIST under
+             # traffic (p99 None otherwise), and pushes land every
+             # ~1s against 0.5s ticks — requiring a consecutive
+             # streak across alternating empty windows would make
+             # the spike a coin flip
+             "slo": {"p99_ms": 25, "queue_high": 3,
+                     "breach_evals": 1, "idle_evals": 5,
+                     "idle_frac": 0.2, "idle_queue": 0,
+                     "cooldown_s": 3.0}},
+            {"name": "train", "kind": "training", "min_np": 1,
+             "max_np": 3,
+             "command": [sys.executable, train_py],
+             "env": {"FS_OUT": out, "PYTHONPATH": REPO}},
+        ],
+    }))
+    # the seeded plan: the resize storm, tick-triggered so two
+    # same-seed runs fire IDENTICALLY
+    plan = {"seed": SEED, "events": []}
+    for i, tick in enumerate(T_STORM):
+        plan["events"].append(
+            {"kind": "revoke_host" if i % 2 == 0 else "restore_host",
+             "host": "127.0.0.1", "after": tick})
+    env = {"HOROVOD_FAULT_PLAN": json.dumps(plan),
+           "HOROVOD_ELASTIC_TIMEOUT": "120",
+           # resizes racing an armed bypass vote wedge the teardown
+           # barrier (docs/fault_tolerance.md); a short budget keeps
+           # the exec-restart recovery cycle tight on this box
+           "HOROVOD_TEARDOWN_BARRIER_SECONDS": "3"}
+
+    controller = FleetController(
+        spec, platform="cpu", verbose=False, env=env,
+        evidence_path=os.path.join(out, "evidence.jsonl"),
+        metrics_port=FLEET_METRICS_PORT)
+    controller.start()
+
+    flood_stop = threading.Event()
+    counts = {"ok": 0, "err": 0}
+    checks = {"spike": [1, 3]}
+
+    def one_tick():
+        time.sleep(TICK_S)
+        controller.reconcile()
+        jobs = controller.snapshot()["jobs"]
+        if controller.tick % 10 == 0:
+            print(f"[fs] tick {controller.tick}: "
+                  + " ".join(f"{n}={j['state']}/{j['np']}"
+                             for n, j in jobs.items()), flush=True)
+        return jobs
+
+    try:
+        # -- tick-scheduled phases: calm, spike, settle, storm (the
+        #    chaos plan's revoke/restore fire on absolute ticks)
+        while controller.tick < T_STORM[-1] + 2:
+            jobs = one_tick()
+            tick = controller.tick
+            if T_FLOOD_START < tick <= T_FLOOD_END + 4:
+                # extremes over the spike window (the grow may land a
+                # tick or two after the sample point)
+                checks["spike"] = [
+                    max(checks["spike"][0], jobs["serve"]["np"]),
+                    min(checks["spike"][1], jobs["train"]["np"])]
+            if tick == T_FLOOD_START:
+                _flood([SERVE_PORT, SERVE_PORT + 1], flood_stop,
+                       counts)
+                print(f"[fs] tick {tick}: flood on", flush=True)
+            elif tick == T_FLOOD_END:
+                flood_stop.set()
+                print(f"[fs] tick {tick}: flood off "
+                      f"(ok={counts['ok']} err={counts['err']})",
+                      flush=True)
+            elif tick == T_SETTLE_END:
+                checks["settled"] = (jobs["serve"]["np"],
+                                     jobs["train"]["np"])
+                checks["breach_at_settle"] = _breach_ticks(
+                    controller, "serve")
+        # -- condition-gated phases: the post-storm re-formation time
+        #    varies wildly with exec-restart churn, so the kill phase
+        #    waits for a training worker on the TARGET HOST to be
+        #    actually stepping again (its per-step beacon file — the
+        #    fleet's np is allocation, not round state; even goodput
+        #    can advance off the size-1 survivor alone); the evidence
+        #    projection carries no tick numbers, so the gate preserves
+        #    byte-identity while adapting to wall time
+        beacon = os.path.join(out, "beat_127.0.0.1")
+        deadline = controller.tick + T_LIVE_BUDGET
+
+        def beacon_stamp():
+            try:
+                return os.stat(beacon).st_mtime
+            except OSError:
+                return None
+
+        seen = beacon_stamp()
+        fresh = 0
+        while controller.tick < deadline:
+            one_tick()
+            now = beacon_stamp()
+            if now is not None and now != seen:
+                fresh += 1
+                seen = now
+                if fresh >= 3:      # stepping, not a dying gasp
+                    break
+        assert fresh >= 3, (
+            f"training round never came back live on 127.0.0.1 "
+            f"within {T_LIVE_BUDGET} ticks after the storm")
+        open(os.path.join(out, "kill_marker"), "w").write("1")
+        print(f"[fs] tick {controller.tick}: host kill armed",
+              flush=True)
+        deadline = controller.tick + T_KILL_BUDGET
+        while controller.tick < deadline:
+            one_tick()
+            if any(d.get("e") == "blacklist"
+                   for d in controller.decisions):
+                break
+        assert any(d.get("e") == "blacklist"
+                   for d in controller.decisions), (
+            f"host kill never produced a blacklist within "
+            f"{T_KILL_BUDGET} ticks")
+        print(f"[fs] tick {controller.tick}: blacklist observed",
+              flush=True)
+        # -- recovery: cooldown expiry + settle return the chips;
+        #    require the calm placement to hold AND the returned
+        #    host's worker to actually be stepping again (allocation
+        #    alone can be ahead of a still-churning round — a drain
+        #    started mid-churn would strand the SPMD stop flag)
+        deadline = controller.tick + T_RECOVER_BUDGET
+        stable = 0
+        seen = beacon_stamp()
+        while controller.tick < deadline:
+            jobs = one_tick()
+            now = beacon_stamp()
+            alive = now is not None and now != seen
+            seen = now
+            if jobs["serve"]["np"] == 1 and jobs["train"]["np"] == 3 \
+                    and alive:
+                stable += 1
+                if stable >= 6:
+                    break
+            else:
+                stable = 0
+        checks["final"] = {n: (j["state"], j["np"])
+                           for n, j in controller.snapshot()
+                           ["jobs"].items()}
+        checks["breach_at_end"] = _breach_ticks(controller, "serve")
+        # the merged /metrics IS the evidence surface: scrape it
+        metrics = urllib.request.urlopen(
+            f"http://127.0.0.1:{FLEET_METRICS_PORT}/metrics",
+            timeout=10).read().decode()
+        with open(os.path.join(out, "metrics.txt"), "w") as f:
+            f.write(metrics)
+        # wind down: STAGGERED stop files (serve first, then train)
+        # so the two terminal `done` evidence records land in a
+        # deterministic order — a shared stop file would race the
+        # jobs' exit paths and flip the last two lines of the
+        # byte-compared log between runs
+        open(os.path.join(out, "stop_serve"), "w").write("1")
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            controller.reconcile()
+            if controller.snapshot()["jobs"]["serve"]["state"] in \
+                    (DONE, "failed"):
+                break
+            time.sleep(TICK_S)
+        open(os.path.join(out, "stop_train"), "w").write("1")
+        deadline = time.monotonic() + 150
+        while time.monotonic() < deadline:
+            controller.reconcile()
+            if all(j["state"] in (DONE, "failed") for j in
+                   controller.snapshot()["jobs"].values()):
+                break
+            time.sleep(TICK_S)
+        checks["terminal"] = {n: j["state"] for n, j in
+                              controller.snapshot()["jobs"].items()}
+    finally:
+        flood_stop.set()
+        controller.stop()
+
+    with open(os.path.join(out, "checks.json"), "w") as f:
+        json.dump(checks, f, sort_keys=True)
+    with open(os.path.join(out, "decisions.json"), "w") as f:
+        json.dump(controller.decisions, f, sort_keys=True)
+    print("[fs] scenario done", flush=True)
+
+
+# ---------------------------------------------------------------------------
+# driver: two same-seed runs + the acceptance assertions
+
+def _metric_total(text, family, **labels):
+    total = 0.0
+    for line in text.splitlines():
+        if not line.startswith(family):
+            continue
+        if all(f'{k}="{v}"' in line for k, v in labels.items()):
+            total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def _assert_run(out):
+    with open(os.path.join(out, "checks.json")) as f:
+        checks = json.load(f)
+    with open(os.path.join(out, "decisions.json")) as f:
+        decisions = json.load(f)
+    with open(os.path.join(out, "metrics.txt")) as f:
+        metrics = f.read()
+
+    # spike: serving grew, training shrank (preemption-by-elasticity)
+    assert checks["spike"] == [2, 2], checks
+    # settle: chips returned
+    assert checks["settled"] == [1, 3], checks
+    # storm + host death recovered: final state is the calm placement
+    assert checks["final"] == {"serve": ["running", 1],
+                               "train": ["running", 3]}, checks
+    # zero SLO-conformance violations after the spike settled
+    assert checks["breach_at_end"] == checks["breach_at_settle"], (
+        checks["breach_at_settle"], checks["breach_at_end"])
+    assert checks["breach_at_settle"] > 0, \
+        "the spike never breached the SLO — the scenario is vacuous"
+    # both jobs finished cleanly
+    assert checks["terminal"] == {"serve": "done", "train": "done"}, \
+        checks
+    # exactly the one injected host death — zero false deaths (the
+    # reporting job rides the on-disk t_ extras, not the projection:
+    # with co-located jobs it is race-ordered)
+    blacklists = [d for d in decisions if d["e"] == "blacklist"]
+    assert blacklists == [{"e": "blacklist",
+                           "host": "127.0.0.1"}], blacklists
+    # the storm was debounced: one shrink + one grow around the six
+    # flaps (count train placements between first revoke and the kill)
+    revs = [i for i, d in enumerate(decisions)
+            if d["e"] in ("revoke_host", "restore_host")]
+    kill_idx = next(i for i, d in enumerate(decisions)
+                    if d["e"] == "blacklist")
+    storm_places = [d for d in decisions[revs[0]:kill_idx]
+                    if d["e"] == "place" and d["job"] == "train"]
+    assert len(storm_places) <= 2, storm_places
+    # per-job goodput > 0 on the merged /metrics
+    g_train = _metric_total(metrics, "horovod_fleet_job_goodput_total",
+                            job="train")
+    g_serve = _metric_total(metrics, "horovod_fleet_job_goodput_total",
+                            job="serve")
+    assert g_train > 0, metrics
+    assert g_serve > 0, metrics
+    # suspension never fired in this scenario (shrink-only preemption)
+    assert not any(d["e"] == "suspend" for d in decisions), decisions
+    return decisions
+
+
+def main():
+    if os.environ.get("FS_RUN"):
+        run_scenario()
+        return
+
+    import tempfile
+    t0 = time.monotonic()
+    evidence = []
+    for run in (1, 2):
+        out = tempfile.mkdtemp(prefix=f"fleet_smoke_{run}_")
+        print(f"--- fleet run {run} ({out})", flush=True)
+        proc = subprocess.run(
+            [sys.executable, "-u", os.path.abspath(__file__)],
+            env={**os.environ, "FS_RUN": "1", "FS_OUT": out,
+                 "PYTHONPATH": REPO},
+            timeout=600, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        sys.stdout.write(proc.stdout[-4000:])
+        assert proc.returncode == 0, \
+            f"run {run} failed:\n{proc.stdout[-6000:]}"
+        decisions = _assert_run(out)
+        evidence.append(json.dumps(decisions, sort_keys=True))
+    assert evidence[0] == evidence[1], (
+        "same-seed runs produced DIFFERENT preemption/fault evidence:"
+        f"\nrun1={evidence[0]}\nrun2={evidence[1]}")
+    print(f"FLEET SMOKE OK ({time.monotonic() - t0:.0f}s; "
+          f"deterministic evidence: {evidence[0]})")
+
+
+if __name__ == "__main__":
+    main()

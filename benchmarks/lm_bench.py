@@ -23,6 +23,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 
@@ -37,12 +38,43 @@ def parse_parallelism(text):
     return tuple(parts)
 
 
-def bench_impl(impl, cfg, tokens, mesh, iters, warmup, pipeline=None):
+def lm_param_count(vocab, d_model, layers, d_ff):
+    """Analytic parameter count of the TransformerLM (tied embedding):
+    embed + per-layer (qkv + proj + mlp + 2 LN) + final LN."""
+    per_layer = 4 * d_model * d_model + 2 * d_model * d_ff \
+        + 4 * d_model + d_ff + d_model
+    return vocab * d_model + layers * per_layer + 2 * d_model
+
+
+def memory_verdict(n_params, dp, budget_gb, param_bytes=2,
+                   opt_bytes=8, sharded=False):
+    """Estimated per-device training footprint (params + grads at the
+    model dtype, adam moments f32 — ÷dp under weight-update sharding)
+    against the device budget.  The skip-vs-run asymmetry this gate
+    produces IS the sharding memory evidence (docs/benchmarks.md)."""
+    opt = opt_bytes / (dp if sharded else 1)
+    need_gb = n_params * (2 * param_bytes + opt) / 1e9
+    return need_gb, need_gb <= budget_gb
+
+
+def device_budget_gb(default=16.0):
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = stats.get("bytes_limit")
+        if limit:
+            return limit / 1e9
+    except Exception:  # noqa: BLE001 — CPU backends have no stats
+        pass
+    return default
+
+
+def bench_impl(impl, cfg, tokens, mesh, iters, warmup, pipeline=None,
+               sharded=False):
     from horovod_tpu.parallel import make_lm_train_step
 
     init, _, jit_step, tok_shd = make_lm_train_step(
         mesh, cfg, optimizer=optax.adamw(1e-3), attention_impl=impl,
-        pipeline=pipeline)
+        pipeline=pipeline, sharded=sharded)
     if iters < 1 or warmup < 1:
         raise ValueError("--iters and --warmup must be >= 1")
     state = init(jax.random.PRNGKey(0), tokens)
@@ -85,7 +117,31 @@ def main():
     p.add_argument("--cpu", type=int, default=0, metavar="N",
                    help="run on N virtual CPU devices (multi-device "
                         "pipeline smoke without a TPU)")
+    p.add_argument("--sharded", action="store_true",
+                   help="weight-update sharding: dp-shard the "
+                        "optimizer state (make_lm_train_step("
+                        "sharded=True); docs/parallelism.md)")
+    p.add_argument("--config", default=None, choices=["lm2b"],
+                   help="named model preset; lm2b is the multi-B-"
+                        "param config that only fits with --sharded")
+    p.add_argument("--memory-budget-gb", type=float, default=None,
+                   help="per-device memory budget for the fit gate "
+                        "(default: the device's reported limit, else "
+                        "16 — one TPUv3 core)")
+    p.add_argument("--estimate-only", action="store_true",
+                   help="print the memory verdict without training "
+                        "(records the skip-vs-run asymmetry on "
+                        "hosts that cannot run the big config)")
     args = p.parse_args()
+
+    if args.config == "lm2b":
+        # ~2.6B params: the post-436M headline config.  Dense adamw
+        # needs ~31 GB/device (bf16 params+grads, f32 moments) and
+        # SKIPS on a 16 GB budget; sharded at dp >= 4 fits — that
+        # asymmetry is the memory evidence ISSUE 14 asks for.
+        args.d_model, args.layers, args.heads = 2560, 32, 32
+        args.seq = max(args.seq, 2048)
+        args.remat = True
 
     if args.cpu:
         os.environ["HOROVOD_TPU_PLATFORM"] = "cpu"
@@ -113,6 +169,16 @@ def main():
     pipeline = None
     if args.parallelism:
         dp, tp, pp = parse_parallelism(args.parallelism)
+        if args.sharded and pp > 1:
+            # the sharded dp hop lives on the MpmdWorker (engine)
+            # substrate — ci.sh pp runs that parity config; the
+            # single-process local pipeline runtime this bench uses
+            # for pp keeps dense updates, and silently ignoring the
+            # flag would record a sharded row that is not one
+            raise SystemExit(
+                "--sharded composes with dp/tp here; for sharded "
+                "dp×pp use the multi-process MpmdWorker substrate "
+                "(tools/pp_smoke.py / ci.sh pp)")
         mesh = build_mesh(MeshSpec(dp=dp, tp=tp, pp=pp),
                           jax.devices()[: dp * tp * pp])
         if pp > 1:
@@ -135,13 +201,48 @@ def main():
 
     out = {"batch": args.batch, "seq": args.seq,
            "d_model": args.d_model, "layers": args.layers, **out_pp}
+    # -- memory fit gate (docs/benchmarks.md "Weight-update sharding"):
+    # big configs must SKIP with a clear verdict when the dense
+    # optimizer cannot fit, and run (or at least fit) sharded — the
+    # asymmetry is the memory evidence.
+    n_params = lm_param_count(cfg.vocab_size, args.d_model,
+                              args.layers, 4 * args.d_model)
+    dp_total = int(np.prod(mesh.devices.shape)) if args.parallelism \
+        else 1
+    budget = args.memory_budget_gb
+    if budget is None:
+        budget = device_budget_gb()
+    pbytes = 2 if cfg.dtype == jnp.bfloat16 else 4
+    need_gb, fits = memory_verdict(n_params, dp_total, budget,
+                                   param_bytes=pbytes,
+                                   sharded=args.sharded)
+    out.update(n_params=n_params, sharded=bool(args.sharded),
+               memory_budget_gb=round(budget, 1),
+               est_need_gb_per_device=round(need_gb, 1))
+    if args.config == "lm2b" or args.estimate_only:
+        if not fits:
+            out["skipped"] = (
+                f"{'sharded' if args.sharded else 'unsharded'} "
+                f"adamw needs ~{need_gb:.1f} GB/device for "
+                f"{n_params / 1e9:.2f}B params, budget is "
+                f"{budget:.1f} GB"
+                + ("" if args.sharded else
+                   " — re-run with --sharded to split the optimizer "
+                   "state ÷dp"))
+            print(json.dumps(out))
+            return
+        if args.estimate_only:
+            out["would_run"] = True
+            print(json.dumps(out))
+            return
     for impl in args.impls.split(","):
         impl = impl.strip()
         # "dense" = the default XLA S^2 softmax path ("ring" without
         # sequence_parallel is the single-shard dense fallback)
         tps, loss = bench_impl("ring" if impl == "dense" else impl,
                                cfg, tokens, mesh, args.iters,
-                               args.warmup, pipeline=pipeline)
+                               args.warmup, pipeline=pipeline,
+                               sharded=args.sharded)
         out[f"{impl}_tokens_per_sec"] = round(tps, 1)
         out[f"{impl}_loss"] = round(loss, 4)
     if "flash_tokens_per_sec" in out and "dense_tokens_per_sec" in out:

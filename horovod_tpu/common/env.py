@@ -180,6 +180,17 @@ HOROVOD_PP_CHUNKS = "HOROVOD_PP_CHUNKS"
 # topology, world size); jobs reload yesterday's optimum at start
 HOROVOD_AUTOTUNE_CACHE = "HOROVOD_AUTOTUNE_CACHE"
 
+# ZeRO-grade weight-update sharding (docs/parallelism.md
+# "Weight-update sharding"; core/sharded.py): SHARDED_OPTIMIZER=1
+# makes DistributedOptimizer default to sharded=True on every
+# frontend — gradients reducescatter, each rank updates its 1/dp
+# shard of params + optimizer state, the updated params allgather
+# back on the configured wire.  SHARD_LAYOUT picks the shard-bucket
+# granularity (bucket | flat) and is the autotuner's EIGHTH
+# dimension.
+HOROVOD_SHARDED_OPTIMIZER = "HOROVOD_SHARDED_OPTIMIZER"
+HOROVOD_SHARD_LAYOUT = "HOROVOD_SHARD_LAYOUT"
+
 # multi-tenant fleet controller (docs/fleet.md; horovodrun
 # --fleet-spec): the JSON fleet spec source (inline, @path, or bare
 # path), the reconciliation cadence, the controller's own journal
@@ -454,3 +465,17 @@ class Config:
         self.pp_chunks = get_int(HOROVOD_PP_CHUNKS, 0)
         # autotune warm-start cache file (core/autotune.py load/save)
         self.autotune_cache = get_str(HOROVOD_AUTOTUNE_CACHE)
+        # ZeRO-grade weight-update sharding (core/sharded.py): the
+        # process-wide default frontends resolve sharded=None against,
+        # and the shard-bucket layout — the autotuner's EIGHTH
+        # dimension, re-read by the updaters at each (re)build so a
+        # sweep flip re-shards deterministically instead of mid-step
+        self.sharded_optimizer = get_bool(HOROVOD_SHARDED_OPTIMIZER)
+        raw_layout = get_str(HOROVOD_SHARD_LAYOUT)
+        if raw_layout:
+            # lazy normalize: core.sharded is tiny, but a malformed
+            # value must fail loudly at init, not at first step
+            from ..core.sharded import normalize_shard_layout
+            self.shard_layout = normalize_shard_layout(raw_layout)
+        else:
+            self.shard_layout = "bucket"

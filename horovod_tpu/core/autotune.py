@@ -35,6 +35,7 @@ import time
 import numpy as np
 
 from .optim import BayesianOptimizer
+from .sharded import SHARD_LAYOUT_CHOICES
 from ..common.topology import ALGORITHMS
 from ..ops.quantize import WIRE_PAIR_CHOICES, wire_pair_label
 # PP_CHOICES / pp_label load lazily in ParameterManager.__init__:
@@ -56,7 +57,8 @@ class ParameterManager:
     def __init__(self, config, warmup_samples=3, steps_per_sample=10,
                  max_samples=20, log_path=None, seed=0, tune_wire=True,
                  tune_algorithm=True, tune_pipeline=False,
-                 cache_path=None, topo_fp="local", world_size=1):
+                 tune_sharded=False, cache_path=None, topo_fp="local",
+                 world_size=1):
         self.config = config
         self.warmup_samples = warmup_samples
         self.steps_per_sample = steps_per_sample
@@ -82,6 +84,12 @@ class ParameterManager:
         if self.tune_pipeline:
             global PP_CHOICES, pp_label
             from ..parallel.schedule import PP_CHOICES, pp_label
+        # EIGHTH dimension: the shard-bucket layout of the sharded
+        # weight update (core/sharded.SHARD_LAYOUT_CHOICES) — only
+        # swept when the job runs DistributedOptimizer(sharded=True);
+        # the updaters re-shard deterministically when a sweep flips
+        # it (a coordinated vote, never mid-step)
+        self.tune_sharded = bool(tune_sharded)
         # warm start (docs/autotune.md "Warm start"): a local JSON
         # cache of converged best configs keyed by (bucket signature,
         # topology, world size) — production jobs start at
@@ -91,10 +99,15 @@ class ParameterManager:
         # persists under the same key.
         self.cache_path = cache_path
         self._key_suffix = f"{topo_fp}|np{int(world_size)}"
+        if self.tune_sharded:
+            # sharded jobs score a different wire/threshold landscape
+            # (reducescatter+allgather vs allreduce): their optima
+            # never warm-start a dense job, or vice versa
+            self._key_suffix += "|sharded"
         self._cache_key = None
         self.warm_started = False
         dims = 4 + int(self.tune_wire) + int(self.tune_algorithm) \
-            + int(self.tune_pipeline)
+            + int(self.tune_pipeline) + int(self.tune_sharded)
         self._bo = BayesianOptimizer(dims=dims, seed=seed)
         self._samples = 0
         self._steps = 0
@@ -108,7 +121,8 @@ class ParameterManager:
              getattr(config, "wire_dtype", None)),
             getattr(config, "algorithm", None),
             (getattr(config, "pp_schedule", None),
-             getattr(config, "pp_n_micro", 0)))
+             getattr(config, "pp_n_micro", 0)),
+            getattr(config, "shard_layout", None))
         self._best_score = -np.inf
         self._best = self._current
         self._log = open(log_path, "w") if log_path else None
@@ -116,16 +130,17 @@ class ParameterManager:
             wire_col = "wire_pair," if self.tune_wire else ""
             algo_col = "algorithm," if self.tune_algorithm else ""
             pp_col = "pipeline," if self.tune_pipeline else ""
+            shard_col = "shard_layout," if self.tune_sharded else ""
             self._log.write(
                 "sample,fusion_threshold_bytes,cycle_time_ms,"
                 f"pack_mt_threshold_bytes,cache_capacity,{wire_col}"
-                f"{algo_col}{pp_col}score_bytes_per_sec\n")
+                f"{algo_col}{pp_col}{shard_col}score_bytes_per_sec\n")
 
     # -- encoding ------------------------------------------------------------
 
     def _encode(self, fusion_bytes, cycle_ms, pack_mt_bytes,
                 cache_capacity, wire_pair=None, algorithm=None,
-                pp_pair=None):
+                pp_pair=None, shard_layout=None):
         x0 = (np.log2(max(fusion_bytes, 1)) - _FUSION_LO) / \
             (_FUSION_HI - _FUSION_LO)
         x1 = (np.log2(max(cycle_ms, 2 ** _CYCLE_LO)) - _CYCLE_LO) / \
@@ -189,6 +204,15 @@ class ParameterManager:
                 pi = min(cands, key=lambda i: abs(
                     PP_CHOICES[i][1] - int(m or PP_CHOICES[i][1])))
             xs.append((pi + 0.5) / len(PP_CHOICES))
+        if self.tune_sharded:
+            # eighth dimension: the shard-bucket layout categorical
+            # (an unset default encodes as 'bucket')
+            try:
+                si = SHARD_LAYOUT_CHOICES.index(
+                    shard_layout or "bucket")
+            except ValueError:
+                si = 0
+            xs.append((si + 0.5) / len(SHARD_LAYOUT_CHOICES))
         return np.clip(xs, 0.0, 1.0)
 
     def _decode(self, x):
@@ -212,6 +236,11 @@ class ParameterManager:
         if self.tune_pipeline:
             pi = min(int(x[i] * len(PP_CHOICES)), len(PP_CHOICES) - 1)
             out.append(PP_CHOICES[pi])
+            i += 1
+        if self.tune_sharded:
+            si = min(int(x[i] * len(SHARD_LAYOUT_CHOICES)),
+                     len(SHARD_LAYOUT_CHOICES) - 1)
+            out.append(SHARD_LAYOUT_CHOICES[si])
         return tuple(out)
 
     # -- recording (engine hot path) ----------------------------------------
@@ -244,7 +273,7 @@ class ParameterManager:
         decoded = self._decode(self._best)
         fusion, cycle, _, _ = decoded[:4]
         i = 4
-        wire = algo = pipeline = ""
+        wire = algo = pipeline = shard = ""
         if self.tune_wire:
             wire = wire_pair_label(*decoded[i])
             i += 1
@@ -253,6 +282,9 @@ class ParameterManager:
             i += 1
         if self.tune_pipeline:
             pipeline = pp_label(*decoded[i])
+            i += 1
+        if self.tune_sharded:
+            shard = decoded[i]
         best = reg.gauge(
             telemetry.AUTOTUNE_BEST_CONFIG_FAMILY,
             telemetry.AUTOTUNE_BEST_CONFIG_HELP,
@@ -263,7 +295,8 @@ class ParameterManager:
         best.labels(fusion_threshold_bytes=fusion,
                     # hvdlint: ignore[telemetry-unbounded-label] info-gauge: best.clear() above caps it at ONE live child; the label IS the payload
                     cycle_time_ms=f"{cycle:.3f}", wire=wire,
-                    algorithm=algo, pipeline=pipeline).set(1)
+                    algorithm=algo, pipeline=pipeline,
+                    shard_layout=shard).set(1)
 
     def _finish_sample(self):
         elapsed = max(time.monotonic() - self._t0, 1e-6)
@@ -273,7 +306,7 @@ class ParameterManager:
             decoded = self._decode(self._current)
             fusion, cycle, pack_mt, cache = decoded[:4]
             i = 4
-            wire_col = algo_col = pp_col = ""
+            wire_col = algo_col = pp_col = shard_col = ""
             if self.tune_wire:
                 wire_col = f"{wire_pair_label(*decoded[i])},"
                 i += 1
@@ -282,9 +315,13 @@ class ParameterManager:
                 i += 1
             if self.tune_pipeline:
                 pp_col = f"{pp_label(*decoded[i])},"
+                i += 1
+            if self.tune_sharded:
+                shard_col = f"{decoded[i]},"
             self._log.write(
                 f"{self._samples},{fusion},{cycle:.3f},{pack_mt},"
-                f"{cache},{wire_col}{algo_col}{pp_col}{score:.1f}\n")
+                f"{cache},{wire_col}{algo_col}{pp_col}{shard_col}"
+                f"{score:.1f}\n")
             self._log.flush()
         if self._samples > self.warmup_samples:
             self._bo.observe(self._current, score)
@@ -340,6 +377,12 @@ class ParameterManager:
             sched, m = decoded[i]
             self.config.pp_schedule = sched
             self.config.pp_n_micro = int(m)
+            i += 1
+        if self.tune_sharded:
+            # the sharded updaters re-read this at their coordinated
+            # re-shard vote (a flip re-shards between steps, never
+            # splits one)
+            self.config.shard_layout = decoded[i]
 
     def best_parameters(self):
         return self._decode(self._best)
@@ -381,6 +424,9 @@ class ParameterManager:
             i += 1
         if self.tune_pipeline:
             entry["pp_schedule"], entry["pp_n_micro"] = decoded[i]
+            i += 1
+        if self.tune_sharded:
+            entry["shard_layout"] = decoded[i]
         return entry
 
     def _load_cache(self):
@@ -402,7 +448,8 @@ class ParameterManager:
                       getattr(self.config, "cache_capacity", 1024)),
             (entry.get("wire_inner"), entry.get("wire_outer")),
             entry.get("algorithm"),
-            (entry.get("pp_schedule"), entry.get("pp_n_micro", 0)))
+            (entry.get("pp_schedule"), entry.get("pp_n_micro", 0)),
+            entry.get("shard_layout"))
         # start the sweep AT the cached optimum: it becomes both the
         # applied config and the BO's incumbent, so early suggestions
         # explore around it instead of from scratch

@@ -195,6 +195,8 @@ class Engine:
                 max_samples=self.config.autotune_max_samples,
                 log_path=self.config.autotune_log,
                 tune_pipeline=getattr(self.config, "pp_stages", 1) > 1,
+                tune_sharded=bool(getattr(self.config,
+                                          "sharded_optimizer", False)),
                 cache_path=getattr(self.config, "autotune_cache", None),
                 topo_fp=topo_fp, world_size=self.global_size)
         #: first-fusion-bucket signature noted exactly once per
@@ -301,6 +303,17 @@ class Engine:
         self._m_fused_ag = m.counter(
             "horovod_fused_allgather_runs_total",
             "Fused allgather buckets executed")
+        # weight-update sharding (core/sharded.py): the runs counter
+        # is bumped by the updaters, the state gauge by the frontends
+        # after they build their shard state — pre-declared here so a
+        # scrape always shows the families (zero until sharded mode
+        # actually runs)
+        self._m_sharded = m.counter(
+            telemetry.SHARDED_UPDATE_RUNS_FAMILY,
+            telemetry.SHARDED_UPDATE_RUNS_HELP)
+        m.gauge(telemetry.OPTIMIZER_STATE_BYTES_FAMILY,
+                telemetry.OPTIMIZER_STATE_BYTES_HELP,
+                labelnames=telemetry.OPTIMIZER_STATE_BYTES_LABELS)
         self._m_negotiation = m.histogram(
             "horovod_negotiation_seconds",
             "First local submission to locally-ready, per op",
@@ -569,6 +582,10 @@ class Engine:
     @property
     def fused_allgather_runs(self):
         return int(self._m_fused_ag.total())
+
+    @property
+    def sharded_update_runs(self):
+        return int(self._m_sharded.total())
 
     def _local_global_ranks(self):
         return range(self.rank_offset, self.rank_offset + self.num_local)
@@ -1206,6 +1223,7 @@ class Engine:
             "wi": req.wire_inner,
             "algo": req.algorithm,
             "pp": req.pp_sched,
+            "sfp": req.shard_fp,
             "ps": ps.id,
             "nbytes": nbytes,
             "nprocs": nprocs,
@@ -1689,6 +1707,17 @@ class Engine:
                     f"{first.tensor_name}: rank {sub.rank} sent "
                     f"{r.pp_sched}, rank {subs[0].rank} sent "
                     f"{first.pp_sched}")
+            if r.shard_fp != first.shard_fp:
+                # sharded weight update (core/sharded.py): ranks whose
+                # shard LAYOUTS disagree would scatter/gather different
+                # slices against each other — corrupt updates, not a
+                # crash — so the layout fingerprint fails loudly like
+                # the wire pair and algorithm
+                return TensorShapeMismatchError(
+                    f"Mismatched shard layouts for "
+                    f"{first.tensor_name}: rank {sub.rank} sent "
+                    f"{r.shard_fp}, rank {subs[0].rank} sent "
+                    f"{first.shard_fp}")
             if rt == RequestType.BROADCAST and r.root_rank != first.root_rank:
                 return TensorShapeMismatchError(
                     f"Mismatched broadcast root for {first.tensor_name}: "
@@ -1764,6 +1793,10 @@ class Engine:
                 # fusion buffer with full-width tensors, and a
                 # hierarchical bucket never fuses with a flat one
                 # (they run different SPMD programs)
+                # ... and the shard-layout fingerprint: a sharded
+                # update's collectives must never fuse with dense (or
+                # differently-laid-out) traffic — the shard slices
+                # are positional within their own buckets
                 sig = (rt, first.request.dtype,
                        first.request.reduce_op,
                        first.request.prescale_factor,
@@ -1771,10 +1804,12 @@ class Engine:
                        first.request.wire_dtype,
                        first.request.wire_inner,
                        first.request.algorithm,
-                       first.request.pp_sched)
+                       first.request.pp_sched,
+                       first.request.shard_fp)
                 nbytes = sum(p.nbytes for p in first.payloads)
             elif rt == RequestType.ALLGATHER:
-                sig = (rt, first.request.dtype)
+                sig = (rt, first.request.dtype,
+                       first.request.shard_fp)
                 # threshold accounts the OUTPUT (gathered) size, like
                 # the reference's fused-buffer accounting
                 nbytes = sum(p.nbytes for p in first.payloads) * ps.size

@@ -108,6 +108,13 @@ class Request:
     # engine latches the process-wide default per negotiation entry
     # (autotune's seventh dimension flips it between steps only).
     pp_sched: Optional[str] = None
+    # shard-layout fingerprint (core/sharded.ShardPlan.fingerprint)
+    # on collectives issued by a sharded weight update: None outside
+    # sharded mode.  Cross-rank validated like wire_dtype — ranks
+    # disagreeing on the shard layout would reducescatter/allgather
+    # different slices against each other and corrupt the update, so
+    # a divergence must fail loudly.
+    shard_fp: Optional[str] = None
     # grouped submissions: shape of EVERY member tensor, so cross-rank
     # validation covers members beyond the first (the reference issues
     # one Request per member inside the group instead)
@@ -133,6 +140,7 @@ class Request:
             "wi": self.wire_inner,
             "alg": self.algorithm,
             "pp": self.pp_sched,
+            "sfp": self.shard_fp,
         }
 
     @classmethod
@@ -156,6 +164,7 @@ class Request:
             wire_inner=d.get("wi"),
             algorithm=d.get("alg"),
             pp_sched=d.get("pp"),
+            shard_fp=d.get("sfp"),
         )
 
 

@@ -1,0 +1,489 @@
+"""ZeRO-grade weight-update sharding — the engine-path core.
+
+The pod-scale playbook (arXiv:1909.09756) pairs distributed gradient
+summation with *weight-update sharding*: each rank REDUCESCATTERs the
+gradients, updates only its 1/dp shard of the parameters + optimizer
+state, and ALLGATHERs the updated parameters back.  The optimizer
+state shrinks by dp and the full allreduce becomes reducescatter +
+allgather — the same bytes, but the update compute and its state are
+distributed.
+
+This module is the framework-agnostic half shared by the torch and
+TF/Keras ``DistributedOptimizer(sharded=True)`` frontends (the
+jax/compiled path builds the same decomposition *inside* one XLA
+program — ops/compiled.py ``make_compiled_train_step(sharded=True)``):
+
+* :class:`ShardPlan` — the deterministic shard layout.  Parameters
+  pack into contiguous flat buckets derived from the SAME rule the
+  engine's fusion uses (matching (dtype, param-group) runs under the
+  fusion threshold), and each bucket splits across ranks with the
+  engine executor's exact ``chunk_sizes`` rule — so bucket boundaries
+  and shard boundaries coincide by construction and the reducescatter
+  output IS the shard (no gather-regather churn).  The layout
+  fingerprint rides every collective as ``Request.shard_fp`` and is
+  cross-rank validated like the wire pair and algorithm: ranks
+  disagreeing on the layout would update different slices against
+  each other, so a mismatch fails LOUDLY, not silently skewed.
+* :class:`ShardedUpdater` — the wire: gradients go out as grouped
+  reducescatter on the existing per-hop quantized wire (with EF21
+  error feedback host-side, exactly like the dense optimizer's
+  residuals), and the updated-param allgather rides the same wire
+  with its OWN error-feedback state — the master shard stays full
+  width on its owning rank, the transmitted params are
+  ``deq(q(master + residual))`` and every rank (owner included)
+  installs the decoded value, so ranks stay bit-identical and the
+  quantization error dithers instead of accumulating into the weights.
+
+Elastic contract: a resize (or an autotune shard-layout flip) re-shards
+DETERMINISTICALLY — :meth:`ShardedUpdater.gather_full` reconstructs
+the full flat state from the shards (an exact allgather), and the new
+plan re-slices it; error-feedback residuals are dropped at every
+re-shard (``reset_wire_state``), never re-injected at stale shapes.
+"""
+
+import hashlib
+import json
+import threading
+
+import numpy as np
+
+SHARD_LAYOUT_CHOICES = ("bucket", "flat")
+
+
+def normalize_shard_layout(layout):
+    """'bucket' (default: shard boundaries from fusion buckets) |
+    'flat' (one bucket per (dtype, group): fewest, largest
+    collectives).  The autotuner sweeps this as its eighth
+    dimension."""
+    if layout is None or layout == "":
+        return "bucket"
+    layout = str(layout).strip().lower()
+    if layout not in SHARD_LAYOUT_CHOICES:
+        raise ValueError(
+            f"shard layout must be one of {SHARD_LAYOUT_CHOICES}, "
+            f"got {layout!r}")
+    return layout
+
+
+def compression_wire(compression):
+    """Wire format a Compression marker/compressor asks for: the
+    quantized markers carry ``wire`` ('int8'/'int4'); the fp16/bf16
+    CAST compressors carry ``wire_dtype`` (a framework dtype).  Under
+    sharded mode the cast happens on the collective wire itself, so
+    both spellings resolve to the updater's wire string instead of
+    the 16-bit request being silently dropped (works on torch and tf
+    dtypes alike via their string forms)."""
+    w = getattr(compression, "wire", None)
+    if w:
+        return w
+    wd = getattr(compression, "wire_dtype", None)
+    if wd is None:
+        return None
+    name = str(wd)
+    if "bfloat16" in name:
+        return "bf16"
+    if "float16" in name:
+        return "fp16"
+    return None
+
+
+def chunk_sizes(n, dp):
+    """THE uneven split rule: as even as possible, larger chunks on
+    lower ranks (reference collective_operations.cc
+    ReducescatterOp::ComputeOutputShapeForRank).  The engine
+    executor's reducescatter (xla_ops.MeshExecutor.chunk_sizes)
+    delegates here, so the shard plan can never drift from what the
+    scatter actually returns."""
+    base = n // dp
+    extra = n % dp
+    return [base + (1 if r < extra else 0) for r in range(dp)]
+
+
+class ShardBucket:
+    """One contiguous flat buffer: members laid out back to back, the
+    dp split at ``chunks`` boundaries."""
+
+    __slots__ = ("index", "dtype", "group", "members", "n", "chunks",
+                 "rank_offsets")
+
+    def __init__(self, index, dtype, group, members, dp):
+        self.index = index
+        self.dtype = dtype          # numpy dtype string
+        self.group = group          # frontend param-group index
+        #: [(key, size, shape)] in pack order
+        self.members = members
+        self.n = sum(m[1] for m in members)
+        self.chunks = chunk_sizes(self.n, dp)
+        offs = np.cumsum([0] + self.chunks[:-1])
+        self.rank_offsets = [int(o) for o in offs]
+
+    def shard_slice(self, pos):
+        """[start, end) of rank-position ``pos``'s shard in the flat
+        bucket."""
+        start = self.rank_offsets[pos]
+        return start, start + self.chunks[pos]
+
+
+class ShardPlan:
+    """Deterministic shard layout over an ordered parameter list.
+
+    ``specs`` is ``[(key, shape, dtype_str, group_index)]`` in the
+    frontend's canonical order (param_groups order for torch, the
+    variable list for TF).  Buckets close when the (dtype, group)
+    signature changes or the running size crosses ``threshold_bytes``
+    ('bucket' layout); the 'flat' layout ignores the threshold and
+    packs each (dtype, group) run into one bucket.
+    """
+
+    def __init__(self, specs, dp, threshold_bytes, layout="bucket"):
+        if dp < 1:
+            raise ValueError(f"dp must be >= 1, got {dp}")
+        self.dp = int(dp)
+        self.layout = normalize_shard_layout(layout)
+        self.threshold_bytes = int(threshold_bytes)
+        self.buckets = []
+        cur, cur_sig, cur_bytes = [], None, 0
+        for key, shape, dtype, group in specs:
+            size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            itemsize = 2 if dtype == "bfloat16" else \
+                np.dtype(dtype).itemsize
+            nbytes = size * itemsize
+            sig = (dtype, group)
+            closes = cur and (
+                sig != cur_sig
+                or (self.layout == "bucket"
+                    and cur_bytes + nbytes > self.threshold_bytes))
+            if closes:
+                self.buckets.append(ShardBucket(
+                    len(self.buckets), cur_sig[0], cur_sig[1], cur,
+                    self.dp))
+                cur, cur_bytes = [], 0
+            cur.append((key, size, tuple(shape)))
+            cur_bytes += nbytes
+            cur_sig = sig
+        if cur:
+            self.buckets.append(ShardBucket(
+                len(self.buckets), cur_sig[0], cur_sig[1], cur,
+                self.dp))
+        self.total_elems = sum(b.n for b in self.buckets)
+
+    def fingerprint(self):
+        """Stable layout identity: every rank derives this from its
+        own spec list; it rides each collective as ``shard_fp`` and
+        the engine/coordinator reject a cross-rank mismatch before
+        anything executes."""
+        doc = [self.layout, self.dp,
+               [[b.dtype, b.group,
+                 [[k, s, list(shp)] for k, s, shp in b.members]]
+                for b in self.buckets]]
+        return hashlib.md5(
+            json.dumps(doc, sort_keys=True).encode()).hexdigest()[:16]
+
+    def local_elems(self, pos):
+        return sum(b.chunks[pos] for b in self.buckets)
+
+    # -- flat pack/unpack ----------------------------------------------------
+
+    def pack(self, bucket, arrays_by_key, dtype=None):
+        """Member arrays → one flat bucket buffer (missing members
+        contribute zeros — the unused-parameter case)."""
+        dt = np.dtype(dtype or np.float32)
+        buf = np.zeros(bucket.n, dtype=dt)
+        off = 0
+        for key, size, shape in bucket.members:
+            a = arrays_by_key.get(key)
+            if a is not None:
+                buf[off:off + size] = np.asarray(a, dtype=dt).ravel()
+            off += size
+        return buf
+
+    def unpack(self, bucket, buf):
+        """Flat bucket buffer → {key: array} views (reshaped)."""
+        out = {}
+        off = 0
+        for key, size, shape in bucket.members:
+            out[key] = buf[off:off + size].reshape(shape)
+            off += size
+        return out
+
+
+class ShardedUpdater:
+    """The sharded weight-update wire for host-side (engine path)
+    frontends.  Owns: the grouped reducescatter of gradient buckets,
+    the grouped allgather of updated param shards (both over the
+    configured wire, each with its own EF residual state), the layout
+    fingerprint threading, and the telemetry that proves the ÷dp
+    claim from a scrape."""
+
+    def __init__(self, plan, process_set=None, op=None,
+                 grad_wire=None, param_wire=None, name="shard"):
+        from ..ops.api import Average
+
+        self.plan = plan
+        self.process_set = process_set
+        self.op = Average if op is None else op
+        #: wire for the gradient reducescatter — None defers to the
+        #: engine's process-wide default (the per-entry latch applies)
+        self.grad_wire = grad_wire
+        #: wire for the updated-param allgather; quantized formats
+        #: keep a per-bucket EF residual here
+        self.param_wire = param_wire
+        self.name = name
+        self.shard_fp = plan.fingerprint()
+        self._grad_residuals = {}
+        self._param_residuals = {}
+        self._lock = threading.Lock()
+
+    # -- position ------------------------------------------------------------
+
+    def my_pos(self):
+        """This rank's position in the process set (the shard index)."""
+        from ..common import basics
+        from ..common.process_sets import ProcessSet
+
+        eng = basics.engine()
+        ps_id = 0
+        if isinstance(self.process_set, ProcessSet):
+            ps_id = self.process_set.process_set_id or 0
+        elif self.process_set is not None:
+            ps_id = int(self.process_set)
+        ps = eng.process_sets[ps_id]
+        rank = basics.context().rank
+        return ps.index[rank]
+
+    # -- gradient reducescatter ---------------------------------------------
+
+    def _ef_inject_grad(self, i, buf, wire):
+        """EF21 on the gradient wire: inject last step's quantization
+        residual, measure this one (ops/quantize.py is a pure function
+        of x, so the host-side re-encode matches the engine's wire)."""
+        from ..ops import quantize as qz
+
+        x = buf.astype(np.float32, copy=True)
+        r = self._grad_residuals.get(i)
+        if r is not None and r.shape == x.shape:
+            x = x + r
+        self._grad_residuals[i] = x - qz.np_fake_quantize_wire(x, wire)
+        return x.astype(buf.dtype, copy=False)
+
+    def reduce_grads(self, bucket_buffers):
+        """Grouped reducescatter of the flat gradient buckets (one
+        jointly-negotiated group per dtype — the shard layout IS the
+        fusion layout).  Returns this rank's shard per bucket."""
+        from ..ops import api
+        from .. import telemetry
+
+        wire = self.grad_wire
+        bufs = list(bucket_buffers)
+        if wire in ("int8", "int4"):
+            bufs = [self._ef_inject_grad(i, b, wire)
+                    for i, b in enumerate(bufs)]
+        by_dtype = {}
+        for i, b in enumerate(bufs):
+            by_dtype.setdefault(str(b.dtype), []).append(i)
+        handles = []
+        for dt in sorted(by_dtype):
+            idxs = by_dtype[dt]
+            handles.append((idxs, api.grouped_reducescatter_async(
+                [bufs[i] for i in idxs], op=self.op,
+                name=f"{self.name}.rs.{dt}",
+                process_set=self.process_set
+                if self.process_set is not None else 0,
+                wire_dtype=wire, shard_fp=self.shard_fp)))
+        out = [None] * len(bufs)
+        for idxs, h in handles:
+            res = api.synchronize(h)
+            if not isinstance(res, (list, tuple)):
+                res = [res]
+            for i, r in zip(idxs, res):
+                out[i] = np.asarray(r)
+        telemetry.count_sharded_update()
+        return out
+
+    # -- updated-param allgather ---------------------------------------------
+
+    def gather_params(self, shard_buffers, async_=False):
+        """Allgather the updated param shards back into full flat
+        buckets, over ``param_wire``.  Quantized wires ship the codec
+        (codes + bf16 scales) with an EF residual per bucket: the
+        master shard never leaves full width on its owner, the decoded
+        value is what EVERY rank (owner included) installs, and the
+        caller must therefore overwrite its own params from the
+        returned buffers too.  ``async_=True`` returns a zero-arg
+        completion callable instead of blocking — the pp runtime
+        overlaps it into the next microbatch's forward."""
+        wire = self.param_wire
+        if wire in ("int8", "int4"):
+            waiter = self._gather_quantized(shard_buffers, wire)
+        elif wire in ("fp16", "bf16"):
+            waiter = self._gather_cast16(shard_buffers, wire)
+        else:
+            waiter = self._gather_plain(shard_buffers)
+        return waiter if async_ else waiter()
+
+    def _gather_plain(self, shards):
+        from ..ops import api
+
+        by_dtype = {}
+        for i, s in enumerate(shards):
+            by_dtype.setdefault(str(s.dtype), []).append(i)
+        handles = []
+        for dt in sorted(by_dtype):
+            idxs = by_dtype[dt]
+            handles.append((idxs, api.grouped_allgather_async(
+                [shards[i] for i in idxs],
+                name=f"{self.name}.ag.{dt}",
+                process_set=self.process_set
+                if self.process_set is not None else 0,
+                shard_fp=self.shard_fp)))
+
+        def wait():
+            from ..ops import api as _api
+            out = [None] * len(shards)
+            for idxs, h in handles:
+                res = _api.synchronize(h)
+                if not isinstance(res, (list, tuple)):
+                    res = [res]
+                for i, r in zip(idxs, res):
+                    out[i] = np.asarray(r)
+            return out
+        return wait
+
+    def _gather_cast16(self, shards, wire):
+        from ..ops import api
+
+        wdt = np.dtype(np.float16) if wire == "fp16" else _bf16()
+        sent, dtypes = [], []
+        for i, s in enumerate(shards):
+            x = s.astype(np.float32, copy=True)
+            r = self._param_residuals.get(i)
+            if r is not None and r.shape == x.shape:
+                x = x + r
+            tx = x.astype(wdt)
+            self._param_residuals[i] = x - tx.astype(np.float32)
+            sent.append(tx)
+            dtypes.append(s.dtype)
+        h = api.grouped_allgather_async(
+            sent, name=f"{self.name}.ag16",
+            process_set=self.process_set
+            if self.process_set is not None else 0,
+            shard_fp=self.shard_fp)
+
+        def wait():
+            from ..ops import api as _api
+            res = _api.synchronize(h)
+            if not isinstance(res, (list, tuple)):
+                res = [res]
+            return [np.asarray(r).astype(dt)
+                    for r, dt in zip(res, dtypes)]
+        return wait
+
+    def _gather_quantized(self, shards, wire):
+        """Codec allgather: encode my shard once (with EF), gather
+        codes + scales for all ranks, decode every rank's segment —
+        the actual 1 B/elem (int8) / 0.5 B/elem (int4) wire, not a
+        full-width gather."""
+        from ..ops import api
+        from ..ops import quantize as qz
+
+        int4 = wire == "int4"
+        encode = qz.np_quantize_blockwise_int4 if int4 \
+            else qz.np_quantize_blockwise
+        codes, scales, dtypes = [], [], []
+        for i, s in enumerate(shards):
+            x = s.astype(np.float32, copy=True).ravel()
+            r = self._param_residuals.get(i)
+            if r is not None and r.shape == x.shape:
+                x = x + r
+            q, sc, n = encode(x)
+            deq = (qz.np_dequantize_blockwise_int4(q, sc, n)
+                   if int4 else qz.np_dequantize_blockwise(q, sc, n))
+            self._param_residuals[i] = x - deq[:x.size]
+            codes.append(q)
+            scales.append(np.asarray(sc))
+            dtypes.append(s.dtype)
+        hq = api.grouped_allgather_async(
+            codes, name=f"{self.name}.agq",
+            process_set=self.process_set
+            if self.process_set is not None else 0,
+            shard_fp=self.shard_fp)
+        hs = api.grouped_allgather_async(
+            scales, name=f"{self.name}.ags",
+            process_set=self.process_set
+            if self.process_set is not None else 0,
+            shard_fp=self.shard_fp)
+        plan = self.plan
+
+        def wait():
+            from ..ops import api as _api
+            gq = _api.synchronize(hq)
+            gs = _api.synchronize(hs)
+            if not isinstance(gq, (list, tuple)):
+                gq, gs = [gq], [gs]
+            out = []
+            for b, q_all, s_all, dt in zip(plan.buckets, gq, gs,
+                                           dtypes):
+                full = np.empty(b.n, np.float32)
+                qo = so = 0
+                for pos in range(plan.dp):
+                    m = b.chunks[pos]
+                    nb = -(-m // qz.BLOCK) if m else 0
+                    qlen = nb * (qz.BLOCK // 2 if int4 else qz.BLOCK)
+                    seg_q = np.asarray(q_all)[qo:qo + qlen]
+                    seg_s = np.asarray(s_all)[so:so + nb]
+                    if m:
+                        deq = (qz.np_dequantize_blockwise_int4(
+                            seg_q, seg_s, nb * qz.BLOCK) if int4 else
+                            qz.np_dequantize_blockwise(
+                                seg_q, seg_s, nb * qz.BLOCK))
+                        start = b.rank_offsets[pos]
+                        full[start:start + m] = deq[:m]
+                    qo += qlen
+                    so += nb
+                out.append(full.astype(dt, copy=False))
+            return out
+        return wait
+
+    # -- re-shard ------------------------------------------------------------
+
+    def gather_full(self, shard_buffers):
+        """EXACT (full-width) allgather of per-bucket shard state —
+        the deterministic re-shard primitive: state_dict saves gather
+        here, and a resize/layout flip reconstructs full flat buffers
+        before re-slicing under the new plan.  Never rides a lossy
+        wire: optimizer state must survive a re-shard bit-exactly."""
+        return self._gather_plain(
+            [np.ascontiguousarray(s) for s in shard_buffers])()
+
+    def reset_wire_state(self):
+        """Drop every EF residual (gradient AND param wires) plus the
+        compiled path's device residuals — the elastic/resize hook
+        (docs/concepts.md residual lifecycle): stale residual shapes
+        from the old layout must never be injected into the new."""
+        with self._lock:
+            self._grad_residuals.clear()
+            self._param_residuals.clear()
+        from ..ops.compiled import reset_ef_state
+        reset_ef_state()
+
+    # -- telemetry -----------------------------------------------------------
+
+    def record_state_bytes(self, shard_state_bytes):
+        """Export the ÷dp evidence: ``scope="shard"`` is what this
+        rank actually holds, ``scope="full"`` what the dense optimizer
+        would hold (shard bytes scaled by total/local elements) — a
+        scrape divides them and reads dp."""
+        from .. import telemetry
+
+        pos = self.my_pos()
+        local = max(self.plan.local_elems(pos), 1)
+        full = int(round(shard_state_bytes
+                         * self.plan.total_elems / local))
+        telemetry.set_optimizer_state_bytes("shard",
+                                            int(shard_state_bytes))
+        telemetry.set_optimizer_state_bytes("full", full)
+
+
+def _bf16():
+    import ml_dtypes
+    return np.dtype(ml_dtypes.bfloat16)

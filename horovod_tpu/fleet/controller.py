@@ -433,6 +433,44 @@ class FleetController:
         if self._metrics_server is not None:
             self._metrics_server.stop()
 
+    def crash(self):
+        """Simulate the controller PROCESS dying mid-run (the
+        tools/fleet_smoke.py crash drill): worker processes are
+        killed hard (they die with the controller's process group in
+        a real crash), the per-job control planes and the metrics
+        endpoint stop, and NOTHING journals a transition — the fleet
+        journal and each job's coordinator journal stay exactly as
+        the last running state recorded them.  Recover with a fresh
+        ``FleetController(resume=True)`` on the same journal path;
+        its first reconcile must reproduce the placement without
+        double-preempting (the unit contract
+        tests/test_fleet.py::test_controller_journal_restart_...)."""
+        self._stop.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+        for job in self.jobs:
+            drv = job.driver
+            if drv is not None and job.started:
+                for p in list(getattr(drv, "_procs", {}).values()):
+                    try:
+                        if p.poll() is None:
+                            p.kill()
+                    except Exception:  # noqa: BLE001 — already gone
+                        pass
+                try:
+                    drv.stop()
+                    if hasattr(drv, "join"):
+                        drv.join(timeout=10)
+                except Exception:  # noqa: BLE001 — crash teardown
+                    pass
+            try:
+                if job.server is not None:
+                    job.server.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+
     # -- journal -------------------------------------------------------------
 
     def _read_journal(self):

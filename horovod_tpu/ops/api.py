@@ -320,7 +320,8 @@ def allgather(tensor, name=None, process_set=global_process_set):
 
 
 def grouped_allgather_async(tensors, name=None,
-                            process_set=global_process_set):
+                            process_set=global_process_set,
+                            shard_fp=None):
     if not tensors:
         raise ValueError("grouped_allgather requires at least one tensor")
     pairs = [util.to_numpy(t) for t in tensors]
@@ -339,15 +340,18 @@ def grouped_allgather_async(tensors, name=None,
         request_type=RequestType.ALLGATHER, tensor_name=base, rank=ctx.rank,
         dtype=normalize_dtype(arrs[0].dtype), shape=tuple(arrs[0].shape),
         process_set_id=_ps_id(process_set), group_id=0,
-        group_shapes=tuple(tuple(a.shape) for a in arrs))
+        group_shapes=tuple(tuple(a.shape) for a in arrs),
+        shard_fp=shard_fp)
     h = _submit(req, arrs, names)
     h.kind = kinds
     h.grouped = True
     return h
 
 
-def grouped_allgather(tensors, name=None, process_set=global_process_set):
-    return synchronize(grouped_allgather_async(tensors, name, process_set))
+def grouped_allgather(tensors, name=None, process_set=global_process_set,
+                      shard_fp=None):
+    return synchronize(grouped_allgather_async(tensors, name,
+                                               process_set, shard_fp))
 
 
 # ----------------------------------------------------------------------------
@@ -468,7 +472,7 @@ def reducescatter(tensor, op=Average, name=None, prescale_factor=1.0,
 def grouped_reducescatter_async(tensors, op=Average, name=None,
                                 prescale_factor=1.0, postscale_factor=1.0,
                                 process_set=global_process_set,
-                                wire_dtype=None):
+                                wire_dtype=None, shard_fp=None):
     """Jointly-negotiated grouped reducescatter (reference
     EnqueueTensorReducescatters + group_table joint readiness): one
     submission, one negotiated unit, one handle resolving to a list."""
@@ -496,7 +500,8 @@ def grouped_reducescatter_async(tensors, op=Average, name=None,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
         process_set_id=_ps_id(process_set), group_id=0,
         group_shapes=tuple(tuple(a.shape) for a in arrs),
-        wire_dtype=normalize_wire_dtype(wire_dtype))
+        wire_dtype=normalize_wire_dtype(wire_dtype),
+        shard_fp=shard_fp)
     h = _submit(req, arrs, names)
     h.kind = kinds
     h.grouped = True
@@ -506,10 +511,10 @@ def grouped_reducescatter_async(tensors, op=Average, name=None,
 def grouped_reducescatter(tensors, op=Average, name=None,
                           prescale_factor=1.0, postscale_factor=1.0,
                           process_set=global_process_set,
-                          wire_dtype=None):
+                          wire_dtype=None, shard_fp=None):
     return synchronize(grouped_reducescatter_async(
         tensors, op, name, prescale_factor, postscale_factor,
-        process_set, wire_dtype))
+        process_set, wire_dtype, shard_fp))
 
 
 # ----------------------------------------------------------------------------

@@ -1283,7 +1283,8 @@ class _CompiledTrainStep:
     """See make_compiled_train_step."""
 
     def __init__(self, loss_fn, optimizer, op, process_set, donate,
-                 has_aux=False):
+                 has_aux=False, sharded=False, wire_dtype=None,
+                 topology_hint=None):
         op = ReduceOp(op)
         if op not in (Average, Sum, Adasum):
             raise ValueError("op must be Average, Sum, or Adasum")
@@ -1293,10 +1294,40 @@ class _CompiledTrainStep:
         self.process_set = process_set
         self.donate = donate
         self.has_aux = has_aux
+        # ZeRO-grade weight-update sharding (arXiv:1909.09756;
+        # docs/parallelism.md "Weight-update sharding"): the ONE
+        # cached program becomes reducescatter(grads) -> 1/R shard
+        # update -> allgather(updated params), with the optimizer
+        # state living as flat dp-sharded leaves — ÷R state memory.
+        # ``wire_dtype`` rides the gradient reducescatter hop (16-bit
+        # cast, or shared-scale int8/int4 integer psum_scatter with a
+        # state-threaded EF residual); ``topology_hint`` decomposes
+        # the scatter/gather per hop AND keys the cache (per-stage
+        # programs stay distinct under pp).
+        self.sharded = bool(sharded)
+        if self.sharded and op not in (Average, Sum):
+            raise ValueError(
+                "sharded=True supports op=Average or Sum (the "
+                "reducescatter has no adasum combine)")
+        self.wire_dtype = quantize_mod.normalize_wire_dtype(wire_dtype)
+        if self.wire_dtype == "f32":
+            self.wire_dtype = None
+        if topology_hint is not None and \
+                not isinstance(topology_hint, TopologyHint):
+            raise ValueError("topology_hint must be a TopologyHint")
+        if topology_hint is not None and \
+                self.wire_dtype in ("int8", "int4"):
+            raise ValueError(
+                "sharded=True supports quantized gradient wires on "
+                "the flat decomposition only (per-hop 16-bit casts "
+                "ride a TopologyHint; the engine-path sharded "
+                "optimizer covers quantized per-hop wires)")
+        self.topology_hint = topology_hint
         self._prog = None
         self._ex = None
         self._tag = None
         self._sig_checked = False
+        self._state_template = None
         self._lock = threading.Lock()
 
     # -- program -------------------------------------------------------------
@@ -1394,12 +1425,249 @@ class _CompiledTrainStep:
         donate = (0,) if self.donate else ()
         return jax.jit(prog, donate_argnums=donate)
 
+    # -- weight-update sharding ----------------------------------------------
+
+    def _shard_pad(self, n, R):
+        """Padded flat length: a multiple of R so the scatter divides
+        evenly — and of BLOCK*R under a quantized wire, so every
+        rank's shard is whole quantization blocks.  Plain wires pad
+        minimally (BLOCK*R padding on small leaves would hand the
+        padding back the memory the mode saves)."""
+        unit = quantize_mod.BLOCK * R \
+            if self.wire_dtype in ("int8", "int4") else R
+        return -(-n // unit) * unit
+
+    def _resolve_shard_hint(self, ex):
+        hint = self.topology_hint
+        if hint is None:
+            return None
+        if hint.outer * hint.inner != ex.num_ranks \
+                or hint.inner <= 1 or hint.outer <= 1:
+            raise ValueError(
+                f"TopologyHint sizes {hint.sizes} do not factor the "
+                f"process set's {ex.num_ranks} ranks into a 2-D mesh")
+        return hint
+
+    def _shard_specs(self, state, hint, R):
+        """shard_map in/out spec tree for the sharded-step state:
+        params + aux replicated, flat opt-state (and EF residual)
+        leaves split on dim0 over the mesh axes (inner-major, so the
+        layout matches what scatter-inner-then-outer produces)."""
+        dim0 = P("hvd") if hint is None \
+            else P((hint.reduce_axes[1], hint.reduce_axes[0]))
+
+        def opt_spec(leaf):
+            # the SAME divisibility rule _init_state_sharded shards
+            # by — a spec/placement drift here would silently
+            # re-shard leaves every step
+            shape = getattr(leaf, "shape", ())
+            return dim0 if len(shape) >= 1 and shape[0] > 0 \
+                and shape[0] % R == 0 else P()
+
+        specs = {"params": jax.tree.map(lambda _: P(),
+                                        state["params"]),
+                 "opt_state": jax.tree.map(opt_spec,
+                                           state["opt_state"])}
+        if "aux" in state:
+            specs["aux"] = jax.tree.map(lambda _: P(), state["aux"])
+        if "grad_ef" in state:
+            specs["grad_ef"] = jax.tree.map(lambda _: dim0,
+                                            state["grad_ef"])
+        return specs
+
+    def _build_sharded(self, ex):
+        """The one cached reducescatter -> shard-update -> allgather
+        program (arXiv:1909.09756 weight-update sharding): gradients
+        leave as ``psum_scatter`` (per-hop under a TopologyHint, the
+        cross hop optionally 16-bit; flat optionally shared-scale
+        int8/int4 integer partials with a state-threaded EF
+        residual), the optimizer update runs on each rank's flat 1/R
+        shard of params + optimizer state, and the updated params
+        ``all_gather`` back — all inside ONE jitted program, so XLA
+        overlaps the collectives with backward compute exactly like
+        the dense path."""
+        loss_fn, optimizer, op = self.loss_fn, self.optimizer, self.op
+        has_aux = self.has_aux
+        R = ex.num_ranks
+        hint = self._resolve_shard_hint(ex)
+        wire = self.wire_dtype
+        quant = wire in ("int8", "int4")
+        bits = 8 if wire == "int8" else 4
+        BLOCK = quantize_mod.BLOCK
+        mesh = ex.mesh if hint is None else \
+            ex.mesh2d(hint.inner, hint.reduce_axes)
+        if hint is not None:
+            ax_out, ax_in = hint.reduce_axes
+
+        import optax
+
+        def grad_call(params, aux, batch):
+            if has_aux:
+                (loss, new_aux), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, aux, batch)
+            else:
+                loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+                new_aux = aux
+            return loss, new_aux, grads
+
+        def shard_start(pad):
+            if hint is None:
+                return lax.axis_index("hvd") * (pad // R)
+            return lax.axis_index(ax_in) * (pad // hint.inner) \
+                + lax.axis_index(ax_out) * (pad // R)
+
+        def scatter_plain(g):
+            # g: (pad,) f32 — per-hop psum_scatter; the inner (ICI)
+            # hop moves the full payload, the outer (DCN) hop only
+            # the 1/inner shard, both optionally 16-bit
+            if hint is None:
+                if wire in ("bf16", "fp16"):
+                    wdt = jnp.bfloat16 if wire == "bf16" \
+                        else jnp.float16
+                    return lax.psum_scatter(
+                        g.astype(wdt), "hvd", scatter_dimension=0,
+                        tiled=True).astype(jnp.float32), None
+                return lax.psum_scatter(
+                    g, "hvd", scatter_dimension=0, tiled=True), None
+            x = g
+            if wire in ("bf16", "fp16"):
+                wdt = jnp.bfloat16 if wire == "bf16" else jnp.float16
+                x = x.astype(wdt)
+            y = lax.psum_scatter(x, ax_in, scatter_dimension=0,
+                                 tiled=True)
+            y = lax.psum_scatter(y, ax_out, scatter_dimension=0,
+                                 tiled=True)
+            return y.astype(jnp.float32), None
+
+        def scatter_quant(g, res):
+            # EQuARX-style shared-scale integer partials, scatter
+            # flavor: bf16-rounded pmax scale grid shared by every
+            # rank, int psum_scatter of codes (the narrow wire), one
+            # decode multiply on the shard — with EF21: ``res`` is
+            # this rank's residual from the previous step, the new
+            # residual is returned as device state
+            qmax = quantize_mod.quantized_qmax(bits)
+            x = g + res
+            nb = x.shape[0] // BLOCK
+            xb = x.reshape(nb, BLOCK)
+            absmax16 = jnp.max(jnp.abs(xb), axis=-1) \
+                .astype(jnp.bfloat16)
+            shared = lax.pmax(absmax16, "hvd")
+            scale = (shared.astype(jnp.float32) / np.float32(qmax)) \
+                .astype(jnp.bfloat16).astype(jnp.float32)
+            safe = jnp.where(scale > 0, scale, np.float32(1.0))
+            q = jnp.clip(jnp.round(xb / safe[:, None]), -qmax, qmax)
+            new_res = (xb - q * safe[:, None]).reshape(-1)
+            acc = jnp.dtype(quantize_mod.quantized_acc_dtype_np(
+                bits, R))
+            y_int = lax.psum_scatter(
+                q.astype(acc).reshape(-1), "hvd",
+                scatter_dimension=0, tiled=True)
+            pad = x.shape[0]
+            m = pad // R
+            sb = shard_start(pad) // BLOCK
+            scale_shard = lax.dynamic_slice(safe, (sb,),
+                                            (m // BLOCK,))
+            y = (y_int.astype(jnp.float32).reshape(m // BLOCK, BLOCK)
+                 * scale_shard[:, None]).reshape(-1)
+            return y, new_res
+
+        def gather_shard(u):
+            # updated param shard back to the full flat buffer —
+            # inner hop last so the DCN hop only moves 1/inner
+            if hint is None:
+                return lax.all_gather(u, "hvd", axis=0, tiled=True)
+            y = lax.all_gather(u, ax_out, axis=0, tiled=True)
+            return lax.all_gather(y, ax_in, axis=0, tiled=True)
+
+        def pack(params, opt_state, aux, grad_ef):
+            state = {"params": params, "opt_state": opt_state}
+            if has_aux:
+                state["aux"] = aux
+            if grad_ef is not None:
+                state["grad_ef"] = grad_ef
+            return state
+
+        def body(state, batch_rows):
+            batch = jax.tree.map(lambda x: x[0], batch_rows)
+            params = state["params"]
+            loss, new_aux, grads = grad_call(params,
+                                             state.get("aux"), batch)
+            loss = lax.pmean(loss, "hvd") if hint is None else \
+                lax.pmean(lax.pmean(loss, ax_in), ax_out)
+            if has_aux:
+                new_aux = jax.tree.map(
+                    lambda a: lax.pmean(a, "hvd")
+                    if hint is None and _is_float(a.dtype) else
+                    (lax.pmean(lax.pmean(a, ax_in), ax_out)
+                     if _is_float(a.dtype) else a), new_aux)
+            leaves, treedef = jax.tree.flatten(grads)
+            p_leaves = jax.tree.leaves(params)
+            ef_in = state.get("grad_ef")
+            ef_leaves = jax.tree.leaves(ef_in) if ef_in is not None \
+                else [None] * len(leaves)
+            shard_g, shard_p, new_ef = [], [], []
+            for g, p, r in zip(leaves, p_leaves, ef_leaves):
+                n = g.size
+                pad = self._shard_pad(n, R)
+                flat = jnp.pad(g.reshape(-1).astype(jnp.float32),
+                               (0, pad - n))
+                if quant:
+                    y, nr = scatter_quant(flat, r.reshape(-1))
+                    new_ef.append(nr.reshape(r.shape))
+                else:
+                    y, _ = scatter_plain(flat)
+                if op == Average:
+                    y = y * np.float32(1.0 / R)
+                shard_g.append(y)
+                pflat = jnp.pad(p.reshape(-1), (0, pad - n))
+                shard_p.append(lax.dynamic_slice(
+                    pflat, (shard_start(pad),), (pad // R,)))
+            shard_g_tree = jax.tree.unflatten(treedef, shard_g)
+            shard_p_tree = jax.tree.unflatten(treedef, [
+                sp.astype(pl.dtype)
+                for sp, pl in zip(shard_p, p_leaves)])
+            updates, opt2 = optimizer.update(
+                jax.tree.map(lambda y, pl: y.astype(pl.dtype),
+                             shard_g_tree, shard_p_tree),
+                state["opt_state"], shard_p_tree)
+            new_shard = optax.apply_updates(shard_p_tree, updates)
+            out_leaves = []
+            for u, p in zip(jax.tree.leaves(new_shard), p_leaves):
+                full = gather_shard(u)
+                out_leaves.append(
+                    full[:p.size].reshape(p.shape).astype(p.dtype))
+            new_params = jax.tree.unflatten(treedef, out_leaves)
+            ef_out = jax.tree.unflatten(jax.tree.structure(ef_in),
+                                        new_ef) \
+                if ef_in is not None else None
+            return pack(new_params, opt2, new_aux, ef_out), loss
+
+        specs = self._state_template
+        prog = shard_map(
+            body, mesh=mesh,
+            in_specs=(specs, P("hvd") if hint is None
+                      else P((ax_out, ax_in))),
+            out_specs=(specs, P()),
+            check_vma=False)
+        donate = (0,) if self.donate else ()
+        return jax.jit(prog, donate_argnums=donate)
+
     # -- staging -------------------------------------------------------------
 
     def init_state(self, params, aux=None):
         """Build a replicated device-resident train state from host (or
         device) params (and mutable-model ``aux``, e.g. batch_stats,
-        when the step was built with ``has_aux``)."""
+        when the step was built with ``has_aux``).
+
+        ``sharded=True`` builds the weight-update-sharded state
+        instead: params replicated (forward needs them whole), the
+        optimizer state as FLAT dp-sharded leaves — each device holds
+        1/R of every moment buffer, the ÷R memory the mode exists
+        for — plus, under a quantized gradient wire, the per-rank EF
+        residual as device state."""
+        if self.sharded:
+            return self._init_state_sharded(params, aux)
         eng, ps = _ps_state(self.process_set)
         ex = ps.executor
         opt_state = self.optimizer.init(params)
@@ -1430,6 +1698,63 @@ class _CompiledTrainStep:
             return jax.device_put(np.asarray(x), ex.devices[0])
 
         return jax.tree.map(put_single, state)
+
+    def _init_state_sharded(self, params, aux=None):
+        eng, ps = _ps_state(self.process_set)
+        ex = ps.executor
+        if not ex.shard_mode:
+            raise ValueError(
+                "sharded=True needs shard-mode execution (one device "
+                "per rank); the stacked single-device emulation has "
+                "no per-rank state to shard")
+        R = ex.num_ranks
+        hint = self._resolve_shard_hint(ex)
+        mesh = ex.mesh if hint is None else \
+            ex.mesh2d(hint.inner, hint.reduce_axes)
+        dim0 = P("hvd") if hint is None else \
+            P((hint.reduce_axes[1], hint.reduce_axes[0]))
+
+        def flat_pad(p):
+            p = jnp.asarray(p)
+            return jnp.pad(p.reshape(-1),
+                           (0, self._shard_pad(p.size, R) - p.size))
+
+        opt_state = self.optimizer.init(
+            jax.tree.map(flat_pad, params))
+        rep = NamedSharding(mesh, P())
+        shd = NamedSharding(mesh, dim0)
+
+        def blocks(idx, shape):
+            return np.zeros(tuple(len(range(*sl.indices(d)))
+                                  for sl, d in zip(idx, shape)),
+                            np.float32)
+
+        def put(x, sharding):
+            x = np.asarray(x)
+            return jax.make_array_from_callback(
+                x.shape, sharding, lambda idx, _x=x: _x[idx])
+
+        def put_opt(x):
+            x = np.asarray(x)
+            sharded = x.ndim >= 1 and x.shape[0] % R == 0 \
+                and x.shape[0] > 0
+            return put(x, shd if sharded else rep)
+
+        state = {"params": jax.tree.map(lambda p: put(p, rep),
+                                        params),
+                 "opt_state": jax.tree.map(put_opt, opt_state)}
+        if self.has_aux:
+            state["aux"] = jax.tree.map(
+                lambda a: put(a, rep), {} if aux is None else aux)
+        if self.wire_dtype in ("int8", "int4"):
+            def ef_leaf(p):
+                pad = self._shard_pad(np.asarray(p).size, R)
+                shape = (R, pad)
+                return jax.make_array_from_callback(
+                    shape, shd,
+                    lambda idx, _s=shape: blocks(idx, _s))
+            state["grad_ef"] = jax.tree.map(ef_leaf, params)
+        return state
 
     def _stage_batch(self, ex, slots):
         """{pos: batch_tree} for local ranks → global (R, ...) batch."""
@@ -1466,16 +1791,26 @@ class _CompiledTrainStep:
                 self._sig_checked = False
                 self._ex = ex
             if self._prog is None:
+                build = self._build_sharded if self.sharded \
+                    else self._build
+                # the sharded decomposition (wire + TopologyHint) is
+                # part of the cache key: the same model under a
+                # different hint/wire is a different XLA program, and
+                # per-stage hints keep pp programs distinct
+                mode = ("sharded", self.wire_dtype,
+                        self.topology_hint.key()
+                        if self.topology_hint is not None else None) \
+                    if self.sharded else None
                 if self._tag is not None:
-                    key = ("step", _ex_uid(ex), self._tag)
+                    key = ("step", _ex_uid(ex), self._tag, mode)
                     self._prog = _shared_program(
-                        key, lambda: self._build(ex))
+                        key, lambda: build(ex))
                 else:
                     # untagged (single-rank) steps skip the shared
                     # cache but still report cache traffic + compile
                     # time to the registry (bench.py reads these)
                     _cache_metrics()[1].inc()
-                    self._prog = _TimedFirstCall(self._build(ex))
+                    self._prog = _TimedFirstCall(build(ex))
             else:
                 _cache_metrics()[0].inc()
             return self._prog
@@ -1530,6 +1865,9 @@ class _CompiledTrainStep:
         eng, ps = _ps_state(self.process_set)
         ex = ps.executor
         n_local = len(ex.local_positions)
+        if self.sharded and self._state_template is None:
+            self._state_template = self._shard_specs(
+                state, self._resolve_shard_hint(ex), ex.num_ranks)
 
         if n_local == 1:
             self._check_step_signature(eng, ps, state, batch)
@@ -1569,7 +1907,9 @@ class StagedBatch:
 
 def make_compiled_train_step(loss_fn, optimizer, *, op=Average,
                              process_set=global_process_set,
-                             donate=True, has_aux=False):
+                             donate=True, has_aux=False,
+                             sharded=False, wire_dtype=None,
+                             topology_hint=None):
     """Build the fully-compiled Horovod train step (reference
     ``xla_mpi_ops.cc`` capability, done the TPU way).
 
@@ -1599,6 +1939,18 @@ def make_compiled_train_step(loss_fn, optimizer, *, op=Average,
         state = step.init_state(params)
         for batch in shard_of_data:
             state, loss = step(state, batch)
+
+    ``sharded=True`` compiles the ZeRO-grade weight-update-sharded
+    step instead (arXiv:1909.09756; docs/parallelism.md): gradients
+    REDUCESCATTER (``lax.psum_scatter``, per-hop under
+    ``topology_hint``, optionally over a 16-bit or shared-scale
+    int8/int4 ``wire_dtype`` with a state-threaded EF residual), the
+    optimizer update runs on each rank's flat 1/R shard of params +
+    optimizer state (÷R state memory — ``init_state`` builds the
+    sharded layout), and the updated params ALLGATHER back — still
+    ONE cached program, same call contract.
     """
     return _CompiledTrainStep(loss_fn, optimizer, op, process_set,
-                              donate, has_aux=has_aux)
+                              donate, has_aux=has_aux,
+                              sharded=sharded, wire_dtype=wire_dtype,
+                              topology_hint=topology_hint)

@@ -819,10 +819,11 @@ class MeshExecutor:
     def chunk_sizes(d0, num_ranks):
         """Uneven reducescatter chunking: as even as possible, larger
         chunks on lower ranks (reference collective_operations.cc
-        ReducescatterOp::ComputeOutputShapeForRank)."""
-        base = d0 // num_ranks
-        extra = d0 % num_ranks
-        return [base + (1 if r < extra else 0) for r in range(num_ranks)]
+        ReducescatterOp::ComputeOutputShapeForRank).  THE rule lives
+        in core/sharded.py — the shard planner slices by it, so one
+        definition keeps the plan and the scatter from drifting."""
+        from ..core.sharded import chunk_sizes as _rule
+        return _rule(d0, num_ranks)
 
     def reducescatter(self, rows, d0, rest_shape, op: ReduceOp,
                       prescale=1.0, postscale=1.0):

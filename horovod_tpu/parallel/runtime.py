@@ -753,6 +753,27 @@ class MpmdWorker:
             self.tp_mesh = Mesh(np.array(local[: self.tp]), ("tp",))
         else:
             self.tp_mesh = None
+        # ZeRO-grade weight-update sharding on the dp hop
+        # (docs/parallelism.md "Weight-update sharding"): at each
+        # reduce tick the chunk's gradients go out as a grouped
+        # REDUCESCATTER over the stage set instead of an allreduce,
+        # the optimizer updates only this rank's dim0 shard of each
+        # layer leaf (layer optimizer state is ÷dp), and the updated
+        # shards ALLGATHER back ASYNCHRONOUSLY — the handles resolve
+        # at the NEXT step's start, so the param gather rides the
+        # engine's background thread through the inter-step gap and
+        # the next step's latch/staging (the reduce-tick seam's
+        # overlap, extended to the weight gather).  Embed/ln_final
+        # stay dense (tiny, and tied across stages).
+        self.sharded = bool(getattr(eng.config, "sharded_optimizer",
+                                    False)) and self.dp > 1
+        if self.sharded and self.tp > 1:
+            raise ValueError(
+                "sharded dp updates do not compose with proc-local "
+                "tp yet (the dim0 shard would cut across the tp "
+                "placement); run sharded with tp=1")
+        self._shard_fp = None
+        self._param_ag = None     # deferred updated-param allgather
 
     # -- state ----------------------------------------------------------
 
@@ -778,12 +799,62 @@ class MpmdWorker:
             state["embed"] = params["embed"]
         if self.my_stage == S - 1:
             state["ln_final"] = params["ln_final"]
-        state["opt"] = {k: self.optimizer.init(v)
-                        for k, v in state.items() if k != "opt"}
+        if self.sharded:
+            import hashlib
+            import json
+
+            shapes = [list(np.shape(l)) for l in
+                      jax.tree_util.tree_leaves(state["layers"])]
+            self._shard_fp = hashlib.md5(json.dumps(
+                ["pp-dim0", self.dp, shapes]).encode()).hexdigest()[:16]
+            shard_layers = {
+                v: jax.tree_util.tree_map(self._dim0_shard, lcv)
+                for v, lcv in state["layers"].items()}
+            state["opt"] = {k: self.optimizer.init(
+                shard_layers if k == "layers" else v)
+                for k, v in state.items() if k != "opt"}
+            self._record_sharded_state_bytes(state)
+        else:
+            state["opt"] = {k: self.optimizer.init(v)
+                            for k, v in state.items() if k != "opt"}
         if self.tp_mesh is not None:
             state = self._place_tp(state)
         self._state = state
         return state
+
+    def _dim0_shard(self, arr):
+        """This rank's dim0 slice of a layer leaf (the engine
+        executor's exact reducescatter chunking, so the scatter
+        output IS the shard)."""
+        from ..core.sharded import chunk_sizes
+
+        a = jnp.asarray(arr)
+        ch = chunk_sizes(int(a.shape[0]), self.dp)
+        start = sum(ch[: self.dp_index])
+        return a[start:start + ch[self.dp_index]]
+
+    def _record_sharded_state_bytes(self, state):
+        """÷dp evidence for the pp runtime: bytes of the sharded
+        layer optimizer state (plus the dense embed/ln tail) next to
+        the dense equivalent."""
+        try:
+            from .. import telemetry
+
+            def nbytes(tree):
+                return sum(
+                    int(np.prod(np.shape(l) or (1,))) *
+                    np.dtype(getattr(l, "dtype", np.float32)).itemsize
+                    for l in jax.tree_util.tree_leaves(tree))
+
+            shard = nbytes(state["opt"])
+            dense_layers = jax.eval_shape(
+                self.optimizer.init, state["layers"])
+            full = shard - nbytes(state["opt"]["layers"]) \
+                + nbytes(dense_layers)
+            telemetry.set_optimizer_state_bytes("shard", shard)
+            telemetry.set_optimizer_state_bytes("full", full)
+        except Exception:  # noqa: BLE001 — telemetry must never kill
+            pass           # a training job
 
     def _place_tp(self, state):
         shd = {}
@@ -851,6 +922,10 @@ class MpmdWorker:
         state = self._state
         if state is None:
             raise RuntimeError("call init() before step()")
+        # land the PREVIOUS step's overlapped updated-param allgather
+        # before any forward touches the layers (sharded mode)
+        self._drain_param_ag()
+        state = self._state
         B = int(tokens.shape[0])
         sched, M, sobj = self._latch(B)
         tag = pp_label(sched, M)
@@ -1007,11 +1082,22 @@ class MpmdWorker:
                         v_r = instr.chunk * S + s
                         g = st.acc[v_r]["layers"]
                         leaves, _ = jax.tree_util.tree_flatten(g)
-                        hs = hvd_ops.grouped_allreduce_async(
-                            [np.asarray(x, np.float32) for x in leaves],
-                            op=hvd_ops.Average,
-                            name=f"pp.grad.{step_no}.{v_r}",
-                            process_set=self.stage_sets[s])
+                        rows = [np.asarray(x, np.float32)
+                                for x in leaves]
+                        if self.sharded:
+                            # weight-update sharding: the dp hop is a
+                            # reducescatter — each rank receives only
+                            # its dim0 shard of every layer gradient
+                            hs = hvd_ops.grouped_reducescatter_async(
+                                rows, op=hvd_ops.Average,
+                                name=f"pp.grad.{step_no}.{v_r}",
+                                process_set=self.stage_sets[s],
+                                shard_fp=self._shard_fp)
+                        else:
+                            hs = hvd_ops.grouped_allreduce_async(
+                                rows, op=hvd_ops.Average,
+                                name=f"pp.grad.{step_no}.{v_r}",
+                                process_set=self.stage_sets[s])
                         reduce_handles.append((v_r, "layers", hs))
                         _count_overlap()
 
@@ -1071,6 +1157,26 @@ class MpmdWorker:
             for k2, p in state.items():
                 if k2 == "opt":
                     continue
+                if self.sharded and k2 == "layers":
+                    # shard update: grads["layers"] already holds the
+                    # reducescattered dim0 shards; the params and
+                    # optimizer state slices match by construction
+                    shard_p = {v: jax.tree_util.tree_map(
+                        self._dim0_shard, lcv) for v, lcv in p.items()}
+                    gk = jax.tree_util.tree_map(
+                        lambda g, pp_: jnp.asarray(g, pp_.dtype),
+                        grads[k2], shard_p)
+                    upd, opt2 = self.optimizer.update(
+                        gk, state["opt"][k2], shard_p)
+                    new_shard = optax.apply_updates(shard_p, upd)
+                    new_state["opt"][k2] = opt2
+                    # updated shards ride home ASYNC — the gather
+                    # lands at the next step's start; until then the
+                    # layers stay at their pre-update values, which
+                    # nothing reads (the step is over)
+                    self._submit_param_ag(p, new_shard)
+                    new_state[k2] = p
+                    continue
                 gk = jax.tree_util.tree_map(
                     lambda g, pp_: jnp.asarray(g, getattr(pp_, "dtype",
                                                           jnp.float32)),
@@ -1109,9 +1215,49 @@ class MpmdWorker:
             # rejoin) and fail cross-rank validation
             self.eng.config.pp_sched_tag = None
 
+    def _submit_param_ag(self, layers, new_shard):
+        """Submit the updated-shard allgather without waiting: the
+        engine's background thread moves it while the host returns
+        from step() and stages the next batch — the overlap half of
+        the sharded dp hop."""
+        from ..ops import api as hvd_ops
+        from .. import telemetry
+
+        leaves, treedef = jax.tree_util.tree_flatten(new_shard)
+        dtypes = [l.dtype for l in
+                  jax.tree_util.tree_leaves(layers)]
+        # f32 on the wire like the activation hops (numpy fabric);
+        # dtypes restore the leaf dtype on the way back in
+        h = hvd_ops.grouped_allgather_async(
+            [np.ascontiguousarray(np.asarray(l, np.float32))
+             for l in leaves],
+            name=f"pp.param.{self._step_no}",
+            process_set=self.stage_sets[self.my_stage],
+            shard_fp=self._shard_fp)
+        self._param_ag = (h, treedef, dtypes)
+        telemetry.count_sharded_update()
+
+    def _drain_param_ag(self):
+        """Install the overlapped allgather's full updated layers
+        (no-op outside sharded mode / when nothing is pending)."""
+        if self._param_ag is None:
+            return
+        from ..ops import api as hvd_ops
+
+        h, treedef, dtypes = self._param_ag
+        self._param_ag = None
+        out = hvd_ops.synchronize(h)
+        if not isinstance(out, (list, tuple)):
+            out = [out]
+        full = jax.tree_util.tree_unflatten(
+            treedef, [jnp.asarray(x, dt)
+                      for x, dt in zip(out, dtypes)])
+        self._state["layers"] = full
+
     def full_params(self):
         """Gather this rank's view into the canonical params pytree
         pieces it holds (tests / checkpoint glue)."""
+        self._drain_param_ag()
         out = {"layers": dict(self._state["layers"])}
         if "embed" in self._state:
             out["embed"] = self._state["embed"]

@@ -37,7 +37,8 @@ def make_lm_train_step(mesh: Mesh, cfg: TransformerConfig,
                        learning_rate: float = 1e-3,
                        fused_ce: bool = False,
                        ce_chunks: int = 16,
-                       pipeline=None):
+                       pipeline=None,
+                       sharded=None):
     """Build (init_fn, step_fn) for the transformer over ``mesh``.
 
     ``step_fn(state, tokens) -> (state, loss)`` is jitted with explicit
@@ -65,6 +66,9 @@ def make_lm_train_step(mesh: Mesh, cfg: TransformerConfig,
     runtime data the autotuner flips between steps).
     """
     optimizer = optimizer or optax.adamw(learning_rate)
+    if sharded is None:
+        from ..common import env as env_mod
+        sharded = env_mod.get_bool(env_mod.HOROVOD_SHARDED_OPTIMIZER)
     if attention_impl not in ("ring", "ulysses", "flash"):
         raise ValueError(
             f"attention_impl must be 'ring', 'ulysses', or 'flash', "
@@ -164,7 +168,8 @@ def make_lm_train_step(mesh: Mesh, cfg: TransformerConfig,
     def shard_state(state):
         pspec = transformer_param_shardings(mesh, state["params"])
         ospec = _opt_state_shardings(mesh, state["opt_state"],
-                                     state["params"], pspec)
+                                     state["params"], pspec,
+                                     sharded=sharded)
         return {"params": pspec, "opt_state": ospec,
                 "step": replicated(mesh)}
 
@@ -176,27 +181,102 @@ def make_lm_train_step(mesh: Mesh, cfg: TransformerConfig,
             in_shardings=(spec, tok_sharding),
             out_shardings=(spec, replicated(mesh)),
             donate_argnums=(0,))
-        return compiled, jax.device_put(state, spec)
+        placed = jax.device_put(state, spec)
+        if sharded:
+            _record_opt_state_bytes(placed["opt_state"])
+        return compiled, placed
 
     return init, step, jit_step, tok_sharding
 
 
-def _opt_state_shardings(mesh, opt_state, params, param_shardings):
+def _record_opt_state_bytes(opt_state):
+    """Export the ÷dp evidence for the SPMD path: per-device bytes of
+    the placed optimizer state (scope="shard") next to the global
+    bytes a dense replica would hold (scope="full")."""
+    try:
+        from .. import telemetry
+        shard = full = 0
+        for leaf in jax.tree_util.tree_leaves(opt_state):
+            if not hasattr(leaf, "addressable_shards"):
+                continue
+            full += int(leaf.size) * leaf.dtype.itemsize
+            shards = leaf.addressable_shards
+            if shards:
+                d = shards[0].data
+                shard += int(np.prod(d.shape, dtype=np.int64)
+                             if d.shape else 1) * leaf.dtype.itemsize
+        telemetry.set_optimizer_state_bytes("shard", shard)
+        telemetry.set_optimizer_state_bytes("full", full)
+    except Exception:  # noqa: BLE001 — telemetry must never kill a
+        pass           # training job
+
+
+def _opt_state_shardings(mesh, opt_state, params, param_shardings,
+                         sharded=False):
     """Optimizer-state sharding: any leaf whose shape matches a
     parameter's gets that parameter's sharding (adam m/v mirror the
     weights — sharding them alike keeps fsdp memory O(params/n));
-    everything else (counts, scalars) is replicated."""
+    everything else (counts, scalars) is replicated.
+
+    ``sharded=True`` is weight-update sharding for the SPMD path
+    (arXiv:1909.09756; docs/parallelism.md): moment leaves are
+    additionally split over the dp axes on their largest divisible
+    axis.  With the optimizer state dp-sharded while params stay
+    replicated, XLA's SPMD partitioner emits exactly the
+    reducescatter(grads) → 1/dp-shard update → allgather(params)
+    decomposition — the compiler-native spelling of the same
+    mechanism the engine-path ``DistributedOptimizer(sharded=True)``
+    runs by hand — and optimizer-state memory drops by dp."""
     flat_params = jax.tree_util.tree_leaves(params)
     flat_shard = jax.tree_util.tree_leaves(
         param_shardings, is_leaf=lambda x: isinstance(x, NamedSharding))
     by_shape = {}
     for p, s in zip(flat_params, flat_shard):
         by_shape.setdefault(p.shape, s)
+    dp_axes = [a for a in BATCH_AXES if a in mesh.shape]
+    dp_total = int(np.prod([mesh.shape[a] for a in dp_axes])) \
+        if dp_axes else 1
+
+    def dp_shard(shape, base):
+        """Split the largest axis not already sharded by ``base``
+        over the dp axes the base spec does not already use; fall
+        back to ``base`` when nothing divides."""
+        spec = list(base.spec) + [None] * (len(shape) - len(base.spec))
+        used = set()
+        for entry in spec:
+            for a in (entry if isinstance(entry, tuple)
+                      else (entry,) if entry else ()):
+                used.add(a)
+        free = [a for a in dp_axes if a not in used]
+        total = int(np.prod([mesh.shape[a] for a in free])) \
+            if free else 1
+        if total <= 1:
+            return base
+        order = sorted(range(len(shape)), key=lambda i: -shape[i])
+        for i in order:
+            if not shape[i]:
+                continue
+            entry = spec[i]
+            cur = (entry if isinstance(entry, tuple)
+                   else (entry,) if entry else ())
+            # an axis nominally sharded by size-1 mesh axes (tp/fsdp
+            # on a pure-dp mesh) still has its full capacity free —
+            # append the dp axes to the entry instead of skipping it
+            factor = int(np.prod([mesh.shape[a] for a in cur])) \
+                if cur else 1
+            if (shape[i] // factor) % total == 0 \
+                    and shape[i] // factor > 0:
+                spec[i] = cur + tuple(free)
+                return NamedSharding(mesh, P(*spec))
+        return base
 
     def pick(leaf):
         if hasattr(leaf, "shape") and leaf.shape in by_shape \
                 and len(leaf.shape) > 0:
-            return by_shape[leaf.shape]
+            base = by_shape[leaf.shape]
+            if sharded and dp_total > 1:
+                return dp_shard(leaf.shape, base)
+            return base
         return replicated(mesh)
 
     return jax.tree_util.tree_map(pick, opt_state)

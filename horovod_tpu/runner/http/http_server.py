@@ -1631,6 +1631,7 @@ class Coordinator:
                                  ("wi", "inner wire dtypes"),
                                  ("algo", "algorithms"),
                                  ("pp", "pipeline schedules"),
+                                 ("sfp", "shard layouts"),
                                  ("root", "root ranks")):
                 if m.get(field) != first.get(field):
                     return (f"Mismatched {label} for {key}: "

@@ -175,7 +175,7 @@ AUTOTUNE_BEST_CONFIG_HELP = ("Current best autotune configuration "
                              "(value 1; the labels are the config)")
 AUTOTUNE_BEST_CONFIG_LABELS = ("fusion_threshold_bytes",
                                "cycle_time_ms", "wire", "algorithm",
-                               "pipeline")
+                               "pipeline", "shard_layout")
 ELASTIC_RESIZE_FAMILY = "horovod_elastic_resize_events_total"
 ELASTIC_RESIZE_HELP = ("Elastic membership changes seen by this "
                        "worker")
@@ -196,6 +196,25 @@ WIRE_HOP_BYTES_HELP = ("Interconnect bytes per decomposition hop, "
                        "(hop=inner: intra-host/ICI, hop=cross: "
                        "cross-host/DCN)")
 WIRE_HOP_BYTES_LABELS = ("hop", "wire")
+
+# -- ZeRO-grade weight-update sharding (docs/parallelism.md
+#    "Weight-update sharding"; core/sharded.py + the sharded
+#    frontends + ops/compiled.py): the state gauge is THE ÷dp
+#    evidence — scope="shard" is what this rank actually holds,
+#    scope="full" the dense equivalent, and a scrape divides them to
+#    read dp.  The runs counter ticks once per
+#    reducescatter→shard-update→allgather round.
+
+OPTIMIZER_STATE_BYTES_FAMILY = "horovod_optimizer_state_bytes"
+OPTIMIZER_STATE_BYTES_HELP = (
+    "Optimizer-state bytes, by scope (shard = held by this rank "
+    "under weight-update sharding, full = the dense equivalent; "
+    "full/shard reads as dp)")
+OPTIMIZER_STATE_BYTES_LABELS = ("scope",)
+SHARDED_UPDATE_RUNS_FAMILY = "horovod_sharded_update_runs_total"
+SHARDED_UPDATE_RUNS_HELP = (
+    "Sharded weight-update rounds executed (reducescatter grads -> "
+    "1/dp shard update -> allgather updated params)")
 
 # -- MPMD pipeline runtime (docs/parallelism.md; parallel/runtime.py):
 #    the runtime and pp_smoke/benchmarks consume these, so the family
@@ -258,6 +277,23 @@ def observe_control_cycle(tier, seconds):
         CONTROL_CYCLE_SECONDS_FAMILY, CONTROL_CYCLE_SECONDS_HELP,
         labelnames=CONTROL_CYCLE_SECONDS_LABELS).labels(
         tier=tier).observe(seconds)
+
+
+def count_sharded_update():
+    """One sharded weight-update round (core/sharded.ShardedUpdater
+    or the pp runtime's sharded dp hop), into the process-current
+    registry."""
+    registry().counter(SHARDED_UPDATE_RUNS_FAMILY,
+                       SHARDED_UPDATE_RUNS_HELP).inc()
+
+
+def set_optimizer_state_bytes(scope, nbytes):
+    """Export this worker's optimizer-state bytes under ``scope``
+    ('shard' | 'full') — the weight-update-sharding memory evidence."""
+    registry().gauge(
+        OPTIMIZER_STATE_BYTES_FAMILY, OPTIMIZER_STATE_BYTES_HELP,
+        labelnames=OPTIMIZER_STATE_BYTES_LABELS).labels(
+        scope=scope).set(int(nbytes))
 
 
 def metrics():

@@ -906,6 +906,162 @@ def PartialDistributedGradientTape(gradtape=None, device_dense="",
     return tape
 
 
+def _make_sharded_optimizer(optimizer, compression, op,
+                            gradient_predivide_factor, process_set):
+    """ZeRO-grade weight-update sharding for keras-3 optimizers
+    (docs/parallelism.md "Weight-update sharding"): gradients go out
+    as a grouped REDUCESCATTER on the quantized wire, a TWIN instance
+    of the wrapped optimizer class (``from_config`` — same
+    hyperparameters) updates only this rank's 1/dp shard as flat
+    per-bucket variables, and the updated params ALLGATHER back over
+    the same wire with their own error-feedback state
+    (core/sharded.ShardedUpdater).  The OUTER optimizer never builds
+    per-variable slots — that absence IS the ÷dp memory win, exported
+    as ``horovod_optimizer_state_bytes{scope}``."""
+    if op not in (Average, Sum):
+        raise ValueError("sharded=True supports op=Average or Sum")
+    if gradient_predivide_factor != 1.0 and op != Average:
+        raise ValueError("gradient_predivide_factor not supported "
+                         "with op != Average")
+    base_cls = optimizer.__class__
+    from ..core.sharded import compression_wire
+    wire = compression_wire(compression)
+
+    class _ShardedDistributed(base_cls):
+        _hvd_wrapped = True
+        _hvd_sharded = True
+
+        def _hvd_build(self, tvars):
+            import numpy as np
+
+            from ..core.sharded import ShardPlan, ShardedUpdater
+
+            eng = _basics.engine()
+            ps_id = process_set.process_set_id or 0
+            dp = len(eng.process_set_ranks(ps_id))
+            specs = [(f"var.{i}", tuple(v.shape.as_list()),
+                      v.dtype.base_dtype.name, 0)
+                     for i, v in enumerate(tvars)]
+            plan = ShardPlan(specs, dp,
+                             eng.config.fusion_threshold_bytes,
+                             layout=getattr(eng.config,
+                                            "shard_layout", "bucket"))
+            self._hvd_updater = ShardedUpdater(
+                plan, process_set=process_set, op=op,
+                grad_wire=wire, param_wire=wire, name="shardopt.tf")
+            pos = self._hvd_updater.my_pos()
+            vals = {f"var.{i}": v.numpy()
+                    for i, v in enumerate(tvars)}
+            self._hvd_shards = []
+            for b in plan.buckets:
+                full = plan.pack(b, vals, dtype=np.dtype(b.dtype))
+                s, e = b.shard_slice(pos)
+                self._hvd_shards.append(tf.Variable(
+                    full[s:e], trainable=True,
+                    name=f"hvd_shard_{b.index}"))
+            self._hvd_twin = base_cls.from_config(self.get_config())
+            self._hvd_vars = list(tvars)
+
+        def _hvd_state_bytes(self):
+            total = 0
+            for v in getattr(self._hvd_twin, "variables", []):
+                try:
+                    total += int(np.prod(v.shape.as_list() or [1])) \
+                        * v.dtype.size
+                except Exception:  # noqa: BLE001 — symbolic shapes
+                    pass
+            if total == 0:
+                total = sum(
+                    int(np.prod(t.shape.as_list() or [1]))
+                    * t.dtype.size for t in self._hvd_shards)
+            self._hvd_updater.record_state_bytes(total)
+
+        def register_local_var(self, var):
+            raise ValueError(
+                "register_local_var is not supported with "
+                "sharded=True (every trainable var is part of the "
+                "shard layout)")
+
+        def reset_wire_state(self):
+            if getattr(self, "_hvd_updater", None) is not None:
+                self._hvd_updater.reset_wire_state()
+            else:
+                from ..ops.compiled import reset_ef_state
+                reset_ef_state()
+
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            import numpy as np
+
+            gv = list(grads_and_vars)
+            tvars = [v for _, v in gv]
+            n_ranks = len(_basics.engine().process_set_ranks(
+                process_set.process_set_id or 0))
+            if n_ranks == 1:
+                return super().apply_gradients(gv, *args, **kwargs)
+            if getattr(self, "_hvd_updater", None) is None:
+                self._hvd_build(tvars)
+            if [id(v) for v in tvars] != \
+                    [id(v) for v in self._hvd_vars]:
+                raise ValueError(
+                    "sharded=True needs a stable variable list "
+                    "across apply_gradients calls (the shard layout "
+                    "is positional)")
+            plan = self._hvd_updater.plan
+            pre = post = 1.0
+            if op == Average and gradient_predivide_factor != 1.0:
+                pre = 1.0 / gradient_predivide_factor
+                post = gradient_predivide_factor
+            grads = {}
+            for i, (g, _v) in enumerate(gv):
+                if g is None:
+                    # zero-filling would let weight/moment decay move
+                    # a param the dense wrapper leaves untouched —
+                    # refuse instead of silently diverging
+                    raise ValueError(
+                        "sharded=True got a None gradient for "
+                        f"variable {i}; filter (grad, var) pairs "
+                        "before apply_gradients (the flat shard "
+                        "update cannot skip parameters elementwise)")
+                if isinstance(g, tf.IndexedSlices):
+                    g = tf.convert_to_tensor(g)
+                grads[f"var.{i}"] = np.asarray(g)
+            bufs = [plan.pack(b, grads, dtype=np.dtype(b.dtype))
+                    for b in plan.buckets]
+            if pre != 1.0:
+                bufs = [b * np.float32(pre) for b in bufs]
+            shard_grads = self._hvd_updater.reduce_grads(bufs)
+            twin_gv = []
+            for sg, sv in zip(shard_grads, self._hvd_shards):
+                g = np.asarray(sg, dtype=sv.dtype.as_numpy_dtype)
+                if post != 1.0:
+                    g = g * np.float32(post)
+                twin_gv.append((tf.convert_to_tensor(g), sv))
+            # mirror a numeric learning rate each step so schedules /
+            # user assignments on the OUTER optimizer apply (schedule
+            # objects were cloned by from_config and track iterations)
+            try:
+                lr = self.learning_rate
+                if not callable(lr):
+                    self._hvd_twin.learning_rate = float(
+                        tf.convert_to_tensor(lr).numpy())
+            except Exception:  # noqa: BLE001 — exotic LR containers
+                pass
+            result = self._hvd_twin.apply_gradients(twin_gv)
+            full = self._hvd_updater.gather_params(
+                [sv.numpy() for sv in self._hvd_shards])
+            for b, buf in zip(plan.buckets, full):
+                for key, arr in plan.unpack(b, buf).items():
+                    self._hvd_vars[int(key.split(".")[1])].assign(arr)
+            self.iterations.assign_add(1)
+            self._hvd_state_bytes()
+            return result
+
+    _ShardedDistributed.__name__ = f"Sharded{base_cls.__name__}"
+    optimizer.__class__ = _ShardedDistributed
+    optimizer._hvd_updater = None
+    return optimizer
+
+
 def DistributedOptimizer(optimizer, name=None,
                          compression=Compression.none,
                          sparse_as_dense=False, op=Average,
@@ -914,14 +1070,35 @@ def DistributedOptimizer(optimizer, name=None,
                          average_aggregated_gradients=False,
                          num_groups=0, groups=None,
                          process_set=global_process_set,
-                         scale_local_gradients=True):
+                         scale_local_gradients=True, sharded=None):
     """Optimizer wrapper (reference
     ``horovod/tensorflow/__init__.py:889`` / ``keras/__init__.py:40``):
     gradients are averaged across ranks inside ``apply_gradients``.
     ``backward_passes_per_step > 1`` accumulates that many
     micro-batches locally before each allreduce (reference
     gradient_aggregation_eager.py LocalGradientAggregationHelperEager).
-    Works with keras-3 optimizers."""
+    Works with keras-3 optimizers.
+
+    ``sharded=True`` (default: ``HOROVOD_SHARDED_OPTIMIZER``) selects
+    ZeRO-grade weight-update sharding — reducescatter grads, update
+    this rank's 1/dp shard, allgather the updated params
+    (docs/parallelism.md "Weight-update sharding")."""
+    if sharded is None:
+        from ..common import env as _env
+        sharded = _env.get_bool(_env.HOROVOD_SHARDED_OPTIMIZER)
+    if sharded:
+        if backward_passes_per_step != 1:
+            raise ValueError(
+                "backward_passes_per_step > 1 is not supported with "
+                "sharded=True (accumulate before apply_gradients)")
+        if sparse_as_dense or num_groups != 0 or groups is not None:
+            raise ValueError(
+                "sparse_as_dense/groups do not apply with "
+                "sharded=True: the shard layout is dense and "
+                "fusion-bucket derived")
+        return _make_sharded_optimizer(
+            optimizer, compression, op, gradient_predivide_factor,
+            process_set)
     base_cls = optimizer.__class__
     bpps = int(backward_passes_per_step)
     if bpps < 1:

@@ -13,6 +13,7 @@ reference's fusion buffer + NCCL launch.
 import warnings
 from contextlib import contextmanager
 
+import numpy as np
 import torch
 
 from ..common import basics
@@ -385,18 +386,507 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             self._allreduce_delay[p] = passes
 
 
+class _ShardedDistributedOptimizer(torch.optim.Optimizer):
+    """ZeRO-grade weight-update sharding (docs/parallelism.md
+    "Weight-update sharding"; arXiv:1909.09756): gradients go out as
+    a grouped REDUCESCATTER on the quantized wire, a shadow instance
+    of the wrapped optimizer class updates only this rank's 1/dp
+    shard of the parameters + optimizer state (flat per-bucket slices
+    — element-wise optimizers like SGD/Adam/AdamW update flat buffers
+    identically to per-tensor), and the updated parameters ALLGATHER
+    back over the same wire with their own error-feedback state
+    (core/sharded.ShardedUpdater).  Optimizer-state memory is ÷dp —
+    ``horovod_optimizer_state_bytes{scope}`` proves it from a scrape.
+
+    Grafted onto a dynamic subclass of the wrapped optimizer's class
+    like the dense wrapper, but the OUTER instance's per-param state
+    stays empty (that is the memory win) — ``param_groups`` keeps the
+    model's params so LR schedulers and ``zero_grad`` work unchanged,
+    and group hyperparameters are mirrored into the shadow groups at
+    every step so schedules apply."""
+
+    def _shard_init(self, named_parameters=None,
+                    compression=Compression.none, op=Average,
+                    gradient_predivide_factor=1.0,
+                    process_set=global_process_set):
+        if op not in (Average, Sum):
+            raise ValueError(
+                "sharded=True supports op=Average or Sum (the "
+                "reducescatter wire has no adasum combine)")
+        self._compression = compression
+        self._wire_dtype = _compression_wire(compression)
+        self.op = op
+        self.gradient_predivide_factor = gradient_predivide_factor
+        self.process_set = process_set
+        self._parameter_names = {}
+        if named_parameters is not None:
+            self._parameter_names = {v: k for k, v in named_parameters}
+        self._updater = None
+        self._shadow = None
+        self._shadow_params = []      # [(bucket, torch shard tensor)]
+        self._by_name = {}
+        self._synchronized = False
+        self._should_synchronize = True
+        self._opt_called = False
+
+    # -- plan / build --------------------------------------------------------
+
+    def _param_name(self, gi, pi, p):
+        return self._parameter_names.get(
+            p, f"shardopt.noname.{gi}.{pi}")
+
+    def _specs(self):
+        specs, by_name = [], {}
+        for gi, group in enumerate(self.param_groups):
+            for pi, p in enumerate(group["params"]):
+                if not p.requires_grad:
+                    continue
+                name = self._param_name(gi, pi, p)
+                specs.append((name, tuple(p.shape),
+                              str(p.dtype).replace("torch.", ""), gi))
+                by_name[name] = p
+        return specs, by_name
+
+    def _build(self, layout=None):
+        from ..core.sharded import ShardPlan, ShardedUpdater
+
+        eng = basics.engine()
+        ps_id = self.process_set.process_set_id or 0
+        dp = len(eng.process_set_ranks(ps_id))
+        layout = layout if layout is not None \
+            else getattr(eng.config, "shard_layout", "bucket")
+        specs, self._by_name = self._specs()
+        plan = ShardPlan(specs, dp,
+                         eng.config.fusion_threshold_bytes,
+                         layout=layout)
+        self._updater = ShardedUpdater(
+            plan, process_set=self.process_set, op=self.op,
+            grad_wire=self._wire_dtype, param_wire=self._wire_dtype,
+            name="shardopt")
+        pos = self._updater.my_pos()
+        # shadow optimizer: one flat shard tensor per bucket, grouped
+        # so each bucket inherits ITS param group's hyperparameters
+        self._shadow_params = []
+        groups = [dict(g, params=[]) for g in self.param_groups]
+        for b in plan.buckets:
+            full = plan.pack(b, {n: p.detach().numpy()
+                                 for n, p in self._by_name.items()},
+                             dtype=_np_dtype(b.dtype))
+            s, e = b.shard_slice(pos)
+            t = torch.nn.Parameter(
+                torch.from_numpy(full[s:e].copy()),
+                requires_grad=True)
+            self._shadow_params.append((b, t))
+            groups[b.group]["params"].append(t)
+        # constructor-required args (e.g. SGD's lr) come from the
+        # wrapped instance's defaults, filtered to what the
+        # constructor actually takes (AdamW's defaults carry
+        # adam-family keys like decoupled_weight_decay that its
+        # __init__ rejects); per-group dicts override anyway
+        import inspect
+        sig = inspect.signature(self._base_cls.__init__)
+        ctor = {k: v for k, v in self.defaults.items()
+                if k in sig.parameters}
+        self._shadow = self._base_cls(
+            [g for g in groups if g["params"]], **ctor)
+        self._record_state_bytes()
+
+    def _mirror_hyperparams(self):
+        """Outer group options (LR schedules mutate them) → shadow."""
+        shadow_groups = {id(t): sg for sg in self._shadow.param_groups
+                         for t in sg["params"]}
+        for b, t in self._shadow_params:
+            outer = self.param_groups[b.group]
+            sg = shadow_groups[id(t)]
+            for k, v in outer.items():
+                if k != "params":
+                    sg[k] = v
+
+    def _record_state_bytes(self):
+        shard_bytes = 0
+        for st in self._shadow.state.values():
+            for v in st.values():
+                if torch.is_tensor(v):
+                    shard_bytes += v.numel() * v.element_size()
+        if shard_bytes == 0:
+            # pre-first-step: adam-style state not materialized yet;
+            # the master shards stand in so the gauge is never blank
+            shard_bytes = sum(t.numel() * t.element_size()
+                              for _, t in self._shadow_params)
+        self._updater.record_state_bytes(shard_bytes)
+
+    # -- step ----------------------------------------------------------------
+
+    def _scale_factors(self):
+        if self.op == Average and self.gradient_predivide_factor != 1.0:
+            return (1.0 / self.gradient_predivide_factor,
+                    self.gradient_predivide_factor)
+        return 1.0, 1.0
+
+    def _maybe_reshard(self):
+        """Autotune's eighth dimension flips config.shard_layout
+        between steps; the flip is COORDINATED by a 1-element MIN
+        vote (every rank re-shards in the same step or none does —
+        a sweep can never split one step across two layouts), and the
+        re-shard itself is deterministic: gather full state exactly,
+        re-slice under the new plan, drop EF residuals."""
+        eng = basics.engine()
+        if eng.autotuner is None:
+            return
+        want = getattr(eng.config, "shard_layout",
+                       self._updater.plan.layout)
+        from ..ops import api
+        from ..core.message import ReduceOp
+        flag = 1.0 if want != self._updater.plan.layout else 0.0
+        out = api.allreduce(np.array([flag], np.float32),
+                            op=ReduceOp.MIN, name="shardopt.reshard",
+                            process_set=self.process_set)
+        if float(out[0]) >= 0.5:
+            state = self._gather_full_state()
+            self._build(layout=want)
+            self._load_full_state(state)
+            self._updater.reset_wire_state()
+
+    def step(self, closure=None):
+        loss = None
+        if closure is not None:
+            with torch.enable_grad():
+                loss = closure()
+        if basics.size() <= 1 and \
+                len(basics.engine().process_set_ranks(
+                    self.process_set.process_set_id or 0)) <= 1:
+            # single rank: the dense update is the sharded update
+            if self._updater is None:
+                self._build()
+            self._dense_single_rank_step()
+            return loss
+        if self._updater is None:
+            self._build()
+        else:
+            self._maybe_reshard()
+        self._mirror_hyperparams()
+        plan = self._updater.plan
+        prescale, postscale = self._scale_factors()
+        grads = {}
+        for n, p in self._by_name.items():
+            if p.grad is not None:
+                if p.grad.is_sparse:
+                    raise ValueError(
+                        "sharded=True does not support sparse "
+                        "gradients (the shard layout is dense flat "
+                        "buckets); use sparse_as_dense upstream or "
+                        "the dense DistributedOptimizer")
+                grads[n] = p.grad.detach().numpy()
+        bufs = [plan.pack(b, grads, dtype=_np_dtype(b.dtype))
+                for b in plan.buckets]
+        if prescale != 1.0:
+            bufs = [b * np.float32(prescale) for b in bufs]
+        shard_grads = self._updater.reduce_grads(bufs)
+        for (b, t), g in zip(self._shadow_params, shard_grads):
+            g = np.asarray(g, dtype=_np_dtype(b.dtype))
+            if postscale != 1.0:
+                g = g * np.float32(postscale)
+            t.grad = torch.from_numpy(np.ascontiguousarray(g))
+        missing = {n for n in self._by_name if n not in grads}
+        pre = self._snapshot_missing(missing) if missing else None
+        self._shadow.step()
+        if pre is not None:
+            # the dense wrapper SKIPS params whose grad is None
+            # (torch optimizers never touch them); the flat shard
+            # update cannot skip elementwise, so revert those
+            # members' param AND state slices — weight decay and
+            # moment decay must not move a never-trained param
+            self._restore_missing(missing, pre)
+        full = self._updater.gather_params(
+            [t.detach().numpy() for _, t in self._shadow_params])
+        with torch.no_grad():
+            for (b, _t), buf in zip(self._shadow_params, full):
+                for n, arr in plan.unpack(b, buf).items():
+                    self._by_name[n].data.copy_(
+                        torch.from_numpy(np.ascontiguousarray(arr)))
+        self._record_state_bytes()
+        self._opt_called = True
+        base = self.__dict__.get("_lr_sched_base_opt")
+        if base is not None:
+            base._opt_called = True
+        return loss
+
+    def _missing_slices(self, bucket, missing, pos):
+        """Intersections of this rank's shard with the flat ranges of
+        ``missing`` members, as local [lo, hi) pairs."""
+        s, e = bucket.shard_slice(pos)
+        out, off = [], 0
+        for key, size, _shape in bucket.members:
+            if key in missing:
+                lo, hi = max(off, s), min(off + size, e)
+                if lo < hi:
+                    out.append((lo - s, hi - s))
+            off += size
+        return out
+
+    def _snapshot_missing(self, missing):
+        pos = self._updater.my_pos()
+        snap = []
+        for b, t in self._shadow_params:
+            ranges = self._missing_slices(b, missing, pos)
+            if not ranges:
+                snap.append(None)
+                continue
+            state = {k: v.detach().clone()
+                     for k, v in self._shadow.state.get(t, {}).items()
+                     if torch.is_tensor(v) and v.numel() > 1}
+            snap.append((ranges, t.detach().clone(), state))
+        return snap
+
+    def _restore_missing(self, missing, snap):
+        with torch.no_grad():
+            for (b, t), entry in zip(self._shadow_params, snap):
+                if entry is None:
+                    continue
+                ranges, old_t, old_state = entry
+                st = self._shadow.state.get(t, {})
+                for lo, hi in ranges:
+                    t.data[lo:hi] = old_t[lo:hi]
+                    for k, v in st.items():
+                        if not torch.is_tensor(v) or v.numel() <= 1:
+                            continue
+                        prev = old_state.get(k)
+                        if prev is not None:
+                            v[lo:hi] = prev[lo:hi]
+                        else:
+                            # state created THIS step: a dense
+                            # optimizer would not have created it for
+                            # a no-grad param — zeros match what a
+                            # later lazy init would start from
+                            v[lo:hi] = 0
+        return None
+
+    def _dense_single_rank_step(self):
+        # world size 1: run the shadow machinery locally so the code
+        # path (and state layout) is identical — dp=1 shards are the
+        # whole buckets
+        self._mirror_hyperparams()
+        plan = self._updater.plan
+        grads = {n: p.grad.detach().numpy()
+                 for n, p in self._by_name.items()
+                 if p.grad is not None}
+        for b, t in self._shadow_params:
+            t.grad = torch.from_numpy(np.ascontiguousarray(
+                plan.pack(b, grads, dtype=_np_dtype(b.dtype))))
+        missing = {n for n in self._by_name if n not in grads}
+        pre = self._snapshot_missing(missing) if missing else None
+        self._shadow.step()
+        if pre is not None:
+            self._restore_missing(missing, pre)
+        with torch.no_grad():
+            for b, t in self._shadow_params:
+                for n, arr in plan.unpack(
+                        b, t.detach().numpy()).items():
+                    self._by_name[n].data.copy_(
+                        torch.from_numpy(np.ascontiguousarray(arr)))
+        self._record_state_bytes()
+        return None
+
+    # -- dense-wrapper API compatibility -------------------------------------
+
+    def synchronize(self):
+        """No pending async handles in sharded mode: the whole
+        reducescatter -> update -> allgather round runs inside
+        step()."""
+        self._synchronized = True
+
+    @contextmanager
+    def skip_synchronize(self):
+        self._should_synchronize = False
+        try:
+            yield
+        finally:
+            self._should_synchronize = True
+
+    def set_backward_passes_per_step(self, passes):
+        # grads accumulate in p.grad between step() calls; nothing to
+        # re-arm (no per-param hooks exist in sharded mode)
+        self.backward_passes_per_step = passes
+
+    def reset_wire_state(self):
+        """Elastic/resize hook: drop every EF residual (grad AND
+        param wires, host and device)."""
+        if self._updater is not None:
+            self._updater.reset_wire_state()
+        else:
+            from ..ops.compiled import reset_ef_state
+            reset_ef_state()
+
+    # -- deterministic re-shard (elastic resize, layout flips) ---------------
+
+    def _gather_full_state(self):
+        """Layout-independent full state: per-param master values and
+        per-param optimizer-state arrays, gathered EXACTLY from the
+        shards (core/sharded.gather_full).  The serialization unit is
+        the PARAM, so a load under any dp/layout re-slices cleanly."""
+        plan = self._updater.plan
+        masters = self._updater.gather_full(
+            [t.detach().numpy() for _, t in self._shadow_params])
+        state_keys = set()
+        for _, t in self._shadow_params:
+            for k, v in self._shadow.state.get(t, {}).items():
+                if torch.is_tensor(v) and v.numel() > 1:
+                    state_keys.add(k)
+        full_state = {}
+        for k in sorted(state_keys):
+            shards = []
+            for b, t in self._shadow_params:
+                v = self._shadow.state.get(t, {}).get(k)
+                if v is None or not torch.is_tensor(v) \
+                        or v.numel() <= 1:
+                    shards.append(np.zeros(t.numel(), np.float32))
+                else:
+                    shards.append(v.detach().numpy().astype(
+                        np.float32).ravel())
+            full_state[k] = self._updater.gather_full(shards)
+        scalars = {}
+        for _, t in self._shadow_params:
+            for k, v in self._shadow.state.get(t, {}).items():
+                if not torch.is_tensor(v) or v.numel() <= 1:
+                    scalars[k] = v
+        per_param = {}
+        for bi, b in enumerate(plan.buckets):
+            vals = plan.unpack(b, masters[bi])
+            for n, arr in vals.items():
+                per_param.setdefault(n, {})["param"] = \
+                    np.array(arr, copy=True)
+            for k, bufs in full_state.items():
+                for n, arr in plan.unpack(b, bufs[bi]).items():
+                    per_param[n][k] = np.array(arr, copy=True)
+        return {"per_param": per_param, "scalars": scalars,
+                "groups": [{k: v for k, v in g.items()
+                            if k != "params"}
+                           for g in self.param_groups]}
+
+    def _load_full_state(self, full):
+        plan = self._updater.plan
+        pos = self._updater.my_pos()
+        per_param = full["per_param"]
+        state_keys = sorted({k for st in per_param.values()
+                             for k in st if k != "param"})
+        for b, t in self._shadow_params:
+            s, e = b.shard_slice(pos)
+            master = plan.pack(
+                b, {n: st["param"] for n, st in per_param.items()
+                    if "param" in st}, dtype=_np_dtype(b.dtype))
+            with torch.no_grad():
+                t.data.copy_(torch.from_numpy(master[s:e].copy()))
+            st = self._shadow.state.setdefault(t, {})
+            for k in state_keys:
+                buf = plan.pack(
+                    b, {n: v[k] for n, v in per_param.items()
+                        if k in v}, dtype=np.float32)
+                st[k] = torch.from_numpy(buf[s:e].copy()).to(t.dtype)
+            for k, v in full.get("scalars", {}).items():
+                st[k] = v.clone() if torch.is_tensor(v) else v
+        # install the (possibly restored-from-another-layout) masters
+        # into the model params so forward sees the loaded weights
+        fullbufs = self._updater.gather_full(
+            [t.detach().numpy() for _, t in self._shadow_params])
+        with torch.no_grad():
+            for (b, _t), buf in zip(self._shadow_params, fullbufs):
+                for n, arr in plan.unpack(b, buf).items():
+                    self._by_name[n].data.copy_(
+                        torch.from_numpy(np.ascontiguousarray(arr)))
+
+    def state_dict(self):
+        """FULL (gathered) state — layout/dp independent, so an
+        elastic resize restores by re-slicing under the NEW world
+        size (the deterministic re-shard contract)."""
+        if self._updater is None:
+            self._build()
+        full = self._gather_full_state()
+        return {"hvd_sharded": True,
+                "per_param": {n: {k: np.asarray(v) for k, v in
+                                  st.items()}
+                              for n, st in full["per_param"].items()},
+                "scalars": full["scalars"],
+                "groups": full["groups"]}
+
+    def load_state_dict(self, state_dict):
+        if not state_dict.get("hvd_sharded"):
+            raise ValueError(
+                "load_state_dict on a sharded DistributedOptimizer "
+                "expects a sharded state dict (state_dict() of the "
+                "same wrapper); dense torch state dicts do not carry "
+                "the flat shard layout")
+        if self._updater is None:
+            self._build()
+        for g, saved in zip(self.param_groups,
+                            state_dict.get("groups", [])):
+            for k, v in saved.items():
+                g[k] = v
+        self._load_full_state(state_dict)
+        self._updater.reset_wire_state()
+
+    def zero_grad(self, *args, **kwargs):
+        return super(self.__class__, self).zero_grad(*args, **kwargs)
+
+
+def _np_dtype(dtype_str):
+    if dtype_str == "bfloat16":
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(dtype_str)
+
+
+def _compression_wire(compression):
+    from ..core.sharded import compression_wire
+    return compression_wire(compression)
+
+
 def DistributedOptimizer(optimizer, named_parameters=None,
                          compression=Compression.none,
                          backward_passes_per_step=1, op=Average,
                          gradient_predivide_factor=1.0,
                          num_groups=0, groups=None,
                          sparse_as_dense=False,
-                         process_set=global_process_set):
+                         process_set=global_process_set,
+                         sharded=None):
     """Wrap ``optimizer`` so gradient averaging happens across ranks
-    (reference ``horovod/torch/optimizer.py:516``)."""
+    (reference ``horovod/torch/optimizer.py:516``).
+
+    ``sharded=True`` (default: ``HOROVOD_SHARDED_OPTIMIZER``) selects
+    ZeRO-grade weight-update sharding: reducescatter the gradients,
+    update only this rank's 1/dp shard of params + optimizer state,
+    allgather the updated params — optimizer-state memory ÷dp
+    (docs/parallelism.md "Weight-update sharding")."""
     if gradient_predivide_factor != 1.0 and op != Average:
         raise ValueError(
             "gradient_predivide_factor not supported with op != Average")
+    if sharded is None:
+        from ..common import env as _env
+        sharded = _env.get_bool(_env.HOROVOD_SHARDED_OPTIMIZER)
+    if sharded:
+        if groups is not None or num_groups != 0:
+            raise ValueError(
+                "groups/num_groups do not apply with sharded=True: "
+                "the shard layout IS the grouping (fusion-bucket "
+                "derived, docs/parallelism.md)")
+        if sparse_as_dense:
+            raise ValueError(
+                "sparse_as_dense is not supported with sharded=True")
+        if named_parameters is not None:
+            named_parameters = list(named_parameters)
+        methods = {k: v for k, v in
+                   _ShardedDistributedOptimizer.__dict__.items()
+                   if k not in ("__dict__", "__weakref__")}
+        cls = type(optimizer.__class__.__name__,
+                   (optimizer.__class__,), methods)
+        inst = cls.__new__(cls)
+        inst.__dict__.update(optimizer.__dict__)
+        inst.__dict__.pop("step", None)
+        inst.__dict__["_lr_sched_base_opt"] = optimizer
+        inst.__dict__["_base_cls"] = optimizer.__class__
+        inst._shard_init(named_parameters, compression, op,
+                         gradient_predivide_factor, process_set)
+        inst.backward_passes_per_step = backward_passes_per_step
+        return inst
     if num_groups != 0:
         warnings.warn(
             "Parameter `num_groups` has been replaced by `groups` and "

@@ -258,6 +258,7 @@ def run_scenario():
     flood_stop = threading.Event()
     counts = {"ok": 0, "err": 0}
     checks = {"spike": [1, 3]}
+    pre_decisions = []      # decisions up to the controller crash
 
     def one_tick():
         time.sleep(TICK_S)
@@ -360,16 +361,67 @@ def run_scenario():
                     break
             else:
                 stable = 0
-        checks["final"] = {n: (j["state"], j["np"])
-                           for n, j in controller.snapshot()
-                           ["jobs"].items()}
+        # scrape the merged /metrics BEFORE the crash drill: the
+        # resumed controller starts fresh counters, and the goodput /
+        # SLO-conformance evidence belongs to the pre-crash run
         checks["breach_at_end"] = _breach_ticks(controller, "serve")
-        # the merged /metrics IS the evidence surface: scrape it
         metrics = urllib.request.urlopen(
             f"http://127.0.0.1:{FLEET_METRICS_PORT}/metrics",
             timeout=10).read().decode()
         with open(os.path.join(out, "metrics.txt"), "w") as f:
             f.write(metrics)
+
+        # -- controller PROCESS crash drill (ROADMAP item 4's
+        #    leftover): kill the controller abruptly — its jobs'
+        #    workers die with the process group, journals stay as the
+        #    running state last recorded them — then resume from the
+        #    journal.  The restart must reproduce the calm placement
+        #    WITHOUT double-preempting (no suspend/blacklist/extra
+        #    shrink) and the training job must come back stepping
+        #    from its last elastic commit.
+        pre_np = {n: j["np"] for n, j in
+                  controller.snapshot()["jobs"].items()}
+        pre_decisions = list(controller.decisions)
+        controller.crash()
+        print(f"[fs] tick {controller.tick}: controller crashed",
+              flush=True)
+        env_resume = {k: v for k, v in env.items()
+                      if k != "HOROVOD_FAULT_PLAN"}
+        controller = FleetController(
+            spec, platform="cpu", verbose=False, env=env_resume,
+            evidence_path=os.path.join(out, "evidence.jsonl"),
+            metrics_port=FLEET_METRICS_PORT, resume=True)
+        controller.start()
+        deadline = controller.tick + T_LIVE_BUDGET
+        seen = beacon_stamp()
+        fresh = 0
+        while controller.tick < deadline:
+            one_tick()
+            now = beacon_stamp()
+            if now is not None and now != seen:
+                fresh += 1
+                seen = now
+                if fresh >= 3:
+                    break
+        assert fresh >= 3, (
+            f"training never came back stepping within "
+            f"{T_LIVE_BUDGET} ticks of the controller crash+resume")
+        resumed = {n: j["np"] for n, j in
+                   controller.snapshot()["jobs"].items()}
+        assert resumed == pre_np, (
+            f"crash+resume changed the placement: {pre_np} -> "
+            f"{resumed}")
+        assert not any(d["e"] in ("suspend", "blacklist")
+                       for d in controller.decisions), (
+            f"controller resume double-preempted: "
+            f"{controller.decisions}")
+        checks["crash_resume"] = resumed
+        print(f"[fs] tick {controller.tick}: crash+resume OK "
+              f"({resumed})", flush=True)
+
+        checks["final"] = {n: (j["state"], j["np"])
+                           for n, j in controller.snapshot()
+                           ["jobs"].items()}
         # wind down: STAGGERED stop files (serve first, then train)
         # so the two terminal `done` evidence records land in a
         # deterministic order — a shared stop file would race the
@@ -400,7 +452,10 @@ def run_scenario():
     with open(os.path.join(out, "checks.json"), "w") as f:
         json.dump(checks, f, sort_keys=True)
     with open(os.path.join(out, "decisions.json"), "w") as f:
-        json.dump(controller.decisions, f, sort_keys=True)
+        # pre-crash decisions + the resumed controller's: one
+        # deterministic sequence per run (the byte-compare surface)
+        json.dump(pre_decisions + controller.decisions, f,
+                  sort_keys=True)
     print("[fs] scenario done", flush=True)
 
 
@@ -440,6 +495,10 @@ def _assert_run(out):
     # both jobs finished cleanly
     assert checks["terminal"] == {"serve": "done", "train": "done"}, \
         checks
+    # the controller crash+resume reproduced the calm placement
+    # without double-preempting (the drill itself asserts the
+    # no-suspend/no-blacklist half in-process)
+    assert checks["crash_resume"] == {"serve": 1, "train": 3}, checks
     # exactly the one injected host death — zero false deaths (the
     # reporting job rides the on-disk t_ extras, not the projection:
     # with co-located jobs it is race-ordered)
@@ -482,7 +541,7 @@ def main():
             [sys.executable, "-u", os.path.abspath(__file__)],
             env={**os.environ, "FS_RUN": "1", "FS_OUT": out,
                  "PYTHONPATH": REPO},
-            timeout=600, stdout=subprocess.PIPE,
+            timeout=900, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True)
         sys.stdout.write(proc.stdout[-4000:])
         assert proc.returncode == 0, \

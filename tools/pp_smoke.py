@@ -81,7 +81,7 @@ def worker():
 
     spec = PipelineSpec(pp=N_STAGES, dp=DP, n_micro=N_MICRO,
                         schedule="1f1b")
-    w = MpmdWorker(cfg, spec, optimizer=optax.sgd(1e-2))
+    w = MpmdWorker(cfg, spec, optimizer=optax.adamw(1e-2))
     assert w.my_stage == r // DP and w.dp_index == r % DP, \
         f"rank {r}: stage {w.my_stage} dp {w.dp_index}"
     w.init(rng, jnp.asarray(tokens))
@@ -122,12 +122,43 @@ def worker():
         f"worker {r}: no gradient reduce was overlapped into a bubble"
     hvd.barrier()
 
+    # -- sharded dp×pp parity config (ISSUE 14, weight-update
+    # sharding): the SAME job re-run with the dp hop as
+    # reducescatter -> 1/dp shard update -> overlapped allgather;
+    # loss trajectory must match the dense twin too, and the
+    # optimizer-state gauge must show the ÷dp layer state
+    from horovod_tpu.common import basics as _basics
+
+    _basics.engine().config.sharded_optimizer = True
+    try:
+        w2 = MpmdWorker(cfg, spec, optimizer=optax.adamw(1e-2))
+        assert w2.sharded, "sharded mode did not engage"
+        w2.init(rng, jnp.asarray(tokens))
+        sharded_losses = []
+        for _ in range(WARMUP_STEPS + STEADY_STEPS):
+            sharded_losses.append(w2.step(mine))
+        w2.full_params()        # land the last overlapped gather
+    finally:
+        _basics.engine().config.sharded_optimizer = False
+    snap = hvd.metrics()
+    runs = _counter_total(snap, "horovod_sharded_update_runs_total")
+    assert runs >= WARMUP_STEPS + STEADY_STEPS, (
+        f"worker {r}: sharded update runs {runs}")
+    shard_b = _counter_total(snap, "horovod_optimizer_state_bytes",
+                             scope="shard")
+    full_b = _counter_total(snap, "horovod_optimizer_state_bytes",
+                            scope="full")
+    assert shard_b > 0 and full_b / shard_b > 1.5, (
+        f"worker {r}: optimizer-state bytes not ÷dp "
+        f"(shard={shard_b} full={full_b})")
+    hvd.barrier()
+
     if r == 0:
         # -- loss parity: the dense twin — same rng, same global
         # batch, same optimizer, one process, no pipeline ------------
         mesh = build_mesh(MeshSpec(dp=1), jax.devices()[:1])
         init_d, step_d, _, _ = make_lm_train_step(
-            mesh, cfg, optimizer=optax.sgd(1e-2))
+            mesh, cfg, optimizer=optax.adamw(1e-2))
         st = init_d(rng, jnp.asarray(tokens))
         dense = []
         for _ in range(WARMUP_STEPS + STEADY_STEPS):
@@ -140,6 +171,14 @@ def worker():
         assert dense[-1] < dense[0], "loss never decreased"
         print(f"loss parity OK: worst |Δ| {worst:.2e} over "
               f"{len(dense)} steps")
+        worst_sh = max(abs(a - b)
+                       for a, b in zip(dense, sharded_losses))
+        assert worst_sh <= LOSS_ATOL, (
+            f"SHARDED pipelined loss diverged from the dense twin: "
+            f"dense={dense} sharded={sharded_losses} "
+            f"(worst {worst_sh:.2e})")
+        print(f"sharded dp×pp loss parity OK: worst |Δ| "
+              f"{worst_sh:.2e} over {len(dense)} steps")
 
         # -- per-stage lanes in the merged job trace ----------------
         addr = env_mod.require_str(env_mod.HOROVOD_RENDEZVOUS_ADDR)

@@ -46,6 +46,17 @@
 #                         #   loss parity with the dense run, per-
 #                         #   stage timeline lanes, zero steady-state
 #                         #   recompiles
+#   ./ci.sh integrity     # gate: tools/integrity_smoke.py — a REAL
+#                         #   2-proc elastic job under a seeded
+#                         #   bit-flip plan: 100% of injected wire/
+#                         #   grad corruptions detected + attributed
+#                         #   to their rank, every step quarantined
+#                         #   unanimously (the implicated-rank vote)
+#                         #   and rolled back to the last commit, the
+#                         #   job finishes with loss parity against a
+#                         #   clean same-seed run, and two same-seed
+#                         #   faulted runs produce byte-identical
+#                         #   evidence
 #   ./ci.sh bench         # smoke: one bench.py run (real chip if any)
 #   ./ci.sh perf          # gate: collective_bench sweeps vs the
 #                         #   checked-in benchmarks/BASELINE.json
@@ -70,7 +81,7 @@ cd "$(dirname "$0")"
 PART1="tests/test_autotune.py tests/test_aux.py tests/test_basics.py \
   tests/test_collectives.py tests/test_compiled.py \
   tests/test_conv_bn_fusion.py tests/test_hvdlint.py \
-  tests/test_integrations.py \
+  tests/test_integrations.py tests/test_integrity.py \
   tests/test_jax_frontend.py tests/test_lightning.py \
   tests/test_models.py tests/test_mxnet_fake.py tests/test_native.py \
   tests/test_telemetry.py tests/test_tracing.py"
@@ -181,6 +192,18 @@ case "${1:-all}" in
     # death (worker_alive), and steady-state traffic adds zero
     # compiled-program-cache misses after warm-up
     python tools/serve_smoke.py
+    ;;
+  integrity)
+    # step-integrity gate (docs/fault_tolerance.md "Silent data
+    # corruption"): seeded bitflip_wire/bitflip_grad chaos against a
+    # REAL 2-proc elastic job — every corruption must be detected at
+    # the decode-side checksum verify, attributed to the targeted
+    # rank on BOTH processes (locally by digest, on the peer through
+    # the implicated-rank MIN vote), quarantined before any optimizer
+    # applies, and replayed from the last elastic commit; final loss
+    # must match the clean same-seed run and two same-seed faulted
+    # runs must produce byte-identical fired/detection evidence
+    python tools/integrity_smoke.py
     ;;
   perf)
     # perf regression gate: re-runs the
@@ -299,9 +322,13 @@ case "${1:-all}" in
     python -m pytest $PART2 -q
     python -m pytest $PART3 -q
     python -m pytest $PART4 -q
+    # the step-integrity gate rides `all` (ISSUE 15): it is fast
+    # (~30 s) and guards the last uncovered failure class — silent
+    # data corruption absorbed into the model
+    python tools/integrity_smoke.py
     ;;
   *)
-    echo "usage: $0 {analyze|fast|matrix|integration|chaos|fleet|scale|trace|metrics|serve|pp|bench|perf|all}" >&2
+    echo "usage: $0 {analyze|fast|matrix|integration|chaos|fleet|scale|trace|metrics|serve|pp|integrity|bench|perf|all}" >&2
     exit 2
     ;;
 esac

@@ -29,6 +29,7 @@ from .common.basics import (  # noqa: F401
     metrics, start_metrics_server,
 )
 from . import telemetry  # noqa: F401
+from .core import integrity  # noqa: F401
 from .common.exceptions import (  # noqa: F401
     HorovodInternalError, HostsUpdatedInterrupt,
 )

@@ -89,16 +89,23 @@ class FaultInjector:
         self._requests = 0
         self._collectives = 0
         self._predicts = 0
+        self._buckets = 0
+        self._commits = 0
         self._epoch = time.monotonic()
         self._skew_ms = 0.0
         self._hang = threading.Event()
+        #: bitflip_wire events whose bucket trigger matured at the
+        #: grad (encode-entry) site, awaiting the same bucket's wire
+        #: site (the encoded bytes do not exist yet at trigger time)
+        self._pending_wire = []
         #: chronological record of fired events — the determinism
         #: evidence two same-seed runs compare (tools/chaos_smoke.py)
         self.fired = []
         events = plan.worker_events(
             proc, rank_offset, rank_offset + num_local)
         self._by_trigger = {"requests": [], "collectives": [],
-                            "predicts": [], "wall": []}
+                            "predicts": [], "wall": [],
+                            "buckets": [], "commits": []}
         for e in events:
             self._by_trigger[e.trigger].append(
                 _EventState(e, plan.rng_for(e)))
@@ -184,11 +191,105 @@ class FaultInjector:
                        if st.due(n)]
             self._apply(due, "collectives", n)
 
+    def corrupt_bucket(self, site, bufs):
+        """Encode-site hook for the silent-data-corruption kinds
+        (core/integrity.py; both collective paths call it).  The
+        ``"grad"`` site counts one reduction bucket and applies due
+        ``bitflip_grad`` events to the packed payload rows — AFTER
+        the submit-time digests, so the payload checksum is what must
+        catch the flip; ``bitflip_wire`` events maturing at the same
+        bucket are stashed for the ``"wire"`` site (the encoded
+        codes/scales/cast), which applies them AFTER the encode
+        digests so the decode-side verify catches them.  Flip
+        positions (victim row, byte, bit) draw from the event's
+        private RNG stream and land in ``fired``, so same-seed runs
+        corrupt identically — the evidence ``ci.sh integrity``
+        compares byte-for-byte."""
+        states = self._by_trigger["buckets"]
+        if not states:
+            return
+        if site == "grad":
+            with self._lock:
+                self._buckets += 1
+                n = self._buckets
+                due = [st for st in states if st.due(n)]
+                grads = [st for st in due
+                         if st.event.kind == "bitflip_grad"]
+                self._pending_wire.extend(
+                    (st, n) for st in due
+                    if st.event.kind == "bitflip_wire")
+            for st in grads:
+                self._flip(st, bufs, "grad", n)
+        else:
+            with self._lock:
+                pending, self._pending_wire = self._pending_wire, []
+            for st, n in pending:
+                self._flip(st, bufs, "wire", n)
+
+    def corrupt_spill(self, blob: bytes) -> bytes:
+        """Spill-write hook (common/elastic.State._spill): counts one
+        commit and flips a seeded bit in the serialized blob when a
+        ``corrupt_spill`` event is due — the CRC trailer was computed
+        over the TRUE bytes, so the flipped blob is exactly what a
+        torn write leaves on disk."""
+        states = self._by_trigger["commits"]
+        if not states:
+            return blob
+        with self._lock:
+            self._commits += 1
+            n = self._commits
+            due = [st for st in states if st.due(n)]
+        if not due:
+            return blob
+        ba = bytearray(blob)
+        for st in due:
+            byte = st.rng.randrange(len(ba)) if ba else 0
+            bit = st.rng.randrange(8)
+            if ba:
+                ba[byte] ^= 1 << bit
+            self._record(st.event, "commits", n,
+                         site="spill", byte=byte, bit=bit)
+        return bytes(ba)
+
+    def _flip(self, st, bufs, site, n):
+        """Flip one seeded bit in one seeded buffer of ``bufs``
+        (numpy arrays, mutated in place).  A read-only buffer is
+        replaced by a flipped copy INSIDE the list, so callers must
+        pass either writable arrays or the exact list the collective
+        consumes (the engine's encode outputs are writable; the
+        compiled path passes its consumed ``my_bufs``) — a flipped
+        copy dropped into a throwaway list would record evidence for
+        a corruption that never happened, so the replacement is
+        flagged ``copied`` in the fired record."""
+        import numpy as np
+
+        if not bufs:
+            self._record(st.event, "buckets", n, site=site,
+                         row=-1, byte=-1, bit=-1)
+            return
+        idx = st.rng.randrange(len(bufs))
+        arr = bufs[idx]
+        copied = not arr.flags.writeable
+        if copied:
+            arr = arr.copy()
+            bufs[idx] = arr
+        view = arr.reshape(-1).view(np.uint8)
+        if view.size == 0:
+            self._record(st.event, "buckets", n, site=site,
+                         row=idx, byte=-1, bit=-1)
+            return
+        byte = st.rng.randrange(view.size)
+        bit = st.rng.randrange(8)
+        view[byte] ^= np.uint8(1 << bit)
+        extra = {"copied": True} if copied else {}
+        self._record(st.event, "buckets", n, site=site,
+                     row=idx, byte=byte, bit=bit, **extra)
+
     # -- application ---------------------------------------------------------
 
-    def _record(self, event: FaultEvent, trigger, n):
+    def _record(self, event: FaultEvent, trigger, n, **extra):
         entry = {"kind": event.kind, "event": event.index,
-                 "trigger": trigger, "n": n}
+                 "trigger": trigger, "n": n, **extra}
         with self._lock:
             self.fired.append(entry)
         _count_injected(event.kind)

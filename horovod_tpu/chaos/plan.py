@@ -37,7 +37,10 @@ path to a JSON file; ``horovodrun --fault-plan`` forwards it)::
                                 "ms": 1500},
         {"kind": "agg_kill", "proc": 1, "after_s": 8.0},
         {"kind": "revoke_host", "host": "host3", "after": 12},
-        {"kind": "restore_host", "host": "host3", "after": 18}
+        {"kind": "restore_host", "host": "host3", "after": 18},
+        {"kind": "bitflip_grad", "proc": 1, "after_buckets": 3},
+        {"kind": "bitflip_wire", "proc": 1, "after_buckets": 6},
+        {"kind": "corrupt_spill", "proc": 0, "after_commits": 2}
       ]
     }
 
@@ -106,14 +109,36 @@ AGG_KINDS = ("agg_kill", "agg_restart")
 #: reconcile tick — deterministic across same-seed runs) or
 #: ``after_s`` (wall offset).
 FLEET_KINDS = ("revoke_host", "restore_host")
+#: Silent-data-corruption kinds (docs/fault_tolerance.md "Silent data
+#: corruption"; core/integrity.py): ``bitflip_grad`` flips one seeded
+#: bit in a packed gradient payload at the fusion-encode site (after
+#: the submit-time digests — the payload checksum must catch it);
+#: ``bitflip_wire`` flips one seeded bit in the ENCODED wire bytes
+#: (codes/scales on quantized wires, the cast or raw buffer
+#: otherwise) after the encode digests — the decode-side verify must
+#: catch it.  Both trigger on ``after_buckets`` (the n-th collective
+#: bucket — reduction, reducescatter or allgather — this process
+#: encodes).  ``corrupt_spill`` flips one seeded
+#: bit in an elastic spill blob as it is written (``after_commits`` =
+#: the n-th spill), exercising the CRC-trailer fallback.  The seeded
+#: (byte, bit) draws ride the event's private RNG stream, so the
+#: ``fired`` evidence (site/row/byte/bit included) is byte-identical
+#: across same-seed runs.
+INTEGRITY_KINDS = ("bitflip_grad", "bitflip_wire", "corrupt_spill")
 KINDS = PROCESS_KINDS + WIRE_KINDS + ENGINE_KINDS + COORD_KINDS \
-    + AGG_KINDS + FLEET_KINDS
+    + AGG_KINDS + FLEET_KINDS + INTEGRITY_KINDS
 
 #: Trigger spellings -> canonical trigger name.
 _TRIGGERS = {"after_requests": "requests",
              "after_collectives": "collectives",
              "after_predicts": "predicts",
              "after_s": "wall",
+             # integrity kinds count encode/spill sites
+             # (core/integrity.py; their OWN counters, so adding
+             # corruption events never perturbs the fabric-request
+             # stream an existing plan was seeded against)
+             "after_buckets": "buckets",
+             "after_commits": "commits",
              # coordinator-side rules count matching requests
              "after": "requests"}
 
@@ -248,6 +273,23 @@ def _parse_event(index: int, raw: dict) -> FaultEvent:
             f"fault event #{index}: {kind} triggers on 'after' "
             f"(n-th service request / reconcile tick) or 'after_s' "
             f"(wall), not {trig_key}")
+    if kind in ("bitflip_grad", "bitflip_wire") \
+            and trig_key != "after_buckets":
+        raise ValueError(
+            f"fault event #{index}: {kind} triggers on "
+            f"'after_buckets' (the n-th reduction bucket this process "
+            f"encodes), not {trig_key}")
+    if kind == "corrupt_spill" and trig_key != "after_commits":
+        raise ValueError(
+            f"fault event #{index}: corrupt_spill triggers on "
+            f"'after_commits' (the n-th elastic spill this process "
+            f"writes), not {trig_key}")
+    if trig_key in ("after_buckets", "after_commits") \
+            and kind not in INTEGRITY_KINDS:
+        raise ValueError(
+            f"fault event #{index}: trigger {trig_key} is reserved "
+            f"for the integrity kinds ({', '.join(INTEGRITY_KINDS)}), "
+            f"not {kind}")
     if kind == "coord_restart" and not raw.get("ms"):
         raise ValueError(
             f"fault event #{index}: coord_restart needs 'ms' > 0 "

@@ -9,6 +9,7 @@ mesh must be rebuilt, so reset() tears the engine down and re-inits.
 """
 
 import functools
+import logging
 import os
 import pickle
 import queue
@@ -87,15 +88,35 @@ class State:
         pass
 
     def _spill(self):
+        """Write the spill with a CRC trailer, keeping the previous
+        generation as ``<path>.prev``: a torn or corrupted write
+        (power loss mid-replace, bit rot — exercised by the
+        ``corrupt_spill`` chaos kind) is DETECTED at load and recovery
+        falls back to the previous commit instead of deserializing
+        garbage into the restored state."""
         path = _spill_path()
         payload = self._spill_payload()
         if path is None or payload is None:
             return
+        from ..core import integrity as integrity_mod
+
         tmp = None
         try:
+            blob = integrity_mod.append_crc_trailer(
+                pickle.dumps(payload,
+                             protocol=pickle.HIGHEST_PROTOCOL))
+            from .. import chaos as chaos_mod
+            inj = chaos_mod.current()
+            if inj is not None:
+                # corrupt_spill chaos rides the REAL write: the CRC
+                # was computed over the true bytes, so the flipped
+                # blob is exactly what a torn write leaves behind
+                blob = inj.corrupt_spill(blob)
             fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
             with os.fdopen(fd, "wb") as f:
-                pickle.dump(payload, f)
+                f.write(blob)
+            if os.path.exists(path):
+                os.replace(path, path + ".prev")
             os.replace(tmp, path)
         except Exception:  # noqa: BLE001 — spill is best-effort
             if tmp and os.path.exists(tmp):
@@ -103,12 +124,49 @@ class State:
 
     def _maybe_unspill(self):
         path = _spill_path()
-        if path and os.path.exists(path):
+        if not path:
+            return
+        from .. import telemetry
+        from ..core import integrity as integrity_mod
+
+        for candidate in (path, path + ".prev"):
+            if not os.path.exists(candidate):
+                continue
             try:
-                with open(path, "rb") as f:
-                    self._load_spill(pickle.load(f))
-            except Exception:  # noqa: BLE001 — corrupt spill: start fresh
-                pass
+                with open(candidate, "rb") as f:
+                    blob = integrity_mod.strip_crc_trailer(f.read())
+                payload = pickle.loads(blob)
+            except Exception as exc:  # noqa: BLE001 — fall back LOUDLY
+                telemetry.count_integrity_check("corrupt", "spill")
+                logging.getLogger("horovod_tpu").warning(
+                    "elastic spill %s failed integrity verification "
+                    "(%s: %s); falling back to %s", candidate,
+                    type(exc).__name__, exc,
+                    "the previous commit" if candidate == path
+                    else "a fresh state")
+                if candidate == path and isinstance(
+                        exc, (integrity_mod.TrailerCorruptionError,
+                              pickle.UnpicklingError, EOFError)):
+                    # the file itself is bad ON DISK (torn/corrupt):
+                    # drop it NOW, or the next _spill rotates it over
+                    # the good .prev we are falling back to.  Scoped
+                    # to on-disk badness — a loader/schema error must
+                    # never delete a valid commit.
+                    try:
+                        os.unlink(candidate)
+                    except OSError:
+                        pass
+                continue
+            try:
+                self._load_spill(payload)
+                telemetry.count_integrity_check("ok", "spill")
+                return
+            except Exception:  # noqa: BLE001 — schema mismatch: the
+                # file is VALID on disk (keep it for a binary
+                # rollback); just don't install it
+                logging.getLogger("horovod_tpu").exception(
+                    "elastic spill %s verified but failed to install",
+                    candidate)
 
     def check_host_updates(self):
         """Raise HostsUpdatedInterrupt if the driver pushed membership
@@ -192,12 +250,32 @@ def run_fn(func, reset):
                     if not skip_sync:
                         state.sync()
                     return func(state, *args, **kwargs)
-                except HorovodInternalError:
+                except HorovodInternalError as e:
+                    if getattr(e, "evict", False):
+                        # eviction-grade integrity verdict
+                        # (core/integrity.HostEvictionError): repeated
+                        # detections implicated THIS host — die so the
+                        # driver's blacklist verdict evicts it instead
+                        # of endlessly replaying a corrupting host
+                        # (docs/fault_tolerance.md "Silent data
+                        # corruption")
+                        raise
                     # comm failure (peer died / stale round): roll back
                     # to the last commit — covers failures inside
                     # sync() too, which the reference leaves uncaught
                     state.restore()
                     skip_sync = False
+                    if getattr(e, "quarantine", False):
+                        # step-integrity quarantine: the implicated-
+                        # rank vote made the verdict unanimous and
+                        # every engine survived delivering it, so the
+                        # mesh is healthy — replay in place (restore +
+                        # resync) instead of tearing it down; a
+                        # teardown here would park every worker in the
+                        # rendezvous waiting for a round the driver
+                        # (which saw no death and no discovery change)
+                        # will never re-form
+                        continue
                 except HostsUpdatedInterrupt as e:
                     skip_sync = e.skip_sync
                 reset()

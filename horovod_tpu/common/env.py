@@ -191,6 +191,21 @@ HOROVOD_AUTOTUNE_CACHE = "HOROVOD_AUTOTUNE_CACHE"
 HOROVOD_SHARDED_OPTIMIZER = "HOROVOD_SHARDED_OPTIMIZER"
 HOROVOD_SHARD_LAYOUT = "HOROVOD_SHARD_LAYOUT"
 
+# end-to-end step integrity (docs/fault_tolerance.md "Silent data
+# corruption"; core/integrity.py): INTEGRITY=0 disables the wire
+# checksums + implicated-rank vote (they default ON — the digests are
+# one xor-fold pass per buffer); SENTINEL_STEPS is the divergence
+# sentinel's cadence (param-fingerprint MIN/MAX agreement every N
+# steps, 0 = off); EVICT_AFTER escalates the N-th detection
+# implicating one rank into a HostEvictionError so the driver's
+# blacklist verdict evicts the host (0 = always roll back, never
+# evict); MAX_GRAD_NORM arms the update guard's norm bound (0 = only
+# the nonfinite check).
+HOROVOD_INTEGRITY = "HOROVOD_INTEGRITY"
+HOROVOD_INTEGRITY_SENTINEL_STEPS = "HOROVOD_INTEGRITY_SENTINEL_STEPS"
+HOROVOD_INTEGRITY_EVICT_AFTER = "HOROVOD_INTEGRITY_EVICT_AFTER"
+HOROVOD_INTEGRITY_MAX_GRAD_NORM = "HOROVOD_INTEGRITY_MAX_GRAD_NORM"
+
 # multi-tenant fleet controller (docs/fleet.md; horovodrun
 # --fleet-spec): the JSON fleet spec source (inline, @path, or bare
 # path), the reconciliation cadence, the controller's own journal
@@ -479,3 +494,14 @@ class Config:
             self.shard_layout = normalize_shard_layout(raw_layout)
         else:
             self.shard_layout = "bucket"
+        # end-to-end step integrity (core/integrity.py): wire
+        # checksums + the implicated-rank vote default ON; the
+        # sentinel cadence and guards are read by StepSentinel, the
+        # eviction threshold by the engine's scoreboard
+        self.integrity = get_bool(HOROVOD_INTEGRITY, True)
+        self.integrity_sentinel_steps = get_int(
+            HOROVOD_INTEGRITY_SENTINEL_STEPS, 50)
+        self.integrity_evict_after = get_int(
+            HOROVOD_INTEGRITY_EVICT_AFTER, 3)
+        self.integrity_max_grad_norm = get_float(
+            HOROVOD_INTEGRITY_MAX_GRAD_NORM, 0.0)

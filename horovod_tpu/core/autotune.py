@@ -257,6 +257,16 @@ class ParameterManager:
         if self._steps >= self.steps_per_sample:
             self._finish_sample()
 
+    def abort_sample(self):
+        """Discard the in-flight sample window (the engine's
+        integrity quarantine): a quarantined step's window spans a
+        rollback + replay, so its bytes/sec would score the current
+        config against fictitious timing.  The next clean step starts
+        a fresh window."""
+        self._bytes = 0
+        self._steps = 0
+        self._t0 = None
+
     def _metrics_record(self, score):
         """Export the sample count, best score and best config
         (telemetry/registry.py; docs/observability.md) — the CSV log's

@@ -42,6 +42,7 @@ from ..common.exceptions import (
     StalledTensorError,
     TensorShapeMismatchError,
 )
+from . import integrity as integrity_mod
 from .message import ReduceOp, Request, RequestType
 from .handles import Handle, HandleManager
 
@@ -58,6 +59,11 @@ class Submission:
     payloads: List[np.ndarray]          # host buffers, one per tensor
     handle: Handle
     enq_time: float = field(default_factory=time.monotonic)
+    #: submit-time payload digests (core/integrity.digest64, one per
+    #: payload) — re-verified after fusion packing so a bit flipped in
+    #: the gradient between submit and encode is detected and
+    #: attributed to this rank instead of trained on
+    payload_fp: Optional[List[int]] = None
 
 
 class NegotiationEntry:
@@ -233,6 +239,16 @@ class Engine:
         #: report_ready, so slow-rank scenarios delay exactly the
         #: report the coordinator's stall attribution watches
         self.chaos = chaos
+        #: end-to-end step integrity (core/integrity.py): submit-time
+        #: payload digests + encode-time wire digests re-verified at
+        #: decode, with the per-bucket implicated-rank MIN vote making
+        #: detection (and the quarantine it triggers) unanimous across
+        #: processes; None when HOROVOD_INTEGRITY=0
+        self.integrity = None
+        if getattr(self.config, "integrity", True):
+            self.integrity = integrity_mod.IntegrityChecker(
+                evict_after=getattr(self.config,
+                                    "integrity_evict_after", 3))
         #: steady-state negotiation bypass (core/bypass.py): armed by
         #: the coordinator's bypass_arm record once every proc voted
         #: the same stable cycle fingerprint; while active the
@@ -393,6 +409,18 @@ class Engine:
                   telemetry.FABRIC_RETRIES_HELP, labelnames=("verb",))
         m.counter(telemetry.FAULTS_INJECTED_FAMILY,
                   telemetry.FAULTS_INJECTED_HELP, labelnames=("kind",))
+        # step-integrity families (core/integrity.py; docs/
+        # fault_tolerance.md "Silent data corruption"): checks are
+        # counted at every verification site, rollbacks once per
+        # quarantined step, and the histogram times sentinel rounds
+        m.counter(telemetry.INTEGRITY_CHECKS_FAMILY,
+                  telemetry.INTEGRITY_CHECKS_HELP,
+                  labelnames=telemetry.INTEGRITY_CHECKS_LABELS)
+        m.counter(telemetry.INTEGRITY_ROLLBACKS_FAMILY,
+                  telemetry.INTEGRITY_ROLLBACKS_HELP,
+                  labelnames=telemetry.INTEGRITY_ROLLBACKS_LABELS)
+        m.histogram(telemetry.INTEGRITY_SENTINEL_SECONDS_FAMILY,
+                    telemetry.INTEGRITY_SENTINEL_SECONDS_HELP)
         self._m_alive = m.gauge(
             telemetry.WORKER_ALIVE_FAMILY, telemetry.WORKER_ALIVE_HELP,
             labelnames=("proc",))
@@ -813,6 +841,14 @@ class Engine:
         """EnqueueTensorAllreduce/... analogue (operations.cc:1408-2060):
         register the submission in the negotiation table; the background
         thread executes it once all participating ranks arrive."""
+        if self.integrity is not None and sub.request.request_type in (
+                RequestType.ALLREDUCE, RequestType.ADASUM):
+            # submit-time payload digests (outside the lock: one
+            # xor-fold pass per payload, rank threads digest in
+            # parallel) — re-verified after fusion packing so grad
+            # corruption is attributed to the submitting rank
+            sub.payload_fp = [integrity_mod.digest64([p])
+                              for p in sub.payloads]
         with self._lock:
             if self._shutdown:
                 raise HorovodInitError("horovod_tpu has been shut down")
@@ -1906,6 +1942,8 @@ class Engine:
         from . import native
         itemsize = dtype.itemsize
         rows = []
+        ictx = None
+        bad_rank = bad_where = None
         try:
             # annotated so host-side fusion phases appear as named
             # ranges inside jax-profiler device traces (the reference's
@@ -1935,13 +1973,32 @@ class Engine:
                         native.pack_mt(arrays, buf, offs_bytes)
                     else:
                         native.pack(arrays, buf, offs_bytes)
+            if self.chaos is not None:
+                # deterministic corruption chaos at the REAL encode
+                # seam: the grad site counts this bucket and applies
+                # due bitflip_grad events to the packed payload; the
+                # wire site (inside dispatch, after the encode
+                # digests) applies bitflip_wire to the encoded bytes
+                self.chaos.corrupt_bucket("grad", rows)
+            if self.integrity is not None:
+                ictx = integrity_mod.BucketWatch(
+                    f"{first.tensor_name}+{len(layout) - 1}")
             results = self._dispatch_allreduce(ps, first, op, dtype,
-                                               rows, total)
+                                               rows, total, ictx=ictx)
+            if self.integrity is not None:
+                # decode-site verification, BEFORE the arena reuses
+                # the slabs: submit-time payload digests against the
+                # packed rows, encode-time wire digests against the
+                # encoded buffers the collective consumed
+                bad_rank, bad_where = self._integrity_scan(
+                    ps, bucket, layout, rows, ictx)
         finally:
             # a pack/collective failure must not leak slabs — the
             # engine survives bucket errors (_execute_batch catches)
             for buf in rows:
                 self._arena.release(buf)
+        if self.integrity is not None:
+            self._integrity_gate(ps, bad_rank, bad_where)
         if self.autotuner is not None:
             if not self._autotune_sig_noted:
                 # the FIRST bucket's identity keys the warm-start
@@ -1969,6 +2026,123 @@ class Engine:
                 outs = per_entry[(id(entry), r)]
                 sub.handle.set_result(
                     outs if len(sub.payloads) > 1 else outs[0])
+
+    def _integrity_scan(self, ps, bucket, layout, rows, ictx):
+        """Decode-site verification of one allreduce bucket: every
+        wire watch the dispatch registered (encode digests), plus the
+        submit-time payload digests against the packed fusion rows.
+        Returns ``(bad_rank, message)`` for the lowest implicated
+        global rank, or ``(None, None)`` — raising is the gate's job,
+        AFTER the cross-process vote, so peers never deadlock in a
+        collective this process skipped."""
+        bad, where = ictx.scan() if ictx is not None else (None, None)
+        for row_i, r in enumerate(ps.local_ranks):
+            if row_i >= len(rows) or (bad is not None and r >= bad):
+                continue
+            buf = rows[row_i]
+            for entry, i, off, size, _shape in layout:
+                sub = entry.subs.get(r)
+                if sub is None or not sub.payload_fp:
+                    continue
+                if integrity_mod.digest64(
+                        [buf[off:off + size]]) == sub.payload_fp[i]:
+                    continue
+                bad = r
+                where = (
+                    f"payload checksum mismatch in bucket "
+                    f"{ictx.label if ictx else '?'!r}: tensor "
+                    f"{sub.names[i]!r} of global rank {r} corrupted "
+                    f"between submit and encode")
+                break
+        return bad, where
+
+    def _integrity_vote(self, ps, bad_rank):
+        """The implicated-rank agreement — a 1-element MIN allreduce
+        over the existing collective path (the bypass-vote shape,
+        :meth:`_bypass_vote`): every rank votes its lowest
+        locally-detected corrupt rank (OK_VOTE when clean), so the
+        reduced value names the same implicated rank on EVERY process
+        at once — which is what makes the quarantine unanimous."""
+        vote = integrity_mod.OK_VOTE if bad_rank is None \
+            else float(bad_rank)
+        rows = [np.full(1, vote, np.float32) for _ in ps.local_ranks]
+        out = ps.executor.allreduce(rows, ReduceOp.MIN)
+        v = float(out[0][0])
+        return None if v >= integrity_mod.OK_VOTE else int(v)
+
+    def _integrity_gate(self, ps, bad, where):
+        """Per-bucket integrity verdict.  Multi-process buckets vote
+        first (:meth:`_integrity_vote`) so a detection on ANY process
+        quarantines the step on ALL of them before any rank's
+        optimizer applies the corrupt update; single-process detection
+        raises directly (every local rank's handle errors together in
+        :meth:`_execute_batch`)."""
+        from .. import telemetry
+
+        voted = bad
+        if self.multiproc:
+            voted = self._integrity_vote(ps, bad)
+            if voted is not None and voted != bad:
+                where = None
+        if voted is None:
+            telemetry.count_integrity_check("ok", "engine")
+            return
+        telemetry.count_integrity_check("corrupt", "engine")
+        evict = self.integrity.record_detection(voted) \
+            and voted in ps.local_ranks
+        self.quarantine_step(
+            integrity_mod.WireIntegrityError.reason, rank=voted)
+        msg = where or (
+            f"a peer process detected wire corruption attributed to "
+            f"global rank {voted}")
+        logger.error(
+            "integrity: %s — quarantining the step and rolling back "
+            "to the last commit", msg)
+        if evict:
+            raise integrity_mod.HostEvictionError(
+                f"integrity: global rank {voted} implicated in "
+                f"{self.integrity.detections.get(voted, 0)} "
+                f"detections (HOROVOD_INTEGRITY_EVICT_AFTER="
+                f"{self.integrity.evict_after}) — exiting so the "
+                f"driver's blacklist verdict evicts this host; "
+                f"last detection: {msg}", rank=voted)
+        raise integrity_mod.WireIntegrityError(msg, rank=voted,
+                                               site="engine")
+
+    def quarantine_step(self, reason, rank=None):
+        """Step-quarantine hygiene (docs/fault_tolerance.md "Silent
+        data corruption"): count the rollback, poison/disarm the
+        negotiation bypass (the corrupted cycle must never [re-]arm
+        or execute again), drop the autotuner's in-flight sample (its
+        timing window now spans a replay) and clear the compiled
+        path's EF residuals — a stale residual after rollback is
+        itself a divergence bug.  The frontends' EF residuals reset
+        through their own ``reset_wire_state`` seam when the elastic
+        restore re-forms the job."""
+        from .. import telemetry
+
+        telemetry.count_integrity_rollback(reason)
+        logger.warning(
+            "integrity: step quarantined (%s%s)", reason,
+            f", implicated rank {rank}" if rank is not None else "")
+        bp = self._bypass
+        if bp is not None:
+            if bp.active:
+                bp.poison("integrity")
+            else:
+                bp.disarm()
+        if self.autotuner is not None:
+            self.autotuner.abort_sample()
+        try:
+            from ..ops.compiled import reset_ef_state
+            reset_ef_state()
+        except Exception:  # noqa: BLE001 — hygiene must not mask detection
+            logger.exception("integrity: compiled EF reset failed")
+        # engine-path EF residuals live on the frontends' updaters
+        # (torch/TF DistributedOptimizer, the sharded updaters), which
+        # the in-place rollback never re-creates: a residual mutated
+        # by the quarantined step's submit must not seed the replay
+        integrity_mod.reset_registered_wire_state()
 
     def _wire_for(self, req, dtype, op):
         """Effective wire format for a float reduction.  The process-
@@ -2088,12 +2262,18 @@ class Engine:
         return qz.effective_inner_wire(req.wire_inner, outer,
                                        dtype.itemsize)
 
-    def _dispatch_allreduce(self, ps, req, op, dtype, rows, total):
+    def _dispatch_allreduce(self, ps, req, op, dtype, rows, total,
+                            ictx=None):
         """Run the fused allreduce over the configured wire PAIR and
         algorithm: full width, 16-bit cast, or block-scaled int8/int4
         (encode -> quantized collective -> f32 decode) x flat /
         hierarchical / torus (ops/xla_ops.allreduce_2d, which fuses
-        the per-hop codecs into the one decomposed program)."""
+        the per-hop codecs into the one decomposed program).  ``ictx``
+        (core/integrity.BucketWatch) captures encode-time digests of
+        the ACTUAL wire buffers — the 16-bit cast or the codes+scales;
+        raw f32 rows are covered by the submit-time payload digests —
+        and the chaos injector's wire site flips bits right after
+        those digests, so the decode-side scan is what detects it."""
         wire = self._wire_for(req, dtype, op)
         algo, inner = self._algo_plan(ps, req, op)
         self._m_algo.labels(algorithm=algo).inc()
@@ -2108,6 +2288,8 @@ class Engine:
             self._account_wire(total * itemsize, total * itemsize,
                                cross=flat_cross)
             self._account_hop(flat_hop, None, total * itemsize)
+            if self.chaos is not None:
+                self.chaos.corrupt_bucket("wire", rows)
             return ps.executor.allreduce(
                 rows, op, req.prescale_factor, req.postscale_factor)
         if wire in ("fp16", "bf16"):
@@ -2117,14 +2299,24 @@ class Engine:
                                cross=total * 2 if flat_cross else 0,
                                wire=wire)
             self._account_hop(flat_hop, wire, total * 2)
+            wrows = [r.astype(wdt) for r in rows]
+            if ictx is not None:
+                ictx.watch("engine", flat_hop, wire, wrows,
+                           ps.local_ranks)
+            if self.chaos is not None:
+                self.chaos.corrupt_bucket("wire", wrows)
             out = ps.executor.allreduce(
-                [r.astype(wdt) for r in rows], op,
-                req.prescale_factor, req.postscale_factor)
+                wrows, op, req.prescale_factor, req.postscale_factor)
             return [o.astype(dtype) for o in out]
         q_rows, s_rows, npad = self._encode_quantized_rows(
             rows, total * itemsize, wire)
         self._account_hop(flat_hop, wire,
                           q_rows[0].nbytes + s_rows[0].nbytes)
+        if ictx is not None:
+            ictx.watch("engine", flat_hop, wire,
+                       list(zip(q_rows, s_rows)), ps.local_ranks)
+        if self.chaos is not None:
+            self.chaos.corrupt_bucket("wire", q_rows + s_rows)
         out = ps.executor.allreduce_quantized(
             q_rows, s_rows, op, req.prescale_factor,
             req.postscale_factor, nbits=4 if wire == "int4" else 8,
@@ -2149,6 +2341,12 @@ class Engine:
         for.  The hop family accounts both stages unconditionally
         (the inner stage is real traffic either way)."""
         from ..ops import quantize as qz
+        if self.chaos is not None:
+            # the decomposed program fuses the codec on-device, so the
+            # host-visible wire IS the packed rows (already digested
+            # at submit): the wire site flips them here and the
+            # payload scan at decode detects it
+            self.chaos.corrupt_bucket("wire", rows)
         itemsize = dtype.itemsize
         m = -(-total // inner)          # cross-hop shard elements
         spans = self._spans_hosts(ps)
@@ -2211,14 +2409,24 @@ class Engine:
     def _run_allgather(self, ps, entry, aux=None):
         """Allgather with per-rank first-dim sizes: pad to max rows
         (the reference exchanges shapes during negotiation and sizes the
-        fused buffer accordingly, controller.cc:901-1080)."""
+        fused buffer accordingly, controller.cc:901-1080).  The sharded
+        updater's PARAM wire rides this path, so it carries the same
+        encode-digest / decode-verify / vote integrity as the gradient
+        wires — a corrupted gathered shard installs IDENTICALLY on
+        every replica, which the divergence sentinel can never see."""
         subs = self._local_subs(ps, entry)
-        n_tensors = len(next(iter(subs.values())).payloads)
+        first = next(iter(subs.values()))
+        n_tensors = len(first.payloads)
         dim0_tables = self._global_dim0s(ps, entry, aux, n_tensors)
+        local_ranks = list(subs)
+        ictx = None
+        if self.integrity is not None:
+            ictx = integrity_mod.BucketWatch(
+                f"{first.request.tensor_name}/ag")
         results_per_rank = {r: [] for r in subs}
         for i in range(n_tensors):
             dim0 = dim0_tables[i]
-            rest = tuple(next(iter(subs.values())).payloads[i].shape[1:])
+            rest = tuple(first.payloads[i].shape[1:])
             max_d = max(dim0) if dim0 else 0
             rest_n = int(np.prod(rest, dtype=np.int64)) if rest else 1
             rows = []
@@ -2228,9 +2436,16 @@ class Engine:
                 buf = np.zeros(max_d * rest_n, dtype=p.dtype)
                 buf[:flat.size] = flat
                 rows.append(buf)
+            if ictx is not None:
+                ictx.watch("engine", "gather", None, rows, local_ranks)
+            if self.chaos is not None:
+                self.chaos.corrupt_bucket("grad", rows)
+                self.chaos.corrupt_bucket("wire", rows)
             gathered = ps.executor.allgather(rows, dim0, rest)
             for r, g in zip(subs, gathered):
                 results_per_rank[r].append(g)
+        if ictx is not None:
+            self._integrity_gate(ps, *ictx.scan())
         for r, sub in subs.items():
             outs = results_per_rank[r]
             sub.handle.set_result(outs if n_tensors > 1 else outs[0])
@@ -2279,7 +2494,18 @@ class Engine:
             buf = np.zeros(max_t, dtype=dtype)
             buf[:flat.size] = flat
             rows.append(buf)
+        ictx = None
+        if self.integrity is not None:
+            ref0 = next(iter(bucket[0].subs.values()))
+            ictx = integrity_mod.BucketWatch(
+                f"{ref0.request.tensor_name}+{len(bucket) - 1}/ag")
+            ictx.watch("engine", "gather", None, rows, local)
+        if self.chaos is not None:
+            self.chaos.corrupt_bucket("grad", rows)
+            self.chaos.corrupt_bucket("wire", rows)
         gathered = ps.executor.allgather(rows, totals, ())
+        if ictx is not None:
+            self._integrity_gate(ps, *ictx.scan())
         # slice table: absolute [start, end) of (entry_idx, tensor,
         # source position) inside the concatenated exact buffer
         rank_starts = np.cumsum([0] + totals[:-1])
@@ -2355,13 +2581,23 @@ class Engine:
 
     def _run_reducescatter(self, ps, entry):
         """Reducescatter; grouped submissions carry several payloads
-        and resolve to a list per rank (like _run_allgather)."""
+        and resolve to a list per rank (like _run_allgather).  The
+        sharded updater's gradient wire rides this path, so it gets
+        the same encode-digest / decode-verify / implicated-rank-vote
+        integrity as the allreduce buckets — the assembled rows are
+        digested right after encode (a reducescatter spreads one
+        rank's corruption into every rank's shard, which the sentinel
+        could NOT catch: the replicas stay bit-identical and wrong)."""
         subs = self._local_subs(ps, entry)
         first = next(iter(subs.values()))
         req = first.request
         op = req.reduce_op
         n_tensors = len(first.payloads)
         R = ps.size
+        local_ranks = list(subs)
+        ictx = None
+        if self.integrity is not None:
+            ictx = integrity_mod.BucketWatch(f"{req.tensor_name}/rs")
         results_per_rank = {r: [] for r in subs}
         for i in range(n_tensors):
             shape = first.payloads[i].shape
@@ -2381,14 +2617,27 @@ class Engine:
                     buf[dst:dst + chunks[j] * rest_n] = \
                         flat[src:src + chunks[j] * rest_n]
                 rows.append(buf)
+            hop = "cross" if self._spans_hosts(ps) else "inner"
+            if ictx is not None:
+                # the assembled rows ARE this path's submit-equivalent
+                # payload; digest before the chaos sites so both
+                # bitflip kinds land after the digest and are caught
+                # by the decode scan
+                ictx.watch("engine", hop, None, rows, local_ranks)
+            if self.chaos is not None:
+                self.chaos.corrupt_bucket("grad", rows)
             wire = self._wire_for(req, np.dtype(rows[0].dtype), op)
             if wire in ("int8", "int4"):
                 dtype = rows[0].dtype
                 q_rows, s_rows, npad = self._encode_quantized_rows(
                     rows, rows[0].nbytes, wire)
                 self._account_hop(
-                    "cross" if self._spans_hosts(ps) else "inner",
-                    wire, q_rows[0].nbytes + s_rows[0].nbytes)
+                    hop, wire, q_rows[0].nbytes + s_rows[0].nbytes)
+                if ictx is not None:
+                    ictx.watch("engine", hop, wire,
+                               list(zip(q_rows, s_rows)), local_ranks)
+                if self.chaos is not None:
+                    self.chaos.corrupt_bucket("wire", q_rows + s_rows)
                 results = [
                     res.astype(dtype)
                     for res in ps.executor.reducescatter_quantized(
@@ -2404,20 +2653,32 @@ class Engine:
                         else _bfloat16_dtype()
                     self._account_wire(rows[0].nbytes,
                                        rows[0].size * 2, wire=wire)
+                    wrows = [row.astype(wdt) for row in rows]
+                    if ictx is not None:
+                        ictx.watch("engine", hop, wire, wrows,
+                                   local_ranks)
+                    if self.chaos is not None:
+                        self.chaos.corrupt_bucket("wire", wrows)
                     results = [
                         res.astype(dtype)
                         for res in ps.executor.reducescatter(
-                            [row.astype(wdt) for row in rows], d0,
-                            rest, op, req.prescale_factor,
+                            wrows, d0, rest, op, req.prescale_factor,
                             req.postscale_factor)
                     ]
                 else:
                     self._account_wire(rows[0].nbytes, rows[0].nbytes)
+                    if self.chaos is not None:
+                        self.chaos.corrupt_bucket("wire", rows)
                     results = ps.executor.reducescatter(
                         rows, d0, rest, op, req.prescale_factor,
                         req.postscale_factor)
             for r, res in zip(subs, results):
                 results_per_rank[r].append(res)
+        if ictx is not None:
+            # decode-site scan + ONE gate (and vote) per entry, after
+            # every tensor dispatched, so peers never desync on a
+            # mid-entry raise
+            self._integrity_gate(ps, *ictx.scan())
         for r, sub in subs.items():
             outs = results_per_rank[r]
             sub.handle.set_result(outs if n_tensors > 1 else outs[0])

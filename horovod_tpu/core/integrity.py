@@ -1,0 +1,533 @@
+"""End-to-end step integrity: wire checksums, divergence sentinel,
+commit-anchored rollback (docs/fault_tolerance.md "Silent data
+corruption").
+
+The stack survives every LOUD failure — worker death, coordinator
+death, aggregator death, host revocation — but a flipped bit on the
+quantized wire, a bad host producing subtly wrong reductions, or a
+torn spill file would be absorbed into the model without a trace, and
+the per-hop int4/int8 codec (EQuARX, arXiv:2506.17615) widens the
+blast radius: one corrupted code byte dequantizes into a whole block
+of wrong gradients.  Horovod's coordinated-collective design
+(arXiv:1802.05799) gives the natural choke point — every byte that
+can diverge replicas crosses the fused-collective seam — so integrity
+is enforced there, end to end:
+
+* **Wire checksums** — a cheap xor-folded 64-bit digest
+  (:func:`digest64`, one SIMD pass at memory bandwidth) is computed
+  over each fused bucket's payload at submit/encode time and
+  re-verified at decode on both collective paths.  On the engine path
+  detection feeds a 1-element MIN allreduce "implicated-rank vote"
+  (the bypass-vote shape, core/engine._integrity_vote) so EVERY
+  process quarantines the step before any rank's optimizer applies
+  the corrupt update — a single-rank raise would let its peers commit
+  the garbage first.
+* **Divergence sentinel** — every ``HOROVOD_INTEGRITY_SENTINEL_STEPS``
+  ranks fold their params into a 64-bit fingerprint and agree via one
+  tiny MIN/MAX allreduce (:class:`StepSentinel`), so replica drift
+  from an SDC, a mis-latched wire flip or EF-residual desync is
+  detected within a bounded step budget; always-on nonfinite /
+  grad-norm guards ride the same class.
+* **Commit-anchored rollback** — every detection raises a
+  :class:`StepIntegrityError` (a ``HorovodInternalError``), which the
+  elastic retry loop (common/elastic.run_fn) answers by restoring the
+  last commit and re-rendezvousing — the job replays, it does not
+  die.  ``Engine.quarantine_step`` resets the bypass arm, the
+  autotuner's in-flight sample and the compiled path's EF residuals
+  so no stale step state survives into the replay.
+* **Eviction scoring** — repeated detections implicating the same
+  rank (:class:`IntegrityChecker` scoreboard,
+  ``HOROVOD_INTEGRITY_EVICT_AFTER``) escalate to
+  :class:`HostEvictionError` on the hosting process: the worker exits
+  instead of restoring, the elastic driver records the slot failure
+  and blacklists the host — a genuinely bad host is evicted, not
+  endlessly retried.
+
+Torn-write hardening for checkpoints and elastic spills rides the CRC
+trailer helpers (:func:`append_crc_trailer` /
+:func:`strip_crc_trailer`); ``corrupt_spill`` chaos events exercise
+them deterministically (chaos/plan.py).
+"""
+
+import logging
+import weakref
+
+import numpy as np
+
+from ..common.exceptions import HorovodInternalError
+
+logger = logging.getLogger("horovod_tpu")
+
+#: Process-wide registry of objects holding wire state (EF residuals):
+#: the frontends' updaters and the compiled reducers register
+#: themselves so a step quarantine can reset EVERY path's residuals —
+#: the in-place rollback (restore + resync, no elastic reset()) never
+#: reaches the frontends' own reset_wire_state seam, and a residual
+#: mutated by the quarantined step's submit would otherwise survive
+#: into the replay and diverge it from the clean trajectory.
+_WIRE_STATE_REGISTRY = weakref.WeakSet()
+
+
+def register_wire_state(obj):
+    """Register an object exposing ``reset_wire_state()`` for
+    quarantine-time residual resets (weakly referenced)."""
+    if hasattr(obj, "reset_wire_state"):
+        _WIRE_STATE_REGISTRY.add(obj)
+    return obj
+
+
+def reset_registered_wire_state():
+    """Reset every registered holder's wire state (engine
+    quarantine_step; resilient — hygiene must not mask detection)."""
+    for obj in list(_WIRE_STATE_REGISTRY):
+        try:
+            obj.reset_wire_state()
+        except Exception:  # noqa: BLE001
+            logger.exception("integrity: wire-state reset failed on %r",
+                             type(obj).__name__)
+
+_M64 = (1 << 64) - 1
+_M63 = (1 << 63) - 1
+_FNV_PRIME = 0x100000001b3
+_FNV_SEED = 0xcbf29ce484222325
+
+#: The "no corruption here" value of the implicated-rank MIN vote —
+#: exact in float32 and larger than any real global rank, so
+#: ``min(votes) < OK_VOTE`` names the lowest implicated rank on every
+#: process at once (core/engine._integrity_vote).
+OK_VOTE = float(1 << 24)
+
+
+# ---------------------------------------------------------------------------
+# digests
+
+
+_SUM_MIX = 0x9E3779B97F4A7C15
+
+
+def _fold(b):
+    """Fold a uint8 vector into 64 bits: the xor AND the wrapping sum
+    of its uint64 words (plus the little-endian tail).  Two vectorized
+    passes at memory bandwidth.  The xor flips for any single flipped
+    bit; the sum breaks the xor's pairwise cancellation (N identical
+    words xor to 0 for even N — a scaled-duplicate payload must not
+    collide with another).  Content-pure: an unaligned view falls back
+    to a byte-identical copy, never to a different scheme — the
+    submit-time digest of a payload MUST equal the decode-time digest
+    of its packed slice."""
+    n8 = (b.size // 8) * 8
+    x = s = 0
+    if n8:
+        body = b[:n8]
+        try:
+            w = body.view(np.uint64)
+        except ValueError:          # unaligned slice offset
+            w = np.frombuffer(body.tobytes(), np.uint64)
+        x = int(np.bitwise_xor.reduce(w))
+        s = int(np.add.reduce(w))   # wraps mod 2**64
+    if n8 != b.size:
+        tail = int.from_bytes(b[n8:].tobytes(), "little")
+        x ^= tail
+        s = (s + tail) & _M64
+    return x ^ ((s * _SUM_MIX) & _M64)
+
+
+def digest64(buffers) -> int:
+    """64-bit content digest of a sequence of array-likes (numpy
+    arrays of any dtype, or bytes).  Per-buffer folds are mixed with
+    an FNV-style multiply so buffer order and lengths matter; the cost
+    is two vectorized passes per buffer — cheap enough for the
+    dispatch loop, which is what lets the wire checksums default on."""
+    h = _FNV_SEED
+    for a in buffers:
+        if isinstance(a, (bytes, bytearray, memoryview)):
+            b = np.frombuffer(a, dtype=np.uint8)
+        else:
+            b = np.ascontiguousarray(a).reshape(-1).view(np.uint8)
+        h = ((h ^ _fold(b)) * _FNV_PRIME + b.size + 1) & _M64
+    return h
+
+
+def _iter_leaves(tree):
+    if tree is None:
+        return
+    if isinstance(tree, dict):
+        for k in sorted(tree, key=str):
+            yield from _iter_leaves(tree[k])
+    elif isinstance(tree, (list, tuple)):
+        for v in tree:
+            yield from _iter_leaves(v)
+    else:
+        yield tree
+
+
+def fold_fingerprint(tree) -> int:
+    """Fold a (possibly nested dict/list/tuple) pytree of arrays into
+    a 63-bit fingerprint — the divergence sentinel's per-rank replica
+    identity.  Dict keys iterate sorted, so the fold is a pure
+    function of the tree's CONTENT (hvdlint determinism rules)."""
+    return digest64(np.asarray(leaf) for leaf in _iter_leaves(tree)) \
+        & _M63
+
+
+# ---------------------------------------------------------------------------
+# errors
+
+
+class StepIntegrityError(HorovodInternalError):
+    """Base of every integrity detection.  A ``HorovodInternalError``
+    on purpose: the elastic retry loop answers it by restoring the
+    last commit and replaying — detection quarantines the step, it
+    never kills the job (docs/fault_tolerance.md)."""
+
+    #: rollback-reason label for horovod_integrity_rollbacks_total
+    reason = "integrity"
+    #: set on eviction-grade errors: run_fn re-raises instead of
+    #: restoring, so the process dies and the driver blacklists it
+    evict = False
+    #: integrity detections leave the mesh HEALTHY — the verdict was
+    #: unanimous (the implicated-rank vote) and every engine survived
+    #: delivering it — so the elastic retry loop rolls back in place:
+    #: restore + resync, no mesh teardown / re-rendezvous (run_fn)
+    quarantine = True
+
+
+class WireIntegrityError(StepIntegrityError):
+    """A wire/payload checksum mismatch: the bytes a rank encoded are
+    not the bytes the collective consumed (or the peers' vote
+    implicated a rank).  Carries the implicated global ``rank``."""
+
+    reason = "wire_checksum"
+
+    def __init__(self, message, rank=None, site=None):
+        super().__init__(message)
+        self.rank = rank
+        self.site = site
+
+
+class ReplicaDivergenceError(StepIntegrityError):
+    """The divergence sentinel's MIN/MAX fingerprints disagree:
+    replicas no longer hold identical params.  ``suspects`` names the
+    minority-fingerprint global ranks (empty when indeterminate, e.g.
+    a 1-vs-1 split)."""
+
+    reason = "divergence"
+
+    def __init__(self, message, suspects=()):
+        super().__init__(message)
+        self.suspects = tuple(suspects)
+
+
+class NonFiniteUpdateError(StepIntegrityError):
+    """The always-on update guard found a nonfinite (or norm-bound
+    violating) gradient/update before the optimizer applied it."""
+
+    reason = "nonfinite"
+
+
+class HostEvictionError(StepIntegrityError):
+    """Repeated integrity detections implicated a rank THIS process
+    hosts: the elastic retry loop re-raises (never restores), the
+    worker exits, and the driver's existing blacklist verdict evicts
+    the host (docs/fault_tolerance.md "Silent data corruption")."""
+
+    reason = "eviction"
+    evict = True
+
+    def __init__(self, message, rank=None):
+        super().__init__(message)
+        self.rank = rank
+
+
+# ---------------------------------------------------------------------------
+# bucket-scoped wire watches (engine dispatch)
+
+
+class BucketWatch:
+    """Per-bucket wire-checksum scope: the dispatch path registers
+    each hop's actual wire buffers right after encode (codes + scales
+    on quantized wires, the 16-bit cast on cast wires, the raw rows on
+    f32) and :meth:`scan` re-verifies them at decode, returning the
+    lowest implicated global rank plus a message naming the bucket,
+    the hop and the wire."""
+
+    __slots__ = ("label", "watches")
+
+    def __init__(self, label):
+        self.label = label
+        self.watches = []
+
+    @staticmethod
+    def _bufs(row):
+        return row if isinstance(row, (list, tuple)) else (row,)
+
+    def watch(self, site, hop, wire, rows, ranks):
+        """Digest one hop's per-rank wire rows (each row an array or a
+        tuple of arrays, e.g. (codes, scales))."""
+        fps = [digest64(self._bufs(r)) for r in rows]
+        self.watches.append((site, hop, wire, rows, list(ranks), fps))
+
+    def scan(self):
+        """Re-verify every watch; returns ``(bad_rank, message)`` for
+        the lowest corrupted global rank, or ``(None, None)``."""
+        bad, msg = None, None
+        for site, hop, wire, rows, ranks, fps in self.watches:
+            for i, (row, fp) in enumerate(zip(rows, fps)):
+                if digest64(self._bufs(row)) == fp:
+                    continue
+                rank = ranks[i] if i < len(ranks) else -1
+                if bad is None or rank < bad:
+                    bad = rank
+                    msg = (
+                        f"wire checksum mismatch in bucket "
+                        f"{self.label!r} (site {site}, hop {hop}, "
+                        f"wire {wire or 'f32'}): global rank {rank}'s "
+                        f"encoded payload changed between encode and "
+                        f"decode")
+        return bad, msg
+
+
+class IntegrityChecker:
+    """Per-engine integrity state: the detection scoreboard that
+    escalates repeated detections of the same rank into the driver's
+    blacklist verdict (``HOROVOD_INTEGRITY_EVICT_AFTER``, 0 = never
+    evict)."""
+
+    def __init__(self, evict_after=3):
+        self.evict_after = int(evict_after)
+        self.detections = {}
+
+    def record_detection(self, rank) -> bool:
+        """Score one detection against ``rank``; True once the rank
+        crossed the eviction threshold."""
+        if rank is None:
+            return False
+        n = self.detections.get(rank, 0) + 1
+        self.detections[rank] = n
+        return self.evict_after > 0 and n >= self.evict_after
+
+
+# ---------------------------------------------------------------------------
+# divergence sentinel + update guards
+
+
+def _quarantine_engine(reason, rank=None):
+    """Best-effort engine quarantine from user-loop call sites (the
+    sentinel/guards run outside the dispatch loop)."""
+    try:
+        from ..common import basics
+        eng = basics._engine
+        if eng is not None:
+            eng.quarantine_step(reason, rank=rank)
+    except Exception:  # noqa: BLE001 — hygiene must not mask detection
+        logger.exception("integrity: engine quarantine failed")
+
+
+def _sentinel_words(fp):
+    """The MIN/MAX agreement payload: four uint16 components of the
+    fingerprint and their negations, exact in float32 — [min(w_k)],
+    [-max(w_k)] after one MIN allreduce (the bypass-vote shape; int64
+    would silently truncate without x64)."""
+    w = [float((fp >> (16 * k)) & 0xFFFF) for k in range(4)]
+    return np.array(w + [-x for x in w], np.float32)
+
+
+def sentinel_agree(fp, allreduce_min):
+    """One agreement round: True when every rank's fingerprint words
+    match (min == max component-wise)."""
+    out = np.asarray(allreduce_min(_sentinel_words(fp)),
+                     np.float32).reshape(-1)
+    mins, maxs = out[:4], -out[4:]
+    return bool(np.array_equal(mins, maxs))
+
+
+class StepSentinel:
+    """Training-loop divergence sentinel + always-on update guards.
+
+    >>> sentinel = integrity.StepSentinel()
+    >>> ...
+    >>> sentinel.after_step(params, grads=grads)   # each step
+
+    ``after_step`` guards the update (nonfinite everywhere;
+    grad-norm when ``HOROVOD_INTEGRITY_MAX_GRAD_NORM`` > 0) and every
+    ``HOROVOD_INTEGRITY_SENTINEL_STEPS`` (default 50) runs one
+    fingerprint agreement round over the existing collective path.
+    Divergence attributes the minority fingerprint via a tiny
+    allgather and raises :class:`ReplicaDivergenceError`; rollback
+    then rides the same commit-anchored path as a wire detection."""
+
+    def __init__(self, every=None, max_grad_norm=None,
+                 process_set=None, name="hvd.integrity.sentinel"):
+        from ..common import env as env_mod
+        self.every = env_mod.get_int(
+            env_mod.HOROVOD_INTEGRITY_SENTINEL_STEPS, 50) \
+            if every is None else int(every)
+        self.max_grad_norm = env_mod.get_float(
+            env_mod.HOROVOD_INTEGRITY_MAX_GRAD_NORM, 0.0) \
+            if max_grad_norm is None else float(max_grad_norm)
+        self.process_set = process_set
+        self.name = name
+        self.steps = 0
+        self.checks = 0
+
+    # -- guards --------------------------------------------------------------
+
+    def guard_update(self, grads):
+        """Nonfinite / grad-norm guard over a pytree of gradients (or
+        updates) — always on, no collective, runs before the optimizer
+        applies."""
+        from .. import telemetry
+
+        sq = 0.0
+        for leaf in _iter_leaves(grads):
+            a = np.asarray(leaf)
+            if str(a.dtype) == "bfloat16":
+                a = a.astype(np.float32)    # isfinite needs a real
+                # IEEE dtype; f32 is the cheap exact widening
+            elif not np.issubdtype(a.dtype, np.floating):
+                continue
+            if not np.all(np.isfinite(a)):
+                telemetry.count_integrity_check("corrupt", "guard")
+                _quarantine_engine(NonFiniteUpdateError.reason)
+                raise NonFiniteUpdateError(
+                    "integrity guard: nonfinite gradient/update "
+                    "detected before the optimizer applied — "
+                    "quarantining the step")
+            if self.max_grad_norm > 0:
+                # float64 ACCUMULATOR without materializing a float64
+                # copy of the leaf (the norm guard is opt-in, but the
+                # copies would double its memory traffic)
+                sq += float(np.sum(np.square(a, dtype=np.float64)))
+        if self.max_grad_norm > 0 and sq ** 0.5 > self.max_grad_norm:
+            telemetry.count_integrity_check("corrupt", "guard")
+            _quarantine_engine(NonFiniteUpdateError.reason)
+            raise NonFiniteUpdateError(
+                f"integrity guard: gradient norm {sq ** 0.5:.3e} "
+                f"exceeds HOROVOD_INTEGRITY_MAX_GRAD_NORM="
+                f"{self.max_grad_norm:.3e} — quarantining the step")
+        telemetry.count_integrity_check("ok", "guard")
+
+    # -- the sentinel round --------------------------------------------------
+
+    def check(self, params):
+        """One agreement round NOW (cadence ignored).  Returns the
+        local fingerprint when replicas agree; raises
+        :class:`ReplicaDivergenceError` when they do not."""
+        import time as _time
+
+        from .. import telemetry
+        from ..ops import api
+        from .message import ReduceOp
+
+        t0 = _time.monotonic()
+        fp = fold_fingerprint(params)
+        kwargs = {} if self.process_set is None \
+            else {"process_set": self.process_set}
+
+        def _armin(arr):
+            return api.allreduce(arr, op=ReduceOp.MIN,
+                                 name=f"{self.name}.{self.checks}",
+                                 **kwargs)
+
+        agreed = sentinel_agree(fp, _armin)
+        self.checks += 1
+        telemetry.observe_sentinel_seconds(_time.monotonic() - t0)
+        if agreed:
+            telemetry.count_integrity_check("ok", "sentinel")
+            return fp
+        telemetry.count_integrity_check("corrupt", "sentinel")
+        fps = api.allgather_object(
+            fp, name=f"{self.name}.who.{self.checks}", **kwargs)
+        counts = {}
+        for v in fps:
+            counts[v] = counts.get(v, 0) + 1
+        majority = max(counts.values())
+        # allgather order is process-set POSITION order: map minority
+        # positions to GLOBAL ranks (misattributing a position as a
+        # rank under a non-global set would score — and eventually
+        # evict — an innocent host)
+        set_ranks = list(getattr(self.process_set, "ranks", []) or []) \
+            if self.process_set is not None else None
+        suspects = tuple(
+            set_ranks[i] if set_ranks and i < len(set_ranks) else i
+            for i, v in enumerate(fps)
+            if counts[v] < majority) if len(counts) > 1 else ()
+        suspect = suspects[0] if suspects else None
+        _quarantine_engine(ReplicaDivergenceError.reason, rank=suspect)
+        raise ReplicaDivergenceError(
+            f"integrity sentinel: replica param fingerprints diverged "
+            f"({len(counts)} distinct values across {len(fps)} ranks; "
+            f"minority rank(s) {list(suspects) or 'indeterminate'}) — "
+            f"quarantining and rolling back to the last commit",
+            suspects=suspects)
+
+    def after_step(self, params, grads=None):
+        """Per-step driver: guard the update, then run the agreement
+        round on the sentinel cadence.  Returns True when a round
+        ran."""
+        if grads is not None:
+            self.guard_update(grads)
+        self.steps += 1
+        if self.every > 0 and self.steps % self.every == 0:
+            self.check(params)
+            return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# CRC trailers (torn-write hardening for checkpoints + elastic spills)
+
+TRAILER_MAGIC = b"HVDCRC1\n"
+_TRAILER_LEN = len(TRAILER_MAGIC) + 12    # magic + <QI>(length, crc32)
+
+
+class TrailerCorruptionError(RuntimeError):
+    """A CRC-trailed payload failed verification; ``kind`` is
+    ``"truncated"`` (length mismatch — a torn write) or
+    ``"mismatch"`` (CRC disagrees — bit rot / corruption)."""
+
+    def __init__(self, message, kind):
+        super().__init__(message)
+        self.kind = kind
+
+
+def crc_trailer(payload_len, crc):
+    import struct
+    return TRAILER_MAGIC + struct.pack("<QI", payload_len,
+                                       crc & 0xFFFFFFFF)
+
+
+def append_crc_trailer(data: bytes) -> bytes:
+    """``payload + magic + (length, crc32)``.  Pickle readers stop at
+    the end of their stream, so legacy loaders ignore the trailer —
+    the format is forward and backward compatible."""
+    import zlib
+    return data + crc_trailer(len(data), zlib.crc32(data))
+
+
+def has_crc_trailer(data: bytes) -> bool:
+    return len(data) >= _TRAILER_LEN and \
+        data[-_TRAILER_LEN:-12] == TRAILER_MAGIC
+
+
+def strip_crc_trailer(data: bytes) -> bytes:
+    """Verify-and-strip: returns the payload of a trailed blob after
+    checking length and CRC (raises :class:`TrailerCorruptionError`
+    naming truncation vs corruption), or the input unchanged when no
+    trailer is present (legacy files — nothing to verify against)."""
+    import struct
+    import zlib
+
+    if not has_crc_trailer(data):
+        return data
+    n, crc = struct.unpack("<QI", data[-12:])
+    payload = data[:-_TRAILER_LEN]
+    if n != len(payload):
+        raise TrailerCorruptionError(
+            f"CRC-trailed payload is torn: trailer records "
+            f"{n} bytes, file holds {len(payload)}", kind="truncated")
+    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+        raise TrailerCorruptionError(
+            "CRC-trailed payload failed checksum verification "
+            "(bit corruption in the stored bytes)", kind="mismatch")
+    return payload

@@ -233,6 +233,11 @@ class ShardedUpdater:
         self._grad_residuals = {}
         self._param_residuals = {}
         self._lock = threading.Lock()
+        # a step quarantine (core/integrity.py) must reset the dual
+        # wires' residuals too: the in-place rollback never reaches
+        # the elastic reset that would
+        from .integrity import register_wire_state
+        register_wire_state(self)
 
     # -- position ------------------------------------------------------------
 

@@ -35,6 +35,7 @@ Two deliverables live here:
   fuses.
 """
 
+import logging
 import threading
 from dataclasses import dataclass
 from typing import Tuple
@@ -59,6 +60,8 @@ __all__ = [
     "batch_signature", "compiled_allreduce",
     "compiled_grouped_allreduce", "make_compiled_train_step",
 ]
+
+logger = logging.getLogger("horovod_tpu")
 
 
 @dataclass(frozen=True)
@@ -475,6 +478,11 @@ class CompiledGroupedAllreduce:
         self.error_feedback = bool(error_feedback) \
             and self.wire_dtype in ("int8", "int4")
         self._residuals = {}     # (sig, pos, buf_idx) -> f32 residual
+        # a step quarantine (core/integrity.py) resets every
+        # registered reducer's host residuals, not only the detecting
+        # one's (the process-global device EF is cleared separately)
+        from ..core.integrity import register_wire_state
+        register_wire_state(self)
         #: wire accounting for the most recent call (collective_bench)
         self.last_logical_bytes = 0
         self.last_wire_bytes = 0
@@ -1103,19 +1111,26 @@ class CompiledGroupedAllreduce:
         flat_ef = self.error_feedback and hint is None
         if n_local == 1:
             pos = ex.local_positions[0]
-            if flat_ef:
-                my_bufs = self._apply_residuals(sig, pos, my_bufs, plan)
-            out = launch({pos: (sig, my_bufs)})
         else:
             pos = _caller_pos(eng, ps)
             if pos is None:
                 raise ValueError(
                     "unbound caller: compiled collectives need a rank "
                     "context (call inside hvd.run / a launched worker)")
-            if flat_ef:
-                my_bufs = self._apply_residuals(sig, pos, my_bufs, plan)
+        if flat_ef:
+            my_bufs = self._apply_residuals(sig, pos, my_bufs, plan)
+        integ_fps = self._integrity_arm(
+            eng, my_bufs, primary=(pos == ex.local_positions[0]))
+        if n_local == 1:
+            out = launch({pos: (sig, my_bufs)})
+        else:
             rdv = _rendezvous_for(ps, tag, n_local)
             out = rdv.run(pos, (sig, my_bufs), launch)
+        if integ_fps is not None:
+            # decode-site verification BEFORE the residual update: a
+            # corrupted payload must neither unpack into results nor
+            # seed next step's error feedback
+            self._integrity_verify(eng, ps, pos, my_bufs, integ_fps)
         if self.wire_dtype is not None:
             outs, extras = out[:len(plan)], out[len(plan):]
             if flat_ef:
@@ -1124,6 +1139,81 @@ class CompiledGroupedAllreduce:
                 self._store_hop_residuals(ef_key, extras)
             out = outs
         return self._unpack(out, plan)
+
+    def _integrity_arm(self, eng, bufs, primary=True):
+        """Encode-site integrity for the compiled path: digest the
+        packed host buffers this call will stage (the host-visible
+        wire — the program fuses any quantization on-device) and run
+        the chaos corruption sites around the digest exactly like the
+        engine path (bitflip_grad before it, bitflip_wire after).
+        The chaos sites fire only on the PRIMARY (lowest local
+        position) rank thread: with several local rank threads racing
+        into one collective call, a shared bucket counter would make
+        which thread's buffers are "bucket n" scheduler-dependent and
+        break the same-seed byte-identical evidence contract.
+        Returns the digests, or None when integrity is off."""
+        inj = getattr(eng, "chaos", None) \
+            if eng is not None and primary else None
+        if inj is not None:
+            inj.corrupt_bucket("grad", bufs)
+        fps = None
+        if eng is not None and getattr(eng, "integrity", None) \
+                is not None:
+            from ..core.integrity import digest64
+            fps = [digest64([b]) for b in bufs]
+        if inj is not None:
+            inj.corrupt_bucket("wire", bufs)
+        return fps
+
+    def _integrity_verify(self, eng, ps, pos, bufs, fps):
+        """Decode-site re-verification (engine _integrity_scan's
+        compiled twin).  No implicated-rank vote on this path — there
+        is no negotiation to ride — so a detection raises locally and
+        the peers roll back when the detecting process's teardown
+        fails their next step; the divergence sentinel is the
+        cross-replica backstop (docs/fault_tolerance.md)."""
+        from .. import telemetry
+        from ..core import integrity as integrity_mod
+
+        bad = next((k for k, (b, fp) in enumerate(zip(bufs, fps))
+                    if integrity_mod.digest64([b]) != fp), None)
+        if bad is None:
+            telemetry.count_integrity_check("ok", "compiled")
+            return
+        telemetry.count_integrity_check("corrupt", "compiled")
+        ranks = getattr(ps, "ranks", [])
+        rank = ranks[pos] if pos is not None and pos < len(ranks) \
+            else -1
+        # tainted EF residuals must not survive into the replay
+        self.reset_wire_state()
+        evict = False
+        if eng is not None and getattr(eng, "integrity", None) \
+                is not None:
+            evict = eng.integrity.record_detection(rank)
+            eng.quarantine_step(
+                integrity_mod.WireIntegrityError.reason, rank=rank)
+        msg = (f"wire checksum mismatch in compiled bucket "
+               f"{self.name or 'reduce'!r} (site compiled, wire "
+               f"{self.wire_dtype or 'f32'}): global rank {rank}'s "
+               f"packed payload changed between encode and decode")
+        logger.error(
+            "integrity: %s — quarantining the step and rolling back "
+            "to the last commit", msg)
+        if evict:
+            raise integrity_mod.HostEvictionError(
+                f"integrity: global rank {rank} crossed the eviction "
+                f"threshold on the compiled path; last detection: "
+                f"{msg}", rank=rank)
+        err = integrity_mod.WireIntegrityError(msg, rank=rank,
+                                               site="compiled")
+        # NO in-place replay on this path: the detection is local (no
+        # vote), so the peers are still stepping — an in-place restore
+        # here would run sync()'s collective against their training
+        # collectives and wedge the job.  quarantine=False routes
+        # run_fn through the full reset(): this process's teardown
+        # fails the peers' next step and everyone rolls back together.
+        err.quarantine = False
+        raise err
 
     @staticmethod
     def _stage(ex, rows):

@@ -216,6 +216,34 @@ SHARDED_UPDATE_RUNS_HELP = (
     "Sharded weight-update rounds executed (reducescatter grads -> "
     "1/dp shard update -> allgather updated params)")
 
+# -- end-to-end step integrity (docs/fault_tolerance.md "Silent data
+#    corruption"; core/integrity.py): the checks counter is bumped at
+#    every verification site (result=ok per clean bucket/round,
+#    result=corrupt per detection; site in engine | compiled |
+#    sentinel | guard | spill | broadcast), the rollbacks counter once
+#    per quarantined step (labeled by the detection reason), and the
+#    histogram times the divergence sentinel's fingerprint-fold +
+#    MIN/MAX agreement rounds.  One definition here — the engine
+#    catalogue, core/integrity.py and tools/integrity_smoke.py all
+#    import it.
+
+INTEGRITY_CHECKS_FAMILY = "horovod_integrity_checks_total"
+INTEGRITY_CHECKS_HELP = (
+    "Step-integrity verifications, by result (ok | corrupt) and site "
+    "(engine/compiled wire checksums, sentinel agreement rounds, "
+    "update guards, spill/broadcast CRC checks)")
+INTEGRITY_CHECKS_LABELS = ("result", "site")
+INTEGRITY_ROLLBACKS_FAMILY = "horovod_integrity_rollbacks_total"
+INTEGRITY_ROLLBACKS_HELP = (
+    "Steps quarantined by an integrity detection (update discarded, "
+    "wire/bypass/autotune state reset, replay from the last elastic "
+    "commit), by detection reason")
+INTEGRITY_ROLLBACKS_LABELS = ("reason",)
+INTEGRITY_SENTINEL_SECONDS_FAMILY = "horovod_integrity_sentinel_seconds"
+INTEGRITY_SENTINEL_SECONDS_HELP = (
+    "Wall seconds per divergence-sentinel round (param fingerprint "
+    "fold + MIN/MAX agreement allreduce)")
+
 # -- MPMD pipeline runtime (docs/parallelism.md; parallel/runtime.py):
 #    the runtime and pp_smoke/benchmarks consume these, so the family
 #    names live ONCE here.  `schedule` label values are the latched
@@ -277,6 +305,34 @@ def observe_control_cycle(tier, seconds):
         CONTROL_CYCLE_SECONDS_FAMILY, CONTROL_CYCLE_SECONDS_HELP,
         labelnames=CONTROL_CYCLE_SECONDS_LABELS).labels(
         tier=tier).observe(seconds)
+
+
+def count_integrity_check(result, site):
+    """One integrity verification outcome, into the process-current
+    registry (resolved per call: the engine installs a fresh registry
+    each lifecycle and the elastic spill path outlives it)."""
+    registry().counter(
+        INTEGRITY_CHECKS_FAMILY, INTEGRITY_CHECKS_HELP,
+        labelnames=INTEGRITY_CHECKS_LABELS).labels(
+        result=result, site=site).inc()
+
+
+def count_integrity_rollback(reason):
+    """One quarantined step (integrity detection -> update discarded,
+    replay from the last elastic commit), into the process-current
+    registry."""
+    registry().counter(
+        INTEGRITY_ROLLBACKS_FAMILY, INTEGRITY_ROLLBACKS_HELP,
+        labelnames=INTEGRITY_ROLLBACKS_LABELS).labels(
+        reason=reason).inc()
+
+
+def observe_sentinel_seconds(seconds):
+    """One divergence-sentinel round's wall time, into the
+    process-current registry."""
+    registry().histogram(
+        INTEGRITY_SENTINEL_SECONDS_FAMILY,
+        INTEGRITY_SENTINEL_SECONDS_HELP).observe(seconds)
 
 
 def count_sharded_update():

@@ -520,6 +520,11 @@ class _GradSync:
         # fixed model across steps)
         self.wire_dtype = getattr(compression, "wire", None)
         self._residuals = {}
+        # a step quarantine (core/integrity.py) must reset these
+        # residuals too: the in-place rollback never reaches the
+        # elastic reset that would
+        from ..core.integrity import register_wire_state
+        register_wire_state(self)
         self.op = op
         self.gradient_predivide_factor = gradient_predivide_factor
         self.process_set = process_set

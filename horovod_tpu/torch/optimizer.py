@@ -41,6 +41,11 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         # optimizer owns the error-feedback residual state
         self._wire_dtype = getattr(compression, "wire", None)
         self._residuals = {}
+        # a step quarantine (core/integrity.py) must reset these
+        # residuals too: the in-place rollback never reaches the
+        # elastic reset that would
+        from ..core.integrity import register_wire_state
+        register_wire_state(self)
         self.op = op
         self.gradient_predivide_factor = gradient_predivide_factor
         self.sparse_as_dense = sparse_as_dense

@@ -90,13 +90,37 @@ class CheckpointManager:
         self._mgr.close()
 
 
+class _CrcWriter:
+    """File proxy accumulating a CRC32 while the pickle streams to
+    disk — the trailer costs no in-memory serialized copy."""
+
+    def __init__(self, f):
+        self._f = f
+        self.crc = 0
+        self.length = 0
+
+    def write(self, b):
+        import zlib
+
+        self.crc = zlib.crc32(b, self.crc)
+        self.length += len(b)
+        return self._f.write(b)
+
+
 def save_rank0(path: str, state: Any):
     """Rank-0-writes convention for host-side states (reference:
     checkpoint on rank 0 only, docs and examples throughout).  Call
-    from every rank; only rank 0 touches the filesystem."""
+    from every rank; only rank 0 touches the filesystem.
+
+    The file ends with a CRC trailer (core/integrity.py): pickle
+    readers stop at the end of their stream so legacy loaders are
+    unaffected, while :func:`read_verified` /
+    :func:`load_and_broadcast` detect torn writes and bit corruption
+    instead of deserializing garbage."""
     import pickle
 
     from ..common import basics
+    from ..core import integrity as integrity_mod
 
     if basics.rank() != 0:
         return
@@ -105,13 +129,39 @@ def save_rank0(path: str, state: Any):
     with open(tmp, "wb") as f:
         # stream straight to disk — no in-memory serialized copies
         # (multi-GB host states are the point of this helper)
-        pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
+        w = _CrcWriter(f)
+        pickle.dump(state, w, protocol=pickle.HIGHEST_PROTOCOL)
+        f.write(integrity_mod.crc_trailer(w.length, w.crc))
     os.replace(tmp, path)
 
 
 class CheckpointLoadError(RuntimeError):
     """The root rank failed to load a checkpoint in
     :func:`load_and_broadcast`; raised COLLECTIVELY on every rank."""
+
+
+class CheckpointCorruptionError(CheckpointLoadError):
+    """The checkpoint file failed CRC-trailer verification (torn
+    write / bit corruption) — detected BEFORE deserialization so
+    garbage never reaches the model (docs/fault_tolerance.md "Silent
+    data corruption")."""
+
+
+def read_verified(path: str) -> bytes:
+    """Read a checkpoint file's payload bytes, verifying the CRC
+    trailer when present (:class:`CheckpointCorruptionError` on a
+    torn or corrupted file; legacy trailer-less files pass
+    through)."""
+    from ..core import integrity as integrity_mod
+
+    with open(path, "rb") as f:
+        raw = f.read()
+    try:
+        return integrity_mod.strip_crc_trailer(raw)
+    except integrity_mod.TrailerCorruptionError as exc:
+        raise CheckpointCorruptionError(
+            f"checkpoint {path} failed integrity verification "
+            f"({exc.kind}): {exc}") from exc
 
 
 class _LoadFailure:
@@ -126,30 +176,64 @@ class _LoadFailure:
 def load_and_broadcast(path: str, root_rank: int = 0) -> Any:
     """Restore-and-broadcast convention (reference
     BroadcastGlobalVariablesHook / broadcast_object on restore): root
-    loads the file, every rank receives the object, so all ranks
-    resume bit-identical.
+    loads and CRC-verifies the file (:func:`read_verified`), every
+    rank receives the serialized bytes AND verifies them against the
+    root's digest before installing — a corrupted broadcast cannot
+    seed a silently-diverged replica fleet.
 
-    A load failure on the root (missing/corrupt file) broadcasts an
-    error sentinel first, then every rank raises
-    :class:`CheckpointLoadError` together — raising only on the root
-    would leave every other rank hanging in the broadcast with no
-    counterpart (docs/fault_tolerance.md)."""
+    Failures raise COLLECTIVELY: a root load/verify failure ships an
+    error sentinel so every rank raises :class:`CheckpointLoadError`
+    together, and a digest mismatch on ANY receiving rank fails every
+    rank (the ok-flags allgather) naming the bad ranks — raising on
+    one rank only would leave its peers hanging or, worse, training
+    against a diverged replica (docs/fault_tolerance.md).
+
+    Memory: root holds the file bytes + the unpickled state (~2x the
+    state) — the same order as before, since ``broadcast_object``
+    always serialized the whole object in memory anyway; the digest
+    protocol just makes the serialized form explicit."""
     import pickle
 
     from ..common import basics
-    from ..ops.api import broadcast_object
+    from ..core import integrity as integrity_mod
+    from ..ops.api import allgather_object, broadcast_object
+    from .. import telemetry
 
-    state = None
+    base = os.path.basename(path)
+    header = None
+    blob = None
     if basics.rank() == root_rank:
         try:
-            with open(path, "rb") as f:
-                state = pickle.load(f)
+            blob = read_verified(path)
+            header = {"digest": integrity_mod.digest64([blob]),
+                      "n": len(blob)}
         except Exception as exc:  # noqa: BLE001 — shipped to all ranks
-            state = _LoadFailure(
+            header = _LoadFailure(
                 f"rank {root_rank} could not load checkpoint "
                 f"{path}: {type(exc).__name__}: {exc}")
-    state = broadcast_object(state, root_rank=root_rank,
-                             name=f"ckpt.{os.path.basename(path)}")
-    if isinstance(state, _LoadFailure):
-        raise CheckpointLoadError(state.message)
-    return state
+    header = broadcast_object(header, root_rank=root_rank,
+                              name=f"ckpt.hdr.{base}")
+    if isinstance(header, _LoadFailure):
+        raise CheckpointLoadError(header.message)
+    blob = broadcast_object(blob, root_rank=root_rank,
+                            name=f"ckpt.{base}")
+    ok = isinstance(blob, (bytes, bytearray)) \
+        and len(blob) == header["n"] \
+        and integrity_mod.digest64([blob]) == header["digest"]
+    oks = allgather_object(bool(ok), name=f"ckpt.ok.{base}")
+    telemetry.count_integrity_check(
+        "ok" if all(oks) else "corrupt", "broadcast")
+    if not all(oks):
+        bad = [i for i, good in enumerate(oks) if not good]
+        raise CheckpointLoadError(
+            f"broadcast checkpoint {path} failed digest verification "
+            f"on rank(s) {bad}: the received bytes do not match rank "
+            f"{root_rank}'s digest — refusing to install a diverged "
+            f"replica state")
+    try:
+        return pickle.loads(blob)
+    except Exception as exc:  # noqa: BLE001 — same bytes everywhere:
+        # the failure is deterministic and collective by construction
+        raise CheckpointLoadError(
+            f"checkpoint {path} deserialization failed after digest "
+            f"verification: {type(exc).__name__}: {exc}") from exc

@@ -68,6 +68,122 @@ def device_budget_gb(default=16.0):
     return default
 
 
+def overlap_grad_shapes(d_model, layers, embed_rows=4096):
+    """Transformer-gradient shapes in backward-readiness order (last
+    layer first, tied embedding last — the order autograd hands them
+    to the hook).  The embedding rows are capped: the harness measures
+    dispatch overlap, not embedding-table bandwidth."""
+    shapes = []
+    for _ in range(layers):
+        shapes += [(d_model, 3 * d_model), (d_model, d_model),
+                   (d_model, 4 * d_model), (4 * d_model, d_model),
+                   (d_model,), (d_model,)]
+    shapes.append((d_model,))                # final LN
+    shapes.append((embed_rows, d_model))     # embedding, ready last
+    return shapes
+
+
+def bench_overlap(args, dp, tp):
+    """A/B the compiled path's grouped vs bucket-granular dispatch
+    (``ci.sh perf`` overlap gate).
+
+    The SPMD train step above never touches ops/compiled.py, so this
+    leg drives CompiledGroupedAllreduce directly under hvd.run rank
+    threads: per gradient tensor, burn a fixed slice of host compute
+    (the stand-in for the next layer's backward) then push it into the
+    stream.  The grouped leg's single bucket closes at the LAST push —
+    all wire time lands exposed in result(); the bucketized leg's
+    early buckets fly while later chunks still compute.  Same inputs,
+    same compute, same wire — the delta is purely what the overlap
+    hides."""
+    import horovod_tpu as hvd
+
+    shapes = overlap_grad_shapes(args.d_model, args.layers,
+                                 embed_rows=args.overlap_embed_rows)
+    bucket_bytes = args.overlap_bucket_bytes
+    iters, warmup = args.iters, args.warmup
+    compute_s = args.overlap_compute_ms / 1000.0
+    hint = hvd.TopologyHint(axes=("dp", "tp"), sizes=(dp, tp)) \
+        if tp > 1 else None
+
+    def worker():
+        from horovod_tpu import telemetry
+
+        reg = telemetry.registry()
+        exposed = reg.counter(
+            telemetry.EXPOSED_COMM_SECONDS_FAMILY,
+            telemetry.EXPOSED_COMM_SECONDS_HELP,
+            labelnames=telemetry.EXPOSED_COMM_SECONDS_LABELS)
+        rng = np.random.default_rng(20260806 + hvd.rank())
+        xs = [rng.standard_normal(s).astype(np.float32)
+              for s in shapes]
+        specs = [(x.shape, x.dtype) for x in xs]
+        a = rng.standard_normal((96, 96)).astype(np.float32)
+
+        def busy(seconds):
+            end = time.perf_counter() + seconds
+            while time.perf_counter() < end:
+                np.dot(a, a)
+
+        row, leg_outs = {}, {}
+        for leg, bb in (("grouped", 0), ("bucketized", bucket_bytes)):
+            red = hvd.CompiledGroupedAllreduce(
+                op=hvd.Sum, name=f"lmov.{leg}", force_program=True,
+                bucket_bytes=bb, topology_hint=hint)
+
+            def step():
+                st = red.stream(specs)
+                for i, x in enumerate(xs):
+                    busy(compute_s)
+                    st.push(i, x)
+                return st.result()
+
+            for _ in range(warmup):
+                outs = step()
+            m0 = telemetry.counter_total(
+                telemetry.PROGRAM_CACHE_MISSES_FAMILY)
+            e0 = exposed.labels(path=leg).value
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                outs = step()
+            dt = time.perf_counter() - t0
+            leg_outs[leg] = outs
+            row[f"overlap_{leg}_step_ms"] = dt / iters * 1000.0
+            row[f"overlap_{leg}_exposed_s"] = \
+                exposed.labels(path=leg).value - e0
+            # cache-miss counter is process-global: any rank seeing a
+            # miss inside its timed window is a steady-state recompile
+            row[f"overlap_{leg}_recompiles"] = \
+                telemetry.counter_total(
+                    telemetry.PROGRAM_CACHE_MISSES_FAMILY) - m0
+        row["parity"] = all(
+            np.array_equal(g, b) for g, b in
+            zip(leg_outs["grouped"], leg_outs["bucketized"]))
+        return row
+
+    rows = hvd.run(worker, np=dp * tp)
+    out = {"overlap_bucket_bytes": bucket_bytes,
+           "overlap_n_tensors": len(shapes),
+           "overlap_compute_ms_per_tensor": args.overlap_compute_ms}
+    for leg in ("grouped", "bucketized"):
+        out[f"overlap_{leg}_step_ms"] = round(float(np.mean(
+            [r[f"overlap_{leg}_step_ms"] for r in rows])), 2)
+        out[f"overlap_{leg}_exposed_s"] = round(float(np.mean(
+            [r[f"overlap_{leg}_exposed_s"] for r in rows])), 4)
+    out["overlap_exposed_reduction"] = round(
+        out["overlap_grouped_exposed_s"]
+        / max(out["overlap_bucketized_exposed_s"], 1e-9), 3)
+    out["overlap_step_win"] = round(
+        out["overlap_grouped_step_ms"]
+        / max(out["overlap_bucketized_step_ms"], 1e-9), 3)
+    out["overlap_steady_recompiles"] = int(max(
+        r[f"overlap_{leg}_recompiles"] for r in rows
+        for leg in ("grouped", "bucketized")))
+    out["overlap_bitwise_parity"] = float(all(
+        r["parity"] for r in rows))
+    return out
+
+
 def bench_impl(impl, cfg, tokens, mesh, iters, warmup, pipeline=None,
                sharded=False):
     from horovod_tpu.parallel import make_lm_train_step
@@ -124,6 +240,24 @@ def main():
     p.add_argument("--config", default=None, choices=["lm2b"],
                    help="named model preset; lm2b is the multi-B-"
                         "param config that only fits with --sharded")
+    p.add_argument("--overlap-compare", action="store_true",
+                   help="A/B the compiled path's grouped vs bucket-"
+                        "granular collective dispatch over hvd.run "
+                        "rank threads (the ci.sh perf overlap gate); "
+                        "composes with --parallelism dp,tp")
+    p.add_argument("--overlap-bucket-bytes", type=int,
+                   default=256 * 1024,
+                   help="bucket ceiling for the bucketized leg of "
+                        "--overlap-compare (0 would degenerate to "
+                        "grouped)")
+    p.add_argument("--overlap-embed-rows", type=int, default=4096,
+                   help="embedding rows in the synthetic gradient set "
+                        "of --overlap-compare (capped: the harness "
+                        "measures dispatch overlap, not table "
+                        "bandwidth)")
+    p.add_argument("--overlap-compute-ms", type=float, default=2.0,
+                   help="simulated backward compute burned per "
+                        "gradient tensor in --overlap-compare")
     p.add_argument("--memory-budget-gb", type=float, default=None,
                    help="per-device memory budget for the fit gate "
                         "(default: the device's reported limit, else "
@@ -156,6 +290,20 @@ def main():
             jax.config.update("jax_num_cpu_devices", args.cpu)
         except AttributeError:
             pass   # older jax: XLA_FLAGS is the only lever
+
+    if args.overlap_compare:
+        dp, tp, pp = parse_parallelism(args.parallelism) \
+            if args.parallelism else (len(jax.devices()), 1, 1)
+        if pp > 1:
+            raise SystemExit(
+                "--overlap-compare composes with dp/tp; the compiled "
+                "path's overlap seam against pp is the reduce tick "
+                "(docs/concepts.md), not this harness")
+        out = {"d_model": args.d_model, "layers": args.layers,
+               "parallelism": {"dp": dp, "tp": tp, "pp": 1}}
+        out.update(bench_overlap(args, dp, tp))
+        print(json.dumps(out))
+        return
 
     from horovod_tpu.models import TransformerConfig
     from horovod_tpu.parallel import (

@@ -191,6 +191,19 @@ HOROVOD_AUTOTUNE_CACHE = "HOROVOD_AUTOTUNE_CACHE"
 HOROVOD_SHARDED_OPTIMIZER = "HOROVOD_SHARDED_OPTIMIZER"
 HOROVOD_SHARD_LAYOUT = "HOROVOD_SHARD_LAYOUT"
 
+# bucket-granular comm/compute overlap on the compiled path
+# (docs/concepts.md "Bucket-granular dispatch"; ops/compiled.py):
+# OVERLAP_BUCKET_BYTES splits the compiled grouped reduction into
+# per-bucket programs of at most this many payload bytes each,
+# dispatched as each bucket's gradients arrive so the collectives
+# pipeline against the remaining backward compute (0 = one grouped
+# program, the pre-overlap behavior).  OVERLAP_AUTOTUNE sweeps the
+# bucket size as the autotuner's NINTH dimension.  Reducers LATCH
+# the value once per call/stream, so a mid-step flip can never split
+# one step across two bucketings.
+HOROVOD_OVERLAP_BUCKET_BYTES = "HOROVOD_OVERLAP_BUCKET_BYTES"
+HOROVOD_OVERLAP_AUTOTUNE = "HOROVOD_OVERLAP_AUTOTUNE"
+
 # end-to-end step integrity (docs/fault_tolerance.md "Silent data
 # corruption"; core/integrity.py): INTEGRITY=0 disables the wire
 # checksums + implicated-rank vote (they default ON — the digests are
@@ -244,6 +257,12 @@ INTERNAL_KNOBS = (
 )
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024
+#: Overlap bucket-size grid the autotuner sweeps (ninth dimension)
+#: and docs/autotune.md documents: 0 = grouped single program, then
+#: 1/4/16/64 MiB bucket ceilings.  Lives here (not core/autotune.py)
+#: so ops/compiled.py and the benches import it without pulling the
+#: tuner.
+OVERLAP_BUCKET_CHOICES = (0, 1 << 20, 4 << 20, 16 << 20, 64 << 20)
 DEFAULT_CYCLE_TIME_MS = 1.0
 DEFAULT_CACHE_CAPACITY = 1024
 DEFAULT_STALL_WARNING_SECS = 60.0
@@ -494,6 +513,15 @@ class Config:
             self.shard_layout = normalize_shard_layout(raw_layout)
         else:
             self.shard_layout = "bucket"
+        # bucket-granular comm/compute overlap (ops/compiled.py):
+        # max payload bytes per compiled bucket program (0 = one
+        # grouped program), and whether the autotuner sweeps the
+        # bucket size as its ninth dimension.  The reducer latches
+        # the value once per call/stream — a mid-step autotune flip
+        # never splits one step across bucketings.
+        self.overlap_bucket_bytes = get_int(
+            HOROVOD_OVERLAP_BUCKET_BYTES, 0)
+        self.overlap_autotune = get_bool(HOROVOD_OVERLAP_AUTOTUNE)
         # end-to-end step integrity (core/integrity.py): wire
         # checksums + the implicated-rank vote default ON; the
         # sentinel cadence and guards are read by StepSentinel, the

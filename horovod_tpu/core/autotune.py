@@ -36,6 +36,7 @@ import numpy as np
 
 from .optim import BayesianOptimizer
 from .sharded import SHARD_LAYOUT_CHOICES
+from ..common.env import OVERLAP_BUCKET_CHOICES
 from ..common.topology import ALGORITHMS
 from ..ops.quantize import WIRE_PAIR_CHOICES, wire_pair_label
 # PP_CHOICES / pp_label load lazily in ParameterManager.__init__:
@@ -57,8 +58,8 @@ class ParameterManager:
     def __init__(self, config, warmup_samples=3, steps_per_sample=10,
                  max_samples=20, log_path=None, seed=0, tune_wire=True,
                  tune_algorithm=True, tune_pipeline=False,
-                 tune_sharded=False, cache_path=None, topo_fp="local",
-                 world_size=1):
+                 tune_sharded=False, tune_overlap=False,
+                 cache_path=None, topo_fp="local", world_size=1):
         self.config = config
         self.warmup_samples = warmup_samples
         self.steps_per_sample = steps_per_sample
@@ -90,6 +91,14 @@ class ParameterManager:
         # the updaters re-shard deterministically when a sweep flips
         # it (a coordinated vote, never mid-step)
         self.tune_sharded = bool(tune_sharded)
+        # NINTH dimension: the compiled path's overlap bucket ceiling
+        # (common/env.OVERLAP_BUCKET_CHOICES; 0 = one grouped
+        # program) — only swept when HOROVOD_OVERLAP_AUTOTUNE opts
+        # in: the dense reducer re-reads config.overlap_bucket_bytes
+        # at each stream's start (never mid-stream), so a sweep can
+        # flip the ceiling without splitting one step across bucket
+        # layouts; the sharded train step latches it once at build
+        self.tune_overlap = bool(tune_overlap)
         # warm start (docs/autotune.md "Warm start"): a local JSON
         # cache of converged best configs keyed by (bucket signature,
         # topology, world size) — production jobs start at
@@ -104,10 +113,15 @@ class ParameterManager:
             # (reducescatter+allgather vs allreduce): their optima
             # never warm-start a dense job, or vice versa
             self._key_suffix += "|sharded"
+        if self.tune_overlap:
+            # an overlap-swept optimum is only meaningful to jobs
+            # that dispatch bucket-granular programs
+            self._key_suffix += "|overlap"
         self._cache_key = None
         self.warm_started = False
         dims = 4 + int(self.tune_wire) + int(self.tune_algorithm) \
-            + int(self.tune_pipeline) + int(self.tune_sharded)
+            + int(self.tune_pipeline) + int(self.tune_sharded) \
+            + int(self.tune_overlap)
         self._bo = BayesianOptimizer(dims=dims, seed=seed)
         self._samples = 0
         self._steps = 0
@@ -122,7 +136,8 @@ class ParameterManager:
             getattr(config, "algorithm", None),
             (getattr(config, "pp_schedule", None),
              getattr(config, "pp_n_micro", 0)),
-            getattr(config, "shard_layout", None))
+            getattr(config, "shard_layout", None),
+            getattr(config, "overlap_bucket_bytes", None))
         self._best_score = -np.inf
         self._best = self._current
         self._log = open(log_path, "w") if log_path else None
@@ -131,16 +146,19 @@ class ParameterManager:
             algo_col = "algorithm," if self.tune_algorithm else ""
             pp_col = "pipeline," if self.tune_pipeline else ""
             shard_col = "shard_layout," if self.tune_sharded else ""
+            ov_col = "overlap_bucket_bytes," if self.tune_overlap \
+                else ""
             self._log.write(
                 "sample,fusion_threshold_bytes,cycle_time_ms,"
                 f"pack_mt_threshold_bytes,cache_capacity,{wire_col}"
-                f"{algo_col}{pp_col}{shard_col}score_bytes_per_sec\n")
+                f"{algo_col}{pp_col}{shard_col}{ov_col}"
+                "score_bytes_per_sec\n")
 
     # -- encoding ------------------------------------------------------------
 
     def _encode(self, fusion_bytes, cycle_ms, pack_mt_bytes,
                 cache_capacity, wire_pair=None, algorithm=None,
-                pp_pair=None, shard_layout=None):
+                pp_pair=None, shard_layout=None, overlap_bucket=None):
         x0 = (np.log2(max(fusion_bytes, 1)) - _FUSION_LO) / \
             (_FUSION_HI - _FUSION_LO)
         x1 = (np.log2(max(cycle_ms, 2 ** _CYCLE_LO)) - _CYCLE_LO) / \
@@ -213,6 +231,15 @@ class ParameterManager:
             except ValueError:
                 si = 0
             xs.append((si + 0.5) / len(SHARD_LAYOUT_CHOICES))
+        if self.tune_overlap:
+            # ninth dimension: the overlap bucket ceiling as a
+            # categorical over OVERLAP_BUCKET_CHOICES; an incumbent
+            # off the grid (hand-set env knob) seeds its nearest bin
+            # so its score stays in its own neighborhood
+            b = int(overlap_bucket or 0)
+            oi = min(range(len(OVERLAP_BUCKET_CHOICES)),
+                     key=lambda j: abs(OVERLAP_BUCKET_CHOICES[j] - b))
+            xs.append((oi + 0.5) / len(OVERLAP_BUCKET_CHOICES))
         return np.clip(xs, 0.0, 1.0)
 
     def _decode(self, x):
@@ -241,6 +268,11 @@ class ParameterManager:
             si = min(int(x[i] * len(SHARD_LAYOUT_CHOICES)),
                      len(SHARD_LAYOUT_CHOICES) - 1)
             out.append(SHARD_LAYOUT_CHOICES[si])
+            i += 1
+        if self.tune_overlap:
+            oi = min(int(x[i] * len(OVERLAP_BUCKET_CHOICES)),
+                     len(OVERLAP_BUCKET_CHOICES) - 1)
+            out.append(OVERLAP_BUCKET_CHOICES[oi])
         return tuple(out)
 
     # -- recording (engine hot path) ----------------------------------------
@@ -283,7 +315,7 @@ class ParameterManager:
         decoded = self._decode(self._best)
         fusion, cycle, _, _ = decoded[:4]
         i = 4
-        wire = algo = pipeline = shard = ""
+        wire = algo = pipeline = shard = overlap = ""
         if self.tune_wire:
             wire = wire_pair_label(*decoded[i])
             i += 1
@@ -295,6 +327,9 @@ class ParameterManager:
             i += 1
         if self.tune_sharded:
             shard = decoded[i]
+            i += 1
+        if self.tune_overlap:
+            overlap = str(decoded[i])
         best = reg.gauge(
             telemetry.AUTOTUNE_BEST_CONFIG_FAMILY,
             telemetry.AUTOTUNE_BEST_CONFIG_HELP,
@@ -306,7 +341,8 @@ class ParameterManager:
                     # hvdlint: ignore[telemetry-unbounded-label] info-gauge: best.clear() above caps it at ONE live child; the label IS the payload
                     cycle_time_ms=f"{cycle:.3f}", wire=wire,
                     algorithm=algo, pipeline=pipeline,
-                    shard_layout=shard).set(1)
+                    shard_layout=shard,
+                    overlap_bucket=overlap).set(1)
 
     def _finish_sample(self):
         elapsed = max(time.monotonic() - self._t0, 1e-6)
@@ -316,7 +352,7 @@ class ParameterManager:
             decoded = self._decode(self._current)
             fusion, cycle, pack_mt, cache = decoded[:4]
             i = 4
-            wire_col = algo_col = pp_col = shard_col = ""
+            wire_col = algo_col = pp_col = shard_col = ov_col = ""
             if self.tune_wire:
                 wire_col = f"{wire_pair_label(*decoded[i])},"
                 i += 1
@@ -328,10 +364,13 @@ class ParameterManager:
                 i += 1
             if self.tune_sharded:
                 shard_col = f"{decoded[i]},"
+                i += 1
+            if self.tune_overlap:
+                ov_col = f"{decoded[i]},"
             self._log.write(
                 f"{self._samples},{fusion},{cycle:.3f},{pack_mt},"
                 f"{cache},{wire_col}{algo_col}{pp_col}{shard_col}"
-                f"{score:.1f}\n")
+                f"{ov_col}{score:.1f}\n")
             self._log.flush()
         if self._samples > self.warmup_samples:
             self._bo.observe(self._current, score)
@@ -393,6 +432,13 @@ class ParameterManager:
             # re-shard vote (a flip re-shards between steps, never
             # splits one)
             self.config.shard_layout = decoded[i]
+            i += 1
+        if self.tune_overlap:
+            # the compiled reducer latches this per stream (every
+            # stream re-reads it at construction), so a flip takes
+            # effect at the NEXT step's first bucket — one step can
+            # never split across bucket layouts
+            self.config.overlap_bucket_bytes = int(decoded[i])
 
     def best_parameters(self):
         return self._decode(self._best)
@@ -437,6 +483,9 @@ class ParameterManager:
             i += 1
         if self.tune_sharded:
             entry["shard_layout"] = decoded[i]
+            i += 1
+        if self.tune_overlap:
+            entry["overlap_bucket_bytes"] = int(decoded[i])
         return entry
 
     def _load_cache(self):
@@ -459,7 +508,8 @@ class ParameterManager:
             (entry.get("wire_inner"), entry.get("wire_outer")),
             entry.get("algorithm"),
             (entry.get("pp_schedule"), entry.get("pp_n_micro", 0)),
-            entry.get("shard_layout"))
+            entry.get("shard_layout"),
+            entry.get("overlap_bucket_bytes"))
         # start the sweep AT the cached optimum: it becomes both the
         # applied config and the BO's incumbent, so early suggestions
         # explore around it instead of from scratch
@@ -473,7 +523,9 @@ class ParameterManager:
                           ("cycle_time_ms", "cycle_time_ms"),
                           ("pack_mt_threshold_bytes",
                            "pack_mt_threshold_bytes"),
-                          ("cache_capacity", "cache_capacity")):
+                          ("cache_capacity", "cache_capacity"),
+                          ("overlap_bucket_bytes",
+                           "overlap_bucket_bytes")):
             if key in entry:
                 setattr(self.config, attr, entry[key])
         self.warm_started = True

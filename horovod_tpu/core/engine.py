@@ -203,6 +203,8 @@ class Engine:
                 tune_pipeline=getattr(self.config, "pp_stages", 1) > 1,
                 tune_sharded=bool(getattr(self.config,
                                           "sharded_optimizer", False)),
+                tune_overlap=bool(getattr(self.config,
+                                          "overlap_autotune", False)),
                 cache_path=getattr(self.config, "autotune_cache", None),
                 topo_fp=topo_fp, world_size=self.global_size)
         #: first-fusion-bucket signature noted exactly once per

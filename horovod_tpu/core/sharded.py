@@ -99,6 +99,56 @@ def chunk_sizes(n, dp):
     return [base + (1 if r < extra else 0) for r in range(dp)]
 
 
+def overlap_bucket_splits(sizes, itemsize, bucket_bytes, align=1):
+    """THE bucketization rule for bucket-granular comm/compute
+    overlap (ops/compiled.py and this module's sharded step both
+    delegate here, so their bucket boundaries can never drift).
+
+    Splits ``sizes`` (per-member element counts, plan order) into
+    contiguous ``(start, stop)`` member-index runs.  A run closes at
+    the first member where the cumulative payload reaches
+    ``bucket_bytes`` AND the cumulative element count from member 0
+    is a multiple of ``align`` — with ``align`` = the quantization
+    BLOCK, every bucket boundary then falls on a block-grid boundary
+    of the grouped flat buffer, which is what keeps the quantized
+    wire bitwise identical to the single grouped program.
+    ``bucket_bytes`` <= 0 (or None) means no split: one bucket, the
+    grouped pre-overlap behavior."""
+    n = len(sizes)
+    if not n:
+        return []
+    if bucket_bytes is None or bucket_bytes <= 0:
+        return [(0, n)]
+    splits = []
+    start, run_elems, total_elems = 0, 0, 0
+    for i, sz in enumerate(sizes):
+        run_elems += int(sz)
+        total_elems += int(sz)
+        full = run_elems * itemsize >= bucket_bytes
+        aligned = align <= 1 or total_elems % align == 0
+        if i == n - 1 or (full and aligned):
+            splits.append((start, i + 1))
+            start, run_elems = i + 1, 0
+    return splits
+
+
+def overlap_segment_bounds(n, itemsize, bucket_bytes, unit=1):
+    """Within-buffer companion to :func:`overlap_bucket_splits`: split
+    one flat buffer of ``n`` elements into contiguous ``(start,
+    stop)`` segments of at most ~``bucket_bytes`` each, every segment
+    length a multiple of ``unit`` (the compiled sharded step passes
+    R, or BLOCK*R under a quantized wire, so each segment scatters
+    evenly into whole-block shards).  ``n`` itself must be a multiple
+    of ``unit`` (the sharded step's pad rule guarantees it).
+    ``bucket_bytes`` <= 0 means no split."""
+    if n <= 0:
+        return []
+    if bucket_bytes is None or bucket_bytes <= 0:
+        return [(0, n)]
+    seglen = max(unit, (bucket_bytes // itemsize) // unit * unit)
+    return [(s, min(s + seglen, n)) for s in range(0, n, seglen)]
+
+
 class ShardBucket:
     """One contiguous flat buffer: members laid out back to back, the
     dp split at ``chunks`` boundaries."""

@@ -417,7 +417,8 @@ class CompiledGroupedAllreduce:
                  postscale_factor=1.0, process_set=global_process_set,
                  name=None, force_program=False, wire_dtype=None,
                  error_feedback=False, algorithm=None,
-                 topology_hint=None, wire_inner=None):
+                 topology_hint=None, wire_inner=None,
+                 bucket_bytes=None):
         op = ReduceOp(op)
         if op not in (Average, Sum):
             raise ValueError(
@@ -477,7 +478,17 @@ class CompiledGroupedAllreduce:
         # into the trained weights.
         self.error_feedback = bool(error_feedback) \
             and self.wire_dtype in ("int8", "int4")
-        self._residuals = {}     # (sig, pos, buf_idx) -> f32 residual
+        # bucket-granular comm/compute overlap: max payload bytes per
+        # compiled bucket program (see :meth:`stream`).  ``None``
+        # defers to the engine config (HOROVOD_OVERLAP_BUCKET_BYTES /
+        # the autotuner's ninth dimension), latched ONCE per
+        # call/stream so a mid-step config flip can never split one
+        # step across bucketings; an explicit int pins it.  <= 0
+        # keeps the single grouped program — the exact pre-overlap
+        # behavior and cache key.
+        self.bucket_bytes = None if bucket_bytes is None \
+            else int(bucket_bytes)
+        self._residuals = {}     # (skey, pos, buf_idx) -> f32 residual
         # a step quarantine (core/integrity.py) resets every
         # registered reducer's host residuals, not only the detecting
         # one's (the process-global device EF is cleared separately)
@@ -505,12 +516,48 @@ class CompiledGroupedAllreduce:
     def _plan(self, arrays):
         """Group leaves by dtype → per-dtype pack layout (the fusion
         buffer, computed once per signature)."""
+        return self._plan_from_sig(self._signature(arrays))
+
+    @staticmethod
+    def _plan_from_sig(sig):
+        """The fusion plan from a (shape, dtype) signature alone — a
+        :class:`_BucketStream` opens before any tensor exists, so the
+        plan must not need the arrays."""
         groups = {}   # dtype str -> list of (index, size, shape)
-        for i, a in enumerate(arrays):
-            groups.setdefault(str(a.dtype), []).append(
-                (i, int(a.size), a.shape))
+        for i, (shape, dtype) in enumerate(sig):
+            size = 1
+            for s in shape:
+                size *= int(s)
+            groups.setdefault(str(dtype), []).append(
+                (i, size, tuple(shape)))
         order = sorted(groups)   # deterministic across ranks
         return [(d, groups[d]) for d in order]
+
+    def _bucketize(self, plan, bucket_bytes, hint=None):
+        """Split the fusion plan into bucket miniplans — each a
+        contiguous single-dtype slice of members, dispatched as its
+        own program.  Boundaries come from
+        ``core.sharded.overlap_bucket_splits``, BLOCK-aligned under a
+        flat quantized wire so every bucket's shared-scale block grid
+        coincides with the grouped buffer's and the reduction stays
+        bitwise identical to the single grouped program.
+        ``bucket_bytes`` <= 0 keeps the whole plan as one bucket (the
+        exact pre-overlap behavior and program cache key)."""
+        if bucket_bytes is None or bucket_bytes <= 0:
+            return [plan]
+        from ..core.sharded import overlap_bucket_splits
+        minis = []
+        for dtype, members in plan:
+            itemsize = 2 if dtype in ("float16", "bfloat16") \
+                else np.dtype(dtype).itemsize
+            align = quantize_mod.BLOCK \
+                if hint is None and self._wire_use(dtype) in (
+                    "int8", "int4") else 1
+            for s, e in overlap_bucket_splits(
+                    [m[1] for m in members], itemsize, bucket_bytes,
+                    align=align):
+                minis.append([(dtype, members[s:e])])
+        return minis
 
     def _wire_use(self, dtype):
         """Effective (outer / only-hop) wire format for one plan
@@ -887,7 +934,10 @@ class CompiledGroupedAllreduce:
             for i, size, shape in members:
                 outs[i] = host[off:off + size].reshape(shape)
                 off += size
-        return [outs[i] for i in range(len(outs))]
+        # ascending GLOBAL member index: a bucket miniplan's members
+        # keep their position in the full signature, so the indices
+        # are not necessarily 0..k-1
+        return [outs[i] for i in sorted(outs)]
 
     # -- execution -----------------------------------------------------------
 
@@ -1033,112 +1083,29 @@ class CompiledGroupedAllreduce:
             self._residuals.clear()
         reset_ef_state()
 
+    def stream(self, specs):
+        """Open a bucket-granular dispatch stream (the overlap PR's
+        entry point): declare the full signature up front — ``specs``
+        is a list of arrays or ``(shape, dtype)`` templates in call
+        order — then ``push(i, array)`` each tensor as backward
+        produces it and ``result()`` at the end of the step.  Each
+        bucket's program launches asynchronously the moment its
+        members are all delivered, so the collectives run underneath
+        the remaining backward compute; ``result()`` pays only the
+        un-hidden remainder (``horovod_exposed_comm_seconds_total``).
+        """
+        return _BucketStream(self, specs)
+
     def __call__(self, arrays):
         arrays = [np.asarray(a) for a in arrays]
         if not arrays:
             return []
-        self._validate(arrays)
-        eng, ps = _ps_state(self.process_set)
-        ex = ps.executor
-        if ex.num_ranks == 1 and not self.force_program:
-            scale = self.prescale * self.postscale
-            if scale != 1.0:
-                return [(a.astype(np.float32) * scale).astype(a.dtype)
-                        if _is_float(a.dtype) else a.copy()
-                        for a in arrays]
-            return [a.copy() for a in arrays]
-        sig = self._signature(arrays)
-        plan = self._plan(arrays)
-        hint = self._resolve_hint(eng, ps, ex)
-        self._account_wire(plan, ex.num_ranks, hint=hint,
-                           multihost=eng._spans_hosts(ps))
-        prog = self._program(ex, sig, plan, hint)
-        n_local = len(ex.local_positions)
-        timeline = eng.timeline
-        tag = ("reduce", int(self.op), self.prescale, self.postscale,
-               self.name, self.wire_dtype, self.wire_inner,
-               self.error_feedback,
-               hint.key() if hint is not None else None)
-        hop_ef = self.error_feedback and hint is not None
-        ef_key = ef_ress = None
-        if hop_ef:
-            ef_key, ef_ress = self._hop_residuals(ex, sig, tag, plan,
-                                                  hint)
-
-        def launch(slot_values):
-            # slot_values: {pos: (sig, [buf per dtype])} — the leader
-            # checks every local rank brought the SAME signature; a
-            # mismatch is a caller bug that must fail loudly on every
-            # rank, not hang or silently mis-reduce
-            sigs = {p: v[0] for p, v in slot_values.items()}
-            if len(set(sigs.values())) > 1:
-                raise ValueError(
-                    "compiled collective signature mismatch across "
-                    f"local ranks: {sigs} — every member rank must "
-                    "call with identical shapes/dtypes in the same "
-                    "order")
-            # first call per signature: fingerprint exchange across
-            # PROCESSES over the coordinator KV (leader-only, cached)
-            if sig not in self._validated:
-                _validate_signature_cross_process(eng, ps, tag, sig)
-                with self._lock:
-                    self._validated.add(sig)
-            import contextlib
-
-            from ..utils import profiler
-
-            span = timeline.span(f"compiled.{self.name or 'reduce'}",
-                                 "COMPILED_ALLREDUCE") \
-                if timeline is not None else contextlib.nullcontext()
-            with span, profiler.annotate("hvd_compiled_dispatch"):
-                staged = []
-                for k in range(len(plan)):
-                    rows = [slot_values[pos][1][k]
-                            for pos in ex.local_positions]
-                    if hint is not None:
-                        staged.append(ex._stage_rows_2d(
-                            rows, hint.inner, hint.reduce_axes))
-                    else:
-                        staged.append(self._stage(ex, rows))
-                if hop_ef:
-                    # per-hop EF: the device residuals ride as extra
-                    # sharded operands; the program returns their
-                    # successors after the outs
-                    staged.extend(ef_ress)
-                return prog(*staged)
-
-        my_bufs = self._pack(arrays, plan)
-        flat_ef = self.error_feedback and hint is None
-        if n_local == 1:
-            pos = ex.local_positions[0]
-        else:
-            pos = _caller_pos(eng, ps)
-            if pos is None:
-                raise ValueError(
-                    "unbound caller: compiled collectives need a rank "
-                    "context (call inside hvd.run / a launched worker)")
-        if flat_ef:
-            my_bufs = self._apply_residuals(sig, pos, my_bufs, plan)
-        integ_fps = self._integrity_arm(
-            eng, my_bufs, primary=(pos == ex.local_positions[0]))
-        if n_local == 1:
-            out = launch({pos: (sig, my_bufs)})
-        else:
-            rdv = _rendezvous_for(ps, tag, n_local)
-            out = rdv.run(pos, (sig, my_bufs), launch)
-        if integ_fps is not None:
-            # decode-site verification BEFORE the residual update: a
-            # corrupted payload must neither unpack into results nor
-            # seed next step's error feedback
-            self._integrity_verify(eng, ps, pos, my_bufs, integ_fps)
-        if self.wire_dtype is not None:
-            outs, extras = out[:len(plan)], out[len(plan):]
-            if flat_ef:
-                self._update_residuals(sig, pos, my_bufs, extras, plan)
-            elif hop_ef and extras:
-                self._store_hop_residuals(ef_key, extras)
-            out = outs
-        return self._unpack(out, plan)
+        # the grouped call IS a degenerate stream: everything pushed
+        # at once, one code path for both dispatch modes
+        st = _BucketStream(self, arrays)
+        for i, a in enumerate(arrays):
+            st.push(i, a)
+        return st.result()
 
     def _integrity_arm(self, eng, bufs, primary=True):
         """Encode-site integrity for the compiled path: digest the
@@ -1221,6 +1188,285 @@ class CompiledGroupedAllreduce:
         the executor's row staging (xla_ops._stage_rows) so shard/stack
         layout logic lives in one place."""
         return ex._stage_rows(rows)
+
+
+def _mini_sig(mp):
+    """Member-order (shape, dtype) signature of one bucket miniplan —
+    the bucket program's cache key.  Equal-shaped buckets share one
+    compiled program."""
+    return tuple((shape, dtype) for dtype, members in mp
+                 for _i, _sz, shape in members)
+
+
+class _BucketStream:
+    """One bucket-granular dispatch round over a
+    :class:`CompiledGroupedAllreduce` (the overlap tentpole).
+
+    The caller declares the full gradient signature up front, then
+    ``push``es each tensor as backward produces it.  Every time a
+    bucket's members are all delivered, the stream launches that
+    bucket's cached program ASYNCHRONOUSLY — jax dispatch returns
+    device futures — and hands control back, so the collective runs
+    underneath the remaining backward compute.  ``result()`` blocks
+    on whatever is still in flight; that residual wait is the EXPOSED
+    communication time, accumulated into
+    ``horovod_exposed_comm_seconds_total`` by dispatch path
+    (``grouped`` | ``bucketized``).
+
+    Cross-rank safety: buckets launch strictly in plan order on every
+    rank regardless of push order (bucket b only after 0..b-1),
+    because collectives must be enqueued in ONE deterministic order
+    on every member — push order decides WHEN the next bucket becomes
+    launchable, never WHICH launches next.  The bucket size is
+    latched once at stream construction (an autotune re-latch between
+    steps can never split one step across bucketings), and the
+    latched value rides the first-bucket cross-process fingerprint so
+    a divergent config fails loudly instead of hanging.  Integrity
+    digests (PR 15) arm and verify PER BUCKET; error feedback —
+    host-side flat residuals and per-hop device residuals alike — is
+    keyed per (signature, bucket size, bucket), so each bucket's
+    residual matches exactly its payload region.
+    """
+
+    def __init__(self, red, specs):
+        self.red = red
+        sig = []
+        for t in specs:
+            if isinstance(t, tuple) and len(t) == 2 \
+                    and not hasattr(t, "dtype"):
+                shape, dtype = t
+                sig.append((tuple(int(s) for s in shape),
+                            str(np.dtype(dtype))))
+            else:
+                a = np.asarray(t)
+                sig.append((a.shape, str(a.dtype)))
+        self.sig = tuple(sig)
+        self.n = len(sig)
+        eng, ps = _ps_state(red.process_set)
+        self.eng, self.ps = eng, ps
+        ex = ps.executor
+        self.ex = ex
+        self.trivial = ex.num_ranks == 1 and not red.force_program
+        self._vals = {}        # global index -> delivered array
+        self._inflight = []    # dispatched, awaiting result()
+        self._next = 0         # next bucket index to launch
+        self._done = False
+        if self.trivial:
+            self.bucket_bytes = 0
+            self.buckets = []
+            return
+        # latch the bucket size ONCE for the whole stream: the
+        # autotuner may re-latch the config between steps, never
+        # inside one (the re-latch rule tests/test_op_matrix.py pins)
+        bb = red.bucket_bytes
+        if bb is None:
+            bb = int(getattr(eng.config, "overlap_bucket_bytes", 0)
+                     or 0)
+        self.bucket_bytes = bb
+        self.plan = red._plan_from_sig(self.sig)
+        self.hint = red._resolve_hint(eng, ps, ex)
+        red._account_wire(self.plan, ex.num_ranks, hint=self.hint,
+                          multihost=eng._spans_hosts(ps))
+        self.buckets = red._bucketize(self.plan, bb, self.hint)
+        # per-bucket (program, bucket signature): the grouped bucket
+        # keeps the caller-order signature — the EXACT legacy cache
+        # key, so bucket_bytes=0 holds the pre-overlap zero-recompile
+        # invariant byte for byte
+        self._progs = []
+        for mp in self.buckets:
+            bsig = self.sig if bb <= 0 else _mini_sig(mp)
+            self._progs.append(
+                (red._program(ex, bsig, mp, self.hint), bsig))
+        n_local = len(ex.local_positions)
+        if n_local == 1:
+            self.pos = ex.local_positions[0]
+            self.rdv = None
+        else:
+            self.pos = _caller_pos(eng, ps)
+            if self.pos is None:
+                raise ValueError(
+                    "unbound caller: compiled collectives need a "
+                    "rank context (call inside hvd.run / a launched "
+                    "worker)")
+            self.rdv = _rendezvous_for(ps, self._tag(), n_local)
+
+    def _tag(self):
+        # the LEGACY rendezvous/collective identity — bucket_bytes
+        # deliberately excluded so bucket_bytes=0 streams meet the
+        # same rendezvous and signature sequence pre-overlap callers
+        # used; a bucket-count divergence across rank threads fails
+        # via the per-bucket value signature / arrival timeout
+        red, hint = self.red, getattr(self, "hint", None)
+        return ("reduce", int(red.op), red.prescale, red.postscale,
+                red.name, red.wire_dtype, red.wire_inner,
+                red.error_feedback,
+                hint.key() if hint is not None else None)
+
+    # -- delivery ------------------------------------------------------------
+
+    def push(self, i, array):
+        """Deliver tensor ``i`` (its position in the declared
+        signature); launches every bucket whose members are now
+        complete, in bucket order."""
+        if self._done:
+            raise RuntimeError("stream already finalized")
+        a = np.asarray(array)
+        if (a.shape, str(a.dtype)) != self.sig[i]:
+            raise ValueError(
+                f"pushed tensor {i} has ({a.shape}, {a.dtype}) but "
+                f"the stream declared {self.sig[i]}")
+        if i in self._vals:
+            raise RuntimeError(
+                f"tensor {i} pushed twice in one stream round")
+        self.red._validate([a])
+        self._vals[i] = a
+        if not self.trivial:
+            self._advance()
+
+    def _advance(self):
+        while self._next < len(self.buckets):
+            mp = self.buckets[self._next]
+            if any(i not in self._vals
+                   for _d, members in mp for i, _s, _sh in members):
+                return
+            self._launch_bucket(self._next, mp)
+            self._next += 1
+
+    def _launch_bucket(self, k, mp):
+        red, ex, eng, ps = self.red, self.ex, self.eng, self.ps
+        hint, bb = self.hint, self.bucket_bytes
+        prog, bsig = self._progs[k]
+        bufs = red._pack(self._vals, mp)
+        skey = (self.sig, bb, k)
+        flat_ef = red.error_feedback and hint is None
+        hop_ef = red.error_feedback and hint is not None
+        ef_key = ef_ress = None
+        if hop_ef:
+            tag = self._tag() if bb <= 0 \
+                else self._tag() + ("bucket", bb, k)
+            ef_key, ef_ress = red._hop_residuals(ex, bsig, tag, mp,
+                                                 hint)
+        if flat_ef:
+            bufs = red._apply_residuals(skey, self.pos, bufs, mp)
+        timeline = eng.timeline
+        vkey = (self.sig, bb)
+
+        def launch(slot_values):
+            # slot_values: {pos: ((bsig, k), [buf per dtype])} — the
+            # leader checks every local rank brought the SAME bucket
+            # of the SAME signature; a mismatch is a caller bug that
+            # must fail loudly, not hang or silently mis-reduce
+            sigs = {p: v[0] for p, v in slot_values.items()}
+            if len(set(sigs.values())) > 1:
+                raise ValueError(
+                    "compiled collective signature mismatch across "
+                    f"local ranks: {sigs} — every member rank must "
+                    "call with identical shapes/dtypes in the same "
+                    "order")
+            # first bucket per (signature, bucket size): fingerprint
+            # exchange across PROCESSES over the coordinator KV — the
+            # latched bucket size rides the fingerprint, so a
+            # divergent HOROVOD_OVERLAP_BUCKET_BYTES fails loudly
+            if vkey not in red._validated:
+                _validate_signature_cross_process(
+                    eng, ps, self._tag(), (self.sig, bb))
+                with red._lock:
+                    red._validated.add(vkey)
+            import contextlib
+
+            from ..utils import profiler
+
+            span = timeline.span(f"compiled.{red.name or 'reduce'}",
+                                 "COMPILED_ALLREDUCE") \
+                if timeline is not None else contextlib.nullcontext()
+            with span, profiler.annotate("hvd_compiled_dispatch"):
+                staged = []
+                for j in range(len(mp)):
+                    rows = [slot_values[p][1][j]
+                            for p in ex.local_positions]
+                    if hint is not None:
+                        staged.append(ex._stage_rows_2d(
+                            rows, hint.inner, hint.reduce_axes))
+                    else:
+                        staged.append(red._stage(ex, rows))
+                if hop_ef:
+                    # per-hop EF: the device residuals ride as extra
+                    # sharded operands; the program returns their
+                    # successors after the outs
+                    staged.extend(ef_ress)
+                # jax dispatch is asynchronous: this returns device
+                # futures while the collective executes — result()
+                # pays only whatever is still in flight
+                return prog(*staged)
+
+        fps = red._integrity_arm(
+            eng, bufs, primary=(self.pos == ex.local_positions[0]))
+        if self.rdv is None:
+            out = launch({self.pos: ((bsig, k), bufs)})
+        else:
+            out = self.rdv.run(self.pos, ((bsig, k), bufs), launch)
+        from .. import telemetry
+        telemetry.count_overlap_buckets()
+        self._inflight.append((mp, bufs, fps, skey, ef_key, out))
+
+    # -- completion ----------------------------------------------------------
+
+    def result(self):
+        """Block on every in-flight bucket, verify integrity and fold
+        error feedback per bucket, and return the reduced tensors in
+        the declared order."""
+        if self._done:
+            raise RuntimeError("stream already finalized")
+        if len(self._vals) != self.n:
+            missing = [i for i in range(self.n)
+                       if i not in self._vals]
+            raise RuntimeError(
+                "result() called before every declared tensor was "
+                f"pushed (missing {missing})")
+        self._done = True
+        red = self.red
+        if self.trivial:
+            scale = red.prescale * red.postscale
+            out = []
+            for i in range(self.n):
+                a = self._vals[i]
+                if scale != 1.0 and _is_float(a.dtype):
+                    out.append((a.astype(np.float32)
+                                * scale).astype(a.dtype))
+                else:
+                    out.append(a.copy())
+            return out
+        import time as _time
+
+        from .. import telemetry
+
+        t0 = _time.perf_counter()
+        for *_head, out in self._inflight:
+            jax.block_until_ready(out)
+        telemetry.add_exposed_comm_seconds(
+            "grouped" if self.bucket_bytes <= 0 else "bucketized",
+            _time.perf_counter() - t0)
+        results = {}
+        for mp, bufs, fps, skey, ef_key, out in self._inflight:
+            if fps is not None:
+                # decode-site verification BEFORE the residual
+                # update: a corrupted payload must neither unpack
+                # into results nor seed next step's error feedback
+                red._integrity_verify(self.eng, self.ps, self.pos,
+                                      bufs, fps)
+            if red.wire_dtype is not None:
+                outs, extras = out[:len(mp)], out[len(mp):]
+                if red.error_feedback and self.hint is None:
+                    red._update_residuals(skey, self.pos, bufs,
+                                          extras, mp)
+                elif ef_key is not None and extras:
+                    red._store_hop_residuals(ef_key, list(extras))
+                out = outs
+            gidx = sorted(i for _d, members in mp
+                          for i, _s, _sh in members)
+            for i, arr in zip(gidx, red._unpack(out, mp)):
+                results[i] = arr
+        return [results[i] for i in range(self.n)]
 
 
 def batch_signature(tree):
@@ -1374,7 +1620,7 @@ class _CompiledTrainStep:
 
     def __init__(self, loss_fn, optimizer, op, process_set, donate,
                  has_aux=False, sharded=False, wire_dtype=None,
-                 topology_hint=None):
+                 topology_hint=None, wire_inner=None):
         op = ReduceOp(op)
         if op not in (Average, Sum, Adasum):
             raise ValueError("op must be Average, Sum, or Adasum")
@@ -1405,14 +1651,22 @@ class _CompiledTrainStep:
         if topology_hint is not None and \
                 not isinstance(topology_hint, TopologyHint):
             raise ValueError("topology_hint must be a TopologyHint")
-        if topology_hint is not None and \
-                self.wire_dtype in ("int8", "int4"):
-            raise ValueError(
-                "sharded=True supports quantized gradient wires on "
-                "the flat decomposition only (per-hop 16-bit casts "
-                "ride a TopologyHint; the engine-path sharded "
-                "optimizer covers quantized per-hop wires)")
         self.topology_hint = topology_hint
+        # per-hop wire pair on the decomposed reducescatter: under a
+        # TopologyHint + quantized ``wire_dtype``, the inner (ICI)
+        # hop rides ``wire_inner`` (16-bit cast, same uniform
+        # shorthand as the dense reducer) and the outer (DCN) hop the
+        # shared-scale integer codec, EF measured on the
+        # inner-scattered shard.  Updated params allgather back full
+        # width — weights never cross a lossy codec.
+        self.wire_inner = quantize_mod.normalize_inner_wire(wire_inner)
+        # bucket-granular rs/ag: the flat sharded program splits each
+        # leaf's scatter/gather into ~bucket_bytes segments so XLA
+        # pipelines them against backward compute.  Latched ONCE from
+        # the engine config at first state-init/build (segment layout
+        # is baked into the opt-state sharding, so a mid-run flip
+        # must never re-split).
+        self._bucket_bytes_latched = None
         self._prog = None
         self._ex = None
         self._tag = None
@@ -1527,6 +1781,37 @@ class _CompiledTrainStep:
             if self.wire_dtype in ("int8", "int4") else R
         return -(-n // unit) * unit
 
+    def _overlap_bucket_bytes(self):
+        """Latched overlap bucket size for the sharded program's
+        segmented rs/ag — read from the engine config exactly once
+        (first of state init / program build), so one training run
+        can never mix segment layouts."""
+        bb = self._bucket_bytes_latched
+        if bb is None:
+            bb = 0
+            if self.sharded:
+                eng, _ps = _ps_state(self.process_set)
+                bb = int(getattr(eng.config, "overlap_bucket_bytes",
+                                 0) or 0)
+            self._bucket_bytes_latched = bb
+        return bb
+
+    def _seg_bounds(self, pad, R, hint):
+        """Scatter/gather segment bounds for one padded flat leaf
+        (core.sharded.overlap_segment_bounds): flat decomposition
+        only — under a TopologyHint the per-hop split is already the
+        finer granularity.  Segment lengths are multiples of the
+        shard unit, so every segment scatters into whole (block-
+        aligned) shards and the reduction stays bitwise identical to
+        the unsegmented program."""
+        if hint is not None:
+            return [(0, pad)]
+        from ..core.sharded import overlap_segment_bounds
+        unit = quantize_mod.BLOCK * R \
+            if self.wire_dtype in ("int8", "int4") else R
+        return overlap_segment_bounds(
+            pad, 4, self._overlap_bucket_bytes(), unit=unit)
+
     def _resolve_shard_hint(self, ex):
         hint = self.topology_hint
         if hint is None:
@@ -1586,8 +1871,11 @@ class _CompiledTrainStep:
         BLOCK = quantize_mod.BLOCK
         mesh = ex.mesh if hint is None else \
             ex.mesh2d(hint.inner, hint.reduce_axes)
+        inner_w = None
         if hint is not None:
             ax_out, ax_in = hint.reduce_axes
+            inner_w = quantize_mod.effective_inner_wire(
+                self.wire_inner, wire, 4)
 
         import optax
 
@@ -1662,6 +1950,48 @@ class _CompiledTrainStep:
                  * scale_shard[:, None]).reshape(-1)
             return y, new_res
 
+        def scatter_quant_2d(g, res):
+            # per-hop wire pair on the sharded reducescatter (the
+            # PR 14 follow-up this PR folds in): inner (ICI) hop over
+            # ``wire_inner`` (16-bit cast), then the EQuARX shared-
+            # scale integer psum_scatter across the outer (DCN) axis.
+            # EF is measured where the quantization error exists — on
+            # the inner-scattered (pad // inner) shard, the state
+            # each rank's grad_ef leaf carries.  Updated params
+            # allgather back full width: weights never cross a lossy
+            # codec.
+            qmax = quantize_mod.quantized_qmax(bits)
+            pad = g.shape[0]
+            x = g
+            if inner_w in ("bf16", "fp16"):
+                x = x.astype(jnp.bfloat16 if inner_w == "bf16"
+                             else jnp.float16)
+            y = lax.psum_scatter(x, ax_in, scatter_dimension=0,
+                                 tiled=True)
+            y = y.astype(jnp.float32) + res
+            nb = y.shape[0] // BLOCK
+            xb = y.reshape(nb, BLOCK)
+            absmax16 = jnp.max(jnp.abs(xb), axis=-1) \
+                .astype(jnp.bfloat16)
+            shared = lax.pmax(absmax16, ax_out)
+            scale = (shared.astype(jnp.float32) / np.float32(qmax)) \
+                .astype(jnp.bfloat16).astype(jnp.float32)
+            safe = jnp.where(scale > 0, scale, np.float32(1.0))
+            q = jnp.clip(jnp.round(xb / safe[:, None]), -qmax, qmax)
+            new_res = (xb - q * safe[:, None]).reshape(-1)
+            acc = jnp.dtype(quantize_mod.quantized_acc_dtype_np(
+                bits, hint.outer))
+            y_int = lax.psum_scatter(
+                q.astype(acc).reshape(-1), ax_out,
+                scatter_dimension=0, tiled=True)
+            m = pad // R
+            sb = (lax.axis_index(ax_out) * m) // BLOCK
+            scale_shard = lax.dynamic_slice(safe, (sb,),
+                                            (m // BLOCK,))
+            y = (y_int.astype(jnp.float32).reshape(m // BLOCK, BLOCK)
+                 * scale_shard[:, None]).reshape(-1)
+            return y, new_res
+
         def gather_shard(u):
             # updated param shard back to the full flat buffer —
             # inner hop last so the DCN hop only moves 1/inner
@@ -1700,19 +2030,52 @@ class _CompiledTrainStep:
             for g, p, r in zip(leaves, p_leaves, ef_leaves):
                 n = g.size
                 pad = self._shard_pad(n, R)
+                # bucket-granular rs (the overlap tentpole, sharded
+                # flavor): segment the flat leaf so XLA gets
+                # bucket-sized collectives to pipeline against the
+                # remaining backward — segments are whole shard
+                # units, so the reduction is bitwise identical to
+                # the unsegmented program
+                segs = self._seg_bounds(pad, R, hint)
                 flat = jnp.pad(g.reshape(-1).astype(jnp.float32),
                                (0, pad - n))
-                if quant:
-                    y, nr = scatter_quant(flat, r.reshape(-1))
+                if quant and hint is not None:
+                    y, nr = scatter_quant_2d(flat, r.reshape(-1))
+                    new_ef.append(nr.reshape(r.shape))
+                elif quant:
+                    rr = r.reshape(-1)
+                    if len(segs) == 1:
+                        y, nr = scatter_quant(flat, rr)
+                    else:
+                        ys, nrs = zip(*[
+                            scatter_quant(flat[s:e], rr[s:e])
+                            for s, e in segs])
+                        y, nr = jnp.concatenate(ys), \
+                            jnp.concatenate(nrs)
                     new_ef.append(nr.reshape(r.shape))
                 else:
-                    y, _ = scatter_plain(flat)
+                    if len(segs) == 1:
+                        y, _ = scatter_plain(flat)
+                    else:
+                        y = jnp.concatenate(
+                            [scatter_plain(flat[s:e])[0]
+                             for s, e in segs])
                 if op == Average:
                     y = y * np.float32(1.0 / R)
                 shard_g.append(y)
                 pflat = jnp.pad(p.reshape(-1), (0, pad - n))
-                shard_p.append(lax.dynamic_slice(
-                    pflat, (shard_start(pad),), (pad // R,)))
+                if len(segs) == 1:
+                    shard_p.append(lax.dynamic_slice(
+                        pflat, (shard_start(pad),), (pad // R,)))
+                else:
+                    # segment-major ownership: this rank's shard is
+                    # its slice of EACH segment, concatenated — the
+                    # layout _init_state_sharded permutes the flat
+                    # opt-state leaves into
+                    shard_p.append(jnp.concatenate(
+                        [lax.dynamic_slice(
+                            pflat, (s + shard_start(e - s),),
+                            ((e - s) // R,)) for s, e in segs]))
             shard_g_tree = jax.tree.unflatten(treedef, shard_g)
             shard_p_tree = jax.tree.unflatten(treedef, [
                 sp.astype(pl.dtype)
@@ -1724,7 +2087,21 @@ class _CompiledTrainStep:
             new_shard = optax.apply_updates(shard_p_tree, updates)
             out_leaves = []
             for u, p in zip(jax.tree.leaves(new_shard), p_leaves):
-                full = gather_shard(u)
+                pad = self._shard_pad(p.size, R)
+                segs = self._seg_bounds(pad, R, hint)
+                if len(segs) == 1:
+                    full = gather_shard(u)
+                else:
+                    # segment-granular ag, mirroring the scatter:
+                    # each segment's gather reassembles that
+                    # contiguous range, concat restores leaf order
+                    off, fulls = 0, []
+                    for s, e in segs:
+                        mi = (e - s) // R
+                        fulls.append(gather_shard(
+                            lax.dynamic_slice(u, (off,), (mi,))))
+                        off += mi
+                    full = jnp.concatenate(fulls)
                 out_leaves.append(
                     full[:p.size].reshape(p.shape).astype(p.dtype))
             new_params = jax.tree.unflatten(treedef, out_leaves)
@@ -1824,10 +2201,40 @@ class _CompiledTrainStep:
             return jax.make_array_from_callback(
                 x.shape, sharding, lambda idx, _x=x: _x[idx])
 
+        perms = {}
+
+        def seg_perm(n0):
+            # segment-major ownership permutation: under a segmented
+            # scatter (bucket-granular overlap), device r's shard is
+            # the concatenation of its slice of EACH segment — the
+            # flat opt-state leaves must be laid out the same way or
+            # the elementwise optimizer update would pair moments
+            # with the wrong gradient elements
+            if n0 not in perms:
+                segs = self._seg_bounds(n0, R, hint)
+                if len(segs) <= 1 or any((e - s) % R
+                                         for s, e in segs):
+                    perms[n0] = None
+                else:
+                    idx = np.empty(n0, np.int64)
+                    o = 0
+                    for r in range(R):
+                        for s, e in segs:
+                            m = (e - s) // R
+                            idx[o:o + m] = np.arange(
+                                s + r * m, s + (r + 1) * m)
+                            o += m
+                    perms[n0] = idx
+            return perms[n0]
+
         def put_opt(x):
             x = np.asarray(x)
             sharded = x.ndim >= 1 and x.shape[0] % R == 0 \
                 and x.shape[0] > 0
+            if sharded:
+                perm = seg_perm(x.shape[0])
+                if perm is not None:
+                    x = x[perm]
             return put(x, shd if sharded else rep)
 
         state = {"params": jax.tree.map(lambda p: put(p, rep),
@@ -1838,8 +2245,12 @@ class _CompiledTrainStep:
                 lambda a: put(a, rep), {} if aux is None else aux)
         if self.wire_dtype in ("int8", "int4"):
             def ef_leaf(p):
+                # flat: the full per-rank residual; decomposed: the
+                # residual lives where the quantization happens — on
+                # the inner-scattered (pad // inner) shard
                 pad = self._shard_pad(np.asarray(p).size, R)
-                shape = (R, pad)
+                m = pad if hint is None else pad // hint.inner
+                shape = (R, m)
                 return jax.make_array_from_callback(
                     shape, shd,
                     lambda idx, _s=shape: blocks(idx, _s))
@@ -1887,7 +2298,8 @@ class _CompiledTrainStep:
                 # part of the cache key: the same model under a
                 # different hint/wire is a different XLA program, and
                 # per-stage hints keep pp programs distinct
-                mode = ("sharded", self.wire_dtype,
+                mode = ("sharded", self.wire_dtype, self.wire_inner,
+                        self._overlap_bucket_bytes(),
                         self.topology_hint.key()
                         if self.topology_hint is not None else None) \
                     if self.sharded else None
@@ -1999,7 +2411,7 @@ def make_compiled_train_step(loss_fn, optimizer, *, op=Average,
                              process_set=global_process_set,
                              donate=True, has_aux=False,
                              sharded=False, wire_dtype=None,
-                             topology_hint=None):
+                             topology_hint=None, wire_inner=None):
     """Build the fully-compiled Horovod train step (reference
     ``xla_mpi_ops.cc`` capability, done the TPU way).
 
@@ -2039,8 +2451,20 @@ def make_compiled_train_step(loss_fn, optimizer, *, op=Average,
     optimizer state (÷R state memory — ``init_state`` builds the
     sharded layout), and the updated params ALLGATHER back — still
     ONE cached program, same call contract.
+
+    Under ``topology_hint`` + a quantized ``wire_dtype``, the
+    decomposed reducescatter carries the full per-hop wire pair:
+    ``wire_inner`` (16-bit cast) on the ICI hop, the shared-scale
+    codec with its own error-feedback state on the DCN hop; updated
+    params allgather back full width.  With
+    ``HOROVOD_OVERLAP_BUCKET_BYTES`` set, the flat sharded program
+    splits each leaf's scatter/gather into bucket-sized segments XLA
+    pipelines against backward compute — bitwise identical to the
+    unsegmented program (segments are whole shard units), latched
+    once per step object.
     """
     return _CompiledTrainStep(loss_fn, optimizer, op, process_set,
                               donate, has_aux=has_aux,
                               sharded=sharded, wire_dtype=wire_dtype,
-                              topology_hint=topology_hint)
+                              topology_hint=topology_hint,
+                              wire_inner=wire_inner)

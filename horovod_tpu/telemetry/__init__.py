@@ -175,7 +175,8 @@ AUTOTUNE_BEST_CONFIG_HELP = ("Current best autotune configuration "
                              "(value 1; the labels are the config)")
 AUTOTUNE_BEST_CONFIG_LABELS = ("fusion_threshold_bytes",
                                "cycle_time_ms", "wire", "algorithm",
-                               "pipeline", "shard_layout")
+                               "pipeline", "shard_layout",
+                               "overlap_bucket")
 ELASTIC_RESIZE_FAMILY = "horovod_elastic_resize_events_total"
 ELASTIC_RESIZE_HELP = ("Elastic membership changes seen by this "
                        "worker")
@@ -266,6 +267,46 @@ PP_RECV_WAIT_HELP = ("Seconds stages spent blocked on activation / "
                      "gradient hops — the measured (residual) bubble "
                      "time after overlap, labeled by stage")
 PP_RECV_WAIT_LABELS = ("stage",)
+
+# -- bucket-granular comm/compute overlap (ops/compiled.py): the
+#    compiled reducer splits the grouped program into per-bucket
+#    programs dispatched as gradients arrive, pipelined against the
+#    remaining backward compute.  `path` is the dispatch mode, a
+#    closed set: "grouped" (single pre-overlap program) or
+#    "bucketized".  Exposed-comm seconds is the wall time the caller
+#    sat blocked on in-flight collective programs AFTER its own
+#    compute finished — the un-hidden remainder the overlap PR
+#    exists to shrink.
+
+EXPOSED_COMM_SECONDS_FAMILY = "horovod_exposed_comm_seconds_total"
+EXPOSED_COMM_SECONDS_HELP = (
+    "Wall seconds the compiled path spent blocked on in-flight "
+    "collective programs after its own compute had finished (the "
+    "exposed, un-overlapped communication remainder), by dispatch "
+    "path (grouped | bucketized)")
+EXPOSED_COMM_SECONDS_LABELS = ("path",)
+OVERLAP_BUCKETS_FAMILY = "horovod_overlap_buckets_dispatched_total"
+OVERLAP_BUCKETS_HELP = (
+    "Bucket-granular collective programs dispatched by the compiled "
+    "path (one grouped launch counts 1; a bucketized step counts one "
+    "per bucket)")
+
+
+def add_exposed_comm_seconds(path, seconds):
+    """Accumulate exposed-communication wall seconds (collective in
+    flight, no local compute left to hide it) for one dispatch path,
+    into the process-current registry."""
+    registry().counter(
+        EXPOSED_COMM_SECONDS_FAMILY, EXPOSED_COMM_SECONDS_HELP,
+        labelnames=EXPOSED_COMM_SECONDS_LABELS).labels(
+        path=path).inc(seconds)
+
+
+def count_overlap_buckets(n=1):
+    """Count bucket programs dispatched by the compiled path, into
+    the process-current registry."""
+    registry().counter(OVERLAP_BUCKETS_FAMILY,
+                       OVERLAP_BUCKETS_HELP).inc(n)
 
 
 def count_fabric_retry(verb):

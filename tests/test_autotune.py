@@ -328,3 +328,49 @@ def test_autotune_engine_session_sweeps_pipeline(hvd_shutdown,
     col = lines[0].split(",").index("pipeline")
     pairs = {parse_pp_label(ln.split(",")[col]) for ln in lines[1:]}
     assert pairs                         # every sample logged a pair
+
+
+def test_parameter_manager_tunes_overlap_dimension(tmp_path):
+    """The NINTH dimension: the compiled path's overlap bucket
+    ceiling as a categorical over env.OVERLAP_BUCKET_CHOICES, applied
+    to config.overlap_bucket_bytes (the reducer latches it per
+    stream, so a flip lands on the next step's first bucket)."""
+    from horovod_tpu.common.env import OVERLAP_BUCKET_CHOICES
+
+    cfg = env_mod.Config()
+    log = tmp_path / "at.csv"
+    pm = ParameterManager(cfg, warmup_samples=1, steps_per_sample=2,
+                          max_samples=5, log_path=str(log),
+                          tune_overlap=True)
+    for _ in range(5 * 2):
+        pm.record_bytes(1 << 20)
+    assert not pm.active
+    best = pm.best_parameters()
+    assert len(best) == 7
+    assert best[6] in OVERLAP_BUCKET_CHOICES
+    assert cfg.overlap_bucket_bytes == best[6]       # applied
+    pm.close()
+    header = log.read_text().splitlines()[0]
+    assert "overlap_bucket_bytes," in header
+
+
+def test_overlap_seed_canonicalizes_to_nearest_bin():
+    """An incumbent hand-set HOROVOD_OVERLAP_BUCKET_BYTES off the
+    sweep grid seeds its NEAREST bin, so its score stays in its own
+    neighborhood instead of landing on 'off'."""
+    from horovod_tpu.common.env import OVERLAP_BUCKET_CHOICES
+
+    cfg = env_mod.Config()
+    pm = ParameterManager(cfg, tune_wire=False, tune_algorithm=False,
+                          tune_overlap=True)
+
+    def seeded_bin(b):
+        x = pm._encode(1 << 24, 2.0, 8 << 20, 1024, None, None,
+                       None, None, b)
+        return pm._decode(x)[4]
+
+    assert seeded_bin(0) == 0                       # off stays off
+    for choice in OVERLAP_BUCKET_CHOICES:
+        assert seeded_bin(choice) == choice         # exact bins
+    assert seeded_bin((4 << 20) + 100) == 4 << 20   # near 4 MiB
+    assert seeded_bin(1 << 30) == OVERLAP_BUCKET_CHOICES[-1]

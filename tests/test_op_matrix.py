@@ -1312,3 +1312,222 @@ def test_int4_on_dcn_error_feedback_convergence(live_engine):
     assert f32_loss < 1.0, f"baseline failed to learn: {f32_loss}"
     assert abs(int4_loss - f32_loss) <= 0.01 * f32_loss + 1e-3, \
         (int4_loss, f32_loss)
+
+
+# ---------------------------------------------------------------------------
+# bucket-granular comm/compute overlap (the overlap PR): bucketized
+# dispatch x wire pair x TopologyHint must match the one grouped
+# program — BITWISE wherever the math is elementwise-equal (full
+# width, 16-bit wires, and the flat quantized wire, whose bucket
+# closure is BLOCK-aligned so every bucket's block grid coincides
+# with the grouped buffer's), tight-allclose for quantized x hint
+# (bucket boundaries are not hint-shard-aligned, documented in
+# docs/concepts.md).
+
+OVERLAP_WIRE_CASES = [
+    # (wire, wire_inner, hint, bitwise)
+    (None, None, False, True),
+    ("bf16", None, False, True),
+    ("fp16", None, False, True),
+    ("int8", None, False, True),
+    ("int4", None, False, True),
+    (None, None, True, True),
+    ("int8", "bf16", True, False),
+]
+
+
+@pytest.mark.parametrize(
+    "wire,inner,hint,bitwise", OVERLAP_WIRE_CASES,
+    ids=[f"{w or 'f32'}{'-' + i if i else ''}{'-hint' if h else ''}"
+         for w, i, h, _ in OVERLAP_WIRE_CASES])
+def test_bucketized_dispatch_matches_grouped(live_engine, wire, inner,
+                                             hint, bitwise):
+    tag = f"ov.{wire or 'f32'}.{inner or ''}.{int(hint)}"
+
+    def run(bucket_bytes):
+        def fn():
+            th = hvd.TopologyHint(axes=("dp", "tp"), sizes=(2, 2)) \
+                if hint else None
+            red = hvd.CompiledGroupedAllreduce(
+                op=hvd.Sum, wire_dtype=wire, wire_inner=inner,
+                topology_hint=th, name=f"{tag}.{bucket_bytes}",
+                bucket_bytes=bucket_bytes, force_program=True)
+            rng = np.random.default_rng(hvd.rank())
+            xs = [rng.standard_normal(600).astype(np.float32),
+                  rng.standard_normal(1024).astype(np.float32),
+                  rng.standard_normal(256).astype(np.float32)]
+            outs = red(xs)
+            return [np.asarray(o) for o in outs]
+        return run_ranks(fn)
+
+    grouped = run(0)
+    bucketized = run(2048)       # splits the 1880-elem group
+    for g_outs, b_outs in zip(grouped, bucketized):
+        for g, b in zip(g_outs, b_outs):
+            if bitwise:
+                assert np.array_equal(g, b), \
+                    (wire, inner, hint, np.abs(g - b).max())
+            else:
+                # quantized x hint: bucket block grids are their own
+                # (align=1), so both dispatches sit within the codec
+                # error bound of the true sum — and of each other
+                assert np.allclose(g, b, atol=WIRE_ATOL[wire]), \
+                    (wire, inner, hint, np.abs(g - b).max())
+
+
+def test_bucketized_stream_incremental_push(live_engine):
+    """push() in backward-completion order (reversed, like autograd
+    produces grads) must give the same answer as the grouped call:
+    push order decides WHEN a bucket launches, never WHICH bucket a
+    tensor joins."""
+    def fn():
+        rng = np.random.default_rng(hvd.rank())
+        xs = [rng.standard_normal(512).astype(np.float32)
+              for _ in range(4)]
+        red0 = hvd.CompiledGroupedAllreduce(
+            op=hvd.Sum, name="ov.push.g", force_program=True)
+        want = [np.asarray(o) for o in red0(xs)]
+        red = hvd.CompiledGroupedAllreduce(
+            op=hvd.Sum, name="ov.push.b", bucket_bytes=1024,
+            force_program=True)
+        st = red.stream([(x.shape, x.dtype) for x in xs])
+        for i in reversed(range(4)):
+            st.push(i, xs[i])
+        got = [np.asarray(o) for o in st.result()]
+        for w, g in zip(want, got):
+            assert np.array_equal(w, g)
+        return True
+
+    assert all(run_ranks(fn))
+
+
+def test_bucketized_zero_steady_state_recompiles(live_engine):
+    """After the first step compiles each bucket program, later steps
+    must be pure cache hits — the zero-recompile invariant carries
+    over to bucket granularity (equal-shaped buckets even share one
+    program via the miniplan signature)."""
+    from horovod_tpu import telemetry
+
+    def fn():
+        rng = np.random.default_rng(hvd.rank())
+        xs = [rng.standard_normal(512).astype(np.float32)
+              for _ in range(4)]
+        red = hvd.CompiledGroupedAllreduce(
+            op=hvd.Sum, name="ov.steady", bucket_bytes=1024,
+            force_program=True)
+        red(xs)                      # warm: compiles bucket programs
+        m0 = telemetry.counter_total(
+            telemetry.PROGRAM_CACHE_MISSES_FAMILY)
+        b0 = telemetry.counter_total(telemetry.OVERLAP_BUCKETS_FAMILY)
+        for _ in range(3):
+            red(xs)
+        misses = telemetry.counter_total(
+            telemetry.PROGRAM_CACHE_MISSES_FAMILY) - m0
+        buckets = telemetry.counter_total(
+            telemetry.OVERLAP_BUCKETS_FAMILY) - b0
+        return misses, buckets
+
+    for misses, buckets in run_ranks(fn):
+        assert misses == 0, misses       # zero steady-state recompiles
+        # 4 buckets per step (each 2 KiB tensor tops the 1 KiB
+        # ceiling); the counter is process-global across the rank
+        # threads, so this rank sees AT LEAST its own 3 steps' worth
+        assert buckets >= 3 * 4, buckets
+
+
+def test_bucketized_exposed_comm_telemetry(live_engine):
+    """Both dispatch paths land wall seconds in the exposed-comm
+    counter under their own path label — the number the overlap gate
+    (ci.sh perf) diffs."""
+    from horovod_tpu import telemetry
+
+    def fn():
+        rng = np.random.default_rng(hvd.rank())
+        xs = [rng.standard_normal(512).astype(np.float32)
+              for _ in range(2)]
+        reg = telemetry.registry()
+        fam = telemetry.EXPOSED_COMM_SECONDS_FAMILY
+        c = reg.counter(fam, telemetry.EXPOSED_COMM_SECONDS_HELP,
+                        labelnames=telemetry.EXPOSED_COMM_SECONDS_LABELS)
+        path_grouped, path_bucketized = "grouped", "bucketized"
+        g0 = c.labels(path=path_grouped).value
+        b0 = c.labels(path=path_bucketized).value
+        hvd.CompiledGroupedAllreduce(
+            op=hvd.Sum, name="ov.tele.g", force_program=True)(xs)
+        hvd.CompiledGroupedAllreduce(
+            op=hvd.Sum, name="ov.tele.b", bucket_bytes=1024,
+            force_program=True)(xs)
+        return (c.labels(path=path_grouped).value - g0,
+                c.labels(path=path_bucketized).value - b0)
+
+    for dg, db in run_ranks(fn):
+        assert dg > 0 and db > 0, (dg, db)
+
+
+def test_bucketized_per_bucket_integrity_digests(live_engine):
+    """Every bucket launch arms its own wire digest: a bucketized
+    step must raise the ok-verification counter by (buckets) per
+    step, not once — PR 15's end-to-end integrity at bucket grain."""
+    from horovod_tpu import telemetry
+
+    def fn():
+        from horovod_tpu.common import basics
+        eng = basics.engine()
+        if not getattr(eng.config, "integrity_checks", True):
+            return None
+        rng = np.random.default_rng(hvd.rank())
+        xs = [rng.standard_normal(512).astype(np.float32)
+              for _ in range(4)]
+        red = hvd.CompiledGroupedAllreduce(
+            op=hvd.Sum, name="ov.integrity", bucket_bytes=1024,
+            force_program=True)
+        red(xs)                                    # warm
+        k0 = telemetry.counter_total(
+            telemetry.INTEGRITY_CHECKS_FAMILY)
+        red(xs)
+        return telemetry.counter_total(
+            telemetry.INTEGRITY_CHECKS_FAMILY) - k0
+
+    deltas = [d for d in run_ranks(fn) if d is not None]
+    # 2 buckets/step, each verified on this rank's local positions
+    assert deltas and all(d >= 2 for d in deltas), deltas
+
+
+def test_bucketized_relatch_cannot_split_one_step(live_engine):
+    """The stream latches bucket_bytes at construction: an autotuner
+    flip mid-step (between pushes) must not re-bucketize the step in
+    flight — the next stream picks the new ceiling up instead."""
+    def fn():
+        from horovod_tpu.common import basics
+        eng = basics.engine()
+        rng = np.random.default_rng(hvd.rank())
+        xs = [rng.standard_normal(512).astype(np.float32)
+              for _ in range(4)]
+        red = hvd.CompiledGroupedAllreduce(
+            op=hvd.Sum, name="ov.latch", force_program=True)
+        old = eng.config.overlap_bucket_bytes
+        eng.config.overlap_bucket_bytes = 1024
+        try:
+            st = red.stream([(x.shape, x.dtype) for x in xs])
+            assert st.bucket_bytes == 1024
+            st.push(0, xs[0])
+            # the flip lands between pushes — this step keeps its
+            # latched bucketing...
+            eng.config.overlap_bucket_bytes = 0
+            for i in range(1, 4):
+                st.push(i, xs[i])
+            st.result()
+            assert st.bucket_bytes == 1024
+            # each 2 KiB tensor tops the 1 KiB ceiling by itself
+            assert len(st.buckets) == 4
+            # ...and the NEXT stream re-latches the new value
+            st2 = red.stream([(x.shape, x.dtype) for x in xs])
+            assert st2.bucket_bytes == 0
+            for i in range(4):
+                st2.push(i, xs[i])
+            st2.result()
+        finally:
+            eng.config.overlap_bucket_bytes = old
+        return True
+
+    assert all(run_ranks(fn))

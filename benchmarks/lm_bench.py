@@ -184,6 +184,111 @@ def bench_overlap(args, dp, tp):
     return out
 
 
+def bench_moe(args):
+    """Expert-parallel loss-parity gate (``ci.sh perf`` moe leg).
+
+    Trains the capacity-routed MoE transformer and a dense baseline
+    whose FFN width FLOP-matches the top-k expert compute
+    (``parallel/moe.dense_flop_matched_ff``) on IDENTICAL data, then
+    scrapes the quantized engine alltoall that multi-process expert
+    dispatch rides.  Emits the final losses and their relative gap
+    (the <=1% acceptance bar), tokens/sec for both legs, the
+    steady-state recompile count of the compiled MoE step (the
+    fixed-capacity dispatch keeps every shape static, so the timed
+    window must never re-enter XLA), and the int8 alltoall
+    logical/actual wire ratio from the telemetry counters."""
+    import optax
+    from jax import monitoring
+
+    from horovod_tpu.models import TransformerConfig
+    from horovod_tpu.parallel import (
+        MeshSpec, build_mesh, dense_flop_matched_ff, make_lm_train_step,
+    )
+
+    compiles = [0]
+
+    def _on_event(name, *_a, **_kw):
+        if name.endswith("backend_compile_duration"):
+            compiles[0] += 1
+
+    monitoring.register_event_duration_secs_listener(_on_event)
+
+    E, K, CF = args.moe_experts, args.moe_topk, args.moe_capacity_factor
+    # per-expert hidden chosen so the top-k expert FLOPs equal the
+    # dense leg's FFN: the two legs differ only in routing
+    d_ff_expert = max((4 * args.d_model) // K, 8)
+    legs = (
+        ("moe", dict(num_experts=E, expert_top_k=K,
+                     moe_capacity_factor=CF, d_ff=d_ff_expert)),
+        ("dense_matched",
+         dict(d_ff=dense_flop_matched_ff(d_ff_expert, K))),
+    )
+    mesh = build_mesh(MeshSpec(dp=1), jax.devices()[:1])
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (args.batch, args.seq), 0, 32000)
+    out = {"moe_experts": E, "moe_topk": K, "moe_capacity_factor": CF,
+           "moe_d_ff_expert": d_ff_expert,
+           "dense_matched_d_ff": dense_flop_matched_ff(d_ff_expert, K)}
+    for leg, kw in legs:
+        cfg = TransformerConfig(
+            vocab_size=32000, d_model=args.d_model,
+            n_layers=args.layers, n_heads=args.heads,
+            max_seq_len=args.seq, dtype=jnp.bfloat16,
+            remat=args.remat, **kw)
+        init, _, jit_step, tok_shd = make_lm_train_step(
+            mesh, cfg, optimizer=optax.adamw(1e-3))
+        state = init(jax.random.PRNGKey(0), tokens)
+        compiled, state = jit_step(state)
+        toks = jax.device_put(tokens, tok_shd)
+        for _ in range(args.warmup):
+            state, loss = compiled(state, toks)
+        float(loss)                       # drain warmup compiles
+        c0 = compiles[0]
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            state, loss = compiled(state, toks)
+        lv = float(loss)
+        dt = time.perf_counter() - t0
+        out[f"{leg}_loss"] = round(lv, 4)
+        out[f"{leg}_tokens_per_sec"] = round(
+            tokens.size * args.iters / dt, 1)
+        if leg == "moe":
+            out["moe_steady_recompiles"] = compiles[0] - c0
+    out["moe_loss_gap"] = round(
+        abs(out["moe_loss"] - out["dense_matched_loss"])
+        / max(out["dense_matched_loss"], 1e-9), 4)
+    out.update(_moe_alltoall_scrape())
+    return out
+
+
+def _moe_alltoall_scrape():
+    """4-rank engine job pushing the MoE dispatch wire: quantized
+    int8 alltoalls, ratio read back from the
+    ``horovod_alltoall_*_bytes_total`` counters — the telemetry the
+    wire-reduction acceptance bar is scraped from."""
+    import horovod_tpu as hvd
+
+    def worker():
+        from horovod_tpu import telemetry
+
+        R = hvd.size()
+        rng = np.random.default_rng(20260806 + hvd.rank())
+        x = rng.standard_normal((R * 2048,)).astype(np.float32)
+        for _ in range(4):
+            hvd.alltoall(x, wire_dtype="int8", name="moe.dispatch")
+        if hvd.rank() != 0:
+            return None
+        lg = telemetry.counter_total(
+            telemetry.ALLTOALL_LOGICAL_BYTES_FAMILY)
+        ac = telemetry.counter_total(
+            telemetry.ALLTOALL_WIRE_BYTES_FAMILY)
+        return lg / max(ac, 1e-9)
+
+    rows = hvd.run(worker, np=4)
+    ratio = next(r for r in rows if r)
+    return {"moe_alltoall_int8_ratio": round(float(ratio), 3)}
+
+
 def bench_impl(impl, cfg, tokens, mesh, iters, warmup, pipeline=None,
                sharded=False):
     from horovod_tpu.parallel import make_lm_train_step
@@ -258,6 +363,20 @@ def main():
     p.add_argument("--overlap-compute-ms", type=float, default=2.0,
                    help="simulated backward compute burned per "
                         "gradient tensor in --overlap-compare")
+    p.add_argument("--moe-experts", type=int, default=0,
+                   help="run the lm-MoE loss-parity leg: train a "
+                        "capacity-routed MoE config against its "
+                        "dense-FLOP-matched baseline on identical "
+                        "data (the ci.sh perf moe gate; "
+                        "docs/parallelism.md 'Expert parallelism')")
+    p.add_argument("--moe-capacity-factor", type=float, default=1.25,
+                   help="per-expert slot headroom: capacity = "
+                        "ceil(cf * tokens * topk / experts); "
+                        "overflow drops deterministically")
+    p.add_argument("--moe-topk", type=int, default=2,
+                   help="experts each token routes to; the dense "
+                        "baseline's FFN width is topk * d_ff_expert "
+                        "so per-token FLOPs match")
     p.add_argument("--memory-budget-gb", type=float, default=None,
                    help="per-device memory budget for the fit gate "
                         "(default: the device's reported limit, else "
@@ -302,6 +421,12 @@ def main():
         out = {"d_model": args.d_model, "layers": args.layers,
                "parallelism": {"dp": dp, "tp": tp, "pp": 1}}
         out.update(bench_overlap(args, dp, tp))
+        print(json.dumps(out))
+        return
+
+    if args.moe_experts:
+        out = {"d_model": args.d_model, "layers": args.layers}
+        out.update(bench_moe(args))
         print(json.dumps(out))
         return
 

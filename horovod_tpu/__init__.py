@@ -52,9 +52,9 @@ from .ops.api import (  # noqa: F401
 )
 from .ops.compression import Compression  # noqa: F401
 from .ops.compiled import (  # noqa: F401
-    compiled_allreduce, compiled_grouped_allreduce,
-    CompiledGroupedAllreduce, CompiledPredict, TopologyHint,
-    make_compiled_train_step,
+    compiled_allreduce, compiled_alltoall, compiled_grouped_allreduce,
+    CompiledAlltoall, CompiledGroupedAllreduce, CompiledPredict,
+    TopologyHint, make_compiled_train_step,
 )
 from . import serving  # noqa: F401
 from .runner.thread_launcher import run  # noqa: F401

@@ -219,6 +219,22 @@ HOROVOD_INTEGRITY_SENTINEL_STEPS = "HOROVOD_INTEGRITY_SENTINEL_STEPS"
 HOROVOD_INTEGRITY_EVICT_AFTER = "HOROVOD_INTEGRITY_EVICT_AFTER"
 HOROVOD_INTEGRITY_MAX_GRAD_NORM = "HOROVOD_INTEGRITY_MAX_GRAD_NORM"
 
+# expert parallelism (docs/parallelism.md "Expert parallelism";
+# parallel/moe.py + ops/compiled.py CompiledAlltoall): MOE_EXPERTS is
+# the total expert count (0 = no MoE layers, the default); the
+# capacity factor sizes each expert's fixed token buffer
+# (capacity = ceil(cf * tokens * topk / experts), deterministic
+# drop/pad keeps compiled shapes static → zero steady-state
+# recompiles); TOPK is the router fan-out.  MOE_EP caps the
+# expert-parallel degree (0 = every rank; experts shard across the ep
+# axis, tokens ride the fused quantized alltoall).  (ep × capacity
+# factor) is the autotuner's TENTH dimension, swept only when
+# MOE_EXPERTS > 0.
+HOROVOD_MOE_EXPERTS = "HOROVOD_MOE_EXPERTS"
+HOROVOD_MOE_CAPACITY_FACTOR = "HOROVOD_MOE_CAPACITY_FACTOR"
+HOROVOD_MOE_TOPK = "HOROVOD_MOE_TOPK"
+HOROVOD_MOE_EP = "HOROVOD_MOE_EP"
+
 # multi-tenant fleet controller (docs/fleet.md; horovodrun
 # --fleet-spec): the JSON fleet spec source (inline, @path, or bare
 # path), the reconciliation cadence, the controller's own journal
@@ -533,3 +549,15 @@ class Config:
             HOROVOD_INTEGRITY_EVICT_AFTER, 3)
         self.integrity_max_grad_norm = get_float(
             HOROVOD_INTEGRITY_MAX_GRAD_NORM, 0.0)
+        # expert parallelism (parallel/moe.py): total experts (0 = no
+        # MoE), fixed-capacity routing factor, router top-k, and the
+        # expert-parallel degree cap (0 = every rank).  (ep ×
+        # capacity factor) is the autotuner's TENTH dimension, swept
+        # only when experts are present; layers re-read the pair at
+        # each step start so a sweep flip re-routes deterministically
+        # between steps, never inside one.
+        self.moe_experts = get_int(HOROVOD_MOE_EXPERTS, 0)
+        self.moe_capacity_factor = get_float(
+            HOROVOD_MOE_CAPACITY_FACTOR, 1.25)
+        self.moe_topk = get_int(HOROVOD_MOE_TOPK, 2)
+        self.moe_ep = get_int(HOROVOD_MOE_EP, 0)

@@ -45,6 +45,10 @@ from ..ops.quantize import WIRE_PAIR_CHOICES, wire_pair_label
 # should pay — the same deal common/env.py strikes for pp_schedule
 PP_CHOICES = None
 pp_label = None
+# MOE_CHOICES / moe_label load lazily the same way (parallel.moe):
+# only MoE jobs (config.moe_experts > 0) pay the parallel import
+MOE_CHOICES = None
+moe_label = None
 
 # log2 bounds: fusion threshold 1 MiB .. 256 MiB, cycle 0.5 .. 32 ms,
 # MT-pack threshold 1 MiB .. 64 MiB, cache capacity 0 .. 4096 entries
@@ -59,7 +63,8 @@ class ParameterManager:
                  max_samples=20, log_path=None, seed=0, tune_wire=True,
                  tune_algorithm=True, tune_pipeline=False,
                  tune_sharded=False, tune_overlap=False,
-                 cache_path=None, topo_fp="local", world_size=1):
+                 tune_moe=False, cache_path=None, topo_fp="local",
+                 world_size=1):
         self.config = config
         self.warmup_samples = warmup_samples
         self.steps_per_sample = steps_per_sample
@@ -99,6 +104,21 @@ class ParameterManager:
         # flip the ceiling without splitting one step across bucket
         # layouts; the sharded train step latches it once at build
         self.tune_overlap = bool(tune_overlap)
+        # TENTH dimension: the MoE routing geometry — the
+        # (expert-parallel degree, capacity factor) pair
+        # (parallel/moe.MOE_CHOICES) swept as ONE categorical, only
+        # when the job actually hosts experts (config.moe_experts >
+        # 0).  ep trades alltoall fan-out against per-rank expert
+        # count; the capacity factor trades dropped tokens against
+        # padded exchange bytes — both move the same wire, so they
+        # sweep together.  The MoE layer re-latches the pair at step
+        # start and snaps an ep that does not divide the set to the
+        # nearest legal degree, so a sweep can propose any bin
+        # without re-sharding mid-step
+        self.tune_moe = bool(tune_moe)
+        if self.tune_moe:
+            global MOE_CHOICES, moe_label
+            from ..parallel.moe import MOE_CHOICES, moe_label
         # warm start (docs/autotune.md "Warm start"): a local JSON
         # cache of converged best configs keyed by (bucket signature,
         # topology, world size) — production jobs start at
@@ -117,11 +137,15 @@ class ParameterManager:
             # an overlap-swept optimum is only meaningful to jobs
             # that dispatch bucket-granular programs
             self._key_suffix += "|overlap"
+        if self.tune_moe:
+            # an expert job's optimum scores the alltoall wire on
+            # top of the reduction wire — meaningless to dense jobs
+            self._key_suffix += "|moe"
         self._cache_key = None
         self.warm_started = False
         dims = 4 + int(self.tune_wire) + int(self.tune_algorithm) \
             + int(self.tune_pipeline) + int(self.tune_sharded) \
-            + int(self.tune_overlap)
+            + int(self.tune_overlap) + int(self.tune_moe)
         self._bo = BayesianOptimizer(dims=dims, seed=seed)
         self._samples = 0
         self._steps = 0
@@ -137,7 +161,9 @@ class ParameterManager:
             (getattr(config, "pp_schedule", None),
              getattr(config, "pp_n_micro", 0)),
             getattr(config, "shard_layout", None),
-            getattr(config, "overlap_bucket_bytes", None))
+            getattr(config, "overlap_bucket_bytes", None),
+            (getattr(config, "moe_ep", 0),
+             getattr(config, "moe_capacity_factor", 0.0)))
         self._best_score = -np.inf
         self._best = self._current
         self._log = open(log_path, "w") if log_path else None
@@ -148,17 +174,19 @@ class ParameterManager:
             shard_col = "shard_layout," if self.tune_sharded else ""
             ov_col = "overlap_bucket_bytes," if self.tune_overlap \
                 else ""
+            moe_col = "moe," if self.tune_moe else ""
             self._log.write(
                 "sample,fusion_threshold_bytes,cycle_time_ms,"
                 f"pack_mt_threshold_bytes,cache_capacity,{wire_col}"
-                f"{algo_col}{pp_col}{shard_col}{ov_col}"
+                f"{algo_col}{pp_col}{shard_col}{ov_col}{moe_col}"
                 "score_bytes_per_sec\n")
 
     # -- encoding ------------------------------------------------------------
 
     def _encode(self, fusion_bytes, cycle_ms, pack_mt_bytes,
                 cache_capacity, wire_pair=None, algorithm=None,
-                pp_pair=None, shard_layout=None, overlap_bucket=None):
+                pp_pair=None, shard_layout=None, overlap_bucket=None,
+                moe_pair=None):
         x0 = (np.log2(max(fusion_bytes, 1)) - _FUSION_LO) / \
             (_FUSION_HI - _FUSION_LO)
         x1 = (np.log2(max(cycle_ms, 2 ** _CYCLE_LO)) - _CYCLE_LO) / \
@@ -240,6 +268,23 @@ class ParameterManager:
             oi = min(range(len(OVERLAP_BUCKET_CHOICES)),
                      key=lambda j: abs(OVERLAP_BUCKET_CHOICES[j] - b))
             xs.append((oi + 0.5) / len(OVERLAP_BUCKET_CHOICES))
+        if self.tune_moe:
+            # tenth dimension: the (ep, capacity factor) pair over
+            # the MOE_CHOICES enumeration; an incumbent off the grid
+            # (hand-set knobs) seeds the nearest bin of its ep degree
+            # so its score stays in its own fan-out neighborhood
+            ep, cf = moe_pair or (0, 0.0)
+            ep = int(ep or 1)
+            cf = float(cf or 1.25)
+            try:
+                mi = MOE_CHOICES.index((ep, cf))
+            except ValueError:
+                cands = [i for i, (e2, _) in enumerate(MOE_CHOICES)
+                         if e2 == ep] or list(range(len(MOE_CHOICES)))
+                mi = min(cands, key=lambda i: (
+                    abs(MOE_CHOICES[i][0] - ep),
+                    abs(MOE_CHOICES[i][1] - cf)))
+            xs.append((mi + 0.5) / len(MOE_CHOICES))
         return np.clip(xs, 0.0, 1.0)
 
     def _decode(self, x):
@@ -273,6 +318,11 @@ class ParameterManager:
             oi = min(int(x[i] * len(OVERLAP_BUCKET_CHOICES)),
                      len(OVERLAP_BUCKET_CHOICES) - 1)
             out.append(OVERLAP_BUCKET_CHOICES[oi])
+            i += 1
+        if self.tune_moe:
+            mi = min(int(x[i] * len(MOE_CHOICES)),
+                     len(MOE_CHOICES) - 1)
+            out.append(MOE_CHOICES[mi])
         return tuple(out)
 
     # -- recording (engine hot path) ----------------------------------------
@@ -315,7 +365,7 @@ class ParameterManager:
         decoded = self._decode(self._best)
         fusion, cycle, _, _ = decoded[:4]
         i = 4
-        wire = algo = pipeline = shard = overlap = ""
+        wire = algo = pipeline = shard = overlap = experts = ""
         if self.tune_wire:
             wire = wire_pair_label(*decoded[i])
             i += 1
@@ -330,6 +380,9 @@ class ParameterManager:
             i += 1
         if self.tune_overlap:
             overlap = str(decoded[i])
+            i += 1
+        if self.tune_moe:
+            experts = moe_label(*decoded[i])
         best = reg.gauge(
             telemetry.AUTOTUNE_BEST_CONFIG_FAMILY,
             telemetry.AUTOTUNE_BEST_CONFIG_HELP,
@@ -342,7 +395,8 @@ class ParameterManager:
                     cycle_time_ms=f"{cycle:.3f}", wire=wire,
                     algorithm=algo, pipeline=pipeline,
                     shard_layout=shard,
-                    overlap_bucket=overlap).set(1)
+                    overlap_bucket=overlap,
+                    experts=experts).set(1)
 
     def _finish_sample(self):
         elapsed = max(time.monotonic() - self._t0, 1e-6)
@@ -353,6 +407,7 @@ class ParameterManager:
             fusion, cycle, pack_mt, cache = decoded[:4]
             i = 4
             wire_col = algo_col = pp_col = shard_col = ov_col = ""
+            moe_col = ""
             if self.tune_wire:
                 wire_col = f"{wire_pair_label(*decoded[i])},"
                 i += 1
@@ -367,10 +422,13 @@ class ParameterManager:
                 i += 1
             if self.tune_overlap:
                 ov_col = f"{decoded[i]},"
+                i += 1
+            if self.tune_moe:
+                moe_col = f"{moe_label(*decoded[i])},"
             self._log.write(
                 f"{self._samples},{fusion},{cycle:.3f},{pack_mt},"
                 f"{cache},{wire_col}{algo_col}{pp_col}{shard_col}"
-                f"{ov_col}{score:.1f}\n")
+                f"{ov_col}{moe_col}{score:.1f}\n")
             self._log.flush()
         if self._samples > self.warmup_samples:
             self._bo.observe(self._current, score)
@@ -439,6 +497,15 @@ class ParameterManager:
             # effect at the NEXT step's first bucket — one step can
             # never split across bucket layouts
             self.config.overlap_bucket_bytes = int(decoded[i])
+            i += 1
+        if self.tune_moe:
+            # one categorical: ep and capacity factor flip together;
+            # the MoE layer latches the pair at its next step start
+            # (snapping ep to a divisor of the set size), so the
+            # running step's routing geometry never splits
+            ep, cf = decoded[i]
+            self.config.moe_ep = int(ep)
+            self.config.moe_capacity_factor = float(cf)
 
     def best_parameters(self):
         return self._decode(self._best)
@@ -486,6 +553,11 @@ class ParameterManager:
             i += 1
         if self.tune_overlap:
             entry["overlap_bucket_bytes"] = int(decoded[i])
+            i += 1
+        if self.tune_moe:
+            ep, cf = decoded[i]
+            entry["moe_ep"] = int(ep)
+            entry["moe_capacity_factor"] = float(cf)
         return entry
 
     def _load_cache(self):
@@ -509,7 +581,9 @@ class ParameterManager:
             entry.get("algorithm"),
             (entry.get("pp_schedule"), entry.get("pp_n_micro", 0)),
             entry.get("shard_layout"),
-            entry.get("overlap_bucket_bytes"))
+            entry.get("overlap_bucket_bytes"),
+            (entry.get("moe_ep", 0),
+             entry.get("moe_capacity_factor", 0.0)))
         # start the sweep AT the cached optimum: it becomes both the
         # applied config and the BO's incumbent, so early suggestions
         # explore around it instead of from scratch

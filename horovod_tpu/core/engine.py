@@ -205,6 +205,7 @@ class Engine:
                                           "sharded_optimizer", False)),
                 tune_overlap=bool(getattr(self.config,
                                           "overlap_autotune", False)),
+                tune_moe=getattr(self.config, "moe_experts", 0) > 0,
                 cache_path=getattr(self.config, "autotune_cache", None),
                 topo_fp=topo_fp, world_size=self.global_size)
         #: first-fusion-bucket signature noted exactly once per
@@ -216,6 +217,13 @@ class Engine:
 
         self._stall_warned = set()
         self._algo_warned = set()
+        #: alltoall error-feedback residuals, keyed (ps.id, rank):
+        #: the quantization error of the last exchange's padded
+        #: per-peer-slot layout, re-injected slot-by-slot into the
+        #: next exchange with the same layout.  Cleared on quarantine
+        #: (a residual from the corrupted step must not seed the
+        #: replay) and dropped whenever the layout changes.
+        self._a2a_ef = {}
         # local-mode trace ids (store mode uses coordinator-minted
         # ones); offset by the rank window so per-process single-mode
         # traces merged offline never collide
@@ -321,6 +329,26 @@ class Engine:
         self._m_fused_ag = m.counter(
             "horovod_fused_allgather_runs_total",
             "Fused allgather buckets executed")
+        # fused quantized alltoall (the MoE dispatch/combine wire):
+        # byte families split by destination hop x wire, plus the
+        # per-path runs counter — pre-declared so a scrape always
+        # shows them; ops/compiled.py bumps the same names through
+        # the telemetry helpers
+        self._m_a2a_logical = m.counter(
+            telemetry.ALLTOALL_LOGICAL_BYTES_FAMILY,
+            telemetry.ALLTOALL_LOGICAL_BYTES_HELP,
+            labelnames=telemetry.ALLTOALL_LOGICAL_BYTES_LABELS)
+        self._m_a2a_wire = m.counter(
+            telemetry.ALLTOALL_WIRE_BYTES_FAMILY,
+            telemetry.ALLTOALL_WIRE_BYTES_HELP,
+            labelnames=telemetry.ALLTOALL_WIRE_BYTES_LABELS)
+        self._m_a2a_runs = m.counter(
+            telemetry.ALLTOALL_RUNS_FAMILY,
+            telemetry.ALLTOALL_RUNS_HELP,
+            labelnames=telemetry.ALLTOALL_RUNS_LABELS)
+        m.counter(telemetry.ALLTOALL_EXPOSED_SECONDS_FAMILY,
+                  telemetry.ALLTOALL_EXPOSED_SECONDS_HELP,
+                  labelnames=telemetry.ALLTOALL_EXPOSED_SECONDS_LABELS)
         # weight-update sharding (core/sharded.py): the runs counter
         # is bumped by the updaters, the state gauge by the frontends
         # after they build their shard state — pre-declared here so a
@@ -896,10 +924,21 @@ class Engine:
                 # cross-rank wire check loudly instead of executing
                 # different collective programs against each other
                 req.wire_dtype = entry.wire_default
+            if (req.wire_dtype is None and entry.wire_default
+                    and req.request_type == RequestType.ALLTOALL):
+                # alltoall has no reduce_op, so it gets its own latch
+                # branch: the exchange moves raw payloads (no
+                # accumulation to commute with), so ANY float payload
+                # may ride the process-wide wire default — the MoE
+                # dispatch/combine wire follows the reduction wire
+                # without per-call plumbing
+                req.wire_dtype = entry.wire_default
             if (req.wire_inner is None and entry.wire_inner_default
-                    and req.request_type == RequestType.ALLREDUCE
-                    and req.reduce_op in (ReduceOp.SUM,
-                                          ReduceOp.AVERAGE)):
+                    and req.request_type in (RequestType.ALLREDUCE,
+                                             RequestType.ALLTOALL)
+                    and (req.request_type == RequestType.ALLTOALL
+                         or req.reduce_op in (ReduceOp.SUM,
+                                              ReduceOp.AVERAGE))):
                 # same latch for the inner-hop wire: the per-hop pair
                 # is tuned as ONE categorical (core/autotune.py), so
                 # both halves resolve at the same instant
@@ -1851,6 +1890,18 @@ class Engine:
                 # threshold accounts the OUTPUT (gathered) size, like
                 # the reference's fused-buffer accounting
                 nbytes = sum(p.nbytes for p in first.payloads) * ps.size
+            elif rt == RequestType.ALLTOALL:
+                # alltoall is its own bucket type, segregated by wire
+                # pair: consecutive exchanges with one (dtype, wire)
+                # merge their per-destination segments into ONE fused
+                # exchange (the MoE dispatch+combine pair of one layer
+                # stack), and a quantized exchange never shares a
+                # buffer with a full-width one
+                sig = (rt, first.request.dtype,
+                       first.request.wire_dtype,
+                       first.request.wire_inner,
+                       first.request.error_feedback)
+                nbytes = sum(p.nbytes for p in first.payloads)
             else:
                 if cur:
                     buckets.append(cur)
@@ -1901,7 +1952,7 @@ class Engine:
             elif rt == RequestType.BROADCAST:
                 self._run_broadcast(ps, bucket[0])
             elif rt == RequestType.ALLTOALL:
-                self._run_alltoall(ps, bucket[0], aux=aux)
+                self._run_alltoall(ps, bucket, aux=aux)
             elif rt == RequestType.REDUCESCATTER:
                 self._run_reducescatter(ps, bucket[0])
             elif rt == RequestType.BARRIER:
@@ -2140,6 +2191,10 @@ class Engine:
             reset_ef_state()
         except Exception:  # noqa: BLE001 — hygiene must not mask detection
             logger.exception("integrity: compiled EF reset failed")
+        # alltoall EF residuals are engine-held (per peer-slot): same
+        # rule — a residual mutated by the quarantined exchange must
+        # not seed the replay
+        self._a2a_ef.clear()
         # engine-path EF residuals live on the frontends' updaters
         # (torch/TF DistributedOptimizer, the sharded updaters), which
         # the in-place rollback never re-creates: a residual mutated
@@ -2158,6 +2213,22 @@ class Engine:
         (bf16 wire for an already-16-bit tensor)."""
         if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
             return None
+        if not (np.issubdtype(dtype, np.floating)
+                or str(dtype) == "bfloat16"):
+            return None
+        wire = req.wire_dtype
+        if wire == "f32":
+            return None
+        if wire in ("fp16", "bf16") and dtype.itemsize <= 2:
+            return None
+        return wire
+
+    def _wire_for_alltoall(self, req, dtype):
+        """Effective wire format for an alltoall exchange.  Unlike the
+        reductions there is no accumulation to commute with — the
+        exchange moves raw segments — so ANY float payload may ride a
+        narrow wire; non-float payloads and no-op "compressions"
+        (16-bit wire for an already-16-bit tensor) ship full width."""
         if not (np.issubdtype(dtype, np.floating)
                 or str(dtype) == "bfloat16"):
             return None
@@ -2569,17 +2640,222 @@ class Engine:
                 splits_by_rank[r] = a["splits"][local_i]
         return [list(splits_by_rank[r]) for r in ps.ranks]
 
-    def _run_alltoall(self, ps, entry, aux=None):
-        subs = self._local_subs(ps, entry)
-        first = next(iter(subs.values()))
-        rest = tuple(first.payloads[0].shape[1:])
-        splits = self._global_splits(ps, entry, aux)
-        # exact concat buffers; the executor picks the wire layout
-        # (one-shot padded vs skew-aware diagonal schedule)
-        rows = [np.ravel(subs[r].payloads[0]) for r in subs]
-        results, recv_splits = ps.executor.alltoall(rows, splits, rest)
-        for (r, sub), res, rsp in zip(subs.items(), results, recv_splits):
-            sub.handle.set_result(res, extra=np.array(rsp, dtype=np.int32))
+    def _run_alltoall(self, ps, bucket, aux=None):
+        """Fused wire-quantized alltoall bucket (the MoE dispatch/
+        combine wire).  All entries of the bucket share one (dtype,
+        wire pair) signature — their per-destination segments merge
+        into ONE exchange, so a layer stack's dispatch+combine pair
+        costs one collective.  The int8/int4 wire pads every
+        (rank, destination) slot to a BLOCK multiple so each slot
+        owns whole scale blocks: the receiver decodes each peer slot
+        against exactly the scales that peer encoded with, error
+        feedback accumulates per peer slot, and the encode/decode
+        digests (BucketWatch) cover every slot — a corrupted expert
+        route is silent by construction, so the alltoall wire gets
+        the same digest + implicated-rank-vote integrity as the
+        reduction wires."""
+        from ..ops import quantize as qz
+
+        R = ps.size
+        entries = []
+        for e in bucket:
+            subs_e = self._local_subs(ps, e)
+            first_e = next(iter(subs_e.values()))
+            rest_e = tuple(first_e.payloads[0].shape[1:])
+            rest_n = int(np.prod(rest_e, dtype=np.int64)) if rest_e else 1
+            splits_e = self._global_splits(ps, e, aux)
+            entries.append((e, subs_e, first_e, rest_e, rest_n, splits_e))
+        subs0 = entries[0][1]
+        req = entries[0][2].request
+        local_ranks = list(subs0)
+        pdtype = entries[0][2].payloads[0].dtype
+        itemsize = np.dtype(pdtype).itemsize
+        # combined element-split matrix over global positions:
+        # comb[src][dst] = elements src sends dst across the bucket
+        comb = [[sum(sp[src][dst] * rn
+                     for (_, _, _, _, rn, sp) in entries)
+                 for dst in range(R)] for src in range(R)]
+        # one exact concat-per-destination stream per local rank
+        rows = []
+        for r in local_ranks:
+            p = ps.index[r]
+            parts = []
+            for dst in range(R):
+                for (_, subs_e, _, _, rn, sp) in entries:
+                    flat = np.ravel(subs_e[r].payloads[0])
+                    start = sum(sp[p][:dst]) * rn
+                    parts.append(flat[start:start + sp[p][dst] * rn])
+            rows.append(np.concatenate(parts) if parts
+                        else np.zeros(0, dtype=pdtype))
+        wire = self._wire_for_alltoall(req, np.dtype(pdtype)) \
+            if R > 1 else None
+        seg = max((comb[s][d] for s in range(R) for d in range(R)),
+                  default=0)
+        if wire in ("int8", "int4") and seg == 0:
+            wire = None
+        hop = "cross" if self._spans_hosts(ps) else "inner"
+        rank0 = local_ranks[0]
+        pos0 = ps.index[rank0]
+        topo = self.topology
+        host0 = topo.host_of_rank[rank0] \
+            if topo is not None and topo.host_of_rank else None
+
+        def hop_of(dst):
+            if host0 is None:
+                return "inner"
+            g = ps.ranks[dst]
+            if g >= len(topo.host_of_rank):
+                return "inner"
+            return "cross" if topo.host_of_rank[g] != host0 else "inner"
+
+        def account(wire_seg_bytes):
+            """Split rank0's exchange bytes by destination hop; a
+            callable maps a destination's element count to its wire
+            bytes (None = one fixed padded slot cost per peer)."""
+            by_hop = {}
+            for dst in range(R):
+                h = hop_of(dst)
+                lg, ac = by_hop.get(h, (0, 0))
+                by_hop[h] = (lg + comb[pos0][dst] * itemsize,
+                             ac + wire_seg_bytes(comb[pos0][dst]))
+            for h, (lg, ac) in by_hop.items():
+                self._m_a2a_logical.labels(hop=h,
+                                           wire=wire or "f32").inc(lg)
+                self._m_a2a_wire.labels(hop=h,
+                                        wire=wire or "f32").inc(ac)
+                self._account_hop(h, wire, ac)
+            tot_l = sum(v[0] for v in by_hop.values())
+            tot_a = sum(v[1] for v in by_hop.values())
+            self._account_wire(tot_l, tot_a, wire=wire)
+
+        ictx = None
+        if self.integrity is not None:
+            ictx = integrity_mod.BucketWatch(f"{req.tensor_name}/a2a")
+            ictx.watch("engine", hop, None, rows, local_ranks)
+        if self.chaos is not None:
+            self.chaos.corrupt_bucket("grad", rows)
+        if wire in ("int8", "int4"):
+            # pad every (rank, dest) slot to a whole number of scale
+            # blocks: slot boundaries align with the block grid, so
+            # the receiver decodes each peer slot against exactly
+            # that peer's scales and EF stays per-slot
+            seg_pad = -(-seg // qz.BLOCK) * qz.BLOCK
+            nbseg = seg_pad // qz.BLOCK
+            encode = qz.np_quantize_blockwise_int4 if wire == "int4" \
+                else qz.np_quantize_blockwise
+            decode = qz.np_dequantize_blockwise_int4 if wire == "int4" \
+                else qz.np_dequantize_blockwise
+            q_rows, s_rows = [], []
+            with profiler.annotate("hvd_a2a_quantize_encode"):
+                for i, r in enumerate(local_ranks):
+                    p = ps.index[r]
+                    padded = np.zeros(R * seg_pad, np.float32)
+                    flat32 = rows[i].astype(np.float32)
+                    off = 0
+                    for dst in range(R):
+                        ln = comb[p][dst]
+                        padded[dst * seg_pad:dst * seg_pad + ln] = \
+                            flat32[off:off + ln]
+                        off += ln
+                    key = (ps.id, r)
+                    if not req.error_feedback:
+                        # stateless encode (bit-exact-replay mode):
+                        # no residual injected, none carried — and a
+                        # residual left by an earlier EF-on exchange
+                        # must not leak into a later EF-on one across
+                        # this stateless step
+                        self._a2a_ef.pop(key, None)
+                        q, s, _ = encode(padded)
+                        q_rows.append(q)
+                        s_rows.append(s)
+                        continue
+                    prev = self._a2a_ef.get(key)
+                    if prev is not None and prev.shape == padded.shape:
+                        # per peer-slot error feedback: only positions
+                        # inside the slot's CURRENT segment re-inject
+                        # (residual under stale padding stays inert)
+                        for dst in range(R):
+                            ln = comb[p][dst]
+                            sl = slice(dst * seg_pad,
+                                       dst * seg_pad + ln)
+                            padded[sl] += prev[sl]
+                    elif prev is not None:
+                        # layout changed (splits / world resize):
+                        # stale residuals never cross layouts
+                        del self._a2a_ef[key]
+                    q, s, _ = encode(padded)
+                    self._a2a_ef[key] = padded - decode(
+                        q, s, R * seg_pad)
+                    q_rows.append(q)
+                    s_rows.append(s)
+            q_seg = q_rows[0].size // R
+            s_seg = nbseg
+            account(lambda _n, _q=q_rows[0], _s=s_rows[0]:
+                    _q.nbytes // R + _s.nbytes // R)
+            self._m_quantized.inc()
+            if ictx is not None:
+                ictx.watch("engine", hop, wire,
+                           list(zip(q_rows, s_rows)), local_ranks)
+            if self.chaos is not None:
+                self.chaos.corrupt_bucket("wire", q_rows + s_rows)
+            eq_q = [[q_seg] * R for _ in range(R)]
+            eq_s = [[s_seg] * R for _ in range(R)]
+            q_res, _ = ps.executor.alltoall(q_rows, eq_q, ())
+            s_res, _ = ps.executor.alltoall(s_rows, eq_s, ())
+            flat_recv = []
+            for i, r in enumerate(local_ranks):
+                p = ps.index[r]
+                full = decode(np.asarray(q_res[i]),
+                              np.asarray(s_res[i]), R * seg_pad)
+                flat_recv.append(np.concatenate(
+                    [full[src * seg_pad:src * seg_pad + comb[src][p]]
+                     for src in range(R)]) if R else full)
+        elif wire in ("fp16", "bf16"):
+            wdt = np.dtype(np.float16) if wire == "fp16" \
+                else _bfloat16_dtype()
+            wrows = [row.astype(wdt) for row in rows]
+            account(lambda n: n * 2)
+            if ictx is not None:
+                ictx.watch("engine", hop, wire, wrows, local_ranks)
+            if self.chaos is not None:
+                self.chaos.corrupt_bucket("wire", wrows)
+            results, _ = ps.executor.alltoall(
+                wrows, [list(c) for c in comb], ())
+            flat_recv = [np.asarray(res) for res in results]
+        else:
+            account(lambda n: n * itemsize)
+            if self.chaos is not None:
+                self.chaos.corrupt_bucket("wire", rows)
+            results, _ = ps.executor.alltoall(
+                rows, [list(c) for c in comb], ())
+            flat_recv = [np.asarray(res) for res in results]
+        self._m_a2a_runs.labels(path="engine",
+                                wire=wire or "f32").inc()
+        if ictx is not None:
+            # decode-site scan + ONE gate (and vote) per bucket, after
+            # the exchange, so peers never desync on a mid-bucket raise
+            self._integrity_gate(ps, *ictx.scan())
+        # de-interleave the received stream back into per-entry
+        # outputs: per source, the bucket's segments arrive in entry
+        # order (the same order the send side concatenated them)
+        for i, r in enumerate(local_ranks):
+            p = ps.index[r]
+            buf = flat_recv[i]
+            per_entry = {id(e): [] for (e, *_rest) in entries}
+            off = 0
+            for src in range(R):
+                for (e, _, _, _, rn, sp) in entries:
+                    ln = sp[src][p] * rn
+                    per_entry[id(e)].append(buf[off:off + ln])
+                    off += ln
+            for (e, subs_e, _, rest_e, rn, sp) in entries:
+                parts = per_entry[id(e)]
+                out = np.concatenate(parts) if parts else \
+                    np.zeros(0, dtype=pdtype)
+                out = out.astype(pdtype).reshape((-1,) + rest_e)
+                rsp = np.array([sp[src][p] for src in range(R)],
+                               dtype=np.int32)
+                subs_e[r].handle.set_result(out, extra=rsp)
 
     def _run_reducescatter(self, ps, entry):
         """Reducescatter; grouped submissions carry several payloads

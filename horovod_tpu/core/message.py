@@ -93,6 +93,15 @@ class Request:
     # quantized formats are never legal here (ops/quantize.py
     # INNER_WIRE_CHOICES).  Cross-rank validated like wire_dtype.
     wire_inner: Optional[str] = None
+    # error feedback for a quantized alltoall wire: the sender folds
+    # each peer slot's quantization residual into that slot's NEXT
+    # exchange.  Default on (it converges the dispatch wire), but the
+    # residual is engine-local state that a step quarantine clears,
+    # so bit-exact-replay consumers (the integrity drills) turn it
+    # off per request.  Only decodes the SENDER's own payload, so no
+    # cross-rank validation is needed — but it does segregate fusion
+    # buckets (one fused exchange has one EF policy).
+    error_feedback: bool = True
     # reduction algorithm for THIS collective: None (= process-wide
     # default) | 'flat' | 'hierarchical' | 'torus'
     # (common/topology.py).  Cross-rank validated like wire_dtype —
@@ -138,6 +147,7 @@ class Request:
             if self.group_shapes is not None else None,
             "w": self.wire_dtype,
             "wi": self.wire_inner,
+            "ef": self.error_feedback,
             "alg": self.algorithm,
             "pp": self.pp_sched,
             "sfp": self.shard_fp,
@@ -162,6 +172,7 @@ class Request:
             if d.get("gs") is not None else None,
             wire_dtype=d.get("w"),
             wire_inner=d.get("wi"),
+            error_feedback=d.get("ef", True),
             algorithm=d.get("alg"),
             pp_sched=d.get("pp"),
             shard_fp=d.get("sfp"),

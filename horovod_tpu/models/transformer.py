@@ -42,6 +42,12 @@ class TransformerConfig:
     max_seq_len: int = 2048
     num_experts: int = 0      # 0 => dense MLP
     expert_top_k: int = 2
+    moe_capacity_factor: float = 0.0   # > 0 => fixed-capacity routing
+    # (parallel/moe.py): capacity = ceil(cf * tokens * topk / E),
+    # deterministic drop/pad, O(topk) expert FLOPs per token and the
+    # equal-splits slot layout the quantized alltoall wire exchanges;
+    # 0 keeps the legacy dense one-hot dispatch (every expert sees
+    # every token — O(E) FLOPs, no drops, no wire)
     n_kv_heads: Optional[int] = None   # GQA/MQA: kv heads < n_heads
     # (None => n_heads, i.e. standard multi-head attention); each kv
     # head serves n_heads/n_kv_heads query heads and the decode cache
@@ -285,6 +291,31 @@ class MoE(nn.Module):
                            (E, M, F), jnp.float32).astype(cfg.dtype)
         wo = self.param("wo", nn.initializers.lecun_normal(),
                         (E, F, M), jnp.float32).astype(cfg.dtype)
+
+        if cfg.moe_capacity_factor > 0:
+            # fixed-capacity routing (parallel/moe.py): static
+            # (E, C, M) slots, deterministic drop/pad, O(K) expert
+            # FLOPs per token — and the slot layout the quantized
+            # alltoall exchanges when the ep mesh axis is real.
+            # Call-time import: parallel imports models, not the
+            # reverse, and moe.py itself is flax-free
+            from ..parallel import moe as moe_mod
+
+            T = B * S
+            w2, idx2 = moe_mod.top_k_gating(
+                logits.reshape(T, E), K)
+            cap = moe_mod.expert_capacity(
+                T, E, K, cfg.moe_capacity_factor)
+            pos, keep, n_dropped = moe_mod.make_dispatch_plan(
+                idx2, E, cap)
+            slots = moe_mod.moe_dispatch(
+                x.reshape(T, M), idx2, pos, keep, E, cap)
+            gate = nn.silu(jnp.einsum("ecm,emf->ecf", slots, wi_gate))
+            up = jnp.einsum("ecm,emf->ecf", slots, wi_up)
+            ye = jnp.einsum("ecf,efm->ecm", gate * up, wo)
+            y = moe_mod.moe_combine(ye, idx2, pos, keep, w2)
+            self.sow("intermediates", "moe_dropped", n_dropped)
+            return y.reshape(B, S, M).astype(cfg.dtype)
 
         xe = jnp.einsum("bske,bsm->ebsm", dispatch, x)   # route tokens
         gate = nn.silu(jnp.einsum("ebsm,emf->ebsf", xe, wi_gate))

@@ -390,7 +390,8 @@ def broadcast_(tensor, root_rank, name=None, process_set=global_process_set):
 # alltoall
 
 def alltoall_async(tensor, splits=None, name=None,
-                   process_set=global_process_set):
+                   process_set=global_process_set, wire_dtype=None,
+                   wire_inner=None, error_feedback=True):
     arr, kind = util.to_numpy(tensor)
     if arr.ndim == 0:
         raise ValueError("alltoall requires a tensor with at least 1 dim")
@@ -423,17 +424,29 @@ def alltoall_async(tensor, splits=None, name=None,
     req = Request(
         request_type=RequestType.ALLTOALL, tensor_name=name, rank=ctx.rank,
         dtype=normalize_dtype(arr.dtype), shape=tuple(arr.shape),
-        splits=splits_t, process_set_id=_ps_id(process_set))
+        splits=splits_t, process_set_id=_ps_id(process_set),
+        wire_dtype=normalize_wire_dtype(wire_dtype),
+        wire_inner=normalize_inner_wire(wire_inner),
+        error_feedback=bool(error_feedback))
     h = _submit(req, [arr], [name])
     h.kind = kind
     h.returns_splits = True
     return h
 
 
-def alltoall(tensor, splits=None, name=None, process_set=global_process_set):
+def alltoall(tensor, splits=None, name=None, process_set=global_process_set,
+             wire_dtype=None, wire_inner=None, error_feedback=True):
     """Returns (received_tensor, received_splits) (reference
-    torch/mpi_ops.py alltoall returns both when splits are given)."""
-    return synchronize(alltoall_async(tensor, splits, name, process_set))
+    torch/mpi_ops.py alltoall returns both when splits are given).
+    ``wire_dtype`` selects the exchange's wire encoding (int8/int4
+    ship block-scaled codes + bf16 scales — the MoE dispatch wire);
+    None inherits the process-wide default like the reductions.
+    ``error_feedback`` folds each peer slot's quantization residual
+    into that slot's next exchange (off = stateless encode, the
+    bit-exact-replay mode)."""
+    return synchronize(alltoall_async(tensor, splits, name, process_set,
+                                      wire_dtype, wire_inner,
+                                      error_feedback))
 
 
 # ----------------------------------------------------------------------------

@@ -21,6 +21,12 @@ from .schedule import (  # noqa: F401
     SCHEDULES, PP_CHOICES, Instr, Schedule, build_schedule,
     bubble_fraction, normalize_schedule, pp_label, parse_pp_label,
 )
+from .moe import (  # noqa: F401
+    MOE_CHOICES, moe_label, parse_moe_label, snap_ep, expert_capacity,
+    top_k_gating, make_dispatch_plan, straight_through, moe_dispatch,
+    moe_combine, capacity_moe_apply, quantized_all_to_all,
+    dense_flop_matched_ff,
+)
 from .runtime import (  # noqa: F401
     PipelineSpec, LocalPipelineRuntime, MpmdWorker,
     make_mpmd_lm_train_step, stage_meshes_from,

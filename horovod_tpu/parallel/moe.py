@@ -1,0 +1,321 @@
+"""Expert parallelism: capacity-factor token routing over the fused
+quantized alltoall.
+
+models/transformer.py's ``MoE`` routes with dense one-hot einsums —
+every token visits every expert's weights, which is fine at small E
+but carries O(E) FLOPs per token and gives the wire nothing to
+exchange.  This module is the FIXED-CAPACITY formulation (Switch /
+GShard style): tokens are scattered into per-expert slots of a static
+size, overflow is DROPPED deterministically, underflow is zero-padded
+— so the dispatched tensor's shape never depends on the routing and
+the compiled step never recompiles as the router drifts.  The static
+(E, C, M) layout is also exactly what the alltoall wire wants: equal
+splits, so the exchange rides ``CompiledAlltoall`` (host path) or
+:func:`quantized_all_to_all` (in-graph, shard_map over the ``ep``
+mesh axis) with the block-scaled int8/int4 codec fused in.
+
+Determinism contract (tests/test_moe.py): same logits -> same routes,
+same drops.  ``lax.top_k`` breaks ties by lowest index; slot
+priority is token-major (token t's k-th choice outranks token t+1's
+first), so "which token overflows" is a pure function of the logits
+— never of scheduling.
+
+The autotuner's TENTH dimension sweeps (ep, capacity factor) as one
+categorical (:data:`MOE_CHOICES`, core/autotune.py): ep trades
+alltoall fan-out against experts hosted per rank, the capacity factor
+trades dropped tokens against padded exchange bytes — both move the
+same wire, so they sweep together.
+"""
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "MOE_EP_CHOICES", "MOE_CF_CHOICES", "MOE_CHOICES", "moe_label",
+    "parse_moe_label", "snap_ep", "expert_capacity", "top_k_gating",
+    "make_dispatch_plan", "straight_through", "moe_dispatch",
+    "moe_combine", "capacity_moe_apply", "quantized_all_to_all",
+    "dense_flop_matched_ff",
+]
+
+#: expert-parallel degrees the autotuner sweeps (snapped at latch
+#: time to a divisor of the process-set size by :func:`snap_ep`)
+MOE_EP_CHOICES = (1, 2, 4, 8)
+
+#: capacity factors the autotuner sweeps: 1.0 = exact budget (hot
+#: experts drop), 1.5 = 50% headroom (cold experts pad the wire)
+MOE_CF_CHOICES = (1.0, 1.25, 1.5)
+
+#: the autotuner's TENTH dimension: (ep, capacity factor) as ONE
+#: categorical — a legal-pair enumeration like schedule.PP_CHOICES,
+#: swept by core/autotune.py only when the job hosts experts
+MOE_CHOICES = tuple(
+    (ep, cf) for ep in MOE_EP_CHOICES for cf in MOE_CF_CHOICES)
+
+
+def moe_label(ep, cf):
+    """Human/metric spelling of the autotune pair (the ``experts``
+    label on ``horovod_autotune_best_config``)."""
+    return f"ep{int(ep)}xcf{float(cf):g}"
+
+
+def parse_moe_label(label):
+    """Inverse of :func:`moe_label` -> (ep, capacity_factor)."""
+    body = label.strip().lower()
+    if not body.startswith("ep") or "xcf" not in body:
+        raise ValueError(f"not a moe label: {label!r}")
+    ep_s, cf_s = body[2:].split("xcf", 1)
+    return int(ep_s), float(cf_s)
+
+
+def snap_ep(ep, world_size):
+    """Largest divisor of ``world_size`` that is <= max(ep, 1): the
+    sweep may propose any grid degree; the layer latches a legal one
+    (ep must divide the set so every rank hosts the same number of
+    experts — the equal-splits contract of the alltoall wire)."""
+    ep = max(int(ep or 1), 1)
+    world_size = max(int(world_size), 1)
+    best = 1
+    for d in range(1, min(ep, world_size) + 1):
+        if world_size % d == 0:
+            best = d
+    return best
+
+
+def expert_capacity(n_tokens, num_experts, topk, capacity_factor):
+    """Per-expert slot count: ``ceil(cf * tokens * topk / experts)``
+    — the static shape that makes routing drift invisible to XLA."""
+    if num_experts < 1:
+        raise ValueError("num_experts must be >= 1")
+    slots = float(capacity_factor) * int(n_tokens) * int(topk)
+    return max(int(-(-slots // num_experts)), 1)
+
+
+def top_k_gating(logits, topk):
+    """Deterministic top-k router: softmax over ALL experts, take the
+    k largest, renormalize among the selected.
+
+    Returns ``(weights, idx)``, both ``(..., topk)``.  The selection
+    is non-differentiable; gradients reach the router logits only
+    through the selected weights — the straight-through estimator for
+    the discrete choice (the combine applies it, see
+    :func:`moe_combine`)."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    weights, idx = lax.top_k(probs, topk)
+    weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+    return weights, idx
+
+
+def make_dispatch_plan(idx, num_experts, capacity):
+    """Slot assignment for flat routed choices ``idx`` (T, K).
+
+    Returns ``(pos, keep, n_dropped)``: ``pos`` (T, K) int32 is each
+    choice's slot within its expert, ``keep`` (T, K) bool marks the
+    choices that fit under ``capacity``, ``n_dropped`` counts the
+    overflow (the drop-accounting scalar tests and telemetry read).
+    Priority is token-major: flatten (t, k) in t-major order and take
+    a running count per expert — fully deterministic."""
+    T, K = idx.shape
+    flat = idx.reshape(T * K)
+    oh = jax.nn.one_hot(flat, num_experts, dtype=jnp.int32)  # (TK, E)
+    # position of each choice inside its expert's arrival order
+    pos = (jnp.cumsum(oh, axis=0) - 1)
+    pos = jnp.sum(pos * oh, axis=-1)                          # (TK,)
+    keep = pos < capacity
+    n_dropped = jnp.sum(~keep).astype(jnp.int32)
+    return (pos.reshape(T, K).astype(jnp.int32),
+            keep.reshape(T, K), n_dropped)
+
+
+@jax.custom_vjp
+def straight_through(weights, keep):
+    """``weights * keep`` forward; identity-to-``weights`` backward.
+
+    The keep mask is a step function of the routing order —
+    d(keep)/d(weights) is zero a.e., which would starve the router of
+    gradient exactly for the hot experts it most needs to cool.  The
+    straight-through VJP passes the combine cotangent to ``weights``
+    as if every choice had fit."""
+    return weights * keep.astype(weights.dtype)
+
+
+def _st_fwd(weights, keep):
+    return weights * keep.astype(weights.dtype), None
+
+
+def _st_bwd(_res, g):
+    return g, None
+
+
+straight_through.defvjp(_st_fwd, _st_bwd)
+
+
+def moe_dispatch(x, idx, pos, keep, num_experts, capacity):
+    """Scatter tokens ``x`` (T, M) into the static slot tensor
+    ``(E, C, M)``: kept choice (t, k) lands at
+    ``[idx[t,k], pos[t,k]]``; dropped choices vanish; empty slots are
+    zero (the deterministic pad)."""
+    T, M = x.shape
+    K = idx.shape[1]
+    keep_f = keep.reshape(T * K, 1).astype(x.dtype)
+    slot = (idx.reshape(T * K) * capacity
+            + jnp.minimum(pos.reshape(T * K), capacity - 1))
+    out = jnp.zeros((num_experts * capacity, M), dtype=x.dtype)
+    vals = jnp.repeat(x, K, axis=0) * keep_f
+    # kept slots are unique by construction; dropped rows add zeros
+    out = out.at[slot].add(vals)
+    return out.reshape(num_experts, capacity, M)
+
+
+def moe_combine(expert_out, idx, pos, keep, weights):
+    """Gather expert outputs back to token order and mix:
+    ``y[t] = sum_k st(w)[t,k] * out[idx[t,k], pos[t,k]]``.  Dropped
+    choices contribute zero (their residual path carries the token);
+    the router still sees their gradient through
+    :func:`straight_through`."""
+    E, C, M = expert_out.shape
+    T, K = idx.shape
+    flat = expert_out.reshape(E * C, M)
+    slot = (idx.reshape(T * K) * C
+            + jnp.minimum(pos.reshape(T * K), C - 1))
+    gathered = flat[slot].reshape(T, K, M)
+    gathered = gathered * keep.reshape(T, K, 1).astype(flat.dtype)
+    w = straight_through(weights, keep).astype(flat.dtype)
+    return jnp.einsum("tk,tkm->tm", w, gathered)
+
+
+def capacity_moe_apply(x, router_w, wi_gate, wi_up, wo, *, topk,
+                       capacity_factor, axis_name=None, wire=None):
+    """One fixed-capacity MoE FFN: route -> dispatch -> (alltoall)
+    -> SwiGLU experts -> (alltoall) -> combine.
+
+    ``x`` (T, M); ``router_w`` (M, E); expert weights carry a leading
+    E axis (``wi_*`` (E, M, F), ``wo`` (E, F, M) — shard them on the
+    ``ep`` mesh axis).  With ``axis_name`` (inside shard_map over the
+    ep axis) the dispatched slots cross ranks through
+    :func:`quantized_all_to_all` — the wire-quantized exchange — and
+    E is the LOCAL expert count; without it the layer is the
+    single-rank reference.  Returns ``(y, aux)`` where ``aux`` has
+    ``n_dropped`` and ``capacity``."""
+    T, M = x.shape
+    E = router_w.shape[-1]
+    ep = lax.psum(1, axis_name) if axis_name is not None else 1
+    logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    weights, idx = top_k_gating(logits, topk)
+    cap = expert_capacity(T, E * ep, topk, capacity_factor)
+    pos, keep, n_dropped = make_dispatch_plan(idx, E * ep, cap)
+    slots = moe_dispatch(x, idx, pos, keep, E * ep, cap)  # (E*ep,C,M)
+    if axis_name is not None:
+        # (ep, E, C, M) by destination rank -> exchanged: this rank's
+        # E experts receive every rank's C-slot slices
+        ex = quantized_all_to_all(
+            slots.reshape(ep, E * cap * M), axis_name, wire=wire)
+        slots = ex.reshape(ep, E, cap, M).swapaxes(0, 1) \
+            .reshape(E, ep * cap, M)
+    gate = jax.nn.silu(jnp.einsum("ecm,emf->ecf", slots, wi_gate))
+    up = jnp.einsum("ecm,emf->ecf", slots, wi_up)
+    out = jnp.einsum("ecf,efm->ecm", gate * up, wo)
+    if axis_name is not None:
+        back = out.reshape(E, ep, cap, M).swapaxes(0, 1) \
+            .reshape(ep, E * cap * M)
+        out = quantized_all_to_all(back, axis_name, wire=wire) \
+            .reshape(ep * E, cap, M)
+    y = moe_combine(out, idx, pos, keep, weights).astype(x.dtype)
+    return y, {"n_dropped": n_dropped, "capacity": cap}
+
+
+# ---------------------------------------------------------------------------
+# the in-graph quantized exchange
+
+def _a2a_codec(x, wire):
+    """Block-scaled encode of ``x`` (R, n) f32 per destination slot
+    -> (payload, scales); the in-graph twin of ops/quantize.py's
+    numpy codec (BLOCK=256, bf16 scales) and of the fused codec in
+    ops/compiled.CompiledAlltoall."""
+    from ..ops import quantize as qz
+
+    R, n = x.shape
+    B = qz.BLOCK
+    npad = -(-n // B) * B
+    qmax = 7 if wire == "int4" else 127
+    xp = jnp.pad(x, ((0, 0), (0, npad - n)))
+    xb = xp.reshape(R, npad // B, B)
+    absmax = jnp.max(jnp.abs(xb), axis=-1)
+    scales = (absmax / jnp.float32(qmax)).astype(jnp.bfloat16) \
+        .astype(jnp.float32)
+    safe = jnp.where(scales > 0, scales, jnp.float32(1.0))
+    q = jnp.clip(jnp.round(xb / safe[..., None]), -qmax, qmax) \
+        .astype(jnp.int8).reshape(R, npad)
+    if wire == "int4":
+        b = (q.astype(jnp.int16) + 8).astype(jnp.uint8)
+        q = b[:, 0::2] | (b[:, 1::2] << 4)
+    return q, scales
+
+
+def _a2a_decode(q, scales, n, wire):
+    from ..ops import quantize as qz
+
+    B = qz.BLOCK
+    R = q.shape[0]
+    if wire == "int4":
+        lo = (q & 0xF).astype(jnp.int8) - 8
+        hi = (q >> 4).astype(jnp.int8) - 8
+        q = jnp.stack([lo, hi], axis=-1).reshape(R, -1)
+    xb = q.reshape(R, -1, B).astype(jnp.float32) * scales[..., None]
+    return xb.reshape(R, -1)[:, :n]
+
+
+def _qa2a_exchange(x, axis_name, wire):
+    a2a = partial(lax.all_to_all, axis_name=axis_name, split_axis=0,
+                  concat_axis=0, tiled=True)
+    if wire in ("int8", "int4"):
+        xf = x.astype(jnp.float32)
+        q, s = _a2a_codec(xf, wire)
+        return _a2a_decode(a2a(q), a2a(s), x.shape[1], wire) \
+            .astype(x.dtype)
+    if wire in ("fp16", "bf16"):
+        wdt = jnp.float16 if wire == "fp16" else jnp.bfloat16
+        return a2a(x.astype(wdt)).astype(x.dtype)
+    return a2a(x)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def quantized_all_to_all(x, axis_name, wire=None):
+    """``lax.all_to_all`` with the block-scaled wire codec fused in:
+    int8 codes / packed int4 nibbles plus bf16 block scales are what
+    actually cross ``axis_name`` — the in-graph (shard_map) twin of
+    ``CompiledAlltoall``, for MoE layers compiled over an ``ep``
+    mesh axis.
+
+    ``x`` is (R, n) per participant: slot j goes to rank j, slot j of
+    the result came from rank j.  Differentiable: the backward pass
+    is the same exchange of the cotangent (the alltoall permutation
+    is its own transpose) with the codec STRAIGHT-THROUGH — the
+    quantization error is treated as identity in the VJP, the same
+    estimator the reducers' error feedback assumes."""
+    return _qa2a_exchange(x, axis_name, wire)
+
+
+def _qa2a_fwd(x, axis_name, wire):
+    return _qa2a_exchange(x, axis_name, wire), None
+
+
+def _qa2a_bwd(axis_name, wire, _res, g):
+    return (_qa2a_exchange(g, axis_name, wire),)
+
+
+quantized_all_to_all.defvjp(_qa2a_fwd, _qa2a_bwd)
+
+
+def dense_flop_matched_ff(d_ff_expert, topk):
+    """Hidden width of the dense FFN whose per-token FLOPs match a
+    top-k MoE with per-expert hidden ``d_ff_expert``: each token runs
+    ``topk`` experts, so the matched dense width is their sum.  The
+    lm_bench loss-parity gate trains this baseline against the MoE
+    config on identical data (docs/parallelism.md)."""
+    return int(d_ff_expert) * int(topk)

@@ -176,7 +176,7 @@ AUTOTUNE_BEST_CONFIG_HELP = ("Current best autotune configuration "
 AUTOTUNE_BEST_CONFIG_LABELS = ("fusion_threshold_bytes",
                                "cycle_time_ms", "wire", "algorithm",
                                "pipeline", "shard_layout",
-                               "overlap_bucket")
+                               "overlap_bucket", "experts")
 ELASTIC_RESIZE_FAMILY = "horovod_elastic_resize_events_total"
 ELASTIC_RESIZE_HELP = ("Elastic membership changes seen by this "
                        "worker")
@@ -290,6 +290,74 @@ OVERLAP_BUCKETS_HELP = (
     "Bucket-granular collective programs dispatched by the compiled "
     "path (one grouped launch counts 1; a bucketized step counts one "
     "per bucket)")
+
+# -- fused quantized alltoall (docs/parallelism.md "Expert
+#    parallelism"; core/engine.py + ops/compiled.py): the MoE
+#    dispatch/combine wire.  Logical bytes are what the caller's exact
+#    segments would cost at payload width; wire bytes are what the
+#    encoded exchange actually moved (codes + block scales under
+#    int8/int4, block-padded) — the logical/wire quotient is the
+#    compression evidence (int8 ~3.97x).  `hop` classes each byte by
+#    the destination peer's host (inner = same host / ICI, cross =
+#    other host / DCN); `wire` is the exchange's encoding.  The runs
+#    counter ticks once per exchange by path (engine | compiled), and
+#    exposed seconds is the wall time a caller sat blocked on an
+#    in-flight compiled alltoall after its own compute finished.
+
+ALLTOALL_LOGICAL_BYTES_FAMILY = "horovod_alltoall_logical_bytes_total"
+ALLTOALL_LOGICAL_BYTES_HELP = (
+    "Alltoall payload bytes at logical (payload-dtype) width, by the "
+    "destination hop class and the exchange's wire encoding")
+ALLTOALL_LOGICAL_BYTES_LABELS = ("hop", "wire")
+ALLTOALL_WIRE_BYTES_FAMILY = "horovod_alltoall_wire_bytes_total"
+ALLTOALL_WIRE_BYTES_HELP = (
+    "Alltoall bytes actually moved on the wire (encoded codes + "
+    "block scales under int8/int4), by destination hop class and "
+    "wire encoding")
+ALLTOALL_WIRE_BYTES_LABELS = ("hop", "wire")
+ALLTOALL_RUNS_FAMILY = "horovod_alltoall_runs_total"
+ALLTOALL_RUNS_HELP = (
+    "Alltoall exchanges executed, by path (engine | compiled) and "
+    "wire encoding")
+ALLTOALL_RUNS_LABELS = ("path", "wire")
+ALLTOALL_EXPOSED_SECONDS_FAMILY = "horovod_alltoall_exposed_seconds_total"
+ALLTOALL_EXPOSED_SECONDS_HELP = (
+    "Wall seconds callers spent blocked on in-flight alltoall "
+    "programs after their own compute had finished, by path")
+ALLTOALL_EXPOSED_SECONDS_LABELS = ("path",)
+
+
+def account_alltoall_bytes(hop, wire, logical, actual):
+    """Accumulate one alltoall hop's logical and wire bytes, into the
+    process-current registry."""
+    w = wire or "f32"
+    registry().counter(
+        ALLTOALL_LOGICAL_BYTES_FAMILY, ALLTOALL_LOGICAL_BYTES_HELP,
+        labelnames=ALLTOALL_LOGICAL_BYTES_LABELS).labels(
+        hop=hop, wire=w).inc(int(logical))
+    registry().counter(
+        ALLTOALL_WIRE_BYTES_FAMILY, ALLTOALL_WIRE_BYTES_HELP,
+        labelnames=ALLTOALL_WIRE_BYTES_LABELS).labels(
+        hop=hop, wire=w).inc(int(actual))
+
+
+def count_alltoall_run(path, wire):
+    """One alltoall exchange on ``path``, into the process-current
+    registry."""
+    registry().counter(
+        ALLTOALL_RUNS_FAMILY, ALLTOALL_RUNS_HELP,
+        labelnames=ALLTOALL_RUNS_LABELS).labels(
+        path=path, wire=wire or "f32").inc()
+
+
+def add_alltoall_exposed_seconds(path, seconds):
+    """Accumulate exposed alltoall wall seconds (exchange in flight,
+    no local compute left to hide it), into the process-current
+    registry."""
+    registry().counter(
+        ALLTOALL_EXPOSED_SECONDS_FAMILY, ALLTOALL_EXPOSED_SECONDS_HELP,
+        labelnames=ALLTOALL_EXPOSED_SECONDS_LABELS).labels(
+        path=path).inc(seconds)
 
 
 def add_exposed_comm_seconds(path, seconds):

@@ -1531,3 +1531,129 @@ def test_bucketized_relatch_cannot_split_one_step(live_engine):
         return True
 
     assert all(run_ranks(fn))
+
+
+# ---------------------------------------------------------------------------
+# fused quantized alltoall: wire x path x TopologyHint.  The MoE
+# dispatch wire must round-trip within its codec's tolerance on BOTH
+# dispatch paths, under a flat layout and under an explicit dp x tp
+# hint, and the quantized formats must show their honest byte
+# reduction in the alltoall accounting families.
+
+A2A_WIRE_CASES = (
+    [("engine", w, "flat") for w in ("f32", "bf16", "fp16",
+                                     "int8", "int4")]
+    + [("compiled", w, h) for w in ("f32", "bf16", "fp16",
+                                    "int8", "int4")
+       for h in ("flat", "torus")]
+)
+
+
+def _a2a_tol(wire, absmax):
+    if wire == "f32":
+        return 0.0
+    if wire == "bf16":
+        return absmax / 128.0
+    if wire == "fp16":
+        return absmax / 1024.0
+    if wire == "int8":
+        # scale = absmax/127 (bf16-roundtripped), worst case half a
+        # step plus the scale's own bf16 roundoff
+        return absmax / 127.0
+    return absmax / 7.0  # int4: qmax 7
+
+
+@pytest.mark.parametrize("path,wire,hint", A2A_WIRE_CASES,
+                         ids=[f"{p}-{w}-{h}"
+                              for p, w, h in A2A_WIRE_CASES])
+def test_alltoall_wire_matrix(live_engine, path, wire, hint):
+    seg = 512  # whole scale blocks per (rank, dest) slot
+
+    def fn():
+        r = hvd.rank()
+        base = np.linspace(-1.0, 1.0, NP * seg).astype(np.float32)
+        x = base + 0.25 * r
+        if path == "engine":
+            out, _recv = hvd.alltoall(
+                x, wire_dtype=wire, error_feedback=False,
+                name=f"m.a2aw.{wire}")
+        else:
+            th = hvd.TopologyHint(axes=("dp", "tp"), sizes=(2, 2)) \
+                if hint == "torus" else None
+            out = hvd.compiled_alltoall(
+                x, wire_dtype=wire, topology_hint=th,
+                name=f"m.a2aw.{wire}.{hint}")
+        expected = np.concatenate(
+            [base[r * seg:(r + 1) * seg] + 0.25 * p
+             for p in range(NP)])
+        tol = _a2a_tol(wire, float(np.abs(x).max()))
+        err = float(np.abs(np.asarray(out, np.float64)
+                           - expected).max())
+        assert err <= tol + 1e-6, (wire, err, tol)
+        return True
+
+    assert all(run_ranks(fn))
+
+
+@pytest.mark.parametrize("path", ["engine", "compiled"])
+@pytest.mark.parametrize("wire,floor", [("int8", 3.9), ("int4", 7.5)])
+def test_alltoall_quantized_accounting(live_engine, path, wire, floor):
+    """The alltoall byte families must show the codec's true wire
+    reduction — int8 ~3.97x, int4 ~7.88x — on both dispatch paths
+    (the exchange ships codes + scales, never dequantized f32)."""
+    from horovod_tpu import telemetry
+    l0 = telemetry.counter_total(telemetry.ALLTOALL_LOGICAL_BYTES_FAMILY)
+    a0 = telemetry.counter_total(telemetry.ALLTOALL_WIRE_BYTES_FAMILY)
+
+    def fn():
+        x = np.linspace(-1.0, 1.0, NP * 512).astype(np.float32)
+        if path == "engine":
+            hvd.alltoall(x, wire_dtype=wire, name=f"m.a2acct.{wire}")
+        else:
+            hvd.compiled_alltoall(x, wire_dtype=wire,
+                                  name=f"m.a2acct.{wire}")
+        return True
+
+    assert all(run_ranks(fn))
+    dl = telemetry.counter_total(
+        telemetry.ALLTOALL_LOGICAL_BYTES_FAMILY) - l0
+    da = telemetry.counter_total(
+        telemetry.ALLTOALL_WIRE_BYTES_FAMILY) - a0
+    assert dl > 0 and dl / da > floor, (dl, da, dl / da)
+
+
+def test_compiled_alltoall_single_program(live_engine):
+    """The compiled alltoall is ONE cached program per (executor,
+    signature) — steady-state steps are pure cache hits with zero
+    recompiles, across every local rank thread."""
+    from horovod_tpu import telemetry
+
+    def fn():
+        a2a = hvd.CompiledAlltoall(name="m.a2a.single",
+                                   wire_dtype="int8",
+                                   force_program=True)
+        x = np.linspace(-1.0, 1.0, NP * 512).astype(np.float32)
+        a2a(x)                       # warm: compiles the program
+        m0 = telemetry.counter_total(
+            telemetry.PROGRAM_CACHE_MISSES_FAMILY)
+        for _ in range(3):
+            a2a(x)
+        misses = telemetry.counter_total(
+            telemetry.PROGRAM_CACHE_MISSES_FAMILY) - m0
+        return misses, len(a2a._programs)
+
+    for misses, n_prog in run_ranks(fn):
+        assert misses == 0, misses   # zero steady-state recompiles
+        assert n_prog == 1, n_prog
+
+
+def test_alltoall_ragged_rejected_on_compiled_path(live_engine):
+    """Ragged exchanges belong to the negotiated engine path — the
+    compiled program bakes equal splits into its shape signature."""
+    def fn():
+        a2a = hvd.CompiledAlltoall(name="m.a2a.ragged")
+        with pytest.raises(ValueError, match="hvd.alltoall"):
+            a2a(np.ones(NP * 8 + 1, np.float32))
+        return True
+
+    assert all(run_ranks(fn))

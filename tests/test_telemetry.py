@@ -220,7 +220,7 @@ def test_autotune_exports_best_config(hvd_shutdown, monkeypatch):
     assert len(best) == 1       # info-gauge: exactly one current best
     assert set(best[0]["labels"]) == {
         "fusion_threshold_bytes", "cycle_time_ms", "wire", "algorithm",
-        "pipeline", "shard_layout", "overlap_bucket"}
+        "pipeline", "shard_layout", "overlap_bucket", "experts"}
     assert snap["horovod_autotune_best_score_bytes_per_sec"][
         "samples"][0]["value"] > 0
 

@@ -35,12 +35,20 @@ sys.path.insert(0, REPO)
 
 SEED = 20260804
 BATCHES = 12
-#: the seeded corruption schedule: one flip on each kind, on each
-#: process — attribution must name BOTH ranks across the run
+#: the seeded corruption schedule.  Bucket numbering is deterministic
+#: because every op below is synchronous: the elastic state sync
+#: claims one allgather bucket at start and one after every
+#: rollback/restore, then each step runs the quantized alltoall
+#: followed by the allreduce.  With detections at buckets 2, 5 and 7
+#: each inserting a restore allgather, the schedule pins flips to
+#: specific ops: the ALLTOALL wire on BOTH ranks (buckets 2 and 7),
+#: the allreduce payload (5) and the allreduce wire (12) —
+#: attribution must name both ranks across the run
 EVENTS = [
-    {"kind": "bitflip_wire", "proc": 1, "after_buckets": 3},
-    {"kind": "bitflip_grad", "proc": 1, "after_buckets": 6},
-    {"kind": "bitflip_wire", "proc": 0, "after_buckets": 9},
+    {"kind": "bitflip_wire", "proc": 1, "after_buckets": 2},   # a2a x0
+    {"kind": "bitflip_grad", "proc": 1, "after_buckets": 5},   # ar g0 (replay)
+    {"kind": "bitflip_wire", "proc": 0, "after_buckets": 7},   # a2a x0 (replay)
+    {"kind": "bitflip_wire", "proc": 0, "after_buckets": 12},  # ar g1
 ]
 
 
@@ -70,8 +78,24 @@ def worker():
         while state.batch < BATCHES:
             w = np.asarray(state.w, np.float32)
             g = grad(w, state.batch)
-            # the wire under test: one engine-path allreduce per step
-            out = hvd.allreduce(g, op=hvd.Average,
+            # the wires under test: a quantized alltoall (the MoE
+            # dispatch wire) feeding an engine-path allreduce each
+            # step.  The allreduce averages the EXCHANGED segments,
+            # so an alltoall corruption that slipped past the decode
+            # scan would flow into the weights and break the loss
+            # parity asserted below — detection is load-bearing, not
+            # decorative.  int8 round-trip is lossy but seeded-
+            # deterministic, so clean/faulted parity still holds —
+            # with error_feedback OFF: the EF residual is engine-
+            # local state that a step quarantine deliberately clears,
+            # so a replayed step would re-encode without the pre-
+            # fault residual and bit-parity with the never-faulted
+            # run would be unprovable by construction.
+            x, _splits = hvd.alltoall(g, wire_dtype="int8",
+                                      name=f"x{state.batch}",
+                                      error_feedback=False)
+            out = hvd.allreduce(np.ascontiguousarray(x),
+                                op=hvd.Average,
                                 name=f"g{state.batch}")
             state.w = (w - 0.1 * np.asarray(out)).astype(np.float32)
             state.losses = state.losses + [
@@ -188,6 +212,11 @@ def main():
             assert f"global rank {rank}" in stderr, (
                 f"no detection attributed to rank {rank}\n"
                 f"{stderr[-3000:]}")
+        # the alltoall wire is covered: at least one detection names
+        # an alltoall bucket (engine BucketWatch label "<name>/a2a")
+        assert "/a2a" in stderr, (
+            f"no detection landed on the alltoall wire\n"
+            f"{stderr[-3000:]}")
         # loss parity: the corrupted updates were DISCARDED — final
         # params and the full loss sequence match the clean run
         for r in (0, 1):

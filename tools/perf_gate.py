@@ -51,6 +51,14 @@ BENCHES = {
                 "--parallelism", "2,2,1", "--d-model", "64",
                 "--layers", "4", "--overlap-compare", "--iters", "8",
                 "--warmup", "2", "--overlap-bucket-bytes", "524288"],
+    # expert parallelism: capacity-routed MoE vs its dense-FLOP-
+    # matched baseline on identical data (the loss-parity gate), plus
+    # the quantized alltoall wire scrape the expert dispatch rides
+    "moe": ["benchmarks/lm_bench.py", "--cpu", "1", "--moe-experts",
+            "8", "--moe-topk", "2", "--moe-capacity-factor", "1.25",
+            "--d-model", "64", "--layers", "2", "--heads", "4",
+            "--seq", "128", "--batch", "4", "--iters", "12",
+            "--warmup", "2"],
 }
 
 #: The seeded fault plan the matrix ALSO runs under (ISSUE 13: "fast",
@@ -146,6 +154,23 @@ METRICS = {
     "overlap_bitwise_parity": (
         "overlap", lambda d: d["overlap_bitwise_parity"],
         "eq", 0.0, 1.0),
+    # expert parallelism (fused quantized alltoall PR).  The loss gap
+    # vs the dense-FLOP-matched baseline carries the <=1% acceptance
+    # bar as an absolute ceiling; the relative band is wide because
+    # tiny-model losses wobble with bf16 reduction order
+    "moe_loss_gap": (
+        "moe", lambda d: d["moe_loss_gap"], "max", 4.0, 0.01),
+    # fixed-capacity dispatch means static shapes: the timed window
+    # must never re-enter XLA — exact, fault plan included
+    "moe_steady_recompiles": (
+        "moe", lambda d: d["moe_steady_recompiles"],
+        "max", 0.0, 0.0),
+    # the dispatch wire's int8 codec ratio — deterministic byte
+    # accounting scraped from horovod_alltoall_*_bytes_total, same
+    # band and floor as the reduction wire's
+    "moe_alltoall_int8_ratio": (
+        "moe", lambda d: d["moe_alltoall_int8_ratio"],
+        "eq", 0.03, 3.8),
 }
 
 

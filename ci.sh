@@ -192,6 +192,15 @@ case "${1:-all}" in
     # death (worker_alive), and steady-state traffic adds zero
     # compiled-program-cache misses after warm-up
     python tools/serve_smoke.py
+    # continuous-batching leg (docs/serving.md "Continuous
+    # batching"): staggered arrivals join/leave decode slots and every
+    # stream completes on drain token-identical to the unbatched
+    # generate path; the paged-KV steady state adds zero
+    # program-cache misses; the prefill/decode split through the
+    # shared executor is parity-exact on the f32 wire; and a seeded
+    # after_decodes kill drill recovers from the slot journal with
+    # byte-identical evidence across two same-seed runs
+    python tools/continuous_smoke.py
     ;;
   integrity)
     # step-integrity gate (docs/fault_tolerance.md "Silent data
@@ -245,6 +254,11 @@ case "${1:-all}" in
     # serving-tier throughput/latency (batcher + compiled dispatch
     # under closed-loop load) — the docs/benchmarks.md serving row
     python benchmarks/serve_bench.py
+    # continuous-batching decode goodput: closed-loop autoregressive
+    # streams through the slot loop + paged KV cache — tokens/sec/chip
+    # at the reported TTFT/TPOT percentiles, zero cache misses
+    # (the docs/benchmarks.md continuous row)
+    python benchmarks/serve_bench.py --continuous --streams 48
     # pipelined LM training on the 8-device virtual mesh: dp×pp and
     # dp×tp×pp through the MPMD runtime (1f1b + interleaved vs the
     # gpipe fallback) — the docs/benchmarks.md pipeline rows report

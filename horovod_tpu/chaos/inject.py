@@ -89,6 +89,7 @@ class FaultInjector:
         self._requests = 0
         self._collectives = 0
         self._predicts = 0
+        self._decodes = 0
         self._buckets = 0
         self._commits = 0
         self._epoch = time.monotonic()
@@ -104,8 +105,8 @@ class FaultInjector:
         events = plan.worker_events(
             proc, rank_offset, rank_offset + num_local)
         self._by_trigger = {"requests": [], "collectives": [],
-                            "predicts": [], "wall": [],
-                            "buckets": [], "commits": []}
+                            "predicts": [], "decodes": [],
+                            "wall": [], "buckets": [], "commits": []}
         for e in events:
             self._by_trigger[e.trigger].append(
                 _EventState(e, plan.rng_for(e)))
@@ -177,6 +178,25 @@ class FaultInjector:
             due = [st.event for st in self._by_trigger["predicts"]
                    if st.due(n)]
         return self._apply(due, "predicts", n, wire=True)
+
+    def before_decode(self):
+        """Continuous-batcher hook: called before every decode tick
+        (serving/continuous.py) — on its OWN counter so a plan seeded
+        against the predict or fabric-request streams fires
+        identically whether decode traffic exists or not, and decode
+        ticks are deterministic tick counts (not wall time), so two
+        same-seed runs kill the replica at the SAME tick — the
+        byte-identical evidence the decode-kill drill compares.
+        Process kinds (``kill`` / ``exit`` / ``hang``) fire inline;
+        ``("delay", secs)`` stalls the tick."""
+        if self._hang.is_set():
+            self._park()
+        with self._lock:
+            self._decodes += 1
+            n = self._decodes
+            due = [st.event for st in self._by_trigger["decodes"]
+                   if st.due(n)]
+        return self._apply(due, "decodes", n, wire=True)
 
     def on_collectives(self, n_entries=1):
         """Engine background-loop hook: called with the number of

@@ -50,7 +50,9 @@ collective this process reports ready), ``after_predicts`` (the n-th
 predict request this process's serving frontend receives — the
 ingestion path of :mod:`horovod_tpu.serving`, counted on its OWN
 counter so adding serving traffic never perturbs the fabric-request
-stream an existing plan was seeded against), or ``after_s``
+stream an existing plan was seeded against), ``after_decodes`` (the
+n-th decode tick this process's continuous batcher runs —
+serving/continuous.py, again its own counter), or ``after_s``
 (wall-clock offset from injector install) — plus a target (``proc``
 index, or ``rank`` for ``slow_rank``; terminal kinds require an
 explicit target so a sloppy plan cannot kill every process at once).  ``count`` fires
@@ -132,6 +134,12 @@ KINDS = PROCESS_KINDS + WIRE_KINDS + ENGINE_KINDS + COORD_KINDS \
 _TRIGGERS = {"after_requests": "requests",
              "after_collectives": "collectives",
              "after_predicts": "predicts",
+             # the continuous batcher's decode ticks (serving/
+             # continuous.py), own counter for the same reason: a
+             # decode-replica kill drill must never perturb the
+             # fabric-request or predict streams a plan was seeded
+             # against
+             "after_decodes": "decodes",
              "after_s": "wall",
              # integrity kinds count encode/spill sites
              # (core/integrity.py; their OWN counters, so adding

@@ -209,7 +209,8 @@ class ManagedJob:
                 idle_evals=int(slo.get("idle_evals", 6)),
                 idle_frac=float(slo.get("idle_frac", 0.25)),
                 idle_queue=int(slo.get("idle_queue", 1)),
-                cooldown_s=float(slo.get("cooldown_s", 30.0)))
+                cooldown_s=float(slo.get("cooldown_s", 30.0)),
+                slo_ttft_ms=slo.get("ttft_ms"))
 
     @property
     def name(self):
@@ -626,12 +627,20 @@ class FleetController:
                 return 0.0
             return sum(float(s.get("value", 0.0))
                        for s in fam.get("samples", []))
+        total = 0.0
         fam = fams.get(SERVING_REQUESTS_FAMILY)
-        if not fam:
-            return 0.0
-        return sum(float(s.get("value", 0.0))
-                   for s in fam.get("samples", [])
-                   if s.get("labels", {}).get("outcome") == "ok")
+        if fam:
+            total += sum(float(s.get("value", 0.0))
+                         for s in fam.get("samples", [])
+                         if s.get("labels", {}).get("outcome") == "ok")
+        # continuous-batching jobs: each generated token is a goodput
+        # unit (a streaming job may finish few "requests" per window
+        # while emitting thousands of tokens)
+        fam = fams.get(telemetry.SERVING_TOKENS_FAMILY)
+        if fam:
+            total += sum(float(s.get("value", 0.0))
+                         for s in fam.get("samples", []))
+        return total
 
     def _observe_job(self, job):
         """Per-tick observation: goodput deltas into the fleet
@@ -665,12 +674,16 @@ class FleetController:
                 job=job.name).inc(good)
         if job.spec.kind != "serving" or job.policy is None:
             return
-        p99, queue, seen = (None, 0.0, False)
+        p99, queue, seen, ttft = (None, 0.0, False, None)
         if job.signals is not None:
-            p99, queue, seen = job.signals.read(payloads)
+            w = job.signals.read(payloads)
+            p99, queue, seen = w
+            ttft = getattr(w, "ttft_p99_s", None)
         breach = (p99 is not None and
                   p99 > job.policy.slo_p99_s) or \
-            queue > job.policy.queue_high
+            queue > job.policy.queue_high or \
+            (job.policy.slo_ttft_s is not None and ttft is not None
+             and ttft > job.policy.slo_ttft_s)
         if breach:
             self.registry.counter(
                 telemetry.FLEET_SLO_BREACH_FAMILY,
@@ -682,7 +695,8 @@ class FleetController:
         # the policy clock is the reconcile tick (deterministic in
         # tests/smokes): cooldown_s counts tick-seconds
         target = job.policy.decide(p99, queue, max(job.np, 1),
-                                   now=self.tick * self.interval_s)
+                                   now=self.tick * self.interval_s,
+                                   ttft_p99_s=ttft)
         job.demand = max(job.spec.min_np,
                          min(target, job.spec.max_np))
 

@@ -59,7 +59,8 @@ __all__ = [
     "CompiledAlltoall", "CompiledGroupedAllreduce", "CompiledPredict",
     "TopologyHint", "batch_signature", "compiled_allreduce",
     "compiled_alltoall", "compiled_grouped_allreduce",
-    "make_compiled_train_step",
+    "make_compiled_train_step", "program_cache_stats",
+    "shared_program",
 ]
 
 logger = logging.getLogger("horovod_tpu")
@@ -334,6 +335,29 @@ def _shared_program(key, builder):
         else:
             hits.inc()
         return prog
+
+
+def shared_program(key, builder):
+    """Public entry to the process-wide compiled-program cache: returns
+    the cached program for ``key`` or builds it once via ``builder()``
+    (a zero-arg callable returning a jitted function).  Every hit /
+    miss / first-call compile lands in the
+    ``horovod_program_cache_{hits,misses}_total`` and
+    ``horovod_compile_seconds_total`` families, so any subsystem that
+    registers its programs here — the pp chunk programs, the serving
+    tier's paged-KV prefill/decode programs — gets "zero steady-state
+    recompiles" assertable from a scrape.  Keys are namespaced by the
+    caller (include a subsystem tag as the first element)."""
+    return _shared_program(key, builder)
+
+
+def program_cache_stats():
+    """(hits, misses) of the process-wide compiled-program cache as
+    integers — the in-process twin of the Prometheus counters, for
+    callers (tests, the continuous-serving smoke, serve_bench) that
+    assert zero steady-state recompiles without scraping."""
+    hits, misses, _ = _cache_metrics()
+    return int(hits.value()), int(misses.value())
 
 
 def _rendezvous_for(ps, tag, n):

@@ -50,7 +50,6 @@ attributes bubble time by stage.
 """
 
 import logging
-import time
 from dataclasses import dataclass, replace
 
 import numpy as np
@@ -60,6 +59,9 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..common.topology import carve_stage_ranks
+from .executor import (
+    EngineTransport, LMStageExecutor, LocalTransport, StageState,
+)
 from .mesh import AXIS_ORDER, BATCH_AXES
 from .schedule import (
     build_schedule, normalize_schedule, pp_label,
@@ -283,26 +285,12 @@ class LMStagePrograms:
 
 
 # ---------------------------------------------------------------------------
-# shared schedule executor
+# shared schedule executor: parallel/executor.py (ScheduleExecutor /
+# LMStageExecutor / StageState) — both runtimes below and the serving
+# tier's continuous-batching inference pipeline dispatch through it
 
-
-class _StageState:
-    """Mutable per-stage state for one step: stored chunk inputs
-    (keyed (virtual stage, microbatch)), accumulated grads, losses."""
-
-    __slots__ = ("x_in", "acc", "losses")
-
-    def __init__(self):
-        self.x_in = {}
-        self.acc = {}        # virtual stage -> grads pytree (sums)
-        self.losses = []
-
-    def accumulate(self, v, grads):
-        if v not in self.acc:
-            self.acc[v] = grads
-        else:
-            self.acc[v] = jax.tree_util.tree_map(
-                jnp.add, self.acc[v], grads)
+#: back-compat alias (the per-stage step state moved to executor.py)
+_StageState = StageState
 
 
 def _tree_div(tree, denom):
@@ -318,22 +306,6 @@ def _pp_metrics(tag, bubble):
                 ).labels(schedule=tag).inc()
     reg.gauge(telemetry.PP_BUBBLE_FRACTION_FAMILY,
               telemetry.PP_BUBBLE_FRACTION_HELP).set(bubble)
-
-
-def _count_overlap():
-    from .. import telemetry
-
-    telemetry.registry().counter(
-        telemetry.PP_OVERLAP_FAMILY, telemetry.PP_OVERLAP_HELP).inc()
-
-
-def _count_recv_wait(stage, seconds):
-    from .. import telemetry
-
-    telemetry.registry().counter(
-        telemetry.PP_RECV_WAIT_FAMILY, telemetry.PP_RECV_WAIT_HELP,
-        labelnames=telemetry.PP_RECV_WAIT_LABELS
-    ).labels(stage=str(stage)).inc(seconds)
 
 
 # ---------------------------------------------------------------------------
@@ -488,85 +460,21 @@ class LocalPipelineRuntime:
                 return contextlib.nullcontext()
             return tl.span(f"pp.stage{s}", op)
 
+        # one executor per stage, all sharing one transport and one
+        # inbox/gbox pair (the stage hop deposits locally); the
+        # dispatch chain itself lives in parallel/executor.py
+        transport = LocalTransport(self.stage_meshes)
+        execs = [LMStageExecutor(
+            progs=progs_by_stage[s],
+            emb_first=emb0, emb_last=embL, lnf=lnf, layers=lc,
+            mb_tok=(lambda mb, s=s: mb_tok(s, mb)),
+            stage=s, n_stages=S, total_chunks=C,
+            transport=transport,
+            span=(lambda op, s=s: span(s, op)),
+            state=st[s], inbox=inbox, gbox=gbox)
+            for s in range(S)]
         for _tick, s, instr in sobj.events:
-            progs = progs_by_stage[s]
-            v = instr.chunk * S + s
-            mb = instr.mb
-            if instr.op == "fwd":
-                with span(s, "PP_FWD"):
-                    if v == 0 and C == 1:
-                        st[s].x_in[(v, mb)] = None     # bwd_single
-                    elif v == 0:
-                        tok = mb_tok(s, mb)
-                        st[s].x_in[(v, mb)] = tok
-                        y = progs.program("fwd_first",
-                                          (emb0, lc[0], tok))(
-                            emb0, lc[0], tok)
-                        inbox[(v + 1, mb)] = y
-                    elif v == C - 1:
-                        # input recorded; loss+grads come out of the
-                        # backward tick's value_and_grad
-                        st[s].x_in[(v, mb)] = inbox.pop((v, mb))
-                    else:
-                        x = inbox.pop((v, mb))
-                        st[s].x_in[(v, mb)] = x
-                        y = progs.program("fwd_mid", (lc[v], x))(
-                            lc[v], x)
-                        inbox[(v + 1, mb)] = y
-            elif instr.op == "bwd":
-                with span(s, "PP_BWD"):
-                    if C == 1:
-                        tok = mb_tok(s, mb)
-                        loss, (de, dl, dc) = progs.program(
-                            "bwd_single", (emb0, lnf, lc[0], tok))(
-                            emb0, lnf, lc[0], tok)
-                        st[s].losses.append(loss)
-                        st[s].accumulate(0, {"embed": de, "ln_final": dl,
-                                             "layers": dc})
-                        st[s].x_in.pop((v, mb), None)
-                    elif v == C - 1:
-                        x = st[s].x_in.pop((v, mb))
-                        tok = mb_tok(s, mb)
-                        loss, (de, dl, dc, dx) = progs.program(
-                            "bwd_last", (embL, lnf, lc[v], x, tok))(
-                            embL, lnf, lc[v], x, tok)
-                        st[s].losses.append(loss)
-                        st[s].accumulate(v, {"embed": de,
-                                             "ln_final": dl,
-                                             "layers": dc})
-                        gbox[(v - 1, mb)] = dx
-                    elif v == 0:
-                        tok = st[s].x_in.pop((v, mb))
-                        dy = gbox.pop((v, mb))
-                        de, dc = progs.program(
-                            "bwd_first", (emb0, lc[0], tok, dy))(
-                            emb0, lc[0], tok, dy)
-                        st[s].accumulate(0, {"embed": de, "layers": dc})
-                    else:
-                        x = st[s].x_in.pop((v, mb))
-                        dy = gbox.pop((v, mb))
-                        dc, dx = progs.program(
-                            "bwd_mid", (lc[v], x, dy))(lc[v], x, dy)
-                        st[s].accumulate(v, {"layers": dc})
-                        gbox[(v - 1, mb)] = dx
-            elif instr.op in ("send_act", "recv_act"):
-                # one-process substrate: the fwd already deposited the
-                # activation; the send materializes it on the
-                # consumer's stage mesh (the pp hop)
-                if instr.op == "send_act":
-                    key = (v + 1, mb)
-                    dest = self.stage_meshes[instr.peer]
-                    inbox[key] = jax.device_put(
-                        inbox[key],
-                        NamedSharding(dest, P(BATCH_AXES, None, None)))
-            elif instr.op == "send_grad":
-                key = (v - 1, mb)
-                dest = self.stage_meshes[instr.peer]
-                gbox[key] = jax.device_put(
-                    gbox[key],
-                    NamedSharding(dest, P(BATCH_AXES, None, None)))
-            # recv_* and reduce are no-ops here: dp reduction compiles
-            # into the chunk programs (XLA psum from the shardings)
+            execs[s].execute(instr)
 
         # gradient assembly: chunk sums / M, embeds tied across the
         # first and last stages (their grads ADD — one logical weight)
@@ -950,12 +858,7 @@ class MpmdWorker:
             # everything else ships native
             ships_f32 = self.cfg.dtype == jnp.bfloat16
 
-            st = _StageState()
-            inbox = {}
-            gbox = {}
-            pending = []          # async handles to drain at the end
-            reduce_handles = []
-            losses = []
+            st = StageState()
             emb = state.get("embed")
             lnf = state.get("ln_final")
             lc = state["layers"]
@@ -975,131 +878,29 @@ class MpmdWorker:
                 return jnp.asarray(arr, self.cfg.dtype) if ships_f32 \
                     else jnp.asarray(arr)
 
-            def pair_ps(peer):
-                return self.pair_sets[(min(s, peer), max(s, peer), d)]
-
             step_no = self._step_no
-            for instr in stream:
-                v = instr.chunk * S + s
-                mb = instr.mb
-                name = f"pp.{step_no}.{v}.{mb}"
-                if instr.op == "recv_act":
-                    t0 = time.monotonic()
-                    with span("PP_BUBBLE"):
-                        buf = hvd_ops.broadcast(
-                            np.zeros(act_shape, act_dtype),
-                            root_rank=self.stage_ranks[instr.peer][d],
-                            name=f"{name}.act",
-                            process_set=pair_ps(instr.peer))
-                    _count_recv_wait(s, time.monotonic() - t0)
-                    inbox[(v, mb)] = unship(buf)
-                elif instr.op == "send_act":
-                    y = inbox.pop((v + 1, mb))
-                    h = hvd_ops.broadcast_async(
-                        ship(y), root_rank=self.rank,
-                        name=f"pp.{step_no}.{v + 1}.{mb}.act",
-                        process_set=pair_ps(instr.peer))
-                    pending.append(h)
-                elif instr.op == "recv_grad":
-                    t0 = time.monotonic()
-                    with span("PP_BUBBLE"):
-                        buf = hvd_ops.broadcast(
-                            np.zeros(act_shape, act_dtype),
-                            root_rank=self.stage_ranks[instr.peer][d],
-                            name=f"{name}.grad",
-                            process_set=pair_ps(instr.peer))
-                    _count_recv_wait(s, time.monotonic() - t0)
-                    gbox[(v, mb)] = unship(buf)
-                elif instr.op == "send_grad":
-                    dx = gbox.pop((v - 1, mb))
-                    h = hvd_ops.broadcast_async(
-                        ship(dx), root_rank=self.rank,
-                        name=f"pp.{step_no}.{v - 1}.{mb}.grad",
-                        process_set=pair_ps(instr.peer))
-                    pending.append(h)
-                elif instr.op == "fwd":
-                    with span("PP_FWD"):
-                        if C == 1:
-                            st.x_in[(v, mb)] = None
-                        elif v == 0:
-                            tok = jnp.asarray(mb_tokens[mb])
-                            st.x_in[(v, mb)] = tok
-                            y = progs.program("fwd_first",
-                                              (emb, lc[0], tok))(
-                                emb, lc[0], tok)
-                            inbox[(v + 1, mb)] = y
-                        elif v == C - 1:
-                            st.x_in[(v, mb)] = inbox.pop((v, mb))
-                        else:
-                            x = inbox.pop((v, mb))
-                            st.x_in[(v, mb)] = x
-                            y = progs.program("fwd_mid", (lc[v], x))(
-                                lc[v], x)
-                            inbox[(v + 1, mb)] = y
-                elif instr.op == "bwd":
-                    with span("PP_BWD"):
-                        if C == 1:
-                            tok = jnp.asarray(mb_tokens[mb])
-                            loss, (de, dl, dc) = progs.program(
-                                "bwd_single", (emb, lnf, lc[0], tok))(
-                                emb, lnf, lc[0], tok)
-                            losses.append(loss)
-                            st.accumulate(0, {"layers": dc, "embed": de,
-                                              "ln_final": dl})
-                            st.x_in.pop((v, mb), None)
-                        elif v == C - 1:
-                            x = st.x_in.pop((v, mb))
-                            tok = jnp.asarray(mb_tokens[mb])
-                            loss, (de, dl, dc, dx) = progs.program(
-                                "bwd_last", (emb, lnf, lc[v], x, tok))(
-                                emb, lnf, lc[v], x, tok)
-                            losses.append(loss)
-                            st.accumulate(v, {"layers": dc, "embed": de,
-                                              "ln_final": dl})
-                            gbox[(v - 1, mb)] = dx
-                        elif v == 0:
-                            tok = st.x_in.pop((v, mb))
-                            dy = gbox.pop((v, mb))
-                            de, dc = progs.program(
-                                "bwd_first", (emb, lc[0], tok, dy))(
-                                emb, lc[0], tok, dy)
-                            st.accumulate(0, {"layers": dc, "embed": de})
-                        else:
-                            x = st.x_in.pop((v, mb))
-                            dy = gbox.pop((v, mb))
-                            dc, dx = progs.program(
-                                "bwd_mid", (lc[v], x, dy))(lc[v], x, dy)
-                            st.accumulate(v, {"layers": dc})
-                            gbox[(v - 1, mb)] = dx
-                elif instr.op == "reduce":
-                    # the bubble overlap: this chunk's gradients are
-                    # complete — submit their dp allreduce (Average over
-                    # the stage set) through the engine NOW, while the
-                    # remaining backward ticks still run.  Quantized wire
-                    # and topology-aware algorithm apply per the engine's
-                    # process-wide defaults, unchanged.
-                    if self.dp > 1:
-                        v_r = instr.chunk * S + s
-                        g = st.acc[v_r]["layers"]
-                        leaves, _ = jax.tree_util.tree_flatten(g)
-                        rows = [np.asarray(x, np.float32)
-                                for x in leaves]
-                        if self.sharded:
-                            # weight-update sharding: the dp hop is a
-                            # reducescatter — each rank receives only
-                            # its dim0 shard of every layer gradient
-                            hs = hvd_ops.grouped_reducescatter_async(
-                                rows, op=hvd_ops.Average,
-                                name=f"pp.grad.{step_no}.{v_r}",
-                                process_set=self.stage_sets[s],
-                                shard_fp=self._shard_fp)
-                        else:
-                            hs = hvd_ops.grouped_allreduce_async(
-                                rows, op=hvd_ops.Average,
-                                name=f"pp.grad.{step_no}.{v_r}",
-                                process_set=self.stage_sets[s])
-                        reduce_handles.append((v_r, "layers", hs))
-                        _count_overlap()
+            # the hop/reduce semantics (pair-set broadcasts, async
+            # grouped reduces at the bubble ticks) live in the
+            # transport; the dispatch chain in parallel/executor.py —
+            # one executor shared with the local runtime and the
+            # serving tier's inference pipeline
+            transport = EngineTransport(
+                ops=hvd_ops, stage=s, dp_index=d, rank=self.rank,
+                stage_ranks=self.stage_ranks,
+                pair_sets=self.pair_sets, stage_sets=self.stage_sets,
+                act_shape=act_shape, act_dtype=act_dtype,
+                ship=ship, unship=unship, step_no=step_no,
+                dp=self.dp, sharded=self.sharded,
+                shard_fp=self._shard_fp, span=span)
+            ex = LMStageExecutor(
+                progs=progs, emb_first=emb, emb_last=emb, lnf=lnf,
+                layers=lc, mb_tok=lambda mb: jnp.asarray(mb_tokens[mb]),
+                stage=s, n_stages=S, total_chunks=C,
+                transport=transport, span=span, state=st)
+            ex.run(stream)
+            pending = transport.pending
+            reduce_handles = transport.reduce_handles
+            losses = st.losses
 
             # drain: finish overlapped reduces + sends, reduce the embeds
             M_f = float(M)

@@ -35,7 +35,14 @@ from .frontend import (  # noqa: F401
     ServingFrontend, decode_example, encode_example,
 )
 from .autoscale import (  # noqa: F401
-    Autoscaler, AutoscalePolicy, quantile_from_buckets,
+    Autoscaler, AutoscalePolicy, ServingWindow, quantile_from_buckets,
+)
+from .kvcache import (  # noqa: F401
+    BlocksExhausted, KVBlockPool, PagedKVPrograms,
+)
+from .continuous import (  # noqa: F401
+    ContinuousBatcher, PrefillDecodeSplit, SequenceHandle,
+    read_journal,
 )
 
 logger = logging.getLogger("horovod_tpu.serving")
@@ -43,9 +50,11 @@ logger = logging.getLogger("horovod_tpu.serving")
 __all__ = [
     "start", "serve_forever", "ServingHandle", "ServingConfig",
     "ServingReplica", "ServingFrontend", "DynamicBatcher",
-    "DrainingError", "Autoscaler", "AutoscalePolicy",
-    "default_buckets", "quantile_from_buckets", "decode_example",
-    "encode_example",
+    "DrainingError", "Autoscaler", "AutoscalePolicy", "ServingWindow",
+    "ContinuousBatcher", "PrefillDecodeSplit", "SequenceHandle",
+    "read_journal", "KVBlockPool", "PagedKVPrograms",
+    "BlocksExhausted", "default_buckets", "quantile_from_buckets",
+    "decode_example", "encode_example",
 ]
 
 

@@ -12,8 +12,11 @@ The loop every ``interval`` seconds:
    — identical semantics to a job-wide scrape);
 2. extract the SLO signals: **p99** of
    ``horovod_serving_request_seconds`` over the last window (bucket
-   deltas, not lifetime — an SLO is about now) and the **max** queue
-   depth across replicas (``horovod_serving_queue_depth``);
+   deltas, not lifetime — an SLO is about now), the **max** queue
+   depth across replicas (``horovod_serving_queue_depth``), and — for
+   continuous-batching jobs — **TTFT p99**
+   (``horovod_serving_ttft_seconds``) plus the windowed
+   **tokens/sec** rate of ``horovod_serving_tokens_total``;
 3. hand them to :class:`AutoscalePolicy.decide` — consecutive-breach
    hysteresis up, long-idle hysteresis down, cooldown after every
    move;
@@ -33,7 +36,41 @@ import time
 logger = logging.getLogger("horovod_tpu.serving")
 
 __all__ = ["quantile_from_buckets", "AutoscalePolicy", "Autoscaler",
-           "ServingSignals"]
+           "ServingSignals", "ServingWindow"]
+
+
+class ServingWindow(tuple):
+    """One window's SLO signals.  Unpacks as the classic 3-tuple
+    ``(p99_s, queue_depth, seen_serving)`` every existing caller
+    destructures, while carrying the continuous-serving signals as
+    attributes: ``ttft_p99_s`` (windowed p99 of
+    ``horovod_serving_ttft_seconds`` — the latency that matters for
+    autoregressive streams, where request p99 only measures the whole
+    generation) and ``tokens_per_s`` (windowed rate of
+    ``horovod_serving_tokens_total`` — the goodput continuous jobs
+    size on)."""
+
+    def __new__(cls, p99_s, queue_depth, seen_serving,
+                ttft_p99_s=None, tokens_per_s=0.0,
+                seen_continuous=False):
+        self = super().__new__(
+            cls, (p99_s, queue_depth, seen_serving))
+        self.ttft_p99_s = ttft_p99_s
+        self.tokens_per_s = tokens_per_s
+        self.seen_continuous = seen_continuous
+        return self
+
+    @property
+    def p99_s(self):
+        return self[0]
+
+    @property
+    def queue_depth(self):
+        return self[1]
+
+    @property
+    def seen_serving(self):
+        return self[2]
 
 
 def quantile_from_buckets(bounds, counts, q):
@@ -74,8 +111,12 @@ class AutoscalePolicy:
 
     def __init__(self, slo_p99_ms=100.0, queue_high=64,
                  breach_evals=2, idle_evals=6, idle_frac=0.25,
-                 idle_queue=1, cooldown_s=30.0):
+                 idle_queue=1, cooldown_s=30.0, slo_ttft_ms=None):
         self.slo_p99_s = float(slo_p99_ms) / 1000.0
+        #: continuous-serving SLO: p99 time-to-first-token.  None
+        #: disables the signal (request-shaped jobs have no TTFT)
+        self.slo_ttft_s = float(slo_ttft_ms) / 1000.0 \
+            if slo_ttft_ms else None
         self.queue_high = int(queue_high)
         self.breach_evals = int(breach_evals)
         self.idle_evals = int(idle_evals)
@@ -88,8 +129,13 @@ class AutoscalePolicy:
         #: (reason, p99_s, queue) of the most recent decision
         self.last = None
 
-    def decide(self, p99_s, queue_depth, current, now=None):
-        """→ target replica count (== ``current`` for "hold")."""
+    def decide(self, p99_s, queue_depth, current, now=None,
+               ttft_p99_s=None):
+        """→ target replica count (== ``current`` for "hold").
+        ``ttft_p99_s`` joins the breach test when a TTFT SLO is
+        configured — a continuous-serving job whose first tokens are
+        slow needs chips even while its request p99 (whole
+        generations) looks unremarkable."""
         now = time.monotonic() if now is None else now
         if now < self._cooldown_until:
             # windows observed mid-resize are noise (replicas
@@ -100,8 +146,13 @@ class AutoscalePolicy:
             return current
         breach = (p99_s is not None and p99_s > self.slo_p99_s) or \
             queue_depth > self.queue_high
+        ttft_ok = True
+        if self.slo_ttft_s is not None and ttft_p99_s is not None:
+            breach = breach or ttft_p99_s > self.slo_ttft_s
+            ttft_ok = ttft_p99_s < self.slo_ttft_s * self.idle_frac
         idle = (p99_s is None or p99_s < self.slo_p99_s *
-                self.idle_frac) and queue_depth <= self.idle_queue
+                self.idle_frac) and queue_depth <= self.idle_queue \
+            and ttft_ok
         self._breaches = self._breaches + 1 if breach else 0
         self._idles = self._idles + 1 if idle else 0
         if self._breaches >= self.breach_evals:
@@ -129,6 +180,8 @@ class ServingSignals:
 
     LATENCY_FAMILY = "horovod_serving_request_seconds"
     QUEUE_FAMILY = "horovod_serving_queue_depth"
+    TTFT_FAMILY = "horovod_serving_ttft_seconds"
+    TOKENS_FAMILY = "horovod_serving_tokens_total"
 
     def __init__(self, store, staleness_s=15.0):
         self._store_owner = store if hasattr(store, "store") else None
@@ -140,6 +193,9 @@ class ServingSignals:
         #: PER REPLICA: a replica whose snapshot re-enters the merge
         #: must not inject its whole lifetime into one window)
         self._prev_counts = {}
+        self._prev_ttft = {}
+        self._prev_tokens = {}
+        self._rate_ts = None      # launcher monotonic of last read()
         #: per-KV-key (raw bytes, last-changed LAUNCHER monotonic) —
         #: the staleness clock; never compares cross-host wall clocks
         self._seen = {}
@@ -180,48 +236,94 @@ class ServingSignals:
                 continue
         return out
 
+    def _hist_window(self, payloads, family, prev_map):
+        """Windowed bucket deltas for one histogram ``family`` across
+        all fresh payloads.  Deltas are tracked per replica key in
+        ``prev_map`` so a snapshot (re)entering the set only
+        contributes what it observed since its last inclusion — never
+        its whole lifetime in one "window".  → (bounds, window counts
+        or None, seen)."""
+        bounds, window = None, None
+        seen = False
+        for key, fams in payloads.items():
+            fam = fams.get(family)
+            if not fam or fam.get("type") != "histogram":
+                continue
+            seen = True
+            b = fam.get("buckets", [])
+            counts = [0] * (len(b) + 1)
+            for sample in fam.get("samples", []):
+                for i, c in enumerate(sample.get("counts", [])):
+                    if i < len(counts):
+                        counts[i] += c
+            prev = prev_map.get(key)
+            delta = [max(c - p, 0) for c, p in zip(counts, prev)] \
+                if prev is not None and len(prev) == len(counts) \
+                else counts
+            prev_map[key] = counts
+            if bounds is None:
+                bounds, window = b, [0] * len(counts)
+            if list(b) == list(bounds) and len(delta) == len(window):
+                window = [a + d for a, d in zip(window, delta)]
+        return bounds, window, seen
+
+    def _counter_delta(self, payloads, family, prev_map):
+        """Windowed sum-of-deltas for one counter ``family`` across
+        all fresh payloads (per-key prev values, same re-entry rule as
+        :meth:`_hist_window`).  → (delta, seen)."""
+        total = 0.0
+        seen = False
+        for key, fams in payloads.items():
+            fam = fams.get(family)
+            if not fam:
+                continue
+            seen = True
+            value = sum(float(s.get("value", 0.0))
+                        for s in fam.get("samples", []))
+            prev = prev_map.get(key)
+            total += max(value - prev, 0.0) if prev is not None \
+                else 0.0
+            prev_map[key] = value
+        return total, seen
+
     def read(self, payloads=None):
-        """(p99 seconds over the last window or None, max queue depth,
-        any-serving-telemetry-seen) from the replicas' fresh
-        snapshots.  Window deltas are tracked per replica key so a
-        snapshot (re)entering the set only contributes what it
-        observed since its last inclusion — never its whole lifetime
-        in one "window"."""
+        """SLO signals over the last window, as a
+        :class:`ServingWindow` (unpacks as the classic ``(p99_s,
+        queue_depth, seen_serving)``).  Request p99 and queue depth
+        drive request-shaped jobs; ``ttft_p99_s`` and ``tokens_per_s``
+        light up when a continuous batcher is pushing its families.
+        The tokens/sec rate window is the launcher-monotonic time
+        between ``read()`` calls — the first call (no baseline)
+        reports 0.0."""
         payloads = self.fresh_payloads() if payloads is None \
             else payloads
-        p99 = None
-        seen_serving = False
-        bounds, window = None, None
+        now = time.monotonic()
+        bounds, window, seen_serving = self._hist_window(
+            payloads, self.LATENCY_FAMILY, self._prev_counts)
+        p99 = quantile_from_buckets(bounds, window, 0.99) \
+            if window is not None else None
+        tb, tw, seen_ttft = self._hist_window(
+            payloads, self.TTFT_FAMILY, self._prev_ttft)
+        ttft_p99 = quantile_from_buckets(tb, tw, 0.99) \
+            if tw is not None else None
+        tok_delta, seen_tokens = self._counter_delta(
+            payloads, self.TOKENS_FAMILY, self._prev_tokens)
+        tokens_per_s = 0.0
+        if self._rate_ts is not None and now > self._rate_ts:
+            tokens_per_s = tok_delta / (now - self._rate_ts)
+        self._rate_ts = now
         queue = 0.0
-        for key, fams in payloads.items():
-            lat = fams.get(self.LATENCY_FAMILY)
-            if lat and lat.get("type") == "histogram":
-                seen_serving = True
-                b = lat.get("buckets", [])
-                counts = [0] * (len(b) + 1)
-                for sample in lat.get("samples", []):
-                    for i, c in enumerate(sample.get("counts", [])):
-                        if i < len(counts):
-                            counts[i] += c
-                prev = self._prev_counts.get(key)
-                delta = [max(c - p, 0) for c, p in zip(counts, prev)] \
-                    if prev is not None and len(prev) == len(counts) \
-                    else counts
-                self._prev_counts[key] = counts
-                if bounds is None:
-                    bounds, window = b, [0] * len(counts)
-                if list(b) == list(bounds) and \
-                        len(delta) == len(window):
-                    window = [a + d for a, d in zip(window, delta)]
+        for fams in payloads.values():
             qd = fams.get(self.QUEUE_FAMILY)
             if qd:
                 seen_serving = True
                 for sample in qd.get("samples", []):
                     queue = max(queue,
                                 float(sample.get("value", 0.0)))
-        if window is not None:
-            p99 = quantile_from_buckets(bounds, window, 0.99)
-        return p99, queue, seen_serving
+        return ServingWindow(
+            p99, queue, seen_serving or seen_ttft or seen_tokens,
+            ttft_p99_s=ttft_p99, tokens_per_s=tokens_per_s,
+            seen_continuous=seen_ttft or seen_tokens)
 
 
 class Autoscaler:
@@ -291,7 +393,8 @@ class Autoscaler:
     def evaluate(self, now=None):
         """One policy evaluation (the loop body, callable directly in
         tests/smokes).  Returns (p99_s, queue_depth, target)."""
-        p99, queue, seen = self.signals.read()
+        w = self.signals.read()
+        p99, queue, seen = w
         current = self.driver.current_world_size()
         if current <= 0:
             return p99, queue, current      # round not formed yet
@@ -301,7 +404,8 @@ class Autoscaler:
             # of data must never read as "idle" and melt a loaded
             # fleet down to min_np
             return p99, queue, current
-        target = self.policy.decide(p99, queue, current, now=now)
+        target = self.policy.decide(p99, queue, current, now=now,
+                                    ttft_p99_s=w.ttft_p99_s)
         if target != current:
             reason = self.policy.last[0]
             logger.warning(

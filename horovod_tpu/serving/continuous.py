@@ -1,0 +1,581 @@
+"""Continuous-batching autoregressive serving
+(docs/serving.md "Continuous batching").
+
+:class:`ContinuousBatcher` evolves :class:`.batcher.DynamicBatcher`
+from request-shaped to sequence-shaped batching: instead of coalescing
+fixed-shape predicts, per-sequence *decode slots* join and leave the
+running batch at every decode tick.  A sequence is admitted the moment
+a slot and its KV blocks are free (prefill + ingest + first token —
+TTFT is measured to here), decodes one token per tick alongside
+whatever else is in flight, and retires on EOS or its token budget,
+freeing its slot and blocks for the next arrival mid-flight.
+
+Determinism is load-bearing, not best-effort:
+
+* admission is arrival-ordered into the **lowest** free slot,
+* KV blocks come from :class:`.kvcache.KVBlockPool`'s lowest-id-first
+  allocator,
+* every tick decodes the full fixed ``max_slots`` batch (inactive
+  slots masked), through the per-bucket programs in the shared
+  program cache — zero steady-state recompiles,
+* the chaos hook is the **tick counter** (``after_decodes``), not
+  wall time,
+
+so two same-seed runs admit, decode, fault and journal byte-
+identically — the decode-kill drill's evidence.  The slot journal
+(JSONL, flushed per event) carries prompt + emitted tokens; after a
+replica death :func:`read_journal` + :meth:`ContinuousBatcher.resume`
+re-prefill every in-flight sequence from its journaled state (prefill
+over a prefix is cache-identical to having decoded it token by token,
+the parity property the tests pin) and the completed streams are the
+ones the dead replica would have produced.
+
+The prefill/decode split (:class:`PrefillDecodeSplit`) disaggregates
+the two phases onto separate stage meshes: prefill is the throughput
+pipeline, decode the latency path, and the KV blocks hop between them
+on the training fabric's quantized wire codec.  The hop is driven
+through :class:`..parallel.executor.ScheduleExecutor` — the serving
+pipeline is the third consumer of the one instruction-stream executor
+(:class:`InferenceExecutor` + :class:`KVWireTransport`), not a third
+copy of the dispatch loop.
+"""
+
+import json
+import logging
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+import jax
+
+from .. import telemetry
+from ..parallel.executor import ScheduleExecutor
+from ..parallel.schedule import Instr
+from .kvcache import (
+    BlocksExhausted, KVBlockPool, PagedKVPrograms, pack_kv_blocks,
+    unpack_kv_blocks,
+)
+
+logger = logging.getLogger("horovod_tpu.serving")
+
+__all__ = [
+    "ContinuousBatcher", "SequenceHandle", "PrefillDecodeSplit",
+    "InferenceExecutor", "KVWireTransport", "read_journal",
+]
+
+
+class SequenceHandle:
+    """One submitted sequence: poll ``tokens()`` / ``done``, or block
+    on ``wait()``.  ``tokens()`` includes any journal-recovered prefix
+    — a resumed stream reads exactly like an uninterrupted one."""
+
+    def __init__(self, seq_id, prompt):
+        self.seq_id = seq_id
+        self.prompt = list(prompt)
+        self._tokens = []
+        self._lock = threading.Lock()
+        self._done = threading.Event()
+        self.reason = None
+
+    def tokens(self):
+        with self._lock:
+            return list(self._tokens)
+
+    @property
+    def done(self):
+        return self._done.is_set()
+
+    def wait(self, timeout=None):
+        return self._done.wait(timeout)
+
+    def _emit(self, tok):
+        with self._lock:
+            self._tokens.append(int(tok))
+
+    def _finish(self, reason):
+        self.reason = reason
+        self._done.set()
+
+
+class _Seq:
+    """Internal per-sequence state while queued or holding a slot."""
+
+    __slots__ = ("handle", "feed", "max_new", "emitted_prior",
+                 "on_token", "prefilled", "blocks", "slot", "pos",
+                 "last_tok", "n_new", "submitted_at")
+
+    def __init__(self, handle, feed, max_new, emitted_prior, on_token,
+                 prefilled):
+        self.handle = handle
+        self.feed = feed                  # prompt + recovered tokens
+        self.max_new = max_new
+        self.emitted_prior = emitted_prior
+        self.on_token = on_token
+        self.prefilled = prefilled        # (tok0, k, v, length) | None
+        self.blocks = None
+        self.slot = None
+        self.pos = 0                      # position of the next write
+        self.last_tok = None              # token to feed next tick
+        self.n_new = 0                    # tokens emitted this life
+        self.submitted_at = time.monotonic()
+
+
+class ContinuousBatcher:
+    """Slot-structured decode loop over one
+    :class:`.kvcache.PagedKVPrograms` vocabulary.
+
+    Two driving modes share every code path: ``start()`` spins the
+    background tick thread (the HTTP ``/generate`` deployment), while
+    tests/drills call :meth:`tick` themselves so arrival order is
+    scripted rather than wall-clock — that is what makes two
+    same-seed runs byte-identical.
+    """
+
+    def __init__(self, params, programs: PagedKVPrograms, *,
+                 pool=None, eos_id=None, max_new_tokens=32,
+                 journal_path=None):
+        self.params = params
+        self.progs = programs
+        self.pool = pool if pool is not None else KVBlockPool(
+            programs.n_blocks, programs.block_tokens)
+        self.k_pool, self.v_pool = programs.make_pools()
+        self.max_slots = programs.max_slots
+        self.eos_id = eos_id
+        self.default_max_new = int(max_new_tokens)
+        self._slots = [None] * self.max_slots
+        self._pending = deque()
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._tick_no = 0
+        self._next_seq = 0
+        self._draining = False
+        self._thread = None
+        self._journal = open(journal_path, "a", encoding="utf-8") \
+            if journal_path else None
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens=None, on_token=None,
+               _emitted_prior=(), _prefilled=None):
+        """Queue one sequence; admission happens at the next tick with
+        a free slot + free blocks.  ``on_token`` (if given) is called
+        with every generated token as it is produced, then ``None`` on
+        completion — the ``/generate`` streaming contract."""
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        max_new = int(max_new_tokens or self.default_max_new)
+        prior = [int(t) for t in _emitted_prior]
+        if max_new - len(prior) < 1:
+            raise ValueError("no token budget left")
+        if len(prompt) + max_new > self.progs.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new}) "
+                f"exceeds max_seq_len {self.progs.cfg.max_seq_len}")
+        with self._lock:
+            if self._draining:
+                raise RuntimeError("batcher is draining")
+            handle = SequenceHandle(self._next_seq, prompt)
+            self._next_seq += 1
+            for t in prior:
+                handle._emit(t)
+            seq = _Seq(handle, prompt + prior, max_new, prior,
+                       on_token, _prefilled)
+            self._pending.append(seq)
+            self._work.notify_all()
+        return handle
+
+    # -- admission + decode --------------------------------------------------
+
+    def _free_slot(self):
+        for i, s in enumerate(self._slots):
+            if s is None:
+                return i
+        return None
+
+    def _admit(self):
+        """Move arrivals into slots while one is free AND the pool can
+        hold the sequence's full reservation (prompt + remaining
+        budget, so block growth can never fail mid-decode — the
+        deterministic admission-control contract).  Head-of-line
+        blocking is intentional: skipping ahead would make admission
+        order depend on pool timing, not arrival order."""
+        while self._pending:
+            slot = self._free_slot()
+            if slot is None:
+                return
+            seq = self._pending[0]
+            total = len(seq.feed) + (seq.max_new - len(seq.emitted_prior))
+            try:
+                blocks = self.pool.alloc(self.progs.blocks_for(total))
+            except BlocksExhausted:
+                return
+            self._pending.popleft()
+            seq.blocks = blocks
+            seq.slot = slot
+            self._slots[slot] = seq
+            if seq.prefilled is not None:
+                tok0, k_all, v_all, length = seq.prefilled
+                seq.prefilled = None
+                k_all = jax.numpy.asarray(k_all)
+                v_all = jax.numpy.asarray(v_all)
+            else:
+                tok0, k_all, v_all = self.progs.prefill(
+                    self.params, seq.feed)
+                length = len(seq.feed)
+            self.k_pool, self.v_pool = self.progs.ingest(
+                self.k_pool, self.v_pool, k_all, v_all,
+                blocks[:self.progs.blocks_for(length)], length)
+            seq.pos = length
+            telemetry.observe_serving_ttft(
+                time.monotonic() - seq.submitted_at)
+            self._journal_event(
+                {"e": "admit", "seq": seq.handle.seq_id,
+                 "slot": slot, "tick": self._tick_no,
+                 "prompt": seq.handle.prompt,
+                 "emitted_prior": seq.emitted_prior,
+                 "max_new": seq.max_new, "blocks": blocks})
+            self._emit(seq, tok0)
+
+    def _emit(self, seq, tok):
+        tok = int(tok)
+        seq.last_tok = tok
+        seq.n_new += 1
+        seq.handle._emit(tok)
+        telemetry.count_serving_tokens()
+        self._journal_event({"e": "tok", "seq": seq.handle.seq_id,
+                             "tick": self._tick_no, "tok": tok})
+        if seq.on_token is not None:
+            seq.on_token(tok)
+        total = len(seq.emitted_prior) + seq.n_new
+        if (self.eos_id is not None and tok == self.eos_id) \
+                or total >= seq.max_new:
+            reason = "eos" if (self.eos_id is not None
+                               and tok == self.eos_id) else "len"
+            self._retire(seq, reason)
+
+    def _retire(self, seq, reason):
+        self._slots[seq.slot] = None
+        self.pool.free(seq.blocks)
+        self._journal_event({"e": "retire", "seq": seq.handle.seq_id,
+                             "tick": self._tick_no, "reason": reason})
+        seq.handle._finish(reason)
+        if seq.on_token is not None:
+            seq.on_token(None)
+
+    def _chaos_tick(self):
+        from .. import chaos
+
+        inj = chaos.current()
+        if inj is None:
+            return
+        act = inj.before_decode()
+        if act is not None and act[0] == "delay":
+            time.sleep(act[1])
+
+    def tick(self):
+        """Admit what fits, then decode ONE token for every active
+        slot.  Returns the number of slots that decoded (0 = idle)."""
+        with self._lock:
+            self._admit()
+            active = [s for s in self._slots if s is not None]
+            if not active:
+                return 0
+            self._tick_no += 1
+            self._chaos_tick()
+            toks = np.zeros(self.max_slots, np.int32)
+            pos = np.zeros(self.max_slots, np.int32)
+            mask = np.zeros(self.max_slots, bool)
+            width = max(len(s.blocks) for s in active)
+            nb = self.progs.table_bucket(width)
+            tables = np.zeros((self.max_slots, nb), np.int32)
+            for s in active:
+                toks[s.slot] = s.last_tok
+                pos[s.slot] = s.pos
+                mask[s.slot] = True
+                tables[s.slot, :len(s.blocks)] = s.blocks
+            out, self.k_pool, self.v_pool = self.progs.decode(
+                self.params, self.k_pool, self.v_pool, toks, pos,
+                tables, mask)
+            for s in active:
+                s.pos += 1
+                self._emit(s, out[s.slot])
+            return len(active)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def queue_depth(self):
+        with self._lock:
+            return len(self._pending)
+
+    @property
+    def active_slots(self):
+        with self._lock:
+            return sum(1 for s in self._slots if s is not None)
+
+    def has_work(self):
+        with self._lock:
+            return bool(self._pending) or \
+                any(s is not None for s in self._slots)
+
+    def drain(self):
+        """Tick until every queued and in-flight sequence completes;
+        asserts the zero-leaked-blocks invariant on the way out."""
+        while self.has_work():
+            self.tick()
+        if self.pool.in_use:
+            raise RuntimeError(
+                f"{self.pool.in_use} KV blocks leaked across drain")
+
+    def start(self):
+        """Background tick loop (the HTTP deployment): decode while
+        work exists, sleep on the condition otherwise."""
+
+        def loop():
+            while True:
+                with self._lock:
+                    while not self._draining and not self._pending \
+                            and all(s is None for s in self._slots):
+                        self._work.wait(0.1)
+                    if self._draining and not self._pending \
+                            and all(s is None for s in self._slots):
+                        return
+                self.tick()
+
+        self._thread = threading.Thread(
+            target=loop, name="horovod_tpu-continuous-decode",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        """Drain, then stop the tick thread."""
+        with self._lock:
+            self._draining = True
+            self._work.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=120.0)
+            self._thread = None
+        else:
+            self.drain()
+        if self._journal is not None:
+            self._journal.close()
+            self._journal = None
+
+    # -- journal + recovery --------------------------------------------------
+
+    def _journal_event(self, rec):
+        if self._journal is None:
+            return
+        self._journal.write(json.dumps(rec, sort_keys=True) + "\n")
+        self._journal.flush()
+
+    def resume(self, entries, on_token=None):
+        """Resubmit journal-recovered sequences (``read_journal``'s
+        unfinished entries): each re-prefills prompt + already-emitted
+        tokens as its feed — prefill over a prefix reproduces the
+        exact cache incremental decode would have built, so the
+        completed stream is the one the dead replica would have
+        produced.  Returns the new handles, arrival order preserved
+        (journal order IS arrival order)."""
+        handles = []
+        for ent in entries:
+            if ent["max_new"] - len(ent["emitted"]) < 1:
+                # the kill landed between the final token's journal
+                # line and its retire line — the stream is complete
+                h = SequenceHandle(-1, ent["prompt"])
+                for t in ent["emitted"]:
+                    h._emit(t)
+                h._finish("len")
+                handles.append(h)
+                continue
+            handles.append(self.submit(
+                ent["prompt"], max_new_tokens=ent["max_new"],
+                on_token=on_token,
+                _emitted_prior=ent["emitted"]))
+        return handles
+
+
+def read_journal(path):
+    """Parse a slot journal; returns ``(unfinished, finished)`` entry
+    lists, each entry ``{"seq", "prompt", "emitted", "max_new"}`` in
+    admission order — the recovery worklist after a decode-replica
+    death (a torn trailing line from the kill is tolerated)."""
+    seqs = {}
+    order = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue        # torn final write from the kill
+            sid = rec["seq"]
+            if rec["e"] == "admit":
+                seqs[sid] = {"seq": sid, "prompt": rec["prompt"],
+                             "emitted": list(rec["emitted_prior"]),
+                             "max_new": rec["max_new"],
+                             "done": False}
+                order.append(sid)
+            elif rec["e"] == "tok":
+                seqs[sid]["emitted"].append(rec["tok"])
+            elif rec["e"] == "retire":
+                seqs[sid]["done"] = True
+    unfinished = [seqs[s] for s in order if not seqs[s]["done"]]
+    finished = [seqs[s] for s in order if seqs[s]["done"]]
+    return unfinished, finished
+
+
+# ---------------------------------------------------------------------------
+# prefill/decode split — the executor's third consumer
+
+
+class KVWireTransport:
+    """Serving's transport binding for the shared executor: the
+    activation hop is a prefill's KV blocks on the training fabric's
+    blockwise-quantized codec (``f32`` lossless / ``int8`` / ``int4``
+    — :mod:`..ops.quantize`).  Inference streams are forward-only, so
+    the gradient verbs refuse loudly."""
+
+    def __init__(self, wire="f32"):
+        self.wire = wire
+        self._mailbox = {}
+        self.hops = 0
+        self.wire_bytes = 0
+
+    def send_act(self, ex, v, mb, peer):
+        tok0, k_all, v_all, length = ex.inbox.pop((v + 1, mb))
+        msg = pack_kv_blocks(k_all, v_all, length, wire=self.wire)
+        self._mailbox[(v + 1, mb)] = (tok0, msg)
+        self.hops += 1
+        for part in (msg["k"], msg["v"]):
+            if isinstance(part, tuple):
+                self.wire_bytes += part[0].nbytes + part[1].nbytes
+            else:
+                self.wire_bytes += part.nbytes
+
+    def recv_act(self, ex, v, mb, peer):
+        tok0, msg = self._mailbox.pop((v, mb))
+        k, vv, length = unpack_kv_blocks(msg)
+        ex.inbox[(v, mb)] = (tok0, k, vv, length)
+
+    def send_grad(self, ex, v, mb, peer):
+        raise RuntimeError("inference streams are forward-only")
+
+    recv_grad = send_grad
+
+    def reduce(self, ex, v):
+        raise RuntimeError("inference streams are forward-only")
+
+
+class InferenceExecutor(ScheduleExecutor):
+    """The serving compute binding for
+    :class:`..parallel.executor.ScheduleExecutor`: virtual stage 0's
+    ``fwd`` is a prompt prefill, virtual stage 1's ``fwd`` ingests the
+    wire-hopped KV into the decode side's batcher.  Same dispatch
+    chain, same mailbox conventions as the two training runtimes."""
+
+    def __init__(self, *, prefill_fn, admit_fn, prompts, **kw):
+        super().__init__(**kw)
+        self.prefill_fn = prefill_fn
+        self.admit_fn = admit_fn
+        self.prompts = prompts
+
+    def _fwd(self, v, mb):
+        if v == 0:
+            feed = self.prompts[mb]
+            tok0, k_all, v_all = self.prefill_fn(feed)
+            self.inbox[(v + 1, mb)] = (tok0, k_all, v_all, len(feed))
+        else:
+            self.admit_fn(mb, *self.inbox.pop((v, mb)))
+
+    def _bwd(self, v, mb):
+        raise RuntimeError("inference streams are forward-only")
+
+
+def _inference_streams(mb):
+    """The two per-stage instruction streams one sequence's
+    prefill→decode handoff compiles to (stage 0 = prefill mesh,
+    stage 1 = decode mesh)."""
+    return (
+        [Instr("fwd", mb=mb, chunk=0),
+         Instr("send_act", mb=mb, chunk=0, peer=1)],
+        [Instr("recv_act", mb=mb, chunk=0, peer=0),
+         Instr("fwd", mb=mb, chunk=0)],
+    )
+
+
+class PrefillDecodeSplit:
+    """Disaggregated serving: prefill on one set of devices (the
+    throughput pipeline), continuous decode on another (the latency
+    path), KV blocks hopping between them on the quantized wire.
+
+    ``prefill_devices`` / ``decode_devices`` place the two phases
+    (defaulting to the process's default device for both — the split
+    is then purely the wire + executor topology, which is what the
+    parity tests pin; a pod deployment hands each phase its stage
+    mesh's devices).  ``wire="f32"`` is lossless and token-identical
+    to the monolithic path; ``int8``/``int4`` trade parity for hop
+    bandwidth."""
+
+    def __init__(self, params, programs: PagedKVPrograms, *,
+                 wire="f32", prefill_devices=None, decode_devices=None,
+                 eos_id=None, max_new_tokens=32, journal_path=None,
+                 batcher=None):
+        self.progs = programs
+        dev_p = prefill_devices[0] if prefill_devices else None
+        dev_d = decode_devices[0] if decode_devices else None
+        self._prefill_params = jax.device_put(params, dev_p) \
+            if dev_p is not None else params
+        decode_params = jax.device_put(params, dev_d) \
+            if dev_d is not None else params
+        self.batcher = batcher if batcher is not None else \
+            ContinuousBatcher(decode_params, programs, eos_id=eos_id,
+                              max_new_tokens=max_new_tokens,
+                              journal_path=journal_path)
+        self.transport = KVWireTransport(wire=wire)
+        self._next_mb = 0
+        self._inflight = {}
+        self._lock = threading.Lock()
+
+    def _prefill(self, feed):
+        return self.progs.prefill(self._prefill_params, feed)
+
+    def _admit(self, mb, tok0, k, v, length):
+        with self._lock:
+            prompt, max_new, on_token = self._inflight.pop(mb)
+        self._inflight[mb] = self.batcher.submit(
+            prompt, max_new_tokens=max_new, on_token=on_token,
+            _prefilled=(tok0, k, v, length))
+
+    def submit(self, prompt, max_new_tokens=None, on_token=None):
+        """Run one sequence's prefill→decode handoff through the
+        shared executor's instruction streams, then hand the decode
+        side its slot.  Returns the decode batcher's handle."""
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        with self._lock:
+            mb = self._next_mb
+            self._next_mb += 1
+            self._inflight[mb] = (prompt, max_new_tokens, on_token)
+        s0, s1 = _inference_streams(mb)
+        inbox = {}
+        execs = [
+            InferenceExecutor(
+                prefill_fn=self._prefill, admit_fn=self._admit,
+                prompts={mb: prompt}, stage=stage, n_stages=2,
+                total_chunks=1, transport=self.transport, inbox=inbox)
+            for stage in (0, 1)]
+        execs[0].run(s0)
+        execs[1].run(s1)
+        with self._lock:
+            return self._inflight.pop(mb)
+
+    def tick(self):
+        return self.batcher.tick()
+
+    def drain(self):
+        self.batcher.drain()

@@ -11,6 +11,12 @@ balancer's contract:
   ``{"outputs": [...]}`` — each element enters the batcher as its own
   request, so a client batch and loose singles coalesce into the same
   bucketed device batches;
+* ``POST /generate``       ``{"tokens": [ids], "max_new_tokens": n}``
+  → streamed NDJSON, one ``{"token": id}`` line per generated token
+  as the continuous batcher produces it (``Connection: close``
+  delimited), closing with ``{"done": true, "tokens": [...],
+  "reason": "eos"|"len"}`` — only when the frontend was built with a
+  ``generator`` (:class:`.continuous.ContinuousBatcher`);
 * ``GET /healthz``         readiness: 200 while accepting, 503 while
   draining (a load balancer drains this replica out of rotation);
 * ``GET /stats``           queue depth / buckets / counters (JSON);
@@ -75,8 +81,10 @@ class ServingFrontend:
     (or anything with ``predict_one`` / ``submit`` / ``draining`` /
     ``batcher``)."""
 
-    def __init__(self, replica, port=0, addr="0.0.0.0"):
+    def __init__(self, replica, port=0, addr="0.0.0.0",
+                 generator=None):
         self.replica = replica
+        self.generator = generator    # ContinuousBatcher for /generate
         self.addr = addr
         self._port = port
         self._httpd = None
@@ -146,6 +154,46 @@ class ServingFrontend:
                 {"error": f"{type(exc).__name__}: {exc}"}).encode(),
                 "application/json")
 
+    def _generate(self, handler, payload):
+        """Stream one sequence: submit to the continuous batcher with
+        a queue-backed ``on_token``, write each token as its own
+        NDJSON line the moment the decode tick emits it (TTFT on the
+        wire, not after the stream finishes)."""
+        import queue as _queue
+
+        try:
+            tokens = [int(t) for t in payload["tokens"]]
+        except (KeyError, TypeError, ValueError):
+            return handler.reply(
+                400, b'{"error": "tokens must be a list of ids"}')
+        q = _queue.Queue()
+        try:
+            handle = self.generator.submit(
+                tokens, max_new_tokens=payload.get("max_new_tokens"),
+                on_token=q.put)
+        except RuntimeError as exc:       # draining
+            return handler.reply(503, json.dumps(
+                {"error": str(exc), "draining": True}).encode())
+        except ValueError as exc:
+            return handler.reply(400, json.dumps(
+                {"error": str(exc)}).encode())
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/x-ndjson")
+        handler.send_header("Connection", "close")
+        handler.end_headers()
+        handler.close_connection = True
+        while True:
+            tok = q.get()
+            if tok is None:
+                break
+            handler.wfile.write(
+                (json.dumps({"token": int(tok)}) + "\n").encode())
+            handler.wfile.flush()
+        handler.wfile.write((json.dumps(
+            {"done": True, "tokens": handle.tokens(),
+             "reason": handle.reason}) + "\n").encode())
+        handler.wfile.flush()
+
     # -- lifecycle -----------------------------------------------------------
 
     def start(self):
@@ -178,14 +226,22 @@ class ServingFrontend:
                         "status": "draining" if draining else "ok",
                     }).encode())
                 elif path == "/stats":
-                    self.reply(200, json.dumps({
+                    stats = {
                         "queue_depth": replica.batcher.queue_depth(),
                         "buckets": list(replica.batcher.buckets),
                         "max_batch_size": replica.batcher.max_batch_size,
                         "max_latency_ms":
                             replica.batcher.max_latency_s * 1000.0,
                         "draining": replica.draining,
-                    }).encode())
+                    }
+                    gen = frontend.generator
+                    if gen is not None:
+                        stats.update({
+                            "decode_queue_depth": gen.queue_depth,
+                            "active_slots": gen.active_slots,
+                            "kv_blocks_in_use": gen.pool.in_use,
+                        })
+                    self.reply(200, json.dumps(stats).encode())
                 elif path == "/metrics":
                     from ..telemetry import (
                         CONTENT_TYPE_LATEST, registry, render_prometheus,
@@ -199,7 +255,10 @@ class ServingFrontend:
 
             def do_POST(self):
                 path = self.path.partition("?")[0]
-                if path not in ("/predict", "/predict_batch"):
+                generate = path == "/generate" and \
+                    frontend.generator is not None
+                if path not in ("/predict", "/predict_batch") \
+                        and not generate:
                     return self.reply(404, b'{"error": "not found"}')
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
@@ -210,6 +269,8 @@ class ServingFrontend:
                 except ValueError:
                     return self.reply(
                         400, b'{"error": "body is not JSON"}')
+                if generate:
+                    return frontend._generate(self, payload)
                 frontend._predict(self, payload,
                                   batch=(path == "/predict_batch"))
 

@@ -46,6 +46,15 @@ HOROVOD_SERVING_SLO_P99_MS = "HOROVOD_SERVING_SLO_P99_MS"
 HOROVOD_SERVING_QUEUE_HIGH = "HOROVOD_SERVING_QUEUE_HIGH"
 HOROVOD_SERVING_AUTOSCALE_SECONDS = "HOROVOD_SERVING_AUTOSCALE_SECONDS"
 HOROVOD_SERVING_DRAIN_SECONDS = "HOROVOD_SERVING_DRAIN_SECONDS"
+# continuous-batching decode (serving/continuous.py + kvcache.py;
+# docs/serving.md "Continuous batching" has the sizing guidance)
+HOROVOD_SERVING_KV_BLOCK_TOKENS = "HOROVOD_SERVING_KV_BLOCK_TOKENS"
+HOROVOD_SERVING_KV_BLOCKS = "HOROVOD_SERVING_KV_BLOCKS"
+HOROVOD_SERVING_KV_WIRE = "HOROVOD_SERVING_KV_WIRE"
+HOROVOD_SERVING_DECODE_SLOTS = "HOROVOD_SERVING_DECODE_SLOTS"
+HOROVOD_SERVING_DECODE_MAX_TOKENS = "HOROVOD_SERVING_DECODE_MAX_TOKENS"
+HOROVOD_SERVING_SLO_TTFT_MS = "HOROVOD_SERVING_SLO_TTFT_MS"
+HOROVOD_SERVING_SLO_TOKENS_PER_S = "HOROVOD_SERVING_SLO_TOKENS_PER_S"
 
 
 class ServingConfig:
@@ -56,7 +65,10 @@ class ServingConfig:
     def __init__(self, port=None, max_batch_size=None,
                  max_latency_ms=None, buckets=None, slo_p99_ms=None,
                  queue_high=None, autoscale_interval_s=None,
-                 drain_timeout_s=None):
+                 drain_timeout_s=None, kv_block_tokens=None,
+                 kv_blocks=None, kv_wire=None, decode_slots=None,
+                 decode_max_tokens=None, slo_ttft_ms=None,
+                 slo_tokens_per_s=None):
         self.port = port if port is not None else \
             env_mod.get_int(HOROVOD_SERVING_PORT, 0)
         self.max_batch_size = max_batch_size if max_batch_size is not None \
@@ -79,6 +91,24 @@ class ServingConfig:
         self.drain_timeout_s = drain_timeout_s \
             if drain_timeout_s is not None else \
             env_mod.get_float(HOROVOD_SERVING_DRAIN_SECONDS, 30.0)
+        # continuous-batching decode geometry + SLOs
+        self.kv_block_tokens = kv_block_tokens \
+            if kv_block_tokens is not None else \
+            env_mod.get_int(HOROVOD_SERVING_KV_BLOCK_TOKENS, 16)
+        self.kv_blocks = kv_blocks if kv_blocks is not None else \
+            env_mod.get_int(HOROVOD_SERVING_KV_BLOCKS, 256)
+        self.kv_wire = kv_wire if kv_wire is not None else \
+            (env_mod.get_str(HOROVOD_SERVING_KV_WIRE) or "f32")
+        self.decode_slots = decode_slots if decode_slots is not None \
+            else env_mod.get_int(HOROVOD_SERVING_DECODE_SLOTS, 8)
+        self.decode_max_tokens = decode_max_tokens \
+            if decode_max_tokens is not None else \
+            env_mod.get_int(HOROVOD_SERVING_DECODE_MAX_TOKENS, 64)
+        self.slo_ttft_ms = slo_ttft_ms if slo_ttft_ms is not None \
+            else env_mod.get_float(HOROVOD_SERVING_SLO_TTFT_MS, 500.0)
+        self.slo_tokens_per_s = slo_tokens_per_s \
+            if slo_tokens_per_s is not None else \
+            env_mod.get_float(HOROVOD_SERVING_SLO_TOKENS_PER_S, 0.0)
 
 
 class ServingReplica:

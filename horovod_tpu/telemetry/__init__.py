@@ -325,6 +325,23 @@ ALLTOALL_EXPOSED_SECONDS_HELP = (
     "Wall seconds callers spent blocked on in-flight alltoall "
     "programs after their own compute had finished, by path")
 ALLTOALL_EXPOSED_SECONDS_LABELS = ("path",)
+# continuous-batching LM serving (docs/serving.md "Continuous
+# batching"): TTFT + token throughput are the latency/goodput pair
+# the autoscaler and the fleet controller size continuous jobs on,
+# and the KV-block gauge is the paged cache's occupancy/leak signal
+SERVING_TTFT_FAMILY = "horovod_serving_ttft_seconds"
+SERVING_TTFT_HELP = (
+    "Time to first generated token per sequence: submit to the "
+    "prefill's first emitted token (continuous-batching decode path)")
+SERVING_TOKENS_FAMILY = "horovod_serving_tokens_total"
+SERVING_TOKENS_HELP = (
+    "Tokens generated by the continuous batcher's decode loop "
+    "(prefill first-tokens included) — the serving goodput unit "
+    "tokens/sec signals derive from")
+KV_BLOCKS_IN_USE_FAMILY = "horovod_kv_blocks_in_use"
+KV_BLOCKS_IN_USE_HELP = (
+    "Paged KV cache blocks currently allocated to live decode "
+    "slots; must return to 0 on drain (leak check)")
 
 
 def account_alltoall_bytes(hop, wire, logical, actual):
@@ -459,6 +476,29 @@ def set_optimizer_state_bytes(scope, nbytes):
         OPTIMIZER_STATE_BYTES_FAMILY, OPTIMIZER_STATE_BYTES_HELP,
         labelnames=OPTIMIZER_STATE_BYTES_LABELS).labels(
         scope=scope).set(int(nbytes))
+
+
+def observe_serving_ttft(seconds):
+    """One sequence's time-to-first-token, into the process-current
+    registry (submit → first emitted token on the continuous decode
+    path)."""
+    registry().histogram(
+        SERVING_TTFT_FAMILY, SERVING_TTFT_HELP,
+        buckets=REQUEST_LATENCY_BUCKETS).observe(seconds)
+
+
+def count_serving_tokens(n=1):
+    """``n`` tokens emitted by the continuous batcher, into the
+    process-current registry."""
+    registry().counter(SERVING_TOKENS_FAMILY,
+                       SERVING_TOKENS_HELP).inc(int(n))
+
+
+def set_kv_blocks_in_use(n):
+    """Current paged KV cache block occupancy (live decode slots),
+    into the process-current registry."""
+    registry().gauge(KV_BLOCKS_IN_USE_FAMILY,
+                     KV_BLOCKS_IN_USE_HELP).set(int(n))
 
 
 def metrics():

@@ -105,6 +105,14 @@ def bench_overlap(args, dp, tp):
     compute_s = args.overlap_compute_ms / 1000.0
     hint = hvd.TopologyHint(axes=("dp", "tp"), sizes=(dp, tp)) \
         if tp > 1 else None
+    # hvd.run ranks are threads in THIS process and the cache-miss
+    # counter is process-global: without a barrier around each leg's
+    # counted window, a fast rank entering the next leg's warmup
+    # (compiling new bucket programs) races a slow rank that hasn't
+    # read its end-of-window counter yet, and the miss gets blamed on
+    # steady state — the overlap_steady_recompiles flake
+    import threading
+    bar = threading.Barrier(dp * tp)
 
     def worker():
         from horovod_tpu import telemetry
@@ -140,6 +148,13 @@ def bench_overlap(args, dp, tp):
 
             for _ in range(warmup):
                 outs = step()
+            # one extra warm step OUTSIDE the counted window (a rank
+            # that lost the dispatch race can trigger a late
+            # first-use compile on the last nominal warmup step),
+            # then barrier: no rank opens its window while another is
+            # still warming (= still compiling)
+            outs = step()
+            bar.wait()
             m0 = telemetry.counter_total(
                 telemetry.PROGRAM_CACHE_MISSES_FAMILY)
             e0 = exposed.labels(path=leg).value
@@ -156,6 +171,9 @@ def bench_overlap(args, dp, tp):
             row[f"overlap_{leg}_recompiles"] = \
                 telemetry.counter_total(
                     telemetry.PROGRAM_CACHE_MISSES_FAMILY) - m0
+            # barrier again: every rank reads its window-end counter
+            # before any rank compiles the next leg's programs
+            bar.wait()
         row["parity"] = all(
             np.array_equal(g, b) for g, b in
             zip(leg_outs["grouped"], leg_outs["bucketized"]))

@@ -46,6 +46,14 @@
 #                         #   loss parity with the dense run, per-
 #                         #   stage timeline lanes, zero steady-state
 #                         #   recompiles
+#   ./ci.sh data          # gate: tools/data_smoke.py — REAL
+#                         #   multi-process data-plane drill: seeded
+#                         #   chaos kills a shard server mid-epoch
+#                         #   (exactly-once visitation histogram after
+#                         #   the journaled-cursor re-form) + a rank
+#                         #   SIGKILLed mid async-checkpoint save
+#                         #   (torn step invisible to restore); two
+#                         #   same-seed runs byte-identical
 #   ./ci.sh integrity     # gate: tools/integrity_smoke.py — a REAL
 #                         #   2-proc elastic job under a seeded
 #                         #   bit-flip plan: 100% of injected wire/
@@ -91,7 +99,7 @@ PART2="tests/test_elastic.py tests/test_examples.py \
   tests/test_tensorflow.py"
 PART3="tests/test_parallel.py tests/test_torch.py"
 PART4="tests/test_aggregator.py tests/test_api_parity.py \
-  tests/test_chaos.py tests/test_fleet.py \
+  tests/test_chaos.py tests/test_data_plane.py tests/test_fleet.py \
   tests/test_pallas.py tests/test_runner.py tests/test_serving.py"
 
 case "${1:-all}" in
@@ -201,6 +209,19 @@ case "${1:-all}" in
     # after_decodes kill drill recovers from the slot journal with
     # byte-identical evidence across two same-seed runs
     python tools/continuous_smoke.py
+    ;;
+  data)
+    # data-plane gate (docs/data.md; ISSUE 20): a REAL multi-process
+    # drill — a seeded fault plan kills one shard server of the
+    # sharded input service mid-epoch (its consumer subprocess exits
+    # on ShardStalledError, never clean EOF), the shard map re-forms
+    # from the journaled cursors and the merged visitation histogram
+    # is EXACTLY one visit per sample; then a rank subprocess is
+    # SIGKILLed mid async-checkpoint save — the torn step never
+    # anchors and both the surviving rank and a fresh process restore
+    # the previous anchored commit.  The whole drill runs twice with
+    # the same seed and the evidence must be byte-identical.
+    python tools/data_smoke.py
     ;;
   integrity)
     # step-integrity gate (docs/fault_tolerance.md "Silent data
@@ -342,7 +363,7 @@ case "${1:-all}" in
     python tools/integrity_smoke.py
     ;;
   *)
-    echo "usage: $0 {analyze|fast|matrix|integration|chaos|fleet|scale|trace|metrics|serve|pp|integrity|bench|perf|all}" >&2
+    echo "usage: $0 {analyze|fast|matrix|integration|chaos|fleet|scale|trace|metrics|serve|pp|data|integrity|bench|perf|all}" >&2
     exit 2
     ;;
 esac

@@ -127,8 +127,18 @@ FLEET_KINDS = ("revoke_host", "restore_host")
 #: ``fired`` evidence (site/row/byte/bit included) is byte-identical
 #: across same-seed runs.
 INTEGRITY_KINDS = ("bitflip_grad", "bitflip_wire", "corrupt_spill")
+#: Data-plane kinds (docs/data.md "Failure-mode matrix"):
+#: ``kill_shard_server`` stops one shard server abruptly mid-epoch —
+#: no end-of-shard sentinel, its staged tail stays undelivered — so
+#: the drill exercises the ledger's reform-from-journaled-cursors
+#: path (exactly-once visitation across the kill).  The target is
+#: ``proc`` (the shard index) and the trigger is ``after_samples``
+#: (the n-th sample that shard server publishes — its OWN counter,
+#: so adding data-plane events never perturbs the fabric-request
+#: stream an existing plan was seeded against).
+DATA_KINDS = ("kill_shard_server",)
 KINDS = PROCESS_KINDS + WIRE_KINDS + ENGINE_KINDS + COORD_KINDS \
-    + AGG_KINDS + FLEET_KINDS + INTEGRITY_KINDS
+    + AGG_KINDS + FLEET_KINDS + INTEGRITY_KINDS + DATA_KINDS
 
 #: Trigger spellings -> canonical trigger name.
 _TRIGGERS = {"after_requests": "requests",
@@ -147,6 +157,10 @@ _TRIGGERS = {"after_requests": "requests",
              # stream an existing plan was seeded against)
              "after_buckets": "buckets",
              "after_commits": "commits",
+             # data-plane kinds count samples a shard server publishes
+             # (data/shard_service.py; its OWN counter — see
+             # DATA_KINDS)
+             "after_samples": "samples",
              # coordinator-side rules count matching requests
              "after": "requests"}
 
@@ -166,7 +180,7 @@ class FaultEvent:
     ms: float = 0.0                 # delay / skew magnitude
     count: int = 1                  # consecutive trigger points to fire on
     p: float = 1.0                  # per-firing probability (seeded RNG)
-    side: str = "worker"            # worker | coord | agg | fleet
+    side: str = "worker"            # worker | coord | agg | fleet | data
     host: Optional[str] = None      # fleet-side pool hostname target
 
 
@@ -204,6 +218,13 @@ class FaultPlan:
         host pool (revoke_host / restore_host)."""
         return [e for e in self.events if e.side == "fleet"]
 
+    def data_events(self) -> List[FaultEvent]:
+        """Events the sharded data service applies to its own shard
+        servers (kill_shard_server; ``proc`` is the shard index — the
+        service hosting the shard threads arms them itself, like the
+        FleetController arms its pool events)."""
+        return [e for e in self.events if e.side == "data"]
+
     def aggregator_events(self, agg_index: int) -> List[FaultEvent]:
         """Service faults the process owning aggregator ``agg_index``
         (= its host index) must apply — targeted by ``proc``, or
@@ -228,10 +249,10 @@ def _parse_event(index: int, raw: dict) -> FaultEvent:
             f"fault event #{index}: unknown kind {kind!r} "
             f"(valid: {', '.join(KINDS)})")
     side = raw.get("side", "worker")
-    if side not in ("worker", "coord", "agg", "fleet"):
+    if side not in ("worker", "coord", "agg", "fleet", "data"):
         raise ValueError(
             f"fault event #{index}: side must be 'worker', 'coord', "
-            f"'agg' or 'fleet', got {side!r}")
+            f"'agg', 'fleet' or 'data', got {side!r}")
     if kind in COORD_KINDS:
         # coordinator-targeting kinds are coord-side by definition
         side = "coord"
@@ -241,6 +262,15 @@ def _parse_event(index: int, raw: dict) -> FaultEvent:
     if kind in FLEET_KINDS:
         # pool-targeting kinds are fleet-side by definition
         side = "fleet"
+    if kind in DATA_KINDS:
+        # shard-server-targeting kinds are data-side by definition
+        # (applied by the sharded data service hosting the shard
+        # threads; ``proc`` is the shard index, not a process index)
+        side = "data"
+    if side == "data" and kind not in DATA_KINDS:
+        raise ValueError(
+            f"fault event #{index}: data-side events support "
+            f"{', '.join(DATA_KINDS)}, not {kind}")
     if side == "coord" and kind not in (
             "http_error", "delay_ms") + COORD_KINDS:
         raise ValueError(
@@ -298,6 +328,21 @@ def _parse_event(index: int, raw: dict) -> FaultEvent:
             f"fault event #{index}: trigger {trig_key} is reserved "
             f"for the integrity kinds ({', '.join(INTEGRITY_KINDS)}), "
             f"not {kind}")
+    if kind in DATA_KINDS and trig_key != "after_samples":
+        raise ValueError(
+            f"fault event #{index}: {kind} triggers on "
+            f"'after_samples' (the n-th sample the targeted shard "
+            f"server publishes), not {trig_key}")
+    if trig_key == "after_samples" and kind not in DATA_KINDS:
+        raise ValueError(
+            f"fault event #{index}: trigger after_samples is "
+            f"reserved for the data-plane kinds "
+            f"({', '.join(DATA_KINDS)}), not {kind}")
+    if kind in DATA_KINDS and raw.get("proc") is None:
+        raise ValueError(
+            f"fault event #{index}: {kind} requires an explicit "
+            f"'proc' target (the shard index) — an untargeted kill "
+            f"would take down every shard server at once")
     if kind == "coord_restart" and not raw.get("ms"):
         raise ValueError(
             f"fault event #{index}: coord_restart needs 'ms' > 0 "

@@ -252,6 +252,23 @@ HOROVOD_FLEET_METRICS_PORT = "HOROVOD_FLEET_METRICS_PORT"
 HOROVOD_FLEET_SETTLE_TICKS = "HOROVOD_FLEET_SETTLE_TICKS"
 HOROVOD_FLEET_BLACKLIST_TICKS = "HOROVOD_FLEET_BLACKLIST_TICKS"
 
+# pod-scale data plane (docs/data.md): DATA_SHARD_JOURNAL names the
+# shard ledger's cursor journal file (unset = in-memory only — no
+# exactly-once guarantee across restarts); DATA_SHARD_SEED seeds the
+# deterministic sample permutation the shard planner splits (same
+# seed → byte-identical shard plans, the data drill's evidence);
+# DATA_QUEUE_SIZE bounds each shard server's staged-batch queue (the
+# backpressure window DATA_QUEUE_DEPTH exports); DATA_ACK_POLL_SECONDS
+# is the ledger's cadence for draining consumer acks from the KV
+# fabric into journaled cursors (the bounded cursor-lag window);
+# DATA_ASYNC_CKPT=0 forces utils/checkpoint.py save_rank0-style
+# inline saves instead of the background CRC-anchored streamer.
+HOROVOD_DATA_SHARD_JOURNAL = "HOROVOD_DATA_SHARD_JOURNAL"
+HOROVOD_DATA_SHARD_SEED = "HOROVOD_DATA_SHARD_SEED"
+HOROVOD_DATA_QUEUE_SIZE = "HOROVOD_DATA_QUEUE_SIZE"
+HOROVOD_DATA_ACK_POLL_SECONDS = "HOROVOD_DATA_ACK_POLL_SECONDS"
+HOROVOD_DATA_ASYNC_CKPT = "HOROVOD_DATA_ASYNC_CKPT"
+
 #: Launcher↔worker handoff ABI: env vars the launcher exports for its
 #: own workers and users never set by hand.  hvdlint checker 5
 #: (`knob-undocumented`) exempts these from the docs/migration.md

@@ -5,3 +5,8 @@ from .device_feeder import DeviceFeeder  # noqa: F401
 from .service import (  # noqa: F401
     DataServiceConfig, DataServiceServer, data_service,
 )
+from .shard_service import (  # noqa: F401
+    ShardLedger, ShardStalledError, ShardedDataService, plan_shards,
+    shard_consumer,
+)
+from .evaluation import merge_eval_results, run_eval_shard  # noqa: F401

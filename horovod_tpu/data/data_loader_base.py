@@ -4,6 +4,17 @@ AsyncDataLoaderMixin with a prefetch thread)."""
 
 import queue
 import threading
+import traceback
+
+
+class _LoaderError:
+    """Queue sentinel carrying a prefetch-worker failure to the
+    consumer (same contract as the data service's _WorkerError: the
+    message embeds the worker traceback so the consumer fails loudly
+    instead of seeing a silently truncated epoch)."""
+
+    def __init__(self, message):
+        self.message = message
 
 
 class BaseDataLoader:
@@ -37,6 +48,9 @@ class AsyncDataLoaderMixin:
         super().__init__(*args, **kwargs)
 
     def close_async_loader(self):
+        """Safe mid-prefetch: the worker only ever does timed puts and
+        re-checks the closing flag between them, so a full queue can
+        never wedge the join."""
         if self._thread is not None:
             self._closing = True
             try:
@@ -48,14 +62,36 @@ class AsyncDataLoaderMixin:
             self._thread = None
             self._closing = False
 
+    def _put(self, item):
+        """Timed put (the DeviceFeeder._put idiom): block at most
+        0.1 s at a time so a close() racing a full queue unblocks the
+        worker instead of deadlocking it."""
+        while not self._closing:
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def _async_worker(self):
+        final = None       # None sentinel = clean end of data
         try:
             for batch in self._iterate():
-                if self._closing:
+                if self._closing or not self._put(batch):
                     return
-                self._queue.put(batch)
+        except Exception as exc:  # noqa: BLE001 — surfaced to consumer
+            final = _LoaderError(
+                f"{type(exc).__name__}: {exc}\n{traceback.format_exc()}")
         finally:
-            self._queue.put(None)
+            if not self._put(final):
+                # close() is draining concurrently — leave a
+                # best-effort sentinel so a consumer still blocked in
+                # get() wakes up rather than hanging.
+                try:
+                    self._queue.put_nowait(final)
+                except queue.Full:
+                    pass
 
     def __iter__(self):
         if not self.async_loading:
@@ -68,6 +104,10 @@ class AsyncDataLoaderMixin:
         def gen():
             while True:
                 batch = self._queue.get()
+                if isinstance(batch, _LoaderError):
+                    raise RuntimeError(
+                        f"async data loader worker failed: "
+                        f"{batch.message}")
                 if batch is None:
                     break
                 yield batch

@@ -1,0 +1,107 @@
+"""Distributed eval over journaled eval-shard cursors (docs/data.md).
+
+Eval in the reference is whatever the user's script does inline —
+serial, unaccounted, and lost on preemption.  Here eval is a
+first-class fleet job kind (``kind: eval`` in the fleet spec): the
+:class:`~horovod_tpu.fleet.controller.FleetController` gang-places
+eval workers like training workers, each worker consumes one shard of
+an eval :class:`~.shard_service.ShardedDataService` (its OWN ledger
+namespace — eval visitation cursors journal separately from
+training's), partial results merge through the existing KV fabric,
+and goodput is counted per job exactly like training commits
+(``horovod_eval_batches_total``).
+
+Exactly-once composes for free: an eval worker preempted mid-pass
+resumes from its journaled shard cursor, so no sample is scored twice
+and the merged metric is a true mean over the eval set.
+
+Result keys live under ``/eval/<job>/<gen>/<shard>`` — NOT under the
+journal-excluded ``/data/`` namespace, so the coordinator journals
+them: merged partials survive a coordinator restart with the rest of
+the control plane.
+"""
+
+import pickle
+from typing import Callable, Dict, Optional
+
+from .service import DataServiceConfig
+from .shard_service import shard_consumer
+
+_RESULT_KEY = "/eval/{job}/{gen}/{shard}"
+
+
+def run_eval_shard(config: DataServiceConfig, shard: int,
+                   eval_fn: Callable[[object], Dict[str, float]],
+                   gen: int = 0, job: str = "eval",
+                   batch_size: int = 8, timeout: float = 30.0,
+                   client=None) -> Dict[str, float]:
+    """Score one eval shard: ``eval_fn(sample) -> {metric: value}``
+    per sample, sums accumulated locally and published to the KV
+    fabric after every batch (so a re-formed shard's partial work is
+    never lost — the cursor and the partial advance together).
+    Returns this shard's final ``{"count": n, "sums": {...}}``."""
+    from ..runner.http.http_client import StoreClient
+
+    if isinstance(config, dict):
+        config = DataServiceConfig.from_dict(config)
+    client = client or StoreClient(config.addr, config.port,
+                                   bytes.fromhex(config.secret_hex))
+    sums: Dict[str, float] = {}
+    count = 0
+    in_batch = 0
+
+    def _publish():
+        client.put(_RESULT_KEY.format(job=job, gen=gen, shard=shard),
+                   pickle.dumps({"count": count, "sums": sums},
+                                protocol=4))
+
+    for _idx, sample in shard_consumer(config, shard, gen=gen,
+                                       timeout=timeout, client=client):
+        for metric, value in eval_fn(sample).items():
+            sums[metric] = sums.get(metric, 0.0) + float(value)
+        count += 1
+        in_batch += 1
+        if in_batch >= batch_size:
+            _publish()
+            try:
+                from .. import telemetry
+                telemetry.count_eval_batches()
+            except Exception:  # noqa: BLE001 — accounting never blocks
+                pass
+            in_batch = 0
+    _publish()
+    if in_batch:
+        try:
+            from .. import telemetry
+            telemetry.count_eval_batches()
+        except Exception:  # noqa: BLE001
+            pass
+    return {"count": count, "sums": dict(sums)}
+
+
+def merge_eval_results(store, num_shards: int, job: str = "eval",
+                       gens: Optional[list] = None) \
+        -> Dict[str, float]:
+    """Merge per-shard partials off the KV fabric into job-level
+    means: ``{metric: sum/count, ..., "count": total}``.  ``store``
+    is anything with the KV ``get`` verb (the dispatcher's in-process
+    store or a StoreClient).  ``gens`` lists the generations whose
+    partials to fold (default ``[0]``) — after a re-form, earlier
+    generations' acked partials still count, which is exactly the
+    exactly-once ledger contract."""
+    total = 0
+    sums: Dict[str, float] = {}
+    for gen in (gens if gens is not None else [0]):
+        for shard in range(int(num_shards)):
+            raw = store.get(_RESULT_KEY.format(job=job, gen=gen,
+                                               shard=shard))
+            if raw is None:
+                continue
+            part = pickle.loads(raw)
+            total += int(part.get("count", 0))
+            for metric, value in part.get("sums", {}).items():
+                sums[metric] = sums.get(metric, 0.0) + float(value)
+    out = {metric: (value / total if total else 0.0)
+           for metric, value in sums.items()}
+    out["count"] = total
+    return out

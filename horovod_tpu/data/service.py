@@ -21,20 +21,39 @@ import queue
 import secrets as _secrets
 import threading
 import time
+import traceback
 from dataclasses import dataclass
 from typing import Callable, Iterator, Optional
+
+from ..runner.http.http_server import RendezvousServer, local_ip
+from ..runner.http.http_client import StoreClient
 
 
 class _WorkerError:
     """Poison sentinel a compute worker publishes when its dataset
     iterator raises, so consumers fail loudly instead of treating the
-    truncated stream as clean end-of-data."""
+    truncated stream as clean end-of-data.  ``message`` carries the
+    worker's full traceback text — the consumer's raise happens in a
+    different process, so this string is the only debugging surface
+    the failure leaves behind."""
 
     def __init__(self, message: str):
         self.message = message
 
-from ..runner.http.http_server import RendezvousServer, local_ip
-from ..runner.http.http_client import StoreClient
+
+def _worker_error(exc):
+    """Format a producer-side failure with its traceback so every
+    consuming rank sees WHERE the iterator died, not just the class."""
+    return _WorkerError(
+        f"{type(exc).__name__}: {exc}\n{traceback.format_exc()}")
+
+
+def _count_wire(direction, nbytes):
+    try:
+        from .. import telemetry
+        telemetry.add_data_wire_bytes(direction, nbytes)
+    except Exception:  # noqa: BLE001 — accounting must never block data
+        pass
 
 
 @dataclass
@@ -153,11 +172,12 @@ class DataServiceServer:
                     time.sleep(0.005)
                 if self._stop.is_set():
                     return
-                store.put(f"/data/{w}/{seq}",
-                          pickle.dumps(batch, protocol=4))
+                blob = pickle.dumps(batch, protocol=4)
+                _count_wire("sent", len(blob))
+                store.put(f"/data/{w}/{seq}", blob)
                 seq += 1
         except BaseException as exc:  # noqa: BLE001 — forwarded
-            final = _WorkerError(f"{type(exc).__name__}: {exc}")
+            final = _worker_error(exc)
         finally:
             store.put(f"/data/{w}/{seq}", pickle.dumps(final, protocol=4))
 
@@ -198,11 +218,12 @@ def run_remote_worker(config: DataServiceConfig, worker_index: int,
                 time.sleep(0.05)
             if stop.is_set():
                 return
-            client.put(f"/data/{w}/{seq}",
-                       pickle.dumps(batch, protocol=4))
+            blob = pickle.dumps(batch, protocol=4)
+            _count_wire("sent", len(blob))
+            client.put(f"/data/{w}/{seq}", blob)
             seq += 1
     except BaseException as exc:  # noqa: BLE001 — forwarded
-        final = _WorkerError(f"{type(exc).__name__}: {exc}")
+        final = _worker_error(exc)
     finally:
         client.put(f"/data/{w}/{seq}", pickle.dumps(final, protocol=4))
 
@@ -255,6 +276,7 @@ def data_service(config: DataServiceConfig, rank: int = 0,
                     client.delete(f"/data/{w}/{seqs[w]}")
                     seqs[w] += 1
                     progressed = True
+                    _count_wire("received", len(raw))
                     batch = pickle.loads(raw)
                     if batch is None:        # worker exhausted
                         live.discard(w)

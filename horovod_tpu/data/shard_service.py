@@ -1,0 +1,599 @@
+"""Pod-scale sharded input service with journaled shard cursors.
+
+The reference ships no input service at all (arXiv:1802.05799 leaves
+``horovod/data/`` a thin loader shim), and the MLPerf TPU-pod
+playbook (arXiv:1909.09756) is explicit that past ~1k chips the fight
+moves off the gradient wire: input pipelines, eval and checkpoint
+stalls dominate step time.  :mod:`.service` already moves input CPU
+off the training hosts, but it streams round-robin with **no
+visitation guarantee** — a resize or worker death silently replays or
+drops samples.
+
+This module is the exactness layer on top of the same KV fabric:
+
+* :func:`plan_shards` — a deterministic seeded permutation of the
+  sample space split into contiguous shard assignments (same seed →
+  byte-identical plans, the ``ci.sh data`` evidence);
+* :class:`ShardLedger` — the cursor authority.  Every shard's
+  visitation cursor is journaled through the existing
+  :class:`~horovod_tpu.runner.http.journal.CoordJournal` machinery
+  (its OWN journal file — ``HOROVOD_DATA_SHARD_JOURNAL``), so a
+  resize, a preemption-to-zero suspend, or a shard-server death
+  re-forms the shard map from journaled cursors and **no sample is
+  replayed or dropped**;
+* :class:`ShardedDataService` — host-local shard servers, one thread
+  per shard, each owning its ledger partition and publishing
+  ``(index, sample)`` batches into per-shard KV slots with the same
+  delete-based flow control as :class:`.service.DataServiceServer`;
+* :func:`shard_consumer` — the training/eval-side iterator: consumes
+  one shard, acknowledges visitation counts back through the KV
+  fabric, and the ledger drains those acks into journaled cursors.
+
+Exactly-once contract (docs/data.md "Failure-mode matrix"): cursors
+advance only on consumer acknowledgement, and a re-form first drains
+the final acks from the surviving KV fabric — so a killed shard
+server's delivered-but-unacked tail is the ONLY replay window, and it
+is empty whenever consumers ack synchronously with consumption (the
+default).  A consumer that dies between visiting and acking re-reads
+its unacked tail in the next generation (at-least-once for consumer
+death; the drill's kill targets are shard servers and ranks mid-
+checkpoint, both exactly-once).
+"""
+
+import logging
+import pickle
+import queue
+import secrets as _secrets
+import threading
+import time
+from typing import Callable, Iterator, List, Optional
+
+from ..common import env as env_mod
+from ..runner.http.http_client import StoreClient
+from ..runner.http.http_server import RendezvousServer, local_ip
+from ..runner.http.journal import CoordJournal
+from .service import DataServiceConfig, _WorkerError, _count_wire, \
+    _worker_error
+
+logger = logging.getLogger("horovod_tpu")
+
+#: KV key namespaces (all under ``/data/`` so the COORDINATOR journal
+#: never records the batch stream — durability for cursors comes from
+#: the ledger's own journal, and acks are monotonic counters the
+#: consumers simply re-put after a coordinator restart).
+_BATCH_KEY = "/data/shard/{gen}/{shard}/{seq}"
+_ACK_KEY = "/data/ack/{gen}/{shard}"
+_PUB_KEY = "/data/pub/{gen}/{shard}"
+
+
+class ShardStalledError(RuntimeError):
+    """A shard server stopped producing mid-epoch (killed / wedged):
+    the consumer surfaces it so the driver can re-form the shard map
+    instead of treating the truncated stream as end-of-data."""
+
+    def __init__(self, shard, waited):
+        super().__init__(
+            f"shard server {shard} produced nothing for "
+            f"{waited:.1f}s (killed or wedged); re-form the shard "
+            f"map from the journaled cursors")
+        self.shard = shard
+
+
+def plan_shards(num_samples: int, num_shards: int, seed: int = 0,
+                epoch: int = 0) -> List[List[int]]:
+    """Deterministic shard plan: a seeded permutation of
+    ``range(num_samples)`` split into ``num_shards`` contiguous,
+    balanced chunks.  A pure function of (n, k, seed, epoch) — every
+    host computes the identical plan, and two same-seed runs journal
+    byte-identical ``dplan`` records."""
+    import random
+    if num_shards < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    idxs = list(range(int(num_samples)))
+    random.Random(f"{seed}:{epoch}").shuffle(idxs)
+    return _split(idxs, num_shards)
+
+
+def _split(idxs: List[int], k: int) -> List[List[int]]:
+    """Split ``idxs`` into ``k`` contiguous chunks, sizes differing by
+    at most one (the first ``len % k`` chunks take the extra)."""
+    n = len(idxs)
+    base, rem = divmod(n, k)
+    out, pos = [], 0
+    for s in range(k):
+        take = base + (1 if s < rem else 0)
+        out.append(idxs[pos:pos + take])
+        pos += take
+    return out
+
+
+class ShardLedger:
+    """Journaled shard-cursor authority.
+
+    One instance per data service (driver side).  State is the
+    current generation's shard plan plus one visitation cursor per
+    shard; every transition appends a record to a dedicated
+    :class:`CoordJournal` file, so a restarted service resumes from
+    the journal with nothing replayed and nothing dropped:
+
+    * ``dplan`` — a (re-)formed shard map: generation, epoch, seed,
+      sample count and the explicit per-shard assignment lists;
+    * ``dcur`` — one shard's cursor advanced to ``cur`` (samples
+      acknowledged from the front of its assignment);
+    * ``snap`` — size-triggered compaction (the journal machinery's
+      own record kind): the full ledger state, superseding history.
+
+    Records carry no wall-clock fields — two same-seed runs write
+    byte-identical journals, which ``tools/data_smoke.py`` asserts.
+    """
+
+    def __init__(self, path: Optional[str] = None,
+                 seed: Optional[int] = None):
+        path = path if path is not None else env_mod.get_str(
+            env_mod.HOROVOD_DATA_SHARD_JOURNAL)
+        self.journal = CoordJournal(path) if path else None
+        self.seed = int(seed) if seed is not None else env_mod.get_int(
+            env_mod.HOROVOD_DATA_SHARD_SEED, 0)
+        self.gen = -1               # no plan yet
+        self.epoch = 0
+        self.num_samples = 0
+        self.assign: List[List[int]] = []
+        self.cur: List[int] = []
+        if self.journal is not None:
+            self._replay()
+
+    # -- journal replay ------------------------------------------------------
+
+    def _state(self):
+        return {"gen": self.gen, "epoch": self.epoch,
+                "seed": self.seed, "n": self.num_samples,
+                "assign": self.assign, "cur": self.cur}
+
+    def _load_state(self, s):
+        self.gen = int(s["gen"])
+        self.epoch = int(s["epoch"])
+        self.seed = int(s["seed"])
+        self.num_samples = int(s["n"])
+        self.assign = [list(map(int, a)) for a in s["assign"]]
+        self.cur = list(map(int, s["cur"]))
+
+    def _replay(self):
+        for rec in self.journal.read():
+            k = rec.get("k")
+            if k == "snap":
+                self._load_state(rec["s"])
+            elif k == "dplan":
+                self._load_state({**rec, "cur": [0] * len(rec["assign"])})
+            elif k == "dcur":
+                if int(rec.get("gen", -2)) == self.gen:
+                    shard = int(rec["shard"])
+                    if 0 <= shard < len(self.cur):
+                        self.cur[shard] = max(self.cur[shard],
+                                              int(rec["cur"]))
+
+    def _append(self, rec):
+        if self.journal is None:
+            return
+        self.journal.append(rec)
+        if self.journal.needs_compaction():
+            self.journal.compact(self._state())
+
+    # -- planning ------------------------------------------------------------
+
+    def begin_epoch(self, num_samples: int, num_shards: int,
+                    epoch: int = 0) -> int:
+        """Install (or resume) the epoch's shard plan.  If the journal
+        already holds a plan for this (epoch, seed, n) the replayed
+        state — cursors included — is kept: a restarted service picks
+        up exactly where the acks left off."""
+        if (self.gen >= 0 and self.epoch == int(epoch)
+                and self.num_samples == int(num_samples)):
+            return self.gen
+        self.gen = self.gen + 1 if self.gen >= 0 else 0
+        self.epoch = int(epoch)
+        self.num_samples = int(num_samples)
+        self.assign = plan_shards(num_samples, num_shards,
+                                  seed=self.seed, epoch=epoch)
+        self.cur = [0] * len(self.assign)
+        self._append({"k": "dplan", "gen": self.gen,
+                      "epoch": self.epoch, "seed": self.seed,
+                      "n": self.num_samples, "assign": self.assign})
+        return self.gen
+
+    def reform(self, num_shards: int, reason: str = "resize") -> int:
+        """Re-form the shard map from the journaled cursors: the
+        unvisited remainder of every current shard — in shard order,
+        each from its acknowledged cursor — is re-split across
+        ``num_shards`` new servers at generation+1.  Nothing is
+        replayed (acked samples are behind the cursors) and nothing
+        is dropped (the remainder is the exact complement)."""
+        remainder = [i for s, a in enumerate(self.assign)
+                     for i in a[self.cur[s]:]]
+        self.gen += 1
+        self.assign = _split(remainder, num_shards)
+        self.cur = [0] * len(self.assign)
+        self._append({"k": "dplan", "gen": self.gen,
+                      "epoch": self.epoch, "seed": self.seed,
+                      "n": self.num_samples, "assign": self.assign})
+        try:
+            from .. import telemetry
+            telemetry.count_data_reform(reason)
+        except Exception:  # noqa: BLE001 — accounting never blocks
+            pass
+        return self.gen
+
+    # -- cursor advancement --------------------------------------------------
+
+    def advance_to(self, shard: int, cur: int):
+        """Advance one shard's cursor to the acknowledged absolute
+        position within the current generation's assignment (monotonic
+        — stale or duplicate acks are no-ops, which is what makes the
+        consumers' re-put-after-coordinator-restart safe)."""
+        cur = min(int(cur), len(self.assign[shard]))
+        if cur <= self.cur[shard]:
+            return
+        delta = cur - self.cur[shard]
+        self.cur[shard] = cur
+        self._append({"k": "dcur", "gen": self.gen,
+                      "shard": int(shard), "cur": cur})
+        try:
+            from .. import telemetry
+            telemetry.count_data_samples("acked", delta)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def assignments(self, shard: int) -> List[int]:
+        """The shard's unvisited remainder (current generation)."""
+        return self.assign[shard][self.cur[shard]:]
+
+    def remaining(self) -> int:
+        return sum(len(a) - c for a, c in zip(self.assign, self.cur))
+
+    def close(self):
+        if self.journal is not None:
+            self.journal.close()
+
+
+class ShardedDataService:
+    """Shard servers + ledger over one KV dispatcher.
+
+    ``sample_fn(index) -> sample`` materializes one sample by global
+    index (the deterministic twin of the reference's
+    ``dataset_fn(worker, num_workers)`` — indexability is what makes
+    exactly-once testable).  Each shard server thread publishes
+    ``[(index, sample), ...]`` batches to its per-shard KV slots; the
+    consumer acks visitation counts; :meth:`drain_acks` folds them
+    into the journaled ledger.
+
+    Chaos: ``kill_shard_server`` events from the seeded fault plan
+    (``HOROVOD_FAULT_PLAN``) are armed by the service itself — the
+    targeted shard's publish loop dies abruptly after ``after_samples``
+    published samples, with no end-of-shard sentinel, exactly like a
+    preempted input host.  Fired events land in :attr:`fired` (the
+    deterministic evidence ``tools/data_smoke.py`` byte-compares).
+    """
+
+    def __init__(self, sample_fn: Callable[[int], object],
+                 num_samples: int, num_shards: int,
+                 batch_size: int = 4, queue_size: Optional[int] = None,
+                 seed: Optional[int] = None,
+                 journal_path: Optional[str] = None,
+                 ack_poll_seconds: Optional[float] = None,
+                 secret: bytes = None, reuse_server=None):
+        self.sample_fn = sample_fn
+        self.num_samples = int(num_samples)
+        self.num_shards = int(num_shards)
+        self.batch_size = max(1, int(batch_size))
+        self.queue_size = int(queue_size) if queue_size is not None \
+            else env_mod.get_int(env_mod.HOROVOD_DATA_QUEUE_SIZE, 8)
+        # cadence for the background ack drainer (0 = disabled: acks
+        # are folded into the journal only at reform/suspend/explicit
+        # drain_acks, which keeps same-seed journals byte-identical —
+        # a periodic drain journals timing-dependent intermediate
+        # cursors in exchange for a bounded replay window)
+        self.ack_poll_seconds = float(ack_poll_seconds) \
+            if ack_poll_seconds is not None else env_mod.get_float(
+                env_mod.HOROVOD_DATA_ACK_POLL_SECONDS, 0.0)
+        self.ledger = ShardLedger(path=journal_path, seed=seed)
+        self._secret = secret or _secrets.token_bytes(16)
+        self._server = reuse_server or RendezvousServer(
+            secret=self._secret)
+        self._owns_server = reuse_server is None
+        self._port = None
+        self._stop = threading.Event()
+        self._drain_lock = threading.Lock()
+        self._drain_thread = None   # background ack drainer (optional)
+        self._threads = {}          # shard -> Thread (current gen)
+        self._kills = {}            # shard -> Event (abrupt death)
+        self.fired = []             # chaos evidence (deterministic)
+        self._fired_lock = threading.Lock()
+        self._data_events = []      # [_EventState-like armed events]
+        self._arm_fault_plan()
+
+    # -- chaos ---------------------------------------------------------------
+
+    def _arm_fault_plan(self):
+        from ..chaos import plan as plan_mod
+        try:
+            plan = plan_mod.plan_from_env()
+        except Exception:  # noqa: BLE001 — a malformed plan fails the
+            # launcher loudly already; the data service must not crash
+            # on a plan aimed at other tiers
+            return
+        if plan is None:
+            return
+        from ..chaos.inject import _EventState
+        self._data_events = [
+            _EventState(e, plan.rng_for(e))
+            for e in plan.data_events()]
+
+    def _maybe_kill(self, shard: int, published: int) -> bool:
+        """Check armed kill_shard_server events against this shard's
+        published-sample count; fire at most one."""
+        for st in self._data_events:
+            e = st.event
+            if e.proc != shard or st.exhausted:
+                continue
+            if st.due(published):
+                from ..chaos.inject import _count_injected
+                rec = {"kind": e.kind, "event": e.index,
+                       "trigger": e.trigger, "n": e.at,
+                       "shard": shard, "gen": self.ledger.gen}
+                with self._fired_lock:
+                    self.fired.append(rec)
+                _count_injected(e.kind)
+                logger.warning(
+                    "chaos: injecting %s (event #%d, samples=%d, "
+                    "shard %d)", e.kind, e.index, published, shard)
+                self._kills.setdefault(
+                    shard, threading.Event()).set()
+                return True
+        return False
+
+    # -- service side --------------------------------------------------------
+
+    def start(self, port: int = 0) -> DataServiceConfig:
+        if self._owns_server:
+            self._port = self._server.start(port)
+        else:
+            self._port = self._server.port
+        return DataServiceConfig(
+            addr=local_ip(), port=self._port,
+            secret_hex=self._secret.hex(),
+            num_workers=self.num_shards)
+
+    def begin_epoch(self, epoch: int = 0) -> int:
+        gen = self.ledger.begin_epoch(self.num_samples,
+                                      self.num_shards, epoch=epoch)
+        self._spawn_all(gen)
+        self._start_drainer()
+        return gen
+
+    def _start_drainer(self):
+        if self.ack_poll_seconds <= 0:
+            return
+        if self._drain_thread is not None \
+                and self._drain_thread.is_alive():
+            return
+        t = threading.Thread(target=self._drain_loop,
+                             name="data-ack-drain", daemon=True)
+        t.start()
+        self._drain_thread = t
+
+    def _drain_loop(self):
+        while not self._stop.wait(self.ack_poll_seconds):
+            try:
+                self.drain_acks()
+            except Exception:  # noqa: BLE001 — a transient KV error
+                # must not kill the drainer; the next tick retries
+                logger.debug("background ack drain failed",
+                             exc_info=True)
+
+    def _spawn_all(self, gen: int):
+        self._threads = {}
+        self._kills = {}
+        for shard in range(len(self.ledger.assign)):
+            self._kills[shard] = threading.Event()
+            t = threading.Thread(
+                target=self._produce,
+                args=(gen, shard, self.ledger.assignments(shard),
+                      self._kills[shard]),
+                name=f"data-shard-{gen}-{shard}", daemon=True)
+            t.start()
+            self._threads[shard] = t
+
+    def _produce(self, gen: int, shard: int, assignment: List[int],
+                 kill: threading.Event):
+        store = self._server.store
+        batches = [assignment[i:i + self.batch_size]
+                   for i in range(0, len(assignment), self.batch_size)]
+        self._publish(gen, shard, batches, kill, store)
+
+    def _publish(self, gen: int, shard: int, batches, kill, store):
+        seq = 0
+        last_deleted = 0
+        published = 0
+        for batch in batches:
+            # bound the pipeline: wait for the consumer to delete the
+            # batch `queue_size` slots back (same flow control as
+            # DataServiceServer._produce)
+            while not (self._stop.is_set() or kill.is_set()):
+                if seq < self.queue_size or store.get(_BATCH_KEY.format(
+                        gen=gen, shard=shard,
+                        seq=seq - self.queue_size)) is None:
+                    break
+                time.sleep(0.005)
+            # chaos: an armed kill fires BEFORE the next publish — the
+            # shard dies abruptly, staged tail undelivered, no sentinel
+            self._maybe_kill(shard, published)
+            if self._stop.is_set() or kill.is_set():
+                return
+            try:
+                payload = [(idx, self.sample_fn(idx)) for idx in batch]
+                blob = pickle.dumps(payload, protocol=4)
+            except BaseException as exc:  # noqa: BLE001 — forwarded:
+                # the consumer must fail loudly with the producer's
+                # traceback, not see truncated-stream EOF
+                store.put(
+                    _BATCH_KEY.format(gen=gen, shard=shard, seq=seq),
+                    pickle.dumps(_worker_error(exc), protocol=4))
+                return
+            _count_wire("sent", len(blob))
+            store.put(_BATCH_KEY.format(gen=gen, shard=shard, seq=seq),
+                      blob)
+            seq += 1
+            published += len(batch)
+            store.put(_PUB_KEY.format(gen=gen, shard=shard),
+                      str(published).encode("ascii"))
+            while last_deleted < seq and store.get(_BATCH_KEY.format(
+                    gen=gen, shard=shard, seq=last_deleted)) is None:
+                last_deleted += 1
+            try:
+                from .. import telemetry
+                telemetry.set_data_queue_depth(shard,
+                                               seq - last_deleted)
+            except Exception:  # noqa: BLE001
+                pass
+        if self._stop.is_set() or kill.is_set():
+            return
+        # clean end of shard
+        store.put(_BATCH_KEY.format(gen=gen, shard=shard, seq=seq),
+                  pickle.dumps(None, protocol=4))
+
+    def alive(self, shard: int) -> bool:
+        t = self._threads.get(shard)
+        return t is not None and t.is_alive() \
+            and not self._kills[shard].is_set()
+
+    def kill_shard(self, shard: int):
+        """Abrupt shard-server death (the chaos drill's direct hook):
+        the thread stops before its next publish, staged batches stay
+        undelivered, no end-of-shard sentinel is written."""
+        self._kills.setdefault(shard, threading.Event()).set()
+
+    # -- cursor plumbing -----------------------------------------------------
+
+    def drain_acks(self):
+        """Fold consumer acks from the KV fabric into journaled
+        cursors, and export per-shard cursor lag (published − acked,
+        the bounded replay window a coordinator crash could cost).
+
+        Serialized under a lock: the optional background drainer
+        (``HOROVOD_DATA_ACK_POLL_SECONDS``) and :meth:`reform`'s final
+        drain may otherwise interleave journal appends."""
+        with self._drain_lock:
+            self._drain_acks_locked()
+
+    def _drain_acks_locked(self):
+        store = self._server.store
+        gen = self.ledger.gen
+        for shard in range(len(self.ledger.assign)):
+            raw = store.get(_ACK_KEY.format(gen=gen, shard=shard))
+            if raw is not None:
+                try:
+                    self.ledger.advance_to(shard, int(raw.decode()))
+                except (ValueError, UnicodeDecodeError):
+                    logger.warning("malformed data ack for shard %d: "
+                                   "%r", shard, raw)
+            pub = store.get(_PUB_KEY.format(gen=gen, shard=shard))
+            if pub is not None:
+                try:
+                    lag = int(pub.decode()) - self.ledger.cur[shard]
+                    from .. import telemetry
+                    telemetry.set_data_cursor_lag(shard, max(0, lag))
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def reform(self, num_shards: Optional[int] = None,
+               reason: str = "resize") -> int:
+        """Stop the current generation's servers, drain the final acks
+        out of the surviving KV fabric, re-form the shard map from the
+        journaled cursors, and start generation+1's servers.  One
+        mechanism for every membership change: resize (``num_shards``
+        changed), shard-server death (``reason='server_death'``), and
+        resume from a preemption-to-zero suspend
+        (``reason='resume'``)."""
+        for ev in self._kills.values():
+            ev.set()
+        for t in self._threads.values():
+            t.join(timeout=5)
+        self.drain_acks()
+        k = int(num_shards) if num_shards is not None \
+            else self.num_shards
+        self.num_shards = k
+        gen = self.ledger.reform(k, reason=reason)
+        self._spawn_all(gen)
+        return gen
+
+    def suspend(self):
+        """Preemption-to-zero: stop every server and journal the final
+        cursors.  A later :meth:`reform` (``reason='resume'``) — or a
+        fresh service pointed at the same journal — continues with
+        nothing replayed or dropped."""
+        for ev in self._kills.values():
+            ev.set()
+        for t in self._threads.values():
+            t.join(timeout=5)
+        self.drain_acks()
+
+    def stop(self):
+        self._stop.set()
+        for ev in self._kills.values():
+            ev.set()
+        for t in self._threads.values():
+            t.join(timeout=5)
+        if self._drain_thread is not None:
+            self._drain_thread.join(timeout=5)
+            self._drain_thread = None
+        if self._owns_server:
+            self._server.stop()
+        self.ledger.close()
+
+
+def shard_consumer(config: DataServiceConfig, shard: int,
+                   gen: int = 0, timeout: float = 30.0,
+                   client: Optional[StoreClient] = None) -> Iterator:
+    """Consume one shard of one generation: yields ``(index, sample)``
+    and acknowledges visitation counts into the KV fabric after each
+    batch (the ledger's :meth:`~ShardedDataService.drain_acks` folds
+    them into journaled cursors).
+
+    Raises :class:`ShardStalledError` when the shard server stops
+    producing mid-epoch (killed / wedged) so the driver re-forms the
+    shard map instead of treating the truncated stream as clean EOF.
+    """
+    if isinstance(config, dict):
+        config = DataServiceConfig.from_dict(config)
+    client = client or StoreClient(config.addr, config.port,
+                                   bytes.fromhex(config.secret_hex))
+    seq = 0
+    consumed = 0
+    while True:
+        deadline = time.monotonic() + timeout
+        raw = None
+        while raw is None:
+            raw = client.get(
+                _BATCH_KEY.format(gen=gen, shard=shard, seq=seq),
+                wait=min(2.0, timeout))
+            if raw is None and time.monotonic() > deadline:
+                raise ShardStalledError(shard, timeout)
+        client.delete(_BATCH_KEY.format(gen=gen, shard=shard, seq=seq))
+        seq += 1
+        _count_wire("received", len(raw))
+        batch = pickle.loads(raw)
+        if batch is None:           # clean end of shard
+            return
+        if isinstance(batch, _WorkerError):
+            raise RuntimeError(
+                f"shard server {shard} failed: {batch.message}")
+        for idx, sample in batch:
+            yield idx, sample
+            consumed += 1
+        try:
+            from .. import telemetry
+            telemetry.count_data_samples("delivered", len(batch))
+        except Exception:  # noqa: BLE001
+            pass
+        # monotonic ack: safe to re-put after a coordinator restart
+        client.put(_ACK_KEY.format(gen=gen, shard=shard),
+                   str(consumed).encode("ascii"))

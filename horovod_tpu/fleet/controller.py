@@ -190,7 +190,10 @@ class ManagedJob:
         self.state = PENDING
         self.np = 0                  # currently allocated slots
         self.alloc = {}              # {host: slots}
-        self.demand = spec.max_np if spec.kind == "training" \
+        # training AND eval soak surplus chips up to max_np (both
+        # return them on demand — preemption-by-elasticity); only a
+        # serving job's demand moves with its SLO signals
+        self.demand = spec.max_np if spec.kind != "serving" \
             else spec.min_np
         self.server = None
         self.driver = None
@@ -620,9 +623,18 @@ class FleetController:
 
     def _payload_total(self, job, fams):
         """Goodput units in ONE pushed snapshot: elastic commits for
-        training, ok-requests for serving."""
+        training, eval batches for eval, ok-requests for serving."""
         if job.spec.kind == "training":
             fam = fams.get(telemetry.ELASTIC_COMMITS_FAMILY)
+            if not fam:
+                return 0.0
+            return sum(float(s.get("value", 0.0))
+                       for s in fam.get("samples", []))
+        if job.spec.kind == "eval":
+            # the eval goodput unit: batches scored against journaled
+            # eval-shard cursors (data/evaluation.py) — counted per
+            # job exactly like training commits
+            fam = fams.get(telemetry.EVAL_BATCHES_FAMILY)
             if not fam:
                 return 0.0
             return sum(float(s.get("value", 0.0))
@@ -824,12 +836,13 @@ class FleetController:
                 job.alloc = dict(host_slots)
                 job.discovery.set_slots(host_slots)
             return
-        # discretionary growth is rate-limited for TRAINING jobs (the
-        # greedy idle-chip reclaim must not thrash rounds when
-        # capacity flaps); serving growth is already hysteretic at the
-        # demand level (AutoscalePolicy breach streaks + cooldown),
-        # and capacity loss / SLO shrink always apply immediately
-        if grew and job.spec.kind == "training" and \
+        # discretionary growth is rate-limited for TRAINING and EVAL
+        # jobs (both greedily reclaim idle chips, so the reclaim must
+        # not thrash rounds when capacity flaps); serving growth is
+        # already hysteretic at the demand level (AutoscalePolicy
+        # breach streaks + cooldown), and capacity loss / SLO shrink
+        # always apply immediately
+        if grew and job.spec.kind in ("training", "eval") and \
                 job.state == RUNNING and \
                 tick - job.last_change_tick < opts.cooldown_ticks:
             return

@@ -28,12 +28,12 @@ Semantics the controller enforces (docs/fleet.md "Reconciliation"):
   serving jobs first (they carry live traffic), then by descending
   ``priority``, then spec order;
 * surplus capacity goes to each job's *demand* in the same order —
-  a serving job's demand moves with its SLO signals, a training
-  job's demand is ``max_np`` (training soaks up idle chips and
-  returns them on demand: preemption-by-elasticity);
-* a training job whose ``min_np`` cannot be met is **suspended**
-  (preempted to zero — a control-plane pause, never a kill); it
-  resumes when capacity returns.
+  a serving job's demand moves with its SLO signals, a training or
+  eval job's demand is ``max_np`` (both soak up idle chips and
+  return them on demand: preemption-by-elasticity);
+* a training or eval job whose ``min_np`` cannot be met is
+  **suspended** (preempted to zero — a control-plane pause, never a
+  kill); it resumes when capacity returns.
 """
 
 import json
@@ -42,7 +42,13 @@ from typing import Dict, List, Optional
 
 from ..chaos.plan import read_plan_source
 
-JOB_KINDS = ("training", "serving")
+#: ``eval`` is the distributed-eval job kind (docs/data.md): the
+#: controller gang-places it like training (it soaks surplus chips up
+#: to max_np, suspends below min_np), its workers score batches
+#: against journaled eval-shard cursors, and its goodput is the
+#: eval-batch counter (``horovod_eval_batches_total``) — counted per
+#: job exactly like training commits.
+JOB_KINDS = ("training", "serving", "eval")
 
 
 @dataclass
@@ -50,7 +56,7 @@ class JobSpec:
     """One job of the fleet."""
 
     name: str
-    kind: str                       # training | serving
+    kind: str                       # training | serving | eval
     command: List[str]
     min_np: int = 1
     max_np: int = 1

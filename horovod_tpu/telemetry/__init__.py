@@ -343,6 +343,54 @@ KV_BLOCKS_IN_USE_HELP = (
     "Paged KV cache blocks currently allocated to live decode "
     "slots; must return to 0 on drain (leak check)")
 
+# -- pod-scale data plane (docs/data.md): the journaled shard
+#    service's wire/queue/cursor families, the eval-job goodput unit
+#    the fleet controller aggregates for kind=eval, and the async
+#    CRC-anchored checkpoint accounting.  One definition here — the
+#    shard ledger, the data servers, tools/data_smoke.py and the
+#    scale harness's data-plane phase all import it.
+
+DATA_WIRE_BYTES_FAMILY = "horovod_data_wire_bytes_total"
+DATA_WIRE_BYTES_HELP = (
+    "Serialized sample-batch bytes moved by the data service "
+    "(shard server -> consumer), by direction (sent | received)")
+DATA_WIRE_BYTES_LABELS = ("direction",)
+DATA_QUEUE_DEPTH_FAMILY = "horovod_data_queue_depth"
+DATA_QUEUE_DEPTH_HELP = (
+    "Batches currently staged ahead of consumption, per shard "
+    "server (the input-bound backpressure signal)")
+DATA_QUEUE_DEPTH_LABELS = ("shard",)
+DATA_CURSOR_LAG_FAMILY = "horovod_data_cursor_lag"
+DATA_CURSOR_LAG_HELP = (
+    "Samples delivered to consumers but not yet acknowledged into "
+    "the journaled shard cursor, per shard (the bounded-replay "
+    "window a coordinator crash could replay)")
+DATA_CURSOR_LAG_LABELS = ("shard",)
+DATA_SAMPLES_FAMILY = "horovod_data_samples_total"
+DATA_SAMPLES_HELP = (
+    "Samples through the sharded input service, by outcome "
+    "(delivered = handed to a consumer, acked = cursor journaled)")
+DATA_SAMPLES_LABELS = ("outcome",)
+DATA_REFORMS_FAMILY = "horovod_data_shard_reforms_total"
+DATA_REFORMS_HELP = (
+    "Shard-map re-formations from journaled cursors (resize, shard-"
+    "server death, resume from suspend), by reason")
+DATA_REFORMS_LABELS = ("reason",)
+EVAL_BATCHES_FAMILY = "horovod_eval_batches_total"
+EVAL_BATCHES_HELP = (
+    "Eval batches scored against journaled eval-shard cursors — the "
+    "eval-job goodput unit the fleet controller aggregates per job")
+CKPT_ASYNC_COMMITS_FAMILY = "horovod_ckpt_async_commits_total"
+CKPT_ASYNC_COMMITS_HELP = (
+    "Async checkpoint commit outcomes (anchored = all shards landed "
+    "and the commit record journaled, torn = a save died before "
+    "anchoring, fallback = restore skipped past a torn save)")
+CKPT_ASYNC_COMMITS_LABELS = ("outcome",)
+CKPT_SHARD_BYTES_FAMILY = "horovod_ckpt_shard_bytes_total"
+CKPT_SHARD_BYTES_HELP = (
+    "CRC-trailed checkpoint shard bytes streamed to the store by "
+    "the async checkpointer's background thread")
+
 
 def account_alltoall_bytes(hop, wire, logical, actual):
     """Accumulate one alltoall hop's logical and wire bytes, into the
@@ -499,6 +547,74 @@ def set_kv_blocks_in_use(n):
     into the process-current registry."""
     registry().gauge(KV_BLOCKS_IN_USE_FAMILY,
                      KV_BLOCKS_IN_USE_HELP).set(int(n))
+
+
+def add_data_wire_bytes(direction, nbytes):
+    """Accumulate serialized data-service bytes for ``direction``
+    ('sent' | 'received'), into the process-current registry."""
+    registry().counter(
+        DATA_WIRE_BYTES_FAMILY, DATA_WIRE_BYTES_HELP,
+        labelnames=DATA_WIRE_BYTES_LABELS).labels(
+        direction=direction).inc(int(nbytes))
+
+
+def set_data_queue_depth(shard, depth):
+    """Current staged-batch depth for one shard server, into the
+    process-current registry."""
+    registry().gauge(
+        DATA_QUEUE_DEPTH_FAMILY, DATA_QUEUE_DEPTH_HELP,
+        labelnames=DATA_QUEUE_DEPTH_LABELS).labels(
+        shard=str(shard)).set(int(depth))
+
+
+def set_data_cursor_lag(shard, lag):
+    """Delivered-but-unacked sample count for one shard, into the
+    process-current registry."""
+    registry().gauge(
+        DATA_CURSOR_LAG_FAMILY, DATA_CURSOR_LAG_HELP,
+        labelnames=DATA_CURSOR_LAG_LABELS).labels(
+        shard=str(shard)).set(int(lag))
+
+
+def count_data_samples(outcome, n=1):
+    """``n`` samples through the sharded input service under
+    ``outcome`` ('delivered' | 'acked'), into the process-current
+    registry."""
+    registry().counter(
+        DATA_SAMPLES_FAMILY, DATA_SAMPLES_HELP,
+        labelnames=DATA_SAMPLES_LABELS).labels(
+        outcome=outcome).inc(int(n))
+
+
+def count_data_reform(reason):
+    """One shard-map re-formation from journaled cursors, into the
+    process-current registry."""
+    registry().counter(
+        DATA_REFORMS_FAMILY, DATA_REFORMS_HELP,
+        labelnames=DATA_REFORMS_LABELS).labels(reason=reason).inc()
+
+
+def count_eval_batches(n=1):
+    """``n`` eval batches scored — the eval goodput unit, into the
+    process-current registry."""
+    registry().counter(EVAL_BATCHES_FAMILY,
+                       EVAL_BATCHES_HELP).inc(int(n))
+
+
+def count_ckpt_commit(outcome):
+    """One async-checkpoint commit outcome ('anchored' | 'torn' |
+    'fallback'), into the process-current registry."""
+    registry().counter(
+        CKPT_ASYNC_COMMITS_FAMILY, CKPT_ASYNC_COMMITS_HELP,
+        labelnames=CKPT_ASYNC_COMMITS_LABELS).labels(
+        outcome=outcome).inc()
+
+
+def add_ckpt_shard_bytes(nbytes):
+    """Accumulate CRC-trailed checkpoint shard bytes streamed by the
+    async checkpointer, into the process-current registry."""
+    registry().counter(CKPT_SHARD_BYTES_FAMILY,
+                       CKPT_SHARD_BYTES_HELP).inc(int(nbytes))
 
 
 def metrics():

@@ -18,10 +18,20 @@ Two layers:
 * :func:`save_rank0` / :func:`load_and_broadcast` — the reference's
   rank-0-writes + broadcast-on-restore convention for host-side
   (numpy/torch) states in multi-controller jobs.
+* :class:`AsyncCheckpointer` — pod-scale async CRC-anchored
+  checkpointing (docs/data.md): each rank streams its CRC-trailed
+  shard from a background thread while training continues, and the
+  commit record is journaled only when ALL shards land — a torn save
+  is invisible to restore, which falls back to the previous anchored
+  commit.
 """
 
+import glob
+import logging
 import os
-from typing import Any, Optional
+import re
+import threading
+from typing import Any, Dict, List, Optional, Tuple
 
 
 class CheckpointManager:
@@ -102,6 +112,11 @@ class _CrcWriter:
     def write(self, b):
         import zlib
 
+        # protocol-5 picklers hand over PickleBuffer objects (numpy
+        # arrays take this path); normalize to a C-contiguous bytes
+        # view before hashing/counting
+        if not isinstance(b, bytes):
+            b = memoryview(b).cast("B")
         self.crc = zlib.crc32(b, self.crc)
         self.length += len(b)
         return self._f.write(b)
@@ -237,3 +252,259 @@ def load_and_broadcast(path: str, root_rank: int = 0) -> Any:
         raise CheckpointLoadError(
             f"checkpoint {path} deserialization failed after digest "
             f"verification: {type(exc).__name__}: {exc}") from exc
+
+
+# -- async CRC-anchored checkpointing (docs/data.md) -------------------------
+
+_STEP_DIR_RE = re.compile(r"^step_(\d+)$")
+_SHARD_RE = re.compile(r"^shard_(\d+)\.pkl$")
+
+
+class AsyncCheckpointer:
+    """Async sharded checkpointing with a journaled commit anchor.
+
+    The MLPerf TPU-pod playbook (arXiv:1909.09756) counts checkpoint
+    stalls among the off-wire costs that dominate pod-scale step time;
+    this class takes the write off the step path.  Each rank streams
+    its shard — :func:`save_rank0`'s CRC-trailer format, tmp +
+    ``os.replace`` so a shard is either absent or complete — from a
+    background thread while training continues.  The step's commit
+    record (``{"k": "ckpt", "step": N, "world": W}``) is appended to a
+    :class:`~horovod_tpu.runner.http.journal.CoordJournal` at
+    ``<directory>/commits.journal`` **only once every shard is present
+    and CRC-valid**, so a rank SIGKILLed mid-save leaves a torn step
+    that restore never sees — it falls back to the previous anchored
+    commit (``horovod_ckpt_async_commits_total`` counts anchored /
+    torn / fallback outcomes).
+
+    Restore returns every rank's shard, so recovery composes with the
+    elastic re-shard path: a job restarted at a different world size
+    redistributes the ``world``-sharded states exactly like an elastic
+    resize does (docs/elastic.md).
+
+    One process (``committer=True``, default rank 0) owns the commit
+    journal; peers only write shards and read anchors.  Set
+    ``HOROVOD_DATA_ASYNC_CKPT=0`` to force inline (synchronous) saves
+    — same layout and anchoring, no background thread.
+    """
+
+    def __init__(self, directory: str, rank: int = 0, world: int = 1,
+                 committer: Optional[bool] = None,
+                 commit_timeout: float = 60.0):
+        from ..common import env as env_mod
+        from ..runner.http.journal import CoordJournal
+
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.rank = int(rank)
+        self.world = int(world)
+        self.committer = (self.rank == 0) if committer is None \
+            else bool(committer)
+        self.commit_timeout = float(commit_timeout)
+        self._async = env_mod.get_bool(
+            env_mod.HOROVOD_DATA_ASYNC_CKPT, True)
+        self._journal = CoordJournal(
+            os.path.join(self.directory, "commits.journal"))
+        self._inflight: List[threading.Thread] = []
+        self._lock = threading.Lock()
+
+    # -- paths ---------------------------------------------------------------
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{int(step):08d}")
+
+    def _shard_path(self, step: int, rank: int) -> str:
+        return os.path.join(self._step_dir(step),
+                            f"shard_{int(rank):05d}.pkl")
+
+    # -- saving --------------------------------------------------------------
+
+    def save(self, step: int, state: Any, wait: bool = False):
+        """Write this rank's shard for ``step``.  Async by default:
+        the CRC-trailed stream rides a background thread and the call
+        returns immediately (``wait=True`` or :meth:`wait` joins it).
+        The committer's thread then polls for the full shard set and
+        anchors the commit."""
+        if not self._async:
+            self._save_shard(step, state)
+            if self.committer:
+                self._await_commit(step)
+            return
+        t = threading.Thread(
+            target=self._save_and_commit, args=(step, state),
+            name=f"ckpt-async-{step}-r{self.rank}", daemon=True)
+        with self._lock:
+            self._inflight = [x for x in self._inflight
+                              if x.is_alive()]
+            self._inflight.append(t)
+        t.start()
+        if wait:
+            t.join()
+
+    def _save_and_commit(self, step: int, state: Any):
+        try:
+            self._save_shard(step, state)
+            if self.committer:
+                self._await_commit(step)
+        except Exception:  # noqa: BLE001 — a failed async save must
+            # not kill training; the step simply never anchors and
+            # restore falls back (logged for the operator)
+            logging.getLogger("horovod_tpu").exception(
+                "async checkpoint save for step %d failed", step)
+
+    def _save_shard(self, step: int, state: Any):
+        import pickle
+
+        from ..core import integrity as integrity_mod
+
+        path = self._shard_path(step, self.rank)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            w = _CrcWriter(f)
+            pickle.dump(state, w, protocol=pickle.HIGHEST_PROTOCOL)
+            f.write(integrity_mod.crc_trailer(w.length, w.crc))
+        os.replace(tmp, path)
+        try:
+            from .. import telemetry
+            telemetry.add_ckpt_shard_bytes(w.length)
+        except Exception:  # noqa: BLE001 — accounting never blocks
+            pass
+
+    def _await_commit(self, step: int):
+        import time
+
+        deadline = time.monotonic() + self.commit_timeout
+        while time.monotonic() < deadline:
+            if self.commit_if_complete(step):
+                return
+            time.sleep(0.05)
+        logging.getLogger("horovod_tpu").warning(
+            "checkpoint step %d never completed (%d/%d shards after "
+            "%.0fs); leaving unanchored — restore will fall back",
+            step, len(self._present_shards(step)), self.world,
+            self.commit_timeout)
+
+    def _present_shards(self, step: int) -> List[int]:
+        d = self._step_dir(step)
+        if not os.path.isdir(d):
+            return []
+        out = []
+        for name in os.listdir(d):
+            m = _SHARD_RE.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def commit_if_complete(self, step: int) -> bool:
+        """Anchor ``step`` if every rank's shard is present and
+        CRC-valid.  Idempotent; only the committer appends.  This is
+        THE anchoring rule: no shard set, no commit record — a torn
+        save can never be restored."""
+        if step in self.anchored_steps():
+            return True
+        present = self._present_shards(step)
+        if present != list(range(self.world)):
+            return False
+        for r in present:
+            try:
+                read_verified(self._shard_path(step, r))
+            except Exception:  # noqa: BLE001 — torn/corrupt shard:
+                # not complete, not anchorable
+                return False
+        if not self.committer:
+            return False
+        self._journal.append({"k": "ckpt", "step": int(step),
+                              "world": self.world})
+        try:
+            from .. import telemetry
+            telemetry.count_ckpt_commit("anchored")
+        except Exception:  # noqa: BLE001
+            pass
+        return True
+
+    def wait(self):
+        """Join every in-flight background save."""
+        with self._lock:
+            inflight = list(self._inflight)
+        for t in inflight:
+            t.join()
+
+    def close(self):
+        self.wait()
+        self._journal.close()
+
+    # -- restore -------------------------------------------------------------
+
+    def anchored_steps(self) -> List[int]:
+        """Steps with a journaled commit record, ascending."""
+        steps = set()
+        for rec in self._journal.read():
+            if rec.get("k") == "ckpt":
+                steps.add(int(rec["step"]))
+            elif rec.get("k") == "snap":
+                for s in rec.get("s", {}).get("steps", []):
+                    steps.add(int(s))
+        return sorted(steps)
+
+    def _step_dirs(self) -> List[int]:
+        out = []
+        for p in glob.glob(os.path.join(self.directory, "step_*")):
+            m = _STEP_DIR_RE.match(os.path.basename(p))
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def restore_shards(self, step: Optional[int] = None) \
+            -> Tuple[int, Dict[int, Any]]:
+        """Restore the newest anchored commit (or ``step``): returns
+        ``(step, {rank: state})`` with every shard CRC-verified before
+        deserialization.  Unanchored step directories newer than the
+        chosen commit are counted torn and skipped — the fallback the
+        async contract promises.  The full shard dict composes with
+        elastic re-shard: a different world size redistributes the
+        shards instead of refusing."""
+        import pickle
+
+        anchored = self.anchored_steps()
+        if step is not None:
+            if int(step) not in anchored:
+                raise CheckpointLoadError(
+                    f"step {step} has no anchored commit under "
+                    f"{self.directory} (anchored: {anchored})")
+            chosen = int(step)
+        else:
+            if not anchored:
+                raise CheckpointLoadError(
+                    f"no anchored checkpoint commits under "
+                    f"{self.directory}")
+            chosen = anchored[-1]
+        torn = [s for s in self._step_dirs()
+                if s > chosen and s not in anchored]
+        try:
+            from .. import telemetry
+            for _ in torn:
+                telemetry.count_ckpt_commit("torn")
+            if torn:
+                telemetry.count_ckpt_commit("fallback")
+        except Exception:  # noqa: BLE001
+            pass
+        if torn:
+            logging.getLogger("horovod_tpu").warning(
+                "skipping torn (unanchored) checkpoint step(s) %s; "
+                "restoring anchored step %d", torn, chosen)
+        shards: Dict[int, Any] = {}
+        for r in self._present_shards(chosen):
+            blob = read_verified(self._shard_path(chosen, r))
+            shards[r] = pickle.loads(blob)
+        return chosen, shards
+
+    def restore_rank(self, rank: Optional[int] = None,
+                     step: Optional[int] = None) -> Tuple[int, Any]:
+        """This rank's shard of the newest anchored commit."""
+        r = self.rank if rank is None else int(rank)
+        chosen, shards = self.restore_shards(step)
+        if r not in shards:
+            raise CheckpointLoadError(
+                f"anchored step {chosen} has no shard for rank {r}")
+        return chosen, shards[r]

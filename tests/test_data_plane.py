@@ -1,0 +1,450 @@
+"""Pod-scale data plane: journaled shard cursors, exactly-once
+visitation across kills/resizes, distributed eval merge, async
+CRC-anchored checkpointing, and the prefetch seams (docs/data.md)."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.data import (
+    AsyncDataLoaderMixin, BaseDataLoader, DeviceFeeder, ShardLedger,
+    ShardStalledError, ShardedDataService, merge_eval_results,
+    plan_shards, run_eval_shard, shard_consumer,
+)
+from horovod_tpu.runner.http.http_client import StoreClient
+
+
+def _client(cfg):
+    return StoreClient(cfg.addr, cfg.port,
+                       bytes.fromhex(cfg.secret_hex))
+
+
+def _service(tmp_path, n=24, shards=3, name="shards.journal", **kw):
+    kw.setdefault("sample_fn", lambda i: i * 10)
+    kw.setdefault("batch_size", 4)
+    kw.setdefault("seed", 7)
+    return ShardedDataService(
+        num_samples=n, num_shards=shards,
+        journal_path=str(tmp_path / name), **kw)
+
+
+# -- shard planning + ledger --------------------------------------------------
+
+def test_plan_shards_deterministic_balanced():
+    a = plan_shards(23, 4, seed=5, epoch=1)
+    b = plan_shards(23, 4, seed=5, epoch=1)
+    assert a == b
+    assert sorted(x for s in a for x in s) == list(range(23))
+    sizes = [len(s) for s in a]
+    assert max(sizes) - min(sizes) <= 1
+    assert plan_shards(23, 4, seed=5, epoch=2) != a
+    assert plan_shards(23, 4, seed=6, epoch=1) != a
+
+
+def test_shard_ledger_journal_resume_and_reform(tmp_path):
+    path = str(tmp_path / "ledger.journal")
+    led = ShardLedger(path=path, seed=3)
+    gen = led.begin_epoch(10, 2, epoch=0)
+    assert gen == 0
+    led.advance_to(0, 3)
+    led.advance_to(0, 2)        # stale ack: no-op
+    assert led.cur == [3, 0]
+    led.close()
+
+    # a restarted service replays plan + cursors from the journal
+    led2 = ShardLedger(path=path, seed=3)
+    assert led2.begin_epoch(10, 2, epoch=0) == 0   # resumed, not new
+    assert led2.cur == [3, 0]
+    assert led2.remaining() == 7
+    remainder_before = set(led2.assignments(0)) | set(led2.assignments(1))
+    gen = led2.reform(3, reason="resize")
+    assert gen == 1
+    assert led2.cur == [0, 0, 0]
+    after = [x for s in range(3) for x in led2.assignments(s)]
+    assert sorted(after) == sorted(remainder_before)
+    assert len(after) == 7      # nothing replayed, nothing dropped
+    led2.close()
+
+
+def test_same_seed_ledgers_byte_identical(tmp_path):
+    blobs = []
+    for run in ("a", "b"):
+        path = str(tmp_path / f"{run}.journal")
+        led = ShardLedger(path=path, seed=11)
+        led.begin_epoch(16, 2, epoch=0)
+        led.advance_to(0, 4)
+        led.advance_to(1, 8)
+        led.reform(3, reason="resize")
+        led.advance_to(2, 1)
+        led.close()
+        with open(path, "rb") as f:
+            blobs.append(f.read())
+    assert blobs[0] == blobs[1]
+
+
+# -- sharded service: exactly-once visitation ---------------------------------
+
+def test_sharded_service_exactly_once_clean_epoch(tmp_path):
+    svc = _service(tmp_path)
+    cfg = svc.start()
+    try:
+        gen = svc.begin_epoch()
+        seen = []
+        for shard in range(3):
+            for idx, sample in shard_consumer(cfg, shard, gen=gen,
+                                              timeout=10,
+                                              client=_client(cfg)):
+                assert sample == idx * 10
+                seen.append(idx)
+        assert sorted(seen) == list(range(24))
+        svc.drain_acks()
+        assert svc.ledger.remaining() == 0
+    finally:
+        svc.stop()
+
+
+def test_server_death_reform_exactly_once(tmp_path):
+    """Kill one shard server mid-epoch: its consumer stalls loudly,
+    the re-formed generation serves exactly the unacked remainder."""
+    # queue_size=2: the server cannot run ahead to the end sentinel,
+    # so a kill leaves an undelivered tail (the interesting case)
+    svc = _service(tmp_path, n=24, shards=2, batch_size=2,
+                   queue_size=2)
+    cfg = svc.start()
+    try:
+        gen = svc.begin_epoch()
+        seen = []
+        # shard 0 completes; shard 1 is killed after its first batch
+        it = shard_consumer(cfg, 1, gen=gen, timeout=2,
+                            client=_client(cfg))
+        for _ in range(2):
+            idx, _s = next(it)
+            seen.append(idx)
+        svc.kill_shard(1)
+        with pytest.raises(ShardStalledError):
+            for idx, _s in it:
+                seen.append(idx)
+        for idx, _s in shard_consumer(cfg, 0, gen=gen, timeout=10,
+                                      client=_client(cfg)):
+            seen.append(idx)
+        gen = svc.reform(num_shards=2, reason="server_death")
+        for shard in range(2):
+            for idx, _s in shard_consumer(cfg, shard, gen=gen,
+                                          timeout=10,
+                                          client=_client(cfg)):
+                seen.append(idx)
+        assert sorted(seen) == list(range(24))   # exactly once
+        svc.drain_acks()
+        assert svc.ledger.remaining() == 0
+    finally:
+        svc.stop()
+
+
+def test_suspend_resume_preemption_to_zero(tmp_path):
+    svc = _service(tmp_path, n=12, shards=2, batch_size=2)
+    cfg = svc.start()
+    try:
+        gen = svc.begin_epoch()
+        it = shard_consumer(cfg, 0, gen=gen, timeout=5,
+                            client=_client(cfg))
+        # 3 samples: batch 1 (2 samples) acked when the consumer pulls
+        # batch 2; sample 3 is delivered-but-unacked — the documented
+        # at-least-once window for a consumer that dies mid-batch
+        first = [next(it)[0] for _ in range(3)]
+        svc.suspend()               # preempted to zero; cursors journaled
+        assert svc.ledger.remaining() == 12 - 2
+        gen = svc.reform(reason="resume")
+        seen = first[:2]            # the acked prefix stays visited
+        for shard in range(2):
+            for idx, _s in shard_consumer(cfg, shard, gen=gen,
+                                          timeout=10,
+                                          client=_client(cfg)):
+                seen.append(idx)
+        # the unacked sample is re-served in the new generation
+        assert sorted(seen) == list(range(12))
+        assert first[2] in seen[2:]
+    finally:
+        svc.stop()
+
+
+def test_background_ack_drainer_bounds_cursor_lag(tmp_path):
+    # HOROVOD_DATA_ACK_POLL_SECONDS > 0 folds acks into the journaled
+    # ledger continuously — no explicit drain_acks/reform needed
+    svc = _service(tmp_path, n=12, shards=1, ack_poll_seconds=0.05)
+    cfg = svc.start()
+    try:
+        gen = svc.begin_epoch()
+        it = shard_consumer(cfg, 0, gen=gen, timeout=10,
+                            client=_client(cfg))
+        got = [next(it)[0] for _ in range(8)]
+        assert len(got) == 8
+        # batch 1's ack (4 samples) landed when the consumer pulled
+        # batch 2; the drainer must journal it without being asked
+        deadline = time.monotonic() + 5.0
+        while svc.ledger.cur[0] < 4 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert svc.ledger.cur[0] >= 4
+        it.close()
+    finally:
+        svc.stop()
+
+
+def test_shard_producer_error_surfaces_traceback(tmp_path):
+    def bad_sample(i):
+        if i == 99:                 # the highest index of 100 samples
+            raise ValueError("sample 99 exploded")
+        return i
+
+    svc = ShardedDataService(bad_sample, num_samples=100, num_shards=1,
+                             batch_size=8, seed=0,
+                             journal_path=str(tmp_path / "j"))
+    cfg = svc.start()
+    try:
+        gen = svc.begin_epoch()
+        with pytest.raises(RuntimeError) as ei:
+            list(shard_consumer(cfg, 0, gen=gen, timeout=10,
+                                client=_client(cfg)))
+        msg = str(ei.value)
+        assert "shard server 0 failed" in msg
+        assert "ValueError: sample 99 exploded" in msg
+        assert "Traceback" in msg   # producer-side traceback forwarded
+    finally:
+        svc.stop()
+
+
+# -- chaos: kill_shard_server -------------------------------------------------
+
+def test_chaos_plan_kill_shard_server_parse():
+    from horovod_tpu.chaos.plan import parse_plan
+    p = parse_plan({"seed": 3, "events": [
+        {"kind": "kill_shard_server", "after_samples": 5, "proc": 1}]})
+    (e,) = p.data_events()
+    assert (e.side, e.trigger, e.at, e.proc) == ("data", "samples", 5, 1)
+    # data events never reach the per-rank injector
+    assert all(ev.kind != "kill_shard_server"
+               for ev in p.worker_events(1))
+    for bad in (
+            {"kind": "kill_shard_server", "after_samples": 2},
+            {"kind": "kill_shard_server", "after_requests": 2,
+             "proc": 0},
+            {"kind": "kill", "after_samples": 2, "proc": 0}):
+        with pytest.raises(ValueError):
+            parse_plan({"seed": 1, "events": [bad]})
+
+
+def test_chaos_kill_shard_server_fires(tmp_path, monkeypatch):
+    monkeypatch.setenv(
+        "HOROVOD_FAULT_PLAN",
+        '{"seed": 9, "events": [{"kind": "kill_shard_server", '
+        '"after_samples": 4, "proc": 1}]}')
+    svc = _service(tmp_path, n=24, shards=2, batch_size=2)
+    cfg = svc.start()
+    try:
+        gen = svc.begin_epoch()
+        seen = []
+        with pytest.raises(ShardStalledError):
+            for idx, _s in shard_consumer(cfg, 1, gen=gen, timeout=2,
+                                          client=_client(cfg)):
+                seen.append(idx)
+        assert len(seen) == 4       # died after exactly 4 published
+        assert svc.fired == [{
+            "kind": "kill_shard_server", "event": 0,
+            "trigger": "samples", "n": 4.0, "shard": 1, "gen": 0}]
+        for idx, _s in shard_consumer(cfg, 0, gen=gen, timeout=10,
+                                      client=_client(cfg)):
+            seen.append(idx)
+        gen = svc.reform(reason="server_death")
+        for shard in range(2):
+            for idx, _s in shard_consumer(cfg, shard, gen=gen,
+                                          timeout=10,
+                                          client=_client(cfg)):
+                seen.append(idx)
+        assert sorted(seen) == list(range(24))
+    finally:
+        svc.stop()
+
+
+# -- data service worker failures (reference service) -------------------------
+
+def test_data_service_worker_error_fails_consumer_loudly():
+    """A mid-epoch iterator exception must not look like clean EOF:
+    the consuming rank raises with the worker's traceback text."""
+    from horovod_tpu.data.service import DataServiceServer, data_service
+
+    def dataset_fn(w, n):
+        yield {"i": 0}
+        raise KeyError("mid-epoch explosion")
+
+    server = DataServiceServer(dataset_fn, num_workers=1)
+    cfg = server.start(0)
+    try:
+        it = data_service(cfg, rank=0, size=1, timeout=10)
+        assert next(it) == {"i": 0}
+        with pytest.raises(RuntimeError) as ei:
+            list(it)
+        msg = str(ei.value)
+        assert "data service worker 0 failed" in msg
+        assert "KeyError" in msg and "mid-epoch explosion" in msg
+        assert "Traceback" in msg and "dataset_fn" in msg
+    finally:
+        server.stop()
+
+
+# -- async loader + device feeder seams ---------------------------------------
+
+class _SlowLoader(AsyncDataLoaderMixin, BaseDataLoader):
+    def __init__(self, n, **kw):
+        self.n = n
+        super().__init__(**kw)
+
+    def __len__(self):
+        return self.n
+
+    def _iterate(self):
+        for i in range(self.n):
+            yield i
+
+
+def test_async_loader_close_while_prefetching_no_deadlock():
+    """close() while the worker is blocked on a full queue must not
+    wedge: the timed put observes the closing flag and gives up."""
+    loader = _SlowLoader(10_000, async_loading=True, queue_size=1)
+    it = iter(loader)
+    assert next(it) == 0            # worker now saturating the queue
+    t0 = time.monotonic()
+    loader.close_async_loader()
+    assert time.monotonic() - t0 < 5.0
+    assert loader._thread is None
+
+
+class _ExplodingLoader(AsyncDataLoaderMixin, BaseDataLoader):
+    def _iterate(self):
+        yield 1
+        raise OSError("disk fell off")
+
+
+def test_async_loader_worker_error_is_loud():
+    loader = _ExplodingLoader(async_loading=True, queue_size=2)
+    it = iter(loader)
+    assert next(it) == 1
+    with pytest.raises(RuntimeError) as ei:
+        list(it)
+    msg = str(ei.value)
+    assert "async data loader worker failed" in msg
+    assert "OSError: disk fell off" in msg and "Traceback" in msg
+    loader.close_async_loader()
+
+
+class _FakeStep:
+    def place_batch(self, batch):
+        return ("staged", batch)
+
+
+def test_device_feeder_early_exit_drain():
+    """Break out of iteration early, close(): the staging thread must
+    join (not stay wedged in put) and a re-entered iterator must end
+    cleanly instead of hanging."""
+    feeder = DeviceFeeder(_FakeStep(), iter(range(10_000)), prefetch=2)
+    got = []
+    for staged in feeder:
+        got.append(staged)
+        if len(got) == 3:
+            break                   # early exit: queue still full
+    feeder.close()
+    assert not feeder._thread.is_alive()
+    assert got == [("staged", i) for i in range(3)]
+    assert list(feeder) == []       # clean StopIteration, no hang
+
+
+# -- async CRC-anchored checkpointing -----------------------------------------
+
+def test_async_checkpointer_anchor_torn_fallback(tmp_path):
+    from horovod_tpu.utils.checkpoint import (
+        AsyncCheckpointer, CheckpointLoadError,
+    )
+    d = str(tmp_path / "ckpt")
+    ckpts = [AsyncCheckpointer(d, rank=r, world=2, commit_timeout=2.0)
+             for r in range(2)]
+    # rank 1's shard first so the committer's poll completes at once
+    ckpts[1].save(100, {"rank": 1, "step": 100}, wait=True)
+    ckpts[0].save(100, {"rank": 0, "step": 100}, wait=True)
+    assert ckpts[0].anchored_steps() == [100]
+
+    # torn save: only rank 0's shard of step 200 lands; the commit
+    # poll times out and the step stays unanchored
+    ckpts[0].save(200, {"rank": 0, "step": 200}, wait=True)
+    assert ckpts[0].anchored_steps() == [100]   # 200 never anchored
+    step, shards = ckpts[0].restore_shards()
+    assert step == 100                          # fell back past the tear
+    assert shards == {0: {"rank": 0, "step": 100},
+                      1: {"rank": 1, "step": 100}}
+    assert ckpts[1].restore_rank(rank=1) == (100, {"rank": 1,
+                                                   "step": 100})
+    for c in ckpts:
+        c.close()
+
+    empty = AsyncCheckpointer(str(tmp_path / "none"), rank=0, world=1)
+    with pytest.raises(CheckpointLoadError):
+        empty.restore_shards()
+    empty.close()
+
+
+def test_async_checkpointer_inline_mode(tmp_path, monkeypatch):
+    from horovod_tpu.utils.checkpoint import AsyncCheckpointer
+    monkeypatch.setenv("HOROVOD_DATA_ASYNC_CKPT", "0")
+    c = AsyncCheckpointer(str(tmp_path / "ckpt"), rank=0, world=1)
+    c.save(7, {"x": 1})             # synchronous despite wait=False
+    assert c.anchored_steps() == [7]
+    assert c.restore_rank() == (7, {"x": 1})
+    c.close()
+
+
+# -- distributed eval ---------------------------------------------------------
+
+def test_eval_shards_merge_over_kv(tmp_path):
+    svc = _service(tmp_path, n=20, shards=2,
+                   sample_fn=lambda i: float(i))
+    cfg = svc.start()
+    try:
+        gen = svc.begin_epoch()
+        threads = [threading.Thread(
+            target=run_eval_shard,
+            args=(cfg, s, lambda x: {"loss": 2.0 * x}),
+            kwargs=dict(gen=gen, batch_size=4, client=_client(cfg)))
+            for s in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=20)
+        svc.drain_acks()
+        assert svc.ledger.remaining() == 0
+        merged = merge_eval_results(_client(cfg), 2, gens=[gen])
+        assert merged["count"] == 20
+        assert merged["loss"] == pytest.approx(
+            sum(2.0 * i for i in range(20)) / 20)
+    finally:
+        svc.stop()
+
+
+def test_fleet_eval_job_kind(tmp_path):
+    from horovod_tpu.fleet.spec import parse_spec
+    spec = parse_spec({
+        "pool": {"h0": 2, "h1": 2},
+        "jobs": [
+            {"name": "serve", "kind": "serving", "min_np": 1,
+             "max_np": 2, "command": ["x"]},
+            {"name": "score", "kind": "eval", "min_np": 1, "max_np": 3,
+             "command": ["x"]},
+        ]})
+    assert spec.job("score").kind == "eval"
+    # slo stays serving-only
+    with pytest.raises(ValueError):
+        parse_spec({"pool": {"h0": 1},
+                    "jobs": [{"name": "e", "kind": "eval",
+                              "command": ["x"], "slo": {}}]})
+    # eval demand soaks surplus like training (max_np), not min_np
+    from horovod_tpu.fleet.controller import ManagedJob
+    job = ManagedJob(spec.job("score"))
+    assert job.demand == 3

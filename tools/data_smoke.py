@@ -1,0 +1,244 @@
+#!/usr/bin/env python
+"""CI data-plane gate (ci.sh `data` step; docs/data.md): a REAL
+multi-process drill over the sharded input service and the async
+CRC-anchored checkpointer.
+
+Scenario A — exactly-once under chaos.  A 2-shard
+:class:`ShardedDataService` serves 48 indexed samples over the HTTP
+KV fabric to consumer SUBPROCESSES (one per shard).  A seeded fault
+plan kills shard server 1 after its 6th published sample: its
+consumer exits on :class:`ShardStalledError` (exit code 7, never
+clean EOF), the driver re-forms the shard map from the journaled
+cursors, and fresh consumers finish the epoch.  The visitation
+histogram — merged across every consumer process — must be EXACTLY
+one visit per index.
+
+Scenario B — torn save invisible to restore.  Two rank subprocesses
+run :class:`AsyncCheckpointer` (world=2).  Both anchor step 1; rank 1
+is SIGKILLed mid-serialization of its step-2 shard (a state object
+that stalls inside pickling — the tmp file never reaches its
+``os.replace``), so step 2 never anchors and both the surviving rank
+and a fresh process restore step 1.
+
+The whole drill runs TWICE with the same seed; the evidence blobs
+(chaos records, reform generations, visitation histogram, ledger
+journal digest, checkpoint anchors — no wall clocks) must be
+byte-identical.
+"""
+
+import hashlib
+import json
+import os
+import pickle
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+N_SAMPLES = 48
+N_SHARDS = 2
+SEED = 1234
+FAULT_PLAN = ('{"seed": %d, "events": [{"kind": "kill_shard_server", '
+              '"after_samples": 6, "proc": 1}]}' % SEED)
+STALL_EXIT = 7
+
+
+# -- consumer subprocess ------------------------------------------------------
+
+def consume_main():
+    """DS_CONSUME=1: consume one shard, append visited indices to the
+    out file (one per line), exit 0 on clean end / STALL_EXIT on
+    stall."""
+    from horovod_tpu.data import ShardStalledError, shard_consumer
+    from horovod_tpu.data.service import DataServiceConfig
+
+    cfg = DataServiceConfig.from_dict(json.loads(os.environ["DS_CFG"]))
+    shard = int(os.environ["DS_SHARD"])
+    gen = int(os.environ["DS_GEN"])
+    out = os.environ["DS_OUT"]
+    visited = []
+    code = 0
+    try:
+        for idx, sample in shard_consumer(cfg, shard, gen=gen,
+                                          timeout=6.0):
+            assert sample == idx * 3, (idx, sample)
+            visited.append(idx)
+    except ShardStalledError:
+        code = STALL_EXIT
+    with open(out, "a") as f:
+        for idx in visited:
+            f.write(f"{idx}\n")
+    sys.exit(code)
+
+
+# -- checkpoint rank subprocess -----------------------------------------------
+
+class _StallingState:
+    """Pickles step-2's payload forever — the SIGKILL window."""
+
+    def __getstate__(self):
+        # signal the driver that serialization started, then stall
+        with open(os.environ["DS_MARKER"], "w") as f:
+            f.write("saving\n")
+        time.sleep(120)
+        return {}
+
+
+def ckpt_main():
+    """DS_CKPT_RANK=r: anchor step 1 (both ranks), then rank 0 attempts
+    step 2 (whose commit can never complete — rank 1 dies mid-save)
+    and reports what restore sees; rank 1 wedges in step-2
+    serialization until the driver SIGKILLs it."""
+    from horovod_tpu.utils.checkpoint import AsyncCheckpointer
+
+    rank = int(os.environ["DS_CKPT_RANK"])
+    d = os.environ["DS_CKPT_DIR"]
+    ckpt = AsyncCheckpointer(d, rank=rank, world=2, commit_timeout=20.0)
+    ckpt.save(1, {"rank": rank, "step": 1}, wait=True)
+    if rank == 1:
+        ckpt.save(2, _StallingState(), wait=True)   # killed in here
+        sys.exit(3)                                 # must not be reached
+    # rank 0: wait until step 1 anchors, then write a torn step 2
+    deadline = time.monotonic() + 20
+    while 1 not in ckpt.anchored_steps():
+        if time.monotonic() > deadline:
+            sys.exit(4)
+        time.sleep(0.05)
+    ckpt._save_shard(2, {"rank": 0, "step": 2})     # shard only, no anchor
+    step, shards = ckpt.restore_shards()
+    with open(os.environ["DS_OUT"], "w") as f:
+        json.dump({"anchored": ckpt.anchored_steps(), "restored": step,
+                   "ranks": sorted(shards)}, f, sort_keys=True)
+    ckpt.close()
+    sys.exit(0)
+
+
+# -- driver -------------------------------------------------------------------
+
+def _spawn(extra_env):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               HOROVOD_TPU_PLATFORM="cpu", **extra_env)
+    env.pop("HOROVOD_FAULT_PLAN", None)     # the plan targets the
+    # driver-side service, not the subprocesses
+    return subprocess.Popen([sys.executable, os.path.abspath(__file__)],
+                            env=env)
+
+
+def _consume_gen(cfg_json, gen, shards, out):
+    procs = [_spawn({"DS_CONSUME": "1", "DS_CFG": cfg_json,
+                     "DS_SHARD": str(s), "DS_GEN": str(gen),
+                     "DS_OUT": out}) for s in shards]
+    return [p.wait(timeout=120) for p in procs]
+
+
+def run_shard_drill(tmp):
+    from horovod_tpu.data import ShardedDataService
+
+    os.environ["HOROVOD_FAULT_PLAN"] = FAULT_PLAN
+    try:
+        svc = ShardedDataService(
+            lambda i: i * 3, num_samples=N_SAMPLES, num_shards=N_SHARDS,
+            batch_size=2, queue_size=2, seed=SEED,
+            journal_path=os.path.join(tmp, "shards.journal"))
+    finally:
+        del os.environ["HOROVOD_FAULT_PLAN"]
+    cfg = svc.start()
+    cfg_json = json.dumps(cfg.to_dict())
+    out = os.path.join(tmp, "visited.txt")
+    try:
+        gen = svc.begin_epoch()
+        codes = _consume_gen(cfg_json, gen, range(N_SHARDS), out)
+        assert codes[1] == STALL_EXIT, \
+            f"killed shard's consumer must stall loudly, got {codes}"
+        assert codes[0] == 0, codes
+        assert not svc.alive(1) and len(svc.fired) == 1, svc.fired
+        gen = svc.reform(reason="server_death")
+        codes = _consume_gen(cfg_json, gen, range(N_SHARDS), out)
+        assert codes == [0, 0], codes
+        svc.drain_acks()
+        remaining = svc.ledger.remaining()
+        assert remaining == 0, f"{remaining} samples never acked"
+    finally:
+        svc.stop()
+
+    with open(out) as f:
+        visits = [int(x) for x in f.read().split()]
+    hist = {}
+    for idx in visits:
+        hist[idx] = hist.get(idx, 0) + 1
+    assert sorted(hist) == list(range(N_SAMPLES)), "dropped samples"
+    dupes = {i: c for i, c in hist.items() if c != 1}
+    assert not dupes, f"replayed samples: {dupes}"
+    with open(os.path.join(tmp, "shards.journal"), "rb") as f:
+        journal_sha = hashlib.sha256(f.read()).hexdigest()
+    print(f"  exactly-once histogram: {N_SAMPLES}/{N_SAMPLES} indices "
+          f"visited once; chaos fired: {svc.fired[0]['kind']} "
+          f"shard={svc.fired[0]['shard']}")
+    return {"chaos_fired": svc.fired, "final_gen": gen,
+            "histogram_ok": True, "n": N_SAMPLES,
+            "journal_sha256": journal_sha}
+
+
+def run_ckpt_drill(tmp):
+    d = os.path.join(tmp, "ckpt")
+    marker = os.path.join(tmp, "r1.saving")
+    out = os.path.join(tmp, "ckpt.json")
+    r1 = _spawn({"DS_CKPT_RANK": "1", "DS_CKPT_DIR": d,
+                 "DS_MARKER": marker})
+    r0 = _spawn({"DS_CKPT_RANK": "0", "DS_CKPT_DIR": d,
+                 "DS_OUT": out, "DS_MARKER": marker})
+    deadline = time.monotonic() + 60
+    while not os.path.exists(marker):
+        if time.monotonic() > deadline:
+            r0.kill(); r1.kill()
+            raise AssertionError("rank 1 never reached its step-2 save")
+        time.sleep(0.05)
+    os.kill(r1.pid, signal.SIGKILL)      # mid-serialization, by design
+    assert r1.wait(timeout=30) == -signal.SIGKILL
+    assert r0.wait(timeout=60) == 0, "surviving rank failed"
+    with open(out) as f:
+        rec = json.load(f)
+    assert rec == {"anchored": [1], "restored": 1, "ranks": [0, 1]}, rec
+
+    # a FRESH process (the restarted job) must also land on step 1
+    from horovod_tpu.utils.checkpoint import AsyncCheckpointer
+    fresh = AsyncCheckpointer(d, rank=0, world=2)
+    step, shards = fresh.restore_shards()
+    assert step == 1 and sorted(shards) == [0, 1]
+    assert shards[1] == {"rank": 1, "step": 1}
+    fresh.close()
+    print("  torn step-2 save invisible: restored anchored step 1 "
+          "on survivor AND fresh process")
+    return rec
+
+
+def run_once(run_id):
+    tmp = tempfile.mkdtemp(prefix=f"data_smoke_{run_id}_")
+    print(f"[data_smoke] run {run_id}: shard drill "
+          f"(kill shard server 1 after 6 samples, reform, finish)")
+    evidence = {"shards": run_shard_drill(tmp)}
+    print(f"[data_smoke] run {run_id}: async-checkpoint drill "
+          f"(SIGKILL rank 1 mid step-2 save)")
+    evidence["ckpt"] = run_ckpt_drill(tmp)
+    return json.dumps(evidence, sort_keys=True).encode()
+
+
+def main():
+    blobs = [run_once(i) for i in range(2)]
+    assert blobs[0] == blobs[1], (
+        "same-seed evidence diverged:\n%r\n%r" % (blobs[0], blobs[1]))
+    print("[data_smoke] same-seed evidence byte-identical across runs")
+    print("[data_smoke] PASS")
+
+
+if __name__ == "__main__":
+    if os.environ.get("DS_CONSUME"):
+        consume_main()
+    elif os.environ.get("DS_CKPT_RANK"):
+        ckpt_main()
+    else:
+        main()

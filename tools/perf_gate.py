@@ -51,6 +51,10 @@ BENCHES = {
                 "--parallelism", "2,2,1", "--d-model", "64",
                 "--layers", "4", "--overlap-compare", "--iters", "8",
                 "--warmup", "2", "--overlap-bucket-bytes", "524288"],
+    # async CRC-anchored checkpointing: per-step impact of the
+    # background save at the default cadence/payload, plus the
+    # blocking cost it replaces (docs/data.md)
+    "ckpt": ["benchmarks/ckpt_bench.py", "--steps", "60"],
     # expert parallelism: capacity-routed MoE vs its dense-FLOP-
     # matched baseline on identical data (the loss-parity gate), plus
     # the quantized alltoall wire scrape the expert dispatch rides
@@ -153,6 +157,21 @@ METRICS = {
     # vs the grouped program, clean and faulted
     "overlap_bitwise_parity": (
         "overlap", lambda d: d["overlap_bitwise_parity"],
+        "eq", 0.0, 1.0),
+    # async checkpointing (pod-scale data plane PR).  The step-time
+    # impact of the background save is the gated number; the absolute
+    # ceiling (one full extra step per step) is the real bar — the
+    # relative band is deliberately huge because the overhead
+    # fraction is small and wall-clock-noisy on a shared runner, and
+    # the async-vs-sync wall-time win is a silicon metric (CPU BLAS
+    # already saturates the cores the background save would hide in)
+    "ckpt_async_overhead_frac": (
+        "ckpt", lambda d: d["ckpt_async_overhead_frac"],
+        "max", 30.0, 1.0),
+    # hiding the write must never mean losing it: every async save at
+    # the bench cadence must end journaled-anchored — exact
+    "ckpt_async_anchored_frac": (
+        "ckpt", lambda d: d["ckpt_async_anchored_frac"],
         "eq", 0.0, 1.0),
     # expert parallelism (fused quantized alltoall PR).  The loss gap
     # vs the dense-FLOP-matched baseline carries the <=1% acceptance
